@@ -1,0 +1,163 @@
+"""Chainable test fixture builders.
+
+Python analogue of the reference's Node/DaemonSet/Pod builders
+(upgrade_suit_test.go:201-372): chainable construction plus a ``create()``
+that registers the object in a FakeCluster and forces pod status the way the
+reference builders force Running+Ready via a status update.
+"""
+
+from __future__ import annotations
+
+import itertools
+from typing import Optional
+
+from tpu_operator_libs.consts import (
+    POD_CONTROLLER_REVISION_HASH_LABEL,
+    TPU_RESOURCE_NAME,
+)
+from tpu_operator_libs.k8s.fake import FakeCluster
+from tpu_operator_libs.k8s.objects import (
+    ContainerStatus,
+    DaemonSet,
+    DaemonSetSpec,
+    DaemonSetStatus,
+    Node,
+    ObjectMeta,
+    OwnerReference,
+    Pod,
+    PodPhase,
+    PodSpec,
+    PodStatus,
+    Volume,
+)
+
+_counter = itertools.count(1)
+
+
+def unique(prefix: str) -> str:
+    return f"{prefix}-{next(_counter)}"
+
+
+class NodeBuilder:
+    def __init__(self, name: Optional[str] = None) -> None:
+        self._node = Node(metadata=ObjectMeta(name=name or unique("node")))
+        self._node.metadata.labels[TPU_RESOURCE_NAME] = "true"
+
+    def with_labels(self, labels: dict[str, str]) -> "NodeBuilder":
+        self._node.metadata.labels.update(labels)
+        return self
+
+    def with_annotations(self, annotations: dict[str, str]) -> "NodeBuilder":
+        self._node.metadata.annotations.update(annotations)
+        return self
+
+    def with_upgrade_state(self, keys, state) -> "NodeBuilder":
+        self._node.metadata.labels[keys.state_label] = str(state)
+        return self
+
+    def unschedulable(self, value: bool = True) -> "NodeBuilder":
+        self._node.spec.unschedulable = value
+        return self
+
+    def not_ready(self) -> "NodeBuilder":
+        for cond in self._node.status.conditions:
+            if cond.type == "Ready":
+                cond.status = "False"
+        return self
+
+    def build(self) -> Node:
+        return self._node
+
+    def create(self, cluster: FakeCluster) -> Node:
+        return cluster.add_node(self._node)
+
+
+class DaemonSetBuilder:
+    def __init__(self, name: Optional[str] = None,
+                 namespace: str = "tpu-system") -> None:
+        self._ds = DaemonSet(
+            metadata=ObjectMeta(name=name or unique("ds"),
+                                namespace=namespace),
+            spec=DaemonSetSpec(),
+            status=DaemonSetStatus())
+        self._revision_hash = "rev1"
+
+    def with_labels(self, labels: dict[str, str]) -> "DaemonSetBuilder":
+        self._ds.metadata.labels.update(labels)
+        self._ds.spec.selector.update(labels)
+        return self
+
+    def with_desired_scheduled(self, n: int) -> "DaemonSetBuilder":
+        self._ds.status.desired_number_scheduled = n
+        return self
+
+    def with_revision_hash(self, rev_hash: str) -> "DaemonSetBuilder":
+        self._revision_hash = rev_hash
+        return self
+
+    def build(self) -> DaemonSet:
+        return self._ds
+
+    def create(self, cluster: FakeCluster) -> DaemonSet:
+        cluster.add_daemon_set(self._ds, revision_hash=self._revision_hash)
+        return self._ds
+
+
+class PodBuilder:
+    def __init__(self, name: Optional[str] = None,
+                 namespace: str = "tpu-system") -> None:
+        self._pod = Pod(
+            metadata=ObjectMeta(name=name or unique("pod"),
+                                namespace=namespace),
+            spec=PodSpec(),
+            status=PodStatus(phase=PodPhase.RUNNING,
+                             container_statuses=[
+                                 ContainerStatus(name="main", ready=True)]))
+
+    def on_node(self, node: Node | str) -> "PodBuilder":
+        self._pod.spec.node_name = (
+            node if isinstance(node, str) else node.metadata.name)
+        return self
+
+    def with_labels(self, labels: dict[str, str]) -> "PodBuilder":
+        self._pod.metadata.labels.update(labels)
+        return self
+
+    def owned_by(self, ds: DaemonSet) -> "PodBuilder":
+        self._pod.metadata.owner_references = [
+            OwnerReference(kind="DaemonSet", name=ds.metadata.name,
+                           uid=ds.metadata.uid)]
+        self._pod.metadata.labels.update(ds.spec.selector)
+        return self
+
+    def with_revision_hash(self, rev_hash: str) -> "PodBuilder":
+        self._pod.metadata.labels[POD_CONTROLLER_REVISION_HASH_LABEL] = rev_hash
+        return self
+
+    def with_phase(self, phase: PodPhase) -> "PodBuilder":
+        self._pod.status.phase = phase
+        return self
+
+    def ready(self, value: bool = True) -> "PodBuilder":
+        for c in self._pod.status.container_statuses:
+            c.ready = value
+        return self
+
+    def with_restart_count(self, count: int) -> "PodBuilder":
+        for c in self._pod.status.container_statuses:
+            c.restart_count = count
+        return self
+
+    def with_empty_dir(self) -> "PodBuilder":
+        self._pod.spec.volumes.append(Volume(name="scratch", empty_dir=True))
+        return self
+
+    def orphaned(self) -> "PodBuilder":
+        self._pod.metadata.owner_references = []
+        return self
+
+    def build(self) -> Pod:
+        return self._pod
+
+    def create(self, cluster: FakeCluster) -> Pod:
+        return cluster.add_pod(self._pod)

@@ -1,0 +1,179 @@
+"""FakeCluster tests: selector semantics, patch semantics, value semantics,
+eviction, DS-controller simulation (the envtest-substitute fixture itself)."""
+
+import pytest
+
+from tpu_operator_libs.consts import POD_CONTROLLER_REVISION_HASH_LABEL
+from tpu_operator_libs.k8s.client import EvictionBlockedError, NotFoundError
+from tpu_operator_libs.k8s.fake import FakeCluster
+from tpu_operator_libs.k8s.objects import PodPhase
+from tpu_operator_libs.k8s.selectors import (
+    SelectorParseError,
+    matches_labels,
+    parse_field_selector,
+    selector_from_labels,
+)
+from tpu_operator_libs.util import FakeClock
+
+from builders import DaemonSetBuilder, NodeBuilder, PodBuilder
+
+
+class TestSelectors:
+    @pytest.mark.parametrize("selector,labels,expected", [
+        ("app=driver", {"app": "driver"}, True),
+        ("app=driver", {"app": "other"}, False),
+        ("app==driver", {"app": "driver"}, True),
+        ("app!=driver", {"app": "other"}, True),
+        ("app!=driver", {}, True),
+        ("app", {"app": "x"}, True),
+        ("app", {}, False),
+        ("!app", {}, True),
+        ("!app", {"app": "x"}, False),
+        ("env in (prod,dev)", {"env": "dev"}, True),
+        ("env in (prod,dev)", {"env": "qa"}, False),
+        ("env notin (prod)", {"env": "dev"}, True),
+        ("env notin (prod)", {}, True),
+        ("a=1,b=2", {"a": "1", "b": "2"}, True),
+        ("a=1,b=2", {"a": "1"}, False),
+        ("", {"anything": "x"}, True),
+    ])
+    def test_label_selectors(self, selector, labels, expected):
+        assert matches_labels(selector, labels) is expected
+
+    def test_field_selector(self):
+        m = parse_field_selector("spec.nodeName=node-1")
+        assert m({"spec.nodeName": "node-1"})
+        assert not m({"spec.nodeName": "node-2"})
+        m2 = parse_field_selector("status.phase!=Running")
+        assert m2({"status.phase": "Failed"})
+
+    def test_selector_from_labels(self):
+        assert selector_from_labels({"b": "2", "a": "1"}) == "a=1,b=2"
+
+    def test_parse_error(self):
+        with pytest.raises(SelectorParseError):
+            matches_labels("a><b", {})
+
+
+class TestFakeClusterNodes:
+    def test_get_returns_copy(self):
+        cluster = FakeCluster()
+        NodeBuilder("n1").create(cluster)
+        node = cluster.get_node("n1")
+        node.metadata.labels["mutated"] = "yes"
+        assert "mutated" not in cluster.get_node("n1").metadata.labels
+
+    def test_patch_labels_merge_and_delete(self):
+        cluster = FakeCluster()
+        NodeBuilder("n1").with_labels({"keep": "1", "drop": "x"}).create(cluster)
+        cluster.patch_node_labels("n1", {"new": "2", "drop": None})
+        labels = cluster.get_node("n1").metadata.labels
+        assert labels["keep"] == "1" and labels["new"] == "2"
+        assert "drop" not in labels
+
+    def test_patch_annotations(self):
+        cluster = FakeCluster()
+        NodeBuilder("n1").create(cluster)
+        cluster.patch_node_annotations("n1", {"a": "1"})
+        assert cluster.get_node("n1").metadata.annotations["a"] == "1"
+        cluster.patch_node_annotations("n1", {"a": None})
+        assert "a" not in cluster.get_node("n1").metadata.annotations
+
+    def test_cordon_flag(self):
+        cluster = FakeCluster()
+        NodeBuilder("n1").create(cluster)
+        cluster.set_node_unschedulable("n1", True)
+        assert cluster.get_node("n1").is_unschedulable()
+
+    def test_missing_node_raises(self):
+        with pytest.raises(NotFoundError):
+            FakeCluster().get_node("ghost")
+
+    def test_stale_reads_then_converge(self):
+        cluster = FakeCluster()
+        NodeBuilder("n1").create(cluster)
+        cluster.inject_stale_node_reads("n1", reads=2)
+        cluster.patch_node_labels("n1", {"k": "v"})
+        assert "k" not in cluster.get_node("n1").metadata.labels  # stale 1
+        assert "k" not in cluster.get_node("n1").metadata.labels  # stale 2
+        assert cluster.get_node("n1").metadata.labels["k"] == "v"  # synced
+
+
+class TestFakeClusterPods:
+    def test_list_by_label_and_field(self):
+        cluster = FakeCluster()
+        n1 = NodeBuilder("n1").create(cluster)
+        n2 = NodeBuilder("n2").create(cluster)
+        PodBuilder("p1").on_node(n1).with_labels({"app": "a"}).create(cluster)
+        PodBuilder("p2").on_node(n2).with_labels({"app": "a"}).create(cluster)
+        PodBuilder("p3").on_node(n1).with_labels({"app": "b"}).create(cluster)
+        pods = cluster.list_pods(label_selector="app=a",
+                                 field_selector="spec.nodeName=n1")
+        assert [p.name for p in pods] == ["p1"]
+
+    def test_all_namespaces(self):
+        cluster = FakeCluster()
+        PodBuilder("p1", namespace="ns1").create(cluster)
+        PodBuilder("p2", namespace="ns2").create(cluster)
+        assert len(cluster.list_pods()) == 2
+        assert len(cluster.list_pods(namespace="ns1")) == 1
+
+    def test_delete_pod(self):
+        cluster = FakeCluster()
+        PodBuilder("p1").create(cluster)
+        cluster.delete_pod("tpu-system", "p1")
+        assert cluster.list_pods() == []
+        with pytest.raises(NotFoundError):
+            cluster.delete_pod("tpu-system", "p1")
+
+    def test_eviction_blocker(self):
+        cluster = FakeCluster()
+        PodBuilder("p1").with_labels({"protected": "true"}).create(cluster)
+        cluster.add_eviction_blocker(
+            lambda pod: pod.metadata.labels.get("protected") == "true")
+        with pytest.raises(EvictionBlockedError):
+            cluster.evict_pod("tpu-system", "p1")
+        assert len(cluster.list_pods()) == 1  # still there
+
+
+class TestDaemonSetsAndRevisions:
+    def test_revision_tracking(self):
+        cluster = FakeCluster()
+        ds = DaemonSetBuilder("libtpu").with_labels(
+            {"app": "libtpu"}).with_revision_hash("aaa").create(cluster)
+        assert cluster.latest_revision_hash("tpu-system", "libtpu") == "aaa"
+        cluster.bump_daemon_set_revision("tpu-system", "libtpu", "bbb")
+        assert cluster.latest_revision_hash("tpu-system", "libtpu") == "bbb"
+        revs = cluster.list_controller_revisions(
+            "tpu-system", "app=libtpu")
+        assert {r.hash for r in revs} == {"aaa", "bbb"}
+        assert max(revs, key=lambda r: r.revision).hash == "bbb"
+        assert ds.metadata.name == "libtpu"
+
+    def test_ds_controller_simulation(self):
+        clock = FakeClock()
+        cluster = FakeCluster(clock=clock)
+        cluster.enable_ds_controller(recreate_delay=5, ready_delay=10)
+        NodeBuilder("n1").create(cluster)
+        ds = DaemonSetBuilder("libtpu").with_labels(
+            {"app": "libtpu"}).with_revision_hash("old").create(cluster)
+        PodBuilder("p-old").on_node("n1").owned_by(ds) \
+            .with_revision_hash("old").create(cluster)
+        cluster.bump_daemon_set_revision("tpu-system", "libtpu", "new")
+
+        cluster.delete_pod("tpu-system", "p-old")
+        assert cluster.list_pods() == []
+
+        clock.advance(5)
+        cluster.step()
+        pods = cluster.list_pods(label_selector="app=libtpu")
+        assert len(pods) == 1
+        new_pod = pods[0]
+        assert new_pod.metadata.labels[
+            POD_CONTROLLER_REVISION_HASH_LABEL] == "new"
+        assert new_pod.status.phase == PodPhase.RUNNING
+        assert not new_pod.is_ready()
+
+        clock.advance(10)
+        cluster.step()
+        assert cluster.list_pods()[0].is_ready()

@@ -1,0 +1,114 @@
+"""Policy API tests: defaults, validation, round-trip, int-or-percent scaling
+(api/upgrade/v1alpha1/upgrade_spec.go parity)."""
+
+import pytest
+
+from tpu_operator_libs.api.upgrade_policy import (
+    DrainSpec,
+    PodDeletionSpec,
+    PolicyValidationError,
+    UpgradePolicySpec,
+    WaitForCompletionSpec,
+    scaled_value_from_int_or_percent,
+)
+
+
+class TestDefaults:
+    def test_policy_defaults_match_reference(self):
+        # upgrade_spec.go:27-49 kubebuilder defaults
+        p = UpgradePolicySpec()
+        assert p.auto_upgrade is False
+        assert p.max_parallel_upgrades == 1
+        assert p.max_unavailable == "25%"
+        assert p.pod_deletion is None and p.drain is None
+
+    def test_sub_spec_defaults(self):
+        assert PodDeletionSpec().timeout_seconds == 300
+        assert DrainSpec().timeout_seconds == 300
+        assert WaitForCompletionSpec().timeout_seconds == 0
+        assert DrainSpec().enable is False
+
+
+class TestScaling:
+    # intstr.GetScaledValueFromIntOrPercent semantics
+    # (upgrade_state.go:395-401: percentages round up)
+    @pytest.mark.parametrize("value,total,expected", [
+        (5, 100, 5),
+        ("25%", 4, 1),
+        ("25%", 10, 3),       # 2.5 rounds up
+        ("10%", 9, 1),        # 0.9 rounds up
+        ("100%", 7, 7),
+        ("0%", 10, 0),
+        (0, 10, 0),
+        ("5", 10, 5),         # bare int string
+        (None, 8, 8),         # nil ⇒ no limit ⇒ total
+    ])
+    def test_scaled(self, value, total, expected):
+        assert scaled_value_from_int_or_percent(value, total) == expected
+
+    def test_round_down(self):
+        assert scaled_value_from_int_or_percent("25%", 10, round_up=False) == 2
+
+    @pytest.mark.parametrize("bad", ["abc", "x%", True])
+    def test_invalid(self, bad):
+        with pytest.raises(PolicyValidationError):
+            scaled_value_from_int_or_percent(bad, 10)
+
+
+class TestValidation:
+    def test_negative_parallel(self):
+        with pytest.raises(PolicyValidationError):
+            UpgradePolicySpec(max_parallel_upgrades=-1).validate()
+
+    def test_negative_timeouts(self):
+        with pytest.raises(PolicyValidationError):
+            UpgradePolicySpec(drain=DrainSpec(timeout_seconds=-5)).validate()
+        with pytest.raises(PolicyValidationError):
+            UpgradePolicySpec(
+                pod_deletion=PodDeletionSpec(timeout_seconds=-1)).validate()
+
+    def test_bad_topology_mode(self):
+        with pytest.raises(PolicyValidationError):
+            UpgradePolicySpec(topology_mode="ring").validate()
+
+    def test_valid_policy(self):
+        UpgradePolicySpec(
+            auto_upgrade=True, max_parallel_upgrades=0,
+            max_unavailable="50%",
+            drain=DrainSpec(enable=True),
+            pod_deletion=PodDeletionSpec(),
+            wait_for_completion=WaitForCompletionSpec(pod_selector="app=job"),
+        ).validate()
+
+
+class TestRoundTrip:
+    def test_dict_round_trip(self):
+        p = UpgradePolicySpec(
+            auto_upgrade=True, max_parallel_upgrades=2, max_unavailable=3,
+            drain=DrainSpec(enable=True, force=True, pod_selector="a=b",
+                            timeout_seconds=60, delete_empty_dir=True),
+            pod_deletion=PodDeletionSpec(force=True, timeout_seconds=30),
+            wait_for_completion=WaitForCompletionSpec(
+                pod_selector="job=train", timeout_seconds=120),
+            topology_mode="slice")
+        restored = UpgradePolicySpec.from_dict(p.to_dict())
+        assert restored == p
+
+    def test_from_yaml_shape(self):
+        # mirrors the policy YAML in docs/automatic-ofed-upgrade.md:11-39
+        data = {
+            "autoUpgrade": True,
+            "maxParallelUpgrades": 1,
+            "drain": {"enable": True, "force": False,
+                      "podSelector": "", "timeoutSeconds": 300,
+                      "deleteEmptyDir": False},
+        }
+        p = UpgradePolicySpec.from_dict(data)
+        assert p.auto_upgrade and p.drain.enable
+        assert p.max_unavailable == "25%"  # default survives
+
+    def test_deep_copy_isolated(self):
+        p = UpgradePolicySpec(drain=DrainSpec(enable=True))
+        q = p.deep_copy()
+        q.drain.enable = False
+        assert p.drain.enable is True

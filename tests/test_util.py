@@ -1,0 +1,147 @@
+"""Tests for concurrency primitives and key builders (pkg/upgrade/util.go
+parity: StringSet/KeyedMutex behavior, instance-scoped key construction)."""
+
+import threading
+
+from tpu_operator_libs.consts import UpgradeKeys, UpgradeState
+from tpu_operator_libs.util import (
+    EventRecorder,
+    FakeClock,
+    KeyedLock,
+    NameSet,
+    Worker,
+    log_event,
+)
+
+
+class TestNameSet:
+    def test_add_remove_has(self):
+        s = NameSet()
+        assert s.add("a")
+        assert "a" in s
+        assert not s.add("a")  # atomic test-and-set: second add fails
+        s.remove("a")
+        assert "a" not in s
+        s.remove("a")  # removing absent item is a no-op
+
+    def test_clear_and_len(self):
+        s = NameSet()
+        s.add("a")
+        s.add("b")
+        assert len(s) == 2
+        s.clear()
+        assert len(s) == 0
+
+    def test_concurrent_add_is_exclusive(self):
+        s = NameSet()
+        wins = []
+
+        def worker():
+            if s.add("node-1"):
+                wins.append(1)
+
+        threads = [threading.Thread(target=worker) for _ in range(32)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+        assert len(wins) == 1
+
+
+class TestKeyedLock:
+    def test_same_key_serializes(self):
+        lock = KeyedLock()
+        order = []
+
+        def worker(i):
+            with lock.lock("node"):
+                order.append(("enter", i))
+                order.append(("exit", i))
+
+        threads = [threading.Thread(target=worker, args=(i,)) for i in range(8)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+        # entries and exits must be strictly paired (no interleaving)
+        for j in range(0, len(order), 2):
+            assert order[j][0] == "enter"
+            assert order[j + 1][0] == "exit"
+            assert order[j][1] == order[j + 1][1]
+
+    def test_different_keys_independent(self):
+        lock = KeyedLock()
+        held = lock.lock("a")
+        done = []
+
+        def worker():
+            with lock.lock("b"):
+                done.append(True)
+
+        t = threading.Thread(target=worker)
+        t.start()
+        t.join(timeout=2)
+        assert done == [True]
+        held.release()
+
+
+class TestUpgradeKeys:
+    def test_tpu_defaults(self):
+        keys = UpgradeKeys()
+        assert keys.state_label == "google.com/libtpu-upgrade-state"
+        assert keys.skip_label == "google.com/libtpu-upgrade.skip"
+        assert keys.wait_for_safe_load_annotation == (
+            "google.com/libtpu-upgrade.wait-for-safe-load")
+        assert keys.upgrade_requested_annotation == (
+            "google.com/libtpu-upgrade-requested")
+        assert keys.event_reason == "LIBTPURuntimeUpgrade"
+
+    def test_gpu_flavour_coexists(self):
+        # No process-global driver name: two instances, two key namespaces
+        # (fixes the reference wart at util.go:87-95).
+        tpu = UpgradeKeys()
+        gpu = UpgradeKeys(driver="gpu", domain="nvidia.com")
+        assert gpu.state_label == "nvidia.com/gpu-upgrade-state"
+        assert tpu.state_label != gpu.state_label
+
+    def test_states_are_strings(self):
+        assert str(UpgradeState.DONE) == "upgrade-done"
+        assert UpgradeState("upgrade-failed") is UpgradeState.FAILED
+        assert UpgradeState("") is UpgradeState.UNKNOWN
+
+
+class TestClockAndEvents:
+    def test_fake_clock(self):
+        clock = FakeClock(start=100.0)
+        assert clock.now() == 100.0
+        clock.advance(50)
+        assert clock.now() == 150.0
+        clock.sleep(10)
+        assert clock.now() == 160.0
+
+    def test_event_recorder(self):
+        rec = EventRecorder()
+
+        class Obj:
+            class metadata:
+                name = "node-1"
+
+        log_event(rec, Obj(), "Normal", "LIBTPURuntimeUpgrade", "hello")
+        log_event(None, Obj(), "Normal", "X", "ignored")  # nil-safe
+        assert len(rec.events) == 1
+        assert rec.find(reason="LIBTPURuntimeUpgrade")[0].object_name == "node-1"
+
+
+class TestWorker:
+    def test_sync_mode_runs_inline(self):
+        w = Worker(async_mode=False)
+        out = []
+        w.submit(lambda: out.append(1))
+        assert out == [1]
+
+    def test_async_mode_joins(self):
+        w = Worker(async_mode=True)
+        out = []
+        w.submit(lambda: out.append(1))
+        w.join()
+        assert out == [1]

@@ -1,0 +1,450 @@
+"""FakeCluster: a thread-safe in-memory Kubernetes API server.
+
+This is the build's envtest substitute (SURVEY.md §4 / BASELINE config #1:
+"single-node UpgradeStateManager reconcile via envtest + fake clientset").
+The reference test suite boots a real etcd+apiserver via envtest
+(upgrade_suit_test.go:73-97); we model the same observable semantics in
+memory:
+
+- Value semantics: every read returns a deep copy, every write goes through
+  an explicit API call — callers can never mutate the store through a
+  returned object, exactly like objects that crossed the wire.
+- Merge-patch label/annotation updates with ``None`` ⇒ delete, matching the
+  raw patches the reference issues (node_upgrade_state_provider.go:80-82,
+  147-151).
+- Label/field selector list semantics via tpu_operator_libs.k8s.selectors.
+- No kubelet and no controllers by default: deleting a pod just deletes it —
+  the property the reference's drain tests rely on (SURVEY.md §4 caveat).
+
+Beyond envtest, an optional **DaemonSet controller simulation**
+(:meth:`FakeCluster.enable_ds_controller`) recreates deleted DS-owned pods
+with the newest ControllerRevision hash after a configurable (virtual) delay
+and marks them Ready after another delay. Combined with the injectable Clock
+this turns the fake into a discrete-event simulator of a rolling upgrade —
+the engine behind ``bench.py`` and the e2e tests (BASELINE configs #2-#4).
+"""
+
+from __future__ import annotations
+
+import copy
+import threading
+from dataclasses import dataclass, field
+from typing import Callable, Mapping, Optional
+
+from tpu_operator_libs.consts import POD_CONTROLLER_REVISION_HASH_LABEL
+from tpu_operator_libs.k8s.client import (
+    EvictionBlockedError,
+    K8sClient,
+    NotFoundError,
+)
+from tpu_operator_libs.k8s.objects import (
+    ContainerStatus,
+    ControllerRevision,
+    DaemonSet,
+    Node,
+    ObjectMeta,
+    OwnerReference,
+    Pod,
+    PodPhase,
+    PodSpec,
+    PodStatus,
+    new_uid,
+)
+from tpu_operator_libs.k8s.selectors import (
+    parse_field_selector,
+    parse_label_selector,
+)
+from tpu_operator_libs.util import Clock
+
+
+def _pod_fields(pod: Pod) -> dict[str, str]:
+    return {
+        "metadata.name": pod.metadata.name,
+        "metadata.namespace": pod.metadata.namespace,
+        "spec.nodeName": pod.spec.node_name,
+        "status.phase": str(pod.status.phase),
+    }
+
+
+@dataclass
+class _DsControllerConfig:
+    recreate_delay: float = 5.0
+    ready_delay: float = 10.0
+    enabled: bool = True
+
+
+@dataclass(order=True)
+class _ScheduledAction:
+    due: float
+    seq: int
+    action: Callable[[], None] = field(compare=False)
+
+
+class FakeCluster(K8sClient):
+    """In-memory cluster store implementing :class:`K8sClient`."""
+
+    def __init__(self, clock: Optional[Clock] = None) -> None:
+        self._clock = clock or Clock()
+        self._lock = threading.RLock()
+        self._nodes: dict[str, Node] = {}
+        self._pods: dict[tuple[str, str], Pod] = {}
+        self._daemon_sets: dict[tuple[str, str], DaemonSet] = {}
+        self._revisions: dict[tuple[str, str], ControllerRevision] = {}
+        # Revision ownership by DS identity, so DaemonSets whose names share
+        # a prefix (e.g. "tpu" / "tpu-plugin") never see each other's
+        # revisions. (The reference's prefix-scan, pod_manager.go:104-109,
+        # has exactly that collision; the fake must not inherit it.)
+        self._revision_owner: dict[tuple[str, str], tuple[str, str]] = {}
+        self._scheduled: list[_ScheduledAction] = []
+        self._seq = 0
+        self._ds_controller: Optional[_DsControllerConfig] = None
+        self._eviction_blockers: list[Callable[[Pod], bool]] = []
+        # Per-node count of reads that should return a stale copy, to
+        # exercise the provider's cache-sync poll loop
+        # (node_upgrade_state_provider.go:100-117).
+        self._stale_reads: dict[str, tuple[int, Node]] = {}
+
+    # ------------------------------------------------------------------
+    # test/simulation helpers
+    # ------------------------------------------------------------------
+    @property
+    def clock(self) -> Clock:
+        return self._clock
+
+    def add_node(self, node: Node) -> Node:
+        with self._lock:
+            self._nodes[node.metadata.name] = copy.deepcopy(node)
+        return node
+
+    def add_pod(self, pod: Pod) -> Pod:
+        with self._lock:
+            self._pods[(pod.metadata.namespace, pod.metadata.name)] = (
+                copy.deepcopy(pod))
+        return pod
+
+    def add_daemon_set(self, ds: DaemonSet,
+                       revision_hash: str = "rev-1",
+                       revision: int = 1) -> DaemonSet:
+        """Register a DaemonSet plus its current ControllerRevision.
+
+        The revision object is named ``<ds-name>-<hash>`` so the hash can be
+        recovered as the name suffix (pod_manager.go:118-119).
+        """
+        with self._lock:
+            self._daemon_sets[(ds.metadata.namespace, ds.metadata.name)] = (
+                copy.deepcopy(ds))
+            rev_name = f"{ds.metadata.name}-{revision_hash}"
+            rev = ControllerRevision(
+                metadata=ObjectMeta(name=rev_name,
+                                    namespace=ds.metadata.namespace,
+                                    labels=dict(ds.spec.selector)),
+                revision=revision)
+            self._revisions[(ds.metadata.namespace, rev_name)] = rev
+            self._revision_owner[(ds.metadata.namespace, rev_name)] = (
+                ds.metadata.namespace, ds.metadata.name)
+        return ds
+
+    def _revisions_of(self, namespace: str, ds_name: str) -> list[ControllerRevision]:
+        """Revisions owned by exactly this DaemonSet (lock must be held)."""
+        return [rev for key, rev in self._revisions.items()
+                if self._revision_owner.get(key) == (namespace, ds_name)]
+
+    def bump_daemon_set_revision(self, namespace: str, name: str,
+                                 revision_hash: str) -> None:
+        """Roll the DS template: add a newer ControllerRevision.
+
+        Existing pods keep their old ``controller-revision-hash`` label and
+        are therefore out of sync — the trigger condition for an upgrade
+        (upgrade_state.go:558-578).
+        """
+        with self._lock:
+            ds = self._daemon_sets[(namespace, name)]
+            ds.spec.template_generation += 1
+            latest = max((r.revision for r in self._revisions_of(namespace, name)),
+                         default=0)
+            rev_name = f"{name}-{revision_hash}"
+            self._revisions[(namespace, rev_name)] = ControllerRevision(
+                metadata=ObjectMeta(name=rev_name, namespace=namespace,
+                                    labels=dict(ds.spec.selector)),
+                revision=latest + 1)
+            self._revision_owner[(namespace, rev_name)] = (namespace, name)
+
+    def latest_revision_hash(self, namespace: str, name: str) -> str:
+        with self._lock:
+            revs = self._revisions_of(namespace, name)
+            if not revs:
+                raise NotFoundError(f"no revisions for daemonset {name}")
+            return max(revs, key=lambda r: r.revision).hash
+
+    def enable_ds_controller(self, recreate_delay: float = 5.0,
+                             ready_delay: float = 10.0) -> None:
+        """Simulate the DaemonSet controller + kubelet: deleted DS pods are
+        recreated with the newest revision hash after ``recreate_delay``
+        (virtual) seconds and become Ready ``ready_delay`` seconds later."""
+        with self._lock:
+            self._ds_controller = _DsControllerConfig(
+                recreate_delay=recreate_delay, ready_delay=ready_delay)
+
+    def add_eviction_blocker(self, blocker: Callable[[Pod], bool]) -> None:
+        """Register a predicate that vetoes evictions (PDB analogue)."""
+        with self._lock:
+            self._eviction_blockers.append(blocker)
+
+    def inject_stale_node_reads(self, name: str, reads: int) -> None:
+        """Make the next ``reads`` get_node() calls return the current
+        (pre-future-patch) snapshot, emulating controller-runtime cache lag
+        that the provider's poll loop exists to absorb
+        (node_upgrade_state_provider.go:92-99)."""
+        if reads <= 0:
+            return
+        with self._lock:
+            node = self._nodes.get(name)
+            if node is None:
+                raise NotFoundError(name)
+            self._stale_reads[name] = (reads, copy.deepcopy(node))
+
+    def step(self, until: Optional[float] = None) -> int:
+        """Run scheduled simulation actions due at or before ``until``
+        (defaults to the clock's current time). Returns actions run."""
+        now = self._clock.now() if until is None else until
+        ran = 0
+        while True:
+            with self._lock:
+                due = [a for a in self._scheduled if a.due <= now]
+                if not due:
+                    return ran
+                due.sort()
+                action = due[0]
+                self._scheduled.remove(action)
+            action.action()
+            ran += 1
+
+    def pending_actions(self) -> int:
+        with self._lock:
+            return len(self._scheduled)
+
+    def next_action_due(self) -> Optional[float]:
+        with self._lock:
+            if not self._scheduled:
+                return None
+            return min(a.due for a in self._scheduled)
+
+    def _schedule(self, delay: float, action: Callable[[], None]) -> None:
+        with self._lock:
+            self._seq += 1
+            self._scheduled.append(
+                _ScheduledAction(self._clock.now() + delay, self._seq, action))
+
+    # ------------------------------------------------------------------
+    # K8sClient: nodes
+    # ------------------------------------------------------------------
+    def get_node(self, name: str) -> Node:
+        with self._lock:
+            stale = self._stale_reads.get(name)
+            if stale is not None:
+                remaining, snapshot = stale
+                if remaining > 1:
+                    self._stale_reads[name] = (remaining - 1, snapshot)
+                else:
+                    del self._stale_reads[name]
+                return copy.deepcopy(snapshot)
+            node = self._nodes.get(name)
+            if node is None:
+                raise NotFoundError(f"node {name!r} not found")
+            return copy.deepcopy(node)
+
+    def list_nodes(self, label_selector: str = "") -> list[Node]:
+        match = parse_label_selector(label_selector)
+        with self._lock:
+            return [copy.deepcopy(n) for n in self._nodes.values()
+                    if match(n.metadata.labels)]
+
+    def _mutate_node(self, name: str) -> Node:
+        node = self._nodes.get(name)
+        if node is None:
+            raise NotFoundError(f"node {name!r} not found")
+        node.metadata.resource_version += 1
+        return node
+
+    def patch_node_labels(self, name: str,
+                          labels: Mapping[str, Optional[str]]) -> Node:
+        with self._lock:
+            node = self._mutate_node(name)
+            for key, value in labels.items():
+                if value is None:
+                    node.metadata.labels.pop(key, None)
+                else:
+                    node.metadata.labels[key] = value
+            return copy.deepcopy(node)
+
+    def patch_node_annotations(self, name: str,
+                               annotations: Mapping[str, Optional[str]]) -> Node:
+        with self._lock:
+            node = self._mutate_node(name)
+            for key, value in annotations.items():
+                if value is None:
+                    node.metadata.annotations.pop(key, None)
+                else:
+                    node.metadata.annotations[key] = value
+            return copy.deepcopy(node)
+
+    def set_node_unschedulable(self, name: str, unschedulable: bool) -> Node:
+        with self._lock:
+            node = self._mutate_node(name)
+            node.spec.unschedulable = unschedulable
+            return copy.deepcopy(node)
+
+    def set_node_ready(self, name: str, ready: bool) -> Node:
+        """Test helper: flip the node Ready condition."""
+        with self._lock:
+            node = self._mutate_node(name)
+            for cond in node.status.conditions:
+                if cond.type == "Ready":
+                    cond.status = "True" if ready else "False"
+                    break
+            else:
+                from tpu_operator_libs.k8s.objects import NodeCondition
+                node.status.conditions.append(
+                    NodeCondition("Ready", "True" if ready else "False"))
+            return copy.deepcopy(node)
+
+    # ------------------------------------------------------------------
+    # K8sClient: pods
+    # ------------------------------------------------------------------
+    def list_pods(self, namespace: Optional[str] = None,
+                  label_selector: str = "",
+                  field_selector: str = "") -> list[Pod]:
+        label_match = parse_label_selector(label_selector)
+        field_match = parse_field_selector(field_selector)
+        with self._lock:
+            out = []
+            for (ns, _), pod in self._pods.items():
+                if namespace is not None and namespace != "" and ns != namespace:
+                    continue
+                if not label_match(pod.metadata.labels):
+                    continue
+                if not field_match(_pod_fields(pod)):
+                    continue
+                out.append(copy.deepcopy(pod))
+            return out
+
+    def get_pod(self, namespace: str, name: str) -> Pod:
+        with self._lock:
+            pod = self._pods.get((namespace, name))
+            if pod is None:
+                raise NotFoundError(f"pod {namespace}/{name} not found")
+            return copy.deepcopy(pod)
+
+    def set_pod_status(self, namespace: str, name: str,
+                       phase: Optional[PodPhase] = None,
+                       ready: Optional[bool] = None,
+                       restart_count: Optional[int] = None) -> Pod:
+        """Test helper: status subresource update (the builders in the
+        reference suite force Running+Ready the same way,
+        upgrade_suit_test.go:311-329)."""
+        with self._lock:
+            pod = self._pods.get((namespace, name))
+            if pod is None:
+                raise NotFoundError(f"pod {namespace}/{name} not found")
+            if phase is not None:
+                pod.status.phase = phase
+            if ready is not None:
+                if not pod.status.container_statuses:
+                    pod.status.container_statuses = [
+                        ContainerStatus(name="main")]
+                for c in pod.status.container_statuses:
+                    c.ready = ready
+            if restart_count is not None:
+                for c in pod.status.container_statuses:
+                    c.restart_count = restart_count
+            pod.metadata.resource_version += 1
+            return copy.deepcopy(pod)
+
+    def delete_pod(self, namespace: str, name: str) -> None:
+        with self._lock:
+            pod = self._pods.pop((namespace, name), None)
+            if pod is None:
+                raise NotFoundError(f"pod {namespace}/{name} not found")
+            self._maybe_recreate_ds_pod(pod)
+
+    def evict_pod(self, namespace: str, name: str) -> None:
+        with self._lock:
+            pod = self._pods.get((namespace, name))
+            if pod is None:
+                raise NotFoundError(f"pod {namespace}/{name} not found")
+            for blocker in self._eviction_blockers:
+                if blocker(pod):
+                    raise EvictionBlockedError(
+                        f"eviction of {namespace}/{name} blocked by "
+                        f"disruption budget")
+            del self._pods[(namespace, name)]
+            self._maybe_recreate_ds_pod(pod)
+
+    def _maybe_recreate_ds_pod(self, pod: Pod) -> None:
+        """DS controller simulation: recreate a deleted DS-owned pod with the
+        newest revision hash (must be called with the lock held)."""
+        cfg = self._ds_controller
+        if cfg is None or not cfg.enabled:
+            return
+        owner = pod.controller_owner()
+        if owner is None or owner.kind != "DaemonSet":
+            return
+        ds_key = next((k for k, ds in self._daemon_sets.items()
+                       if ds.metadata.uid == owner.uid), None)
+        if ds_key is None:
+            return
+        namespace, ds_name = ds_key
+        node_name = pod.spec.node_name
+
+        def recreate() -> None:
+            with self._lock:
+                ds = self._daemon_sets.get(ds_key)
+                if ds is None or node_name not in self._nodes:
+                    return
+                new_hash = self.latest_revision_hash(namespace, ds_name)
+                labels = dict(ds.spec.selector)
+                labels[POD_CONTROLLER_REVISION_HASH_LABEL] = new_hash
+                pod_name = f"{ds_name}-{node_name}-{new_uid('p')}"
+                new_pod = Pod(
+                    metadata=ObjectMeta(
+                        name=pod_name, namespace=namespace, labels=labels,
+                        owner_references=[OwnerReference(
+                            kind="DaemonSet", name=ds_name,
+                            uid=ds.metadata.uid)]),
+                    spec=PodSpec(node_name=node_name),
+                    status=PodStatus(
+                        phase=PodPhase.RUNNING,
+                        container_statuses=[
+                            ContainerStatus(name="runtime", ready=False)]))
+                self._pods[(namespace, pod_name)] = new_pod
+
+                def make_ready() -> None:
+                    with self._lock:
+                        p = self._pods.get((namespace, pod_name))
+                        if p is not None:
+                            for c in p.status.container_statuses:
+                                c.ready = True
+                            p.metadata.resource_version += 1
+
+                self._schedule(cfg.ready_delay, make_ready)
+
+        self._schedule(cfg.recreate_delay, recreate)
+
+    # ------------------------------------------------------------------
+    # K8sClient: daemonsets & revisions
+    # ------------------------------------------------------------------
+    def list_daemon_sets(self, namespace: str,
+                         label_selector: str = "") -> list[DaemonSet]:
+        match = parse_label_selector(label_selector)
+        with self._lock:
+            return [copy.deepcopy(ds)
+                    for (ns, _), ds in self._daemon_sets.items()
+                    if ns == namespace and match(ds.metadata.labels)]
+
+    def list_controller_revisions(self, namespace: str,
+                                  label_selector: str = "") -> list[ControllerRevision]:
+        match = parse_label_selector(label_selector)
+        with self._lock:
+            return [copy.deepcopy(rev)
+                    for (ns, _), rev in self._revisions.items()
+                    if ns == namespace and match(rev.metadata.labels)]

@@ -1,0 +1,235 @@
+"""Typed Kubernetes object model (the slice of the API the library needs).
+
+The reference consumes corev1.Node / corev1.Pod / appsv1.DaemonSet /
+appsv1.ControllerRevision through client-go. This module models exactly the
+fields the upgrade flow reads or writes — nothing more:
+
+- Node: labels, annotations, spec.unschedulable, Ready condition
+  (upgrade_state.go:980-993).
+- Pod: labels, owner references, spec.nodeName, phase, container statuses
+  (readiness + restart counts, upgrade_state.go:936-978), deletion timestamp
+  (upgrade_state.go:779), emptyDir volume usage (drain filters).
+- DaemonSet: selector labels + desired scheduled count
+  (upgrade_state.go:243-246).
+- ControllerRevision: name + monotonically increasing revision number, for
+  the "is this pod running the newest template" oracle
+  (pod_manager.go:95-121).
+"""
+
+from __future__ import annotations
+
+import enum
+import itertools
+import threading
+from dataclasses import dataclass, field
+from typing import Optional
+
+_uid_counter = itertools.count(1)
+_uid_lock = threading.Lock()
+
+
+def new_uid(prefix: str = "uid") -> str:
+    with _uid_lock:
+        return f"{prefix}-{next(_uid_counter)}"
+
+
+@dataclass
+class ObjectMeta:
+    name: str = ""
+    namespace: str = ""
+    uid: str = ""
+    labels: dict[str, str] = field(default_factory=dict)
+    annotations: dict[str, str] = field(default_factory=dict)
+    owner_references: list["OwnerReference"] = field(default_factory=list)
+    deletion_timestamp: Optional[float] = None
+    resource_version: int = 0
+
+    def __post_init__(self) -> None:
+        if not self.uid:
+            self.uid = new_uid(self.name or "obj")
+
+
+@dataclass
+class OwnerReference:
+    kind: str
+    name: str
+    uid: str
+    controller: bool = True
+
+
+class PodPhase(str, enum.Enum):
+    PENDING = "Pending"
+    RUNNING = "Running"
+    SUCCEEDED = "Succeeded"
+    FAILED = "Failed"
+
+    def __str__(self) -> str:
+        return self.value
+
+
+@dataclass
+class ContainerStatus:
+    name: str
+    ready: bool = False
+    restart_count: int = 0
+
+
+@dataclass
+class NodeCondition:
+    type: str
+    status: str  # "True" / "False" / "Unknown"
+
+
+@dataclass
+class NodeSpec:
+    unschedulable: bool = False
+
+
+@dataclass
+class NodeStatus:
+    conditions: list[NodeCondition] = field(
+        default_factory=lambda: [NodeCondition("Ready", "True")])
+
+
+@dataclass
+class Node:
+    metadata: ObjectMeta
+    spec: NodeSpec = field(default_factory=NodeSpec)
+    status: NodeStatus = field(default_factory=NodeStatus)
+
+    @property
+    def name(self) -> str:
+        return self.metadata.name
+
+    def is_unschedulable(self) -> bool:
+        """True if the node is cordoned (upgrade_state.go:980-983)."""
+        return self.spec.unschedulable
+
+    def is_ready(self) -> bool:
+        """True unless an explicit Ready condition is not "True"
+        (upgrade_state.go:985-993)."""
+        for cond in self.status.conditions:
+            if cond.type == "Ready" and cond.status != "True":
+                return False
+        return True
+
+
+@dataclass
+class Volume:
+    name: str
+    empty_dir: bool = False
+
+
+@dataclass
+class PodSpec:
+    node_name: str = ""
+    volumes: list[Volume] = field(default_factory=list)
+
+
+@dataclass
+class PodStatus:
+    phase: PodPhase = PodPhase.PENDING
+    container_statuses: list[ContainerStatus] = field(default_factory=list)
+    init_container_statuses: list[ContainerStatus] = field(default_factory=list)
+
+
+@dataclass
+class Pod:
+    metadata: ObjectMeta
+    spec: PodSpec = field(default_factory=PodSpec)
+    status: PodStatus = field(default_factory=PodStatus)
+
+    @property
+    def name(self) -> str:
+        return self.metadata.name
+
+    @property
+    def namespace(self) -> str:
+        return self.metadata.namespace
+
+    def controller_owner(self) -> Optional[OwnerReference]:
+        for ref in self.metadata.owner_references:
+            if ref.controller:
+                return ref
+        if self.metadata.owner_references:
+            return self.metadata.owner_references[0]
+        return None
+
+    def is_orphaned(self) -> bool:
+        """Pod with no owner references — never auto-upgraded because its
+        revision hash cannot be compared (upgrade_state.go:353-355)."""
+        return not self.metadata.owner_references
+
+    def is_ready(self) -> bool:
+        """Running with at least one container and all containers ready
+        (mirrors isDriverPodInSync's readiness arm and the validation
+        manager's isPodReady, upgrade_state.go:947-960,
+        validation_manager.go:118-136)."""
+        if self.status.phase != PodPhase.RUNNING:
+            return False
+        if not self.status.container_statuses:
+            return False
+        return all(c.ready for c in self.status.container_statuses)
+
+    def is_failing(self, restart_threshold: int = 10) -> bool:
+        """A not-ready container restarted more than ``restart_threshold``
+        times (upgrade_state.go:966-978)."""
+        for status in (self.status.init_container_statuses
+                       + self.status.container_statuses):
+            if not status.ready and status.restart_count > restart_threshold:
+                return True
+        return False
+
+    def uses_empty_dir(self) -> bool:
+        return any(v.empty_dir for v in self.spec.volumes)
+
+    def is_daemonset_pod(self) -> bool:
+        owner = self.controller_owner()
+        return owner is not None and owner.kind == "DaemonSet"
+
+    def is_mirror_pod(self) -> bool:
+        return "kubernetes.io/config.mirror" in self.metadata.annotations
+
+
+@dataclass
+class DaemonSetSpec:
+    selector: dict[str, str] = field(default_factory=dict)
+    # Opaque identifier of the current pod template; bumping it models a
+    # rollout (the fake cluster turns it into a new ControllerRevision).
+    template_generation: int = 1
+
+
+@dataclass
+class DaemonSetStatus:
+    desired_number_scheduled: int = 0
+
+
+@dataclass
+class DaemonSet:
+    metadata: ObjectMeta
+    spec: DaemonSetSpec = field(default_factory=DaemonSetSpec)
+    status: DaemonSetStatus = field(default_factory=DaemonSetStatus)
+
+    @property
+    def name(self) -> str:
+        return self.metadata.name
+
+    @property
+    def namespace(self) -> str:
+        return self.metadata.namespace
+
+
+@dataclass
+class ControllerRevision:
+    metadata: ObjectMeta
+    revision: int = 1
+
+    @property
+    def name(self) -> str:
+        return self.metadata.name
+
+    @property
+    def hash(self) -> str:
+        """The revision hash is the name suffix after '<ds-name>-'
+        (pod_manager.go:118-119)."""
+        return self.metadata.name.rsplit("-", 1)[-1]
