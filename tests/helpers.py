@@ -1,0 +1,68 @@
+"""Shared test environment: FakeCluster + provider + managers wired with a
+virtual clock and synchronous workers for determinism."""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from typing import Optional
+
+from tpu_operator_libs.consts import UpgradeKeys
+from tpu_operator_libs.k8s.fake import FakeCluster
+from tpu_operator_libs.upgrade.cordon_manager import CordonManager
+from tpu_operator_libs.upgrade.drain_manager import DrainManager
+from tpu_operator_libs.upgrade.pod_manager import PodManager
+from tpu_operator_libs.upgrade.safe_load_manager import SafeRuntimeLoadManager
+from tpu_operator_libs.upgrade.state_manager import ClusterUpgradeStateManager
+from tpu_operator_libs.upgrade.state_provider import NodeUpgradeStateProvider
+from tpu_operator_libs.upgrade.validation_manager import ValidationManager
+from tpu_operator_libs.util import EventRecorder, FakeClock, Worker
+
+
+@dataclass
+class Env:
+    cluster: FakeCluster
+    clock: FakeClock
+    keys: UpgradeKeys
+    recorder: EventRecorder
+    provider: NodeUpgradeStateProvider
+
+    def state_of(self, node_name: str) -> str:
+        return self.cluster.get_node(node_name).metadata.labels.get(
+            self.keys.state_label, "")
+
+
+def make_env(keys: Optional[UpgradeKeys] = None) -> Env:
+    clock = FakeClock(start=1_000_000.0)
+    cluster = FakeCluster(clock=clock)
+    keys = keys or UpgradeKeys()
+    recorder = EventRecorder()
+    provider = NodeUpgradeStateProvider(
+        cluster, keys, recorder, clock,
+        sync_timeout=10.0, poll_interval=0.01)
+    return Env(cluster=cluster, clock=clock, keys=keys, recorder=recorder,
+               provider=provider)
+
+
+def make_pod_manager(env: Env, deletion_filter=None) -> PodManager:
+    return PodManager(env.cluster, env.provider, deletion_filter,
+                      env.recorder, env.clock, Worker(async_mode=False))
+
+
+def make_drain_manager(env: Env) -> DrainManager:
+    return DrainManager(env.cluster, env.provider, env.recorder, env.clock,
+                        Worker(async_mode=False))
+
+
+def make_validation_manager(env: Env, pod_selector: str = "",
+                            extra_validator=None,
+                            timeout_seconds: int = 600) -> ValidationManager:
+    return ValidationManager(env.cluster, env.provider, pod_selector,
+                             env.recorder, env.clock, extra_validator,
+                             timeout_seconds)
+
+
+def make_state_manager(env: Env, **kwargs) -> ClusterUpgradeStateManager:
+    return ClusterUpgradeStateManager(
+        env.cluster, env.keys, env.recorder, env.clock,
+        async_workers=False, provider=env.provider,
+        poll_interval=0.01, **kwargs)

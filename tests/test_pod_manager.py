@@ -1,0 +1,240 @@
+"""PodManager tests (pod_manager_test.go parity: revision oracle, eviction
+matrix, restart, completion-wait with timeout annotations)."""
+
+import pytest
+
+from tpu_operator_libs.api.upgrade_policy import (
+    PodDeletionSpec,
+    WaitForCompletionSpec,
+)
+from tpu_operator_libs.consts import UpgradeState
+from tpu_operator_libs.k8s.objects import PodPhase
+from tpu_operator_libs.upgrade.pod_manager import (
+    PodManagerConfig,
+    RevisionHashError,
+)
+
+from builders import DaemonSetBuilder, NodeBuilder, PodBuilder
+from helpers import make_env, make_pod_manager
+
+
+class TestRevisionOracle:
+    def test_pod_hash_from_label(self):
+        env = make_env()
+        pod = PodBuilder("p").with_revision_hash("abc123").build()
+        mgr = make_pod_manager(env)
+        assert mgr.get_pod_revision_hash(pod) == "abc123"
+
+    def test_pod_hash_missing_raises(self):
+        env = make_env()
+        mgr = make_pod_manager(env)
+        with pytest.raises(RevisionHashError):
+            mgr.get_pod_revision_hash(PodBuilder("p").build())
+
+    def test_ds_hash_newest_revision_wins(self):
+        env = make_env()
+        ds = DaemonSetBuilder("libtpu").with_labels(
+            {"app": "libtpu"}).with_revision_hash("aaa").create(env.cluster)
+        env.cluster.bump_daemon_set_revision("tpu-system", "libtpu", "bbb")
+        mgr = make_pod_manager(env)
+        assert mgr.get_daemon_set_revision_hash(ds) == "bbb"
+
+    def test_ds_hash_no_revisions_raises(self):
+        env = make_env()
+        ds = DaemonSetBuilder("ghost").with_labels({"app": "x"}).build()
+        mgr = make_pod_manager(env)
+        with pytest.raises(RevisionHashError):
+            mgr.get_daemon_set_revision_hash(ds)
+
+    def test_prefix_sibling_daemonset_not_confused(self):
+        # "tpu" must not see revisions of "tpu-plugin"
+        # (fixes the reference's prefix-scan collision, pod_manager.go:106)
+        env = make_env()
+        ds_a = DaemonSetBuilder("tpu").with_labels(
+            {"app": "shared"}).with_revision_hash("aaa").create(env.cluster)
+        DaemonSetBuilder("tpu-plugin").with_labels(
+            {"app": "shared"}).with_revision_hash("zzz").create(env.cluster)
+        env.cluster.bump_daemon_set_revision("tpu-system", "tpu-plugin", "yyy")
+        mgr = make_pod_manager(env)
+        assert mgr.get_daemon_set_revision_hash(ds_a) == "aaa"
+
+
+class TestSchedulePodsRestart:
+    def test_deletes_pods(self):
+        env = make_env()
+        node = NodeBuilder("n1").create(env.cluster)
+        p1 = PodBuilder("p1").on_node(node).create(env.cluster)
+        p2 = PodBuilder("p2").on_node(node).create(env.cluster)
+        make_pod_manager(env).schedule_pods_restart([p1, p2])
+        assert env.cluster.list_pods() == []
+
+    def test_empty_list_noop(self):
+        env = make_env()
+        make_pod_manager(env).schedule_pods_restart([])
+
+    def test_missing_pod_raises(self):
+        env = make_env()
+        pod = PodBuilder("ghost").build()
+        with pytest.raises(KeyError):
+            make_pod_manager(env).schedule_pods_restart([pod])
+
+
+class TestSchedulePodEviction:
+    def _env_with_workload(self, filter_label="evict-me"):
+        env = make_env()
+        node = NodeBuilder("n1").create(env.cluster)
+        PodBuilder("victim").on_node(node).orphaned() \
+            .with_labels({filter_label: "true"}).create(env.cluster)
+        PodBuilder("bystander").on_node(node).orphaned().create(env.cluster)
+        deletion_filter = (
+            lambda pod: pod.metadata.labels.get(filter_label) == "true")
+        mgr = make_pod_manager(env, deletion_filter)
+        return env, node, mgr
+
+    def test_deletes_only_filtered_pods(self):
+        env, node, mgr = self._env_with_workload()
+        mgr.schedule_pod_eviction(PodManagerConfig(
+            nodes=[node],
+            deletion_spec=PodDeletionSpec(force=True)))
+        names = [p.name for p in env.cluster.list_pods()]
+        assert names == ["bystander"]
+        assert env.state_of("n1") == "pod-restart-required"
+
+    def test_no_matching_pods_advances_state(self):
+        env = make_env()
+        node = NodeBuilder("n1").create(env.cluster)
+        PodBuilder("other").on_node(node).orphaned().create(env.cluster)
+        mgr = make_pod_manager(env, lambda pod: False)
+        mgr.schedule_pod_eviction(PodManagerConfig(
+            nodes=[node], deletion_spec=PodDeletionSpec()))
+        assert env.state_of("n1") == "pod-restart-required"
+        assert len(env.cluster.list_pods()) == 1
+
+    def test_blocked_eviction_goes_to_failed_without_drain(self):
+        # victim is unreplicated and force=False ⇒ cannot delete ⇒ failed
+        env, node, mgr = self._env_with_workload()
+        mgr.schedule_pod_eviction(PodManagerConfig(
+            nodes=[node],
+            deletion_spec=PodDeletionSpec(force=False),
+            drain_enabled=False))
+        assert env.state_of("n1") == "upgrade-failed"
+
+    def test_blocked_eviction_goes_to_drain_when_enabled(self):
+        env, node, mgr = self._env_with_workload()
+        mgr.schedule_pod_eviction(PodManagerConfig(
+            nodes=[node],
+            deletion_spec=PodDeletionSpec(force=False),
+            drain_enabled=True))
+        assert env.state_of("n1") == "drain-required"
+
+    def test_empty_dir_matrix(self):
+        env = make_env()
+        node = NodeBuilder("n1").create(env.cluster)
+        PodBuilder("scratch").on_node(node).orphaned().with_empty_dir() \
+            .with_labels({"evict-me": "true"}).create(env.cluster)
+        mgr = make_pod_manager(
+            env, lambda pod: pod.metadata.labels.get("evict-me") == "true")
+        # without delete_empty_dir: blocked
+        mgr.schedule_pod_eviction(PodManagerConfig(
+            nodes=[node],
+            deletion_spec=PodDeletionSpec(force=True,
+                                          delete_empty_dir=False)))
+        assert env.state_of("n1") == "upgrade-failed"
+        # with delete_empty_dir: proceeds
+        mgr.schedule_pod_eviction(PodManagerConfig(
+            nodes=[node],
+            deletion_spec=PodDeletionSpec(force=True,
+                                          delete_empty_dir=True)))
+        assert env.cluster.list_pods() == []
+        assert env.state_of("n1") == "pod-restart-required"
+
+    def test_nil_spec_raises(self):
+        env, node, mgr = self._env_with_workload()
+        with pytest.raises(ValueError):
+            mgr.schedule_pod_eviction(PodManagerConfig(
+                nodes=[node], deletion_spec=None))
+
+
+class TestScheduleCheckOnPodCompletion:
+    def test_no_running_pods_advances(self):
+        env = make_env()
+        node = NodeBuilder("n1").create(env.cluster)
+        PodBuilder("done-job").on_node(node).orphaned() \
+            .with_labels({"job": "train"}) \
+            .with_phase(PodPhase.SUCCEEDED).create(env.cluster)
+        mgr = make_pod_manager(env)
+        mgr.schedule_check_on_pod_completion(PodManagerConfig(
+            nodes=[node],
+            wait_for_completion_spec=WaitForCompletionSpec(
+                pod_selector="job=train")))
+        assert env.state_of("n1") == "pod-deletion-required"
+
+    def test_running_pod_blocks_without_timeout(self):
+        env = make_env()
+        node = NodeBuilder("n1").create(env.cluster)
+        PodBuilder("busy").on_node(node).orphaned() \
+            .with_labels({"job": "train"}).create(env.cluster)
+        mgr = make_pod_manager(env)
+        mgr.schedule_check_on_pod_completion(PodManagerConfig(
+            nodes=[node],
+            wait_for_completion_spec=WaitForCompletionSpec(
+                pod_selector="job=train", timeout_seconds=0)))
+        assert env.state_of("n1") == ""  # unchanged, wait forever
+
+    def test_timeout_flow(self):
+        env = make_env()
+        node = NodeBuilder("n1").create(env.cluster)
+        PodBuilder("busy").on_node(node).orphaned() \
+            .with_labels({"job": "train"}).create(env.cluster)
+        mgr = make_pod_manager(env)
+        spec = WaitForCompletionSpec(pod_selector="job=train",
+                                     timeout_seconds=100)
+        annotation = env.keys.pod_completion_start_annotation
+
+        # pass 1: stamps start time
+        mgr.schedule_check_on_pod_completion(PodManagerConfig(
+            nodes=[node], wait_for_completion_spec=spec))
+        stamped = env.cluster.get_node("n1").metadata.annotations[annotation]
+        assert int(stamped) == int(env.clock.now())
+        assert env.state_of("n1") == ""
+
+        # pass 2 before expiry: no change
+        env.clock.advance(50)
+        node = env.provider.get_node("n1")
+        mgr.schedule_check_on_pod_completion(PodManagerConfig(
+            nodes=[node], wait_for_completion_spec=spec))
+        assert env.state_of("n1") == ""
+
+        # pass 3 after expiry: forced to pod-deletion, stamp removed
+        env.clock.advance(51)
+        node = env.provider.get_node("n1")
+        mgr.schedule_check_on_pod_completion(PodManagerConfig(
+            nodes=[node], wait_for_completion_spec=spec))
+        assert env.state_of("n1") == "pod-deletion-required"
+        assert annotation not in env.cluster.get_node(
+            "n1").metadata.annotations
+
+    def test_completion_clears_stamp(self):
+        env = make_env()
+        node = NodeBuilder("n1").create(env.cluster)
+        annotation = env.keys.pod_completion_start_annotation
+        env.cluster.patch_node_annotations("n1", {annotation: "123"})
+        node = env.provider.get_node("n1")
+        mgr = make_pod_manager(env)
+        mgr.schedule_check_on_pod_completion(PodManagerConfig(
+            nodes=[node],
+            wait_for_completion_spec=WaitForCompletionSpec(
+                pod_selector="job=train", timeout_seconds=100)))
+        assert annotation not in env.cluster.get_node(
+            "n1").metadata.annotations
+        assert env.state_of("n1") == "pod-deletion-required"
+
+    def test_is_pod_running_or_pending(self):
+        env = make_env()
+        mgr = make_pod_manager(env)
+        for phase, expected in [(PodPhase.RUNNING, True),
+                                (PodPhase.PENDING, True),
+                                (PodPhase.SUCCEEDED, False),
+                                (PodPhase.FAILED, False)]:
+            pod = PodBuilder().with_phase(phase).build()
+            assert mgr.is_pod_running_or_pending(pod) is expected

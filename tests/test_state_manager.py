@@ -1,0 +1,643 @@
+"""ClusterUpgradeStateManager scenario tests.
+
+Mirrors the reference's scenario matrix (upgrade_state_test.go:139-1211):
+build_state snapshots, every transition of the state graph, the
+maxParallelUpgrades × maxUnavailable throttle interaction, optional-state
+toggles, orphaned-pod paths, safe-load, failure/recovery, and a full
+multi-reconcile rolling upgrade against the simulated DS controller.
+"""
+
+import pytest
+
+from tpu_operator_libs.api.upgrade_policy import (
+    DrainSpec,
+    PodDeletionSpec,
+    UpgradePolicySpec,
+    WaitForCompletionSpec,
+)
+from tpu_operator_libs.consts import TRUE_STRING, UpgradeState
+from tpu_operator_libs.k8s.objects import PodPhase
+from tpu_operator_libs.upgrade.state_manager import BuildStateError
+
+from builders import DaemonSetBuilder, NodeBuilder, PodBuilder
+from helpers import make_env, make_state_manager
+
+NS = "tpu-system"
+RUNTIME_LABELS = {"app": "libtpu"}
+
+
+def setup_fleet(env, n_nodes=3, pod_hash="rev1", ds_hash="rev1",
+                state=None, ready=True):
+    """n nodes, one libtpu DS, one DS pod per node."""
+    ds = DaemonSetBuilder("libtpu").with_labels(dict(RUNTIME_LABELS)) \
+        .with_desired_scheduled(n_nodes).with_revision_hash(ds_hash) \
+        .create(env.cluster)
+    nodes = []
+    for i in range(n_nodes):
+        b = NodeBuilder(f"node-{i}")
+        if state is not None:
+            b = b.with_upgrade_state(env.keys, state)
+        node = b.create(env.cluster)
+        PodBuilder(f"libtpu-{i}").on_node(node).owned_by(ds) \
+            .with_revision_hash(pod_hash).ready(ready).create(env.cluster)
+        nodes.append(node)
+    return ds, nodes
+
+
+def policy(**kwargs):
+    defaults = dict(auto_upgrade=True, max_parallel_upgrades=0,
+                    max_unavailable=None)
+    defaults.update(kwargs)
+    return UpgradePolicySpec(**defaults)
+
+
+class TestBuildState:
+    def test_buckets_by_state_label(self):
+        env = make_env()
+        setup_fleet(env, n_nodes=2, state=UpgradeState.DONE)
+        mgr = make_state_manager(env)
+        state = mgr.build_state(NS, RUNTIME_LABELS)
+        assert len(state.bucket(UpgradeState.DONE)) == 2
+        assert state.bucket(UpgradeState.UNKNOWN) == []
+
+    def test_unscheduled_ds_pods_error(self):
+        env = make_env()
+        ds = DaemonSetBuilder("libtpu").with_labels(dict(RUNTIME_LABELS)) \
+            .with_desired_scheduled(3).create(env.cluster)
+        node = NodeBuilder("n1").create(env.cluster)
+        PodBuilder("p1").on_node(node).owned_by(ds).create(env.cluster)
+        mgr = make_state_manager(env)
+        with pytest.raises(BuildStateError):
+            mgr.build_state(NS, RUNTIME_LABELS)
+
+    def test_orphaned_pods_included(self):
+        env = make_env()
+        node = NodeBuilder("n1").create(env.cluster)
+        PodBuilder("orphan").on_node(node).orphaned() \
+            .with_labels(dict(RUNTIME_LABELS)).create(env.cluster)
+        mgr = make_state_manager(env)
+        state = mgr.build_state(NS, RUNTIME_LABELS)
+        assert len(state.bucket(UpgradeState.UNKNOWN)) == 1
+        assert state.bucket(UpgradeState.UNKNOWN)[0].is_orphaned()
+
+    def test_pending_unassigned_pod_skipped(self):
+        env = make_env()
+        pod = PodBuilder("floating").orphaned() \
+            .with_labels(dict(RUNTIME_LABELS)) \
+            .with_phase(PodPhase.PENDING).build()
+        pod.spec.node_name = ""
+        env.cluster.add_pod(pod)
+        mgr = make_state_manager(env)
+        state = mgr.build_state(NS, RUNTIME_LABELS)
+        assert mgr.get_total_managed_nodes(state) == 0
+
+
+class TestProcessDoneOrUnknown:
+    def test_unknown_synced_becomes_done(self):
+        env = make_env()
+        setup_fleet(env, n_nodes=1)
+        mgr = make_state_manager(env)
+        mgr.apply_state(mgr.build_state(NS, RUNTIME_LABELS), policy())
+        assert env.state_of("node-0") == "upgrade-done"
+
+    def test_unknown_out_of_sync_becomes_upgrade_required(self):
+        env = make_env()
+        setup_fleet(env, n_nodes=1, pod_hash="old", ds_hash="new")
+        mgr = make_state_manager(env)
+        mgr.process_done_or_unknown_nodes(
+            mgr.build_state(NS, RUNTIME_LABELS), UpgradeState.UNKNOWN)
+        assert env.state_of("node-0") == "upgrade-required"
+
+    def test_done_out_of_sync_becomes_upgrade_required(self):
+        env = make_env()
+        setup_fleet(env, n_nodes=1, pod_hash="old", ds_hash="new",
+                    state=UpgradeState.DONE)
+        mgr = make_state_manager(env)
+        mgr.process_done_or_unknown_nodes(
+            mgr.build_state(NS, RUNTIME_LABELS), UpgradeState.DONE)
+        assert env.state_of("node-0") == "upgrade-required"
+
+    def test_done_synced_stays_done(self):
+        env = make_env()
+        setup_fleet(env, n_nodes=1, state=UpgradeState.DONE)
+        mgr = make_state_manager(env)
+        mgr.process_done_or_unknown_nodes(
+            mgr.build_state(NS, RUNTIME_LABELS), UpgradeState.DONE)
+        assert env.state_of("node-0") == "upgrade-done"
+
+    def test_orphan_unknown_becomes_done_not_upgraded(self):
+        # orphaned pods never auto-trigger upgrades
+        # (upgrade_state.go:552-578)
+        env = make_env()
+        node = NodeBuilder("n1").create(env.cluster)
+        PodBuilder("orphan").on_node(node).orphaned() \
+            .with_labels(dict(RUNTIME_LABELS)).create(env.cluster)
+        mgr = make_state_manager(env)
+        mgr.process_done_or_unknown_nodes(
+            mgr.build_state(NS, RUNTIME_LABELS), UpgradeState.UNKNOWN)
+        assert env.state_of("n1") == "upgrade-done"
+
+    def test_orphan_with_upgrade_requested_annotation(self):
+        # on-demand trigger for orphans (consts.go:38-41)
+        env = make_env()
+        node = NodeBuilder("n1").create(env.cluster)
+        env.cluster.patch_node_annotations(
+            "n1", {env.keys.upgrade_requested_annotation: TRUE_STRING})
+        PodBuilder("orphan").on_node(node).orphaned() \
+            .with_labels(dict(RUNTIME_LABELS)).create(env.cluster)
+        mgr = make_state_manager(env)
+        mgr.process_done_or_unknown_nodes(
+            mgr.build_state(NS, RUNTIME_LABELS), UpgradeState.UNKNOWN)
+        assert env.state_of("n1") == "upgrade-required"
+
+    def test_safe_load_waiting_triggers_upgrade(self):
+        env = make_env()
+        setup_fleet(env, n_nodes=1)  # pod in sync!
+        env.cluster.patch_node_annotations(
+            "node-0", {env.keys.wait_for_safe_load_annotation: "true"})
+        mgr = make_state_manager(env)
+        mgr.process_done_or_unknown_nodes(
+            mgr.build_state(NS, RUNTIME_LABELS), UpgradeState.UNKNOWN)
+        assert env.state_of("node-0") == "upgrade-required"
+
+    def test_unschedulable_node_gets_initial_state_annotation(self):
+        env = make_env()
+        _, nodes = setup_fleet(env, n_nodes=1, pod_hash="old", ds_hash="new")
+        env.cluster.set_node_unschedulable("node-0", True)
+        mgr = make_state_manager(env)
+        mgr.process_done_or_unknown_nodes(
+            mgr.build_state(NS, RUNTIME_LABELS), UpgradeState.UNKNOWN)
+        annotations = env.cluster.get_node("node-0").metadata.annotations
+        assert annotations[env.keys.initial_state_annotation] == TRUE_STRING
+
+
+class TestProcessUpgradeRequired:
+    def test_slots_limit_parallel_upgrades(self):
+        env = make_env()
+        setup_fleet(env, n_nodes=5, pod_hash="old", ds_hash="new",
+                    state=UpgradeState.UPGRADE_REQUIRED)
+        mgr = make_state_manager(env)
+        state = mgr.build_state(NS, RUNTIME_LABELS)
+        mgr.process_upgrade_required_nodes(state, upgrades_available=2)
+        cordon = [n for n in range(5)
+                  if env.state_of(f"node-{n}") == "cordon-required"]
+        assert len(cordon) == 2
+
+    def test_skip_label_respected(self):
+        env = make_env()
+        setup_fleet(env, n_nodes=2, pod_hash="old", ds_hash="new",
+                    state=UpgradeState.UPGRADE_REQUIRED)
+        env.cluster.patch_node_labels(
+            "node-0", {env.keys.skip_label: TRUE_STRING})
+        mgr = make_state_manager(env)
+        state = mgr.build_state(NS, RUNTIME_LABELS)
+        mgr.process_upgrade_required_nodes(state, upgrades_available=5)
+        assert env.state_of("node-0") == "upgrade-required"  # skipped
+        assert env.state_of("node-1") == "cordon-required"
+
+    def test_cordoned_node_proceeds_without_slots(self):
+        # manual-cordon override (upgrade_state.go:606-616)
+        env = make_env()
+        setup_fleet(env, n_nodes=2, pod_hash="old", ds_hash="new",
+                    state=UpgradeState.UPGRADE_REQUIRED)
+        env.cluster.set_node_unschedulable("node-1", True)
+        mgr = make_state_manager(env)
+        state = mgr.build_state(NS, RUNTIME_LABELS)
+        mgr.process_upgrade_required_nodes(state, upgrades_available=0)
+        assert env.state_of("node-0") == "upgrade-required"
+        assert env.state_of("node-1") == "cordon-required"
+
+    def test_upgrade_requested_annotation_removed(self):
+        env = make_env()
+        setup_fleet(env, n_nodes=1, pod_hash="old", ds_hash="new",
+                    state=UpgradeState.UPGRADE_REQUIRED)
+        env.cluster.patch_node_annotations(
+            "node-0", {env.keys.upgrade_requested_annotation: TRUE_STRING})
+        mgr = make_state_manager(env)
+        state = mgr.build_state(NS, RUNTIME_LABELS)
+        mgr.process_upgrade_required_nodes(state, upgrades_available=1)
+        annotations = env.cluster.get_node("node-0").metadata.annotations
+        assert env.keys.upgrade_requested_annotation not in annotations
+
+
+class TestThrottleMath:
+    """get_upgrades_available parity matrix (upgrade_state.go:1073-1102 and
+    its scenario tests upgrade_state_test.go:237-556)."""
+
+    def _state(self, env, upgrade_required=0, cordon_required=0,
+               drain_required=0, done=0, unschedulable_done=0):
+        n = 0
+        ds = DaemonSetBuilder("libtpu").with_labels(dict(RUNTIME_LABELS)) \
+            .with_desired_scheduled(upgrade_required + cordon_required
+                                    + drain_required + done
+                                    + unschedulable_done) \
+            .create(env.cluster)
+
+        def add(state, count, unschedulable=False):
+            nonlocal n
+            for _ in range(count):
+                b = NodeBuilder(f"tn-{n}").with_upgrade_state(env.keys, state)
+                if unschedulable:
+                    b = b.unschedulable()
+                node = b.create(env.cluster)
+                PodBuilder(f"tp-{n}").on_node(node).owned_by(ds) \
+                    .with_revision_hash("rev1").create(env.cluster)
+                n += 1
+
+        add(UpgradeState.UPGRADE_REQUIRED, upgrade_required)
+        add(UpgradeState.CORDON_REQUIRED, cordon_required)
+        add(UpgradeState.DRAIN_REQUIRED, drain_required, unschedulable=True)
+        add(UpgradeState.DONE, done)
+        add(UpgradeState.DONE, unschedulable_done, unschedulable=True)
+        mgr = make_state_manager(env)
+        return mgr, mgr.build_state(NS, RUNTIME_LABELS)
+
+    def test_unlimited_parallel_returns_all_required(self):
+        env = make_env()
+        mgr, state = self._state(env, upgrade_required=4, done=4)
+        assert mgr.get_upgrades_available(state, 0, 8) == 4
+
+    def test_parallel_budget_minus_in_progress(self):
+        env = make_env()
+        mgr, state = self._state(env, upgrade_required=4, drain_required=2,
+                                 done=2)
+        # maxParallel=3, 2 in progress -> 1 slot; drain nodes are cordoned
+        # so unavailable=2 < maxUnavailable=8
+        assert mgr.get_upgrades_available(state, 3, 8) == 1
+
+    def test_max_unavailable_caps_slots(self):
+        env = make_env()
+        mgr, state = self._state(env, upgrade_required=4, done=4)
+        # 8 slots from parallel, but only 2 may be unavailable
+        assert mgr.get_upgrades_available(state, 8, 2) == 2
+
+    def test_existing_unavailable_consume_budget(self):
+        env = make_env()
+        mgr, state = self._state(env, upgrade_required=4, done=2,
+                                 unschedulable_done=2)
+        # maxUnavailable=3, 2 already cordoned -> 1 slot
+        assert mgr.get_upgrades_available(state, 8, 3) == 1
+
+    def test_unavailable_exceeds_budget_blocks_all(self):
+        env = make_env()
+        mgr, state = self._state(env, upgrade_required=4,
+                                 unschedulable_done=3)
+        assert mgr.get_upgrades_available(state, 8, 2) == 0
+
+    def test_cordon_required_counts_as_unavailable(self):
+        env = make_env()
+        mgr, state = self._state(env, upgrade_required=3, cordon_required=2,
+                                 done=3)
+        # maxParallel=8 -> 8-2=6; maxUnavailable=3, cordon_required 2
+        # already counted -> 1
+        assert mgr.get_upgrades_available(state, 8, 3) == 1
+
+    def test_in_progress_exhausts_parallel_budget(self):
+        env = make_env()
+        mgr, state = self._state(env, upgrade_required=2, drain_required=2)
+        assert mgr.get_upgrades_available(state, 2, 8) == 0
+
+    def test_counters(self):
+        env = make_env()
+        mgr, state = self._state(env, upgrade_required=2, drain_required=1,
+                                 done=3)
+        assert mgr.get_total_managed_nodes(state) == 6
+        assert mgr.get_upgrades_in_progress(state) == 1
+        assert mgr.get_upgrades_done(state) == 3
+        assert mgr.get_upgrades_pending(state) == 2
+        assert mgr.get_upgrades_failed(state) == 0
+        assert mgr.get_current_unavailable_nodes(state) == 1
+
+    def test_not_ready_node_counts_unavailable(self):
+        env = make_env()
+        ds = DaemonSetBuilder("libtpu").with_labels(dict(RUNTIME_LABELS)) \
+            .with_desired_scheduled(1).create(env.cluster)
+        node = NodeBuilder("sick").not_ready().create(env.cluster)
+        PodBuilder("p").on_node(node).owned_by(ds) \
+            .with_revision_hash("rev1").create(env.cluster)
+        mgr = make_state_manager(env)
+        state = mgr.build_state(NS, RUNTIME_LABELS)
+        assert mgr.get_current_unavailable_nodes(state) == 1
+
+
+class TestCordonAndWaitForJobs:
+    def test_cordon_required_cordons_and_advances(self):
+        env = make_env()
+        setup_fleet(env, n_nodes=1, state=UpgradeState.CORDON_REQUIRED)
+        mgr = make_state_manager(env)
+        mgr.process_cordon_required_nodes(mgr.build_state(NS, RUNTIME_LABELS))
+        assert env.cluster.get_node("node-0").is_unschedulable()
+        assert env.state_of("node-0") == "wait-for-jobs-required"
+
+    def test_no_selector_advances_to_drain(self):
+        env = make_env()
+        setup_fleet(env, n_nodes=1, state=UpgradeState.WAIT_FOR_JOBS_REQUIRED)
+        mgr = make_state_manager(env)  # pod deletion NOT enabled
+        mgr.process_wait_for_jobs_required_nodes(
+            mgr.build_state(NS, RUNTIME_LABELS), None)
+        assert env.state_of("node-0") == "drain-required"
+
+    def test_no_selector_advances_to_pod_deletion_when_enabled(self):
+        env = make_env()
+        setup_fleet(env, n_nodes=1, state=UpgradeState.WAIT_FOR_JOBS_REQUIRED)
+        mgr = make_state_manager(env).with_pod_deletion_enabled(
+            lambda pod: False)
+        mgr.process_wait_for_jobs_required_nodes(
+            mgr.build_state(NS, RUNTIME_LABELS), None)
+        assert env.state_of("node-0") == "pod-deletion-required"
+
+    def test_with_selector_waits_for_running_jobs(self):
+        env = make_env()
+        _, nodes = setup_fleet(env, n_nodes=1,
+                               state=UpgradeState.WAIT_FOR_JOBS_REQUIRED)
+        PodBuilder("job").on_node(nodes[0]).orphaned() \
+            .with_labels({"job": "train"}).create(env.cluster)
+        mgr = make_state_manager(env)
+        mgr.process_wait_for_jobs_required_nodes(
+            mgr.build_state(NS, RUNTIME_LABELS),
+            WaitForCompletionSpec(pod_selector="job=train"))
+        assert env.state_of("node-0") == "wait-for-jobs-required"
+
+
+class TestPodDeletionState:
+    def test_disabled_goes_straight_to_drain(self):
+        env = make_env()
+        setup_fleet(env, n_nodes=2, state=UpgradeState.POD_DELETION_REQUIRED)
+        mgr = make_state_manager(env)
+        mgr.process_pod_deletion_required_nodes(
+            mgr.build_state(NS, RUNTIME_LABELS), PodDeletionSpec(), True)
+        assert env.state_of("node-0") == "drain-required"
+        assert env.state_of("node-1") == "drain-required"
+
+    def test_enabled_deletes_matching_pods(self):
+        env = make_env()
+        _, nodes = setup_fleet(env, n_nodes=1,
+                               state=UpgradeState.POD_DELETION_REQUIRED)
+        PodBuilder("victim").on_node(nodes[0]).orphaned() \
+            .with_labels({"tpu-job": "true"}).create(env.cluster)
+        mgr = make_state_manager(env).with_pod_deletion_enabled(
+            lambda pod: pod.metadata.labels.get("tpu-job") == "true")
+        mgr.process_pod_deletion_required_nodes(
+            mgr.build_state(NS, RUNTIME_LABELS),
+            PodDeletionSpec(force=True), True)
+        mgr.join_workers()
+        assert "victim" not in [p.name for p in env.cluster.list_pods()]
+        assert env.state_of("node-0") == "pod-restart-required"
+
+
+class TestDrainState:
+    def test_drain_disabled_advances_to_pod_restart(self):
+        env = make_env()
+        setup_fleet(env, n_nodes=2, state=UpgradeState.DRAIN_REQUIRED)
+        mgr = make_state_manager(env)
+        mgr.process_drain_nodes(mgr.build_state(NS, RUNTIME_LABELS), None)
+        assert env.state_of("node-0") == "pod-restart-required"
+        mgr.process_drain_nodes(mgr.build_state(NS, RUNTIME_LABELS),
+                                DrainSpec(enable=False))
+        assert env.state_of("node-1") == "pod-restart-required"
+
+    def test_drain_enabled_drains(self):
+        env = make_env()
+        _, nodes = setup_fleet(env, n_nodes=1,
+                               state=UpgradeState.DRAIN_REQUIRED)
+        PodBuilder("workload").on_node(nodes[0]).orphaned() \
+            .create(env.cluster)
+        mgr = make_state_manager(env)
+        mgr.process_drain_nodes(mgr.build_state(NS, RUNTIME_LABELS),
+                                DrainSpec(enable=True, force=True))
+        mgr.join_workers()
+        assert env.state_of("node-0") == "pod-restart-required"
+        names = [p.name for p in env.cluster.list_pods()]
+        assert "workload" not in names and "libtpu-0" in names
+
+
+class TestPodRestartState:
+    def test_out_of_sync_pod_restarted(self):
+        env = make_env()
+        setup_fleet(env, n_nodes=1, pod_hash="old", ds_hash="new",
+                    state=UpgradeState.POD_RESTART_REQUIRED)
+        mgr = make_state_manager(env)
+        mgr.process_pod_restart_nodes(mgr.build_state(NS, RUNTIME_LABELS))
+        assert env.cluster.list_pods(label_selector="app=libtpu") == []
+
+    def test_terminating_pod_not_restarted(self):
+        env = make_env()
+        _, nodes = setup_fleet(env, n_nodes=0)
+        node = NodeBuilder("n1").with_upgrade_state(
+            env.keys, UpgradeState.POD_RESTART_REQUIRED).create(env.cluster)
+        ds = env.cluster.list_daemon_sets(NS, "app=libtpu")[0]
+        pod = PodBuilder("terminating").on_node(node) \
+            .with_labels(dict(RUNTIME_LABELS)) \
+            .with_revision_hash("old").build()
+        from tpu_operator_libs.k8s.objects import OwnerReference
+        pod.metadata.owner_references = [OwnerReference(
+            kind="DaemonSet", name=ds.metadata.name, uid=ds.metadata.uid)]
+        pod.metadata.deletion_timestamp = 123.0
+        env.cluster.add_pod(pod)
+        env.cluster._daemon_sets[(NS, "libtpu")].status \
+            .desired_number_scheduled = 1
+        mgr = make_state_manager(env)
+        mgr.process_pod_restart_nodes(mgr.build_state(NS, RUNTIME_LABELS))
+        assert len(env.cluster.list_pods(label_selector="app=libtpu")) == 1
+
+    def test_synced_ready_pod_advances_to_uncordon(self):
+        env = make_env()
+        setup_fleet(env, n_nodes=1, state=UpgradeState.POD_RESTART_REQUIRED)
+        mgr = make_state_manager(env)
+        mgr.process_pod_restart_nodes(mgr.build_state(NS, RUNTIME_LABELS))
+        assert env.state_of("node-0") == "uncordon-required"
+
+    def test_synced_ready_pod_advances_to_validation_when_enabled(self):
+        env = make_env()
+        setup_fleet(env, n_nodes=1, state=UpgradeState.POD_RESTART_REQUIRED)
+        mgr = make_state_manager(env).with_validation_enabled(
+            "app=validator")
+        mgr.process_pod_restart_nodes(mgr.build_state(NS, RUNTIME_LABELS))
+        assert env.state_of("node-0") == "validation-required"
+
+    def test_initially_cordoned_node_goes_straight_to_done(self):
+        env = make_env()
+        setup_fleet(env, n_nodes=1, state=UpgradeState.POD_RESTART_REQUIRED)
+        env.cluster.patch_node_annotations(
+            "node-0", {env.keys.initial_state_annotation: TRUE_STRING})
+        mgr = make_state_manager(env)
+        mgr.process_pod_restart_nodes(mgr.build_state(NS, RUNTIME_LABELS))
+        assert env.state_of("node-0") == "upgrade-done"
+        annotations = env.cluster.get_node("node-0").metadata.annotations
+        assert env.keys.initial_state_annotation not in annotations
+
+    def test_crash_looping_pod_marks_failed(self):
+        env = make_env()
+        setup_fleet(env, n_nodes=1, state=UpgradeState.POD_RESTART_REQUIRED,
+                    ready=False)
+        env.cluster.set_pod_status(NS, "libtpu-0", restart_count=11)
+        mgr = make_state_manager(env)
+        mgr.process_pod_restart_nodes(mgr.build_state(NS, RUNTIME_LABELS))
+        assert env.state_of("node-0") == "upgrade-failed"
+
+    def test_not_ready_few_restarts_waits(self):
+        env = make_env()
+        setup_fleet(env, n_nodes=1, state=UpgradeState.POD_RESTART_REQUIRED,
+                    ready=False)
+        env.cluster.set_pod_status(NS, "libtpu-0", restart_count=3)
+        mgr = make_state_manager(env)
+        mgr.process_pod_restart_nodes(mgr.build_state(NS, RUNTIME_LABELS))
+        assert env.state_of("node-0") == "pod-restart-required"
+
+    def test_safe_load_unblocked_when_pod_synced(self):
+        env = make_env()
+        setup_fleet(env, n_nodes=1, state=UpgradeState.POD_RESTART_REQUIRED)
+        env.cluster.patch_node_annotations(
+            "node-0", {env.keys.wait_for_safe_load_annotation: "true"})
+        mgr = make_state_manager(env)
+        mgr.process_pod_restart_nodes(mgr.build_state(NS, RUNTIME_LABELS))
+        annotations = env.cluster.get_node("node-0").metadata.annotations
+        assert env.keys.wait_for_safe_load_annotation not in annotations
+
+
+class TestFailedState:
+    def test_recovers_when_pod_healthy(self):
+        env = make_env()
+        setup_fleet(env, n_nodes=1, state=UpgradeState.FAILED)
+        mgr = make_state_manager(env)
+        mgr.process_upgrade_failed_nodes(mgr.build_state(NS, RUNTIME_LABELS))
+        assert env.state_of("node-0") == "uncordon-required"
+
+    def test_stays_failed_when_pod_unhealthy(self):
+        env = make_env()
+        setup_fleet(env, n_nodes=1, state=UpgradeState.FAILED, ready=False)
+        mgr = make_state_manager(env)
+        mgr.process_upgrade_failed_nodes(mgr.build_state(NS, RUNTIME_LABELS))
+        assert env.state_of("node-0") == "upgrade-failed"
+
+    def test_initially_cordoned_recovery_to_done(self):
+        env = make_env()
+        setup_fleet(env, n_nodes=1, state=UpgradeState.FAILED)
+        env.cluster.patch_node_annotations(
+            "node-0", {env.keys.initial_state_annotation: TRUE_STRING})
+        mgr = make_state_manager(env)
+        mgr.process_upgrade_failed_nodes(mgr.build_state(NS, RUNTIME_LABELS))
+        assert env.state_of("node-0") == "upgrade-done"
+        annotations = env.cluster.get_node("node-0").metadata.annotations
+        assert env.keys.initial_state_annotation not in annotations
+
+
+class TestValidationAndUncordon:
+    def test_validation_passes_advances(self):
+        env = make_env()
+        _, nodes = setup_fleet(env, n_nodes=1,
+                               state=UpgradeState.VALIDATION_REQUIRED)
+        PodBuilder("validator").on_node(nodes[0]).orphaned() \
+            .with_labels({"app": "validator"}).ready().create(env.cluster)
+        mgr = make_state_manager(env).with_validation_enabled("app=validator")
+        mgr.process_validation_required_nodes(
+            mgr.build_state(NS, RUNTIME_LABELS))
+        assert env.state_of("node-0") == "uncordon-required"
+
+    def test_validation_pending_stays(self):
+        env = make_env()
+        _, nodes = setup_fleet(env, n_nodes=1,
+                               state=UpgradeState.VALIDATION_REQUIRED)
+        PodBuilder("validator").on_node(nodes[0]).orphaned() \
+            .with_labels({"app": "validator"}).ready(False) \
+            .create(env.cluster)
+        mgr = make_state_manager(env).with_validation_enabled("app=validator")
+        mgr.process_validation_required_nodes(
+            mgr.build_state(NS, RUNTIME_LABELS))
+        assert env.state_of("node-0") == "validation-required"
+
+    def test_uncordon_required_finishes(self):
+        env = make_env()
+        setup_fleet(env, n_nodes=1, state=UpgradeState.UNCORDON_REQUIRED)
+        env.cluster.set_node_unschedulable("node-0", True)
+        mgr = make_state_manager(env)
+        mgr.process_uncordon_required_nodes(
+            mgr.build_state(NS, RUNTIME_LABELS))
+        assert env.state_of("node-0") == "upgrade-done"
+        assert not env.cluster.get_node("node-0").is_unschedulable()
+
+
+class TestApplyStateGuards:
+    def test_nil_state_raises(self):
+        env = make_env()
+        mgr = make_state_manager(env)
+        with pytest.raises(ValueError):
+            mgr.apply_state(None, policy())
+
+    def test_disabled_policy_is_noop(self):
+        env = make_env()
+        setup_fleet(env, n_nodes=1, pod_hash="old", ds_hash="new")
+        mgr = make_state_manager(env)
+        mgr.apply_state(mgr.build_state(NS, RUNTIME_LABELS),
+                        UpgradePolicySpec(auto_upgrade=False))
+        assert env.state_of("node-0") == ""
+        mgr.apply_state(mgr.build_state(NS, RUNTIME_LABELS), None)
+        assert env.state_of("node-0") == ""
+
+
+class TestEndToEndRollingUpgrade:
+    """The minimum end-to-end slice (SURVEY.md §7 step 4), run repeatedly
+    until the whole fleet converges — BASELINE config #2 shape."""
+
+    def _reconcile_until_done(self, env, mgr, pol, max_iters=60):
+        max_cordoned = 0
+        for _ in range(max_iters):
+            state = mgr.build_state(NS, RUNTIME_LABELS)
+            mgr.apply_state(state, pol)
+            mgr.join_workers()
+            cordoned = sum(
+                1 for n in env.cluster.list_nodes()
+                if n.is_unschedulable())
+            max_cordoned = max(max_cordoned, cordoned)
+            env.clock.advance(5)
+            env.cluster.step()
+            states = [env.state_of(n.metadata.name)
+                      for n in env.cluster.list_nodes()]
+            if all(s == "upgrade-done" for s in states):
+                return max_cordoned
+        raise AssertionError(
+            f"fleet did not converge; states: "
+            f"{[env.state_of(n.metadata.name) for n in env.cluster.list_nodes()]}")
+
+    def test_full_rolling_upgrade_4_nodes(self):
+        env = make_env()
+        env.cluster.enable_ds_controller(recreate_delay=2, ready_delay=4)
+        setup_fleet(env, n_nodes=4, pod_hash="old", ds_hash="old")
+        env.cluster.bump_daemon_set_revision(NS, "libtpu", "new")
+        mgr = make_state_manager(env)
+        pol = policy(max_parallel_upgrades=1, max_unavailable=None,
+                     drain=DrainSpec(enable=True, force=True))
+        max_cordoned = self._reconcile_until_done(env, mgr, pol)
+        # maxParallelUpgrades=1 ⇒ never more than 1 node down at once
+        assert max_cordoned == 1
+        for pod in env.cluster.list_pods(label_selector="app=libtpu"):
+            assert pod.metadata.labels["controller-revision-hash"] == "new"
+            assert pod.is_ready()
+
+    def test_rolling_upgrade_respects_max_unavailable(self):
+        env = make_env()
+        env.cluster.enable_ds_controller(recreate_delay=2, ready_delay=4)
+        setup_fleet(env, n_nodes=8, pod_hash="old", ds_hash="old")
+        env.cluster.bump_daemon_set_revision(NS, "libtpu", "new")
+        mgr = make_state_manager(env)
+        pol = policy(max_parallel_upgrades=0, max_unavailable="25%",
+                     drain=DrainSpec(enable=True, force=True))
+        max_cordoned = self._reconcile_until_done(env, mgr, pol)
+        assert max_cordoned <= 2  # 25% of 8
+
+    def test_upgrade_with_workload_eviction(self):
+        env = make_env()
+        env.cluster.enable_ds_controller(recreate_delay=2, ready_delay=4)
+        _, nodes = setup_fleet(env, n_nodes=2, pod_hash="old", ds_hash="old")
+        env.cluster.bump_daemon_set_revision(NS, "libtpu", "new")
+        for i, node in enumerate(nodes):
+            PodBuilder(f"train-{i}").on_node(node).orphaned() \
+                .with_labels({"tpu-job": "true"}).create(env.cluster)
+        mgr = make_state_manager(env).with_pod_deletion_enabled(
+            lambda pod: pod.metadata.labels.get("tpu-job") == "true")
+        pol = policy(max_parallel_upgrades=1,
+                     pod_deletion=PodDeletionSpec(force=True),
+                     drain=DrainSpec(enable=True, force=True))
+        self._reconcile_until_done(env, mgr, pol)
+        remaining = [p.name for p in env.cluster.list_pods()]
+        assert not any(name.startswith("train-") for name in remaining)

@@ -1,0 +1,86 @@
+"""NodeUpgradeStateProvider tests (node_upgrade_state_provider_test.go
+parity: patch + readback, annotation null-delete, cache-sync polling)."""
+
+import pytest
+
+from tpu_operator_libs.consts import UpgradeState
+from tpu_operator_libs.upgrade.state_provider import CacheSyncTimeout
+from tpu_operator_libs.util import Event
+
+from builders import NodeBuilder
+from helpers import make_env
+
+
+class TestChangeNodeUpgradeState:
+    def test_sets_label_and_updates_node_in_place(self):
+        env = make_env()
+        node = NodeBuilder("n1").create(env.cluster)
+        env.provider.change_node_upgrade_state(
+            node, UpgradeState.UPGRADE_REQUIRED)
+        assert env.state_of("n1") == "upgrade-required"
+        # the caller's node object reflects the new state (the reference
+        # Gets into the caller's pointer)
+        assert node.metadata.labels[env.keys.state_label] == "upgrade-required"
+
+    def test_emits_success_event(self):
+        env = make_env()
+        node = NodeBuilder("n1").create(env.cluster)
+        env.provider.change_node_upgrade_state(node, UpgradeState.DONE)
+        events = env.recorder.find(reason=env.keys.event_reason,
+                                   type_=Event.NORMAL)
+        assert any("upgrade-done" in e.message for e in events)
+
+    def test_polls_through_stale_cache(self):
+        env = make_env()
+        node = NodeBuilder("n1").create(env.cluster)
+        env.cluster.inject_stale_node_reads("n1", reads=3)
+        env.provider.change_node_upgrade_state(
+            node, UpgradeState.CORDON_REQUIRED)
+        assert env.state_of("n1") == "cordon-required"
+
+    def test_times_out_when_never_visible(self):
+        env = make_env()
+        node = NodeBuilder("n1").create(env.cluster)
+        # More stale reads than the sync timeout allows at 0.01s poll with
+        # a virtual clock that advances on sleep (10s / 0.01 = 1000 polls).
+        env.cluster.inject_stale_node_reads("n1", reads=100000)
+        with pytest.raises(CacheSyncTimeout):
+            env.provider.change_node_upgrade_state(node, UpgradeState.DONE)
+        warnings = env.recorder.find(type_=Event.WARNING)
+        assert warnings
+
+    def test_missing_node_raises(self):
+        env = make_env()
+        node = NodeBuilder("ghost").build()  # never created
+        with pytest.raises(KeyError):
+            env.provider.change_node_upgrade_state(node, UpgradeState.DONE)
+
+
+class TestChangeNodeUpgradeAnnotation:
+    def test_set_and_delete(self):
+        env = make_env()
+        node = NodeBuilder("n1").create(env.cluster)
+        key = env.keys.validation_start_annotation
+        env.provider.change_node_upgrade_annotation(node, key, "12345")
+        assert env.cluster.get_node("n1").metadata.annotations[key] == "12345"
+        assert node.metadata.annotations[key] == "12345"
+        # "null" and None both delete (node_upgrade_state_provider.go:147-151)
+        env.provider.change_node_upgrade_annotation(node, key, "null")
+        assert key not in env.cluster.get_node("n1").metadata.annotations
+        assert key not in node.metadata.annotations
+
+    def test_delete_absent_annotation_is_ok(self):
+        env = make_env()
+        node = NodeBuilder("n1").create(env.cluster)
+        env.provider.change_node_upgrade_annotation(
+            node, env.keys.validation_start_annotation, None)
+        assert env.keys.validation_start_annotation not in (
+            env.cluster.get_node("n1").metadata.annotations)
+
+
+class TestGetNode:
+    def test_returns_fresh_snapshot(self):
+        env = make_env()
+        NodeBuilder("n1").create(env.cluster)
+        env.cluster.patch_node_labels("n1", {"x": "1"})
+        assert env.provider.get_node("n1").metadata.labels["x"] == "1"

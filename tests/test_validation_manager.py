@@ -1,0 +1,128 @@
+"""ValidationManager + SafeRuntimeLoadManager tests
+(validation_manager_test.go:45-160 and safe_driver_load_manager_test.go
+parity, plus the TPU extra-validator seam)."""
+
+from tpu_operator_libs.consts import UpgradeState
+from tpu_operator_libs.upgrade.safe_load_manager import SafeRuntimeLoadManager
+
+from builders import NodeBuilder, PodBuilder
+from helpers import make_env, make_validation_manager
+
+
+class TestValidate:
+    def test_empty_selector_trivially_true(self):
+        env = make_env()
+        node = NodeBuilder("n1").create(env.cluster)
+        assert make_validation_manager(env, "").validate(node) is True
+
+    def test_ready_validation_pod_passes(self):
+        env = make_env()
+        node = NodeBuilder("n1").create(env.cluster)
+        PodBuilder("validator").on_node(node).orphaned() \
+            .with_labels({"app": "validator"}).ready().create(env.cluster)
+        mgr = make_validation_manager(env, "app=validator")
+        assert mgr.validate(node) is True
+
+    def test_no_pods_returns_false_without_timer(self):
+        env = make_env()
+        node = NodeBuilder("n1").create(env.cluster)
+        mgr = make_validation_manager(env, "app=validator")
+        assert mgr.validate(node) is False
+        # reference returns early before timeout handling when no pods
+        # exist (validation_manager.go:85-89): no stamp
+        assert env.keys.validation_start_annotation not in (
+            env.cluster.get_node("n1").metadata.annotations)
+
+    def test_not_ready_pod_starts_timer_then_fails(self):
+        env = make_env()
+        node = NodeBuilder("n1").with_upgrade_state(
+            env.keys, UpgradeState.VALIDATION_REQUIRED).create(env.cluster)
+        PodBuilder("validator").on_node(node).orphaned() \
+            .with_labels({"app": "validator"}).ready(False).create(env.cluster)
+        mgr = make_validation_manager(env, "app=validator",
+                                      timeout_seconds=600)
+        node = env.provider.get_node("n1")
+        assert mgr.validate(node) is False
+        annotation = env.keys.validation_start_annotation
+        assert annotation in env.cluster.get_node("n1").metadata.annotations
+
+        # before expiry: still false, state unchanged
+        env.clock.advance(300)
+        node = env.provider.get_node("n1")
+        assert mgr.validate(node) is False
+        assert env.state_of("n1") == "validation-required"
+
+        # after expiry: node failed, stamp cleared
+        env.clock.advance(301)
+        node = env.provider.get_node("n1")
+        assert mgr.validate(node) is False
+        assert env.state_of("n1") == "upgrade-failed"
+        assert annotation not in env.cluster.get_node(
+            "n1").metadata.annotations
+
+    def test_success_clears_timer(self):
+        env = make_env()
+        node = NodeBuilder("n1").create(env.cluster)
+        pod = PodBuilder("validator").on_node(node).orphaned() \
+            .with_labels({"app": "validator"}).ready(False).create(env.cluster)
+        mgr = make_validation_manager(env, "app=validator")
+        node = env.provider.get_node("n1")
+        assert mgr.validate(node) is False  # stamps timer
+        env.cluster.set_pod_status("tpu-system", pod.name, ready=True)
+        node = env.provider.get_node("n1")
+        assert mgr.validate(node) is True
+        assert env.keys.validation_start_annotation not in (
+            env.cluster.get_node("n1").metadata.annotations)
+
+    def test_extra_validator_gate(self):
+        env = make_env()
+        node = NodeBuilder("n1").create(env.cluster)
+        healthy = {"value": False}
+        mgr = make_validation_manager(
+            env, "", extra_validator=lambda n: healthy["value"])
+        node = env.provider.get_node("n1")
+        assert mgr.validate(node) is False  # gate fails, timer starts
+        healthy["value"] = True
+        node = env.provider.get_node("n1")
+        assert mgr.validate(node) is True
+
+    def test_extra_validator_exception_is_unhealthy(self):
+        env = make_env()
+        node = NodeBuilder("n1").create(env.cluster)
+
+        def broken(n):
+            raise RuntimeError("fabric probe crashed")
+
+        mgr = make_validation_manager(env, "", extra_validator=broken)
+        node = env.provider.get_node("n1")
+        assert mgr.validate(node) is False
+
+
+class TestSafeRuntimeLoad:
+    def test_detects_waiting_annotation(self):
+        env = make_env()
+        node = NodeBuilder("n1").with_annotations(
+            {env.keys.wait_for_safe_load_annotation: "true"}) \
+            .create(env.cluster)
+        mgr = SafeRuntimeLoadManager(env.provider)
+        node = env.provider.get_node("n1")
+        assert mgr.is_waiting_for_safe_load(node) is True
+
+    def test_unblock_removes_annotation(self):
+        env = make_env()
+        NodeBuilder("n1").with_annotations(
+            {env.keys.wait_for_safe_load_annotation: "true"}) \
+            .create(env.cluster)
+        mgr = SafeRuntimeLoadManager(env.provider)
+        node = env.provider.get_node("n1")
+        mgr.unblock_loading(node)
+        assert env.keys.wait_for_safe_load_annotation not in (
+            env.cluster.get_node("n1").metadata.annotations)
+        assert mgr.is_waiting_for_safe_load(node) is False
+
+    def test_unblock_noop_when_not_waiting(self):
+        env = make_env()
+        NodeBuilder("n1").create(env.cluster)
+        mgr = SafeRuntimeLoadManager(env.provider)
+        node = env.provider.get_node("n1")
+        mgr.unblock_loading(node)  # must not raise or patch

@@ -122,14 +122,25 @@ class FakeCluster(K8sClient):
                 copy.deepcopy(pod))
         return pod
 
+    @staticmethod
+    def _check_revision_hash(revision_hash: str) -> None:
+        """Controller-generated revision hashes are single dash-free
+        segments; enforcing that here keeps the '<ds-name>-<hash>' naming
+        scheme reversible (pod_manager.go:118-119)."""
+        if not revision_hash or "-" in revision_hash:
+            raise ValueError(
+                f"revision hash must be a non-empty dash-free segment, "
+                f"got {revision_hash!r}")
+
     def add_daemon_set(self, ds: DaemonSet,
-                       revision_hash: str = "rev-1",
+                       revision_hash: str = "rev1",
                        revision: int = 1) -> DaemonSet:
         """Register a DaemonSet plus its current ControllerRevision.
 
         The revision object is named ``<ds-name>-<hash>`` so the hash can be
         recovered as the name suffix (pod_manager.go:118-119).
         """
+        self._check_revision_hash(revision_hash)
         with self._lock:
             self._daemon_sets[(ds.metadata.namespace, ds.metadata.name)] = (
                 copy.deepcopy(ds))
@@ -157,8 +168,11 @@ class FakeCluster(K8sClient):
         are therefore out of sync — the trigger condition for an upgrade
         (upgrade_state.go:558-578).
         """
+        self._check_revision_hash(revision_hash)
         with self._lock:
-            ds = self._daemon_sets[(namespace, name)]
+            ds = self._daemon_sets.get((namespace, name))
+            if ds is None:
+                raise NotFoundError(f"daemonset {namespace}/{name} not found")
             ds.spec.template_generation += 1
             latest = max((r.revision for r in self._revisions_of(namespace, name)),
                          default=0)
@@ -229,11 +243,14 @@ class FakeCluster(K8sClient):
                 return None
             return min(a.due for a in self._scheduled)
 
-    def _schedule(self, delay: float, action: Callable[[], None]) -> None:
+    def _schedule(self, delay: float, action: Callable[[], None]) -> float:
+        return self._schedule_at(self._clock.now() + delay, action)
+
+    def _schedule_at(self, due: float, action: Callable[[], None]) -> float:
         with self._lock:
             self._seq += 1
-            self._scheduled.append(
-                _ScheduledAction(self._clock.now() + delay, self._seq, action))
+            self._scheduled.append(_ScheduledAction(due, self._seq, action))
+            return due
 
     # ------------------------------------------------------------------
     # K8sClient: nodes
@@ -348,10 +365,11 @@ class FakeCluster(K8sClient):
                 raise NotFoundError(f"pod {namespace}/{name} not found")
             if phase is not None:
                 pod.status.phase = phase
-            if ready is not None:
+            if ready is not None or restart_count is not None:
                 if not pod.status.container_statuses:
                     pod.status.container_statuses = [
                         ContainerStatus(name="main")]
+            if ready is not None:
                 for c in pod.status.container_statuses:
                     c.ready = ready
             if restart_count is not None:
@@ -395,6 +413,7 @@ class FakeCluster(K8sClient):
             return
         namespace, ds_name = ds_key
         node_name = pod.spec.node_name
+        recreate_due = self._clock.now() + cfg.recreate_delay
 
         def recreate() -> None:
             with self._lock:
@@ -426,9 +445,12 @@ class FakeCluster(K8sClient):
                                 c.ready = True
                             p.metadata.resource_version += 1
 
-                self._schedule(cfg.ready_delay, make_ready)
+                # Anchor readiness to the recreation's due time, not to
+                # whenever step() happened to execute the action, so coarse
+                # step() calls don't inflate pod-ready latencies.
+                self._schedule_at(recreate_due + cfg.ready_delay, make_ready)
 
-        self._schedule(cfg.recreate_delay, recreate)
+        self._schedule_at(recreate_due, recreate)
 
     # ------------------------------------------------------------------
     # K8sClient: daemonsets & revisions

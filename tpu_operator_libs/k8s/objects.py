@@ -231,5 +231,7 @@ class ControllerRevision:
     @property
     def hash(self) -> str:
         """The revision hash is the name suffix after '<ds-name>-'
-        (pod_manager.go:118-119)."""
+        (pod_manager.go:118-119). Controller-generated hashes never contain
+        hyphens (FakeCluster enforces this for injected hashes), so the last
+        segment is always the full hash."""
         return self.metadata.name.rsplit("-", 1)[-1]
