@@ -1,0 +1,284 @@
+"""Health gate tests: ICI fabric probe on the virtual 8-device CPU mesh,
+checkpoint-durability gate against real Orbax checkpoints, and the
+eviction-gate integration with PodManager (BASELINE config #4)."""
+
+import os
+
+import numpy as np
+import pytest
+
+from tpu_operator_libs.api.upgrade_policy import PodDeletionSpec
+from tpu_operator_libs.health.checkpoint_gate import (
+    CheckpointDurabilityGate,
+    latest_committed_step,
+)
+from tpu_operator_libs.upgrade.pod_manager import PodManager, PodManagerConfig
+from tpu_operator_libs.util import FakeClock, Worker
+
+from builders import NodeBuilder, PodBuilder
+from helpers import make_env
+
+
+class TestFabricProbe:
+    def test_probe_healthy_on_8_device_mesh(self):
+        from tpu_operator_libs.health.ici_probe import fabric_probe
+        result = fabric_probe(n_devices=8)
+        assert result.n_devices == 8
+        assert result.healthy, str(result)
+        assert result.max_abs_error <= 1e-3
+        assert result.latency_s > 0
+
+    def test_probe_healthy_on_small_meshes(self):
+        from tpu_operator_libs.health.ici_probe import fabric_probe
+        for n in (1, 2, 4):
+            result = fabric_probe(n_devices=n)
+            assert result.healthy, f"{n} devices: {result}"
+
+    def test_single_chip_probe_jits(self):
+        import jax
+        from tpu_operator_libs.health.ici_probe import single_chip_probe
+        fn, args = single_chip_probe()
+        out = jax.jit(fn)(*args)
+        assert out.shape == (128, 128)
+        # closed-form check: x=0.5, w=I -> y=0.5, tanh(0.5)+0.25
+        expected = np.tanh(0.5) + 0.25
+        np.testing.assert_allclose(np.asarray(out), expected, atol=1e-2)
+
+    def test_validator_caches(self):
+        from tpu_operator_libs.health.ici_probe import ICIFabricValidator
+        calls = {"n": 0}
+
+        def fake_probe():
+            calls["n"] += 1
+            return True
+
+        clock = FakeClock()
+        validator = ICIFabricValidator(probe_runner=fake_probe,
+                                       cache_seconds=100, clock=clock)
+        assert validator(None) and validator(None)
+        assert calls["n"] == 1  # cached
+        clock.advance(101)
+        assert validator(None)
+        assert calls["n"] == 2  # expired
+
+
+class TestCheckpointDetection:
+    def _mk_step(self, root, name, committed=True, marker=False):
+        d = os.path.join(root, name)
+        os.makedirs(d)
+        if committed or marker:
+            with open(os.path.join(d, "checkpoint"), "w") as f:
+                f.write("data")
+        if marker:
+            with open(os.path.join(d, "commit_success.txt"), "w") as f:
+                f.write("ok")
+        return d
+
+    def test_missing_dir_is_none(self, tmp_path):
+        assert latest_committed_step(str(tmp_path / "ghost")) is None
+
+    def test_latest_committed_wins(self, tmp_path):
+        root = str(tmp_path)
+        self._mk_step(root, "100")
+        self._mk_step(root, "200")
+        assert latest_committed_step(root) == 200
+
+    def test_tmp_dirs_ignored(self, tmp_path):
+        root = str(tmp_path)
+        self._mk_step(root, "100")
+        self._mk_step(root, "200.orbax-checkpoint-tmp-1234567")
+        assert latest_committed_step(root) == 100
+
+    def test_empty_step_dir_not_committed(self, tmp_path):
+        root = str(tmp_path)
+        os.makedirs(os.path.join(root, "300"))
+        self._mk_step(root, "100")
+        assert latest_committed_step(root) == 100
+
+    def test_commit_marker_layout(self, tmp_path):
+        root = str(tmp_path)
+        self._mk_step(root, "100", marker=True)
+        assert latest_committed_step(root) == 100
+
+    def test_prefixed_step_names(self, tmp_path):
+        root = str(tmp_path)
+        self._mk_step(root, "step_500")
+        assert latest_committed_step(root) == 500
+
+    def test_real_orbax_checkpoint(self, tmp_path):
+        """Write a real Orbax checkpoint and verify the reader agrees with
+        orbax about what is committed."""
+        ocp = pytest.importorskip("orbax.checkpoint")
+        import jax.numpy as jnp
+
+        root = tmp_path / "ckpt"
+        with ocp.CheckpointManager(str(root)) as mngr:
+            mngr.save(42, args=ocp.args.StandardSave(
+                {"w": jnp.ones((4, 4))}))
+            mngr.wait_until_finished()
+            assert mngr.latest_step() == 42
+        assert latest_committed_step(str(root)) == 42
+
+
+class TestCheckpointGate:
+    def test_gate_closed_without_checkpoint(self, tmp_path):
+        gate = CheckpointDurabilityGate(str(tmp_path))
+        assert gate.check() is False
+
+    def test_gate_open_with_committed_step(self, tmp_path):
+        d = tmp_path / "100"
+        d.mkdir()
+        (d / "checkpoint").write_text("data")
+        gate = CheckpointDurabilityGate(str(tmp_path))
+        assert gate.check() is True
+
+    def test_min_step_enforced(self, tmp_path):
+        d = tmp_path / "100"
+        d.mkdir()
+        (d / "checkpoint").write_text("data")
+        assert CheckpointDurabilityGate(
+            str(tmp_path), min_step=200).check() is False
+        assert CheckpointDurabilityGate(
+            str(tmp_path), min_step=100).check() is True
+
+    def test_max_age_enforced(self, tmp_path):
+        d = tmp_path / "100"
+        d.mkdir()
+        (d / "checkpoint").write_text("data")
+        os.utime(d, (0, 0))  # ancient
+        assert CheckpointDurabilityGate(
+            str(tmp_path), max_age_seconds=60).check() is False
+        assert CheckpointDurabilityGate(
+            str(tmp_path), max_age_seconds=0).check() is True
+
+
+class TestGateCannotBeBypassed:
+    def test_blocked_pod_with_closed_gate_parks_not_drains(self, tmp_path):
+        """A PDB-blocked pod + closed gate must NOT escalate to drain
+        (which would evict without consulting the gate)."""
+        env = make_env()
+        node = NodeBuilder("n1").with_upgrade_state(
+            env.keys, "pod-deletion-required").create(env.cluster)
+        PodBuilder("train").on_node(node).orphaned() \
+            .with_labels({"tpu-job": "true"}).create(env.cluster)
+        gate = CheckpointDurabilityGate(str(tmp_path / "none"))
+        mgr = PodManager(
+            env.cluster, env.provider,
+            lambda pod: pod.metadata.labels.get("tpu-job") == "true",
+            env.recorder, env.clock, Worker(async_mode=False),
+            eviction_gate=gate)
+        node = env.provider.get_node("n1")
+        # force=False would make the unreplicated pod undeletable — but the
+        # gate must be checked FIRST, so the node parks instead of
+        # escalating to drain-required.
+        mgr.schedule_pod_eviction(PodManagerConfig(
+            nodes=[node], deletion_spec=PodDeletionSpec(force=False),
+            drain_enabled=True))
+        assert env.state_of("n1") == "pod-deletion-required"
+        assert len(env.cluster.list_pods()) == 1
+
+    def test_raising_gate_parks_not_escalates(self):
+        env = make_env()
+        node = NodeBuilder("n1").with_upgrade_state(
+            env.keys, "pod-deletion-required").create(env.cluster)
+        PodBuilder("train").on_node(node).orphaned() \
+            .with_labels({"tpu-job": "true"}).create(env.cluster)
+
+        def broken_gate(node, pods):
+            raise OSError("transient storage error")
+
+        mgr = PodManager(
+            env.cluster, env.provider,
+            lambda pod: pod.metadata.labels.get("tpu-job") == "true",
+            env.recorder, env.clock, Worker(async_mode=False),
+            eviction_gate=broken_gate)
+        node = env.provider.get_node("n1")
+        mgr.schedule_pod_eviction(PodManagerConfig(
+            nodes=[node], deletion_spec=PodDeletionSpec(force=True),
+            drain_enabled=True))
+        assert env.state_of("n1") == "pod-deletion-required"
+        assert len(env.cluster.list_pods()) == 1
+
+    def test_drain_manager_honors_gate(self, tmp_path):
+        from tpu_operator_libs.api.upgrade_policy import DrainSpec
+        from tpu_operator_libs.upgrade.drain_manager import (
+            DrainConfiguration,
+            DrainManager,
+        )
+        env = make_env()
+        node = NodeBuilder("n1").with_upgrade_state(
+            env.keys, "drain-required").create(env.cluster)
+        PodBuilder("train").on_node(node).orphaned().create(env.cluster)
+        gate = CheckpointDurabilityGate(str(tmp_path / "none"))
+        mgr = DrainManager(env.cluster, env.provider, env.recorder,
+                           env.clock, Worker(async_mode=False),
+                           eviction_gate=gate)
+        node = env.provider.get_node("n1")
+        mgr.schedule_nodes_drain(DrainConfiguration(
+            spec=DrainSpec(enable=True, force=True), nodes=[node]))
+        # gate closed: parked in drain-required, workload alive
+        assert env.state_of("n1") == "drain-required"
+        assert len(env.cluster.list_pods()) == 1
+        # open the gate -> drain proceeds
+        d = tmp_path / "none"
+        d.mkdir()
+        (d / "100").mkdir()
+        (d / "100" / "ckpt").write_text("x")
+        node = env.provider.get_node("n1")
+        mgr.schedule_nodes_drain(DrainConfiguration(
+            spec=DrainSpec(enable=True, force=True), nodes=[node]))
+        assert env.state_of("n1") == "pod-restart-required"
+        assert env.cluster.list_pods() == []
+
+    def test_deferral_event_emitted_once(self, tmp_path):
+        env = make_env()
+        node = NodeBuilder("n1").with_upgrade_state(
+            env.keys, "pod-deletion-required").create(env.cluster)
+        PodBuilder("train").on_node(node).orphaned() \
+            .with_labels({"tpu-job": "true"}).create(env.cluster)
+        gate = CheckpointDurabilityGate(str(tmp_path / "none"))
+        mgr = PodManager(
+            env.cluster, env.provider,
+            lambda pod: pod.metadata.labels.get("tpu-job") == "true",
+            env.recorder, env.clock, Worker(async_mode=False),
+            eviction_gate=gate)
+        for _ in range(5):
+            node = env.provider.get_node("n1")
+            mgr.schedule_pod_eviction(PodManagerConfig(
+                nodes=[node], deletion_spec=PodDeletionSpec(force=True)))
+        deferrals = [e for e in env.recorder.events
+                     if "deferred" in e.message.lower()]
+        assert len(deferrals) == 1
+
+
+class TestEvictionGateIntegration:
+    def test_closed_gate_parks_node(self, tmp_path):
+        env = make_env()
+        node = NodeBuilder("n1").with_upgrade_state(
+            env.keys, "pod-deletion-required").create(env.cluster)
+        PodBuilder("train").on_node(node).orphaned() \
+            .with_labels({"tpu-job": "true"}).create(env.cluster)
+        gate = CheckpointDurabilityGate(str(tmp_path / "none"))
+        mgr = PodManager(
+            env.cluster, env.provider,
+            lambda pod: pod.metadata.labels.get("tpu-job") == "true",
+            env.recorder, env.clock, Worker(async_mode=False),
+            eviction_gate=gate)
+        node = env.provider.get_node("n1")
+        mgr.schedule_pod_eviction(PodManagerConfig(
+            nodes=[node], deletion_spec=PodDeletionSpec(force=True)))
+        # gate closed: pod alive, node parked in pod-deletion-required
+        assert len(env.cluster.list_pods()) == 1
+        assert env.state_of("n1") == "pod-deletion-required"
+
+        # checkpoint commits -> gate opens -> eviction proceeds
+        d = tmp_path / "none"
+        d.mkdir()
+        step = d / "1000"
+        step.mkdir()
+        (step / "checkpoint").write_text("data")
+        node = env.provider.get_node("n1")
+        mgr.schedule_pod_eviction(PodManagerConfig(
+            nodes=[node], deletion_spec=PodDeletionSpec(force=True)))
+        assert env.cluster.list_pods() == []
+        assert env.state_of("n1") == "pod-restart-required"

@@ -1,0 +1,232 @@
+"""Topology layer tests: slice grouping from GKE labels, slice-atomic
+planning, budget accounting, and a slice-mode rolling upgrade e2e
+(BASELINE config #3: multi-host v5e-16 slice, ICI-topology-aware drain
+ordering)."""
+
+from tpu_operator_libs.api.upgrade_policy import DrainSpec, UpgradePolicySpec
+from tpu_operator_libs.consts import (
+    GKE_NODEPOOL_LABEL,
+    GKE_TPU_ACCELERATOR_LABEL,
+    GKE_TPU_TOPOLOGY_LABEL,
+    UpgradeState,
+)
+from tpu_operator_libs.topology import (
+    SlicePlanner,
+    SliceTopology,
+    slice_id_for_node,
+)
+from tpu_operator_libs.topology.slice_topology import parse_chip_topology
+
+from builders import DaemonSetBuilder, NodeBuilder, PodBuilder
+from helpers import make_env, make_state_manager
+
+NS = "tpu-system"
+RUNTIME_LABELS = {"app": "libtpu"}
+
+
+def tpu_labels(pool: str, accel: str = "tpu-v5-lite-podslice",
+               topo: str = "4x4") -> dict:
+    return {GKE_NODEPOOL_LABEL: pool,
+            GKE_TPU_ACCELERATOR_LABEL: accel,
+            GKE_TPU_TOPOLOGY_LABEL: topo}
+
+
+def setup_sliced_fleet(env, n_slices=4, hosts_per_slice=4,
+                       pod_hash="old", ds_hash="old", state=None):
+    """n_slices multi-host slices, one libtpu DS pod per host."""
+    total = n_slices * hosts_per_slice
+    ds = DaemonSetBuilder("libtpu").with_labels(dict(RUNTIME_LABELS)) \
+        .with_desired_scheduled(total).with_revision_hash(ds_hash) \
+        .create(env.cluster)
+    nodes = []
+    for s in range(n_slices):
+        for h in range(hosts_per_slice):
+            b = NodeBuilder(f"s{s}-h{h}").with_labels(
+                tpu_labels(f"pool-{s}"))
+            if state is not None:
+                b = b.with_upgrade_state(env.keys, state)
+            node = b.create(env.cluster)
+            PodBuilder(f"libtpu-s{s}-h{h}").on_node(node).owned_by(ds) \
+                .with_revision_hash(pod_hash).create(env.cluster)
+            nodes.append(node)
+    return ds, nodes
+
+
+class TestSliceTopology:
+    def test_groups_by_nodepool(self):
+        env = make_env()
+        for s in range(2):
+            for h in range(3):
+                NodeBuilder(f"s{s}-h{h}").with_labels(
+                    tpu_labels(f"pool-{s}")).create(env.cluster)
+        topo = SliceTopology.from_nodes(env.cluster.list_nodes())
+        assert set(topo.slices) == {"pool-0", "pool-1"}
+        assert all(len(s.nodes) == 3 for s in topo.slices.values())
+        assert all(s.is_multi_host for s in topo.slices.values())
+
+    def test_non_tpu_nodes_are_singleton_slices(self):
+        env = make_env()
+        NodeBuilder("plain-1").create(env.cluster)
+        NodeBuilder("plain-2").create(env.cluster)
+        topo = SliceTopology.from_nodes(env.cluster.list_nodes())
+        assert len(topo.slices) == 2
+        assert not any(s.is_multi_host for s in topo.slices.values())
+
+    def test_slice_availability(self):
+        env = make_env()
+        for s in range(2):
+            for h in range(2):
+                NodeBuilder(f"s{s}-h{h}").with_labels(
+                    tpu_labels(f"pool-{s}")).create(env.cluster)
+        env.cluster.set_node_unschedulable("s0-h1", True)
+        topo = SliceTopology.from_nodes(env.cluster.list_nodes())
+        assert not topo.slices["pool-0"].is_available
+        assert topo.slices["pool-1"].is_available
+        assert topo.availability() == 0.5
+
+    def test_chip_topology_parsing(self):
+        assert parse_chip_topology("4x4x8") == (4, 4, 8)
+        assert parse_chip_topology("2x2") == (2, 2)
+        assert parse_chip_topology("bogus") is None
+
+    def test_slice_id_for_plain_node(self):
+        env = make_env()
+        node = NodeBuilder("plain").create(env.cluster)
+        assert slice_id_for_node(node).startswith("node:")
+
+
+class TestSlicePlanner:
+    def _candidates(self, env, mgr):
+        state = mgr.build_state(NS, RUNTIME_LABELS)
+        return state.bucket(UpgradeState.UPGRADE_REQUIRED), state
+
+    def test_advances_whole_slice_atomically(self):
+        env = make_env()
+        setup_sliced_fleet(env, n_slices=3, hosts_per_slice=4,
+                           state=UpgradeState.UPGRADE_REQUIRED)
+        mgr = make_state_manager(env)
+        candidates, state = self._candidates(env, mgr)
+        planned = SlicePlanner().plan(candidates, 4, state)
+        slices = {slice_id_for_node(ns.node) for ns in planned}
+        assert len(planned) == 4
+        assert len(slices) == 1  # all four from the same slice
+
+    def test_budget_allows_multiple_slices(self):
+        env = make_env()
+        setup_sliced_fleet(env, n_slices=3, hosts_per_slice=2,
+                           state=UpgradeState.UPGRADE_REQUIRED)
+        mgr = make_state_manager(env)
+        candidates, state = self._candidates(env, mgr)
+        planned = SlicePlanner().plan(candidates, 4, state)
+        slices = {slice_id_for_node(ns.node) for ns in planned}
+        assert len(planned) == 4 and len(slices) == 2
+
+    def test_overdraw_for_first_slice_prevents_deadlock(self):
+        # budget 1 < slice size 4: the slice still advances as a unit
+        env = make_env()
+        setup_sliced_fleet(env, n_slices=2, hosts_per_slice=4,
+                           state=UpgradeState.UPGRADE_REQUIRED)
+        mgr = make_state_manager(env)
+        candidates, state = self._candidates(env, mgr)
+        planned = SlicePlanner().plan(candidates, 1, state)
+        slices = {slice_id_for_node(ns.node) for ns in planned}
+        assert len(planned) == 4 and len(slices) == 1
+
+    def test_zero_budget_blocks_unless_free(self):
+        env = make_env()
+        setup_sliced_fleet(env, n_slices=2, hosts_per_slice=2,
+                           state=UpgradeState.UPGRADE_REQUIRED)
+        mgr = make_state_manager(env)
+        candidates, state = self._candidates(env, mgr)
+        assert SlicePlanner().plan(candidates, 0, state) == []
+        # cordon every host of slice 0: its candidates are now free
+        env.cluster.set_node_unschedulable("s0-h0", True)
+        env.cluster.set_node_unschedulable("s0-h1", True)
+        candidates, state = self._candidates(env, mgr)
+        planned = SlicePlanner().plan(candidates, 0, state)
+        assert {ns.node.metadata.name for ns in planned} == {"s0-h0", "s0-h1"}
+
+    def test_prefers_already_broken_slice(self):
+        env = make_env()
+        setup_sliced_fleet(env, n_slices=3, hosts_per_slice=2,
+                           state=UpgradeState.UPGRADE_REQUIRED)
+        # slice 2 already has one host down
+        env.cluster.set_node_unschedulable("s2-h0", True)
+        mgr = make_state_manager(env)
+        candidates, state = self._candidates(env, mgr)
+        planned = SlicePlanner().plan(candidates, 2, state)
+        slices = {slice_id_for_node(ns.node) for ns in planned}
+        assert "pool-2" in slices
+
+    def test_single_host_slices_behave_flat(self):
+        env = make_env()
+        ds = DaemonSetBuilder("libtpu").with_labels(dict(RUNTIME_LABELS)) \
+            .with_desired_scheduled(3).create(env.cluster)
+        for i in range(3):
+            node = NodeBuilder(f"n{i}").with_upgrade_state(
+                env.keys, UpgradeState.UPGRADE_REQUIRED).create(env.cluster)
+            PodBuilder(f"p{i}").on_node(node).owned_by(ds) \
+                .with_revision_hash("rev1").create(env.cluster)
+        mgr = make_state_manager(env)
+        candidates, state = self._candidates(env, mgr)
+        planned = SlicePlanner().plan(candidates, 2, state)
+        assert len(planned) == 2
+
+
+class TestSliceModeEndToEnd:
+    def test_slice_mode_cordons_whole_slice_together(self):
+        env = make_env()
+        env.cluster.enable_ds_controller(recreate_delay=2, ready_delay=4)
+        setup_sliced_fleet(env, n_slices=2, hosts_per_slice=4)
+        env.cluster.bump_daemon_set_revision(NS, "libtpu", "new")
+        mgr = make_state_manager(env)
+        pol = UpgradePolicySpec(
+            auto_upgrade=True, max_parallel_upgrades=0, max_unavailable=4,
+            topology_mode="slice",
+            drain=DrainSpec(enable=True, force=True))
+
+        per_pass_cordoned_slices = []
+        for _ in range(60):
+            state = mgr.build_state(NS, RUNTIME_LABELS)
+            mgr.apply_state(state, pol)
+            mgr.join_workers()
+            cordoned = [n.metadata.name for n in env.cluster.list_nodes()
+                        if n.is_unschedulable()]
+            if cordoned:
+                by_slice = {}
+                for name in cordoned:
+                    sid = name.split("-")[0]
+                    by_slice.setdefault(sid, []).append(name)
+                per_pass_cordoned_slices.append(by_slice)
+            env.clock.advance(3)
+            env.cluster.step()
+            states = [env.state_of(n.metadata.name)
+                      for n in env.cluster.list_nodes()]
+            if all(s == "upgrade-done" for s in states):
+                break
+        else:
+            raise AssertionError("fleet did not converge")
+
+        # whenever a slice had any host cordoned, ALL its hosts were
+        # cordoned in the same observation (atomic slice drain)
+        for by_slice in per_pass_cordoned_slices:
+            for sid, hosts in by_slice.items():
+                assert len(hosts) == 4, (
+                    f"slice {sid} partially cordoned: {hosts}")
+        # and only one slice was down at a time (maxUnavailable=4 hosts)
+        assert all(len(bs) == 1 for bs in per_pass_cordoned_slices)
+
+    def test_flat_mode_unchanged_by_default(self):
+        env = make_env()
+        setup_sliced_fleet(env, n_slices=1, hosts_per_slice=4,
+                           state=UpgradeState.UPGRADE_REQUIRED)
+        mgr = make_state_manager(env)
+        pol = UpgradePolicySpec(auto_upgrade=True, max_parallel_upgrades=1,
+                                max_unavailable=None)
+        state = mgr.build_state(NS, RUNTIME_LABELS)
+        mgr.process_upgrade_required_nodes(
+            state, 1, planner=mgr._planner_for_policy(pol))
+        cordon_count = sum(
+            1 for n in env.cluster.list_nodes()
+            if env.state_of(n.metadata.name) == "cordon-required")
+        assert cordon_count == 1  # flat: one node only

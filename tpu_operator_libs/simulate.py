@@ -1,0 +1,209 @@
+"""Discrete-event simulation of a rolling libtpu upgrade.
+
+Drives the real state machine (not a model of it) against the FakeCluster's
+DaemonSet-controller simulation under a virtual clock, and measures the
+north-star metrics from BASELINE.md:
+
+- **drain→ready p50 (s)** per node: wall-clock from the moment a node
+  leaves service (cordoned) until it is back in ``upgrade-done``.
+- **slice availability %**: time-weighted fraction of ICI slices fully
+  available over the upgrade window (a multi-host slice counts as down
+  whenever any of its hosts is cordoned or not-ready).
+
+Running the same fleet with ``topology_mode`` flat (reference semantics)
+vs ``slice`` (topology-aware planning) quantifies the benefit of
+slice-atomic upgrades — the comparison ``bench.py`` reports.
+"""
+
+from __future__ import annotations
+
+import random
+import statistics
+from dataclasses import dataclass, field
+from typing import Optional
+
+from tpu_operator_libs.api.upgrade_policy import (
+    DrainSpec,
+    UpgradePolicySpec,
+)
+from tpu_operator_libs.consts import (
+    GKE_NODEPOOL_LABEL,
+    GKE_TPU_ACCELERATOR_LABEL,
+    GKE_TPU_TOPOLOGY_LABEL,
+    POD_CONTROLLER_REVISION_HASH_LABEL,
+    UpgradeKeys,
+    UpgradeState,
+)
+from tpu_operator_libs.k8s.fake import FakeCluster
+from tpu_operator_libs.k8s.objects import (
+    ContainerStatus,
+    DaemonSet,
+    DaemonSetSpec,
+    DaemonSetStatus,
+    Node,
+    ObjectMeta,
+    OwnerReference,
+    Pod,
+    PodPhase,
+    PodSpec,
+    PodStatus,
+)
+from tpu_operator_libs.topology.slice_topology import SliceTopology
+from tpu_operator_libs.upgrade.state_manager import ClusterUpgradeStateManager
+from tpu_operator_libs.util import FakeClock
+
+NS = "tpu-system"
+RUNTIME_LABELS = {"app": "libtpu"}
+
+
+@dataclass
+class FleetSpec:
+    """Shape of the simulated fleet (BASELINE config #3: v5e-16-style
+    multi-host slices)."""
+
+    n_slices: int = 4
+    hosts_per_slice: int = 4
+    accelerator: str = "tpu-v5-lite-podslice"
+    topology: str = "4x4"
+    # libtpu DaemonSet pod lifecycle (seconds, virtual)
+    pod_recreate_delay: float = 15.0
+    pod_ready_delay: float = 45.0
+    # Real GKE node names carry random VM suffixes, so list order is
+    # uncorrelated with slice membership; a seeded shuffle models that.
+    # (Without it, slice-contiguous creation order would hand the flat
+    # planner whole slices by accident and mask the topology benefit.)
+    shuffle_seed: Optional[int] = 1234
+
+
+@dataclass
+class SimResult:
+    converged: bool
+    total_seconds: float
+    drain_to_ready_seconds: list[float] = field(default_factory=list)
+    availability_integral: float = 0.0  # ∫ availability dt / total
+    reconciles: int = 0
+
+    @property
+    def drain_to_ready_p50(self) -> Optional[float]:
+        if not self.drain_to_ready_seconds:
+            return None
+        return statistics.median(self.drain_to_ready_seconds)
+
+    @property
+    def slice_availability_pct(self) -> float:
+        return 100.0 * self.availability_integral
+
+
+def build_fleet(spec: FleetSpec) -> tuple[FakeCluster, FakeClock, UpgradeKeys]:
+    clock = FakeClock(start=0.0)
+    cluster = FakeCluster(clock=clock)
+    cluster.enable_ds_controller(recreate_delay=spec.pod_recreate_delay,
+                                 ready_delay=spec.pod_ready_delay)
+    keys = UpgradeKeys()
+    total = spec.n_slices * spec.hosts_per_slice
+    ds = DaemonSet(
+        metadata=ObjectMeta(name="libtpu", namespace=NS,
+                            labels=dict(RUNTIME_LABELS)),
+        spec=DaemonSetSpec(selector=dict(RUNTIME_LABELS)),
+        status=DaemonSetStatus(desired_number_scheduled=total))
+    cluster.add_daemon_set(ds, revision_hash="old")
+    members = [(s, h) for s in range(spec.n_slices)
+               for h in range(spec.hosts_per_slice)]
+    if spec.shuffle_seed is not None:
+        random.Random(spec.shuffle_seed).shuffle(members)
+    for s, h in members:
+        name = f"s{s}-h{h}"
+        cluster.add_node(Node(metadata=ObjectMeta(name=name, labels={
+            GKE_NODEPOOL_LABEL: f"pool-{s}",
+            GKE_TPU_ACCELERATOR_LABEL: spec.accelerator,
+            GKE_TPU_TOPOLOGY_LABEL: spec.topology,
+            "google.com/tpu": "true",
+        })))
+        cluster.add_pod(Pod(
+            metadata=ObjectMeta(
+                name=f"libtpu-{name}", namespace=NS,
+                labels={**RUNTIME_LABELS,
+                        POD_CONTROLLER_REVISION_HASH_LABEL: "old"},
+                owner_references=[OwnerReference(
+                    kind="DaemonSet", name="libtpu",
+                    uid=ds.metadata.uid)]),
+            spec=PodSpec(node_name=name),
+            status=PodStatus(
+                phase=PodPhase.RUNNING,
+                container_statuses=[
+                    ContainerStatus(name="libtpu", ready=True)])))
+    # roll the DS template: every pod is now out of date
+    cluster.bump_daemon_set_revision(NS, "libtpu", "new")
+    return cluster, clock, keys
+
+
+def simulate_rolling_upgrade(
+        topology_mode: str = "slice",
+        fleet: Optional[FleetSpec] = None,
+        max_unavailable="25%",
+        max_parallel_upgrades: int = 0,
+        reconcile_interval: float = 10.0,
+        max_sim_seconds: float = 24 * 3600.0) -> SimResult:
+    """Run one full rolling upgrade and measure it."""
+    fleet = fleet or FleetSpec()
+    cluster, clock, keys = build_fleet(fleet)
+    mgr = ClusterUpgradeStateManager(
+        cluster, keys, async_workers=False, poll_interval=0.0)
+    policy = UpgradePolicySpec(
+        auto_upgrade=True,
+        max_parallel_upgrades=max_parallel_upgrades,
+        max_unavailable=max_unavailable,
+        topology_mode=topology_mode,
+        drain=DrainSpec(enable=True, force=True, timeout_seconds=300))
+
+    down_since: dict[str, float] = {}
+    drain_to_ready: list[float] = []
+    availability_weighted = 0.0
+    reconciles = 0
+    converged = False
+
+    def sample_availability() -> float:
+        topo = SliceTopology.from_nodes(cluster.list_nodes())
+        return topo.availability()
+
+    from tpu_operator_libs.upgrade.state_manager import BuildStateError
+
+    while clock.now() < max_sim_seconds:
+        try:
+            state = mgr.build_state(NS, RUNTIME_LABELS)
+            mgr.apply_state(state, policy)
+        except BuildStateError:
+            # A restarted runtime pod is between deletion and recreation;
+            # the snapshot is incomplete. Like the reference
+            # (upgrade_state.go:243-246), the reconciler simply retries.
+            pass
+        reconciles += 1
+
+        now = clock.now()
+        for node in cluster.list_nodes():
+            name = node.metadata.name
+            label = node.metadata.labels.get(keys.state_label, "")
+            if node.is_unschedulable() and name not in down_since:
+                down_since[name] = now
+            elif (name in down_since and not node.is_unschedulable()
+                  and label == str(UpgradeState.DONE)):
+                drain_to_ready.append(now - down_since.pop(name))
+
+        availability_weighted += sample_availability() * reconcile_interval
+
+        labels = [n.metadata.labels.get(keys.state_label, "")
+                  for n in cluster.list_nodes()]
+        if all(lb == str(UpgradeState.DONE) for lb in labels):
+            converged = True
+            break
+
+        clock.advance(reconcile_interval)
+        cluster.step()
+
+    total = max(clock.now(), reconcile_interval)
+    return SimResult(
+        converged=converged,
+        total_seconds=total,
+        drain_to_ready_seconds=drain_to_ready,
+        availability_integral=availability_weighted / total,
+        reconciles=reconciles)

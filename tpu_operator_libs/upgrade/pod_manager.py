@@ -46,9 +46,16 @@ from tpu_operator_libs.util import (
 logger = logging.getLogger(__name__)
 
 #: Decides whether a workload pod must be deleted before the runtime upgrade
-#: (reference PodDeletionFilter, pod_manager.go:76). This is the seam the
-#: checkpoint-durability gate plugs into (tpu_operator_libs.health).
+#: (reference PodDeletionFilter, pod_manager.go:76).
 PodDeletionFilter = Callable[[Pod], bool]
+
+#: Eviction-time veto: called with (node, pods_to_delete) right before
+#: eviction; returning False leaves the node parked in
+#: pod-deletion-required for the next reconcile. Unlike the deletion
+#: *filter* (which silently skips pods), a closed gate blocks progress —
+#: the hook the Orbax checkpoint-durability gate plugs into
+#: (tpu_operator_libs.health.checkpoint_gate; BASELINE config #4).
+EvictionGate = Callable[[Node, list[Pod]], bool]
 
 
 @dataclass
@@ -71,14 +78,17 @@ class PodManager:
                  deletion_filter: Optional[PodDeletionFilter] = None,
                  recorder: Optional[EventRecorder] = None,
                  clock: Optional[Clock] = None,
-                 worker: Optional[Worker] = None) -> None:
+                 worker: Optional[Worker] = None,
+                 eviction_gate: Optional[EvictionGate] = None) -> None:
         self._client = client
         self._provider = provider
         self._deletion_filter = deletion_filter
+        self._eviction_gate = eviction_gate
         self._recorder = recorder
         self._clock = clock or Clock()
         self._worker = worker or Worker()
         self._nodes_in_progress = NameSet()
+        self._deferred_nodes = NameSet()
         self._keys = provider.keys
 
     @property
@@ -160,22 +170,55 @@ class PodManager:
             self._worker.submit(
                 lambda n=node: self._evict_node_pods(n, helper, config))
 
+    def _gate_open(self, node: Node, pods: list[Pod]) -> bool:
+        """Evaluate the eviction gate. A raising gate counts as CLOSED —
+        never as a deletion failure — so a transient gate error can only
+        delay eviction, not escalate to drain/failed and bypass the
+        durability guarantee."""
+        if self._eviction_gate is None:
+            return True
+        try:
+            open_ = bool(self._eviction_gate(node, pods))
+        except Exception as exc:  # noqa: BLE001 — gate boundary
+            logger.warning("eviction gate raised for node %s (treating as "
+                           "closed): %s", node.metadata.name, exc)
+            return False
+        return open_
+
+    def _note_deferred(self, node: Node) -> None:
+        """Emit the deferral event only when a node first parks, not on
+        every reconcile pass while the gate stays closed."""
+        if self._deferred_nodes.add(node.metadata.name):
+            log_event(self._recorder, node, Event.NORMAL,
+                      self._keys.event_reason,
+                      "Eviction deferred: checkpoint/eviction gate not "
+                      "yet open")
+
     def _evict_node_pods(self, node: Node, helper: DrainHelper,
                          config: PodManagerConfig) -> None:
         name = node.metadata.name
         try:
             pods = self._client.list_pods(
                 namespace=None, field_selector=f"spec.nodeName={name}")
-            num_to_delete = sum(
-                1 for p in pods if self._deletion_filter(p))
-            if num_to_delete == 0:
+            to_delete = [p for p in pods if self._deletion_filter(p)]
+            if not to_delete:
                 logger.info("no pods require deletion on node %s", name)
                 self._change_state_quietly(
                     node, UpgradeState.POD_RESTART_REQUIRED)
                 return
 
+            # Gate check comes FIRST: while the workload's checkpoint is
+            # not durable the node must park in pod-deletion-required — no
+            # path below (including the drain fallback) may run.
+            if not self._gate_open(node, to_delete):
+                logger.info("eviction gate closed for node %s; deferring "
+                            "pod deletion", name)
+                self._note_deferred(node)
+                return
+            self._deferred_nodes.remove(name)
+
             deletable, errors = helper.get_pods_for_deletion(name)
-            if len(deletable) != num_to_delete:
+            if len(deletable) != len(to_delete):
                 logger.error("cannot delete all required pods on %s: %s",
                              name, errors)
                 self._update_node_to_drain_or_failed(

@@ -175,24 +175,49 @@ class ClusterUpgradeStateManager:
             client, self.provider, "", recorder, self.clock)
         self.safe_load_manager = safe_load_manager or SafeRuntimeLoadManager(
             self.provider)
-        self.planner: UpgradePlanner = planner or FlatPlanner()
+        # Explicit planner wins; otherwise policy.topology_mode selects
+        # flat (reference parity) or slice-atomic planning per apply_state.
+        self._explicit_planner = planner
 
         self._pod_deletion_enabled = False
         self._validation_enabled = False
+
+    @property
+    def planner(self) -> UpgradePlanner:
+        """The explicitly-set planner, or the flat default. Assigning here
+        overrides policy-driven selection permanently."""
+        return self._explicit_planner or FlatPlanner()
+
+    @planner.setter
+    def planner(self, value: Optional[UpgradePlanner]) -> None:
+        self._explicit_planner = value
 
     # ------------------------------------------------------------------
     # options (upgrade_state.go:155-186)
     # ------------------------------------------------------------------
     def with_pod_deletion_enabled(
-            self, deletion_filter: PodDeletionFilter
+            self, deletion_filter: PodDeletionFilter,
+            eviction_gate=None,
     ) -> "ClusterUpgradeStateManager":
         if deletion_filter is None:
             logger.warning("cannot enable pod deletion: filter is None")
             return self
         self.pod_manager = PodManager(
             self.client, self.provider, deletion_filter, self.recorder,
-            self.clock, Worker(async_mode=self._async_workers))
+            self.clock, Worker(async_mode=self._async_workers),
+            eviction_gate=eviction_gate)
+        if eviction_gate is not None:
+            # The drain fallback must honor the same gate, or a failed
+            # pod deletion would evict the workload anyway.
+            self.drain_manager.set_eviction_gate(eviction_gate)
         self._pod_deletion_enabled = True
+        return self
+
+    def with_eviction_gate(self, gate) -> "ClusterUpgradeStateManager":
+        """Install an eviction gate on both the pod-deletion and drain
+        paths without enabling the pod-deletion state."""
+        self.pod_manager._eviction_gate = gate
+        self.drain_manager.set_eviction_gate(gate)
         return self
 
     def with_validation_enabled(
@@ -287,7 +312,9 @@ class ClusterUpgradeStateManager:
 
         self.process_done_or_unknown_nodes(state, UpgradeState.UNKNOWN)
         self.process_done_or_unknown_nodes(state, UpgradeState.DONE)
-        self.process_upgrade_required_nodes(state, upgrades_available)
+        self.process_upgrade_required_nodes(
+            state, upgrades_available,
+            planner=self._planner_for_policy(policy))
         self.process_cordon_required_nodes(state)
         self.process_wait_for_jobs_required_nodes(
             state, policy.wait_for_completion)
@@ -329,10 +356,26 @@ class ClusterUpgradeStateManager:
                 self.provider.change_node_upgrade_state(
                     ns.node, UpgradeState.DONE)
 
-    def process_upgrade_required_nodes(self, state: ClusterUpgradeState,
-                                       upgrades_available: int) -> None:
+    def _planner_for_policy(
+            self, policy: UpgradePolicySpec) -> UpgradePlanner:
+        if self._explicit_planner is not None:
+            return self._explicit_planner
+        if policy.topology_mode == "slice":
+            from tpu_operator_libs.topology.planner import SlicePlanner
+            return SlicePlanner()
+        return FlatPlanner()
+
+    def process_upgrade_required_nodes(
+            self, state: ClusterUpgradeState, upgrades_available: int,
+            planner: Optional[UpgradePlanner] = None) -> None:
         """Start upgrades for as many nodes as the throttle allows
-        (upgrade_state.go:587-631), selection delegated to the planner."""
+        (upgrade_state.go:587-631), selection delegated to the planner.
+
+        ``apply_state`` resolves the planner from the policy's
+        topology_mode; direct callers get the explicit planner (or flat)
+        unless they pass one.
+        """
+        planner = planner or self.planner
         candidates = []
         for ns in state.bucket(UpgradeState.UPGRADE_REQUIRED):
             if self._is_upgrade_requested(ns.node):
@@ -344,7 +387,7 @@ class ClusterUpgradeStateManager:
                             ns.node.metadata.name)
                 continue
             candidates.append(ns)
-        for ns in self.planner.plan(candidates, upgrades_available, state):
+        for ns in planner.plan(candidates, upgrades_available, state):
             self.provider.change_node_upgrade_state(
                 ns.node, UpgradeState.CORDON_REQUIRED)
             logger.info("node %s waiting for cordon", ns.node.metadata.name)
