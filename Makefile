@@ -1,0 +1,39 @@
+# Build/test targets (parity with the reference Makefile:61-91, Python-flavored)
+
+PYTHON ?= python3
+PYTEST_FLAGS ?= -q
+
+.PHONY: all test test-fast lint cov bench graft-check clean
+
+all: lint test
+
+test:
+	$(PYTHON) -m pytest tests/ $(PYTEST_FLAGS)
+
+test-fast:
+	$(PYTHON) -m pytest tests/ $(PYTEST_FLAGS) -x
+
+# Byte-compile everything + pyflakes when available (the reference pins
+# golangci-lint; this image has no ruff/flake8 baked in, so lint degrades
+# gracefully to a compile check).
+lint:
+	$(PYTHON) -m compileall -q tpu_operator_libs tests bench.py __graft_entry__.py
+	@$(PYTHON) -c "import pyflakes" 2>/dev/null \
+		&& $(PYTHON) -m pyflakes tpu_operator_libs tests \
+		|| echo "pyflakes not installed; compile check only"
+
+cov:
+	@$(PYTHON) -c "import coverage" 2>/dev/null \
+		&& $(PYTHON) -m coverage run -m pytest tests/ -q \
+		&& $(PYTHON) -m coverage report --include='tpu_operator_libs/*' \
+		|| $(PYTHON) -m pytest tests/ $(PYTEST_FLAGS)
+
+bench:
+	$(PYTHON) bench.py
+
+graft-check:
+	$(PYTHON) __graft_entry__.py
+
+clean:
+	find . -name __pycache__ -type d -exec rm -rf {} + 2>/dev/null || true
+	rm -rf .pytest_cache .coverage

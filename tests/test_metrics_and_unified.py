@@ -1,0 +1,302 @@
+"""Metrics registry, mocked-seam state machine tests, unified GPU+TPU
+policy (BASELINE config #5), real-adapter gating, and a concurrent
+reconcile stress test (SURVEY.md §5 race-detection guidance)."""
+
+import threading
+
+import pytest
+
+from tpu_operator_libs.api.unified_policy import (
+    AcceleratorSpec,
+    MultiAcceleratorUpgradeManager,
+    UnifiedUpgradePolicySpec,
+)
+from tpu_operator_libs.api.upgrade_policy import (
+    DrainSpec,
+    PolicyValidationError,
+    UpgradePolicySpec,
+)
+from tpu_operator_libs.consts import UpgradeKeys, UpgradeState
+from tpu_operator_libs.metrics import MetricsRegistry, observe_cluster_state
+from tpu_operator_libs.upgrade.mocks import mock_managers
+from tpu_operator_libs.upgrade.state_manager import ClusterUpgradeStateManager
+
+from builders import DaemonSetBuilder, NodeBuilder, PodBuilder
+from helpers import make_env, make_state_manager
+
+NS = "tpu-system"
+RUNTIME_LABELS = {"app": "libtpu"}
+
+
+class TestMetricsRegistry:
+    def test_gauges_and_counters(self):
+        reg = MetricsRegistry()
+        reg.set_gauge("nodes_total", 4, "help", {"driver": "libtpu"})
+        reg.inc_counter("reconciles_total", labels={"driver": "libtpu"})
+        reg.inc_counter("reconciles_total", labels={"driver": "libtpu"})
+        assert reg.get("nodes_total", {"driver": "libtpu"}) == 4
+        assert reg.get("reconciles_total", {"driver": "libtpu"}) == 2
+        assert reg.get("missing") is None
+
+    def test_prometheus_rendering(self):
+        reg = MetricsRegistry()
+        reg.set_gauge("nodes_total", 4, "Nodes managed", {"driver": "libtpu"})
+        text = reg.render_prometheus()
+        assert "# HELP tpu_upgrade_nodes_total Nodes managed" in text
+        assert "# TYPE tpu_upgrade_nodes_total gauge" in text
+        assert 'tpu_upgrade_nodes_total{driver="libtpu"} 4' in text
+
+    def test_observe_cluster_state(self):
+        env = make_env()
+        ds = DaemonSetBuilder("libtpu").with_labels(dict(RUNTIME_LABELS)) \
+            .with_desired_scheduled(2).create(env.cluster)
+        for i, state in enumerate([UpgradeState.DONE,
+                                   UpgradeState.DRAIN_REQUIRED]):
+            node = NodeBuilder(f"n{i}").with_upgrade_state(
+                env.keys, state).create(env.cluster)
+            PodBuilder(f"p{i}").on_node(node).owned_by(ds) \
+                .with_revision_hash("rev1").create(env.cluster)
+        mgr = make_state_manager(env)
+        snapshot = mgr.build_state(NS, RUNTIME_LABELS)
+        reg = MetricsRegistry()
+        observe_cluster_state(reg, mgr, snapshot)
+        assert reg.get("nodes_total", {"driver": "libtpu"}) == 2
+        assert reg.get("upgrades_in_progress", {"driver": "libtpu"}) == 1
+        assert reg.get("nodes_in_state",
+                       {"driver": "libtpu", "state": "upgrade-done"}) == 1
+        assert reg.get("reconciles_total", {"driver": "libtpu"}) == 1
+
+
+class TestMockedStateMachine:
+    """Transition logic in isolation — every seam mocked
+    (upgrade_state_test.go pattern of swapping manager fields)."""
+
+    def _snapshot(self, keys, bucket, node_names, ds_hash="test-hash-12345",
+                  pod_hash="test-hash-12345"):
+        from tpu_operator_libs.k8s.objects import (
+            DaemonSet,
+            DaemonSetSpec,
+            ObjectMeta,
+            Pod,
+            PodSpec,
+        )
+        from tpu_operator_libs.upgrade.state_manager import (
+            ClusterUpgradeState,
+            NodeUpgradeState,
+        )
+
+        state = ClusterUpgradeState()
+        for name in node_names:
+            from tpu_operator_libs.k8s.objects import Node
+            node = Node(metadata=ObjectMeta(
+                name=name, labels={keys.state_label: str(bucket)}))
+            ds = DaemonSet(metadata=ObjectMeta(name="libtpu", namespace=NS),
+                           spec=DaemonSetSpec(selector=dict(RUNTIME_LABELS)))
+            pod = Pod(metadata=ObjectMeta(name=f"pod-{name}", namespace=NS),
+                      spec=PodSpec(node_name=name))
+            state.node_states.setdefault(str(bucket), []).append(
+                NodeUpgradeState(node=node, runtime_pod=pod,
+                                 runtime_daemon_set=ds))
+        return state
+
+    def test_cordon_flow_with_mocks(self):
+        keys = UpgradeKeys()
+        mocks = mock_managers(keys)
+        mgr = ClusterUpgradeStateManager(client=None, keys=keys, **mocks)
+        state = self._snapshot(keys, UpgradeState.CORDON_REQUIRED,
+                               ["a", "b"])
+        mgr.process_cordon_required_nodes(state)
+        assert [c.args[0] for c in
+                mocks["cordon_manager"].calls_to("cordon")] == ["a", "b"]
+        transitions = mocks["provider"].calls_to(
+            "change_node_upgrade_state")
+        assert all(c.args[1] == "wait-for-jobs-required"
+                   for c in transitions)
+
+    def test_out_of_sync_pod_scheduled_for_restart_with_mocks(self):
+        keys = UpgradeKeys()
+        mocks = mock_managers(keys)
+        mocks["pod_manager"].ds_hashes["libtpu"] = "new-hash"
+        mgr = ClusterUpgradeStateManager(client=None, keys=keys, **mocks)
+        state = self._snapshot(keys, UpgradeState.POD_RESTART_REQUIRED,
+                               ["a"])
+        mgr.process_pod_restart_nodes(state)
+        restarts = mocks["pod_manager"].calls_to("schedule_pods_restart")
+        assert restarts and restarts[0].args[0] == ("pod-a",)
+
+    def test_provider_error_aborts_pass(self):
+        keys = UpgradeKeys()
+        mocks = mock_managers(keys)
+        mocks["provider"].fail_next = RuntimeError("apiserver down")
+        mgr = ClusterUpgradeStateManager(client=None, keys=keys, **mocks)
+        state = self._snapshot(keys, UpgradeState.UNCORDON_REQUIRED, ["a"])
+        with pytest.raises(RuntimeError):
+            mgr.process_uncordon_required_nodes(state)
+
+
+class TestUnifiedPolicy:
+    def _unified(self):
+        return UnifiedUpgradePolicySpec.from_dict({
+            "accelerators": {
+                "tpu": {
+                    "driver": "libtpu", "domain": "google.com",
+                    "namespace": NS,
+                    "runtimeLabels": {"app": "libtpu"},
+                    "policy": {"autoUpgrade": True,
+                               "maxParallelUpgrades": 0,
+                               "maxUnavailable": None,
+                               "topologyMode": "slice",
+                               "drain": {"enable": True, "force": True}},
+                },
+                "gpu": {
+                    "driver": "gpu", "domain": "nvidia.com",
+                    "namespace": NS,
+                    "runtimeLabels": {"app": "nvidia-driver"},
+                    "policy": {"autoUpgrade": True,
+                               "maxParallelUpgrades": 0,
+                               "maxUnavailable": None,
+                               "drain": {"enable": True, "force": True}},
+                },
+            }})
+
+    def test_round_trip_and_validation(self):
+        unified = self._unified()
+        unified.validate()
+        restored = UnifiedUpgradePolicySpec.from_dict(unified.to_dict())
+        assert restored.accelerators["tpu"].driver == "libtpu"
+        assert restored.accelerators["tpu"].policy.topology_mode == "slice"
+
+    def test_duplicate_key_namespace_rejected(self):
+        unified = UnifiedUpgradePolicySpec(accelerators={
+            "a": AcceleratorSpec(name="a", driver="d", domain="x.com",
+                                 runtime_labels={"k": "v"}),
+            "b": AcceleratorSpec(name="b", driver="d", domain="x.com",
+                                 runtime_labels={"k": "v"}),
+        })
+        with pytest.raises(PolicyValidationError):
+            unified.validate()
+
+    def test_mixed_cluster_reconcile(self):
+        """GPU and TPU runtimes upgrade side by side in one cluster —
+        impossible in the reference's global-DriverName design."""
+        env = make_env()
+        env.cluster.enable_ds_controller(recreate_delay=2, ready_delay=4)
+        gpu_keys = UpgradeKeys(driver="gpu", domain="nvidia.com")
+
+        tpu_ds = DaemonSetBuilder("libtpu", namespace=NS).with_labels(
+            {"app": "libtpu"}).with_revision_hash("old") \
+            .with_desired_scheduled(2).create(env.cluster)
+        gpu_ds = DaemonSetBuilder("nvidia-driver", namespace=NS).with_labels(
+            {"app": "nvidia-driver"}).with_revision_hash("old") \
+            .with_desired_scheduled(2).create(env.cluster)
+        for i in range(2):
+            tn = NodeBuilder(f"tpu-n{i}").create(env.cluster)
+            PodBuilder(f"libtpu-{i}").on_node(tn).owned_by(tpu_ds) \
+                .with_revision_hash("old").create(env.cluster)
+            gn = NodeBuilder(f"gpu-n{i}").create(env.cluster)
+            PodBuilder(f"nvdrv-{i}").on_node(gn).owned_by(gpu_ds) \
+                .with_revision_hash("old").create(env.cluster)
+        env.cluster.bump_daemon_set_revision(NS, "libtpu", "new")
+        env.cluster.bump_daemon_set_revision(NS, "nvidia-driver", "new")
+
+        unified = self._unified()
+        multi = MultiAcceleratorUpgradeManager(
+            env.cluster, unified, async_workers=False,
+            clock=env.clock, poll_interval=0.01)
+
+        for _ in range(40):
+            results = multi.reconcile()
+            env.clock.advance(3)
+            env.cluster.step()
+            tpu_done = all(
+                env.cluster.get_node(f"tpu-n{i}").metadata.labels.get(
+                    env.keys.state_label) == "upgrade-done"
+                for i in range(2))
+            gpu_done = all(
+                env.cluster.get_node(f"gpu-n{i}").metadata.labels.get(
+                    gpu_keys.state_label) == "upgrade-done"
+                for i in range(2))
+            if tpu_done and gpu_done:
+                break
+        else:
+            raise AssertionError(f"mixed fleet did not converge: {results}")
+
+        # each runtime landed on its own new revision
+        for pod in env.cluster.list_pods(label_selector="app=libtpu"):
+            assert pod.metadata.labels["controller-revision-hash"] == "new"
+        for pod in env.cluster.list_pods(label_selector="app=nvidia-driver"):
+            assert pod.metadata.labels["controller-revision-hash"] == "new"
+        # and the two state machines never touched each other's labels
+        tpu_node_labels = env.cluster.get_node("tpu-n0").metadata.labels
+        assert gpu_keys.state_label not in tpu_node_labels
+
+
+class TestRealAdapterGating:
+    def test_import_error_is_clear(self):
+        pytest.importorskip  # only meaningful when kubernetes is absent
+        try:
+            import kubernetes  # noqa: F401
+            pytest.skip("kubernetes installed; gating not exercised")
+        except ImportError:
+            pass
+        from tpu_operator_libs.k8s.real import RealCluster
+        with pytest.raises(ImportError, match="kubernetes"):
+            RealCluster()
+
+
+class TestConcurrentReconciles:
+    def test_two_concurrent_apply_state_passes_converge(self):
+        """The reference allows one reconcile at a time but its workers are
+        detached goroutines; our invariants must hold even when two full
+        passes race (per-node KeyedLock + atomic NameSet dedup)."""
+        env = make_env()
+        env.cluster.enable_ds_controller(recreate_delay=0, ready_delay=0)
+        ds = DaemonSetBuilder("libtpu").with_labels(dict(RUNTIME_LABELS)) \
+            .with_desired_scheduled(8).with_revision_hash("old") \
+            .create(env.cluster)
+        for i in range(8):
+            node = NodeBuilder(f"n{i}").create(env.cluster)
+            PodBuilder(f"p{i}").on_node(node).owned_by(ds) \
+                .with_revision_hash("old").create(env.cluster)
+        env.cluster.bump_daemon_set_revision(NS, "libtpu", "new")
+
+        mgr = ClusterUpgradeStateManager(
+            env.cluster, env.keys, env.recorder, env.clock,
+            async_workers=True, poll_interval=0.001)
+        policy = UpgradePolicySpec(
+            auto_upgrade=True, max_parallel_upgrades=0, max_unavailable=None,
+            drain=DrainSpec(enable=True, force=True))
+
+        errors = []
+
+        def reconcile_loop():
+            from tpu_operator_libs.upgrade.state_manager import (
+                BuildStateError,
+            )
+            for _ in range(60):
+                try:
+                    state = mgr.build_state(NS, RUNTIME_LABELS)
+                    mgr.apply_state(state, policy)
+                except BuildStateError:
+                    pass
+                except Exception as exc:  # noqa: BLE001
+                    errors.append(exc)
+                    return
+                env.cluster.step()
+                done = all(
+                    n.metadata.labels.get(env.keys.state_label) ==
+                    "upgrade-done" for n in env.cluster.list_nodes())
+                if done:
+                    return
+
+        threads = [threading.Thread(target=reconcile_loop)
+                   for _ in range(2)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join(timeout=60)
+        mgr.join_workers()
+        assert not errors, errors
+        final = [n.metadata.labels.get(env.keys.state_label)
+                 for n in env.cluster.list_nodes()]
+        assert all(s == "upgrade-done" for s in final), final

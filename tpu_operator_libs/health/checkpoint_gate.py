@@ -48,10 +48,12 @@ def _step_of(name: str) -> Optional[int]:
     return int(m.group(1)) if m else None
 
 
-def _is_committed(path: str) -> bool:
-    """Committed = final name and, if any sibling uses commit markers, the
-    marker is present (Orbax writes the marker before rename on non-atomic
-    filesystems; on atomic ones the final name alone is the commit)."""
+def _is_committed(path: str, require_marker: bool) -> bool:
+    """Committed = final name, non-empty, and — when the checkpoint root
+    uses commit markers at all (GCS-style non-atomic filesystems, where
+    Orbax writes the step under its final name and the marker last) — the
+    marker itself. On atomic-rename filesystems the final name alone is
+    the commit."""
     if _is_tmp_dir(os.path.basename(path)):
         return False
     if not os.path.isdir(path):
@@ -61,8 +63,9 @@ def _is_committed(path: str) -> bool:
         return False
     if _COMMIT_MARKER in entries:
         return True
-    # No marker file: atomic-rename semantics — final name == committed,
-    # unless an explicit orbax "checkpoint in progress" sentinel exists.
+    if require_marker:
+        # Sibling steps carry markers, this one doesn't: still uploading.
+        return False
     return not any(e.endswith(".orbax-checkpoint-in-progress")
                    for e in entries)
 
@@ -73,15 +76,23 @@ def latest_committed_step(checkpoint_dir: str) -> Optional[int]:
         names = os.listdir(checkpoint_dir)
     except (FileNotFoundError, NotADirectoryError):
         return None
-    steps = []
+    step_dirs = []
+    uses_markers = False
     for name in names:
         if _is_tmp_dir(name):
             continue
         step = _step_of(name)
         if step is None:
             continue
-        if _is_committed(os.path.join(checkpoint_dir, name)):
-            steps.append(step)
+        path = os.path.join(checkpoint_dir, name)
+        step_dirs.append((step, path))
+        try:
+            if os.path.isdir(path) and _COMMIT_MARKER in os.listdir(path):
+                uses_markers = True
+        except OSError:
+            continue
+    steps = [step for step, path in step_dirs
+             if _is_committed(path, require_marker=uses_markers)]
     return max(steps, default=None)
 
 

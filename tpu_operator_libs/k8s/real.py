@@ -1,0 +1,235 @@
+"""Live-cluster adapter backed by the official ``kubernetes`` Python client.
+
+Fills the role of the reference's client-go/clientset pair
+(upgrade_state.go:127-132) for real GKE clusters. Import-gated: the
+``kubernetes`` package is an optional dependency — everything else in this
+library (tests, simulation, bench) runs without it, and constructing
+:class:`RealCluster` without the package raises a clear error.
+
+Mapping to API calls:
+
+- nodes: ``CoreV1Api.read_node`` / ``list_node`` / ``patch_node``
+  (merge-patch with ``None`` values deleting keys, the same semantics the
+  reference's raw patches rely on, node_upgrade_state_provider.go:147-151)
+- pods: ``list_pod_for_all_namespaces`` / ``list_namespaced_pod`` with
+  label+field selectors; ``delete_namespaced_pod``;
+  ``create_namespaced_pod_eviction`` for the eviction subresource
+- daemonsets/revisions: ``AppsV1Api.list_namespaced_daemon_set`` /
+  ``list_namespaced_controller_revision``
+"""
+
+from __future__ import annotations
+
+from typing import Mapping, Optional
+
+from tpu_operator_libs.k8s.client import (
+    EvictionBlockedError,
+    K8sClient,
+    NotFoundError,
+)
+from tpu_operator_libs.k8s.objects import (
+    ContainerStatus,
+    ControllerRevision,
+    DaemonSet,
+    DaemonSetSpec,
+    DaemonSetStatus,
+    Node,
+    NodeCondition,
+    NodeSpec,
+    NodeStatus,
+    ObjectMeta,
+    OwnerReference,
+    Pod,
+    PodPhase,
+    PodSpec,
+    PodStatus,
+    Volume,
+)
+
+
+def _require_kubernetes():
+    try:
+        import kubernetes  # noqa: F401
+        from kubernetes import client as k8s_client
+        return k8s_client
+    except ImportError as exc:  # pragma: no cover - exercised via test stub
+        raise ImportError(
+            "the 'kubernetes' package is required for RealCluster; "
+            "install it in the operator image (everything else in "
+            "tpu_operator_libs works without it)") from exc
+
+
+def _meta_from(obj) -> ObjectMeta:
+    meta = obj.metadata
+    owners = []
+    for ref in (getattr(meta, "owner_references", None) or []):
+        owners.append(OwnerReference(
+            kind=ref.kind, name=ref.name, uid=ref.uid,
+            controller=bool(getattr(ref, "controller", False))))
+    ts = getattr(meta, "deletion_timestamp", None)
+    return ObjectMeta(
+        name=meta.name,
+        namespace=meta.namespace or "",
+        uid=meta.uid or "",
+        labels=dict(meta.labels or {}),
+        annotations=dict(meta.annotations or {}),
+        owner_references=owners,
+        deletion_timestamp=ts.timestamp() if ts is not None else None)
+
+
+def _node_from(obj) -> Node:
+    conditions = [NodeCondition(type=c.type, status=c.status)
+                  for c in (obj.status.conditions or [])]
+    return Node(
+        metadata=_meta_from(obj),
+        spec=NodeSpec(unschedulable=bool(obj.spec.unschedulable)),
+        status=NodeStatus(conditions=conditions
+                          or [NodeCondition("Ready", "True")]))
+
+
+def _container_statuses(statuses) -> list[ContainerStatus]:
+    return [ContainerStatus(name=s.name, ready=bool(s.ready),
+                            restart_count=int(s.restart_count or 0))
+            for s in (statuses or [])]
+
+
+def _pod_from(obj) -> Pod:
+    volumes = []
+    for v in (obj.spec.volumes or []):
+        volumes.append(Volume(
+            name=v.name, empty_dir=getattr(v, "empty_dir", None) is not None))
+    phase = obj.status.phase or "Pending"
+    return Pod(
+        metadata=_meta_from(obj),
+        spec=PodSpec(node_name=obj.spec.node_name or "", volumes=volumes),
+        status=PodStatus(
+            phase=PodPhase(phase),
+            container_statuses=_container_statuses(
+                obj.status.container_statuses),
+            init_container_statuses=_container_statuses(
+                obj.status.init_container_statuses)))
+
+
+def _daemon_set_from(obj) -> DaemonSet:
+    selector = dict((obj.spec.selector.match_labels or {})
+                    if obj.spec.selector else {})
+    return DaemonSet(
+        metadata=_meta_from(obj),
+        spec=DaemonSetSpec(selector=selector),
+        status=DaemonSetStatus(
+            desired_number_scheduled=int(
+                obj.status.desired_number_scheduled or 0)))
+
+
+def _revision_from(obj) -> ControllerRevision:
+    return ControllerRevision(metadata=_meta_from(obj),
+                              revision=int(obj.revision))
+
+
+class RealCluster(K8sClient):
+    """K8sClient against a live API server."""
+
+    def __init__(self, api_client=None) -> None:
+        k8s = _require_kubernetes()
+        self._core = k8s.CoreV1Api(api_client)
+        self._apps = k8s.AppsV1Api(api_client)
+        self._k8s = k8s
+
+    @classmethod
+    def from_kubeconfig(cls, context: Optional[str] = None) -> "RealCluster":
+        _require_kubernetes()
+        from kubernetes import config
+
+        config.load_kube_config(context=context)
+        return cls()
+
+    @classmethod
+    def in_cluster(cls) -> "RealCluster":
+        _require_kubernetes()
+        from kubernetes import config
+
+        config.load_incluster_config()
+        return cls()
+
+    # -- error translation -------------------------------------------------
+    def _translate(self, exc):
+        status = getattr(exc, "status", None)
+        if status == 404:
+            return NotFoundError(str(exc))
+        if status == 429:
+            return EvictionBlockedError(str(exc))
+        return exc
+
+    # -- nodes -------------------------------------------------------------
+    def get_node(self, name: str) -> Node:
+        try:
+            return _node_from(self._core.read_node(name))
+        except self._k8s.ApiException as exc:
+            raise self._translate(exc) from exc
+
+    def list_nodes(self, label_selector: str = "") -> list[Node]:
+        result = self._core.list_node(label_selector=label_selector or None)
+        return [_node_from(item) for item in result.items]
+
+    def patch_node_labels(self, name: str,
+                          labels: Mapping[str, Optional[str]]) -> Node:
+        body = {"metadata": {"labels": dict(labels)}}
+        try:
+            return _node_from(self._core.patch_node(name, body))
+        except self._k8s.ApiException as exc:
+            raise self._translate(exc) from exc
+
+    def patch_node_annotations(self, name: str,
+                               annotations: Mapping[str, Optional[str]]) -> Node:
+        body = {"metadata": {"annotations": dict(annotations)}}
+        try:
+            return _node_from(self._core.patch_node(name, body))
+        except self._k8s.ApiException as exc:
+            raise self._translate(exc) from exc
+
+    def set_node_unschedulable(self, name: str, unschedulable: bool) -> Node:
+        body = {"spec": {"unschedulable": unschedulable}}
+        try:
+            return _node_from(self._core.patch_node(name, body))
+        except self._k8s.ApiException as exc:
+            raise self._translate(exc) from exc
+
+    # -- pods --------------------------------------------------------------
+    def list_pods(self, namespace: Optional[str] = None,
+                  label_selector: str = "",
+                  field_selector: str = "") -> list[Pod]:
+        kwargs = {"label_selector": label_selector or None,
+                  "field_selector": field_selector or None}
+        if namespace:
+            result = self._core.list_namespaced_pod(namespace, **kwargs)
+        else:
+            result = self._core.list_pod_for_all_namespaces(**kwargs)
+        return [_pod_from(item) for item in result.items]
+
+    def delete_pod(self, namespace: str, name: str) -> None:
+        try:
+            self._core.delete_namespaced_pod(name, namespace)
+        except self._k8s.ApiException as exc:
+            raise self._translate(exc) from exc
+
+    def evict_pod(self, namespace: str, name: str) -> None:
+        eviction = self._k8s.V1Eviction(
+            metadata=self._k8s.V1ObjectMeta(name=name, namespace=namespace))
+        try:
+            self._core.create_namespaced_pod_eviction(
+                name, namespace, eviction)
+        except self._k8s.ApiException as exc:
+            raise self._translate(exc) from exc
+
+    # -- daemonsets & revisions ---------------------------------------------
+    def list_daemon_sets(self, namespace: str,
+                         label_selector: str = "") -> list[DaemonSet]:
+        result = self._apps.list_namespaced_daemon_set(
+            namespace, label_selector=label_selector or None)
+        return [_daemon_set_from(item) for item in result.items]
+
+    def list_controller_revisions(self, namespace: str,
+                                  label_selector: str = "") -> list[ControllerRevision]:
+        result = self._apps.list_namespaced_controller_revision(
+            namespace, label_selector=label_selector or None)
+        return [_revision_from(item) for item in result.items]

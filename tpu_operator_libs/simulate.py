@@ -189,21 +189,26 @@ def simulate_rolling_upgrade(
                   and label == str(UpgradeState.DONE)):
                 drain_to_ready.append(now - down_since.pop(name))
 
-        availability_weighted += sample_availability() * reconcile_interval
-
         labels = [n.metadata.labels.get(keys.state_label, "")
                   for n in cluster.list_nodes()]
         if all(lb == str(UpgradeState.DONE) for lb in labels):
+            # Converged: no further virtual time elapses, so this pass
+            # contributes no interval to the availability integral.
             converged = True
             break
 
+        # The sampled availability holds for the upcoming interval
+        # [now, now + reconcile_interval); weight and advance together so
+        # the integral normalizes by exactly the elapsed virtual time.
+        availability_weighted += sample_availability() * reconcile_interval
         clock.advance(reconcile_interval)
         cluster.step()
 
-    total = max(clock.now(), reconcile_interval)
+    total = clock.now()
     return SimResult(
         converged=converged,
         total_seconds=total,
         drain_to_ready_seconds=drain_to_ready,
-        availability_integral=availability_weighted / total,
+        availability_integral=(availability_weighted / total
+                               if total > 0 else 1.0),
         reconciles=reconciles)

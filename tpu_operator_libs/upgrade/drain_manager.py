@@ -52,16 +52,22 @@ class DrainManager:
         self._clock = clock or Clock()
         self._worker = worker or Worker()
         self._draining_nodes = NameSet()
-        self._deferred_nodes = NameSet()
         # Same veto as PodManager's eviction_gate: drain must not destroy
         # a workload whose checkpoint is not yet durable — otherwise the
         # pod-deletion→drain fallback would bypass the durability
-        # guarantee entirely.
-        self._eviction_gate = eviction_gate
+        # guarantee entirely. Shared semantics via GateKeeper.
+        from tpu_operator_libs.upgrade.gate import GateKeeper
+
+        self._gatekeeper = GateKeeper(provider.keys, recorder, "drain")
+        self._gatekeeper.set_gate(eviction_gate)
         self._keys = provider.keys
 
+    @property
+    def eviction_gate(self):
+        return self._gatekeeper.gate
+
     def set_eviction_gate(self, gate) -> None:
-        self._eviction_gate = gate
+        self._gatekeeper.set_gate(gate)
 
     def schedule_nodes_drain(self, config: DrainConfiguration) -> None:
         """Schedule an async drain per node (drain_manager.go:58-138)."""
@@ -102,26 +108,17 @@ class DrainManager:
     def _drain_node(self, node: Node, helper: DrainHelper) -> None:
         name = node.metadata.name
         try:
-            if self._eviction_gate is not None:
+            if self._gatekeeper.gate is not None:
                 try:
                     pods, _ = helper.get_pods_for_deletion(name)
-                    gate_open = bool(self._eviction_gate(node, pods))
-                except Exception as exc:  # noqa: BLE001 — gate boundary
-                    logger.warning("eviction gate raised for node %s "
-                                   "(treating as closed): %s", name, exc)
-                    gate_open = False
-                if not gate_open:
-                    # Park in drain-required until the gate opens; a
-                    # raising gate only delays, never escalates.
-                    logger.info("eviction gate closed for node %s; "
-                                "deferring drain", name)
-                    if self._deferred_nodes.add(name):
-                        log_event(self._recorder, node, Event.NORMAL,
-                                  self._keys.event_reason,
-                                  "Drain deferred: checkpoint/eviction "
-                                  "gate not yet open")
+                except Exception as exc:  # noqa: BLE001 — worker boundary
+                    logger.warning("could not enumerate pods for gate on "
+                                   "node %s: %s", name, exc)
+                    pods = []
+                # Park in drain-required until the gate opens; a raising
+                # gate only delays, never escalates (GateKeeper semantics).
+                if not self._gatekeeper.allows(node, pods):
                     return
-                self._deferred_nodes.remove(name)
             try:
                 run_cordon_or_uncordon(self._client, name, True)
             except Exception as exc:  # noqa: BLE001 — worker boundary

@@ -55,7 +55,8 @@ PodDeletionFilter = Callable[[Pod], bool]
 #: *filter* (which silently skips pods), a closed gate blocks progress —
 #: the hook the Orbax checkpoint-durability gate plugs into
 #: (tpu_operator_libs.health.checkpoint_gate; BASELINE config #4).
-EvictionGate = Callable[[Node, list[Pod]], bool]
+#: Shared semantics live in tpu_operator_libs.upgrade.gate.GateKeeper.
+from tpu_operator_libs.upgrade.gate import EvictionGate, GateKeeper  # noqa: E402,F401
 
 
 @dataclass
@@ -83,17 +84,25 @@ class PodManager:
         self._client = client
         self._provider = provider
         self._deletion_filter = deletion_filter
-        self._eviction_gate = eviction_gate
+        self._gatekeeper = GateKeeper(provider.keys, recorder,
+                                      "pod deletion")
+        self._gatekeeper.set_gate(eviction_gate)
         self._recorder = recorder
         self._clock = clock or Clock()
         self._worker = worker or Worker()
         self._nodes_in_progress = NameSet()
-        self._deferred_nodes = NameSet()
         self._keys = provider.keys
 
     @property
     def deletion_filter(self) -> Optional[PodDeletionFilter]:
         return self._deletion_filter
+
+    @property
+    def eviction_gate(self) -> Optional[EvictionGate]:
+        return self._gatekeeper.gate
+
+    def set_eviction_gate(self, gate: Optional[EvictionGate]) -> None:
+        self._gatekeeper.set_gate(gate)
 
     # ------------------------------------------------------------------
     # (d) revision oracle
@@ -170,30 +179,6 @@ class PodManager:
             self._worker.submit(
                 lambda n=node: self._evict_node_pods(n, helper, config))
 
-    def _gate_open(self, node: Node, pods: list[Pod]) -> bool:
-        """Evaluate the eviction gate. A raising gate counts as CLOSED —
-        never as a deletion failure — so a transient gate error can only
-        delay eviction, not escalate to drain/failed and bypass the
-        durability guarantee."""
-        if self._eviction_gate is None:
-            return True
-        try:
-            open_ = bool(self._eviction_gate(node, pods))
-        except Exception as exc:  # noqa: BLE001 — gate boundary
-            logger.warning("eviction gate raised for node %s (treating as "
-                           "closed): %s", node.metadata.name, exc)
-            return False
-        return open_
-
-    def _note_deferred(self, node: Node) -> None:
-        """Emit the deferral event only when a node first parks, not on
-        every reconcile pass while the gate stays closed."""
-        if self._deferred_nodes.add(node.metadata.name):
-            log_event(self._recorder, node, Event.NORMAL,
-                      self._keys.event_reason,
-                      "Eviction deferred: checkpoint/eviction gate not "
-                      "yet open")
-
     def _evict_node_pods(self, node: Node, helper: DrainHelper,
                          config: PodManagerConfig) -> None:
         name = node.metadata.name
@@ -210,12 +195,8 @@ class PodManager:
             # Gate check comes FIRST: while the workload's checkpoint is
             # not durable the node must park in pod-deletion-required — no
             # path below (including the drain fallback) may run.
-            if not self._gate_open(node, to_delete):
-                logger.info("eviction gate closed for node %s; deferring "
-                            "pod deletion", name)
-                self._note_deferred(node)
+            if not self._gatekeeper.allows(node, to_delete):
                 return
-            self._deferred_nodes.remove(name)
 
             deletable, errors = helper.get_pods_for_deletion(name)
             if len(deletable) != len(to_delete):
@@ -276,10 +257,16 @@ class PodManager:
         if not pods:
             logger.info("no pods scheduled to restart")
             return
+        from tpu_operator_libs.k8s.client import NotFoundError
+
         for pod in pods:
             logger.info("deleting pod %s", pod.name)
             try:
                 self._client.delete_pod(pod.namespace, pod.name)
+            except NotFoundError:
+                # Already gone (e.g. a concurrent reconcile won the race):
+                # the restart goal is achieved — idempotent by design.
+                logger.info("pod %s already deleted", pod.name)
             except Exception as exc:
                 log_event(self._recorder, pod, Event.WARNING,
                           self._keys.event_reason,

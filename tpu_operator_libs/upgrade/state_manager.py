@@ -202,6 +202,10 @@ class ClusterUpgradeStateManager:
         if deletion_filter is None:
             logger.warning("cannot enable pod deletion: filter is None")
             return self
+        if eviction_gate is None:
+            # Preserve a gate installed earlier via with_eviction_gate —
+            # rebuilding the PodManager must not drop it.
+            eviction_gate = self.pod_manager.eviction_gate
         self.pod_manager = PodManager(
             self.client, self.provider, deletion_filter, self.recorder,
             self.clock, Worker(async_mode=self._async_workers),
@@ -216,7 +220,7 @@ class ClusterUpgradeStateManager:
     def with_eviction_gate(self, gate) -> "ClusterUpgradeStateManager":
         """Install an eviction gate on both the pod-deletion and drain
         paths without enabling the pod-deletion state."""
-        self.pod_manager._eviction_gate = gate
+        self.pod_manager.set_eviction_gate(gate)
         self.drain_manager.set_eviction_gate(gate)
         return self
 
