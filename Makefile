@@ -17,10 +17,12 @@ test-fast:
 # golangci-lint; this image has no ruff/flake8 baked in, so lint degrades
 # gracefully to a compile check).
 lint:
-	$(PYTHON) -m compileall -q tpu_operator_libs tests bench.py __graft_entry__.py
-	@$(PYTHON) -c "import pyflakes" 2>/dev/null \
-		&& $(PYTHON) -m pyflakes tpu_operator_libs tests \
-		|| echo "pyflakes not installed; compile check only"
+	$(PYTHON) -m compileall -q tpu_operator_libs tests examples bench.py __graft_entry__.py
+	@if $(PYTHON) -c "import pyflakes" 2>/dev/null; then \
+		$(PYTHON) -m pyflakes tpu_operator_libs tests examples; \
+	else \
+		echo "pyflakes not installed; compile check only"; \
+	fi
 
 cov:
 	@$(PYTHON) -c "import coverage" 2>/dev/null \

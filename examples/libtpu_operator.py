@@ -1,0 +1,246 @@
+#!/usr/bin/env python3
+"""Example consumer operator (the reference's out-of-tree L5 layer).
+
+The reference library has no main(); GPU-Operator-style controllers import
+it and call SetDriverName → NewClusterUpgradeStateManager → BuildState →
+ApplyState per reconcile (SURVEY.md §3.1). This example is that consumer
+for libtpu on GKE, runnable two ways:
+
+    # against a live cluster (requires the `kubernetes` package):
+    python examples/libtpu_operator.py --kubeconfig --policy policy.yaml
+
+    # demo: simulated 4-slice fleet with a rolling libtpu upgrade
+    python examples/libtpu_operator.py --demo
+
+It wires everything this library offers: topology-aware planning, the
+Orbax checkpoint eviction gate, the ICI fabric validator, Prometheus
+metrics on --metrics-port, and a reconcile loop that treats every error as
+retryable (the state machine is stateless/idempotent by design).
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import logging
+import signal
+import sys
+import threading
+import time
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+
+sys.path.insert(0, ".")  # repo-root execution
+
+from tpu_operator_libs.api.upgrade_policy import UpgradePolicySpec
+from tpu_operator_libs.consts import UpgradeKeys
+from tpu_operator_libs.metrics import MetricsRegistry, observe_cluster_state
+from tpu_operator_libs.upgrade.state_manager import (
+    BuildStateError,
+    ClusterUpgradeStateManager,
+)
+
+logger = logging.getLogger("libtpu-operator")
+
+
+def load_policy(path: str | None) -> UpgradePolicySpec:
+    if path is None:
+        return UpgradePolicySpec(
+            auto_upgrade=True, max_parallel_upgrades=0,
+            max_unavailable="25%", topology_mode="slice")
+    with open(path) as f:
+        text = f.read()
+    try:
+        data = json.loads(text)
+    except json.JSONDecodeError:
+        import yaml
+
+        data = yaml.safe_load(text)
+    spec = UpgradePolicySpec.from_dict(data.get("upgradePolicy", data))
+    spec.validate()
+    return spec
+
+
+def serve_metrics(registry: MetricsRegistry, port: int) -> ThreadingHTTPServer:
+    class Handler(BaseHTTPRequestHandler):
+        def do_GET(self):  # noqa: N802 - stdlib API
+            if self.path != "/metrics":
+                self.send_response(404)
+                self.end_headers()
+                return
+            body = registry.render_prometheus().encode()
+            self.send_response(200)
+            self.send_header("Content-Type",
+                             "text/plain; version=0.0.4")
+            self.end_headers()
+            self.wfile.write(body)
+
+        def log_message(self, *args):  # quiet
+            pass
+
+    server = ThreadingHTTPServer(("", port), Handler)
+    threading.Thread(target=server.serve_forever, daemon=True).start()
+    logger.info("metrics on :%d/metrics", port)
+    return server
+
+
+def build_manager(args, cluster, clock=None) -> ClusterUpgradeStateManager:
+    keys = UpgradeKeys(driver=args.driver, domain=args.domain)
+    mgr = ClusterUpgradeStateManager(cluster, keys, clock=clock)
+    if args.job_selector:
+        gate = None
+        if args.checkpoint_dir:
+            from tpu_operator_libs.health.checkpoint_gate import (
+                CheckpointDurabilityGate,
+            )
+
+            gate = CheckpointDurabilityGate(
+                args.checkpoint_dir,
+                max_age_seconds=args.checkpoint_max_age)
+        selector = args.job_selector
+
+        def deletion_filter(pod, _selector=selector):
+            from tpu_operator_libs.k8s.selectors import matches_labels
+
+            return matches_labels(_selector, pod.metadata.labels)
+
+        mgr.with_pod_deletion_enabled(deletion_filter, eviction_gate=gate)
+    if args.validator_selector or args.ici_probe:
+        extra = None
+        if args.ici_probe:
+            from tpu_operator_libs.health.ici_probe import ICIFabricValidator
+
+            extra = ICIFabricValidator()
+        mgr.with_validation_enabled(args.validator_selector or "",
+                                    extra_validator=extra)
+    return mgr
+
+
+def reconcile_forever(mgr, args, policy, registry, stop: threading.Event,
+                      step_hook=None) -> None:
+    runtime_labels = dict(kv.split("=", 1)
+                          for kv in args.runtime_labels.split(","))
+    while not stop.is_set():
+        started = time.monotonic()
+        try:
+            state = mgr.build_state(args.namespace, runtime_labels)
+            mgr.apply_state(state, policy)
+            observe_cluster_state(registry, mgr, state, driver=args.driver)
+            done = mgr.get_upgrades_done(state)
+            total = mgr.get_total_managed_nodes(state)
+            logger.info("reconciled: %d/%d done, %d in progress, %d failed",
+                        done, total, mgr.get_upgrades_in_progress(state),
+                        mgr.get_upgrades_failed(state))
+        except BuildStateError as exc:
+            logger.info("snapshot incomplete (%s); retrying", exc)
+        except Exception:
+            logger.exception("reconcile failed; retrying")
+        registry.set_gauge("reconcile_duration_seconds",
+                           time.monotonic() - started,
+                           "Duration of the last reconcile pass",
+                           {"driver": args.driver})
+        if step_hook is not None:
+            if step_hook():
+                return
+        stop.wait(args.interval)
+
+
+def run_demo(args, registry) -> int:
+    """Simulated fleet: watch a full slice-atomic rolling upgrade."""
+    from tpu_operator_libs.simulate import (
+        NS,
+        RUNTIME_LABELS,
+        FleetSpec,
+        build_fleet,
+    )
+
+    fleet = FleetSpec(n_slices=args.demo_slices, hosts_per_slice=4)
+    cluster, clock, keys = build_fleet(fleet)
+    args.namespace = NS
+    args.runtime_labels = ",".join(f"{k}={v}"
+                                   for k, v in RUNTIME_LABELS.items())
+    mgr = build_manager(args, cluster, clock=clock)
+    mgr.provider._poll_interval = 0.0
+    policy = load_policy(args.policy)
+    stop = threading.Event()
+
+    virtual_interval = args.interval  # simulated seconds between passes
+    deadline = time.monotonic() + 120  # real-time safety stop
+
+    def step_hook() -> bool:
+        clock.advance(virtual_interval)
+        cluster.step()
+        labels = [n.metadata.labels.get(keys.state_label, "")
+                  for n in cluster.list_nodes()]
+        if all(lb == "upgrade-done" for lb in labels):
+            logger.info("demo complete: all %d nodes upgraded in %.0fs "
+                        "simulated", len(labels), clock.now())
+            print(registry.render_prometheus())
+            stop.set()
+            return True
+        if time.monotonic() > deadline:
+            logger.error("demo did not converge within the safety window")
+            stop.set()
+            return True
+        return False
+
+    args.interval = 0.0  # no real-time sleep between simulated passes
+    reconcile_forever(mgr, args, policy, registry, stop, step_hook)
+    return 0
+
+
+def main() -> int:
+    parser = argparse.ArgumentParser(description=__doc__.split("\n")[0])
+    parser.add_argument("--namespace", default="tpu-system")
+    parser.add_argument("--runtime-labels", default="app=libtpu",
+                        help="k=v[,k=v] selecting the runtime DaemonSet")
+    parser.add_argument("--driver", default="libtpu")
+    parser.add_argument("--domain", default="google.com")
+    parser.add_argument("--policy", help="policy YAML/JSON file")
+    parser.add_argument("--interval", type=float, default=30.0)
+    parser.add_argument("--metrics-port", type=int, default=0,
+                        help="serve /metrics on this port (0 = off)")
+    parser.add_argument("--job-selector", default="",
+                        help="label selector for workload pods to delete")
+    parser.add_argument("--checkpoint-dir", default="",
+                        help="Orbax checkpoint root gating eviction")
+    parser.add_argument("--checkpoint-max-age", type=float, default=0.0)
+    parser.add_argument("--validator-selector", default="",
+                        help="label selector for validation pods")
+    parser.add_argument("--ici-probe", action="store_true",
+                        help="gate validation on the local ICI fabric probe")
+    parser.add_argument("--kubeconfig", action="store_true",
+                        help="connect via local kubeconfig (else in-cluster)")
+    parser.add_argument("--demo", action="store_true",
+                        help="run against a simulated fleet")
+    parser.add_argument("--demo-slices", type=int, default=4)
+    args = parser.parse_args()
+
+    logging.basicConfig(
+        level=logging.INFO,
+        format="%(asctime)s %(name)s %(levelname)s %(message)s")
+    registry = MetricsRegistry()
+    server = serve_metrics(registry, args.metrics_port) \
+        if args.metrics_port else None
+
+    try:
+        if args.demo:
+            return run_demo(args, registry)
+
+        from tpu_operator_libs.k8s.real import RealCluster
+
+        cluster = (RealCluster.from_kubeconfig() if args.kubeconfig
+                   else RealCluster.in_cluster())
+        mgr = build_manager(args, cluster)
+        policy = load_policy(args.policy)
+        stop = threading.Event()
+        signal.signal(signal.SIGTERM, lambda *a: stop.set())
+        signal.signal(signal.SIGINT, lambda *a: stop.set())
+        reconcile_forever(mgr, args, policy, registry, stop)
+        return 0
+    finally:
+        if server is not None:
+            server.shutdown()
+
+
+if __name__ == "__main__":
+    sys.exit(main())

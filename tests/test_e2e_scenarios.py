@@ -1,0 +1,204 @@
+"""End-to-end scenario tests beyond the unit matrix:
+
+- BASELINE config #4: rolling upgrade over a pool running live training
+  jobs, gated on checkpoint durability (park → commit → proceed → resume).
+- State-graph invariants: across full simulated upgrades (both planners,
+  randomized fleets via hypothesis) every observed node transition is a
+  legal edge of the reference state graph (upgrade_state.go §1 diagram).
+"""
+
+import os
+
+from hypothesis import given, settings
+from hypothesis import strategies as st
+
+from tpu_operator_libs.api.upgrade_policy import (
+    DrainSpec,
+    PodDeletionSpec,
+    UpgradePolicySpec,
+    WaitForCompletionSpec,
+)
+from tpu_operator_libs.consts import UpgradeState
+from tpu_operator_libs.health.checkpoint_gate import CheckpointDurabilityGate
+from tpu_operator_libs.simulate import (
+    NS,
+    RUNTIME_LABELS,
+    FleetSpec,
+    build_fleet,
+    simulate_rolling_upgrade,
+)
+from tpu_operator_libs.upgrade.state_manager import (
+    BuildStateError,
+    ClusterUpgradeStateManager,
+)
+
+from builders import PodBuilder
+
+#: Legal edges of the state graph (SURVEY.md §1; upgrade_state.go). Keyed
+#: by source label value; "" is unknown.
+LEGAL_EDGES = {
+    "": {"upgrade-done", "upgrade-required"},
+    "upgrade-done": {"upgrade-required"},
+    "upgrade-required": {"cordon-required"},
+    "cordon-required": {"wait-for-jobs-required"},
+    "wait-for-jobs-required": {"pod-deletion-required", "drain-required"},
+    "pod-deletion-required": {"pod-restart-required", "drain-required",
+                              "upgrade-failed"},
+    "drain-required": {"pod-restart-required", "upgrade-failed"},
+    "pod-restart-required": {"validation-required", "uncordon-required",
+                             "upgrade-done", "upgrade-failed"},
+    "validation-required": {"uncordon-required", "upgrade-done",
+                            "upgrade-failed"},
+    "uncordon-required": {"upgrade-done"},
+    "upgrade-failed": {"uncordon-required", "upgrade-done"},
+}
+
+
+def assert_transitions_legal(trail: dict[str, list[str]]) -> None:
+    for node, states in trail.items():
+        for src, dst in zip(states, states[1:]):
+            if src == dst:
+                continue
+            assert dst in LEGAL_EDGES.get(src, set()), (
+                f"illegal transition on {node}: {src!r} -> {dst!r}; "
+                f"full trail: {states}")
+
+
+class TestCheckpointGatedRollingUpgrade:
+    """Config #4: live training job + checkpoint-resume gate."""
+
+    def test_fleet_parks_until_checkpoint_commits(self, tmp_path):
+        fleet = FleetSpec(n_slices=2, hosts_per_slice=2)
+        cluster, clock, keys = build_fleet(fleet)
+        # one training pod per node
+        for node in cluster.list_nodes():
+            PodBuilder(f"train-{node.metadata.name}", namespace="ml") \
+                .on_node(node.metadata.name).orphaned() \
+                .with_labels({"tpu-job": "llama3"}).create(cluster)
+
+        ckpt_root = tmp_path / "ckpt"
+        gate = CheckpointDurabilityGate(str(ckpt_root))
+        mgr = ClusterUpgradeStateManager(
+            cluster, keys, async_workers=False, poll_interval=0.0,
+            clock=clock).with_pod_deletion_enabled(
+                lambda pod: pod.metadata.labels.get("tpu-job") == "llama3",
+                eviction_gate=gate)
+        policy = UpgradePolicySpec(
+            auto_upgrade=True, max_parallel_upgrades=0,
+            max_unavailable=None, topology_mode="slice",
+            wait_for_completion=WaitForCompletionSpec(
+                pod_selector="tpu-job=llama3", timeout_seconds=20),
+            pod_deletion=PodDeletionSpec(force=True),
+            drain=DrainSpec(enable=True, force=True))
+
+        trail = {n.metadata.name: [""] for n in cluster.list_nodes()}
+
+        def reconcile():
+            try:
+                state = mgr.build_state(NS, RUNTIME_LABELS)
+                mgr.apply_state(state, policy)
+            except BuildStateError:
+                pass
+            for n in cluster.list_nodes():
+                label = n.metadata.labels.get(keys.state_label, "")
+                if trail[n.metadata.name][-1] != label:
+                    trail[n.metadata.name].append(label)
+            clock.advance(10)
+            cluster.step()
+
+        # Phase 1: no checkpoint committed — every node that reaches
+        # pod-deletion-required parks there; training pods stay alive.
+        for _ in range(15):
+            reconcile()
+        states = {n.metadata.name:
+                  n.metadata.labels.get(keys.state_label, "")
+                  for n in cluster.list_nodes()}
+        assert any(s == "pod-deletion-required" for s in states.values()), \
+            states
+        assert all(s != "upgrade-done" for s in states.values())
+        train_pods = cluster.list_pods(label_selector="tpu-job=llama3")
+        assert len(train_pods) == 4  # nothing evicted
+
+        # Phase 2: the job commits a checkpoint — gate opens, upgrade
+        # completes, training pods evicted for the runtime swap.
+        step_dir = ckpt_root / "1000"
+        os.makedirs(step_dir)
+        (step_dir / "checkpoint").write_text("weights")
+        for _ in range(40):
+            reconcile()
+            final = [n.metadata.labels.get(keys.state_label, "")
+                     for n in cluster.list_nodes()]
+            if all(s == "upgrade-done" for s in final):
+                break
+        else:
+            raise AssertionError(f"did not converge: {final}")
+        assert cluster.list_pods(label_selector="tpu-job=llama3") == []
+        assert_transitions_legal(trail)
+
+
+class TestStateGraphInvariants:
+    def _trail_from_sim(self, topology_mode, fleet, max_unavailable):
+        """Re-run the simulator while recording label trails."""
+        cluster, clock, keys = build_fleet(fleet)
+        mgr = ClusterUpgradeStateManager(
+            cluster, keys, async_workers=False, poll_interval=0.0,
+            clock=clock)
+        policy = UpgradePolicySpec(
+            auto_upgrade=True, max_parallel_upgrades=0,
+            max_unavailable=max_unavailable, topology_mode=topology_mode,
+            drain=DrainSpec(enable=True, force=True))
+        trail = {n.metadata.name: [""] for n in cluster.list_nodes()}
+        for _ in range(200):
+            try:
+                state = mgr.build_state(NS, RUNTIME_LABELS)
+                mgr.apply_state(state, policy)
+            except BuildStateError:
+                pass
+            for n in cluster.list_nodes():
+                label = n.metadata.labels.get(keys.state_label, "")
+                if trail[n.metadata.name][-1] != label:
+                    trail[n.metadata.name].append(label)
+            clock.advance(10)
+            cluster.step()
+            if all(n.metadata.labels.get(keys.state_label, "") ==
+                   "upgrade-done" for n in cluster.list_nodes()):
+                return trail, True
+        return trail, False
+
+    def test_flat_mode_transitions_legal(self):
+        trail, converged = self._trail_from_sim(
+            "flat", FleetSpec(n_slices=3, hosts_per_slice=2), "25%")
+        assert converged
+        assert_transitions_legal(trail)
+
+    def test_slice_mode_transitions_legal(self):
+        trail, converged = self._trail_from_sim(
+            "slice", FleetSpec(n_slices=3, hosts_per_slice=2), "25%")
+        assert converged
+        assert_transitions_legal(trail)
+
+    @settings(max_examples=10, deadline=None)
+    @given(
+        n_slices=st.integers(min_value=1, max_value=4),
+        hosts=st.integers(min_value=1, max_value=3),
+        topology_mode=st.sampled_from(["flat", "slice"]),
+        max_unavailable=st.sampled_from([1, 2, "25%", "50%", None]),
+        seed=st.integers(min_value=0, max_value=2**16),
+    )
+    def test_random_fleets_converge_legally(self, n_slices, hosts,
+                                            topology_mode, max_unavailable,
+                                            seed):
+        fleet = FleetSpec(n_slices=n_slices, hosts_per_slice=hosts,
+                          shuffle_seed=seed)
+        trail, converged = self._trail_from_sim(
+            topology_mode, fleet, max_unavailable)
+        assert converged, {k: v[-1] for k, v in trail.items()}
+        assert_transitions_legal(trail)
+
+    def test_flat_mode_respects_max_unavailable(self):
+        fleet = FleetSpec(n_slices=4, hosts_per_slice=2)
+        result = simulate_rolling_upgrade(
+            topology_mode="flat", fleet=fleet, max_unavailable=2)
+        assert result.converged
+        # implied by the throttle: every drain->ready window bounded
+        assert max(result.drain_to_ready_seconds) < result.total_seconds

@@ -233,7 +233,6 @@ class TestUnifiedPolicy:
 
 class TestRealAdapterGating:
     def test_import_error_is_clear(self):
-        pytest.importorskip  # only meaningful when kubernetes is absent
         try:
             import kubernetes  # noqa: F401
             pytest.skip("kubernetes installed; gating not exercised")

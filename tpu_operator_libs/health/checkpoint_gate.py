@@ -48,17 +48,13 @@ def _step_of(name: str) -> Optional[int]:
     return int(m.group(1)) if m else None
 
 
-def _is_committed(path: str, require_marker: bool) -> bool:
+def _is_committed(entries: Optional[list[str]], require_marker: bool) -> bool:
     """Committed = final name, non-empty, and — when the checkpoint root
     uses commit markers at all (GCS-style non-atomic filesystems, where
     Orbax writes the step under its final name and the marker last) — the
     marker itself. On atomic-rename filesystems the final name alone is
-    the commit."""
-    if _is_tmp_dir(os.path.basename(path)):
-        return False
-    if not os.path.isdir(path):
-        return False
-    entries = os.listdir(path)
+    the commit. ``entries`` is the step directory's listing (None when the
+    path is not a listable directory)."""
     if not entries:
         return False
     if _COMMIT_MARKER in entries:
@@ -71,12 +67,17 @@ def _is_committed(path: str, require_marker: bool) -> bool:
 
 
 def latest_committed_step(checkpoint_dir: str) -> Optional[int]:
-    """Newest committed step number under ``checkpoint_dir``, or None."""
+    """Newest committed step number under ``checkpoint_dir``, or None.
+
+    Each step directory is listed exactly once (remote LIST calls are the
+    cost driver on gcsfuse-mounted roots, re-run every reconcile for every
+    parked node).
+    """
     try:
         names = os.listdir(checkpoint_dir)
     except (FileNotFoundError, NotADirectoryError):
         return None
-    step_dirs = []
+    listings: list[tuple[int, Optional[list[str]]]] = []
     uses_markers = False
     for name in names:
         if _is_tmp_dir(name):
@@ -85,14 +86,15 @@ def latest_committed_step(checkpoint_dir: str) -> Optional[int]:
         if step is None:
             continue
         path = os.path.join(checkpoint_dir, name)
-        step_dirs.append((step, path))
         try:
-            if os.path.isdir(path) and _COMMIT_MARKER in os.listdir(path):
-                uses_markers = True
+            entries = os.listdir(path) if os.path.isdir(path) else None
         except OSError:
-            continue
-    steps = [step for step, path in step_dirs
-             if _is_committed(path, require_marker=uses_markers)]
+            entries = None
+        listings.append((step, entries))
+        if entries and _COMMIT_MARKER in entries:
+            uses_markers = True
+    steps = [step for step, entries in listings
+             if _is_committed(entries, require_marker=uses_markers)]
     return max(steps, default=None)
 
 

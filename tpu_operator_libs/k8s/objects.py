@@ -62,6 +62,9 @@ class PodPhase(str, enum.Enum):
     RUNNING = "Running"
     SUCCEEDED = "Succeeded"
     FAILED = "Failed"
+    # Reported when the kubelet is unreachable — exactly the condition a
+    # fleet upgrade provokes; parsing must not crash on it.
+    UNKNOWN = "Unknown"
 
     def __str__(self) -> str:
         return self.value

@@ -152,11 +152,14 @@ class RealCluster(K8sClient):
         return cls()
 
     # -- error translation -------------------------------------------------
-    def _translate(self, exc):
+    def _translate(self, exc, eviction: bool = False):
         status = getattr(exc, "status", None)
         if status == 404:
             return NotFoundError(str(exc))
-        if status == 429:
+        # 429 means "blocked by a PodDisruptionBudget" ONLY on the eviction
+        # subresource; everywhere else it is apiserver rate limiting and
+        # must surface as-is (callers back off and retry).
+        if status == 429 and eviction:
             return EvictionBlockedError(str(exc))
         return exc
 
@@ -219,7 +222,7 @@ class RealCluster(K8sClient):
             self._core.create_namespaced_pod_eviction(
                 name, namespace, eviction)
         except self._k8s.ApiException as exc:
-            raise self._translate(exc) from exc
+            raise self._translate(exc, eviction=True) from exc
 
     # -- daemonsets & revisions ---------------------------------------------
     def list_daemon_sets(self, namespace: str,

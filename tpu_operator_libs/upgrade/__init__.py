@@ -15,8 +15,13 @@ from tpu_operator_libs.upgrade.drain_manager import (  # noqa: F401
     DrainManager,
 )
 from tpu_operator_libs.upgrade.pod_manager import (  # noqa: F401
+    PodDeletionFilter,
     PodManager,
     PodManagerConfig,
+)
+from tpu_operator_libs.upgrade.gate import (  # noqa: F401
+    EvictionGate,
+    GateKeeper,
 )
 from tpu_operator_libs.upgrade.validation_manager import (  # noqa: F401
     ValidationManager,
@@ -25,7 +30,10 @@ from tpu_operator_libs.upgrade.safe_load_manager import (  # noqa: F401
     SafeRuntimeLoadManager,
 )
 from tpu_operator_libs.upgrade.state_manager import (  # noqa: F401
+    BuildStateError,
     ClusterUpgradeState,
     ClusterUpgradeStateManager,
+    FlatPlanner,
     NodeUpgradeState,
+    UpgradePlanner,
 )

@@ -112,9 +112,13 @@ class DrainManager:
                 try:
                     pods, _ = helper.get_pods_for_deletion(name)
                 except Exception as exc:  # noqa: BLE001 — worker boundary
+                    # Cannot even enumerate pods (transient API error):
+                    # park in drain-required and retry next reconcile —
+                    # delay, never escalate.
                     logger.warning("could not enumerate pods for gate on "
-                                   "node %s: %s", name, exc)
-                    pods = []
+                                   "node %s; deferring drain: %s",
+                                   name, exc)
+                    return
                 # Park in drain-required until the gate opens; a raising
                 # gate only delays, never escalates (GateKeeper semantics).
                 if not self._gatekeeper.allows(node, pods):
