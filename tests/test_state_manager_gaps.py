@@ -1,0 +1,166 @@
+"""Scenario-parity gap fillers vs the reference suite
+(upgrade_state_test.go): orphan restart paths (:1182, :1212), process-level
+throttle interplay (:293, :488), cordon failure aborting the pass (:1098),
+nil-policy tolerance (:136)."""
+
+import pytest
+
+from tpu_operator_libs.consts import TRUE_STRING, UpgradeKeys, UpgradeState
+from tpu_operator_libs.upgrade.mocks import mock_managers
+from tpu_operator_libs.upgrade.state_manager import ClusterUpgradeStateManager
+
+from builders import DaemonSetBuilder, NodeBuilder, PodBuilder
+from helpers import make_env, make_state_manager
+from test_state_manager import NS, RUNTIME_LABELS, policy, setup_fleet
+
+
+class TestOrphanedPodPaths:
+    def _orphan_in_state(self, env, state):
+        node = NodeBuilder("n1").with_upgrade_state(
+            env.keys, state).create(env.cluster)
+        PodBuilder("orphan").on_node(node).orphaned() \
+            .with_labels(dict(RUNTIME_LABELS)).create(env.cluster)
+        return node
+
+    def test_orphan_restarted_in_pod_restart_state(self):
+        # reference :1182 — orphaned pods ARE restarted (deleted); they
+        # have no DS to recreate them, so they simply disappear
+        env = make_env()
+        self._orphan_in_state(env, UpgradeState.POD_RESTART_REQUIRED)
+        mgr = make_state_manager(env)
+        mgr.process_pod_restart_nodes(mgr.build_state(NS, RUNTIME_LABELS))
+        assert env.cluster.list_pods() == []
+        # node stays in pod-restart-required (reference :1212: orphans
+        # never reach UncordonRequired via the in-sync branch)
+        assert env.state_of("n1") == "pod-restart-required"
+
+    def test_orphan_terminating_not_restarted(self):
+        env = make_env()
+        node = NodeBuilder("n1").with_upgrade_state(
+            env.keys, UpgradeState.POD_RESTART_REQUIRED).create(env.cluster)
+        pod = PodBuilder("orphan").on_node(node).orphaned() \
+            .with_labels(dict(RUNTIME_LABELS)).build()
+        pod.metadata.deletion_timestamp = 42.0
+        env.cluster.add_pod(pod)
+        mgr = make_state_manager(env)
+        mgr.process_pod_restart_nodes(mgr.build_state(NS, RUNTIME_LABELS))
+        assert len(env.cluster.list_pods()) == 1  # left terminating
+
+    def test_orphan_full_requested_flow(self):
+        # reference :1144/:1166 — upgrade-requested drives an orphan
+        # through cordon; the annotation is consumed
+        env = make_env()
+        node = NodeBuilder("n1").create(env.cluster)
+        env.cluster.patch_node_annotations(
+            "n1", {env.keys.upgrade_requested_annotation: TRUE_STRING})
+        PodBuilder("orphan").on_node(node).orphaned() \
+            .with_labels(dict(RUNTIME_LABELS)).create(env.cluster)
+        mgr = make_state_manager(env)
+        mgr.apply_state(mgr.build_state(NS, RUNTIME_LABELS), policy())
+        assert env.state_of("n1") == "upgrade-required"  # pass 1 (:1144)
+        mgr.apply_state(mgr.build_state(NS, RUNTIME_LABELS), policy())
+        assert env.state_of("n1") == "cordon-required"   # pass 2 (:1166)
+        annotations = env.cluster.get_node("n1").metadata.annotations
+        assert env.keys.upgrade_requested_annotation not in annotations
+
+
+class TestThrottleInterplayProcessLevel:
+    """Process-level (not just math-level) maxParallel × maxUnavailable
+    checks (reference :293, :457-556)."""
+
+    def _fleet(self, env, upgrade_required, in_progress_drain, done):
+        ds = DaemonSetBuilder("libtpu").with_labels(dict(RUNTIME_LABELS)) \
+            .with_desired_scheduled(
+                upgrade_required + in_progress_drain + done) \
+            .with_revision_hash("new").create(env.cluster)
+        i = 0
+
+        def add(state, count, pod_hash, unschedulable=False):
+            nonlocal i
+            for _ in range(count):
+                b = NodeBuilder(f"n{i}").with_upgrade_state(env.keys, state)
+                if unschedulable:
+                    b = b.unschedulable()
+                node = b.create(env.cluster)
+                PodBuilder(f"p{i}").on_node(node).owned_by(ds) \
+                    .with_revision_hash(pod_hash).create(env.cluster)
+                i += 1
+
+        add(UpgradeState.UPGRADE_REQUIRED, upgrade_required, "old")
+        add(UpgradeState.DRAIN_REQUIRED, in_progress_drain, "old",
+            unschedulable=True)
+        add(UpgradeState.DONE, done, "new")
+
+    def test_additional_upgrades_started_up_to_parallel_limit(self):
+        env = make_env()
+        self._fleet(env, upgrade_required=4, in_progress_drain=2, done=2)
+        mgr = make_state_manager(env)
+        pol = policy(max_parallel_upgrades=4, max_unavailable=None)
+        mgr.apply_state(mgr.build_state(NS, RUNTIME_LABELS), pol)
+        cordoned = sum(1 for j in range(8)
+                       if env.state_of(f"n{j}") == "cordon-required")
+        # 4 parallel slots - 2 already in progress = 2 new starts
+        assert cordoned == 2
+
+    def test_max_unavailable_further_constrains_parallel(self):
+        env = make_env()
+        self._fleet(env, upgrade_required=4, in_progress_drain=2, done=2)
+        mgr = make_state_manager(env)
+        # 8 nodes, 50% = 4 unavailable allowed; 2 drain nodes already
+        # cordoned -> only 2 new; parallel limit 8-2=6 -> min is 2
+        pol = policy(max_parallel_upgrades=8, max_unavailable="50%")
+        mgr.apply_state(mgr.build_state(NS, RUNTIME_LABELS), pol)
+        cordoned = sum(1 for j in range(8)
+                       if env.state_of(f"n{j}") == "cordon-required")
+        assert cordoned == 2
+
+    def test_unavailable_budget_exhausted_blocks_starts(self):
+        env = make_env()
+        self._fleet(env, upgrade_required=4, in_progress_drain=2, done=2)
+        mgr = make_state_manager(env)
+        pol = policy(max_parallel_upgrades=0, max_unavailable=2)
+        mgr.apply_state(mgr.build_state(NS, RUNTIME_LABELS), pol)
+        cordoned = sum(1 for j in range(8)
+                       if env.state_of(f"n{j}") == "cordon-required")
+        assert cordoned == 0
+
+
+class TestErrorPropagation:
+    def test_cordon_failure_aborts_pass(self):
+        # reference :1098
+        keys = UpgradeKeys()
+        mocks = mock_managers(keys)
+        mocks["cordon_manager"].fail_next = RuntimeError("cordon exploded")
+        mgr = ClusterUpgradeStateManager(client=None, keys=keys, **mocks)
+        from tpu_operator_libs.k8s.objects import (
+            DaemonSet,
+            DaemonSetSpec,
+            Node,
+            ObjectMeta,
+            Pod,
+            PodSpec,
+        )
+        from tpu_operator_libs.upgrade.state_manager import (
+            ClusterUpgradeState,
+            NodeUpgradeState,
+        )
+
+        state = ClusterUpgradeState()
+        node = Node(metadata=ObjectMeta(
+            name="a", labels={keys.state_label: "cordon-required"}))
+        state.node_states["cordon-required"] = [NodeUpgradeState(
+            node=node,
+            runtime_pod=Pod(metadata=ObjectMeta(name="p", namespace=NS),
+                            spec=PodSpec(node_name="a")),
+            runtime_daemon_set=DaemonSet(
+                metadata=ObjectMeta(name="libtpu", namespace=NS),
+                spec=DaemonSetSpec(selector=dict(RUNTIME_LABELS))))]
+        with pytest.raises(RuntimeError, match="cordon exploded"):
+            mgr.process_cordon_required_nodes(state)
+
+    def test_nil_policy_is_tolerated(self):
+        # reference :136 — nil policy must not raise
+        env = make_env()
+        setup_fleet(env, n_nodes=1)
+        mgr = make_state_manager(env)
+        mgr.apply_state(mgr.build_state(NS, RUNTIME_LABELS), None)

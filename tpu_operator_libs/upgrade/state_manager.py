@@ -618,6 +618,47 @@ class ClusterUpgradeStateManager:
         return available
 
     # ------------------------------------------------------------------
+    # chained reconcile
+    # ------------------------------------------------------------------
+    def reconcile(self, namespace: str, runtime_labels: dict[str, str],
+                  policy: Optional[UpgradePolicySpec],
+                  max_chain: int = 12) -> Optional[ClusterUpgradeState]:
+        """build_state + apply_state, chained until node states stabilize.
+
+        The reference moves a node at most one transition per reconcile and
+        then waits for the operator's next reconcile interval, so a node
+        burns ~interval seconds per edge of the state graph even when every
+        action is instantaneous. Chaining is exactly what a consumer's
+        immediate-requeue loop does — each inner pass is a full
+        reference-semantics pass committed to node labels, preserving
+        idempotence and crash-resume — minus the dead time. Stops as soon
+        as a pass changes nothing (async work in flight reports through
+        labels on a later reconcile), after ``max_chain`` passes, or when
+        the snapshot is momentarily incomplete.
+
+        Returns the last built state (None if the first build failed).
+        """
+        last_state = None
+        fingerprint = None
+        for _ in range(max_chain):
+            try:
+                state = self.build_state(namespace, runtime_labels)
+            except BuildStateError:
+                # restarted runtime pod between deletion and recreation;
+                # nothing more to do until the controller catches up
+                return last_state
+            new_fingerprint = tuple(sorted(
+                (ns.node.metadata.name, label)
+                for label, bucket in state.node_states.items()
+                for ns in bucket))
+            if new_fingerprint == fingerprint:
+                return state
+            fingerprint = new_fingerprint
+            last_state = state
+            self.apply_state(state, policy)
+        return last_state
+
+    # ------------------------------------------------------------------
     # test/sim helper
     # ------------------------------------------------------------------
     def join_workers(self, timeout: float = 30.0) -> None:
