@@ -200,5 +200,79 @@ class TestStateGraphInvariants:
         result = simulate_rolling_upgrade(
             topology_mode="flat", fleet=fleet, max_unavailable=2)
         assert result.converged
-        # implied by the throttle: every drain->ready window bounded
         assert max(result.drain_to_ready_seconds) < result.total_seconds
+
+class TestThrottleMathProperties:
+    """Property check of get_upgrades_available against its invariants
+    (the subtlest reference logic, upgrade_state.go:1073-1102 —
+    SURVEY.md §7 'hard parts' (a))."""
+
+    @settings(max_examples=60, deadline=None)
+    @given(
+        upgrade_required=st.integers(min_value=0, max_value=12),
+        in_progress=st.integers(min_value=0, max_value=12),
+        done=st.integers(min_value=0, max_value=12),
+        unavailable_done=st.integers(min_value=0, max_value=6),
+        cordon_required=st.integers(min_value=0, max_value=6),
+        max_parallel=st.integers(min_value=0, max_value=16),
+        max_unavailable=st.integers(min_value=0, max_value=16),
+    )
+    def test_invariants(self, upgrade_required, in_progress, done,
+                        unavailable_done, cordon_required, max_parallel,
+                        max_unavailable):
+        from tpu_operator_libs.consts import UpgradeKeys
+        from tpu_operator_libs.k8s.objects import (
+            Node,
+            NodeSpec,
+            ObjectMeta,
+        )
+        from tpu_operator_libs.upgrade.mocks import mock_managers
+        from tpu_operator_libs.upgrade.state_manager import (
+            ClusterUpgradeState,
+            NodeUpgradeState,
+        )
+
+        keys = UpgradeKeys()
+        mgr = ClusterUpgradeStateManager(client=None, keys=keys,
+                                         **mock_managers(keys))
+        state = ClusterUpgradeState()
+        i = 0
+
+        def add(label, count, unschedulable=False):
+            nonlocal i
+            for _ in range(count):
+                node = Node(metadata=ObjectMeta(name=f"n{i}"),
+                            spec=NodeSpec(unschedulable=unschedulable))
+                state.node_states.setdefault(label, []).append(
+                    NodeUpgradeState(node=node, runtime_pod=None,
+                                     runtime_daemon_set=None))
+                i += 1
+
+        add("upgrade-required", upgrade_required)
+        add("drain-required", in_progress, unschedulable=True)
+        add("upgrade-done", done)
+        add("upgrade-done", unavailable_done, unschedulable=True)
+        add("cordon-required", cordon_required)
+
+        available = mgr.get_upgrades_available(
+            state, max_parallel, max_unavailable)
+
+        total = mgr.get_total_managed_nodes(state)
+        unavailable = (mgr.get_current_unavailable_nodes(state)
+                       + cordon_required)
+        assert available >= 0
+        # budget already blown (pre-existing unavailability) => no new
+        # starts at all (upgrade_state.go:1096-1097)
+        if unavailable >= max_unavailable:
+            assert available == 0
+        # otherwise, when maxUnavailable is limiting, new starts never push
+        # unavailability past the budget (upgrade_state.go:1098-1100)
+        elif max_unavailable < total:
+            assert unavailable + available <= max_unavailable
+        # never exceeds the parallel budget (when one exists)
+        if max_parallel > 0:
+            assert available <= max(0, max_parallel
+                                    - (in_progress + cordon_required))
+        # never exceeds the number of candidates under unlimited parallel
+        if max_parallel == 0:
+            assert available <= upgrade_required

@@ -55,6 +55,71 @@ class TestSelectors:
             matches_labels("a><b", {})
 
 
+class TestCloneCompleteness:
+    """clone() must stay field-complete as dataclasses evolve: for fully
+    populated instances, clone(x) == deepcopy(x) exactly (dataclass __eq__
+    compares every field recursively)."""
+
+    def _populated_pod(self):
+        from tpu_operator_libs.k8s.objects import (
+            ContainerStatus,
+            ObjectMeta,
+            OwnerReference,
+            Pod,
+            PodPhase,
+            PodSpec,
+            PodStatus,
+            Volume,
+        )
+        return Pod(
+            metadata=ObjectMeta(
+                name="p", namespace="ns", uid="u1",
+                labels={"a": "1"}, annotations={"b": "2"},
+                owner_references=[OwnerReference("DaemonSet", "d", "u2")],
+                deletion_timestamp=12.5, resource_version=7),
+            spec=PodSpec(node_name="n",
+                         volumes=[Volume("v", empty_dir=True)]),
+            status=PodStatus(
+                phase=PodPhase.RUNNING,
+                container_statuses=[ContainerStatus("c", True, 3)],
+                init_container_statuses=[ContainerStatus("i", False, 11)]))
+
+    def test_clone_equals_deepcopy(self):
+        import copy
+        import dataclasses
+
+        from tpu_operator_libs.k8s.objects import (
+            ControllerRevision,
+            DaemonSet,
+            DaemonSetSpec,
+            DaemonSetStatus,
+            Node,
+            NodeCondition,
+            NodeSpec,
+            NodeStatus,
+            ObjectMeta,
+        )
+
+        pod = self._populated_pod()
+        node = Node(metadata=pod.metadata.clone(),
+                    spec=NodeSpec(unschedulable=True),
+                    status=NodeStatus(conditions=[
+                        NodeCondition("Ready", "False")]))
+        ds = DaemonSet(metadata=pod.metadata.clone(),
+                       spec=DaemonSetSpec(selector={"a": "1"},
+                                          template_generation=4),
+                       status=DaemonSetStatus(desired_number_scheduled=9))
+        rev = ControllerRevision(metadata=pod.metadata.clone(), revision=6)
+        for obj in (pod, node, ds, rev, pod.metadata):
+            cloned = obj.clone()
+            assert cloned == copy.deepcopy(obj), type(obj).__name__
+            assert cloned is not obj
+            # dataclass field count drift guard: clone compared above via
+            # __eq__ walks every declared field, so a new field that is
+            # populated here but dropped by clone() fails the equality.
+            assert dataclasses.fields(obj)
+
+
 class TestFakeClusterNodes:
     def test_get_returns_copy(self):
         cluster = FakeCluster()

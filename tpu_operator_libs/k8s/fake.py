@@ -26,7 +26,6 @@ the engine behind ``bench.py`` and the e2e tests (BASELINE configs #2-#4).
 
 from __future__ import annotations
 
-import copy
 import threading
 from dataclasses import dataclass, field
 from typing import Callable, Mapping, Optional
@@ -113,13 +112,13 @@ class FakeCluster(K8sClient):
 
     def add_node(self, node: Node) -> Node:
         with self._lock:
-            self._nodes[node.metadata.name] = copy.deepcopy(node)
+            self._nodes[node.metadata.name] = node.clone()
         return node
 
     def add_pod(self, pod: Pod) -> Pod:
         with self._lock:
             self._pods[(pod.metadata.namespace, pod.metadata.name)] = (
-                copy.deepcopy(pod))
+                pod.clone())
         return pod
 
     @staticmethod
@@ -143,7 +142,7 @@ class FakeCluster(K8sClient):
         self._check_revision_hash(revision_hash)
         with self._lock:
             self._daemon_sets[(ds.metadata.namespace, ds.metadata.name)] = (
-                copy.deepcopy(ds))
+                ds.clone())
             rev_name = f"{ds.metadata.name}-{revision_hash}"
             rev = ControllerRevision(
                 metadata=ObjectMeta(name=rev_name,
@@ -215,7 +214,7 @@ class FakeCluster(K8sClient):
             node = self._nodes.get(name)
             if node is None:
                 raise NotFoundError(name)
-            self._stale_reads[name] = (reads, copy.deepcopy(node))
+            self._stale_reads[name] = (reads, node.clone())
 
     def step(self, until: Optional[float] = None) -> int:
         """Run scheduled simulation actions due at or before ``until``
@@ -264,16 +263,16 @@ class FakeCluster(K8sClient):
                     self._stale_reads[name] = (remaining - 1, snapshot)
                 else:
                     del self._stale_reads[name]
-                return copy.deepcopy(snapshot)
+                return snapshot.clone()
             node = self._nodes.get(name)
             if node is None:
                 raise NotFoundError(f"node {name!r} not found")
-            return copy.deepcopy(node)
+            return node.clone()
 
     def list_nodes(self, label_selector: str = "") -> list[Node]:
         match = parse_label_selector(label_selector)
         with self._lock:
-            return [copy.deepcopy(n) for n in self._nodes.values()
+            return [n.clone() for n in self._nodes.values()
                     if match(n.metadata.labels)]
 
     def _mutate_node(self, name: str) -> Node:
@@ -292,7 +291,7 @@ class FakeCluster(K8sClient):
                     node.metadata.labels.pop(key, None)
                 else:
                     node.metadata.labels[key] = value
-            return copy.deepcopy(node)
+            return node.clone()
 
     def patch_node_annotations(self, name: str,
                                annotations: Mapping[str, Optional[str]]) -> Node:
@@ -303,13 +302,13 @@ class FakeCluster(K8sClient):
                     node.metadata.annotations.pop(key, None)
                 else:
                     node.metadata.annotations[key] = value
-            return copy.deepcopy(node)
+            return node.clone()
 
     def set_node_unschedulable(self, name: str, unschedulable: bool) -> Node:
         with self._lock:
             node = self._mutate_node(name)
             node.spec.unschedulable = unschedulable
-            return copy.deepcopy(node)
+            return node.clone()
 
     def set_node_ready(self, name: str, ready: bool) -> Node:
         """Test helper: flip the node Ready condition."""
@@ -323,7 +322,7 @@ class FakeCluster(K8sClient):
                 from tpu_operator_libs.k8s.objects import NodeCondition
                 node.status.conditions.append(
                     NodeCondition("Ready", "True" if ready else "False"))
-            return copy.deepcopy(node)
+            return node.clone()
 
     # ------------------------------------------------------------------
     # K8sClient: pods
@@ -342,7 +341,7 @@ class FakeCluster(K8sClient):
                     continue
                 if not field_match(_pod_fields(pod)):
                     continue
-                out.append(copy.deepcopy(pod))
+                out.append(pod.clone())
             return out
 
     def get_pod(self, namespace: str, name: str) -> Pod:
@@ -350,7 +349,7 @@ class FakeCluster(K8sClient):
             pod = self._pods.get((namespace, name))
             if pod is None:
                 raise NotFoundError(f"pod {namespace}/{name} not found")
-            return copy.deepcopy(pod)
+            return pod.clone()
 
     def set_pod_status(self, namespace: str, name: str,
                        phase: Optional[PodPhase] = None,
@@ -376,7 +375,7 @@ class FakeCluster(K8sClient):
                 for c in pod.status.container_statuses:
                     c.restart_count = restart_count
             pod.metadata.resource_version += 1
-            return copy.deepcopy(pod)
+            return pod.clone()
 
     def delete_pod(self, namespace: str, name: str) -> None:
         with self._lock:
@@ -459,7 +458,7 @@ class FakeCluster(K8sClient):
                          label_selector: str = "") -> list[DaemonSet]:
         match = parse_label_selector(label_selector)
         with self._lock:
-            return [copy.deepcopy(ds)
+            return [ds.clone()
                     for (ns, _), ds in self._daemon_sets.items()
                     if ns == namespace and match(ds.metadata.labels)]
 
@@ -467,6 +466,6 @@ class FakeCluster(K8sClient):
                                   label_selector: str = "") -> list[ControllerRevision]:
         match = parse_label_selector(label_selector)
         with self._lock:
-            return [copy.deepcopy(rev)
+            return [rev.clone()
                     for (ns, _), rev in self._revisions.items()
                     if ns == namespace and match(rev.metadata.labels)]

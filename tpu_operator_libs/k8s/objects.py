@@ -48,6 +48,20 @@ class ObjectMeta:
         if not self.uid:
             self.uid = new_uid(self.name or "obj")
 
+    def clone(self) -> "ObjectMeta":
+        """Field-wise copy. The fake API server returns copies on every
+        read (value semantics, like objects off the wire); the generic
+        copy.deepcopy dominated simulation profiles, so cloning is
+        hand-rolled over the known fields."""
+        return ObjectMeta(
+            name=self.name, namespace=self.namespace, uid=self.uid,
+            labels=dict(self.labels), annotations=dict(self.annotations),
+            owner_references=[OwnerReference(r.kind, r.name, r.uid,
+                                             r.controller)
+                              for r in self.owner_references],
+            deletion_timestamp=self.deletion_timestamp,
+            resource_version=self.resource_version)
+
 
 @dataclass
 class OwnerReference:
@@ -115,6 +129,14 @@ class Node:
             if cond.type == "Ready" and cond.status != "True":
                 return False
         return True
+
+    def clone(self) -> "Node":
+        return Node(
+            metadata=self.metadata.clone(),
+            spec=NodeSpec(unschedulable=self.spec.unschedulable),
+            status=NodeStatus(conditions=[
+                NodeCondition(c.type, c.status)
+                for c in self.status.conditions]))
 
 
 @dataclass
@@ -193,6 +215,21 @@ class Pod:
     def is_mirror_pod(self) -> bool:
         return "kubernetes.io/config.mirror" in self.metadata.annotations
 
+    def clone(self) -> "Pod":
+        return Pod(
+            metadata=self.metadata.clone(),
+            spec=PodSpec(node_name=self.spec.node_name,
+                         volumes=[Volume(v.name, v.empty_dir)
+                                  for v in self.spec.volumes]),
+            status=PodStatus(
+                phase=self.status.phase,
+                container_statuses=[
+                    ContainerStatus(c.name, c.ready, c.restart_count)
+                    for c in self.status.container_statuses],
+                init_container_statuses=[
+                    ContainerStatus(c.name, c.ready, c.restart_count)
+                    for c in self.status.init_container_statuses]))
+
 
 @dataclass
 class DaemonSetSpec:
@@ -221,6 +258,15 @@ class DaemonSet:
     def namespace(self) -> str:
         return self.metadata.namespace
 
+    def clone(self) -> "DaemonSet":
+        return DaemonSet(
+            metadata=self.metadata.clone(),
+            spec=DaemonSetSpec(
+                selector=dict(self.spec.selector),
+                template_generation=self.spec.template_generation),
+            status=DaemonSetStatus(
+                desired_number_scheduled=self.status.desired_number_scheduled))
+
 
 @dataclass
 class ControllerRevision:
@@ -238,3 +284,7 @@ class ControllerRevision:
         hyphens (FakeCluster enforces this for injected hashes), so the last
         segment is always the full hash."""
         return self.metadata.name.rsplit("-", 1)[-1]
+
+    def clone(self) -> "ControllerRevision":
+        return ControllerRevision(metadata=self.metadata.clone(),
+                                  revision=self.revision)

@@ -615,7 +615,10 @@ class ClusterUpgradeStateManager:
         elif (max_unavailable < total_nodes
               and unavailable + available > max_unavailable):
             available = max_unavailable - unavailable
-        return available
+        # The reference can return a negative count here when in-progress
+        # exceeds the parallel budget (upgrade_state.go:1084 with no clamp)
+        # — harmless to its caller but wrong as an exposed fleet counter.
+        return max(0, available)
 
     # ------------------------------------------------------------------
     # chained reconcile
