@@ -1,0 +1,51 @@
+"""Fault-injection e2e: failure detection and elastic recovery under the
+real state machine (SURVEY.md §5 — the reference only simulates failures
+via mock errors; here the failures happen in the cluster model)."""
+
+from tpu_operator_libs.simulate import FleetSpec, simulate_rolling_upgrade
+
+
+class TestCrashLoopingRuntime:
+    def test_crashloop_node_fails_then_autorecovers(self):
+        """A node whose new runtime pod crash-loops must go upgrade-failed
+        (restart threshold, upgrade_state.go:966-978), stop blocking the
+        rest of the fleet beyond budget accounting, and auto-recover to
+        done once the pod is healthy (upgrade_state.go:835-877)."""
+        fleet = FleetSpec(n_slices=3, hosts_per_slice=2,
+                          crashloop_nodes=("s0-h0",),
+                          crashloop_heal_after=400.0)
+        r = simulate_rolling_upgrade(
+            topology_mode="slice", fleet=fleet, chained=True,
+            max_sim_seconds=4000.0)
+        # the whole fleet, including the afflicted node, eventually lands
+        # in upgrade-done
+        assert r.converged
+        # recovery costs sim time: convergence must be after the heal
+        assert r.total_seconds >= 400.0
+
+    def test_healthy_fleet_is_faster_than_crashlooping(self):
+        fleet_ok = FleetSpec(n_slices=3, hosts_per_slice=2)
+        fleet_bad = FleetSpec(n_slices=3, hosts_per_slice=2,
+                              crashloop_nodes=("s0-h0",),
+                              crashloop_heal_after=400.0)
+        ok = simulate_rolling_upgrade("slice", fleet=fleet_ok, chained=True)
+        bad = simulate_rolling_upgrade("slice", fleet=fleet_bad,
+                                       chained=True, max_sim_seconds=4000.0)
+        assert ok.converged and bad.converged
+        assert ok.total_seconds < bad.total_seconds
+
+
+class TestNotReadyNode:
+    def test_not_ready_node_consumes_budget_then_heals(self):
+        """A NotReady node counts against maxUnavailable
+        (upgrade_state.go:192-211): with budget 1 and one sick node, no new
+        upgrades start until it heals; afterwards the fleet converges."""
+        fleet = FleetSpec(n_slices=2, hosts_per_slice=1,
+                          not_ready_nodes=("s1-h0",),
+                          not_ready_at=0.0, not_ready_heal_at=300.0)
+        r = simulate_rolling_upgrade(
+            topology_mode="flat", fleet=fleet, max_unavailable=1,
+            max_sim_seconds=4000.0)
+        assert r.converged
+        # nothing could start while the sick node consumed the budget
+        assert r.total_seconds > 300.0

@@ -98,6 +98,11 @@ class FakeCluster(K8sClient):
         self._seq = 0
         self._ds_controller: Optional[_DsControllerConfig] = None
         self._eviction_blockers: list[Callable[[Pod], bool]] = []
+        # Health gate consulted by the DS-controller simulation before
+        # marking a recreated pod Ready. Returning False models a
+        # crash-looping runtime: the pod stays not-ready with a
+        # crash-loop restart count and readiness is retried later.
+        self._pod_ready_gate: Optional[Callable[[Pod], bool]] = None
         # Per-node count of reads that should return a stale copy, to
         # exercise the provider's cache-sync poll loop
         # (node_upgrade_state_provider.go:100-117).
@@ -203,6 +208,13 @@ class FakeCluster(K8sClient):
         with self._lock:
             self._eviction_blockers.append(blocker)
 
+    def set_pod_ready_gate(self, gate: Optional[Callable[[Pod], bool]]) -> None:
+        """Fault injection: recreated DS pods become Ready only when
+        ``gate(pod)`` returns True; until then they crash-loop (not ready,
+        restart count above the failure threshold)."""
+        with self._lock:
+            self._pod_ready_gate = gate
+
     def inject_stale_node_reads(self, name: str, reads: int) -> None:
         """Make the next ``reads`` get_node() calls return the current
         (pre-future-patch) snapshot, emulating controller-runtime cache lag
@@ -243,9 +255,12 @@ class FakeCluster(K8sClient):
             return min(a.due for a in self._scheduled)
 
     def _schedule(self, delay: float, action: Callable[[], None]) -> float:
-        return self._schedule_at(self._clock.now() + delay, action)
+        return self.schedule_at(self._clock.now() + delay, action)
 
-    def _schedule_at(self, due: float, action: Callable[[], None]) -> float:
+    def schedule_at(self, due: float, action: Callable[[], None]) -> float:
+        """Public scheduler hook: run ``action`` once the virtual clock
+        reaches ``due`` and :meth:`step` is called. Used by fault
+        injection (tpu_operator_libs.simulate) and available to tests."""
         with self._lock:
             self._seq += 1
             self._scheduled.append(_ScheduledAction(due, self._seq, action))
@@ -436,20 +451,39 @@ class FakeCluster(K8sClient):
                             ContainerStatus(name="runtime", ready=False)]))
                 self._pods[(namespace, pod_name)] = new_pod
 
-                def make_ready() -> None:
+                def make_ready(due: float) -> None:
                     with self._lock:
                         p = self._pods.get((namespace, pod_name))
-                        if p is not None:
+                        if p is None:
+                            return
+                        gate = self._pod_ready_gate
+                        if gate is not None and not gate(p):
+                            # crash-looping: stay not-ready, accumulate
+                            # restarts past the failure threshold, retry.
+                            # The retry is anchored to this action's OWN
+                            # due time (not clock.now()): step(until=T)
+                            # with a frozen clock must terminate, and
+                            # coarse step() calls must not skew timing.
                             for c in p.status.container_statuses:
-                                c.ready = True
+                                c.ready = False
+                                c.restart_count = max(c.restart_count, 11)
                             p.metadata.resource_version += 1
+                            retry_due = due + 5.0
+                            self.schedule_at(
+                                retry_due, lambda: make_ready(retry_due))
+                            return
+                        for c in p.status.container_statuses:
+                            c.ready = True
+                            c.restart_count = 0
+                        p.metadata.resource_version += 1
 
                 # Anchor readiness to the recreation's due time, not to
                 # whenever step() happened to execute the action, so coarse
                 # step() calls don't inflate pod-ready latencies.
-                self._schedule_at(recreate_due + cfg.ready_delay, make_ready)
+                ready_due = recreate_due + cfg.ready_delay
+                self.schedule_at(ready_due, lambda: make_ready(ready_due))
 
-        self._schedule_at(recreate_due, recreate)
+        self.schedule_at(recreate_due, recreate)
 
     # ------------------------------------------------------------------
     # K8sClient: daemonsets & revisions

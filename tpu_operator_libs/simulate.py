@@ -73,6 +73,17 @@ class FleetSpec:
     # (Without it, slice-contiguous creation order would hand the flat
     # planner whole slices by accident and mask the topology benefit.)
     shuffle_seed: Optional[int] = 1234
+    # --- fault injection (SURVEY.md §5: the reference has none; failures
+    # are only ever simulated via mock errors in its tests) ---
+    # Node names whose recreated runtime pod crash-loops (stays not-ready
+    # with >10 restarts) until `crashloop_heal_after` virtual seconds.
+    crashloop_nodes: tuple[str, ...] = ()
+    crashloop_heal_after: float = 300.0
+    # Node names that flip NotReady at `not_ready_at` and recover at
+    # `not_ready_heal_at` (virtual seconds).
+    not_ready_nodes: tuple[str, ...] = ()
+    not_ready_at: float = 50.0
+    not_ready_heal_at: float = 200.0
 
 
 @dataclass
@@ -145,7 +156,37 @@ def build_fleet(spec: FleetSpec) -> tuple[FakeCluster, FakeClock, UpgradeKeys]:
                     ContainerStatus(name="libtpu", ready=True)])))
     # roll the DS template: every pod is now out of date
     cluster.bump_daemon_set_revision(NS, "libtpu", "new")
+    _schedule_faults(cluster, spec)
+    # apply any faults due at t=0 so "broken from the start" scenarios are
+    # visible to the very first reconcile pass
+    cluster.step()
     return cluster, clock, keys
+
+
+def _schedule_faults(cluster: FakeCluster, spec: FleetSpec) -> None:
+    """Install the configured fault injections as scheduled sim actions."""
+    known = {n.metadata.name for n in cluster.list_nodes()}
+    for name in (*spec.not_ready_nodes, *spec.crashloop_nodes):
+        if name not in known:
+            raise ValueError(
+                f"fault-injection target {name!r} is not a fleet node "
+                f"(nodes are named s<slice>-h<host>)")
+    for name in spec.not_ready_nodes:
+        cluster.schedule_at(spec.not_ready_at,
+                            lambda n=name: cluster.set_node_ready(n, False))
+        cluster.schedule_at(spec.not_ready_heal_at,
+                            lambda n=name: cluster.set_node_ready(n, True))
+    if not spec.crashloop_nodes:
+        return
+    afflicted = set(spec.crashloop_nodes)
+    heal_at = spec.crashloop_heal_after
+
+    def ready_gate(pod) -> bool:
+        if pod.spec.node_name not in afflicted:
+            return True
+        return cluster.clock.now() >= heal_at
+
+    cluster.set_pod_ready_gate(ready_gate)
 
 
 def simulate_rolling_upgrade(

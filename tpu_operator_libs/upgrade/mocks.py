@@ -10,10 +10,10 @@ tested without any cluster at all.
 
 from __future__ import annotations
 
-from dataclasses import dataclass, field
+from dataclasses import dataclass
 from typing import Optional
 
-from tpu_operator_libs.consts import NULL_STRING, UpgradeKeys, UpgradeState
+from tpu_operator_libs.consts import NULL_STRING, UpgradeKeys
 from tpu_operator_libs.k8s.objects import Node
 
 
