@@ -35,6 +35,87 @@ class TestCrashLoopingRuntime:
         assert ok.total_seconds < bad.total_seconds
 
 
+class TestDegradedICIFabric:
+    def test_degraded_fabric_blocks_validation_then_heals(self):
+        """SURVEY.md §5: ICI-fabric health as an additional failure
+        signal. A node whose post-upgrade fabric probe fails must be held
+        in validation-required (then upgrade-failed after the timeout) and
+        only return to service when the fabric is healthy again."""
+        from tpu_operator_libs.api.upgrade_policy import (
+            DrainSpec,
+            UpgradePolicySpec,
+        )
+        from tpu_operator_libs.simulate import (
+            NS,
+            RUNTIME_LABELS,
+            FleetSpec,
+            build_fleet,
+        )
+        from tpu_operator_libs.upgrade.state_manager import (
+            BuildStateError,
+            ClusterUpgradeStateManager,
+        )
+
+        fleet = FleetSpec(n_slices=1, hosts_per_slice=2)
+        cluster, clock, keys = build_fleet(fleet)
+        fabric_healthy = {"value": False}
+        mgr = ClusterUpgradeStateManager(
+            cluster, keys, async_workers=False, poll_interval=0.0,
+            clock=clock).with_validation_enabled(
+                extra_validator=lambda node: fabric_healthy["value"])
+        pol = UpgradePolicySpec(
+            auto_upgrade=True, max_parallel_upgrades=0, max_unavailable=None,
+            topology_mode="slice", drain=DrainSpec(enable=True, force=True))
+
+        saw_validation = saw_failed = False
+        for _ in range(120):
+            try:
+                mgr.reconcile(NS, RUNTIME_LABELS, pol)
+            except BuildStateError:
+                pass
+            states = {n.metadata.labels.get(keys.state_label, "")
+                      for n in cluster.list_nodes()}
+            saw_validation |= "validation-required" in states
+            saw_failed |= "upgrade-failed" in states
+            if saw_failed and not fabric_healthy["value"]:
+                fabric_healthy["value"] = True  # fabric repaired
+            clock.advance(30)
+            cluster.step()
+            if states == {"upgrade-done"}:
+                break
+        else:
+            raise AssertionError(f"did not converge: {states}")
+        assert saw_validation, "validation state never entered"
+        assert saw_failed, "validation timeout never fired"
+
+
+class TestHeldFailedNodeDoesNotChurn:
+    def test_no_timer_stamps_or_events_while_held(self):
+        """Recovery uses the side-effect-free check(): a failed node with a
+        healthy pod but failing validation gate must park quietly — no
+        validation-start stamps, no timeout events, no label rewrites."""
+        import sys
+
+        sys.path.insert(0, "tests")
+        from helpers import make_env, make_state_manager
+        from test_state_manager import NS, RUNTIME_LABELS, setup_fleet
+
+        from tpu_operator_libs.consts import UpgradeState
+
+        env = make_env()
+        setup_fleet(env, n_nodes=1, state=UpgradeState.FAILED)
+        mgr = make_state_manager(env).with_validation_enabled(
+            extra_validator=lambda n: False)
+        for _ in range(10):
+            mgr.process_upgrade_failed_nodes(
+                mgr.build_state(NS, RUNTIME_LABELS))
+            env.clock.advance(700)  # well past the validation timeout
+        annotations = env.cluster.get_node("node-0").metadata.annotations
+        assert env.state_of("node-0") == "upgrade-failed"
+        assert env.keys.validation_start_annotation not in annotations
+        assert env.recorder.find(type_="Warning") == []
+
+
 class TestNotReadyNode:
     def test_not_ready_node_consumes_budget_then_heals(self):
         """A NotReady node counts against maxUnavailable

@@ -44,6 +44,78 @@ class TestFabricProbe:
         expected = np.tanh(0.5) + 0.25
         np.testing.assert_allclose(np.asarray(out), expected, atol=1e-2)
 
+    def test_topology_probe_2d(self):
+        from tpu_operator_libs.health.ici_probe import fabric_probe_topology
+        # v5e-16-style 4x4 torus, scaled to the 8 local CPU devices (2x4)
+        results = fabric_probe_topology("4x4")
+        assert results and all(r.healthy for r in results), results
+
+    def test_topology_probe_3d(self):
+        from tpu_operator_libs.health.ici_probe import fabric_probe_topology
+        results = fabric_probe_topology("2x2x2")
+        assert results and all(r.healthy for r in results)
+
+    def test_topology_probe_rings_are_strided(self):
+        """Axis rings must stride the device grid, not slice contiguous
+        blocks: for dims (2,4), axis-0 rings are (0,4),(1,5),(2,6),(3,7)
+        — the links a contiguous grouping never touches."""
+        import tpu_operator_libs.health.ici_probe as probe_mod
+        from tpu_operator_libs.health.ici_probe import fabric_probe_topology
+
+        rings = []
+        orig = probe_mod.fabric_probe
+
+        def spy(mesh=None, **kw):
+            rings.append(tuple(d.id for d in mesh.devices.flatten()))
+            return orig(mesh=mesh, **kw)
+
+        probe_mod.fabric_probe = spy
+        try:
+            fabric_probe_topology("2x4")
+        finally:
+            probe_mod.fabric_probe = orig
+        assert (0, 4) in rings and (1, 5) in rings, rings
+        assert (0, 1, 2, 3) in rings, rings
+
+    def test_validator_cache_keyed_per_slice(self):
+        from tpu_operator_libs.consts import (
+            GKE_NODEPOOL_LABEL,
+            GKE_TPU_TOPOLOGY_LABEL,
+        )
+        from tpu_operator_libs.health.ici_probe import ICIFabricValidator
+        from tpu_operator_libs.k8s.objects import Node, ObjectMeta
+
+        calls = []
+        v = ICIFabricValidator(
+            probe_runner=lambda: calls.append(1) or True,
+            cache_seconds=1000)
+        labels_a = {GKE_NODEPOOL_LABEL: "p1", GKE_TPU_TOPOLOGY_LABEL: "2x2"}
+        labels_b = {GKE_NODEPOOL_LABEL: "p2", GKE_TPU_TOPOLOGY_LABEL: "2x2"}
+        na = Node(metadata=ObjectMeta(name="a", labels=labels_a))
+        nb = Node(metadata=ObjectMeta(name="b", labels=labels_b))
+        na2 = Node(metadata=ObjectMeta(name="a2", labels=labels_a))
+        v(na)
+        v(na2)  # same slice: cached
+        v(nb)   # different slice: fresh probe
+        assert len(calls) == 2, calls
+
+    def test_topology_probe_bad_string(self):
+        import pytest as _pytest
+
+        from tpu_operator_libs.health.ici_probe import fabric_probe_topology
+        with _pytest.raises(ValueError):
+            fabric_probe_topology("banana")
+
+    def test_validator_uses_topology_label(self):
+        from tpu_operator_libs.consts import GKE_TPU_TOPOLOGY_LABEL
+        from tpu_operator_libs.health.ici_probe import ICIFabricValidator
+        from tpu_operator_libs.k8s.objects import Node, ObjectMeta
+
+        node = Node(metadata=ObjectMeta(
+            name="n", labels={GKE_TPU_TOPOLOGY_LABEL: "2x2"}))
+        validator = ICIFabricValidator(cache_seconds=0)
+        assert validator(node) is True
+
     def test_validator_caches(self):
         from tpu_operator_libs.health.ici_probe import ICIFabricValidator
         calls = {"n": 0}

@@ -16,6 +16,7 @@ from tpu_operator_libs.health.ici_probe import (  # noqa: F401
     FabricProbeResult,
     ICIFabricValidator,
     fabric_probe,
+    fabric_probe_topology,
     make_mesh,
     single_chip_probe,
 )

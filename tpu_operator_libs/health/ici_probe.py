@@ -166,6 +166,82 @@ def single_chip_probe():
     return probe_step, (x, w)
 
 
+def fabric_probe_topology(topology: str,
+                          n_devices: Optional[int] = None,
+                          tolerance: float = 1e-3,
+                          max_rings_per_axis: int = 4) -> list[FabricProbeResult]:
+    """Probe every axis of a multi-dimensional ICI torus.
+
+    TPU slices are 2-D/3-D tori (GKE exposes the shape via the
+    ``cloud.google.com/gke-tpu-topology`` label, e.g. ``4x4`` for a v5e-16
+    slice or ``4x4x8`` for v5p). A link can be healthy on one axis and
+    broken on another, so the device array is reshaped to ``dims`` and,
+    per axis, the *strided* rings along that axis (all other coordinates
+    fixed) are each probed with the psum/ppermute/reduce-scatter battery.
+    For dims (4,4), axis 0's rings are devices [0,4,8,12], [1,5,9,13], …
+    — the column links a contiguous grouping would never touch.
+
+    Probe cost is bounded at ``max_rings_per_axis`` rings per axis (the
+    skipped count is logged — partial coverage is never silent). Uses as
+    many local devices as the topology requires; with fewer (e.g. CI's
+    virtual CPU mesh) the dims are scaled down while keeping the rank.
+    """
+    import jax
+
+    from tpu_operator_libs.topology.slice_topology import parse_chip_topology
+
+    dims = parse_chip_topology(topology)
+    if dims is None:
+        raise ValueError(f"unparseable TPU topology {topology!r}")
+    devices = jax.devices()
+    if n_devices is not None:
+        devices = devices[:n_devices]
+    available = len(devices)
+    need = 1
+    for d in dims:
+        need *= d
+    while need > available:
+        # scale the largest axis down by 2 until the shape fits locally
+        dims = tuple(sorted(dims, reverse=True))
+        if dims[0] == 1:
+            break
+        dims = (max(1, dims[0] // 2),) + dims[1:]
+        need = 1
+        for d in dims:
+            need *= d
+
+    grid = np.array(devices[:need], dtype=object).reshape(dims)
+    results = []
+    probed_rings: set[tuple[int, ...]] = set()
+    for axis, axis_len in enumerate(dims):
+        if axis_len <= 1:
+            continue
+        rings = np.moveaxis(grid, axis, -1).reshape(-1, axis_len)
+        probed_this_axis = 0
+        for ring in rings:
+            if probed_this_axis >= max_rings_per_axis:
+                break
+            ring_key = tuple(sorted(d.id for d in ring))
+            if ring_key in probed_rings:
+                continue  # identical ring already certified (square dims)
+            mesh = jax.sharding.Mesh(np.array(list(ring)), (_AXIS,))
+            results.append(fabric_probe(mesh=mesh, tolerance=tolerance))
+            probed_rings.add(ring_key)
+            probed_this_axis += 1
+        skipped = sum(
+            1 for ring in rings
+            if tuple(sorted(d.id for d in ring)) not in probed_rings)
+        if skipped > 0:
+            logger.warning(
+                "fabric probe axis %d: %d of %d rings not probed "
+                "(max_rings_per_axis=%d) — coverage is partial",
+                axis, skipped, len(rings), max_rings_per_axis)
+    if not results:
+        results.append(fabric_probe(n_devices=min(available, need),
+                                    tolerance=tolerance))
+    return results
+
+
 class ICIFabricValidator:
     """NodeValidator adapter: plugs the fabric probe into the validation
     state (ValidationManager ``extra_validator`` seam).
@@ -174,26 +250,62 @@ class ICIFabricValidator:
     validated; ``probe_runner`` is injectable so tests — and deployments
     where probing happens via a validation Job — can substitute transport.
     Results are cached for ``cache_seconds`` per slice to keep reconcile
-    loops cheap.
+    loops cheap. When the validated node carries a GKE topology label, the
+    per-axis torus battery (:func:`fabric_probe_topology`) runs instead of
+    the flat probe.
     """
 
     def __init__(self, probe_runner=None, cache_seconds: float = 300.0,
                  clock=None, tolerance: float = 1e-3) -> None:
         from tpu_operator_libs.util import Clock
 
-        self._probe = probe_runner or (
-            lambda: fabric_probe(tolerance=tolerance))
+        self._probe = probe_runner
+        self._tolerance = tolerance
         self._cache_seconds = cache_seconds
         self._clock = clock or Clock()
-        self._cached: Optional[tuple[float, bool]] = None
+        # Keyed per slice/topology: one validator instance serves the whole
+        # fleet (examples/libtpu_operator.py), and a cached result for
+        # slice A must never be served for slice B.
+        self._cached: dict[object, tuple[float, bool]] = {}
+
+    @staticmethod
+    def _cache_key(node) -> object:
+        from tpu_operator_libs.consts import GKE_TPU_TOPOLOGY_LABEL
+        from tpu_operator_libs.topology.slice_topology import (
+            slice_id_for_node,
+        )
+
+        if node is None:
+            return None
+        labels = getattr(node.metadata, "labels", {})
+        return (slice_id_for_node(node),
+                labels.get(GKE_TPU_TOPOLOGY_LABEL, ""))
+
+    def _default_probe(self, node) -> bool:
+        from tpu_operator_libs.consts import GKE_TPU_TOPOLOGY_LABEL
+
+        topology = ""
+        if node is not None:
+            topology = getattr(node.metadata, "labels", {}).get(
+                GKE_TPU_TOPOLOGY_LABEL, "")
+        if topology:
+            results = fabric_probe_topology(topology,
+                                            tolerance=self._tolerance)
+            return all(r.healthy for r in results)
+        return fabric_probe(tolerance=self._tolerance).healthy
 
     def __call__(self, node) -> bool:
         now = self._clock.now()
-        if self._cached is not None:
-            ts, healthy = self._cached
+        key = self._cache_key(node)
+        cached = self._cached.get(key)
+        if cached is not None:
+            ts, healthy = cached
             if now - ts < self._cache_seconds:
                 return healthy
-        result = self._probe()
-        healthy = bool(getattr(result, "healthy", result))
-        self._cached = (now, healthy)
+        if self._probe is not None:
+            result = self._probe()
+            healthy = bool(getattr(result, "healthy", result))
+        else:
+            healthy = self._default_probe(node)
+        self._cached[key] = (now, healthy)
         return healthy

@@ -146,6 +146,10 @@ class MockValidationManager(RecordingMixin):
         self.record("validate", node.metadata.name)
         return self.result
 
+    def check(self, node: Node) -> bool:
+        self.record("check", node.metadata.name)
+        return self.result
+
 
 class MockSafeLoadManager(RecordingMixin):
     def __init__(self, keys: Optional[UpgradeKeys] = None) -> None:

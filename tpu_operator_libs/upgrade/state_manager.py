@@ -494,10 +494,29 @@ class ClusterUpgradeStateManager:
 
     def process_upgrade_failed_nodes(self, state: ClusterUpgradeState) -> None:
         """Auto-recover failed nodes whose pod became healthy
-        (upgrade_state.go:835-877)."""
+        (upgrade_state.go:835-877).
+
+        Deliberate delta from the reference: when validation is enabled,
+        recovery also requires the validation gate to pass. The reference
+        recovers on pod-readiness alone, which lets a node that *failed
+        validation* (e.g. validation timeout with a degraded ICI fabric)
+        slip back into service the moment its runtime pod is Ready —
+        bypassing the very gate that failed it. Pod-level failures recover
+        exactly as before; gate-level failures stay failed until the gate
+        passes.
+        """
         for ns in state.bucket(UpgradeState.FAILED):
-            if self._is_runtime_pod_in_sync(ns):
-                self._update_node_to_uncordon_or_done(ns.node)
+            if not self._is_runtime_pod_in_sync(ns):
+                continue
+            # check(), not validate(): the recovery gate must not stamp or
+            # expire validation timers on an already-failed node.
+            if self._validation_enabled \
+                    and not self.validation_manager.check(ns.node):
+                logger.info("failed node %s has a healthy pod but has not "
+                            "passed validation; holding",
+                            ns.node.metadata.name)
+                continue
+            self._update_node_to_uncordon_or_done(ns.node)
 
     def process_validation_required_nodes(
             self, state: ClusterUpgradeState) -> None:

@@ -93,6 +93,30 @@ class ValidationManager:
             node, self._keys.validation_start_annotation, None)
         return True
 
+    def check(self, node: Node) -> bool:
+        """Side-effect-free variant of :meth:`validate`: runs the same pod
+        and extra-validator gates but never stamps/advances the timeout
+        state machine. Used by failed-node recovery, which must consult
+        the gate repeatedly without churning annotations or re-marking an
+        already-failed node."""
+        if not self._pod_selector and self._extra_validator is None:
+            return True
+        if self._pod_selector:
+            pods = self._client.list_pods(
+                namespace=None, label_selector=self._pod_selector,
+                field_selector=f"spec.nodeName={node.metadata.name}")
+            if not pods or any(not pod.is_ready() for pod in pods):
+                return False
+        if self._extra_validator is not None:
+            try:
+                if not self._extra_validator(node):
+                    return False
+            except Exception as exc:  # noqa: BLE001 — gate boundary
+                logger.warning("extra validator raised on node %s: %s",
+                               node.metadata.name, exc)
+                return False
+        return True
+
     def _handle_timeout(self, node: Node) -> None:
         """Start or check the validation timer (validation_manager.go:
         139-175): first failure stamps the start time; expiry marks the node
