@@ -124,10 +124,14 @@ def build_manager(args, cluster, clock=None,
     return mgr
 
 
+def parse_runtime_labels(args) -> dict[str, str]:
+    return dict(kv.split("=", 1)
+                for kv in args.runtime_labels.split(",") if kv)
+
+
 def reconcile_forever(mgr, args, policy, registry, stop: threading.Event,
                       step_hook=None) -> None:
-    runtime_labels = dict(kv.split("=", 1)
-                          for kv in args.runtime_labels.split(","))
+    runtime_labels = parse_runtime_labels(args)
     while not stop.is_set():
         started = time.monotonic()
         try:
@@ -151,6 +155,45 @@ def reconcile_forever(mgr, args, policy, registry, stop: threading.Event,
             if step_hook():
                 return
         stop.wait(args.interval)
+
+
+def reconcile_watch_driven(mgr, args, policy, registry, stop, cluster) -> None:
+    """Event-driven reconcile: Node/Pod/DaemonSet watch events enqueue
+    work, coalesced by the controller's work queue; ``--interval`` becomes
+    the resync safety net instead of the polling cadence."""
+    from tpu_operator_libs.controller import Controller
+    from tpu_operator_libs.metrics import observe_cluster_state as observe
+
+    runtime_labels = parse_runtime_labels(args)
+
+    def reconcile(_key):
+        started = time.monotonic()
+        try:
+            state = mgr.build_state(args.namespace, runtime_labels)
+            mgr.apply_state(state, policy)
+            observe(registry, mgr, state, driver=args.driver)
+            logger.info(
+                "reconciled: %d/%d done, %d in progress, %d failed",
+                mgr.get_upgrades_done(state),
+                mgr.get_total_managed_nodes(state),
+                mgr.get_upgrades_in_progress(state),
+                mgr.get_upgrades_failed(state))
+        except BuildStateError as exc:
+            logger.info("snapshot incomplete (%s); retrying", exc)
+        finally:
+            registry.set_gauge("reconcile_duration_seconds",
+                               time.monotonic() - started,
+                               "Duration of the last reconcile pass",
+                               {"driver": args.driver})
+        return None
+
+    ctrl = Controller(reconcile, resync_period=args.interval)
+    ctrl.watch(cluster.watch(namespace=args.namespace))
+    ctrl.start()
+    try:
+        stop.wait()
+    finally:
+        ctrl.stop()
 
 
 def run_demo(args, registry) -> int:
@@ -220,6 +263,9 @@ def main() -> int:
                         help="gate validation on the local ICI fabric probe")
     parser.add_argument("--kubeconfig", action="store_true",
                         help="connect via local kubeconfig (else in-cluster)")
+    parser.add_argument("--poll", action="store_true",
+                        help="fixed-interval polling instead of the "
+                             "default watch-driven reconcile loop")
     parser.add_argument("--demo", action="store_true",
                         help="run against a simulated fleet")
     parser.add_argument("--demo-slices", type=int, default=4)
@@ -245,7 +291,11 @@ def main() -> int:
         stop = threading.Event()
         signal.signal(signal.SIGTERM, lambda *a: stop.set())
         signal.signal(signal.SIGINT, lambda *a: stop.set())
-        reconcile_forever(mgr, args, policy, registry, stop)
+        if args.poll:
+            reconcile_forever(mgr, args, policy, registry, stop)
+        else:
+            reconcile_watch_driven(mgr, args, policy, registry, stop,
+                                   cluster)
         return 0
     finally:
         if server is not None:

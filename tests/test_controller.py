@@ -1,0 +1,434 @@
+"""Controller runtime: rate limiter, work queue, informer, watch-driven
+reconcile loop (tpu_operator_libs.controller + k8s.watch).
+
+The reference inherits all of this from controller-runtime (SURVEY.md §1
+L0); these tests pin the client-go contracts we re-implement: coalescing
+work queue, dirty-while-processing requeue, informer cache sync, and an
+event-driven end-to-end rolling upgrade with no polling loop.
+"""
+
+import threading
+import time
+
+import pytest
+
+from tpu_operator_libs.api.upgrade_policy import UpgradePolicySpec
+from tpu_operator_libs.consts import UpgradeState
+from tpu_operator_libs.controller import (
+    CLUSTER_KEY,
+    Controller,
+    ExponentialBackoffRateLimiter,
+    Informer,
+    ReconcileResult,
+    WorkQueue,
+)
+from tpu_operator_libs.k8s.fake import FakeCluster
+from tpu_operator_libs.k8s.watch import (
+    ADDED,
+    DELETED,
+    KIND_DAEMON_SET,
+    KIND_NODE,
+    KIND_POD,
+    MODIFIED,
+)
+from tpu_operator_libs.simulate import (
+    NS,
+    RUNTIME_LABELS,
+    FleetSpec,
+    build_fleet,
+)
+from tpu_operator_libs.upgrade.state_manager import ClusterUpgradeStateManager
+
+from builders import NodeBuilder, PodBuilder
+
+
+class TestRateLimiter:
+    def test_exponential_growth_and_cap(self):
+        rl = ExponentialBackoffRateLimiter(base=0.01, max_delay=0.05)
+        delays = [rl.when("k") for _ in range(5)]
+        assert delays[0] == pytest.approx(0.01)
+        assert delays[1] == pytest.approx(0.02)
+        assert delays[2] == pytest.approx(0.04)
+        assert delays[3] == pytest.approx(0.05)  # capped
+        assert delays[4] == pytest.approx(0.05)
+
+    def test_forget_resets(self):
+        rl = ExponentialBackoffRateLimiter(base=0.01)
+        rl.when("k")
+        rl.when("k")
+        assert rl.retries("k") == 2
+        rl.forget("k")
+        assert rl.retries("k") == 0
+        assert rl.when("k") == pytest.approx(0.01)
+
+    def test_keys_independent(self):
+        rl = ExponentialBackoffRateLimiter(base=0.01)
+        rl.when("a")
+        rl.when("a")
+        assert rl.when("b") == pytest.approx(0.01)
+
+
+class TestWorkQueue:
+    def test_coalesces_duplicate_adds(self):
+        q = WorkQueue()
+        q.add("k")
+        q.add("k")
+        q.add("k")
+        assert q.get(timeout=0.1) == "k"
+        q.done("k")
+        assert q.get(timeout=0.05) is None
+
+    def test_add_while_processing_requeues_on_done(self):
+        q = WorkQueue()
+        q.add("k")
+        assert q.get(timeout=0.1) == "k"
+        q.add("k")  # arrives mid-processing: must not be lost
+        assert q.get(timeout=0.05) is None  # but also not processed concurrently
+        q.done("k")
+        assert q.get(timeout=0.1) == "k"
+
+    def test_add_after_delays_delivery(self):
+        q = WorkQueue()
+        q.add_after("k", 0.08)
+        start = time.monotonic()
+        assert q.get(timeout=1.0) == "k"
+        assert time.monotonic() - start >= 0.07
+
+    def test_add_after_zero_is_immediate(self):
+        q = WorkQueue()
+        q.add_after("k", 0.0)
+        assert q.get(timeout=0.1) == "k"
+
+    def test_shutdown_unblocks_get(self):
+        q = WorkQueue()
+        results = []
+        t = threading.Thread(target=lambda: results.append(q.get()))
+        t.start()
+        time.sleep(0.05)
+        q.shut_down()
+        t.join(timeout=1.0)
+        assert results == [None]
+
+    def test_fifo_across_keys(self):
+        q = WorkQueue()
+        q.add("a")
+        q.add("b")
+        assert q.get(timeout=0.1) == "a"
+        assert q.get(timeout=0.1) == "b"
+
+
+class TestFakeClusterWatch:
+    def test_node_lifecycle_events(self):
+        cluster = FakeCluster()
+        watch = cluster.watch({KIND_NODE})
+        NodeBuilder("n1").create(cluster)
+        cluster.patch_node_labels("n1", {"x": "1"})
+        cluster.set_node_unschedulable("n1", True)
+        e1 = watch.get(timeout=1.0)
+        e2 = watch.get(timeout=1.0)
+        e3 = watch.get(timeout=1.0)
+        assert (e1.type, e1.kind, e1.object.metadata.name) == (
+            ADDED, KIND_NODE, "n1")
+        assert e2.type == MODIFIED and e2.object.metadata.labels["x"] == "1"
+        assert e3.type == MODIFIED and e3.object.spec.unschedulable
+
+    def test_kind_filter_suppresses_other_kinds(self):
+        cluster = FakeCluster()
+        watch = cluster.watch({KIND_POD})
+        NodeBuilder("n1").create(cluster)
+        PodBuilder("p1", namespace="d").on_node("n1").orphaned().create(cluster)
+        event = watch.get(timeout=1.0)
+        assert event.kind == KIND_POD
+        assert watch.get(timeout=0.05) is None
+
+    def test_delete_and_evict_emit_deleted(self):
+        cluster = FakeCluster()
+        NodeBuilder("n1").create(cluster)
+        PodBuilder("p1", namespace="d").on_node("n1").orphaned().create(cluster)
+        PodBuilder("p2", namespace="d").on_node("n1").orphaned().create(cluster)
+        watch = cluster.watch({KIND_POD})
+        cluster.delete_pod("d", "p1")
+        cluster.evict_pod("d", "p2")
+        assert [watch.get(timeout=1.0).type for _ in range(2)] == [
+            DELETED, DELETED]
+
+    def test_stopped_watch_is_unsubscribed(self):
+        cluster = FakeCluster()
+        watch = cluster.watch()
+        watch.stop()
+        NodeBuilder("n1").create(cluster)
+        assert watch.get(timeout=0.05) is None
+
+    def test_namespace_filter_on_namespaced_kinds_only(self):
+        cluster = FakeCluster()
+        watch = cluster.watch(namespace="tpu-system")
+        NodeBuilder("n1").create(cluster)  # cluster-scoped: passes filter
+        PodBuilder("p1", namespace="other").on_node("n1").orphaned() \
+            .create(cluster)
+        PodBuilder("p2", namespace="tpu-system").on_node("n1").orphaned() \
+            .create(cluster)
+        e1 = watch.get(timeout=1.0)
+        e2 = watch.get(timeout=1.0)
+        assert e1.kind == KIND_NODE
+        assert (e2.kind, e2.object.metadata.name) == (KIND_POD, "p2")
+        assert watch.get(timeout=0.05) is None
+
+    def test_events_are_snapshots(self):
+        cluster = FakeCluster()
+        watch = cluster.watch({KIND_NODE})
+        NodeBuilder("n1").create(cluster)
+        event = watch.get(timeout=1.0)
+        event.object.metadata.labels["mutated"] = "yes"
+        assert "mutated" not in cluster.get_node("n1").metadata.labels
+
+
+class TestInformer:
+    def _informer(self, cluster, kinds, lister):
+        return Informer(lister, cluster.watch(kinds))
+
+    def test_initial_list_sync_fires_adds(self):
+        cluster = FakeCluster()
+        NodeBuilder("n1").create(cluster)
+        NodeBuilder("n2").create(cluster)
+        inf = self._informer(cluster, {KIND_NODE}, cluster.list_nodes)
+        added = []
+        inf.add_event_handler(on_add=lambda o: added.append(o.metadata.name))
+        inf.start()
+        assert inf.has_synced(timeout=2.0)
+        assert sorted(added) == ["n1", "n2"]
+        assert len(inf) == 2
+        inf.stop()
+
+    def test_update_handler_sees_old_and_new(self):
+        cluster = FakeCluster()
+        NodeBuilder("n1").create(cluster)
+        inf = self._informer(cluster, {KIND_NODE}, cluster.list_nodes)
+        updates = []
+        done = threading.Event()
+
+        def on_update(old, new):
+            updates.append((old.metadata.labels.get("v"),
+                            new.metadata.labels.get("v")))
+            done.set()
+
+        inf.add_event_handler(on_update=on_update)
+        inf.start()
+        assert inf.has_synced(timeout=2.0)
+        cluster.patch_node_labels("n1", {"v": "2"})
+        assert done.wait(timeout=2.0)
+        assert updates == [(None, "2")]
+        inf.stop()
+
+    def test_delete_removes_from_store(self):
+        cluster = FakeCluster()
+        NodeBuilder("n1").create(cluster)
+        PodBuilder("p1", namespace="d").on_node("n1").orphaned().create(cluster)
+        inf = self._informer(cluster, {KIND_POD},
+                             lambda: cluster.list_pods(namespace="d"))
+        deleted = threading.Event()
+        inf.add_event_handler(on_delete=lambda _o: deleted.set())
+        inf.start()
+        assert inf.has_synced(timeout=2.0)
+        cluster.delete_pod("d", "p1")
+        assert deleted.wait(timeout=2.0)
+        assert inf.get("d", "p1") is None
+        inf.stop()
+
+    def test_re_added_known_key_dispatches_update_not_add(self):
+        # A restarted server watch re-delivers the current set as ADDED;
+        # client-go converts those to updates — so must we.
+        cluster = FakeCluster()
+        NodeBuilder("n1").create(cluster)
+        inf = self._informer(cluster, {KIND_NODE}, cluster.list_nodes)
+        adds, updates = [], []
+        seen = threading.Event()
+        inf.add_event_handler(
+            on_add=lambda o: adds.append(o.metadata.name),
+            on_update=lambda _old, _new: (updates.append(1), seen.set()))
+        inf.start()
+        assert inf.has_synced(timeout=2.0)
+        # simulate the re-list: deliver ADDED for an object already cached
+        cluster._broadcaster.notify(ADDED, KIND_NODE,
+                                    cluster.get_node("n1"))
+        assert seen.wait(timeout=2.0)
+        assert adds == ["n1"] and updates == [1]
+        inf.stop()
+
+    def test_handler_exception_does_not_kill_pump(self):
+        cluster = FakeCluster()
+        inf = self._informer(cluster, {KIND_NODE}, cluster.list_nodes)
+        seen = []
+        inf.add_event_handler(on_add=lambda _o: 1 / 0)
+        inf.add_event_handler(on_add=lambda o: seen.append(o.metadata.name))
+        inf.start()
+        assert inf.has_synced(timeout=2.0)
+        NodeBuilder("n1").create(cluster)
+        NodeBuilder("n2").create(cluster)
+        deadline = time.monotonic() + 2.0
+        while len(seen) < 2 and time.monotonic() < deadline:
+            time.sleep(0.01)
+        assert sorted(seen) == ["n1", "n2"]
+        inf.stop()
+
+
+class TestController:
+    def test_event_triggers_reconcile(self):
+        cluster = FakeCluster()
+        NodeBuilder("n1").create(cluster)
+        calls = []
+        seen = threading.Event()
+
+        def reconcile(key):
+            calls.append(key)
+            seen.set()
+            return None
+
+        ctrl = Controller(reconcile)
+        ctrl.watch(cluster.watch({KIND_NODE}))
+        ctrl.start(initial_sync=False)
+        try:
+            cluster.patch_node_labels("n1", {"roll": "1"})
+            assert seen.wait(timeout=2.0)
+            assert calls[0] == CLUSTER_KEY
+        finally:
+            ctrl.stop()
+
+    def test_burst_coalesces(self):
+        cluster = FakeCluster()
+        for i in range(20):
+            NodeBuilder(f"n{i}").create(cluster)
+        gate = threading.Event()
+        entered = threading.Event()
+
+        def reconcile(key):
+            entered.set()
+            gate.wait(timeout=5.0)  # hold the reconcile open
+            return None
+
+        ctrl = Controller(reconcile)
+        ctrl.watch(cluster.watch({KIND_NODE}))
+        ctrl.start(initial_sync=False)
+        try:
+            cluster.patch_node_labels("n0", {"roll": "1"})
+            assert entered.wait(timeout=2.0)  # worker is inside reconcile
+            for i in range(1, 20):
+                cluster.patch_node_labels(f"n{i}", {"roll": "1"})
+            time.sleep(0.2)  # let all 19 burst events land in the queue
+            gate.set()
+            deadline = time.monotonic() + 2.0
+            while ctrl.reconcile_count < 2 and time.monotonic() < deadline:
+                time.sleep(0.01)
+            # 20 events while one reconcile is in flight collapse into a
+            # single follow-up pass, not 20.
+            time.sleep(0.2)
+            assert 2 <= ctrl.reconcile_count <= 3
+        finally:
+            ctrl.stop()
+
+    def test_error_backoff_then_success(self):
+        attempts = []
+        done = threading.Event()
+
+        def reconcile(key):
+            attempts.append(time.monotonic())
+            if len(attempts) < 3:
+                raise RuntimeError("transient")
+            done.set()
+            return None
+
+        ctrl = Controller(
+            reconcile,
+            rate_limiter=ExponentialBackoffRateLimiter(base=0.02))
+        ctrl.start()  # initial_sync seeds the first reconcile
+        try:
+            assert done.wait(timeout=5.0)
+            assert len(attempts) == 3
+            assert ctrl.error_count == 2
+            # second retry waited ~2x the first
+            assert attempts[2] - attempts[1] >= 0.03
+        finally:
+            ctrl.stop()
+
+    def test_requeue_after(self):
+        times = []
+        done = threading.Event()
+
+        def reconcile(key):
+            times.append(time.monotonic())
+            if len(times) == 1:
+                return ReconcileResult(requeue_after=0.08)
+            done.set()
+            return None
+
+        ctrl = Controller(reconcile)
+        ctrl.start()
+        try:
+            assert done.wait(timeout=5.0)
+            assert times[1] - times[0] >= 0.07
+        finally:
+            ctrl.stop()
+
+    def test_resync_fires_without_events(self):
+        count = threading.Semaphore(0)
+        ctrl = Controller(lambda _k: count.release() or None,
+                          resync_period=0.05)
+        ctrl.start(initial_sync=False)
+        try:
+            assert count.acquire(timeout=2.0)
+            assert count.acquire(timeout=2.0)
+        finally:
+            ctrl.stop()
+
+
+class TestWatchDrivenRollingUpgrade:
+    """The flagship: a full rolling libtpu upgrade driven purely by watch
+    events — no polling loop anywhere. Replaces the reference consumer's
+    controller-runtime wiring (SURVEY.md §1 L5)."""
+
+    def test_fleet_converges_to_done(self):
+        fleet = FleetSpec(n_slices=2, hosts_per_slice=2,
+                          pod_recreate_delay=1.0, pod_ready_delay=2.0)
+        cluster, clock, keys = build_fleet(fleet)
+        policy = UpgradePolicySpec(auto_upgrade=True, max_parallel_upgrades=0,
+                                   max_unavailable="100%")
+        mgr = ClusterUpgradeStateManager(
+            cluster, keys, None, clock, async_workers=False,
+            poll_interval=0.001)
+
+        lock = threading.Lock()
+
+        def reconcile(_key):
+            # the manager is idempotent; serialize passes like the
+            # reference's single reconcile goroutine
+            with lock:
+                mgr.reconcile(NS, RUNTIME_LABELS, policy)
+            return None
+
+        ctrl = Controller(reconcile,
+                          rate_limiter=ExponentialBackoffRateLimiter(
+                              base=0.005, max_delay=0.1))
+        ctrl.watch(cluster.watch({KIND_NODE, KIND_POD, KIND_DAEMON_SET}))
+        ctrl.start()
+        try:
+            deadline = time.monotonic() + 30.0
+            while time.monotonic() < deadline:
+                # drive the simulated kubelet/DS controller: virtual time
+                # advances, scheduled recreations/readiness fire (emitting
+                # pod events that wake the controller)
+                clock.advance(0.5)
+                cluster.step()
+                states = [n.metadata.labels.get(keys.state_label)
+                          for n in cluster.list_nodes()]
+                if all(s == UpgradeState.DONE for s in states):
+                    break
+                time.sleep(0.02)
+            states = [n.metadata.labels.get(keys.state_label)
+                      for n in cluster.list_nodes()]
+            assert all(s == UpgradeState.DONE for s in states), states
+            # every libtpu pod is on the new revision
+            for pod in cluster.list_pods(namespace=NS):
+                assert pod.metadata.labels.get(
+                    "controller-revision-hash") == "new"
+            assert ctrl.error_count == 0
+        finally:
+            ctrl.stop()

@@ -74,6 +74,16 @@ class K8sClient(abc.ABC):
         """Evict a pod via the eviction subresource (drain path). May raise
         EvictionBlockedError when a disruption budget forbids it."""
 
+    # -- watches ----------------------------------------------------------
+    def watch(self, kinds=None, namespace: Optional[str] = None):
+        """Stream change events (k8s.watch.WatchEvent) for Nodes / Pods /
+        DaemonSets, optionally filtered by kind set and (for namespaced
+        kinds) namespace. Returns a k8s.watch.Watch. Optional capability:
+        implemented by FakeCluster and RealCluster; other backends may
+        leave it unsupported and drive reconciles by polling."""
+        raise NotImplementedError(
+            f"{type(self).__name__} does not support watches")
+
     # -- daemonsets & revisions ------------------------------------------
     @abc.abstractmethod
     def list_daemon_sets(self, namespace: str,

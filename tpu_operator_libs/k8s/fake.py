@@ -53,6 +53,16 @@ from tpu_operator_libs.k8s.selectors import (
     parse_field_selector,
     parse_label_selector,
 )
+from tpu_operator_libs.k8s.watch import (
+    ADDED,
+    DELETED,
+    KIND_DAEMON_SET,
+    KIND_NODE,
+    KIND_POD,
+    MODIFIED,
+    Watch,
+    WatchBroadcaster,
+)
 from tpu_operator_libs.util import Clock
 
 
@@ -107,6 +117,27 @@ class FakeCluster(K8sClient):
         # exercise the provider's cache-sync poll loop
         # (node_upgrade_state_provider.go:100-117).
         self._stale_reads: dict[str, tuple[int, Node]] = {}
+        # Watch fan-out: every mutation below emits a typed event so
+        # informers/controllers (tpu_operator_libs.controller) can drive
+        # reconciles the way controller-runtime does for the reference.
+        self._broadcaster = WatchBroadcaster()
+
+    def watch(self, kinds: Optional[set[str]] = None,
+              namespace: Optional[str] = None) -> Watch:
+        """Subscribe to change events, optionally filtered to a kind set
+        ({"Node", "Pod", "DaemonSet"}) and — for namespaced kinds — a
+        namespace. Snapshot copies only. Signature matches
+        RealCluster.watch so consumers are backend-agnostic."""
+        predicate = None
+        if namespace:
+            def predicate(event):
+                meta = getattr(event.object, "metadata", None)
+                ns = getattr(meta, "namespace", "")
+                return not ns or ns == namespace
+        return self._broadcaster.subscribe(kinds, predicate)
+
+    def _notify(self, event_type: str, kind: str, obj) -> None:
+        self._broadcaster.notify(event_type, kind, obj.clone())
 
     # ------------------------------------------------------------------
     # test/simulation helpers
@@ -118,12 +149,14 @@ class FakeCluster(K8sClient):
     def add_node(self, node: Node) -> Node:
         with self._lock:
             self._nodes[node.metadata.name] = node.clone()
+            self._notify(ADDED, KIND_NODE, node)
         return node
 
     def add_pod(self, pod: Pod) -> Pod:
         with self._lock:
             self._pods[(pod.metadata.namespace, pod.metadata.name)] = (
                 pod.clone())
+            self._notify(ADDED, KIND_POD, pod)
         return pod
 
     @staticmethod
@@ -157,6 +190,7 @@ class FakeCluster(K8sClient):
             self._revisions[(ds.metadata.namespace, rev_name)] = rev
             self._revision_owner[(ds.metadata.namespace, rev_name)] = (
                 ds.metadata.namespace, ds.metadata.name)
+            self._notify(ADDED, KIND_DAEMON_SET, ds)
         return ds
 
     def _revisions_of(self, namespace: str, ds_name: str) -> list[ControllerRevision]:
@@ -186,6 +220,7 @@ class FakeCluster(K8sClient):
                                     labels=dict(ds.spec.selector)),
                 revision=latest + 1)
             self._revision_owner[(namespace, rev_name)] = (namespace, name)
+            self._notify(MODIFIED, KIND_DAEMON_SET, ds)
 
     def latest_revision_hash(self, namespace: str, name: str) -> str:
         with self._lock:
@@ -306,6 +341,7 @@ class FakeCluster(K8sClient):
                     node.metadata.labels.pop(key, None)
                 else:
                     node.metadata.labels[key] = value
+            self._notify(MODIFIED, KIND_NODE, node)
             return node.clone()
 
     def patch_node_annotations(self, name: str,
@@ -317,12 +353,14 @@ class FakeCluster(K8sClient):
                     node.metadata.annotations.pop(key, None)
                 else:
                     node.metadata.annotations[key] = value
+            self._notify(MODIFIED, KIND_NODE, node)
             return node.clone()
 
     def set_node_unschedulable(self, name: str, unschedulable: bool) -> Node:
         with self._lock:
             node = self._mutate_node(name)
             node.spec.unschedulable = unschedulable
+            self._notify(MODIFIED, KIND_NODE, node)
             return node.clone()
 
     def set_node_ready(self, name: str, ready: bool) -> Node:
@@ -337,6 +375,7 @@ class FakeCluster(K8sClient):
                 from tpu_operator_libs.k8s.objects import NodeCondition
                 node.status.conditions.append(
                     NodeCondition("Ready", "True" if ready else "False"))
+            self._notify(MODIFIED, KIND_NODE, node)
             return node.clone()
 
     # ------------------------------------------------------------------
@@ -390,6 +429,7 @@ class FakeCluster(K8sClient):
                 for c in pod.status.container_statuses:
                     c.restart_count = restart_count
             pod.metadata.resource_version += 1
+            self._notify(MODIFIED, KIND_POD, pod)
             return pod.clone()
 
     def delete_pod(self, namespace: str, name: str) -> None:
@@ -397,6 +437,7 @@ class FakeCluster(K8sClient):
             pod = self._pods.pop((namespace, name), None)
             if pod is None:
                 raise NotFoundError(f"pod {namespace}/{name} not found")
+            self._notify(DELETED, KIND_POD, pod)
             self._maybe_recreate_ds_pod(pod)
 
     def evict_pod(self, namespace: str, name: str) -> None:
@@ -410,6 +451,7 @@ class FakeCluster(K8sClient):
                         f"eviction of {namespace}/{name} blocked by "
                         f"disruption budget")
             del self._pods[(namespace, name)]
+            self._notify(DELETED, KIND_POD, pod)
             self._maybe_recreate_ds_pod(pod)
 
     def _maybe_recreate_ds_pod(self, pod: Pod) -> None:
@@ -450,6 +492,7 @@ class FakeCluster(K8sClient):
                         container_statuses=[
                             ContainerStatus(name="runtime", ready=False)]))
                 self._pods[(namespace, pod_name)] = new_pod
+                self._notify(ADDED, KIND_POD, new_pod)
 
                 def make_ready(due: float) -> None:
                     with self._lock:
@@ -468,6 +511,7 @@ class FakeCluster(K8sClient):
                                 c.ready = False
                                 c.restart_count = max(c.restart_count, 11)
                             p.metadata.resource_version += 1
+                            self._notify(MODIFIED, KIND_POD, p)
                             retry_due = due + 5.0
                             self.schedule_at(
                                 retry_due, lambda: make_ready(retry_due))
@@ -476,6 +520,7 @@ class FakeCluster(K8sClient):
                             c.ready = True
                             c.restart_count = 0
                         p.metadata.resource_version += 1
+                        self._notify(MODIFIED, KIND_POD, p)
 
                 # Anchor readiness to the recreation's due time, not to
                 # whenever step() happened to execute the action, so coarse

@@ -62,21 +62,43 @@ class ValidationManager:
         node to upgrade-failed.
         """
         if not self._pod_selector and self._extra_validator is None:
-            return True
+            return True  # trivially valid, no annotation traffic (:72-74)
 
+        failure = self._gate_failure(node)
+        if failure is None:
+            # Validation complete: clear the timeout stamp.
+            self._provider.change_node_upgrade_annotation(
+                node, self._keys.validation_start_annotation, None)
+            return True
+        if failure == "no-pods":
+            # Missing validation pods never start the timer (matches the
+            # reference's bare return at validation_manager.go:98-103).
+            logger.warning("no validation pods found on node %s",
+                           node.metadata.name)
+            return False
+        self._handle_timeout(node)
+        return False
+
+    def check(self, node: Node) -> bool:
+        """Side-effect-free variant of :meth:`validate`: runs the same
+        gates but never stamps/advances the timeout state machine. Used by
+        failed-node recovery, which must consult the gate repeatedly
+        without churning annotations or re-marking an already-failed
+        node."""
+        return self._gate_failure(node) is None
+
+    def _gate_failure(self, node: Node) -> Optional[str]:
+        """Evaluate both gates without side effects. Returns None when the
+        node passes, else why it failed: "no-pods" (selector matched
+        nothing), "pod-not-ready", or "extra-validator"."""
         if self._pod_selector:
             pods = self._client.list_pods(
                 namespace=None, label_selector=self._pod_selector,
                 field_selector=f"spec.nodeName={node.metadata.name}")
             if not pods:
-                logger.warning("no validation pods found on node %s",
-                               node.metadata.name)
-                return False
-            for pod in pods:
-                if not pod.is_ready():
-                    self._handle_timeout(node)
-                    return False
-
+                return "no-pods"
+            if any(not pod.is_ready() for pod in pods):
+                return "pod-not-ready"
         if self._extra_validator is not None:
             try:
                 healthy = self._extra_validator(node)
@@ -85,37 +107,8 @@ class ValidationManager:
                                node.metadata.name, exc)
                 healthy = False
             if not healthy:
-                self._handle_timeout(node)
-                return False
-
-        # Validation complete: clear the timeout stamp.
-        self._provider.change_node_upgrade_annotation(
-            node, self._keys.validation_start_annotation, None)
-        return True
-
-    def check(self, node: Node) -> bool:
-        """Side-effect-free variant of :meth:`validate`: runs the same pod
-        and extra-validator gates but never stamps/advances the timeout
-        state machine. Used by failed-node recovery, which must consult
-        the gate repeatedly without churning annotations or re-marking an
-        already-failed node."""
-        if not self._pod_selector and self._extra_validator is None:
-            return True
-        if self._pod_selector:
-            pods = self._client.list_pods(
-                namespace=None, label_selector=self._pod_selector,
-                field_selector=f"spec.nodeName={node.metadata.name}")
-            if not pods or any(not pod.is_ready() for pod in pods):
-                return False
-        if self._extra_validator is not None:
-            try:
-                if not self._extra_validator(node):
-                    return False
-            except Exception as exc:  # noqa: BLE001 — gate boundary
-                logger.warning("extra validator raised on node %s: %s",
-                               node.metadata.name, exc)
-                return False
-        return True
+                return "extra-validator"
+        return None
 
     def _handle_timeout(self, node: Node) -> None:
         """Start or check the validation timer (validation_manager.go:
