@@ -129,28 +129,37 @@ def parse_runtime_labels(args) -> dict[str, str]:
                 for kv in args.runtime_labels.split(",") if kv)
 
 
-def reconcile_forever(mgr, args, policy, registry, stop: threading.Event,
-                      step_hook=None) -> None:
-    runtime_labels = parse_runtime_labels(args)
-    while not stop.is_set():
-        started = time.monotonic()
-        try:
-            state = mgr.build_state(args.namespace, runtime_labels)
-            mgr.apply_state(state, policy)
-            observe_cluster_state(registry, mgr, state, driver=args.driver)
-            done = mgr.get_upgrades_done(state)
-            total = mgr.get_total_managed_nodes(state)
-            logger.info("reconciled: %d/%d done, %d in progress, %d failed",
-                        done, total, mgr.get_upgrades_in_progress(state),
-                        mgr.get_upgrades_failed(state))
-        except BuildStateError as exc:
-            logger.info("snapshot incomplete (%s); retrying", exc)
-        except Exception:
-            logger.exception("reconcile failed; retrying")
+def reconcile_once(mgr, args, policy, registry, runtime_labels) -> None:
+    """One build_state+apply_state pass with metrics/logging; shared by
+    the polling and watch-driven loops. BuildStateError (incomplete
+    snapshot) is retryable and only logged."""
+    started = time.monotonic()
+    try:
+        state = mgr.build_state(args.namespace, runtime_labels)
+        mgr.apply_state(state, policy)
+        observe_cluster_state(registry, mgr, state, driver=args.driver)
+        logger.info("reconciled: %d/%d done, %d in progress, %d failed",
+                    mgr.get_upgrades_done(state),
+                    mgr.get_total_managed_nodes(state),
+                    mgr.get_upgrades_in_progress(state),
+                    mgr.get_upgrades_failed(state))
+    except BuildStateError as exc:
+        logger.info("snapshot incomplete (%s); retrying", exc)
+    finally:
         registry.set_gauge("reconcile_duration_seconds",
                            time.monotonic() - started,
                            "Duration of the last reconcile pass",
                            {"driver": args.driver})
+
+
+def reconcile_forever(mgr, args, policy, registry, stop: threading.Event,
+                      step_hook=None) -> None:
+    runtime_labels = parse_runtime_labels(args)
+    while not stop.is_set():
+        try:
+            reconcile_once(mgr, args, policy, registry, runtime_labels)
+        except Exception:
+            logger.exception("reconcile failed; retrying")
         if step_hook is not None:
             if step_hook():
                 return
@@ -162,29 +171,11 @@ def reconcile_watch_driven(mgr, args, policy, registry, stop, cluster) -> None:
     work, coalesced by the controller's work queue; ``--interval`` becomes
     the resync safety net instead of the polling cadence."""
     from tpu_operator_libs.controller import Controller
-    from tpu_operator_libs.metrics import observe_cluster_state as observe
 
     runtime_labels = parse_runtime_labels(args)
 
     def reconcile(_key):
-        started = time.monotonic()
-        try:
-            state = mgr.build_state(args.namespace, runtime_labels)
-            mgr.apply_state(state, policy)
-            observe(registry, mgr, state, driver=args.driver)
-            logger.info(
-                "reconciled: %d/%d done, %d in progress, %d failed",
-                mgr.get_upgrades_done(state),
-                mgr.get_total_managed_nodes(state),
-                mgr.get_upgrades_in_progress(state),
-                mgr.get_upgrades_failed(state))
-        except BuildStateError as exc:
-            logger.info("snapshot incomplete (%s); retrying", exc)
-        finally:
-            registry.set_gauge("reconcile_duration_seconds",
-                               time.monotonic() - started,
-                               "Duration of the last reconcile pass",
-                               {"driver": args.driver})
+        reconcile_once(mgr, args, policy, registry, runtime_labels)
         return None
 
     ctrl = Controller(reconcile, resync_period=args.interval)

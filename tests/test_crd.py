@@ -1,0 +1,197 @@
+"""CRD generation + structural defaulting/validation (api/crd.py).
+
+The load-bearing property: schema defaults must agree with
+``from_dict`` defaulting (the reference gets this for free because
+kubebuilder markers and Go zero-values live on the same struct; here two
+artifacts must be pinned together)."""
+
+import pytest
+
+from tpu_operator_libs.api.crd import (
+    apply_defaults,
+    build_crd,
+    render_yaml,
+    unified_policy_schema,
+    upgrade_policy_schema,
+    validate_against_schema,
+)
+from tpu_operator_libs.api.unified_policy import UnifiedUpgradePolicySpec
+from tpu_operator_libs.api.upgrade_policy import (
+    DrainSpec,
+    PodDeletionSpec,
+    PolicyValidationError,
+    UpgradePolicySpec,
+    WaitForCompletionSpec,
+)
+
+
+class TestSchemaDefaultsMatchFromDict:
+    """Defaulting an empty document through the schema must produce the
+    same policy as from_dict({}) — admission-time and library-time
+    defaults may never diverge."""
+
+    def test_top_level(self):
+        defaulted = apply_defaults({}, upgrade_policy_schema())
+        spec = UpgradePolicySpec.from_dict(defaulted)
+        assert spec == UpgradePolicySpec.from_dict({})
+        assert defaulted["autoUpgrade"] is False
+        assert defaulted["maxParallelUpgrades"] == 1
+        assert defaulted["maxUnavailable"] == "25%"
+        assert defaulted["topologyMode"] == "flat"
+
+    def test_absent_subobjects_stay_absent(self):
+        # nil sub-specs in the reference stay nil; defaults must not
+        # materialize podDeletion/drain/waitForCompletion out of nothing
+        defaulted = apply_defaults({}, upgrade_policy_schema())
+        assert "podDeletion" not in defaulted
+        assert "drain" not in defaulted
+        assert "waitForCompletion" not in defaulted
+
+    @pytest.mark.parametrize("key,spec_cls", [
+        ("podDeletion", PodDeletionSpec),
+        ("drain", DrainSpec),
+        ("waitForCompletion", WaitForCompletionSpec),
+    ])
+    def test_subobject_defaults(self, key, spec_cls):
+        defaulted = apply_defaults({key: {}}, upgrade_policy_schema())
+        assert spec_cls.from_dict(defaulted[key]) == spec_cls.from_dict({})
+        assert spec_cls.from_dict(defaulted[key]) == spec_cls()
+
+    def test_existing_values_not_overwritten(self):
+        data = {"maxParallelUpgrades": 7,
+                "drain": {"enable": True, "timeoutSeconds": 10}}
+        defaulted = apply_defaults(data, upgrade_policy_schema())
+        assert defaulted["maxParallelUpgrades"] == 7
+        assert defaulted["drain"]["enable"] is True
+        assert defaulted["drain"]["timeoutSeconds"] == 10
+        assert defaulted["drain"]["force"] is False  # filled in
+
+    def test_round_trips_spec_to_dict(self):
+        spec = UpgradePolicySpec(
+            auto_upgrade=True, max_parallel_upgrades=3,
+            max_unavailable=5, topology_mode="slice",
+            drain=DrainSpec(enable=True),
+            pod_deletion=PodDeletionSpec(force=True),
+            wait_for_completion=WaitForCompletionSpec(
+                pod_selector="job=llm", timeout_seconds=60))
+        doc = spec.to_dict()
+        validate_against_schema(doc, upgrade_policy_schema())
+        assert UpgradePolicySpec.from_dict(
+            apply_defaults(doc, upgrade_policy_schema())) == spec
+
+
+class TestValidation:
+    def test_accepts_reference_policy_yaml_shape(self):
+        # the policy example from docs/automatic-ofed-upgrade.md:11-39
+        doc = {
+            "autoUpgrade": True,
+            "maxParallelUpgrades": 1,
+            "maxUnavailable": "25%",
+            "waitForCompletion": {"podSelector": "app=myapp",
+                                  "timeoutSeconds": 300},
+            "drain": {"enable": True, "force": False,
+                      "podSelector": "", "timeoutSeconds": 300,
+                      "deleteEmptyDir": False},
+        }
+        validate_against_schema(doc, upgrade_policy_schema())
+
+    @pytest.mark.parametrize("doc,fragment", [
+        ({"maxParallelUpgrades": -1}, "minimum"),
+        ({"drain": {"timeoutSeconds": -5}}, "minimum"),
+        ({"topologyMode": "ring"}, "not one of"),
+        ({"autoUpgrade": "yes"}, "expected boolean"),
+        ({"maxParallelUpgrades": True}, "expected integer"),
+        ({"maxUnavailable": {"percent": 25}}, "expected integer or string"),
+        ({"drain": []}, "expected object"),
+    ])
+    def test_rejects(self, doc, fragment):
+        with pytest.raises(PolicyValidationError) as err:
+            validate_against_schema(doc, upgrade_policy_schema())
+        assert fragment in str(err.value)
+
+    def test_error_path_names_offending_field(self):
+        with pytest.raises(PolicyValidationError) as err:
+            validate_against_schema(
+                {"drain": {"timeoutSeconds": -5}}, upgrade_policy_schema())
+        assert "spec.drain.timeoutSeconds" in str(err.value)
+
+    def test_unknown_fields_tolerated(self):
+        # the server prunes unknown fields rather than rejecting
+        validate_against_schema({"futureKnob": 1}, upgrade_policy_schema())
+
+
+class TestUnifiedSchema:
+    def test_round_trip_and_required(self):
+        doc = {"accelerators": {
+            "tpu": {"driver": "libtpu", "domain": "google.com",
+                    "runtimeLabels": {"app": "libtpu"},
+                    "policy": {"autoUpgrade": True,
+                               "topologyMode": "slice"}},
+            "gpu": {"driver": "gpu", "domain": "nvidia.com",
+                    "runtimeLabels": {"app": "nvidia-driver"}},
+        }}
+        schema = unified_policy_schema()
+        validate_against_schema(doc, schema)
+        defaulted = apply_defaults(doc, schema)
+        assert defaulted["accelerators"]["gpu"]["namespace"] == "kube-system"
+        unified = UnifiedUpgradePolicySpec.from_dict(defaulted)
+        unified.validate()
+        assert unified.accelerators["tpu"].policy.topology_mode == "slice"
+
+    def test_missing_required_domain_rejected(self):
+        with pytest.raises(PolicyValidationError) as err:
+            validate_against_schema(
+                {"accelerators": {"tpu": {"runtimeLabels": {"a": "b"}}}},
+                unified_policy_schema())
+        assert "domain" in str(err.value)
+
+
+class TestCrdManifest:
+    def test_structure(self):
+        crd = build_crd()
+        assert crd["apiVersion"] == "apiextensions.k8s.io/v1"
+        assert crd["kind"] == "CustomResourceDefinition"
+        assert crd["metadata"]["name"] == \
+            "tpuupgradepolicies.tpu-operator.dev"
+        names = crd["spec"]["names"]
+        assert names["kind"] == "TPUUpgradePolicy"
+        assert names["plural"] == "tpuupgradepolicies"
+        version = crd["spec"]["versions"][0]
+        assert version["served"] and version["storage"]
+        schema = version["schema"]["openAPIV3Schema"]
+        assert schema["properties"]["spec"]["properties"][
+            "maxUnavailable"]["x-kubernetes-int-or-string"] is True
+
+    def test_renders_as_yaml(self):
+        text = render_yaml(build_crd())
+        assert "openAPIV3Schema" in text
+        try:
+            import yaml
+        except ImportError:
+            return
+        parsed = yaml.safe_load(text)
+        assert parsed == build_crd()
+
+    def test_generated_examples_in_sync(self):
+        """examples/crd/*.yaml must match what the generator emits now
+        (the repo's analogue of the reference's `make generate` drift
+        check, ci.yaml:44-53)."""
+        import os
+
+        yaml = pytest.importorskip(
+            "yaml", reason="drift check compares parsed structures")
+        root = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+        expected = {
+            "tpuupgradepolicy.yaml": build_crd(),
+            "unifiedupgradepolicy.yaml": build_crd(
+                kind="UnifiedUpgradePolicy",
+                spec_schema=unified_policy_schema()),
+        }
+        for name, manifest in expected.items():
+            path = os.path.join(root, "examples", "crd", name)
+            assert os.path.exists(path), (
+                f"{path} missing; run python -m tpu_operator_libs.api.crd")
+            with open(path) as f:
+                assert yaml.safe_load(f) == manifest, (
+                    f"{name} out of date; "
+                    f"run python -m tpu_operator_libs.api.crd")

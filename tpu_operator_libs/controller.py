@@ -214,14 +214,41 @@ class Informer:
         self._thread.start()
 
     def _run(self) -> None:
-        for obj in self._lister():
-            key = self._key_fn(obj)
+        # The initial list retries with backoff like a client-go informer:
+        # one transient API error at startup must not leave the cache
+        # permanently empty with has_synced() never firing.
+        backoff = 0.5
+        while not self._watch.stopped:
+            try:
+                objects = self._lister()
+                break
+            except Exception:
+                logger.exception("%s: initial list failed; retrying in "
+                                 "%.1fs", self._name, backoff)
+                if self._watch.stopped:
+                    return
+                time.sleep(backoff)
+                backoff = min(backoff * 2, 30.0)
+        else:
+            return
+        for obj in objects:
+            try:
+                key = self._key_fn(obj)
+            except Exception:
+                logger.exception("%s: key function failed on listed object",
+                                 self._name)
+                continue
             with self._store_lock:
                 self._store[key] = obj
             self._dispatch_add(obj)
         self._synced.set()
         for event in self._watch:
-            self._apply(event)
+            try:
+                self._apply(event)
+            except Exception:
+                # one malformed event must not freeze the cache forever
+                logger.exception("%s: failed to apply watch event",
+                                 self._name)
 
     def _apply(self, event: WatchEvent) -> None:
         obj = event.object
@@ -296,8 +323,8 @@ class Controller:
     ``reconcile(key)``; an exception or ``ReconcileResult(requeue=True)``
     re-enqueues with exponential backoff, ``requeue_after`` re-enqueues
     after a fixed delay, success forgets the backoff. ``resync_period``
-    enqueues unconditionally on a timer — the safety net for missed
-    events, mirroring controller-runtime's SyncPeriod.
+    re-enqueues every key seen so far on a timer — the safety net for
+    missed events, mirroring controller-runtime's SyncPeriod.
     """
 
     def __init__(self, reconcile: Callable[[str], Optional[ReconcileResult]],
@@ -318,6 +345,16 @@ class Controller:
         self._reconcile_count = 0
         self._error_count = 0
         self._count_lock = threading.Lock()
+        # Every key ever enqueued; the resync timer re-enqueues all of
+        # them (not just CLUSTER_KEY) so controllers with per-object
+        # key functions also get the missed-event safety net.
+        self._known_keys: set[str] = set()
+        self._known_lock = threading.Lock()
+
+    def _enqueue(self, key: str) -> None:
+        with self._known_lock:
+            self._known_keys.add(key)
+        self.queue.add(key)
 
     # -- wiring ----------------------------------------------------------
     def watch(self, watch: Watch,
@@ -338,7 +375,7 @@ class Controller:
         if self._threads:
             raise RuntimeError("controller already started")
         if initial_sync:
-            self.queue.add(CLUSTER_KEY)
+            self._enqueue(CLUSTER_KEY)
         for i, (watch, key_fn) in enumerate(self._watches):
             t = threading.Thread(target=self._pump, args=(watch, key_fn),
                                  name=f"{self._name}-watch-{i}", daemon=True)
@@ -389,7 +426,7 @@ class Controller:
                 logger.exception("watch key function failed")
                 continue
             if key is not None:
-                self.queue.add(key)
+                self._enqueue(key)
 
     def _worker(self) -> None:
         while not self._stop.is_set():
@@ -421,4 +458,8 @@ class Controller:
     def _resync(self) -> None:
         assert self._resync_period is not None
         while not self._stop.wait(self._resync_period):
-            self.queue.add(CLUSTER_KEY)
+            with self._known_lock:
+                keys = self._known_keys or {CLUSTER_KEY}
+                keys = set(keys)
+            for key in keys:
+                self.queue.add(key)

@@ -240,7 +240,22 @@ class RealCluster(K8sClient):
 
         wanted = kinds or {watch_mod.KIND_NODE, watch_mod.KIND_POD,
                            watch_mod.KIND_DAEMON_SET}
-        sub = watch_mod.Watch()
+        # stop() must actually terminate the pump threads: track each
+        # pump's live kubernetes stream and stop them all on sub.stop(),
+        # releasing the HTTP watch connections (client-go Stop parity).
+        streams_lock = threading.Lock()
+        active_streams: list = []
+
+        def on_stop(_watch) -> None:
+            with streams_lock:
+                streams = list(active_streams)
+            for stream in streams:
+                try:
+                    stream.stop()
+                except Exception:
+                    pass
+
+        sub = watch_mod.Watch(on_stop=on_stop)
         sources = []
         if watch_mod.KIND_NODE in wanted:
             sources.append((watch_mod.KIND_NODE, self._core.list_node, {},
@@ -274,9 +289,15 @@ class RealCluster(K8sClient):
             backoff = 0.5
             while not sub.stopped:
                 stream = k8s_watch.Watch()
+                with streams_lock:
+                    active_streams.append(stream)
                 delivered = False
                 try:
-                    for raw in stream.stream(list_fn, **kwargs):
+                    # timeout_seconds bounds how long a quiet stream blocks
+                    # so a stop() is honored promptly even mid-connect
+                    for raw in stream.stream(list_fn,
+                                             timeout_seconds=300,
+                                             **kwargs):
                         if sub.stopped:
                             return
                         event_type = raw["type"]
@@ -301,6 +322,9 @@ class RealCluster(K8sClient):
                     continue
                 finally:
                     stream.stop()
+                    with streams_lock:
+                        if stream in active_streams:
+                            active_streams.remove(stream)
                 if not delivered:
                     # clean-but-empty expiry loop: avoid a tight relist
                     time_mod.sleep(min(backoff, 1.0))
