@@ -368,6 +368,42 @@ class TestController:
         finally:
             ctrl.stop()
 
+    def test_deleted_key_reconciled_once_then_dropped_from_resync(self):
+        cluster = FakeCluster()
+        NodeBuilder("n1").create(cluster)
+        seen = []
+        event_seen = threading.Event()
+
+        def reconcile(key):
+            seen.append(key)
+            event_seen.set()
+            return None
+
+        ctrl = Controller(reconcile)
+        ctrl.watch(cluster.watch({KIND_POD}),
+                   key_fn=lambda e: e.object.metadata.name)
+        ctrl.start(initial_sync=False)
+        try:
+            PodBuilder("p1", namespace="d").on_node("n1").orphaned() \
+                .create(cluster)
+            assert event_seen.wait(timeout=2.0)
+            event_seen.clear()
+            with ctrl._known_lock:
+                assert "p1" in ctrl._known_keys
+            cluster.delete_pod("d", "p1")
+            assert event_seen.wait(timeout=2.0)  # final cleanup reconcile
+            deadline = time.monotonic() + 2.0
+            while time.monotonic() < deadline:
+                with ctrl._known_lock:
+                    if "p1" not in ctrl._known_keys:
+                        break
+                time.sleep(0.01)
+            with ctrl._known_lock:
+                assert "p1" not in ctrl._known_keys
+            assert seen.count("p1") >= 2  # add + delete reconciles ran
+        finally:
+            ctrl.stop()
+
     def test_resync_fires_without_events(self):
         count = threading.Semaphore(0)
         ctrl = Controller(lambda _k: count.release() or None,

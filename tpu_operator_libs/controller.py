@@ -42,6 +42,13 @@ logger = logging.getLogger(__name__)
 CLUSTER_KEY = "cluster"
 
 
+def _cluster_key_fn(_event: "WatchEvent") -> str:
+    """Default key function: every event maps to the cluster singleton.
+    Identity-compared in the pump to exempt the singleton from
+    DELETED-event key forgetting."""
+    return CLUSTER_KEY
+
+
 class ExponentialBackoffRateLimiter:
     """Per-key exponential backoff: base * 2^retries, capped.
 
@@ -356,17 +363,33 @@ class Controller:
             self._known_keys.add(key)
         self.queue.add(key)
 
+    def forget_key(self, key: str) -> None:
+        """Stop resyncing ``key`` (e.g. the reconciler found its object
+        gone). A later event for the key re-registers it."""
+        with self._known_lock:
+            self._known_keys.discard(key)
+        self._limiter.forget(key)
+
     # -- wiring ----------------------------------------------------------
     def watch(self, watch: Watch,
               key_fn: Optional[Callable[[WatchEvent], Optional[str]]] = None) -> None:
         """Enqueue ``key_fn(event)`` for every event (None = skip event;
         default maps everything to :data:`CLUSTER_KEY`). Must be called
-        before :meth:`start` — pump threads are spawned there."""
+        before :meth:`start` — pump threads are spawned there.
+
+        With a custom per-object ``key_fn``, a DELETED event still
+        enqueues one final reconcile for its key, after which the key is
+        forgotten so the resync timer stops re-enqueueing dead objects
+        (the known-key set would otherwise grow forever in a churny
+        namespace). The default cluster-singleton key is never forgotten.
+        """
         if self._threads:
             raise RuntimeError(
                 "Controller.watch() after start(): the watch would never "
                 "be pumped; register watches before starting")
-        self._watches.append((watch, key_fn or (lambda _e: CLUSTER_KEY)))
+        if key_fn is None:
+            key_fn = _cluster_key_fn
+        self._watches.append((watch, key_fn))
 
     # -- lifecycle -------------------------------------------------------
     def start(self, workers: int = 1, initial_sync: bool = True) -> None:
@@ -427,6 +450,12 @@ class Controller:
                 continue
             if key is not None:
                 self._enqueue(key)
+                if event.type == DELETED and key_fn is not _cluster_key_fn:
+                    # final cleanup reconcile is queued; drop the key from
+                    # the resync set so dead objects aren't re-enqueued
+                    # forever
+                    with self._known_lock:
+                        self._known_keys.discard(key)
 
     def _worker(self) -> None:
         while not self._stop.is_set():

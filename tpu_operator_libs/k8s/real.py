@@ -291,6 +291,13 @@ class RealCluster(K8sClient):
                 stream = k8s_watch.Watch()
                 with streams_lock:
                     active_streams.append(stream)
+                if sub.stopped:
+                    # sub.stop() may have snapshotted active_streams just
+                    # before the append; re-check so this stream never
+                    # opens a connection nothing will stop
+                    with streams_lock:
+                        active_streams.remove(stream)
+                    return
                 delivered = False
                 try:
                     # timeout_seconds bounds how long a quiet stream blocks
