@@ -33,8 +33,6 @@ import os
 import signal
 import sys
 
-sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
-
 logger = logging.getLogger("jax-training-job")
 
 
@@ -171,25 +169,28 @@ def train(checkpoint_dir: str, max_steps: int = 100,
     mesh = make_mesh(n_devices)
     state, apply_update = init_state(mesh)
     manager = make_checkpoint_manager(checkpoint_dir)
-    state, start_step = restore_state(manager, state)
-    loss = None
-    step = start_step
-    for step in range(start_step, max_steps):
-        if stop_flag is not None and stop_flag():
-            logger.info("stop requested at step %d", step)
-            break
-        x, y = make_batch(mesh, step)
-        state, loss = apply_update(state, x, y)
-        done = step + 1
-        if done % save_interval == 0 or done == max_steps:
-            # blocking save: once save() returns the step is committed,
-            # which is exactly what the operator's gate checks for
-            manager.save(done, args=_save_args(state))
-            manager.wait_until_finished()
-            logger.info("step %d: loss %.5f (checkpoint committed)",
-                        done, float(loss))
-        step = done
-    manager.close()
+    try:
+        state, start_step = restore_state(manager, state)
+        loss = None
+        step = start_step
+        for step in range(start_step, max_steps):
+            if stop_flag is not None and stop_flag():
+                logger.info("stop requested at step %d", step)
+                break
+            x, y = make_batch(mesh, step)
+            state, loss = apply_update(state, x, y)
+            done = step + 1
+            if done % save_interval == 0 or done == max_steps:
+                # blocking save: once save() returns the step is
+                # committed, which is exactly what the operator's gate
+                # checks for
+                manager.save(done, args=_save_args(state))
+                manager.wait_until_finished()
+                logger.info("step %d: loss %.5f (checkpoint committed)",
+                            done, float(loss))
+            step = done
+    finally:
+        manager.close()
     return {"final_step": step, "start_step": start_step,
             "loss": None if loss is None else float(loss)}
 
@@ -213,11 +214,15 @@ def main() -> int:
 
     stop = {"flag": False}
 
-    def on_term(*_a):
+    def on_term(signum, _frame):
         # an evicted pod gets SIGTERM: stop cleanly WITHOUT saving —
         # durability must come from the periodic commits the operator's
         # gate verified, not from a grace-period race
         stop["flag"] = True
+        if signum == signal.SIGINT:
+            # keep the Ctrl-C escape hatch: a second SIGINT raises
+            # KeyboardInterrupt even while blocked inside an Orbax save
+            signal.signal(signal.SIGINT, signal.default_int_handler)
 
     signal.signal(signal.SIGTERM, on_term)
     signal.signal(signal.SIGINT, on_term)

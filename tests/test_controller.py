@@ -408,10 +408,64 @@ class TestController:
         count = threading.Semaphore(0)
         ctrl = Controller(lambda _k: count.release() or None,
                           resync_period=0.05)
+        # initial_sync registers CLUSTER_KEY; resync then re-enqueues it
+        # forever with no events arriving
+        ctrl.start()
+        try:
+            assert count.acquire(timeout=2.0)  # the initial sync
+            assert count.acquire(timeout=2.0)  # a resync tick
+            assert count.acquire(timeout=2.0)  # another one
+        finally:
+            ctrl.stop()
+
+    def test_resync_enqueues_only_known_keys(self):
+        seen = []
+        event_seen = threading.Event()
+
+        def reconcile(key):
+            seen.append(key)
+            event_seen.set()
+            return None
+
+        cluster = FakeCluster()
+        NodeBuilder("n1").create(cluster)
+        ctrl = Controller(reconcile, resync_period=0.05)
+        ctrl.watch(cluster.watch({KIND_NODE}),
+                   key_fn=lambda e: e.object.metadata.name)
         ctrl.start(initial_sync=False)
         try:
-            assert count.acquire(timeout=2.0)
-            assert count.acquire(timeout=2.0)
+            time.sleep(0.15)  # several resync periods with no known keys
+            assert seen == []  # no fabricated CLUSTER_KEY reconciles
+            cluster.patch_node_labels("n1", {"x": "1"})
+            assert event_seen.wait(timeout=2.0)
+            deadline = time.monotonic() + 2.0
+            while seen.count("n1") < 3 and time.monotonic() < deadline:
+                time.sleep(0.01)
+            assert seen.count("n1") >= 3  # event + resyncs, key preserved
+            assert CLUSTER_KEY not in seen
+        finally:
+            ctrl.stop()
+
+    def test_reconcile_result_forget_drops_key_from_resync(self):
+        seen = []
+        event_seen = threading.Event()
+
+        def reconcile(key):
+            seen.append(key)
+            event_seen.set()
+            return ReconcileResult(forget=True)
+
+        cluster = FakeCluster()
+        NodeBuilder("n1").create(cluster)
+        ctrl = Controller(reconcile, resync_period=0.05)
+        ctrl.watch(cluster.watch({KIND_NODE}),
+                   key_fn=lambda e: e.object.metadata.name)
+        ctrl.start(initial_sync=False)
+        try:
+            cluster.patch_node_labels("n1", {"x": "1"})
+            assert event_seen.wait(timeout=2.0)
+            time.sleep(0.2)  # several resync periods
+            assert seen == ["n1"]  # forgotten: resync never re-enqueued
         finally:
             ctrl.stop()
 

@@ -1,0 +1,328 @@
+"""Race-style stress tests of concurrent reconciles (SURVEY.md §5).
+
+The reference has no race detector in CI and leans on per-node
+serialization by construction (KeyedMutex locks, StringSet in-flight
+guards, label writes as the only commit point). These tests hammer those
+same constructions here with real thread concurrency:
+
+- many simultaneous ``reconcile`` passes with async (detached-thread)
+  workers against one shared FakeCluster,
+- every node-label transition recorded via the watch stream and checked
+  against the legal state-graph edges,
+- primitive-level contention on NameSet / KeyedLock / WorkQueue.
+
+The one guarantee concurrency does NOT add: throttle exactness across
+simultaneous passes (two racing ApplyState calls can both see a free
+slot — the reference has the same property, which is why its consumer
+runs a single reconcile goroutine and why our Controller's work queue
+serializes per key). Transition legality and convergence must hold
+regardless.
+"""
+
+import threading
+import time
+
+from tpu_operator_libs.api.upgrade_policy import UpgradePolicySpec
+from tpu_operator_libs.consts import UpgradeState
+from tpu_operator_libs.k8s.watch import KIND_NODE, MODIFIED
+from tpu_operator_libs.simulate import (
+    NS,
+    RUNTIME_LABELS,
+    FleetSpec,
+    build_fleet,
+)
+from tpu_operator_libs.upgrade.state_manager import ClusterUpgradeStateManager
+from tpu_operator_libs.util import KeyedLock, NameSet
+
+from test_e2e_scenarios import LEGAL_EDGES, assert_transitions_legal
+
+
+def _record_trails(cluster, keys):
+    """Subscribe to node watch events, returning (trails, stop) where
+    trails accumulates each node's ordered distinct state-label values."""
+    watch = cluster.watch({KIND_NODE})
+    trails: dict[str, list[str]] = {
+        n.metadata.name: [n.metadata.labels.get(keys.state_label, "")]
+        for n in cluster.list_nodes()}
+    lock = threading.Lock()
+
+    def pump():
+        for event in watch:
+            if event.type != MODIFIED:
+                continue
+            node = event.object
+            state = node.metadata.labels.get(keys.state_label, "")
+            with lock:
+                trail = trails.setdefault(node.metadata.name, [""])
+                if trail[-1] != state:
+                    trail.append(state)
+
+    thread = threading.Thread(target=pump, daemon=True)
+    thread.start()
+
+    def stop():
+        watch.stop()
+        thread.join(timeout=5.0)
+        return trails
+
+    return trails, stop
+
+
+class TestConcurrentReconciles:
+    def test_parallel_reconciles_converge_with_legal_transitions(self):
+        fleet = FleetSpec(n_slices=2, hosts_per_slice=4,
+                          pod_recreate_delay=1.0, pod_ready_delay=2.0)
+        cluster, clock, keys = build_fleet(fleet)
+        # async_workers=True: drains/evictions run on detached threads,
+        # the same shape as the reference's fire-and-forget goroutines
+        mgr = ClusterUpgradeStateManager(
+            cluster, keys, None, clock, async_workers=True,
+            poll_interval=0.001)
+        policy = UpgradePolicySpec(auto_upgrade=True,
+                                   max_parallel_upgrades=0,
+                                   max_unavailable="50%")
+        trails, stop_trails = _record_trails(cluster, keys)
+        errors: list[BaseException] = []
+        stop = threading.Event()
+
+        def reconciler():
+            while not stop.is_set():
+                try:
+                    mgr.reconcile(NS, RUNTIME_LABELS, policy)
+                except BaseException as exc:  # noqa: BLE001 - recorded
+                    errors.append(exc)
+                time.sleep(0.001)
+
+        threads = [threading.Thread(target=reconciler, daemon=True)
+                   for _ in range(4)]
+        for t in threads:
+            t.start()
+        deadline = time.monotonic() + 30.0
+        try:
+            while time.monotonic() < deadline:
+                clock.advance(0.5)
+                cluster.step()
+                states = [n.metadata.labels.get(keys.state_label)
+                          for n in cluster.list_nodes()]
+                if all(s == UpgradeState.DONE for s in states):
+                    break
+                time.sleep(0.005)
+        finally:
+            stop.set()
+            for t in threads:
+                t.join(timeout=5.0)
+        final = {n.metadata.name: n.metadata.labels.get(keys.state_label)
+                 for n in cluster.list_nodes()}
+        assert not errors, errors[:3]
+        assert all(s == UpgradeState.DONE for s in final.values()), final
+        trails = stop_trails()
+        assert_transitions_legal(trails)
+        # every node actually moved through the machine
+        for name, trail in trails.items():
+            assert trail[-1] == UpgradeState.DONE
+            assert UpgradeState.POD_RESTART_REQUIRED in trail, (name, trail)
+
+    def test_concurrent_reconciles_during_fault_recovery(self):
+        """Crash-looping pods (ready gate closed) + concurrent reconciles:
+        nodes park in upgrade-failed, then all recover once the gate
+        opens — transitions stay legal throughout."""
+        fleet = FleetSpec(n_slices=1, hosts_per_slice=4,
+                          pod_recreate_delay=1.0, pod_ready_delay=2.0)
+        cluster, clock, keys = build_fleet(fleet)
+        gate_open = threading.Event()
+        cluster.set_pod_ready_gate(lambda _pod: gate_open.is_set())
+        mgr = ClusterUpgradeStateManager(
+            cluster, keys, None, clock, async_workers=True,
+            poll_interval=0.001)
+        policy = UpgradePolicySpec(auto_upgrade=True,
+                                   max_parallel_upgrades=0,
+                                   max_unavailable="100%")
+        trails, stop_trails = _record_trails(cluster, keys)
+        errors: list[BaseException] = []
+        stop = threading.Event()
+
+        def reconciler():
+            while not stop.is_set():
+                try:
+                    mgr.reconcile(NS, RUNTIME_LABELS, policy)
+                except BaseException as exc:  # noqa: BLE001 - recorded
+                    errors.append(exc)
+                time.sleep(0.001)
+
+        threads = [threading.Thread(target=reconciler, daemon=True)
+                   for _ in range(3)]
+        for t in threads:
+            t.start()
+        try:
+            # phase 1: let the crash-loop drive nodes into upgrade-failed
+            deadline = time.monotonic() + 15.0
+            while time.monotonic() < deadline:
+                clock.advance(0.5)
+                cluster.step()
+                states = [n.metadata.labels.get(keys.state_label)
+                          for n in cluster.list_nodes()]
+                if all(s == UpgradeState.FAILED for s in states):
+                    break
+                time.sleep(0.005)
+            assert all(
+                n.metadata.labels.get(keys.state_label) == UpgradeState.FAILED
+                for n in cluster.list_nodes()), "fleet never parked in failed"
+            # phase 2: open the gate; recovery must reach done
+            gate_open.set()
+            deadline = time.monotonic() + 15.0
+            while time.monotonic() < deadline:
+                clock.advance(0.5)
+                cluster.step()
+                states = [n.metadata.labels.get(keys.state_label)
+                          for n in cluster.list_nodes()]
+                if all(s == UpgradeState.DONE for s in states):
+                    break
+                time.sleep(0.005)
+        finally:
+            stop.set()
+            for t in threads:
+                t.join(timeout=5.0)
+        assert not errors, errors[:3]
+        assert all(
+            n.metadata.labels.get(keys.state_label) == UpgradeState.DONE
+            for n in cluster.list_nodes())
+        assert_transitions_legal(stop_trails())
+
+
+class TestPrimitiveContention:
+    def test_nameset_single_winner_per_round(self):
+        names = NameSet()
+        winners: list[int] = []
+        barrier = threading.Barrier(8)
+
+        def contender(i):
+            barrier.wait()
+            if names.add("node"):
+                winners.append(i)
+
+        for _round in range(50):
+            winners.clear()
+            threads = [threading.Thread(target=contender, args=(i,))
+                       for i in range(8)]
+            for t in threads:
+                t.start()
+            for t in threads:
+                t.join()
+            assert len(winners) == 1, winners
+            names.remove("node")
+            barrier.reset()
+
+    def test_keyed_lock_serializes_per_key_not_globally(self):
+        lock = KeyedLock()
+        active: dict[str, int] = {"a": 0, "b": 0}
+        max_active: dict[str, int] = {"a": 0, "b": 0}
+        both_running = threading.Event()
+        guard = threading.Lock()
+
+        def worker(key):
+            for _ in range(200):
+                held = lock.lock(key)
+                try:
+                    with guard:
+                        active[key] += 1
+                        max_active[key] = max(max_active[key], active[key])
+                        if active["a"] and active["b"]:
+                            both_running.set()
+                    time.sleep(0)
+                    with guard:
+                        active[key] -= 1
+                finally:
+                    held.release()
+
+        threads = [threading.Thread(target=worker, args=(k,))
+                   for k in ("a", "b") for _ in range(4)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+        # per-key mutual exclusion...
+        assert max_active == {"a": 1, "b": 1}
+        # ...but different keys genuinely ran concurrently
+        assert both_running.is_set()
+
+    def test_workqueue_never_processes_key_concurrently(self):
+        from tpu_operator_libs.controller import WorkQueue
+
+        q = WorkQueue()
+        processing: set[str] = set()
+        processed = {"count": 0}
+        violations: list[str] = []
+        guard = threading.Lock()
+        stop = threading.Event()
+
+        def worker():
+            while not stop.is_set():
+                key = q.get(timeout=0.05)
+                if key is None:
+                    continue
+                with guard:
+                    if key in processing:
+                        violations.append(key)
+                    processing.add(key)
+                time.sleep(0.001)
+                with guard:
+                    processing.discard(key)
+                    processed["count"] += 1
+                q.done(key)
+
+        workers = [threading.Thread(target=worker, daemon=True)
+                   for _ in range(6)]
+        for t in workers:
+            t.start()
+        for i in range(600):
+            q.add(f"k{i % 5}")  # heavy per-key contention
+            if i % 7 == 0:
+                time.sleep(0.0005)
+        deadline = time.monotonic() + 10.0
+        while len(q) > 0 and time.monotonic() < deadline:
+            time.sleep(0.01)
+        stop.set()
+        for t in workers:
+            t.join(timeout=2.0)
+        assert not violations
+        assert processed["count"] >= 5  # every key saw work
+
+    def test_provider_concurrent_state_writes_serialize(self):
+        """Concurrent writers to one node: per-node lock serializes the
+        patch+read-back commits; the final label is the last writer's and
+        every write bumped the resource version exactly once."""
+        from helpers import make_env
+
+        from builders import NodeBuilder
+
+        env = make_env()
+        node = NodeBuilder("n1").create(env.cluster)
+        start_rv = env.cluster.get_node("n1").metadata.resource_version
+        states = [UpgradeState.UPGRADE_REQUIRED, UpgradeState.CORDON_REQUIRED,
+                  UpgradeState.WAIT_FOR_JOBS_REQUIRED,
+                  UpgradeState.POD_RESTART_REQUIRED]
+        barrier = threading.Barrier(len(states))
+        errors = []
+
+        def writer(state):
+            barrier.wait()
+            try:
+                n = env.cluster.get_node("n1")
+                env.provider.change_node_upgrade_state(n, state)
+            except Exception as exc:  # noqa: BLE001 - recorded
+                errors.append(exc)
+
+        for _round in range(20):
+            threads = [threading.Thread(target=writer, args=(s,))
+                       for s in states]
+            for t in threads:
+                t.start()
+            for t in threads:
+                t.join()
+            barrier.reset()
+        assert not errors, errors[:3]
+        final = env.cluster.get_node("n1")
+        assert final.metadata.labels[env.keys.state_label] in set(states)
+        # 4 writers x 20 rounds = 80 label patches exactly (no lost or
+        # duplicated commits)
+        assert final.metadata.resource_version == start_rv + 80

@@ -316,10 +316,16 @@ class Informer:
 
 @dataclass
 class ReconcileResult:
-    """Outcome of one reconcile (controller-runtime ``ctrl.Result``)."""
+    """Outcome of one reconcile (controller-runtime ``ctrl.Result``).
+
+    ``forget=True`` additionally drops the key from the resync set — the
+    reconciler's way of saying "this object is gone" for deletions the
+    watch never observed (stream-gap deletions emit no DELETED event).
+    """
 
     requeue: bool = False
     requeue_after: Optional[float] = None
+    forget: bool = False
 
 
 class Controller:
@@ -382,6 +388,11 @@ class Controller:
         forgotten so the resync timer stops re-enqueueing dead objects
         (the known-key set would otherwise grow forever in a churny
         namespace). The default cluster-singleton key is never forgotten.
+
+        This is best-effort: a deletion during a watch-stream gap emits
+        no DELETED event (restarted live streams re-list current objects
+        only), so a per-object reconciler should also return
+        ``ReconcileResult(forget=True)`` when it finds its object gone.
         """
         if self._threads:
             raise RuntimeError(
@@ -477,6 +488,9 @@ class Controller:
             with self._count_lock:
                 self._reconcile_count += 1
             self.queue.done(key)
+            if result is not None and result.forget:
+                self.forget_key(key)
+                continue
             if result is not None and result.requeue_after is not None:
                 self.queue.add_after(key, result.requeue_after)
             elif result is not None and result.requeue:
@@ -485,10 +499,14 @@ class Controller:
                 self._limiter.forget(key)
 
     def _resync(self) -> None:
+        # Only keys actually seen are resynced: injecting CLUSTER_KEY
+        # into a per-object controller that never registered it would
+        # hand its reconciler a key it cannot resolve. Cluster-scoped
+        # controllers register CLUSTER_KEY via initial_sync or their
+        # first event.
         assert self._resync_period is not None
         while not self._stop.wait(self._resync_period):
             with self._known_lock:
-                keys = self._known_keys or {CLUSTER_KEY}
-                keys = set(keys)
+                keys = set(self._known_keys)
             for key in keys:
                 self.queue.add(key)
