@@ -464,8 +464,16 @@ class TestController:
         try:
             cluster.patch_node_labels("n1", {"x": "1"})
             assert event_seen.wait(timeout=2.0)
+            # A resync tick can land while the first reconcile is still
+            # queued or in flight, legally producing one extra run before
+            # forget takes effect — assert the count *stabilizes*, not
+            # that it is exactly 1.
             time.sleep(0.2)  # several resync periods
-            assert seen == ["n1"]  # forgotten: resync never re-enqueued
+            settled = seen.count("n1")
+            assert settled >= 1
+            time.sleep(0.2)  # several more periods
+            assert seen.count("n1") == settled  # forgotten: no regrowth
+            assert CLUSTER_KEY not in seen
         finally:
             ctrl.stop()
 

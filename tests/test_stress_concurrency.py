@@ -34,7 +34,7 @@ from tpu_operator_libs.simulate import (
 from tpu_operator_libs.upgrade.state_manager import ClusterUpgradeStateManager
 from tpu_operator_libs.util import KeyedLock, NameSet
 
-from test_e2e_scenarios import LEGAL_EDGES, assert_transitions_legal
+from test_e2e_scenarios import assert_transitions_legal
 
 
 def _record_trails(cluster, keys):
