@@ -1,0 +1,353 @@
+"""Contract tests for the live-cluster adapter (k8s/real.py) against a
+stubbed ``kubernetes`` package.
+
+The reference gets this layer for free from client-go; here the adapter
+owns the wire conversions (kubernetes client model -> our dataclasses),
+the merge-patch bodies (``None`` deletes a key,
+node_upgrade_state_provider.go:147-151 semantics), the eviction
+subresource, error translation, and the list+watch pump. None of that was
+covered before this suite: the real ``kubernetes`` package is absent from
+the image, so we install a recording stub into ``sys.modules``.
+"""
+
+import sys
+import threading
+import time
+import types
+from types import SimpleNamespace as NS
+
+import pytest
+
+from tpu_operator_libs.k8s.client import EvictionBlockedError, NotFoundError
+from tpu_operator_libs.k8s.watch import (
+    ADDED,
+    DELETED,
+    KIND_DAEMON_SET,
+    KIND_NODE,
+    KIND_POD,
+    MODIFIED,
+)
+
+
+class StubApiException(Exception):
+    def __init__(self, status, reason=""):
+        super().__init__(f"({status}) {reason}")
+        self.status = status
+        self.reason = reason
+
+
+class Recorder:
+    """Records every API call; canned responses keyed by method name."""
+
+    def __init__(self):
+        self.calls = []
+        self.responses = {}
+        self.errors = {}
+
+    def _invoke(self, method, *args, **kwargs):
+        self.calls.append((method, args, kwargs))
+        if method in self.errors:
+            raise self.errors[method]
+        return self.responses.get(method, NS(items=[]))
+
+    def __getattr__(self, method):
+        if method.startswith("_"):
+            raise AttributeError(method)
+        return lambda *a, **k: self._invoke(method, *a, **k)
+
+
+class StubWatchStream:
+    """Stands in for kubernetes.watch.Watch: replays scripted raw events."""
+
+    script = []          # class-level: list of raw event dicts to replay
+    instances = []
+
+    def __init__(self):
+        self._stopped = threading.Event()
+        StubWatchStream.instances.append(self)
+
+    def stream(self, list_fn, timeout_seconds=None, **kwargs):
+        # note which list endpoint the pump wired up
+        self.list_fn = list_fn
+        self.kwargs = kwargs
+        for raw in StubWatchStream.script:
+            if self._stopped.is_set():
+                return
+            yield raw
+        # block like a quiet long-poll until stopped so the pump doesn't
+        # spin through restart cycles during the test
+        self._stopped.wait(timeout=5.0)
+
+    def stop(self):
+        self._stopped.set()
+
+
+@pytest.fixture()
+def stub_k8s():
+    """Install a minimal ``kubernetes`` package into sys.modules."""
+    recorder = Recorder()
+
+    client_mod = types.ModuleType("kubernetes.client")
+    client_mod.ApiException = StubApiException
+    client_mod.CoreV1Api = lambda api_client=None: recorder
+    client_mod.AppsV1Api = lambda api_client=None: recorder
+    client_mod.V1Eviction = lambda metadata=None: NS(metadata=metadata)
+    client_mod.V1ObjectMeta = lambda name=None, namespace=None: NS(
+        name=name, namespace=namespace)
+
+    watch_mod = types.ModuleType("kubernetes.watch")
+    watch_mod.Watch = StubWatchStream
+
+    root = types.ModuleType("kubernetes")
+    root.client = client_mod
+    root.watch = watch_mod
+
+    saved = {name: sys.modules.get(name)
+             for name in ("kubernetes", "kubernetes.client",
+                          "kubernetes.watch")}
+    sys.modules["kubernetes"] = root
+    sys.modules["kubernetes.client"] = client_mod
+    sys.modules["kubernetes.watch"] = watch_mod
+    StubWatchStream.script = []
+    StubWatchStream.instances = []
+    try:
+        yield recorder
+    finally:
+        for name, mod in saved.items():
+            if mod is None:
+                sys.modules.pop(name, None)
+            else:
+                sys.modules[name] = mod
+
+
+def make_cluster():
+    from tpu_operator_libs.k8s.real import RealCluster
+
+    return RealCluster()
+
+
+def raw_meta(name, namespace="", uid="u1", labels=None, annotations=None,
+             owners=None, deletion_timestamp=None):
+    return NS(name=name, namespace=namespace, uid=uid, labels=labels,
+              annotations=annotations, owner_references=owners,
+              deletion_timestamp=deletion_timestamp)
+
+
+def raw_node(name, unschedulable=False, conditions=None, **meta_kwargs):
+    return NS(metadata=raw_meta(name, **meta_kwargs),
+              spec=NS(unschedulable=unschedulable),
+              status=NS(conditions=conditions))
+
+
+def raw_pod(name, namespace="ns", node_name="n1", phase="Running",
+            statuses=None, init_statuses=None, volumes=None, **meta_kwargs):
+    return NS(metadata=raw_meta(name, namespace=namespace, **meta_kwargs),
+              spec=NS(node_name=node_name, volumes=volumes),
+              status=NS(phase=phase, container_statuses=statuses,
+                        init_container_statuses=init_statuses))
+
+
+class TestConversions:
+    def test_node_defaults_and_conditions(self, stub_k8s):
+        stub_k8s.responses["read_node"] = raw_node(
+            "n1", unschedulable=True,
+            conditions=[NS(type="Ready", status="False")],
+            labels={"a": "1"}, annotations=None)
+        node = make_cluster().get_node("n1")
+        assert node.metadata.name == "n1"
+        assert node.metadata.labels == {"a": "1"}
+        assert node.metadata.annotations == {}
+        assert node.spec.unschedulable is True
+        assert [(c.type, c.status) for c in node.status.conditions] \
+            == [("Ready", "False")]
+        # absent conditions default to Ready=True (GKE nodes always
+        # carry conditions; the default keeps tests permissive)
+        stub_k8s.responses["read_node"] = raw_node("n2", conditions=None)
+        assert make_cluster().get_node("n2").status.conditions[0].status \
+            == "True"
+
+    def test_pod_conversion(self, stub_k8s):
+        pod_obj = raw_pod(
+            "p1", phase=None,
+            statuses=[NS(name="c", ready=True, restart_count=None)],
+            init_statuses=[NS(name="init", ready=False, restart_count=3)],
+            volumes=[NS(name="scratch", empty_dir=NS()),
+                     NS(name="cfg", empty_dir=None)],
+            owners=[NS(kind="DaemonSet", name="ds", uid="du",
+                       controller=True)],
+            deletion_timestamp=None)
+        stub_k8s.responses["list_namespaced_pod"] = NS(items=[pod_obj])
+        (pod,) = make_cluster().list_pods(namespace="ns")
+        assert pod.status.phase.value == "Pending"  # None phase -> Pending
+        assert pod.status.container_statuses[0].restart_count == 0
+        assert pod.status.init_container_statuses[0].name == "init"
+        assert [v.empty_dir for v in pod.spec.volumes] == [True, False]
+        owner = pod.metadata.owner_references[0]
+        assert (owner.kind, owner.uid, owner.controller) \
+            == ("DaemonSet", "du", True)
+
+    def test_deletion_timestamp_converted_to_epoch(self, stub_k8s):
+        class Ts:
+            def timestamp(self):
+                return 1234.5
+
+        stub_k8s.responses["list_pod_for_all_namespaces"] = NS(
+            items=[raw_pod("p1", deletion_timestamp=Ts())])
+        (pod,) = make_cluster().list_pods()
+        assert pod.metadata.deletion_timestamp == 1234.5
+
+    def test_daemon_set_and_revision_conversion(self, stub_k8s):
+        ds_obj = NS(metadata=raw_meta("libtpu", namespace="kube-system"),
+                    spec=NS(selector=NS(match_labels={"app": "libtpu"})),
+                    status=NS(desired_number_scheduled=None))
+        rev_obj = NS(metadata=raw_meta("libtpu-abc", namespace="kube-system"),
+                     revision=7)
+        stub_k8s.responses["list_namespaced_daemon_set"] = NS(items=[ds_obj])
+        stub_k8s.responses["list_namespaced_controller_revision"] = NS(
+            items=[rev_obj])
+        cluster = make_cluster()
+        (ds,) = cluster.list_daemon_sets("kube-system")
+        assert ds.spec.selector == {"app": "libtpu"}
+        assert ds.status.desired_number_scheduled == 0
+        (rev,) = cluster.list_controller_revisions("kube-system")
+        assert rev.revision == 7
+
+
+class TestRequestShapes:
+    def test_label_patch_body_preserves_none_for_delete(self, stub_k8s):
+        stub_k8s.responses["patch_node"] = raw_node("n1")
+        make_cluster().patch_node_labels("n1", {"keep": "v", "drop": None})
+        method, args, _ = stub_k8s.calls[-1]
+        assert method == "patch_node"
+        assert args == ("n1",
+                        {"metadata": {"labels": {"keep": "v", "drop": None}}})
+
+    def test_annotation_patch_and_cordon_bodies(self, stub_k8s):
+        stub_k8s.responses["patch_node"] = raw_node("n1")
+        cluster = make_cluster()
+        cluster.patch_node_annotations("n1", {"a": None})
+        assert stub_k8s.calls[-1][1][1] \
+            == {"metadata": {"annotations": {"a": None}}}
+        cluster.set_node_unschedulable("n1", True)
+        assert stub_k8s.calls[-1][1][1] == {"spec": {"unschedulable": True}}
+
+    def test_list_pods_routing_and_selector_noneing(self, stub_k8s):
+        cluster = make_cluster()
+        cluster.list_pods(namespace="ns", label_selector="app=x",
+                          field_selector="spec.nodeName=n1")
+        method, args, kwargs = stub_k8s.calls[-1]
+        assert method == "list_namespaced_pod" and args == ("ns",)
+        assert kwargs == {"label_selector": "app=x",
+                          "field_selector": "spec.nodeName=n1"}
+        cluster.list_pods()  # no namespace -> all-namespaces endpoint
+        method, _, kwargs = stub_k8s.calls[-1]
+        assert method == "list_pod_for_all_namespaces"
+        # empty selectors must be sent as None, not ""
+        assert kwargs == {"label_selector": None, "field_selector": None}
+
+    def test_evict_pod_builds_eviction_subresource(self, stub_k8s):
+        make_cluster().evict_pod("ns", "p1")
+        method, args, _ = stub_k8s.calls[-1]
+        assert method == "create_namespaced_pod_eviction"
+        name, namespace, eviction = args
+        assert (name, namespace) == ("p1", "ns")
+        assert (eviction.metadata.name, eviction.metadata.namespace) \
+            == ("p1", "ns")
+
+
+class TestErrorTranslation:
+    def test_404_becomes_not_found(self, stub_k8s):
+        stub_k8s.errors["read_node"] = StubApiException(404, "nope")
+        with pytest.raises(NotFoundError):
+            make_cluster().get_node("ghost")
+        stub_k8s.errors["delete_namespaced_pod"] = StubApiException(404)
+        with pytest.raises(NotFoundError):
+            make_cluster().delete_pod("ns", "ghost")
+
+    def test_429_on_eviction_is_pdb_block(self, stub_k8s):
+        stub_k8s.errors["create_namespaced_pod_eviction"] = \
+            StubApiException(429, "disruption budget")
+        with pytest.raises(EvictionBlockedError):
+            make_cluster().evict_pod("ns", "p1")
+
+    def test_429_elsewhere_is_not_pdb_block(self, stub_k8s):
+        # apiserver rate limiting must surface as the raw ApiException so
+        # callers back off and retry instead of rerouting to drain/failed
+        stub_k8s.errors["patch_node"] = StubApiException(429, "slow down")
+        with pytest.raises(StubApiException):
+            make_cluster().patch_node_labels("n1", {"a": "1"})
+
+    def test_other_statuses_pass_through(self, stub_k8s):
+        stub_k8s.errors["patch_node"] = StubApiException(403, "rbac")
+        with pytest.raises(StubApiException):
+            make_cluster().set_node_unschedulable("n1", True)
+
+
+class TestWatchPump:
+    def _drain(self, sub, want, timeout=5.0):
+        events = []
+        deadline = time.monotonic() + timeout
+        while len(events) < want and time.monotonic() < deadline:
+            event = sub.get(timeout=0.2)
+            if event is not None:
+                events.append(event)
+        return events
+
+    def test_events_converted_and_bookmarks_skipped(self, stub_k8s):
+        StubWatchStream.script = [
+            {"type": "ADDED", "object": raw_node("n1")},
+            {"type": "BOOKMARK", "object": NS()},
+            {"type": "MODIFIED", "object": raw_node("n1",
+                                                    unschedulable=True)},
+            {"type": "DELETED", "object": raw_node("n1")},
+        ]
+        sub = make_cluster().watch(kinds={KIND_NODE})
+        try:
+            events = self._drain(sub, want=3)
+            assert [e.type for e in events] == [ADDED, MODIFIED, DELETED]
+            assert all(e.kind == KIND_NODE for e in events)
+            assert events[1].object.spec.unschedulable is True
+        finally:
+            sub.stop()
+
+    def test_namespaced_pod_watch_uses_namespaced_endpoint(self, stub_k8s):
+        StubWatchStream.script = [
+            {"type": "ADDED", "object": raw_pod("p1")}]
+        sub = make_cluster().watch(kinds={KIND_POD}, namespace="ns")
+        try:
+            (event,) = self._drain(sub, want=1)
+            assert event.kind == KIND_POD
+            assert event.object.metadata.name == "p1"
+            stream = StubWatchStream.instances[0]
+            assert stream.kwargs.get("namespace") == "ns"
+        finally:
+            sub.stop()
+
+    def test_stop_terminates_streams(self, stub_k8s):
+        StubWatchStream.script = []
+        sub = make_cluster().watch(kinds={KIND_NODE, KIND_POD,
+                                          KIND_DAEMON_SET})
+        deadline = time.monotonic() + 2.0
+        while len(StubWatchStream.instances) < 3 \
+                and time.monotonic() < deadline:
+            time.sleep(0.01)
+        sub.stop()
+        deadline = time.monotonic() + 2.0
+        while time.monotonic() < deadline:
+            if all(s._stopped.is_set() for s in StubWatchStream.instances):
+                break
+            time.sleep(0.01)
+        assert all(s._stopped.is_set() for s in StubWatchStream.instances)
+
+
+class TestImportGate:
+    def test_clear_error_without_kubernetes(self):
+        import importlib.util
+
+        if importlib.util.find_spec("kubernetes") is not None:
+            pytest.skip("kubernetes package installed; gate not reachable")
+        assert "kubernetes" not in sys.modules
+        from tpu_operator_libs.k8s.real import RealCluster
+
+        with pytest.raises(ImportError, match="kubernetes"):
+            RealCluster()

@@ -46,6 +46,16 @@ class TestOrphanedPodPaths:
         mgr.process_pod_restart_nodes(mgr.build_state(NS, RUNTIME_LABELS))
         assert len(env.cluster.list_pods()) == 1  # left terminating
 
+    def test_orphan_in_failed_state_never_uncordons(self):
+        # reference :1212 — UpgradeFailed + orphaned pod (running and
+        # ready, but sync is undecidable without a DaemonSet revision):
+        # auto-recovery must NOT fire; the node stays failed.
+        env = make_env()
+        self._orphan_in_state(env, UpgradeState.FAILED)
+        mgr = make_state_manager(env)
+        mgr.process_upgrade_failed_nodes(mgr.build_state(NS, RUNTIME_LABELS))
+        assert env.state_of("n1") == "upgrade-failed"
+
     def test_orphan_full_requested_flow(self):
         # reference :1144/:1166 — upgrade-requested drives an orphan
         # through cordon; the annotation is consumed
