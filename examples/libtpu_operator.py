@@ -232,6 +232,49 @@ def run_demo(args, registry) -> int:
     return 0 if outcome["converged"] else 1
 
 
+def run_leader_elected(args, cluster, stop: threading.Event,
+                       run_loop) -> None:
+    """Gate the reconcile loop on a coordination.k8s.io Lease, the way a
+    controller-runtime manager does for the reference's consumers. The
+    reconcile loop starts when leadership is acquired and the process
+    exits when it is lost (the standard HA-operator pattern: let the
+    replica controller restart us as a follower)."""
+    import os
+    import socket
+
+    from tpu_operator_libs.k8s.leaderelection import (
+        LeaderElectionConfig,
+        LeaderElector,
+    )
+
+    identity = args.leader_identity \
+        or f"{socket.gethostname()}-{os.getpid()}"
+    loop_thread: list[threading.Thread] = []
+
+    def on_started():
+        logger.info("leader election: became leader as %s", identity)
+        thread = threading.Thread(target=run_loop, daemon=True)
+        thread.start()
+        loop_thread.append(thread)
+
+    def on_stopped():
+        logger.warning("leader election: leadership lost; stopping")
+        stop.set()
+
+    elector = LeaderElector(
+        cluster,
+        LeaderElectionConfig(namespace=args.namespace,
+                             name="tpu-operator-leader",
+                             identity=identity),
+        on_started_leading=on_started,
+        on_stopped_leading=on_stopped,
+        on_new_leader=lambda leader: logger.info(
+            "leader election: current leader is %s", leader))
+    elector.run(stop)
+    for thread in loop_thread:
+        thread.join(timeout=5.0)
+
+
 def main() -> int:
     parser = argparse.ArgumentParser(description=__doc__.split("\n")[0])
     parser.add_argument("--namespace", default="tpu-system")
@@ -254,6 +297,11 @@ def main() -> int:
                         help="gate validation on the local ICI fabric probe")
     parser.add_argument("--kubeconfig", action="store_true",
                         help="connect via local kubeconfig (else in-cluster)")
+    parser.add_argument("--leader-elect", action="store_true",
+                        help="run only while holding the Lease "
+                             "<namespace>/tpu-operator-leader (HA replicas)")
+    parser.add_argument("--leader-identity", default="",
+                        help="contender identity (default: hostname+pid)")
     parser.add_argument("--poll", action="store_true",
                         help="fixed-interval polling instead of the "
                              "default watch-driven reconcile loop")
@@ -282,11 +330,18 @@ def main() -> int:
         stop = threading.Event()
         signal.signal(signal.SIGTERM, lambda *a: stop.set())
         signal.signal(signal.SIGINT, lambda *a: stop.set())
-        if args.poll:
-            reconcile_forever(mgr, args, policy, registry, stop)
+
+        def run_loop():
+            if args.poll:
+                reconcile_forever(mgr, args, policy, registry, stop)
+            else:
+                reconcile_watch_driven(mgr, args, policy, registry, stop,
+                                       cluster)
+
+        if args.leader_elect:
+            run_leader_elected(args, cluster, stop, run_loop)
         else:
-            reconcile_watch_driven(mgr, args, policy, registry, stop,
-                                   cluster)
+            run_loop()
         return 0
     finally:
         if server is not None:

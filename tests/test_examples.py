@@ -41,6 +41,50 @@ class TestDemoOperator:
         spec = load_policy(str(policy_file))
         assert spec.auto_upgrade and spec.max_unavailable == "50%"
 
+    def test_leader_elected_loop_starts_and_hands_over(self):
+        """--leader-elect wiring: the reconcile loop runs only while the
+        Lease is held, and losing it stops the loop (HA replica pattern)."""
+        from examples.libtpu_operator import run_leader_elected
+
+        from tpu_operator_libs.k8s.fake import FakeCluster
+        from tpu_operator_libs.util import FakeClock
+
+        clock = FakeClock()
+        cluster = FakeCluster(clock=clock)
+        stop = threading.Event()
+        loop_ran = threading.Event()
+
+        def run_loop():
+            loop_ran.set()
+            stop.wait(5.0)
+
+        args = type("Args", (), {"namespace": "tpu-system",
+                                 "leader_identity": "test-op"})()
+
+        def usurp(seconds):
+            # once the loop is up, an intruder takes the lease out-of-band
+            assert loop_ran.wait(timeout=5.0)
+            lease = cluster.get_lease("tpu-system", "tpu-operator-leader")
+            assert lease.holder_identity == "test-op"
+            lease.holder_identity = "replica-2"
+            cluster.update_lease(lease)
+            clock.advance(seconds)
+
+        clock.sleep = usurp  # type: ignore
+        # run_leader_elected builds its own LeaderElector with the default
+        # Clock; patch le.Clock so the elector shares the FakeClock and
+        # the whole test stays deterministic and sub-second.
+        import tpu_operator_libs.k8s.leaderelection as le
+
+        orig_clock = le.Clock
+        le.Clock = lambda: clock  # type: ignore
+        try:
+            run_leader_elected(args, cluster, stop, run_loop)
+        finally:
+            le.Clock = orig_clock  # type: ignore
+        assert loop_ran.is_set()
+        assert stop.is_set()
+
     def test_example_policy_yaml_parses(self):
         from examples.libtpu_operator import load_policy
 

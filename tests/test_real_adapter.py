@@ -91,9 +91,13 @@ def stub_k8s():
     client_mod.ApiException = StubApiException
     client_mod.CoreV1Api = lambda api_client=None: recorder
     client_mod.AppsV1Api = lambda api_client=None: recorder
+    client_mod.CoordinationV1Api = lambda api_client=None: recorder
     client_mod.V1Eviction = lambda metadata=None: NS(metadata=metadata)
     client_mod.V1ObjectMeta = lambda name=None, namespace=None: NS(
-        name=name, namespace=namespace)
+        name=name, namespace=namespace, resource_version=None)
+    client_mod.V1Lease = lambda metadata=None, spec=None: NS(
+        metadata=metadata, spec=spec)
+    client_mod.V1LeaseSpec = lambda **kw: NS(**kw)
 
     watch_mod = types.ModuleType("kubernetes.watch")
     watch_mod.Watch = StubWatchStream
@@ -338,6 +342,136 @@ class TestWatchPump:
                 break
             time.sleep(0.01)
         assert all(s._stopped.is_set() for s in StubWatchStream.instances)
+
+
+class TestLeaseContract:
+    def _raw_lease(self, holder="a", rv="abc123", renew_epoch=100.0):
+        class Ts:
+            def __init__(self, epoch):
+                self._epoch = epoch
+
+            def timestamp(self):
+                return self._epoch
+
+        return NS(
+            metadata=NS(name="lock", namespace="kube-system", uid="u1",
+                        resource_version=rv),
+            spec=NS(holder_identity=holder, lease_duration_seconds=15,
+                    acquire_time=Ts(90.0), renew_time=Ts(renew_epoch),
+                    lease_transitions=2))
+
+    def test_get_lease_conversion_keeps_opaque_resource_version(
+            self, stub_k8s):
+        stub_k8s.responses["read_namespaced_lease"] = self._raw_lease()
+        lease = make_cluster().get_lease("kube-system", "lock")
+        assert lease.holder_identity == "a"
+        assert lease.metadata.resource_version == "abc123"  # verbatim
+        assert lease.renew_time == 100.0
+        assert lease.acquire_time == 90.0
+        assert lease.lease_transitions == 2
+        assert stub_k8s.calls[-1] == ("read_namespaced_lease",
+                                      ("lock", "kube-system"), {})
+
+    def test_update_round_trips_version_and_times(self, stub_k8s):
+        from tpu_operator_libs.k8s.objects import Lease, ObjectMeta
+
+        stub_k8s.responses["replace_namespaced_lease"] = self._raw_lease(
+            rv="next")
+        meta = ObjectMeta(name="lock", namespace="kube-system")
+        meta.resource_version = "abc123"
+        lease = Lease(metadata=meta, holder_identity="me",
+                      lease_duration_seconds=15, acquire_time=90.0,
+                      renew_time=120.0, lease_transitions=3)
+        make_cluster().update_lease(lease)
+        method, args, _ = stub_k8s.calls[-1]
+        assert method == "replace_namespaced_lease"
+        name, namespace, body = args
+        assert (name, namespace) == ("lock", "kube-system")
+        assert body.metadata.resource_version == "abc123"
+        assert body.spec.holder_identity == "me"
+        assert body.spec.lease_transitions == 3
+        # epoch -> aware datetime -> epoch must be lossless
+        assert body.spec.renew_time.timestamp() == 120.0
+        assert body.spec.acquire_time.timestamp() == 90.0
+
+    def test_bare_lease_without_spec_reads_as_unheld(self, stub_k8s):
+        # kubectl-applied minimal Lease manifests have spec=None; that
+        # must read as an unheld lock, not wedge every contender with an
+        # untranslated AttributeError
+        stub_k8s.responses["read_namespaced_lease"] = NS(
+            metadata=NS(name="lock", namespace="kube-system", uid="u1",
+                        resource_version="1"),
+            spec=None)
+        lease = make_cluster().get_lease("kube-system", "lock")
+        assert lease.holder_identity == ""
+        assert lease.metadata.resource_version == "1"
+
+    def test_create_omits_resource_version(self, stub_k8s):
+        from tpu_operator_libs.k8s.objects import Lease, ObjectMeta
+
+        stub_k8s.responses["create_namespaced_lease"] = self._raw_lease()
+        make_cluster().create_lease(
+            Lease(metadata=ObjectMeta(name="lock", namespace="kube-system"),
+                  holder_identity="me"))
+        _, args, _ = stub_k8s.calls[-1]
+        namespace, body = args
+        assert namespace == "kube-system"
+        assert body.metadata.resource_version is None
+
+    def test_409_maps_by_operation(self, stub_k8s):
+        from tpu_operator_libs.k8s.client import (
+            AlreadyExistsError,
+            ConflictError,
+        )
+        from tpu_operator_libs.k8s.objects import Lease, ObjectMeta
+
+        lease = Lease(metadata=ObjectMeta(name="lock",
+                                          namespace="kube-system"))
+        stub_k8s.errors["create_namespaced_lease"] = StubApiException(409)
+        with pytest.raises(AlreadyExistsError):
+            make_cluster().create_lease(lease)
+        stub_k8s.errors["replace_namespaced_lease"] = StubApiException(409)
+        with pytest.raises(ConflictError):
+            make_cluster().update_lease(lease)
+        stub_k8s.errors["read_namespaced_lease"] = StubApiException(404)
+        with pytest.raises(NotFoundError):
+            make_cluster().get_lease("kube-system", "lock")
+
+
+class TestElectorOverRealAdapter:
+    def test_elector_acquires_via_stubbed_api(self, stub_k8s):
+        """LeaderElector drives RealCluster's lease methods end-to-end:
+        NotFound -> create -> leading."""
+        from tpu_operator_libs.k8s.leaderelection import (
+            LeaderElectionConfig,
+            LeaderElector,
+        )
+
+        stub_k8s.errors["read_namespaced_lease"] = StubApiException(404)
+
+        def create(namespace, body):
+            raw = NS(metadata=NS(name=body.metadata.name,
+                                 namespace=namespace, uid="u1",
+                                 resource_version="1"),
+                     spec=body.spec)
+            return raw
+
+        stub_k8s.responses["create_namespaced_lease"] = None  # unused
+        recorder = stub_k8s
+        recorder._invoke_orig = recorder._invoke
+
+        def invoke(method, *args, **kwargs):
+            if method == "create_namespaced_lease":
+                recorder.calls.append((method, args, kwargs))
+                return create(*args)
+            return recorder._invoke_orig(method, *args, **kwargs)
+
+        recorder._invoke = invoke
+        elector = LeaderElector(
+            make_cluster(),
+            LeaderElectionConfig("kube-system", "lock", "op-1"))
+        assert elector.try_acquire_or_renew() is True
+        assert elector.is_leader
 
 
 class TestImportGate:

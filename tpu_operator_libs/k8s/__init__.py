@@ -12,12 +12,15 @@ The reference leans on k8s.io/client-go, apimachinery and controller-runtime
   dependency on k8s.io/kubectl/pkg/drain.
 - ``real``: optional adapter to a live cluster via the ``kubernetes`` client
   (import-gated; not required for tests or simulation).
+- ``leaderelection``: Lease-based leader election for HA operator
+  deployments (client-go tools/leaderelection analogue).
 """
 
 from tpu_operator_libs.k8s.objects import (  # noqa: F401
     ContainerStatus,
     ControllerRevision,
     DaemonSet,
+    Lease,
     Node,
     ObjectMeta,
     OwnerReference,
@@ -26,3 +29,7 @@ from tpu_operator_libs.k8s.objects import (  # noqa: F401
 )
 from tpu_operator_libs.k8s.client import K8sClient  # noqa: F401
 from tpu_operator_libs.k8s.fake import FakeCluster  # noqa: F401
+from tpu_operator_libs.k8s.leaderelection import (  # noqa: F401
+    LeaderElectionConfig,
+    LeaderElector,
+)

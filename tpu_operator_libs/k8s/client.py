@@ -98,3 +98,13 @@ class K8sClient(abc.ABC):
 
 class EvictionBlockedError(RuntimeError):
     """Eviction rejected (e.g. by a PodDisruptionBudget)."""
+
+
+class ConflictError(RuntimeError):
+    """Optimistic-concurrency failure: the object's resourceVersion moved
+    between read and write (apierrors.IsConflict analogue)."""
+
+
+class AlreadyExistsError(RuntimeError):
+    """Create of an object that already exists (apierrors.IsAlreadyExists
+    analogue)."""

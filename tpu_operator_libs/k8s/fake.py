@@ -32,6 +32,8 @@ from typing import Callable, Mapping, Optional
 
 from tpu_operator_libs.consts import POD_CONTROLLER_REVISION_HASH_LABEL
 from tpu_operator_libs.k8s.client import (
+    AlreadyExistsError,
+    ConflictError,
     EvictionBlockedError,
     K8sClient,
     NotFoundError,
@@ -40,6 +42,7 @@ from tpu_operator_libs.k8s.objects import (
     ContainerStatus,
     ControllerRevision,
     DaemonSet,
+    Lease,
     Node,
     ObjectMeta,
     OwnerReference,
@@ -104,6 +107,7 @@ class FakeCluster(K8sClient):
         # revisions. (The reference's prefix-scan, pod_manager.go:104-109,
         # has exactly that collision; the fake must not inherit it.)
         self._revision_owner: dict[tuple[str, str], tuple[str, str]] = {}
+        self._leases: dict[tuple[str, str], Lease] = {}
         self._scheduled: list[_ScheduledAction] = []
         self._seq = 0
         self._ds_controller: Optional[_DsControllerConfig] = None
@@ -548,3 +552,46 @@ class FakeCluster(K8sClient):
             return [rev.clone()
                     for (ns, _), rev in self._revisions.items()
                     if ns == namespace and match(rev.metadata.labels)]
+
+    # ------------------------------------------------------------------
+    # coordination.k8s.io Leases (leader-election lock objects)
+    # ------------------------------------------------------------------
+    def get_lease(self, namespace: str, name: str) -> Lease:
+        with self._lock:
+            lease = self._leases.get((namespace, name))
+            if lease is None:
+                raise NotFoundError(f"lease {namespace}/{name} not found")
+            return lease.clone()
+
+    def create_lease(self, lease: Lease) -> Lease:
+        key = (lease.metadata.namespace, lease.metadata.name)
+        with self._lock:
+            if key in self._leases:
+                raise AlreadyExistsError(
+                    f"lease {key[0]}/{key[1]} already exists")
+            stored = lease.clone()
+            stored.metadata.resource_version = 1
+            self._leases[key] = stored
+            return stored.clone()
+
+    def update_lease(self, lease: Lease) -> Lease:
+        """Replace with optimistic concurrency: the caller's
+        resourceVersion must match the stored one or ConflictError is
+        raised — exactly the apiserver contract leader election's
+        acquire race depends on."""
+        key = (lease.metadata.namespace, lease.metadata.name)
+        with self._lock:
+            stored = self._leases.get(key)
+            if stored is None:
+                raise NotFoundError(f"lease {key[0]}/{key[1]} not found")
+            if lease.metadata.resource_version \
+                    != stored.metadata.resource_version:
+                raise ConflictError(
+                    f"lease {key[0]}/{key[1]}: resourceVersion "
+                    f"{lease.metadata.resource_version} != "
+                    f"{stored.metadata.resource_version}")
+            updated = lease.clone()
+            updated.metadata.resource_version = (
+                stored.metadata.resource_version + 1)
+            self._leases[key] = updated
+            return updated.clone()

@@ -288,3 +288,29 @@ class ControllerRevision:
     def clone(self) -> "ControllerRevision":
         return ControllerRevision(metadata=self.metadata.clone(),
                                   revision=self.revision)
+
+
+@dataclass
+class Lease:
+    """A coordination.k8s.io/v1 Lease, the leader-election lock object.
+
+    The reference library leaves leader election to its consumer's
+    controller-runtime manager; a complete TPU operator stack must own it
+    (see k8s/leaderelection.py). Times are epoch seconds (spec.acquireTime /
+    spec.renewTime MicroTime equivalents).
+    """
+
+    metadata: ObjectMeta
+    holder_identity: str = ""
+    lease_duration_seconds: int = 15
+    acquire_time: Optional[float] = None
+    renew_time: Optional[float] = None
+    lease_transitions: int = 0
+
+    def clone(self) -> "Lease":
+        return Lease(metadata=self.metadata.clone(),
+                     holder_identity=self.holder_identity,
+                     lease_duration_seconds=self.lease_duration_seconds,
+                     acquire_time=self.acquire_time,
+                     renew_time=self.renew_time,
+                     lease_transitions=self.lease_transitions)

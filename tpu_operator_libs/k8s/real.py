@@ -23,6 +23,8 @@ from __future__ import annotations
 from typing import Mapping, Optional
 
 from tpu_operator_libs.k8s.client import (
+    AlreadyExistsError,
+    ConflictError,
     EvictionBlockedError,
     K8sClient,
     NotFoundError,
@@ -33,6 +35,7 @@ from tpu_operator_libs.k8s.objects import (
     DaemonSet,
     DaemonSetSpec,
     DaemonSetStatus,
+    Lease,
     Node,
     NodeCondition,
     NodeSpec,
@@ -133,6 +136,7 @@ class RealCluster(K8sClient):
         k8s = _require_kubernetes()
         self._core = k8s.CoreV1Api(api_client)
         self._apps = k8s.AppsV1Api(api_client)
+        self._coordination = k8s.CoordinationV1Api(api_client)
         self._k8s = k8s
 
     @classmethod
@@ -354,3 +358,77 @@ class RealCluster(K8sClient):
         result = self._apps.list_namespaced_controller_revision(
             namespace, label_selector=label_selector or None)
         return [_revision_from(item) for item in result.items]
+
+    # -- leases (coordination.k8s.io, leader election) -----------------------
+    # resourceVersion is opaque on the wire; it is carried through
+    # ObjectMeta.resource_version verbatim (the elector only compares and
+    # round-trips it, fake.py uses ints, the real server strings).
+    @staticmethod
+    def _lease_from(obj) -> Lease:
+        meta = ObjectMeta(
+            name=obj.metadata.name,
+            namespace=obj.metadata.namespace or "",
+            uid=obj.metadata.uid or "")
+        meta.resource_version = obj.metadata.resource_version
+        spec = getattr(obj, "spec", None)
+        if spec is None:
+            # a pre-created bare Lease manifest has no spec: an unheld lock
+            return Lease(metadata=meta)
+        acquire = getattr(spec, "acquire_time", None)
+        renew = getattr(spec, "renew_time", None)
+        return Lease(
+            metadata=meta,
+            holder_identity=spec.holder_identity or "",
+            lease_duration_seconds=int(spec.lease_duration_seconds or 0),
+            acquire_time=acquire.timestamp() if acquire else None,
+            renew_time=renew.timestamp() if renew else None,
+            lease_transitions=int(spec.lease_transitions or 0))
+
+    def _lease_body(self, lease: Lease, with_version: bool):
+        from datetime import datetime, timezone
+
+        def ts(epoch):
+            return (datetime.fromtimestamp(epoch, tz=timezone.utc)
+                    if epoch is not None else None)
+
+        meta = self._k8s.V1ObjectMeta(name=lease.metadata.name,
+                                      namespace=lease.metadata.namespace)
+        if with_version:
+            meta.resource_version = lease.metadata.resource_version
+        return self._k8s.V1Lease(
+            metadata=meta,
+            spec=self._k8s.V1LeaseSpec(
+                holder_identity=lease.holder_identity,
+                lease_duration_seconds=lease.lease_duration_seconds,
+                acquire_time=ts(lease.acquire_time),
+                renew_time=ts(lease.renew_time),
+                lease_transitions=lease.lease_transitions))
+
+    def get_lease(self, namespace: str, name: str) -> Lease:
+        try:
+            return self._lease_from(
+                self._coordination.read_namespaced_lease(name, namespace))
+        except self._k8s.ApiException as exc:
+            raise self._translate(exc) from exc
+
+    def create_lease(self, lease: Lease) -> Lease:
+        try:
+            return self._lease_from(
+                self._coordination.create_namespaced_lease(
+                    lease.metadata.namespace,
+                    self._lease_body(lease, with_version=False)))
+        except self._k8s.ApiException as exc:
+            if getattr(exc, "status", None) == 409:
+                raise AlreadyExistsError(str(exc)) from exc
+            raise self._translate(exc) from exc
+
+    def update_lease(self, lease: Lease) -> Lease:
+        try:
+            return self._lease_from(
+                self._coordination.replace_namespaced_lease(
+                    lease.metadata.name, lease.metadata.namespace,
+                    self._lease_body(lease, with_version=True)))
+        except self._k8s.ApiException as exc:
+            if getattr(exc, "status", None) == 409:
+                raise ConflictError(str(exc)) from exc
+            raise self._translate(exc) from exc
