@@ -111,6 +111,61 @@ class TestDrainHelperFilters:
         with pytest.raises(DrainError):
             self._helper(env).run_node_drain("n1")
 
+    def test_pdb_blocked_eviction_retried_until_unblocked(self):
+        # kubectl evictPods parity: a 429 from a disruption budget is
+        # retried on the poll interval, not a drain failure
+        env = make_env()
+        node = NodeBuilder("n1").create(env.cluster)
+        pod = PodBuilder("w1").on_node(node).orphaned().create(env.cluster)
+        unblock_at = 3.0
+        env.cluster.add_eviction_blocker(
+            lambda p: env.clock.now() < unblock_at)
+        helper = self._helper(env, force=True, timeout_seconds=30,
+                              poll_interval=1.0)
+        helper.delete_or_evict_pods([pod])
+        assert env.cluster.list_pods() == []
+        assert env.clock.now() >= unblock_at  # actually waited
+
+    def test_pdb_blocked_past_timeout_raises(self):
+        env = make_env()
+        node = NodeBuilder("n1").create(env.cluster)
+        pod = PodBuilder("w1").on_node(node).orphaned().create(env.cluster)
+        env.cluster.add_eviction_blocker(lambda p: True)  # forever
+        helper = self._helper(env, force=True, timeout_seconds=5,
+                              poll_interval=1.0)
+        with pytest.raises(DrainTimeoutError, match="disruption budget"):
+            helper.delete_or_evict_pods([pod])
+        assert len(env.cluster.list_pods()) == 1  # never evicted
+
+    def test_pdb_blocked_without_timeout_fails_fast(self):
+        # timeout 0 = infinite termination wait, but a PDB block must NOT
+        # spin forever: without a retry budget it surfaces immediately so
+        # the pod-manager can route the node to drain/failed
+        from tpu_operator_libs.k8s.client import EvictionBlockedError
+
+        env = make_env()
+        node = NodeBuilder("n1").create(env.cluster)
+        pod = PodBuilder("w1").on_node(node).orphaned().create(env.cluster)
+        env.cluster.add_eviction_blocker(lambda p: True)
+        helper = self._helper(env, force=True, timeout_seconds=0)
+        with pytest.raises(EvictionBlockedError):
+            helper.delete_or_evict_pods([pod])
+
+    def test_blocked_pod_does_not_starve_others(self):
+        env = make_env()
+        node = NodeBuilder("n1").create(env.cluster)
+        free = PodBuilder("free").on_node(node).orphaned().create(env.cluster)
+        guarded = PodBuilder("guarded").on_node(node).orphaned() \
+            .create(env.cluster)
+        env.cluster.add_eviction_blocker(
+            lambda p: p.metadata.name == "guarded")
+        helper = self._helper(env, force=True, timeout_seconds=3,
+                              poll_interval=1.0)
+        with pytest.raises(DrainTimeoutError):
+            helper.delete_or_evict_pods([free, guarded])
+        # the unguarded pod went immediately despite the blocked one
+        assert [p.name for p in env.cluster.list_pods()] == ["guarded"]
+
     def test_wait_for_delete_timeout(self):
         env = make_env()
         node = NodeBuilder("n1").create(env.cluster)

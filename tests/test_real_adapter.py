@@ -406,6 +406,27 @@ class TestLeaseContract:
         assert lease.holder_identity == ""
         assert lease.metadata.resource_version == "1"
 
+    def test_update_after_get_preserves_live_metadata(self, stub_k8s):
+        # a renew must replace with the FULL metadata from the last read
+        # (labels/annotations/ownerReferences survive), not a minimal
+        # reconstruction — otherwise every renew strips GC owner refs
+        raw = self._raw_lease(rv="abc123")
+        raw.metadata.labels = {"app": "op"}
+        raw.metadata.owner_references = [NS(kind="Deployment")]
+        stub_k8s.responses["read_namespaced_lease"] = raw
+        stub_k8s.responses["replace_namespaced_lease"] = self._raw_lease(
+            rv="next")
+        cluster = make_cluster()
+        lease = cluster.get_lease("kube-system", "lock")
+        lease.holder_identity = "me"
+        cluster.update_lease(lease)
+        _, args, _ = stub_k8s.calls[-1]
+        body = args[2]
+        assert body.metadata is raw.metadata          # full wire metadata
+        assert body.metadata.labels == {"app": "op"}
+        assert body.metadata.resource_version == "abc123"
+        assert body.spec.holder_identity == "me"
+
     def test_create_omits_resource_version(self, stub_k8s):
         from tpu_operator_libs.k8s.objects import Lease, ObjectMeta
 

@@ -21,7 +21,11 @@ from __future__ import annotations
 from dataclasses import dataclass, field
 from typing import Callable, Optional
 
-from tpu_operator_libs.k8s.client import K8sClient, NotFoundError
+from tpu_operator_libs.k8s.client import (
+    EvictionBlockedError,
+    K8sClient,
+    NotFoundError,
+)
 from tpu_operator_libs.k8s.objects import Pod
 from tpu_operator_libs.util import Clock
 
@@ -143,19 +147,51 @@ class DrainHelper:
 
     def delete_or_evict_pods(self, pods: list[Pod]) -> None:
         """Evict the pods and wait for them to disappear (kubectl
-        DeleteOrEvictPods + waitForDelete)."""
-        for pod in pods:
-            try:
-                self.client.evict_pod(pod.namespace, pod.name)
-            except NotFoundError:
-                continue
-            if self.on_pod_deleted is not None:
-                self.on_pod_deleted(pod)
-        self._wait_for_delete(pods)
+        DeleteOrEvictPods + waitForDelete).
 
-    def _wait_for_delete(self, pods: list[Pod]) -> None:
+        An eviction rejected by a PodDisruptionBudget (API 429) is retried
+        every ``poll_interval`` until the drain timeout — kubectl's
+        evictPods does exactly this on IsTooManyRequests rather than
+        failing the drain on the first blocked pod. Deliberate delta from
+        kubectl: with ``timeout_seconds=0`` (infinite) a blocked eviction
+        raises immediately instead of retrying forever — an unbounded
+        silent wait would pin the node in-progress with no event or state
+        transition; waiting out a PDB requires an explicit retry budget.
+        """
         deadline = (self.clock.now() + self.timeout_seconds
                     if self.timeout_seconds else None)
+        pending = list(pods)
+        while pending:
+            blocked = []
+            first_error: Optional[EvictionBlockedError] = None
+            for pod in pending:
+                try:
+                    self.client.evict_pod(pod.namespace, pod.name)
+                except NotFoundError:
+                    continue
+                except EvictionBlockedError as exc:
+                    blocked.append(pod)
+                    first_error = first_error or exc
+                    continue
+                if self.on_pod_deleted is not None:
+                    self.on_pod_deleted(pod)
+            pending = blocked
+            if pending:
+                if deadline is None:
+                    raise first_error  # no retry budget: fail fast
+                if self.clock.now() >= deadline:
+                    names = ", ".join(p.name for p in pending)
+                    raise DrainTimeoutError(
+                        "evictions blocked by disruption budgets past the "
+                        f"drain timeout: {names}")
+                self.clock.sleep(self.poll_interval)
+        self._wait_for_delete(pods, deadline)
+
+    def _wait_for_delete(self, pods: list[Pod],
+                         deadline: Optional[float] = None) -> None:
+        if deadline is None:
+            deadline = (self.clock.now() + self.timeout_seconds
+                        if self.timeout_seconds else None)
         remaining = list(pods)
         while remaining:
             still_there = []

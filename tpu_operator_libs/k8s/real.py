@@ -138,6 +138,8 @@ class RealCluster(K8sClient):
         self._apps = k8s.AppsV1Api(api_client)
         self._coordination = k8s.CoordinationV1Api(api_client)
         self._k8s = k8s
+        # last-seen raw V1ObjectMeta per lease lock (see lease section)
+        self._lease_raw_meta: dict = {}
 
     @classmethod
     def from_kubeconfig(cls, context: Optional[str] = None) -> "RealCluster":
@@ -363,6 +365,10 @@ class RealCluster(K8sClient):
     # resourceVersion is opaque on the wire; it is carried through
     # ObjectMeta.resource_version verbatim (the elector only compares and
     # round-trips it, fake.py uses ints, the real server strings).
+    # The raw wire metadata of the last-seen lease is cached per lock so
+    # renews replace with the object's FULL metadata (labels, annotations,
+    # ownerReferences for GC) rather than a reconstructed minimal one —
+    # client-go's LeaseLock mutates the Get result for the same reason.
     @staticmethod
     def _lease_from(obj) -> Lease:
         meta = ObjectMeta(
@@ -391,10 +397,18 @@ class RealCluster(K8sClient):
             return (datetime.fromtimestamp(epoch, tz=timezone.utc)
                     if epoch is not None else None)
 
-        meta = self._k8s.V1ObjectMeta(name=lease.metadata.name,
-                                      namespace=lease.metadata.namespace)
-        if with_version:
+        cached = self._lease_raw_meta.get(
+            (lease.metadata.namespace, lease.metadata.name))
+        if with_version and cached is not None:
+            # full wire metadata from the last read: labels/annotations/
+            # ownerReferences survive the replace
+            meta = cached
             meta.resource_version = lease.metadata.resource_version
+        else:
+            meta = self._k8s.V1ObjectMeta(name=lease.metadata.name,
+                                          namespace=lease.metadata.namespace)
+            if with_version:
+                meta.resource_version = lease.metadata.resource_version
         return self._k8s.V1Lease(
             metadata=meta,
             spec=self._k8s.V1LeaseSpec(
@@ -404,31 +418,38 @@ class RealCluster(K8sClient):
                 renew_time=ts(lease.renew_time),
                 lease_transitions=lease.lease_transitions))
 
+    def _cache_lease_meta(self, raw) -> None:
+        self._lease_raw_meta[(raw.metadata.namespace or "",
+                              raw.metadata.name)] = raw.metadata
+
     def get_lease(self, namespace: str, name: str) -> Lease:
         try:
-            return self._lease_from(
-                self._coordination.read_namespaced_lease(name, namespace))
+            raw = self._coordination.read_namespaced_lease(name, namespace)
         except self._k8s.ApiException as exc:
             raise self._translate(exc) from exc
+        self._cache_lease_meta(raw)
+        return self._lease_from(raw)
 
     def create_lease(self, lease: Lease) -> Lease:
         try:
-            return self._lease_from(
-                self._coordination.create_namespaced_lease(
-                    lease.metadata.namespace,
-                    self._lease_body(lease, with_version=False)))
+            raw = self._coordination.create_namespaced_lease(
+                lease.metadata.namespace,
+                self._lease_body(lease, with_version=False))
         except self._k8s.ApiException as exc:
             if getattr(exc, "status", None) == 409:
                 raise AlreadyExistsError(str(exc)) from exc
             raise self._translate(exc) from exc
+        self._cache_lease_meta(raw)
+        return self._lease_from(raw)
 
     def update_lease(self, lease: Lease) -> Lease:
         try:
-            return self._lease_from(
-                self._coordination.replace_namespaced_lease(
-                    lease.metadata.name, lease.metadata.namespace,
-                    self._lease_body(lease, with_version=True)))
+            raw = self._coordination.replace_namespaced_lease(
+                lease.metadata.name, lease.metadata.namespace,
+                self._lease_body(lease, with_version=True))
         except self._k8s.ApiException as exc:
             if getattr(exc, "status", None) == 409:
                 raise ConflictError(str(exc)) from exc
             raise self._translate(exc) from exc
+        self._cache_lease_meta(raw)
+        return self._lease_from(raw)
