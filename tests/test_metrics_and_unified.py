@@ -66,6 +66,66 @@ class TestMetricsRegistry:
                        {"driver": "libtpu", "state": "upgrade-done"}) == 1
         assert reg.get("reconciles_total", {"driver": "libtpu"}) == 1
 
+    def test_histogram_observation_and_rendering(self):
+        reg = MetricsRegistry()
+        labels = {"controller": "c"}
+        for v in (0.003, 0.02, 0.02, 4.0):
+            reg.observe_histogram("reconcile_duration_seconds", v,
+                                  "Reconcile latency", labels)
+        count, total = reg.histogram_stats(
+            "reconcile_duration_seconds", labels)
+        assert count == 4
+        assert total == pytest.approx(4.043)
+        text = reg.render_prometheus()
+        assert ("# TYPE tpu_upgrade_reconcile_duration_seconds histogram"
+                in text)
+        # cumulative le buckets: 0.003 lands in le=0.005, both 0.02s in
+        # le=0.025, 4.0 in le=5
+        assert ('tpu_upgrade_reconcile_duration_seconds_bucket'
+                '{controller="c",le="0.005"} 1') in text
+        assert ('tpu_upgrade_reconcile_duration_seconds_bucket'
+                '{controller="c",le="0.025"} 3') in text
+        assert ('tpu_upgrade_reconcile_duration_seconds_bucket'
+                '{controller="c",le="+Inf"} 4') in text
+        assert ('tpu_upgrade_reconcile_duration_seconds_count'
+                '{controller="c"} 4') in text
+
+    def test_histogram_missing_series(self):
+        reg = MetricsRegistry()
+        assert reg.histogram_stats("nope") is None
+        reg.observe_histogram("h", 1.0, labels={"a": "b"})
+        assert reg.histogram_stats("h", {"a": "other"}) is None
+
+    def test_controller_records_reconcile_duration(self):
+        from tpu_operator_libs.controller import (
+            CLUSTER_KEY,
+            Controller,
+            ReconcileResult,
+        )
+        reg = MetricsRegistry()
+        done = threading.Event()
+        calls = []
+
+        def reconcile(key):
+            calls.append(key)
+            if len(calls) == 1:
+                raise RuntimeError("first pass fails")
+            done.set()
+            return ReconcileResult()
+
+        ctrl = Controller(reconcile, name="metrics-test", metrics=reg)
+        ctrl.start(initial_sync=True)
+        try:
+            assert done.wait(timeout=10.0)
+        finally:
+            ctrl.stop()
+        labels = {"controller": "metrics-test"}
+        count, _total = reg.histogram_stats(
+            "reconcile_duration_seconds", labels)
+        assert count >= 2
+        assert reg.get("reconcile_errors_total", labels) == 1
+        assert reg.get("workqueue_depth", labels) is not None
+
 
 class TestMockedStateMachine:
     """Transition logic in isolation — every seam mocked

@@ -5,6 +5,7 @@ nil-policy tolerance (:136)."""
 
 import pytest
 
+from tpu_operator_libs.api.upgrade_policy import DrainSpec
 from tpu_operator_libs.consts import TRUE_STRING, UpgradeKeys, UpgradeState
 from tpu_operator_libs.upgrade.mocks import mock_managers
 from tpu_operator_libs.upgrade.state_manager import ClusterUpgradeStateManager
@@ -174,3 +175,86 @@ class TestErrorPropagation:
         setup_fleet(env, n_nodes=1)
         mgr = make_state_manager(env)
         mgr.apply_state(mgr.build_state(NS, RUNTIME_LABELS), None)
+
+    def test_drain_manager_error_propagates(self):
+        # reference :707 — a drain-manager scheduling error fails the
+        # ApplyState pass (distinct from an async drain failure, which
+        # lands in upgrade-failed)
+        env = make_env()
+        setup_fleet(env, n_nodes=1, state=UpgradeState.DRAIN_REQUIRED)
+        mgr = make_state_manager(env)
+        from tpu_operator_libs.upgrade.mocks import MockDrainManager
+        mock_drain = MockDrainManager()
+        mock_drain.fail_next = RuntimeError("drain scheduling exploded")
+        mgr.drain_manager = mock_drain
+        pol = policy(drain=DrainSpec(enable=True))
+        with pytest.raises(RuntimeError, match="drain scheduling exploded"):
+            mgr.apply_state(mgr.build_state(NS, RUNTIME_LABELS), pol)
+
+
+class TestThrottlePercentCombos:
+    """maxParallelUpgrades=0 × maxUnavailable percent interplay at the
+    apply_state level (reference :327, :356, :384)."""
+
+    def test_unlimited_parallel_100pct_unavailable_schedules_all(self):
+        # reference :327 — maxParallel=0 + maxUnavailable=100% ⇒ every
+        # upgrade-required node starts at once
+        env = make_env()
+        setup_fleet(env, n_nodes=4, pod_hash="old", ds_hash="new",
+                    state=UpgradeState.UPGRADE_REQUIRED)
+        mgr = make_state_manager(env)
+        pol = policy(max_parallel_upgrades=0, max_unavailable="100%")
+        mgr.apply_state(mgr.build_state(NS, RUNTIME_LABELS), pol)
+        assert all(env.state_of(f"node-{i}") == "cordon-required"
+                   for i in range(4))
+
+    def test_unlimited_parallel_50pct_unavailable_caps_half(self):
+        # reference :356 — maxParallel=0 + maxUnavailable=50% ⇒ half start
+        env = make_env()
+        setup_fleet(env, n_nodes=4, pod_hash="old", ds_hash="new",
+                    state=UpgradeState.UPGRADE_REQUIRED)
+        mgr = make_state_manager(env)
+        pol = policy(max_parallel_upgrades=0, max_unavailable="50%")
+        mgr.apply_state(mgr.build_state(NS, RUNTIME_LABELS), pol)
+        cordoned = sum(1 for i in range(4)
+                       if env.state_of(f"node-{i}") == "cordon-required")
+        assert cordoned == 2
+
+    def test_50pct_with_unavailable_nodes_already_upgraded(self):
+        # reference :384 — cordoned-Done nodes eat the unavailability
+        # budget: 4 nodes, 50% ⇒ 2 allowed, 1 already-cordoned Done node
+        # leaves 1 slot
+        env = make_env()
+        ds = DaemonSetBuilder("libtpu").with_labels(dict(RUNTIME_LABELS)) \
+            .with_desired_scheduled(4).with_revision_hash("new") \
+            .create(env.cluster)
+        for i in range(3):
+            node = NodeBuilder(f"node-{i}").with_upgrade_state(
+                env.keys, UpgradeState.UPGRADE_REQUIRED).create(env.cluster)
+            PodBuilder(f"p-{i}").on_node(node).owned_by(ds) \
+                .with_revision_hash("old").create(env.cluster)
+        done = NodeBuilder("node-3").with_upgrade_state(
+            env.keys, UpgradeState.DONE).unschedulable().create(env.cluster)
+        PodBuilder("p-3").on_node(done).owned_by(ds) \
+            .with_revision_hash("new").create(env.cluster)
+        env.cluster.patch_node_annotations(
+            "node-3", {env.keys.initial_state_annotation: TRUE_STRING})
+        mgr = make_state_manager(env)
+        pol = policy(max_parallel_upgrades=0, max_unavailable="50%")
+        mgr.apply_state(mgr.build_state(NS, RUNTIME_LABELS), pol)
+        cordoned = sum(1 for i in range(3)
+                       if env.state_of(f"node-{i}") == "cordon-required")
+        assert cordoned == 1
+
+
+class TestPodDeletionNilFilter:
+    def test_enable_with_nil_filter_stays_disabled(self):
+        # reference :558 — a PodManager constructed without a deletion
+        # filter must skip the pod-deletion stage entirely
+        env = make_env()
+        setup_fleet(env, n_nodes=1, state=UpgradeState.POD_DELETION_REQUIRED)
+        mgr = make_state_manager(env).with_pod_deletion_enabled(None)
+        assert not mgr.is_pod_deletion_enabled
+        mgr.process_pod_deletion_required_nodes(
+            mgr.build_state(NS, RUNTIME_LABELS), None, True)
+        assert env.state_of("node-0") == "drain-required"

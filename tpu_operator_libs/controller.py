@@ -32,9 +32,12 @@ import logging
 import threading
 import time
 from dataclasses import dataclass
-from typing import Callable, Optional
+from typing import TYPE_CHECKING, Callable, Optional
 
 from tpu_operator_libs.k8s.watch import DELETED, Watch, WatchEvent
+
+if TYPE_CHECKING:
+    from tpu_operator_libs.metrics import MetricsRegistry
 
 logger = logging.getLogger(__name__)
 
@@ -343,9 +346,11 @@ class Controller:
     def __init__(self, reconcile: Callable[[str], Optional[ReconcileResult]],
                  name: str = "upgrade-controller",
                  rate_limiter: Optional[ExponentialBackoffRateLimiter] = None,
-                 resync_period: Optional[float] = None) -> None:
+                 resync_period: Optional[float] = None,
+                 metrics: Optional["MetricsRegistry"] = None) -> None:
         self._reconcile = reconcile
         self._name = name
+        self._metrics = metrics
         self._limiter = rate_limiter or ExponentialBackoffRateLimiter()
         # 0/negative would busy-loop the resync thread; treat as disabled.
         if resync_period is not None and resync_period <= 0:
@@ -468,11 +473,25 @@ class Controller:
                     with self._known_lock:
                         self._known_keys.discard(key)
 
+    def _observe(self, started: float, error: bool) -> None:
+        if self._metrics is None:
+            return
+        labels = {"controller": self._name}
+        self._metrics.observe_histogram(
+            "reconcile_duration_seconds", time.monotonic() - started,
+            "Wall-clock seconds per reconcile pass", labels)
+        if error:
+            self._metrics.inc_counter("reconcile_errors_total",
+                                      "Reconciles that raised", labels)
+        self._metrics.set_gauge("workqueue_depth", len(self.queue),
+                                "Keys queued or delay-pending", labels)
+
     def _worker(self) -> None:
         while not self._stop.is_set():
             key = self.queue.get(timeout=0.5)
             if key is None:
                 continue
+            started = time.monotonic()
             try:
                 result = self._reconcile(key)
             except Exception:
@@ -484,10 +503,12 @@ class Controller:
                                  key, delay)
                 self.queue.done(key)
                 self.queue.add_after(key, delay)
+                self._observe(started, error=True)
                 continue
             with self._count_lock:
                 self._reconcile_count += 1
             self.queue.done(key)
+            self._observe(started, error=False)
             if result is not None and result.forget:
                 self.forget_key(key)
                 continue

@@ -188,10 +188,10 @@ class DrainHelper:
         self._wait_for_delete(pods, deadline)
 
     def _wait_for_delete(self, pods: list[Pod],
-                         deadline: Optional[float] = None) -> None:
-        if deadline is None:
-            deadline = (self.clock.now() + self.timeout_seconds
-                        if self.timeout_seconds else None)
+                         deadline: Optional[float]) -> None:
+        """``deadline`` is the drain-wide deadline computed at drain start
+        (None = unbounded) — shared with the eviction-retry phase so the
+        whole drain honors one timeout."""
         remaining = list(pods)
         while remaining:
             still_there = []

@@ -31,12 +31,34 @@ class _Metric:
         default_factory=dict)
 
 
+#: Default histogram buckets, tuned for reconcile latencies (seconds).
+DEFAULT_BUCKETS = (0.001, 0.0025, 0.005, 0.01, 0.025, 0.05, 0.1, 0.25,
+                   0.5, 1.0, 2.5, 5.0, 10.0, 30.0, 60.0)
+
+
+@dataclass
+class _HistData:
+    bucket_counts: list[int]
+    total: float = 0.0
+    count: int = 0
+
+
+@dataclass
+class _Histogram:
+    name: str
+    help: str
+    buckets: tuple[float, ...]
+    values: dict[tuple[tuple[str, str], ...], _HistData] = field(
+        default_factory=dict)
+
+
 class MetricsRegistry:
     """Thread-safe gauge/counter store with Prometheus text rendering."""
 
     def __init__(self, namespace: str = "tpu_upgrade") -> None:
         self._ns = namespace
         self._metrics: dict[str, _Metric] = {}
+        self._histograms: dict[str, _Histogram] = {}
         self._lock = threading.Lock()
 
     def _metric(self, name: str, help_: str, type_: str) -> _Metric:
@@ -65,6 +87,44 @@ class MetricsRegistry:
             key = self._key(labels)
             m.values[key] = m.values.get(key, 0.0) + by
 
+    def observe_histogram(self, name: str, value: float, help_: str = "",
+                          labels: Optional[dict[str, str]] = None,
+                          buckets: Optional[tuple[float, ...]] = None) -> None:
+        """Record one observation (Prometheus histogram semantics: cumulative
+        ``le`` buckets plus ``_sum``/``_count``). SURVEY.md §5 maps the
+        reference's absent tracing to reconcile-duration metrics — this is
+        that seam."""
+        with self._lock:
+            h = self._histograms.get(name)
+            if h is None:
+                h = _Histogram(name=f"{self._ns}_{name}", help=help_,
+                               buckets=tuple(sorted(
+                                   buckets or DEFAULT_BUCKETS)))
+                self._histograms[name] = h
+            key = self._key(labels)
+            data = h.values.get(key)
+            if data is None:
+                data = _HistData(bucket_counts=[0] * len(h.buckets))
+                h.values[key] = data
+            for i, le in enumerate(h.buckets):
+                if value <= le:
+                    data.bucket_counts[i] += 1
+            data.total += value
+            data.count += 1
+
+    def histogram_stats(
+            self, name: str, labels: Optional[dict[str, str]] = None,
+    ) -> Optional[tuple[int, float]]:
+        """(count, sum) for one histogram series, or None."""
+        with self._lock:
+            h = self._histograms.get(name)
+            if h is None:
+                return None
+            data = h.values.get(self._key(labels))
+            if data is None:
+                return None
+            return data.count, data.total
+
     def get(self, name: str,
             labels: Optional[dict[str, str]] = None) -> Optional[float]:
         with self._lock:
@@ -87,6 +147,23 @@ class MetricsRegistry:
                         lines.append(f"{m.name}{{{rendered}}} {value:g}")
                     else:
                         lines.append(f"{m.name} {value:g}")
+            for h in self._histograms.values():
+                if h.help:
+                    lines.append(f"# HELP {h.name} {h.help}")
+                lines.append(f"# TYPE {h.name} histogram")
+                for key, data in sorted(h.values.items()):
+                    base = ",".join(f'{k}="{v}"' for k, v in key)
+                    sep = "," if base else ""
+                    for le, count in zip(h.buckets, data.bucket_counts):
+                        lines.append(
+                            f'{h.name}_bucket{{{base}{sep}le="{le:g}"}} '
+                            f"{count}")
+                    lines.append(
+                        f'{h.name}_bucket{{{base}{sep}le="+Inf"}} '
+                        f"{data.count}")
+                    suffix = f"{{{base}}}" if base else ""
+                    lines.append(f"{h.name}_sum{suffix} {data.total:g}")
+                    lines.append(f"{h.name}_count{suffix} {data.count}")
         return "\n".join(lines) + "\n"
 
 

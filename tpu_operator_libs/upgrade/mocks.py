@@ -95,10 +95,14 @@ class MockCordonManager(RecordingMixin):
 class MockDrainManager(RecordingMixin):
     def __init__(self) -> None:
         super().__init__()
+        self.fail_next: Optional[Exception] = None
 
     def schedule_nodes_drain(self, config) -> None:
         self.record("schedule_nodes_drain",
                     tuple(n.metadata.name for n in config.nodes))
+        if self.fail_next is not None:
+            exc, self.fail_next = self.fail_next, None
+            raise exc
 
     def join(self, timeout: float = 0.0) -> None:
         pass
