@@ -146,10 +146,12 @@ def reconcile_once(mgr, args, policy, registry, runtime_labels) -> None:
     except BuildStateError as exc:
         logger.info("snapshot incomplete (%s); retrying", exc)
     finally:
-        registry.set_gauge("reconcile_duration_seconds",
-                           time.monotonic() - started,
-                           "Duration of the last reconcile pass",
-                           {"driver": args.driver})
+        # histogram, not gauge: same metric family the watch-driven
+        # Controller records, so dashboards see one latency series
+        registry.observe_histogram("reconcile_duration_seconds",
+                                   time.monotonic() - started,
+                                   "Wall-clock seconds per reconcile pass",
+                                   {"driver": args.driver})
 
 
 def reconcile_forever(mgr, args, policy, registry, stop: threading.Event,
@@ -302,6 +304,9 @@ def main() -> int:
                              "<namespace>/tpu-operator-leader (HA replicas)")
     parser.add_argument("--leader-identity", default="",
                         help="contender identity (default: hostname+pid)")
+    parser.add_argument("--no-cache", action="store_true",
+                        help="read straight from the apiserver instead of "
+                             "the informer-backed read cache")
     parser.add_argument("--poll", action="store_true",
                         help="fixed-interval polling instead of the "
                              "default watch-driven reconcile loop")
@@ -325,13 +330,28 @@ def main() -> int:
 
         cluster = (RealCluster.from_kubeconfig() if args.kubeconfig
                    else RealCluster.in_cluster())
-        mgr = build_manager(args, cluster)
         policy = load_policy(args.policy)
         stop = threading.Event()
         signal.signal(signal.SIGTERM, lambda *a: stop.set())
         signal.signal(signal.SIGINT, lambda *a: stop.set())
 
         def run_loop():
+            # Built here — after leader election is won — so standby
+            # replicas hold no informer caches or watch streams, the way
+            # controller-runtime starts caches only post-election. Reads
+            # go through the cache, writes pass straight through (leases,
+            # evictions unaffected).
+            client = cluster
+            if not args.no_cache:
+                from tpu_operator_libs.k8s.cached import CachedReadClient
+
+                client = CachedReadClient(cluster, args.namespace)
+                if not client.has_synced(timeout=60.0):
+                    logger.error("informer caches failed to sync "
+                                 "within 60s")
+                    stop.set()
+                    return
+            mgr = build_manager(args, client)
             if args.poll:
                 reconcile_forever(mgr, args, policy, registry, stop)
             else:

@@ -1,0 +1,318 @@
+"""CachedReadClient: the controller-runtime cached-client analogue.
+
+The reference's hot loop reads through an informer cache while writes hit
+the apiserver directly (upgrade_state.go:127, SURVEY.md §1 L0); the
+provider's read-back poll (node_upgrade_state_provider.go:100-117) exists
+precisely because such reads are eventually consistent. These tests pin:
+initial-sync barrier, selector semantics matching the fake apiserver,
+value semantics, write pass-through with eventual cache convergence, and
+a full rolling upgrade running every read through the cache.
+"""
+
+import time
+
+import pytest
+
+from tpu_operator_libs.api.upgrade_policy import DrainSpec, UpgradePolicySpec
+from tpu_operator_libs.consts import UpgradeKeys, UpgradeState
+from tpu_operator_libs.k8s.cached import CachedReadClient, CacheNotSyncedError
+from tpu_operator_libs.k8s.client import NotFoundError
+from tpu_operator_libs.simulate import (
+    NS,
+    RUNTIME_LABELS,
+    FleetSpec,
+    build_fleet,
+)
+from tpu_operator_libs.upgrade.state_manager import (
+    BuildStateError,
+    ClusterUpgradeStateManager,
+)
+from tpu_operator_libs.upgrade.state_provider import NodeUpgradeStateProvider
+
+from builders import DaemonSetBuilder, NodeBuilder, PodBuilder
+from helpers import make_env
+
+
+def make_cached(env, namespace="tpu-system"):
+    cached = CachedReadClient(env.cluster, namespace)
+    assert cached.has_synced(timeout=5.0)
+    return cached
+
+
+def wait_until(predicate, timeout=5.0, interval=0.005):
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        if predicate():
+            return True
+        time.sleep(interval)
+    return predicate()
+
+
+class TestCacheReads:
+    def test_sync_barrier(self):
+        env = make_env()
+        NodeBuilder("n1").create(env.cluster)
+        cached = CachedReadClient(env.cluster, "tpu-system")
+        assert cached.has_synced(timeout=5.0)
+        assert cached.get_node("n1").metadata.name == "n1"
+        cached.stop()
+
+    def test_unsynced_read_raises(self):
+        env = make_env()
+
+        class NeverListing:
+            """Delegate whose initial pod list hangs forever."""
+
+            def __getattr__(self, name):
+                return getattr(env.cluster, name)
+
+            def list_pods(self, namespace=None, label_selector="",
+                          field_selector=""):
+                time.sleep(3600)
+
+        cached = CachedReadClient(NeverListing(), "tpu-system")
+        with pytest.raises(CacheNotSyncedError):
+            cached.get_node("missing")
+
+    def test_label_selector_parity_with_fake(self):
+        env = make_env()
+        NodeBuilder("a").with_labels({"pool": "x"}).create(env.cluster)
+        NodeBuilder("b").with_labels({"pool": "y"}).create(env.cluster)
+        cached = make_cached(env)
+        for selector in ("pool=x", "pool!=x", "pool in (x,y)", ""):
+            direct = {n.metadata.name
+                      for n in env.cluster.list_nodes(selector)}
+            via_cache = {n.metadata.name
+                         for n in cached.list_nodes(selector)}
+            assert via_cache == direct, selector
+        cached.stop()
+
+    def test_pod_field_selector_parity_with_fake(self):
+        env = make_env()
+        node = NodeBuilder("n1").create(env.cluster)
+        PodBuilder("p1").on_node(node).orphaned().create(env.cluster)
+        PodBuilder("p2").on_node("elsewhere").orphaned().create(env.cluster)
+        cached = make_cached(env)
+        for fs in ("spec.nodeName=n1", "metadata.name=p2",
+                   "status.phase=Running"):
+            direct = {p.metadata.name for p in env.cluster.list_pods(
+                namespace="tpu-system", field_selector=fs)}
+            via_cache = {p.metadata.name for p in cached.list_pods(
+                namespace="tpu-system", field_selector=fs)}
+            assert via_cache == direct, fs
+        cached.stop()
+
+    def test_value_semantics(self):
+        env = make_env()
+        NodeBuilder("n1").create(env.cluster)
+        cached = make_cached(env)
+        cached.get_node("n1").metadata.labels["poison"] = "true"
+        assert "poison" not in cached.get_node("n1").metadata.labels
+        cached.stop()
+
+    def test_missing_node_raises_not_found(self):
+        env = make_env()
+        cached = make_cached(env)
+        with pytest.raises(NotFoundError):
+            cached.get_node("ghost")
+        cached.stop()
+
+    def test_other_namespace_falls_through_to_delegate(self):
+        env = make_env()
+        node = NodeBuilder("n1").create(env.cluster)
+        PodBuilder("other", namespace="elsewhere").on_node(node) \
+            .orphaned().create(env.cluster)
+        cached = make_cached(env)
+        names = {p.metadata.name for p in cached.list_pods("elsewhere")}
+        assert names == {"other"}
+        cached.stop()
+
+    def test_all_namespaces_query_sees_workload_pods(self):
+        # namespace=None means ALL namespaces (pod_manager.go:323-331):
+        # the drain path uses it to find workload pods outside the
+        # operator namespace — the single-namespace cache must not
+        # swallow them
+        env = make_env()
+        node = NodeBuilder("n1").create(env.cluster)
+        PodBuilder("runtime").on_node(node).orphaned().create(env.cluster)
+        PodBuilder("train", namespace="ml").on_node(node) \
+            .orphaned().create(env.cluster)
+        cached = make_cached(env)
+        for namespace in (None, ""):
+            names = {p.metadata.name for p in cached.list_pods(
+                namespace=namespace, field_selector="spec.nodeName=n1")}
+            assert names == {"runtime", "train"}, namespace
+        cached.stop()
+
+
+class TestWriteThroughAndConvergence:
+    def test_patch_visible_in_cache_eventually(self):
+        env = make_env()
+        NodeBuilder("n1").create(env.cluster)
+        cached = make_cached(env)
+        cached.patch_node_labels("n1", {env.keys.state_label: "upgrade-done"})
+        assert wait_until(lambda: cached.get_node("n1").metadata.labels
+                          .get(env.keys.state_label) == "upgrade-done")
+        cached.stop()
+
+    def test_provider_readback_poll_absorbs_staleness(self):
+        # the reference's reason for the poll: patch via apiserver, then
+        # poll the *cache* until it reflects the change
+        # (node_upgrade_state_provider.go:100-117)
+        env = make_env()
+        node = NodeBuilder("n1").create(env.cluster)
+        cached = make_cached(env)
+        # a real clock: the poll waits on the informer thread, which runs
+        # in real time (a FakeClock would burn the timeout instantly)
+        from tpu_operator_libs.util import Clock
+        provider = NodeUpgradeStateProvider(
+            cached, env.keys, env.recorder, Clock(),
+            sync_timeout=5.0, poll_interval=0.005)
+        provider.change_node_upgrade_state(node, UpgradeState.DONE)
+        assert (cached.get_node("n1").metadata.labels[env.keys.state_label]
+                == "upgrade-done")
+        cached.stop()
+
+    def test_delete_pod_disappears_from_cache(self):
+        env = make_env()
+        node = NodeBuilder("n1").create(env.cluster)
+        PodBuilder("p1").on_node(node).orphaned().create(env.cluster)
+        cached = make_cached(env)
+        cached.delete_pod("tpu-system", "p1")
+        assert wait_until(
+            lambda: cached.list_pods("tpu-system") == [])
+        cached.stop()
+
+
+class _GappedWatchDelegate:
+    """Delegate whose watches never deliver events — models a live watch
+    stream gap (expired server watch) during which objects change."""
+
+    def __init__(self, cluster):
+        self._cluster = cluster
+
+    def __getattr__(self, name):
+        return getattr(self._cluster, name)
+
+    def watch(self, kinds=None, namespace=None):
+        from tpu_operator_libs.k8s.watch import Watch
+        return Watch()
+
+
+class TestRelistReplace:
+    def test_refresh_prunes_ghost_pod_after_watch_gap(self):
+        # client-go Reflector.Replace semantics: a pod deleted during a
+        # watch gap must not be served from the cache forever (it would
+        # wedge _wait_for_delete in a permanent DrainTimeoutError)
+        env = make_env()
+        node = NodeBuilder("n1").create(env.cluster)
+        PodBuilder("ghost").on_node(node).orphaned().create(env.cluster)
+        cached = CachedReadClient(_GappedWatchDelegate(env.cluster),
+                                  "tpu-system", relist_interval=None)
+        assert cached.has_synced(timeout=5.0)
+        env.cluster.delete_pod("tpu-system", "ghost")
+        # gap: no DELETED event reaches the informer
+        assert [p.metadata.name
+                for p in cached.list_pods("tpu-system")] == ["ghost"]
+        cached.refresh()
+        assert cached.list_pods("tpu-system") == []
+        cached.stop()
+
+    def test_refresh_picks_up_missed_add_and_update(self):
+        env = make_env()
+        NodeBuilder("n1").create(env.cluster)
+        cached = CachedReadClient(_GappedWatchDelegate(env.cluster),
+                                  "tpu-system", relist_interval=None)
+        assert cached.has_synced(timeout=5.0)
+        env.cluster.patch_node_labels("n1", {"pool": "x"})
+        NodeBuilder("n2").create(env.cluster)
+        cached.refresh()
+        assert cached.get_node("n1").metadata.labels.get("pool") == "x"
+        assert cached.get_node("n2").metadata.name == "n2"
+        cached.stop()
+
+    def test_periodic_relist_thread_converges_without_events(self):
+        env = make_env()
+        node = NodeBuilder("n1").create(env.cluster)
+        PodBuilder("ghost").on_node(node).orphaned().create(env.cluster)
+        cached = CachedReadClient(_GappedWatchDelegate(env.cluster),
+                                  "tpu-system", relist_interval=0.02)
+        assert cached.has_synced(timeout=5.0)
+        env.cluster.delete_pod("tpu-system", "ghost")
+        assert wait_until(lambda: cached.list_pods("tpu-system") == [])
+        cached.stop()
+
+    def test_informer_refresh_fires_delete_handlers(self):
+        from tpu_operator_libs.controller import Informer
+        from tpu_operator_libs.k8s.watch import Watch
+        env = make_env()
+        node = NodeBuilder("n1").create(env.cluster)
+        PodBuilder("p1").on_node(node).orphaned().create(env.cluster)
+        informer = Informer(lambda: env.cluster.list_pods("tpu-system"),
+                            Watch(), name="t")
+        deleted = []
+        informer.add_event_handler(on_delete=lambda p: deleted.append(
+            p.metadata.name))
+        informer.start()
+        assert informer.has_synced(timeout=5.0)
+        env.cluster.delete_pod("tpu-system", "p1")
+        informer.refresh()
+        assert deleted == ["p1"]
+        assert len(informer) == 0
+        informer.stop()
+
+    def test_has_synced_budget_is_shared_not_per_cache(self):
+        env = make_env()
+
+        class SlowPodList:
+            def __getattr__(self, name):
+                return getattr(env.cluster, name)
+
+            def list_pods(self, namespace=None, label_selector="",
+                          field_selector=""):
+                time.sleep(3600)
+
+        cached = CachedReadClient(SlowPodList(), "tpu-system",
+                                  relist_interval=None)
+        started = time.monotonic()
+        assert not cached.has_synced(timeout=0.3)
+        elapsed = time.monotonic() - started
+        assert elapsed < 0.9  # one shared budget, not 0.3s x 3 caches
+
+
+class TestRollingUpgradeThroughCache:
+    def test_full_upgrade_with_cached_reads(self):
+        fleet = FleetSpec(n_slices=2, hosts_per_slice=2,
+                          pod_recreate_delay=1.0, pod_ready_delay=1.0)
+        cluster, clock, keys = build_fleet(fleet)
+        cached = CachedReadClient(cluster, NS)
+        assert cached.has_synced(timeout=5.0)
+        mgr = ClusterUpgradeStateManager(
+            cached, keys, async_workers=False, poll_interval=0.005)
+        policy = UpgradePolicySpec(
+            auto_upgrade=True, max_parallel_upgrades=0,
+            max_unavailable="50%",
+            drain=DrainSpec(enable=True, force=True))
+
+        def all_done():
+            return all(
+                n.metadata.labels.get(keys.state_label) == "upgrade-done"
+                and not n.spec.unschedulable
+                for n in cluster.list_nodes())
+
+        for _ in range(200):
+            clock.advance(5.0)
+            cluster.step()
+            try:
+                mgr.reconcile(NS, dict(RUNTIME_LABELS), policy)
+            except BuildStateError:
+                pass  # pod between delete and recreate; retry
+            if all_done():
+                break
+            # let watch events drain into the informer caches
+            time.sleep(0.002)
+        assert all_done()
+        hashes = {p.metadata.labels.get("controller-revision-hash")
+                  for p in cluster.list_pods(NS)}
+        assert hashes == {"new"}
+        cached.stop()

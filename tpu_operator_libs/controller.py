@@ -299,6 +299,39 @@ class Informer:
     def has_synced(self, timeout: Optional[float] = None) -> bool:
         return self._synced.wait(timeout=timeout)
 
+    def refresh(self) -> None:
+        """Relist and replace the store (client-go ``Reflector.Replace``).
+
+        A restarted live watch re-delivers current objects as ADDED but
+        never emits DELETED for objects removed during the stream gap, so
+        a long-lived cache must periodically reconcile against a full
+        list: objects that vanished get their delete handlers fired and
+        are pruned; present objects dispatch add/update as usual."""
+        objects = self._lister()
+        fresh: dict[tuple[str, str], object] = {}
+        for obj in objects:
+            try:
+                fresh[self._key_fn(obj)] = obj
+            except Exception:
+                logger.exception("%s: key function failed on relisted "
+                                 "object", self._name)
+        with self._store_lock:
+            stale = [self._store[k] for k in self._store if k not in fresh]
+            old_by_key = {k: self._store.get(k) for k in fresh}
+            self._store = dict(fresh)
+        for obj in stale:
+            for _, _, on_delete in self._handlers:
+                if on_delete is not None:
+                    self._safe(on_delete, obj)
+        for key, obj in fresh.items():
+            old = old_by_key.get(key)
+            if old is None:
+                self._dispatch_add(obj)
+            else:
+                for _, on_update, _ in self._handlers:
+                    if on_update is not None:
+                        self._safe(on_update, old, obj)
+
     def get(self, namespace: str, name: str) -> Optional[object]:
         with self._store_lock:
             return self._store.get((namespace, name))

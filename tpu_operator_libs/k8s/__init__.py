@@ -14,6 +14,9 @@ The reference leans on k8s.io/client-go, apimachinery and controller-runtime
   (import-gated; not required for tests or simulation).
 - ``leaderelection``: Lease-based leader election for HA operator
   deployments (client-go tools/leaderelection analogue).
+- ``cached``: informer-backed read cache over any backend — the
+  controller-runtime cached-client analogue the provider's read-back
+  poll was designed against.
 """
 
 from tpu_operator_libs.k8s.objects import (  # noqa: F401
@@ -27,6 +30,7 @@ from tpu_operator_libs.k8s.objects import (  # noqa: F401
     Pod,
     PodPhase,
 )
+from tpu_operator_libs.k8s.cached import CachedReadClient  # noqa: F401
 from tpu_operator_libs.k8s.client import K8sClient  # noqa: F401
 from tpu_operator_libs.k8s.fake import FakeCluster  # noqa: F401
 from tpu_operator_libs.k8s.leaderelection import (  # noqa: F401

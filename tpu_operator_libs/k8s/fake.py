@@ -70,12 +70,7 @@ from tpu_operator_libs.util import Clock
 
 
 def _pod_fields(pod: Pod) -> dict[str, str]:
-    return {
-        "metadata.name": pod.metadata.name,
-        "metadata.namespace": pod.metadata.namespace,
-        "spec.nodeName": pod.spec.node_name,
-        "status.phase": str(pod.status.phase),
-    }
+    return pod.field_map()
 
 
 @dataclass

@@ -215,6 +215,17 @@ class Pod:
     def is_mirror_pod(self) -> bool:
         return "kubernetes.io/config.mirror" in self.metadata.annotations
 
+    def field_map(self) -> dict[str, str]:
+        """The pod's field-selector-addressable fields (the subset the
+        apiserver supports for pods; shared by every client backend so
+        field-selector semantics cannot drift between fake and cache)."""
+        return {
+            "metadata.name": self.metadata.name,
+            "metadata.namespace": self.metadata.namespace,
+            "spec.nodeName": self.spec.node_name,
+            "status.phase": str(self.status.phase),
+        }
+
     def clone(self) -> "Pod":
         return Pod(
             metadata=self.metadata.clone(),
