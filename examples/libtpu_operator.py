@@ -335,6 +335,8 @@ def main() -> int:
         signal.signal(signal.SIGTERM, lambda *a: stop.set())
         signal.signal(signal.SIGINT, lambda *a: stop.set())
 
+        exit_code = [0]
+
         def run_loop():
             # Built here — after leader election is won — so standby
             # replicas hold no informer caches or watch streams, the way
@@ -342,27 +344,34 @@ def main() -> int:
             # go through the cache, writes pass straight through (leases,
             # evictions unaffected).
             client = cluster
+            cached = None
             if not args.no_cache:
                 from tpu_operator_libs.k8s.cached import CachedReadClient
 
-                client = CachedReadClient(cluster, args.namespace)
-                if not client.has_synced(timeout=60.0):
+                client = cached = CachedReadClient(cluster, args.namespace)
+                if not cached.has_synced(timeout=60.0):
                     logger.error("informer caches failed to sync "
                                  "within 60s")
+                    cached.stop()
+                    exit_code[0] = 1  # startup failure must not exit 0
                     stop.set()
                     return
-            mgr = build_manager(args, client)
-            if args.poll:
-                reconcile_forever(mgr, args, policy, registry, stop)
-            else:
-                reconcile_watch_driven(mgr, args, policy, registry, stop,
-                                       cluster)
+            try:
+                mgr = build_manager(args, client)
+                if args.poll:
+                    reconcile_forever(mgr, args, policy, registry, stop)
+                else:
+                    reconcile_watch_driven(mgr, args, policy, registry,
+                                           stop, cluster)
+            finally:
+                if cached is not None:
+                    cached.stop()
 
         if args.leader_elect:
             run_leader_elected(args, cluster, stop, run_loop)
         else:
             run_loop()
-        return 0
+        return exit_code[0]
     finally:
         if server is not None:
             server.shutdown()

@@ -261,6 +261,86 @@ class TestRelistReplace:
         assert len(informer) == 0
         informer.stop()
 
+    def test_refresh_never_reverts_state_applied_after_list_start(self):
+        # the relist races the watch pump; a snapshot taken at T0 must not
+        # clobber an event applied at T1>T0 (client-go serializes Replace
+        # through DeltaFIFO for exactly this)
+        from tpu_operator_libs.controller import Informer
+        from tpu_operator_libs.k8s.watch import (
+            KIND_NODE,
+            MODIFIED,
+            Watch,
+            WatchEvent,
+        )
+        env = make_env()
+        NodeBuilder("n1").create(env.cluster)
+        informer_box = []
+
+        def lister():
+            snapshot = env.cluster.list_nodes()  # stale from T0
+            if informer_box and informer_box[0].has_synced(timeout=0):
+                # an event lands while the list RPC is in flight
+                fresh = env.cluster.patch_node_labels("n1", {"pool": "x"})
+                informer_box[0]._apply(WatchEvent(MODIFIED, KIND_NODE,
+                                                  fresh))
+            return snapshot
+
+        informer = Informer(lister, Watch(), name="t")
+        informer_box.append(informer)
+        informer.start()
+        assert informer.has_synced(timeout=5.0)
+        informer.refresh()
+        assert informer.get("", "n1").metadata.labels.get("pool") == "x"
+        informer.stop()
+
+    def test_refresh_does_not_resurrect_mid_list_deletion(self):
+        from tpu_operator_libs.controller import Informer
+        from tpu_operator_libs.k8s.watch import (
+            DELETED,
+            KIND_POD,
+            Watch,
+            WatchEvent,
+        )
+        env = make_env()
+        node = NodeBuilder("n1").create(env.cluster)
+        PodBuilder("p1").on_node(node).orphaned().create(env.cluster)
+        informer_box = []
+
+        def lister():
+            snapshot = env.cluster.list_pods("tpu-system")  # contains p1
+            if informer_box and informer_box[0].has_synced(timeout=0):
+                gone = env.cluster.get_pod("tpu-system", "p1")
+                env.cluster.delete_pod("tpu-system", "p1")
+                informer_box[0]._apply(WatchEvent(DELETED, KIND_POD, gone))
+            return snapshot
+
+        informer = Informer(lister, Watch(), name="t")
+        informer_box.append(informer)
+        informer.start()
+        assert informer.has_synced(timeout=5.0)
+        informer.refresh()
+        assert informer.get("tpu-system", "p1") is None
+        informer.stop()
+
+    def test_refresh_suppresses_noop_updates(self):
+        from tpu_operator_libs.controller import Informer
+        from tpu_operator_libs.k8s.watch import Watch
+        env = make_env()
+        NodeBuilder("n1").create(env.cluster)
+        informer = Informer(env.cluster.list_nodes, Watch(), name="t")
+        updates = []
+        informer.add_event_handler(
+            on_update=lambda old, new: updates.append(new.metadata.name))
+        informer.start()
+        assert informer.has_synced(timeout=5.0)
+        informer.refresh()
+        informer.refresh()
+        assert updates == []  # nothing changed: no reconcile storm
+        env.cluster.patch_node_labels("n1", {"pool": "x"})
+        informer.refresh()
+        assert updates == ["n1"]
+        informer.stop()
+
     def test_has_synced_budget_is_shared_not_per_cache(self):
         env = make_env()
 

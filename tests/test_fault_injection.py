@@ -130,3 +130,207 @@ class TestNotReadyNode:
         assert r.converged
         # nothing could start while the sick node consumed the budget
         assert r.total_seconds > 300.0
+
+
+class TestTransientApiErrors:
+    """Injected apiserver failures (5xx analogue): the pass aborts, the
+    next reconcile retries, and the machine still converges — the
+    reference's abort-on-first-error + re-reconcile contract
+    (upgrade_state.go:420-423)."""
+
+    def test_injection_budget_is_consumed_per_call(self):
+        import pytest
+
+        from tpu_operator_libs.k8s.client import ApiServerError
+        from tpu_operator_libs.k8s.fake import FakeCluster
+        from tpu_operator_libs.k8s.objects import Node, ObjectMeta
+
+        cluster = FakeCluster()
+        cluster.add_node(Node(metadata=ObjectMeta(name="n1")))
+        cluster.inject_api_errors("get_node", 2)
+        for _ in range(2):
+            with pytest.raises(ApiServerError):
+                cluster.get_node("n1")
+        assert cluster.get_node("n1").metadata.name == "n1"
+
+    def test_custom_exception_factory(self):
+        import pytest
+
+        from tpu_operator_libs.k8s.fake import FakeCluster
+
+        cluster = FakeCluster()
+        cluster.inject_api_errors("list_nodes", 1,
+                                  lambda: TimeoutError("etcd slow"))
+        with pytest.raises(TimeoutError):
+            cluster.list_nodes()
+        assert cluster.list_nodes() == []
+        # a later injection without a factory gets the documented default,
+        # not the exhausted custom one
+        from tpu_operator_libs.k8s.client import ApiServerError
+
+        cluster.inject_api_errors("list_nodes", 1)
+        with pytest.raises(ApiServerError):
+            cluster.list_nodes()
+
+    def test_rolling_upgrade_converges_through_flaky_apiserver(self):
+        """Every mutation/read op fails intermittently throughout the
+        whole upgrade; convergence must still happen and every observed
+        node transition must be a legal state-graph edge."""
+        import random
+
+        from test_e2e_scenarios import assert_transitions_legal
+
+        from tpu_operator_libs.api.upgrade_policy import (
+            DrainSpec,
+            UpgradePolicySpec,
+        )
+        from tpu_operator_libs.simulate import (
+            NS,
+            RUNTIME_LABELS,
+            FleetSpec,
+            build_fleet,
+        )
+        from tpu_operator_libs.upgrade.state_manager import (
+            BuildStateError,
+            ClusterUpgradeStateManager,
+        )
+
+        fleet = FleetSpec(n_slices=2, hosts_per_slice=2,
+                          pod_recreate_delay=5.0, pod_ready_delay=10.0)
+        cluster, clock, keys = build_fleet(fleet)
+        mgr = ClusterUpgradeStateManager(
+            cluster, keys, async_workers=False, poll_interval=0.0)
+        policy = UpgradePolicySpec(
+            auto_upgrade=True, max_parallel_upgrades=0,
+            max_unavailable="50%",
+            drain=DrainSpec(enable=True, force=True))
+        rng = random.Random(7)
+        flaky_ops = ["get_node", "list_pods", "patch_node_labels",
+                     "patch_node_annotations", "set_node_unschedulable",
+                     "delete_pod", "evict_pod", "list_daemon_sets",
+                     "list_controller_revisions"]
+        trails: dict[str, list[str]] = {
+            n.metadata.name: [""] for n in cluster.list_nodes()}
+        converged = False
+        for i in range(400):
+            # one op flakes per reconcile, on average
+            if rng.random() < 0.8:
+                cluster.inject_api_errors(rng.choice(flaky_ops), 1)
+            try:
+                state = mgr.build_state(NS, dict(RUNTIME_LABELS))
+                mgr.apply_state(state, policy)
+            except BuildStateError:
+                pass
+            except Exception:
+                pass  # transient apiserver error: pass aborted, retry
+            for node in cluster.list_nodes():
+                label = node.metadata.labels.get(keys.state_label, "")
+                if trails[node.metadata.name][-1] != label:
+                    trails[node.metadata.name].append(label)
+            if all(t[-1] == "upgrade-done" for t in trails.values()):
+                converged = True
+                break
+            clock.advance(10.0)
+            cluster.step()
+        assert converged, {k: v[-1] for k, v in trails.items()}
+        assert_transitions_legal(trails)
+        # and the fleet really finished: new revision everywhere, nothing
+        # left cordoned
+        hashes = {p.metadata.labels.get("controller-revision-hash")
+                  for p in cluster.list_pods(NS)}
+        assert hashes == {"new"}
+        assert not any(n.is_unschedulable() for n in cluster.list_nodes())
+
+
+class TestTransientErrorsDontConsumeFailureBudget:
+    """A 5xx during an async worker must defer (state unchanged, retried
+    next reconcile), not mark upgrade-failed: a failed node with an
+    out-of-sync pod can never auto-recover (upgrade_state.go:835-877), so
+    escalation would strand it until manual intervention."""
+
+    def _drain_fleet(self):
+        import sys
+
+        sys.path.insert(0, "tests")
+        from helpers import make_drain_manager, make_env
+        from test_state_manager import setup_fleet
+
+        from tpu_operator_libs.consts import UpgradeState
+
+        env = make_env()
+        _, nodes = setup_fleet(env, n_nodes=1,
+                               state=UpgradeState.DRAIN_REQUIRED)
+        return env, nodes, make_drain_manager(env)
+
+    def test_transient_cordon_error_defers_drain(self):
+        from tpu_operator_libs.api.upgrade_policy import DrainSpec
+        from tpu_operator_libs.upgrade.drain_manager import (
+            DrainConfiguration,
+        )
+
+        env, nodes, dm = self._drain_fleet()
+        env.cluster.inject_api_errors("set_node_unschedulable", 1)
+        dm.schedule_nodes_drain(DrainConfiguration(
+            spec=DrainSpec(enable=True, force=True), nodes=nodes))
+        dm.join()
+        # state unchanged: retried on the next reconcile
+        assert env.state_of("node-0") == "drain-required"
+        # and the retry succeeds
+        dm.schedule_nodes_drain(DrainConfiguration(
+            spec=DrainSpec(enable=True, force=True), nodes=nodes))
+        dm.join()
+        assert env.state_of("node-0") == "pod-restart-required"
+
+    def test_hard_drain_failure_still_fails_the_node(self):
+        import sys
+
+        sys.path.insert(0, "tests")
+        from builders import PodBuilder
+
+        from tpu_operator_libs.api.upgrade_policy import DrainSpec
+        from tpu_operator_libs.upgrade.drain_manager import (
+            DrainConfiguration,
+        )
+
+        env, nodes, dm = self._drain_fleet()
+        # an unreplicated pod without force is a semantic failure, not a
+        # transient one — the upgrade-failed escalation must survive
+        PodBuilder("block").on_node(nodes[0]).orphaned().create(env.cluster)
+        dm.schedule_nodes_drain(DrainConfiguration(
+            spec=DrainSpec(enable=True, force=False), nodes=nodes))
+        dm.join()
+        assert env.state_of("node-0") == "upgrade-failed"
+
+    def test_transient_eviction_error_defers_pod_deletion(self):
+        import sys
+
+        sys.path.insert(0, "tests")
+        from builders import PodBuilder
+        from helpers import make_env, make_pod_manager
+        from test_state_manager import setup_fleet
+
+        from tpu_operator_libs.api.upgrade_policy import PodDeletionSpec
+        from tpu_operator_libs.consts import UpgradeState
+        from tpu_operator_libs.upgrade.pod_manager import PodManagerConfig
+
+        env = make_env()
+        _, nodes = setup_fleet(env, n_nodes=1,
+                               state=UpgradeState.POD_DELETION_REQUIRED)
+        PodBuilder("victim").on_node(nodes[0]).orphaned() \
+            .with_labels({"tpu-job": "true"}).create(env.cluster)
+        pm = make_pod_manager(
+            env, deletion_filter=lambda pod:
+            pod.metadata.labels.get("tpu-job") == "true")
+        env.cluster.inject_api_errors("evict_pod", 1)
+        pm.schedule_pod_eviction(PodManagerConfig(
+            nodes=list(nodes), deletion_spec=PodDeletionSpec(force=True),
+            drain_enabled=False))
+        pm.join()
+        # deferred, not failed — and still in place for the retry
+        assert env.state_of("node-0") == "pod-deletion-required"
+        pm.schedule_pod_eviction(PodManagerConfig(
+            nodes=list(nodes), deletion_spec=PodDeletionSpec(force=True),
+            drain_enabled=False))
+        pm.join()
+        assert env.state_of("node-0") == "pod-restart-required"
+        assert "victim" not in [p.name for p in env.cluster.list_pods()]

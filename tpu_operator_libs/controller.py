@@ -202,6 +202,12 @@ class Informer:
         self._key_fn = key_fn
         self._name = name
         self._store: dict[tuple[str, str], object] = {}
+        # Monotonic time of the last watch-event apply per key; deleted
+        # keys keep their entry as a tombstone. refresh() consults these
+        # so a list snapshot can never overwrite state applied after the
+        # list began (client-go serializes Replace through DeltaFIFO for
+        # the same reason).
+        self._last_applied: dict[tuple[str, str], float] = {}
         self._store_lock = threading.Lock()
         self._synced = threading.Event()
         self._handlers: list[tuple[
@@ -266,6 +272,7 @@ class Informer:
         if event.type == DELETED:
             with self._store_lock:
                 old = self._store.pop(key, None)
+                self._last_applied[key] = time.monotonic()  # tombstone
             for _, _, on_delete in self._handlers:
                 if on_delete is not None:
                     self._safe(on_delete, old if old is not None else obj)
@@ -273,6 +280,7 @@ class Informer:
         with self._store_lock:
             old = self._store.get(key)
             self._store[key] = obj
+            self._last_applied[key] = time.monotonic()
         # An ADDED for a key already in the store happens when a restarted
         # server watch re-delivers the current object set; client-go
         # converts those to updates so derived state is not double-counted
@@ -300,13 +308,18 @@ class Informer:
         return self._synced.wait(timeout=timeout)
 
     def refresh(self) -> None:
-        """Relist and replace the store (client-go ``Reflector.Replace``).
+        """Relist and reconcile the store (client-go ``Reflector.Replace``).
 
         A restarted live watch re-delivers current objects as ADDED but
         never emits DELETED for objects removed during the stream gap, so
         a long-lived cache must periodically reconcile against a full
-        list: objects that vanished get their delete handlers fired and
-        are pruned; present objects dispatch add/update as usual."""
+        list. The list snapshot races the watch pump, and there is no
+        cross-backend resourceVersion to order by — so any key whose last
+        watch event applied *after* the list began is left untouched (the
+        event is newer than the snapshot; the next relist converges it).
+        Deleted keys leave tombstones for the same reason: a DELETED that
+        lands mid-list must not be undone by the stale snapshot."""
+        list_started = time.monotonic()
         objects = self._lister()
         fresh: dict[tuple[str, str], object] = {}
         for obj in objects:
@@ -315,22 +328,47 @@ class Informer:
             except Exception:
                 logger.exception("%s: key function failed on relisted "
                                  "object", self._name)
+        deleted: list[object] = []
+        added: list[object] = []
+        updated: list[tuple[object, object]] = []
         with self._store_lock:
-            stale = [self._store[k] for k in self._store if k not in fresh]
-            old_by_key = {k: self._store.get(k) for k in fresh}
-            self._store = dict(fresh)
-        for obj in stale:
+            def newer_than_list(key: tuple[str, str]) -> bool:
+                return self._last_applied.get(key, -1.0) >= list_started
+
+            for key in [k for k in self._store if k not in fresh]:
+                if newer_than_list(key):
+                    continue  # added by a watch event during the list
+                deleted.append(self._store.pop(key))
+                self._last_applied[key] = list_started
+            for key, obj in fresh.items():
+                if newer_than_list(key):
+                    continue  # modified/deleted during the list; keep event
+                old = self._store.get(key)
+                if old is None and key in self._last_applied:
+                    # tombstoned before the list began: the object was in
+                    # the (stale) snapshot but deleted since
+                    continue
+                self._store[key] = obj
+                self._last_applied[key] = list_started
+                if old is None:
+                    added.append(obj)
+                elif old != obj:
+                    updated.append((old, obj))
+            # drop tombstones that predate this list and were not
+            # resurrected — they have served their purpose
+            for key in [k for k, t in self._last_applied.items()
+                        if k not in self._store and t < list_started]:
+                del self._last_applied[key]
+        for obj in deleted:
             for _, _, on_delete in self._handlers:
                 if on_delete is not None:
                     self._safe(on_delete, obj)
-        for key, obj in fresh.items():
-            old = old_by_key.get(key)
-            if old is None:
-                self._dispatch_add(obj)
-            else:
-                for _, on_update, _ in self._handlers:
-                    if on_update is not None:
-                        self._safe(on_update, old, obj)
+        for obj in added:
+            self._dispatch_add(obj)
+        for old, obj in updated:
+            for _, on_update, _ in self._handlers:
+                if on_update is not None:
+                    self._safe(on_update, old, obj)
 
     def get(self, namespace: str, name: str) -> Optional[object]:
         with self._store_lock:

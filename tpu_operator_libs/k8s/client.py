@@ -96,6 +96,12 @@ class K8sClient(abc.ABC):
         ...
 
 
+class ApiServerError(RuntimeError):
+    """Transient apiserver failure (5xx / connection-reset analogue).
+    Retryable: the reference aborts the ApplyState pass and relies on
+    re-reconcile (upgrade_state.go:420-423)."""
+
+
 class EvictionBlockedError(RuntimeError):
     """Eviction rejected (e.g. by a PodDisruptionBudget)."""
 

@@ -33,6 +33,7 @@ from typing import Callable, Mapping, Optional
 from tpu_operator_libs.consts import POD_CONTROLLER_REVISION_HASH_LABEL
 from tpu_operator_libs.k8s.client import (
     AlreadyExistsError,
+    ApiServerError,
     ConflictError,
     EvictionBlockedError,
     K8sClient,
@@ -67,10 +68,6 @@ from tpu_operator_libs.k8s.watch import (
     WatchBroadcaster,
 )
 from tpu_operator_libs.util import Clock
-
-
-def _pod_fields(pod: Pod) -> dict[str, str]:
-    return pod.field_map()
 
 
 @dataclass
@@ -116,6 +113,13 @@ class FakeCluster(K8sClient):
         # exercise the provider's cache-sync poll loop
         # (node_upgrade_state_provider.go:100-117).
         self._stale_reads: dict[str, tuple[int, Node]] = {}
+        # Per-operation budget of injected transient API failures
+        # (apiserver 5xx / connection-reset modeling); consumed one per
+        # call. The reference's answer to such errors is abort-the-pass +
+        # re-reconcile (upgrade_state.go:420-423), so tests assert the
+        # machine converges through them.
+        self._api_errors: dict[str, int] = {}
+        self._api_error_exc: dict[str, Callable[[], Exception]] = {}
         # Watch fan-out: every mutation below emits a typed event so
         # informers/controllers (tpu_operator_libs.controller) can drive
         # reconciles the way controller-runtime does for the reference.
@@ -249,6 +253,36 @@ class FakeCluster(K8sClient):
         with self._lock:
             self._pod_ready_gate = gate
 
+    def inject_api_errors(self, operation: str, count: int,
+                          exc_factory: Optional[Callable[[], Exception]]
+                          = None) -> None:
+        """The next ``count`` calls of ``operation`` (a K8sClient method
+        name, e.g. ``"patch_node_labels"``) raise a transient
+        :class:`ApiServerError` (or ``exc_factory()``). Each call sets the
+        factory for the whole outstanding budget — passing None restores
+        the default ApiServerError."""
+        with self._lock:
+            self._api_errors[operation] = (
+                self._api_errors.get(operation, 0) + count)
+            if exc_factory is not None:
+                self._api_error_exc[operation] = exc_factory
+            else:
+                self._api_error_exc.pop(operation, None)
+
+    def _maybe_api_error(self, operation: str) -> None:
+        with self._lock:
+            remaining = self._api_errors.get(operation, 0)
+            if remaining <= 0:
+                return
+            self._api_errors[operation] = remaining - 1
+            factory = self._api_error_exc.get(operation)
+            if remaining == 1:
+                # budget exhausted: a later injection without a factory
+                # must get the documented default, not this leftover
+                self._api_error_exc.pop(operation, None)
+        raise factory() if factory else ApiServerError(
+            f"injected transient apiserver error on {operation}")
+
     def inject_stale_node_reads(self, name: str, reads: int) -> None:
         """Make the next ``reads`` get_node() calls return the current
         (pre-future-patch) snapshot, emulating controller-runtime cache lag
@@ -304,6 +338,7 @@ class FakeCluster(K8sClient):
     # K8sClient: nodes
     # ------------------------------------------------------------------
     def get_node(self, name: str) -> Node:
+        self._maybe_api_error("get_node")
         with self._lock:
             stale = self._stale_reads.get(name)
             if stale is not None:
@@ -319,6 +354,7 @@ class FakeCluster(K8sClient):
             return node.clone()
 
     def list_nodes(self, label_selector: str = "") -> list[Node]:
+        self._maybe_api_error("list_nodes")
         match = parse_label_selector(label_selector)
         with self._lock:
             return [n.clone() for n in self._nodes.values()
@@ -333,6 +369,7 @@ class FakeCluster(K8sClient):
 
     def patch_node_labels(self, name: str,
                           labels: Mapping[str, Optional[str]]) -> Node:
+        self._maybe_api_error("patch_node_labels")
         with self._lock:
             node = self._mutate_node(name)
             for key, value in labels.items():
@@ -345,6 +382,7 @@ class FakeCluster(K8sClient):
 
     def patch_node_annotations(self, name: str,
                                annotations: Mapping[str, Optional[str]]) -> Node:
+        self._maybe_api_error("patch_node_annotations")
         with self._lock:
             node = self._mutate_node(name)
             for key, value in annotations.items():
@@ -356,6 +394,7 @@ class FakeCluster(K8sClient):
             return node.clone()
 
     def set_node_unschedulable(self, name: str, unschedulable: bool) -> Node:
+        self._maybe_api_error("set_node_unschedulable")
         with self._lock:
             node = self._mutate_node(name)
             node.spec.unschedulable = unschedulable
@@ -383,6 +422,7 @@ class FakeCluster(K8sClient):
     def list_pods(self, namespace: Optional[str] = None,
                   label_selector: str = "",
                   field_selector: str = "") -> list[Pod]:
+        self._maybe_api_error("list_pods")
         label_match = parse_label_selector(label_selector)
         field_match = parse_field_selector(field_selector)
         with self._lock:
@@ -392,12 +432,13 @@ class FakeCluster(K8sClient):
                     continue
                 if not label_match(pod.metadata.labels):
                     continue
-                if not field_match(_pod_fields(pod)):
+                if not field_match(pod.field_map()):
                     continue
                 out.append(pod.clone())
             return out
 
     def get_pod(self, namespace: str, name: str) -> Pod:
+        self._maybe_api_error("get_pod")
         with self._lock:
             pod = self._pods.get((namespace, name))
             if pod is None:
@@ -432,6 +473,7 @@ class FakeCluster(K8sClient):
             return pod.clone()
 
     def delete_pod(self, namespace: str, name: str) -> None:
+        self._maybe_api_error("delete_pod")
         with self._lock:
             pod = self._pods.pop((namespace, name), None)
             if pod is None:
@@ -440,6 +482,7 @@ class FakeCluster(K8sClient):
             self._maybe_recreate_ds_pod(pod)
 
     def evict_pod(self, namespace: str, name: str) -> None:
+        self._maybe_api_error("evict_pod")
         with self._lock:
             pod = self._pods.get((namespace, name))
             if pod is None:
@@ -534,6 +577,7 @@ class FakeCluster(K8sClient):
     # ------------------------------------------------------------------
     def list_daemon_sets(self, namespace: str,
                          label_selector: str = "") -> list[DaemonSet]:
+        self._maybe_api_error("list_daemon_sets")
         match = parse_label_selector(label_selector)
         with self._lock:
             return [ds.clone()
@@ -542,6 +586,7 @@ class FakeCluster(K8sClient):
 
     def list_controller_revisions(self, namespace: str,
                                   label_selector: str = "") -> list[ControllerRevision]:
+        self._maybe_api_error("list_controller_revisions")
         match = parse_label_selector(label_selector)
         with self._lock:
             return [rev.clone()

@@ -24,6 +24,7 @@ from typing import Mapping, Optional
 
 from tpu_operator_libs.k8s.client import (
     AlreadyExistsError,
+    ApiServerError,
     ConflictError,
     EvictionBlockedError,
     K8sClient,
@@ -167,6 +168,13 @@ class RealCluster(K8sClient):
         # must surface as-is (callers back off and retry).
         if status == 429 and eviction:
             return EvictionBlockedError(str(exc))
+        if status == 409:
+            return ConflictError(str(exc))
+        # 5xx: retryable apiserver failure — typed so the drain/eviction
+        # workers defer (retry next reconcile) instead of consuming the
+        # node's failure budget on a hiccup.
+        if status is not None and 500 <= status < 600:
+            return ApiServerError(str(exc))
         return exc
 
     # -- nodes -------------------------------------------------------------

@@ -15,7 +15,11 @@ from typing import Optional
 
 from tpu_operator_libs.api.upgrade_policy import DrainSpec
 from tpu_operator_libs.consts import UpgradeState
-from tpu_operator_libs.k8s.client import K8sClient
+from tpu_operator_libs.k8s.client import (
+    ApiServerError,
+    ConflictError,
+    K8sClient,
+)
 from tpu_operator_libs.k8s.drain import DrainHelper, run_cordon_or_uncordon
 from tpu_operator_libs.k8s.objects import Node
 from tpu_operator_libs.upgrade.state_provider import NodeUpgradeStateProvider
@@ -125,6 +129,14 @@ class DrainManager:
                     return
             try:
                 run_cordon_or_uncordon(self._client, name, True)
+            except (ApiServerError, ConflictError) as exc:
+                # Transient apiserver failure: marking the node
+                # upgrade-failed would strand it (its pod is out of sync,
+                # so auto-recovery can never fire). Stay drain-required
+                # and let the next reconcile retry.
+                logger.warning("transient error cordoning node %s; "
+                               "deferring drain: %s", name, exc)
+                return
             except Exception as exc:  # noqa: BLE001 — worker boundary
                 logger.error("failed to cordon node %s: %s", name, exc)
                 self._fail(node, f"Failed to cordon the node: {exc}")
@@ -132,6 +144,10 @@ class DrainManager:
             logger.info("cordoned node %s", name)
             try:
                 helper.run_node_drain(name)
+            except (ApiServerError, ConflictError) as exc:
+                logger.warning("transient error draining node %s; "
+                               "deferring drain: %s", name, exc)
+                return
             except Exception as exc:  # noqa: BLE001 — worker boundary
                 logger.error("failed to drain node %s: %s", name, exc)
                 self._fail(node, f"Failed to drain the node: {exc}")

@@ -29,7 +29,11 @@ from tpu_operator_libs.consts import (
     POD_CONTROLLER_REVISION_HASH_LABEL,
     UpgradeState,
 )
-from tpu_operator_libs.k8s.client import K8sClient
+from tpu_operator_libs.k8s.client import (
+    ApiServerError,
+    ConflictError,
+    K8sClient,
+)
 from tpu_operator_libs.k8s.drain import DrainHelper, PodDeleteStatus
 from tpu_operator_libs.k8s.objects import DaemonSet, Node, Pod, PodPhase
 from tpu_operator_libs.k8s.selectors import selector_from_labels
@@ -214,6 +218,13 @@ class PodManager:
                       self._keys.event_reason,
                       "Deleted workload pods on the node for the runtime "
                       "upgrade")
+        except (ApiServerError, ConflictError) as exc:
+            # Transient apiserver failure: escalating to drain-or-failed
+            # could strand the node in upgrade-failed (out-of-sync pod ⇒
+            # auto-recovery can never fire). Park in
+            # pod-deletion-required; the next reconcile retries.
+            logger.warning("transient error deleting pods on node %s; "
+                           "deferring: %s", name, exc)
         except Exception as exc:  # noqa: BLE001 — worker boundary
             logger.error("failed to delete pods on node %s: %s", name, exc)
             log_event(self._recorder, node, Event.WARNING,
