@@ -42,10 +42,14 @@ def main() -> int:
     # Exercise the real accelerator when present: the validation gate's
     # fabric probe latency on the local chip(s).
     probe_ms = None
+    bandwidth_gbps = None
     try:
         import jax
 
-        from tpu_operator_libs.health.ici_probe import fabric_probe
+        from tpu_operator_libs.health.ici_probe import (
+            fabric_bandwidth_probe,
+            fabric_probe,
+        )
 
         n = len(jax.devices())
         while n > 1 and 128 % n:
@@ -53,6 +57,10 @@ def main() -> int:
         result = fabric_probe(n_devices=n)
         if result.healthy:
             probe_ms = round(result.latency_s * 1e3, 3)
+            if n > 1:
+                # throughput only means something on a correct fabric
+                bandwidth_gbps = fabric_bandwidth_probe(
+                    n_devices=n).gbytes_per_s
     except Exception:
         pass
 
@@ -78,6 +86,7 @@ def main() -> int:
         "flat_upgrade_wall_clock_s": flat.total_seconds,
         "fleet": f"{fleet.n_slices}x{fleet.hosts_per_slice} hosts",
         "ici_probe_ms": probe_ms,
+        "ici_bandwidth_gbytes_per_s": bandwidth_gbps,
         "reconcile_p50_ms_256_nodes": reconcile_ms,
     }))
     return 0

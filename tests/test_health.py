@@ -116,6 +116,74 @@ class TestFabricProbe:
         validator = ICIFabricValidator(cache_seconds=0)
         assert validator(node) is True
 
+    def test_bandwidth_probe_structure(self):
+        # on the CPU mesh this measures memcpy, so assert structure and
+        # positivity, never a throughput floor
+        from tpu_operator_libs.health.ici_probe import fabric_bandwidth_probe
+        result = fabric_bandwidth_probe(n_devices=8, payload_mib=1,
+                                        rounds=2)
+        assert result.gbytes_per_s > 0
+        assert result.n_devices == 8
+        assert result.rounds == 2
+        assert result.healthy  # no floor given
+        assert "GByte/s" in str(result)
+
+    def test_bandwidth_probe_floor_marks_degraded(self):
+        from tpu_operator_libs.health.ici_probe import fabric_bandwidth_probe
+        result = fabric_bandwidth_probe(n_devices=2, payload_mib=1,
+                                        rounds=2, min_gbytes_per_s=1e12)
+        assert not result.healthy
+
+    def test_bandwidth_probe_rejects_single_device(self):
+        from tpu_operator_libs.health.ici_probe import fabric_bandwidth_probe
+        with pytest.raises(ValueError):
+            fabric_bandwidth_probe(n_devices=1)
+
+    def test_bandwidth_topology_rings_are_per_axis(self):
+        """With a torus topology, bandwidth rings must be true neighbor
+        rings along one axis (a flat ring over linear device order would
+        cross physical hops at row boundaries and under-report)."""
+        import tpu_operator_libs.health.ici_probe as probe_mod
+        from tpu_operator_libs.health.ici_probe import (
+            fabric_bandwidth_topology,
+        )
+
+        rings = []
+        orig = probe_mod.fabric_bandwidth_probe
+
+        def spy(mesh=None, **kw):
+            rings.append(tuple(d.id for d in mesh.devices.flatten()))
+            return orig(mesh=mesh, **kw)
+
+        probe_mod.fabric_bandwidth_probe = spy
+        try:
+            results = fabric_bandwidth_topology("2x4", payload_mib=1,
+                                                rounds=2)
+        finally:
+            probe_mod.fabric_bandwidth_probe = orig
+        assert len(results) == 2  # one ring per axis by default
+        assert (0, 4) in rings, rings       # axis-0 stride ring
+        assert (0, 1, 2, 3) in rings, rings  # axis-1 row ring
+
+    def test_validator_bandwidth_floor_gates_health(self):
+        from tpu_operator_libs.consts import GKE_TPU_TOPOLOGY_LABEL
+        from tpu_operator_libs.health.ici_probe import ICIFabricValidator
+        from tpu_operator_libs.k8s.objects import Node, ObjectMeta
+
+        # unreachable floor: correctness passes but throughput gates
+        validator = ICIFabricValidator(cache_seconds=0,
+                                       min_bandwidth_gbytes_per_s=1e12)
+        assert validator(None) is False
+        validator_ok = ICIFabricValidator(cache_seconds=0,
+                                          min_bandwidth_gbytes_per_s=1e-9)
+        assert validator_ok(None) is True
+        # with a topology label the floor applies per torus axis
+        node = Node(metadata=ObjectMeta(
+            name="n", labels={GKE_TPU_TOPOLOGY_LABEL: "2x2"}))
+        validator_topo = ICIFabricValidator(cache_seconds=0,
+                                            min_bandwidth_gbytes_per_s=1e12)
+        assert validator_topo(node) is False
+
     def test_validator_caches(self):
         from tpu_operator_libs.health.ici_probe import ICIFabricValidator
         calls = {"n": 0}

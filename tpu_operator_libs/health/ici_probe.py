@@ -148,6 +148,97 @@ def fabric_probe(mesh=None, n_devices: Optional[int] = None,
     return result
 
 
+@dataclass
+class BandwidthProbeResult:
+    """Achieved per-link ICI throughput from a timed ppermute ring.
+
+    ``gbytes_per_s`` is giga**bytes**/s (the unit TPU ICI specs quote),
+    not gigabits."""
+
+    gbytes_per_s: float
+    bytes_per_hop: int
+    rounds: int
+    latency_s: float
+    n_devices: int
+    healthy: bool = True
+
+    def __str__(self) -> str:
+        status = "ok" if self.healthy else "DEGRADED"
+        return (f"ICI bandwidth {status}: "
+                f"{self.gbytes_per_s:.1f} GByte/s/link "
+                f"({self.n_devices} devices, "
+                f"{self.bytes_per_hop >> 20} MiB x {self.rounds} hops, "
+                f"{self.latency_s * 1e3:.1f} ms)")
+
+
+def fabric_bandwidth_probe(mesh=None, n_devices: Optional[int] = None,
+                           payload_mib: int = 16, rounds: int = 8,
+                           min_gbytes_per_s: Optional[float] = None,
+                           ) -> BandwidthProbeResult:
+    """Measure achieved ICI throughput with a timed neighbor-ring pass.
+
+    The correctness battery (:func:`fabric_probe`) certifies that every
+    link produces right answers; a link can still be *slow* (retraining,
+    lane degradation) and silently halve step time. This probe pushes
+    ``payload_mib`` of bfloat16 around the ring ``rounds`` times — each
+    round moves the full payload across every link simultaneously — and
+    reports bytes/wall-time as per-link unidirectional gigabytes/s.
+    ``healthy`` is ``gbytes_per_s >= min_gbytes_per_s`` when a floor is
+    given (deployments set it per TPU generation; v4/v5 ICI links are
+    O(100) GByte/s each way).
+
+    On a physical torus the mesh must be a real neighbor ring (one axis,
+    all other coordinates fixed — see :func:`fabric_bandwidth_topology`);
+    a flat ring over linear device order crosses multiple physical hops
+    at row boundaries and under-reports. On a CPU mesh this measures
+    memcpy, so tests assert structure, not thresholds.
+    """
+    import jax
+    import jax.numpy as jnp
+    from jax import lax, shard_map
+    from jax.sharding import PartitionSpec as P
+
+    if mesh is None:
+        mesh = make_mesh(n_devices)
+    axis_size = mesh.devices.size
+    if axis_size < 2:
+        raise ValueError("bandwidth probe needs >= 2 devices")
+
+    elems = (payload_mib << 20) // 2  # bf16 = 2 bytes
+    cols = max(elems // _TILE, 1)
+    perm = [(i, (i + 1) % axis_size) for i in range(axis_size)]
+
+    def body(x):
+        local = x[0]
+        for _ in range(rounds):
+            # data dependency between hops so XLA cannot fuse them away
+            local = lax.ppermute(local, _AXIS, perm=perm) + jnp.bfloat16(0)
+        return local[None]
+
+    host = np.ones((axis_size, _TILE, cols), dtype=np.float32)
+    sharding = jax.sharding.NamedSharding(mesh, P(_AXIS))
+    x = jax.device_put(host.astype(jnp.bfloat16), sharding)
+    probed = jax.jit(shard_map(body, mesh=mesh,
+                               in_specs=P(_AXIS), out_specs=P(_AXIS)))
+    jax.block_until_ready(probed(x))  # compile outside the timed region
+    start = time.perf_counter()
+    jax.block_until_ready(probed(x))
+    latency = time.perf_counter() - start
+
+    bytes_per_hop = _TILE * cols * 2
+    gbytes_per_s = (bytes_per_hop * rounds / latency) / 1e9
+    result = BandwidthProbeResult(
+        gbytes_per_s=round(gbytes_per_s, 2),
+        bytes_per_hop=bytes_per_hop,
+        rounds=rounds,
+        latency_s=latency,
+        n_devices=axis_size,
+        healthy=(min_gbytes_per_s is None
+                 or gbytes_per_s >= min_gbytes_per_s))
+    logger.info("%s", result)
+    return result
+
+
 def single_chip_probe():
     """(fn, example_args) for the single-device probe step — the jittable
     forward step exposed through ``__graft_entry__.entry()``.
@@ -188,6 +279,32 @@ def fabric_probe_topology(topology: str,
     """
     import jax
 
+    rings = _torus_axis_rings(topology, n_devices, max_rings_per_axis)
+    results = [
+        fabric_probe(mesh=jax.sharding.Mesh(np.array(list(ring)), (_AXIS,)),
+                     tolerance=tolerance)
+        for _axis, ring in rings
+    ]
+    if not results:
+        devices = jax.devices()
+        if n_devices is not None:
+            devices = devices[:n_devices]
+        results.append(fabric_probe(n_devices=len(devices),
+                                    tolerance=tolerance))
+    return results
+
+
+def _torus_axis_rings(topology: str, n_devices: Optional[int],
+                      max_rings_per_axis: int,
+                      ) -> list[tuple[int, tuple]]:
+    """(axis, ring-of-devices) for each strided torus ring to probe.
+
+    Deduplicates identical rings (square dims), caps per axis at
+    ``max_rings_per_axis`` (skips logged — partial coverage is never
+    silent), and scales the dims down to fit the locally visible device
+    count while keeping the rank."""
+    import jax
+
     from tpu_operator_libs.topology.slice_topology import parse_chip_topology
 
     dims = parse_chip_topology(topology)
@@ -211,7 +328,7 @@ def fabric_probe_topology(topology: str,
             need *= d
 
     grid = np.array(devices[:need], dtype=object).reshape(dims)
-    results = []
+    out: list[tuple[int, tuple]] = []
     probed_rings: set[tuple[int, ...]] = set()
     for axis, axis_len in enumerate(dims):
         if axis_len <= 1:
@@ -224,8 +341,7 @@ def fabric_probe_topology(topology: str,
             ring_key = tuple(sorted(d.id for d in ring))
             if ring_key in probed_rings:
                 continue  # identical ring already certified (square dims)
-            mesh = jax.sharding.Mesh(np.array(list(ring)), (_AXIS,))
-            results.append(fabric_probe(mesh=mesh, tolerance=tolerance))
+            out.append((axis, tuple(ring)))
             probed_rings.add(ring_key)
             probed_this_axis += 1
         skipped = sum(
@@ -236,10 +352,33 @@ def fabric_probe_topology(topology: str,
                 "fabric probe axis %d: %d of %d rings not probed "
                 "(max_rings_per_axis=%d) — coverage is partial",
                 axis, skipped, len(rings), max_rings_per_axis)
-    if not results:
-        results.append(fabric_probe(n_devices=min(available, need),
-                                    tolerance=tolerance))
-    return results
+    return out
+
+
+def fabric_bandwidth_topology(topology: str,
+                              n_devices: Optional[int] = None,
+                              min_gbytes_per_s: Optional[float] = None,
+                              payload_mib: int = 16, rounds: int = 8,
+                              max_rings_per_axis: int = 1,
+                              ) -> list[BandwidthProbeResult]:
+    """Per-axis bandwidth battery over a multi-dimensional ICI torus.
+
+    Each probed ring is a true neighbor ring along one torus axis (all
+    other coordinates fixed), so the measured GByte/s reflects single
+    physical links — a flat ring over linear device order would cross
+    multiple hops at row boundaries and under-report. One ring per axis
+    (the default cap) is enough to floor-check link speed per direction.
+    """
+    import jax
+
+    rings = _torus_axis_rings(topology, n_devices, max_rings_per_axis)
+    return [
+        fabric_bandwidth_probe(
+            mesh=jax.sharding.Mesh(np.array(list(ring)), (_AXIS,)),
+            payload_mib=payload_mib, rounds=rounds,
+            min_gbytes_per_s=min_gbytes_per_s)
+        for _axis, ring in rings
+    ]
 
 
 class ICIFabricValidator:
@@ -256,11 +395,13 @@ class ICIFabricValidator:
     """
 
     def __init__(self, probe_runner=None, cache_seconds: float = 300.0,
-                 clock=None, tolerance: float = 1e-3) -> None:
+                 clock=None, tolerance: float = 1e-3,
+                 min_bandwidth_gbytes_per_s: Optional[float] = None) -> None:
         from tpu_operator_libs.util import Clock
 
         self._probe = probe_runner
         self._tolerance = tolerance
+        self._min_bandwidth = min_bandwidth_gbytes_per_s
         self._cache_seconds = cache_seconds
         self._clock = clock or Clock()
         # Keyed per slice/topology: one validator instance serves the whole
@@ -291,8 +432,24 @@ class ICIFabricValidator:
         if topology:
             results = fabric_probe_topology(topology,
                                             tolerance=self._tolerance)
-            return all(r.healthy for r in results)
-        return fabric_probe(tolerance=self._tolerance).healthy
+            healthy = all(r.healthy for r in results)
+        else:
+            healthy = fabric_probe(tolerance=self._tolerance).healthy
+        if healthy and self._min_bandwidth is not None:
+            # correctness passed; also require undegraded throughput —
+            # per torus axis when a topology is known, so each measured
+            # ring rides single physical links
+            import jax
+
+            if len(jax.devices()) >= 2:
+                if topology:
+                    bw = fabric_bandwidth_topology(
+                        topology, min_gbytes_per_s=self._min_bandwidth)
+                    healthy = all(r.healthy for r in bw)
+                else:
+                    healthy = fabric_bandwidth_probe(
+                        min_gbytes_per_s=self._min_bandwidth).healthy
+        return healthy
 
     def __call__(self, node) -> bool:
         now = self._clock.now()
