@@ -359,3 +359,112 @@ class TestFakeLeaseStore:
                   holder_identity="x"))
         created.holder_identity = "mutated"
         assert cluster.get_lease(NS, NAME).holder_identity == "x"
+
+
+class TestHAOperatorComposition:
+    """End-to-end HA shape: two replicas contend for the Lease; only the
+    leader builds caches and reconciles (examples/libtpu_operator.py's
+    run_leader_elected + run_loop wiring); after the leader is deposed the
+    standby takes over and finishes the rolling upgrade."""
+
+    def test_leadership_transfer_mid_upgrade(self):
+        import time
+
+        from tpu_operator_libs.api.upgrade_policy import (
+            DrainSpec,
+            UpgradePolicySpec,
+        )
+        from tpu_operator_libs.k8s.cached import CachedReadClient
+        from tpu_operator_libs.simulate import (
+            NS as SIM_NS,
+            RUNTIME_LABELS,
+            FleetSpec,
+            build_fleet,
+        )
+        from tpu_operator_libs.upgrade.state_manager import (
+            BuildStateError,
+            ClusterUpgradeStateManager,
+        )
+
+        fleet = FleetSpec(n_slices=2, hosts_per_slice=2,
+                          pod_recreate_delay=1.0, pod_ready_delay=1.0)
+        cluster, sim_clock, keys = build_fleet(fleet)
+        policy = UpgradePolicySpec(
+            auto_upgrade=True, max_parallel_upgrades=0,
+            max_unavailable="50%",
+            drain=DrainSpec(enable=True, force=True))
+        election_clock = FakeClock()
+
+        def make_replica(identity):
+            """A replica: elector + (lazily built, leader-only) manager."""
+            state = {"cached": None, "mgr": None, "reconciles": 0}
+
+            def on_started():
+                state["cached"] = CachedReadClient(cluster, SIM_NS,
+                                                   relist_interval=None)
+                assert state["cached"].has_synced(timeout=5.0)
+                state["mgr"] = ClusterUpgradeStateManager(
+                    state["cached"], keys, async_workers=False,
+                    poll_interval=0.005)
+
+            def on_stopped():
+                if state["cached"] is not None:
+                    state["cached"].stop()
+                state["cached"] = state["mgr"] = None
+
+            elector = make_elector(cluster, election_clock, identity,
+                                   on_started_leading=on_started,
+                                   on_stopped_leading=on_stopped)
+            return elector, state
+
+        elector_a, a = make_replica("replica-a")
+        elector_b, b = make_replica("replica-b")
+
+        def reconcile_with(state):
+            if state["mgr"] is None:
+                return
+            sim_clock.advance(5.0)
+            cluster.step()
+            try:
+                state["mgr"].reconcile(SIM_NS, dict(RUNTIME_LABELS), policy)
+                state["reconciles"] += 1
+            except BuildStateError:
+                pass
+            time.sleep(0.002)  # let watch events drain into the caches
+
+        def all_done():
+            return all(
+                n.metadata.labels.get(keys.state_label) == "upgrade-done"
+                and not n.spec.unschedulable
+                for n in cluster.list_nodes())
+
+        # replica A wins, B stays standby (no caches, no manager)
+        assert elector_a.try_acquire_or_renew()
+        assert not elector_b.try_acquire_or_renew()
+        assert a["mgr"] is not None
+        assert b["mgr"] is None and b["cached"] is None
+
+        # A reconciles a few passes (partial progress), then dies
+        for _ in range(4):
+            reconcile_with(a)
+        assert not all_done()  # mid-upgrade
+        elector_a.release()
+        a["cached"] and a["cached"].stop()
+
+        # B observes the released lease and takes over
+        election_clock.advance(3.0)
+        assert elector_b.try_acquire_or_renew()
+        assert b["mgr"] is not None
+
+        for _ in range(100):
+            reconcile_with(b)
+            if all_done():
+                break
+        assert all_done()
+        assert b["reconciles"] > 0
+        hashes = {p.metadata.labels.get("controller-revision-hash")
+                  for p in cluster.list_pods(SIM_NS)}
+        assert hashes == {"new"}
+        elector_b.release()
+        if b["cached"] is not None:
+            b["cached"].stop()

@@ -96,6 +96,73 @@ class TestMetricsRegistry:
         reg.observe_histogram("h", 1.0, labels={"a": "b"})
         assert reg.histogram_stats("h", {"a": "other"}) is None
 
+    def test_cluster_status_block(self):
+        import json
+
+        env = make_env()
+        ds = DaemonSetBuilder("libtpu").with_labels(dict(RUNTIME_LABELS)) \
+            .with_desired_scheduled(3).create(env.cluster)
+        for i, state in enumerate([UpgradeState.DONE,
+                                   UpgradeState.DRAIN_REQUIRED,
+                                   UpgradeState.UPGRADE_REQUIRED]):
+            node = NodeBuilder(f"n{i}").with_upgrade_state(
+                env.keys, state).create(env.cluster)
+            PodBuilder(f"p{i}").on_node(node).owned_by(ds) \
+                .with_revision_hash("rev1").create(env.cluster)
+        mgr = make_state_manager(env)
+        status = mgr.cluster_status(mgr.build_state(NS, RUNTIME_LABELS))
+        assert status["totalNodes"] == 3
+        assert status["upgradesInProgress"] == 1
+        assert status["upgradesDone"] == 1
+        assert status["upgradesPending"] == 1
+        assert status["upgradesFailed"] == 0
+        assert status["nodesByState"] == {
+            "drain-required": 1, "upgrade-done": 1, "upgrade-required": 1}
+        # no TPU topology labels -> no slice figure (it would just
+        # restate node readiness)
+        assert "sliceAvailability" not in status
+        # CRD-embeddable: must round-trip through JSON unchanged
+        assert json.loads(json.dumps(status)) == status
+
+    def test_cluster_status_surfaces_unrecognized_labels(self):
+        env = make_env()
+        ds = DaemonSetBuilder("libtpu").with_labels(dict(RUNTIME_LABELS)) \
+            .with_desired_scheduled(1).create(env.cluster)
+        node = NodeBuilder("n0").create(env.cluster)
+        env.cluster.patch_node_labels(
+            "n0", {env.keys.state_label: "drain-requierd"})  # typo'd label
+        PodBuilder("p0").on_node(node).owned_by(ds) \
+            .with_revision_hash("rev1").create(env.cluster)
+        mgr = make_state_manager(env)
+        status = mgr.cluster_status(mgr.build_state(NS, RUNTIME_LABELS))
+        # counts must sum: the raw label appears rather than vanishing
+        assert status["nodesByState"] == {"drain-requierd": 1}
+        assert sum(status["nodesByState"].values()) == status["totalNodes"]
+
+    def test_cluster_status_includes_slice_availability(self):
+        from tpu_operator_libs.consts import (
+            GKE_NODEPOOL_LABEL,
+            GKE_TPU_TOPOLOGY_LABEL,
+        )
+
+        env = make_env()
+        ds = DaemonSetBuilder("libtpu").with_labels(dict(RUNTIME_LABELS)) \
+            .with_desired_scheduled(2).create(env.cluster)
+        for i, sched in enumerate([False, True]):
+            b = NodeBuilder(f"n{i}").with_upgrade_state(
+                env.keys, UpgradeState.DONE).with_labels({
+                    GKE_NODEPOOL_LABEL: f"pool-{i}",
+                    GKE_TPU_TOPOLOGY_LABEL: "2x2",
+                    "google.com/tpu": "true"})
+            if sched:
+                b = b.unschedulable()
+            node = b.create(env.cluster)
+            PodBuilder(f"p{i}").on_node(node).owned_by(ds) \
+                .with_revision_hash("rev1").create(env.cluster)
+        mgr = make_state_manager(env)
+        status = mgr.cluster_status(mgr.build_state(NS, RUNTIME_LABELS))
+        assert status["sliceAvailability"] == 0.5  # one of two slices up
+
     def test_controller_records_reconcile_duration(self):
         from tpu_operator_libs.controller import (
             CLUSTER_KEY,

@@ -226,9 +226,11 @@ def fabric_bandwidth_probe(mesh=None, n_devices: Optional[int] = None,
     latency = time.perf_counter() - start
 
     bytes_per_hop = _TILE * cols * 2
-    gbytes_per_s = (bytes_per_hop * rounds / latency) / 1e9
+    # verdict computed from the same rounded value that is reported, so
+    # result.gbytes_per_s >= floor always agrees with result.healthy
+    gbytes_per_s = round((bytes_per_hop * rounds / latency) / 1e9, 2)
     result = BandwidthProbeResult(
-        gbytes_per_s=round(gbytes_per_s, 2),
+        gbytes_per_s=gbytes_per_s,
         bytes_per_hop=bytes_per_hop,
         rounds=rounds,
         latency_s=latency,
@@ -279,30 +281,32 @@ def fabric_probe_topology(topology: str,
     """
     import jax
 
-    rings = _torus_axis_rings(topology, n_devices, max_rings_per_axis)
+    rings, fitted = _torus_axis_rings(topology, n_devices,
+                                      max_rings_per_axis)
     results = [
         fabric_probe(mesh=jax.sharding.Mesh(np.array(list(ring)), (_AXIS,)),
                      tolerance=tolerance)
         for _axis, ring in rings
     ]
     if not results:
-        devices = jax.devices()
-        if n_devices is not None:
-            devices = devices[:n_devices]
-        results.append(fabric_probe(n_devices=len(devices),
-                                    tolerance=tolerance))
+        # no multi-device axis (e.g. a 1x1 single-chip slice): probe only
+        # the devices the topology spans, never unrelated local devices
+        results.append(fabric_probe(n_devices=fitted, tolerance=tolerance))
     return results
 
 
 def _torus_axis_rings(topology: str, n_devices: Optional[int],
                       max_rings_per_axis: int,
-                      ) -> list[tuple[int, tuple]]:
-    """(axis, ring-of-devices) for each strided torus ring to probe.
+                      warn_on_skip: bool = True,
+                      ) -> tuple[list[tuple[int, tuple]], int]:
+    """((axis, ring-of-devices) per strided torus ring, fitted device
+    count).
 
     Deduplicates identical rings (square dims), caps per axis at
-    ``max_rings_per_axis`` (skips logged — partial coverage is never
-    silent), and scales the dims down to fit the locally visible device
-    count while keeping the rank."""
+    ``max_rings_per_axis`` (skips logged unless the cap is the caller's
+    documented coverage — ``warn_on_skip=False``), and scales the dims
+    down to fit the locally visible device count while keeping the
+    rank."""
     import jax
 
     from tpu_operator_libs.topology.slice_topology import parse_chip_topology
@@ -347,12 +351,12 @@ def _torus_axis_rings(topology: str, n_devices: Optional[int],
         skipped = sum(
             1 for ring in rings
             if tuple(sorted(d.id for d in ring)) not in probed_rings)
-        if skipped > 0:
+        if skipped > 0 and warn_on_skip:
             logger.warning(
                 "fabric probe axis %d: %d of %d rings not probed "
                 "(max_rings_per_axis=%d) — coverage is partial",
                 axis, skipped, len(rings), max_rings_per_axis)
-    return out
+    return out, min(need, available)
 
 
 def fabric_bandwidth_topology(topology: str,
@@ -367,11 +371,15 @@ def fabric_bandwidth_topology(topology: str,
     other coordinates fixed), so the measured GByte/s reflects single
     physical links — a flat ring over linear device order would cross
     multiple hops at row boundaries and under-report. One ring per axis
-    (the default cap) is enough to floor-check link speed per direction.
+    (the default cap) is the documented coverage, so the per-axis skip
+    warning is suppressed. Returns an empty list for a topology with no
+    multi-device axis (nothing to measure — there is no ICI).
     """
     import jax
 
-    rings = _torus_axis_rings(topology, n_devices, max_rings_per_axis)
+    rings, _fitted = _torus_axis_rings(topology, n_devices,
+                                       max_rings_per_axis,
+                                       warn_on_skip=False)
     return [
         fabric_bandwidth_probe(
             mesh=jax.sharding.Mesh(np.array(list(ring)), (_AXIS,)),
@@ -445,6 +453,14 @@ class ICIFabricValidator:
                 if topology:
                     bw = fabric_bandwidth_topology(
                         topology, min_gbytes_per_s=self._min_bandwidth)
+                    if not bw:
+                        # single-chip topology: no ICI to measure — the
+                        # configured floor is unenforceable here, which
+                        # must be visible, not a silent pass
+                        logger.warning(
+                            "bandwidth floor configured but topology %r "
+                            "has no multi-device axis; skipping the "
+                            "throughput gate", topology)
                     healthy = all(r.healthy for r in bw)
                 else:
                     healthy = fabric_bandwidth_probe(

@@ -639,6 +639,45 @@ class ClusterUpgradeStateManager:
         # — harmless to its caller but wrong as an exposed fleet counter.
         return max(0, available)
 
+    def cluster_status(self, state: ClusterUpgradeState) -> dict:
+        """CRD-embeddable status block for one snapshot.
+
+        Reference consumers surface the fleet counters
+        (upgrade_state.go:1034-1120) in their own CRD ``.status``; this
+        returns that block ready-made — JSON-serializable, camelCase
+        keys, deterministic ordering — plus the TPU-native slice
+        availability when topology labels are present.
+        """
+        # raw snapshot buckets, not ALL_STATES: a node with an unrecognized
+        # label value must still appear (as its raw label) so the per-state
+        # counts always sum to totalNodes
+        per_state = {key or "unknown": len(bucket)
+                     for key, bucket in state.node_states.items() if bucket}
+        status = {
+            "totalNodes": self.get_total_managed_nodes(state),
+            "upgradesInProgress": self.get_upgrades_in_progress(state),
+            "upgradesDone": self.get_upgrades_done(state),
+            "upgradesFailed": self.get_upgrades_failed(state),
+            "upgradesPending": self.get_upgrades_pending(state),
+            "unavailableNodes": self.get_current_unavailable_nodes(state),
+            "nodesByState": dict(sorted(per_state.items())),
+        }
+        nodes = [ns.node for bucket in state.node_states.values()
+                 for ns in bucket]
+        from tpu_operator_libs.consts import GKE_TPU_TOPOLOGY_LABEL
+
+        if any(GKE_TPU_TOPOLOGY_LABEL in n.metadata.labels for n in nodes):
+            # only meaningful on TPU-labeled fleets: without topology
+            # labels every node is its own "slice" and the number would
+            # just restate node readiness
+            from tpu_operator_libs.topology.slice_topology import (
+                SliceTopology,
+            )
+
+            topo = SliceTopology.from_nodes(nodes)
+            status["sliceAvailability"] = round(topo.availability(), 4)
+        return status
+
     # ------------------------------------------------------------------
     # chained reconcile
     # ------------------------------------------------------------------
