@@ -67,17 +67,28 @@ def load_policy(path: str | None) -> UpgradePolicySpec:
     return spec
 
 
+#: Latest CRD-style status block per driver, refreshed each reconcile and
+#: served at /status (the operator-side view of cluster_status()).
+latest_status: dict = {}
+
+
 def serve_metrics(registry: MetricsRegistry, port: int) -> ThreadingHTTPServer:
     class Handler(BaseHTTPRequestHandler):
         def do_GET(self):  # noqa: N802 - stdlib API
-            if self.path != "/metrics":
+            if self.path == "/metrics":
+                body = registry.render_prometheus().encode()
+                content_type = "text/plain; version=0.0.4"
+            elif self.path == "/status":
+                import json as _json
+
+                body = _json.dumps(latest_status, indent=2).encode()
+                content_type = "application/json"
+            else:
                 self.send_response(404)
                 self.end_headers()
                 return
-            body = registry.render_prometheus().encode()
             self.send_response(200)
-            self.send_header("Content-Type",
-                             "text/plain; version=0.0.4")
+            self.send_header("Content-Type", content_type)
             self.end_headers()
             self.wfile.write(body)
 
@@ -86,7 +97,7 @@ def serve_metrics(registry: MetricsRegistry, port: int) -> ThreadingHTTPServer:
 
     server = ThreadingHTTPServer(("", port), Handler)
     threading.Thread(target=server.serve_forever, daemon=True).start()
-    logger.info("metrics on :%d/metrics", port)
+    logger.info("metrics on :%d/metrics, status on :%d/status", port, port)
     return server
 
 
@@ -138,6 +149,7 @@ def reconcile_once(mgr, args, policy, registry, runtime_labels) -> None:
         state = mgr.build_state(args.namespace, runtime_labels)
         mgr.apply_state(state, policy)
         observe_cluster_state(registry, mgr, state, driver=args.driver)
+        latest_status[args.driver] = mgr.cluster_status(state)
         logger.info("reconciled: %d/%d done, %d in progress, %d failed",
                     mgr.get_upgrades_done(state),
                     mgr.get_total_managed_nodes(state),

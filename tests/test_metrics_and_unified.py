@@ -357,6 +357,32 @@ class TestUnifiedPolicy:
         tpu_node_labels = env.cluster.get_node("tpu-n0").metadata.labels
         assert gpu_keys.state_label not in tpu_node_labels
 
+        # per-accelerator CRD status blocks after convergence
+        status = multi.cluster_status()
+        assert set(status) == {"tpu", "gpu"}
+        for block in status.values():
+            assert block["upgradesDone"] == 2
+            assert block["totalNodes"] == 2
+
+    def test_unified_status_reports_error_per_accelerator(self):
+        env = make_env()
+        ds = DaemonSetBuilder("libtpu", namespace=NS).with_labels(
+            {"app": "libtpu"}).with_desired_scheduled(1).create(env.cluster)
+        node = NodeBuilder("n0").create(env.cluster)
+        PodBuilder("p0").on_node(node).owned_by(ds) \
+            .with_revision_hash("rev1").create(env.cluster)
+        unified = self._unified()
+        multi = MultiAcceleratorUpgradeManager(
+            env.cluster, unified, async_workers=False,
+            clock=env.clock, poll_interval=0.01)
+        env.cluster.inject_api_errors("list_daemon_sets", 1)
+        status = multi.cluster_status()
+        # first accelerator hit the injected error; it reports instead of
+        # vanishing, and the other still returns a real block
+        errors = [b for b in status.values() if "error" in b]
+        blocks = [b for b in status.values() if "totalNodes" in b]
+        assert len(errors) == 1 and len(blocks) == 1
+
 
 class TestRealAdapterGating:
     def test_import_error_is_clear(self):

@@ -142,3 +142,18 @@ class MultiAcceleratorUpgradeManager:
             except Exception as exc:  # noqa: BLE001 — per-accelerator
                 results[name] = exc
         return results
+
+    def cluster_status(self) -> dict[str, dict]:
+        """Fresh CRD-embeddable status block per accelerator (the unified
+        analogue of ClusterUpgradeStateManager.cluster_status). A runtime
+        whose snapshot is temporarily unbuildable reports an ``error``
+        entry instead of hiding the accelerator."""
+        out: dict[str, dict] = {}
+        for name, spec in self.policy.accelerators.items():
+            mgr = self.managers[name]
+            try:
+                state = mgr.build_state(spec.namespace, spec.runtime_labels)
+                out[name] = mgr.cluster_status(state)
+            except Exception as exc:  # noqa: BLE001 — per-accelerator
+                out[name] = {"error": str(exc)}
+        return out
