@@ -72,7 +72,15 @@ def load_policy(path: str | None) -> UpgradePolicySpec:
 latest_status: dict = {}
 
 
-def serve_metrics(registry: MetricsRegistry, port: int) -> ThreadingHTTPServer:
+def serve_metrics(registry: MetricsRegistry, port: int,
+                  status_source=None) -> ThreadingHTTPServer:
+    """HTTP server for /metrics + /status. ``status_source`` is the
+    mutable status mapping to serve (default: this module's
+    ``latest_status``) — passed explicitly so other operators (the
+    unified example) don't have to rebind a cross-module global."""
+    if status_source is None:
+        status_source = latest_status
+
     class Handler(BaseHTTPRequestHandler):
         def do_GET(self):  # noqa: N802 - stdlib API
             if self.path == "/metrics":
@@ -81,7 +89,9 @@ def serve_metrics(registry: MetricsRegistry, port: int) -> ThreadingHTTPServer:
             elif self.path == "/status":
                 import json as _json
 
-                body = _json.dumps(latest_status, indent=2).encode()
+                # shallow copy: the reconcile thread inserts keys
+                # concurrently and dict iteration must not race it
+                body = _json.dumps(dict(status_source), indent=2).encode()
                 content_type = "application/json"
             else:
                 self.send_response(404)
@@ -129,7 +139,9 @@ def build_manager(args, cluster, clock=None,
         if args.ici_probe:
             from tpu_operator_libs.health.ici_probe import ICIFabricValidator
 
-            extra = ICIFabricValidator()
+            extra = ICIFabricValidator(
+                min_bandwidth_gbytes_per_s=getattr(
+                    args, "min_bandwidth_gbytes_per_s", None))
         mgr.with_validation_enabled(args.validator_selector or "",
                                     extra_validator=extra)
     return mgr
@@ -147,9 +159,12 @@ def reconcile_once(mgr, args, policy, registry, runtime_labels) -> None:
     started = time.monotonic()
     try:
         state = mgr.build_state(args.namespace, runtime_labels)
+        # status reflects the snapshot even when the transition pass below
+        # fails — /status must not freeze on the last-good block during
+        # exactly the incident it exists to expose
+        latest_status[args.driver] = mgr.cluster_status(state)
         mgr.apply_state(state, policy)
         observe_cluster_state(registry, mgr, state, driver=args.driver)
-        latest_status[args.driver] = mgr.cluster_status(state)
         logger.info("reconciled: %d/%d done, %d in progress, %d failed",
                     mgr.get_upgrades_done(state),
                     mgr.get_total_managed_nodes(state),
@@ -307,6 +322,11 @@ def main() -> int:
     parser.add_argument("--checkpoint-max-age", type=float, default=0.0)
     parser.add_argument("--validator-selector", default="",
                         help="label selector for validation pods")
+    parser.add_argument("--min-bandwidth-gbytes-per-s", type=float,
+                        default=None,
+                        help="fail validation when measured per-link ICI "
+                             "throughput is below this floor (GByte/s); "
+                             "requires --ici-probe")
     parser.add_argument("--ici-probe", action="store_true",
                         help="gate validation on the local ICI fabric probe")
     parser.add_argument("--kubeconfig", action="store_true",
@@ -326,6 +346,9 @@ def main() -> int:
                         help="run against a simulated fleet")
     parser.add_argument("--demo-slices", type=int, default=4)
     args = parser.parse_args()
+    if args.min_bandwidth_gbytes_per_s is not None and not args.ici_probe:
+        # without the probe the floor would be silently unenforced
+        parser.error("--min-bandwidth-gbytes-per-s requires --ici-probe")
 
     logging.basicConfig(
         level=logging.INFO,

@@ -30,6 +30,52 @@ class TestDemoOperator:
         assert "demo complete" in proc.stderr
         assert "tpu_upgrade_upgrades_done" in proc.stdout
 
+    def test_unified_demo_runs_to_completion(self):
+        """BASELINE config #5 operator shape: one process drives GPU and
+        TPU runtimes to done under one policy document."""
+        proc = subprocess.run(
+            [sys.executable, "examples/unified_operator.py", "--demo"],
+            capture_output=True, text=True, timeout=150)
+        assert proc.returncode == 0, proc.stderr[-2000:]
+        assert "demo complete" in proc.stderr
+        status = json.loads(proc.stdout)
+        assert status["tpu"]["upgradesDone"] == 4
+        assert status["tpu"]["sliceAvailability"] == 1.0
+        assert status["gpu"]["upgradesDone"] == 2
+        assert "sliceAvailability" not in status["gpu"]
+
+    def test_unified_policy_file_loading(self, tmp_path):
+        sys.path.insert(0, "examples")
+        from unified_operator import load_unified_policy
+
+        policy_file = tmp_path / "u.yaml"
+        policy_file.write_text(json.dumps({
+            "accelerators": {
+                "tpu": {"domain": "google.com", "driver": "libtpu",
+                        "runtimeLabels": {"app": "libtpu"},
+                        "policy": {"topologyMode": "slice"}}}}))
+        spec = load_unified_policy(str(policy_file))
+        assert spec.accelerators["tpu"].policy.topology_mode == "slice"
+
+    def test_unified_policy_null_spec_rejected(self, tmp_path):
+        import pytest
+
+        sys.path.insert(0, "examples")
+        from unified_operator import load_unified_policy
+
+        policy_file = tmp_path / "u.yaml"
+        policy_file.write_text("spec:\n")  # CRD shell with null spec
+        with pytest.raises(ValueError, match="must be a mapping"):
+            load_unified_policy(str(policy_file))
+
+    def test_bandwidth_floor_requires_probe_flag(self):
+        proc = subprocess.run(
+            [sys.executable, "examples/libtpu_operator.py",
+             "--min-bandwidth-gbytes-per-s", "40", "--demo"],
+            capture_output=True, text=True, timeout=60)
+        assert proc.returncode != 0
+        assert "requires --ici-probe" in proc.stderr
+
     def test_policy_file_loading(self, tmp_path):
         from examples.libtpu_operator import load_policy
 

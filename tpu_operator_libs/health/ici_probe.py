@@ -449,7 +449,14 @@ class ICIFabricValidator:
             # ring rides single physical links
             import jax
 
-            if len(jax.devices()) >= 2:
+            if len(jax.devices()) < 2:
+                # off-slice single-device host: the floor is unenforceable
+                # from here — must be visible, not a silent pass
+                logger.warning(
+                    "bandwidth floor configured but only %d local device "
+                    "visible; skipping the throughput gate",
+                    len(jax.devices()))
+            else:
                 if topology:
                     bw = fabric_bandwidth_topology(
                         topology, min_gbytes_per_s=self._min_bandwidth)
