@@ -40,29 +40,10 @@ def main() -> int:
         return 1
 
     # Exercise the real accelerator when present: the validation gate's
-    # fabric probe latency on the local chip(s).
-    probe_ms = None
-    bandwidth_gbps = None
-    try:
-        import jax
-
-        from tpu_operator_libs.health.ici_probe import (
-            fabric_bandwidth_probe,
-            fabric_probe,
-        )
-
-        n = len(jax.devices())
-        while n > 1 and 128 % n:
-            n -= 1
-        result = fabric_probe(n_devices=n)
-        if result.healthy:
-            probe_ms = round(result.latency_s * 1e3, 3)
-            if n > 1:
-                # throughput only means something on a correct fabric
-                bandwidth_gbps = fabric_bandwidth_probe(
-                    n_devices=n).gbytes_per_s
-    except Exception:
-        pass
+    # fabric probe latency on the local chip(s). Runs in a subprocess
+    # with a hard timeout — a wedged TPU tunnel must degrade to null
+    # probe fields, not hang the whole bench.
+    probe_ms, bandwidth_gbps = _hardware_probe(timeout_s=120)
 
     # hot-loop latency: one build_state+apply_state pass over a 256-node
     # fleet mid-upgrade (real wall time, not virtual) — the library-side
@@ -90,6 +71,52 @@ def main() -> int:
         "reconcile_p50_ms_256_nodes": reconcile_ms,
     }))
     return 0
+
+
+_PROBE_SCRIPT = r"""
+import json
+try:
+    import jax
+
+    from tpu_operator_libs.health.ici_probe import (
+        fabric_bandwidth_probe,
+        fabric_probe,
+    )
+
+    n = len(jax.devices())
+    while n > 1 and 128 % n:
+        n -= 1
+    probe_ms = bandwidth = None
+    result = fabric_probe(n_devices=n)
+    if result.healthy:
+        probe_ms = round(result.latency_s * 1e3, 3)
+        if n > 1:
+            # throughput only means something on a correct fabric
+            bandwidth = fabric_bandwidth_probe(n_devices=n).gbytes_per_s
+    print(json.dumps({"probe_ms": probe_ms, "bandwidth": bandwidth}))
+except Exception:
+    print(json.dumps({"probe_ms": None, "bandwidth": None}))
+"""
+
+
+def _hardware_probe(timeout_s: float):
+    """(ici_probe_ms, ici_bandwidth_gbytes_per_s) from a subprocess, or
+    (None, None) on timeout/error."""
+    import json as _json
+    import os
+    import subprocess
+    import sys as _sys
+
+    try:
+        proc = subprocess.run(
+            [_sys.executable, "-c", _PROBE_SCRIPT],
+            capture_output=True, text=True, timeout=timeout_s,
+            cwd=os.path.dirname(os.path.abspath(__file__)))
+        line = [ln for ln in proc.stdout.splitlines() if ln.strip()][-1]
+        data = _json.loads(line)
+        return data.get("probe_ms"), data.get("bandwidth")
+    except Exception:
+        return None, None
 
 
 def _reconcile_latency_ms(n_slices: int = 64, hosts: int = 4,

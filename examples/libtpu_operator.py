@@ -195,27 +195,6 @@ def reconcile_forever(mgr, args, policy, registry, stop: threading.Event,
         stop.wait(args.interval)
 
 
-def reconcile_watch_driven(mgr, args, policy, registry, stop, cluster) -> None:
-    """Event-driven reconcile: Node/Pod/DaemonSet watch events enqueue
-    work, coalesced by the controller's work queue; ``--interval`` becomes
-    the resync safety net instead of the polling cadence."""
-    from tpu_operator_libs.controller import Controller
-
-    runtime_labels = parse_runtime_labels(args)
-
-    def reconcile(_key):
-        reconcile_once(mgr, args, policy, registry, runtime_labels)
-        return None
-
-    ctrl = Controller(reconcile, resync_period=args.interval)
-    ctrl.watch(cluster.watch(namespace=args.namespace))
-    ctrl.start()
-    try:
-        stop.wait()
-    finally:
-        ctrl.stop()
-
-
 def run_demo(args, registry) -> int:
     """Simulated fleet: watch a full slice-atomic rolling upgrade."""
     from tpu_operator_libs.simulate import (
@@ -261,6 +240,22 @@ def run_demo(args, registry) -> int:
     return 0 if outcome["converged"] else 1
 
 
+def election_config(args):
+    """The one LeaderElectionConfig both run paths share — the watch and
+    poll variants of the same deployment must contend for the SAME
+    lease."""
+    import os
+    import socket
+
+    from tpu_operator_libs.k8s.leaderelection import LeaderElectionConfig
+
+    identity = args.leader_identity \
+        or f"{socket.gethostname()}-{os.getpid()}"
+    return LeaderElectionConfig(namespace=args.namespace,
+                                name="tpu-operator-leader",
+                                identity=identity)
+
+
 def run_leader_elected(args, cluster, stop: threading.Event,
                        run_loop) -> None:
     """Gate the reconcile loop on a coordination.k8s.io Lease, the way a
@@ -268,16 +263,10 @@ def run_leader_elected(args, cluster, stop: threading.Event,
     reconcile loop starts when leadership is acquired and the process
     exits when it is lost (the standard HA-operator pattern: let the
     replica controller restart us as a follower)."""
-    import os
-    import socket
+    from tpu_operator_libs.k8s.leaderelection import LeaderElector
 
-    from tpu_operator_libs.k8s.leaderelection import (
-        LeaderElectionConfig,
-        LeaderElector,
-    )
-
-    identity = args.leader_identity \
-        or f"{socket.gethostname()}-{os.getpid()}"
+    config = election_config(args)
+    identity = config.identity
     loop_thread: list[threading.Thread] = []
 
     def on_started():
@@ -291,10 +280,7 @@ def run_leader_elected(args, cluster, stop: threading.Event,
         stop.set()
 
     elector = LeaderElector(
-        cluster,
-        LeaderElectionConfig(namespace=args.namespace,
-                             name="tpu-operator-leader",
-                             identity=identity),
+        cluster, config,
         on_started_leading=on_started,
         on_stopped_leading=on_stopped,
         on_new_leader=lambda leader: logger.info(
@@ -372,12 +358,42 @@ def main() -> int:
 
         exit_code = [0]
 
+        if not args.poll:
+            # Watch-driven default: OperatorManager packages the cached
+            # client, controller, and (optionally) leader election the
+            # way controller-runtime's manager does — caches are built
+            # only after leadership is won.
+            from tpu_operator_libs.controller import ReconcileResult
+            from tpu_operator_libs.manager import OperatorManager
+
+            runtime_labels = parse_runtime_labels(args)
+            held = {}
+
+            def reconcile(_key):
+                if "mgr" not in held:
+                    held["mgr"] = build_manager(args, op_mgr.client)
+                reconcile_once(held["mgr"], args, policy, registry,
+                               runtime_labels)
+                return ReconcileResult()
+
+            election = election_config(args) if args.leader_elect else None
+            op_mgr = OperatorManager(
+                cluster, args.namespace, reconcile,
+                name=f"{args.driver}-operator",
+                use_cache=not args.no_cache,
+                resync_period=args.interval,
+                leader_election=election, metrics=registry)
+            try:
+                op_mgr.run(stop)
+            except TimeoutError as exc:
+                logger.error("startup failed: %s", exc)
+                exit_code[0] = 1
+            return exit_code[0]
+
         def run_loop():
-            # Built here — after leader election is won — so standby
-            # replicas hold no informer caches or watch streams, the way
-            # controller-runtime starts caches only post-election. Reads
-            # go through the cache, writes pass straight through (leases,
-            # evictions unaffected).
+            # Polling fallback (--poll). Built here — after leader
+            # election is won — so standby replicas hold no informer
+            # caches or watch streams.
             client = cluster
             cached = None
             if not args.no_cache:
@@ -393,11 +409,7 @@ def main() -> int:
                     return
             try:
                 mgr = build_manager(args, client)
-                if args.poll:
-                    reconcile_forever(mgr, args, policy, registry, stop)
-                else:
-                    reconcile_watch_driven(mgr, args, policy, registry,
-                                           stop, cluster)
+                reconcile_forever(mgr, args, policy, registry, stop)
             finally:
                 if cached is not None:
                     cached.stop()

@@ -1,0 +1,269 @@
+"""OperatorManager — the controller-runtime manager analogue: cache +
+controller + optional leader election packaged as one runnable."""
+
+import threading
+import time
+
+import pytest
+
+from tpu_operator_libs.controller import ReconcileResult
+from tpu_operator_libs.k8s.cached import CachedReadClient
+from tpu_operator_libs.k8s.leaderelection import LeaderElectionConfig
+from tpu_operator_libs.manager import OperatorManager
+from tpu_operator_libs.util import FakeClock
+
+from builders import NodeBuilder
+from helpers import make_env
+
+NS = "tpu-system"
+
+
+def wait_until(predicate, timeout=10.0, interval=0.01):
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        if predicate():
+            return True
+        time.sleep(interval)
+    return predicate()
+
+
+class TestLifecycle:
+    def test_start_syncs_cache_and_reconciles_on_events(self):
+        env = make_env()
+        NodeBuilder("n1").create(env.cluster)
+        seen = []
+        mgr_box = []
+
+        def reconcile(key):
+            # reads go through the manager's cached client
+            seen.append(len(mgr_box[0].client.list_nodes()))
+            return ReconcileResult()
+
+        mgr = OperatorManager(env.cluster, NS, reconcile, name="t")
+        mgr_box.append(mgr)
+        mgr.start()
+        try:
+            assert mgr.is_started
+            assert isinstance(mgr.client, CachedReadClient)
+            assert wait_until(lambda: len(seen) >= 1)  # initial sync pass
+            NodeBuilder("n2").create(env.cluster)  # event → reconcile
+            assert wait_until(lambda: seen and seen[-1] == 2)
+        finally:
+            mgr.stop()
+        assert not mgr.is_started
+        # stopped: client falls back to the raw backend
+        assert mgr.client is env.cluster
+
+    def test_start_twice_raises(self):
+        env = make_env()
+        mgr = OperatorManager(env.cluster, NS, lambda key: None, name="t")
+        mgr.start()
+        try:
+            with pytest.raises(RuntimeError, match="already started"):
+                mgr.start()
+        finally:
+            mgr.stop()
+
+    def test_no_cache_mode_uses_raw_client(self):
+        env = make_env()
+        mgr = OperatorManager(env.cluster, NS, lambda key: None,
+                              name="t", use_cache=False)
+        mgr.start()
+        try:
+            assert mgr.client is env.cluster
+            assert mgr.has_synced(timeout=0)  # vacuously true
+        finally:
+            mgr.stop()
+
+    def test_cache_sync_failure_raises_and_cleans_up(self):
+        env = make_env()
+
+        class HangingList:
+            def __getattr__(self, name):
+                return getattr(env.cluster, name)
+
+            def list_pods(self, namespace=None, label_selector="",
+                          field_selector=""):
+                time.sleep(3600)
+
+        mgr = OperatorManager(HangingList(), NS, lambda key: None,
+                              name="t", cache_sync_timeout=0.3)
+        with pytest.raises(TimeoutError, match="failed to sync"):
+            mgr.start()
+        assert not mgr.is_started
+
+    def test_run_without_election_blocks_until_stop(self):
+        env = make_env()
+        reconciled = threading.Event()
+
+        def reconcile(key):
+            reconciled.set()
+            return ReconcileResult()
+
+        mgr = OperatorManager(env.cluster, NS, reconcile, name="t")
+        stop = threading.Event()
+        runner = threading.Thread(target=lambda: mgr.run(stop), daemon=True)
+        runner.start()
+        assert reconciled.wait(timeout=10.0)
+        stop.set()
+        runner.join(timeout=10.0)
+        assert not runner.is_alive()
+        assert not mgr.is_started
+
+
+class TestStopDuringSlowStart:
+    def test_stop_returns_promptly_and_aborts_sync(self):
+        env = make_env()
+        release = threading.Event()
+
+        class SlowList:
+            def __getattr__(self, name):
+                return getattr(env.cluster, name)
+
+            def list_pods(self, namespace=None, label_selector="",
+                          field_selector=""):
+                release.wait(timeout=30.0)
+                return []
+
+        mgr = OperatorManager(SlowList(), NS, lambda key: None,
+                              name="t", cache_sync_timeout=30.0)
+        start_done = threading.Event()
+
+        def starter():
+            mgr.start()  # returns (aborted) rather than raising
+            start_done.set()
+
+        t = threading.Thread(target=starter, daemon=True)
+        t.start()
+        time.sleep(0.3)  # let start() reach the sync wait
+        stopped_at = time.monotonic()
+        mgr.stop()
+        # stop must not block for the 30s sync phase
+        assert time.monotonic() - stopped_at < 5.0
+        release.set()
+        assert start_done.wait(timeout=10.0)
+        assert not mgr.is_started
+
+    def test_start_failure_under_election_raises_from_run(self):
+        env = make_env()
+
+        class HangingList:
+            def __getattr__(self, name):
+                return getattr(env.cluster, name)
+
+            def list_pods(self, namespace=None, label_selector="",
+                          field_selector=""):
+                time.sleep(3600)
+
+        config = LeaderElectionConfig(
+            namespace="kube-system", name="op-fail", identity="x",
+            lease_duration=2.0, renew_deadline=1.5, retry_period=0.05)
+        mgr = OperatorManager(HangingList(), NS, lambda key: None,
+                              name="t", cache_sync_timeout=0.3,
+                              leader_election=config)
+        with pytest.raises(TimeoutError, match="failed to sync"):
+            mgr.run(threading.Event())
+
+
+class TestRollingUpgradeThroughManager:
+    def test_full_upgrade_converges(self):
+        """Product shape: the state machine reconciled by OperatorManager
+        (cached reads, watch-driven, resync safety net) drives a fleet to
+        upgrade-done."""
+        from tpu_operator_libs.api.upgrade_policy import (
+            DrainSpec,
+            UpgradePolicySpec,
+        )
+        from tpu_operator_libs.simulate import (
+            NS as SIM_NS,
+            RUNTIME_LABELS,
+            FleetSpec,
+            build_fleet,
+        )
+        from tpu_operator_libs.upgrade.state_manager import (
+            BuildStateError,
+            ClusterUpgradeStateManager,
+        )
+
+        fleet = FleetSpec(n_slices=2, hosts_per_slice=2,
+                          pod_recreate_delay=1.0, pod_ready_delay=1.0)
+        cluster, clock, keys = build_fleet(fleet)
+        policy = UpgradePolicySpec(
+            auto_upgrade=True, max_parallel_upgrades=0,
+            max_unavailable="50%",
+            drain=DrainSpec(enable=True, force=True))
+        done = threading.Event()
+        mgr_box = []
+
+        def reconcile(_key):
+            clock.advance(5.0)
+            cluster.step()
+            if not mgr_box:
+                return ReconcileResult(requeue_after=0.01)
+            try:
+                mgr_box[0].reconcile(SIM_NS, dict(RUNTIME_LABELS), policy)
+            except BuildStateError:
+                return ReconcileResult(requeue=True)
+            if all(n.metadata.labels.get(keys.state_label) == "upgrade-done"
+                   and not n.spec.unschedulable
+                   for n in cluster.list_nodes()):
+                done.set()
+            return ReconcileResult(requeue_after=0.01)
+
+        op = OperatorManager(cluster, SIM_NS, reconcile, name="upgrade",
+                             resync_period=0.5)
+        op.start()
+        mgr_box.append(ClusterUpgradeStateManager(
+            op.client, keys, poll_interval=0.005))
+        try:
+            assert done.wait(timeout=60.0)
+        finally:
+            op.stop()
+        hashes = {p.metadata.labels.get("controller-revision-hash")
+                  for p in cluster.list_pods(SIM_NS)}
+        assert hashes == {"new"}
+
+
+class TestLeaderElectedRun:
+    def _config(self, identity):
+        return LeaderElectionConfig(
+            namespace="kube-system", name="op-leader", identity=identity,
+            lease_duration=2.0, renew_deadline=1.5, retry_period=0.05)
+
+    def test_runtime_gated_on_leadership(self):
+        env = make_env()
+        NodeBuilder("n1").create(env.cluster)
+        a_reconciles = []
+        b_reconciles = []
+
+        def make(identity, sink):
+            def reconcile(key):
+                sink.append(key)
+                return ReconcileResult()
+
+            return OperatorManager(
+                env.cluster, NS, reconcile, name=identity,
+                leader_election=self._config(identity))
+
+        mgr_a = make("rep-a", a_reconciles)
+        mgr_b = make("rep-b", b_reconciles)
+        stop_a, stop_b = threading.Event(), threading.Event()
+        ta = threading.Thread(target=lambda: mgr_a.run(stop_a), daemon=True)
+        ta.start()
+        assert wait_until(lambda: mgr_a.is_started)
+        tb = threading.Thread(target=lambda: mgr_b.run(stop_b), daemon=True)
+        tb.start()
+        # follower must not start while the leader renews
+        time.sleep(0.3)
+        assert not mgr_b.is_started
+        assert b_reconciles == []
+        assert wait_until(lambda: len(a_reconciles) >= 1)
+
+        # leader exits; its release lets the follower take over quickly
+        stop_a.set()
+        ta.join(timeout=10.0)
+        assert wait_until(lambda: mgr_b.is_started, timeout=15.0)
+        assert wait_until(lambda: len(b_reconciles) >= 1)
+        stop_b.set()
+        tb.join(timeout=10.0)
+        assert not mgr_b.is_started

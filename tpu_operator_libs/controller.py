@@ -445,6 +445,13 @@ class Controller:
             self._known_keys.add(key)
         self.queue.add(key)
 
+    def enqueue(self, key: str = CLUSTER_KEY) -> None:
+        """Externally trigger a reconcile for ``key`` (default: the
+        cluster singleton). Lets event sources that are not Watch objects
+        — e.g. a read cache's post-apply informer handlers — drive the
+        controller."""
+        self._enqueue(key)
+
     def forget_key(self, key: str) -> None:
         """Stop resyncing ``key`` (e.g. the reconciler found its object
         gone). A later event for the key re-registers it."""

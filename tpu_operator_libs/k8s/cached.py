@@ -124,6 +124,17 @@ class CachedReadClient(K8sClient):
         for informer in self._informers:
             informer.refresh()
 
+    def add_event_handler(self, on_change) -> None:
+        """``on_change(obj)`` after any add/update/delete is APPLIED to a
+        cache. Wiring reconcile triggers here (rather than to a raw
+        watch) guarantees a triggered reconcile reads a cache that
+        already contains the triggering event."""
+        for informer in self._informers:
+            informer.add_event_handler(
+                on_add=on_change,
+                on_update=lambda _old, new: on_change(new),
+                on_delete=on_change)
+
     def _relist_loop(self, interval: float) -> None:
         while not self._stop_relist.wait(interval):
             try:
