@@ -42,8 +42,12 @@ def main() -> int:
     # Exercise the real accelerator when present: the validation gate's
     # fabric probe latency on the local chip(s). Runs in a subprocess
     # with a hard timeout — a wedged TPU tunnel must degrade to null
-    # probe fields, not hang the whole bench.
-    probe_ms, bandwidth_gbps = _hardware_probe(timeout_s=120)
+    # probe fields, not hang the whole bench. BENCH_PROBE_TIMEOUT lets
+    # CI shrink the wait.
+    import os as _os
+
+    probe_ms, bandwidth_gbps = _hardware_probe(
+        timeout_s=float(_os.environ.get("BENCH_PROBE_TIMEOUT", "120")))
 
     # hot-loop latency: one build_state+apply_state pass over a 256-node
     # fleet mid-upgrade (real wall time, not virtual) — the library-side

@@ -1,0 +1,146 @@
+"""Admission webhook example: AdmissionReview v1 validate + mutate over
+HTTP against the CRD schemas (the admission-side half of the reference's
+kubebuilder marker pipeline)."""
+
+import base64
+import json
+import sys
+import urllib.error
+import urllib.request
+
+import pytest
+
+sys.path.insert(0, "examples")
+
+from admission_webhook import handle_review, make_server  # noqa: E402
+
+PORT = 18431
+
+
+def review(kind, spec, uid="u1"):
+    return {"apiVersion": "admission.k8s.io/v1", "kind": "AdmissionReview",
+            "request": {"uid": uid, "kind": {"kind": kind},
+                        "object": {"spec": spec}}}
+
+
+class TestHandleReview:
+    def test_valid_policy_allowed(self):
+        out = handle_review(review("TPUUpgradePolicy",
+                                   {"autoUpgrade": True}), mutate=False)
+        assert out["response"]["allowed"] is True
+        assert out["response"]["uid"] == "u1"
+
+    def test_schema_violation_denied_with_path(self):
+        out = handle_review(
+            review("TPUUpgradePolicy",
+                   {"maxParallelUpgrades": -2}), mutate=False)
+        assert out["response"]["allowed"] is False
+        assert "maxParallelUpgrades" in out["response"]["status"]["message"]
+
+    def test_semantic_violation_denied(self):
+        # schema-valid but semantically invalid: negative percent string
+        # (the reference accepts this silently; we reject)
+        out = handle_review(
+            review("TPUUpgradePolicy",
+                   {"maxUnavailable": "-25%"}), mutate=False)
+        assert out["response"]["allowed"] is False
+
+    def test_unknown_kind_denied(self):
+        out = handle_review(review("GpuPolicy", {}), mutate=False)
+        assert out["response"]["allowed"] is False
+        assert "unsupported kind" in out["response"]["status"]["message"]
+
+    def test_missing_spec_denied(self):
+        out = handle_review(
+            {"request": {"uid": "u2", "kind": {"kind": "TPUUpgradePolicy"},
+                         "object": {}}}, mutate=False)
+        assert out["response"]["allowed"] is False
+
+    def test_mutate_fills_defaults_as_jsonpatch(self):
+        out = handle_review(review("TPUUpgradePolicy",
+                                   {"autoUpgrade": True}), mutate=True)
+        resp = out["response"]
+        assert resp["allowed"] is True
+        assert resp["patchType"] == "JSONPatch"
+        patch = json.loads(base64.b64decode(resp["patch"]))
+        assert patch[0]["op"] == "replace" and patch[0]["path"] == "/spec"
+        defaulted = patch[0]["value"]
+        assert defaulted["maxParallelUpgrades"] == 1
+        assert defaulted["maxUnavailable"] == "25%"
+
+    def test_mutate_noop_when_already_defaulted(self):
+        spec = {"autoUpgrade": True}
+        first = handle_review(review("TPUUpgradePolicy", spec), mutate=True)
+        defaulted = json.loads(base64.b64decode(
+            first["response"]["patch"]))[0]["value"]
+        second = handle_review(review("TPUUpgradePolicy", defaulted),
+                               mutate=True)
+        assert "patch" not in second["response"]
+
+    def test_unified_kind_supported(self):
+        spec = {"accelerators": {
+            "tpu": {"domain": "google.com", "driver": "libtpu",
+                    "runtimeLabels": {"app": "libtpu"},
+                    "policy": {"topologyMode": "slice"}}}}
+        out = handle_review(review("UnifiedUpgradePolicy", spec),
+                            mutate=False)
+        assert out["response"]["allowed"] is True
+
+    def test_unified_duplicate_namespace_denied(self):
+        spec = {"accelerators": {
+            "a": {"domain": "x.com", "driver": "d",
+                  "runtimeLabels": {"k": "v"}},
+            "b": {"domain": "x.com", "driver": "d",
+                  "runtimeLabels": {"k": "v"}}}}
+        out = handle_review(review("UnifiedUpgradePolicy", spec),
+                            mutate=False)
+        assert out["response"]["allowed"] is False
+
+
+class TestHTTPServer:
+    @pytest.fixture(scope="class")
+    def server(self):
+        server = make_server(PORT)
+        import threading
+
+        threading.Thread(target=server.serve_forever, daemon=True).start()
+        yield server
+        server.shutdown()
+        server.server_close()
+
+    def _post(self, path, body):
+        req = urllib.request.Request(
+            f"http://localhost:{PORT}{path}",
+            data=json.dumps(body).encode(),
+            headers={"Content-Type": "application/json"})
+        with urllib.request.urlopen(req) as resp:
+            return json.load(resp)
+
+    def test_validate_endpoint_round_trip(self, server):
+        out = self._post("/validate",
+                         review("TPUUpgradePolicy", {"autoUpgrade": True}))
+        assert out["response"]["allowed"] is True
+        out = self._post("/validate",
+                         review("TPUUpgradePolicy",
+                                {"maxParallelUpgrades": -1}))
+        assert out["response"]["allowed"] is False
+
+    def test_mutate_endpoint_round_trip(self, server):
+        out = self._post("/mutate",
+                         review("TPUUpgradePolicy", {}))
+        assert out["response"]["patchType"] == "JSONPatch"
+
+    def test_unknown_path_404(self, server):
+        req = urllib.request.Request(
+            f"http://localhost:{PORT}/nope", data=b"{}")
+        with pytest.raises(urllib.error.HTTPError) as exc_info:
+            urllib.request.urlopen(req)
+        assert exc_info.value.code == 404
+
+    def test_malformed_body_400(self, server):
+        req = urllib.request.Request(
+            f"http://localhost:{PORT}/validate", data=b"not json",
+            headers={"Content-Type": "application/json"})
+        with pytest.raises(urllib.error.HTTPError) as exc_info:
+            urllib.request.urlopen(req)
+        assert exc_info.value.code == 400

@@ -75,9 +75,16 @@ class TestGraftEntry:
         graft.dryrun_multichip(8)  # raises on any failure
 
     def test_bench_prints_one_json_line(self):
+        import os
+
+        env = dict(os.environ)
+        # CI must not wait out the full hardware-probe timeout when the
+        # accelerator tunnel is absent or wedged; null probe fields are
+        # the expected degradation
+        env["BENCH_PROBE_TIMEOUT"] = "10"
         proc = subprocess.run(
             [sys.executable, "bench.py"], capture_output=True, text=True,
-            timeout=300)
+            timeout=300, env=env)
         assert proc.returncode == 0, proc.stderr
         lines = [ln for ln in proc.stdout.splitlines() if ln.strip()]
         assert len(lines) == 1
