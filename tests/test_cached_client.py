@@ -341,6 +341,70 @@ class TestRelistReplace:
         assert updates == ["n1"]
         informer.stop()
 
+    def test_refresh_applies_recreated_object_despite_stale_tombstone(self):
+        # delete observed via watch → tombstone; object recreated but the
+        # ADD is lost in a watch gap. A snapshot taken after the delete
+        # that contains the key means the object is back — refresh must
+        # apply it now, not one relist interval later.
+        from tpu_operator_libs.controller import Informer
+        from tpu_operator_libs.k8s.watch import (
+            DELETED,
+            KIND_POD,
+            Watch,
+            WatchEvent,
+        )
+        env = make_env()
+        node = NodeBuilder("n1").create(env.cluster)
+        PodBuilder("p1").on_node(node).orphaned().create(env.cluster)
+        informer = Informer(lambda: env.cluster.list_pods("tpu-system"),
+                            Watch(), name="t")
+        adds = []
+        informer.add_event_handler(
+            on_add=lambda p: adds.append(p.metadata.name))
+        informer.start()
+        assert informer.has_synced(timeout=5.0)
+        gone = env.cluster.get_pod("tpu-system", "p1")
+        env.cluster.delete_pod("tpu-system", "p1")
+        informer._apply(WatchEvent(DELETED, KIND_POD, gone))
+        assert informer.get("tpu-system", "p1") is None
+        PodBuilder("p1").on_node(node).orphaned().create(env.cluster)
+        informer.refresh()  # list starts after the tombstone
+        assert informer.get("tpu-system", "p1") is not None
+        assert adds == ["p1", "p1"]
+        informer.stop()
+
+    def test_delete_tombstones_are_ttl_pruned_without_relist(self,
+                                                            monkeypatch):
+        # with relisting disabled, tombstones must not accumulate for the
+        # process lifetime; _apply prunes expired ones on each delete
+        import tpu_operator_libs.controller as controller_mod
+        from tpu_operator_libs.controller import Informer
+        from tpu_operator_libs.k8s.watch import (
+            DELETED,
+            KIND_POD,
+            Watch,
+            WatchEvent,
+        )
+        monkeypatch.setattr(controller_mod, "_TOMBSTONE_TTL", 0.0)
+        monkeypatch.setattr(controller_mod, "_TOMBSTONE_PRUNE_EVERY", 1)
+        env = make_env()
+        node = NodeBuilder("n1").create(env.cluster)
+        for i in range(4):
+            PodBuilder(f"p{i}").on_node(node).orphaned().create(env.cluster)
+        informer = Informer(lambda: env.cluster.list_pods("tpu-system"),
+                            Watch(), name="t")
+        informer.start()
+        assert informer.has_synced(timeout=5.0)
+        for i in range(4):
+            gone = env.cluster.get_pod("tpu-system", f"p{i}")
+            env.cluster.delete_pod("tpu-system", f"p{i}")
+            time.sleep(0.002)  # let each tombstone expire (ttl=0)
+            informer._apply(WatchEvent(DELETED, KIND_POD, gone))
+        tombstones = [k for k in informer._last_applied
+                      if k not in informer._store]
+        assert len(tombstones) <= 1  # only the just-written one survives
+        informer.stop()
+
     def test_has_synced_budget_is_shared_not_per_cache(self):
         env = make_env()
 

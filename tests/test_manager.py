@@ -92,6 +92,71 @@ class TestLifecycle:
             mgr.start()
         assert not mgr.is_started
 
+    def test_cache_sync_timeout_zero_passes_when_already_synced(
+            self, monkeypatch):
+        # do-while: the sync wait must ask has_synced() at least once,
+        # so timeout<=0 on an instantly-synced cache is not a spurious
+        # TimeoutError
+        import tpu_operator_libs.k8s.cached as cached_mod
+        env = make_env()
+
+        class InstantCache:
+            def __init__(self, delegate, namespace):
+                self._delegate = delegate
+
+            def has_synced(self, timeout=None):
+                return True
+
+            def add_event_handler(self, on_change):
+                pass
+
+            def stop(self):
+                pass
+
+            def __getattr__(self, name):
+                return getattr(self._delegate, name)
+
+        monkeypatch.setattr(cached_mod, "CachedReadClient", InstantCache)
+        mgr = OperatorManager(env.cluster, NS, lambda key: None,
+                              name="t", cache_sync_timeout=0.0)
+        mgr.start()
+        try:
+            assert mgr.is_started
+        finally:
+            mgr.stop()
+
+    def test_concurrent_stop_during_start_leaves_manager_stopped(
+            self, monkeypatch):
+        # publish+worker-start happen under one lock hold, so a stop()
+        # issued mid-start is ordered after the workers exist and tears
+        # the manager down normally — never is_started with no controller
+        from tpu_operator_libs.controller import Controller
+        env = make_env()
+        mgr = OperatorManager(env.cluster, NS, lambda key: None,
+                              name="t", use_cache=False)
+        orig_start = Controller.start
+        stoppers = []
+
+        def racing_start(self, workers=1):
+            t = threading.Thread(target=mgr.stop)
+            stoppers.append(t)
+            t.start()  # blocks on the manager lock until start() is done
+            orig_start(self, workers=workers)
+
+        monkeypatch.setattr(Controller, "start", racing_start)
+        mgr.start()
+        stoppers[0].join(timeout=10.0)
+        assert not stoppers[0].is_alive()
+        assert not mgr.is_started
+        assert mgr.client is env.cluster  # refs taken by the stop
+        # a fresh start must work after the concurrent stop
+        monkeypatch.setattr(Controller, "start", orig_start)
+        mgr.start()
+        try:
+            assert mgr.is_started
+        finally:
+            mgr.stop()
+
     def test_run_without_election_blocks_until_stop(self):
         env = make_env()
         reconciled = threading.Event()

@@ -186,6 +186,16 @@ def default_key_fn(obj: object) -> tuple[str, str]:
     return (getattr(meta, "namespace", "") or "", meta.name)
 
 
+# How long a deletion tombstone can outlive its key before _apply prunes
+# it. Only a refresh() whose list started before the tombstone needs it;
+# no list takes 10 minutes, so this is safely conservative while keeping
+# _last_applied bounded even with periodic relisting disabled.
+_TOMBSTONE_TTL = 600.0
+# Sweep cadence for the amortized tombstone prune in _apply (the sweep is
+# O(len(_last_applied)) under _store_lock, so not on every delete).
+_TOMBSTONE_PRUNE_EVERY = 64
+
+
 class Informer:
     """List+watch cache for one object kind.
 
@@ -208,6 +218,7 @@ class Informer:
         # list began (client-go serializes Replace through DeltaFIFO for
         # the same reason).
         self._last_applied: dict[tuple[str, str], float] = {}
+        self._deletes_since_prune = 0
         self._store_lock = threading.Lock()
         self._synced = threading.Event()
         self._handlers: list[tuple[
@@ -272,7 +283,24 @@ class Informer:
         if event.type == DELETED:
             with self._store_lock:
                 old = self._store.pop(key, None)
-                self._last_applied[key] = time.monotonic()  # tombstone
+                now = time.monotonic()
+                self._last_applied[key] = now  # tombstone
+                # Tombstones exist only to stop an in-flight refresh()
+                # from resurrecting a concurrently-deleted key; one older
+                # than any plausible list duration protects nothing.
+                # refresh() prunes the tombstones it creates itself; this
+                # amortized sweep bounds the watch-DELETED path even with
+                # periodic relisting disabled (CachedReadClient
+                # relist_interval=None). Amortized (every 64th delete)
+                # because the sweep scans all of _last_applied — live
+                # keys included — under _store_lock.
+                self._deletes_since_prune += 1
+                if self._deletes_since_prune >= _TOMBSTONE_PRUNE_EVERY:
+                    self._deletes_since_prune = 0
+                    cutoff = now - _TOMBSTONE_TTL
+                    for k in [k for k, t in self._last_applied.items()
+                              if t < cutoff and k not in self._store]:
+                        del self._last_applied[k]
             for _, _, on_delete in self._handlers:
                 if on_delete is not None:
                     self._safe(on_delete, old if old is not None else obj)
@@ -335,6 +363,17 @@ class Informer:
             def newer_than_list(key: tuple[str, str]) -> bool:
                 return self._last_applied.get(key, -1.0) >= list_started
 
+            # Tombstones older than the list have served their purpose:
+            # the snapshot was taken after those deletes applied, so if
+            # it still contains such a key the object was RECREATED and
+            # the watch ADD was lost — exactly the gap relist heals.
+            # Pruning first lets the fresh-object loop apply it now
+            # instead of one relist interval later. Delete-during-list
+            # tombstones are >= list_started and are preserved by the
+            # newer_than_list check below.
+            for key in [k for k, t in self._last_applied.items()
+                        if k not in self._store and t < list_started]:
+                del self._last_applied[key]
             for key in [k for k in self._store if k not in fresh]:
                 if newer_than_list(key):
                     continue  # added by a watch event during the list
@@ -344,21 +383,12 @@ class Informer:
                 if newer_than_list(key):
                     continue  # modified/deleted during the list; keep event
                 old = self._store.get(key)
-                if old is None and key in self._last_applied:
-                    # tombstoned before the list began: the object was in
-                    # the (stale) snapshot but deleted since
-                    continue
                 self._store[key] = obj
                 self._last_applied[key] = list_started
                 if old is None:
                     added.append(obj)
                 elif old != obj:
                     updated.append((old, obj))
-            # drop tombstones that predate this list and were not
-            # resurrected — they have served their purpose
-            for key in [k for k, t in self._last_applied.items()
-                        if k not in self._store and t < list_started]:
-                del self._last_applied[key]
         for obj in deleted:
             for _, _, on_delete in self._handlers:
                 if on_delete is not None:

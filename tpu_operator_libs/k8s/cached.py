@@ -96,7 +96,10 @@ class CachedReadClient(K8sClient):
         # A restarted live watch re-delivers current objects but never
         # DELETEDs lost in the stream gap; periodic relist (Reflector
         # Replace) prunes such ghosts so e.g. _wait_for_delete cannot
-        # spin on a pod that terminated during the gap.
+        # spin on a pod that terminated during the gap. With
+        # relist_interval=None ghost objects persist until a manual
+        # refresh(); deletion tombstones stay bounded either way (the
+        # informer TTL-prunes them on delete, controller._TOMBSTONE_TTL).
         self._stop_relist = threading.Event()
         self._relist_thread: Optional[threading.Thread] = None
         if relist_interval is not None and relist_interval > 0:

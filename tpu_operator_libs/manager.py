@@ -135,12 +135,20 @@ class OperatorManager:
 
                 end = _time.monotonic() + self._cache_sync_timeout
                 synced = False
-                while _time.monotonic() < end:
+                # do-while shape: an already-synced cache must pass even
+                # with cache_sync_timeout <= 0 (the deadline-first loop
+                # would return a spurious TimeoutError without ever
+                # asking).
+                while True:
                     if self._stop_requested.is_set():
                         cached.stop()
                         return
-                    if cached.has_synced(timeout=0.2):
+                    remaining = end - _time.monotonic()
+                    if cached.has_synced(
+                            timeout=min(0.2, max(0.0, remaining))):
                         synced = True
+                        break
+                    if remaining <= 0:
                         break
                 if not synced:
                     cached.stop()
@@ -165,14 +173,21 @@ class OperatorManager:
                     self._raw_client.watch(namespace=self._namespace))
             with self._lock:
                 if self._stop_requested.is_set():
-                    controller = None
                     if cached is not None:
                         cached.stop()
                     return
                 self._cached = cached
                 self._controller = controller
-            controller.start(workers=self._workers)
-            self._started.set()
+                # Publish and start under ONE lock hold: a concurrent
+                # stop() is thereby ordered strictly before the publish
+                # (caught by the check above) or after the workers exist
+                # (normal teardown) — there is no window where it stops
+                # a not-yet-started controller. controller.start only
+                # spawns threads, so holding the lock here is cheap; the
+                # lock-free waiting the docstring describes is for the
+                # long cache-sync loop above, not this.
+                controller.start(workers=self._workers)
+                self._started.set()
             logger.info("%s: started (cache=%s)", self._name,
                         self._use_cache)
         except BaseException:
