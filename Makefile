@@ -2,10 +2,13 @@
 
 PYTHON ?= python3
 PYTEST_FLAGS ?= -q
+COV_THRESHOLD ?= 85
 
-.PHONY: all test test-fast lint cov bench graft-check clean
+.PHONY: all check test test-fast lint cov bench graft-check package clean
 
 all: lint test
+
+check: lint test cov package
 
 test:
 	$(PYTHON) -m pytest tests/ $(PYTEST_FLAGS)
@@ -13,22 +16,37 @@ test:
 test-fast:
 	$(PYTHON) -m pytest tests/ $(PYTEST_FLAGS) -x
 
-# Byte-compile everything + pyflakes when available (the reference pins
-# golangci-lint; this image has no ruff/flake8 baked in, so lint degrades
-# gracefully to a compile check).
+# In-repo static analyzer (tools/lint.py): always available, fails on
+# findings — no silent degradation when external linters are missing
+# (the reference pins golangci-lint the same way, Makefile:44-46).
+# When ruff/pyflakes exist in the environment they run as an extra
+# belt-and-suspenders pass and also fail the target.
 lint:
-	$(PYTHON) -m compileall -q tpu_operator_libs tests examples bench.py __graft_entry__.py
-	@if $(PYTHON) -c "import pyflakes" 2>/dev/null; then \
-		$(PYTHON) -m pyflakes tpu_operator_libs tests examples; \
-	else \
-		echo "pyflakes not installed; compile check only"; \
+	$(PYTHON) -m compileall -q tpu_operator_libs tools tests examples bench.py __graft_entry__.py
+	$(PYTHON) tools/lint.py
+	@if $(PYTHON) -c "import ruff" 2>/dev/null; then \
+		$(PYTHON) -m ruff check tpu_operator_libs tools tests examples; \
+	elif $(PYTHON) -c "import pyflakes" 2>/dev/null; then \
+		$(PYTHON) -m pyflakes tpu_operator_libs tools tests examples; \
 	fi
 
+# Line coverage with a hard gate (reference: Coveralls upload,
+# ci.yaml:45-64). Built on sys.monitoring — no external deps.
+COV_ARGS ?=
 cov:
-	@$(PYTHON) -c "import coverage" 2>/dev/null \
-		&& $(PYTHON) -m coverage run -m pytest tests/ -q \
-		&& $(PYTHON) -m coverage report --include='tpu_operator_libs/*' \
-		|| $(PYTHON) -m pytest tests/ $(PYTEST_FLAGS)
+	$(PYTHON) tools/cov.py --threshold $(COV_THRESHOLD) $(COV_ARGS)
+
+# Wheel build + install into a scratch prefix + import & entry-point
+# smoke — proves `pip install tpu-operator-libs` works.
+package:
+	rm -rf build dist .pkgtest
+	$(PYTHON) -m build --wheel --no-isolation -o dist .
+	$(PYTHON) -m pip install --quiet --no-deps --target .pkgtest dist/*.whl
+	PYTHONPATH=$(CURDIR)/.pkgtest $(PYTHON) -P -c "import tpu_operator_libs; \
+		assert '.pkgtest' in tpu_operator_libs.__file__, tpu_operator_libs.__file__; \
+		import tpu_operator_libs.examples.libtpu_operator; \
+		print('package import OK from', tpu_operator_libs.__file__)"
+	rm -rf .pkgtest
 
 bench:
 	$(PYTHON) bench.py
@@ -38,4 +56,4 @@ graft-check:
 
 clean:
 	find . -name __pycache__ -type d -exec rm -rf {} + 2>/dev/null || true
-	rm -rf .pytest_cache .coverage
+	rm -rf .pytest_cache .coverage build dist .pkgtest *.egg-info

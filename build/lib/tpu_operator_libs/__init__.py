@@ -1,0 +1,32 @@
+"""tpu_operator_libs: TPU-native Kubernetes operator support libraries.
+
+A from-scratch, TPU-first re-design of the capability surface of
+NVIDIA's ``k8s-operator-libs`` (reference: /root/reference): a cluster-wide,
+per-node rolling-upgrade state machine for accelerator-runtime DaemonSets
+(libtpu / TPU device plugin on GKE TPU node pools), with cordon / drain /
+pod-deletion / validation / safe-load managers, a declarative upgrade policy,
+and durable state recorded in node labels so every reconcile is stateless and
+idempotent (reference: pkg/upgrade/upgrade_state.go:68-72).
+
+Beyond the reference's capability surface this package adds what TPU hardware
+demands:
+
+- ICI-topology-aware upgrade planning: on multi-host TPU slices nodes are not
+  independent (draining one host idles the whole ICI domain), so the upgrade
+  unit is a sub-slice, not a node (``tpu_operator_libs.topology``).
+- A JAX-native ICI fabric health gate run before uncordoning upgraded nodes
+  (``tpu_operator_libs.health``), replacing the reference's OFED/RDMA story.
+- An Orbax checkpoint-durability gate so live JAX training jobs are only
+  evicted once their latest checkpoint is committed
+  (``tpu_operator_libs.health.checkpoint_gate``).
+"""
+
+__version__ = "0.1.0"
+
+from tpu_operator_libs.consts import UpgradeState  # noqa: F401
+from tpu_operator_libs.api.upgrade_policy import (  # noqa: F401
+    DrainSpec,
+    PodDeletionSpec,
+    UpgradePolicySpec,
+    WaitForCompletionSpec,
+)

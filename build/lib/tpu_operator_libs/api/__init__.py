@@ -1,0 +1,9 @@
+"""Declarative upgrade-policy API types (CRD-embeddable)."""
+
+from tpu_operator_libs.api.upgrade_policy import (  # noqa: F401
+    DrainSpec,
+    PodDeletionSpec,
+    UpgradePolicySpec,
+    WaitForCompletionSpec,
+    scaled_value_from_int_or_percent,
+)

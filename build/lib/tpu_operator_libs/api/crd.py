@@ -1,0 +1,390 @@
+"""CRD manifest generation + structural-schema defaulting/validation.
+
+The reference's policy types carry kubebuilder markers (``+kubebuilder:
+default``, ``+kubebuilder:validation:Minimum`` — upgrade_spec.go:27-110)
+and rely on controller-gen to turn them into a CustomResourceDefinition's
+OpenAPI v3 schema, with the API server applying defaults and validation at
+admission. This build has no controller-gen, so this module is that
+pipeline, owned directly:
+
+- ``upgrade_policy_schema()`` / ``unified_policy_schema()`` — OpenAPI v3
+  structural schemas for the policy specs, with the same defaults and
+  minimums the reference's markers declare (plus the beyond-reference
+  ``topologyMode`` enum).
+- ``build_crd()`` — wraps a spec schema into a complete CRD manifest a
+  consumer can ``kubectl apply`` to get a standalone ``TPUUpgradePolicy``
+  (or unified multi-accelerator) resource.
+- ``apply_defaults()`` / ``validate_against_schema()`` — the API-server
+  side of the contract for tests and offline policy linting; defaulting
+  here must agree with ``from_dict`` defaulting (pinned by
+  tests/test_crd.py).
+
+Run ``python -m tpu_operator_libs.api.crd`` to (re)generate
+``examples/crd/*.yaml``.
+"""
+
+from __future__ import annotations
+
+import json
+from typing import Any, Optional
+
+from tpu_operator_libs.api.upgrade_policy import PolicyValidationError
+
+DEFAULT_GROUP = "tpu-operator.dev"
+DEFAULT_VERSION = "v1alpha1"
+
+
+def _int_or_string(description: str, default: Any = None) -> dict[str, Any]:
+    schema: dict[str, Any] = {
+        "x-kubernetes-int-or-string": True,
+        "description": description,
+    }
+    if default is not None:
+        schema["default"] = default
+    return schema
+
+
+def wait_for_completion_schema() -> dict[str, Any]:
+    """WaitForCompletionSpec (upgrade_spec.go:52-64)."""
+    return {
+        "type": "object",
+        "description": "Wait for selected workload pods to finish before "
+                       "disrupting the node.",
+        "properties": {
+            "podSelector": {
+                "type": "string",
+                "description": "Label selector for pods to wait on; empty "
+                               "means don't wait.",
+                "default": "",
+            },
+            "timeoutSeconds": {
+                "type": "integer",
+                "minimum": 0,
+                "default": 0,
+                "description": "Seconds to wait before giving up; 0 waits "
+                               "forever.",
+            },
+        },
+    }
+
+
+def pod_deletion_schema() -> dict[str, Any]:
+    """PodDeletionSpec (upgrade_spec.go:67-83)."""
+    return {
+        "type": "object",
+        "description": "Configuration for the optional pod-deletion state.",
+        "properties": {
+            "force": {
+                "type": "boolean",
+                "default": False,
+                "description": "Allow deleting pods that have no "
+                               "controller.",
+            },
+            "timeoutSeconds": {
+                "type": "integer",
+                "minimum": 0,
+                "default": 300,
+                "description": "Seconds to wait for pod termination; 0 is "
+                               "infinite.",
+            },
+            "deleteEmptyDir": {
+                "type": "boolean",
+                "default": False,
+                "description": "Proceed even if pods use emptyDir volumes "
+                               "(their data is lost).",
+            },
+        },
+    }
+
+
+def drain_schema() -> dict[str, Any]:
+    """DrainSpec (upgrade_spec.go:86-110)."""
+    return {
+        "type": "object",
+        "description": "Configuration for node drain during upgrade.",
+        "properties": {
+            "enable": {
+                "type": "boolean",
+                "default": False,
+                "description": "Master switch; when false the drain state "
+                               "is skipped entirely.",
+            },
+            "force": {
+                "type": "boolean",
+                "default": False,
+                "description": "Evict pods without a controller.",
+            },
+            "podSelector": {
+                "type": "string",
+                "default": "",
+                "description": "Label selector restricting which pods are "
+                               "drained; empty means all.",
+            },
+            "timeoutSeconds": {
+                "type": "integer",
+                "minimum": 0,
+                "default": 300,
+                "description": "Seconds before giving up the drain; 0 is "
+                               "infinite.",
+            },
+            "deleteEmptyDir": {
+                "type": "boolean",
+                "default": False,
+                "description": "Evict pods using emptyDir volumes (their "
+                               "data is deleted).",
+            },
+        },
+    }
+
+
+def upgrade_policy_schema() -> dict[str, Any]:
+    """The embeddable policy spec (DriverUpgradePolicySpec,
+    upgrade_spec.go:27-49) with reference defaults: autoUpgrade=false,
+    maxParallelUpgrades=1, maxUnavailable="25%"."""
+    return {
+        "type": "object",
+        "description": "Rolling-upgrade policy for an accelerator runtime "
+                       "DaemonSet.",
+        "properties": {
+            "autoUpgrade": {
+                "type": "boolean",
+                "default": False,
+                "description": "Global switch for the automatic upgrade "
+                               "feature; when false all other options are "
+                               "ignored.",
+            },
+            "maxParallelUpgrades": {
+                "type": "integer",
+                "minimum": 0,
+                "default": 1,
+                "description": "How many nodes may upgrade concurrently; "
+                               "0 means no limit.",
+            },
+            "maxUnavailable": _int_or_string(
+                "Maximum number (ex: 5) or percentage (ex: \"10%\") of "
+                "nodes that may be unavailable during the upgrade, "
+                "cordoned/not-ready nodes included. Percentages round up.",
+                default="25%"),
+            "podDeletion": pod_deletion_schema(),
+            "waitForCompletion": wait_for_completion_schema(),
+            "drain": drain_schema(),
+            "topologyMode": {
+                "type": "string",
+                "enum": ["flat", "slice"],
+                "default": "flat",
+                "description": "Upgrade unit: 'flat' treats nodes as "
+                               "independent (reference semantics); 'slice' "
+                               "upgrades whole ICI domains atomically.",
+            },
+        },
+    }
+
+
+def unified_policy_schema() -> dict[str, Any]:
+    """UnifiedUpgradePolicySpec: per-accelerator policies in one document
+    (BASELINE config #5)."""
+    return {
+        "type": "object",
+        "description": "Per-accelerator upgrade policies under one "
+                       "resource (mixed GPU+TPU clusters).",
+        "properties": {
+            "accelerators": {
+                "type": "object",
+                "description": "Accelerator name -> runtime + policy.",
+                "additionalProperties": {
+                    "type": "object",
+                    "required": ["domain", "runtimeLabels"],
+                    "properties": {
+                        "driver": {
+                            "type": "string",
+                            "description": "Driver name used in node "
+                                           "label/annotation keys; "
+                                           "defaults to the entry name.",
+                        },
+                        "domain": {
+                            "type": "string",
+                            "description": "Label-key domain, e.g. "
+                                           "google.com or nvidia.com.",
+                        },
+                        "runtimeLabels": {
+                            "type": "object",
+                            "additionalProperties": {"type": "string"},
+                            "description": "Labels selecting the runtime "
+                                           "DaemonSet.",
+                        },
+                        "namespace": {
+                            "type": "string",
+                            "default": "kube-system",
+                            "description": "Namespace of the runtime "
+                                           "DaemonSet.",
+                        },
+                        "policy": upgrade_policy_schema(),
+                    },
+                },
+            },
+        },
+    }
+
+
+def build_crd(kind: str = "TPUUpgradePolicy",
+              plural: Optional[str] = None,
+              group: str = DEFAULT_GROUP,
+              version: str = DEFAULT_VERSION,
+              spec_schema: Optional[dict[str, Any]] = None,
+              scope: str = "Cluster") -> dict[str, Any]:
+    """A complete CustomResourceDefinition manifest embedding the policy
+    schema under .spec — what controller-gen would emit for a consumer
+    CRD that embeds DriverUpgradePolicySpec."""
+    singular = kind.lower()
+    plural = plural or (singular[:-1] + "ies" if singular.endswith("y")
+                        else singular + "s")
+    spec_schema = spec_schema or upgrade_policy_schema()
+    return {
+        "apiVersion": "apiextensions.k8s.io/v1",
+        "kind": "CustomResourceDefinition",
+        "metadata": {"name": f"{plural}.{group}"},
+        "spec": {
+            "group": group,
+            "names": {
+                "kind": kind,
+                "listKind": f"{kind}List",
+                "plural": plural,
+                "singular": singular,
+            },
+            "scope": scope,
+            "versions": [{
+                "name": version,
+                "served": True,
+                "storage": True,
+                "subresources": {"status": {}},
+                "schema": {
+                    "openAPIV3Schema": {
+                        "type": "object",
+                        "properties": {
+                            "apiVersion": {"type": "string"},
+                            "kind": {"type": "string"},
+                            "metadata": {"type": "object"},
+                            "spec": spec_schema,
+                            "status": {
+                                "type": "object",
+                                "x-kubernetes-preserve-unknown-fields": True,
+                            },
+                        },
+                    },
+                },
+            }],
+        },
+    }
+
+
+# ---------------------------------------------------------------------------
+# The API-server side: structural defaulting + validation
+# ---------------------------------------------------------------------------
+
+def apply_defaults(data: Optional[dict[str, Any]],
+                   schema: dict[str, Any]) -> dict[str, Any]:
+    """Fill in schema defaults the way the API server does at admission:
+    a property's default applies when the key is absent; defaults inside
+    a sub-object apply only once the sub-object itself exists (absent
+    optional sub-objects stay absent, matching nil sub-specs in the
+    reference)."""
+    out = dict(data or {})
+    for name, prop in schema.get("properties", {}).items():
+        if name not in out:
+            if "default" in prop:
+                out[name] = prop["default"]
+            continue
+        if prop.get("type") == "object" and isinstance(out[name], dict):
+            out[name] = apply_defaults(out[name], prop)
+    extra = schema.get("additionalProperties")
+    if isinstance(extra, dict) and extra.get("type") == "object":
+        for name, value in out.items():
+            if name not in schema.get("properties", {}) \
+                    and isinstance(value, dict):
+                out[name] = apply_defaults(value, extra)
+    return out
+
+
+def validate_against_schema(data: Any, schema: dict[str, Any],
+                            path: str = "spec") -> None:
+    """Structural validation with the subset of OpenAPI the policy schemas
+    use: type, minimum, enum, required, additionalProperties,
+    x-kubernetes-int-or-string. Raises PolicyValidationError with the
+    offending path."""
+    if schema.get("x-kubernetes-int-or-string"):
+        if not isinstance(data, (int, str)) or isinstance(data, bool):
+            raise PolicyValidationError(
+                f"{path}: expected integer or string, got "
+                f"{type(data).__name__}")
+        return
+    expected = schema.get("type")
+    if expected == "object":
+        if not isinstance(data, dict):
+            raise PolicyValidationError(
+                f"{path}: expected object, got {type(data).__name__}")
+        for req in schema.get("required", []):
+            if req not in data:
+                raise PolicyValidationError(f"{path}.{req}: required")
+        props = schema.get("properties", {})
+        extra = schema.get("additionalProperties")
+        for key, value in data.items():
+            if key in props:
+                validate_against_schema(value, props[key], f"{path}.{key}")
+            elif isinstance(extra, dict):
+                validate_against_schema(value, extra, f"{path}.{key}")
+            # unknown fields with no additionalProperties schema are
+            # pruned by the server, not rejected; accept them here too
+        return
+    if expected == "integer":
+        if not isinstance(data, int) or isinstance(data, bool):
+            raise PolicyValidationError(
+                f"{path}: expected integer, got {type(data).__name__}")
+    elif expected == "boolean":
+        if not isinstance(data, bool):
+            raise PolicyValidationError(
+                f"{path}: expected boolean, got {type(data).__name__}")
+    elif expected == "string":
+        if not isinstance(data, str):
+            raise PolicyValidationError(
+                f"{path}: expected string, got {type(data).__name__}")
+    if "minimum" in schema and isinstance(data, (int, float)) \
+            and not isinstance(data, bool):
+        if data < schema["minimum"]:
+            raise PolicyValidationError(
+                f"{path}: {data} is less than minimum {schema['minimum']}")
+    if "enum" in schema and data not in schema["enum"]:
+        raise PolicyValidationError(
+            f"{path}: {data!r} not one of {schema['enum']}")
+
+
+def render_yaml(obj: dict[str, Any]) -> str:
+    """Render a manifest as YAML (JSON fallback when pyyaml is absent —
+    JSON is valid YAML)."""
+    try:
+        import yaml
+    except ImportError:  # pragma: no cover
+        return json.dumps(obj, indent=2, sort_keys=False) + "\n"
+    return yaml.safe_dump(obj, sort_keys=False, default_flow_style=False)
+
+
+def _main() -> None:  # pragma: no cover - exercised via test subprocess
+    import os
+
+    out_dir = os.path.join(os.path.dirname(os.path.dirname(
+        os.path.dirname(os.path.abspath(__file__)))), "examples", "crd")
+    os.makedirs(out_dir, exist_ok=True)
+    manifests = {
+        "tpuupgradepolicy.yaml": build_crd(),
+        "unifiedupgradepolicy.yaml": build_crd(
+            kind="UnifiedUpgradePolicy",
+            spec_schema=unified_policy_schema()),
+    }
+    for name, manifest in manifests.items():
+        path = os.path.join(out_dir, name)
+        with open(path, "w") as f:
+            f.write(render_yaml(manifest))
+        print(f"wrote {path}")
+
+
+if __name__ == "__main__":
+    _main()

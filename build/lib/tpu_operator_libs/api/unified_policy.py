@@ -1,0 +1,159 @@
+"""Unified multi-accelerator upgrade policy (BASELINE config #5).
+
+The reference is single-driver-per-process by construction (the global
+``DriverName``, util.go:87-95). Because this build scopes keys per
+:class:`~tpu_operator_libs.consts.UpgradeKeys` instance, one operator
+process can run one state machine per accelerator runtime — GPU driver and
+libtpu side by side in a mixed cluster — under a single CRD-embeddable
+policy document:
+
+.. code-block:: yaml
+
+    accelerators:
+      tpu:
+        domain: google.com
+        driver: libtpu
+        runtimeLabels: {app: libtpu}
+        policy: {autoUpgrade: true, maxUnavailable: "25%",
+                 topologyMode: slice, drain: {enable: true}}
+      gpu:
+        domain: nvidia.com
+        driver: gpu
+        runtimeLabels: {app: nvidia-driver}
+        policy: {autoUpgrade: true, maxParallelUpgrades: 1,
+                 drain: {enable: true}}
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import Any, Optional
+
+from tpu_operator_libs.api.upgrade_policy import (
+    PolicyValidationError,
+    UpgradePolicySpec,
+)
+from tpu_operator_libs.consts import UpgradeKeys
+
+
+@dataclass
+class AcceleratorSpec:
+    """One accelerator runtime entry in the unified policy."""
+
+    name: str
+    driver: str
+    domain: str
+    runtime_labels: dict[str, str] = field(default_factory=dict)
+    namespace: str = "kube-system"
+    policy: UpgradePolicySpec = field(default_factory=UpgradePolicySpec)
+
+    @property
+    def keys(self) -> UpgradeKeys:
+        return UpgradeKeys(driver=self.driver, domain=self.domain)
+
+    def validate(self) -> None:
+        if not self.driver or not self.domain:
+            raise PolicyValidationError(
+                f"accelerator {self.name!r}: driver and domain are required")
+        if not self.runtime_labels:
+            raise PolicyValidationError(
+                f"accelerator {self.name!r}: runtimeLabels must select the "
+                f"runtime DaemonSet")
+        self.policy.validate()
+
+    def to_dict(self) -> dict[str, Any]:
+        return {"driver": self.driver, "domain": self.domain,
+                "runtimeLabels": dict(self.runtime_labels),
+                "namespace": self.namespace,
+                "policy": self.policy.to_dict()}
+
+    @classmethod
+    def from_dict(cls, name: str, data: dict[str, Any]) -> "AcceleratorSpec":
+        return cls(
+            name=name,
+            driver=data.get("driver", name),
+            domain=data.get("domain", ""),
+            runtime_labels=dict(data.get("runtimeLabels", {})),
+            namespace=data.get("namespace", "kube-system"),
+            policy=UpgradePolicySpec.from_dict(data.get("policy", {})))
+
+
+@dataclass
+class UnifiedUpgradePolicySpec:
+    """Per-accelerator upgrade policies under one document."""
+
+    accelerators: dict[str, AcceleratorSpec] = field(default_factory=dict)
+
+    def validate(self) -> None:
+        seen: dict[tuple[str, str], str] = {}
+        for name, spec in self.accelerators.items():
+            spec.validate()
+            key = (spec.domain, spec.driver)
+            if key in seen:
+                raise PolicyValidationError(
+                    f"accelerators {seen[key]!r} and {name!r} share the "
+                    f"same key namespace {spec.domain}/{spec.driver}")
+            seen[key] = name
+
+    def to_dict(self) -> dict[str, Any]:
+        return {"accelerators": {name: spec.to_dict()
+                                 for name, spec in self.accelerators.items()}}
+
+    @classmethod
+    def from_dict(cls, data: dict[str, Any]) -> "UnifiedUpgradePolicySpec":
+        return cls(accelerators={
+            name: AcceleratorSpec.from_dict(name, spec)
+            for name, spec in data.get("accelerators", {}).items()})
+
+
+class MultiAcceleratorUpgradeManager:
+    """One ClusterUpgradeStateManager per accelerator, one reconcile call.
+
+    The downstream operator calls :meth:`reconcile` from its loop; each
+    accelerator's state machine runs against its own label namespace, so a
+    mixed GPU+TPU cluster upgrades both runtimes independently but under
+    one policy document.
+    """
+
+    def __init__(self, client, unified_policy: UnifiedUpgradePolicySpec,
+                 manager_factory=None, **manager_kwargs) -> None:
+        from tpu_operator_libs.upgrade.state_manager import (
+            ClusterUpgradeStateManager,
+        )
+
+        unified_policy.validate()
+        self.policy = unified_policy
+        factory = manager_factory or ClusterUpgradeStateManager
+        self.managers: dict[str, ClusterUpgradeStateManager] = {
+            name: factory(client, spec.keys, **manager_kwargs)
+            for name, spec in unified_policy.accelerators.items()}
+
+    def reconcile(self) -> dict[str, Optional[Exception]]:
+        """Build + apply state for every accelerator. Failures are
+        per-accelerator: one runtime's error does not block the others.
+        Returns accelerator -> error (None on success)."""
+        results: dict[str, Optional[Exception]] = {}
+        for name, spec in self.policy.accelerators.items():
+            mgr = self.managers[name]
+            try:
+                state = mgr.build_state(spec.namespace, spec.runtime_labels)
+                mgr.apply_state(state, spec.policy)
+                results[name] = None
+            except Exception as exc:  # noqa: BLE001 — per-accelerator
+                results[name] = exc
+        return results
+
+    def cluster_status(self) -> dict[str, dict]:
+        """Fresh CRD-embeddable status block per accelerator (the unified
+        analogue of ClusterUpgradeStateManager.cluster_status). A runtime
+        whose snapshot is temporarily unbuildable reports an ``error``
+        entry instead of hiding the accelerator."""
+        out: dict[str, dict] = {}
+        for name, spec in self.policy.accelerators.items():
+            mgr = self.managers[name]
+            try:
+                state = mgr.build_state(spec.namespace, spec.runtime_labels)
+                out[name] = mgr.cluster_status(state)
+            except Exception as exc:  # noqa: BLE001 — per-accelerator
+                out[name] = {"error": str(exc)}
+        return out

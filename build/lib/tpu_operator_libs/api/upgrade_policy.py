@@ -1,0 +1,240 @@
+"""Upgrade policy types — the declarative configuration surface.
+
+TPU-native equivalent of ``api/upgrade/v1alpha1/upgrade_spec.go`` in the
+reference: a policy object consumers embed in their own CRD and pass to
+``apply_state`` on every reconcile (upgrade_state.go:364-365).  Field names,
+defaults and validation mirror the reference's kubebuilder markers
+(upgrade_spec.go:27-110); serialization uses the same camelCase JSON keys so
+existing GPU-operator-style policy YAML round-trips unchanged.
+
+Implemented as plain dataclasses with explicit ``to_dict``/``from_dict`` and
+``deep_copy`` (the reference generates DeepCopy via controller-gen,
+zz_generated.deepcopy.go:29-69 — here it is one honest method instead of
+generated code).
+"""
+
+from __future__ import annotations
+
+import copy
+import math
+from dataclasses import dataclass
+from typing import Any, Optional, Union
+
+IntOrString = Union[int, str]
+
+
+class PolicyValidationError(ValueError):
+    """Raised when a policy spec fails validation."""
+
+
+def scaled_value_from_int_or_percent(value: Optional[IntOrString],
+                                     total: int,
+                                     round_up: bool = True) -> int:
+    """Resolve an int-or-percent value against a total.
+
+    Equivalent of apimachinery's ``intstr.GetScaledValueFromIntOrPercent`` as
+    used for maxUnavailable scaling (upgrade_state.go:395-401).  Percentages
+    round up by default, matching the reference call site.
+    """
+    if value is None:
+        return total
+    if isinstance(value, bool):  # bool is an int subclass; reject explicitly
+        raise PolicyValidationError(f"invalid int-or-percent value: {value!r}")
+    if isinstance(value, int):
+        return value
+    text = value.strip()
+    if not text.endswith("%"):
+        try:
+            return int(text)
+        except ValueError:
+            raise PolicyValidationError(
+                f"invalid int-or-percent value: {value!r}") from None
+    try:
+        percent = float(text[:-1])
+    except ValueError:
+        raise PolicyValidationError(
+            f"invalid percentage value: {value!r}") from None
+    scaled = percent * total / 100.0
+    return math.ceil(scaled) if round_up else math.floor(scaled)
+
+
+@dataclass
+class WaitForCompletionSpec:
+    """Wait for selected workload pods to finish before disruption.
+
+    Mirrors WaitForCompletionSpec (upgrade_spec.go:52-64).
+    """
+
+    # Label selector for the pods to wait on; empty = don't wait.
+    pod_selector: str = ""
+    # Seconds to wait before giving up; 0 = wait forever.
+    timeout_seconds: int = 0
+
+    def validate(self) -> None:
+        if self.timeout_seconds < 0:
+            raise PolicyValidationError(
+                "waitForCompletion.timeoutSeconds must be >= 0")
+
+    def to_dict(self) -> dict[str, Any]:
+        return {"podSelector": self.pod_selector,
+                "timeoutSeconds": self.timeout_seconds}
+
+    @classmethod
+    def from_dict(cls, data: dict[str, Any]) -> "WaitForCompletionSpec":
+        return cls(pod_selector=data.get("podSelector", ""),
+                   timeout_seconds=data.get("timeoutSeconds", 0))
+
+    def deep_copy(self) -> "WaitForCompletionSpec":
+        return copy.deepcopy(self)
+
+
+@dataclass
+class PodDeletionSpec:
+    """Configuration for the optional pod-deletion state.
+
+    Mirrors PodDeletionSpec (upgrade_spec.go:67-83).
+    """
+
+    # Allow deleting pods that have no controller (would not be recreated).
+    force: bool = False
+    # Seconds to wait for pod termination; 0 = infinite.
+    timeout_seconds: int = 300
+    # Proceed even if pods use emptyDir volumes (data is lost on delete).
+    delete_empty_dir: bool = False
+
+    def validate(self) -> None:
+        if self.timeout_seconds < 0:
+            raise PolicyValidationError(
+                "podDeletion.timeoutSeconds must be >= 0")
+
+    def to_dict(self) -> dict[str, Any]:
+        return {"force": self.force,
+                "timeoutSeconds": self.timeout_seconds,
+                "deleteEmptyDir": self.delete_empty_dir}
+
+    @classmethod
+    def from_dict(cls, data: dict[str, Any]) -> "PodDeletionSpec":
+        return cls(force=data.get("force", False),
+                   timeout_seconds=data.get("timeoutSeconds", 300),
+                   delete_empty_dir=data.get("deleteEmptyDir", False))
+
+    def deep_copy(self) -> "PodDeletionSpec":
+        return copy.deepcopy(self)
+
+
+@dataclass
+class DrainSpec:
+    """Configuration for node drain during upgrade.
+
+    Mirrors DrainSpec (upgrade_spec.go:86-110).
+    """
+
+    # Master switch; when False the drain state is skipped entirely
+    # (upgrade_state.go:734-747).
+    enable: bool = False
+    # Evict pods without a controller.
+    force: bool = False
+    # Label selector restricting which pods are drained; empty = all.
+    pod_selector: str = ""
+    # Seconds before giving up the drain; 0 = infinite.
+    timeout_seconds: int = 300
+    # Evict pods using emptyDir volumes (their data is deleted).
+    delete_empty_dir: bool = False
+
+    def validate(self) -> None:
+        if self.timeout_seconds < 0:
+            raise PolicyValidationError("drain.timeoutSeconds must be >= 0")
+
+    def to_dict(self) -> dict[str, Any]:
+        return {"enable": self.enable,
+                "force": self.force,
+                "podSelector": self.pod_selector,
+                "timeoutSeconds": self.timeout_seconds,
+                "deleteEmptyDir": self.delete_empty_dir}
+
+    @classmethod
+    def from_dict(cls, data: dict[str, Any]) -> "DrainSpec":
+        return cls(enable=data.get("enable", False),
+                   force=data.get("force", False),
+                   pod_selector=data.get("podSelector", ""),
+                   timeout_seconds=data.get("timeoutSeconds", 300),
+                   delete_empty_dir=data.get("deleteEmptyDir", False))
+
+    def deep_copy(self) -> "DrainSpec":
+        return copy.deepcopy(self)
+
+
+@dataclass
+class UpgradePolicySpec:
+    """Top-level rolling-upgrade policy.
+
+    Mirrors DriverUpgradePolicySpec (upgrade_spec.go:27-49) with identical
+    defaults: autoUpgrade=False, maxParallelUpgrades=1 (0 = unlimited),
+    maxUnavailable="25%".
+    """
+
+    # Global switch; when False apply_state is a no-op
+    # (upgrade_state.go:372-375).
+    auto_upgrade: bool = False
+    # How many nodes may upgrade concurrently; 0 = no limit.
+    max_parallel_upgrades: int = 1
+    # Max nodes (int) or fraction of fleet (percent string) that may be
+    # unavailable during the upgrade, cordoned/not-ready nodes included.
+    max_unavailable: Optional[IntOrString] = "25%"
+    pod_deletion: Optional[PodDeletionSpec] = None
+    wait_for_completion: Optional[WaitForCompletionSpec] = None
+    drain: Optional[DrainSpec] = None
+    # Beyond-reference: name of the topology grouping mode ("flat" keeps
+    # reference per-node semantics; "slice" upgrades whole ICI domains
+    # atomically — see tpu_operator_libs.topology).
+    topology_mode: str = "flat"
+
+    def validate(self) -> None:
+        if self.max_parallel_upgrades < 0:
+            raise PolicyValidationError("maxParallelUpgrades must be >= 0")
+        if self.max_unavailable is not None:
+            # Raises on malformed values; negative budgets (int, "-5" or
+            # "-10%") are rejected uniformly.
+            if scaled_value_from_int_or_percent(self.max_unavailable, 100) < 0:
+                raise PolicyValidationError("maxUnavailable must be >= 0")
+        if self.topology_mode not in ("flat", "slice"):
+            raise PolicyValidationError(
+                f"unknown topologyMode {self.topology_mode!r}")
+        for sub in (self.pod_deletion, self.wait_for_completion, self.drain):
+            if sub is not None:
+                sub.validate()
+
+    def to_dict(self) -> dict[str, Any]:
+        out: dict[str, Any] = {
+            "autoUpgrade": self.auto_upgrade,
+            "maxParallelUpgrades": self.max_parallel_upgrades,
+            "maxUnavailable": self.max_unavailable,
+            "topologyMode": self.topology_mode,
+        }
+        if self.pod_deletion is not None:
+            out["podDeletion"] = self.pod_deletion.to_dict()
+        if self.wait_for_completion is not None:
+            out["waitForCompletion"] = self.wait_for_completion.to_dict()
+        if self.drain is not None:
+            out["drain"] = self.drain.to_dict()
+        return out
+
+    @classmethod
+    def from_dict(cls, data: dict[str, Any]) -> "UpgradePolicySpec":
+        spec = cls(
+            auto_upgrade=data.get("autoUpgrade", False),
+            max_parallel_upgrades=data.get("maxParallelUpgrades", 1),
+            max_unavailable=data.get("maxUnavailable", "25%"),
+            topology_mode=data.get("topologyMode", "flat"),
+        )
+        if "podDeletion" in data and data["podDeletion"] is not None:
+            spec.pod_deletion = PodDeletionSpec.from_dict(data["podDeletion"])
+        if "waitForCompletion" in data and data["waitForCompletion"] is not None:
+            spec.wait_for_completion = WaitForCompletionSpec.from_dict(
+                data["waitForCompletion"])
+        if "drain" in data and data["drain"] is not None:
+            spec.drain = DrainSpec.from_dict(data["drain"])
+        return spec
+
+    def deep_copy(self) -> "UpgradePolicySpec":
+        return copy.deepcopy(self)

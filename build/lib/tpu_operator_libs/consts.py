@@ -1,0 +1,189 @@
+"""State names, label/annotation key formats and log levels.
+
+TPU-native analogue of the reference's ``pkg/upgrade/consts.go`` and
+``pkg/consts/consts.go``.  Two deliberate departures from the reference:
+
+- Keys live under the ``google.com`` / ``cloud.google.com`` label domains and
+  default to the ``libtpu`` runtime name (reference keys:
+  ``nvidia.com/%s-driver-upgrade-state`` etc., pkg/upgrade/consts.go:21-41).
+- Key construction is *instance-scoped* via :class:`UpgradeKeys` rather than a
+  process-global mutable driver name (the reference's ``DriverName`` global,
+  pkg/upgrade/util.go:87-95, makes one process unable to manage two
+  accelerator runtimes; we need GPU+TPU in one cluster).
+"""
+
+from __future__ import annotations
+
+import enum
+from dataclasses import dataclass
+
+
+class LogLevel(enum.IntEnum):
+    """Semantic log levels mapped onto Python logging levels.
+
+    The reference maps semantic levels to logr verbosity
+    (pkg/consts/consts.go:24-29: Error=-2, Warning=-1, Info=0, Debug=1).
+    Python's logging has a native severity scale, so we use it directly.
+    """
+
+    ERROR = 40
+    WARNING = 30
+    INFO = 20
+    DEBUG = 10
+
+
+class UpgradeState(str, enum.Enum):
+    """Per-node upgrade states, durably recorded as a node label value.
+
+    Mirrors the 11 states of the reference state machine
+    (pkg/upgrade/consts.go:42-67).  The state label on the Node object *is*
+    the durable store: there is no database, and every reconcile rebuilds the
+    cluster picture from these labels (upgrade_state.go:68-72).
+    """
+
+    # Node not yet processed, or upgrade flow disabled. Stored as the absence
+    # of the label / empty value (consts.go:42-43).
+    UNKNOWN = ""
+    # Runtime pod on the node is out of date; no action taken yet.
+    UPGRADE_REQUIRED = "upgrade-required"
+    # Node must be made unschedulable before the runtime upgrade.
+    CORDON_REQUIRED = "cordon-required"
+    # Wait (up to a timeout) for workload jobs on the node to finish.
+    WAIT_FOR_JOBS_REQUIRED = "wait-for-jobs-required"
+    # Selected workload pods must be deleted before the upgrade proceeds.
+    POD_DELETION_REQUIRED = "pod-deletion-required"
+    # Node must be drained (cordon + evict remaining workload pods).
+    DRAIN_REQUIRED = "drain-required"
+    # Runtime pod must be restarted (or safe-load unblocked) to pick up the
+    # new DaemonSet revision.
+    POD_RESTART_REQUIRED = "pod-restart-required"
+    # Post-upgrade validation (validation pod ready / ICI fabric healthy)
+    # must pass before the node returns to service.
+    VALIDATION_REQUIRED = "validation-required"
+    # Upgrade complete; node must be made schedulable again.
+    UNCORDON_REQUIRED = "uncordon-required"
+    # Runtime pod up to date and ready; node schedulable.
+    DONE = "upgrade-done"
+    # Any failure during the upgrade; auto-recovers when the pod is healthy.
+    FAILED = "upgrade-failed"
+
+    def __str__(self) -> str:  # label values are plain strings
+        return self.value
+
+
+#: States that count as "upgrade in progress" — everything except the three
+#: idle buckets (unknown / done / upgrade-required), mirroring
+#: GetUpgradesInProgress (upgrade_state.go:1055-1062).
+IN_PROGRESS_STATES = (
+    UpgradeState.CORDON_REQUIRED,
+    UpgradeState.WAIT_FOR_JOBS_REQUIRED,
+    UpgradeState.POD_DELETION_REQUIRED,
+    UpgradeState.DRAIN_REQUIRED,
+    UpgradeState.POD_RESTART_REQUIRED,
+    UpgradeState.VALIDATION_REQUIRED,
+    UpgradeState.UNCORDON_REQUIRED,
+    UpgradeState.FAILED,
+)
+
+#: Every state bucket, in the fixed order ApplyState processes them
+#: (upgrade_state.go:418-481). Used for census logging and counters.
+ALL_STATES = (
+    UpgradeState.UNKNOWN,
+    UpgradeState.DONE,
+    UpgradeState.UPGRADE_REQUIRED,
+    UpgradeState.CORDON_REQUIRED,
+    UpgradeState.WAIT_FOR_JOBS_REQUIRED,
+    UpgradeState.POD_DELETION_REQUIRED,
+    UpgradeState.DRAIN_REQUIRED,
+    UpgradeState.POD_RESTART_REQUIRED,
+    UpgradeState.FAILED,
+    UpgradeState.VALIDATION_REQUIRED,
+    UpgradeState.UNCORDON_REQUIRED,
+)
+
+#: Label key whose presence identifies a TPU node on GKE.
+TPU_RESOURCE_NAME = "google.com/tpu"
+
+#: GKE node labels describing TPU slice topology. Used by
+#: tpu_operator_libs.topology to derive the upgrade unit (sub-slice).
+GKE_TPU_ACCELERATOR_LABEL = "cloud.google.com/gke-tpu-accelerator"
+GKE_TPU_TOPOLOGY_LABEL = "cloud.google.com/gke-tpu-topology"
+GKE_NODEPOOL_LABEL = "cloud.google.com/gke-nodepool"
+
+#: The label kubelet/DaemonSet controller stamps on DS pods with the hash of
+#: the ControllerRevision they were created from (pod_manager.go:70-73).
+POD_CONTROLLER_REVISION_HASH_LABEL = "controller-revision-hash"
+
+#: Merge-patch value meaning "delete this annotation"
+#: (node_upgrade_state_provider.go:147-151).
+NULL_STRING = "null"
+TRUE_STRING = "true"
+
+
+@dataclass(frozen=True)
+class UpgradeKeys:
+    """Instance-scoped builder for the node label/annotation keys.
+
+    One instance per managed accelerator runtime; default is the libtpu
+    runtime under the ``google.com`` domain.  A GPU-flavoured instance
+    (``UpgradeKeys(driver="gpu", domain="nvidia.com")``) reproduces the
+    reference key scheme exactly, which is how mixed GPU+TPU clusters are
+    supported (BASELINE config #5).
+
+    Reference: the seven Get*Key() builders in pkg/upgrade/util.go:97-139.
+    """
+
+    driver: str = "libtpu"
+    domain: str = "google.com"
+
+    @property
+    def state_label(self) -> str:
+        """Node label carrying the upgrade state (consts.go:20-21)."""
+        return f"{self.domain}/{self.driver}-upgrade-state"
+
+    @property
+    def skip_label(self) -> str:
+        """Node label opting a node out of upgrades (consts.go:22-23)."""
+        return f"{self.domain}/{self.driver}-upgrade.skip"
+
+    @property
+    def wait_for_safe_load_annotation(self) -> str:
+        """Annotation the runtime init container sets to request a safe
+        (cordoned + drained) first load (consts.go:24-27)."""
+        return f"{self.domain}/{self.driver}-upgrade.wait-for-safe-load"
+
+    @property
+    def initial_state_annotation(self) -> str:
+        """Annotation remembering the node was already unschedulable when the
+        upgrade started, so it is not uncordoned at the end
+        (consts.go:28-30)."""
+        return f"{self.domain}/{self.driver}-upgrade.node-initial-state.unschedulable"
+
+    @property
+    def pod_completion_start_annotation(self) -> str:
+        """Annotation checkpointing the wall-clock start of the
+        wait-for-jobs timeout across reconciles (consts.go:31-34)."""
+        return f"{self.domain}/{self.driver}-upgrade.wait-for-pod-completion-start-time"
+
+    @property
+    def validation_start_annotation(self) -> str:
+        """Annotation checkpointing the start of the validation timeout
+        (consts.go:35-37)."""
+        return f"{self.domain}/{self.driver}-upgrade.validation-start-time"
+
+    @property
+    def upgrade_requested_annotation(self) -> str:
+        """Annotation requesting an on-demand upgrade (the only trigger for
+        orphaned pods, whose revision hash cannot be compared)
+        (consts.go:38-41)."""
+        return f"{self.domain}/{self.driver}-upgrade-requested"
+
+    @property
+    def event_reason(self) -> str:
+        """Reason string attached to Kubernetes events (util.go:136-139)."""
+        return f"{self.driver.upper()}RuntimeUpgrade"
+
+
+#: Field selector template filtering pods by the node they run on
+#: (consts.go:70-73).
+NODE_NAME_FIELD_SELECTOR_FMT = "spec.nodeName={}"

@@ -1,0 +1,641 @@
+"""Watch-driven controller runtime: work queue, informers, reconcile loop.
+
+The reference library has no main loop of its own — it is embedded in a
+controller built with sigs.k8s.io/controller-runtime, which supplies the
+informer caches, the rate-limited work queue, and the "any relevant event
+enqueues a reconcile" wiring (SURVEY.md §1 L0/L5). This build owns its
+substrate, so those pieces live here, shaped like their client-go
+namesakes:
+
+- :class:`ExponentialBackoffRateLimiter` — per-key exponential backoff
+  (client-go ``workqueue.DefaultControllerRateLimiter`` semantics).
+- :class:`WorkQueue` — deduplicating delaying queue with the three-set
+  (dirty/queue/processing) contract: adds while a key is being processed
+  mark it dirty and re-enqueue it on :meth:`WorkQueue.done`, so a burst of
+  events coalesces into at most one queued reconcile per key.
+- :class:`Informer` — list+watch cache with add/update/delete handlers and
+  a ``has_synced`` barrier.
+- :class:`Controller` — wires watches → keys → work queue → the consumer's
+  reconcile function, with error backoff and periodic resync, replacing
+  the fixed-interval polling loop a consumer would otherwise write
+  (examples/libtpu_operator.py uses it in live mode).
+
+The upgrade flow itself stays cluster-scoped: one reconcile key
+(:data:`CLUSTER_KEY`) covers BuildState+ApplyState, exactly like the
+reference consumer's singleton reconcile (SURVEY.md §3.1).
+"""
+
+from __future__ import annotations
+
+import heapq
+import logging
+import threading
+import time
+from dataclasses import dataclass
+from typing import TYPE_CHECKING, Callable, Optional
+
+from tpu_operator_libs.k8s.watch import DELETED, Watch, WatchEvent
+
+if TYPE_CHECKING:
+    from tpu_operator_libs.metrics import MetricsRegistry
+
+logger = logging.getLogger(__name__)
+
+#: The single reconcile key for cluster-scoped upgrade controllers.
+CLUSTER_KEY = "cluster"
+
+
+def _cluster_key_fn(_event: "WatchEvent") -> str:
+    """Default key function: every event maps to the cluster singleton.
+    Identity-compared in the pump to exempt the singleton from
+    DELETED-event key forgetting."""
+    return CLUSTER_KEY
+
+
+class ExponentialBackoffRateLimiter:
+    """Per-key exponential backoff: base * 2^retries, capped.
+
+    Defaults match client-go's item-bucket limiter (5 ms base, 16 m 40 s
+    cap is client-go's 1000 s; we default the cap lower because driver
+    upgrades re-reconcile anyway on the next event).
+    """
+
+    def __init__(self, base: float = 0.005, max_delay: float = 60.0) -> None:
+        if base <= 0:
+            raise ValueError("base must be positive")
+        self._base = base
+        self._max = max_delay
+        self._retries: dict[str, int] = {}
+        self._lock = threading.Lock()
+
+    def when(self, key: str) -> float:
+        """Delay before the next retry of ``key``; increments the count."""
+        with self._lock:
+            n = self._retries.get(key, 0)
+            self._retries[key] = n + 1
+        return min(self._base * (2 ** n), self._max)
+
+    def forget(self, key: str) -> None:
+        with self._lock:
+            self._retries.pop(key, None)
+
+    def retries(self, key: str) -> int:
+        with self._lock:
+            return self._retries.get(key, 0)
+
+
+class WorkQueue:
+    """Deduplicating, delaying work queue (client-go workqueue contract).
+
+    Invariants:
+    - A key is queued at most once at a time; adding an already-queued key
+      is a no-op (event bursts coalesce).
+    - Adding a key that is currently being processed marks it dirty; it is
+      re-queued when :meth:`done` is called — no update is ever lost, and
+      no key is processed concurrently with itself.
+    """
+
+    def __init__(self) -> None:
+        self._cond = threading.Condition()
+        self._queue: list[str] = []
+        self._dirty: set[str] = set()
+        self._processing: set[str] = set()
+        self._delayed: list[tuple[float, int, str]] = []  # (due, seq, key)
+        self._seq = 0
+        self._shutdown = False
+
+    # -- producers -------------------------------------------------------
+    def add(self, key: str) -> None:
+        with self._cond:
+            if self._shutdown or key in self._dirty:
+                return
+            self._dirty.add(key)
+            if key in self._processing:
+                return
+            self._queue.append(key)
+            self._cond.notify()
+
+    def add_after(self, key: str, delay: float) -> None:
+        if delay <= 0:
+            self.add(key)
+            return
+        with self._cond:
+            if self._shutdown:
+                return
+            self._seq += 1
+            heapq.heappush(self._delayed,
+                           (time.monotonic() + delay, self._seq, key))
+            self._cond.notify()
+
+    # -- consumer --------------------------------------------------------
+    def _promote_due(self) -> Optional[float]:
+        """Move due delayed items into the queue; return seconds until the
+        next delayed item, or None. Caller holds the lock."""
+        now = time.monotonic()
+        while self._delayed and self._delayed[0][0] <= now:
+            _, _, key = heapq.heappop(self._delayed)
+            if key not in self._dirty:
+                self._dirty.add(key)
+                if key not in self._processing:
+                    self._queue.append(key)
+        if self._delayed:
+            return max(self._delayed[0][0] - now, 0.0)
+        return None
+
+    def get(self, timeout: Optional[float] = None) -> Optional[str]:
+        """Next key (marking it processing), or None on timeout/shutdown."""
+        deadline = None if timeout is None else time.monotonic() + timeout
+        with self._cond:
+            while True:
+                next_delay = self._promote_due()
+                if self._queue:
+                    key = self._queue.pop(0)
+                    self._dirty.discard(key)
+                    self._processing.add(key)
+                    return key
+                if self._shutdown:
+                    return None
+                wait = next_delay
+                if deadline is not None:
+                    remaining = deadline - time.monotonic()
+                    if remaining <= 0:
+                        return None
+                    wait = remaining if wait is None else min(wait, remaining)
+                self._cond.wait(wait)
+
+    def done(self, key: str) -> None:
+        with self._cond:
+            self._processing.discard(key)
+            if key in self._dirty:
+                self._queue.append(key)
+                self._cond.notify()
+
+    # -- lifecycle -------------------------------------------------------
+    def shut_down(self) -> None:
+        with self._cond:
+            self._shutdown = True
+            self._cond.notify_all()
+
+    def __len__(self) -> int:
+        with self._cond:
+            return len(self._queue) + len(self._delayed)
+
+
+def default_key_fn(obj: object) -> tuple[str, str]:
+    meta = getattr(obj, "metadata")
+    return (getattr(meta, "namespace", "") or "", meta.name)
+
+
+# How long a deletion tombstone can outlive its key before _apply prunes
+# it. Only a refresh() whose list started before the tombstone needs it;
+# no list takes 10 minutes, so this is safely conservative while keeping
+# _last_applied bounded even with periodic relisting disabled.
+_TOMBSTONE_TTL = 600.0
+# Sweep cadence for the amortized tombstone prune in _apply (the sweep is
+# O(len(_last_applied)) under _store_lock, so not on every delete).
+_TOMBSTONE_PRUNE_EVERY = 64
+
+
+class Informer:
+    """List+watch cache for one object kind.
+
+    ``lister`` provides the initial snapshot (fires add handlers, like a
+    client-go informer's initial sync); ``watch`` streams subsequent
+    events. The store always holds snapshot copies.
+    """
+
+    def __init__(self, lister: Callable[[], list], watch: Watch,
+                 key_fn: Callable[[object], tuple[str, str]] = default_key_fn,
+                 name: str = "informer") -> None:
+        self._lister = lister
+        self._watch = watch
+        self._key_fn = key_fn
+        self._name = name
+        self._store: dict[tuple[str, str], object] = {}
+        # Monotonic time of the last watch-event apply per key; deleted
+        # keys keep their entry as a tombstone. refresh() consults these
+        # so a list snapshot can never overwrite state applied after the
+        # list began (client-go serializes Replace through DeltaFIFO for
+        # the same reason).
+        self._last_applied: dict[tuple[str, str], float] = {}
+        self._deletes_since_prune = 0
+        self._store_lock = threading.Lock()
+        self._synced = threading.Event()
+        self._handlers: list[tuple[
+            Optional[Callable[[object], None]],
+            Optional[Callable[[object, object], None]],
+            Optional[Callable[[object], None]]]] = []
+        self._thread: Optional[threading.Thread] = None
+
+    def add_event_handler(self,
+                          on_add: Optional[Callable[[object], None]] = None,
+                          on_update: Optional[Callable[[object, object], None]] = None,
+                          on_delete: Optional[Callable[[object], None]] = None) -> None:
+        self._handlers.append((on_add, on_update, on_delete))
+
+    def start(self) -> None:
+        if self._thread is not None:
+            return
+        self._thread = threading.Thread(
+            target=self._run, name=self._name, daemon=True)
+        self._thread.start()
+
+    def _run(self) -> None:
+        # The initial list retries with backoff like a client-go informer:
+        # one transient API error at startup must not leave the cache
+        # permanently empty with has_synced() never firing.
+        backoff = 0.5
+        while not self._watch.stopped:
+            try:
+                objects = self._lister()
+                break
+            except Exception:
+                logger.exception("%s: initial list failed; retrying in "
+                                 "%.1fs", self._name, backoff)
+                if self._watch.stopped:
+                    return
+                time.sleep(backoff)
+                backoff = min(backoff * 2, 30.0)
+        else:
+            return
+        for obj in objects:
+            try:
+                key = self._key_fn(obj)
+            except Exception:
+                logger.exception("%s: key function failed on listed object",
+                                 self._name)
+                continue
+            with self._store_lock:
+                self._store[key] = obj
+            self._dispatch_add(obj)
+        self._synced.set()
+        for event in self._watch:
+            try:
+                self._apply(event)
+            except Exception:
+                # one malformed event must not freeze the cache forever
+                logger.exception("%s: failed to apply watch event",
+                                 self._name)
+
+    def _apply(self, event: WatchEvent) -> None:
+        obj = event.object
+        key = self._key_fn(obj)
+        if event.type == DELETED:
+            with self._store_lock:
+                old = self._store.pop(key, None)
+                now = time.monotonic()
+                self._last_applied[key] = now  # tombstone
+                # Tombstones exist only to stop an in-flight refresh()
+                # from resurrecting a concurrently-deleted key; one older
+                # than any plausible list duration protects nothing.
+                # refresh() prunes the tombstones it creates itself; this
+                # amortized sweep bounds the watch-DELETED path even with
+                # periodic relisting disabled (CachedReadClient
+                # relist_interval=None). Amortized (every 64th delete)
+                # because the sweep scans all of _last_applied — live
+                # keys included — under _store_lock.
+                self._deletes_since_prune += 1
+                if self._deletes_since_prune >= _TOMBSTONE_PRUNE_EVERY:
+                    self._deletes_since_prune = 0
+                    cutoff = now - _TOMBSTONE_TTL
+                    for k in [k for k, t in self._last_applied.items()
+                              if t < cutoff and k not in self._store]:
+                        del self._last_applied[k]
+            for _, _, on_delete in self._handlers:
+                if on_delete is not None:
+                    self._safe(on_delete, old if old is not None else obj)
+            return
+        with self._store_lock:
+            old = self._store.get(key)
+            self._store[key] = obj
+            self._last_applied[key] = time.monotonic()
+        # An ADDED for a key already in the store happens when a restarted
+        # server watch re-delivers the current object set; client-go
+        # converts those to updates so derived state is not double-counted
+        # and modifications hidden by the watch gap still surface.
+        if old is None:
+            self._dispatch_add(obj)
+        else:
+            for _, on_update, _ in self._handlers:
+                if on_update is not None:
+                    self._safe(on_update, old, obj)
+
+    def _dispatch_add(self, obj: object) -> None:
+        for on_add, _, _ in self._handlers:
+            if on_add is not None:
+                self._safe(on_add, obj)
+
+    @staticmethod
+    def _safe(fn: Callable, *args: object) -> None:
+        try:
+            fn(*args)
+        except Exception:  # handler bugs must not kill the watch pump
+            logger.exception("informer event handler failed")
+
+    def has_synced(self, timeout: Optional[float] = None) -> bool:
+        return self._synced.wait(timeout=timeout)
+
+    def refresh(self) -> None:
+        """Relist and reconcile the store (client-go ``Reflector.Replace``).
+
+        A restarted live watch re-delivers current objects as ADDED but
+        never emits DELETED for objects removed during the stream gap, so
+        a long-lived cache must periodically reconcile against a full
+        list. The list snapshot races the watch pump, and there is no
+        cross-backend resourceVersion to order by — so any key whose last
+        watch event applied *after* the list began is left untouched (the
+        event is newer than the snapshot; the next relist converges it).
+        Deleted keys leave tombstones for the same reason: a DELETED that
+        lands mid-list must not be undone by the stale snapshot."""
+        list_started = time.monotonic()
+        objects = self._lister()
+        fresh: dict[tuple[str, str], object] = {}
+        for obj in objects:
+            try:
+                fresh[self._key_fn(obj)] = obj
+            except Exception:
+                logger.exception("%s: key function failed on relisted "
+                                 "object", self._name)
+        deleted: list[object] = []
+        added: list[object] = []
+        updated: list[tuple[object, object]] = []
+        with self._store_lock:
+            def newer_than_list(key: tuple[str, str]) -> bool:
+                return self._last_applied.get(key, -1.0) >= list_started
+
+            # Tombstones older than the list have served their purpose:
+            # the snapshot was taken after those deletes applied, so if
+            # it still contains such a key the object was RECREATED and
+            # the watch ADD was lost — exactly the gap relist heals.
+            # Pruning first lets the fresh-object loop apply it now
+            # instead of one relist interval later. Delete-during-list
+            # tombstones are >= list_started and are preserved by the
+            # newer_than_list check below.
+            for key in [k for k, t in self._last_applied.items()
+                        if k not in self._store and t < list_started]:
+                del self._last_applied[key]
+            for key in [k for k in self._store if k not in fresh]:
+                if newer_than_list(key):
+                    continue  # added by a watch event during the list
+                deleted.append(self._store.pop(key))
+                self._last_applied[key] = list_started
+            for key, obj in fresh.items():
+                if newer_than_list(key):
+                    continue  # modified/deleted during the list; keep event
+                old = self._store.get(key)
+                self._store[key] = obj
+                self._last_applied[key] = list_started
+                if old is None:
+                    added.append(obj)
+                elif old != obj:
+                    updated.append((old, obj))
+        for obj in deleted:
+            for _, _, on_delete in self._handlers:
+                if on_delete is not None:
+                    self._safe(on_delete, obj)
+        for obj in added:
+            self._dispatch_add(obj)
+        for old, obj in updated:
+            for _, on_update, _ in self._handlers:
+                if on_update is not None:
+                    self._safe(on_update, old, obj)
+
+    def get(self, namespace: str, name: str) -> Optional[object]:
+        with self._store_lock:
+            return self._store.get((namespace, name))
+
+    def list(self) -> list:
+        with self._store_lock:
+            return list(self._store.values())
+
+    def __len__(self) -> int:
+        with self._store_lock:
+            return len(self._store)
+
+    def stop(self) -> None:
+        self._watch.stop()
+        if self._thread is not None:
+            self._thread.join(timeout=5.0)
+
+
+@dataclass
+class ReconcileResult:
+    """Outcome of one reconcile (controller-runtime ``ctrl.Result``).
+
+    ``forget=True`` additionally drops the key from the resync set — the
+    reconciler's way of saying "this object is gone" for deletions the
+    watch never observed (stream-gap deletions emit no DELETED event).
+    """
+
+    requeue: bool = False
+    requeue_after: Optional[float] = None
+    forget: bool = False
+
+
+class Controller:
+    """Drives a reconcile function from watch events.
+
+    Every event on a registered watch enqueues ``key`` (default: the
+    cluster-scoped singleton). Worker threads pop keys and call
+    ``reconcile(key)``; an exception or ``ReconcileResult(requeue=True)``
+    re-enqueues with exponential backoff, ``requeue_after`` re-enqueues
+    after a fixed delay, success forgets the backoff. ``resync_period``
+    re-enqueues every key seen so far on a timer — the safety net for
+    missed events, mirroring controller-runtime's SyncPeriod.
+    """
+
+    def __init__(self, reconcile: Callable[[str], Optional[ReconcileResult]],
+                 name: str = "upgrade-controller",
+                 rate_limiter: Optional[ExponentialBackoffRateLimiter] = None,
+                 resync_period: Optional[float] = None,
+                 metrics: Optional["MetricsRegistry"] = None) -> None:
+        self._reconcile = reconcile
+        self._name = name
+        self._metrics = metrics
+        self._limiter = rate_limiter or ExponentialBackoffRateLimiter()
+        # 0/negative would busy-loop the resync thread; treat as disabled.
+        if resync_period is not None and resync_period <= 0:
+            resync_period = None
+        self._resync_period = resync_period
+        self.queue = WorkQueue()
+        self._watches: list[tuple[Watch, Callable[[WatchEvent], Optional[str]]]] = []
+        self._threads: list[threading.Thread] = []
+        self._stop = threading.Event()
+        self._reconcile_count = 0
+        self._error_count = 0
+        self._count_lock = threading.Lock()
+        # Every key ever enqueued; the resync timer re-enqueues all of
+        # them (not just CLUSTER_KEY) so controllers with per-object
+        # key functions also get the missed-event safety net.
+        self._known_keys: set[str] = set()
+        self._known_lock = threading.Lock()
+
+    def _enqueue(self, key: str) -> None:
+        with self._known_lock:
+            self._known_keys.add(key)
+        self.queue.add(key)
+
+    def enqueue(self, key: str = CLUSTER_KEY) -> None:
+        """Externally trigger a reconcile for ``key`` (default: the
+        cluster singleton). Lets event sources that are not Watch objects
+        — e.g. a read cache's post-apply informer handlers — drive the
+        controller."""
+        self._enqueue(key)
+
+    def forget_key(self, key: str) -> None:
+        """Stop resyncing ``key`` (e.g. the reconciler found its object
+        gone). A later event for the key re-registers it."""
+        with self._known_lock:
+            self._known_keys.discard(key)
+        self._limiter.forget(key)
+
+    # -- wiring ----------------------------------------------------------
+    def watch(self, watch: Watch,
+              key_fn: Optional[Callable[[WatchEvent], Optional[str]]] = None) -> None:
+        """Enqueue ``key_fn(event)`` for every event (None = skip event;
+        default maps everything to :data:`CLUSTER_KEY`). Must be called
+        before :meth:`start` — pump threads are spawned there.
+
+        With a custom per-object ``key_fn``, a DELETED event still
+        enqueues one final reconcile for its key, after which the key is
+        forgotten so the resync timer stops re-enqueueing dead objects
+        (the known-key set would otherwise grow forever in a churny
+        namespace). The default cluster-singleton key is never forgotten.
+
+        This is best-effort: a deletion during a watch-stream gap emits
+        no DELETED event (restarted live streams re-list current objects
+        only), so a per-object reconciler should also return
+        ``ReconcileResult(forget=True)`` when it finds its object gone.
+        """
+        if self._threads:
+            raise RuntimeError(
+                "Controller.watch() after start(): the watch would never "
+                "be pumped; register watches before starting")
+        if key_fn is None:
+            key_fn = _cluster_key_fn
+        self._watches.append((watch, key_fn))
+
+    # -- lifecycle -------------------------------------------------------
+    def start(self, workers: int = 1, initial_sync: bool = True) -> None:
+        """Start pumps + workers; ``initial_sync`` seeds one reconcile so
+        state converges even if no event ever fires."""
+        if self._threads:
+            raise RuntimeError("controller already started")
+        if initial_sync:
+            self._enqueue(CLUSTER_KEY)
+        for i, (watch, key_fn) in enumerate(self._watches):
+            t = threading.Thread(target=self._pump, args=(watch, key_fn),
+                                 name=f"{self._name}-watch-{i}", daemon=True)
+            t.start()
+            self._threads.append(t)
+        for i in range(workers):
+            t = threading.Thread(target=self._worker,
+                                 name=f"{self._name}-worker-{i}", daemon=True)
+            t.start()
+            self._threads.append(t)
+        if self._resync_period is not None:
+            t = threading.Thread(target=self._resync,
+                                 name=f"{self._name}-resync", daemon=True)
+            t.start()
+            self._threads.append(t)
+
+    def stop(self, timeout: float = 10.0) -> None:
+        self._stop.set()
+        for watch, _ in self._watches:
+            watch.stop()
+        self.queue.shut_down()
+        deadline = time.monotonic() + timeout
+        for t in self._threads:
+            remaining = deadline - time.monotonic()
+            if remaining > 0:
+                t.join(remaining)
+        self._threads = []
+
+    # -- introspection ---------------------------------------------------
+    @property
+    def reconcile_count(self) -> int:
+        with self._count_lock:
+            return self._reconcile_count
+
+    @property
+    def error_count(self) -> int:
+        with self._count_lock:
+            return self._error_count
+
+    # -- internals -------------------------------------------------------
+    def _pump(self, watch: Watch, key_fn: Callable[[WatchEvent], Optional[str]]) -> None:
+        for event in watch:
+            if self._stop.is_set():
+                return
+            try:
+                key = key_fn(event)
+            except Exception:
+                logger.exception("watch key function failed")
+                continue
+            if key is not None:
+                self._enqueue(key)
+                if event.type == DELETED and key_fn is not _cluster_key_fn:
+                    # final cleanup reconcile is queued; drop the key from
+                    # the resync set so dead objects aren't re-enqueued
+                    # forever
+                    with self._known_lock:
+                        self._known_keys.discard(key)
+
+    def _observe(self, started: float, error: bool) -> None:
+        if self._metrics is None:
+            return
+        labels = {"controller": self._name}
+        self._metrics.observe_histogram(
+            "reconcile_duration_seconds", time.monotonic() - started,
+            "Wall-clock seconds per reconcile pass", labels)
+        if error:
+            self._metrics.inc_counter("reconcile_errors_total",
+                                      "Reconciles that raised", labels)
+        self._metrics.set_gauge("workqueue_depth", len(self.queue),
+                                "Keys queued or delay-pending", labels)
+
+    def _worker(self) -> None:
+        while not self._stop.is_set():
+            key = self.queue.get(timeout=0.5)
+            if key is None:
+                continue
+            started = time.monotonic()
+            try:
+                result = self._reconcile(key)
+            except Exception:
+                with self._count_lock:
+                    self._reconcile_count += 1
+                    self._error_count += 1
+                delay = self._limiter.when(key)
+                logger.exception("reconcile %r failed; retrying in %.3fs",
+                                 key, delay)
+                self.queue.done(key)
+                self.queue.add_after(key, delay)
+                self._observe(started, error=True)
+                continue
+            with self._count_lock:
+                self._reconcile_count += 1
+            self.queue.done(key)
+            self._observe(started, error=False)
+            if result is not None and result.forget:
+                self.forget_key(key)
+                continue
+            if result is not None and result.requeue_after is not None:
+                self.queue.add_after(key, result.requeue_after)
+            elif result is not None and result.requeue:
+                self.queue.add_after(key, self._limiter.when(key))
+            else:
+                self._limiter.forget(key)
+
+    def _resync(self) -> None:
+        # Only keys actually seen are resynced: injecting CLUSTER_KEY
+        # into a per-object controller that never registered it would
+        # hand its reconciler a key it cannot resolve. Cluster-scoped
+        # controllers register CLUSTER_KEY via initial_sync or their
+        # first event.
+        assert self._resync_period is not None
+        while not self._stop.wait(self._resync_period):
+            with self._known_lock:
+                keys = set(self._known_keys)
+            for key in keys:
+                self.queue.add(key)

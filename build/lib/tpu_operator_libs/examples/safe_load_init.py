@@ -1,0 +1,73 @@
+#!/usr/bin/env python3
+"""Safe-load init container for the libtpu DaemonSet.
+
+The workload side of the safe runtime load handshake
+(docs/automatic-libtpu-upgrade.md; reference protocol:
+docs/automatic-ofed-upgrade.md:43-66 and safe_driver_load_manager.go):
+
+1. On start, set the ``wait-for-safe-load`` annotation on this Pod's Node
+   and block.
+2. The upgrade state machine sees the annotation, cordons + drains the
+   node, then deletes the annotation (SafeRuntimeLoadManager.unblock_loading).
+3. This container observes the deletion and exits 0; the main libtpu
+   container starts with the TPU chips guaranteed idle.
+
+DaemonSet usage:
+
+    initContainers:
+    - name: safe-load-gate
+      image: <this image>
+      command: ["python", "/safe_load_init.py"]
+      env:
+      - name: NODE_NAME
+        valueFrom: {fieldRef: {fieldPath: spec.nodeName}}
+"""
+
+from __future__ import annotations
+
+import logging
+import os
+import sys
+import time
+
+from tpu_operator_libs.consts import TRUE_STRING, UpgradeKeys
+from tpu_operator_libs.k8s.client import K8sClient
+
+logger = logging.getLogger("safe-load-init")
+
+
+def wait_for_safe_load(client: K8sClient, node_name: str,
+                       keys: UpgradeKeys | None = None,
+                       poll_seconds: float = 5.0,
+                       sleep=time.sleep) -> None:
+    """Set the safe-load annotation and block until the upgrade state
+    machine removes it. Separated from main() so it runs against the
+    FakeCluster in tests."""
+    keys = keys or UpgradeKeys()
+    annotation = keys.wait_for_safe_load_annotation
+    client.patch_node_annotations(node_name, {annotation: TRUE_STRING})
+    logger.info("set %s on node %s; waiting for the operator to cordon, "
+                "drain and unblock", annotation, node_name)
+    while True:
+        node = client.get_node(node_name)
+        if annotation not in node.metadata.annotations:
+            logger.info("unblocked; proceeding with libtpu load")
+            return
+        sleep(poll_seconds)
+
+
+def main() -> int:
+    logging.basicConfig(level=logging.INFO)
+    node_name = os.environ.get("NODE_NAME", "")
+    if not node_name:
+        logger.error("NODE_NAME env var is required (downward API)")
+        return 2
+    from tpu_operator_libs.k8s.real import RealCluster
+
+    wait_for_safe_load(RealCluster.in_cluster(), node_name,
+                       UpgradeKeys(driver=os.environ.get("DRIVER", "libtpu")))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,255 @@
+#!/usr/bin/env python3
+"""Unified GPU+TPU upgrade operator (BASELINE config #5).
+
+One process, one policy document, one state machine per accelerator
+runtime — the deployment shape the reference cannot take (its global
+``DriverName``, util.go:87-95, pins a process to a single driver). Each
+accelerator's state machine runs against its own label namespace
+(``<domain>/<driver>-runtime-upgrade-*``), so a mixed cluster upgrades
+its NVIDIA driver and libtpu DaemonSets side by side without the state
+machines ever touching each other's labels.
+
+Run against a live cluster:
+
+    python examples/unified_operator.py --policy unified.yaml --kubeconfig
+
+or watch a simulated mixed fleet converge:
+
+    python examples/unified_operator.py --demo
+
+Policy document shape: see ``tpu_operator_libs/api/unified_policy.py``
+(YAML example in the module docstring) and
+``examples/crd/unifiedupgradepolicy.yaml`` for the CRD schema.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import logging
+import signal
+import sys
+import threading
+import time
+
+from tpu_operator_libs.api.unified_policy import (  # noqa: E402
+    MultiAcceleratorUpgradeManager,
+    UnifiedUpgradePolicySpec,
+)
+from tpu_operator_libs.metrics import (  # noqa: E402
+    MetricsRegistry,
+    observe_cluster_state,
+)
+
+logger = logging.getLogger("unified-operator")
+
+DEMO_POLICY = {
+    "accelerators": {
+        "tpu": {
+            "domain": "google.com", "driver": "libtpu",
+            "namespace": "kube-system",
+            "runtimeLabels": {"app": "libtpu"},
+            "policy": {"autoUpgrade": True, "maxUnavailable": "50%",
+                       "topologyMode": "slice",
+                       "drain": {"enable": True, "force": True}},
+        },
+        "gpu": {
+            "domain": "nvidia.com", "driver": "gpu",
+            "namespace": "kube-system",
+            "runtimeLabels": {"app": "nvidia-driver"},
+            "policy": {"autoUpgrade": True, "maxParallelUpgrades": 1,
+                       "drain": {"enable": True, "force": True}},
+        },
+    },
+}
+
+
+def load_unified_policy(path: str | None) -> UnifiedUpgradePolicySpec:
+    if path is None:
+        spec = UnifiedUpgradePolicySpec.from_dict(DEMO_POLICY)
+    else:
+        import yaml
+
+        with open(path) as f:
+            data = yaml.safe_load(f)
+        if not isinstance(data, dict):
+            raise ValueError(f"policy file {path!r} is not a mapping")
+        inner = data.get("spec", data)
+        if not isinstance(inner, dict):
+            raise ValueError(
+                f"policy file {path!r}: 'spec' must be a mapping")
+        spec = UnifiedUpgradePolicySpec.from_dict(inner)
+    spec.validate()
+    return spec
+
+
+def reconcile_pass(multi: MultiAcceleratorUpgradeManager,
+                   registry: MetricsRegistry,
+                   latest_status: dict) -> dict:
+    """One reconcile over every accelerator. One snapshot per accelerator
+    serves the transition pass, the /status block, and the metrics —
+    three consumers of the SAME state, and 1x the apiserver list load.
+    Failures stay per-accelerator (MultiAcceleratorUpgradeManager
+    semantics): one runtime's error never blocks the others."""
+    errors: dict = {}
+    for name, spec in multi.policy.accelerators.items():
+        mgr = multi.managers[name]
+        try:
+            state = mgr.build_state(spec.namespace, spec.runtime_labels)
+            # status before apply: it must not freeze on the last good
+            # block while transition passes fail
+            latest_status[name] = mgr.cluster_status(state)
+            mgr.apply_state(state, spec.policy)
+            observe_cluster_state(registry, mgr, state, driver=spec.driver)
+            errors[name] = None
+        except Exception as exc:  # noqa: BLE001 — per-accelerator
+            errors[name] = exc
+            latest_status[name] = {
+                **latest_status.get(name, {}), "error": str(exc)}
+            logger.warning("accelerator %s: reconcile error: %s", name, exc)
+    return errors
+
+
+def build_demo_cluster():
+    """A mixed fleet: one 2x2-host TPU slice pool + 2 GPU nodes, both
+    runtime DaemonSets one revision behind."""
+    from tpu_operator_libs.consts import (
+        GKE_NODEPOOL_LABEL,
+        GKE_TPU_ACCELERATOR_LABEL,
+        GKE_TPU_TOPOLOGY_LABEL,
+    )
+    from tpu_operator_libs.k8s.fake import FakeCluster
+    from tpu_operator_libs.k8s.objects import (
+        ContainerStatus,
+        DaemonSet,
+        DaemonSetSpec,
+        DaemonSetStatus,
+        Node,
+        ObjectMeta,
+        OwnerReference,
+        Pod,
+        PodPhase,
+        PodSpec,
+        PodStatus,
+    )
+    from tpu_operator_libs.util import FakeClock
+
+    ns = "kube-system"
+    clock = FakeClock()
+    cluster = FakeCluster(clock=clock)
+    cluster.enable_ds_controller(recreate_delay=10.0, ready_delay=20.0)
+
+    def add_ds(name, labels, desired):
+        return cluster.add_daemon_set(DaemonSet(
+            metadata=ObjectMeta(name=name, namespace=ns, labels=labels),
+            spec=DaemonSetSpec(selector=dict(labels)),
+            status=DaemonSetStatus(desired_number_scheduled=desired)),
+            revision_hash="old")
+
+    tpu_ds = add_ds("libtpu", {"app": "libtpu"}, desired=4)
+    gpu_ds = add_ds("nvidia-driver", {"app": "nvidia-driver"}, desired=2)
+
+    def add_node(name, labels, ds, pod_prefix):
+        cluster.add_node(Node(metadata=ObjectMeta(name=name, labels=labels)))
+        cluster.add_pod(Pod(
+            metadata=ObjectMeta(
+                name=f"{pod_prefix}-{name}", namespace=ns,
+                labels={**ds.spec.selector,
+                        "controller-revision-hash": "old"},
+                owner_references=[OwnerReference(
+                    kind="DaemonSet", name=ds.metadata.name,
+                    uid=ds.metadata.uid)]),
+            spec=PodSpec(node_name=name),
+            status=PodStatus(phase=PodPhase.RUNNING, container_statuses=[
+                ContainerStatus(name="runtime", ready=True)])))
+
+    for s in range(2):
+        for h in range(2):
+            add_node(f"tpu-s{s}-h{h}", {
+                GKE_NODEPOOL_LABEL: f"tpu-pool-{s}",
+                GKE_TPU_ACCELERATOR_LABEL: "tpu-v5-lite-podslice",
+                GKE_TPU_TOPOLOGY_LABEL: "2x2",
+                "google.com/tpu": "true"}, tpu_ds, "libtpu")
+    for i in range(2):
+        add_node(f"gpu-n{i}", {}, gpu_ds, "nvdrv")
+
+    cluster.bump_daemon_set_revision(ns, "libtpu", "new")
+    cluster.bump_daemon_set_revision(ns, "nvidia-driver", "new")
+    return cluster, clock
+
+
+def run_demo(registry: MetricsRegistry, latest_status: dict,
+             interval_sim_s: float = 10.0) -> int:
+    cluster, clock = build_demo_cluster()
+    policy = load_unified_policy(None)
+    multi = MultiAcceleratorUpgradeManager(
+        cluster, policy, async_workers=False, clock=clock,
+        poll_interval=0.0)
+
+    deadline = time.monotonic() + 120
+    while time.monotonic() < deadline:
+        reconcile_pass(multi, registry, latest_status)
+        done = all(
+            isinstance(block, dict)
+            and block.get("totalNodes", 0) > 0
+            and block.get("upgradesDone") == block.get("totalNodes")
+            and block.get("unavailableNodes") == 0
+            for block in latest_status.values())
+        if done and len(latest_status) == len(policy.accelerators):
+            logger.info("demo complete in %.0fs simulated", clock.now())
+            print(json.dumps(latest_status, indent=2))
+            return 0
+        clock.advance(interval_sim_s)
+        cluster.step()
+    logger.error("demo did not converge; status: %s", latest_status)
+    return 1
+
+
+def main() -> int:
+    parser = argparse.ArgumentParser(description=__doc__)
+    parser.add_argument("--policy", help="unified policy YAML file")
+    parser.add_argument("--interval", type=float, default=30.0)
+    parser.add_argument("--metrics-port", type=int, default=0)
+    parser.add_argument("--kubeconfig", action="store_true")
+    parser.add_argument("--demo", action="store_true",
+                        help="simulated mixed GPU+TPU fleet")
+    args = parser.parse_args()
+
+    logging.basicConfig(
+        level=logging.INFO,
+        format="%(asctime)s %(name)s %(levelname)s %(message)s")
+    registry = MetricsRegistry()
+    latest_status: dict = {}
+    server = None
+    if args.metrics_port:
+        from tpu_operator_libs.examples.libtpu_operator import serve_metrics
+
+        server = serve_metrics(registry, args.metrics_port,
+                               status_source=latest_status)
+    try:
+        if args.demo:
+            return run_demo(registry, latest_status)
+
+        from tpu_operator_libs.k8s.real import RealCluster
+
+        cluster = (RealCluster.from_kubeconfig() if args.kubeconfig
+                   else RealCluster.in_cluster())
+        policy = load_unified_policy(args.policy)
+        multi = MultiAcceleratorUpgradeManager(cluster, policy)
+        stop = threading.Event()
+        signal.signal(signal.SIGTERM, lambda *a: stop.set())
+        signal.signal(signal.SIGINT, lambda *a: stop.set())
+        while not stop.is_set():
+            try:
+                reconcile_pass(multi, registry, latest_status)
+            except Exception:  # noqa: BLE001 — keep the loop alive
+                logger.exception("reconcile pass failed; retrying")
+            stop.wait(args.interval)
+        return 0
+    finally:
+        if server is not None:
+            server.shutdown()
+
+
+if __name__ == "__main__":
+    sys.exit(main())

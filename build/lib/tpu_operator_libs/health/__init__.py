@@ -1,0 +1,26 @@
+"""TPU-native health gates.
+
+Two gates that replace the reference's OFED/RDMA-specific concerns
+(docs/automatic-ofed-upgrade.md) with their TPU equivalents:
+
+- ``ici_probe``: a JAX/XLA collective probe that proves the ICI fabric of a
+  slice is healthy after a libtpu upgrade, plugged into the validation
+  state via the ValidationManager ``extra_validator`` seam
+  (SURVEY.md §5 "distributed communication backend").
+- ``checkpoint_gate``: an Orbax checkpoint-durability check that blocks
+  eviction of a live JAX training job until its latest checkpoint is
+  committed to durable storage (BASELINE config #4).
+"""
+
+from tpu_operator_libs.health.ici_probe import (  # noqa: F401
+    FabricProbeResult,
+    ICIFabricValidator,
+    fabric_probe,
+    fabric_probe_topology,
+    make_mesh,
+    single_chip_probe,
+)
+from tpu_operator_libs.health.checkpoint_gate import (  # noqa: F401
+    CheckpointDurabilityGate,
+    latest_committed_step,
+)

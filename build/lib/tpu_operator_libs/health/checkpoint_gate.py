@@ -1,0 +1,151 @@
+"""Checkpoint-durability gate for evicting live JAX training jobs.
+
+BASELINE config #4: during a rolling libtpu upgrade on a pool running a
+JAX training Job, the pod-deletion state must verify the job's (Orbax)
+checkpoint is durable before evicting — eviction then costs at most the
+steps since the last commit, and the job resumes from checkpoint on a
+fresh node.
+
+The reference's insertion points are the ``PodDeletionFilter`` seam
+(pod_manager.go:76) and ``WaitForCompletionSpec``; this module supplies the
+gate itself plus the eviction-time hook PodManager exposes
+(``eviction_gate``), which — unlike the deletion *filter* — keeps the node
+parked in pod-deletion-required until the gate opens instead of silently
+skipping the pod.
+
+Orbax layout knowledge (mirrors orbax.checkpoint's commit protocol):
+
+- Each step is a numbered subdirectory of the checkpoint root.
+- In-progress saves use a ``<step>.orbax-checkpoint-tmp-<ts>`` directory
+  name (atomic-rename filesystems) or contain no commit-success marker
+  yet (GCS-style non-atomic filesystems).
+- A step directory is committed once it has its final name and, when a
+  ``commit_success.txt`` marker is used at all, the marker exists.
+"""
+
+from __future__ import annotations
+
+import logging
+import os
+import re
+import time
+from dataclasses import dataclass
+from typing import Optional
+
+logger = logging.getLogger(__name__)
+
+_TMP_RE = re.compile(r"\.orbax-checkpoint-tmp-\d+$")
+_STEP_RE = re.compile(r"^(?:[a-zA-Z_]*?)(\d+)$")
+_COMMIT_MARKER = "commit_success.txt"
+
+
+def _is_tmp_dir(name: str) -> bool:
+    return bool(_TMP_RE.search(name))
+
+
+def _step_of(name: str) -> Optional[int]:
+    m = _STEP_RE.match(name)
+    return int(m.group(1)) if m else None
+
+
+def _is_committed(entries: Optional[list[str]], require_marker: bool) -> bool:
+    """Committed = final name, non-empty, and — when the checkpoint root
+    uses commit markers at all (GCS-style non-atomic filesystems, where
+    Orbax writes the step under its final name and the marker last) — the
+    marker itself. On atomic-rename filesystems the final name alone is
+    the commit. ``entries`` is the step directory's listing (None when the
+    path is not a listable directory)."""
+    if not entries:
+        return False
+    if _COMMIT_MARKER in entries:
+        return True
+    if require_marker:
+        # Sibling steps carry markers, this one doesn't: still uploading.
+        return False
+    return not any(e.endswith(".orbax-checkpoint-in-progress")
+                   for e in entries)
+
+
+def latest_committed_step(checkpoint_dir: str) -> Optional[int]:
+    """Newest committed step number under ``checkpoint_dir``, or None.
+
+    Each step directory is listed exactly once (remote LIST calls are the
+    cost driver on gcsfuse-mounted roots, re-run every reconcile for every
+    parked node).
+    """
+    try:
+        names = os.listdir(checkpoint_dir)
+    except (FileNotFoundError, NotADirectoryError):
+        return None
+    listings: list[tuple[int, Optional[list[str]]]] = []
+    uses_markers = False
+    for name in names:
+        if _is_tmp_dir(name):
+            continue
+        step = _step_of(name)
+        if step is None:
+            continue
+        path = os.path.join(checkpoint_dir, name)
+        try:
+            entries = os.listdir(path) if os.path.isdir(path) else None
+        except OSError:
+            entries = None
+        listings.append((step, entries))
+        if entries and _COMMIT_MARKER in entries:
+            uses_markers = True
+    steps = [step for step, entries in listings
+             if _is_committed(entries, require_marker=uses_markers)]
+    return max(steps, default=None)
+
+
+@dataclass
+class CheckpointDurabilityGate:
+    """Eviction gate: open once a sufficiently fresh checkpoint is durable.
+
+    Usable directly as PodManager's ``eviction_gate(node, pods)`` — it
+    returns True when eviction may proceed. Policy knobs:
+
+    - ``min_step``: require at least this step to be committed (e.g. the
+      job's current step minus an acceptable loss window).
+    - ``max_age_seconds``: require the newest committed step's mtime to be
+      within this window (guards against a job that stopped checkpointing);
+      0 disables the age check.
+    """
+
+    checkpoint_dir: str
+    min_step: Optional[int] = None
+    max_age_seconds: float = 0.0
+
+    def check(self) -> bool:
+        step = latest_committed_step(self.checkpoint_dir)
+        if step is None:
+            logger.info("checkpoint gate: no committed checkpoint in %s",
+                        self.checkpoint_dir)
+            return False
+        if self.min_step is not None and step < self.min_step:
+            logger.info("checkpoint gate: latest committed step %d < "
+                        "required %d", step, self.min_step)
+            return False
+        if self.max_age_seconds > 0:
+            age = self._age_of_step(step)
+            if age is None or age > self.max_age_seconds:
+                logger.info("checkpoint gate: step %d age %s exceeds %.0fs",
+                            step, age, self.max_age_seconds)
+                return False
+        logger.info("checkpoint gate open: step %d durable in %s",
+                    step, self.checkpoint_dir)
+        return True
+
+    def _age_of_step(self, step: int) -> Optional[float]:
+        try:
+            for name in os.listdir(self.checkpoint_dir):
+                if _step_of(name) == step and not _is_tmp_dir(name):
+                    mtime = os.path.getmtime(
+                        os.path.join(self.checkpoint_dir, name))
+                    return time.time() - mtime
+        except OSError:
+            return None
+        return None
+
+    def __call__(self, node, pods) -> bool:  # PodManager eviction_gate
+        return self.check()

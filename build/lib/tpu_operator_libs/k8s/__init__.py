@@ -1,0 +1,39 @@
+"""Minimal Kubernetes object model, selectors, client seam and fake cluster.
+
+The reference leans on k8s.io/client-go, apimachinery and controller-runtime
+(SURVEY.md L0). This package is the TPU build's equivalent substrate:
+
+- ``objects``: typed Node / Pod / DaemonSet / ControllerRevision model.
+- ``selectors``: label selectors (equality and set-based) + field selectors.
+- ``client``: the abstract cluster interface every manager talks to.
+- ``fake``: a thread-safe in-memory API server — the envtest substitute the
+  test strategy requires (SURVEY.md §4: "fake in-memory API server fixture").
+- ``drain``: cordon/uncordon + drain filter chain, replacing the reference's
+  dependency on k8s.io/kubectl/pkg/drain.
+- ``real``: optional adapter to a live cluster via the ``kubernetes`` client
+  (import-gated; not required for tests or simulation).
+- ``leaderelection``: Lease-based leader election for HA operator
+  deployments (client-go tools/leaderelection analogue).
+- ``cached``: informer-backed read cache over any backend — the
+  controller-runtime cached-client analogue the provider's read-back
+  poll was designed against.
+"""
+
+from tpu_operator_libs.k8s.objects import (  # noqa: F401
+    ContainerStatus,
+    ControllerRevision,
+    DaemonSet,
+    Lease,
+    Node,
+    ObjectMeta,
+    OwnerReference,
+    Pod,
+    PodPhase,
+)
+from tpu_operator_libs.k8s.cached import CachedReadClient  # noqa: F401
+from tpu_operator_libs.k8s.client import K8sClient  # noqa: F401
+from tpu_operator_libs.k8s.fake import FakeCluster  # noqa: F401
+from tpu_operator_libs.k8s.leaderelection import (  # noqa: F401
+    LeaderElectionConfig,
+    LeaderElector,
+)

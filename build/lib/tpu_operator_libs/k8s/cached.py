@@ -1,0 +1,227 @@
+"""Informer-backed cached read client.
+
+The reference's hot loop reads through a controller-runtime cached
+``client.Client`` (created at upgrade_state.go:127) while writes go
+straight to the apiserver — which is why ``ChangeNodeUpgradeState`` must
+poll its own cache until a patch becomes visible
+(node_upgrade_state_provider.go:100-117). This module is that substrate,
+built on this repo's own informers:
+
+- **Reads** (`get_node`, `list_nodes`, `list_pods`, `list_daemon_sets`)
+  are served from list+watch :class:`~tpu_operator_libs.controller.Informer`
+  caches — zero API traffic per reconcile once synced.
+- **Writes** (patches, cordon, delete, evict) pass through to the
+  delegate client; the cache catches up when the resulting watch event
+  lands. Reads are therefore *eventually* consistent, exactly the
+  staleness contract NodeUpgradeStateProvider's read-back poll exists
+  to absorb.
+- **ControllerRevisions** pass through uncached: they are immutable,
+  read only by the revision oracle (one list per BuildState), and the
+  watch plane does not carry them — the same shape as controller-runtime
+  bypassing the cache for unregistered kinds.
+
+Use :meth:`CachedReadClient.has_synced` as the start-up barrier before
+the first reconcile, mirroring controller-runtime's
+``mgr.GetCache().WaitForCacheSync``.
+"""
+
+from __future__ import annotations
+
+import logging
+import threading
+import time
+from typing import Mapping, Optional
+
+from tpu_operator_libs.k8s.client import K8sClient, NotFoundError
+from tpu_operator_libs.k8s.objects import (
+    ControllerRevision,
+    DaemonSet,
+    Node,
+    Pod,
+)
+from tpu_operator_libs.k8s.selectors import (
+    parse_field_selector,
+    parse_label_selector,
+)
+from tpu_operator_libs.k8s.watch import (
+    KIND_DAEMON_SET,
+    KIND_NODE,
+    KIND_POD,
+)
+
+
+logger = logging.getLogger(__name__)
+
+
+class CacheNotSyncedError(RuntimeError):
+    """A read was attempted before the initial list completed."""
+
+
+class CachedReadClient(K8sClient):
+    """K8sClient whose reads come from informer caches.
+
+    ``namespace`` scopes the pod and DaemonSet caches (the upgrade flow
+    is single-namespace, like the reference consumer's driver
+    namespace); nodes are cluster-scoped. The delegate must support
+    :meth:`K8sClient.watch`.
+    """
+
+    def __init__(self, delegate: K8sClient, namespace: str,
+                 require_sync: bool = True,
+                 relist_interval: Optional[float] = 300.0) -> None:
+        # Deferred: controller.py imports k8s.watch, whose package
+        # __init__ re-exports this module — a top-level import of
+        # controller here would be circular for any consumer that
+        # imports tpu_operator_libs.controller first.
+        from tpu_operator_libs.controller import Informer
+
+        self._delegate = delegate
+        self._namespace = namespace
+        self._require_sync = require_sync
+        self._nodes = Informer(
+            delegate.list_nodes,
+            delegate.watch(kinds={KIND_NODE}),
+            name="node-cache")
+        self._pods = Informer(
+            lambda: delegate.list_pods(namespace=namespace),
+            delegate.watch(kinds={KIND_POD}, namespace=namespace),
+            name="pod-cache")
+        self._daemon_sets = Informer(
+            lambda: delegate.list_daemon_sets(namespace),
+            delegate.watch(kinds={KIND_DAEMON_SET}, namespace=namespace),
+            name="ds-cache")
+        self._informers = (self._nodes, self._pods, self._daemon_sets)
+        for informer in self._informers:
+            informer.start()
+        # A restarted live watch re-delivers current objects but never
+        # DELETEDs lost in the stream gap; periodic relist (Reflector
+        # Replace) prunes such ghosts so e.g. _wait_for_delete cannot
+        # spin on a pod that terminated during the gap. With
+        # relist_interval=None ghost objects persist until a manual
+        # refresh(); deletion tombstones stay bounded either way (the
+        # informer TTL-prunes them on delete, controller._TOMBSTONE_TTL).
+        self._stop_relist = threading.Event()
+        self._relist_thread: Optional[threading.Thread] = None
+        if relist_interval is not None and relist_interval > 0:
+            self._relist_thread = threading.Thread(
+                target=self._relist_loop, args=(relist_interval,),
+                name="cache-relist", daemon=True)
+            self._relist_thread.start()
+
+    # -- lifecycle --------------------------------------------------------
+    def has_synced(self, timeout: Optional[float] = None) -> bool:
+        """True once every cache finished its initial list
+        (WaitForCacheSync analogue). ``timeout`` is one shared budget
+        across all caches, not per cache."""
+        deadline = (None if timeout is None
+                    else time.monotonic() + timeout)
+        for informer in self._informers:
+            remaining = (None if deadline is None
+                         else max(0.0, deadline - time.monotonic()))
+            if not informer.has_synced(timeout=remaining):
+                return False
+        return True
+
+    def refresh(self) -> None:
+        """Force one relist-and-replace of every cache."""
+        for informer in self._informers:
+            informer.refresh()
+
+    def add_event_handler(self, on_change) -> None:
+        """``on_change(obj)`` after any add/update/delete is APPLIED to a
+        cache. Wiring reconcile triggers here (rather than to a raw
+        watch) guarantees a triggered reconcile reads a cache that
+        already contains the triggering event."""
+        for informer in self._informers:
+            informer.add_event_handler(
+                on_add=on_change,
+                on_update=lambda _old, new: on_change(new),
+                on_delete=on_change)
+
+    def _relist_loop(self, interval: float) -> None:
+        while not self._stop_relist.wait(interval):
+            try:
+                self.refresh()
+            except Exception:
+                logger.exception("periodic cache relist failed; next "
+                                 "interval retries")
+
+    def stop(self) -> None:
+        self._stop_relist.set()
+        for informer in self._informers:
+            informer.stop()
+        if self._relist_thread is not None:
+            self._relist_thread.join(timeout=5.0)
+
+    def _barrier(self) -> None:
+        if self._require_sync and not self.has_synced(timeout=0):
+            raise CacheNotSyncedError(
+                "cache read before initial sync; call has_synced() first")
+
+    # -- cached reads -----------------------------------------------------
+    def get_node(self, name: str) -> Node:
+        self._barrier()
+        node = self._nodes.get("", name)
+        if node is None:
+            raise NotFoundError(f"node {name!r} not found")
+        return node.clone()
+
+    def list_nodes(self, label_selector: str = "") -> list[Node]:
+        self._barrier()
+        match = parse_label_selector(label_selector)
+        return [n.clone() for n in self._nodes.list()
+                if match(n.metadata.labels)]
+
+    def list_pods(self, namespace: Optional[str] = None,
+                  label_selector: str = "",
+                  field_selector: str = "") -> list[Pod]:
+        self._barrier()
+        if namespace != self._namespace:
+            # None/"" mean ALL namespaces (pod_manager.go:323-331), and
+            # the drain/eviction/validation paths rely on that to see
+            # workload pods outside the operator namespace — the
+            # single-namespace cache cannot answer those queries.
+            return self._delegate.list_pods(namespace, label_selector,
+                                            field_selector)
+        label_match = parse_label_selector(label_selector)
+        field_match = parse_field_selector(field_selector)
+        return [p.clone() for p in self._pods.list()
+                if label_match(p.metadata.labels)
+                and field_match(p.field_map())]
+
+    def list_daemon_sets(self, namespace: str,
+                         label_selector: str = "") -> list[DaemonSet]:
+        self._barrier()
+        if namespace != self._namespace:
+            return self._delegate.list_daemon_sets(namespace, label_selector)
+        match = parse_label_selector(label_selector)
+        return [d.clone() for d in self._daemon_sets.list()
+                if match(d.metadata.labels)]
+
+    # -- uncached reads ---------------------------------------------------
+    def list_controller_revisions(self, namespace: str,
+                                  label_selector: str = "") -> list[ControllerRevision]:
+        return self._delegate.list_controller_revisions(
+            namespace, label_selector)
+
+    # -- writes (pass through; cache catches up via watch events) ---------
+    def patch_node_labels(self, name: str,
+                          labels: Mapping[str, Optional[str]]) -> Node:
+        return self._delegate.patch_node_labels(name, labels)
+
+    def patch_node_annotations(self, name: str,
+                               annotations: Mapping[str, Optional[str]]) -> Node:
+        return self._delegate.patch_node_annotations(name, annotations)
+
+    def set_node_unschedulable(self, name: str, unschedulable: bool) -> Node:
+        return self._delegate.set_node_unschedulable(name, unschedulable)
+
+    def delete_pod(self, namespace: str, name: str) -> None:
+        self._delegate.delete_pod(namespace, name)
+
+    def evict_pod(self, namespace: str, name: str) -> None:
+        self._delegate.evict_pod(namespace, name)
+
+    # -- watches ----------------------------------------------------------
+    def watch(self, kinds=None, namespace: Optional[str] = None):
+        return self._delegate.watch(kinds=kinds, namespace=namespace)

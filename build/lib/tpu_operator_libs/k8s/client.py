@@ -1,0 +1,116 @@
+"""Abstract cluster client — the seam every manager talks through.
+
+The reference splits cluster access between a controller-runtime cached
+``client.Client`` and a typed clientset ``kubernetes.Interface``
+(upgrade_state.go:104-108). Here a single narrow interface covers the union
+of operations the upgrade flow actually performs, so it can be backed by:
+
+- :class:`tpu_operator_libs.k8s.fake.FakeCluster` (tests / simulation), or
+- :class:`tpu_operator_libs.k8s.real.RealCluster` (live cluster via the
+  ``kubernetes`` Python client, import-gated).
+
+All mutating label/annotation operations use merge-patch semantics with
+``None`` meaning "delete the key", mirroring the reference's raw merge
+patches (node_upgrade_state_provider.go:80-82,147-151).
+"""
+
+from __future__ import annotations
+
+import abc
+from typing import Mapping, Optional
+
+from tpu_operator_libs.k8s.objects import (
+    ControllerRevision,
+    DaemonSet,
+    Node,
+    Pod,
+)
+
+
+class NotFoundError(KeyError):
+    """Object does not exist (client-go apierrors.IsNotFound analogue)."""
+
+
+class K8sClient(abc.ABC):
+    """The cluster operations required by the upgrade state machine."""
+
+    # -- nodes ------------------------------------------------------------
+    @abc.abstractmethod
+    def get_node(self, name: str) -> Node:
+        """Return a snapshot copy of the node; raises NotFoundError."""
+
+    @abc.abstractmethod
+    def list_nodes(self, label_selector: str = "") -> list[Node]:
+        ...
+
+    @abc.abstractmethod
+    def patch_node_labels(self, name: str,
+                          labels: Mapping[str, Optional[str]]) -> Node:
+        """Merge-patch node labels; value None deletes the key."""
+
+    @abc.abstractmethod
+    def patch_node_annotations(self, name: str,
+                               annotations: Mapping[str, Optional[str]]) -> Node:
+        """Merge-patch node annotations; value None deletes the key."""
+
+    @abc.abstractmethod
+    def set_node_unschedulable(self, name: str, unschedulable: bool) -> Node:
+        """Cordon (True) or uncordon (False) the node."""
+
+    # -- pods -------------------------------------------------------------
+    @abc.abstractmethod
+    def list_pods(self, namespace: Optional[str] = None,
+                  label_selector: str = "",
+                  field_selector: str = "") -> list[Pod]:
+        """List pods; ``namespace=None`` means all namespaces
+        (pod_manager.go:323-331 lists with Pods(""))."""
+
+    @abc.abstractmethod
+    def delete_pod(self, namespace: str, name: str) -> None:
+        """Delete a pod; raises NotFoundError if absent."""
+
+    @abc.abstractmethod
+    def evict_pod(self, namespace: str, name: str) -> None:
+        """Evict a pod via the eviction subresource (drain path). May raise
+        EvictionBlockedError when a disruption budget forbids it."""
+
+    # -- watches ----------------------------------------------------------
+    def watch(self, kinds=None, namespace: Optional[str] = None):
+        """Stream change events (k8s.watch.WatchEvent) for Nodes / Pods /
+        DaemonSets, optionally filtered by kind set and (for namespaced
+        kinds) namespace. Returns a k8s.watch.Watch. Optional capability:
+        implemented by FakeCluster and RealCluster; other backends may
+        leave it unsupported and drive reconciles by polling."""
+        raise NotImplementedError(
+            f"{type(self).__name__} does not support watches")
+
+    # -- daemonsets & revisions ------------------------------------------
+    @abc.abstractmethod
+    def list_daemon_sets(self, namespace: str,
+                         label_selector: str = "") -> list[DaemonSet]:
+        ...
+
+    @abc.abstractmethod
+    def list_controller_revisions(self, namespace: str,
+                                  label_selector: str = "") -> list[ControllerRevision]:
+        ...
+
+
+class ApiServerError(RuntimeError):
+    """Transient apiserver failure (5xx / connection-reset analogue).
+    Retryable: the reference aborts the ApplyState pass and relies on
+    re-reconcile (upgrade_state.go:420-423)."""
+
+
+class EvictionBlockedError(RuntimeError):
+    """Eviction rejected (e.g. by a PodDisruptionBudget)."""
+
+
+class ConflictError(RuntimeError):
+    """Optimistic-concurrency failure: the object's resourceVersion moved
+    between read and write (apierrors.IsConflict analogue)."""
+
+
+class AlreadyExistsError(RuntimeError):
+    """Create of an object that already exists (apierrors.IsAlreadyExists
+    analogue)."""

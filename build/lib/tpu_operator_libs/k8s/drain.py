@@ -1,0 +1,228 @@
+"""Node drain: cordon/uncordon and the pod filter chain.
+
+The reference outsources this to ``k8s.io/kubectl/pkg/drain`` (used by
+CordonManager cordon_manager.go:39-48, DrainManager drain_manager.go:76-95
+and PodManager's eviction path pod_manager.go:139-160). A TPU-native build
+has no kubectl to lean on, so this module implements the same observable
+semantics in-repo:
+
+- ``run_cordon_or_uncordon``: flip ``spec.unschedulable``.
+- :class:`DrainHelper`: decide per pod whether it may be deleted, using the
+  kubectl filter chain (DaemonSet pods skipped when IgnoreAllDaemonSets,
+  mirror pods always skipped, unreplicated pods an error unless Force,
+  emptyDir pods an error unless DeleteEmptyDirData, optional pod selector,
+  plus caller-supplied additional filters — the seam the reference threads
+  its PodDeletionFilter through, pod_manager.go:141-147,159).
+- ``delete_or_evict_pods``: evict and wait for disappearance up to Timeout.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import Callable, Optional
+
+from tpu_operator_libs.k8s.client import (
+    EvictionBlockedError,
+    K8sClient,
+    NotFoundError,
+)
+from tpu_operator_libs.k8s.objects import Pod
+from tpu_operator_libs.util import Clock
+
+
+class DrainError(RuntimeError):
+    """Drain could not delete every required pod."""
+
+
+class DrainTimeoutError(DrainError):
+    """Pods did not terminate within the drain timeout."""
+
+
+@dataclass
+class PodDeleteStatus:
+    """Verdict of one filter for one pod (kubectl's podDeleteStatus)."""
+
+    delete: bool
+    reason: str = ""
+    error: bool = False
+
+    @classmethod
+    def okay(cls) -> "PodDeleteStatus":
+        return cls(delete=True)
+
+    @classmethod
+    def skip(cls, reason: str = "") -> "PodDeleteStatus":
+        return cls(delete=False, reason=reason)
+
+    @classmethod
+    def blocked(cls, reason: str) -> "PodDeleteStatus":
+        return cls(delete=False, reason=reason, error=True)
+
+
+PodFilter = Callable[[Pod], PodDeleteStatus]
+
+
+@dataclass
+class DrainHelper:
+    """Equivalent of kubectl drain.Helper for the operations the upgrade
+    flow performs."""
+
+    client: K8sClient
+    force: bool = False
+    ignore_all_daemon_sets: bool = True
+    delete_empty_dir_data: bool = False
+    timeout_seconds: float = 0  # 0 = infinite
+    pod_selector: str = ""
+    additional_filters: list[PodFilter] = field(default_factory=list)
+    on_pod_deleted: Optional[Callable[[Pod], None]] = None
+    clock: Clock = field(default_factory=Clock)
+    poll_interval: float = 1.0
+
+    # -- filter chain (kubectl drain's makeFilters equivalents) -----------
+    def _daemon_set_filter(self, pod: Pod) -> PodDeleteStatus:
+        if pod.is_daemonset_pod():
+            if self.ignore_all_daemon_sets:
+                return PodDeleteStatus.skip("DaemonSet-managed pod")
+            return PodDeleteStatus.blocked(
+                f"pod {pod.name} is DaemonSet-managed")
+        return PodDeleteStatus.okay()
+
+    def _mirror_filter(self, pod: Pod) -> PodDeleteStatus:
+        if pod.is_mirror_pod():
+            return PodDeleteStatus.skip("static mirror pod")
+        return PodDeleteStatus.okay()
+
+    def _unreplicated_filter(self, pod: Pod) -> PodDeleteStatus:
+        if pod.controller_owner() is None and not self.force:
+            return PodDeleteStatus.blocked(
+                f"pod {pod.name} has no controller; use force to delete")
+        return PodDeleteStatus.okay()
+
+    def _local_storage_filter(self, pod: Pod) -> PodDeleteStatus:
+        if pod.uses_empty_dir() and not self.delete_empty_dir_data:
+            return PodDeleteStatus.blocked(
+                f"pod {pod.name} has emptyDir volumes; "
+                f"use delete-emptydir-data to proceed")
+        return PodDeleteStatus.okay()
+
+    def _selector_filter(self, pod: Pod) -> PodDeleteStatus:
+        if self.pod_selector:
+            from tpu_operator_libs.k8s.selectors import matches_labels
+            if not matches_labels(self.pod_selector, pod.metadata.labels):
+                return PodDeleteStatus.skip("does not match pod selector")
+        return PodDeleteStatus.okay()
+
+    def get_pods_for_deletion(
+            self, node_name: str) -> tuple[list[Pod], list[str]]:
+        """Classify every pod on the node.
+
+        Returns (pods to delete, blocking errors). Mirrors kubectl's
+        GetPodsForDeletion as used at pod_manager.go:194 and inside
+        RunNodeDrain: a pod is deletable only if every filter approves;
+        filters marking ``error`` produce entries in the error list.
+        """
+        pods = self.client.list_pods(
+            namespace=None, field_selector=f"spec.nodeName={node_name}")
+        deletable: list[Pod] = []
+        errors: list[str] = []
+        filters: list[PodFilter] = [
+            self._selector_filter,
+            self._mirror_filter,
+            self._daemon_set_filter,
+            self._unreplicated_filter,
+            self._local_storage_filter,
+            *self.additional_filters,
+        ]
+        for pod in pods:
+            verdict = PodDeleteStatus.okay()
+            for f in filters:
+                verdict = f(pod)
+                if not verdict.delete:
+                    break
+            if verdict.delete:
+                deletable.append(pod)
+            elif verdict.error:
+                errors.append(verdict.reason)
+        return deletable, errors
+
+    def delete_or_evict_pods(self, pods: list[Pod]) -> None:
+        """Evict the pods and wait for them to disappear (kubectl
+        DeleteOrEvictPods + waitForDelete).
+
+        An eviction rejected by a PodDisruptionBudget (API 429) is retried
+        every ``poll_interval`` until the drain timeout — kubectl's
+        evictPods does exactly this on IsTooManyRequests rather than
+        failing the drain on the first blocked pod. Deliberate delta from
+        kubectl: with ``timeout_seconds=0`` (infinite) a blocked eviction
+        raises immediately instead of retrying forever — an unbounded
+        silent wait would pin the node in-progress with no event or state
+        transition; waiting out a PDB requires an explicit retry budget.
+        """
+        deadline = (self.clock.now() + self.timeout_seconds
+                    if self.timeout_seconds else None)
+        pending = list(pods)
+        while pending:
+            blocked = []
+            first_error: Optional[EvictionBlockedError] = None
+            for pod in pending:
+                try:
+                    self.client.evict_pod(pod.namespace, pod.name)
+                except NotFoundError:
+                    continue
+                except EvictionBlockedError as exc:
+                    blocked.append(pod)
+                    first_error = first_error or exc
+                    continue
+                if self.on_pod_deleted is not None:
+                    self.on_pod_deleted(pod)
+            pending = blocked
+            if pending:
+                if deadline is None:
+                    raise first_error  # no retry budget: fail fast
+                if self.clock.now() >= deadline:
+                    names = ", ".join(p.name for p in pending)
+                    raise DrainTimeoutError(
+                        "evictions blocked by disruption budgets past the "
+                        f"drain timeout: {names}")
+                self.clock.sleep(self.poll_interval)
+        self._wait_for_delete(pods, deadline)
+
+    def _wait_for_delete(self, pods: list[Pod],
+                         deadline: Optional[float]) -> None:
+        """``deadline`` is the drain-wide deadline computed at drain start
+        (None = unbounded) — shared with the eviction-retry phase so the
+        whole drain honors one timeout."""
+        remaining = list(pods)
+        while remaining:
+            still_there = []
+            for pod in remaining:
+                existing = self.client.list_pods(
+                    namespace=pod.namespace,
+                    field_selector=f"metadata.name={pod.name}")
+                # A recreated pod has a different UID; only the same
+                # incarnation counts as "still terminating".
+                if any(p.metadata.uid == pod.metadata.uid for p in existing):
+                    still_there.append(pod)
+            remaining = still_there
+            if not remaining:
+                return
+            if deadline is not None and self.clock.now() >= deadline:
+                names = ", ".join(p.name for p in remaining)
+                raise DrainTimeoutError(
+                    f"timed out waiting for pods to terminate: {names}")
+            self.clock.sleep(self.poll_interval)
+
+    def run_node_drain(self, node_name: str) -> None:
+        """Full drain of a node: classify then evict (kubectl RunNodeDrain,
+        called from drain_manager.go:120)."""
+        deletable, errors = self.get_pods_for_deletion(node_name)
+        if errors:
+            raise DrainError("; ".join(errors))
+        self.delete_or_evict_pods(deletable)
+
+
+def run_cordon_or_uncordon(client: K8sClient, node_name: str,
+                           desired: bool) -> None:
+    """Set spec.unschedulable (kubectl RunCordonOrUncordon,
+    cordon_manager.go:39-48)."""
+    client.set_node_unschedulable(node_name, desired)

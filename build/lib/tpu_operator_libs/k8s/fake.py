@@ -1,0 +1,637 @@
+"""FakeCluster: a thread-safe in-memory Kubernetes API server.
+
+This is the build's envtest substitute (SURVEY.md §4 / BASELINE config #1:
+"single-node UpgradeStateManager reconcile via envtest + fake clientset").
+The reference test suite boots a real etcd+apiserver via envtest
+(upgrade_suit_test.go:73-97); we model the same observable semantics in
+memory:
+
+- Value semantics: every read returns a deep copy, every write goes through
+  an explicit API call — callers can never mutate the store through a
+  returned object, exactly like objects that crossed the wire.
+- Merge-patch label/annotation updates with ``None`` ⇒ delete, matching the
+  raw patches the reference issues (node_upgrade_state_provider.go:80-82,
+  147-151).
+- Label/field selector list semantics via tpu_operator_libs.k8s.selectors.
+- No kubelet and no controllers by default: deleting a pod just deletes it —
+  the property the reference's drain tests rely on (SURVEY.md §4 caveat).
+
+Beyond envtest, an optional **DaemonSet controller simulation**
+(:meth:`FakeCluster.enable_ds_controller`) recreates deleted DS-owned pods
+with the newest ControllerRevision hash after a configurable (virtual) delay
+and marks them Ready after another delay. Combined with the injectable Clock
+this turns the fake into a discrete-event simulator of a rolling upgrade —
+the engine behind ``bench.py`` and the e2e tests (BASELINE configs #2-#4).
+"""
+
+from __future__ import annotations
+
+import threading
+from dataclasses import dataclass, field
+from typing import Callable, Mapping, Optional
+
+from tpu_operator_libs.consts import POD_CONTROLLER_REVISION_HASH_LABEL
+from tpu_operator_libs.k8s.client import (
+    AlreadyExistsError,
+    ApiServerError,
+    ConflictError,
+    EvictionBlockedError,
+    K8sClient,
+    NotFoundError,
+)
+from tpu_operator_libs.k8s.objects import (
+    ContainerStatus,
+    ControllerRevision,
+    DaemonSet,
+    Lease,
+    Node,
+    ObjectMeta,
+    OwnerReference,
+    Pod,
+    PodPhase,
+    PodSpec,
+    PodStatus,
+    new_uid,
+)
+from tpu_operator_libs.k8s.selectors import (
+    parse_field_selector,
+    parse_label_selector,
+)
+from tpu_operator_libs.k8s.watch import (
+    ADDED,
+    DELETED,
+    KIND_DAEMON_SET,
+    KIND_NODE,
+    KIND_POD,
+    MODIFIED,
+    Watch,
+    WatchBroadcaster,
+)
+from tpu_operator_libs.util import Clock
+
+
+@dataclass
+class _DsControllerConfig:
+    recreate_delay: float = 5.0
+    ready_delay: float = 10.0
+    enabled: bool = True
+
+
+@dataclass(order=True)
+class _ScheduledAction:
+    due: float
+    seq: int
+    action: Callable[[], None] = field(compare=False)
+
+
+class FakeCluster(K8sClient):
+    """In-memory cluster store implementing :class:`K8sClient`."""
+
+    def __init__(self, clock: Optional[Clock] = None) -> None:
+        self._clock = clock or Clock()
+        self._lock = threading.RLock()
+        self._nodes: dict[str, Node] = {}
+        self._pods: dict[tuple[str, str], Pod] = {}
+        self._daemon_sets: dict[tuple[str, str], DaemonSet] = {}
+        self._revisions: dict[tuple[str, str], ControllerRevision] = {}
+        # Revision ownership by DS identity, so DaemonSets whose names share
+        # a prefix (e.g. "tpu" / "tpu-plugin") never see each other's
+        # revisions. (The reference's prefix-scan, pod_manager.go:104-109,
+        # has exactly that collision; the fake must not inherit it.)
+        self._revision_owner: dict[tuple[str, str], tuple[str, str]] = {}
+        self._leases: dict[tuple[str, str], Lease] = {}
+        self._scheduled: list[_ScheduledAction] = []
+        self._seq = 0
+        self._ds_controller: Optional[_DsControllerConfig] = None
+        self._eviction_blockers: list[Callable[[Pod], bool]] = []
+        # Health gate consulted by the DS-controller simulation before
+        # marking a recreated pod Ready. Returning False models a
+        # crash-looping runtime: the pod stays not-ready with a
+        # crash-loop restart count and readiness is retried later.
+        self._pod_ready_gate: Optional[Callable[[Pod], bool]] = None
+        # Per-node count of reads that should return a stale copy, to
+        # exercise the provider's cache-sync poll loop
+        # (node_upgrade_state_provider.go:100-117).
+        self._stale_reads: dict[str, tuple[int, Node]] = {}
+        # Per-operation budget of injected transient API failures
+        # (apiserver 5xx / connection-reset modeling); consumed one per
+        # call. The reference's answer to such errors is abort-the-pass +
+        # re-reconcile (upgrade_state.go:420-423), so tests assert the
+        # machine converges through them.
+        self._api_errors: dict[str, int] = {}
+        self._api_error_exc: dict[str, Callable[[], Exception]] = {}
+        # Watch fan-out: every mutation below emits a typed event so
+        # informers/controllers (tpu_operator_libs.controller) can drive
+        # reconciles the way controller-runtime does for the reference.
+        self._broadcaster = WatchBroadcaster()
+
+    def watch(self, kinds: Optional[set[str]] = None,
+              namespace: Optional[str] = None) -> Watch:
+        """Subscribe to change events, optionally filtered to a kind set
+        ({"Node", "Pod", "DaemonSet"}) and — for namespaced kinds — a
+        namespace. Snapshot copies only. Signature matches
+        RealCluster.watch so consumers are backend-agnostic."""
+        predicate = None
+        if namespace:
+            def predicate(event):
+                meta = getattr(event.object, "metadata", None)
+                ns = getattr(meta, "namespace", "")
+                return not ns or ns == namespace
+        return self._broadcaster.subscribe(kinds, predicate)
+
+    def _notify(self, event_type: str, kind: str, obj) -> None:
+        self._broadcaster.notify(event_type, kind, obj.clone())
+
+    # ------------------------------------------------------------------
+    # test/simulation helpers
+    # ------------------------------------------------------------------
+    @property
+    def clock(self) -> Clock:
+        return self._clock
+
+    def add_node(self, node: Node) -> Node:
+        with self._lock:
+            self._nodes[node.metadata.name] = node.clone()
+            self._notify(ADDED, KIND_NODE, node)
+        return node
+
+    def add_pod(self, pod: Pod) -> Pod:
+        with self._lock:
+            self._pods[(pod.metadata.namespace, pod.metadata.name)] = (
+                pod.clone())
+            self._notify(ADDED, KIND_POD, pod)
+        return pod
+
+    @staticmethod
+    def _check_revision_hash(revision_hash: str) -> None:
+        """Controller-generated revision hashes are single dash-free
+        segments; enforcing that here keeps the '<ds-name>-<hash>' naming
+        scheme reversible (pod_manager.go:118-119)."""
+        if not revision_hash or "-" in revision_hash:
+            raise ValueError(
+                f"revision hash must be a non-empty dash-free segment, "
+                f"got {revision_hash!r}")
+
+    def add_daemon_set(self, ds: DaemonSet,
+                       revision_hash: str = "rev1",
+                       revision: int = 1) -> DaemonSet:
+        """Register a DaemonSet plus its current ControllerRevision.
+
+        The revision object is named ``<ds-name>-<hash>`` so the hash can be
+        recovered as the name suffix (pod_manager.go:118-119).
+        """
+        self._check_revision_hash(revision_hash)
+        with self._lock:
+            self._daemon_sets[(ds.metadata.namespace, ds.metadata.name)] = (
+                ds.clone())
+            rev_name = f"{ds.metadata.name}-{revision_hash}"
+            rev = ControllerRevision(
+                metadata=ObjectMeta(name=rev_name,
+                                    namespace=ds.metadata.namespace,
+                                    labels=dict(ds.spec.selector)),
+                revision=revision)
+            self._revisions[(ds.metadata.namespace, rev_name)] = rev
+            self._revision_owner[(ds.metadata.namespace, rev_name)] = (
+                ds.metadata.namespace, ds.metadata.name)
+            self._notify(ADDED, KIND_DAEMON_SET, ds)
+        return ds
+
+    def _revisions_of(self, namespace: str, ds_name: str) -> list[ControllerRevision]:
+        """Revisions owned by exactly this DaemonSet (lock must be held)."""
+        return [rev for key, rev in self._revisions.items()
+                if self._revision_owner.get(key) == (namespace, ds_name)]
+
+    def bump_daemon_set_revision(self, namespace: str, name: str,
+                                 revision_hash: str) -> None:
+        """Roll the DS template: add a newer ControllerRevision.
+
+        Existing pods keep their old ``controller-revision-hash`` label and
+        are therefore out of sync — the trigger condition for an upgrade
+        (upgrade_state.go:558-578).
+        """
+        self._check_revision_hash(revision_hash)
+        with self._lock:
+            ds = self._daemon_sets.get((namespace, name))
+            if ds is None:
+                raise NotFoundError(f"daemonset {namespace}/{name} not found")
+            ds.spec.template_generation += 1
+            latest = max((r.revision for r in self._revisions_of(namespace, name)),
+                         default=0)
+            rev_name = f"{name}-{revision_hash}"
+            self._revisions[(namespace, rev_name)] = ControllerRevision(
+                metadata=ObjectMeta(name=rev_name, namespace=namespace,
+                                    labels=dict(ds.spec.selector)),
+                revision=latest + 1)
+            self._revision_owner[(namespace, rev_name)] = (namespace, name)
+            self._notify(MODIFIED, KIND_DAEMON_SET, ds)
+
+    def latest_revision_hash(self, namespace: str, name: str) -> str:
+        with self._lock:
+            revs = self._revisions_of(namespace, name)
+            if not revs:
+                raise NotFoundError(f"no revisions for daemonset {name}")
+            return max(revs, key=lambda r: r.revision).hash
+
+    def enable_ds_controller(self, recreate_delay: float = 5.0,
+                             ready_delay: float = 10.0) -> None:
+        """Simulate the DaemonSet controller + kubelet: deleted DS pods are
+        recreated with the newest revision hash after ``recreate_delay``
+        (virtual) seconds and become Ready ``ready_delay`` seconds later."""
+        with self._lock:
+            self._ds_controller = _DsControllerConfig(
+                recreate_delay=recreate_delay, ready_delay=ready_delay)
+
+    def add_eviction_blocker(self, blocker: Callable[[Pod], bool]) -> None:
+        """Register a predicate that vetoes evictions (PDB analogue)."""
+        with self._lock:
+            self._eviction_blockers.append(blocker)
+
+    def set_pod_ready_gate(self, gate: Optional[Callable[[Pod], bool]]) -> None:
+        """Fault injection: recreated DS pods become Ready only when
+        ``gate(pod)`` returns True; until then they crash-loop (not ready,
+        restart count above the failure threshold)."""
+        with self._lock:
+            self._pod_ready_gate = gate
+
+    def inject_api_errors(self, operation: str, count: int,
+                          exc_factory: Optional[Callable[[], Exception]]
+                          = None) -> None:
+        """The next ``count`` calls of ``operation`` (a K8sClient method
+        name, e.g. ``"patch_node_labels"``) raise a transient
+        :class:`ApiServerError` (or ``exc_factory()``). Each call sets the
+        factory for the whole outstanding budget — passing None restores
+        the default ApiServerError."""
+        with self._lock:
+            self._api_errors[operation] = (
+                self._api_errors.get(operation, 0) + count)
+            if exc_factory is not None:
+                self._api_error_exc[operation] = exc_factory
+            else:
+                self._api_error_exc.pop(operation, None)
+
+    def _maybe_api_error(self, operation: str) -> None:
+        with self._lock:
+            remaining = self._api_errors.get(operation, 0)
+            if remaining <= 0:
+                return
+            self._api_errors[operation] = remaining - 1
+            factory = self._api_error_exc.get(operation)
+            if remaining == 1:
+                # budget exhausted: a later injection without a factory
+                # must get the documented default, not this leftover
+                self._api_error_exc.pop(operation, None)
+        raise factory() if factory else ApiServerError(
+            f"injected transient apiserver error on {operation}")
+
+    def inject_stale_node_reads(self, name: str, reads: int) -> None:
+        """Make the next ``reads`` get_node() calls return the current
+        (pre-future-patch) snapshot, emulating controller-runtime cache lag
+        that the provider's poll loop exists to absorb
+        (node_upgrade_state_provider.go:92-99)."""
+        if reads <= 0:
+            return
+        with self._lock:
+            node = self._nodes.get(name)
+            if node is None:
+                raise NotFoundError(name)
+            self._stale_reads[name] = (reads, node.clone())
+
+    def step(self, until: Optional[float] = None) -> int:
+        """Run scheduled simulation actions due at or before ``until``
+        (defaults to the clock's current time). Returns actions run."""
+        now = self._clock.now() if until is None else until
+        ran = 0
+        while True:
+            with self._lock:
+                due = [a for a in self._scheduled if a.due <= now]
+                if not due:
+                    return ran
+                due.sort()
+                action = due[0]
+                self._scheduled.remove(action)
+            action.action()
+            ran += 1
+
+    def pending_actions(self) -> int:
+        with self._lock:
+            return len(self._scheduled)
+
+    def next_action_due(self) -> Optional[float]:
+        with self._lock:
+            if not self._scheduled:
+                return None
+            return min(a.due for a in self._scheduled)
+
+    def _schedule(self, delay: float, action: Callable[[], None]) -> float:
+        return self.schedule_at(self._clock.now() + delay, action)
+
+    def schedule_at(self, due: float, action: Callable[[], None]) -> float:
+        """Public scheduler hook: run ``action`` once the virtual clock
+        reaches ``due`` and :meth:`step` is called. Used by fault
+        injection (tpu_operator_libs.simulate) and available to tests."""
+        with self._lock:
+            self._seq += 1
+            self._scheduled.append(_ScheduledAction(due, self._seq, action))
+            return due
+
+    # ------------------------------------------------------------------
+    # K8sClient: nodes
+    # ------------------------------------------------------------------
+    def get_node(self, name: str) -> Node:
+        self._maybe_api_error("get_node")
+        with self._lock:
+            stale = self._stale_reads.get(name)
+            if stale is not None:
+                remaining, snapshot = stale
+                if remaining > 1:
+                    self._stale_reads[name] = (remaining - 1, snapshot)
+                else:
+                    del self._stale_reads[name]
+                return snapshot.clone()
+            node = self._nodes.get(name)
+            if node is None:
+                raise NotFoundError(f"node {name!r} not found")
+            return node.clone()
+
+    def list_nodes(self, label_selector: str = "") -> list[Node]:
+        self._maybe_api_error("list_nodes")
+        match = parse_label_selector(label_selector)
+        with self._lock:
+            return [n.clone() for n in self._nodes.values()
+                    if match(n.metadata.labels)]
+
+    def _mutate_node(self, name: str) -> Node:
+        node = self._nodes.get(name)
+        if node is None:
+            raise NotFoundError(f"node {name!r} not found")
+        node.metadata.resource_version += 1
+        return node
+
+    def patch_node_labels(self, name: str,
+                          labels: Mapping[str, Optional[str]]) -> Node:
+        self._maybe_api_error("patch_node_labels")
+        with self._lock:
+            node = self._mutate_node(name)
+            for key, value in labels.items():
+                if value is None:
+                    node.metadata.labels.pop(key, None)
+                else:
+                    node.metadata.labels[key] = value
+            self._notify(MODIFIED, KIND_NODE, node)
+            return node.clone()
+
+    def patch_node_annotations(self, name: str,
+                               annotations: Mapping[str, Optional[str]]) -> Node:
+        self._maybe_api_error("patch_node_annotations")
+        with self._lock:
+            node = self._mutate_node(name)
+            for key, value in annotations.items():
+                if value is None:
+                    node.metadata.annotations.pop(key, None)
+                else:
+                    node.metadata.annotations[key] = value
+            self._notify(MODIFIED, KIND_NODE, node)
+            return node.clone()
+
+    def set_node_unschedulable(self, name: str, unschedulable: bool) -> Node:
+        self._maybe_api_error("set_node_unschedulable")
+        with self._lock:
+            node = self._mutate_node(name)
+            node.spec.unschedulable = unschedulable
+            self._notify(MODIFIED, KIND_NODE, node)
+            return node.clone()
+
+    def set_node_ready(self, name: str, ready: bool) -> Node:
+        """Test helper: flip the node Ready condition."""
+        with self._lock:
+            node = self._mutate_node(name)
+            for cond in node.status.conditions:
+                if cond.type == "Ready":
+                    cond.status = "True" if ready else "False"
+                    break
+            else:
+                from tpu_operator_libs.k8s.objects import NodeCondition
+                node.status.conditions.append(
+                    NodeCondition("Ready", "True" if ready else "False"))
+            self._notify(MODIFIED, KIND_NODE, node)
+            return node.clone()
+
+    # ------------------------------------------------------------------
+    # K8sClient: pods
+    # ------------------------------------------------------------------
+    def list_pods(self, namespace: Optional[str] = None,
+                  label_selector: str = "",
+                  field_selector: str = "") -> list[Pod]:
+        self._maybe_api_error("list_pods")
+        label_match = parse_label_selector(label_selector)
+        field_match = parse_field_selector(field_selector)
+        with self._lock:
+            out = []
+            for (ns, _), pod in self._pods.items():
+                if namespace is not None and namespace != "" and ns != namespace:
+                    continue
+                if not label_match(pod.metadata.labels):
+                    continue
+                if not field_match(pod.field_map()):
+                    continue
+                out.append(pod.clone())
+            return out
+
+    def get_pod(self, namespace: str, name: str) -> Pod:
+        self._maybe_api_error("get_pod")
+        with self._lock:
+            pod = self._pods.get((namespace, name))
+            if pod is None:
+                raise NotFoundError(f"pod {namespace}/{name} not found")
+            return pod.clone()
+
+    def set_pod_status(self, namespace: str, name: str,
+                       phase: Optional[PodPhase] = None,
+                       ready: Optional[bool] = None,
+                       restart_count: Optional[int] = None) -> Pod:
+        """Test helper: status subresource update (the builders in the
+        reference suite force Running+Ready the same way,
+        upgrade_suit_test.go:311-329)."""
+        with self._lock:
+            pod = self._pods.get((namespace, name))
+            if pod is None:
+                raise NotFoundError(f"pod {namespace}/{name} not found")
+            if phase is not None:
+                pod.status.phase = phase
+            if ready is not None or restart_count is not None:
+                if not pod.status.container_statuses:
+                    pod.status.container_statuses = [
+                        ContainerStatus(name="main")]
+            if ready is not None:
+                for c in pod.status.container_statuses:
+                    c.ready = ready
+            if restart_count is not None:
+                for c in pod.status.container_statuses:
+                    c.restart_count = restart_count
+            pod.metadata.resource_version += 1
+            self._notify(MODIFIED, KIND_POD, pod)
+            return pod.clone()
+
+    def delete_pod(self, namespace: str, name: str) -> None:
+        self._maybe_api_error("delete_pod")
+        with self._lock:
+            pod = self._pods.pop((namespace, name), None)
+            if pod is None:
+                raise NotFoundError(f"pod {namespace}/{name} not found")
+            self._notify(DELETED, KIND_POD, pod)
+            self._maybe_recreate_ds_pod(pod)
+
+    def evict_pod(self, namespace: str, name: str) -> None:
+        self._maybe_api_error("evict_pod")
+        with self._lock:
+            pod = self._pods.get((namespace, name))
+            if pod is None:
+                raise NotFoundError(f"pod {namespace}/{name} not found")
+            for blocker in self._eviction_blockers:
+                if blocker(pod):
+                    raise EvictionBlockedError(
+                        f"eviction of {namespace}/{name} blocked by "
+                        f"disruption budget")
+            del self._pods[(namespace, name)]
+            self._notify(DELETED, KIND_POD, pod)
+            self._maybe_recreate_ds_pod(pod)
+
+    def _maybe_recreate_ds_pod(self, pod: Pod) -> None:
+        """DS controller simulation: recreate a deleted DS-owned pod with the
+        newest revision hash (must be called with the lock held)."""
+        cfg = self._ds_controller
+        if cfg is None or not cfg.enabled:
+            return
+        owner = pod.controller_owner()
+        if owner is None or owner.kind != "DaemonSet":
+            return
+        ds_key = next((k for k, ds in self._daemon_sets.items()
+                       if ds.metadata.uid == owner.uid), None)
+        if ds_key is None:
+            return
+        namespace, ds_name = ds_key
+        node_name = pod.spec.node_name
+        recreate_due = self._clock.now() + cfg.recreate_delay
+
+        def recreate() -> None:
+            with self._lock:
+                ds = self._daemon_sets.get(ds_key)
+                if ds is None or node_name not in self._nodes:
+                    return
+                new_hash = self.latest_revision_hash(namespace, ds_name)
+                labels = dict(ds.spec.selector)
+                labels[POD_CONTROLLER_REVISION_HASH_LABEL] = new_hash
+                pod_name = f"{ds_name}-{node_name}-{new_uid('p')}"
+                new_pod = Pod(
+                    metadata=ObjectMeta(
+                        name=pod_name, namespace=namespace, labels=labels,
+                        owner_references=[OwnerReference(
+                            kind="DaemonSet", name=ds_name,
+                            uid=ds.metadata.uid)]),
+                    spec=PodSpec(node_name=node_name),
+                    status=PodStatus(
+                        phase=PodPhase.RUNNING,
+                        container_statuses=[
+                            ContainerStatus(name="runtime", ready=False)]))
+                self._pods[(namespace, pod_name)] = new_pod
+                self._notify(ADDED, KIND_POD, new_pod)
+
+                def make_ready(due: float) -> None:
+                    with self._lock:
+                        p = self._pods.get((namespace, pod_name))
+                        if p is None:
+                            return
+                        gate = self._pod_ready_gate
+                        if gate is not None and not gate(p):
+                            # crash-looping: stay not-ready, accumulate
+                            # restarts past the failure threshold, retry.
+                            # The retry is anchored to this action's OWN
+                            # due time (not clock.now()): step(until=T)
+                            # with a frozen clock must terminate, and
+                            # coarse step() calls must not skew timing.
+                            for c in p.status.container_statuses:
+                                c.ready = False
+                                c.restart_count = max(c.restart_count, 11)
+                            p.metadata.resource_version += 1
+                            self._notify(MODIFIED, KIND_POD, p)
+                            retry_due = due + 5.0
+                            self.schedule_at(
+                                retry_due, lambda: make_ready(retry_due))
+                            return
+                        for c in p.status.container_statuses:
+                            c.ready = True
+                            c.restart_count = 0
+                        p.metadata.resource_version += 1
+                        self._notify(MODIFIED, KIND_POD, p)
+
+                # Anchor readiness to the recreation's due time, not to
+                # whenever step() happened to execute the action, so coarse
+                # step() calls don't inflate pod-ready latencies.
+                ready_due = recreate_due + cfg.ready_delay
+                self.schedule_at(ready_due, lambda: make_ready(ready_due))
+
+        self.schedule_at(recreate_due, recreate)
+
+    # ------------------------------------------------------------------
+    # K8sClient: daemonsets & revisions
+    # ------------------------------------------------------------------
+    def list_daemon_sets(self, namespace: str,
+                         label_selector: str = "") -> list[DaemonSet]:
+        self._maybe_api_error("list_daemon_sets")
+        match = parse_label_selector(label_selector)
+        with self._lock:
+            return [ds.clone()
+                    for (ns, _), ds in self._daemon_sets.items()
+                    if ns == namespace and match(ds.metadata.labels)]
+
+    def list_controller_revisions(self, namespace: str,
+                                  label_selector: str = "") -> list[ControllerRevision]:
+        self._maybe_api_error("list_controller_revisions")
+        match = parse_label_selector(label_selector)
+        with self._lock:
+            return [rev.clone()
+                    for (ns, _), rev in self._revisions.items()
+                    if ns == namespace and match(rev.metadata.labels)]
+
+    # ------------------------------------------------------------------
+    # coordination.k8s.io Leases (leader-election lock objects)
+    # ------------------------------------------------------------------
+    def get_lease(self, namespace: str, name: str) -> Lease:
+        with self._lock:
+            lease = self._leases.get((namespace, name))
+            if lease is None:
+                raise NotFoundError(f"lease {namespace}/{name} not found")
+            return lease.clone()
+
+    def create_lease(self, lease: Lease) -> Lease:
+        key = (lease.metadata.namespace, lease.metadata.name)
+        with self._lock:
+            if key in self._leases:
+                raise AlreadyExistsError(
+                    f"lease {key[0]}/{key[1]} already exists")
+            stored = lease.clone()
+            stored.metadata.resource_version = 1
+            self._leases[key] = stored
+            return stored.clone()
+
+    def update_lease(self, lease: Lease) -> Lease:
+        """Replace with optimistic concurrency: the caller's
+        resourceVersion must match the stored one or ConflictError is
+        raised — exactly the apiserver contract leader election's
+        acquire race depends on."""
+        key = (lease.metadata.namespace, lease.metadata.name)
+        with self._lock:
+            stored = self._leases.get(key)
+            if stored is None:
+                raise NotFoundError(f"lease {key[0]}/{key[1]} not found")
+            if lease.metadata.resource_version \
+                    != stored.metadata.resource_version:
+                raise ConflictError(
+                    f"lease {key[0]}/{key[1]}: resourceVersion "
+                    f"{lease.metadata.resource_version} != "
+                    f"{stored.metadata.resource_version}")
+            updated = lease.clone()
+            updated.metadata.resource_version = (
+                stored.metadata.resource_version + 1)
+            self._leases[key] = updated
+            return updated.clone()

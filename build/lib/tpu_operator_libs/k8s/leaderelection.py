@@ -1,0 +1,281 @@
+"""Lease-based leader election for HA operator deployments.
+
+The reference library runs inside a controller-runtime manager, which
+provides leader election out of the box (the consumer enables it with
+``LeaderElection: true`` — SURVEY.md §1 L5); a complete operator stack must
+own the equivalent. This is a re-design of client-go's
+``tools/leaderelection`` + ``resourcelock`` pair on coordination.k8s.io/v1
+Leases:
+
+- :class:`LeaseLockClient` is the narrow resource-lock protocol
+  (``resourcelock.Interface`` analogue). FakeCluster and RealCluster both
+  satisfy it; it is deliberately NOT part of :class:`K8sClient` — leader
+  election is an optional, separate concern, as it is upstream.
+- :class:`LeaderElector` implements acquire/renew with the same
+  observed-time expiry rule as client-go: a lease is considered expired
+  ``lease_duration`` after *this process last observed the record change*,
+  not after the renew timestamp inside the record — so wall-clock skew
+  between contenders never causes double-leadership.
+
+Unlike the upstream loop, the decision step
+(:meth:`LeaderElector.try_acquire_or_renew`) is a pure, non-blocking state
+transition driven by the injectable Clock, so tests (and the rolling-upgrade
+simulator) exercise election races deterministically; :meth:`run` is the
+thin blocking driver for production.
+"""
+
+from __future__ import annotations
+
+import logging
+import threading
+from dataclasses import dataclass
+from typing import Callable, Optional, Protocol
+
+from tpu_operator_libs.k8s.client import (
+    AlreadyExistsError,
+    ConflictError,
+    NotFoundError,
+)
+from tpu_operator_libs.k8s.objects import Lease, ObjectMeta
+from tpu_operator_libs.util import Clock
+
+logger = logging.getLogger(__name__)
+
+# client-go defaults (leaderelection.go): LeaseDuration 15s,
+# RenewDeadline 10s, RetryPeriod 2s.
+DEFAULT_LEASE_DURATION = 15.0
+DEFAULT_RENEW_DEADLINE = 10.0
+DEFAULT_RETRY_PERIOD = 2.0
+
+
+class LeaseLockClient(Protocol):
+    """The three operations leader election needs from the cluster."""
+
+    def get_lease(self, namespace: str, name: str) -> Lease: ...
+
+    def create_lease(self, lease: Lease) -> Lease: ...
+
+    def update_lease(self, lease: Lease) -> Lease: ...
+
+
+@dataclass
+class LeaderElectionConfig:
+    namespace: str
+    name: str
+    identity: str
+    lease_duration: float = DEFAULT_LEASE_DURATION
+    renew_deadline: float = DEFAULT_RENEW_DEADLINE
+    retry_period: float = DEFAULT_RETRY_PERIOD
+    # Upstream's ReleaseOnCancel: on a clean stop, write holder="" so the
+    # next contender doesn't wait out the lease.
+    release_on_stop: bool = True
+
+    def __post_init__(self) -> None:
+        if self.lease_duration <= self.renew_deadline:
+            raise ValueError("lease_duration must exceed renew_deadline")
+        if self.renew_deadline <= self.retry_period:
+            raise ValueError("renew_deadline must exceed retry_period")
+        if not self.identity:
+            raise ValueError("identity must be non-empty")
+
+
+class LeaderElector:
+    """One contender for a named Lease.
+
+    Callbacks (all optional, invoked from the thread driving the elector):
+
+    - ``on_started_leading()`` — acquired the lease.
+    - ``on_stopped_leading()`` — lost or released it. Always follows a
+      prior ``on_started_leading``.
+    - ``on_new_leader(identity)`` — observed leadership change, including
+      ourselves; fired once per distinct holder.
+    """
+
+    def __init__(self, client: LeaseLockClient,
+                 config: LeaderElectionConfig,
+                 clock: Optional[Clock] = None,
+                 on_started_leading: Optional[Callable[[], None]] = None,
+                 on_stopped_leading: Optional[Callable[[], None]] = None,
+                 on_new_leader: Optional[Callable[[str], None]] = None) -> None:
+        self._client = client
+        self._config = config
+        self._clock = clock or Clock()
+        self._on_started_leading = on_started_leading
+        self._on_stopped_leading = on_stopped_leading
+        self._on_new_leader = on_new_leader
+        self._leading = False
+        # Local observation of the remote record: expiry is judged from
+        # _observed_at (when *we* saw it change), never from the record's
+        # own renew_time — clock-skew tolerance, as upstream.
+        self._observed: Optional[Lease] = None
+        self._observed_at = 0.0
+        self._last_reported_leader: Optional[str] = None
+        self._last_renew_success = 0.0
+
+    # -- inspection --------------------------------------------------------
+    @property
+    def is_leader(self) -> bool:
+        return self._leading
+
+    @property
+    def observed_leader(self) -> str:
+        return self._observed.holder_identity if self._observed else ""
+
+    # -- the decision step -------------------------------------------------
+    def try_acquire_or_renew(self) -> bool:
+        """One acquire-or-renew attempt; returns True iff this attempt
+        SUCCEEDED (we wrote the lease). Non-blocking and idempotent
+        (leaderelection.go tryAcquireOrRenew).
+
+        Transient failures (apiserver error, write conflict, lost create
+        race) return False WITHOUT dropping leadership: ``run`` keeps a
+        current leader through outages until ``renew_deadline`` — the same
+        grace client-go gives. Only the definitive observation of another
+        live holder steps us down immediately.
+        """
+        config = self._config
+        now = self._clock.now()
+        try:
+            current = self._client.get_lease(config.namespace, config.name)
+        except NotFoundError:
+            fresh = Lease(
+                metadata=ObjectMeta(name=config.name,
+                                    namespace=config.namespace),
+                holder_identity=config.identity,
+                lease_duration_seconds=int(config.lease_duration),
+                acquire_time=now, renew_time=now, lease_transitions=0)
+            try:
+                created = self._client.create_lease(fresh)
+            except AlreadyExistsError:
+                return False  # lost the create race; observe next tick
+            except Exception:
+                logger.warning("leader election: create %s/%s failed",
+                               config.namespace, config.name, exc_info=True)
+                return False
+            self._observe(created, now)
+            self._set_leading(True)
+            return True
+        except Exception:
+            logger.warning("leader election: get %s/%s failed",
+                           config.namespace, config.name, exc_info=True)
+            return False
+
+        if self._record_changed(current):
+            self._observe(current, now)
+        holder = current.holder_identity
+        # Expiry honors the HOLDER's advertised duration from the record
+        # (that is why the field is stored in the lease at all) — judging
+        # by our own config would let a short-configured follower depose a
+        # long-configured leader mid-outage (client-go parity).
+        holder_duration = (self._observed.lease_duration_seconds
+                           if self._observed
+                           and self._observed.lease_duration_seconds > 0
+                           else config.lease_duration)
+        expired = self._observed_at + holder_duration <= now
+        if holder and holder != config.identity and not expired:
+            self._set_leading(False)  # held by a live leader
+            return False
+
+        # Our lease (renew), expired (take over) or released (holder "").
+        updated = current.clone()
+        updated.holder_identity = config.identity
+        updated.lease_duration_seconds = int(config.lease_duration)
+        updated.renew_time = now
+        if holder != config.identity:
+            updated.acquire_time = now
+            updated.lease_transitions = current.lease_transitions + 1
+        try:
+            stored = self._client.update_lease(updated)
+        except ConflictError:
+            return False  # someone else moved it; re-observe next tick
+        except Exception:
+            logger.warning("leader election: update %s/%s failed",
+                           config.namespace, config.name, exc_info=True)
+            return False
+        self._observe(stored, now)
+        self._set_leading(True)
+        return True
+
+    # -- the blocking driver -------------------------------------------------
+    def run(self, stop: Optional[threading.Event] = None) -> None:
+        """Acquire, then renew until leadership is lost or ``stop`` is set.
+        Returns after ``on_stopped_leading`` (if we ever led)."""
+        stop = stop or threading.Event()
+        config = self._config
+        try:
+            while not stop.is_set():
+                if self.try_acquire_or_renew():
+                    self._last_renew_success = self._clock.now()
+                    break
+                self._clock.sleep(config.retry_period)
+            if stop.is_set():
+                return
+            logger.info("leader election: %s acquired %s/%s",
+                        config.identity, config.namespace, config.name)
+            while not stop.is_set():
+                self._clock.sleep(config.retry_period)
+                if stop.is_set():
+                    break
+                if self.try_acquire_or_renew():
+                    self._last_renew_success = self._clock.now()
+                elif not self._leading:
+                    # another contender holds a live lease: definitive loss
+                    # (on_stopped_leading already fired); no deadline grace
+                    logger.info(
+                        "leader election: %s lost %s/%s to %s",
+                        config.identity, config.namespace, config.name,
+                        self.observed_leader)
+                    return
+                elif (self._clock.now() - self._last_renew_success
+                        >= config.renew_deadline):
+                    logger.warning(
+                        "leader election: %s failed to renew %s/%s within "
+                        "%.0fs; stepping down", config.identity,
+                        config.namespace, config.name, config.renew_deadline)
+                    self._set_leading(False)
+                    return
+        finally:
+            if self._leading:
+                if config.release_on_stop:
+                    self.release()
+                self._set_leading(False)
+
+    def release(self) -> bool:
+        """Write holder="" so successors need not wait out the lease."""
+        if not self._leading or self._observed is None:
+            return False
+        released = self._observed.clone()
+        released.holder_identity = ""
+        released.renew_time = self._clock.now()
+        try:
+            stored = self._client.update_lease(released)
+        except (ConflictError, NotFoundError):
+            return False
+        self._observe(stored, self._clock.now())
+        return True
+
+    # -- internals -----------------------------------------------------------
+    def _record_changed(self, current: Lease) -> bool:
+        return (self._observed is None
+                or current.metadata.resource_version
+                != self._observed.metadata.resource_version)
+
+    def _observe(self, lease: Lease, now: float) -> None:
+        self._observed = lease.clone()
+        self._observed_at = now
+        holder = lease.holder_identity
+        if holder and holder != self._last_reported_leader:
+            self._last_reported_leader = holder
+            if self._on_new_leader is not None:
+                self._on_new_leader(holder)
+
+    def _set_leading(self, leading: bool) -> bool:
+        if leading and not self._leading:
+            self._leading = True
+            if self._on_started_leading is not None:
+                self._on_started_leading()
+        elif not leading and self._leading:
+            self._leading = False
+            if self._on_stopped_leading is not None:
+                self._on_stopped_leading()
+        return self._leading

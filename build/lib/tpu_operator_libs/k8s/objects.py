@@ -1,0 +1,327 @@
+"""Typed Kubernetes object model (the slice of the API the library needs).
+
+The reference consumes corev1.Node / corev1.Pod / appsv1.DaemonSet /
+appsv1.ControllerRevision through client-go. This module models exactly the
+fields the upgrade flow reads or writes — nothing more:
+
+- Node: labels, annotations, spec.unschedulable, Ready condition
+  (upgrade_state.go:980-993).
+- Pod: labels, owner references, spec.nodeName, phase, container statuses
+  (readiness + restart counts, upgrade_state.go:936-978), deletion timestamp
+  (upgrade_state.go:779), emptyDir volume usage (drain filters).
+- DaemonSet: selector labels + desired scheduled count
+  (upgrade_state.go:243-246).
+- ControllerRevision: name + monotonically increasing revision number, for
+  the "is this pod running the newest template" oracle
+  (pod_manager.go:95-121).
+"""
+
+from __future__ import annotations
+
+import enum
+import itertools
+import threading
+from dataclasses import dataclass, field
+from typing import Optional
+
+_uid_counter = itertools.count(1)
+_uid_lock = threading.Lock()
+
+
+def new_uid(prefix: str = "uid") -> str:
+    with _uid_lock:
+        return f"{prefix}-{next(_uid_counter)}"
+
+
+@dataclass
+class ObjectMeta:
+    name: str = ""
+    namespace: str = ""
+    uid: str = ""
+    labels: dict[str, str] = field(default_factory=dict)
+    annotations: dict[str, str] = field(default_factory=dict)
+    owner_references: list["OwnerReference"] = field(default_factory=list)
+    deletion_timestamp: Optional[float] = None
+    resource_version: int = 0
+
+    def __post_init__(self) -> None:
+        if not self.uid:
+            self.uid = new_uid(self.name or "obj")
+
+    def clone(self) -> "ObjectMeta":
+        """Field-wise copy. The fake API server returns copies on every
+        read (value semantics, like objects off the wire); the generic
+        copy.deepcopy dominated simulation profiles, so cloning is
+        hand-rolled over the known fields."""
+        return ObjectMeta(
+            name=self.name, namespace=self.namespace, uid=self.uid,
+            labels=dict(self.labels), annotations=dict(self.annotations),
+            owner_references=[OwnerReference(r.kind, r.name, r.uid,
+                                             r.controller)
+                              for r in self.owner_references],
+            deletion_timestamp=self.deletion_timestamp,
+            resource_version=self.resource_version)
+
+
+@dataclass
+class OwnerReference:
+    kind: str
+    name: str
+    uid: str
+    controller: bool = True
+
+
+class PodPhase(str, enum.Enum):
+    PENDING = "Pending"
+    RUNNING = "Running"
+    SUCCEEDED = "Succeeded"
+    FAILED = "Failed"
+    # Reported when the kubelet is unreachable — exactly the condition a
+    # fleet upgrade provokes; parsing must not crash on it.
+    UNKNOWN = "Unknown"
+
+    def __str__(self) -> str:
+        return self.value
+
+
+@dataclass
+class ContainerStatus:
+    name: str
+    ready: bool = False
+    restart_count: int = 0
+
+
+@dataclass
+class NodeCondition:
+    type: str
+    status: str  # "True" / "False" / "Unknown"
+
+
+@dataclass
+class NodeSpec:
+    unschedulable: bool = False
+
+
+@dataclass
+class NodeStatus:
+    conditions: list[NodeCondition] = field(
+        default_factory=lambda: [NodeCondition("Ready", "True")])
+
+
+@dataclass
+class Node:
+    metadata: ObjectMeta
+    spec: NodeSpec = field(default_factory=NodeSpec)
+    status: NodeStatus = field(default_factory=NodeStatus)
+
+    @property
+    def name(self) -> str:
+        return self.metadata.name
+
+    def is_unschedulable(self) -> bool:
+        """True if the node is cordoned (upgrade_state.go:980-983)."""
+        return self.spec.unschedulable
+
+    def is_ready(self) -> bool:
+        """True unless an explicit Ready condition is not "True"
+        (upgrade_state.go:985-993)."""
+        for cond in self.status.conditions:
+            if cond.type == "Ready" and cond.status != "True":
+                return False
+        return True
+
+    def clone(self) -> "Node":
+        return Node(
+            metadata=self.metadata.clone(),
+            spec=NodeSpec(unschedulable=self.spec.unschedulable),
+            status=NodeStatus(conditions=[
+                NodeCondition(c.type, c.status)
+                for c in self.status.conditions]))
+
+
+@dataclass
+class Volume:
+    name: str
+    empty_dir: bool = False
+
+
+@dataclass
+class PodSpec:
+    node_name: str = ""
+    volumes: list[Volume] = field(default_factory=list)
+
+
+@dataclass
+class PodStatus:
+    phase: PodPhase = PodPhase.PENDING
+    container_statuses: list[ContainerStatus] = field(default_factory=list)
+    init_container_statuses: list[ContainerStatus] = field(default_factory=list)
+
+
+@dataclass
+class Pod:
+    metadata: ObjectMeta
+    spec: PodSpec = field(default_factory=PodSpec)
+    status: PodStatus = field(default_factory=PodStatus)
+
+    @property
+    def name(self) -> str:
+        return self.metadata.name
+
+    @property
+    def namespace(self) -> str:
+        return self.metadata.namespace
+
+    def controller_owner(self) -> Optional[OwnerReference]:
+        for ref in self.metadata.owner_references:
+            if ref.controller:
+                return ref
+        if self.metadata.owner_references:
+            return self.metadata.owner_references[0]
+        return None
+
+    def is_orphaned(self) -> bool:
+        """Pod with no owner references — never auto-upgraded because its
+        revision hash cannot be compared (upgrade_state.go:353-355)."""
+        return not self.metadata.owner_references
+
+    def is_ready(self) -> bool:
+        """Running with at least one container and all containers ready
+        (mirrors isDriverPodInSync's readiness arm and the validation
+        manager's isPodReady, upgrade_state.go:947-960,
+        validation_manager.go:118-136)."""
+        if self.status.phase != PodPhase.RUNNING:
+            return False
+        if not self.status.container_statuses:
+            return False
+        return all(c.ready for c in self.status.container_statuses)
+
+    def is_failing(self, restart_threshold: int = 10) -> bool:
+        """A not-ready container restarted more than ``restart_threshold``
+        times (upgrade_state.go:966-978)."""
+        for status in (self.status.init_container_statuses
+                       + self.status.container_statuses):
+            if not status.ready and status.restart_count > restart_threshold:
+                return True
+        return False
+
+    def uses_empty_dir(self) -> bool:
+        return any(v.empty_dir for v in self.spec.volumes)
+
+    def is_daemonset_pod(self) -> bool:
+        owner = self.controller_owner()
+        return owner is not None and owner.kind == "DaemonSet"
+
+    def is_mirror_pod(self) -> bool:
+        return "kubernetes.io/config.mirror" in self.metadata.annotations
+
+    def field_map(self) -> dict[str, str]:
+        """The pod's field-selector-addressable fields (the subset the
+        apiserver supports for pods; shared by every client backend so
+        field-selector semantics cannot drift between fake and cache)."""
+        return {
+            "metadata.name": self.metadata.name,
+            "metadata.namespace": self.metadata.namespace,
+            "spec.nodeName": self.spec.node_name,
+            "status.phase": str(self.status.phase),
+        }
+
+    def clone(self) -> "Pod":
+        return Pod(
+            metadata=self.metadata.clone(),
+            spec=PodSpec(node_name=self.spec.node_name,
+                         volumes=[Volume(v.name, v.empty_dir)
+                                  for v in self.spec.volumes]),
+            status=PodStatus(
+                phase=self.status.phase,
+                container_statuses=[
+                    ContainerStatus(c.name, c.ready, c.restart_count)
+                    for c in self.status.container_statuses],
+                init_container_statuses=[
+                    ContainerStatus(c.name, c.ready, c.restart_count)
+                    for c in self.status.init_container_statuses]))
+
+
+@dataclass
+class DaemonSetSpec:
+    selector: dict[str, str] = field(default_factory=dict)
+    # Opaque identifier of the current pod template; bumping it models a
+    # rollout (the fake cluster turns it into a new ControllerRevision).
+    template_generation: int = 1
+
+
+@dataclass
+class DaemonSetStatus:
+    desired_number_scheduled: int = 0
+
+
+@dataclass
+class DaemonSet:
+    metadata: ObjectMeta
+    spec: DaemonSetSpec = field(default_factory=DaemonSetSpec)
+    status: DaemonSetStatus = field(default_factory=DaemonSetStatus)
+
+    @property
+    def name(self) -> str:
+        return self.metadata.name
+
+    @property
+    def namespace(self) -> str:
+        return self.metadata.namespace
+
+    def clone(self) -> "DaemonSet":
+        return DaemonSet(
+            metadata=self.metadata.clone(),
+            spec=DaemonSetSpec(
+                selector=dict(self.spec.selector),
+                template_generation=self.spec.template_generation),
+            status=DaemonSetStatus(
+                desired_number_scheduled=self.status.desired_number_scheduled))
+
+
+@dataclass
+class ControllerRevision:
+    metadata: ObjectMeta
+    revision: int = 1
+
+    @property
+    def name(self) -> str:
+        return self.metadata.name
+
+    @property
+    def hash(self) -> str:
+        """The revision hash is the name suffix after '<ds-name>-'
+        (pod_manager.go:118-119). Controller-generated hashes never contain
+        hyphens (FakeCluster enforces this for injected hashes), so the last
+        segment is always the full hash."""
+        return self.metadata.name.rsplit("-", 1)[-1]
+
+    def clone(self) -> "ControllerRevision":
+        return ControllerRevision(metadata=self.metadata.clone(),
+                                  revision=self.revision)
+
+
+@dataclass
+class Lease:
+    """A coordination.k8s.io/v1 Lease, the leader-election lock object.
+
+    The reference library leaves leader election to its consumer's
+    controller-runtime manager; a complete TPU operator stack must own it
+    (see k8s/leaderelection.py). Times are epoch seconds (spec.acquireTime /
+    spec.renewTime MicroTime equivalents).
+    """
+
+    metadata: ObjectMeta
+    holder_identity: str = ""
+    lease_duration_seconds: int = 15
+    acquire_time: Optional[float] = None
+    renew_time: Optional[float] = None
+    lease_transitions: int = 0
+
+    def clone(self) -> "Lease":
+        return Lease(metadata=self.metadata.clone(),
+                     holder_identity=self.holder_identity,
+                     lease_duration_seconds=self.lease_duration_seconds,
+                     acquire_time=self.acquire_time,
+                     renew_time=self.renew_time,
+                     lease_transitions=self.lease_transitions)

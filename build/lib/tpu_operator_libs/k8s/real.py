@@ -1,0 +1,463 @@
+"""Live-cluster adapter backed by the official ``kubernetes`` Python client.
+
+Fills the role of the reference's client-go/clientset pair
+(upgrade_state.go:127-132) for real GKE clusters. Import-gated: the
+``kubernetes`` package is an optional dependency — everything else in this
+library (tests, simulation, bench) runs without it, and constructing
+:class:`RealCluster` without the package raises a clear error.
+
+Mapping to API calls:
+
+- nodes: ``CoreV1Api.read_node`` / ``list_node`` / ``patch_node``
+  (merge-patch with ``None`` values deleting keys, the same semantics the
+  reference's raw patches rely on, node_upgrade_state_provider.go:147-151)
+- pods: ``list_pod_for_all_namespaces`` / ``list_namespaced_pod`` with
+  label+field selectors; ``delete_namespaced_pod``;
+  ``create_namespaced_pod_eviction`` for the eviction subresource
+- daemonsets/revisions: ``AppsV1Api.list_namespaced_daemon_set`` /
+  ``list_namespaced_controller_revision``
+"""
+
+from __future__ import annotations
+
+from typing import Mapping, Optional
+
+from tpu_operator_libs.k8s.client import (
+    AlreadyExistsError,
+    ApiServerError,
+    ConflictError,
+    EvictionBlockedError,
+    K8sClient,
+    NotFoundError,
+)
+from tpu_operator_libs.k8s.objects import (
+    ContainerStatus,
+    ControllerRevision,
+    DaemonSet,
+    DaemonSetSpec,
+    DaemonSetStatus,
+    Lease,
+    Node,
+    NodeCondition,
+    NodeSpec,
+    NodeStatus,
+    ObjectMeta,
+    OwnerReference,
+    Pod,
+    PodPhase,
+    PodSpec,
+    PodStatus,
+    Volume,
+)
+
+
+def _require_kubernetes():
+    try:
+        import kubernetes  # noqa: F401
+        from kubernetes import client as k8s_client
+        return k8s_client
+    except ImportError as exc:  # pragma: no cover - exercised via test stub
+        raise ImportError(
+            "the 'kubernetes' package is required for RealCluster; "
+            "install it in the operator image (everything else in "
+            "tpu_operator_libs works without it)") from exc
+
+
+def _meta_from(obj) -> ObjectMeta:
+    meta = obj.metadata
+    owners = []
+    for ref in (getattr(meta, "owner_references", None) or []):
+        owners.append(OwnerReference(
+            kind=ref.kind, name=ref.name, uid=ref.uid,
+            controller=bool(getattr(ref, "controller", False))))
+    ts = getattr(meta, "deletion_timestamp", None)
+    return ObjectMeta(
+        name=meta.name,
+        namespace=meta.namespace or "",
+        uid=meta.uid or "",
+        labels=dict(meta.labels or {}),
+        annotations=dict(meta.annotations or {}),
+        owner_references=owners,
+        deletion_timestamp=ts.timestamp() if ts is not None else None)
+
+
+def _node_from(obj) -> Node:
+    conditions = [NodeCondition(type=c.type, status=c.status)
+                  for c in (obj.status.conditions or [])]
+    return Node(
+        metadata=_meta_from(obj),
+        spec=NodeSpec(unschedulable=bool(obj.spec.unschedulable)),
+        status=NodeStatus(conditions=conditions
+                          or [NodeCondition("Ready", "True")]))
+
+
+def _container_statuses(statuses) -> list[ContainerStatus]:
+    return [ContainerStatus(name=s.name, ready=bool(s.ready),
+                            restart_count=int(s.restart_count or 0))
+            for s in (statuses or [])]
+
+
+def _pod_from(obj) -> Pod:
+    volumes = []
+    for v in (obj.spec.volumes or []):
+        volumes.append(Volume(
+            name=v.name, empty_dir=getattr(v, "empty_dir", None) is not None))
+    phase = obj.status.phase or "Pending"
+    return Pod(
+        metadata=_meta_from(obj),
+        spec=PodSpec(node_name=obj.spec.node_name or "", volumes=volumes),
+        status=PodStatus(
+            phase=PodPhase(phase),
+            container_statuses=_container_statuses(
+                obj.status.container_statuses),
+            init_container_statuses=_container_statuses(
+                obj.status.init_container_statuses)))
+
+
+def _daemon_set_from(obj) -> DaemonSet:
+    selector = dict((obj.spec.selector.match_labels or {})
+                    if obj.spec.selector else {})
+    return DaemonSet(
+        metadata=_meta_from(obj),
+        spec=DaemonSetSpec(selector=selector),
+        status=DaemonSetStatus(
+            desired_number_scheduled=int(
+                obj.status.desired_number_scheduled or 0)))
+
+
+def _revision_from(obj) -> ControllerRevision:
+    return ControllerRevision(metadata=_meta_from(obj),
+                              revision=int(obj.revision))
+
+
+class RealCluster(K8sClient):
+    """K8sClient against a live API server."""
+
+    def __init__(self, api_client=None) -> None:
+        k8s = _require_kubernetes()
+        self._core = k8s.CoreV1Api(api_client)
+        self._apps = k8s.AppsV1Api(api_client)
+        self._coordination = k8s.CoordinationV1Api(api_client)
+        self._k8s = k8s
+        # last-seen raw V1ObjectMeta per lease lock (see lease section)
+        self._lease_raw_meta: dict = {}
+
+    @classmethod
+    def from_kubeconfig(cls, context: Optional[str] = None) -> "RealCluster":
+        _require_kubernetes()
+        from kubernetes import config
+
+        config.load_kube_config(context=context)
+        return cls()
+
+    @classmethod
+    def in_cluster(cls) -> "RealCluster":
+        _require_kubernetes()
+        from kubernetes import config
+
+        config.load_incluster_config()
+        return cls()
+
+    # -- error translation -------------------------------------------------
+    def _translate(self, exc, eviction: bool = False):
+        status = getattr(exc, "status", None)
+        if status == 404:
+            return NotFoundError(str(exc))
+        # 429 means "blocked by a PodDisruptionBudget" ONLY on the eviction
+        # subresource; everywhere else it is apiserver rate limiting and
+        # must surface as-is (callers back off and retry).
+        if status == 429 and eviction:
+            return EvictionBlockedError(str(exc))
+        if status == 409:
+            return ConflictError(str(exc))
+        # 5xx: retryable apiserver failure — typed so the drain/eviction
+        # workers defer (retry next reconcile) instead of consuming the
+        # node's failure budget on a hiccup.
+        if status is not None and 500 <= status < 600:
+            return ApiServerError(str(exc))
+        return exc
+
+    # -- nodes -------------------------------------------------------------
+    def get_node(self, name: str) -> Node:
+        try:
+            return _node_from(self._core.read_node(name))
+        except self._k8s.ApiException as exc:
+            raise self._translate(exc) from exc
+
+    def list_nodes(self, label_selector: str = "") -> list[Node]:
+        result = self._core.list_node(label_selector=label_selector or None)
+        return [_node_from(item) for item in result.items]
+
+    def patch_node_labels(self, name: str,
+                          labels: Mapping[str, Optional[str]]) -> Node:
+        body = {"metadata": {"labels": dict(labels)}}
+        try:
+            return _node_from(self._core.patch_node(name, body))
+        except self._k8s.ApiException as exc:
+            raise self._translate(exc) from exc
+
+    def patch_node_annotations(self, name: str,
+                               annotations: Mapping[str, Optional[str]]) -> Node:
+        body = {"metadata": {"annotations": dict(annotations)}}
+        try:
+            return _node_from(self._core.patch_node(name, body))
+        except self._k8s.ApiException as exc:
+            raise self._translate(exc) from exc
+
+    def set_node_unschedulable(self, name: str, unschedulable: bool) -> Node:
+        body = {"spec": {"unschedulable": unschedulable}}
+        try:
+            return _node_from(self._core.patch_node(name, body))
+        except self._k8s.ApiException as exc:
+            raise self._translate(exc) from exc
+
+    # -- pods --------------------------------------------------------------
+    def list_pods(self, namespace: Optional[str] = None,
+                  label_selector: str = "",
+                  field_selector: str = "") -> list[Pod]:
+        kwargs = {"label_selector": label_selector or None,
+                  "field_selector": field_selector or None}
+        if namespace:
+            result = self._core.list_namespaced_pod(namespace, **kwargs)
+        else:
+            result = self._core.list_pod_for_all_namespaces(**kwargs)
+        return [_pod_from(item) for item in result.items]
+
+    def delete_pod(self, namespace: str, name: str) -> None:
+        try:
+            self._core.delete_namespaced_pod(name, namespace)
+        except self._k8s.ApiException as exc:
+            raise self._translate(exc) from exc
+
+    def evict_pod(self, namespace: str, name: str) -> None:
+        eviction = self._k8s.V1Eviction(
+            metadata=self._k8s.V1ObjectMeta(name=name, namespace=namespace))
+        try:
+            self._core.create_namespaced_pod_eviction(
+                name, namespace, eviction)
+        except self._k8s.ApiException as exc:
+            raise self._translate(exc, eviction=True) from exc
+
+    # -- watches -------------------------------------------------------------
+    def watch(self, kinds: Optional[set[str]] = None,
+              namespace: Optional[str] = None) -> "watch_mod.Watch":
+        """Stream Node/Pod/DaemonSet change events as
+        :class:`tpu_operator_libs.k8s.watch.WatchEvent`, for driving a
+        :class:`tpu_operator_libs.controller.Controller` (the live
+        equivalent of FakeCluster.watch). One pump thread per kind;
+        expired server watches are transparently restarted, which may
+        re-deliver the current object set as ADDED events — harmless to a
+        level-triggered reconcile."""
+        import threading
+
+        from tpu_operator_libs.k8s import watch as watch_mod
+
+        wanted = kinds or {watch_mod.KIND_NODE, watch_mod.KIND_POD,
+                           watch_mod.KIND_DAEMON_SET}
+        # stop() must actually terminate the pump threads: track each
+        # pump's live kubernetes stream and stop them all on sub.stop(),
+        # releasing the HTTP watch connections (client-go Stop parity).
+        streams_lock = threading.Lock()
+        active_streams: list = []
+
+        def on_stop(_watch) -> None:
+            with streams_lock:
+                streams = list(active_streams)
+            for stream in streams:
+                try:
+                    stream.stop()
+                except Exception:
+                    pass
+
+        sub = watch_mod.Watch(on_stop=on_stop)
+        sources = []
+        if watch_mod.KIND_NODE in wanted:
+            sources.append((watch_mod.KIND_NODE, self._core.list_node, {},
+                            _node_from))
+        if watch_mod.KIND_POD in wanted:
+            if namespace:
+                sources.append((watch_mod.KIND_POD,
+                                self._core.list_namespaced_pod,
+                                {"namespace": namespace}, _pod_from))
+            else:
+                sources.append((watch_mod.KIND_POD,
+                                self._core.list_pod_for_all_namespaces, {},
+                                _pod_from))
+        if watch_mod.KIND_DAEMON_SET in wanted:
+            if namespace:
+                sources.append((watch_mod.KIND_DAEMON_SET,
+                                self._apps.list_namespaced_daemon_set,
+                                {"namespace": namespace}, _daemon_set_from))
+            else:
+                sources.append((watch_mod.KIND_DAEMON_SET,
+                                self._apps.list_daemon_set_for_all_namespaces,
+                                {}, _daemon_set_from))
+
+        def pump(kind, list_fn, kwargs, convert):
+            import logging
+            import time as time_mod
+
+            from kubernetes import watch as k8s_watch
+
+            log = logging.getLogger(__name__)
+            backoff = 0.5
+            while not sub.stopped:
+                stream = k8s_watch.Watch()
+                with streams_lock:
+                    active_streams.append(stream)
+                if sub.stopped:
+                    # sub.stop() may have snapshotted active_streams just
+                    # before the append; re-check so this stream never
+                    # opens a connection nothing will stop
+                    with streams_lock:
+                        active_streams.remove(stream)
+                    return
+                delivered = False
+                try:
+                    # timeout_seconds bounds how long a quiet stream blocks
+                    # so a stop() is honored promptly even mid-connect
+                    for raw in stream.stream(list_fn,
+                                             timeout_seconds=300,
+                                             **kwargs):
+                        if sub.stopped:
+                            return
+                        event_type = raw["type"]
+                        if event_type not in (watch_mod.ADDED,
+                                              watch_mod.MODIFIED,
+                                              watch_mod.DELETED):
+                            continue  # BOOKMARK / ERROR
+                        sub._deliver(watch_mod.WatchEvent(
+                            event_type, kind, convert(raw["object"])))
+                        delivered = True
+                        backoff = 0.5
+                except Exception:
+                    if sub.stopped:
+                        return
+                    # Persistent failures (RBAC, bad namespace) would
+                    # otherwise hot-loop list+watch against the API
+                    # server; back off and say why.
+                    log.warning("%s watch failed; restarting in %.1fs",
+                                kind, backoff, exc_info=True)
+                    time_mod.sleep(backoff)
+                    backoff = min(backoff * 2, 30.0)
+                    continue
+                finally:
+                    stream.stop()
+                    with streams_lock:
+                        if stream in active_streams:
+                            active_streams.remove(stream)
+                if not delivered:
+                    # clean-but-empty expiry loop: avoid a tight relist
+                    time_mod.sleep(min(backoff, 1.0))
+
+        for kind, list_fn, kwargs, convert in sources:
+            threading.Thread(target=pump, name=f"watch-{kind}",
+                             args=(kind, list_fn, kwargs, convert),
+                             daemon=True).start()
+        return sub
+
+    # -- daemonsets & revisions ---------------------------------------------
+    def list_daemon_sets(self, namespace: str,
+                         label_selector: str = "") -> list[DaemonSet]:
+        result = self._apps.list_namespaced_daemon_set(
+            namespace, label_selector=label_selector or None)
+        return [_daemon_set_from(item) for item in result.items]
+
+    def list_controller_revisions(self, namespace: str,
+                                  label_selector: str = "") -> list[ControllerRevision]:
+        result = self._apps.list_namespaced_controller_revision(
+            namespace, label_selector=label_selector or None)
+        return [_revision_from(item) for item in result.items]
+
+    # -- leases (coordination.k8s.io, leader election) -----------------------
+    # resourceVersion is opaque on the wire; it is carried through
+    # ObjectMeta.resource_version verbatim (the elector only compares and
+    # round-trips it, fake.py uses ints, the real server strings).
+    # The raw wire metadata of the last-seen lease is cached per lock so
+    # renews replace with the object's FULL metadata (labels, annotations,
+    # ownerReferences for GC) rather than a reconstructed minimal one —
+    # client-go's LeaseLock mutates the Get result for the same reason.
+    @staticmethod
+    def _lease_from(obj) -> Lease:
+        meta = ObjectMeta(
+            name=obj.metadata.name,
+            namespace=obj.metadata.namespace or "",
+            uid=obj.metadata.uid or "")
+        meta.resource_version = obj.metadata.resource_version
+        spec = getattr(obj, "spec", None)
+        if spec is None:
+            # a pre-created bare Lease manifest has no spec: an unheld lock
+            return Lease(metadata=meta)
+        acquire = getattr(spec, "acquire_time", None)
+        renew = getattr(spec, "renew_time", None)
+        return Lease(
+            metadata=meta,
+            holder_identity=spec.holder_identity or "",
+            lease_duration_seconds=int(spec.lease_duration_seconds or 0),
+            acquire_time=acquire.timestamp() if acquire else None,
+            renew_time=renew.timestamp() if renew else None,
+            lease_transitions=int(spec.lease_transitions or 0))
+
+    def _lease_body(self, lease: Lease, with_version: bool):
+        from datetime import datetime, timezone
+
+        def ts(epoch):
+            return (datetime.fromtimestamp(epoch, tz=timezone.utc)
+                    if epoch is not None else None)
+
+        cached = self._lease_raw_meta.get(
+            (lease.metadata.namespace, lease.metadata.name))
+        if with_version and cached is not None:
+            # full wire metadata from the last read: labels/annotations/
+            # ownerReferences survive the replace
+            meta = cached
+            meta.resource_version = lease.metadata.resource_version
+        else:
+            meta = self._k8s.V1ObjectMeta(name=lease.metadata.name,
+                                          namespace=lease.metadata.namespace)
+            if with_version:
+                meta.resource_version = lease.metadata.resource_version
+        return self._k8s.V1Lease(
+            metadata=meta,
+            spec=self._k8s.V1LeaseSpec(
+                holder_identity=lease.holder_identity,
+                lease_duration_seconds=lease.lease_duration_seconds,
+                acquire_time=ts(lease.acquire_time),
+                renew_time=ts(lease.renew_time),
+                lease_transitions=lease.lease_transitions))
+
+    def _cache_lease_meta(self, raw) -> None:
+        self._lease_raw_meta[(raw.metadata.namespace or "",
+                              raw.metadata.name)] = raw.metadata
+
+    def get_lease(self, namespace: str, name: str) -> Lease:
+        try:
+            raw = self._coordination.read_namespaced_lease(name, namespace)
+        except self._k8s.ApiException as exc:
+            raise self._translate(exc) from exc
+        self._cache_lease_meta(raw)
+        return self._lease_from(raw)
+
+    def create_lease(self, lease: Lease) -> Lease:
+        try:
+            raw = self._coordination.create_namespaced_lease(
+                lease.metadata.namespace,
+                self._lease_body(lease, with_version=False))
+        except self._k8s.ApiException as exc:
+            if getattr(exc, "status", None) == 409:
+                raise AlreadyExistsError(str(exc)) from exc
+            raise self._translate(exc) from exc
+        self._cache_lease_meta(raw)
+        return self._lease_from(raw)
+
+    def update_lease(self, lease: Lease) -> Lease:
+        try:
+            raw = self._coordination.replace_namespaced_lease(
+                lease.metadata.name, lease.metadata.namespace,
+                self._lease_body(lease, with_version=True))
+        except self._k8s.ApiException as exc:
+            if getattr(exc, "status", None) == 409:
+                raise ConflictError(str(exc)) from exc
+            raise self._translate(exc) from exc
+        self._cache_lease_meta(raw)
+        return self._lease_from(raw)

@@ -1,0 +1,137 @@
+"""Label and field selectors.
+
+The reference relies on apimachinery's selector machinery (labels.Selector in
+pod_manager.go:98, metav1.ListOptions selectors in validation_manager.go:77-78).
+This module implements the subset of Kubernetes selector syntax the upgrade
+flow uses, faithfully enough that policy fields like
+``waitForCompletion.podSelector`` accept real-world selector strings:
+
+- equality-based: ``k=v``, ``k==v``, ``k!=v``
+- set-based: ``k in (a,b)``, ``k notin (a,b)``, ``k`` (exists),
+  ``!k`` (not exists)
+- comma-joined conjunction of the above
+- field selectors of the form ``spec.nodeName=<name>`` (consts.go:70-73)
+"""
+
+from __future__ import annotations
+
+import re
+from typing import Callable, Mapping
+
+Matcher = Callable[[Mapping[str, str]], bool]
+
+
+class SelectorParseError(ValueError):
+    pass
+
+
+# Label keys: [prefix/]name with alphanumerics, '-', '_', '.' (the charset
+# Kubernetes accepts); field selector keys additionally use dots.
+_KEY = r"[A-Za-z0-9_./-]+"
+_SET_RE = re.compile(
+    rf"^\s*(?P<key>{_KEY})\s+(?P<op>in|notin)\s+\((?P<vals>[^)]*)\)\s*$")
+# Label values: empty or alphanumeric with '-', '_', '.' (Kubernetes charset).
+_EQ_RE = re.compile(
+    rf"^\s*(?P<key>{_KEY})\s*(?P<op>==|=|!=)\s*(?P<val>[A-Za-z0-9_.-]*)\s*$")
+_EXISTS_RE = re.compile(rf"^\s*(?P<neg>!?)\s*(?P<key>{_KEY})\s*$")
+
+
+def _split_requirements(selector: str) -> list[str]:
+    """Split on commas that are not inside parentheses."""
+    parts: list[str] = []
+    depth = 0
+    current = []
+    for ch in selector:
+        if ch == "(":
+            depth += 1
+        elif ch == ")":
+            depth -= 1
+        if ch == "," and depth == 0:
+            parts.append("".join(current))
+            current = []
+        else:
+            current.append(ch)
+    if current:
+        parts.append("".join(current))
+    return [p for p in (part.strip() for part in parts) if p]
+
+
+def parse_label_selector(selector: str) -> Matcher:
+    """Compile a label selector string into a matcher over a label dict.
+
+    An empty selector matches everything (the semantics the reference gets
+    from metav1.ListOptions with an empty LabelSelector).
+    """
+    selector = (selector or "").strip()
+    if not selector:
+        return lambda labels: True
+
+    requirements: list[Matcher] = []
+    for req in _split_requirements(selector):
+        m = _SET_RE.match(req)
+        if m:
+            key = m.group("key")
+            values = {v.strip() for v in m.group("vals").split(",") if v.strip()}
+            if m.group("op") == "in":
+                requirements.append(
+                    lambda labels, k=key, vs=values: labels.get(k) in vs)
+            else:
+                requirements.append(
+                    lambda labels, k=key, vs=values:
+                        k not in labels or labels[k] not in vs)
+            continue
+        m = _EQ_RE.match(req)
+        if m:
+            key, op, val = m.group("key"), m.group("op"), m.group("val")
+            if op in ("=", "=="):
+                requirements.append(
+                    lambda labels, k=key, v=val: labels.get(k) == v)
+            else:
+                requirements.append(
+                    lambda labels, k=key, v=val: labels.get(k) != v)
+            continue
+        m = _EXISTS_RE.match(req)
+        if m:
+            key, neg = m.group("key"), bool(m.group("neg"))
+            if neg:
+                requirements.append(lambda labels, k=key: k not in labels)
+            else:
+                requirements.append(lambda labels, k=key: k in labels)
+            continue
+        raise SelectorParseError(f"cannot parse selector requirement {req!r}")
+
+    return lambda labels: all(r(labels) for r in requirements)
+
+
+def matches_labels(selector: str, labels: Mapping[str, str]) -> bool:
+    return parse_label_selector(selector)(labels)
+
+
+def parse_field_selector(selector: str) -> Matcher:
+    """Compile a field selector into a matcher over a flat field dict.
+
+    Objects are exposed as flat dotted field maps (e.g. pods provide
+    ``spec.nodeName``, ``metadata.name``, ``metadata.namespace``,
+    ``status.phase``). Supports comma-joined ``=``/``==``/``!=`` requirements,
+    which is the full syntax Kubernetes itself supports for field selectors.
+    """
+    selector = (selector or "").strip()
+    if not selector:
+        return lambda fields: True
+    requirements: list[Matcher] = []
+    for req in _split_requirements(selector):
+        m = _EQ_RE.match(req)
+        if not m:
+            raise SelectorParseError(f"cannot parse field selector {req!r}")
+        key, op, val = m.group("key"), m.group("op"), m.group("val")
+        if op in ("=", "=="):
+            requirements.append(lambda fields, k=key, v=val: fields.get(k) == v)
+        else:
+            requirements.append(lambda fields, k=key, v=val: fields.get(k) != v)
+    return lambda fields: all(r(fields) for r in requirements)
+
+
+def selector_from_labels(labels: Mapping[str, str]) -> str:
+    """Render a label dict as an equality selector string (the inverse the
+    reference gets from labels.SelectorFromSet, pod_manager.go:98)."""
+    return ",".join(f"{k}={v}" for k, v in sorted(labels.items()))

@@ -1,0 +1,140 @@
+"""Watch plumbing: typed change events streamed from the cluster store.
+
+The reference never implements watches itself — it inherits them from
+controller-runtime, whose cached client is fed by list+watch informers and
+whose manager triggers the consumer's reconcile on every Node/DaemonSet/Pod
+event. Owning the substrate in this build (SURVEY.md §2 "L0") means owning
+that machinery too: this module defines the wire-shaped event type and the
+subscription object; :class:`tpu_operator_libs.k8s.fake.FakeCluster` emits
+events on every mutation, and :mod:`tpu_operator_libs.controller` builds
+informers and the watch-driven reconcile loop on top.
+"""
+
+from __future__ import annotations
+
+import queue
+import threading
+from dataclasses import dataclass
+from typing import Callable, Iterator, Optional
+
+ADDED = "ADDED"
+MODIFIED = "MODIFIED"
+DELETED = "DELETED"
+
+#: Sentinel object kinds, matching the reference's watched types
+#: (Nodes + driver DaemonSets + their pods).
+KIND_NODE = "Node"
+KIND_POD = "Pod"
+KIND_DAEMON_SET = "DaemonSet"
+
+
+@dataclass(frozen=True)
+class WatchEvent:
+    """One change notification.
+
+    ``object`` is a snapshot copy (value semantics, like objects that
+    crossed the wire) — mutating it never affects the store.
+    """
+
+    type: str          # ADDED | MODIFIED | DELETED
+    kind: str          # KIND_NODE | KIND_POD | KIND_DAEMON_SET
+    object: object     # Node | Pod | DaemonSet snapshot
+
+
+class Watch:
+    """A single subscriber's event stream.
+
+    Iterating blocks until the next event or :meth:`stop`. The internal
+    queue is unbounded; a subscriber that stops draining leaks memory, not
+    deadlocks — the same trade client-go's watch buffers make.
+    """
+
+    _STOP = object()
+
+    def __init__(self, on_stop: Optional[Callable[["Watch"], None]] = None) -> None:
+        self._queue: "queue.Queue[object]" = queue.Queue()
+        self._on_stop = on_stop
+        self._stopped = threading.Event()
+
+    # -- producer side ---------------------------------------------------
+    def _deliver(self, event: WatchEvent) -> None:
+        if not self._stopped.is_set():
+            self._queue.put(event)
+
+    # -- consumer side ---------------------------------------------------
+    def get(self, timeout: Optional[float] = None) -> Optional[WatchEvent]:
+        """Next event, or None on timeout / after stop."""
+        if self._stopped.is_set() and self._queue.empty():
+            return None
+        try:
+            item = self._queue.get(timeout=timeout)
+        except queue.Empty:
+            return None
+        if item is Watch._STOP:
+            return None
+        assert isinstance(item, WatchEvent)
+        return item
+
+    def __iter__(self) -> Iterator[WatchEvent]:
+        while True:
+            event = self.get()
+            if event is None and self._stopped.is_set():
+                return
+            if event is not None:
+                yield event
+
+    def stop(self) -> None:
+        if self._stopped.is_set():
+            return
+        self._stopped.set()
+        self._queue.put(Watch._STOP)
+        if self._on_stop is not None:
+            self._on_stop(self)
+
+    @property
+    def stopped(self) -> bool:
+        return self._stopped.is_set()
+
+
+class WatchBroadcaster:
+    """Fan-out of cluster change events to any number of subscribers.
+
+    The store (FakeCluster) calls :meth:`notify` on each mutation;
+    subscribers register via :meth:`subscribe`, optionally filtered by
+    kind. Delivery is synchronous enqueue — subscribers consume on their
+    own threads.
+    """
+
+    def __init__(self) -> None:
+        self._lock = threading.Lock()
+        self._subs: list[tuple[Optional[frozenset[str]],
+                               Optional[Callable[[WatchEvent], bool]],
+                               Watch]] = []
+
+    def subscribe(self, kinds: Optional[set[str]] = None,
+                  predicate: Optional[Callable[[WatchEvent], bool]] = None) -> Watch:
+        watch = Watch(on_stop=self._unsubscribe)
+        kindset = frozenset(kinds) if kinds is not None else None
+        with self._lock:
+            self._subs.append((kindset, predicate, watch))
+        return watch
+
+    def _unsubscribe(self, watch: Watch) -> None:
+        with self._lock:
+            self._subs = [(k, p, w) for (k, p, w) in self._subs
+                          if w is not watch]
+
+    def notify(self, event_type: str, kind: str, obj: object) -> None:
+        event = WatchEvent(event_type, kind, obj)
+        with self._lock:
+            subs = list(self._subs)
+        for kindset, predicate, watch in subs:
+            if kindset is not None and kind not in kindset:
+                continue
+            if predicate is not None and not predicate(event):
+                continue
+            watch._deliver(event)
+
+    def subscriber_count(self) -> int:
+        with self._lock:
+            return len(self._subs)

@@ -1,0 +1,272 @@
+"""OperatorManager: the controller-runtime "manager" analogue.
+
+The reference's consumers get informer caches, a rate-limited work queue,
+watch→reconcile wiring, leader election and a metrics endpoint for free
+from ``ctrl.NewManager`` (SURVEY.md §1 L0/L5); this build owns each of
+those pieces (:mod:`tpu_operator_libs.controller`,
+:mod:`tpu_operator_libs.k8s.cached`,
+:mod:`tpu_operator_libs.k8s.leaderelection`,
+:mod:`tpu_operator_libs.metrics`) — this module packages them the same
+way so a consumer operator is four lines:
+
+.. code-block:: python
+
+    mgr = OperatorManager(cluster, namespace="kube-system",
+                          reconcile=my_reconcile)
+    mgr.run(stop_event)          # blocks; Ctrl-C sets the event
+
+With ``leader_election`` configured, caches and the reconcile loop start
+only after the Lease is won (standby replicas hold no watches), and
+losing leadership stops them — the HA replica pattern
+controller-runtime's manager implements.
+"""
+
+from __future__ import annotations
+
+import logging
+import threading
+from typing import Callable, Optional
+
+from tpu_operator_libs.controller import (
+    Controller,
+    ExponentialBackoffRateLimiter,
+    ReconcileResult,
+)
+from tpu_operator_libs.k8s.client import K8sClient
+
+logger = logging.getLogger(__name__)
+
+
+class OperatorManager:
+    """Wires cache + controller + optional leader election into one
+    runnable.
+
+    Parameters
+    ----------
+    client:
+        The cluster backend (FakeCluster or RealCluster). When
+        ``use_cache`` is true (default), reads go through a
+        :class:`~tpu_operator_libs.k8s.cached.CachedReadClient` built at
+        start time; access it via :attr:`client` from inside
+        ``reconcile``.
+    reconcile:
+        ``fn(key) -> Optional[ReconcileResult]`` — the consumer's
+        reconcile, called from worker threads exactly like
+        :class:`~tpu_operator_libs.controller.Controller`'s.
+    leader_election:
+        Optional :class:`~tpu_operator_libs.k8s.leaderelection.
+        LeaderElectionConfig`; when set, :meth:`run` contends for the
+        Lease and gates the whole runtime on holding it.
+    """
+
+    def __init__(self, client: K8sClient, namespace: str,
+                 reconcile: Callable[[str], Optional[ReconcileResult]],
+                 name: str = "operator",
+                 use_cache: bool = True,
+                 cache_sync_timeout: float = 60.0,
+                 resync_period: Optional[float] = 300.0,
+                 workers: int = 1,
+                 leader_election=None,
+                 leader_election_clock=None,
+                 metrics=None,
+                 rate_limiter: Optional[ExponentialBackoffRateLimiter] = None,
+                 ) -> None:
+        self._raw_client = client
+        self._namespace = namespace
+        self._reconcile = reconcile
+        self._name = name
+        self._use_cache = use_cache
+        self._cache_sync_timeout = cache_sync_timeout
+        self._resync_period = resync_period
+        self._workers = workers
+        self._leader_election = leader_election
+        self._leader_election_clock = leader_election_clock
+        self._metrics = metrics
+        self._rate_limiter = rate_limiter
+
+        self._cached = None
+        self._controller: Optional[Controller] = None
+        self._started = threading.Event()
+        self._lock = threading.Lock()
+        self._starting = False
+        self._stop_requested = threading.Event()
+        self._start_error: Optional[BaseException] = None
+
+    # -- accessors --------------------------------------------------------
+    @property
+    def client(self) -> K8sClient:
+        """The read client reconcilers should use: the informer cache
+        once started (GetClient analogue), else the raw backend."""
+        return self._cached if self._cached is not None else self._raw_client
+
+    @property
+    def is_started(self) -> bool:
+        return self._started.is_set()
+
+    def has_synced(self, timeout: Optional[float] = None) -> bool:
+        """WaitForCacheSync analogue (always True without a cache)."""
+        if self._cached is None:
+            return True
+        return self._cached.has_synced(timeout=timeout)
+
+    # -- lifecycle --------------------------------------------------------
+    def start(self) -> None:
+        """Build caches, sync them, and start the controller. Without
+        leader election, call this directly; :meth:`run` calls it (on a
+        worker thread) after winning the Lease. Raises if caches fail to
+        sync. The cache-sync wait runs without holding the manager lock,
+        so a concurrent :meth:`stop` returns promptly and aborts the
+        sync."""
+        with self._lock:
+            if self._controller is not None or self._starting:
+                raise RuntimeError("manager already started")
+            self._starting = True
+            # a fresh start supersedes any previous stop request; an
+            # in-flight stop() from a previous life has already taken its
+            # refs under this lock
+            self._stop_requested.clear()
+        cached = None
+        try:
+            if self._use_cache:
+                from tpu_operator_libs.k8s.cached import CachedReadClient
+
+                cached = CachedReadClient(self._raw_client, self._namespace)
+                import time as _time
+
+                end = _time.monotonic() + self._cache_sync_timeout
+                synced = False
+                # do-while shape: an already-synced cache must pass even
+                # with cache_sync_timeout <= 0 (the deadline-first loop
+                # would return a spurious TimeoutError without ever
+                # asking).
+                while True:
+                    if self._stop_requested.is_set():
+                        cached.stop()
+                        return
+                    remaining = end - _time.monotonic()
+                    if cached.has_synced(
+                            timeout=min(0.2, max(0.0, remaining))):
+                        synced = True
+                        break
+                    if remaining <= 0:
+                        break
+                if not synced:
+                    cached.stop()
+                    raise TimeoutError(
+                        f"informer caches failed to sync within "
+                        f"{self._cache_sync_timeout}s")
+            controller = Controller(
+                self._reconcile, name=self._name,
+                rate_limiter=self._rate_limiter,
+                resync_period=self._resync_period,
+                metrics=self._metrics)
+            # Events trigger reconciles *after* they are applied to the
+            # read cache: the controller is fed by the cache informers'
+            # handlers (controller-runtime sources its workqueue the same
+            # way), so a reconcile never races its own trigger reading a
+            # pre-event cache. Without a cache, fall back to a raw watch.
+            if cached is not None:
+                cached.add_event_handler(
+                    lambda *_a: controller.enqueue())
+            else:
+                controller.watch(
+                    self._raw_client.watch(namespace=self._namespace))
+            with self._lock:
+                if self._stop_requested.is_set():
+                    if cached is not None:
+                        cached.stop()
+                    return
+                self._cached = cached
+                self._controller = controller
+                # Publish and start under ONE lock hold: a concurrent
+                # stop() is thereby ordered strictly before the publish
+                # (caught by the check above) or after the workers exist
+                # (normal teardown) — there is no window where it stops
+                # a not-yet-started controller. controller.start only
+                # spawns threads, so holding the lock here is cheap; the
+                # lock-free waiting the docstring describes is for the
+                # long cache-sync loop above, not this.
+                controller.start(workers=self._workers)
+                self._started.set()
+            logger.info("%s: started (cache=%s)", self._name,
+                        self._use_cache)
+        except BaseException:
+            if cached is not None and self._cached is None:
+                cached.stop()
+            raise
+        finally:
+            with self._lock:
+                self._starting = False
+
+    def stop(self, timeout: float = 10.0) -> None:
+        self._stop_requested.set()
+        with self._lock:
+            controller, cached = self._controller, self._cached
+            self._controller = None
+            self._cached = None
+            self._started.clear()
+        if controller is not None:
+            controller.stop(timeout=timeout)
+        if cached is not None:
+            cached.stop()
+        logger.info("%s: stopped", self._name)
+
+    def run(self, stop: Optional[threading.Event] = None) -> None:
+        """Blocking entry point (manager.Start analogue).
+
+        Without leader election: start, then wait for ``stop``. With it:
+        contend for the Lease; the runtime starts on acquiring and stops
+        on losing it, and the loop exits when ``stop`` is set (or
+        leadership is lost — the standard exit-and-let-the-replica-
+        controller-restart-us pattern)."""
+        stop = stop or threading.Event()
+        if self._leader_election is None:
+            self.start()
+            try:
+                stop.wait()
+            finally:
+                self.stop()
+            return
+
+        from tpu_operator_libs.k8s.leaderelection import LeaderElector
+
+        def start_async():
+            # a worker thread, NOT the elector's: the elector must keep
+            # renewing the Lease while caches sync, or a slow sync blows
+            # the renew deadline and a second leader starts writing node
+            # state concurrently (split brain)
+            try:
+                self.start()
+            except Exception as exc:  # noqa: BLE001 — surfaced via run()
+                logger.exception("%s: start after winning lease failed",
+                                 self._name)
+                self._start_error = exc
+                stop.set()
+
+        def on_started():
+            threading.Thread(target=start_async, daemon=True,
+                             name=f"{self._name}-start").start()
+
+        def on_stopped():
+            self.stop()
+            # deposed: exit so the replica controller restarts us as a
+            # follower (controller-runtime does the same)
+            stop.set()
+
+        elector = LeaderElector(self._raw_client, self._leader_election,
+                                clock=self._leader_election_clock,
+                                on_started_leading=on_started,
+                                on_stopped_leading=on_stopped)
+        elector_thread = threading.Thread(
+            target=lambda: elector.run(stop), daemon=True,
+            name=f"{self._name}-elector")
+        elector_thread.start()
+        try:
+            stop.wait()
+        finally:
+            elector.release()
+            self.stop()
+            elector_thread.join(timeout=5.0)
+        if self._start_error is not None:
+            # a startup failure must not look like a clean exit
+            raise self._start_error

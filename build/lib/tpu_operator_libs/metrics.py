@@ -1,0 +1,207 @@
+"""Fleet metrics registry.
+
+The reference exposes fleet gauges only as methods on the state manager
+(GetUpgradesInProgress/Done/Available/Failed/Pending/TotalManagedNodes,
+upgrade_state.go:1034-1120) and left metrics export as a commented-out
+TODO (upgrade_state.go:413-416). SURVEY.md §5 asks the TPU build to surface
+these as real metrics — they are the numerators/denominators of the
+north-star "slice availability %".
+
+Prometheus-text exposition without any client library dependency: call
+:meth:`MetricsRegistry.render_prometheus` from whatever HTTP handler the
+consumer operator runs.
+"""
+
+from __future__ import annotations
+
+import threading
+from dataclasses import dataclass, field
+from typing import Optional
+
+from tpu_operator_libs.consts import ALL_STATES
+from tpu_operator_libs.topology.slice_topology import SliceTopology
+
+
+@dataclass
+class _Metric:
+    name: str
+    help: str
+    type: str  # "gauge" | "counter"
+    values: dict[tuple[tuple[str, str], ...], float] = field(
+        default_factory=dict)
+
+
+#: Default histogram buckets, tuned for reconcile latencies (seconds).
+DEFAULT_BUCKETS = (0.001, 0.0025, 0.005, 0.01, 0.025, 0.05, 0.1, 0.25,
+                   0.5, 1.0, 2.5, 5.0, 10.0, 30.0, 60.0)
+
+
+@dataclass
+class _HistData:
+    bucket_counts: list[int]
+    total: float = 0.0
+    count: int = 0
+
+
+@dataclass
+class _Histogram:
+    name: str
+    help: str
+    buckets: tuple[float, ...]
+    values: dict[tuple[tuple[str, str], ...], _HistData] = field(
+        default_factory=dict)
+
+
+class MetricsRegistry:
+    """Thread-safe gauge/counter store with Prometheus text rendering."""
+
+    def __init__(self, namespace: str = "tpu_upgrade") -> None:
+        self._ns = namespace
+        self._metrics: dict[str, _Metric] = {}
+        self._histograms: dict[str, _Histogram] = {}
+        self._lock = threading.Lock()
+
+    def _metric(self, name: str, help_: str, type_: str) -> _Metric:
+        with self._lock:
+            m = self._metrics.get(name)
+            if m is None:
+                m = _Metric(name=f"{self._ns}_{name}", help=help_, type=type_)
+                self._metrics[name] = m
+            return m
+
+    @staticmethod
+    def _key(labels: Optional[dict[str, str]]) -> tuple[tuple[str, str], ...]:
+        return tuple(sorted((labels or {}).items()))
+
+    def set_gauge(self, name: str, value: float, help_: str = "",
+                  labels: Optional[dict[str, str]] = None) -> None:
+        m = self._metric(name, help_, "gauge")
+        with self._lock:
+            m.values[self._key(labels)] = value
+
+    def inc_counter(self, name: str, help_: str = "",
+                    labels: Optional[dict[str, str]] = None,
+                    by: float = 1.0) -> None:
+        m = self._metric(name, help_, "counter")
+        with self._lock:
+            key = self._key(labels)
+            m.values[key] = m.values.get(key, 0.0) + by
+
+    def observe_histogram(self, name: str, value: float, help_: str = "",
+                          labels: Optional[dict[str, str]] = None,
+                          buckets: Optional[tuple[float, ...]] = None) -> None:
+        """Record one observation (Prometheus histogram semantics: cumulative
+        ``le`` buckets plus ``_sum``/``_count``). SURVEY.md §5 maps the
+        reference's absent tracing to reconcile-duration metrics — this is
+        that seam."""
+        with self._lock:
+            h = self._histograms.get(name)
+            if h is None:
+                h = _Histogram(name=f"{self._ns}_{name}", help=help_,
+                               buckets=tuple(sorted(
+                                   buckets or DEFAULT_BUCKETS)))
+                self._histograms[name] = h
+            key = self._key(labels)
+            data = h.values.get(key)
+            if data is None:
+                data = _HistData(bucket_counts=[0] * len(h.buckets))
+                h.values[key] = data
+            for i, le in enumerate(h.buckets):
+                if value <= le:
+                    data.bucket_counts[i] += 1
+            data.total += value
+            data.count += 1
+
+    def histogram_stats(
+            self, name: str, labels: Optional[dict[str, str]] = None,
+    ) -> Optional[tuple[int, float]]:
+        """(count, sum) for one histogram series, or None."""
+        with self._lock:
+            h = self._histograms.get(name)
+            if h is None:
+                return None
+            data = h.values.get(self._key(labels))
+            if data is None:
+                return None
+            return data.count, data.total
+
+    def get(self, name: str,
+            labels: Optional[dict[str, str]] = None) -> Optional[float]:
+        with self._lock:
+            m = self._metrics.get(name)
+            if m is None:
+                return None
+            return m.values.get(self._key(labels))
+
+    def render_prometheus(self) -> str:
+        lines = []
+        with self._lock:
+            for m in self._metrics.values():
+                if m.help:
+                    lines.append(f"# HELP {m.name} {m.help}")
+                lines.append(f"# TYPE {m.name} {m.type}")
+                for key, value in sorted(m.values.items()):
+                    if key:
+                        rendered = ",".join(
+                            f'{k}="{v}"' for k, v in key)
+                        lines.append(f"{m.name}{{{rendered}}} {value:g}")
+                    else:
+                        lines.append(f"{m.name} {value:g}")
+            for h in self._histograms.values():
+                if h.help:
+                    lines.append(f"# HELP {h.name} {h.help}")
+                lines.append(f"# TYPE {h.name} histogram")
+                for key, data in sorted(h.values.items()):
+                    base = ",".join(f'{k}="{v}"' for k, v in key)
+                    sep = "," if base else ""
+                    for le, count in zip(h.buckets, data.bucket_counts):
+                        lines.append(
+                            f'{h.name}_bucket{{{base}{sep}le="{le:g}"}} '
+                            f"{count}")
+                    lines.append(
+                        f'{h.name}_bucket{{{base}{sep}le="+Inf"}} '
+                        f"{data.count}")
+                    suffix = f"{{{base}}}" if base else ""
+                    lines.append(f"{h.name}_sum{suffix} {data.total:g}")
+                    lines.append(f"{h.name}_count{suffix} {data.count}")
+        return "\n".join(lines) + "\n"
+
+
+def observe_cluster_state(registry: MetricsRegistry, manager,
+                          state, driver: str = "libtpu") -> None:
+    """Record the fleet gauges for one reconcile pass.
+
+    ``manager`` is a ClusterUpgradeStateManager, ``state`` the snapshot it
+    just processed. Includes the per-state node census, the reference's six
+    fleet counters, and the TPU-native slice availability gauge.
+    """
+    labels = {"driver": driver}
+    registry.set_gauge("nodes_total",
+                       manager.get_total_managed_nodes(state),
+                       "Nodes managed for runtime upgrades", labels)
+    registry.set_gauge("upgrades_in_progress",
+                       manager.get_upgrades_in_progress(state),
+                       "Nodes currently upgrading", labels)
+    registry.set_gauge("upgrades_done", manager.get_upgrades_done(state),
+                       "Nodes with upgrade complete", labels)
+    registry.set_gauge("upgrades_failed", manager.get_upgrades_failed(state),
+                       "Nodes in upgrade-failed", labels)
+    registry.set_gauge("upgrades_pending", manager.get_upgrades_pending(state),
+                       "Nodes awaiting an upgrade slot", labels)
+    registry.set_gauge("nodes_unavailable",
+                       manager.get_current_unavailable_nodes(state),
+                       "Cordoned or not-ready nodes", labels)
+    for s in ALL_STATES:
+        registry.set_gauge(
+            "nodes_in_state", len(state.bucket(s)),
+            "Node count per upgrade state",
+            {**labels, "state": str(s) or "unknown"})
+
+    nodes = [ns.node for bucket in state.node_states.values()
+             for ns in bucket]
+    if nodes:
+        topo = SliceTopology.from_nodes(nodes)
+        registry.set_gauge("slice_availability_ratio", topo.availability(),
+                           "Fraction of ICI slices fully available", labels)
+    registry.inc_counter("reconciles_total",
+                         "apply_state passes executed", labels)

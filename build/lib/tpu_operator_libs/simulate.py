@@ -1,0 +1,276 @@
+"""Discrete-event simulation of a rolling libtpu upgrade.
+
+Drives the real state machine (not a model of it) against the FakeCluster's
+DaemonSet-controller simulation under a virtual clock, and measures the
+north-star metrics from BASELINE.md:
+
+- **drain→ready p50 (s)** per node: wall-clock from the moment a node
+  leaves service (cordoned) until it is back in ``upgrade-done``.
+- **slice availability %**: time-weighted fraction of ICI slices fully
+  available over the upgrade window (a multi-host slice counts as down
+  whenever any of its hosts is cordoned or not-ready).
+
+Running the same fleet with ``topology_mode`` flat (reference semantics)
+vs ``slice`` (topology-aware planning) quantifies the benefit of
+slice-atomic upgrades — the comparison ``bench.py`` reports.
+"""
+
+from __future__ import annotations
+
+import random
+import statistics
+from dataclasses import dataclass, field
+from typing import Optional
+
+from tpu_operator_libs.api.upgrade_policy import (
+    DrainSpec,
+    UpgradePolicySpec,
+)
+from tpu_operator_libs.consts import (
+    GKE_NODEPOOL_LABEL,
+    GKE_TPU_ACCELERATOR_LABEL,
+    GKE_TPU_TOPOLOGY_LABEL,
+    POD_CONTROLLER_REVISION_HASH_LABEL,
+    UpgradeKeys,
+    UpgradeState,
+)
+from tpu_operator_libs.k8s.fake import FakeCluster
+from tpu_operator_libs.k8s.objects import (
+    ContainerStatus,
+    DaemonSet,
+    DaemonSetSpec,
+    DaemonSetStatus,
+    Node,
+    ObjectMeta,
+    OwnerReference,
+    Pod,
+    PodPhase,
+    PodSpec,
+    PodStatus,
+)
+from tpu_operator_libs.topology.slice_topology import SliceTopology
+from tpu_operator_libs.upgrade.state_manager import ClusterUpgradeStateManager
+from tpu_operator_libs.util import FakeClock
+
+NS = "tpu-system"
+RUNTIME_LABELS = {"app": "libtpu"}
+
+
+@dataclass
+class FleetSpec:
+    """Shape of the simulated fleet (BASELINE config #3: v5e-16-style
+    multi-host slices)."""
+
+    n_slices: int = 4
+    hosts_per_slice: int = 4
+    accelerator: str = "tpu-v5-lite-podslice"
+    topology: str = "4x4"
+    # libtpu DaemonSet pod lifecycle (seconds, virtual)
+    pod_recreate_delay: float = 15.0
+    pod_ready_delay: float = 45.0
+    # Real GKE node names carry random VM suffixes, so list order is
+    # uncorrelated with slice membership; a seeded shuffle models that.
+    # (Without it, slice-contiguous creation order would hand the flat
+    # planner whole slices by accident and mask the topology benefit.)
+    shuffle_seed: Optional[int] = 1234
+    # --- fault injection (SURVEY.md §5: the reference has none; failures
+    # are only ever simulated via mock errors in its tests) ---
+    # Node names whose recreated runtime pod crash-loops (stays not-ready
+    # with >10 restarts) until `crashloop_heal_after` virtual seconds.
+    crashloop_nodes: tuple[str, ...] = ()
+    crashloop_heal_after: float = 300.0
+    # Node names that flip NotReady at `not_ready_at` and recover at
+    # `not_ready_heal_at` (virtual seconds).
+    not_ready_nodes: tuple[str, ...] = ()
+    not_ready_at: float = 50.0
+    not_ready_heal_at: float = 200.0
+
+
+@dataclass
+class SimResult:
+    converged: bool
+    total_seconds: float
+    drain_to_ready_seconds: list[float] = field(default_factory=list)
+    availability_integral: float = 0.0  # ∫ availability dt / total
+    reconciles: int = 0
+
+    @property
+    def drain_to_ready_p50(self) -> Optional[float]:
+        if not self.drain_to_ready_seconds:
+            return None
+        return statistics.median(self.drain_to_ready_seconds)
+
+    @property
+    def slice_availability_pct(self) -> float:
+        return 100.0 * self.availability_integral
+
+    def slice_availability_pct_over(self, window_seconds: float) -> float:
+        """Availability over a fixed window ≥ the upgrade duration: the
+        fleet is fully available after convergence, so comparing two runs
+        over the same window credits faster convergence instead of
+        punishing it (a shorter upgrade over its own shorter window would
+        otherwise look *worse*)."""
+        if window_seconds <= self.total_seconds:
+            return self.slice_availability_pct
+        downtime = (1.0 - self.availability_integral) * self.total_seconds
+        return 100.0 * (1.0 - downtime / window_seconds)
+
+
+def build_fleet(spec: FleetSpec) -> tuple[FakeCluster, FakeClock, UpgradeKeys]:
+    clock = FakeClock(start=0.0)
+    cluster = FakeCluster(clock=clock)
+    cluster.enable_ds_controller(recreate_delay=spec.pod_recreate_delay,
+                                 ready_delay=spec.pod_ready_delay)
+    keys = UpgradeKeys()
+    total = spec.n_slices * spec.hosts_per_slice
+    ds = DaemonSet(
+        metadata=ObjectMeta(name="libtpu", namespace=NS,
+                            labels=dict(RUNTIME_LABELS)),
+        spec=DaemonSetSpec(selector=dict(RUNTIME_LABELS)),
+        status=DaemonSetStatus(desired_number_scheduled=total))
+    cluster.add_daemon_set(ds, revision_hash="old")
+    members = [(s, h) for s in range(spec.n_slices)
+               for h in range(spec.hosts_per_slice)]
+    if spec.shuffle_seed is not None:
+        random.Random(spec.shuffle_seed).shuffle(members)
+    for s, h in members:
+        name = f"s{s}-h{h}"
+        cluster.add_node(Node(metadata=ObjectMeta(name=name, labels={
+            GKE_NODEPOOL_LABEL: f"pool-{s}",
+            GKE_TPU_ACCELERATOR_LABEL: spec.accelerator,
+            GKE_TPU_TOPOLOGY_LABEL: spec.topology,
+            "google.com/tpu": "true",
+        })))
+        cluster.add_pod(Pod(
+            metadata=ObjectMeta(
+                name=f"libtpu-{name}", namespace=NS,
+                labels={**RUNTIME_LABELS,
+                        POD_CONTROLLER_REVISION_HASH_LABEL: "old"},
+                owner_references=[OwnerReference(
+                    kind="DaemonSet", name="libtpu",
+                    uid=ds.metadata.uid)]),
+            spec=PodSpec(node_name=name),
+            status=PodStatus(
+                phase=PodPhase.RUNNING,
+                container_statuses=[
+                    ContainerStatus(name="libtpu", ready=True)])))
+    # roll the DS template: every pod is now out of date
+    cluster.bump_daemon_set_revision(NS, "libtpu", "new")
+    _schedule_faults(cluster, spec)
+    # apply any faults due at t=0 so "broken from the start" scenarios are
+    # visible to the very first reconcile pass
+    cluster.step()
+    return cluster, clock, keys
+
+
+def _schedule_faults(cluster: FakeCluster, spec: FleetSpec) -> None:
+    """Install the configured fault injections as scheduled sim actions."""
+    known = {n.metadata.name for n in cluster.list_nodes()}
+    for name in (*spec.not_ready_nodes, *spec.crashloop_nodes):
+        if name not in known:
+            raise ValueError(
+                f"fault-injection target {name!r} is not a fleet node "
+                f"(nodes are named s<slice>-h<host>)")
+    for name in spec.not_ready_nodes:
+        cluster.schedule_at(spec.not_ready_at,
+                            lambda n=name: cluster.set_node_ready(n, False))
+        cluster.schedule_at(spec.not_ready_heal_at,
+                            lambda n=name: cluster.set_node_ready(n, True))
+    if not spec.crashloop_nodes:
+        return
+    afflicted = set(spec.crashloop_nodes)
+    heal_at = spec.crashloop_heal_after
+
+    def ready_gate(pod) -> bool:
+        if pod.spec.node_name not in afflicted:
+            return True
+        return cluster.clock.now() >= heal_at
+
+    cluster.set_pod_ready_gate(ready_gate)
+
+
+def simulate_rolling_upgrade(
+        topology_mode: str = "slice",
+        fleet: Optional[FleetSpec] = None,
+        max_unavailable="25%",
+        max_parallel_upgrades: int = 0,
+        reconcile_interval: float = 10.0,
+        max_sim_seconds: float = 24 * 3600.0,
+        chained: bool = False) -> SimResult:
+    """Run one full rolling upgrade and measure it.
+
+    ``chained=False`` models the reference consumer: one apply_state per
+    reconcile interval (one transition per node per interval).
+    ``chained=True`` uses ClusterUpgradeStateManager.reconcile, which
+    chains passes until states stabilize — this framework's fast path.
+    """
+    fleet = fleet or FleetSpec()
+    cluster, clock, keys = build_fleet(fleet)
+    mgr = ClusterUpgradeStateManager(
+        cluster, keys, async_workers=False, poll_interval=0.0)
+    policy = UpgradePolicySpec(
+        auto_upgrade=True,
+        max_parallel_upgrades=max_parallel_upgrades,
+        max_unavailable=max_unavailable,
+        topology_mode=topology_mode,
+        drain=DrainSpec(enable=True, force=True, timeout_seconds=300))
+
+    down_since: dict[str, float] = {}
+    drain_to_ready: list[float] = []
+    availability_weighted = 0.0
+    reconciles = 0
+    converged = False
+
+    def sample_availability() -> float:
+        topo = SliceTopology.from_nodes(cluster.list_nodes())
+        return topo.availability()
+
+    from tpu_operator_libs.upgrade.state_manager import BuildStateError
+
+    while clock.now() < max_sim_seconds:
+        try:
+            if chained:
+                mgr.reconcile(NS, RUNTIME_LABELS, policy)
+            else:
+                state = mgr.build_state(NS, RUNTIME_LABELS)
+                mgr.apply_state(state, policy)
+        except BuildStateError:
+            # A restarted runtime pod is between deletion and recreation;
+            # the snapshot is incomplete. Like the reference
+            # (upgrade_state.go:243-246), the reconciler simply retries.
+            pass
+        reconciles += 1
+
+        now = clock.now()
+        for node in cluster.list_nodes():
+            name = node.metadata.name
+            label = node.metadata.labels.get(keys.state_label, "")
+            if node.is_unschedulable() and name not in down_since:
+                down_since[name] = now
+            elif (name in down_since and not node.is_unschedulable()
+                  and label == str(UpgradeState.DONE)):
+                drain_to_ready.append(now - down_since.pop(name))
+
+        labels = [n.metadata.labels.get(keys.state_label, "")
+                  for n in cluster.list_nodes()]
+        if all(lb == str(UpgradeState.DONE) for lb in labels):
+            # Converged: no further virtual time elapses, so this pass
+            # contributes no interval to the availability integral.
+            converged = True
+            break
+
+        # The sampled availability holds for the upcoming interval
+        # [now, now + reconcile_interval); weight and advance together so
+        # the integral normalizes by exactly the elapsed virtual time.
+        availability_weighted += sample_availability() * reconcile_interval
+        clock.advance(reconcile_interval)
+        cluster.step()
+
+    total = clock.now()
+    return SimResult(
+        converged=converged,
+        total_seconds=total,
+        drain_to_ready_seconds=drain_to_ready,
+        availability_integral=(availability_weighted / total
+                               if total > 0 else 1.0),
+        reconciles=reconciles)

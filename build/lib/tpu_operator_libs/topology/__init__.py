@@ -1,0 +1,16 @@
+"""ICI-topology-aware upgrade planning.
+
+The reference treats nodes as independent and throttles purely by count
+(GetUpgradesAvailable, upgrade_state.go:1073-1102). On multi-host TPU
+slices that model is wrong: all hosts of a slice are coupled by the ICI
+fabric, and draining any one host idles the entire slice (SURVEY.md §5
+"long-context / topology-coupled upgrade ordering"; BASELINE config #3).
+This package changes the unit of work from node to slice.
+"""
+
+from tpu_operator_libs.topology.slice_topology import (  # noqa: F401
+    SliceInfo,
+    SliceTopology,
+    slice_id_for_node,
+)
+from tpu_operator_libs.topology.planner import SlicePlanner  # noqa: F401

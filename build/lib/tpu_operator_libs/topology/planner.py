@@ -1,0 +1,100 @@
+"""SlicePlanner: slice-atomic node selection for upgrade-required nodes.
+
+Replaces the reference's flat per-node slot loop (upgrade_state.go:587-631)
+when ``topologyMode: slice`` is set. Rationale: on a multi-host TPU slice,
+cordoning host 1 already idles hosts 2..N's chips — upgrading hosts one at
+a time multiplies slice downtime by N for zero availability benefit. The
+planner therefore:
+
+1. Groups upgrade-required candidates into slices (ICI domains).
+2. Charges the availability budget only for *newly* unavailable hosts —
+   hosts of an already-broken slice upgrade "for free", generalizing the
+   reference's manual-cordon override (upgrade_state.go:606-616).
+3. Advances whole slices atomically, preferring (a) slices already
+   partially unavailable (finish what is already down), then (b) cheaper
+   slices (maximize number of fully-available slices at all times).
+4. Never deadlocks: when the budget is positive but smaller than the
+   cheapest slice, that one slice may overdraw the budget — a partial
+   upgrade would hurt availability strictly more than a brief overdraw,
+   since the slice becomes unusable at the first cordoned host either way.
+"""
+
+from __future__ import annotations
+
+import logging
+from typing import TYPE_CHECKING
+
+from tpu_operator_libs.topology.slice_topology import (
+    SliceTopology,
+    slice_id_for_node,
+)
+
+if TYPE_CHECKING:  # pragma: no cover
+    from tpu_operator_libs.upgrade.state_manager import (
+        ClusterUpgradeState,
+        NodeUpgradeState,
+    )
+
+logger = logging.getLogger(__name__)
+
+
+class SlicePlanner:
+    """Slice-atomic implementation of the UpgradePlanner protocol."""
+
+    def plan(self, candidates: list["NodeUpgradeState"], available: int,
+             state: "ClusterUpgradeState") -> list["NodeUpgradeState"]:
+        if not candidates:
+            return []
+
+        # Build the topology over every known node, not just candidates, so
+        # hosts of the same slice that are mid-upgrade count toward
+        # "slice already down".
+        all_nodes = [ns.node for bucket in state.node_states.values()
+                     for ns in bucket]
+        topology = SliceTopology.from_nodes(all_nodes)
+
+        by_slice: dict[str, list["NodeUpgradeState"]] = {}
+        for ns in candidates:
+            by_slice.setdefault(slice_id_for_node(ns.node), []).append(ns)
+
+        def cost(slice_id: str) -> int:
+            """Hosts that would *newly* become unavailable."""
+            return sum(1 for ns in by_slice[slice_id]
+                       if not ns.node.is_unschedulable())
+
+        def already_broken(slice_id: str) -> bool:
+            info = topology.slices.get(slice_id)
+            return info is not None and not info.is_available
+
+        order = sorted(
+            by_slice,
+            key=lambda sid: (
+                not already_broken(sid),  # broken slices first
+                cost(sid),                # then cheapest
+                sid,                      # deterministic tie-break
+            ))
+
+        selected: list["NodeUpgradeState"] = []
+        budget = available
+        paid = False
+        for sid in order:
+            c = cost(sid)
+            if c == 0:
+                # every candidate host already unavailable — free progress
+                selected.extend(by_slice[sid])
+                continue
+            if budget <= 0:
+                continue
+            if c > budget and paid:
+                # Overdraw is only allowed for the first PAYING slice;
+                # free slices selected above don't consume that right.
+                continue
+            selected.extend(by_slice[sid])
+            budget = max(0, budget - c)
+            paid = True
+        if selected:
+            logger.info(
+                "slice planner advancing %d nodes across %d slice(s)",
+                len(selected),
+                len({slice_id_for_node(ns.node) for ns in selected}))
+        return selected

@@ -1,0 +1,114 @@
+"""TPU slice topology derived from GKE node labels.
+
+On GKE, a multi-host TPU slice maps 1:1 to a node pool: every node carries
+``cloud.google.com/gke-nodepool`` plus the TPU shape labels
+``cloud.google.com/gke-tpu-accelerator`` (e.g. ``tpu-v5p-slice``) and
+``cloud.google.com/gke-tpu-topology`` (e.g. ``4x4x8``). All hosts of a
+slice share one ICI domain: the slice is available only while *every* host
+is schedulable and healthy.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from functools import reduce
+from typing import Iterable, Optional
+
+from tpu_operator_libs.consts import (
+    GKE_NODEPOOL_LABEL,
+    GKE_TPU_ACCELERATOR_LABEL,
+    GKE_TPU_TOPOLOGY_LABEL,
+)
+from tpu_operator_libs.k8s.objects import Node
+
+
+def slice_id_for_node(node: Node) -> str:
+    """The slice a node belongs to.
+
+    Nodes with TPU shape labels group by node pool (one multi-host slice
+    per pool on GKE); anything else is its own single-node "slice", which
+    makes non-TPU and single-host nodes degrade to exactly the reference's
+    per-node semantics.
+    """
+    labels = node.metadata.labels
+    if GKE_TPU_TOPOLOGY_LABEL in labels and GKE_NODEPOOL_LABEL in labels:
+        return labels[GKE_NODEPOOL_LABEL]
+    return f"node:{node.metadata.name}"
+
+
+def parse_chip_topology(topology: str) -> Optional[tuple[int, ...]]:
+    """Parse a GKE TPU topology string like ``4x4x8`` into dims."""
+    try:
+        dims = tuple(int(part) for part in topology.lower().split("x"))
+    except ValueError:
+        return None
+    return dims if dims else None
+
+
+@dataclass
+class SliceInfo:
+    """One ICI domain: the atomic unit of upgrade."""
+
+    slice_id: str
+    nodes: list[Node] = field(default_factory=list)
+    accelerator: str = ""
+    topology: str = ""
+
+    @property
+    def is_multi_host(self) -> bool:
+        return len(self.nodes) > 1
+
+    @property
+    def chip_count(self) -> Optional[int]:
+        dims = parse_chip_topology(self.topology) if self.topology else None
+        if dims is None:
+            return None
+        return reduce(lambda a, b: a * b, dims, 1)
+
+    def unavailable_host_count(self) -> int:
+        return sum(1 for n in self.nodes
+                   if n.is_unschedulable() or not n.is_ready())
+
+    @property
+    def is_available(self) -> bool:
+        """A slice serves traffic only when every host is up — one cordoned
+        host idles the whole ICI domain."""
+        return self.unavailable_host_count() == 0
+
+
+class SliceTopology:
+    """Groups nodes into slices."""
+
+    def __init__(self, slices: dict[str, SliceInfo]) -> None:
+        self._slices = slices
+
+    @classmethod
+    def from_nodes(cls, nodes: Iterable[Node]) -> "SliceTopology":
+        slices: dict[str, SliceInfo] = {}
+        for node in nodes:
+            sid = slice_id_for_node(node)
+            info = slices.get(sid)
+            if info is None:
+                labels = node.metadata.labels
+                info = SliceInfo(
+                    slice_id=sid,
+                    accelerator=labels.get(GKE_TPU_ACCELERATOR_LABEL, ""),
+                    topology=labels.get(GKE_TPU_TOPOLOGY_LABEL, ""))
+                slices[sid] = info
+            info.nodes.append(node)
+        return cls(slices)
+
+    @property
+    def slices(self) -> dict[str, SliceInfo]:
+        return self._slices
+
+    def slice_of(self, node: Node) -> SliceInfo:
+        return self._slices[slice_id_for_node(node)]
+
+    def availability(self) -> float:
+        """Fraction of slices currently fully available — the north-star
+        "slice availability %" numerator (BASELINE.md)."""
+        if not self._slices:
+            return 1.0
+        available = sum(1 for s in self._slices.values() if s.is_available)
+        return available / len(self._slices)

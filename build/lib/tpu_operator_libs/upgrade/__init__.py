@@ -1,0 +1,39 @@
+"""The upgrade state machine and its managers.
+
+Layer map (SURVEY.md §1): this package is L2 (node-action managers) + L3
+(cluster state machine). Every manager is an injectable seam on the state
+manager, preserving the reference's pluggability-by-interface design
+(upgrade_state.go:110-115).
+"""
+
+from tpu_operator_libs.upgrade.state_provider import (  # noqa: F401
+    NodeUpgradeStateProvider,
+)
+from tpu_operator_libs.upgrade.cordon_manager import CordonManager  # noqa: F401
+from tpu_operator_libs.upgrade.drain_manager import (  # noqa: F401
+    DrainConfiguration,
+    DrainManager,
+)
+from tpu_operator_libs.upgrade.pod_manager import (  # noqa: F401
+    PodDeletionFilter,
+    PodManager,
+    PodManagerConfig,
+)
+from tpu_operator_libs.upgrade.gate import (  # noqa: F401
+    EvictionGate,
+    GateKeeper,
+)
+from tpu_operator_libs.upgrade.validation_manager import (  # noqa: F401
+    ValidationManager,
+)
+from tpu_operator_libs.upgrade.safe_load_manager import (  # noqa: F401
+    SafeRuntimeLoadManager,
+)
+from tpu_operator_libs.upgrade.state_manager import (  # noqa: F401
+    BuildStateError,
+    ClusterUpgradeState,
+    ClusterUpgradeStateManager,
+    FlatPlanner,
+    NodeUpgradeState,
+    UpgradePlanner,
+)

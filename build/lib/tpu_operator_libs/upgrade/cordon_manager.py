@@ -1,0 +1,28 @@
+"""Cordon / uncordon nodes (reference cordon_manager.go:25-56)."""
+
+from __future__ import annotations
+
+import logging
+
+from tpu_operator_libs.k8s.client import K8sClient
+from tpu_operator_libs.k8s.drain import run_cordon_or_uncordon
+from tpu_operator_libs.k8s.objects import Node
+
+logger = logging.getLogger(__name__)
+
+
+class CordonManager:
+    """Marks nodes (un)schedulable via the drain helper's cordon path."""
+
+    def __init__(self, client: K8sClient) -> None:
+        self._client = client
+
+    def cordon(self, node: Node) -> None:
+        run_cordon_or_uncordon(self._client, node.metadata.name, True)
+        node.spec.unschedulable = True
+        logger.info("cordoned node %s", node.metadata.name)
+
+    def uncordon(self, node: Node) -> None:
+        run_cordon_or_uncordon(self._client, node.metadata.name, False)
+        node.spec.unschedulable = False
+        logger.info("uncordoned node %s", node.metadata.name)

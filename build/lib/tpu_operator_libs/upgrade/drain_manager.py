@@ -1,0 +1,177 @@
+"""DrainManager: async node drain (reference drain_manager.go:32-155).
+
+One worker per node, deduplicated by an in-flight set; the worker cordons,
+drains, then commits the outcome as the node's next state label
+(pod-restart-required on success, upgrade-failed on any failure). The state
+write is the only side channel back to the state machine — the reconcile
+loop discovers the result on its next pass.
+"""
+
+from __future__ import annotations
+
+import logging
+from dataclasses import dataclass, field
+from typing import Optional
+
+from tpu_operator_libs.api.upgrade_policy import DrainSpec
+from tpu_operator_libs.consts import UpgradeState
+from tpu_operator_libs.k8s.client import (
+    ApiServerError,
+    ConflictError,
+    K8sClient,
+)
+from tpu_operator_libs.k8s.drain import DrainHelper, run_cordon_or_uncordon
+from tpu_operator_libs.k8s.objects import Node
+from tpu_operator_libs.upgrade.state_provider import NodeUpgradeStateProvider
+from tpu_operator_libs.util import (
+    Clock,
+    Event,
+    EventRecorder,
+    NameSet,
+    Worker,
+    log_event,
+)
+
+logger = logging.getLogger(__name__)
+
+
+@dataclass
+class DrainConfiguration:
+    """Drain spec plus target nodes (drain_manager.go:33-36)."""
+
+    spec: Optional[DrainSpec]
+    nodes: list[Node] = field(default_factory=list)
+
+
+class DrainManager:
+    def __init__(self, client: K8sClient,
+                 provider: NodeUpgradeStateProvider,
+                 recorder: Optional[EventRecorder] = None,
+                 clock: Optional[Clock] = None,
+                 worker: Optional[Worker] = None,
+                 eviction_gate=None) -> None:
+        self._client = client
+        self._provider = provider
+        self._recorder = recorder
+        self._clock = clock or Clock()
+        self._worker = worker or Worker()
+        self._draining_nodes = NameSet()
+        # Same veto as PodManager's eviction_gate: drain must not destroy
+        # a workload whose checkpoint is not yet durable — otherwise the
+        # pod-deletion→drain fallback would bypass the durability
+        # guarantee entirely. Shared semantics via GateKeeper.
+        from tpu_operator_libs.upgrade.gate import GateKeeper
+
+        self._gatekeeper = GateKeeper(provider.keys, recorder, "drain")
+        self._gatekeeper.set_gate(eviction_gate)
+        self._keys = provider.keys
+
+    @property
+    def eviction_gate(self):
+        return self._gatekeeper.gate
+
+    def set_eviction_gate(self, gate) -> None:
+        self._gatekeeper.set_gate(gate)
+
+    def schedule_nodes_drain(self, config: DrainConfiguration) -> None:
+        """Schedule an async drain per node (drain_manager.go:58-138)."""
+        if not config.nodes:
+            logger.info("no nodes scheduled to drain")
+            return
+        spec = config.spec
+        if spec is None:
+            raise ValueError("drain spec should not be empty")
+        if not spec.enable:
+            logger.info("drain is disabled")
+            return
+
+        helper = DrainHelper(
+            client=self._client,
+            force=spec.force,
+            # TPU runtime pods are DaemonSet-owned, like the reference's
+            # OFED driver pods (drain_manager.go:80-82) — never drain them.
+            ignore_all_daemon_sets=True,
+            delete_empty_dir_data=spec.delete_empty_dir,
+            timeout_seconds=spec.timeout_seconds,
+            pod_selector=spec.pod_selector,
+            on_pod_deleted=lambda pod: logger.info(
+                "evicted pod %s/%s", pod.namespace, pod.name),
+            clock=self._clock,
+        )
+
+        for node in config.nodes:
+            if not self._draining_nodes.add(node.metadata.name):
+                logger.info("node %s is already being drained, skipping",
+                            node.metadata.name)
+                continue
+            logger.info("schedule drain for node %s", node.metadata.name)
+            log_event(self._recorder, node, Event.NORMAL,
+                      self._keys.event_reason, "Scheduling drain of the node")
+            self._worker.submit(lambda n=node: self._drain_node(n, helper))
+
+    def _drain_node(self, node: Node, helper: DrainHelper) -> None:
+        name = node.metadata.name
+        try:
+            if self._gatekeeper.gate is not None:
+                try:
+                    pods, _ = helper.get_pods_for_deletion(name)
+                except Exception as exc:  # noqa: BLE001 — worker boundary
+                    # Cannot even enumerate pods (transient API error):
+                    # park in drain-required and retry next reconcile —
+                    # delay, never escalate.
+                    logger.warning("could not enumerate pods for gate on "
+                                   "node %s; deferring drain: %s",
+                                   name, exc)
+                    return
+                # Park in drain-required until the gate opens; a raising
+                # gate only delays, never escalates (GateKeeper semantics).
+                if not self._gatekeeper.allows(node, pods):
+                    return
+            try:
+                run_cordon_or_uncordon(self._client, name, True)
+            except (ApiServerError, ConflictError) as exc:
+                # Transient apiserver failure: marking the node
+                # upgrade-failed would strand it (its pod is out of sync,
+                # so auto-recovery can never fire). Stay drain-required
+                # and let the next reconcile retry.
+                logger.warning("transient error cordoning node %s; "
+                               "deferring drain: %s", name, exc)
+                return
+            except Exception as exc:  # noqa: BLE001 — worker boundary
+                logger.error("failed to cordon node %s: %s", name, exc)
+                self._fail(node, f"Failed to cordon the node: {exc}")
+                return
+            logger.info("cordoned node %s", name)
+            try:
+                helper.run_node_drain(name)
+            except (ApiServerError, ConflictError) as exc:
+                logger.warning("transient error draining node %s; "
+                               "deferring drain: %s", name, exc)
+                return
+            except Exception as exc:  # noqa: BLE001 — worker boundary
+                logger.error("failed to drain node %s: %s", name, exc)
+                self._fail(node, f"Failed to drain the node: {exc}")
+                return
+            logger.info("drained node %s", name)
+            log_event(self._recorder, node, Event.NORMAL,
+                      self._keys.event_reason, "Successfully drained the node")
+            self._change_state_quietly(
+                node, UpgradeState.POD_RESTART_REQUIRED)
+        finally:
+            self._draining_nodes.remove(name)
+
+    def _fail(self, node: Node, message: str) -> None:
+        self._change_state_quietly(node, UpgradeState.FAILED)
+        log_event(self._recorder, node, Event.WARNING,
+                  self._keys.event_reason, message)
+
+    def _change_state_quietly(self, node: Node, state: UpgradeState) -> None:
+        try:
+            self._provider.change_node_upgrade_state(node, state)
+        except Exception as exc:  # noqa: BLE001 — worker boundary
+            logger.error("failed to change state of node %s to %s: %s",
+                         node.metadata.name, state, exc)
+
+    def join(self, timeout: float = 30.0) -> None:
+        """Wait for in-flight drain workers (test/sim helper)."""
+        self._worker.join(timeout)

@@ -1,0 +1,67 @@
+"""Shared eviction-gate evaluation for the pod-deletion and drain paths.
+
+One implementation of the safety-critical semantics both managers need
+(pod_manager / drain_manager): a closed gate parks the node, a RAISING gate
+counts as closed (delay, never escalate — escalation would bypass the
+checkpoint-durability guarantee), and the deferral event is emitted once
+per parked node, not on every reconcile pass.
+"""
+
+from __future__ import annotations
+
+import logging
+from typing import Callable, Optional
+
+from tpu_operator_libs.consts import UpgradeKeys
+from tpu_operator_libs.k8s.objects import Node, Pod
+from tpu_operator_libs.util import Event, EventRecorder, NameSet, log_event
+
+logger = logging.getLogger(__name__)
+
+#: (node, pods about to be evicted) -> True when eviction may proceed.
+EvictionGate = Callable[[Node, list[Pod]], bool]
+
+
+class GateKeeper:
+    """Evaluates an optional EvictionGate with park-don't-escalate
+    semantics and one-shot deferral events."""
+
+    def __init__(self, keys: UpgradeKeys,
+                 recorder: Optional[EventRecorder],
+                 action: str) -> None:
+        self._gate: Optional[EvictionGate] = None
+        self._keys = keys
+        self._recorder = recorder
+        self._action = action  # "pod deletion" | "drain" — event wording
+        self._deferred = NameSet()
+
+    @property
+    def gate(self) -> Optional[EvictionGate]:
+        return self._gate
+
+    def set_gate(self, gate: Optional[EvictionGate]) -> None:
+        self._gate = gate
+
+    def allows(self, node: Node, pods: list[Pod]) -> bool:
+        """True when the gate is absent or open. On False the caller must
+        leave the node in its current state for the next reconcile."""
+        if self._gate is None:
+            return True
+        name = node.metadata.name
+        try:
+            open_ = bool(self._gate(node, pods))
+        except Exception as exc:  # noqa: BLE001 — gate boundary
+            logger.warning("eviction gate raised for node %s (treating as "
+                           "closed): %s", name, exc)
+            open_ = False
+        if open_:
+            self._deferred.remove(name)
+            return True
+        logger.info("eviction gate closed for node %s; deferring %s",
+                    name, self._action)
+        if self._deferred.add(name):
+            log_event(self._recorder, node, Event.NORMAL,
+                      self._keys.event_reason,
+                      f"{self._action.capitalize()} deferred: "
+                      f"checkpoint/eviction gate not yet open")
+        return False

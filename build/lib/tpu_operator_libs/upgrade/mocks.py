@@ -1,0 +1,186 @@
+"""Recording mock managers for state-machine-isolation tests.
+
+Equivalent of the reference's mockery-generated testify mocks
+(pkg/upgrade/mocks/): drop-in implementations of every manager seam on
+ClusterUpgradeStateManager that record calls and apply the observable side
+effect in memory (e.g. the mocked state provider just mutates the node's
+label, mirroring upgrade_suit_test.go:100-105), so transition logic can be
+tested without any cluster at all.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from typing import Optional
+
+from tpu_operator_libs.consts import NULL_STRING, UpgradeKeys
+from tpu_operator_libs.k8s.objects import Node
+
+
+@dataclass
+class Call:
+    method: str
+    args: tuple
+
+    def __repr__(self) -> str:
+        return f"{self.method}{self.args!r}"
+
+
+class RecordingMixin:
+    def __init__(self) -> None:
+        self.calls: list[Call] = []
+
+    def record(self, method: str, *args) -> None:
+        self.calls.append(Call(method, args))
+
+    def calls_to(self, method: str) -> list[Call]:
+        return [c for c in self.calls if c.method == method]
+
+
+class MockNodeUpgradeStateProvider(RecordingMixin):
+    """Mutates node labels/annotations in memory (no cluster, no polling)."""
+
+    def __init__(self, keys: Optional[UpgradeKeys] = None) -> None:
+        super().__init__()
+        self.keys = keys or UpgradeKeys()
+        self.fail_next: Optional[Exception] = None
+
+    def _maybe_fail(self) -> None:
+        if self.fail_next is not None:
+            exc, self.fail_next = self.fail_next, None
+            raise exc
+
+    def get_node(self, name: str) -> Node:
+        raise NotImplementedError(
+            "MockNodeUpgradeStateProvider has no store; tests build "
+            "snapshots directly")
+
+    def change_node_upgrade_state(self, node: Node, new_state) -> None:
+        self.record("change_node_upgrade_state", node.metadata.name,
+                    str(new_state))
+        self._maybe_fail()
+        node.metadata.labels[self.keys.state_label] = str(new_state)
+
+    def change_node_upgrade_annotation(self, node: Node, key: str,
+                                       value) -> None:
+        self.record("change_node_upgrade_annotation", node.metadata.name,
+                    key, value)
+        self._maybe_fail()
+        if value is None or value == NULL_STRING:
+            node.metadata.annotations.pop(key, None)
+        else:
+            node.metadata.annotations[key] = value
+
+
+class MockCordonManager(RecordingMixin):
+    def __init__(self) -> None:
+        super().__init__()
+        self.fail_next: Optional[Exception] = None
+
+    def cordon(self, node: Node) -> None:
+        self.record("cordon", node.metadata.name)
+        if self.fail_next is not None:
+            exc, self.fail_next = self.fail_next, None
+            raise exc
+        node.spec.unschedulable = True
+
+    def uncordon(self, node: Node) -> None:
+        self.record("uncordon", node.metadata.name)
+        if self.fail_next is not None:
+            exc, self.fail_next = self.fail_next, None
+            raise exc
+        node.spec.unschedulable = False
+
+
+class MockDrainManager(RecordingMixin):
+    def __init__(self) -> None:
+        super().__init__()
+        self.fail_next: Optional[Exception] = None
+
+    def schedule_nodes_drain(self, config) -> None:
+        self.record("schedule_nodes_drain",
+                    tuple(n.metadata.name for n in config.nodes))
+        if self.fail_next is not None:
+            exc, self.fail_next = self.fail_next, None
+            raise exc
+
+    def join(self, timeout: float = 0.0) -> None:
+        pass
+
+
+class MockPodManager(RecordingMixin):
+    """Revision hashes come from an in-memory dict (default: everything in
+    sync with hash 'test-hash-12345', upgrade_suit_test.go:144-156)."""
+
+    def __init__(self) -> None:
+        super().__init__()
+        self.pod_hashes: dict[str, str] = {}
+        self.ds_hashes: dict[str, str] = {}
+        self.default_hash = "test-hash-12345"
+
+    def get_pod_revision_hash(self, pod) -> str:
+        self.record("get_pod_revision_hash", pod.name)
+        return self.pod_hashes.get(pod.name, self.default_hash)
+
+    def get_daemon_set_revision_hash(self, ds) -> str:
+        self.record("get_daemon_set_revision_hash", ds.name)
+        return self.ds_hashes.get(ds.name, self.default_hash)
+
+    def schedule_pod_eviction(self, config) -> None:
+        self.record("schedule_pod_eviction",
+                    tuple(n.metadata.name for n in config.nodes))
+
+    def schedule_pods_restart(self, pods) -> None:
+        self.record("schedule_pods_restart", tuple(p.name for p in pods))
+
+    def schedule_check_on_pod_completion(self, config) -> None:
+        self.record("schedule_check_on_pod_completion",
+                    tuple(n.metadata.name for n in config.nodes))
+
+    def join(self, timeout: float = 0.0) -> None:
+        pass
+
+
+class MockValidationManager(RecordingMixin):
+    def __init__(self, result: bool = True) -> None:
+        super().__init__()
+        self.result = result
+
+    def validate(self, node: Node) -> bool:
+        self.record("validate", node.metadata.name)
+        return self.result
+
+    def check(self, node: Node) -> bool:
+        self.record("check", node.metadata.name)
+        return self.result
+
+
+class MockSafeLoadManager(RecordingMixin):
+    def __init__(self, keys: Optional[UpgradeKeys] = None) -> None:
+        super().__init__()
+        self.keys = keys or UpgradeKeys()
+
+    def is_waiting_for_safe_load(self, node: Node) -> bool:
+        self.record("is_waiting_for_safe_load", node.metadata.name)
+        return bool(node.metadata.annotations.get(
+            self.keys.wait_for_safe_load_annotation))
+
+    def unblock_loading(self, node: Node) -> None:
+        self.record("unblock_loading", node.metadata.name)
+        node.metadata.annotations.pop(
+            self.keys.wait_for_safe_load_annotation, None)
+
+
+def mock_managers(keys: Optional[UpgradeKeys] = None) -> dict:
+    """Kwargs bundle: ClusterUpgradeStateManager(client, keys,
+    **mock_managers()) wires every seam to a mock (the reference swaps the
+    fields the same way, upgrade_state_test.go:48-56)."""
+    keys = keys or UpgradeKeys()
+    return {
+        "provider": MockNodeUpgradeStateProvider(keys),
+        "cordon_manager": MockCordonManager(),
+        "drain_manager": MockDrainManager(),
+        "pod_manager": MockPodManager(),
+        "validation_manager": MockValidationManager(),
+        "safe_load_manager": MockSafeLoadManager(keys),
+    }

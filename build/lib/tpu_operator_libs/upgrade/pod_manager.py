@@ -1,0 +1,363 @@
+"""PodManager: eviction, restarts, completion-waits and the revision oracle.
+
+Equivalent of the reference PodManager (pod_manager.go). Four jobs:
+
+a. ``schedule_pod_eviction`` — delete workload pods selected by the injected
+   deletion filter, one async worker per node, deduplicated by an in-flight
+   set (pod_manager.go:125-232).
+b. ``schedule_pods_restart`` — delete runtime pods so the DaemonSet
+   recreates them at the new revision (pod_manager.go:236-254).
+c. ``schedule_check_on_pod_completion`` — wait for workload pods to finish,
+   with the timeout checkpointed in a node annotation so it survives
+   reconciles (pod_manager.go:259-320, 333-371).
+d. revision-hash getters — the "does this node need an upgrade" oracle:
+   compare the pod's ``controller-revision-hash`` label with the DaemonSet's
+   newest ControllerRevision (pod_manager.go:83-121).
+"""
+
+from __future__ import annotations
+
+import logging
+from dataclasses import dataclass, field
+from typing import Callable, Optional
+
+from tpu_operator_libs.api.upgrade_policy import (
+    PodDeletionSpec,
+    WaitForCompletionSpec,
+)
+from tpu_operator_libs.consts import (
+    POD_CONTROLLER_REVISION_HASH_LABEL,
+    UpgradeState,
+)
+from tpu_operator_libs.k8s.client import (
+    ApiServerError,
+    ConflictError,
+    K8sClient,
+)
+from tpu_operator_libs.k8s.drain import DrainHelper, PodDeleteStatus
+from tpu_operator_libs.k8s.objects import DaemonSet, Node, Pod, PodPhase
+from tpu_operator_libs.k8s.selectors import selector_from_labels
+from tpu_operator_libs.upgrade.state_provider import NodeUpgradeStateProvider
+from tpu_operator_libs.util import (
+    Clock,
+    Event,
+    EventRecorder,
+    NameSet,
+    Worker,
+    log_event,
+)
+
+logger = logging.getLogger(__name__)
+
+#: Decides whether a workload pod must be deleted before the runtime upgrade
+#: (reference PodDeletionFilter, pod_manager.go:76).
+PodDeletionFilter = Callable[[Pod], bool]
+
+#: Eviction-time veto: called with (node, pods_to_delete) right before
+#: eviction; returning False leaves the node parked in
+#: pod-deletion-required for the next reconcile. Unlike the deletion
+#: *filter* (which silently skips pods), a closed gate blocks progress —
+#: the hook the Orbax checkpoint-durability gate plugs into
+#: (tpu_operator_libs.health.checkpoint_gate; BASELINE config #4).
+#: Shared semantics live in tpu_operator_libs.upgrade.gate.GateKeeper.
+from tpu_operator_libs.upgrade.gate import EvictionGate, GateKeeper  # noqa: E402,F401
+
+
+@dataclass
+class PodManagerConfig:
+    """Selector/config bundle for pod jobs (pod_manager.go:63-68)."""
+
+    nodes: list[Node] = field(default_factory=list)
+    deletion_spec: Optional[PodDeletionSpec] = None
+    wait_for_completion_spec: Optional[WaitForCompletionSpec] = None
+    drain_enabled: bool = False
+
+
+class RevisionHashError(RuntimeError):
+    """Revision hash could not be determined."""
+
+
+class PodManager:
+    def __init__(self, client: K8sClient,
+                 provider: NodeUpgradeStateProvider,
+                 deletion_filter: Optional[PodDeletionFilter] = None,
+                 recorder: Optional[EventRecorder] = None,
+                 clock: Optional[Clock] = None,
+                 worker: Optional[Worker] = None,
+                 eviction_gate: Optional[EvictionGate] = None) -> None:
+        self._client = client
+        self._provider = provider
+        self._deletion_filter = deletion_filter
+        self._gatekeeper = GateKeeper(provider.keys, recorder,
+                                      "pod deletion")
+        self._gatekeeper.set_gate(eviction_gate)
+        self._recorder = recorder
+        self._clock = clock or Clock()
+        self._worker = worker or Worker()
+        self._nodes_in_progress = NameSet()
+        self._keys = provider.keys
+
+    @property
+    def deletion_filter(self) -> Optional[PodDeletionFilter]:
+        return self._deletion_filter
+
+    @property
+    def eviction_gate(self) -> Optional[EvictionGate]:
+        return self._gatekeeper.gate
+
+    def set_eviction_gate(self, gate: Optional[EvictionGate]) -> None:
+        self._gatekeeper.set_gate(gate)
+
+    # ------------------------------------------------------------------
+    # (d) revision oracle
+    # ------------------------------------------------------------------
+    def get_pod_revision_hash(self, pod: Pod) -> str:
+        """Pod's controller-revision-hash label (pod_manager.go:87-92)."""
+        try:
+            return pod.metadata.labels[POD_CONTROLLER_REVISION_HASH_LABEL]
+        except KeyError:
+            raise RevisionHashError(
+                f"controller-revision-hash label not present for pod "
+                f"{pod.name}") from None
+
+    def get_daemon_set_revision_hash(self, ds: DaemonSet) -> str:
+        """Newest ControllerRevision hash for the DaemonSet
+        (pod_manager.go:95-121).
+
+        The reference selects revisions by "name starts with the DS name",
+        which collides between DaemonSets sharing a name prefix
+        (pod_manager.go:106). We additionally require the suffix after
+        ``<name>-`` to be a single hash segment (no further dashes), which
+        holds for controller-generated revision names.
+        """
+        selector = selector_from_labels(ds.spec.selector)
+        revisions = self._client.list_controller_revisions(
+            ds.metadata.namespace, selector)
+        prefix = f"{ds.metadata.name}-"
+        owned = [r for r in revisions
+                 if r.metadata.name.startswith(prefix)
+                 and "-" not in r.metadata.name[len(prefix):]]
+        if not owned:
+            raise RevisionHashError(
+                f"no revision found for daemonset {ds.metadata.name}")
+        newest = max(owned, key=lambda r: r.revision)
+        return newest.metadata.name[len(prefix):]
+
+    # ------------------------------------------------------------------
+    # (a) pod eviction
+    # ------------------------------------------------------------------
+    def schedule_pod_eviction(self, config: PodManagerConfig) -> None:
+        """Delete filter-selected pods on each node, async per node
+        (pod_manager.go:125-232). On success the node moves to
+        pod-restart-required; on failure to drain-required when drain is
+        enabled, else upgrade-failed (pod_manager.go:396-406)."""
+        if not config.nodes:
+            logger.info("no nodes scheduled for pod deletion")
+            return
+        spec = config.deletion_spec
+        if spec is None:
+            raise ValueError("pod deletion spec should not be empty")
+        if self._deletion_filter is None:
+            raise ValueError("pod deletion filter not configured")
+
+        def additional_filter(pod: Pod) -> PodDeleteStatus:
+            if self._deletion_filter(pod):
+                return PodDeleteStatus.okay()
+            return PodDeleteStatus.skip("not selected by deletion filter")
+
+        helper = DrainHelper(
+            client=self._client,
+            force=spec.force,
+            ignore_all_daemon_sets=True,
+            delete_empty_dir_data=spec.delete_empty_dir,
+            timeout_seconds=spec.timeout_seconds,
+            additional_filters=[additional_filter],
+            clock=self._clock,
+        )
+
+        for node in config.nodes:
+            if not self._nodes_in_progress.add(node.metadata.name):
+                logger.info("node %s already getting pods deleted, skipping",
+                            node.metadata.name)
+                continue
+            self._worker.submit(
+                lambda n=node: self._evict_node_pods(n, helper, config))
+
+    def _evict_node_pods(self, node: Node, helper: DrainHelper,
+                         config: PodManagerConfig) -> None:
+        name = node.metadata.name
+        try:
+            pods = self._client.list_pods(
+                namespace=None, field_selector=f"spec.nodeName={name}")
+            to_delete = [p for p in pods if self._deletion_filter(p)]
+            if not to_delete:
+                logger.info("no pods require deletion on node %s", name)
+                self._change_state_quietly(
+                    node, UpgradeState.POD_RESTART_REQUIRED)
+                return
+
+            # Gate check comes FIRST: while the workload's checkpoint is
+            # not durable the node must park in pod-deletion-required — no
+            # path below (including the drain fallback) may run.
+            if not self._gatekeeper.allows(node, to_delete):
+                return
+
+            deletable, errors = helper.get_pods_for_deletion(name)
+            if len(deletable) != len(to_delete):
+                logger.error("cannot delete all required pods on %s: %s",
+                             name, errors)
+                self._update_node_to_drain_or_failed(
+                    node, config.drain_enabled)
+                return
+
+            helper.delete_or_evict_pods(deletable)
+            logger.info("deleted pods on node %s", name)
+            self._change_state_quietly(
+                node, UpgradeState.POD_RESTART_REQUIRED)
+            log_event(self._recorder, node, Event.NORMAL,
+                      self._keys.event_reason,
+                      "Deleted workload pods on the node for the runtime "
+                      "upgrade")
+        except (ApiServerError, ConflictError) as exc:
+            # Transient apiserver failure: escalating to drain-or-failed
+            # could strand the node in upgrade-failed (out-of-sync pod ⇒
+            # auto-recovery can never fire). Park in
+            # pod-deletion-required; the next reconcile retries.
+            logger.warning("transient error deleting pods on node %s; "
+                           "deferring: %s", name, exc)
+        except Exception as exc:  # noqa: BLE001 — worker boundary
+            logger.error("failed to delete pods on node %s: %s", name, exc)
+            log_event(self._recorder, node, Event.WARNING,
+                      self._keys.event_reason,
+                      f"Failed to delete workload pods on the node for the "
+                      f"runtime upgrade: {exc}")
+            self._update_node_to_drain_or_failed(node, config.drain_enabled)
+        finally:
+            self._nodes_in_progress.remove(name)
+
+    def _update_node_to_drain_or_failed(self, node: Node,
+                                        drain_enabled: bool) -> None:
+        next_state = UpgradeState.FAILED
+        if drain_enabled:
+            logger.info("pod deletion failed on %s; drain enabled, will "
+                        "attempt node drain", node.metadata.name)
+            log_event(self._recorder, node, Event.WARNING,
+                      self._keys.event_reason,
+                      "Pod deletion failed but drain is enabled in spec. "
+                      "Will attempt a node drain")
+            next_state = UpgradeState.DRAIN_REQUIRED
+        self._change_state_quietly(node, next_state)
+
+    def _change_state_quietly(self, node: Node, state: UpgradeState) -> None:
+        """State write from an async worker: errors are logged, not raised —
+        the next reconcile re-derives the correct action (the reference
+        ignores these errors outright, pod_manager.go:189,223)."""
+        try:
+            self._provider.change_node_upgrade_state(node, state)
+        except Exception as exc:  # noqa: BLE001 — worker boundary
+            logger.error("failed to change state of node %s to %s: %s",
+                         node.metadata.name, state, exc)
+
+    # ------------------------------------------------------------------
+    # (b) restart runtime pods
+    # ------------------------------------------------------------------
+    def schedule_pods_restart(self, pods: list[Pod]) -> None:
+        """Delete runtime pods so the DaemonSet controller recreates them
+        with the new template (pod_manager.go:236-254). Synchronous; an
+        error aborts the reconcile pass."""
+        if not pods:
+            logger.info("no pods scheduled to restart")
+            return
+        from tpu_operator_libs.k8s.client import NotFoundError
+
+        for pod in pods:
+            logger.info("deleting pod %s", pod.name)
+            try:
+                self._client.delete_pod(pod.namespace, pod.name)
+            except NotFoundError:
+                # Already gone (e.g. a concurrent reconcile won the race):
+                # the restart goal is achieved — idempotent by design.
+                logger.info("pod %s already deleted", pod.name)
+            except Exception as exc:
+                log_event(self._recorder, pod, Event.WARNING,
+                          self._keys.event_reason,
+                          f"Failed to restart runtime pod: {exc}")
+                raise
+
+    # ------------------------------------------------------------------
+    # (c) wait for workload completion
+    # ------------------------------------------------------------------
+    def schedule_check_on_pod_completion(self,
+                                         config: PodManagerConfig) -> None:
+        """Per node: if no selected workload pod is still running/pending,
+        advance to pod-deletion-required; otherwise keep waiting, enforcing
+        the policy timeout via a start-time annotation
+        (pod_manager.go:259-320).
+
+        The reference spawns one goroutine per node but joins them all
+        before returning (wg.Wait, pod_manager.go:318); sequential execution
+        is observably identical and deterministic.
+        """
+        spec = config.wait_for_completion_spec
+        assert spec is not None
+        for node in config.nodes:
+            pods = self._client.list_pods(
+                namespace=None, label_selector=spec.pod_selector,
+                field_selector=f"spec.nodeName={node.metadata.name}")
+            running = any(self.is_pod_running_or_pending(p) for p in pods)
+            if running:
+                logger.info("workload pods still running on node %s",
+                            node.metadata.name)
+                if spec.timeout_seconds != 0:
+                    try:
+                        self.handle_timeout_on_pod_completions(
+                            node, spec.timeout_seconds)
+                    except Exception as exc:  # noqa: BLE001
+                        log_event(self._recorder, node, Event.WARNING,
+                                  self._keys.event_reason,
+                                  f"Failed to handle timeout for job "
+                                  f"completions: {exc}")
+                continue
+            annotation = self._keys.pod_completion_start_annotation
+            try:
+                self._provider.change_node_upgrade_annotation(
+                    node, annotation, None)
+            except Exception as exc:  # noqa: BLE001
+                log_event(self._recorder, node, Event.WARNING,
+                          self._keys.event_reason,
+                          f"Failed to remove annotation used to track job "
+                          f"completions: {exc}")
+                continue
+            self._change_state_quietly(
+                node, UpgradeState.POD_DELETION_REQUIRED)
+
+    def handle_timeout_on_pod_completions(self, node: Node,
+                                          timeout_seconds: int) -> None:
+        """Start or check the wait-for-jobs timer (pod_manager.go:333-371):
+        first sighting stamps the start-time annotation; once expired the
+        node is forced to pod-deletion-required and the stamp removed."""
+        annotation = self._keys.pod_completion_start_annotation
+        now = int(self._clock.now())
+        stamp = node.metadata.annotations.get(annotation)
+        if stamp is None:
+            self._provider.change_node_upgrade_annotation(
+                node, annotation, str(now))
+            return
+        start = int(stamp)
+        if now > start + timeout_seconds:
+            self._change_state_quietly(
+                node, UpgradeState.POD_DELETION_REQUIRED)
+            logger.info("timeout exceeded for job completions on node %s",
+                        node.metadata.name)
+            self._provider.change_node_upgrade_annotation(
+                node, annotation, None)
+
+    @staticmethod
+    def is_pod_running_or_pending(pod: Pod) -> bool:
+        """Running/Pending block progress; Succeeded/Failed do not
+        (pod_manager.go:374-394)."""
+        return pod.status.phase in (PodPhase.RUNNING, PodPhase.PENDING)
+
+    def join(self, timeout: float = 30.0) -> None:
+        """Wait for in-flight async eviction workers (test/sim helper)."""
+        self._worker.join(timeout)

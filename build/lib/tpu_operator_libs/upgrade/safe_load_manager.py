@@ -1,0 +1,45 @@
+"""Safe runtime load: first-load handshake with the libtpu init container.
+
+Reference: safe_driver_load_manager.go:28-89 and the protocol description in
+docs/automatic-ofed-upgrade.md:43-66. The TPU flavour is identical in shape:
+
+1. The libtpu DaemonSet pod's init container sets the
+   ``wait-for-safe-load`` annotation on its Node and blocks.
+2. The state manager treats that annotation as an upgrade trigger
+   (upgrade_state.go:499-508) and walks the node through cordon/drain.
+3. Once the node reaches pod-restart-required (workloads gone), the manager
+   deletes the annotation instead of restarting the pod
+   (upgrade_state.go:783); the init container unblocks and libtpu loads
+   with the TPU chips guaranteed idle.
+"""
+
+from __future__ import annotations
+
+import logging
+
+from tpu_operator_libs.k8s.objects import Node
+from tpu_operator_libs.upgrade.state_provider import NodeUpgradeStateProvider
+
+logger = logging.getLogger(__name__)
+
+
+class SafeRuntimeLoadManager:
+    def __init__(self, provider: NodeUpgradeStateProvider) -> None:
+        self._provider = provider
+        self._keys = provider.keys
+
+    def is_waiting_for_safe_load(self, node: Node) -> bool:
+        """True when the node's runtime pod is blocked awaiting safe load
+        (safe_driver_load_manager.go:51-53)."""
+        return bool(node.metadata.annotations.get(
+            self._keys.wait_for_safe_load_annotation))
+
+    def unblock_loading(self, node: Node) -> None:
+        """Delete the safe-load annotation, releasing the init container
+        (safe_driver_load_manager.go:57-71). No-op when not set."""
+        if not self.is_waiting_for_safe_load(node):
+            return
+        self._provider.change_node_upgrade_annotation(
+            node, self._keys.wait_for_safe_load_annotation, None)
+        logger.info("unblocked safe runtime load on node %s",
+                    node.metadata.name)

@@ -1,0 +1,728 @@
+"""ClusterUpgradeStateManager — the cluster-wide upgrade state machine.
+
+Equivalent of the reference's upgrade_state.go:40-1120. One reconcile is:
+
+1. ``build_state``: snapshot every runtime pod + its DaemonSet + its node,
+   bucketed by the node's upgrade-state label (upgrade_state.go:214-279).
+2. ``apply_state``: one pass over the buckets in fixed order, moving each
+   node at most one transition along the graph (upgrade_state.go:364-484):
+
+   unknown ─┬─(pod in sync)──────────────────────────→ upgrade-done
+            └─(out of sync | safe-load | requested)──→ upgrade-required
+   upgrade-required ─(slot available)→ cordon-required
+   cordon-required ─(cordon ok)→ wait-for-jobs-required
+   wait-for-jobs-required ─(jobs done | timeout)→ pod-deletion-required
+                                     [drain-required if deletion disabled]
+   pod-deletion-required ─(ok)→ pod-restart-required ; fail→ drain|failed
+   drain-required ─(drain ok)→ pod-restart-required ; fail→ upgrade-failed
+   pod-restart-required ─(pod recreated & ready)→ validation-required
+                                     [uncordon-required | upgrade-done]
+   validation-required ─(gate passes)→ uncordon-required | upgrade-done
+   uncordon-required ─(uncordon ok)→ upgrade-done
+   upgrade-failed ─(pod healthy again)→ uncordon-required | upgrade-done
+
+``apply_state`` is stateless and idempotent: every decision derives from
+the snapshot, and every transition is committed as a node label before any
+further progress, so a crashed operator resumes mid-upgrade for free
+(upgrade_state.go:68-72; SURVEY.md §5 "checkpoint/resume").
+
+TPU-specific departure: node selection in upgrade-required is delegated to
+a pluggable :class:`UpgradePlanner`. The default :class:`FlatPlanner`
+reproduces the reference's per-node slot loop; the slice-aware planner in
+``tpu_operator_libs.topology`` advances whole ICI domains atomically,
+because draining one host of a multi-host TPU slice idles the entire slice
+(SURVEY.md §5 "long-context / topology-coupled upgrade ordering").
+"""
+
+from __future__ import annotations
+
+import logging
+from dataclasses import dataclass, field
+from typing import Optional, Protocol
+
+from tpu_operator_libs.api.upgrade_policy import (
+    UpgradePolicySpec,
+    scaled_value_from_int_or_percent,
+)
+from tpu_operator_libs.consts import (
+    ALL_STATES,
+    IN_PROGRESS_STATES,
+    TRUE_STRING,
+    UpgradeKeys,
+    UpgradeState,
+)
+from tpu_operator_libs.k8s.client import K8sClient
+from tpu_operator_libs.k8s.objects import DaemonSet, Node, Pod, PodPhase
+from tpu_operator_libs.k8s.selectors import selector_from_labels
+from tpu_operator_libs.upgrade.cordon_manager import CordonManager
+from tpu_operator_libs.upgrade.drain_manager import (
+    DrainConfiguration,
+    DrainManager,
+)
+from tpu_operator_libs.upgrade.pod_manager import (
+    PodDeletionFilter,
+    PodManager,
+    PodManagerConfig,
+)
+from tpu_operator_libs.upgrade.safe_load_manager import SafeRuntimeLoadManager
+from tpu_operator_libs.upgrade.state_provider import NodeUpgradeStateProvider
+from tpu_operator_libs.upgrade.validation_manager import (
+    NodeValidator,
+    ValidationManager,
+)
+from tpu_operator_libs.util import Clock, EventRecorder, Worker
+
+logger = logging.getLogger(__name__)
+
+#: A runtime pod restarted more than this many times while not ready is
+#: considered failing (upgrade_state.go:966-978).
+POD_RESTART_FAILURE_THRESHOLD = 10
+
+
+class BuildStateError(RuntimeError):
+    """build_state could not produce a consistent snapshot."""
+
+
+@dataclass
+class NodeUpgradeState:
+    """A node, the runtime pod on it, and the owning DaemonSet
+    (upgrade_state.go:40-49)."""
+
+    node: Node
+    runtime_pod: Pod
+    runtime_daemon_set: Optional[DaemonSet]
+
+    def is_orphaned(self) -> bool:
+        return self.runtime_daemon_set is None
+
+
+@dataclass
+class ClusterUpgradeState:
+    """Snapshot of the cluster bucketed by upgrade state
+    (upgrade_state.go:51-62)."""
+
+    node_states: dict[str, list[NodeUpgradeState]] = field(
+        default_factory=dict)
+
+    def bucket(self, state: UpgradeState | str) -> list[NodeUpgradeState]:
+        return self.node_states.get(str(state), [])
+
+
+class UpgradePlanner(Protocol):
+    """Chooses which upgrade-required nodes start upgrading this pass."""
+
+    def plan(self, candidates: list[NodeUpgradeState], available: int,
+             state: "ClusterUpgradeState") -> list[NodeUpgradeState]:
+        """Return the subset of ``candidates`` to advance to
+        cordon-required, at most ``available`` plus any already-cordoned
+        nodes (which may proceed even without slots,
+        upgrade_state.go:606-616)."""
+        ...
+
+
+class FlatPlanner:
+    """Reference-parity planner: first-come order, one slot per node, with
+    the manual-cordon override (upgrade_state.go:587-631)."""
+
+    def plan(self, candidates: list[NodeUpgradeState], available: int,
+             state: ClusterUpgradeState) -> list[NodeUpgradeState]:
+        selected = []
+        for ns in candidates:
+            if available <= 0:
+                if ns.node.is_unschedulable():
+                    # already cordoned (manually or by a previous pass):
+                    # proceeding does not reduce availability further.
+                    selected.append(ns)
+                continue
+            selected.append(ns)
+            available -= 1
+        return selected
+
+
+class ClusterUpgradeStateManager:
+    """The state machine hub (upgrade_state.go:104-151)."""
+
+    def __init__(self, client: K8sClient,
+                 keys: Optional[UpgradeKeys] = None,
+                 recorder: Optional[EventRecorder] = None,
+                 clock: Optional[Clock] = None,
+                 async_workers: bool = True,
+                 provider: Optional[NodeUpgradeStateProvider] = None,
+                 cordon_manager: Optional[CordonManager] = None,
+                 drain_manager: Optional[DrainManager] = None,
+                 pod_manager: Optional[PodManager] = None,
+                 validation_manager: Optional[ValidationManager] = None,
+                 safe_load_manager: Optional[SafeRuntimeLoadManager] = None,
+                 planner: Optional[UpgradePlanner] = None,
+                 sync_timeout: float = 10.0,
+                 poll_interval: float = 1.0) -> None:
+        self.keys = keys or UpgradeKeys()
+        self.client = client
+        self.recorder = recorder
+        self.clock = clock or Clock()
+        self._async_workers = async_workers
+        self.provider = provider or NodeUpgradeStateProvider(
+            client, self.keys, recorder, self.clock,
+            sync_timeout=sync_timeout, poll_interval=poll_interval)
+        self.cordon_manager = cordon_manager or CordonManager(client)
+        self.drain_manager = drain_manager or DrainManager(
+            client, self.provider, recorder, self.clock,
+            Worker(async_mode=async_workers))
+        self.pod_manager = pod_manager or PodManager(
+            client, self.provider, None, recorder, self.clock,
+            Worker(async_mode=async_workers))
+        self.validation_manager = validation_manager or ValidationManager(
+            client, self.provider, "", recorder, self.clock)
+        self.safe_load_manager = safe_load_manager or SafeRuntimeLoadManager(
+            self.provider)
+        # Explicit planner wins; otherwise policy.topology_mode selects
+        # flat (reference parity) or slice-atomic planning per apply_state.
+        self._explicit_planner = planner
+
+        self._pod_deletion_enabled = False
+        self._validation_enabled = False
+
+    @property
+    def planner(self) -> UpgradePlanner:
+        """The explicitly-set planner, or the flat default. Assigning here
+        overrides policy-driven selection permanently."""
+        return self._explicit_planner or FlatPlanner()
+
+    @planner.setter
+    def planner(self, value: Optional[UpgradePlanner]) -> None:
+        self._explicit_planner = value
+
+    # ------------------------------------------------------------------
+    # options (upgrade_state.go:155-186)
+    # ------------------------------------------------------------------
+    def with_pod_deletion_enabled(
+            self, deletion_filter: PodDeletionFilter,
+            eviction_gate=None,
+    ) -> "ClusterUpgradeStateManager":
+        if deletion_filter is None:
+            logger.warning("cannot enable pod deletion: filter is None")
+            return self
+        if eviction_gate is None:
+            # Preserve a gate installed earlier via with_eviction_gate —
+            # rebuilding the PodManager must not drop it.
+            eviction_gate = self.pod_manager.eviction_gate
+        self.pod_manager = PodManager(
+            self.client, self.provider, deletion_filter, self.recorder,
+            self.clock, Worker(async_mode=self._async_workers),
+            eviction_gate=eviction_gate)
+        if eviction_gate is not None:
+            # The drain fallback must honor the same gate, or a failed
+            # pod deletion would evict the workload anyway.
+            self.drain_manager.set_eviction_gate(eviction_gate)
+        self._pod_deletion_enabled = True
+        return self
+
+    def with_eviction_gate(self, gate) -> "ClusterUpgradeStateManager":
+        """Install an eviction gate on both the pod-deletion and drain
+        paths without enabling the pod-deletion state."""
+        self.pod_manager.set_eviction_gate(gate)
+        self.drain_manager.set_eviction_gate(gate)
+        return self
+
+    def with_validation_enabled(
+            self, pod_selector: str = "",
+            extra_validator: Optional[NodeValidator] = None,
+    ) -> "ClusterUpgradeStateManager":
+        if not pod_selector and extra_validator is None:
+            logger.warning("cannot enable validation: no selector or "
+                           "validator provided")
+            return self
+        self.validation_manager = ValidationManager(
+            self.client, self.provider, pod_selector, self.recorder,
+            self.clock, extra_validator)
+        self._validation_enabled = True
+        return self
+
+    @property
+    def is_pod_deletion_enabled(self) -> bool:
+        return self._pod_deletion_enabled
+
+    @property
+    def is_validation_enabled(self) -> bool:
+        return self._validation_enabled
+
+    # ------------------------------------------------------------------
+    # build_state (upgrade_state.go:214-355)
+    # ------------------------------------------------------------------
+    def build_state(self, namespace: str,
+                    runtime_labels: dict[str, str]) -> ClusterUpgradeState:
+        """Snapshot runtime DaemonSets + pods + nodes into state buckets."""
+        state = ClusterUpgradeState()
+        selector = selector_from_labels(runtime_labels)
+        daemon_sets = {ds.metadata.uid: ds
+                       for ds in self.client.list_daemon_sets(
+                           namespace, selector)}
+        pods = self.client.list_pods(namespace=namespace,
+                                     label_selector=selector)
+
+        filtered: list[tuple[Pod, Optional[DaemonSet]]] = []
+        for ds in daemon_sets.values():
+            ds_pods = [p for p in pods
+                       if not p.is_orphaned()
+                       and p.controller_owner().uid == ds.metadata.uid]
+            if ds.status.desired_number_scheduled != len(ds_pods):
+                # A DS with unscheduled pods gives an incomplete picture;
+                # refuse to act on it (upgrade_state.go:243-246).
+                raise BuildStateError(
+                    f"runtime DaemonSet {ds.metadata.name} should not have "
+                    f"unscheduled pods")
+            filtered.extend((p, ds) for p in ds_pods)
+        filtered.extend((p, None) for p in pods if p.is_orphaned())
+
+        for pod, ds in filtered:
+            if not pod.spec.node_name and pod.status.phase == PodPhase.PENDING:
+                logger.info("runtime pod %s has no node, skipping", pod.name)
+                continue
+            node = self.provider.get_node(pod.spec.node_name)
+            node_state = NodeUpgradeState(
+                node=node, runtime_pod=pod, runtime_daemon_set=ds)
+            label = node.metadata.labels.get(self.keys.state_label, "")
+            state.node_states.setdefault(label, []).append(node_state)
+        return state
+
+    # ------------------------------------------------------------------
+    # apply_state (upgrade_state.go:364-484)
+    # ------------------------------------------------------------------
+    def apply_state(self, state: ClusterUpgradeState,
+                    policy: Optional[UpgradePolicySpec]) -> None:
+        """One transition pass. Raises on the first hard error; the caller
+        re-reconciles (idempotence guarantees forward progress)."""
+        if state is None:
+            raise ValueError("currentState should not be empty")
+        if policy is None or not policy.auto_upgrade:
+            logger.info("auto upgrade is disabled, skipping")
+            return
+
+        logger.info("node states: %s", {
+            str(s) or "unknown": len(state.bucket(s)) for s in ALL_STATES})
+
+        total_nodes = self.get_total_managed_nodes(state)
+        max_unavailable = total_nodes
+        if policy.max_unavailable is not None:
+            max_unavailable = scaled_value_from_int_or_percent(
+                policy.max_unavailable, total_nodes, round_up=True)
+        upgrades_available = self.get_upgrades_available(
+            state, policy.max_parallel_upgrades, max_unavailable)
+        logger.info(
+            "upgrades in progress: %d, available slots: %d, "
+            "unavailable nodes: %d/%d",
+            self.get_upgrades_in_progress(state), upgrades_available,
+            self.get_current_unavailable_nodes(state), max_unavailable)
+
+        self.process_done_or_unknown_nodes(state, UpgradeState.UNKNOWN)
+        self.process_done_or_unknown_nodes(state, UpgradeState.DONE)
+        self.process_upgrade_required_nodes(
+            state, upgrades_available,
+            planner=self._planner_for_policy(policy))
+        self.process_cordon_required_nodes(state)
+        self.process_wait_for_jobs_required_nodes(
+            state, policy.wait_for_completion)
+        drain_enabled = policy.drain is not None and policy.drain.enable
+        self.process_pod_deletion_required_nodes(
+            state, policy.pod_deletion, drain_enabled)
+        self.process_drain_nodes(state, policy.drain)
+        self.process_pod_restart_nodes(state)
+        self.process_upgrade_failed_nodes(state)
+        self.process_validation_required_nodes(state)
+        self.process_uncordon_required_nodes(state)
+        logger.info("state manager finished processing")
+
+    # ------------------------------------------------------------------
+    # per-state processors
+    # ------------------------------------------------------------------
+    def process_done_or_unknown_nodes(self, state: ClusterUpgradeState,
+                                      bucket: UpgradeState) -> None:
+        """Decide done vs upgrade-required for idle nodes
+        (upgrade_state.go:486-550)."""
+        for ns in state.bucket(bucket):
+            pod_synced, orphaned = self._pod_in_sync_with_ds(ns)
+            upgrade_requested = self._is_upgrade_requested(ns.node)
+            waiting_safe_load = (
+                self.safe_load_manager.is_waiting_for_safe_load(ns.node))
+            if (not pod_synced and not orphaned) or waiting_safe_load \
+                    or upgrade_requested:
+                if ns.node.is_unschedulable():
+                    # Remember pre-upgrade cordon so we restore it at the
+                    # end (upgrade_state.go:509-523).
+                    self.provider.change_node_upgrade_annotation(
+                        ns.node, self.keys.initial_state_annotation,
+                        TRUE_STRING)
+                self.provider.change_node_upgrade_state(
+                    ns.node, UpgradeState.UPGRADE_REQUIRED)
+                logger.info("node %s requires upgrade", ns.node.metadata.name)
+                continue
+            if bucket == UpgradeState.UNKNOWN:
+                self.provider.change_node_upgrade_state(
+                    ns.node, UpgradeState.DONE)
+
+    def _planner_for_policy(
+            self, policy: UpgradePolicySpec) -> UpgradePlanner:
+        if self._explicit_planner is not None:
+            return self._explicit_planner
+        if policy.topology_mode == "slice":
+            from tpu_operator_libs.topology.planner import SlicePlanner
+            return SlicePlanner()
+        return FlatPlanner()
+
+    def process_upgrade_required_nodes(
+            self, state: ClusterUpgradeState, upgrades_available: int,
+            planner: Optional[UpgradePlanner] = None) -> None:
+        """Start upgrades for as many nodes as the throttle allows
+        (upgrade_state.go:587-631), selection delegated to the planner.
+
+        ``apply_state`` resolves the planner from the policy's
+        topology_mode; direct callers get the explicit planner (or flat)
+        unless they pass one.
+        """
+        planner = planner or self.planner
+        candidates = []
+        for ns in state.bucket(UpgradeState.UPGRADE_REQUIRED):
+            if self._is_upgrade_requested(ns.node):
+                # one-shot trigger: consume the annotation
+                self.provider.change_node_upgrade_annotation(
+                    ns.node, self.keys.upgrade_requested_annotation, None)
+            if self._skip_node_upgrade(ns.node):
+                logger.info("node %s is marked to skip upgrades",
+                            ns.node.metadata.name)
+                continue
+            candidates.append(ns)
+        for ns in planner.plan(candidates, upgrades_available, state):
+            self.provider.change_node_upgrade_state(
+                ns.node, UpgradeState.CORDON_REQUIRED)
+            logger.info("node %s waiting for cordon", ns.node.metadata.name)
+
+    def process_cordon_required_nodes(self, state: ClusterUpgradeState) -> None:
+        """Cordon and advance to wait-for-jobs (upgrade_state.go:635-654)."""
+        for ns in state.bucket(UpgradeState.CORDON_REQUIRED):
+            self.cordon_manager.cordon(ns.node)
+            self.provider.change_node_upgrade_state(
+                ns.node, UpgradeState.WAIT_FOR_JOBS_REQUIRED)
+
+    def process_wait_for_jobs_required_nodes(
+            self, state: ClusterUpgradeState,
+            wait_spec) -> None:
+        """Wait for workload completion or skip straight on when no
+        selector is configured (upgrade_state.go:658-693)."""
+        nodes = [ns.node for ns in
+                 state.bucket(UpgradeState.WAIT_FOR_JOBS_REQUIRED)]
+        if wait_spec is None or not wait_spec.pod_selector:
+            next_state = (UpgradeState.POD_DELETION_REQUIRED
+                          if self._pod_deletion_enabled
+                          else UpgradeState.DRAIN_REQUIRED)
+            for node in nodes:
+                try:
+                    self.provider.change_node_upgrade_state(node, next_state)
+                except Exception as exc:  # noqa: BLE001 — reference ignores
+                    # this error (upgrade_state.go:673)
+                    logger.error("failed to advance node %s: %s",
+                                 node.metadata.name, exc)
+            return
+        if not nodes:
+            return
+        self.pod_manager.schedule_check_on_pod_completion(PodManagerConfig(
+            nodes=nodes, wait_for_completion_spec=wait_spec))
+
+    def process_pod_deletion_required_nodes(
+            self, state: ClusterUpgradeState, deletion_spec,
+            drain_enabled: bool) -> None:
+        """Evict filter-selected workload pods (upgrade_state.go:698-727)."""
+        nodes = [ns.node for ns in
+                 state.bucket(UpgradeState.POD_DELETION_REQUIRED)]
+        if not self._pod_deletion_enabled:
+            for node in nodes:
+                try:
+                    self.provider.change_node_upgrade_state(
+                        node, UpgradeState.DRAIN_REQUIRED)
+                except Exception as exc:  # noqa: BLE001 — reference ignores
+                    # this error (upgrade_state.go:706)
+                    logger.error("failed to advance node %s: %s",
+                                 node.metadata.name, exc)
+            return
+        if not nodes:
+            return
+        self.pod_manager.schedule_pod_eviction(PodManagerConfig(
+            nodes=nodes, deletion_spec=deletion_spec,
+            drain_enabled=drain_enabled))
+
+    def process_drain_nodes(self, state: ClusterUpgradeState,
+                            drain_spec) -> None:
+        """Schedule async drains, or skip the stage when disabled
+        (upgrade_state.go:731-760)."""
+        nodes = [ns.node for ns in state.bucket(UpgradeState.DRAIN_REQUIRED)]
+        if drain_spec is None or not drain_spec.enable:
+            for node in nodes:
+                self.provider.change_node_upgrade_state(
+                    node, UpgradeState.POD_RESTART_REQUIRED)
+            return
+        if not nodes:
+            return
+        self.drain_manager.schedule_nodes_drain(
+            DrainConfiguration(spec=drain_spec, nodes=nodes))
+
+    def process_pod_restart_nodes(self, state: ClusterUpgradeState) -> None:
+        """Restart outdated runtime pods; advance nodes whose new pod is
+        ready (upgrade_state.go:764-831)."""
+        pods_to_restart = []
+        for ns in state.bucket(UpgradeState.POD_RESTART_REQUIRED):
+            pod_synced, orphaned = self._pod_in_sync_with_ds(ns)
+            if not pod_synced or orphaned:
+                # Only restart pods not already terminating
+                # (upgrade_state.go:775-781).
+                if ns.runtime_pod.metadata.deletion_timestamp is None:
+                    pods_to_restart.append(ns.runtime_pod)
+                continue
+            # Pod template is current: release any blocked safe load, then
+            # wait for readiness.
+            self.safe_load_manager.unblock_loading(ns.node)
+            if self._is_runtime_pod_in_sync(ns):
+                if not self._validation_enabled:
+                    self._update_node_to_uncordon_or_done(ns.node)
+                    continue
+                self.provider.change_node_upgrade_state(
+                    ns.node, UpgradeState.VALIDATION_REQUIRED)
+            elif ns.runtime_pod.is_failing(POD_RESTART_FAILURE_THRESHOLD):
+                logger.info("runtime pod failing on node %s with repeated "
+                            "restarts", ns.node.metadata.name)
+                self.provider.change_node_upgrade_state(
+                    ns.node, UpgradeState.FAILED)
+        self.pod_manager.schedule_pods_restart(pods_to_restart)
+
+    def process_upgrade_failed_nodes(self, state: ClusterUpgradeState) -> None:
+        """Auto-recover failed nodes whose pod became healthy
+        (upgrade_state.go:835-877).
+
+        Deliberate delta from the reference: when validation is enabled,
+        recovery also requires the validation gate to pass. The reference
+        recovers on pod-readiness alone, which lets a node that *failed
+        validation* (e.g. validation timeout with a degraded ICI fabric)
+        slip back into service the moment its runtime pod is Ready —
+        bypassing the very gate that failed it. Pod-level failures recover
+        exactly as before; gate-level failures stay failed until the gate
+        passes.
+        """
+        for ns in state.bucket(UpgradeState.FAILED):
+            if not self._is_runtime_pod_in_sync(ns):
+                continue
+            # check(), not validate(): the recovery gate must not stamp or
+            # expire validation timers on an already-failed node.
+            if self._validation_enabled \
+                    and not self.validation_manager.check(ns.node):
+                logger.info("failed node %s has a healthy pod but has not "
+                            "passed validation; holding",
+                            ns.node.metadata.name)
+                continue
+            self._update_node_to_uncordon_or_done(ns.node)
+
+    def process_validation_required_nodes(
+            self, state: ClusterUpgradeState) -> None:
+        """Run the validation gate (upgrade_state.go:880-911)."""
+        for ns in state.bucket(UpgradeState.VALIDATION_REQUIRED):
+            # The runtime pod may have restarted after entering this state
+            # and be blocked on safe load again (upgrade_state.go:886-893).
+            self.safe_load_manager.unblock_loading(ns.node)
+            if not self.validation_manager.validate(ns.node):
+                logger.info("validation not complete on node %s",
+                            ns.node.metadata.name)
+                continue
+            self._update_node_to_uncordon_or_done(ns.node)
+
+    def process_uncordon_required_nodes(
+            self, state: ClusterUpgradeState) -> None:
+        """Uncordon and finish (upgrade_state.go:915-934)."""
+        for ns in state.bucket(UpgradeState.UNCORDON_REQUIRED):
+            self.cordon_manager.uncordon(ns.node)
+            self.provider.change_node_upgrade_state(
+                ns.node, UpgradeState.DONE)
+
+    # ------------------------------------------------------------------
+    # predicates
+    # ------------------------------------------------------------------
+    def _pod_in_sync_with_ds(self,
+                             ns: NodeUpgradeState) -> tuple[bool, bool]:
+        """(synced, orphaned) — orphaned pods are never "synced"
+        (upgrade_state.go:552-578)."""
+        if ns.is_orphaned():
+            return False, True
+        pod_hash = self.pod_manager.get_pod_revision_hash(ns.runtime_pod)
+        ds_hash = self.pod_manager.get_daemon_set_revision_hash(
+            ns.runtime_daemon_set)
+        return pod_hash == ds_hash, False
+
+    def _is_runtime_pod_in_sync(self, ns: NodeUpgradeState) -> bool:
+        """Synced AND Running AND all containers ready
+        (upgrade_state.go:936-964)."""
+        synced, orphaned = self._pod_in_sync_with_ds(ns)
+        if orphaned:
+            return False
+        return synced and ns.runtime_pod.is_ready()
+
+    def _is_upgrade_requested(self, node: Node) -> bool:
+        return node.metadata.annotations.get(
+            self.keys.upgrade_requested_annotation) == TRUE_STRING
+
+    def _skip_node_upgrade(self, node: Node) -> bool:
+        return node.metadata.labels.get(
+            self.keys.skip_label) == TRUE_STRING
+
+    def _update_node_to_uncordon_or_done(self, node: Node) -> None:
+        """Finish the node: uncordon-required normally, straight to done if
+        it was already cordoned before the upgrade began
+        (upgrade_state.go:1000-1028)."""
+        new_state = UpgradeState.UNCORDON_REQUIRED
+        annotation = self.keys.initial_state_annotation
+        if annotation in node.metadata.annotations:
+            logger.info("node %s was unschedulable before upgrade; "
+                        "skipping uncordon", node.metadata.name)
+            new_state = UpgradeState.DONE
+        self.provider.change_node_upgrade_state(node, new_state)
+        if new_state == UpgradeState.DONE:
+            self.provider.change_node_upgrade_annotation(
+                node, annotation, None)
+
+    # ------------------------------------------------------------------
+    # fleet counters (upgrade_state.go:188-211, 1034-1120)
+    # ------------------------------------------------------------------
+    def get_total_managed_nodes(self, state: ClusterUpgradeState) -> int:
+        return sum(len(v) for v in state.node_states.values())
+
+    def get_upgrades_in_progress(self, state: ClusterUpgradeState) -> int:
+        return sum(len(state.bucket(s)) for s in IN_PROGRESS_STATES)
+
+    def get_upgrades_done(self, state: ClusterUpgradeState) -> int:
+        return len(state.bucket(UpgradeState.DONE))
+
+    def get_upgrades_failed(self, state: ClusterUpgradeState) -> int:
+        return len(state.bucket(UpgradeState.FAILED))
+
+    def get_upgrades_pending(self, state: ClusterUpgradeState) -> int:
+        return len(state.bucket(UpgradeState.UPGRADE_REQUIRED))
+
+    def get_current_unavailable_nodes(self, state: ClusterUpgradeState) -> int:
+        """Cordoned or not-ready nodes (upgrade_state.go:192-211)."""
+        count = 0
+        for bucket in state.node_states.values():
+            for ns in bucket:
+                if ns.node.is_unschedulable() or not ns.node.is_ready():
+                    count += 1
+        return count
+
+    def get_upgrades_available(self, state: ClusterUpgradeState,
+                               max_parallel_upgrades: int,
+                               max_unavailable: int) -> int:
+        """The throttle math (upgrade_state.go:1073-1102): parallel-slot
+        budget intersected with the unavailability budget, where nodes
+        already unavailable (cordoned/not-ready) and nodes about to be
+        cordoned all count against maxUnavailable."""
+        in_progress = self.get_upgrades_in_progress(state)
+        total_nodes = self.get_total_managed_nodes(state)
+        if max_parallel_upgrades == 0:
+            available = len(state.bucket(UpgradeState.UPGRADE_REQUIRED))
+        else:
+            available = max_parallel_upgrades - in_progress
+
+        unavailable = (self.get_current_unavailable_nodes(state)
+                       + len(state.bucket(UpgradeState.CORDON_REQUIRED)))
+        if available > max_unavailable:
+            available = max_unavailable
+        if unavailable >= max_unavailable:
+            available = 0
+        elif (max_unavailable < total_nodes
+              and unavailable + available > max_unavailable):
+            available = max_unavailable - unavailable
+        # The reference can return a negative count here when in-progress
+        # exceeds the parallel budget (upgrade_state.go:1084 with no clamp)
+        # — harmless to its caller but wrong as an exposed fleet counter.
+        return max(0, available)
+
+    def cluster_status(self, state: ClusterUpgradeState) -> dict:
+        """CRD-embeddable status block for one snapshot.
+
+        Reference consumers surface the fleet counters
+        (upgrade_state.go:1034-1120) in their own CRD ``.status``; this
+        returns that block ready-made — JSON-serializable, camelCase
+        keys, deterministic ordering — plus the TPU-native slice
+        availability when topology labels are present.
+        """
+        # raw snapshot buckets, not ALL_STATES: a node with an unrecognized
+        # label value must still appear (as its raw label) so the per-state
+        # counts always sum to totalNodes
+        per_state = {key or "unknown": len(bucket)
+                     for key, bucket in state.node_states.items() if bucket}
+        status = {
+            "totalNodes": self.get_total_managed_nodes(state),
+            "upgradesInProgress": self.get_upgrades_in_progress(state),
+            "upgradesDone": self.get_upgrades_done(state),
+            "upgradesFailed": self.get_upgrades_failed(state),
+            "upgradesPending": self.get_upgrades_pending(state),
+            "unavailableNodes": self.get_current_unavailable_nodes(state),
+            "nodesByState": dict(sorted(per_state.items())),
+        }
+        nodes = [ns.node for bucket in state.node_states.values()
+                 for ns in bucket]
+        from tpu_operator_libs.consts import GKE_TPU_TOPOLOGY_LABEL
+
+        if any(GKE_TPU_TOPOLOGY_LABEL in n.metadata.labels for n in nodes):
+            # only meaningful on TPU-labeled fleets: without topology
+            # labels every node is its own "slice" and the number would
+            # just restate node readiness
+            from tpu_operator_libs.topology.slice_topology import (
+                SliceTopology,
+            )
+
+            topo = SliceTopology.from_nodes(nodes)
+            status["sliceAvailability"] = round(topo.availability(), 4)
+        return status
+
+    # ------------------------------------------------------------------
+    # chained reconcile
+    # ------------------------------------------------------------------
+    def reconcile(self, namespace: str, runtime_labels: dict[str, str],
+                  policy: Optional[UpgradePolicySpec],
+                  max_chain: int = 12) -> Optional[ClusterUpgradeState]:
+        """build_state + apply_state, chained until node states stabilize.
+
+        The reference moves a node at most one transition per reconcile and
+        then waits for the operator's next reconcile interval, so a node
+        burns ~interval seconds per edge of the state graph even when every
+        action is instantaneous. Chaining is exactly what a consumer's
+        immediate-requeue loop does — each inner pass is a full
+        reference-semantics pass committed to node labels, preserving
+        idempotence and crash-resume — minus the dead time. Stops as soon
+        as a pass changes nothing (async work in flight reports through
+        labels on a later reconcile), after ``max_chain`` passes, or when
+        the snapshot is momentarily incomplete.
+
+        Returns the last built state (None if the first build failed).
+        """
+        last_state = None
+        fingerprint = None
+        for _ in range(max_chain):
+            try:
+                state = self.build_state(namespace, runtime_labels)
+            except BuildStateError:
+                # restarted runtime pod between deletion and recreation;
+                # nothing more to do until the controller catches up
+                return last_state
+            new_fingerprint = tuple(sorted(
+                (ns.node.metadata.name, label)
+                for label, bucket in state.node_states.items()
+                for ns in bucket))
+            if new_fingerprint == fingerprint:
+                return state
+            fingerprint = new_fingerprint
+            last_state = state
+            self.apply_state(state, policy)
+        return last_state
+
+    # ------------------------------------------------------------------
+    # test/sim helper
+    # ------------------------------------------------------------------
+    def join_workers(self, timeout: float = 30.0) -> None:
+        """Wait for in-flight async drain/eviction workers."""
+        self.drain_manager.join(timeout)
+        self.pod_manager.join(timeout)

@@ -1,0 +1,139 @@
+"""Synchronized node access: the only writer of upgrade state.
+
+Equivalent of the reference NodeUpgradeStateProvider
+(node_upgrade_state_provider.go:33-216). Every state transition in the
+system funnels through ``change_node_upgrade_state`` — the label write *is*
+the durable commit point of the state machine.
+
+Like the reference, after a successful patch the provider polls the node
+back until the change is visible (node_upgrade_state_provider.go:92-117):
+the consumer operator's informer cache may lag the API server, and the next
+reconcile must see its own writes. Poll interval and timeout are injectable
+(the reference hardcodes 1 s / 10 s).
+"""
+
+from __future__ import annotations
+
+import logging
+from typing import Optional
+
+from tpu_operator_libs.consts import NULL_STRING, UpgradeKeys, UpgradeState
+from tpu_operator_libs.k8s.client import K8sClient
+from tpu_operator_libs.k8s.objects import Node
+from tpu_operator_libs.util import Clock, EventRecorder, Event, KeyedLock, log_event
+
+logger = logging.getLogger(__name__)
+
+
+class CacheSyncTimeout(TimeoutError):
+    """The patched value never became visible within the sync timeout."""
+
+
+class NodeUpgradeStateProvider:
+    """Get nodes and change their upgrade state/annotations atomically."""
+
+    def __init__(self, client: K8sClient, keys: UpgradeKeys,
+                 recorder: Optional[EventRecorder] = None,
+                 clock: Optional[Clock] = None,
+                 sync_timeout: float = 10.0,
+                 poll_interval: float = 1.0) -> None:
+        self._client = client
+        self._keys = keys
+        self._recorder = recorder
+        self._clock = clock or Clock()
+        self._sync_timeout = sync_timeout
+        self._poll_interval = poll_interval
+        self._node_lock = KeyedLock()
+
+    @property
+    def keys(self) -> UpgradeKeys:
+        return self._keys
+
+    def get_node(self, name: str) -> Node:
+        """Fetch a fresh snapshot of the node
+        (node_upgrade_state_provider.go:59-68)."""
+        with self._node_lock.lock(name):
+            return self._client.get_node(name)
+
+    def change_node_upgrade_state(self, node: Node,
+                                  new_state: UpgradeState | str) -> None:
+        """Patch the upgrade-state label and wait until the change is
+        readable back (node_upgrade_state_provider.go:72-134).
+
+        ``node`` is updated in place on success, so later processing within
+        the same reconcile pass observes the new state — matching the
+        reference, which Gets into the caller's node object.
+        """
+        value = str(new_state)
+        with self._node_lock.lock(node.metadata.name):
+            try:
+                self._client.patch_node_labels(
+                    node.metadata.name, {self._keys.state_label: value})
+            except Exception as exc:
+                log_event(self._recorder, node, Event.WARNING,
+                          self._keys.event_reason,
+                          f"Failed to update node state label to {value}: {exc}")
+                raise
+            try:
+                fresh = self._wait_visible(
+                    node.metadata.name,
+                    lambda n: n.metadata.labels.get(self._keys.state_label, "") == value)
+            except CacheSyncTimeout:
+                log_event(self._recorder, node, Event.WARNING,
+                          self._keys.event_reason,
+                          f"Failed to observe node state label {value} after patch")
+                raise
+        self._copy_into(node, fresh)
+        logger.info("node %s upgrade state -> %s", node.metadata.name, value)
+        log_event(self._recorder, node, Event.NORMAL, self._keys.event_reason,
+                  f"Successfully updated node state label to {value}")
+
+    def change_node_upgrade_annotation(self, node: Node, key: str,
+                                       value: Optional[str]) -> None:
+        """Patch (or with value None / "null" delete) a node annotation and
+        wait for visibility (node_upgrade_state_provider.go:138-216)."""
+        delete = value is None or value == NULL_STRING
+        patch_value = None if delete else value
+        with self._node_lock.lock(node.metadata.name):
+            try:
+                self._client.patch_node_annotations(
+                    node.metadata.name, {key: patch_value})
+            except Exception as exc:
+                log_event(self._recorder, node, Event.WARNING,
+                          self._keys.event_reason,
+                          f"Failed to update node annotation {key}={value}: {exc}")
+                raise
+            if delete:
+                check = lambda n: key not in n.metadata.annotations  # noqa: E731
+            else:
+                check = lambda n: n.metadata.annotations.get(key) == value  # noqa: E731
+            try:
+                fresh = self._wait_visible(node.metadata.name, check)
+            except CacheSyncTimeout:
+                log_event(self._recorder, node, Event.WARNING,
+                          self._keys.event_reason,
+                          f"Failed to observe node annotation {key}={value}")
+                raise
+        self._copy_into(node, fresh)
+        log_event(self._recorder, node, Event.NORMAL, self._keys.event_reason,
+                  f"Successfully updated node annotation {key}={value}")
+
+    def _wait_visible(self, name: str, predicate) -> Node:
+        deadline = self._clock.now() + self._sync_timeout
+        while True:
+            fresh = self._client.get_node(name)
+            if predicate(fresh):
+                return fresh
+            if self._clock.now() >= deadline:
+                raise CacheSyncTimeout(
+                    f"node {name} update not visible within "
+                    f"{self._sync_timeout}s")
+            self._clock.sleep(self._poll_interval)
+
+    @staticmethod
+    def _copy_into(node: Node, fresh: Node) -> None:
+        node.metadata.labels = fresh.metadata.labels
+        node.metadata.annotations = fresh.metadata.annotations
+        node.metadata.resource_version = fresh.metadata.resource_version
+        node.spec = fresh.spec
+        node.status = fresh.status

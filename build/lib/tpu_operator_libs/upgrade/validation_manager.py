@@ -1,0 +1,139 @@
+"""ValidationManager: the post-upgrade gate before a node returns to service.
+
+Reference: validation_manager.go:35-175 — a validation pod, selected by
+``pod_selector`` on the node, must be Running+Ready; if it stays not-ready
+past a 600 s timeout (checkpointed in a node annotation) the node is marked
+upgrade-failed.
+
+TPU extension: an optional ``extra_validator`` callable is consulted after
+the pod gate. This is the insertion point SURVEY.md §5 calls for — the ICI
+fabric health probe (tpu_operator_libs.health.ici_probe) plugs in here so a
+node only returns to service when the TPU interconnect is provably healthy.
+"""
+
+from __future__ import annotations
+
+import logging
+from typing import Callable, Optional
+
+from tpu_operator_libs.consts import UpgradeState
+from tpu_operator_libs.k8s.client import K8sClient
+from tpu_operator_libs.k8s.objects import Node
+from tpu_operator_libs.upgrade.state_provider import NodeUpgradeStateProvider
+from tpu_operator_libs.util import Clock, Event, EventRecorder, log_event
+
+logger = logging.getLogger(__name__)
+
+VALIDATION_TIMEOUT_SECONDS = 600  # validation_manager.go:31-33
+
+#: Extra health gate: returns True when the node is healthy. Exceptions are
+#: treated as "not yet healthy" and retried next reconcile.
+NodeValidator = Callable[[Node], bool]
+
+
+class ValidationManager:
+    def __init__(self, client: K8sClient,
+                 provider: NodeUpgradeStateProvider,
+                 pod_selector: str = "",
+                 recorder: Optional[EventRecorder] = None,
+                 clock: Optional[Clock] = None,
+                 extra_validator: Optional[NodeValidator] = None,
+                 timeout_seconds: int = VALIDATION_TIMEOUT_SECONDS) -> None:
+        self._client = client
+        self._provider = provider
+        self._pod_selector = pod_selector
+        self._recorder = recorder
+        self._clock = clock or Clock()
+        self._extra_validator = extra_validator
+        self._timeout_seconds = timeout_seconds
+        self._keys = provider.keys
+
+    @property
+    def pod_selector(self) -> str:
+        return self._pod_selector
+
+    def validate(self, node: Node) -> bool:
+        """True when validation is complete for the node
+        (validation_manager.go:71-116).
+
+        Empty selector and no extra validator ⇒ trivially true (matches the
+        reference's early return at :72-74). A not-ready validation pod (or
+        failing extra validator) starts/checks the timeout; expiry flips the
+        node to upgrade-failed.
+        """
+        if not self._pod_selector and self._extra_validator is None:
+            return True  # trivially valid, no annotation traffic (:72-74)
+
+        failure = self._gate_failure(node)
+        if failure is None:
+            # Validation complete: clear the timeout stamp.
+            self._provider.change_node_upgrade_annotation(
+                node, self._keys.validation_start_annotation, None)
+            return True
+        if failure == "no-pods":
+            # Missing validation pods never start the timer (matches the
+            # reference's bare return at validation_manager.go:98-103).
+            logger.warning("no validation pods found on node %s",
+                           node.metadata.name)
+            return False
+        self._handle_timeout(node)
+        return False
+
+    def check(self, node: Node) -> bool:
+        """Side-effect-free variant of :meth:`validate`: runs the same
+        gates but never stamps/advances the timeout state machine. Used by
+        failed-node recovery, which must consult the gate repeatedly
+        without churning annotations or re-marking an already-failed
+        node."""
+        return self._gate_failure(node) is None
+
+    def _gate_failure(self, node: Node) -> Optional[str]:
+        """Evaluate both gates without side effects. Returns None when the
+        node passes, else why it failed: "no-pods" (selector matched
+        nothing), "pod-not-ready", or "extra-validator"."""
+        if self._pod_selector:
+            pods = self._client.list_pods(
+                namespace=None, label_selector=self._pod_selector,
+                field_selector=f"spec.nodeName={node.metadata.name}")
+            if not pods:
+                return "no-pods"
+            if any(not pod.is_ready() for pod in pods):
+                return "pod-not-ready"
+        if self._extra_validator is not None:
+            try:
+                healthy = self._extra_validator(node)
+            except Exception as exc:  # noqa: BLE001 — gate boundary
+                logger.warning("extra validator raised on node %s: %s",
+                               node.metadata.name, exc)
+                healthy = False
+            if not healthy:
+                return "extra-validator"
+        return None
+
+    def _handle_timeout(self, node: Node) -> None:
+        """Start or check the validation timer (validation_manager.go:
+        139-175): first failure stamps the start time; expiry marks the node
+        upgrade-failed and clears the stamp."""
+        annotation = self._keys.validation_start_annotation
+        now = int(self._clock.now())
+        stamp = node.metadata.annotations.get(annotation)
+        if stamp is None:
+            self._provider.change_node_upgrade_annotation(
+                node, annotation, str(now))
+            return
+        start = int(stamp)
+        if now > start + self._timeout_seconds:
+            try:
+                self._provider.change_node_upgrade_state(
+                    node, UpgradeState.FAILED)
+            except Exception as exc:  # noqa: BLE001 — matches reference's
+                # ignored error at validation_manager.go:163
+                logger.error("failed to fail node %s: %s",
+                             node.metadata.name, exc)
+            logger.info("validation timeout exceeded on node %s",
+                        node.metadata.name)
+            log_event(self._recorder, node, Event.WARNING,
+                      self._keys.event_reason,
+                      "Validation timed out; node marked upgrade-failed")
+            self._provider.change_node_upgrade_annotation(
+                node, annotation, None)

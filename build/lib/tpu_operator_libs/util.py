@@ -1,0 +1,242 @@
+"""Concurrency primitives, clock abstraction and event helpers.
+
+TPU-native analogue of pkg/upgrade/util.go. The reference's global mutable
+``DriverName`` (util.go:87-95) is deliberately absent — key construction is
+instance-scoped via :class:`tpu_operator_libs.consts.UpgradeKeys`.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from typing import Callable, Iterator, Optional
+
+
+class NameSet:
+    """Thread-safe set of strings.
+
+    Used to deduplicate in-flight async work per node: a node already being
+    drained / having pods evicted is never scheduled twice
+    (reference StringSet, util.go:26-66; guards at drain_manager.go:103 and
+    pod_manager.go:163).
+    """
+
+    def __init__(self) -> None:
+        self._items: set[str] = set()
+        self._lock = threading.Lock()
+
+    def add(self, item: str) -> bool:
+        """Add ``item``; returns False if it was already present.
+
+        The test-and-set is atomic, unlike the reference's separate
+        Has()+Add() calls (pod_manager.go:163-165) which race two concurrent
+        reconciles into double-scheduling the same node.
+        """
+        with self._lock:
+            if item in self._items:
+                return False
+            self._items.add(item)
+            return True
+
+    def remove(self, item: str) -> None:
+        with self._lock:
+            self._items.discard(item)
+
+    def __contains__(self, item: str) -> bool:
+        with self._lock:
+            return item in self._items
+
+    def __len__(self) -> int:
+        with self._lock:
+            return len(self._items)
+
+    def clear(self) -> None:
+        with self._lock:
+            self._items.clear()
+
+
+class KeyedLock:
+    """Per-key mutual exclusion (reference KeyedMutex, util.go:69-85).
+
+    Serializes access to a single node's label/annotation updates while
+    letting different nodes proceed in parallel.
+    """
+
+    def __init__(self) -> None:
+        self._locks: dict[str, threading.Lock] = {}
+        self._guard = threading.Lock()
+
+    def _get(self, key: str) -> threading.Lock:
+        with self._guard:
+            lock = self._locks.get(key)
+            if lock is None:
+                lock = threading.Lock()
+                self._locks[key] = lock
+            return lock
+
+    def lock(self, key: str) -> "_HeldLock":
+        """Acquire the lock for ``key``; usable as a context manager."""
+        lock = self._get(key)
+        lock.acquire()
+        return _HeldLock(lock)
+
+
+class _HeldLock:
+    def __init__(self, lock: threading.Lock) -> None:
+        self._lock = lock
+        self._released = False
+
+    def release(self) -> None:
+        if not self._released:
+            self._released = True
+            self._lock.release()
+
+    def __enter__(self) -> "_HeldLock":
+        return self
+
+    def __exit__(self, *exc: object) -> None:
+        self.release()
+
+
+class Clock:
+    """Injectable time source.
+
+    The reference calls ``time.Now()`` directly inside timeout logic
+    (pod_manager.go:337, validation_manager.go:141), forcing its tests to
+    sleep.  All timeout handling here goes through a Clock so tests (and the
+    rolling-upgrade simulator) can advance virtual time instantly.
+    """
+
+    def now(self) -> float:
+        return time.time()
+
+    def sleep(self, seconds: float) -> None:
+        time.sleep(seconds)
+
+
+class FakeClock(Clock):
+    """Deterministic clock for tests and simulation."""
+
+    def __init__(self, start: float = 0.0) -> None:
+        self._now = start
+        self._lock = threading.Lock()
+
+    def now(self) -> float:
+        with self._lock:
+            return self._now
+
+    def sleep(self, seconds: float) -> None:
+        self.advance(seconds)
+
+    def advance(self, seconds: float) -> None:
+        with self._lock:
+            self._now += seconds
+
+
+class Event:
+    """A recorded Kubernetes-style event (type/reason/message on an object)."""
+
+    NORMAL = "Normal"
+    WARNING = "Warning"
+
+    __slots__ = ("object_name", "kind", "type", "reason", "message")
+
+    def __init__(self, object_name: str, kind: str, type_: str, reason: str,
+                 message: str) -> None:
+        self.object_name = object_name
+        self.kind = kind
+        self.type = type_
+        self.reason = reason
+        self.message = message
+
+    def __repr__(self) -> str:
+        return (f"Event({self.type} {self.reason} on {self.kind}/"
+                f"{self.object_name}: {self.message})")
+
+
+class EventRecorder:
+    """Collects events emitted on cluster objects.
+
+    Equivalent of client-go's record.EventRecorder as used by the reference
+    (util.go:141-153); the in-memory list doubles as the FakeRecorder used
+    throughout the reference test suite (upgrade_suit_test.go:63).
+    """
+
+    def __init__(self, capacity: int = 1000) -> None:
+        self._events: list[Event] = []
+        self._lock = threading.Lock()
+        self._capacity = capacity
+
+    def event(self, obj: object, type_: str, reason: str, message: str) -> None:
+        name = getattr(getattr(obj, "metadata", obj), "name", str(obj))
+        kind = type(obj).__name__
+        with self._lock:
+            self._events.append(Event(name, kind, type_, reason, message))
+            if len(self._events) > self._capacity:
+                self._events.pop(0)
+
+    @property
+    def events(self) -> list[Event]:
+        with self._lock:
+            return list(self._events)
+
+    def clear(self) -> None:
+        with self._lock:
+            self._events.clear()
+
+    def find(self, reason: Optional[str] = None,
+             type_: Optional[str] = None) -> list[Event]:
+        with self._lock:
+            return [e for e in self._events
+                    if (reason is None or e.reason == reason)
+                    and (type_ is None or e.type == type_)]
+
+
+def log_event(recorder: Optional[EventRecorder], obj: object, type_: str,
+              reason: str, message: str) -> None:
+    """Nil-safe event emission (reference logEvent/logEventf,
+    util.go:141-153)."""
+    if recorder is not None:
+        recorder.event(obj, type_, reason, message)
+
+
+class Worker:
+    """Runs fire-and-forget node actions, sync or async.
+
+    The reference spawns one detached goroutine per slow node action (drain:
+    drain_manager.go:108-132, eviction: pod_manager.go:167-226).  Detached
+    threads make tests and the simulator nondeterministic, so the executor is
+    a seam: ``Worker(async_mode=False)`` runs actions inline (deterministic,
+    used by tests/bench), ``async_mode=True`` spawns a daemon thread per
+    action like the reference.  ``join()`` waits for in-flight actions.
+    """
+
+    def __init__(self, async_mode: bool = True) -> None:
+        self.async_mode = async_mode
+        self._threads: list[threading.Thread] = []
+        self._lock = threading.Lock()
+
+    def submit(self, fn: Callable[[], None]) -> None:
+        if not self.async_mode:
+            fn()
+            return
+        thread = threading.Thread(target=fn, daemon=True)
+        with self._lock:
+            self._threads = [t for t in self._threads if t.is_alive()]
+            self._threads.append(thread)
+        thread.start()
+
+    def join(self, timeout: float = 30.0) -> None:
+        deadline = time.monotonic() + timeout
+        with self._lock:
+            threads = list(self._threads)
+        for t in threads:
+            remaining = deadline - time.monotonic()
+            if remaining > 0:
+                t.join(remaining)
+
+
+def chunked(items: list, size: int) -> Iterator[list]:
+    """Yield ``items`` in chunks of at most ``size``."""
+    for i in range(0, len(items), size):
+        yield items[i:i + size]

@@ -1,167 +1,13 @@
 #!/usr/bin/env python3
-"""Admission webhook for the upgrade-policy CRDs.
+"""Shim: see tpu_operator_libs/examples/admission_webhook.py."""
 
-The reference relies on kubebuilder markers compiled into CRD schemas for
-defaulting and validation (api/upgrade/v1alpha1/upgrade_spec.go:27-110);
-this build additionally ships the admission-side implementations
-(tpu_operator_libs/api/crd.py: ``apply_defaults`` /
-``validate_against_schema``), and this webhook serves them the way a
-cluster consumes them:
-
-- ``POST /validate`` — ValidatingWebhook: reject a TPUUpgradePolicy /
-  UnifiedUpgradePolicy whose spec fails schema validation *or* semantic
-  validation (``UpgradePolicySpec.validate``, e.g. negative percent
-  strings the reference silently accepts).
-- ``POST /mutate`` — MutatingWebhook: fill in schema defaults
-  (maxParallelUpgrades=1, maxUnavailable="25%", timeouts) as a JSONPatch,
-  so stored objects are fully defaulted like kubebuilder CRDs.
-
-Both speak ``admission.k8s.io/v1 AdmissionReview``. TLS (required by
-real apiservers) via ``--tls-cert/--tls-key``; plain HTTP without, for
-tests and port-forward experiments.
-"""
-
-from __future__ import annotations
-
-import argparse
-import base64
-import json
-import logging
+import os
 import sys
-from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 
-sys.path.insert(0, ".")  # repo-root invocation, like the other examples
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
-from tpu_operator_libs.api.crd import (  # noqa: E402
-    apply_defaults,
-    unified_policy_schema,
-    upgrade_policy_schema,
-    validate_against_schema,
-)
-from tpu_operator_libs.api.unified_policy import (  # noqa: E402
-    UnifiedUpgradePolicySpec,
-)
-from tpu_operator_libs.api.upgrade_policy import (  # noqa: E402
-    PolicyValidationError,
-    UpgradePolicySpec,
-)
-
-logger = logging.getLogger("admission-webhook")
-
-#: kind -> (schema, semantic validator over the defaulted spec dict)
-_KINDS = {
-    "TPUUpgradePolicy": (
-        upgrade_policy_schema,
-        lambda spec: UpgradePolicySpec.from_dict(spec).validate()),
-    "UnifiedUpgradePolicy": (
-        unified_policy_schema,
-        lambda spec: UnifiedUpgradePolicySpec.from_dict(spec).validate()),
-}
-
-
-def review_response(request: dict, *, allowed: bool,
-                    message: str = "", patch: list | None = None) -> dict:
-    response: dict = {"uid": request.get("uid", ""), "allowed": allowed}
-    if message:
-        response["status"] = {"message": message}
-    if patch is not None:
-        response["patchType"] = "JSONPatch"
-        response["patch"] = base64.b64encode(
-            json.dumps(patch).encode()).decode()
-    return {"apiVersion": "admission.k8s.io/v1", "kind": "AdmissionReview",
-            "response": response}
-
-
-def handle_review(body: dict, mutate: bool) -> dict:
-    request = body.get("request") or {}
-    if request.get("operation") == "DELETE":
-        # DELETE reviews carry object: null (the old object is in
-        # oldObject); there is nothing to validate or default, and
-        # denying would make policies undeletable
-        return review_response(request, allowed=True)
-    kind = (request.get("kind") or {}).get("kind", "")
-    entry = _KINDS.get(kind)
-    if entry is None:
-        return review_response(
-            request, allowed=False,
-            message=f"unsupported kind {kind!r}; expected one of "
-                    f"{sorted(_KINDS)}")
-    schema_fn, semantic = entry
-    schema = schema_fn()
-    obj = request.get("object") or {}
-    spec = obj.get("spec")
-    if spec is None or not isinstance(spec, dict):
-        return review_response(request, allowed=False,
-                               message="spec: required and must be an "
-                                       "object")
-    try:
-        validate_against_schema(spec, schema)
-        defaulted = apply_defaults(spec, schema)
-        semantic(defaulted)
-    except PolicyValidationError as exc:
-        return review_response(request, allowed=False, message=str(exc))
-    if not mutate or defaulted == spec:
-        return review_response(request, allowed=True)
-    return review_response(
-        request, allowed=True,
-        patch=[{"op": "replace", "path": "/spec", "value": defaulted}])
-
-
-def make_server(port: int, tls_cert: str = "",
-                tls_key: str = "") -> ThreadingHTTPServer:
-    class Handler(BaseHTTPRequestHandler):
-        def do_POST(self):  # noqa: N802 - stdlib API
-            if self.path not in ("/validate", "/mutate"):
-                self.send_response(404)
-                self.end_headers()
-                return
-            try:
-                length = int(self.headers.get("Content-Length", "0"))
-                body = json.loads(self.rfile.read(length))
-                review = handle_review(body, mutate=self.path == "/mutate")
-            except Exception as exc:  # noqa: BLE001 — malformed review
-                self.send_response(400)
-                self.end_headers()
-                self.wfile.write(str(exc).encode())
-                return
-            payload = json.dumps(review).encode()
-            self.send_response(200)
-            self.send_header("Content-Type", "application/json")
-            self.end_headers()
-            self.wfile.write(payload)
-
-        def log_message(self, *args):  # quiet
-            pass
-
-    server = ThreadingHTTPServer(("", port), Handler)
-    if tls_cert and tls_key:
-        import ssl
-
-        ctx = ssl.SSLContext(ssl.PROTOCOL_TLS_SERVER)
-        ctx.load_cert_chain(tls_cert, tls_key)
-        server.socket = ctx.wrap_socket(server.socket, server_side=True)
-    return server
-
-
-def main() -> int:
-    parser = argparse.ArgumentParser(description=__doc__.split("\n")[0])
-    parser.add_argument("--port", type=int, default=8443)
-    parser.add_argument("--tls-cert", default="",
-                        help="PEM cert (apiservers require TLS)")
-    parser.add_argument("--tls-key", default="")
-    args = parser.parse_args()
-    logging.basicConfig(level=logging.INFO)
-    server = make_server(args.port, args.tls_cert, args.tls_key)
-    logger.info("admission webhook on :%d (/validate, /mutate)%s",
-                args.port, "" if args.tls_cert else " [no TLS]")
-    try:
-        server.serve_forever()
-    except KeyboardInterrupt:
-        pass
-    finally:
-        server.shutdown()
-    return 0
-
+from tpu_operator_libs.examples.admission_webhook import *  # noqa: F401,F403
+from tpu_operator_libs.examples.admission_webhook import main  # noqa: F401
 
 if __name__ == "__main__":
     sys.exit(main())

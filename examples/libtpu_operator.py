@@ -1,428 +1,21 @@
 #!/usr/bin/env python3
-"""Example consumer operator (the reference's out-of-tree L5 layer).
+"""Shim: the operator lives in the installable package
+(tpu_operator_libs/examples/libtpu_operator.py); this path-based entry
+point is kept for repo-checkout invocation and docs parity."""
 
-The reference library has no main(); GPU-Operator-style controllers import
-it and call SetDriverName → NewClusterUpgradeStateManager → BuildState →
-ApplyState per reconcile (SURVEY.md §3.1). This example is that consumer
-for libtpu on GKE, runnable two ways:
-
-    # against a live cluster (requires the `kubernetes` package):
-    python examples/libtpu_operator.py --kubeconfig --policy policy.yaml
-
-    # demo: simulated 4-slice fleet with a rolling libtpu upgrade
-    python examples/libtpu_operator.py --demo
-
-It wires everything this library offers: topology-aware planning, the
-Orbax checkpoint eviction gate, the ICI fabric validator, Prometheus
-metrics on --metrics-port, and a reconcile loop that treats every error as
-retryable (the state machine is stateless/idempotent by design).
-"""
-
-from __future__ import annotations
-
-import argparse
-import json
-import logging
-import signal
+import os
 import sys
-import threading
-import time
-from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 
-sys.path.insert(0, ".")  # repo-root execution
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
-from tpu_operator_libs.api.upgrade_policy import UpgradePolicySpec
-from tpu_operator_libs.consts import UpgradeKeys
-from tpu_operator_libs.metrics import MetricsRegistry, observe_cluster_state
-from tpu_operator_libs.upgrade.state_manager import (
-    BuildStateError,
-    ClusterUpgradeStateManager,
+from tpu_operator_libs.examples.libtpu_operator import *  # noqa: F401,F403
+from tpu_operator_libs.examples.libtpu_operator import (  # noqa: F401
+    latest_status,
+    load_policy,
+    main,
+    run_leader_elected,
+    serve_metrics,
 )
-
-logger = logging.getLogger("libtpu-operator")
-
-
-def load_policy(path: str | None) -> UpgradePolicySpec:
-    if path is None:
-        return UpgradePolicySpec(
-            auto_upgrade=True, max_parallel_upgrades=0,
-            max_unavailable="25%", topology_mode="slice")
-    with open(path) as f:
-        text = f.read()
-    try:
-        data = json.loads(text)
-    except json.JSONDecodeError:
-        import yaml
-
-        data = yaml.safe_load(text)
-    if not isinstance(data, dict):
-        raise ValueError(
-            f"policy file {path!r} is empty or not a mapping")
-    inner = data.get("upgradePolicy", data)
-    if not isinstance(inner, dict):
-        raise ValueError(
-            f"policy file {path!r}: 'upgradePolicy' must be a mapping")
-    spec = UpgradePolicySpec.from_dict(inner)
-    spec.validate()
-    return spec
-
-
-#: Latest CRD-style status block per driver, refreshed each reconcile and
-#: served at /status (the operator-side view of cluster_status()).
-latest_status: dict = {}
-
-
-def serve_metrics(registry: MetricsRegistry, port: int,
-                  status_source=None) -> ThreadingHTTPServer:
-    """HTTP server for /metrics + /status. ``status_source`` is the
-    mutable status mapping to serve (default: this module's
-    ``latest_status``) — passed explicitly so other operators (the
-    unified example) don't have to rebind a cross-module global."""
-    if status_source is None:
-        status_source = latest_status
-
-    class Handler(BaseHTTPRequestHandler):
-        def do_GET(self):  # noqa: N802 - stdlib API
-            if self.path == "/metrics":
-                body = registry.render_prometheus().encode()
-                content_type = "text/plain; version=0.0.4"
-            elif self.path == "/status":
-                import json as _json
-
-                # shallow copy: the reconcile thread inserts keys
-                # concurrently and dict iteration must not race it
-                body = _json.dumps(dict(status_source), indent=2).encode()
-                content_type = "application/json"
-            else:
-                self.send_response(404)
-                self.end_headers()
-                return
-            self.send_response(200)
-            self.send_header("Content-Type", content_type)
-            self.end_headers()
-            self.wfile.write(body)
-
-        def log_message(self, *args):  # quiet
-            pass
-
-    server = ThreadingHTTPServer(("", port), Handler)
-    threading.Thread(target=server.serve_forever, daemon=True).start()
-    logger.info("metrics on :%d/metrics, status on :%d/status", port, port)
-    return server
-
-
-def build_manager(args, cluster, clock=None,
-                  poll_interval: float = 1.0) -> ClusterUpgradeStateManager:
-    keys = UpgradeKeys(driver=args.driver, domain=args.domain)
-    mgr = ClusterUpgradeStateManager(cluster, keys, clock=clock,
-                                     poll_interval=poll_interval)
-    if args.job_selector:
-        gate = None
-        if args.checkpoint_dir:
-            from tpu_operator_libs.health.checkpoint_gate import (
-                CheckpointDurabilityGate,
-            )
-
-            gate = CheckpointDurabilityGate(
-                args.checkpoint_dir,
-                max_age_seconds=args.checkpoint_max_age)
-        selector = args.job_selector
-
-        def deletion_filter(pod, _selector=selector):
-            from tpu_operator_libs.k8s.selectors import matches_labels
-
-            return matches_labels(_selector, pod.metadata.labels)
-
-        mgr.with_pod_deletion_enabled(deletion_filter, eviction_gate=gate)
-    if args.validator_selector or args.ici_probe:
-        extra = None
-        if args.ici_probe:
-            from tpu_operator_libs.health.ici_probe import ICIFabricValidator
-
-            extra = ICIFabricValidator(
-                min_bandwidth_gbytes_per_s=getattr(
-                    args, "min_bandwidth_gbytes_per_s", None))
-        mgr.with_validation_enabled(args.validator_selector or "",
-                                    extra_validator=extra)
-    return mgr
-
-
-def parse_runtime_labels(args) -> dict[str, str]:
-    return dict(kv.split("=", 1)
-                for kv in args.runtime_labels.split(",") if kv)
-
-
-def reconcile_once(mgr, args, policy, registry, runtime_labels) -> None:
-    """One build_state+apply_state pass with metrics/logging; shared by
-    the polling and watch-driven loops. BuildStateError (incomplete
-    snapshot) is retryable and only logged."""
-    started = time.monotonic()
-    try:
-        state = mgr.build_state(args.namespace, runtime_labels)
-        # status reflects the snapshot even when the transition pass below
-        # fails — /status must not freeze on the last-good block during
-        # exactly the incident it exists to expose
-        latest_status[args.driver] = mgr.cluster_status(state)
-        mgr.apply_state(state, policy)
-        observe_cluster_state(registry, mgr, state, driver=args.driver)
-        logger.info("reconciled: %d/%d done, %d in progress, %d failed",
-                    mgr.get_upgrades_done(state),
-                    mgr.get_total_managed_nodes(state),
-                    mgr.get_upgrades_in_progress(state),
-                    mgr.get_upgrades_failed(state))
-    except BuildStateError as exc:
-        logger.info("snapshot incomplete (%s); retrying", exc)
-    finally:
-        # histogram, not gauge: same metric family the watch-driven
-        # Controller records, so dashboards see one latency series
-        registry.observe_histogram("reconcile_duration_seconds",
-                                   time.monotonic() - started,
-                                   "Wall-clock seconds per reconcile pass",
-                                   {"driver": args.driver})
-
-
-def reconcile_forever(mgr, args, policy, registry, stop: threading.Event,
-                      step_hook=None) -> None:
-    runtime_labels = parse_runtime_labels(args)
-    while not stop.is_set():
-        try:
-            reconcile_once(mgr, args, policy, registry, runtime_labels)
-        except Exception:
-            logger.exception("reconcile failed; retrying")
-        if step_hook is not None:
-            if step_hook():
-                return
-        stop.wait(args.interval)
-
-
-def run_demo(args, registry) -> int:
-    """Simulated fleet: watch a full slice-atomic rolling upgrade."""
-    from tpu_operator_libs.simulate import (
-        NS,
-        RUNTIME_LABELS,
-        FleetSpec,
-        build_fleet,
-    )
-
-    fleet = FleetSpec(n_slices=args.demo_slices, hosts_per_slice=4)
-    cluster, clock, keys = build_fleet(fleet)
-    args.namespace = NS
-    args.runtime_labels = ",".join(f"{k}={v}"
-                                   for k, v in RUNTIME_LABELS.items())
-    mgr = build_manager(args, cluster, clock=clock, poll_interval=0.0)
-    policy = load_policy(args.policy)
-    stop = threading.Event()
-    outcome = {"converged": False}
-
-    virtual_interval = args.interval  # simulated seconds between passes
-    deadline = time.monotonic() + 120  # real-time safety stop
-
-    def step_hook() -> bool:
-        clock.advance(virtual_interval)
-        cluster.step()
-        labels = [n.metadata.labels.get(keys.state_label, "")
-                  for n in cluster.list_nodes()]
-        if all(lb == "upgrade-done" for lb in labels):
-            logger.info("demo complete: all %d nodes upgraded in %.0fs "
-                        "simulated", len(labels), clock.now())
-            print(registry.render_prometheus())
-            outcome["converged"] = True
-            stop.set()
-            return True
-        if time.monotonic() > deadline:
-            logger.error("demo did not converge within the safety window")
-            stop.set()
-            return True
-        return False
-
-    args.interval = 0.0  # no real-time sleep between simulated passes
-    reconcile_forever(mgr, args, policy, registry, stop, step_hook)
-    return 0 if outcome["converged"] else 1
-
-
-def election_config(args):
-    """The one LeaderElectionConfig both run paths share — the watch and
-    poll variants of the same deployment must contend for the SAME
-    lease."""
-    import os
-    import socket
-
-    from tpu_operator_libs.k8s.leaderelection import LeaderElectionConfig
-
-    identity = args.leader_identity \
-        or f"{socket.gethostname()}-{os.getpid()}"
-    return LeaderElectionConfig(namespace=args.namespace,
-                                name="tpu-operator-leader",
-                                identity=identity)
-
-
-def run_leader_elected(args, cluster, stop: threading.Event,
-                       run_loop) -> None:
-    """Gate the reconcile loop on a coordination.k8s.io Lease, the way a
-    controller-runtime manager does for the reference's consumers. The
-    reconcile loop starts when leadership is acquired and the process
-    exits when it is lost (the standard HA-operator pattern: let the
-    replica controller restart us as a follower)."""
-    from tpu_operator_libs.k8s.leaderelection import LeaderElector
-
-    config = election_config(args)
-    identity = config.identity
-    loop_thread: list[threading.Thread] = []
-
-    def on_started():
-        logger.info("leader election: became leader as %s", identity)
-        thread = threading.Thread(target=run_loop, daemon=True)
-        thread.start()
-        loop_thread.append(thread)
-
-    def on_stopped():
-        logger.warning("leader election: leadership lost; stopping")
-        stop.set()
-
-    elector = LeaderElector(
-        cluster, config,
-        on_started_leading=on_started,
-        on_stopped_leading=on_stopped,
-        on_new_leader=lambda leader: logger.info(
-            "leader election: current leader is %s", leader))
-    elector.run(stop)
-    for thread in loop_thread:
-        thread.join(timeout=5.0)
-
-
-def main() -> int:
-    parser = argparse.ArgumentParser(description=__doc__.split("\n")[0])
-    parser.add_argument("--namespace", default="tpu-system")
-    parser.add_argument("--runtime-labels", default="app=libtpu",
-                        help="k=v[,k=v] selecting the runtime DaemonSet")
-    parser.add_argument("--driver", default="libtpu")
-    parser.add_argument("--domain", default="google.com")
-    parser.add_argument("--policy", help="policy YAML/JSON file")
-    parser.add_argument("--interval", type=float, default=30.0)
-    parser.add_argument("--metrics-port", type=int, default=0,
-                        help="serve /metrics on this port (0 = off)")
-    parser.add_argument("--job-selector", default="",
-                        help="label selector for workload pods to delete")
-    parser.add_argument("--checkpoint-dir", default="",
-                        help="Orbax checkpoint root gating eviction")
-    parser.add_argument("--checkpoint-max-age", type=float, default=0.0)
-    parser.add_argument("--validator-selector", default="",
-                        help="label selector for validation pods")
-    parser.add_argument("--min-bandwidth-gbytes-per-s", type=float,
-                        default=None,
-                        help="fail validation when measured per-link ICI "
-                             "throughput is below this floor (GByte/s); "
-                             "requires --ici-probe")
-    parser.add_argument("--ici-probe", action="store_true",
-                        help="gate validation on the local ICI fabric probe")
-    parser.add_argument("--kubeconfig", action="store_true",
-                        help="connect via local kubeconfig (else in-cluster)")
-    parser.add_argument("--leader-elect", action="store_true",
-                        help="run only while holding the Lease "
-                             "<namespace>/tpu-operator-leader (HA replicas)")
-    parser.add_argument("--leader-identity", default="",
-                        help="contender identity (default: hostname+pid)")
-    parser.add_argument("--no-cache", action="store_true",
-                        help="read straight from the apiserver instead of "
-                             "the informer-backed read cache")
-    parser.add_argument("--poll", action="store_true",
-                        help="fixed-interval polling instead of the "
-                             "default watch-driven reconcile loop")
-    parser.add_argument("--demo", action="store_true",
-                        help="run against a simulated fleet")
-    parser.add_argument("--demo-slices", type=int, default=4)
-    args = parser.parse_args()
-    if args.min_bandwidth_gbytes_per_s is not None and not args.ici_probe:
-        # without the probe the floor would be silently unenforced
-        parser.error("--min-bandwidth-gbytes-per-s requires --ici-probe")
-
-    logging.basicConfig(
-        level=logging.INFO,
-        format="%(asctime)s %(name)s %(levelname)s %(message)s")
-    registry = MetricsRegistry()
-    server = serve_metrics(registry, args.metrics_port) \
-        if args.metrics_port else None
-
-    try:
-        if args.demo:
-            return run_demo(args, registry)
-
-        from tpu_operator_libs.k8s.real import RealCluster
-
-        cluster = (RealCluster.from_kubeconfig() if args.kubeconfig
-                   else RealCluster.in_cluster())
-        policy = load_policy(args.policy)
-        stop = threading.Event()
-        signal.signal(signal.SIGTERM, lambda *a: stop.set())
-        signal.signal(signal.SIGINT, lambda *a: stop.set())
-
-        exit_code = [0]
-
-        if not args.poll:
-            # Watch-driven default: OperatorManager packages the cached
-            # client, controller, and (optionally) leader election the
-            # way controller-runtime's manager does — caches are built
-            # only after leadership is won.
-            from tpu_operator_libs.controller import ReconcileResult
-            from tpu_operator_libs.manager import OperatorManager
-
-            runtime_labels = parse_runtime_labels(args)
-            held = {}
-
-            def reconcile(_key):
-                if "mgr" not in held:
-                    held["mgr"] = build_manager(args, op_mgr.client)
-                reconcile_once(held["mgr"], args, policy, registry,
-                               runtime_labels)
-                return ReconcileResult()
-
-            election = election_config(args) if args.leader_elect else None
-            op_mgr = OperatorManager(
-                cluster, args.namespace, reconcile,
-                name=f"{args.driver}-operator",
-                use_cache=not args.no_cache,
-                resync_period=args.interval,
-                leader_election=election, metrics=registry)
-            try:
-                op_mgr.run(stop)
-            except TimeoutError as exc:
-                logger.error("startup failed: %s", exc)
-                exit_code[0] = 1
-            return exit_code[0]
-
-        def run_loop():
-            # Polling fallback (--poll). Built here — after leader
-            # election is won — so standby replicas hold no informer
-            # caches or watch streams.
-            client = cluster
-            cached = None
-            if not args.no_cache:
-                from tpu_operator_libs.k8s.cached import CachedReadClient
-
-                client = cached = CachedReadClient(cluster, args.namespace)
-                if not cached.has_synced(timeout=60.0):
-                    logger.error("informer caches failed to sync "
-                                 "within 60s")
-                    cached.stop()
-                    exit_code[0] = 1  # startup failure must not exit 0
-                    stop.set()
-                    return
-            try:
-                mgr = build_manager(args, client)
-                reconcile_forever(mgr, args, policy, registry, stop)
-            finally:
-                if cached is not None:
-                    cached.stop()
-
-        if args.leader_elect:
-            run_leader_elected(args, cluster, stop, run_loop)
-        else:
-            run_loop()
-        return exit_code[0]
-    finally:
-        if server is not None:
-            server.shutdown()
-
 
 if __name__ == "__main__":
     sys.exit(main())

@@ -8,10 +8,8 @@ from typing import Optional
 
 from tpu_operator_libs.consts import UpgradeKeys
 from tpu_operator_libs.k8s.fake import FakeCluster
-from tpu_operator_libs.upgrade.cordon_manager import CordonManager
 from tpu_operator_libs.upgrade.drain_manager import DrainManager
 from tpu_operator_libs.upgrade.pod_manager import PodManager
-from tpu_operator_libs.upgrade.safe_load_manager import SafeRuntimeLoadManager
 from tpu_operator_libs.upgrade.state_manager import ClusterUpgradeStateManager
 from tpu_operator_libs.upgrade.state_provider import NodeUpgradeStateProvider
 from tpu_operator_libs.upgrade.validation_manager import ValidationManager

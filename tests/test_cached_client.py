@@ -14,7 +14,7 @@ import time
 import pytest
 
 from tpu_operator_libs.api.upgrade_policy import DrainSpec, UpgradePolicySpec
-from tpu_operator_libs.consts import UpgradeKeys, UpgradeState
+from tpu_operator_libs.consts import UpgradeState
 from tpu_operator_libs.k8s.cached import CachedReadClient, CacheNotSyncedError
 from tpu_operator_libs.k8s.client import NotFoundError
 from tpu_operator_libs.simulate import (
@@ -29,7 +29,7 @@ from tpu_operator_libs.upgrade.state_manager import (
 )
 from tpu_operator_libs.upgrade.state_provider import NodeUpgradeStateProvider
 
-from builders import DaemonSetBuilder, NodeBuilder, PodBuilder
+from builders import NodeBuilder, PodBuilder
 from helpers import make_env
 
 

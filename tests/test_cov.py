@@ -1,0 +1,106 @@
+"""tools/cov.py — the sys.monitoring line-coverage tracer + gate.
+
+Pins the denominator semantics (co_lines over nested code objects,
+pragma exclusion spans) and the end-to-end gate behavior on a synthetic
+package, so the CI coverage job's tool is itself under test.
+"""
+
+import os
+import subprocess
+import sys
+import textwrap
+
+sys.path.insert(0, os.path.join(
+    os.path.dirname(os.path.dirname(os.path.abspath(__file__))), "tools"))
+
+from cov import _pragma_excluded, _summarize, traceable_lines  # noqa: E402
+
+ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+class TestTraceableLines:
+    def test_nested_code_objects_counted(self, tmp_path):
+        path = tmp_path / "m.py"
+        path.write_text(textwrap.dedent("""\
+            def outer():
+                def inner():
+                    return 1
+                return inner
+
+            class C:
+                def method(self):
+                    return [x for x in range(3)]
+            """))
+        lines = traceable_lines(path)
+        # the inner function body and the comprehension are included
+        assert {2, 3, 7, 8}.issubset(lines)
+
+    def test_pragma_excludes_whole_statement_span(self):
+        source = textwrap.dedent("""\
+            x = 1
+            if x:  # pragma: no cover
+                y = 2
+                z = 3
+            w = 4
+            """)
+        excluded = _pragma_excluded(source)
+        assert excluded == {2, 3, 4}
+
+    def test_syntax_error_file_is_empty(self, tmp_path):
+        path = tmp_path / "bad.py"
+        path.write_text("def f(:\n")
+        assert traceable_lines(path) == set()
+
+
+class TestSummarize:
+    def test_ranges(self):
+        assert _summarize([1, 2, 3, 7, 9]) == "1-3, 7, 9"
+
+    def test_truncation(self):
+        text = _summarize(list(range(1, 40, 2)), limit=3)
+        assert text.endswith(", ...")
+
+
+class TestGateEndToEnd:
+    def _run(self, tmp_path, threshold):
+        pkg = tmp_path / "toypkg"
+        pkg.mkdir()
+        (pkg / "__init__.py").write_text("")
+        (pkg / "mod.py").write_text(textwrap.dedent("""\
+            def covered():
+                return 1
+
+            def uncovered():
+                a = 1
+                b = 2
+                c = 3
+                d = 4
+                return a + b + c + d
+            """))
+        test_file = tmp_path / "test_toy.py"
+        test_file.write_text(textwrap.dedent("""\
+            import sys
+            sys.path.insert(0, %r)
+            from toypkg.mod import covered
+
+            def test_covered():
+                assert covered() == 1
+            """ % str(tmp_path)))
+        return subprocess.run(
+            [sys.executable, os.path.join(ROOT, "tools", "cov.py"),
+             "--threshold", str(threshold),
+             "--include", str(pkg), "--exclude", "/nonexistent",
+             "--", str(test_file), "-q", "-p", "no:cacheprovider"],
+            capture_output=True, text=True, cwd=tmp_path, timeout=120)
+
+    def test_gate_fails_below_threshold(self, tmp_path):
+        proc = self._run(tmp_path, threshold=95)
+        assert proc.returncode == 1, proc.stdout + proc.stderr
+        assert "FAIL" in proc.stderr
+
+    def test_gate_passes_above_threshold(self, tmp_path):
+        proc = self._run(tmp_path, threshold=30)
+        assert proc.returncode == 0, proc.stdout + proc.stderr
+        assert "OK" in proc.stderr
+        # per-file table shows the module with partial coverage
+        assert "mod.py" in proc.stdout

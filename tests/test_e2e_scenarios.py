@@ -18,7 +18,6 @@ from tpu_operator_libs.api.upgrade_policy import (
     UpgradePolicySpec,
     WaitForCompletionSpec,
 )
-from tpu_operator_libs.consts import UpgradeState
 from tpu_operator_libs.health.checkpoint_gate import CheckpointDurabilityGate
 from tpu_operator_libs.simulate import (
     NS,

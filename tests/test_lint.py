@@ -1,0 +1,246 @@
+"""tools/lint.py — the in-repo static analyzer.
+
+The reference enforces ~40 golangci linters in CI (.golangci.yaml:17-60);
+our rule set is implemented in-repo so `make lint` can never silently
+degrade when external tools are missing. These tests pin each rule and —
+just as important — the false-positive guards (format-spec f-strings,
+class-scope opacity, comprehension scoping, noqa, re-export idioms).
+"""
+
+import os
+import subprocess
+import sys
+
+sys.path.insert(0, os.path.join(
+    os.path.dirname(os.path.dirname(os.path.abspath(__file__))), "tools"))
+
+from lint import check_source  # noqa: E402
+
+
+def codes(source):
+    return [f.code for f in check_source(source)]
+
+
+class TestUndefinedNames:
+    def test_flags_undefined(self):
+        assert codes("x = undefined_thing\n") == ["F821"]
+
+    def test_builtin_ok(self):
+        assert codes("x = len([])\nprint(x)\n") == []
+
+    def test_forward_reference_in_function_body(self):
+        # bodies execute later: later module names are fine
+        assert codes("def f():\n    return g()\ndef g():\n    return 1\n") == []
+
+    def test_class_scope_invisible_to_methods(self):
+        source = (
+            "class C:\n"
+            "    attr = 1\n"
+            "    def m(self):\n"
+            "        return attr\n")
+        assert codes(source) == ["F821"]
+
+    def test_class_scope_visible_at_class_level(self):
+        source = "class C:\n    a = 1\n    b = a + 1\n"
+        assert codes(source) == []
+
+    def test_global_statement(self):
+        source = (
+            "def set_it():\n"
+            "    global counter\n"
+            "    counter = 1\n"
+            "def get_it():\n"
+            "    return counter\n")
+        assert codes(source) == []
+
+    def test_nonlocal(self):
+        source = (
+            "def outer():\n"
+            "    x = 0\n"
+            "    def inner():\n"
+            "        nonlocal x\n"
+            "        x = 1\n"
+            "    inner()\n"
+            "    return x\n")
+        assert codes(source) == []
+
+    def test_comprehension_scope(self):
+        assert codes("xs = [1]\nys = [x * 2 for x in xs]\nprint(ys)\n") == []
+
+    def test_star_import_suppresses(self):
+        assert "F821" not in codes("from os.path import *\nx = join('a')\n")
+
+    def test_except_alias_and_with_target(self):
+        source = (
+            "try:\n"
+            "    pass\n"
+            "except ValueError as exc:\n"
+            "    print(exc)\n"
+            "with open('f') as fh:\n"
+            "    print(fh)\n")
+        assert codes(source) == []
+
+    def test_annotation_names_are_uses(self):
+        source = (
+            "from typing import Optional\n"
+            "def f(x: Optional[int]) -> Optional[str]:\n"
+            "    return None\n")
+        assert codes(source) == []
+
+    def test_quoted_forward_ref_is_a_use(self):
+        source = (
+            "from typing import List\n"
+            "def f(x: \"List[int]\"):\n"
+            "    return x\n")
+        assert codes(source) == []
+
+
+class TestUnusedImports:
+    def test_flags_unused(self):
+        assert codes("import json\n") == ["F401"]
+
+    def test_used_import_ok(self):
+        assert codes("import json\nprint(json.dumps({}))\n") == []
+
+    def test_attribute_chain_counts_root(self):
+        assert codes("import os.path\nprint(os.path.join('a'))\n") == []
+
+    def test_reexport_idiom_exempt(self):
+        assert codes("import json as json\n") == []
+
+    def test_init_py_exempt(self):
+        findings = check_source("from .mod import thing\n",
+                                path="pkg/__init__.py")
+        assert findings == []
+
+    def test_future_exempt(self):
+        assert codes("from __future__ import annotations\n") == []
+
+    def test_import_used_only_in_annotation(self):
+        source = (
+            "from __future__ import annotations\n"
+            "import decimal\n"
+            "def f(x: decimal.Decimal) -> None:\n"
+            "    pass\n")
+        assert codes(source) == []
+
+
+class TestUnusedLocals:
+    def test_flags_unused_local(self):
+        assert codes("def f():\n    x = 1\n    return 2\n") == ["F841"]
+
+    def test_underscore_exempt(self):
+        assert codes("def f():\n    _ignored = 1\n    return 2\n") == []
+
+    def test_closure_read_counts(self):
+        source = (
+            "def f():\n"
+            "    x = 1\n"
+            "    def g():\n"
+            "        return x\n"
+            "    return g\n")
+        assert codes(source) == []
+
+    def test_loop_variable_exempt(self):
+        assert codes("def f(xs):\n    for i in xs:\n        pass\n") == []
+
+    def test_tuple_unpack_exempt(self):
+        assert codes("def f(p):\n    a, b = p\n    return a\n") == []
+
+    def test_module_level_not_flagged(self):
+        assert codes("x = 1\n") == []
+
+
+class TestExpressionRules:
+    def test_fstring_no_placeholder(self):
+        assert codes("x = f'static'\nprint(x)\n") == ["F541"]
+
+    def test_format_spec_not_flagged(self):
+        # `{v:.3e}` has a placeholder; the format spec itself is a
+        # JoinedStr with none — must not be flagged
+        assert codes("v = 1.0\nprint(f'{v:.3e}')\n") == []
+
+    def test_nested_spec_placeholder_is_use(self):
+        assert codes("v, w = 1.0, 8\nprint(f'{v:{w}}')\n") == []
+
+    def test_none_comparison(self):
+        assert codes("x = 1\nprint(x == None)\n") == ["E711"]
+
+    def test_bool_comparison(self):
+        assert codes("x = True\nprint(x == True)\n") == ["E712"]
+
+    def test_is_literal(self):
+        assert codes("x = 'a'\nprint(x is 'a')\n") == ["B015"]
+
+    def test_bare_except(self):
+        source = "try:\n    pass\nexcept:\n    pass\n"
+        assert codes(source) == ["E722"]
+
+    def test_typed_except_ok(self):
+        source = "try:\n    pass\nexcept Exception:\n    pass\n"
+        assert codes(source) == []
+
+    def test_mutable_default(self):
+        assert codes("def f(x=[]):\n    return x\n") == ["B006"]
+
+    def test_none_default_ok(self):
+        assert codes("def f(x=None):\n    return x\n") == []
+
+    def test_assert_tuple(self):
+        assert codes("assert (1, 'always true')\n") == ["B011"]
+
+    def test_duplicate_dict_key(self):
+        assert codes("d = {'a': 1, 'a': 2}\nprint(d)\n") == ["C416"]
+
+    def test_redefinition(self):
+        source = "def f():\n    pass\ndef f():\n    pass\nf()\n"
+        assert codes(source) == ["F811"]
+
+    def test_property_setter_not_redefinition(self):
+        source = (
+            "class C:\n"
+            "    @property\n"
+            "    def x(self):\n"
+            "        return 1\n"
+            "    @x.setter\n"
+            "    def x(self, v):\n"
+            "        pass\n")
+        assert codes(source) == []
+
+    def test_invalid_escape(self):
+        assert codes("p = '\\d+'\nprint(p)\n") == ["W605"]
+
+    def test_raw_string_ok(self):
+        assert codes("p = r'\\d+'\nprint(p)\n") == []
+
+    def test_dunder_all_undefined_entry(self):
+        assert codes("__all__ = ['ghost']\n") == ["A001"]
+
+    def test_dunder_all_defined_ok(self):
+        assert codes("def thing():\n    pass\n__all__ = ['thing']\n") == []
+
+
+class TestSuppression:
+    def test_noqa_bare(self):
+        assert codes("import json  # noqa\n") == []
+
+    def test_noqa_with_matching_code(self):
+        assert codes("import json  # noqa: F401\n") == []
+
+    def test_noqa_with_other_code_still_reports(self):
+        assert codes("import json  # noqa: E722\n") == ["F401"]
+
+    def test_syntax_error_reported_not_crash(self):
+        assert codes("def f(:\n") == ["E999"]
+
+
+class TestCli:
+    def test_clean_repo_lints_clean(self):
+        # the repo itself must stay lint-clean — this is the CI gate
+        # duplicated as a test so `make test` alone catches regressions
+        root = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+        proc = subprocess.run(
+            [sys.executable, os.path.join(root, "tools", "lint.py")],
+            capture_output=True, text=True, cwd=root, timeout=300)
+        assert proc.returncode == 0, proc.stdout[-4000:]
+        assert "0 findings" in proc.stderr

@@ -10,7 +10,6 @@ from tpu_operator_libs.controller import ReconcileResult
 from tpu_operator_libs.k8s.cached import CachedReadClient
 from tpu_operator_libs.k8s.leaderelection import LeaderElectionConfig
 from tpu_operator_libs.manager import OperatorManager
-from tpu_operator_libs.util import FakeClock
 
 from builders import NodeBuilder
 from helpers import make_env

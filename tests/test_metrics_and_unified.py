@@ -165,7 +165,6 @@ class TestMetricsRegistry:
 
     def test_controller_records_reconcile_duration(self):
         from tpu_operator_libs.controller import (
-            CLUSTER_KEY,
             Controller,
             ReconcileResult,
         )

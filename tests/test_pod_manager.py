@@ -7,7 +7,6 @@ from tpu_operator_libs.api.upgrade_policy import (
     PodDeletionSpec,
     WaitForCompletionSpec,
 )
-from tpu_operator_libs.consts import UpgradeState
 from tpu_operator_libs.k8s.objects import PodPhase
 from tpu_operator_libs.upgrade.pod_manager import (
     PodManagerConfig,

@@ -296,7 +296,7 @@ class TestPrimitiveContention:
         from builders import NodeBuilder
 
         env = make_env()
-        node = NodeBuilder("n1").create(env.cluster)
+        NodeBuilder("n1").create(env.cluster)
         start_rv = env.cluster.get_node("n1").metadata.resource_version
         states = [UpgradeState.UPGRADE_REQUIRED, UpgradeState.CORDON_REQUIRED,
                   UpgradeState.WAIT_FOR_JOBS_REQUIRED,

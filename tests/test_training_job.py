@@ -12,7 +12,6 @@ commit exists.
 import importlib.util
 import os
 import sys
-import threading
 
 import pytest
 
@@ -59,8 +58,6 @@ class TestGateParsesRealOrbax:
 
 class TestResume:
     def test_resumes_from_last_commit_with_identical_state(self, job, tmp_path):
-        import jax.numpy as jnp
-
         ckpt = str(tmp_path / "ckpt")
         # run 1: 10 steps, committing every 5 — then "evicted"
         first = job.train(ckpt, max_steps=10, save_interval=5, n_devices=4)

@@ -1,0 +1,246 @@
+#!/usr/bin/env python3
+"""In-repo line-coverage tracer with a threshold gate.
+
+The reference CI uploads coverage and gates on it
+(.github/workflows/ci.yaml:45-64 → Coveralls); this image has no
+coverage.py, so the tracer lives here, built on PEP 669
+``sys.monitoring`` (Python ≥ 3.12): LINE events with per-location
+DISABLE once seen, which keeps overhead far below settrace.
+
+Usage::
+
+    python tools/cov.py [--threshold 85] [--include tpu_operator_libs]
+                        [--exclude tpu_operator_libs/examples]
+                        [--report-json cov.json] [--] [pytest args...]
+
+Runs pytest in-process under the tracer, then reports per-file and total
+line coverage over the include roots and exits non-zero if total
+coverage is below the threshold. The denominator is each file's set of
+*traceable* lines — the union of ``co_lines()`` over every code object
+compiled from the file — so numerator and denominator come from the same
+authority (the interpreter), not an AST approximation. Lines inside a
+``# pragma: no cover`` statement (the statement's whole span) are
+excluded, matching coverage.py's contract.
+
+Examples (``tpu_operator_libs/examples``) are excluded from the default
+gate: they run as subprocesses in the test suite (their ``__main__``
+path), which an in-process tracer cannot observe.
+"""
+
+from __future__ import annotations
+
+import argparse
+import ast
+import os
+import sys
+from collections import defaultdict
+from pathlib import Path
+
+TOOL_ID = 3  # sys.monitoring.COVERAGE_ID
+
+
+class LineCollector:
+    """Records executed lines for files under the include roots."""
+
+    def __init__(self, include: list[str], exclude: list[str]) -> None:
+        self.include = [os.path.abspath(p) + os.sep for p in include]
+        self.exclude = [os.path.abspath(p) + os.sep for p in exclude]
+        self.executed: dict[str, set[int]] = defaultdict(set)
+        self._interesting: dict[str, bool] = {}
+
+    def _wanted(self, filename: str) -> bool:
+        cached = self._interesting.get(filename)
+        if cached is not None:
+            return cached
+        path = os.path.abspath(filename) + ("" if filename.endswith(".py")
+                                            else os.sep)
+        wanted = (any(path.startswith(root) for root in self.include)
+                  and not any(path.startswith(root)
+                              for root in self.exclude))
+        self._interesting[filename] = wanted
+        return wanted
+
+    def on_line(self, code, line_number: int):
+        filename = code.co_filename
+        if not self._wanted(filename):
+            return sys.monitoring.DISABLE
+        self.executed[os.path.abspath(filename)].add(line_number)
+        # this exact (code, line) location will not change coverage again
+        return sys.monitoring.DISABLE
+
+    def start(self) -> None:
+        sys.monitoring.use_tool_id(TOOL_ID, "tpucov")
+        sys.monitoring.register_callback(
+            TOOL_ID, sys.monitoring.events.LINE, self.on_line)
+        sys.monitoring.set_events(TOOL_ID, sys.monitoring.events.LINE)
+
+    def stop(self) -> None:
+        sys.monitoring.set_events(TOOL_ID, 0)
+        sys.monitoring.register_callback(
+            TOOL_ID, sys.monitoring.events.LINE, None)
+        sys.monitoring.free_tool_id(TOOL_ID)
+
+
+def traceable_lines(path: Path) -> set[int]:
+    """All line numbers the interpreter can emit LINE events for, from
+    the code objects themselves (recursing into nested functions,
+    classes, and comprehensions via co_consts)."""
+    try:
+        source = path.read_text()
+        top = compile(source, str(path), "exec")
+    except (OSError, SyntaxError, UnicodeDecodeError):
+        return set()
+    lines: set[int] = set()
+    stack = [top]
+    while stack:
+        code = stack.pop()
+        for _start, _end, line in code.co_lines():
+            if line is not None and line > 0:
+                lines.add(line)
+        for const in code.co_consts:
+            if hasattr(const, "co_lines"):
+                stack.append(const)
+    lines -= _pragma_excluded(source)
+    return lines
+
+
+def _pragma_excluded(source: str) -> set[int]:
+    """Whole line-spans of statements whose header line carries
+    ``pragma: no cover``."""
+    marked: set[int] = set()
+    for i, text in enumerate(source.splitlines(), start=1):
+        if "pragma: no cover" in text:
+            marked.add(i)
+    if not marked:
+        return marked
+    excluded = set(marked)
+    try:
+        tree = ast.parse(source)
+    except SyntaxError:
+        return excluded
+    for node in ast.walk(tree):
+        lineno = getattr(node, "lineno", None)
+        end = getattr(node, "end_lineno", None)
+        if lineno in marked and end is not None \
+                and isinstance(node, ast.stmt):
+            excluded.update(range(lineno, end + 1))
+    return excluded
+
+
+def iter_source_files(include: list[str],
+                      exclude: list[str]) -> list[Path]:
+    seen: list[Path] = []
+    exclude_abs = [os.path.abspath(p) + os.sep for p in exclude]
+    for root in include:
+        base = Path(root)
+        if base.is_file():
+            seen.append(base)
+            continue
+        for path in sorted(base.rglob("*.py")):
+            abspath = os.path.abspath(path) + os.sep
+            if any(abspath.startswith(e) for e in exclude_abs):
+                continue
+            seen.append(path)
+    return seen
+
+
+def main(argv: list[str] | None = None) -> int:
+    parser = argparse.ArgumentParser(description=__doc__.split("\n")[0])
+    parser.add_argument("--threshold", type=float, default=85.0,
+                        help="fail if total coverage %% is below this")
+    parser.add_argument("--include", action="append", default=None,
+                        help="source roots to measure (repeatable)")
+    parser.add_argument("--exclude", action="append", default=None,
+                        help="roots to exclude from the gate (repeatable)")
+    parser.add_argument("--report-json", default=None,
+                        help="write a machine-readable report here")
+    parser.add_argument("--top-misses", type=int, default=5,
+                        help="show the N files with most uncovered lines")
+    parser.add_argument("pytest_args", nargs="*",
+                        help="arguments forwarded to pytest")
+    args = parser.parse_args(argv)
+    include = args.include or ["tpu_operator_libs"]
+    exclude = args.exclude if args.exclude is not None \
+        else ["tpu_operator_libs/examples"]
+
+    if sys.version_info < (3, 12):
+        print("cov: sys.monitoring requires Python >= 3.12; refusing to "
+              "report fake numbers", file=sys.stderr)
+        return 2
+
+    collector = LineCollector(include, exclude)
+    collector.start()
+    try:
+        import pytest
+
+        pytest_rc = pytest.main(args.pytest_args or ["tests/", "-q"])
+    finally:
+        collector.stop()
+    if pytest_rc != 0:
+        print(f"cov: pytest failed (rc={pytest_rc}); coverage not gated",
+              file=sys.stderr)
+        return int(pytest_rc)
+
+    rows = []
+    total_hit = total_lines = 0
+    for path in iter_source_files(include, exclude):
+        lines = traceable_lines(path)
+        if not lines:
+            continue
+        hit = collector.executed.get(os.path.abspath(str(path)), set())
+        covered = len(lines & hit)
+        rows.append((str(path), covered, len(lines),
+                     sorted(lines - hit)))
+        total_hit += covered
+        total_lines += len(lines)
+
+    pct = 100.0 * total_hit / total_lines if total_lines else 0.0
+    width = max((len(r[0]) for r in rows), default=10)
+    print(f"\n{'file':<{width}}  lines  miss   cover")
+    for name, covered, n_lines, missing in rows:
+        print(f"{name:<{width}}  {n_lines:5d}  {n_lines - covered:4d}  "
+              f"{100.0 * covered / n_lines:5.1f}%")
+    print(f"{'TOTAL':<{width}}  {total_lines:5d}  "
+          f"{total_lines - total_hit:4d}  {pct:5.1f}%")
+
+    worst = sorted(rows, key=lambda r: len(r[3]), reverse=True)
+    for name, _covered, _n, missing in worst[:args.top_misses]:
+        if missing:
+            print(f"  miss {name}: {_summarize(missing)}")
+
+    if args.report_json:
+        import json
+
+        with open(args.report_json, "w") as fh:
+            json.dump({
+                "total_pct": round(pct, 2),
+                "threshold": args.threshold,
+                "files": {name: {"covered": covered, "lines": n_lines,
+                                 "missing": missing}
+                          for name, covered, n_lines, missing in rows},
+            }, fh, indent=1)
+
+    if pct < args.threshold:
+        print(f"cov: FAIL — total {pct:.1f}% < threshold "
+              f"{args.threshold:.1f}%", file=sys.stderr)
+        return 1
+    print(f"cov: OK — total {pct:.1f}% >= threshold "
+          f"{args.threshold:.1f}%", file=sys.stderr)
+    return 0
+
+
+def _summarize(lines: list[int], limit: int = 8) -> str:
+    """Compress [1,2,3,7,9] to '1-3, 7, 9'."""
+    ranges: list[tuple[int, int]] = []
+    for line in lines:
+        if ranges and line == ranges[-1][1] + 1:
+            ranges[-1] = (ranges[-1][0], line)
+        else:
+            ranges.append((line, line))
+    parts = [f"{a}-{b}" if a != b else str(a) for a, b in ranges]
+    suffix = ", ..." if len(parts) > limit else ""
+    return ", ".join(parts[:limit]) + suffix
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,626 @@
+#!/usr/bin/env python3
+"""In-repo static analyzer (the .golangci.yaml analogue, tools edition).
+
+The reference pins golangci-lint with ~40 linters (.golangci.yaml:17-60)
+and fails CI on findings. This image ships no Python linter at all, and a
+`make lint` that silently degrades to byte-compilation is worse than none
+— so the rule set lives here, in ~600 lines of stdlib `ast`, and is
+always available. Checks (codes mirror the pyflakes/pycodestyle family
+where one exists):
+
+  F401  import bound but never used (skipped in __init__.py re-export
+        surfaces and behind `as _` aliases)
+  F403  wildcard import (informational only in shim files; suppresses
+        F401/F821 for the module, like pyflakes)
+  F811  redefinition of a function/class in the same scope
+  F821  undefined name (scope-aware: module/class/function/comprehension
+        chains, class-scope opacity to nested functions, global/nonlocal)
+  F841  local variable assigned but never used
+  F541  f-string without placeholders
+  E711  comparison to None with ==/!=
+  E712  comparison to True/False with ==/!=
+  E722  bare `except:`
+  B006  mutable default argument (list/dict/set literal or call)
+  B011  assert on a non-empty tuple (always true)
+  B015  `is` comparison against a str/int/tuple literal
+  W605  invalid escape sequence in a plain string literal
+  C416  dict/list/set literal with duplicate keys → F601-style dup check
+  A001  `__all__` entry not defined in module scope
+
+Suppression: a trailing ``# noqa`` comment silences every finding on that
+line; ``# noqa: F401`` silences only the listed codes. Config: paths come
+from ``[tool.tpulint] paths`` in pyproject.toml when no CLI paths are
+given. Exit status 1 iff findings were printed — `make lint` and CI rely
+on that.
+"""
+
+from __future__ import annotations
+
+import ast
+import builtins
+import sys
+import tokenize
+from dataclasses import dataclass, field
+from pathlib import Path
+from typing import Iterable, Optional
+
+BUILTIN_NAMES = set(dir(builtins)) | {
+    "__file__", "__name__", "__doc__", "__package__", "__spec__",
+    "__loader__", "__builtins__", "__debug__", "__annotations__",
+    "__path__", "__class__", "__module__", "__qualname__", "__dict__",
+}
+
+MUTABLE_CALLS = {"list", "dict", "set", "defaultdict", "OrderedDict",
+                 "Counter", "deque"}
+
+
+@dataclass
+class Finding:
+    path: str
+    line: int
+    col: int
+    code: str
+    message: str
+
+    def render(self) -> str:
+        return f"{self.path}:{self.line}:{self.col}: {self.code} {self.message}"
+
+
+@dataclass
+class Scope:
+    kind: str  # module | class | function | comprehension
+    node: ast.AST
+    bindings: dict[str, ast.AST] = field(default_factory=dict)
+    uses: set[str] = field(default_factory=set)
+    globals_: set[str] = field(default_factory=set)
+    nonlocals: set[str] = field(default_factory=set)
+
+
+class _Binder(ast.NodeVisitor):
+    """Pass 1: build the scope tree and record every binding and use."""
+
+    def __init__(self, checker: "FileChecker") -> None:
+        self.c = checker
+
+    # -- scope helpers ----------------------------------------------------
+    def _push(self, kind: str, node: ast.AST) -> Scope:
+        scope = Scope(kind, node)
+        self.c.scope_of[node] = scope
+        self.c.parents[node] = self.c.stack[-1] if self.c.stack else None
+        self.c.stack.append(scope)
+        return scope
+
+    def _pop(self) -> None:
+        self.c.stack.pop()
+
+    def _bind(self, name: str, node: ast.AST) -> None:
+        scope = self.c.stack[-1]
+        if name in scope.globals_:
+            self.c.module_scope.bindings.setdefault(name, node)
+            return
+        if name in scope.nonlocals:
+            for outer in reversed(self.c.stack[:-1]):
+                if outer.kind in ("function", "comprehension"):
+                    outer.bindings.setdefault(name, node)
+                    return
+            return
+        scope.bindings[name] = node
+
+    def _use(self, name: str) -> None:
+        self.c.stack[-1].uses.add(name)
+        self.c.all_uses.add(name)
+
+    # -- bindings ---------------------------------------------------------
+    def visit_Name(self, node: ast.Name) -> None:
+        if isinstance(node.ctx, ast.Load):
+            self._use(node.id)
+            self.c.load_sites.append((node, tuple(self.c.stack)))
+        elif isinstance(node.ctx, (ast.Store, ast.Del)):
+            self._bind(node.id, node)
+            if isinstance(node.ctx, ast.Store):
+                self.c.store_sites.append((node, self.c.stack[-1]))
+        self.generic_visit(node)
+
+    def visit_Global(self, node: ast.Global) -> None:
+        self.c.stack[-1].globals_.update(node.names)
+
+    def visit_Nonlocal(self, node: ast.Nonlocal) -> None:
+        self.c.stack[-1].nonlocals.update(node.names)
+
+    def _visit_import(self, node, names: Iterable[ast.alias],
+                      from_module: Optional[str]) -> None:
+        for alias in names:
+            if alias.name == "*":
+                self.c.has_star_import = True
+                self.c.report(node, "F403",
+                              f"wildcard import from {from_module!r} "
+                              "(undefined-name analysis degraded)")
+                continue
+            bound = alias.asname or alias.name.split(".")[0]
+            self._bind(bound, node)
+            self.c.imports.append((bound, alias, node,
+                                   self.c.stack[-1]))
+
+    def visit_Import(self, node: ast.Import) -> None:
+        self._visit_import(node, node.names, None)
+
+    def visit_ImportFrom(self, node: ast.ImportFrom) -> None:
+        if node.module == "__future__":
+            for alias in node.names:
+                self._bind(alias.asname or alias.name, node)
+            return
+        self._visit_import(node, node.names, node.module or "." * node.level)
+
+    # -- function-like scopes ---------------------------------------------
+    def _walk_args(self, args: ast.arguments) -> None:
+        for arg in (*args.posonlyargs, *args.args, *args.kwonlyargs,
+                    *([args.vararg] if args.vararg else []),
+                    *([args.kwarg] if args.kwarg else [])):
+            self._bind(arg.arg, arg)
+            if arg.annotation is not None:
+                self._eval_annotation(arg.annotation)
+
+    def _eval_annotation(self, node: ast.AST) -> None:
+        # annotations are uses (they keep typing imports alive); a quoted
+        # forward reference is parsed and its names count too
+        if isinstance(node, ast.Constant) and isinstance(node.value, str):
+            try:
+                parsed = ast.parse(node.value, mode="eval")
+            except SyntaxError:
+                return
+            for sub in ast.walk(parsed):
+                if isinstance(sub, ast.Name):
+                    self._use(sub.id)
+            return
+        for sub in ast.walk(node):
+            if isinstance(sub, ast.Name) and isinstance(sub.ctx, ast.Load):
+                self._use(sub.id)
+            elif (isinstance(sub, ast.Constant)
+                  and isinstance(sub.value, str)):
+                self._eval_annotation(sub)
+
+    def _visit_functiondef(self, node) -> None:
+        prev = self.c.stack[-1].bindings.get(node.name)
+        if (isinstance(prev, (ast.FunctionDef, ast.AsyncFunctionDef,
+                              ast.ClassDef))
+                and not _is_overload_or_dispatch(prev, node)):
+            self.c.report(node, "F811",
+                          f"redefinition of {node.name!r} "
+                          f"(first defined at line {prev.lineno})")
+        self._bind(node.name, node)
+        for deco in node.decorator_list:
+            self.visit(deco)
+        if node.returns is not None:
+            self._eval_annotation(node.returns)
+        for default in (*node.args.defaults, *node.args.kw_defaults):
+            if default is not None:
+                self.visit(default)
+        self._check_mutable_defaults(node)
+        self._push("function", node)
+        self._walk_args(node.args)
+        for stmt in node.body:
+            self.visit(stmt)
+        self._pop()
+
+    visit_FunctionDef = _visit_functiondef
+    visit_AsyncFunctionDef = _visit_functiondef
+
+    def visit_Lambda(self, node: ast.Lambda) -> None:
+        for default in (*node.args.defaults, *node.args.kw_defaults):
+            if default is not None:
+                self.visit(default)
+        self._push("function", node)
+        self._walk_args(node.args)
+        self.visit(node.body)
+        self._pop()
+
+    def visit_ClassDef(self, node: ast.ClassDef) -> None:
+        prev = self.c.stack[-1].bindings.get(node.name)
+        if isinstance(prev, (ast.FunctionDef, ast.AsyncFunctionDef,
+                             ast.ClassDef)):
+            self.c.report(node, "F811",
+                          f"redefinition of {node.name!r} "
+                          f"(first defined at line {prev.lineno})")
+        self._bind(node.name, node)
+        for deco in node.decorator_list:
+            self.visit(deco)
+        for base in (*node.bases, *node.keywords):
+            self.visit(base)
+        self._push("class", node)
+        for stmt in node.body:
+            self.visit(stmt)
+        self._pop()
+
+    def _visit_comprehension(self, node) -> None:
+        # the leftmost iterable evaluates in the enclosing scope
+        self.visit(node.generators[0].iter)
+        self._push("comprehension", node)
+        for i, gen in enumerate(node.generators):
+            self.visit(gen.target)
+            if i > 0:
+                self.visit(gen.iter)
+            for cond in gen.ifs:
+                self.visit(cond)
+        if isinstance(node, ast.DictComp):
+            self.visit(node.key)
+            self.visit(node.value)
+        else:
+            self.visit(node.elt)
+        self._pop()
+
+    visit_ListComp = _visit_comprehension
+    visit_SetComp = _visit_comprehension
+    visit_DictComp = _visit_comprehension
+    visit_GeneratorExp = _visit_comprehension
+
+    def visit_ExceptHandler(self, node: ast.ExceptHandler) -> None:
+        if node.type is None:
+            self.c.report(node, "E722", "bare `except:`")
+        if node.name:
+            self._bind(node.name, node)
+        self.generic_visit(node)
+
+    def visit_AnnAssign(self, node: ast.AnnAssign) -> None:
+        self._eval_annotation(node.annotation)
+        if node.value is not None:
+            self.visit(node.value)
+        self.visit(node.target)
+
+    def visit_MatchAs(self, node) -> None:
+        if node.pattern is not None:
+            self.visit(node.pattern)
+        if node.name:
+            self._bind(node.name, node)
+
+    def visit_MatchStar(self, node) -> None:
+        if node.name:
+            self._bind(node.name, node)
+
+    def visit_MatchMapping(self, node) -> None:
+        self.generic_visit(node)
+        if node.rest:
+            self._bind(node.rest, node)
+
+    # -- expression-level checks ------------------------------------------
+    def visit_Compare(self, node: ast.Compare) -> None:
+        for op, comparator in zip(node.ops, node.comparators):
+            if isinstance(op, (ast.Eq, ast.NotEq)):
+                if _is_const(comparator, None) or _is_const(node.left, None):
+                    self.c.report(node, "E711",
+                                  "comparison to None with ==/!= "
+                                  "(use `is`/`is not`)")
+                elif any(_is_const(side, True) or _is_const(side, False)
+                         for side in (node.left, comparator)):
+                    self.c.report(node, "E712",
+                                  "comparison to True/False with ==/!=")
+            if isinstance(op, (ast.Is, ast.IsNot)):
+                for side in (node.left, comparator):
+                    if (isinstance(side, ast.Constant)
+                            and isinstance(side.value, (str, int, float,
+                                                        tuple))
+                            and not isinstance(side.value, bool)
+                            and side.value is not None):
+                        self.c.report(node, "B015",
+                                      "`is` comparison with a literal "
+                                      "(use ==)")
+        self.generic_visit(node)
+
+    def visit_JoinedStr(self, node: ast.JoinedStr) -> None:
+        if not any(isinstance(part, ast.FormattedValue)
+                   for part in node.values):
+            self.c.report(node, "F541", "f-string without placeholders")
+        # visit children manually: a format spec (`{x:.3e}`) is itself a
+        # JoinedStr that legitimately has no placeholders — walk it for
+        # name uses (`{x:{width}}`) without re-running the F541 check
+        for part in node.values:
+            if isinstance(part, ast.FormattedValue):
+                self.visit(part.value)
+                if part.format_spec is not None:
+                    for spec_part in part.format_spec.values:
+                        if isinstance(spec_part, ast.FormattedValue):
+                            self.visit(spec_part)
+
+    def visit_Assert(self, node: ast.Assert) -> None:
+        if isinstance(node.test, ast.Tuple) and node.test.elts:
+            self.c.report(node, "B011",
+                          "assert on a non-empty tuple is always true")
+        self.generic_visit(node)
+
+    def visit_Dict(self, node: ast.Dict) -> None:
+        seen: dict[object, int] = {}
+        for key in node.keys:
+            if isinstance(key, ast.Constant):
+                try:
+                    marker = (type(key.value), key.value)
+                except TypeError:
+                    continue
+                if marker in seen:
+                    self.c.report(key, "C416",
+                                  f"duplicate dict key {key.value!r}")
+                seen[marker] = key.lineno
+        self.generic_visit(node)
+
+    def _check_mutable_defaults(self, node) -> None:
+        for default in (*node.args.defaults, *node.args.kw_defaults):
+            if default is None:
+                continue
+            bad = (isinstance(default, (ast.List, ast.Dict, ast.Set))
+                   or (isinstance(default, ast.Call)
+                       and isinstance(default.func, ast.Name)
+                       and default.func.id in MUTABLE_CALLS))
+            if bad:
+                self.c.report(default, "B006",
+                              "mutable default argument")
+
+
+def _is_const(node: ast.AST, value: object) -> bool:
+    return isinstance(node, ast.Constant) and node.value is value
+
+
+def _is_overload_or_dispatch(prev: ast.AST, node: ast.AST) -> bool:
+    """typing.overload / functools.singledispatch / property-setter
+    redefinitions are deliberate."""
+    names = set()
+    for n in (prev, node):
+        for deco in getattr(n, "decorator_list", []):
+            for sub in ast.walk(deco):
+                if isinstance(sub, ast.Name):
+                    names.add(sub.id)
+                elif isinstance(sub, ast.Attribute):
+                    names.add(sub.attr)
+    return bool(names & {"overload", "register", "setter", "getter",
+                         "deleter"})
+
+
+class FileChecker:
+    def __init__(self, path: Path, source: str) -> None:
+        self.path = path
+        self.source = source
+        self.findings: list[Finding] = []
+        self.noqa: dict[int, Optional[set[str]]] = {}
+        self.has_star_import = False
+        self.imports: list[tuple[str, ast.alias, ast.AST, Scope]] = []
+        self.all_uses: set[str] = set()
+        self.load_sites: list[tuple[ast.Name, tuple[Scope, ...]]] = []
+        self.store_sites: list[tuple[ast.Name, Scope]] = []
+        self.scope_of: dict[ast.AST, Scope] = {}
+        self.parents: dict[ast.AST, Optional[Scope]] = {}
+        self.stack: list[Scope] = []
+        self.module_scope: Scope = None  # type: ignore[assignment]
+
+    def report(self, node: ast.AST, code: str, message: str) -> None:
+        line = getattr(node, "lineno", 1)
+        suppressed = self.noqa.get(line)
+        if suppressed is not None and (not suppressed or code in suppressed):
+            return
+        self.findings.append(Finding(str(self.path), line,
+                                     getattr(node, "col_offset", 0) + 1,
+                                     code, message))
+
+    # -- driver -----------------------------------------------------------
+    def run(self) -> list[Finding]:
+        self._collect_noqa()
+        try:
+            tree = ast.parse(self.source, filename=str(self.path))
+        except SyntaxError as exc:
+            self.findings.append(Finding(
+                str(self.path), exc.lineno or 1, (exc.offset or 0) + 1,
+                "E999", f"syntax error: {exc.msg}"))
+            return self.findings
+        _mark_plain_targets(tree)
+        self._check_escapes()
+        binder = _Binder(self)
+        self.module_scope = Scope("module", tree)
+        self.scope_of[tree] = self.module_scope
+        self.stack = [self.module_scope]
+        for stmt in tree.body:
+            binder.visit(stmt)
+        self._check_undefined()
+        self._check_unused_imports()
+        self._check_unused_locals()
+        self._check_dunder_all(tree)
+        self.findings.sort(key=lambda f: (f.line, f.col, f.code))
+        return self.findings
+
+    def _collect_noqa(self) -> None:
+        import io
+        try:
+            tokens = tokenize.generate_tokens(
+                io.StringIO(self.source).readline)
+            for tok in tokens:
+                if tok.type == tokenize.COMMENT and "noqa" in tok.string:
+                    comment = tok.string
+                    idx = comment.find("noqa")
+                    rest = comment[idx + 4:].strip()
+                    if rest.startswith(":"):
+                        codes = {c.strip() for c in
+                                 rest[1:].replace(",", " ").split()}
+                        self.noqa[tok.start[0]] = codes
+                    else:
+                        self.noqa[tok.start[0]] = set()
+        except tokenize.TokenError:
+            pass
+
+    def _check_escapes(self) -> None:
+        import io
+        import re
+        import warnings
+        try:
+            tokens = list(tokenize.generate_tokens(
+                io.StringIO(self.source).readline))
+        except tokenize.TokenError:
+            return
+        for tok in tokens:
+            if tok.type != tokenize.STRING:
+                continue  # 3.12 f-strings arrive as FSTRING_* tokens
+            match = re.match(r"([A-Za-z]*)['\"]", tok.string)
+            if match is None or "r" in match.group(1).lower():
+                continue
+            with warnings.catch_warnings(record=True) as caught:
+                warnings.simplefilter("always")
+                try:
+                    compile(tok.string, "<lint>", "eval")
+                except (SyntaxError, ValueError):
+                    continue
+                if any(issubclass(w.category, SyntaxWarning)
+                       and "invalid escape" in str(w.message)
+                       for w in caught):
+                    self.report(_FakeNode(tok.start[0], tok.start[1]),
+                                "W605",
+                                "invalid escape sequence in non-raw "
+                                "string")
+
+    # -- whole-file checks -------------------------------------------------
+    def _check_undefined(self) -> None:
+        if self.has_star_import:
+            return
+        for node, chain in self.load_sites:
+            name = node.id
+            if name in BUILTIN_NAMES:
+                continue
+            if self._resolves(name, chain):
+                continue
+            self.report(node, "F821", f"undefined name {name!r}")
+
+    @staticmethod
+    def _resolves(name: str, chain: tuple[Scope, ...]) -> bool:
+        innermost = chain[-1]
+        for i, scope in enumerate(reversed(chain)):
+            if scope.kind == "class" and scope is not innermost:
+                continue  # class scope invisible to nested scopes
+            if name in scope.bindings:
+                return True
+            if name in scope.globals_ and chain[0].kind == "module":
+                if name in chain[0].bindings:
+                    return True
+        return False
+
+    def _check_unused_imports(self) -> None:
+        if self.has_star_import or self.path.name == "__init__.py":
+            return
+        for bound, alias, node, scope in self.imports:
+            if bound.startswith("_"):
+                continue
+            if alias.asname is not None and alias.asname == alias.name:
+                continue  # `import x as x` is the re-export idiom
+            if bound in self.all_uses:
+                continue
+            shown = alias.name + (f" as {alias.asname}" if alias.asname
+                                  else "")
+            self.report(node, "F401", f"{shown!r} imported but unused")
+
+    def _check_unused_locals(self) -> None:
+        # A use anywhere in a scope chain makes the name "visible" to
+        # every scope on that chain — a closure may read an outer local,
+        # so credit uses to all enclosing scopes.
+        visible: dict[int, set[str]] = {}
+        for node, chain in self.load_sites:
+            for scope in chain:
+                visible.setdefault(id(scope), set()).add(node.id)
+        for node, scope in self.store_sites:
+            if scope.kind != "function":
+                continue
+            name = node.id
+            if name.startswith("_") or name in scope.globals_ \
+                    or name in scope.nonlocals:
+                continue
+            if name in visible.get(id(scope), ()):
+                continue
+            if scope.bindings.get(name) is not node:
+                continue  # report only the (last) binding site, once
+            # Only flag `x = expr` / `x: T = expr` targets; loop
+            # variables, tuple unpacking, with/except aliases, del, and
+            # walrus stay exempt (pyflakes flags some of these; we
+            # prefer precision).
+            if not getattr(node, "_is_plain_target", False):
+                continue
+            self.report(node, "F841",
+                        f"local variable {name!r} assigned but never used")
+
+    def _check_dunder_all(self, tree: ast.Module) -> None:
+        if self.has_star_import:
+            return
+        for stmt in tree.body:
+            if not (isinstance(stmt, ast.Assign)
+                    and any(isinstance(t, ast.Name) and t.id == "__all__"
+                            for t in stmt.targets)
+                    and isinstance(stmt.value, (ast.List, ast.Tuple))):
+                continue
+            for element in stmt.value.elts:
+                if (isinstance(element, ast.Constant)
+                        and isinstance(element.value, str)
+                        and element.value not in
+                        self.module_scope.bindings):
+                    self.report(element, "A001",
+                                f"__all__ entry {element.value!r} is "
+                                "not defined in the module")
+
+
+class _FakeNode:
+    def __init__(self, lineno: int, col: int) -> None:
+        self.lineno = lineno
+        self.col_offset = col
+
+
+def _mark_plain_targets(tree: ast.AST) -> None:
+    for node in ast.walk(tree):
+        if isinstance(node, ast.Assign):
+            for target in node.targets:
+                if isinstance(target, ast.Name):
+                    target._is_plain_target = True  # type: ignore
+        elif isinstance(node, ast.AnnAssign) and node.value is not None:
+            if isinstance(node.target, ast.Name):
+                node.target._is_plain_target = True  # type: ignore
+
+
+def check_source(source: str, path: str = "<source>") -> list[Finding]:
+    """Lint one source string (the unit-test entry point)."""
+    return FileChecker(Path(path), source).run()
+
+
+def _default_paths() -> list[str]:
+    pyproject = Path(__file__).resolve().parent.parent / "pyproject.toml"
+    if pyproject.exists():
+        import tomllib
+
+        config = tomllib.loads(pyproject.read_text())
+        paths = (config.get("tool", {}).get("tpulint", {})
+                 .get("paths"))
+        if paths:
+            return paths
+    return ["tpu_operator_libs"]
+
+
+def iter_files(paths: Iterable[str]) -> Iterable[Path]:
+    for raw in paths:
+        path = Path(raw)
+        if path.is_dir():
+            yield from sorted(path.rglob("*.py"))
+        elif path.suffix == ".py":
+            yield path
+
+
+def main(argv: Optional[list[str]] = None) -> int:
+    argv = list(sys.argv[1:] if argv is None else argv)
+    paths = argv or _default_paths()
+    findings: list[Finding] = []
+    n_files = 0
+    for file_path in iter_files(paths):
+        n_files += 1
+        try:
+            source = file_path.read_text()
+        except (OSError, UnicodeDecodeError) as exc:
+            findings.append(Finding(str(file_path), 1, 1, "E902",
+                                    f"cannot read: {exc}"))
+            continue
+        findings.extend(check_source(source, str(file_path)))
+    for finding in findings:
+        print(finding.render())
+    status = 1 if findings else 0
+    print(f"tpulint: {n_files} files, {len(findings)} findings",
+          file=sys.stderr)
+    return status
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,15 @@
+"""Runnable consumer examples, shipped with the package.
+
+The reference keeps its consumer operators out of tree (SURVEY.md §1 L5);
+we ship them as installable modules so ``pip install tpu-operator-libs``
+gives working entry points (see ``[project.scripts]`` in pyproject.toml):
+
+- :mod:`.libtpu_operator` — the libtpu upgrade operator (live or --demo).
+- :mod:`.unified_operator` — mixed GPU+TPU fleet operator.
+- :mod:`.safe_load_init` — the workload-side safe-load init-container.
+- :mod:`.admission_webhook` — CRD defaulting/validation webhook.
+- :mod:`.jax_training_job` — checkpoint-resumable JAX training job used
+  by the eviction-gate scenario.
+
+Thin shims remain at ``examples/`` in the repo for path-based invocation.
+"""
