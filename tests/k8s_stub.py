@@ -1,0 +1,341 @@
+"""Behavioral ``kubernetes``-package stub backed by a FakeCluster.
+
+The recording stub in test_real_adapter.py pins RealCluster's wire
+conversions call-by-call; this one is the *contract* fixture: a
+kubernetes-client-shaped facade over a live FakeCluster state machine, so
+``RealCluster(over this stub)`` and ``FakeCluster`` can be driven by the
+SAME test scenarios and must exhibit identical observable behavior
+(tests/test_client_contract.py). This is the envtest role in the
+reference suite (upgrade_suit_test.go:73-97): managers talk to a real
+API-semantics backend, not canned responses.
+
+Conversion directions:
+
+- outgoing: our dataclasses → kubernetes model shapes (snake_case
+  attributes, datetimes for timestamps, ``V1*``-like namespaces);
+- incoming: patch bodies / eviction / lease writes → FakeCluster calls;
+- errors: the fake's typed errors → ``ApiException(status=...)`` so
+  RealCluster's ``_translate`` must map them BACK to the same types —
+  the round-trip is exactly what the contract suite asserts.
+"""
+
+from __future__ import annotations
+
+import sys
+import threading
+import types
+from datetime import datetime, timezone
+from types import SimpleNamespace as NS
+
+from tpu_operator_libs.k8s.client import (
+    AlreadyExistsError,
+    ApiServerError,
+    ConflictError,
+    EvictionBlockedError,
+    NotFoundError,
+)
+from tpu_operator_libs.k8s.objects import Lease, ObjectMeta
+from tpu_operator_libs.k8s.watch import (
+    ADDED,
+    KIND_DAEMON_SET,
+    KIND_NODE,
+    KIND_POD,
+)
+
+
+class StubApiException(Exception):
+    def __init__(self, status, reason=""):
+        super().__init__(f"({status}) {reason}")
+        self.status = status
+        self.reason = reason
+
+
+def _raise_as_api_exception(exc: Exception, *args):
+    if isinstance(exc, NotFoundError):
+        raise StubApiException(404, str(exc)) from exc
+    if isinstance(exc, (AlreadyExistsError, ConflictError)):
+        raise StubApiException(409, str(exc)) from exc
+    if isinstance(exc, EvictionBlockedError):
+        # the apiserver reports PDB-blocked evictions as 429 on the
+        # eviction subresource
+        raise StubApiException(429, str(exc)) from exc
+    if isinstance(exc, ApiServerError):
+        raise StubApiException(503, str(exc)) from exc
+    raise exc
+
+
+def _ts(epoch):
+    return (datetime.fromtimestamp(epoch, tz=timezone.utc)
+            if epoch is not None else None)
+
+
+def _meta_to_k8s(meta) -> NS:
+    return NS(
+        name=meta.name,
+        namespace=meta.namespace or None,
+        uid=meta.uid or None,
+        labels=dict(meta.labels),
+        annotations=dict(meta.annotations),
+        owner_references=[
+            NS(kind=ref.kind, name=ref.name, uid=ref.uid,
+               controller=ref.controller)
+            for ref in meta.owner_references],
+        deletion_timestamp=_ts(meta.deletion_timestamp),
+        resource_version=meta.resource_version)
+
+
+def node_to_k8s(node) -> NS:
+    return NS(
+        metadata=_meta_to_k8s(node.metadata),
+        spec=NS(unschedulable=node.spec.unschedulable),
+        status=NS(conditions=[NS(type=c.type, status=c.status)
+                              for c in node.status.conditions]))
+
+
+def pod_to_k8s(pod) -> NS:
+    def statuses(items):
+        return [NS(name=s.name, ready=s.ready,
+                   restart_count=s.restart_count) for s in items]
+
+    return NS(
+        metadata=_meta_to_k8s(pod.metadata),
+        spec=NS(
+            node_name=pod.spec.node_name or None,
+            volumes=[NS(name=v.name,
+                        empty_dir=NS() if v.empty_dir else None)
+                     for v in pod.spec.volumes]),
+        status=NS(
+            phase=pod.status.phase.value,
+            container_statuses=statuses(pod.status.container_statuses),
+            init_container_statuses=statuses(
+                pod.status.init_container_statuses)))
+
+
+def daemon_set_to_k8s(ds) -> NS:
+    return NS(
+        metadata=_meta_to_k8s(ds.metadata),
+        spec=NS(selector=NS(match_labels=dict(ds.spec.selector))),
+        status=NS(desired_number_scheduled=(
+            ds.status.desired_number_scheduled)))
+
+
+def revision_to_k8s(rev) -> NS:
+    return NS(metadata=_meta_to_k8s(rev.metadata), revision=rev.revision)
+
+
+def lease_to_k8s(lease) -> NS:
+    meta = _meta_to_k8s(lease.metadata)
+    return NS(
+        metadata=meta,
+        spec=NS(
+            holder_identity=lease.holder_identity or None,
+            lease_duration_seconds=lease.lease_duration_seconds or None,
+            acquire_time=_ts(lease.acquire_time),
+            renew_time=_ts(lease.renew_time),
+            lease_transitions=lease.lease_transitions or None))
+
+
+def _lease_from_body(body) -> Lease:
+    meta = ObjectMeta(name=body.metadata.name,
+                      namespace=body.metadata.namespace or "")
+    version = getattr(body.metadata, "resource_version", None)
+    if version is not None:
+        meta.resource_version = version
+    spec = body.spec
+
+    def epoch(value):
+        return value.timestamp() if value is not None else None
+
+    return Lease(
+        metadata=meta,
+        holder_identity=spec.holder_identity or "",
+        lease_duration_seconds=int(spec.lease_duration_seconds or 0),
+        acquire_time=epoch(spec.acquire_time),
+        renew_time=epoch(spec.renew_time),
+        lease_transitions=int(spec.lease_transitions or 0))
+
+
+class _Api:
+    def __init__(self, cluster):
+        self._cluster = cluster
+
+    def _do(self, fn, *args, **kwargs):
+        try:
+            return fn(*args, **kwargs)
+        except Exception as exc:
+            _raise_as_api_exception(exc)
+
+
+class BehavioralCoreV1(_Api):
+    def read_node(self, name):
+        return node_to_k8s(self._do(self._cluster.get_node, name))
+
+    def list_node(self, label_selector=None):
+        nodes = self._do(self._cluster.list_nodes, label_selector or "")
+        return NS(items=[node_to_k8s(n) for n in nodes])
+
+    def patch_node(self, name, body):
+        if "metadata" in body and "labels" in body["metadata"]:
+            node = self._do(self._cluster.patch_node_labels, name,
+                            body["metadata"]["labels"])
+        elif "metadata" in body and "annotations" in body["metadata"]:
+            node = self._do(self._cluster.patch_node_annotations, name,
+                            body["metadata"]["annotations"])
+        elif "spec" in body and "unschedulable" in body["spec"]:
+            node = self._do(self._cluster.set_node_unschedulable, name,
+                            body["spec"]["unschedulable"])
+        else:
+            raise StubApiException(422, f"unsupported patch body {body}")
+        return node_to_k8s(node)
+
+    def list_namespaced_pod(self, namespace, label_selector=None,
+                            field_selector=None):
+        pods = self._do(self._cluster.list_pods, namespace,
+                        label_selector or "", field_selector or "")
+        return NS(items=[pod_to_k8s(p) for p in pods])
+
+    def list_pod_for_all_namespaces(self, label_selector=None,
+                                    field_selector=None):
+        pods = self._do(self._cluster.list_pods, None,
+                        label_selector or "", field_selector or "")
+        return NS(items=[pod_to_k8s(p) for p in pods])
+
+    def delete_namespaced_pod(self, name, namespace):
+        self._do(self._cluster.delete_pod, namespace, name)
+
+    def create_namespaced_pod_eviction(self, name, namespace, eviction):
+        self._do(self._cluster.evict_pod, namespace, name)
+
+
+class BehavioralAppsV1(_Api):
+    def list_namespaced_daemon_set(self, namespace, label_selector=None):
+        items = self._do(self._cluster.list_daemon_sets, namespace,
+                         label_selector or "")
+        return NS(items=[daemon_set_to_k8s(d) for d in items])
+
+    def list_daemon_set_for_all_namespaces(self, label_selector=None):
+        raise StubApiException(501, "all-namespace DS list not modeled "
+                                    "by FakeCluster")
+
+    def list_namespaced_controller_revision(self, namespace,
+                                            label_selector=None):
+        items = self._do(self._cluster.list_controller_revisions,
+                         namespace, label_selector or "")
+        return NS(items=[revision_to_k8s(r) for r in items])
+
+
+class BehavioralCoordinationV1(_Api):
+    def read_namespaced_lease(self, name, namespace):
+        return lease_to_k8s(self._do(self._cluster.get_lease,
+                                     namespace, name))
+
+    def create_namespaced_lease(self, namespace, body):
+        lease = _lease_from_body(body)
+        lease.metadata.namespace = namespace
+        return lease_to_k8s(self._do(self._cluster.create_lease, lease))
+
+    def replace_namespaced_lease(self, name, namespace, body):
+        lease = _lease_from_body(body)
+        lease.metadata.name = name
+        lease.metadata.namespace = namespace
+        return lease_to_k8s(self._do(self._cluster.update_lease, lease))
+
+
+_LIST_FN_TO_KIND = {
+    "list_node": (KIND_NODE, node_to_k8s),
+    "list_namespaced_pod": (KIND_POD, pod_to_k8s),
+    "list_pod_for_all_namespaces": (KIND_POD, pod_to_k8s),
+    "list_namespaced_daemon_set": (KIND_DAEMON_SET, daemon_set_to_k8s),
+}
+
+
+class BehavioralWatchStream:
+    """kubernetes.watch.Watch over the FakeCluster broadcaster.
+
+    Mirrors apiserver watch semantics with no resourceVersion: the
+    current object set is delivered first as ADDED, then live events
+    stream until ``stop()``. ``expire_all()`` force-ends every open
+    stream (server-side watch expiry) so tests can exercise the
+    RealCluster pump's restart path.
+    """
+
+    instances: list["BehavioralWatchStream"] = []
+
+    def __init__(self):
+        self._stopped = threading.Event()
+        BehavioralWatchStream.instances.append(self)
+
+    @classmethod
+    def expire_all(cls):
+        for stream in list(cls.instances):
+            stream._stopped.set()
+
+    def stream(self, list_fn, timeout_seconds=None, **kwargs):
+        api = list_fn.__self__
+        cluster = api._cluster
+        kind, convert = _LIST_FN_TO_KIND[list_fn.__name__]
+        namespace = kwargs.get("namespace")
+        inner = cluster.watch(kinds={kind}, namespace=namespace)
+        try:
+            # subscribe-then-list: an object created in between appears
+            # twice (once listed, once as an event) — exactly the
+            # at-least-once delivery real watches give a level-triggered
+            # consumer
+            for raw in list_fn(**kwargs).items:
+                if self._stopped.is_set():
+                    return
+                yield {"type": ADDED, "object": raw}
+            while not self._stopped.is_set():
+                event = inner.get(timeout=0.05)
+                if event is None:
+                    continue
+                yield {"type": event.type, "object": convert(event.object)}
+        finally:
+            inner.stop()
+
+    def stop(self):
+        self._stopped.set()
+
+
+def install_behavioral_stub(cluster):
+    """Install a ``kubernetes`` package into sys.modules whose API
+    semantics are the given FakeCluster. Returns a restore() callable."""
+    client_mod = types.ModuleType("kubernetes.client")
+    client_mod.ApiException = StubApiException
+    client_mod.CoreV1Api = lambda api_client=None: BehavioralCoreV1(cluster)
+    client_mod.AppsV1Api = lambda api_client=None: BehavioralAppsV1(cluster)
+    client_mod.CoordinationV1Api = (
+        lambda api_client=None: BehavioralCoordinationV1(cluster))
+    client_mod.V1Eviction = lambda metadata=None: NS(metadata=metadata)
+    client_mod.V1ObjectMeta = lambda name=None, namespace=None: NS(
+        name=name, namespace=namespace, resource_version=None)
+    client_mod.V1Lease = lambda metadata=None, spec=None: NS(
+        metadata=metadata, spec=spec)
+    client_mod.V1LeaseSpec = lambda **kw: NS(
+        **{key: kw.get(key) for key in (
+            "holder_identity", "lease_duration_seconds", "acquire_time",
+            "renew_time", "lease_transitions")})
+
+    watch_mod = types.ModuleType("kubernetes.watch")
+    watch_mod.Watch = BehavioralWatchStream
+
+    root = types.ModuleType("kubernetes")
+    root.client = client_mod
+    root.watch = watch_mod
+
+    saved = {name: sys.modules.get(name)
+             for name in ("kubernetes", "kubernetes.client",
+                          "kubernetes.watch")}
+    sys.modules["kubernetes"] = root
+    sys.modules["kubernetes.client"] = client_mod
+    sys.modules["kubernetes.watch"] = watch_mod
+    BehavioralWatchStream.instances = []
+
+    def restore():
+        for name, mod in saved.items():
+            if mod is None:
+                sys.modules.pop(name, None)
+            else:
+                sys.modules[name] = mod
+
+    return restore

@@ -52,6 +52,26 @@ class TestTraceableLines:
         assert traceable_lines(path) == set()
 
 
+class TestWantedRoots:
+    def test_single_file_include_matches_exactly(self, tmp_path):
+        from cov import LineCollector
+        target = tmp_path / "bench.py"
+        target.write_text("x = 1\n")
+        collector = LineCollector([str(target)], [])
+        assert collector._wanted(str(target)) is True
+        other = tmp_path / "other.py"
+        assert collector._wanted(str(other)) is False
+
+    def test_directory_include_prefix_matches(self, tmp_path):
+        from cov import LineCollector
+        pkg = tmp_path / "pkg"
+        pkg.mkdir()
+        collector = LineCollector([str(pkg)], [])
+        assert collector._wanted(str(pkg / "mod.py")) is True
+        assert collector._wanted(str(tmp_path / "pkg2" / "mod.py")) \
+            is False
+
+
 class TestSummarize:
     def test_ranges(self):
         assert _summarize([1, 2, 3, 7, 9]) == "1-3, 7, 9"

@@ -70,6 +70,15 @@ class TestUndefinedNames:
     def test_star_import_suppresses(self):
         assert "F821" not in codes("from os.path import *\nx = join('a')\n")
 
+    def test_walrus_in_comprehension_leaks_to_enclosing_scope(self):
+        # PEP 572 leakage: the walrus target binds in the enclosing
+        # scope, so the later use is defined
+        source = (
+            "xs = [1, 2]\n"
+            "vals = [y for x in xs if (y := x) > 0]\n"
+            "print(vals, y)\n")
+        assert codes(source) == []
+
     def test_except_alias_and_with_target(self):
         source = (
             "try:\n"
@@ -230,17 +239,28 @@ class TestSuppression:
     def test_noqa_with_other_code_still_reports(self):
         assert codes("import json  # noqa: E722\n") == ["F401"]
 
+    def test_prose_mentioning_noqa_does_not_suppress(self):
+        assert codes("import json  # docs mention noqa stuff\n") \
+            == ["F401"]
+
+    def test_noqa_case_insensitive_token(self):
+        assert codes("import json  # NOQA\n") == []
+
     def test_syntax_error_reported_not_crash(self):
         assert codes("def f(:\n") == ["E999"]
 
 
 class TestCli:
-    def test_clean_repo_lints_clean(self):
-        # the repo itself must stay lint-clean — this is the CI gate
-        # duplicated as a test so `make test` alone catches regressions
+    def test_library_lints_clean(self):
+        # the product code must stay lint-clean — narrowed to the
+        # package + tools (NOT tests/examples, which the CI lint job
+        # covers) so an untracked scratch file under tests/ cannot fail
+        # the whole suite
         root = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
         proc = subprocess.run(
-            [sys.executable, os.path.join(root, "tools", "lint.py")],
+            [sys.executable, os.path.join(root, "tools", "lint.py"),
+             "tpu_operator_libs", "tools", "bench.py",
+             "__graft_entry__.py"],
             capture_output=True, text=True, cwd=root, timeout=300)
         assert proc.returncode == 0, proc.stdout[-4000:]
         assert "0 findings" in proc.stderr

@@ -43,7 +43,12 @@ class LineCollector:
     """Records executed lines for files under the include roots."""
 
     def __init__(self, include: list[str], exclude: list[str]) -> None:
-        self.include = [os.path.abspath(p) + os.sep for p in include]
+        # a root may be a directory (prefix match) or a single file
+        # (exact match) — `--include bench.py` must trace that file
+        self.include_dirs = [os.path.abspath(p) + os.sep
+                             for p in include if not p.endswith(".py")]
+        self.include_files = {os.path.abspath(p)
+                              for p in include if p.endswith(".py")}
         self.exclude = [os.path.abspath(p) + os.sep for p in exclude]
         self.executed: dict[str, set[int]] = defaultdict(set)
         self._interesting: dict[str, bool] = {}
@@ -52,9 +57,10 @@ class LineCollector:
         cached = self._interesting.get(filename)
         if cached is not None:
             return cached
-        path = os.path.abspath(filename) + ("" if filename.endswith(".py")
-                                            else os.sep)
-        wanted = (any(path.startswith(root) for root in self.include)
+        path = os.path.abspath(filename)
+        wanted = ((path in self.include_files
+                   or any(path.startswith(root)
+                          for root in self.include_dirs))
                   and not any(path.startswith(root)
                               for root in self.exclude))
         self._interesting[filename] = wanted

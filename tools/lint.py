@@ -121,6 +121,17 @@ class _Binder(ast.NodeVisitor):
                 self.c.store_sites.append((node, self.c.stack[-1]))
         self.generic_visit(node)
 
+    def visit_NamedExpr(self, node: ast.NamedExpr) -> None:
+        # PEP 572: a walrus inside a comprehension binds in the nearest
+        # enclosing non-comprehension scope (the "leak"), not the
+        # comprehension's own scope
+        self.visit(node.value)
+        if isinstance(node.target, ast.Name):
+            for scope in reversed(self.c.stack):
+                if scope.kind != "comprehension":
+                    scope.bindings[node.target.id] = node.target
+                    break
+
     def visit_Global(self, node: ast.Global) -> None:
         self.c.stack[-1].globals_.update(node.names)
 
@@ -425,19 +436,29 @@ class FileChecker:
     def _collect_noqa(self) -> None:
         import io
         try:
+            import re
+            # a suppression must be a `# noqa` token (optionally with
+            # codes), not prose that merely contains the substring —
+            # matching pyflakes/ruff, so a comment like "# docs mention
+            # noqa" cannot silently mask findings
+            pattern = re.compile(
+                r"#\s*noqa(?P<codes>\s*:\s*[A-Z][A-Z0-9]*"
+                r"(?:[,\s]+[A-Z][A-Z0-9]*)*)?\s*$", re.IGNORECASE)
             tokens = tokenize.generate_tokens(
                 io.StringIO(self.source).readline)
             for tok in tokens:
-                if tok.type == tokenize.COMMENT and "noqa" in tok.string:
-                    comment = tok.string
-                    idx = comment.find("noqa")
-                    rest = comment[idx + 4:].strip()
-                    if rest.startswith(":"):
-                        codes = {c.strip() for c in
-                                 rest[1:].replace(",", " ").split()}
-                        self.noqa[tok.start[0]] = codes
-                    else:
-                        self.noqa[tok.start[0]] = set()
+                if tok.type != tokenize.COMMENT:
+                    continue
+                match = pattern.search(tok.string)
+                if match is None:
+                    continue
+                codes = match.group("codes")
+                if codes:
+                    self.noqa[tok.start[0]] = {
+                        c.strip().upper()
+                        for c in codes.lstrip(" :").replace(",", " ").split()}
+                else:
+                    self.noqa[tok.start[0]] = set()
         except tokenize.TokenError:
             pass
 
