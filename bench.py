@@ -1,93 +1,148 @@
 #!/usr/bin/env python3
 """Benchmark: rolling libtpu upgrade, topology-aware vs reference-flat.
 
-Runs the real state machine twice over a simulated 8-slice × 4-host GKE
-TPU fleet (v5e-16-style multi-host slices, BASELINE config #3) under a
-virtual clock:
+Runs the real state machine over a simulated 8-slice × 4-host GKE TPU
+fleet (v5e-16-style multi-host slices, BASELINE config #3) under a
+virtual clock, across the full 2×2 design so the two independent
+advantages are reported separately, not conflated:
 
-- baseline: ``topology_mode=flat`` — the reference's per-node slot loop
-  (upgrade_state.go:587-631) with GKE-realistic (slice-uncorrelated) node
-  ordering.
-- ours: ``topology_mode=slice`` — slice-atomic planning.
+    planner axis:  flat (reference per-node slot loop,
+                   upgrade_state.go:587-631) vs slice (slice-atomic)
+    cadence axis:  interval (one apply_state per reconcile tick, the
+                   reference consumer loop) vs chained (reconcile runs
+                   to quiescence per wake-up, this framework's fast path)
 
-Headline metric: time-weighted **slice availability %** over the upgrade
-window (BASELINE.md north star). ``vs_baseline`` is ours/flat (>1 is
-better). Prints exactly one JSON line.
+Headline metric: time-weighted, event-integrated **slice availability %**
+over a common observation window (BASELINE.md north star). The
+``vs_baseline`` ratio compares ours (slice+chained) against the
+reference cell (flat+interval); ``planner_effect`` and
+``chaining_effect`` isolate each axis.
+
+Hardware section (real TPU when reachable): ICI fabric probe latency,
+per-link bandwidth, and an MXU throughput benchmark — chained bf16
+matmuls sized for the systolic array, reported as achieved TFLOP/s and
+MFU against the chip's published bf16 peak. The probe runs in a
+subprocess with a hard timeout and bounded retries; on failure the JSON
+carries a structured diagnostic (`tpu_unreachable` + reason) and the
+last good hardware numbers from the BENCH_HW.json sidecar, marked stale
+— a wedged TPU tunnel degrades loudly, never hangs the bench and never
+masquerades as "probe never ran".
+
+Prints exactly ONE JSON line.
 """
 
 import json
+import os
 import sys
+import time
 from typing import Optional
 
 from tpu_operator_libs.simulate import FleetSpec, simulate_rolling_upgrade
 
+SIDECAR = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                       "BENCH_HW.json")
+
 
 def main() -> int:
     fleet = FleetSpec(n_slices=8, hosts_per_slice=4)
-    # baseline: reference semantics — flat per-node planning, one
-    # transition per reconcile interval
-    flat = simulate_rolling_upgrade(topology_mode="flat", fleet=fleet)
-    # ours: slice-atomic planning + chained reconcile (state machine runs
-    # to quiescence each wake-up instead of one edge per interval)
-    ours = simulate_rolling_upgrade(topology_mode="slice", fleet=fleet,
-                                    chained=True)
+    cells = {}
+    for planner in ("flat", "slice"):
+        for cadence in ("interval", "chained"):
+            cells[f"{planner}_{cadence}"] = simulate_rolling_upgrade(
+                topology_mode=planner, fleet=fleet,
+                chained=(cadence == "chained"))
 
-    if not (flat.converged and ours.converged):
+    if not all(cell.converged for cell in cells.values()):
+        bad = [name for name, cell in cells.items() if not cell.converged]
         print(json.dumps({
             "metric": "rolling_upgrade_slice_availability",
             "value": 0.0, "unit": "%", "vs_baseline": 0.0,
-            "error": "simulation did not converge"}))
+            "error": f"simulation did not converge: {bad}"}))
         return 1
 
-    # Exercise the real accelerator when present: the validation gate's
-    # fabric probe latency on the local chip(s). Runs in a subprocess
-    # with a hard timeout — a wedged TPU tunnel must degrade to null
-    # probe fields, not hang the whole bench. BENCH_PROBE_TIMEOUT lets
-    # CI shrink the wait.
-    import os as _os
+    # common observation window so faster convergence is credited, not
+    # penalized (every fleet is 100% available after its upgrade ends)
+    window = max(cell.total_seconds for cell in cells.values())
 
-    probe_ms, bandwidth_gbps = _hardware_probe(
-        timeout_s=float(_os.environ.get("BENCH_PROBE_TIMEOUT", "120")))
+    def availability(name: str) -> float:
+        return round(cells[name].slice_availability_pct_over(window), 2)
 
-    # hot-loop latency: one build_state+apply_state pass over a 256-node
-    # fleet mid-upgrade (real wall time, not virtual) — the library-side
-    # cost a consumer's reconcile pays at fleet scale
+    matrix = {
+        name: {
+            "availability_pct": availability(name),
+            "drain_to_ready_p50_s": cell.drain_to_ready_p50,
+            "drain_to_ready_p95_s": cell.drain_to_ready_p95,
+            "upgrade_wall_clock_s": cell.total_seconds,
+        }
+        for name, cell in cells.items()
+    }
+
+    ours = availability("slice_chained")
+    reference = availability("flat_interval")
+    hardware = _hardware_capture()
     reconcile_ms = _reconcile_latency_ms()
 
-    # common observation window so faster convergence is credited, not
-    # penalized (both fleets are 100% available after their upgrade ends)
-    window = max(flat.total_seconds, ours.total_seconds)
-    value = round(ours.slice_availability_pct_over(window), 2)
-    baseline = flat.slice_availability_pct_over(window)
-    print(json.dumps({
+    result = {
         "metric": "rolling_upgrade_slice_availability",
-        "value": value,
+        "value": ours,
         "unit": "%",
-        "vs_baseline": round(value / baseline, 3) if baseline else 0.0,
-        "flat_availability_pct": round(baseline, 2),
-        "drain_to_ready_p50_s": ours.drain_to_ready_p50,
-        "flat_drain_to_ready_p50_s": flat.drain_to_ready_p50,
-        "upgrade_wall_clock_s": ours.total_seconds,
-        "flat_upgrade_wall_clock_s": flat.total_seconds,
+        "vs_baseline": round(ours / reference, 3) if reference else 0.0,
+        # de-confounded contributions (same window):
+        #   planner_effect  = slice vs flat at the reference cadence
+        #   chaining_effect = chained vs interval with the slice planner
+        "planner_effect": round(
+            availability("slice_interval") / reference, 3)
+        if reference else 0.0,
+        "chaining_effect": round(
+            ours / availability("slice_interval"), 3)
+        if availability("slice_interval") else 0.0,
+        "matrix": matrix,
         "fleet": f"{fleet.n_slices}x{fleet.hosts_per_slice} hosts",
-        "ici_probe_ms": probe_ms,
-        "ici_bandwidth_gbytes_per_s": bandwidth_gbps,
         "reconcile_p50_ms_256_nodes": reconcile_ms,
-    }))
+        # flattened legacy keys (round-over-round comparability)
+        "flat_availability_pct": reference,
+        "drain_to_ready_p50_s": cells["slice_chained"].drain_to_ready_p50,
+        "flat_drain_to_ready_p50_s": cells["flat_interval"].drain_to_ready_p50,
+        "upgrade_wall_clock_s": cells["slice_chained"].total_seconds,
+        "flat_upgrade_wall_clock_s": cells["flat_interval"].total_seconds,
+    }
+    result.update(hardware)
+    print(json.dumps(result))
     return 0
 
 
+# Chip bf16 peak TFLOP/s per core-pair ("chip"), public figures; used
+# only for the MFU denominator. Unknown kinds report mfu=null.
+_BF16_PEAK_TFLOPS = (
+    ("v6", 918.0),   # Trillium
+    ("v5p", 459.0),
+    ("v5e", 197.0),
+    ("v5 lite", 197.0),
+    ("v5lite", 197.0),
+    ("v4", 275.0),
+    ("v3", 123.0),
+    ("v2", 45.0),
+)
+
 _PROBE_SCRIPT = r"""
 import json
+import sys
+import time
+
 try:
     import jax
+    import jax.numpy as jnp
 
     from tpu_operator_libs.health.ici_probe import (
         fabric_bandwidth_probe,
         fabric_probe,
     )
 
-    n = len(jax.devices())
+    devices = jax.devices()
+    device_kind = devices[0].device_kind
+    platform = devices[0].platform
+
+    n = len(devices)
     while n > 1 and 128 % n:
         n -= 1
     probe_ms = bandwidth = None
@@ -97,30 +152,148 @@ try:
         if n > 1:
             # throughput only means something on a correct fabric
             bandwidth = fabric_bandwidth_probe(n_devices=n).gbytes_per_s
-    print(json.dumps({"probe_ms": probe_ms, "bandwidth": bandwidth}))
-except Exception:
-    print(json.dumps({"probe_ms": None, "bandwidth": None}))
+
+    # MXU throughput: chained bf16 matmuls inside ONE jit so dispatch
+    # overhead cannot hide the systolic array. y ~ 1/K keeps values ~1.
+    M = K = N = 4096
+    CHAIN = 8
+    x = jnp.ones((M, K), jnp.bfloat16)
+    y = jnp.full((K, N), 1.0 / K, jnp.bfloat16)
+
+    def chain(a, b):
+        out = a
+        for _ in range(CHAIN):
+            out = out @ b
+        return out
+
+    fn = jax.jit(chain)
+    fn(x, y).block_until_ready()  # compile
+    iters = 8
+    t0 = time.perf_counter()
+    for _ in range(iters):
+        out = fn(x, y)
+    out.block_until_ready()
+    dt = time.perf_counter() - t0
+    flops = 2.0 * M * K * N * CHAIN * iters
+    tflops = flops / dt / 1e12
+    print(json.dumps({
+        "probe_ms": probe_ms, "bandwidth": bandwidth,
+        "tflops": round(tflops, 1), "device_kind": device_kind,
+        "platform": platform,
+    }))
+except Exception as exc:  # structured failure, never a bare traceback
+    print(json.dumps({"error": f"{type(exc).__name__}: {exc}"}))
+    sys.exit(0)
 """
 
 
-def _hardware_probe(timeout_s: float):
-    """(ici_probe_ms, ici_bandwidth_gbytes_per_s) from a subprocess, or
-    (None, None) on timeout/error."""
-    import json as _json
-    import os
+def _hardware_capture() -> dict:
+    """Bounded-retry hardware probe with structured degradation.
+
+    Returns a dict merged into the bench JSON:
+    - success: ici_probe_ms / ici_bandwidth_gbytes_per_s /
+      mxu_tflops_bf16 / mxu_mfu_pct / tpu_device_kind (and the sidecar
+      is refreshed);
+    - failure: the same keys null, plus tpu_unreachable=true, a reason,
+      and hardware_last_good (sidecar contents, marked stale) so a
+      wedged chip is distinguishable from "never tried".
+    """
+    timeout_s = float(os.environ.get("BENCH_PROBE_TIMEOUT", "120"))
+    attempts = max(1, int(os.environ.get("BENCH_PROBE_ATTEMPTS", "2")))
+    backoff_s = float(os.environ.get("BENCH_PROBE_BACKOFF", "10"))
+
+    reason = "unknown"
+    for attempt in range(attempts):
+        data, reason = _probe_once(timeout_s)
+        if data is not None and "error" not in data:
+            out = _hardware_result(data)
+            _write_sidecar(out)
+            return out
+        if data is not None and "error" in data:
+            reason = f"probe raised: {data['error']}"
+            if any(marker in data["error"] for marker in
+                   ("ImportError", "ModuleNotFoundError")):
+                break  # deterministic failure; retrying cannot help
+        if attempt + 1 < attempts:
+            time.sleep(backoff_s * (attempt + 1))
+
+    out = {
+        "ici_probe_ms": None,
+        "ici_bandwidth_gbytes_per_s": None,
+        "mxu_tflops_bf16": None,
+        "mxu_mfu_pct": None,
+        "tpu_device_kind": None,
+        "tpu_unreachable": True,
+        "tpu_unreachable_reason": f"{reason} ({attempts} attempts, "
+                                  f"{timeout_s:.0f}s timeout each)",
+    }
+    last_good = _read_sidecar()
+    if isinstance(last_good, dict):  # non-dict JSON must not crash the
+        last_good["stale"] = True    # degradation path itself
+        out["hardware_last_good"] = last_good
+    return out
+
+
+def _probe_once(timeout_s: float):
+    """(parsed-json-or-None, reason)."""
     import subprocess
-    import sys as _sys
 
     try:
         proc = subprocess.run(
-            [_sys.executable, "-c", _PROBE_SCRIPT],
+            [sys.executable, "-c", _PROBE_SCRIPT],
             capture_output=True, text=True, timeout=timeout_s,
             cwd=os.path.dirname(os.path.abspath(__file__)))
-        line = [ln for ln in proc.stdout.splitlines() if ln.strip()][-1]
-        data = _json.loads(line)
-        return data.get("probe_ms"), data.get("bandwidth")
-    except Exception:
-        return None, None
+    except subprocess.TimeoutExpired:
+        return None, (f"probe subprocess exceeded {timeout_s:.0f}s "
+                      "(TPU backend likely wedged at device enumeration)")
+    except OSError as exc:
+        return None, f"could not spawn probe subprocess: {exc}"
+    lines = [ln for ln in proc.stdout.splitlines() if ln.strip()]
+    if not lines:
+        tail = (proc.stderr or "")[-300:].replace("\n", " ")
+        return None, (f"probe produced no output "
+                      f"(rc={proc.returncode}, stderr: {tail!r})")
+    try:
+        return json.loads(lines[-1]), "ok"
+    except json.JSONDecodeError:
+        return None, f"unparseable probe output: {lines[-1][:200]!r}"
+
+
+def _hardware_result(data: dict) -> dict:
+    tflops = data.get("tflops")
+    kind = data.get("device_kind") or ""
+    peak = None
+    for marker, value in _BF16_PEAK_TFLOPS:
+        if marker in kind.lower():
+            peak = value
+            break
+    mfu = (round(100.0 * tflops / peak, 1)
+           if tflops is not None and peak else None)
+    return {
+        "ici_probe_ms": data.get("probe_ms"),
+        "ici_bandwidth_gbytes_per_s": data.get("bandwidth"),
+        "mxu_tflops_bf16": tflops,
+        "mxu_mfu_pct": mfu,
+        "tpu_device_kind": data.get("device_kind"),
+    }
+
+
+def _write_sidecar(result: dict) -> None:
+    try:
+        with open(SIDECAR, "w") as fh:
+            json.dump({"captured_at": time.strftime(
+                "%Y-%m-%dT%H:%M:%SZ", time.gmtime()), **result}, fh,
+                indent=1)
+    except OSError:
+        pass  # sidecar is best-effort; the live numbers already printed
+
+
+def _read_sidecar() -> Optional[dict]:
+    try:
+        with open(SIDECAR) as fh:
+            return json.load(fh)
+    except (OSError, json.JSONDecodeError):
+        return None
 
 
 def _reconcile_latency_ms(n_slices: int = 64, hosts: int = 4,

@@ -246,6 +246,10 @@ class TestSuppression:
     def test_noqa_case_insensitive_token(self):
         assert codes("import json  # NOQA\n") == []
 
+    def test_noqa_with_trailing_explanation(self):
+        assert codes(
+            "import json  # noqa: F401 (kept for side effects)\n") == []
+
     def test_syntax_error_reported_not_crash(self):
         assert codes("def f(:\n") == ["E999"]
 

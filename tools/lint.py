@@ -437,26 +437,28 @@ class FileChecker:
         import io
         try:
             import re
-            # a suppression must be a `# noqa` token (optionally with
-            # codes), not prose that merely contains the substring —
-            # matching pyflakes/ruff, so a comment like "# docs mention
-            # noqa" cannot silently mask findings
+            # a suppression is a comment that STARTS with the `noqa`
+            # token (optionally `: CODES`, with trailing prose ignored —
+            # pyflakes/ruff accept "# noqa: F401 (kept for reexport)");
+            # prose that merely mentions the substring mid-comment
+            # ("# docs mention noqa") cannot silently mask findings
             pattern = re.compile(
-                r"#\s*noqa(?P<codes>\s*:\s*[A-Z][A-Z0-9]*"
-                r"(?:[,\s]+[A-Z][A-Z0-9]*)*)?\s*$", re.IGNORECASE)
+                r"^#+\s*noqa\b"
+                r"(?:\s*:\s*(?P<codes>[A-Za-z][A-Za-z0-9]*"
+                r"(?:[,\s]+[A-Za-z][A-Za-z0-9]*)*))?", re.IGNORECASE)
             tokens = tokenize.generate_tokens(
                 io.StringIO(self.source).readline)
             for tok in tokens:
                 if tok.type != tokenize.COMMENT:
                     continue
-                match = pattern.search(tok.string)
+                match = pattern.match(tok.string)
                 if match is None:
                     continue
                 codes = match.group("codes")
                 if codes:
                     self.noqa[tok.start[0]] = {
                         c.strip().upper()
-                        for c in codes.lstrip(" :").replace(",", " ").split()}
+                        for c in codes.replace(",", " ").split()}
                 else:
                     self.noqa[tok.start[0]] = set()
         except tokenize.TokenError:

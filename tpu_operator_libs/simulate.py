@@ -101,6 +101,14 @@ class SimResult:
         return statistics.median(self.drain_to_ready_seconds)
 
     @property
+    def drain_to_ready_p95(self) -> Optional[float]:
+        if not self.drain_to_ready_seconds:
+            return None
+        ordered = sorted(self.drain_to_ready_seconds)
+        index = max(0, -(-len(ordered) * 95 // 100) - 1)  # ceil(0.95n)-1
+        return ordered[index]
+
+    @property
     def slice_availability_pct(self) -> float:
         return 100.0 * self.availability_integral
 
@@ -259,12 +267,27 @@ def simulate_rolling_upgrade(
             converged = True
             break
 
-        # The sampled availability holds for the upcoming interval
-        # [now, now + reconcile_interval); weight and advance together so
-        # the integral normalizes by exactly the elapsed virtual time.
-        availability_weighted += sample_availability() * reconcile_interval
-        clock.advance(reconcile_interval)
-        cluster.step()
+        # Event-driven integration over [now, now + reconcile_interval):
+        # availability is piecewise-constant between cluster events
+        # (pod recreation/readiness, fault flips are scheduled actions;
+        # cordon/uncordon happen at reconcile boundaries, sampled above),
+        # so advancing to each due action and weighting by the exact
+        # sub-interval makes the integral exact rather than crediting a
+        # whole interval to its opening sample.
+        interval_end = now + reconcile_interval
+        t = now
+        while t < interval_end:
+            due = cluster.next_action_due()
+            t_next = interval_end if due is None else min(interval_end,
+                                                          max(due, t))
+            if t_next <= t:
+                # action due now (or overdue): run it before weighting
+                cluster.step()
+                continue
+            availability_weighted += sample_availability() * (t_next - t)
+            clock.advance(t_next - t)
+            cluster.step()
+            t = t_next
 
     total = clock.now()
     return SimResult(
