@@ -1,0 +1,122 @@
+"""Multislice (DCN-spanning) job awareness for upgrade planning.
+
+A multislice JAX job spans several ICI slices connected over DCN (one
+JobSet replica per slice on GKE). Losing one member slice already forces
+the job to pause or restart from checkpoint; losing a *second* member
+concurrently buys no additional upgrade progress for the job while
+doubling its blast radius and delaying its recovery. The planner
+therefore enforces: **per multislice job, at most
+``max_unavailable_slices_per_job`` member slices unavailable at a time**
+(default 1) — generalizing the reference's budget logic
+(upgrade_state.go:606-616) from host-counts to DCN job membership.
+
+Membership is derived from workload pod labels: every pod carrying one
+of the configured job-label keys (default: JobSet's
+``jobset.sigs.k8s.io/jobset-name``) ties the slice its node belongs to
+into the job identified by ``(namespace, label value)``.
+
+Pod-derived membership has a known transient gap: a drained member's
+pods are evicted, and their replacements stay Pending (no nodeName)
+until the slice is schedulable again — so the live map alone would
+"forget" the down member and let the planner take a second one.
+:class:`MultisliceJobMap` therefore carries membership of currently
+*unavailable* slices forward from round to round (sticky-down memory),
+forgetting a slice only once it is available again. This requires the
+map (and the planner holding it) to live across reconciles.
+"""
+
+from __future__ import annotations
+
+from typing import Callable, Iterable, Optional
+
+from tpu_operator_libs.k8s.objects import Node, Pod
+from tpu_operator_libs.topology.slice_topology import slice_id_for_node
+
+#: Default pod label keys identifying the multislice job a pod belongs
+#: to, tried in order. JobSet is the GKE-blessed multislice launcher.
+DEFAULT_JOB_LABEL_KEYS: tuple[str, ...] = (
+    "jobset.sigs.k8s.io/jobset-name",
+)
+
+JobId = tuple[str, str]  # (namespace, job name)
+
+
+def job_id_for_pod(pod: Pod,
+                   keys: Iterable[str] = DEFAULT_JOB_LABEL_KEYS
+                   ) -> Optional[JobId]:
+    for key in keys:
+        value = pod.metadata.labels.get(key)
+        if value:
+            return (pod.metadata.namespace, value)
+    return None
+
+
+class MultisliceJobMap:
+    """job → member slices, built from live pods each round with
+    sticky-down memory (see module docstring)."""
+
+    def __init__(self, job_label_keys: Iterable[str] = DEFAULT_JOB_LABEL_KEYS
+                 ) -> None:
+        self._keys = tuple(job_label_keys)
+        self._last: dict[JobId, set[str]] = {}
+
+    def refresh(self, pods: Iterable[Pod], nodes: Iterable[Node],
+                down_slices: set[str]) -> dict[JobId, set[str]]:
+        """Rebuild the map from live pods, carrying forward membership of
+        slices in ``down_slices`` from the previous round."""
+        node_slice = {node.metadata.name: slice_id_for_node(node)
+                      for node in nodes}
+        live: dict[JobId, set[str]] = {}
+        for pod in pods:
+            job = job_id_for_pod(pod, self._keys)
+            if job is None:
+                continue
+            sid = node_slice.get(pod.spec.node_name)
+            if sid is None:
+                continue  # Pending/unscheduled or foreign node
+            live.setdefault(job, set()).add(sid)
+        for job, members in self._last.items():
+            for sid in members:
+                if sid in down_slices:
+                    # its pods may be evicted right now; the slice is
+                    # still this job's member until it comes back up
+                    live.setdefault(job, set()).add(sid)
+        self._last = live
+        return live
+
+
+class MultisliceConstraint:
+    """The planner-side admission check.
+
+    ``workload_pods`` supplies the current workload pods (typically
+    ``lambda: client.list_pods()`` across namespaces); construct once
+    and reuse across reconciles so the sticky-down memory works.
+    """
+
+    def __init__(self, workload_pods: Callable[[], list[Pod]],
+                 job_label_keys: Iterable[str] = DEFAULT_JOB_LABEL_KEYS,
+                 max_unavailable_slices_per_job: int = 1) -> None:
+        if max_unavailable_slices_per_job < 1:
+            raise ValueError(
+                "max_unavailable_slices_per_job must be >= 1")
+        self._workload_pods = workload_pods
+        self._map = MultisliceJobMap(job_label_keys)
+        self.max_down = max_unavailable_slices_per_job
+        self._job_slices: dict[JobId, set[str]] = {}
+
+    def begin_round(self, nodes: Iterable[Node],
+                    down_slices: set[str]) -> None:
+        self._job_slices = self._map.refresh(
+            self._workload_pods(), nodes, down_slices)
+
+    def admits(self, slice_id: str, down_slices: set[str],
+               selected_slices: set[str]) -> bool:
+        """May ``slice_id`` (currently available) be taken down, given
+        already-down slices and slices selected earlier this round?"""
+        for members in self._job_slices.values():
+            if slice_id not in members:
+                continue
+            down = len((down_slices | selected_slices) & members)
+            if down + 1 > self.max_down:
+                return False
+        return True
