@@ -176,6 +176,15 @@ def upgrade_policy_schema() -> dict[str, Any]:
                                "independent (reference semantics); 'slice' "
                                "upgrades whole ICI domains atomically.",
             },
+            "maxUnavailableSlicesPerJob": {
+                "type": "integer",
+                "minimum": 1,
+                "default": 1,
+                "description": "With topologyMode=slice: per multislice "
+                               "(DCN-spanning, JobSet-launched) job, at "
+                               "most this many member slices may be "
+                               "unavailable concurrently.",
+            },
         },
     }
 

@@ -188,6 +188,12 @@ class UpgradePolicySpec:
     # reference per-node semantics; "slice" upgrades whole ICI domains
     # atomically — see tpu_operator_libs.topology).
     topology_mode: str = "flat"
+    # Beyond-reference (topology_mode="slice" only): per multislice
+    # (DCN-spanning, JobSet-launched) job, at most this many member
+    # slices may be unavailable concurrently — generalizing the
+    # reference's per-node budget (upgrade_state.go:606-616) to DCN job
+    # membership. See tpu_operator_libs.topology.multislice.
+    max_unavailable_slices_per_job: int = 1
 
     def validate(self) -> None:
         if self.max_parallel_upgrades < 0:
@@ -200,6 +206,9 @@ class UpgradePolicySpec:
         if self.topology_mode not in ("flat", "slice"):
             raise PolicyValidationError(
                 f"unknown topologyMode {self.topology_mode!r}")
+        if self.max_unavailable_slices_per_job < 1:
+            raise PolicyValidationError(
+                "maxUnavailableSlicesPerJob must be >= 1")
         for sub in (self.pod_deletion, self.wait_for_completion, self.drain):
             if sub is not None:
                 sub.validate()
@@ -210,6 +219,7 @@ class UpgradePolicySpec:
             "maxParallelUpgrades": self.max_parallel_upgrades,
             "maxUnavailable": self.max_unavailable,
             "topologyMode": self.topology_mode,
+            "maxUnavailableSlicesPerJob": self.max_unavailable_slices_per_job,
         }
         if self.pod_deletion is not None:
             out["podDeletion"] = self.pod_deletion.to_dict()
@@ -226,6 +236,8 @@ class UpgradePolicySpec:
             max_parallel_upgrades=data.get("maxParallelUpgrades", 1),
             max_unavailable=data.get("maxUnavailable", "25%"),
             topology_mode=data.get("topologyMode", "flat"),
+            max_unavailable_slices_per_job=data.get(
+                "maxUnavailableSlicesPerJob", 1),
         )
         if "podDeletion" in data and data["podDeletion"] is not None:
             spec.pod_deletion = PodDeletionSpec.from_dict(data["podDeletion"])

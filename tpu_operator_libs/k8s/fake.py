@@ -103,6 +103,11 @@ class FakeCluster(K8sClient):
         self._scheduled: list[_ScheduledAction] = []
         self._seq = 0
         self._ds_controller: Optional[_DsControllerConfig] = None
+        # Optional per-node (recreate_delay, ready_delay) override for the
+        # DS-controller sim — models heterogeneous hosts / stragglers so
+        # simulated latency distributions have a real tail.
+        self._ds_delay_fn: Optional[
+            Callable[[str], tuple[float, float]]] = None
         self._eviction_blockers: list[Callable[[Pod], bool]] = []
         # Health gate consulted by the DS-controller simulation before
         # marking a recreated pod Ready. Returning False models a
@@ -240,6 +245,14 @@ class FakeCluster(K8sClient):
         with self._lock:
             self._ds_controller = _DsControllerConfig(
                 recreate_delay=recreate_delay, ready_delay=ready_delay)
+
+    def set_per_node_ds_delays(
+            self, fn: Optional[Callable[[str], tuple[float, float]]]) -> None:
+        """Per-node ``(recreate_delay, ready_delay)`` override for the DS
+        controller sim; ``fn(node_name)`` wins over the global delays.
+        Models heterogeneous hosts and stragglers."""
+        with self._lock:
+            self._ds_delay_fn = fn
 
     def add_eviction_blocker(self, blocker: Callable[[Pod], bool]) -> None:
         """Register a predicate that vetoes evictions (PDB analogue)."""
@@ -511,7 +524,10 @@ class FakeCluster(K8sClient):
             return
         namespace, ds_name = ds_key
         node_name = pod.spec.node_name
-        recreate_due = self._clock.now() + cfg.recreate_delay
+        recreate_delay, ready_delay = cfg.recreate_delay, cfg.ready_delay
+        if self._ds_delay_fn is not None:
+            recreate_delay, ready_delay = self._ds_delay_fn(node_name)
+        recreate_due = self._clock.now() + recreate_delay
 
         def recreate() -> None:
             with self._lock:
@@ -567,7 +583,7 @@ class FakeCluster(K8sClient):
                 # Anchor readiness to the recreation's due time, not to
                 # whenever step() happened to execute the action, so coarse
                 # step() calls don't inflate pod-ready latencies.
-                ready_due = recreate_due + cfg.ready_delay
+                ready_due = recreate_due + ready_delay
                 self.schedule_at(ready_due, lambda: make_ready(ready_due))
 
         self.schedule_at(recreate_due, recreate)

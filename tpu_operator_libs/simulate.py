@@ -54,6 +54,8 @@ from tpu_operator_libs.util import FakeClock
 
 NS = "tpu-system"
 RUNTIME_LABELS = {"app": "libtpu"}
+WORKLOAD_NS = "workloads"
+JOBSET_NAME_LABEL = "jobset.sigs.k8s.io/jobset-name"
 
 
 @dataclass
@@ -84,6 +86,24 @@ class FleetSpec:
     not_ready_nodes: tuple[str, ...] = ()
     not_ready_at: float = 50.0
     not_ready_heal_at: float = 200.0
+    # --- multislice (DCN-spanning) jobs (BASELINE configs #3-#4) ---
+    # (job_name, member slice indices): each member slice runs one
+    # JobSet-labeled workload pod (on host 0, namespace WORKLOAD_NS).
+    # Pods evicted by a drain are re-created by the sim once their slice
+    # is fully schedulable+ready again — modeling the JobSet controller
+    # rescheduling the replica.
+    multislice_jobs: tuple[tuple[str, tuple[int, ...]], ...] = ()
+    # --- per-node heterogeneity (tail realism) ---
+    # Seeded jitter fraction applied per node to recreate/ready delays:
+    # each node's delays are scaled by U[1-jitter, 1+jitter] drawn once
+    # from `delay_seed`, so the drain->ready distribution has a real
+    # spread (p50 < p95) while staying deterministic.
+    delay_jitter: float = 0.0
+    delay_seed: int = 20260729
+    # Straggler hosts: named nodes whose runtime pod takes
+    # `straggler_factor` x the ready delay (heterogeneous-fleet tail).
+    straggler_nodes: tuple[str, ...] = ()
+    straggler_factor: float = 3.0
 
 
 @dataclass
@@ -93,6 +113,11 @@ class SimResult:
     drain_to_ready_seconds: list[float] = field(default_factory=list)
     availability_integral: float = 0.0  # ∫ availability dt / total
     reconciles: int = 0
+    # Per multislice job: max member slices concurrently unavailable at
+    # any sampled sim instant — measured from the configured (ground
+    # truth) membership, not the pod-derived map the planner uses, so
+    # the invariant check cannot be fooled by membership-tracking bugs.
+    max_down_members_per_job: dict[str, int] = field(default_factory=dict)
 
     @property
     def drain_to_ready_p50(self) -> Optional[float]:
@@ -162,6 +187,14 @@ def build_fleet(spec: FleetSpec) -> tuple[FakeCluster, FakeClock, UpgradeKeys]:
                 phase=PodPhase.RUNNING,
                 container_statuses=[
                     ContainerStatus(name="libtpu", ready=True)])))
+    for job, slice_ids in spec.multislice_jobs:
+        bad = [s for s in slice_ids if not 0 <= s < spec.n_slices]
+        if bad:
+            raise ValueError(
+                f"multislice job {job!r} references slice(s) {bad} "
+                f"outside the fleet (n_slices={spec.n_slices})")
+    _install_delay_model(cluster, spec)
+    restore_workload_pods(cluster, spec)
     # roll the DS template: every pod is now out of date
     cluster.bump_daemon_set_revision(NS, "libtpu", "new")
     _schedule_faults(cluster, spec)
@@ -169,6 +202,68 @@ def build_fleet(spec: FleetSpec) -> tuple[FakeCluster, FakeClock, UpgradeKeys]:
     # visible to the very first reconcile pass
     cluster.step()
     return cluster, clock, keys
+
+
+def _install_delay_model(cluster: FakeCluster, spec: FleetSpec) -> None:
+    """Per-node recreate/ready delays: seeded jitter + straggler hosts.
+
+    Each node's factors are drawn from a generator seeded by
+    ``(delay_seed, node name)``, so the distribution is deterministic,
+    independent of fleet-creation order, and has real spread
+    (p50 < p95) instead of the point mass fixed constants produce.
+    """
+    if not 0.0 <= spec.delay_jitter < 1.0:
+        raise ValueError("delay_jitter must be in [0, 1)")
+    if spec.delay_jitter == 0.0 and not spec.straggler_nodes:
+        return
+    stragglers = set(spec.straggler_nodes)
+    known = {n.metadata.name for n in cluster.list_nodes()}
+    unknown = stragglers - known
+    if unknown:
+        raise ValueError(
+            f"straggler nodes {sorted(unknown)} are not fleet nodes")
+    delays: dict[str, tuple[float, float]] = {}
+    for name in known:
+        rng = random.Random(f"{spec.delay_seed}:{name}")
+        recreate = spec.pod_recreate_delay * (
+            1.0 + spec.delay_jitter * (2.0 * rng.random() - 1.0))
+        ready = spec.pod_ready_delay * (
+            1.0 + spec.delay_jitter * (2.0 * rng.random() - 1.0))
+        if name in stragglers:
+            ready *= spec.straggler_factor
+        delays[name] = (recreate, ready)
+    cluster.set_per_node_ds_delays(lambda n: delays[n])
+
+
+def restore_workload_pods(cluster: FakeCluster, spec: FleetSpec) -> None:
+    """(Re)create each multislice job's member pods on slices that are
+    fully schedulable+ready — the sim's stand-in for the JobSet
+    controller rescheduling an evicted replica once its slice recovers.
+    """
+    if not spec.multislice_jobs:
+        return
+    nodes = {n.metadata.name: n for n in cluster.list_nodes()}
+    existing = {p.metadata.name
+                for p in cluster.list_pods(namespace=WORKLOAD_NS)}
+    for job, slice_ids in spec.multislice_jobs:
+        for s in slice_ids:
+            pod_name = f"{job}-s{s}"
+            if pod_name in existing:
+                continue
+            hosts = [nodes.get(f"s{s}-h{h}")
+                     for h in range(spec.hosts_per_slice)]
+            if any(n is None or n.is_unschedulable() or not n.is_ready()
+                   for n in hosts):
+                continue  # replica stays Pending until the slice is back
+            cluster.add_pod(Pod(
+                metadata=ObjectMeta(
+                    name=pod_name, namespace=WORKLOAD_NS,
+                    labels={JOBSET_NAME_LABEL: job}),
+                spec=PodSpec(node_name=f"s{s}-h0"),
+                status=PodStatus(
+                    phase=PodPhase.RUNNING,
+                    container_statuses=[
+                        ContainerStatus(name="worker", ready=True)])))
 
 
 def _schedule_faults(cluster: FakeCluster, spec: FleetSpec) -> None:
@@ -204,7 +299,8 @@ def simulate_rolling_upgrade(
         max_parallel_upgrades: int = 0,
         reconcile_interval: float = 10.0,
         max_sim_seconds: float = 24 * 3600.0,
-        chained: bool = False) -> SimResult:
+        chained: bool = False,
+        max_unavailable_slices_per_job: int = 1) -> SimResult:
     """Run one full rolling upgrade and measure it.
 
     ``chained=False`` models the reference consumer: one apply_state per
@@ -221,21 +317,35 @@ def simulate_rolling_upgrade(
         max_parallel_upgrades=max_parallel_upgrades,
         max_unavailable=max_unavailable,
         topology_mode=topology_mode,
-        drain=DrainSpec(enable=True, force=True, timeout_seconds=300))
+        drain=DrainSpec(enable=True, force=True, timeout_seconds=300),
+        max_unavailable_slices_per_job=max_unavailable_slices_per_job)
 
     down_since: dict[str, float] = {}
     drain_to_ready: list[float] = []
     availability_weighted = 0.0
     reconciles = 0
     converged = False
+    # Ground-truth multislice membership (configured, not pod-derived):
+    # the invariant check below must not depend on the same machinery it
+    # is validating.
+    job_members = {name: {f"pool-{s}" for s in slice_ids}
+                   for name, slice_ids in fleet.multislice_jobs}
+    max_down: dict[str, int] = {name: 0 for name in job_members}
 
     def sample_availability() -> float:
         topo = SliceTopology.from_nodes(cluster.list_nodes())
+        for name, members in job_members.items():
+            down = sum(1 for sid in members
+                       if sid in topo.slices
+                       and not topo.slices[sid].is_available)
+            if down > max_down[name]:
+                max_down[name] = down
         return topo.availability()
 
     from tpu_operator_libs.upgrade.state_manager import BuildStateError
 
     while clock.now() < max_sim_seconds:
+        restore_workload_pods(cluster, fleet)
         try:
             if chained:
                 mgr.reconcile(NS, RUNTIME_LABELS, policy)
@@ -296,4 +406,5 @@ def simulate_rolling_upgrade(
         drain_to_ready_seconds=drain_to_ready,
         availability_integral=(availability_weighted / total
                                if total > 0 else 1.0),
-        reconciles=reconciles)
+        reconciles=reconciles,
+        max_down_members_per_job=max_down)

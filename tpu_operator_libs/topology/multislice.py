@@ -41,6 +41,29 @@ DEFAULT_JOB_LABEL_KEYS: tuple[str, ...] = (
 JobId = tuple[str, str]  # (namespace, job name)
 
 
+def default_workload_pods(client,
+                          keys: Iterable[str] = DEFAULT_JOB_LABEL_KEYS
+                          ) -> Callable[[], list[Pod]]:
+    """A workload-pod source that lists only pods carrying one of the
+    job-label keys (bare-key existence selector), instead of every pod
+    in the cluster — on a real apiserver a full-namespace-less LIST per
+    reconcile pass would be the dominant cost of slice planning.
+
+    Pods matching several keys are deduplicated by (namespace, name).
+    """
+    key_list = tuple(keys)
+
+    def source() -> list[Pod]:
+        seen: dict[tuple[str, str], Pod] = {}
+        for key in key_list:
+            for pod in client.list_pods(label_selector=key):
+                seen.setdefault(
+                    (pod.metadata.namespace, pod.metadata.name), pod)
+        return list(seen.values())
+
+    return source
+
+
 def job_id_for_pod(pod: Pod,
                    keys: Iterable[str] = DEFAULT_JOB_LABEL_KEYS
                    ) -> Optional[JobId]:
@@ -111,12 +134,21 @@ class MultisliceConstraint:
 
     def admits(self, slice_id: str, down_slices: set[str],
                selected_slices: set[str]) -> bool:
-        """May ``slice_id`` (currently available) be taken down, given
-        already-down slices and slices selected earlier this round?"""
+        """May ``slice_id`` be taken (fully) down, given already-down
+        slices and slices selected earlier this round?
+
+        A slice already counted down (partially cordoned, or selected
+        earlier) adds nothing new to its job's blast radius — finishing
+        an already-broken member is always admitted, mirroring the
+        planner's broken-slices-first preference.
+        """
+        counted = down_slices | selected_slices
+        extra = 0 if slice_id in counted else 1
+        if extra == 0:
+            return True
         for members in self._job_slices.values():
             if slice_id not in members:
                 continue
-            down = len((down_slices | selected_slices) & members)
-            if down + 1 > self.max_down:
+            if len(counted & members) + extra > self.max_down:
                 return False
         return True

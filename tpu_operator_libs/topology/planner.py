@@ -17,13 +17,19 @@ planner therefore:
    cheapest slice, that one slice may overdraw the budget — a partial
    upgrade would hurt availability strictly more than a brief overdraw,
    since the slice becomes unusable at the first cordoned host either way.
+5. Optionally consults a :class:`MultisliceConstraint`: a slice whose
+   DCN-spanning job already has ``maxUnavailableSlicesPerJob`` member
+   slices down is deferred this round (it stays a candidate and is
+   retried once a down member recovers).
 """
 
 from __future__ import annotations
 
 import logging
-from typing import TYPE_CHECKING
+from typing import TYPE_CHECKING, Optional
 
+from tpu_operator_libs.consts import IN_PROGRESS_STATES
+from tpu_operator_libs.topology.multislice import MultisliceConstraint
 from tpu_operator_libs.topology.slice_topology import (
     SliceTopology,
     slice_id_for_node,
@@ -39,7 +45,17 @@ logger = logging.getLogger(__name__)
 
 
 class SlicePlanner:
-    """Slice-atomic implementation of the UpgradePlanner protocol."""
+    """Slice-atomic implementation of the UpgradePlanner protocol.
+
+    ``constraint`` (optional) adds multislice-job awareness: construct
+    the :class:`MultisliceConstraint` once and keep the planner (or at
+    least the constraint) alive across reconciles so its sticky-down
+    membership memory works (see topology/multislice.py).
+    """
+
+    def __init__(self,
+                 constraint: Optional[MultisliceConstraint] = None) -> None:
+        self.constraint = constraint
 
     def plan(self, candidates: list["NodeUpgradeState"], available: int,
              state: "ClusterUpgradeState") -> list["NodeUpgradeState"]:
@@ -52,6 +68,19 @@ class SlicePlanner:
         all_nodes = [ns.node for bucket in state.node_states.values()
                      for ns in bucket]
         topology = SliceTopology.from_nodes(all_nodes)
+        down_slices = {sid for sid, info in topology.slices.items()
+                       if not info.is_available}
+        # For the multislice constraint, "down" must also cover slices
+        # *committed* to going down — a host selected last pass sits in
+        # cordon-required but is not yet unschedulable; admitting a
+        # sibling member in that window would break the per-job
+        # guarantee the moment both cordons land.
+        committed_down = down_slices | {
+            slice_id_for_node(ns.node)
+            for st in IN_PROGRESS_STATES
+            for ns in state.bucket(st)}
+        if self.constraint is not None:
+            self.constraint.begin_round(all_nodes, committed_down)
 
         by_slice: dict[str, list["NodeUpgradeState"]] = {}
         for ns in candidates:
@@ -75,12 +104,16 @@ class SlicePlanner:
             ))
 
         selected: list["NodeUpgradeState"] = []
+        selected_down: set[str] = set()  # slices newly taken down this round
+        deferred: list[str] = []
         budget = available
         paid = False
         for sid in order:
             c = cost(sid)
             if c == 0:
                 # every candidate host already unavailable — free progress
+                # (the slice is in down_slices, so the multislice
+                # constraint already charges its job for it)
                 selected.extend(by_slice[sid])
                 continue
             if budget <= 0:
@@ -89,9 +122,24 @@ class SlicePlanner:
                 # Overdraw is only allowed for the first PAYING slice;
                 # free slices selected above don't consume that right.
                 continue
+            if (self.constraint is not None
+                    and not self.constraint.admits(
+                        sid, committed_down, selected_down)):
+                # This slice's multislice job already has its budget of
+                # member slices down; defer — it stays upgrade-required
+                # and is reconsidered next round.
+                deferred.append(sid)
+                continue
             selected.extend(by_slice[sid])
+            selected_down.add(sid)
             budget = max(0, budget - c)
             paid = True
+        if deferred:
+            logger.info(
+                "multislice constraint deferred slice(s) %s "
+                "(max %d member(s) down per job)",
+                ", ".join(sorted(deferred)),
+                self.constraint.max_down if self.constraint else 0)
         if selected:
             logger.info(
                 "slice planner advancing %d nodes across %d slice(s)",

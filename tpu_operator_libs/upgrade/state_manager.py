@@ -38,7 +38,11 @@ from __future__ import annotations
 
 import logging
 from dataclasses import dataclass, field
-from typing import Optional, Protocol
+from typing import TYPE_CHECKING, Optional, Protocol
+
+if TYPE_CHECKING:  # pragma: no cover - import cycle guard (topology
+    # imports k8s.objects; planner imports this module's state types)
+    from tpu_operator_libs.topology.multislice import MultisliceConstraint
 
 from tpu_operator_libs.api.upgrade_policy import (
     UpgradePolicySpec,
@@ -178,6 +182,12 @@ class ClusterUpgradeStateManager:
         # Explicit planner wins; otherwise policy.topology_mode selects
         # flat (reference parity) or slice-atomic planning per apply_state.
         self._explicit_planner = planner
+        # Multislice-job awareness for the slice planner. Lives on the
+        # manager (not rebuilt per pass) because its sticky-down
+        # membership memory must survive across reconciles
+        # (topology/multislice.py module docstring).
+        self._multislice_constraint: Optional["MultisliceConstraint"] = None
+        self._multislice_constraint_is_custom = False
 
         self._pod_deletion_enabled = False
         self._validation_enabled = False
@@ -360,14 +370,51 @@ class ClusterUpgradeStateManager:
                 self.provider.change_node_upgrade_state(
                     ns.node, UpgradeState.DONE)
 
+    def with_multislice_constraint(
+            self, constraint: "MultisliceConstraint",
+    ) -> "ClusterUpgradeStateManager":
+        """Install a custom multislice constraint (own job-label keys /
+        workload-pod source / budget) used when ``topology_mode=slice``.
+        A custom constraint is authoritative: the policy's
+        ``maxUnavailableSlicesPerJob`` does not override its budget."""
+        self._multislice_constraint = constraint
+        self._multislice_constraint_is_custom = True
+        return self
+
     def _planner_for_policy(
             self, policy: UpgradePolicySpec) -> UpgradePlanner:
         if self._explicit_planner is not None:
             return self._explicit_planner
         if policy.topology_mode == "slice":
             from tpu_operator_libs.topology.planner import SlicePlanner
-            return SlicePlanner()
+            return SlicePlanner(self._multislice_for_policy(policy))
         return FlatPlanner()
+
+    def _multislice_for_policy(
+            self, policy: UpgradePolicySpec) -> "MultisliceConstraint":
+        """The persistent multislice constraint for slice-mode planning.
+
+        Auto-created on first use over a job-label-selector pod list
+        (all namespaces — JobSet workloads live outside the runtime
+        namespace); the policy is re-read every pass (reference
+        semantics, upgrade_state.go:364-365), so a changed
+        ``maxUnavailableSlicesPerJob`` takes effect immediately unless a
+        custom constraint was installed via
+        :meth:`with_multislice_constraint`.
+        """
+        from tpu_operator_libs.topology.multislice import (
+            MultisliceConstraint,
+            default_workload_pods,
+        )
+        if self._multislice_constraint is None:
+            self._multislice_constraint = MultisliceConstraint(
+                workload_pods=default_workload_pods(self.client),
+                max_unavailable_slices_per_job=(
+                    policy.max_unavailable_slices_per_job))
+        elif not self._multislice_constraint_is_custom:
+            self._multislice_constraint.max_down = (
+                policy.max_unavailable_slices_per_job)
+        return self._multislice_constraint
 
     def process_upgrade_required_nodes(
             self, state: ClusterUpgradeState, upgrades_available: int,
