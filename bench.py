@@ -43,8 +43,16 @@ SIDECAR = os.path.join(os.path.dirname(os.path.abspath(__file__)),
                        "BENCH_HW.json")
 
 
+#: Seeded per-node delay jitter for the headline matrix: drains get a
+#: real drain->ready distribution (p50 < p95) instead of the point mass
+#: fixed constants produce, while staying bit-deterministic (the seed is
+#: FleetSpec.delay_seed, reported in the JSON).
+DELAY_JITTER = 0.35
+
+
 def main() -> int:
-    fleet = FleetSpec(n_slices=8, hosts_per_slice=4)
+    fleet = FleetSpec(n_slices=8, hosts_per_slice=4,
+                      delay_jitter=DELAY_JITTER)
     cells = {}
     for planner in ("flat", "slice"):
         for cadence in ("interval", "chained"):
@@ -80,7 +88,8 @@ def main() -> int:
     ours = availability("slice_chained")
     reference = availability("flat_interval")
     hardware = _hardware_capture()
-    reconcile_ms = _reconcile_latency_ms()
+    reconcile = _reconcile_latency_cells()
+    straggler = _straggler_scenario()
 
     result = {
         "metric": "rolling_upgrade_slice_availability",
@@ -98,7 +107,14 @@ def main() -> int:
         if availability("slice_interval") else 0.0,
         "matrix": matrix,
         "fleet": f"{fleet.n_slices}x{fleet.hosts_per_slice} hosts",
-        "reconcile_p50_ms_256_nodes": reconcile_ms,
+        "delay_jitter": DELAY_JITTER,
+        "delay_seed": fleet.delay_seed,
+        "straggler": straggler,
+        # control-plane scale: p50/p95 per build+apply pass, flat vs
+        # slice planner, 256 (64x4) and 1024 (64x16) node fleets
+        "reconcile_latency_ms": reconcile,
+        "reconcile_p50_ms_256_nodes": (
+            reconcile.get("256_nodes", {}).get("slice", {}).get("p50")),
         # flattened legacy keys (round-over-round comparability)
         "flat_availability_pct": reference,
         "drain_to_ready_p50_s": cells["slice_chained"].drain_to_ready_p50,
@@ -208,12 +224,14 @@ def _hardware_capture() -> dict:
         if data is not None and "error" not in data:
             out = _hardware_result(data)
             _write_sidecar(out)
+            out["hardware_attempt_history"] = _attempt_history()
             return out
         if data is not None and "error" in data:
             reason = f"probe raised: {data['error']}"
             if any(marker in data["error"] for marker in
                    ("ImportError", "ModuleNotFoundError")):
                 break  # deterministic failure; retrying cannot help
+        _record_attempt(ok=False, reason=reason)
         if attempt + 1 < attempts:
             time.sleep(backoff_s * (attempt + 1))
 
@@ -226,10 +244,19 @@ def _hardware_capture() -> dict:
         "tpu_unreachable": True,
         "tpu_unreachable_reason": f"{reason} ({attempts} attempts, "
                                   f"{timeout_s:.0f}s timeout each)",
+        # every probe attempt this round (incl. opportunistic ones via
+        # tools/hwprobe.py), so "wedged all round" is distinguishable
+        # from "never tried until bench capture"
+        "hardware_attempt_history": _attempt_history(),
     }
     last_good = _read_sidecar()
-    if isinstance(last_good, dict):  # non-dict JSON must not crash the
-        last_good["stale"] = True    # degradation path itself
+    # "captured_at" is only ever written on probe success, so its
+    # presence distinguishes a real last-good from a sidecar that holds
+    # nothing but failed-attempt history (and non-dict JSON must not
+    # crash the degradation path itself).
+    if isinstance(last_good, dict) and "captured_at" in last_good:
+        last_good.pop("attempt_history", None)  # already surfaced above
+        last_good["stale"] = True
         out["hardware_last_good"] = last_good
     return out
 
@@ -278,14 +305,53 @@ def _hardware_result(data: dict) -> dict:
     }
 
 
+_MAX_ATTEMPTS_KEPT = 50
+
+
 def _write_sidecar(result: dict) -> None:
+    """Refresh the last-good numbers, appending a success attempt to the
+    history carried over from the previous sidecar."""
+    now = time.strftime("%Y-%m-%dT%H:%M:%SZ", time.gmtime())
+    history = _attempt_history()
+    history.append({"at": now, "ok": True,
+                    "mxu_tflops_bf16": result.get("mxu_tflops_bf16")})
     try:
         with open(SIDECAR, "w") as fh:
-            json.dump({"captured_at": time.strftime(
-                "%Y-%m-%dT%H:%M:%SZ", time.gmtime()), **result}, fh,
-                indent=1)
+            json.dump({"captured_at": now, **result,
+                       "attempt_history": history[-_MAX_ATTEMPTS_KEPT:]},
+                      fh, indent=1)
     except OSError:
         pass  # sidecar is best-effort; the live numbers already printed
+
+
+def _record_attempt(ok: bool, reason: Optional[str] = None) -> None:
+    """Append a probe attempt to the sidecar without touching the
+    last-good hardware numbers."""
+    sidecar = _read_sidecar()
+    if not isinstance(sidecar, dict):
+        sidecar = {}
+    history = sidecar.get("attempt_history")
+    if not isinstance(history, list):
+        history = []
+    entry: dict = {"at": time.strftime("%Y-%m-%dT%H:%M:%SZ",
+                                       time.gmtime()), "ok": ok}
+    if reason:
+        entry["reason"] = reason[:200]
+    history.append(entry)
+    sidecar["attempt_history"] = history[-_MAX_ATTEMPTS_KEPT:]
+    try:
+        with open(SIDECAR, "w") as fh:
+            json.dump(sidecar, fh, indent=1)
+    except OSError:
+        pass
+
+
+def _attempt_history() -> list:
+    sidecar = _read_sidecar()
+    if isinstance(sidecar, dict) and isinstance(
+            sidecar.get("attempt_history"), list):
+        return list(sidecar["attempt_history"])
+    return []
 
 
 def _read_sidecar() -> Optional[dict]:
@@ -296,10 +362,57 @@ def _read_sidecar() -> Optional[dict]:
         return None
 
 
-def _reconcile_latency_ms(n_slices: int = 64, hosts: int = 4,
-                          passes: int = 9) -> Optional[float]:
-    """Median real-time ms per build_state+apply_state over an
-    n_slices*hosts fleet that is mid-upgrade (every state bucket busy)."""
+def _straggler_scenario() -> dict:
+    """Heterogeneous-fleet tail: one host's runtime pod takes 3x the
+    ready delay. The slice planner confines the straggler's cost to its
+    own slice's (single) downtime window; the flat planner re-breaks
+    slices across windows, so the straggler's slice — and the fleet tail
+    — stays degraded longer. Reported as availability and drain->ready
+    p95 per planner at the reference cadence, plus their ratio."""
+    fleet = FleetSpec(n_slices=8, hosts_per_slice=4,
+                      delay_jitter=DELAY_JITTER,
+                      straggler_nodes=("s5-h2",))
+    cells = {mode: simulate_rolling_upgrade(topology_mode=mode,
+                                            fleet=fleet)
+             for mode in ("flat", "slice")}
+    if not all(cell.converged for cell in cells.values()):
+        return {"error": "straggler scenario did not converge"}
+    window = max(cell.total_seconds for cell in cells.values())
+    out = {
+        mode: {
+            "availability_pct": round(
+                cell.slice_availability_pct_over(window), 2),
+            "drain_to_ready_p95_s": cell.drain_to_ready_p95,
+        }
+        for mode, cell in cells.items()
+    }
+    flat = out["flat"]["availability_pct"]
+    out["slice_vs_flat"] = (round(out["slice"]["availability_pct"] / flat, 3)
+                            if flat else None)
+    out["straggler_nodes"] = list(fleet.straggler_nodes)
+    out["straggler_factor"] = fleet.straggler_factor
+    return out
+
+
+def _reconcile_latency_cells(passes: int = 9) -> dict:
+    """Control-plane scale evidence: p50/p95 real-time ms per
+    build_state+apply_state pass, flat vs slice planner, at 256 (64x4)
+    and 1024 (64x16) nodes, each fleet mid-upgrade (every state bucket
+    busy)."""
+    cells: dict = {}
+    for n_slices, hosts in ((64, 4), (64, 16)):
+        label = f"{n_slices * hosts}_nodes"
+        cells[label] = {"fleet": f"{n_slices}x{hosts}"}
+        for mode in ("flat", "slice"):
+            cells[label][mode] = _reconcile_latency_ms(
+                n_slices, hosts, mode, passes)
+    return cells
+
+
+def _reconcile_latency_ms(n_slices: int, hosts: int, topology_mode: str,
+                          passes: int) -> Optional[dict]:
+    """p50/p95 real-time ms per build_state+apply_state over an
+    n_slices*hosts fleet that is mid-upgrade."""
     import statistics
     import time as _time
 
@@ -313,6 +426,7 @@ def _reconcile_latency_ms(n_slices: int = 64, hosts: int = 4,
         build_fleet,
     )
     from tpu_operator_libs.upgrade.state_manager import (
+        BuildStateError,
         ClusterUpgradeStateManager,
     )
 
@@ -322,9 +436,8 @@ def _reconcile_latency_ms(n_slices: int = 64, hosts: int = 4,
         cluster, keys, async_workers=False, poll_interval=0.0)
     policy = UpgradePolicySpec(
         auto_upgrade=True, max_parallel_upgrades=0,
-        max_unavailable="25%", topology_mode="slice",
+        max_unavailable="25%", topology_mode=topology_mode,
         drain=DrainSpec(enable=True, force=True))
-    from tpu_operator_libs.upgrade.state_manager import BuildStateError
 
     def one_pass() -> Optional[float]:
         started = _time.perf_counter()
@@ -357,7 +470,10 @@ def _reconcile_latency_ms(n_slices: int = 64, hosts: int = 4,
     if len(samples) < passes:
         # a partial sample set must not masquerade as a healthy p50
         return None
-    return round(statistics.median(samples), 2)
+    ordered = sorted(samples)
+    p95_index = max(0, -(-len(ordered) * 95 // 100) - 1)
+    return {"p50": round(statistics.median(samples), 2),
+            "p95": round(ordered[p95_index], 2)}
 
 
 if __name__ == "__main__":

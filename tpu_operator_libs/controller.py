@@ -202,6 +202,18 @@ class Informer:
     ``lister`` provides the initial snapshot (fires add handlers, like a
     client-go informer's initial sync); ``watch`` streams subsequent
     events. The store always holds snapshot copies.
+
+    **Lister freshness requirement**: ``refresh()`` treats the list
+    snapshot as at-least-as-fresh as the moment the list started — a key
+    absent from the store with a pre-list tombstone but present in the
+    snapshot is taken to mean the object was *recreated* (lost watch
+    ADD), and is resurrected. That inference only holds for quorum
+    reads: a lister backed by a stale cache (e.g. a real apiserver list
+    at ``resourceVersion=0``, which may be served from any replica's
+    watch cache) can return a snapshot predating a delivered DELETE and
+    would silently undo it. Listers plugged in here must issue quorum
+    list requests (client-go's default of ``resourceVersion=""``), never
+    ``resourceVersion=0``.
     """
 
     def __init__(self, lister: Callable[[], list], watch: Watch,
