@@ -114,7 +114,8 @@ def main() -> int:
         # slice planner, 256 (64x4) and 1024 (64x16) node fleets
         "reconcile_latency_ms": reconcile,
         "reconcile_p50_ms_256_nodes": (
-            reconcile.get("256_nodes", {}).get("slice", {}).get("p50")),
+            (reconcile.get("256_nodes") or {}).get("slice")
+            or {}).get("p50"),
         # flattened legacy keys (round-over-round comparability)
         "flat_availability_pct": reference,
         "drain_to_ready_p50_s": cells["slice_chained"].drain_to_ready_p50,
@@ -230,7 +231,10 @@ def _hardware_capture() -> dict:
             reason = f"probe raised: {data['error']}"
             if any(marker in data["error"] for marker in
                    ("ImportError", "ModuleNotFoundError")):
-                break  # deterministic failure; retrying cannot help
+                # deterministic failure; retrying cannot help — but it
+                # is still an attempt the history must show
+                _record_attempt(ok=False, reason=reason)
+                break
         _record_attempt(ok=False, reason=reason)
         if attempt + 1 < attempts:
             time.sleep(backoff_s * (attempt + 1))
@@ -315,13 +319,8 @@ def _write_sidecar(result: dict) -> None:
     history = _attempt_history()
     history.append({"at": now, "ok": True,
                     "mxu_tflops_bf16": result.get("mxu_tflops_bf16")})
-    try:
-        with open(SIDECAR, "w") as fh:
-            json.dump({"captured_at": now, **result,
-                       "attempt_history": history[-_MAX_ATTEMPTS_KEPT:]},
-                      fh, indent=1)
-    except OSError:
-        pass  # sidecar is best-effort; the live numbers already printed
+    _dump_sidecar({"captured_at": now, **result,
+                   "attempt_history": history[-_MAX_ATTEMPTS_KEPT:]})
 
 
 def _record_attempt(ok: bool, reason: Optional[str] = None) -> None:
@@ -339,11 +338,25 @@ def _record_attempt(ok: bool, reason: Optional[str] = None) -> None:
         entry["reason"] = reason[:200]
     history.append(entry)
     sidecar["attempt_history"] = history[-_MAX_ATTEMPTS_KEPT:]
+    _dump_sidecar(sidecar)
+
+
+def _dump_sidecar(payload: dict) -> None:
+    """Atomic write (temp + rename): bench.py and tools/hwprobe.py may
+    run concurrently, and a reader landing mid-truncate would take the
+    half-written JSON for a corrupt sidecar and clobber the last-good
+    numbers on its next write."""
+    tmp = f"{SIDECAR}.tmp.{os.getpid()}"
     try:
-        with open(SIDECAR, "w") as fh:
-            json.dump(sidecar, fh, indent=1)
+        with open(tmp, "w") as fh:
+            json.dump(payload, fh, indent=1)
+        os.replace(tmp, SIDECAR)
     except OSError:
-        pass
+        # sidecar is best-effort; the live numbers already printed
+        try:
+            os.unlink(tmp)
+        except OSError:
+            pass
 
 
 def _attempt_history() -> list:

@@ -199,6 +199,22 @@ class TestAttemptHistory:
             bench._record_attempt(ok=False, reason="x")
         assert len(bench._attempt_history()) == bench._MAX_ATTEMPTS_KEPT
 
+    def test_import_error_fast_fail_still_recorded(self, tmp_path,
+                                                   monkeypatch):
+        monkeypatch.setattr(bench, "SIDECAR",
+                            str(tmp_path / "BENCH_HW.json"))
+        monkeypatch.setenv("BENCH_PROBE_ATTEMPTS", "3")
+        monkeypatch.setenv("BENCH_PROBE_BACKOFF", "0")
+        monkeypatch.setattr(
+            bench, "_probe_once",
+            lambda timeout_s: ({"error": "ModuleNotFoundError: no jax"},
+                               "ok"))
+        out = bench._hardware_capture()
+        history = out["hardware_attempt_history"]
+        assert len(history) == 1  # fast-fail: one attempt, but recorded
+        assert history[0]["ok"] is False
+        assert "ModuleNotFoundError" in history[0]["reason"]
+
     def test_corrupt_history_shape_tolerated(self, tmp_path, monkeypatch):
         sidecar = tmp_path / "BENCH_HW.json"
         sidecar.write_text(json.dumps({"attempt_history": "not-a-list"}))

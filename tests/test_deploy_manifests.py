@@ -1,0 +1,317 @@
+"""examples/deploy/ manifest validation (VERDICT r2 item 2).
+
+The deployable consumer story is only real if the manifests stay in
+lock-step with the code: the operator flags referenced by the
+Deployment must exist in the CLI, the RBAC rules must cover the API
+surface k8s/real.py actually calls, the ConfigMap policy must parse
+through the same UpgradePolicySpec/CRD-schema path the operator uses,
+and the DaemonSet wiring must match what the state machine expects.
+No cluster needed — pure YAML + schema checks, the same envtest-free
+strategy as tests/test_crd.py.
+"""
+
+import os
+import re
+import subprocess
+import sys
+
+import pytest
+
+yaml = pytest.importorskip("yaml")
+
+from tpu_operator_libs.api.crd import (  # noqa: E402
+    apply_defaults,
+    upgrade_policy_schema,
+    validate_against_schema,
+)
+from tpu_operator_libs.api.upgrade_policy import UpgradePolicySpec  # noqa: E402
+from tpu_operator_libs.consts import UpgradeKeys  # noqa: E402
+
+DEPLOY_DIR = os.path.join(os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))), "examples", "deploy")
+
+
+def load_all(name: str) -> list[dict]:
+    with open(os.path.join(DEPLOY_DIR, name)) as fh:
+        return [doc for doc in yaml.safe_load_all(fh) if doc]
+
+
+def by_kind(docs: list[dict], kind: str) -> list[dict]:
+    return [d for d in docs if d.get("kind") == kind]
+
+
+@pytest.fixture(scope="module")
+def manifests() -> dict[str, list[dict]]:
+    names = [n for n in os.listdir(DEPLOY_DIR) if n.endswith(".yaml")]
+    return {name: load_all(name) for name in names}
+
+
+class TestEveryManifest:
+    def test_all_docs_have_identity(self, manifests):
+        for name, docs in manifests.items():
+            for doc in docs:
+                assert doc.get("apiVersion"), f"{name}: missing apiVersion"
+                assert doc.get("kind"), f"{name}: missing kind"
+                if doc["kind"] == "Kustomization":
+                    continue  # kustomize allows anonymous Kustomizations
+                assert doc.get("metadata", {}).get("name"), \
+                    f"{name}: unnamed {doc.get('kind')}"
+
+    def test_namespaced_objects_use_tpu_system(self, manifests):
+        cluster_scoped = {"Namespace", "ClusterRole", "ClusterRoleBinding",
+                          "CustomResourceDefinition", "Kustomization"}
+        for name, docs in manifests.items():
+            for doc in docs:
+                if doc["kind"] in cluster_scoped:
+                    continue
+                assert doc["metadata"].get("namespace") == "tpu-system", \
+                    f"{name}: {doc['kind']}/{doc['metadata']['name']} " \
+                    "not in tpu-system"
+
+    def test_kustomization_lists_every_local_manifest(self, manifests):
+        resources = manifests["kustomization.yaml"][0]["resources"]
+        local = {r for r in resources if not r.startswith("..")}
+        expected = {n for n in manifests if n != "kustomization.yaml"}
+        assert local == expected
+        # Out-of-root refs must be DIRECTORY bases carrying their own
+        # kustomization.yaml — kustomize's default load restrictor
+        # (LoadRestrictionsRootOnly) rejects plain-file resources
+        # outside the root, which `kubectl apply -k` cannot override.
+        for ref in (r for r in resources if r.startswith("..")):
+            base = os.path.join(DEPLOY_DIR, ref)
+            assert os.path.isdir(base), (
+                f"{ref}: out-of-root resources must be directory bases")
+            kust = os.path.join(base, "kustomization.yaml")
+            assert os.path.exists(kust), f"{ref} has no kustomization.yaml"
+            with open(kust) as fh:
+                for sub in yaml.safe_load(fh)["resources"]:
+                    assert os.path.exists(os.path.join(base, sub)), sub
+
+    def test_crd_base_covers_all_crd_manifests(self):
+        crd_dir = os.path.join(os.path.dirname(DEPLOY_DIR), "crd")
+        with open(os.path.join(crd_dir, "kustomization.yaml")) as fh:
+            listed = set(yaml.safe_load(fh)["resources"])
+        present = {n for n in os.listdir(crd_dir)
+                   if n.endswith(".yaml") and n != "kustomization.yaml"}
+        assert listed == present
+
+
+class TestRBAC:
+    """The rules must cover exactly the verbs the library issues
+    (k8s/real.py); a missing rule only surfaces as a 403 mid-upgrade on
+    a live cluster, so pin it here."""
+
+    @pytest.fixture(scope="class")
+    def rules(self):
+        docs = load_all("rbac.yaml")
+        role = [d for d in by_kind(docs, "ClusterRole")
+                if d["metadata"]["name"] == "tpu-operator"][0]
+        return role["rules"]
+
+    def allows(self, rules, group, resource, verb) -> bool:
+        return any(group in r.get("apiGroups", [])
+                   and resource in r.get("resources", [])
+                   and verb in r.get("verbs", [])
+                   for r in rules)
+
+    @pytest.mark.parametrize("group,resource,verb", [
+        ("", "nodes", "patch"),        # state label/annotation writes
+        ("", "nodes", "list"),         # build_state snapshot
+        ("", "pods", "list"),
+        ("", "pods", "delete"),        # pod restart
+        ("", "pods/eviction", "create"),  # drain
+        ("apps", "daemonsets", "list"),
+        ("apps", "controllerrevisions", "list"),  # revision oracle
+        ("", "events", "create"),
+    ])
+    def test_operator_surface_covered(self, rules, group, resource, verb):
+        assert self.allows(rules, group, resource, verb)
+
+    def test_leader_election_lease_role(self):
+        docs = load_all("rbac.yaml")
+        role = [d for d in by_kind(docs, "Role")
+                if "leader-election" in d["metadata"]["name"]][0]
+        rule = role["rules"][0]
+        assert "coordination.k8s.io" in rule["apiGroups"]
+        assert "leases" in rule["resources"]
+        assert {"get", "create", "update"} <= set(rule["verbs"])
+
+    def test_bindings_reference_defined_subjects(self):
+        docs = load_all("rbac.yaml")
+        accounts = {(d["metadata"]["name"], d["metadata"]["namespace"])
+                    for d in by_kind(docs, "ServiceAccount")}
+        roles = {d["metadata"]["name"] for d in by_kind(docs, "ClusterRole")
+                 + by_kind(docs, "Role")}
+        for binding in (by_kind(docs, "ClusterRoleBinding")
+                        + by_kind(docs, "RoleBinding")):
+            assert binding["roleRef"]["name"] in roles
+            for subject in binding["subjects"]:
+                assert (subject["name"], subject["namespace"]) in accounts
+
+    def test_safe_load_identity_is_minimal(self):
+        docs = load_all("rbac.yaml")
+        role = [d for d in by_kind(docs, "ClusterRole")
+                if d["metadata"]["name"] == "libtpu-safe-load"][0]
+        assert role["rules"] == [{"apiGroups": [""],
+                                  "resources": ["nodes"],
+                                  "verbs": ["get", "patch"]}]
+
+
+class TestOperatorDeployment:
+    @pytest.fixture(scope="class")
+    def deployment(self):
+        return by_kind(load_all("operator.yaml"), "Deployment")[0]
+
+    @pytest.fixture(scope="class")
+    def container(self, deployment):
+        return deployment["spec"]["template"]["spec"]["containers"][0]
+
+    def test_two_leader_elected_replicas(self, deployment, container):
+        assert deployment["spec"]["replicas"] == 2
+        assert "--leader-elect" in container["args"]
+
+    def test_service_account_matches_rbac(self, deployment):
+        accounts = {d["metadata"]["name"]
+                    for d in by_kind(load_all("rbac.yaml"), "ServiceAccount")}
+        assert deployment["spec"]["template"]["spec"][
+            "serviceAccountName"] in accounts
+
+    def test_all_flags_exist_in_cli(self, container):
+        from tpu_operator_libs.examples import libtpu_operator
+        help_text = subprocess.run(
+            [sys.executable, "-m",
+             "tpu_operator_libs.examples.libtpu_operator", "--help"],
+            capture_output=True, text=True,
+            cwd=os.path.dirname(DEPLOY_DIR) + "/..").stdout
+        assert libtpu_operator  # imported: the module must at least load
+        for arg in container["args"]:
+            flag = arg.split("=")[0]
+            assert flag in help_text, f"{flag} not a CLI flag"
+
+    def test_selector_matches_template_labels(self, deployment):
+        selector = deployment["spec"]["selector"]["matchLabels"]
+        labels = deployment["spec"]["template"]["metadata"]["labels"]
+        assert selector.items() <= labels.items()
+
+    def test_metrics_port_consistent(self, container):
+        port_flag = [a for a in container["args"]
+                     if a.startswith("--metrics-port=")][0]
+        port = int(port_flag.split("=")[1])
+        assert container["ports"][0]["containerPort"] == port
+        assert container["livenessProbe"]["httpGet"]["path"] == "/metrics"
+
+    def test_policy_volume_wiring(self, deployment, container):
+        policy_flag = [a for a in container["args"]
+                       if a.startswith("--policy=")][0]
+        mount = container["volumeMounts"][0]
+        assert policy_flag.split("=", 1)[1].startswith(mount["mountPath"])
+        volume = deployment["spec"]["template"]["spec"]["volumes"][0]
+        assert volume["name"] == mount["name"]
+        configmaps = {d["metadata"]["name"]
+                      for d in by_kind(load_all("operator.yaml"), "ConfigMap")}
+        assert volume["configMap"]["name"] in configmaps
+
+
+class TestPolicyConfigMap:
+    """The shipped policy must load through the exact path the operator
+    uses (load_policy -> from_dict) and pass the CRD schema."""
+
+    @pytest.fixture(scope="class")
+    def policy_doc(self):
+        cm = by_kind(load_all("operator.yaml"), "ConfigMap")[0]
+        return yaml.safe_load(cm["data"]["policy.yaml"])
+
+    def test_parses_into_spec(self, policy_doc):
+        spec = UpgradePolicySpec.from_dict(policy_doc["upgradePolicy"])
+        assert spec.auto_upgrade is True
+        assert spec.topology_mode == "slice"
+        assert spec.max_unavailable_slices_per_job == 1
+        assert spec.drain is not None and spec.drain.enable
+
+    def test_passes_crd_schema(self, policy_doc):
+        data = apply_defaults(policy_doc["upgradePolicy"],
+                              upgrade_policy_schema())
+        errors = validate_against_schema(data, upgrade_policy_schema())
+        assert not errors, errors
+
+
+class TestLibtpuDaemonSet:
+    @pytest.fixture(scope="class")
+    def daemonset(self):
+        return by_kind(load_all("libtpu-daemonset.yaml"), "DaemonSet")[0]
+
+    def test_selector_matches_operator_runtime_labels(self, daemonset):
+        operator = by_kind(load_all("operator.yaml"),
+                           "Deployment")[0]
+        args = operator["spec"]["template"]["spec"]["containers"][0]["args"]
+        runtime = [a for a in args
+                   if a.startswith("--runtime-labels=")][0].split("=", 1)[1]
+        labels = dict(kv.split("=") for kv in runtime.split(","))
+        selector = daemonset["spec"]["selector"]["matchLabels"]
+        template_labels = daemonset["spec"]["template"]["metadata"]["labels"]
+        assert selector == labels
+        assert labels.items() <= template_labels.items()
+
+    def test_on_delete_strategy(self, daemonset):
+        # RollingUpdate would race the operator's cordon/drain pacing
+        assert daemonset["spec"]["updateStrategy"]["type"] == "OnDelete"
+
+    def test_targets_tpu_nodes(self, daemonset):
+        spec = daemonset["spec"]["template"]["spec"]
+        assert spec["nodeSelector"] == {"google.com/tpu": "true"}
+        assert any(t["key"] == "google.com/tpu"
+                   for t in spec["tolerations"])
+
+    def test_safe_load_init_container(self, daemonset):
+        spec = daemonset["spec"]["template"]["spec"]
+        init = spec["initContainers"][0]
+        assert init["command"] == ["tpu-safe-load-init"]
+        env = {e["name"]: e for e in init["env"]}
+        assert env["NODE_NAME"]["valueFrom"]["fieldRef"][
+            "fieldPath"] == "spec.nodeName"
+        assert spec["serviceAccountName"] == "libtpu-safe-load"
+
+
+class TestDockerfile:
+    def test_console_scripts_in_image_exist_in_pyproject(self):
+        with open(os.path.join(DEPLOY_DIR, "Dockerfile")) as fh:
+            dockerfile = fh.read()
+        with open(os.path.join(os.path.dirname(DEPLOY_DIR), "..",
+                               "pyproject.toml")) as fh:
+            pyproject = fh.read()
+        scripts = re.findall(r"^(tpu-[a-z-]+) = ", pyproject, re.M)
+        entry = re.search(r'ENTRYPOINT \["([^"]+)"\]', dockerfile).group(1)
+        assert entry in scripts
+        # every script named in the Dockerfile comment's list must be
+        # real (the comma/paren delimiters exclude image names)
+        mentions = re.findall(r"(tpu-[a-z-]+)[,)]", dockerfile)
+        assert mentions, "Dockerfile no longer lists the console scripts"
+        for mention in mentions:
+            assert mention in scripts, mention
+
+    def test_manifest_commands_are_console_scripts(self):
+        with open(os.path.join(os.path.dirname(DEPLOY_DIR), "..",
+                               "pyproject.toml")) as fh:
+            scripts = re.findall(r"^(tpu-[a-z-]+) = ", fh.read(), re.M)
+        for name in ("operator.yaml", "libtpu-daemonset.yaml"):
+            for doc in load_all(name):
+                spec = (doc.get("spec", {}).get("template", {})
+                        .get("spec", {}))
+                for ctr in (spec.get("initContainers", [])
+                            + spec.get("containers", [])):
+                    for cmd in ctr.get("command", []):
+                        if cmd.startswith("tpu-"):
+                            assert cmd in scripts, cmd
+
+
+class TestDocsWalkthrough:
+    def test_deploy_doc_references_real_files(self):
+        docs_path = os.path.join(os.path.dirname(DEPLOY_DIR), "..",
+                                 "docs", "deploy.md")
+        with open(docs_path) as fh:
+            text = fh.read()
+        for name in ("namespace.yaml", "rbac.yaml", "operator.yaml",
+                     "libtpu-daemonset.yaml", "Dockerfile"):
+            assert name in text, f"docs/deploy.md does not mention {name}"
+        # the state label the doc tells users to watch must be the real one
+        assert UpgradeKeys().state_label in text
