@@ -4,11 +4,16 @@ PYTHON ?= python3
 PYTEST_FLAGS ?= -q
 COV_THRESHOLD ?= 85
 
-.PHONY: all check test test-fast lint cov bench graft-check package clean
+.PHONY: all check test test-fast lint cov bench graft-check package clean diagram
 
 all: lint test
 
 check: lint test cov package
+
+# Regenerate docs/state-diagram.{dot,svg} from consts.STATE_EDGES
+# (tests/test_state_diagram.py fails when they drift).
+diagram:
+	$(PYTHON) tools/state_diagram.py
 
 test:
 	$(PYTHON) -m pytest tests/ $(PYTEST_FLAGS)

@@ -220,7 +220,9 @@ def _hardware_capture() -> dict:
     backoff_s = float(os.environ.get("BENCH_PROBE_BACKOFF", "10"))
 
     reason = "unknown"
+    attempts_made = 0
     for attempt in range(attempts):
+        attempts_made += 1
         data, reason = _probe_once(timeout_s)
         if data is not None and "error" not in data:
             out = _hardware_result(data)
@@ -246,7 +248,7 @@ def _hardware_capture() -> dict:
         "mxu_mfu_pct": None,
         "tpu_device_kind": None,
         "tpu_unreachable": True,
-        "tpu_unreachable_reason": f"{reason} ({attempts} attempts, "
+        "tpu_unreachable_reason": f"{reason} ({attempts_made} attempt(s), "
                                   f"{timeout_s:.0f}s timeout each)",
         # every probe attempt this round (incl. opportunistic ones via
         # tools/hwprobe.py), so "wedged all round" is distinguishable
@@ -312,40 +314,65 @@ def _hardware_result(data: dict) -> dict:
 _MAX_ATTEMPTS_KEPT = 50
 
 
+def _sidecar_lock():
+    """Advisory lock serializing sidecar read-modify-write cycles:
+    bench.py and tools/hwprobe.py may run concurrently, and an unlocked
+    read → modify → write could resurrect a stale snapshot over a
+    last-good capture the other process just wrote. Yields None (and
+    degrades to lockless) where flock is unavailable."""
+    import contextlib
+
+    @contextlib.contextmanager
+    def locked():
+        try:
+            import fcntl
+            fh = open(f"{SIDECAR}.lock", "w")
+        except (ImportError, OSError):
+            yield None
+            return
+        try:
+            fcntl.flock(fh, fcntl.LOCK_EX)
+            yield None
+        finally:
+            fh.close()  # releases the flock
+
+    return locked()
+
+
 def _write_sidecar(result: dict) -> None:
     """Refresh the last-good numbers, appending a success attempt to the
     history carried over from the previous sidecar."""
     now = time.strftime("%Y-%m-%dT%H:%M:%SZ", time.gmtime())
-    history = _attempt_history()
-    history.append({"at": now, "ok": True,
-                    "mxu_tflops_bf16": result.get("mxu_tflops_bf16")})
-    _dump_sidecar({"captured_at": now, **result,
-                   "attempt_history": history[-_MAX_ATTEMPTS_KEPT:]})
+    with _sidecar_lock():
+        history = _attempt_history()
+        history.append({"at": now, "ok": True,
+                        "mxu_tflops_bf16": result.get("mxu_tflops_bf16")})
+        _dump_sidecar({"captured_at": now, **result,
+                       "attempt_history": history[-_MAX_ATTEMPTS_KEPT:]})
 
 
 def _record_attempt(ok: bool, reason: Optional[str] = None) -> None:
     """Append a probe attempt to the sidecar without touching the
     last-good hardware numbers."""
-    sidecar = _read_sidecar()
-    if not isinstance(sidecar, dict):
-        sidecar = {}
-    history = sidecar.get("attempt_history")
-    if not isinstance(history, list):
-        history = []
-    entry: dict = {"at": time.strftime("%Y-%m-%dT%H:%M:%SZ",
-                                       time.gmtime()), "ok": ok}
-    if reason:
-        entry["reason"] = reason[:200]
-    history.append(entry)
-    sidecar["attempt_history"] = history[-_MAX_ATTEMPTS_KEPT:]
-    _dump_sidecar(sidecar)
+    with _sidecar_lock():
+        sidecar = _read_sidecar()
+        if not isinstance(sidecar, dict):
+            sidecar = {}
+        history = sidecar.get("attempt_history")
+        if not isinstance(history, list):
+            history = []
+        entry: dict = {"at": time.strftime("%Y-%m-%dT%H:%M:%SZ",
+                                           time.gmtime()), "ok": ok}
+        if reason:
+            entry["reason"] = reason[:200]
+        history.append(entry)
+        sidecar["attempt_history"] = history[-_MAX_ATTEMPTS_KEPT:]
+        _dump_sidecar(sidecar)
 
 
 def _dump_sidecar(payload: dict) -> None:
-    """Atomic write (temp + rename): bench.py and tools/hwprobe.py may
-    run concurrently, and a reader landing mid-truncate would take the
-    half-written JSON for a corrupt sidecar and clobber the last-good
-    numbers on its next write."""
+    """Atomic write (temp + rename) so a reader landing mid-write never
+    sees a torn file; call with the sidecar lock held."""
     tmp = f"{SIDECAR}.tmp.{os.getpid()}"
     try:
         with open(tmp, "w") as fh:

@@ -85,7 +85,7 @@ class TestHardwareCaptureDegradation:
         assert len(attempts) == 2  # bounded retries actually happened
         assert out["tpu_unreachable"] is True
         assert "wedged" in out["tpu_unreachable_reason"]
-        assert "2 attempts" in out["tpu_unreachable_reason"]
+        assert "2 attempt(s)" in out["tpu_unreachable_reason"]
         assert out["ici_probe_ms"] is None
         assert out["hardware_last_good"]["stale"] is True
         assert out["hardware_last_good"]["ici_probe_ms"] == 2.5

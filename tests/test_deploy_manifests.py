@@ -59,7 +59,9 @@ class TestEveryManifest:
 
     def test_namespaced_objects_use_tpu_system(self, manifests):
         cluster_scoped = {"Namespace", "ClusterRole", "ClusterRoleBinding",
-                          "CustomResourceDefinition", "Kustomization"}
+                          "CustomResourceDefinition", "Kustomization",
+                          "ValidatingAdmissionPolicy",
+                          "ValidatingAdmissionPolicyBinding"}
         for name, docs in manifests.items():
             for doc in docs:
                 if doc["kind"] in cluster_scoped:
@@ -270,6 +272,56 @@ class TestLibtpuDaemonSet:
         assert env["NODE_NAME"]["valueFrom"]["fieldRef"][
             "fieldPath"] == "spec.nodeName"
         assert spec["serviceAccountName"] == "libtpu-safe-load"
+
+
+class TestSafeLoadAdmissionPolicy:
+    """RBAC cannot scope node patches to the pod's own node/annotation;
+    the ValidatingAdmissionPolicy is the mitigation and must stay in
+    lock-step with the real key and ServiceAccount names."""
+
+    @pytest.fixture(scope="class")
+    def policy(self):
+        return by_kind(load_all("safe-load-admission.yaml"),
+                       "ValidatingAdmissionPolicy")[0]
+
+    def test_binding_denies_via_this_policy(self, policy):
+        binding = by_kind(load_all("safe-load-admission.yaml"),
+                          "ValidatingAdmissionPolicyBinding")[0]
+        assert binding["spec"]["policyName"] == policy["metadata"]["name"]
+        assert binding["spec"]["validationActions"] == ["Deny"]
+
+    def test_matches_the_declared_serviceaccount(self, policy):
+        accounts = {(d["metadata"]["name"], d["metadata"]["namespace"])
+                    for d in by_kind(load_all("rbac.yaml"),
+                                     "ServiceAccount")}
+        condition = policy["spec"]["matchConditions"][0]["expression"]
+        match = re.search(r"system:serviceaccount:([\w-]+):([\w-]+)",
+                          condition)
+        assert match, condition
+        namespace, name = match.group(1), match.group(2)
+        assert (name, namespace) in accounts
+        # and it is the account the DaemonSet actually runs under
+        ds = by_kind(load_all("libtpu-daemonset.yaml"), "DaemonSet")[0]
+        assert ds["spec"]["template"]["spec"][
+            "serviceAccountName"] == name
+
+    def test_guards_the_real_safe_load_key(self, policy):
+        variables = {v["name"]: v["expression"]
+                     for v in policy["spec"]["variables"]}
+        assert UpgradeKeys().wait_for_safe_load_annotation in \
+            variables["safeLoadKey"]
+
+    def test_covers_labels_spec_annotations_and_node_identity(self, policy):
+        messages = " ".join(v["message"]
+                            for v in policy["spec"]["validations"])
+        for surface in ("labels", "spec", "annotation", "own node"):
+            assert surface in messages, f"no validation for {surface}"
+
+    def test_applies_to_node_updates(self, policy):
+        rule = policy["spec"]["matchConstraints"]["resourceRules"][0]
+        assert rule["resources"] == ["nodes"]
+        assert rule["operations"] == ["UPDATE"]
+        assert policy["spec"]["failurePolicy"] == "Fail"
 
 
 class TestDockerfile:

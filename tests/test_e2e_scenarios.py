@@ -33,24 +33,9 @@ from tpu_operator_libs.upgrade.state_manager import (
 
 from builders import PodBuilder
 
-#: Legal edges of the state graph (SURVEY.md §1; upgrade_state.go). Keyed
-#: by source label value; "" is unknown.
-LEGAL_EDGES = {
-    "": {"upgrade-done", "upgrade-required"},
-    "upgrade-done": {"upgrade-required"},
-    "upgrade-required": {"cordon-required"},
-    "cordon-required": {"wait-for-jobs-required"},
-    "wait-for-jobs-required": {"pod-deletion-required", "drain-required"},
-    "pod-deletion-required": {"pod-restart-required", "drain-required",
-                              "upgrade-failed"},
-    "drain-required": {"pod-restart-required", "upgrade-failed"},
-    "pod-restart-required": {"validation-required", "uncordon-required",
-                             "upgrade-done", "upgrade-failed"},
-    "validation-required": {"uncordon-required", "upgrade-done",
-                            "upgrade-failed"},
-    "uncordon-required": {"upgrade-done"},
-    "upgrade-failed": {"uncordon-required", "upgrade-done"},
-}
+from tpu_operator_libs.consts import LEGAL_EDGES  # noqa: E402  (the
+# canonical machine-checked edge table; docs/state-diagram.{dot,svg}
+# are generated from the same source, see tools/state_diagram.py)
 
 
 def assert_transitions_legal(trail: dict[str, list[str]]) -> None:

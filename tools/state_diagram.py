@@ -1,0 +1,217 @@
+#!/usr/bin/env python3
+"""Generate docs/state-diagram.{dot,svg} from consts.STATE_EDGES.
+
+The reference ships a hand-drawn PNG that its own docs mark outdated
+(/root/reference/docs/automatic-ofed-upgrade.md:85,
+images/driver-upgrade-state-diagram.png). Here the diagram is *derived*
+from the machine-checked transition table — the same one the e2e suite
+asserts against — and tests/test_state_diagram.py fails whenever the
+committed artifacts drift from the table, so the diagram cannot go
+stale.
+
+Usage:
+    python tools/state_diagram.py           # (re)write docs/ artifacts
+    python tools/state_diagram.py --check   # exit 1 if artifacts drift
+
+Output is deterministic: same table -> byte-identical files.
+"""
+
+from __future__ import annotations
+
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+
+from tpu_operator_libs.consts import STATE_EDGES, UpgradeState  # noqa: E402
+
+DOCS = os.path.join(os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))), "docs")
+DOT_PATH = os.path.join(DOCS, "state-diagram.dot")
+SVG_PATH = os.path.join(DOCS, "state-diagram.svg")
+
+#: Display name for the unknown state (its label value is "").
+UNKNOWN = "unknown"
+
+
+def state_name(state: UpgradeState) -> str:
+    return state.value or UNKNOWN
+
+
+def render_dot() -> str:
+    """Graphviz source with full edge conditions — the renderable source
+    of truth for anyone with `dot` installed."""
+    lines = [
+        "// GENERATED from tpu_operator_libs.consts.STATE_EDGES by",
+        "// tools/state_diagram.py — do not edit by hand; a test",
+        "// (tests/test_state_diagram.py) fails if this file drifts.",
+        "digraph upgrade_state_machine {",
+        "  rankdir=TB;",
+        '  node [shape=box, style="rounded,filled", fillcolor="#eef3fc",'
+        ' fontname="Helvetica", fontsize=11];',
+        '  edge [fontname="Helvetica", fontsize=9, color="#555555"];',
+        f'  "{UNKNOWN}" [fillcolor="#f5f5f5"];',
+        '  "upgrade-done" [fillcolor="#e3f4e3"];',
+        '  "upgrade-failed" [fillcolor="#fbe9e7"];',
+    ]
+    for src, dst, condition in STATE_EDGES:
+        lines.append(f'  "{state_name(src)}" -> "{state_name(dst)}"'
+                     f' [label="{condition}"];')
+    lines.append("}")
+    return "\n".join(lines) + "\n"
+
+
+# --- SVG layout -----------------------------------------------------------
+# Main flow is a single top-to-bottom column in process order; the
+# failure state sits in a side column. Skip/return edges bow out left,
+# failure edges go right. Every edge carries a number resolved by the
+# legend underneath (numbered in STATE_EDGES order), which keeps the
+# drawing legible without graphviz's label placement.
+
+_BOX_W, _BOX_H = 230, 40
+_COL_X = 260            # left edge of the main column
+_FAIL_X = 640           # left edge of upgrade-failed
+_TOP_Y = 46
+_STEP = 96
+
+_RANK = {
+    UNKNOWN: 0, "upgrade-required": 1, "cordon-required": 2,
+    "wait-for-jobs-required": 3, "pod-deletion-required": 4,
+    "drain-required": 5, "pod-restart-required": 6,
+    "validation-required": 7, "uncordon-required": 8, "upgrade-done": 9,
+}
+_FAIL_RANK = 4.5  # vertical midpoint of its in-edges
+
+_FILL = {UNKNOWN: "#f5f5f5", "upgrade-done": "#e3f4e3",
+         "upgrade-failed": "#fbe9e7"}
+
+
+def _pos(name: str) -> tuple[float, float]:
+    """(x, y) of a state's box top-left corner."""
+    if name == "upgrade-failed":
+        return _FAIL_X, _TOP_Y + _FAIL_RANK * _STEP
+    return _COL_X, _TOP_Y + _RANK[name] * _STEP
+
+
+def _edge_path(src: str, dst: str, bow: int) -> tuple[str, float, float]:
+    """SVG path + label anchor for one edge.
+
+    ``bow`` differentiates multiple left-bowing edges so they nest
+    instead of overlapping.
+    """
+    sx, sy = _pos(src)
+    dx, dy = _pos(dst)
+    if src == "upgrade-failed" or dst == "upgrade-failed":
+        # horizontal-ish curve between the columns
+        x0, y0 = (sx + _BOX_W, sy + _BOX_H / 2)
+        x1, y1 = (dx, dy + _BOX_H / 2)
+        if src == "upgrade-failed":  # recovery: leave left edge of failed
+            x0, y0 = sx, sy + _BOX_H / 2
+            x1, y1 = dx + _BOX_W, dy + _BOX_H / 2
+        mx = (x0 + x1) / 2
+        path = f"M {x0:.0f} {y0:.0f} C {mx:.0f} {y0:.0f}," \
+               f" {mx:.0f} {y1:.0f}, {x1:.0f} {y1:.0f}"
+        return path, mx, (y0 + y1) / 2 - 6
+    if _RANK[dst] == _RANK[src] + 1:
+        # adjacent: straight vertical arrow
+        x = sx + _BOX_W / 2
+        path = f"M {x:.0f} {sy + _BOX_H:.0f} L {x:.0f} {dy:.0f}"
+        return path, x + 8, (sy + _BOX_H + dy) / 2 + 4
+    # skip or return edge: bow to the left of the column
+    span = abs(_RANK[dst] - _RANK[src])
+    bulge = 46 + 26 * bow + 6 * span
+    x0, y0 = sx, sy + _BOX_H / 2
+    x1, y1 = dx, dy + _BOX_H / 2
+    cx = _COL_X - bulge
+    path = f"M {x0:.0f} {y0:.0f} C {cx:.0f} {y0:.0f}," \
+           f" {cx:.0f} {y1:.0f}, {x1:.0f} {y1:.0f}"
+    return path, cx + 14, (y0 + y1) / 2 + 4
+
+
+def render_svg() -> str:
+    edges = [(state_name(s), state_name(d), cond)
+             for s, d, cond in STATE_EDGES]
+    legend_y = _TOP_Y + 10 * _STEP + 30
+    height = legend_y + 16 * len(edges) + 24
+    out = [
+        '<?xml version="1.0" encoding="UTF-8"?>',
+        "<!-- GENERATED from tpu_operator_libs.consts.STATE_EDGES by",
+        "     tools/state_diagram.py; do not edit (drift-checked by",
+        "     tests/test_state_diagram.py) -->",
+        f'<svg xmlns="http://www.w3.org/2000/svg" width="940"'
+        f' height="{height}" viewBox="0 0 940 {height}"'
+        ' font-family="Helvetica,Arial,sans-serif">',
+        "<defs><marker id='arrow' viewBox='0 0 10 10' refX='9' refY='5'"
+        " markerWidth='7' markerHeight='7' orient='auto-start-reverse'>"
+        "<path d='M 0 0 L 10 5 L 0 10 z' fill='#555555'/></marker></defs>",
+        "<text x='20' y='24' font-size='15' font-weight='bold'>"
+        "libtpu upgrade state machine (generated from consts.STATE_EDGES)"
+        "</text>",
+    ]
+    # edges under boxes
+    bows: dict[str, int] = {}
+    for index, (src, dst, _) in enumerate(edges, start=1):
+        is_fail = "upgrade-failed" in (src, dst)
+        adjacent = (not is_fail and _RANK[dst] == _RANK[src] + 1)
+        bow = 0
+        if not is_fail and not adjacent:
+            bow = bows.get("left", 0)
+            bows["left"] = bow + 1
+        path, lx, ly = _edge_path(src, dst, bow)
+        out.append(f"<path d='{path}' fill='none' stroke='#555555'"
+                   " stroke-width='1.2' marker-end='url(#arrow)'/>")
+        out.append(f"<text x='{lx:.0f}' y='{ly:.0f}' font-size='10'"
+                   f" fill='#333333'>{index}</text>")
+    # boxes over edges
+    for name in list(_RANK) + ["upgrade-failed"]:
+        x, y = _pos(name)
+        fill = _FILL.get(name, "#eef3fc")
+        out.append(f"<rect x='{x:.0f}' y='{y:.0f}' rx='8' width='{_BOX_W}'"
+                   f" height='{_BOX_H}' fill='{fill}' stroke='#7a8aa0'/>")
+        out.append(f"<text x='{x + _BOX_W / 2:.0f}' y='{y + 25:.0f}'"
+                   " font-size='13' text-anchor='middle'>"
+                   f"{name}</text>")
+    # legend
+    out.append(f"<text x='20' y='{legend_y:.0f}' font-size='12'"
+               " font-weight='bold'>Transitions</text>")
+    for index, (src, dst, cond) in enumerate(edges, start=1):
+        y = legend_y + 16 * index
+        out.append(f"<text x='20' y='{y:.0f}' font-size='11'"
+                   f" fill='#333333'>{index}. {src} &#8594; {dst}"
+                   f" &#8212; {_escape(cond)}</text>")
+    out.append("</svg>")
+    return "\n".join(out) + "\n"
+
+
+def _escape(text: str) -> str:
+    return (text.replace("&", "&amp;").replace("<", "&lt;")
+            .replace(">", "&gt;"))
+
+
+def main() -> int:
+    check = "--check" in sys.argv[1:]
+    drift = []
+    for path, content in ((DOT_PATH, render_dot()),
+                          (SVG_PATH, render_svg())):
+        if check:
+            try:
+                with open(path) as fh:
+                    on_disk = fh.read()
+            except OSError:
+                on_disk = None
+            if on_disk != content:
+                drift.append(os.path.relpath(path))
+        else:
+            with open(path, "w") as fh:
+                fh.write(content)
+            print(f"wrote {os.path.relpath(path)}")
+    if drift:
+        print(f"state-diagram drift: {', '.join(drift)} out of date; "
+              "run python tools/state_diagram.py", file=sys.stderr)
+        return 1
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -101,6 +101,65 @@ ALL_STATES = (
     UpgradeState.UNCORDON_REQUIRED,
 )
 
+#: The legal transitions of the state machine, with the condition that
+#: takes each edge — the single source of truth for the graph. The e2e
+#: suite asserts every transition observed in full simulated upgrades is
+#: one of these edges, and docs/state-diagram.{dot,svg} are generated
+#: from this table (tools/state_diagram.py) with a drift-check test, so
+#: the diagram can never go stale the way the reference's PNG did
+#: (docs/automatic-ofed-upgrade.md:85 marks it outdated). Transitions
+#: mirror upgrade_state.go (SURVEY.md §1 diagram).
+STATE_EDGES: tuple[tuple[UpgradeState, UpgradeState, str], ...] = (
+    (UpgradeState.UNKNOWN, UpgradeState.DONE,
+     "runtime pod in sync with DaemonSet"),
+    (UpgradeState.UNKNOWN, UpgradeState.UPGRADE_REQUIRED,
+     "pod outdated | safe-load wait | upgrade-requested"),
+    (UpgradeState.DONE, UpgradeState.UPGRADE_REQUIRED,
+     "new DS revision | safe-load wait | upgrade-requested"),
+    (UpgradeState.UPGRADE_REQUIRED, UpgradeState.CORDON_REQUIRED,
+     "slot available (throttle + slice planner)"),
+    (UpgradeState.CORDON_REQUIRED, UpgradeState.WAIT_FOR_JOBS_REQUIRED,
+     "cordoned"),
+    (UpgradeState.WAIT_FOR_JOBS_REQUIRED, UpgradeState.POD_DELETION_REQUIRED,
+     "jobs done | timeout (pod deletion enabled)"),
+    (UpgradeState.WAIT_FOR_JOBS_REQUIRED, UpgradeState.DRAIN_REQUIRED,
+     "jobs done | timeout (pod deletion disabled)"),
+    (UpgradeState.POD_DELETION_REQUIRED, UpgradeState.POD_RESTART_REQUIRED,
+     "filtered pods evicted (checkpoint gate passed)"),
+    (UpgradeState.POD_DELETION_REQUIRED, UpgradeState.DRAIN_REQUIRED,
+     "eviction failed, drain enabled"),
+    (UpgradeState.POD_DELETION_REQUIRED, UpgradeState.FAILED,
+     "eviction failed, drain disabled"),
+    (UpgradeState.DRAIN_REQUIRED, UpgradeState.POD_RESTART_REQUIRED,
+     "drain succeeded"),
+    (UpgradeState.DRAIN_REQUIRED, UpgradeState.FAILED, "drain failed"),
+    (UpgradeState.POD_RESTART_REQUIRED, UpgradeState.VALIDATION_REQUIRED,
+     "new pod in sync & ready (validation enabled)"),
+    (UpgradeState.POD_RESTART_REQUIRED, UpgradeState.UNCORDON_REQUIRED,
+     "new pod in sync & ready (was schedulable)"),
+    (UpgradeState.POD_RESTART_REQUIRED, UpgradeState.DONE,
+     "new pod in sync & ready (was cordoned before upgrade)"),
+    (UpgradeState.POD_RESTART_REQUIRED, UpgradeState.FAILED,
+     "pod crash-looping (>10 restarts)"),
+    (UpgradeState.VALIDATION_REQUIRED, UpgradeState.UNCORDON_REQUIRED,
+     "validation passed (was schedulable)"),
+    (UpgradeState.VALIDATION_REQUIRED, UpgradeState.DONE,
+     "validation passed (was cordoned before upgrade)"),
+    (UpgradeState.VALIDATION_REQUIRED, UpgradeState.FAILED,
+     "600 s validation timeout"),
+    (UpgradeState.UNCORDON_REQUIRED, UpgradeState.DONE, "uncordoned"),
+    (UpgradeState.FAILED, UpgradeState.UNCORDON_REQUIRED,
+     "pod healthy again [validated] (was schedulable)"),
+    (UpgradeState.FAILED, UpgradeState.DONE,
+     "pod healthy again [validated] (was cordoned before upgrade)"),
+)
+
+#: Adjacency view of STATE_EDGES, keyed by label value ("" = unknown).
+LEGAL_EDGES: dict[str, frozenset[str]] = {
+    src: frozenset(d.value for s, d, _ in STATE_EDGES if s.value == src)
+    for src in {s.value for s, _, _ in STATE_EDGES}
+}
+
 #: Label key whose presence identifies a TPU node on GKE.
 TPU_RESOURCE_NAME = "google.com/tpu"
 

@@ -755,8 +755,21 @@ class ClusterUpgradeStateManager:
                 # restarted runtime pod between deletion and recreation;
                 # nothing more to do until the controller catches up
                 return last_state
+            # The fingerprint must cover EVERY durable bit a pass can
+            # write, not just the state label: a pass that only consumes
+            # an annotation (upgrade-requested, safe-load, wait-start
+            # stamps) or only flips unschedulable would otherwise look
+            # like quiescence and end the chain one transition early.
+            # Today every such path also moves a label, but that is an
+            # accident of the current graph — this makes it structural.
+            annotation_prefix = f"{self.keys.domain}/{self.keys.driver}-"
             new_fingerprint = tuple(sorted(
-                (ns.node.metadata.name, label)
+                (ns.node.metadata.name, label,
+                 ns.node.is_unschedulable(),
+                 tuple(sorted(
+                     (key, value) for key, value
+                     in ns.node.metadata.annotations.items()
+                     if key.startswith(annotation_prefix))))
                 for label, bucket in state.node_states.items()
                 for ns in bucket))
             if new_fingerprint == fingerprint:
