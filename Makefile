@@ -34,6 +34,19 @@ lint:
 	elif $(PYTHON) -c "import pyflakes" 2>/dev/null; then \
 		$(PYTHON) -m pyflakes tpu_operator_libs tools tests examples; \
 	fi
+	@if $(PYTHON) -c "import mypy" 2>/dev/null; then \
+		$(MAKE) typecheck; \
+	else \
+		echo "mypy unavailable in this environment -- type checking" \
+		     "SKIPPED here; the CI typecheck job enforces it"; \
+	fi
+
+# Strict static types on the library package (config: [tool.mypy] in
+# pyproject.toml). Fails when mypy is missing — lint's conditional wraps
+# it for environments without mypy.
+.PHONY: typecheck
+typecheck:
+	$(PYTHON) -m mypy --strict tpu_operator_libs
 
 # Line coverage with a hard gate (reference: Coveralls upload,
 # ci.yaml:45-64). Built on sys.monitoring — no external deps.

@@ -331,10 +331,17 @@ def _sidecar_lock():
             yield None
             return
         try:
-            fcntl.flock(fh, fcntl.LOCK_EX)
+            try:
+                fcntl.flock(fh, fcntl.LOCK_EX)
+            except OSError:
+                # flock(2) unsupported on this filesystem (some NFS /
+                # container volumes): degrade to lockless rather than
+                # failing the bench
+                yield None
+                return
             yield None
         finally:
-            fh.close()  # releases the flock
+            fh.close()  # releases the flock when it was taken
 
     return locked()
 

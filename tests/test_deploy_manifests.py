@@ -314,7 +314,8 @@ class TestSafeLoadAdmissionPolicy:
     def test_covers_labels_spec_annotations_and_node_identity(self, policy):
         messages = " ".join(v["message"]
                             for v in policy["spec"]["validations"])
-        for surface in ("labels", "spec", "annotation", "own node"):
+        for surface in ("labels", "spec", "annotation", "own node",
+                        "finalizers", "owner"):
             assert surface in messages, f"no validation for {surface}"
 
     def test_applies_to_node_updates(self, policy):

@@ -254,6 +254,71 @@ class TestSuppression:
         assert codes("def f(:\n") == ["E999"]
 
 
+class TestAnnotationRules:
+    """ANN001/ANN201: full annotation coverage of the library package's
+    public API — the always-available local floor under CI's
+    mypy --strict job (round-3 typing work)."""
+
+    LIB = "tpu_operator_libs/upgrade/thing.py"
+
+    def lib_codes(self, source):
+        return [f.code for f in check_source(source, self.LIB)]
+
+    def test_unannotated_param_flagged(self):
+        assert "ANN001" in self.lib_codes("def f(x) -> None: ...\n")
+
+    def test_missing_return_flagged(self):
+        assert "ANN201" in self.lib_codes("def f(x: int): ...\n")
+
+    def test_fully_annotated_clean(self):
+        assert self.lib_codes("def f(x: int) -> int:\n    return x\n") == []
+
+    def test_private_functions_exempt(self):
+        assert self.lib_codes("def _f(x): ...\n") == []
+
+    def test_nested_functions_exempt(self):
+        src = ("def outer() -> None:\n"
+               "    def inner(x):\n"
+               "        return x\n"
+               "    inner(1)\n")
+        assert self.lib_codes(src) == []
+
+    def test_self_and_cls_exempt(self):
+        src = ("class C:\n"
+               "    def m(self, x: int) -> int:\n"
+               "        return x\n"
+               "    @classmethod\n"
+               "    def n(cls) -> None: ...\n")
+        assert self.lib_codes(src) == []
+
+    def test_init_return_exempt_but_params_required(self):
+        clean = ("class C:\n"
+                 "    def __init__(self, x: int):\n"
+                 "        self.x = x\n")
+        assert self.lib_codes(clean) == []
+        dirty = ("class C:\n"
+                 "    def __init__(self, x):\n"
+                 "        self.x = x\n")
+        assert "ANN001" in self.lib_codes(dirty)
+
+    def test_vararg_and_kwarg_require_annotations(self):
+        assert "ANN001" in self.lib_codes("def f(*a) -> None: ...\n")
+        assert "ANN001" in self.lib_codes("def f(**k) -> None: ...\n")
+
+    def test_outside_library_exempt(self):
+        assert [f.code for f in check_source("def f(x): ...\n",
+                                             "tests/test_x.py")] == []
+
+    def test_examples_exempt(self):
+        path = "tpu_operator_libs/examples/demo.py"
+        assert [f.code for f in check_source("def f(x): ...\n",
+                                             path)] == []
+
+    def test_noqa_suppresses(self):
+        assert self.lib_codes("def f(x):  # noqa: ANN001, ANN201\n"
+                              "    return x\n") == []
+
+
 class TestCli:
     def test_library_lints_clean(self):
         # the product code must stay lint-clean — narrowed to the

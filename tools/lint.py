@@ -171,6 +171,40 @@ class _Binder(ast.NodeVisitor):
             if arg.annotation is not None:
                 self._eval_annotation(arg.annotation)
 
+    def _check_public_annotations(self, node) -> None:
+        """ANN001/ANN201: the library package's public API must be fully
+        annotated (the local floor under the CI mypy --strict job, which
+        this environment cannot always run). Applies to module/class-level
+        defs not starting with '_' in tpu_operator_libs/ (examples
+        excluded — they are consumer-facing scripts, not API)."""
+        posix = str(self.c.path).replace("\\", "/")
+        if ("tpu_operator_libs/" not in posix
+                or "tpu_operator_libs/examples/" in posix):
+            return
+        kind = self.c.stack[-1].kind
+        is_dunder = (node.name.startswith("__")
+                     and node.name.endswith("__"))
+        if kind not in ("module", "class") or (
+                node.name.startswith("_") and not is_dunder):
+            return
+        args = [*node.args.posonlyargs, *node.args.args,
+                *node.args.kwonlyargs]
+        if kind == "class" and args and args[0].arg in ("self", "cls"):
+            args = args[1:]
+        if node.args.vararg:
+            args.append(node.args.vararg)
+        if node.args.kwarg:
+            args.append(node.args.kwarg)
+        for arg in args:
+            if arg.annotation is None:
+                self.c.report(node, "ANN001",
+                              f"public function {node.name!r}: parameter "
+                              f"{arg.arg!r} lacks a type annotation")
+        if node.returns is None and node.name != "__init__":
+            self.c.report(node, "ANN201",
+                          f"public function {node.name!r} lacks a return "
+                          "type annotation")
+
     def _eval_annotation(self, node: ast.AST) -> None:
         # annotations are uses (they keep typing imports alive); a quoted
         # forward reference is parsed and its names count too
@@ -198,6 +232,7 @@ class _Binder(ast.NodeVisitor):
             self.c.report(node, "F811",
                           f"redefinition of {node.name!r} "
                           f"(first defined at line {prev.lineno})")
+        self._check_public_annotations(node)
         self._bind(node.name, node)
         for deco in node.decorator_list:
             self.visit(deco)

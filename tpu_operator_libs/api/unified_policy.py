@@ -27,7 +27,10 @@ policy document:
 from __future__ import annotations
 
 from dataclasses import dataclass, field
-from typing import Any, Optional
+from typing import TYPE_CHECKING, Any, Callable, Optional
+
+if TYPE_CHECKING:  # pragma: no cover - types only
+    from tpu_operator_libs.k8s.client import K8sClient
 
 from tpu_operator_libs.api.upgrade_policy import (
     PolicyValidationError,
@@ -115,8 +118,10 @@ class MultiAcceleratorUpgradeManager:
     one policy document.
     """
 
-    def __init__(self, client, unified_policy: UnifiedUpgradePolicySpec,
-                 manager_factory=None, **manager_kwargs) -> None:
+    def __init__(self, client: "K8sClient",
+                 unified_policy: UnifiedUpgradePolicySpec,
+                 manager_factory: Optional[Callable[..., Any]] = None,
+                 **manager_kwargs: Any) -> None:
         from tpu_operator_libs.upgrade.state_manager import (
             ClusterUpgradeStateManager,
         )

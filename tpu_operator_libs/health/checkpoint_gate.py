@@ -30,7 +30,10 @@ import os
 import re
 import time
 from dataclasses import dataclass
-from typing import Optional
+from typing import TYPE_CHECKING, Optional
+
+if TYPE_CHECKING:  # pragma: no cover - types only
+    from tpu_operator_libs.k8s.objects import Node, Pod
 
 logger = logging.getLogger(__name__)
 
@@ -147,5 +150,6 @@ class CheckpointDurabilityGate:
             return None
         return None
 
-    def __call__(self, node, pods) -> bool:  # PodManager eviction_gate
+    def __call__(self, node: "Node",
+                 pods: "list[Pod]") -> bool:  # PodManager eviction_gate
         return self.check()

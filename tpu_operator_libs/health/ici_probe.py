@@ -26,9 +26,15 @@ from __future__ import annotations
 import logging
 import time
 from dataclasses import dataclass
-from typing import Optional
+from typing import TYPE_CHECKING, Any, Callable, Optional
 
 import numpy as np
+
+if TYPE_CHECKING:  # pragma: no cover - types only; jax stays lazy
+    import jax
+
+    from tpu_operator_libs.k8s.objects import Node
+    from tpu_operator_libs.util import Clock
 
 logger = logging.getLogger(__name__)
 
@@ -38,7 +44,7 @@ _TILE = 128
 _AXIS = "ici"
 
 
-def make_mesh(n_devices: Optional[int] = None):
+def make_mesh(n_devices: Optional[int] = None) -> "jax.sharding.Mesh":
     """A 1-D mesh over the first ``n_devices`` local devices (the ICI
     domain of the local slice)."""
     import jax
@@ -105,7 +111,8 @@ def _probe_fn(axis_size: int):
     return body
 
 
-def fabric_probe(mesh=None, n_devices: Optional[int] = None,
+def fabric_probe(mesh: Optional["jax.sharding.Mesh"] = None,
+                 n_devices: Optional[int] = None,
                  tolerance: float = 1e-3) -> FabricProbeResult:
     """Run the fabric probe over ``mesh`` (default: all local devices).
 
@@ -171,7 +178,8 @@ class BandwidthProbeResult:
                 f"{self.latency_s * 1e3:.1f} ms)")
 
 
-def fabric_bandwidth_probe(mesh=None, n_devices: Optional[int] = None,
+def fabric_bandwidth_probe(mesh: Optional["jax.sharding.Mesh"] = None,
+                           n_devices: Optional[int] = None,
                            payload_mib: int = 16, rounds: int = 8,
                            min_gbytes_per_s: Optional[float] = None,
                            ) -> BandwidthProbeResult:
@@ -241,7 +249,8 @@ def fabric_bandwidth_probe(mesh=None, n_devices: Optional[int] = None,
     return result
 
 
-def single_chip_probe():
+def single_chip_probe() -> tuple[Callable[[Any, Any], Any],
+                                 tuple[Any, Any]]:
     """(fn, example_args) for the single-device probe step — the jittable
     forward step exposed through ``__graft_entry__.entry()``.
 
@@ -402,8 +411,11 @@ class ICIFabricValidator:
     the flat probe.
     """
 
-    def __init__(self, probe_runner=None, cache_seconds: float = 300.0,
-                 clock=None, tolerance: float = 1e-3,
+    def __init__(self,
+                 probe_runner: Optional[Callable[..., Any]] = None,
+                 cache_seconds: float = 300.0,
+                 clock: Optional["Clock"] = None,
+                 tolerance: float = 1e-3,
                  min_bandwidth_gbytes_per_s: Optional[float] = None) -> None:
         from tpu_operator_libs.util import Clock
 
@@ -474,7 +486,7 @@ class ICIFabricValidator:
                         min_gbytes_per_s=self._min_bandwidth).healthy
         return healthy
 
-    def __call__(self, node) -> bool:
+    def __call__(self, node: "Node") -> bool:
         now = self._clock.now()
         key = self._cache_key(node)
         cached = self._cached.get(key)

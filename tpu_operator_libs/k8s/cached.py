@@ -30,7 +30,7 @@ from __future__ import annotations
 import logging
 import threading
 import time
-from typing import Mapping, Optional
+from typing import Callable, Mapping, Optional
 
 from tpu_operator_libs.k8s.client import K8sClient, NotFoundError
 from tpu_operator_libs.k8s.objects import (
@@ -47,6 +47,7 @@ from tpu_operator_libs.k8s.watch import (
     KIND_DAEMON_SET,
     KIND_NODE,
     KIND_POD,
+    Watch,
 )
 
 
@@ -127,7 +128,8 @@ class CachedReadClient(K8sClient):
         for informer in self._informers:
             informer.refresh()
 
-    def add_event_handler(self, on_change) -> None:
+    def add_event_handler(
+            self, on_change: Callable[[object], None]) -> None:
         """``on_change(obj)`` after any add/update/delete is APPLIED to a
         cache. Wiring reconcile triggers here (rather than to a raw
         watch) guarantees a triggered reconcile reads a cache that
@@ -223,5 +225,6 @@ class CachedReadClient(K8sClient):
         self._delegate.evict_pod(namespace, name)
 
     # -- watches ----------------------------------------------------------
-    def watch(self, kinds=None, namespace: Optional[str] = None):
+    def watch(self, kinds: Optional[set[str]] = None,
+              namespace: Optional[str] = None) -> Watch:
         return self._delegate.watch(kinds=kinds, namespace=namespace)

@@ -17,7 +17,10 @@ patches (node_upgrade_state_provider.go:80-82,147-151).
 from __future__ import annotations
 
 import abc
-from typing import Mapping, Optional
+from typing import TYPE_CHECKING, Mapping, Optional
+
+if TYPE_CHECKING:  # pragma: no cover - import cycle guard, types only
+    from tpu_operator_libs.k8s.watch import Watch
 
 from tpu_operator_libs.k8s.objects import (
     ControllerRevision,
@@ -75,7 +78,8 @@ class K8sClient(abc.ABC):
         EvictionBlockedError when a disruption budget forbids it."""
 
     # -- watches ----------------------------------------------------------
-    def watch(self, kinds=None, namespace: Optional[str] = None):
+    def watch(self, kinds: Optional[set[str]] = None,
+              namespace: Optional[str] = None) -> "Watch":
         """Stream change events (k8s.watch.WatchEvent) for Nodes / Pods /
         DaemonSets, optionally filtered by kind set and (for namespaced
         kinds) namespace. Returns a k8s.watch.Watch. Optional capability:

@@ -133,7 +133,11 @@ def _revision_from(obj) -> ControllerRevision:
 class RealCluster(K8sClient):
     """K8sClient against a live API server."""
 
-    def __init__(self, api_client=None) -> None:
+    def __init__(self, api_client: Optional[object] = None) -> None:
+        # api_client: an optional kubernetes.client.ApiClient;
+        # typed as object because the kubernetes package is an
+        # import-gated optional dependency
+
         k8s = _require_kubernetes()
         self._core = k8s.CoreV1Api(api_client)
         self._apps = k8s.AppsV1Api(api_client)

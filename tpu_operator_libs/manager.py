@@ -25,7 +25,7 @@ from __future__ import annotations
 
 import logging
 import threading
-from typing import Callable, Optional
+from typing import TYPE_CHECKING, Callable, Optional
 
 from tpu_operator_libs.controller import (
     Controller,
@@ -33,6 +33,13 @@ from tpu_operator_libs.controller import (
     ReconcileResult,
 )
 from tpu_operator_libs.k8s.client import K8sClient
+
+if TYPE_CHECKING:  # pragma: no cover - types only
+    from tpu_operator_libs.k8s.leaderelection import (
+        LeaderElectionConfig,
+    )
+    from tpu_operator_libs.metrics import MetricsRegistry
+    from tpu_operator_libs.util import Clock
 
 logger = logging.getLogger(__name__)
 
@@ -66,9 +73,10 @@ class OperatorManager:
                  cache_sync_timeout: float = 60.0,
                  resync_period: Optional[float] = 300.0,
                  workers: int = 1,
-                 leader_election=None,
-                 leader_election_clock=None,
-                 metrics=None,
+                 leader_election: Optional[
+                     "LeaderElectionConfig"] = None,
+                 leader_election_clock: Optional["Clock"] = None,
+                 metrics: Optional["MetricsRegistry"] = None,
                  rate_limiter: Optional[ExponentialBackoffRateLimiter] = None,
                  ) -> None:
         self._raw_client = client

@@ -16,10 +16,16 @@ from __future__ import annotations
 
 import threading
 from dataclasses import dataclass, field
-from typing import Optional
+from typing import TYPE_CHECKING, Optional
 
 from tpu_operator_libs.consts import ALL_STATES
 from tpu_operator_libs.topology.slice_topology import SliceTopology
+
+if TYPE_CHECKING:  # pragma: no cover - types only (import cycle guard)
+    from tpu_operator_libs.upgrade.state_manager import (
+        ClusterUpgradeState,
+        ClusterUpgradeStateManager,
+    )
 
 
 @dataclass
@@ -167,8 +173,10 @@ class MetricsRegistry:
         return "\n".join(lines) + "\n"
 
 
-def observe_cluster_state(registry: MetricsRegistry, manager,
-                          state, driver: str = "libtpu") -> None:
+def observe_cluster_state(registry: MetricsRegistry,
+                          manager: "ClusterUpgradeStateManager",
+                          state: "ClusterUpgradeState",
+                          driver: str = "libtpu") -> None:
     """Record the fleet gauges for one reconcile pass.
 
     ``manager`` is a ClusterUpgradeStateManager, ``state`` the snapshot it

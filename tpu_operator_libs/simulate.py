@@ -24,6 +24,7 @@ from typing import Optional
 
 from tpu_operator_libs.api.upgrade_policy import (
     DrainSpec,
+    IntOrString,
     UpgradePolicySpec,
 )
 from tpu_operator_libs.consts import (
@@ -295,7 +296,7 @@ def _schedule_faults(cluster: FakeCluster, spec: FleetSpec) -> None:
 def simulate_rolling_upgrade(
         topology_mode: str = "slice",
         fleet: Optional[FleetSpec] = None,
-        max_unavailable="25%",
+        max_unavailable: Optional[IntOrString] = "25%",
         max_parallel_upgrades: int = 0,
         reconcile_interval: float = 10.0,
         max_sim_seconds: float = 24 * 3600.0,

@@ -27,9 +27,12 @@ map (and the planner holding it) to live across reconciles.
 
 from __future__ import annotations
 
-from typing import Callable, Iterable, Optional
+from typing import TYPE_CHECKING, Callable, Iterable, Optional
 
 from tpu_operator_libs.k8s.objects import Node, Pod
+
+if TYPE_CHECKING:  # pragma: no cover - types only
+    from tpu_operator_libs.k8s.client import K8sClient
 from tpu_operator_libs.topology.slice_topology import slice_id_for_node
 
 #: Default pod label keys identifying the multislice job a pod belongs
@@ -41,7 +44,7 @@ DEFAULT_JOB_LABEL_KEYS: tuple[str, ...] = (
 JobId = tuple[str, str]  # (namespace, job name)
 
 
-def default_workload_pods(client,
+def default_workload_pods(client: "K8sClient",
                           keys: Iterable[str] = DEFAULT_JOB_LABEL_KEYS
                           ) -> Callable[[], list[Pod]]:
     """A workload-pod source that lists only pods carrying one of the

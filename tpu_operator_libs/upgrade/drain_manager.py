@@ -22,6 +22,7 @@ from tpu_operator_libs.k8s.client import (
 )
 from tpu_operator_libs.k8s.drain import DrainHelper, run_cordon_or_uncordon
 from tpu_operator_libs.k8s.objects import Node
+from tpu_operator_libs.upgrade.gate import EvictionGate
 from tpu_operator_libs.upgrade.state_provider import NodeUpgradeStateProvider
 from tpu_operator_libs.util import (
     Clock,
@@ -49,7 +50,7 @@ class DrainManager:
                  recorder: Optional[EventRecorder] = None,
                  clock: Optional[Clock] = None,
                  worker: Optional[Worker] = None,
-                 eviction_gate=None) -> None:
+                 eviction_gate: Optional[EvictionGate] = None) -> None:
         self._client = client
         self._provider = provider
         self._recorder = recorder
@@ -67,10 +68,10 @@ class DrainManager:
         self._keys = provider.keys
 
     @property
-    def eviction_gate(self):
+    def eviction_gate(self) -> Optional["EvictionGate"]:
         return self._gatekeeper.gate
 
-    def set_eviction_gate(self, gate) -> None:
+    def set_eviction_gate(self, gate: Optional["EvictionGate"]) -> None:
         self._gatekeeper.set_gate(gate)
 
     def schedule_nodes_drain(self, config: DrainConfiguration) -> None:

@@ -13,8 +13,10 @@ from __future__ import annotations
 from dataclasses import dataclass
 from typing import Optional
 
-from tpu_operator_libs.consts import NULL_STRING, UpgradeKeys
-from tpu_operator_libs.k8s.objects import Node
+from tpu_operator_libs.consts import NULL_STRING, UpgradeKeys, UpgradeState
+from tpu_operator_libs.k8s.objects import DaemonSet, Node, Pod
+from tpu_operator_libs.upgrade.drain_manager import DrainConfiguration
+from tpu_operator_libs.upgrade.pod_manager import PodManagerConfig
 
 
 @dataclass
@@ -30,7 +32,7 @@ class RecordingMixin:
     def __init__(self) -> None:
         self.calls: list[Call] = []
 
-    def record(self, method: str, *args) -> None:
+    def record(self, method: str, *args: object) -> None:
         self.calls.append(Call(method, args))
 
     def calls_to(self, method: str) -> list[Call]:
@@ -55,14 +57,15 @@ class MockNodeUpgradeStateProvider(RecordingMixin):
             "MockNodeUpgradeStateProvider has no store; tests build "
             "snapshots directly")
 
-    def change_node_upgrade_state(self, node: Node, new_state) -> None:
+    def change_node_upgrade_state(self, node: Node,
+                                  new_state: UpgradeState | str) -> None:
         self.record("change_node_upgrade_state", node.metadata.name,
                     str(new_state))
         self._maybe_fail()
         node.metadata.labels[self.keys.state_label] = str(new_state)
 
     def change_node_upgrade_annotation(self, node: Node, key: str,
-                                       value) -> None:
+                                       value: Optional[str]) -> None:
         self.record("change_node_upgrade_annotation", node.metadata.name,
                     key, value)
         self._maybe_fail()
@@ -97,7 +100,7 @@ class MockDrainManager(RecordingMixin):
         super().__init__()
         self.fail_next: Optional[Exception] = None
 
-    def schedule_nodes_drain(self, config) -> None:
+    def schedule_nodes_drain(self, config: DrainConfiguration) -> None:
         self.record("schedule_nodes_drain",
                     tuple(n.metadata.name for n in config.nodes))
         if self.fail_next is not None:
@@ -118,22 +121,23 @@ class MockPodManager(RecordingMixin):
         self.ds_hashes: dict[str, str] = {}
         self.default_hash = "test-hash-12345"
 
-    def get_pod_revision_hash(self, pod) -> str:
+    def get_pod_revision_hash(self, pod: Pod) -> str:
         self.record("get_pod_revision_hash", pod.name)
         return self.pod_hashes.get(pod.name, self.default_hash)
 
-    def get_daemon_set_revision_hash(self, ds) -> str:
+    def get_daemon_set_revision_hash(self, ds: DaemonSet) -> str:
         self.record("get_daemon_set_revision_hash", ds.name)
         return self.ds_hashes.get(ds.name, self.default_hash)
 
-    def schedule_pod_eviction(self, config) -> None:
+    def schedule_pod_eviction(self, config: PodManagerConfig) -> None:
         self.record("schedule_pod_eviction",
                     tuple(n.metadata.name for n in config.nodes))
 
-    def schedule_pods_restart(self, pods) -> None:
+    def schedule_pods_restart(self, pods: list[Pod]) -> None:
         self.record("schedule_pods_restart", tuple(p.name for p in pods))
 
-    def schedule_check_on_pod_completion(self, config) -> None:
+    def schedule_check_on_pod_completion(
+            self, config: PodManagerConfig) -> None:
         self.record("schedule_check_on_pod_completion",
                     tuple(n.metadata.name for n in config.nodes))
 

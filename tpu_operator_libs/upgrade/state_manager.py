@@ -45,7 +45,10 @@ if TYPE_CHECKING:  # pragma: no cover - import cycle guard (topology
     from tpu_operator_libs.topology.multislice import MultisliceConstraint
 
 from tpu_operator_libs.api.upgrade_policy import (
+    DrainSpec,
+    PodDeletionSpec,
     UpgradePolicySpec,
+    WaitForCompletionSpec,
     scaled_value_from_int_or_percent,
 )
 from tpu_operator_libs.consts import (
@@ -63,6 +66,7 @@ from tpu_operator_libs.upgrade.drain_manager import (
     DrainConfiguration,
     DrainManager,
 )
+from tpu_operator_libs.upgrade.gate import EvictionGate
 from tpu_operator_libs.upgrade.pod_manager import (
     PodDeletionFilter,
     PodManager,
@@ -207,7 +211,7 @@ class ClusterUpgradeStateManager:
     # ------------------------------------------------------------------
     def with_pod_deletion_enabled(
             self, deletion_filter: PodDeletionFilter,
-            eviction_gate=None,
+            eviction_gate: Optional[EvictionGate] = None,
     ) -> "ClusterUpgradeStateManager":
         if deletion_filter is None:
             logger.warning("cannot enable pod deletion: filter is None")
@@ -227,7 +231,9 @@ class ClusterUpgradeStateManager:
         self._pod_deletion_enabled = True
         return self
 
-    def with_eviction_gate(self, gate) -> "ClusterUpgradeStateManager":
+    def with_eviction_gate(
+            self, gate: Optional[EvictionGate],
+    ) -> "ClusterUpgradeStateManager":
         """Install an eviction gate on both the pod-deletion and drain
         paths without enabling the pod-deletion state."""
         self.pod_manager.set_eviction_gate(gate)
@@ -452,7 +458,7 @@ class ClusterUpgradeStateManager:
 
     def process_wait_for_jobs_required_nodes(
             self, state: ClusterUpgradeState,
-            wait_spec) -> None:
+            wait_spec: Optional[WaitForCompletionSpec]) -> None:
         """Wait for workload completion or skip straight on when no
         selector is configured (upgrade_state.go:658-693)."""
         nodes = [ns.node for ns in
@@ -475,7 +481,8 @@ class ClusterUpgradeStateManager:
             nodes=nodes, wait_for_completion_spec=wait_spec))
 
     def process_pod_deletion_required_nodes(
-            self, state: ClusterUpgradeState, deletion_spec,
+            self, state: ClusterUpgradeState,
+            deletion_spec: Optional[PodDeletionSpec],
             drain_enabled: bool) -> None:
         """Evict filter-selected workload pods (upgrade_state.go:698-727)."""
         nodes = [ns.node for ns in
@@ -497,7 +504,7 @@ class ClusterUpgradeStateManager:
             drain_enabled=drain_enabled))
 
     def process_drain_nodes(self, state: ClusterUpgradeState,
-                            drain_spec) -> None:
+                            drain_spec: Optional[DrainSpec]) -> None:
         """Schedule async drains, or skip the stage when disabled
         (upgrade_state.go:731-760)."""
         nodes = [ns.node for ns in state.bucket(UpgradeState.DRAIN_REQUIRED)]
