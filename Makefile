@@ -41,12 +41,14 @@ lint:
 		     "SKIPPED here; the CI typecheck job enforces it"; \
 	fi
 
-# Strict static types on the library package (config: [tool.mypy] in
-# pyproject.toml). Fails when mypy is missing — lint's conditional wraps
-# it for environments without mypy.
+# Static types on the library package. The profile lives ONLY in
+# pyproject.toml's [tool.mypy] (strict with targeted relaxations) —
+# passing --strict here would re-enable the relaxed flags, because mypy
+# gives CLI flags precedence over config. Fails when mypy is missing —
+# lint's conditional wraps it for environments without mypy.
 .PHONY: typecheck
 typecheck:
-	$(PYTHON) -m mypy --strict tpu_operator_libs
+	$(PYTHON) -m mypy tpu_operator_libs
 
 # Line coverage with a hard gate (reference: Coveralls upload,
 # ci.yaml:45-64). Built on sys.monitoring — no external deps.

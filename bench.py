@@ -39,8 +39,12 @@ from typing import Optional
 
 from tpu_operator_libs.simulate import FleetSpec, simulate_rolling_upgrade
 
-SIDECAR = os.path.join(os.path.dirname(os.path.abspath(__file__)),
-                       "BENCH_HW.json")
+# BENCH_HW_SIDECAR overrides the sidecar path so tests (and parallel
+# scratch runs) never pollute the repo's real last-good/attempt history.
+SIDECAR = os.environ.get(
+    "BENCH_HW_SIDECAR",
+    os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                 "BENCH_HW.json"))
 
 
 #: Seeded per-node delay jitter for the headline matrix: drains get a
@@ -170,29 +174,37 @@ try:
             # throughput only means something on a correct fabric
             bandwidth = fabric_bandwidth_probe(n_devices=n).gbytes_per_s
 
-    # MXU throughput: chained bf16 matmuls inside ONE jit so dispatch
-    # overhead cannot hide the systolic array. y ~ 1/K keeps values ~1.
-    M = K = N = 4096
-    CHAIN = 8
-    x = jnp.ones((M, K), jnp.bfloat16)
-    y = jnp.full((K, N), 1.0 / K, jnp.bfloat16)
+    # MXU throughput: a long on-device bf16 matmul chain (lax.fori_loop
+    # inside ONE jit) reduced to a scalar that is read back on the host.
+    # The scalar readback is the timing fence — on tunneled/async PJRT
+    # platforms block_until_ready() can return before the device work
+    # completes, which both inflates and deflates naive timings; a value
+    # materialized on the host cannot lie. The 256-deep chain amortizes
+    # the per-call dispatch + readback overhead to <5%. y ~ 1/K keeps
+    # values ~1 so bf16 never saturates.
+    from jax import lax
 
-    def chain(a, b):
-        out = a
-        for _ in range(CHAIN):
-            out = out @ b
-        return out
+    M = 8192
+    CHAIN = 256
+    y = jnp.full((M, M), 1.0 / M, jnp.bfloat16)
 
-    fn = jax.jit(chain)
-    fn(x, y).block_until_ready()  # compile
-    iters = 8
-    t0 = time.perf_counter()
-    for _ in range(iters):
-        out = fn(x, y)
-    out.block_until_ready()
-    dt = time.perf_counter() - t0
-    flops = 2.0 * M * K * N * CHAIN * iters
-    tflops = flops / dt / 1e12
+    def chain_fn(a, b):
+        out = lax.fori_loop(0, CHAIN, lambda i, o: o @ b, a)
+        return jnp.sum(out.astype(jnp.float32))
+
+    fn = jax.jit(chain_fn)
+    float(fn(jnp.ones((M, M), jnp.bfloat16), y))  # compile + warm
+    best = None
+    for rep in range(3):
+        # distinct inputs per rep so no caching layer can serve a
+        # repeat; rep/64 is exactly representable in bf16 (8-bit
+        # mantissa), unlike 1e-3 steps which would all round to 1.0
+        x = jnp.full((M, M), 1.0 + rep / 64.0, jnp.bfloat16)
+        t0 = time.perf_counter()
+        float(fn(x, y))  # host readback = completion fence
+        dt = time.perf_counter() - t0
+        best = dt if best is None else min(best, dt)
+    tflops = 2.0 * M * M * M * CHAIN / best / 1e12
     print(json.dumps({
         "probe_ms": probe_ms, "bandwidth": bandwidth,
         "tflops": round(tflops, 1), "device_kind": device_kind,

@@ -74,7 +74,7 @@ class TestGraftEntry:
 
         graft.dryrun_multichip(8)  # raises on any failure
 
-    def test_bench_prints_one_json_line(self):
+    def test_bench_prints_one_json_line(self, tmp_path):
         import os
 
         env = dict(os.environ)
@@ -82,6 +82,10 @@ class TestGraftEntry:
         # accelerator tunnel is absent or wedged; null probe fields are
         # the expected degradation
         env["BENCH_PROBE_TIMEOUT"] = "10"
+        env["BENCH_PROBE_ATTEMPTS"] = "1"
+        # isolate the sidecar: the suite must never write failed-attempt
+        # entries (or cheap successes) into the repo's real history
+        env["BENCH_HW_SIDECAR"] = str(tmp_path / "BENCH_HW.json")
         proc = subprocess.run(
             [sys.executable, "bench.py"], capture_output=True, text=True,
             timeout=300, env=env)

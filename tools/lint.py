@@ -177,9 +177,13 @@ class _Binder(ast.NodeVisitor):
         this environment cannot always run). Applies to module/class-level
         defs not starting with '_' in tpu_operator_libs/ (examples
         excluded — they are consumer-facing scripts, not API)."""
-        posix = str(self.c.path).replace("\\", "/")
-        if ("tpu_operator_libs/" not in posix
-                or "tpu_operator_libs/examples/" in posix):
+        # Path-component match, not substring: a checkout cloned AS
+        # "tpu_operator_libs" would otherwise pull tests/ and tools/
+        # under the rule via their absolute-path prefix. tests/ and
+        # examples/ components are exempt wherever they appear.
+        parts = Path(str(self.c.path)).parts
+        if ("tpu_operator_libs" not in parts
+                or "examples" in parts or "tests" in parts):
             return
         kind = self.c.stack[-1].kind
         is_dunder = (node.name.startswith("__")
