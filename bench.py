@@ -457,7 +457,14 @@ def _reconcile_latency_cells(passes: int = 9) -> dict:
     """Control-plane scale evidence: p50/p95 real-time ms per
     build_state+apply_state pass, flat vs slice planner, at 256 (64x4)
     and 1024 (64x16) nodes, each fleet mid-upgrade (every state bucket
-    busy)."""
+    busy).
+
+    Interpretation: p50 scales ~linearly with fleet size (snapshot +
+    bucket walk). p95 captures the "wave" pass where maxUnavailable
+    worth of nodes (256 at 1024 nodes / 25%) transition in one pass —
+    cost is O(wave size) node-label writes, the same writes a real
+    apiserver would absorb as PATCHes; profiling shows no superlinear
+    hot spot (clone-on-read value semantics of the fake dominates)."""
     cells: dict = {}
     for n_slices, hosts in ((64, 4), (64, 16)):
         label = f"{n_slices * hosts}_nodes"
