@@ -244,3 +244,66 @@ class TestDrainManager:
         remaining = [p.name for p in env.cluster.list_pods()]
         assert remaining == ["runtime"]
         assert env.state_of("n1") == "pod-restart-required"
+
+
+class TestDrainManagerErrorPaths:
+    """The worker's failure taxonomy: transient errors park the node in
+    drain-required for retry, non-transient errors commit upgrade-failed,
+    and the gate's own failures only defer (GateKeeper semantics)."""
+
+    def _env(self):
+        env = make_env()
+        node = NodeBuilder("n1").with_upgrade_state(
+            env.keys, UpgradeState.DRAIN_REQUIRED).create(env.cluster)
+        PodBuilder("w1").on_node(node).orphaned().create(env.cluster)
+        return env, node, make_drain_manager(env)
+
+    def test_gate_roundtrip(self):
+        env, node, mgr = self._env()
+        gate = lambda node, pods: True  # noqa: E731
+        mgr.set_eviction_gate(gate)
+        assert mgr.eviction_gate is gate
+
+    def test_gate_enumeration_failure_defers(self):
+        # cannot even list pods for the gate: park, never escalate
+        env, node, mgr = self._env()
+        mgr.set_eviction_gate(lambda node, pods: True)
+        env.cluster.inject_api_errors(
+            "list_pods", 1, exc_factory=lambda: RuntimeError("boom"))
+        mgr.schedule_nodes_drain(DrainConfiguration(
+            spec=DrainSpec(enable=True, force=True), nodes=[node]))
+        assert env.state_of("n1") == "drain-required"
+        assert not env.cluster.get_node("n1").is_unschedulable()
+
+    # (transient cordon failure -> defer is covered by
+    # tests/test_fault_injection.py::test_transient_cordon_error_defers_drain,
+    # which also verifies the subsequent retry succeeds)
+
+    def test_nontransient_cordon_failure_fails_node(self):
+        env, node, mgr = self._env()
+        env.cluster.inject_api_errors(
+            "set_node_unschedulable", 1,
+            exc_factory=lambda: RuntimeError("kernel panic"))
+        mgr.schedule_nodes_drain(DrainConfiguration(
+            spec=DrainSpec(enable=True, force=True), nodes=[node]))
+        assert env.state_of("n1") == "upgrade-failed"
+
+    def test_transient_drain_failure_defers_cordoned(self):
+        # cordon lands, then the drain's pod listing hits a transient
+        # apiserver error: stay drain-required (cordoned), retry later
+        env, node, mgr = self._env()
+        env.cluster.inject_api_errors("list_pods", 1)
+        mgr.schedule_nodes_drain(DrainConfiguration(
+            spec=DrainSpec(enable=True, force=True), nodes=[node]))
+        assert env.state_of("n1") == "drain-required"
+        assert env.cluster.get_node("n1").is_unschedulable()
+
+    def test_state_write_failure_is_quiet(self):
+        env, node, mgr = self._env()
+        env.cluster.inject_api_errors("patch_node_labels", 20)
+        mgr.schedule_nodes_drain(DrainConfiguration(
+            spec=DrainSpec(enable=True, force=True), nodes=[node]))
+        # drain completed but the commit failed: no exception escaped,
+        # label unchanged (converges next reconcile)
+        assert env.cluster.list_pods() == []
+        assert env.state_of("n1") == "drain-required"

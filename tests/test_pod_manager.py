@@ -128,6 +128,67 @@ class TestSchedulePodEviction:
             drain_enabled=True))
         assert env.state_of("n1") == "drain-required"
 
+    def test_missing_deletion_spec_raises(self):
+        env, node, mgr = self._env_with_workload()
+        with pytest.raises(ValueError, match="deletion spec"):
+            mgr.schedule_pod_eviction(PodManagerConfig(
+                nodes=[node], deletion_spec=None))
+
+    def test_missing_deletion_filter_raises(self):
+        # pod_manager.go requires WithPodDeletionEnabled before eviction
+        env = make_env()
+        node = NodeBuilder("n1").create(env.cluster)
+        mgr = make_pod_manager(env)  # no filter configured
+        with pytest.raises(ValueError, match="filter"):
+            mgr.schedule_pod_eviction(PodManagerConfig(
+                nodes=[node], deletion_spec=PodDeletionSpec()))
+
+    def test_in_flight_node_skipped(self):
+        # in-flight dedup (reference StringSet guard, pod_manager.go:163)
+        env, node, mgr = self._env_with_workload()
+        assert mgr._nodes_in_progress.add("n1")  # simulate a running worker
+        mgr.schedule_pod_eviction(PodManagerConfig(
+            nodes=[node], deletion_spec=PodDeletionSpec(force=True)))
+        # nothing happened: pods intact, state unchanged
+        assert len(env.cluster.list_pods()) == 2
+        assert env.state_of("n1") == ""
+
+    @pytest.mark.parametrize("drain_enabled,expected", [
+        (True, "drain-required"),
+        (False, "upgrade-failed"),
+    ])
+    def test_nontransient_error_escalates_to_drain_or_failed(
+            self, drain_enabled, expected):
+        # a NON-transient failure mid-eviction must take the reference's
+        # updateNodeToDrainOrFailed path (pod_manager.go:396-406) — only
+        # transient ApiServerError/ConflictError park for retry
+        env, node, mgr = self._env_with_workload()
+        env.cluster.inject_api_errors(
+            "list_pods", 1, exc_factory=lambda: RuntimeError("boom"))
+        mgr.schedule_pod_eviction(PodManagerConfig(
+            nodes=[node], deletion_spec=PodDeletionSpec(force=True),
+            drain_enabled=drain_enabled))
+        assert env.state_of("n1") == expected
+
+    def test_transient_error_parks_for_retry(self):
+        env, node, mgr = self._env_with_workload()
+        env.cluster.inject_api_errors("list_pods", 1)  # ApiServerError
+        mgr.schedule_pod_eviction(PodManagerConfig(
+            nodes=[node], deletion_spec=PodDeletionSpec(force=True)))
+        # parked: no state movement, pods intact, retried next reconcile
+        assert env.state_of("n1") == ""
+        assert len(env.cluster.list_pods()) == 2
+
+    def test_state_write_failure_is_quiet(self):
+        # the post-eviction label write failing must not raise out of the
+        # worker (the label converges on a later reconcile)
+        env, node, mgr = self._env_with_workload()
+        env.cluster.inject_api_errors("patch_node_labels", 20)
+        mgr.schedule_pod_eviction(PodManagerConfig(
+            nodes=[node], deletion_spec=PodDeletionSpec(force=True)))
+        assert [p.name for p in env.cluster.list_pods()] == ["bystander"]
+        assert env.state_of("n1") == ""  # write failed, quietly
+
     def test_empty_dir_matrix(self):
         env = make_env()
         node = NodeBuilder("n1").create(env.cluster)
@@ -181,6 +242,44 @@ class TestScheduleCheckOnPodCompletion:
             wait_for_completion_spec=WaitForCompletionSpec(
                 pod_selector="job=train", timeout_seconds=0)))
         assert env.state_of("n1") == ""  # unchanged, wait forever
+
+    def test_timeout_stamp_write_failure_logged_not_raised(self):
+        # the start-time annotation write failing must log an event and
+        # leave the node waiting, never raise out of the reconcile
+        env = make_env()
+        node = NodeBuilder("n1").create(env.cluster)
+        PodBuilder("busy").on_node(node).orphaned() \
+            .with_labels({"job": "train"}).create(env.cluster)
+        mgr = make_pod_manager(env)
+        env.cluster.inject_api_errors("patch_node_annotations", 20)
+        mgr.schedule_check_on_pod_completion(PodManagerConfig(
+            nodes=[node],
+            wait_for_completion_spec=WaitForCompletionSpec(
+                pod_selector="job=train", timeout_seconds=300)))
+        assert env.state_of("n1") == ""
+        assert any("Failed to handle timeout" in e.message
+                   for e in env.recorder.events)
+
+    def test_stamp_removal_failure_blocks_advance(self):
+        # jobs done, but the tracking-annotation delete fails: the node
+        # must NOT advance this pass (otherwise a stale stamp could
+        # instantly time out the next upgrade of this node)
+        env = make_env()
+        node = NodeBuilder("n1").create(env.cluster)
+        PodBuilder("done-job").on_node(node).orphaned() \
+            .with_labels({"job": "train"}) \
+            .with_phase(PodPhase.SUCCEEDED).create(env.cluster)
+        # pre-existing stamp from the waiting period
+        env.cluster.patch_node_annotations(
+            "n1", {env.keys.pod_completion_start_annotation: "123"})
+        mgr = make_pod_manager(env)
+        env.cluster.inject_api_errors("patch_node_annotations", 20)
+        mgr.schedule_check_on_pod_completion(PodManagerConfig(
+            nodes=[node],
+            wait_for_completion_spec=WaitForCompletionSpec(
+                pod_selector="job=train")))
+        assert env.state_of("n1") == ""
+        assert any("track job" in e.message for e in env.recorder.events)
 
     def test_timeout_flow(self):
         env = make_env()

@@ -4,6 +4,7 @@ parity: patch + readback, annotation null-delete, cache-sync polling)."""
 import pytest
 
 from tpu_operator_libs.consts import UpgradeState
+from tpu_operator_libs.k8s.client import ApiServerError
 from tpu_operator_libs.upgrade.state_provider import CacheSyncTimeout
 from tpu_operator_libs.util import Event
 
@@ -76,6 +77,39 @@ class TestChangeNodeUpgradeAnnotation:
             node, env.keys.validation_start_annotation, None)
         assert env.keys.validation_start_annotation not in (
             env.cluster.get_node("n1").metadata.annotations)
+
+    def test_patch_failure_raises_and_emits_warning(self):
+        # parity with node_upgrade_state_provider.go:87-88: the error is
+        # surfaced to the caller AND recorded as a k8s Event
+        env = make_env()
+        node = NodeBuilder("n1").create(env.cluster)
+        env.cluster.inject_api_errors("patch_node_annotations", 1)
+        key = env.keys.validation_start_annotation
+        # the exact type matters: PodManager's transient-vs-nontransient
+        # split keys on ApiServerError propagating unwrapped
+        with pytest.raises(ApiServerError):
+            env.provider.change_node_upgrade_annotation(node, key, "1")
+        assert any("Failed to update node annotation" in e.message
+                   for e in env.recorder.events)
+
+    def test_cache_sync_timeout_raises_and_emits_warning(self):
+        # the patch lands but the read-back never reflects it (stale
+        # cache): CacheSyncTimeout after the bounded poll window
+        from tpu_operator_libs.upgrade.state_provider import (
+            NodeUpgradeStateProvider,
+        )
+
+        env = make_env()
+        node = NodeBuilder("n1").create(env.cluster)
+        env.cluster.inject_stale_node_reads("n1", 10_000)
+        key = env.keys.validation_start_annotation
+        provider = NodeUpgradeStateProvider(
+            env.cluster, env.keys, env.recorder, env.clock,
+            sync_timeout=0.05, poll_interval=0.01)
+        with pytest.raises(CacheSyncTimeout):
+            provider.change_node_upgrade_annotation(node, key, "1")
+        assert any("Failed to observe node annotation" in e.message
+                   for e in env.recorder.events)
 
 
 class TestGetNode:

@@ -60,6 +60,33 @@ class TestValidate:
         assert annotation not in env.cluster.get_node(
             "n1").metadata.annotations
 
+    def test_pod_selector_property(self):
+        env = make_env()
+        assert make_validation_manager(
+            env, "app=validator").pod_selector == "app=validator"
+
+    def test_timeout_state_write_failure_is_quiet(self):
+        # the FAILED commit erroring must be swallowed (reference ignores
+        # it at validation_manager.go:163). NOTE the re-arm semantics this
+        # pins: the start-time stamp is still cleared, so the next pass
+        # re-stamps and the node waits a FRESH timeout window — the
+        # failure does not retry on the next reconcile.
+        env = make_env()
+        node = NodeBuilder("n1").with_upgrade_state(
+            env.keys, UpgradeState.VALIDATION_REQUIRED).create(env.cluster)
+        PodBuilder("validator").on_node(node).orphaned() \
+            .with_labels({"app": "validator"}).ready(False).create(env.cluster)
+        mgr = make_validation_manager(env, "app=validator",
+                                      timeout_seconds=600)
+        assert mgr.validate(env.provider.get_node("n1")) is False
+        env.clock.advance(601)
+        env.cluster.inject_api_errors("patch_node_labels", 20)
+        assert mgr.validate(env.provider.get_node("n1")) is False  # no raise
+        assert env.state_of("n1") == "validation-required"  # write failed
+        # stamp cleared -> timer re-arms from zero on the next pass
+        stamp = env.keys.validation_start_annotation
+        assert stamp not in env.cluster.get_node("n1").metadata.annotations
+
     def test_success_clears_timer(self):
         env = make_env()
         node = NodeBuilder("n1").create(env.cluster)
