@@ -52,6 +52,19 @@ def policy(**kwargs):
 
 
 class TestBuildState:
+    def test_vanished_node_raises_not_found(self):
+        # build_state reads nodes via one bulk LIST; a pod whose node no
+        # longer exists must surface the same NotFoundError a per-node
+        # GET would have raised (not a silent skip)
+        from tpu_operator_libs.k8s.client import NotFoundError
+
+        env = make_env()
+        setup_fleet(env, n_nodes=2)
+        env.cluster.delete_node("node-1")
+        mgr = make_state_manager(env)
+        with pytest.raises(NotFoundError, match="node-1"):
+            mgr.build_state(NS, RUNTIME_LABELS)
+
     def test_buckets_by_state_label(self):
         env = make_env()
         setup_fleet(env, n_nodes=2, state=UpgradeState.DONE)

@@ -160,6 +160,14 @@ class FakeCluster(K8sClient):
             self._notify(ADDED, KIND_NODE, node)
         return node
 
+    def delete_node(self, name: str) -> None:
+        """Remove a node (scale-down / repair events in tests and sims)."""
+        with self._lock:
+            node = self._nodes.pop(name, None)
+            if node is None:
+                raise NotFoundError(f"node {name!r} not found")
+            self._notify(DELETED, KIND_NODE, node)
+
     def add_pod(self, pod: Pod) -> Pod:
         with self._lock:
             self._pods[(pod.metadata.namespace, pod.metadata.name)] = (

@@ -58,7 +58,7 @@ from tpu_operator_libs.consts import (
     UpgradeKeys,
     UpgradeState,
 )
-from tpu_operator_libs.k8s.client import K8sClient
+from tpu_operator_libs.k8s.client import K8sClient, NotFoundError
 from tpu_operator_libs.k8s.objects import DaemonSet, Node, Pod, PodPhase
 from tpu_operator_libs.k8s.selectors import selector_from_labels
 from tpu_operator_libs.upgrade.cordon_manager import CordonManager
@@ -290,11 +290,23 @@ class ClusterUpgradeStateManager:
             filtered.extend((p, ds) for p in ds_pods)
         filtered.extend((p, None) for p in pods if p.is_orphaned())
 
+        # One bulk LIST instead of a GET per pod: the reference issues
+        # N GetNode round-trips per snapshot (upgrade_state.go:285); at
+        # TPU-fleet scale (1024 hosts) that is 1024 apiserver RPCs per
+        # reconcile for data a single quorum list returns atomically —
+        # and a single list is a more consistent snapshot besides.
+        nodes_by_name = {n.metadata.name: n
+                         for n in self.client.list_nodes()}
         for pod, ds in filtered:
             if not pod.spec.node_name and pod.status.phase == PodPhase.PENDING:
                 logger.info("runtime pod %s has no node, skipping", pod.name)
                 continue
-            node = self.provider.get_node(pod.spec.node_name)
+            node = nodes_by_name.get(pod.spec.node_name)
+            if node is None:
+                # same contract as a per-node GET of a vanished node
+                raise NotFoundError(
+                    f"node {pod.spec.node_name!r} (runtime pod "
+                    f"{pod.name}) not found")
             node_state = NodeUpgradeState(
                 node=node, runtime_pod=pod, runtime_daemon_set=ds)
             label = node.metadata.labels.get(self.keys.state_label, "")
