@@ -52,18 +52,46 @@ def policy(**kwargs):
 
 
 class TestBuildState:
-    def test_vanished_node_raises_not_found(self):
-        # build_state reads nodes via one bulk LIST; a pod whose node no
-        # longer exists must surface the same NotFoundError a per-node
-        # GET would have raised (not a silent skip)
-        from tpu_operator_libs.k8s.client import NotFoundError
+    def test_vanished_node_skipped_fleet_progresses(self):
+        # Deliberate delta from the reference (upgrade_state.go:285,
+        # which errors the whole BuildState): a node deleted mid-upgrade
+        # leaves a lingering pod until pod GC runs; the snapshot skips
+        # it (with a warning) so the REST of the fleet keeps upgrading.
+        env = make_env()
+        setup_fleet(env, n_nodes=3, pod_hash="old", ds_hash="old")
+        env.cluster.bump_daemon_set_revision(NS, "libtpu", "new")
+        env.cluster.delete_node("node-1")
+        mgr = make_state_manager(env)
+        state = mgr.build_state(NS, RUNTIME_LABELS)
+        surviving = {ns.node.metadata.name
+                     for bucket in state.node_states.values()
+                     for ns in bucket}
+        assert surviving == {"node-0", "node-2"}
+        # and the pass over the snapshot still acts on the survivors
+        mgr.apply_state(state, policy())
+        assert env.state_of("node-0") == "upgrade-required"
+        assert env.state_of("node-2") == "upgrade-required"
+
+    def test_unscheduled_non_pending_pod_skipped_loudly(self, caplog):
+        # empty node_name + phase != Pending (kubelet unreachable /
+        # stuck pod) is abnormal: skipped at WARNING — and NOT
+        # misdiagnosed as a vanished node (that message claims pod GC
+        # will clean up, which is false for a never-scheduled pod)
+        import logging
 
         env = make_env()
         setup_fleet(env, n_nodes=2)
-        env.cluster.delete_node("node-1")
+        PodBuilder("stuck", namespace=NS) \
+            .with_labels(dict(RUNTIME_LABELS)) \
+            .orphaned().with_revision_hash("old") \
+            .with_phase(PodPhase.UNKNOWN).create(env.cluster)
         mgr = make_state_manager(env)
-        with pytest.raises(NotFoundError, match="node-1"):
-            mgr.build_state(NS, RUNTIME_LABELS)
+        with caplog.at_level(logging.WARNING):
+            state = mgr.build_state(NS, RUNTIME_LABELS)
+        assert sum(len(b) for b in state.node_states.values()) == 2
+        messages = [r.message for r in caplog.records]
+        assert any("has no node" in m for m in messages)
+        assert not any("no longer exists" in m for m in messages)
 
     def test_buckets_by_state_label(self):
         env = make_env()

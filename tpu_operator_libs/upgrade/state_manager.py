@@ -58,7 +58,7 @@ from tpu_operator_libs.consts import (
     UpgradeKeys,
     UpgradeState,
 )
-from tpu_operator_libs.k8s.client import K8sClient, NotFoundError
+from tpu_operator_libs.k8s.client import K8sClient
 from tpu_operator_libs.k8s.objects import DaemonSet, Node, Pod, PodPhase
 from tpu_operator_libs.k8s.selectors import selector_from_labels
 from tpu_operator_libs.upgrade.cordon_manager import CordonManager
@@ -298,15 +298,32 @@ class ClusterUpgradeStateManager:
         nodes_by_name = {n.metadata.name: n
                          for n in self.client.list_nodes()}
         for pod, ds in filtered:
-            if not pod.spec.node_name and pod.status.phase == PodPhase.PENDING:
-                logger.info("runtime pod %s has no node, skipping", pod.name)
+            if not pod.spec.node_name:
+                # unscheduled pod: Pending is the normal transient (pod
+                # recreation in flight); any other phase with no node is
+                # abnormal and must be loud — but it is still not a
+                # "vanished node", so the warning below must not fire
+                level = (logging.INFO
+                         if pod.status.phase == PodPhase.PENDING
+                         else logging.WARNING)
+                logger.log(level, "runtime pod %s (phase %s) has no "
+                           "node, skipping", pod.name, pod.status.phase)
                 continue
             node = nodes_by_name.get(pod.spec.node_name)
             if node is None:
-                # same contract as a per-node GET of a vanished node
-                raise NotFoundError(
-                    f"node {pod.spec.node_name!r} (runtime pod "
-                    f"{pod.name}) not found")
+                # Deliberate delta from the reference, which errors the
+                # whole BuildState on a vanished node
+                # (upgrade_state.go:285 error path): a node deleted
+                # mid-upgrade (scale-down, repair) leaves its runtime
+                # pod behind until pod GC catches up, and aborting the
+                # snapshot would stall the ENTIRE fleet's upgrade for
+                # that window. There is no node to upgrade — skip the
+                # pod loudly and let the rest of the fleet progress.
+                logger.warning(
+                    "node %r (runtime pod %s) no longer exists; "
+                    "skipping until pod GC removes the pod",
+                    pod.spec.node_name, pod.name)
+                continue
             node_state = NodeUpgradeState(
                 node=node, runtime_pod=pod, runtime_daemon_set=ds)
             label = node.metadata.labels.get(self.keys.state_label, "")
