@@ -65,6 +65,9 @@ class TestMetricsRegistry:
         assert reg.get("nodes_in_state",
                        {"driver": "libtpu", "state": "upgrade-done"}) == 1
         assert reg.get("reconciles_total", {"driver": "libtpu"}) == 1
+        # no slice constraint active -> zero deferred, gauge still set
+        assert reg.get("multislice_deferred_slices",
+                       {"driver": "libtpu"}) == 0
 
     def test_histogram_observation_and_rendering(self):
         reg = MetricsRegistry()

@@ -369,6 +369,53 @@ class TestPlannerIntegration:
         assert env.state_of("s1-h1") == str(UpgradeState.UPGRADE_REQUIRED)
         assert not env.cluster.get_node("s1-h0").is_unschedulable()
 
+    def test_deferrals_surfaced_in_status_and_property(self):
+        """Operators must be able to see WHY the upgrade is pacing: the
+        deferral the planner logs is also exposed through
+        multislice_deferred_slices, cluster_status, and the metrics
+        gauge — and cleared once nothing is deferred."""
+        from tpu_operator_libs.metrics import (
+            MetricsRegistry,
+            observe_cluster_state,
+        )
+
+        env = make_env()
+        self._fleet_with_job(env)
+        mgr = make_state_manager(env)
+        policy = slice_policy()
+        self._apply(mgr, policy)   # unknown -> upgrade-required
+        assert mgr.multislice_deferred_slices == ()
+        self._apply(mgr, policy)   # slice 0 selected, slice 1 deferred
+        assert mgr.multislice_deferred_slices == ("pool-1",)
+        state = mgr.build_state(NS, RUNTIME_LABELS)
+        assert mgr.cluster_status(state)[
+            "multisliceDeferredSlices"] == ["pool-1"]
+        reg = MetricsRegistry()
+        observe_cluster_state(reg, mgr, state)
+        assert reg.get("multislice_deferred_slices",
+                       {"driver": "libtpu"}) == 1
+        # widen the budget: the deferral clears on the next pass
+        self._apply(mgr, slice_policy(max_unavailable_slices_per_job=2))
+        assert mgr.multislice_deferred_slices == ()
+        state = mgr.build_state(NS, RUNTIME_LABELS)
+        assert "multisliceDeferredSlices" not in mgr.cluster_status(state)
+
+    @pytest.mark.parametrize("later_policy", [
+        # switching away from slice planning (or disabling upgrades)
+        # stops enforcing the budget — stale deferrals must clear too
+        slice_policy(topology_mode="flat"),
+        slice_policy(auto_upgrade=False),
+    ])
+    def test_deferrals_clear_when_slice_planning_stops(self, later_policy):
+        env = make_env()
+        self._fleet_with_job(env)
+        mgr = make_state_manager(env)
+        self._apply(mgr, slice_policy())
+        self._apply(mgr, slice_policy())
+        assert mgr.multislice_deferred_slices == ("pool-1",)
+        self._apply(mgr, later_policy)
+        assert mgr.multislice_deferred_slices == ()
+
     def test_custom_constraint_is_authoritative(self):
         """with_multislice_constraint installs the consumer's own
         constraint; the policy knob must not clobber its budget."""

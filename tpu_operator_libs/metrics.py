@@ -211,5 +211,10 @@ def observe_cluster_state(registry: MetricsRegistry,
         topo = SliceTopology.from_nodes(nodes)
         registry.set_gauge("slice_availability_ratio", topo.availability(),
                            "Fraction of ICI slices fully available", labels)
+    registry.set_gauge(
+        "multislice_deferred_slices",
+        len(manager.multislice_deferred_slices),
+        "Slices deferred because their DCN job's member budget is "
+        "exhausted", labels)
     registry.inc_counter("reconciles_total",
                          "apply_state passes executed", labels)

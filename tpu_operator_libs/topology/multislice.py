@@ -129,6 +129,12 @@ class MultisliceConstraint:
         self._map = MultisliceJobMap(job_label_keys)
         self.max_down = max_unavailable_slices_per_job
         self._job_slices: dict[JobId, set[str]] = {}
+        #: Slices the planner deferred on the most recent round because
+        #: their job's member-slice budget was exhausted (written by
+        #: SlicePlanner.plan; surfaced via cluster_status and the
+        #: multislice_deferred_slices metric so operators can see WHY an
+        #: upgrade is pacing instead of progressing).
+        self.last_deferred: tuple[str, ...] = ()
 
     def begin_round(self, nodes: Iterable[Node],
                     down_slices: set[str]) -> None:

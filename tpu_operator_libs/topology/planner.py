@@ -59,6 +59,10 @@ class SlicePlanner:
 
     def plan(self, candidates: list["NodeUpgradeState"], available: int,
              state: "ClusterUpgradeState") -> list["NodeUpgradeState"]:
+        if self.constraint is not None:
+            # reset before any early return: a round with nothing to
+            # plan has, by definition, no multislice deferrals
+            self.constraint.last_deferred = ()
         if not candidates:
             return []
 
@@ -134,6 +138,10 @@ class SlicePlanner:
             selected_down.add(sid)
             budget = max(0, budget - c)
             paid = True
+        if self.constraint is not None:
+            # persisted on the constraint (it outlives this per-pass
+            # planner) so status/metrics can report the deferrals
+            self.constraint.last_deferred = tuple(sorted(deferred))
         if deferred:
             logger.info(
                 "multislice constraint deferred slice(s) %s "

@@ -341,6 +341,9 @@ class ClusterUpgradeStateManager:
             raise ValueError("currentState should not be empty")
         if policy is None or not policy.auto_upgrade:
             logger.info("auto upgrade is disabled, skipping")
+            # no planning happens while disabled: previously reported
+            # deferrals would otherwise go permanently stale
+            self._clear_multislice_deferrals()
             return
 
         logger.info("node states: %s", {
@@ -405,6 +408,15 @@ class ClusterUpgradeStateManager:
                 self.provider.change_node_upgrade_state(
                     ns.node, UpgradeState.DONE)
 
+    @property
+    def multislice_deferred_slices(self) -> tuple[str, ...]:
+        """Slices the most recent slice-planning round deferred because
+        their DCN job's member-slice budget was exhausted (empty when no
+        constraint is active or nothing was deferred)."""
+        if self._multislice_constraint is None:
+            return ()
+        return self._multislice_constraint.last_deferred
+
     def with_multislice_constraint(
             self, constraint: "MultisliceConstraint",
     ) -> "ClusterUpgradeStateManager":
@@ -418,12 +430,19 @@ class ClusterUpgradeStateManager:
 
     def _planner_for_policy(
             self, policy: UpgradePolicySpec) -> UpgradePlanner:
-        if self._explicit_planner is not None:
-            return self._explicit_planner
-        if policy.topology_mode == "slice":
+        if self._explicit_planner is None and policy.topology_mode == "slice":
             from tpu_operator_libs.topology.planner import SlicePlanner
             return SlicePlanner(self._multislice_for_policy(policy))
-        return FlatPlanner()
+        # The slice planner is not running, so nothing enforces (or
+        # refreshes) multislice deferrals — stale ones must not keep
+        # reporting through status/metrics after a switch to flat mode
+        # or an explicit planner.
+        self._clear_multislice_deferrals()
+        return self._explicit_planner or FlatPlanner()
+
+    def _clear_multislice_deferrals(self) -> None:
+        if self._multislice_constraint is not None:
+            self._multislice_constraint.last_deferred = ()
 
     def _multislice_for_policy(
             self, policy: UpgradePolicySpec) -> "MultisliceConstraint":
@@ -759,6 +778,11 @@ class ClusterUpgradeStateManager:
 
             topo = SliceTopology.from_nodes(nodes)
             status["sliceAvailability"] = round(topo.availability(), 4)
+        deferred = self.multislice_deferred_slices
+        if deferred:
+            # why the upgrade is pacing: these slices wait for a member
+            # of their DCN job to come back up
+            status["multisliceDeferredSlices"] = list(deferred)
         return status
 
     # ------------------------------------------------------------------
