@@ -258,3 +258,42 @@ class TestPodDeletionNilFilter:
         mgr.process_pod_deletion_required_nodes(
             mgr.build_state(NS, RUNTIME_LABELS), None, True)
         assert env.state_of("node-0") == "drain-required"
+
+
+class TestMockProviderConcurrencyContract:
+    """The recording mock mirrors the real provider's optimistic-
+    concurrency contract, so mock-driven suites can exercise the
+    stale-snapshot (False) path the real provider takes under
+    concurrent reconciles."""
+
+    def test_mock_skips_stale_snapshot(self):
+        from tpu_operator_libs.upgrade.mocks import (
+            MockNodeUpgradeStateProvider,
+        )
+
+        keys = UpgradeKeys()
+        provider = MockNodeUpgradeStateProvider(keys)
+        node = NodeBuilder("n1").with_upgrade_state(
+            keys, UpgradeState.WAIT_FOR_JOBS_REQUIRED).build()
+        # a "concurrent pass" already advanced the live state
+        provider.live_states["n1"] = str(UpgradeState.POD_RESTART_REQUIRED)
+        assert provider.change_node_upgrade_state(
+            node, UpgradeState.DRAIN_REQUIRED) is False
+        # neither the live state nor the snapshot was touched
+        assert provider.live_states["n1"] == "pod-restart-required"
+        assert node.metadata.labels[keys.state_label] == \
+            "wait-for-jobs-required"
+
+    def test_mock_fresh_write_lands_and_tracks(self):
+        from tpu_operator_libs.upgrade.mocks import (
+            MockNodeUpgradeStateProvider,
+        )
+
+        keys = UpgradeKeys()
+        provider = MockNodeUpgradeStateProvider(keys)
+        node = NodeBuilder("n1").with_upgrade_state(
+            keys, UpgradeState.UPGRADE_REQUIRED).build()
+        assert provider.change_node_upgrade_state(
+            node, UpgradeState.CORDON_REQUIRED) is True
+        assert provider.live_states["n1"] == "cordon-required"
+        assert node.metadata.labels[keys.state_label] == "cordon-required"

@@ -112,6 +112,51 @@ class TestChangeNodeUpgradeAnnotation:
                    for e in env.recorder.events)
 
 
+class TestOptimisticConcurrency:
+    """Label writes carry a precondition on the snapshot's label: a
+    stale pass (or detached worker) must not regress a node another
+    pass has already advanced. The reference has no such guard — it
+    assumes one reconcile goroutine; this build supports concurrent
+    reconciles (tests/test_stress_concurrency.py hammers it)."""
+
+    def test_stale_snapshot_write_skipped(self):
+        env = make_env()
+        NodeBuilder("n1").with_upgrade_state(
+            env.keys, UpgradeState.WAIT_FOR_JOBS_REQUIRED).create(env.cluster)
+        snapshot = env.provider.get_node("n1")
+        # another pass advances the node after our snapshot
+        env.cluster.patch_node_labels("n1", {
+            env.keys.state_label: str(UpgradeState.POD_RESTART_REQUIRED)})
+        assert env.provider.change_node_upgrade_state(
+            snapshot, UpgradeState.DRAIN_REQUIRED) is False
+        # the live label is untouched; no regression happened
+        assert env.state_of("n1") == "pod-restart-required"
+
+    def test_duplicate_transition_is_committed(self):
+        # two racing passes committing the SAME edge: the loser sees the
+        # value already in place and reports success (idempotent)
+        env = make_env()
+        NodeBuilder("n1").with_upgrade_state(
+            env.keys, UpgradeState.UPGRADE_REQUIRED).create(env.cluster)
+        snapshot = env.provider.get_node("n1")
+        env.cluster.patch_node_labels("n1", {
+            env.keys.state_label: str(UpgradeState.CORDON_REQUIRED)})
+        assert env.provider.change_node_upgrade_state(
+            snapshot, UpgradeState.CORDON_REQUIRED) is True
+        # the caller's node object is refreshed to the live state
+        assert snapshot.metadata.labels[env.keys.state_label] == \
+            "cordon-required"
+
+    def test_fresh_snapshot_write_lands(self):
+        env = make_env()
+        NodeBuilder("n1").with_upgrade_state(
+            env.keys, UpgradeState.UPGRADE_REQUIRED).create(env.cluster)
+        snapshot = env.provider.get_node("n1")
+        assert env.provider.change_node_upgrade_state(
+            snapshot, UpgradeState.CORDON_REQUIRED) is True
+        assert env.state_of("n1") == "cordon-required"
+
+
 class TestGetNode:
     def test_returns_fresh_snapshot(self):
         env = make_env()

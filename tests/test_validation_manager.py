@@ -65,12 +65,12 @@ class TestValidate:
         assert make_validation_manager(
             env, "app=validator").pod_selector == "app=validator"
 
-    def test_timeout_state_write_failure_is_quiet(self):
+    def test_timeout_state_write_failure_is_quiet_and_retries(self):
         # the FAILED commit erroring must be swallowed (reference ignores
-        # it at validation_manager.go:163). NOTE the re-arm semantics this
-        # pins: the start-time stamp is still cleared, so the next pass
-        # re-stamps and the node waits a FRESH timeout window — the
-        # failure does not retry on the next reconcile.
+        # it at validation_manager.go:163) — and because the write did
+        # NOT land, the stamp survives and no "marked upgrade-failed"
+        # event is emitted, so the timeout simply fires again next pass
+        # instead of silently re-arming a fresh 600 s window
         env = make_env()
         node = NodeBuilder("n1").with_upgrade_state(
             env.keys, UpgradeState.VALIDATION_REQUIRED).create(env.cluster)
@@ -80,12 +80,39 @@ class TestValidate:
                                       timeout_seconds=600)
         assert mgr.validate(env.provider.get_node("n1")) is False
         env.clock.advance(601)
-        env.cluster.inject_api_errors("patch_node_labels", 20)
+        env.cluster.inject_api_errors("patch_node_labels", 1)
         assert mgr.validate(env.provider.get_node("n1")) is False  # no raise
         assert env.state_of("n1") == "validation-required"  # write failed
-        # stamp cleared -> timer re-arms from zero on the next pass
         stamp = env.keys.validation_start_annotation
+        assert stamp in env.cluster.get_node("n1").metadata.annotations
+        assert not any("marked upgrade-failed" in e.message
+                       for e in env.recorder.events)
+        # injection exhausted: the very next pass completes the timeout
+        assert mgr.validate(env.provider.get_node("n1")) is False
+        assert env.state_of("n1") == "upgrade-failed"
         assert stamp not in env.cluster.get_node("n1").metadata.annotations
+
+    def test_timeout_stale_snapshot_does_not_fail_node(self):
+        # a concurrent pass advanced the node past validation while this
+        # pass was timing out: the FAILED write is skipped as stale and
+        # neither the false event nor the stamp cleanup happens
+        env = make_env()
+        node = NodeBuilder("n1").with_upgrade_state(
+            env.keys, UpgradeState.VALIDATION_REQUIRED).create(env.cluster)
+        PodBuilder("validator").on_node(node).orphaned() \
+            .with_labels({"app": "validator"}).ready(False).create(env.cluster)
+        mgr = make_validation_manager(env, "app=validator",
+                                      timeout_seconds=600)
+        snapshot = env.provider.get_node("n1")
+        assert mgr.validate(snapshot) is False  # stamps start time
+        env.clock.advance(601)
+        stale = env.provider.get_node("n1")
+        env.cluster.patch_node_labels("n1", {
+            env.keys.state_label: str(UpgradeState.UNCORDON_REQUIRED)})
+        assert mgr.validate(stale) is False
+        assert env.state_of("n1") == "uncordon-required"  # untouched
+        assert not any("marked upgrade-failed" in e.message
+                       for e in env.recorder.events)
 
     def test_success_clears_timer(self):
         env = make_env()

@@ -40,12 +40,20 @@ class RecordingMixin:
 
 
 class MockNodeUpgradeStateProvider(RecordingMixin):
-    """Mutates node labels/annotations in memory (no cluster, no polling)."""
+    """Mutates node labels/annotations in memory (no cluster, no polling).
+
+    Models the real provider's optimistic-concurrency contract: each
+    node's last committed label is tracked in ``live_states``, and a
+    write whose snapshot label disagrees with it is skipped with
+    ``False`` — so mock-driven tests can exercise the stale-snapshot
+    path (seed ``live_states`` to simulate a concurrent pass).
+    """
 
     def __init__(self, keys: Optional[UpgradeKeys] = None) -> None:
         super().__init__()
         self.keys = keys or UpgradeKeys()
         self.fail_next: Optional[Exception] = None
+        self.live_states: dict[str, str] = {}
 
     def _maybe_fail(self) -> None:
         if self.fail_next is not None:
@@ -58,11 +66,19 @@ class MockNodeUpgradeStateProvider(RecordingMixin):
             "snapshots directly")
 
     def change_node_upgrade_state(self, node: Node,
-                                  new_state: UpgradeState | str) -> None:
+                                  new_state: UpgradeState | str) -> bool:
         self.record("change_node_upgrade_state", node.metadata.name,
                     str(new_state))
         self._maybe_fail()
-        node.metadata.labels[self.keys.state_label] = str(new_state)
+        value = str(new_state)
+        name = node.metadata.name
+        expected = node.metadata.labels.get(self.keys.state_label, "")
+        current = self.live_states.get(name, expected)
+        if current not in (expected, value):
+            return False  # stale snapshot, same as the real provider
+        self.live_states[name] = value
+        node.metadata.labels[self.keys.state_label] = value
+        return True
 
     def change_node_upgrade_annotation(self, node: Node, key: str,
                                        value: Optional[str]) -> None:

@@ -635,8 +635,24 @@ class ClusterUpgradeStateManager:
 
     def process_uncordon_required_nodes(
             self, state: ClusterUpgradeState) -> None:
-        """Uncordon and finish (upgrade_state.go:915-934)."""
+        """Uncordon and finish (upgrade_state.go:915-934).
+
+        The physical uncordon must come before the label write (a failed
+        uncordon must leave the node in uncordon-required for retry, the
+        reference's ordering) — but a STALE snapshot must not uncordon a
+        node a faster pass already finished and a new rollout re-cordoned.
+        Re-reading the label first closes that stale-pass window; the
+        write itself still carries the optimistic-concurrency check.
+        """
         for ns in state.bucket(UpgradeState.UNCORDON_REQUIRED):
+            current = self.provider.get_node(ns.node.metadata.name) \
+                .metadata.labels.get(self.keys.state_label, "")
+            if current != str(UpgradeState.UNCORDON_REQUIRED):
+                logger.warning(
+                    "node %s is %r, not uncordon-required: snapshot is "
+                    "stale; skipping uncordon",
+                    ns.node.metadata.name, current or "unknown")
+                continue
             self.cordon_manager.uncordon(ns.node)
             self.provider.change_node_upgrade_state(
                 ns.node, UpgradeState.DONE)
@@ -681,7 +697,11 @@ class ClusterUpgradeStateManager:
             logger.info("node %s was unschedulable before upgrade; "
                         "skipping uncordon", node.metadata.name)
             new_state = UpgradeState.DONE
-        self.provider.change_node_upgrade_state(node, new_state)
+        if not self.provider.change_node_upgrade_state(node, new_state):
+            # stale snapshot: another pass moved the node — deleting the
+            # initial-state annotation now would erase the "admin had
+            # this node cordoned" memory for whatever flow owns it
+            return
         if new_state == UpgradeState.DONE:
             self.provider.change_node_upgrade_annotation(
                 node, annotation, None)

@@ -56,16 +56,42 @@ class NodeUpgradeStateProvider:
             return self._client.get_node(name)
 
     def change_node_upgrade_state(self, node: Node,
-                                  new_state: UpgradeState | str) -> None:
+                                  new_state: UpgradeState | str) -> bool:
         """Patch the upgrade-state label and wait until the change is
         readable back (node_upgrade_state_provider.go:72-134).
 
         ``node`` is updated in place on success, so later processing within
         the same reconcile pass observes the new state — matching the
         reference, which Gets into the caller's node object.
+
+        **Optimistic concurrency (beyond-reference):** the write only
+        lands if the node's live state label still equals the label in
+        the caller's ``node`` snapshot; otherwise it is skipped and
+        ``False`` is returned. A pass (or detached worker) holding a
+        stale snapshot must not regress a node another pass has already
+        advanced — the reference avoids that race only by convention
+        (one reconcile goroutine per consumer); here concurrent
+        reconciles are supported, so the label write carries the
+        precondition, the way a Kubernetes update carries its
+        resourceVersion. The skipped caller's next reconcile re-derives
+        the correct action from the fresh label.
         """
         value = str(new_state)
+        expected = node.metadata.labels.get(self._keys.state_label, "")
         with self._node_lock.lock(node.metadata.name):
+            live = self._client.get_node(node.metadata.name)
+            current = live.metadata.labels.get(self._keys.state_label, "")
+            if current not in (expected, value):
+                logger.warning(
+                    "node %s state is %r, not %r: snapshot is stale; "
+                    "skipping transition to %r",
+                    node.metadata.name, current or "unknown",
+                    expected or "unknown", value)
+                return False
+            if current == value:
+                # another pass already committed this exact transition
+                self._copy_into(node, live)
+                return True
             try:
                 self._client.patch_node_labels(
                     node.metadata.name, {self._keys.state_label: value})
@@ -87,6 +113,7 @@ class NodeUpgradeStateProvider:
         logger.info("node %s upgrade state -> %s", node.metadata.name, value)
         log_event(self._recorder, node, Event.NORMAL, self._keys.event_reason,
                   f"Successfully updated node state label to {value}")
+        return True
 
     def change_node_upgrade_annotation(self, node: Node, key: str,
                                        value: Optional[str]) -> None:

@@ -123,13 +123,21 @@ class ValidationManager:
             return
         start = int(stamp)
         if now > start + self._timeout_seconds:
+            committed = False
             try:
-                self._provider.change_node_upgrade_state(
+                committed = self._provider.change_node_upgrade_state(
                     node, UpgradeState.FAILED)
             except Exception as exc:  # noqa: BLE001 — matches reference's
                 # ignored error at validation_manager.go:163
                 logger.error("failed to fail node %s: %s",
                              node.metadata.name, exc)
+            if not committed:
+                # write failed or snapshot was stale (a concurrent pass
+                # already moved the node on): the node was NOT marked
+                # failed, so no event claiming otherwise and no stamp
+                # cleanup — whatever state the node is really in owns
+                # the stamp's lifecycle now
+                return
             logger.info("validation timeout exceeded on node %s",
                         node.metadata.name)
             log_event(self._recorder, node, Event.WARNING,
