@@ -154,6 +154,62 @@ class TestFakeClusterNodes:
         with pytest.raises(NotFoundError):
             FakeCluster().get_node("ghost")
 
+    def test_delete_node_ds_follow_through(self):
+        # with the DS controller sim on, deleting a node mirrors the
+        # real control plane: desired count drops NOW, pods linger until
+        # pod GC fires, and no recreation happens for the gone node
+        clock = FakeClock()
+        cluster = FakeCluster(clock=clock)
+        cluster.enable_ds_controller(recreate_delay=1.0, ready_delay=1.0,
+                                     pod_gc_delay=30.0)
+        ds = DaemonSetBuilder("libtpu").with_labels({"app": "rt"}) \
+            .with_desired_scheduled(2).create(cluster)
+        for i in range(2):
+            NodeBuilder(f"n{i}").create(cluster)
+            PodBuilder(f"p{i}").on_node(f"n{i}").owned_by(ds) \
+                .with_labels({"app": "rt"}).create(cluster)
+        cluster.delete_node("n1")
+        assert cluster.list_daemon_sets("tpu-system", "app=rt")[0] \
+            .status.desired_number_scheduled == 1
+        # pod lingers through the GC window...
+        assert {p.name for p in cluster.list_pods()} == {"p0", "p1"}
+        clock.advance(31.0)
+        cluster.step()
+        assert {p.name for p in cluster.list_pods()} == {"p0"}
+
+    def test_delete_node_during_pod_recreation_window(self):
+        # the pod was deleted and its recreation is pending when the
+        # node vanishes: the recreate must not fire AND the desired
+        # count must still drop (otherwise desired stays one above the
+        # pod count forever and every snapshot is "incomplete")
+        clock = FakeClock()
+        cluster = FakeCluster(clock=clock)
+        cluster.enable_ds_controller(recreate_delay=10.0, ready_delay=1.0)
+        ds = DaemonSetBuilder("libtpu").with_labels({"app": "rt"}) \
+            .with_desired_scheduled(2).create(cluster)
+        for i in range(2):
+            NodeBuilder(f"n{i}").create(cluster)
+            PodBuilder(f"p{i}").on_node(f"n{i}").owned_by(ds) \
+                .with_labels({"app": "rt"}).create(cluster)
+        cluster.delete_pod("tpu-system", "p1")  # recreate pending +10s
+        cluster.delete_node("n1")               # no stranded pod now
+        clock.advance(11.0)
+        cluster.step()
+        assert {p.name for p in cluster.list_pods()} == {"p0"}
+        assert cluster.list_daemon_sets("tpu-system", "app=rt")[0] \
+            .status.desired_number_scheduled == 1
+
+    def test_delete_node_without_ds_controller_leaves_pods(self):
+        cluster = FakeCluster()
+        NodeBuilder("n1").create(cluster)
+        PodBuilder("p1").on_node("n1").orphaned().create(cluster)
+        cluster.delete_node("n1")
+        assert [p.name for p in cluster.list_pods()] == ["p1"]
+
+    def test_delete_missing_node_raises(self):
+        with pytest.raises(NotFoundError):
+            FakeCluster().delete_node("ghost")
+
     def test_stale_reads_then_converge(self):
         cluster = FakeCluster()
         NodeBuilder("n1").create(cluster)

@@ -44,6 +44,69 @@ class TestSimulation:
         # transitions stay legal under chaining (one edge per inner pass)
         # — covered structurally: chained mode reuses apply_state verbatim.
 
+    def test_scale_down_mid_upgrade_converges(self):
+        # a node deleted mid-upgrade (the vanished-node delta) must not
+        # stall the remaining fleet, including with a multislice job
+        # spanning the removed node's slice
+        r = simulate_rolling_upgrade(
+            topology_mode="slice", chained=True,
+            fleet=FleetSpec(n_slices=4, hosts_per_slice=2,
+                            multislice_jobs=(("train", (0, 1)),),
+                            node_removals=(("s1-h0", 80.0),)))
+        assert r.converged
+        assert all(v <= 1 for v in r.max_down_members_per_job.values())
+
+    def test_scale_down_does_not_stall_the_gc_window(self):
+        # while the deleted node's pod awaits GC, the OTHER nodes must
+        # keep making progress — a regression here reintroduces the
+        # whole-fleet stall the vanished-node delta exists to prevent
+        from tpu_operator_libs.api.upgrade_policy import (
+            DrainSpec,
+            UpgradePolicySpec,
+        )
+        from tpu_operator_libs.simulate import NS, RUNTIME_LABELS, build_fleet
+        from tpu_operator_libs.upgrade.state_manager import (
+            ClusterUpgradeStateManager,
+        )
+
+        cluster, clock, keys = build_fleet(
+            FleetSpec(n_slices=2, hosts_per_slice=2))
+        mgr = ClusterUpgradeStateManager(cluster, keys,
+                                         async_workers=False,
+                                         poll_interval=0.0)
+        pol = UpgradePolicySpec(auto_upgrade=True, max_unavailable=None,
+                                max_parallel_upgrades=0,
+                                topology_mode="slice",
+                                drain=DrainSpec(enable=True, force=True))
+        cluster.delete_node("s1-h1")  # pod lingers for pod_gc_delay
+        # with the stranded pod excluded before the completeness guard,
+        # the very next pass acts on the surviving nodes
+        mgr.apply_state(mgr.build_state(NS, RUNTIME_LABELS), pol)
+        survivors = [n.metadata.labels.get(keys.state_label)
+                     for n in cluster.list_nodes()]
+        assert all(s == "upgrade-required" for s in survivors), survivors
+
+    def test_removal_of_unknown_node_rejected(self):
+        import pytest
+
+        with pytest.raises(ValueError, match="not a fleet node"):
+            simulate_rolling_upgrade(fleet=FleetSpec(
+                n_slices=2, hosts_per_slice=2,
+                node_removals=(("s9-h9", 10.0),)))
+
+    def test_conflicting_or_duplicate_removals_rejected(self):
+        import pytest
+
+        with pytest.raises(ValueError, match="more than once"):
+            simulate_rolling_upgrade(fleet=FleetSpec(
+                n_slices=2, hosts_per_slice=2,
+                node_removals=(("s0-h0", 10.0), ("s0-h0", 20.0))))
+        with pytest.raises(ValueError, match="both node_removals"):
+            simulate_rolling_upgrade(fleet=FleetSpec(
+                n_slices=2, hosts_per_slice=2,
+                node_removals=(("s0-h0", 10.0),),
+                not_ready_nodes=("s0-h0",)))
+
     def test_windowed_availability_credits_fast_convergence(self):
         fleet = FleetSpec(n_slices=2, hosts_per_slice=2)
         plain = simulate_rolling_upgrade("slice", fleet=fleet)

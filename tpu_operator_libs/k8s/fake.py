@@ -74,6 +74,7 @@ from tpu_operator_libs.util import Clock
 class _DsControllerConfig:
     recreate_delay: float = 5.0
     ready_delay: float = 10.0
+    pod_gc_delay: float = 30.0
     enabled: bool = True
 
 
@@ -161,12 +162,42 @@ class FakeCluster(K8sClient):
         return node
 
     def delete_node(self, name: str) -> None:
-        """Remove a node (scale-down / repair events in tests and sims)."""
+        """Remove a node (scale-down / repair events in tests and sims).
+
+        With the DS controller sim enabled this models the real control
+        plane's follow-through: desired counts of DaemonSets that had a
+        pod on the node drop immediately, and the node's pods linger
+        until pod GC deletes them ``pod_gc_delay`` virtual seconds later
+        — exactly the window the state machine's vanished-node skip
+        covers.
+        """
         with self._lock:
             node = self._nodes.pop(name, None)
             if node is None:
                 raise NotFoundError(f"node {name!r} not found")
             self._notify(DELETED, KIND_NODE, node)
+            cfg = self._ds_controller
+            if cfg is None or not cfg.enabled:
+                return
+            stranded = [p for p in self._pods.values()
+                        if p.spec.node_name == name]
+            for pod in stranded:
+                owner = pod.controller_owner()
+                if owner is not None and owner.kind == "DaemonSet":
+                    for ds in self._daemon_sets.values():
+                        if ds.metadata.uid == owner.uid:
+                            ds.status.desired_number_scheduled = max(
+                                0, ds.status.desired_number_scheduled - 1)
+                key = (pod.metadata.namespace, pod.metadata.name)
+
+                def gc(pod_key=key) -> None:
+                    with self._lock:
+                        gone = self._pods.pop(pod_key, None)
+                        if gone is not None:
+                            self._notify(DELETED, KIND_POD, gone)
+                        # no recreate: the node is gone
+
+                self._schedule(cfg.pod_gc_delay, gc)
 
     def add_pod(self, pod: Pod) -> Pod:
         with self._lock:
@@ -246,13 +277,18 @@ class FakeCluster(K8sClient):
             return max(revs, key=lambda r: r.revision).hash
 
     def enable_ds_controller(self, recreate_delay: float = 5.0,
-                             ready_delay: float = 10.0) -> None:
+                             ready_delay: float = 10.0,
+                             pod_gc_delay: float = 30.0) -> None:
         """Simulate the DaemonSet controller + kubelet: deleted DS pods are
         recreated with the newest revision hash after ``recreate_delay``
-        (virtual) seconds and become Ready ``ready_delay`` seconds later."""
+        (virtual) seconds and become Ready ``ready_delay`` seconds later.
+        When a NODE is deleted, its DaemonSets' desired counts drop
+        immediately (the real DS controller reacts to the node list) and
+        the node's pods are garbage-collected after ``pod_gc_delay``."""
         with self._lock:
             self._ds_controller = _DsControllerConfig(
-                recreate_delay=recreate_delay, ready_delay=ready_delay)
+                recreate_delay=recreate_delay, ready_delay=ready_delay,
+                pod_gc_delay=pod_gc_delay)
 
     def set_per_node_ds_delays(
             self, fn: Optional[Callable[[str], tuple[float, float]]]) -> None:
@@ -540,7 +576,16 @@ class FakeCluster(K8sClient):
         def recreate() -> None:
             with self._lock:
                 ds = self._daemon_sets.get(ds_key)
-                if ds is None or node_name not in self._nodes:
+                if ds is None:
+                    return
+                if node_name not in self._nodes:
+                    # the node vanished while the pod was between
+                    # deletion and recreation: the real DS controller
+                    # drops its desired count for the gone node (the
+                    # delete_node path handled pods that still existed;
+                    # this closure owns the in-flight-recreation case)
+                    ds.status.desired_number_scheduled = max(
+                        0, ds.status.desired_number_scheduled - 1)
                     return
                 new_hash = self.latest_revision_hash(namespace, ds_name)
                 labels = dict(ds.spec.selector)

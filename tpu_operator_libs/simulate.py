@@ -105,6 +105,12 @@ class FleetSpec:
     # `straggler_factor` x the ready delay (heterogeneous-fleet tail).
     straggler_nodes: tuple[str, ...] = ()
     straggler_factor: float = 3.0
+    # Scale-down events: (node name, virtual seconds) — the node is
+    # deleted mid-upgrade. The DS controller sim drops desired counts
+    # immediately and garbage-collects the node's pods after its
+    # pod_gc_delay, so the run exercises the vanished-node window the
+    # state machine must ride out without stalling the fleet.
+    node_removals: tuple[tuple[str, float], ...] = ()
 
 
 @dataclass
@@ -270,11 +276,25 @@ def restore_workload_pods(cluster: FakeCluster, spec: FleetSpec) -> None:
 def _schedule_faults(cluster: FakeCluster, spec: FleetSpec) -> None:
     """Install the configured fault injections as scheduled sim actions."""
     known = {n.metadata.name for n in cluster.list_nodes()}
-    for name in (*spec.not_ready_nodes, *spec.crashloop_nodes):
+    for name in (*spec.not_ready_nodes, *spec.crashloop_nodes,
+                 *(n for n, _ in spec.node_removals)):
         if name not in known:
             raise ValueError(
                 f"fault-injection target {name!r} is not a fleet node "
                 f"(nodes are named s<slice>-h<host>)")
+    removal_names = [n for n, _ in spec.node_removals]
+    if len(set(removal_names)) != len(removal_names):
+        raise ValueError("node_removals lists a node more than once")
+    conflict = set(removal_names) & set(spec.not_ready_nodes)
+    if conflict:
+        # a scheduled not-ready flip would fire against a deleted node
+        # and crash the sim mid-run; reject the combination up front
+        raise ValueError(
+            f"node(s) {sorted(conflict)} appear in both node_removals "
+            "and not_ready_nodes")
+    for name, at in spec.node_removals:
+        cluster.schedule_at(
+            at, lambda n=name: cluster.delete_node(n))
     for name in spec.not_ready_nodes:
         cluster.schedule_at(spec.not_ready_at,
                             lambda n=name: cluster.set_node_ready(n, False))

@@ -275,21 +275,6 @@ class ClusterUpgradeStateManager:
                            namespace, selector)}
         pods = self.client.list_pods(namespace=namespace,
                                      label_selector=selector)
-
-        filtered: list[tuple[Pod, Optional[DaemonSet]]] = []
-        for ds in daemon_sets.values():
-            ds_pods = [p for p in pods
-                       if not p.is_orphaned()
-                       and p.controller_owner().uid == ds.metadata.uid]
-            if ds.status.desired_number_scheduled != len(ds_pods):
-                # A DS with unscheduled pods gives an incomplete picture;
-                # refuse to act on it (upgrade_state.go:243-246).
-                raise BuildStateError(
-                    f"runtime DaemonSet {ds.metadata.name} should not have "
-                    f"unscheduled pods")
-            filtered.extend((p, ds) for p in ds_pods)
-        filtered.extend((p, None) for p in pods if p.is_orphaned())
-
         # One bulk LIST instead of a GET per pod: the reference issues
         # N GetNode round-trips per snapshot (upgrade_state.go:285); at
         # TPU-fleet scale (1024 hosts) that is 1024 apiserver RPCs per
@@ -297,33 +282,66 @@ class ClusterUpgradeStateManager:
         # and a single list is a more consistent snapshot besides.
         nodes_by_name = {n.metadata.name: n
                          for n in self.client.list_nodes()}
+        # Deliberate delta from the reference, which errors the whole
+        # BuildState on a vanished node (upgrade_state.go:285 error
+        # path): a node deleted mid-upgrade (scale-down, repair) leaves
+        # its runtime pod behind until pod GC catches up, and aborting
+        # the snapshot would stall the ENTIRE fleet's upgrade for that
+        # window. The stranded pods are excluded HERE, before the
+        # desired-count completeness guard below — the DS controller has
+        # already dropped its desired count for the gone node, so
+        # counting the lingering pod would otherwise fail the guard for
+        # the whole GC window.
+        live_pods = []
+        stranded_by_uid: dict[str, int] = {}
+        for pod in pods:
+            if pod.spec.node_name and pod.spec.node_name not in nodes_by_name:
+                logger.warning(
+                    "node %r (runtime pod %s) no longer exists; "
+                    "skipping until pod GC removes the pod",
+                    pod.spec.node_name, pod.name)
+                owner = pod.controller_owner()
+                if owner is not None:
+                    stranded_by_uid[owner.uid] = (
+                        stranded_by_uid.get(owner.uid, 0) + 1)
+                continue
+            live_pods.append(pod)
+        pods = live_pods
+
+        filtered: list[tuple[Pod, Optional[DaemonSet]]] = []
+        for ds in daemon_sets.values():
+            ds_pods = [p for p in pods
+                       if not p.is_orphaned()
+                       and p.controller_owner().uid == ds.metadata.uid]
+            stranded = stranded_by_uid.get(ds.metadata.uid, 0)
+            # Completeness guard (upgrade_state.go:243-246), vanished-
+            # node aware: after a node deletion the DS controller may
+            # not have dropped its desired count yet, so BOTH the
+            # synced count (live pods) and the lagging count (live +
+            # stranded) are complete pictures. Anything else means
+            # genuinely unscheduled pods — refuse to act.
+            if ds.status.desired_number_scheduled not in (
+                    len(ds_pods), len(ds_pods) + stranded):
+                raise BuildStateError(
+                    f"runtime DaemonSet {ds.metadata.name} should not have "
+                    f"unscheduled pods")
+            filtered.extend((p, ds) for p in ds_pods)
+        filtered.extend((p, None) for p in pods if p.is_orphaned())
+
         for pod, ds in filtered:
             if not pod.spec.node_name:
                 # unscheduled pod: Pending is the normal transient (pod
                 # recreation in flight); any other phase with no node is
-                # abnormal and must be loud — but it is still not a
-                # "vanished node", so the warning below must not fire
+                # abnormal and must be loud — but it is not a "vanished
+                # node" (those were excluded above), so no misleading
+                # pod-GC diagnosis
                 level = (logging.INFO
                          if pod.status.phase == PodPhase.PENDING
                          else logging.WARNING)
                 logger.log(level, "runtime pod %s (phase %s) has no "
                            "node, skipping", pod.name, pod.status.phase)
                 continue
-            node = nodes_by_name.get(pod.spec.node_name)
-            if node is None:
-                # Deliberate delta from the reference, which errors the
-                # whole BuildState on a vanished node
-                # (upgrade_state.go:285 error path): a node deleted
-                # mid-upgrade (scale-down, repair) leaves its runtime
-                # pod behind until pod GC catches up, and aborting the
-                # snapshot would stall the ENTIRE fleet's upgrade for
-                # that window. There is no node to upgrade — skip the
-                # pod loudly and let the rest of the fleet progress.
-                logger.warning(
-                    "node %r (runtime pod %s) no longer exists; "
-                    "skipping until pod GC removes the pod",
-                    pod.spec.node_name, pod.name)
-                continue
+            node = nodes_by_name[pod.spec.node_name]
             node_state = NodeUpgradeState(
                 node=node, runtime_pod=pod, runtime_daemon_set=ds)
             label = node.metadata.labels.get(self.keys.state_label, "")
