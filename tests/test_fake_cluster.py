@@ -199,6 +199,28 @@ class TestFakeClusterNodes:
         assert cluster.list_daemon_sets("tpu-system", "app=rt")[0] \
             .status.desired_number_scheduled == 1
 
+    def test_stranded_pod_deleted_in_gc_window_no_double_decrement(self):
+        # delete_node already accounted for the stranded pod; an
+        # explicit delete of that pod during the GC window must not
+        # schedule a recreate whose closure decrements desired again
+        clock = FakeClock()
+        cluster = FakeCluster(clock=clock)
+        cluster.enable_ds_controller(recreate_delay=5.0, ready_delay=1.0,
+                                     pod_gc_delay=30.0)
+        ds = DaemonSetBuilder("libtpu").with_labels({"app": "rt"}) \
+            .with_desired_scheduled(2).create(cluster)
+        for i in range(2):
+            NodeBuilder(f"n{i}").create(cluster)
+            PodBuilder(f"p{i}").on_node(f"n{i}").owned_by(ds) \
+                .with_labels({"app": "rt"}).create(cluster)
+        cluster.delete_node("n1")  # desired 2 -> 1, GC scheduled
+        cluster.delete_pod("tpu-system", "p1")  # mid-GC-window delete
+        clock.advance(60.0)
+        cluster.step()
+        assert cluster.list_daemon_sets("tpu-system", "app=rt")[0] \
+            .status.desired_number_scheduled == 1  # NOT 0
+        assert {p.name for p in cluster.list_pods()} == {"p0"}
+
     def test_delete_node_without_ds_controller_leaves_pods(self):
         cluster = FakeCluster()
         NodeBuilder("n1").create(cluster)

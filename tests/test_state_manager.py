@@ -93,6 +93,41 @@ class TestBuildState:
         assert any("has no node" in m for m in messages)
         assert not any("no longer exists" in m for m in messages)
 
+    def test_node_added_mid_upgrade_joins_the_rollout(self):
+        # autoscaler scale-up: a new node appears mid-upgrade with an
+        # old-revision runtime pod — it enters the machine at unknown
+        # and is upgraded like any other node (no special-casing needed;
+        # this pins that the snapshot picks it up next pass)
+        env = make_env()
+        env.cluster.enable_ds_controller(recreate_delay=0, ready_delay=0)
+        setup_fleet(env, n_nodes=2, pod_hash="old", ds_hash="old")
+        env.cluster.bump_daemon_set_revision(NS, "libtpu", "new")
+        mgr = make_state_manager(env)
+        pol = policy(max_parallel_upgrades=0, max_unavailable=None,
+                     drain=DrainSpec(enable=True, force=True))
+        mgr.reconcile(NS, RUNTIME_LABELS, pol)
+        # scale-up lands while the original nodes are mid-flight
+        ds = env.cluster.list_daemon_sets(NS, "app=libtpu")[0]
+        NodeBuilder("node-new").create(env.cluster)
+        PodBuilder("libtpu-new", namespace=NS) \
+            .with_labels(dict(RUNTIME_LABELS)) \
+            .owned_by(ds).with_revision_hash("old") \
+            .on_node("node-new").create(env.cluster)
+        env.cluster.set_daemon_set_desired(NS, "libtpu", 3)
+        for _ in range(40):
+            mgr.reconcile(NS, RUNTIME_LABELS, pol)
+            env.clock.advance(10.0)
+            env.cluster.step()
+            states = {n.metadata.name: env.state_of(n.metadata.name)
+                      for n in env.cluster.list_nodes()}
+            if set(states.values()) == {"upgrade-done"}:
+                break
+        assert set(states.values()) == {"upgrade-done"}, states
+        new_pod = [p for p in env.cluster.list_pods(
+            label_selector="app=libtpu")
+            if p.spec.node_name == "node-new"][0]
+        assert new_pod.metadata.labels["controller-revision-hash"] == "new"
+
     def test_buckets_by_state_label(self):
         env = make_env()
         setup_fleet(env, n_nodes=2, state=UpgradeState.DONE)

@@ -184,10 +184,12 @@ class FakeCluster(K8sClient):
             for pod in stranded:
                 owner = pod.controller_owner()
                 if owner is not None and owner.kind == "DaemonSet":
-                    for ds in self._daemon_sets.values():
-                        if ds.metadata.uid == owner.uid:
-                            ds.status.desired_number_scheduled = max(
-                                0, ds.status.desired_number_scheduled - 1)
+                    ds_key = self._ds_key_by_owner_uid(owner.uid)
+                    if ds_key is not None:
+                        ds = self._daemon_sets[ds_key]
+                        ds.status.desired_number_scheduled = max(
+                            0, ds.status.desired_number_scheduled - 1)
+                        self._notify(MODIFIED, KIND_DAEMON_SET, ds)
                 key = (pod.metadata.namespace, pod.metadata.name)
 
                 def gc(pod_key=key) -> None:
@@ -244,6 +246,18 @@ class FakeCluster(K8sClient):
         """Revisions owned by exactly this DaemonSet (lock must be held)."""
         return [rev for key, rev in self._revisions.items()
                 if self._revision_owner.get(key) == (namespace, ds_name)]
+
+    def set_daemon_set_desired(self, namespace: str, name: str,
+                               desired: int) -> None:
+        """Adjust a DaemonSet's desired count (scale-up/down events in
+        tests — the real DS controller recomputes this from the node
+        list)."""
+        with self._lock:
+            ds = self._daemon_sets.get((namespace, name))
+            if ds is None:
+                raise NotFoundError(f"daemonset {namespace}/{name} not found")
+            ds.status.desired_number_scheduled = desired
+            self._notify(MODIFIED, KIND_DAEMON_SET, ds)
 
     def bump_daemon_set_revision(self, namespace: str, name: str,
                                  revision_hash: str) -> None:
@@ -553,6 +567,12 @@ class FakeCluster(K8sClient):
             self._notify(DELETED, KIND_POD, pod)
             self._maybe_recreate_ds_pod(pod)
 
+    def _ds_key_by_owner_uid(self, uid: str) -> Optional[tuple[str, str]]:
+        """(namespace, name) of the DaemonSet with this UID, or None.
+        Call with the lock held."""
+        return next((k for k, ds in self._daemon_sets.items()
+                     if ds.metadata.uid == uid), None)
+
     def _maybe_recreate_ds_pod(self, pod: Pod) -> None:
         """DS controller simulation: recreate a deleted DS-owned pod with the
         newest revision hash (must be called with the lock held)."""
@@ -562,12 +582,19 @@ class FakeCluster(K8sClient):
         owner = pod.controller_owner()
         if owner is None or owner.kind != "DaemonSet":
             return
-        ds_key = next((k for k, ds in self._daemon_sets.items()
-                       if ds.metadata.uid == owner.uid), None)
+        ds_key = self._ds_key_by_owner_uid(owner.uid)
         if ds_key is None:
             return
         namespace, ds_name = ds_key
         node_name = pod.spec.node_name
+        if node_name not in self._nodes:
+            # The pod's node is ALREADY gone (a stranded pod deleted or
+            # evicted during the GC window): no recreation, and no
+            # accounting either — delete_node already decremented
+            # desired for every pod present at node-deletion time. The
+            # closure-side decrement below covers only the node
+            # vanishing BETWEEN this scheduling and the recreate firing.
+            return
         recreate_delay, ready_delay = cfg.recreate_delay, cfg.ready_delay
         if self._ds_delay_fn is not None:
             recreate_delay, ready_delay = self._ds_delay_fn(node_name)
@@ -586,6 +613,7 @@ class FakeCluster(K8sClient):
                     # this closure owns the in-flight-recreation case)
                     ds.status.desired_number_scheduled = max(
                         0, ds.status.desired_number_scheduled - 1)
+                    self._notify(MODIFIED, KIND_DAEMON_SET, ds)
                     return
                 new_hash = self.latest_revision_hash(namespace, ds_name)
                 labels = dict(ds.spec.selector)

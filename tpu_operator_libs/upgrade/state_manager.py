@@ -316,10 +316,16 @@ class ClusterUpgradeStateManager:
             stranded = stranded_by_uid.get(ds.metadata.uid, 0)
             # Completeness guard (upgrade_state.go:243-246), vanished-
             # node aware: after a node deletion the DS controller may
-            # not have dropped its desired count yet, so BOTH the
-            # synced count (live pods) and the lagging count (live +
-            # stranded) are complete pictures. Anything else means
-            # genuinely unscheduled pods — refuse to act.
+            # not yet have dropped its desired count, so the lagging
+            # count (live + stranded) is accepted alongside the synced
+            # one. Deliberate tradeoff: while BOTH a stranded pod and an
+            # in-flight recreation exist, the lagging interpretation can
+            # mask the recreation and the throttle can overshoot by at
+            # most the stranded-pod count for one pass — bounded,
+            # transient, and self-correcting, versus the reference's
+            # answer of stalling the ENTIRE fleet for the whole GC
+            # window. Anything outside these two counts means genuinely
+            # unscheduled pods — refuse to act.
             if ds.status.desired_number_scheduled not in (
                     len(ds_pods), len(ds_pods) + stranded):
                 raise BuildStateError(
