@@ -93,6 +93,21 @@ class TestBuildState:
         assert any("has no node" in m for m in messages)
         assert not any("no longer exists" in m for m in messages)
 
+    def test_vanished_node_warning_fires_once_then_debug(self, caplog):
+        import logging
+
+        env = make_env()
+        setup_fleet(env, n_nodes=2)
+        env.cluster.delete_node("node-1")
+        mgr = make_state_manager(env)
+        with caplog.at_level(logging.DEBUG):
+            mgr.build_state(NS, RUNTIME_LABELS)
+            mgr.build_state(NS, RUNTIME_LABELS)
+        vanished = [r for r in caplog.records
+                    if "no longer exists" in r.message]
+        assert [r.levelno for r in vanished] == [logging.WARNING,
+                                                 logging.DEBUG]
+
     def test_node_added_mid_upgrade_joins_the_rollout(self):
         # autoscaler scale-up: a new node appears mid-upgrade with an
         # old-revision runtime pod — it enters the machine at unknown

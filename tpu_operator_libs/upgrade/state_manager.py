@@ -194,6 +194,9 @@ class ClusterUpgradeStateManager:
         self._multislice_constraint_is_custom = False
 
         self._pod_deletion_enabled = False
+        # vanished nodes already warned about (log-dedup only; carries
+        # no state-machine meaning — apply_state stays snapshot-driven)
+        self._warned_vanished: set[str] = set()
         self._validation_enabled = False
 
     @property
@@ -294,9 +297,19 @@ class ClusterUpgradeStateManager:
         # the whole GC window.
         live_pods = []
         stranded_by_uid: dict[str, int] = {}
+        vanished_now: set[str] = set()
         for pod in pods:
             if pod.spec.node_name and pod.spec.node_name not in nodes_by_name:
-                logger.warning(
+                # WARNING once per vanished node, DEBUG on the repeats —
+                # the condition persists for the whole pod-GC window and
+                # a per-pass warning would just be noise. vanished_now
+                # covers a second pod of the same node within this pass.
+                repeat = (pod.spec.node_name in self._warned_vanished
+                          or pod.spec.node_name in vanished_now)
+                vanished_now.add(pod.spec.node_name)
+                level = logging.DEBUG if repeat else logging.WARNING
+                logger.log(
+                    level,
                     "node %r (runtime pod %s) no longer exists; "
                     "skipping until pod GC removes the pod",
                     pod.spec.node_name, pod.name)
@@ -307,6 +320,8 @@ class ClusterUpgradeStateManager:
                 continue
             live_pods.append(pod)
         pods = live_pods
+        # forget healed entries so a future recurrence warns again
+        self._warned_vanished = vanished_now
 
         filtered: list[tuple[Pod, Optional[DaemonSet]]] = []
         for ds in daemon_sets.values():
