@@ -331,3 +331,90 @@ class TestLeaderElectedRun:
         stop_b.set()
         tb.join(timeout=10.0)
         assert not mgr_b.is_started
+
+
+class TestUpgradeSurvivesLeadershipHandover:
+    """The labels-as-database claim, replica to replica: a rolling
+    upgrade begun by the leader resumes exactly where it stopped when a
+    standby takes over — BOTH replicas run the real state machine
+    against the shared cluster (docs/automatic-libtpu-upgrade.md "HA
+    deployment")."""
+
+    def test_upgrade_completes_across_handover(self):
+        from tpu_operator_libs.api.upgrade_policy import (
+            DrainSpec,
+            UpgradePolicySpec,
+        )
+        from tpu_operator_libs.simulate import (
+            NS as SIM_NS,
+            RUNTIME_LABELS,
+            FleetSpec,
+            build_fleet,
+        )
+        from tpu_operator_libs.upgrade.state_manager import (
+            ClusterUpgradeStateManager,
+        )
+
+        cluster, clock, keys = build_fleet(
+            FleetSpec(n_slices=2, hosts_per_slice=2,
+                      pod_recreate_delay=1.0, pod_ready_delay=1.0))
+        policy = UpgradePolicySpec(
+            auto_upgrade=True, max_parallel_upgrades=0,
+            max_unavailable="50%", topology_mode="slice",
+            drain=DrainSpec(enable=True, force=True))
+
+        def make_replica(identity):
+            sm = ClusterUpgradeStateManager(
+                cluster, keys, async_workers=False, poll_interval=0.0)
+
+            def reconcile(key):
+                sm.reconcile(SIM_NS, RUNTIME_LABELS, policy)
+                return ReconcileResult()
+
+            return OperatorManager(
+                cluster, SIM_NS, reconcile, name=identity,
+                resync_period=0.05,
+                leader_election=LeaderElectionConfig(
+                    namespace="kube-system", name="op-leader",
+                    identity=identity, lease_duration=2.0,
+                    renew_deadline=1.5, retry_period=0.05))
+
+        rep_a, rep_b = make_replica("rep-a"), make_replica("rep-b")
+        stop_a, stop_b = threading.Event(), threading.Event()
+        ta = threading.Thread(target=lambda: rep_a.run(stop_a), daemon=True)
+        tb = threading.Thread(target=lambda: rep_b.run(stop_b), daemon=True)
+        ta.start()
+        assert wait_until(lambda: rep_a.is_started)
+        tb.start()
+
+        def states():
+            return {n.metadata.name: n.metadata.labels.get(keys.state_label)
+                    for n in cluster.list_nodes()}
+
+        def pump(predicate, timeout=20.0):
+            deadline = time.monotonic() + timeout
+            while time.monotonic() < deadline:
+                clock.advance(0.5)
+                cluster.step()
+                if predicate():
+                    return True
+                time.sleep(0.02)
+            return predicate()
+
+        # leader A drives the fleet mid-upgrade...
+        assert pump(lambda: any(s and s not in ("upgrade-done",)
+                                for s in states().values()))
+        assert not rep_b.is_started  # standby stays gated
+        mid_upgrade = states()
+        # ...and dies; the standby must pick the upgrade up from the
+        # labels alone and finish it
+        stop_a.set()
+        ta.join(timeout=10.0)
+        assert wait_until(lambda: rep_b.is_started, timeout=15.0)
+        assert pump(lambda: set(states().values()) == {"upgrade-done"},
+                    timeout=30.0)
+        stop_b.set()
+        tb.join(timeout=10.0)
+        # the handover happened mid-flight, not after completion
+        assert any(s != "upgrade-done" for s in mid_upgrade.values()), \
+            mid_upgrade
