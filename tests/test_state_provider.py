@@ -2,6 +2,8 @@
 parity: patch + readback, annotation null-delete, cache-sync polling)."""
 
 import pytest
+from hypothesis import assume, given, settings
+from hypothesis import strategies as st
 
 from tpu_operator_libs.consts import UpgradeState
 from tpu_operator_libs.k8s.client import ApiServerError
@@ -155,6 +157,42 @@ class TestOptimisticConcurrency:
         assert env.provider.change_node_upgrade_state(
             snapshot, UpgradeState.CORDON_REQUIRED) is True
         assert env.state_of("n1") == "cordon-required"
+
+
+class TestOptimisticConcurrencyProperty:
+    """Property: for ANY (snapshot, live, target) label triple, the
+    write lands iff the live label is the snapshot's (fresh) or already
+    the target (idempotent duplicate); otherwise the live label is left
+    exactly as it was. Hypothesis drives the full matrix including the
+    unknown ('') state."""
+
+    _labels = st.sampled_from(
+        ["", "upgrade-required", "cordon-required", "drain-required",
+         "pod-restart-required", "upgrade-done", "upgrade-failed"])
+
+    @settings(deadline=None)
+    @given(snapshot=_labels, live=_labels, target=_labels)
+    def test_write_matrix(self, snapshot, live, target):
+        assume(target != "")  # "" is the absence of the label, never a
+        # value a transition writes
+        env = make_env()
+        NodeBuilder("n1").create(env.cluster)
+        if snapshot:
+            env.cluster.patch_node_labels(
+                "n1", {env.keys.state_label: snapshot})
+        node = env.provider.get_node("n1")
+        env.cluster.patch_node_labels(
+            "n1", {env.keys.state_label: live or None})
+        committed = env.provider.change_node_upgrade_state(node, target)
+        final = env.state_of("n1")
+        if live in (snapshot, target):
+            assert committed is True
+            assert final == target
+            assert node.metadata.labels.get(
+                env.keys.state_label, "") == target
+        else:
+            assert committed is False
+            assert final == live  # untouched
 
 
 class TestGetNode:
