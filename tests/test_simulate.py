@@ -160,3 +160,32 @@ class TestGraftEntry:
         assert data["unit"] == "%"
         assert data["value"] > 0
         assert data["vs_baseline"] >= 1.0
+
+
+class TestChaosCombined:
+    """Capstone: every fault class in ONE rolling upgrade — seeded
+    delay jitter, a straggler host, a crash-looping runtime pod, a
+    NotReady flip, a mid-upgrade scale-down, and a multislice job —
+    exercising the interactions the per-fault tests cannot."""
+
+    def test_all_faults_together_converges_with_invariants(self):
+        r = simulate_rolling_upgrade(
+            topology_mode="slice", chained=True,
+            fleet=FleetSpec(
+                n_slices=4, hosts_per_slice=2,
+                delay_jitter=0.35,
+                straggler_nodes=("s0-h1",),
+                crashloop_nodes=("s2-h0",),
+                crashloop_heal_after=300.0,
+                not_ready_nodes=("s3-h1",),
+                not_ready_at=40.0,
+                not_ready_heal_at=120.0,
+                multislice_jobs=(("train", (0, 1)),),
+                node_removals=(("s1-h1", 100.0),)))
+        assert r.converged, "chaos fleet did not converge"
+        # the multislice budget held through every fault
+        assert all(v <= 1 for v in r.max_down_members_per_job.values()), \
+            r.max_down_members_per_job
+        # drains produced a real distribution despite the chaos
+        assert r.drain_to_ready_p50 is not None
+        assert r.drain_to_ready_p95 >= r.drain_to_ready_p50
