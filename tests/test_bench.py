@@ -65,6 +65,11 @@ class TestHardwareResult:
                    JAX_PLATFORMS="cpu",
                    BENCH_PROBE_MXU_DIM="256", BENCH_PROBE_MXU_CHAIN="4",
                    BENCH_PROBE_HBM_MIB="8", BENCH_PROBE_HBM_ITERS="4")
+        # keep the subprocess off the accelerator tunnel entirely: with
+        # this var set, the host's sitecustomize registers the TPU PJRT
+        # plugin at interpreter start, which can block when the tunnel
+        # is wedged — even though the script itself pins jax to CPU
+        env.pop("PALLAS_AXON_POOL_IPS", None)
         proc = subprocess.run(
             [sys.executable, "-c", bench._PROBE_SCRIPT],
             capture_output=True, text=True, timeout=240, env=env,
