@@ -221,10 +221,11 @@ try:
     float(fn(jnp.ones((M, M), jnp.bfloat16), y))  # compile + warm
     best = None
     for rep in range(3):
-        # distinct inputs per rep so no caching layer can serve a
-        # repeat; rep/64 is exactly representable in bf16 (8-bit
-        # mantissa), unlike 1e-3 steps which would all round to 1.0
-        x = jnp.full((M, M), 1.0 + rep / 64.0, jnp.bfloat16)
+        # distinct inputs per rep — and distinct from the all-ones
+        # warm-up — so no value-keyed caching layer can serve a repeat;
+        # (rep+1)/64 is exactly representable in bf16 (8-bit mantissa),
+        # unlike 1e-3 steps which would all round to 1.0
+        x = jnp.full((M, M), 1.0 + (rep + 1) / 64.0, jnp.bfloat16)
         t0 = time.perf_counter()
         float(fn(x, y))  # host readback = completion fence
         dt = time.perf_counter() - t0
@@ -240,10 +241,17 @@ try:
     # x * 1.0). Isolated in its own try: an HBM-only failure (e.g.
     # RESOURCE_EXHAUSTED when another process holds the chip's memory)
     # must not discard the valid ICI/MXU measurements above.
-    HBM_MIB = int(os.environ.get("BENCH_PROBE_HBM_MIB", "512"))
-    HBM_ITERS = int(os.environ.get("BENCH_PROBE_HBM_ITERS", "64"))
+    # Buffer/iteration counts tuned on a live v5e: per-iteration loop
+    # overhead is ~1 ms, so a 512 MiB buffer (2.6 ms of pure streaming
+    # per pass) under-measures by ~30%; 1024 MiB x 128 iters amortizes
+    # it (measured 555 vs 396 GB/s on the same chip). Lane-aligned 2D
+    # shape so Mosaic never pads.
+    HBM_MIB = int(os.environ.get("BENCH_PROBE_HBM_MIB", "1024"))
+    HBM_ITERS = int(os.environ.get("BENCH_PROBE_HBM_ITERS", "128"))
     try:
         n_elems = (HBM_MIB << 20) // 2  # bf16
+        # n_elems is HBM_MIB * 2^19, always a multiple of 512
+        hbm_shape = (n_elems // 512, 512)
 
         def hbm_fn(a):
             out = lax.fori_loop(
@@ -251,10 +259,11 @@ try:
             return jnp.sum(out.astype(jnp.float32))
 
         hfn = jax.jit(hbm_fn)
-        float(hfn(jnp.ones((n_elems,), jnp.bfloat16)))  # compile + warm
+        float(hfn(jnp.ones(hbm_shape, jnp.bfloat16)))  # compile + warm
         hbm_best = None
         for rep in range(3):
-            a = jnp.full((n_elems,), 1.0 + rep / 64.0, jnp.bfloat16)
+            a = jnp.full(hbm_shape, 1.0 + (rep + 1) / 64.0,
+                         jnp.bfloat16)
             t0 = time.perf_counter()
             float(hfn(a))
             dt = time.perf_counter() - t0
