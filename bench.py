@@ -244,7 +244,7 @@ try:
     # Buffer/iteration counts tuned on a live v5e: per-iteration loop
     # overhead is ~1 ms, so a 512 MiB buffer (2.6 ms of pure streaming
     # per pass) under-measures by ~30%; 1024 MiB x 128 iters amortizes
-    # it (measured 555 vs 396 GB/s on the same chip). Lane-aligned 2D
+    # it (measured 553 vs 396 GB/s on the same chip). Lane-aligned 2D
     # shape so Mosaic never pads.
     HBM_MIB = int(os.environ.get("BENCH_PROBE_HBM_MIB", "1024"))
     HBM_ITERS = int(os.environ.get("BENCH_PROBE_HBM_ITERS", "128"))
@@ -574,9 +574,11 @@ def _reconcile_latency_cells(passes: int = 9) -> dict:
     Interpretation: p50 scales ~linearly with fleet size (snapshot +
     bucket walk). p95 captures the "wave" pass where maxUnavailable
     worth of nodes (256 at 1024 nodes / 25%) transition in one pass —
-    cost is O(wave size) node-label writes, the same writes a real
-    apiserver would absorb as PATCHes; profiling shows no superlinear
-    hot spot (clone-on-read value semantics of the fake dominates)."""
+    cost is O(wave size) node-label writes plus one indexed
+    pods-on-node LIST per drained node (the fake serves spec.nodeName
+    field selectors from an index, as the apiserver does; before that
+    index the wave pass was O(wave x all-pods) and p95 at 1024 nodes
+    ran ~5x higher)."""
     cells: dict = {}
     for n_slices, hosts in ((64, 4), (64, 16)):
         label = f"{n_slices * hosts}_nodes"

@@ -9,6 +9,7 @@ from tpu_operator_libs.k8s.fake import FakeCluster
 from tpu_operator_libs.k8s.objects import PodPhase
 from tpu_operator_libs.k8s.selectors import (
     SelectorParseError,
+    exact_field_requirement,
     matches_labels,
     parse_field_selector,
     selector_from_labels,
@@ -53,6 +54,18 @@ class TestSelectors:
     def test_parse_error(self):
         with pytest.raises(SelectorParseError):
             matches_labels("a><b", {})
+
+    @pytest.mark.parametrize("selector,key,expected", [
+        ("spec.nodeName=n1", "spec.nodeName", "n1"),
+        ("spec.nodeName==n1", "spec.nodeName", "n1"),
+        ("status.phase=Running,spec.nodeName=n1", "spec.nodeName", "n1"),
+        ("spec.nodeName!=n1", "spec.nodeName", None),  # exclusion pins nothing
+        ("status.phase=Running", "spec.nodeName", None),
+        ("", "spec.nodeName", None),
+        ("a><b", "spec.nodeName", None),  # unparseable: caller's matcher raises
+    ])
+    def test_exact_field_requirement(self, selector, key, expected):
+        assert exact_field_requirement(selector, key) == expected
 
 
 class TestCloneCompleteness:
@@ -268,6 +281,80 @@ class TestFakeClusterPods:
         assert cluster.list_pods() == []
         with pytest.raises(NotFoundError):
             cluster.delete_pod("tpu-system", "p1")
+
+    def test_node_name_index_tracks_every_mutation_path(self):
+        """The spec.nodeName indexed LIST path must agree with a full
+        scan after every pod lifecycle event: add, delete, evict with
+        DS-controller recreate, and node deletion with delayed pod GC."""
+        clock = FakeClock()
+        cluster = FakeCluster(clock=clock)
+        cluster.enable_ds_controller(recreate_delay=2.0, ready_delay=1.0)
+
+        def assert_index_consistent():
+            for node in ("n1", "n2", "gone"):
+                indexed = {p.name for p in cluster.list_pods(
+                    field_selector=f"spec.nodeName={node}")}
+                scanned = {p.name for p in cluster.list_pods()
+                           if p.spec.node_name == node}
+                assert indexed == scanned
+
+        NodeBuilder("n1").create(cluster)
+        NodeBuilder("n2").create(cluster)
+        ds = DaemonSetBuilder("libtpu").create(cluster)
+        p1 = (PodBuilder("p1").on_node("n1").owned_by(ds)
+              .with_revision_hash(cluster.latest_revision_hash(
+                  "tpu-system", "libtpu")).create(cluster))
+        PodBuilder("p2").on_node("n2").create(cluster)
+        assert_index_consistent()
+
+        # evict a DS-owned pod: removed now, recreated on n1 later
+        cluster.evict_pod(p1.namespace, p1.name)
+        assert_index_consistent()
+        clock.advance(2.5)
+        cluster.step()
+        assert len(cluster.list_pods(
+            field_selector="spec.nodeName=n1")) == 1  # recreated
+        assert_index_consistent()
+
+        # plain delete
+        cluster.delete_pod("tpu-system", "p2")
+        assert_index_consistent()
+
+        # node deletion strands its pods until GC fires
+        cluster.delete_node("n1")
+        assert_index_consistent()
+        clock.advance(60.0)
+        cluster.step()
+        assert cluster.list_pods(
+            field_selector="spec.nodeName=n1") == []
+        assert_index_consistent()
+
+    def test_empty_node_name_selector_lists_unscheduled_pods(self):
+        """'spec.nodeName=' selects pending (unbound) pods — the indexed
+        fast path must not swallow them."""
+        cluster = FakeCluster()
+        NodeBuilder("n1").create(cluster)
+        PodBuilder("bound").on_node("n1").create(cluster)
+        PodBuilder("pending").create(cluster)  # no node assignment
+        assert [p.name for p in cluster.list_pods(
+            field_selector="spec.nodeName=")] == ["pending"]
+        assert [p.name for p in cluster.list_pods(
+            field_selector="spec.nodeName=n1")] == ["bound"]
+
+    def test_add_pod_overwrite_reindexes_node(self):
+        """Re-adding a pod under the same key but a different node must
+        not leave a stale index entry behind."""
+        cluster = FakeCluster()
+        NodeBuilder("a").create(cluster)
+        NodeBuilder("b").create(cluster)
+        PodBuilder("x").on_node("a").create(cluster)
+        PodBuilder("x").on_node("b").create(cluster)  # overwrite
+        assert cluster.list_pods(field_selector="spec.nodeName=a") == []
+        assert [p.name for p in cluster.list_pods(
+            field_selector="spec.nodeName=b")] == ["x"]
+        cluster.delete_pod("tpu-system", "x")
+        # the stale entry used to make this raise KeyError
+        assert cluster.list_pods(field_selector="spec.nodeName=a") == []
 
     def test_eviction_blocker(self):
         cluster = FakeCluster()

@@ -54,6 +54,7 @@ from tpu_operator_libs.k8s.objects import (
     new_uid,
 )
 from tpu_operator_libs.k8s.selectors import (
+    exact_field_requirement,
     parse_field_selector,
     parse_label_selector,
 )
@@ -93,6 +94,13 @@ class FakeCluster(K8sClient):
         self._lock = threading.RLock()
         self._nodes: dict[str, Node] = {}
         self._pods: dict[tuple[str, str], Pod] = {}
+        # spec.nodeName index over _pods, maintained by _pod_put/_pod_pop
+        # (pod nodeName is immutable once bound, as in Kubernetes, so
+        # membership never changes in place). Serves the apiserver's
+        # indexed spec.nodeName field-selector path at fleet scale: a
+        # drain wave issues one pods-on-node LIST per node, and a full
+        # scan per LIST makes the wave O(pods^2).
+        self._pods_by_node: dict[str, set[tuple[str, str]]] = {}
         self._daemon_sets: dict[tuple[str, str], DaemonSet] = {}
         self._revisions: dict[tuple[str, str], ControllerRevision] = {}
         # Revision ownership by DS identity, so DaemonSets whose names share
@@ -179,8 +187,8 @@ class FakeCluster(K8sClient):
             cfg = self._ds_controller
             if cfg is None or not cfg.enabled:
                 return
-            stranded = [p for p in self._pods.values()
-                        if p.spec.node_name == name]
+            stranded = [self._pods[k] for k in sorted(
+                self._pods_by_node.get(name, ()))]
             for pod in stranded:
                 owner = pod.controller_owner()
                 if owner is not None and owner.kind == "DaemonSet":
@@ -194,17 +202,39 @@ class FakeCluster(K8sClient):
 
                 def gc(pod_key=key) -> None:
                     with self._lock:
-                        gone = self._pods.pop(pod_key, None)
+                        gone = self._pod_pop(pod_key)
                         if gone is not None:
                             self._notify(DELETED, KIND_POD, gone)
                         # no recreate: the node is gone
 
                 self._schedule(cfg.pod_gc_delay, gc)
 
+    def _pod_put(self, pod: Pod) -> None:
+        """Insert/replace a pod in the store + nodeName index (lock held)."""
+        key = (pod.metadata.namespace, pod.metadata.name)
+        if key in self._pods:
+            # replacing an existing pod: drop its old index entry, which
+            # may live under a different node
+            self._pod_pop(key)
+        self._pods[key] = pod
+        if pod.spec.node_name:
+            self._pods_by_node.setdefault(
+                pod.spec.node_name, set()).add(key)
+
+    def _pod_pop(self, key: tuple[str, str]) -> Optional[Pod]:
+        """Remove a pod from the store + nodeName index (lock held)."""
+        pod = self._pods.pop(key, None)
+        if pod is not None and pod.spec.node_name:
+            members = self._pods_by_node.get(pod.spec.node_name)
+            if members is not None:
+                members.discard(key)
+                if not members:
+                    del self._pods_by_node[pod.spec.node_name]
+        return pod
+
     def add_pod(self, pod: Pod) -> Pod:
         with self._lock:
-            self._pods[(pod.metadata.namespace, pod.metadata.name)] = (
-                pod.clone())
+            self._pod_put(pod.clone())
             self._notify(ADDED, KIND_POD, pod)
         return pod
 
@@ -496,9 +526,20 @@ class FakeCluster(K8sClient):
         self._maybe_api_error("list_pods")
         label_match = parse_label_selector(label_selector)
         field_match = parse_field_selector(field_selector)
+        node = exact_field_requirement(field_selector, "spec.nodeName")
         with self._lock:
+            # truthiness matters: "spec.nodeName=" selects UNSCHEDULED
+            # pods, which the index (bound pods only) cannot serve
+            if node:
+                # indexed path (narrows candidates; full matchers still
+                # apply below, so semantics are unchanged)
+                candidates = [self._pods[k] for k in sorted(
+                    self._pods_by_node.get(node, ()))]
+            else:
+                candidates = list(self._pods.values())
             out = []
-            for (ns, _), pod in self._pods.items():
+            for pod in candidates:
+                ns = pod.metadata.namespace
                 if namespace is not None and namespace != "" and ns != namespace:
                     continue
                 if not label_match(pod.metadata.labels):
@@ -546,7 +587,7 @@ class FakeCluster(K8sClient):
     def delete_pod(self, namespace: str, name: str) -> None:
         self._maybe_api_error("delete_pod")
         with self._lock:
-            pod = self._pods.pop((namespace, name), None)
+            pod = self._pod_pop((namespace, name))
             if pod is None:
                 raise NotFoundError(f"pod {namespace}/{name} not found")
             self._notify(DELETED, KIND_POD, pod)
@@ -563,7 +604,7 @@ class FakeCluster(K8sClient):
                     raise EvictionBlockedError(
                         f"eviction of {namespace}/{name} blocked by "
                         f"disruption budget")
-            del self._pods[(namespace, name)]
+            self._pod_pop((namespace, name))
             self._notify(DELETED, KIND_POD, pod)
             self._maybe_recreate_ds_pod(pod)
 
@@ -630,7 +671,7 @@ class FakeCluster(K8sClient):
                         phase=PodPhase.RUNNING,
                         container_statuses=[
                             ContainerStatus(name="runtime", ready=False)]))
-                self._pods[(namespace, pod_name)] = new_pod
+                self._pod_put(new_pod)
                 self._notify(ADDED, KIND_POD, new_pod)
 
                 def make_ready(due: float) -> None:

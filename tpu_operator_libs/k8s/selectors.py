@@ -16,7 +16,7 @@ flow uses, faithfully enough that policy fields like
 from __future__ import annotations
 
 import re
-from typing import Callable, Mapping
+from typing import Callable, Mapping, Optional
 
 Matcher = Callable[[Mapping[str, str]], bool]
 
@@ -129,6 +129,26 @@ def parse_field_selector(selector: str) -> Matcher:
         else:
             requirements.append(lambda fields, k=key, v=val: fields.get(k) != v)
     return lambda fields: all(r(fields) for r in requirements)
+
+
+def exact_field_requirement(selector: str, key: str) -> Optional[str]:
+    """The value an ``=``/``==`` requirement pins ``key`` to, or None.
+
+    Lets a store serve an indexed fast path for common exact-match field
+    selectors (the apiserver does the same for ``spec.nodeName`` on
+    pods) without changing matching semantics: callers still apply the
+    full compiled matcher; this only narrows the candidate set. Returns
+    None for absent keys, ``!=`` requirements, and unparseable
+    selectors (the caller's full matcher is the one that raises).
+    """
+    selector = (selector or "").strip()
+    if not selector:
+        return None
+    for req in _split_requirements(selector):
+        m = _EQ_RE.match(req)
+        if m and m.group("key") == key and m.group("op") in ("=", "=="):
+            return m.group("val")
+    return None
 
 
 def selector_from_labels(labels: Mapping[str, str]) -> str:
