@@ -158,6 +158,13 @@ def _lease_from_body(body) -> Lease:
 class _Api:
     def __init__(self, cluster):
         self._cluster = cluster
+        # continue-token pagination state (apiserver limit/continue
+        # emulation): token -> (remaining items snapshot). Set
+        # expire_tokens=True to 410 every continuation, exercising the
+        # adapter's full-list fallback.
+        self._page_snapshots = {}
+        self._next_token = 0
+        self.expire_tokens = False
 
     def _do(self, fn, *args, **kwargs):
         try:
@@ -165,14 +172,35 @@ class _Api:
         except Exception as exc:
             _raise_as_api_exception(exc)
 
+    def _paginate(self, items, limit, token):
+        """Serve a LIST result page like the apiserver: at most ``limit``
+        items plus a continue token pinning the rest of the snapshot."""
+        if token:
+            if self.expire_tokens:
+                raise StubApiException(
+                    410, "the provided continue parameter is too old")
+            if token not in self._page_snapshots:
+                # unknown or already-consumed token: the apiserver
+                # answers 410, never a silently-empty page
+                raise StubApiException(
+                    410, f"unrecognized continue parameter {token!r}")
+            items = self._page_snapshots.pop(token)
+        if limit is None or len(items) <= limit:
+            return NS(items=items, metadata=NS(_continue=None))
+        self._next_token += 1
+        next_token = f"page-{self._next_token}"
+        self._page_snapshots[next_token] = items[limit:]
+        return NS(items=items[:limit], metadata=NS(_continue=next_token))
+
 
 class BehavioralCoreV1(_Api):
     def read_node(self, name):
         return node_to_k8s(self._do(self._cluster.get_node, name))
 
-    def list_node(self, label_selector=None):
+    def list_node(self, label_selector=None, limit=None, _continue=None):
         nodes = self._do(self._cluster.list_nodes, label_selector or "")
-        return NS(items=[node_to_k8s(n) for n in nodes])
+        return self._paginate([node_to_k8s(n) for n in nodes],
+                              limit, _continue)
 
     def patch_node(self, name, body):
         if "metadata" in body and "labels" in body["metadata"]:
@@ -189,16 +217,20 @@ class BehavioralCoreV1(_Api):
         return node_to_k8s(node)
 
     def list_namespaced_pod(self, namespace, label_selector=None,
-                            field_selector=None):
+                            field_selector=None, limit=None,
+                            _continue=None):
         pods = self._do(self._cluster.list_pods, namespace,
                         label_selector or "", field_selector or "")
-        return NS(items=[pod_to_k8s(p) for p in pods])
+        return self._paginate([pod_to_k8s(p) for p in pods],
+                              limit, _continue)
 
     def list_pod_for_all_namespaces(self, label_selector=None,
-                                    field_selector=None):
+                                    field_selector=None, limit=None,
+                                    _continue=None):
         pods = self._do(self._cluster.list_pods, None,
                         label_selector or "", field_selector or "")
-        return NS(items=[pod_to_k8s(p) for p in pods])
+        return self._paginate([pod_to_k8s(p) for p in pods],
+                              limit, _continue)
 
     def delete_namespaced_pod(self, name, namespace):
         self._do(self._cluster.delete_pod, namespace, name)
@@ -208,20 +240,25 @@ class BehavioralCoreV1(_Api):
 
 
 class BehavioralAppsV1(_Api):
-    def list_namespaced_daemon_set(self, namespace, label_selector=None):
+    def list_namespaced_daemon_set(self, namespace, label_selector=None,
+                                   limit=None, _continue=None):
         items = self._do(self._cluster.list_daemon_sets, namespace,
                          label_selector or "")
-        return NS(items=[daemon_set_to_k8s(d) for d in items])
+        return self._paginate([daemon_set_to_k8s(d) for d in items],
+                              limit, _continue)
 
-    def list_daemon_set_for_all_namespaces(self, label_selector=None):
+    def list_daemon_set_for_all_namespaces(self, label_selector=None,
+                                           limit=None, _continue=None):
         raise StubApiException(501, "all-namespace DS list not modeled "
                                     "by FakeCluster")
 
     def list_namespaced_controller_revision(self, namespace,
-                                            label_selector=None):
+                                            label_selector=None,
+                                            limit=None, _continue=None):
         items = self._do(self._cluster.list_controller_revisions,
                          namespace, label_selector or "")
-        return NS(items=[revision_to_k8s(r) for r in items])
+        return self._paginate([revision_to_k8s(r) for r in items],
+                              limit, _continue)
 
 
 class BehavioralCoordinationV1(_Api):

@@ -240,14 +240,16 @@ class TestRequestShapes:
         cluster.list_pods(namespace="ns", label_selector="app=x",
                           field_selector="spec.nodeName=n1")
         method, args, kwargs = stub_k8s.calls[-1]
-        assert method == "list_namespaced_pod" and args == ("ns",)
-        assert kwargs == {"label_selector": "app=x",
-                          "field_selector": "spec.nodeName=n1"}
+        assert method == "list_namespaced_pod" and args == ()
+        assert kwargs == {"namespace": "ns", "label_selector": "app=x",
+                          "field_selector": "spec.nodeName=n1",
+                          "limit": 500, "_continue": None}
         cluster.list_pods()  # no namespace -> all-namespaces endpoint
         method, _, kwargs = stub_k8s.calls[-1]
         assert method == "list_pod_for_all_namespaces"
         # empty selectors must be sent as None, not ""
-        assert kwargs == {"label_selector": None, "field_selector": None}
+        assert kwargs == {"label_selector": None, "field_selector": None,
+                          "limit": 500, "_continue": None}
 
     def test_evict_pod_builds_eviction_subresource(self, stub_k8s):
         make_cluster().evict_pod("ns", "p1")

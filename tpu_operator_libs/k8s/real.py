@@ -133,7 +133,8 @@ def _revision_from(obj) -> ControllerRevision:
 class RealCluster(K8sClient):
     """K8sClient against a live API server."""
 
-    def __init__(self, api_client: Optional[object] = None) -> None:
+    def __init__(self, api_client: Optional[object] = None,
+                 list_page_size: int = 500) -> None:
         # api_client: an optional kubernetes.client.ApiClient;
         # typed as object because the kubernetes package is an
         # import-gated optional dependency
@@ -143,8 +144,38 @@ class RealCluster(K8sClient):
         self._apps = k8s.AppsV1Api(api_client)
         self._coordination = k8s.CoordinationV1Api(api_client)
         self._k8s = k8s
+        # LIST chunk size (client-go pager default); <= 0 disables
+        # pagination and issues single unbounded LISTs
+        self._list_page_size = list_page_size
         # last-seen raw V1ObjectMeta per lease lock (see lease section)
         self._lease_raw_meta: dict = {}
+
+    def _paged_list(self, list_fn, **kwargs) -> list:
+        """client-go-pager-style LIST: chunk with limit/continue and
+        concatenate pages.
+
+        Large fleets make unbounded LISTs expensive for the apiserver
+        (client-go's ListPager chunks at 500 for the same reason). An
+        expired continue token (410 Gone mid-pagination — etcd compacted
+        the snapshot the token pinned) falls back to one full LIST, the
+        pager's ``FullListIfExpired`` behavior."""
+        if self._list_page_size <= 0:
+            return list(list_fn(**kwargs).items)
+        items: list = []
+        token: Optional[str] = None
+        while True:
+            try:
+                result = list_fn(limit=self._list_page_size,
+                                 _continue=token, **kwargs)
+            except self._k8s.ApiException as exc:
+                if getattr(exc, "status", None) == 410 and token:
+                    return list(list_fn(**kwargs).items)
+                raise
+            items.extend(result.items)
+            meta = getattr(result, "metadata", None)
+            token = getattr(meta, "_continue", None) or None
+            if not token:
+                return items
 
     @classmethod
     def from_kubeconfig(cls, context: Optional[str] = None) -> "RealCluster":
@@ -189,8 +220,9 @@ class RealCluster(K8sClient):
             raise self._translate(exc) from exc
 
     def list_nodes(self, label_selector: str = "") -> list[Node]:
-        result = self._core.list_node(label_selector=label_selector or None)
-        return [_node_from(item) for item in result.items]
+        items = self._paged_list(
+            self._core.list_node, label_selector=label_selector or None)
+        return [_node_from(item) for item in items]
 
     def patch_node_labels(self, name: str,
                           labels: Mapping[str, Optional[str]]) -> Node:
@@ -222,10 +254,13 @@ class RealCluster(K8sClient):
         kwargs = {"label_selector": label_selector or None,
                   "field_selector": field_selector or None}
         if namespace:
-            result = self._core.list_namespaced_pod(namespace, **kwargs)
+            items = self._paged_list(
+                self._core.list_namespaced_pod, namespace=namespace,
+                **kwargs)
         else:
-            result = self._core.list_pod_for_all_namespaces(**kwargs)
-        return [_pod_from(item) for item in result.items]
+            items = self._paged_list(
+                self._core.list_pod_for_all_namespaces, **kwargs)
+        return [_pod_from(item) for item in items]
 
     def delete_pod(self, namespace: str, name: str) -> None:
         try:
@@ -363,15 +398,17 @@ class RealCluster(K8sClient):
     # -- daemonsets & revisions ---------------------------------------------
     def list_daemon_sets(self, namespace: str,
                          label_selector: str = "") -> list[DaemonSet]:
-        result = self._apps.list_namespaced_daemon_set(
-            namespace, label_selector=label_selector or None)
-        return [_daemon_set_from(item) for item in result.items]
+        items = self._paged_list(
+            self._apps.list_namespaced_daemon_set, namespace=namespace,
+            label_selector=label_selector or None)
+        return [_daemon_set_from(item) for item in items]
 
     def list_controller_revisions(self, namespace: str,
                                   label_selector: str = "") -> list[ControllerRevision]:
-        result = self._apps.list_namespaced_controller_revision(
-            namespace, label_selector=label_selector or None)
-        return [_revision_from(item) for item in result.items]
+        items = self._paged_list(
+            self._apps.list_namespaced_controller_revision,
+            namespace=namespace, label_selector=label_selector or None)
+        return [_revision_from(item) for item in items]
 
     # -- leases (coordination.k8s.io, leader election) -----------------------
     # resourceVersion is opaque on the wire; it is carried through
