@@ -1,0 +1,175 @@
+"""Client-side flow control: token-bucket semantics and its transport
+mount in RealCluster (client-go ``flowcontrol`` + rest.Config
+rate-limiter parity — the layer the Python kubernetes client does not
+ship)."""
+
+import threading
+
+import pytest
+
+from tpu_operator_libs.k8s.fake import FakeCluster
+from tpu_operator_libs.k8s.flowcontrol import TokenBucketRateLimiter
+
+from builders import NodeBuilder
+from k8s_stub import install_behavioral_stub
+
+
+class ManualTime:
+    """Deterministic now()/sleep() pair: sleeping advances now."""
+
+    def __init__(self):
+        self.t = 0.0
+        self.slept = []
+
+    def now(self):
+        return self.t
+
+    def sleep(self, seconds):
+        self.slept.append(seconds)
+        self.t += seconds
+
+
+def make_limiter(qps=5.0, burst=10):
+    mt = ManualTime()
+    return TokenBucketRateLimiter(qps=qps, burst=burst,
+                                  now=mt.now, sleep=mt.sleep), mt
+
+
+class TestTokenBucket:
+    def test_burst_admitted_immediately(self):
+        limiter, mt = make_limiter(qps=1.0, burst=5)
+        assert [limiter.wait() for _ in range(5)] == [0.0] * 5
+        assert mt.slept == []
+
+    def test_post_burst_calls_space_at_qps(self):
+        limiter, _ = make_limiter(qps=2.0, burst=1)
+        assert limiter.wait() == 0.0
+        # each subsequent reservation matures 1/qps later
+        assert limiter.wait() == pytest.approx(0.5)
+        assert limiter.wait() == pytest.approx(0.5)
+
+    def test_tokens_refill_while_idle(self):
+        limiter, mt = make_limiter(qps=10.0, burst=2)
+        limiter.wait()
+        limiter.wait()
+        mt.t += 1.0  # idle: bucket refills to burst, not beyond
+        assert limiter.wait() == 0.0
+        assert limiter.wait() == 0.0
+        assert limiter.wait() > 0.0
+
+    def test_refill_caps_at_burst(self):
+        limiter, mt = make_limiter(qps=100.0, burst=3)
+        mt.t += 60.0  # a minute idle must not bank 6000 tokens
+        for _ in range(3):
+            assert limiter.wait() == 0.0
+        assert limiter.wait() > 0.0
+
+    def test_try_accept_never_blocks(self):
+        limiter, mt = make_limiter(qps=1.0, burst=1)
+        assert limiter.try_accept() is True
+        assert limiter.try_accept() is False
+        assert mt.slept == []
+        mt.t += 1.0
+        assert limiter.try_accept() is True
+
+    def test_waited_seconds_total_accumulates(self):
+        limiter, _ = make_limiter(qps=2.0, burst=1)
+        limiter.wait()
+        limiter.wait()
+        limiter.wait()
+        assert limiter.waited_seconds_total == pytest.approx(1.0)
+
+    def test_concurrent_waiters_serialize_at_qps(self):
+        # real clock, tiny scale: 1 token burst + 50 qps, 5 threads ->
+        # reservations must mature 20 ms apart, total wait >= 80 ms
+        limiter = TokenBucketRateLimiter(qps=50.0, burst=1)
+        delays = []
+        lock = threading.Lock()
+
+        def worker():
+            d = limiter.wait()
+            with lock:
+                delays.append(d)
+
+        threads = [threading.Thread(target=worker) for _ in range(5)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+        assert len(delays) == 5
+        assert max(delays) == pytest.approx(0.08, abs=0.02)
+
+    def test_invalid_config_rejected(self):
+        with pytest.raises(ValueError):
+            TokenBucketRateLimiter(qps=0.0)
+        with pytest.raises(ValueError):
+            TokenBucketRateLimiter(burst=0)
+
+
+class TestRealClusterTransportThrottling:
+    """The limiter mounts below the pager (client-go rest.Config
+    placement): every HTTP request charges a token, including each page
+    of a chunked LIST — not one token per K8sClient call."""
+
+    def make(self, qps=1000.0, burst=10**6, page_size=500):
+        cluster = FakeCluster()
+        restore = install_behavioral_stub(cluster)
+        from tpu_operator_libs.k8s.real import RealCluster
+
+        mt = ManualTime()
+        limiter = TokenBucketRateLimiter(qps=qps, burst=burst,
+                                         now=mt.now, sleep=mt.sleep)
+        client = RealCluster(list_page_size=page_size,
+                             rate_limiter=limiter)
+        return client, cluster, limiter, restore
+
+    def test_each_list_page_charges_a_token(self):
+        client, cluster, limiter, restore = self.make(page_size=3)
+        try:
+            for i in range(7):
+                NodeBuilder(f"n{i}").create(cluster)
+            waits = []
+            original = limiter.wait
+            limiter.wait = lambda: waits.append(original())  # type: ignore[method-assign]
+            assert len(client.list_nodes()) == 7
+            assert len(waits) == 3  # 7 items / page 3 -> 3 HTTP requests
+        finally:
+            restore()
+
+    def test_request_accounting_via_small_burst(self):
+        # burst 1, qps 10: a 3-page LIST must wait twice (2 requests
+        # beyond the burst token, 0.1 s apart), proving per-page charging
+        client, cluster, limiter, restore = self.make(
+            qps=10.0, burst=1, page_size=3)
+        try:
+            for i in range(7):
+                NodeBuilder(f"n{i}").create(cluster)
+            assert len(client.list_nodes()) == 7
+            assert limiter.waited_seconds_total == pytest.approx(0.2, abs=0.01)
+        finally:
+            restore()
+
+    def test_non_list_calls_throttled_too(self):
+        client, cluster, limiter, restore = self.make(qps=10.0, burst=1)
+        try:
+            NodeBuilder("n1").create(cluster)
+            client.get_node("n1")
+            client.patch_node_labels("n1", {"k": "v"})
+            assert cluster.get_node("n1").metadata.labels["k"] == "v"
+            # 2 requests through a burst-1 bucket: the second waited
+            assert limiter.waited_seconds_total > 0.0
+        finally:
+            restore()
+
+    def test_unthrottled_by_default(self):
+        cluster = FakeCluster()
+        restore = install_behavioral_stub(cluster)
+        try:
+            from tpu_operator_libs.k8s.real import RealCluster
+
+            client = RealCluster()
+            assert client.rate_limiter is None
+            NodeBuilder("n1").create(cluster)
+            assert len(client.list_nodes()) == 1
+        finally:
+            restore()

@@ -313,6 +313,12 @@ def main() -> int:
                              "requires --ici-probe")
     parser.add_argument("--ici-probe", action="store_true",
                         help="gate validation on the local ICI fabric probe")
+    parser.add_argument("--api-qps", type=float, default=20.0,
+                        help="client-side API rate limit in requests/s "
+                             "(controller-runtime default 20; 0 disables)")
+    parser.add_argument("--api-burst", type=int, default=30,
+                        help="client-side API burst size "
+                             "(controller-runtime default 30)")
     parser.add_argument("--kubeconfig", action="store_true",
                         help="connect via local kubeconfig (else in-cluster)")
     parser.add_argument("--leader-elect", action="store_true",
@@ -333,6 +339,9 @@ def main() -> int:
     if args.min_bandwidth_gbytes_per_s is not None and not args.ici_probe:
         # without the probe the floor would be silently unenforced
         parser.error("--min-bandwidth-gbytes-per-s requires --ici-probe")
+    if args.api_qps > 0 and args.api_burst < 1:
+        parser.error("--api-burst must be >= 1 when --api-qps is enabled "
+                     "(use --api-qps 0 to disable client-side throttling)")
 
     logging.basicConfig(
         level=logging.INFO,
@@ -347,8 +356,21 @@ def main() -> int:
 
         from tpu_operator_libs.k8s.real import RealCluster
 
-        cluster = (RealCluster.from_kubeconfig() if args.kubeconfig
-                   else RealCluster.in_cluster())
+        limiter = None
+        if args.api_qps > 0:
+            # client-go charges every HTTP request against a token
+            # bucket at the transport; the Python client has no such
+            # layer, so RealCluster mounts ours in the same place
+            from tpu_operator_libs.k8s.flowcontrol import (
+                TokenBucketRateLimiter,
+            )
+
+            limiter = TokenBucketRateLimiter(
+                qps=args.api_qps, burst=args.api_burst)
+        cluster = (
+            RealCluster.from_kubeconfig(rate_limiter=limiter)
+            if args.kubeconfig
+            else RealCluster.in_cluster(rate_limiter=limiter))
         policy = load_policy(args.policy)
         stop = threading.Event()
         signal.signal(signal.SIGTERM, lambda *a: stop.set())

@@ -14,6 +14,8 @@ The reference leans on k8s.io/client-go, apimachinery and controller-runtime
   (import-gated; not required for tests or simulation).
 - ``leaderelection``: Lease-based leader election for HA operator
   deployments (client-go tools/leaderelection analogue).
+- ``flowcontrol``: client-side token-bucket QPS limiting (client-go
+  ``flowcontrol`` analogue; the Python kubernetes client ships none).
 - ``cached``: informer-backed read cache over any backend — the
   controller-runtime cached-client analogue the provider's read-back
   poll was designed against.
@@ -33,6 +35,9 @@ from tpu_operator_libs.k8s.objects import (  # noqa: F401
 from tpu_operator_libs.k8s.cached import CachedReadClient  # noqa: F401
 from tpu_operator_libs.k8s.client import K8sClient  # noqa: F401
 from tpu_operator_libs.k8s.fake import FakeCluster  # noqa: F401
+from tpu_operator_libs.k8s.flowcontrol import (  # noqa: F401
+    TokenBucketRateLimiter,
+)
 from tpu_operator_libs.k8s.leaderelection import (  # noqa: F401
     LeaderElectionConfig,
     LeaderElector,

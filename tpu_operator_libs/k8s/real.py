@@ -130,25 +130,69 @@ def _revision_from(obj) -> ControllerRevision:
                               revision=int(obj.revision))
 
 
+class _ThrottledApi:
+    """Charge one rate-limiter token per API method invocation.
+
+    Wraps a kubernetes API object (CoreV1Api etc.) at the transport
+    level, which is where client-go's rest.Config limiter lives: every
+    HTTP request — including each page of a chunked LIST and each watch
+    stream (re-)establishment — acquires a token, not just each
+    top-level K8sClient call."""
+
+    def __init__(self, api: object, limiter: object) -> None:
+        self._api = api
+        self._limiter = limiter
+
+    def __getattr__(self, name: str) -> object:
+        attr = getattr(self._api, name)
+        if not callable(attr):
+            return attr
+        limiter = self._limiter
+
+        def call(*args, **kwargs):
+            limiter.wait()
+            return attr(*args, **kwargs)
+
+        return call
+
+
+def _throttled(api: object, limiter: Optional[object]) -> object:
+    return api if limiter is None else _ThrottledApi(api, limiter)
+
+
 class RealCluster(K8sClient):
     """K8sClient against a live API server."""
 
     def __init__(self, api_client: Optional[object] = None,
-                 list_page_size: int = 500) -> None:
+                 list_page_size: int = 500,
+                 rate_limiter: Optional[object] = None) -> None:
         # api_client: an optional kubernetes.client.ApiClient;
         # typed as object because the kubernetes package is an
-        # import-gated optional dependency
+        # import-gated optional dependency.
+        # rate_limiter: an optional
+        # tpu_operator_libs.k8s.flowcontrol.TokenBucketRateLimiter.
+        # It sits where client-go's rest.Config limiter sits — below
+        # everything, charging one token per HTTP request — so paged
+        # LIST chunks and watch (re-)establishment are each accounted,
+        # not just top-level K8sClient calls.
 
         k8s = _require_kubernetes()
-        self._core = k8s.CoreV1Api(api_client)
-        self._apps = k8s.AppsV1Api(api_client)
-        self._coordination = k8s.CoordinationV1Api(api_client)
+        self._core = _throttled(k8s.CoreV1Api(api_client), rate_limiter)
+        self._apps = _throttled(k8s.AppsV1Api(api_client), rate_limiter)
+        self._coordination = _throttled(
+            k8s.CoordinationV1Api(api_client), rate_limiter)
         self._k8s = k8s
         # LIST chunk size (client-go pager default); <= 0 disables
         # pagination and issues single unbounded LISTs
         self._list_page_size = list_page_size
+        self._rate_limiter = rate_limiter
         # last-seen raw V1ObjectMeta per lease lock (see lease section)
         self._lease_raw_meta: dict = {}
+
+    @property
+    def rate_limiter(self) -> Optional[object]:
+        """The client-side limiter, for observability (None = unthrottled)."""
+        return self._rate_limiter
 
     def _paged_list(self, list_fn, **kwargs) -> list:
         """client-go-pager-style LIST: chunk with limit/continue and
@@ -158,40 +202,47 @@ class RealCluster(K8sClient):
         (client-go's ListPager chunks at 500 for the same reason). An
         expired continue token (410 Gone mid-pagination — etcd compacted
         the snapshot the token pinned) falls back to one full LIST, the
-        pager's ``FullListIfExpired`` behavior."""
-        if self._list_page_size <= 0:
-            return list(list_fn(**kwargs).items)
-        items: list = []
-        token: Optional[str] = None
-        while True:
-            try:
-                result = list_fn(limit=self._list_page_size,
-                                 _continue=token, **kwargs)
-            except self._k8s.ApiException as exc:
-                if getattr(exc, "status", None) == 410 and token:
-                    return list(list_fn(**kwargs).items)
-                raise
-            items.extend(result.items)
-            meta = getattr(result, "metadata", None)
-            token = getattr(meta, "_continue", None) or None
-            if not token:
-                return items
+        pager's ``FullListIfExpired`` behavior. Other API errors get the
+        same typed translation as every non-LIST call, so a transient
+        5xx surfaces as a retryable ApiServerError, not a raw exception
+        the manager error paths don't recognize."""
+        try:
+            if self._list_page_size <= 0:
+                return list(list_fn(**kwargs).items)
+            items: list = []
+            token: Optional[str] = None
+            while True:
+                try:
+                    result = list_fn(limit=self._list_page_size,
+                                     _continue=token, **kwargs)
+                except self._k8s.ApiException as exc:
+                    if getattr(exc, "status", None) == 410 and token:
+                        return list(list_fn(**kwargs).items)
+                    raise
+                items.extend(result.items)
+                meta = getattr(result, "metadata", None)
+                token = getattr(meta, "_continue", None) or None
+                if not token:
+                    return items
+        except self._k8s.ApiException as exc:
+            raise self._translate(exc) from exc
 
     @classmethod
-    def from_kubeconfig(cls, context: Optional[str] = None) -> "RealCluster":
+    def from_kubeconfig(cls, context: Optional[str] = None,
+                        rate_limiter: Optional[object] = None) -> "RealCluster":
         _require_kubernetes()
         from kubernetes import config
 
         config.load_kube_config(context=context)
-        return cls()
+        return cls(rate_limiter=rate_limiter)
 
     @classmethod
-    def in_cluster(cls) -> "RealCluster":
+    def in_cluster(cls, rate_limiter: Optional[object] = None) -> "RealCluster":
         _require_kubernetes()
         from kubernetes import config
 
         config.load_incluster_config()
-        return cls()
+        return cls(rate_limiter=rate_limiter)
 
     # -- error translation -------------------------------------------------
     def _translate(self, exc, eviction: bool = False):
