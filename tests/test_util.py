@@ -5,6 +5,7 @@ import threading
 
 from tpu_operator_libs.consts import UpgradeKeys, UpgradeState
 from tpu_operator_libs.util import (
+    CorrelatingEventRecorder,
     EventRecorder,
     FakeClock,
     KeyedLock,
@@ -130,6 +131,93 @@ class TestClockAndEvents:
         log_event(None, Obj(), "Normal", "X", "ignored")  # nil-safe
         assert len(rec.events) == 1
         assert rec.find(reason="LIBTPURuntimeUpgrade")[0].object_name == "node-1"
+
+
+class _Node1:
+    class metadata:
+        name = "node-1"
+
+
+class _Node2:
+    class metadata:
+        name = "node-2"
+
+
+class TestCorrelatingEventRecorder:
+    """client-go EventCorrelator parity: duplicate counting, similar-
+    event aggregation, per-object spam filtering."""
+
+    def make(self, **kwargs):
+        clock = FakeClock(start=0.0)
+        rec = CorrelatingEventRecorder(clock=clock, **kwargs)
+        return rec, clock
+
+    def test_exact_duplicates_bump_count_not_append(self):
+        rec, clock = self.make()
+        for _ in range(5):
+            rec.event(_Node1(), "Normal", "CordonStarted", "cordoning")
+            clock.advance(1.0)
+        assert len(rec.events) == 1
+        e = rec.events[0]
+        assert e.count == 5
+        assert e.first_seen == 0.0 and e.last_seen == 4.0
+
+    def test_similar_events_aggregate_past_threshold(self):
+        rec, _ = self.make(max_similar=3)
+        for i in range(6):
+            rec.event(_Node1(), "Warning", "EvictionFailed", f"pod-{i}")
+        # first 3 recorded distinctly; 4th+ fold into one aggregate
+        distinct = [e for e in rec.events
+                    if not e.message.startswith("(combined")]
+        combined = [e for e in rec.events
+                    if e.message.startswith("(combined")]
+        assert len(distinct) == 3
+        assert len(combined) == 1
+        assert combined[0].count == 3  # events 4,5,6
+
+    def test_aggregation_window_resets(self):
+        rec, clock = self.make(max_similar=2, similar_interval=10.0)
+        for i in range(3):
+            rec.event(_Node1(), "Normal", "R", f"m{i}")
+        assert any(e.message.startswith("(combined") for e in rec.events)
+        clock.advance(11.0)  # window expires
+        rec.event(_Node1(), "Normal", "R", "fresh")
+        fresh = [e for e in rec.events if e.message == "fresh"]
+        assert len(fresh) == 1  # recorded distinctly again
+
+    def test_spam_filter_drops_floods_per_object(self):
+        rec, _ = self.make(spam_burst=5, max_similar=10**6)
+        for i in range(20):
+            rec.event(_Node1(), "Normal", "R", f"msg-{i}")
+        assert rec.dropped_total == 15
+        # another object has its own bucket
+        rec.event(_Node2(), "Normal", "R", "other")
+        assert any(e.object_name == "node-2" for e in rec.events)
+
+    def test_spam_bucket_refills_with_time(self):
+        rec, clock = self.make(spam_burst=1, spam_qps=0.1,
+                               max_similar=10**6)
+        rec.event(_Node1(), "Normal", "R", "a")
+        rec.event(_Node1(), "Normal", "R", "b")  # dropped
+        assert rec.dropped_total == 1
+        clock.advance(10.0)  # one token accrues
+        rec.event(_Node1(), "Normal", "R", "c")
+        assert [e.message for e in rec.events] == ["a", "c"]
+
+    def test_sink_sees_creates_and_updates(self):
+        calls = []
+        clock = FakeClock(start=0.0)
+        rec = CorrelatingEventRecorder(
+            clock=clock, sink=lambda e, upd: calls.append((e.message, upd)))
+        rec.event(_Node1(), "Normal", "R", "m")
+        rec.event(_Node1(), "Normal", "R", "m")
+        assert calls == [("m", False), ("m", True)]
+
+    def test_find_still_works(self):
+        rec, _ = self.make()
+        rec.event(_Node1(), "Warning", "DrainFailed", "boom")
+        assert rec.find(reason="DrainFailed",
+                        type_="Warning")[0].object_name == "node-1"
 
 
 class TestWorker:

@@ -112,8 +112,15 @@ def serve_metrics(registry: MetricsRegistry, port: int,
 def build_manager(args, cluster, clock=None,
                   poll_interval: float = 1.0) -> ClusterUpgradeStateManager:
     keys = UpgradeKeys(driver=args.driver, domain=args.domain)
-    mgr = ClusterUpgradeStateManager(cluster, keys, clock=clock,
-                                     poll_interval=poll_interval)
+    # Correlated recorder: duplicate counting, similar-event
+    # aggregation and per-object spam filtering (client-go
+    # EventCorrelator semantics) so a fleet-wide wave cannot emit an
+    # event storm.
+    from tpu_operator_libs.util import Clock, CorrelatingEventRecorder
+
+    mgr = ClusterUpgradeStateManager(
+        cluster, keys, clock=clock, poll_interval=poll_interval,
+        recorder=CorrelatingEventRecorder(clock=clock or Clock()))
     if args.job_selector:
         gate = None
         if args.checkpoint_dir:

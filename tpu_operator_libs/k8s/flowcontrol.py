@@ -17,85 +17,6 @@ Throttle the *apiserver-bound* client, never a cached read client:
 informer cache hits cost the apiserver nothing and must not burn budget.
 """
 
-from __future__ import annotations
+from tpu_operator_libs.util import TokenBucketRateLimiter  # noqa: F401
 
-import logging
-import threading
-import time as _time
-from typing import Callable, Optional
-
-logger = logging.getLogger(__name__)
-
-# client-go logs client-side throttling that delays a request by more
-# than 1 s at warning level; mirror that.
-_LONG_THROTTLE_WARN_S = 1.0
-
-
-class TokenBucketRateLimiter:
-    """Token bucket with client-go flowcontrol semantics.
-
-    ``qps`` tokens accrue per second up to a capacity of ``burst``.
-    :meth:`wait` always admits the caller, blocking until its
-    reservation matures; concurrent waiters queue fairly because each
-    reservation pushes the bucket further into debt (golang
-    ``rate.Limiter`` reservation model). :meth:`try_accept` is the
-    non-blocking form (client-go ``TryAccept``).
-
-    ``now``/``sleep`` are injectable so tests drive time explicitly.
-    """
-
-    def __init__(self, qps: float = 5.0, burst: int = 10,
-                 now: Optional[Callable[[], float]] = None,
-                 sleep: Optional[Callable[[float], None]] = None) -> None:
-        if qps <= 0:
-            raise ValueError(f"qps must be positive, got {qps}")
-        if burst < 1:
-            raise ValueError(f"burst must be >= 1, got {burst}")
-        self.qps = float(qps)
-        self.burst = int(burst)
-        self._now = now or _time.monotonic
-        self._sleep = sleep or _time.sleep
-        self._lock = threading.Lock()
-        self._tokens = float(burst)  # may go negative: queued debt
-        self._last = self._now()
-        self._waited_total = 0.0
-
-    def _refill(self, now: float) -> None:
-        """Accrue tokens since the last accounting instant (lock held)."""
-        elapsed = max(0.0, now - self._last)
-        self._last = now
-        self._tokens = min(float(self.burst),
-                           self._tokens + elapsed * self.qps)
-
-    def try_accept(self) -> bool:
-        """Take a token if one is available right now; never blocks."""
-        with self._lock:
-            self._refill(self._now())
-            if self._tokens >= 1.0:
-                self._tokens -= 1.0
-                return True
-            return False
-
-    def wait(self) -> float:
-        """Reserve the next token, blocking until the reservation
-        matures. Returns the seconds slept (0.0 when admitted
-        immediately)."""
-        with self._lock:
-            now = self._now()
-            self._refill(now)
-            self._tokens -= 1.0
-            delay = 0.0 if self._tokens >= 0.0 else -self._tokens / self.qps
-            self._waited_total += delay
-        if delay > 0.0:
-            if delay > _LONG_THROTTLE_WARN_S:
-                logger.warning(
-                    "client-side throttling: waiting %.2fs for an API "
-                    "token (qps=%g burst=%d)", delay, self.qps, self.burst)
-            self._sleep(delay)
-        return delay
-
-    @property
-    def waited_seconds_total(self) -> float:
-        """Cumulative seconds callers spent throttled (observability)."""
-        with self._lock:
-            return self._waited_total
+__all__ = ["TokenBucketRateLimiter"]

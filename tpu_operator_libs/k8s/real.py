@@ -20,6 +20,7 @@ Mapping to API calls:
 
 from __future__ import annotations
 
+import functools
 from typing import Mapping, Optional
 
 from tpu_operator_libs.k8s.client import (
@@ -149,10 +150,17 @@ class _ThrottledApi:
             return attr
         limiter = self._limiter
 
+        @functools.wraps(attr)
         def call(*args, **kwargs):
             limiter.wait()
             return attr(*args, **kwargs)
 
+        # The watch plumbing introspects the bound method it is handed:
+        # kubernetes.watch.Watch.stream reads __doc__ (return-type
+        # discovery) and __self__ (api_client access) — wraps() covers
+        # the former, __self__ must be restored by hand or every watch
+        # breaks the moment a limiter is mounted.
+        call.__self__ = getattr(attr, "__self__", self._api)  # type: ignore[attr-defined]
         return call
 
 

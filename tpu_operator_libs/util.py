@@ -7,9 +7,13 @@ instance-scoped via :class:`tpu_operator_libs.consts.UpgradeKeys`.
 
 from __future__ import annotations
 
+import logging
 import threading
 import time
+from collections import OrderedDict
 from typing import Callable, Iterator, Optional
+
+logger = logging.getLogger(__name__)
 
 
 class NameSet:
@@ -133,25 +137,110 @@ class FakeClock(Clock):
             self._now += seconds
 
 
+# client-go logs client-side throttling that delays a request by more
+# than 1 s at warning level; mirror that.
+_LONG_THROTTLE_WARN_S = 1.0
+
+
+class TokenBucketRateLimiter:
+    """Token bucket with client-go flowcontrol semantics.
+
+    ``qps`` tokens accrue per second up to a capacity of ``burst``.
+    :meth:`wait` always admits the caller, blocking until its
+    reservation matures; concurrent waiters queue fairly because each
+    reservation pushes the bucket further into debt (golang
+    ``rate.Limiter`` reservation model). :meth:`try_accept` is the
+    non-blocking form (client-go ``TryAccept``).
+
+    ``now``/``sleep`` are injectable so tests drive time explicitly.
+    """
+
+    def __init__(self, qps: float = 5.0, burst: int = 10,
+                 now: Optional[Callable[[], float]] = None,
+                 sleep: Optional[Callable[[float], None]] = None) -> None:
+        if qps <= 0:
+            raise ValueError(f"qps must be positive, got {qps}")
+        if burst < 1:
+            raise ValueError(f"burst must be >= 1, got {burst}")
+        self.qps = float(qps)
+        self.burst = int(burst)
+        self._now = now or time.monotonic
+        self._sleep = sleep or time.sleep
+        self._lock = threading.Lock()
+        self._tokens = float(burst)  # may go negative: queued debt
+        self._last = self._now()
+        self._waited_total = 0.0
+
+    def _refill(self, now: float) -> None:
+        """Accrue tokens since the last accounting instant (lock held)."""
+        elapsed = max(0.0, now - self._last)
+        self._last = now
+        self._tokens = min(float(self.burst),
+                           self._tokens + elapsed * self.qps)
+
+    def try_accept(self) -> bool:
+        """Take a token if one is available right now; never blocks."""
+        with self._lock:
+            self._refill(self._now())
+            if self._tokens >= 1.0:
+                self._tokens -= 1.0
+                return True
+            return False
+
+    def wait(self) -> float:
+        """Reserve the next token, blocking until the reservation
+        matures. Returns the seconds slept (0.0 when admitted
+        immediately)."""
+        with self._lock:
+            now = self._now()
+            self._refill(now)
+            self._tokens -= 1.0
+            delay = 0.0 if self._tokens >= 0.0 else -self._tokens / self.qps
+            self._waited_total += delay
+        if delay > 0.0:
+            if delay > _LONG_THROTTLE_WARN_S:
+                logger.warning(
+                    "client-side throttling: waiting %.2fs for an API "
+                    "token (qps=%g burst=%d)", delay, self.qps, self.burst)
+            self._sleep(delay)
+        return delay
+
+    @property
+    def waited_seconds_total(self) -> float:
+        """Cumulative seconds callers spent throttled (observability)."""
+        with self._lock:
+            return self._waited_total
+
+
 class Event:
-    """A recorded Kubernetes-style event (type/reason/message on an object)."""
+    """A recorded Kubernetes-style event (type/reason/message on an object).
+
+    ``count``/``first_seen``/``last_seen`` carry the duplicate-counting
+    semantics of the v1 Events API (client-go bumps ``count`` on the
+    existing event instead of creating a new one)."""
 
     NORMAL = "Normal"
     WARNING = "Warning"
 
-    __slots__ = ("object_name", "kind", "type", "reason", "message")
+    __slots__ = ("object_name", "kind", "type", "reason", "message",
+                 "count", "first_seen", "last_seen")
 
     def __init__(self, object_name: str, kind: str, type_: str, reason: str,
-                 message: str) -> None:
+                 message: str, count: int = 1,
+                 first_seen: float = 0.0, last_seen: float = 0.0) -> None:
         self.object_name = object_name
         self.kind = kind
         self.type = type_
         self.reason = reason
         self.message = message
+        self.count = count
+        self.first_seen = first_seen
+        self.last_seen = last_seen
 
     def __repr__(self) -> str:
+        suffix = f" x{self.count}" if self.count > 1 else ""
         return (f"Event({self.type} {self.reason} on {self.kind}/"
-                f"{self.object_name}: {self.message})")
+                f"{self.object_name}: {self.message}{suffix})")
 
 
 class EventRecorder:
@@ -190,6 +279,135 @@ class EventRecorder:
             return [e for e in self._events
                     if (reason is None or e.reason == reason)
                     and (type_ is None or e.type == type_)]
+
+
+class CorrelatingEventRecorder(EventRecorder):
+    """EventRecorder with client-go ``EventCorrelator`` semantics.
+
+    The reference gets this from client-go's event broadcaster for
+    free; without it, a 256-node drain wave would write an event per
+    node transition straight to the apiserver. Three layers, applied in
+    client-go's order:
+
+    1. **Aggregation** (``EventAggregator``): more than
+       ``max_similar`` events sharing (object, type, reason) inside
+       ``similar_interval`` seconds fold into one
+       "(combined from similar events)" event keyed without the
+       message.
+    2. **Duplicate counting** (``eventObserve``): an event identical to
+       one already recorded bumps its ``count``/``last_seen`` in place —
+       the v1 Events API PATCH path — instead of appending.
+    3. **Spam filtering** (``EventSourceObjectSpamFilter``): a token
+       bucket per involved object (burst ``spam_burst``, refill
+       ``spam_qps``) drops floods that survive aggregation.
+
+    Correlation state is LRU-bounded at ``lru_size`` keys (client-go
+    bounds its aggregator/spam caches at 4096 the same way) so churning
+    objects cannot grow the recorder without bound over an operator's
+    lifetime.
+
+    An optional ``sink`` callable receives every event that survives
+    correlation — ``(event, is_update)`` — for forwarding to a real
+    Events API; the in-memory list keeps serving tests either way.
+    """
+
+    def __init__(self, capacity: int = 1000,
+                 clock: Optional[Clock] = None,
+                 max_similar: int = 10,
+                 similar_interval: float = 600.0,
+                 spam_burst: int = 25,
+                 spam_qps: float = 1.0 / 300.0,
+                 lru_size: int = 4096,
+                 sink: Optional[Callable[[Event, bool], None]] = None) -> None:
+        super().__init__(capacity)
+        self._clock = clock or Clock()
+        self._max_similar = max_similar
+        self._similar_interval = similar_interval
+        self._spam_burst = spam_burst
+        self._spam_qps = spam_qps
+        self._lru_size = lru_size
+        self._sink = sink
+        # aggregation key -> (window start, events seen) — LRU-bounded
+        self._similar: "OrderedDict[tuple, tuple[float, int]]" = \
+            OrderedDict()
+        # full key (incl. message) -> recorded Event for count bumping
+        self._by_key: dict[tuple, Event] = {}
+        # parallel to _events: the _by_key key of each recorded event,
+        # so capacity eviction is an O(1) pop instead of a dict rebuild
+        self._event_keys: list[tuple] = []
+        # spam key (per object) -> token bucket — LRU-bounded
+        self._buckets: "OrderedDict[tuple, TokenBucketRateLimiter]" = \
+            OrderedDict()
+        self.dropped_total = 0
+
+    def _lru_touch(self, lru: "OrderedDict", key: tuple) -> None:
+        """Mark ``key`` most-recently-used; evict the coldest past the
+        bound (lock held)."""
+        lru.move_to_end(key)
+        while len(lru) > self._lru_size:
+            lru.popitem(last=False)
+
+    def _spam_ok(self, key: tuple) -> bool:
+        bucket = self._buckets.get(key)
+        if bucket is None:
+            bucket = TokenBucketRateLimiter(
+                qps=self._spam_qps, burst=self._spam_burst,
+                now=self._clock.now)
+            self._buckets[key] = bucket
+        self._lru_touch(self._buckets, key)
+        return bucket.try_accept()
+
+    def event(self, obj: object, type_: str, reason: str,
+              message: str) -> None:
+        name = getattr(getattr(obj, "metadata", obj), "name", str(obj))
+        kind = type(obj).__name__
+        now = self._clock.now()
+        with self._lock:
+            agg_key = (kind, name, type_, reason)
+            start, seen = self._similar.get(agg_key, (now, 0))
+            if now - start > self._similar_interval:
+                start, seen = now, 0  # window expired: reset
+            seen += 1
+            self._similar[agg_key] = (start, seen)
+            self._lru_touch(self._similar, agg_key)
+            if seen > self._max_similar:
+                message = "(combined from similar events) " + message
+                full_key = agg_key  # aggregate: message no longer keys
+            else:
+                full_key = agg_key + (message,)
+
+            if not self._spam_ok((kind, name)):
+                self.dropped_total += 1
+                return
+
+            existing = self._by_key.get(full_key)
+            if existing is not None:
+                existing.count += 1
+                existing.last_seen = now
+                existing.message = message
+                event = existing
+                is_update = True
+            else:
+                event = Event(name, kind, type_, reason, message,
+                              count=1, first_seen=now, last_seen=now)
+                self._by_key[full_key] = event
+                self._events.append(event)
+                self._event_keys.append(full_key)
+                if len(self._events) > self._capacity:
+                    self._events.pop(0)
+                    self._by_key.pop(self._event_keys.pop(0), None)
+                is_update = False
+        if self._sink is not None:
+            self._sink(event, is_update)
+
+    def clear(self) -> None:
+        with self._lock:
+            self._events.clear()
+            self._event_keys.clear()
+            self._by_key.clear()
+            self._similar.clear()
+            self._buckets.clear()
+            self.dropped_total = 0
 
 
 def log_event(recorder: Optional[EventRecorder], obj: object, type_: str,
