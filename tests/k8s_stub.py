@@ -235,6 +235,26 @@ class BehavioralCoreV1(_Api):
     def delete_namespaced_pod(self, name, namespace):
         self._do(self._cluster.delete_pod, namespace, name)
 
+    def create_namespaced_event(self, namespace, body):
+        from tpu_operator_libs.util import Event as UtilEvent
+
+        involved = body.involved_object
+        event = UtilEvent(
+            involved.name, involved.kind, body.type, body.reason,
+            body.message, count=body.count,
+            first_seen=body.first_timestamp.timestamp(),
+            last_seen=body.last_timestamp.timestamp())
+        self._do(self._cluster.create_event, namespace,
+                 body.metadata.name, event)
+
+    def patch_namespaced_event(self, name, namespace, body):
+        from datetime import datetime
+
+        patch = NS(count=body["count"], message=body["message"],
+                   last_seen=datetime.fromisoformat(
+                       body["lastTimestamp"]).timestamp())
+        self._do(self._cluster.patch_event, namespace, name, patch)
+
     def create_namespaced_pod_eviction(self, name, namespace, eviction):
         self._do(self._cluster.evict_pod, namespace, name)
 
@@ -344,6 +364,9 @@ def install_behavioral_stub(cluster):
     client_mod.CoordinationV1Api = (
         lambda api_client=None: BehavioralCoordinationV1(cluster))
     client_mod.V1Eviction = lambda metadata=None: NS(metadata=metadata)
+    client_mod.V1Event = lambda **kw: NS(**kw)
+    client_mod.V1ObjectReference = lambda kind=None, name=None: NS(
+        kind=kind, name=name)
     client_mod.V1ObjectMeta = lambda name=None, namespace=None: NS(
         name=name, namespace=namespace, resource_version=None)
     client_mod.V1Lease = lambda metadata=None, spec=None: NS(

@@ -282,6 +282,22 @@ class TestListPagination:
             restore()
 
 
+class TestEventsContract:
+    def test_upsert_event_create_then_patch(self, backend):
+        from tpu_operator_libs.util import Event
+
+        event = Event("n1", "Node", "Normal", "CordonStarted", "first",
+                      count=1, first_seen=10.0, last_seen=10.0)
+        backend.client.upsert_event(NS_NAME, "n1.ev1", event)
+        event.count, event.message, event.last_seen = 3, "again", 42.0
+        backend.client.upsert_event(NS_NAME, "n1.ev1", event)
+        (got,) = backend.control.list_events(NS_NAME)
+        assert (got.count, got.message) == (3, "again")
+        assert got.last_seen == pytest.approx(42.0)
+        assert (got.object_name, got.kind, got.type, got.reason) \
+            == ("n1", "Node", "Normal", "CordonStarted")
+
+
 class TestLeaseContract:
     def _lease(self, version=None, holder="op-a"):
         meta = ObjectMeta(name="op-lock", namespace=NS_NAME)

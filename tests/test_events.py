@@ -1,0 +1,211 @@
+"""Events API surface: FakeCluster storage semantics and the
+recorder→correlator→sink→cluster pipeline (client-go broadcaster
+parity — state changes must be visible as kubectl-describe events)."""
+
+import pytest
+
+from tpu_operator_libs.k8s.client import (
+    AlreadyExistsError,
+    K8sClient,
+    NotFoundError,
+)
+from tpu_operator_libs.k8s.events import ClusterEventSink
+from tpu_operator_libs.k8s.fake import FakeCluster
+from tpu_operator_libs.util import (
+    CorrelatingEventRecorder,
+    Event,
+    FakeClock,
+)
+
+NS = "tpu-system"
+
+
+def ev(msg="m", count=1, first=0.0, last=0.0):
+    return Event("node-1", "Node", "Normal", "CordonStarted", msg,
+                 count=count, first_seen=first, last_seen=last)
+
+
+class TestFakeClusterEvents:
+    def test_create_then_list(self):
+        cluster = FakeCluster()
+        cluster.create_event(NS, "node-1.ev1", ev())
+        (got,) = cluster.list_events(NS)
+        assert (got.object_name, got.reason) == ("node-1", "CordonStarted")
+        assert cluster.list_events("other") == []
+
+    def test_create_duplicate_name_conflicts(self):
+        cluster = FakeCluster()
+        cluster.create_event(NS, "node-1.ev1", ev())
+        with pytest.raises(AlreadyExistsError):
+            cluster.create_event(NS, "node-1.ev1", ev())
+
+    def test_patch_refreshes_count_message_last_seen(self):
+        cluster = FakeCluster()
+        cluster.create_event(NS, "node-1.ev1", ev(count=1, last=1.0))
+        cluster.patch_event(NS, "node-1.ev1",
+                            ev("updated", count=4, last=9.0))
+        (got,) = cluster.list_events(NS)
+        assert (got.count, got.message, got.last_seen) == (4, "updated", 9.0)
+
+    def test_patch_missing_not_found(self):
+        with pytest.raises(NotFoundError):
+            FakeCluster().patch_event(NS, "nope", ev())
+
+    def test_upsert_is_create_then_patch(self):
+        cluster = FakeCluster()
+        cluster.upsert_event(NS, "node-1.ev1", ev(count=1))
+        cluster.upsert_event(NS, "node-1.ev1", ev(count=2))
+        (got,) = cluster.list_events(NS)
+        assert got.count == 2
+
+    def test_stored_events_are_copies(self):
+        cluster = FakeCluster()
+        event = ev()
+        cluster.create_event(NS, "n.e", event)
+        event.count = 99  # caller mutation must not reach the store
+        assert cluster.list_events(NS)[0].count == 1
+
+
+class TestClusterEventSink:
+    def test_duplicates_collapse_to_one_cluster_event(self):
+        cluster = FakeCluster()
+        clock = FakeClock()
+        rec = CorrelatingEventRecorder(
+            clock=clock, sink=ClusterEventSink(cluster, NS))
+
+        class Node1:
+            class metadata:
+                name = "node-1"
+
+        for _ in range(3):
+            rec.event(Node1(), "Normal", "CordonStarted", "cordoning")
+            clock.advance(1.0)
+        rec.flush()
+        events = cluster.list_events(NS)
+        assert len(events) == 1
+        assert events[0].count == 3
+        assert events[0].last_seen == 2.0
+
+    def test_distinct_events_get_distinct_names(self):
+        cluster = FakeCluster()
+        sink = ClusterEventSink(cluster, NS)
+        rec = CorrelatingEventRecorder(clock=FakeClock(), sink=sink)
+
+        class Node1:
+            class metadata:
+                name = "node-1"
+
+        rec.event(Node1(), "Normal", "CordonStarted", "a")
+        rec.event(Node1(), "Warning", "DrainFailed", "b")
+        rec.flush()
+        assert len(cluster.list_events(NS)) == 2
+
+    def test_backend_without_events_api_disables_sink(self):
+        class NoEvents(K8sClient):
+            # minimal concrete backend: abstract surface stubbed out
+            def get_node(self, name):
+                raise NotImplementedError
+
+            def list_nodes(self, label_selector=""):
+                return []
+
+            def patch_node_labels(self, name, labels):
+                raise NotImplementedError
+
+            def patch_node_annotations(self, name, annotations):
+                raise NotImplementedError
+
+            def set_node_unschedulable(self, name, unschedulable):
+                raise NotImplementedError
+
+            def list_pods(self, namespace=None, label_selector="",
+                          field_selector=""):
+                return []
+
+            def delete_pod(self, namespace, name):
+                raise NotImplementedError
+
+            def evict_pod(self, namespace, name):
+                raise NotImplementedError
+
+            def list_daemon_sets(self, namespace, label_selector=""):
+                return []
+
+            def list_controller_revisions(self, namespace,
+                                          label_selector=""):
+                return []
+
+        sink = ClusterEventSink(NoEvents(), NS)
+        sink(("k",), ev(), False)
+        assert sink.disabled
+        sink(("k",), ev(), False)  # no raise, no retry storm
+
+    def test_backend_errors_are_swallowed(self):
+        cluster = FakeCluster()
+        cluster.inject_api_errors("create_event", count=1)
+        sink = ClusterEventSink(cluster, NS)
+        sink(("k",), ev(), False)  # must not raise
+        assert not sink.disabled
+
+    def test_works_through_cached_read_client(self):
+        """Regression: the production wiring hands the sink the cached
+        client; without upsert_event delegation the sink self-disabled
+        and no event ever reached the cluster."""
+        from tpu_operator_libs.k8s.cached import CachedReadClient
+
+        cluster = FakeCluster()
+        cached = CachedReadClient(cluster, NS)
+        sink = ClusterEventSink(cached, NS)
+        sink(("k",), ev(), False)
+        assert not sink.disabled
+        assert len(cluster.list_events(NS)) == 1
+
+
+class TestEventTTLRecreate:
+    def test_ttl_collected_event_is_recreated(self):
+        """The apiserver TTL-collects Events (~1h): the next upsert of
+        the cached name simply POSTs again and must succeed."""
+        from k8s_stub import install_behavioral_stub
+
+        cluster = FakeCluster()
+        restore = install_behavioral_stub(cluster)
+        try:
+            from tpu_operator_libs.k8s.real import RealCluster
+
+            client = RealCluster()
+            client.upsert_event(NS, "n1.abc", ev(count=1))
+            # simulate the TTL garbage collector
+            with cluster._lock:
+                cluster._cluster_events.clear()
+            client.upsert_event(NS, "n1.abc", ev(count=7))
+            (got,) = cluster.list_events(NS)
+            assert got.count == 7
+        finally:
+            restore()
+
+    def test_patch_404_race_falls_back_to_create(self):
+        """Narrower race: create sees 409 (event exists) but the Event
+        is TTL-collected before the PATCH lands — the adapter must fall
+        back to POST (client-go recordEvent does the same)."""
+        from k8s_stub import install_behavioral_stub
+
+        cluster = FakeCluster()
+        restore = install_behavioral_stub(cluster)
+        try:
+            from tpu_operator_libs.k8s.real import RealCluster
+
+            client = RealCluster()
+            client.upsert_event(NS, "n1.abc", ev(count=1))
+
+            def gc_then_404():
+                with cluster._lock:
+                    cluster._cluster_events.clear()
+                return NotFoundError("event TTL-collected mid-upsert")
+
+            cluster.inject_api_errors("patch_event", count=1,
+                                      exc_factory=gc_then_404)
+            client.upsert_event(NS, "n1.abc", ev(count=5))
+            (got,) = cluster.list_events(NS)
+            assert got.count == 5
+        finally:
+            restore()

@@ -204,14 +204,31 @@ class TestCorrelatingEventRecorder:
         rec.event(_Node1(), "Normal", "R", "c")
         assert [e.message for e in rec.events] == ["a", "c"]
 
-    def test_sink_sees_creates_and_updates(self):
+    def test_sink_sees_creates_and_updates_in_order(self):
         calls = []
         clock = FakeClock(start=0.0)
         rec = CorrelatingEventRecorder(
-            clock=clock, sink=lambda e, upd: calls.append((e.message, upd)))
+            clock=clock,
+            sink=lambda key, e, upd: calls.append((e.message, e.count, upd)))
         rec.event(_Node1(), "Normal", "R", "m")
         rec.event(_Node1(), "Normal", "R", "m")
-        assert calls == [("m", False), ("m", True)]
+        rec.flush()
+        # snapshots: the first delivery must still carry count=1 even
+        # though the live event was bumped to 2 before the writer ran
+        assert calls == [("m", 1, False), ("m", 2, True)]
+        rec.close()
+
+    def test_sink_same_key_for_updates_distinct_for_new(self):
+        keys = []
+        rec = CorrelatingEventRecorder(
+            clock=FakeClock(), sink=lambda key, e, upd: keys.append(key))
+        rec.event(_Node1(), "Normal", "R", "m")
+        rec.event(_Node1(), "Normal", "R", "m")
+        rec.event(_Node1(), "Warning", "Other", "x")
+        rec.flush()
+        assert keys[0] == keys[1]
+        assert keys[2] != keys[0]
+        rec.close()
 
     def test_find_still_works(self):
         rec, _ = self.make()

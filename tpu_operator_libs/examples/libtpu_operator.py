@@ -115,12 +115,17 @@ def build_manager(args, cluster, clock=None,
     # Correlated recorder: duplicate counting, similar-event
     # aggregation and per-object spam filtering (client-go
     # EventCorrelator semantics) so a fleet-wide wave cannot emit an
-    # event storm.
+    # event storm. Surviving events land in the cluster's Events API
+    # (kubectl describe node parity); the sink self-disables on
+    # backends without one.
+    from tpu_operator_libs.k8s.events import ClusterEventSink
     from tpu_operator_libs.util import Clock, CorrelatingEventRecorder
 
     mgr = ClusterUpgradeStateManager(
         cluster, keys, clock=clock, poll_interval=poll_interval,
-        recorder=CorrelatingEventRecorder(clock=clock or Clock()))
+        recorder=CorrelatingEventRecorder(
+            clock=clock or Clock(),
+            sink=ClusterEventSink(cluster, args.namespace)))
     if args.job_selector:
         gate = None
         if args.checkpoint_dir:

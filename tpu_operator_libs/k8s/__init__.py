@@ -35,6 +35,7 @@ from tpu_operator_libs.k8s.objects import (  # noqa: F401
 from tpu_operator_libs.k8s.cached import CachedReadClient  # noqa: F401
 from tpu_operator_libs.k8s.client import K8sClient  # noqa: F401
 from tpu_operator_libs.k8s.fake import FakeCluster  # noqa: F401
+from tpu_operator_libs.k8s.events import ClusterEventSink  # noqa: F401
 from tpu_operator_libs.k8s.flowcontrol import (  # noqa: F401
     TokenBucketRateLimiter,
 )

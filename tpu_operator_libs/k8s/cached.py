@@ -224,6 +224,12 @@ class CachedReadClient(K8sClient):
     def evict_pod(self, namespace: str, name: str) -> None:
         self._delegate.evict_pod(namespace, name)
 
+    def upsert_event(self, namespace: str, name: str,
+                     event: object) -> None:
+        # write pass-through like every other mutation: without this
+        # delegation the event sink would self-disable behind the cache
+        self._delegate.upsert_event(namespace, name, event)
+
     # -- watches ----------------------------------------------------------
     def watch(self, kinds: Optional[set[str]] = None,
               namespace: Optional[str] = None) -> Watch:

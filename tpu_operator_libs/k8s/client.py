@@ -88,6 +88,19 @@ class K8sClient(abc.ABC):
         raise NotImplementedError(
             f"{type(self).__name__} does not support watches")
 
+    # -- events -----------------------------------------------------------
+    def upsert_event(self, namespace: str, name: str,
+                     event: object) -> None:
+        """Record a v1 Event for ``event``'s involved object: create the
+        named Event, or — when it already exists (duplicate-counting via
+        a correlator) — patch its count/message/lastTimestamp, the way
+        client-go's broadcaster PATCHes recurring events. ``event`` is a
+        :class:`tpu_operator_libs.util.Event`. Optional capability:
+        implemented by FakeCluster and RealCluster; a backend without it
+        leaves events in-memory only (the recorder still records)."""
+        raise NotImplementedError(
+            f"{type(self).__name__} does not support the Events API")
+
     # -- daemonsets & revisions ------------------------------------------
     @abc.abstractmethod
     def list_daemon_sets(self, namespace: str,

@@ -1,0 +1,80 @@
+"""Forward recorder events to the cluster's v1 Events API.
+
+The reference's state changes surface in ``kubectl describe node``
+because client-go's broadcaster writes every recorded event to the
+apiserver (node_upgrade_state_provider.go:87-88 emits through
+record.EventRecorder). This module is that last hop for our build:
+
+    recorder = CorrelatingEventRecorder(
+        clock=clock, sink=ClusterEventSink(cluster, namespace))
+
+Events must never break a reconcile: sink failures are logged and
+swallowed, and a backend without the Events API (NotImplementedError)
+disables the sink after the first attempt — the in-memory recorder
+keeps recording either way.
+"""
+
+from __future__ import annotations
+
+import logging
+import threading
+import uuid
+from collections import OrderedDict
+
+from tpu_operator_libs.k8s.client import K8sClient
+from tpu_operator_libs.util import Event
+
+logger = logging.getLogger(__name__)
+
+
+class ClusterEventSink:
+    """``CorrelatingEventRecorder`` sink writing v1 Events.
+
+    Each distinct correlation key gets one cluster Event object named
+    ``<object>.<uuid>`` — the random suffix (unlike a process-local
+    counter) cannot collide with Events left behind by a previous
+    operator incarnation or another replica, so the 409→PATCH path
+    never grafts this run's counts onto a stale Event. Updates to the
+    same correlated event re-upsert under the same name so the
+    apiserver PATCHes count/lastTimestamp instead of accumulating
+    copies. The key→name map is LRU-bounded.
+    """
+
+    def __init__(self, client: K8sClient, namespace: str,
+                 lru_size: int = 4096) -> None:
+        self._client = client
+        self._namespace = namespace
+        self._lock = threading.Lock()
+        self._lru_size = lru_size
+        self._names: "OrderedDict[tuple, str]" = OrderedDict()
+        self._disabled = False
+
+    @property
+    def disabled(self) -> bool:
+        """True once the backend reported it has no Events API."""
+        return self._disabled
+
+    def __call__(self, key: tuple, event: Event,
+                 is_update: bool) -> None:
+        if self._disabled:
+            return
+        with self._lock:
+            name = self._names.get(key)
+            if name is None:
+                name = f"{event.object_name}.{uuid.uuid4().hex[:16]}"
+                self._names[key] = name
+            self._names.move_to_end(key)
+            while len(self._names) > self._lru_size:
+                self._names.popitem(last=False)
+        try:
+            self._client.upsert_event(self._namespace, name, event)
+        except NotImplementedError:
+            self._disabled = True
+            logger.info(
+                "cluster backend has no Events API; recorder events "
+                "stay in-memory only")
+        except Exception as exc:
+            # an event is observability, never control flow: a failed
+            # write must not fail the state transition that emitted it
+            logger.warning("failed to write event %s/%s: %s",
+                           self._namespace, name, exc)

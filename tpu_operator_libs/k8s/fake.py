@@ -94,6 +94,8 @@ class FakeCluster(K8sClient):
         self._lock = threading.RLock()
         self._nodes: dict[str, Node] = {}
         self._pods: dict[tuple[str, str], Pod] = {}
+        # v1 Events written through the recorder sink, keyed (ns, name)
+        self._cluster_events: dict[tuple[str, str], object] = {}
         # spec.nodeName index over _pods, maintained by _pod_put/_pod_pop
         # (pod nodeName is immutable once bound, as in Kubernetes, so
         # membership never changes in place). Serves the apiserver's
@@ -730,6 +732,50 @@ class FakeCluster(K8sClient):
             return [rev.clone()
                     for (ns, _), rev in self._revisions.items()
                     if ns == namespace and match(rev.metadata.labels)]
+
+    # ------------------------------------------------------------------
+    # v1 Events (recorder sink target)
+    # ------------------------------------------------------------------
+    def create_event(self, namespace: str, name: str,
+                     event: object) -> None:
+        """POST semantics: raises AlreadyExistsError on a name clash."""
+        self._maybe_api_error("create_event")
+        import copy
+
+        with self._lock:
+            key = (namespace, name)
+            if key in self._cluster_events:
+                raise AlreadyExistsError(
+                    f"event {namespace}/{name} already exists")
+            self._cluster_events[key] = copy.copy(event)
+
+    def patch_event(self, namespace: str, name: str,
+                    event: object) -> None:
+        """PATCH semantics: refresh count/message/lastTimestamp of an
+        existing Event; raises NotFoundError when absent."""
+        self._maybe_api_error("patch_event")
+        with self._lock:
+            stored = self._cluster_events.get((namespace, name))
+            if stored is None:
+                raise NotFoundError(f"event {namespace}/{name} not found")
+            stored.count = event.count
+            stored.message = event.message
+            stored.last_seen = event.last_seen
+
+    def upsert_event(self, namespace: str, name: str,
+                     event: object) -> None:
+        try:
+            self.create_event(namespace, name, event)
+        except AlreadyExistsError:
+            self.patch_event(namespace, name, event)
+
+    def list_events(self, namespace: str) -> list:
+        """Test helper: recorded cluster Events in the namespace."""
+        import copy
+
+        with self._lock:
+            return [copy.copy(e) for (ns, _), e in
+                    sorted(self._cluster_events.items()) if ns == namespace]
 
     # ------------------------------------------------------------------
     # coordination.k8s.io Leases (leader-election lock objects)

@@ -526,6 +526,48 @@ class RealCluster(K8sClient):
                 renew_time=ts(lease.renew_time),
                 lease_transitions=lease.lease_transitions))
 
+    # -- events ---------------------------------------------------------
+    def upsert_event(self, namespace: str, name: str,
+                     event: object) -> None:
+        """v1 Events upsert: POST the named Event; a 409 (the correlator
+        re-reporting a recurring event) PATCHes count/message/
+        lastTimestamp instead — the client-go broadcaster's write
+        pattern."""
+        from datetime import datetime, timezone
+
+        def ts(epoch: float):
+            return datetime.fromtimestamp(epoch, tz=timezone.utc)
+
+        body = self._k8s.V1Event(
+            metadata=self._k8s.V1ObjectMeta(name=name,
+                                            namespace=namespace),
+            involved_object=self._k8s.V1ObjectReference(
+                kind=event.kind, name=event.object_name),
+            type=event.type, reason=event.reason, message=event.message,
+            count=event.count,
+            first_timestamp=ts(event.first_seen),
+            last_timestamp=ts(event.last_seen))
+        try:
+            self._core.create_namespaced_event(namespace, body)
+            return
+        except self._k8s.ApiException as exc:
+            if getattr(exc, "status", None) != 409:
+                raise self._translate(exc) from exc
+        patch = {"count": event.count, "message": event.message,
+                 "lastTimestamp": ts(event.last_seen).isoformat()}
+        try:
+            self._core.patch_namespaced_event(name, namespace, patch)
+        except self._k8s.ApiException as exc:
+            if getattr(exc, "status", None) != 404:
+                raise self._translate(exc) from exc
+            # the apiserver TTL-collected the Event between our create
+            # and this recurrence: recreate it (client-go's recordEvent
+            # falls back to POST the same way)
+            try:
+                self._core.create_namespaced_event(namespace, body)
+            except self._k8s.ApiException as exc2:
+                raise self._translate(exc2) from exc2
+
     def _cache_lease_meta(self, raw) -> None:
         self._lease_raw_meta[(raw.metadata.namespace or "",
                               raw.metadata.name)] = raw.metadata

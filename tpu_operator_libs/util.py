@@ -223,7 +223,7 @@ class Event:
     WARNING = "Warning"
 
     __slots__ = ("object_name", "kind", "type", "reason", "message",
-                 "count", "first_seen", "last_seen")
+                 "count", "first_seen", "last_seen", "__weakref__")
 
     def __init__(self, object_name: str, kind: str, type_: str, reason: str,
                  message: str, count: int = 1,
@@ -307,8 +307,14 @@ class CorrelatingEventRecorder(EventRecorder):
     lifetime.
 
     An optional ``sink`` callable receives every event that survives
-    correlation — ``(event, is_update)`` — for forwarding to a real
-    Events API; the in-memory list keeps serving tests either way.
+    correlation — ``(key, event_snapshot, is_update)``, where ``key`` is
+    the stable correlation identity and the snapshot is immutable — for
+    forwarding to a real Events API. Deliveries are queued (bounded,
+    overflow-dropping) and drained by one background writer thread, so
+    emitting an event never blocks a reconcile on network I/O and
+    cluster writes land in emission order (the client-go broadcaster's
+    buffered-channel design). Tests call :meth:`flush` to join the
+    queue. The in-memory list keeps serving either way.
     """
 
     def __init__(self, capacity: int = 1000,
@@ -318,7 +324,8 @@ class CorrelatingEventRecorder(EventRecorder):
                  spam_burst: int = 25,
                  spam_qps: float = 1.0 / 300.0,
                  lru_size: int = 4096,
-                 sink: Optional[Callable[[Event, bool], None]] = None) -> None:
+                 sink: Optional[Callable[[tuple, Event, bool], None]] = None,
+                 sink_queue_size: int = 512) -> None:
         super().__init__(capacity)
         self._clock = clock or Clock()
         self._max_similar = max_similar
@@ -327,6 +334,16 @@ class CorrelatingEventRecorder(EventRecorder):
         self._spam_qps = spam_qps
         self._lru_size = lru_size
         self._sink = sink
+        self.sink_dropped_total = 0
+        if sink is not None:
+            import queue as _queue
+
+            self._sink_queue: "_queue.Queue[Optional[tuple]]" = \
+                _queue.Queue(maxsize=sink_queue_size)
+            self._writer = threading.Thread(
+                target=self._drain_sink, name="event-sink-writer",
+                daemon=True)
+            self._writer.start()
         # aggregation key -> (window start, events seen) — LRU-bounded
         self._similar: "OrderedDict[tuple, tuple[float, int]]" = \
             OrderedDict()
@@ -397,8 +414,46 @@ class CorrelatingEventRecorder(EventRecorder):
                     self._events.pop(0)
                     self._by_key.pop(self._event_keys.pop(0), None)
                 is_update = False
+            if self._sink is not None:
+                # snapshot under the lock: the live Event keeps mutating
+                # (count bumps) and the writer thread must not read torn
+                # field combinations
+                snapshot = Event(event.object_name, event.kind,
+                                 event.type, event.reason, event.message,
+                                 count=event.count,
+                                 first_seen=event.first_seen,
+                                 last_seen=event.last_seen)
+                try:
+                    self._sink_queue.put_nowait(
+                        (full_key, snapshot, is_update))
+                except Exception:
+                    # full queue: drop rather than block the emitter
+                    # (client-go's broadcaster makes the same trade)
+                    self.sink_dropped_total += 1
+
+    def _drain_sink(self) -> None:
+        while True:
+            item = self._sink_queue.get()
+            try:
+                if item is None:
+                    return
+                try:
+                    self._sink(*item)
+                except Exception:
+                    logger.exception("event sink delivery failed")
+            finally:
+                self._sink_queue.task_done()
+
+    def flush(self) -> None:
+        """Block until every queued sink delivery has been processed."""
         if self._sink is not None:
-            self._sink(event, is_update)
+            self._sink_queue.join()
+
+    def close(self) -> None:
+        """Stop the sink writer thread (queued deliveries drain first)."""
+        if self._sink is not None and self._writer.is_alive():
+            self._sink_queue.put(None)
+            self._writer.join(timeout=5.0)
 
     def clear(self) -> None:
         with self._lock:
