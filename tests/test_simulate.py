@@ -44,6 +44,40 @@ class TestSimulation:
         # transitions stay legal under chaining (one edge per inner pass)
         # — covered structurally: chained mode reuses apply_state verbatim.
 
+    def test_watch_driven_is_at_least_as_fast_as_tick_driven(self):
+        # jittered delays land pod-ready events mid-interval; the
+        # watch-driven path reconciles at the event instant instead of
+        # waiting out the tick, so wall clock and per-node downtime can
+        # only shrink
+        fleet = FleetSpec(n_slices=4, hosts_per_slice=2,
+                          delay_jitter=0.35)
+        ticked = simulate_rolling_upgrade("slice", fleet=fleet,
+                                          chained=True)
+        watched = simulate_rolling_upgrade("slice", fleet=fleet,
+                                           chained=True,
+                                           watch_driven=True)
+        assert ticked.converged and watched.converged
+        assert watched.total_seconds <= ticked.total_seconds
+        # NOTE: per-node drain_to_ready percentiles are NOT asserted —
+        # earlier mid-interval cordons change wave composition, so
+        # individual drains can lengthen even as the whole upgrade
+        # finishes sooner (the bench's own 8x4 fleet shows watch p95
+        # slightly above chained p95). Wall clock is the honest claim.
+        # event-driven dispatch reconciles strictly more often
+        assert watched.reconciles > ticked.reconciles
+
+    def test_watch_driven_respects_multislice_budget(self):
+        # higher reconcile frequency must not let a second member slice
+        # of one DCN job start down while another is still recovering
+        r = simulate_rolling_upgrade(
+            "slice", chained=True, watch_driven=True,
+            fleet=FleetSpec(n_slices=4, hosts_per_slice=2,
+                            delay_jitter=0.35,
+                            multislice_jobs=(("train", (0, 1)),
+                                             ("eval", (2, 3)))))
+        assert r.converged
+        assert all(v <= 1 for v in r.max_down_members_per_job.values())
+
     def test_scale_down_mid_upgrade_converges(self):
         # a node deleted mid-upgrade (the vanished-node delta) must not
         # stall the remaining fleet, including with a multislice job
