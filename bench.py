@@ -144,6 +144,7 @@ def main() -> int:
         "flat_upgrade_wall_clock_s": cells["flat_interval"].total_seconds,
     }
     result.update(hardware)
+    result.update(_model_capture(hardware))
     print(json.dumps(result))
     return 0
 
@@ -305,6 +306,121 @@ except Exception as exc:  # structured failure, never a bare traceback
 """
 
 
+_MODEL_PROBE_SCRIPT = r"""
+import json, math, os, sys, time
+try:
+    import jax
+
+    # Honor an explicit platform override BEFORE first backend use (same
+    # guard as the roofline probe): on hosts whose sitecustomize
+    # force-registers an accelerator plugin, the env var alone is not
+    # enough — without this, a CPU-pinned run still enumerates (and can
+    # hang on) the wedged TPU tunnel.
+    if os.environ.get("JAX_PLATFORMS"):
+        jax.config.update("jax_platforms", os.environ["JAX_PLATFORMS"])
+
+    import jax.numpy as jnp
+    import numpy as np
+    from jax.sharding import Mesh
+
+    from tpu_operator_libs.examples.llama import (
+        LlamaConfig, init_llama_params, make_token_batch, make_train_step)
+
+    D = int(os.environ.get("BENCH_MODEL_D", "2048"))
+    LAYERS = int(os.environ.get("BENCH_MODEL_LAYERS", "4"))
+    SEQ = int(os.environ.get("BENCH_MODEL_SEQ", "1024"))
+    BATCH = int(os.environ.get("BENCH_MODEL_BATCH", "16"))
+    overridden = any(os.environ.get(k) for k in (
+        "BENCH_MODEL_D", "BENCH_MODEL_LAYERS", "BENCH_MODEL_SEQ",
+        "BENCH_MODEL_BATCH"))
+
+    device = jax.devices()[0]
+    mesh = Mesh(np.array([device]).reshape(1, 1), ("dp", "tp"))
+    cfg = LlamaConfig(vocab=D, d_model=D, n_layers=LAYERS,
+                      n_heads=max(1, D // 128),
+                      n_kv_heads=max(1, D // 128), d_ff=4 * D,
+                      seq_len=SEQ, learning_rate=1e-4)
+    params = init_llama_params(mesh, cfg, param_dtype=jnp.bfloat16)
+    optimizer, step_fn = make_train_step(mesh, cfg)
+    state = {"params": params, "opt": optimizer.init(params),
+             "step": jnp.zeros((), jnp.int32)}
+    toks = make_token_batch(mesh, 0, cfg, batch_per_shard=BATCH)
+    state, loss = step_fn(state, toks)
+    jax.block_until_ready(state)  # compile + warm
+    n_params = sum(x.size for x in jax.tree.leaves(params))
+    best = None
+    for rep in range(3):
+        toks = make_token_batch(mesh, rep + 1, cfg,
+                                batch_per_shard=BATCH)
+        t0 = time.perf_counter()
+        state, loss = step_fn(state, toks)
+        fenced = float(loss)  # host readback = completion fence
+        dt = time.perf_counter() - t0
+        best = dt if best is None else min(best, dt)
+    tokens = BATCH * cfg.seq_len
+    # fwd+bwd matmul FLOPs = 6 * params * tokens, plus the quadratic
+    # attention term (12 * B * heads * S^2 * head_dim per layer)
+    flops = 6.0 * n_params * tokens + 12.0 * BATCH * cfg.n_heads \
+        * cfg.seq_len ** 2 * cfg.head_dim * cfg.n_layers
+    print(json.dumps({
+        "train_model": f"llama-{round(n_params / 1e6)}M",
+        "train_params_m": round(n_params / 1e6, 1),
+        "train_step_ms": round(best * 1e3, 1),
+        "train_tflops_bf16": round(flops / best / 1e12, 3),
+        "loss_finite": math.isfinite(fenced),
+        "shape_overrides": overridden,
+        "device_kind": device.device_kind,
+    }))
+except Exception as exc:  # structured failure, never a bare traceback
+    print(json.dumps({"error": f"{type(exc).__name__}: {exc}"}))
+    sys.exit(0)
+"""
+
+_MODEL_NULLS = {
+    "train_model": None,
+    "train_params_m": None,
+    "train_step_ms": None,
+    "train_tflops_bf16": None,
+    "train_mfu_pct": None,
+}
+
+
+def _model_capture(hardware: dict) -> dict:
+    """One bounded attempt at the model-level probe: a full Llama-style
+    bf16 training step (fwd+bwd+adamw) on the real chip, reported as
+    train_tflops_bf16 / train_mfu_pct. Skipped without cost when the
+    roofline probe already found the chip unreachable."""
+    if hardware.get("tpu_unreachable"):
+        return dict(_MODEL_NULLS,
+                    train_probe_skipped_reason="chip unreachable at "
+                                               "roofline probe")
+    timeout_s = float(os.environ.get("BENCH_MODEL_TIMEOUT", "420"))
+    data, reason = _probe_once(timeout_s, script=_MODEL_PROBE_SCRIPT)
+    if data is None or "error" in data:
+        if data is not None:
+            reason = f"probe raised: {data['error']}"
+        return dict(_MODEL_NULLS, train_probe_skipped_reason=reason)
+    if not data.get("loss_finite"):
+        # a diverged step's timing is not a capture — throughput of
+        # numerically broken work proves nothing
+        return dict(_MODEL_NULLS,
+                    train_probe_skipped_reason="train step produced a "
+                                               "non-finite loss")
+    peak = _peak_for(data.get("device_kind", ""), _BF16_PEAK_TFLOPS)
+    tflops = data.get("train_tflops_bf16")
+    out = {
+        "train_model": data.get("train_model"),
+        "train_params_m": data.get("train_params_m"),
+        "train_step_ms": data.get("train_step_ms"),
+        "train_tflops_bf16": tflops,
+        "train_mfu_pct": (round(100.0 * tflops / peak, 1)
+                          if tflops and peak else None),
+    }
+    if data.get("shape_overrides"):
+        out["train_shape_overrides"] = True
+    return out
+
+
 def _hardware_capture() -> dict:
     """Bounded-retry hardware probe with structured degradation.
 
@@ -375,13 +491,13 @@ def _hardware_capture() -> dict:
     return out
 
 
-def _probe_once(timeout_s: float):
+def _probe_once(timeout_s: float, script: Optional[str] = None):
     """(parsed-json-or-None, reason)."""
     import subprocess
 
     try:
         proc = subprocess.run(
-            [sys.executable, "-c", _PROBE_SCRIPT],
+            [sys.executable, "-c", script or _PROBE_SCRIPT],
             capture_output=True, text=True, timeout=timeout_s,
             cwd=os.path.dirname(os.path.abspath(__file__)))
     except subprocess.TimeoutExpired:

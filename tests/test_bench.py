@@ -84,6 +84,67 @@ class TestHardwareResult:
         # toy shapes must be flagged so they can never pass for a capture
         assert data["shape_overrides"] is True
 
+    def test_model_probe_script_runs_on_cpu(self):
+        """The Llama train-step probe must execute end-to-end on the CPU
+        backend with toy shapes (flagged, never persisted as capture)."""
+        import subprocess
+        import sys
+
+        env = dict(os.environ,
+                   JAX_PLATFORMS="cpu",
+                   BENCH_MODEL_D="128", BENCH_MODEL_LAYERS="1",
+                   BENCH_MODEL_SEQ="32", BENCH_MODEL_BATCH="2")
+        env.pop("PALLAS_AXON_POOL_IPS", None)  # stay off the tunnel
+        proc = subprocess.run(
+            [sys.executable, "-c", bench._MODEL_PROBE_SCRIPT],
+            capture_output=True, text=True, timeout=240, env=env,
+            cwd=os.path.dirname(os.path.abspath(bench.__file__)))
+        lines = [ln for ln in proc.stdout.splitlines() if ln.strip()]
+        assert lines, proc.stderr
+        data = json.loads(lines[-1])
+        assert "error" not in data, data
+        assert data["train_tflops_bf16"] > 0
+        assert data["train_step_ms"] > 0
+        assert data["loss_finite"] is True
+        assert data["shape_overrides"] is True
+
+    def test_model_capture_skipped_when_chip_unreachable(self):
+        out = bench._model_capture({"tpu_unreachable": True})
+        assert out["train_tflops_bf16"] is None
+        assert "unreachable" in out["train_probe_skipped_reason"]
+
+    def test_model_capture_structured_failure(self, monkeypatch):
+        monkeypatch.setattr(
+            bench, "_probe_once",
+            lambda timeout_s, script=None: (None, "boom reason"))
+        out = bench._model_capture({"tpu_unreachable": False})
+        assert out["train_mfu_pct"] is None
+        assert out["train_probe_skipped_reason"] == "boom reason"
+
+    def test_model_capture_rejects_non_finite_loss(self, monkeypatch):
+        payload = {"train_model": "llama-277M", "train_params_m": 276.8,
+                   "train_step_ms": 300.0, "train_tflops_bf16": 98.5,
+                   "loss_finite": False, "shape_overrides": False,
+                   "device_kind": "TPU v5 lite"}
+        monkeypatch.setattr(
+            bench, "_probe_once",
+            lambda timeout_s, script=None: (payload, "ok"))
+        out = bench._model_capture({"tpu_unreachable": False})
+        assert out["train_tflops_bf16"] is None
+        assert "non-finite" in out["train_probe_skipped_reason"]
+
+    def test_model_capture_computes_mfu_from_peak_table(self, monkeypatch):
+        payload = {"train_model": "llama-277M", "train_params_m": 276.8,
+                   "train_step_ms": 300.0, "train_tflops_bf16": 98.5,
+                   "loss_finite": True, "shape_overrides": False,
+                   "device_kind": "TPU v5 lite"}
+        monkeypatch.setattr(
+            bench, "_probe_once",
+            lambda timeout_s, script=None: (payload, "ok"))
+        out = bench._model_capture({"tpu_unreachable": False})
+        assert out["train_mfu_pct"] == 50.0
+        assert out["train_model"] == "llama-277M"
+
     def test_shape_overridden_capture_not_persisted(self, tmp_path,
                                                     monkeypatch):
         monkeypatch.setattr(bench, "SIDECAR",

@@ -82,21 +82,7 @@ def init_state(mesh, d_in: int = 32, d_hidden_per_shard: int = 16,
     opt_state = optimizer.init(params)
     state = {"params": params, "opt": opt_state,
              "step": jnp.zeros((), jnp.int32)}
-    # Leaves that didn't inherit a mesh sharding (adam's step counter,
-    # anything scalar) are committed to a single device; replicate them
-    # over the mesh so the whole state has one consistent device set —
-    # otherwise a restored checkpoint pins them to device 0 and jit
-    # rejects the mixed placement.
-    replicated = NamedSharding(mesh, P())
-    n_mesh = mesh.devices.size
-
-    def place(x):
-        sharding = getattr(x, "sharding", None)
-        if sharding is not None and len(sharding.device_set) == n_mesh:
-            return x
-        return jax.device_put(x, replicated)
-
-    state = jax.tree.map(place, state)
+    state = replicate_unplaced(state, mesh)
 
     def loss_fn(params, batch_x, batch_y):
         hidden = jnp.tanh(batch_x @ params["w1"])
@@ -159,15 +145,78 @@ def restore_state(manager, state):
     return restored, latest
 
 
+def replicate_unplaced(state, mesh):
+    """Leaves that didn't inherit a mesh sharding (optimizer step
+    counters, scalars) get replicated over the mesh so the whole state
+    has one consistent device set — otherwise a restored checkpoint
+    pins them to device 0 and jit rejects the mixed placement."""
+    import jax
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    replicated = NamedSharding(mesh, P())
+    n_mesh = mesh.devices.size
+
+    def place(x):
+        sharding = getattr(x, "sharding", None)
+        if sharding is not None and len(sharding.device_set) == n_mesh:
+            return x
+        return jax.device_put(x, replicated)
+
+    return jax.tree.map(place, state)
+
+
+def init_state_llama(mesh):
+    """Llama-style decoder workload (BASELINE #4's model family): same
+    {"params", "opt", "step"} state contract as the MLP, so the
+    checkpoint/resume loop and the operator's durability gate are
+    model-agnostic."""
+    import jax.numpy as jnp
+
+    from tpu_operator_libs.examples.llama import (
+        config_for_mesh,
+        init_llama_params,
+        make_train_step,
+    )
+
+    config = config_for_mesh(mesh.shape["tp"])
+    params = init_llama_params(mesh, config)
+    optimizer, step_fn = make_train_step(mesh, config)
+    state = {"params": params, "opt": optimizer.init(params),
+             "step": jnp.zeros((), jnp.int32)}
+    return replicate_unplaced(state, mesh), step_fn, config
+
+
 def train(checkpoint_dir: str, max_steps: int = 100,
           save_interval: int = 10, n_devices: int | None = None,
-          stop_flag=None) -> dict:
+          stop_flag=None, model: str = "mlp") -> dict:
     """The training loop. Returns {"final_step", "start_step", "loss"}.
 
-    Importable for tests; __main__ adds signal handling around it.
+    ``model`` picks the workload: "mlp" (tiny regression net) or
+    "llama" (dp×tp-sharded Llama-style decoder). Importable for tests;
+    __main__ adds signal handling around it.
     """
     mesh = make_mesh(n_devices)
-    state, apply_update = init_state(mesh)
+    if model == "llama":
+        from tpu_operator_libs.examples.llama import make_token_batch
+
+        state, step_fn, config = init_state_llama(mesh)
+
+        def apply_update(state, x, y):
+            return step_fn(state, x)
+
+        def llama_batch(step):
+            return make_token_batch(mesh, step, config), None
+
+        next_batch = llama_batch
+    elif model == "mlp":
+        state, apply_update = init_state(mesh)
+
+        def mlp_batch(step):
+            return make_batch(mesh, step)
+
+        next_batch = mlp_batch
+    else:
+        raise ValueError(f"unknown model {model!r}")
     manager = make_checkpoint_manager(checkpoint_dir)
     try:
         state, start_step = restore_state(manager, state)
@@ -177,7 +226,7 @@ def train(checkpoint_dir: str, max_steps: int = 100,
             if stop_flag is not None and stop_flag():
                 logger.info("stop requested at step %d", step)
                 break
-            x, y = make_batch(mesh, step)
+            x, y = next_batch(step)
             state, loss = apply_update(state, x, y)
             done = step + 1
             if done % save_interval == 0 or done == max_steps:
@@ -207,6 +256,10 @@ def main() -> int:
     parser.add_argument("--max-steps", type=int, default=100)
     parser.add_argument("--save-interval", type=int, default=10)
     parser.add_argument("--n-devices", type=int, default=None)
+    parser.add_argument("--model", choices=("mlp", "llama"),
+                        default="mlp",
+                        help="workload: tiny regression MLP or the "
+                             "dp x tp-sharded Llama-style decoder")
     args = parser.parse_args()
     logging.basicConfig(
         level=logging.INFO,
@@ -227,7 +280,8 @@ def main() -> int:
     signal.signal(signal.SIGTERM, on_term)
     signal.signal(signal.SIGINT, on_term)
     result = train(args.checkpoint_dir, args.max_steps, args.save_interval,
-                   args.n_devices, stop_flag=lambda: stop["flag"])
+                   args.n_devices, stop_flag=lambda: stop["flag"],
+                   model=args.model)
     logger.info("exiting at step %d (started from %d)",
                 result["final_step"], result["start_step"])
     return 0
