@@ -69,6 +69,46 @@ class TestMetricsRegistry:
         assert reg.get("multislice_deferred_slices",
                        {"driver": "libtpu"}) == 0
 
+    def test_observe_client_health(self):
+        from tpu_operator_libs.metrics import observe_client_health
+        from tpu_operator_libs.util import (
+            CorrelatingEventRecorder,
+            FakeClock,
+            TokenBucketRateLimiter,
+        )
+
+        clock = FakeClock()
+        limiter = TokenBucketRateLimiter(
+            qps=10.0, burst=1, now=clock.now, sleep=clock.advance)
+        limiter.wait()
+        limiter.wait()  # second call waits 0.1 s
+        recorder = CorrelatingEventRecorder(
+            clock=clock, spam_burst=1, max_similar=10**6)
+
+        class Node1:
+            class metadata:
+                name = "n1"
+
+        recorder.event(Node1(), "Normal", "R", "a")
+        recorder.event(Node1(), "Normal", "R", "b")  # spam-dropped
+        reg = MetricsRegistry()
+        observe_client_health(reg, limiter=limiter, recorder=recorder)
+        labels = {"driver": "libtpu"}
+        assert reg.get("api_throttle_wait_seconds_total",
+                       labels) == pytest.approx(0.1)
+        assert reg.get("events_spam_dropped_total", labels) == 1
+        assert reg.get("events_sink_dropped_total", labels) == 0
+
+    def test_observe_client_health_absent_inputs_export_nothing(self):
+        from tpu_operator_libs.metrics import observe_client_health
+
+        reg = MetricsRegistry()
+        observe_client_health(reg)
+        assert reg.get("api_throttle_wait_seconds_total",
+                       {"driver": "libtpu"}) is None
+        assert reg.get("events_spam_dropped_total",
+                       {"driver": "libtpu"}) is None
+
     def test_histogram_observation_and_rendering(self):
         reg = MetricsRegistry()
         labels = {"controller": "c"}

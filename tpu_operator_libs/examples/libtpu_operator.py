@@ -31,7 +31,11 @@ from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 
 from tpu_operator_libs.api.upgrade_policy import UpgradePolicySpec
 from tpu_operator_libs.consts import UpgradeKeys
-from tpu_operator_libs.metrics import MetricsRegistry, observe_cluster_state
+from tpu_operator_libs.metrics import (
+    MetricsRegistry,
+    observe_client_health,
+    observe_cluster_state,
+)
 from tpu_operator_libs.upgrade.state_manager import (
     BuildStateError,
     ClusterUpgradeStateManager,
@@ -189,6 +193,13 @@ def reconcile_once(mgr, args, policy, registry, runtime_labels) -> None:
                                    time.monotonic() - started,
                                    "Wall-clock seconds per reconcile pass",
                                    {"driver": args.driver})
+        # client-side health: throttle time (on the write client behind
+        # any read cache) + event-correlation drop counters
+        write_client = getattr(mgr.client, "delegate", mgr.client)
+        observe_client_health(
+            registry, args.driver,
+            limiter=getattr(write_client, "rate_limiter", None),
+            recorder=mgr.recorder)
 
 
 def reconcile_forever(mgr, args, policy, registry, stop: threading.Event,

@@ -83,11 +83,26 @@ def ring_attention(q, k, v, axis_name: str, axis_size: int,
     def step(i, carry):
         k_cur, v_cur, m, l, acc = carry
         src_block = (my_block - i) % axis_size
-        k_pos = src_block * s_local + jnp.arange(s_local)
-        m, l, acc = _block_attention(q, k_cur, v_cur, q_pos, k_pos,
-                                     m, l, acc, causal)
+
+        def fold(state):
+            m, l, acc = state
+            k_pos = src_block * s_local + jnp.arange(s_local)
+            return _block_attention(q, k_cur, v_cur, q_pos, k_pos,
+                                    m, l, acc, causal)
+
+        if causal:
+            # a block entirely in the future contributes exact zeros;
+            # skip its einsum+exp rather than computing masked work.
+            # (Devices early in the ring still idle while late ones
+            # fold — the zigzag block layout is the balanced variant.)
+            m, l, acc = lax.cond(src_block > my_block,
+                                 lambda state: state, fold, (m, l, acc))
+        else:
+            m, l, acc = fold((m, l, acc))
         # rotate K/V one hop around the ring for the next step (the
-        # final rotation is wasted but keeps the loop body uniform)
+        # final rotation is wasted but keeps the loop body uniform);
+        # the collective stays OUTSIDE the cond — every device must
+        # participate in every ppermute
         k_nxt = lax.ppermute(k_cur, axis_name, ring)
         v_nxt = lax.ppermute(v_cur, axis_name, ring)
         return k_nxt, v_nxt, m, l, acc

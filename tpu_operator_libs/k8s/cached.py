@@ -230,6 +230,12 @@ class CachedReadClient(K8sClient):
         # delegation the event sink would self-disable behind the cache
         self._delegate.upsert_event(namespace, name, event)
 
+    @property
+    def delegate(self) -> K8sClient:
+        """The wrapped write client (e.g. for reading its rate-limiter
+        counters)."""
+        return self._delegate
+
     # -- watches ----------------------------------------------------------
     def watch(self, kinds: Optional[set[str]] = None,
               namespace: Optional[str] = None) -> Watch:

@@ -85,6 +85,17 @@ class MetricsRegistry:
         with self._lock:
             m.values[self._key(labels)] = value
 
+    def set_counter_total(self, name: str, value: float, help_: str = "",
+                          labels: Optional[dict[str, str]] = None) -> None:
+        """Export an externally-accumulated cumulative value with
+        counter TYPE (Prometheus ``rate()`` then treats any decrease as
+        a counter reset, which is exactly what e.g. a recorder
+        ``clear()`` is). ``set_gauge`` would render ``# TYPE gauge`` and
+        break rate() on *_total-named series."""
+        m = self._metric(name, help_, "counter")
+        with self._lock:
+            m.values[self._key(labels)] = value
+
     def inc_counter(self, name: str, help_: str = "",
                     labels: Optional[dict[str, str]] = None,
                     by: float = 1.0) -> None:
@@ -218,3 +229,34 @@ def observe_cluster_state(registry: MetricsRegistry,
         "exhausted", labels)
     registry.inc_counter("reconciles_total",
                          "apply_state passes executed", labels)
+
+
+def observe_client_health(registry: MetricsRegistry,
+                          driver: str = "libtpu",
+                          limiter: Optional[object] = None,
+                          recorder: Optional[object] = None) -> None:
+    """Export client-side health counters alongside the fleet gauges.
+
+    ``limiter``: a ``TokenBucketRateLimiter`` (api throttle time — the
+    number client-go logs as "client-side throttling"); ``recorder``: a
+    ``CorrelatingEventRecorder`` (spam-filter and sink-overflow drops).
+    Either may be None (the demo / an unthrottled client) — absent
+    inputs export nothing rather than a misleading zero.
+    """
+    labels = {"driver": driver}
+    waited = getattr(limiter, "waited_seconds_total", None)
+    if waited is not None:
+        registry.set_counter_total(
+            "api_throttle_wait_seconds_total", waited,
+            "Cumulative seconds API calls spent client-side throttled",
+            labels)
+    dropped = getattr(recorder, "dropped_total", None)
+    if dropped is not None:
+        registry.set_counter_total(
+            "events_spam_dropped_total", dropped,
+            "Events dropped by the per-object spam filter", labels)
+    sink_dropped = getattr(recorder, "sink_dropped_total", None)
+    if sink_dropped is not None:
+        registry.set_counter_total(
+            "events_sink_dropped_total", sink_dropped,
+            "Correlated events dropped on sink-queue overflow", labels)
