@@ -1,0 +1,161 @@
+"""Pipeline parallelism: layers sharded over a ``pp`` mesh axis,
+activations flowing stage-to-stage on the ICI ring (GPipe schedule).
+
+Each device holds one stage (a contiguous slice of layers). A batch is
+split into M microbatches; on schedule step t, stage s processes
+microbatch ``t - s`` (when in range) and hands its activation to stage
+``s+1`` via ``ppermute`` — the classic bubble-filled GPipe forward:
+``pp + M - 1`` steps total, bubble fraction ``(pp-1)/(pp+M-1)``.
+
+The computation is exact: activations are selected by predicate, the
+permutation only moves them, so the pipelined result equals running all
+layers sequentially on one device to float tolerance (tests assert
+this). The reference has no counterpart (it ships no model code); this
+completes the workload family's parallelism axes (dp/tp/sp/pp/ep)
+alongside the Megatron-split Llama block and ring attention.
+"""
+
+from __future__ import annotations
+
+
+def init_stage_params(key, n_layers_total: int, d_model: int,
+                      d_hidden: int, pp: int):
+    """Stacked residual-MLP block weights, (n_layers, d, h) / (n_layers,
+    h, d) — layer ``i`` belongs to stage ``i // (n_layers/pp)``."""
+    import jax
+
+    if n_layers_total % pp:
+        raise ValueError(
+            f"pp={pp} must divide n_layers={n_layers_total}")
+    k1, k2 = jax.random.split(key)
+    w1 = jax.random.normal(
+        k1, (n_layers_total, d_model, d_hidden)) * d_model ** -0.5
+    w2 = jax.random.normal(
+        k2, (n_layers_total, d_hidden, d_model)) * d_hidden ** -0.5
+    return {"w1": w1, "w2": w2}
+
+
+def _block(x, w1, w2):
+    """One residual MLP layer (B, d) -> (B, d)."""
+    import jax.numpy as jnp
+
+    return x + jnp.tanh(x @ w1) @ w2
+
+
+def _stage_forward(x, w1_stack, w2_stack):
+    """Apply this stage's layer stack sequentially."""
+    from jax import lax
+
+    def body(i, h):
+        return _block(h, w1_stack[i], w2_stack[i])
+
+    return lax.fori_loop(0, w1_stack.shape[0], body, x)
+
+
+def pipeline_forward(params_local, microbatches, axis_name: str,
+                     axis_size: int):
+    """Call INSIDE shard_map. ``params_local``: this stage's stacked
+    weights {"w1": (L/pp, d, h), "w2": (L/pp, h, d)}; ``microbatches``:
+    the full (M, Bm, d) input, identical on every stage (stage 0 reads
+    it; later stages consume upstream activations). Returns (M, Bm, d):
+    the final activations, materialized on the LAST stage (zeros
+    elsewhere — callers psum or read the last stage's shard).
+    """
+    import jax.numpy as jnp
+    from jax import lax
+
+    stage = lax.axis_index(axis_name)
+    n_micro, _, _ = microbatches.shape
+    ring = [(i, (i + 1) % axis_size) for i in range(axis_size)]
+    zero = jnp.zeros_like(microbatches[0])
+
+    def varying(x):
+        return lax.pcast(x, axis_name, to="varying")
+
+    outputs0 = varying(jnp.zeros_like(microbatches))
+    recv0 = varying(zero)
+
+    def step(t, carry):
+        recv, outputs = carry
+        micro_idx = t - stage
+        active = jnp.logical_and(micro_idx >= 0, micro_idx < n_micro)
+        # stage 0 reads the schedule's microbatch; later stages consume
+        # what the previous stage handed over last step
+        feed = lax.dynamic_index_in_dim(
+            microbatches, jnp.clip(micro_idx, 0, n_micro - 1), axis=0,
+            keepdims=False)
+        x_in = jnp.where(stage == 0, feed, recv)
+        out = _stage_forward(x_in, params_local["w1"],
+                             params_local["w2"])
+        out = jnp.where(active, out, zero)
+        # the last stage banks its finished microbatch...
+        is_last = stage == axis_size - 1
+        bank_idx = jnp.clip(micro_idx, 0, n_micro - 1)
+        banked = lax.dynamic_update_index_in_dim(
+            outputs, jnp.where(jnp.logical_and(active, is_last),
+                               out,
+                               lax.dynamic_index_in_dim(
+                                   outputs, bank_idx, axis=0,
+                                   keepdims=False)),
+            bank_idx, axis=0)
+        # ...and every stage forwards to its successor (stage pp-1's
+        # hand-off wraps to stage 0, which ignores it: x_in selects the
+        # schedule feed there)
+        handed = lax.ppermute(out, axis_name, ring)
+        return handed, banked
+
+    _, outputs = lax.fori_loop(0, axis_size + n_micro - 1, step,
+                               (recv0, outputs0))
+    return outputs
+
+
+def make_pipeline(mesh, axis_name: str = "pp"):
+    """jitted (stacked_params, microbatches) -> (M, Bm, d) final
+    activations. ``stacked_params`` are the full-model stacks (L, ...)
+    sharded over layers; the result is psum-combined so every stage
+    returns the same full output (only the last stage's contribution is
+    non-zero)."""
+    import jax
+    from jax import shard_map
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    axis_size = mesh.shape[axis_name]
+    param_spec = {"w1": P(axis_name, None, None),
+                  "w2": P(axis_name, None, None)}
+    data_spec = P()
+
+    def inner(params_local, microbatches):
+        import jax.numpy as jnp
+        from jax import lax
+
+        out = pipeline_forward(params_local, microbatches, axis_name,
+                               axis_size)
+        return lax.psum(out, axis_name)
+
+    sharded = shard_map(inner, mesh=mesh,
+                        in_specs=(param_spec, data_spec),
+                        out_specs=data_spec)
+
+    def place(params, microbatches):
+        placed = {
+            name: jax.device_put(
+                value, NamedSharding(mesh, param_spec[name]))
+            for name, value in params.items()
+        }
+        data = jax.device_put(microbatches, NamedSharding(mesh, P()))
+        return sharded(placed, data)
+
+    return jax.jit(place)
+
+
+def sequential_reference(params, microbatches):
+    """All layers on one device, for verification."""
+    out = []
+    for m in range(microbatches.shape[0]):
+        h = microbatches[m]
+        for i in range(params["w1"].shape[0]):
+            h = _block(h, params["w1"][i], params["w2"][i])
+        out.append(h)
+    import jax.numpy as jnp
+
+    return jnp.stack(out)

@@ -79,11 +79,15 @@ class MetricsRegistry:
     def _key(labels: Optional[dict[str, str]]) -> tuple[tuple[str, str], ...]:
         return tuple(sorted((labels or {}).items()))
 
-    def set_gauge(self, name: str, value: float, help_: str = "",
-                  labels: Optional[dict[str, str]] = None) -> None:
-        m = self._metric(name, help_, "gauge")
+    def _set(self, name: str, value: float, help_: str, type_: str,
+             labels: Optional[dict[str, str]]) -> None:
+        m = self._metric(name, help_, type_)
         with self._lock:
             m.values[self._key(labels)] = value
+
+    def set_gauge(self, name: str, value: float, help_: str = "",
+                  labels: Optional[dict[str, str]] = None) -> None:
+        self._set(name, value, help_, "gauge", labels)
 
     def set_counter_total(self, name: str, value: float, help_: str = "",
                           labels: Optional[dict[str, str]] = None) -> None:
@@ -92,9 +96,7 @@ class MetricsRegistry:
         a counter reset, which is exactly what e.g. a recorder
         ``clear()`` is). ``set_gauge`` would render ``# TYPE gauge`` and
         break rate() on *_total-named series."""
-        m = self._metric(name, help_, "counter")
-        with self._lock:
-            m.values[self._key(labels)] = value
+        self._set(name, value, help_, "counter", labels)
 
     def inc_counter(self, name: str, help_: str = "",
                     labels: Optional[dict[str, str]] = None,
