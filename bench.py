@@ -332,7 +332,7 @@ try:
     BATCH = int(os.environ.get("BENCH_MODEL_BATCH", "16"))
     overridden = any(os.environ.get(k) for k in (
         "BENCH_MODEL_D", "BENCH_MODEL_LAYERS", "BENCH_MODEL_SEQ",
-        "BENCH_MODEL_BATCH"))
+        "BENCH_MODEL_BATCH", "BENCH_MODEL_LONG_SEQ"))
 
     device = jax.devices()[0]
     mesh = Mesh(np.array([device]).reshape(1, 1), ("dp", "tp"))
@@ -362,11 +362,50 @@ try:
     # attention term (12 * B * heads * S^2 * head_dim per layer)
     flops = 6.0 * n_params * tokens + 12.0 * BATCH * cfg.n_heads \
         * cfg.seq_len ** 2 * cfg.head_dim * cfg.n_layers
+
+    # Long-context cell: forward loss at BENCH_MODEL_LONG_SEQ, XLA
+    # einsum attention vs the Pallas flash kernel (TPU only — the
+    # kernel never materializes the S x S scores, which is where XLA's
+    # path drowns in HBM traffic at long context).
+    import dataclasses
+
+    long_ms = {"xla": None, "flash": None}
+    LONG_SEQ = int(os.environ.get("BENCH_MODEL_LONG_SEQ", "8192"))
+    if device.platform == "tpu":
+        cfg_long = dataclasses.replace(cfg, seq_len=LONG_SEQ,
+                                       n_layers=min(cfg.n_layers, 2))
+        # forward() iterates params["layers"], so the depth bound must
+        # be applied to the params too, not just the config
+        params_long = dict(params,
+                           layers=params["layers"][:cfg_long.n_layers])
+        toks_long = make_token_batch(mesh, 0, cfg_long,
+                                     batch_per_shard=1)
+        for impl in ("xla", "flash"):
+            cfg_i = dataclasses.replace(cfg_long, attention_impl=impl)
+
+            def loss_fn(p, t, cfg_i=cfg_i):
+                from tpu_operator_libs.examples.llama import (
+                    next_token_loss,
+                )
+
+                return next_token_loss(p, t, cfg_i, mesh)
+
+            fn = jax.jit(loss_fn)
+            float(fn(params_long, toks_long))  # compile + warm
+            t0 = time.perf_counter()
+            for _ in range(3):
+                float(fn(params_long, toks_long))
+            long_ms[impl] = round(
+                (time.perf_counter() - t0) / 3 * 1e3, 1)
+
     print(json.dumps({
         "train_model": f"llama-{round(n_params / 1e6)}M",
         "train_params_m": round(n_params / 1e6, 1),
         "train_step_ms": round(best * 1e3, 1),
         "train_tflops_bf16": round(flops / best / 1e12, 3),
+        "long_context_seq": LONG_SEQ,
+        "long_context_xla_ms": long_ms["xla"],
+        "long_context_flash_ms": long_ms["flash"],
         "loss_finite": math.isfinite(fenced),
         "shape_overrides": overridden,
         "device_kind": device.device_kind,
@@ -382,6 +421,10 @@ _MODEL_NULLS = {
     "train_step_ms": None,
     "train_tflops_bf16": None,
     "train_mfu_pct": None,
+    "long_context_seq": None,
+    "long_context_xla_ms": None,
+    "long_context_flash_ms": None,
+    "flash_attention_speedup": None,
 }
 
 
@@ -408,6 +451,8 @@ def _model_capture(hardware: dict) -> dict:
                                                "non-finite loss")
     peak = _peak_for(data.get("device_kind", ""), _BF16_PEAK_TFLOPS)
     tflops = data.get("train_tflops_bf16")
+    xla_ms = data.get("long_context_xla_ms")
+    flash_ms = data.get("long_context_flash_ms")
     out = {
         "train_model": data.get("train_model"),
         "train_params_m": data.get("train_params_m"),
@@ -415,6 +460,11 @@ def _model_capture(hardware: dict) -> dict:
         "train_tflops_bf16": tflops,
         "train_mfu_pct": (round(100.0 * tflops / peak, 1)
                           if tflops and peak else None),
+        "long_context_seq": data.get("long_context_seq"),
+        "long_context_xla_ms": xla_ms,
+        "long_context_flash_ms": flash_ms,
+        "flash_attention_speedup": (round(xla_ms / flash_ms, 2)
+                                    if xla_ms and flash_ms else None),
     }
     if data.get("shape_overrides"):
         out["train_shape_overrides"] = True

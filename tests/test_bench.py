@@ -10,6 +10,8 @@ import json
 import os
 import sys
 
+import pytest
+
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(
     __file__))))
 
@@ -136,6 +138,9 @@ class TestHardwareResult:
     def test_model_capture_computes_mfu_from_peak_table(self, monkeypatch):
         payload = {"train_model": "llama-277M", "train_params_m": 276.8,
                    "train_step_ms": 300.0, "train_tflops_bf16": 98.5,
+                   "long_context_seq": 8192,
+                   "long_context_xla_ms": 978.0,
+                   "long_context_flash_ms": 106.0,
                    "loss_finite": True, "shape_overrides": False,
                    "device_kind": "TPU v5 lite"}
         monkeypatch.setattr(
@@ -144,6 +149,23 @@ class TestHardwareResult:
         out = bench._model_capture({"tpu_unreachable": False})
         assert out["train_mfu_pct"] == 50.0
         assert out["train_model"] == "llama-277M"
+        assert out["flash_attention_speedup"] == pytest.approx(9.23)
+
+    def test_model_capture_long_context_nullable(self, monkeypatch):
+        # CPU toy run: the long-context cell is TPU-only and must stay
+        # null without breaking the capture
+        payload = {"train_model": "llama-1M", "train_params_m": 1.0,
+                   "train_step_ms": 3.0, "train_tflops_bf16": 0.01,
+                   "long_context_seq": 8192,
+                   "long_context_xla_ms": None,
+                   "long_context_flash_ms": None,
+                   "loss_finite": True, "shape_overrides": True,
+                   "device_kind": "cpu"}
+        monkeypatch.setattr(
+            bench, "_probe_once",
+            lambda timeout_s, script=None: (payload, "ok"))
+        out = bench._model_capture({"tpu_unreachable": False})
+        assert out["flash_attention_speedup"] is None
 
     def test_shape_overridden_capture_not_persisted(self, tmp_path,
                                                     monkeypatch):

@@ -53,6 +53,17 @@ class TestShardings:
         with pytest.raises(ValueError, match="tp=3"):
             LlamaConfig(n_kv_heads=4).validate_for(3)
 
+    def test_flash_requires_tpu(self):
+        mesh = make_mesh()
+        config = LlamaConfig(attention_impl="flash")
+        params = init_llama_params(mesh, config)
+        with pytest.raises(ValueError, match="Pallas TPU kernel"):
+            forward(params, make_token_batch(mesh, 0, config), config)
+
+    def test_unknown_attention_impl_rejected(self):
+        with pytest.raises(ValueError, match="attention_impl"):
+            LlamaConfig(attention_impl="sdpa").validate_for(1)
+
     def test_odd_head_dim_rejected(self):
         with pytest.raises(ValueError, match="even"):
             LlamaConfig(d_model=72, n_heads=8).validate_for(1)
