@@ -54,7 +54,7 @@ class TestShardings:
             LlamaConfig(n_kv_heads=4).validate_for(3)
 
     def test_flash_requires_tpu(self):
-        mesh = make_mesh()
+        mesh = make_mesh(dp=2, tp=1)  # flash is tp=1-only by validation
         config = LlamaConfig(attention_impl="flash")
         params = init_llama_params(mesh, config)
         with pytest.raises(ValueError, match="Pallas TPU kernel"):
@@ -63,6 +63,20 @@ class TestShardings:
     def test_unknown_attention_impl_rejected(self):
         with pytest.raises(ValueError, match="attention_impl"):
             LlamaConfig(attention_impl="sdpa").validate_for(1)
+        # forward validates too: direct callers must not silently fall
+        # back to the einsum path on a typo
+        mesh = make_mesh()
+        config = LlamaConfig()
+        params = init_llama_params(mesh, config)
+        import dataclasses
+
+        bad = dataclasses.replace(config, attention_impl="Flash")
+        with pytest.raises(ValueError, match="attention_impl"):
+            forward(params, make_token_batch(mesh, 0, config), bad)
+
+    def test_flash_rejected_with_tensor_parallelism(self):
+        with pytest.raises(ValueError, match="tp=1"):
+            LlamaConfig(attention_impl="flash").validate_for(4)
 
     def test_odd_head_dim_rejected(self):
         with pytest.raises(ValueError, match="even"):

@@ -212,6 +212,66 @@ class TestPrimitiveContention:
             names.remove("node")
             barrier.reset()
 
+    def test_correlating_recorder_under_parallel_emitters(self):
+        """8 threads hammer the correlating recorder with a mix of
+        duplicate and distinct events on shared and private objects;
+        totals must balance exactly (recorded counts + spam drops =
+        emissions) and the sink must see every surviving delivery in a
+        consistent snapshot (count fields monotone per key)."""
+        from tpu_operator_libs.util import CorrelatingEventRecorder
+
+        deliveries: list[tuple] = []
+        dlock = threading.Lock()
+
+        def sink(key, event, is_update):
+            with dlock:
+                deliveries.append((key, event.count))
+
+        rec = CorrelatingEventRecorder(
+            capacity=5000, spam_burst=10**6, max_similar=10**6,
+            sink=sink, sink_queue_size=10**6)
+        per_thread = 200
+
+        class Obj:
+            def __init__(self, name):
+                self.metadata = type("M", (), {"name": name})
+
+        def emitter(i):
+            shared = Obj("shared-node")
+            private = Obj(f"node-{i}")
+            for n in range(per_thread):
+                # duplicates on a shared object contend on count bumps
+                rec.event(shared, "Normal", "Shared", "same message")
+                # distinct per-thread events exercise insertion
+                rec.event(private, "Normal", "Priv", f"m{n}")
+
+        threads = [threading.Thread(target=emitter, args=(i,))
+                   for i in range(8)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+        rec.flush()
+        rec.close()
+
+        total_emitted = 8 * per_thread * 2
+        # every emission is either spam-dropped or lands in exactly one
+        # recorded event's count (capacity is sized to avoid eviction)
+        assert len(rec.events) < 5000
+        assert sum(e.count for e in rec.events) + rec.dropped_total \
+            == total_emitted
+        assert rec.sink_dropped_total == 0  # queue sized not to drop
+        shared_events = [e for e in rec.events
+                        if e.object_name == "shared-node"]
+        assert len(shared_events) == 1
+        assert shared_events[0].count == 8 * per_thread
+        # sink deliveries for one key carry monotonically nondecreasing
+        # counts (snapshots are taken under the recorder lock)
+        by_key: dict = {}
+        for key, count in deliveries:
+            assert count >= by_key.get(key, 0), key
+            by_key[key] = count
+
     def test_keyed_lock_serializes_per_key_not_globally(self):
         lock = KeyedLock()
         active: dict[str, int] = {"a": 0, "b": 0}

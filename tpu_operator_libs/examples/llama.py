@@ -40,9 +40,10 @@ class LlamaConfig:
     learning_rate: float = 3e-3
     # "xla" (einsum softmax; the compiler tiles it well to ~4k context)
     # or "flash" (the Pallas TPU flash-attention kernel; never
-    # materializes the S x S scores — measured ~10x faster end-to-end
-    # at seq 8192 on a v5e, where XLA's materialized f32 score matrix
-    # thrashes HBM)
+    # materializes the S x S scores — measured ~15x faster at seq 8192
+    # on a v5e with amortized-fence timing, where XLA's materialized
+    # f32 score matrix thrashes HBM). tp=1 only: the Pallas custom
+    # call has no tensor-parallel partitioning rule.
     attention_impl: str = "xla"
 
     @property
@@ -67,6 +68,14 @@ class LlamaConfig:
             raise ValueError(
                 f"unknown attention_impl {self.attention_impl!r} "
                 "(expected 'xla' or 'flash')")
+        if self.attention_impl == "flash" and tp > 1:
+            # the Pallas custom call registers no GSPMD partitioning
+            # rule, so head-sharded q/k/v cannot flow through it; until
+            # it is wrapped in shard_map, flash is the tp=1 (dp/sp-only)
+            # configuration
+            raise ValueError(
+                "attention_impl='flash' requires tp=1 (the Pallas "
+                "kernel is not tensor-parallel partitionable)")
 
 
 def _rms_norm(x, weight, eps: float = 1e-5):
@@ -160,10 +169,23 @@ def forward(params, tokens, config: LlamaConfig, mesh=None):
 
     batch, seq = tokens.shape
     hd, nh, nkv = config.head_dim, config.n_heads, config.n_kv_heads
+    if config.attention_impl not in ("xla", "flash"):
+        raise ValueError(
+            f"unknown attention_impl {config.attention_impl!r}")
+    use_flash = config.attention_impl == "flash"
+    if use_flash:
+        if jax.devices()[0].platform != "tpu":
+            raise ValueError(
+                "attention_impl='flash' is the Pallas TPU kernel; "
+                "use 'xla' on other backends")
+        from jax.experimental.pallas.ops.tpu.flash_attention import (
+            flash_attention,
+        )
+
     h = params["embed"][tokens]
     h = constrain(h, P("dp", None, None))
     # only the einsum path materializes a mask; flash masks in-kernel
-    causal = (None if config.attention_impl == "flash"
+    causal = (None if use_flash
               else jnp.tril(jnp.ones((seq, seq), jnp.bool_)))
 
     for layer in params["layers"]:
@@ -178,15 +200,7 @@ def forward(params, tokens, config: LlamaConfig, mesh=None):
         group = nh // nkv
         k = jnp.repeat(k, group, axis=2)
         v = jnp.repeat(v, group, axis=2)
-        if config.attention_impl == "flash":
-            if jax.devices()[0].platform != "tpu":
-                raise ValueError(
-                    "attention_impl='flash' is the Pallas TPU kernel; "
-                    "use 'xla' on other backends")
-            from jax.experimental.pallas.ops.tpu.flash_attention import (
-                flash_attention,
-            )
-
+        if use_flash:
             ctx = flash_attention(
                 jnp.transpose(q, (0, 2, 1, 3)),
                 jnp.transpose(k, (0, 2, 1, 3)),
