@@ -25,6 +25,11 @@ import dataclasses
 from typing import Callable, Optional
 
 
+#: Attention implementations forward() accepts; validate_for and
+#: forward both check against this single list so they cannot drift.
+ATTENTION_IMPLS = ("xla", "flash", "ring")
+
+
 @dataclasses.dataclass(frozen=True)
 class LlamaConfig:
     """Model shape. tp must divide n_heads, n_kv_heads and d_ff."""
@@ -64,10 +69,10 @@ class LlamaConfig:
                 f"tp={tp} must divide n_kv_heads={self.n_kv_heads}, "
                 f"d_ff={self.d_ff} and vocab={self.vocab} "
                 "(lm_head is column-parallel)")
-        if self.attention_impl not in ("xla", "flash"):
+        if self.attention_impl not in ATTENTION_IMPLS:
             raise ValueError(
                 f"unknown attention_impl {self.attention_impl!r} "
-                "(expected 'xla' or 'flash')")
+                f"(expected one of {ATTENTION_IMPLS})")
         if self.attention_impl == "flash" and tp > 1:
             # the Pallas custom call registers no GSPMD partitioning
             # rule, so head-sharded q/k/v cannot flow through it; until
@@ -76,6 +81,10 @@ class LlamaConfig:
             raise ValueError(
                 "attention_impl='flash' requires tp=1 (the Pallas "
                 "kernel is not tensor-parallel partitionable)")
+        if self.attention_impl == "ring" and tp > 1:
+            raise ValueError(
+                "attention_impl='ring' shards the sequence (sp), not "
+                "heads; use tp=1 with a dp x sp mesh")
 
 
 def _rms_norm(x, weight, eps: float = 1e-5):
@@ -115,16 +124,28 @@ def init_llama_params(mesh, config: Optional[LlamaConfig] = None,
     from jax.sharding import NamedSharding, PartitionSpec as P
 
     config = config or LlamaConfig()
-    config.validate_for(mesh.shape["tp"])
+    config.validate_for(dict(mesh.shape).get("tp", 1))
     dtype = param_dtype or jnp.float32
     d, hd = config.d_model, config.head_dim
     keys = iter(jax.random.split(jax.random.PRNGKey(seed),
                                  4 + 9 * config.n_layers))
 
+    axis_names = set(mesh.axis_names)
+    if "tp" not in axis_names and "sp" not in axis_names:
+        # a loud error beats silently replicating every weight on a
+        # mesh whose tp axis was merely misspelled
+        raise ValueError(
+            f"mesh axes {tuple(mesh.axis_names)} carry neither 'tp' "
+            "(Megatron tensor parallelism) nor 'sp' (sequence "
+            "parallelism)")
+
     def tensor(key, shape, spec, scale=None):
         scale = scale if scale is not None else shape[0] ** -0.5
         value = (jax.random.normal(key, shape, jnp.float32)
                  * scale).astype(dtype)
+        if "tp" not in axis_names and "tp" in spec:
+            # sequence-parallel (dp x sp) meshes replicate the weights
+            spec = P()
         return jax.device_put(value, NamedSharding(mesh, spec))
 
     params = {
@@ -169,10 +190,12 @@ def forward(params, tokens, config: LlamaConfig, mesh=None):
 
     batch, seq = tokens.shape
     hd, nh, nkv = config.head_dim, config.n_heads, config.n_kv_heads
-    if config.attention_impl not in ("xla", "flash"):
+    if config.attention_impl not in ATTENTION_IMPLS:
         raise ValueError(
-            f"unknown attention_impl {config.attention_impl!r}")
+            f"unknown attention_impl {config.attention_impl!r} "
+            f"(expected one of {ATTENTION_IMPLS})")
     use_flash = config.attention_impl == "flash"
+    use_ring = config.attention_impl == "ring"
     if use_flash:
         if jax.devices()[0].platform != "tpu":
             raise ValueError(
@@ -181,11 +204,41 @@ def forward(params, tokens, config: LlamaConfig, mesh=None):
         from jax.experimental.pallas.ops.tpu.flash_attention import (
             flash_attention,
         )
+    if use_ring:
+        # sequence parallelism: the sequence dimension shards over an
+        # "sp" mesh axis; attention runs as the ppermute ring (RoPE is
+        # applied below on the GLOBAL position view, so sharding the
+        # sequence cannot skew positions)
+        if mesh is None or "sp" not in mesh.axis_names:
+            raise ValueError(
+                "attention_impl='ring' needs a mesh with an 'sp' axis")
+        from jax import shard_map
+        from tpu_operator_libs.examples.ring_attention import (
+            ring_attention,
+        )
 
+        sp = mesh.shape["sp"]
+        if seq % sp:
+            raise ValueError(
+                f"sequence {seq} must divide over sp={sp}")
+        spec4 = P("dp", "sp", None, None)
+
+        def ring_fn(q, k, v, _sp=sp):
+            from functools import partial
+
+            inner = partial(ring_attention, axis_name="sp",
+                            axis_size=_sp, causal=True)
+            return shard_map(inner, mesh=mesh,
+                             in_specs=(spec4, spec4, spec4),
+                             out_specs=spec4)(q, k, v)
+
+    h_spec = (P("dp", "sp", None) if use_ring
+              else P("dp", None, None))
     h = params["embed"][tokens]
-    h = constrain(h, P("dp", None, None))
-    # only the einsum path materializes a mask; flash masks in-kernel
-    causal = (None if use_flash
+    h = constrain(h, h_spec)
+    # only the einsum path materializes a mask; flash and ring mask
+    # inside their kernels
+    causal = (None if (use_flash or use_ring)
               else jnp.tril(jnp.ones((seq, seq), jnp.bool_)))
 
     for layer in params["layers"]:
@@ -197,9 +250,14 @@ def forward(params, tokens, config: LlamaConfig, mesh=None):
         # grouped-query attention: each KV head serves n_heads/n_kv_heads
         # query heads (repeat stays inside the tp shard: both counts
         # divide by tp)
+        # grouped-query attention: xla/flash repeat KV up-front; the
+        # ring path hands the kernel the narrow nkv-head K/V so each
+        # ppermute hop moves group-x fewer bytes (the kernel repeats
+        # locally per fold)
         group = nh // nkv
-        k = jnp.repeat(k, group, axis=2)
-        v = jnp.repeat(v, group, axis=2)
+        if not use_ring:
+            k = jnp.repeat(k, group, axis=2)
+            v = jnp.repeat(v, group, axis=2)
         if use_flash:
             ctx = flash_attention(
                 jnp.transpose(q, (0, 2, 1, 3)),
@@ -207,6 +265,8 @@ def forward(params, tokens, config: LlamaConfig, mesh=None):
                 jnp.transpose(v, (0, 2, 1, 3)),
                 causal=True, sm_scale=hd ** -0.5)
             ctx = jnp.transpose(ctx, (0, 2, 1, 3))
+        elif use_ring:
+            ctx = ring_fn(q, k, v)
         else:
             scores = jnp.einsum("bqhd,bkhd->bhqk", q, k) * (hd ** -0.5)
             scores = jnp.where(causal[None, None, :, :],
@@ -214,15 +274,20 @@ def forward(params, tokens, config: LlamaConfig, mesh=None):
             attn = jax.nn.softmax(scores, axis=-1).astype(h.dtype)
             ctx = jnp.einsum("bhqk,bkhd->bqhd", attn, v)
         h = h + ctx.reshape(batch, seq, nh * hd) @ layer["wo"]
-        h = constrain(h, P("dp", None, None))
+        h = constrain(h, h_spec)
 
         m = _rms_norm(h, layer["mlp_norm"])
         gated = jax.nn.silu(m @ layer["w_gate"]) * (m @ layer["w_up"])
         h = h + gated @ layer["w_down"]
-        h = constrain(h, P("dp", None, None))
+        h = constrain(h, h_spec)
 
     h = _rms_norm(h, params["final_norm"])
-    return constrain(h @ params["lm_head"], P("dp", None, None))
+    # ring mode keeps the logits sequence-sharded: replicating
+    # (B, S, vocab) — the model's largest activation — would undo the
+    # memory win sequence parallelism exists for
+    return constrain(h @ params["lm_head"],
+                     P("dp", "sp", None) if use_ring
+                     else P("dp", None, None))
 
 
 def next_token_loss(params, tokens, config: LlamaConfig, mesh=None):

@@ -25,12 +25,19 @@ from functools import partial
 def _block_attention(q, k, v, q_pos, k_pos, m, l, acc, causal: bool):
     """Fold one K/V block into the online-softmax state.
 
-    q: (B, Sq, H, D); k/v: (B, Sk, H, D); positions are global token
-    indices used for causal masking across blocks. State: m (running
-    max, B,H,Sq), l (running denominator), acc (B,H,Sq,D), all f32.
+    q: (B, Sq, H, D); k/v: (B, Sk, Hkv, D) where Hkv divides H
+    (grouped-query attention — the repeat to full head count happens
+    HERE, after the ring transfer, so each ppermute hop moves only the
+    narrow KV heads); positions are global token indices used for
+    causal masking across blocks. State: m (running max, B,H,Sq),
+    l (running denominator), acc (B,H,Sq,D), all f32.
     """
     import jax.numpy as jnp
 
+    if k.shape[2] != q.shape[2]:
+        group = q.shape[2] // k.shape[2]
+        k = jnp.repeat(k, group, axis=2)
+        v = jnp.repeat(v, group, axis=2)
     scores = jnp.einsum("bqhd,bkhd->bhqk", q, k).astype(jnp.float32)
     scores = scores * (q.shape[-1] ** -0.5)
     if causal:
@@ -66,18 +73,17 @@ def ring_attention(q, k, v, axis_name: str, axis_size: int,
     my_block = lax.axis_index(axis_name)
     q_pos = my_block * s_local + jnp.arange(s_local)
 
-    # pcast-to-varying: the accumulators are device-local state varying
-    # over the ring axis (jax >= 0.8 tracks varying-manual-axes through
-    # the scan carry; replicated constants would type-mismatch against
-    # the rotating K/V blocks)
-    def varying(x):
-        return lax.pcast(x, axis_name, to="varying")
-
-    m0 = varying(jnp.full((batch, heads, s_local), -jnp.inf,
-                          jnp.float32))
-    l0 = varying(jnp.zeros((batch, heads, s_local), jnp.float32))
-    acc0 = varying(jnp.zeros((batch, heads, s_local, head_dim),
-                             jnp.float32))
+    # The accumulators are device-local state and must carry exactly
+    # the varying-manual-axes q does (jax >= 0.8 type-checks vma
+    # through scan/cond carries; a hand-pcast over just the ring axis
+    # breaks when the caller's shard_map also spans other axes, e.g. a
+    # dp x sp mesh) — deriving them arithmetically from q inherits the
+    # right vma automatically.
+    zeros_bhs = jnp.transpose(q[..., 0] * 0.0,
+                              (0, 2, 1)).astype(jnp.float32)
+    m0 = zeros_bhs - jnp.inf
+    l0 = zeros_bhs
+    acc0 = jnp.transpose(q * 0.0, (0, 2, 1, 3)).astype(jnp.float32)
     ring = [(i, (i + 1) % axis_size) for i in range(axis_size)]
 
     def step(i, carry):
