@@ -88,6 +88,64 @@ class TestMoE:
         assert set(np.array(choice).tolist()) == {0, 1, 2, 3}
         assert float(jnp.min(gate)) > 0.0
 
+    def test_all_to_all_matches_dense_with_generous_capacity(self):
+        """The capacity-bounded Switch dispatch with capacity no token
+        exceeds must equal dense exactly, with zero drops."""
+        params = init_moe_params(jax.random.PRNGKey(0), n_experts=4,
+                                 d_model=16, d_hidden=32)
+        tokens = jax.random.normal(jax.random.PRNGKey(1), (32, 16))
+        out, dropped = make_moe(mesh_1d(4, "ep"), 4,
+                                dispatch="all_to_all",
+                                capacity_factor=8.0)(params, tokens)
+        assert int(dropped) == 0
+        np.testing.assert_allclose(
+            np.array(out), np.array(moe_reference(params, tokens)),
+            rtol=1e-6, atol=1e-6)
+
+    def test_all_to_all_drop_accounting_is_exact(self):
+        """Under a tight capacity, every dropped token gets a zero MoE
+        output (the residual path carries it — Switch semantics), every
+        kept token still matches dense, and the dropped count equals
+        the number of zero rows."""
+        params = init_moe_params(jax.random.PRNGKey(0), n_experts=4,
+                                 d_model=16, d_hidden=32)
+        tokens = jax.random.normal(jax.random.PRNGKey(1), (32, 16))
+        out, dropped = make_moe(mesh_1d(4, "ep"), 4,
+                                dispatch="all_to_all",
+                                capacity_factor=0.25)(params, tokens)
+        out = np.array(out)
+        ref = np.array(moe_reference(params, tokens))
+        zero_rows = int((np.abs(out).sum(axis=1) == 0).sum())
+        kept_match = int((np.abs(out - ref).max(axis=1) < 1e-5).sum())
+        assert int(dropped) > 0
+        assert zero_rows == int(dropped)
+        assert kept_match + zero_rows >= len(tokens)
+
+    def test_all_to_all_bf16_tokens_no_slot_collisions(self):
+        """Regression: slot positions computed in the token dtype made
+        a bf16 cumsum collide slots past 256 tokens per expert (tokens
+        summed into one slot, wrong outputs, no drop recorded). Routing
+        math now stays f32: 600 bf16 tokens to 2 experts must match the
+        dense reference with zero drops."""
+        params = init_moe_params(jax.random.PRNGKey(0), n_experts=2,
+                                 d_model=8, d_hidden=16)
+        tokens = jax.random.normal(jax.random.PRNGKey(1),
+                                   (600, 8)).astype(jnp.bfloat16)
+        out, dropped = make_moe(mesh_1d(2, "ep"), 2,
+                                dispatch="all_to_all",
+                                capacity_factor=4.0)(params, tokens)
+        assert int(dropped) == 0
+        ref = moe_reference(
+            {k: jnp.asarray(v, jnp.bfloat16) if k != "router" else v
+             for k, v in params.items()}, tokens)
+        np.testing.assert_allclose(
+            np.array(out, dtype=np.float32),
+            np.array(ref, dtype=np.float32), rtol=0.1, atol=0.1)
+
+    def test_unknown_dispatch_rejected(self):
+        with pytest.raises(ValueError, match="dispatch"):
+            make_moe(mesh_1d(2, "ep"), 4, dispatch="scatter")
+
     def test_experts_must_divide_shards(self):
         with pytest.raises(ValueError, match="must divide"):
             make_moe(mesh_1d(8, "ep"), n_experts=6)

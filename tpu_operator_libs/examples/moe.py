@@ -5,13 +5,18 @@ Token-choice top-1 routing: a linear router scores every token against
 every expert; each token is processed by its argmax expert, scaled by
 the softmax router probability (Switch-Transformer style). Experts
 live on distinct devices (one expert — or an equal stack — per ``ep``
-shard); tokens are sharded over the same axis as data. Dispatch is the
-all-gather pattern: every expert device gathers the full token set,
-computes only the tokens routed to its local experts (others masked to
-zero), and a ``psum`` combines the disjoint expert outputs back onto
-every shard. Exact — no capacity factor, no token dropping — so tests
-verify equality with the unsharded reference to float tolerance, and
-the routing itself is deterministic.
+shard); tokens are sharded over the same axis as data. Two dispatch
+modes:
+
+- ``"gather"`` (default): every expert device all-gathers the full
+  token set, computes only the tokens routed to its local experts, and
+  a ``psum`` combines the disjoint outputs. Exact — no capacity
+  factor, no token dropping — so tests verify equality with the
+  unsharded reference to float tolerance.
+- ``"all_to_all"``: the production Switch shape — each token travels
+  only to its expert's shard through capacity-bounded slots; tokens
+  over capacity are dropped (zero MoE output, residual carries them)
+  with exact drop accounting.
 
 The reference ships no model code; with the Megatron-split Llama block
 (tp), ring attention (sp) and the GPipe pipeline (pp), this completes
@@ -34,6 +39,15 @@ def init_moe_params(key, n_experts: int, d_model: int, d_hidden: int):
         "w2": jax.random.normal(
             k2, (n_experts, d_hidden, d_model)) * d_hidden ** -0.5,
     }
+
+
+def _expert_mlp(x, w1, w2):
+    """One expert's MLP — single definition shared by both dispatch
+    paths and the dense reference, so the equality tests can never mask
+    a divergence introduced by editing one copy."""
+    import jax.numpy as jnp
+
+    return jnp.tanh(x @ w1) @ w2
 
 
 def _route(tokens, router):
@@ -73,8 +87,8 @@ def moe_forward(params_local, tokens_local, axis_name: str,
         expert_id = shard * experts_per_shard + local_idx
         mine = (choice == expert_id)[:, None]
         x = jnp.where(mine, all_tokens, 0.0)
-        y = jnp.tanh(x @ params_local["w1"][local_idx]) \
-            @ params_local["w2"][local_idx]
+        y = _expert_mlp(x, params_local["w1"][local_idx],
+                        params_local["w2"][local_idx])
         out = out + jnp.where(mine, y, 0.0)
     combined = lax.psum(out * gate[:, None], axis_name)
     # keep only this shard's token slice (the data sharding)
@@ -82,9 +96,85 @@ def moe_forward(params_local, tokens_local, axis_name: str,
                                     axis=0)
 
 
-def make_moe(mesh, n_experts: int, axis_name: str = "ep"):
+def moe_forward_a2a(params_local, tokens_local, axis_name: str,
+                    axis_size: int, n_experts: int, capacity: int):
+    """Call INSIDE shard_map: capacity-bounded all_to_all dispatch —
+    the production Switch-Transformer routing shape.
+
+    Unlike the all-gather path (every shard sees every token, O(global
+    tokens) per device), each token is *sent* to its expert's shard:
+    per (source shard, expert) at most ``capacity`` token slots travel,
+    so per-device ICI traffic and expert compute are O(local tokens ×
+    capacity factor) regardless of fleet size. (The dense one-hot
+    dispatch/combine einsums themselves cost O(Bl·E·C·d) — the standard
+    Switch trade; sort-based dispatch would remove it at the price of
+    gather/scatter.) Tokens beyond an expert's capacity are dropped
+    (their MoE output is zero — the transformer's residual carries
+    them, Switch semantics); the number dropped on this shard is
+    returned for accounting.
+    """
+    import jax
+    import jax.numpy as jnp
+    from jax import lax
+
+    experts_per_shard = n_experts // axis_size
+    d_model = tokens_local.shape[-1]
+    choice, gate = _route(tokens_local, params_local["router"])
+
+    # Slot assignment: position of each token within its expert's
+    # capacity, computed over the LOCAL shard (per-source capacity, as
+    # in Mesh-TensorFlow/Switch dispatch). Routing math stays in f32
+    # regardless of token dtype: a bf16 cumsum cannot represent
+    # integers past 256, which silently COLLIDES slot positions (tokens
+    # summed into one slot, wrong outputs scattered back, no drop
+    # recorded).
+    onehot = jax.nn.one_hot(choice, n_experts,
+                            dtype=jnp.float32)  # (Bl, E)
+    position = (jnp.cumsum(onehot, axis=0) - 1.0) * onehot  # (Bl, E)
+    keep = onehot * (position < capacity)  # (Bl, E) {0,1}
+    dropped = jnp.sum(onehot) - jnp.sum(keep)
+    slot_onehot = keep[..., None] * jax.nn.one_hot(
+        position.astype(jnp.int32), capacity,
+        dtype=jnp.float32)  # (Bl, E, C)
+
+    # dispatch: (E, C, d) slots destined per expert, reshaped so the
+    # leading axis is the destination shard for all_to_all (f32 slot
+    # math; cast back to the token dtype at the end)
+    send = jnp.einsum("bd,bec->ecd",
+                      tokens_local.astype(jnp.float32), slot_onehot)
+    send = send.astype(tokens_local.dtype)
+    send = send.reshape(axis_size, experts_per_shard, capacity, d_model)
+    recv = lax.all_to_all(send, axis_name, split_axis=0, concat_axis=0,
+                          tiled=False)
+    # recv: (source_shard, Eps, C, d) — every source's slots for MY
+    # experts; run each local expert over its flattened slot batch
+    out_slots = []
+    for local_idx in range(experts_per_shard):
+        x = recv[:, local_idx].reshape(axis_size * capacity, d_model)
+        y = _expert_mlp(x, params_local["w1"][local_idx],
+                        params_local["w2"][local_idx])
+        out_slots.append(y.reshape(axis_size, capacity, d_model))
+    processed = jnp.stack(out_slots, axis=1)  # (src, Eps, C, d)
+    back = lax.all_to_all(processed, axis_name, split_axis=0,
+                          concat_axis=0, tiled=False)
+    # back: (dest_shard=my experts' shards, Eps, C, d) == the slot
+    # layout of `send`; combine into token order and apply the gate
+    back = back.reshape(n_experts, capacity, d_model)
+    combined = jnp.einsum("ecd,bec->bd", back.astype(jnp.float32),
+                          slot_onehot).astype(tokens_local.dtype)
+    return combined * gate[:, None], dropped
+
+
+def make_moe(mesh, n_experts: int, axis_name: str = "ep",
+             dispatch: str = "gather", capacity_factor: float = 1.25):
     """jitted (params, tokens) -> MoE output; tokens (B, d) sharded over
-    ``ep``, experts sharded over ``ep``, router replicated."""
+    ``ep``, experts sharded over ``ep``, router replicated.
+
+    ``dispatch``: "gather" (all-gather + psum; exact, no drops, per-
+    device cost O(global tokens)) or "all_to_all" (capacity-bounded
+    Switch dispatch; per-device cost O(local tokens × capacity_factor);
+    over-capacity tokens get a zero MoE output). With all_to_all the
+    returned callable yields ``(out, dropped_total)``."""
     import jax
     from jax import shard_map
     from jax.sharding import NamedSharding, PartitionSpec as P
@@ -93,18 +183,40 @@ def make_moe(mesh, n_experts: int, axis_name: str = "ep"):
     if n_experts % axis_size:
         raise ValueError(
             f"ep={axis_size} must divide n_experts={n_experts}")
+    if dispatch not in ("gather", "all_to_all"):
+        raise ValueError(f"unknown dispatch {dispatch!r}")
     param_spec = {"router": P(None, None),
                   "w1": P(axis_name, None, None),
                   "w2": P(axis_name, None, None)}
     token_spec = P(axis_name, None)
 
-    def inner(params_local, tokens_local):
+    def inner_gather(params_local, tokens_local):
         return moe_forward(params_local, tokens_local, axis_name,
                            axis_size, n_experts)
 
+    def inner_a2a(params_local, tokens_local):
+        # per-(source shard, expert) capacity from the local batch
+        import math
+
+        from jax import lax
+
+        capacity = max(1, math.ceil(
+            tokens_local.shape[0] * capacity_factor / n_experts))
+        out, dropped = moe_forward_a2a(
+            params_local, tokens_local, axis_name, axis_size,
+            n_experts, capacity)
+        return out, lax.psum(dropped, axis_name)
+
+    if dispatch == "gather":
+        inner = inner_gather
+        out_specs = token_spec
+    else:
+        inner = inner_a2a
+        out_specs = (token_spec, P())
+
     sharded = shard_map(inner, mesh=mesh,
                         in_specs=(param_spec, token_spec),
-                        out_specs=token_spec)
+                        out_specs=out_specs)
 
     def place(params, tokens):
         placed = {
@@ -126,6 +238,6 @@ def dense_reference(params, tokens):
     out = jnp.zeros_like(tokens)
     for e in range(params["w1"].shape[0]):
         mine = (choice == e)[:, None]
-        y = jnp.tanh(tokens @ params["w1"][e]) @ params["w2"][e]
+        y = _expert_mlp(tokens, params["w1"][e], params["w2"][e])
         out = out + jnp.where(mine, y, 0.0)
     return out * gate[:, None]
