@@ -356,6 +356,111 @@ class TestFakeClusterPods:
         # the stale entry used to make this raise KeyError
         assert cluster.list_pods(field_selector="spec.nodeName=a") == []
 
+    def test_pdb_min_available_blocks_then_admits(self):
+        """policy/v1 PDB semantics on the eviction subresource: with
+        minAvailable=2 of 3 ready pods, one eviction is admitted and
+        the next is blocked (HTTP 429 analogue)."""
+        from tpu_operator_libs.k8s.objects import (
+            ObjectMeta,
+            PodDisruptionBudget,
+        )
+
+        cluster = FakeCluster()
+        for i in range(3):
+            PodBuilder(f"w{i}").with_labels({"app": "job"}) \
+                .create(cluster)
+        cluster.add_pod_disruption_budget(PodDisruptionBudget(
+            metadata=ObjectMeta(name="job-pdb", namespace="tpu-system"),
+            selector={"app": "job"}, min_available=2))
+        cluster.evict_pod("tpu-system", "w0")  # 3 healthy -> 2, allowed
+        with pytest.raises(EvictionBlockedError, match="job-pdb"):
+            cluster.evict_pod("tpu-system", "w1")  # would leave 1 < 2
+        # non-matching pods are unaffected
+        PodBuilder("other").with_labels({"app": "else"}).create(cluster)
+        cluster.evict_pod("tpu-system", "other")
+
+    def test_pdb_max_unavailable_percent(self):
+        from tpu_operator_libs.k8s.objects import (
+            ObjectMeta,
+            PodDisruptionBudget,
+        )
+
+        cluster = FakeCluster()
+        for i in range(4):
+            PodBuilder(f"w{i}").with_labels({"app": "job"}) \
+                .create(cluster)
+        cluster.add_pod_disruption_budget(PodDisruptionBudget(
+            metadata=ObjectMeta(name="pdb", namespace="tpu-system"),
+            selector={"app": "job"}, max_unavailable="25%"))
+        cluster.evict_pod("tpu-system", "w0")  # 25% of 4 = 1, allowed
+        # the workload controller recreates the evicted pod (pending,
+        # not ready) — expected stays 4, healthy 3, budget exhausted
+        PodBuilder("w0b").with_labels({"app": "job"}).create(cluster)
+        cluster.set_pod_status("tpu-system", "w0b", ready=False)
+        with pytest.raises(EvictionBlockedError):
+            cluster.evict_pod("tpu-system", "w1")
+
+    def test_pdb_not_ready_pods_do_not_count_healthy(self):
+        from tpu_operator_libs.k8s.objects import (
+            ObjectMeta,
+            PodDisruptionBudget,
+        )
+
+        cluster = FakeCluster()
+        for i in range(2):
+            PodBuilder(f"w{i}").with_labels({"app": "job"}) \
+                .create(cluster)
+        cluster.set_pod_status("tpu-system", "w1", ready=False)
+        cluster.add_pod_disruption_budget(PodDisruptionBudget(
+            metadata=ObjectMeta(name="pdb", namespace="tpu-system"),
+            selector={"app": "job"}, min_available=1))
+        with pytest.raises(EvictionBlockedError):
+            # only w0 is healthy; evicting it leaves 0 < 1
+            cluster.evict_pod("tpu-system", "w0")
+        # IfHealthyBudget (policy/v1 default): evicting the UNHEALTHY
+        # pod does not reduce currentHealthy and is admitted
+        cluster.evict_pod("tpu-system", "w1")
+        # deleting the PDB lifts the gate
+        cluster.delete_pod_disruption_budget("tpu-system", "pdb")
+        cluster.evict_pod("tpu-system", "w0")
+
+    def test_pdb_empty_selector_guards_whole_namespace(self):
+        # policy/v1: an empty selector selects ALL pods in the namespace
+        from tpu_operator_libs.k8s.objects import (
+            ObjectMeta,
+            PodDisruptionBudget,
+        )
+
+        cluster = FakeCluster()
+        PodBuilder("w0").with_labels({"anything": "x"}).create(cluster)
+        cluster.add_pod_disruption_budget(PodDisruptionBudget(
+            metadata=ObjectMeta(name="pdb", namespace="tpu-system"),
+            min_available=1))
+        with pytest.raises(EvictionBlockedError):
+            cluster.evict_pod("tpu-system", "w0")
+
+    def test_pdb_overlapping_budgets_refuse_eviction(self):
+        # the apiserver refuses when >1 PDB covers the pod, even with
+        # budget to spare in each
+        from tpu_operator_libs.k8s.objects import (
+            ObjectMeta,
+            PodDisruptionBudget,
+        )
+
+        cluster = FakeCluster()
+        PodBuilder("w0").with_labels({"app": "job"}).create(cluster)
+        for name in ("a", "b"):
+            cluster.add_pod_disruption_budget(PodDisruptionBudget(
+                metadata=ObjectMeta(name=name, namespace="tpu-system"),
+                selector={"app": "job"}, min_available=0))
+        with pytest.raises(EvictionBlockedError,
+                           match="more than one"):
+            cluster.evict_pod("tpu-system", "w0")
+
+    def test_pdb_delete_missing_not_found(self):
+        with pytest.raises(NotFoundError):
+            FakeCluster().delete_pod_disruption_budget("ns", "nope")
+
     def test_eviction_blocker(self):
         cluster = FakeCluster()
         PodBuilder("p1").with_labels({"protected": "true"}).create(cluster)

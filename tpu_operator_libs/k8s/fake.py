@@ -48,6 +48,7 @@ from tpu_operator_libs.k8s.objects import (
     ObjectMeta,
     OwnerReference,
     Pod,
+    PodDisruptionBudget,
     PodPhase,
     PodSpec,
     PodStatus,
@@ -96,6 +97,8 @@ class FakeCluster(K8sClient):
         self._pods: dict[tuple[str, str], Pod] = {}
         # v1 Events written through the recorder sink, keyed (ns, name)
         self._cluster_events: dict[tuple[str, str], object] = {}
+        # policy/v1 PodDisruptionBudgets, keyed (ns, name)
+        self._pdbs: dict[tuple[str, str], PodDisruptionBudget] = {}
         # spec.nodeName index over _pods, maintained by _pod_put/_pod_pop
         # (pod nodeName is immutable once bound, as in Kubernetes, so
         # membership never changes in place). Serves the apiserver's
@@ -606,9 +609,88 @@ class FakeCluster(K8sClient):
                     raise EvictionBlockedError(
                         f"eviction of {namespace}/{name} blocked by "
                         f"disruption budget")
+            self._check_pdbs(pod)
             self._pod_pop((namespace, name))
             self._notify(DELETED, KIND_POD, pod)
             self._maybe_recreate_ds_pod(pod)
+
+    # ------------------------------------------------------------------
+    # policy/v1 PodDisruptionBudgets (eviction-subresource enforcement)
+    # ------------------------------------------------------------------
+    def add_pod_disruption_budget(self, pdb: PodDisruptionBudget) \
+            -> PodDisruptionBudget:
+        """Install a PDB; subsequent evictions of selector-matching pods
+        in its namespace are admitted only while disruptionsAllowed > 0,
+        exactly the apiserver check that surfaces as HTTP 429."""
+        with self._lock:
+            self._pdbs[(pdb.metadata.namespace, pdb.metadata.name)] = \
+                pdb.clone()
+        return pdb
+
+    def delete_pod_disruption_budget(self, namespace: str,
+                                     name: str) -> None:
+        with self._lock:
+            if self._pdbs.pop((namespace, name), None) is None:
+                raise NotFoundError(
+                    f"pdb {namespace}/{name} not found")
+
+    @staticmethod
+    def _scaled(value: object, total: int) -> int:
+        """int, or "N%" rounded the way the apiserver rounds:
+        minAvailable percents round UP (conservative toward keeping
+        pods), which is also safe for maxUnavailable here because the
+        caller subtracts."""
+        if isinstance(value, str) and value.endswith("%"):
+            import math
+
+            return math.ceil(total * int(value[:-1]) / 100.0)
+        return int(value)  # type: ignore[arg-type]
+
+    def _check_pdbs(self, pod: Pod) -> None:
+        """Raise EvictionBlockedError when any matching PDB has no
+        disruptions left (lock held).
+
+        Expected pod count is the CURRENT selector-matching count (the
+        apiserver reads the controller's scale; with no controllers the
+        live count is the envtest-grade approximation — note an evicted
+        pod that the workload controller has not yet recreated shrinks
+        the percent base accordingly)."""
+        def matches(labels: Mapping[str, str], selector: dict) -> bool:
+            # policy/v1 semantics: an EMPTY selector selects every pod
+            # in the namespace (v1beta1's match-nothing was reversed)
+            return all(labels.get(k) == v for k, v in selector.items())
+
+        relevant = [pdb for pdb in self._pdbs.values()
+                    if pdb.metadata.namespace == pod.metadata.namespace
+                    and matches(pod.metadata.labels, pdb.selector)]
+        if len(relevant) > 1:
+            # the real apiserver refuses outright when a pod is covered
+            # by more than one PDB
+            raise EvictionBlockedError(
+                f"pod {pod.metadata.namespace}/{pod.metadata.name} is "
+                f"covered by more than one PodDisruptionBudget")
+        for pdb in relevant:
+            matching = [p for p in self._pods.values()
+                        if p.metadata.namespace == pdb.metadata.namespace
+                        and matches(p.metadata.labels, pdb.selector)]
+            healthy = sum(1 for p in matching if p.is_ready())
+            if pdb.min_available is not None:
+                desired = self._scaled(pdb.min_available, len(matching))
+            elif pdb.max_unavailable is not None:
+                desired = len(matching) - self._scaled(
+                    pdb.max_unavailable, len(matching))
+            else:
+                continue
+            # IfHealthyBudget (the policy/v1 default): evicting an
+            # UNHEALTHY pod does not reduce currentHealthy and is
+            # admitted while the budget holds
+            delta = 1 if pod.is_ready() else 0
+            if healthy - delta < desired:
+                raise EvictionBlockedError(
+                    f"eviction of {pod.metadata.namespace}/"
+                    f"{pod.metadata.name} violates PodDisruptionBudget "
+                    f"{pdb.metadata.name} (healthy={healthy}, "
+                    f"required={desired})")
 
     def _ds_key_by_owner_uid(self, uid: str) -> Optional[tuple[str, str]]:
         """(namespace, name) of the DaemonSet with this UID, or None.

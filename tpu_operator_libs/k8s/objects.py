@@ -302,6 +302,30 @@ class ControllerRevision:
 
 
 @dataclass
+class PodDisruptionBudget:
+    """A policy/v1 PodDisruptionBudget (the object behind eviction 429s).
+
+    Exactly one of ``min_available`` / ``max_unavailable`` should be
+    set; each accepts an int or a percent string ("50%"), scaled
+    against the count of selector-matching pods (the apiserver scales
+    against the controller's expected replicas; matching-pod count is
+    the envtest-grade approximation — with no controllers, they agree).
+    """
+
+    metadata: ObjectMeta
+    selector: dict = field(default_factory=dict)
+    min_available: Optional[object] = None
+    max_unavailable: Optional[object] = None
+
+    def clone(self) -> "PodDisruptionBudget":
+        return PodDisruptionBudget(
+            metadata=self.metadata.clone(),
+            selector=dict(self.selector),
+            min_available=self.min_available,
+            max_unavailable=self.max_unavailable)
+
+
+@dataclass
 class Lease:
     """A coordination.k8s.io/v1 Lease, the leader-election lock object.
 
