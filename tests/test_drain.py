@@ -126,6 +126,46 @@ class TestDrainHelperFilters:
         assert env.cluster.list_pods() == []
         assert env.clock.now() >= unblock_at  # actually waited
 
+    def test_real_pdb_object_blocks_then_admits_drain(self):
+        """Same retry path driven by an actual policy/v1 PDB object:
+        the budget frees when a sibling pod on another node becomes
+        Ready again, and the drain completes within its timeout."""
+        from tpu_operator_libs.k8s.objects import (
+            ObjectMeta,
+            PodDisruptionBudget,
+        )
+
+        env = make_env()
+        n1 = NodeBuilder("n1").create(env.cluster)
+        NodeBuilder("n2").create(env.cluster)
+        victim = PodBuilder("w1").on_node(n1).orphaned() \
+            .with_labels({"app": "job"}).create(env.cluster)
+        PodBuilder("w2").on_node("n2").orphaned() \
+            .with_labels({"app": "job"}).create(env.cluster)
+        # sibling not ready: healthy=1, minAvailable=1 -> w1 blocked
+        env.cluster.set_pod_status("tpu-system", "w2", ready=False)
+        env.cluster.add_pod_disruption_budget(PodDisruptionBudget(
+            metadata=ObjectMeta(name="job-pdb", namespace="tpu-system"),
+            selector={"app": "job"}, min_available=1))
+        env.cluster.schedule_at(
+            3.0, lambda: env.cluster.set_pod_status(
+                "tpu-system", "w2", ready=True))
+        # the world advances while the drain waits: each virtual sleep
+        # also fires due cluster actions (what the simulator's event
+        # loop does between reconciles)
+        orig_sleep = env.clock.sleep
+
+        def sleep_and_step(seconds):
+            orig_sleep(seconds)
+            env.cluster.step()
+
+        env.clock.sleep = sleep_and_step
+        helper = self._helper(env, force=True, timeout_seconds=30,
+                              poll_interval=1.0)
+        helper.delete_or_evict_pods([victim])
+        assert [p.name for p in env.cluster.list_pods()] == ["w2"]
+        assert env.clock.now() >= 3.0  # the budget gated real time
+
     def test_pdb_blocked_past_timeout_raises(self):
         env = make_env()
         node = NodeBuilder("n1").create(env.cluster)
