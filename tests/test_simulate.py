@@ -4,6 +4,8 @@ import json
 import subprocess
 import sys
 
+import pytest
+
 from tpu_operator_libs.simulate import FleetSpec, simulate_rolling_upgrade
 
 
@@ -202,9 +204,12 @@ class TestChaosCombined:
     NotReady flip, a mid-upgrade scale-down, and a multislice job —
     exercising the interactions the per-fault tests cannot."""
 
-    def test_all_faults_together_converges_with_invariants(self):
+    @pytest.mark.parametrize("watch_driven", [False, True])
+    def test_all_faults_together_converges_with_invariants(
+            self, watch_driven):
         r = simulate_rolling_upgrade(
             topology_mode="slice", chained=True,
+            watch_driven=watch_driven,
             fleet=FleetSpec(
                 n_slices=4, hosts_per_slice=2,
                 delay_jitter=0.35,
