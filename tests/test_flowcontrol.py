@@ -112,6 +112,58 @@ class TestTokenBucket:
             TokenBucketRateLimiter(burst=0)
 
 
+class TestTokenBucketProperties:
+    """Property-based: for ANY qps/burst and any admission sequence,
+    the limiter never admits more than burst + qps*elapsed requests —
+    the one guarantee everything else rests on."""
+
+    from hypothesis import given, settings
+    from hypothesis import strategies as st
+
+    @given(
+        qps=st.floats(min_value=0.5, max_value=100.0,
+                      allow_nan=False, allow_infinity=False),
+        burst=st.integers(min_value=1, max_value=20),
+        gaps=st.lists(st.floats(min_value=0.0, max_value=5.0,
+                                allow_nan=False, allow_infinity=False),
+                      min_size=1, max_size=60),
+    )
+    @settings(deadline=None, max_examples=60)
+    def test_rate_never_exceeded(self, qps, burst, gaps):
+        mt = ManualTime()
+        limiter = TokenBucketRateLimiter(qps=qps, burst=burst,
+                                         now=mt.now, sleep=mt.sleep)
+        admitted_at = []
+        for gap in gaps:
+            mt.t += gap
+            limiter.wait()  # sleeping advances mt.t to admission time
+            admitted_at.append(mt.t)
+        start = admitted_at[0]
+        for i, t in enumerate(admitted_at):
+            # by time t, at most burst + qps*(t-start) admissions may
+            # have occurred (i+1 happened, the first at `start`)
+            ceiling = burst + qps * (t - start) + 1e-6
+            assert i + 1 <= ceiling, (
+                f"admitted {i + 1} by +{t - start:.3f}s "
+                f"(ceiling {ceiling:.3f}) qps={qps} burst={burst}")
+
+    @given(
+        qps=st.floats(min_value=0.5, max_value=100.0,
+                      allow_nan=False, allow_infinity=False),
+        burst=st.integers(min_value=1, max_value=20),
+        n=st.integers(min_value=1, max_value=50),
+    )
+    @settings(deadline=None, max_examples=40)
+    def test_every_waiter_is_eventually_admitted(self, qps, burst, n):
+        mt = ManualTime()
+        limiter = TokenBucketRateLimiter(qps=qps, burst=burst,
+                                         now=mt.now, sleep=mt.sleep)
+        for _ in range(n):
+            limiter.wait()  # must never deadlock or raise
+        # total time spent is bounded by the debt the rate implies
+        assert mt.t <= (n / qps) + 1e-6
+
+
 class TestRealClusterTransportThrottling:
     """The limiter mounts below the pager (client-go rest.Config
     placement): every HTTP request charges a token, including each page
