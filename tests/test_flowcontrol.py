@@ -7,6 +7,8 @@ import threading
 import time
 
 import pytest
+from hypothesis import given, settings
+from hypothesis import strategies as st
 
 from tpu_operator_libs.k8s.fake import FakeCluster
 from tpu_operator_libs.k8s.flowcontrol import TokenBucketRateLimiter
@@ -116,9 +118,6 @@ class TestTokenBucketProperties:
     """Property-based: for ANY qps/burst and any admission sequence,
     the limiter never admits more than burst + qps*elapsed requests —
     the one guarantee everything else rests on."""
-
-    from hypothesis import given, settings
-    from hypothesis import strategies as st
 
     @given(
         qps=st.floats(min_value=0.5, max_value=100.0,

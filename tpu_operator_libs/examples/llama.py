@@ -96,14 +96,20 @@ def _rms_norm(x, weight, eps: float = 1e-5):
         * weight
 
 
-def _rope(x, theta: float):
-    """Rotary position embedding over the last axis of (B, S, H, D)."""
+def _rope(x, theta: float, positions=None):
+    """Rotary position embedding over the last axis of (B, S, H, D).
+
+    ``positions`` (S,) overrides the default 0..S-1 — decode steps pass
+    the absolute position so a cached token rotates identically whether
+    it arrived via prefill or one step at a time."""
     import jax.numpy as jnp
 
     _, seq, _, head_dim = x.shape
     half = head_dim // 2
     freqs = theta ** (-jnp.arange(0, half, dtype=jnp.float32) / half)
-    angles = jnp.arange(seq, dtype=jnp.float32)[:, None] * freqs[None, :]
+    if positions is None:
+        positions = jnp.arange(seq, dtype=jnp.float32)
+    angles = positions.astype(jnp.float32)[:, None] * freqs[None, :]
     cos = jnp.cos(angles)[None, :, None, :]
     sin = jnp.sin(angles)[None, :, None, :]
     x1, x2 = x[..., :half], x[..., half:]

@@ -1,0 +1,150 @@
+"""Autoregressive decoding with a KV cache for the Llama example.
+
+The serving half of the workload family: training (``llama.py``) and
+inference share the same parameters and block math; decode adds a
+per-layer key/value cache so each generated token costs one pass over
+the new position instead of re-running the full sequence (decode is
+memory-bound — every step streams the parameters once, so step time
+≈ param bytes / HBM bandwidth).
+
+The test contract: feeding a sequence one token at a time through
+:func:`forward_with_cache` reproduces the batch
+:func:`~tpu_operator_libs.examples.llama.forward` logits at every
+position to float tolerance (~1e-4 — the cache is a rearrangement,
+not an approximation, but softmax reduction order differs over the
+masked cache width).
+"""
+
+from __future__ import annotations
+
+import functools
+
+
+def init_kv_cache(mesh, config, batch: int, max_seq: int,
+                  param_dtype=None):
+    """Per-layer K/V buffers (B, max_seq, n_kv_heads, head_dim),
+    zero-filled; sharded over tp on the KV-head axis when the mesh
+    carries a tp axis."""
+    import jax
+    import jax.numpy as jnp
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    dtype = param_dtype or jnp.float32
+    spec = (P("dp", None, "tp", None)
+            if "tp" in mesh.axis_names else P("dp", None, None, None))
+    shape = (batch, max_seq, config.n_kv_heads, config.head_dim)
+    zeros = jnp.zeros(shape, dtype)
+    return [{"k": jax.device_put(zeros, NamedSharding(mesh, spec)),
+             "v": jax.device_put(zeros, NamedSharding(mesh, spec))}
+            for _ in range(config.n_layers)]
+
+
+def forward_with_cache(params, tokens, cache, start_pos, config,
+                       mesh=None):
+    """Logits for ``tokens`` (B, T) occupying absolute positions
+    ``start_pos .. start_pos+T-1``, attending to everything already in
+    ``cache`` plus themselves. Returns (logits (B, T, vocab),
+    updated cache). T is static; ``start_pos`` may be traced (the same
+    jitted function serves every decode step)."""
+    import jax
+    import jax.numpy as jnp
+    from tpu_operator_libs.examples.llama import _rms_norm, _rope
+
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    if config.attention_impl != "xla":
+        raise ValueError(
+            "forward_with_cache implements the einsum path; decode "
+            "with attention_impl='xla'")
+
+    def constrain(x, spec):
+        if mesh is None:
+            return x
+        return jax.lax.with_sharding_constraint(
+            x, NamedSharding(mesh, spec))
+
+    batch, t_new = tokens.shape
+    hd, nh, nkv = config.head_dim, config.n_heads, config.n_kv_heads
+    group = nh // nkv
+    max_seq = cache[0]["k"].shape[1]
+    positions = start_pos + jnp.arange(t_new)
+
+    h = params["embed"][tokens]
+    h = constrain(h, P("dp", None, None))
+    new_cache = []
+    # key validity: cached positions < start_pos+T, and causality
+    # within the new block
+    kv_pos = jnp.arange(max_seq)
+    mask = (kv_pos[None, :] <= positions[:, None])  # (T, max_seq)
+
+    for layer, entry in zip(params["layers"], cache):
+        a = _rms_norm(h, layer["attn_norm"])
+        q = (a @ layer["wq"]).reshape(batch, t_new, nh, hd)
+        k = (a @ layer["wk"]).reshape(batch, t_new, nkv, hd)
+        v = (a @ layer["wv"]).reshape(batch, t_new, nkv, hd)
+        q = _rope(q, config.rope_theta, positions)
+        k = _rope(k, config.rope_theta, positions)
+        k_cache = jax.lax.dynamic_update_slice(
+            entry["k"], k.astype(entry["k"].dtype), (0, start_pos, 0, 0))
+        v_cache = jax.lax.dynamic_update_slice(
+            entry["v"], v.astype(entry["v"].dtype), (0, start_pos, 0, 0))
+        new_cache.append({"k": k_cache, "v": v_cache})
+
+        # grouped einsum over (kv-head, group) — never materializes a
+        # group-times-repeated copy of the cache, which would dominate
+        # the step's HBM traffic at long context
+        q_g = q.reshape(batch, t_new, nkv, group, hd)
+        scores = jnp.einsum("bqkgd,bskd->bkgqs", q_g, k_cache) \
+            * (hd ** -0.5)
+        scores = jnp.where(mask[None, None, None, :, :],
+                           scores.astype(jnp.float32), -1e30)
+        attn = jax.nn.softmax(scores, axis=-1).astype(h.dtype)
+        ctx = jnp.einsum("bkgqs,bskd->bqkgd", attn, v_cache)
+        h = h + ctx.reshape(batch, t_new, nh * hd) @ layer["wo"]
+        h = constrain(h, P("dp", None, None))
+
+        m = _rms_norm(h, layer["mlp_norm"])
+        gated = jax.nn.silu(m @ layer["w_gate"]) * (m @ layer["w_up"])
+        h = h + gated @ layer["w_down"]
+        h = constrain(h, P("dp", None, None))
+
+    h = _rms_norm(h, params["final_norm"])
+    return constrain(h @ params["lm_head"], P("dp", None, None)), \
+        new_cache
+
+
+@functools.lru_cache(maxsize=32)
+def _jitted_step(config, mesh):
+    """One jitted cache-step per (config, mesh) — generate() must not
+    rebuild jit wrappers per call (a fresh lambda is a fresh jit cache
+    key: every request would recompile). jit itself specializes per
+    token-block shape, so the same function serves prefill and decode."""
+    import jax
+
+    return jax.jit(lambda p, t, c, pos: forward_with_cache(
+        p, t, c, pos, config, mesh))
+
+
+def generate(params, prompt, config, mesh, max_new_tokens: int,
+             param_dtype=None):
+    """Greedy decode: prefill the prompt, then one cached step per
+    token. Returns (B, prompt+max_new_tokens) int32."""
+    import jax.numpy as jnp
+
+    batch, prompt_len = prompt.shape
+    total = prompt_len + max_new_tokens
+    cache = init_kv_cache(mesh, config, batch, total, param_dtype)
+    step = _jitted_step(config, mesh)
+
+    logits, cache = step(params, prompt, cache, 0)
+    tokens = [prompt]
+    last = jnp.argmax(logits[:, -1, :], axis=-1)[:, None].astype(
+        jnp.int32)
+    for i in range(max_new_tokens):
+        tokens.append(last)
+        if i + 1 == max_new_tokens:
+            break
+        logits, cache = step(params, last, cache, prompt_len + i)
+        last = jnp.argmax(logits[:, -1, :], axis=-1)[:, None].astype(
+            jnp.int32)
+    return jnp.concatenate(tokens, axis=1)
