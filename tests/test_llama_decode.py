@@ -99,6 +99,39 @@ class TestGenerate:
         assert (out1[:, 8:] >= 0).all() and \
             (out1[:, 8:] < config.vocab).all()
 
+    def test_sampling_is_seed_deterministic_and_needs_a_key(self):
+        mesh = make_mesh()
+        config = LlamaConfig()
+        params = init_llama_params(mesh, config)
+        prompt = make_token_batch(mesh, 0, config)[:, :4]
+        key = jax.random.PRNGKey(7)
+        s1 = np.array(generate(params, prompt, config, mesh, 4,
+                               temperature=0.8, key=key))
+        s2 = np.array(generate(params, prompt, config, mesh, 4,
+                               temperature=0.8, key=key))
+        np.testing.assert_array_equal(s1, s2)
+        with pytest.raises(ValueError, match="PRNG key"):
+            generate(params, prompt, config, mesh, 2, temperature=0.8)
+
+    def test_top_k_restricts_to_top_logits(self):
+        """Every sampled token must be in the top-k set of the batch
+        forward's logits over the sequence-so-far."""
+        mesh = make_mesh()
+        config = LlamaConfig()
+        params = init_llama_params(mesh, config)
+        prompt = make_token_batch(mesh, 0, config)[:, :4]
+        k = 3
+        out = np.array(generate(params, prompt, config, mesh, 4,
+                                temperature=1.0, top_k=k,
+                                key=jax.random.PRNGKey(1)))
+        for step in range(4):
+            prefix = jnp.asarray(out[:, :4 + step])
+            logits = np.array(forward(params, prefix, config,
+                                      mesh))[:, -1, :]
+            topk = np.argsort(logits, axis=-1)[:, -k:]
+            for b in range(out.shape[0]):
+                assert out[b, 4 + step] in topk[b], (b, step)
+
     def test_generation_matches_teacher_forced_argmax(self):
         """Each generated token must equal the argmax of the batch
         forward over the sequence-so-far: greedy decode with a cache is

@@ -125,26 +125,61 @@ def _jitted_step(config, mesh):
         p, t, c, pos, config, mesh))
 
 
-def generate(params, prompt, config, mesh, max_new_tokens: int,
-             param_dtype=None):
-    """Greedy decode: prefill the prompt, then one cached step per
-    token. Returns (B, prompt+max_new_tokens) int32."""
+def _pick_next(logits_last, temperature: float, top_k, key):
+    """(B, vocab) logits -> (B, 1) int32 next tokens.
+
+    temperature 0 = greedy argmax (no key needed). Otherwise sample
+    from softmax(logits/temperature), optionally truncated to the
+    ``top_k`` highest-logit tokens first."""
+    import jax
     import jax.numpy as jnp
 
+    if temperature <= 0.0:
+        choice = jnp.argmax(logits_last, axis=-1)
+    else:
+        logits_f = logits_last.astype(jnp.float32)
+        if top_k is not None:
+            kth = jnp.sort(logits_f, axis=-1)[:, -top_k][:, None]
+            logits_f = jnp.where(logits_f < kth, -jnp.inf, logits_f)
+        choice = jax.random.categorical(key, logits_f / temperature,
+                                        axis=-1)
+    return choice[:, None].astype(jnp.int32)
+
+
+def generate(params, prompt, config, mesh, max_new_tokens: int,
+             param_dtype=None, temperature: float = 0.0,
+             top_k=None, key=None):
+    """Autoregressive decode: prefill the prompt, then one cached step
+    per token. ``temperature=0`` (default) is greedy; otherwise
+    softmax sampling at the given temperature, optionally top-k
+    truncated, driven by ``key`` (required when sampling — explicit
+    PRNG keys keep generation reproducible). Returns
+    (B, prompt+max_new_tokens) int32."""
+    import jax
+    import jax.numpy as jnp
+
+    if temperature > 0.0 and key is None:
+        raise ValueError("sampling (temperature > 0) requires a PRNG key")
     batch, prompt_len = prompt.shape
     total = prompt_len + max_new_tokens
     cache = init_kv_cache(mesh, config, batch, total, param_dtype)
     step = _jitted_step(config, mesh)
 
+    def next_key():
+        nonlocal key
+        if key is None:
+            return None
+        key, sub = jax.random.split(key)
+        return sub
+
     logits, cache = step(params, prompt, cache, 0)
     tokens = [prompt]
-    last = jnp.argmax(logits[:, -1, :], axis=-1)[:, None].astype(
-        jnp.int32)
+    last = _pick_next(logits[:, -1, :], temperature, top_k, next_key())
     for i in range(max_new_tokens):
         tokens.append(last)
         if i + 1 == max_new_tokens:
             break
         logits, cache = step(params, last, cache, prompt_len + i)
-        last = jnp.argmax(logits[:, -1, :], axis=-1)[:, None].astype(
-            jnp.int32)
+        last = _pick_next(logits[:, -1, :], temperature, top_k,
+                          next_key())
     return jnp.concatenate(tokens, axis=1)
