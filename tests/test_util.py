@@ -237,6 +237,48 @@ class TestCorrelatingEventRecorder:
                         type_="Warning")[0].object_name == "node-1"
 
 
+class TestCorrelatorConservation:
+    """Property-based: for ANY emission sequence and clock pattern,
+    every emission is either spam-dropped or lands in exactly one
+    recorded event's count — nothing lost, nothing double-counted."""
+
+    from hypothesis import given, settings
+    from hypothesis import strategies as st
+
+    @given(
+        events=st.lists(
+            st.tuples(st.integers(min_value=0, max_value=3),   # object
+                      st.integers(min_value=0, max_value=2),   # reason
+                      st.integers(min_value=0, max_value=4),   # message
+                      st.floats(min_value=0.0, max_value=400.0,
+                                allow_nan=False,
+                                allow_infinity=False)),         # gap
+            min_size=1, max_size=120),
+        max_similar=st.integers(min_value=1, max_value=8),
+        spam_burst=st.integers(min_value=1, max_value=30),
+    )
+    @settings(deadline=None, max_examples=50)
+    def test_counts_plus_drops_equal_emissions(self, events,
+                                               max_similar, spam_burst):
+        clock = FakeClock()
+        rec = CorrelatingEventRecorder(
+            capacity=10_000, clock=clock, max_similar=max_similar,
+            similar_interval=120.0, spam_burst=spam_burst,
+            spam_qps=0.05)
+
+        for obj_i, reason_i, msg_i, gap in events:
+            clock.advance(gap)
+
+            class Obj:
+                class metadata:
+                    name = f"node-{obj_i}"
+
+            rec.event(Obj(), "Normal", f"reason-{reason_i}",
+                      f"msg-{msg_i}")
+        assert sum(e.count for e in rec.events) + rec.dropped_total \
+            == len(events)
+
+
 class TestWorker:
     def test_sync_mode_runs_inline(self):
         w = Worker(async_mode=False)
