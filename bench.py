@@ -164,6 +164,15 @@ _BF16_PEAK_TFLOPS = (
     ("v2", 45.0),
 )
 
+# Chip int8 peak TOPS per chip, public figures; used only for the
+# utilization denominator. Unknown kinds report utilization=null.
+_INT8_PEAK_TOPS = (
+    ("v6", 1836.0),  # Trillium
+    ("v5e", 394.0),
+    ("v5 lite", 394.0),
+    ("v5lite", 394.0),
+)
+
 # Chip HBM bandwidth GB/s per chip, public figures; used only for the
 # utilization denominator. Unknown kinds report utilization=null.
 _HBM_PEAK_GBS = (
@@ -249,6 +258,53 @@ try:
         best = dt if best is None else min(best, dt)
     tflops = 2.0 * M * M * M * CHAIN / best / 1e12
 
+    # int8 MXU throughput: same chained-matmul protocol, int8 inputs
+    # with an int32 accumulator (the MXU's int8 path — on v5e its peak
+    # is ~2x the bf16 peak). The & 3 re-quantization keeps the chain
+    # value-bounded and data-dependent so the loop cannot fold; its
+    # elementwise cost fuses into the matmul epilogue. Guarded by a
+    # small exact-equality check against the f32 reference computed on
+    # device — a fast-but-wrong int path must report null, not a TOPS
+    # figure. Isolated try: int8 support failing must not discard the
+    # bf16/ICI measurements.
+    try:
+        xi = jnp.ones((256, 256), jnp.int8) * 2
+        yi = (jnp.arange(256 * 256, dtype=jnp.int32)
+              .reshape(256, 256) % 3).astype(jnp.int8)
+        got = jax.jit(lambda a, b: jax.lax.dot_general(
+            a, b, (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.int32))(xi, yi)
+        want = jax.jit(lambda a, b: (
+            a.astype(jnp.float32) @ b.astype(jnp.float32))
+        )(xi, yi).astype(jnp.int32)
+        if not bool(jnp.all(got == want)):
+            raise ValueError("int8 matmul mismatch vs f32 reference")
+
+        def int8_chain(a, b):
+            def body(i, x):
+                acc = jax.lax.dot_general(
+                    x, b, (((1,), (0,)), ((), ())),
+                    preferred_element_type=jnp.int32)
+                return (acc & 3).astype(jnp.int8)
+
+            out = lax.fori_loop(0, CHAIN, body, a)
+            return jnp.sum(out.astype(jnp.int32))
+
+        yi8 = (jnp.arange(M * M, dtype=jnp.int32)
+               .reshape(M, M) % 3).astype(jnp.int8)
+        ifn = jax.jit(int8_chain)
+        int(ifn(jnp.ones((M, M), jnp.int8), yi8))  # compile + warm
+        int8_best = None
+        for rep in range(3):
+            a = jnp.full((M, M), rep + 1, jnp.int8)
+            t0 = time.perf_counter()
+            int(ifn(a, yi8))  # host readback = completion fence
+            dt = time.perf_counter() - t0
+            int8_best = dt if int8_best is None else min(int8_best, dt)
+        tops_int8 = round(2.0 * M * M * M * CHAIN / int8_best / 1e12, 1)
+    except Exception:
+        tops_int8 = None
+
     # HBM bandwidth: iterated elementwise pass over a large buffer
     # (memory-bound: one read + one write per element per iteration),
     # fenced the same way. The usual TPU bottleneck is HBM, not FLOPs —
@@ -297,6 +353,7 @@ try:
     print(json.dumps({
         "probe_ms": probe_ms, "bandwidth": bandwidth,
         "tflops": round(tflops, 1),
+        "tops_int8": tops_int8,
         "hbm_gbytes_per_s": hbm_gbs,
         "shape_overrides": overridden,
         "device_kind": device_kind,
@@ -343,20 +400,25 @@ try:
                       n_kv_heads=max(1, D // 128), d_ff=4 * D,
                       seq_len=SEQ, learning_rate=1e-4)
     params = init_llama_params(mesh, cfg, param_dtype=jnp.bfloat16)
-    optimizer, step_fn = make_train_step(mesh, cfg)
+    # Donated state: XLA updates params/optimizer in place, so several
+    # steps can sit in the dispatch queue without each holding a fresh
+    # ~1.7 GB param+adam copy. Round 3 could not donate (the tunnel
+    # raised INVALID_ARGUMENT — no longer reproducible, see
+    # docs/benchmarks.md) and measured queued un-donated steps ~10x
+    # slower from allocator thrash; with donation, queueing is the
+    # honest protocol because it amortizes the ~66 ms tunnel round-trip
+    # instead of billing it to every step.
+    optimizer, step_fn = make_train_step(mesh, cfg, donate=True)
     state = {"params": params, "opt": optimizer.init(params),
              "step": jnp.zeros((), jnp.int32)}
+    n_params = sum(x.size for x in jax.tree.leaves(params))
     toks = make_token_batch(mesh, 0, cfg, batch_per_shard=BATCH)
     state, loss = step_fn(state, toks)
     jax.block_until_ready(state)  # compile + warm
-    n_params = sum(x.size for x in jax.tree.leaves(params))
-    # Per-step readback fence, best of 3. This bills each step one
-    # host<->chip tunnel round-trip (~66 ms here), i.e. the reported
-    # MFU is CONSERVATIVE — queuing the three steps behind one fence
-    # was measured 10x slower on this tunnel (each un-donated step
-    # holds a fresh ~1.7 GB param+adam state, and three in flight
-    # thrash the allocator), so the honest simple fence stays.
-    best = None
+    # Conservative cell: per-step readback fence, best of 3 — each step
+    # billed one full tunnel round-trip (round-over-round comparable
+    # with BENCH_r03's train_step_ms).
+    fenced_best = None
     for rep in range(3):
         toks = make_token_batch(mesh, rep + 1, cfg,
                                 batch_per_shard=BATCH)
@@ -364,7 +426,19 @@ try:
         state, loss = step_fn(state, toks)
         fenced = float(loss)  # host readback = completion fence
         dt = time.perf_counter() - t0
-        best = dt if best is None else min(best, dt)
+        fenced_best = dt if fenced_best is None else min(fenced_best, dt)
+    # Primary cell: QUEUE steps behind one fence (the shape of a real
+    # training loop, which fences once per logging interval, not per
+    # step).
+    QUEUE = int(os.environ.get("BENCH_MODEL_QUEUE", "6"))
+    toks_list = [make_token_batch(mesh, 10 + i, cfg,
+                                  batch_per_shard=BATCH)
+                 for i in range(QUEUE)]
+    t0 = time.perf_counter()
+    for toks in toks_list:
+        state, loss = step_fn(state, toks)
+    fenced = float(loss)
+    best = (time.perf_counter() - t0) / QUEUE
     tokens = BATCH * cfg.seq_len
     # fwd+bwd matmul FLOPs = 6 * params * tokens, plus the quadratic
     # attention term (12 * B * heads * S^2 * head_dim per layer)
@@ -383,9 +457,12 @@ try:
         cfg_long = dataclasses.replace(cfg, seq_len=LONG_SEQ,
                                        n_layers=min(cfg.n_layers, 2))
         # forward() iterates params["layers"], so the depth bound must
-        # be applied to the params too, not just the config
-        params_long = dict(params,
-                           layers=params["layers"][:cfg_long.n_layers])
+        # be applied to the params too, not just the config; taken from
+        # the LIVE state — the donated train step consumed the
+        # init-time param buffers
+        params_long = dict(state["params"],
+                           layers=state["params"]
+                           ["layers"][:cfg_long.n_layers])
         toks_long = make_token_batch(mesh, 0, cfg_long,
                                      batch_per_shard=1)
         for impl in ("xla", "flash"):
@@ -400,25 +477,82 @@ try:
 
             fn = jax.jit(loss_fn)
             float(fn(params_long, toks_long))  # compile + warm
-            # 3 dispatches, one fence (same amortization as above —
-            # a per-call fence would bill the fast flash cell a full
-            # tunnel round-trip per iteration and understate it)
+            # N dispatches, one fence (same amortization as above — a
+            # per-call fence would bill the fast flash cell a full
+            # tunnel round-trip per iteration). N scales inversely with
+            # kernel cost: the flash kernel (~60 ms) is the same order
+            # as one tunnel round-trip, so at N=3 a single RTT hiccup
+            # swung the cell 2.5x between captures; N=16 keeps the
+            # fence overhead <7% of the window.
+            iters = 16 if impl == "flash" else 3
             t0 = time.perf_counter()
             acc = 0.0
-            for _ in range(3):
+            for _ in range(iters):
                 acc = acc + fn(params_long, toks_long)
             float(acc)
             long_ms[impl] = round(
-                (time.perf_counter() - t0) / 3 * 1e3, 1)
+                (time.perf_counter() - t0) / iters * 1e3, 1)
+
+    # Decode cell: the serving path. generate_on_device fuses prefill,
+    # every KV-cache decode step and sampling into ONE jitted call
+    # (lax.scan token loop, donated cache) with a single token readback
+    # — the host-driven loop this replaces paid one ~66 ms tunnel
+    # round-trip per token and measured 236 tok/s against a ~8000 tok/s
+    # memory-bound roofline (round-3 VERDICT weak #1).
+    from tpu_operator_libs.examples.llama_decode import (
+        generate_on_device,
+    )
+
+    DEC_BATCH = int(os.environ.get("BENCH_DECODE_BATCH", "8"))
+    DEC_PROMPT = int(os.environ.get("BENCH_DECODE_PROMPT", "64"))
+    DEC_NEW = int(os.environ.get("BENCH_DECODE_NEW", "960"))
+    overridden = overridden or any(os.environ.get(k) for k in (
+        "BENCH_DECODE_BATCH", "BENCH_DECODE_PROMPT", "BENCH_DECODE_NEW"))
+    import dataclasses as _dc
+
+    cfg_dec = _dc.replace(cfg, seq_len=DEC_PROMPT + DEC_NEW)
+    decode_best = None
+    decode_ok = True
+    # Isolated try (like the int8 cell): a decode-only failure — e.g.
+    # OOM on the KV cache — must null decode_tok_s, not discard the
+    # train/long-context numbers measured moments earlier.
+    try:
+        for rep in range(3):
+            key = jax.random.PRNGKey(rep)
+            prompt = jax.random.randint(key, (DEC_BATCH, DEC_PROMPT), 0,
+                                        cfg.vocab, dtype=jnp.int32)
+            t0 = time.perf_counter()
+            # state["params"], not the init-time params: the donated
+            # train step consumed (deleted) every pre-step param buffer
+            out = np.asarray(generate_on_device(
+                state["params"], prompt, cfg_dec, mesh, DEC_NEW,
+                param_dtype=jnp.bfloat16))  # full readback = fence
+            dt = time.perf_counter() - t0
+            if rep == 0:
+                decode_ok = bool(
+                    ((out >= 0) & (out < cfg.vocab)).all()
+                    and out.shape == (DEC_BATCH, DEC_PROMPT + DEC_NEW))
+            decode_best = (dt if decode_best is None
+                           else min(decode_best, dt))
+    except Exception:
+        decode_best = None
 
     print(json.dumps({
         "train_model": f"llama-{round(n_params / 1e6)}M",
         "train_params_m": round(n_params / 1e6, 1),
         "train_step_ms": round(best * 1e3, 1),
+        "train_step_ms_fenced": round(fenced_best * 1e3, 1),
+        "train_queue_depth": QUEUE,
         "train_tflops_bf16": round(flops / best / 1e12, 3),
         "long_context_seq": LONG_SEQ,
         "long_context_xla_ms": long_ms["xla"],
         "long_context_flash_ms": long_ms["flash"],
+        "decode_tok_s": (round(DEC_BATCH * DEC_NEW / decode_best)
+                         if decode_ok and decode_best else None),
+        "decode_batch": DEC_BATCH,
+        "decode_ctx": DEC_PROMPT + DEC_NEW,
+        "decode_new_tokens": DEC_NEW,
+        "decode_sane": decode_ok,
         "loss_finite": math.isfinite(fenced),
         "shape_overrides": overridden,
         "device_kind": device.device_kind,
@@ -432,12 +566,18 @@ _MODEL_NULLS = {
     "train_model": None,
     "train_params_m": None,
     "train_step_ms": None,
+    "train_step_ms_fenced": None,
     "train_tflops_bf16": None,
     "train_mfu_pct": None,
     "long_context_seq": None,
     "long_context_xla_ms": None,
     "long_context_flash_ms": None,
     "flash_attention_speedup": None,
+    "decode_tok_s": None,
+    "decode_batch": None,
+    "decode_ctx": None,
+    "decode_new_tokens": None,
+    "train_queue_depth": None,
 }
 
 
@@ -470,6 +610,8 @@ def _model_capture(hardware: dict) -> dict:
         "train_model": data.get("train_model"),
         "train_params_m": data.get("train_params_m"),
         "train_step_ms": data.get("train_step_ms"),
+        "train_step_ms_fenced": data.get("train_step_ms_fenced"),
+        "train_queue_depth": data.get("train_queue_depth"),
         "train_tflops_bf16": tflops,
         "train_mfu_pct": (round(100.0 * tflops / peak, 1)
                           if tflops and peak else None),
@@ -478,6 +620,10 @@ def _model_capture(hardware: dict) -> dict:
         "long_context_flash_ms": flash_ms,
         "flash_attention_speedup": (round(xla_ms / flash_ms, 2)
                                     if xla_ms and flash_ms else None),
+        "decode_tok_s": data.get("decode_tok_s"),
+        "decode_batch": data.get("decode_batch"),
+        "decode_ctx": data.get("decode_ctx"),
+        "decode_new_tokens": data.get("decode_new_tokens"),
     }
     if data.get("shape_overrides"):
         out["train_shape_overrides"] = True
@@ -531,6 +677,8 @@ def _hardware_capture() -> dict:
         "ici_bandwidth_gbytes_per_s": None,
         "mxu_tflops_bf16": None,
         "mxu_mfu_pct": None,
+        "mxu_tops_int8": None,
+        "mxu_int8_utilization_pct": None,
         "hbm_gbytes_per_s": None,
         "hbm_utilization_pct": None,
         "tpu_device_kind": None,
@@ -588,12 +736,16 @@ def _peak_for(kind: str, table: tuple) -> Optional[float]:
 
 def _hardware_result(data: dict) -> dict:
     tflops = data.get("tflops")
+    tops8 = data.get("tops_int8")
     hbm = data.get("hbm_gbytes_per_s")
     kind = data.get("device_kind") or ""
     peak = _peak_for(kind, _BF16_PEAK_TFLOPS)
+    peak8 = _peak_for(kind, _INT8_PEAK_TOPS)
     hbm_peak = _peak_for(kind, _HBM_PEAK_GBS)
     mfu = (round(100.0 * tflops / peak, 1)
            if tflops is not None and peak else None)
+    mfu8 = (round(100.0 * tops8 / peak8, 1)
+            if tops8 is not None and peak8 else None)
     hbm_util = (round(100.0 * hbm / hbm_peak, 1)
                 if hbm is not None and hbm_peak else None)
     return {
@@ -601,6 +753,8 @@ def _hardware_result(data: dict) -> dict:
         "ici_bandwidth_gbytes_per_s": data.get("bandwidth"),
         "mxu_tflops_bf16": tflops,
         "mxu_mfu_pct": mfu,
+        "mxu_tops_int8": tops8,
+        "mxu_int8_utilization_pct": mfu8,
         "hbm_gbytes_per_s": hbm,
         "hbm_utilization_pct": hbm_util,
         "tpu_device_kind": data.get("device_kind"),
@@ -779,6 +933,18 @@ def _reconcile_latency_cells(passes: int = 9) -> dict:
         for mode in ("flat", "slice"):
             cells[label][mode] = _reconcile_latency_ms(
                 n_slices, hosts, mode, passes)
+    # p50 scaling exponent over the 16x node range (1.0 = linear).
+    # Round 3 measured 1.26 — the superlinear term was CPython's
+    # generational GC rescanning the ever-larger live fleet on every
+    # pass; gc.freeze() after fleet build (below) plus cheaper clones
+    # restored ~linear scaling.
+    for mode in ("flat", "slice"):
+        lo = (cells["256_nodes"].get(mode) or {}).get("p50")
+        hi = (cells["4096_nodes"].get(mode) or {}).get("p50")
+        if lo and hi:
+            import math
+            cells[f"{mode}_p50_scaling_exponent"] = round(
+                math.log(hi / lo) / math.log(16), 2)
     return cells
 
 
@@ -803,50 +969,68 @@ def _reconcile_latency_ms(n_slices: int, hosts: int, topology_mode: str,
         ClusterUpgradeStateManager,
     )
 
+    import gc
+
     cluster, clock, keys = build_fleet(
         FleetSpec(n_slices=n_slices, hosts_per_slice=hosts))
     mgr = ClusterUpgradeStateManager(
         cluster, keys, async_workers=False, poll_interval=0.0)
-    policy = UpgradePolicySpec(
-        auto_upgrade=True, max_parallel_upgrades=0,
-        max_unavailable="25%", topology_mode=topology_mode,
-        drain=DrainSpec(enable=True, force=True))
+    # Freeze the fleet store for the duration of the cell: it exempts
+    # those ~10^6 objects from every generational GC scan the pass's
+    # clone traffic triggers. Without this, GC was 40% of a 4096-node
+    # pass and grew superlinearly with fleet size (more allocations per
+    # pass x larger heap per scan) — the same tuning a long-running
+    # large-heap CPython service applies (OperatorManager exposes it as
+    # gc_freeze_after_sync). Unfrozen in the finally below: the fleet
+    # is cyclic (scheduled-action closures capture the cluster), and a
+    # frozen dead fleet would leak for the rest of the bench process.
+    gc.collect()
+    gc.freeze()
+    try:
+        policy = UpgradePolicySpec(
+            auto_upgrade=True, max_parallel_upgrades=0,
+            max_unavailable="25%", topology_mode=topology_mode,
+            drain=DrainSpec(enable=True, force=True))
 
-    def one_pass() -> Optional[float]:
-        started = _time.perf_counter()
-        try:
-            mgr.apply_state(mgr.build_state(NS, RUNTIME_LABELS), policy)
-        except BuildStateError:
-            # pods mid-recreation; an incomplete snapshot is not a
-            # representative sample
+        def one_pass() -> Optional[float]:
+            started = _time.perf_counter()
+            try:
+                mgr.apply_state(mgr.build_state(NS, RUNTIME_LABELS),
+                                policy)
+            except BuildStateError:
+                # pods mid-recreation; an incomplete snapshot is not a
+                # representative sample
+                return None
+            return (_time.perf_counter() - started) * 1e3
+
+        # advance a few passes so the fleet spreads across states
+        for _ in range(4):
+            one_pass()
+            clock.advance(10.0)
+            cluster.step()
+        samples = []
+        # Bounded attempts: if the simulated fleet wedges where every
+        # snapshot is incomplete, return what we have (or None) rather
+        # than hanging the bench — the same failure mode the probe
+        # subprocess timeout guards against.
+        for _ in range(5 * passes):
+            if len(samples) >= passes:
+                break
+            sample = one_pass()
+            if sample is not None:
+                samples.append(sample)
+            clock.advance(10.0)
+            cluster.step()
+        if len(samples) < passes:
+            # a partial sample set must not masquerade as a healthy p50
             return None
-        return (_time.perf_counter() - started) * 1e3
-
-    # advance a few passes so the fleet spreads across states
-    for _ in range(4):
-        one_pass()
-        clock.advance(10.0)
-        cluster.step()
-    samples = []
-    # Bounded attempts: if the simulated fleet wedges where every
-    # snapshot is incomplete, return what we have (or None) rather than
-    # hanging the bench — the same failure mode the probe subprocess
-    # timeout guards against.
-    for _ in range(5 * passes):
-        if len(samples) >= passes:
-            break
-        sample = one_pass()
-        if sample is not None:
-            samples.append(sample)
-        clock.advance(10.0)
-        cluster.step()
-    if len(samples) < passes:
-        # a partial sample set must not masquerade as a healthy p50
-        return None
-    ordered = sorted(samples)
-    p95_index = max(0, -(-len(ordered) * 95 // 100) - 1)
-    return {"p50": round(statistics.median(samples), 2),
-            "p95": round(ordered[p95_index], 2)}
+        ordered = sorted(samples)
+        p95_index = max(0, -(-len(ordered) * 95 // 100) - 1)
+        return {"p50": round(statistics.median(samples), 2),
+                "p95": round(ordered[p95_index], 2)}
+    finally:
+        gc.unfreeze()
+        gc.collect()
 
 
 if __name__ == "__main__":

@@ -37,6 +37,15 @@ class TestSelectors:
         ("a=1,b=2", {"a": "1", "b": "2"}, True),
         ("a=1,b=2", {"a": "1"}, False),
         ("", {"anything": "x"}, True),
+        # contradictory conjunction: ANDed requirements, so it matches
+        # nothing — must not collapse to last-value-wins
+        ("env=prod,env=canary", {"env": "canary"}, False),
+        ("env=prod,env=canary", {"env": "prod"}, False),
+        ("env=prod,env=prod", {"env": "prod"}, True),
+        # mixed equality + other requirement shapes
+        ("env=prod,tier", {"env": "prod", "tier": "web"}, True),
+        ("env=prod,tier", {"env": "prod"}, False),
+        ("env=prod,env!=canary", {"env": "prod"}, True),
     ])
     def test_label_selectors(self, selector, labels, expected):
         assert matches_labels(selector, labels) is expected

@@ -17,6 +17,7 @@ from tpu_operator_libs.examples.llama import (
 from tpu_operator_libs.examples.llama_decode import (
     forward_with_cache,
     generate,
+    generate_on_device,
     init_kv_cache,
 )
 
@@ -147,3 +148,56 @@ class TestGenerate:
             logits = forward(params, prefix, config, mesh)
             expect = np.array(jnp.argmax(logits[:, -1, :], axis=-1))
             np.testing.assert_array_equal(out[:, 4 + step], expect)
+
+
+class TestDeviceResidentDecode:
+    """generate_on_device: the fused single-dispatch serving path (one
+    jitted prefill+scan+sampling call, KV cache donated) must be
+    behaviorally identical to the host-driven loop."""
+
+    def test_greedy_matches_host_loop_exactly(self):
+        mesh = make_mesh()
+        config = LlamaConfig()
+        params = init_llama_params(mesh, config)
+        prompt = make_token_batch(mesh, 0, config)[:, :4]
+        host = np.array(generate(params, prompt, config, mesh, 6))
+        dev = np.array(generate_on_device(params, prompt, config,
+                                          mesh, 6))
+        np.testing.assert_array_equal(host, dev)
+
+    def test_single_new_token(self):
+        """max_new_tokens=1 is the scan-length-0 edge: prefill + one
+        pick, no loop iterations."""
+        mesh = make_mesh()
+        config = LlamaConfig()
+        params = init_llama_params(mesh, config)
+        prompt = make_token_batch(mesh, 0, config)[:, :4]
+        host = np.array(generate(params, prompt, config, mesh, 1))
+        dev = np.array(generate_on_device(params, prompt, config,
+                                          mesh, 1))
+        np.testing.assert_array_equal(host, dev)
+
+    def test_sampling_is_seed_deterministic_and_in_vocab(self):
+        mesh = make_mesh()
+        config = LlamaConfig()
+        params = init_llama_params(mesh, config)
+        prompt = make_token_batch(mesh, 0, config)[:, :4]
+        a = np.array(generate_on_device(
+            params, prompt, config, mesh, 5, temperature=0.9, top_k=4,
+            key=jax.random.PRNGKey(7)))
+        b = np.array(generate_on_device(
+            params, prompt, config, mesh, 5, temperature=0.9, top_k=4,
+            key=jax.random.PRNGKey(7)))
+        np.testing.assert_array_equal(a, b)
+        assert ((a >= 0) & (a < config.vocab)).all()
+        with pytest.raises(ValueError):
+            generate_on_device(params, prompt, config, mesh, 5,
+                               temperature=0.9)
+
+    def test_rejects_zero_new_tokens(self):
+        mesh = make_mesh()
+        config = LlamaConfig()
+        params = init_llama_params(mesh, config)
+        prompt = make_token_batch(mesh, 0, config)[:, :4]
+        with pytest.raises(ValueError):
+            generate_on_device(params, prompt, config, mesh, 0)

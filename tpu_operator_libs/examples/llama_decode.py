@@ -17,8 +17,6 @@ masked cache width).
 
 from __future__ import annotations
 
-import functools
-
 
 def init_kv_cache(mesh, config, batch: int, max_seq: int,
                   param_dtype=None):
@@ -113,16 +111,31 @@ def forward_with_cache(params, tokens, cache, start_pos, config,
         new_cache
 
 
-@functools.lru_cache(maxsize=32)
+_STEP_JIT = None
+
+
 def _jitted_step(config, mesh):
-    """One jitted cache-step per (config, mesh) — generate() must not
-    rebuild jit wrappers per call (a fresh lambda is a fresh jit cache
-    key: every request would recompile). jit itself specializes per
-    token-block shape, so the same function serves prefill and decode."""
+    """The jitted cache-step, shared across every (config, mesh).
+
+    One module-level ``jax.jit`` with config/mesh as *static* arguments:
+    jit's own cache keys on their equality, so a caller constructing a
+    fresh-but-identical Mesh per request hits the compiled executable
+    instead of recompiling (and nothing here pins Mesh or executable
+    references beyond jax's standard cache, which ``jax.clear_caches()``
+    empties — the leak a per-module ``lru_cache`` keyed on mesh identity
+    would have made permanent). jit itself specializes per token-block
+    shape, so the same function serves prefill and decode."""
     import jax
 
-    return jax.jit(lambda p, t, c, pos: forward_with_cache(
-        p, t, c, pos, config, mesh))
+    global _STEP_JIT
+    if _STEP_JIT is None:
+        def step(params, tokens, cache, pos, config, mesh):
+            return forward_with_cache(params, tokens, cache, pos,
+                                      config, mesh)
+
+        _STEP_JIT = jax.jit(step, static_argnums=(4, 5))
+
+    return lambda p, t, c, pos: _STEP_JIT(p, t, c, pos, config, mesh)
 
 
 def _pick_next(logits_last, temperature: float, top_k, key):
@@ -183,3 +196,94 @@ def generate(params, prompt, config, mesh, max_new_tokens: int,
         last = _pick_next(logits[:, -1, :], temperature, top_k,
                           next_key())
     return jnp.concatenate(tokens, axis=1)
+
+
+_DEVICE_DECODE_JIT = None
+
+
+def _jitted_device_decode():
+    """The fused prefill+decode executable (one per (shapes, config,
+    mesh, sampling) combination, cached by jax.jit's static-argument
+    cache — same non-pinning rationale as :func:`_jitted_step`)."""
+    import jax
+    import jax.numpy as jnp
+    from jax import lax
+
+    global _DEVICE_DECODE_JIT
+    if _DEVICE_DECODE_JIT is None:
+        def decode(params, prompt, cache, key, max_new_tokens,
+                   temperature, top_k, config, mesh):
+            prompt_len = prompt.shape[1]
+            greedy = temperature <= 0.0
+            if key is None:
+                # keep the carry structure static; greedy never uses it
+                key = jax.random.PRNGKey(0)
+
+            def pick(logits_last, sub):
+                return _pick_next(logits_last, temperature, top_k, sub)
+
+            def split(k):
+                if greedy:
+                    return k, None
+                return tuple(jax.random.split(k))
+
+            logits, cache = forward_with_cache(
+                params, prompt, cache, 0, config, mesh)
+            key, sub = split(key)
+            first = pick(logits[:, -1, :], sub)
+
+            def body(carry, i):
+                cache, last, key = carry
+                logits, cache = forward_with_cache(
+                    params, last, cache, prompt_len + i, config, mesh)
+                key, sub = split(key)
+                nxt = pick(logits[:, -1, :], sub)
+                return (cache, nxt, key), nxt[:, 0]
+
+            (_, _, _), rest = lax.scan(
+                body, (cache, first, key),
+                jnp.arange(max_new_tokens - 1, dtype=jnp.int32))
+            # rest: (max_new_tokens-1, B) -> (B, max_new_tokens-1)
+            return jnp.concatenate(
+                [prompt, first, jnp.transpose(rest, (1, 0))], axis=1)
+
+        _DEVICE_DECODE_JIT = jax.jit(
+            decode, static_argnums=(4, 5, 6, 7, 8), donate_argnums=(2,))
+    return _DEVICE_DECODE_JIT
+
+
+def generate_on_device(params, prompt, config, mesh,
+                       max_new_tokens: int, param_dtype=None,
+                       temperature: float = 0.0, top_k=None, key=None):
+    """:func:`generate`, but the token loop runs ON the device.
+
+    The host-driven loop costs one dispatch (and on a tunneled backend,
+    one ~66 ms round-trip) per token; here prefill, every decode step,
+    and sampling are fused into ONE jitted call whose inner loop is a
+    ``lax.scan``, and the tokens come back in a single readback — the
+    difference between ~240 and several thousand tok/s on a v5e behind
+    a tunnel. The KV cache is donated into the call (it is dead
+    afterwards) and the scan carry aliases it in place thereafter.
+
+    Same contract as :func:`generate` (tested equal on the greedy
+    path): returns (B, prompt+max_new_tokens) int32.
+    """
+    import warnings
+
+    if temperature > 0.0 and key is None:
+        raise ValueError("sampling (temperature > 0) requires a PRNG key")
+    if max_new_tokens < 1:
+        raise ValueError("max_new_tokens must be >= 1")
+    batch, prompt_len = prompt.shape
+    cache = init_kv_cache(mesh, config, batch,
+                          prompt_len + max_new_tokens, param_dtype)
+    with warnings.catch_warnings():
+        # The donated cache cannot alias the (tiny, int32) token output
+        # — donation here is for the entry copy + in-loop aliasing, so
+        # XLA's "donated buffers were not usable [as outputs]" note is
+        # expected, not a bug signal.
+        warnings.filterwarnings(
+            "ignore", message="Some donated buffers were not usable")
+        return _jitted_device_decode()(
+            params, prompt, cache, key if temperature > 0.0 else None,
+            max_new_tokens, float(temperature), top_k, config, mesh)

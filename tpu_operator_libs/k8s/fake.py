@@ -26,6 +26,7 @@ the engine behind ``bench.py`` and the e2e tests (BASELINE configs #2-#4).
 
 from __future__ import annotations
 
+import heapq
 import threading
 from dataclasses import dataclass, field
 from typing import Callable, Mapping, Optional
@@ -404,17 +405,18 @@ class FakeCluster(K8sClient):
 
     def step(self, until: Optional[float] = None) -> int:
         """Run scheduled simulation actions due at or before ``until``
-        (defaults to the clock's current time). Returns actions run."""
+        (defaults to the clock's current time), in (due, insertion)
+        order. Returns actions run. The queue is a heap: a fleet-wide
+        drain wave schedules thousands of recreation/ready actions, and
+        the previous scan-filter-sort-remove loop made draining the
+        queue O(n^2 log n) in wave size."""
         now = self._clock.now() if until is None else until
         ran = 0
         while True:
             with self._lock:
-                due = [a for a in self._scheduled if a.due <= now]
-                if not due:
+                if not self._scheduled or self._scheduled[0].due > now:
                     return ran
-                due.sort()
-                action = due[0]
-                self._scheduled.remove(action)
+                action = heapq.heappop(self._scheduled)
             action.action()
             ran += 1
 
@@ -426,7 +428,7 @@ class FakeCluster(K8sClient):
         with self._lock:
             if not self._scheduled:
                 return None
-            return min(a.due for a in self._scheduled)
+            return self._scheduled[0].due
 
     def _schedule(self, delay: float, action: Callable[[], None]) -> float:
         return self.schedule_at(self._clock.now() + delay, action)
@@ -437,7 +439,8 @@ class FakeCluster(K8sClient):
         injection (tpu_operator_libs.simulate) and available to tests."""
         with self._lock:
             self._seq += 1
-            self._scheduled.append(_ScheduledAction(due, self._seq, action))
+            heapq.heappush(self._scheduled,
+                           _ScheduledAction(due, self._seq, action))
             return due
 
     # ------------------------------------------------------------------
@@ -530,6 +533,7 @@ class FakeCluster(K8sClient):
                   field_selector: str = "") -> list[Pod]:
         self._maybe_api_error("list_pods")
         label_match = parse_label_selector(label_selector)
+        has_fields = bool((field_selector or "").strip())
         field_match = parse_field_selector(field_selector)
         node = exact_field_requirement(field_selector, "spec.nodeName")
         with self._lock:
@@ -549,7 +553,9 @@ class FakeCluster(K8sClient):
                     continue
                 if not label_match(pod.metadata.labels):
                     continue
-                if not field_match(pod.field_map()):
+                # field_map() allocates a fresh dict per pod; only pay
+                # for it when a field selector is actually present
+                if has_fields and not field_match(pod.field_map()):
                     continue
                 out.append(pod.clone())
             return out

@@ -67,6 +67,8 @@ def parse_label_selector(selector: str) -> Matcher:
         return lambda labels: True
 
     requirements: list[Matcher] = []
+    equalities: dict[str, str] = {}  # k=v requirements, the common case
+    unsatisfiable = False  # k=a,k=b with a != b: matches nothing
     for req in _split_requirements(selector):
         m = _SET_RE.match(req)
         if m:
@@ -84,8 +86,12 @@ def parse_label_selector(selector: str) -> Matcher:
         if m:
             key, op, val = m.group("key"), m.group("op"), m.group("val")
             if op in ("=", "=="):
-                requirements.append(
-                    lambda labels, k=key, v=val: labels.get(k) == v)
+                if key in equalities and equalities[key] != val:
+                    # contradictory conjunction — the dict must not
+                    # collapse it to last-value-wins (the apiserver
+                    # ANDs the requirements and matches nothing)
+                    unsatisfiable = True
+                equalities[key] = val
             else:
                 requirements.append(
                     lambda labels, k=key, v=val: labels.get(k) != v)
@@ -100,6 +106,35 @@ def parse_label_selector(selector: str) -> Matcher:
             continue
         raise SelectorParseError(f"cannot parse selector requirement {req!r}")
 
+    # Matchers run once per object per LIST — at fleet scale (4096 nodes,
+    # ~10k pods) per-call overhead is reconcile latency, so the common
+    # shapes get closures without the all()-over-genexpr indirection.
+    if unsatisfiable:
+        # parsed fully (malformed requirements above still raise), but
+        # the conjunction can never hold
+        return lambda labels: False
+    if equalities:
+        items = tuple(equalities.items())
+        if not requirements:
+            if len(items) == 1:
+                (k0, v0), = items
+                return lambda labels: labels.get(k0) == v0
+
+            def eq_only(labels, _items=items):
+                for k, v in _items:
+                    if labels.get(k) != v:
+                        return False
+                return True
+            return eq_only
+
+        def eq_requirement(labels, _items=items):
+            for k, v in _items:
+                if labels.get(k) != v:
+                    return False
+            return True
+        requirements.append(eq_requirement)
+    if len(requirements) == 1:
+        return requirements[0]
     return lambda labels: all(r(labels) for r in requirements)
 
 

@@ -64,6 +64,12 @@ class OperatorManager:
         Optional :class:`~tpu_operator_libs.k8s.leaderelection.
         LeaderElectionConfig`; when set, :meth:`run` contends for the
         Lease and gates the whole runtime on holding it.
+    gc_freeze_after_sync:
+        Freeze the CPython heap once the informer caches have synced
+        (``gc.freeze()``), exempting the long-lived cache from every
+        later generational GC scan. Recommended for fleets of
+        thousands of nodes; off by default because frozen objects are
+        never collected.
     """
 
     def __init__(self, client: K8sClient, namespace: str,
@@ -78,6 +84,7 @@ class OperatorManager:
                  leader_election_clock: Optional["Clock"] = None,
                  metrics: Optional["MetricsRegistry"] = None,
                  rate_limiter: Optional[ExponentialBackoffRateLimiter] = None,
+                 gc_freeze_after_sync: bool = False,
                  ) -> None:
         self._raw_client = client
         self._namespace = namespace
@@ -91,6 +98,7 @@ class OperatorManager:
         self._leader_election_clock = leader_election_clock
         self._metrics = metrics
         self._rate_limiter = rate_limiter
+        self._gc_freeze_after_sync = gc_freeze_after_sync
 
         self._cached = None
         self._controller: Optional[Controller] = None
@@ -163,6 +171,20 @@ class OperatorManager:
                     raise TimeoutError(
                         f"informer caches failed to sync within "
                         f"{self._cache_sync_timeout}s")
+            if self._gc_freeze_after_sync:
+                # Large-fleet tuning: the freshly-synced informer cache
+                # is effectively process-permanent, yet CPython's
+                # generational GC rescans it on every collection the
+                # reconcile loop's allocation traffic triggers — at 4096
+                # nodes that was 40% of pass latency and scaled
+                # superlinearly. freeze() moves the current heap to the
+                # permanent generation (the standard large-heap CPython
+                # mitigation); the cost is that objects alive right now
+                # are never collected, bounded by one fleet snapshot.
+                import gc
+
+                gc.collect()
+                gc.freeze()
             controller = Controller(
                 self._reconcile, name=self._name,
                 rate_limiter=self._rate_limiter,

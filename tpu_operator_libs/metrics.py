@@ -19,7 +19,6 @@ from dataclasses import dataclass, field
 from typing import TYPE_CHECKING, Optional
 
 from tpu_operator_libs.consts import ALL_STATES
-from tpu_operator_libs.topology.slice_topology import SliceTopology
 
 if TYPE_CHECKING:  # pragma: no cover - types only (import cycle guard)
     from tpu_operator_libs.upgrade.state_manager import (
@@ -218,11 +217,10 @@ def observe_cluster_state(registry: MetricsRegistry,
             "Node count per upgrade state",
             {**labels, "state": str(s) or "unknown"})
 
-    nodes = [ns.node for bucket in state.node_states.values()
-             for ns in bucket]
-    if nodes:
-        topo = SliceTopology.from_nodes(nodes)
-        registry.set_gauge("slice_availability_ratio", topo.availability(),
+    if state.all_nodes():
+        # shares the snapshot's cached topology with planner/status
+        registry.set_gauge("slice_availability_ratio",
+                           state.topology().availability(),
                            "Fraction of ICI slices fully available", labels)
     registry.set_gauge(
         "multislice_deferred_slices",

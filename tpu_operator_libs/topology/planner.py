@@ -30,10 +30,7 @@ from typing import TYPE_CHECKING, Optional
 
 from tpu_operator_libs.consts import IN_PROGRESS_STATES
 from tpu_operator_libs.topology.multislice import MultisliceConstraint
-from tpu_operator_libs.topology.slice_topology import (
-    SliceTopology,
-    slice_id_for_node,
-)
+from tpu_operator_libs.topology.slice_topology import slice_id_for_node
 
 if TYPE_CHECKING:  # pragma: no cover
     from tpu_operator_libs.upgrade.state_manager import (
@@ -66,12 +63,12 @@ class SlicePlanner:
         if not candidates:
             return []
 
-        # Build the topology over every known node, not just candidates, so
+        # The topology covers every known node, not just candidates, so
         # hosts of the same slice that are mid-upgrade count toward
-        # "slice already down".
-        all_nodes = [ns.node for bucket in state.node_states.values()
-                     for ns in bucket]
-        topology = SliceTopology.from_nodes(all_nodes)
+        # "slice already down"; it comes from the snapshot's per-pass
+        # cache, shared with cluster_status/metrics.
+        all_nodes = state.all_nodes()
+        topology = state.topology()
         down_slices = {sid for sid, info in topology.slices.items()
                        if not info.is_available}
         # For the multislice constraint, "down" must also cover slices
