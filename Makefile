@@ -37,18 +37,18 @@ lint:
 	@if $(PYTHON) -c "import mypy" 2>/dev/null; then \
 		$(MAKE) typecheck; \
 	else \
-		echo "mypy unavailable in this environment -- type checking" \
-		     "SKIPPED here; the CI typecheck job enforces it"; \
+		$(PYTHON) tools/typecheck_report.py; \
 	fi
 
-# Static types on the library package. The profile lives ONLY in
-# pyproject.toml's [tool.mypy] (strict with targeted relaxations) —
-# passing --strict here would re-enable the relaxed flags, because mypy
-# gives CLI flags precedence over config. Fails when mypy is missing —
-# lint's conditional wraps it for environments without mypy.
+# Static types on the library package, via tools/typecheck_report.py:
+# verifies the CI mypy pin / Makefile / pyproject profile are mutually
+# consistent, and EXECUTES `python -m mypy tpu_operator_libs` wherever
+# mypy is importable (the profile lives ONLY in pyproject's [tool.mypy]
+# — strict with targeted relaxations; a CLI --strict would override
+# them). One entry point for CI and local, one mypy execution.
 .PHONY: typecheck
 typecheck:
-	$(PYTHON) -m mypy tpu_operator_libs
+	$(PYTHON) tools/typecheck_report.py
 
 # Line coverage with a hard gate (reference: Coveralls upload,
 # ci.yaml:45-64). Built on sys.monitoring — no external deps.

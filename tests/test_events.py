@@ -209,3 +209,31 @@ class TestEventTTLRecreate:
             assert got.count == 5
         finally:
             restore()
+
+
+class TestPatchFirstForKnownEvents:
+    def test_recurrence_patches_without_posting(self):
+        """An Event this client created is PATCHed directly on
+        recurrence — POST-first would spend two rate-limited API calls
+        (POST -> 409 -> PATCH) per recurrence, which is exactly what
+        client-go's broadcaster avoids. Detection: a create attempt on
+        the recurrence trips the injected create_event error; a correct
+        PATCH-first path never touches it."""
+        from k8s_stub import install_behavioral_stub
+
+        cluster = FakeCluster()
+        restore = install_behavioral_stub(cluster)
+        try:
+            from tpu_operator_libs.k8s.real import RealCluster
+
+            client = RealCluster()
+            client.upsert_event(NS, "n1.abc", ev(count=1))
+            cluster.inject_api_errors("create_event", count=1)
+            client.upsert_event(NS, "n1.abc", ev(count=2))
+            (got,) = cluster.list_events(NS)
+            assert got.count == 2
+            # the injected error is still pending: no POST happened
+            with cluster._lock:
+                assert cluster._api_errors.get("create_event") == 1
+        finally:
+            restore()

@@ -1,0 +1,270 @@
+"""Serving-aware eviction gate: unit semantics + the e2e guarantee.
+
+Round-3 VERDICT task 5: training pods get the checkpoint gate, serving
+pods got nothing — eviction mid-generation dropped requests. The
+ServingDrainGate parks new requests, finishes in-flight generations,
+then admits eviction. The capstone here runs a full rolling libtpu
+upgrade over a fleet whose slices serve real llama_decode generations
+and asserts ZERO dropped generations with the gate — and, as the
+negative control, that the same fleet WITHOUT the gate does drop
+in-flight generations (otherwise the zero proves nothing).
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+from jax.sharding import Mesh
+
+from tpu_operator_libs.api.upgrade_policy import (
+    DrainSpec,
+    UpgradePolicySpec,
+)
+from tpu_operator_libs.consts import UpgradeState
+from tpu_operator_libs.health.serving_gate import (
+    ServingDrainGate,
+    ServingEndpoint,
+)
+from tpu_operator_libs.k8s.objects import (
+    ContainerStatus,
+    ObjectMeta,
+    Pod,
+    PodPhase,
+    PodSpec,
+    PodStatus,
+)
+from tpu_operator_libs.simulate import (
+    NS,
+    RUNTIME_LABELS,
+    WORKLOAD_NS,
+    FleetSpec,
+    build_fleet,
+)
+from tpu_operator_libs.upgrade.state_manager import (
+    BuildStateError,
+    ClusterUpgradeStateManager,
+)
+
+
+class TestServingEndpoint:
+    def test_admission_and_completion(self):
+        ep = ServingEndpoint("ep")
+        assert ep.try_begin()
+        assert ep.in_flight == 1
+        ep.finish()
+        assert ep.completed == 1
+        assert ep.quiesced
+
+    def test_drain_parks_new_requests_but_not_in_flight(self):
+        ep = ServingEndpoint("ep")
+        assert ep.try_begin()
+        ep.begin_drain()
+        assert not ep.try_begin()  # parked, not dropped
+        assert ep.in_flight == 1  # untouched
+        ep.finish()
+        assert ep.quiesced
+        assert ep.dropped == 0
+        ep.resume()
+        assert ep.try_begin()
+
+    def test_kill_drops_in_flight(self):
+        ep = ServingEndpoint("ep")
+        ep.try_begin()
+        ep.try_begin()
+        assert ep.kill() == 2
+        assert ep.dropped == 2
+        assert not ep.try_begin()  # dead pods admit nothing
+
+    def test_finish_without_begin_raises(self):
+        with pytest.raises(RuntimeError):
+            ServingEndpoint("ep").finish()
+
+
+class TestServingDrainGate:
+    def test_gate_drains_then_opens(self):
+        ep = ServingEndpoint("ep")
+        ep.try_begin()
+        gate = ServingDrainGate(lambda node, pods: [ep])
+        node = _node_stub()
+        assert gate(node, []) is False  # in flight -> closed
+        assert ep.draining  # evaluation initiated the drain
+        assert not ep.try_begin()
+        ep.finish()
+        assert gate(node, []) is True
+
+    def test_release_resumes_admission(self):
+        ep = ServingEndpoint("ep")
+        gate = ServingDrainGate(lambda node, pods: [ep])
+        node = _node_stub()
+        assert gate(node, []) is True  # idle -> drains and opens
+        assert not ep.try_begin()
+        gate.release(node, [])
+        assert ep.try_begin()
+
+
+def _node_stub():
+    from tpu_operator_libs.k8s.objects import Node
+
+    return Node(metadata=ObjectMeta(name="n"))
+
+
+class ServingFleet:
+    """Test double for a decode service over the simulated fleet.
+
+    One endpoint per slice (pod on host 0 of the slice, WORKLOAD_NS).
+    Requests arrive on a fixed virtual cadence; each generation holds
+    its endpoint for ``generation_s`` virtual seconds, and on completion
+    runs a REAL llama_decode.generate_on_device call (tiny config) so
+    the served artifact is actual decoded tokens, not a counter.
+    """
+
+    def __init__(self, cluster, n_slices, generation_s=12.0):
+        self.cluster = cluster
+        self.generation_s = generation_s
+        self.endpoints = {}  # slice index -> current ServingEndpoint
+        self.retired = []  # replaced endpoints (keep drop accounting)
+        self.parked = 0
+        self.tokens_served = 0
+        for s in range(n_slices):
+            self._create(s)
+        from tpu_operator_libs.examples.llama import (
+            LlamaConfig,
+            init_llama_params,
+        )
+
+        self._config = LlamaConfig()
+        devices = jax.devices()[:1]
+        self._mesh = Mesh(np.array(devices).reshape(1, 1), ("dp", "tp"))
+        self._params = init_llama_params(self._mesh, self._config)
+
+    def pod_name(self, s):
+        return f"decode-s{s}"
+
+    def _create(self, s):
+        self.cluster.add_pod(Pod(
+            metadata=ObjectMeta(name=self.pod_name(s),
+                                namespace=WORKLOAD_NS,
+                                labels={"app": "decode"}),
+            spec=PodSpec(node_name=f"s{s}-h0"),
+            status=PodStatus(
+                phase=PodPhase.RUNNING,
+                container_statuses=[
+                    ContainerStatus(name="decode", ready=True)])))
+        self.endpoints[s] = ServingEndpoint(self.pod_name(s))
+
+    def resolver(self, node, pods):
+        """Endpoints backed by any pod in the eviction set."""
+        names = {p.metadata.name for p in pods}
+        return [ep for ep in self.endpoints.values()
+                if ep.name in names]
+
+    def submit(self, s):
+        """One request aimed at slice ``s``; parked when draining."""
+        ep = self.endpoints[s]
+        if not ep.try_begin():
+            self.parked += 1
+            return
+        done_at = self.cluster.clock.now() + self.generation_s
+
+        def complete(ep=ep):
+            if ep.dropped or ep is not self.endpoints.get(
+                    _slice_of(ep.name), ep):
+                return  # pod died mid-generation; kill() accounted it
+            if ep.in_flight:
+                out = self._generate()
+                self.tokens_served += int(out.shape[1])
+                ep.finish()
+
+        self.cluster.schedule_at(done_at, complete)
+
+    def _generate(self):
+        from tpu_operator_libs.examples.llama_decode import (
+            generate_on_device,
+        )
+
+        prompt = jnp.ones((1, 2), jnp.int32)
+        return generate_on_device(self._params, prompt, self._config,
+                                  self._mesh, 2)
+
+    def sync_with_cluster(self):
+        """Detect evicted/killed pods and reschedule replicas on
+        recovered slices (the serving controller's job)."""
+        alive = {p.metadata.name
+                 for p in self.cluster.list_pods(namespace=WORKLOAD_NS)}
+        nodes = {n.metadata.name: n for n in self.cluster.list_nodes()}
+        for s, ep in list(self.endpoints.items()):
+            if ep.name not in alive:
+                ep.kill()
+                host = nodes.get(f"s{s}-h0")
+                if (host is not None and not host.is_unschedulable()
+                        and host.is_ready()):
+                    self.retired.append(ep)
+                    self._create(s)
+
+    @property
+    def dropped(self):
+        return (sum(ep.dropped for ep in self.endpoints.values())
+                + sum(ep.dropped for ep in self.retired))
+
+    @property
+    def completed(self):
+        return (sum(ep.completed for ep in self.endpoints.values())
+                + sum(ep.completed for ep in self.retired))
+
+
+def _slice_of(pod_name):
+    return int(pod_name.rsplit("s", 1)[1])
+
+
+def _run_serving_upgrade(with_gate):
+    fleet = FleetSpec(n_slices=2, hosts_per_slice=2)
+    cluster, clock, keys = build_fleet(fleet)
+    serving = ServingFleet(cluster, fleet.n_slices)
+    mgr = ClusterUpgradeStateManager(
+        cluster, keys, async_workers=False, poll_interval=0.0)
+    if with_gate:
+        mgr.with_eviction_gate(ServingDrainGate(serving.resolver))
+    policy = UpgradePolicySpec(
+        auto_upgrade=True, max_parallel_upgrades=0,
+        max_unavailable="50%", topology_mode="slice",
+        drain=DrainSpec(enable=True, force=True, timeout_seconds=300))
+
+    for tick in range(200):
+        # a request lands on every slice each tick, so evictions always
+        # race in-flight generations unless the gate serializes them
+        for s in serving.endpoints:
+            serving.submit(s)
+        try:
+            state = mgr.reconcile(NS, RUNTIME_LABELS, policy)
+        except BuildStateError:
+            state = None
+        serving.sync_with_cluster()
+        if state is not None:
+            buckets = state.node_states
+            done = len(state.bucket(UpgradeState.DONE))
+            total = sum(len(b) for b in buckets.values())
+            if total and done == total:
+                break
+        clock.advance(5.0)
+        cluster.step()
+        serving.sync_with_cluster()
+    else:
+        raise AssertionError("serving-fleet upgrade did not converge")
+    return serving
+
+
+class TestServingUpgradeEndToEnd:
+    def test_rolling_upgrade_drops_zero_generations_with_gate(self):
+        serving = _run_serving_upgrade(with_gate=True)
+        assert serving.dropped == 0
+        assert serving.completed > 0
+        assert serving.tokens_served == serving.completed * 4
+        # the gate parked requests during drains — admission control
+        # actually engaged (otherwise the run never exercised the gate)
+        assert serving.parked > 0
+
+    def test_without_gate_generations_are_dropped(self):
+        """Negative control: the zero above is meaningful only if the
+        ungated fleet demonstrably loses in-flight generations."""
+        serving = _run_serving_upgrade(with_gate=False)
+        assert serving.dropped > 0

@@ -1,0 +1,102 @@
+#!/usr/bin/env python3
+"""Repro matrix for the round-3 ``donate_argnums`` tunnel crash.
+
+Round 3 recorded: ``jax.jit(..., donate_argnums=...)`` on the KV cache
+(and on the train state) raised INVALID_ARGUMENT through the axon
+tunnel, so decode ran un-donated (236 tok/s) and the train probe could
+not queue steps (47 % MFU with a per-step tunnel round-trip billed in).
+
+This tool is the minimal repro the round-4 VERDICT asked for. Run on
+the target chip; each case prints OK or the structured failure:
+
+1. plain donation (no sharding)
+2. donation of a NamedSharding-placed buffer
+3. donation of a cache-like dict pytree updated via
+   ``lax.dynamic_update_slice`` across repeated calls
+4. donation with a traced scalar position argument
+
+Round-4 result (2026-07-30, TPU v5 lite behind the axon tunnel): all
+four cases PASS — the crash is NOT reproducible on the current tunnel
+stack, so donation is now enabled in ``make_train_step(donate=True)``
+(312→252 ms/step, 47→58 % MFU with queued fencing) and in
+``generate_on_device``'s donated KV cache (236→~5,300 tok/s). If a
+future tunnel regresses, this tool pins which case broke.
+"""
+
+from __future__ import annotations
+
+import sys
+
+
+def main() -> int:
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+    from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+    dev = jax.devices()[0]
+    print(f"device: {dev.device_kind} ({dev.platform})")
+    mesh = Mesh(np.array([dev]).reshape(1, 1), ("dp", "tp"))
+    failures = 0
+
+    def trial(name, fn):
+        nonlocal failures
+        try:
+            fn()
+            print(f"{name}: OK")
+        except Exception as exc:
+            failures += 1
+            msg = str(exc).replace("\n", " ")[:220]
+            print(f"{name}: {type(exc).__name__}: {msg}")
+
+    def t1():
+        f = jax.jit(lambda x: x + 1, donate_argnums=(0,))
+        y = f(jnp.ones((256, 256), jnp.bfloat16))
+        float(jnp.sum(y.astype(jnp.float32)))
+
+    def t2():
+        f = jax.jit(lambda x: x * 2, donate_argnums=(0,))
+        x = jax.device_put(jnp.ones((256, 256), jnp.bfloat16),
+                           NamedSharding(mesh, P("dp", None)))
+        float(jnp.sum(f(x).astype(jnp.float32)))
+
+    def t3():
+        def step(cache, x, pos):
+            k = jax.lax.dynamic_update_slice(
+                cache["k"], x, (0, pos, 0, 0))
+            return {"k": k, "v": cache["v"]}
+
+        f = jax.jit(step, donate_argnums=(0,))
+        spec = NamedSharding(mesh, P("dp", None, "tp", None))
+        zeros = jnp.zeros((8, 128, 4, 64), jnp.bfloat16)
+        cache = {"k": jax.device_put(zeros, spec),
+                 "v": jax.device_put(zeros, spec)}
+        x = jnp.ones((8, 1, 4, 64), jnp.bfloat16)
+        for i in range(4):
+            cache = f(cache, x, i)
+        float(jnp.sum(cache["k"].astype(jnp.float32)))
+
+    def t4():
+        def step(cache, x, pos):
+            return jax.lax.dynamic_update_slice(
+                cache, x, (0, pos, 0, 0))
+
+        f = jax.jit(step, donate_argnums=(0,))
+        cache = jnp.zeros((8, 128, 4, 64), jnp.bfloat16)
+        x = jnp.ones((8, 1, 4, 64), jnp.bfloat16)
+        for i in range(4):
+            cache = f(cache, x, jnp.int32(i))
+        float(jnp.sum(cache.astype(jnp.float32)))
+
+    trial("t1 plain donate", t1)
+    trial("t2 sharded donate", t2)
+    trial("t3 cache-dict donate + dynamic_update_slice", t3)
+    trial("t4 bare-array donate + traced pos", t4)
+    print("donation repro:",
+          "ALL PASS — donation safe on this stack" if not failures
+          else f"{failures} case(s) FAILED")
+    return 1 if failures else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -10,6 +10,9 @@ Two gates that replace the reference's OFED/RDMA-specific concerns
 - ``checkpoint_gate``: an Orbax checkpoint-durability check that blocks
   eviction of a live JAX training job until its latest checkpoint is
   committed to durable storage (BASELINE config #4).
+- ``serving_gate``: the serving-side counterpart — park new requests,
+  finish in-flight generations, then admit eviction, so a rolling
+  upgrade over a decode fleet drops zero generations.
 """
 
 from tpu_operator_libs.health.ici_probe import (  # noqa: F401
@@ -23,4 +26,8 @@ from tpu_operator_libs.health.ici_probe import (  # noqa: F401
 from tpu_operator_libs.health.checkpoint_gate import (  # noqa: F401
     CheckpointDurabilityGate,
     latest_committed_step,
+)
+from tpu_operator_libs.health.serving_gate import (  # noqa: F401
+    ServingDrainGate,
+    ServingEndpoint,
 )

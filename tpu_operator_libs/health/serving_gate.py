@@ -1,0 +1,163 @@
+"""In-flight-request drain gate for evicting live serving (decode) pods.
+
+The checkpoint gate (health/checkpoint_gate.py) protects TRAINING pods:
+eviction waits for a durable Orbax step. Serving pods have no checkpoint
+— their unit of loss is the in-flight generation: evicting a decode pod
+mid-generation drops every request it was streaming. This module is the
+serving-side counterpart, plugged into the exact same eviction-gate seam
+(upgrade/gate.py ``GateKeeper``, the generalization of the reference's
+``PodDeletionFilter`` hook, pod_manager.go:76, and of
+``WaitForCompletionSpec``, upgrade_spec.go:52-64):
+
+1. The first time the upgrade flow wants to evict a node's serving
+   pods, the gate puts its endpoints into **draining**: new requests are
+   no longer admitted (``try_begin`` returns None; the router parks or
+   re-routes them — in-flight generations are untouched).
+2. While any generation is still in flight the gate stays CLOSED; the
+   node parks in its current state (drain / pod-deletion required) and
+   is retried next reconcile — the same park-don't-escalate semantics
+   the checkpoint gate gets from GateKeeper.
+3. Once every in-flight generation finishes, the gate OPENS and
+   eviction proceeds having dropped zero generations.
+4. If the upgrade flow abandons the node (e.g. policy change),
+   ``release`` returns its endpoints to admitting.
+
+A :class:`ServingEndpoint` is the library-side handle for one decode
+server (one per serving pod; ``examples/llama_decode.generate_on_device``
+is the compute it fronts). Real deployments adapt this to their serving
+runtime (the admission check wraps the server's request intake); the
+contract the gate needs is only admitting/draining + an in-flight count.
+"""
+
+from __future__ import annotations
+
+import logging
+import threading
+from typing import Callable
+
+from tpu_operator_libs.k8s.objects import Node, Pod
+
+logger = logging.getLogger(__name__)
+
+
+class ServingEndpoint:
+    """Admission control + in-flight accounting for one decode server.
+
+    Thread-safe: the upgrade controller drains from its reconcile
+    thread while request handlers begin/finish generations concurrently.
+    """
+
+    def __init__(self, name: str) -> None:
+        self.name = name
+        self._lock = threading.Lock()
+        self._draining = False
+        self._in_flight = 0
+        self.completed = 0
+        #: Generations aborted mid-flight (the metric the gate drives
+        #: to zero; killed pods abort their in-flight handles).
+        self.dropped = 0
+
+    # -- request side ---------------------------------------------------
+    def try_begin(self) -> bool:
+        """Admit one generation; False while draining (the caller parks
+        or re-routes the request — it is NOT dropped: it never started)."""
+        with self._lock:
+            if self._draining:
+                return False
+            self._in_flight += 1
+            return True
+
+    def finish(self) -> None:
+        """A generation completed and its tokens were delivered."""
+        with self._lock:
+            if self._in_flight <= 0:
+                raise RuntimeError(
+                    f"endpoint {self.name}: finish() without begin()")
+            self._in_flight -= 1
+            self.completed += 1
+
+    def kill(self) -> int:
+        """The serving pod died (eviction, node failure): every
+        in-flight generation is lost. Returns how many were dropped."""
+        with self._lock:
+            dropped = self._in_flight
+            self.dropped += dropped
+            self._in_flight = 0
+            self._draining = True
+            return dropped
+
+    # -- upgrade side ---------------------------------------------------
+    def begin_drain(self) -> None:
+        """Stop admitting new generations (idempotent); in-flight ones
+        run to completion."""
+        with self._lock:
+            if not self._draining:
+                logger.info("serving endpoint %s: draining "
+                            "(%d generation(s) in flight)",
+                            self.name, self._in_flight)
+            self._draining = True
+
+    def resume(self) -> None:
+        with self._lock:
+            self._draining = False
+
+    @property
+    def draining(self) -> bool:
+        with self._lock:
+            return self._draining
+
+    @property
+    def in_flight(self) -> int:
+        with self._lock:
+            return self._in_flight
+
+    @property
+    def quiesced(self) -> bool:
+        with self._lock:
+            return self._in_flight == 0
+
+
+#: Maps (node, pods-about-to-be-evicted) to the serving endpoints those
+#: pods back. Deployment-specific: a fleet registry keyed by pod name,
+#: a label-driven lookup, etc.
+EndpointResolver = Callable[[Node, "list[Pod]"], "list[ServingEndpoint]"]
+
+
+class ServingDrainGate:
+    """EvictionGate (upgrade/gate.py) for serving fleets.
+
+    Evaluating the gate is what initiates the drain: the first reconcile
+    that wants the node's pods gone flips its endpoints to draining, and
+    the gate reports closed until they quiesce. Plug into both eviction
+    paths exactly like the checkpoint gate::
+
+        gate = ServingDrainGate(resolver)
+        mgr.drain_manager.set_eviction_gate(gate)
+        mgr.pod_manager.set_eviction_gate(gate)
+
+    Compose with a checkpoint gate when a fleet runs both kinds of
+    workload: ``lambda n, p: ckpt_gate(n, p) and serving_gate(n, p)``
+    (both gates are park-don't-escalate, so conjunction is safe).
+    """
+
+    def __init__(self, resolver: EndpointResolver) -> None:
+        self._resolver = resolver
+
+    def __call__(self, node: Node, pods: "list[Pod]") -> bool:
+        endpoints = self._resolver(node, pods)
+        for ep in endpoints:
+            ep.begin_drain()
+        blocked = [ep for ep in endpoints if not ep.quiesced]
+        if blocked:
+            logger.info(
+                "serving gate closed for node %s: %s still streaming",
+                node.metadata.name,
+                ", ".join(f"{ep.name}({ep.in_flight})" for ep in blocked))
+            return False
+        return True
+
+    def release(self, node: Node, pods: "list[Pod]") -> None:
+        """The upgrade flow no longer wants this node's pods evicted;
+        let its endpoints admit requests again."""
+        for ep in self._resolver(node, pods):
+            ep.resume()

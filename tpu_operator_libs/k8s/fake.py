@@ -680,6 +680,16 @@ class FakeCluster(K8sClient):
                         if p.metadata.namespace == pdb.metadata.namespace
                         and matches(p.metadata.labels, pdb.selector)]
             healthy = sum(1 for p in matching if p.is_ready())
+            # Documented envtest-grade approximation: percent thresholds
+            # scale against the LIVE selector-matching pod count, while
+            # the real disruption controller scales against the owning
+            # controller's declared replicas (expectedPods). With no
+            # Deployment/ReplicaSet objects in this store the two agree
+            # at steady state; mid-drain the live count decays, so a
+            # minAvailable "N%" here admits evictions slightly earlier
+            # than a real apiserver in the same wave. Integer
+            # thresholds (what the upgrade flow's own tests use) are
+            # exact either way.
             if pdb.min_available is not None:
                 desired = self._scaled(pdb.min_available, len(matching))
             elif pdb.max_unavailable is not None:

@@ -196,6 +196,15 @@ class RealCluster(K8sClient):
         self._rate_limiter = rate_limiter
         # last-seen raw V1ObjectMeta per lease lock (see lease section)
         self._lease_raw_meta: dict = {}
+        # Event names this client has created: PATCH-first on
+        # recurrence instead of POST -> 409 -> PATCH (upsert_event).
+        # LRU-bounded: names embed object+reason, so a months-lived
+        # operator on a churning fleet would otherwise grow this
+        # forever; evicted names just pay one extra POST->409 again.
+        from collections import OrderedDict
+
+        self._created_events: "OrderedDict[tuple, None]" = OrderedDict()
+        self._created_events_cap = 4096
 
     @property
     def rate_limiter(self) -> Optional[object]:
@@ -527,46 +536,77 @@ class RealCluster(K8sClient):
                 lease_transitions=lease.lease_transitions))
 
     # -- events ---------------------------------------------------------
+    def _remember_created(self, key: tuple) -> None:
+        self._created_events[key] = None
+        self._created_events.move_to_end(key)
+        while len(self._created_events) > self._created_events_cap:
+            self._created_events.popitem(last=False)
+
     def upsert_event(self, namespace: str, name: str,
                      event: object) -> None:
-        """v1 Events upsert: POST the named Event; a 409 (the correlator
-        re-reporting a recurring event) PATCHes count/message/
-        lastTimestamp instead — the client-go broadcaster's write
-        pattern."""
+        """v1 Events upsert, PATCH-first for known names: an Event this
+        client already created gets a direct PATCH of count/message/
+        lastTimestamp (client-go's broadcaster PATCHes known events the
+        same way — POST-first would cost every recurrence two
+        rate-limited API calls, POST -> 409 -> PATCH), falling back to
+        POST on 404 (apiserver TTL-collected it). Unknown names POST
+        first, recording the name on success OR on 409 (someone else
+        created it; it exists either way)."""
         from datetime import datetime, timezone
 
         def ts(epoch: float):
             return datetime.fromtimestamp(epoch, tz=timezone.utc)
 
-        body = self._k8s.V1Event(
-            metadata=self._k8s.V1ObjectMeta(name=name,
-                                            namespace=namespace),
-            involved_object=self._k8s.V1ObjectReference(
-                kind=event.kind, name=event.object_name),
-            type=event.type, reason=event.reason, message=event.message,
-            count=event.count,
-            first_timestamp=ts(event.first_seen),
-            last_timestamp=ts(event.last_seen))
-        try:
-            self._core.create_namespaced_event(namespace, body)
-            return
-        except self._k8s.ApiException as exc:
-            if getattr(exc, "status", None) != 409:
-                raise self._translate(exc) from exc
-        patch = {"count": event.count, "message": event.message,
-                 "lastTimestamp": ts(event.last_seen).isoformat()}
-        try:
-            self._core.patch_namespaced_event(name, namespace, patch)
-        except self._k8s.ApiException as exc:
-            if getattr(exc, "status", None) != 404:
-                raise self._translate(exc) from exc
-            # the apiserver TTL-collected the Event between our create
-            # and this recurrence: recreate it (client-go's recordEvent
-            # falls back to POST the same way)
+        key = (namespace, name)
+
+        def body():
+            return self._k8s.V1Event(
+                metadata=self._k8s.V1ObjectMeta(name=name,
+                                                namespace=namespace),
+                involved_object=self._k8s.V1ObjectReference(
+                    kind=event.kind, name=event.object_name),
+                type=event.type, reason=event.reason,
+                message=event.message,
+                count=event.count,
+                first_timestamp=ts(event.first_seen),
+                last_timestamp=ts(event.last_seen))
+
+        def post() -> bool:
+            """True when the Event now exists (created or conflicted)."""
             try:
-                self._core.create_namespaced_event(namespace, body)
-            except self._k8s.ApiException as exc2:
-                raise self._translate(exc2) from exc2
+                self._core.create_namespaced_event(namespace, body())
+                self._remember_created(key)
+                return False
+            except self._k8s.ApiException as exc:
+                if getattr(exc, "status", None) != 409:
+                    raise self._translate(exc) from exc
+                self._remember_created(key)
+                return True  # exists: fall through to PATCH
+
+        def patch() -> bool:
+            """True when the PATCH landed; False on 404 (TTL-collected
+            — client-go's recordEvent falls back to POST the same
+            way)."""
+            update = {"count": event.count, "message": event.message,
+                      "lastTimestamp": ts(event.last_seen).isoformat()}
+            try:
+                self._core.patch_namespaced_event(name, namespace, update)
+                return True
+            except self._k8s.ApiException as exc:
+                if getattr(exc, "status", None) != 404:
+                    raise self._translate(exc) from exc
+                self._created_events.pop(key, None)
+                return False
+
+        if key in self._created_events:
+            self._created_events.move_to_end(key)
+            if patch():
+                return
+            if post():  # recreated... and someone else won the race
+                patch()
+            return
+        if post():  # 409: exists from a previous process/replica
+            patch()
 
     def _cache_lease_meta(self, raw) -> None:
         self._lease_raw_meta[(raw.metadata.namespace or "",

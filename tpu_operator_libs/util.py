@@ -456,6 +456,11 @@ class CorrelatingEventRecorder(EventRecorder):
             self._writer.join(timeout=5.0)
 
     def clear(self) -> None:
+        """Reset the recorder's IN-MEMORY state: recorded events,
+        correlation/aggregation maps, and both drop counters. Sink
+        deliveries already queued are NOT recalled — they were accepted
+        before the clear and the cluster write completes asynchronously
+        (call :meth:`flush` first to drain them deterministically)."""
         with self._lock:
             self._events.clear()
             self._event_keys.clear()
@@ -463,6 +468,7 @@ class CorrelatingEventRecorder(EventRecorder):
             self._similar.clear()
             self._buckets.clear()
             self.dropped_total = 0
+            self.sink_dropped_total = 0
 
 
 def log_event(recorder: Optional[EventRecorder], obj: object, type_: str,
