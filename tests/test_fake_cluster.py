@@ -521,3 +521,92 @@ class TestDaemonSetsAndRevisions:
         clock.advance(10)
         cluster.step()
         assert cluster.list_pods()[0].is_ready()
+
+
+class TestSelectorFastPathProperty:
+    """The compiled matcher's fast paths (single-requirement closure,
+    equality-dict batching, contradiction short-circuit) must be
+    observably identical to a naive per-requirement evaluation."""
+
+    @staticmethod
+    def _split(selector):
+        # independent splitter (NOT the module under test's), so a
+        # regression in selectors._split_requirements is caught too
+        parts, depth, cur = [], 0, []
+        for ch in selector:
+            if ch == "(":
+                depth += 1
+            elif ch == ")":
+                depth -= 1
+            if ch == "," and depth == 0:
+                parts.append("".join(cur))
+                cur = []
+            else:
+                cur.append(ch)
+        if cur:
+            parts.append("".join(cur))
+        return [p for p in (x.strip() for x in parts) if p]
+
+    @classmethod
+    def _naive(cls, selector, labels):
+        for req in cls._split(selector):
+            req = req.strip()
+            if req.startswith("!"):
+                if req[1:].strip() in labels:
+                    return False
+            elif " in " in req or " notin " in req:
+                key, op_rest = req.split(" in ", 1) if " in " in req \
+                    and " notin " not in req else req.split(" notin ", 1)
+                values = {v.strip() for v in
+                          op_rest.strip()[1:-1].split(",") if v.strip()}
+                if " notin " in req:
+                    if key.strip() in labels \
+                            and labels[key.strip()] in values:
+                        return False
+                elif labels.get(key.strip()) not in values:
+                    return False
+            elif "!=" in req:
+                key, val = req.split("!=", 1)
+                if labels.get(key.strip()) == val.strip():
+                    return False
+            elif "==" in req:
+                key, val = req.split("==", 1)
+                if labels.get(key.strip()) != val.strip():
+                    return False
+            elif "=" in req:
+                key, val = req.split("=", 1)
+                if labels.get(key.strip()) != val.strip():
+                    return False
+            else:
+                if req not in labels:
+                    return False
+        return True
+
+    def test_matches_naive_reference(self):
+        from hypothesis import given, settings
+        from hypothesis import strategies as st
+
+        keys = st.sampled_from(["a", "b", "app", "env", "tier"])
+        vals = st.sampled_from(["1", "2", "x", "prod", "canary", ""])
+
+        req = st.one_of(
+            st.tuples(keys, st.sampled_from(["=", "==", "!="]), vals)
+            .map(lambda t: f"{t[0]}{t[1]}{t[2]}"),
+            st.tuples(keys, st.sampled_from(["in", "notin"]),
+                      st.lists(vals.filter(bool), min_size=1,
+                               max_size=3))
+            .map(lambda t: f"{t[0]} {t[1]} ({','.join(t[2])})"),
+            keys,
+            keys.map(lambda k: f"!{k}"),
+        )
+        selectors = st.lists(req, min_size=0, max_size=4).map(",".join)
+        label_dicts = st.dictionaries(keys, vals, max_size=4)
+
+        @settings(max_examples=300, deadline=None)
+        @given(selector=selectors, labels=label_dicts)
+        def check(selector, labels):
+            got = matches_labels(selector, labels)
+            want = self._naive(selector, labels)
+            assert got is want, (selector, labels, got, want)
+
+        check()

@@ -268,3 +268,27 @@ class TestServingUpgradeEndToEnd:
         ungated fleet demonstrably loses in-flight generations."""
         serving = _run_serving_upgrade(with_gate=False)
         assert serving.dropped > 0
+
+
+class TestComposedGates:
+    def test_conjunction_with_checkpoint_gate_is_park_safe(self):
+        """A fleet running both workload kinds composes the gates with
+        plain conjunction (both are park-don't-escalate): eviction
+        waits for checkpoint durability AND quiesced generations."""
+        ep = ServingEndpoint("ep")
+        ep.try_begin()
+        serving = ServingDrainGate(lambda node, pods: [ep])
+        ckpt_open = [False]
+
+        def composed(node, pods):
+            return ckpt_open[0] and serving(node, pods)
+
+        node = _node_stub()
+        assert composed(node, []) is False  # checkpoint not durable
+        # NOTE: short-circuit means serving drain has not initiated yet
+        assert not ep.draining
+        ckpt_open[0] = True
+        assert composed(node, []) is False  # draining, 1 in flight
+        assert ep.draining
+        ep.finish()
+        assert composed(node, []) is True
