@@ -628,23 +628,30 @@ def _model_capture(hardware: dict) -> dict:
     """One bounded attempt at the model-level probe: a full Llama-style
     bf16 training step (fwd+bwd+adamw) on the real chip, reported as
     train_tflops_bf16 / train_mfu_pct. Skipped without cost when the
-    roofline probe already found the chip unreachable."""
+    roofline probe already found the chip unreachable. Successful
+    captures also persist to the sidecar as ``model_last_good`` so a
+    later wedged-chip bench still surfaces the newest real model
+    numbers (marked stale), same degradation contract as the roofline
+    cells."""
     if hardware.get("tpu_unreachable"):
         return dict(_MODEL_NULLS,
                     train_probe_skipped_reason="chip unreachable at "
-                                               "roofline probe")
+                                               "roofline probe",
+                    **_model_last_good())
     timeout_s = float(os.environ.get("BENCH_MODEL_TIMEOUT", "420"))
     data, reason = _probe_once(timeout_s, script=_MODEL_PROBE_SCRIPT)
     if data is None or "error" in data:
         if data is not None:
             reason = f"probe raised: {data['error']}"
-        return dict(_MODEL_NULLS, train_probe_skipped_reason=reason)
+        return dict(_MODEL_NULLS, train_probe_skipped_reason=reason,
+                    **_model_last_good())
     if not data.get("loss_finite"):
         # a diverged step's timing is not a capture — throughput of
         # numerically broken work proves nothing
         return dict(_MODEL_NULLS,
                     train_probe_skipped_reason="train step produced a "
-                                               "non-finite loss")
+                                               "non-finite loss",
+                    **_model_last_good())
     peak = _peak_for(data.get("device_kind", ""), _BF16_PEAK_TFLOPS)
     tflops = data.get("train_tflops_bf16")
     xla_ms = data.get("long_context_xla_ms")
@@ -671,7 +678,29 @@ def _model_capture(hardware: dict) -> dict:
     }
     if data.get("shape_overrides"):
         out["train_shape_overrides"] = True
+    else:
+        _write_model_sidecar(out)
     return out
+
+
+def _model_last_good() -> dict:
+    """{'model_last_good': {...stale capture...}} or {} — the model
+    analogue of hardware_last_good, so a wedged chip cannot erase the
+    newest real train/decode measurements from the bench output."""
+    sidecar = _read_sidecar()
+    if isinstance(sidecar, dict) and isinstance(
+            sidecar.get("model_last_good"), dict):
+        snapshot = dict(sidecar["model_last_good"])
+        snapshot["stale"] = True
+        return {"model_last_good": snapshot}
+    return {}
+
+
+def _write_model_sidecar(result: dict) -> None:
+    """Persist a successful model capture under model_last_good
+    (keeps the roofline last-good and attempt history intact)."""
+    _update_sidecar(lambda sidecar: sidecar.__setitem__(
+        "model_last_good", {"captured_at": _utcnow(), **result}))
 
 
 def _hardware_capture() -> dict:
@@ -741,6 +770,10 @@ def _hardware_capture() -> dict:
     # crash the degradation path itself).
     if isinstance(last_good, dict) and "captured_at" in last_good:
         last_good.pop("attempt_history", None)  # already surfaced above
+        # surfaced separately as the top-level model_last_good; nesting
+        # it here would duplicate 16 model cells inside the roofline
+        # block
+        last_good.pop("model_last_good", None)
         last_good["stale"] = True
         out["hardware_last_good"] = last_good
     return out
@@ -840,35 +873,59 @@ def _sidecar_lock():
     return locked()
 
 
-def _write_sidecar(result: dict) -> None:
-    """Refresh the last-good numbers, appending a success attempt to the
-    history carried over from the previous sidecar."""
-    now = time.strftime("%Y-%m-%dT%H:%M:%SZ", time.gmtime())
+def _utcnow() -> str:
+    return time.strftime("%Y-%m-%dT%H:%M:%SZ", time.gmtime())
+
+
+def _update_sidecar(mutate) -> None:
+    """Locked read-modify-write: ``mutate(sidecar_dict)`` edits the
+    parsed sidecar in place (non-dict/missing files coerce to {});
+    the result is dumped atomically. Every sidecar writer goes through
+    here so locking, coercion and atomicity live in one place."""
     with _sidecar_lock():
-        history = _attempt_history()
+        sidecar = _read_sidecar()
+        if not isinstance(sidecar, dict):
+            sidecar = {}
+        mutate(sidecar)
+        _dump_sidecar(sidecar)
+
+
+def _write_sidecar(result: dict) -> None:
+    """Refresh the last-good roofline numbers, appending a success
+    attempt to the carried-over history. Read-modify-write: the
+    model_last_good block (written by the separate model probe) must
+    survive a roofline refresh, or the common "roofline fine, model
+    probe wedges" sequence would erase the newest model capture."""
+    def mutate(sidecar: dict) -> None:
+        now = _utcnow()
+        history = sidecar.get("attempt_history")
+        history = list(history) if isinstance(history, list) else []
         history.append({"at": now, "ok": True,
                         "mxu_tflops_bf16": result.get("mxu_tflops_bf16")})
-        _dump_sidecar({"captured_at": now, **result,
-                       "attempt_history": history[-_MAX_ATTEMPTS_KEPT:]})
+        model = sidecar.get("model_last_good")
+        sidecar.clear()
+        sidecar.update({"captured_at": now, **result,
+                        "attempt_history": history[-_MAX_ATTEMPTS_KEPT:]})
+        if isinstance(model, dict):
+            sidecar["model_last_good"] = model
+
+    _update_sidecar(mutate)
 
 
 def _record_attempt(ok: bool, reason: Optional[str] = None) -> None:
     """Append a probe attempt to the sidecar without touching the
     last-good hardware numbers."""
-    with _sidecar_lock():
-        sidecar = _read_sidecar()
-        if not isinstance(sidecar, dict):
-            sidecar = {}
+    def mutate(sidecar: dict) -> None:
         history = sidecar.get("attempt_history")
         if not isinstance(history, list):
             history = []
-        entry: dict = {"at": time.strftime("%Y-%m-%dT%H:%M:%SZ",
-                                           time.gmtime()), "ok": ok}
+        entry: dict = {"at": _utcnow(), "ok": ok}
         if reason:
             entry["reason"] = reason[:200]
         history.append(entry)
         sidecar["attempt_history"] = history[-_MAX_ATTEMPTS_KEPT:]
-        _dump_sidecar(sidecar)
+
+    _update_sidecar(mutate)
 
 
 def _dump_sidecar(payload: dict) -> None:

@@ -105,11 +105,12 @@ def render(capture: dict) -> str:
     lines += [f"| {k} | {v} |" for k, v in rows]
     if capture.get("tpu_unreachable"):
         lines += ["",
-                  "*Hardware cells are null in this capture: the chip "
-                  "was unreachable (`tpu_unreachable_reason` in the "
-                  "JSON); the sidecar's last-good values ride along "
-                  "under `hardware_last_good`, marked stale. "
-                  "Re-capture when the tunnel recovers.*"]
+                  "*Hardware/model cells are null in this capture: the "
+                  "chip was unreachable (`tpu_unreachable_reason` in "
+                  "the JSON); the sidecar's newest real measurements "
+                  "ride along under `hardware_last_good` and "
+                  "`model_last_good`, marked stale. Re-capture when "
+                  "the tunnel recovers.*"]
     lines += ["", END]
     return "\n".join(lines)
 
