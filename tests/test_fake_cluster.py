@@ -610,3 +610,51 @@ class TestSelectorFastPathProperty:
             assert got is want, (selector, labels, got, want)
 
         check()
+
+
+class TestPdbControllerDeclaredBase:
+    def test_percent_base_holds_through_a_drain_wave(self):
+        """Percent thresholds scale against the owning DaemonSet's
+        DECLARED desired count (the disruption controller's
+        expectedPods), not the decaying live pod count: with
+        minAvailable=50% of a declared 4, evicting down to 2 ready pods
+        exhausts the budget even after earlier evictions shrank the
+        live matching set."""
+        from tpu_operator_libs.k8s.objects import (
+            ObjectMeta,
+            PodDisruptionBudget,
+        )
+
+        cluster = FakeCluster()
+        ds = DaemonSetBuilder("runtime").with_labels({"app": "job"}) \
+            .with_desired_scheduled(4).create(cluster)
+        for i in range(4):
+            PodBuilder(f"w{i}").with_labels({"app": "job"}) \
+                .owned_by(ds).create(cluster)
+        cluster.add_pod_disruption_budget(PodDisruptionBudget(
+            metadata=ObjectMeta(name="pdb", namespace="tpu-system"),
+            selector={"app": "job"}, min_available="50%"))
+        cluster.evict_pod("tpu-system", "w0")  # 4 healthy -> 3 >= 2
+        # live count is now 3; a live-count base would re-derive the
+        # threshold as ceil(50% of 3) = 2 and admit down to 2 -> 1.
+        # The declared base keeps requiring 2 of the DECLARED 4:
+        cluster.evict_pod("tpu-system", "w1")  # 3 -> 2, still >= 2
+        with pytest.raises(EvictionBlockedError):
+            cluster.evict_pod("tpu-system", "w2")  # would leave 1 < 2
+
+    def test_unowned_pods_fall_back_to_live_count(self):
+        from tpu_operator_libs.k8s.objects import (
+            ObjectMeta,
+            PodDisruptionBudget,
+        )
+
+        cluster = FakeCluster()
+        for i in range(2):
+            PodBuilder(f"w{i}").with_labels({"app": "bare"}) \
+                .create(cluster)
+        cluster.add_pod_disruption_budget(PodDisruptionBudget(
+            metadata=ObjectMeta(name="pdb", namespace="tpu-system"),
+            selector={"app": "bare"}, min_available="50%"))
+        cluster.evict_pod("tpu-system", "w0")  # 50% of live 2 = 1, ok
+        with pytest.raises(EvictionBlockedError):
+            cluster.evict_pod("tpu-system", "w1")

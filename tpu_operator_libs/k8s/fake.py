@@ -656,11 +656,15 @@ class FakeCluster(K8sClient):
         """Raise EvictionBlockedError when any matching PDB has no
         disruptions left (lock held).
 
-        Expected pod count is the CURRENT selector-matching count (the
-        apiserver reads the controller's scale; with no controllers the
-        live count is the envtest-grade approximation — note an evicted
-        pod that the workload controller has not yet recreated shrinks
-        the percent base accordingly)."""
+        Threshold base: when every matching pod belongs to one
+        DaemonSet in this store, the DECLARED desired_number_scheduled
+        (the disruption controller's expectedPods) — so percent
+        budgets hold through a drain wave. Unowned/mixed pods fall
+        back to the live matching count, the envtest-grade
+        approximation for controllers this store does not model: there
+        an evicted-but-not-yet-recreated pod shrinks the base, which
+        admits evictions a real apiserver would block (see the inline
+        note below)."""
         def matches(labels: Mapping[str, str], selector: dict) -> bool:
             # policy/v1 semantics: an EMPTY selector selects every pod
             # in the namespace (v1beta1's match-nothing was reversed)
@@ -680,21 +684,32 @@ class FakeCluster(K8sClient):
                         if p.metadata.namespace == pdb.metadata.namespace
                         and matches(p.metadata.labels, pdb.selector)]
             healthy = sum(1 for p in matching if p.is_ready())
-            # Documented envtest-grade approximation: percent thresholds
-            # scale against the LIVE selector-matching pod count, while
-            # the real disruption controller scales against the owning
-            # controller's declared replicas (expectedPods). With no
-            # Deployment/ReplicaSet objects in this store the two agree
-            # at steady state; mid-drain the live count decays, so a
-            # minAvailable "N%" here admits evictions slightly earlier
-            # than a real apiserver in the same wave. Integer
-            # thresholds (what the upgrade flow's own tests use) are
-            # exact either way.
+            # Percent-threshold base: the real disruption controller
+            # scales against the owning controller's DECLARED count
+            # (expectedPods), not the live pod count. When every
+            # matching pod belongs to one DaemonSet in this store, use
+            # its desired_number_scheduled — so a budget like
+            # minAvailable "N%" holds through a drain wave instead of
+            # decaying with the evictions. Mixed/unowned pods fall
+            # back to the live matching count (envtest-grade
+            # approximation: no Deployment/ReplicaSet objects here;
+            # the bases agree at steady state, but a sequential drain
+            # against the decaying live base admits evictions — e.g.
+            # integer max_unavailable re-derived per step — that a
+            # real apiserver would block).
+            expected = len(matching)
+            owners = [p.controller_owner() for p in matching]
+            owner_uids = {o.uid for o in owners if o is not None}
+            if len(owner_uids) == 1 and None not in owners:
+                ds_key = self._ds_key_by_owner_uid(next(iter(owner_uids)))
+                if ds_key is not None:
+                    expected = self._daemon_sets[
+                        ds_key].status.desired_number_scheduled
             if pdb.min_available is not None:
-                desired = self._scaled(pdb.min_available, len(matching))
+                desired = self._scaled(pdb.min_available, expected)
             elif pdb.max_unavailable is not None:
-                desired = len(matching) - self._scaled(
-                    pdb.max_unavailable, len(matching))
+                desired = expected - self._scaled(
+                    pdb.max_unavailable, expected)
             else:
                 continue
             # IfHealthyBudget (the policy/v1 default): evicting an
