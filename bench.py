@@ -154,8 +154,74 @@ def main() -> int:
     }
     result.update(hardware)
     result.update(_model_capture(hardware))
+    _promote_recent(result)
     print(json.dumps(result))
     return 0
+
+
+def _age_s(captured_at) -> Optional[float]:
+    """Seconds since a sidecar ``captured_at`` stamp (None if absent or
+    unparseable)."""
+    import calendar
+
+    try:
+        parsed = time.strptime(captured_at, "%Y-%m-%dT%H:%M:%SZ")
+    except (TypeError, ValueError):
+        return None
+    return max(0.0, time.time() - calendar.timegm(parsed))
+
+
+def _promote_recent(result: dict) -> None:
+    """Surface a RECENT probe-written capture as the headline when the
+    chip is wedged at bench time (round-4 VERDICT task 1).
+
+    The tunnel wedges for hours at a stretch (round 4: >5 h covering
+    the entire capture window), so the capture daemon
+    (tools/capture_daemon.py) grabs full probes opportunistically at
+    healthy windows during the round. If the end-of-round bench then
+    lands in a wedge, the freshest machine-written capture — younger
+    than BENCH_RECENT_MAX_AGE (default 24 h) — is promoted into the
+    headline fields WITH explicit provenance: ``*_capture_mode:
+    "recent"``, ``*_captured_at`` and ``*_capture_age_s``; the
+    ``tpu_unreachable`` diagnostic stays. Nothing is promoted silently:
+    a live capture reports ``capture_mode: "live"``, a hand-seeded
+    sidecar block (no ``probe_written``) is never promoted, and an
+    over-age capture stays in the stale ``*_last_good`` tier."""
+    max_age = float(os.environ.get("BENCH_RECENT_MAX_AGE", "86400"))
+    if not result.get("tpu_unreachable"):
+        result["hardware_capture_mode"] = "live"
+    else:
+        good = result.get("hardware_last_good")
+        age = _age_s((good or {}).get("captured_at"))
+        # roofline last-good is only ever probe-written (_write_sidecar
+        # runs on probe success; shape-overridden runs never persist)
+        if good and age is not None and age <= max_age:
+            for key in ("ici_probe_ms", "ici_bandwidth_gbytes_per_s",
+                        "mxu_tflops_bf16", "mxu_mfu_pct", "mxu_tops_int8",
+                        "mxu_int8_utilization_pct", "hbm_gbytes_per_s",
+                        "hbm_utilization_pct", "tpu_device_kind"):
+                if result.get(key) is None:
+                    result[key] = good.get(key)
+            result["hardware_capture_mode"] = "recent"
+            result["hardware_captured_at"] = good["captured_at"]
+            result["hardware_capture_age_s"] = round(age)
+        else:
+            result["hardware_capture_mode"] = "degraded"
+    if result.get("train_tflops_bf16") is not None:
+        result["model_capture_mode"] = "live"
+    else:
+        good = result.get("model_last_good")
+        age = _age_s((good or {}).get("captured_at"))
+        if (good and good.get("probe_written")
+                and age is not None and age <= max_age):
+            for key in _MODEL_NULLS:
+                if result.get(key) is None:
+                    result[key] = good.get(key)
+            result["model_capture_mode"] = "recent"
+            result["model_captured_at"] = good["captured_at"]
+            result["model_capture_age_s"] = round(age)
+        else:
+            result["model_capture_mode"] = "degraded"
 
 
 # Chip bf16 peak TFLOP/s per core-pair ("chip"), public figures; used
@@ -604,6 +670,45 @@ except Exception as exc:  # structured failure, never a bare traceback
     sys.exit(0)
 """
 
+_PREFLIGHT_SCRIPT = r"""
+import json
+import os
+import sys
+
+try:
+    import jax
+
+    if os.environ.get("JAX_PLATFORMS"):
+        jax.config.update("jax_platforms", os.environ["JAX_PLATFORMS"])
+    devices = jax.devices()
+    print(json.dumps({"n_devices": len(devices),
+                      "platform": devices[0].platform,
+                      "device_kind": devices[0].device_kind}))
+except Exception as exc:  # structured failure, never a bare traceback
+    print(json.dumps({"error": f"{type(exc).__name__}: {exc}"}))
+    sys.exit(0)
+"""
+
+
+def _preflight(timeout_s: Optional[float] = None):
+    """(ok, reason): cheap device-enumeration check in a throwaway
+    subprocess before committing to a full probe.
+
+    The round-4 wedge burned 2 x 120 s on full-probe attempts whose
+    subprocesses never got past ``jax.devices()``; enumeration alone
+    answers "is the tunnel wedged?" in a fraction of the budget, so the
+    bench (and the opportunistic capture daemon) can fail fast and
+    spend the saved time on spaced retries instead."""
+    if timeout_s is None:
+        timeout_s = float(os.environ.get("BENCH_PREFLIGHT_TIMEOUT", "75"))
+    data, reason = _probe_once(timeout_s, script=_PREFLIGHT_SCRIPT)
+    if data is None:
+        return False, f"pre-flight enumeration failed: {reason}"
+    if "error" in data:
+        return False, f"pre-flight enumeration raised: {data['error']}"
+    return True, "ok"
+
+
 _MODEL_NULLS = {
     "train_model": None,
     "train_params_m": None,
@@ -698,9 +803,14 @@ def _model_last_good() -> dict:
 
 def _write_model_sidecar(result: dict) -> None:
     """Persist a successful model capture under model_last_good
-    (keeps the roofline last-good and attempt history intact)."""
+    (keeps the roofline last-good and attempt history intact).
+    ``probe_written`` marks machine-written records: only those are
+    eligible for recent-capture promotion (_promote_recent) — a
+    hand-seeded block can surface as stale last-good but never as the
+    headline."""
     _update_sidecar(lambda sidecar: sidecar.__setitem__(
-        "model_last_good", {"captured_at": _utcnow(), **result}))
+        "model_last_good",
+        {"captured_at": _utcnow(), "probe_written": True, **result}))
 
 
 def _hardware_capture() -> dict:
@@ -717,6 +827,20 @@ def _hardware_capture() -> dict:
     timeout_s = float(os.environ.get("BENCH_PROBE_TIMEOUT", "120"))
     attempts = max(1, int(os.environ.get("BENCH_PROBE_ATTEMPTS", "2")))
     backoff_s = float(os.environ.get("BENCH_PROBE_BACKOFF", "10"))
+
+    # Pre-flight: device enumeration in its own bounded subprocess. A
+    # wedged tunnel hangs there, so failing fast here saves the full
+    # probe budget (attempts x timeout) for windows where the chip can
+    # actually answer.
+    ok, pf_reason = _preflight()
+    if not ok:
+        _record_attempt(ok=False, reason=pf_reason)
+        # report the PRE-FLIGHT budget, not the full-probe timeout the
+        # wedge never reached — the diagnostic must describe what ran
+        return _hardware_degraded(
+            pf_reason, attempts_made=1,
+            timeout_s=float(os.environ.get("BENCH_PREFLIGHT_TIMEOUT",
+                                           "75")))
 
     reason = "unknown"
     attempts_made = 0
@@ -745,6 +869,13 @@ def _hardware_capture() -> dict:
         if attempt + 1 < attempts:
             time.sleep(backoff_s * (attempt + 1))
 
+    return _hardware_degraded(reason, attempts_made, timeout_s)
+
+
+def _hardware_degraded(reason: str, attempts_made: int,
+                       timeout_s: float) -> dict:
+    """The unreachable-chip result: nulls + structured reason + attempt
+    history + last-good sidecar contents marked stale."""
     out = {
         "ici_probe_ms": None,
         "ici_bandwidth_gbytes_per_s": None,

@@ -25,9 +25,17 @@ def _isolated_sidecar(tmp_path, monkeypatch):
     bench helpers (_record_attempt, _write_model_sidecar via
     _model_capture) persist as a side effect, and a stubbed capture
     must never clobber the repo's REAL last-good sidecar. Tests that
-    care about sidecar content still monkeypatch SIDECAR themselves."""
+    care about sidecar content still monkeypatch SIDECAR themselves.
+
+    The pre-flight enumeration check is stubbed green for the same
+    reason _probe_once is stubbed everywhere: these are plumbing tests,
+    and a real enumeration subprocess against a wedged tunnel would
+    cost every test its full timeout. Pre-flight behavior has its own
+    tests (TestPreflight)."""
     monkeypatch.setattr(bench, "SIDECAR",
                         str(tmp_path / "BENCH_HW.autouse.json"))
+    monkeypatch.setattr(bench, "_preflight", lambda timeout_s=None:
+                        (True, "ok"))
 
 
 class TestHardwareResult:
@@ -440,3 +448,139 @@ class TestModelLastGood:
                             str(tmp_path / "missing.json"))
         out = bench._model_capture({"tpu_unreachable": True})
         assert "model_last_good" not in out
+
+
+class TestPreflight:
+    """Round-5 wedge hardening: a cheap enumeration subprocess gates
+    the full probe, so a wedged tunnel costs one short timeout instead
+    of attempts x 120 s — and the failure is recorded like any other
+    attempt."""
+
+    def test_preflight_failure_skips_full_probe(self, tmp_path,
+                                                monkeypatch):
+        monkeypatch.setattr(bench, "SIDECAR",
+                            str(tmp_path / "BENCH_HW.json"))
+        monkeypatch.setattr(
+            bench, "_preflight",
+            lambda timeout_s=None: (False, "pre-flight enumeration "
+                                           "failed: wedged"))
+        full_probe_calls = []
+        monkeypatch.setattr(
+            bench, "_probe_once",
+            lambda *a, **k: full_probe_calls.append(1) or (None, "x"))
+        out = bench._hardware_capture()
+        assert not full_probe_calls  # full probe never attempted
+        assert out["tpu_unreachable"] is True
+        assert "pre-flight" in out["tpu_unreachable_reason"]
+        history = out["hardware_attempt_history"]
+        assert len(history) == 1 and history[0]["ok"] is False
+        assert "pre-flight" in history[0]["reason"]
+
+    def test_preflight_success_proceeds_to_full_probe(self, tmp_path,
+                                                      monkeypatch):
+        monkeypatch.setattr(bench, "SIDECAR",
+                            str(tmp_path / "BENCH_HW.json"))
+        monkeypatch.setattr(bench, "_preflight",
+                            lambda timeout_s=None: (True, "ok"))
+        monkeypatch.setattr(
+            bench, "_probe_once",
+            lambda timeout_s: ({"probe_ms": 3.0, "tflops": 150.0,
+                                "device_kind": "TPU v5e"}, "ok"))
+        out = bench._hardware_capture()
+        assert out["mxu_tflops_bf16"] == 150.0
+
+    def test_preflight_script_runs_on_cpu(self):
+        """The enumeration script itself must execute on the CPU
+        backend and report a structured payload."""
+        import subprocess
+        import sys
+
+        env = dict(os.environ, JAX_PLATFORMS="cpu")
+        env.pop("PALLAS_AXON_POOL_IPS", None)  # stay off the tunnel
+        proc = subprocess.run(
+            [sys.executable, "-c", bench._PREFLIGHT_SCRIPT],
+            capture_output=True, text=True, timeout=120, env=env,
+            cwd=os.path.dirname(os.path.abspath(bench.__file__)))
+        lines = [ln for ln in proc.stdout.splitlines() if ln.strip()]
+        assert lines, (proc.stdout, proc.stderr)
+        data = json.loads(lines[-1])
+        assert "error" not in data, data
+        assert data["n_devices"] >= 1
+        assert data["platform"] == "cpu"
+
+
+class TestPromoteRecent:
+    """Round-5 VERDICT task 1: when the chip is wedged at bench time, a
+    RECENT machine-written capture (from the round's capture daemon) is
+    promoted into the headline fields with explicit provenance; manual
+    seeds and over-age captures never are."""
+
+    def _degraded_result(self, **extra):
+        out = {"tpu_unreachable": True, "train_tflops_bf16": None}
+        out.update({k: None for k in bench._MODEL_NULLS})
+        out.update(extra)
+        return out
+
+    def test_live_capture_marked_live(self):
+        result = {"mxu_tflops_bf16": 167.0, "train_tflops_bf16": 114.0}
+        bench._promote_recent(result)
+        assert result["hardware_capture_mode"] == "live"
+        assert result["model_capture_mode"] == "live"
+        assert "hardware_capture_age_s" not in result
+
+    def test_recent_hardware_promoted_with_age(self):
+        result = self._degraded_result(
+            mxu_tflops_bf16=None, mxu_mfu_pct=None,
+            hardware_last_good={"captured_at": bench._utcnow(),
+                                "mxu_tflops_bf16": 167.0,
+                                "mxu_mfu_pct": 85.0, "stale": True})
+        bench._promote_recent(result)
+        assert result["hardware_capture_mode"] == "recent"
+        assert result["mxu_tflops_bf16"] == 167.0
+        assert result["hardware_capture_age_s"] < 60
+        assert result["tpu_unreachable"] is True  # diagnostic kept
+
+    def test_over_age_hardware_not_promoted(self, monkeypatch):
+        monkeypatch.setenv("BENCH_RECENT_MAX_AGE", "10")
+        result = self._degraded_result(
+            mxu_tflops_bf16=None,
+            hardware_last_good={"captured_at": "2026-07-01T00:00:00Z",
+                                "mxu_tflops_bf16": 167.0, "stale": True})
+        bench._promote_recent(result)
+        assert result["hardware_capture_mode"] == "degraded"
+        assert result["mxu_tflops_bf16"] is None
+
+    def test_probe_written_model_promoted(self):
+        good = dict(bench._MODEL_NULLS, captured_at=bench._utcnow(),
+                    probe_written=True, train_step_ms=252.0,
+                    train_tflops_bf16=114.0, train_mfu_pct=58.0,
+                    decode_tok_s=5264, stale=True)
+        result = self._degraded_result(model_last_good=good)
+        bench._promote_recent(result)
+        assert result["model_capture_mode"] == "recent"
+        assert result["train_mfu_pct"] == 58.0
+        assert result["decode_tok_s"] == 5264
+        assert result["model_capture_age_s"] < 60
+
+    def test_manually_seeded_model_never_promoted(self):
+        # no probe_written marker => hand-seeded (the round-4 record)
+        good = dict(bench._MODEL_NULLS, captured_at=bench._utcnow(),
+                    train_mfu_pct=58.0, stale=True,
+                    source="seeded manually")
+        result = self._degraded_result(model_last_good=good)
+        bench._promote_recent(result)
+        assert result["model_capture_mode"] == "degraded"
+        assert result["train_mfu_pct"] is None
+
+    def test_unparseable_captured_at_not_promoted(self):
+        result = self._degraded_result(
+            hardware_last_good={"captured_at": "garbage",
+                                "mxu_tflops_bf16": 167.0})
+        bench._promote_recent(result)
+        assert result["hardware_capture_mode"] == "degraded"
+
+    def test_age_s_parses_roundtrip(self):
+        age = bench._age_s(bench._utcnow())
+        assert age is not None and age < 60
+        assert bench._age_s(None) is None
+        assert bench._age_s("nope") is None

@@ -642,6 +642,30 @@ class TestPdbControllerDeclaredBase:
         with pytest.raises(EvictionBlockedError):
             cluster.evict_pod("tpu-system", "w2")  # would leave 1 < 2
 
+    def test_unpopulated_ds_status_falls_back_to_live_count(self):
+        """Round-4 advisor finding: a DS whose status was never
+        populated reports desired_number_scheduled=0; taking that as
+        the percent base would compute desired=0 and the budget would
+        silently never block. The declared base must never be weaker
+        than the live matching count."""
+        from tpu_operator_libs.k8s.objects import (
+            ObjectMeta,
+            PodDisruptionBudget,
+        )
+
+        cluster = FakeCluster()
+        ds = DaemonSetBuilder("runtime").with_labels({"app": "job"}) \
+            .with_desired_scheduled(0).create(cluster)  # status unset
+        for i in range(2):
+            PodBuilder(f"w{i}").with_labels({"app": "job"}) \
+                .owned_by(ds).create(cluster)
+        cluster.add_pod_disruption_budget(PodDisruptionBudget(
+            metadata=ObjectMeta(name="pdb", namespace="tpu-system"),
+            selector={"app": "job"}, min_available="50%"))
+        cluster.evict_pod("tpu-system", "w0")  # 50% of live 2 = 1, ok
+        with pytest.raises(EvictionBlockedError):
+            cluster.evict_pod("tpu-system", "w1")  # would leave 0 < 1
+
     def test_unowned_pods_fall_back_to_live_count(self):
         from tpu_operator_libs.k8s.objects import (
             ObjectMeta,

@@ -1,0 +1,131 @@
+#!/usr/bin/env python3
+"""Wedge-aware opportunistic capture daemon (round-5 VERDICT task 1).
+
+The TPU tunnel wedges for hours at a stretch (round 4: one >5 h wedge
+covered the entire capture window, so every live bench field shipped
+null). This daemon turns the capture from a point-in-time gamble into a
+window-wide watch:
+
+1. **Cheap pre-flight** — device enumeration in a throwaway subprocess
+   (bench._preflight): a wedged tunnel costs one short timeout, not the
+   full probe budget.
+2. **Spaced backoff** — failed pre-flights sleep 2 min doubling to a
+   15 min cap, for the whole watch window (default 11 h), each attempt
+   recorded in the BENCH_HW.json sidecar's attempt_history.
+3. **Opportunistic full capture** — the first healthy window runs the
+   real `python bench.py` (roofline + model probes + simulation cells),
+   validates the JSON, atomically refreshes ``docs/bench_capture.json``
+   and regenerates the docs table (tools/gen_bench_docs.py). Probe
+   successes refresh the sidecar's last-good blocks as a side effect of
+   bench's own machinery, so even a later wedge surfaces these numbers
+   (and bench._promote_recent can promote them with explicit age).
+
+Usage:
+    python tools/capture_daemon.py                 # watch + one capture
+    python tools/capture_daemon.py --once          # single attempt
+    python tools/capture_daemon.py --keep-watching # re-capture hourly
+
+Exit 0 after a successful capture (unless --keep-watching), 1 when the
+watch window expires with the chip never reachable.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import subprocess
+import sys
+import time
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+
+import bench  # noqa: E402  (repo-root module, path set above)
+
+CAPTURE = os.path.join(REPO, "docs", "bench_capture.json")
+
+
+def log(msg: str) -> None:
+    print(f"[{bench._utcnow()}] {msg}", flush=True)
+
+
+def run_full_capture(timeout_s: float) -> bool:
+    """Run `python bench.py`, validate, and atomically install the
+    capture + regenerated docs table. True on a live-chip capture."""
+    log("pre-flight green; running full bench capture...")
+    try:
+        proc = subprocess.run(
+            [sys.executable, os.path.join(REPO, "bench.py")],
+            capture_output=True, text=True, timeout=timeout_s, cwd=REPO)
+    except subprocess.TimeoutExpired:
+        log(f"full bench exceeded {timeout_s:.0f}s; treating as wedged")
+        return False
+    lines = [ln for ln in proc.stdout.splitlines() if ln.strip()]
+    if proc.returncode != 0 or not lines:
+        log(f"bench failed rc={proc.returncode}: "
+            f"{(proc.stderr or '')[-300:]!r}")
+        return False
+    try:
+        capture = json.loads(lines[-1])
+    except json.JSONDecodeError:
+        log(f"unparseable bench output: {lines[-1][:200]!r}")
+        return False
+    if capture.get("tpu_unreachable") or \
+            capture.get("mxu_tflops_bf16") is None:
+        log("bench ran but chip was unreachable mid-capture "
+            f"({capture.get('tpu_unreachable_reason')!r})")
+        return False
+    tmp = f"{CAPTURE}.tmp.{os.getpid()}"
+    with open(tmp, "w") as fh:
+        fh.write(lines[-1] + "\n")
+    os.replace(tmp, CAPTURE)
+    gen = subprocess.run(
+        [sys.executable, os.path.join(REPO, "tools", "gen_bench_docs.py")],
+        capture_output=True, text=True, cwd=REPO)
+    log(f"capture installed: mxu={capture.get('mxu_tflops_bf16')} "
+        f"TFLOP/s, train_mfu={capture.get('train_mfu_pct')}%, "
+        f"decode={capture.get('decode_tok_s')} tok/s, "
+        f"decode_int8={capture.get('decode_int8_tok_s')} tok/s; "
+        f"gen_bench_docs rc={gen.returncode}")
+    return True
+
+
+def main() -> int:
+    parser = argparse.ArgumentParser()
+    parser.add_argument("--once", action="store_true",
+                        help="single pre-flight + capture attempt")
+    parser.add_argument("--keep-watching", action="store_true",
+                        help="after a success, keep re-capturing hourly")
+    parser.add_argument("--max-hours", type=float, default=11.0)
+    parser.add_argument("--bench-timeout", type=float, default=3600.0)
+    args = parser.parse_args()
+
+    deadline = time.monotonic() + args.max_hours * 3600.0
+    backoff = 120.0
+    captured = False
+    while time.monotonic() < deadline:
+        ok, reason = bench._preflight()
+        if ok:
+            backoff = 120.0
+            if run_full_capture(args.bench_timeout):
+                captured = True
+                if not args.keep_watching:
+                    return 0
+                log("keep-watching: next re-capture in 1h")
+                time.sleep(3600.0)
+                continue
+        else:
+            bench._record_attempt(ok=False, reason=f"daemon {reason}")
+            log(f"chip not reachable ({reason}); retrying in "
+                f"{backoff:.0f}s")
+        if args.once:
+            return 0 if captured else 1
+        time.sleep(min(backoff, max(0.0, deadline - time.monotonic())))
+        backoff = min(backoff * 2.0, 900.0)
+    log("watch window expired")
+    return 0 if captured else 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -703,8 +703,15 @@ class FakeCluster(K8sClient):
             if len(owner_uids) == 1 and None not in owners:
                 ds_key = self._ds_key_by_owner_uid(next(iter(owner_uids)))
                 if ds_key is not None:
-                    expected = self._daemon_sets[
+                    # A DS whose status was never populated reports
+                    # desired_number_scheduled=0; taking that at face
+                    # value would make every percent threshold compute
+                    # desired=0 and the budget silently never block.
+                    # The declared base exists to be STRONGER than the
+                    # decaying live count, so never let it be weaker.
+                    declared = self._daemon_sets[
                         ds_key].status.desired_number_scheduled
+                    expected = max(declared, len(matching))
             if pdb.min_available is not None:
                 desired = self._scaled(pdb.min_available, expected)
             elif pdb.max_unavailable is not None:

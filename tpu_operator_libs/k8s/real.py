@@ -572,7 +572,8 @@ class RealCluster(K8sClient):
                 last_timestamp=ts(event.last_seen))
 
         def post() -> bool:
-            """True when the Event now exists (created or conflicted)."""
+            """True when a 409 indicated the Event already exists (fall
+            through to PATCH); False when this POST created it."""
             try:
                 self._core.create_namespaced_event(namespace, body())
                 self._remember_created(key)

@@ -595,9 +595,13 @@ def simulate_with_operator_stack(
                     # virtual time at the pre-reconcile availability
                     availability_weighted += pre.availability() * real_dt
                     clock.advance(real_dt)
-                else:
-                    with flight_lock:
-                        armed[0] = None
+                # Disarm unconditionally once the batch's window closed:
+                # fired actions that produced no watch enqueue (e.g. a
+                # no-op write) would otherwise leave a stale arm
+                # timestamp for the next interval-tick reconcile to
+                # consume as an inflated dispatch sample.
+                with flight_lock:
+                    armed[0] = None
                 topo = SliceTopology.from_nodes(cluster.list_nodes())
                 t = t_next
             if all_done.is_set():

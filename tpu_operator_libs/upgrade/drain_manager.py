@@ -74,6 +74,11 @@ class DrainManager:
     def set_eviction_gate(self, gate: Optional["EvictionGate"]) -> None:
         self._gatekeeper.set_gate(gate)
 
+    def abandon_stale_gate_deferrals(self, still_wanted: "set[str]") -> None:
+        """Hand gate-parked nodes that left every eviction-wanting state
+        back to the gate's ``release`` hook (GateKeeper.abandon_stale)."""
+        self._gatekeeper.abandon_stale(still_wanted)
+
     def schedule_nodes_drain(self, config: DrainConfiguration) -> None:
         """Schedule an async drain per node (drain_manager.go:58-138)."""
         if not config.nodes:

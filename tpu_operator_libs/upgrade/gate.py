@@ -34,6 +34,16 @@ class GateKeeper:
         self._recorder = recorder
         self._action = action  # "pod deletion" | "drain" — event wording
         self._deferred = NameSet()
+        # Last (node, pods) snapshot per parked node, so an abandon can
+        # replay them into the gate's release() even after the node
+        # left the eviction-wanting bucket (or vanished entirely).
+        # Guarded by _parked_lock: allows() runs on async drain/
+        # pod-deletion worker threads while abandon_stale() runs on the
+        # reconcile thread.
+        import threading
+
+        self._parked: dict[str, tuple[Node, list[Pod]]] = {}
+        self._parked_lock = threading.Lock()
 
     @property
     def gate(self) -> Optional[EvictionGate]:
@@ -56,12 +66,50 @@ class GateKeeper:
             open_ = False
         if open_:
             self._deferred.remove(name)
+            with self._parked_lock:
+                self._parked.pop(name, None)
             return True
         logger.info("eviction gate closed for node %s; deferring %s",
                     name, self._action)
+        with self._parked_lock:
+            self._parked[name] = (node, list(pods))
         if self._deferred.add(name):
             log_event(self._recorder, node, Event.NORMAL,
                       self._keys.event_reason,
                       f"{self._action.capitalize()} deferred: "
                       f"checkpoint/eviction gate not yet open")
         return False
+
+    def abandon_stale(self, still_wanted: "set[str]") -> None:
+        """Release parked nodes the upgrade flow no longer wants evicted.
+
+        Evaluating a stateful gate (e.g. ServingDrainGate) has side
+        effects — it flips endpoints to draining. If the flow then stops
+        wanting the node's pods gone (policy change, auto-upgrade
+        disabled, node vanished), nothing would ever re-open those
+        endpoints. The state manager calls this at the end of each pass
+        with the names still in an eviction-wanting state; any other
+        parked node is handed back to the gate's optional ``release``
+        hook and its one-shot deferral marker cleared.
+        """
+        with self._parked_lock:
+            stale = [n for n in self._parked if n not in still_wanted]
+        for name in stale:
+            with self._parked_lock:
+                parked = self._parked.pop(name, None)
+            if parked is None:
+                # an async gate evaluation opened (and un-parked) the
+                # node between the snapshot and now — nothing to release
+                continue
+            node, pods = parked
+            self._deferred.remove(name)
+            release = getattr(self._gate, "release", None)
+            if release is None:
+                continue
+            logger.info("eviction no longer wanted for node %s; "
+                        "releasing %s gate", name, self._action)
+            try:
+                release(node, pods)
+            except Exception as exc:  # noqa: BLE001 — gate boundary
+                logger.warning("gate release raised for node %s: %s",
+                               name, exc)

@@ -108,6 +108,11 @@ class PodManager:
     def set_eviction_gate(self, gate: Optional[EvictionGate]) -> None:
         self._gatekeeper.set_gate(gate)
 
+    def abandon_stale_gate_deferrals(self, still_wanted: "set[str]") -> None:
+        """Hand gate-parked nodes that left every eviction-wanting state
+        back to the gate's ``release`` hook (GateKeeper.abandon_stale)."""
+        self._gatekeeper.abandon_stale(still_wanted)
+
     # ------------------------------------------------------------------
     # (d) revision oracle
     # ------------------------------------------------------------------

@@ -405,6 +405,11 @@ class ClusterUpgradeStateManager:
             # no planning happens while disabled: previously reported
             # deferrals would otherwise go permanently stale
             self._clear_multislice_deferrals()
+            # ...and so would gate-side drain state: a stateful eviction
+            # gate (ServingDrainGate) flipped endpoints to draining when
+            # it parked the node; nothing is asking for those evictions
+            # any more, so hand every parked node back to the gate.
+            self._abandon_stale_gate_deferrals(set())
             return
 
         logger.info("node states: %s", {
@@ -439,7 +444,24 @@ class ClusterUpgradeStateManager:
         self.process_upgrade_failed_nodes(state)
         self.process_validation_required_nodes(state)
         self.process_uncordon_required_nodes(state)
+        # Gate-parked nodes that left every eviction-wanting state this
+        # pass (policy flipped drain off, node recovered or vanished) are
+        # handed back to the gate's release hook so e.g. serving
+        # endpoints it set draining resume admitting requests.
+        wanting = {
+            ns.node.metadata.name
+            for bucket in (UpgradeState.POD_DELETION_REQUIRED,
+                           UpgradeState.DRAIN_REQUIRED)
+            for ns in state.bucket(bucket)}
+        self._abandon_stale_gate_deferrals(wanting)
         logger.info("state manager finished processing")
+
+    def _abandon_stale_gate_deferrals(self, wanting: "set[str]") -> None:
+        # Both gatekeepers get the union of eviction-wanting names: a
+        # node moving pod-deletion -> drain (fallback) must not bounce
+        # its endpoints through release/re-drain in between.
+        self.pod_manager.abandon_stale_gate_deferrals(wanting)
+        self.drain_manager.abandon_stale_gate_deferrals(wanting)
 
     # ------------------------------------------------------------------
     # per-state processors
