@@ -98,7 +98,8 @@ def main() -> int:
 
     ours = availability("slice_watch")
     reference = availability("flat_interval")
-    measured = _measured_dispatch_cell(fleet, cells["slice_watch"])
+    measured = _measured_dispatch_cell(fleet, cells["slice_watch"],
+                                       headline_window=window)
     hardware = _hardware_capture()
     reconcile = _reconcile_latency_cells()
     straggler = _straggler_scenario()
@@ -1091,12 +1092,24 @@ def _read_sidecar() -> Optional[dict]:
         return None
 
 
-def _measured_dispatch_cell(fleet: "FleetSpec", modeled) -> dict:
+def _measured_dispatch_cell(fleet: "FleetSpec", modeled,
+                            headline_window: Optional[float] = None
+                            ) -> dict:
     """Round-3 VERDICT task 4: measure the packaged stack instead of
     modeling it. Runs the headline fleet through OperatorManager's real
     informer->workqueue->controller path (simulate_with_operator_stack)
     and reports measured dispatch latency plus parity against the
-    modeled slice_watch cell over a common window."""
+    modeled slice_watch cell over a common window.
+
+    Two availability figures, two windows (round-4 VERDICT task 7 —
+    they looked contradictory side by side): ``availability_pct`` /
+    ``availability_pct_over_window`` integrate over the measured run's
+    own duration (the parity denominator uses the same window, so
+    parity isolates dispatch-latency cost); ``availability_pct_over_
+    headline_window`` re-windows the identical downtime over the
+    matrix's common observation window (the slowest cell's duration),
+    which credits post-convergence uptime exactly like the headline
+    ``value`` — that is the number directly comparable to it."""
     from tpu_operator_libs.simulate import simulate_with_operator_stack
 
     try:
@@ -1116,6 +1129,9 @@ def _measured_dispatch_cell(fleet: "FleetSpec", modeled) -> dict:
     out["availability_pct_over_window"] = round(measured_over, 2)
     out["parity_vs_modeled"] = (round(measured_over / modeled_pct, 4)
                                 if modeled_pct else None)
+    if headline_window and headline_window > 0:
+        out["availability_pct_over_headline_window"] = round(
+            100.0 * (1.0 - downtime / max(headline_window, window)), 2)
     return out
 
 

@@ -135,6 +135,10 @@ class TestHTTPServer:
             f"http://localhost:{PORT}/nope", data=b"{}")
         with pytest.raises(urllib.error.HTTPError) as exc_info:
             urllib.request.urlopen(req)
+        # HTTPError owns the response socket: close it, or its later
+        # GC emits a ResourceWarning in whatever unrelated test is
+        # running at collection time (seen in test_bench)
+        exc_info.value.close()
         assert exc_info.value.code == 404
 
     def test_malformed_body_400(self, server):
@@ -143,4 +147,5 @@ class TestHTTPServer:
             headers={"Content-Type": "application/json"})
         with pytest.raises(urllib.error.HTTPError) as exc_info:
             urllib.request.urlopen(req)
+        exc_info.value.close()
         assert exc_info.value.code == 400

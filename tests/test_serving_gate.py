@@ -392,6 +392,35 @@ class TestGateReleaseWiring:
         assert keeper.allows(_node_stub(), []) is False
         keeper.abandon_stale(set())  # must not raise
 
+    def test_set_gate_replacement_releases_parked_nodes(self):
+        """Swapping (or clearing) the gate must hand parked nodes back
+        to the OUTGOING gate's release hook — abandon_stale can only
+        consult the current gate, so without this an old stateful
+        gate's drained endpoints would be stranded forever."""
+        from tpu_operator_libs.consts import UpgradeKeys
+        from tpu_operator_libs.upgrade.gate import GateKeeper
+
+        released = []
+
+        class Gate:
+            def __call__(self, node, pods):
+                return False
+
+            def release(self, node, pods):
+                released.append(node.metadata.name)
+
+        keeper = GateKeeper(UpgradeKeys(), None, "drain")
+        old = Gate()
+        keeper.set_gate(old)
+        assert keeper.allows(_node_stub(), []) is False
+        keeper.set_gate(None)  # gating disabled while a node is parked
+        assert released == ["n"]
+        # and installing the same gate again is not a release
+        keeper.set_gate(old)
+        assert keeper.allows(_node_stub(), []) is False
+        keeper.set_gate(old)
+        assert released == ["n"]
+
     def test_release_exception_does_not_propagate(self):
         from tpu_operator_libs.consts import UpgradeKeys
         from tpu_operator_libs.upgrade.gate import GateKeeper

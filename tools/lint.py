@@ -451,7 +451,16 @@ class FileChecker:
     def run(self) -> list[Finding]:
         self._collect_noqa()
         try:
-            tree = ast.parse(self.source, filename=str(self.path))
+            # Silence CPython's own SyntaxWarnings (e.g. invalid escape
+            # sequences) during the parse: W605 reports them as lint
+            # findings, and the raw warning leaking to stderr made every
+            # full-suite run emit `<source>:1: SyntaxWarning` from the
+            # W605 unit-test snippet.
+            import warnings
+
+            with warnings.catch_warnings():
+                warnings.simplefilter("ignore", SyntaxWarning)
+                tree = ast.parse(self.source, filename=str(self.path))
         except SyntaxError as exc:
             self.findings.append(Finding(
                 str(self.path), exc.lineno or 1, (exc.offset or 0) + 1,

@@ -1,0 +1,745 @@
+#!/usr/bin/env python3
+"""Wire-faithful Kubernetes apiserver double (for tools/wire_smoke.py).
+
+A real HTTP server (ThreadingHTTPServer on a TCP socket) implementing
+the REST subset the upgrade flow uses, **independently of the library's
+own FakeCluster** — the store is plain JSON dicts, the merge-patch is a
+fresh RFC 7386 implementation, the selector parser is its own ~30 lines
+— so driving the operator stack against it over sockets validates the
+framework's wire protocol (merge patches, eviction subresource
+semantics, LIST chunking, watch streaming, 404/409/429 mapping) against
+an implementation that shares no code with the thing under test.
+
+The real kube-apiserver + etcd binaries do not exist in this image (and
+there is no network egress to fetch them); this double plus
+``tools/kind_smoke.py`` (same artifact schema, runnable against any
+real cluster) is the closest attainable analogue of the reference's
+envtest setup (upgrade_suit_test.go:73-97 boots a real apiserver the
+same way this boots the double).
+
+Supported surface:
+
+- ``GET/PATCH /api/v1/nodes[/{name}]`` (merge-patch labels/annotations/
+  spec.unschedulable; null deletes a key)
+- ``GET/POST/DELETE /api/v1/namespaces/{ns}/pods[/{name}]`` and
+  all-namespace ``GET /api/v1/pods``
+- ``POST /api/v1/namespaces/{ns}/pods/{name}/eviction`` — policy/v1
+  checks: 404 unknown pod, 429 + DisruptionBudget cause when a PDB
+  would be violated (percent thresholds scale against the owning
+  DaemonSet's declared desiredNumberScheduled, like the disruption
+  controller's expectedPods), 201 otherwise
+- ``GET /apis/apps/v1/namespaces/{ns}/daemonsets`` /
+  ``controllerrevisions``
+- ``POST/PATCH /api/v1/namespaces/{ns}/events[/{name}]`` (409 on
+  duplicate create — exercising the client's POST->409->PATCH path)
+- LIST params: ``labelSelector`` (equality / set-based in / != /
+  exists / !key), ``fieldSelector`` (metadata.name, metadata.namespace,
+  spec.nodeName, status.phase), ``limit`` + ``continue`` chunking,
+  ``watch=true`` streaming (newline-delimited JSON events)
+
+Controller loops a real cluster would run (and kind would provide) are
+simulated with background threads in REAL time: the DaemonSet
+controller recreates deleted/evicted DS pods at the newest revision
+after ``recreate_delay_s``; the kubelet marks recreated pods Ready
+after ``ready_delay_s``.
+"""
+
+from __future__ import annotations
+
+import itertools
+import json
+import re
+import threading
+import time
+import urllib.parse
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from typing import Callable, Optional
+
+
+# ---------------------------------------------------------------------------
+# RFC 7386 JSON merge patch — independent implementation
+# ---------------------------------------------------------------------------
+
+def json_merge_patch(target, patch):
+    """Apply ``patch`` to ``target`` per RFC 7386 (null deletes)."""
+    if not isinstance(patch, dict):
+        return patch
+    out = dict(target) if isinstance(target, dict) else {}
+    for key, value in patch.items():
+        if value is None:
+            out.pop(key, None)
+        else:
+            out[key] = json_merge_patch(out.get(key), value)
+    return out
+
+
+# ---------------------------------------------------------------------------
+# label/field selectors — independent implementation
+# ---------------------------------------------------------------------------
+
+_SET_RE = re.compile(
+    r"^\s*(?P<key>[^\s!=,()]+)\s+(?P<op>in|notin)\s*"
+    r"\((?P<vals>[^)]*)\)\s*$")
+
+
+def match_label_selector(selector: str, labels: dict) -> bool:
+    if not selector:
+        return True
+    for requirement in _split_requirements(selector):
+        req = requirement.strip()
+        if not req:
+            continue
+        match = _SET_RE.match(req)
+        if match:
+            values = {v.strip() for v in match.group("vals").split(",")}
+            has = labels.get(match.group("key"))
+            ok = has in values
+            if match.group("op") == "notin":
+                ok = has is None or has not in values
+            if not ok:
+                return False
+        elif "!=" in req:
+            key, _, value = req.partition("!=")
+            if labels.get(key.strip()) == value.strip():
+                return False
+        elif "==" in req or "=" in req:
+            key, _, value = req.partition("==" if "==" in req else "=")
+            if labels.get(key.strip()) != value.strip():
+                return False
+        elif req.startswith("!"):
+            if req[1:].strip() in labels:
+                return False
+        else:
+            if req not in labels:
+                return False
+    return True
+
+
+def _split_requirements(selector: str) -> list[str]:
+    """Split on commas not inside parentheses."""
+    parts, depth, cur = [], 0, []
+    for ch in selector:
+        if ch == "(":
+            depth += 1
+        elif ch == ")":
+            depth -= 1
+        if ch == "," and depth == 0:
+            parts.append("".join(cur))
+            cur = []
+        else:
+            cur.append(ch)
+    parts.append("".join(cur))
+    return parts
+
+
+def pod_fields(obj: dict) -> dict:
+    meta = obj.get("metadata") or {}
+    return {
+        "metadata.name": meta.get("name", ""),
+        "metadata.namespace": meta.get("namespace", ""),
+        "spec.nodeName": (obj.get("spec") or {}).get("nodeName", ""),
+        "status.phase": (obj.get("status") or {}).get("phase", ""),
+    }
+
+
+def match_field_selector(selector: str, fields: dict) -> bool:
+    if not selector:
+        return True
+    for requirement in selector.split(","):
+        req = requirement.strip()
+        if not req:
+            continue
+        if "!=" in req:
+            key, _, value = req.partition("!=")
+            if fields.get(key.strip(), "") == value.strip():
+                return False
+        else:
+            key, _, value = req.partition("==" if "==" in req else "=")
+            if fields.get(key.strip(), "") != value.strip():
+                return False
+    return True
+
+
+# ---------------------------------------------------------------------------
+# the store
+# ---------------------------------------------------------------------------
+
+class WireStore:
+    """JSON-object store with resourceVersions, watches and the PDB
+    eviction check. Thread-safe (one lock; handler threads + controller
+    loops)."""
+
+    def __init__(self) -> None:
+        self._lock = threading.RLock()
+        self._rv = itertools.count(1)
+        self._uid = itertools.count(1)
+        # kind -> {(namespace, name): json-object}
+        self.objects: dict[str, dict[tuple, dict]] = {
+            kind: {} for kind in
+            ("nodes", "pods", "daemonsets", "controllerrevisions",
+             "events", "poddisruptionbudgets")}
+        self._watchers: list[tuple[str, "_WatchQueue"]] = []
+        self.request_log: list[str] = []
+        self.evictions_admitted = 0
+        self.evictions_blocked = 0
+
+    # -- primitives -------------------------------------------------------
+    def _bump(self, obj: dict) -> None:
+        meta = obj.setdefault("metadata", {})
+        meta["resourceVersion"] = str(next(self._rv))
+        if not meta.get("uid"):
+            meta["uid"] = f"wire-uid-{next(self._uid)}"
+
+    def put(self, kind: str, obj: dict,
+            event: Optional[str] = "ADDED") -> dict:
+        with self._lock:
+            meta = obj.setdefault("metadata", {})
+            key = (meta.get("namespace", ""), meta["name"])
+            self._bump(obj)
+            self.objects[kind][key] = obj
+            if event:
+                self._notify(kind, event, obj)
+            return obj
+
+    def get(self, kind: str, namespace: str, name: str) -> Optional[dict]:
+        with self._lock:
+            obj = self.objects[kind].get((namespace, name))
+            return json.loads(json.dumps(obj)) if obj else None
+
+    def delete(self, kind: str, namespace: str, name: str) -> bool:
+        with self._lock:
+            obj = self.objects[kind].pop((namespace, name), None)
+            if obj is None:
+                return False
+            self._notify(kind, "DELETED", obj)
+            return True
+
+    def patch(self, kind: str, namespace: str, name: str,
+              patch: dict) -> Optional[dict]:
+        with self._lock:
+            obj = self.objects[kind].get((namespace, name))
+            if obj is None:
+                return None
+            merged = json_merge_patch(obj, patch)
+            # metadata identity is immutable on the wire
+            merged.setdefault("metadata", {})["name"] = name
+            if namespace:
+                merged["metadata"]["namespace"] = namespace
+            merged["metadata"]["uid"] = obj["metadata"]["uid"]
+            self._bump(merged)
+            self.objects[kind][(namespace, name)] = merged
+            self._notify(kind, "MODIFIED", merged)
+            return json.loads(json.dumps(merged))
+
+    def list(self, kind: str, namespace: Optional[str],
+             label_selector: str, field_selector: str) -> list[dict]:
+        with self._lock:
+            out = []
+            for (ns, _), obj in sorted(self.objects[kind].items()):
+                if namespace is not None and ns != namespace:
+                    continue
+                labels = (obj.get("metadata") or {}).get("labels") or {}
+                if not match_label_selector(label_selector, labels):
+                    continue
+                if field_selector and not match_field_selector(
+                        field_selector, pod_fields(obj)):
+                    continue
+                out.append(json.loads(json.dumps(obj)))
+            return out
+
+    # -- watches ----------------------------------------------------------
+    def subscribe(self, kind: str) -> "_WatchQueue":
+        queue = _WatchQueue()
+        with self._lock:
+            self._watchers.append((kind, queue))
+        return queue
+
+    def unsubscribe(self, queue: "_WatchQueue") -> None:
+        with self._lock:
+            self._watchers = [(k, q) for k, q in self._watchers
+                              if q is not queue]
+
+    def _notify(self, kind: str, event: str, obj: dict) -> None:
+        snapshot = json.loads(json.dumps(obj))
+        for wkind, queue in list(self._watchers):
+            if wkind == kind:
+                queue.put({"type": event, "object": snapshot})
+
+    # -- eviction / PDB ---------------------------------------------------
+    def check_eviction(self, namespace: str, name: str) -> Optional[str]:
+        """None when admitted; a human-readable cause when a PDB blocks
+        it. policy/v1 semantics: percent thresholds scale against the
+        owning DaemonSet's declared count; evicting an unhealthy pod is
+        admitted while the budget holds (IfHealthyBudget)."""
+        with self._lock:
+            pod = self.objects["pods"].get((namespace, name))
+            if pod is None:
+                return None  # caller 404s first
+            pod_labels = (pod.get("metadata") or {}).get("labels") or {}
+            covering = [
+                pdb for (ns, _), pdb in
+                self.objects["poddisruptionbudgets"].items()
+                if ns == namespace and all(
+                    pod_labels.get(k) == v for k, v in
+                    ((pdb.get("spec") or {}).get("selector") or {})
+                    .get("matchLabels", {}).items())]
+            for pdb in covering:
+                spec = pdb.get("spec") or {}
+                selector = (spec.get("selector") or {}) \
+                    .get("matchLabels") or {}
+                matching = [
+                    p for (ns, _), p in self.objects["pods"].items()
+                    if ns == namespace and all(
+                        ((p.get("metadata") or {}).get("labels") or {})
+                        .get(k) == v for k, v in selector.items())]
+                healthy = sum(1 for p in matching if _pod_ready(p))
+                expected = max(len(matching),
+                               self._declared_count(matching))
+                threshold = spec.get("minAvailable")
+                if threshold is None and \
+                        spec.get("maxUnavailable") is not None:
+                    required = expected - _scaled(
+                        spec["maxUnavailable"], expected)
+                elif threshold is not None:
+                    required = _scaled(threshold, expected)
+                else:
+                    continue
+                delta = 1 if _pod_ready(pod) else 0
+                if healthy - delta < required:
+                    return (f"Cannot evict pod as it would violate the "
+                            f"pod's disruption budget: healthy="
+                            f"{healthy}, required={required}")
+            return None
+
+    def _declared_count(self, matching: list[dict]) -> int:
+        owners = set()
+        for pod in matching:
+            refs = (pod.get("metadata") or {}) \
+                .get("ownerReferences") or []
+            ctrl = next((r for r in refs if r.get("controller")), None)
+            if ctrl is None or ctrl.get("kind") != "DaemonSet":
+                return 0
+            owners.add((pod["metadata"].get("namespace", ""),
+                        ctrl.get("name")))
+        if len(owners) != 1:
+            return 0
+        ds = self.objects["daemonsets"].get(next(iter(owners)))
+        if ds is None:
+            return 0
+        return int((ds.get("status") or {})
+                   .get("desiredNumberScheduled") or 0)
+
+
+def _pod_ready(pod: dict) -> bool:
+    status = pod.get("status") or {}
+    containers = status.get("containerStatuses") or []
+    return (status.get("phase") == "Running" and bool(containers)
+            and all(c.get("ready") for c in containers))
+
+
+def _scaled(value, total: int) -> int:
+    if isinstance(value, str) and value.endswith("%"):
+        import math
+        return math.ceil(total * int(value[:-1]) / 100.0)
+    return int(value)
+
+
+class _WatchQueue:
+    def __init__(self) -> None:
+        import queue
+        self._q: "queue.Queue[dict]" = queue.Queue()
+
+    def put(self, event: dict) -> None:
+        self._q.put(event)
+
+    def get(self, timeout: float) -> Optional[dict]:
+        import queue
+        try:
+            return self._q.get(timeout=timeout)
+        except queue.Empty:
+            return None
+
+
+# ---------------------------------------------------------------------------
+# HTTP layer
+# ---------------------------------------------------------------------------
+
+_POD_RE = re.compile(r"^/api/v1/namespaces/([^/]+)/pods(?:/([^/]+))?$")
+_EVICT_RE = re.compile(r"^/api/v1/namespaces/([^/]+)/pods/([^/]+)/eviction$")
+_NODE_RE = re.compile(r"^/api/v1/nodes(?:/([^/]+))?$")
+_APPS_RE = re.compile(
+    r"^/apis/apps/v1/namespaces/([^/]+)/"
+    r"(daemonsets|controllerrevisions)(?:/([^/]+))?$")
+_EVENT_RE = re.compile(r"^/api/v1/namespaces/([^/]+)/events(?:/([^/]+))?$")
+
+
+class WireHandler(BaseHTTPRequestHandler):
+    protocol_version = "HTTP/1.1"
+    store: WireStore  # injected by serve()
+
+    # silence per-request stderr logging
+    def log_message(self, fmt, *args):  # noqa: A003
+        pass
+
+    # -- helpers ----------------------------------------------------------
+    def _send(self, code: int, payload: dict) -> None:
+        data = json.dumps(payload).encode()
+        self.send_response(code)
+        self.send_header("Content-Type", "application/json")
+        self.send_header("Content-Length", str(len(data)))
+        self.end_headers()
+        self.wfile.write(data)
+
+    def _status(self, code: int, reason: str, message: str,
+                details: Optional[dict] = None) -> None:
+        body = {"kind": "Status", "apiVersion": "v1", "status": "Failure",
+                "reason": reason, "message": message, "code": code}
+        if details:
+            body["details"] = details
+        self._send(code, body)
+
+    def _body(self) -> dict:
+        length = int(self.headers.get("Content-Length") or 0)
+        if not length:
+            return {}
+        try:
+            return json.loads(self.rfile.read(length))
+        except json.JSONDecodeError:
+            return {}
+
+    def _params(self) -> dict:
+        query = urllib.parse.urlsplit(self.path).query
+        return {k: v[0] for k, v in
+                urllib.parse.parse_qs(query).items()}
+
+    @property
+    def _path(self) -> str:
+        return urllib.parse.urlsplit(self.path).path
+
+    def _list_or_watch(self, kind: str, namespace: Optional[str],
+                       list_kind: str) -> None:
+        params = self._params()
+        if params.get("watch") in ("true", "1"):
+            return self._serve_watch(kind)
+        items = self.store.list(
+            kind, namespace, params.get("labelSelector", ""),
+            params.get("fieldSelector", ""))
+        # limit/continue chunking: the continue token is the offset —
+        # opaque to clients, like the apiserver's
+        offset = int(params.get("continue") or 0)
+        limit = int(params.get("limit") or 0)
+        meta: dict = {"resourceVersion": "0"}
+        if limit and offset + limit < len(items):
+            meta["continue"] = str(offset + limit)
+            page = items[offset:offset + limit]
+        else:
+            page = items[offset:] if offset else items
+        self._send(200, {"kind": list_kind, "apiVersion": "v1",
+                         "metadata": meta, "items": page})
+
+    def _serve_watch(self, kind: str) -> None:
+        queue = self.store.subscribe(kind)
+        try:
+            self.send_response(200)
+            self.send_header("Content-Type", "application/json")
+            self.send_header("Transfer-Encoding", "chunked")
+            self.end_headers()
+            while not getattr(self.server, "_shutting_down", False):
+                event = queue.get(timeout=0.5)
+                if event is None:
+                    continue
+                line = (json.dumps(event) + "\n").encode()
+                self.wfile.write(f"{len(line):x}\r\n".encode()
+                                 + line + b"\r\n")
+                self.wfile.flush()
+        except (BrokenPipeError, ConnectionResetError):
+            pass
+        finally:
+            self.store.unsubscribe(queue)
+
+    # -- verbs ------------------------------------------------------------
+    def do_GET(self) -> None:  # noqa: N802
+        path = self._path
+        self.store.request_log.append(f"GET {path}")
+        match = _NODE_RE.match(path)
+        if match:
+            if match.group(1):
+                obj = self.store.get("nodes", "", match.group(1))
+                if obj is None:
+                    return self._status(404, "NotFound", "node not found")
+                return self._send(200, obj)
+            return self._list_or_watch("nodes", None, "NodeList")
+        if path == "/api/v1/pods":
+            return self._list_or_watch("pods", None, "PodList")
+        match = _POD_RE.match(path)
+        if match:
+            namespace, name = match.group(1), match.group(2)
+            if name:
+                obj = self.store.get("pods", namespace, name)
+                if obj is None:
+                    return self._status(404, "NotFound", "pod not found")
+                return self._send(200, obj)
+            return self._list_or_watch("pods", namespace, "PodList")
+        match = _APPS_RE.match(path)
+        if match:
+            namespace, kind, name = match.groups()
+            if name:
+                obj = self.store.get(kind, namespace, name)
+                if obj is None:
+                    return self._status(404, "NotFound", f"{kind} not found")
+                return self._send(200, obj)
+            return self._list_or_watch(
+                kind, namespace,
+                "DaemonSetList" if kind == "daemonsets"
+                else "ControllerRevisionList")
+        match = _EVENT_RE.match(path)
+        if match and not match.group(2):
+            return self._list_or_watch("events", match.group(1),
+                                       "EventList")
+        self._status(404, "NotFound", f"unknown path {path}")
+
+    def do_PATCH(self) -> None:  # noqa: N802
+        path = self._path
+        self.store.request_log.append(f"PATCH {path}")
+        if self.headers.get("Content-Type") not in (
+                "application/merge-patch+json",
+                "application/strategic-merge-patch+json"):
+            return self._status(
+                415, "UnsupportedMediaType",
+                "only merge-patch content types are accepted")
+        body = self._body()
+        match = _NODE_RE.match(path)
+        if match and match.group(1):
+            out = self.store.patch("nodes", "", match.group(1), body)
+            if out is None:
+                return self._status(404, "NotFound", "node not found")
+            return self._send(200, out)
+        match = _POD_RE.match(path)
+        if match and match.group(2):
+            out = self.store.patch("pods", match.group(1),
+                                   match.group(2), body)
+            if out is None:
+                return self._status(404, "NotFound", "pod not found")
+            return self._send(200, out)
+        match = _EVENT_RE.match(path)
+        if match and match.group(2):
+            out = self.store.patch("events", match.group(1),
+                                   match.group(2), body)
+            if out is None:
+                return self._status(404, "NotFound", "event not found")
+            return self._send(200, out)
+        self._status(404, "NotFound", f"unknown path {path}")
+
+    def do_POST(self) -> None:  # noqa: N802
+        path = self._path
+        self.store.request_log.append(f"POST {path}")
+        match = _EVICT_RE.match(path)
+        if match:
+            namespace, name = match.groups()
+            if self.store.get("pods", namespace, name) is None:
+                return self._status(404, "NotFound", "pod not found")
+            cause = self.store.check_eviction(namespace, name)
+            if cause is not None:
+                self.store.evictions_blocked += 1
+                return self._status(
+                    429, "TooManyRequests", cause,
+                    details={"causes": [{"reason": "DisruptionBudget"}]})
+            self.store.evictions_admitted += 1
+            self.store.delete("pods", namespace, name)
+            return self._send(201, {"kind": "Status", "status": "Success"})
+        match = _EVENT_RE.match(path)
+        if match and not match.group(2):
+            namespace = match.group(1)
+            body = self._body()
+            name = (body.get("metadata") or {}).get("name") or ""
+            if self.store.get("events", namespace, name) is not None:
+                return self._status(
+                    409, "AlreadyExists",
+                    f"events \"{name}\" already exists")
+            body.setdefault("metadata", {})["namespace"] = namespace
+            return self._send(201, self.store.put("events", body,
+                                                  event=None))
+        match = _POD_RE.match(path)
+        if match and not match.group(2):
+            body = self._body()
+            body.setdefault("metadata", {})["namespace"] = match.group(1)
+            return self._send(201, self.store.put("pods", body))
+        self._status(404, "NotFound", f"unknown path {path}")
+
+    def do_DELETE(self) -> None:  # noqa: N802
+        path = self._path
+        self.store.request_log.append(f"DELETE {path}")
+        match = _POD_RE.match(path)
+        if match and match.group(2):
+            if not self.store.delete("pods", match.group(1),
+                                     match.group(2)):
+                return self._status(404, "NotFound", "pod not found")
+            return self._send(200, {"kind": "Status", "status": "Success"})
+        self._status(404, "NotFound", f"unknown path {path}")
+
+
+# ---------------------------------------------------------------------------
+# controller simulations (what kind's control plane would run)
+# ---------------------------------------------------------------------------
+
+class ControllerSim:
+    """DS controller + kubelet loops in real time over the WireStore."""
+
+    def __init__(self, store: WireStore, recreate_delay_s: float = 0.3,
+                 ready_delay_s: float = 0.3) -> None:
+        self.store = store
+        self.recreate_delay = recreate_delay_s
+        self.ready_delay = ready_delay_s
+        self._stop = threading.Event()
+        self._pending: list[tuple[float, Callable[[], None]]] = []
+        self._lock = threading.Lock()
+        self._thread = threading.Thread(target=self._run, daemon=True,
+                                        name="wire-controller-sim")
+        # pod key -> (ds_name, node) for every live DS-owned pod, so a
+        # vanished key can be re-scheduled without parsing pod names
+        self._ds_pods: dict[tuple, tuple[str, str]] = {}
+
+    def start(self) -> None:
+        self._track_ds_pods()
+        self._thread.start()
+
+    def _track_ds_pods(self) -> set[tuple]:
+        with self.store._lock:
+            live = set(self.store.objects["pods"])
+            for key, pod in self.store.objects["pods"].items():
+                refs = (pod.get("metadata") or {}) \
+                    .get("ownerReferences") or []
+                ctrl = next((r for r in refs if r.get("controller")),
+                            None)
+                if ctrl is not None and ctrl.get("kind") == "DaemonSet":
+                    self._ds_pods[key] = (
+                        ctrl.get("name", ""),
+                        (pod.get("spec") or {}).get("nodeName", ""))
+        return live
+
+    def stop(self) -> None:
+        self._stop.set()
+        self._thread.join(timeout=5.0)
+
+    def _run(self) -> None:
+        while not self._stop.is_set():
+            self._reconcile_once()
+            now = time.monotonic()
+            with self._lock:
+                due = [fn for at, fn in self._pending if at <= now]
+                self._pending = [(at, fn) for at, fn in self._pending
+                                 if at > now]
+            for fn in due:
+                fn()
+            time.sleep(0.05)
+
+    def _schedule(self, delay: float, fn: Callable[[], None]) -> None:
+        with self._lock:
+            self._pending.append((time.monotonic() + delay, fn))
+
+    def _newest_revision_hash(self, namespace: str, ds_name: str) -> str:
+        with self.store._lock:
+            revisions = [
+                obj for (ns, _), obj in
+                self.store.objects["controllerrevisions"].items()
+                if ns == namespace and any(
+                    r.get("name") == ds_name for r in
+                    (obj.get("metadata") or {})
+                    .get("ownerReferences") or [])]
+        if not revisions:
+            return "none"
+        newest = max(revisions, key=lambda r: int(r.get("revision") or 0))
+        return newest["metadata"]["name"].rsplit("-", 1)[-1]
+
+    def _reconcile_once(self) -> None:
+        """Recreate DS pods that vanished (evicted/deleted). DS pods
+        tolerate the cordon taint, so recreation ignores
+        unschedulable — the same behavior a kind control plane shows."""
+        live = self._track_ds_pods()
+        gone = [key for key in self._ds_pods if key not in live]
+        with self.store._lock:
+            daemon_sets = {key: json.loads(json.dumps(ds)) for key, ds
+                           in self.store.objects["daemonsets"].items()}
+        for key in gone:
+            namespace, _ = key
+            ds_name, node = self._ds_pods.pop(key)
+            ds = daemon_sets.get((namespace, ds_name))
+            if ds is None or not node:
+                continue
+            self._schedule(
+                self.recreate_delay,
+                lambda ns=namespace, name=ds_name, node=node, ds=ds:
+                self._recreate(ns, name, node, ds))
+
+    def _recreate(self, namespace: str, ds_name: str, node: str,
+                  ds: dict) -> None:
+        rev = self._newest_revision_hash(namespace, ds_name)
+        labels = dict(((ds.get("spec") or {}).get("selector") or {})
+                      .get("matchLabels") or {})
+        name = f"{ds_name}-{node}"  # deterministic per (ds, node)
+        labels["controller-revision-hash"] = rev  # DS pods carry it as
+        pod = {                                   # a LABEL, like the DS
+            "metadata": {                         # controller sets it
+                "name": name, "namespace": namespace,
+                "labels": labels,
+                "ownerReferences": [{
+                    "kind": "DaemonSet", "name": ds_name,
+                    "uid": (ds.get("metadata") or {}).get("uid", ""),
+                    "controller": True}],
+            },
+            "spec": {"nodeName": node},
+            "status": {"phase": "Pending", "containerStatuses": [
+                {"name": "runtime", "ready": False, "restartCount": 0}]},
+        }
+        self.store.put("pods", pod)
+        self._ds_pods[(namespace, name)] = (ds_name, node)
+        self._schedule(self.ready_delay,
+                       lambda: self._mark_ready(namespace, name))
+
+    def _mark_ready(self, namespace: str, name: str) -> None:
+        self.store.patch("pods", namespace, name, {"status": {
+            "phase": "Running",
+            "containerStatuses": [{"name": "runtime", "ready": True,
+                                   "restartCount": 0}]}})
+
+
+# ---------------------------------------------------------------------------
+# server lifecycle
+# ---------------------------------------------------------------------------
+
+class WireApiServer:
+    """ThreadingHTTPServer wrapper bound to 127.0.0.1:<ephemeral>."""
+
+    def __init__(self, store: Optional[WireStore] = None) -> None:
+        self.store = store or WireStore()
+        handler = type("BoundWireHandler", (WireHandler,),
+                       {"store": self.store})
+        self.httpd = ThreadingHTTPServer(("127.0.0.1", 0), handler)
+        self.httpd.daemon_threads = True
+        self._thread = threading.Thread(
+            target=self.httpd.serve_forever, daemon=True,
+            name="wire-apiserver")
+
+    @property
+    def url(self) -> str:
+        host, port = self.httpd.server_address[:2]
+        return f"http://{host}:{port}"
+
+    def start(self) -> "WireApiServer":
+        self._thread.start()
+        return self
+
+    def stop(self) -> None:
+        self.httpd._shutting_down = True  # type: ignore[attr-defined]
+        self.httpd.shutdown()
+        self.httpd.server_close()
+
+
+if __name__ == "__main__":
+    server = WireApiServer().start()
+    print(f"wire apiserver on {server.url} (ctrl-c to stop)")
+    try:
+        while True:
+            time.sleep(1.0)
+    except KeyboardInterrupt:
+        server.stop()

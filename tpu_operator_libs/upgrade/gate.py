@@ -50,7 +50,31 @@ class GateKeeper:
         return self._gate
 
     def set_gate(self, gate: Optional[EvictionGate]) -> None:
+        """Install (or clear) the gate. Nodes still parked against the
+        OUTGOING gate are handed back to ITS release hook first —
+        replacing a stateful gate (or disabling gating) must not strand
+        endpoints the old gate flipped to draining, because
+        abandon_stale can only consult the current gate."""
+        if gate is not self._gate and self._gate is not None:
+            self._release_all(self._gate)
         self._gate = gate
+
+    def _release_all(self, gate: EvictionGate) -> None:
+        with self._parked_lock:
+            parked = list(self._parked.items())
+            self._parked.clear()
+        release = getattr(gate, "release", None)
+        for name, (node, pods) in parked:
+            self._deferred.remove(name)
+            if release is None:
+                continue
+            logger.info("gate replaced; releasing %s deferral for "
+                        "node %s", self._action, name)
+            try:
+                release(node, pods)
+            except Exception as exc:  # noqa: BLE001 — gate boundary
+                logger.warning("gate release raised for node %s: %s",
+                               name, exc)
 
     def allows(self, node: Node, pods: list[Pod]) -> bool:
         """True when the gate is absent or open. On False the caller must
