@@ -233,7 +233,7 @@ def train(checkpoint_dir: str, max_steps: int = 100,
                 # blocking save: once save() returns the step is
                 # committed, which is exactly what the operator's gate
                 # checks for
-                manager.save(done, args=_save_args(state))
+                manager.save(done, args=save_args(state))
                 manager.wait_until_finished()
                 logger.info("step %d: loss %.5f (checkpoint committed)",
                             done, float(loss))
@@ -244,7 +244,7 @@ def train(checkpoint_dir: str, max_steps: int = 100,
             "loss": None if loss is None else float(loss)}
 
 
-def _save_args(state):
+def save_args(state):
     import orbax.checkpoint as ocp
 
     return ocp.args.StandardSave(state)
