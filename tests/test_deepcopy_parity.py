@@ -1,0 +1,172 @@
+"""Generative drift check for every hand-written deep_copy / clone.
+
+The reference GENERATES its deepcopy code (zz_generated.deepcopy.go:29-69
+via controller-gen) and its mocks (mockery), so a new struct field can
+never be silently missed — the generator re-walks the type. This
+build's deep_copy/clone methods are hand-written; this module recovers
+the generator's guarantee mechanically:
+
+- every ``@dataclass`` with a ``deep_copy`` or ``clone`` method is
+  DISCOVERED from its module (not enumerated by hand), so new types
+  join the check automatically;
+- instances are built by filling every field generatively from its
+  type (so a field added tomorrow is exercised without touching this
+  file);
+- the copy must be (a) value-equal field-by-field, (b) deeply
+  independent: mutating every mutable leaf of the copy must leave the
+  original unchanged.
+
+A hand-written copy that misses a newly added field fails (a) when the
+fill makes the field non-default, exactly like stale generated code
+failing a re-generation diff.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import enum
+import inspect
+import typing
+
+import pytest
+
+from tpu_operator_libs.api import unified_policy, upgrade_policy
+from tpu_operator_libs.k8s import objects
+
+
+def _copy_method(cls) -> str | None:
+    for name in ("deep_copy", "clone"):
+        if name in vars(cls):
+            return name
+    return None
+
+
+def _discover(module) -> list[tuple[type, str]]:
+    out = []
+    for _, cls in inspect.getmembers(module, inspect.isclass):
+        if cls.__module__ != module.__name__:
+            continue
+        if not dataclasses.is_dataclass(cls):
+            continue
+        method = _copy_method(cls)
+        if method:
+            out.append((cls, method))
+    return out
+
+
+CASES = (_discover(upgrade_policy) + _discover(unified_policy)
+         + _discover(objects))
+
+
+def _unwrap_optional(tp):
+    origin = typing.get_origin(tp)
+    if origin is typing.Union:
+        args = [a for a in typing.get_args(tp) if a is not type(None)]
+        if len(args) == 1:
+            return args[0]
+    return tp
+
+
+def _value_for(tp, depth: int, salt: int):
+    """A non-default, recognizable value of (roughly) type ``tp``."""
+    tp = _unwrap_optional(tp)
+    origin = typing.get_origin(tp)
+    if origin in (list,):
+        (item,) = typing.get_args(tp) or (str,)
+        return [_value_for(item, depth + 1, salt)]
+    if origin in (dict,):
+        args = typing.get_args(tp) or (str, str)
+        return {_value_for(args[0], depth + 1, salt):
+                _value_for(args[1], depth + 1, salt)}
+    if tp is dict:  # bare dict annotation (e.g. PDB.selector)
+        return {f"k{salt}": f"v{salt}"}
+    if tp is list:
+        return [f"item{salt}"]
+    if tp is bool:
+        return True
+    if tp is int:
+        return 7 + salt
+    if tp is float:
+        return 3.5 + salt
+    if tp is str:
+        return f"gen-{salt}"
+    if tp is object:
+        return "25%"  # IntOrString-style fields accept percents
+    if isinstance(tp, type) and issubclass(tp, enum.Enum):
+        return list(tp)[-1]
+    if dataclasses.is_dataclass(tp):
+        if depth > 4:
+            return None
+        return _build(tp, depth + 1, salt)
+    return f"gen-{salt}"
+
+
+def _build(cls, depth: int = 0, salt: int = 0):
+    """Instance with EVERY field set generatively (never the default)."""
+    hints = typing.get_type_hints(cls)
+    kwargs = {}
+    for field in dataclasses.fields(cls):
+        if not field.init:
+            continue
+        kwargs[field.name] = _value_for(hints[field.name], depth,
+                                        salt + len(kwargs))
+    return cls(**kwargs)
+
+
+def _mutable_leaves(obj, path=""):
+    """(path, container) pairs for every mutable container reachable."""
+    if dataclasses.is_dataclass(obj) and not isinstance(obj, type):
+        for field in dataclasses.fields(obj):
+            yield from _mutable_leaves(getattr(obj, field.name),
+                                       f"{path}.{field.name}")
+    elif isinstance(obj, list):
+        yield path, obj
+        for i, item in enumerate(obj):
+            yield from _mutable_leaves(item, f"{path}[{i}]")
+    elif isinstance(obj, dict):
+        yield path, obj
+        for key, value in obj.items():
+            yield from _mutable_leaves(value, f"{path}[{key!r}]")
+
+
+@pytest.mark.parametrize(
+    "cls,method", CASES, ids=[c.__name__ for c, _ in CASES])
+class TestDeepCopyParity:
+    def test_every_field_value_equal(self, cls, method):
+        original = _build(cls)
+        copy = getattr(original, method)()
+        assert type(copy) is cls
+        for field in dataclasses.fields(cls):
+            got = getattr(copy, field.name)
+            want = getattr(original, field.name)
+            assert got == want, (
+                f"{cls.__name__}.{method} dropped/changed field "
+                f"{field.name!r}: {got!r} != {want!r} — a new field "
+                f"was probably added without updating {method}()")
+
+    def test_copy_is_deeply_independent(self, cls, method):
+        original = _build(cls)
+        copy = getattr(original, method)()
+        baseline = _build(cls)  # same generative values, for comparison
+        for path, container in _mutable_leaves(copy):
+            if isinstance(container, list):
+                container.append("mutated")
+            else:
+                container["__mutated__"] = "mutated"
+        for field in dataclasses.fields(cls):
+            assert getattr(original, field.name) == \
+                getattr(baseline, field.name), (
+                f"mutating the copy leaked into the original at "
+                f"{cls.__name__}.{field.name} — {method}() shares a "
+                f"mutable container")
+
+
+def test_known_families_are_covered():
+    names = {cls.__name__ for cls, _ in CASES}
+    # the contract the reference generates code for (api/ specs) plus
+    # the wire objects the fake/real/http backends clone
+    for expected in ("UpgradePolicySpec", "DrainSpec", "PodDeletionSpec",
+                     "WaitForCompletionSpec", "Node", "Pod", "DaemonSet",
+                     "ControllerRevision", "ObjectMeta",
+                     "PodDisruptionBudget", "Lease"):
+        assert expected in names, f"{expected} lost its copy method"

@@ -104,13 +104,37 @@ def render(capture: dict) -> str:
     lines = [START, "", "| metric | value |", "|---|---|"]
     lines += [f"| {k} | {v} |" for k, v in rows]
     if capture.get("tpu_unreachable"):
-        lines += ["",
-                  "*Hardware/model cells are null in this capture: the "
-                  "chip was unreachable (`tpu_unreachable_reason` in "
-                  "the JSON); the sidecar's newest real measurements "
-                  "ride along under `hardware_last_good` and "
-                  "`model_last_good`, marked stale. Re-capture when "
-                  "the tunnel recovers.*"]
+        notes = ["", "*The chip was unreachable at capture time "
+                     "(`tpu_unreachable_reason` + the most recent probe "
+                     "attempts — a 50-entry rolling window — are in "
+                     "the JSON).*"]
+        if capture.get("hardware_capture_mode") == "recent":
+            notes += [
+                "", "*Roofline (MXU/HBM/ICI) cells above are a "
+                    "promoted RECENT machine-written capture — "
+                    f"`hardware_captured_at` "
+                    f"{capture.get('hardware_captured_at')}, age "
+                    f"{capture.get('hardware_capture_age_s')} s at "
+                    "bench time (`hardware_capture_mode: recent`).*"]
+        else:
+            notes += ["", "*Roofline cells are null; the newest real "
+                          "measurements ride along under "
+                          "`hardware_last_good`, marked stale.*"]
+        if capture.get("model_capture_mode") == "recent":
+            notes += [
+                "", "*Train/decode/long-context cells are a promoted "
+                    "RECENT machine-written capture "
+                    f"(`model_captured_at` "
+                    f"{capture.get('model_captured_at')}, age "
+                    f"{capture.get('model_capture_age_s')} s).*"]
+        elif capture.get("train_mfu_pct") is None:
+            notes += ["", "*Train/decode/long-context cells are null; "
+                          "the newest real model measurements ride "
+                          "along under `model_last_good` (provenance "
+                          "in its `source` field — hand-seeded blocks "
+                          "are never promoted into the cells above). "
+                          "Re-capture when the tunnel recovers.*"]
+        lines += notes
     lines += ["", END]
     return "\n".join(lines)
 
