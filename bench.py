@@ -156,8 +156,39 @@ def main() -> int:
     result.update(hardware)
     result.update(_model_capture(hardware))
     _promote_recent(result)
+    result.update(_decode_roofline(result))
     print(json.dumps(result))
     return 0
+
+
+def _decode_roofline(result: dict) -> dict:
+    """decode_roofline_pct / decode_int8_roofline_pct: measured decode
+    throughput as a percentage of the weight-stream bound.
+
+    Greedy decode at small batch is memory-bound on streaming the
+    weights once per step: the ceiling is ``batch / (weight_bytes /
+    measured_HBM_rate)`` tokens/s (docs/benchmarks.md "decode" cell).
+    Using the chip's MEASURED stream rate (not the paper peak) makes
+    the percentage attribute the remaining gap to the decode path
+    itself — KV-cache traffic and attention work — not to HBM
+    turbulence. int8 halves the weight bytes (per-channel scales are
+    <1% extra), so its bound is ~2x bf16's."""
+    out = {"decode_roofline_pct": None, "decode_int8_roofline_pct": None}
+    params_m = result.get("train_params_m")
+    batch = result.get("decode_batch")
+    hbm = result.get("hbm_gbytes_per_s")
+    if not (params_m and batch and hbm):
+        return out
+    bf16_bytes = params_m * 1e6 * 2.0
+    bound_bf16 = batch * hbm * 1e9 / bf16_bytes
+    bound_int8 = batch * hbm * 1e9 / (bf16_bytes / 2.0)
+    if result.get("decode_tok_s"):
+        out["decode_roofline_pct"] = round(
+            100.0 * result["decode_tok_s"] / bound_bf16, 1)
+    if result.get("decode_int8_tok_s"):
+        out["decode_int8_roofline_pct"] = round(
+            100.0 * result["decode_int8_tok_s"] / bound_int8, 1)
+    return out
 
 
 def _age_s(captured_at) -> Optional[float]:

@@ -586,3 +586,26 @@ class TestPromoteRecent:
         assert age is not None and age < 60
         assert bench._age_s(None) is None
         assert bench._age_s("nope") is None
+
+
+class TestDecodeRoofline:
+    """decode_roofline_pct: measured decode vs the weight-stream bound
+    computed from the chip's MEASURED HBM rate (docs/benchmarks.md)."""
+
+    def test_decode_roofline_pct(self):
+        out = bench._decode_roofline({
+            "train_params_m": 276.8, "decode_batch": 8,
+            "hbm_gbytes_per_s": 560.6, "decode_tok_s": 5264,
+            "decode_int8_tok_s": 9000})
+        # bound = 8 * 560.6e9 / (276.8e6 * 2) ~ 8101 tok/s
+        assert out["decode_roofline_pct"] == pytest.approx(65.0, abs=0.5)
+        # int8 bound is 2x: 9000 / 16202 ~ 55.5%
+        assert out["decode_int8_roofline_pct"] == pytest.approx(
+            55.5, abs=0.5)
+
+    def test_decode_roofline_null_without_inputs(self):
+        assert bench._decode_roofline({})["decode_roofline_pct"] is None
+        out = bench._decode_roofline({
+            "train_params_m": 276.8, "decode_batch": 8,
+            "hbm_gbytes_per_s": None, "decode_tok_s": 5264})
+        assert out["decode_roofline_pct"] is None

@@ -92,9 +92,13 @@ def render(capture: dict) -> str:
         (f"greedy decode (fused on-device loop, batch "
          f"{fmt(capture.get('decode_batch'))}, ctx "
          f"{fmt(capture.get('decode_ctx'))})",
-         fmt(capture.get("decode_tok_s"), "{} tok/s")),
+         f"{fmt(capture.get('decode_tok_s'), '{} tok/s')} = "
+         f"{fmt(capture.get('decode_roofline_pct'), '{} %')} of the "
+         "weight-stream roofline"),
         ("greedy decode, int8 weight-only quantized",
-         fmt(capture.get("decode_int8_tok_s"), "{} tok/s")),
+         f"{fmt(capture.get('decode_int8_tok_s'), '{} tok/s')} = "
+         f"{fmt(capture.get('decode_int8_roofline_pct'), '{} %')} of "
+         "its (2× higher) roofline"),
         ("seq-8192 forward, flash vs XLA attention",
          f"{fmt(capture.get('flash_attention_speedup'), '{}×')} "
          f"({fmt(flash, '{}')} vs {fmt(xla, '{}')} ms)"),
@@ -103,11 +107,17 @@ def render(capture: dict) -> str:
     ]
     lines = [START, "", "| metric | value |", "|---|---|"]
     lines += [f"| {k} | {v} |" for k, v in rows]
+    # Provenance notes. The model notes are NOT gated on
+    # tpu_unreachable: a live roofline with a failed model probe still
+    # promotes (or nulls) the train/decode cells, and "nothing is
+    # promoted silently" (bench._promote_recent) must hold in the
+    # rendered table too, not just the JSON.
+    notes: list = []
     if capture.get("tpu_unreachable"):
-        notes = ["", "*The chip was unreachable at capture time "
-                     "(`tpu_unreachable_reason` + the most recent probe "
-                     "attempts — a 50-entry rolling window — are in "
-                     "the JSON).*"]
+        notes += ["", "*The chip was unreachable at capture time "
+                      "(`tpu_unreachable_reason` + the most recent "
+                      "probe attempts — a 50-entry rolling window — "
+                      "are in the JSON).*"]
         if capture.get("hardware_capture_mode") == "recent":
             notes += [
                 "", "*Roofline (MXU/HBM/ICI) cells above are a "
@@ -120,21 +130,23 @@ def render(capture: dict) -> str:
             notes += ["", "*Roofline cells are null; the newest real "
                           "measurements ride along under "
                           "`hardware_last_good`, marked stale.*"]
-        if capture.get("model_capture_mode") == "recent":
-            notes += [
-                "", "*Train/decode/long-context cells are a promoted "
-                    "RECENT machine-written capture "
-                    f"(`model_captured_at` "
-                    f"{capture.get('model_captured_at')}, age "
-                    f"{capture.get('model_capture_age_s')} s).*"]
-        elif capture.get("train_mfu_pct") is None:
-            notes += ["", "*Train/decode/long-context cells are null; "
-                          "the newest real model measurements ride "
-                          "along under `model_last_good` (provenance "
-                          "in its `source` field — hand-seeded blocks "
-                          "are never promoted into the cells above). "
-                          "Re-capture when the tunnel recovers.*"]
-        lines += notes
+    if capture.get("model_capture_mode") == "recent":
+        notes += [
+            "", "*Train/decode/long-context cells are a promoted "
+                "RECENT machine-written capture "
+                f"(`model_captured_at` "
+                f"{capture.get('model_captured_at')}, age "
+                f"{capture.get('model_capture_age_s')} s).*"]
+    elif capture.get("train_mfu_pct") is None:
+        notes += ["", "*Train/decode/long-context cells are null "
+                      f"(`train_probe_skipped_reason`: "
+                      f"{capture.get('train_probe_skipped_reason')!r}); "
+                      "the newest real model measurements ride along "
+                      "under `model_last_good` (provenance in its "
+                      "`source` field — hand-seeded blocks are never "
+                      "promoted into the cells above). Re-capture when "
+                      "the tunnel recovers.*"]
+    lines += notes
     lines += ["", END]
     return "\n".join(lines)
 
