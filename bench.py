@@ -494,16 +494,27 @@ try:
     LAYERS = int(os.environ.get("BENCH_MODEL_LAYERS", "4"))
     SEQ = int(os.environ.get("BENCH_MODEL_SEQ", "1024"))
     BATCH = int(os.environ.get("BENCH_MODEL_BATCH", "16"))
+    # A/B lever for the MFU push: remat the layers (recompute
+    # activations on backward) so BATCH can grow on the same HBM.
+    # Counts as an override — experiments never persist as last-good;
+    # if a remat+bigger-batch protocol wins, flip the defaults in code.
+    _remat_raw = os.environ.get("BENCH_MODEL_REMAT", "")
+    if _remat_raw.lower() not in ("", "0", "1", "false", "true",
+                                  "no", "yes"):
+        raise SystemExit(
+            f"BENCH_MODEL_REMAT={_remat_raw!r}: use 1/0")
+    REMAT = _remat_raw.lower() in ("1", "true", "yes")
     overridden = any(os.environ.get(k) for k in (
         "BENCH_MODEL_D", "BENCH_MODEL_LAYERS", "BENCH_MODEL_SEQ",
-        "BENCH_MODEL_BATCH", "BENCH_MODEL_LONG_SEQ"))
+        "BENCH_MODEL_BATCH", "BENCH_MODEL_LONG_SEQ",
+        "BENCH_MODEL_REMAT"))
 
     device = jax.devices()[0]
     mesh = Mesh(np.array([device]).reshape(1, 1), ("dp", "tp"))
     cfg = LlamaConfig(vocab=D, d_model=D, n_layers=LAYERS,
                       n_heads=max(1, D // 128),
                       n_kv_heads=max(1, D // 128), d_ff=4 * D,
-                      seq_len=SEQ, learning_rate=1e-4)
+                      seq_len=SEQ, learning_rate=1e-4, remat=REMAT)
     params = init_llama_params(mesh, cfg, param_dtype=jnp.bfloat16)
     # Long-context cell: forward loss at BENCH_MODEL_LONG_SEQ, XLA
     # einsum attention vs the Pallas flash kernel (TPU only — the

@@ -122,6 +122,32 @@ class TestModelSemantics:
                                config)
         assert jnp.isfinite(loss)
 
+    def test_remat_matches_forward_and_gradients(self):
+        """remat=True must change what the backward pass KEEPS, not the
+        math: forward logits equal, and the train-step gradients (via
+        one step's loss) equal the non-remat run to float tolerance."""
+        import dataclasses
+
+        mesh = make_mesh()
+        config = LlamaConfig()
+        config_remat = dataclasses.replace(config, remat=True)
+        params = init_llama_params(mesh, config)
+        tokens = make_token_batch(mesh, 0, config)
+        np.testing.assert_allclose(
+            np.array(forward(params, tokens, config)),
+            np.array(forward(params, tokens, config_remat)),
+            rtol=1e-6, atol=1e-6)
+        grads_plain = jax.grad(
+            lambda p: next_token_loss(p, tokens, config))(params)
+        grads_remat = jax.grad(
+            lambda p: next_token_loss(p, tokens, config_remat))(params)
+        flat_a = jax.tree.leaves(grads_plain)
+        flat_b = jax.tree.leaves(grads_remat)
+        assert len(flat_a) == len(flat_b)
+        for a, b in zip(flat_a, flat_b):
+            np.testing.assert_allclose(np.array(a), np.array(b),
+                                       rtol=1e-5, atol=1e-6)
+
     def test_learns_the_synthetic_rule(self):
         """Loss on the affine next-token rule must drop decisively —
         the whole pipeline (RoPE, attention, SwiGLU, adamw) is live."""
