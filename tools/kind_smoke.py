@@ -34,6 +34,7 @@ cordoned mid-upgrade.
 from __future__ import annotations
 
 import argparse
+import json
 import os
 import subprocess
 import sys
@@ -81,6 +82,44 @@ spec:
 """
 
 
+#: Shared artifact schema with tools/wire_smoke.py — one format for
+#: "the upgrade ran against an apiserver", whether the in-image wire
+#: double or a genuine cluster (tools/smoke_common.py owns it so the
+#: writers cannot drift). Wire-only diagnostic keys the real apiserver
+#: cannot report (server-side eviction counters, request log) are
+#: null here.
+from smoke_common import SCHEMA, event_row  # noqa: E402
+
+
+def build_artifact(*, converged: bool, duration_s: float,
+                   timeline: list, final_node_states: dict,
+                   final_runtime_revisions: dict, events: list,
+                   context: str, n_nodes: int) -> dict:
+    """Assemble the committed-evidence JSON (same schema as
+    tools/wire_smoke.py's run_smoke; pure so it is testable without a
+    cluster)."""
+    return {
+        "schema": SCHEMA,
+        "captured_at": time.strftime("%Y-%m-%dT%H:%M:%SZ",
+                                     time.gmtime()),
+        "server": {"impl": "real-apiserver (kind or any cluster)",
+                   "transport": f"kubeconfig context {context}",
+                   "independent_of_fakecluster": True},
+        "client": "tpu_operator_libs.k8s.real.RealCluster",
+        "fleet": {"nodes": n_nodes, "runtime_ds": "libtpu-smoke",
+                  "workload_pdb": None},
+        "converged": bool(converged),
+        "duration_s": round(duration_s, 2),
+        "label_timeline": timeline,
+        "final_node_states": final_node_states,
+        "final_runtime_revisions": final_runtime_revisions,
+        "events": events,
+        # server-side counters only the wire double can report
+        "evictions": None,
+        "http_requests": None,
+    }
+
+
 def sh(*args: str) -> str:
     proc = subprocess.run(args, capture_output=True, text=True)
     if proc.returncode != 0:
@@ -106,6 +145,9 @@ def main() -> int:
                         help="seconds to wait for the upgrade to finish")
     parser.add_argument("--keep", action="store_true",
                         help="leave the smoke namespace in place")
+    parser.add_argument("--out", default=None,
+                        help="write the run artifact JSON here (same "
+                             "schema as docs/wire_smoke_run.json)")
     args = parser.parse_args()
     ctx = args.context or sh(
         "kubectl", "config", "current-context").strip()
@@ -162,8 +204,12 @@ def main() -> int:
 
     node_names = [n.metadata.name for n in client.list_nodes()]
     print(f"kind_smoke: upgrading nodes: {node_names}")
-    deadline = time.monotonic() + args.timeout
+    t0 = time.monotonic()
+    deadline = t0 + args.timeout
     label = keys.state_label
+    timeline: list = []
+    last_state: dict = {}
+    converged = False
     while time.monotonic() < deadline:
         try:
             state = mgr.reconcile(NS, RUNTIME_LABELS, policy)
@@ -171,38 +217,76 @@ def main() -> int:
             print(f"kind_smoke: snapshot incomplete ({exc}); retrying")
             state = None
         if state is not None:
-            states = {n.metadata.name:
-                      n.metadata.labels.get(label, "<unset>")
-                      for n in client.list_nodes()}
+            states = {}
+            for node in client.list_nodes():
+                name = node.metadata.name
+                value = node.metadata.labels.get(label, "<unset>")
+                states[name] = value
+                # poll-sampled timeline (coarser than the wire smoke's
+                # watch-stream capture, same entry shape)
+                if value != last_state.get(name):
+                    last_state[name] = value
+                    timeline.append({
+                        "t_s": round(time.monotonic() - t0, 3),
+                        "node": name, "state": value,
+                        "unschedulable": node.is_unschedulable()})
             print(f"kind_smoke: node states: {states}")
             if states and all(v == str(UpgradeState.DONE)
                               for v in states.values()):
+                converged = True
                 break
         time.sleep(2.0)
-    else:
+    recorder.flush()
+
+    # One snapshot serves the assertions AND the artifact — re-listing
+    # for each would be redundant round-trips that can disagree.
+    nodes = client.list_nodes()
+    pods = client.list_pods(NS, label_selector="app=libtpu-smoke")
+    raw_events = json.loads(kubectl(
+        ctx, "-n", NS, "get", "events", "--field-selector",
+        f"reason={keys.event_reason}", "-o", "json"))
+    event_rows = [event_row(e) for e in raw_events.get("items", [])]
+
+    if args.out:
+        # written for FAILED runs too (converged=false): the timeline
+        # of a wedged upgrade is evidence, same as the wire smoke's
+        artifact = build_artifact(
+            converged=converged,
+            duration_s=time.monotonic() - t0,
+            timeline=timeline,
+            final_node_states={
+                n.metadata.name: n.metadata.labels.get(label)
+                for n in nodes},
+            final_runtime_revisions={
+                p.metadata.name: p.metadata.labels.get(
+                    "controller-revision-hash")
+                for p in pods},
+            events=event_rows, context=ctx, n_nodes=len(node_names))
+        with open(args.out, "w") as fh:
+            json.dump(artifact, fh, indent=1)
+            fh.write("\n")
+        print(f"kind_smoke: artifact written to {args.out}")
+
+    if not converged:
         print("kind_smoke: FAIL — upgrade did not converge in time")
         return 1
-    recorder.flush()
 
     # 5. assertions against the real cluster
     failures = []
-    for node in client.list_nodes():
+    for node in nodes:
         if node.is_unschedulable():
             failures.append(f"node {node.metadata.name} still cordoned")
     revisions = client.list_controller_revisions(
         NS, "app=libtpu-smoke")
     newest = max(revisions, key=lambda r: r.revision)
-    for pod in client.list_pods(NS, label_selector="app=libtpu-smoke"):
+    for pod in pods:
         got = pod.metadata.labels.get(
             "controller-revision-hash", "")
         if got != newest.hash:
             failures.append(
                 f"pod {pod.metadata.name} runs revision {got!r}, "
                 f"expected {newest.hash!r}")
-    events = kubectl(ctx, "-n", NS, "get", "events",
-                     "--field-selector",
-                     f"reason={keys.event_reason}", "-o", "name")
-    if not events.strip():
+    if not event_rows:
         failures.append(
             f"no {keys.event_reason} Events visible in {NS}")
 

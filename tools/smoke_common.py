@@ -1,0 +1,24 @@
+"""Shared pieces of the apiserver-smoke artifact format.
+
+Both smokes — the in-image wire double (tools/wire_smoke.py) and the
+real-cluster run (tools/kind_smoke.py) — emit one artifact schema so
+the same readers and tests (tests/test_wire_smoke.py) consume either.
+The schema id and the Event projection live here so the two writers
+cannot drift apart silently.
+"""
+
+from __future__ import annotations
+
+SCHEMA = "tpu-operator-libs/apiserver-smoke/v1"
+
+
+def event_row(event: dict) -> dict:
+    """Project one v1 Event JSON object into the artifact's row shape."""
+    return {
+        "name": (event.get("metadata") or {}).get("name"),
+        "reason": event.get("reason"),
+        "type": event.get("type"),
+        "count": event.get("count"),
+        "involved": (event.get("involvedObject") or {}).get("name"),
+        "message": (event.get("message") or "")[:160],
+    }

@@ -46,6 +46,7 @@ REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 sys.path.insert(0, REPO)
 sys.path.insert(0, os.path.join(REPO, "tools"))
 
+from smoke_common import SCHEMA, event_row  # noqa: E402
 from wire_apiserver import ControllerSim, WireApiServer  # noqa: E402
 
 from tpu_operator_libs.api.upgrade_policy import (  # noqa: E402
@@ -65,7 +66,6 @@ from tpu_operator_libs.util import CorrelatingEventRecorder  # noqa: E402
 
 NS = "tpu-system"
 RUNTIME_LABELS = {"app": "libtpu"}
-SCHEMA = "tpu-operator-libs/apiserver-smoke/v1"
 
 
 def seed(store, n_nodes: int) -> None:
@@ -286,13 +286,7 @@ def run_smoke(n_nodes: int = 4, timeout_s: float = 120.0) -> dict:
             name: (obj.get("metadata") or {}).get("labels", {})
             .get(keys.state_label) for name, obj in nodes.items()},
         "final_runtime_revisions": runtime_revisions,
-        "events": [{
-            "name": (e.get("metadata") or {}).get("name"),
-            "reason": e.get("reason"), "type": e.get("type"),
-            "count": e.get("count"),
-            "involved": (e.get("involvedObject") or {}).get("name"),
-            "message": (e.get("message") or "")[:160],
-        } for e in events],
+        "events": [event_row(e) for e in events],
         "evictions": {"admitted": store.evictions_admitted,
                       "blocked_by_pdb": store.evictions_blocked},
         "http_requests": {"total": len(requests), **verb_counts},
