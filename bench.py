@@ -953,15 +953,17 @@ def _hardware_degraded(reason: str, attempts_made: int,
     return out
 
 
-def _probe_once(timeout_s: float, script: Optional[str] = None):
-    """(parsed-json-or-None, reason)."""
+def _probe_once(timeout_s: float, script: Optional[str] = None,
+                env: Optional[dict] = None):
+    """(parsed-json-or-None, reason). ``env`` overrides the subprocess
+    environment (tools/mfu_sweep.py sets BENCH_MODEL_* per cell)."""
     import subprocess
 
     try:
         proc = subprocess.run(
             [sys.executable, "-c", script or _PROBE_SCRIPT],
             capture_output=True, text=True, timeout=timeout_s,
-            cwd=os.path.dirname(os.path.abspath(__file__)))
+            cwd=os.path.dirname(os.path.abspath(__file__)), env=env)
     except subprocess.TimeoutExpired:
         return None, (f"probe subprocess exceeded {timeout_s:.0f}s "
                       "(TPU backend likely wedged at device enumeration)")
