@@ -507,7 +507,7 @@ try:
     overridden = any(os.environ.get(k) for k in (
         "BENCH_MODEL_D", "BENCH_MODEL_LAYERS", "BENCH_MODEL_SEQ",
         "BENCH_MODEL_BATCH", "BENCH_MODEL_LONG_SEQ",
-        "BENCH_MODEL_REMAT"))
+        "BENCH_MODEL_REMAT", "BENCH_MODEL_QUEUE"))
 
     device = jax.devices()[0]
     mesh = Mesh(np.array([device]).reshape(1, 1), ("dp", "tp"))

@@ -36,8 +36,10 @@ from tpu_operator_libs.k8s.client import (  # noqa: E402
 from tpu_operator_libs.k8s.http import HttpCluster  # noqa: E402
 from tpu_operator_libs.k8s.watch import KIND_NODE  # noqa: E402
 
-ARTIFACT = os.path.join(os.path.dirname(os.path.dirname(
-    os.path.abspath(__file__))), "docs", "wire_smoke_run.json")
+_DOCS = os.path.join(os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))), "docs")
+ARTIFACT = os.path.join(_DOCS, "wire_smoke_run.json")
+ARTIFACT_PD = os.path.join(_DOCS, "wire_smoke_poddeletion_run.json")
 
 
 class TestJsonMergePatch:
@@ -300,6 +302,56 @@ class TestEndToEndSmoke:
             assert "drain-required" in walk
 
 
+class TestPodDeletionScenario:
+    def test_pod_deletion_path_with_validation_over_sockets(self):
+        """The second committed artifact's claim, re-proven in-process:
+        the OPTIONAL pod-deletion state (drain disabled) plus the
+        validation gate, all over real HTTP."""
+        from wire_smoke import run_smoke
+
+        result = run_smoke(n_nodes=4, timeout_s=90.0,
+                           scenario="pod-deletion")
+        assert result["converged"], result
+        assert set(result["final_node_states"].values()) == {
+            "upgrade-done"}
+        for node in result["final_node_states"]:
+            walk = [e["state"] for e in result["label_timeline"]
+                    if e["node"] == node]
+            assert "pod-deletion-required" in walk
+            assert "validation-required" in walk
+            assert "drain-required" not in walk  # drain disabled
+
+    def test_unknown_scenario_rejected(self):
+        from wire_smoke import run_smoke
+
+        with pytest.raises(ValueError):
+            run_smoke(n_nodes=1, scenario="nope")
+
+
+class TestCommittedPodDeletionArtifact:
+    @pytest.fixture(scope="class")
+    def artifact(self):
+        with open(ARTIFACT_PD) as fh:
+            return json.load(fh)
+
+    def test_schema_and_walk(self, artifact):
+        assert artifact["schema"] == \
+            "tpu-operator-libs/apiserver-smoke/v1"
+        assert artifact["converged"] is True
+        assert artifact["fleet"]["eviction_path"] == "pod-deletion"
+        assert artifact["fleet"]["validation"] is True
+        assert set(artifact["final_node_states"].values()) == {
+            "upgrade-done"}
+        assert set(artifact["final_runtime_revisions"].values()) == {
+            "newrev"}
+        for node in artifact["final_node_states"]:
+            walk = [e["state"] for e in artifact["label_timeline"]
+                    if e["node"] == node]
+            assert "pod-deletion-required" in walk
+            assert "validation-required" in walk
+            assert "drain-required" not in walk
+
+
 class TestKindSmokeSchemaParity:
     """tools/kind_smoke.py --out must emit the SAME artifact schema as
     the wire smoke, so real-cluster evidence drops into the same
@@ -327,6 +379,10 @@ class TestKindSmokeSchemaParity:
         # key-for-key schema parity with the committed wire artifact
         assert set(artifact) == set(wire)
         assert artifact["schema"] == wire["schema"]
+        # nested blocks agree too — a reader of fleet.eviction_path
+        # etc. must not KeyError on either producer's output
+        assert set(artifact["fleet"]) == set(wire["fleet"])
+        assert set(artifact["server"]) == set(wire["server"])
         # entry shapes agree where both sides populate them
         assert set(artifact["label_timeline"][0]) == set(
             wire["label_timeline"][0])

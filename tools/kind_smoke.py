@@ -107,7 +107,10 @@ def build_artifact(*, converged: bool, duration_s: float,
                    "independent_of_fakecluster": True},
         "client": "tpu_operator_libs.k8s.real.RealCluster",
         "fleet": {"nodes": n_nodes, "runtime_ds": "libtpu-smoke",
-                  "workload_pdb": None},
+                  "workload_pdb": None,
+                  # the kind flow drains; validation needs a per-node
+                  # validator the generic smoke does not install
+                  "eviction_path": "drain", "validation": False},
         "converged": bool(converged),
         "duration_s": round(duration_s, 2),
         "label_timeline": timeline,

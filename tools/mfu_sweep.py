@@ -66,9 +66,13 @@ def main() -> int:
         overrides = {"BENCH_MODEL_REMAT": remat,
                      "BENCH_MODEL_BATCH": batch,
                      "BENCH_MODEL_QUEUE": queue,
-                     # long-context cell is orthogonal to this sweep
-                     # and costs ~30 s per run; pin it tiny
-                     "BENCH_MODEL_LONG_SEQ": "256"}
+                     # the long-context and decode cells are orthogonal
+                     # to this sweep; pin them tiny so each cell's
+                     # budget goes to the train step being ranked
+                     "BENCH_MODEL_LONG_SEQ": "256",
+                     "BENCH_DECODE_BATCH": "2",
+                     "BENCH_DECODE_PROMPT": "8",
+                     "BENCH_DECODE_NEW": "8"}
         label = f"remat={remat} batch={batch} queue={queue}"
         print(f"mfu_sweep: running {label} ...", flush=True)
         data = run_cell(overrides, args.timeout)
@@ -103,13 +107,18 @@ def main() -> int:
                     key=lambda c: c[1])
     print("\nmfu_sweep results (worst -> best):")
     for label, tflops, mfu, _ in ranked:
-        print(f"  {label:32s} {tflops:7.1f} TFLOP/s  {mfu:5.1f}% MFU")
+        # mfu is None when the chip kind has no peak-table row (e.g. a
+        # CPU debugging run) — the ranking still stands on TFLOP/s
+        mfu_s = f"{mfu:5.1f}% MFU" if mfu is not None else "(no peak)"
+        print(f"  {label:32s} {tflops:7.1f} TFLOP/s  {mfu_s}")
     for label, _, _, error in cells:
         if error:
             print(f"  {label:32s} FAILED: {error}")
     if ranked:
         best = ranked[-1]
-        print(f"\nbest: {best[0]} at {best[2]}% MFU — promote by "
+        best_s = (f"{best[2]}% MFU" if best[2] is not None
+                  else f"{best[1]} TFLOP/s")
+        print(f"\nbest: {best[0]} at {best_s} — promote by "
               "changing bench.py defaults (env overrides never persist "
               "as last-good)")
     return 0

@@ -176,7 +176,20 @@ class WorkloadSim:
             time.sleep(0.05)
 
 
-def run_smoke(n_nodes: int = 4, timeout_s: float = 120.0) -> dict:
+def run_smoke(n_nodes: int = 4, timeout_s: float = 120.0,
+              scenario: str = "drain") -> dict:
+    """One rolling upgrade over sockets. ``scenario``:
+
+    - ``"drain"``: the default path — kubectl-drain-equivalent
+      evictions fight the web PDB (429s on the wire).
+    - ``"pod-deletion"``: the optional pod-deletion state instead
+      (drain disabled; filter-selected workload pods deleted by
+      PodManager), plus the validation state enabled with a
+      wire-backed validator — so the committed evidence covers BOTH
+      eviction branches and the validation gate of the 11-state graph.
+    """
+    if scenario not in ("drain", "pod-deletion"):
+        raise ValueError(f"unknown scenario {scenario!r}")
     server = WireApiServer().start()
     seed(server.store, n_nodes)
     controllers = ControllerSim(server.store)
@@ -186,10 +199,21 @@ def run_smoke(n_nodes: int = 4, timeout_s: float = 120.0) -> dict:
 
     keys = UpgradeKeys()
     client = HttpCluster(server.url)
-    policy = UpgradePolicySpec(
-        auto_upgrade=True, max_parallel_upgrades=0,
-        max_unavailable="50%",
-        drain=DrainSpec(enable=True, force=True, timeout_seconds=60))
+    if scenario == "drain":
+        policy = UpgradePolicySpec(
+            auto_upgrade=True, max_parallel_upgrades=0,
+            max_unavailable="50%",
+            drain=DrainSpec(enable=True, force=True,
+                            timeout_seconds=60))
+    else:
+        from tpu_operator_libs.api.upgrade_policy import PodDeletionSpec
+
+        policy = UpgradePolicySpec(
+            auto_upgrade=True, max_parallel_upgrades=0,
+            max_unavailable="50%",
+            pod_deletion=PodDeletionSpec(force=True,
+                                         timeout_seconds=60),
+            drain=DrainSpec(enable=False))
 
     # node-label timeline from a dedicated wire watch stream — the
     # artifact's transitions are what an independent observer saw on
@@ -218,13 +242,29 @@ def run_smoke(n_nodes: int = 4, timeout_s: float = 120.0) -> dict:
     state_mgr: list = [None]
     manager_box: list = [None]
 
+    def runtime_pod_ready(node) -> bool:
+        """Wire-backed validator: the node's runtime pod must be Ready
+        as seen through a FRESH apiserver read (not the informer
+        cache) — the kind of post-upgrade health check the validation
+        state exists for."""
+        pods = client.list_pods(
+            NS, label_selector="app=libtpu",
+            field_selector=f"spec.nodeName={node.metadata.name}")
+        return any(p.is_ready() for p in pods)
+
     def reconcile_fn(_key: str):
         if state_mgr[0] is None:
-            state_mgr[0] = ClusterUpgradeStateManager(
+            mgr = ClusterUpgradeStateManager(
                 manager_box[0].client, keys, async_workers=False,
                 poll_interval=0.05,
                 recorder=CorrelatingEventRecorder(
                     sink=ClusterEventSink(client, NS)))
+            if scenario == "pod-deletion":
+                mgr.with_pod_deletion_enabled(
+                    lambda pod: pod.metadata.labels.get("app") == "web")
+                mgr.with_validation_enabled(
+                    extra_validator=runtime_pod_ready)
+            state_mgr[0] = mgr
         try:
             state = state_mgr[0].reconcile(NS, RUNTIME_LABELS, policy)
         except BuildStateError:
@@ -278,7 +318,9 @@ def run_smoke(n_nodes: int = 4, timeout_s: float = 120.0) -> dict:
                    "independent_of_fakecluster": True},
         "client": "tpu_operator_libs.k8s.http.HttpCluster",
         "fleet": {"nodes": n_nodes, "runtime_ds": "libtpu",
-                  "workload_pdb": "web-pdb minAvailable=75%"},
+                  "workload_pdb": "web-pdb minAvailable=75%",
+                  "eviction_path": scenario,
+                  "validation": scenario == "pod-deletion"},
         "converged": bool(converged),
         "duration_s": round(duration, 2),
         "label_timeline": timeline,
@@ -297,10 +339,12 @@ def main() -> int:
     parser = argparse.ArgumentParser()
     parser.add_argument("--nodes", type=int, default=4)
     parser.add_argument("--timeout", type=float, default=120.0)
+    parser.add_argument("--scenario", default="drain",
+                        choices=("drain", "pod-deletion"))
     parser.add_argument("--out", default=None,
                         help="write the artifact JSON here")
     args = parser.parse_args()
-    result = run_smoke(args.nodes, args.timeout)
+    result = run_smoke(args.nodes, args.timeout, args.scenario)
     payload = json.dumps(result, indent=1)
     if args.out:
         with open(args.out, "w") as fh:
