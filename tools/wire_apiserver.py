@@ -710,11 +710,12 @@ class ControllerSim:
 class WireApiServer:
     """ThreadingHTTPServer wrapper bound to 127.0.0.1:<ephemeral>."""
 
-    def __init__(self, store: Optional[WireStore] = None) -> None:
+    def __init__(self, store: Optional[WireStore] = None,
+                 port: int = 0) -> None:
         self.store = store or WireStore()
         handler = type("BoundWireHandler", (WireHandler,),
                        {"store": self.store})
-        self.httpd = ThreadingHTTPServer(("127.0.0.1", 0), handler)
+        self.httpd = ThreadingHTTPServer(("127.0.0.1", port), handler)
         self.httpd.daemon_threads = True
         self._thread = threading.Thread(
             target=self.httpd.serve_forever, daemon=True,

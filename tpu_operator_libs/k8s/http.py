@@ -437,33 +437,84 @@ class HttpCluster(K8sClient):
         return watch
 
     def _watch_stream(self, kind: str, path: str, watch: Watch) -> None:
+        """One kind's watch loop: stream, and RECONNECT when the server
+        drops the connection.
+
+        Real apiservers close watch streams routinely (connection
+        timeouts, resourceVersion compaction); client-go's reflector
+        answers by re-list + re-watch. Same here: after a drop the
+        stream reconnects with capped exponential backoff, and each
+        RE-connect replays a full LIST as MODIFIED events so the
+        informer caches repair whatever changed during the gap (a
+        silent dead watch would otherwise starve the controller of
+        events forever). Limitation, by design: deletions that happened
+        during the gap are not synthesized (this layer has no cache to
+        diff against) — the controller's ``resync_period`` remains the
+        backstop for those, exactly the role client-go gives resync.
+        """
+        import time as _time
+
         parse = _KIND_PARSERS[kind]
-        url = f"{self._base}{path}?watch=true"
-        req = urllib.request.Request(url)
-        req.add_header("Accept", _JSON)
-        if self._token:
-            req.add_header("Authorization", f"Bearer {self._token}")
         ctx = self._ssl if self._base.startswith("https") else None
-        try:
-            with urllib.request.urlopen(req, timeout=None,
-                                        context=ctx) as resp:
-                for raw in resp:
-                    if watch.stopped:
-                        return
-                    line = raw.strip()
-                    if not line:
-                        continue
-                    try:
-                        evt = json.loads(line)
-                    except json.JSONDecodeError:
-                        continue
-                    if evt.get("type") not in (ADDED, MODIFIED, DELETED):
-                        continue
-                    # WatchEvent carries a typed snapshot, exactly
-                    # like FakeCluster's broadcaster
-                    watch._deliver(WatchEvent(
-                        evt["type"], kind,
-                        parse(evt.get("object") or {})))
-        except (urllib.error.URLError, OSError, ValueError) as exc:
-            if not watch.stopped:
-                logger.warning("watch stream %s ended: %s", kind, exc)
+        backoff = 1.0
+        first = True
+        while not watch.stopped:
+            req = urllib.request.Request(
+                f"{self._base}{path}?watch=true")
+            req.add_header("Accept", _JSON)
+            if self._token:
+                req.add_header("Authorization",
+                               f"Bearer {self._token}")
+            try:
+                with urllib.request.urlopen(req, timeout=None,
+                                            context=ctx) as resp:
+                    if not first:
+                        logger.info("watch stream %s reconnected; "
+                                    "replaying LIST", kind)
+                        for obj in self._list(path):
+                            if watch.stopped:
+                                return
+                            watch._deliver(
+                                WatchEvent(MODIFIED, kind, parse(obj)))
+                    streamed = False
+                    for raw in resp:
+                        if watch.stopped:
+                            return
+                        line = raw.strip()
+                        if not line:
+                            continue
+                        try:
+                            evt = json.loads(line)
+                        except json.JSONDecodeError:
+                            continue
+                        if evt.get("type") not in (ADDED, MODIFIED,
+                                                   DELETED):
+                            continue
+                        if not streamed:
+                            # the stream proved healthy (an actual
+                            # event arrived) — only now reset backoff.
+                            # Resetting on mere connect would let a
+                            # server whose watch endpoint drops
+                            # instantly (but serves LISTs fine) induce
+                            # a full re-LIST per second forever.
+                            streamed = True
+                            backoff = 1.0
+                        # WatchEvent carries a typed snapshot, exactly
+                        # like FakeCluster's broadcaster
+                        watch._deliver(WatchEvent(
+                            evt["type"], kind,
+                            parse(evt.get("object") or {})))
+            except Exception as exc:  # noqa: BLE001 — thread boundary:
+                # ANY escape kills the daemon thread and the watch goes
+                # silently deaf (urllib raises URLError/OSError, the
+                # chunked reader http.client.IncompleteRead, the replay
+                # LIST any client-seam error incl. 429/404 mappings) —
+                # every one of them must land in backoff-and-retry
+                if watch.stopped:
+                    return
+                logger.warning("watch stream %s dropped (%s); "
+                               "reconnecting in %.0fs", kind, exc,
+                               backoff)
+            first = False
+            _time.sleep(backoff)
+            backoff = min(backoff * 2.0, 30.0)
