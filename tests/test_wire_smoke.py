@@ -450,6 +450,36 @@ class TestCommittedPodDeletionArtifact:
             assert "drain-required" not in walk
 
 
+class TestWireFaultInjection:
+    def test_upgrade_converges_through_500s_on_the_wire(self):
+        """The fault-injection suite's guarantee — transient apiserver
+        errors defer, never consume failure budget — demonstrated at
+        the HTTP layer: 30% of non-watch requests answer 500 (seeded)
+        and the rolling upgrade still walks every node to done."""
+        from wire_smoke import run_smoke
+
+        result = run_smoke(n_nodes=4, timeout_s=120.0, fault_rate=0.3)
+        assert result["converged"], result
+        assert set(result["final_node_states"].values()) == {
+            "upgrade-done"}
+        assert set(result["final_runtime_revisions"].values()) == {
+            "newrev"}
+        # the chaos actually happened
+        assert result["http_requests"]["faults_injected"] > 20
+
+    def test_fault_rng_is_seeded(self):
+        from wire_apiserver import WireStore
+
+        store_a = WireStore()
+        store_b = WireStore()
+        store_a.inject_faults(0.5)
+        store_b.inject_faults(0.5)
+        seq_a = [store_a.should_fault() for _ in range(64)]
+        seq_b = [store_b.should_fault() for _ in range(64)]
+        assert seq_a == seq_b  # reproducible chaos
+        assert any(seq_a) and not all(seq_a)
+
+
 class TestKindSmokeSchemaParity:
     """tools/kind_smoke.py --out must emit the SAME artifact schema as
     the wire smoke, so real-cluster evidence drops into the same

@@ -182,6 +182,29 @@ class WireStore:
         self.request_log: list[str] = []
         self.evictions_admitted = 0
         self.evictions_blocked = 0
+        # Fault injection: every non-watch request fails with a 500
+        # with this probability. The RNG is seeded so the DRAW SEQUENCE
+        # is reproducible (which request in arrival order gets faulted
+        # still depends on handler-thread scheduling). The operator's
+        # transient-error handling (park-and-retry, no failure-budget
+        # consumption) must converge through it.
+        self.faults_injected = 0
+        self.inject_faults(0.0)
+
+    def inject_faults(self, rate: float, seed: int = 20260730) -> None:
+        import random
+
+        self.fault_rate = rate
+        self._fault_rng = random.Random(seed)
+
+    def should_fault(self) -> bool:
+        if self.fault_rate <= 0.0:
+            return False
+        with self._lock:  # RNG draw + counter: shared across handlers
+            if self._fault_rng.random() < self.fault_rate:
+                self.faults_injected += 1
+                return True
+            return False
 
     # -- primitives -------------------------------------------------------
     def _bump(self, obj: dict) -> None:
@@ -457,10 +480,24 @@ class WireHandler(BaseHTTPRequestHandler):
         finally:
             self.store.unsubscribe(queue)
 
+    def _maybe_fault(self) -> bool:
+        """Inject a 500 per the store's fault_rate (watch requests are
+        exempt — stream robustness has its own reconnect machinery and
+        tests; this knob targets the request/response paths)."""
+        if self._params().get("watch") in ("true", "1"):
+            return False
+        if self.store.should_fault():
+            self._status(500, "InternalError",
+                         "injected fault (wire_apiserver fault_rate)")
+            return True
+        return False
+
     # -- verbs ------------------------------------------------------------
     def do_GET(self) -> None:  # noqa: N802
         path = self._path
         self.store.request_log.append(f"GET {path}")
+        if self._maybe_fault():
+            return
         match = _NODE_RE.match(path)
         if match:
             if match.group(1):
@@ -501,6 +538,8 @@ class WireHandler(BaseHTTPRequestHandler):
     def do_PATCH(self) -> None:  # noqa: N802
         path = self._path
         self.store.request_log.append(f"PATCH {path}")
+        if self._maybe_fault():
+            return
         if self.headers.get("Content-Type") not in (
                 "application/merge-patch+json",
                 "application/strategic-merge-patch+json"):
@@ -533,6 +572,8 @@ class WireHandler(BaseHTTPRequestHandler):
     def do_POST(self) -> None:  # noqa: N802
         path = self._path
         self.store.request_log.append(f"POST {path}")
+        if self._maybe_fault():
+            return
         match = _EVICT_RE.match(path)
         if match:
             namespace, name = match.groups()
@@ -569,6 +610,8 @@ class WireHandler(BaseHTTPRequestHandler):
     def do_DELETE(self) -> None:  # noqa: N802
         path = self._path
         self.store.request_log.append(f"DELETE {path}")
+        if self._maybe_fault():
+            return
         match = _POD_RE.match(path)
         if match and match.group(2):
             if not self.store.delete("pods", match.group(1),

@@ -177,7 +177,8 @@ class WorkloadSim:
 
 
 def run_smoke(n_nodes: int = 4, timeout_s: float = 120.0,
-              scenario: str = "drain") -> dict:
+              scenario: str = "drain",
+              fault_rate: float = 0.0) -> dict:
     """One rolling upgrade over sockets. ``scenario``:
 
     - ``"drain"``: the default path — kubectl-drain-equivalent
@@ -187,11 +188,18 @@ def run_smoke(n_nodes: int = 4, timeout_s: float = 120.0,
       PodManager), plus the validation state enabled with a
       wire-backed validator — so the committed evidence covers BOTH
       eviction branches and the validation gate of the 11-state graph.
+
+    ``fault_rate`` makes the apiserver double answer that fraction of
+    non-watch requests with a 500 (seeded RNG): the upgrade must still
+    converge through park-and-retry transient-error handling — the
+    fault-injection suite's guarantee, demonstrated at the HTTP layer.
     """
     if scenario not in ("drain", "pod-deletion"):
         raise ValueError(f"unknown scenario {scenario!r}")
     server = WireApiServer().start()
     seed(server.store, n_nodes)
+    if fault_rate:
+        server.store.inject_faults(fault_rate)
     controllers = ControllerSim(server.store)
     workload = WorkloadSim(server.store)
     controllers.start()
@@ -331,7 +339,10 @@ def run_smoke(n_nodes: int = 4, timeout_s: float = 120.0,
         "events": [event_row(e) for e in events],
         "evictions": {"admitted": store.evictions_admitted,
                       "blocked_by_pdb": store.evictions_blocked},
-        "http_requests": {"total": len(requests), **verb_counts},
+        "http_requests": {"total": len(requests), **verb_counts,
+                          **({"faults_injected": store.faults_injected,
+                              "fault_rate": store.fault_rate}
+                             if store.fault_rate else {})},
     }
 
 
@@ -341,10 +352,14 @@ def main() -> int:
     parser.add_argument("--timeout", type=float, default=120.0)
     parser.add_argument("--scenario", default="drain",
                         choices=("drain", "pod-deletion"))
+    parser.add_argument("--fault-rate", type=float, default=0.0,
+                        help="answer this fraction of non-watch "
+                             "requests with a 500 (seeded chaos)")
     parser.add_argument("--out", default=None,
                         help="write the artifact JSON here")
     args = parser.parse_args()
-    result = run_smoke(args.nodes, args.timeout, args.scenario)
+    result = run_smoke(args.nodes, args.timeout, args.scenario,
+                       fault_rate=args.fault_rate)
     payload = json.dumps(result, indent=1)
     if args.out:
         with open(args.out, "w") as fh:
