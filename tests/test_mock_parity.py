@@ -1,0 +1,115 @@
+"""Signature-parity drift check for the hand-written mocks.
+
+The reference generates its mocks with mockery
+(pkg/upgrade/mocks/CordonManager.go:13-17) so a changed manager
+interface regenerates the mock. This build's `upgrade/mocks.py` is
+hand-written; this module recovers the generator's guarantee: every
+public method the state manager can call on a real manager must exist
+on its mock **with a call-compatible signature** — a seam method added
+or re-shaped without updating the mock fails here, like a stale
+generated mock failing regeneration.
+
+Only the methods the state machine actually dispatches are required
+(the mocks are seams for transition-logic tests, not full replicas);
+the required set is DISCOVERED from the real class's public surface
+minus documented non-seam exclusions, so a new manager method is
+flagged by default rather than silently skipped.
+"""
+
+from __future__ import annotations
+
+import inspect
+
+import pytest
+
+from tpu_operator_libs.upgrade import mocks
+from tpu_operator_libs.upgrade.cordon_manager import CordonManager
+from tpu_operator_libs.upgrade.drain_manager import DrainManager
+from tpu_operator_libs.upgrade.pod_manager import PodManager
+from tpu_operator_libs.upgrade.safe_load_manager import (
+    SafeRuntimeLoadManager,
+)
+from tpu_operator_libs.upgrade.state_provider import (
+    NodeUpgradeStateProvider,
+)
+from tpu_operator_libs.upgrade.validation_manager import ValidationManager
+
+#: (real class, mock class, methods that are NOT state-manager seams —
+#: configuration/introspection surface the mocks legitimately omit).
+PAIRS = [
+    (NodeUpgradeStateProvider, mocks.MockNodeUpgradeStateProvider,
+     set()),
+    (CordonManager, mocks.MockCordonManager, set()),
+    (DrainManager, mocks.MockDrainManager,
+     {"set_eviction_gate", "abandon_stale_gate_deferrals", "join"}),
+    (PodManager, mocks.MockPodManager,
+     {"set_eviction_gate", "abandon_stale_gate_deferrals", "join"}),
+    (ValidationManager, mocks.MockValidationManager, set()),
+    (SafeRuntimeLoadManager, mocks.MockSafeLoadManager, set()),
+]
+
+
+def _public_methods(cls) -> dict[str, object]:
+    out = {}
+    for name, member in inspect.getmembers(cls, inspect.isfunction):
+        if name.startswith("_"):
+            continue
+        out[name] = member
+    return out
+
+
+@pytest.mark.parametrize(
+    "real,mock,excluded", PAIRS, ids=[r.__name__ for r, _, _ in PAIRS])
+def test_mock_covers_every_seam_method(real, mock, excluded):
+    real_methods = _public_methods(real)
+    mock_methods = _public_methods(mock)
+    missing = set(real_methods) - set(mock_methods) - excluded
+    assert not missing, (
+        f"{mock.__name__} is missing seam method(s) {sorted(missing)} "
+        f"present on {real.__name__} — a new manager method was "
+        "probably added without updating the mock (or add it to the "
+        "documented exclusions if it is not a state-manager seam)")
+
+
+@pytest.mark.parametrize(
+    "real,mock,excluded", PAIRS, ids=[r.__name__ for r, _, _ in PAIRS])
+def test_shared_methods_are_call_compatible(real, mock, excluded):
+    """Positional parameter names must agree (prefix-wise): the state
+    manager calls seams positionally and by keyword; a renamed or
+    re-ordered parameter breaks mock-driven tests silently if the mock
+    keeps the old shape."""
+    real_methods = _public_methods(real)
+    mock_methods = _public_methods(mock)
+    def params_of(fn):
+        return [p for p in inspect.signature(fn).parameters.values()
+                if p.kind in (inspect.Parameter.POSITIONAL_OR_KEYWORD,
+                              inspect.Parameter.POSITIONAL_ONLY,
+                              inspect.Parameter.KEYWORD_ONLY)
+                and p.name != "self"]
+
+    for name in set(real_methods) & set(mock_methods):
+        real_params = params_of(real_methods[name])
+        mock_params = params_of(mock_methods[name])
+        names_real = [p.name for p in real_params]
+        names_mock = [p.name for p in mock_params]
+        # the mock may omit trailing params ONLY if they are optional;
+        # it may never rename, reorder, or drop a required one
+        assert names_mock == names_real[:len(names_mock)], (
+            f"{mock.__name__}.{name} parameters {names_mock} are not "
+            f"a prefix of {real.__name__}.{name} {names_real}")
+        for omitted in real_params[len(mock_params):]:
+            assert omitted.default is not inspect.Parameter.empty, (
+                f"{mock.__name__}.{name} omits REQUIRED parameter "
+                f"{omitted.name!r} of {real.__name__}.{name} — the "
+                "state manager would pass it and the mock would raise")
+
+
+def test_every_mock_is_checked():
+    """A new Mock* class in mocks.py must join PAIRS (discovery guard:
+    the parity above means nothing for a mock nobody lists)."""
+    mock_classes = {name for name, obj in inspect.getmembers(
+        mocks, inspect.isclass) if name.startswith("Mock")}
+    listed = {m.__name__ for _, m, _ in PAIRS}
+    assert mock_classes == listed, (
+        f"mocks.py classes {sorted(mock_classes - listed)} are not "
+        "covered by test_mock_parity.PAIRS")

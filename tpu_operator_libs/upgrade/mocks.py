@@ -157,6 +157,21 @@ class MockPodManager(RecordingMixin):
         self.record("schedule_check_on_pod_completion",
                     tuple(n.metadata.name for n in config.nodes))
 
+    def is_pod_running_or_pending(self, pod: Pod) -> bool:
+        """Full-interface parity with the real manager (the reference's
+        generated mock covers IsPodRunningOrPending the same way).
+        Delegates to the real static predicate — duplicating the phase
+        set here could silently drift from it."""
+        self.record("is_pod_running_or_pending", pod.name)
+        from tpu_operator_libs.upgrade.pod_manager import PodManager
+
+        return PodManager.is_pod_running_or_pending(pod)
+
+    def handle_timeout_on_pod_completions(self, node: Node,
+                                          timeout_seconds: int) -> None:
+        self.record("handle_timeout_on_pod_completions",
+                    node.metadata.name, timeout_seconds)
+
     def join(self, timeout: float = 0.0) -> None:
         pass
 
