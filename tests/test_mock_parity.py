@@ -58,6 +58,12 @@ def _public_methods(cls) -> dict[str, object]:
     return out
 
 
+def _public_properties(cls) -> set[str]:
+    return {name for name, member in inspect.getmembers(
+        cls, lambda m: isinstance(m, property))
+        if not name.startswith("_")}
+
+
 @pytest.mark.parametrize(
     "real,mock,excluded", PAIRS, ids=[r.__name__ for r, _, _ in PAIRS])
 def test_mock_covers_every_seam_method(real, mock, excluded):
@@ -69,6 +75,21 @@ def test_mock_covers_every_seam_method(real, mock, excluded):
         f"present on {real.__name__} — a new manager method was "
         "probably added without updating the mock (or add it to the "
         "documented exclusions if it is not a state-manager seam)")
+
+
+@pytest.mark.parametrize(
+    "real,mock,excluded", PAIRS, ids=[r.__name__ for r, _, _ in PAIRS])
+def test_mock_exposes_every_seam_property(real, mock, excluded):
+    """Public @property members are part of the readable surface too
+    (state_manager reads pod_manager.eviction_gate); the mock must
+    expose the attribute — as a property, class attribute, or an
+    attribute its no-extra-arg constructor sets."""
+    instance = mock()
+    missing = {name for name in _public_properties(real) - excluded
+               if not hasattr(instance, name)}
+    assert not missing, (
+        f"{mock.__name__} lacks attribute(s) {sorted(missing)} that "
+        f"are public properties on {real.__name__}")
 
 
 @pytest.mark.parametrize(

@@ -334,6 +334,75 @@ class TestHttpClusterWire:
                             {"metadata": {"name": "e"}})
 
 
+class TestLeaseWire:
+    """coordination.k8s.io Leases over the wire: the CRUD + optimistic
+    concurrency the LeaderElector's safety rides on, then an actual
+    two-contender election over sockets."""
+
+    def test_lease_crud_round_trip(self, wire):
+        from tpu_operator_libs.k8s.client import AlreadyExistsError
+        from tpu_operator_libs.k8s.objects import Lease, ObjectMeta
+
+        server, client = wire
+        lease = Lease(metadata=ObjectMeta(name="op-lock",
+                                          namespace="ns"),
+                      holder_identity="a", lease_duration_seconds=15,
+                      acquire_time=1000.25, renew_time=1000.75,
+                      lease_transitions=1)
+        created = client.create_lease(lease)
+        assert created.holder_identity == "a"
+        got = client.get_lease("ns", "op-lock")
+        assert got.acquire_time == pytest.approx(1000.25, abs=1e-5)
+        assert got.renew_time == pytest.approx(1000.75, abs=1e-5)
+        assert got.lease_transitions == 1
+        with pytest.raises(AlreadyExistsError):
+            client.create_lease(lease)
+
+    def test_update_requires_matching_resource_version(self, wire):
+        from tpu_operator_libs.k8s.client import ConflictError
+        from tpu_operator_libs.k8s.objects import Lease, ObjectMeta
+
+        server, client = wire
+        client.create_lease(Lease(metadata=ObjectMeta(
+            name="op-lock", namespace="ns"), holder_identity="a"))
+        fresh = client.get_lease("ns", "op-lock")
+        fresh.holder_identity = "b"
+        updated = client.update_lease(fresh)
+        assert updated.holder_identity == "b"
+        # re-sending the now-stale version must 409 -> ConflictError
+        fresh.holder_identity = "c"
+        with pytest.raises(ConflictError):
+            client.update_lease(fresh)
+        assert client.get_lease("ns", "op-lock").holder_identity == "b"
+
+    def test_two_contenders_elect_exactly_one_leader(self, wire):
+        from tpu_operator_libs.k8s.leaderelection import (
+            LeaderElectionConfig,
+            LeaderElector,
+        )
+
+        server, _ = wire
+        config = dict(namespace="ns", name="op-lock",
+                      lease_duration=3.0, renew_deadline=2.0,
+                      retry_period=0.5)
+        a = LeaderElector(HttpCluster(server.url),
+                          LeaderElectionConfig(identity="a", **config))
+        b = LeaderElector(HttpCluster(server.url),
+                          LeaderElectionConfig(identity="b", **config))
+        assert a.try_acquire_or_renew() is True
+        assert a.is_leader
+        assert b.try_acquire_or_renew() is False
+        assert not b.is_leader
+        assert b.observed_leader == "a"
+        # clean handover: a releases, b acquires on its next attempt
+        assert a.release() is True
+        assert b.try_acquire_or_renew() is True
+        assert b.is_leader
+        # and a now observes b (renew attempt fails fast)
+        assert a.try_acquire_or_renew() is False
+        assert a.observed_leader == "b"
+
+
 class TestControllerSim:
     def test_ds_pod_recreated_at_newest_revision(self, wire):
         server, client = wire

@@ -177,7 +177,7 @@ class WireStore:
         self.objects: dict[str, dict[tuple, dict]] = {
             kind: {} for kind in
             ("nodes", "pods", "daemonsets", "controllerrevisions",
-             "events", "poddisruptionbudgets")}
+             "events", "poddisruptionbudgets", "leases")}
         self._watchers: list[tuple[str, "_WatchQueue"]] = []
         self.request_log: list[str] = []
         self.evictions_admitted = 0
@@ -224,6 +224,24 @@ class WireStore:
                 self._notify(kind, event, obj)
             return obj
 
+    def create(self, kind: str, obj: dict,
+               event: Optional[str] = "ADDED") -> Optional[dict]:
+        """Atomic create: existence check + insert under ONE lock hold,
+        None when the object already exists. A check-then-put in the
+        handler would let two concurrent POSTs both succeed — for
+        Leases that is a split-brain in the very contract
+        (AlreadyExists on the acquire race) leader election rides on."""
+        with self._lock:
+            meta = obj.setdefault("metadata", {})
+            key = (meta.get("namespace", ""), meta["name"])
+            if key in self.objects[kind]:
+                return None
+            self._bump(obj)
+            self.objects[kind][key] = obj
+            if event:
+                self._notify(kind, event, obj)
+            return json.loads(json.dumps(obj))
+
     def get(self, kind: str, namespace: str, name: str) -> Optional[dict]:
         with self._lock:
             obj = self.objects[kind].get((namespace, name))
@@ -249,6 +267,31 @@ class WireStore:
             if namespace:
                 merged["metadata"]["namespace"] = namespace
             merged["metadata"]["uid"] = obj["metadata"]["uid"]
+            self._bump(merged)
+            self.objects[kind][(namespace, name)] = merged
+            self._notify(kind, "MODIFIED", merged)
+            return json.loads(json.dumps(merged))
+
+    def replace(self, kind: str, namespace: str, name: str,
+                body: dict) -> dict:
+        """PUT semantics with optimistic concurrency: the body's
+        metadata.resourceVersion must equal the stored one, or 409 —
+        the apiserver contract leader election's safety rides on.
+        Raises KeyError when absent, ValueError on version mismatch."""
+        with self._lock:
+            stored = self.objects[kind].get((namespace, name))
+            if stored is None:
+                raise KeyError(name)
+            want = str((body.get("metadata") or {})
+                       .get("resourceVersion") or "")
+            have = str(stored["metadata"].get("resourceVersion") or "")
+            if want != have:
+                raise ValueError(
+                    f"resourceVersion {want!r} does not match {have!r}")
+            merged = dict(body)
+            merged.setdefault("metadata", {})["name"] = name
+            merged["metadata"]["namespace"] = namespace
+            merged["metadata"]["uid"] = stored["metadata"]["uid"]
             self._bump(merged)
             self.objects[kind][(namespace, name)] = merged
             self._notify(kind, "MODIFIED", merged)
@@ -394,6 +437,9 @@ _APPS_RE = re.compile(
     r"^/apis/apps/v1/namespaces/([^/]+)/"
     r"(daemonsets|controllerrevisions)(?:/([^/]+))?$")
 _EVENT_RE = re.compile(r"^/api/v1/namespaces/([^/]+)/events(?:/([^/]+))?$")
+_LEASE_RE = re.compile(
+    r"^/apis/coordination\.k8s\.io/v1/namespaces/([^/]+)/"
+    r"leases(?:/([^/]+))?$")
 
 
 class WireHandler(BaseHTTPRequestHandler):
@@ -533,6 +579,13 @@ class WireHandler(BaseHTTPRequestHandler):
         if match and not match.group(2):
             return self._list_or_watch("events", match.group(1),
                                        "EventList")
+        match = _LEASE_RE.match(path)
+        if match and match.group(2):
+            obj = self.store.get("leases", match.group(1),
+                                 match.group(2))
+            if obj is None:
+                return self._status(404, "NotFound", "lease not found")
+            return self._send(200, obj)
         self._status(404, "NotFound", f"unknown path {path}")
 
     def do_PATCH(self) -> None:  # noqa: N802
@@ -593,18 +646,52 @@ class WireHandler(BaseHTTPRequestHandler):
             namespace = match.group(1)
             body = self._body()
             name = (body.get("metadata") or {}).get("name") or ""
-            if self.store.get("events", namespace, name) is not None:
+            body.setdefault("metadata", {})["namespace"] = namespace
+            created = self.store.create("events", body, event=None)
+            if created is None:
                 return self._status(
                     409, "AlreadyExists",
                     f"events \"{name}\" already exists")
-            body.setdefault("metadata", {})["namespace"] = namespace
-            return self._send(201, self.store.put("events", body,
-                                                  event=None))
+            return self._send(201, created)
         match = _POD_RE.match(path)
         if match and not match.group(2):
             body = self._body()
             body.setdefault("metadata", {})["namespace"] = match.group(1)
             return self._send(201, self.store.put("pods", body))
+        match = _LEASE_RE.match(path)
+        if match and not match.group(2):
+            namespace = match.group(1)
+            body = self._body()
+            name = (body.get("metadata") or {}).get("name") or ""
+            body.setdefault("metadata", {})["namespace"] = namespace
+            created = self.store.create("leases", body, event=None)
+            if created is None:
+                return self._status(
+                    409, "AlreadyExists",
+                    f"leases \"{name}\" already exists")
+            return self._send(201, created)
+        self._status(404, "NotFound", f"unknown path {path}")
+
+    def do_PUT(self) -> None:  # noqa: N802
+        path = self._path
+        self.store.request_log.append(f"PUT {path}")
+        if self._maybe_fault():
+            return
+        match = _LEASE_RE.match(path)
+        if match and match.group(2):
+            namespace, name = match.groups()
+            try:
+                out = self.store.replace("leases", namespace, name,
+                                         self._body())
+            except KeyError:
+                return self._status(404, "NotFound", "lease not found")
+            except ValueError as exc:
+                # the acquire/renew race: stale resourceVersion
+                return self._status(
+                    409, "Conflict",
+                    f"Operation cannot be fulfilled on leases "
+                    f"\"{name}\": {exc}")
+            return self._send(200, out)
         self._status(404, "NotFound", f"unknown path {path}")
 
     def do_DELETE(self) -> None:  # noqa: N802

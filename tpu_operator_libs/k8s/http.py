@@ -42,12 +42,14 @@ import json
 import logging
 import ssl
 import threading
+import time
 import urllib.error
 import urllib.parse
 import urllib.request
 from typing import Iterator, Mapping, Optional
 
 from tpu_operator_libs.k8s.client import (
+    AlreadyExistsError,
     ApiServerError,
     ConflictError,
     EvictionBlockedError,
@@ -60,6 +62,7 @@ from tpu_operator_libs.k8s.objects import (
     DaemonSet,
     DaemonSetSpec,
     DaemonSetStatus,
+    Lease,
     Node,
     NodeCondition,
     NodeSpec,
@@ -184,6 +187,70 @@ def controller_revision_from_json(obj: dict) -> ControllerRevision:
     return ControllerRevision(
         metadata=_meta_from_json(obj.get("metadata") or {}),
         revision=int(obj.get("revision") or 1))
+
+
+def _micro_time_to_epoch(value) -> Optional[float]:
+    """RFC3339 MicroTime -> epoch seconds (None passes through)."""
+    import calendar
+
+    if not value:
+        return None
+    base, _, frac = str(value).rstrip("Z").partition(".")
+    try:
+        parsed = time.strptime(base, "%Y-%m-%dT%H:%M:%S")
+    except ValueError:
+        return None
+    epoch = float(calendar.timegm(parsed))
+    if frac:
+        try:
+            epoch += float(f"0.{frac}")
+        except ValueError:
+            pass
+    return epoch
+
+
+def _epoch_to_micro_time(epoch: Optional[float]) -> Optional[str]:
+    if epoch is None:
+        return None
+    whole = time.strftime("%Y-%m-%dT%H:%M:%S", time.gmtime(epoch))
+    return f"{whole}.{int((epoch % 1.0) * 1e6):06d}Z"
+
+
+def lease_from_json(obj: dict) -> Lease:
+    meta = _meta_from_json(obj.get("metadata") or {})
+    # the apiserver's resourceVersion is an opaque string the update
+    # must echo verbatim — keep it raw, like the RealCluster adapter
+    meta.resource_version = (obj.get("metadata") or {}).get(
+        "resourceVersion") or 0
+    spec = obj.get("spec") or {}
+    return Lease(
+        metadata=meta,
+        holder_identity=spec.get("holderIdentity") or "",
+        lease_duration_seconds=int(
+            spec.get("leaseDurationSeconds") or 0),
+        acquire_time=_micro_time_to_epoch(spec.get("acquireTime")),
+        renew_time=_micro_time_to_epoch(spec.get("renewTime")),
+        lease_transitions=int(spec.get("leaseTransitions") or 0))
+
+
+def _lease_to_json(lease: Lease, with_version: bool) -> dict:
+    meta: dict = {"name": lease.metadata.name,
+                  "namespace": lease.metadata.namespace}
+    if with_version:
+        meta["resourceVersion"] = str(lease.metadata.resource_version)
+    spec: dict = {
+        "holderIdentity": lease.holder_identity,
+        "leaseDurationSeconds": lease.lease_duration_seconds,
+        "leaseTransitions": lease.lease_transitions,
+    }
+    acquire = _epoch_to_micro_time(lease.acquire_time)
+    renew = _epoch_to_micro_time(lease.renew_time)
+    if acquire:
+        spec["acquireTime"] = acquire
+    if renew:
+        spec["renewTime"] = renew
+    return {"apiVersion": "coordination.k8s.io/v1", "kind": "Lease",
+            "metadata": meta, "spec": spec}
 
 
 _KIND_PARSERS = {
@@ -410,6 +477,33 @@ class HttpCluster(K8sClient):
         except NotFoundError:
             # TTL-collected between the 409 and the PATCH; re-create
             self._request("POST", path, body)
+
+    # -- coordination.k8s.io Leases (leader election) ---------------------
+    def get_lease(self, namespace: str, name: str) -> Lease:
+        return lease_from_json(self._request(
+            "GET", f"/apis/coordination.k8s.io/v1/namespaces/"
+                   f"{namespace}/leases/{name}"))
+
+    def create_lease(self, lease: Lease) -> Lease:
+        try:
+            return lease_from_json(self._request(
+                "POST", f"/apis/coordination.k8s.io/v1/namespaces/"
+                        f"{lease.metadata.namespace}/leases",
+                _lease_to_json(lease, with_version=False)))
+        except ConflictError as exc:
+            # 409 on POST = already exists (the acquire race the
+            # elector retries after)
+            raise AlreadyExistsError(str(exc)) from exc
+
+    def update_lease(self, lease: Lease) -> Lease:
+        """PUT with the caller's resourceVersion: the apiserver's
+        optimistic-concurrency check is the entire leader-election
+        safety story — a stale holder's renew must 409."""
+        return lease_from_json(self._request(
+            "PUT", f"/apis/coordination.k8s.io/v1/namespaces/"
+                   f"{lease.metadata.namespace}/leases/"
+                   f"{lease.metadata.name}",
+            _lease_to_json(lease, with_version=True)))
 
     # -- watches ----------------------------------------------------------
     def watch(self, kinds: Optional[set[str]] = None,

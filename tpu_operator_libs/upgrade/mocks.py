@@ -112,6 +112,9 @@ class MockCordonManager(RecordingMixin):
 
 
 class MockDrainManager(RecordingMixin):
+    #: readable surface parity with the real manager (property there)
+    eviction_gate = None
+
     def __init__(self) -> None:
         super().__init__()
         self.fail_next: Optional[Exception] = None
@@ -130,6 +133,12 @@ class MockDrainManager(RecordingMixin):
 class MockPodManager(RecordingMixin):
     """Revision hashes come from an in-memory dict (default: everything in
     sync with hash 'test-hash-12345', upgrade_suit_test.go:144-156)."""
+
+    #: readable surface parity with the real manager (properties there;
+    #: state_manager reads pod_manager.eviction_gate when re-building
+    #: the manager for pod-deletion mode)
+    eviction_gate = None
+    deletion_filter = None
 
     def __init__(self) -> None:
         super().__init__()
@@ -177,6 +186,9 @@ class MockPodManager(RecordingMixin):
 
 
 class MockValidationManager(RecordingMixin):
+    #: readable surface parity with the real manager (property there)
+    pod_selector = ""
+
     def __init__(self, result: bool = True) -> None:
         super().__init__()
         self.result = result
