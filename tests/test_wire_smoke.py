@@ -375,6 +375,45 @@ class TestLeaseWire:
             client.update_lease(fresh)
         assert client.get_lease("ns", "op-lock").holder_identity == "b"
 
+    def test_renew_preserves_lease_wire_metadata(self, wire):
+        """A PUT is a replace: labels/annotations/ownerReferences on
+        the Lease (GC wiring, monitoring selectors) must survive every
+        renew — RealCluster caches the raw object for the same reason
+        (client-go LeaseLock parity)."""
+        server, client = wire
+        server.store.put("leases", {
+            "metadata": {"name": "op-lock", "namespace": "ns",
+                         "labels": {"team": "ml"},
+                         "annotations": {"note": "keep"},
+                         "ownerReferences": [{
+                             "kind": "ConfigMap", "name": "owner",
+                             "uid": "u9", "controller": True}]},
+            "spec": {"holderIdentity": ""}}, event=None)
+        lease = client.get_lease("ns", "op-lock")
+        lease.holder_identity = "a"
+        client.update_lease(lease)
+        stored = server.store.get("leases", "ns", "op-lock")
+        assert stored["metadata"]["labels"] == {"team": "ml"}
+        assert stored["metadata"]["annotations"] == {"note": "keep"}
+        assert stored["metadata"]["ownerReferences"][0]["name"] == \
+            "owner"
+        assert stored["spec"]["holderIdentity"] == "a"
+
+    def test_token_file_rotation_is_picked_up(self, wire, tmp_path):
+        """Bound SA tokens rotate ~hourly; the adapter must re-read the
+        file instead of serving the startup token forever."""
+        import os as _os
+
+        server, _ = wire
+        token_file = tmp_path / "token"
+        token_file.write_text("tok-v1\n")
+        client = HttpCluster(server.url,
+                             token_file=str(token_file))
+        assert client._token == "tok-v1"
+        token_file.write_text("tok-v2\n")
+        _os.utime(token_file, (1e9, 1e9))  # force a distinct mtime
+        assert client._token == "tok-v2"
+
     def test_two_contenders_elect_exactly_one_leader(self, wire):
         from tpu_operator_libs.k8s.leaderelection import (
             LeaderElectionConfig,
@@ -517,6 +556,82 @@ class TestCommittedPodDeletionArtifact:
             assert "pod-deletion-required" in walk
             assert "validation-required" in walk
             assert "drain-required" not in walk
+
+
+class TestOperatorCliOnHttpAdapter:
+    def test_packaged_cli_upgrades_a_fleet_over_http(self, tmp_path):
+        """The user-reachable dependency-free path: the REAL operator
+        CLI (`python -m ...libtpu_operator --api-server URL`) drives a
+        rolling upgrade against the wire apiserver — no kubernetes
+        package, no kubeconfig, just a URL (+ optional token/CA)."""
+        import subprocess
+        import sys as _sys
+
+        from wire_apiserver import ControllerSim
+        from wire_smoke import NS, WorkloadSim, seed
+
+        server = WireApiServer().start()
+        seed(server.store, 4)
+        controllers = ControllerSim(server.store)
+        workload = WorkloadSim(server.store)
+        controllers.start()
+        workload.start()
+        policy_file = tmp_path / "policy.json"
+        policy_file.write_text(json.dumps({
+            "autoUpgrade": True, "maxParallelUpgrades": 0,
+            "maxUnavailable": "50%",
+            "drain": {"enable": True, "force": True,
+                      "timeoutSeconds": 60}}))
+        env = dict(os.environ, JAX_PLATFORMS="cpu")
+        env.pop("PALLAS_AXON_POOL_IPS", None)  # stay off the tunnel
+        proc = subprocess.Popen(
+            [_sys.executable, "-m",
+             "tpu_operator_libs.examples.libtpu_operator",
+             "--api-server", server.url, "--policy", str(policy_file),
+             "--interval", "0.5"],
+            cwd=os.path.dirname(os.path.dirname(
+                os.path.abspath(__file__))),
+            env=env, stdout=subprocess.PIPE, stderr=subprocess.PIPE,
+            text=True)
+        label = "google.com/libtpu-upgrade-state"
+        try:
+            deadline = time.monotonic() + 90.0
+            done = False
+            while time.monotonic() < deadline:
+                if proc.poll() is not None:
+                    break  # operator died; fall through to assert
+                with server.store._lock:
+                    states = [
+                        ((obj.get("metadata") or {}).get("labels")
+                         or {}).get(label)
+                        for (_, _), obj in
+                        server.store.objects["nodes"].items()]
+                if states and all(s == "upgrade-done" for s in states):
+                    done = True
+                    break
+                time.sleep(0.5)
+        finally:
+            proc.terminate()
+            try:
+                out, err = proc.communicate(timeout=10)
+            except subprocess.TimeoutExpired:
+                proc.kill()
+                out, err = proc.communicate()
+            workload.stop()
+            controllers.stop()
+            server.stop()
+        assert done, (f"operator CLI did not converge the fleet; "
+                      f"rc={proc.returncode}, stderr tail: "
+                      f"{err[-2000:]!r}")
+        # the runtime pods really rolled to the new revision
+        with server.store._lock:
+            revisions = {
+                name: ((obj.get("metadata") or {}).get("labels") or {})
+                .get("controller-revision-hash")
+                for (ns, name), obj in
+                server.store.objects["pods"].items()
+                if ns == NS and name.startswith("libtpu-")}
+        assert revisions and set(revisions.values()) == {"newrev"}
 
 
 class TestWireFaultInjection:

@@ -342,6 +342,15 @@ def main() -> int:
     parser.add_argument("--api-burst", type=int, default=30,
                         help="client-side API burst size "
                              "(controller-runtime default 30)")
+    parser.add_argument("--api-server", default="",
+                        help="apiserver base URL (e.g. a kubectl proxy "
+                             "at http://127.0.0.1:8001): run on the "
+                             "dependency-free HTTP adapter instead of "
+                             "the kubernetes client package")
+    parser.add_argument("--token-file", default="",
+                        help="bearer-token file for --api-server")
+    parser.add_argument("--ca-file", default="",
+                        help="CA bundle for --api-server TLS")
     parser.add_argument("--kubeconfig", action="store_true",
                         help="connect via local kubeconfig (else in-cluster)")
     parser.add_argument("--leader-elect", action="store_true",
@@ -377,8 +386,6 @@ def main() -> int:
         if args.demo:
             return run_demo(args, registry)
 
-        from tpu_operator_libs.k8s.real import RealCluster
-
         limiter = None
         if args.api_qps > 0:
             # client-go charges every HTTP request against a token
@@ -390,10 +397,23 @@ def main() -> int:
 
             limiter = TokenBucketRateLimiter(
                 qps=args.api_qps, burst=args.api_burst)
-        cluster = (
-            RealCluster.from_kubeconfig(rate_limiter=limiter)
-            if args.kubeconfig
-            else RealCluster.in_cluster(rate_limiter=limiter))
+        if args.api_server:
+            # dependency-free path: no `kubernetes` package required;
+            # the token file is re-read on rotation (bound SA tokens
+            # expire ~hourly)
+            from tpu_operator_libs.k8s.http import HttpCluster
+
+            cluster = HttpCluster(args.api_server,
+                                  token_file=args.token_file or None,
+                                  ca_file=args.ca_file or None,
+                                  rate_limiter=limiter)
+        else:
+            from tpu_operator_libs.k8s.real import RealCluster
+
+            cluster = (
+                RealCluster.from_kubeconfig(rate_limiter=limiter)
+                if args.kubeconfig
+                else RealCluster.in_cluster(rate_limiter=limiter))
         policy = load_policy(args.policy)
         stop = threading.Event()
         signal.signal(signal.SIGTERM, lambda *a: stop.set())

@@ -233,11 +233,20 @@ def lease_from_json(obj: dict) -> Lease:
         lease_transitions=int(spec.get("leaseTransitions") or 0))
 
 
-def _lease_to_json(lease: Lease, with_version: bool) -> dict:
-    meta: dict = {"name": lease.metadata.name,
-                  "namespace": lease.metadata.namespace}
+def _lease_to_json(lease: Lease, with_version: bool,
+                   base_meta: Optional[dict] = None) -> dict:
+    """``base_meta``: the raw wire metadata from the last read of this
+    lease — a PUT is a REPLACE, so labels/annotations/ownerReferences
+    must ride along or every renew strips them (client-go's LeaseLock
+    mutates the Get result for the same reason; RealCluster caches the
+    raw object identically, real.py:485-527)."""
+    meta: dict = dict(base_meta or {})
+    meta["name"] = lease.metadata.name
+    meta["namespace"] = lease.metadata.namespace
     if with_version:
         meta["resourceVersion"] = str(lease.metadata.resource_version)
+    else:
+        meta.pop("resourceVersion", None)
     spec: dict = {
         "holderIdentity": lease.holder_identity,
         "leaseDurationSeconds": lease.lease_duration_seconds,
@@ -275,12 +284,24 @@ class HttpCluster(K8sClient):
 
     def __init__(self, base_url: str, token: Optional[str] = None,
                  ca_file: Optional[str] = None, insecure: bool = False,
-                 timeout_s: float = 30.0, list_chunk: int = 500) -> None:
+                 timeout_s: float = 30.0, list_chunk: int = 500,
+                 rate_limiter: Optional[object] = None,
+                 token_file: Optional[str] = None) -> None:
         self._base = base_url.rstrip("/")
-        self._token = token
+        self._static_token = token
+        # token_file wins over token and is re-read (mtime-cached) per
+        # request: bound service-account tokens rotate on disk (~1 h
+        # lifetime) and a once-read token would 401 the long-running
+        # operator after the first rotation
+        self._token_file = token_file
+        self._token_cache: tuple[float, str] = (-1.0, "")
         self._timeout = timeout_s
         self._chunk = list_chunk
+        # client-go placement: every HTTP request (each LIST page, each
+        # watch (re)establishment) charges one token at the transport
+        self._rate_limiter = rate_limiter
         self._watch_threads: list[threading.Thread] = []
+        self._lease_raw_meta: dict[tuple, dict] = {}
         if ca_file:
             self._ssl = ssl.create_default_context(cafile=ca_file)
         elif insecure:
@@ -293,15 +314,36 @@ class HttpCluster(K8sClient):
     @classmethod
     def in_cluster(cls, **kwargs: object) -> "HttpCluster":
         """Build from the pod's service-account credentials (what
-        client-go's rest.InClusterConfig does)."""
+        client-go's rest.InClusterConfig does). The token is wired as a
+        token_file so kubelet rotations of the bound token are picked
+        up live."""
         import os
 
         host = os.environ["KUBERNETES_SERVICE_HOST"]
         port = os.environ.get("KUBERNETES_SERVICE_PORT", "443")
+        # fail fast on missing credentials, like InClusterConfig
         with open(f"{SERVICEACCOUNT_DIR}/token") as fh:
-            token = fh.read().strip()
-        return cls(f"https://{host}:{port}", token=token,
+            fh.read()
+        return cls(f"https://{host}:{port}",
+                   token_file=f"{SERVICEACCOUNT_DIR}/token",
                    ca_file=f"{SERVICEACCOUNT_DIR}/ca.crt", **kwargs)
+
+    @property
+    def _token(self) -> Optional[str]:
+        if self._token_file is None:
+            return self._static_token
+        import os
+
+        try:
+            mtime = os.stat(self._token_file).st_mtime
+        except OSError:
+            # keep serving the last-known token through a transient
+            # stat failure; auth errors will surface loudly if stale
+            return self._token_cache[1] or self._static_token
+        if mtime != self._token_cache[0]:
+            with open(self._token_file) as fh:
+                self._token_cache = (mtime, fh.read().strip())
+        return self._token_cache[1]
 
     # -- plumbing ---------------------------------------------------------
     def _request(self, method: str, path: str, body: Optional[dict] = None,
@@ -310,6 +352,8 @@ class HttpCluster(K8sClient):
         """One API call -> parsed JSON. Maps HTTP errors onto the
         client-seam exception types (client.py), so callers are backend
         agnostic."""
+        if self._rate_limiter is not None:
+            self._rate_limiter.wait()
         data = None if body is None else json.dumps(body).encode()
         req = urllib.request.Request(
             f"{self._base}{path}", data=data, method=method)
@@ -479,17 +523,24 @@ class HttpCluster(K8sClient):
             self._request("POST", path, body)
 
     # -- coordination.k8s.io Leases (leader election) ---------------------
+    def _remember_lease_meta(self, raw: dict) -> dict:
+        meta = raw.get("metadata") or {}
+        self._lease_raw_meta[(meta.get("namespace", ""),
+                              meta.get("name", ""))] = dict(meta)
+        return raw
+
     def get_lease(self, namespace: str, name: str) -> Lease:
-        return lease_from_json(self._request(
+        return lease_from_json(self._remember_lease_meta(self._request(
             "GET", f"/apis/coordination.k8s.io/v1/namespaces/"
-                   f"{namespace}/leases/{name}"))
+                   f"{namespace}/leases/{name}")))
 
     def create_lease(self, lease: Lease) -> Lease:
         try:
-            return lease_from_json(self._request(
-                "POST", f"/apis/coordination.k8s.io/v1/namespaces/"
-                        f"{lease.metadata.namespace}/leases",
-                _lease_to_json(lease, with_version=False)))
+            return lease_from_json(self._remember_lease_meta(
+                self._request(
+                    "POST", f"/apis/coordination.k8s.io/v1/namespaces/"
+                            f"{lease.metadata.namespace}/leases",
+                    _lease_to_json(lease, with_version=False))))
         except ConflictError as exc:
             # 409 on POST = already exists (the acquire race the
             # elector retries after)
@@ -498,12 +549,16 @@ class HttpCluster(K8sClient):
     def update_lease(self, lease: Lease) -> Lease:
         """PUT with the caller's resourceVersion: the apiserver's
         optimistic-concurrency check is the entire leader-election
-        safety story — a stale holder's renew must 409."""
-        return lease_from_json(self._request(
+        safety story — a stale holder's renew must 409. The replace
+        body carries the last-read wire metadata so renews never strip
+        labels/annotations/ownerReferences."""
+        key = (lease.metadata.namespace, lease.metadata.name)
+        return lease_from_json(self._remember_lease_meta(self._request(
             "PUT", f"/apis/coordination.k8s.io/v1/namespaces/"
                    f"{lease.metadata.namespace}/leases/"
                    f"{lease.metadata.name}",
-            _lease_to_json(lease, with_version=True)))
+            _lease_to_json(lease, with_version=True,
+                           base_meta=self._lease_raw_meta.get(key)))))
 
     # -- watches ----------------------------------------------------------
     def watch(self, kinds: Optional[set[str]] = None,
@@ -553,6 +608,8 @@ class HttpCluster(K8sClient):
         backoff = 1.0
         first = True
         while not watch.stopped:
+            if self._rate_limiter is not None:
+                self._rate_limiter.wait()  # charge the (re)establish
             req = urllib.request.Request(
                 f"{self._base}{path}?watch=true")
             req.add_header("Accept", _JSON)
