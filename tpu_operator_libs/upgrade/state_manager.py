@@ -36,6 +36,7 @@ because draining one host of a multi-host TPU slice idles the entire slice
 
 from __future__ import annotations
 
+import contextlib
 import logging
 from dataclasses import dataclass, field
 from typing import TYPE_CHECKING, Optional, Protocol
@@ -59,7 +60,12 @@ from tpu_operator_libs.consts import (
     UpgradeKeys,
     UpgradeState,
 )
-from tpu_operator_libs.k8s.client import K8sClient
+from tpu_operator_libs.k8s.client import (
+    ApiServerError,
+    ConflictError,
+    K8sClient,
+    NotFoundError,
+)
 from tpu_operator_libs.k8s.objects import DaemonSet, Node, Pod, PodPhase
 from tpu_operator_libs.k8s.selectors import selector_from_labels
 from tpu_operator_libs.upgrade.cordon_manager import CordonManager
@@ -220,6 +226,10 @@ class ClusterUpgradeStateManager:
         # no state-machine meaning — apply_state stays snapshot-driven)
         self._warned_vanished: set[str] = set()
         self._validation_enabled = False
+        #: Count of per-node transitions deferred on a transient
+        #: cluster error (see _defer_node_on_transient) — observability
+        #: for flaky-apiserver diagnosis.
+        self._transient_deferrals = 0
 
     @property
     def planner(self) -> UpgradePlanner:
@@ -396,8 +406,12 @@ class ClusterUpgradeStateManager:
     # ------------------------------------------------------------------
     def apply_state(self, state: ClusterUpgradeState,
                     policy: Optional[UpgradePolicySpec]) -> None:
-        """One transition pass. Raises on the first hard error; the caller
-        re-reconciles (idempotence guarantees forward progress)."""
+        """One transition pass. Raises on the first HARD error; the caller
+        re-reconciles (idempotence guarantees forward progress).
+        TRANSIENT cluster errors (5xx/conflict/vanished object) defer
+        only the affected node and the pass continues — see
+        _defer_node_on_transient for why this deliberately diverges
+        from the reference's abort-whole-pass semantics."""
         if state is None:
             raise ValueError("currentState should not be empty")
         if policy is None or not policy.auto_upgrade:
@@ -466,30 +480,63 @@ class ClusterUpgradeStateManager:
     # ------------------------------------------------------------------
     # per-state processors
     # ------------------------------------------------------------------
+    @contextlib.contextmanager
+    def _defer_node_on_transient(self, node: Node, action: str):
+        """Context manager isolating one node's transition from
+        TRANSIENT cluster errors (5xx / write conflict / object
+        vanished): the node simply stays in its current state and the
+        next reconcile retries it, while the rest of the pass keeps
+        processing.
+
+        Deliberate delta from the reference, which aborts the whole
+        ApplyState pass on the first error (upgrade_state.go:420-423):
+        under a sustained apiserver error rate an aborted pass rarely
+        reaches the later state buckets of a large fleet — measured on
+        the wire smoke, a 16-node upgrade through 30% injected 500s
+        effectively stalled, because reaching the Nth node's write
+        required every preceding request to succeed (~0.7^N per pass).
+        Per-node isolation preserves idempotence (a deferred node is
+        indistinguishable from one the snapshot missed) and keeps the
+        fleet converging at the per-node success rate instead of the
+        per-pass one. Hard errors (anything not a transient seam
+        error) still abort the pass, exactly like the reference
+        (pinned by test_cordon_failure_aborts_pass)."""
+        try:
+            yield
+        except (ApiServerError, ConflictError, NotFoundError) as exc:
+            logger.warning(
+                "transient cluster error during %s for node %s; "
+                "deferring the node to the next reconcile: %s",
+                action, node.metadata.name, exc)
+            self._transient_deferrals += 1
+
     def process_done_or_unknown_nodes(self, state: ClusterUpgradeState,
                                       bucket: UpgradeState) -> None:
         """Decide done vs upgrade-required for idle nodes
         (upgrade_state.go:486-550)."""
         for ns in state.bucket(bucket):
-            pod_synced, orphaned = self._pod_in_sync_with_ds(ns)
-            upgrade_requested = self._is_upgrade_requested(ns.node)
-            waiting_safe_load = (
-                self.safe_load_manager.is_waiting_for_safe_load(ns.node))
-            if (not pod_synced and not orphaned) or waiting_safe_load \
-                    or upgrade_requested:
-                if ns.node.is_unschedulable():
-                    # Remember pre-upgrade cordon so we restore it at the
-                    # end (upgrade_state.go:509-523).
-                    self.provider.change_node_upgrade_annotation(
-                        ns.node, self.keys.initial_state_annotation,
-                        TRUE_STRING)
-                self.provider.change_node_upgrade_state(
-                    ns.node, UpgradeState.UPGRADE_REQUIRED)
-                logger.info("node %s requires upgrade", ns.node.metadata.name)
-                continue
-            if bucket == UpgradeState.UNKNOWN:
-                self.provider.change_node_upgrade_state(
-                    ns.node, UpgradeState.DONE)
+            with self._defer_node_on_transient(ns.node, "idle triage"):
+                pod_synced, orphaned = self._pod_in_sync_with_ds(ns)
+                upgrade_requested = self._is_upgrade_requested(ns.node)
+                waiting_safe_load = (
+                    self.safe_load_manager.is_waiting_for_safe_load(
+                        ns.node))
+                if (not pod_synced and not orphaned) or waiting_safe_load \
+                        or upgrade_requested:
+                    if ns.node.is_unschedulable():
+                        # Remember pre-upgrade cordon so we restore it at
+                        # the end (upgrade_state.go:509-523).
+                        self.provider.change_node_upgrade_annotation(
+                            ns.node, self.keys.initial_state_annotation,
+                            TRUE_STRING)
+                    self.provider.change_node_upgrade_state(
+                        ns.node, UpgradeState.UPGRADE_REQUIRED)
+                    logger.info("node %s requires upgrade",
+                                ns.node.metadata.name)
+                    continue
+                if bucket == UpgradeState.UNKNOWN:
+                    self.provider.change_node_upgrade_state(
+                        ns.node, UpgradeState.DONE)
 
     @property
     def multislice_deferred_slices(self) -> tuple[str, ...]:
@@ -566,26 +613,34 @@ class ClusterUpgradeStateManager:
         planner = planner or self.planner
         candidates = []
         for ns in state.bucket(UpgradeState.UPGRADE_REQUIRED):
-            if self._is_upgrade_requested(ns.node):
-                # one-shot trigger: consume the annotation
-                self.provider.change_node_upgrade_annotation(
-                    ns.node, self.keys.upgrade_requested_annotation, None)
-            if self._skip_node_upgrade(ns.node):
-                logger.info("node %s is marked to skip upgrades",
-                            ns.node.metadata.name)
-                continue
-            candidates.append(ns)
+            with self._defer_node_on_transient(ns.node,
+                                               "upgrade triage"):
+                if self._is_upgrade_requested(ns.node):
+                    # one-shot trigger: consume the annotation
+                    self.provider.change_node_upgrade_annotation(
+                        ns.node, self.keys.upgrade_requested_annotation,
+                        None)
+                if self._skip_node_upgrade(ns.node):
+                    logger.info("node %s is marked to skip upgrades",
+                                ns.node.metadata.name)
+                    continue
+                candidates.append(ns)
         for ns in planner.plan(candidates, upgrades_available, state):
-            self.provider.change_node_upgrade_state(
-                ns.node, UpgradeState.CORDON_REQUIRED)
-            logger.info("node %s waiting for cordon", ns.node.metadata.name)
+            # a deferred node's slot stays consumed for this pass —
+            # conservative under the throttle, corrected next pass
+            with self._defer_node_on_transient(ns.node, "upgrade start"):
+                self.provider.change_node_upgrade_state(
+                    ns.node, UpgradeState.CORDON_REQUIRED)
+                logger.info("node %s waiting for cordon",
+                            ns.node.metadata.name)
 
     def process_cordon_required_nodes(self, state: ClusterUpgradeState) -> None:
         """Cordon and advance to wait-for-jobs (upgrade_state.go:635-654)."""
         for ns in state.bucket(UpgradeState.CORDON_REQUIRED):
-            self.cordon_manager.cordon(ns.node)
-            self.provider.change_node_upgrade_state(
-                ns.node, UpgradeState.WAIT_FOR_JOBS_REQUIRED)
+            with self._defer_node_on_transient(ns.node, "cordon"):
+                self.cordon_manager.cordon(ns.node)
+                self.provider.change_node_upgrade_state(
+                    ns.node, UpgradeState.WAIT_FOR_JOBS_REQUIRED)
 
     def process_wait_for_jobs_required_nodes(
             self, state: ClusterUpgradeState,
@@ -641,8 +696,10 @@ class ClusterUpgradeStateManager:
         nodes = [ns.node for ns in state.bucket(UpgradeState.DRAIN_REQUIRED)]
         if drain_spec is None or not drain_spec.enable:
             for node in nodes:
-                self.provider.change_node_upgrade_state(
-                    node, UpgradeState.POD_RESTART_REQUIRED)
+                with self._defer_node_on_transient(node,
+                                                   "drain-disabled skip"):
+                    self.provider.change_node_upgrade_state(
+                        node, UpgradeState.POD_RESTART_REQUIRED)
             return
         if not nodes:
             return
@@ -654,27 +711,29 @@ class ClusterUpgradeStateManager:
         ready (upgrade_state.go:764-831)."""
         pods_to_restart = []
         for ns in state.bucket(UpgradeState.POD_RESTART_REQUIRED):
-            pod_synced, orphaned = self._pod_in_sync_with_ds(ns)
-            if not pod_synced or orphaned:
-                # Only restart pods not already terminating
-                # (upgrade_state.go:775-781).
-                if ns.runtime_pod.metadata.deletion_timestamp is None:
-                    pods_to_restart.append(ns.runtime_pod)
-                continue
-            # Pod template is current: release any blocked safe load, then
-            # wait for readiness.
-            self.safe_load_manager.unblock_loading(ns.node)
-            if self._is_runtime_pod_in_sync(ns):
-                if not self._validation_enabled:
-                    self._update_node_to_uncordon_or_done(ns.node)
+            with self._defer_node_on_transient(ns.node, "pod restart"):
+                pod_synced, orphaned = self._pod_in_sync_with_ds(ns)
+                if not pod_synced or orphaned:
+                    # Only restart pods not already terminating
+                    # (upgrade_state.go:775-781).
+                    if ns.runtime_pod.metadata.deletion_timestamp is None:
+                        pods_to_restart.append(ns.runtime_pod)
                     continue
-                self.provider.change_node_upgrade_state(
-                    ns.node, UpgradeState.VALIDATION_REQUIRED)
-            elif ns.runtime_pod.is_failing(POD_RESTART_FAILURE_THRESHOLD):
-                logger.info("runtime pod failing on node %s with repeated "
-                            "restarts", ns.node.metadata.name)
-                self.provider.change_node_upgrade_state(
-                    ns.node, UpgradeState.FAILED)
+                # Pod template is current: release any blocked safe load,
+                # then wait for readiness.
+                self.safe_load_manager.unblock_loading(ns.node)
+                if self._is_runtime_pod_in_sync(ns):
+                    if not self._validation_enabled:
+                        self._update_node_to_uncordon_or_done(ns.node)
+                        continue
+                    self.provider.change_node_upgrade_state(
+                        ns.node, UpgradeState.VALIDATION_REQUIRED)
+                elif ns.runtime_pod.is_failing(
+                        POD_RESTART_FAILURE_THRESHOLD):
+                    logger.info("runtime pod failing on node %s with "
+                                "repeated restarts", ns.node.metadata.name)
+                    self.provider.change_node_upgrade_state(
+                        ns.node, UpgradeState.FAILED)
         self.pod_manager.schedule_pods_restart(pods_to_restart)
 
     def process_upgrade_failed_nodes(self, state: ClusterUpgradeState) -> None:
@@ -691,30 +750,35 @@ class ClusterUpgradeStateManager:
         passes.
         """
         for ns in state.bucket(UpgradeState.FAILED):
-            if not self._is_runtime_pod_in_sync(ns):
-                continue
-            # check(), not validate(): the recovery gate must not stamp or
-            # expire validation timers on an already-failed node.
-            if self._validation_enabled \
-                    and not self.validation_manager.check(ns.node):
-                logger.info("failed node %s has a healthy pod but has not "
-                            "passed validation; holding",
-                            ns.node.metadata.name)
-                continue
-            self._update_node_to_uncordon_or_done(ns.node)
+            with self._defer_node_on_transient(ns.node,
+                                               "failed-node recovery"):
+                if not self._is_runtime_pod_in_sync(ns):
+                    continue
+                # check(), not validate(): the recovery gate must not
+                # stamp or expire validation timers on an already-failed
+                # node.
+                if self._validation_enabled \
+                        and not self.validation_manager.check(ns.node):
+                    logger.info("failed node %s has a healthy pod but has "
+                                "not passed validation; holding",
+                                ns.node.metadata.name)
+                    continue
+                self._update_node_to_uncordon_or_done(ns.node)
 
     def process_validation_required_nodes(
             self, state: ClusterUpgradeState) -> None:
         """Run the validation gate (upgrade_state.go:880-911)."""
         for ns in state.bucket(UpgradeState.VALIDATION_REQUIRED):
-            # The runtime pod may have restarted after entering this state
-            # and be blocked on safe load again (upgrade_state.go:886-893).
-            self.safe_load_manager.unblock_loading(ns.node)
-            if not self.validation_manager.validate(ns.node):
-                logger.info("validation not complete on node %s",
-                            ns.node.metadata.name)
-                continue
-            self._update_node_to_uncordon_or_done(ns.node)
+            with self._defer_node_on_transient(ns.node, "validation"):
+                # The runtime pod may have restarted after entering this
+                # state and be blocked on safe load again
+                # (upgrade_state.go:886-893).
+                self.safe_load_manager.unblock_loading(ns.node)
+                if not self.validation_manager.validate(ns.node):
+                    logger.info("validation not complete on node %s",
+                                ns.node.metadata.name)
+                    continue
+                self._update_node_to_uncordon_or_done(ns.node)
 
     def process_uncordon_required_nodes(
             self, state: ClusterUpgradeState) -> None:
@@ -728,17 +792,18 @@ class ClusterUpgradeStateManager:
         write itself still carries the optimistic-concurrency check.
         """
         for ns in state.bucket(UpgradeState.UNCORDON_REQUIRED):
-            current = self.provider.get_node(ns.node.metadata.name) \
-                .metadata.labels.get(self.keys.state_label, "")
-            if current != str(UpgradeState.UNCORDON_REQUIRED):
-                logger.warning(
-                    "node %s is %r, not uncordon-required: snapshot is "
-                    "stale; skipping uncordon",
-                    ns.node.metadata.name, current or "unknown")
-                continue
-            self.cordon_manager.uncordon(ns.node)
-            self.provider.change_node_upgrade_state(
-                ns.node, UpgradeState.DONE)
+            with self._defer_node_on_transient(ns.node, "uncordon"):
+                current = self.provider.get_node(ns.node.metadata.name) \
+                    .metadata.labels.get(self.keys.state_label, "")
+                if current != str(UpgradeState.UNCORDON_REQUIRED):
+                    logger.warning(
+                        "node %s is %r, not uncordon-required: snapshot "
+                        "is stale; skipping uncordon",
+                        ns.node.metadata.name, current or "unknown")
+                    continue
+                self.cordon_manager.uncordon(ns.node)
+                self.provider.change_node_upgrade_state(
+                    ns.node, UpgradeState.DONE)
 
     # ------------------------------------------------------------------
     # predicates
