@@ -167,6 +167,23 @@ class TestMetricsRegistry:
         # CRD-embeddable: must round-trip through JSON unchanged
         assert json.loads(json.dumps(status)) == status
 
+    def test_cluster_status_surfaces_transient_deferrals(self):
+        env = make_env()
+        ds = DaemonSetBuilder("libtpu").with_labels(dict(RUNTIME_LABELS)) \
+            .with_desired_scheduled(1).create(env.cluster)
+        node = NodeBuilder("n0").with_upgrade_state(
+            env.keys, UpgradeState.CORDON_REQUIRED).create(env.cluster)
+        PodBuilder("p0").on_node(node).owned_by(ds) \
+            .with_revision_hash("rev1").create(env.cluster)
+        mgr = make_state_manager(env)
+        status = mgr.cluster_status(mgr.build_state(NS, RUNTIME_LABELS))
+        assert "transientDeferrals" not in status  # healthy: absent
+        env.cluster.inject_api_errors("set_node_unschedulable", 1)
+        mgr.process_cordon_required_nodes(
+            mgr.build_state(NS, RUNTIME_LABELS))
+        status = mgr.cluster_status(mgr.build_state(NS, RUNTIME_LABELS))
+        assert status["transientDeferrals"] == 1
+
     def test_cluster_status_surfaces_unrecognized_labels(self):
         env = make_env()
         ds = DaemonSetBuilder("libtpu").with_labels(dict(RUNTIME_LABELS)) \

@@ -283,6 +283,12 @@ def run_smoke(n_nodes: int = 4, timeout_s: float = 120.0,
             total = sum(len(b) for b in buckets.values())
             if total == n_nodes and done == total:
                 all_done.set()
+        if state_mgr[0].last_pass_deferrals:
+            from tpu_operator_libs.controller import ReconcileResult
+
+            # deferred nodes emitted no watch event; requeue with the
+            # controller's backoff instead of waiting out the resync
+            return ReconcileResult(requeue=True)
         return None
 
     manager = OperatorManager(client, NS, reconcile_fn,

@@ -437,6 +437,12 @@ def main() -> int:
                     held["mgr"] = build_manager(args, op_mgr.client)
                 reconcile_once(held["mgr"], args, policy, registry,
                                runtime_labels)
+                if held["mgr"].last_pass_deferrals:
+                    # a transiently-deferred node produced no cluster
+                    # change, hence no watch event — requeue with the
+                    # controller's error backoff instead of waiting
+                    # out the resync interval
+                    return ReconcileResult(requeue=True)
                 return ReconcileResult()
 
             election = election_config(args) if args.leader_elect else None

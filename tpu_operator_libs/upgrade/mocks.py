@@ -158,8 +158,9 @@ class MockPodManager(RecordingMixin):
         self.record("schedule_pod_eviction",
                     tuple(n.metadata.name for n in config.nodes))
 
-    def schedule_pods_restart(self, pods: list[Pod]) -> None:
+    def schedule_pods_restart(self, pods: list[Pod]) -> int:
         self.record("schedule_pods_restart", tuple(p.name for p in pods))
+        return 0  # same contract as the real manager: deferred count
 
     def schedule_check_on_pod_completion(
             self, config: PodManagerConfig) -> None:

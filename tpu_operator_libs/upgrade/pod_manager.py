@@ -266,15 +266,28 @@ class PodManager:
     # ------------------------------------------------------------------
     # (b) restart runtime pods
     # ------------------------------------------------------------------
-    def schedule_pods_restart(self, pods: list[Pod]) -> None:
+    def schedule_pods_restart(self, pods: list[Pod]) -> int:
         """Delete runtime pods so the DaemonSet controller recreates them
-        with the new template (pod_manager.go:236-254). Synchronous; an
-        error aborts the reconcile pass."""
+        with the new template (pod_manager.go:236-254). Synchronous.
+
+        A TRANSIENT cluster error (5xx / conflict) on one pod's delete
+        defers only that pod — its node re-enters pod-restart-required
+        on the next reconcile — and the remaining pods still restart
+        (the same per-node isolation the state manager's processors
+        apply; under a sustained apiserver error rate an abort here
+        skipped every later pod AND every later state bucket). Hard
+        errors still abort the pass. Returns the number of deferred
+        pods so callers can requeue promptly."""
         if not pods:
             logger.info("no pods scheduled to restart")
-            return
-        from tpu_operator_libs.k8s.client import NotFoundError
+            return 0
+        from tpu_operator_libs.k8s.client import (
+            ApiServerError,
+            ConflictError,
+            NotFoundError,
+        )
 
+        deferred = 0
         for pod in pods:
             logger.info("deleting pod %s", pod.name)
             try:
@@ -283,11 +296,17 @@ class PodManager:
                 # Already gone (e.g. a concurrent reconcile won the race):
                 # the restart goal is achieved — idempotent by design.
                 logger.info("pod %s already deleted", pod.name)
+            except (ApiServerError, ConflictError) as exc:
+                logger.warning("transient error deleting pod %s; "
+                               "deferring to the next reconcile: %s",
+                               pod.name, exc)
+                deferred += 1
             except Exception as exc:
                 log_event(self._recorder, pod, Event.WARNING,
                           self._keys.event_reason,
                           f"Failed to restart runtime pod: {exc}")
                 raise
+        return deferred
 
     # ------------------------------------------------------------------
     # (c) wait for workload completion

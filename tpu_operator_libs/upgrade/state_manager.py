@@ -226,10 +226,18 @@ class ClusterUpgradeStateManager:
         # no state-machine meaning — apply_state stays snapshot-driven)
         self._warned_vanished: set[str] = set()
         self._validation_enabled = False
-        #: Count of per-node transitions deferred on a transient
-        #: cluster error (see _defer_node_on_transient) — observability
-        #: for flaky-apiserver diagnosis.
+        #: Lifetime count of per-node transitions deferred on a
+        #: transient cluster error (see _defer_node_on_transient).
         self._transient_deferrals = 0
+        #: Same, for the most recent apply_state pass — the
+        #: CURRENT-flakiness signal callers requeue on (a swallowed
+        #: deferral produces no watch event, so without a prompt
+        #: requeue the retry would wait out the resync period). After
+        #: a chained reconcile() this holds the FINAL pass's count,
+        #: i.e. the deferrals still outstanding at chain exit — a
+        #: deferral an earlier chain pass already retried successfully
+        #: does not linger here.
+        self.last_pass_deferrals = 0
 
     @property
     def planner(self) -> UpgradePlanner:
@@ -414,6 +422,7 @@ class ClusterUpgradeStateManager:
         from the reference's abort-whole-pass semantics."""
         if state is None:
             raise ValueError("currentState should not be empty")
+        self.last_pass_deferrals = 0
         if policy is None or not policy.auto_upgrade:
             logger.info("auto upgrade is disabled, skipping")
             # no planning happens while disabled: previously reported
@@ -509,6 +518,7 @@ class ClusterUpgradeStateManager:
                 "deferring the node to the next reconcile: %s",
                 action, node.metadata.name, exc)
             self._transient_deferrals += 1
+            self.last_pass_deferrals += 1
 
     def process_done_or_unknown_nodes(self, state: ClusterUpgradeState,
                                       bucket: UpgradeState) -> None:
@@ -734,7 +744,10 @@ class ClusterUpgradeStateManager:
                                 "repeated restarts", ns.node.metadata.name)
                     self.provider.change_node_upgrade_state(
                         ns.node, UpgradeState.FAILED)
-        self.pod_manager.schedule_pods_restart(pods_to_restart)
+        deferred_pods = self.pod_manager.schedule_pods_restart(
+            pods_to_restart)
+        self._transient_deferrals += deferred_pods
+        self.last_pass_deferrals += deferred_pods
 
     def process_upgrade_failed_nodes(self, state: ClusterUpgradeState) -> None:
         """Auto-recover failed nodes whose pod became healthy
@@ -947,6 +960,13 @@ class ClusterUpgradeStateManager:
             # why the upgrade is pacing: these slices wait for a member
             # of their DCN job to come back up
             status["multisliceDeferredSlices"] = list(deferred)
+        # per-node transitions deferred on transient cluster errors in
+        # the MOST RECENT pass (after a chained reconcile: the count
+        # still outstanding at chain exit) — a current-flakiness
+        # signal; the status block is per snapshot, so the lifetime
+        # total stays in _transient_deferrals for metrics/debugging
+        if self.last_pass_deferrals:
+            status["transientDeferrals"] = self.last_pass_deferrals
         return status
 
     # ------------------------------------------------------------------
