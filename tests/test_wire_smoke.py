@@ -84,6 +84,43 @@ class TestWireSelectors:
         assert match_label_selector("!missing", labels)
         assert not match_label_selector("app=api", labels)
 
+    def test_agrees_with_library_selector_engine(self):
+        """Cross-validation of the two INDEPENDENT implementations on
+        their shared grammar (equality / != / in / notin / exists /
+        !key): the operator sends selectors built by
+        `selectors.selector_from_labels` to the wire double, so a
+        divergence here would mean the smoke tests a different
+        predicate than production evaluates."""
+        from hypothesis import given, settings
+        from hypothesis import strategies as st
+
+        from tpu_operator_libs.k8s.selectors import matches_labels
+
+        keys = st.sampled_from(["a", "b", "app", "env", "tier"])
+        vals = st.sampled_from(["1", "2", "x", "prod", "canary", ""])
+        req = st.one_of(
+            st.tuples(keys, st.sampled_from(["=", "==", "!="]), vals)
+            .map(lambda t: f"{t[0]}{t[1]}{t[2]}"),
+            # empty entries included on purpose: "a in (x,)" (trailing
+            # comma) is where the two parsers originally diverged
+            st.tuples(keys, st.sampled_from(["in", "notin"]),
+                      st.lists(vals, min_size=1, max_size=3))
+            .map(lambda t: f"{t[0]} {t[1]} ({','.join(t[2])})"),
+            keys,
+            keys.map(lambda k: f"!{k}"),
+        )
+        selectors = st.lists(req, min_size=0, max_size=4).map(",".join)
+        label_dicts = st.dictionaries(keys, vals, max_size=4)
+
+        @settings(max_examples=300, deadline=None)
+        @given(selector=selectors, labels=label_dicts)
+        def check(selector, labels):
+            got = match_label_selector(selector, labels)
+            want = matches_labels(selector, labels)
+            assert got is want, (selector, labels, got, want)
+
+        check()
+
 
 def _self_signed_ca_pem() -> bytes:
     """Throwaway self-signed cert for CA-pinning tests (minted in

@@ -91,7 +91,11 @@ def match_label_selector(selector: str, labels: dict) -> bool:
             continue
         match = _SET_RE.match(req)
         if match:
-            values = {v.strip() for v in match.group("vals").split(",")}
+            # empty entries (trailing commas) are dropped, matching the
+            # library engine (k8s/selectors.py) — cross-validated by
+            # the property test in tests/test_wire_smoke.py
+            values = {v.strip() for v in match.group("vals").split(",")
+                      if v.strip()}
             has = labels.get(match.group("key"))
             ok = has in values
             if match.group("op") == "notin":
