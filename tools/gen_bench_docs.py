@@ -99,6 +99,14 @@ def render(capture: dict) -> str:
          f"{fmt(capture.get('decode_int8_tok_s'), '{} tok/s')} = "
          f"{fmt(capture.get('decode_int8_roofline_pct'), '{} %')} of "
          "its (2× higher) roofline"),
+        # rendered only when the capture is new enough to carry the
+        # cell at all — a pre-int8-KV capture omits the row instead of
+        # publishing "null" for a cell its bench never ran
+        *([("greedy decode, int8 weights + int8 KV cache",
+            f"{fmt(capture.get('decode_int8_kv_tok_s'), '{} tok/s')} = "
+            f"{fmt(capture.get('decode_int8_kv_roofline_pct'), '{} %')} "
+            "of the int8 weight-stream roofline")]
+          if "decode_int8_kv_tok_s" in capture else []),
         ("seq-8192 forward, flash vs XLA attention",
          f"{fmt(capture.get('flash_attention_speedup'), '{}×')} "
          f"({fmt(flash, '{}')} vs {fmt(xla, '{}')} ms)"),

@@ -19,22 +19,72 @@ from __future__ import annotations
 
 
 def init_kv_cache(mesh, config, batch: int, max_seq: int,
-                  param_dtype=None):
+                  param_dtype=None, quantize_kv: bool = False):
     """Per-layer K/V buffers (B, max_seq, n_kv_heads, head_dim),
     zero-filled; sharded over tp on the KV-head axis when the mesh
-    carries a tp axis."""
+    carries a tp axis.
+
+    With ``quantize_kv=True`` the buffers are int8 with a per-token
+    per-kv-head float32 scale (``k_s``/``v_s``, (B, max_seq, n_kv)):
+    at serving context lengths the cache — not the weights — is the
+    dominant HBM stream of each decode step (e.g. 277M bf16 weights
+    are ~0.55 GB read once per step, while a batch-8 ctx-1024 bf16
+    cache is ~1 GB read per step), so halving the cache bytes is the
+    rung of the memory-bound roofline that weight-only int8
+    (:func:`quantize_params_int8`) cannot reach. Unlike weight
+    quantization the write side is in the hot loop, so the scheme is
+    chosen so both sides fuse: symmetric per-(token, kv-head) scales
+    make the K dequant a rank-1 rescale of the score matrix AFTER the
+    int8 einsum and the V dequant a rescale of the attention weights
+    BEFORE the value einsum — HBM sees int8 bytes, the MXU sees the
+    activation dtype, and nothing ever materializes a dequantized
+    cache."""
     import jax
     import jax.numpy as jnp
     from jax.sharding import NamedSharding, PartitionSpec as P
 
     dtype = param_dtype or jnp.float32
-    spec = (P("dp", None, "tp", None)
-            if "tp" in mesh.axis_names else P("dp", None, None, None))
+    tp = "tp" in mesh.axis_names
+    spec = (P("dp", None, "tp", None) if tp
+            else P("dp", None, None, None))
     shape = (batch, max_seq, config.n_kv_heads, config.head_dim)
+    if quantize_kv:
+        s_spec = P("dp", None, "tp") if tp else P("dp", None, None)
+        q0 = jnp.zeros(shape, jnp.int8)
+        s0 = jnp.zeros(shape[:3], jnp.float32)
+        return [{"k": jax.device_put(q0, NamedSharding(mesh, spec)),
+                 "k_s": jax.device_put(s0, NamedSharding(mesh, s_spec)),
+                 "v": jax.device_put(q0, NamedSharding(mesh, spec)),
+                 "v_s": jax.device_put(s0, NamedSharding(mesh, s_spec))}
+                for _ in range(config.n_layers)]
     zeros = jnp.zeros(shape, dtype)
     return [{"k": jax.device_put(zeros, NamedSharding(mesh, spec)),
              "v": jax.device_put(zeros, NamedSharding(mesh, spec))}
             for _ in range(config.n_layers)]
+
+
+def _sym_int8(x, axis):
+    """Symmetric int8 quantization along ``axis``: ``s = max|x| / 127``
+    (floored at 1e-8 so all-zero slices don't divide by zero), ``q =
+    clip(round(x / s))``. The single recipe both the weight and the
+    KV-cache quantizers share — one place to change the clamp floor or
+    the symmetry policy. Returns (int8 codes, float32 scales with
+    ``axis`` removed)."""
+    import jax.numpy as jnp
+
+    xf = x.astype(jnp.float32)
+    s = jnp.maximum(jnp.max(jnp.abs(xf), axis=axis), 1e-8) / 127.0
+    q = jnp.clip(jnp.round(xf / jnp.expand_dims(s, axis)), -127, 127) \
+        .astype(jnp.int8)
+    return q, s
+
+
+def _quantize_kv_block(x):
+    """(B, T, n_kv, head_dim) activations -> (int8 codes, (B, T, n_kv)
+    float32 scales), symmetric per-(token, kv-head) over head_dim. The
+    scale axis choice is what keeps dequantization out of the cache
+    stream (see :func:`init_kv_cache`)."""
+    return _sym_int8(x, axis=-1)
 
 
 def quantize_params_int8(params):
@@ -55,12 +105,8 @@ def quantize_params_int8(params):
     point (:func:`forward_with_cache`, :func:`generate`,
     :func:`generate_on_device`) accepts either representation.
     """
-    import jax.numpy as jnp
-
     def quant(w):
-        wf = w.astype(jnp.float32)
-        s = jnp.maximum(jnp.max(jnp.abs(wf), axis=0), 1e-8) / 127.0
-        q = jnp.clip(jnp.round(wf / s), -127, 127).astype(jnp.int8)
+        q, s = _sym_int8(w, axis=0)
         return {"q": q, "s": s}
 
     out = {"embed": params["embed"],
@@ -128,28 +174,62 @@ def forward_with_cache(params, tokens, cache, start_pos, config,
     mask = (kv_pos[None, :] <= positions[:, None])  # (T, max_seq)
 
     for layer, entry in zip(params["layers"], cache):
+        quant_kv = "k_s" in entry
         a = _rms_norm(h, layer["attn_norm"])
         q = _mm(a, layer["wq"]).reshape(batch, t_new, nh, hd)
         k = _mm(a, layer["wk"]).reshape(batch, t_new, nkv, hd)
         v = _mm(a, layer["wv"]).reshape(batch, t_new, nkv, hd)
         q = _rope(q, config.rope_theta, positions)
         k = _rope(k, config.rope_theta, positions)
-        k_cache = jax.lax.dynamic_update_slice(
-            entry["k"], k.astype(entry["k"].dtype), (0, start_pos, 0, 0))
-        v_cache = jax.lax.dynamic_update_slice(
-            entry["v"], v.astype(entry["v"].dtype), (0, start_pos, 0, 0))
-        new_cache.append({"k": k_cache, "v": v_cache})
+        if quant_kv:
+            # quantize AFTER RoPE — the cache holds exactly what dense
+            # attention would read, just coded int8 + per-token scale
+            k_q, k_s = _quantize_kv_block(k)
+            v_q, v_s = _quantize_kv_block(v)
+            k_cache = jax.lax.dynamic_update_slice(
+                entry["k"], k_q, (0, start_pos, 0, 0))
+            v_cache = jax.lax.dynamic_update_slice(
+                entry["v"], v_q, (0, start_pos, 0, 0))
+            ks_cache = jax.lax.dynamic_update_slice(
+                entry["k_s"], k_s, (0, start_pos, 0))
+            vs_cache = jax.lax.dynamic_update_slice(
+                entry["v_s"], v_s, (0, start_pos, 0))
+            new_cache.append({"k": k_cache, "k_s": ks_cache,
+                              "v": v_cache, "v_s": vs_cache})
+        else:
+            k_cache = jax.lax.dynamic_update_slice(
+                entry["k"], k.astype(entry["k"].dtype),
+                (0, start_pos, 0, 0))
+            v_cache = jax.lax.dynamic_update_slice(
+                entry["v"], v.astype(entry["v"].dtype),
+                (0, start_pos, 0, 0))
+            new_cache.append({"k": k_cache, "v": v_cache})
 
         # grouped einsum over (kv-head, group) — never materializes a
         # group-times-repeated copy of the cache, which would dominate
         # the step's HBM traffic at long context
         q_g = q.reshape(batch, t_new, nkv, group, hd)
-        scores = jnp.einsum("bqkgd,bskd->bkgqs", q_g, k_cache) \
-            * (hd ** -0.5)
+        scores = jnp.einsum("bqkgd,bskd->bkgqs", q_g,
+                            k_cache.astype(h.dtype)) * (hd ** -0.5)
+        scores = scores.astype(jnp.float32)
+        if quant_kv:
+            # K dequant: the per-(s, k) scale factors straight out of
+            # the head_dim contraction — one rank-1 rescale of the
+            # score matrix, the int8 codes were the einsum operand
+            scores = scores \
+                * ks_cache.transpose(0, 2, 1)[:, :, None, None, :]
         scores = jnp.where(mask[None, None, None, :, :],
-                           scores.astype(jnp.float32), -1e30)
-        attn = jax.nn.softmax(scores, axis=-1).astype(h.dtype)
-        ctx = jnp.einsum("bkgqs,bskd->bqkgd", attn, v_cache)
+                           scores, -1e30)
+        attn = jax.nn.softmax(scores, axis=-1)
+        if quant_kv:
+            # V dequant: fold the per-(s, k) scale into the attention
+            # weights BEFORE the value einsum (the s axis is the
+            # contraction, so scaling either operand is exact)
+            attn = attn \
+                * vs_cache.transpose(0, 2, 1)[:, :, None, None, :]
+        attn = attn.astype(h.dtype)
+        ctx = jnp.einsum("bkgqs,bskd->bqkgd", attn,
+                         v_cache.astype(h.dtype))
         h = h + _mm(ctx.reshape(batch, t_new, nh * hd), layer["wo"])
         h = constrain(h, P("dp", None, None))
 
@@ -214,12 +294,13 @@ def _pick_next(logits_last, temperature: float, top_k, key):
 
 def generate(params, prompt, config, mesh, max_new_tokens: int,
              param_dtype=None, temperature: float = 0.0,
-             top_k=None, key=None):
+             top_k=None, key=None, quantize_kv: bool = False):
     """Autoregressive decode: prefill the prompt, then one cached step
     per token. ``temperature=0`` (default) is greedy; otherwise
     softmax sampling at the given temperature, optionally top-k
     truncated, driven by ``key`` (required when sampling — explicit
-    PRNG keys keep generation reproducible). Returns
+    PRNG keys keep generation reproducible). ``quantize_kv`` stores
+    the cache int8 (see :func:`init_kv_cache`). Returns
     (B, prompt+max_new_tokens) int32."""
     import jax
     import jax.numpy as jnp
@@ -228,7 +309,8 @@ def generate(params, prompt, config, mesh, max_new_tokens: int,
         raise ValueError("sampling (temperature > 0) requires a PRNG key")
     batch, prompt_len = prompt.shape
     total = prompt_len + max_new_tokens
-    cache = init_kv_cache(mesh, config, batch, total, param_dtype)
+    cache = init_kv_cache(mesh, config, batch, total, param_dtype,
+                          quantize_kv=quantize_kv)
     step = _jitted_step(config, mesh)
 
     def next_key():
@@ -307,7 +389,8 @@ def _jitted_device_decode():
 
 def generate_on_device(params, prompt, config, mesh,
                        max_new_tokens: int, param_dtype=None,
-                       temperature: float = 0.0, top_k=None, key=None):
+                       temperature: float = 0.0, top_k=None, key=None,
+                       quantize_kv: bool = False):
     """:func:`generate`, but the token loop runs ON the device.
 
     The host-driven loop costs one dispatch (and on a tunneled backend,
@@ -319,7 +402,9 @@ def generate_on_device(params, prompt, config, mesh,
     afterwards) and the scan carry aliases it in place thereafter.
 
     Same contract as :func:`generate` (tested equal on the greedy
-    path): returns (B, prompt+max_new_tokens) int32.
+    path, including with ``quantize_kv`` — both paths run the same
+    quantized math, so host/device equality stays exact): returns
+    (B, prompt+max_new_tokens) int32.
     """
     import warnings
 
@@ -329,7 +414,8 @@ def generate_on_device(params, prompt, config, mesh,
         raise ValueError("max_new_tokens must be >= 1")
     batch, prompt_len = prompt.shape
     cache = init_kv_cache(mesh, config, batch,
-                          prompt_len + max_new_tokens, param_dtype)
+                          prompt_len + max_new_tokens, param_dtype,
+                          quantize_kv=quantize_kv)
     with warnings.catch_warnings():
         # The donated cache cannot alias the (tiny, int32) token output
         # — donation here is for the entry copy + in-loop aliasing, so
