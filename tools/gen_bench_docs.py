@@ -99,14 +99,16 @@ def render(capture: dict) -> str:
          f"{fmt(capture.get('decode_int8_tok_s'), '{} tok/s')} = "
          f"{fmt(capture.get('decode_int8_roofline_pct'), '{} %')} of "
          "its (2× higher) roofline"),
-        # rendered only when the capture is new enough to carry the
-        # cell at all — a pre-int8-KV capture omits the row instead of
-        # publishing "null" for a cell its bench never ran
+        # rendered only when the capture actually measured the cell —
+        # key-presence alone is not enough, because a wedged-chip
+        # capture seeds the key as null from _MODEL_NULLS even when
+        # promoting a pre-int8-KV sidecar, which would publish
+        # "null = null" for a cell that bench never ran
         *([("greedy decode, int8 weights + int8 KV cache",
-            f"{fmt(capture.get('decode_int8_kv_tok_s'), '{} tok/s')} = "
+            f"{capture['decode_int8_kv_tok_s']} tok/s = "
             f"{fmt(capture.get('decode_int8_kv_roofline_pct'), '{} %')} "
             "of the int8 weight-stream roofline")]
-          if "decode_int8_kv_tok_s" in capture else []),
+          if capture.get("decode_int8_kv_tok_s") is not None else []),
         ("seq-8192 forward, flash vs XLA attention",
          f"{fmt(capture.get('flash_attention_speedup'), '{}×')} "
          f"({fmt(flash, '{}')} vs {fmt(xla, '{}')} ms)"),
