@@ -328,7 +328,10 @@ class TestInt8KVCacheDecode:
 
     def test_composes_with_int8_weights(self):
         """The full int8 serving stack: int8 weights AND int8 cache in
-        one fused device loop — valid tokens, right shape."""
+        one fused device loop — valid tokens, right shape, and exact
+        host/device parity (both loops run the identical quantized
+        math, so the combined variant keeps the same exactness
+        contract as each half)."""
         mesh = make_mesh()
         config = LlamaConfig()
         qparams = quantize_params_int8(init_llama_params(mesh, config))
@@ -337,3 +340,69 @@ class TestInt8KVCacheDecode:
                                           mesh, 6, quantize_kv=True))
         assert out.shape == (prompt.shape[0], 4 + 6)
         assert ((out >= 0) & (out < config.vocab)).all()
+        host = np.array(generate(qparams, prompt, config, mesh, 6,
+                                 quantize_kv=True))
+        np.testing.assert_array_equal(out, host)
+
+    def test_dequant_factorization_is_exact(self):
+        """The scale placement is algebra, not approximation: for the
+        einsum strings the decode path uses, multiplying the
+        per-(token, kv-head) scale AFTER the K einsum (and folding it
+        into the attention weights BEFORE the V einsum) equals
+        dequantizing the codes first — to f32 rounding, on random
+        codes/scales. NOTE: this pins the factorization *recipe* on a
+        local copy of the einsums (the module's own placement is
+        covered by the e2e logits-tolerance tests above, which would
+        catch a gross mis-scaling but not a subtle one); the e2e
+        tests also bound the (separate) quantization error."""
+        B, T, S, K, G, D = 2, 3, 7, 2, 2, 8
+        rng = np.random.default_rng(0)
+        q_g = jnp.asarray(rng.normal(size=(B, T, K, G, D)),
+                          jnp.float32)
+        codes = jnp.asarray(rng.integers(-127, 128, (B, S, K, D)),
+                            jnp.float32)
+        scale = jnp.asarray(rng.uniform(1e-3, 2e-2, (B, S, K)),
+                            jnp.float32)
+        attn = jnp.asarray(rng.uniform(0, 1, (B, K, G, T, S)),
+                           jnp.float32)
+
+        # K path: einsum on codes, then the rank-1 rescale
+        fact = jnp.einsum("bqkgd,bskd->bkgqs", q_g, codes) \
+            * scale.transpose(0, 2, 1)[:, :, None, None, :]
+        full = jnp.einsum("bqkgd,bskd->bkgqs", q_g,
+                          codes * scale[..., None])
+        np.testing.assert_allclose(np.asarray(fact), np.asarray(full),
+                                   rtol=1e-5, atol=1e-5)
+
+        # V path: scale folded into the attention weights
+        fact_v = jnp.einsum(
+            "bkgqs,bskd->bqkgd",
+            attn * scale.transpose(0, 2, 1)[:, :, None, None, :],
+            codes)
+        full_v = jnp.einsum("bkgqs,bskd->bqkgd", attn,
+                            codes * scale[..., None])
+        np.testing.assert_allclose(np.asarray(fact_v),
+                                   np.asarray(full_v),
+                                   rtol=1e-5, atol=1e-5)
+
+    def test_quantize_roundtrip_error_bound(self):
+        """Per-element dequant error is bounded by s/2 (half a code
+        step) — the contract the 'few percent on logits' tolerances
+        rest on."""
+        from tpu_operator_libs.examples.llama_decode import (
+            _quantize_kv_block,
+        )
+
+        rng = np.random.default_rng(1)
+        x = jnp.asarray(rng.normal(size=(2, 5, 3, 16)) * 3.0,
+                        jnp.float32)
+        q, s = _quantize_kv_block(x)
+        assert q.dtype == jnp.int8
+        recon = q.astype(jnp.float32) * s[..., None]
+        err = np.asarray(jnp.abs(recon - x))
+        # slack scales with ulp(|x|): fl(x/s) landing a hair past a
+        # half-integer can flip round(), so a fixed 1e-7 would be
+        # fragile across backends/fma policies at |x| ~ 10
+        xa = np.abs(np.asarray(x))
+        bound = np.asarray(s)[..., None] / 2.0 + 1e-5 * xa + 1e-7
+        assert (err <= bound).all()
