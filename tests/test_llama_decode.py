@@ -406,3 +406,34 @@ class TestInt8KVCacheDecode:
         xa = np.abs(np.asarray(x))
         bound = np.asarray(s)[..., None] / 2.0 + 1e-5 * xa + 1e-7
         assert (err <= bound).all()
+
+    def test_single_device_mesh_cache_leaves_share_no_buffers(self):
+        """Regression: on any single-device mesh, device_put returns
+        its input unchanged when the sharding already matches, so a
+        zeros template shared across cache leaves made every k/v (and
+        scale slab) alias ONE buffer — and donating the cache into
+        generate_on_device died on the real chip with XLA's 'buffer
+        was previously donated in the same call' error, silently
+        nulling the bench decode cells. The donation error itself
+        doesn't reproduce on the CPU backend (the tiny int32 token
+        output can't alias the cache, so the duplicate-donation check
+        never fires), so the guard asserts the root cause directly:
+        every leaf of every entry must own a distinct device buffer."""
+        mesh = Mesh(np.array(jax.devices()[:1]).reshape(1, 1),
+                    ("dp", "tp"))
+        config = LlamaConfig()
+        for quantize_kv in (False, True):
+            cache = init_kv_cache(mesh, config, 2, 8, jnp.bfloat16,
+                                  quantize_kv=quantize_kv)
+            ptrs = [
+                leaf.addressable_shards[0].data.unsafe_buffer_pointer()
+                for entry in cache for leaf in entry.values()]
+            assert len(ptrs) == len(set(ptrs)), \
+                f"aliased cache buffers (quantize_kv={quantize_kv})"
+        # and the donated end-to-end path still runs on this mesh
+        params = init_llama_params(mesh, config)
+        prompt = make_token_batch(mesh, 0, config)[:, :4]
+        out = np.array(generate_on_device(
+            quantize_params_int8(params), prompt, config, mesh, 5,
+            quantize_kv=True))
+        assert out.shape == (prompt.shape[0], 4 + 5)

@@ -1,0 +1,109 @@
+#!/usr/bin/env python3
+"""Decode sweep: the serving matrix (context length × quantization).
+
+The decode roofline story (docs/benchmarks.md "decode" cells) has a
+shape axis the single bench capture can't show: the KV cache's share
+of each step's HBM stream GROWS with context, so int8-KV's advantage
+over weight-only int8 should widen from ctx 1024 to ctx 4096 while
+bf16 falls further behind. This tool runs the UNMODIFIED bench model
+probe (bench._MODEL_PROBE_SCRIPT — same fencing, same sanity checks;
+all three decode variants are measured inside every probe run) across
+a context matrix and prints tok/s per (ctx, variant) cell.
+
+Every cell sets BENCH_* env overrides, so by bench's own rules nothing
+here persists as last-good — this is an A/B instrument; the committed
+capture keeps the production shape.
+
+Usage:
+    python tools/decode_sweep.py                # ctx 1024 + 4096
+    python tools/decode_sweep.py --ctx 1024 2048 4096
+"""
+
+from __future__ import annotations
+
+import argparse
+import os
+import sys
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+
+import bench  # noqa: E402
+from sweep_common import run_probe_cell, wedged_mid_sweep  # noqa: E402
+
+PROMPT = 64
+VARIANTS = ("decode_tok_s", "decode_int8_tok_s", "decode_int8_kv_tok_s")
+
+
+def run_cell(ctx: int, timeout_s: float) -> dict:
+    """One context length through the shared probe-cell runner. The
+    long-context cell is pinned small so its budget goes to the decode
+    loops being ranked; the train cell still runs at the production
+    shape — it CANNOT be pinned small, because the decode model
+    derives from the train config and its params are the train step's
+    output (~2-4 min of each cell is that train step). Overrides flag
+    the run as shape-overridden, so it can never masquerade as a
+    capture."""
+    return run_probe_cell({
+        "BENCH_DECODE_PROMPT": PROMPT,
+        "BENCH_DECODE_NEW": ctx - PROMPT,
+        "BENCH_MODEL_LONG_SEQ": 256,
+    }, timeout_s)
+
+
+def main() -> int:
+    parser = argparse.ArgumentParser()
+    parser.add_argument("--ctx", type=int, nargs="+",
+                        default=[1024, 4096],
+                        help="context lengths (prompt 64 + the rest "
+                             "generated)")
+    parser.add_argument("--timeout", type=float, default=900.0)
+    args = parser.parse_args()
+
+    bad = [c for c in args.ctx if c <= PROMPT]
+    if bad:
+        print(f"decode_sweep: ctx must exceed the {PROMPT}-token "
+              f"prompt, got {bad}")
+        return 2
+
+    ok, reason = bench._preflight()
+    if not ok:
+        print(f"decode_sweep: chip not reachable ({reason}); aborting")
+        return 1
+
+    cells = []
+    for ctx in args.ctx:
+        print(f"decode_sweep: running ctx={ctx} ...", flush=True)
+        data = run_cell(ctx, args.timeout)
+        if "error" in data:
+            print(f"  -> {data['error']}")
+            cells.append((ctx, None))
+            if wedged_mid_sweep("decode_sweep"):
+                break
+            continue
+        row = {v: data.get(v) for v in VARIANTS}
+        names = {"decode_tok_s": "bf16", "decode_int8_tok_s": "int8",
+                 "decode_int8_kv_tok_s": "int8+kv"}
+        print("  -> " + "  ".join(
+            f"{names[v]}={row[v] or 'null'} tok/s" for v in VARIANTS))
+        cells.append((ctx, row))
+
+    print("\ndecode_sweep results (tok/s):")
+    print(f"  {'ctx':>6s}  {'bf16':>8s}  {'int8':>8s}  {'int8+kv':>8s}"
+          f"  {'kv gain':>8s}")
+    for ctx, row in cells:
+        if row is None:
+            print(f"  {ctx:6d}  FAILED")
+            continue
+        gain = ""
+        if row["decode_int8_tok_s"] and row["decode_int8_kv_tok_s"]:
+            gain = (f"{row['decode_int8_kv_tok_s'] / row['decode_int8_tok_s']:.2f}x")
+        print(f"  {ctx:6d}  "
+              f"{row['decode_tok_s'] or '-':>8}  "
+              f"{row['decode_int8_tok_s'] or '-':>8}  "
+              f"{row['decode_int8_kv_tok_s'] or '-':>8}  {gain:>8s}")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -31,18 +31,7 @@ REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 sys.path.insert(0, REPO)
 
 import bench  # noqa: E402
-
-
-def run_cell(overrides: dict, timeout_s: float) -> dict:
-    """One matrix cell through bench's own probe runner (shared spawn/
-    timeout/parse semantics — only the env differs per cell)."""
-    env = dict(os.environ)
-    env.update({k: str(v) for k, v in overrides.items()})
-    data, reason = bench._probe_once(
-        timeout_s, script=bench._MODEL_PROBE_SCRIPT, env=env)
-    if data is None:
-        return {"error": reason}
-    return data
+from sweep_common import run_probe_cell, wedged_mid_sweep  # noqa: E402
 
 
 def main() -> int:
@@ -75,17 +64,11 @@ def main() -> int:
                      "BENCH_DECODE_NEW": "8"}
         label = f"remat={remat} batch={batch} queue={queue}"
         print(f"mfu_sweep: running {label} ...", flush=True)
-        data = run_cell(overrides, args.timeout)
+        data = run_probe_cell(overrides, args.timeout)
         if "error" in data:
             print(f"  -> {data['error']}")
             cells.append((label, None, None, data["error"]))
-            # a mid-sweep wedge would otherwise burn the full timeout
-            # on every remaining cell; the cheap pre-flight answers
-            # "is the chip still there?" in 75 s
-            ok, reason = bench._preflight()
-            if not ok:
-                print(f"mfu_sweep: chip wedged mid-sweep ({reason}); "
-                      "aborting remaining cells")
+            if wedged_mid_sweep("mfu_sweep"):
                 break
             continue
         if not data.get("loss_finite"):

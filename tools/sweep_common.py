@@ -1,0 +1,45 @@
+#!/usr/bin/env python3
+"""Shared cell runner for the probe sweep tools (mfu_sweep,
+decode_sweep): one place for probe spawn/parse semantics and the
+mid-sweep wedge abort, so a change to either never has to be made in
+N near-identical copies."""
+
+from __future__ import annotations
+
+import os
+import sys
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+
+import bench  # noqa: E402
+
+
+def run_probe_cell(overrides: dict, timeout_s: float) -> dict:
+    """One bench model-probe subprocess with env overrides -> the
+    parsed probe dict, or ``{"error": reason}`` (covering both spawn
+    failures and the probe's own structured errors). Cells run through
+    bench's spawn/timeout/parse machinery — only the env differs —
+    and override runs are flagged by the probe itself so they can
+    never persist as last-good."""
+    env = dict(os.environ)
+    env.update({k: str(v) for k, v in overrides.items()})
+    data, reason = bench._probe_once(
+        timeout_s, script=bench._MODEL_PROBE_SCRIPT, env=env)
+    if data is None:
+        return {"error": reason}
+    if "error" in data:
+        return {"error": data["error"]}
+    return data
+
+
+def wedged_mid_sweep(tool: str) -> bool:
+    """After a failed cell: is the chip itself gone? A wedged tunnel
+    would otherwise burn the full timeout on every remaining cell; the
+    cheap pre-flight answers in ~75 s. Prints the abort message and
+    returns True when the sweep should stop."""
+    ok, reason = bench._preflight()
+    if not ok:
+        print(f"{tool}: chip wedged mid-sweep ({reason}); "
+              "aborting remaining cells")
+    return not ok

@@ -48,18 +48,26 @@ def init_kv_cache(mesh, config, batch: int, max_seq: int,
     spec = (P("dp", None, "tp", None) if tp
             else P("dp", None, None, None))
     shape = (batch, max_seq, config.n_kv_heads, config.head_dim)
+
+    def buf(shp, dt, sp):
+        # a FRESH zeros per leaf: device_put returns its input
+        # unchanged when the sharding already matches (e.g. any
+        # single-device mesh), so a shared zeros template would make
+        # every k/v leaf alias ONE buffer — and donating the cache
+        # into generate_on_device then dies with XLA's
+        # "buffer was previously donated in the same call" error
+        return jax.device_put(jnp.zeros(shp, dt),
+                              NamedSharding(mesh, sp))
+
     if quantize_kv:
         s_spec = P("dp", None, "tp") if tp else P("dp", None, None)
-        q0 = jnp.zeros(shape, jnp.int8)
-        s0 = jnp.zeros(shape[:3], jnp.float32)
-        return [{"k": jax.device_put(q0, NamedSharding(mesh, spec)),
-                 "k_s": jax.device_put(s0, NamedSharding(mesh, s_spec)),
-                 "v": jax.device_put(q0, NamedSharding(mesh, spec)),
-                 "v_s": jax.device_put(s0, NamedSharding(mesh, s_spec))}
+        return [{"k": buf(shape, jnp.int8, spec),
+                 "k_s": buf(shape[:3], jnp.float32, s_spec),
+                 "v": buf(shape, jnp.int8, spec),
+                 "v_s": buf(shape[:3], jnp.float32, s_spec)}
                 for _ in range(config.n_layers)]
-    zeros = jnp.zeros(shape, dtype)
-    return [{"k": jax.device_put(zeros, NamedSharding(mesh, spec)),
-             "v": jax.device_put(zeros, NamedSharding(mesh, spec))}
+    return [{"k": buf(shape, dtype, spec),
+             "v": buf(shape, dtype, spec)}
             for _ in range(config.n_layers)]
 
 
