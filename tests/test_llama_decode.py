@@ -437,3 +437,82 @@ class TestInt8KVCacheDecode:
             quantize_params_int8(params), prompt, config, mesh, 5,
             quantize_kv=True))
         assert out.shape == (prompt.shape[0], 4 + 5)
+
+
+class TestTopPSampling:
+    """Nucleus sampling: the smallest set of tokens whose tempered
+    probability sums to top_p (boundary ties kept); composes with
+    top_k (truncate first, nucleus over the renormalized survivors)."""
+
+    @staticmethod
+    def _nucleus(logits_row, temperature, top_p):
+        """Reference nucleus set, computed independently in numpy."""
+        z = logits_row.astype(np.float64) / temperature
+        p = np.exp(z - z.max())
+        p /= p.sum()
+        order = np.argsort(-p)
+        csum = np.cumsum(p[order])
+        kept = (csum - p[order]) < top_p
+        pstar = p[order][kept].min()
+        return set(np.flatnonzero(p >= pstar - 1e-12).tolist())
+
+    def test_samples_stay_inside_the_nucleus(self):
+        mesh = make_mesh()
+        config = LlamaConfig()
+        params = init_llama_params(mesh, config)
+        prompt = make_token_batch(mesh, 0, config)[:, :4]
+        temp, top_p = 1.0, 0.35
+        out = np.array(generate(params, prompt, config, mesh, 4,
+                                temperature=temp, top_p=top_p,
+                                key=jax.random.PRNGKey(3)))
+        for step in range(4):
+            prefix = jnp.asarray(out[:, :4 + step])
+            logits = np.array(forward(params, prefix, config,
+                                      mesh))[:, -1, :]
+            for b in range(out.shape[0]):
+                allowed = self._nucleus(logits[b], temp, top_p)
+                assert int(out[b, 4 + step]) in allowed, (b, step)
+
+    def test_top_p_one_is_plain_sampling(self):
+        """top_p=1.0 keeps every positive-probability token: same key
+        => identical draws as no-top_p sampling."""
+        mesh = make_mesh()
+        config = LlamaConfig()
+        params = init_llama_params(mesh, config)
+        prompt = make_token_batch(mesh, 0, config)[:, :4]
+        key = jax.random.PRNGKey(11)
+        a = np.array(generate(params, prompt, config, mesh, 4,
+                              temperature=0.7, key=key))
+        b = np.array(generate(params, prompt, config, mesh, 4,
+                              temperature=0.7, top_p=1.0, key=key))
+        np.testing.assert_array_equal(a, b)
+
+    def test_device_loop_matches_host_loop(self):
+        """Same key stream on both paths: the fused loop's top_p
+        sampling must reproduce the host loop draw for draw."""
+        mesh = make_mesh()
+        config = LlamaConfig()
+        params = init_llama_params(mesh, config)
+        prompt = make_token_batch(mesh, 0, config)[:, :4]
+        key = jax.random.PRNGKey(5)
+        host = np.array(generate(params, prompt, config, mesh, 5,
+                                 temperature=0.9, top_p=0.5, key=key))
+        dev = np.array(generate_on_device(
+            params, prompt, config, mesh, 5, temperature=0.9,
+            top_p=0.5, key=key))
+        np.testing.assert_array_equal(host, dev)
+
+    def test_invalid_top_p_rejected_by_both_paths(self):
+        mesh = make_mesh()
+        config = LlamaConfig()
+        params = init_llama_params(mesh, config)
+        prompt = make_token_batch(mesh, 0, config)[:, :4]
+        for bad in (0.0, -0.5, 1.5):
+            with pytest.raises(ValueError, match="top_p"):
+                generate(params, prompt, config, mesh, 2,
+                         temperature=0.8, top_p=bad,
+                         key=jax.random.PRNGKey(0))
+            with pytest.raises(ValueError, match="top_p"):
+                generate_on_device(params, prompt, config, mesh, 2,
+                                   temperature=0.8, top_p=bad,
+                                   key=jax.random.PRNGKey(0))

@@ -279,12 +279,17 @@ def _jitted_step(config, mesh):
     return lambda p, t, c, pos: _STEP_JIT(p, t, c, pos, config, mesh)
 
 
-def _pick_next(logits_last, temperature: float, top_k, key):
+def _pick_next(logits_last, temperature: float, top_k, key,
+               top_p=None):
     """(B, vocab) logits -> (B, 1) int32 next tokens.
 
     temperature 0 = greedy argmax (no key needed). Otherwise sample
     from softmax(logits/temperature), optionally truncated to the
-    ``top_k`` highest-logit tokens first."""
+    ``top_k`` highest-logit tokens and/or the ``top_p`` nucleus (the
+    smallest set of tokens whose tempered probability sums to
+    ``top_p``; ties at the nucleus boundary are kept) first. top_k and
+    top_p compose the standard way: top_k truncates, then the nucleus
+    is computed over the renormalized survivors."""
     import jax
     import jax.numpy as jnp
 
@@ -295,26 +300,47 @@ def _pick_next(logits_last, temperature: float, top_k, key):
         if top_k is not None:
             kth = jnp.sort(logits_f, axis=-1)[:, -top_k][:, None]
             logits_f = jnp.where(logits_f < kth, -jnp.inf, logits_f)
+        if top_p is not None:
+            # nucleus over the tempered distribution, sort-free on the
+            # sampling side: find the smallest kept probability p*
+            # (sorted cumulative mass exclusive of self < top_p), then
+            # mask everything below it — no gather/scatter, shapes
+            # static, fuses into the scan body
+            probs = jax.nn.softmax(logits_f / temperature, axis=-1)
+            sp = jnp.flip(jnp.sort(probs, axis=-1), axis=-1)
+            csum = jnp.cumsum(sp, axis=-1)
+            kept = (csum - sp) < top_p  # first token always kept
+            pstar = jnp.min(jnp.where(kept, sp, jnp.inf), axis=-1,
+                            keepdims=True)
+            logits_f = jnp.where(probs < pstar, -jnp.inf, logits_f)
         choice = jax.random.categorical(key, logits_f / temperature,
                                         axis=-1)
     return choice[:, None].astype(jnp.int32)
 
 
+def _check_sampling_args(temperature, key, top_p):
+    """Shared sampling-argument validation for both generate paths."""
+    if temperature > 0.0 and key is None:
+        raise ValueError("sampling (temperature > 0) requires a PRNG key")
+    if top_p is not None and not (0.0 < top_p <= 1.0):
+        raise ValueError(f"top_p must be in (0, 1], got {top_p}")
+
+
 def generate(params, prompt, config, mesh, max_new_tokens: int,
              param_dtype=None, temperature: float = 0.0,
-             top_k=None, key=None, quantize_kv: bool = False):
+             top_k=None, key=None, quantize_kv: bool = False,
+             top_p=None):
     """Autoregressive decode: prefill the prompt, then one cached step
     per token. ``temperature=0`` (default) is greedy; otherwise
-    softmax sampling at the given temperature, optionally top-k
-    truncated, driven by ``key`` (required when sampling — explicit
-    PRNG keys keep generation reproducible). ``quantize_kv`` stores
-    the cache int8 (see :func:`init_kv_cache`). Returns
-    (B, prompt+max_new_tokens) int32."""
+    softmax sampling at the given temperature, optionally top-k and/or
+    top-p (nucleus) truncated, driven by ``key`` (required when
+    sampling — explicit PRNG keys keep generation reproducible).
+    ``quantize_kv`` stores the cache int8 (see :func:`init_kv_cache`).
+    Returns (B, prompt+max_new_tokens) int32."""
     import jax
     import jax.numpy as jnp
 
-    if temperature > 0.0 and key is None:
-        raise ValueError("sampling (temperature > 0) requires a PRNG key")
+    _check_sampling_args(temperature, key, top_p)
     batch, prompt_len = prompt.shape
     total = prompt_len + max_new_tokens
     cache = init_kv_cache(mesh, config, batch, total, param_dtype,
@@ -330,14 +356,15 @@ def generate(params, prompt, config, mesh, max_new_tokens: int,
 
     logits, cache = step(params, prompt, cache, 0)
     tokens = [prompt]
-    last = _pick_next(logits[:, -1, :], temperature, top_k, next_key())
+    last = _pick_next(logits[:, -1, :], temperature, top_k, next_key(),
+                      top_p)
     for i in range(max_new_tokens):
         tokens.append(last)
         if i + 1 == max_new_tokens:
             break
         logits, cache = step(params, last, cache, prompt_len + i)
         last = _pick_next(logits[:, -1, :], temperature, top_k,
-                          next_key())
+                          next_key(), top_p)
     return jnp.concatenate(tokens, axis=1)
 
 
@@ -355,7 +382,7 @@ def _jitted_device_decode():
     global _DEVICE_DECODE_JIT
     if _DEVICE_DECODE_JIT is None:
         def decode(params, prompt, cache, key, max_new_tokens,
-                   temperature, top_k, config, mesh):
+                   temperature, top_k, top_p, config, mesh):
             prompt_len = prompt.shape[1]
             greedy = temperature <= 0.0
             if key is None:
@@ -363,7 +390,8 @@ def _jitted_device_decode():
                 key = jax.random.PRNGKey(0)
 
             def pick(logits_last, sub):
-                return _pick_next(logits_last, temperature, top_k, sub)
+                return _pick_next(logits_last, temperature, top_k, sub,
+                                  top_p)
 
             def split(k):
                 if greedy:
@@ -391,14 +419,15 @@ def _jitted_device_decode():
                 [prompt, first, jnp.transpose(rest, (1, 0))], axis=1)
 
         _DEVICE_DECODE_JIT = jax.jit(
-            decode, static_argnums=(4, 5, 6, 7, 8), donate_argnums=(2,))
+            decode, static_argnums=(4, 5, 6, 7, 8, 9),
+            donate_argnums=(2,))
     return _DEVICE_DECODE_JIT
 
 
 def generate_on_device(params, prompt, config, mesh,
                        max_new_tokens: int, param_dtype=None,
                        temperature: float = 0.0, top_k=None, key=None,
-                       quantize_kv: bool = False):
+                       quantize_kv: bool = False, top_p=None):
     """:func:`generate`, but the token loop runs ON the device.
 
     The host-driven loop costs one dispatch (and on a tunneled backend,
@@ -416,8 +445,7 @@ def generate_on_device(params, prompt, config, mesh,
     """
     import warnings
 
-    if temperature > 0.0 and key is None:
-        raise ValueError("sampling (temperature > 0) requires a PRNG key")
+    _check_sampling_args(temperature, key, top_p)
     if max_new_tokens < 1:
         raise ValueError("max_new_tokens must be >= 1")
     batch, prompt_len = prompt.shape
@@ -433,4 +461,5 @@ def generate_on_device(params, prompt, config, mesh,
             "ignore", message="Some donated buffers were not usable")
         return _jitted_device_decode()(
             params, prompt, cache, key if temperature > 0.0 else None,
-            max_new_tokens, float(temperature), top_k, config, mesh)
+            max_new_tokens, float(temperature), top_k,
+            float(top_p) if top_p is not None else None, config, mesh)
