@@ -446,7 +446,14 @@ class TestTopPSampling:
 
     @staticmethod
     def _nucleus(logits_row, temperature, top_p):
-        """Reference nucleus set, computed independently in numpy."""
+        """Reference nucleus set, computed independently in numpy.
+
+        The boundary is relaxed by a 1e-3 relative margin: the sampler
+        masked on cached-decode logits while this reference uses the
+        batch forward, and the module contract says those agree only
+        to ~1e-4 — a token sitting inside that gap of the exact
+        boundary is legitimately in the sampler's nucleus, so a
+        razor-thin reference would flake on it."""
         z = logits_row.astype(np.float64) / temperature
         p = np.exp(z - z.max())
         p /= p.sum()
@@ -454,7 +461,8 @@ class TestTopPSampling:
         csum = np.cumsum(p[order])
         kept = (csum - p[order]) < top_p
         pstar = p[order][kept].min()
-        return set(np.flatnonzero(p >= pstar - 1e-12).tolist())
+        return set(np.flatnonzero(
+            p >= pstar * (1.0 - 1e-3) - 1e-12).tolist())
 
     def test_samples_stay_inside_the_nucleus(self):
         mesh = make_mesh()
@@ -516,3 +524,57 @@ class TestTopPSampling:
                 generate_on_device(params, prompt, config, mesh, 2,
                                    temperature=0.8, top_p=bad,
                                    key=jax.random.PRNGKey(0))
+
+
+class TestEosEarlyStop:
+    """eos_id: once a row emits it, every later position in that row
+    is eos_id (fixed-width padding — shapes stay static); rows that
+    never emit it are untouched. Batch rows are independent, so the
+    expected output is computable exactly from an unconstrained run."""
+
+    def test_post_eos_positions_pad_and_other_rows_unchanged(self):
+        mesh = make_mesh()
+        config = LlamaConfig()
+        params = init_llama_params(mesh, config)
+        prompt = make_token_batch(mesh, 0, config)[:, :4]
+        n_new = 8
+        free = np.array(generate(params, prompt, config, mesh, n_new))
+        # choose the token row 0 emits at step 2 as the eos marker —
+        # guaranteed to fire mid-generation for at least that row
+        eos = int(free[0, 4 + 2])
+        got = np.array(generate(params, prompt, config, mesh, n_new,
+                                eos_id=eos))
+        expect = free.copy()
+        for b in range(free.shape[0]):
+            hits = np.flatnonzero(free[b, 4:] == eos)
+            if hits.size:
+                expect[b, 4 + hits[0]:] = eos
+        np.testing.assert_array_equal(got, expect)
+        assert (got[0, 4 + 2:] == eos).all()  # row 0 actually stopped
+
+    def test_device_loop_matches_host_loop_with_eos(self):
+        mesh = make_mesh()
+        config = LlamaConfig()
+        params = init_llama_params(mesh, config)
+        prompt = make_token_batch(mesh, 0, config)[:, :4]
+        free = np.array(generate(params, prompt, config, mesh, 6))
+        eos = int(free[0, 4 + 1])
+        host = np.array(generate(params, prompt, config, mesh, 6,
+                                 eos_id=eos))
+        dev = np.array(generate_on_device(params, prompt, config,
+                                          mesh, 6, eos_id=eos))
+        np.testing.assert_array_equal(host, dev)
+
+    def test_eos_on_first_token_pads_everything(self):
+        mesh = make_mesh()
+        config = LlamaConfig()
+        params = init_llama_params(mesh, config)
+        prompt = make_token_batch(mesh, 0, config)[:, :4]
+        free = np.array(generate(params, prompt, config, mesh, 5))
+        eos = int(free[0, 4])  # row 0's very first generated token
+        dev = np.array(generate_on_device(params, prompt, config,
+                                          mesh, 5, eos_id=eos))
+        assert (dev[0, 4:] == eos).all()
+        host = np.array(generate(params, prompt, config, mesh, 5,
+                                 eos_id=eos))
+        np.testing.assert_array_equal(host, dev)

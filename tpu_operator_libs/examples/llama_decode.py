@@ -329,14 +329,18 @@ def _check_sampling_args(temperature, key, top_p):
 def generate(params, prompt, config, mesh, max_new_tokens: int,
              param_dtype=None, temperature: float = 0.0,
              top_k=None, key=None, quantize_kv: bool = False,
-             top_p=None):
+             top_p=None, eos_id=None):
     """Autoregressive decode: prefill the prompt, then one cached step
     per token. ``temperature=0`` (default) is greedy; otherwise
     softmax sampling at the given temperature, optionally top-k and/or
     top-p (nucleus) truncated, driven by ``key`` (required when
     sampling — explicit PRNG keys keep generation reproducible).
     ``quantize_kv`` stores the cache int8 (see :func:`init_kv_cache`).
-    Returns (B, prompt+max_new_tokens) int32."""
+    ``eos_id`` enables early-stop semantics: once a row emits it,
+    every later position in that row is ``eos_id`` (the fixed-width
+    padding convention serving stacks use — shapes stay static, the
+    caller truncates at the first eos). Returns
+    (B, prompt+max_new_tokens) int32."""
     import jax
     import jax.numpy as jnp
 
@@ -358,7 +362,11 @@ def generate(params, prompt, config, mesh, max_new_tokens: int,
     tokens = [prompt]
     last = _pick_next(logits[:, -1, :], temperature, top_k, next_key(),
                       top_p)
+    done = jnp.zeros((batch,), bool)
     for i in range(max_new_tokens):
+        if eos_id is not None:
+            last = jnp.where(done[:, None], eos_id, last)
+            done = done | (last[:, 0] == eos_id)
         tokens.append(last)
         if i + 1 == max_new_tokens:
             break
@@ -382,7 +390,7 @@ def _jitted_device_decode():
     global _DEVICE_DECODE_JIT
     if _DEVICE_DECODE_JIT is None:
         def decode(params, prompt, cache, key, max_new_tokens,
-                   temperature, top_k, top_p, config, mesh):
+                   temperature, top_k, top_p, eos_id, config, mesh):
             prompt_len = prompt.shape[1]
             greedy = temperature <= 0.0
             if key is None:
@@ -402,24 +410,32 @@ def _jitted_device_decode():
                 params, prompt, cache, 0, config, mesh)
             key, sub = split(key)
             first = pick(logits[:, -1, :], sub)
+            done0 = (first[:, 0] == eos_id if eos_id is not None
+                     else jnp.zeros((first.shape[0],), bool))
 
             def body(carry, i):
-                cache, last, key = carry
+                cache, last, key, done = carry
                 logits, cache = forward_with_cache(
                     params, last, cache, prompt_len + i, config, mesh)
                 key, sub = split(key)
                 nxt = pick(logits[:, -1, :], sub)
-                return (cache, nxt, key), nxt[:, 0]
+                if eos_id is not None:
+                    # a finished row keeps emitting eos_id; the step
+                    # above still ran (static shapes — the scan can't
+                    # skip work), its output is simply masked out
+                    nxt = jnp.where(done[:, None], eos_id, nxt)
+                    done = done | (nxt[:, 0] == eos_id)
+                return (cache, nxt, key, done), nxt[:, 0]
 
-            (_, _, _), rest = lax.scan(
-                body, (cache, first, key),
+            (_, _, _, _), rest = lax.scan(
+                body, (cache, first, key, done0),
                 jnp.arange(max_new_tokens - 1, dtype=jnp.int32))
             # rest: (max_new_tokens-1, B) -> (B, max_new_tokens-1)
             return jnp.concatenate(
                 [prompt, first, jnp.transpose(rest, (1, 0))], axis=1)
 
         _DEVICE_DECODE_JIT = jax.jit(
-            decode, static_argnums=(4, 5, 6, 7, 8, 9),
+            decode, static_argnums=(4, 5, 6, 7, 8, 9, 10),
             donate_argnums=(2,))
     return _DEVICE_DECODE_JIT
 
@@ -427,7 +443,8 @@ def _jitted_device_decode():
 def generate_on_device(params, prompt, config, mesh,
                        max_new_tokens: int, param_dtype=None,
                        temperature: float = 0.0, top_k=None, key=None,
-                       quantize_kv: bool = False, top_p=None):
+                       quantize_kv: bool = False, top_p=None,
+                       eos_id=None):
     """:func:`generate`, but the token loop runs ON the device.
 
     The host-driven loop costs one dispatch (and on a tunneled backend,
@@ -462,4 +479,5 @@ def generate_on_device(params, prompt, config, mesh,
         return _jitted_device_decode()(
             params, prompt, cache, key if temperature > 0.0 else None,
             max_new_tokens, float(temperature), top_k,
-            float(top_p) if top_p is not None else None, config, mesh)
+            float(top_p) if top_p is not None else None,
+            int(eos_id) if eos_id is not None else None, config, mesh)
