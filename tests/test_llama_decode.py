@@ -578,3 +578,62 @@ class TestEosEarlyStop:
         host = np.array(generate(params, prompt, config, mesh, 5,
                                  eos_id=eos))
         np.testing.assert_array_equal(host, dev)
+
+
+class TestLogprobs:
+    """return_logprobs: each generated token's log-probability under
+    the model's own (untempered, untruncated) distribution — the
+    serving-API quantity; eos-padded positions carry 0.0."""
+
+    def test_greedy_logprobs_match_batch_forward(self):
+        mesh = make_mesh()
+        config = LlamaConfig()
+        params = init_llama_params(mesh, config)
+        prompt = make_token_batch(mesh, 0, config)[:, :4]
+        toks, lps = generate(params, prompt, config, mesh, 4,
+                             return_logprobs=True)
+        toks, lps = np.array(toks), np.array(lps)
+        assert lps.shape == (prompt.shape[0], 4)
+        for step in range(4):
+            prefix = jnp.asarray(toks[:, :4 + step])
+            logits = np.array(forward(params, prefix, config,
+                                      mesh))[:, -1, :].astype(np.float64)
+            ref = logits - logits.max(-1, keepdims=True)
+            ref = ref - np.log(np.exp(ref).sum(-1, keepdims=True))
+            for b in range(toks.shape[0]):
+                got = lps[b, step]
+                want = ref[b, toks[b, 4 + step]]
+                assert abs(got - want) < 5e-3, (b, step, got, want)
+
+    def test_device_logprobs_match_host(self):
+        mesh = make_mesh()
+        config = LlamaConfig()
+        params = init_llama_params(mesh, config)
+        prompt = make_token_batch(mesh, 0, config)[:, :4]
+        key = jax.random.PRNGKey(9)
+        ht, hl = generate(params, prompt, config, mesh, 5,
+                          temperature=0.8, top_p=0.7, key=key,
+                          return_logprobs=True)
+        dt, dl = generate_on_device(params, prompt, config, mesh, 5,
+                                    temperature=0.8, top_p=0.7,
+                                    key=key, return_logprobs=True)
+        np.testing.assert_array_equal(np.array(ht), np.array(dt))
+        np.testing.assert_allclose(np.array(hl), np.array(dl),
+                                   rtol=1e-5, atol=1e-6)
+
+    def test_eos_padded_positions_carry_zero(self):
+        mesh = make_mesh()
+        config = LlamaConfig()
+        params = init_llama_params(mesh, config)
+        prompt = make_token_batch(mesh, 0, config)[:, :4]
+        free = np.array(generate(params, prompt, config, mesh, 6))
+        eos = int(free[0, 4 + 1])
+        toks, lps = generate_on_device(params, prompt, config, mesh, 6,
+                                       eos_id=eos,
+                                       return_logprobs=True)
+        toks, lps = np.array(toks), np.array(lps)
+        # row 0 emitted eos at step 1: steps 2.. are padding with 0.0
+        assert (toks[0, 4 + 2:] == eos).all()
+        assert (lps[0, 2:] == 0.0).all()
+        # the eos emission itself keeps its real (negative) logprob
+        assert lps[0, 1] < 0.0

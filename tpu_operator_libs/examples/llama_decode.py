@@ -280,7 +280,7 @@ def _jitted_step(config, mesh):
 
 
 def _pick_next(logits_last, temperature: float, top_k, key,
-               top_p=None):
+               top_p=None, want_logprob: bool = False):
     """(B, vocab) logits -> (B, 1) int32 next tokens.
 
     temperature 0 = greedy argmax (no key needed). Otherwise sample
@@ -315,7 +315,16 @@ def _pick_next(logits_last, temperature: float, top_k, key,
             logits_f = jnp.where(probs < pstar, -jnp.inf, logits_f)
         choice = jax.random.categorical(key, logits_f / temperature,
                                         axis=-1)
-    return choice[:, None].astype(jnp.int32)
+    if not want_logprob:
+        return choice[:, None].astype(jnp.int32), None
+    # logprob of the chosen token under the MODEL's (untempered,
+    # untruncated) distribution — what serving APIs report; the
+    # truncated/tempered distribution above only steers the draw.
+    # Computed only on request: a full-vocab log_softmax per step is
+    # real work in the fused hot loop
+    lp = jax.nn.log_softmax(logits_last.astype(jnp.float32), axis=-1)
+    chosen_lp = jnp.take_along_axis(lp, choice[:, None], axis=-1)
+    return choice[:, None].astype(jnp.int32), chosen_lp[:, 0]
 
 
 def _check_sampling_args(temperature, key, top_p):
@@ -329,7 +338,7 @@ def _check_sampling_args(temperature, key, top_p):
 def generate(params, prompt, config, mesh, max_new_tokens: int,
              param_dtype=None, temperature: float = 0.0,
              top_k=None, key=None, quantize_kv: bool = False,
-             top_p=None, eos_id=None):
+             top_p=None, eos_id=None, return_logprobs: bool = False):
     """Autoregressive decode: prefill the prompt, then one cached step
     per token. ``temperature=0`` (default) is greedy; otherwise
     softmax sampling at the given temperature, optionally top-k and/or
@@ -340,11 +349,17 @@ def generate(params, prompt, config, mesh, max_new_tokens: int,
     every later position in that row is ``eos_id`` (the fixed-width
     padding convention serving stacks use — shapes stay static, the
     caller truncates at the first eos). Returns
-    (B, prompt+max_new_tokens) int32."""
+    (B, prompt+max_new_tokens) int32; with ``return_logprobs=True``,
+    a (tokens, logprobs) pair where logprobs is (B, max_new_tokens)
+    float32 — each generated token's log-probability under the
+    model's own (untempered, untruncated) distribution, the quantity
+    serving APIs report; eos-padded positions carry 0.0."""
     import jax
     import jax.numpy as jnp
 
     _check_sampling_args(temperature, key, top_p)
+    if max_new_tokens < 1:
+        raise ValueError("max_new_tokens must be >= 1")
     batch, prompt_len = prompt.shape
     total = prompt_len + max_new_tokens
     cache = init_kv_cache(mesh, config, batch, total, param_dtype,
@@ -360,20 +375,27 @@ def generate(params, prompt, config, mesh, max_new_tokens: int,
 
     logits, cache = step(params, prompt, cache, 0)
     tokens = [prompt]
-    last = _pick_next(logits[:, -1, :], temperature, top_k, next_key(),
-                      top_p)
+    lps = []
+    last, lp = _pick_next(logits[:, -1, :], temperature, top_k,
+                          next_key(), top_p, return_logprobs)
     done = jnp.zeros((batch,), bool)
     for i in range(max_new_tokens):
         if eos_id is not None:
             last = jnp.where(done[:, None], eos_id, last)
+            if return_logprobs:
+                lp = jnp.where(done, 0.0, lp)
             done = done | (last[:, 0] == eos_id)
         tokens.append(last)
+        lps.append(lp)
         if i + 1 == max_new_tokens:
             break
         logits, cache = step(params, last, cache, prompt_len + i)
-        last = _pick_next(logits[:, -1, :], temperature, top_k,
-                          next_key(), top_p)
-    return jnp.concatenate(tokens, axis=1)
+        last, lp = _pick_next(logits[:, -1, :], temperature, top_k,
+                              next_key(), top_p, return_logprobs)
+    out = jnp.concatenate(tokens, axis=1)
+    if return_logprobs:
+        return out, jnp.stack(lps, axis=1)
+    return out
 
 
 _DEVICE_DECODE_JIT = None
@@ -390,7 +412,8 @@ def _jitted_device_decode():
     global _DEVICE_DECODE_JIT
     if _DEVICE_DECODE_JIT is None:
         def decode(params, prompt, cache, key, max_new_tokens,
-                   temperature, top_k, top_p, eos_id, config, mesh):
+                   temperature, top_k, top_p, eos_id, want_lp,
+                   config, mesh):
             prompt_len = prompt.shape[1]
             greedy = temperature <= 0.0
             if key is None:
@@ -398,8 +421,10 @@ def _jitted_device_decode():
                 key = jax.random.PRNGKey(0)
 
             def pick(logits_last, sub):
+                # -> (token, logprob-or-None); the logprob branch is
+                # traced only in the want_lp specialization
                 return _pick_next(logits_last, temperature, top_k, sub,
-                                  top_p)
+                                  top_p, want_lp)
 
             def split(k):
                 if greedy:
@@ -409,7 +434,7 @@ def _jitted_device_decode():
             logits, cache = forward_with_cache(
                 params, prompt, cache, 0, config, mesh)
             key, sub = split(key)
-            first = pick(logits[:, -1, :], sub)
+            first, first_lp = pick(logits[:, -1, :], sub)
             done0 = (first[:, 0] == eos_id if eos_id is not None
                      else jnp.zeros((first.shape[0],), bool))
 
@@ -418,24 +443,34 @@ def _jitted_device_decode():
                 logits, cache = forward_with_cache(
                     params, last, cache, prompt_len + i, config, mesh)
                 key, sub = split(key)
-                nxt = pick(logits[:, -1, :], sub)
+                nxt, lp = pick(logits[:, -1, :], sub)
                 if eos_id is not None:
                     # a finished row keeps emitting eos_id; the step
                     # above still ran (static shapes — the scan can't
                     # skip work), its output is simply masked out
                     nxt = jnp.where(done[:, None], eos_id, nxt)
+                    if want_lp:
+                        lp = jnp.where(done, 0.0, lp)
                     done = done | (nxt[:, 0] == eos_id)
-                return (cache, nxt, key, done), nxt[:, 0]
+                out = (nxt[:, 0], lp) if want_lp else nxt[:, 0]
+                return (cache, nxt, key, done), out
 
-            (_, _, _, _), rest = lax.scan(
+            (_, _, _, _), rest_out = lax.scan(
                 body, (cache, first, key, done0),
                 jnp.arange(max_new_tokens - 1, dtype=jnp.int32))
+            rest = rest_out[0] if want_lp else rest_out
             # rest: (max_new_tokens-1, B) -> (B, max_new_tokens-1)
-            return jnp.concatenate(
+            tokens = jnp.concatenate(
                 [prompt, first, jnp.transpose(rest, (1, 0))], axis=1)
+            if not want_lp:
+                return tokens
+            logprobs = jnp.concatenate(
+                [first_lp[:, None],
+                 jnp.transpose(rest_out[1], (1, 0))], axis=1)
+            return tokens, logprobs
 
         _DEVICE_DECODE_JIT = jax.jit(
-            decode, static_argnums=(4, 5, 6, 7, 8, 9, 10),
+            decode, static_argnums=(4, 5, 6, 7, 8, 9, 10, 11),
             donate_argnums=(2,))
     return _DEVICE_DECODE_JIT
 
@@ -444,7 +479,7 @@ def generate_on_device(params, prompt, config, mesh,
                        max_new_tokens: int, param_dtype=None,
                        temperature: float = 0.0, top_k=None, key=None,
                        quantize_kv: bool = False, top_p=None,
-                       eos_id=None):
+                       eos_id=None, return_logprobs: bool = False):
     """:func:`generate`, but the token loop runs ON the device.
 
     The host-driven loop costs one dispatch (and on a tunneled backend,
@@ -480,4 +515,5 @@ def generate_on_device(params, prompt, config, mesh,
             params, prompt, cache, key if temperature > 0.0 else None,
             max_new_tokens, float(temperature), top_k,
             float(top_p) if top_p is not None else None,
-            int(eos_id) if eos_id is not None else None, config, mesh)
+            int(eos_id) if eos_id is not None else None,
+            bool(return_logprobs), config, mesh)
