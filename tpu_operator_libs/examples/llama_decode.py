@@ -327,6 +327,32 @@ def _pick_next(logits_last, temperature: float, top_k, key,
     return choice[:, None].astype(jnp.int32), chosen_lp[:, 0]
 
 
+def _prefill(step, params, prompt, cache, prefill_chunk):
+    """Prompt through the cache in one pass, or in ``prefill_chunk``-
+    sized blocks (static count — the loop unrolls at trace time).
+    Returns (last block's logits, cache)."""
+    prompt_len = prompt.shape[1]
+    _check_prefill_chunk(prefill_chunk)
+    if prefill_chunk is None or prompt_len <= prefill_chunk:
+        return step(params, prompt, cache, 0)
+    logits = None
+    for off in range(0, prompt_len, prefill_chunk):
+        block = prompt[:, off:off + prefill_chunk]
+        logits, cache = step(params, block, cache, off)
+    return logits, cache
+
+
+def _check_prefill_chunk(prefill_chunk):
+    """Both generate paths must agree on what a valid chunk is — an
+    int >= 1 (a float would silently chunk differently on one path
+    and crash range() on the other)."""
+    if prefill_chunk is None:
+        return
+    if not isinstance(prefill_chunk, int) or prefill_chunk < 1:
+        raise ValueError(
+            f"prefill_chunk must be an int >= 1, got {prefill_chunk!r}")
+
+
 def _check_sampling_args(temperature, key, top_p):
     """Shared sampling-argument validation for both generate paths."""
     if temperature > 0.0 and key is None:
@@ -338,7 +364,8 @@ def _check_sampling_args(temperature, key, top_p):
 def generate(params, prompt, config, mesh, max_new_tokens: int,
              param_dtype=None, temperature: float = 0.0,
              top_k=None, key=None, quantize_kv: bool = False,
-             top_p=None, eos_id=None, return_logprobs: bool = False):
+             top_p=None, eos_id=None, return_logprobs: bool = False,
+             prefill_chunk=None):
     """Autoregressive decode: prefill the prompt, then one cached step
     per token. ``temperature=0`` (default) is greedy; otherwise
     softmax sampling at the given temperature, optionally top-k and/or
@@ -353,7 +380,13 @@ def generate(params, prompt, config, mesh, max_new_tokens: int,
     a (tokens, logprobs) pair where logprobs is (B, max_new_tokens)
     float32 — each generated token's log-probability under the
     model's own (untempered, untruncated) distribution, the quantity
-    serving APIs report; eos-padded positions carry 0.0."""
+    serving APIs report; eos-padded positions carry 0.0.
+    ``prefill_chunk`` processes the prompt in fixed-size blocks
+    instead of one pass: the prefill score buffer is (T × cache
+    width), so at long prompts chunking bounds peak memory at
+    (chunk × width) — chunk-by-chunk prefill is mathematically the
+    same attention (each query row reduces over the same positions in
+    the same order), it just never materializes the full-T buffer."""
     import jax
     import jax.numpy as jnp
 
@@ -373,7 +406,8 @@ def generate(params, prompt, config, mesh, max_new_tokens: int,
         key, sub = jax.random.split(key)
         return sub
 
-    logits, cache = step(params, prompt, cache, 0)
+    logits, cache = _prefill(step, params, prompt, cache,
+                             prefill_chunk)
     tokens = [prompt]
     lps = []
     last, lp = _pick_next(logits[:, -1, :], temperature, top_k,
@@ -413,7 +447,7 @@ def _jitted_device_decode():
     if _DEVICE_DECODE_JIT is None:
         def decode(params, prompt, cache, key, max_new_tokens,
                    temperature, top_k, top_p, eos_id, want_lp,
-                   config, mesh):
+                   prefill_chunk, config, mesh):
             prompt_len = prompt.shape[1]
             greedy = temperature <= 0.0
             if key is None:
@@ -431,8 +465,11 @@ def _jitted_device_decode():
                     return k, None
                 return tuple(jax.random.split(k))
 
-            logits, cache = forward_with_cache(
-                params, prompt, cache, 0, config, mesh)
+            def step(p, t, c, pos):
+                return forward_with_cache(p, t, c, pos, config, mesh)
+
+            logits, cache = _prefill(step, params, prompt, cache,
+                                     prefill_chunk)
             key, sub = split(key)
             first, first_lp = pick(logits[:, -1, :], sub)
             done0 = (first[:, 0] == eos_id if eos_id is not None
@@ -470,7 +507,7 @@ def _jitted_device_decode():
             return tokens, logprobs
 
         _DEVICE_DECODE_JIT = jax.jit(
-            decode, static_argnums=(4, 5, 6, 7, 8, 9, 10, 11),
+            decode, static_argnums=(4, 5, 6, 7, 8, 9, 10, 11, 12),
             donate_argnums=(2,))
     return _DEVICE_DECODE_JIT
 
@@ -479,7 +516,8 @@ def generate_on_device(params, prompt, config, mesh,
                        max_new_tokens: int, param_dtype=None,
                        temperature: float = 0.0, top_k=None, key=None,
                        quantize_kv: bool = False, top_p=None,
-                       eos_id=None, return_logprobs: bool = False):
+                       eos_id=None, return_logprobs: bool = False,
+                       prefill_chunk=None):
     """:func:`generate`, but the token loop runs ON the device.
 
     The host-driven loop costs one dispatch (and on a tunneled backend,
@@ -511,9 +549,15 @@ def generate_on_device(params, prompt, config, mesh,
         # expected, not a bug signal.
         warnings.filterwarnings(
             "ignore", message="Some donated buffers were not usable")
+        # normalize a no-op chunk to None BEFORE the jitted call:
+        # the chunk is a static argument, so distinct values would
+        # otherwise compile distinct (but identical) executables
+        _check_prefill_chunk(prefill_chunk)
+        if prefill_chunk is not None and prefill_chunk >= prompt_len:
+            prefill_chunk = None
         return _jitted_device_decode()(
             params, prompt, cache, key if temperature > 0.0 else None,
             max_new_tokens, float(temperature), top_k,
             float(top_p) if top_p is not None else None,
             int(eos_id) if eos_id is not None else None,
-            bool(return_logprobs), config, mesh)
+            bool(return_logprobs), prefill_chunk, config, mesh)
