@@ -1,0 +1,253 @@
+#!/usr/bin/env python3
+"""Drainable JAX serving job — the workload BASELINE config #5 protects.
+
+This is the pod on the other side of the serving drain gate
+(tpu_operator_libs.health.serving_gate): a decode server whose request
+intake is a :class:`~tpu_operator_libs.health.serving_gate
+.ServingEndpoint` and whose compute is the fused single-dispatch loop
+(``examples/llama_decode.generate_on_device`` — prefill + ``lax.scan``
+token loop + sampling, donated KV cache). During a rolling libtpu
+upgrade the operator's ``ServingDrainGate`` flips the endpoint to
+draining: new requests are parked (never dropped — they simply never
+start here and the router re-routes them), in-flight generations run to
+completion, and only then does eviction proceed. The unit of loss the
+gate drives to zero is a dropped generation; this binary's summary line
+reports exactly that counter.
+
+Run the self-contained demo (any backend; a TPU serves for real):
+
+    python -m tpu_operator_libs.examples.llama_serving_job --demo
+
+It serves a burst of concurrent requests, begins draining mid-burst
+(as the first upgrade reconcile that wants this pod gone would), lets
+the in-flight generations finish, and prints one JSON summary line —
+``dropped`` is 0 and ``parked`` counts the requests the drain turned
+away. On SIGTERM (the eviction that should only arrive after the gate
+opened) it marks any still-in-flight generations dropped, so a
+mis-sequenced eviction is visible in the same counter the gate
+protects.
+
+The operator-side wiring is ``ServingDrainGate`` on the eviction-gate
+seam — see health/serving_gate.py and
+docs/automatic-libtpu-upgrade.md.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import logging
+import signal
+import sys
+import threading
+
+logger = logging.getLogger("llama-serving-job")
+
+
+def make_mesh(n_devices=None):
+    """A dp×tp mesh over the available devices — the same
+    factorization the training job uses (one implementation; a future
+    mesh-construction change must not silently diverge between the
+    two workload binaries)."""
+    from tpu_operator_libs.examples.jax_training_job import (
+        make_mesh as _mm,
+    )
+
+    return _mm(n_devices)
+
+
+class DecodeServer:
+    """One serving pod: a ServingEndpoint fronting the fused decode.
+
+    ``handle`` is the whole request path — admission, generation,
+    accounting. It returns the generated tokens, or ``None`` when the
+    endpoint is draining (the request was PARKED: it never started, so
+    it is not a drop — the router's job is to re-route it)."""
+
+    def __init__(self, mesh, config, params, endpoint,
+                 max_new_tokens: int = 8, temperature: float = 0.0,
+                 quantize_kv: bool = False):
+        self.mesh = mesh
+        self.config = config
+        self.params = params
+        self.endpoint = endpoint
+        self.max_new_tokens = max_new_tokens
+        self.temperature = temperature
+        self.quantize_kv = quantize_kv
+        self.parked = 0
+        self._lock = threading.Lock()
+
+    def handle(self, prompt, key=None):
+        import numpy as np
+
+        from tpu_operator_libs.examples.llama_decode import (
+            generate_on_device,
+        )
+
+        if not self.endpoint.try_begin():
+            with self._lock:
+                self.parked += 1
+            return None
+        try:
+            out = generate_on_device(
+                self.params, prompt, self.config, self.mesh,
+                self.max_new_tokens, temperature=self.temperature,
+                key=key, quantize_kv=self.quantize_kv)
+            return np.asarray(out)
+        finally:
+            try:
+                self.endpoint.finish()
+            except RuntimeError:
+                # the endpoint was kill()ed (eviction) while this
+                # generation ran: its loss is already counted in
+                # ``dropped``, and the finish of that dead epoch must
+                # not crash the worker thread during shutdown
+                pass
+
+    def summary(self) -> dict:
+        return {
+            "completed": self.endpoint.completed,
+            "dropped": self.endpoint.dropped,
+            "parked": self.parked,
+            "draining": self.endpoint.draining,
+        }
+
+
+def build_server(mesh, n_layers: int = 2, d_model: int = 64,
+                 quantize: bool = False, quantize_kv: bool = False,
+                 max_new_tokens: int = 8):
+    """A small Llama-style decode server (demo-sized; real deployments
+    load real weights the same way). ``quantize``/``quantize_kv``
+    switch on the int8 weight / int8 KV-cache serving stack."""
+    import jax.numpy as jnp
+
+    from tpu_operator_libs.examples.llama import (
+        LlamaConfig,
+        init_llama_params,
+    )
+    from tpu_operator_libs.examples.llama_decode import (
+        quantize_params_int8,
+    )
+    from tpu_operator_libs.health.serving_gate import ServingEndpoint
+
+    config = LlamaConfig(vocab=d_model, d_model=d_model,
+                         n_layers=n_layers,
+                         n_heads=max(4, d_model // 16),
+                         n_kv_heads=max(4, d_model // 16),
+                         d_ff=4 * d_model, seq_len=64,
+                         learning_rate=1e-4)
+    params = init_llama_params(mesh, config, param_dtype=jnp.bfloat16)
+    if quantize:
+        params = quantize_params_int8(params)
+    endpoint = ServingEndpoint("llama-serving-demo")
+    return DecodeServer(mesh, config, params, endpoint,
+                        max_new_tokens=max_new_tokens,
+                        quantize_kv=quantize_kv)
+
+
+def run_demo(server, n_requests: int = 12, drain_after: int = 6,
+             workers: int = 3) -> dict:
+    """Serve a burst of concurrent requests, begin draining mid-burst,
+    and wait for quiescence — the sequence an upgrade reconcile drives
+    through ServingDrainGate. The drain fires synchronously in the
+    worker that picks request ``drain_after``, BEFORE it submits that
+    request: the demo is deterministic about at least that request
+    being parked (never a race against sub-millisecond decodes), while
+    requests already admitted on other threads model the in-flight
+    generations the gate waits out. Returns the summary dict."""
+    import jax
+    import jax.numpy as jnp
+
+    # a drain index past the burst would never fire: clamp so --demo
+    # with a tiny --requests still exercises the drain
+    drain_after = min(drain_after, n_requests - 1)
+    prompts = [
+        jax.random.randint(jax.random.PRNGKey(i), (2, 4), 0,
+                           server.config.vocab, dtype=jnp.int32)
+        for i in range(n_requests)
+    ]
+    # warm the executable once so the drain window doesn't race a
+    # multi-second first compile (a real server warms at startup too)
+    server.handle(prompts[0])
+
+    served = []
+    idx_lock = threading.Lock()
+    next_idx = [0]
+
+    def worker():
+        while True:
+            with idx_lock:
+                i = next_idx[0]
+                if i >= n_requests:
+                    return
+                next_idx[0] = i + 1
+            if i == drain_after:
+                # the "upgrade reconcile": the first evaluation that
+                # wants this pod gone begins the drain
+                server.endpoint.begin_drain()
+            out = server.handle(prompts[i])
+            if out is not None:
+                served.append(i)
+
+    threads = [threading.Thread(target=worker) for _ in range(workers)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join(timeout=120)
+    if not server.endpoint.quiesced:
+        raise RuntimeError("demo did not quiesce")
+    out = server.summary()
+    out["served_request_ids"] = sorted(served)
+    return out
+
+
+def main() -> int:
+    parser = argparse.ArgumentParser(
+        description=__doc__.split("\n")[0])
+    parser.add_argument("--demo", action="store_true",
+                        help="serve a burst, drain mid-burst, print a "
+                             "JSON summary line")
+    parser.add_argument("--requests", type=int, default=12)
+    parser.add_argument("--drain-after", type=int, default=6)
+    parser.add_argument("--int8", action="store_true",
+                        help="serve the int8 weight + int8 KV stack")
+    parser.add_argument("--max-new-tokens", type=int, default=8)
+    args = parser.parse_args()
+    logging.basicConfig(level=logging.INFO,
+                        format="%(asctime)s %(name)s %(message)s")
+    # honor JAX_PLATFORMS even where a sitecustomize force-registered
+    # an accelerator plugin (env alone is not enough once jax is
+    # imported — same belt-and-suspenders as the bench probes)
+    import os
+
+    if os.environ.get("JAX_PLATFORMS"):
+        import jax
+
+        jax.config.update("jax_platforms", os.environ["JAX_PLATFORMS"])
+    if not args.demo:
+        parser.error("only --demo mode is implemented standalone; "
+                     "real deployments embed DecodeServer")
+
+    mesh = make_mesh()
+    server = build_server(mesh, quantize=args.int8,
+                          quantize_kv=args.int8,
+                          max_new_tokens=args.max_new_tokens)
+
+    def on_sigterm(signum, frame):
+        # eviction arriving BEFORE the gate opened: every in-flight
+        # generation is lost, and the summary shows it
+        dropped = server.endpoint.kill()
+        logger.warning("SIGTERM: %d in-flight generation(s) dropped",
+                       dropped)
+        print(json.dumps(server.summary()))
+        sys.exit(1)
+
+    signal.signal(signal.SIGTERM, on_sigterm)
+    summary = run_demo(server, n_requests=args.requests,
+                       drain_after=args.drain_after)
+    print(json.dumps(summary))
+    return 0 if summary["dropped"] == 0 else 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())
