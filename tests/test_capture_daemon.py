@@ -1,0 +1,132 @@
+"""capture_daemon: the wedge-aware opportunistic capture loop that
+produces the committed hardware evidence (docs/bench_capture.json).
+The contract under test is the validation/install step: only a
+live-chip, parseable capture is atomically installed; every failure
+shape (timeout, nonzero exit, garbage output, wedged-mid-capture) is
+rejected WITHOUT touching the committed capture."""
+
+import importlib.util
+import json
+import os
+import subprocess
+import sys
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+_spec = importlib.util.spec_from_file_location(
+    "capture_daemon", os.path.join(REPO, "tools", "capture_daemon.py"))
+daemon = importlib.util.module_from_spec(_spec)
+sys.modules["capture_daemon"] = daemon  # one shared module instance
+_spec.loader.exec_module(daemon)
+
+
+def _proc(stdout="", returncode=0, stderr=""):
+    class P:
+        pass
+
+    p = P()
+    p.stdout = stdout
+    p.returncode = returncode
+    p.stderr = stderr
+    return p
+
+
+LIVE_LINE = json.dumps({
+    "metric": "rolling_upgrade_slice_availability", "value": 87.4,
+    "mxu_tflops_bf16": 165.7, "tpu_unreachable": False})
+
+
+class TestRunFullCapture:
+    def _patch(self, monkeypatch, tmp_path, bench_proc,
+               raise_timeout=False):
+        capture_path = tmp_path / "bench_capture.json"
+        capture_path.write_text('{"sentinel": true}\n')
+        monkeypatch.setattr(daemon, "CAPTURE", str(capture_path))
+        calls = []
+
+        def fake_run(cmd, **kw):
+            calls.append(cmd)
+            if "bench.py" in " ".join(cmd):
+                if raise_timeout:
+                    raise subprocess.TimeoutExpired(cmd, 1.0)
+                return bench_proc
+            return _proc()  # gen_bench_docs
+
+        monkeypatch.setattr(daemon.subprocess, "run", fake_run)
+        return capture_path, calls
+
+    def test_live_capture_installs_atomically(self, monkeypatch,
+                                              tmp_path):
+        capture_path, calls = self._patch(
+            monkeypatch, tmp_path, _proc(stdout=LIVE_LINE + "\n"))
+        assert daemon.run_full_capture(10.0) is True
+        installed = json.loads(capture_path.read_text())
+        assert installed["mxu_tflops_bf16"] == 165.7
+        # docs regenerated after the install
+        assert any("gen_bench_docs" in " ".join(c) for c in calls)
+
+    def test_wedged_mid_capture_rejected(self, monkeypatch, tmp_path):
+        wedged = json.dumps({"value": 87.4, "tpu_unreachable": True,
+                             "tpu_unreachable_reason": "wedged",
+                             "mxu_tflops_bf16": None})
+        capture_path, _ = self._patch(monkeypatch, tmp_path,
+                                      _proc(stdout=wedged + "\n"))
+        assert daemon.run_full_capture(10.0) is False
+        # committed capture untouched
+        assert json.loads(capture_path.read_text()) == {
+            "sentinel": True}
+
+    def test_nonzero_exit_rejected(self, monkeypatch, tmp_path):
+        capture_path, _ = self._patch(
+            monkeypatch, tmp_path,
+            _proc(stdout=LIVE_LINE, returncode=3, stderr="boom"))
+        assert daemon.run_full_capture(10.0) is False
+        assert json.loads(capture_path.read_text()) == {
+            "sentinel": True}
+
+    def test_unparseable_output_rejected(self, monkeypatch, tmp_path):
+        capture_path, _ = self._patch(
+            monkeypatch, tmp_path, _proc(stdout="not json at all\n"))
+        assert daemon.run_full_capture(10.0) is False
+        assert json.loads(capture_path.read_text()) == {
+            "sentinel": True}
+
+    def test_bench_timeout_treated_as_wedged(self, monkeypatch,
+                                             tmp_path):
+        capture_path, _ = self._patch(monkeypatch, tmp_path, None,
+                                      raise_timeout=True)
+        assert daemon.run_full_capture(10.0) is False
+        assert json.loads(capture_path.read_text()) == {
+            "sentinel": True}
+
+    def test_last_json_line_wins(self, monkeypatch, tmp_path):
+        """Warning noise on stdout before the JSON line must not break
+        parsing — bench's contract is ONE JSON line, last."""
+        noisy = "some warning\n" + LIVE_LINE + "\n"
+        capture_path, _ = self._patch(monkeypatch, tmp_path,
+                                      _proc(stdout=noisy))
+        assert daemon.run_full_capture(10.0) is True
+        assert json.loads(
+            capture_path.read_text())["mxu_tflops_bf16"] == 165.7
+
+
+class TestMainOnce:
+    def test_once_exits_nonzero_when_wedged(self, monkeypatch,
+                                            capsys):
+        monkeypatch.setattr(daemon.bench, "_preflight",
+                            lambda: (False, "wedged"))
+        recorded = []
+        monkeypatch.setattr(daemon.bench, "_record_attempt",
+                            lambda ok, reason=None: recorded.append(
+                                (ok, reason)))
+        monkeypatch.setattr(sys, "argv", ["capture_daemon", "--once"])
+        assert daemon.main() == 1
+        assert recorded and recorded[0][0] is False
+
+    def test_once_exits_zero_on_capture(self, monkeypatch):
+        monkeypatch.setattr(daemon.bench, "_preflight",
+                            lambda: (True, "ok"))
+        monkeypatch.setattr(daemon, "run_full_capture",
+                            lambda timeout_s: True)
+        monkeypatch.setattr(sys, "argv", ["capture_daemon", "--once"])
+        assert daemon.main() == 0
