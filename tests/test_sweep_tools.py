@@ -1,0 +1,133 @@
+"""Sweep tools (mfu_sweep / decode_sweep / sweep_common): the A/B
+instruments that rank probe protocols on the live chip. Under test:
+the shared cell runner's env/error contract and decode_sweep's
+argument validation, wedge abort, and result table."""
+
+import importlib.util
+import os
+import sys
+
+import pytest
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def _load(name):
+    spec = importlib.util.spec_from_file_location(
+        name, os.path.join(REPO, "tools", f"{name}.py"))
+    mod = importlib.util.module_from_spec(spec)
+    sys.modules[name] = mod
+    spec.loader.exec_module(mod)
+    return mod
+
+
+sweep_common = _load("sweep_common")
+decode_sweep = _load("decode_sweep")
+
+
+class TestRunProbeCell:
+    def test_overrides_stringified_and_merged(self, monkeypatch):
+        seen = {}
+
+        def fake_probe(timeout_s, script=None, env=None):
+            seen.update(env=env, timeout=timeout_s, script=script)
+            return {"decode_tok_s": 123}, None
+
+        monkeypatch.setattr(sweep_common.bench, "_probe_once",
+                            fake_probe)
+        out = sweep_common.run_probe_cell({"BENCH_DECODE_NEW": 32},
+                                          timeout_s=5.0)
+        assert out == {"decode_tok_s": 123}
+        assert seen["env"]["BENCH_DECODE_NEW"] == "32"  # stringified
+        assert "PATH" in seen["env"]  # merged over os.environ
+        assert seen["timeout"] == 5.0
+        # the runner's core guarantee: cells run the UNMODIFIED model
+        # probe, not some other script (or the default roofline probe)
+        assert seen["script"] is sweep_common.bench._MODEL_PROBE_SCRIPT
+
+    def test_spawn_failure_and_probe_error_same_shape(self,
+                                                     monkeypatch):
+        monkeypatch.setattr(sweep_common.bench, "_probe_once",
+                            lambda *a, **k: (None, "timed out"))
+        assert sweep_common.run_probe_cell({}, 1.0) == {
+            "error": "timed out"}
+        monkeypatch.setattr(
+            sweep_common.bench, "_probe_once",
+            lambda *a, **k: ({"error": "OOM"}, None))
+        assert sweep_common.run_probe_cell({}, 1.0) == {"error": "OOM"}
+
+    def test_wedged_mid_sweep(self, monkeypatch, capsys):
+        monkeypatch.setattr(sweep_common.bench, "_preflight",
+                            lambda: (False, "gone"))
+        assert sweep_common.wedged_mid_sweep("toolx") is True
+        assert "toolx: chip wedged mid-sweep" in capsys.readouterr().out
+        monkeypatch.setattr(sweep_common.bench, "_preflight",
+                            lambda: (True, "ok"))
+        assert sweep_common.wedged_mid_sweep("toolx") is False
+
+
+class TestDecodeSweep:
+    def test_rejects_ctx_not_exceeding_prompt(self, monkeypatch):
+        monkeypatch.setattr(sys, "argv",
+                            ["decode_sweep", "--ctx", "64"])
+        assert decode_sweep.main() == 2
+
+    def test_aborts_when_preflight_fails(self, monkeypatch, capsys):
+        monkeypatch.setattr(decode_sweep.bench, "_preflight",
+                            lambda: (False, "wedged"))
+        monkeypatch.setattr(sys, "argv", ["decode_sweep"])
+        assert decode_sweep.main() == 1
+        assert "aborting" in capsys.readouterr().out
+
+    def test_table_and_kv_gain(self, monkeypatch, capsys):
+        monkeypatch.setattr(decode_sweep.bench, "_preflight",
+                            lambda: (True, "ok"))
+
+        def fake_cell(ctx, timeout_s):
+            return {"decode_tok_s": 5000, "decode_int8_tok_s": 7000,
+                    "decode_int8_kv_tok_s": 9100}
+
+        monkeypatch.setattr(decode_sweep, "run_cell", fake_cell)
+        monkeypatch.setattr(sys, "argv",
+                            ["decode_sweep", "--ctx", "1024"])
+        assert decode_sweep.main() == 0
+        out = capsys.readouterr().out
+        assert "9100" in out
+        assert "1.30x" in out  # 9100 / 7000
+
+    def test_failed_cell_then_wedge_aborts_remaining(self,
+                                                     monkeypatch,
+                                                     capsys):
+        pre = iter([(True, "ok"), (False, "gone")])
+        monkeypatch.setattr(decode_sweep.bench, "_preflight",
+                            lambda: next(pre))
+        calls = []
+
+        def fake_cell(ctx, timeout_s):
+            calls.append(ctx)
+            return {"error": "probe died"}
+
+        monkeypatch.setattr(decode_sweep, "run_cell", fake_cell)
+        monkeypatch.setattr(
+            sys, "argv", ["decode_sweep", "--ctx", "1024", "4096"])
+        assert decode_sweep.main() == 0
+        assert calls == [1024]  # 4096 never ran after the wedge
+        out = capsys.readouterr().out
+        assert "FAILED" in out
+
+    def test_run_cell_pins_long_context_small(self, monkeypatch):
+        seen = {}
+        monkeypatch.setattr(
+            decode_sweep, "run_probe_cell",
+            lambda overrides, t: seen.update(overrides) or {})
+        decode_sweep.run_cell(1024, 10.0)
+        assert seen["BENCH_DECODE_PROMPT"] == decode_sweep.PROMPT
+        assert seen["BENCH_DECODE_NEW"] == 1024 - decode_sweep.PROMPT
+        assert seen["BENCH_MODEL_LONG_SEQ"] == 256
+
+
+@pytest.mark.parametrize("tool", ["mfu_sweep"])
+def test_sweep_tools_import_and_share_runner(tool):
+    mod = _load(tool)
+    assert mod.run_probe_cell is sweep_common.run_probe_cell
+    assert mod.wedged_mid_sweep is sweep_common.wedged_mid_sweep
