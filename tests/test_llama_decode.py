@@ -689,3 +689,65 @@ class TestChunkedPrefill:
         prompt = make_token_batch(mesh, 0, config)[:, :4]
         with pytest.raises(ValueError, match="prefill_chunk"):
             generate(params, prompt, config, mesh, 2, prefill_chunk=0)
+
+
+class TestQuantizationProperties:
+    """Property tests (hypothesis) for the int8 recipe and the nucleus
+    sampler's invariants — the deterministic tests above pin specific
+    shapes; these pin the CONTRACTS over arbitrary finite inputs."""
+
+    from hypothesis import given, settings
+    from hypothesis import strategies as st
+    import hypothesis.extra.numpy as hnp
+
+    _finite = st.floats(min_value=-1e4, max_value=1e4, width=32)
+
+    @given(hnp.arrays(dtype="float32", elements=_finite,
+                      shape=hnp.array_shapes(min_dims=2, max_dims=4,
+                                             min_side=1, max_side=6)))
+    @settings(deadline=None, max_examples=50)
+    def test_sym_int8_roundtrip_bound_any_axis(self, x):
+        """For every axis choice: codes are int8, scales positive, and
+        per-element reconstruction error <= s/2 + ulp slack — including
+        all-zero slices (the 1e-8 floor) and extreme magnitudes."""
+        import numpy as np
+
+        from tpu_operator_libs.examples.llama_decode import _sym_int8
+
+        for axis in range(x.ndim):
+            q, s = _sym_int8(x, axis=axis)
+            q, s = np.asarray(q), np.asarray(s)
+            assert q.dtype == np.int8
+            assert (s > 0).all()
+            recon = q.astype(np.float32) * np.expand_dims(s, axis)
+            err = np.abs(recon - x)
+            bound = (np.expand_dims(s, axis) / 2.0
+                     + 1e-5 * np.abs(x) + 1e-7)
+            assert (err <= bound).all()
+
+    @given(st.integers(min_value=0, max_value=2 ** 31 - 1),
+           st.floats(min_value=0.05, max_value=1.0),
+           st.floats(min_value=0.1, max_value=3.0))
+    @settings(deadline=None, max_examples=30)
+    def test_nucleus_always_contains_argmax_and_is_nonempty(
+            self, seed, top_p, temperature):
+        """Whatever top_p/temperature: the most-likely token is always
+        sampleable (the exclusive-cumsum keeps the first sorted token
+        unconditionally), so sampling can never see an all -inf row."""
+        import jax
+        import numpy as np
+
+        from tpu_operator_libs.examples.llama_decode import _pick_next
+
+        key = jax.random.PRNGKey(seed)
+        logits = jax.random.normal(key, (3, 17)) * 4.0
+        tok, _ = _pick_next(logits, temperature, None,
+                            jax.random.PRNGKey(seed + 1), top_p)
+        tok = np.asarray(tok)
+        assert tok.shape == (3, 1)
+        assert ((tok >= 0) & (tok < 17)).all()
+        # degenerate top_p: only the argmax survives the nucleus
+        tok_tiny, _ = _pick_next(logits, temperature, None,
+                                 jax.random.PRNGKey(seed + 2), 1e-9)
+        expect = np.asarray(logits.argmax(axis=-1))[:, None]
+        assert (np.asarray(tok_tiny) == expect).all()
