@@ -237,3 +237,131 @@ class TestLlamaResume:
                              save_interval=4, n_devices=4, model="llama")
         assert straight["loss"] == pytest.approx(second["loss"],
                                                  abs=1e-6)
+
+
+def _param_delta(before, after):
+    """Summed per-leaf L2 norm of the parameter change — the single
+    step-magnitude metric every trainer-knob test uses."""
+    import numpy as np
+
+    d = jax.tree.map(
+        lambda a, b: float(jnp.linalg.norm(
+            jnp.asarray(a, jnp.float32) - jnp.asarray(b, jnp.float32))),
+        after, before)
+    return sum(jax.tree.leaves(d))
+
+
+class TestTrainerKnobs:
+    """LR schedule + gradient clipping: config-gated (defaults keep
+    the constant-LR, unclipped step bit-unchanged — the bench
+    protocol's shape)."""
+
+    def _one_step(self, config, seed=0):
+        import numpy as np
+
+        mesh = make_mesh()
+        params = init_llama_params(mesh, config)
+        optimizer, step = make_train_step(mesh, config)
+        state = {"params": params, "opt": optimizer.init(params),
+                 "step": jnp.zeros((), jnp.int32)}
+        toks = make_token_batch(mesh, seed, config)
+        before = jax.tree.map(lambda x: np.asarray(x), params)
+        state, loss = step(state, toks)
+        return state, float(loss), _param_delta(before,
+                                                state["params"])
+
+    def test_warmup_freezes_step_zero_then_ramps(self):
+        import dataclasses
+
+        base = LlamaConfig()
+        sched = dataclasses.replace(base, warmup_steps=10,
+                                    total_steps=100)
+        _, loss_c, delta_c = self._one_step(base)
+        state_s, loss_s, delta_s = self._one_step(sched)
+        # identical loss (forward unchanged); warmup LR is exactly 0
+        # at step 0, so the first update is a true no-op
+        assert abs(loss_c - loss_s) < 1e-5
+        assert delta_s == 0.0
+        # ...and the ramp is real: the next step moves, but far less
+        # than the constant-LR step (lr is 1/10th of peak at step 1)
+        import numpy as np
+
+        mesh = make_mesh()
+        before = jax.tree.map(lambda x: np.asarray(x),
+                              state_s["params"])
+        optimizer, step = make_train_step(mesh, sched)
+        state_s, _ = step(state_s, make_token_batch(mesh, 1, sched))
+        delta1 = _param_delta(before, state_s["params"])
+        assert 0.0 < delta1 < delta_c
+
+    def test_schedule_decays_to_zero_at_horizon(self):
+        import dataclasses
+
+        config = dataclasses.replace(LlamaConfig(), warmup_steps=2,
+                                     total_steps=8)
+        mesh = make_mesh()
+        params = init_llama_params(mesh, config)
+        optimizer, step = make_train_step(mesh, config)
+        state = {"params": params, "opt": optimizer.init(params),
+                 "step": jnp.zeros((), jnp.int32)}
+        deltas = []
+        for i in range(8):
+            import numpy as np
+
+            before = jax.tree.map(lambda x: np.asarray(x),
+                                  state["params"])
+            state, loss = step(state, make_token_batch(mesh, i,
+                                                       config))
+            deltas.append(_param_delta(before, state["params"]))
+        assert jnp.isfinite(loss)
+        # warmup rises, cosine tail shrinks toward the horizon
+        assert deltas[1] > deltas[0]
+        assert deltas[-1] < max(deltas) * 0.35
+
+    def test_grad_clip_wiring_binding_and_not(self):
+        """Adam's update is ~scale-invariant, so a moderate clip barely
+        changes step magnitude — the wiring is pinned from both sides:
+        a non-binding ceiling leaves the step exactly unchanged, and a
+        ceiling far below adam's eps scale visibly shrinks it."""
+        import dataclasses
+
+        base = LlamaConfig()
+        _, _, delta_free = self._one_step(base)
+        loose = dataclasses.replace(base, grad_clip_norm=1e9)
+        _, _, delta_loose = self._one_step(loose)
+        assert abs(delta_loose - delta_free) < 1e-4 * max(
+            delta_free, 1.0)
+        tight = dataclasses.replace(base, grad_clip_norm=1e-8)
+        _, _, delta_tight = self._one_step(tight)
+        # clipped grads ~1e-10/coord sink below adam's eps: the
+        # update collapses by orders of magnitude
+        assert delta_tight < delta_free * 0.1
+
+    def test_defaults_unchanged_and_resumable_shape(self):
+        """total_steps=0 keeps plain adamw optimizer state (no chain
+        tuple nesting) — checkpoints from earlier builds keep loading."""
+        mesh = make_mesh()
+        config = LlamaConfig()
+        params = init_llama_params(mesh, config)
+        optimizer, _ = make_train_step(mesh, config)
+        opt_state = optimizer.init(params)
+        import optax
+
+        # adamw's state: (ScaleByAdamState, ...) — the clip chain would
+        # wrap this in ANOTHER tuple layer whose first element is
+        # ClipByGlobalNormState (an EmptyState)
+        assert isinstance(opt_state[0], optax.ScaleByAdamState)
+
+    def test_schedule_knob_validation(self):
+        import dataclasses
+
+        mesh = make_mesh()
+        with pytest.raises(ValueError, match="requires total_steps"):
+            make_train_step(mesh, dataclasses.replace(
+                LlamaConfig(), warmup_steps=100))
+        with pytest.raises(ValueError, match="must be <"):
+            make_train_step(mesh, dataclasses.replace(
+                LlamaConfig(), warmup_steps=8, total_steps=8))
+        with pytest.raises(ValueError, match="grad_clip_norm"):
+            make_train_step(mesh, dataclasses.replace(
+                LlamaConfig(), grad_clip_norm=-1.0))

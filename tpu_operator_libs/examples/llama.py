@@ -43,6 +43,16 @@ class LlamaConfig:
     seq_len: int = 32
     rope_theta: float = 10000.0
     learning_rate: float = 3e-3
+    # Optional LR schedule: with total_steps > 0 the step uses linear
+    # warmup over warmup_steps then cosine decay to 0 at total_steps
+    # (the standard LLM pretraining shape); 0 keeps the constant LR so
+    # existing configs (and the bench protocol) are bit-unchanged.
+    warmup_steps: int = 0
+    total_steps: int = 0
+    # Optional global-norm gradient clipping (0 = off). When on, the
+    # optimizer state gains the chain's tuple nesting — a checkpoint
+    # written with clipping on/off must resume with the same setting.
+    grad_clip_norm: float = 0.0
     # "xla" (einsum softmax; the compiler tiles it well to ~4k context)
     # or "flash" (the Pallas TPU flash-attention kernel; never
     # materializes the S x S scores — measured ~15x faster at seq 8192
@@ -355,7 +365,31 @@ def make_train_step(mesh, config: LlamaConfig,
     import jax
     import optax
 
-    optimizer = optax.adamw(config.learning_rate)
+    if config.warmup_steps and config.total_steps <= 0:
+        raise ValueError(
+            f"warmup_steps={config.warmup_steps} requires "
+            "total_steps > 0 (the schedule horizon); total_steps=0 "
+            "means constant LR and would silently skip the warmup")
+    if 0 < config.total_steps <= config.warmup_steps:
+        raise ValueError(
+            f"warmup_steps={config.warmup_steps} must be < "
+            f"total_steps={config.total_steps} (cosine decay needs a "
+            "positive post-warmup horizon)")
+    if config.grad_clip_norm < 0.0:
+        raise ValueError(
+            f"grad_clip_norm must be >= 0, got {config.grad_clip_norm}")
+    if config.total_steps > 0:
+        lr = optax.warmup_cosine_decay_schedule(
+            init_value=0.0, peak_value=config.learning_rate,
+            warmup_steps=config.warmup_steps,
+            decay_steps=config.total_steps)
+    else:
+        lr = config.learning_rate
+    optimizer = optax.adamw(lr)
+    if config.grad_clip_norm > 0.0:
+        optimizer = optax.chain(
+            optax.clip_by_global_norm(config.grad_clip_norm),
+            optimizer)
 
     def train_step(state, tokens):
         def loss_of(p):
