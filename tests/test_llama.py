@@ -365,3 +365,6 @@ class TestTrainerKnobs:
         with pytest.raises(ValueError, match="grad_clip_norm"):
             make_train_step(mesh, dataclasses.replace(
                 LlamaConfig(), grad_clip_norm=-1.0))
+        with pytest.raises(ValueError, match="must be >= 0"):
+            make_train_step(mesh, dataclasses.replace(
+                LlamaConfig(), total_steps=-200))

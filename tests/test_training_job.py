@@ -151,3 +151,30 @@ class TestGatedEvictionWithLiveJob:
         assert cluster.get_node(node).metadata.labels[keys.state_label] == \
             UpgradeState.DONE
         assert not cluster.list_pods(namespace="ml")  # evicted after gate
+
+
+class TestTrainerOverrides:
+    """--total-steps/--warmup-steps/--grad-clip-norm reach the llama
+    workload's LlamaConfig; misuse (overrides with the MLP) fails
+    fast — before any mesh/backend work."""
+
+    def test_llama_trains_under_schedule_and_clip(self, job, tmp_path):
+        result = job.train(str(tmp_path / "ckpt"), max_steps=3,
+                           save_interval=2, n_devices=8, model="llama",
+                           trainer_overrides={"total_steps": 50,
+                                              "warmup_steps": 5,
+                                              "grad_clip_norm": 1.0})
+        assert result["final_step"] == 3
+        import math
+
+        assert math.isfinite(result["loss"])
+
+    def test_overrides_rejected_for_mlp(self, job, tmp_path):
+        import pytest
+
+        with pytest.raises(ValueError, match="llama workload only"):
+            job.train(str(tmp_path / "ckpt"), max_steps=1, n_devices=8,
+                      model="mlp", trainer_overrides={"total_steps": 10})
+        with pytest.raises(ValueError, match="unknown model"):
+            job.train(str(tmp_path / "ckpt"), max_steps=1, n_devices=8,
+                      model="bogus", trainer_overrides={"total_steps": 10})

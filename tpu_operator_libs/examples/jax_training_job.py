@@ -165,11 +165,17 @@ def replicate_unplaced(state, mesh):
     return jax.tree.map(place, state)
 
 
-def init_state_llama(mesh):
+def init_state_llama(mesh, trainer_overrides=None):
     """Llama-style decoder workload (BASELINE #4's model family): same
     {"params", "opt", "step"} state contract as the MLP, so the
     checkpoint/resume loop and the operator's durability gate are
-    model-agnostic."""
+    model-agnostic. ``trainer_overrides`` replaces LlamaConfig fields
+    (the CLI's --total-steps/--warmup-steps/--grad-clip-norm path);
+    NOTE a checkpoint must resume with the same overrides — the
+    schedule position and the clip chain's state shape live in the
+    optimizer state."""
+    import dataclasses
+
     import jax.numpy as jnp
 
     from tpu_operator_libs.examples.llama import (
@@ -179,6 +185,8 @@ def init_state_llama(mesh):
     )
 
     config = config_for_mesh(mesh.shape["tp"])
+    if trainer_overrides:
+        config = dataclasses.replace(config, **trainer_overrides)
     params = init_llama_params(mesh, config)
     optimizer, step_fn = make_train_step(mesh, config)
     state = {"params": params, "opt": optimizer.init(params),
@@ -188,18 +196,28 @@ def init_state_llama(mesh):
 
 def train(checkpoint_dir: str, max_steps: int = 100,
           save_interval: int = 10, n_devices: int | None = None,
-          stop_flag=None, model: str = "mlp") -> dict:
+          stop_flag=None, model: str = "mlp",
+          trainer_overrides=None) -> dict:
     """The training loop. Returns {"final_step", "start_step", "loss"}.
 
     ``model`` picks the workload: "mlp" (tiny regression net) or
     "llama" (dp×tp-sharded Llama-style decoder). Importable for tests;
-    __main__ adds signal handling around it.
+    __main__ adds signal handling around it. ``trainer_overrides``
+    (llama only) replaces LlamaConfig fields, e.g. the LR schedule /
+    grad-clip knobs.
     """
+    if model not in ("mlp", "llama"):
+        raise ValueError(f"unknown model {model!r}")
+    if trainer_overrides and model != "llama":
+        raise ValueError(
+            "trainer_overrides (LR schedule / grad clip) apply to the "
+            "llama workload only")
     mesh = make_mesh(n_devices)
     if model == "llama":
         from tpu_operator_libs.examples.llama import make_token_batch
 
-        state, step_fn, config = init_state_llama(mesh)
+        state, step_fn, config = init_state_llama(mesh,
+                                                  trainer_overrides)
 
         def apply_update(state, x, y):
             return step_fn(state, x)
@@ -260,7 +278,20 @@ def main() -> int:
                         default="mlp",
                         help="workload: tiny regression MLP or the "
                              "dp x tp-sharded Llama-style decoder")
+    parser.add_argument("--total-steps", type=int, default=0,
+                        help="llama: LR schedule horizon (warmup + "
+                             "cosine decay); 0 = constant LR")
+    parser.add_argument("--warmup-steps", type=int, default=0,
+                        help="llama: linear LR warmup steps")
+    parser.add_argument("--grad-clip-norm", type=float, default=0.0,
+                        help="llama: global-norm gradient clip; "
+                             "0 = off")
     args = parser.parse_args()
+    trainer_overrides = {
+        k: v for k, v in (("total_steps", args.total_steps),
+                          ("warmup_steps", args.warmup_steps),
+                          ("grad_clip_norm", args.grad_clip_norm))
+        if v} or None
     logging.basicConfig(
         level=logging.INFO,
         format="%(asctime)s %(name)s %(levelname)s %(message)s")
@@ -281,7 +312,7 @@ def main() -> int:
     signal.signal(signal.SIGINT, on_term)
     result = train(args.checkpoint_dir, args.max_steps, args.save_interval,
                    args.n_devices, stop_flag=lambda: stop["flag"],
-                   model=args.model)
+                   model=args.model, trainer_overrides=trainer_overrides)
     logger.info("exiting at step %d (started from %d)",
                 result["final_step"], result["start_step"])
     return 0

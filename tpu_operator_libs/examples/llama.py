@@ -365,6 +365,11 @@ def make_train_step(mesh, config: LlamaConfig,
     import jax
     import optax
 
+    if config.total_steps < 0 or config.warmup_steps < 0:
+        raise ValueError(
+            f"total_steps={config.total_steps} / warmup_steps="
+            f"{config.warmup_steps} must be >= 0 (a negative horizon "
+            "would silently fall back to constant LR)")
     if config.warmup_steps and config.total_steps <= 0:
         raise ValueError(
             f"warmup_steps={config.warmup_steps} requires "
