@@ -1,0 +1,13 @@
+#!/usr/bin/env python3
+"""Shim: see tpu_operator_libs/examples/llama_serving_job.py."""
+
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from tpu_operator_libs.examples.llama_serving_job import *  # noqa: F401,F403
+from tpu_operator_libs.examples.llama_serving_job import main  # noqa: F401
+
+if __name__ == "__main__":
+    sys.exit(main())
