@@ -84,3 +84,60 @@ class TestDemoDrainSequence:
         assert dropped == 2
         assert srv.summary()["dropped"] == 2
         assert srv.endpoint.draining
+
+
+class TestServingMetrics:
+    def test_endpoint_counters_render_on_the_fleet_scrape(self):
+        from tpu_operator_libs.health.serving_gate import ServingEndpoint
+        from tpu_operator_libs.metrics import (
+            MetricsRegistry,
+            observe_serving_endpoints,
+        )
+
+        ep = ServingEndpoint("decode-s0")
+        assert ep.try_begin()
+        ep.finish()
+        assert ep.try_begin()
+        ep.kill()  # one dropped
+        registry = MetricsRegistry()
+        observe_serving_endpoints(registry, [ep])
+        text = registry.render_prometheus()
+        assert 'serving_generations_completed_total{' in text
+        assert 'endpoint="decode-s0"' in text
+        assert registry.get("serving_generations_dropped_total",
+                            {"driver": "libtpu",
+                             "endpoint": "decode-s0"}) == 1
+        assert registry.get("serving_draining",
+                            {"driver": "libtpu",
+                             "endpoint": "decode-s0"}) == 1.0
+        assert registry.get("serving_in_flight",
+                            {"driver": "libtpu",
+                             "endpoint": "decode-s0"}) == 0
+
+    def test_retired_endpoint_gauges_removed_counters_kept(self):
+        from tpu_operator_libs.health.serving_gate import ServingEndpoint
+        from tpu_operator_libs.metrics import (
+            MetricsRegistry,
+            observe_serving_endpoints,
+        )
+
+        ep = ServingEndpoint("decode-s1")
+        assert ep.try_begin()
+        ep.kill()  # pod evicted mid-flight: 1 dropped, then retired
+        registry = MetricsRegistry()
+        observe_serving_endpoints(registry, [ep])
+        assert registry.get("serving_draining",
+                            {"driver": "libtpu",
+                             "endpoint": "decode-s1"}) == 1.0
+        # next pass: the endpoint is gone from the live set
+        observe_serving_endpoints(registry, [], retired=[ep])
+        assert registry.get("serving_draining",
+                            {"driver": "libtpu",
+                             "endpoint": "decode-s1"}) is None
+        assert registry.get("serving_in_flight",
+                            {"driver": "libtpu",
+                             "endpoint": "decode-s1"}) is None
+        # the loss stays on the books
+        assert registry.get("serving_generations_dropped_total",
+                            {"driver": "libtpu",
+                             "endpoint": "decode-s1"}) == 1

@@ -16,7 +16,7 @@ from __future__ import annotations
 
 import threading
 from dataclasses import dataclass, field
-from typing import TYPE_CHECKING, Optional
+from typing import TYPE_CHECKING, Iterable, Optional
 
 from tpu_operator_libs.consts import ALL_STATES
 
@@ -96,6 +96,18 @@ class MetricsRegistry:
         ``clear()`` is). ``set_gauge`` would render ``# TYPE gauge`` and
         break rate() on *_total-named series."""
         self._set(name, value, help_, "counter", labels)
+
+    def remove_series(self, name: str,
+                      labels: Optional[dict[str, str]] = None) -> None:
+        """Drop one labeled series (no-op when absent). The registry's
+        only removal path — needed by observers whose label sets are
+        dynamic (e.g. per-endpoint serving gauges): without removal, a
+        vanished endpoint's last gauge values would render on every
+        future scrape forever."""
+        with self._lock:
+            m = self._metrics.get(name)
+            if m is not None:
+                m.values.pop(self._key(labels), None)
 
     def inc_counter(self, name: str, help_: str = "",
                     labels: Optional[dict[str, str]] = None,
@@ -260,3 +272,52 @@ def observe_client_health(registry: MetricsRegistry,
         registry.set_counter_total(
             "events_sink_dropped_total", sink_dropped,
             "Correlated events dropped on sink-queue overflow", labels)
+
+
+def observe_serving_endpoints(registry: MetricsRegistry,
+                              endpoints: "Iterable[object]",
+                              driver: str = "libtpu",
+                              retired: "Iterable[object]" = ()) -> None:
+    """Export the serving drain gate's unit-of-loss accounting.
+
+    ``endpoints``: an iterable of ``ServingEndpoint``-shaped objects
+    (health/serving_gate.py) — per endpoint: in-flight generations and
+    draining state as gauges, completed/dropped generations as
+    counters. ``dropped_total`` staying at 0 across a rolling upgrade
+    IS the gate's guarantee, so it belongs on the same scrape the
+    fleet gauges ride.
+
+    ``retired``: endpoints whose pods are gone (the e2e fleet keeps
+    exactly this list for drop accounting). Their point-in-time GAUGES
+    are removed — a dead endpoint's frozen ``serving_draining=1``
+    would otherwise alert forever — while their cumulative counters
+    keep exporting: losses must not vanish from the books when the
+    endpoint that suffered them does.
+    """
+    labels = {"driver": driver}
+    for ep in endpoints:
+        ep_labels = {**labels, "endpoint": ep.name}
+        registry.set_gauge(
+            "serving_in_flight", ep.in_flight,
+            "Generations currently running on the endpoint", ep_labels)
+        registry.set_gauge(
+            "serving_draining", 1.0 if ep.draining else 0.0,
+            "1 while the endpoint refuses new generations", ep_labels)
+        registry.set_counter_total(
+            "serving_generations_completed_total", ep.completed,
+            "Generations finished and delivered", ep_labels)
+        registry.set_counter_total(
+            "serving_generations_dropped_total", ep.dropped,
+            "Generations lost to eviction (the gate drives this to 0)",
+            ep_labels)
+    for ep in retired:
+        ep_labels = {**labels, "endpoint": ep.name}
+        registry.remove_series("serving_in_flight", ep_labels)
+        registry.remove_series("serving_draining", ep_labels)
+        registry.set_counter_total(
+            "serving_generations_completed_total", ep.completed,
+            "Generations finished and delivered", ep_labels)
+        registry.set_counter_total(
+            "serving_generations_dropped_total", ep.dropped,
+            "Generations lost to eviction (the gate drives this to 0)",
+            ep_labels)
