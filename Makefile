@@ -4,7 +4,7 @@ PYTHON ?= python3
 PYTEST_FLAGS ?= -q
 COV_THRESHOLD ?= 85
 
-.PHONY: all check test test-fast lint cov bench graft-check package clean diagram
+.PHONY: all check test test-fast test-fault lint cov bench graft-check package clean diagram
 
 all: lint test
 
@@ -20,6 +20,11 @@ test:
 
 test-fast:
 	$(PYTHON) -m pytest tests/ $(PYTEST_FLAGS) -x
+
+# Tier-1 unplanned-fault slice: wedge detection, the remediation ladder,
+# and lossy-apiserver convergence (marker registered in pyproject.toml).
+test-fault:
+	$(PYTHON) -m pytest tests/ $(PYTEST_FLAGS) -m fault
 
 # In-repo static analyzer (tools/lint.py): always available, fails on
 # findings — no silent degradation when external linters are missing

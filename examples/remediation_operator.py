@@ -1,0 +1,20 @@
+#!/usr/bin/env python3
+"""Shim: the operator lives in the installable package
+(tpu_operator_libs/examples/remediation_operator.py); this path-based
+entry point is kept for repo-checkout invocation and docs parity."""
+
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from tpu_operator_libs.examples.remediation_operator import *  # noqa: F401,F403
+from tpu_operator_libs.examples.remediation_operator import (  # noqa: F401
+    DemoRebooter,
+    load_remediation_policy,
+    main,
+    run_demo,
+)
+
+if __name__ == "__main__":
+    sys.exit(main())

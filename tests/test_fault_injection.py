@@ -2,7 +2,11 @@
 real state machine (SURVEY.md §5 — the reference only simulates failures
 via mock errors; here the failures happen in the cluster model)."""
 
+import pytest
+
 from tpu_operator_libs.simulate import FleetSpec, simulate_rolling_upgrade
+
+pytestmark = pytest.mark.fault
 
 
 class TestCrashLoopingRuntime:

@@ -1,0 +1,302 @@
+"""End-to-end auto-remediation scenarios on the simulated GKE TPU fleet:
+
+- Full ladder runs whose every observed node transition is asserted
+  against the machine-checked edge table (consts.REMEDIATION_EDGES) —
+  the same invariant the upgrade e2e suite pins for its graph.
+- Coexistence with the planned-upgrade machine: a wedged node is parked
+  out of an in-flight rollout via the upgrade skip label, the rollout
+  completes around it, and the parking is lifted on recovery.
+- The unified multi-accelerator manager driving both machines from one
+  policy document.
+- The demo operator as a subprocess (examples are product surface).
+"""
+
+import json
+import subprocess
+import sys
+
+import pytest
+
+pytestmark = pytest.mark.fault
+
+from tpu_operator_libs.api.remediation_policy import RemediationPolicySpec
+from tpu_operator_libs.api.unified_policy import (
+    AcceleratorSpec,
+    MultiAcceleratorUpgradeManager,
+    UnifiedUpgradePolicySpec,
+)
+from tpu_operator_libs.api.upgrade_policy import (
+    DrainSpec,
+    UpgradePolicySpec,
+)
+from tpu_operator_libs.consts import (
+    REMEDIATION_LEGAL_EDGES,
+    TRUE_STRING,
+    RemediationKeys,
+    RemediationState,
+    UpgradeState,
+)
+from tpu_operator_libs.remediation import NodeRemediationManager
+from tpu_operator_libs.simulate import (
+    NS,
+    RUNTIME_LABELS,
+    FleetSpec,
+    build_fleet,
+)
+from tpu_operator_libs.upgrade.state_manager import (
+    BuildStateError,
+    ClusterUpgradeStateManager,
+)
+
+KEYS = RemediationKeys()
+
+
+def assert_remediation_transitions_legal(trail):
+    for node, states in trail.items():
+        for src, dst in zip(states, states[1:]):
+            if src == dst:
+                continue
+            assert dst in REMEDIATION_LEGAL_EDGES.get(src, set()), (
+                f"illegal remediation transition on {node}: "
+                f"{src!r} -> {dst!r}; full trail: {states}")
+
+
+def record_trail(cluster, trail):
+    for node in cluster.list_nodes():
+        state = node.metadata.labels.get(KEYS.state_label, "")
+        if trail[node.metadata.name][-1] != state:
+            trail[node.metadata.name].append(state)
+
+
+class HealingRebooter:
+    """Models a real power-cycle in the sim: the node goes away briefly,
+    then comes back Ready."""
+
+    def __init__(self, cluster, reboot_seconds=60.0):
+        self.cluster = cluster
+        self.reboot_seconds = reboot_seconds
+        self.requests = []
+
+    def request_reboot(self, node):
+        name = node.metadata.name
+        self.requests.append(name)
+        self.cluster.schedule_at(
+            self.cluster.clock.now() + self.reboot_seconds,
+            lambda: self.cluster.set_node_ready(name, True))
+
+
+class TestRemediationScenarios:
+    def drive(self, cluster, clock, mgr, policy, trail,
+              done, max_steps=400, dt=10.0):
+        """One apply_state per virtual interval (reference-consumer
+        pacing), recording per-pass label trails."""
+        for _ in range(max_steps):
+            snapshot = mgr.build_state(NS, RUNTIME_LABELS)
+            mgr.apply_state(snapshot, policy)
+            record_trail(cluster, trail)
+            if done():
+                return
+            clock.advance(dt)
+            cluster.step()
+        raise AssertionError("scenario did not converge; trail: "
+                             f"{trail}")
+
+    def test_crashloop_recovery_trail_is_legal(self):
+        fleet = FleetSpec(n_slices=2, hosts_per_slice=2,
+                          pod_recreate_delay=5.0, pod_ready_delay=15.0)
+        cluster, clock, upgrade_keys = build_fleet(fleet)
+        mgr = NodeRemediationManager(
+            cluster, KEYS, upgrade_keys=upgrade_keys, clock=clock,
+            poll_interval=0.0, sync_timeout=5.0)
+        policy = RemediationPolicySpec(
+            enable=True, settle_seconds=30,
+            drain=DrainSpec(enable=True, force=True))
+        victim = "s0-h0"
+        pod = next(p for p in cluster.list_pods(namespace=NS)
+                   if p.spec.node_name == victim)
+        cluster.set_pod_status(NS, pod.name, ready=False,
+                               restart_count=20)
+        trail = {n.metadata.name: [""] for n in cluster.list_nodes()}
+        self.drive(cluster, clock, mgr, policy, trail,
+                   done=lambda: (mgr.remediations_succeeded_total == 1))
+        assert_remediation_transitions_legal(trail)
+        # the victim walked the restart arc, nobody else moved
+        assert str(RemediationState.RESTART_REQUIRED) in trail[victim]
+        assert str(RemediationState.REBOOT_REQUIRED) not in trail[victim]
+        for name, states in trail.items():
+            if name != victim:
+                assert states == [""]
+
+    def test_dead_node_escalates_to_reboot_trail_is_legal(self):
+        fleet = FleetSpec(n_slices=2, hosts_per_slice=2,
+                          pod_recreate_delay=5.0, pod_ready_delay=15.0)
+        cluster, clock, upgrade_keys = build_fleet(fleet)
+        rebooter = HealingRebooter(cluster)
+        mgr = NodeRemediationManager(
+            cluster, KEYS, upgrade_keys=upgrade_keys, rebooter=rebooter,
+            clock=clock, poll_interval=0.0, sync_timeout=5.0)
+        policy = RemediationPolicySpec(
+            enable=True, restart_attempts=1, max_attempts=3,
+            action_timeout_seconds=120, settle_seconds=30,
+            revalidate_timeout_seconds=60,
+            drain=DrainSpec(enable=True, force=True))
+        policy.detection.not_ready_grace_seconds = 30
+        victim = "s1-h1"
+        cluster.set_node_ready(victim, False)
+        trail = {n.metadata.name: [""] for n in cluster.list_nodes()}
+        self.drive(cluster, clock, mgr, policy, trail,
+                   done=lambda: (mgr.remediations_succeeded_total == 1))
+        assert_remediation_transitions_legal(trail)
+        assert rebooter.requests == [victim]
+        # the dead node burned the restart rung first, then escalated
+        assert str(RemediationState.REBOOT_REQUIRED) in trail[victim]
+        node = cluster.get_node(victim)
+        assert node.is_ready() and not node.spec.unschedulable
+
+    def test_remediation_coexists_with_rolling_upgrade(self):
+        """A wedged node is quarantined while a libtpu rollout runs: the
+        rollout completes on every healthy node (the wedged one is
+        skipped via the parking label), and after recovery the node is
+        eligible for upgrades again."""
+        fleet = FleetSpec(n_slices=2, hosts_per_slice=2,
+                          pod_recreate_delay=5.0, pod_ready_delay=15.0)
+        cluster, clock, upgrade_keys = build_fleet(fleet)
+        rem = NodeRemediationManager(
+            cluster, KEYS, upgrade_keys=upgrade_keys,
+            rebooter=HealingRebooter(cluster), clock=clock,
+            poll_interval=0.0, sync_timeout=5.0)
+        rem_policy = RemediationPolicySpec(
+            enable=True, restart_attempts=1, max_attempts=3,
+            action_timeout_seconds=120, settle_seconds=30,
+            revalidate_timeout_seconds=60)
+        rem_policy.detection.not_ready_grace_seconds = 30
+        upgrade = ClusterUpgradeStateManager(
+            cluster, upgrade_keys, async_workers=False, clock=clock,
+            poll_interval=0.0)
+        upgrade_policy = UpgradePolicySpec(
+            auto_upgrade=True, max_parallel_upgrades=0,
+            max_unavailable=None,
+            drain=DrainSpec(enable=True, force=True))
+
+        victim = "s0-h1"
+        cluster.set_node_ready(victim, False)
+        cluster.bump_daemon_set_revision(NS, "libtpu", "rev2")
+        healthy = [n.metadata.name for n in cluster.list_nodes()
+                   if n.metadata.name != victim]
+        saw_parked_skip = False
+        for _ in range(400):
+            try:
+                state = upgrade.build_state(NS, RUNTIME_LABELS)
+                upgrade.apply_state(state, upgrade_policy)
+            except BuildStateError:
+                pass  # restarted pod mid-recreation; next pass catches up
+            rem.apply_state(rem.build_state(NS, RUNTIME_LABELS),
+                            rem_policy)
+            upgrade.join_workers()
+            victim_labels = cluster.get_node(victim).metadata.labels
+            if victim_labels.get(upgrade_keys.skip_label) == TRUE_STRING:
+                saw_parked_skip = True
+            done_upgrades = all(
+                cluster.get_node(n).metadata.labels.get(
+                    upgrade_keys.state_label) == str(UpgradeState.DONE)
+                for n in healthy)
+            if done_upgrades and rem.remediations_succeeded_total == 1:
+                break
+            clock.advance(10.0)
+            cluster.step()
+        else:
+            raise AssertionError("combined scenario did not converge")
+        assert saw_parked_skip
+        # recovered node no longer parked: the next rollout may take it
+        final = cluster.get_node(victim).metadata.labels
+        assert upgrade_keys.skip_label not in final
+        assert final.get(KEYS.state_label, "") == ""
+
+    def test_unified_manager_drives_remediation_per_accelerator(self):
+        fleet = FleetSpec(n_slices=2, hosts_per_slice=2,
+                          pod_recreate_delay=5.0, pod_ready_delay=15.0)
+        cluster, clock, _ = build_fleet(fleet)
+        unified = UnifiedUpgradePolicySpec(accelerators={
+            "tpu": AcceleratorSpec(
+                name="tpu", driver="libtpu", domain="google.com",
+                runtime_labels=dict(RUNTIME_LABELS), namespace=NS,
+                policy=UpgradePolicySpec(),
+                remediation=RemediationPolicySpec(
+                    enable=True, settle_seconds=0)),
+        })
+        mgr = MultiAcceleratorUpgradeManager(
+            cluster, unified, async_workers=False, clock=clock,
+            remediation_kwargs=dict(clock=clock, poll_interval=0.0,
+                                    sync_timeout=5.0))
+        pod = next(p for p in cluster.list_pods(namespace=NS)
+                   if p.spec.node_name == "s0-h0")
+        cluster.set_pod_status(NS, pod.name, ready=False,
+                               restart_count=20)
+        rem = mgr.remediation_managers["tpu"]
+        for _ in range(200):
+            results = mgr.reconcile()
+            assert results["tpu"] is None
+            if rem.remediations_succeeded_total == 1:
+                break
+            clock.advance(10.0)
+            cluster.step()
+        else:
+            raise AssertionError("unified remediation did not converge")
+        status = mgr.cluster_status()
+        assert status["tpu"]["remediation"]["recoveredTotal"] == 1
+        assert status["tpu"]["remediation"]["nodesByState"] \
+            == {"healthy": 4}
+
+    def test_policy_roundtrips_through_unified_document(self):
+        doc = {
+            "accelerators": {
+                "tpu": {
+                    "domain": "google.com", "driver": "libtpu",
+                    "runtimeLabels": {"app": "libtpu"},
+                    "policy": {"autoUpgrade": True},
+                    "remediation": {
+                        "enable": True, "maxConcurrent": 2,
+                        "restartAttempts": 1, "maxAttempts": 3,
+                        "detection": {"notReadyGraceSeconds": 120},
+                        "drain": {"enable": True, "force": True},
+                    },
+                },
+            },
+        }
+        spec = UnifiedUpgradePolicySpec.from_dict(doc)
+        spec.validate()
+        tpu = spec.accelerators["tpu"]
+        assert tpu.remediation.max_concurrent == 2
+        assert tpu.remediation.detection.not_ready_grace_seconds == 120
+        assert tpu.remediation_keys.state_label \
+            == "google.com/libtpu-remediation-state"
+        assert spec.to_dict()["accelerators"]["tpu"]["remediation"][
+            "maxAttempts"] == 3
+
+
+class TestDemoOperator:
+    def test_demo_recovers_both_fault_classes(self):
+        proc = subprocess.run(
+            [sys.executable, "examples/remediation_operator.py", "--demo"],
+            capture_output=True, text=True, timeout=150)
+        assert proc.returncode == 0, proc.stderr[-2000:]
+        assert "demo complete" in proc.stderr
+        status = json.loads(
+            proc.stdout[:proc.stdout.index("\n# ") + 1] or proc.stdout)
+        assert status["recoveredTotal"] == 2
+        assert status["wedgedNodes"] == 0
+        assert "tpu_upgrade_remediation_recovery_seconds_count" \
+            in proc.stdout
+
+    def test_policy_check_mode(self, tmp_path):
+        policy_file = tmp_path / "remediation.json"
+        policy_file.write_text(json.dumps({
+            "enable": True, "maxAttempts": 5,
+            "detection": {"unhealthyConditionTypes": ["TpuHealthy"]}}))
+        proc = subprocess.run(
+            [sys.executable, "examples/remediation_operator.py",
+             "--policy", str(policy_file), "--check"],
+            capture_output=True, text=True, timeout=60)
+        assert proc.returncode == 0, proc.stderr[-2000:]
+        canonical = json.loads(proc.stdout)
+        assert canonical["maxAttempts"] == 5

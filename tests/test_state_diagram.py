@@ -1,9 +1,10 @@
-"""docs/state-diagram.{dot,svg} drift check (VERDICT r2 item 6).
+"""docs/ state-diagram drift checks (VERDICT r2 item 6).
 
-The diagram artifacts are generated from consts.STATE_EDGES; these
-tests fail the build whenever the table and the committed artifacts
-disagree — the failure mode the reference's hand-drawn PNG suffers
-(its own docs mark it outdated, automatic-ofed-upgrade.md:85).
+Both diagram pairs — the planned-upgrade machine's and the
+auto-remediation machine's — are generated from their transition tables
+in consts; these tests fail the build whenever a table and its committed
+artifacts disagree — the failure mode the reference's hand-drawn PNG
+suffers (its own docs mark it outdated, automatic-ofed-upgrade.md:85).
 """
 
 import os
@@ -14,7 +15,11 @@ import sys
 from tpu_operator_libs.consts import (
     ALL_STATES,
     LEGAL_EDGES,
+    REMEDIATION_ALL_STATES,
+    REMEDIATION_EDGES,
+    REMEDIATION_LEGAL_EDGES,
     STATE_EDGES,
+    RemediationState,
     UpgradeState,
 )
 
@@ -50,18 +55,55 @@ class TestEdgeTable:
             seen.add((src, dst))
 
 
-class TestArtifactsInSync:
-    def test_dot_matches_table(self):
-        with open(os.path.join(ROOT, "docs", "state-diagram.dot")) as fh:
-            assert fh.read() == state_diagram.render_dot(), (
-                "docs/state-diagram.dot out of date; "
-                "run python tools/state_diagram.py")
+class TestRemediationEdgeTable:
+    def test_every_state_reachable_and_productive(self):
+        sources = {s for s, _, _ in REMEDIATION_EDGES}
+        targets = {d for _, d, _ in REMEDIATION_EDGES}
+        for state in REMEDIATION_ALL_STATES:
+            if state is RemediationState.HEALTHY:
+                assert state in sources  # entry point
+                continue
+            assert state in targets, f"{state!r} unreachable"
+            # no dead ends: even remediation-failed re-arms
+            assert state in sources, f"{state!r} has no way out"
 
-    def test_svg_matches_table(self):
-        with open(os.path.join(ROOT, "docs", "state-diagram.svg")) as fh:
-            assert fh.read() == state_diagram.render_svg(), (
-                "docs/state-diagram.svg out of date; "
-                "run python tools/state_diagram.py")
+    def test_adjacency_view_consistent(self):
+        for src, dst, _ in REMEDIATION_EDGES:
+            assert dst.value in REMEDIATION_LEGAL_EDGES[src.value]
+        assert sum(len(v) for v in REMEDIATION_LEGAL_EDGES.values()) \
+            == len(REMEDIATION_EDGES)
+
+    def test_no_self_edges_or_duplicates(self):
+        seen = set()
+        for src, dst, _ in REMEDIATION_EDGES:
+            assert src is not dst
+            assert (src, dst) not in seen, f"duplicate edge {src}->{dst}"
+            seen.add((src, dst))
+
+    def test_recovery_cycle_exists(self):
+        """The machine must be able to bring a node all the way back:
+        healthy -> wedged -> ... -> healthy along legal edges."""
+        reachable = {""}
+        frontier = [""]
+        while frontier:
+            src = frontier.pop()
+            for dst in REMEDIATION_LEGAL_EDGES.get(src, ()):
+                if dst not in reachable:
+                    reachable.add(dst)
+                    frontier.append(dst)
+        assert {s.value for s in REMEDIATION_ALL_STATES} <= reachable
+        # ...and healthy is reachable FROM wedged (the recovery arc)
+        assert "" in REMEDIATION_LEGAL_EDGES[
+            RemediationState.UNCORDON_REQUIRED.value]
+
+
+class TestArtifactsInSync:
+    def test_artifacts_match_tables(self):
+        for path, content in state_diagram.artifacts():
+            with open(path) as fh:
+                assert fh.read() == content, (
+                    f"{os.path.relpath(path, ROOT)} out of date; "
+                    "run python tools/state_diagram.py")
 
     def test_check_mode_detects_drift(self, tmp_path, monkeypatch):
         env = dict(os.environ, PYTHONPATH=ROOT)
@@ -70,29 +112,45 @@ class TestArtifactsInSync:
                                           "state_diagram.py"), "--check"],
             capture_output=True, text=True, env=env, cwd=ROOT)
         assert ok.returncode == 0, ok.stderr
-        # drift the svg in a scratch copy of docs/ via the module paths
-        monkeypatch.setattr(state_diagram, "SVG_PATH",
-                            str(tmp_path / "state-diagram.svg"))
-        monkeypatch.setattr(state_diagram, "DOT_PATH",
-                            str(tmp_path / "state-diagram.dot"))
+        # drift one artifact in a scratch copy of docs/ via module paths
+        for attr in ("SVG_PATH", "DOT_PATH", "REMEDIATION_SVG_PATH",
+                     "REMEDIATION_DOT_PATH"):
+            monkeypatch.setattr(
+                state_diagram, attr,
+                str(tmp_path / os.path.basename(
+                    getattr(state_diagram, attr))))
         monkeypatch.setattr(sys, "argv", ["state_diagram.py"])
         assert state_diagram.main() == 0  # writes fresh artifacts
-        (tmp_path / "state-diagram.svg").write_text("stale")
+        (tmp_path / "remediation-state-diagram.svg").write_text("stale")
         monkeypatch.setattr(sys, "argv", ["state_diagram.py", "--check"])
         assert state_diagram.main() == 1
 
 
 class TestRenderedContent:
     def test_dot_contains_every_edge_and_condition(self):
-        dot = state_diagram.render_dot()
-        for src, dst, cond in STATE_EDGES:
-            src_name = src.value or "unknown"
-            assert f'"{src_name}" -> "{dst.value}"' in dot
-            assert cond in dot
+        for spec, table in (
+                (state_diagram.UPGRADE_SPEC, STATE_EDGES),
+                (state_diagram.REMEDIATION_SPEC, REMEDIATION_EDGES)):
+            dot = state_diagram.render_dot(spec)
+            empty = (state_diagram.UNKNOWN
+                     if spec is state_diagram.UPGRADE_SPEC
+                     else state_diagram.HEALTHY)
+            for src, dst, cond in table:
+                src_name = src.value or empty
+                dst_name = dst.value or empty
+                assert f'"{src_name}" -> "{dst_name}"' in dot
+                assert cond in dot
 
     def test_svg_contains_every_state_and_legend_line(self):
-        svg = state_diagram.render_svg()
+        svg = state_diagram.render_svg(state_diagram.UPGRADE_SPEC)
         for state in ALL_STATES:
             assert f">{state.value or 'unknown'}</text>" in svg
         legend = re.findall(r"\d+\. [\w-]+ &#8594; [\w-]+", svg)
         assert len(legend) == len(STATE_EDGES)
+
+    def test_remediation_svg_contains_every_state_and_legend_line(self):
+        svg = state_diagram.render_svg(state_diagram.REMEDIATION_SPEC)
+        for state in REMEDIATION_ALL_STATES:
+            assert f">{state.value or 'healthy'}</text>" in svg
+        legend = re.findall(r"\d+\. [\w-]+ &#8594; [\w-]+", svg)
+        assert len(legend) == len(REMEDIATION_EDGES)

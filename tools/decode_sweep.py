@@ -27,6 +27,11 @@ import sys
 
 REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 sys.path.insert(0, REPO)
+# The bare `from sweep_common import ...` only resolves when the script is
+# run directly (the interpreter puts tools/ itself on sys.path); under
+# `python -m tools.decode_sweep` or an importlib load from another entry
+# point only REPO is present, so add tools/ explicitly.
+sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
 
 import bench  # noqa: E402
 from sweep_common import run_probe_cell, wedged_mid_sweep  # noqa: E402

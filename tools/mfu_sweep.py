@@ -29,6 +29,10 @@ import sys
 
 REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 sys.path.insert(0, REPO)
+# Same rationale as decode_sweep: tools/ is only implicitly importable when
+# the script runs as __main__; make it explicit so `python -m tools.mfu_sweep`
+# and importlib loads resolve sweep_common too.
+sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
 
 import bench  # noqa: E402
 from sweep_common import run_probe_cell, wedged_mid_sweep  # noqa: E402

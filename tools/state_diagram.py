@@ -1,13 +1,19 @@
 #!/usr/bin/env python3
-"""Generate docs/state-diagram.{dot,svg} from consts.STATE_EDGES.
+"""Generate docs/ state-diagram artifacts from the machine-checked
+transition tables in tpu_operator_libs.consts.
 
 The reference ships a hand-drawn PNG that its own docs mark outdated
 (/root/reference/docs/automatic-ofed-upgrade.md:85,
-images/driver-upgrade-state-diagram.png). Here the diagram is *derived*
-from the machine-checked transition table — the same one the e2e suite
-asserts against — and tests/test_state_diagram.py fails whenever the
-committed artifacts drift from the table, so the diagram cannot go
-stale.
+images/driver-upgrade-state-diagram.png). Here the diagrams are
+*derived* from the transition tables — the same ones the e2e suites
+assert against — and tests/test_state_diagram.py fails whenever the
+committed artifacts drift from the tables, so neither diagram can go
+stale:
+
+- docs/state-diagram.{dot,svg} from consts.STATE_EDGES (the planned
+  rolling-upgrade machine)
+- docs/remediation-state-diagram.{dot,svg} from
+  consts.REMEDIATION_EDGES (the unplanned-fault machine)
 
 Usage:
     python tools/state_diagram.py           # (re)write docs/ artifacts
@@ -20,106 +26,151 @@ from __future__ import annotations
 
 import os
 import sys
+from dataclasses import dataclass
 
 sys.path.insert(0, os.path.dirname(os.path.dirname(
     os.path.abspath(__file__))))
 
-from tpu_operator_libs.consts import STATE_EDGES, UpgradeState  # noqa: E402
+from tpu_operator_libs.consts import (  # noqa: E402
+    REMEDIATION_EDGES,
+    STATE_EDGES,
+)
 
 DOCS = os.path.join(os.path.dirname(os.path.dirname(
     os.path.abspath(__file__))), "docs")
 DOT_PATH = os.path.join(DOCS, "state-diagram.dot")
 SVG_PATH = os.path.join(DOCS, "state-diagram.svg")
+REMEDIATION_DOT_PATH = os.path.join(DOCS, "remediation-state-diagram.dot")
+REMEDIATION_SVG_PATH = os.path.join(DOCS, "remediation-state-diagram.svg")
 
-#: Display name for the unknown state (its label value is "").
+_BOX_W, _BOX_H = 230, 40
+_COL_X = 260            # left edge of the main column
+_FAIL_X = 640           # left edge of the failure state's side column
+_TOP_Y = 46
+_STEP = 96
+
+
+@dataclass(frozen=True)
+class DiagramSpec:
+    """One state machine's rendering recipe.
+
+    ``rank`` lays the main flow out as a single top-to-bottom column in
+    process order; ``fail_name`` sits in a side column at
+    ``fail_rank`` (the vertical midpoint of its in-edges). Skip/return
+    edges bow out left, failure edges go right. Every SVG edge carries a
+    number resolved by the legend underneath (numbered in table order),
+    which keeps the drawing legible without graphviz's label placement.
+    """
+
+    name: str                    # dot digraph identifier
+    title: str                   # SVG heading
+    table_name: str              # consts attribute the edges come from
+    edges: tuple                 # ((src, dst, condition) display names)
+    rank: dict                   # main-column state -> row index
+    fail_name: str
+    fail_rank: float
+    fill: dict                   # state -> box fill color
+
+
+#: Display name for the empty-label state of each machine.
 UNKNOWN = "unknown"
+HEALTHY = "healthy"
+
+UPGRADE_SPEC = DiagramSpec(
+    name="upgrade_state_machine",
+    title="libtpu upgrade state machine "
+          "(generated from consts.STATE_EDGES)",
+    table_name="STATE_EDGES",
+    edges=tuple((s.value or UNKNOWN, d.value or UNKNOWN, c)
+                for s, d, c in STATE_EDGES),
+    rank={
+        UNKNOWN: 0, "upgrade-required": 1, "cordon-required": 2,
+        "wait-for-jobs-required": 3, "pod-deletion-required": 4,
+        "drain-required": 5, "pod-restart-required": 6,
+        "validation-required": 7, "uncordon-required": 8,
+        "upgrade-done": 9,
+    },
+    fail_name="upgrade-failed",
+    fail_rank=4.5,
+    fill={UNKNOWN: "#f5f5f5", "upgrade-done": "#e3f4e3",
+          "upgrade-failed": "#fbe9e7"},
+)
+
+REMEDIATION_SPEC = DiagramSpec(
+    name="remediation_state_machine",
+    title="libtpu auto-remediation state machine "
+          "(generated from consts.REMEDIATION_EDGES)",
+    table_name="REMEDIATION_EDGES",
+    edges=tuple((s.value or HEALTHY, d.value or HEALTHY, c)
+                for s, d, c in REMEDIATION_EDGES),
+    rank={
+        HEALTHY: 0, "wedged": 1, "cordon-required": 2,
+        "drain-required": 3, "runtime-restart-required": 4,
+        "reboot-required": 5, "revalidate-required": 6,
+        "uncordon-required": 7,
+    },
+    fail_name="remediation-failed",
+    fail_rank=3.5,
+    fill={HEALTHY: "#e3f4e3", "wedged": "#fdf3d8",
+          "remediation-failed": "#fbe9e7"},
+)
 
 
-def state_name(state: UpgradeState) -> str:
-    return state.value or UNKNOWN
-
-
-def render_dot() -> str:
+def render_dot(spec: DiagramSpec) -> str:
     """Graphviz source with full edge conditions — the renderable source
     of truth for anyone with `dot` installed."""
     lines = [
-        "// GENERATED from tpu_operator_libs.consts.STATE_EDGES by",
+        f"// GENERATED from tpu_operator_libs.consts.{spec.table_name} by",
         "// tools/state_diagram.py — do not edit by hand; a test",
         "// (tests/test_state_diagram.py) fails if this file drifts.",
-        "digraph upgrade_state_machine {",
+        f"digraph {spec.name} {{",
         "  rankdir=TB;",
         '  node [shape=box, style="rounded,filled", fillcolor="#eef3fc",'
         ' fontname="Helvetica", fontsize=11];',
         '  edge [fontname="Helvetica", fontsize=9, color="#555555"];',
-        f'  "{UNKNOWN}" [fillcolor="#f5f5f5"];',
-        '  "upgrade-done" [fillcolor="#e3f4e3"];',
-        '  "upgrade-failed" [fillcolor="#fbe9e7"];',
     ]
-    for src, dst, condition in STATE_EDGES:
-        lines.append(f'  "{state_name(src)}" -> "{state_name(dst)}"'
-                     f' [label="{condition}"];')
+    for state, color in spec.fill.items():
+        lines.append(f'  "{state}" [fillcolor="{color}"];')
+    for src, dst, condition in spec.edges:
+        lines.append(f'  "{src}" -> "{dst}" [label="{condition}"];')
     lines.append("}")
     return "\n".join(lines) + "\n"
 
 
-# --- SVG layout -----------------------------------------------------------
-# Main flow is a single top-to-bottom column in process order; the
-# failure state sits in a side column. Skip/return edges bow out left,
-# failure edges go right. Every edge carries a number resolved by the
-# legend underneath (numbered in STATE_EDGES order), which keeps the
-# drawing legible without graphviz's label placement.
-
-_BOX_W, _BOX_H = 230, 40
-_COL_X = 260            # left edge of the main column
-_FAIL_X = 640           # left edge of upgrade-failed
-_TOP_Y = 46
-_STEP = 96
-
-_RANK = {
-    UNKNOWN: 0, "upgrade-required": 1, "cordon-required": 2,
-    "wait-for-jobs-required": 3, "pod-deletion-required": 4,
-    "drain-required": 5, "pod-restart-required": 6,
-    "validation-required": 7, "uncordon-required": 8, "upgrade-done": 9,
-}
-_FAIL_RANK = 4.5  # vertical midpoint of its in-edges
-
-_FILL = {UNKNOWN: "#f5f5f5", "upgrade-done": "#e3f4e3",
-         "upgrade-failed": "#fbe9e7"}
-
-
-def _pos(name: str) -> tuple[float, float]:
+def _pos(spec: DiagramSpec, name: str) -> tuple[float, float]:
     """(x, y) of a state's box top-left corner."""
-    if name == "upgrade-failed":
-        return _FAIL_X, _TOP_Y + _FAIL_RANK * _STEP
-    return _COL_X, _TOP_Y + _RANK[name] * _STEP
+    if name == spec.fail_name:
+        return _FAIL_X, _TOP_Y + spec.fail_rank * _STEP
+    return _COL_X, _TOP_Y + spec.rank[name] * _STEP
 
 
-def _edge_path(src: str, dst: str, bow: int) -> tuple[str, float, float]:
+def _edge_path(spec: DiagramSpec, src: str, dst: str,
+               bow: int) -> tuple[str, float, float]:
     """SVG path + label anchor for one edge.
 
     ``bow`` differentiates multiple left-bowing edges so they nest
     instead of overlapping.
     """
-    sx, sy = _pos(src)
-    dx, dy = _pos(dst)
-    if src == "upgrade-failed" or dst == "upgrade-failed":
+    sx, sy = _pos(spec, src)
+    dx, dy = _pos(spec, dst)
+    if spec.fail_name in (src, dst):
         # horizontal-ish curve between the columns
         x0, y0 = (sx + _BOX_W, sy + _BOX_H / 2)
         x1, y1 = (dx, dy + _BOX_H / 2)
-        if src == "upgrade-failed":  # recovery: leave left edge of failed
+        if src == spec.fail_name:  # recovery: leave left edge of failed
             x0, y0 = sx, sy + _BOX_H / 2
             x1, y1 = dx + _BOX_W, dy + _BOX_H / 2
         mx = (x0 + x1) / 2
         path = f"M {x0:.0f} {y0:.0f} C {mx:.0f} {y0:.0f}," \
                f" {mx:.0f} {y1:.0f}, {x1:.0f} {y1:.0f}"
         return path, mx, (y0 + y1) / 2 - 6
-    if _RANK[dst] == _RANK[src] + 1:
+    if spec.rank[dst] == spec.rank[src] + 1:
         # adjacent: straight vertical arrow
         x = sx + _BOX_W / 2
         path = f"M {x:.0f} {sy + _BOX_H:.0f} L {x:.0f} {dy:.0f}"
         return path, x + 8, (sy + _BOX_H + dy) / 2 + 4
     # skip or return edge: bow to the left of the column
-    span = abs(_RANK[dst] - _RANK[src])
+    span = abs(spec.rank[dst] - spec.rank[src])
     bulge = 46 + 26 * bow + 6 * span
     x0, y0 = sx, sy + _BOX_H / 2
     x1, y1 = dx, dy + _BOX_H / 2
@@ -129,14 +180,14 @@ def _edge_path(src: str, dst: str, bow: int) -> tuple[str, float, float]:
     return path, cx + 14, (y0 + y1) / 2 + 4
 
 
-def render_svg() -> str:
-    edges = [(state_name(s), state_name(d), cond)
-             for s, d, cond in STATE_EDGES]
-    legend_y = _TOP_Y + 10 * _STEP + 30
+def render_svg(spec: DiagramSpec) -> str:
+    edges = spec.edges
+    legend_y = _TOP_Y + len(spec.rank) * _STEP + 30
     height = legend_y + 16 * len(edges) + 24
     out = [
         '<?xml version="1.0" encoding="UTF-8"?>',
-        "<!-- GENERATED from tpu_operator_libs.consts.STATE_EDGES by",
+        f"<!-- GENERATED from tpu_operator_libs.consts.{spec.table_name}"
+        " by",
         "     tools/state_diagram.py; do not edit (drift-checked by",
         "     tests/test_state_diagram.py) -->",
         f'<svg xmlns="http://www.w3.org/2000/svg" width="940"'
@@ -145,28 +196,28 @@ def render_svg() -> str:
         "<defs><marker id='arrow' viewBox='0 0 10 10' refX='9' refY='5'"
         " markerWidth='7' markerHeight='7' orient='auto-start-reverse'>"
         "<path d='M 0 0 L 10 5 L 0 10 z' fill='#555555'/></marker></defs>",
-        "<text x='20' y='24' font-size='15' font-weight='bold'>"
-        "libtpu upgrade state machine (generated from consts.STATE_EDGES)"
-        "</text>",
+        f"<text x='20' y='24' font-size='15' font-weight='bold'>"
+        f"{spec.title}</text>",
     ]
     # edges under boxes
     bows: dict[str, int] = {}
     for index, (src, dst, _) in enumerate(edges, start=1):
-        is_fail = "upgrade-failed" in (src, dst)
-        adjacent = (not is_fail and _RANK[dst] == _RANK[src] + 1)
+        is_fail = spec.fail_name in (src, dst)
+        adjacent = (not is_fail
+                    and spec.rank[dst] == spec.rank[src] + 1)
         bow = 0
         if not is_fail and not adjacent:
             bow = bows.get("left", 0)
             bows["left"] = bow + 1
-        path, lx, ly = _edge_path(src, dst, bow)
+        path, lx, ly = _edge_path(spec, src, dst, bow)
         out.append(f"<path d='{path}' fill='none' stroke='#555555'"
                    " stroke-width='1.2' marker-end='url(#arrow)'/>")
         out.append(f"<text x='{lx:.0f}' y='{ly:.0f}' font-size='10'"
                    f" fill='#333333'>{index}</text>")
     # boxes over edges
-    for name in list(_RANK) + ["upgrade-failed"]:
-        x, y = _pos(name)
-        fill = _FILL.get(name, "#eef3fc")
+    for name in list(spec.rank) + [spec.fail_name]:
+        x, y = _pos(spec, name)
+        fill = spec.fill.get(name, "#eef3fc")
         out.append(f"<rect x='{x:.0f}' y='{y:.0f}' rx='8' width='{_BOX_W}'"
                    f" height='{_BOX_H}' fill='{fill}' stroke='#7a8aa0'/>")
         out.append(f"<text x='{x + _BOX_W / 2:.0f}' y='{y + 25:.0f}'"
@@ -189,11 +240,20 @@ def _escape(text: str) -> str:
             .replace(">", "&gt;"))
 
 
+def artifacts() -> list[tuple[str, str]]:
+    """(path, expected content) for every generated artifact."""
+    return [
+        (DOT_PATH, render_dot(UPGRADE_SPEC)),
+        (SVG_PATH, render_svg(UPGRADE_SPEC)),
+        (REMEDIATION_DOT_PATH, render_dot(REMEDIATION_SPEC)),
+        (REMEDIATION_SVG_PATH, render_svg(REMEDIATION_SPEC)),
+    ]
+
+
 def main() -> int:
     check = "--check" in sys.argv[1:]
     drift = []
-    for path, content in ((DOT_PATH, render_dot()),
-                          (SVG_PATH, render_svg())):
+    for path, content in artifacts():
         if check:
             try:
                 with open(path) as fh:

@@ -19,11 +19,24 @@ demands:
 - An Orbax checkpoint-durability gate so live JAX training jobs are only
   evicted once their latest checkpoint is committed
   (``tpu_operator_libs.health.checkpoint_gate``).
+- An auto-remediation subsystem — the unplanned-fault dual of the
+  upgrade machine: wedge detection (NotReady kubelets, crash-looping
+  libtpu pods, stuck-Terminating workloads, node-problem-detector
+  conditions) with durable debounce, and a quarantine → drain →
+  runtime-restart → reboot → revalidate escalation ladder
+  (``tpu_operator_libs.remediation``).
 """
 
 __version__ = "0.1.0"
 
-from tpu_operator_libs.consts import UpgradeState  # noqa: F401
+from tpu_operator_libs.consts import (  # noqa: F401
+    RemediationState,
+    UpgradeState,
+)
+from tpu_operator_libs.api.remediation_policy import (  # noqa: F401
+    RemediationPolicySpec,
+    WedgeDetectionSpec,
+)
 from tpu_operator_libs.api.upgrade_policy import (  # noqa: F401
     DrainSpec,
     PodDeletionSpec,

@@ -1,0 +1,187 @@
+"""Auto-remediation policy — the declarative surface of the
+unplanned-fault state machine.
+
+No reference counterpart: ``k8s-operator-libs`` only manages *planned*
+disruptions (driver rollouts); a wedged node simply stalls there until a
+human intervenes. TPU fleets cannot afford that — a single NotReady host
+idles its whole ICI slice — so this build adds a remediation machine
+(:mod:`tpu_operator_libs.remediation`) and this spec configures it.
+Shape and conventions mirror :mod:`tpu_operator_libs.api.upgrade_policy`:
+plain dataclasses, camelCase JSON keys, explicit ``to_dict`` /
+``from_dict`` / ``validate`` / ``deep_copy``.
+"""
+
+from __future__ import annotations
+
+import copy
+from dataclasses import dataclass
+from typing import Any, Optional
+
+from tpu_operator_libs.api.upgrade_policy import (
+    DrainSpec,
+    IntOrString,
+    PolicyValidationError,
+    scaled_value_from_int_or_percent,
+)
+
+
+@dataclass
+class WedgeDetectionSpec:
+    """Thresholds of the built-in wedge detectors
+    (:func:`tpu_operator_libs.remediation.detectors.default_detector_chain`).
+    """
+
+    # Seconds a node may report NotReady before it counts as wedged
+    # (kubelet restarts and brief network blips must not trigger
+    # quarantine).
+    not_ready_grace_seconds: int = 300
+    # Restart count beyond which a not-ready runtime container is a
+    # crash loop (same threshold the upgrade machine uses for
+    # pod-restart failure, upgrade_state.go:966-978).
+    pod_restart_threshold: int = 10
+    # Seconds a runtime pod may sit Terminating before it counts as
+    # stuck (a wedged TPU driver commonly blocks container teardown).
+    terminating_stuck_seconds: int = 600
+    # Node condition types (node-problem-detector style) whose status
+    # != "True" marks the node wedged immediately.
+    unhealthy_condition_types: tuple[str, ...] = ("TpuHealthy",)
+
+    def validate(self) -> None:
+        if self.not_ready_grace_seconds < 0:
+            raise PolicyValidationError(
+                "detection.notReadyGraceSeconds must be >= 0")
+        if self.pod_restart_threshold < 1:
+            raise PolicyValidationError(
+                "detection.podRestartThreshold must be >= 1")
+        if self.terminating_stuck_seconds < 0:
+            raise PolicyValidationError(
+                "detection.terminatingStuckSeconds must be >= 0")
+
+    def to_dict(self) -> dict[str, Any]:
+        return {
+            "notReadyGraceSeconds": self.not_ready_grace_seconds,
+            "podRestartThreshold": self.pod_restart_threshold,
+            "terminatingStuckSeconds": self.terminating_stuck_seconds,
+            "unhealthyConditionTypes": list(self.unhealthy_condition_types),
+        }
+
+    @classmethod
+    def from_dict(cls, data: dict[str, Any]) -> "WedgeDetectionSpec":
+        return cls(
+            not_ready_grace_seconds=data.get("notReadyGraceSeconds", 300),
+            pod_restart_threshold=data.get("podRestartThreshold", 10),
+            terminating_stuck_seconds=data.get(
+                "terminatingStuckSeconds", 600),
+            unhealthy_condition_types=tuple(data.get(
+                "unhealthyConditionTypes", ("TpuHealthy",))))
+
+    def deep_copy(self) -> "WedgeDetectionSpec":
+        return copy.deepcopy(self)
+
+
+@dataclass
+class RemediationPolicySpec:
+    """Top-level auto-remediation policy.
+
+    The escalation ladder: each recovery attempt ``n`` (1-based, stamped
+    durably in a node annotation) runs the runtime-restart rung while
+    ``n <= restartAttempts``, then the reboot rung; after
+    ``maxAttempts`` dispatched attempts the node parks in
+    ``remediation-failed`` for manual repair.
+    """
+
+    # Global switch; when False apply_state is a no-op (mirrors the
+    # upgrade policy's autoUpgrade gate, upgrade_state.go:372-375).
+    enable: bool = False
+    # How many nodes may be actively remediated concurrently; 0 = no
+    # limit.
+    max_concurrent: int = 1
+    # Availability budget for remediating nodes that are still serving
+    # (Ready + schedulable, e.g. a crash-looping runtime pod on a live
+    # node): such a node is only quarantined while fleet unavailability
+    # stays under this cap. Nodes already unavailable (NotReady or
+    # cordoned) are exempt — quarantining a dead node costs nothing.
+    max_unavailable: Optional[IntOrString] = "10%"
+    # Recovery-attempt ladder (see class docstring).
+    restart_attempts: int = 1
+    max_attempts: int = 3
+    # Seconds a dispatched restart/reboot may run before the attempt is
+    # written off and the node re-enters the wedged bucket.
+    action_timeout_seconds: int = 600
+    # Seconds the wedge signal must stay clear during revalidation
+    # before the node returns to service.
+    settle_seconds: int = 60
+    # Seconds revalidation may churn (signal flapping) before the
+    # attempt is written off.
+    revalidate_timeout_seconds: int = 900
+    # Workload eviction before recovery actions; None disables the
+    # drain stage (the cordon still protects new workloads).
+    drain: Optional[DrainSpec] = None
+    detection: WedgeDetectionSpec = None  # type: ignore[assignment]
+
+    def __post_init__(self) -> None:
+        if self.detection is None:
+            self.detection = WedgeDetectionSpec()
+
+    def validate(self) -> None:
+        if self.max_concurrent < 0:
+            raise PolicyValidationError("maxConcurrent must be >= 0")
+        if self.max_unavailable is not None:
+            if scaled_value_from_int_or_percent(
+                    self.max_unavailable, 100) < 0:
+                raise PolicyValidationError("maxUnavailable must be >= 0")
+        if self.restart_attempts < 0:
+            raise PolicyValidationError("restartAttempts must be >= 0")
+        if self.max_attempts < 1:
+            raise PolicyValidationError("maxAttempts must be >= 1")
+        if self.restart_attempts > self.max_attempts:
+            raise PolicyValidationError(
+                "restartAttempts must be <= maxAttempts (the ladder "
+                "cannot have more restart rungs than total attempts)")
+        for name, value in (
+                ("actionTimeoutSeconds", self.action_timeout_seconds),
+                ("settleSeconds", self.settle_seconds),
+                ("revalidateTimeoutSeconds",
+                 self.revalidate_timeout_seconds)):
+            if value < 0:
+                raise PolicyValidationError(f"{name} must be >= 0")
+        if self.drain is not None:
+            self.drain.validate()
+        self.detection.validate()
+
+    def to_dict(self) -> dict[str, Any]:
+        out: dict[str, Any] = {
+            "enable": self.enable,
+            "maxConcurrent": self.max_concurrent,
+            "maxUnavailable": self.max_unavailable,
+            "restartAttempts": self.restart_attempts,
+            "maxAttempts": self.max_attempts,
+            "actionTimeoutSeconds": self.action_timeout_seconds,
+            "settleSeconds": self.settle_seconds,
+            "revalidateTimeoutSeconds": self.revalidate_timeout_seconds,
+            "detection": self.detection.to_dict(),
+        }
+        if self.drain is not None:
+            out["drain"] = self.drain.to_dict()
+        return out
+
+    @classmethod
+    def from_dict(cls, data: dict[str, Any]) -> "RemediationPolicySpec":
+        spec = cls(
+            enable=data.get("enable", False),
+            max_concurrent=data.get("maxConcurrent", 1),
+            max_unavailable=data.get("maxUnavailable", "10%"),
+            restart_attempts=data.get("restartAttempts", 1),
+            max_attempts=data.get("maxAttempts", 3),
+            action_timeout_seconds=data.get("actionTimeoutSeconds", 600),
+            settle_seconds=data.get("settleSeconds", 60),
+            revalidate_timeout_seconds=data.get(
+                "revalidateTimeoutSeconds", 900))
+        if data.get("drain") is not None:
+            spec.drain = DrainSpec.from_dict(data["drain"])
+        if data.get("detection") is not None:
+            spec.detection = WedgeDetectionSpec.from_dict(data["detection"])
+        return spec
+
+    def deep_copy(self) -> "RemediationPolicySpec":
+        return copy.deepcopy(self)

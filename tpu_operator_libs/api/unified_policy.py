@@ -32,11 +32,12 @@ from typing import TYPE_CHECKING, Any, Callable, Optional
 if TYPE_CHECKING:  # pragma: no cover - types only
     from tpu_operator_libs.k8s.client import K8sClient
 
+from tpu_operator_libs.api.remediation_policy import RemediationPolicySpec
 from tpu_operator_libs.api.upgrade_policy import (
     PolicyValidationError,
     UpgradePolicySpec,
 )
-from tpu_operator_libs.consts import UpgradeKeys
+from tpu_operator_libs.consts import RemediationKeys, UpgradeKeys
 
 
 @dataclass
@@ -49,10 +50,17 @@ class AcceleratorSpec:
     runtime_labels: dict[str, str] = field(default_factory=dict)
     namespace: str = "kube-system"
     policy: UpgradePolicySpec = field(default_factory=UpgradePolicySpec)
+    # Optional unplanned-fault policy; None disables auto-remediation
+    # for this accelerator (tpu_operator_libs.remediation).
+    remediation: Optional[RemediationPolicySpec] = None
 
     @property
     def keys(self) -> UpgradeKeys:
         return UpgradeKeys(driver=self.driver, domain=self.domain)
+
+    @property
+    def remediation_keys(self) -> RemediationKeys:
+        return RemediationKeys(driver=self.driver, domain=self.domain)
 
     def validate(self) -> None:
         if not self.driver or not self.domain:
@@ -63,22 +71,31 @@ class AcceleratorSpec:
                 f"accelerator {self.name!r}: runtimeLabels must select the "
                 f"runtime DaemonSet")
         self.policy.validate()
+        if self.remediation is not None:
+            self.remediation.validate()
 
     def to_dict(self) -> dict[str, Any]:
-        return {"driver": self.driver, "domain": self.domain,
-                "runtimeLabels": dict(self.runtime_labels),
-                "namespace": self.namespace,
-                "policy": self.policy.to_dict()}
+        out = {"driver": self.driver, "domain": self.domain,
+               "runtimeLabels": dict(self.runtime_labels),
+               "namespace": self.namespace,
+               "policy": self.policy.to_dict()}
+        if self.remediation is not None:
+            out["remediation"] = self.remediation.to_dict()
+        return out
 
     @classmethod
     def from_dict(cls, name: str, data: dict[str, Any]) -> "AcceleratorSpec":
-        return cls(
+        spec = cls(
             name=name,
             driver=data.get("driver", name),
             domain=data.get("domain", ""),
             runtime_labels=dict(data.get("runtimeLabels", {})),
             namespace=data.get("namespace", "kube-system"),
             policy=UpgradePolicySpec.from_dict(data.get("policy", {})))
+        if data.get("remediation") is not None:
+            spec.remediation = RemediationPolicySpec.from_dict(
+                data["remediation"])
+        return spec
 
 
 @dataclass
@@ -121,7 +138,12 @@ class MultiAcceleratorUpgradeManager:
     def __init__(self, client: "K8sClient",
                  unified_policy: UnifiedUpgradePolicySpec,
                  manager_factory: Optional[Callable[..., Any]] = None,
+                 remediation_factory: Optional[Callable[..., Any]] = None,
+                 remediation_kwargs: Optional[dict[str, Any]] = None,
                  **manager_kwargs: Any) -> None:
+        from tpu_operator_libs.remediation.state_machine import (
+            NodeRemediationManager,
+        )
         from tpu_operator_libs.upgrade.state_manager import (
             ClusterUpgradeStateManager,
         )
@@ -132,11 +154,23 @@ class MultiAcceleratorUpgradeManager:
         self.managers: dict[str, ClusterUpgradeStateManager] = {
             name: factory(client, spec.keys, **manager_kwargs)
             for name, spec in unified_policy.accelerators.items()}
+        # One remediation machine per accelerator that configures one —
+        # keyed to the SAME driver/domain namespace as its upgrade
+        # machine, so the two coordinate (upgrade-in-progress guard,
+        # skip-label parking) per accelerator.
+        rem_factory = remediation_factory or NodeRemediationManager
+        self.remediation_managers: dict[str, NodeRemediationManager] = {
+            name: rem_factory(client, spec.remediation_keys,
+                              upgrade_keys=spec.keys,
+                              **(remediation_kwargs or {}))
+            for name, spec in unified_policy.accelerators.items()
+            if spec.remediation is not None}
 
     def reconcile(self) -> dict[str, Optional[Exception]]:
-        """Build + apply state for every accelerator. Failures are
-        per-accelerator: one runtime's error does not block the others.
-        Returns accelerator -> error (None on success)."""
+        """Build + apply state for every accelerator — the upgrade
+        machine and (when configured) the remediation machine. Failures
+        are per-accelerator: one runtime's error does not block the
+        others. Returns accelerator -> error (None on success)."""
         results: dict[str, Optional[Exception]] = {}
         for name, spec in self.policy.accelerators.items():
             mgr = self.managers[name]
@@ -146,6 +180,18 @@ class MultiAcceleratorUpgradeManager:
                 results[name] = None
             except Exception as exc:  # noqa: BLE001 — per-accelerator
                 results[name] = exc
+            rem = self.remediation_managers.get(name)
+            if rem is None:
+                continue
+            try:
+                snapshot = rem.build_state(spec.namespace,
+                                           spec.runtime_labels)
+                rem.apply_state(snapshot, spec.remediation)
+            except Exception as exc:  # noqa: BLE001 — per-accelerator
+                # remediation trouble must not mask an upgrade success,
+                # but an upgrade error stays the headline
+                if results[name] is None:
+                    results[name] = exc
         return results
 
     def cluster_status(self) -> dict[str, dict]:
@@ -161,4 +207,13 @@ class MultiAcceleratorUpgradeManager:
                 out[name] = mgr.cluster_status(state)
             except Exception as exc:  # noqa: BLE001 — per-accelerator
                 out[name] = {"error": str(exc)}
+            rem = self.remediation_managers.get(name)
+            if rem is None:
+                continue
+            try:
+                snapshot = rem.build_state(spec.namespace,
+                                           spec.runtime_labels)
+                out[name]["remediation"] = rem.remediation_status(snapshot)
+            except Exception as exc:  # noqa: BLE001 — per-accelerator
+                out[name]["remediation"] = {"error": str(exc)}
         return out

@@ -160,6 +160,131 @@ LEGAL_EDGES: dict[str, frozenset[str]] = {
     for src in {s.value for s, _, _ in STATE_EDGES}
 }
 
+class RemediationState(str, enum.Enum):
+    """Per-node states of the UNPLANNED-fault (auto-remediation) machine.
+
+    The planned-upgrade machine (:class:`UpgradeState`) assumes the node
+    is healthy and the disruption is chosen; this machine is its dual —
+    the disruption already happened (a wedged TPU node: NotReady kubelet,
+    crash-looping libtpu pod, stuck-Terminating workload, device-plugin
+    health condition) and the operator must claw the node back. Stored
+    under a *separate* node label (:class:`RemediationKeys`), so the two
+    machines coexist on one node and each reconcile stays stateless and
+    idempotent the same way the upgrade labels do
+    (upgrade_state.go:68-72).
+    """
+
+    # Node healthy (or not yet examined). Absence of the label / empty.
+    HEALTHY = ""
+    # A wedge signal persisted past its grace window; waiting for a
+    # remediation slot (concurrency + availability budget).
+    WEDGED = "wedged"
+    # Slot granted: the node must be made unschedulable before recovery
+    # actions run.
+    CORDON_REQUIRED = "cordon-required"
+    # Workload pods must be evicted so recovery actions cannot destroy
+    # in-flight work invisibly.
+    DRAIN_REQUIRED = "drain-required"
+    # Cheapest recovery rung: delete the runtime (libtpu) pod so the
+    # DaemonSet controller recreates it fresh.
+    RESTART_REQUIRED = "runtime-restart-required"
+    # Escalation rung: a host reboot has been (or must be) requested via
+    # the NodeRebooter seam.
+    REBOOT_REQUIRED = "reboot-required"
+    # Recovery action completed; the wedge signal must stay clear for the
+    # settle window and the validation gate must pass.
+    REVALIDATE_REQUIRED = "revalidate-required"
+    # Recovered; node must be made schedulable again.
+    UNCORDON_REQUIRED = "uncordon-required"
+    # Attempt budget exhausted; node stays quarantined for manual repair.
+    FAILED = "remediation-failed"
+
+    def __str__(self) -> str:  # label values are plain strings
+        return self.value
+
+
+#: Remediation states that consume a concurrency slot — every state in
+#: which the machine is actively driving the node. FAILED is excluded:
+#: a node parked for manual repair must not starve the rest of the fleet
+#: of remediation slots (it still counts as unavailable via its cordon).
+REMEDIATION_IN_PROGRESS_STATES = (
+    RemediationState.CORDON_REQUIRED,
+    RemediationState.DRAIN_REQUIRED,
+    RemediationState.RESTART_REQUIRED,
+    RemediationState.REBOOT_REQUIRED,
+    RemediationState.REVALIDATE_REQUIRED,
+    RemediationState.UNCORDON_REQUIRED,
+)
+
+#: Every remediation bucket, in apply_state processing order.
+REMEDIATION_ALL_STATES = (
+    RemediationState.HEALTHY,
+    RemediationState.WEDGED,
+    RemediationState.CORDON_REQUIRED,
+    RemediationState.DRAIN_REQUIRED,
+    RemediationState.RESTART_REQUIRED,
+    RemediationState.REBOOT_REQUIRED,
+    RemediationState.REVALIDATE_REQUIRED,
+    RemediationState.UNCORDON_REQUIRED,
+    RemediationState.FAILED,
+)
+
+#: Legal transitions of the remediation machine — single source of truth
+#: for the graph, exactly like :data:`STATE_EDGES` for upgrades: the e2e
+#: suite asserts observed transitions against it and
+#: docs/remediation-state-diagram.{dot,svg} are generated from it with a
+#: drift-check test (tools/state_diagram.py).
+REMEDIATION_EDGES: tuple[
+        tuple[RemediationState, RemediationState, str], ...] = (
+    (RemediationState.HEALTHY, RemediationState.WEDGED,
+     "wedge signal persisted past its grace window"),
+    (RemediationState.WEDGED, RemediationState.HEALTHY,
+     "signal cleared before any recovery action ran"),
+    (RemediationState.WEDGED, RemediationState.CORDON_REQUIRED,
+     "slot available (concurrency + availability budget)"),
+    (RemediationState.WEDGED, RemediationState.FAILED,
+     "attempt budget exhausted"),
+    (RemediationState.CORDON_REQUIRED, RemediationState.DRAIN_REQUIRED,
+     "cordoned (upgrade flow parked via skip label)"),
+    (RemediationState.DRAIN_REQUIRED, RemediationState.RESTART_REQUIRED,
+     "workloads evicted; attempt within restart rungs"),
+    (RemediationState.DRAIN_REQUIRED, RemediationState.REBOOT_REQUIRED,
+     "workloads evicted; restart rungs exhausted, rebooter available"),
+    (RemediationState.DRAIN_REQUIRED, RemediationState.FAILED,
+     "no recovery action applicable (no pod, no rebooter)"),
+    (RemediationState.RESTART_REQUIRED,
+     RemediationState.REVALIDATE_REQUIRED,
+     "runtime pod deleted and recreated Ready"),
+    (RemediationState.RESTART_REQUIRED, RemediationState.WEDGED,
+     "restart timeout (attempt consumed)"),
+    (RemediationState.REBOOT_REQUIRED,
+     RemediationState.REVALIDATE_REQUIRED,
+     "reboot completed; node Ready again"),
+    (RemediationState.REBOOT_REQUIRED, RemediationState.WEDGED,
+     "reboot timeout (attempt consumed)"),
+    (RemediationState.REVALIDATE_REQUIRED,
+     RemediationState.UNCORDON_REQUIRED,
+     "signal clear for settle window + validator passed "
+     "(was schedulable)"),
+    (RemediationState.REVALIDATE_REQUIRED, RemediationState.HEALTHY,
+     "signal clear for settle window + validator passed "
+     "(was cordoned before remediation)"),
+    (RemediationState.REVALIDATE_REQUIRED, RemediationState.WEDGED,
+     "wedge signal returned | revalidation timeout"),
+    (RemediationState.UNCORDON_REQUIRED, RemediationState.HEALTHY,
+     "uncordoned; bookkeeping cleared"),
+    (RemediationState.FAILED, RemediationState.REVALIDATE_REQUIRED,
+     "signal cleared out-of-band | manual re-arm annotation"),
+)
+
+#: Adjacency view of REMEDIATION_EDGES, keyed by label value
+#: ("" = healthy).
+REMEDIATION_LEGAL_EDGES: dict[str, frozenset[str]] = {
+    src: frozenset(d.value for s, d, _ in REMEDIATION_EDGES
+                   if s.value == src)
+    for src in {s.value for s, _, _ in REMEDIATION_EDGES}
+}
+
 #: Label key whose presence identifies a TPU node on GKE.
 TPU_RESOURCE_NAME = "google.com/tpu"
 
@@ -241,6 +366,93 @@ class UpgradeKeys:
     def event_reason(self) -> str:
         """Reason string attached to Kubernetes events (util.go:136-139)."""
         return f"{self.driver.upper()}RuntimeUpgrade"
+
+
+@dataclass(frozen=True)
+class RemediationKeys:
+    """Instance-scoped builder for the remediation label/annotation keys.
+
+    Parallel to :class:`UpgradeKeys` but under a distinct label family so
+    the planned-upgrade and unplanned-fault machines never collide on a
+    node. Exposes the same ``state_label`` / ``event_reason`` attribute
+    shape, so :class:`~tpu_operator_libs.upgrade.state_provider.
+    NodeUpgradeStateProvider` serves as the durable-commit writer for
+    both machines unchanged.
+    """
+
+    driver: str = "libtpu"
+    domain: str = "google.com"
+
+    @property
+    def state_label(self) -> str:
+        """Node label carrying the remediation state (the durable store
+        of the unplanned-fault machine)."""
+        return f"{self.domain}/{self.driver}-remediation-state"
+
+    @property
+    def skip_label(self) -> str:
+        """Node label opting a node out of auto-remediation."""
+        return f"{self.domain}/{self.driver}-remediation.skip"
+
+    @property
+    def wedge_since_annotation(self) -> str:
+        """Epoch-seconds stamp of when the current wedge signal was first
+        observed — the grace window and MTTR both derive from it."""
+        return f"{self.domain}/{self.driver}-remediation.wedge-first-seen"
+
+    @property
+    def wedge_reason_annotation(self) -> str:
+        """Machine-readable reason slug of the confirmed wedge."""
+        return f"{self.domain}/{self.driver}-remediation.wedge-reason"
+
+    @property
+    def attempt_annotation(self) -> str:
+        """Count of recovery attempts dispatched for the current wedge
+        (the escalation ladder's durable rung pointer)."""
+        return f"{self.domain}/{self.driver}-remediation.attempt"
+
+    @property
+    def action_start_annotation(self) -> str:
+        """Epoch-seconds stamp of when the in-flight recovery action
+        (restart/reboot) was dispatched; drives action timeouts."""
+        return f"{self.domain}/{self.driver}-remediation.action-start"
+
+    @property
+    def restart_pod_uid_annotation(self) -> str:
+        """UID of the runtime pod deleted by the restart rung, so 'the
+        pod was recreated' is detectable across operator restarts."""
+        return f"{self.domain}/{self.driver}-remediation.restart-pod-uid"
+
+    @property
+    def settle_start_annotation(self) -> str:
+        """Epoch-seconds stamp of when the wedge signal was last observed
+        clear during revalidation (the stability window)."""
+        return f"{self.domain}/{self.driver}-remediation.settle-start"
+
+    @property
+    def reboot_requested_annotation(self) -> str:
+        """Epoch-seconds stamp written when a reboot was requested — the
+        handshake contract a privileged host agent acts on."""
+        return f"{self.domain}/{self.driver}-remediation.reboot-requested-at"
+
+    @property
+    def initial_state_annotation(self) -> str:
+        """Annotation remembering the node was already unschedulable when
+        remediation began, so it is not uncordoned at the end (same
+        semantics as the upgrade machine's, consts.go:28-30)."""
+        return (f"{self.domain}/{self.driver}"
+                f"-remediation.node-initial-state.unschedulable")
+
+    @property
+    def rearm_annotation(self) -> str:
+        """Annotation an operator sets to re-arm a remediation-failed
+        node after manual repair."""
+        return f"{self.domain}/{self.driver}-remediation-requested"
+
+    @property
+    def event_reason(self) -> str:
+        """Reason string attached to Kubernetes events."""
+        return f"{self.driver.upper()}NodeRemediation"
 
 
 #: Field selector template filtering pods by the node they run on

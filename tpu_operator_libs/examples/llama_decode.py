@@ -217,8 +217,14 @@ def forward_with_cache(params, tokens, cache, start_pos, config,
         # group-times-repeated copy of the cache, which would dominate
         # the step's HBM traffic at long context
         q_g = q.reshape(batch, t_new, nkv, group, hd)
+        # int8 codes must be widened to the compute dtype before the
+        # einsum (the dequant path); a float cache is left as-is — when
+        # it is wider than the activations (float32 cache, bf16 params)
+        # casting would narrow it, and the mixed-dtype einsum already
+        # promotes correctly.
+        k_op = k_cache.astype(h.dtype) if quant_kv else k_cache
         scores = jnp.einsum("bqkgd,bskd->bkgqs", q_g,
-                            k_cache.astype(h.dtype)) * (hd ** -0.5)
+                            k_op) * (hd ** -0.5)
         scores = scores.astype(jnp.float32)
         if quant_kv:
             # K dequant: the per-(s, k) scale factors straight out of
@@ -236,8 +242,8 @@ def forward_with_cache(params, tokens, cache, start_pos, config,
             attn = attn \
                 * vs_cache.transpose(0, 2, 1)[:, :, None, None, :]
         attn = attn.astype(h.dtype)
-        ctx = jnp.einsum("bkgqs,bskd->bqkgd", attn,
-                         v_cache.astype(h.dtype))
+        v_op = v_cache.astype(h.dtype) if quant_kv else v_cache
+        ctx = jnp.einsum("bkgqs,bskd->bqkgd", attn, v_op)
         h = h + _mm(ctx.reshape(batch, t_new, nh * hd), layer["wo"])
         h = constrain(h, P("dp", None, None))
 
@@ -348,7 +354,8 @@ def _check_prefill_chunk(prefill_chunk):
     and crash range() on the other)."""
     if prefill_chunk is None:
         return
-    if not isinstance(prefill_chunk, int) or prefill_chunk < 1:
+    if (not isinstance(prefill_chunk, int)
+            or isinstance(prefill_chunk, bool) or prefill_chunk < 1):
         raise ValueError(
             f"prefill_chunk must be an int >= 1, got {prefill_chunk!r}")
 
@@ -422,6 +429,16 @@ def generate(params, prompt, config, mesh, max_new_tokens: int,
         tokens.append(last)
         lps.append(lp)
         if i + 1 == max_new_tokens:
+            break
+        if eos_id is not None and bool(done.all()):
+            # Every row has emitted eos: the remaining positions are pure
+            # padding, so unlike the device scan the host loop can stop
+            # dispatching forward steps and fill them locally.
+            pad = max_new_tokens - (i + 1)
+            tokens.append(jnp.full((batch, pad), eos_id, last.dtype))
+            if return_logprobs:
+                lps.extend(jnp.zeros((batch,), jnp.float32)
+                           for _ in range(pad))
             break
         logits, cache = step(params, last, cache, prompt_len + i)
         last, lp = _pick_next(logits[:, -1, :], temperature, top_k,

@@ -525,6 +525,24 @@ class FakeCluster(K8sClient):
             self._notify(MODIFIED, KIND_NODE, node)
             return node.clone()
 
+    def set_node_condition(self, name: str, condition_type: str,
+                           status: str) -> Node:
+        """Test helper: set an arbitrary node condition (the
+        node-problem-detector seam the remediation wedge detectors
+        watch, e.g. ``TpuHealthy=False``)."""
+        with self._lock:
+            node = self._mutate_node(name)
+            for cond in node.status.conditions:
+                if cond.type == condition_type:
+                    cond.status = status
+                    break
+            else:
+                from tpu_operator_libs.k8s.objects import NodeCondition
+                node.status.conditions.append(
+                    NodeCondition(condition_type, status))
+            self._notify(MODIFIED, KIND_NODE, node)
+            return node.clone()
+
     # ------------------------------------------------------------------
     # K8sClient: pods
     # ------------------------------------------------------------------

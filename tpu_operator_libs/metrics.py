@@ -18,9 +18,13 @@ import threading
 from dataclasses import dataclass, field
 from typing import TYPE_CHECKING, Iterable, Optional
 
-from tpu_operator_libs.consts import ALL_STATES
+from tpu_operator_libs.consts import ALL_STATES, REMEDIATION_ALL_STATES
 
 if TYPE_CHECKING:  # pragma: no cover - types only (import cycle guard)
+    from tpu_operator_libs.remediation.state_machine import (
+        NodeRemediationManager,
+        RemediationSnapshot,
+    )
     from tpu_operator_libs.upgrade.state_manager import (
         ClusterUpgradeState,
         ClusterUpgradeStateManager,
@@ -241,6 +245,64 @@ def observe_cluster_state(registry: MetricsRegistry,
         "exhausted", labels)
     registry.inc_counter("reconciles_total",
                          "apply_state passes executed", labels)
+
+
+#: Buckets for wedge→recovered durations: remediation rides restart /
+#: reboot / revalidation-settle timescales (minutes to hours), not the
+#: reconcile-latency scale DEFAULT_BUCKETS covers.
+RECOVERY_SECONDS_BUCKETS = (30.0, 60.0, 120.0, 300.0, 600.0, 1200.0,
+                            1800.0, 3600.0, 7200.0, 14400.0)
+
+
+def observe_remediation(registry: MetricsRegistry,
+                        manager: "NodeRemediationManager",
+                        snapshot: "RemediationSnapshot",
+                        driver: str = "libtpu") -> None:
+    """Record the auto-remediation gauges for one reconcile pass.
+
+    Rides the same scrape as the upgrade fleet gauges: the per-state
+    node census, the in-progress/wedged/failed counts, the lifetime
+    action counters, and the wedge→recovered duration histogram (the
+    fleet's measured MTTR).
+    """
+    labels = {"driver": driver}
+    registry.set_gauge("remediation_nodes_total", snapshot.total_nodes(),
+                       "Nodes managed for auto-remediation", labels)
+    registry.set_gauge("remediation_in_progress", snapshot.in_progress(),
+                       "Nodes currently being remediated", labels)
+    registry.set_gauge("remediation_unavailable_nodes",
+                       snapshot.unavailable_nodes(),
+                       "Cordoned or not-ready managed nodes", labels)
+    for s in REMEDIATION_ALL_STATES:
+        registry.set_gauge(
+            "remediation_nodes_in_state", len(snapshot.bucket(s)),
+            "Node count per remediation state",
+            {**labels, "state": str(s) or "healthy"})
+    registry.set_counter_total(
+        "remediation_wedged_detected_total",
+        manager.wedged_detected_total,
+        "Wedge signals confirmed past their grace window", labels)
+    registry.set_counter_total(
+        "remediation_recovered_total",
+        manager.remediations_succeeded_total,
+        "Nodes recovered and returned to service", labels)
+    registry.set_counter_total(
+        "remediation_failed_total",
+        manager.remediations_failed_total,
+        "Nodes parked in remediation-failed for manual repair", labels)
+    registry.set_counter_total(
+        "remediation_runtime_restarts_total",
+        manager.runtime_restarts_total,
+        "Runtime pods deleted by the restart rung", labels)
+    registry.set_counter_total(
+        "remediation_reboots_requested_total",
+        manager.reboots_requested_total,
+        "Host reboots requested by the escalation rung", labels)
+    for seconds in manager.drain_recovery_durations():
+        registry.observe_histogram(
+            "remediation_recovery_seconds", seconds,
+            "Wedge-first-seen to returned-to-service (MTTR)", labels,
+            buckets=RECOVERY_SECONDS_BUCKETS)
 
 
 def observe_client_health(registry: MetricsRegistry,

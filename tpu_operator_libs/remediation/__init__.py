@@ -1,0 +1,29 @@
+"""Auto-remediation: detect and recover wedged TPU nodes.
+
+The planned-upgrade machine (:mod:`tpu_operator_libs.upgrade`) chooses
+its disruptions; this package handles the ones the hardware chooses —
+NotReady kubelets, crash-looping libtpu pods, stuck-Terminating
+workloads, device-plugin health conditions. Detection
+(:mod:`.detectors`) turns those signals into durable wedge facts on the
+node; the unplanned-fault state machine (:mod:`.state_machine`) drives
+each confirmed-wedged node through an escalation ladder — quarantine →
+drain → runtime restart → host reboot → revalidate — with every
+transition committed as a node label, so a crashed operator resumes
+mid-remediation exactly like the upgrade flow does
+(upgrade_state.go:68-72).
+"""
+
+from tpu_operator_libs.remediation.detectors import (  # noqa: F401
+    NodeConditionDetector,
+    NodeNotReadyDetector,
+    RuntimePodCrashLoopDetector,
+    StuckTerminatingDetector,
+    WedgeDetectorChain,
+    WedgeSignal,
+    default_detector_chain,
+)
+from tpu_operator_libs.remediation.state_machine import (  # noqa: F401
+    AnnotationRebooter,
+    NodeRemediationManager,
+    RemediationSnapshot,
+)

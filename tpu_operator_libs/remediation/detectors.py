@@ -1,0 +1,187 @@
+"""Wedge detectors: the sensory layer of the auto-remediation machine.
+
+A detector inspects one node (plus its runtime pod, when present) and
+answers "does this node look wedged right now, and why?". Detectors are
+deliberately *stateless and instantaneous* — debouncing lives in the
+state machine, which stamps the first-seen time durably in a node
+annotation and only confirms the wedge once the signal has persisted
+past the detector's grace window. That split keeps detectors trivially
+composable and keeps the debounce crash-safe (an operator restart does
+not reset the clock).
+
+The reference library has no counterpart: a wedged node under
+``k8s-operator-libs`` just stalls the rollout until a human notices.
+"""
+
+from __future__ import annotations
+
+import logging
+from dataclasses import dataclass
+from typing import TYPE_CHECKING, Callable, Iterable, Optional, Sequence
+
+from tpu_operator_libs.k8s.objects import Node, Pod, PodPhase
+
+if TYPE_CHECKING:  # pragma: no cover - types only
+    from tpu_operator_libs.api.remediation_policy import WedgeDetectionSpec
+
+logger = logging.getLogger(__name__)
+
+
+@dataclass(frozen=True)
+class WedgeSignal:
+    """One detector's verdict that a node is wedged.
+
+    ``reason`` is a stable machine-readable slug (it lands in the node's
+    wedge-reason annotation, events, and metrics labels); ``detail`` is
+    the human-facing elaboration; ``grace_seconds`` is how long the
+    signal must persist before the state machine confirms the wedge.
+    """
+
+    reason: str
+    detail: str = ""
+    grace_seconds: float = 0.0
+
+
+#: A wedge detector: ``(node, runtime_pod, now) -> Optional[WedgeSignal]``.
+#: ``runtime_pod`` is None when the node has no runtime pod in the
+#: snapshot (possible for a node so wedged its pods were GC'd).
+WedgeDetector = Callable[[Node, Optional[Pod], float],
+                         Optional[WedgeSignal]]
+
+
+class NodeNotReadyDetector:
+    """Node Ready condition not "True" — the kubelet-level wedge.
+
+    The grace window absorbs kubelet restarts and transient network
+    partitions; a genuinely dead host stays NotReady far longer.
+    """
+
+    def __init__(self, grace_seconds: float = 300.0) -> None:
+        self._grace = grace_seconds
+
+    def __call__(self, node: Node, runtime_pod: Optional[Pod],
+                 now: float) -> Optional[WedgeSignal]:
+        if node.is_ready():
+            return None
+        return WedgeSignal(
+            reason="node-not-ready",
+            detail=f"node {node.metadata.name} reports NotReady",
+            grace_seconds=self._grace)
+
+
+class RuntimePodCrashLoopDetector:
+    """Runtime (libtpu) pod crash-looping or unreachable.
+
+    Two arms: a not-ready container past the restart threshold (the same
+    failure the upgrade machine recognizes mid-rollout,
+    upgrade_state.go:966-978 — this detector covers it *outside* a
+    rollout), and phase Unknown (kubelet stopped reporting, the phase
+    the apiserver shows exactly when a TPU host wedges hard).
+    """
+
+    def __init__(self, restart_threshold: int = 10) -> None:
+        self._threshold = restart_threshold
+
+    def __call__(self, node: Node, runtime_pod: Optional[Pod],
+                 now: float) -> Optional[WedgeSignal]:
+        if runtime_pod is None:
+            return None
+        if runtime_pod.status.phase == PodPhase.UNKNOWN:
+            return WedgeSignal(
+                reason="runtime-pod-unknown",
+                detail=f"runtime pod {runtime_pod.name} phase Unknown "
+                       "(kubelet unreachable)")
+        if runtime_pod.is_failing(self._threshold):
+            return WedgeSignal(
+                reason="runtime-crashloop",
+                detail=f"runtime pod {runtime_pod.name} crash-looping "
+                       f"(>{self._threshold} restarts while not ready)")
+        return None
+
+
+class StuckTerminatingDetector:
+    """Runtime pod stuck Terminating — a wedged TPU driver commonly
+    blocks container teardown, which then blocks the DaemonSet from ever
+    recreating the pod."""
+
+    def __init__(self, stuck_seconds: float = 600.0) -> None:
+        self._stuck = stuck_seconds
+
+    def __call__(self, node: Node, runtime_pod: Optional[Pod],
+                 now: float) -> Optional[WedgeSignal]:
+        if runtime_pod is None:
+            return None
+        deleted_at = runtime_pod.metadata.deletion_timestamp
+        if deleted_at is None or now - deleted_at < self._stuck:
+            return None
+        return WedgeSignal(
+            reason="runtime-pod-stuck-terminating",
+            detail=f"runtime pod {runtime_pod.name} Terminating for "
+                   f"{now - deleted_at:.0f}s")
+
+
+class NodeConditionDetector:
+    """Node-problem-detector-style conditions (e.g. a TPU health agent
+    publishing ``TpuHealthy=False``). Any listed condition type present
+    with status != "True" wedges the node immediately (the agent already
+    debounced)."""
+
+    def __init__(self,
+                 condition_types: Sequence[str] = ("TpuHealthy",)) -> None:
+        self._types = tuple(condition_types)
+
+    def __call__(self, node: Node, runtime_pod: Optional[Pod],
+                 now: float) -> Optional[WedgeSignal]:
+        for cond in node.status.conditions:
+            if cond.type in self._types and cond.status != "True":
+                return WedgeSignal(
+                    reason=f"condition-{cond.type}",
+                    detail=f"node condition {cond.type}={cond.status}")
+        return None
+
+
+class WedgeDetectorChain:
+    """First-signal-wins composition of detectors.
+
+    Order matters for *reason attribution* only (any firing detector
+    wedges the node): put the most specific detectors first so the
+    recorded reason names the root cause, not a symptom. A detector
+    that raises is logged and skipped — one broken probe must not blind
+    the whole chain (same boundary rule as ValidationManager's
+    extra_validator seam).
+    """
+
+    def __init__(self, detectors: Iterable[WedgeDetector]) -> None:
+        self._detectors = tuple(detectors)
+
+    def __call__(self, node: Node, runtime_pod: Optional[Pod],
+                 now: float) -> Optional[WedgeSignal]:
+        for detector in self._detectors:
+            try:
+                signal = detector(node, runtime_pod, now)
+            except Exception:  # noqa: BLE001 — detector boundary
+                logger.exception(
+                    "wedge detector %r failed on node %s; skipping",
+                    detector, node.metadata.name)
+                continue
+            if signal is not None:
+                return signal
+        return None
+
+
+def default_detector_chain(
+        detection: Optional["WedgeDetectionSpec"] = None,
+) -> WedgeDetectorChain:
+    """The built-in chain, thresholds taken from the policy's detection
+    spec (defaults when None). Condition and crash-loop detectors come
+    first: they name root causes, while NotReady is the symptom every
+    hard wedge eventually shows."""
+    from tpu_operator_libs.api.remediation_policy import WedgeDetectionSpec
+
+    spec = detection or WedgeDetectionSpec()
+    return WedgeDetectorChain((
+        NodeConditionDetector(spec.unhealthy_condition_types),
+        RuntimePodCrashLoopDetector(spec.pod_restart_threshold),
+        StuckTerminatingDetector(spec.terminating_stuck_seconds),
+        NodeNotReadyDetector(spec.not_ready_grace_seconds),
+    ))

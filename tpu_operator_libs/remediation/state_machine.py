@@ -1,0 +1,841 @@
+"""NodeRemediationManager — the unplanned-fault state machine.
+
+The dual of :class:`~tpu_operator_libs.upgrade.state_manager.
+ClusterUpgradeStateManager`: that machine schedules disruptions on
+healthy nodes; this one recovers nodes the hardware already disrupted.
+One reconcile is:
+
+1. ``build_state``: snapshot every managed node + its runtime pod,
+   bucketed by the node's remediation-state label.
+2. ``apply_state``: one pass over the buckets in fixed order, moving
+   each node at most one transition along the graph
+   (consts.REMEDIATION_EDGES):
+
+   healthy ──(signal persisted past grace)──────────→ wedged
+   wedged ─┬─(signal cleared, nothing dispatched)──→ healthy
+           ├─(attempt budget exhausted)────────────→ remediation-failed
+           └─(slot available)──────────────────────→ cordon-required
+   cordon-required ─(cordoned, upgrade flow parked)→ drain-required
+   drain-required ─┬─(attempt ≤ restart rungs)─────→ runtime-restart
+                   ├─(rungs exhausted, rebooter)───→ reboot-required
+                   └─(no action applicable)────────→ remediation-failed
+   runtime-restart ─(pod recreated & ready)────────→ revalidate
+                    (timeout → wedged, attempt consumed)
+   reboot-required ─(node Ready again)─────────────→ revalidate
+                    (timeout → wedged, attempt consumed)
+   revalidate ─┬─(clear for settle window + gate)──→ uncordon | healthy
+               └─(signal returned past timeout)────→ wedged
+   uncordon-required ─(uncordoned)─────────────────→ healthy
+   remediation-failed ─(out-of-band fix | re-arm)──→ revalidate
+
+Durability model is identical to the upgrade machine: the node label is
+the commit point, every decision re-derives from the snapshot, and the
+escalation ladder's rung pointer (the attempt annotation), debounce
+stamps, and action handshakes are all node annotations — a crashed
+operator resumes mid-remediation for free (upgrade_state.go:68-72).
+
+Coordination with the planned-upgrade machine is explicit and two-way:
+detection never confirms a wedge on a node the upgrade machine is
+actively moving (its failure handling owns mid-rollout breakage), and a
+node under remediation carries the upgrade ``skip`` label from cordon
+until recovery, so a rollout starting mid-remediation routes around it.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import logging
+from dataclasses import dataclass, field
+from typing import Iterator, Optional, Protocol
+
+from tpu_operator_libs.api.remediation_policy import RemediationPolicySpec
+from tpu_operator_libs.api.upgrade_policy import (
+    scaled_value_from_int_or_percent,
+)
+from tpu_operator_libs.consts import (
+    IN_PROGRESS_STATES,
+    REMEDIATION_ALL_STATES,
+    REMEDIATION_IN_PROGRESS_STATES,
+    TPU_RESOURCE_NAME,
+    TRUE_STRING,
+    RemediationKeys,
+    RemediationState,
+    UpgradeKeys,
+)
+from tpu_operator_libs.k8s.client import (
+    ApiServerError,
+    ConflictError,
+    K8sClient,
+    NotFoundError,
+)
+from tpu_operator_libs.k8s.drain import DrainError, DrainHelper
+from tpu_operator_libs.k8s.objects import Node, Pod
+from tpu_operator_libs.k8s.selectors import selector_from_labels
+from tpu_operator_libs.remediation.detectors import (
+    WedgeDetector,
+    default_detector_chain,
+)
+from tpu_operator_libs.upgrade.cordon_manager import CordonManager
+from tpu_operator_libs.upgrade.state_provider import (
+    NodeUpgradeStateProvider,
+)
+from tpu_operator_libs.upgrade.validation_manager import NodeValidator
+from tpu_operator_libs.util import Clock, Event, EventRecorder, log_event
+
+logger = logging.getLogger(__name__)
+
+
+class NodeRebooter(Protocol):
+    """Escalation seam: ask the infrastructure to power-cycle a node.
+
+    Implementations range from stamping an annotation a privileged host
+    agent watches (:class:`AnnotationRebooter`, the default contract) to
+    calling a cloud instance API. ``request_reboot`` must be idempotent
+    per node — the machine guards re-requests with a handshake
+    annotation, but a crashed pass may replay one request.
+    """
+
+    def request_reboot(self, node: Node) -> None:
+        """Initiate a reboot of ``node``; returns immediately."""
+        ...
+
+
+class AnnotationRebooter:
+    """Default rebooter: records the request as a node annotation.
+
+    The deployment contract: a privileged DaemonSet agent on each host
+    watches its own node for ``keys.reboot_requested_annotation`` and
+    executes the reboot out-of-band. The machine detects completion by
+    the node turning Ready again, not by anything the agent writes, so
+    the agent side stays trivial.
+    """
+
+    def __init__(self, provider: NodeUpgradeStateProvider,
+                 keys: RemediationKeys, clock: Optional[Clock] = None,
+                 ) -> None:
+        self._provider = provider
+        self._keys = keys
+        self._clock = clock or Clock()
+
+    def request_reboot(self, node: Node) -> None:
+        self._provider.change_node_upgrade_annotation(
+            node, self._keys.reboot_requested_annotation,
+            str(int(self._clock.now())))
+
+
+@dataclass
+class NodeRemediationState:
+    """A managed node and the runtime pod on it (None when the pod is
+    gone — possible for a node wedged long enough for pod GC)."""
+
+    node: Node
+    runtime_pod: Optional[Pod]
+
+
+@dataclass
+class RemediationSnapshot:
+    """Snapshot of the managed fleet bucketed by remediation state."""
+
+    node_states: dict[str, list[NodeRemediationState]] = field(
+        default_factory=dict)
+
+    def bucket(self, state: RemediationState | str,
+               ) -> list[NodeRemediationState]:
+        return self.node_states.get(str(state), [])
+
+    def total_nodes(self) -> int:
+        return sum(len(v) for v in self.node_states.values())
+
+    def in_progress(self) -> int:
+        return sum(len(self.bucket(s))
+                   for s in REMEDIATION_IN_PROGRESS_STATES)
+
+    def unavailable_nodes(self) -> int:
+        """Cordoned or NotReady nodes across all buckets (same
+        definition as the upgrade machine's availability budget,
+        upgrade_state.go:192-211)."""
+        return sum(
+            1 for bucket in self.node_states.values() for ns in bucket
+            if ns.node.is_unschedulable() or not ns.node.is_ready())
+
+
+class NodeRemediationManager:
+    """The unplanned-fault state machine hub."""
+
+    def __init__(self, client: K8sClient,
+                 keys: Optional[RemediationKeys] = None,
+                 upgrade_keys: Optional[UpgradeKeys] = None,
+                 detector: Optional[WedgeDetector] = None,
+                 rebooter: Optional[NodeRebooter] = None,
+                 validator: Optional[NodeValidator] = None,
+                 recorder: Optional[EventRecorder] = None,
+                 clock: Optional[Clock] = None,
+                 provider: Optional[NodeUpgradeStateProvider] = None,
+                 sync_timeout: float = 10.0,
+                 poll_interval: float = 1.0) -> None:
+        self.keys = keys or RemediationKeys()
+        self.client = client
+        # With upgrade keys, the two machines actively coordinate:
+        # detection defers to in-progress upgrades, and remediated
+        # nodes carry the upgrade skip label until recovered.
+        self.upgrade_keys = upgrade_keys
+        self.recorder = recorder
+        self.clock = clock or Clock()
+        # The provider is the same durable-commit writer the upgrade
+        # machine uses — RemediationKeys exposes the state_label /
+        # event_reason surface it needs, so every remediation
+        # transition gets the same visibility-wait and
+        # optimistic-concurrency guarantees for free.
+        self.provider = provider or NodeUpgradeStateProvider(
+            client, self.keys,  # type: ignore[arg-type]
+            recorder, self.clock,
+            sync_timeout=sync_timeout, poll_interval=poll_interval)
+        self.cordon_manager = CordonManager(client)
+        self._explicit_detector = detector
+        self.rebooter = rebooter if rebooter is not None else \
+            AnnotationRebooter(self.provider, self.keys, self.clock)
+        self.validator = validator
+        self._poll_interval = poll_interval
+        # fleet counters (exported via metrics.observe_remediation)
+        self.wedged_detected_total = 0
+        self.remediations_succeeded_total = 0
+        self.remediations_failed_total = 0
+        self.runtime_restarts_total = 0
+        self.reboots_requested_total = 0
+        self._recovery_seconds: list[float] = []
+        self._transient_deferrals = 0
+        self.last_pass_deferrals = 0
+
+    # ------------------------------------------------------------------
+    # snapshot
+    # ------------------------------------------------------------------
+    def build_state(self, namespace: str,
+                    runtime_labels: dict[str, str]) -> RemediationSnapshot:
+        """Snapshot managed nodes + runtime pods into state buckets.
+
+        A node is managed when it runs a runtime pod, carries the TPU
+        resource label, or already has a remediation state — the last
+        arm keeps a node whose pods were GC'd mid-remediation from
+        silently leaving the machine.
+        """
+        snapshot = RemediationSnapshot()
+        selector = selector_from_labels(runtime_labels)
+        pods_by_node: dict[str, Pod] = {}
+        for pod in self.client.list_pods(namespace=namespace,
+                                         label_selector=selector):
+            if pod.spec.node_name:
+                pods_by_node.setdefault(pod.spec.node_name, pod)
+        for node in self.client.list_nodes():
+            label = node.metadata.labels.get(self.keys.state_label, "")
+            pod = pods_by_node.get(node.metadata.name)
+            if pod is None and not label \
+                    and TPU_RESOURCE_NAME not in node.metadata.labels:
+                continue
+            snapshot.node_states.setdefault(label, []).append(
+                NodeRemediationState(node=node, runtime_pod=pod))
+        return snapshot
+
+    # ------------------------------------------------------------------
+    # apply_state
+    # ------------------------------------------------------------------
+    def apply_state(self, snapshot: RemediationSnapshot,
+                    policy: Optional[RemediationPolicySpec]) -> None:
+        """One transition pass. Transient cluster errors defer only the
+        affected node (the upgrade machine's per-node isolation,
+        state_manager._defer_node_on_transient); hard errors abort the
+        pass for the caller to retry."""
+        if snapshot is None:
+            raise ValueError("snapshot should not be empty")
+        self.last_pass_deferrals = 0
+        if policy is None or not policy.enable:
+            logger.info("auto remediation is disabled, skipping")
+            return
+        logger.info("remediation states: %s", {
+            str(s) or "healthy": len(snapshot.bucket(s))
+            for s in REMEDIATION_ALL_STATES})
+        detector = self._detector_for_policy(policy)
+        self.process_healthy_nodes(snapshot, detector)
+        self.process_wedged_nodes(snapshot, policy, detector)
+        self.process_cordon_required_nodes(snapshot)
+        self.process_drain_required_nodes(snapshot, policy)
+        self.process_restart_required_nodes(snapshot, policy)
+        self.process_reboot_required_nodes(snapshot, policy)
+        self.process_revalidate_required_nodes(snapshot, policy, detector)
+        self.process_uncordon_required_nodes(snapshot)
+        self.process_failed_nodes(snapshot, detector)
+        logger.info("remediation manager finished processing")
+
+    def _detector_for_policy(self, policy: RemediationPolicySpec,
+                             ) -> WedgeDetector:
+        if self._explicit_detector is not None:
+            return self._explicit_detector
+        return default_detector_chain(policy.detection)
+
+    @contextlib.contextmanager
+    def _defer_node_on_transient(self, node: Node,
+                                 action: str) -> Iterator[None]:
+        try:
+            yield
+        except (ApiServerError, ConflictError, NotFoundError) as exc:
+            logger.warning(
+                "transient cluster error during %s for node %s; "
+                "deferring the node to the next reconcile: %s",
+                action, node.metadata.name, exc)
+            self._transient_deferrals += 1
+            self.last_pass_deferrals += 1
+
+    # ------------------------------------------------------------------
+    # per-state processors
+    # ------------------------------------------------------------------
+    def process_healthy_nodes(self, snapshot: RemediationSnapshot,
+                              detector: WedgeDetector) -> None:
+        """Detection with durable debounce: first sighting stamps the
+        wedge-first-seen annotation; the wedge is confirmed (node →
+        wedged) only once the signal has persisted past the detector's
+        grace window. A cleared signal erases the stamp."""
+        now = self.clock.now()
+        for ns in snapshot.bucket(RemediationState.HEALTHY):
+            node = ns.node
+            with self._defer_node_on_transient(node, "wedge detection"):
+                if self._skip_remediation(node):
+                    continue
+                if self._upgrade_in_progress(node):
+                    # mid-rollout breakage belongs to the upgrade
+                    # machine's own failure handling
+                    continue
+                signal = detector(node, ns.runtime_pod, now)
+                since_raw = node.metadata.annotations.get(
+                    self.keys.wedge_since_annotation)
+                if signal is None:
+                    if since_raw is not None:
+                        self.provider.change_node_upgrade_annotation(
+                            node, self.keys.wedge_since_annotation, None)
+                    continue
+                if since_raw is None:
+                    self.provider.change_node_upgrade_annotation(
+                        node, self.keys.wedge_since_annotation,
+                        str(int(now)))
+                    since = now
+                else:
+                    since = float(since_raw)
+                if now - since < signal.grace_seconds:
+                    continue
+                self.provider.change_node_upgrade_annotation(
+                    node, self.keys.wedge_reason_annotation, signal.reason)
+                if self.provider.change_node_upgrade_state(
+                        node, RemediationState.WEDGED):
+                    self.wedged_detected_total += 1
+                    logger.warning("node %s confirmed wedged: %s",
+                                   node.metadata.name, signal.detail)
+                    log_event(self.recorder, node, Event.WARNING,
+                              self.keys.event_reason,
+                              f"Node wedged ({signal.reason}): "
+                              f"{signal.detail}")
+
+    def process_wedged_nodes(self, snapshot: RemediationSnapshot,
+                             policy: RemediationPolicySpec,
+                             detector: WedgeDetector) -> None:
+        """Triage the quarantine queue: self-healed nodes go back to
+        healthy, exhausted nodes park as failed, and the rest are
+        admitted under the concurrency + availability budgets."""
+        now = self.clock.now()
+        total = snapshot.total_nodes()
+        in_progress = snapshot.in_progress()
+        slots = (len(snapshot.bucket(RemediationState.WEDGED))
+                 if policy.max_concurrent == 0
+                 else max(0, policy.max_concurrent - in_progress))
+        max_unavailable = total
+        if policy.max_unavailable is not None:
+            max_unavailable = scaled_value_from_int_or_percent(
+                policy.max_unavailable, total, round_up=True)
+        unavailable = snapshot.unavailable_nodes()
+        for ns in snapshot.bucket(RemediationState.WEDGED):
+            node = ns.node
+            with self._defer_node_on_transient(node, "wedge triage"):
+                attempts = self._attempts_used(node)
+                if attempts == 0 \
+                        and detector(node, ns.runtime_pod, now) is None:
+                    # self-healed before any recovery action ran
+                    self._clear_bookkeeping(node)
+                    self.provider.change_node_upgrade_state(
+                        node, RemediationState.HEALTHY)
+                    logger.info("node %s wedge cleared on its own",
+                                node.metadata.name)
+                    continue
+                if attempts >= policy.max_attempts:
+                    self._mark_failed(
+                        node, f"attempt budget exhausted "
+                              f"({attempts}/{policy.max_attempts})")
+                    continue
+                if self._skip_remediation(node):
+                    continue
+                if slots <= 0:
+                    continue
+                live = node.is_ready() and not node.is_unschedulable()
+                if live and unavailable >= max_unavailable:
+                    # quarantining a still-serving node would breach the
+                    # availability budget; dead nodes are exempt (they
+                    # already count as unavailable)
+                    logger.info(
+                        "deferring remediation of live node %s: "
+                        "%d/%d nodes already unavailable",
+                        node.metadata.name, unavailable, max_unavailable)
+                    continue
+                if attempts == 0 and node.is_unschedulable():
+                    # remember the pre-remediation cordon so the node is
+                    # not uncordoned at the end; only on FIRST admission
+                    # — a re-admission after a failed attempt sees the
+                    # cordon this machine itself applied
+                    self.provider.change_node_upgrade_annotation(
+                        node, self.keys.initial_state_annotation,
+                        TRUE_STRING)
+                if self.provider.change_node_upgrade_state(
+                        node, RemediationState.CORDON_REQUIRED):
+                    slots -= 1
+                    if live:
+                        unavailable += 1
+                    logger.info("node %s admitted for remediation",
+                                node.metadata.name)
+                    log_event(self.recorder, node, Event.NORMAL,
+                              self.keys.event_reason,
+                              "Remediation started (attempt "
+                              f"{attempts + 1}/{policy.max_attempts})")
+
+    def process_cordon_required_nodes(
+            self, snapshot: RemediationSnapshot) -> None:
+        for ns in snapshot.bucket(RemediationState.CORDON_REQUIRED):
+            node = ns.node
+            with self._defer_node_on_transient(node, "quarantine cordon"):
+                self.cordon_manager.cordon(node)
+                self._park_upgrade_flow(node, parked=True)
+                self.provider.change_node_upgrade_state(
+                    node, RemediationState.DRAIN_REQUIRED)
+
+    def process_drain_required_nodes(self, snapshot: RemediationSnapshot,
+                                     policy: RemediationPolicySpec) -> None:
+        """Evict workloads (when configured), then dispatch the next
+        recovery rung. The drain runs inline — remediation throughput is
+        bounded by the concurrency budget, not by drain parallelism, and
+        an inline drain keeps the pass deterministic."""
+        for ns in snapshot.bucket(RemediationState.DRAIN_REQUIRED):
+            node = ns.node
+            with self._defer_node_on_transient(node, "quarantine drain"):
+                spec = policy.drain
+                if spec is not None and spec.enable:
+                    helper = DrainHelper(
+                        client=self.client, force=spec.force,
+                        delete_empty_dir_data=spec.delete_empty_dir,
+                        timeout_seconds=spec.timeout_seconds,
+                        pod_selector=spec.pod_selector,
+                        clock=self.clock,
+                        poll_interval=self._poll_interval)
+                    try:
+                        helper.run_node_drain(node.metadata.name)
+                    except DrainError as exc:
+                        # stay in drain-required; retried next pass
+                        logger.warning("drain of node %s failed: %s",
+                                       node.metadata.name, exc)
+                        continue
+                self._dispatch_recovery_action(ns, policy)
+
+    def _dispatch_recovery_action(self, ns: NodeRemediationState,
+                                  policy: RemediationPolicySpec) -> None:
+        """Stamp the next attempt and route to its rung. Idempotent
+        across crashes: a pass that stamped the attempt but died before
+        the state transition re-enters here and reuses the stamp (the
+        action-start annotation is the marker)."""
+        node = ns.node
+        started = node.metadata.annotations.get(
+            self.keys.action_start_annotation)
+        if started is None:
+            attempt = self._attempts_used(node) + 1
+            self.provider.change_node_upgrade_annotation(
+                node, self.keys.attempt_annotation, str(attempt))
+            self.provider.change_node_upgrade_annotation(
+                node, self.keys.action_start_annotation,
+                str(int(self.clock.now())))
+        else:
+            attempt = self._attempts_used(node)
+        use_restart = (attempt <= policy.restart_attempts
+                       or self.rebooter is None)
+        if use_restart and ns.runtime_pod is not None:
+            self.provider.change_node_upgrade_state(
+                node, RemediationState.RESTART_REQUIRED)
+        elif self.rebooter is not None:
+            self.provider.change_node_upgrade_state(
+                node, RemediationState.REBOOT_REQUIRED)
+        else:
+            self._mark_failed(
+                node, "no recovery action applicable "
+                      "(no runtime pod to restart, no rebooter)")
+
+    def process_restart_required_nodes(
+            self, snapshot: RemediationSnapshot,
+            policy: RemediationPolicySpec) -> None:
+        """The cheap rung: delete the runtime pod so the DaemonSet
+        controller recreates it. 'Recreated' is detected by UID change
+        (recorded durably), so the check survives operator restarts."""
+        now = self.clock.now()
+        for ns in snapshot.bucket(RemediationState.RESTART_REQUIRED):
+            node = ns.node
+            with self._defer_node_on_transient(node, "runtime restart"):
+                recorded = node.metadata.annotations.get(
+                    self.keys.restart_pod_uid_annotation)
+                if recorded is None:
+                    old_uid = "gone"
+                    if ns.runtime_pod is not None:
+                        old_uid = ns.runtime_pod.metadata.uid
+                        try:
+                            self.client.delete_pod(
+                                ns.runtime_pod.namespace,
+                                ns.runtime_pod.name)
+                        except NotFoundError:
+                            pass  # already gone — that is the goal
+                    self.provider.change_node_upgrade_annotation(
+                        node, self.keys.restart_pod_uid_annotation,
+                        old_uid)
+                    self.runtime_restarts_total += 1
+                    log_event(self.recorder, node, Event.NORMAL,
+                              self.keys.event_reason,
+                              "Runtime pod deleted for restart")
+                    continue
+                pod = ns.runtime_pod
+                if pod is not None and pod.metadata.uid != recorded \
+                        and pod.metadata.deletion_timestamp is None \
+                        and pod.is_ready():
+                    self.provider.change_node_upgrade_annotation(
+                        node, self.keys.restart_pod_uid_annotation, None)
+                    self.provider.change_node_upgrade_state(
+                        node, RemediationState.REVALIDATE_REQUIRED)
+                    continue
+                self._maybe_action_timeout(
+                    node, policy, now, "runtime restart",
+                    extra_annotations=(
+                        self.keys.restart_pod_uid_annotation,))
+
+    def process_reboot_required_nodes(
+            self, snapshot: RemediationSnapshot,
+            policy: RemediationPolicySpec) -> None:
+        """The escalation rung: one reboot request per attempt (guarded
+        by the handshake annotation); completion is the node reporting
+        Ready again."""
+        now = self.clock.now()
+        for ns in snapshot.bucket(RemediationState.REBOOT_REQUIRED):
+            node = ns.node
+            with self._defer_node_on_transient(node, "node reboot"):
+                if self.rebooter is None:
+                    # configuration changed mid-flight: write the
+                    # attempt off rather than wait out the timeout
+                    self._fail_attempt(node, "rebooter removed")
+                    continue
+                requested = node.metadata.annotations.get(
+                    self.keys.reboot_requested_annotation)
+                if requested is None:
+                    self.rebooter.request_reboot(node)
+                    if node.metadata.annotations.get(
+                            self.keys.reboot_requested_annotation) is None:
+                        # non-annotation rebooters (cloud APIs) do not
+                        # stamp the handshake themselves
+                        self.provider.change_node_upgrade_annotation(
+                            node, self.keys.reboot_requested_annotation,
+                            str(int(now)))
+                    self.reboots_requested_total += 1
+                    log_event(self.recorder, node, Event.WARNING,
+                              self.keys.event_reason,
+                              "Node reboot requested")
+                    continue
+                if node.is_ready():
+                    self.provider.change_node_upgrade_annotation(
+                        node, self.keys.reboot_requested_annotation, None)
+                    self.provider.change_node_upgrade_state(
+                        node, RemediationState.REVALIDATE_REQUIRED)
+                    continue
+                self._maybe_action_timeout(
+                    node, policy, now, "reboot",
+                    extra_annotations=(
+                        self.keys.reboot_requested_annotation,))
+
+    def process_revalidate_required_nodes(
+            self, snapshot: RemediationSnapshot,
+            policy: RemediationPolicySpec,
+            detector: WedgeDetector) -> None:
+        """The recovery gate: the wedge signal must stay clear for the
+        settle window AND the optional validator (e.g. the ICI fabric
+        probe) must pass. Signal flaps reset the window; flapping past
+        the revalidation timeout writes the attempt off."""
+        now = self.clock.now()
+        for ns in snapshot.bucket(RemediationState.REVALIDATE_REQUIRED):
+            node = ns.node
+            with self._defer_node_on_transient(node, "revalidation"):
+                signal = detector(node, ns.runtime_pod, now)
+                settle_raw = node.metadata.annotations.get(
+                    self.keys.settle_start_annotation)
+                if signal is not None:
+                    if settle_raw is not None:
+                        self.provider.change_node_upgrade_annotation(
+                            node, self.keys.settle_start_annotation, None)
+                    self._maybe_action_timeout(
+                        node, policy, now, "revalidation",
+                        timeout=(policy.action_timeout_seconds
+                                 + policy.revalidate_timeout_seconds))
+                    continue
+                if settle_raw is None:
+                    self.provider.change_node_upgrade_annotation(
+                        node, self.keys.settle_start_annotation,
+                        str(int(now)))
+                    continue
+                if now - float(settle_raw) < policy.settle_seconds:
+                    continue
+                if not self._validator_passes(node):
+                    self._maybe_action_timeout(
+                        node, policy, now, "revalidation",
+                        timeout=(policy.action_timeout_seconds
+                                 + policy.revalidate_timeout_seconds))
+                    continue
+                if self.keys.initial_state_annotation \
+                        in node.metadata.annotations:
+                    # node was cordoned before remediation began: leave
+                    # the cordon, finish directly
+                    self._finish_recovery(node)
+                else:
+                    self.provider.change_node_upgrade_state(
+                        node, RemediationState.UNCORDON_REQUIRED)
+
+    def process_uncordon_required_nodes(
+            self, snapshot: RemediationSnapshot) -> None:
+        for ns in snapshot.bucket(RemediationState.UNCORDON_REQUIRED):
+            node = ns.node
+            with self._defer_node_on_transient(node, "uncordon"):
+                # stale-snapshot guard, same as the upgrade machine's
+                # uncordon: never uncordon a node another pass moved on
+                current = self.provider.get_node(node.metadata.name) \
+                    .metadata.labels.get(self.keys.state_label, "")
+                if current != str(RemediationState.UNCORDON_REQUIRED):
+                    logger.warning(
+                        "node %s is %r, not uncordon-required: snapshot "
+                        "is stale; skipping uncordon",
+                        node.metadata.name, current or "healthy")
+                    continue
+                self.cordon_manager.uncordon(node)
+                self._finish_recovery(node)
+
+    def process_failed_nodes(self, snapshot: RemediationSnapshot,
+                             detector: WedgeDetector) -> None:
+        """Parked nodes re-enter revalidation when the wedge cleared
+        out-of-band, or when an operator re-arms them (which also resets
+        the attempt ladder)."""
+        now = self.clock.now()
+        for ns in snapshot.bucket(RemediationState.FAILED):
+            node = ns.node
+            with self._defer_node_on_transient(node, "failed-node triage"):
+                rearmed = node.metadata.annotations.get(
+                    self.keys.rearm_annotation) == TRUE_STRING
+                if rearmed:
+                    self.provider.change_node_upgrade_annotation(
+                        node, self.keys.rearm_annotation, None)
+                    self.provider.change_node_upgrade_annotation(
+                        node, self.keys.attempt_annotation, None)
+                elif detector(node, ns.runtime_pod, now) is not None:
+                    continue
+                self.provider.change_node_upgrade_annotation(
+                    node, self.keys.settle_start_annotation, None)
+                self.provider.change_node_upgrade_state(
+                    node, RemediationState.REVALIDATE_REQUIRED)
+                logger.info("failed node %s re-entering revalidation%s",
+                            node.metadata.name,
+                            " (re-armed)" if rearmed else "")
+
+    # ------------------------------------------------------------------
+    # helpers
+    # ------------------------------------------------------------------
+    def _attempts_used(self, node: Node) -> int:
+        raw = node.metadata.annotations.get(self.keys.attempt_annotation)
+        try:
+            return int(raw) if raw is not None else 0
+        except ValueError:
+            logger.warning("node %s has malformed attempt annotation %r; "
+                           "treating as 0", node.metadata.name, raw)
+            return 0
+
+    def _skip_remediation(self, node: Node) -> bool:
+        return node.metadata.labels.get(
+            self.keys.skip_label) == TRUE_STRING
+
+    def _upgrade_in_progress(self, node: Node) -> bool:
+        if self.upgrade_keys is None:
+            return False
+        state = node.metadata.labels.get(self.upgrade_keys.state_label, "")
+        return state in {str(s) for s in IN_PROGRESS_STATES}
+
+    def _park_upgrade_flow(self, node: Node, parked: bool) -> None:
+        """Set/clear the upgrade machine's skip label so a rollout
+        starting mid-remediation routes around the quarantined node."""
+        if self.upgrade_keys is None:
+            return
+        value = TRUE_STRING if parked else None
+        self.client.patch_node_labels(
+            node.metadata.name, {self.upgrade_keys.skip_label: value})
+        if parked:
+            node.metadata.labels[self.upgrade_keys.skip_label] = TRUE_STRING
+        else:
+            node.metadata.labels.pop(self.upgrade_keys.skip_label, None)
+
+    def _validator_passes(self, node: Node) -> bool:
+        if self.validator is None:
+            return True
+        try:
+            return bool(self.validator(node))
+        except Exception as exc:  # noqa: BLE001 — gate boundary
+            logger.warning("remediation validator raised on node %s: %s",
+                           node.metadata.name, exc)
+            return False
+
+    def _maybe_action_timeout(self, node: Node,
+                              policy: RemediationPolicySpec, now: float,
+                              action: str,
+                              timeout: Optional[float] = None,
+                              extra_annotations: tuple[str, ...] = (),
+                              ) -> None:
+        """Write the attempt off (node → wedged) when its action has run
+        past its budget; otherwise leave the node in place to retry."""
+        started_raw = node.metadata.annotations.get(
+            self.keys.action_start_annotation)
+        if started_raw is None:
+            # dispatch stamps this before routing here; a missing stamp
+            # means an operator with older keys — start the clock now
+            self.provider.change_node_upgrade_annotation(
+                node, self.keys.action_start_annotation, str(int(now)))
+            return
+        limit = timeout if timeout is not None \
+            else policy.action_timeout_seconds
+        if now - float(started_raw) <= limit:
+            return
+        self._fail_attempt(node, f"{action} timed out after {limit:g}s",
+                           extra_annotations=extra_annotations)
+
+    def _fail_attempt(self, node: Node, why: str,
+                      extra_annotations: tuple[str, ...] = ()) -> None:
+        """One consumed attempt: clear the action bookkeeping and send
+        the node back to the quarantine queue (which escalates or parks
+        it)."""
+        for key in (self.keys.action_start_annotation,
+                    self.keys.settle_start_annotation,
+                    *extra_annotations):
+            if key in node.metadata.annotations:
+                self.provider.change_node_upgrade_annotation(
+                    node, key, None)
+        if self.provider.change_node_upgrade_state(
+                node, RemediationState.WEDGED):
+            logger.warning("remediation attempt on node %s failed: %s",
+                           node.metadata.name, why)
+            log_event(self.recorder, node, Event.WARNING,
+                      self.keys.event_reason,
+                      f"Recovery attempt failed: {why}")
+
+    def _mark_failed(self, node: Node, why: str) -> None:
+        if self.provider.change_node_upgrade_state(
+                node, RemediationState.FAILED):
+            self.remediations_failed_total += 1
+            logger.error("node %s remediation failed: %s",
+                         node.metadata.name, why)
+            log_event(self.recorder, node, Event.WARNING,
+                      self.keys.event_reason,
+                      f"Remediation failed; node parked for manual "
+                      f"repair: {why}")
+
+    def _clear_bookkeeping(self, node: Node) -> None:
+        for key in (self.keys.wedge_since_annotation,
+                    self.keys.wedge_reason_annotation,
+                    self.keys.attempt_annotation,
+                    self.keys.action_start_annotation,
+                    self.keys.restart_pod_uid_annotation,
+                    self.keys.settle_start_annotation,
+                    self.keys.reboot_requested_annotation,
+                    self.keys.initial_state_annotation,
+                    self.keys.rearm_annotation):
+            if key in node.metadata.annotations:
+                self.provider.change_node_upgrade_annotation(
+                    node, key, None)
+
+    def _finish_recovery(self, node: Node) -> None:
+        """Return the node to service: clear the upgrade parking and all
+        bookkeeping, record MTTR, commit healthy."""
+        since_raw = node.metadata.annotations.get(
+            self.keys.wedge_since_annotation)
+        self._park_upgrade_flow(node, parked=False)
+        self._clear_bookkeeping(node)
+        if not self.provider.change_node_upgrade_state(
+                node, RemediationState.HEALTHY):
+            return
+        self.remediations_succeeded_total += 1
+        if since_raw is not None:
+            self._recovery_seconds.append(
+                max(0.0, self.clock.now() - float(since_raw)))
+        logger.info("node %s recovered", node.metadata.name)
+        log_event(self.recorder, node, Event.NORMAL,
+                  self.keys.event_reason,
+                  "Node recovered and returned to service")
+
+    # ------------------------------------------------------------------
+    # status / metrics feed
+    # ------------------------------------------------------------------
+    def drain_recovery_durations(self) -> list[float]:
+        """Pop the wedge→recovered durations (seconds) accumulated since
+        the last call — the MTTR histogram feed."""
+        out = self._recovery_seconds
+        self._recovery_seconds = []
+        return out
+
+    def remediation_status(self, snapshot: RemediationSnapshot) -> dict:
+        """CRD-embeddable status block for one snapshot (JSON-ready,
+        camelCase, deterministic ordering — the shape consumers splice
+        into their CRD ``.status`` next to the upgrade block)."""
+        per_state = {key or "healthy": len(bucket)
+                     for key, bucket in sorted(snapshot.node_states.items())
+                     if bucket}
+        status = {
+            "totalNodes": snapshot.total_nodes(),
+            "wedgedNodes": len(snapshot.bucket(RemediationState.WEDGED)),
+            "remediationsInProgress": snapshot.in_progress(),
+            "remediationsFailed": len(
+                snapshot.bucket(RemediationState.FAILED)),
+            "unavailableNodes": snapshot.unavailable_nodes(),
+            "nodesByState": per_state,
+            "wedgedDetectedTotal": self.wedged_detected_total,
+            "recoveredTotal": self.remediations_succeeded_total,
+        }
+        if self.last_pass_deferrals:
+            status["transientDeferrals"] = self.last_pass_deferrals
+        return status
+
+    # ------------------------------------------------------------------
+    # chained reconcile
+    # ------------------------------------------------------------------
+    def reconcile(self, namespace: str, runtime_labels: dict[str, str],
+                  policy: Optional[RemediationPolicySpec],
+                  max_chain: int = 10) -> Optional[RemediationSnapshot]:
+        """build_state + apply_state, chained until node states
+        stabilize — the same dead-time elimination the upgrade machine's
+        chained reconcile performs, with the fingerprint covering every
+        durable bit a pass can write (labels, schedulability, and all
+        remediation annotations)."""
+        last_snapshot = None
+        fingerprint = None
+        prefix = f"{self.keys.domain}/{self.keys.driver}-remediation"
+        for _ in range(max_chain):
+            snapshot = self.build_state(namespace, runtime_labels)
+            new_fingerprint = tuple(sorted(
+                (ns.node.metadata.name, label,
+                 ns.node.is_unschedulable(),
+                 tuple(sorted(
+                     (key, value) for key, value
+                     in ns.node.metadata.annotations.items()
+                     if key.startswith(prefix))))
+                for label, bucket in snapshot.node_states.items()
+                for ns in bucket))
+            if new_fingerprint == fingerprint:
+                return snapshot
+            fingerprint = new_fingerprint
+            last_snapshot = snapshot
+            self.apply_state(snapshot, policy)
+        return last_snapshot
