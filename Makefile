@@ -4,7 +4,7 @@ PYTHON ?= python3
 PYTEST_FLAGS ?= -q
 COV_THRESHOLD ?= 85
 
-.PHONY: all check test test-fast test-fault lint cov bench graft-check package clean diagram
+.PHONY: all check test test-fast test-fault test-chaos test-soak lint cov bench graft-check package clean diagram
 
 all: lint test
 
@@ -25,6 +25,17 @@ test-fast:
 # and lossy-apiserver convergence (marker registered in pyproject.toml).
 test-fault:
 	$(PYTHON) -m pytest tests/ $(PYTEST_FLAGS) -m fault
+
+# The chaos gate: fixed seeds, tier-1 fast — seeded compound-fault soaks
+# with invariant monitoring (docs/chaos-testing.md). A failure prints
+# the seed + event trace needed to replay it deterministically.
+test-chaos:
+	$(PYTHON) -m pytest tests/test_chaos.py $(PYTEST_FLAGS) -m "chaos and not slow"
+
+# Long randomized soak, outside tier-1. Widen with the env knobs, e.g.:
+#   CHAOS_SEEDS=$$(seq -s, 100 199) CHAOS_STEPS=2400 make test-soak
+test-soak:
+	$(PYTHON) -m pytest tests/test_chaos.py $(PYTEST_FLAGS) -m soak
 
 # In-repo static analyzer (tools/lint.py): always available, fails on
 # findings — no silent degradation when external linters are missing

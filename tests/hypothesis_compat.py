@@ -1,0 +1,56 @@
+"""Import shim for ``hypothesis``: real when installed, graceful when not.
+
+The image this repo targets does not ship hypothesis; importing it at
+module scope used to ERROR five test modules out of collection — taking
+their non-property tests (and helpers other suites import, e.g.
+``test_e2e_scenarios.assert_transitions_legal``) down with them. Import
+from here instead::
+
+    from hypothesis_compat import assume, given, settings, st
+
+With hypothesis installed this re-exports the real objects. Without it,
+``@given`` replaces the test with one that SKIPs, and ``st``/``hnp``
+are inert stand-ins that absorb any strategy expression (chained calls
+included) so module-scope strategy definitions still evaluate.
+"""
+
+try:
+    from hypothesis import assume, given, settings  # noqa: F401 (re-export)
+    from hypothesis import strategies as st  # noqa: F401 (re-export)
+    import hypothesis.extra.numpy as hnp  # noqa: F401 (re-export)
+
+    HAVE_HYPOTHESIS = True
+except ModuleNotFoundError:
+    import pytest as _pytest
+
+    HAVE_HYPOTHESIS = False
+
+    class _AnyStrategy:
+        """Absorbs any attribute access or call: st.lists(st.text().map(f))
+        and friends all evaluate to this same inert object."""
+
+        def __getattr__(self, _name):
+            return self
+
+        def __call__(self, *_args, **_kwargs):
+            return self
+
+    st = _AnyStrategy()
+    hnp = _AnyStrategy()
+
+    def assume(_condition):
+        return True
+
+    def settings(*_args, **_kwargs):
+        return lambda fn: fn
+
+    def given(*_args, **_kwargs):
+        def deco(fn):
+            def skipper(*_a, **_k):
+                _pytest.skip("hypothesis not installed")
+
+            skipper.__name__ = getattr(fn, "__name__", "test")
+            skipper.__doc__ = fn.__doc__
+            return skipper
+
+        return deco

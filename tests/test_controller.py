@@ -44,7 +44,8 @@ from builders import NodeBuilder, PodBuilder
 
 class TestRateLimiter:
     def test_exponential_growth_and_cap(self):
-        rl = ExponentialBackoffRateLimiter(base=0.01, max_delay=0.05)
+        rl = ExponentialBackoffRateLimiter(base=0.01, max_delay=0.05,
+                                           jitter=0.0)
         delays = [rl.when("k") for _ in range(5)]
         assert delays[0] == pytest.approx(0.01)
         assert delays[1] == pytest.approx(0.02)
@@ -53,7 +54,7 @@ class TestRateLimiter:
         assert delays[4] == pytest.approx(0.05)
 
     def test_forget_resets(self):
-        rl = ExponentialBackoffRateLimiter(base=0.01)
+        rl = ExponentialBackoffRateLimiter(base=0.01, jitter=0.0)
         rl.when("k")
         rl.when("k")
         assert rl.retries("k") == 2
@@ -62,10 +63,40 @@ class TestRateLimiter:
         assert rl.when("k") == pytest.approx(0.01)
 
     def test_keys_independent(self):
-        rl = ExponentialBackoffRateLimiter(base=0.01)
+        rl = ExponentialBackoffRateLimiter(base=0.01, jitter=0.0)
         rl.when("a")
         rl.when("a")
         assert rl.when("b") == pytest.approx(0.01)
+
+    def test_full_jitter_is_default_and_bounded(self):
+        """Default full jitter: every delay lands in (0, base*2^n] —
+        never zero (no hot retry), never above the deterministic
+        schedule, and not a constant (desynchronized retries are the
+        whole point: deterministic backoff thundering-herds the
+        apiserver with aligned retry waves)."""
+        import random
+
+        rl = ExponentialBackoffRateLimiter(
+            base=0.01, max_delay=0.05, rng=random.Random(7))
+        seen = []
+        for n in range(50):
+            rl.forget("k")
+            delay = rl.when("k")
+            assert 0.0 < delay <= 0.01
+            seen.append(delay)
+        assert len(set(seen)) > 1, "jittered delays were constant"
+        # partial jitter keeps a floor of (1 - jitter) * delay
+        rl = ExponentialBackoffRateLimiter(
+            base=0.01, jitter=0.5, rng=random.Random(7))
+        for _ in range(20):
+            rl.forget("k")
+            assert 0.005 < rl.when("k") <= 0.01
+
+    def test_jitter_validation(self):
+        with pytest.raises(ValueError):
+            ExponentialBackoffRateLimiter(jitter=1.5)
+        with pytest.raises(ValueError):
+            ExponentialBackoffRateLimiter(jitter=-0.1)
 
 
 class TestWorkQueue:
@@ -180,6 +211,114 @@ class TestFakeClusterWatch:
         event = watch.get(timeout=1.0)
         event.object.metadata.labels["mutated"] = "yes"
         assert "mutated" not in cluster.get_node("n1").metadata.labels
+
+
+class TestBoundedWatch:
+    """Bounded subscriber queues: overflow drops observably (counter +
+    BOOKMARK resync marker) instead of leaking memory."""
+
+    def _node_event(self, name="n1"):
+        from tpu_operator_libs.k8s.objects import Node, ObjectMeta
+        from tpu_operator_libs.k8s.watch import WatchEvent
+
+        return WatchEvent(ADDED, KIND_NODE,
+                          Node(metadata=ObjectMeta(name=name)))
+
+    def test_overflow_drops_counts_and_bookmarks(self):
+        from tpu_operator_libs.k8s.watch import BOOKMARK, Watch
+
+        watch = Watch(max_queue=2)
+        for i in range(5):
+            watch._deliver(self._node_event(f"n{i}"))
+        assert watch.overflow_dropped == 3
+        # the consumer learns about the loss FIRST (resync before
+        # trusting anything derived from the stream)
+        first = watch.get(timeout=0.1)
+        assert first.type == BOOKMARK and first.object is None
+        assert watch.get(timeout=0.1).object.metadata.name == "n0"
+        assert watch.get(timeout=0.1).object.metadata.name == "n1"
+        assert watch.get(timeout=0.01) is None
+
+    def test_unbounded_watch_never_drops(self):
+        from tpu_operator_libs.k8s.watch import Watch
+
+        watch = Watch()
+        for i in range(100):
+            watch._deliver(self._node_event(f"n{i}"))
+        assert watch.overflow_dropped == 0
+
+    def test_max_queue_validation(self):
+        from tpu_operator_libs.k8s.watch import Watch
+
+        with pytest.raises(ValueError):
+            Watch(max_queue=0)
+
+    def test_fake_cluster_bounded_subscription(self):
+        from tpu_operator_libs.k8s.watch import BOOKMARK
+
+        cluster = FakeCluster()
+        watch = cluster.watch(max_queue=1)
+        from builders import NodeBuilder
+
+        NodeBuilder("a").create(cluster)
+        NodeBuilder("b").create(cluster)  # overflows the bound of 1
+        assert watch.overflow_dropped == 1
+        assert watch.get(timeout=0.1).type == BOOKMARK
+
+    def test_informer_relists_on_bookmark(self):
+        """An informer fed a bounded watch repairs its cache via relist
+        when events were dropped, so a slow consumer converges instead
+        of serving a silently stale cache."""
+        cluster = FakeCluster()
+        from builders import NodeBuilder
+
+        NodeBuilder("seed").create(cluster)
+        watch = cluster.watch(kinds={KIND_NODE}, max_queue=1)
+        informer = Informer(lister=cluster.list_nodes, watch=watch,
+                            name="bounded")
+        informer.start()
+        assert informer.has_synced(timeout=5.0)
+        # burst past the bound while the pump may be busy; some events
+        # drop, the bookmark forces a refresh
+        for i in range(10):
+            NodeBuilder(f"burst-{i}").create(cluster)
+        deadline = time.monotonic() + 5.0
+        while time.monotonic() < deadline and len(informer) < 11:
+            time.sleep(0.01)
+        if watch.overflow_dropped:
+            # the relist healed every dropped event
+            assert len(informer) == 11
+        informer.stop()
+
+
+class TestWorkerHonorsRetryAfter:
+    def test_retry_after_floors_the_backoff_delay(self):
+        """A reconcile failing with ApiServerError(retry_after=N) must
+        not be retried before N seconds — the server said when to come
+        back; the limiter's (jittered, much smaller) delay would
+        otherwise hammer the throttle."""
+        from tpu_operator_libs.k8s.client import ApiServerError
+
+        calls = []
+        done = threading.Event()
+
+        def reconcile(_key):
+            calls.append(time.monotonic())
+            if len(calls) == 1:
+                raise ApiServerError("HTTP 429", retry_after=0.4)
+            done.set()
+            return None
+
+        controller = Controller(
+            reconcile, name="retry-after",
+            rate_limiter=ExponentialBackoffRateLimiter(base=0.001))
+        controller.start(workers=1)
+        try:
+            assert done.wait(timeout=5.0)
+        finally:
+            controller.stop()
+        assert len(calls) >= 2
+        assert calls[1] - calls[0] >= 0.35
 
 
 class TestInformer:
@@ -338,7 +477,8 @@ class TestController:
 
         ctrl = Controller(
             reconcile,
-            rate_limiter=ExponentialBackoffRateLimiter(base=0.02))
+            rate_limiter=ExponentialBackoffRateLimiter(base=0.02,
+                                                       jitter=0.0))
         ctrl.start()  # initial_sync seeds the first reconcile
         try:
             assert done.wait(timeout=5.0)

@@ -57,6 +57,24 @@ def _discover(module) -> list[tuple[type, str]]:
 CASES = (_discover(upgrade_policy) + _discover(unified_policy)
          + _discover(objects))
 
+#: Name -> class over every scanned module: Python < 3.11 leaves the
+#: inner forward ref of builtin-generic annotations (list["X"]) as a
+#: bare string in get_type_hints output; _value_for resolves it here.
+_NAMES = {
+    name: value
+    for module in (upgrade_policy, unified_policy, objects)
+    for name, value in vars(module).items()
+    if inspect.isclass(value)
+}
+
+
+def _resolve_forward(tp):
+    if isinstance(tp, typing.ForwardRef):
+        tp = tp.__forward_arg__
+    if isinstance(tp, str):
+        return _NAMES.get(tp, str)
+    return tp
+
 
 def _unwrap_optional(tp):
     origin = typing.get_origin(tp)
@@ -69,7 +87,7 @@ def _unwrap_optional(tp):
 
 def _value_for(tp, depth: int, salt: int):
     """A non-default, recognizable value of (roughly) type ``tp``."""
-    tp = _unwrap_optional(tp)
+    tp = _resolve_forward(_unwrap_optional(tp))
     origin = typing.get_origin(tp)
     if origin in (list,):
         (item,) = typing.get_args(tp) or (str,)

@@ -9,8 +9,7 @@
 
 import os
 
-from hypothesis import given, settings
-from hypothesis import strategies as st
+from hypothesis_compat import given, settings, st
 
 from tpu_operator_libs.api.upgrade_policy import (
     DrainSpec,

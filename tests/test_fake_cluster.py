@@ -583,8 +583,7 @@ class TestSelectorFastPathProperty:
         return True
 
     def test_matches_naive_reference(self):
-        from hypothesis import given, settings
-        from hypothesis import strategies as st
+        from hypothesis_compat import given, settings, st
 
         keys = st.sampled_from(["a", "b", "app", "env", "tier"])
         vals = st.sampled_from(["1", "2", "x", "prod", "canary", ""])

@@ -176,6 +176,30 @@ class TestTransientApiErrors:
         with pytest.raises(ApiServerError):
             cluster.list_nodes()
 
+    def test_factoryless_topup_mid_budget_restores_default(self):
+        """Regression for the leftover-factory edge: a second injection
+        WITHOUT a factory while custom-budget errors are still
+        outstanding must restore the documented default ApiServerError
+        for the whole remaining budget — not keep raising the stale
+        custom exception."""
+        import pytest
+
+        from tpu_operator_libs.k8s.client import ApiServerError
+        from tpu_operator_libs.k8s.fake import FakeCluster
+
+        cluster = FakeCluster()
+        cluster.inject_api_errors("list_nodes", 2,
+                                  lambda: TimeoutError("etcd slow"))
+        with pytest.raises(TimeoutError):
+            cluster.list_nodes()
+        # one custom error still outstanding; the factoryless top-up
+        # must override it ("passing None restores the default")
+        cluster.inject_api_errors("list_nodes", 1)
+        for _ in range(2):
+            with pytest.raises(ApiServerError):
+                cluster.list_nodes()
+        assert cluster.list_nodes() == []
+
     def test_rolling_upgrade_converges_through_flaky_apiserver(self):
         """Every mutation/read op fails intermittently throughout the
         whole upgrade; convergence must still happen and every observed
@@ -244,6 +268,92 @@ class TestTransientApiErrors:
                   for p in cluster.list_pods(NS)}
         assert hashes == {"new"}
         assert not any(n.is_unschedulable() for n in cluster.list_nodes())
+
+
+class TestHttp429Semantics:
+    """HttpCluster: 429 means PDB-blocked ONLY on the eviction
+    subresource; elsewhere it is apiserver throttling — retried in place
+    honoring Retry-After, then surfaced as a typed retryable error
+    carrying the header (k8s/http.py)."""
+
+    def _http_429(self, retry_after=None):
+        import email.message
+        import io
+        import urllib.error
+
+        headers = email.message.Message()
+        if retry_after is not None:
+            headers["Retry-After"] = str(retry_after)
+        return urllib.error.HTTPError(
+            "http://test/x", 429, "Too Many Requests", headers,
+            io.BytesIO(b"throttled"))
+
+    def _cluster(self, responses):
+        """HttpCluster whose urlopen raises/returns from ``responses``
+        (a list of exceptions or bytes payloads) and records sleeps."""
+        import contextlib
+        import io
+
+        from tpu_operator_libs.k8s.http import HttpCluster
+
+        cluster = HttpCluster("http://test")
+        sleeps = []
+        cluster._sleep = sleeps.append
+        calls = {"n": 0}
+
+        def fake_urlopen(req, timeout=None, context=None):
+            item = responses[min(calls["n"], len(responses) - 1)]
+            calls["n"] += 1
+            if callable(item):  # factory: fresh exception per attempt
+                raise item()
+            return contextlib.closing(io.BytesIO(item))
+
+        import urllib.request
+        original = urllib.request.urlopen
+        urllib.request.urlopen = fake_urlopen
+        return cluster, sleeps, calls, lambda: setattr(
+            urllib.request, "urlopen", original)
+
+    def test_non_eviction_429_retries_honoring_retry_after(self):
+        cluster, sleeps, calls, restore = self._cluster(
+            [lambda: self._http_429(retry_after=3), b'{"items": []}'])
+        try:
+            assert cluster.list_nodes() == []
+        finally:
+            restore()
+        assert calls["n"] == 2
+        assert sleeps == [3.0]  # the server's Retry-After, verbatim
+
+    def test_exhausted_429_surfaces_typed_with_retry_after(self):
+        import pytest
+
+        from tpu_operator_libs.k8s.client import ApiServerError
+
+        cluster, sleeps, _calls, restore = self._cluster(
+            [lambda: self._http_429(retry_after=7)])
+        try:
+            with pytest.raises(ApiServerError) as excinfo:
+                cluster.list_nodes()
+        finally:
+            restore()
+        assert excinfo.value.retry_after == 7.0
+        # in-place retries were paced but capped
+        assert sleeps == [7.0, 7.0]
+
+    def test_eviction_429_still_means_pdb_blocked(self):
+        import pytest
+
+        from tpu_operator_libs.k8s.client import EvictionBlockedError
+
+        cluster, sleeps, calls, restore = self._cluster(
+            [lambda: self._http_429(retry_after=9)])
+        try:
+            with pytest.raises(EvictionBlockedError):
+                cluster.evict_pod("ns", "pod")
+        finally:
+            restore()
+        assert calls["n"] == 1  # no in-place retry: the caller decides
+        assert sleeps == []
 
 
 class TestTransientErrorsDontConsumeFailureBudget:

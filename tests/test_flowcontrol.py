@@ -7,8 +7,7 @@ import threading
 import time
 
 import pytest
-from hypothesis import given, settings
-from hypothesis import strategies as st
+from hypothesis_compat import given, settings, st
 
 from tpu_operator_libs.k8s.fake import FakeCluster
 from tpu_operator_libs.k8s.flowcontrol import TokenBucketRateLimiter

@@ -18,7 +18,11 @@ from types import SimpleNamespace as NS
 
 import pytest
 
-from tpu_operator_libs.k8s.client import EvictionBlockedError, NotFoundError
+from tpu_operator_libs.k8s.client import (
+    ApiServerError,
+    EvictionBlockedError,
+    NotFoundError,
+)
 from tpu_operator_libs.k8s.watch import (
     ADDED,
     DELETED,
@@ -277,11 +281,22 @@ class TestErrorTranslation:
             make_cluster().evict_pod("ns", "p1")
 
     def test_429_elsewhere_is_not_pdb_block(self, stub_k8s):
-        # apiserver rate limiting must surface as the raw ApiException so
-        # callers back off and retry instead of rerouting to drain/failed
-        stub_k8s.errors["patch_node"] = StubApiException(429, "slow down")
-        with pytest.raises(StubApiException):
+        # apiserver rate limiting must surface as the retryable typed
+        # error (NOT EvictionBlockedError) carrying the server's
+        # Retry-After so callers back off and retry instead of rerouting
+        # to drain/failed
+        exc = StubApiException(429, "slow down")
+        exc.headers = {"Retry-After": "7"}
+        stub_k8s.errors["patch_node"] = exc
+        with pytest.raises(ApiServerError) as excinfo:
             make_cluster().patch_node_labels("n1", {"a": "1"})
+        assert excinfo.value.retry_after == 7.0
+
+    def test_429_elsewhere_without_retry_after(self, stub_k8s):
+        stub_k8s.errors["patch_node"] = StubApiException(429, "slow down")
+        with pytest.raises(ApiServerError) as excinfo:
+            make_cluster().patch_node_labels("n1", {"a": "1"})
+        assert excinfo.value.retry_after is None
 
     def test_other_statuses_pass_through(self, stub_k8s):
         stub_k8s.errors["patch_node"] = StubApiException(403, "rbac")

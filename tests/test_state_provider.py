@@ -2,8 +2,7 @@
 parity: patch + readback, annotation null-delete, cache-sync polling)."""
 
 import pytest
-from hypothesis import assume, given, settings
-from hypothesis import strategies as st
+from hypothesis_compat import assume, given, settings, st
 
 from tpu_operator_libs.consts import UpgradeState
 from tpu_operator_libs.k8s.client import ApiServerError

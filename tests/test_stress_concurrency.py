@@ -278,9 +278,16 @@ class TestPrimitiveContention:
         max_active: dict[str, int] = {"a": 0, "b": 0}
         both_running = threading.Event()
         guard = threading.Lock()
+        # all workers start looping together — without this, a loaded
+        # machine can run each thread's brief loop to completion before
+        # the next even starts, and the overlap assertion flakes
+        start = threading.Barrier(8)
 
         def worker(key):
-            for _ in range(200):
+            start.wait()
+            for _ in range(5000):
+                if both_running.is_set():
+                    break
                 held = lock.lock(key)
                 try:
                     with guard:

@@ -242,8 +242,7 @@ class TestCorrelatorConservation:
     every emission is either spam-dropped or lands in exactly one
     recorded event's count — nothing lost, nothing double-counted."""
 
-    from hypothesis import given, settings
-    from hypothesis import strategies as st
+    from hypothesis_compat import given, settings, st
 
     @given(
         events=st.lists(

@@ -91,8 +91,7 @@ class TestWireSelectors:
         `selectors.selector_from_labels` to the wire double, so a
         divergence here would mean the smoke tests a different
         predicate than production evaluates."""
-        from hypothesis import given, settings
-        from hypothesis import strategies as st
+        from hypothesis_compat import given, settings, st
 
         from tpu_operator_libs.k8s.selectors import matches_labels
 
@@ -124,9 +123,13 @@ class TestWireSelectors:
 
 def _self_signed_ca_pem() -> bytes:
     """Throwaway self-signed cert for CA-pinning tests (minted in
-    memory; `cryptography` is baked into the image)."""
+    memory; skips when the image lacks `cryptography` — stdlib ssl
+    cannot mint certificates)."""
     import datetime
 
+    pytest.importorskip(
+        "cryptography", reason="cryptography not installed — cannot "
+        "mint a throwaway CA with the stdlib alone")
     from cryptography import x509
     from cryptography.hazmat.primitives import hashes, serialization
     from cryptography.hazmat.primitives.asymmetric import rsa

@@ -38,6 +38,7 @@ from __future__ import annotations
 
 import ast
 import builtins
+import re
 import sys
 import tokenize
 from dataclasses import dataclass, field
@@ -460,6 +461,8 @@ class FileChecker:
 
             with warnings.catch_warnings():
                 warnings.simplefilter("ignore", SyntaxWarning)
+                # pre-3.12 parsers emit these as DeprecationWarning
+                warnings.simplefilter("ignore", DeprecationWarning)
                 tree = ast.parse(self.source, filename=str(self.path))
         except SyntaxError as exc:
             self.findings.append(Finding(
@@ -533,7 +536,9 @@ class FileChecker:
                     compile(tok.string, "<lint>", "eval")
                 except (SyntaxError, ValueError):
                     continue
-                if any(issubclass(w.category, SyntaxWarning)
+                # 3.12+ emits SyntaxWarning; 3.8–3.11 DeprecationWarning
+                if any(issubclass(w.category,
+                                  (SyntaxWarning, DeprecationWarning))
                        and "invalid escape" in str(w.message)
                        for w in caught):
                     self.report(_FakeNode(tok.start[0], tok.start[1]),
@@ -652,13 +657,26 @@ def check_source(source: str, path: str = "<source>") -> list[Finding]:
 def _default_paths() -> list[str]:
     pyproject = Path(__file__).resolve().parent.parent / "pyproject.toml"
     if pyproject.exists():
-        import tomllib
-
-        config = tomllib.loads(pyproject.read_text())
-        paths = (config.get("tool", {}).get("tpulint", {})
-                 .get("paths"))
-        if paths:
-            return paths
+        text = pyproject.read_text()
+        try:
+            import tomllib
+        except ModuleNotFoundError:
+            # Python < 3.11: the [tool.tpulint] paths value is a flat
+            # one-line string list — a targeted regex keeps the lint
+            # surface identical instead of silently shrinking it
+            m = re.search(
+                r"^\[tool\.tpulint\]\s*?\npaths\s*=\s*\[([^\]]*)\]",
+                text, re.MULTILINE)
+            if m:
+                paths = re.findall(r'"([^"]+)"', m.group(1))
+                if paths:
+                    return paths
+        else:
+            config = tomllib.loads(text)
+            paths = (config.get("tool", {}).get("tpulint", {})
+                     .get("paths"))
+            if paths:
+                return paths
     return ["tpu_operator_libs"]
 
 

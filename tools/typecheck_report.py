@@ -78,11 +78,25 @@ def makefile_target() -> "list[str]":
 
 
 def pyproject_profile() -> "list[str]":
-    import tomllib
-
-    with open(REPO / "pyproject.toml", "rb") as fh:
-        cfg = tomllib.load(fh)
-    mypy_cfg = cfg.get("tool", {}).get("mypy")
+    try:
+        import tomllib
+    except ModuleNotFoundError:
+        # Python < 3.11: the strict/check_untyped_defs flags live on
+        # their own lines under [tool.mypy] — check them textually so
+        # the profile gate still runs instead of crashing the tool
+        text = (REPO / "pyproject.toml").read_text()
+        m = re.search(r"^\[tool\.mypy\]\n(.*?)(?=^\[|\Z)", text,
+                      re.M | re.S)
+        if not m:
+            return ["pyproject.toml has no [tool.mypy] profile"]
+        mypy_cfg = {
+            key: value == "true"
+            for key, value in re.findall(
+                r"^(\w+)\s*=\s*(true|false)\s*$", m.group(1), re.M)}
+    else:
+        with open(REPO / "pyproject.toml", "rb") as fh:
+            cfg = tomllib.load(fh)
+        mypy_cfg = cfg.get("tool", {}).get("mypy")
     if not isinstance(mypy_cfg, dict):
         return ["pyproject.toml has no [tool.mypy] profile"]
     problems = []

@@ -1,0 +1,64 @@
+"""Chaos harness: seeded fault schedules, invariant monitoring, soak runs.
+
+Drives the REAL :class:`~tpu_operator_libs.upgrade.state_manager.
+ClusterUpgradeStateManager` and :class:`~tpu_operator_libs.remediation.
+state_machine.NodeRemediationManager` against the FakeCluster virtual
+clock while a deterministic, seed-derived schedule fires compound
+failures — apiserver error bursts, watch-stream drops, stale reads,
+NotReady flaps, crash-looping runtime pods, PDB-blocked evictions,
+leader-election loss, and operator crash–restart (the managers are torn
+down mid-transition and rebuilt from cluster state alone, proving node
+labels/annotations are a sufficient durable store). An
+:class:`InvariantMonitor` subscribed to the cluster's watch stream
+asserts safety after every mutation; the soak runner proves liveness
+(full fleet convergence once the schedule's faults heal).
+
+Every run is replayable from its seed: a violation report carries the
+seed plus the event trace needed to reproduce it deterministically
+(``docs/chaos-testing.md``).
+"""
+
+from tpu_operator_libs.chaos.injector import ChaosInjector, OperatorCrash
+from tpu_operator_libs.chaos.invariants import (
+    InvariantMonitor,
+    InvariantViolation,
+)
+from tpu_operator_libs.chaos.runner import (
+    ChaosConfig,
+    ChaosReport,
+    run_chaos_soak,
+)
+from tpu_operator_libs.chaos.schedule import (
+    FAULT_API_BURST,
+    FAULT_CRASHLOOP,
+    FAULT_KINDS,
+    FAULT_LEADER_LOSS,
+    FAULT_NOT_READY_FLAP,
+    FAULT_OPERATOR_CRASH,
+    FAULT_PDB_BLOCK,
+    FAULT_STALE_READS,
+    FAULT_WATCH_BREAK,
+    FaultEvent,
+    FaultSchedule,
+)
+
+__all__ = [
+    "ChaosConfig",
+    "ChaosInjector",
+    "ChaosReport",
+    "FAULT_API_BURST",
+    "FAULT_CRASHLOOP",
+    "FAULT_KINDS",
+    "FAULT_LEADER_LOSS",
+    "FAULT_NOT_READY_FLAP",
+    "FAULT_OPERATOR_CRASH",
+    "FAULT_PDB_BLOCK",
+    "FAULT_STALE_READS",
+    "FAULT_WATCH_BREAK",
+    "FaultEvent",
+    "FaultSchedule",
+    "InvariantMonitor",
+    "InvariantViolation",
+    "OperatorCrash",
+    "run_chaos_soak",
+]

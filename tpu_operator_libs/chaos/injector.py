@@ -1,0 +1,270 @@
+"""ChaosInjector: arms a FaultSchedule against a FakeCluster.
+
+Cluster-side faults (error bursts, watch drops, stale reads, flaps,
+crash-loop windows, PDB blocks, lease theft) are installed as scheduled
+virtual-clock actions — :meth:`FakeCluster.step` fires them, so the
+interleaving with reconciles is owned entirely by the runner's loop and
+is reproducible from the seed.
+
+Operator-side faults (``operator-crash``) cannot be cluster actions:
+the "process" that must die is the caller. They are exposed through
+:class:`CrashFuse` — the runner arms the fuse when a crash event comes
+due, and the fuse detonates inside the state machines' durable-write
+path (:class:`CrashingStateProvider`), aborting the pass mid-transition
+exactly the way a SIGKILL between two apiserver writes would.
+"""
+
+from __future__ import annotations
+
+import logging
+from typing import Callable, Optional
+
+from tpu_operator_libs.chaos.schedule import (
+    FAULT_API_BURST,
+    FAULT_CRASHLOOP,
+    FAULT_LEADER_LOSS,
+    FAULT_NOT_READY_FLAP,
+    FAULT_OPERATOR_CRASH,
+    FAULT_PDB_BLOCK,
+    FAULT_STALE_READS,
+    FAULT_WATCH_BREAK,
+    FaultEvent,
+    FaultSchedule,
+)
+from tpu_operator_libs.consts import UpgradeState
+from tpu_operator_libs.k8s.client import ApiServerError, NotFoundError
+from tpu_operator_libs.k8s.fake import FakeCluster
+from tpu_operator_libs.k8s.objects import Node
+from tpu_operator_libs.upgrade.state_provider import (
+    NodeUpgradeStateProvider,
+)
+
+logger = logging.getLogger(__name__)
+
+
+class OperatorCrash(RuntimeError):
+    """The simulated operator process died mid-reconcile.
+
+    Deliberately NOT an ApiServerError/ConflictError/NotFoundError: the
+    state machines' per-node transient isolation must not swallow it —
+    a crash aborts the whole pass, and the runner rebuilds the managers
+    from cluster state alone (the resume-from-labels proof).
+    """
+
+
+class CrashFuse:
+    """Shared write-counting detonator for operator-crash faults.
+
+    ``arm(budget, after)`` lets the next ``budget`` durable writes
+    commit, then raises :class:`OperatorCrash` on the following one —
+    before the commit (``after=False``, the write is lost) or after it
+    (``after=True``, the write landed but the process died before
+    acting on it). Both windows are the classic crash-consistency
+    holes; seeds exercise each. While :attr:`pending` the fuse keeps
+    raising on every write, so a crash swallowed by a broad exception
+    handler deterministically resurfaces instead of vanishing — a dead
+    process stays dead until the runner "restarts" it via
+    :meth:`reset`.
+    """
+
+    def __init__(self) -> None:
+        self._budget: Optional[int] = None
+        self._after = False
+        self.pending = False
+        self.fired_total = 0
+
+    def arm(self, budget: int, after: bool) -> None:
+        self._budget = max(0, budget)
+        self._after = after
+
+    @property
+    def armed(self) -> bool:
+        return self._budget is not None
+
+    def reset(self) -> None:
+        """The replacement operator process has started. Clears only the
+        ``pending`` flag: an ARMED-but-unfired crash survives restarts
+        and leader handovers — the schedule says the process dies around
+        its time, and whichever incarnation is alive then dies."""
+        self.pending = False
+
+    def guard(self, write: Callable[[], object]) -> object:
+        """Run one durable write under the fuse."""
+        if self.pending:
+            raise OperatorCrash("operator process is down (crash "
+                                "pending restart)")
+        if self._budget is None:
+            return write()
+        if self._budget > 0:
+            self._budget -= 1
+            return write()
+        self._budget = None
+        self.pending = True
+        self.fired_total += 1
+        if self._after:
+            write()
+            raise OperatorCrash(
+                "operator crashed AFTER committing a durable write")
+        raise OperatorCrash(
+            "operator crashed BEFORE committing a durable write")
+
+
+class CrashingStateProvider(NodeUpgradeStateProvider):
+    """NodeUpgradeStateProvider whose durable writes pass through a
+    :class:`CrashFuse`. This is the crash seam: every label/annotation
+    commit of both state machines funnels through the provider, so a
+    detonation here is indistinguishable from the operator dying between
+    (or during) apiserver writes."""
+
+    def __init__(self, *args: object, fuse: CrashFuse,
+                 **kwargs: object) -> None:
+        super().__init__(*args, **kwargs)  # type: ignore[arg-type]
+        self._fuse = fuse
+
+    def change_node_upgrade_state(self, node: Node,
+                                  new_state: "UpgradeState | str") -> bool:
+        return bool(self._fuse.guard(
+            lambda: super(CrashingStateProvider, self)
+            .change_node_upgrade_state(node, new_state)))
+
+    def change_node_upgrade_annotation(self, node: Node, key: str,
+                                       value: Optional[str]) -> None:
+        self._fuse.guard(
+            lambda: super(CrashingStateProvider, self)
+            .change_node_upgrade_annotation(node, key, value))
+
+    def change_node_upgrade_annotations(
+            self, node: Node,
+            annotations: "dict[str, Optional[str]]") -> None:
+        self._fuse.guard(
+            lambda: super(CrashingStateProvider, self)
+            .change_node_upgrade_annotations(node, annotations))
+
+
+class ChaosInjector:
+    """Installs a schedule's cluster-side faults; owns the crash fuse.
+
+    ``lease`` identifies the leader-election Lease that leader-loss
+    events overwrite. Workload-namespace evictions are the PDB-block
+    target (runtime DaemonSet pods are never evicted by drains anyway).
+    """
+
+    def __init__(self, cluster: FakeCluster, schedule: FaultSchedule,
+                 lease_namespace: str = "kube-system",
+                 lease_name: str = "chaos-operator-leader") -> None:
+        self._cluster = cluster
+        self._schedule = schedule
+        self._lease_namespace = lease_namespace
+        self._lease_name = lease_name
+        self.fuse = CrashFuse()
+        self._crash_events: list[FaultEvent] = sorted(
+            schedule.by_kind(FAULT_OPERATOR_CRASH), key=lambda e: e.at)
+        self._crash_index = 0
+        # active crash-loop windows: node -> heal time
+        self._crashloop_until: dict[str, float] = {}
+        # active PDB windows (static list; the blocker checks the clock)
+        self._pdb_windows: list[tuple[float, float]] = []
+        self.installed = False
+        self.leader_losses = 0
+
+    # -- installation -----------------------------------------------------
+    def install(self) -> None:
+        """Arm every cluster-side fault as a scheduled virtual action."""
+        if self.installed:
+            return
+        self.installed = True
+        cluster = self._cluster
+        for event in self._schedule.events:
+            if event.kind == FAULT_API_BURST:
+                cluster.schedule_at(
+                    event.at, lambda e=event: cluster.inject_api_errors(
+                        e.target, e.param))
+            elif event.kind == FAULT_WATCH_BREAK:
+                cluster.schedule_at(
+                    event.at, lambda: cluster.drop_watch_streams())
+            elif event.kind == FAULT_STALE_READS:
+                cluster.schedule_at(
+                    event.at, lambda e=event: self._inject_stale(e))
+            elif event.kind == FAULT_NOT_READY_FLAP:
+                cluster.flap_node_ready(event.target, event.at,
+                                        event.until)
+            elif event.kind == FAULT_CRASHLOOP:
+                cluster.schedule_at(
+                    event.at,
+                    lambda e=event: self._crashloop_until.__setitem__(
+                        e.target, e.until))
+            elif event.kind == FAULT_PDB_BLOCK:
+                self._pdb_windows.append((event.at, event.until))
+            elif event.kind == FAULT_LEADER_LOSS:
+                cluster.schedule_at(
+                    event.at, lambda: self._steal_lease())
+        if any(e.kind == FAULT_CRASHLOOP for e in self._schedule.events):
+            cluster.add_pod_ready_gate(self._ready_gate)
+        if self._pdb_windows:
+            cluster.add_eviction_blocker(self._eviction_blocked)
+
+    def _inject_stale(self, event: FaultEvent) -> None:
+        try:
+            self._cluster.inject_stale_node_reads(event.target, event.param)
+        except NotFoundError:
+            # the target node vanished before the fault fired — a chaos
+            # run must not die on its own injection
+            logger.info("stale-read target %s gone; skipping", event.target)
+
+    def _ready_gate(self, pod) -> bool:
+        heal = self._crashloop_until.get(pod.spec.node_name)
+        return heal is None or self._cluster.clock.now() >= heal
+
+    def _eviction_blocked(self, pod) -> bool:
+        now = self._cluster.clock.now()
+        if not any(start <= now < end for start, end in self._pdb_windows):
+            return False
+        # PDB semantics: budgets guard workload pods; DaemonSet-owned
+        # runtime pods are deleted (not evicted) and drains skip them
+        owner = pod.controller_owner()
+        return owner is None or owner.kind != "DaemonSet"
+
+    def _steal_lease(self) -> None:
+        self.leader_losses += 1
+        self._cluster.steal_lease(
+            self._lease_namespace, self._lease_name,
+            f"chaos-intruder-{self.leader_losses}")
+
+    # -- operator-side faults ---------------------------------------------
+    def arm_due_crashes(self, now: float) -> bool:
+        """Arm the fuse for any crash event at or before ``now`` not yet
+        armed. Returns True when one was armed this call."""
+        armed = False
+        while (self._crash_index < len(self._crash_events)
+               and self._crash_events[self._crash_index].at <= now):
+            event = self._crash_events[self._crash_index]
+            self._crash_index += 1
+            # parity of the write budget decides the crash window:
+            # before vs after the durable commit
+            self.fuse.arm(event.param, after=event.param % 2 == 1)
+            armed = True
+        return armed
+
+    @property
+    def crashes_fired(self) -> int:
+        return self.fuse.fired_total
+
+
+def consume_transient(fn: Callable[[], object],
+                      attempts: int = 12) -> object:
+    """Run harness-side bookkeeping reads through injected API faults.
+
+    The injector deliberately poisons shared client operations; the
+    HARNESS (monitor resyncs, convergence checks, workload restore) must
+    ride those out the way any other client would — retry, consuming the
+    injected budget — without mistaking its own tooling for the system
+    under test."""
+    last: Optional[Exception] = None
+    for _ in range(attempts):
+        try:
+            return fn()
+        except (ApiServerError, TimeoutError) as exc:
+            last = exc
+    raise RuntimeError(
+        f"injected fault budget not consumable in {attempts} attempts"
+    ) from last

@@ -1,0 +1,382 @@
+"""InvariantMonitor: watch-driven safety assertions for chaos runs.
+
+Subscribes to the cluster's watch stream and checks, after every
+mutation, that the two state machines held their contracts no matter
+what the fault schedule did:
+
+- **legal-transition**: a node's upgrade-state label only ever moves
+  along ``consts.STATE_EDGES``; its remediation label only along
+  ``consts.REMEDIATION_EDGES``.
+- **max-unavailable**: at every admission instant (a node entering
+  ``cordon-required`` in either machine), fleet unavailability plus the
+  nodes committed-to-cordon stays within the policy budget. Nodes that
+  were already unschedulable are exempt (the documented manual-cordon
+  override); the check is only armed for the flat planner — the slice
+  planner may deliberately overdraw by one slice (topology/planner.py
+  point 4).
+- **max-parallel**: at admission, upgrades in progress never exceed
+  ``maxParallelUpgrades`` (when set).
+- **workload-placement**: no workload pod is ever scheduled onto a
+  cordoned node or one whose state says its runtime is being torn down
+  (``consts.WORKLOAD_UNSAFE_STATES`` /
+  ``REMEDIATION_WORKLOAD_UNSAFE_STATES``).
+- **cordon-pairing** (checked at the end via :meth:`final_check`):
+  every cordon the operators applied was eventually paired with an
+  uncordon — no node is left quarantined once the fleet converged.
+
+The monitor mirrors cluster state from events only; when its stream is
+broken (the ``watch-break`` fault) or overflows (a BOOKMARK marker from
+a bounded Watch), it resubscribes and relists — transitions hidden by
+the gap are absorbed without assertion, exactly the blind spot a real
+informer has, and the gap itself is recorded in the trace.
+
+Every event lands in a bounded trace; a violation report carries the
+seed and that trace, which is all that is needed to replay the run
+(``FaultSchedule`` is pure in the seed).
+"""
+
+from __future__ import annotations
+
+import logging
+from dataclasses import dataclass, field
+from typing import Optional
+
+from tpu_operator_libs.api.upgrade_policy import (
+    IntOrString,
+    scaled_value_from_int_or_percent,
+)
+from tpu_operator_libs.chaos.injector import consume_transient
+from tpu_operator_libs.consts import (
+    IN_PROGRESS_STATES,
+    LEGAL_EDGES,
+    REMEDIATION_LEGAL_EDGES,
+    REMEDIATION_WORKLOAD_UNSAFE_STATES,
+    WORKLOAD_UNSAFE_STATES,
+    RemediationKeys,
+    RemediationState,
+    UpgradeKeys,
+    UpgradeState,
+)
+from tpu_operator_libs.k8s.fake import FakeCluster
+from tpu_operator_libs.k8s.watch import (
+    ADDED,
+    BOOKMARK,
+    DELETED,
+    KIND_NODE,
+    KIND_POD,
+)
+
+logger = logging.getLogger(__name__)
+
+_IN_PROGRESS = frozenset(str(s) for s in IN_PROGRESS_STATES)
+
+
+@dataclass(frozen=True)
+class InvariantViolation:
+    """One broken safety property, with everything needed to replay it."""
+
+    invariant: str
+    at: float
+    subject: str
+    detail: str
+
+    def describe(self) -> str:
+        return (f"[t={self.at:g}] INVARIANT {self.invariant} violated on "
+                f"{self.subject}: {self.detail}")
+
+
+@dataclass
+class _NodeMirror:
+    upgrade_state: str = ""
+    remediation_state: str = ""
+    unschedulable: bool = False
+    ready: bool = True
+
+
+@dataclass
+class InvariantMonitor:
+    """Event-sourced safety checker for one chaos run."""
+
+    cluster: FakeCluster
+    upgrade_keys: UpgradeKeys
+    remediation_keys: Optional[RemediationKeys] = None
+    #: Upgrade-machine availability budget (int or "N%"); None disables
+    #: the max-unavailable check (slice-planner runs).
+    max_unavailable: Optional[IntOrString] = None
+    #: Remediation availability budget; None disables its check.
+    remediation_max_unavailable: Optional[IntOrString] = None
+    #: maxParallelUpgrades; 0 disables the max-parallel check.
+    max_parallel_upgrades: int = 0
+    workload_namespace: str = "workloads"
+    trace_limit: int = 4000
+    watch_queue_bound: Optional[int] = None
+
+    violations: list[InvariantViolation] = field(default_factory=list)
+    trace: list[str] = field(default_factory=list)
+    events_seen: int = 0
+    watch_gaps: int = 0
+    cordons_seen: int = 0
+    uncordons_seen: int = 0
+
+    def __post_init__(self) -> None:
+        self._nodes: dict[str, _NodeMirror] = {}
+        self._watch = self.cluster.watch(max_queue=self.watch_queue_bound)
+        self.resync("initial sync")
+
+    # -- plumbing ---------------------------------------------------------
+    def _now(self) -> float:
+        return self.cluster.clock.now()
+
+    def _record(self, line: str) -> None:
+        self.trace.append(f"[t={self._now():g}] {line}")
+        if len(self.trace) > self.trace_limit:
+            # keep the tail; the head is summarized by its loss
+            del self.trace[:len(self.trace) - self.trace_limit]
+
+    def _violate(self, invariant: str, subject: str, detail: str) -> None:
+        violation = InvariantViolation(invariant, self._now(), subject,
+                                       detail)
+        self.violations.append(violation)
+        self._record(violation.describe())
+        logger.error("%s", violation.describe())
+
+    def resync(self, why: str) -> None:
+        """Rebuild the node mirror from a fresh list, assertion-free (a
+        stream gap hides an unknown number of intermediate states — the
+        same blind spot an informer relist has)."""
+        self._record(f"resync ({why})")
+        nodes = consume_transient(self.cluster.list_nodes)
+        fresh: dict[str, _NodeMirror] = {}
+        for node in nodes:
+            fresh[node.metadata.name] = _NodeMirror(
+                upgrade_state=node.metadata.labels.get(
+                    self.upgrade_keys.state_label, ""),
+                remediation_state=(node.metadata.labels.get(
+                    self.remediation_keys.state_label, "")
+                    if self.remediation_keys else ""),
+                unschedulable=node.is_unschedulable(),
+                ready=node.is_ready())
+        self._nodes = fresh
+
+    def drain(self) -> int:
+        """Consume every pending watch event; returns events processed.
+        Call between mutation batches (the runner does, after each
+        reconcile and each virtual-clock step)."""
+        processed = 0
+        while True:
+            if self._watch.stopped:
+                # the watch-break fault closed our stream: resubscribe
+                # and relist, like any informer whose server hung up
+                self.watch_gaps += 1
+                self._watch = self.cluster.watch(
+                    max_queue=self.watch_queue_bound)
+                self.resync("watch stream dropped")
+            event = self._watch.get(timeout=0.0)
+            if event is None:
+                if self._watch.stopped:
+                    continue  # stopped between get() calls: resubscribe
+                return processed
+            processed += 1
+            self.events_seen += 1
+            if event.type == BOOKMARK:
+                # bounded-queue overflow: events were dropped
+                self.watch_gaps += 1
+                self.resync("watch queue overflow (BOOKMARK)")
+                continue
+            if event.kind == KIND_NODE:
+                self._on_node(event.type, event.object)
+            elif event.kind == KIND_POD:
+                self._on_pod(event.type, event.object)
+
+    # -- node events ------------------------------------------------------
+    def _on_node(self, event_type: str, node) -> None:
+        name = node.metadata.name
+        if event_type == DELETED:
+            self._nodes.pop(name, None)
+            self._record(f"node {name} deleted")
+            return
+        new = _NodeMirror(
+            upgrade_state=node.metadata.labels.get(
+                self.upgrade_keys.state_label, ""),
+            remediation_state=(node.metadata.labels.get(
+                self.remediation_keys.state_label, "")
+                if self.remediation_keys else ""),
+            unschedulable=node.is_unschedulable(),
+            ready=node.is_ready())
+        old = self._nodes.get(name)
+        if old is None:
+            self._nodes[name] = new
+            self._record(f"node {name} added "
+                         f"(upgrade={new.upgrade_state or 'unknown'})")
+            return
+        if old.unschedulable != new.unschedulable:
+            if new.unschedulable:
+                self.cordons_seen += 1
+                self._record(f"node {name} cordoned")
+            else:
+                self.uncordons_seen += 1
+                self._record(f"node {name} uncordoned")
+        if old.ready != new.ready:
+            self._record(f"node {name} ready={new.ready}")
+        # commit the new mirror BEFORE budget math so counts include
+        # this very transition ("at any instant" includes the instant
+        # the admission label lands)
+        self._nodes[name] = new
+        if old.upgrade_state != new.upgrade_state:
+            self._record(f"node {name} upgrade "
+                         f"{old.upgrade_state or 'unknown'} -> "
+                         f"{new.upgrade_state or 'unknown'}")
+            self._check_upgrade_edge(name, old, new)
+        if old.remediation_state != new.remediation_state:
+            self._record(f"node {name} remediation "
+                         f"{old.remediation_state or 'healthy'} -> "
+                         f"{new.remediation_state or 'healthy'}")
+            self._check_remediation_edge(name, old, new)
+
+    def _check_upgrade_edge(self, name: str, old: _NodeMirror,
+                            new: _NodeMirror) -> None:
+        legal = LEGAL_EDGES.get(old.upgrade_state, frozenset())
+        if new.upgrade_state not in legal:
+            self._violate(
+                "legal-transition", name,
+                f"upgrade {old.upgrade_state or 'unknown'!r} -> "
+                f"{new.upgrade_state or 'unknown'!r} is not an edge of "
+                f"consts.STATE_EDGES")
+            return
+        if new.upgrade_state != str(UpgradeState.CORDON_REQUIRED):
+            return
+        if old.unschedulable:
+            return  # manual-cordon override: admission is budget-free
+        total = len(self._nodes)
+        if self.max_unavailable is not None and total:
+            budget = scaled_value_from_int_or_percent(
+                self.max_unavailable, total, round_up=True)
+            unavailable = sum(
+                1 for m in self._nodes.values()
+                if m.unschedulable or not m.ready)
+            committed = sum(
+                1 for m in self._nodes.values()
+                if m.upgrade_state == str(UpgradeState.CORDON_REQUIRED))
+            if unavailable + committed > budget:
+                self._violate(
+                    "max-unavailable", name,
+                    f"admission makes {unavailable} unavailable + "
+                    f"{committed} committed-to-cordon > budget {budget} "
+                    f"(maxUnavailable={self.max_unavailable!r}, "
+                    f"total={total})")
+        if self.max_parallel_upgrades > 0:
+            in_progress = sum(
+                1 for m in self._nodes.values()
+                if m.upgrade_state in _IN_PROGRESS)
+            if in_progress > self.max_parallel_upgrades:
+                self._violate(
+                    "max-parallel", name,
+                    f"{in_progress} upgrades in progress > "
+                    f"maxParallelUpgrades={self.max_parallel_upgrades}")
+
+    def _check_remediation_edge(self, name: str, old: _NodeMirror,
+                                new: _NodeMirror) -> None:
+        legal = REMEDIATION_LEGAL_EDGES.get(old.remediation_state,
+                                            frozenset())
+        if new.remediation_state not in legal:
+            self._violate(
+                "legal-transition", name,
+                f"remediation {old.remediation_state or 'healthy'!r} -> "
+                f"{new.remediation_state or 'healthy'!r} is not an edge "
+                f"of consts.REMEDIATION_EDGES")
+            return
+        if new.remediation_state != str(RemediationState.CORDON_REQUIRED):
+            return
+        live = new.ready and not new.unschedulable
+        if not live:
+            return  # dead nodes are budget-exempt (already unavailable)
+        total = len(self._nodes)
+        if self.remediation_max_unavailable is None or not total:
+            return
+        budget = scaled_value_from_int_or_percent(
+            self.remediation_max_unavailable, total, round_up=True)
+        unavailable = sum(1 for m in self._nodes.values()
+                          if m.unschedulable or not m.ready)
+        live_committed = sum(
+            1 for m in self._nodes.values()
+            if m.remediation_state
+            == str(RemediationState.CORDON_REQUIRED)
+            and m.ready and not m.unschedulable)
+        if unavailable + live_committed > budget:
+            self._violate(
+                "max-unavailable", name,
+                f"remediation admission makes {unavailable} unavailable "
+                f"+ {live_committed} live committed-to-cordon > budget "
+                f"{budget} (maxUnavailable="
+                f"{self.remediation_max_unavailable!r}, total={total})")
+
+    # -- pod events -------------------------------------------------------
+    def _on_pod(self, event_type: str, pod) -> None:
+        if event_type != ADDED:
+            return
+        if pod.metadata.namespace != self.workload_namespace:
+            return  # DaemonSet runtime pods legally land on cordoned nodes
+        node_name = pod.spec.node_name
+        mirror = self._nodes.get(node_name) if node_name else None
+        if mirror is None:
+            return
+        where = f"pod {pod.metadata.namespace}/{pod.metadata.name}"
+        self._record(f"{where} scheduled on {node_name}")
+        if mirror.unschedulable:
+            self._violate(
+                "workload-placement", where,
+                f"scheduled onto cordoned node {node_name}")
+        if mirror.upgrade_state in WORKLOAD_UNSAFE_STATES:
+            self._violate(
+                "workload-placement", where,
+                f"scheduled onto node {node_name} in mid-upgrade state "
+                f"{mirror.upgrade_state!r}")
+        if mirror.remediation_state in REMEDIATION_WORKLOAD_UNSAFE_STATES:
+            self._violate(
+                "workload-placement", where,
+                f"scheduled onto node {node_name} under remediation "
+                f"({mirror.remediation_state!r})")
+
+    # -- liveness ---------------------------------------------------------
+    def final_check(self) -> None:
+        """End-of-run pairing/liveness assertions against live state:
+        once the fleet converged, every cordon must have been paired
+        with an uncordon (nothing left quarantined) and no remediation
+        bookkeeping may linger."""
+        self.drain()
+        nodes = consume_transient(self.cluster.list_nodes)
+        for node in nodes:
+            name = node.metadata.name
+            if node.is_unschedulable():
+                self._violate(
+                    "cordon-pairing", name,
+                    "node left cordoned after convergence — a cordon was "
+                    "never paired with its uncordon")
+            if self.remediation_keys is not None:
+                prefix = (f"{self.remediation_keys.domain}/"
+                          f"{self.remediation_keys.driver}-remediation")
+                leftovers = sorted(
+                    key for key in node.metadata.annotations
+                    if key.startswith(prefix))
+                if leftovers:
+                    self._violate(
+                        "cordon-pairing", name,
+                        f"remediation bookkeeping annotations survived "
+                        f"convergence: {leftovers}")
+
+    def report(self, seed: Optional[int] = None,
+               trace_tail: int = 120) -> str:
+        """Human-readable violation report: the seed, every violation,
+        and the trailing event trace — everything needed to replay."""
+        header = (f"chaos run seed={seed}" if seed is not None
+                  else "chaos run")
+        lines = [f"{header}: {len(self.violations)} violation(s), "
+                 f"{self.events_seen} events, {self.watch_gaps} watch "
+                 f"gap(s), {self.cordons_seen} cordons / "
+                 f"{self.uncordons_seen} uncordons"]
+        lines += [v.describe() for v in self.violations]
+        if self.violations:
+            lines.append(f"--- trace (last {trace_tail} events; replay "
+                         f"with run_chaos_soak(seed={seed})) ---")
+            lines += self.trace[-trace_tail:]
+        return "\n".join(lines)

@@ -1,0 +1,396 @@
+"""The chaos soak runner: real state machines vs a seeded fault storm.
+
+One :func:`run_chaos_soak` call is a full deterministic episode:
+
+1. Build a virtual GKE TPU fleet (simulate.build_fleet) with a
+   multislice workload, roll the runtime DaemonSet (rollout #1), and
+   schedule a SECOND revision bump mid-horizon — write traffic is
+   guaranteed deep into the fault window, so every armed operator crash
+   detonates.
+2. Install the seed's :class:`~tpu_operator_libs.chaos.schedule.
+   FaultSchedule` via :class:`~tpu_operator_libs.chaos.injector.
+   ChaosInjector`.
+3. Tick virtual time. Each tick, the current operator *incarnation*
+   (leader-elected ClusterUpgradeStateManager + NodeRemediationManager
+   sharing a crash fuse) reconciles; faults fire between ticks; the
+   :class:`~tpu_operator_libs.chaos.invariants.InvariantMonitor`
+   drains the watch stream and asserts safety after every mutation.
+4. Operator crash–restart: when the fuse detonates mid-pass, the
+   incarnation is discarded and a brand-new one — fresh managers, fresh
+   provider, fresh elector identity, zero in-memory state — takes over
+   from node labels/annotations alone. Leader loss works the same way:
+   a stolen Lease demotes the incumbent and a fresh instance wins the
+   lock after expiry.
+5. After the last scheduled fault heals, the run must converge: every
+   node upgrade-done on the final revision, remediation-clean,
+   schedulable, Ready; every cordon paired with an uncordon.
+
+The report carries the seed, fault kinds, crash/handover counts, the
+violation list and the replay trace — rerunning the seed reproduces the
+episode exactly (the only entropy is the seed).
+"""
+
+from __future__ import annotations
+
+import logging
+from dataclasses import dataclass, field
+from typing import Optional
+
+from tpu_operator_libs.api.remediation_policy import RemediationPolicySpec
+from tpu_operator_libs.api.upgrade_policy import (
+    DrainSpec,
+    IntOrString,
+    UpgradePolicySpec,
+)
+from tpu_operator_libs.chaos.injector import (
+    ChaosInjector,
+    CrashingStateProvider,
+    OperatorCrash,
+)
+from tpu_operator_libs.chaos.invariants import (
+    InvariantMonitor,
+    InvariantViolation,
+)
+from tpu_operator_libs.chaos.schedule import FaultSchedule
+from tpu_operator_libs.consts import (
+    POD_CONTROLLER_REVISION_HASH_LABEL,
+    RemediationKeys,
+    UpgradeKeys,
+    UpgradeState,
+)
+from tpu_operator_libs.k8s.client import (
+    ApiServerError,
+    ConflictError,
+    NotFoundError,
+)
+from tpu_operator_libs.k8s.fake import FakeCluster
+from tpu_operator_libs.k8s.leaderelection import (
+    LeaderElectionConfig,
+    LeaderElector,
+)
+from tpu_operator_libs.remediation.state_machine import (
+    NodeRemediationManager,
+)
+from tpu_operator_libs.simulate import (
+    NS,
+    RUNTIME_LABELS,
+    FleetSpec,
+    build_fleet,
+    restore_workload_pods,
+)
+from tpu_operator_libs.upgrade.state_manager import (
+    BuildStateError,
+    ClusterUpgradeStateManager,
+)
+from tpu_operator_libs.util import FakeClock
+
+logger = logging.getLogger(__name__)
+
+#: Revision hashes of the two rollouts every soak performs. build_fleet
+#: already rolls "old" -> "new"; the runner bumps to FINAL_REVISION at
+#: horizon/2 so the fleet is mid-rollout when late faults land.
+FINAL_REVISION = "new2"
+
+
+@dataclass
+class ChaosConfig:
+    """Knobs of one soak episode (defaults are the tier-1 shape)."""
+
+    n_slices: int = 3
+    hosts_per_slice: int = 2
+    pod_recreate_delay: float = 5.0
+    pod_ready_delay: float = 15.0
+    reconcile_interval: float = 10.0
+    #: Fault windows live inside [0, horizon); convergence is only
+    #: checked after the horizon.
+    horizon: float = 600.0
+    #: Hard step cap (steps * reconcile_interval bounds virtual time).
+    max_steps: int = 1200
+    #: How many fault kinds ride along besides operator-crash.
+    extra_fault_kinds: int = 4
+    #: Flat-planner budgets — strict, so the monitor's max-unavailable
+    #: invariant is exact (the slice planner may legally overdraw).
+    max_unavailable: IntOrString = "50%"
+    max_parallel_upgrades: int = 0
+    lease_namespace: str = "kube-system"
+    lease_name: str = "chaos-operator-leader"
+
+    def upgrade_policy(self) -> UpgradePolicySpec:
+        return UpgradePolicySpec(
+            auto_upgrade=True,
+            max_parallel_upgrades=self.max_parallel_upgrades,
+            max_unavailable=self.max_unavailable,
+            topology_mode="flat",
+            drain=DrainSpec(enable=True, force=True,
+                            timeout_seconds=300))
+
+    def remediation_policy(self) -> RemediationPolicySpec:
+        policy = RemediationPolicySpec(
+            enable=True,
+            max_concurrent=1,
+            max_unavailable="50%",
+            restart_attempts=1,
+            max_attempts=4,
+            action_timeout_seconds=300,
+            settle_seconds=60,
+            revalidate_timeout_seconds=600,
+            drain=DrainSpec(enable=True, force=True,
+                            timeout_seconds=240))
+        policy.detection.not_ready_grace_seconds = 120
+        return policy
+
+
+@dataclass
+class ChaosReport:
+    """Outcome of one seeded soak episode."""
+
+    seed: int
+    converged: bool
+    violations: list[InvariantViolation]
+    fault_kinds: tuple[str, ...]
+    crashes_fired: int
+    leader_handovers: int
+    operator_incarnations: int
+    watch_gaps: int
+    total_seconds: float
+    steps: int
+    reconciles: int
+    report_text: str = ""
+    trace: list[str] = field(default_factory=list)
+
+    @property
+    def ok(self) -> bool:
+        return self.converged and not self.violations
+
+    def summary(self) -> str:
+        verdict = "OK" if self.ok else "FAILED"
+        return (f"chaos seed={self.seed}: {verdict} — "
+                f"{len(self.fault_kinds)} fault kinds "
+                f"{sorted(self.fault_kinds)}, "
+                f"{self.crashes_fired} operator crash(es), "
+                f"{self.leader_handovers} leader handover(s), "
+                f"{self.watch_gaps} watch gap(s), "
+                f"{len(self.violations)} violation(s), "
+                f"converged={self.converged} in {self.total_seconds:g}s "
+                f"virtual / {self.steps} steps / "
+                f"{self.reconciles} reconciles")
+
+
+class _OperatorIncarnation:
+    """One operator process-lifetime: fresh managers, fresh elector.
+
+    Everything here is rebuilt from scratch on crash/demotion — the ONLY
+    state that survives an incarnation is what lives on the cluster
+    (node labels, annotations, the Lease), which is precisely the
+    durability claim the harness proves.
+    """
+
+    def __init__(self, cluster: FakeCluster, clock: FakeClock,
+                 keys: UpgradeKeys, rem_keys: RemediationKeys,
+                 config: ChaosConfig, injector: ChaosInjector,
+                 identity: str) -> None:
+        provider = CrashingStateProvider(
+            cluster, keys, None, clock, sync_timeout=5.0,
+            poll_interval=1.0, fuse=injector.fuse)
+        self.upgrade = ClusterUpgradeStateManager(
+            cluster, keys, clock=clock, async_workers=False,
+            provider=provider, poll_interval=1.0, sync_timeout=5.0)
+        rem_provider = CrashingStateProvider(
+            cluster, rem_keys, None, clock,  # type: ignore[arg-type]
+            sync_timeout=5.0, poll_interval=1.0, fuse=injector.fuse)
+        self.remediation = NodeRemediationManager(
+            cluster, rem_keys, upgrade_keys=keys, clock=clock,
+            provider=rem_provider, poll_interval=1.0, sync_timeout=5.0)
+        self.elector = LeaderElector(
+            cluster,
+            LeaderElectionConfig(
+                namespace=config.lease_namespace, name=config.lease_name,
+                identity=identity, lease_duration=30.0,
+                renew_deadline=20.0, retry_period=2.0),
+            clock=clock)
+        self.identity = identity
+
+
+def run_chaos_soak(seed: int,
+                   config: Optional[ChaosConfig] = None) -> ChaosReport:
+    """Run one seeded chaos episode; deterministic in ``seed``."""
+    config = config or ChaosConfig()
+    fleet = FleetSpec(
+        n_slices=config.n_slices,
+        hosts_per_slice=config.hosts_per_slice,
+        pod_recreate_delay=config.pod_recreate_delay,
+        pod_ready_delay=config.pod_ready_delay,
+        multislice_jobs=(
+            ("chaos-job", tuple(range(config.n_slices))),))
+    cluster, clock, keys = build_fleet(fleet)
+    rem_keys = RemediationKeys()
+    node_names = [n.metadata.name for n in cluster.list_nodes()]
+
+    schedule = FaultSchedule.generate(
+        seed, node_names, horizon=config.horizon,
+        extra_kinds=config.extra_fault_kinds)
+    injector = ChaosInjector(cluster, schedule,
+                             lease_namespace=config.lease_namespace,
+                             lease_name=config.lease_name)
+    injector.install()
+    # rollout #2 mid-horizon: guarantees write traffic after every
+    # armed crash, and lands late faults on a mid-rollout fleet
+    cluster.schedule_at(
+        config.horizon / 2.0,
+        lambda: cluster.bump_daemon_set_revision(NS, "libtpu",
+                                                 FINAL_REVISION))
+
+    upgrade_policy = config.upgrade_policy()
+    remediation_policy = config.remediation_policy()
+    monitor = InvariantMonitor(
+        cluster=cluster, upgrade_keys=keys, remediation_keys=rem_keys,
+        max_unavailable=upgrade_policy.max_unavailable,
+        remediation_max_unavailable=remediation_policy.max_unavailable,
+        max_parallel_upgrades=config.max_parallel_upgrades)
+
+    incarnations = 1
+    handovers = 0
+    reconciles = 0
+    op = _OperatorIncarnation(cluster, clock, keys, rem_keys, config,
+                              injector, identity="operator-1")
+
+    def next_incarnation(reason: str) -> _OperatorIncarnation:
+        nonlocal incarnations
+        incarnations += 1
+        injector.fuse.reset()
+        monitor.trace.append(
+            f"[t={clock.now():g}] operator restart #{incarnations} "
+            f"({reason}) — rebuilding managers from cluster state alone")
+        return _OperatorIncarnation(
+            cluster, clock, keys, rem_keys, config, injector,
+            identity=f"operator-{incarnations}")
+
+    def converged() -> bool:
+        try:
+            nodes = cluster.list_nodes()
+            pods = cluster.list_pods(namespace=NS)
+        except (ApiServerError, TimeoutError):
+            return False
+        if len(nodes) != len(node_names):
+            return False
+        for node in nodes:
+            labels = node.metadata.labels
+            if labels.get(keys.state_label) != str(UpgradeState.DONE):
+                return False
+            if labels.get(rem_keys.state_label, ""):
+                return False
+            if keys.skip_label in labels:
+                return False
+            if node.is_unschedulable() or not node.is_ready():
+                return False
+        runtime = [p for p in pods
+                   if p.controller_owner() is not None]
+        if len(runtime) != len(node_names):
+            return False
+        return all(
+            p.metadata.labels.get(POD_CONTROLLER_REVISION_HASH_LABEL)
+            == FINAL_REVISION and p.is_ready() for p in runtime)
+
+    steps = 0
+    is_converged = False
+    quiesce_ticks = 0
+    while steps < config.max_steps:
+        steps += 1
+        now = clock.now()
+        was_leading = op.elector.is_leader
+        op.elector.try_acquire_or_renew()
+        if was_leading and not op.elector.is_leader:
+            # demoted: a live intruder holds the Lease. The incumbent
+            # stops reconciling ON THIS TICK (split-brain safety); a
+            # fresh instance contends and resumes from labels once the
+            # intruder's lease expires.
+            handovers += 1
+            op = next_incarnation("leader election lost")
+            op.elector.try_acquire_or_renew()
+        if op.elector.is_leader:
+            injector.arm_due_crashes(now)
+            try:
+                op.remediation.reconcile(NS, dict(RUNTIME_LABELS),
+                                         remediation_policy)
+                op.upgrade.reconcile(NS, dict(RUNTIME_LABELS),
+                                     upgrade_policy)
+                reconciles += 1
+            except OperatorCrash:
+                op = next_incarnation("operator crash mid-reconcile")
+            except BuildStateError:
+                pass  # incomplete snapshot; next tick retries
+            except (ApiServerError, ConflictError, NotFoundError):
+                pass  # pass aborted on a transient; next tick retries
+            if injector.fuse.pending:
+                # the crash was swallowed by a broad handler somewhere
+                # down the stack — the process is still "dead"
+                op = next_incarnation("operator crash (surfaced late)")
+        monitor.drain()
+        try:
+            restore_workload_pods(cluster, fleet)
+        except (ApiServerError, TimeoutError):
+            pass  # injected fault; the JobSet controller retries too
+        monitor.drain()
+        if (now > schedule.last_fault_time
+                and not injector.fuse.armed
+                and not injector.fuse.pending
+                and converged()):
+            # Converged — but a real operator keeps reconciling in
+            # steady state, and the machines clear residual bookkeeping
+            # (e.g. a wedge debounce stamp frozen while the node was
+            # mid-upgrade) on exactly those quiet passes. Run two of
+            # them before the final annotation/pairing audit so the
+            # audit measures the system, not the harness's stop timing.
+            quiesce_ticks += 1
+            if quiesce_ticks >= 3:
+                is_converged = True
+                break
+        else:
+            quiesce_ticks = 0
+        clock.advance(config.reconcile_interval)
+        cluster.step()
+        monitor.drain()
+
+    if is_converged:
+        monitor.final_check()
+    else:
+        monitor.violations.append(InvariantViolation(
+            invariant="liveness", at=clock.now(), subject="fleet",
+            detail=f"fleet did not converge within {config.max_steps} "
+                   f"steps ({clock.now():g}s virtual) after the last "
+                   f"fault healed at {schedule.last_fault_time:g}s"))
+
+    # sanity: the harness itself must have exercised what it claims
+    if injector.crashes_fired == 0:
+        monitor.violations.append(InvariantViolation(
+            invariant="harness", at=clock.now(), subject="injector",
+            detail="no operator crash fired — the schedule's crash "
+                   "events never detonated"))
+
+    report = ChaosReport(
+        seed=seed,
+        converged=is_converged,
+        violations=list(monitor.violations),
+        fault_kinds=tuple(sorted(schedule.kinds)),
+        crashes_fired=injector.crashes_fired,
+        leader_handovers=handovers,
+        operator_incarnations=incarnations,
+        watch_gaps=monitor.watch_gaps,
+        total_seconds=clock.now(),
+        steps=steps,
+        reconciles=reconciles,
+        trace=list(monitor.trace))
+    report.report_text = "\n".join(
+        [schedule.describe(), monitor.report(seed=seed)])
+    if not report.ok:
+        logger.error("%s", report.report_text)
+    return report
+
+
+def run_many(seeds: "list[int]",
+             config: Optional[ChaosConfig] = None) -> "list[ChaosReport]":
+    """Convenience sweep used by ``make test-chaos`` and the soak test."""
+    reports = [run_chaos_soak(seed, config) for seed in seeds]
+    for report in reports:
+        logger.info("%s", report.summary())
+    return reports

@@ -1,0 +1,194 @@
+"""Seeded fault schedules: the deterministic "what breaks when" plan.
+
+A :class:`FaultSchedule` is a pure value derived from ``(seed, fleet)``
+— generating it twice yields byte-identical event lists, which is the
+whole replay story: a failing soak prints its seed, and re-running that
+seed reproduces the exact interleaving (virtual time has no other
+entropy source).
+
+Fault catalog (compound by construction — windows overlap):
+
+- ``api-error-burst``: N consecutive calls of one API operation fail
+  with a transient 5xx/429 (FakeCluster.inject_api_errors).
+- ``watch-break``: every open watch stream is dropped; consumers must
+  resubscribe + relist (FakeCluster.drop_watch_streams).
+- ``stale-reads``: the next K reads of one node return a pre-patch
+  snapshot (controller-runtime cache lag).
+- ``notready-flap``: a node's Ready condition flips False, healing
+  after the window (kubelet outage; long flaps cross the remediation
+  grace and trigger quarantine).
+- ``crashloop``: runtime pods recreated on a node stay crash-looping
+  until the window closes (bad driver load).
+- ``pdb-block``: evictions of workload pods are refused (API 429,
+  PodDisruptionBudget semantics) for the window; windows are kept
+  shorter than the drain timeout so drains ride them out.
+- ``leader-loss``: the operator's Lease is overwritten server-side; the
+  incumbent demotes and a fresh instance must win the lock and resume
+  from node labels.
+- ``operator-crash``: the operator process dies mid-reconcile after a
+  seed-chosen number of durable writes (before or after the commit),
+  and is rebuilt from cluster state alone.
+"""
+
+from __future__ import annotations
+
+import random
+from dataclasses import dataclass
+
+FAULT_API_BURST = "api-error-burst"
+FAULT_WATCH_BREAK = "watch-break"
+FAULT_STALE_READS = "stale-reads"
+FAULT_NOT_READY_FLAP = "notready-flap"
+FAULT_CRASHLOOP = "crashloop"
+FAULT_PDB_BLOCK = "pdb-block"
+FAULT_LEADER_LOSS = "leader-loss"
+FAULT_OPERATOR_CRASH = "operator-crash"
+
+#: The full catalog, in deterministic order (generation samples from it).
+FAULT_KINDS = (
+    FAULT_API_BURST,
+    FAULT_WATCH_BREAK,
+    FAULT_STALE_READS,
+    FAULT_NOT_READY_FLAP,
+    FAULT_CRASHLOOP,
+    FAULT_PDB_BLOCK,
+    FAULT_LEADER_LOSS,
+    FAULT_OPERATOR_CRASH,
+)
+
+#: Operations the api-burst fault may target. Write ops plus the reads
+#: the managers issue per pass; deliberately excludes nothing the
+#: machines call — convergence through bursts on any of these is the
+#: point.
+API_BURST_OPERATIONS = (
+    "get_node",
+    "list_nodes",
+    "list_pods",
+    "patch_node_labels",
+    "patch_node_annotations",
+    "set_node_unschedulable",
+    "delete_pod",
+    "evict_pod",
+    "list_daemon_sets",
+    "list_controller_revisions",
+)
+
+
+@dataclass(frozen=True)
+class FaultEvent:
+    """One scheduled fault.
+
+    ``at``/``until`` are virtual seconds; ``until`` is 0 for point
+    faults. ``target`` is a node name or API operation (kind-dependent);
+    ``param`` carries the kind-specific magnitude (error count, stale
+    reads, crash write budget).
+    """
+
+    at: float
+    kind: str
+    target: str = ""
+    until: float = 0.0
+    param: int = 0
+
+    def describe(self) -> str:
+        window = f"..{self.until:g}" if self.until else ""
+        target = f" {self.target}" if self.target else ""
+        param = f" x{self.param}" if self.param else ""
+        return f"[t={self.at:g}{window}] {self.kind}{target}{param}"
+
+
+@dataclass(frozen=True)
+class FaultSchedule:
+    """A seed plus its deterministic event list."""
+
+    seed: int
+    events: tuple[FaultEvent, ...]
+
+    @property
+    def kinds(self) -> frozenset[str]:
+        return frozenset(e.kind for e in self.events)
+
+    def by_kind(self, kind: str) -> tuple[FaultEvent, ...]:
+        return tuple(e for e in self.events if e.kind == kind)
+
+    @property
+    def last_fault_time(self) -> float:
+        """Virtual time after which no scheduled fault is active — the
+        runner's convergence check only arms past this point."""
+        return max((max(e.at, e.until) for e in self.events), default=0.0)
+
+    def describe(self) -> str:
+        lines = [f"fault schedule (seed={self.seed}):"]
+        lines += [f"  {e.describe()}" for e in self.events]
+        return "\n".join(lines)
+
+    @classmethod
+    def generate(cls, seed: int, node_names: list[str],
+                 horizon: float = 600.0,
+                 extra_kinds: int = 4) -> "FaultSchedule":
+        """Derive the schedule for ``seed`` over ``node_names``.
+
+        Always includes at least one ``operator-crash`` (the capability
+        this harness exists to prove) plus ``extra_kinds`` further fault
+        kinds sampled from the catalog, every window placed inside
+        ``[0, horizon)`` so overlap — compound failure — is the common
+        case, not the exception.
+        """
+        if not node_names:
+            raise ValueError("node_names must be non-empty")
+        rng = random.Random(f"chaos-schedule:{seed}")
+        nodes = sorted(node_names)
+        events: list[FaultEvent] = []
+
+        # One or two operator crashes, always. Kept inside the first 45%
+        # of the horizon so the runner's mid-run rollout (scheduled at
+        # horizon/2) guarantees durable-write traffic AFTER every crash
+        # arms — an armed crash must always detonate, never expire
+        # silently on an already-quiet fleet.
+        for _ in range(rng.randint(1, 2)):
+            events.append(FaultEvent(
+                at=rng.uniform(0.1, horizon * 0.45),
+                kind=FAULT_OPERATOR_CRASH,
+                # writes allowed before the crash fires; parity decides
+                # crash-before vs crash-after the durable commit
+                param=rng.randint(0, 8)))
+
+        pool = [k for k in FAULT_KINDS if k != FAULT_OPERATOR_CRASH]
+        chosen = rng.sample(pool, min(extra_kinds, len(pool)))
+        for kind in chosen:
+            for _ in range(rng.randint(1, 2)):
+                start = rng.uniform(0.1, horizon * 0.8)
+                if kind == FAULT_API_BURST:
+                    events.append(FaultEvent(
+                        at=start, kind=kind,
+                        target=rng.choice(API_BURST_OPERATIONS),
+                        param=rng.randint(1, 4)))
+                elif kind == FAULT_WATCH_BREAK:
+                    events.append(FaultEvent(at=start, kind=kind))
+                elif kind == FAULT_STALE_READS:
+                    events.append(FaultEvent(
+                        at=start, kind=kind, target=rng.choice(nodes),
+                        param=rng.randint(1, 3)))
+                elif kind == FAULT_NOT_READY_FLAP:
+                    # short flaps self-heal inside the detection grace;
+                    # long ones cross it and exercise the remediation
+                    # ladder — both arise across seeds
+                    events.append(FaultEvent(
+                        at=start, kind=kind, target=rng.choice(nodes),
+                        until=start + rng.uniform(40.0, 260.0)))
+                elif kind == FAULT_CRASHLOOP:
+                    events.append(FaultEvent(
+                        at=start, kind=kind, target=rng.choice(nodes),
+                        until=start + rng.uniform(60.0, 240.0)))
+                elif kind == FAULT_PDB_BLOCK:
+                    # strictly shorter than any drain timeout in use so
+                    # a blocked drain rides the window out instead of
+                    # hard-failing the node (chaos proves convergence
+                    # THROUGH the block, not that blocks strand nodes)
+                    events.append(FaultEvent(
+                        at=start, kind=kind,
+                        until=start + rng.uniform(20.0, 110.0)))
+                elif kind == FAULT_LEADER_LOSS:
+                    events.append(FaultEvent(at=start, kind=kind))
+        events.sort(key=lambda e: (e.at, e.kind, e.target))
+        return cls(seed=seed, events=tuple(events))

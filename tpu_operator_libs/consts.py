@@ -152,6 +152,8 @@ STATE_EDGES: tuple[tuple[UpgradeState, UpgradeState, str], ...] = (
      "pod healthy again [validated] (was schedulable)"),
     (UpgradeState.FAILED, UpgradeState.DONE,
      "pod healthy again [validated] (was cordoned before upgrade)"),
+    (UpgradeState.FAILED, UpgradeState.DRAIN_REQUIRED,
+     "pod healthy but OUTDATED (new DS revision while failed)"),
 )
 
 #: Adjacency view of STATE_EDGES, keyed by label value ("" = unknown).
@@ -159,6 +161,22 @@ LEGAL_EDGES: dict[str, frozenset[str]] = {
     src: frozenset(d.value for s, d, _ in STATE_EDGES if s.value == src)
     for src in {s.value for s, _, _ in STATE_EDGES}
 }
+
+#: Upgrade states in which a node must not receive NEW workload pods:
+#: from wait-for-jobs onward the node's runtime is being (or about to
+#: be) torn down, and the machine guarantees the node is cordoned for
+#: that whole window (cordon precedes wait-for-jobs; uncordon follows
+#: validation). The chaos harness's InvariantMonitor asserts the
+#: guarantee — a workload pod landing on a node in one of these states
+#: means a cordon was lost or an uncordon fired early.
+WORKLOAD_UNSAFE_STATES = frozenset(str(s) for s in (
+    UpgradeState.WAIT_FOR_JOBS_REQUIRED,
+    UpgradeState.POD_DELETION_REQUIRED,
+    UpgradeState.DRAIN_REQUIRED,
+    UpgradeState.POD_RESTART_REQUIRED,
+    UpgradeState.VALIDATION_REQUIRED,
+    UpgradeState.FAILED,
+))
 
 class RemediationState(str, enum.Enum):
     """Per-node states of the UNPLANNED-fault (auto-remediation) machine.
@@ -284,6 +302,18 @@ REMEDIATION_LEGAL_EDGES: dict[str, frozenset[str]] = {
                    if s.value == src)
     for src in {s.value for s, _, _ in REMEDIATION_EDGES}
 }
+
+#: Remediation states in which a node must not receive NEW workload
+#: pods: recovery actions (drain/restart/reboot/revalidate) run only on
+#: a quarantined node — the machine cordons at admission and uncordons
+#: only after revalidation passes. Dual of WORKLOAD_UNSAFE_STATES, used
+#: by the chaos InvariantMonitor.
+REMEDIATION_WORKLOAD_UNSAFE_STATES = frozenset(str(s) for s in (
+    RemediationState.DRAIN_REQUIRED,
+    RemediationState.RESTART_REQUIRED,
+    RemediationState.REBOOT_REQUIRED,
+    RemediationState.REVALIDATE_REQUIRED,
+))
 
 #: Label key whose presence identifies a TPU node on GKE.
 TPU_RESOURCE_NAME = "google.com/tpu"

@@ -29,12 +29,13 @@ from __future__ import annotations
 
 import heapq
 import logging
+import random
 import threading
 import time
 from dataclasses import dataclass
 from typing import TYPE_CHECKING, Callable, Optional
 
-from tpu_operator_libs.k8s.watch import DELETED, Watch, WatchEvent
+from tpu_operator_libs.k8s.watch import BOOKMARK, DELETED, Watch, WatchEvent
 
 if TYPE_CHECKING:
     from tpu_operator_libs.metrics import MetricsRegistry
@@ -53,18 +54,31 @@ def _cluster_key_fn(_event: "WatchEvent") -> str:
 
 
 class ExponentialBackoffRateLimiter:
-    """Per-key exponential backoff: base * 2^retries, capped.
+    """Per-key exponential backoff: base * 2^retries, capped + jittered.
 
     Defaults match client-go's item-bucket limiter (5 ms base, 16 m 40 s
     cap is client-go's 1000 s; we default the cap lower because driver
     upgrades re-reconcile anyway on the next event).
+
+    ``jitter`` randomizes that fraction of each delay (AWS "full jitter"
+    at the default 1.0: delay ~ U(0, base*2^n]). A purely deterministic
+    schedule synchronizes every failed key — and, worse, every replica
+    of the operator fleet retrying the same outage — into aligned retry
+    waves that thundering-herd the apiserver exactly when it is least
+    healthy. Pass ``jitter=0.0`` for the deterministic schedule (tests).
     """
 
-    def __init__(self, base: float = 0.005, max_delay: float = 60.0) -> None:
+    def __init__(self, base: float = 0.005, max_delay: float = 60.0,
+                 jitter: float = 1.0,
+                 rng: Optional[random.Random] = None) -> None:
         if base <= 0:
             raise ValueError("base must be positive")
+        if not 0.0 <= jitter <= 1.0:
+            raise ValueError("jitter must be in [0, 1]")
         self._base = base
         self._max = max_delay
+        self._jitter = jitter
+        self._rng = rng or random.Random()
         self._retries: dict[str, int] = {}
         self._lock = threading.Lock()
 
@@ -73,7 +87,11 @@ class ExponentialBackoffRateLimiter:
         with self._lock:
             n = self._retries.get(key, 0)
             self._retries[key] = n + 1
-        return min(self._base * (2 ** n), self._max)
+            delay = min(self._base * (2 ** n), self._max)
+            if self._jitter:
+                # rng under the lock: random.Random is not thread-safe
+                delay *= 1.0 - self._jitter * self._rng.random()
+        return delay
 
     def forget(self, key: str) -> None:
         with self._lock:
@@ -283,6 +301,14 @@ class Informer:
         self._synced.set()
         for event in self._watch:
             try:
+                if event.type == BOOKMARK:
+                    # a bounded watch dropped events on overflow: the
+                    # cache may have missed adds/updates/deletes — only
+                    # a relist repairs it
+                    logger.warning("%s: watch overflow bookmark; "
+                                   "relisting", self._name)
+                    self.refresh()
+                    continue
                 self._apply(event)
             except Exception:
                 # one malformed event must not freeze the cache forever
@@ -579,6 +605,11 @@ class Controller:
         for event in watch:
             if self._stop.is_set():
                 return
+            if event.type == BOOKMARK and key_fn is not _cluster_key_fn:
+                # overflow marker carries no object, so a per-object key
+                # function cannot resolve it; the resync timer remains
+                # the repair path for those controllers
+                continue
             try:
                 key = key_fn(event)
             except Exception:
@@ -614,11 +645,18 @@ class Controller:
             started = time.monotonic()
             try:
                 result = self._reconcile(key)
-            except Exception:
+            except Exception as exc:
                 with self._count_lock:
                     self._reconcile_count += 1
                     self._error_count += 1
                 delay = self._limiter.when(key)
+                # An apiserver that answered 429 with Retry-After has
+                # told us exactly when it wants the retry; coming back
+                # sooner just feeds the throttle (the typed error carries
+                # the header, k8s.client.ApiServerError.retry_after).
+                retry_after = getattr(exc, "retry_after", None)
+                if retry_after is not None and retry_after > delay:
+                    delay = float(retry_after)
                 logger.exception("reconcile %r failed; retrying in %.3fs",
                                  key, delay)
                 self.queue.done(key)

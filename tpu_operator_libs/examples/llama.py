@@ -236,7 +236,14 @@ def forward(params, tokens, config: LlamaConfig, mesh=None):
         if mesh is None or "sp" not in mesh.axis_names:
             raise ValueError(
                 "attention_impl='ring' needs a mesh with an 'sp' axis")
-        from jax import shard_map
+        try:
+            from jax import shard_map
+        except ImportError:  # pre-0.7 jax: experimental location
+            from functools import partial as _partial
+
+            from jax.experimental.shard_map import shard_map as _shard_map
+
+            shard_map = _partial(_shard_map, check_rep=False)
         from tpu_operator_libs.examples.ring_attention import (
             ring_attention,
         )

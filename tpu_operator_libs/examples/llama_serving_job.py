@@ -76,6 +76,14 @@ class DecodeServer:
         self.quantize_kv = quantize_kv
         self.parked = 0
         self._lock = threading.Lock()
+        # One multi-device computation in flight at a time: concurrent
+        # sharded executions from several Python threads can interleave
+        # their per-device collective steps on the CPU backend's shared
+        # pool and deadlock (observed as worker threads parked forever
+        # in __array__ under suite load). The slice is one device set —
+        # serializing dispatch models real contention; admission and
+        # drain stay concurrent (try_begin/finish are outside the lock).
+        self._dispatch_lock = threading.Lock()
 
     def handle(self, prompt, key=None):
         import numpy as np
@@ -89,11 +97,12 @@ class DecodeServer:
                 self.parked += 1
             return None
         try:
-            out = generate_on_device(
-                self.params, prompt, self.config, self.mesh,
-                self.max_new_tokens, temperature=self.temperature,
-                key=key, quantize_kv=self.quantize_kv)
-            return np.asarray(out)
+            with self._dispatch_lock:
+                out = generate_on_device(
+                    self.params, prompt, self.config, self.mesh,
+                    self.max_new_tokens, temperature=self.temperature,
+                    key=key, quantize_kv=self.quantize_kv)
+                return np.asarray(out)
         finally:
             try:
                 self.endpoint.finish()

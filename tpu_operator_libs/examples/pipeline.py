@@ -70,6 +70,10 @@ def pipeline_forward(params_local, microbatches, axis_name: str,
     zero = jnp.zeros_like(microbatches[0])
 
     def varying(x):
+        if not hasattr(lax, "pcast"):
+            # pre-0.7 jax has no varying-type system (and its shard_map
+            # runs with check_rep=False here) — identity is correct
+            return x
         return lax.pcast(x, axis_name, to="varying")
 
     outputs0 = varying(jnp.zeros_like(microbatches))
@@ -116,7 +120,16 @@ def make_pipeline(mesh, axis_name: str = "pp"):
     returns the same full output (only the last stage's contribution is
     non-zero)."""
     import jax
-    from jax import shard_map
+    try:
+        from jax import shard_map
+    except ImportError:  # pre-0.7 jax: experimental location
+        from functools import partial as _partial
+
+        from jax.experimental.shard_map import shard_map as _shard_map
+
+        # check_rep rejects valid rep types around lax.cond on old jax
+        # (the check no longer exists upstream); disable, same semantics
+        shard_map = _partial(_shard_map, check_rep=False)
     from jax.sharding import NamedSharding, PartitionSpec as P
 
     axis_size = mesh.shape[axis_name]

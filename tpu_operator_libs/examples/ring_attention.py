@@ -131,7 +131,16 @@ def make_ring_attention(mesh, axis_name: str = "sp",
     ``shard_map``.
     """
     import jax
-    from jax import shard_map
+    try:
+        from jax import shard_map
+    except ImportError:  # pre-0.7 jax: experimental location
+        from functools import partial as _partial
+
+        from jax.experimental.shard_map import shard_map as _shard_map
+
+        # check_rep rejects valid rep types around lax.cond on old jax
+        # (the check no longer exists upstream); disable, same semantics
+        shard_map = _partial(_shard_map, check_rep=False)
     from jax.sharding import NamedSharding, PartitionSpec as P
 
     axis_size = mesh.shape[axis_name]

@@ -121,7 +121,16 @@ def fabric_probe(mesh: Optional["jax.sharding.Mesh"] = None,
     """
     import jax
     import jax.numpy as jnp
-    from jax import shard_map
+    try:
+        from jax import shard_map
+    except ImportError:  # pre-0.7 jax: experimental location
+        from functools import partial as _partial
+
+        from jax.experimental.shard_map import shard_map as _shard_map
+
+        # check_rep rejects valid rep types around lax.cond on old jax
+        # (the check no longer exists upstream); disable, same semantics
+        shard_map = _partial(_shard_map, check_rep=False)
     from jax.sharding import PartitionSpec as P
 
     if mesh is None:
@@ -207,7 +216,17 @@ def fabric_bandwidth_probe(mesh: Optional["jax.sharding.Mesh"] = None,
     """
     import jax
     import jax.numpy as jnp
-    from jax import lax, shard_map
+    from jax import lax
+    try:
+        from jax import shard_map
+    except ImportError:  # pre-0.7 jax: experimental location
+        from functools import partial as _partial
+
+        from jax.experimental.shard_map import shard_map as _shard_map
+
+        # check_rep rejects valid rep types around lax.cond on old jax
+        # (the check no longer exists upstream); disable, same semantics
+        shard_map = _partial(_shard_map, check_rep=False)
     from jax.sharding import PartitionSpec as P
 
     if mesh is None:

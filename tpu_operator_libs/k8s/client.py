@@ -114,9 +114,19 @@ class K8sClient(abc.ABC):
 
 
 class ApiServerError(RuntimeError):
-    """Transient apiserver failure (5xx / connection-reset analogue).
-    Retryable: the reference aborts the ApplyState pass and relies on
-    re-reconcile (upgrade_state.go:420-423)."""
+    """Transient apiserver failure (5xx / non-eviction 429 /
+    connection-reset analogue). Retryable: the reference aborts the
+    ApplyState pass and relies on re-reconcile (upgrade_state.go:420-423).
+
+    ``retry_after``: seconds the server asked the client to wait before
+    retrying (a 429/503 ``Retry-After`` header), or None. Retry loops
+    honor it as a floor on their backoff delay
+    (controller.Controller._worker)."""
+
+    def __init__(self, *args: object,
+                 retry_after: "Optional[float]" = None) -> None:
+        super().__init__(*args)
+        self.retry_after = retry_after
 
 
 class EvictionBlockedError(RuntimeError):

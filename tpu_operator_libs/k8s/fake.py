@@ -146,18 +146,30 @@ class FakeCluster(K8sClient):
         self._broadcaster = WatchBroadcaster()
 
     def watch(self, kinds: Optional[set[str]] = None,
-              namespace: Optional[str] = None) -> Watch:
+              namespace: Optional[str] = None,
+              max_queue: Optional[int] = None) -> Watch:
         """Subscribe to change events, optionally filtered to a kind set
         ({"Node", "Pod", "DaemonSet"}) and — for namespaced kinds — a
         namespace. Snapshot copies only. Signature matches
-        RealCluster.watch so consumers are backend-agnostic."""
+        RealCluster.watch so consumers are backend-agnostic.
+        ``max_queue`` bounds the subscriber's buffer (overflow drops
+        events and delivers a BOOKMARK resync marker, k8s.watch.Watch)."""
         predicate = None
         if namespace:
             def predicate(event):
                 meta = getattr(event.object, "metadata", None)
                 ns = getattr(meta, "namespace", "")
                 return not ns or ns == namespace
-        return self._broadcaster.subscribe(kinds, predicate)
+        return self._broadcaster.subscribe(kinds, predicate,
+                                           max_queue=max_queue)
+
+    def drop_watch_streams(self) -> int:
+        """Fault injection: close every open watch stream, the way a real
+        apiserver drops watch connections (timeouts, resourceVersion
+        compaction). Each consumer observes its Watch as stopped and must
+        resubscribe + relist — the informer-relist path. Returns the
+        number of streams dropped."""
+        return self._broadcaster.drop_all()
 
     def _notify(self, event_type: str, kind: str, obj) -> None:
         self._broadcaster.notify(event_type, kind, obj.clone())
@@ -356,9 +368,24 @@ class FakeCluster(K8sClient):
     def set_pod_ready_gate(self, gate: Optional[Callable[[Pod], bool]]) -> None:
         """Fault injection: recreated DS pods become Ready only when
         ``gate(pod)`` returns True; until then they crash-loop (not ready,
-        restart count above the failure threshold)."""
+        restart count above the failure threshold). Replaces any gate
+        already installed; use :meth:`add_pod_ready_gate` to compose."""
         with self._lock:
             self._pod_ready_gate = gate
+
+    def add_pod_ready_gate(self, gate: Callable[[Pod], bool]) -> None:
+        """Compose ``gate`` with any existing readiness gate (logical
+        AND): a recreated pod becomes Ready only when every installed
+        gate approves. Lets independent fault sources (a FleetSpec
+        crashloop window and a chaos injector, say) coexist without
+        silently replacing each other."""
+        with self._lock:
+            existing = self._pod_ready_gate
+            if existing is None:
+                self._pod_ready_gate = gate
+            else:
+                self._pod_ready_gate = (
+                    lambda pod, a=existing, b=gate: a(pod) and b(pod))
 
     def inject_api_errors(self, operation: str, count: int,
                           exc_factory: Optional[Callable[[], Exception]]
@@ -524,6 +551,16 @@ class FakeCluster(K8sClient):
                     NodeCondition("Ready", "True" if ready else "False"))
             self._notify(MODIFIED, KIND_NODE, node)
             return node.clone()
+
+    def flap_node_ready(self, name: str, down_at: float,
+                        up_at: float) -> None:
+        """Fault injection: schedule a NotReady flap — the node's Ready
+        condition flips False at ``down_at`` and back True at ``up_at``
+        (virtual seconds, fired by :meth:`step`)."""
+        if up_at <= down_at:
+            raise ValueError("up_at must be after down_at")
+        self.schedule_at(down_at, lambda: self.set_node_ready(name, False))
+        self.schedule_at(up_at, lambda: self.set_node_ready(name, True))
 
     def set_node_condition(self, name: str, condition_type: str,
                            status: str) -> Node:
@@ -934,6 +971,34 @@ class FakeCluster(K8sClient):
             stored = lease.clone()
             stored.metadata.resource_version = 1
             self._leases[key] = stored
+            return stored.clone()
+
+    def steal_lease(self, namespace: str, name: str, holder: str,
+                    lease_duration_seconds: int = 15) -> Lease:
+        """Fault injection: overwrite the lease holder server-side,
+        bypassing the optimistic-concurrency check — what the current
+        leader observes when another contender legitimately won the lock
+        during a partition it could not see. Creates the lease when
+        absent. The victim's next renew hits a ConflictError (its
+        resourceVersion is stale) and it steps down."""
+        with self._lock:
+            stored = self._leases.get((namespace, name))
+            now = self._clock.now()
+            if stored is None:
+                stored = Lease(
+                    metadata=ObjectMeta(name=name, namespace=namespace),
+                    holder_identity=holder,
+                    lease_duration_seconds=lease_duration_seconds,
+                    acquire_time=now, renew_time=now, lease_transitions=0)
+                stored.metadata.resource_version = 1
+                self._leases[(namespace, name)] = stored
+            else:
+                stored.holder_identity = holder
+                stored.lease_duration_seconds = lease_duration_seconds
+                stored.acquire_time = now
+                stored.renew_time = now
+                stored.lease_transitions += 1
+                stored.metadata.resource_version += 1
             return stored.clone()
 
     def update_lease(self, lease: Lease) -> Lease:

@@ -40,6 +40,7 @@ from __future__ import annotations
 
 import json
 import logging
+import random
 import ssl
 import threading
 import time
@@ -302,6 +303,8 @@ class HttpCluster(K8sClient):
         self._rate_limiter = rate_limiter
         self._watch_threads: list[threading.Thread] = []
         self._lease_raw_meta: dict[tuple, dict] = {}
+        # injectable for tests: 429-throttle and watch-reconnect sleeps
+        self._sleep = time.sleep
         if ca_file:
             self._ssl = ssl.create_default_context(cafile=ca_file)
         elif insecure:
@@ -346,55 +349,98 @@ class HttpCluster(K8sClient):
         return self._token_cache[1]
 
     # -- plumbing ---------------------------------------------------------
-    def _request(self, method: str, path: str, body: Optional[dict] = None,
-                 content_type: str = _JSON,
-                 timeout: Optional[float] = None):
-        """One API call -> parsed JSON. Maps HTTP errors onto the
-        client-seam exception types (client.py), so callers are backend
-        agnostic."""
-        if self._rate_limiter is not None:
-            self._rate_limiter.wait()
-        data = None if body is None else json.dumps(body).encode()
-        req = urllib.request.Request(
-            f"{self._base}{path}", data=data, method=method)
-        req.add_header("Accept", _JSON)
-        if data is not None:
-            req.add_header("Content-Type", content_type)
-        if self._token:
-            req.add_header("Authorization", f"Bearer {self._token}")
-        ctx = self._ssl if self._base.startswith("https") else None
-        try:
-            with urllib.request.urlopen(
-                    req, timeout=timeout or self._timeout,
-                    context=ctx) as resp:
-                payload = resp.read()
-        except urllib.error.HTTPError as exc:
-            detail = ""
-            try:
-                detail = exc.read().decode(errors="replace")[:400]
-            except OSError:
-                pass
-            finally:
-                exc.close()  # HTTPError owns the response socket
-            if exc.code == 404:
-                raise NotFoundError(f"{method} {path}: not found") from exc
-            if exc.code == 409:
-                raise ConflictError(
-                    f"{method} {path}: conflict: {detail}") from exc
-            if exc.code == 429:
-                raise EvictionBlockedError(
-                    f"{method} {path}: blocked: {detail}") from exc
-            raise ApiServerError(
-                f"{method} {path}: HTTP {exc.code}: {detail}") from exc
-        except (urllib.error.URLError, OSError, TimeoutError) as exc:
-            raise ApiServerError(f"{method} {path}: {exc}") from exc
-        if not payload:
+    #: In-place retries of a non-eviction 429 before surfacing the typed
+    #: ApiServerError (the server's Retry-After, when present, paces the
+    #: wait). Kept small: the reconcile loop's own backoff is the real
+    #: retry budget.
+    RETRY_429_ATTEMPTS = 2
+    #: Ceiling on a single honored Retry-After sleep — a misconfigured
+    #: server must not park a reconcile for minutes.
+    RETRY_AFTER_CAP_S = 10.0
+
+    @staticmethod
+    def _retry_after_seconds(headers) -> Optional[float]:
+        """Parse a Retry-After header (seconds form; the HTTP-date form
+        is not worth a date parser here) from an HTTPError's headers."""
+        raw = headers.get("Retry-After") if headers is not None else None
+        if raw is None:
             return None
         try:
-            return json.loads(payload)
-        except json.JSONDecodeError as exc:
-            raise ApiServerError(
-                f"{method} {path}: unparseable response") from exc
+            value = float(raw)
+        except (TypeError, ValueError):
+            return None
+        return value if value >= 0 else None
+
+    def _request(self, method: str, path: str, body: Optional[dict] = None,
+                 content_type: str = _JSON,
+                 timeout: Optional[float] = None,
+                 eviction: bool = False):
+        """One API call -> parsed JSON. Maps HTTP errors onto the
+        client-seam exception types (client.py), so callers are backend
+        agnostic. A 429 means "PDB-blocked" ONLY on the eviction
+        subresource (``eviction=True``); anywhere else it is apiserver
+        rate limiting — retried in place honoring the Retry-After header,
+        then surfaced as a retryable ApiServerError carrying it."""
+        attempts_429 = 0
+        while True:
+            if self._rate_limiter is not None:
+                self._rate_limiter.wait()
+            data = None if body is None else json.dumps(body).encode()
+            req = urllib.request.Request(
+                f"{self._base}{path}", data=data, method=method)
+            req.add_header("Accept", _JSON)
+            if data is not None:
+                req.add_header("Content-Type", content_type)
+            if self._token:
+                req.add_header("Authorization", f"Bearer {self._token}")
+            ctx = self._ssl if self._base.startswith("https") else None
+            try:
+                with urllib.request.urlopen(
+                        req, timeout=timeout or self._timeout,
+                        context=ctx) as resp:
+                    payload = resp.read()
+            except urllib.error.HTTPError as exc:
+                detail = ""
+                try:
+                    detail = exc.read().decode(errors="replace")[:400]
+                except OSError:
+                    pass
+                finally:
+                    exc.close()  # HTTPError owns the response socket
+                if exc.code == 404:
+                    raise NotFoundError(
+                        f"{method} {path}: not found") from exc
+                if exc.code == 409:
+                    raise ConflictError(
+                        f"{method} {path}: conflict: {detail}") from exc
+                if exc.code == 429:
+                    if eviction:
+                        raise EvictionBlockedError(
+                            f"{method} {path}: blocked: {detail}") from exc
+                    retry_after = self._retry_after_seconds(exc.headers)
+                    if attempts_429 < self.RETRY_429_ATTEMPTS:
+                        attempts_429 += 1
+                        # server-paced when it said so, else a jittered
+                        # second — never a synchronized fixed delay
+                        delay = (min(retry_after, self.RETRY_AFTER_CAP_S)
+                                 if retry_after is not None
+                                 else random.uniform(0.2, 1.0))
+                        self._sleep(delay)
+                        continue
+                    raise ApiServerError(
+                        f"{method} {path}: HTTP 429 throttled: {detail}",
+                        retry_after=retry_after) from exc
+                raise ApiServerError(
+                    f"{method} {path}: HTTP {exc.code}: {detail}") from exc
+            except (urllib.error.URLError, OSError, TimeoutError) as exc:
+                raise ApiServerError(f"{method} {path}: {exc}") from exc
+            if not payload:
+                return None
+            try:
+                return json.loads(payload)
+            except json.JSONDecodeError as exc:
+                raise ApiServerError(
+                    f"{method} {path}: unparseable response") from exc
 
     def _list(self, path: str, label_selector: str = "",
               field_selector: str = "") -> Iterator[dict]:
@@ -464,12 +510,14 @@ class HttpCluster(K8sClient):
 
     def evict_pod(self, namespace: str, name: str) -> None:
         # policy/v1 Eviction subresource; the apiserver answers 429 +
-        # DisruptionBudget cause when a PDB forbids the eviction
+        # DisruptionBudget cause when a PDB forbids the eviction — only
+        # HERE does 429 mean "blocked" rather than throttling
         self._request(
             "POST",
             f"/api/v1/namespaces/{namespace}/pods/{name}/eviction",
             {"apiVersion": "policy/v1", "kind": "Eviction",
-             "metadata": {"name": name, "namespace": namespace}})
+             "metadata": {"name": name, "namespace": namespace}},
+            eviction=True)
 
     # -- daemonsets & revisions ------------------------------------------
     def list_daemon_sets(self, namespace: str,
@@ -601,8 +649,6 @@ class HttpCluster(K8sClient):
         diff against) — the controller's ``resync_period`` remains the
         backstop for those, exactly the role client-go gives resync.
         """
-        import time as _time
-
         parse = _KIND_PARSERS[kind]
         ctx = self._ssl if self._base.startswith("https") else None
         backoff = 1.0
@@ -667,5 +713,7 @@ class HttpCluster(K8sClient):
                                "reconnecting in %.0fs", kind, exc,
                                backoff)
             first = False
-            _time.sleep(backoff)
+            # jittered (uniform half-to-full) so a fleet of operators
+            # whose watches died together does not re-list in lockstep
+            self._sleep(backoff * random.uniform(0.5, 1.0))
             backoff = min(backoff * 2.0, 30.0)

@@ -262,15 +262,34 @@ class RealCluster(K8sClient):
         return cls(rate_limiter=rate_limiter)
 
     # -- error translation -------------------------------------------------
+    @staticmethod
+    def _retry_after_seconds(exc) -> "Optional[float]":
+        """Retry-After (seconds form) from an ApiException's response
+        headers, or None."""
+        headers = getattr(exc, "headers", None)
+        raw = headers.get("Retry-After") if headers is not None else None
+        if raw is None:
+            return None
+        try:
+            value = float(raw)
+        except (TypeError, ValueError):
+            return None
+        return value if value >= 0 else None
+
     def _translate(self, exc, eviction: bool = False):
         status = getattr(exc, "status", None)
         if status == 404:
             return NotFoundError(str(exc))
         # 429 means "blocked by a PodDisruptionBudget" ONLY on the eviction
-        # subresource; everywhere else it is apiserver rate limiting and
-        # must surface as-is (callers back off and retry).
+        # subresource; everywhere else it is apiserver rate limiting —
+        # typed retryable, carrying the server's Retry-After so the
+        # controller's backoff honors it instead of hammering the
+        # throttle (controller.Controller._worker).
         if status == 429 and eviction:
             return EvictionBlockedError(str(exc))
+        if status == 429:
+            return ApiServerError(
+                str(exc), retry_after=self._retry_after_seconds(exc))
         if status == 409:
             return ConflictError(str(exc))
         # 5xx: retryable apiserver failure — typed so the drain/eviction
@@ -402,6 +421,7 @@ class RealCluster(K8sClient):
 
         def pump(kind, list_fn, kwargs, convert):
             import logging
+            import random as random_mod
             import time as time_mod
 
             from kubernetes import watch as k8s_watch
@@ -445,7 +465,9 @@ class RealCluster(K8sClient):
                     # server; back off and say why.
                     log.warning("%s watch failed; restarting in %.1fs",
                                 kind, backoff, exc_info=True)
-                    time_mod.sleep(backoff)
+                    # jittered so a fleet whose watches died together
+                    # does not re-list the apiserver in lockstep
+                    time_mod.sleep(backoff * random_mod.uniform(0.5, 1.0))
                     backoff = min(backoff * 2, 30.0)
                     continue
                 finally:

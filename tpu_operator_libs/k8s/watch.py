@@ -20,6 +20,12 @@ from typing import Callable, Iterator, Optional
 ADDED = "ADDED"
 MODIFIED = "MODIFIED"
 DELETED = "DELETED"
+#: Synthetic resync marker: delivered by a BOUNDED Watch after it had to
+#: drop events on overflow (apiserver watches use BOOKMARK events to
+#: carry resourceVersion checkpoints; here the marker means "events were
+#: lost — relist to repair"). ``WatchEvent.object`` is None and ``kind``
+#: is empty for these.
+BOOKMARK = "BOOKMARK"
 
 #: Sentinel object kinds, matching the reference's watched types
 #: (Nodes + driver DaemonSets + their pods).
@@ -33,42 +39,80 @@ class WatchEvent:
     """One change notification.
 
     ``object`` is a snapshot copy (value semantics, like objects that
-    crossed the wire) — mutating it never affects the store.
+    crossed the wire) — mutating it never affects the store. For
+    :data:`BOOKMARK` resync markers ``object`` is None.
     """
 
-    type: str          # ADDED | MODIFIED | DELETED
-    kind: str          # KIND_NODE | KIND_POD | KIND_DAEMON_SET
-    object: object     # Node | Pod | DaemonSet snapshot
+    type: str          # ADDED | MODIFIED | DELETED | BOOKMARK
+    kind: str          # KIND_NODE | KIND_POD | KIND_DAEMON_SET | ""
+    object: object     # Node | Pod | DaemonSet snapshot | None
 
 
 class Watch:
     """A single subscriber's event stream.
 
-    Iterating blocks until the next event or :meth:`stop`. The internal
-    queue is unbounded; a subscriber that stops draining leaks memory, not
-    deadlocks — the same trade client-go's watch buffers make.
+    Iterating blocks until the next event or :meth:`stop`.
+
+    Unbounded by default: a subscriber that stops draining leaks memory,
+    not deadlocks — the same trade client-go's watch buffers make. Pass
+    ``max_queue`` to bound the buffer instead: overflowing events are
+    DROPPED (counted in :attr:`overflow_dropped`) and the next
+    :meth:`get` returns a single :data:`BOOKMARK` marker telling the
+    consumer to relist — a slow consumer degrades observably instead of
+    growing the heap forever.
     """
 
     _STOP = object()
 
-    def __init__(self, on_stop: Optional[Callable[["Watch"], None]] = None) -> None:
-        self._queue: "queue.Queue[object]" = queue.Queue()
+    def __init__(self, on_stop: Optional[Callable[["Watch"], None]] = None,
+                 max_queue: Optional[int] = None) -> None:
+        if max_queue is not None and max_queue < 1:
+            raise ValueError("max_queue must be >= 1 (or None = unbounded)")
+        self._queue: "queue.Queue[object]" = queue.Queue(
+            maxsize=max_queue or 0)
         self._on_stop = on_stop
         self._stopped = threading.Event()
+        self._overflow_lock = threading.Lock()
+        self._overflow_pending = False
+        #: Events dropped on a full bounded queue (observability; 0 on
+        #: unbounded watches).
+        self.overflow_dropped = 0
 
     # -- producer side ---------------------------------------------------
     def _deliver(self, event: WatchEvent) -> None:
-        if not self._stopped.is_set():
-            self._queue.put(event)
+        if self._stopped.is_set():
+            return
+        try:
+            self._queue.put_nowait(event)
+        except queue.Full:
+            # Bounded watch overflow: the event is lost; record the loss
+            # and arrange for the consumer to see one BOOKMARK marker so
+            # it knows a relist is required (dropping silently would
+            # leave its derived state stale forever).
+            with self._overflow_lock:
+                self.overflow_dropped += 1
+                self._overflow_pending = True
+
+    def _take_overflow_marker(self) -> bool:
+        with self._overflow_lock:
+            if self._overflow_pending:
+                self._overflow_pending = False
+                return True
+            return False
 
     # -- consumer side ---------------------------------------------------
     def get(self, timeout: Optional[float] = None) -> Optional[WatchEvent]:
         """Next event, or None on timeout / after stop."""
+        if self._take_overflow_marker():
+            return WatchEvent(BOOKMARK, "", None)
         if self._stopped.is_set() and self._queue.empty():
             return None
         try:
             item = self._queue.get(timeout=timeout)
         except queue.Empty:
+            # the overflow may have been recorded while we blocked
+            if self._take_overflow_marker():
+                return WatchEvent(BOOKMARK, "", None)
             return None
         if item is Watch._STOP:
             return None
@@ -87,7 +131,12 @@ class Watch:
         if self._stopped.is_set():
             return
         self._stopped.set()
-        self._queue.put(Watch._STOP)
+        try:
+            self._queue.put_nowait(Watch._STOP)
+        except queue.Full:
+            # a full bounded queue still wakes the consumer: get() checks
+            # the stopped flag once the backlog drains
+            pass
         if self._on_stop is not None:
             self._on_stop(self)
 
@@ -112,8 +161,9 @@ class WatchBroadcaster:
                                Watch]] = []
 
     def subscribe(self, kinds: Optional[set[str]] = None,
-                  predicate: Optional[Callable[[WatchEvent], bool]] = None) -> Watch:
-        watch = Watch(on_stop=self._unsubscribe)
+                  predicate: Optional[Callable[[WatchEvent], bool]] = None,
+                  max_queue: Optional[int] = None) -> Watch:
+        watch = Watch(on_stop=self._unsubscribe, max_queue=max_queue)
         kindset = frozenset(kinds) if kinds is not None else None
         with self._lock:
             self._subs.append((kindset, predicate, watch))
@@ -134,6 +184,19 @@ class WatchBroadcaster:
             if predicate is not None and not predicate(event):
                 continue
             watch._deliver(event)
+
+    def drop_all(self) -> int:
+        """Fault injection: terminate every subscriber's stream (the
+        apiserver closing watch connections). Consumers observe their
+        Watch as stopped and must resubscribe + relist — exactly the
+        informer relist path a real stream drop forces. Returns the
+        number of streams dropped."""
+        with self._lock:
+            subs = [w for (_, _, w) in self._subs]
+            self._subs = []
+        for watch in subs:
+            watch.stop()
+        return len(subs)
 
     def subscriber_count(self) -> int:
         with self._lock:

@@ -21,6 +21,7 @@ from typing import TYPE_CHECKING, Iterable, Optional
 from tpu_operator_libs.consts import ALL_STATES, REMEDIATION_ALL_STATES
 
 if TYPE_CHECKING:  # pragma: no cover - types only (import cycle guard)
+    from tpu_operator_libs.chaos.runner import ChaosReport
     from tpu_operator_libs.remediation.state_machine import (
         NodeRemediationManager,
         RemediationSnapshot,
@@ -303,6 +304,52 @@ def observe_remediation(registry: MetricsRegistry,
             "remediation_recovery_seconds", seconds,
             "Wedge-first-seen to returned-to-service (MTTR)", labels,
             buckets=RECOVERY_SECONDS_BUCKETS)
+
+
+#: Buckets for chaos convergence times (virtual seconds): soak episodes
+#: ride fault-window + recovery-ladder timescales.
+CHAOS_SECONDS_BUCKETS = (60.0, 120.0, 300.0, 600.0, 900.0, 1800.0,
+                         3600.0, 7200.0, 14400.0)
+
+
+def observe_chaos(registry: MetricsRegistry, report: "ChaosReport",
+                  driver: str = "libtpu") -> None:
+    """Export one chaos soak episode's outcome.
+
+    ``report`` is a :class:`tpu_operator_libs.chaos.runner.ChaosReport`.
+    Run counts, invariant violations (labeled by invariant name),
+    operator crashes/handovers/watch gaps, and the convergence-time
+    histogram — the series a CI soak job scrapes to trend robustness
+    over time (``chaos_invariant_violations_total`` staying at 0 IS the
+    harness's guarantee, so it belongs on the same scrape surface as
+    the fleet gauges).
+    """
+    labels = {"driver": driver}
+    registry.inc_counter("chaos_runs_total",
+                         "Chaos soak episodes executed", labels)
+    if not report.ok:
+        registry.inc_counter("chaos_runs_failed_total",
+                             "Chaos episodes with violations or no "
+                             "convergence", labels)
+    for violation in report.violations:
+        registry.inc_counter(
+            "chaos_invariant_violations_total",
+            "Safety invariants broken during chaos soaks",
+            {**labels, "invariant": violation.invariant})
+    registry.inc_counter("chaos_operator_crashes_total",
+                         "Operator crash–restarts injected",
+                         labels, by=report.crashes_fired)
+    registry.inc_counter("chaos_leader_handovers_total",
+                         "Leader-election losses forcing a handover",
+                         labels, by=report.leader_handovers)
+    registry.inc_counter("chaos_watch_gaps_total",
+                         "Watch stream drops/overflows absorbed",
+                         labels, by=report.watch_gaps)
+    if report.converged:
+        registry.observe_histogram(
+            "chaos_convergence_seconds", report.total_seconds,
+            "Virtual seconds from episode start to full fleet "
+            "convergence", labels, buckets=CHAOS_SECONDS_BUCKETS)
 
 
 def observe_client_health(registry: MetricsRegistry,

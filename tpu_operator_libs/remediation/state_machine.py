@@ -307,9 +307,19 @@ class NodeRemediationManager:
                 since_raw = node.metadata.annotations.get(
                     self.keys.wedge_since_annotation)
                 if signal is None:
-                    if since_raw is not None:
-                        self.provider.change_node_upgrade_annotation(
-                            node, self.keys.wedge_since_annotation, None)
+                    # clear the debounce stamp AND any wedge-reason
+                    # residue: a crash between the reason stamp and the
+                    # WEDGED commit leaves a healthy-labeled node with a
+                    # reason annotation that nothing else ever deletes
+                    # (found by the chaos harness, seed 16)
+                    stale = {
+                        key: None for key in (
+                            self.keys.wedge_since_annotation,
+                            self.keys.wedge_reason_annotation)
+                        if key in node.metadata.annotations}
+                    if stale:
+                        self.provider.change_node_upgrade_annotations(
+                            node, stale)
                     continue
                 if since_raw is None:
                     self.provider.change_node_upgrade_annotation(
@@ -368,6 +378,16 @@ class NodeRemediationManager:
                               f"({attempts}/{policy.max_attempts})")
                     continue
                 if self._skip_remediation(node):
+                    continue
+                if self._upgrade_in_progress(node):
+                    # The upgrade machine took the node between wedge
+                    # confirmation and this triage (both can happen in
+                    # one reconcile cycle): admitting now would have two
+                    # machines driving one node — the upgrade's uncordon
+                    # would fire mid-quarantine (found by the chaos
+                    # harness, seed 132). Mid-rollout breakage belongs
+                    # to the upgrade machine's own failure handling;
+                    # this node waits in the quarantine queue.
                     continue
                 if slots <= 0:
                     continue
@@ -449,11 +469,16 @@ class NodeRemediationManager:
             self.keys.action_start_annotation)
         if started is None:
             attempt = self._attempts_used(node) + 1
-            self.provider.change_node_upgrade_annotation(
-                node, self.keys.attempt_annotation, str(attempt))
-            self.provider.change_node_upgrade_annotation(
-                node, self.keys.action_start_annotation,
-                str(int(self.clock.now())))
+            # ONE merge patch: the attempt counter and the action-start
+            # stamp are indistinguishable crash markers when written
+            # separately — a crash between the two writes would make the
+            # resumed operator read the half-stamped attempt as a
+            # previous (completed) one and bill the ladder twice.
+            self.provider.change_node_upgrade_annotations(node, {
+                self.keys.attempt_annotation: str(attempt),
+                self.keys.action_start_annotation:
+                    str(int(self.clock.now())),
+            })
         else:
             attempt = self._attempts_used(node)
         use_restart = (attempt <= policy.restart_attempts

@@ -296,10 +296,8 @@ def _schedule_faults(cluster: FakeCluster, spec: FleetSpec) -> None:
         cluster.schedule_at(
             at, lambda n=name: cluster.delete_node(n))
     for name in spec.not_ready_nodes:
-        cluster.schedule_at(spec.not_ready_at,
-                            lambda n=name: cluster.set_node_ready(n, False))
-        cluster.schedule_at(spec.not_ready_heal_at,
-                            lambda n=name: cluster.set_node_ready(n, True))
+        cluster.flap_node_ready(name, spec.not_ready_at,
+                                spec.not_ready_heal_at)
     if not spec.crashloop_nodes:
         return
     afflicted = set(spec.crashloop_nodes)
@@ -310,7 +308,10 @@ def _schedule_faults(cluster: FakeCluster, spec: FleetSpec) -> None:
             return True
         return cluster.clock.now() >= heal_at
 
-    cluster.set_pod_ready_gate(ready_gate)
+    # add (not set): composes with gates other fault sources install —
+    # the chaos injector layers its own crash-loop windows on the same
+    # cluster (fake.add_pod_ready_gate ANDs all installed gates)
+    cluster.add_pod_ready_gate(ready_gate)
 
 
 def simulate_rolling_upgrade(

@@ -90,6 +90,18 @@ class MockNodeUpgradeStateProvider(RecordingMixin):
         else:
             node.metadata.annotations[key] = value
 
+    def change_node_upgrade_annotations(
+            self, node: Node,
+            annotations: dict[str, Optional[str]]) -> None:
+        self.record("change_node_upgrade_annotations", node.metadata.name,
+                    dict(annotations))
+        self._maybe_fail()
+        for key, value in annotations.items():
+            if value is None or value == NULL_STRING:
+                node.metadata.annotations.pop(key, None)
+            else:
+                node.metadata.annotations[key] = value
+
 
 class MockCordonManager(RecordingMixin):
     def __init__(self) -> None:

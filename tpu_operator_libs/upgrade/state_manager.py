@@ -533,17 +533,51 @@ class ClusterUpgradeStateManager:
                         ns.node))
                 if (not pod_synced and not orphaned) or waiting_safe_load \
                         or upgrade_requested:
+                    if self._skip_node_upgrade(ns.node):
+                        # Honor the skip label HERE, not only at
+                        # admission: a remediation-parked node is
+                        # typically CORDONED by that machine, and
+                        # entering upgrade-required now would capture
+                        # that quarantine cordon as the "node was
+                        # unschedulable before the upgrade" memory —
+                        # the upgrade would then finish without an
+                        # uncordon and strand the node (found by the
+                        # chaos harness, seed 10).
+                        logger.info(
+                            "node %s is marked to skip upgrades; "
+                            "leaving idle", ns.node.metadata.name)
+                        continue
                     if ns.node.is_unschedulable():
                         # Remember pre-upgrade cordon so we restore it at
                         # the end (upgrade_state.go:509-523).
                         self.provider.change_node_upgrade_annotation(
                             ns.node, self.keys.initial_state_annotation,
                             TRUE_STRING)
+                    elif self.keys.initial_state_annotation \
+                            in ns.node.metadata.annotations:
+                        # Crash residue: the finishing pass committed the
+                        # state but died before deleting the marker. A
+                        # SCHEDULABLE node starting a new upgrade with it
+                        # would be remembered as "cordoned before the
+                        # upgrade" and left cordoned forever at its end.
+                        self.provider.change_node_upgrade_annotation(
+                            ns.node, self.keys.initial_state_annotation,
+                            None)
                     self.provider.change_node_upgrade_state(
                         ns.node, UpgradeState.UPGRADE_REQUIRED)
                     logger.info("node %s requires upgrade",
                                 ns.node.metadata.name)
                     continue
+                if bucket == UpgradeState.DONE and \
+                        self.keys.initial_state_annotation \
+                        in ns.node.metadata.annotations:
+                    # Crash residue on an idle node (the finish path
+                    # deletes the marker right after the DONE commit);
+                    # the cordon itself is untouched — DONE+marker only
+                    # arises on the pre-cordoned arc, which must stay
+                    # cordoned.
+                    self.provider.change_node_upgrade_annotation(
+                        ns.node, self.keys.initial_state_annotation, None)
                 if bucket == UpgradeState.UNKNOWN:
                     self.provider.change_node_upgrade_state(
                         ns.node, UpgradeState.DONE)
@@ -765,6 +799,26 @@ class ClusterUpgradeStateManager:
         for ns in state.bucket(UpgradeState.FAILED):
             with self._defer_node_on_transient(ns.node,
                                                "failed-node recovery"):
+                synced, orphaned = self._pod_in_sync_with_ds(ns)
+                if not synced and not orphaned \
+                        and ns.runtime_pod.is_ready():
+                    # The DaemonSet rolled a NEW revision while the node
+                    # sat failed (its crash-loop healed on the old one,
+                    # or a drain failed): a healthy-but-outdated pod can
+                    # never become "in sync" on its own, so the
+                    # pod-healthy recovery below would wait forever —
+                    # the node is stranded (found by the chaos harness,
+                    # seed 113). Resume via drain-required: the drain
+                    # retries (covering the drain-failure origin without
+                    # ever skipping workload eviction) and the flow then
+                    # restarts the pod onto the current revision.
+                    logger.info(
+                        "failed node %s has a healthy but outdated pod; "
+                        "re-entering the upgrade flow at drain",
+                        ns.node.metadata.name)
+                    self.provider.change_node_upgrade_state(
+                        ns.node, UpgradeState.DRAIN_REQUIRED)
+                    continue
                 if not self._is_runtime_pod_in_sync(ns):
                     continue
                 # check(), not validate(): the recovery gate must not

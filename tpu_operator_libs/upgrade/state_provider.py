@@ -115,6 +115,55 @@ class NodeUpgradeStateProvider:
                   f"Successfully updated node state label to {value}")
         return True
 
+    def change_node_upgrade_annotations(
+            self, node: Node,
+            annotations: "dict[str, Optional[str]]") -> None:
+        """Patch SEVERAL node annotations as one merge patch (value None
+        deletes the key) and wait for visibility.
+
+        The single patch is the crash-atomicity seam: bookkeeping that
+        must move together — e.g. the remediation machine's attempt
+        counter and action-start stamp — would otherwise be two wire
+        writes with a window between them, and an operator crash inside
+        that window leaves durable state the resumed instance
+        misreads (a half-stamped attempt double-bills the escalation
+        budget). One merge patch commits all-or-nothing, exactly like
+        the label write that is the state machine's commit point."""
+        if not annotations:
+            return
+        patch = {key: (None if value is None or value == NULL_STRING
+                       else value)
+                 for key, value in annotations.items()}
+        with self._node_lock.lock(node.metadata.name):
+            try:
+                self._client.patch_node_annotations(
+                    node.metadata.name, patch)
+            except Exception as exc:
+                log_event(self._recorder, node, Event.WARNING,
+                          self._keys.event_reason,
+                          f"Failed to update node annotations "
+                          f"{sorted(patch)}: {exc}")
+                raise
+
+            def check(n: Node) -> bool:
+                return all(
+                    key not in n.metadata.annotations if value is None
+                    else n.metadata.annotations.get(key) == value
+                    for key, value in patch.items())
+
+            try:
+                fresh = self._wait_visible(node.metadata.name, check)
+            except CacheSyncTimeout:
+                log_event(self._recorder, node, Event.WARNING,
+                          self._keys.event_reason,
+                          f"Failed to observe node annotations "
+                          f"{sorted(patch)} after patch")
+                raise
+        self._copy_into(node, fresh)
+        log_event(self._recorder, node, Event.NORMAL,
+                  self._keys.event_reason,
+                  f"Successfully updated node annotations {sorted(patch)}")
+
     def change_node_upgrade_annotation(self, node: Node, key: str,
                                        value: Optional[str]) -> None:
         """Patch (or with value None / "null" delete) a node annotation and
