@@ -4,7 +4,7 @@ PYTHON ?= python3
 PYTEST_FLAGS ?= -q
 COV_THRESHOLD ?= 85
 
-.PHONY: all check test test-fast test-fault test-chaos test-soak lint cov bench graft-check package clean diagram
+.PHONY: all check test test-fast test-fault test-chaos test-soak test-scale lint cov bench bench-reconcile graft-check package clean diagram
 
 all: lint test
 
@@ -86,6 +86,17 @@ package:
 
 bench:
 	$(PYTHON) bench.py
+
+# Fleet-scale reconcile pipeline: watch-indexed reads + parallel bucket
+# workers + coalesced writes vs the full-relist baseline, 64/256/1024
+# nodes (tools/reconcile_bench.py; docs/benchmarks.md §2c).
+bench-reconcile:
+	$(PYTHON) tools/reconcile_bench.py
+
+# Fleet-scale regression tests (`scale` marker): the tier-1 64-node
+# smoke runs in `make test` too; this target adds the big fleets.
+test-scale:
+	$(PYTHON) -m pytest tests/ $(PYTEST_FLAGS) -m scale
 
 graft-check:
 	$(PYTHON) __graft_entry__.py

@@ -102,6 +102,7 @@ def main() -> int:
                                        headline_window=window)
     hardware = _hardware_capture()
     reconcile = _reconcile_latency_cells()
+    reconcile_pipeline = _reconcile_pipeline_cells()
     straggler = _straggler_scenario()
     scale_down = _scale_down_scenario()
 
@@ -143,6 +144,11 @@ def main() -> int:
         "reconcile_p50_ms_256_nodes": (
             (reconcile.get("256_nodes") or {}).get("slice")
             or {}).get("p50"),
+        # fleet-scale reconcile pipeline (tools/reconcile_bench.py):
+        # watch-indexed reads + parallel bucket workers + coalesced
+        # writes vs the full-relist baseline, 64/256/1024-node fleets —
+        # steady-state LIST calls per pass is the acceptance metric
+        "reconcile_pipeline": reconcile_pipeline,
         # flattened legacy keys (round-over-round comparability); the
         # "ours" cell is the full framework path (slice_watch)
         "flat_availability_pct": reference,
@@ -1247,6 +1253,24 @@ def _scale_down_scenario() -> dict:
         "upgrade_wall_clock_s": cell.total_seconds,
         "removed_nodes": [n for n, _ in fleet.node_removals],
     }
+
+
+def _reconcile_pipeline_cells() -> dict:
+    """Fleet-scale reconcile pipeline comparison (ISSUE 3 tentpole):
+    the full-relist baseline vs watch-indexed reads + parallel bucket
+    workers + coalesced writes, via tools/reconcile_bench.py. Fleet
+    sizes overridable via BENCH_RECONCILE_NODES (comma-separated; tests
+    shrink it). A cell failure degrades to a structured error — the
+    bench never dies on one section."""
+    from tools.reconcile_bench import run_reconcile_bench
+
+    sizes = tuple(
+        int(s) for s in os.environ.get(
+            "BENCH_RECONCILE_NODES", "64,256,1024").split(","))
+    try:
+        return run_reconcile_bench(sizes)
+    except Exception as exc:  # noqa: BLE001 — section boundary
+        return {"error": f"{type(exc).__name__}: {exc}"}
 
 
 def _reconcile_latency_cells(passes: int = 9) -> dict:

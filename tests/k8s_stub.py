@@ -203,12 +203,17 @@ class BehavioralCoreV1(_Api):
                               limit, _continue)
 
     def patch_node(self, name, body):
-        if "metadata" in body and "labels" in body["metadata"]:
+        meta = body.get("metadata") or {}
+        if "labels" in meta and "annotations" in meta:
+            # coalesced metadata patch (RealCluster.patch_node_meta)
+            node = self._do(self._cluster.patch_node_meta, name,
+                            meta["labels"], meta["annotations"])
+        elif "labels" in meta:
             node = self._do(self._cluster.patch_node_labels, name,
-                            body["metadata"]["labels"])
-        elif "metadata" in body and "annotations" in body["metadata"]:
+                            meta["labels"])
+        elif "annotations" in meta:
             node = self._do(self._cluster.patch_node_annotations, name,
-                            body["metadata"]["annotations"])
+                            meta["annotations"])
         elif "spec" in body and "unschedulable" in body["spec"]:
             node = self._do(self._cluster.set_node_unschedulable, name,
                             body["spec"]["unschedulable"])

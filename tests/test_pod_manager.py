@@ -261,9 +261,10 @@ class TestScheduleCheckOnPodCompletion:
                    for e in env.recorder.events)
 
     def test_stamp_removal_failure_blocks_advance(self):
-        # jobs done, but the tracking-annotation delete fails: the node
-        # must NOT advance this pass (otherwise a stale stamp could
-        # instantly time out the next upgrade of this node)
+        # jobs done, but the combined advance+stamp-delete merge patch
+        # fails: the node must NOT advance this pass AND the stamp must
+        # survive (the advance and the stamp delete commit atomically —
+        # a stale stamp can no longer outlive a committed advance)
         env = make_env()
         node = NodeBuilder("n1").create(env.cluster)
         PodBuilder("done-job").on_node(node).orphaned() \
@@ -279,7 +280,10 @@ class TestScheduleCheckOnPodCompletion:
             wait_for_completion_spec=WaitForCompletionSpec(
                 pod_selector="job=train")))
         assert env.state_of("n1") == ""
-        assert any("track job" in e.message for e in env.recorder.events)
+        stamp = env.cluster.get_node("n1").metadata.annotations.get(
+            env.keys.pod_completion_start_annotation)
+        assert stamp == "123"  # nothing half-committed
+        assert any("advance node" in e.message for e in env.recorder.events)
 
     def test_timeout_flow(self):
         env = make_env()

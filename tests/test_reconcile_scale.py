@@ -1,0 +1,51 @@
+"""Fleet-scale reconcile regressions (`scale` marker).
+
+The 64-node smoke is tier-1 (fast, not `slow`): it pins the ISSUE 3
+acceptance criteria — ≥10× fewer API LIST calls per steady-state pass
+for the watch-indexed pipeline vs the full-relist baseline, with
+upgrade makespan, drain→ready p50 and slice availability no worse.
+The 256/1024-node cells run the same comparison at size and are
+additionally marked `slow` (``make test-scale`` covers them;
+``make bench-reconcile`` prints the full numbers).
+"""
+
+from __future__ import annotations
+
+import pytest
+
+from tools.reconcile_bench import run_fleet_cell
+
+pytestmark = pytest.mark.scale
+
+
+def _assert_pipeline_no_worse(baseline: dict, pipelined: dict) -> None:
+    assert baseline["converged"] and pipelined["converged"]
+    # the acceptance metric: steady-state LIST fan-out collapses
+    assert baseline["api_list_calls_per_steady_pass"] >= \
+        10.0 * pipelined["api_list_calls_per_steady_pass"], (
+            baseline["api_list_calls_per_steady_pass"],
+            pipelined["api_list_calls_per_steady_pass"])
+    # behavior parity: the pipeline changes wire cost, never decisions
+    assert pipelined["upgrade_makespan_s"] <= \
+        baseline["upgrade_makespan_s"]
+    assert pipelined["drain_to_ready_p50_s"] <= \
+        baseline["drain_to_ready_p50_s"]
+    assert pipelined["slice_availability_pct"] >= \
+        baseline["slice_availability_pct"] - 0.01
+    # and the whole upgrade costs strictly fewer wire calls
+    assert pipelined["api_calls_upgrade_total"] < \
+        baseline["api_calls_upgrade_total"]
+
+
+def test_scale_smoke_64_nodes():
+    baseline = run_fleet_cell(64, pipelined=False)
+    pipelined = run_fleet_cell(64, pipelined=True)
+    _assert_pipeline_no_worse(baseline, pipelined)
+
+
+@pytest.mark.slow
+@pytest.mark.parametrize("n_nodes", [256, 1024])
+def test_scale_large_fleets(n_nodes):
+    baseline = run_fleet_cell(n_nodes, pipelined=False)
+    pipelined = run_fleet_cell(n_nodes, pipelined=True)
+    _assert_pipeline_no_worse(baseline, pipelined)

@@ -1,0 +1,276 @@
+#!/usr/bin/env python3
+"""Fleet-scale reconcile pipeline benchmark.
+
+Drives the REAL state machine over simulate.py fleets (64 / 256 / 1024
+nodes on the FakeCluster virtual clock) in two configurations and
+reports the difference the fleet-scale pipeline makes:
+
+- **baseline** — the full-relist path: the manager reads the
+  FakeCluster directly (every pass re-LISTs DaemonSets, pods and
+  nodes), walks buckets serially, and commits each transition as
+  separate label/annotation patches with a read-back poll. This is the
+  reference consumer's wire shape.
+- **pipelined** — reads through ``CachedReadClient`` (watch-indexed
+  node→pods cache, per-pass delta consumption, DS-generation-cached
+  revision lists, read-your-writes), per-node bucket work fanned out on
+  the bounded worker pool with admission serialized, and each
+  transition's label+annotation changes coalesced into one merge patch.
+
+Per fleet size and cell: reconcile pass p50/p95 (real ms), API calls
+for the whole upgrade, **API list calls per steady-state pass** (the
+acceptance metric: ≥10× fewer than baseline), upgrade makespan
+(virtual s), drain→ready p50/p95 and slice availability — the last
+three must be no worse than baseline (the pipeline changes wire cost,
+never decisions).
+
+CLI: ``python tools/reconcile_bench.py [--nodes 64,256,1024]``
+prints one JSON document. ``make bench-reconcile`` wraps it; bench.py
+embeds the same cells in its output.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import statistics
+import sys
+import time
+from typing import Optional
+
+# direct `python tools/reconcile_bench.py` runs with tools/ on sys.path
+# but not the repo root; add it (same fix as the sweep tools)
+sys.path.insert(0, os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+
+from tpu_operator_libs.api.upgrade_policy import (  # noqa: E402
+    DrainSpec,
+    UpgradePolicySpec,
+)
+from tpu_operator_libs.consts import UpgradeState  # noqa: E402
+from tpu_operator_libs.k8s.cached import CachedReadClient  # noqa: E402
+from tpu_operator_libs.simulate import (  # noqa: E402
+    NS,
+    RUNTIME_LABELS,
+    FleetSpec,
+    build_fleet,
+)
+from tpu_operator_libs.topology.slice_topology import SliceTopology  # noqa: E402
+from tpu_operator_libs.upgrade.state_manager import (  # noqa: E402
+    BuildStateError,
+    ClusterUpgradeStateManager,
+)
+
+HOSTS_PER_SLICE = 4
+PARALLEL_WORKERS = 8
+RECONCILE_INTERVAL = 10.0
+STEADY_PASSES = 3
+
+
+def _percentile(samples: "list[float]", pct: float) -> Optional[float]:
+    if not samples:
+        return None
+    ordered = sorted(samples)
+    index = max(0, -(-len(ordered) * int(pct) // 100) - 1)
+    return ordered[index]
+
+
+class _HarnessReads:
+    """Cluster reads the HARNESS makes (bookkeeping, cache settling) —
+    tracked per operation so they can be subtracted from the wire-cost
+    report; only the state machine's own calls should be billed."""
+
+    def __init__(self, cluster) -> None:
+        self._cluster = cluster
+        self.counts: dict[str, int] = {}
+
+    def list_nodes(self):
+        self.counts["list_nodes"] = self.counts.get("list_nodes", 0) + 1
+        return self._cluster.list_nodes()
+
+    def list_pods(self, namespace):
+        self.counts["list_pods"] = self.counts.get("list_pods", 0) + 1
+        return self._cluster.list_pods(namespace=namespace)
+
+    def total(self) -> int:
+        return sum(self.counts.values())
+
+
+def _settle_cache(cached: Optional[CachedReadClient],
+                  harness: _HarnessReads,
+                  timeout: float = 5.0) -> None:
+    """Wait (real time) until the cache has applied every event the
+    cluster emitted so far. The packaged operator stack reconciles only
+    AFTER an event is applied to the cache (CachedReadClient's
+    add_event_handler contract), so the tick-driven harness must grant
+    the same guarantee — otherwise millisecond pump lag is billed as a
+    full 10-virtual-second tick and the cells stop being comparable."""
+    if cached is None:
+        return
+    want_pods = {p.metadata.name: p.metadata.resource_version
+                 for p in harness.list_pods(NS)}
+    want_nodes = {n.metadata.name: n.metadata.resource_version
+                  for n in harness.list_nodes()}
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        have_pods = {p.metadata.name: p.metadata.resource_version
+                     for p in cached.list_pods(namespace=NS)}
+        have_nodes = {n.metadata.name: n.metadata.resource_version
+                      for n in cached.list_nodes()}
+        if have_pods == want_pods and have_nodes == want_nodes:
+            return
+        time.sleep(0.0005)
+    raise RuntimeError("cache did not catch up with the cluster")
+
+
+def run_fleet_cell(n_nodes: int, pipelined: bool,
+                   max_sim_seconds: float = 4 * 3600.0,
+                   steady_passes: int = STEADY_PASSES) -> dict:
+    """One full rolling upgrade + a post-convergence steady-state
+    window, instrumented for wire cost and pass latency."""
+    if n_nodes % HOSTS_PER_SLICE:
+        raise ValueError(f"n_nodes must be a multiple of {HOSTS_PER_SLICE}")
+    fleet = FleetSpec(n_slices=n_nodes // HOSTS_PER_SLICE,
+                      hosts_per_slice=HOSTS_PER_SLICE)
+    cluster, clock, keys = build_fleet(fleet)
+    client = cluster
+    if pipelined:
+        client = CachedReadClient(cluster, NS, relist_interval=None)
+        if not client.has_synced(timeout=60.0):
+            raise RuntimeError("cache never synced")
+    mgr = ClusterUpgradeStateManager(
+        client, keys, async_workers=False, poll_interval=0.0,
+        parallel_workers=PARALLEL_WORKERS if pipelined else 0)
+    policy = UpgradePolicySpec(
+        auto_upgrade=True, max_parallel_upgrades=0,
+        max_unavailable="25%", topology_mode="flat",
+        drain=DrainSpec(enable=True, force=True, timeout_seconds=300))
+
+    pass_ms: list[float] = []
+    down_since: dict[str, float] = {}
+    drain_ready: list[float] = []
+    availability_weighted = 0.0
+    harness = _HarnessReads(cluster)
+    cached = client if pipelined else None
+    converged = False
+    done = str(UpgradeState.DONE)
+
+    try:
+        _settle_cache(cached, harness)
+        while clock.now() < max_sim_seconds:
+            t0 = time.perf_counter()
+            try:
+                state = mgr.build_state(NS, RUNTIME_LABELS)
+                mgr.apply_state(state, policy)
+            except BuildStateError:
+                state = None
+            pass_ms.append((time.perf_counter() - t0) * 1e3)
+            # bookkeeping reads the cluster directly; its own list calls
+            # are counted and subtracted from the wire-cost report
+            nodes = harness.list_nodes()
+            now = clock.now()
+            all_done = bool(nodes)
+            for node in nodes:
+                name = node.metadata.name
+                label = node.metadata.labels.get(keys.state_label, "")
+                if label != done:
+                    all_done = False
+                if node.is_unschedulable() and name not in down_since:
+                    down_since[name] = now
+                elif (name in down_since and not node.is_unschedulable()
+                      and label == done):
+                    drain_ready.append(now - down_since.pop(name))
+            if all_done:
+                converged = True
+                break
+            availability_weighted += (SliceTopology.from_nodes(nodes)
+                                      .availability() * RECONCILE_INTERVAL)
+            clock.advance(RECONCILE_INTERVAL)
+            cluster.step()
+            _settle_cache(cached, harness)
+
+        makespan = clock.now()
+        upgrade_calls = cluster.api_call_counts()
+        upgrade_total = sum(upgrade_calls.values()) - harness.total()
+
+        # steady state: the fleet is fully upgraded; measure the pure
+        # per-pass wire cost with no harness reads inside the window
+        _settle_cache(cached, harness)
+        cluster.reset_api_call_counts()
+        for _ in range(steady_passes):
+            state = mgr.build_state(NS, RUNTIME_LABELS)
+            mgr.apply_state(state, policy)
+            clock.advance(RECONCILE_INTERVAL)
+            cluster.step()
+        steady = cluster.api_call_counts()
+        steady_lists = sum(v for op, v in steady.items()
+                           if op.startswith("list_")) / steady_passes
+        steady_total = sum(steady.values()) / steady_passes
+    finally:
+        if pipelined:
+            client.stop()
+
+    return {
+        "converged": converged,
+        "upgrade_makespan_s": round(makespan, 1),
+        "reconcile_pass_p50_ms": round(statistics.median(pass_ms), 2),
+        "reconcile_pass_p95_ms": round(_percentile(pass_ms, 95), 2),
+        "passes": len(pass_ms),
+        "drain_to_ready_p50_s": (round(statistics.median(drain_ready), 1)
+                                 if drain_ready else None),
+        "drain_to_ready_p95_s": (round(_percentile(drain_ready, 95), 1)
+                                 if drain_ready else None),
+        "slice_availability_pct": round(
+            100.0 * availability_weighted / makespan, 2) if makespan else 100.0,
+        "api_calls_upgrade_total": upgrade_total,
+        "api_list_calls_per_steady_pass": round(steady_lists, 2),
+        "api_calls_per_steady_pass": round(steady_total, 2),
+    }
+
+
+def run_reconcile_bench(sizes: "tuple[int, ...]" = (64, 256, 1024)) -> dict:
+    """The baseline-vs-pipelined comparison across fleet sizes."""
+    out: dict = {
+        "hosts_per_slice": HOSTS_PER_SLICE,
+        "parallel_workers": PARALLEL_WORKERS,
+        "steady_passes": STEADY_PASSES,
+    }
+    for n_nodes in sizes:
+        baseline = run_fleet_cell(n_nodes, pipelined=False)
+        pipelined = run_fleet_cell(n_nodes, pipelined=True)
+        base_lists = baseline["api_list_calls_per_steady_pass"]
+        pipe_lists = pipelined["api_list_calls_per_steady_pass"]
+        cell = {
+            "baseline": baseline,
+            "pipelined": pipelined,
+            # the acceptance metric: steady-state LIST fan-out ratio
+            # (None when the pipelined cell reaches zero — infinitely
+            # fewer; meets_10x carries the pass/fail either way)
+            "steady_list_ratio": (round(base_lists / pipe_lists, 1)
+                                  if pipe_lists else None),
+            "meets_10x_fewer_lists": base_lists >= 10.0 * pipe_lists,
+            "pass_p50_speedup": round(
+                baseline["reconcile_pass_p50_ms"]
+                / pipelined["reconcile_pass_p50_ms"], 2)
+            if pipelined["reconcile_pass_p50_ms"] else None,
+            "api_calls_upgrade_ratio": round(
+                baseline["api_calls_upgrade_total"]
+                / pipelined["api_calls_upgrade_total"], 2)
+            if pipelined["api_calls_upgrade_total"] else None,
+        }
+        out[f"{n_nodes}_nodes"] = cell
+    return out
+
+
+def main(argv: "list[str]") -> int:
+    sizes = (64, 256, 1024)
+    for i, arg in enumerate(argv):
+        if arg == "--nodes" and i + 1 < len(argv):
+            sizes = tuple(int(s) for s in argv[i + 1].split(","))
+        elif arg.startswith("--nodes="):
+            sizes = tuple(int(s) for s in arg.split("=", 1)[1].split(","))
+    print(json.dumps(run_reconcile_bench(sizes), indent=2))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main(sys.argv[1:]))

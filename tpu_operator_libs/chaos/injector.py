@@ -68,40 +68,59 @@ class CrashFuse:
     """
 
     def __init__(self) -> None:
+        import threading
+
+        # The budget is decremented from parallel bucket workers when
+        # the state manager runs with a worker pool; without the lock
+        # two racing writes could both consume the last unit and the
+        # crash would never fire.
+        self._lock = threading.Lock()
         self._budget: Optional[int] = None
         self._after = False
         self.pending = False
         self.fired_total = 0
 
     def arm(self, budget: int, after: bool) -> None:
-        self._budget = max(0, budget)
-        self._after = after
+        with self._lock:
+            self._budget = max(0, budget)
+            self._after = after
 
     @property
     def armed(self) -> bool:
-        return self._budget is not None
+        with self._lock:
+            return self._budget is not None
 
     def reset(self) -> None:
         """The replacement operator process has started. Clears only the
         ``pending`` flag: an ARMED-but-unfired crash survives restarts
         and leader handovers — the schedule says the process dies around
         its time, and whichever incarnation is alive then dies."""
-        self.pending = False
+        with self._lock:
+            self.pending = False
 
     def guard(self, write: Callable[[], object]) -> object:
-        """Run one durable write under the fuse."""
-        if self.pending:
-            raise OperatorCrash("operator process is down (crash "
-                                "pending restart)")
-        if self._budget is None:
+        """Run one durable write under the fuse. The detonation decision
+        is atomic; the write itself runs outside the lock so concurrent
+        writers (the parallel bucket pool) are not serialized — writes
+        already in flight when the fuse blows still land, exactly like
+        requests racing a real process death."""
+        with self._lock:
+            if self.pending:
+                raise OperatorCrash("operator process is down (crash "
+                                    "pending restart)")
+            if self._budget is None:
+                detonate = None
+            elif self._budget > 0:
+                self._budget -= 1
+                detonate = None
+            else:
+                self._budget = None
+                self.pending = True
+                self.fired_total += 1
+                detonate = "after" if self._after else "before"
+        if detonate is None:
             return write()
-        if self._budget > 0:
-            self._budget -= 1
-            return write()
-        self._budget = None
-        self.pending = True
-        self.fired_total += 1
-        if self._after:
+        if detonate == "after":
             write()
             raise OperatorCrash(
                 "operator crashed AFTER committing a durable write")
@@ -121,11 +140,14 @@ class CrashingStateProvider(NodeUpgradeStateProvider):
         super().__init__(*args, **kwargs)  # type: ignore[arg-type]
         self._fuse = fuse
 
-    def change_node_upgrade_state(self, node: Node,
-                                  new_state: "UpgradeState | str") -> bool:
+    def change_node_upgrade_state(
+            self, node: Node, new_state: "UpgradeState | str",
+            annotations: "Optional[dict[str, Optional[str]]]" = None,
+    ) -> bool:
         return bool(self._fuse.guard(
             lambda: super(CrashingStateProvider, self)
-            .change_node_upgrade_state(node, new_state)))
+            .change_node_upgrade_state(node, new_state,
+                                       annotations=annotations)))
 
     def change_node_upgrade_annotation(self, node: Node, key: str,
                                        value: Optional[str]) -> None:

@@ -112,6 +112,12 @@ class ChaosConfig:
     #: invariant is exact (the slice planner may legally overdraw).
     max_unavailable: IntOrString = "50%"
     max_parallel_upgrades: int = 0
+    #: Bucket worker pool size for the upgrade machine (state_manager
+    #: parallel_workers). ON by default: the chaos gate is exactly
+    #: where concurrency bugs in the fan-out must surface — budget
+    #: admission stays serialized, so the invariants must hold under
+    #: any thread interleaving. 0 restores the serial reference walk.
+    parallel_workers: int = 4
     lease_namespace: str = "kube-system"
     lease_name: str = "chaos-operator-leader"
 
@@ -194,7 +200,8 @@ class _OperatorIncarnation:
             poll_interval=1.0, fuse=injector.fuse)
         self.upgrade = ClusterUpgradeStateManager(
             cluster, keys, clock=clock, async_workers=False,
-            provider=provider, poll_interval=1.0, sync_timeout=5.0)
+            provider=provider, poll_interval=1.0, sync_timeout=5.0,
+            parallel_workers=config.parallel_workers)
         rem_provider = CrashingStateProvider(
             cluster, rem_keys, None, clock,  # type: ignore[arg-type]
             sync_timeout=5.0, poll_interval=1.0, fuse=injector.fuse)

@@ -438,6 +438,44 @@ class Informer:
                 if on_update is not None:
                     self._safe(on_update, old, obj)
 
+    def apply_external(self, obj: object) -> None:
+        """Apply a write RESULT directly to the cache (read-your-writes).
+
+        The caller just performed a mutation against the backend and
+        holds the fresh object the server returned; applying it here
+        makes the cache reflect the write immediately instead of after
+        the watch round-trip — which is what turns the provider's
+        read-back poll (node_upgrade_state_provider.go:100-117) into a
+        no-wait check and lets a write wave pipeline instead of each
+        write blocking on the watch pump. The freshness stamp protects
+        it from an in-flight relist exactly like a watch event; the
+        mutation's own watch event lands later as an equal-value update.
+        """
+        key = self._key_fn(obj)
+        with self._store_lock:
+            old = self._store.get(key)
+            self._store[key] = obj
+            self._last_applied[key] = time.monotonic()
+        if old is None:
+            self._dispatch_add(obj)
+        else:
+            for _, on_update, _ in self._handlers:
+                if on_update is not None:
+                    self._safe(on_update, old, obj)
+
+    def apply_external_delete(self, namespace: str, name: str) -> None:
+        """Delete-side of :meth:`apply_external`: the caller deleted the
+        object on the backend; drop it from the cache now (tombstoned,
+        so a racing relist cannot resurrect it)."""
+        key = (namespace, name)
+        with self._store_lock:
+            old = self._store.pop(key, None)
+            self._last_applied[key] = time.monotonic()  # tombstone
+        if old is not None:
+            for _, _, on_delete in self._handlers:
+                if on_delete is not None:
+                    self._safe(on_delete, old)
+
     def get(self, namespace: str, name: str) -> Optional[object]:
         with self._store_lock:
             return self._store.get((namespace, name))

@@ -11,14 +11,24 @@ built on this repo's own informers:
   are served from list+watch :class:`~tpu_operator_libs.controller.Informer`
   caches — zero API traffic per reconcile once synced.
 - **Writes** (patches, cordon, delete, evict) pass through to the
-  delegate client; the cache catches up when the resulting watch event
-  lands. Reads are therefore *eventually* consistent, exactly the
-  staleness contract NodeUpgradeStateProvider's read-back poll exists
-  to absorb.
-- **ControllerRevisions** pass through uncached: they are immutable,
-  read only by the revision oracle (one list per BuildState), and the
-  watch plane does not carry them — the same shape as controller-runtime
-  bypassing the cache for unregistered kinds.
+  delegate client AND apply their returned result to the cache
+  immediately (read-your-writes): NodeUpgradeStateProvider's read-back
+  poll degenerates to a no-wait check, so a transition wave pipelines
+  instead of each write blocking on the watch round-trip. Third-party
+  writes remain *eventually* consistent via the watch stream, exactly
+  the staleness contract the read-back poll exists to absorb.
+- **ControllerRevisions** are delegate-read but cached keyed on the
+  DaemonSet cache's change generation: the watch plane does not carry
+  revisions, but a new revision only ever appears alongside a DS
+  update, so any DS event invalidates. The revision oracle's
+  steady-state read therefore costs zero API calls.
+- A **node→pods index** (:class:`NodePodIndex`) and per-consumer
+  **delta views** (:meth:`CachedReadClient.delta_view`) ride the
+  informer handler chain: the index serves ``spec.nodeName`` field
+  selectors without scanning, and the views let ``build_state`` patch
+  its previous snapshot instead of re-reading the cluster — O(delta)
+  per pass instead of O(cluster), falling back to a full rebuild only
+  on the first poll or after a resync.
 
 Use :meth:`CachedReadClient.has_synced` as the start-up barrier before
 the first reconcile, mirroring controller-runtime's
@@ -30,6 +40,7 @@ from __future__ import annotations
 import logging
 import threading
 import time
+from dataclasses import dataclass, field
 from typing import Callable, Mapping, Optional
 
 from tpu_operator_libs.k8s.client import K8sClient, NotFoundError
@@ -40,6 +51,7 @@ from tpu_operator_libs.k8s.objects import (
     Pod,
 )
 from tpu_operator_libs.k8s.selectors import (
+    exact_field_requirement,
     parse_field_selector,
     parse_label_selector,
 )
@@ -56,6 +68,137 @@ logger = logging.getLogger(__name__)
 
 class CacheNotSyncedError(RuntimeError):
     """A read was attempted before the initial list completed."""
+
+
+class NodePodIndex:
+    """node name → pods, maintained from the pod informer's watch deltas.
+
+    The apiserver serves ``spec.nodeName`` field selectors from an
+    index; a cached client must too, or a fleet-wide drain wave's
+    pods-on-node queries degenerate to O(pods) scans per node. The
+    index is wired as an ordinary informer event handler, so every
+    repair path the informer has (watch replay after a drop, overflow
+    BOOKMARK relist, periodic relist, write-through applies) updates it
+    for free — there is no second consistency protocol to get wrong.
+    Pods with no ``spec.nodeName`` (unscheduled) are not indexed; node
+    binding is immutable in Kubernetes, but a changed binding is
+    tolerated anyway (the stale entry is unlinked first).
+    """
+
+    def __init__(self) -> None:
+        self._lock = threading.Lock()
+        self._by_node: dict[str, dict[tuple[str, str], Pod]] = {}
+        self._node_of: dict[tuple[str, str], str] = {}
+
+    # -- informer handlers -------------------------------------------------
+    def on_add(self, obj: object) -> None:
+        self._link(obj)
+
+    def on_update(self, _old: object, new: object) -> None:
+        self._link(new)
+
+    def on_delete(self, obj: object) -> None:
+        meta = getattr(obj, "metadata", None)
+        if meta is None:
+            return
+        self._unlink((meta.namespace, meta.name))
+
+    def _link(self, obj: object) -> None:
+        pod = obj  # type: Pod
+        key = (pod.metadata.namespace, pod.metadata.name)
+        node = pod.spec.node_name
+        with self._lock:
+            previous = self._node_of.get(key)
+            if previous is not None and previous != node:
+                members = self._by_node.get(previous)
+                if members is not None:
+                    members.pop(key, None)
+                    if not members:
+                        del self._by_node[previous]
+            if not node:
+                self._node_of.pop(key, None)
+                return
+            self._node_of[key] = node
+            self._by_node.setdefault(node, {})[key] = pod
+
+    def _unlink(self, key: tuple[str, str]) -> None:
+        with self._lock:
+            node = self._node_of.pop(key, None)
+            if node is None:
+                return
+            members = self._by_node.get(node)
+            if members is not None:
+                members.pop(key, None)
+                if not members:
+                    del self._by_node[node]
+
+    # -- reads -------------------------------------------------------------
+    def pods_on(self, node_name: str) -> list[Pod]:
+        """Snapshot copies of the pods bound to ``node_name``."""
+        with self._lock:
+            return [p.clone()
+                    for p in self._by_node.get(node_name, {}).values()]
+
+    def node_count(self) -> int:
+        with self._lock:
+            return len(self._by_node)
+
+    def __len__(self) -> int:
+        with self._lock:
+            return len(self._node_of)
+
+
+@dataclass
+class ClusterDelta:
+    """What changed since a view's previous poll."""
+
+    full: bool = False            # consumer must rebuild from scratch
+    daemon_sets: bool = False     # any DaemonSet add/update/delete
+    nodes: set = field(default_factory=set)            # node names
+    pods: set = field(default_factory=set)             # (ns, name) keys
+
+    def empty(self) -> bool:
+        return not (self.full or self.daemon_sets
+                    or self.nodes or self.pods)
+
+
+class ClusterDeltaView:
+    """One consumer's cursor over the cache's change stream.
+
+    Every informer apply (watch event, relist repair, write-through)
+    marks the touched object dirty in every registered view;
+    :meth:`poll` hands the accumulated delta to the consumer and resets
+    it. The very first poll reports ``full=True`` — the consumer has no
+    prior snapshot to patch. Dirty sets are bounded by the object count
+    (sets dedup), so an idle consumer cannot leak.
+    """
+
+    def __init__(self) -> None:
+        self._lock = threading.Lock()
+        self._delta = ClusterDelta(full=True)
+
+    # -- producer (cache) --------------------------------------------------
+    def mark_node(self, name: str) -> None:
+        with self._lock:
+            self._delta.nodes.add(name)
+
+    def mark_pod(self, key: tuple[str, str]) -> None:
+        with self._lock:
+            self._delta.pods.add(key)
+
+    def mark_daemon_sets(self) -> None:
+        with self._lock:
+            self._delta.daemon_sets = True
+
+    def mark_full(self) -> None:
+        with self._lock:
+            self._delta.full = True
+
+    # -- consumer ----------------------------------------------------------
+    def poll(self) -> ClusterDelta:
+        with self._lock:
+            delta, self._delta = self._delta, ClusterDelta()
+            return delta
 
 
 class CachedReadClient(K8sClient):
@@ -92,6 +235,44 @@ class CachedReadClient(K8sClient):
             delegate.watch(kinds={KIND_DAEMON_SET}, namespace=namespace),
             name="ds-cache")
         self._informers = (self._nodes, self._pods, self._daemon_sets)
+        # node→pods index + delta fan-out ride the informer handler
+        # chain, BEFORE start(): initial-sync adds must flow through
+        # them too. Handler order matters — the index applies first so
+        # a delta-marked pod is already resolvable through the index.
+        self._pod_index = NodePodIndex()
+        self._pods.add_event_handler(on_add=self._pod_index.on_add,
+                                     on_update=self._pod_index.on_update,
+                                     on_delete=self._pod_index.on_delete)
+        self._views: list[ClusterDeltaView] = []
+        self._views_lock = threading.Lock()
+        self._counters_lock = threading.Lock()
+        #: API calls this client actually forwarded to the delegate
+        #: (cache misses + writes); cache hits cost zero. Exported by
+        #: metrics.observe_reconcile.
+        self.api_reads_total = 0
+        self.api_writes_total = 0
+        # ControllerRevision lists, cached keyed on the DS cache's
+        # change generation: a new revision only ever appears alongside
+        # a DaemonSet template update (a MODIFIED event), so any DS
+        # event invalidates. This removes the one remaining per-pass
+        # LIST the revision oracle issues in steady state — and is MORE
+        # snapshot-consistent than the uncached read, which could see
+        # revisions newer than the DS snapshot mid-pass.
+        self._revisions_gen = 0
+        self._revisions_cache: dict[tuple[str, str],
+                                    tuple[int, list[ControllerRevision]]] = {}
+        self._nodes.add_event_handler(
+            on_add=lambda obj: self._mark_node(obj),
+            on_update=lambda _old, new: self._mark_node(new),
+            on_delete=lambda obj: self._mark_node(obj))
+        self._pods.add_event_handler(
+            on_add=lambda obj: self._mark_pod(obj),
+            on_update=lambda _old, new: self._mark_pod(new),
+            on_delete=lambda obj: self._mark_pod(obj))
+        self._daemon_sets.add_event_handler(
+            on_add=lambda obj: self._mark_ds(),
+            on_update=lambda _old, new: self._mark_ds(),
+            on_delete=lambda obj: self._mark_ds())
         for informer in self._informers:
             informer.start()
         # A restarted live watch re-delivers current objects but never
@@ -108,6 +289,53 @@ class CachedReadClient(K8sClient):
                 target=self._relist_loop, args=(relist_interval,),
                 name="cache-relist", daemon=True)
             self._relist_thread.start()
+
+    # -- delta plumbing ---------------------------------------------------
+    def _mark_node(self, obj: object) -> None:
+        name = getattr(getattr(obj, "metadata", None), "name", None)
+        if name is None:
+            return
+        with self._views_lock:
+            for view in self._views:
+                view.mark_node(name)
+
+    def _mark_pod(self, obj: object) -> None:
+        meta = getattr(obj, "metadata", None)
+        if meta is None:
+            return
+        key = (meta.namespace, meta.name)
+        with self._views_lock:
+            for view in self._views:
+                view.mark_pod(key)
+
+    def _mark_ds(self) -> None:
+        with self._views_lock:
+            self._revisions_gen += 1
+            self._revisions_cache.clear()
+            for view in self._views:
+                view.mark_daemon_sets()
+
+    def delta_view(self) -> ClusterDeltaView:
+        """Register a new change-stream cursor (first poll reports a
+        full resync). The state manager's incremental build_state is
+        the intended consumer; each consumer gets its own view."""
+        view = ClusterDeltaView()
+        with self._views_lock:
+            self._views.append(view)
+        return view
+
+    @property
+    def pod_index(self) -> NodePodIndex:
+        """The watch-delta-maintained node→pods index."""
+        return self._pod_index
+
+    def _count_read(self) -> None:
+        with self._counters_lock:
+            self.api_reads_total += 1
+
+    def _count_write(self) -> None:
+        with self._counters_lock:
+            self.api_writes_total += 1
 
     # -- lifecycle --------------------------------------------------------
     def has_synced(self, timeout: Optional[float] = None) -> bool:
@@ -183,46 +411,115 @@ class CachedReadClient(K8sClient):
             # the drain/eviction/validation paths rely on that to see
             # workload pods outside the operator namespace — the
             # single-namespace cache cannot answer those queries.
+            self._count_read()
             return self._delegate.list_pods(namespace, label_selector,
                                             field_selector)
         label_match = parse_label_selector(label_selector)
+        node = exact_field_requirement(field_selector, "spec.nodeName")
+        if node:
+            # indexed pods-on-node path (the apiserver's own indexed
+            # field selector); full matchers still apply, so semantics
+            # are unchanged — only the candidate set narrows
+            field_match = parse_field_selector(field_selector)
+            return [p for p in self._pod_index.pods_on(node)
+                    if label_match(p.metadata.labels)
+                    and field_match(p.field_map())]
         field_match = parse_field_selector(field_selector)
         return [p.clone() for p in self._pods.list()
                 if label_match(p.metadata.labels)
                 and field_match(p.field_map())]
 
+    def get_pod(self, namespace: str, name: str) -> Pod:
+        self._barrier()
+        if namespace != self._namespace:
+            self._count_read()
+            return self._delegate.get_pod(namespace, name)
+        pod = self._pods.get(namespace, name)
+        if pod is None:
+            raise NotFoundError(f"pod {namespace}/{name} not found")
+        return pod.clone()
+
     def list_daemon_sets(self, namespace: str,
                          label_selector: str = "") -> list[DaemonSet]:
         self._barrier()
         if namespace != self._namespace:
+            self._count_read()
             return self._delegate.list_daemon_sets(namespace, label_selector)
         match = parse_label_selector(label_selector)
         return [d.clone() for d in self._daemon_sets.list()
                 if match(d.metadata.labels)]
 
-    # -- uncached reads ---------------------------------------------------
+    # -- revision reads (delegate-backed, DS-generation cached) -----------
     def list_controller_revisions(self, namespace: str,
                                   label_selector: str = "") -> list[ControllerRevision]:
-        return self._delegate.list_controller_revisions(
+        # The watch plane does not carry ControllerRevisions, so they
+        # cannot be informer-cached — but a new revision only appears
+        # together with a DaemonSet update, so the result is valid for
+        # as long as the DS cache sees no event. Keyed on that change
+        # generation, the revision oracle's steady-state read costs
+        # zero API calls; any DS event (including relist repairs after
+        # a watch gap) invalidates everything.
+        with self._views_lock:
+            gen = self._revisions_gen
+            cached = self._revisions_cache.get((namespace, label_selector))
+            if cached is not None and cached[0] == gen:
+                return [r.clone() for r in cached[1]]
+        self._count_read()
+        revisions = self._delegate.list_controller_revisions(
             namespace, label_selector)
+        with self._views_lock:
+            if self._revisions_gen == gen:
+                self._revisions_cache[(namespace, label_selector)] = (
+                    gen, [r.clone() for r in revisions])
+        return revisions
 
-    # -- writes (pass through; cache catches up via watch events) ---------
+    # -- writes (pass through + read-your-writes cache apply) -------------
+    # Each write's RESULT is applied to the informer store immediately
+    # (Informer.apply_external): the provider's read-back poll becomes a
+    # no-wait check and a transition wave pipelines instead of each
+    # write blocking on the watch round-trip. The mutation's own watch
+    # event lands later as an equal-value update.
     def patch_node_labels(self, name: str,
                           labels: Mapping[str, Optional[str]]) -> Node:
-        return self._delegate.patch_node_labels(name, labels)
+        self._count_write()
+        node = self._delegate.patch_node_labels(name, labels)
+        self._nodes.apply_external(node.clone())
+        return node
 
     def patch_node_annotations(self, name: str,
                                annotations: Mapping[str, Optional[str]]) -> Node:
-        return self._delegate.patch_node_annotations(name, annotations)
+        self._count_write()
+        node = self._delegate.patch_node_annotations(name, annotations)
+        self._nodes.apply_external(node.clone())
+        return node
+
+    def patch_node_meta(self, name: str,
+                        labels: Optional[Mapping[str, Optional[str]]] = None,
+                        annotations: Optional[Mapping[str, Optional[str]]]
+                        = None) -> Node:
+        self._count_write()
+        node = self._delegate.patch_node_meta(
+            name, labels=labels, annotations=annotations)
+        self._nodes.apply_external(node.clone())
+        return node
 
     def set_node_unschedulable(self, name: str, unschedulable: bool) -> Node:
-        return self._delegate.set_node_unschedulable(name, unschedulable)
+        self._count_write()
+        node = self._delegate.set_node_unschedulable(name, unschedulable)
+        self._nodes.apply_external(node.clone())
+        return node
 
     def delete_pod(self, namespace: str, name: str) -> None:
+        self._count_write()
         self._delegate.delete_pod(namespace, name)
+        if namespace == self._namespace:
+            self._pods.apply_external_delete(namespace, name)
 
     def evict_pod(self, namespace: str, name: str) -> None:
+        self._count_write()
         self._delegate.evict_pod(namespace, name)
+        if namespace == self._namespace:
+            self._pods.apply_external_delete(namespace, name)
 
     def upsert_event(self, namespace: str, name: str,
                      event: object) -> None:

@@ -133,6 +133,10 @@ class FakeCluster(K8sClient):
         # exercise the provider's cache-sync poll loop
         # (node_upgrade_state_provider.go:100-117).
         self._stale_reads: dict[str, tuple[int, Node]] = {}
+        # Per-operation count of every API call served — the wire-cost
+        # instrumentation tools/reconcile_bench.py diffs to prove the
+        # watch-indexed read path actually eliminates per-pass LISTs.
+        self._api_call_counts: dict[str, int] = {}
         # Per-operation budget of injected transient API failures
         # (apiserver 5xx / connection-reset modeling); consumed one per
         # call. The reference's answer to such errors is abort-the-pass +
@@ -403,7 +407,24 @@ class FakeCluster(K8sClient):
             else:
                 self._api_error_exc.pop(operation, None)
 
+    def api_call_counts(self) -> dict[str, int]:
+        """Snapshot of API calls served per operation (every K8sClient
+        entry point counts itself on entry, successes and injected
+        failures alike — a failed wire call still cost a round trip)."""
+        with self._lock:
+            return dict(self._api_call_counts)
+
+    def reset_api_call_counts(self) -> None:
+        with self._lock:
+            self._api_call_counts.clear()
+
     def _maybe_api_error(self, operation: str) -> None:
+        with self._lock:
+            self._api_call_counts[operation] = (
+                self._api_call_counts.get(operation, 0) + 1)
+        self._consume_injected_error(operation)
+
+    def _consume_injected_error(self, operation: str) -> None:
         with self._lock:
             remaining = self._api_errors.get(operation, 0)
             if remaining <= 0:
@@ -522,6 +543,39 @@ class FakeCluster(K8sClient):
         with self._lock:
             node = self._mutate_node(name)
             for key, value in annotations.items():
+                if value is None:
+                    node.metadata.annotations.pop(key, None)
+                else:
+                    node.metadata.annotations[key] = value
+            self._notify(MODIFIED, KIND_NODE, node)
+            return node.clone()
+
+    def patch_node_meta(self, name: str,
+                        labels: Optional[Mapping[str, Optional[str]]] = None,
+                        annotations: Optional[Mapping[str, Optional[str]]]
+                        = None) -> Node:
+        """One atomic metadata merge patch (labels + annotations, one
+        watch event) — the coalesced-write path. Consumes the SAME
+        injected-error budgets as the split patches so fault schedules
+        targeting patch_node_labels / patch_node_annotations still bite
+        coalesced writers."""
+        with self._lock:
+            # one wire request, one count (the split ops' injected-error
+            # budgets are still consumed below)
+            self._api_call_counts["patch_node_meta"] = (
+                self._api_call_counts.get("patch_node_meta", 0) + 1)
+        if labels:
+            self._consume_injected_error("patch_node_labels")
+        if annotations:
+            self._consume_injected_error("patch_node_annotations")
+        with self._lock:
+            node = self._mutate_node(name)
+            for key, value in (labels or {}).items():
+                if value is None:
+                    node.metadata.labels.pop(key, None)
+                else:
+                    node.metadata.labels[key] = value
+            for key, value in (annotations or {}).items():
                 if value is None:
                     node.metadata.annotations.pop(key, None)
                 else:

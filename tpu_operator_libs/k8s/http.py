@@ -489,6 +489,24 @@ class HttpCluster(K8sClient):
         return node_from_json(self._request(
             "PATCH", f"/api/v1/nodes/{name}", body, _MERGE_PATCH))
 
+    def patch_node_meta(self, name: str,
+                        labels: Optional[Mapping[str, Optional[str]]] = None,
+                        annotations: Optional[Mapping[str, Optional[str]]]
+                        = None) -> Node:
+        # the coalesced-write path: labels + annotations in ONE
+        # merge-patch request — crash-atomic and half the round trips
+        # of the split patches the base-class fallback issues
+        meta: dict = {}
+        if labels:
+            meta["labels"] = dict(labels)
+        if annotations:
+            meta["annotations"] = dict(annotations)
+        if not meta:
+            return self.get_node(name)
+        return node_from_json(self._request(
+            "PATCH", f"/api/v1/nodes/{name}", {"metadata": meta},
+            _MERGE_PATCH))
+
     def set_node_unschedulable(self, name: str,
                                unschedulable: bool) -> Node:
         return node_from_json(self._request(

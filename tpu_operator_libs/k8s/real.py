@@ -327,6 +327,25 @@ class RealCluster(K8sClient):
         except self._k8s.ApiException as exc:
             raise self._translate(exc) from exc
 
+    def patch_node_meta(self, name: str,
+                        labels: Optional[Mapping[str, Optional[str]]] = None,
+                        annotations: Optional[Mapping[str, Optional[str]]]
+                        = None) -> Node:
+        # coalesced-write path: one strategic/merge patch carrying both
+        # metadata maps instead of the base class's two requests
+        meta: dict = {}
+        if labels:
+            meta["labels"] = dict(labels)
+        if annotations:
+            meta["annotations"] = dict(annotations)
+        if not meta:
+            return self.get_node(name)
+        try:
+            return _node_from(self._core.patch_node(
+                name, {"metadata": meta}))
+        except self._k8s.ApiException as exc:
+            raise self._translate(exc) from exc
+
     def set_node_unschedulable(self, name: str, unschedulable: bool) -> Node:
         body = {"spec": {"unschedulable": unschedulable}}
         try:

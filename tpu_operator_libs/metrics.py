@@ -248,6 +248,61 @@ def observe_cluster_state(registry: MetricsRegistry,
                          "apply_state passes executed", labels)
 
 
+def observe_reconcile(registry: MetricsRegistry,
+                      manager: "ClusterUpgradeStateManager",
+                      state: "ClusterUpgradeState",
+                      duration_seconds: float,
+                      client: Optional[object] = None,
+                      driver: str = "libtpu") -> None:
+    """Record one reconcile pass's control-plane cost.
+
+    The fleet-scale evidence trio: pass duration (histogram), per-bucket
+    node counts, and the wire-cost counters — API reads/writes the
+    cached client actually forwarded to the apiserver, durable node
+    writes the provider issued, and the patches it AVOIDED by
+    coalescing a transition's label + annotation changes into one merge
+    patch. ``client`` is optional (a CachedReadClient or anything
+    exposing ``api_reads_total``/``api_writes_total``); absent counters
+    export nothing rather than a misleading zero.
+    """
+    labels = {"driver": driver}
+    registry.observe_histogram(
+        "reconcile_pass_seconds", duration_seconds,
+        "Wall-clock seconds per build_state+apply_state pass", labels)
+    for s in ALL_STATES:
+        registry.set_gauge(
+            "reconcile_bucket_nodes", len(state.bucket(s)),
+            "Node count per upgrade-state bucket at the last pass",
+            {**labels, "state": str(s) or "unknown"})
+    registry.set_gauge(
+        "reconcile_transient_deferrals", manager.last_pass_deferrals,
+        "Per-node transitions deferred on transient errors, last pass",
+        labels)
+    provider = getattr(manager, "provider", None)
+    writes = getattr(provider, "writes_total", None)
+    if writes is not None:
+        registry.set_counter_total(
+            "reconcile_node_writes_total", writes,
+            "Durable node patches issued by the state provider", labels)
+    saved = getattr(provider, "coalesced_writes_saved_total", None)
+    if saved is not None:
+        registry.set_counter_total(
+            "reconcile_coalesced_writes_saved_total", saved,
+            "Wire patches avoided by coalescing label+annotation "
+            "changes into one merge patch", labels)
+    api_reads = getattr(client, "api_reads_total", None)
+    if api_reads is not None:
+        registry.set_counter_total(
+            "reconcile_api_reads_total", api_reads,
+            "Reads the cached client forwarded to the apiserver "
+            "(cache hits cost zero)", labels)
+    api_writes = getattr(client, "api_writes_total", None)
+    if api_writes is not None:
+        registry.set_counter_total(
+            "reconcile_api_writes_total", api_writes,
+            "Writes forwarded to the apiserver", labels)
+
+
 #: Buckets for wedge→recovered durations: remediation rides restart /
 #: reboot / revalidation-settle timescales (minutes to hours), not the
 #: reconcile-latency scale DEFAULT_BUCKETS covers.

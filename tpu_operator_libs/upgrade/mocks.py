@@ -65,8 +65,10 @@ class MockNodeUpgradeStateProvider(RecordingMixin):
             "MockNodeUpgradeStateProvider has no store; tests build "
             "snapshots directly")
 
-    def change_node_upgrade_state(self, node: Node,
-                                  new_state: UpgradeState | str) -> bool:
+    def change_node_upgrade_state(
+            self, node: Node, new_state: UpgradeState | str,
+            annotations: "Optional[dict[str, Optional[str]]]" = None,
+    ) -> bool:
         self.record("change_node_upgrade_state", node.metadata.name,
                     str(new_state))
         self._maybe_fail()
@@ -78,6 +80,13 @@ class MockNodeUpgradeStateProvider(RecordingMixin):
             return False  # stale snapshot, same as the real provider
         self.live_states[name] = value
         node.metadata.labels[self.keys.state_label] = value
+        # coalesced annotations commit with the label, like the real
+        # provider's single merge patch
+        for key, ann_value in (annotations or {}).items():
+            if ann_value is None or ann_value == NULL_STRING:
+                node.metadata.annotations.pop(key, None)
+            else:
+                node.metadata.annotations[key] = ann_value
         return True
 
     def change_node_upgrade_annotation(self, node: Node, key: str,
@@ -165,6 +174,11 @@ class MockPodManager(RecordingMixin):
     def get_daemon_set_revision_hash(self, ds: DaemonSet) -> str:
         self.record("get_daemon_set_revision_hash", ds.name)
         return self.ds_hashes.get(ds.name, self.default_hash)
+
+    def reset_revision_cache(self) -> None:
+        # deliberately not recorded: it is per-pass bookkeeping, and
+        # recording it would pollute call-sequence assertions
+        pass
 
     def schedule_pod_eviction(self, config: PodManagerConfig) -> None:
         self.record("schedule_pod_eviction",

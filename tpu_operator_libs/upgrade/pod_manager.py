@@ -18,6 +18,7 @@ d. revision-hash getters — the "does this node need an upgrade" oracle:
 from __future__ import annotations
 
 import logging
+import threading
 from dataclasses import dataclass, field
 from typing import Callable, Optional
 
@@ -96,6 +97,12 @@ class PodManager:
         self._worker = worker or Worker()
         self._nodes_in_progress = NameSet()
         self._keys = provider.keys
+        # Per-snapshot revision-oracle memo (see
+        # get_daemon_set_revision_hash); reset by the state manager at
+        # every build_state. Locked: bucket workers consult it in
+        # parallel.
+        self._revision_memo_lock = threading.Lock()
+        self._revision_memo: dict[str, str] = {}
 
     @property
     def deletion_filter(self) -> Optional[PodDeletionFilter]:
@@ -125,6 +132,12 @@ class PodManager:
                 f"controller-revision-hash label not present for pod "
                 f"{pod.name}") from None
 
+    def reset_revision_cache(self) -> None:
+        """Drop the per-snapshot revision memo (called by the state
+        manager at the start of every build_state)."""
+        with self._revision_memo_lock:
+            self._revision_memo.clear()
+
     def get_daemon_set_revision_hash(self, ds: DaemonSet) -> str:
         """Newest ControllerRevision hash for the DaemonSet
         (pod_manager.go:95-121).
@@ -134,7 +147,19 @@ class PodManager:
         (pod_manager.go:106). We additionally require the suffix after
         ``<name>-`` to be a single hash segment (no further dashes), which
         holds for controller-generated revision names.
+
+        Memoized per snapshot (keyed by DS UID, reset each build_state):
+        the in-sync oracle runs once per NODE per pass, and without the
+        memo a 1024-node steady-state pass issued 1024 identical
+        ControllerRevision LISTs — the dominant per-pass API fan-out at
+        fleet scale. Within one snapshot the newest revision is
+        immutable by construction, so the memo cannot change any
+        decision.
         """
+        with self._revision_memo_lock:
+            memoized = self._revision_memo.get(ds.metadata.uid)
+        if memoized is not None:
+            return memoized
         selector = selector_from_labels(ds.spec.selector)
         revisions = self._client.list_controller_revisions(
             ds.metadata.namespace, selector)
@@ -146,7 +171,10 @@ class PodManager:
             raise RevisionHashError(
                 f"no revision found for daemonset {ds.metadata.name}")
         newest = max(owned, key=lambda r: r.revision)
-        return newest.metadata.name[len(prefix):]
+        result = newest.metadata.name[len(prefix):]
+        with self._revision_memo_lock:
+            self._revision_memo[ds.metadata.uid] = result
+        return result
 
     # ------------------------------------------------------------------
     # (a) pod eviction
@@ -180,20 +208,55 @@ class PodManager:
             clock=self._clock,
         )
 
+        # ONE all-namespaces LIST grouped by spec.nodeName instead of a
+        # pods-on-node LIST per target node: a fleet-scale eviction wave
+        # previously paid O(wave) apiserver LIST round-trips before the
+        # first pod was touched. Error semantics match the old per-node
+        # list exactly, applied wave-wide: a transient failure parks
+        # every node for the next reconcile; a non-transient one takes
+        # the reference's drain-or-failed escalation
+        # (pod_manager.go:396-406) for each node.
+        try:
+            pods_by_node = self._pods_by_node(self._client.list_pods(
+                namespace=None))
+        except (ApiServerError, ConflictError) as exc:
+            logger.warning("transient error listing pods for eviction "
+                           "wave; deferring %d node(s): %s",
+                           len(config.nodes), exc)
+            return
+        except Exception as exc:  # noqa: BLE001 — reference escalation path
+            logger.error("failed to list pods for eviction wave: %s", exc)
+            for node in config.nodes:
+                log_event(self._recorder, node, Event.WARNING,
+                          self._keys.event_reason,
+                          f"Failed to delete workload pods on the node for "
+                          f"the runtime upgrade: {exc}")
+                self._update_node_to_drain_or_failed(
+                    node, config.drain_enabled)
+            return
         for node in config.nodes:
             if not self._nodes_in_progress.add(node.metadata.name):
                 logger.info("node %s already getting pods deleted, skipping",
                             node.metadata.name)
                 continue
+            node_pods = pods_by_node.get(node.metadata.name, [])
             self._worker.submit(
-                lambda n=node: self._evict_node_pods(n, helper, config))
+                lambda n=node, p=node_pods: self._evict_node_pods(
+                    n, helper, config, p))
+
+    @staticmethod
+    def _pods_by_node(pods: list[Pod]) -> dict[str, list[Pod]]:
+        grouped: dict[str, list[Pod]] = {}
+        for pod in pods:
+            if pod.spec.node_name:
+                grouped.setdefault(pod.spec.node_name, []).append(pod)
+        return grouped
 
     def _evict_node_pods(self, node: Node, helper: DrainHelper,
-                         config: PodManagerConfig) -> None:
+                         config: PodManagerConfig,
+                         pods: list[Pod]) -> None:
         name = node.metadata.name
         try:
-            pods = self._client.list_pods(
-                namespace=None, field_selector=f"spec.nodeName={name}")
             to_delete = [p for p in pods if self._deletion_filter(p)]
             if not to_delete:
                 logger.info("no pods require deletion on node %s", name)
@@ -324,10 +387,20 @@ class PodManager:
         """
         spec = config.wait_for_completion_spec
         assert spec is not None
+        # ONE selector LIST grouped by node instead of a LIST per
+        # waiting node (the same O(wave)→O(1) wire-cost fix as the
+        # eviction path). A transient failure leaves every node parked
+        # in wait-for-jobs for the next reconcile.
+        try:
+            pods_by_node = self._pods_by_node(self._client.list_pods(
+                namespace=None, label_selector=spec.pod_selector))
+        except (ApiServerError, ConflictError) as exc:
+            logger.warning("transient error listing workload pods for "
+                           "completion checks; deferring %d node(s): %s",
+                           len(config.nodes), exc)
+            return
         for node in config.nodes:
-            pods = self._client.list_pods(
-                namespace=None, label_selector=spec.pod_selector,
-                field_selector=f"spec.nodeName={node.metadata.name}")
+            pods = pods_by_node.get(node.metadata.name, [])
             running = any(self.is_pod_running_or_pending(p) for p in pods)
             if running:
                 logger.info("workload pods still running on node %s",
@@ -344,16 +417,18 @@ class PodManager:
                 continue
             annotation = self._keys.pod_completion_start_annotation
             try:
-                self._provider.change_node_upgrade_annotation(
-                    node, annotation, None)
-            except Exception as exc:  # noqa: BLE001
+                # timer-stamp removal rides the transition's merge
+                # patch: one write, crash-atomic
+                self._provider.change_node_upgrade_state(
+                    node, UpgradeState.POD_DELETION_REQUIRED,
+                    annotations={annotation: None})
+            except Exception as exc:  # noqa: BLE001 — worker boundary
+                logger.error("failed to advance node %s past job "
+                             "completion: %s", node.metadata.name, exc)
                 log_event(self._recorder, node, Event.WARNING,
                           self._keys.event_reason,
-                          f"Failed to remove annotation used to track job "
+                          f"Failed to advance node after job "
                           f"completions: {exc}")
-                continue
-            self._change_state_quietly(
-                node, UpgradeState.POD_DELETION_REQUIRED)
 
     def handle_timeout_on_pod_completions(self, node: Node,
                                           timeout_seconds: int) -> None:
@@ -369,12 +444,20 @@ class PodManager:
             return
         start = int(stamp)
         if now > start + timeout_seconds:
-            self._change_state_quietly(
-                node, UpgradeState.POD_DELETION_REQUIRED)
+            # forced advance + stamp removal as ONE merge patch (the
+            # split form could crash between the two writes and leave a
+            # stale stamp for the next wait to misread)
+            try:
+                self._provider.change_node_upgrade_state(
+                    node, UpgradeState.POD_DELETION_REQUIRED,
+                    annotations={annotation: None})
+            except Exception as exc:  # noqa: BLE001 — worker boundary
+                logger.error("failed to change state of node %s to %s: %s",
+                             node.metadata.name,
+                             UpgradeState.POD_DELETION_REQUIRED, exc)
+                return
             logger.info("timeout exceeded for job completions on node %s",
                         node.metadata.name)
-            self._provider.change_node_upgrade_annotation(
-                node, annotation, None)
 
     @staticmethod
     def is_pod_running_or_pending(pod: Pod) -> bool:

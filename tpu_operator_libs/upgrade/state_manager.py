@@ -38,8 +38,9 @@ from __future__ import annotations
 
 import contextlib
 import logging
+import threading
 from dataclasses import dataclass, field
-from typing import TYPE_CHECKING, Optional, Protocol
+from typing import TYPE_CHECKING, Callable, Optional, Protocol
 
 if TYPE_CHECKING:  # pragma: no cover - import cycle guard (topology
     # imports k8s.objects; planner imports this module's state types)
@@ -67,7 +68,10 @@ from tpu_operator_libs.k8s.client import (
     NotFoundError,
 )
 from tpu_operator_libs.k8s.objects import DaemonSet, Node, Pod, PodPhase
-from tpu_operator_libs.k8s.selectors import selector_from_labels
+from tpu_operator_libs.k8s.selectors import (
+    parse_label_selector,
+    selector_from_labels,
+)
 from tpu_operator_libs.upgrade.cordon_manager import CordonManager
 from tpu_operator_libs.upgrade.drain_manager import (
     DrainConfiguration,
@@ -85,6 +89,7 @@ from tpu_operator_libs.upgrade.validation_manager import (
     NodeValidator,
     ValidationManager,
 )
+from tpu_operator_libs.upgrade.worker_pool import BoundedKeyedPool
 from tpu_operator_libs.util import Clock, EventRecorder, Worker
 
 logger = logging.getLogger(__name__)
@@ -191,7 +196,9 @@ class ClusterUpgradeStateManager:
                  safe_load_manager: Optional[SafeRuntimeLoadManager] = None,
                  planner: Optional[UpgradePlanner] = None,
                  sync_timeout: float = 10.0,
-                 poll_interval: float = 1.0) -> None:
+                 poll_interval: float = 1.0,
+                 parallel_workers: int = 0,
+                 incremental_reads: bool = True) -> None:
         self.keys = keys or UpgradeKeys()
         self.client = client
         self.recorder = recorder
@@ -226,6 +233,28 @@ class ClusterUpgradeStateManager:
         # no state-machine meaning — apply_state stays snapshot-driven)
         self._warned_vanished: set[str] = set()
         self._validation_enabled = False
+        # Bounded keyed pool for per-node bucket fan-out: the
+        # independent process_* transitions of one bucket run on
+        # parallel_workers threads, with a barrier per bucket, so every
+        # pass still commits bucket-by-bucket in the reference's order.
+        # Budget admission (planner.plan + the throttle math) stays
+        # serialized at a single point regardless. 0 = serial (the
+        # reference's semantics, and the default for tests).
+        self._pool = (BoundedKeyedPool(max_workers=parallel_workers,
+                                       name="bucket-pool")
+                      if parallel_workers > 0 else None)
+        # Incremental snapshot state for delta-capable clients
+        # (CachedReadClient.delta_view): the previous pass's raw inputs,
+        # patched per pass by the cache's change stream instead of
+        # re-read wholesale — O(delta) reads per pass.
+        self._incremental_reads = incremental_reads
+        self._delta_view = None
+        self._inputs_key: Optional[tuple[str, str]] = None
+        self._inputs_ds: dict[str, DaemonSet] = {}
+        self._inputs_pods: dict[tuple[str, str], Pod] = {}
+        self._inputs_nodes: dict[str, Node] = {}
+        # deferral counters are bumped from pool threads too
+        self._deferral_lock = threading.Lock()
         #: Lifetime count of per-node transitions deferred on a
         #: transient cluster error (see _defer_node_on_transient).
         self._transient_deferrals = 0
@@ -310,21 +339,97 @@ class ClusterUpgradeStateManager:
     # ------------------------------------------------------------------
     def build_state(self, namespace: str,
                     runtime_labels: dict[str, str]) -> ClusterUpgradeState:
-        """Snapshot runtime DaemonSets + pods + nodes into state buckets."""
-        state = ClusterUpgradeState()
+        """Snapshot runtime DaemonSets + pods + nodes into state buckets.
+
+        Reads go one of two ways: a plain client is re-listed wholesale
+        every pass (reference semantics — but one bulk LIST instead of
+        the reference's GET per pod, upgrade_state.go:285); a
+        delta-capable client (CachedReadClient) is consulted only for
+        the objects its watch stream marked dirty since the previous
+        pass, the prior inputs are patched in place, and only a resync
+        (first pass, watch overflow relist, selector change) falls back
+        to the full re-read — per-pass read cost O(delta), not
+        O(cluster). Both paths feed the same assembly, so the snapshot
+        semantics are byte-identical (pinned by the mock-parity test).
+        """
+        reset_memo = getattr(self.pod_manager, "reset_revision_cache", None)
+        if reset_memo is not None:
+            # the revision oracle's memo is per-snapshot: within one
+            # pass a DaemonSet's newest revision is immutable
+            reset_memo()
         selector = selector_from_labels(runtime_labels)
+        daemon_sets, pods, nodes_by_name = self._snapshot_inputs(
+            namespace, selector)
+        return self._assemble_state(daemon_sets, pods, nodes_by_name)
+
+    def _full_inputs(self, namespace: str, selector: str) -> tuple[
+            dict[str, DaemonSet], list[Pod], dict[str, Node]]:
         daemon_sets = {ds.metadata.uid: ds
                        for ds in self.client.list_daemon_sets(
                            namespace, selector)}
         pods = self.client.list_pods(namespace=namespace,
                                      label_selector=selector)
-        # One bulk LIST instead of a GET per pod: the reference issues
-        # N GetNode round-trips per snapshot (upgrade_state.go:285); at
-        # TPU-fleet scale (1024 hosts) that is 1024 apiserver RPCs per
-        # reconcile for data a single quorum list returns atomically —
-        # and a single list is a more consistent snapshot besides.
         nodes_by_name = {n.metadata.name: n
                          for n in self.client.list_nodes()}
+        return daemon_sets, pods, nodes_by_name
+
+    def _snapshot_inputs(self, namespace: str, selector: str) -> tuple[
+            dict[str, DaemonSet], list[Pod], dict[str, Node]]:
+        factory = (getattr(self.client, "delta_view", None)
+                   if self._incremental_reads else None)
+        if factory is None:
+            return self._full_inputs(namespace, selector)
+        if self._delta_view is None:
+            self._delta_view = factory()
+        delta = self._delta_view.poll()
+        key = (namespace, selector)
+        try:
+            if delta.full or self._inputs_key != key:
+                ds, pods, nodes = self._full_inputs(namespace, selector)
+                self._inputs_key = key
+                self._inputs_ds = ds
+                self._inputs_pods = {
+                    (p.metadata.namespace, p.metadata.name): p
+                    for p in pods}
+                self._inputs_nodes = nodes
+                return ds, pods, nodes
+            if delta.daemon_sets:
+                self._inputs_ds = {
+                    ds.metadata.uid: ds
+                    for ds in self.client.list_daemon_sets(
+                        namespace, selector)}
+            if delta.pods:
+                label_match = parse_label_selector(selector)
+                for pod_key in delta.pods:
+                    if pod_key[0] != namespace:
+                        continue
+                    try:
+                        pod = self.client.get_pod(*pod_key)
+                    except NotFoundError:
+                        pod = None
+                    if pod is None or not label_match(pod.metadata.labels):
+                        self._inputs_pods.pop(pod_key, None)
+                    else:
+                        self._inputs_pods[pod_key] = pod
+            for name in delta.nodes:
+                try:
+                    self._inputs_nodes[name] = self.client.get_node(name)
+                except NotFoundError:
+                    self._inputs_nodes.pop(name, None)
+        except Exception:
+            # the delta was consumed but not fully applied: without
+            # this the lost entries would leave the snapshot stale
+            # FOREVER. Force a full rebuild on the next pass.
+            self._delta_view.mark_full()
+            raise
+        return (self._inputs_ds, list(self._inputs_pods.values()),
+                self._inputs_nodes)
+
+    def _assemble_state(self, daemon_sets: dict[str, DaemonSet],
+                        pods: list[Pod],
+                        nodes_by_name: dict[str, Node]) -> ClusterUpgradeState:
+        """Bucket the raw snapshot inputs — pure CPU, no cluster reads."""
+        state = ClusterUpgradeState()
         # Deliberate delta from the reference, which errors the whole
         # BuildState on a vanished node (upgrade_state.go:285 error
         # path): a node deleted mid-upgrade (scale-down, repair) leaves
@@ -517,70 +622,98 @@ class ClusterUpgradeStateManager:
                 "transient cluster error during %s for node %s; "
                 "deferring the node to the next reconcile: %s",
                 action, node.metadata.name, exc)
-            self._transient_deferrals += 1
-            self.last_pass_deferrals += 1
+            with self._deferral_lock:
+                self._transient_deferrals += 1
+                self.last_pass_deferrals += 1
+
+    def _map_bucket(self, items: list, action: str,
+                    body: Callable) -> list:
+        """Run ``body(item)`` per item under per-node transient
+        isolation — on the bounded worker pool when one is configured,
+        else serially. Results come back in input order (None for
+        deferred items); the pool barrier means the whole bucket has
+        committed before this returns, so bucket ordering, crash-resume
+        and the chaos monitor's per-tick audits all see the same
+        pass structure as the serial reference. Hard errors surface
+        after the barrier (serial mode: immediately), aborting the pass
+        exactly like the reference."""
+        def one(item):
+            node = item.node if isinstance(item, NodeUpgradeState) else item
+            with self._defer_node_on_transient(node, action):
+                return body(item)
+            return None  # transient error: node deferred to next pass
+
+        # Small buckets run inline: fanning out 2-3 items costs more in
+        # thread spawn than the overlap buys; the pool earns its keep on
+        # wave-sized buckets (maxUnavailable worth of write round-trips).
+        if self._pool is None or len(items) < 4:
+            return [one(item) for item in items]
+        return self._pool.map_wait(
+            [lambda it=item: one(it) for item in items])
 
     def process_done_or_unknown_nodes(self, state: ClusterUpgradeState,
                                       bucket: UpgradeState) -> None:
         """Decide done vs upgrade-required for idle nodes
         (upgrade_state.go:486-550)."""
-        for ns in state.bucket(bucket):
-            with self._defer_node_on_transient(ns.node, "idle triage"):
-                pod_synced, orphaned = self._pod_in_sync_with_ds(ns)
-                upgrade_requested = self._is_upgrade_requested(ns.node)
-                waiting_safe_load = (
-                    self.safe_load_manager.is_waiting_for_safe_load(
-                        ns.node))
-                if (not pod_synced and not orphaned) or waiting_safe_load \
-                        or upgrade_requested:
-                    if self._skip_node_upgrade(ns.node):
-                        # Honor the skip label HERE, not only at
-                        # admission: a remediation-parked node is
-                        # typically CORDONED by that machine, and
-                        # entering upgrade-required now would capture
-                        # that quarantine cordon as the "node was
-                        # unschedulable before the upgrade" memory —
-                        # the upgrade would then finish without an
-                        # uncordon and strand the node (found by the
-                        # chaos harness, seed 10).
-                        logger.info(
-                            "node %s is marked to skip upgrades; "
-                            "leaving idle", ns.node.metadata.name)
-                        continue
-                    if ns.node.is_unschedulable():
-                        # Remember pre-upgrade cordon so we restore it at
-                        # the end (upgrade_state.go:509-523).
-                        self.provider.change_node_upgrade_annotation(
-                            ns.node, self.keys.initial_state_annotation,
-                            TRUE_STRING)
-                    elif self.keys.initial_state_annotation \
-                            in ns.node.metadata.annotations:
-                        # Crash residue: the finishing pass committed the
-                        # state but died before deleting the marker. A
-                        # SCHEDULABLE node starting a new upgrade with it
-                        # would be remembered as "cordoned before the
-                        # upgrade" and left cordoned forever at its end.
-                        self.provider.change_node_upgrade_annotation(
-                            ns.node, self.keys.initial_state_annotation,
-                            None)
-                    self.provider.change_node_upgrade_state(
-                        ns.node, UpgradeState.UPGRADE_REQUIRED)
-                    logger.info("node %s requires upgrade",
-                                ns.node.metadata.name)
-                    continue
-                if bucket == UpgradeState.DONE and \
-                        self.keys.initial_state_annotation \
+        def triage(ns: NodeUpgradeState) -> None:
+            pod_synced, orphaned = self._pod_in_sync_with_ds(ns)
+            upgrade_requested = self._is_upgrade_requested(ns.node)
+            waiting_safe_load = (
+                self.safe_load_manager.is_waiting_for_safe_load(
+                    ns.node))
+            if (not pod_synced and not orphaned) or waiting_safe_load \
+                    or upgrade_requested:
+                if self._skip_node_upgrade(ns.node):
+                    # Honor the skip label HERE, not only at
+                    # admission: a remediation-parked node is
+                    # typically CORDONED by that machine, and
+                    # entering upgrade-required now would capture
+                    # that quarantine cordon as the "node was
+                    # unschedulable before the upgrade" memory —
+                    # the upgrade would then finish without an
+                    # uncordon and strand the node (found by the
+                    # chaos harness, seed 10).
+                    logger.info(
+                        "node %s is marked to skip upgrades; "
+                        "leaving idle", ns.node.metadata.name)
+                    return
+                annotations: dict[str, Optional[str]] = {}
+                if ns.node.is_unschedulable():
+                    # Remember pre-upgrade cordon so we restore it at
+                    # the end (upgrade_state.go:509-523).
+                    annotations[self.keys.initial_state_annotation] = \
+                        TRUE_STRING
+                elif self.keys.initial_state_annotation \
                         in ns.node.metadata.annotations:
-                    # Crash residue on an idle node (the finish path
-                    # deletes the marker right after the DONE commit);
-                    # the cordon itself is untouched — DONE+marker only
-                    # arises on the pre-cordoned arc, which must stay
-                    # cordoned.
-                    self.provider.change_node_upgrade_annotation(
-                        ns.node, self.keys.initial_state_annotation, None)
-                if bucket == UpgradeState.UNKNOWN:
-                    self.provider.change_node_upgrade_state(
-                        ns.node, UpgradeState.DONE)
+                    # Crash residue: the finishing pass committed the
+                    # state but died before deleting the marker. A
+                    # SCHEDULABLE node starting a new upgrade with it
+                    # would be remembered as "cordoned before the
+                    # upgrade" and left cordoned forever at its end.
+                    annotations[self.keys.initial_state_annotation] = None
+                # annotation bookkeeping rides the state transition's
+                # merge patch: one write, crash-atomic
+                self.provider.change_node_upgrade_state(
+                    ns.node, UpgradeState.UPGRADE_REQUIRED,
+                    annotations=annotations or None)
+                logger.info("node %s requires upgrade",
+                            ns.node.metadata.name)
+                return
+            if bucket == UpgradeState.DONE and \
+                    self.keys.initial_state_annotation \
+                    in ns.node.metadata.annotations:
+                # Crash residue on an idle node (the finish path
+                # deletes the marker right after the DONE commit);
+                # the cordon itself is untouched — DONE+marker only
+                # arises on the pre-cordoned arc, which must stay
+                # cordoned.
+                self.provider.change_node_upgrade_annotation(
+                    ns.node, self.keys.initial_state_annotation, None)
+            if bucket == UpgradeState.UNKNOWN:
+                self.provider.change_node_upgrade_state(
+                    ns.node, UpgradeState.DONE)
+
+        self._map_bucket(state.bucket(bucket), "idle triage", triage)
 
     @property
     def multislice_deferred_slices(self) -> tuple[str, ...]:
@@ -655,36 +788,48 @@ class ClusterUpgradeStateManager:
         unless they pass one.
         """
         planner = planner or self.planner
-        candidates = []
-        for ns in state.bucket(UpgradeState.UPGRADE_REQUIRED):
-            with self._defer_node_on_transient(ns.node,
-                                               "upgrade triage"):
-                if self._is_upgrade_requested(ns.node):
-                    # one-shot trigger: consume the annotation
-                    self.provider.change_node_upgrade_annotation(
-                        ns.node, self.keys.upgrade_requested_annotation,
-                        None)
-                if self._skip_node_upgrade(ns.node):
-                    logger.info("node %s is marked to skip upgrades",
-                                ns.node.metadata.name)
-                    continue
-                candidates.append(ns)
-        for ns in planner.plan(candidates, upgrades_available, state):
+
+        def triage(ns: NodeUpgradeState) -> Optional[NodeUpgradeState]:
+            if self._is_upgrade_requested(ns.node):
+                # one-shot trigger: consume the annotation
+                self.provider.change_node_upgrade_annotation(
+                    ns.node, self.keys.upgrade_requested_annotation,
+                    None)
+            if self._skip_node_upgrade(ns.node):
+                logger.info("node %s is marked to skip upgrades",
+                            ns.node.metadata.name)
+                return None
+            return ns
+
+        # triage fans out; ADMISSION does not: planner.plan runs once,
+        # serially, over the ordered candidate list — the single point
+        # where the max-unavailable / max-parallel budgets are spent,
+        # which is what keeps the chaos invariants exact under the
+        # parallel pool.
+        candidates = [ns for ns in self._map_bucket(
+            state.bucket(UpgradeState.UPGRADE_REQUIRED),
+            "upgrade triage", triage) if ns is not None]
+
+        def start(ns: NodeUpgradeState) -> None:
             # a deferred node's slot stays consumed for this pass —
             # conservative under the throttle, corrected next pass
-            with self._defer_node_on_transient(ns.node, "upgrade start"):
-                self.provider.change_node_upgrade_state(
-                    ns.node, UpgradeState.CORDON_REQUIRED)
-                logger.info("node %s waiting for cordon",
-                            ns.node.metadata.name)
+            self.provider.change_node_upgrade_state(
+                ns.node, UpgradeState.CORDON_REQUIRED)
+            logger.info("node %s waiting for cordon",
+                        ns.node.metadata.name)
+
+        self._map_bucket(planner.plan(candidates, upgrades_available, state),
+                         "upgrade start", start)
 
     def process_cordon_required_nodes(self, state: ClusterUpgradeState) -> None:
         """Cordon and advance to wait-for-jobs (upgrade_state.go:635-654)."""
-        for ns in state.bucket(UpgradeState.CORDON_REQUIRED):
-            with self._defer_node_on_transient(ns.node, "cordon"):
-                self.cordon_manager.cordon(ns.node)
-                self.provider.change_node_upgrade_state(
-                    ns.node, UpgradeState.WAIT_FOR_JOBS_REQUIRED)
+        def cordon(ns: NodeUpgradeState) -> None:
+            self.cordon_manager.cordon(ns.node)
+            self.provider.change_node_upgrade_state(
+                ns.node, UpgradeState.WAIT_FOR_JOBS_REQUIRED)
+
+        self._map_bucket(state.bucket(UpgradeState.CORDON_REQUIRED),
+                         "cordon", cordon)
 
     def process_wait_for_jobs_required_nodes(
             self, state: ClusterUpgradeState,
@@ -697,13 +842,16 @@ class ClusterUpgradeStateManager:
             next_state = (UpgradeState.POD_DELETION_REQUIRED
                           if self._pod_deletion_enabled
                           else UpgradeState.DRAIN_REQUIRED)
-            for node in nodes:
+
+            def advance(node: Node) -> None:
                 try:
                     self.provider.change_node_upgrade_state(node, next_state)
                 except Exception as exc:  # noqa: BLE001 — reference ignores
                     # this error (upgrade_state.go:673)
                     logger.error("failed to advance node %s: %s",
                                  node.metadata.name, exc)
+
+            self._map_bucket(nodes, "wait-for-jobs skip", advance)
             return
         if not nodes:
             return
@@ -718,7 +866,7 @@ class ClusterUpgradeStateManager:
         nodes = [ns.node for ns in
                  state.bucket(UpgradeState.POD_DELETION_REQUIRED)]
         if not self._pod_deletion_enabled:
-            for node in nodes:
+            def advance(node: Node) -> None:
                 try:
                     self.provider.change_node_upgrade_state(
                         node, UpgradeState.DRAIN_REQUIRED)
@@ -726,6 +874,8 @@ class ClusterUpgradeStateManager:
                     # this error (upgrade_state.go:706)
                     logger.error("failed to advance node %s: %s",
                                  node.metadata.name, exc)
+
+            self._map_bucket(nodes, "pod-deletion-disabled skip", advance)
             return
         if not nodes:
             return
@@ -739,11 +889,10 @@ class ClusterUpgradeStateManager:
         (upgrade_state.go:731-760)."""
         nodes = [ns.node for ns in state.bucket(UpgradeState.DRAIN_REQUIRED)]
         if drain_spec is None or not drain_spec.enable:
-            for node in nodes:
-                with self._defer_node_on_transient(node,
-                                                   "drain-disabled skip"):
-                    self.provider.change_node_upgrade_state(
-                        node, UpgradeState.POD_RESTART_REQUIRED)
+            self._map_bucket(
+                nodes, "drain-disabled skip",
+                lambda node: self.provider.change_node_upgrade_state(
+                    node, UpgradeState.POD_RESTART_REQUIRED))
             return
         if not nodes:
             return
@@ -753,35 +902,50 @@ class ClusterUpgradeStateManager:
     def process_pod_restart_nodes(self, state: ClusterUpgradeState) -> None:
         """Restart outdated runtime pods; advance nodes whose new pod is
         ready (upgrade_state.go:764-831)."""
-        pods_to_restart = []
-        for ns in state.bucket(UpgradeState.POD_RESTART_REQUIRED):
-            with self._defer_node_on_transient(ns.node, "pod restart"):
-                pod_synced, orphaned = self._pod_in_sync_with_ds(ns)
-                if not pod_synced or orphaned:
-                    # Only restart pods not already terminating
-                    # (upgrade_state.go:775-781).
-                    if ns.runtime_pod.metadata.deletion_timestamp is None:
-                        pods_to_restart.append(ns.runtime_pod)
-                    continue
-                # Pod template is current: release any blocked safe load,
-                # then wait for readiness.
-                self.safe_load_manager.unblock_loading(ns.node)
-                if self._is_runtime_pod_in_sync(ns):
-                    if not self._validation_enabled:
-                        self._update_node_to_uncordon_or_done(ns.node)
-                        continue
-                    self.provider.change_node_upgrade_state(
-                        ns.node, UpgradeState.VALIDATION_REQUIRED)
-                elif ns.runtime_pod.is_failing(
-                        POD_RESTART_FAILURE_THRESHOLD):
-                    logger.info("runtime pod failing on node %s with "
-                                "repeated restarts", ns.node.metadata.name)
-                    self.provider.change_node_upgrade_state(
-                        ns.node, UpgradeState.FAILED)
-        deferred_pods = self.pod_manager.schedule_pods_restart(
-            pods_to_restart)
-        self._transient_deferrals += deferred_pods
-        self.last_pass_deferrals += deferred_pods
+        def triage(ns: NodeUpgradeState) -> Optional[Pod]:
+            pod_synced, orphaned = self._pod_in_sync_with_ds(ns)
+            if not pod_synced or orphaned:
+                # Only restart pods not already terminating
+                # (upgrade_state.go:775-781).
+                if ns.runtime_pod.metadata.deletion_timestamp is None:
+                    return ns.runtime_pod
+                return None
+            # Pod template is current: release any blocked safe load,
+            # then wait for readiness.
+            self.safe_load_manager.unblock_loading(ns.node)
+            if self._is_runtime_pod_in_sync(ns):
+                if not self._validation_enabled:
+                    self._update_node_to_uncordon_or_done(ns.node)
+                    return None
+                self.provider.change_node_upgrade_state(
+                    ns.node, UpgradeState.VALIDATION_REQUIRED)
+            elif ns.runtime_pod.is_failing(
+                    POD_RESTART_FAILURE_THRESHOLD):
+                logger.info("runtime pod failing on node %s with "
+                            "repeated restarts", ns.node.metadata.name)
+                self.provider.change_node_upgrade_state(
+                    ns.node, UpgradeState.FAILED)
+            return None
+
+        pods_to_restart = [
+            pod for pod in self._map_bucket(
+                state.bucket(UpgradeState.POD_RESTART_REQUIRED),
+                "pod restart", triage)
+            if pod is not None]
+        if self._pool is not None and len(pods_to_restart) >= 4:
+            # Restart deletes are independent per pod: pipeline the
+            # write wave on the pool instead of one blocking round-trip
+            # at a time. Per-pod batches keep schedule_pods_restart's
+            # transient-vs-hard error semantics intact.
+            deferred_pods = sum(self._pool.map_wait(
+                [lambda p=pod: self.pod_manager.schedule_pods_restart([p])
+                 for pod in pods_to_restart]))
+        else:
+            deferred_pods = self.pod_manager.schedule_pods_restart(
+                pods_to_restart)
+        with self._deferral_lock:
+            self._transient_deferrals += deferred_pods
+            self.last_pass_deferrals += deferred_pods
 
     def process_upgrade_failed_nodes(self, state: ClusterUpgradeState) -> None:
         """Auto-recover failed nodes whose pod became healthy
@@ -796,56 +960,59 @@ class ClusterUpgradeStateManager:
         exactly as before; gate-level failures stay failed until the gate
         passes.
         """
-        for ns in state.bucket(UpgradeState.FAILED):
-            with self._defer_node_on_transient(ns.node,
-                                               "failed-node recovery"):
-                synced, orphaned = self._pod_in_sync_with_ds(ns)
-                if not synced and not orphaned \
-                        and ns.runtime_pod.is_ready():
-                    # The DaemonSet rolled a NEW revision while the node
-                    # sat failed (its crash-loop healed on the old one,
-                    # or a drain failed): a healthy-but-outdated pod can
-                    # never become "in sync" on its own, so the
-                    # pod-healthy recovery below would wait forever —
-                    # the node is stranded (found by the chaos harness,
-                    # seed 113). Resume via drain-required: the drain
-                    # retries (covering the drain-failure origin without
-                    # ever skipping workload eviction) and the flow then
-                    # restarts the pod onto the current revision.
-                    logger.info(
-                        "failed node %s has a healthy but outdated pod; "
-                        "re-entering the upgrade flow at drain",
-                        ns.node.metadata.name)
-                    self.provider.change_node_upgrade_state(
-                        ns.node, UpgradeState.DRAIN_REQUIRED)
-                    continue
-                if not self._is_runtime_pod_in_sync(ns):
-                    continue
-                # check(), not validate(): the recovery gate must not
-                # stamp or expire validation timers on an already-failed
-                # node.
-                if self._validation_enabled \
-                        and not self.validation_manager.check(ns.node):
-                    logger.info("failed node %s has a healthy pod but has "
-                                "not passed validation; holding",
-                                ns.node.metadata.name)
-                    continue
-                self._update_node_to_uncordon_or_done(ns.node)
+        def recover(ns: NodeUpgradeState) -> None:
+            synced, orphaned = self._pod_in_sync_with_ds(ns)
+            if not synced and not orphaned \
+                    and ns.runtime_pod.is_ready():
+                # The DaemonSet rolled a NEW revision while the node
+                # sat failed (its crash-loop healed on the old one,
+                # or a drain failed): a healthy-but-outdated pod can
+                # never become "in sync" on its own, so the
+                # pod-healthy recovery below would wait forever —
+                # the node is stranded (found by the chaos harness,
+                # seed 113). Resume via drain-required: the drain
+                # retries (covering the drain-failure origin without
+                # ever skipping workload eviction) and the flow then
+                # restarts the pod onto the current revision.
+                logger.info(
+                    "failed node %s has a healthy but outdated pod; "
+                    "re-entering the upgrade flow at drain",
+                    ns.node.metadata.name)
+                self.provider.change_node_upgrade_state(
+                    ns.node, UpgradeState.DRAIN_REQUIRED)
+                return
+            if not self._is_runtime_pod_in_sync(ns):
+                return
+            # check(), not validate(): the recovery gate must not
+            # stamp or expire validation timers on an already-failed
+            # node.
+            if self._validation_enabled \
+                    and not self.validation_manager.check(ns.node):
+                logger.info("failed node %s has a healthy pod but has "
+                            "not passed validation; holding",
+                            ns.node.metadata.name)
+                return
+            self._update_node_to_uncordon_or_done(ns.node)
+
+        self._map_bucket(state.bucket(UpgradeState.FAILED),
+                         "failed-node recovery", recover)
 
     def process_validation_required_nodes(
             self, state: ClusterUpgradeState) -> None:
         """Run the validation gate (upgrade_state.go:880-911)."""
-        for ns in state.bucket(UpgradeState.VALIDATION_REQUIRED):
-            with self._defer_node_on_transient(ns.node, "validation"):
-                # The runtime pod may have restarted after entering this
-                # state and be blocked on safe load again
-                # (upgrade_state.go:886-893).
-                self.safe_load_manager.unblock_loading(ns.node)
-                if not self.validation_manager.validate(ns.node):
-                    logger.info("validation not complete on node %s",
-                                ns.node.metadata.name)
-                    continue
-                self._update_node_to_uncordon_or_done(ns.node)
+        def validate(ns: NodeUpgradeState) -> None:
+            # The runtime pod may have restarted after entering this
+            # state and be blocked on safe load again
+            # (upgrade_state.go:886-893).
+            self.safe_load_manager.unblock_loading(ns.node)
+            if not self.validation_manager.validate(ns.node):
+                logger.info("validation not complete on node %s",
+                            ns.node.metadata.name)
+                return
+            self._update_node_to_uncordon_or_done(ns.node)
+
+        self._map_bucket(state.bucket(UpgradeState.VALIDATION_REQUIRED),
+                         "validation", validate)
 
     def process_uncordon_required_nodes(
             self, state: ClusterUpgradeState) -> None:
@@ -858,19 +1025,21 @@ class ClusterUpgradeStateManager:
         Re-reading the label first closes that stale-pass window; the
         write itself still carries the optimistic-concurrency check.
         """
-        for ns in state.bucket(UpgradeState.UNCORDON_REQUIRED):
-            with self._defer_node_on_transient(ns.node, "uncordon"):
-                current = self.provider.get_node(ns.node.metadata.name) \
-                    .metadata.labels.get(self.keys.state_label, "")
-                if current != str(UpgradeState.UNCORDON_REQUIRED):
-                    logger.warning(
-                        "node %s is %r, not uncordon-required: snapshot "
-                        "is stale; skipping uncordon",
-                        ns.node.metadata.name, current or "unknown")
-                    continue
-                self.cordon_manager.uncordon(ns.node)
-                self.provider.change_node_upgrade_state(
-                    ns.node, UpgradeState.DONE)
+        def uncordon(ns: NodeUpgradeState) -> None:
+            current = self.provider.get_node(ns.node.metadata.name) \
+                .metadata.labels.get(self.keys.state_label, "")
+            if current != str(UpgradeState.UNCORDON_REQUIRED):
+                logger.warning(
+                    "node %s is %r, not uncordon-required: snapshot "
+                    "is stale; skipping uncordon",
+                    ns.node.metadata.name, current or "unknown")
+                return
+            self.cordon_manager.uncordon(ns.node)
+            self.provider.change_node_upgrade_state(
+                ns.node, UpgradeState.DONE)
+
+        self._map_bucket(state.bucket(UpgradeState.UNCORDON_REQUIRED),
+                         "uncordon", uncordon)
 
     # ------------------------------------------------------------------
     # predicates
@@ -905,21 +1074,25 @@ class ClusterUpgradeStateManager:
     def _update_node_to_uncordon_or_done(self, node: Node) -> None:
         """Finish the node: uncordon-required normally, straight to done if
         it was already cordoned before the upgrade began
-        (upgrade_state.go:1000-1028)."""
+        (upgrade_state.go:1000-1028).
+
+        On the DONE arc the initial-state marker deletion rides the
+        state commit's merge patch (one write, crash-atomic): the
+        "committed DONE but died before deleting the marker" crash
+        residue the idle-triage paths mop up can no longer be minted by
+        THIS path, and a stale snapshot still patches nothing — the
+        provider's precondition covers label and annotation together.
+        """
         new_state = UpgradeState.UNCORDON_REQUIRED
         annotation = self.keys.initial_state_annotation
+        annotations = None
         if annotation in node.metadata.annotations:
             logger.info("node %s was unschedulable before upgrade; "
                         "skipping uncordon", node.metadata.name)
             new_state = UpgradeState.DONE
-        if not self.provider.change_node_upgrade_state(node, new_state):
-            # stale snapshot: another pass moved the node — deleting the
-            # initial-state annotation now would erase the "admin had
-            # this node cordoned" memory for whatever flow owns it
-            return
-        if new_state == UpgradeState.DONE:
-            self.provider.change_node_upgrade_annotation(
-                node, annotation, None)
+            annotations = {annotation: None}
+        self.provider.change_node_upgrade_state(node, new_state,
+                                                annotations=annotations)
 
     # ------------------------------------------------------------------
     # fleet counters (upgrade_state.go:188-211, 1034-1120)
@@ -1081,6 +1254,10 @@ class ClusterUpgradeStateManager:
     # test/sim helper
     # ------------------------------------------------------------------
     def join_workers(self, timeout: float = 30.0) -> None:
-        """Wait for in-flight async drain/eviction workers."""
+        """Wait for in-flight async drain/eviction workers and drain the
+        bucket pool — the deterministic shutdown barrier tests, the
+        simulator and crash-restart replays synchronize on."""
         self.drain_manager.join(timeout)
         self.pod_manager.join(timeout)
+        if self._pool is not None:
+            self._pool.drain(timeout)

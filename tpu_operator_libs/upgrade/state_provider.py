@@ -15,6 +15,7 @@ reconcile must see its own writes. Poll interval and timeout are injectable
 from __future__ import annotations
 
 import logging
+import threading
 from typing import Optional
 
 from tpu_operator_libs.consts import NULL_STRING, UpgradeKeys, UpgradeState
@@ -44,6 +45,18 @@ class NodeUpgradeStateProvider:
         self._sync_timeout = sync_timeout
         self._poll_interval = poll_interval
         self._node_lock = KeyedLock()
+        self._counter_lock = threading.Lock()
+        #: Durable node writes issued (each is one wire patch).
+        self.writes_total = 0
+        #: Wire patches avoided by coalescing a transition's label +
+        #: annotation changes into one merge patch (metrics evidence
+        #: for the fleet-scale write path).
+        self.coalesced_writes_saved_total = 0
+
+    def _count_write(self, saved: int = 0) -> None:
+        with self._counter_lock:
+            self.writes_total += 1
+            self.coalesced_writes_saved_total += saved
 
     @property
     def keys(self) -> UpgradeKeys:
@@ -55,14 +68,25 @@ class NodeUpgradeStateProvider:
         with self._node_lock.lock(name):
             return self._client.get_node(name)
 
-    def change_node_upgrade_state(self, node: Node,
-                                  new_state: UpgradeState | str) -> bool:
+    def change_node_upgrade_state(
+            self, node: Node, new_state: UpgradeState | str,
+            annotations: "Optional[dict[str, Optional[str]]]" = None,
+    ) -> bool:
         """Patch the upgrade-state label and wait until the change is
         readable back (node_upgrade_state_provider.go:72-134).
 
         ``node`` is updated in place on success, so later processing within
         the same reconcile pass observes the new state — matching the
         reference, which Gets into the caller's node object.
+
+        ``annotations`` (value None/"null" deletes the key) ride the
+        SAME merge patch as the label when given — the coalesced-write
+        path: bookkeeping that belongs to the transition (the
+        initial-state marker, a consumed timer stamp) commits
+        atomically with it, in one wire round-trip instead of two, and
+        an operator crash can no longer land between the two writes.
+        The annotations are only applied when the state precondition
+        passes — a skipped (stale-snapshot) transition patches nothing.
 
         **Optimistic concurrency (beyond-reference):** the write only
         lands if the node's live state label still equals the label in
@@ -77,6 +101,8 @@ class NodeUpgradeStateProvider:
         the correct action from the fresh label.
         """
         value = str(new_state)
+        ann_patch = {key: (None if v is None or v == NULL_STRING else v)
+                     for key, v in (annotations or {}).items()}
         expected = node.metadata.labels.get(self._keys.state_label, "")
         with self._node_lock.lock(node.metadata.name):
             live = self._client.get_node(node.metadata.name)
@@ -88,22 +114,38 @@ class NodeUpgradeStateProvider:
                     node.metadata.name, current or "unknown",
                     expected or "unknown", value)
                 return False
-            if current == value:
+            if current == value and not ann_patch:
                 # another pass already committed this exact transition
                 self._copy_into(node, live)
                 return True
             try:
-                self._client.patch_node_labels(
-                    node.metadata.name, {self._keys.state_label: value})
+                if ann_patch:
+                    self._client.patch_node_meta(
+                        node.metadata.name,
+                        labels={self._keys.state_label: value},
+                        annotations=ann_patch)
+                    self._count_write(saved=1)
+                else:
+                    self._client.patch_node_labels(
+                        node.metadata.name, {self._keys.state_label: value})
+                    self._count_write()
             except Exception as exc:
                 log_event(self._recorder, node, Event.WARNING,
                           self._keys.event_reason,
                           f"Failed to update node state label to {value}: {exc}")
                 raise
+
+            def check(n: Node) -> bool:
+                if n.metadata.labels.get(
+                        self._keys.state_label, "") != value:
+                    return False
+                return all(
+                    key not in n.metadata.annotations if v is None
+                    else n.metadata.annotations.get(key) == v
+                    for key, v in ann_patch.items())
+
             try:
-                fresh = self._wait_visible(
-                    node.metadata.name,
-                    lambda n: n.metadata.labels.get(self._keys.state_label, "") == value)
+                fresh = self._wait_visible(node.metadata.name, check)
             except CacheSyncTimeout:
                 log_event(self._recorder, node, Event.WARNING,
                           self._keys.event_reason,
@@ -138,6 +180,7 @@ class NodeUpgradeStateProvider:
             try:
                 self._client.patch_node_annotations(
                     node.metadata.name, patch)
+                self._count_write()
             except Exception as exc:
                 log_event(self._recorder, node, Event.WARNING,
                           self._keys.event_reason,
@@ -174,6 +217,7 @@ class NodeUpgradeStateProvider:
             try:
                 self._client.patch_node_annotations(
                     node.metadata.name, {key: patch_value})
+                self._count_write()
             except Exception as exc:
                 log_event(self._recorder, node, Event.WARNING,
                           self._keys.event_reason,

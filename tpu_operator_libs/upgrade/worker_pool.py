@@ -1,0 +1,182 @@
+"""Bounded keyed worker pool for per-node bucket fan-out.
+
+The reference processes every state bucket serially and spawns one
+detached goroutine per *slow* node action (drain, eviction). At TPU
+fleet scale the serial bucket walk itself becomes the bottleneck: a
+wave pass performs O(maxUnavailable) independent per-node transitions,
+each paying an apiserver write round-trip, strictly one after another.
+
+:class:`BoundedKeyedPool` is the execution substrate the
+:class:`~tpu_operator_libs.upgrade.state_manager.ClusterUpgradeStateManager`
+fans that work out on:
+
+- **Barrier map** (:meth:`map_wait`): run a batch of thunks on at most
+  ``max_workers`` threads and return every result, in input order,
+  only once ALL of them finished. A pass's bucket work is therefore
+  structurally drained before the next bucket starts — the property
+  the chaos harness's crash–restart replay depends on (no node action
+  can straddle the "process death" boundary unobserved). The calling
+  thread participates as one of the workers, so a pool of size N adds
+  N-1 threads and can never deadlock on its own capacity.
+- **Keyed fire-and-forget** (:meth:`submit` + :meth:`drain`): the
+  generalized form of DrainManager's ``NameSet`` + ``Worker`` seam —
+  per-key dedup so the same node is never scheduled twice, a bounded
+  thread count instead of one thread per node, and a deterministic
+  :meth:`drain` barrier (``join`` alias) tests and the simulator wait
+  on.
+
+``async_mode=False`` degrades every path to inline sequential
+execution — the same determinism seam :class:`~tpu_operator_libs.util.
+Worker` offers, so seeded tests can opt out of real threads entirely.
+"""
+
+from __future__ import annotations
+
+import logging
+import threading
+from typing import Callable, Optional
+
+logger = logging.getLogger(__name__)
+
+
+class BoundedKeyedPool:
+    """Bounded worker pool with keyed dedup and deterministic drain."""
+
+    def __init__(self, max_workers: int = 8, async_mode: bool = True,
+                 name: str = "bucket-pool") -> None:
+        if max_workers < 1:
+            raise ValueError("max_workers must be >= 1")
+        self.max_workers = max_workers
+        self.async_mode = async_mode
+        self._name = name
+        self._cond = threading.Condition()
+        self._queue: list[tuple[Callable[[], None], Optional[str]]] = []
+        self._in_flight: set[str] = set()
+        self._pending = 0          # queued + running fire-and-forget tasks
+        self._drainers = 0         # live fire-and-forget worker threads
+
+    # ------------------------------------------------------------------
+    # barrier map (bucket fan-out)
+    # ------------------------------------------------------------------
+    def map_wait(self, thunks: "list[Callable[[], object]]") -> list:
+        """Run every thunk, at most ``max_workers`` at a time; return
+        results in input order once ALL completed (the barrier). The
+        first exception (by input order) is re-raised after the barrier
+        — by then every other thunk has still run, which is a superset
+        of the serial semantics (idempotent passes re-derive anyway).
+        """
+        n = len(thunks)
+        if n == 0:
+            return []
+        if not self.async_mode or self.max_workers == 1 or n == 1:
+            return [thunk() for thunk in thunks]
+        results: list = [None] * n
+        errors: list = [None] * n
+        cursor = [0]
+        cursor_lock = threading.Lock()
+
+        def run() -> None:
+            while True:
+                with cursor_lock:
+                    i = cursor[0]
+                    if i >= n:
+                        return
+                    cursor[0] = i + 1
+                try:
+                    results[i] = thunks[i]()
+                except BaseException as exc:  # noqa: BLE001 — re-raised below
+                    errors[i] = exc
+
+        helpers = [threading.Thread(target=run, daemon=True,
+                                    name=f"{self._name}-map-{i}")
+                   for i in range(min(self.max_workers, n) - 1)]
+        for t in helpers:
+            t.start()
+        run()  # the caller is a worker too: no idle blocking, no deadlock
+        for t in helpers:
+            t.join()
+        for exc in errors:
+            if exc is not None:
+                raise exc
+        return results
+
+    # ------------------------------------------------------------------
+    # keyed fire-and-forget (Worker/NameSet generalization)
+    # ------------------------------------------------------------------
+    def submit(self, fn: Callable[[], None],
+               key: Optional[str] = None) -> bool:
+        """Schedule ``fn``; with ``key`` given, dedup against in-flight
+        work for the same key (returns False when already scheduled —
+        the atomic NameSet test-and-set). Exceptions are logged, never
+        propagated (worker boundary, like :class:`~tpu_operator_libs.
+        util.Worker` threads dying silently in the reference)."""
+        with self._cond:
+            if key is not None:
+                if key in self._in_flight:
+                    return False
+                self._in_flight.add(key)
+            if not self.async_mode:
+                self._pending += 1
+            else:
+                self._queue.append((fn, key))
+                self._pending += 1
+                if self._drainers < min(self.max_workers, len(self._queue)):
+                    self._drainers += 1
+                    threading.Thread(
+                        target=self._drain_loop, daemon=True,
+                        name=f"{self._name}-worker").start()
+                return True
+        # inline mode: run outside the lock, then settle bookkeeping
+        try:
+            self._run_one(fn, key)
+        finally:
+            with self._cond:
+                self._pending -= 1
+                self._cond.notify_all()
+        return True
+
+    def _run_one(self, fn: Callable[[], None], key: Optional[str]) -> None:
+        try:
+            fn()
+        except Exception:  # noqa: BLE001 — worker boundary
+            logger.exception("%s: submitted task failed", self._name)
+        finally:
+            if key is not None:
+                with self._cond:
+                    self._in_flight.discard(key)
+
+    def _drain_loop(self) -> None:
+        while True:
+            with self._cond:
+                if not self._queue:
+                    self._drainers -= 1
+                    return
+                fn, key = self._queue.pop(0)
+            try:
+                self._run_one(fn, key)
+            finally:
+                with self._cond:
+                    self._pending -= 1
+                    self._cond.notify_all()
+
+    def drain(self, timeout: float = 30.0) -> bool:
+        """Block until every submitted task finished (the deterministic
+        shutdown barrier); True when fully drained within ``timeout``."""
+        import time as _time
+
+        deadline = _time.monotonic() + timeout
+        with self._cond:
+            while self._pending > 0:
+                remaining = deadline - _time.monotonic()
+                if remaining <= 0:
+                    return False
+                self._cond.wait(remaining)
+            return True
+
+    def join(self, timeout: float = 30.0) -> None:
+        """Worker-interface alias for :meth:`drain`."""
+        self.drain(timeout)
+
+    def in_flight(self, key: str) -> bool:
+        with self._cond:
+            return key in self._in_flight
