@@ -4,7 +4,7 @@ PYTHON ?= python3
 PYTEST_FLAGS ?= -q
 COV_THRESHOLD ?= 85
 
-.PHONY: all check test test-fast test-fault test-chaos test-soak test-scale lint cov bench bench-reconcile graft-check package clean diagram
+.PHONY: all check test test-fast test-fault test-chaos test-soak test-scale test-rollout lint cov bench bench-reconcile graft-check package clean diagram
 
 all: lint test
 
@@ -31,6 +31,13 @@ test-fault:
 # the seed + event trace needed to replay it deterministically.
 test-chaos:
 	$(PYTHON) -m pytest tests/test_chaos.py $(PYTEST_FLAGS) -m "chaos and not slow"
+
+# Canary-wave / fleet-halt / rollback slice: the RolloutGuard unit +
+# e2e tests plus the seeded bad-revision chaos gate (a broken libtpu
+# revision must be contained: halt within one reconcile pass, quarantine
+# the hash, roll every touched node back to the previous revision).
+test-rollout:
+	$(PYTHON) -m pytest tests/ $(PYTEST_FLAGS) -m "rollout and not slow"
 
 # Long randomized soak, outside tier-1. Widen with the env knobs, e.g.:
 #   CHAOS_SEEDS=$$(seq -s, 100 199) CHAOS_STEPS=2400 make test-soak

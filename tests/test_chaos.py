@@ -22,11 +22,13 @@ from tpu_operator_libs.api.upgrade_policy import (
     UpgradePolicySpec,
 )
 from tpu_operator_libs.chaos import (
+    FAULT_BAD_REVISION,
     FAULT_OPERATOR_CRASH,
     ChaosConfig,
     FaultSchedule,
     InvariantMonitor,
     OperatorCrash,
+    run_bad_revision_soak,
     run_chaos_soak,
 )
 from tpu_operator_libs.chaos.injector import (
@@ -73,6 +75,28 @@ class TestChaosSoakGate:
         assert report.crashes_fired >= 1
         assert report.operator_incarnations >= 2
         assert report.converged and not report.violations
+
+    @pytest.mark.rollout
+    @pytest.mark.parametrize("seed", GATE_SEEDS)
+    def test_bad_revision_seed_halts_and_rolls_back(self, seed):
+        """The canary-halt-rollback gate: the runtime DS is rolled to a
+        revision whose pods can never become Ready, under compound
+        control-plane faults including operator crash–restart. The
+        monitor's rollout invariants prove the fleet halts within one
+        reconcile pass of the canary threshold tripping, that no node
+        newly enters the upgrade flow after the halt (until the
+        rollback signal), that no pod of the condemned revision is
+        minted past the grace window, and that every touched node
+        converges back to the previous ControllerRevision with the
+        maxUnavailable/maxParallel budgets held throughout (the
+        standing budget invariants stay armed for the whole episode)."""
+        report = run_bad_revision_soak(seed)
+        _assert_ok(report)
+        assert FAULT_BAD_REVISION in report.fault_kinds
+        assert FAULT_OPERATOR_CRASH in report.fault_kinds
+        assert report.crashes_fired >= 1
+        # the designed rollback arc was actually walked
+        assert any("-> rollback-required" in line for line in report.trace)
 
     def test_failure_report_carries_seed_and_trace(self):
         """A violating run must print everything needed to replay it:

@@ -27,8 +27,15 @@ class TestDemoOperator:
              "--demo-slices", "2"],
             capture_output=True, text=True, timeout=150)
         assert proc.returncode == 0, proc.stderr[-2000:]
-        assert "demo complete" in proc.stderr
+        # episode 1: the plain rolling upgrade
+        assert "demo episode 1 complete" in proc.stderr
+        # episode 2: canary probes the broken revision, the fleet halts,
+        # quarantines it and rolls back to the previous revision
+        assert "FLEET HALT" in proc.stderr
+        assert "demo episode 2 complete" in proc.stderr
+        assert "'broken' quarantined" in proc.stderr
         assert "tpu_upgrade_upgrades_done" in proc.stdout
+        assert "tpu_upgrade_rollout_halts_total" in proc.stdout
 
     def test_unified_demo_runs_to_completion(self):
         """BASELINE config #5 operator shape: one process drives GPU and

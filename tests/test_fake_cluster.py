@@ -522,6 +522,82 @@ class TestDaemonSetsAndRevisions:
         cluster.step()
         assert cluster.list_pods()[0].is_ready()
 
+    def test_seed_revision_history_numbers_below_newest(self):
+        cluster = FakeCluster()
+        DaemonSetBuilder("libtpu").with_labels(
+            {"app": "libtpu"}).with_revision_hash("current").create(cluster)
+        cluster.seed_revision_history("tpu-system", "libtpu",
+                                      ["ancient", "older"])
+        revs = {r.hash: r.revision for r in
+                cluster.list_controller_revisions("tpu-system",
+                                                  "app=libtpu")}
+        # seeded oldest-first, all beneath the pre-existing newest
+        assert revs["ancient"] < revs["older"] < revs["current"]
+        assert cluster.latest_revision_hash(
+            "tpu-system", "libtpu") == "current"
+
+    def test_seed_revision_history_rejects_duplicates_and_missing_ds(self):
+        cluster = FakeCluster()
+        DaemonSetBuilder("libtpu").with_labels(
+            {"app": "libtpu"}).with_revision_hash("current").create(cluster)
+        with pytest.raises(ValueError):
+            cluster.seed_revision_history("tpu-system", "libtpu",
+                                          ["current"])
+        with pytest.raises(NotFoundError):
+            cluster.seed_revision_history("tpu-system", "ghost", ["x"])
+
+    def test_rollback_daemon_set_repins_and_recreates_on_old_hash(self):
+        clock = FakeClock()
+        cluster = FakeCluster(clock=clock)
+        cluster.enable_ds_controller(recreate_delay=5, ready_delay=10)
+        NodeBuilder("n1").create(cluster)
+        ds = DaemonSetBuilder("libtpu").with_labels(
+            {"app": "libtpu"}).with_revision_hash("old").create(cluster)
+        PodBuilder("p").on_node("n1").owned_by(ds) \
+            .with_revision_hash("old").create(cluster)
+        cluster.bump_daemon_set_revision("tpu-system", "libtpu", "new")
+        assert cluster.latest_revision_hash("tpu-system", "libtpu") == "new"
+
+        # roll BACK: the old revision is re-numbered newest (kubectl
+        # rollout undo semantics) and subsequent recreations carry it
+        cluster.rollback_daemon_set("tpu-system", "libtpu", "old")
+        assert cluster.latest_revision_hash("tpu-system", "libtpu") == "old"
+        cluster.delete_pod("tpu-system", "p")
+        clock.advance(5)
+        cluster.step()
+        (pod,) = cluster.list_pods(label_selector="app=libtpu")
+        assert pod.metadata.labels[
+            POD_CONTROLLER_REVISION_HASH_LABEL] == "old"
+
+        # ...and FORWARD again across the same history
+        cluster.rollback_daemon_set("tpu-system", "libtpu", "new")
+        assert cluster.latest_revision_hash("tpu-system", "libtpu") == "new"
+        # no-op when the hash is already newest
+        cluster.rollback_daemon_set("tpu-system", "libtpu", "new")
+        assert cluster.latest_revision_hash("tpu-system", "libtpu") == "new"
+
+    def test_rollback_daemon_set_unknown_targets_raise(self):
+        cluster = FakeCluster()
+        DaemonSetBuilder("libtpu").with_labels(
+            {"app": "libtpu"}).with_revision_hash("only1").create(cluster)
+        with pytest.raises(NotFoundError):
+            cluster.rollback_daemon_set("tpu-system", "libtpu", "ghost")
+        with pytest.raises(NotFoundError):
+            cluster.rollback_daemon_set("tpu-system", "ghost", "only1")
+
+    def test_patch_daemon_set_annotations_merge_semantics(self):
+        cluster = FakeCluster()
+        DaemonSetBuilder("libtpu").with_labels(
+            {"app": "libtpu"}).create(cluster)
+        patched = cluster.patch_daemon_set_annotations(
+            "tpu-system", "libtpu", {"a": "1", "b": "2"})
+        assert patched.metadata.annotations == {"a": "1", "b": "2"}
+        patched = cluster.patch_daemon_set_annotations(
+            "tpu-system", "libtpu", {"a": None, "c": "3"})
+        assert patched.metadata.annotations == {"b": "2", "c": "3"}
+        with pytest.raises(NotFoundError):
+            cluster.patch_daemon_set_annotations("tpu-system", "ghost", {})
+
 
 class TestSelectorFastPathProperty:
     """The compiled matcher's fast paths (single-requirement closure,

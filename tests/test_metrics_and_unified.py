@@ -139,6 +139,56 @@ class TestMetricsRegistry:
         reg.observe_histogram("h", 1.0, labels={"a": "b"})
         assert reg.histogram_stats("h", {"a": "other"}) is None
 
+    def test_observe_rollout_exports_guard_accounting(self):
+        from tpu_operator_libs.metrics import observe_rollout
+        from tpu_operator_libs.upgrade.rollout_guard import (
+            RolloutDecision,
+            RolloutGuard,
+        )
+
+        env = make_env()
+        guard = RolloutGuard(env.cluster, env.keys, clock=env.clock)
+        guard.canary_failure_verdicts_total = 2
+        guard.halts_total = 1
+        guard.rollbacks_started_total = 1
+        guard.rollbacks_completed_total = 1
+        guard._rollback_durations.append(150.0)
+        guard.last_decision = RolloutDecision(
+            halted=True, quarantined=frozenset({"bad"}),
+            quarantined_active=frozenset({"bad"}))
+        reg = MetricsRegistry()
+        observe_rollout(reg, guard)
+        labels = {"driver": "libtpu"}
+        assert reg.get("rollout_canary_failure_verdicts_total",
+                       labels) == 2
+        assert reg.get("rollout_halts_total", labels) == 1
+        assert reg.get("rollout_rollbacks_started_total", labels) == 1
+        assert reg.get("rollout_rollbacks_completed_total", labels) == 1
+        assert reg.get("rollout_halted", labels) == 1.0
+        assert reg.get("rollout_canary_wave_active", labels) == 0.0
+        assert reg.get("rollout_quarantined_revisions", labels) == 1
+        assert reg.histogram_stats("rollout_rollback_seconds",
+                                   labels) == (1, 150.0)
+        # the duration list is drained: re-observing must not double
+        # count the histogram sample
+        observe_rollout(reg, guard)
+        assert reg.histogram_stats("rollout_rollback_seconds",
+                                   labels) == (1, 150.0)
+        text = reg.render_prometheus()
+        assert "tpu_upgrade_rollout_halted" in text
+
+    def test_observe_rollout_neutral_guard(self):
+        from tpu_operator_libs.metrics import observe_rollout
+        from tpu_operator_libs.upgrade.rollout_guard import RolloutGuard
+
+        env = make_env()
+        reg = MetricsRegistry()
+        observe_rollout(reg, RolloutGuard(env.cluster, env.keys,
+                                          clock=env.clock))
+        labels = {"driver": "libtpu"}
+        assert reg.get("rollout_halts_total", labels) == 0
+        assert reg.get("rollout_halted", labels) == 0.0
+
     def test_cluster_status_block(self):
         import json
 
@@ -351,6 +401,33 @@ class TestUnifiedPolicy:
         restored = UnifiedUpgradePolicySpec.from_dict(unified.to_dict())
         assert restored.accelerators["tpu"].driver == "libtpu"
         assert restored.accelerators["tpu"].policy.topology_mode == "slice"
+
+    def test_canary_and_rollback_thread_through_unified(self):
+        # the canary/rollback specs are per-accelerator policy fields:
+        # they must survive the unified document round trip and validate
+        # through it
+        doc = self._unified().to_dict()
+        doc["accelerators"]["tpu"]["policy"]["canary"] = {
+            "enable": True, "canaryCount": "10%", "bakeSeconds": 120,
+            "failureThreshold": 2}
+        doc["accelerators"]["tpu"]["policy"]["rollback"] = {
+            "enable": False}
+        unified = UnifiedUpgradePolicySpec.from_dict(doc)
+        unified.validate()
+        tpu = unified.accelerators["tpu"].policy
+        assert tpu.canary is not None and tpu.canary.enable
+        assert tpu.canary.canary_count == "10%"
+        assert tpu.canary.failure_threshold == 2
+        assert tpu.rollback is not None and not tpu.rollback.enable
+        # the GPU accelerator is untouched: canary gating is per-runtime
+        assert unified.accelerators["gpu"].policy.canary is None
+        assert unified.to_dict()["accelerators"]["tpu"]["policy"][
+            "canary"]["bakeSeconds"] == 120
+        # invalid canary config is caught through the unified validate
+        doc["accelerators"]["tpu"]["policy"]["canary"][
+            "failureThreshold"] = 0
+        with pytest.raises(PolicyValidationError):
+            UnifiedUpgradePolicySpec.from_dict(doc).validate()
 
     def test_duplicate_key_namespace_rejected(self):
         unified = UnifiedUpgradePolicySpec(accelerators={

@@ -151,6 +151,68 @@ class TestValidate:
         node = env.provider.get_node("n1")
         assert mgr.validate(node) is False
 
+    def test_timeout_event_names_concrete_failure_reason(self):
+        # operators watching `kubectl get events` must see WHAT failed:
+        # the gate's failure slug rides the upgrade-failed Event
+        env = make_env()
+        node = NodeBuilder("n1").with_upgrade_state(
+            env.keys, UpgradeState.VALIDATION_REQUIRED).create(env.cluster)
+        PodBuilder("validator").on_node(node).orphaned() \
+            .with_labels({"app": "validator"}).ready(False).create(env.cluster)
+        mgr = make_validation_manager(env, "app=validator",
+                                      timeout_seconds=600)
+        assert mgr.validate(env.provider.get_node("n1")) is False
+        env.clock.advance(601)
+        assert mgr.validate(env.provider.get_node("n1")) is False
+        assert env.state_of("n1") == "upgrade-failed"
+        (event,) = [e for e in env.recorder.events
+                    if "marked upgrade-failed" in e.message]
+        assert "pod-not-ready" in event.message
+        assert event.type == "Warning"
+
+    def test_extra_validator_raise_starts_timer_and_fails_on_expiry(self):
+        # the raising-validator branch must drive the FULL timeout arc:
+        # stamp on first failure, upgrade-failed + stamp cleared on
+        # expiry, and the event carries the extra-validator reason
+        env = make_env()
+        NodeBuilder("n1").with_upgrade_state(
+            env.keys, UpgradeState.VALIDATION_REQUIRED).create(env.cluster)
+
+        def broken(n):
+            raise RuntimeError("fabric probe crashed")
+
+        mgr = make_validation_manager(env, "", extra_validator=broken,
+                                      timeout_seconds=600)
+        assert mgr.validate(env.provider.get_node("n1")) is False
+        stamp = env.keys.validation_start_annotation
+        assert stamp in env.cluster.get_node("n1").metadata.annotations
+
+        env.clock.advance(601)
+        assert mgr.validate(env.provider.get_node("n1")) is False
+        assert env.state_of("n1") == "upgrade-failed"
+        # timeout stamp cleared on expiry — no residue for the next
+        # validation cycle to misread as an already-running timer
+        assert stamp not in env.cluster.get_node("n1").metadata.annotations
+        (event,) = [e for e in env.recorder.events
+                    if "marked upgrade-failed" in e.message]
+        assert "extra-validator" in event.message
+
+    def test_check_is_side_effect_free_on_raising_validator(self):
+        # the failed-node recovery gate consults check() repeatedly; a
+        # raising validator must read as unhealthy without stamping or
+        # advancing the timeout machinery
+        env = make_env()
+        NodeBuilder("n1").create(env.cluster)
+
+        def broken(n):
+            raise RuntimeError("fabric probe crashed")
+
+        mgr = make_validation_manager(env, "", extra_validator=broken)
+        node = env.provider.get_node("n1")
+        assert mgr.check(node) is False
+        assert env.keys.validation_start_annotation not in (
+            env.cluster.get_node("n1").metadata.annotations)
+
 
 class TestSafeRuntimeLoad:
     def test_detects_waiting_annotation(self):

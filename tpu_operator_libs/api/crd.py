@@ -137,6 +137,59 @@ def drain_schema() -> dict[str, Any]:
     }
 
 
+def canary_schema() -> dict[str, Any]:
+    """CanaryRolloutSpec (beyond-reference: canary-gated rollout)."""
+    return {
+        "type": "object",
+        "description": "Canary-gated rollout: probe a new revision on a "
+                       "small cohort before opening the fleet waves.",
+        "properties": {
+            "enable": {
+                "type": "boolean",
+                "default": False,
+                "description": "Master switch; when false rollout "
+                               "proceeds reference-style.",
+            },
+            "canaryCount": _int_or_string(
+                "Cohort size: node count (ex: 2) or fleet percentage "
+                "(ex: \"10%\"), minimum 1.", default=1),
+            "bakeSeconds": {
+                "type": "integer",
+                "minimum": 0,
+                "default": 300,
+                "description": "Seconds the completed cohort must bake "
+                               "before fleet waves open.",
+            },
+            "failureThreshold": {
+                "type": "integer",
+                "minimum": 1,
+                "default": 1,
+                "description": "Failure verdicts on one revision that "
+                               "flip the fleet to HALTED.",
+            },
+        },
+    }
+
+
+def rollback_schema() -> dict[str, Any]:
+    """RollbackSpec (what a canary HALT does beyond freezing)."""
+    return {
+        "type": "object",
+        "description": "Automatic rollback to the previous "
+                       "ControllerRevision after a canary halt.",
+        "properties": {
+            "enable": {
+                "type": "boolean",
+                "default": True,
+                "description": "Re-pin the previous revision and drive "
+                               "affected nodes through rollback-required; "
+                               "when false the fleet stays halted for a "
+                               "human.",
+            },
+        },
+    }
+
+
 def upgrade_policy_schema() -> dict[str, Any]:
     """The embeddable policy spec (DriverUpgradePolicySpec,
     upgrade_spec.go:27-49) with reference defaults: autoUpgrade=false,
@@ -168,6 +221,8 @@ def upgrade_policy_schema() -> dict[str, Any]:
             "podDeletion": pod_deletion_schema(),
             "waitForCompletion": wait_for_completion_schema(),
             "drain": drain_schema(),
+            "canary": canary_schema(),
+            "rollback": rollback_schema(),
             "topologyMode": {
                 "type": "string",
                 "enum": ["flat", "slice"],

@@ -165,6 +165,85 @@ class DrainSpec:
 
 
 @dataclass
+class CanaryRolloutSpec:
+    """Canary-gated rollout: probe a new revision on a small cohort
+    before opening the fleet waves (beyond-reference; the reference
+    upgrades every node with no notion of "the revision itself is bad").
+
+    The canary cohort is chosen deterministically from the managed node
+    names, so a restarted operator derives the same cohort from cluster
+    state alone. While the cohort is upgrading (and for ``bakeSeconds``
+    after it completes) no other node is admitted; once
+    ``failureThreshold`` nodes fail on the new revision the fleet HALTS
+    (see :class:`RollbackSpec` for what happens next).
+    """
+
+    # Master switch; when False rollout proceeds reference-style.
+    enable: bool = False
+    # Cohort size: node count (int) or fleet percentage ("10%"), min 1.
+    canary_count: IntOrString = 1
+    # Seconds the completed cohort must bake before fleet waves open.
+    bake_seconds: int = 300
+    # Failure verdicts (validation timeout, pod crash-loop) on one
+    # revision that flip the fleet to HALTED.
+    failure_threshold: int = 1
+
+    def validate(self) -> None:
+        if scaled_value_from_int_or_percent(self.canary_count, 100) < 1:
+            raise PolicyValidationError("canary.canaryCount must be >= 1")
+        if self.bake_seconds < 0:
+            raise PolicyValidationError("canary.bakeSeconds must be >= 0")
+        if self.failure_threshold < 1:
+            raise PolicyValidationError(
+                "canary.failureThreshold must be >= 1")
+
+    def to_dict(self) -> dict[str, Any]:
+        return {"enable": self.enable,
+                "canaryCount": self.canary_count,
+                "bakeSeconds": self.bake_seconds,
+                "failureThreshold": self.failure_threshold}
+
+    @classmethod
+    def from_dict(cls, data: dict[str, Any]) -> "CanaryRolloutSpec":
+        return cls(enable=data.get("enable", False),
+                   canary_count=data.get("canaryCount", 1),
+                   bake_seconds=data.get("bakeSeconds", 300),
+                   failure_threshold=data.get("failureThreshold", 1))
+
+    def deep_copy(self) -> "CanaryRolloutSpec":
+        return copy.deepcopy(self)
+
+
+@dataclass
+class RollbackSpec:
+    """What a canary HALT does beyond freezing admissions.
+
+    With ``enable`` the operator re-pins the DaemonSet's previous
+    ControllerRevision and drives every node stuck on the condemned
+    revision through ``rollback-required`` (pod delete → restart on the
+    old revision → revalidate → uncordon). Disabled, the fleet stays
+    halted for a human: the quarantine annotation keeps reconcile from
+    re-attempting the bad hash either way.
+    """
+
+    # Automatically roll the fleet back to the previous revision.
+    enable: bool = True
+
+    def validate(self) -> None:
+        pass  # nothing to range-check yet; symmetry with sibling specs
+
+    def to_dict(self) -> dict[str, Any]:
+        return {"enable": self.enable}
+
+    @classmethod
+    def from_dict(cls, data: dict[str, Any]) -> "RollbackSpec":
+        return cls(enable=data.get("enable", True))
+
+    def deep_copy(self) -> "RollbackSpec":
+        return copy.deepcopy(self)
+
+
+@dataclass
 class UpgradePolicySpec:
     """Top-level rolling-upgrade policy.
 
@@ -194,6 +273,13 @@ class UpgradePolicySpec:
     # reference's per-node budget (upgrade_state.go:606-616) to DCN job
     # membership. See tpu_operator_libs.topology.multislice.
     max_unavailable_slices_per_job: int = 1
+    # Beyond-reference: canary-gated rollout (probe a new revision on a
+    # small cohort, halt the fleet when it fails). None = disabled.
+    canary: Optional[CanaryRolloutSpec] = None
+    # Beyond-reference: automatic rollback to the previous
+    # ControllerRevision after a canary halt. None = rollback enabled
+    # with defaults whenever canary is enabled.
+    rollback: Optional[RollbackSpec] = None
 
     def validate(self) -> None:
         if self.max_parallel_upgrades < 0:
@@ -209,7 +295,8 @@ class UpgradePolicySpec:
         if self.max_unavailable_slices_per_job < 1:
             raise PolicyValidationError(
                 "maxUnavailableSlicesPerJob must be >= 1")
-        for sub in (self.pod_deletion, self.wait_for_completion, self.drain):
+        for sub in (self.pod_deletion, self.wait_for_completion, self.drain,
+                    self.canary, self.rollback):
             if sub is not None:
                 sub.validate()
 
@@ -227,6 +314,10 @@ class UpgradePolicySpec:
             out["waitForCompletion"] = self.wait_for_completion.to_dict()
         if self.drain is not None:
             out["drain"] = self.drain.to_dict()
+        if self.canary is not None:
+            out["canary"] = self.canary.to_dict()
+        if self.rollback is not None:
+            out["rollback"] = self.rollback.to_dict()
         return out
 
     @classmethod
@@ -246,6 +337,10 @@ class UpgradePolicySpec:
                 data["waitForCompletion"])
         if "drain" in data and data["drain"] is not None:
             spec.drain = DrainSpec.from_dict(data["drain"])
+        if data.get("canary") is not None:
+            spec.canary = CanaryRolloutSpec.from_dict(data["canary"])
+        if data.get("rollback") is not None:
+            spec.rollback = RollbackSpec.from_dict(data["rollback"])
         return spec
 
     def deep_copy(self) -> "UpgradePolicySpec":

@@ -6,30 +6,40 @@ state_machine.NodeRemediationManager` against the FakeCluster virtual
 clock while a deterministic, seed-derived schedule fires compound
 failures — apiserver error bursts, watch-stream drops, stale reads,
 NotReady flaps, crash-looping runtime pods, PDB-blocked evictions,
-leader-election loss, and operator crash–restart (the managers are torn
+leader-election loss, operator crash–restart (the managers are torn
 down mid-transition and rebuilt from cluster state alone, proving node
-labels/annotations are a sufficient durable store). An
+labels/annotations are a sufficient durable store), and a bad-revision
+rollout (the runtime DaemonSet rolled to a build whose pods can never
+become Ready — recovery is the canary guard's halt + rollback). An
 :class:`InvariantMonitor` subscribed to the cluster's watch stream
-asserts safety after every mutation; the soak runner proves liveness
-(full fleet convergence once the schedule's faults heal).
+asserts safety after every mutation; the soak runners prove liveness
+(full fleet convergence once the schedule's faults heal — or, for the
+bad-revision gate, convergence BACK to the previous revision).
 
 Every run is replayable from its seed: a violation report carries the
 seed plus the event trace needed to reproduce it deterministically
 (``docs/chaos-testing.md``).
 """
 
-from tpu_operator_libs.chaos.injector import ChaosInjector, OperatorCrash
+from tpu_operator_libs.chaos.injector import (
+    BAD_REVISION_HASH,
+    ChaosInjector,
+    OperatorCrash,
+)
 from tpu_operator_libs.chaos.invariants import (
     InvariantMonitor,
     InvariantViolation,
+    RolloutExpectation,
 )
 from tpu_operator_libs.chaos.runner import (
     ChaosConfig,
     ChaosReport,
+    run_bad_revision_soak,
     run_chaos_soak,
 )
 from tpu_operator_libs.chaos.schedule import (
     FAULT_API_BURST,
+    FAULT_BAD_REVISION,
     FAULT_CRASHLOOP,
     FAULT_KINDS,
     FAULT_LEADER_LOSS,
@@ -43,10 +53,12 @@ from tpu_operator_libs.chaos.schedule import (
 )
 
 __all__ = [
+    "BAD_REVISION_HASH",
     "ChaosConfig",
     "ChaosInjector",
     "ChaosReport",
     "FAULT_API_BURST",
+    "FAULT_BAD_REVISION",
     "FAULT_CRASHLOOP",
     "FAULT_KINDS",
     "FAULT_LEADER_LOSS",
@@ -60,5 +72,7 @@ __all__ = [
     "InvariantMonitor",
     "InvariantViolation",
     "OperatorCrash",
+    "RolloutExpectation",
+    "run_bad_revision_soak",
     "run_chaos_soak",
 ]

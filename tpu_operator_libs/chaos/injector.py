@@ -21,6 +21,7 @@ from typing import Callable, Optional
 
 from tpu_operator_libs.chaos.schedule import (
     FAULT_API_BURST,
+    FAULT_BAD_REVISION,
     FAULT_CRASHLOOP,
     FAULT_LEADER_LOSS,
     FAULT_NOT_READY_FLAP,
@@ -31,6 +32,7 @@ from tpu_operator_libs.chaos.schedule import (
     FaultEvent,
     FaultSchedule,
 )
+from tpu_operator_libs.consts import POD_CONTROLLER_REVISION_HASH_LABEL
 from tpu_operator_libs.consts import UpgradeState
 from tpu_operator_libs.k8s.client import ApiServerError, NotFoundError
 from tpu_operator_libs.k8s.fake import FakeCluster
@@ -40,6 +42,11 @@ from tpu_operator_libs.upgrade.state_provider import (
 )
 
 logger = logging.getLogger(__name__)
+
+#: Revision hash the bad-revision fault rolls the runtime DaemonSet to.
+#: Pods carrying it can never become Ready — the "broken libtpu build"
+#: the canary guard exists to contain.
+BAD_REVISION_HASH = "bad"
 
 
 class OperatorCrash(RuntimeError):
@@ -188,6 +195,7 @@ class ChaosInjector:
         self._pdb_windows: list[tuple[float, float]] = []
         self.installed = False
         self.leader_losses = 0
+        self.bad_revisions_rolled = 0
 
     # -- installation -----------------------------------------------------
     def install(self) -> None:
@@ -220,10 +228,31 @@ class ChaosInjector:
             elif event.kind == FAULT_LEADER_LOSS:
                 cluster.schedule_at(
                     event.at, lambda: self._steal_lease())
+            elif event.kind == FAULT_BAD_REVISION:
+                cluster.schedule_at(
+                    event.at,
+                    lambda e=event: self._inject_bad_revision(e))
         if any(e.kind == FAULT_CRASHLOOP for e in self._schedule.events):
             cluster.add_pod_ready_gate(self._ready_gate)
+        if any(e.kind == FAULT_BAD_REVISION
+               for e in self._schedule.events):
+            # the broken build: any pod recreated from the bad revision
+            # crash-loops forever — there is no heal window; recovery is
+            # the canary guard's rollback or nothing
+            cluster.add_pod_ready_gate(
+                lambda pod: pod.metadata.labels.get(
+                    POD_CONTROLLER_REVISION_HASH_LABEL)
+                != BAD_REVISION_HASH)
         if self._pdb_windows:
             cluster.add_eviction_blocker(self._eviction_blocked)
+
+    def _inject_bad_revision(self, event: FaultEvent) -> None:
+        namespace, _, name = event.target.partition("/")
+        self.bad_revisions_rolled += 1
+        logger.info("chaos: rolling DaemonSet %s to broken revision %r",
+                    event.target, BAD_REVISION_HASH)
+        self._cluster.bump_daemon_set_revision(namespace, name,
+                                               BAD_REVISION_HASH)
 
     def _inject_stale(self, event: FaultEvent) -> None:
         try:

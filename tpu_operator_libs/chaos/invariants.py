@@ -49,6 +49,7 @@ from tpu_operator_libs.chaos.injector import consume_transient
 from tpu_operator_libs.consts import (
     IN_PROGRESS_STATES,
     LEGAL_EDGES,
+    POD_CONTROLLER_REVISION_HASH_LABEL,
     REMEDIATION_LEGAL_EDGES,
     REMEDIATION_WORKLOAD_UNSAFE_STATES,
     WORKLOAD_UNSAFE_STATES,
@@ -69,6 +70,38 @@ from tpu_operator_libs.k8s.watch import (
 logger = logging.getLogger(__name__)
 
 _IN_PROGRESS = frozenset(str(s) for s in IN_PROGRESS_STATES)
+
+
+@dataclass(frozen=True)
+class RolloutExpectation:
+    """Arms the rollout (canary halt + rollback) invariants.
+
+    ``bad_revision`` is the revision hash the scenario condemned. The
+    monitor then asserts, from watch events alone:
+
+    - **rollout-halt**: after it has itself observed
+      ``failure_threshold`` distinct nodes enter ``upgrade-failed``
+      while carrying the bad revision, NO node is admitted into the
+      upgrade flow (into ``cordon-required``, or newly into
+      ``upgrade-required``) until a rollback signal appears — a
+      bad-revision runtime pod being deleted, or a runtime pod of any
+      other revision being created (either proves the DaemonSet was
+      re-pinned). Event order makes "within one reconcile pass" exact:
+      a pass that admits before its snapshot could contain the verdicts
+      emits its admissions BEFORE the verdict labels, so any admission
+      event AFTER the threshold verdict and BEFORE the rollback signal
+      is a genuine halt breach.
+    - **rollout-bad-pod**: no runtime pod carrying the bad revision is
+      created later than ``bad_pod_grace_seconds`` after the halt
+      evidence — recreations already in flight when the halt landed get
+      the grace; anything later means a restart re-attempted the
+      quarantined revision.
+    """
+
+    bad_revision: str
+    failure_threshold: int = 1
+    runtime_namespace: str = "tpu-system"
+    bad_pod_grace_seconds: float = 30.0
 
 
 @dataclass(frozen=True)
@@ -110,6 +143,8 @@ class InvariantMonitor:
     workload_namespace: str = "workloads"
     trace_limit: int = 4000
     watch_queue_bound: Optional[int] = None
+    #: Arms the canary-halt/rollback invariants; None disables them.
+    rollout: Optional[RolloutExpectation] = None
 
     violations: list[InvariantViolation] = field(default_factory=list)
     trace: list[str] = field(default_factory=list)
@@ -120,6 +155,15 @@ class InvariantMonitor:
 
     def __post_init__(self) -> None:
         self._nodes: dict[str, _NodeMirror] = {}
+        #: node -> revision hash of its runtime pod (rollout mode only).
+        self._pod_revisions: dict[str, str] = {}
+        #: distinct nodes seen failing ON the bad revision.
+        self._bad_failed: set[str] = set()
+        #: virtual time the failure threshold was first observed met.
+        self.halt_evidence_at: Optional[float] = None
+        #: True once a rollback signal (bad pod deleted / non-bad pod
+        #: created after halt evidence) has been observed.
+        self.rollback_signaled = False
         self._watch = self.cluster.watch(max_queue=self.watch_queue_bound)
         self.resync("initial sync")
 
@@ -157,6 +201,16 @@ class InvariantMonitor:
                 unschedulable=node.is_unschedulable(),
                 ready=node.is_ready())
         self._nodes = fresh
+        if self.rollout is not None:
+            pods = consume_transient(lambda: self.cluster.list_pods(
+                namespace=self.rollout.runtime_namespace))
+            revisions: dict[str, str] = {}
+            for pod in pods:
+                pod_hash = pod.metadata.labels.get(
+                    POD_CONTROLLER_REVISION_HASH_LABEL)
+                if pod_hash and pod.spec.node_name:
+                    revisions[pod.spec.node_name] = pod_hash
+            self._pod_revisions = revisions
 
     def drain(self) -> int:
         """Consume every pending watch event; returns events processed.
@@ -227,11 +281,31 @@ class InvariantMonitor:
                          f"{old.upgrade_state or 'unknown'} -> "
                          f"{new.upgrade_state or 'unknown'}")
             self._check_upgrade_edge(name, old, new)
+            self._track_rollout_verdict(name, new)
         if old.remediation_state != new.remediation_state:
             self._record(f"node {name} remediation "
                          f"{old.remediation_state or 'healthy'} -> "
                          f"{new.remediation_state or 'healthy'}")
             self._check_remediation_edge(name, old, new)
+
+    def _track_rollout_verdict(self, name: str,
+                               new: _NodeMirror) -> None:
+        """Accumulate bad-revision failure verdicts the monitor has
+        OBSERVED (its own evidence, independent of the guard's)."""
+        if self.rollout is None or self.halt_evidence_at is not None:
+            return
+        if new.upgrade_state != str(UpgradeState.FAILED):
+            return
+        if self._pod_revisions.get(name) != self.rollout.bad_revision:
+            return
+        self._bad_failed.add(name)
+        if len(self._bad_failed) >= self.rollout.failure_threshold:
+            self.halt_evidence_at = self._now()
+            self._record(
+                f"rollout halt evidence: {len(self._bad_failed)} "
+                f"node(s) failed on revision "
+                f"{self.rollout.bad_revision!r} — admissions must stop "
+                f"until a rollback signal")
 
     def _check_upgrade_edge(self, name: str, old: _NodeMirror,
                             new: _NodeMirror) -> None:
@@ -243,6 +317,18 @@ class InvariantMonitor:
                 f"{new.upgrade_state or 'unknown'!r} is not an edge of "
                 f"consts.STATE_EDGES")
             return
+        if (self.rollout is not None
+                and self.halt_evidence_at is not None
+                and not self.rollback_signaled
+                and new.upgrade_state in (
+                    str(UpgradeState.CORDON_REQUIRED),
+                    str(UpgradeState.UPGRADE_REQUIRED))):
+            self._violate(
+                "rollout-halt", name,
+                f"node moved to {new.upgrade_state!r} after the canary "
+                f"failure threshold was met (at t="
+                f"{self.halt_evidence_at:g}) and before any rollback "
+                f"signal — the fleet failed to halt")
         if new.upgrade_state != str(UpgradeState.CORDON_REQUIRED):
             return
         if old.unschedulable:
@@ -312,6 +398,10 @@ class InvariantMonitor:
 
     # -- pod events -------------------------------------------------------
     def _on_pod(self, event_type: str, pod) -> None:
+        if (self.rollout is not None and pod.metadata.namespace
+                == self.rollout.runtime_namespace):
+            self._on_runtime_pod(event_type, pod)
+            return
         if event_type != ADDED:
             return
         if pod.metadata.namespace != self.workload_namespace:
@@ -336,6 +426,49 @@ class InvariantMonitor:
                 "workload-placement", where,
                 f"scheduled onto node {node_name} under remediation "
                 f"({mirror.remediation_state!r})")
+
+    def _on_runtime_pod(self, event_type: str, pod) -> None:
+        """Rollout-mode bookkeeping over the runtime DaemonSet's pods:
+        per-node revision mirror, the rollback signal, and the
+        no-bad-pod-after-halt assertion."""
+        rollout = self.rollout
+        pod_hash = pod.metadata.labels.get(
+            POD_CONTROLLER_REVISION_HASH_LABEL)
+        node_name = pod.spec.node_name
+        if not pod_hash or not node_name:
+            return
+        bad = rollout.bad_revision
+        if event_type == DELETED:
+            if self._pod_revisions.get(node_name) == pod_hash:
+                del self._pod_revisions[node_name]
+            if pod_hash == bad and self.halt_evidence_at is not None \
+                    and not self.rollback_signaled:
+                # the machine is evacuating the condemned revision —
+                # admissions after this point are re-convergence
+                self.rollback_signaled = True
+                self._record(f"rollback signal: bad-revision pod "
+                             f"{pod.metadata.name} deleted")
+            return
+        self._pod_revisions[node_name] = pod_hash
+        if event_type != ADDED or self.halt_evidence_at is None:
+            return
+        if pod_hash != bad:
+            # a pod of another revision materialized after the halt:
+            # only a re-pinned DaemonSet mints those
+            if not self.rollback_signaled:
+                self.rollback_signaled = True
+                self._record(f"rollback signal: pod {pod.metadata.name} "
+                             f"created on revision {pod_hash!r}")
+            return
+        grace_until = self.halt_evidence_at + rollout.bad_pod_grace_seconds
+        if self._now() > grace_until:
+            self._violate(
+                "rollout-bad-pod", f"pod {pod.metadata.name}",
+                f"runtime pod created on quarantined revision {bad!r} "
+                f"at t={self._now():g}, past the halt grace window "
+                f"(evidence at t={self.halt_evidence_at:g} + "
+                f"{rollout.bad_pod_grace_seconds:g}s) — a restart "
+                f"re-attempted the condemned revision")
 
     # -- liveness ---------------------------------------------------------
     def final_check(self) -> None:

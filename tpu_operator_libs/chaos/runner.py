@@ -43,6 +43,7 @@ from tpu_operator_libs.api.upgrade_policy import (
     UpgradePolicySpec,
 )
 from tpu_operator_libs.chaos.injector import (
+    BAD_REVISION_HASH,
     ChaosInjector,
     CrashingStateProvider,
     OperatorCrash,
@@ -50,6 +51,7 @@ from tpu_operator_libs.chaos.injector import (
 from tpu_operator_libs.chaos.invariants import (
     InvariantMonitor,
     InvariantViolation,
+    RolloutExpectation,
 )
 from tpu_operator_libs.chaos.schedule import FaultSchedule
 from tpu_operator_libs.consts import (
@@ -368,6 +370,215 @@ def run_chaos_soak(seed: int,
                    f"fault healed at {schedule.last_fault_time:g}s"))
 
     # sanity: the harness itself must have exercised what it claims
+    if injector.crashes_fired == 0:
+        monitor.violations.append(InvariantViolation(
+            invariant="harness", at=clock.now(), subject="injector",
+            detail="no operator crash fired — the schedule's crash "
+                   "events never detonated"))
+
+    report = ChaosReport(
+        seed=seed,
+        converged=is_converged,
+        violations=list(monitor.violations),
+        fault_kinds=tuple(sorted(schedule.kinds)),
+        crashes_fired=injector.crashes_fired,
+        leader_handovers=handovers,
+        operator_incarnations=incarnations,
+        watch_gaps=monitor.watch_gaps,
+        total_seconds=clock.now(),
+        steps=steps,
+        reconciles=reconciles,
+        trace=list(monitor.trace))
+    report.report_text = "\n".join(
+        [schedule.describe(), monitor.report(seed=seed)])
+    if not report.ok:
+        logger.error("%s", report.report_text)
+    return report
+
+
+def run_bad_revision_soak(seed: int,
+                          config: Optional[ChaosConfig] = None,
+                          ) -> ChaosReport:
+    """The canary-halt-rollback gate: one seeded episode where the
+    runtime DaemonSet is rolled to a revision whose pods can never
+    become Ready.
+
+    The operator runs with a canary policy (cohort of 1, failure
+    threshold 1, automatic rollback); the monitor's rollout invariants
+    prove the fleet halts within one reconcile pass of the threshold
+    tripping, that no node newly enters the upgrade flow after the halt
+    until the rollback signal, and that no pod of the condemned
+    revision is ever minted again; convergence means every node is
+    upgrade-done back on the PREVIOUS revision with the quarantine
+    annotation still on the DaemonSet. Remediation is disabled for the
+    episode: a crash-looping canary pod is also a wedge signal, and the
+    gate must attribute the recovery to the upgrade machine's rollback,
+    not to the node-remediation ladder (their interplay is covered by
+    the main soak gate).
+    """
+    config = config or ChaosConfig()
+    fleet = FleetSpec(
+        n_slices=config.n_slices,
+        hosts_per_slice=config.hosts_per_slice,
+        pod_recreate_delay=config.pod_recreate_delay,
+        pod_ready_delay=config.pod_ready_delay,
+        multislice_jobs=(
+            ("chaos-job", tuple(range(config.n_slices))),))
+    cluster, clock, keys = build_fleet(fleet)
+    rem_keys = RemediationKeys()
+    node_names = [n.metadata.name for n in cluster.list_nodes()]
+
+    schedule = FaultSchedule.generate_bad_revision(
+        seed, node_names, ds_target=f"{NS}/libtpu",
+        horizon=config.horizon)
+    injector = ChaosInjector(cluster, schedule,
+                             lease_namespace=config.lease_namespace,
+                             lease_name=config.lease_name)
+    injector.install()
+
+    from tpu_operator_libs.api.upgrade_policy import (
+        CanaryRolloutSpec,
+        RollbackSpec,
+    )
+
+    upgrade_policy = config.upgrade_policy()
+    upgrade_policy.canary = CanaryRolloutSpec(
+        enable=True, canary_count=1, bake_seconds=30,
+        failure_threshold=1)
+    upgrade_policy.rollback = RollbackSpec(enable=True)
+    remediation_policy = config.remediation_policy()
+    remediation_policy.enable = False
+
+    monitor = InvariantMonitor(
+        cluster=cluster, upgrade_keys=keys, remediation_keys=rem_keys,
+        max_unavailable=upgrade_policy.max_unavailable,
+        remediation_max_unavailable=None,
+        max_parallel_upgrades=config.max_parallel_upgrades,
+        rollout=RolloutExpectation(
+            bad_revision=BAD_REVISION_HASH,
+            failure_threshold=upgrade_policy.canary.failure_threshold,
+            runtime_namespace=NS,
+            bad_pod_grace_seconds=(config.pod_recreate_delay
+                                   + 3 * config.reconcile_interval)))
+
+    incarnations = 1
+    handovers = 0
+    reconciles = 0
+    op = _OperatorIncarnation(cluster, clock, keys, rem_keys, config,
+                              injector, identity="operator-1")
+
+    def next_incarnation(reason: str) -> _OperatorIncarnation:
+        nonlocal incarnations
+        incarnations += 1
+        injector.fuse.reset()
+        monitor.trace.append(
+            f"[t={clock.now():g}] operator restart #{incarnations} "
+            f"({reason}) — rebuilding managers from cluster state alone")
+        return _OperatorIncarnation(
+            cluster, clock, keys, rem_keys, config, injector,
+            identity=f"operator-{incarnations}")
+
+    #: what the fleet must converge BACK to: the newest revision before
+    #: the bad roll (build_fleet's rollout target)
+    good_revision = cluster.latest_revision_hash(NS, "libtpu")
+
+    def converged() -> bool:
+        try:
+            nodes = cluster.list_nodes()
+            pods = cluster.list_pods(namespace=NS)
+            daemon_sets = cluster.list_daemon_sets(NS)
+        except (ApiServerError, TimeoutError):
+            return False
+        if len(nodes) != len(node_names):
+            return False
+        for node in nodes:
+            labels = node.metadata.labels
+            if labels.get(keys.state_label) != str(UpgradeState.DONE):
+                return False
+            if node.is_unschedulable() or not node.is_ready():
+                return False
+        runtime = [p for p in pods if p.controller_owner() is not None]
+        if len(runtime) != len(node_names):
+            return False
+        if not all(
+                p.metadata.labels.get(POD_CONTROLLER_REVISION_HASH_LABEL)
+                == good_revision and p.is_ready() for p in runtime):
+            return False
+        # the quarantine record must survive convergence: it is what
+        # keeps reconcile from ever re-attempting the bad hash
+        return any(
+            ds.metadata.annotations.get(
+                keys.quarantined_revision_annotation)
+            == BAD_REVISION_HASH for ds in daemon_sets)
+
+    steps = 0
+    is_converged = False
+    quiesce_ticks = 0
+    while steps < config.max_steps:
+        steps += 1
+        now = clock.now()
+        was_leading = op.elector.is_leader
+        op.elector.try_acquire_or_renew()
+        if was_leading and not op.elector.is_leader:
+            handovers += 1
+            op = next_incarnation("leader election lost")
+            op.elector.try_acquire_or_renew()
+        if op.elector.is_leader:
+            injector.arm_due_crashes(now)
+            try:
+                op.remediation.reconcile(NS, dict(RUNTIME_LABELS),
+                                         remediation_policy)
+                op.upgrade.reconcile(NS, dict(RUNTIME_LABELS),
+                                     upgrade_policy)
+                reconciles += 1
+            except OperatorCrash:
+                op = next_incarnation("operator crash mid-reconcile")
+            except BuildStateError:
+                pass
+            except (ApiServerError, ConflictError, NotFoundError):
+                pass
+            if injector.fuse.pending:
+                op = next_incarnation("operator crash (surfaced late)")
+        monitor.drain()
+        try:
+            restore_workload_pods(cluster, fleet)
+        except (ApiServerError, TimeoutError):
+            pass
+        monitor.drain()
+        if (now > schedule.last_fault_time
+                and not injector.fuse.armed
+                and not injector.fuse.pending
+                and converged()):
+            quiesce_ticks += 1
+            if quiesce_ticks >= 3:
+                is_converged = True
+                break
+        else:
+            quiesce_ticks = 0
+        clock.advance(config.reconcile_interval)
+        cluster.step()
+        monitor.drain()
+
+    if is_converged:
+        monitor.final_check()
+    else:
+        monitor.violations.append(InvariantViolation(
+            invariant="liveness", at=clock.now(), subject="fleet",
+            detail=f"fleet did not converge back to revision "
+                   f"{good_revision!r} within {config.max_steps} steps "
+                   f"({clock.now():g}s virtual)"))
+
+    # harness sanity: the episode must have exercised what it gates
+    if injector.bad_revisions_rolled == 0:
+        monitor.violations.append(InvariantViolation(
+            invariant="harness", at=clock.now(), subject="injector",
+            detail="bad-revision fault never fired"))
+    if monitor.halt_evidence_at is None:
+        monitor.violations.append(InvariantViolation(
+            invariant="harness", at=clock.now(), subject="monitor",
+            detail="no halt evidence observed — the bad revision never "
+                   "produced a failure verdict, so the gate proved "
+                   "nothing"))
     if injector.crashes_fired == 0:
         monitor.violations.append(InvariantViolation(
             invariant="harness", at=clock.now(), subject="injector",

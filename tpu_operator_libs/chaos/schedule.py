@@ -43,6 +43,15 @@ FAULT_CRASHLOOP = "crashloop"
 FAULT_PDB_BLOCK = "pdb-block"
 FAULT_LEADER_LOSS = "leader-loss"
 FAULT_OPERATOR_CRASH = "operator-crash"
+#: The runtime DaemonSet is rolled to a revision whose pods can never
+#: become Ready (a broken libtpu build): every pod recreated from it
+#: crash-loops until the fleet rolls the revision back. target is the
+#: "namespace/name" of the DaemonSet; the injected hash is
+#: ``injector.BAD_REVISION_HASH``. Unlike every other kind this fault
+#: does not heal on its own — recovering from it is the system's job
+#: (canary halt + rollback), which is exactly what the bad-revision
+#: soak gate proves.
+FAULT_BAD_REVISION = "bad-revision"
 
 #: The full catalog, in deterministic order (generation samples from it).
 FAULT_KINDS = (
@@ -190,5 +199,57 @@ class FaultSchedule:
                         until=start + rng.uniform(20.0, 110.0)))
                 elif kind == FAULT_LEADER_LOSS:
                     events.append(FaultEvent(at=start, kind=kind))
+        events.sort(key=lambda e: (e.at, e.kind, e.target))
+        return cls(seed=seed, events=tuple(events))
+
+    @classmethod
+    def generate_bad_revision(cls, seed: int, node_names: list[str],
+                              ds_target: str,
+                              horizon: float = 600.0,
+                              extra_kinds: int = 2) -> "FaultSchedule":
+        """Schedule for the canary-halt-rollback gate: exactly one
+        ``bad-revision`` rollout of ``ds_target`` (a "namespace/name"
+        DaemonSet reference), at least one operator crash, and
+        ``extra_kinds`` control-plane fault kinds riding along. The
+        side-fault pool deliberately excludes ``crashloop`` and
+        ``notready-flap``: a node crash-looping for an unrelated reason
+        would be indistinguishable from a bad-revision verdict, and the
+        gate must prove the guard halts on the REVISION's failures, not
+        on coincident node faults.
+        """
+        if not node_names:
+            raise ValueError("node_names must be non-empty")
+        rng = random.Random(f"chaos-bad-revision:{seed}")
+        nodes = sorted(node_names)
+        # late enough that the first (good) rollout is under way or
+        # done, early enough that halt + rollback + re-convergence
+        # fit inside the horizon across all seeds
+        bad_at = rng.uniform(horizon * 0.25, horizon * 0.45)
+        events: list[FaultEvent] = [FaultEvent(
+            at=bad_at, kind=FAULT_BAD_REVISION, target=ds_target)]
+        for _ in range(rng.randint(1, 2)):
+            events.append(FaultEvent(
+                # strictly before the bad roll: the halt/rollback write
+                # storm that follows guarantees every armed crash
+                # detonates (an armed-but-never-fired crash would block
+                # the convergence check forever on a quiet fleet)
+                at=rng.uniform(0.1, bad_at - 10.0),
+                kind=FAULT_OPERATOR_CRASH,
+                param=rng.randint(0, 8)))
+        pool = [FAULT_API_BURST, FAULT_WATCH_BREAK, FAULT_STALE_READS,
+                FAULT_LEADER_LOSS]
+        for kind in rng.sample(pool, min(extra_kinds, len(pool))):
+            start = rng.uniform(0.1, horizon * 0.7)
+            if kind == FAULT_API_BURST:
+                events.append(FaultEvent(
+                    at=start, kind=kind,
+                    target=rng.choice(API_BURST_OPERATIONS),
+                    param=rng.randint(1, 3)))
+            elif kind == FAULT_STALE_READS:
+                events.append(FaultEvent(
+                    at=start, kind=kind, target=rng.choice(nodes),
+                    param=rng.randint(1, 3)))
+            else:
+                events.append(FaultEvent(at=start, kind=kind))
         events.sort(key=lambda e: (e.at, e.kind, e.target))
         return cls(seed=seed, events=tuple(events))

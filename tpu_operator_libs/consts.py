@@ -66,6 +66,13 @@ class UpgradeState(str, enum.Enum):
     DONE = "upgrade-done"
     # Any failure during the upgrade; auto-recovers when the pod is healthy.
     FAILED = "upgrade-failed"
+    # The fleet halted on a bad revision (canary threshold tripped): the
+    # node's runtime pod must be restarted back onto the PREVIOUS
+    # ControllerRevision, revalidated, and returned to service. Entered
+    # only from FAILED / VALIDATION_REQUIRED while the RolloutGuard has
+    # quarantined the node's current revision (beyond-reference: the
+    # reference has no notion of "the new revision itself is bad").
+    ROLLBACK_REQUIRED = "rollback-required"
 
     def __str__(self) -> str:  # label values are plain strings
         return self.value
@@ -83,6 +90,7 @@ IN_PROGRESS_STATES = (
     UpgradeState.VALIDATION_REQUIRED,
     UpgradeState.UNCORDON_REQUIRED,
     UpgradeState.FAILED,
+    UpgradeState.ROLLBACK_REQUIRED,
 )
 
 #: Every state bucket, in the fixed order ApplyState processes them
@@ -97,6 +105,7 @@ ALL_STATES = (
     UpgradeState.DRAIN_REQUIRED,
     UpgradeState.POD_RESTART_REQUIRED,
     UpgradeState.FAILED,
+    UpgradeState.ROLLBACK_REQUIRED,
     UpgradeState.VALIDATION_REQUIRED,
     UpgradeState.UNCORDON_REQUIRED,
 )
@@ -154,6 +163,19 @@ STATE_EDGES: tuple[tuple[UpgradeState, UpgradeState, str], ...] = (
      "pod healthy again [validated] (was cordoned before upgrade)"),
     (UpgradeState.FAILED, UpgradeState.DRAIN_REQUIRED,
      "pod healthy but OUTDATED (new DS revision while failed)"),
+    (UpgradeState.FAILED, UpgradeState.ROLLBACK_REQUIRED,
+     "fleet halted: node's revision quarantined, rollback enabled"),
+    (UpgradeState.VALIDATION_REQUIRED, UpgradeState.ROLLBACK_REQUIRED,
+     "fleet halted: node's revision quarantined, rollback enabled"),
+    (UpgradeState.ROLLBACK_REQUIRED, UpgradeState.VALIDATION_REQUIRED,
+     "pod back on previous revision & ready (validation enabled)"),
+    (UpgradeState.ROLLBACK_REQUIRED, UpgradeState.UNCORDON_REQUIRED,
+     "pod back on previous revision & ready (was schedulable)"),
+    (UpgradeState.ROLLBACK_REQUIRED, UpgradeState.DONE,
+     "pod back on previous revision & ready (was cordoned before "
+     "upgrade)"),
+    (UpgradeState.ROLLBACK_REQUIRED, UpgradeState.FAILED,
+     "rollback pod crash-looping (>10 restarts)"),
 )
 
 #: Adjacency view of STATE_EDGES, keyed by label value ("" = unknown).
@@ -176,6 +198,7 @@ WORKLOAD_UNSAFE_STATES = frozenset(str(s) for s in (
     UpgradeState.POD_RESTART_REQUIRED,
     UpgradeState.VALIDATION_REQUIRED,
     UpgradeState.FAILED,
+    UpgradeState.ROLLBACK_REQUIRED,
 ))
 
 class RemediationState(str, enum.Enum):
@@ -391,6 +414,28 @@ class UpgradeKeys:
         orphaned pods, whose revision hash cannot be compared)
         (consts.go:38-41)."""
         return f"{self.domain}/{self.driver}-upgrade-requested"
+
+    @property
+    def quarantined_revision_annotation(self) -> str:
+        """DAEMONSET annotation recording a revision hash the
+        RolloutGuard condemned (canary failure threshold tripped). While
+        the DaemonSet's newest ControllerRevision still carries this
+        hash the fleet is HALTED: no node newly enters the upgrade flow
+        and no runtime pod is restarted onto it. The annotation is the
+        durable halt commit — an operator crash between halt and
+        rollback resumes from it — and it outlives the rollback as the
+        quarantine record, so reconcile never re-attempts the hash until
+        the DS spec changes (a changed spec means a different hash)."""
+        return f"{self.domain}/{self.driver}-upgrade.quarantined-revision"
+
+    @property
+    def canary_passed_annotation(self) -> str:
+        """DAEMONSET annotation: ``<revision-hash>:<epoch-seconds>``
+        stamped when every canary-cohort node reached upgrade-done on
+        that revision. Fleet waves open once the bake time has elapsed
+        past the stamp; keyed by hash so a new rollout re-runs its own
+        canary instead of inheriting the previous rollout's verdict."""
+        return f"{self.domain}/{self.driver}-upgrade.canary-passed"
 
     @property
     def event_reason(self) -> str:

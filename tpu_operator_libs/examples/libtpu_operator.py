@@ -35,6 +35,7 @@ from tpu_operator_libs.metrics import (
     MetricsRegistry,
     observe_client_health,
     observe_cluster_state,
+    observe_rollout,
 )
 from tpu_operator_libs.upgrade.state_manager import (
     BuildStateError,
@@ -179,6 +180,9 @@ def reconcile_once(mgr, args, policy, registry, runtime_labels) -> None:
         latest_status[args.driver] = mgr.cluster_status(state)
         mgr.apply_state(state, policy)
         observe_cluster_state(registry, mgr, state, driver=args.driver)
+        # canary/halt/rollback accounting rides the same scrape: the
+        # rollout_halted gauge flipping to 1 is the on-call page
+        observe_rollout(registry, mgr.rollout_guard, driver=args.driver)
         logger.info("reconciled: %d/%d done, %d in progress, %d failed",
                     mgr.get_upgrades_done(state),
                     mgr.get_total_managed_nodes(state),
@@ -217,7 +221,21 @@ def reconcile_forever(mgr, args, policy, registry, stop: threading.Event,
 
 
 def run_demo(args, registry) -> int:
-    """Simulated fleet: watch a full slice-atomic rolling upgrade."""
+    """Simulated fleet, two episodes end to end:
+
+    1. a full slice-atomic rolling upgrade (old -> new), then
+    2. a canary-halt-rollback walk: the DaemonSet rolls to a BROKEN
+       revision whose pods can never become Ready; the canary cohort
+       probes it, fails, the RolloutGuard halts the fleet, quarantines
+       the revision, re-pins the previous one, and every touched node
+       rolls back — the fleet converges on the old revision with the
+       quarantine annotation as the durable record.
+    """
+    from tpu_operator_libs.api.upgrade_policy import (
+        CanaryRolloutSpec,
+        RollbackSpec,
+    )
+    from tpu_operator_libs.consts import POD_CONTROLLER_REVISION_HASH_LABEL
     from tpu_operator_libs.simulate import (
         NS,
         RUNTIME_LABELS,
@@ -232,33 +250,83 @@ def run_demo(args, registry) -> int:
                                    for k, v in RUNTIME_LABELS.items())
     mgr = build_manager(args, cluster, clock=clock, poll_interval=0.0)
     policy = load_policy(args.policy)
-    stop = threading.Event()
-    outcome = {"converged": False}
 
     virtual_interval = args.interval  # simulated seconds between passes
     deadline = time.monotonic() + 120  # real-time safety stop
-
-    def step_hook() -> bool:
-        clock.advance(virtual_interval)
-        cluster.step()
-        labels = [n.metadata.labels.get(keys.state_label, "")
-                  for n in cluster.list_nodes()]
-        if all(lb == "upgrade-done" for lb in labels):
-            logger.info("demo complete: all %d nodes upgraded in %.0fs "
-                        "simulated", len(labels), clock.now())
-            print(registry.render_prometheus())
-            outcome["converged"] = True
-            stop.set()
-            return True
-        if time.monotonic() > deadline:
-            logger.error("demo did not converge within the safety window")
-            stop.set()
-            return True
-        return False
-
     args.interval = 0.0  # no real-time sleep between simulated passes
-    reconcile_forever(mgr, args, policy, registry, stop, step_hook)
-    return 0 if outcome["converged"] else 1
+
+    def drive(done, what: str) -> bool:
+        """Run reconcile passes over virtual time until ``done()``."""
+        stop = threading.Event()
+        outcome = {"ok": False}
+
+        def step_hook() -> bool:
+            clock.advance(virtual_interval)
+            cluster.step()
+            if done():
+                outcome["ok"] = True
+                stop.set()
+                return True
+            if time.monotonic() > deadline:
+                logger.error("demo %s did not converge within the "
+                             "safety window", what)
+                stop.set()
+                return True
+            return False
+
+        reconcile_forever(mgr, args, policy, registry, stop, step_hook)
+        return outcome["ok"]
+
+    def fleet_done_on(revision: str) -> bool:
+        nodes = cluster.list_nodes()
+        if not all(n.metadata.labels.get(keys.state_label, "")
+                   == "upgrade-done" and not n.is_unschedulable()
+                   for n in nodes):
+            return False
+        pods = [p for p in cluster.list_pods(namespace=NS)
+                if p.controller_owner() is not None]
+        return len(pods) == len(nodes) and all(
+            p.metadata.labels.get(POD_CONTROLLER_REVISION_HASH_LABEL)
+            == revision and p.is_ready() for p in pods)
+
+    # ---- episode 1: the plain rolling upgrade (old -> new) ----------
+    if not drive(lambda: fleet_done_on("new"), "rolling upgrade"):
+        return 1
+    logger.info("demo episode 1 complete: all %d nodes upgraded in "
+                "%.0fs simulated", len(cluster.list_nodes()), clock.now())
+
+    # ---- episode 2: canary wave -> halt -> automatic rollback -------
+    policy.canary = CanaryRolloutSpec(enable=True, canary_count=1,
+                                      bake_seconds=60,
+                                      failure_threshold=1)
+    policy.rollback = RollbackSpec(enable=True)
+    # the broken build: pods of this revision never become Ready
+    cluster.add_pod_ready_gate(
+        lambda pod: pod.metadata.labels.get(
+            POD_CONTROLLER_REVISION_HASH_LABEL) != "broken")
+    cluster.bump_daemon_set_revision(NS, "libtpu", "broken")
+    logger.info("demo episode 2: DaemonSet rolled to BROKEN revision; "
+                "canary wave begins")
+
+    def rolled_back() -> bool:
+        if not fleet_done_on("new"):
+            return False
+        return any(
+            ds.metadata.annotations.get(
+                keys.quarantined_revision_annotation) == "broken"
+            for ds in cluster.list_daemon_sets(NS))
+
+    if not drive(rolled_back, "canary rollback"):
+        return 1
+    guard = mgr.rollout_guard
+    logger.info(
+        "demo episode 2 complete in %.0fs simulated: %d failure "
+        "verdict(s), %d halt(s), %d rollback(s) — fleet back on the "
+        "previous revision, 'broken' quarantined",
+        clock.now(), guard.canary_failure_verdicts_total,
+        guard.halts_total, guard.rollbacks_started_total)
+    print(registry.render_prometheus())
+    return 0
 
 
 def election_config(args):

@@ -521,6 +521,27 @@ class CachedReadClient(K8sClient):
         if namespace == self._namespace:
             self._pods.apply_external_delete(namespace, name)
 
+    def patch_daemon_set_annotations(
+            self, namespace: str, name: str,
+            annotations: Mapping[str, Optional[str]]) -> DaemonSet:
+        self._count_write()
+        ds = self._delegate.patch_daemon_set_annotations(
+            namespace, name, annotations)
+        if namespace == self._namespace:
+            self._daemon_sets.apply_external(ds.clone())
+        return ds
+
+    def rollback_daemon_set(self, namespace: str, name: str,
+                            revision_hash: str) -> None:
+        # invalidation rides the DS watch event the rollback emits; the
+        # revision-generation cache is bumped eagerly so the very next
+        # oracle read sees the re-pinned ordering
+        self._count_write()
+        self._delegate.rollback_daemon_set(namespace, name, revision_hash)
+        with self._views_lock:
+            self._revisions_gen += 1
+            self._revisions_cache.clear()
+
     def upsert_event(self, namespace: str, name: str,
                      event: object) -> None:
         # write pass-through like every other mutation: without this

@@ -127,6 +127,31 @@ class K8sClient(abc.ABC):
                          label_selector: str = "") -> list[DaemonSet]:
         ...
 
+    def patch_daemon_set_annotations(
+            self, namespace: str, name: str,
+            annotations: Mapping[str, Optional[str]]) -> DaemonSet:
+        """Merge-patch DaemonSet annotations; value None deletes the key.
+        The RolloutGuard's durable store (quarantined revision, canary
+        bake stamp) — fleet-level facts belong on the fleet object, not
+        fanned out across node annotations. Optional capability:
+        implemented by FakeCluster, HttpCluster and RealCluster; a
+        backend without it cannot run canary-gated rollouts."""
+        raise NotImplementedError(
+            f"{type(self).__name__} does not support DaemonSet "
+            f"annotation patches")
+
+    def rollback_daemon_set(self, namespace: str, name: str,
+                            revision_hash: str) -> None:
+        """Re-pin the DaemonSet's pod template to the ControllerRevision
+        carrying ``revision_hash`` (``kubectl rollout undo`` semantics:
+        the old revision is re-numbered newest and subsequent pod
+        recreations use it). Raises NotFoundError when the DS or the
+        revision does not exist. Optional capability: implemented by
+        FakeCluster; live backends need the revision's stored template
+        data, which this object model does not carry yet."""
+        raise NotImplementedError(
+            f"{type(self).__name__} does not support DaemonSet rollback")
+
     @abc.abstractmethod
     def list_controller_revisions(self, namespace: str,
                                   label_selector: str = "") -> list[ControllerRevision]:

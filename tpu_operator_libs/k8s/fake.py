@@ -342,6 +342,77 @@ class FakeCluster(K8sClient):
                 raise NotFoundError(f"no revisions for daemonset {name}")
             return max(revs, key=lambda r: r.revision).hash
 
+    def seed_revision_history(self, namespace: str, name: str,
+                              hashes: "list[str]") -> None:
+        """Seed PRIOR ControllerRevisions for a DaemonSet — oldest first,
+        all numbered beneath the current newest revision — so rollback
+        paths are testable without hand-building revision objects.
+        Existing revisions are re-numbered upward to make room; their
+        relative order (and therefore the newest hash) is unchanged."""
+        for revision_hash in hashes:
+            self._check_revision_hash(revision_hash)
+        with self._lock:
+            ds = self._daemon_sets.get((namespace, name))
+            if ds is None:
+                raise NotFoundError(f"daemonset {namespace}/{name} not found")
+            for rev in self._revisions_of(namespace, name):
+                rev.revision += len(hashes)
+            for index, revision_hash in enumerate(hashes, start=1):
+                rev_name = f"{name}-{revision_hash}"
+                key = (namespace, rev_name)
+                if key in self._revisions:
+                    raise ValueError(
+                        f"revision hash {revision_hash!r} already exists "
+                        f"for daemonset {name}")
+                self._revisions[key] = ControllerRevision(
+                    metadata=ObjectMeta(name=rev_name, namespace=namespace,
+                                        labels=dict(ds.spec.selector)),
+                    revision=index)
+                self._revision_owner[key] = (namespace, name)
+
+    def rollback_daemon_set(self, namespace: str, name: str,
+                            revision_hash: str) -> None:
+        """Re-pin an EXISTING revision as the DS's update revision
+        (``kubectl rollout undo --to-revision`` semantics: the chosen
+        revision is re-numbered newest; subsequent DS-controller pod
+        recreations carry its hash). Works backward or forward across
+        the seeded history. No-op when the hash is already newest."""
+        self._maybe_api_error("rollback_daemon_set")
+        with self._lock:
+            ds = self._daemon_sets.get((namespace, name))
+            if ds is None:
+                raise NotFoundError(f"daemonset {namespace}/{name} not found")
+            revs = self._revisions_of(namespace, name)
+            target = next((r for r in revs if r.hash == revision_hash), None)
+            if target is None:
+                raise NotFoundError(
+                    f"daemonset {name} has no revision {revision_hash!r}")
+            newest = max(revs, key=lambda r: r.revision)
+            if newest.hash == revision_hash:
+                return
+            target.revision = newest.revision + 1
+            # the template changed back: a real rollout undo bumps the
+            # template generation too
+            ds.spec.template_generation += 1
+            self._notify(MODIFIED, KIND_DAEMON_SET, ds)
+
+    def patch_daemon_set_annotations(
+            self, namespace: str, name: str,
+            annotations: Mapping[str, Optional[str]]) -> DaemonSet:
+        self._maybe_api_error("patch_daemon_set_annotations")
+        with self._lock:
+            ds = self._daemon_sets.get((namespace, name))
+            if ds is None:
+                raise NotFoundError(f"daemonset {namespace}/{name} not found")
+            for key, value in annotations.items():
+                if value is None:
+                    ds.metadata.annotations.pop(key, None)
+                else:
+                    ds.metadata.annotations[key] = value
+            ds.metadata.resource_version += 1
+            self._notify(MODIFIED, KIND_DAEMON_SET, ds)
+            return ds.clone()
+
     def enable_ds_controller(self, recreate_delay: float = 5.0,
                              ready_delay: float = 10.0,
                              pod_gc_delay: float = 30.0) -> None:

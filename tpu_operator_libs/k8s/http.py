@@ -551,6 +551,18 @@ class HttpCluster(K8sClient):
             f"/apis/apps/v1/namespaces/{namespace}/controllerrevisions",
             label_selector)]
 
+    def patch_daemon_set_annotations(
+            self, namespace: str, name: str,
+            annotations: Mapping[str, Optional[str]]) -> DaemonSet:
+        # same raw merge-patch shape as the node metadata writes: null
+        # deletes the key, untouched keys survive (the RolloutGuard's
+        # quarantine/bake stamps ride this)
+        body = {"metadata": {"annotations": dict(annotations)}}
+        return daemon_set_from_json(self._request(
+            "PATCH",
+            f"/apis/apps/v1/namespaces/{namespace}/daemonsets/{name}",
+            body, _MERGE_PATCH))
+
     # -- events -----------------------------------------------------------
     def upsert_event(self, namespace: str, name: str,
                      event: object) -> None:

@@ -519,6 +519,18 @@ class RealCluster(K8sClient):
             namespace=namespace, label_selector=label_selector or None)
         return [_revision_from(item) for item in items]
 
+    def patch_daemon_set_annotations(
+            self, namespace: str, name: str,
+            annotations: Mapping[str, Optional[str]]) -> DaemonSet:
+        # same merge-patch contract as the node metadata writes (None
+        # deletes); carries the RolloutGuard's quarantine/bake stamps
+        body = {"metadata": {"annotations": dict(annotations)}}
+        try:
+            return _daemon_set_from(self._apps.patch_namespaced_daemon_set(
+                name, namespace, body))
+        except self._k8s.ApiException as exc:
+            raise self._translate(exc) from exc
+
     # -- leases (coordination.k8s.io, leader election) -----------------------
     # resourceVersion is opaque on the wire; it is carried through
     # ObjectMeta.resource_version verbatim (the elector only compares and

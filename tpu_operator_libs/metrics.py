@@ -303,6 +303,62 @@ def observe_reconcile(registry: MetricsRegistry,
             "Writes forwarded to the apiserver", labels)
 
 
+#: Buckets for canary-halt→evacuated durations: a rollback rides pod
+#: restart + revalidation timescales across the touched cohort.
+ROLLBACK_SECONDS_BUCKETS = (30.0, 60.0, 120.0, 300.0, 600.0, 1200.0,
+                            1800.0, 3600.0)
+
+
+def observe_rollout(registry: MetricsRegistry,
+                    guard: "object",
+                    driver: str = "libtpu") -> None:
+    """Export the canary/halt/rollback guard's accounting.
+
+    ``guard`` is a :class:`tpu_operator_libs.upgrade.rollout_guard.
+    RolloutGuard` (anything exposing its counter surface works). Rides
+    the same scrape as the fleet gauges: canary failure verdicts, fleet
+    halts, rollbacks started/completed, the halt→evacuated duration
+    histogram, and point-in-time gauges for "is the fleet halted right
+    now" / "is a canary wave gating admissions" —
+    ``rollout_halted`` going 1 IS the page an on-call wants.
+    """
+    labels = {"driver": driver}
+    registry.set_counter_total(
+        "rollout_canary_failure_verdicts_total",
+        guard.canary_failure_verdicts_total,
+        "Distinct (revision, node) failure verdicts observed", labels)
+    registry.set_counter_total(
+        "rollout_halts_total", guard.halts_total,
+        "Fleet halts committed (revision quarantined)", labels)
+    registry.set_counter_total(
+        "rollout_rollbacks_started_total", guard.rollbacks_started_total,
+        "DaemonSet rollbacks issued (previous revision re-pinned)",
+        labels)
+    registry.set_counter_total(
+        "rollout_rollbacks_completed_total",
+        guard.rollbacks_completed_total,
+        "Quarantined revisions fully evacuated from the fleet", labels)
+    decision = getattr(guard, "last_decision", None)
+    if decision is not None:
+        registry.set_gauge(
+            "rollout_halted", 1.0 if decision.halted else 0.0,
+            "1 while the fleet refuses new upgrade admissions", labels)
+        registry.set_gauge(
+            "rollout_canary_wave_active",
+            1.0 if decision.canary_active else 0.0,
+            "1 while admissions are restricted to the canary cohort",
+            labels)
+        registry.set_gauge(
+            "rollout_quarantined_revisions", len(decision.quarantined),
+            "Revision hashes condemned by the quarantine annotation",
+            labels)
+    for seconds in guard.drain_rollback_durations():
+        registry.observe_histogram(
+            "rollout_rollback_seconds", seconds,
+            "Fleet halt to quarantined-revision evacuation (virtual "
+            "seconds)", labels, buckets=ROLLBACK_SECONDS_BUCKETS)
+
+
 #: Buckets for wedge→recovered durations: remediation rides restart /
 #: reboot / revalidation-settle timescales (minutes to hours), not the
 #: reconcile-latency scale DEFAULT_BUCKETS covers.

@@ -36,9 +36,41 @@ if TYPE_CHECKING:  # pragma: no cover
     from tpu_operator_libs.upgrade.state_manager import (
         ClusterUpgradeState,
         NodeUpgradeState,
+        UpgradePlanner,
     )
 
 logger = logging.getLogger(__name__)
+
+
+class CanaryWavePlanner:
+    """Restricts any inner planner to the canary cohort.
+
+    While a canary wave is active (cohort not yet done + baked on the
+    new revision, see ``upgrade.rollout_guard``), only cohort members
+    may be admitted into the upgrade flow; everything else stays parked
+    in ``upgrade-required``. Composes with both the flat and the
+    slice-atomic planner — a slice-mode canary probes whole cohort
+    slices, budget rules unchanged, because the inner planner still
+    makes the admission decision over the filtered candidate list.
+    """
+
+    def __init__(self, inner: "UpgradePlanner",
+                 cohort: "frozenset[str]") -> None:
+        self.inner = inner
+        self.cohort = cohort
+
+    def plan(self, candidates: list["NodeUpgradeState"], available: int,
+             state: "ClusterUpgradeState") -> list["NodeUpgradeState"]:
+        gated = [ns for ns in candidates
+                 if ns.node.metadata.name in self.cohort]
+        held = len(candidates) - len(gated)
+        if held:
+            logger.info(
+                "canary wave: holding %d node(s) outside the %d-node "
+                "cohort", held, len(self.cohort))
+        if not gated:
+            return []
+        return self.inner.plan(gated, available, state)
 
 
 class SlicePlanner:

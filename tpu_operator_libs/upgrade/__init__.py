@@ -29,6 +29,10 @@ from tpu_operator_libs.upgrade.validation_manager import (  # noqa: F401
 from tpu_operator_libs.upgrade.safe_load_manager import (  # noqa: F401
     SafeRuntimeLoadManager,
 )
+from tpu_operator_libs.upgrade.rollout_guard import (  # noqa: F401
+    RolloutDecision,
+    RolloutGuard,
+)
 from tpu_operator_libs.upgrade.state_manager import (  # noqa: F401
     BuildStateError,
     ClusterUpgradeState,

@@ -165,6 +165,7 @@ class MockPodManager(RecordingMixin):
         super().__init__()
         self.pod_hashes: dict[str, str] = {}
         self.ds_hashes: dict[str, str] = {}
+        self.previous_hashes: dict[str, str] = {}
         self.default_hash = "test-hash-12345"
 
     def get_pod_revision_hash(self, pod: Pod) -> str:
@@ -174,6 +175,11 @@ class MockPodManager(RecordingMixin):
     def get_daemon_set_revision_hash(self, ds: DaemonSet) -> str:
         self.record("get_daemon_set_revision_hash", ds.name)
         return self.ds_hashes.get(ds.name, self.default_hash)
+
+    def get_previous_daemon_set_revision_hash(
+            self, ds: DaemonSet) -> Optional[str]:
+        self.record("get_previous_daemon_set_revision_hash", ds.name)
+        return self.previous_hashes.get(ds.name)
 
     def reset_revision_cache(self) -> None:
         # deliberately not recorded: it is per-pass bookkeeping, and

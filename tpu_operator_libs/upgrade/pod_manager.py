@@ -176,6 +176,25 @@ class PodManager:
             self._revision_memo[ds.metadata.uid] = result
         return result
 
+    def get_previous_daemon_set_revision_hash(
+            self, ds: DaemonSet) -> Optional[str]:
+        """Hash of the DaemonSet's SECOND-newest ControllerRevision — the
+        rollback target after a canary halt — or None when the DS has no
+        history to fall back to (first-ever revision). Same ownership
+        filter as the newest-hash oracle; not memoized: it runs once per
+        halt, not once per node per pass."""
+        selector = selector_from_labels(ds.spec.selector)
+        revisions = self._client.list_controller_revisions(
+            ds.metadata.namespace, selector)
+        prefix = f"{ds.metadata.name}-"
+        owned = [r for r in revisions
+                 if r.metadata.name.startswith(prefix)
+                 and "-" not in r.metadata.name[len(prefix):]]
+        if len(owned) < 2:
+            return None
+        ordered = sorted(owned, key=lambda r: r.revision)
+        return ordered[-2].metadata.name[len(prefix):]
+
     # ------------------------------------------------------------------
     # (a) pod eviction
     # ------------------------------------------------------------------

@@ -1,0 +1,449 @@
+"""RolloutGuard: canary verdicts, fleet halt and revision quarantine.
+
+The reference library upgrades every node to the new DaemonSet revision
+with no notion of "the new revision itself is bad": ``FAILED`` is a
+per-node dead end, and a broken libtpu build takes out the whole fleet
+one ``maxUnavailable`` batch at a time. This guard closes that hole:
+
+1. **Canary waves.** With ``CanaryRolloutSpec.enable`` the first
+   ``canaryCount`` nodes (a deterministic cohort derived from sorted
+   node names, so a restarted operator recomputes the identical set
+   from cluster state alone) upgrade first; every other node waits
+   until the whole cohort is ``upgrade-done`` on the new revision AND
+   ``bakeSeconds`` have elapsed since (the bake stamp is a DaemonSet
+   annotation — durable, crash-safe).
+2. **Verdicts & halt.** Per revision, the guard aggregates failure
+   verdicts: a node whose runtime pod carries the revision and is in
+   ``upgrade-failed`` (validation timeout, drain failure) or whose pod
+   is crash-looping past the restart threshold. At
+   ``failureThreshold`` verdicts the fleet HALTS — the revision hash is
+   written to the DaemonSet's quarantine annotation in ONE patch (the
+   durable halt commit), and the state manager stops admitting nodes
+   into the upgrade flow and stops restarting pods onto the hash.
+3. **Rollback.** With ``RollbackSpec.enable`` the guard re-pins the
+   previous ControllerRevision (``kubectl rollout undo`` semantics via
+   ``K8sClient.rollback_daemon_set``); the state manager then drives
+   every node stuck on the condemned hash through
+   ``rollback-required`` (pod delete → restart on the old revision →
+   revalidate → uncordon). The quarantine annotation OUTLIVES the
+   rollback: reconcile never re-attempts the hash, because a changed DS
+   spec produces a different hash.
+
+Everything durable lives on the DaemonSet (quarantine + bake stamps);
+the guard object itself only carries metrics accumulators, so a crash
+loses at most one histogram sample, never a safety decision.
+"""
+
+from __future__ import annotations
+
+import logging
+from dataclasses import dataclass, field
+from typing import TYPE_CHECKING, Optional
+
+from tpu_operator_libs.api.upgrade_policy import (
+    CanaryRolloutSpec,
+    RollbackSpec,
+    UpgradePolicySpec,
+    scaled_value_from_int_or_percent,
+)
+from tpu_operator_libs.consts import (
+    POD_CONTROLLER_REVISION_HASH_LABEL,
+    TRUE_STRING,
+    UpgradeKeys,
+    UpgradeState,
+)
+from tpu_operator_libs.k8s.client import (
+    ApiServerError,
+    ConflictError,
+    K8sClient,
+    NotFoundError,
+)
+from tpu_operator_libs.k8s.objects import DaemonSet
+from tpu_operator_libs.upgrade.pod_manager import RevisionHashError
+from tpu_operator_libs.util import Clock, Event, EventRecorder, log_event
+
+if TYPE_CHECKING:  # pragma: no cover - import cycle guard (state_manager
+    # imports this module; only type names flow the other way)
+    from tpu_operator_libs.upgrade.pod_manager import PodManager
+    from tpu_operator_libs.upgrade.state_manager import ClusterUpgradeState
+
+logger = logging.getLogger(__name__)
+
+
+@dataclass(frozen=True)
+class RolloutDecision:
+    """One pass's verdict, consumed by ``apply_state``.
+
+    ``halted`` freezes the fleet: no node newly enters
+    ``upgrade-required``, no admission to ``cordon-required``, and no
+    pod restart toward a hash in ``quarantined``. ``canary_active``
+    restricts admission to ``cohort`` (the canary wave). ``quarantined``
+    also drives the per-node rollback transitions — it persists after
+    the halt lifts, which is what keeps a condemned hash condemned.
+    """
+
+    canary_active: bool = False
+    cohort: frozenset[str] = frozenset()
+    halted: bool = False
+    #: Revision hashes condemned by annotation (whether or not they are
+    #: still the DS's newest — i.e. whether the halt is still in force).
+    quarantined: frozenset[str] = frozenset()
+    #: Quarantined hashes that are STILL the update revision: restarts
+    #: toward these must be suppressed (rollback pending or disabled).
+    quarantined_active: frozenset[str] = frozenset()
+    #: Failure verdicts counted for the newest revision this pass.
+    failure_verdicts: int = 0
+    #: Why admissions are gated, for status/debugging.
+    reason: str = ""
+
+
+@dataclass
+class _DsRollout:
+    """Per-DaemonSet working set for one assessment."""
+
+    ds: DaemonSet
+    newest: str
+    quarantined: Optional[str]
+    failures: "list[str]" = field(default_factory=list)
+
+
+class RolloutGuard:
+    """Fleet-level canary/halt/rollback brain, one per state manager."""
+
+    def __init__(self, client: K8sClient, keys: UpgradeKeys,
+                 recorder: Optional[EventRecorder] = None,
+                 clock: Optional[Clock] = None,
+                 pod_failure_threshold: int = 10) -> None:
+        self._client = client
+        self._keys = keys
+        self._recorder = recorder
+        self._clock = clock or Clock()
+        self._pod_failure_threshold = pod_failure_threshold
+        #: Lifetime failure verdicts observed, deduplicated per
+        #: (revision, node) — a crash-looping canary is one verdict, not
+        #: one per reconcile pass.
+        self.canary_failure_verdicts_total = 0
+        self._verdicts_seen: set[tuple[str, str]] = set()
+        #: Fleet halts committed (quarantine annotations written).
+        self.halts_total = 0
+        #: DaemonSet rollbacks (previous revision re-pins) issued.
+        self.rollbacks_started_total = 0
+        #: Halted revisions fully evacuated: the halt lifted and no pod
+        #: carries the hash any more.
+        self.rollbacks_completed_total = 0
+        #: Wall-clock (virtual) halt→evacuated durations, drained by
+        #: metrics.observe_rollout. In-memory: a crash loses the sample,
+        #: never the rollback itself.
+        self._rollback_durations: list[float] = []
+        self._halt_started_at: dict[str, float] = {}
+        self.last_decision = RolloutDecision()
+
+    def drain_rollback_durations(self) -> "list[float]":
+        out, self._rollback_durations = self._rollback_durations, []
+        return out
+
+    # ------------------------------------------------------------------
+    # assessment (runs first in every apply_state pass)
+    # ------------------------------------------------------------------
+    def assess(self, state: "ClusterUpgradeState",
+               policy: UpgradePolicySpec,
+               pod_manager: "PodManager") -> RolloutDecision:
+        """Evaluate verdicts, commit halts/rollbacks, return the pass
+        decision. ``pod_manager`` is passed per call (not captured at
+        construction) because ``with_pod_deletion_enabled`` rebuilds the
+        state manager's instance and the revision memo must be the
+        per-snapshot one."""
+        canary = policy.canary
+        if canary is None or not canary.enable:
+            self.last_decision = RolloutDecision()
+            return self.last_decision
+        rollback = policy.rollback or RollbackSpec()
+
+        rollouts = self._collect(state, pod_manager)
+        if not rollouts:
+            self.last_decision = RolloutDecision()
+            return self.last_decision
+
+        quarantined: set[str] = set()
+        quarantined_active: set[str] = set()
+        halted = False
+        failure_verdicts = 0
+        reason = ""
+        for ro in rollouts.values():
+            failure_verdicts += len(ro.failures)
+            for node_name in ro.failures:
+                if (ro.newest, node_name) not in self._verdicts_seen:
+                    self._verdicts_seen.add((ro.newest, node_name))
+                    self.canary_failure_verdicts_total += 1
+            if (ro.quarantined is None
+                    and len(ro.failures) >= canary.failure_threshold):
+                self._halt(ro)
+            if ro.quarantined is not None:
+                quarantined.add(ro.quarantined)
+                if ro.quarantined == ro.newest:
+                    # halt in force: the DS still points at the bad hash
+                    halted = True
+                    quarantined_active.add(ro.quarantined)
+                    reason = (f"halted: revision {ro.quarantined!r} "
+                              f"quarantined")
+                    if rollback.enable:
+                        self._rollback(ro, pod_manager)
+                else:
+                    self._maybe_complete(ro, state, pod_manager)
+
+        cohort: frozenset[str] = frozenset()
+        canary_active = False
+        if not halted:
+            cohort, canary_active = self._canary_wave(
+                state, canary, rollouts)
+            if canary_active:
+                reason = (f"canary wave: {len(cohort)} node(s) probing "
+                          f"the new revision")
+        self.last_decision = RolloutDecision(
+            canary_active=canary_active, cohort=cohort, halted=halted,
+            quarantined=frozenset(quarantined),
+            quarantined_active=frozenset(quarantined_active),
+            failure_verdicts=failure_verdicts, reason=reason)
+        return self.last_decision
+
+    # ------------------------------------------------------------------
+    # verdict collection
+    # ------------------------------------------------------------------
+    def _collect(self, state: "ClusterUpgradeState",
+                 pod_manager: "PodManager") -> "dict[str, _DsRollout]":
+        rollouts: dict[str, _DsRollout] = {}
+        quarantine_key = self._keys.quarantined_revision_annotation
+        for bucket_label, bucket in state.node_states.items():
+            for ns in bucket:
+                ds = ns.runtime_daemon_set
+                if ds is None:
+                    continue  # orphaned pods have no revision to judge
+                ro = rollouts.get(ds.metadata.uid)
+                if ro is None:
+                    try:
+                        newest = pod_manager.get_daemon_set_revision_hash(ds)
+                    except (RevisionHashError, ApiServerError,
+                            ConflictError) as exc:
+                        logger.warning(
+                            "rollout guard cannot resolve newest revision "
+                            "of %s; skipping this pass: %s",
+                            ds.metadata.name, exc)
+                        continue
+                    ro = _DsRollout(
+                        ds=ds, newest=newest,
+                        quarantined=ds.metadata.annotations.get(
+                            quarantine_key))
+                    rollouts[ds.metadata.uid] = ro
+                try:
+                    pod_hash = pod_manager.get_pod_revision_hash(
+                        ns.runtime_pod)
+                except RevisionHashError:
+                    continue
+                if pod_hash != ro.newest:
+                    continue
+                if bucket_label == str(UpgradeState.FAILED):
+                    # FAILED on the revision under test — the machine
+                    # already folded crash-loops and validation
+                    # timeouts into this state, so it is the one
+                    # verdict signal (in VALIDATION_REQUIRED, a
+                    # crash-looping pod is merely "not yet ready" until
+                    # its timeout fails the node)
+                    ro.failures.append(ns.node.metadata.name)
+                elif (bucket_label == str(UpgradeState.ROLLBACK_REQUIRED)
+                        and ns.runtime_pod.is_failing(
+                            self._pod_failure_threshold)):
+                    # a node already rolling back that STILL carries a
+                    # crash-looping pod of the newest revision keeps
+                    # its verdict standing (it was FAILED a pass ago)
+                    ro.failures.append(ns.node.metadata.name)
+        return rollouts
+
+    # ------------------------------------------------------------------
+    # halt / rollback commits
+    # ------------------------------------------------------------------
+    def _halt(self, ro: _DsRollout) -> None:
+        """Condemn ``ro.newest``: ONE annotation patch is the durable
+        halt commit (crash before it = re-derived next pass; crash after
+        = the halt holds)."""
+        ds = ro.ds
+        try:
+            fresh = self._client.patch_daemon_set_annotations(
+                ds.metadata.namespace, ds.metadata.name,
+                {self._keys.quarantined_revision_annotation: ro.newest})
+        except (ApiServerError, ConflictError, NotFoundError) as exc:
+            logger.warning("failed to commit fleet halt for %s revision "
+                           "%s; retrying next pass: %s",
+                           ds.metadata.name, ro.newest, exc)
+            return
+        ds.metadata.annotations = fresh.metadata.annotations
+        ro.quarantined = ro.newest
+        self.halts_total += 1
+        self._halt_started_at.setdefault(ro.newest, self._clock.now())
+        logger.warning(
+            "FLEET HALT: revision %s of DaemonSet %s/%s quarantined "
+            "(%d failure verdict(s) >= threshold)", ro.newest,
+            ds.metadata.namespace, ds.metadata.name, len(ro.failures))
+        log_event(self._recorder, ds, Event.WARNING,
+                  self._keys.event_reason,
+                  f"Fleet halted: revision {ro.newest} quarantined after "
+                  f"{len(ro.failures)} canary failure verdict(s) "
+                  f"({', '.join(sorted(ro.failures))})")
+
+    def _rollback(self, ro: _DsRollout,
+                  pod_manager: "PodManager") -> None:
+        """Re-pin the previous ControllerRevision. Idempotent: a crash
+        between halt and rollback re-attempts here next pass."""
+        ds = ro.ds
+        try:
+            previous = pod_manager.get_previous_daemon_set_revision_hash(ds)
+        except (ApiServerError, ConflictError) as exc:
+            logger.warning("cannot resolve previous revision of %s; "
+                           "retrying next pass: %s", ds.metadata.name, exc)
+            return
+        if previous is None:
+            logger.error(
+                "DaemonSet %s has no previous ControllerRevision to roll "
+                "back to; fleet stays halted for manual action",
+                ds.metadata.name)
+            return
+        try:
+            self._client.rollback_daemon_set(
+                ds.metadata.namespace, ds.metadata.name, previous)
+        except NotImplementedError:
+            logger.error(
+                "cluster backend cannot roll back DaemonSets; fleet "
+                "stays halted for manual action")
+            return
+        except (ApiServerError, ConflictError, NotFoundError) as exc:
+            logger.warning("failed to roll back %s to revision %s; "
+                           "retrying next pass: %s",
+                           ds.metadata.name, previous, exc)
+            return
+        # the revision ordering changed mid-snapshot: the per-snapshot
+        # memo would keep answering with the condemned hash for the rest
+        # of this pass, freezing the rollback transitions a full tick
+        pod_manager.reset_revision_cache()
+        self.rollbacks_started_total += 1
+        logger.warning(
+            "ROLLBACK: DaemonSet %s/%s re-pinned to previous revision %s "
+            "(quarantined: %s)", ds.metadata.namespace, ds.metadata.name,
+            previous, ro.quarantined)
+        log_event(self._recorder, ds, Event.NORMAL,
+                  self._keys.event_reason,
+                  f"Rolled DaemonSet back to previous revision {previous} "
+                  f"(revision {ro.quarantined} quarantined)")
+
+    def _maybe_complete(self, ro: _DsRollout,
+                        state: "ClusterUpgradeState",
+                        pod_manager: "PodManager") -> None:
+        """Close the books on a lifted halt: once no runtime pod carries
+        the condemned hash and no node is mid-rollback, record the
+        halt→evacuated duration."""
+        started = self._halt_started_at.get(ro.quarantined or "")
+        if started is None:
+            return
+        if state.bucket(UpgradeState.ROLLBACK_REQUIRED):
+            return
+        for bucket in state.node_states.values():
+            for ns in bucket:
+                try:
+                    if pod_manager.get_pod_revision_hash(
+                            ns.runtime_pod) == ro.quarantined:
+                        return
+                except RevisionHashError:
+                    continue
+        del self._halt_started_at[ro.quarantined or ""]
+        self.rollbacks_completed_total += 1
+        self._rollback_durations.append(self._clock.now() - started)
+        logger.info("rollback complete: no pod carries quarantined "
+                    "revision %s any more", ro.quarantined)
+
+    # ------------------------------------------------------------------
+    # canary wave
+    # ------------------------------------------------------------------
+    def canary_cohort(self, state: "ClusterUpgradeState",
+                      canary: CanaryRolloutSpec) -> frozenset[str]:
+        """The deterministic canary cohort: first ``canaryCount`` of the
+        managed node names in sorted order, skip-labeled nodes excluded
+        (they would park the canary phase forever). Pure in the
+        snapshot, so every operator incarnation derives the same set."""
+        eligible = sorted(
+            node.metadata.name for node in state.all_nodes()
+            if node.metadata.labels.get(self._keys.skip_label)
+            != TRUE_STRING)
+        if not eligible:
+            return frozenset()
+        count = max(1, scaled_value_from_int_or_percent(
+            canary.canary_count, len(eligible), round_up=True))
+        return frozenset(eligible[:count])
+
+    def _canary_wave(self, state: "ClusterUpgradeState",
+                     canary: CanaryRolloutSpec,
+                     rollouts: "dict[str, _DsRollout]",
+                     ) -> tuple[frozenset[str], bool]:
+        """(cohort, canary_active): active while the cohort has not yet
+        proven the newest revision (done + baked)."""
+        cohort = self.canary_cohort(state, canary)
+        if not cohort:
+            return cohort, False
+        # one runtime DS per managed namespace is the deployed shape;
+        # with several, the wave gates on ALL of them having baked
+        for ro in rollouts.values():
+            if not self._revision_baked(state, ro, cohort, canary):
+                return cohort, True
+        return cohort, False
+
+    def _revision_baked(self, state: "ClusterUpgradeState",
+                        ro: _DsRollout, cohort: frozenset[str],
+                        canary: CanaryRolloutSpec) -> bool:
+        """True once every cohort node is upgrade-done on ``ro.newest``
+        and the bake time has elapsed since the (durable) pass stamp."""
+        stamp_key = self._keys.canary_passed_annotation
+        stamp = ro.ds.metadata.annotations.get(stamp_key, "")
+        revision, _, passed_at = stamp.partition(":")
+        if revision == ro.newest and passed_at:
+            try:
+                return self._clock.now() >= (
+                    float(passed_at) + canary.bake_seconds)
+            except ValueError:
+                pass  # corrupt stamp: fall through and re-derive
+        done_on_newest: set[str] = set()
+        for ns in state.bucket(UpgradeState.DONE):
+            pod_hash = ns.runtime_pod.metadata.labels.get(
+                POD_CONTROLLER_REVISION_HASH_LABEL, "")
+            if pod_hash == ro.newest and ns.runtime_pod.is_ready():
+                done_on_newest.add(ns.node.metadata.name)
+        if not cohort <= done_on_newest:
+            return False
+        now = self._clock.now()
+        try:
+            fresh = self._client.patch_daemon_set_annotations(
+                ro.ds.metadata.namespace, ro.ds.metadata.name,
+                {stamp_key: f"{ro.newest}:{now:g}"})
+            ro.ds.metadata.annotations = fresh.metadata.annotations
+        except (ApiServerError, ConflictError, NotFoundError) as exc:
+            logger.warning("failed to stamp canary pass for %s; retrying "
+                           "next pass: %s", ro.ds.metadata.name, exc)
+            return False
+        logger.info(
+            "canary cohort %s passed on revision %s; baking %ds before "
+            "fleet waves", sorted(cohort), ro.newest, canary.bake_seconds)
+        log_event(self._recorder, ro.ds, Event.NORMAL,
+                  self._keys.event_reason,
+                  f"Canary cohort passed on revision {ro.newest}; baking "
+                  f"{canary.bake_seconds}s before fleet waves")
+        return canary.bake_seconds <= 0
+
+    def status(self) -> dict:
+        """CRD-embeddable rollout block for the last assessed pass."""
+        decision = self.last_decision
+        out: dict = {}
+        if decision.halted:
+            out["halted"] = True
+        if decision.quarantined:
+            out["quarantinedRevisions"] = sorted(decision.quarantined)
+        if decision.canary_active:
+            out["canaryWave"] = sorted(decision.cohort)
+        if decision.reason:
+            out["reason"] = decision.reason
+        return out

@@ -82,6 +82,11 @@ from tpu_operator_libs.upgrade.pod_manager import (
     PodDeletionFilter,
     PodManager,
     PodManagerConfig,
+    RevisionHashError,
+)
+from tpu_operator_libs.upgrade.rollout_guard import (
+    RolloutDecision,
+    RolloutGuard,
 )
 from tpu_operator_libs.upgrade.safe_load_manager import SafeRuntimeLoadManager
 from tpu_operator_libs.upgrade.state_provider import NodeUpgradeStateProvider
@@ -218,6 +223,15 @@ class ClusterUpgradeStateManager:
             client, self.provider, "", recorder, self.clock)
         self.safe_load_manager = safe_load_manager or SafeRuntimeLoadManager(
             self.provider)
+        # Canary/halt/rollback brain. Holds no durable state of its own
+        # (quarantine + bake stamps live as DaemonSet annotations), so
+        # rebuilding the manager after a crash loses nothing.
+        self.rollout_guard = RolloutGuard(
+            client, self.keys, recorder, self.clock,
+            pod_failure_threshold=POD_RESTART_FAILURE_THRESHOLD)
+        # The current pass's rollout decision (neutral outside
+        # apply_state and whenever canary gating is disabled).
+        self._rollout = RolloutDecision()
         # Explicit planner wins; otherwise policy.topology_mode selects
         # flat (reference parity) or slice-atomic planning per apply_state.
         self._explicit_planner = planner
@@ -530,6 +544,7 @@ class ClusterUpgradeStateManager:
         self.last_pass_deferrals = 0
         if policy is None or not policy.auto_upgrade:
             logger.info("auto upgrade is disabled, skipping")
+            self._rollout = RolloutDecision()
             # no planning happens while disabled: previously reported
             # deferrals would otherwise go permanently stale
             self._clear_multislice_deferrals()
@@ -542,6 +557,14 @@ class ClusterUpgradeStateManager:
 
         logger.info("node states: %s", {
             str(s) or "unknown": len(state.bucket(s)) for s in ALL_STATES})
+
+        # Rollout guard first: halt detection must land in the SAME pass
+        # as the verdicts that tripped it — admissions below consult the
+        # decision, so a halting fleet admits nothing this pass.
+        self._rollout = self.rollout_guard.assess(state, policy,
+                                                 self.pod_manager)
+        if self._rollout.quarantined:
+            self._admit_rollback_nodes(state, policy)
 
         total_nodes = self.get_total_managed_nodes(state)
         max_unavailable = total_nodes
@@ -558,9 +581,19 @@ class ClusterUpgradeStateManager:
 
         self.process_done_or_unknown_nodes(state, UpgradeState.UNKNOWN)
         self.process_done_or_unknown_nodes(state, UpgradeState.DONE)
+        planner = self._planner_for_policy(policy)
+        if self._rollout.halted:
+            # HALTED: spend zero slots — nodes already mid-flow keep
+            # converging (their pods predate the bad revision or are
+            # being rolled back), but nothing new is admitted.
+            upgrades_available = 0
+        elif self._rollout.canary_active:
+            from tpu_operator_libs.topology.planner import (
+                CanaryWavePlanner,
+            )
+            planner = CanaryWavePlanner(planner, self._rollout.cohort)
         self.process_upgrade_required_nodes(
-            state, upgrades_available,
-            planner=self._planner_for_policy(policy))
+            state, upgrades_available, planner=planner)
         self.process_cordon_required_nodes(state)
         self.process_wait_for_jobs_required_nodes(
             state, policy.wait_for_completion)
@@ -570,6 +603,7 @@ class ClusterUpgradeStateManager:
         self.process_drain_nodes(state, policy.drain)
         self.process_pod_restart_nodes(state)
         self.process_upgrade_failed_nodes(state)
+        self.process_rollback_required_nodes(state)
         self.process_validation_required_nodes(state)
         self.process_uncordon_required_nodes(state)
         # Gate-parked nodes that left every eviction-wanting state this
@@ -663,6 +697,15 @@ class ClusterUpgradeStateManager:
                     ns.node))
             if (not pod_synced and not orphaned) or waiting_safe_load \
                     or upgrade_requested:
+                if self._rollout.halted:
+                    # HALTED fleet: the out-of-sync target is the
+                    # quarantined revision — admitting the node would
+                    # feed it to the bad build. It stays idle until the
+                    # rollback (or a new DS spec) lifts the halt.
+                    logger.info(
+                        "fleet halted; node %s stays idle instead of "
+                        "entering the upgrade flow", ns.node.metadata.name)
+                    return
                 if self._skip_node_upgrade(ns.node):
                     # Honor the skip label HERE, not only at
                     # admission: a remediation-parked node is
@@ -905,6 +948,17 @@ class ClusterUpgradeStateManager:
         def triage(ns: NodeUpgradeState) -> Optional[Pod]:
             pod_synced, orphaned = self._pod_in_sync_with_ds(ns)
             if not pod_synced or orphaned:
+                if (not orphaned and self._rollout.quarantined_active
+                        and self.pod_manager.get_daemon_set_revision_hash(
+                            ns.runtime_daemon_set)
+                        in self._rollout.quarantined_active):
+                    # the DS still points at a quarantined revision
+                    # (rollback pending or disabled): restarting now
+                    # would mint another pod of the bad build
+                    logger.info(
+                        "holding pod restart on node %s: target revision "
+                        "is quarantined", ns.node.metadata.name)
+                    return None
                 # Only restart pods not already terminating
                 # (upgrade_state.go:775-781).
                 if ns.runtime_pod.metadata.deletion_timestamp is None:
@@ -996,6 +1050,100 @@ class ClusterUpgradeStateManager:
 
         self._map_bucket(state.bucket(UpgradeState.FAILED),
                          "failed-node recovery", recover)
+
+    # ------------------------------------------------------------------
+    # canary rollback (beyond-reference; see upgrade/rollout_guard.py)
+    # ------------------------------------------------------------------
+    def _admit_rollback_nodes(self, state: ClusterUpgradeState,
+                              policy: UpgradePolicySpec) -> None:
+        """Move nodes stuck on a QUARANTINED revision out of
+        failed/validation-required into rollback-required — the fleet
+        decided their revision is bad, so waiting for the pod to heal
+        (it never will) or validating it (it already lost) is pointless.
+        Runs right after the guard's assessment so the transition lands
+        in the same pass as the halt; the snapshot buckets are updated
+        in place so later processors never act on a stale membership."""
+        if policy.rollback is not None and not policy.rollback.enable:
+            return
+        bad = self._rollout.quarantined
+        for source in (UpgradeState.FAILED,
+                       UpgradeState.VALIDATION_REQUIRED):
+            bucket = state.node_states.get(str(source), [])
+            moved: list[NodeUpgradeState] = []
+            for ns in bucket:
+                if ns.is_orphaned():
+                    continue
+                try:
+                    pod_hash = self.pod_manager.get_pod_revision_hash(
+                        ns.runtime_pod)
+                except RevisionHashError:
+                    continue
+                if pod_hash not in bad:
+                    continue
+                with self._defer_node_on_transient(ns.node,
+                                                   "rollback admit"):
+                    if self.provider.change_node_upgrade_state(
+                            ns.node, UpgradeState.ROLLBACK_REQUIRED):
+                        logger.info(
+                            "node %s is on quarantined revision %s; "
+                            "rolling back", ns.node.metadata.name,
+                            pod_hash)
+                        moved.append(ns)
+            for ns in moved:
+                bucket.remove(ns)
+                state.node_states.setdefault(
+                    str(UpgradeState.ROLLBACK_REQUIRED), []).append(ns)
+
+    def process_rollback_required_nodes(
+            self, state: ClusterUpgradeState) -> None:
+        """Drive rolled-back nodes home: restart the condemned pod onto
+        the re-pinned previous revision, then revalidate and return the
+        node to service. The node stayed cordoned through its whole
+        failed upgrade, so no fresh drain is needed — its workloads were
+        already evicted on the way in."""
+        def triage(ns: NodeUpgradeState) -> Optional[Pod]:
+            if ns.is_orphaned():
+                return None  # no DS, nothing to re-pin against
+            ds_hash = self.pod_manager.get_daemon_set_revision_hash(
+                ns.runtime_daemon_set)
+            quarantined = ns.runtime_daemon_set.metadata.annotations.get(
+                self.keys.quarantined_revision_annotation)
+            pod_hash = self.pod_manager.get_pod_revision_hash(
+                ns.runtime_pod)
+            if pod_hash == quarantined:
+                if ds_hash == quarantined:
+                    # rollback has not re-pinned the DS yet (guard retry
+                    # in flight, or rollback disabled): deleting now
+                    # would just recreate the bad build
+                    return None
+                if ns.runtime_pod.metadata.deletion_timestamp is None:
+                    return ns.runtime_pod
+                return None
+            # pod is off the condemned hash: wait for sync+ready, then
+            # hand back through the standard validation/uncordon arc
+            if self._is_runtime_pod_in_sync(ns):
+                if not self._validation_enabled:
+                    self._update_node_to_uncordon_or_done(ns.node)
+                    return None
+                self.provider.change_node_upgrade_state(
+                    ns.node, UpgradeState.VALIDATION_REQUIRED)
+            elif ns.runtime_pod.is_failing(POD_RESTART_FAILURE_THRESHOLD):
+                logger.info("rollback pod failing on node %s with "
+                            "repeated restarts", ns.node.metadata.name)
+                self.provider.change_node_upgrade_state(
+                    ns.node, UpgradeState.FAILED)
+            return None
+
+        pods_to_restart = [
+            pod for pod in self._map_bucket(
+                state.bucket(UpgradeState.ROLLBACK_REQUIRED),
+                "rollback restart", triage)
+            if pod is not None]
+        deferred_pods = self.pod_manager.schedule_pods_restart(
+            pods_to_restart)
+        with self._deferral_lock:
+            self._transient_deferrals += deferred_pods
+            self.last_pass_deferrals += deferred_pods
 
     def process_validation_required_nodes(
             self, state: ClusterUpgradeState) -> None:
@@ -1194,6 +1342,11 @@ class ClusterUpgradeStateManager:
         # total stays in _transient_deferrals for metrics/debugging
         if self.last_pass_deferrals:
             status["transientDeferrals"] = self.last_pass_deferrals
+        rollout = self.rollout_guard.status()
+        if rollout:
+            # why the rollout is gated: canary wave in flight, or the
+            # fleet halted on a quarantined revision
+            status["rollout"] = rollout
         return status
 
     # ------------------------------------------------------------------

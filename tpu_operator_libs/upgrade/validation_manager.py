@@ -76,7 +76,7 @@ class ValidationManager:
             logger.warning("no validation pods found on node %s",
                            node.metadata.name)
             return False
-        self._handle_timeout(node)
+        self._handle_timeout(node, failure)
         return False
 
     def check(self, node: Node) -> bool:
@@ -110,10 +110,14 @@ class ValidationManager:
                 return "extra-validator"
         return None
 
-    def _handle_timeout(self, node: Node) -> None:
+    def _handle_timeout(self, node: Node,
+                        reason: str = "unknown") -> None:
         """Start or check the validation timer (validation_manager.go:
         139-175): first failure stamps the start time; expiry marks the node
-        upgrade-failed and clears the stamp."""
+        upgrade-failed and clears the stamp. ``reason`` is the concrete
+        gate failure ("pod-not-ready" / "extra-validator") carried into
+        the Kubernetes Event, so operators watching ``kubectl get
+        events`` see WHAT failed, not just that something did."""
         annotation = self._keys.validation_start_annotation
         now = int(self._clock.now())
         stamp = node.metadata.annotations.get(annotation)
@@ -138,10 +142,12 @@ class ValidationManager:
                 # cleanup — whatever state the node is really in owns
                 # the stamp's lifecycle now
                 return
-            logger.info("validation timeout exceeded on node %s",
-                        node.metadata.name)
+            logger.info("validation timeout exceeded on node %s (%s)",
+                        node.metadata.name, reason)
             log_event(self._recorder, node, Event.WARNING,
                       self._keys.event_reason,
-                      "Validation timed out; node marked upgrade-failed")
+                      f"Validation timed out after "
+                      f"{self._timeout_seconds}s ({reason}); node marked "
+                      f"upgrade-failed")
             self._provider.change_node_upgrade_annotation(
                 node, annotation, None)
