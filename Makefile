@@ -4,7 +4,7 @@ PYTHON ?= python3
 PYTEST_FLAGS ?= -q
 COV_THRESHOLD ?= 85
 
-.PHONY: all check test test-fast test-fault test-chaos test-soak test-scale test-rollout lint cov bench bench-reconcile graft-check package clean diagram
+.PHONY: all check test test-fast test-fault test-chaos test-soak test-scale test-rollout test-latency lint cov bench bench-reconcile bench-latency graft-check package clean diagram
 
 all: lint test
 
@@ -104,6 +104,18 @@ bench-reconcile:
 # smoke runs in `make test` too; this target adds the big fleets.
 test-scale:
 	$(PYTHON) -m pytest tests/ $(PYTEST_FLAGS) -m scale
+
+# Zero-idle scheduling: poll-paced vs event-driven wakeups (completion
+# nudges + deadline timer wheel + eager slot refill), 64/256/1024
+# nodes (tools/latency_bench.py; docs/benchmarks.md §2d).
+bench-latency:
+	$(PYTHON) tools/latency_bench.py
+
+# Event-driven scheduling regressions (`latency` marker): timer wheel,
+# nudge dedup, eager refill, and the 64-node bench smoke are tier-1;
+# the 256/1024-node makespan-ratio cells are also marked slow.
+test-latency:
+	$(PYTHON) -m pytest tests/ $(PYTEST_FLAGS) -m latency
 
 graft-check:
 	$(PYTHON) __graft_entry__.py

@@ -1,0 +1,365 @@
+#!/usr/bin/env python3
+"""Zero-idle upgrade-scheduling benchmark: poll-paced vs event-driven.
+
+Drives the REAL state machine over simulate.py fleets (64 / 256 / 1024
+nodes on the FakeCluster virtual clock) through a rolling upgrade whose
+per-node latency has three async stages with realistic durations —
+wait-for-jobs (long-running workload force-advanced at its 60 s policy
+timeout), runtime pod recreation/readiness (10 s + 30 s, jittered
+±25 %), and a post-upgrade health probe that passes 30 s after the new
+pod is first seen Ready — and compares two wakeup disciplines over the
+IDENTICAL manager configuration:
+
+- **poll** — the reconcile loop runs only on its resync tick (default
+  120 s, the conservative fleet resync cadence). Every async outcome
+  and every deadline expiry waits for the next tick: the reference
+  consumer's pacing.
+- **event** — the completion-driven layer is live: cluster events wake
+  the loop at the event instant, DrainManager/PodManager/Validation
+  nudges fire the moment an outcome lands, and the deadline timer
+  wheel (wait-for-jobs timeout, validation settle retries, canary
+  bake) wakes the pass at expiry, coalesced to 1 s slots. The same
+  resync tick remains as a pure safety net.
+
+Per fleet size the bench reports whole-upgrade makespan (virtual s),
+the per-transition idle-time distribution (outcome actionable → pass
+picked up), wakeup-source counters, in-flight slot saturation, and —
+the safety half of the claim — a full final-cluster-state fingerprint
+that must be bit-identical between the two cells (the layer changes
+WHEN passes run, never what they decide).
+
+Acceptance (ISSUE 5): ≥2× makespan reduction at 256 nodes.
+
+CLI: ``python tools/latency_bench.py [--nodes 64,256,1024]
+[--interval 120]`` prints one JSON document. ``make bench-latency``
+wraps it; bench.py embeds the same cells and writes BENCH_latency.json.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import statistics
+import sys
+from typing import Optional
+
+# direct `python tools/latency_bench.py` runs with tools/ on sys.path
+# but not the repo root; add it (same fix as the sweep tools)
+sys.path.insert(0, os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+
+from tpu_operator_libs.api.upgrade_policy import (  # noqa: E402
+    DrainSpec,
+    UpgradePolicySpec,
+    WaitForCompletionSpec,
+)
+from tpu_operator_libs.consts import (  # noqa: E402
+    POD_CONTROLLER_REVISION_HASH_LABEL,
+    UpgradeState,
+)
+from tpu_operator_libs.k8s.objects import (  # noqa: E402
+    ContainerStatus,
+    ObjectMeta,
+    Pod,
+    PodPhase,
+    PodSpec,
+    PodStatus,
+)
+from tpu_operator_libs.simulate import (  # noqa: E402
+    NS,
+    RUNTIME_LABELS,
+    WORKLOAD_NS,
+    FleetSpec,
+    build_fleet,
+)
+from tpu_operator_libs.upgrade.nudger import ReconcileNudger  # noqa: E402
+from tpu_operator_libs.upgrade.state_manager import (  # noqa: E402
+    BuildStateError,
+    ClusterUpgradeStateManager,
+)
+
+HOSTS_PER_SLICE = 4
+#: The poll cadence under comparison — a conservative operator resync
+#: interval; the event cell keeps it as a safety net only.
+RESYNC_INTERVAL = 120.0
+#: Policy timeout forcing wait-for-jobs past the never-finishing
+#: workload — a pure deadline, exercised through the timer wheel.
+WAIT_FOR_JOBS_TIMEOUT = 60
+#: The extra validator passes this long after it first sees the node's
+#: new runtime pod Ready (modeling an ICI-probe settle window).
+VALIDATION_SETTLE = 30.0
+#: Event-cell retry cadence for the failing validator (timer wheel).
+VALIDATION_RETRY = 5.0
+POD_RECREATE_DELAY = 10.0
+POD_READY_DELAY = 30.0
+DELAY_JITTER = 0.25
+#: Cluster events landing within this window of a wakeup are absorbed
+#: into the same reconcile. Models the real stack's workqueue
+#: coalescing: events arriving while a pass is in flight mark the key
+#: dirty and fold into ONE follow-up reconcile, so a jittered wave's
+#: per-node readiness instants never cost one pass each.
+EVENT_BATCH_WINDOW = 1.0
+BLOCKER_LABELS = {"bench-role": "blocker"}
+
+
+def _percentile(samples: "list[float]", pct: int) -> Optional[float]:
+    if not samples:
+        return None
+    ordered = sorted(samples)
+    index = max(0, -(-len(ordered) * pct // 100) - 1)
+    return ordered[index]
+
+
+def _add_blocker_pods(cluster) -> None:
+    """One long-running workload pod per node: it never completes, so
+    every node's wait-for-jobs stage ends at the policy timeout — the
+    deadline the timer wheel turns from poll-quantized into precise."""
+    for node in cluster.list_nodes():
+        name = node.metadata.name
+        cluster.add_pod(Pod(
+            metadata=ObjectMeta(name=f"blocker-{name}",
+                                namespace=WORKLOAD_NS,
+                                labels=dict(BLOCKER_LABELS)),
+            spec=PodSpec(node_name=name),
+            status=PodStatus(
+                phase=PodPhase.RUNNING,
+                container_statuses=[
+                    ContainerStatus(name="worker", ready=True)])))
+
+
+class _SettleValidator:
+    """Extra validator: healthy ``settle`` seconds after it FIRST sees
+    the node's current runtime pod Ready. The pass becoming observable
+    emits no cluster event — exactly the probe shape the timer-wheel
+    retry exists for."""
+
+    def __init__(self, cluster, clock, settle: float) -> None:
+        self._cluster = cluster
+        self._clock = clock
+        self._settle = settle
+        self._first_ready: dict[tuple[str, str], float] = {}
+
+    def __call__(self, node) -> bool:
+        name = node.metadata.name
+        # indexed pods-on-node lookup (the fake serves spec.nodeName
+        # field selectors from an index, like the apiserver) — a full
+        # namespace LIST per node per pass would be O(fleet²)
+        pods = self._cluster.list_pods(
+            namespace=NS, field_selector=f"spec.nodeName={name}")
+        pod = pods[0] if pods else None
+        if pod is None or not pod.is_ready():
+            return False
+        key = (name, pod.metadata.uid)
+        first = self._first_ready.setdefault(key, self._clock.now())
+        return self._clock.now() - first >= self._settle
+
+
+def _final_fingerprint(cluster, keys) -> tuple:
+    """Every durable bit of cluster state the upgrade can touch. The
+    two cells must produce IDENTICAL fingerprints: the scheduling layer
+    may only change when passes run, never what they commit."""
+    nodes = tuple(sorted(
+        (n.metadata.name,
+         tuple(sorted(n.metadata.labels.items())),
+         tuple(sorted(n.metadata.annotations.items())),
+         n.is_unschedulable(), n.is_ready())
+        for n in cluster.list_nodes()))
+    # Pods are keyed by node, not by name: a recreated DS pod's name
+    # carries a controller-generated suffix (the fake mints them from a
+    # global counter, like the apiserver's random suffix), so the name
+    # encodes how many recreations the WHOLE run performed — identity
+    # noise, not cluster state. Everything semantic about the pod
+    # (placement, revision, phase, readiness) is covered.
+    pods = tuple(sorted(
+        (p.metadata.namespace, p.spec.node_name,
+         p.metadata.labels.get(POD_CONTROLLER_REVISION_HASH_LABEL, ""),
+         str(p.status.phase), p.is_ready())
+        for p in cluster.list_pods(namespace=NS)))
+    return (nodes, pods)
+
+
+def run_latency_cell(n_nodes: int, event_driven: bool,
+                     interval: float = RESYNC_INTERVAL,
+                     max_sim_seconds: float = 12 * 3600.0) -> dict:
+    """One full rolling upgrade under one wakeup discipline."""
+    if n_nodes % HOSTS_PER_SLICE:
+        raise ValueError(f"n_nodes must be a multiple of {HOSTS_PER_SLICE}")
+    fleet = FleetSpec(n_slices=n_nodes // HOSTS_PER_SLICE,
+                      hosts_per_slice=HOSTS_PER_SLICE,
+                      pod_recreate_delay=POD_RECREATE_DELAY,
+                      pod_ready_delay=POD_READY_DELAY,
+                      delay_jitter=DELAY_JITTER)
+    cluster, clock, keys = build_fleet(fleet)
+    _add_blocker_pods(cluster)
+    # Both cells carry the nudger so the MANAGER code paths are
+    # identical (registrations, counters, eager refill); only the
+    # driver below differs in whether it listens to them.
+    nudger = ReconcileNudger(clock=clock, resolution=1.0)
+    mgr = ClusterUpgradeStateManager(
+        cluster, keys, clock=clock, async_workers=False,
+        poll_interval=0.0, nudger=nudger)
+    mgr.with_validation_enabled(
+        "", extra_validator=_SettleValidator(cluster, clock,
+                                             VALIDATION_SETTLE))
+    mgr.validation_manager.retry_seconds = VALIDATION_RETRY
+    policy = UpgradePolicySpec(
+        auto_upgrade=True, max_parallel_upgrades=0,
+        max_unavailable="25%", topology_mode="flat",
+        wait_for_completion=WaitForCompletionSpec(
+            pod_selector="bench-role=blocker",
+            timeout_seconds=WAIT_FOR_JOBS_TIMEOUT),
+        drain=DrainSpec(enable=True, force=True, timeout_seconds=300))
+
+    wakeups = {"resync": 0, "event": 0, "timer": 0}
+    idle_samples: list[float] = []
+    pending_outcomes: list[float] = []
+    saturation_weighted = 0.0
+    saturated_span = 0.0
+    reconciles = 0
+    done = str(UpgradeState.DONE)
+
+    def reconcile(source: str) -> bool:
+        nonlocal reconciles
+        wakeups[source] += 1
+        reconciles += 1
+        now = clock.now()
+        idle_samples.extend(now - t for t in pending_outcomes)
+        pending_outcomes.clear()
+        try:
+            mgr.reconcile(NS, RUNTIME_LABELS, policy)
+        except BuildStateError:
+            pass  # incomplete snapshot; the next wakeup retries
+        # inline workers committed during the chained call — their
+        # nudges are already satisfied by the chain itself
+        nudger.consume_pending()
+        nudger.pop_due(clock.now())
+        return all(
+            n.metadata.labels.get(keys.state_label, "") == done
+            for n in cluster.list_nodes())
+
+    def weigh_saturation(span: float) -> None:
+        nonlocal saturation_weighted, saturated_span
+        if mgr.last_pass_slots is not None and span > 0:
+            saturation_weighted += \
+                mgr.last_pass_slots["saturation"] * span
+            saturated_span += span
+
+    converged = reconcile("resync")  # initial sync
+    next_resync = clock.now() + interval
+    while not converged and clock.now() < max_sim_seconds:
+        now = clock.now()
+        wake = next_resync
+        source = "resync"
+        if event_driven:
+            due = cluster.next_action_due()
+            if due is not None and max(due, now) < wake:
+                wake, source = max(due, now), "event"
+            deadline = nudger.next_deadline()
+            if deadline is not None and max(deadline, now) < wake:
+                wake, source = max(deadline, now), "timer"
+        weigh_saturation(wake - now)
+        clock.advance(wake - now)
+        now = clock.now()
+        # fire cluster actions due at (or before) this instant; each
+        # firing batch is an actionable outcome timestamped now
+        if cluster.step():
+            pending_outcomes.append(now)
+        if event_driven:
+            # workqueue-coalescing model: events due within the batch
+            # window ride the same wakeup (timestamped at their own
+            # instants for the idle accounting)
+            while True:
+                due = cluster.next_action_due()
+                if due is None or due > wake + EVENT_BATCH_WINDOW:
+                    break
+                clock.advance(max(0.0, due - clock.now()))
+                if cluster.step():
+                    pending_outcomes.append(clock.now())
+            now = clock.now()
+        for slot in nudger.pop_due(now):
+            pending_outcomes.append(slot)
+            if source == "resync" and event_driven:
+                source = "timer"
+        if not event_driven and not pending_outcomes:
+            # poll cell still measures deadline/event idle against the
+            # tick that finally picks the outcome up — an empty tick
+            # contributes no sample
+            pass
+        if now >= next_resync:
+            next_resync = now + interval
+        converged = reconcile(source)
+
+    makespan = clock.now()
+    counts = nudger.counts_snapshot()
+    return {
+        "converged": converged,
+        "makespan_s": round(makespan, 1),
+        "reconciles": reconciles,
+        "wakeups": dict(wakeups),
+        "nudge_sources": counts,
+        "deadlines_registered": nudger.wheel.registered_total,
+        "deadlines_coalesced": nudger.wheel.coalesced_total,
+        "eager_refills": mgr.eager_refills_total,
+        "eager_refill_admissions": mgr.eager_refill_admissions_total,
+        "idle_p50_s": (round(statistics.median(idle_samples), 2)
+                       if idle_samples else None),
+        "idle_p95_s": round(_percentile(idle_samples, 95), 2)
+        if idle_samples else None,
+        "idle_mean_s": (round(statistics.fmean(idle_samples), 2)
+                        if idle_samples else None),
+        "idle_samples": len(idle_samples),
+        "slot_saturation_pct": round(
+            100.0 * saturation_weighted / saturated_span, 2)
+        if saturated_span else None,
+        "_fingerprint": _final_fingerprint(cluster, keys),
+    }
+
+
+def run_latency_bench(sizes: "tuple[int, ...]" = (64, 256, 1024),
+                      interval: float = RESYNC_INTERVAL) -> dict:
+    """The poll-paced vs event-driven comparison across fleet sizes."""
+    out: dict = {
+        "resync_interval_s": interval,
+        "wait_for_jobs_timeout_s": WAIT_FOR_JOBS_TIMEOUT,
+        "validation_settle_s": VALIDATION_SETTLE,
+        "pod_recreate_delay_s": POD_RECREATE_DELAY,
+        "pod_ready_delay_s": POD_READY_DELAY,
+        "delay_jitter": DELAY_JITTER,
+    }
+    for n_nodes in sizes:
+        poll = run_latency_cell(n_nodes, event_driven=False,
+                                interval=interval)
+        event = run_latency_cell(n_nodes, event_driven=True,
+                                 interval=interval)
+        identical = poll.pop("_fingerprint") == event.pop("_fingerprint")
+        ratio = (round(poll["makespan_s"] / event["makespan_s"], 2)
+                 if event["makespan_s"] else None)
+        out[f"{n_nodes}_nodes"] = {
+            "poll": poll,
+            "event": event,
+            # the acceptance metric: whole-upgrade makespan ratio
+            "makespan_ratio": ratio,
+            "meets_2x_makespan": bool(ratio and ratio >= 2.0),
+            "final_state_identical": identical,
+        }
+    return out
+
+
+def main(argv: "list[str]") -> int:
+    sizes = (64, 256, 1024)
+    interval = RESYNC_INTERVAL
+    for i, arg in enumerate(argv):
+        if arg == "--nodes" and i + 1 < len(argv):
+            sizes = tuple(int(s) for s in argv[i + 1].split(","))
+        elif arg.startswith("--nodes="):
+            sizes = tuple(int(s) for s in arg.split("=", 1)[1].split(","))
+        elif arg == "--interval" and i + 1 < len(argv):
+            interval = float(argv[i + 1])
+        elif arg.startswith("--interval="):
+            interval = float(arg.split("=", 1)[1])
+    print(json.dumps(run_latency_bench(sizes, interval), indent=2))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main(sys.argv[1:]))

@@ -197,19 +197,33 @@ class _OperatorIncarnation:
                  keys: UpgradeKeys, rem_keys: RemediationKeys,
                  config: ChaosConfig, injector: ChaosInjector,
                  identity: str) -> None:
+        # The event-driven scheduling layer runs INSIDE the gate: both
+        # machines carry a live ReconcileNudger (completion nudges +
+        # deadline timer wheel + eager slot refill all active), exactly
+        # like the packaged operator. The tick-driven soak loop owns
+        # the clock, so it consumes the nudger's due slots each tick —
+        # the wakeups add no new reconcile instants to the seeded
+        # replay, but every seam executes under chaos. Like the rest of
+        # an incarnation, the nudger dies with the process: deadlines
+        # must be re-derivable from durable stamps alone.
+        from tpu_operator_libs.upgrade.nudger import ReconcileNudger
+
+        self.nudger = ReconcileNudger(clock=clock)
         provider = CrashingStateProvider(
             cluster, keys, None, clock, sync_timeout=5.0,
             poll_interval=1.0, fuse=injector.fuse)
         self.upgrade = ClusterUpgradeStateManager(
             cluster, keys, clock=clock, async_workers=False,
             provider=provider, poll_interval=1.0, sync_timeout=5.0,
-            parallel_workers=config.parallel_workers)
+            parallel_workers=config.parallel_workers,
+            nudger=self.nudger)
         rem_provider = CrashingStateProvider(
             cluster, rem_keys, None, clock,  # type: ignore[arg-type]
             sync_timeout=5.0, poll_interval=1.0, fuse=injector.fuse)
         self.remediation = NodeRemediationManager(
             cluster, rem_keys, upgrade_keys=keys, clock=clock,
-            provider=rem_provider, poll_interval=1.0, sync_timeout=5.0)
+            provider=rem_provider, poll_interval=1.0, sync_timeout=5.0,
+            nudger=self.nudger)
         self.elector = LeaderElector(
             cluster,
             LeaderElectionConfig(
@@ -318,6 +332,11 @@ def run_chaos_soak(seed: int,
             op.elector.try_acquire_or_renew()
         if op.elector.is_leader:
             injector.arm_due_crashes(now)
+            # tick-driven loop owns the clock: drain the nudger's due
+            # deadline slots and pending completion flag so the wheel
+            # stays bounded (the tick itself is the wakeup here)
+            op.nudger.pop_due(now)
+            op.nudger.consume_pending()
             try:
                 op.remediation.reconcile(NS, dict(RUNTIME_LABELS),
                                          remediation_policy)
@@ -525,6 +544,11 @@ def run_bad_revision_soak(seed: int,
             op.elector.try_acquire_or_renew()
         if op.elector.is_leader:
             injector.arm_due_crashes(now)
+            # tick-driven loop owns the clock: drain the nudger's due
+            # deadline slots and pending completion flag so the wheel
+            # stays bounded (the tick itself is the wakeup here)
+            op.nudger.pop_due(now)
+            op.nudger.consume_pending()
             try:
                 op.remediation.reconcile(NS, dict(RUNTIME_LABELS),
                                          remediation_policy)

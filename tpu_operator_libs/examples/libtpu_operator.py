@@ -499,10 +499,19 @@ def main() -> int:
 
             runtime_labels = parse_runtime_labels(args)
             held = {}
+            # Completion-driven wakeups: drain/eviction workers and the
+            # deadline timer wheel (validation / wait-for-jobs / canary
+            # bake expiries) enqueue a reconcile the moment an outcome
+            # lands — the resync interval remains only as a safety net.
+            from tpu_operator_libs.upgrade.nudger import ReconcileNudger
+
+            nudger = ReconcileNudger()
 
             def reconcile(_key):
                 if "mgr" not in held:
-                    held["mgr"] = build_manager(args, op_mgr.client)
+                    held["mgr"] = build_manager(
+                        args, op_mgr.client).with_nudger(nudger)
+                nudger.pop_due()  # consume deadline slots this pass acts on
                 reconcile_once(held["mgr"], args, policy, registry,
                                runtime_labels)
                 if held["mgr"].last_pass_deferrals:
@@ -519,7 +528,8 @@ def main() -> int:
                 name=f"{args.driver}-operator",
                 use_cache=not args.no_cache,
                 resync_period=args.interval,
-                leader_election=election, metrics=registry)
+                leader_election=election, metrics=registry,
+                nudger=nudger)
             try:
                 op_mgr.run(stop)
             except TimeoutError as exc:

@@ -28,6 +28,7 @@ import threading
 from typing import TYPE_CHECKING, Callable, Optional
 
 from tpu_operator_libs.controller import (
+    CLUSTER_KEY,
     Controller,
     ExponentialBackoffRateLimiter,
     ReconcileResult,
@@ -39,6 +40,7 @@ if TYPE_CHECKING:  # pragma: no cover - types only
         LeaderElectionConfig,
     )
     from tpu_operator_libs.metrics import MetricsRegistry
+    from tpu_operator_libs.upgrade.nudger import ReconcileNudger
     from tpu_operator_libs.util import Clock
 
 logger = logging.getLogger(__name__)
@@ -85,6 +87,7 @@ class OperatorManager:
                  metrics: Optional["MetricsRegistry"] = None,
                  rate_limiter: Optional[ExponentialBackoffRateLimiter] = None,
                  gc_freeze_after_sync: bool = False,
+                 nudger: Optional["ReconcileNudger"] = None,
                  ) -> None:
         self._raw_client = client
         self._namespace = namespace
@@ -99,6 +102,13 @@ class OperatorManager:
         self._metrics = metrics
         self._rate_limiter = rate_limiter
         self._gc_freeze_after_sync = gc_freeze_after_sync
+        # Completion-wakeup seam: bound to the controller at start()
+        # (nudge → enqueue now; deadline slots → WorkQueue.add_after),
+        # unbound at stop(). Build one ReconcileNudger, hand it to the
+        # state managers via with_nudger, and pass it here — async
+        # outcomes then reconcile the moment they land instead of on
+        # the resync poll.
+        self.nudger = nudger
 
         self._cached = None
         self._controller: Optional[Controller] = None
@@ -218,6 +228,11 @@ class OperatorManager:
                 # long cache-sync loop above, not this.
                 controller.start(workers=self._workers)
                 self._started.set()
+            if self.nudger is not None:
+                self.nudger.bind(
+                    wake=controller.enqueue,
+                    schedule=lambda d: controller.queue.add_after(
+                        CLUSTER_KEY, d))
             logger.info("%s: started (cache=%s)", self._name,
                         self._use_cache)
         except BaseException:
@@ -230,6 +245,8 @@ class OperatorManager:
 
     def stop(self, timeout: float = 10.0) -> None:
         self._stop_requested.set()
+        if self.nudger is not None:
+            self.nudger.unbind()
         with self._lock:
             controller, cached = self._controller, self._cached
             self._controller = None

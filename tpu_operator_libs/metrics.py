@@ -303,6 +303,89 @@ def observe_reconcile(registry: MetricsRegistry,
             "Writes forwarded to the apiserver", labels)
 
 
+#: Buckets for per-transition idle time (outcome committed → pass
+#: picked up): event-driven wakeups land sub-second, poll-paced ones
+#: ride the resync interval — the histogram must resolve both regimes.
+IDLE_SECONDS_BUCKETS = (0.1, 0.5, 1.0, 2.0, 5.0, 10.0, 20.0, 30.0,
+                        60.0, 120.0, 300.0, 600.0)
+
+
+def observe_latency(registry: MetricsRegistry,
+                    manager: "ClusterUpgradeStateManager",
+                    nudger: Optional[object] = None,
+                    idle_seconds: "Iterable[float]" = (),
+                    resync_wakeups_total: Optional[int] = None,
+                    driver: str = "libtpu") -> None:
+    """Export the event-driven scheduling layer's evidence.
+
+    Three families (the zero-idle upgrade-scheduling trio):
+
+    - ``transition_idle_seconds`` — histogram of outcome-committed →
+      pass-picked-up latency; ``idle_seconds`` carries the samples the
+      caller measured since its last scrape (the latency bench and the
+      packaged operator both feed event timestamps vs reconcile-start).
+    - wakeup-source counters — ``scheduling_wakeups_total`` labeled by
+      source (``drain``, ``eviction``, ``validation-timeout``,
+      ``canary-bake``, …) from the nudger, plus the resync safety-net
+      count when the caller tracks it, and the timer wheel's
+      registered/coalesced totals (coalescing staying high is the
+      wheel doing its job).
+    - saturation — ``upgrade_slots_in_progress`` / ``_budget`` /
+      ``_saturation_ratio`` gauges from the manager's last pass, plus
+      the eager-refill counters: a saturation that dips between waves
+      is exactly the idle the refill eliminates.
+    """
+    labels = {"driver": driver}
+    for sample in idle_seconds:
+        registry.observe_histogram(
+            "transition_idle_seconds", sample,
+            "Async outcome committed to reconcile pass pickup (seconds)",
+            labels, buckets=IDLE_SECONDS_BUCKETS)
+    if nudger is not None:
+        for source, count in nudger.counts_snapshot().items():
+            registry.set_counter_total(
+                "scheduling_wakeups_total", count,
+                "Wakeup requests by source (completion nudges + timer "
+                "deadlines)", {**labels, "source": source})
+        wheel = getattr(nudger, "wheel", None)
+        if wheel is not None:
+            registry.set_counter_total(
+                "scheduling_deadlines_registered_total",
+                wheel.registered_total,
+                "Deadline slots scheduled on the timer wheel", labels)
+            registry.set_counter_total(
+                "scheduling_deadlines_coalesced_total",
+                wheel.coalesced_total,
+                "Deadlines absorbed into an already-scheduled slot",
+                labels)
+    if resync_wakeups_total is not None:
+        registry.set_counter_total(
+            "scheduling_wakeups_total", resync_wakeups_total,
+            "Wakeup requests by source (completion nudges + timer "
+            "deadlines)", {**labels, "source": "resync"})
+    slots = getattr(manager, "last_pass_slots", None)
+    if slots is not None:
+        registry.set_gauge(
+            "upgrade_slots_in_progress", slots["inProgress"],
+            "Nodes holding an in-flight upgrade slot at the last pass",
+            labels)
+        registry.set_gauge(
+            "upgrade_slots_budget", slots["budget"],
+            "Slot budget (min of maxUnavailable and maxParallel)",
+            labels)
+        registry.set_gauge(
+            "upgrade_slots_saturation_ratio", slots["saturation"],
+            "In-flight slots over budget at the last pass", labels)
+    registry.set_counter_total(
+        "upgrade_eager_refills_total", manager.eager_refills_total,
+        "apply_state passes that ran a second admission round on "
+        "slots freed in-pass", labels)
+    registry.set_counter_total(
+        "upgrade_eager_refill_admissions_total",
+        manager.eager_refill_admissions_total,
+        "Nodes admitted by eager refill rounds", labels)
+
+
 #: Buckets for canary-halt→evacuated durations: a rollback rides pod
 #: restart + revalidation timescales across the touched cohort.
 ROLLBACK_SECONDS_BUCKETS = (30.0, 60.0, 120.0, 300.0, 600.0, 1200.0,

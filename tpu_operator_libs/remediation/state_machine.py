@@ -46,7 +46,7 @@ from __future__ import annotations
 import contextlib
 import logging
 from dataclasses import dataclass, field
-from typing import Iterator, Optional, Protocol
+from typing import TYPE_CHECKING, Iterator, Optional, Protocol
 
 from tpu_operator_libs.api.remediation_policy import RemediationPolicySpec
 from tpu_operator_libs.api.upgrade_policy import (
@@ -81,6 +81,9 @@ from tpu_operator_libs.upgrade.state_provider import (
 )
 from tpu_operator_libs.upgrade.validation_manager import NodeValidator
 from tpu_operator_libs.util import Clock, Event, EventRecorder, log_event
+
+if TYPE_CHECKING:
+    from tpu_operator_libs.upgrade.nudger import ReconcileNudger
 
 logger = logging.getLogger(__name__)
 
@@ -172,8 +175,15 @@ class NodeRemediationManager:
                  clock: Optional[Clock] = None,
                  provider: Optional[NodeUpgradeStateProvider] = None,
                  sync_timeout: float = 10.0,
-                 poll_interval: float = 1.0) -> None:
+                 poll_interval: float = 1.0,
+                 nudger: Optional["ReconcileNudger"] = None) -> None:
         self.keys = keys or RemediationKeys()
+        # Completion-wakeup seam, shared with the upgrade machine (both
+        # feed the same controller key): every durable deadline this
+        # machine stamps — wedge-grace debounce, action timeouts, the
+        # revalidation settle window — registers a precise wakeup so
+        # expiry is acted on at expiry, not at the next resync.
+        self.nudger = nudger
         self.client = client
         # With upgrade keys, the two machines actively coordinate:
         # detection defers to in-progress upgrades, and remediated
@@ -329,6 +339,11 @@ class NodeRemediationManager:
                 else:
                     since = float(since_raw)
                 if now - since < signal.grace_seconds:
+                    if self.nudger is not None:
+                        # confirm the wedge at grace expiry, not at
+                        # whenever the next pass happens to run
+                        self.nudger.nudge_at(
+                            since + signal.grace_seconds, "wedge-grace")
                     continue
                 self.provider.change_node_upgrade_annotation(
                     node, self.keys.wedge_reason_annotation, signal.reason)
@@ -608,8 +623,15 @@ class NodeRemediationManager:
                     self.provider.change_node_upgrade_annotation(
                         node, self.keys.settle_start_annotation,
                         str(int(now)))
+                    if self.nudger is not None:
+                        self.nudger.nudge_at(now + policy.settle_seconds,
+                                             "remediation-settle")
                     continue
                 if now - float(settle_raw) < policy.settle_seconds:
+                    if self.nudger is not None:
+                        self.nudger.nudge_at(
+                            float(settle_raw) + policy.settle_seconds,
+                            "remediation-settle")
                     continue
                 if not self._validator_passes(node):
                     self._maybe_action_timeout(
@@ -734,6 +756,11 @@ class NodeRemediationManager:
         limit = timeout if timeout is not None \
             else policy.action_timeout_seconds
         if now - float(started_raw) <= limit:
+            if self.nudger is not None:
+                # write the attempt off exactly at its deadline instead
+                # of discovering the expiry a resync later
+                self.nudger.nudge_at(float(started_raw) + limit,
+                                     "remediation-timeout")
             return
         self._fail_attempt(node, f"{action} timed out after {limit:g}s",
                            extra_annotations=extra_annotations)

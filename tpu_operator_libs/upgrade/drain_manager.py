@@ -1,17 +1,23 @@
 """DrainManager: async node drain (reference drain_manager.go:32-155).
 
-One worker per node, deduplicated by an in-flight set; the worker cordons,
-drains, then commits the outcome as the node's next state label
-(pod-restart-required on success, upgrade-failed on any failure). The state
-write is the only side channel back to the state machine — the reconcile
-loop discovers the result on its next pass.
+Workers run on a :class:`~tpu_operator_libs.upgrade.worker_pool.
+BoundedKeyedPool` keyed by node name — per-node dedup (a node already
+being drained is never scheduled twice) with a bounded thread count,
+replacing the reference's unbounded one-goroutine-per-node fan-out. The
+worker cordons, drains, then commits the outcome as the node's next
+state label (pod-restart-required on success, upgrade-failed on any
+failure). The state write is the durable side channel back to the state
+machine; with a :class:`~tpu_operator_libs.upgrade.nudger.
+ReconcileNudger` installed the commit also wakes the reconcile loop
+immediately, and a transient-error deferral registers a backoff wakeup
+instead of silently waiting out the resync interval.
 """
 
 from __future__ import annotations
 
 import logging
 from dataclasses import dataclass, field
-from typing import Optional
+from typing import TYPE_CHECKING, Optional
 
 from tpu_operator_libs.api.upgrade_policy import DrainSpec
 from tpu_operator_libs.consts import UpgradeState
@@ -24,16 +30,31 @@ from tpu_operator_libs.k8s.drain import DrainHelper, run_cordon_or_uncordon
 from tpu_operator_libs.k8s.objects import Node
 from tpu_operator_libs.upgrade.gate import EvictionGate
 from tpu_operator_libs.upgrade.state_provider import NodeUpgradeStateProvider
+from tpu_operator_libs.upgrade.worker_pool import BoundedKeyedPool
 from tpu_operator_libs.util import (
     Clock,
     Event,
     EventRecorder,
-    NameSet,
     Worker,
     log_event,
 )
 
+if TYPE_CHECKING:
+    from tpu_operator_libs.upgrade.nudger import ReconcileNudger
+
 logger = logging.getLogger(__name__)
+
+#: Thread bound for the drain worker pool. A drain is dominated by
+#: eviction round-trips and grace-period waits, so a small pool keeps a
+#: maxUnavailable-sized wave pipelined without one thread per node.
+DEFAULT_DRAIN_WORKERS = 8
+
+#: Backoff base/cap for transient-error drain retries (seconds). The
+#: schedule is deliberately jitter-free: retries feed the nudger's
+#: timer wheel, which coalesces same-slot wakeups anyway, and a
+#: deterministic schedule keeps the seeded harnesses replayable.
+DRAIN_RETRY_BASE = 2.0
+DRAIN_RETRY_MAX = 60.0
 
 
 @dataclass
@@ -50,13 +71,27 @@ class DrainManager:
                  recorder: Optional[EventRecorder] = None,
                  clock: Optional[Clock] = None,
                  worker: Optional[Worker] = None,
-                 eviction_gate: Optional[EvictionGate] = None) -> None:
+                 eviction_gate: Optional[EvictionGate] = None,
+                 pool: Optional[BoundedKeyedPool] = None,
+                 nudger: Optional["ReconcileNudger"] = None,
+                 max_workers: int = DEFAULT_DRAIN_WORKERS) -> None:
         self._client = client
         self._provider = provider
         self._recorder = recorder
         self._clock = clock or Clock()
-        self._worker = worker or Worker()
-        self._draining_nodes = NameSet()
+        # `worker` is kept as the async-mode seam callers already use
+        # (Worker(async_mode=False) = deterministic inline drains); the
+        # execution substrate is the keyed pool either way.
+        if pool is None:
+            async_mode = worker.async_mode if worker is not None else True
+            pool = BoundedKeyedPool(max_workers=max_workers,
+                                    async_mode=async_mode,
+                                    name="drain-pool")
+        self._pool = pool
+        self.nudger = nudger
+        # per-node retry count for the transient-deferral backoff
+        # wakeups; reset on any committed outcome
+        self._retry_counts: dict[str, int] = {}
         # Same veto as PodManager's eviction_gate: drain must not destroy
         # a workload whose checkpoint is not yet durable — otherwise the
         # pod-deletion→drain fallback would bypass the durability
@@ -106,70 +141,95 @@ class DrainManager:
         )
 
         for node in config.nodes:
-            if not self._draining_nodes.add(node.metadata.name):
+            name = node.metadata.name
+            submitted = self._pool.submit(
+                lambda n=node: self._drain_node(n, helper), key=name)
+            if not submitted:
                 logger.info("node %s is already being drained, skipping",
-                            node.metadata.name)
+                            name)
                 continue
-            logger.info("schedule drain for node %s", node.metadata.name)
+            logger.info("schedule drain for node %s", name)
             log_event(self._recorder, node, Event.NORMAL,
                       self._keys.event_reason, "Scheduling drain of the node")
-            self._worker.submit(lambda n=node: self._drain_node(n, helper))
+
+    # ------------------------------------------------------------------
+    # wakeup plumbing
+    # ------------------------------------------------------------------
+    def _nudge_outcome(self, name: str) -> None:
+        """An outcome (success or failure) was committed as a label:
+        the retry ladder resets and the loop is woken right away."""
+        self._retry_counts.pop(name, None)
+        if self.nudger is not None:
+            self.nudger.nudge("drain")
+
+    def _defer_retry(self, name: str) -> None:
+        """Transient error: the node stays in drain-required with no
+        label write — nothing will ever wake the loop for it, so
+        register a backoff wakeup (exponential, capped) instead of
+        waiting out a full resync interval."""
+        if self.nudger is None:
+            return
+        retries = self._retry_counts.get(name, 0)
+        self._retry_counts[name] = retries + 1
+        delay = min(DRAIN_RETRY_BASE * (2 ** retries), DRAIN_RETRY_MAX)
+        self.nudger.nudge_after(delay, "drain-retry")
 
     def _drain_node(self, node: Node, helper: DrainHelper) -> None:
         name = node.metadata.name
+        if self._gatekeeper.gate is not None:
+            try:
+                pods, _ = helper.get_pods_for_deletion(name)
+            except Exception as exc:  # noqa: BLE001 — worker boundary
+                # Cannot even enumerate pods (transient API error):
+                # park in drain-required and retry on the backoff
+                # wakeup — delay, never escalate.
+                logger.warning("could not enumerate pods for gate on "
+                               "node %s; deferring drain: %s",
+                               name, exc)
+                self._defer_retry(name)
+                return
+            # Park in drain-required until the gate opens; a raising
+            # gate only delays, never escalates (GateKeeper semantics).
+            if not self._gatekeeper.allows(node, pods):
+                return
         try:
-            if self._gatekeeper.gate is not None:
-                try:
-                    pods, _ = helper.get_pods_for_deletion(name)
-                except Exception as exc:  # noqa: BLE001 — worker boundary
-                    # Cannot even enumerate pods (transient API error):
-                    # park in drain-required and retry next reconcile —
-                    # delay, never escalate.
-                    logger.warning("could not enumerate pods for gate on "
-                                   "node %s; deferring drain: %s",
-                                   name, exc)
-                    return
-                # Park in drain-required until the gate opens; a raising
-                # gate only delays, never escalates (GateKeeper semantics).
-                if not self._gatekeeper.allows(node, pods):
-                    return
-            try:
-                run_cordon_or_uncordon(self._client, name, True)
-            except (ApiServerError, ConflictError) as exc:
-                # Transient apiserver failure: marking the node
-                # upgrade-failed would strand it (its pod is out of sync,
-                # so auto-recovery can never fire). Stay drain-required
-                # and let the next reconcile retry.
-                logger.warning("transient error cordoning node %s; "
-                               "deferring drain: %s", name, exc)
-                return
-            except Exception as exc:  # noqa: BLE001 — worker boundary
-                logger.error("failed to cordon node %s: %s", name, exc)
-                self._fail(node, f"Failed to cordon the node: {exc}")
-                return
-            logger.info("cordoned node %s", name)
-            try:
-                helper.run_node_drain(name)
-            except (ApiServerError, ConflictError) as exc:
-                logger.warning("transient error draining node %s; "
-                               "deferring drain: %s", name, exc)
-                return
-            except Exception as exc:  # noqa: BLE001 — worker boundary
-                logger.error("failed to drain node %s: %s", name, exc)
-                self._fail(node, f"Failed to drain the node: {exc}")
-                return
-            logger.info("drained node %s", name)
-            log_event(self._recorder, node, Event.NORMAL,
-                      self._keys.event_reason, "Successfully drained the node")
-            self._change_state_quietly(
-                node, UpgradeState.POD_RESTART_REQUIRED)
-        finally:
-            self._draining_nodes.remove(name)
+            run_cordon_or_uncordon(self._client, name, True)
+        except (ApiServerError, ConflictError) as exc:
+            # Transient apiserver failure: marking the node
+            # upgrade-failed would strand it (its pod is out of sync,
+            # so auto-recovery can never fire). Stay drain-required
+            # and let the backoff wakeup retry.
+            logger.warning("transient error cordoning node %s; "
+                           "deferring drain: %s", name, exc)
+            self._defer_retry(name)
+            return
+        except Exception as exc:  # noqa: BLE001 — worker boundary
+            logger.error("failed to cordon node %s: %s", name, exc)
+            self._fail(node, f"Failed to cordon the node: {exc}")
+            return
+        logger.info("cordoned node %s", name)
+        try:
+            helper.run_node_drain(name)
+        except (ApiServerError, ConflictError) as exc:
+            logger.warning("transient error draining node %s; "
+                           "deferring drain: %s", name, exc)
+            self._defer_retry(name)
+            return
+        except Exception as exc:  # noqa: BLE001 — worker boundary
+            logger.error("failed to drain node %s: %s", name, exc)
+            self._fail(node, f"Failed to drain the node: {exc}")
+            return
+        logger.info("drained node %s", name)
+        log_event(self._recorder, node, Event.NORMAL,
+                  self._keys.event_reason, "Successfully drained the node")
+        self._change_state_quietly(node, UpgradeState.POD_RESTART_REQUIRED)
+        self._nudge_outcome(name)
 
     def _fail(self, node: Node, message: str) -> None:
         self._change_state_quietly(node, UpgradeState.FAILED)
         log_event(self._recorder, node, Event.WARNING,
                   self._keys.event_reason, message)
+        self._nudge_outcome(node.metadata.name)
 
     def _change_state_quietly(self, node: Node, state: UpgradeState) -> None:
         try:
@@ -180,4 +240,4 @@ class DrainManager:
 
     def join(self, timeout: float = 30.0) -> None:
         """Wait for in-flight drain workers (test/sim helper)."""
-        self._worker.join(timeout)
+        self._pool.drain(timeout)

@@ -1,0 +1,243 @@
+"""ReconcileNudger: completion-driven wakeups + the deadline timer wheel.
+
+The reference's async drain design (drain_manager.go:58-138) commits
+worker outcomes as node labels that the reconcile loop only discovers on
+its next poll, and every timeout in the system (canary bake, validation,
+wait-for-jobs, retry backoff) expires silently between resyncs. At fleet
+scale that idle time — not the pass cost PR 3 already drove to O(delta)
+— dominates upgrade makespan: every async hop pays up to a full resync
+interval of dead air.
+
+This module is the seam that removes it. A single
+:class:`ReconcileNudger` instance is threaded through the state
+machines and their node-action managers; anything that learns an async
+outcome calls :meth:`ReconcileNudger.nudge` the instant the outcome
+lands, and anything that stamps a future deadline registers it on the
+:class:`DeadlineTimerWheel` via :meth:`ReconcileNudger.nudge_at` /
+:meth:`ReconcileNudger.nudge_after`.
+
+Two consumption modes, one object:
+
+- **Live (bound)** — :meth:`ReconcileNudger.bind` wires the nudger to a
+  running :class:`~tpu_operator_libs.controller.Controller`:
+  ``nudge`` enqueues the cluster key immediately and deadline slots are
+  scheduled through ``WorkQueue.add_after``. The work queue's
+  three-set dedup guarantees a burst of nudges coalesces into at most
+  one queued reconcile (no double reconcile for one event), and the
+  wheel's slotting guarantees near-simultaneous deadlines cost one
+  wakeup, not one each.
+- **Driven (unbound)** — simulation/bench/chaos harnesses own the clock
+  and the loop; they poll :meth:`consume_pending` and
+  :meth:`next_deadline` to decide when the next reconcile runs. Nothing
+  is lost while unbound: a later ``bind`` flushes the pending nudge and
+  re-schedules every outstanding deadline slot.
+
+Every wakeup request is counted by source (``drain``, ``eviction``,
+``validation-timeout``, ``canary-bake``, …) — the evidence feed for
+``metrics.observe_latency`` and ``cluster_status``.
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import Callable, Optional
+
+from tpu_operator_libs.util import Clock
+
+
+class DeadlineTimerWheel:
+    """Slotted one-shot timer wheel with wakeup coalescing.
+
+    Deadlines are rounded UP to the next ``resolution`` boundary; one
+    wakeup is scheduled per occupied slot, so N deadlines landing within
+    one slot cost one reconcile instead of N, and no deadline is woken
+    early (expiry checks would find nothing to do) — at most
+    ``resolution`` seconds late, which the registrants tolerate by
+    construction (their stamps are second-granular).
+
+    ``schedule`` is the delay-seconds sink — in live mode a closure over
+    the controller's ``WorkQueue.add_after``; ``None`` leaves the wheel
+    passive for clock-owning drivers that poll :meth:`next_deadline` /
+    :meth:`pop_due` instead.
+    """
+
+    def __init__(self, clock: Optional[Clock] = None,
+                 schedule: Optional[Callable[[float], None]] = None,
+                 resolution: float = 1.0) -> None:
+        if resolution <= 0:
+            raise ValueError("resolution must be positive")
+        self._clock = clock or Clock()
+        self._schedule = schedule
+        self.resolution = resolution
+        self._lock = threading.Lock()
+        # occupied slot boundaries (absolute clock seconds)
+        self._slots: set[float] = set()
+        #: deadlines registered (fresh slots scheduled).
+        self.registered_total = 0
+        #: deadlines absorbed into an already-scheduled slot.
+        self.coalesced_total = 0
+
+    def _slot_of(self, when: float) -> float:
+        # ceil to the next boundary; a deadline exactly on a boundary
+        # keeps it (never wake early)
+        slots = -(-when // self.resolution)
+        return slots * self.resolution
+
+    def register(self, when: float) -> bool:
+        """Register an absolute-deadline wakeup. Returns True when a new
+        slot was scheduled, False when an existing slot already covers
+        it (coalesced)."""
+        slot = self._slot_of(when)
+        now = self._clock.now()
+        with self._lock:
+            if slot in self._slots:
+                self.coalesced_total += 1
+                return False
+            self._slots.add(slot)
+            self.registered_total += 1
+            schedule = self._schedule
+        if schedule is not None:
+            schedule(max(0.0, slot - now))
+        return True
+
+    def rebind(self, schedule: Optional[Callable[[float], None]]) -> None:
+        """Swap the scheduling sink; outstanding future slots are
+        re-scheduled through the new one so nothing registered while
+        unbound is lost."""
+        now = self._clock.now()
+        with self._lock:
+            self._schedule = schedule
+            pending = sorted(s for s in self._slots if s > now)
+        if schedule is not None:
+            for slot in pending:
+                schedule(max(0.0, slot - now))
+
+    def next_deadline(self) -> Optional[float]:
+        """Earliest outstanding slot boundary (absolute seconds), or
+        None. Clock-owning drivers advance virtual time to this."""
+        with self._lock:
+            return min(self._slots) if self._slots else None
+
+    def pop_due(self, now: Optional[float] = None) -> "list[float]":
+        """Drop every slot at or before ``now``; returns their times
+        (sorted). Live mode relies on ``WorkQueue.add_after`` for the
+        actual wakeup and calls this from the nudger to keep the slot
+        set (and ``next_deadline``) from growing stale; clock-owning
+        drivers use the returned instants for idle-time accounting."""
+        if now is None:
+            now = self._clock.now()
+        with self._lock:
+            due = sorted(s for s in self._slots if s <= now)
+            for slot in due:
+                self._slots.discard(slot)
+            return due
+
+    def outstanding(self) -> int:
+        with self._lock:
+            return len(self._slots)
+
+
+class ReconcileNudger:
+    """The completion-wakeup seam threaded through the state machines.
+
+    Construct once per operator (share between the upgrade and
+    remediation machines — they feed the same controller key), hand it
+    to the managers, and either :meth:`bind` it to a live controller or
+    poll it from a clock-owning driver loop.
+    """
+
+    def __init__(self, clock: Optional[Clock] = None,
+                 resolution: float = 1.0) -> None:
+        self._clock = clock or Clock()
+        self._lock = threading.Lock()
+        self._wake: Optional[Callable[[], None]] = None
+        self.wheel = DeadlineTimerWheel(clock=self._clock,
+                                        resolution=resolution)
+        self._pending = False
+        #: wakeup requests by source label (immediate + deadline).
+        self.wakeups_by_source: dict[str, int] = {}
+        #: immediate nudges absorbed by an already-pending wakeup.
+        self.nudges_coalesced_total = 0
+
+    # ------------------------------------------------------------------
+    # producer surface (managers)
+    # ------------------------------------------------------------------
+    def _count(self, source: str) -> None:
+        self.wakeups_by_source[source] = \
+            self.wakeups_by_source.get(source, 0) + 1
+
+    def nudge(self, source: str = "completion") -> None:
+        """An async outcome just landed: wake the controller now. In
+        live mode the work queue dedups bursts; while unbound the
+        pending flag does (the driver runs ONE pass per batch)."""
+        with self._lock:
+            self._count(source)
+            if self._pending:
+                self.nudges_coalesced_total += 1
+            self._pending = True
+            wake = self._wake
+        if wake is not None:
+            wake()
+
+    def nudge_at(self, when: float, source: str = "deadline") -> bool:
+        """Register a precise wakeup for an absolute deadline (canary
+        bake expiry, validation/wait-for-jobs timeout, backoff retry).
+        Returns False when an already-registered slot covers it."""
+        with self._lock:
+            self._count(source)
+        return self.wheel.register(when)
+
+    def nudge_after(self, delay: float, source: str = "deadline") -> bool:
+        """Relative-delay form of :meth:`nudge_at`."""
+        return self.nudge_at(self._clock.now() + max(0.0, delay), source)
+
+    # ------------------------------------------------------------------
+    # live wiring
+    # ------------------------------------------------------------------
+    def bind(self, wake: Callable[[], None],
+             schedule: Optional[Callable[[float], None]] = None) -> None:
+        """Wire to a live controller: ``wake`` enqueues an immediate
+        reconcile (``Controller.enqueue``); ``schedule`` is the delayed
+        form (``lambda d: controller.queue.add_after(CLUSTER_KEY, d)``).
+        A nudge that arrived while unbound fires immediately, and every
+        outstanding deadline slot is re-scheduled."""
+        with self._lock:
+            self._wake = wake
+            flush = self._pending
+            self._pending = False
+        self.wheel.rebind(schedule)
+        if flush:
+            wake()
+
+    def unbind(self) -> None:
+        with self._lock:
+            self._wake = None
+        self.wheel.rebind(None)
+
+    # ------------------------------------------------------------------
+    # driver surface (sim/bench/chaos loops that own the clock)
+    # ------------------------------------------------------------------
+    def consume_pending(self) -> bool:
+        """True when an immediate nudge arrived since the last call (the
+        driver should reconcile now); clears the flag."""
+        with self._lock:
+            pending, self._pending = self._pending, False
+            return pending
+
+    def next_deadline(self) -> Optional[float]:
+        return self.wheel.next_deadline()
+
+    def pop_due(self, now: Optional[float] = None) -> "list[float]":
+        """Consume deadline slots due at ``now`` (their times returned).
+        Live consumers call this at the top of a reconcile so the slot
+        set tracks the queue's delayed items; drivers call it after
+        advancing virtual time."""
+        return self.wheel.pop_due(now)
+
+    # ------------------------------------------------------------------
+    # metrics feed
+    # ------------------------------------------------------------------
+    def counts_snapshot(self) -> dict[str, int]:
+        """Per-source wakeup counts (copy), for status/metrics."""
+        with self._lock:
+            return dict(sorted(self.wakeups_by_source.items()))

@@ -20,7 +20,7 @@ from __future__ import annotations
 import logging
 import threading
 from dataclasses import dataclass, field
-from typing import Callable, Optional
+from typing import TYPE_CHECKING, Callable, Optional
 
 from tpu_operator_libs.api.upgrade_policy import (
     PodDeletionSpec,
@@ -48,7 +48,15 @@ from tpu_operator_libs.util import (
     log_event,
 )
 
+if TYPE_CHECKING:
+    from tpu_operator_libs.upgrade.nudger import ReconcileNudger
+
 logger = logging.getLogger(__name__)
+
+#: Backoff for transient-error eviction retries (seconds); see the
+#: drain manager's jitter-free rationale — retries land on the nudger's
+#: coalescing timer wheel, and determinism keeps seeded replays exact.
+EVICTION_RETRY_SECONDS = 5.0
 
 #: Decides whether a workload pod must be deleted before the runtime upgrade
 #: (reference PodDeletionFilter, pod_manager.go:76).
@@ -85,7 +93,8 @@ class PodManager:
                  recorder: Optional[EventRecorder] = None,
                  clock: Optional[Clock] = None,
                  worker: Optional[Worker] = None,
-                 eviction_gate: Optional[EvictionGate] = None) -> None:
+                 eviction_gate: Optional[EvictionGate] = None,
+                 nudger: Optional["ReconcileNudger"] = None) -> None:
         self._client = client
         self._provider = provider
         self._deletion_filter = deletion_filter
@@ -96,6 +105,7 @@ class PodManager:
         self._clock = clock or Clock()
         self._worker = worker or Worker()
         self._nodes_in_progress = NameSet()
+        self.nudger = nudger
         self._keys = provider.keys
         # Per-snapshot revision-oracle memo (see
         # get_daemon_set_revision_hash); reset by the state manager at
@@ -309,9 +319,13 @@ class PodManager:
             # Transient apiserver failure: escalating to drain-or-failed
             # could strand the node in upgrade-failed (out-of-sync pod ⇒
             # auto-recovery can never fire). Park in
-            # pod-deletion-required; the next reconcile retries.
+            # pod-deletion-required; a backoff wakeup retries without
+            # waiting out the resync interval.
             logger.warning("transient error deleting pods on node %s; "
                            "deferring: %s", name, exc)
+            if self.nudger is not None:
+                self.nudger.nudge_after(EVICTION_RETRY_SECONDS,
+                                        "eviction-retry")
         except Exception as exc:  # noqa: BLE001 — worker boundary
             logger.error("failed to delete pods on node %s: %s", name, exc)
             log_event(self._recorder, node, Event.WARNING,
@@ -338,12 +352,17 @@ class PodManager:
     def _change_state_quietly(self, node: Node, state: UpgradeState) -> None:
         """State write from an async worker: errors are logged, not raised —
         the next reconcile re-derives the correct action (the reference
-        ignores these errors outright, pod_manager.go:189,223)."""
+        ignores these errors outright, pod_manager.go:189,223). A
+        committed outcome wakes the reconcile loop immediately instead
+        of waiting for the next poll."""
         try:
             self._provider.change_node_upgrade_state(node, state)
         except Exception as exc:  # noqa: BLE001 — worker boundary
             logger.error("failed to change state of node %s to %s: %s",
                          node.metadata.name, state, exc)
+            return
+        if self.nudger is not None:
+            self.nudger.nudge("eviction")
 
     # ------------------------------------------------------------------
     # (b) restart runtime pods
@@ -460,8 +479,17 @@ class PodManager:
         if stamp is None:
             self._provider.change_node_upgrade_annotation(
                 node, annotation, str(now))
+            if self.nudger is not None:
+                # precise wakeup at expiry (slot-coalesced with the
+                # rest of the wave); re-registered below on later
+                # sightings so it survives operator restarts
+                self.nudger.nudge_at(now + timeout_seconds,
+                                     "wait-for-jobs-timeout")
             return
         start = int(stamp)
+        if self.nudger is not None and now <= start + timeout_seconds:
+            self.nudger.nudge_at(start + timeout_seconds,
+                                 "wait-for-jobs-timeout")
         if now > start + timeout_seconds:
             # forced advance + stamp removal as ONE merge patch (the
             # split form could crash between the two writes and leave a

@@ -119,6 +119,11 @@ class RolloutGuard:
         self._recorder = recorder
         self._clock = clock or Clock()
         self._pod_failure_threshold = pod_failure_threshold
+        #: Optional ReconcileNudger (installed by the state manager):
+        #: bake expiry is a pure-time deadline with no cluster event, so
+        #: without a timer-wheel wakeup the fleet waves only start at
+        #: whatever pass happens to run after the bake elapses.
+        self.nudger = None
         #: Lifetime failure verdicts observed, deduplicated per
         #: (revision, node) — a crash-looping canary is one verdict, not
         #: one per reconcile pass.
@@ -403,8 +408,14 @@ class RolloutGuard:
         revision, _, passed_at = stamp.partition(":")
         if revision == ro.newest and passed_at:
             try:
-                return self._clock.now() >= (
-                    float(passed_at) + canary.bake_seconds)
+                expiry = float(passed_at) + canary.bake_seconds
+                baked = self._clock.now() >= expiry
+                if not baked and self.nudger is not None:
+                    # wake the pass that opens the fleet waves exactly
+                    # at bake expiry (idempotent via slot dedup, and
+                    # re-derived from the durable stamp after a crash)
+                    self.nudger.nudge_at(expiry, "canary-bake")
+                return baked
             except ValueError:
                 pass  # corrupt stamp: fall through and re-derive
         done_on_newest: set[str] = set()
@@ -425,6 +436,8 @@ class RolloutGuard:
             logger.warning("failed to stamp canary pass for %s; retrying "
                            "next pass: %s", ro.ds.metadata.name, exc)
             return False
+        if canary.bake_seconds > 0 and self.nudger is not None:
+            self.nudger.nudge_at(now + canary.bake_seconds, "canary-bake")
         logger.info(
             "canary cohort %s passed on revision %s; baking %ds before "
             "fleet waves", sorted(cohort), ro.newest, canary.bake_seconds)

@@ -46,6 +46,7 @@ if TYPE_CHECKING:  # pragma: no cover - import cycle guard (topology
     # imports k8s.objects; planner imports this module's state types)
     from tpu_operator_libs.topology.multislice import MultisliceConstraint
     from tpu_operator_libs.topology.slice_topology import SliceTopology
+    from tpu_operator_libs.upgrade.nudger import ReconcileNudger
 
 from tpu_operator_libs.api.upgrade_policy import (
     DrainSpec,
@@ -203,24 +204,31 @@ class ClusterUpgradeStateManager:
                  sync_timeout: float = 10.0,
                  poll_interval: float = 1.0,
                  parallel_workers: int = 0,
-                 incremental_reads: bool = True) -> None:
+                 incremental_reads: bool = True,
+                 nudger: Optional["ReconcileNudger"] = None) -> None:
         self.keys = keys or UpgradeKeys()
         self.client = client
         self.recorder = recorder
         self.clock = clock or Clock()
         self._async_workers = async_workers
+        # Completion-driven wakeup seam (upgrade/nudger.py): threaded
+        # into every manager that learns async outcomes or stamps
+        # deadlines, so the reconcile loop is woken the moment the
+        # outcome lands instead of on its next poll. None = the
+        # reference's poll-paced behavior, bit for bit.
+        self.nudger = nudger
         self.provider = provider or NodeUpgradeStateProvider(
             client, self.keys, recorder, self.clock,
             sync_timeout=sync_timeout, poll_interval=poll_interval)
         self.cordon_manager = cordon_manager or CordonManager(client)
         self.drain_manager = drain_manager or DrainManager(
             client, self.provider, recorder, self.clock,
-            Worker(async_mode=async_workers))
+            Worker(async_mode=async_workers), nudger=nudger)
         self.pod_manager = pod_manager or PodManager(
             client, self.provider, None, recorder, self.clock,
-            Worker(async_mode=async_workers))
+            Worker(async_mode=async_workers), nudger=nudger)
         self.validation_manager = validation_manager or ValidationManager(
-            client, self.provider, "", recorder, self.clock)
+            client, self.provider, "", recorder, self.clock, nudger=nudger)
         self.safe_load_manager = safe_load_manager or SafeRuntimeLoadManager(
             self.provider)
         # Canary/halt/rollback brain. Holds no durable state of its own
@@ -229,6 +237,7 @@ class ClusterUpgradeStateManager:
         self.rollout_guard = RolloutGuard(
             client, self.keys, recorder, self.clock,
             pod_failure_threshold=POD_RESTART_FAILURE_THRESHOLD)
+        self.rollout_guard.nudger = nudger
         # The current pass's rollout decision (neutral outside
         # apply_state and whenever canary gating is disabled).
         self._rollout = RolloutDecision()
@@ -281,6 +290,32 @@ class ClusterUpgradeStateManager:
         #: deferral an earlier chain pass already retried successfully
         #: does not linger here.
         self.last_pass_deferrals = 0
+        # ---- eager slot refill bookkeeping (see _eager_slot_refill) ----
+        #: Nodes that reached DONE during the current pass — each one
+        #: frees an in-flight slot the refill round may re-spend.
+        self._pass_slots_freed = 0
+        #: Lifetime refill rounds run / candidates admitted by them.
+        self.eager_refills_total = 0
+        self.eager_refill_admissions_total = 0
+        #: Throttle observability for the most recent pass: in-progress
+        #: count, slot budget and saturation — the gauge feed for
+        #: metrics.observe_latency and the cluster_status "slots" block.
+        self.last_pass_slots: Optional[dict] = None
+
+    def with_nudger(
+            self, nudger: Optional["ReconcileNudger"],
+    ) -> "ClusterUpgradeStateManager":
+        """Install (or clear) the completion-wakeup seam on this manager
+        AND every node-action manager it currently holds. Use after
+        construction when the nudger is built later than the manager
+        (e.g. the OperatorManager wires it to the controller at
+        start)."""
+        self.nudger = nudger
+        self.drain_manager.nudger = nudger
+        self.pod_manager.nudger = nudger
+        self.validation_manager.nudger = nudger
+        self.rollout_guard.nudger = nudger
+        return self
 
     @property
     def planner(self) -> UpgradePlanner:
@@ -309,7 +344,7 @@ class ClusterUpgradeStateManager:
         self.pod_manager = PodManager(
             self.client, self.provider, deletion_filter, self.recorder,
             self.clock, Worker(async_mode=self._async_workers),
-            eviction_gate=eviction_gate)
+            eviction_gate=eviction_gate, nudger=self.nudger)
         if eviction_gate is not None:
             # The drain fallback must honor the same gate, or a failed
             # pod deletion would evict the workload anyway.
@@ -336,7 +371,7 @@ class ClusterUpgradeStateManager:
             return self
         self.validation_manager = ValidationManager(
             self.client, self.provider, pod_selector, self.recorder,
-            self.clock, extra_validator)
+            self.clock, extra_validator, nudger=self.nudger)
         self._validation_enabled = True
         return self
 
@@ -542,6 +577,8 @@ class ClusterUpgradeStateManager:
         if state is None:
             raise ValueError("currentState should not be empty")
         self.last_pass_deferrals = 0
+        with self._deferral_lock:
+            self._pass_slots_freed = 0
         if policy is None or not policy.auto_upgrade:
             logger.info("auto upgrade is disabled, skipping")
             self._rollout = RolloutDecision()
@@ -573,11 +610,24 @@ class ClusterUpgradeStateManager:
                 policy.max_unavailable, total_nodes, round_up=True)
         upgrades_available = self.get_upgrades_available(
             state, policy.max_parallel_upgrades, max_unavailable)
+        in_progress = self.get_upgrades_in_progress(state)
         logger.info(
             "upgrades in progress: %d, available slots: %d, "
             "unavailable nodes: %d/%d",
-            self.get_upgrades_in_progress(state), upgrades_available,
+            in_progress, upgrades_available,
             self.get_current_unavailable_nodes(state), max_unavailable)
+        # in-flight window observability: how full is the budget the
+        # throttle lets us spend? (the eager refill exists to keep this
+        # saturated — see _eager_slot_refill)
+        budget = max_unavailable
+        if policy.max_parallel_upgrades > 0:
+            budget = min(budget, policy.max_parallel_upgrades)
+        self.last_pass_slots = {
+            "inProgress": in_progress,
+            "available": upgrades_available,
+            "budget": budget,
+            "saturation": round(in_progress / budget, 4) if budget else 0.0,
+        }
 
         self.process_done_or_unknown_nodes(state, UpgradeState.UNKNOWN)
         self.process_done_or_unknown_nodes(state, UpgradeState.DONE)
@@ -606,6 +656,7 @@ class ClusterUpgradeStateManager:
         self.process_rollback_required_nodes(state)
         self.process_validation_required_nodes(state)
         self.process_uncordon_required_nodes(state)
+        self._eager_slot_refill(state, policy, planner, max_unavailable)
         # Gate-parked nodes that left every eviction-wanting state this
         # pass (policy flipped drain off, node recovered or vanished) are
         # handed back to the gate's release hook so e.g. serving
@@ -1183,11 +1234,79 @@ class ClusterUpgradeStateManager:
                     ns.node.metadata.name, current or "unknown")
                 return
             self.cordon_manager.uncordon(ns.node)
-            self.provider.change_node_upgrade_state(
-                ns.node, UpgradeState.DONE)
+            if self.provider.change_node_upgrade_state(
+                    ns.node, UpgradeState.DONE):
+                self._count_slot_freed()
 
         self._map_bucket(state.bucket(UpgradeState.UNCORDON_REQUIRED),
                          "uncordon", uncordon)
+
+    def _count_slot_freed(self) -> None:
+        """A node reached DONE inside the current pass: its in-flight
+        slot is free again (thread-safe — finish commits run on the
+        bucket pool)."""
+        with self._deferral_lock:
+            self._pass_slots_freed += 1
+
+    def _eager_slot_refill(self, state: ClusterUpgradeState,
+                           policy: UpgradePolicySpec,
+                           planner: UpgradePlanner,
+                           max_unavailable: int) -> None:
+        """Re-spend slots freed by nodes that finished THIS pass.
+
+        Admission runs first in ``apply_state`` (reference bucket
+        order), so a slot freed by an uncordon later in the same pass
+        used to sit idle until the next reconcile — the in-flight
+        window drained by one wave-slot per finish, and a poll-paced
+        consumer paid a full interval of lost parallelism for it. This
+        second admission round runs after the finish buckets, against
+        the nodes' CURRENT labels (provider commits update the node
+        objects in place, so no cluster read is needed), and re-applies
+        the exact same throttle math and planner — maxUnavailable,
+        maxParallel, ICI-slice atomicity and the canary cohort all hold
+        because they are re-derived, not cached.
+
+        Candidates are restricted to nodes that BOTH started and still
+        sit in ``upgrade-required``: a node idle-triaged into the queue
+        this pass already made its one transition, and admitting it
+        here would break the one-transition-per-pass invariant the
+        chaos monitor audits. Halted fleets refill nothing — the freeze
+        must also freeze this round."""
+        with self._deferral_lock:
+            freed = self._pass_slots_freed
+        if freed <= 0 or self._rollout.halted:
+            return
+        required = str(UpgradeState.UPGRADE_REQUIRED)
+        effective = ClusterUpgradeState()
+        candidates: list[NodeUpgradeState] = []
+        for label, bucket in state.node_states.items():
+            for ns in bucket:
+                current = ns.node.metadata.labels.get(
+                    self.keys.state_label, "")
+                effective.node_states.setdefault(current, []).append(ns)
+                if current == required and label == required:
+                    candidates.append(ns)
+        if not candidates:
+            return
+        available = self.get_upgrades_available(
+            effective, policy.max_parallel_upgrades, max_unavailable)
+        if available <= 0:
+            return
+        effective.node_states[required] = candidates
+        self.eager_refills_total += 1
+        logger.info(
+            "eager slot refill: %d slot(s) freed this pass, %d "
+            "available, %d candidate(s)", freed, available,
+            len(candidates))
+        self.process_upgrade_required_nodes(effective, available,
+                                            planner=planner)
+        admitted = sum(
+            1 for ns in candidates
+            if ns.node.metadata.labels.get(self.keys.state_label, "")
+            == str(UpgradeState.CORDON_REQUIRED))
+        self.eager_refill_admissions_total += admitted
+        if self.last_pass_slots is not None:
+            self.last_pass_slots["refilled"] = admitted
 
     # ------------------------------------------------------------------
     # predicates
@@ -1239,8 +1358,12 @@ class ClusterUpgradeStateManager:
                         "skipping uncordon", node.metadata.name)
             new_state = UpgradeState.DONE
             annotations = {annotation: None}
-        self.provider.change_node_upgrade_state(node, new_state,
-                                                annotations=annotations)
+        committed = self.provider.change_node_upgrade_state(
+            node, new_state, annotations=annotations)
+        if committed and new_state == UpgradeState.DONE:
+            # the pre-cordoned arc finishes in place: its max-parallel
+            # slot frees this pass even though availability is unchanged
+            self._count_slot_freed()
 
     # ------------------------------------------------------------------
     # fleet counters (upgrade_state.go:188-211, 1034-1120)
@@ -1347,6 +1470,17 @@ class ClusterUpgradeStateManager:
             # why the rollout is gated: canary wave in flight, or the
             # fleet halted on a quarantined revision
             status["rollout"] = rollout
+        if self.last_pass_slots is not None:
+            # in-flight window saturation + eager-refill evidence for
+            # the most recent pass (why the fleet is / is not pacing)
+            status["slots"] = dict(self.last_pass_slots)
+        if self.nudger is not None:
+            wakeups = self.nudger.counts_snapshot()
+            if wakeups:
+                # per-source wakeup counts (drain/eviction/validation-
+                # timeout/canary-bake/…): the event-driven layer's
+                # lifetime activity, matching observe_latency's counters
+                status["wakeups"] = wakeups
         return status
 
     # ------------------------------------------------------------------

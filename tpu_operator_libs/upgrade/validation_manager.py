@@ -14,7 +14,7 @@ node only returns to service when the TPU interconnect is provably healthy.
 from __future__ import annotations
 
 import logging
-from typing import Callable, Optional
+from typing import TYPE_CHECKING, Callable, Optional
 
 from tpu_operator_libs.consts import UpgradeState
 from tpu_operator_libs.k8s.client import K8sClient
@@ -22,9 +22,20 @@ from tpu_operator_libs.k8s.objects import Node
 from tpu_operator_libs.upgrade.state_provider import NodeUpgradeStateProvider
 from tpu_operator_libs.util import Clock, Event, EventRecorder, log_event
 
+if TYPE_CHECKING:
+    from tpu_operator_libs.upgrade.nudger import ReconcileNudger
+
 logger = logging.getLogger(__name__)
 
 VALIDATION_TIMEOUT_SECONDS = 600  # validation_manager.go:31-33
+
+#: Re-check cadence for a failing EXTRA validator (seconds). A not-ready
+#: validation pod becoming Ready is a watch event and wakes the loop on
+#: its own; an extra validator (e.g. the ICI fabric probe) is invisible
+#: to the watch stream, so without a timed retry its eventual pass would
+#: only be discovered at the next resync. Registered through the nudger's
+#: timer wheel, so a wave of probing nodes coalesces into one wakeup.
+VALIDATION_RETRY_SECONDS = 15.0
 
 #: Extra health gate: returns True when the node is healthy. Exceptions are
 #: treated as "not yet healthy" and retried next reconcile.
@@ -38,7 +49,9 @@ class ValidationManager:
                  recorder: Optional[EventRecorder] = None,
                  clock: Optional[Clock] = None,
                  extra_validator: Optional[NodeValidator] = None,
-                 timeout_seconds: int = VALIDATION_TIMEOUT_SECONDS) -> None:
+                 timeout_seconds: int = VALIDATION_TIMEOUT_SECONDS,
+                 nudger: Optional["ReconcileNudger"] = None,
+                 retry_seconds: float = VALIDATION_RETRY_SECONDS) -> None:
         self._client = client
         self._provider = provider
         self._pod_selector = pod_selector
@@ -46,6 +59,8 @@ class ValidationManager:
         self._clock = clock or Clock()
         self._extra_validator = extra_validator
         self._timeout_seconds = timeout_seconds
+        self.nudger = nudger
+        self.retry_seconds = retry_seconds
         self._keys = provider.keys
 
     @property
@@ -76,6 +91,11 @@ class ValidationManager:
             logger.warning("no validation pods found on node %s",
                            node.metadata.name)
             return False
+        if failure == "extra-validator" and self.nudger is not None:
+            # the probe's eventual pass emits no cluster event — poll it
+            # on the timer wheel instead of waiting for the resync
+            self.nudger.nudge_after(self.retry_seconds,
+                                    "validation-retry")
         self._handle_timeout(node, failure)
         return False
 
@@ -124,8 +144,19 @@ class ValidationManager:
         if stamp is None:
             self._provider.change_node_upgrade_annotation(
                 node, annotation, str(now))
+            if self.nudger is not None:
+                # precise wakeup at expiry: the timeout otherwise fires
+                # only when something else happens to run a pass
+                self.nudger.nudge_at(now + self._timeout_seconds,
+                                     "validation-timeout")
             return
         start = int(stamp)
+        if self.nudger is not None and now <= start + self._timeout_seconds:
+            # re-register on every sighting: idempotent through the
+            # wheel's slot dedup, and it survives operator restarts
+            # (the stamp is durable, the wheel is not)
+            self.nudger.nudge_at(start + self._timeout_seconds,
+                                 "validation-timeout")
         if now > start + self._timeout_seconds:
             committed = False
             try:
