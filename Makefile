@@ -4,7 +4,7 @@ PYTHON ?= python3
 PYTEST_FLAGS ?= -q
 COV_THRESHOLD ?= 85
 
-.PHONY: all check test test-fast test-fault test-chaos test-soak test-scale test-rollout test-latency lint cov bench bench-reconcile bench-latency graft-check package clean diagram
+.PHONY: all check test test-fast test-fault test-chaos test-soak test-scale test-rollout test-latency test-reconfig lint cov bench bench-reconcile bench-latency graft-check package clean diagram
 
 all: lint test
 
@@ -38,6 +38,14 @@ test-chaos:
 # the hash, roll every touched node back to the previous revision).
 test-rollout:
 	$(PYTHON) -m pytest tests/ $(PYTEST_FLAGS) -m "rollout and not slow"
+
+# Degraded-slice reconfiguration slice: reconfigurer units, the
+# remediation reconfigure-required arc, joint planning, and the seeded
+# reconfiguration chaos gate (k permanent node kills across >= 2 slices
+# mid-rollout: every slice must be remapped onto a spare or admitted
+# degraded — never silently short).
+test-reconfig:
+	$(PYTHON) -m pytest tests/ $(PYTEST_FLAGS) -m "reconfig and not slow"
 
 # Long randomized soak, outside tier-1. Widen with the env knobs, e.g.:
 #   CHAOS_SEEDS=$$(seq -s, 100 199) CHAOS_STEPS=2400 make test-soak

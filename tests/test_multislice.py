@@ -461,6 +461,89 @@ class TestPlannerIntegration:
                    for st in states.values())
 
 
+class TestRemapMembershipHold:
+    """Sticky-down memory across a slice REMAP (the reconfigurer swaps a
+    spare in for a condemned host): the slice comes back up while the
+    job's replacement pods are still Pending, and the planner must not
+    take a second member of the same job in that window."""
+
+    def _two_slice_fleet(self, env):
+        return [NodeBuilder(f"s{s}-h{h}").with_labels(
+            tpu_labels(f"pool-{s}")).create(env.cluster)
+            for s in range(2) for h in range(2)]
+
+    def test_hold_carries_membership_of_remapped_slice(self):
+        env = make_env()
+        nodes = self._two_slice_fleet(env)
+        p0 = workload_pod(env, "train", "s0-h0")
+        workload_pod(env, "train", "s1-h0")
+        jm = MultisliceJobMap()
+        jm.refresh(env.cluster.list_pods(namespace=WORKLOAD_NS), nodes,
+                   down_slices=set())
+        env.cluster.delete_pod(WORKLOAD_NS, p0.metadata.name)
+        jm.refresh(env.cluster.list_pods(namespace=WORKLOAD_NS), nodes,
+                   down_slices={"pool-0"})
+        # the remap finished: pool-0 is UP again, replacement Pending —
+        # without the hold this round forgets the member (the
+        # pre-reconfiguration behavior the sibling test above pins)
+        members = jm.refresh(
+            env.cluster.list_pods(namespace=WORKLOAD_NS), nodes,
+            down_slices=set(), hold_slices={"pool-0"})
+        assert members[(WORKLOAD_NS, "train")] == {"pool-0", "pool-1"}
+
+    def test_hold_released_early_by_live_pods(self):
+        env = make_env()
+        nodes = self._two_slice_fleet(env)
+        workload_pod(env, "train", "s0-h0")
+        workload_pod(env, "train", "s1-h0")
+        jm = MultisliceJobMap()
+        jm.refresh(env.cluster.list_pods(namespace=WORKLOAD_NS), nodes,
+                   down_slices=set())
+        # pods are live on the held slice: membership comes from them,
+        # the hold adds nothing and cannot pin stale state
+        members = jm.refresh(
+            env.cluster.list_pods(namespace=WORKLOAD_NS), nodes,
+            down_slices=set(), hold_slices={"pool-0"})
+        assert members[(WORKLOAD_NS, "train")] == {"pool-0", "pool-1"}
+
+    def test_planner_defers_second_member_during_remap_settle(self):
+        """Through the state machine: slice 0 was remapped (settle stamp
+        on its replacement host, job replica still Pending) — the
+        planner must defer slice 1 even though every pool-0 host is up
+        and schedulable. A per-pass-rebuilt map, or a hold that did not
+        COUNT the settling slice against its job's budget, would take
+        the second member here and leave the job with zero usable
+        slices."""
+        from tpu_operator_libs.consts import TopologyKeys
+
+        env = make_env()
+        setup_sliced_fleet(env, n_slices=2, hosts_per_slice=2,
+                           pod_hash="old", ds_hash="new")
+        workload_pod(env, "train", "s0-h0")
+        workload_pod(env, "train", "s1-h0")
+        constraint = MultisliceConstraint(
+            workload_pods=lambda: env.cluster.list_pods(
+                namespace=WORKLOAD_NS))
+        mgr = make_state_manager(env).with_multislice_constraint(
+            constraint)
+        policy = slice_policy()
+        mgr.apply_state(mgr.build_state(NS, RUNTIME_LABELS), policy)
+        # membership learned while both replicas are live
+        constraint.begin_round(env.cluster.list_nodes(), set())
+        # remap aftermath: pool-0's replica evicted, replacement still
+        # Pending, settle stamp on the replacement host, ALL hosts up
+        env.cluster.delete_pod(WORKLOAD_NS, "train-s0-h0")
+        PodBuilder("train-s0-h0-repl", namespace=WORKLOAD_NS) \
+            .with_labels({JOBSET_NAME_LABEL: "train"}).create(env.cluster)
+        env.cluster.patch_node_annotations(
+            "s0-h1", {TopologyKeys().remapped_at_annotation: "123:s0-h0"})
+        mgr.apply_state(mgr.build_state(NS, RUNTIME_LABELS), policy)
+        # slice 1 deferred: its job already counts the settling pool-0
+        assert env.state_of("s1-h0") == str(UpgradeState.UPGRADE_REQUIRED)
+        assert env.state_of("s1-h1") == str(UpgradeState.UPGRADE_REQUIRED)
+        assert "pool-1" in mgr.multislice_deferred_slices
+
+
 class TestSimulationInvariant:
     """Randomized-fleet invariant (VERDICT round 2, next-round #1): per
     multislice job, at most N member slices are down at any sim instant,

@@ -1,0 +1,623 @@
+"""Degraded-slice reconfiguration: the SliceReconfigurer, the
+remediation machine's ``reconfigure-required`` arc, joint planning with
+the upgrade planners, the policy/CRD surface, metrics, and the seeded
+reconfiguration chaos gate (k permanent node kills across >= 2 slices
+mid-rollout; every affected slice must be remapped onto a spare or
+admitted as a documented degraded shape — never silently short)."""
+
+import os
+
+import pytest
+
+pytestmark = [pytest.mark.fault, pytest.mark.reconfig]
+
+from tpu_operator_libs.api.remediation_policy import (
+    ReconfigurationPolicySpec,
+    RemediationPolicySpec,
+)
+from tpu_operator_libs.api.upgrade_policy import PolicyValidationError
+from tpu_operator_libs.consts import (
+    GKE_NODEPOOL_LABEL,
+    GKE_TPU_ACCELERATOR_LABEL,
+    GKE_TPU_TOPOLOGY_LABEL,
+    TRUE_STRING,
+    RemediationKeys,
+    RemediationState,
+    TopologyKeys,
+    UpgradeKeys,
+    UpgradeState,
+)
+from tpu_operator_libs.chaos import run_reconfig_soak
+from tpu_operator_libs.k8s.fake import FakeCluster
+from tpu_operator_libs.k8s.objects import Node, ObjectMeta
+from tpu_operator_libs.metrics import MetricsRegistry, observe_topology
+from tpu_operator_libs.remediation import NodeRemediationManager
+from tpu_operator_libs.topology.reconfigurer import SliceReconfigurer
+from tpu_operator_libs.topology.slice_topology import (
+    SliceTopology,
+    decode_degraded_slices,
+    encode_degraded_slices,
+)
+from tpu_operator_libs.util import EventRecorder, FakeClock
+
+from builders import DaemonSetBuilder, NodeBuilder, PodBuilder
+
+NS = "tpu-system"
+RUNTIME_LABELS = {"app": "libtpu"}
+KEYS = RemediationKeys()
+UKEYS = UpgradeKeys()
+TKEYS = TopologyKeys()
+
+#: The fixed tier-1 reconfiguration gate seeds.
+GATE_SEEDS = tuple(range(1, 11))
+
+
+def tpu_labels(pool=None, accel="tpu-v5-lite-podslice", topo="2x2"):
+    labels = {GKE_TPU_ACCELERATOR_LABEL: accel,
+              GKE_TPU_TOPOLOGY_LABEL: topo,
+              "google.com/tpu": "true"}
+    if pool is not None:
+        labels[GKE_NODEPOOL_LABEL] = pool
+    return labels
+
+
+def make_fleet(n_slices=2, hosts=2, spares=1, revision="new",
+               spare_state=UpgradeState.DONE):
+    """Sliced TPU fleet, every node upgrade-done on ``revision``, plus
+    ``spares`` ready spare-pool hosts."""
+    clock = FakeClock(start=1_000_000.0)
+    cluster = FakeCluster(clock=clock)
+    cluster.enable_ds_controller(recreate_delay=2.0, ready_delay=4.0)
+    ds = DaemonSetBuilder("libtpu", namespace=NS) \
+        .with_labels(dict(RUNTIME_LABELS)) \
+        .with_desired_scheduled(n_slices * hosts) \
+        .with_revision_hash(revision).create(cluster)
+    for s in range(n_slices):
+        for h in range(hosts):
+            node = NodeBuilder(f"s{s}-h{h}") \
+                .with_labels(tpu_labels(f"pool-{s}")) \
+                .with_upgrade_state(UKEYS, UpgradeState.DONE) \
+                .create(cluster)
+            PodBuilder(f"libtpu-s{s}-h{h}", namespace=NS).on_node(node) \
+                .owned_by(ds).with_revision_hash(revision).create(cluster)
+    for i in range(spares):
+        labels = tpu_labels()
+        labels[TKEYS.spare_pool_label] = TRUE_STRING
+        if spare_state is not None:
+            labels[UKEYS.state_label] = str(spare_state)
+        cluster.seed_node_with_ds_pod(
+            Node(metadata=ObjectMeta(name=f"spare-{i}", labels=labels)),
+            NS, "libtpu", revision_hash=revision)
+    return cluster, clock, ds
+
+
+def make_manager(cluster, clock, recorder=None):
+    reconfigurer = SliceReconfigurer(
+        cluster, TKEYS, remediation_keys=KEYS, upgrade_keys=UKEYS,
+        recorder=recorder, clock=clock)
+    manager = NodeRemediationManager(
+        cluster, KEYS, upgrade_keys=UKEYS, clock=clock,
+        recorder=recorder, poll_interval=0.0, sync_timeout=5.0,
+        reconfigurer=reconfigurer)
+    return manager, reconfigurer
+
+
+def make_policy(**reconfig_kwargs):
+    reconfig_kwargs.setdefault("enable", True)
+    reconfig_kwargs.setdefault("settle_seconds", 0)
+    policy = RemediationPolicySpec(
+        enable=True, settle_seconds=0,
+        reconfiguration=ReconfigurationPolicySpec(**reconfig_kwargs))
+    policy.detection.not_ready_grace_seconds = 0
+    return policy
+
+
+def condemn(cluster, name):
+    """Hand-place a node in remediation-failed with a live wedge signal
+    (the ladder's give-up point; the full walk is the soak's job)."""
+    cluster.set_node_ready(name, False)
+    cluster.set_node_unschedulable(name, True)
+    cluster.patch_node_labels(
+        name, {KEYS.state_label: str(RemediationState.FAILED)})
+
+
+def rem_state(cluster, name):
+    return cluster.get_node(name).metadata.labels.get(KEYS.state_label, "")
+
+
+def apply(manager, policy, passes=1):
+    for _ in range(passes):
+        snapshot = manager.build_state(NS, RUNTIME_LABELS)
+        manager.apply_state(snapshot, policy)
+    return snapshot
+
+
+class TestDegradedCodec:
+    def test_round_trip(self):
+        record = {"pool-0": ("s0-h1", "s0-h0"), "pool-2": ("s2-h3",)}
+        encoded = encode_degraded_slices(record)
+        assert encoded == "pool-0:s0-h0+s0-h1,pool-2:s2-h3"
+        assert decode_degraded_slices(encoded) == {
+            "pool-0": ("s0-h0", "s0-h1"), "pool-2": ("s2-h3",)}
+
+    def test_empty_and_malformed(self):
+        assert encode_degraded_slices({}) == ""
+        assert decode_degraded_slices("") == {}
+        assert decode_degraded_slices("garbage,pool-1:h1") == {
+            "pool-1": ("h1",)}
+
+    def test_slice_topology_carries_degraded_marker(self):
+        cluster, _clock, _ds = make_fleet(n_slices=1, spares=0)
+        topo = SliceTopology.from_nodes(
+            cluster.list_nodes(), degraded={"pool-0": ("lost-h9",)})
+        info = topo.slices["pool-0"]
+        assert info.declared_degraded and info.lost_hosts == ("lost-h9",)
+        assert info.is_available  # remaining hosts are all up: truthful
+
+
+class TestPolicySurface:
+    def test_round_trip_and_defaults(self):
+        spec = ReconfigurationPolicySpec()
+        assert not spec.enable and spec.allow_degraded
+        data = ReconfigurationPolicySpec(
+            enable=True, spare_provision_timeout_seconds=60,
+            settle_seconds=5, allow_degraded=False,
+            take_over_failed_upgrades=False).to_dict()
+        loaded = ReconfigurationPolicySpec.from_dict(data)
+        assert loaded.to_dict() == data
+        policy = RemediationPolicySpec(
+            enable=True, reconfiguration=loaded)
+        assert RemediationPolicySpec.from_dict(policy.to_dict()) \
+            .reconfiguration.settle_seconds == 5
+
+    def test_validation_rejects_negatives(self):
+        with pytest.raises(PolicyValidationError):
+            ReconfigurationPolicySpec(
+                spare_provision_timeout_seconds=-1).validate()
+        with pytest.raises(PolicyValidationError):
+            RemediationPolicySpec(
+                reconfiguration=ReconfigurationPolicySpec(
+                    settle_seconds=-2)).validate()
+
+    def test_crd_schema_carries_reconfiguration(self):
+        from tpu_operator_libs.api.crd import (
+            remediation_policy_schema,
+            unified_policy_schema,
+        )
+        schema = remediation_policy_schema()
+        reconfig = schema["properties"]["reconfiguration"]
+        assert reconfig["properties"]["enable"]["default"] is False
+        assert reconfig["properties"]["allowDegraded"]["default"] is True
+        accel = unified_policy_schema()["properties"]["accelerators"][
+            "additionalProperties"]
+        assert "reconfiguration" in accel["properties"]["remediation"][
+            "properties"]
+
+
+class TestCondemnation:
+    def test_failed_node_is_condemned_with_event(self):
+        cluster, clock, _ds = make_fleet(spares=0)
+        recorder = EventRecorder()
+        manager, _ = make_manager(cluster, clock, recorder)
+        condemn(cluster, "s0-h0")
+        apply(manager, make_policy())
+        node = cluster.get_node("s0-h0")
+        assert KEYS.condemned_annotation in node.metadata.annotations
+        assert any(e.reason == "NodeCondemned" for e in recorder.events)
+
+    def test_condemned_stamp_without_reconfiguration_policy(self):
+        """The NodeCondemned record is NOT gated on reconfiguration:
+        plain remediation consumers get the Event + annotation too."""
+        cluster, clock, _ds = make_fleet(spares=0)
+        recorder = EventRecorder()
+        manager, _ = make_manager(cluster, clock, recorder)
+        condemn(cluster, "s0-h0")
+        policy = RemediationPolicySpec(enable=True)
+        policy.detection.not_ready_grace_seconds = 0
+        apply(manager, policy)
+        node = cluster.get_node("s0-h0")
+        assert KEYS.condemned_annotation in node.metadata.annotations
+        assert rem_state(cluster, "s0-h0") == str(RemediationState.FAILED)
+
+    def test_recovered_node_clears_condemned_record(self):
+        cluster, clock, _ds = make_fleet(spares=0)
+        manager, _ = make_manager(cluster, clock)
+        condemn(cluster, "s0-h0")
+        policy = make_policy()
+        apply(manager, policy)
+        assert rem_state(cluster, "s0-h0") \
+            == str(RemediationState.RECONFIGURE_REQUIRED)
+        # out-of-band repair + re-arm mid-reconfiguration
+        cluster.set_node_ready("s0-h0", True)
+        cluster.patch_node_annotations(
+            "s0-h0", {KEYS.rearm_annotation: TRUE_STRING})
+        apply(manager, policy)
+        assert rem_state(cluster, "s0-h0") \
+            == str(RemediationState.REVALIDATE_REQUIRED)
+        apply(manager, policy, passes=3)
+        node = cluster.get_node("s0-h0")
+        assert rem_state(cluster, "s0-h0") == ""
+        assert KEYS.condemned_annotation not in node.metadata.annotations
+        assert not node.is_unschedulable()
+
+
+class TestRemapFlow:
+    def test_full_remap_onto_ready_spare(self):
+        cluster, clock, _ds = make_fleet(spares=1)
+        recorder = EventRecorder()
+        manager, reconfigurer = make_manager(cluster, clock, recorder)
+        condemn(cluster, "s0-h0")
+        policy = make_policy(settle_seconds=300)
+        apply(manager, policy, passes=2)
+        # the spare joined pool-0 (spare label off, settle stamp on)...
+        spare = cluster.get_node("spare-0")
+        assert spare.metadata.labels.get(GKE_NODEPOOL_LABEL) == "pool-0"
+        assert TKEYS.spare_pool_label not in spare.metadata.labels
+        assert TKEYS.remapped_at_annotation in spare.metadata.annotations
+        # ...the condemned node was released and parked...
+        victim = cluster.get_node("s0-h0")
+        assert GKE_NODEPOOL_LABEL not in victim.metadata.labels
+        assert victim.metadata.annotations.get(
+            TKEYS.released_from_annotation) == "pool-0"
+        assert rem_state(cluster, "s0-h0") == str(RemediationState.FAILED)
+        # ...the slice is whole again (2 hosts), and metrics recorded it
+        topo = SliceTopology.from_nodes(cluster.list_nodes())
+        assert {n.metadata.name for n in topo.slices["pool-0"].nodes} \
+            == {"s0-h1", "spare-0"}
+        assert reconfigurer.reconfigurations_total == 1
+        assert reconfigurer.drain_remap_durations()
+        assert any("Joined slice pool-0" in e.message
+                   for e in recorder.events)
+
+    def test_settle_stamp_clears_after_window(self):
+        cluster, clock, _ds = make_fleet(spares=1)
+        manager, _ = make_manager(cluster, clock)
+        policy = make_policy(settle_seconds=30)
+        condemn(cluster, "s0-h0")
+        apply(manager, policy, passes=2)
+        spare = cluster.get_node("spare-0")
+        assert TKEYS.remapped_at_annotation in spare.metadata.annotations
+        clock.advance(31.0)
+        apply(manager, policy)
+        spare = cluster.get_node("spare-0")
+        assert TKEYS.remapped_at_annotation \
+            not in spare.metadata.annotations
+
+    def test_spare_waits_for_target_revision(self):
+        """Joint planning: a spare still carrying the OLD revision (or
+        not yet upgrade-done) must not join — the remap waits for the
+        upgrade to finish while the spare is out of the slice."""
+        cluster, clock, _ds = make_fleet(spares=1)
+        # roll the DS: the spare's pod is now outdated
+        cluster.bump_daemon_set_revision(NS, "libtpu", "new2")
+        manager, _ = make_manager(cluster, clock)
+        condemn(cluster, "s0-h0")
+        policy = make_policy()
+        apply(manager, policy, passes=2)
+        spare = cluster.get_node("spare-0")
+        # reserved but NOT joined (pending)
+        assert TKEYS.reserved_for_annotation in spare.metadata.annotations
+        assert GKE_NODEPOOL_LABEL not in spare.metadata.labels
+        assert rem_state(cluster, "s0-h0") \
+            == str(RemediationState.RECONFIGURE_REQUIRED)
+        # the spare's upgrade completes (pod restarted on the target)
+        cluster.delete_pod(NS, "libtpu-spare-0")
+        clock.advance(10.0)
+        cluster.step()
+        apply(manager, policy, passes=2)
+        spare = cluster.get_node("spare-0")
+        assert spare.metadata.labels.get(GKE_NODEPOOL_LABEL) == "pool-0"
+        assert rem_state(cluster, "s0-h0") == str(RemediationState.FAILED)
+
+    def test_provision_timeout_falls_back_to_degraded(self):
+        cluster, clock, ds = make_fleet(spares=1)
+        cluster.bump_daemon_set_revision(NS, "libtpu", "new2")
+        manager, reconfigurer = make_manager(cluster, clock)
+        condemn(cluster, "s0-h0")
+        policy = make_policy(spare_provision_timeout_seconds=60)
+        apply(manager, policy, passes=2)
+        clock.advance(61.0)
+        apply(manager, policy)
+        # reservation abandoned, degraded admitted, node released (the
+        # HEAL path may immediately re-book the spare for the degraded
+        # slice — correct: the interim shape is documented either way)
+        degraded = decode_degraded_slices(
+            cluster.list_daemon_sets(NS)[0].metadata.annotations.get(
+                TKEYS.degraded_slices_annotation, ""))
+        assert degraded == {"pool-0": ("s0-h0",)}
+        assert reconfigurer.degraded_admissions_total == 1
+        assert rem_state(cluster, "s0-h0") == str(RemediationState.FAILED)
+
+    def test_degraded_admission_and_late_spare_heal(self):
+        cluster, clock, _ds = make_fleet(spares=0)
+        recorder = EventRecorder()
+        manager, reconfigurer = make_manager(cluster, clock, recorder)
+        condemn(cluster, "s0-h0")
+        policy = make_policy()
+        apply(manager, policy, passes=2)
+        degraded = decode_degraded_slices(
+            cluster.list_daemon_sets(NS)[0].metadata.annotations.get(
+                TKEYS.degraded_slices_annotation, ""))
+        assert degraded == {"pool-0": ("s0-h0",)}
+        assert any("degraded shape" in e.message for e in recorder.events)
+        victim = cluster.get_node("s0-h0")
+        assert GKE_NODEPOOL_LABEL not in victim.metadata.labels
+        # a spare appears later: the slice heals back to full shape
+        labels = tpu_labels()
+        labels[TKEYS.spare_pool_label] = TRUE_STRING
+        labels[UKEYS.state_label] = str(UpgradeState.DONE)
+        cluster.seed_node_with_ds_pod(
+            Node(metadata=ObjectMeta(name="spare-9", labels=labels)),
+            NS, "libtpu", revision_hash="new")
+        apply(manager, policy, passes=2)
+        spare = cluster.get_node("spare-9")
+        assert spare.metadata.labels.get(GKE_NODEPOOL_LABEL) == "pool-0"
+        assert TKEYS.degraded_slices_annotation not in \
+            cluster.list_daemon_sets(NS)[0].metadata.annotations
+        assert reconfigurer.degraded_healed_total == 1
+
+    def test_no_spare_and_degraded_disallowed_waits(self):
+        cluster, clock, _ds = make_fleet(spares=0)
+        manager, _ = make_manager(cluster, clock)
+        condemn(cluster, "s0-h0")
+        apply(manager, make_policy(allow_degraded=False), passes=3)
+        assert rem_state(cluster, "s0-h0") \
+            == str(RemediationState.RECONFIGURE_REQUIRED)
+        node = cluster.get_node("s0-h0")
+        assert node.metadata.labels.get(GKE_NODEPOOL_LABEL) == "pool-0"
+
+    def test_crash_residue_join_without_release_resumes(self):
+        """Crash between the spare's join and the condemned node's
+        release: the resumed pass must finish the release from the
+        remapped-at marker instead of booking a second spare."""
+        cluster, clock, _ds = make_fleet(spares=2)
+        manager, reconfigurer = make_manager(cluster, clock)
+        condemn(cluster, "s0-h0")
+        # hand-commit the join (what a crashed pass left behind)
+        cluster.patch_node_meta(
+            "spare-0",
+            labels={GKE_NODEPOOL_LABEL: "pool-0",
+                    TKEYS.spare_pool_label: None},
+            annotations={TKEYS.remapped_at_annotation:
+                         f"{int(clock.now())}:s0-h0"})
+        cluster.patch_node_labels(
+            "s0-h0",
+            {KEYS.state_label: str(RemediationState.RECONFIGURE_REQUIRED)})
+        cluster.patch_node_annotations(
+            "s0-h0", {KEYS.condemned_annotation: str(int(clock.now()))})
+        apply(manager, make_policy())
+        victim = cluster.get_node("s0-h0")
+        assert GKE_NODEPOOL_LABEL not in victim.metadata.labels
+        assert rem_state(cluster, "s0-h0") == str(RemediationState.FAILED)
+        # the second spare was never touched
+        other = cluster.get_node("spare-1")
+        assert TKEYS.reserved_for_annotation \
+            not in other.metadata.annotations
+        assert reconfigurer.spares_reserved_total == 0
+
+    def test_two_condemned_members_take_two_spares(self):
+        cluster, clock, _ds = make_fleet(n_slices=2, hosts=2, spares=2)
+        manager, reconfigurer = make_manager(cluster, clock)
+        condemn(cluster, "s0-h0")
+        condemn(cluster, "s1-h1")
+        apply(manager, make_policy(), passes=3)
+        topo = SliceTopology.from_nodes(cluster.list_nodes())
+        assert len(topo.slices["pool-0"].nodes) == 2
+        assert len(topo.slices["pool-1"].nodes) == 2
+        assert reconfigurer.reconfigurations_total == 2
+        joined = {n.metadata.name for n in cluster.list_nodes()
+                  if n.metadata.name.startswith("spare-")
+                  and GKE_NODEPOOL_LABEL in n.metadata.labels}
+        assert joined == {"spare-0", "spare-1"}
+
+
+class TestJointPlanning:
+    def test_slice_planner_prioritizes_reserved_spares(self):
+        from tpu_operator_libs.topology.planner import SlicePlanner
+        from helpers import make_env, make_state_manager
+
+        env = make_env()
+        ds = DaemonSetBuilder("libtpu", namespace=NS) \
+            .with_labels(dict(RUNTIME_LABELS)).with_desired_scheduled(3) \
+            .with_revision_hash("new").create(env.cluster)
+        for name, labels in (
+                ("a-node", tpu_labels("pool-0")),
+                ("b-node", tpu_labels("pool-1")),
+                ("z-spare", {**tpu_labels(),
+                             TKEYS.spare_pool_label: TRUE_STRING})):
+            node = NodeBuilder(name).with_labels(labels) \
+                .with_upgrade_state(env.keys,
+                                    UpgradeState.UPGRADE_REQUIRED) \
+                .create(env.cluster)
+            PodBuilder(f"libtpu-{name}", namespace=NS).on_node(node) \
+                .owned_by(ds).with_revision_hash("old").create(env.cluster)
+        env.cluster.patch_node_annotations(
+            "z-spare",
+            {TKEYS.reserved_for_annotation: "pool-9/dead-h0:123"})
+        mgr = make_state_manager(env)
+        state = mgr.build_state(NS, RUNTIME_LABELS)
+        candidates = state.bucket(UpgradeState.UPGRADE_REQUIRED)
+        planner = SlicePlanner(topology_keys=TKEYS)
+        planned = planner.plan(candidates, 1, state)
+        # budget 1: the reserved spare wins the only slot despite
+        # sorting last by name
+        assert [ns.node.metadata.name for ns in planned] == ["z-spare"]
+
+    def test_canary_wave_passes_reserved_spares_through(self):
+        from tpu_operator_libs.topology.planner import CanaryWavePlanner
+        from tpu_operator_libs.upgrade.state_manager import FlatPlanner
+        from helpers import make_env, make_state_manager
+
+        env = make_env()
+        ds = DaemonSetBuilder("libtpu", namespace=NS) \
+            .with_labels(dict(RUNTIME_LABELS)).with_desired_scheduled(2) \
+            .with_revision_hash("new").create(env.cluster)
+        for name in ("n0", "spare-0"):
+            node = NodeBuilder(name).with_upgrade_state(
+                env.keys, UpgradeState.UPGRADE_REQUIRED).create(env.cluster)
+            PodBuilder(f"libtpu-{name}", namespace=NS).on_node(node) \
+                .owned_by(ds).with_revision_hash("old").create(env.cluster)
+        mgr = make_state_manager(env)
+        state = mgr.build_state(NS, RUNTIME_LABELS)
+        candidates = state.bucket(UpgradeState.UPGRADE_REQUIRED)
+        gated = CanaryWavePlanner(
+            FlatPlanner(), cohort=frozenset({"n0"}),
+            passthrough=frozenset({"spare-0"}))
+        planned = gated.plan(candidates, 4, state)
+        assert {ns.node.metadata.name for ns in planned} \
+            == {"n0", "spare-0"}
+
+
+class TestFailedUpgradeTakeover:
+    def _wedged_failed_upgrade(self):
+        cluster, clock, _ds = make_fleet(spares=0)
+        cluster.set_node_ready("s0-h0", False)
+        cluster.patch_node_labels(
+            "s0-h0", {UKEYS.state_label: str(UpgradeState.FAILED)})
+        manager, _ = make_manager(cluster, clock)
+        return cluster, clock, manager
+
+    def test_takeover_detects_wedge_on_upgrade_failed_node(self):
+        cluster, clock, manager = self._wedged_failed_upgrade()
+        apply(manager, make_policy(), passes=1)
+        assert rem_state(cluster, "s0-h0") == str(RemediationState.WEDGED)
+        # the ladder takes it from there (quarantine cordon next pass)
+        apply(manager, make_policy(), passes=1)
+        assert rem_state(cluster, "s0-h0") \
+            == str(RemediationState.CORDON_REQUIRED)
+
+    def test_without_takeover_upgrade_failed_is_left_alone(self):
+        cluster, clock, manager = self._wedged_failed_upgrade()
+        apply(manager, make_policy(take_over_failed_upgrades=False),
+              passes=2)
+        assert rem_state(cluster, "s0-h0") == ""
+
+    def test_upgrade_machine_holds_failed_recovery_under_skip(self):
+        """The other half of the takeover contract: while the node
+        carries the skip label (remediation quarantine), the upgrade
+        machine's FAILED recovery must not fire."""
+        from tpu_operator_libs.api.upgrade_policy import UpgradePolicySpec
+        from helpers import make_env, make_state_manager
+
+        env = make_env()
+        ds = DaemonSetBuilder("libtpu", namespace=NS) \
+            .with_labels(dict(RUNTIME_LABELS)).with_desired_scheduled(1) \
+            .with_revision_hash("new").create(env.cluster)
+        node = NodeBuilder("n0") \
+            .with_upgrade_state(env.keys, UpgradeState.FAILED) \
+            .unschedulable().create(env.cluster)
+        PodBuilder("libtpu-n0", namespace=NS).on_node(node) \
+            .owned_by(ds).with_revision_hash("new").create(env.cluster)
+        env.cluster.patch_node_labels(
+            "n0", {env.keys.skip_label: TRUE_STRING})
+        mgr = make_state_manager(env)
+        policy = UpgradePolicySpec(auto_upgrade=True)
+        mgr.apply_state(mgr.build_state(NS, RUNTIME_LABELS), policy)
+        assert env.state_of("n0") == str(UpgradeState.FAILED)
+        # skip cleared (quarantine lifted): recovery proceeds
+        env.cluster.patch_node_labels("n0", {env.keys.skip_label: None})
+        mgr.apply_state(mgr.build_state(NS, RUNTIME_LABELS), policy)
+        assert env.state_of("n0") == str(UpgradeState.UNCORDON_REQUIRED)
+
+
+class TestObservability:
+    def test_observe_topology_exports_metrics(self):
+        cluster, clock, _ds = make_fleet(spares=2)
+        manager, reconfigurer = make_manager(cluster, clock)
+        condemn(cluster, "s0-h0")
+        apply(manager, make_policy(), passes=2)
+        registry = MetricsRegistry()
+        observe_topology(registry, reconfigurer, cluster.list_nodes())
+        labels = {"driver": "libtpu"}
+        assert registry.get("topology_reconfigurations_total",
+                            labels) == 1
+        # one spare joined, one remains in the pool, unreserved
+        assert registry.get("topology_spare_pool_size", labels) == 1
+        assert registry.get("topology_spare_pool_in_use", labels) == 0
+        stats = registry.histogram_stats(
+            "topology_time_to_remapped_seconds", labels)
+        assert stats is not None and stats[0] == 1
+        text = registry.render_prometheus()
+        assert "tpu_upgrade_topology_spare_pool_size" in text
+
+    def test_cluster_status_topology_block(self):
+        from tpu_operator_libs.api.upgrade_policy import UpgradePolicySpec
+        from tpu_operator_libs.upgrade.state_manager import (
+            ClusterUpgradeStateManager,
+        )
+
+        cluster, clock, _ds = make_fleet(spares=2)
+        cluster.patch_daemon_set_annotations(
+            NS, "libtpu",
+            {TKEYS.degraded_slices_annotation: "pool-1:s1-h0"})
+        cluster.patch_node_annotations(
+            "spare-0", {TKEYS.reserved_for_annotation: "pool-1/s1-h0:1"})
+        mgr = ClusterUpgradeStateManager(
+            cluster, UKEYS, clock=clock, async_workers=False,
+            poll_interval=0.0)
+        status = mgr.cluster_status(mgr.build_state(NS, RUNTIME_LABELS))
+        assert status["topology"]["sparePool"] == {"size": 2, "inUse": 1}
+        assert status["topology"]["degradedSlices"] == {
+            "pool-1": ["s1-h0"]}
+        assert UpgradePolicySpec  # imported for policy parity elsewhere
+
+    def test_remediation_status_counts_condemned(self):
+        cluster, clock, _ds = make_fleet(spares=1)
+        manager, _ = make_manager(cluster, clock)
+        condemn(cluster, "s0-h0")
+        snapshot = apply(manager, make_policy())
+        status = manager.remediation_status(
+            manager.build_state(NS, RUNTIME_LABELS))
+        assert status["condemnedNodes"] == 1
+        assert status["reconfiguration"]["sparesReserved"] >= 0
+        assert snapshot.namespace == NS
+
+
+class TestReconfigSoakGate:
+    """The standing reconfiguration gate: ten fixed seeds, each killing
+    >= 2 nodes across >= 2 slices mid-rollout under operator crashes and
+    control-plane faults. Every multislice job must hold a legal (full
+    or declared-degraded, never silently short) placement at every
+    observed step, every affected slice must be remapped onto a spare
+    upgraded to the target revision before joining (zero extra
+    cordon/drain cycles), and the fleet must converge with condemned
+    nodes parked out of their slices."""
+
+    @pytest.mark.parametrize("seed", GATE_SEEDS)
+    def test_seed_remaps_and_converges(self, seed):
+        report = run_reconfig_soak(seed)
+        assert report.ok, (
+            f"reconfig seed {report.seed} failed — replay with "
+            f"run_reconfig_soak(seed={report.seed})\n{report.report_text}")
+        assert "node-kill" in report.fault_kinds
+        assert report.crashes_fired >= 1
+        assert report.operator_incarnations >= 2
+        # the designed arc was actually walked
+        assert any("-> reconfigure-required" in line
+                   for line in report.trace)
+        assert any("released from condemned node" in line
+                   for line in report.trace)
+
+
+@pytest.mark.soak
+@pytest.mark.slow
+class TestReconfigSoakExtended:
+    """Long randomized reconfiguration soak, outside tier-1 (`-m soak`):
+
+        CHAOS_SEEDS=100,101 CHAOS_STEPS=2400 pytest -m soak
+    """
+
+    def test_randomized_soak(self):
+        from tpu_operator_libs.chaos import ReconfigChaosConfig
+
+        raw = os.environ.get("CHAOS_SEEDS", "")
+        seeds = ([int(s) for s in raw.split(",") if s.strip()]
+                 or list(range(1, 26)))
+        steps = int(os.environ.get("CHAOS_STEPS", "1200"))
+        config = ReconfigChaosConfig(max_steps=steps)
+        failed = []
+        for seed in seeds:
+            report = run_reconfig_soak(seed, config)
+            if not report.ok:
+                failed.append(report)
+        assert not failed, "\n\n".join(r.report_text for r in failed)

@@ -107,12 +107,13 @@ REMEDIATION_SPEC = DiagramSpec(
         HEALTHY: 0, "wedged": 1, "cordon-required": 2,
         "drain-required": 3, "runtime-restart-required": 4,
         "reboot-required": 5, "revalidate-required": 6,
-        "uncordon-required": 7,
+        "uncordon-required": 7, "reconfigure-required": 8,
     },
     fail_name="remediation-failed",
     fail_rank=3.5,
     fill={HEALTHY: "#e3f4e3", "wedged": "#fdf3d8",
-          "remediation-failed": "#fbe9e7"},
+          "remediation-failed": "#fbe9e7",
+          "reconfigure-required": "#fdf3d8"},
 )
 
 

@@ -190,6 +190,161 @@ def rollback_schema() -> dict[str, Any]:
     }
 
 
+def wedge_detection_schema() -> dict[str, Any]:
+    """WedgeDetectionSpec (api/remediation_policy.py)."""
+    return {
+        "type": "object",
+        "description": "Thresholds of the built-in wedge detectors.",
+        "properties": {
+            "notReadyGraceSeconds": {
+                "type": "integer",
+                "minimum": 0,
+                "default": 300,
+                "description": "Seconds a node may report NotReady "
+                               "before it counts as wedged.",
+            },
+            "podRestartThreshold": {
+                "type": "integer",
+                "minimum": 1,
+                "default": 10,
+                "description": "Restart count beyond which a not-ready "
+                               "runtime container is a crash loop.",
+            },
+            "terminatingStuckSeconds": {
+                "type": "integer",
+                "minimum": 0,
+                "default": 600,
+                "description": "Seconds a runtime pod may sit "
+                               "Terminating before it counts as stuck.",
+            },
+            "unhealthyConditionTypes": {
+                "type": "array",
+                "items": {"type": "string"},
+                "description": "Node condition types whose status != "
+                               "True mark the node wedged immediately.",
+            },
+        },
+    }
+
+
+def reconfiguration_schema() -> dict[str, Any]:
+    """ReconfigurationPolicySpec (degraded-slice topology
+    reconfiguration — the Ironwood OCS analogue)."""
+    return {
+        "type": "object",
+        "description": "Remap a condemned node's ICI slice onto a spare "
+                       "host (or admit a documented degraded shape) "
+                       "instead of parking the slice on its repair.",
+        "properties": {
+            "enable": {
+                "type": "boolean",
+                "default": False,
+                "description": "Master switch; when false condemned "
+                               "nodes park in remediation-failed with "
+                               "their slice down.",
+            },
+            "spareProvisionTimeoutSeconds": {
+                "type": "integer",
+                "minimum": 0,
+                "default": 1800,
+                "description": "Seconds a reserved spare may take to "
+                               "reach the target revision before the "
+                               "slice falls back to a degraded "
+                               "admission; 0 waits forever.",
+            },
+            "settleSeconds": {
+                "type": "integer",
+                "minimum": 0,
+                "default": 120,
+                "description": "Seconds a freshly remapped slice keeps "
+                               "its multislice sticky-down membership "
+                               "while its job's pods reschedule.",
+            },
+            "allowDegraded": {
+                "type": "boolean",
+                "default": True,
+                "description": "Permit documented degraded shapes when "
+                               "no spare is available.",
+            },
+            "takeOverFailedUpgrades": {
+                "type": "boolean",
+                "default": True,
+                "description": "Let remediation take over nodes parked "
+                               "in upgrade-failed whose wedge signal "
+                               "persists (dead hardware mid-rollout).",
+            },
+        },
+    }
+
+
+def remediation_policy_schema() -> dict[str, Any]:
+    """RemediationPolicySpec (api/remediation_policy.py): the
+    unplanned-fault machine's declarative surface."""
+    return {
+        "type": "object",
+        "description": "Auto-remediation policy for wedged nodes "
+                       "(detection, escalation ladder, budgets, slice "
+                       "reconfiguration).",
+        "properties": {
+            "enable": {
+                "type": "boolean",
+                "default": False,
+                "description": "Global switch; when false the "
+                               "remediation machine is a no-op.",
+            },
+            "maxConcurrent": {
+                "type": "integer",
+                "minimum": 0,
+                "default": 1,
+                "description": "Nodes actively remediated concurrently; "
+                               "0 means no limit.",
+            },
+            "maxUnavailable": _int_or_string(
+                "Availability budget for quarantining nodes that are "
+                "still serving; already-unavailable nodes are exempt.",
+                default="10%"),
+            "restartAttempts": {
+                "type": "integer",
+                "minimum": 0,
+                "default": 1,
+                "description": "Attempts that run the runtime-restart "
+                               "rung before escalating to reboot.",
+            },
+            "maxAttempts": {
+                "type": "integer",
+                "minimum": 1,
+                "default": 3,
+                "description": "Dispatched recovery attempts before the "
+                               "node parks in remediation-failed.",
+            },
+            "actionTimeoutSeconds": {
+                "type": "integer",
+                "minimum": 0,
+                "default": 600,
+                "description": "Seconds a dispatched restart/reboot may "
+                               "run before the attempt is written off.",
+            },
+            "settleSeconds": {
+                "type": "integer",
+                "minimum": 0,
+                "default": 60,
+                "description": "Seconds the wedge signal must stay "
+                               "clear during revalidation.",
+            },
+            "revalidateTimeoutSeconds": {
+                "type": "integer",
+                "minimum": 0,
+                "default": 900,
+                "description": "Seconds revalidation may churn before "
+                               "the attempt is written off.",
+            },
+            "drain": drain_schema(),
+            "detection": wedge_detection_schema(),
+            "reconfiguration": reconfiguration_schema(),
+        },
+    }
+
+
 def upgrade_policy_schema() -> dict[str, Any]:
     """The embeddable policy spec (DriverUpgradePolicySpec,
     upgrade_spec.go:27-49) with reference defaults: autoUpgrade=false,
@@ -283,6 +438,7 @@ def unified_policy_schema() -> dict[str, Any]:
                                            "DaemonSet.",
                         },
                         "policy": upgrade_policy_schema(),
+                        "remediation": remediation_policy_schema(),
                     },
                 },
             },

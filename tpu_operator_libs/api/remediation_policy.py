@@ -80,6 +80,78 @@ class WedgeDetectionSpec:
 
 
 @dataclass
+class ReconfigurationPolicySpec:
+    """Degraded-slice topology reconfiguration (the Ironwood OCS
+    analogue): when remediation condemns a node, its ICI slice is
+    remapped onto a spare host from the spare pool — or, when no spare
+    exists, admitted as a documented degraded shape — instead of parking
+    the whole slice on the node's repair. Consumed by
+    :class:`~tpu_operator_libs.topology.reconfigurer.SliceReconfigurer`
+    through the remediation machine's ``reconfigure-required`` arc.
+    """
+
+    # Master switch; when False condemned nodes park in
+    # remediation-failed with their slice down (pre-reconfiguration
+    # behavior).
+    enable: bool = False
+    # Seconds a reserved spare may take to reach the target revision
+    # (upgrade-done, pod ready) before the reservation is abandoned and
+    # the slice falls back to a degraded admission; 0 = wait forever.
+    spare_provision_timeout_seconds: int = 1800
+    # Seconds a freshly remapped slice holds its multislice sticky-down
+    # membership (the job's replacement pods are still Pending right
+    # after the remap; without the hold the planner could take a second
+    # member slice in that window).
+    settle_seconds: int = 120
+    # Permit admitting a documented degraded shape when no spare is
+    # available; when False the condemned node waits in
+    # reconfigure-required until a spare appears.
+    allow_degraded: bool = True
+    # Let the remediation machine take over nodes parked in the upgrade
+    # machine's terminal ``upgrade-failed`` state whose wedge signal
+    # persists past its grace window. A node that failed its upgrade
+    # because the hardware died can only be recovered (or condemned and
+    # routed around) by the remediation ladder — without the takeover it
+    # wedges both machines forever. The upgrade machine holds its own
+    # FAILED recovery while the node carries the remediation skip label,
+    # so the two machines never drive the node concurrently.
+    take_over_failed_upgrades: bool = True
+
+    def validate(self) -> None:
+        if self.spare_provision_timeout_seconds < 0:
+            raise PolicyValidationError(
+                "reconfiguration.spareProvisionTimeoutSeconds must be "
+                ">= 0")
+        if self.settle_seconds < 0:
+            raise PolicyValidationError(
+                "reconfiguration.settleSeconds must be >= 0")
+
+    def to_dict(self) -> dict[str, Any]:
+        return {
+            "enable": self.enable,
+            "spareProvisionTimeoutSeconds":
+                self.spare_provision_timeout_seconds,
+            "settleSeconds": self.settle_seconds,
+            "allowDegraded": self.allow_degraded,
+            "takeOverFailedUpgrades": self.take_over_failed_upgrades,
+        }
+
+    @classmethod
+    def from_dict(cls, data: dict[str, Any]) -> "ReconfigurationPolicySpec":
+        return cls(
+            enable=data.get("enable", False),
+            spare_provision_timeout_seconds=data.get(
+                "spareProvisionTimeoutSeconds", 1800),
+            settle_seconds=data.get("settleSeconds", 120),
+            allow_degraded=data.get("allowDegraded", True),
+            take_over_failed_upgrades=data.get(
+                "takeOverFailedUpgrades", True))
+
+    def deep_copy(self) -> "ReconfigurationPolicySpec":
+        return copy.deepcopy(self)
+
+
+@dataclass
 class RemediationPolicySpec:
     """Top-level auto-remediation policy.
 
@@ -118,6 +190,9 @@ class RemediationPolicySpec:
     # drain stage (the cordon still protects new workloads).
     drain: Optional[DrainSpec] = None
     detection: WedgeDetectionSpec = None  # type: ignore[assignment]
+    # Degraded-slice topology reconfiguration after give-up; None
+    # disables it (condemned nodes park with their slice down).
+    reconfiguration: Optional[ReconfigurationPolicySpec] = None
 
     def __post_init__(self) -> None:
         if self.detection is None:
@@ -148,6 +223,8 @@ class RemediationPolicySpec:
         if self.drain is not None:
             self.drain.validate()
         self.detection.validate()
+        if self.reconfiguration is not None:
+            self.reconfiguration.validate()
 
     def to_dict(self) -> dict[str, Any]:
         out: dict[str, Any] = {
@@ -163,6 +240,8 @@ class RemediationPolicySpec:
         }
         if self.drain is not None:
             out["drain"] = self.drain.to_dict()
+        if self.reconfiguration is not None:
+            out["reconfiguration"] = self.reconfiguration.to_dict()
         return out
 
     @classmethod
@@ -181,6 +260,9 @@ class RemediationPolicySpec:
             spec.drain = DrainSpec.from_dict(data["drain"])
         if data.get("detection") is not None:
             spec.detection = WedgeDetectionSpec.from_dict(data["detection"])
+        if data.get("reconfiguration") is not None:
+            spec.reconfiguration = ReconfigurationPolicySpec.from_dict(
+                data["reconfiguration"])
         return spec
 
     def deep_copy(self) -> "RemediationPolicySpec":

@@ -29,13 +29,16 @@ from tpu_operator_libs.chaos.injector import (
 from tpu_operator_libs.chaos.invariants import (
     InvariantMonitor,
     InvariantViolation,
+    ReconfigExpectation,
     RolloutExpectation,
 )
 from tpu_operator_libs.chaos.runner import (
     ChaosConfig,
     ChaosReport,
+    ReconfigChaosConfig,
     run_bad_revision_soak,
     run_chaos_soak,
+    run_reconfig_soak,
 )
 from tpu_operator_libs.chaos.schedule import (
     FAULT_API_BURST,
@@ -43,6 +46,7 @@ from tpu_operator_libs.chaos.schedule import (
     FAULT_CRASHLOOP,
     FAULT_KINDS,
     FAULT_LEADER_LOSS,
+    FAULT_NODE_KILL,
     FAULT_NOT_READY_FLAP,
     FAULT_OPERATOR_CRASH,
     FAULT_PDB_BLOCK,
@@ -62,6 +66,7 @@ __all__ = [
     "FAULT_CRASHLOOP",
     "FAULT_KINDS",
     "FAULT_LEADER_LOSS",
+    "FAULT_NODE_KILL",
     "FAULT_NOT_READY_FLAP",
     "FAULT_OPERATOR_CRASH",
     "FAULT_PDB_BLOCK",
@@ -72,7 +77,10 @@ __all__ = [
     "InvariantMonitor",
     "InvariantViolation",
     "OperatorCrash",
+    "ReconfigChaosConfig",
+    "ReconfigExpectation",
     "RolloutExpectation",
     "run_bad_revision_soak",
     "run_chaos_soak",
+    "run_reconfig_soak",
 ]

@@ -24,6 +24,7 @@ from tpu_operator_libs.chaos.schedule import (
     FAULT_BAD_REVISION,
     FAULT_CRASHLOOP,
     FAULT_LEADER_LOSS,
+    FAULT_NODE_KILL,
     FAULT_NOT_READY_FLAP,
     FAULT_OPERATOR_CRASH,
     FAULT_PDB_BLOCK,
@@ -196,6 +197,8 @@ class ChaosInjector:
         self.installed = False
         self.leader_losses = 0
         self.bad_revisions_rolled = 0
+        self.nodes_killed = 0
+        self.killed_nodes: list[str] = []
 
     # -- installation -----------------------------------------------------
     def install(self) -> None:
@@ -232,6 +235,14 @@ class ChaosInjector:
                 cluster.schedule_at(
                     event.at,
                     lambda e=event: self._inject_bad_revision(e))
+            elif event.kind == FAULT_NODE_KILL:
+                cluster.schedule_at(
+                    event.at, lambda e=event: self._kill_node(e))
+        if any(e.kind == FAULT_NODE_KILL for e in self._schedule.events):
+            # a dead host's kubelet never reports a healthy container:
+            # pods recreated on a killed node crash-loop until the node
+            # is Ready again (it never is — kills do not heal)
+            cluster.gate_pod_ready_on_node_ready()
         if any(e.kind == FAULT_CRASHLOOP for e in self._schedule.events):
             cluster.add_pod_ready_gate(self._ready_gate)
         if any(e.kind == FAULT_BAD_REVISION
@@ -253,6 +264,16 @@ class ChaosInjector:
                     event.target, BAD_REVISION_HASH)
         self._cluster.bump_daemon_set_revision(namespace, name,
                                                BAD_REVISION_HASH)
+
+    def _kill_node(self, event: FaultEvent) -> None:
+        """Permanent NotReady: the node is dead hardware. No heal is
+        ever scheduled — remediation must condemn it and the
+        reconfigurer must route its slice around it."""
+        self.nodes_killed += 1
+        self.killed_nodes.append(event.target)
+        logger.info("chaos: killing node %s (permanent NotReady)",
+                    event.target)
+        self._cluster.set_node_ready(event.target, False)
 
     def _inject_stale(self, event: FaultEvent) -> None:
         try:

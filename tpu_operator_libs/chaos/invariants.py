@@ -47,6 +47,7 @@ from tpu_operator_libs.api.upgrade_policy import (
 )
 from tpu_operator_libs.chaos.injector import consume_transient
 from tpu_operator_libs.consts import (
+    GKE_NODEPOOL_LABEL,
     IN_PROGRESS_STATES,
     LEGAL_EDGES,
     POD_CONTROLLER_REVISION_HASH_LABEL,
@@ -55,6 +56,7 @@ from tpu_operator_libs.consts import (
     WORKLOAD_UNSAFE_STATES,
     RemediationKeys,
     RemediationState,
+    TopologyKeys,
     UpgradeKeys,
     UpgradeState,
 )
@@ -105,6 +107,36 @@ class RolloutExpectation:
 
 
 @dataclass(frozen=True)
+class ReconfigExpectation:
+    """Arms the degraded-slice reconfiguration invariants.
+
+    The monitor learns each slice's full shape (host count per nodepool)
+    at its initial sync, then asserts from watch events alone:
+
+    - **slice-placement**: a slice's member count never drops below its
+      expected shape minus the hosts durably admitted as lost in the
+      runtime DaemonSet's degraded-slices annotation — every multislice
+      job's placement is full or DECLARED degraded, never silently
+      short. (Join-before-release ordering in the reconfigurer makes a
+      correct remap invisible to this check.)
+    - **reconfig-joint-plan**: a node joining a slice it was not an
+      original member of (a remapped spare) must carry a runtime pod on
+      ``target_revision``, must join schedulable, and must never be
+      cordoned again afterwards — the joint plan gave it its one
+      cordon/drain cycle while still out of the slice, so any later
+      cordon is a second disruption the remap was supposed to avoid.
+
+    Condemned→remapped durations are accumulated in
+    ``InvariantMonitor.remap_seconds`` (the report's MTTR-style
+    evidence).
+    """
+
+    topology_keys: TopologyKeys
+    target_revision: str
+    runtime_namespace: str = "tpu-system"
+
+
+@dataclass(frozen=True)
 class InvariantViolation:
     """One broken safety property, with everything needed to replay it."""
 
@@ -124,6 +156,8 @@ class _NodeMirror:
     remediation_state: str = ""
     unschedulable: bool = False
     ready: bool = True
+    pool: str = ""
+    condemned: bool = False
 
 
 @dataclass
@@ -145,6 +179,8 @@ class InvariantMonitor:
     watch_queue_bound: Optional[int] = None
     #: Arms the canary-halt/rollback invariants; None disables them.
     rollout: Optional[RolloutExpectation] = None
+    #: Arms the slice-reconfiguration invariants; None disables them.
+    reconfig: Optional[ReconfigExpectation] = None
 
     violations: list[InvariantViolation] = field(default_factory=list)
     trace: list[str] = field(default_factory=list)
@@ -152,10 +188,12 @@ class InvariantMonitor:
     watch_gaps: int = 0
     cordons_seen: int = 0
     uncordons_seen: int = 0
+    #: condemned→slice-released durations observed (reconfig mode).
+    remap_seconds: list[float] = field(default_factory=list)
 
     def __post_init__(self) -> None:
         self._nodes: dict[str, _NodeMirror] = {}
-        #: node -> revision hash of its runtime pod (rollout mode only).
+        #: node -> revision hash of its runtime pod (rollout/reconfig).
         self._pod_revisions: dict[str, str] = {}
         #: distinct nodes seen failing ON the bad revision.
         self._bad_failed: set[str] = set()
@@ -164,8 +202,35 @@ class InvariantMonitor:
         #: True once a rollback signal (bad pod deleted / non-bad pod
         #: created after halt evidence) has been observed.
         self.rollback_signaled = False
+        # -- reconfig mode bookkeeping --
+        #: live pool membership mirrored from node labels.
+        self._pool_members: dict[str, set[str]] = {}
+        #: full shape per pool, learned at the INITIAL sync.
+        self._pool_expected: dict[str, int] = {}
+        #: original pool membership (initial sync) — anything added to a
+        #: pool beyond this is a remapped spare.
+        self._original_members: dict[str, set[str]] = {}
+        #: nodes that joined a pool as a remapped spare.
+        self._joined: set[str] = set()
+        #: node -> virtual time its condemned annotation first appeared.
+        self._condemned_at: dict[str, float] = {}
+        self._expected_armed = False
         self._watch = self.cluster.watch(max_queue=self.watch_queue_bound)
         self.resync("initial sync")
+
+    def _mirror_of(self, node) -> _NodeMirror:
+        labels = node.metadata.labels
+        return _NodeMirror(
+            upgrade_state=labels.get(self.upgrade_keys.state_label, ""),
+            remediation_state=(labels.get(
+                self.remediation_keys.state_label, "")
+                if self.remediation_keys else ""),
+            unschedulable=node.is_unschedulable(),
+            ready=node.is_ready(),
+            pool=labels.get(GKE_NODEPOOL_LABEL, ""),
+            condemned=(self.remediation_keys is not None
+                       and self.remediation_keys.condemned_annotation
+                       in node.metadata.annotations))
 
     # -- plumbing ---------------------------------------------------------
     def _now(self) -> float:
@@ -192,18 +257,38 @@ class InvariantMonitor:
         nodes = consume_transient(self.cluster.list_nodes)
         fresh: dict[str, _NodeMirror] = {}
         for node in nodes:
-            fresh[node.metadata.name] = _NodeMirror(
-                upgrade_state=node.metadata.labels.get(
-                    self.upgrade_keys.state_label, ""),
-                remediation_state=(node.metadata.labels.get(
-                    self.remediation_keys.state_label, "")
-                    if self.remediation_keys else ""),
-                unschedulable=node.is_unschedulable(),
-                ready=node.is_ready())
+            fresh[node.metadata.name] = self._mirror_of(node)
         self._nodes = fresh
+        if self.reconfig is not None:
+            members: dict[str, set[str]] = {}
+            for name, mirror in fresh.items():
+                if mirror.pool:
+                    members.setdefault(mirror.pool, set()).add(name)
+                if mirror.condemned:
+                    self._condemned_at.setdefault(name, self._now())
+            self._pool_members = members
+            if not self._expected_armed:
+                # the initial sync defines each slice's full shape
+                self._pool_expected = {pool: len(names)
+                                       for pool, names in members.items()}
+                self._original_members = {pool: set(names)
+                                          for pool, names in members.items()}
+                self._expected_armed = True
+            else:
+                # joins hidden by a watch gap are absorbed (no
+                # assertions) but still tracked for the cordon-after-
+                # join check
+                for pool, names in members.items():
+                    extra = names - self._original_members.get(pool, set())
+                    self._joined.update(extra)
+        runtime_ns = None
         if self.rollout is not None:
+            runtime_ns = self.rollout.runtime_namespace
+        elif self.reconfig is not None:
+            runtime_ns = self.reconfig.runtime_namespace
+        if runtime_ns is not None:
             pods = consume_transient(lambda: self.cluster.list_pods(
-                namespace=self.rollout.runtime_namespace))
+                namespace=runtime_ns))
             revisions: dict[str, str] = {}
             for pod in pods:
                 pod_hash = pod.metadata.labels.get(
@@ -246,20 +331,19 @@ class InvariantMonitor:
     def _on_node(self, event_type: str, node) -> None:
         name = node.metadata.name
         if event_type == DELETED:
-            self._nodes.pop(name, None)
+            gone = self._nodes.pop(name, None)
             self._record(f"node {name} deleted")
+            if self.reconfig is not None and gone is not None \
+                    and gone.pool:
+                self._pool_members.get(gone.pool, set()).discard(name)
+                self._check_slice_shape(gone.pool)
             return
-        new = _NodeMirror(
-            upgrade_state=node.metadata.labels.get(
-                self.upgrade_keys.state_label, ""),
-            remediation_state=(node.metadata.labels.get(
-                self.remediation_keys.state_label, "")
-                if self.remediation_keys else ""),
-            unschedulable=node.is_unschedulable(),
-            ready=node.is_ready())
+        new = self._mirror_of(node)
         old = self._nodes.get(name)
         if old is None:
             self._nodes[name] = new
+            if self.reconfig is not None and new.pool:
+                self._pool_members.setdefault(new.pool, set()).add(name)
             self._record(f"node {name} added "
                          f"(upgrade={new.upgrade_state or 'unknown'})")
             return
@@ -267,6 +351,13 @@ class InvariantMonitor:
             if new.unschedulable:
                 self.cordons_seen += 1
                 self._record(f"node {name} cordoned")
+                if self.reconfig is not None and name in self._joined:
+                    self._violate(
+                        "reconfig-joint-plan", name,
+                        "remapped spare cordoned AFTER joining its "
+                        "slice — the joint plan owed it exactly one "
+                        "cordon/drain cycle, taken while it was still "
+                        "out of the slice")
             else:
                 self.uncordons_seen += 1
                 self._record(f"node {name} uncordoned")
@@ -276,6 +367,12 @@ class InvariantMonitor:
         # this very transition ("at any instant" includes the instant
         # the admission label lands)
         self._nodes[name] = new
+        if self.reconfig is not None:
+            if not old.condemned and new.condemned:
+                self._condemned_at.setdefault(name, self._now())
+                self._record(f"node {name} condemned")
+            if old.pool != new.pool:
+                self._on_pool_change(name, old, new)
         if old.upgrade_state != new.upgrade_state:
             self._record(f"node {name} upgrade "
                          f"{old.upgrade_state or 'unknown'} -> "
@@ -287,6 +384,81 @@ class InvariantMonitor:
                          f"{old.remediation_state or 'healthy'} -> "
                          f"{new.remediation_state or 'healthy'}")
             self._check_remediation_edge(name, old, new)
+
+    # -- slice-reconfiguration invariants ---------------------------------
+    def _degraded_lost(self, pool: str) -> int:
+        """Hosts of ``pool`` durably admitted as lost (degraded-slices
+        DaemonSet annotation). Read lazily — only when a shape check
+        needs it."""
+        from tpu_operator_libs.topology.slice_topology import (
+            decode_degraded_slices,
+        )
+
+        assert self.reconfig is not None
+        key = self.reconfig.topology_keys.degraded_slices_annotation
+        daemon_sets = consume_transient(lambda: self.cluster.list_daemon_sets(
+            self.reconfig.runtime_namespace))
+        lost: set[str] = set()
+        for ds in daemon_sets:
+            lost.update(decode_degraded_slices(
+                ds.metadata.annotations.get(key, "")).get(pool, ()))
+        return len(lost)
+
+    def _check_slice_shape(self, pool: str) -> None:
+        """A slice may only be short of its learned full shape by hosts
+        the degraded record declares lost — anything else is a silently
+        short placement."""
+        expected = self._pool_expected.get(pool)
+        if expected is None:
+            return  # pool born after arming (not a managed slice shape)
+        have = len(self._pool_members.get(pool, ()))
+        if have >= expected:
+            return
+        allowed = expected - self._degraded_lost(pool)
+        if have < allowed:
+            self._violate(
+                "slice-placement", pool,
+                f"slice has {have} host(s), expected {expected} with "
+                f"{expected - allowed} declared lost — a member was "
+                f"removed without a spare remap or a degraded "
+                f"admission (silently short placement)")
+
+    def _on_pool_change(self, name: str, old: _NodeMirror,
+                        new: _NodeMirror) -> None:
+        reconfig = self.reconfig
+        if old.pool:
+            self._pool_members.get(old.pool, set()).discard(name)
+        if new.pool:
+            self._pool_members.setdefault(new.pool, set()).add(name)
+        self._record(f"node {name} pool "
+                     f"{old.pool or '-'} -> {new.pool or '-'}")
+        if new.pool and name not in self._original_members.get(
+                new.pool, set()):
+            # a remapped spare joined: the joint plan must have finished
+            # its upgrade (target revision, schedulable) BEFORE the join
+            self._joined.add(name)
+            revision = self._pod_revisions.get(name)
+            if revision != reconfig.target_revision:
+                self._violate(
+                    "reconfig-joint-plan", name,
+                    f"spare joined slice {new.pool} with runtime pod on "
+                    f"revision {revision!r}, not the target "
+                    f"{reconfig.target_revision!r} — it must be "
+                    f"upgraded while still OUT of the slice")
+            if new.unschedulable:
+                self._violate(
+                    "reconfig-joint-plan", name,
+                    f"spare joined slice {new.pool} while cordoned")
+        if old.pool and not new.pool:
+            # release: the shape must already be whole (spare joined
+            # first) or declared degraded
+            self._check_slice_shape(old.pool)
+            condemned_at = self._condemned_at.get(name)
+            if condemned_at is not None:
+                self.remap_seconds.append(self._now() - condemned_at)
+                self._record(
+                    f"slice {old.pool} released from condemned node "
+                    f"{name} after {self._now() - condemned_at:g}s")
 
     def _track_rollout_verdict(self, name: str,
                                new: _NodeMirror) -> None:
@@ -402,6 +574,20 @@ class InvariantMonitor:
                 == self.rollout.runtime_namespace):
             self._on_runtime_pod(event_type, pod)
             return
+        if (self.reconfig is not None and pod.metadata.namespace
+                == self.reconfig.runtime_namespace):
+            # per-node revision mirror feeding the joint-plan check;
+            # runtime DS pods legally land on cordoned nodes
+            pod_hash = pod.metadata.labels.get(
+                POD_CONTROLLER_REVISION_HASH_LABEL)
+            node_name = pod.spec.node_name
+            if pod_hash and node_name:
+                if event_type == DELETED:
+                    if self._pod_revisions.get(node_name) == pod_hash:
+                        del self._pod_revisions[node_name]
+                else:
+                    self._pod_revisions[node_name] = pod_hash
+            return
         if event_type != ADDED:
             return
         if pod.metadata.namespace != self.workload_namespace:
@@ -480,6 +666,13 @@ class InvariantMonitor:
         nodes = consume_transient(self.cluster.list_nodes)
         for node in nodes:
             name = node.metadata.name
+            if self.remediation_keys is not None \
+                    and self.remediation_keys.condemned_annotation \
+                    in node.metadata.annotations:
+                # condemned nodes are INTENTIONALLY left quarantined:
+                # cordoned, parked in remediation-failed, released from
+                # their slice, bookkeeping preserved for the repair crew
+                continue
             if node.is_unschedulable():
                 self._violate(
                     "cordon-pairing", name,

@@ -36,7 +36,10 @@ import logging
 from dataclasses import dataclass, field
 from typing import Optional
 
-from tpu_operator_libs.api.remediation_policy import RemediationPolicySpec
+from tpu_operator_libs.api.remediation_policy import (
+    ReconfigurationPolicySpec,
+    RemediationPolicySpec,
+)
 from tpu_operator_libs.api.upgrade_policy import (
     DrainSpec,
     IntOrString,
@@ -51,12 +54,16 @@ from tpu_operator_libs.chaos.injector import (
 from tpu_operator_libs.chaos.invariants import (
     InvariantMonitor,
     InvariantViolation,
+    ReconfigExpectation,
     RolloutExpectation,
 )
 from tpu_operator_libs.chaos.schedule import FaultSchedule
 from tpu_operator_libs.consts import (
+    GKE_NODEPOOL_LABEL,
     POD_CONTROLLER_REVISION_HASH_LABEL,
     RemediationKeys,
+    RemediationState,
+    TopologyKeys,
     UpgradeKeys,
     UpgradeState,
 )
@@ -76,9 +83,11 @@ from tpu_operator_libs.remediation.state_machine import (
 from tpu_operator_libs.simulate import (
     NS,
     RUNTIME_LABELS,
+    WORKLOAD_NS,
     FleetSpec,
     build_fleet,
     restore_workload_pods,
+    seed_spare_pool,
 )
 from tpu_operator_libs.upgrade.state_manager import (
     BuildStateError,
@@ -196,7 +205,7 @@ class _OperatorIncarnation:
     def __init__(self, cluster: FakeCluster, clock: FakeClock,
                  keys: UpgradeKeys, rem_keys: RemediationKeys,
                  config: ChaosConfig, injector: ChaosInjector,
-                 identity: str) -> None:
+                 identity: str, with_reconfigurer: bool = False) -> None:
         # The event-driven scheduling layer runs INSIDE the gate: both
         # machines carry a live ReconcileNudger (completion nudges +
         # deadline timer wheel + eager slot refill all active), exactly
@@ -220,10 +229,25 @@ class _OperatorIncarnation:
         rem_provider = CrashingStateProvider(
             cluster, rem_keys, None, clock,  # type: ignore[arg-type]
             sync_timeout=5.0, poll_interval=1.0, fuse=injector.fuse)
+        reconfigurer = None
+        if with_reconfigurer:
+            # the remap's durable writes run through the same crash
+            # fuse as the state machines' label commits, so operator
+            # crashes land INSIDE the reserve→join→release sequence
+            from tpu_operator_libs.topology.reconfigurer import (
+                SliceReconfigurer,
+            )
+
+            reconfigurer = SliceReconfigurer(
+                cluster,
+                TopologyKeys(driver=keys.driver, domain=keys.domain),
+                remediation_keys=rem_keys, upgrade_keys=keys,
+                clock=clock, nudger=self.nudger,
+                guard=injector.fuse.guard)
         self.remediation = NodeRemediationManager(
             cluster, rem_keys, upgrade_keys=keys, clock=clock,
             provider=rem_provider, poll_interval=1.0, sync_timeout=5.0,
-            nudger=self.nudger)
+            nudger=self.nudger, reconfigurer=reconfigurer)
         self.elector = LeaderElector(
             cluster,
             LeaderElectionConfig(
@@ -608,6 +632,378 @@ def run_bad_revision_soak(seed: int,
             invariant="harness", at=clock.now(), subject="injector",
             detail="no operator crash fired — the schedule's crash "
                    "events never detonated"))
+
+    report = ChaosReport(
+        seed=seed,
+        converged=is_converged,
+        violations=list(monitor.violations),
+        fault_kinds=tuple(sorted(schedule.kinds)),
+        crashes_fired=injector.crashes_fired,
+        leader_handovers=handovers,
+        operator_incarnations=incarnations,
+        watch_gaps=monitor.watch_gaps,
+        total_seconds=clock.now(),
+        steps=steps,
+        reconciles=reconciles,
+        trace=list(monitor.trace))
+    report.report_text = "\n".join(
+        [schedule.describe(), monitor.report(seed=seed)])
+    if not report.ok:
+        logger.error("%s", report.report_text)
+    return report
+
+
+@dataclass
+class ReconfigChaosConfig(ChaosConfig):
+    """Knobs of one reconfiguration soak episode.
+
+    Defaults trade horizon for ladder speed: the victims must walk the
+    FULL give-up path (grace → restart rung timeout → reboot rung
+    timeout → condemned) before the remap even starts, so the ladder
+    timeouts are tightened rather than the horizon stretched."""
+
+    #: Permanent node kills, spread across >= 2 distinct slices.
+    kills: int = 2
+    #: Hot-standby spares seeded next to the fleet (>= kills proves the
+    #: full-remap outcome; fewer exercises degraded admissions).
+    spares: int = 2
+
+    def remediation_policy(self) -> RemediationPolicySpec:
+        policy = RemediationPolicySpec(
+            enable=True,
+            max_concurrent=2,
+            max_unavailable="50%",
+            restart_attempts=1,
+            max_attempts=2,
+            action_timeout_seconds=120,
+            settle_seconds=30,
+            revalidate_timeout_seconds=300,
+            drain=DrainSpec(enable=True, force=True,
+                            timeout_seconds=240),
+            reconfiguration=ReconfigurationPolicySpec(
+                enable=True,
+                spare_provision_timeout_seconds=6000,
+                settle_seconds=60,
+                allow_degraded=True,
+                take_over_failed_upgrades=True))
+        policy.detection.not_ready_grace_seconds = 60
+        return policy
+
+    def upgrade_policy(self) -> UpgradePolicySpec:
+        # slice-atomic planning with the multislice constraint live:
+        # the gate must prove the constraint follows the remap instead
+        # of double-counting old+new members
+        return UpgradePolicySpec(
+            auto_upgrade=True,
+            max_parallel_upgrades=0,
+            max_unavailable="50%",
+            topology_mode="slice",
+            max_unavailable_slices_per_job=1,
+            drain=DrainSpec(enable=True, force=True,
+                            timeout_seconds=300))
+
+
+def _restore_workload_pods_by_pool(cluster: FakeCluster,
+                                   fleet: FleetSpec,
+                                   topology_keys: TopologyKeys) -> None:
+    """Pool-membership-based JobSet stand-in for reconfig episodes.
+
+    simulate.restore_workload_pods addresses hosts by their ORIGINAL
+    names (``s<slice>-h<host>``), which goes stale the moment a remap
+    swaps a spare in. This variant derives each member slice's hosts
+    from the nodepool label and recreates the job replica once the
+    slice is whole — full shape, or its documented degraded shape —
+    and every current member is schedulable + Ready.
+    """
+    from tpu_operator_libs.simulate import JOBSET_NAME_LABEL
+    from tpu_operator_libs.topology.slice_topology import (
+        decode_degraded_slices,
+    )
+
+    if not fleet.multislice_jobs:
+        return
+    nodes = cluster.list_nodes()
+    by_pool: dict[str, list] = {}
+    for node in nodes:
+        pool = node.metadata.labels.get(GKE_NODEPOOL_LABEL)
+        if pool:
+            by_pool.setdefault(pool, []).append(node)
+    lost: dict[str, tuple[str, ...]] = {}
+    for ds in cluster.list_daemon_sets(NS):
+        lost.update(decode_degraded_slices(ds.metadata.annotations.get(
+            topology_keys.degraded_slices_annotation, "")))
+    existing = {p.metadata.name
+                for p in cluster.list_pods(namespace=WORKLOAD_NS)}
+    from tpu_operator_libs.k8s.objects import (
+        ContainerStatus,
+        ObjectMeta,
+        Pod,
+        PodPhase,
+        PodSpec,
+        PodStatus,
+    )
+
+    for job, slice_ids in fleet.multislice_jobs:
+        for s in slice_ids:
+            pod_name = f"{job}-s{s}"
+            if pod_name in existing:
+                continue
+            pool = f"pool-{s}"
+            members = sorted(by_pool.get(pool, []),
+                             key=lambda n: n.metadata.name)
+            expected = fleet.hosts_per_slice - len(lost.get(pool, ()))
+            if len(members) < expected or expected <= 0:
+                continue  # slice still short of its (declared) shape
+            if any(n.is_unschedulable() or not n.is_ready()
+                   for n in members):
+                continue  # replica stays Pending until the slice is back
+            cluster.add_pod(Pod(
+                metadata=ObjectMeta(
+                    name=pod_name, namespace=WORKLOAD_NS,
+                    labels={JOBSET_NAME_LABEL: job}),
+                spec=PodSpec(node_name=members[0].metadata.name),
+                status=PodStatus(
+                    phase=PodPhase.RUNNING,
+                    container_statuses=[
+                        ContainerStatus(name="worker", ready=True)])))
+
+
+def run_reconfig_soak(seed: int,
+                      config: Optional[ReconfigChaosConfig] = None,
+                      ) -> ChaosReport:
+    """The degraded-slice reconfiguration gate: k nodes are killed
+    permanently across >= 2 slices mid-rollout (plus operator crashes
+    and control-plane faults), and the system must route every affected
+    slice around its dead host instead of parking it.
+
+    What the episode proves, via the monitor's invariants plus the
+    convergence check:
+
+    - every multislice job holds a legal placement at every observed
+      step — each member slice is full, actively being disrupted under
+      budget, or DECLARED degraded; never silently short
+      (``slice-placement``);
+    - with spares available, each affected slice is remapped: the spare
+      is upgraded to the target revision while still out of the slice
+      and is never cordoned again after joining — zero extra
+      cordon/drain cycles versus the joint plan
+      (``reconfig-joint-plan``);
+    - condemned nodes end parked in remediation-failed, released from
+      their pools, with the ``NodeCondemned`` record stamped, and every
+      surviving + spare host converges to upgrade-done on the final
+      revision.
+
+    Deterministic in ``seed``; time-to-remapped samples ride the report
+    trace (and ``monitor.remap_seconds``).
+    """
+    config = config or ReconfigChaosConfig()
+    fleet = FleetSpec(
+        n_slices=config.n_slices,
+        hosts_per_slice=config.hosts_per_slice,
+        pod_recreate_delay=config.pod_recreate_delay,
+        pod_ready_delay=config.pod_ready_delay,
+        multislice_jobs=(
+            ("chaos-job", tuple(range(config.n_slices))),))
+    cluster, clock, keys = build_fleet(fleet)
+    rem_keys = RemediationKeys()
+    topo_keys = TopologyKeys(driver=keys.driver, domain=keys.domain)
+    seed_spare_pool(cluster, fleet, config.spares)
+    node_names = [n.metadata.name for n in cluster.list_nodes()]
+
+    slice_members: dict[str, list[str]] = {}
+    for node in cluster.list_nodes():
+        pool = node.metadata.labels.get(GKE_NODEPOOL_LABEL)
+        if pool:
+            slice_members.setdefault(pool, []).append(node.metadata.name)
+    schedule = FaultSchedule.generate_reconfig(
+        seed, slice_members, horizon=config.horizon, kills=config.kills)
+    injector = ChaosInjector(cluster, schedule,
+                             lease_namespace=config.lease_namespace,
+                             lease_name=config.lease_name)
+    injector.install()
+    # rollout #2 mid-horizon, exactly like the main soak: kills land on
+    # a mid-rollout fleet and spares must chase the FINAL revision
+    cluster.schedule_at(
+        config.horizon / 2.0,
+        lambda: cluster.bump_daemon_set_revision(NS, "libtpu",
+                                                 FINAL_REVISION))
+
+    upgrade_policy = config.upgrade_policy()
+    remediation_policy = config.remediation_policy()
+    monitor = InvariantMonitor(
+        cluster=cluster, upgrade_keys=keys, remediation_keys=rem_keys,
+        # slice planner may legally overdraw; the placement/joint-plan
+        # invariants are this gate's teeth
+        max_unavailable=None,
+        remediation_max_unavailable=None,
+        max_parallel_upgrades=0,
+        reconfig=ReconfigExpectation(
+            topology_keys=topo_keys,
+            target_revision=FINAL_REVISION,
+            runtime_namespace=NS))
+
+    incarnations = 1
+    handovers = 0
+    reconciles = 0
+    op = _OperatorIncarnation(cluster, clock, keys, rem_keys, config,
+                              injector, identity="operator-1",
+                              with_reconfigurer=True)
+
+    def next_incarnation(reason: str) -> _OperatorIncarnation:
+        nonlocal incarnations
+        incarnations += 1
+        injector.fuse.reset()
+        monitor.trace.append(
+            f"[t={clock.now():g}] operator restart #{incarnations} "
+            f"({reason}) — rebuilding managers from cluster state alone")
+        return _OperatorIncarnation(
+            cluster, clock, keys, rem_keys, config, injector,
+            identity=f"operator-{incarnations}", with_reconfigurer=True)
+
+    def converged() -> bool:
+        try:
+            nodes = cluster.list_nodes()
+            pods = cluster.list_pods(namespace=NS)
+            workload = cluster.list_pods(namespace=WORKLOAD_NS)
+            daemon_sets = cluster.list_daemon_sets(NS)
+        except (ApiServerError, TimeoutError):
+            return False
+        if len(nodes) != len(node_names):
+            return False
+        pods_by_node: dict[str, list] = {}
+        for pod in pods:
+            if pod.controller_owner() is not None and pod.spec.node_name:
+                pods_by_node.setdefault(pod.spec.node_name, []).append(pod)
+        pools: dict[str, list] = {}
+        for node in nodes:
+            labels = node.metadata.labels
+            condemned = rem_keys.condemned_annotation \
+                in node.metadata.annotations
+            if condemned:
+                # parked for repair: quarantined, out of its slice
+                if labels.get(rem_keys.state_label) \
+                        != str(RemediationState.FAILED):
+                    return False
+                if not node.is_unschedulable():
+                    return False
+                if labels.get(GKE_NODEPOOL_LABEL):
+                    return False
+                continue
+            if labels.get(keys.state_label) != str(UpgradeState.DONE):
+                return False
+            if labels.get(rem_keys.state_label, ""):
+                return False
+            if keys.skip_label in labels:
+                return False
+            if node.is_unschedulable() or not node.is_ready():
+                return False
+            runtime = pods_by_node.get(node.metadata.name, [])
+            if not any(
+                    p.metadata.labels.get(
+                        POD_CONTROLLER_REVISION_HASH_LABEL)
+                    == FINAL_REVISION and p.is_ready() for p in runtime):
+                return False
+            pool = labels.get(GKE_NODEPOOL_LABEL)
+            if pool:
+                pools.setdefault(pool, []).append(node)
+        # every slice back to full shape (enough spares were seeded for
+        # every kill, so no degraded entry may survive convergence)
+        for s in range(config.n_slices):
+            if len(pools.get(f"pool-{s}", [])) != fleet.hosts_per_slice:
+                return False
+        if config.spares >= config.kills and any(
+                topo_keys.degraded_slices_annotation
+                in ds.metadata.annotations for ds in daemon_sets):
+            return False
+        # every multislice job replica rescheduled
+        names = {p.metadata.name for p in workload}
+        for job, slice_ids in fleet.multislice_jobs:
+            if any(f"{job}-s{s}" not in names for s in slice_ids):
+                return False
+        return True
+
+    steps = 0
+    is_converged = False
+    quiesce_ticks = 0
+    while steps < config.max_steps:
+        steps += 1
+        now = clock.now()
+        was_leading = op.elector.is_leader
+        op.elector.try_acquire_or_renew()
+        if was_leading and not op.elector.is_leader:
+            handovers += 1
+            op = next_incarnation("leader election lost")
+            op.elector.try_acquire_or_renew()
+        if op.elector.is_leader:
+            injector.arm_due_crashes(now)
+            op.nudger.pop_due(now)
+            op.nudger.consume_pending()
+            try:
+                op.remediation.reconcile(NS, dict(RUNTIME_LABELS),
+                                         remediation_policy)
+                op.upgrade.reconcile(NS, dict(RUNTIME_LABELS),
+                                     upgrade_policy)
+                reconciles += 1
+            except OperatorCrash:
+                op = next_incarnation("operator crash mid-reconcile")
+            except BuildStateError:
+                pass
+            except (ApiServerError, ConflictError, NotFoundError):
+                pass
+            if injector.fuse.pending:
+                op = next_incarnation("operator crash (surfaced late)")
+        monitor.drain()
+        try:
+            _restore_workload_pods_by_pool(cluster, fleet, topo_keys)
+        except (ApiServerError, TimeoutError):
+            pass
+        monitor.drain()
+        if (now > schedule.last_fault_time
+                and not injector.fuse.armed
+                and not injector.fuse.pending
+                and converged()):
+            quiesce_ticks += 1
+            if quiesce_ticks >= 3:
+                is_converged = True
+                break
+        else:
+            quiesce_ticks = 0
+        clock.advance(config.reconcile_interval)
+        cluster.step()
+        monitor.drain()
+
+    if is_converged:
+        monitor.final_check()
+    else:
+        monitor.violations.append(InvariantViolation(
+            invariant="liveness", at=clock.now(), subject="fleet",
+            detail=f"fleet did not converge (slices remapped, survivors "
+                   f"on {FINAL_REVISION!r}, condemned nodes parked) "
+                   f"within {config.max_steps} steps "
+                   f"({clock.now():g}s virtual)"))
+
+    # harness sanity: the episode must have exercised what it gates
+    if injector.nodes_killed < 2:
+        monitor.violations.append(InvariantViolation(
+            invariant="harness", at=clock.now(), subject="injector",
+            detail=f"only {injector.nodes_killed} node kill(s) fired; "
+                   f"the gate requires kills across >= 2 slices"))
+    if injector.crashes_fired == 0:
+        monitor.violations.append(InvariantViolation(
+            invariant="harness", at=clock.now(), subject="injector",
+            detail="no operator crash fired — the schedule's crash "
+                   "events never detonated"))
+    if is_converged and len(monitor.remap_seconds) < injector.nodes_killed:
+        monitor.violations.append(InvariantViolation(
+            invariant="harness", at=clock.now(), subject="monitor",
+            detail=f"only {len(monitor.remap_seconds)} condemned→released "
+                   f"remap(s) observed for {injector.nodes_killed} "
+                   f"kill(s) — a slice was not routed around its dead "
+                   f"host"))
+    if monitor.remap_seconds:
+        monitor.trace.append(
+            f"[t={clock.now():g}] time-to-remapped (condemned→released, "
+            f"s): {sorted(round(s, 1) for s in monitor.remap_seconds)}")
 
     report = ChaosReport(
         seed=seed,

@@ -52,6 +52,13 @@ FAULT_OPERATOR_CRASH = "operator-crash"
 #: (canary halt + rollback), which is exactly what the bad-revision
 #: soak gate proves.
 FAULT_BAD_REVISION = "bad-revision"
+#: The target node goes NotReady and NEVER heals (dead host: failed
+#: board, unrecoverable kernel wedge). Like bad-revision, recovery is
+#: the system's job — the remediation ladder must exhaust, condemn the
+#: node, and the SliceReconfigurer must route its slice around it
+#: (spare remap or documented degraded admission), which is exactly
+#: what the reconfiguration soak gate proves.
+FAULT_NODE_KILL = "node-kill"
 
 #: The full catalog, in deterministic order (generation samples from it).
 FAULT_KINDS = (
@@ -199,6 +206,65 @@ class FaultSchedule:
                         until=start + rng.uniform(20.0, 110.0)))
                 elif kind == FAULT_LEADER_LOSS:
                     events.append(FaultEvent(at=start, kind=kind))
+        events.sort(key=lambda e: (e.at, e.kind, e.target))
+        return cls(seed=seed, events=tuple(events))
+
+    @classmethod
+    def generate_reconfig(cls, seed: int,
+                          slice_members: "dict[str, list[str]]",
+                          horizon: float = 600.0,
+                          kills: int = 2,
+                          extra_kinds: int = 2) -> "FaultSchedule":
+        """Schedule for the degraded-slice reconfiguration gate:
+        ``kills`` permanent node kills spread across ≥2 distinct slices
+        (one victim per slice, seed-chosen), landing mid-rollout, plus
+        at least one operator crash and ``extra_kinds`` control-plane
+        fault kinds riding along. The side-fault pool excludes the
+        healing node faults (crashloop / notready-flap): a second,
+        temporary wedge on a surviving host would serialize the
+        remediation ladder behind the kill it is racing and push
+        condemnation past the horizon on slow seeds — the gate proves
+        reconfiguration, and the compound-fault interplay is the main
+        soak's job.
+        """
+        pools = {sid: sorted(nodes)
+                 for sid, nodes in slice_members.items()
+                 if len(nodes) > 1}
+        if len(pools) < 2:
+            raise ValueError(
+                "reconfig schedule needs >= 2 multi-host slices")
+        kills = max(2, min(kills, len(pools)))
+        rng = random.Random(f"chaos-reconfig:{seed}")
+        victims = [rng.choice(pools[sid])
+                   for sid in rng.sample(sorted(pools), kills)]
+        events: list[FaultEvent] = []
+        for victim in victims:
+            # mid-rollout: after the first waves start, before the
+            # mid-horizon revision bump's storm settles
+            events.append(FaultEvent(
+                at=rng.uniform(horizon * 0.15, horizon * 0.55),
+                kind=FAULT_NODE_KILL, target=victim))
+        for _ in range(rng.randint(1, 2)):
+            events.append(FaultEvent(
+                at=rng.uniform(0.1, horizon * 0.45),
+                kind=FAULT_OPERATOR_CRASH,
+                param=rng.randint(0, 8)))
+        pool = [FAULT_API_BURST, FAULT_WATCH_BREAK, FAULT_STALE_READS,
+                FAULT_LEADER_LOSS]
+        nodes = sorted(n for members in pools.values() for n in members)
+        for kind in rng.sample(pool, min(extra_kinds, len(pool))):
+            start = rng.uniform(0.1, horizon * 0.7)
+            if kind == FAULT_API_BURST:
+                events.append(FaultEvent(
+                    at=start, kind=kind,
+                    target=rng.choice(API_BURST_OPERATIONS),
+                    param=rng.randint(1, 3)))
+            elif kind == FAULT_STALE_READS:
+                events.append(FaultEvent(
+                    at=start, kind=kind, target=rng.choice(nodes),
+                    param=rng.randint(1, 3)))
+            else:
+                events.append(FaultEvent(at=start, kind=kind))
         events.sort(key=lambda e: (e.at, e.kind, e.target))
         return cls(seed=seed, events=tuple(events))
 

@@ -239,6 +239,14 @@ class RemediationState(str, enum.Enum):
     UNCORDON_REQUIRED = "uncordon-required"
     # Attempt budget exhausted; node stays quarantined for manual repair.
     FAILED = "remediation-failed"
+    # Condemned member of a multi-host slice, with topology
+    # reconfiguration enabled: the SliceReconfigurer must release the
+    # slice (remap it onto a spare, or admit a documented degraded
+    # shape) before the node parks back in ``remediation-failed``. The
+    # Ironwood-retrospective analogue of optical-circuit-switch
+    # reconfiguration: route the slice AROUND the dead host instead of
+    # parking the whole ICI domain on its repair.
+    RECONFIGURE_REQUIRED = "reconfigure-required"
 
     def __str__(self) -> str:  # label values are plain strings
         return self.value
@@ -248,6 +256,10 @@ class RemediationState(str, enum.Enum):
 #: which the machine is actively driving the node. FAILED is excluded:
 #: a node parked for manual repair must not starve the rest of the fleet
 #: of remediation slots (it still counts as unavailable via its cordon).
+#: RECONFIGURE_REQUIRED is excluded for the same reason: the node is
+#: already dead and cordoned, and waiting for a spare to provision and
+#: upgrade can take a long time — holding a slot for that window would
+#: starve live wedges of remediation.
 REMEDIATION_IN_PROGRESS_STATES = (
     RemediationState.CORDON_REQUIRED,
     RemediationState.DRAIN_REQUIRED,
@@ -268,6 +280,7 @@ REMEDIATION_ALL_STATES = (
     RemediationState.REVALIDATE_REQUIRED,
     RemediationState.UNCORDON_REQUIRED,
     RemediationState.FAILED,
+    RemediationState.RECONFIGURE_REQUIRED,
 )
 
 #: Legal transitions of the remediation machine — single source of truth
@@ -316,6 +329,13 @@ REMEDIATION_EDGES: tuple[
      "uncordoned; bookkeeping cleared"),
     (RemediationState.FAILED, RemediationState.REVALIDATE_REQUIRED,
      "signal cleared out-of-band | manual re-arm annotation"),
+    (RemediationState.FAILED, RemediationState.RECONFIGURE_REQUIRED,
+     "condemned member of a multi-host slice; reconfiguration enabled"),
+    (RemediationState.RECONFIGURE_REQUIRED, RemediationState.FAILED,
+     "slice released: remapped onto spare | degraded shape admitted"),
+    (RemediationState.RECONFIGURE_REQUIRED,
+     RemediationState.REVALIDATE_REQUIRED,
+     "manual re-arm during reconfiguration (remap aborted)"),
 )
 
 #: Adjacency view of REMEDIATION_EDGES, keyed by label value
@@ -336,6 +356,7 @@ REMEDIATION_WORKLOAD_UNSAFE_STATES = frozenset(str(s) for s in (
     RemediationState.RESTART_REQUIRED,
     RemediationState.REBOOT_REQUIRED,
     RemediationState.REVALIDATE_REQUIRED,
+    RemediationState.RECONFIGURE_REQUIRED,
 ))
 
 #: Label key whose presence identifies a TPU node on GKE.
@@ -525,9 +546,92 @@ class RemediationKeys:
         return f"{self.domain}/{self.driver}-remediation-requested"
 
     @property
+    def condemned_annotation(self) -> str:
+        """Epoch-seconds stamp written when the machine gave the node up
+        (attempt budget exhausted with the wedge signal still present).
+        The durable give-up record: the SliceReconfigurer keys slice
+        remaps on it, time-to-remapped is measured from it, and
+        operators watching ``kubectl get events`` get the paired
+        ``NodeCondemned`` Event instead of a silent FAILED dead end.
+        Cleared only when the node recovers."""
+        return f"{self.domain}/{self.driver}-remediation.condemned-at"
+
+    @property
     def event_reason(self) -> str:
         """Reason string attached to Kubernetes events."""
         return f"{self.driver.upper()}NodeRemediation"
+
+
+@dataclass(frozen=True)
+class TopologyKeys:
+    """Instance-scoped builder for the slice-reconfiguration keys.
+
+    Third key family next to :class:`UpgradeKeys` /
+    :class:`RemediationKeys`, same driver/domain scoping. The spare-pool
+    label marks hot-standby hosts the
+    :class:`~tpu_operator_libs.topology.reconfigurer.SliceReconfigurer`
+    may swap into a slice in place of a condemned node; the annotations
+    are the remap flow's durable commit points (reservation → join →
+    release), so a crashed operator resumes a half-finished remap from
+    cluster state alone. The degraded-slices record lives on the runtime
+    DaemonSet (one crash-atomic annotation patch — the RolloutGuard
+    quarantine idiom) because slices themselves are not API objects.
+    """
+
+    driver: str = "libtpu"
+    domain: str = "google.com"
+
+    @property
+    def spare_pool_label(self) -> str:
+        """Node label marking a hot-standby host (value "true"). Spares
+        carry the accelerator/topology labels of the slices they can
+        replace into, but NO nodepool label — each spare is its own
+        single-node "slice" until a remap joins it to a pool."""
+        return f"{self.domain}/{self.driver}-topology.spare"
+
+    @property
+    def reserved_for_annotation(self) -> str:
+        """On a spare: ``<slice-id>/<missing-host>:<epoch>`` — reserved
+        to replace ``missing-host`` in ``slice-id`` (stamped at
+        reservation time, driving the spare-provision deadline). The
+        durable booking that keeps two remaps from claiming one spare,
+        and the joint-planning marker the upgrade planners prioritize
+        (the spare must reach the target revision while still OUT of the
+        slice — one cordon/drain cycle total)."""
+        return f"{self.domain}/{self.driver}-topology.reserved-for"
+
+    @property
+    def remapped_at_annotation(self) -> str:
+        """On a just-joined spare: ``<epoch>:<missing-host>`` stamped in
+        the same patch that joins it to the pool. Holds the multislice
+        sticky-down membership (the job's replacement pods are still
+        Pending right after a remap) until the settle window passes, and
+        records which condemned host this join replaced (the crash-safe
+        resume marker for the join→release window)."""
+        return f"{self.domain}/{self.driver}-topology.remapped-at"
+
+    @property
+    def released_from_annotation(self) -> str:
+        """On a released condemned node: the slice id it was removed
+        from (audit trail; the node itself keeps its condemned
+        annotation and stays parked for repair)."""
+        return f"{self.domain}/{self.driver}-topology.released-from"
+
+    @property
+    def degraded_slices_annotation(self) -> str:
+        """DAEMONSET annotation recording admitted degraded shapes:
+        ``slice:lost-host[+lost-host...]`` entries, comma-separated,
+        sorted (see topology.slice_topology.encode_degraded_slices).
+        Written in ONE patch before the condemned node is released, so
+        planners and the serving gate always see a truthful capacity
+        picture — a slice is never silently short. Entries are removed
+        when a late spare heals the slice back to full shape."""
+        return f"{self.domain}/{self.driver}-topology.degraded-slices"
+
+    @property
+    def event_reason(self) -> str:
+        """Reason string attached to Kubernetes events."""
+        return f"{self.driver.upper()}SliceReconfiguration"
 
 
 #: Field selector template filtering pods by the node they run on

@@ -462,6 +462,58 @@ class FakeCluster(K8sClient):
                 self._pod_ready_gate = (
                     lambda pod, a=existing, b=gate: a(pod) and b(pod))
 
+    def gate_pod_ready_on_node_ready(self) -> None:
+        """Compose a readiness gate tying recreated DS pods to their
+        node's Ready condition: a pod recreated on a NotReady node
+        crash-loops (restart count past the failure threshold) until the
+        node comes back. Models a dead host's kubelet never reporting a
+        healthy container — the signal the node-kill chaos fault needs
+        so a mid-upgrade kill lands in ``upgrade-failed`` instead of
+        waiting forever in pod-restart."""
+        def gate(pod: Pod) -> bool:
+            # called under self._lock (make_ready); read the store
+            # directly instead of re-locking through get_node
+            node = self._nodes.get(pod.spec.node_name)
+            return node is None or node.is_ready()
+
+        self.add_pod_ready_gate(gate)
+
+    def seed_node_with_ds_pod(self, node: Node, ds_namespace: str,
+                              ds_name: str,
+                              revision_hash: Optional[str] = None,
+                              ready: bool = True) -> Node:
+        """Test/sim helper: add ``node`` plus a Ready runtime pod owned
+        by an existing DaemonSet, bumping the DS desired count to match
+        (build_state's completeness guard requires desired == scheduled).
+        The spare-pool seeding path for reconfiguration tests: label the
+        node as a spare and this wires everything else."""
+        with self._lock:
+            ds = self._daemon_sets.get((ds_namespace, ds_name))
+            if ds is None:
+                raise NotFoundError(
+                    f"daemonset {ds_namespace}/{ds_name} not found")
+        if revision_hash is None:
+            revision_hash = self.latest_revision_hash(ds_namespace, ds_name)
+        self.add_node(node)
+        labels = dict(ds.spec.selector)
+        labels[POD_CONTROLLER_REVISION_HASH_LABEL] = revision_hash
+        self.add_pod(Pod(
+            metadata=ObjectMeta(
+                name=f"{ds_name}-{node.metadata.name}",
+                namespace=ds_namespace, labels=labels,
+                owner_references=[OwnerReference(
+                    kind="DaemonSet", name=ds_name, uid=ds.metadata.uid)]),
+            spec=PodSpec(node_name=node.metadata.name),
+            status=PodStatus(
+                phase=PodPhase.RUNNING,
+                container_statuses=[
+                    ContainerStatus(name="runtime", ready=ready)])))
+        with self._lock:
+            live = self._daemon_sets[(ds_namespace, ds_name)]
+            live.status.desired_number_scheduled += 1
+            self._notify(MODIFIED, KIND_DAEMON_SET, live)
+        return node
+
     def inject_api_errors(self, operation: str, count: int,
                           exc_factory: Optional[Callable[[], Exception]]
                           = None) -> None:

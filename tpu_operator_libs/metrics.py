@@ -500,6 +500,67 @@ def observe_remediation(registry: MetricsRegistry,
             buckets=RECOVERY_SECONDS_BUCKETS)
 
 
+#: Buckets for condemned→remapped durations: a remap rides the spare's
+#: upgrade (one cordon/drain cycle) plus the reconfigurer's settle.
+REMAP_SECONDS_BUCKETS = (30.0, 60.0, 120.0, 300.0, 600.0, 1200.0,
+                         1800.0, 3600.0, 7200.0)
+
+
+def observe_topology(registry: MetricsRegistry,
+                     reconfigurer: "object",
+                     nodes: "Iterable[object]" = (),
+                     driver: str = "libtpu") -> None:
+    """Export the slice-reconfiguration layer's accounting.
+
+    ``reconfigurer`` is a :class:`tpu_operator_libs.topology.
+    reconfigurer.SliceReconfigurer` (anything exposing its counter
+    surface works); ``nodes`` the pass's node list for the spare-pool
+    gauges. Rides the same scrape as the fleet gauges: spare-pool
+    size/in-use, remaps and degraded admissions/heals as counters, and
+    the time-to-remapped histogram — the MTTR-style evidence that a
+    condemned node costs minutes of slice capacity, not a repair
+    ticket's worth.
+    """
+    labels = {"driver": driver}
+    keys = getattr(reconfigurer, "keys", None)
+    if keys is not None:
+        spares = [n for n in nodes
+                  if n.metadata.labels.get(keys.spare_pool_label)
+                  == "true"]
+        registry.set_gauge(
+            "topology_spare_pool_size", len(spares),
+            "Hot-standby spare hosts available for slice remaps", labels)
+        registry.set_gauge(
+            "topology_spare_pool_in_use",
+            sum(1 for n in spares
+                if keys.reserved_for_annotation in n.metadata.annotations),
+            "Spares currently reserved for an in-flight remap", labels)
+    registry.set_counter_total(
+        "topology_reconfigurations_total",
+        reconfigurer.reconfigurations_total,
+        "Slices remapped onto a spare after a node condemnation", labels)
+    registry.set_counter_total(
+        "topology_degraded_admissions_total",
+        reconfigurer.degraded_admissions_total,
+        "Slices admitted in a documented degraded shape (no spare)",
+        labels)
+    registry.set_counter_total(
+        "topology_degraded_healed_total",
+        reconfigurer.degraded_healed_total,
+        "Degraded slices healed back to full shape by a late spare",
+        labels)
+    registry.set_counter_total(
+        "topology_spares_reserved_total",
+        reconfigurer.spares_reserved_total,
+        "Spare reservations issued (bookings, including abandoned ones)",
+        labels)
+    for seconds in reconfigurer.drain_remap_durations():
+        registry.observe_histogram(
+            "topology_time_to_remapped_seconds", seconds,
+            "Node condemned to slice released (remapped or degraded)",
+            labels, buckets=REMAP_SECONDS_BUCKETS)
+
+
 #: Buckets for chaos convergence times (virtual seconds): soak episodes
 #: ride fault-window + recovery-ladder timescales.
 CHAOS_SECONDS_BUCKETS = (60.0, 120.0, 300.0, 600.0, 900.0, 1800.0,

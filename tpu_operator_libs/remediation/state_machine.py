@@ -26,7 +26,12 @@ One reconcile is:
    revalidate ─┬─(clear for settle window + gate)──→ uncordon | healthy
                └─(signal returned past timeout)────→ wedged
    uncordon-required ─(uncordoned)─────────────────→ healthy
-   remediation-failed ─(out-of-band fix | re-arm)──→ revalidate
+   remediation-failed ─┬─(out-of-band fix | re-arm)→ revalidate
+                       └─(condemned slice member,
+                          reconfiguration enabled)─→ reconfigure-required
+   reconfigure-required ─┬─(slice released: spare
+                            remap | degraded admit)→ remediation-failed
+                         └─(manual re-arm)─────────→ revalidate
 
 Durability model is identical to the upgrade machine: the node label is
 the commit point, every decision re-derives from the snapshot, and the
@@ -53,6 +58,7 @@ from tpu_operator_libs.api.upgrade_policy import (
     scaled_value_from_int_or_percent,
 )
 from tpu_operator_libs.consts import (
+    GKE_NODEPOOL_LABEL,
     IN_PROGRESS_STATES,
     REMEDIATION_ALL_STATES,
     REMEDIATION_IN_PROGRESS_STATES,
@@ -61,6 +67,7 @@ from tpu_operator_libs.consts import (
     RemediationKeys,
     RemediationState,
     UpgradeKeys,
+    UpgradeState,
 )
 from tpu_operator_libs.k8s.client import (
     ApiServerError,
@@ -83,6 +90,7 @@ from tpu_operator_libs.upgrade.validation_manager import NodeValidator
 from tpu_operator_libs.util import Clock, Event, EventRecorder, log_event
 
 if TYPE_CHECKING:
+    from tpu_operator_libs.topology.reconfigurer import SliceReconfigurer
     from tpu_operator_libs.upgrade.nudger import ReconcileNudger
 
 logger = logging.getLogger(__name__)
@@ -137,10 +145,16 @@ class NodeRemediationState:
 
 @dataclass
 class RemediationSnapshot:
-    """Snapshot of the managed fleet bucketed by remediation state."""
+    """Snapshot of the managed fleet bucketed by remediation state.
+
+    Carries the runtime namespace + labels it was built from so
+    pass-scoped consumers (the SliceReconfigurer resolving the runtime
+    DaemonSet) need no side channel."""
 
     node_states: dict[str, list[NodeRemediationState]] = field(
         default_factory=dict)
+    namespace: str = ""
+    runtime_labels: dict[str, str] = field(default_factory=dict)
 
     def bucket(self, state: RemediationState | str,
                ) -> list[NodeRemediationState]:
@@ -176,7 +190,9 @@ class NodeRemediationManager:
                  provider: Optional[NodeUpgradeStateProvider] = None,
                  sync_timeout: float = 10.0,
                  poll_interval: float = 1.0,
-                 nudger: Optional["ReconcileNudger"] = None) -> None:
+                 nudger: Optional["ReconcileNudger"] = None,
+                 reconfigurer: Optional["SliceReconfigurer"] = None,
+                 ) -> None:
         self.keys = keys or RemediationKeys()
         # Completion-wakeup seam, shared with the upgrade machine (both
         # feed the same controller key): every durable deadline this
@@ -205,6 +221,17 @@ class NodeRemediationManager:
         self.rebooter = rebooter if rebooter is not None else \
             AnnotationRebooter(self.provider, self.keys, self.clock)
         self.validator = validator
+        # Degraded-slice reconfiguration seam (topology/reconfigurer.py):
+        # drives condemned nodes through the reconfigure-required arc.
+        # None = the pre-reconfiguration dead end (FAILED parks the
+        # slice), regardless of policy.
+        self.reconfigurer = reconfigurer
+        # Set per apply_state pass from policy.reconfiguration: when
+        # True, nodes parked in the upgrade machine's terminal FAILED
+        # state are eligible for wedge detection/triage (the upgrade
+        # machine holds its own FAILED recovery while the remediation
+        # skip label is on the node, so only one machine drives it).
+        self._takeover_failed_upgrades = False
         self._poll_interval = poll_interval
         # fleet counters (exported via metrics.observe_remediation)
         self.wedged_detected_total = 0
@@ -228,7 +255,8 @@ class NodeRemediationManager:
         arm keeps a node whose pods were GC'd mid-remediation from
         silently leaving the machine.
         """
-        snapshot = RemediationSnapshot()
+        snapshot = RemediationSnapshot(
+            namespace=namespace, runtime_labels=dict(runtime_labels))
         selector = selector_from_labels(runtime_labels)
         pods_by_node: dict[str, Pod] = {}
         for pod in self.client.list_pods(namespace=namespace,
@@ -263,6 +291,13 @@ class NodeRemediationManager:
         logger.info("remediation states: %s", {
             str(s) or "healthy": len(snapshot.bucket(s))
             for s in REMEDIATION_ALL_STATES})
+        reconfig = policy.reconfiguration
+        reconfig_active = (reconfig is not None and reconfig.enable
+                          and self.reconfigurer is not None)
+        self._takeover_failed_upgrades = (
+            reconfig_active and reconfig.take_over_failed_upgrades)
+        if reconfig_active:
+            self.reconfigurer.begin_pass(snapshot)
         detector = self._detector_for_policy(policy)
         self.process_healthy_nodes(snapshot, detector)
         self.process_wedged_nodes(snapshot, policy, detector)
@@ -272,7 +307,19 @@ class NodeRemediationManager:
         self.process_reboot_required_nodes(snapshot, policy)
         self.process_revalidate_required_nodes(snapshot, policy, detector)
         self.process_uncordon_required_nodes(snapshot)
-        self.process_failed_nodes(snapshot, detector)
+        self.process_failed_nodes(snapshot, detector, policy)
+        self.process_reconfigure_required_nodes(snapshot, policy)
+        if reconfig_active:
+            # settle-stamp expiry + degraded-slice healing ride the same
+            # pass; transient errors defer to the next reconcile
+            try:
+                self.reconfigurer.reconcile_extras(snapshot, reconfig)
+            except (ApiServerError, ConflictError, NotFoundError) as exc:
+                logger.warning("transient cluster error during slice-"
+                               "reconfiguration follow-through; deferring "
+                               "to the next reconcile: %s", exc)
+                self._transient_deferrals += 1
+                self.last_pass_deferrals += 1
         logger.info("remediation manager finished processing")
 
     def _detector_for_policy(self, policy: RemediationPolicySpec,
@@ -667,11 +714,22 @@ class NodeRemediationManager:
                 self._finish_recovery(node)
 
     def process_failed_nodes(self, snapshot: RemediationSnapshot,
-                             detector: WedgeDetector) -> None:
+                             detector: WedgeDetector,
+                             policy: Optional[RemediationPolicySpec] = None,
+                             ) -> None:
         """Parked nodes re-enter revalidation when the wedge cleared
         out-of-band, or when an operator re-arms them (which also resets
-        the attempt ladder)."""
+        the attempt ladder). A node whose signal persists is CONDEMNED:
+        the give-up is stamped durably and announced as a
+        ``NodeCondemned`` Event (FAILED used to be a silent dead end
+        neither the reconfigurer nor an operator watching ``kubectl get
+        events`` could react to), and — with reconfiguration enabled —
+        a condemned member of a named slice moves to
+        ``reconfigure-required`` so the slice is routed around it."""
         now = self.clock.now()
+        reconfig = policy.reconfiguration if policy is not None else None
+        reconfig_active = (reconfig is not None and reconfig.enable
+                          and self.reconfigurer is not None)
         for ns in snapshot.bucket(RemediationState.FAILED):
             node = ns.node
             with self._defer_node_on_transient(node, "failed-node triage"):
@@ -683,6 +741,30 @@ class NodeRemediationManager:
                     self.provider.change_node_upgrade_annotation(
                         node, self.keys.attempt_annotation, None)
                 elif detector(node, ns.runtime_pod, now) is not None:
+                    if self.keys.condemned_annotation \
+                            not in node.metadata.annotations:
+                        self.provider.change_node_upgrade_annotation(
+                            node, self.keys.condemned_annotation,
+                            str(int(now)))
+                        reason = node.metadata.annotations.get(
+                            self.keys.wedge_reason_annotation, "unknown")
+                        logger.error(
+                            "node %s condemned: remediation exhausted "
+                            "with wedge signal (%s) still present",
+                            node.metadata.name, reason)
+                        log_event(self.recorder, node, Event.WARNING,
+                                  "NodeCondemned",
+                                  f"Remediation gave the node up "
+                                  f"({reason}); slice reconfiguration "
+                                  f"or manual repair required")
+                    if reconfig_active and node.metadata.labels.get(
+                            GKE_NODEPOOL_LABEL):
+                        if self.provider.change_node_upgrade_state(
+                                node,
+                                RemediationState.RECONFIGURE_REQUIRED):
+                            logger.warning(
+                                "condemned node %s entering slice "
+                                "reconfiguration", node.metadata.name)
                     continue
                 self.provider.change_node_upgrade_annotation(
                     node, self.keys.settle_start_annotation, None)
@@ -691,6 +773,49 @@ class NodeRemediationManager:
                 logger.info("failed node %s re-entering revalidation%s",
                             node.metadata.name,
                             " (re-armed)" if rearmed else "")
+
+    def process_reconfigure_required_nodes(
+            self, snapshot: RemediationSnapshot,
+            policy: RemediationPolicySpec) -> None:
+        """Drive condemned slice members through the reconfigurer: once
+        the slice is released (remapped onto a spare, or admitted as a
+        documented degraded shape) the node parks back in FAILED — out
+        of its slice, so planners and budgets stop paying for it. A
+        re-arm aborts the remap and re-enters revalidation."""
+        from tpu_operator_libs.topology.reconfigurer import RELEASED
+
+        reconfig = policy.reconfiguration
+        reconfig_active = (reconfig is not None and reconfig.enable
+                          and self.reconfigurer is not None)
+        for ns in snapshot.bucket(RemediationState.RECONFIGURE_REQUIRED):
+            node = ns.node
+            with self._defer_node_on_transient(node,
+                                               "slice reconfiguration"):
+                rearmed = node.metadata.annotations.get(
+                    self.keys.rearm_annotation) == TRUE_STRING
+                if rearmed:
+                    if self.reconfigurer is not None:
+                        self.reconfigurer.abort(node)
+                    self.provider.change_node_upgrade_annotations(node, {
+                        self.keys.rearm_annotation: None,
+                        self.keys.attempt_annotation: None,
+                        self.keys.settle_start_annotation: None,
+                    })
+                    self.provider.change_node_upgrade_state(
+                        node, RemediationState.REVALIDATE_REQUIRED)
+                    logger.info("node %s re-armed mid-reconfiguration; "
+                                "remap aborted", node.metadata.name)
+                    continue
+                if not reconfig_active:
+                    # policy flipped off mid-flight: the node returns to
+                    # the plain parked state (its slice membership is
+                    # whatever the remap got to)
+                    self.provider.change_node_upgrade_state(
+                        node, RemediationState.FAILED)
+                    continue
+                if self.reconfigurer.advance(ns, reconfig) == RELEASED:
+                    self.provider.change_node_upgrade_state(
+                        node, RemediationState.FAILED)
 
     # ------------------------------------------------------------------
     # helpers
@@ -712,6 +837,15 @@ class NodeRemediationManager:
         if self.upgrade_keys is None:
             return False
         state = node.metadata.labels.get(self.upgrade_keys.state_label, "")
+        if state == str(UpgradeState.FAILED) \
+                and self._takeover_failed_upgrades:
+            # upgrade-failed is a PARKED state, not active motion: the
+            # upgrade machine is waiting for pod health, which only this
+            # machine's ladder can restore when the hardware is the
+            # problem. It holds its FAILED recovery while the skip label
+            # (set at quarantine cordon) is on the node, so the takeover
+            # never has two machines driving one node.
+            return False
         return state in {str(s) for s in IN_PROGRESS_STATES}
 
     def _park_upgrade_flow(self, node: Node, parked: bool) -> None:
@@ -804,6 +938,7 @@ class NodeRemediationManager:
                     self.keys.settle_start_annotation,
                     self.keys.reboot_requested_annotation,
                     self.keys.initial_state_annotation,
+                    self.keys.condemned_annotation,
                     self.keys.rearm_annotation):
             if key in node.metadata.annotations:
                 self.provider.change_node_upgrade_annotation(
@@ -858,6 +993,14 @@ class NodeRemediationManager:
         }
         if self.last_pass_deferrals:
             status["transientDeferrals"] = self.last_pass_deferrals
+        condemned = sum(
+            1 for bucket in snapshot.node_states.values() for ns in bucket
+            if self.keys.condemned_annotation
+            in ns.node.metadata.annotations)
+        if condemned:
+            status["condemnedNodes"] = condemned
+        if self.reconfigurer is not None:
+            status["reconfiguration"] = self.reconfigurer.status()
         return status
 
     # ------------------------------------------------------------------
@@ -873,16 +1016,22 @@ class NodeRemediationManager:
         remediation annotations)."""
         last_snapshot = None
         fingerprint = None
-        prefix = f"{self.keys.domain}/{self.keys.driver}-remediation"
+        # Two durable families matter to this machine's quiescence: its
+        # own bookkeeping and the reconfigurer's remap annotations
+        # (reservation / remapped-at / released-from) — a remap step
+        # that only moved those must not look like a settled chain.
+        prefixes = (f"{self.keys.domain}/{self.keys.driver}-remediation",
+                    f"{self.keys.domain}/{self.keys.driver}-topology")
         for _ in range(max_chain):
             snapshot = self.build_state(namespace, runtime_labels)
             new_fingerprint = tuple(sorted(
                 (ns.node.metadata.name, label,
                  ns.node.is_unschedulable(),
+                 ns.node.metadata.labels.get(GKE_NODEPOOL_LABEL, ""),
                  tuple(sorted(
                      (key, value) for key, value
                      in ns.node.metadata.annotations.items()
-                     if key.startswith(prefix))))
+                     if key.startswith(prefixes))))
                 for label, bucket in snapshot.node_states.items()
                 for ns in bucket))
             if new_fingerprint == fingerprint:

@@ -242,6 +242,35 @@ def _install_delay_model(cluster: FakeCluster, spec: FleetSpec) -> None:
     cluster.set_per_node_ds_delays(lambda n: delays[n])
 
 
+def seed_spare_pool(cluster: FakeCluster, spec: FleetSpec, count: int,
+                    revision_hash: Optional[str] = None) -> list[str]:
+    """Add ``count`` hot-standby spare hosts to a built fleet.
+
+    Spares carry the fleet's accelerator/topology labels plus the
+    spare-pool label — but NO nodepool label, so each is its own
+    single-node "slice" until a remap joins it (the joint-planning
+    property the reconfigurer relies on). Each spare runs a runtime DS
+    pod (the DS desired count is bumped to match), so it is managed by
+    both state machines like any other host. Returns the spare names.
+    """
+    from tpu_operator_libs.consts import TRUE_STRING, TopologyKeys
+
+    keys = TopologyKeys()
+    names = []
+    for i in range(count):
+        name = f"spare-{i}"
+        cluster.seed_node_with_ds_pod(
+            Node(metadata=ObjectMeta(name=name, labels={
+                GKE_TPU_ACCELERATOR_LABEL: spec.accelerator,
+                GKE_TPU_TOPOLOGY_LABEL: spec.topology,
+                "google.com/tpu": "true",
+                keys.spare_pool_label: TRUE_STRING,
+            })),
+            NS, "libtpu", revision_hash=revision_hash)
+        names.append(name)
+    return names
+
+
 def restore_workload_pods(cluster: FakeCluster, spec: FleetSpec) -> None:
     """(Re)create each multislice job's member pods on slices that are
     fully schedulable+ready — the sim's stand-in for the JobSet

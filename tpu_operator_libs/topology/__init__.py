@@ -11,6 +11,11 @@ This package changes the unit of work from node to slice.
 from tpu_operator_libs.topology.slice_topology import (  # noqa: F401
     SliceInfo,
     SliceTopology,
+    decode_degraded_slices,
+    encode_degraded_slices,
     slice_id_for_node,
 )
 from tpu_operator_libs.topology.planner import SlicePlanner  # noqa: F401
+from tpu_operator_libs.topology.reconfigurer import (  # noqa: F401
+    SliceReconfigurer,
+)

@@ -87,9 +87,21 @@ class MultisliceJobMap:
         self._last: dict[JobId, set[str]] = {}
 
     def refresh(self, pods: Iterable[Pod], nodes: Iterable[Node],
-                down_slices: set[str]) -> dict[JobId, set[str]]:
+                down_slices: set[str],
+                hold_slices: "set[str] | frozenset[str]" = frozenset(),
+                ) -> dict[JobId, set[str]]:
         """Rebuild the map from live pods, carrying forward membership of
-        slices in ``down_slices`` from the previous round."""
+        slices in ``down_slices`` from the previous round.
+
+        ``hold_slices`` extends the carry to slices that are back UP but
+        whose membership must not be forgotten yet — the remap case: a
+        slice reconfigured onto a spare is immediately available, while
+        its job's replacement pods are still Pending, and a map that
+        forgot the member there would let the planner take a second
+        member of the same job. A held slice is released early once live
+        pods re-bind it (the hold can never pin stale membership a
+        running pod contradicts); otherwise the hold lasts until the
+        reconfigurer clears the remap settle stamp."""
         node_slice = {node.metadata.name: slice_id_for_node(node)
                       for node in nodes}
         live: dict[JobId, set[str]] = {}
@@ -106,6 +118,10 @@ class MultisliceJobMap:
                 if sid in down_slices:
                     # its pods may be evicted right now; the slice is
                     # still this job's member until it comes back up
+                    live.setdefault(job, set()).add(sid)
+                elif sid in hold_slices and sid not in live.get(job, ()):
+                    # freshly remapped: up, but the job has not re-bound
+                    # it yet — keep the membership through the settle
                     live.setdefault(job, set()).add(sid)
         self._last = live
         return live
@@ -137,9 +153,11 @@ class MultisliceConstraint:
         self.last_deferred: tuple[str, ...] = ()
 
     def begin_round(self, nodes: Iterable[Node],
-                    down_slices: set[str]) -> None:
+                    down_slices: set[str],
+                    hold_slices: "set[str] | frozenset[str]" = frozenset(),
+                    ) -> None:
         self._job_slices = self._map.refresh(
-            self._workload_pods(), nodes, down_slices)
+            self._workload_pods(), nodes, down_slices, hold_slices)
 
     def admits(self, slice_id: str, down_slices: set[str],
                selected_slices: set[str]) -> bool:

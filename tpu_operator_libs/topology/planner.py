@@ -28,7 +28,7 @@ from __future__ import annotations
 import logging
 from typing import TYPE_CHECKING, Optional
 
-from tpu_operator_libs.consts import IN_PROGRESS_STATES
+from tpu_operator_libs.consts import IN_PROGRESS_STATES, TopologyKeys
 from tpu_operator_libs.topology.multislice import MultisliceConstraint
 from tpu_operator_libs.topology.slice_topology import slice_id_for_node
 
@@ -52,17 +52,27 @@ class CanaryWavePlanner:
     slice-atomic planner — a slice-mode canary probes whole cohort
     slices, budget rules unchanged, because the inner planner still
     makes the admission decision over the filtered candidate list.
+
+    ``passthrough`` names nodes admitted ALONGSIDE the cohort: spares
+    reserved for a slice remap (topology/reconfigurer.py) must reach the
+    target revision while still out of their slice — parking them behind
+    a canary wave would stall the remap (and the condemned slice) for
+    the whole bake, for no safety benefit since a spare serves nothing
+    yet.
     """
 
     def __init__(self, inner: "UpgradePlanner",
-                 cohort: "frozenset[str]") -> None:
+                 cohort: "frozenset[str]",
+                 passthrough: "frozenset[str]" = frozenset()) -> None:
         self.inner = inner
         self.cohort = cohort
+        self.passthrough = passthrough
 
     def plan(self, candidates: list["NodeUpgradeState"], available: int,
              state: "ClusterUpgradeState") -> list["NodeUpgradeState"]:
         gated = [ns for ns in candidates
-                 if ns.node.metadata.name in self.cohort]
+                 if ns.node.metadata.name in self.cohort
+                 or ns.node.metadata.name in self.passthrough]
         held = len(candidates) - len(gated)
         if held:
             logger.info(
@@ -80,11 +90,25 @@ class SlicePlanner:
     the :class:`MultisliceConstraint` once and keep the planner (or at
     least the constraint) alive across reconciles so its sticky-down
     membership memory works (see topology/multislice.py).
+
+    ``topology_keys`` (optional) adds slice-reconfiguration awareness:
+
+    - Spares reserved for a remap (``reserved-for`` annotation) are
+      planned FIRST — the condemned slice they will heal waits on their
+      upgrade, so every pass they sit in the queue extends that slice's
+      outage for zero benefit.
+    - Slices holding a fresh ``remapped-at`` settle stamp keep their
+      multislice sticky-down membership until the stamp clears, so the
+      planner cannot take a second member slice in the window where the
+      remapped slice is up but its job's replacement pods are still
+      Pending.
     """
 
     def __init__(self,
-                 constraint: Optional[MultisliceConstraint] = None) -> None:
+                 constraint: Optional[MultisliceConstraint] = None,
+                 topology_keys: Optional[TopologyKeys] = None) -> None:
         self.constraint = constraint
+        self.topology_keys = topology_keys
 
     def plan(self, candidates: list["NodeUpgradeState"], available: int,
              state: "ClusterUpgradeState") -> list["NodeUpgradeState"]:
@@ -112,12 +136,37 @@ class SlicePlanner:
             slice_id_for_node(ns.node)
             for st in IN_PROGRESS_STATES
             for ns in state.bucket(st)}
+        # Freshly remapped slices (settle stamp not yet cleared) hold
+        # their job membership AND count against their job's down
+        # budget even though their hosts are back up: the job's
+        # replacement pods are still Pending there, so for the job the
+        # slice is down in every way that matters — taking a second
+        # member in that window is exactly the double-outage the budget
+        # exists to prevent. (The map releases a held slice early once
+        # live pods re-bind it, which also removes it from the job's
+        # counted set here.)
+        hold_slices: set[str] = set()
+        if self.topology_keys is not None:
+            stamp_key = self.topology_keys.remapped_at_annotation
+            hold_slices = {slice_id_for_node(node) for node in all_nodes
+                           if stamp_key in node.metadata.annotations}
+        counted_down = committed_down | hold_slices
         if self.constraint is not None:
-            self.constraint.begin_round(all_nodes, committed_down)
+            self.constraint.begin_round(all_nodes, committed_down,
+                                        hold_slices)
 
         by_slice: dict[str, list["NodeUpgradeState"]] = {}
         for ns in candidates:
             by_slice.setdefault(slice_id_for_node(ns.node), []).append(ns)
+
+        def reserved_spare(slice_id: str) -> bool:
+            """Candidate slice is a reserved remap spare (spares carry
+            no pool label, so each is its own single-node slice)."""
+            if self.topology_keys is None:
+                return False
+            key = self.topology_keys.reserved_for_annotation
+            return any(key in ns.node.metadata.annotations
+                       for ns in by_slice[slice_id])
 
         def cost(slice_id: str) -> int:
             """Hosts that would *newly* become unavailable."""
@@ -131,7 +180,8 @@ class SlicePlanner:
         order = sorted(
             by_slice,
             key=lambda sid: (
-                not already_broken(sid),  # broken slices first
+                not reserved_spare(sid),  # remap spares first
+                not already_broken(sid),  # then broken slices
                 cost(sid),                # then cheapest
                 sid,                      # deterministic tie-break
             ))
@@ -157,7 +207,7 @@ class SlicePlanner:
                 continue
             if (self.constraint is not None
                     and not self.constraint.admits(
-                        sid, committed_down, selected_down)):
+                        sid, counted_down, selected_down)):
                 # This slice's multislice job already has its budget of
                 # member slices down; defer — it stays upgrade-required
                 # and is reconsidered next round.

@@ -1,0 +1,564 @@
+"""SliceReconfigurer: route a slice around a condemned node.
+
+The Ironwood retrospective credits optical-circuit-switch
+reconfiguration — remapping a slice around failed hosts rather than
+waiting on repair — as a primary fleet-resilience mechanism. This module
+is the GKE-label analogue: slice membership IS the nodepool label, so a
+remap is a pair of crash-ordered label patches instead of an OCS
+program.
+
+When remediation condemns a node (attempt budget exhausted, wedge signal
+still present — the durable ``condemned-at`` annotation plus the
+``NodeCondemned`` Event), the node enters the remediation machine's
+``reconfigure-required`` state and this class drives the remap:
+
+1. **Reserve** a spare from the spare pool (``TopologyKeys.
+   spare_pool_label``, matching accelerator/topology labels) by stamping
+   ``reserved-for: <slice>/<condemned-host>:<epoch>`` on it — the
+   durable booking no second remap can double-claim.
+2. **Joint plan**: wait until the spare is on the target revision
+   (``upgrade-done``, runtime pod ready on the DaemonSet's newest
+   ControllerRevision). The upgrade planners prioritize reserved spares
+   (and pass them through an active canary wave), so the spare takes its
+   one cordon/drain cycle while still OUT of the slice — joining it
+   never disrupts the slice again.
+3. **Join then release**: one patch joins the spare to the pool (and
+   stamps ``remapped-at``), a second removes the condemned node from the
+   pool. Join-before-release means the slice is never observed short of
+   hosts; a crash between the two resumes from the ``remapped-at``
+   marker.
+4. **Degraded admission**: with no eligible spare (or after the
+   spare-provision deadline), the lost host is recorded in the runtime
+   DaemonSet's ``degraded-slices`` annotation in ONE patch (the
+   RolloutGuard quarantine idiom) BEFORE the release — planners and the
+   serving gate see a documented reduced shape, never a silently short
+   slice. A spare appearing later heals the entry back to full shape.
+
+Every decision re-derives from cluster state (annotations + labels), so
+a crashed operator resumes a half-finished remap for free; the object
+itself holds only metrics accumulators. Deadlines (spare provision,
+remap settle) register nudger wakeups so reconfiguration never waits on
+a resync tick.
+"""
+
+from __future__ import annotations
+
+import logging
+from typing import TYPE_CHECKING, Callable, Optional
+
+from tpu_operator_libs.consts import (
+    GKE_NODEPOOL_LABEL,
+    GKE_TPU_ACCELERATOR_LABEL,
+    GKE_TPU_TOPOLOGY_LABEL,
+    POD_CONTROLLER_REVISION_HASH_LABEL,
+    TRUE_STRING,
+    RemediationKeys,
+    TopologyKeys,
+    UpgradeKeys,
+    UpgradeState,
+)
+from tpu_operator_libs.k8s.client import K8sClient
+from tpu_operator_libs.k8s.objects import DaemonSet, Node
+from tpu_operator_libs.k8s.selectors import selector_from_labels
+from tpu_operator_libs.topology.slice_topology import (
+    decode_degraded_slices,
+    encode_degraded_slices,
+)
+from tpu_operator_libs.util import Clock, Event, EventRecorder, log_event
+
+if TYPE_CHECKING:  # pragma: no cover - types only
+    from tpu_operator_libs.api.remediation_policy import (
+        ReconfigurationPolicySpec,
+    )
+    from tpu_operator_libs.remediation.state_machine import (
+        NodeRemediationState,
+        RemediationSnapshot,
+    )
+    from tpu_operator_libs.upgrade.nudger import ReconcileNudger
+
+logger = logging.getLogger(__name__)
+
+#: advance() verdicts the remediation machine commits on.
+RELEASED = "released"
+PENDING = "pending"
+
+
+class SliceReconfigurer:
+    """Remaps slices of condemned nodes onto spares (or degraded shapes).
+
+    ``guard`` wraps every durable write (chaos harnesses pass the crash
+    fuse here so remap commits crash mid-sequence exactly like the state
+    machines' label writes do).
+    """
+
+    def __init__(self, client: K8sClient,
+                 keys: Optional[TopologyKeys] = None,
+                 remediation_keys: Optional[RemediationKeys] = None,
+                 upgrade_keys: Optional[UpgradeKeys] = None,
+                 recorder: Optional[EventRecorder] = None,
+                 clock: Optional[Clock] = None,
+                 nudger: Optional["ReconcileNudger"] = None,
+                 guard: Optional[Callable[[Callable[[], object]], object]]
+                 = None) -> None:
+        self.client = client
+        self.keys = keys or TopologyKeys()
+        self.remediation_keys = remediation_keys or RemediationKeys(
+            driver=self.keys.driver, domain=self.keys.domain)
+        self.upgrade_keys = upgrade_keys or UpgradeKeys(
+            driver=self.keys.driver, domain=self.keys.domain)
+        self.recorder = recorder
+        self.clock = clock or Clock()
+        self.nudger = nudger
+        self._guard = guard or (lambda write: write())
+        # fleet counters (exported via metrics.observe_topology)
+        self.reconfigurations_total = 0
+        self.degraded_admissions_total = 0
+        self.degraded_healed_total = 0
+        self.spares_reserved_total = 0
+        self._remap_seconds: list[float] = []
+        # per-pass working set (begin_pass)
+        self._by_name: dict[str, "NodeRemediationState"] = {}
+        self._daemon_sets: list[DaemonSet] = []
+        self._newest: dict[str, Optional[str]] = {}
+
+    def drain_remap_durations(self) -> "list[float]":
+        """Pop condemned→remapped durations (seconds) accumulated since
+        the last call — the time-to-remapped histogram feed."""
+        out, self._remap_seconds = self._remap_seconds, []
+        return out
+
+    # ------------------------------------------------------------------
+    # per-pass working set
+    # ------------------------------------------------------------------
+    def begin_pass(self, snapshot: "RemediationSnapshot") -> None:
+        """Resolve the pass's runtime DaemonSets, their newest revisions
+        and the per-node index once (the remap decisions below are pure
+        in the snapshot plus these)."""
+        self._by_name = {
+            ns.node.metadata.name: ns
+            for bucket in snapshot.node_states.values() for ns in bucket}
+        self._daemon_sets = sorted(
+            self.client.list_daemon_sets(
+                snapshot.namespace,
+                selector_from_labels(snapshot.runtime_labels)),
+            key=lambda ds: (ds.metadata.namespace, ds.metadata.name))
+        self._newest = {}
+
+    def _newest_hash(self, ds: DaemonSet) -> Optional[str]:
+        cached = self._newest.get(ds.metadata.uid, "unset")
+        if cached != "unset":
+            return cached
+        revisions = self.client.list_controller_revisions(
+            ds.metadata.namespace, selector_from_labels(ds.spec.selector))
+        prefix = f"{ds.metadata.name}-"
+        owned = [r for r in revisions
+                 if r.metadata.name.startswith(prefix)
+                 and "-" not in r.metadata.name[len(prefix):]]
+        newest = (max(owned, key=lambda r: r.revision)
+                  .metadata.name[len(prefix):] if owned else None)
+        self._newest[ds.metadata.uid] = newest
+        return newest
+
+    def _degraded_record(self) -> dict[str, tuple[str, ...]]:
+        """Union of the degraded-slices annotations across the pass's
+        DaemonSets (one runtime DS is the deployed shape; the union
+        keeps multi-DS setups readable)."""
+        merged: dict[str, set[str]] = {}
+        for ds in self._daemon_sets:
+            value = ds.metadata.annotations.get(
+                self.keys.degraded_slices_annotation, "")
+            for sid, hosts in decode_degraded_slices(value).items():
+                merged.setdefault(sid, set()).update(hosts)
+        return {sid: tuple(sorted(hosts))
+                for sid, hosts in merged.items()}
+
+    def _patch_degraded(self, degraded: dict[str, tuple[str, ...]]) -> None:
+        """Commit the degraded record in ONE DaemonSet annotation patch
+        (crash-atomic; empty record deletes the annotation)."""
+        if not self._daemon_sets:
+            raise RuntimeError(
+                "no runtime DaemonSet to carry the degraded-slices record")
+        ds = self._daemon_sets[0]
+        encoded = encode_degraded_slices(degraded) or None
+        fresh = self._guard(
+            lambda: self.client.patch_daemon_set_annotations(
+                ds.metadata.namespace, ds.metadata.name,
+                {self.keys.degraded_slices_annotation: encoded}))
+        ds.metadata.annotations = fresh.metadata.annotations
+
+    # ------------------------------------------------------------------
+    # the reconfigure-required arc (driven by the remediation machine)
+    # ------------------------------------------------------------------
+    def advance(self, ns: "NodeRemediationState",
+                spec: "ReconfigurationPolicySpec") -> str:
+        """One step of the condemned node's remap. Returns ``RELEASED``
+        once the slice no longer depends on the node (the machine then
+        commits reconfigure-required → remediation-failed) or
+        ``PENDING`` while a spare is provisioning."""
+        node = ns.node
+        name = node.metadata.name
+        pool = node.metadata.labels.get(GKE_NODEPOOL_LABEL)
+        if not pool:
+            # already released (crash residue between release and the
+            # state commit), or a single-host "slice" with nothing to
+            # remap — either way the slice no longer depends on it
+            return RELEASED
+
+        degraded = self._degraded_record()
+        if name in degraded.get(pool, ()):
+            # crash residue: the degraded admission committed but the
+            # release did not — finish it
+            self._release(node, pool)
+            return RELEASED
+        joined = self._find_join(pool, name)
+        if joined is not None:
+            # crash residue: a spare already joined for this node
+            self._finish_remap(node, pool, joined)
+            return RELEASED
+
+        spare = self._find_reservation(pool, name)
+        now = self.clock.now()
+        if spare is None:
+            spare = self._pick_spare(node)
+            if spare is not None:
+                self._guard(lambda: self.client.patch_node_annotations(
+                    spare.metadata.name,
+                    {self.keys.reserved_for_annotation:
+                     f"{pool}/{name}:{int(now)}"}))
+                spare.metadata.annotations[
+                    self.keys.reserved_for_annotation] = \
+                    f"{pool}/{name}:{int(now)}"
+                self.spares_reserved_total += 1
+                logger.info(
+                    "reserved spare %s to replace condemned node %s in "
+                    "slice %s", spare.metadata.name, name, pool)
+                log_event(self.recorder, node, Event.NORMAL,
+                          self.keys.event_reason,
+                          f"Spare {spare.metadata.name} reserved to "
+                          f"replace this node in slice {pool}")
+        if spare is None:
+            if spec.allow_degraded:
+                self._admit_degraded(node, pool, degraded)
+                return RELEASED
+            # wait for a spare to join the pool; re-checked every pass
+            # (and on the next resync — there is no deadline to wake on)
+            logger.info(
+                "no eligible spare for slice %s (condemned node %s); "
+                "waiting (allowDegraded=false)", pool, name)
+            return PENDING
+
+        if self._spare_ready(spare):
+            self._join_spare(spare, pool, name, now)
+            self._finish_remap(node, pool, spare.metadata.name)
+            return RELEASED
+
+        reserved_at = self._reservation_epoch(spare)
+        timeout = spec.spare_provision_timeout_seconds
+        if timeout and reserved_at is not None \
+                and now - reserved_at > timeout:
+            # the spare never provisioned: abandon the booking and fall
+            # back to a degraded admission (or keep waiting next pass
+            # with a fresh pick when degraded shapes are disallowed)
+            self._guard(lambda: self.client.patch_node_annotations(
+                spare.metadata.name,
+                {self.keys.reserved_for_annotation: None}))
+            spare.metadata.annotations.pop(
+                self.keys.reserved_for_annotation, None)
+            logger.warning(
+                "spare %s missed the provision deadline (%gs) for slice "
+                "%s; abandoning the reservation", spare.metadata.name,
+                timeout, pool)
+            if spec.allow_degraded:
+                self._admit_degraded(node, pool, degraded)
+                return RELEASED
+            return PENDING
+        if timeout and reserved_at is not None and self.nudger is not None:
+            # act on the provision deadline at the deadline, not at
+            # whatever resync follows it
+            self.nudger.nudge_at(reserved_at + timeout, "spare-provision")
+        return PENDING
+
+    def abort(self, node: Node) -> None:
+        """A condemned node was re-armed mid-reconfiguration: drop any
+        spare booking made for it (the node itself re-enters
+        revalidation; its slice membership is untouched)."""
+        pool = node.metadata.labels.get(GKE_NODEPOOL_LABEL, "")
+        spare = self._find_reservation(pool, node.metadata.name)
+        if spare is None:
+            return
+        self._guard(lambda: self.client.patch_node_annotations(
+            spare.metadata.name,
+            {self.keys.reserved_for_annotation: None}))
+        spare.metadata.annotations.pop(
+            self.keys.reserved_for_annotation, None)
+
+    # ------------------------------------------------------------------
+    # post-bucket reconcile: settle expiry + degraded healing
+    # ------------------------------------------------------------------
+    def reconcile_extras(self, snapshot: "RemediationSnapshot",
+                         spec: "ReconfigurationPolicySpec") -> None:
+        """Pass-scoped follow-through that is not tied to a condemned
+        node: heal degraded slices when a spare has become available,
+        then clear settled ``remapped-at`` stamps (ending the multislice
+        membership hold). Heal runs FIRST — it consumes join stamps to
+        retire degraded entries, so the clear must never get there
+        before it."""
+        self._heal_degraded(spec)
+        self._clear_settled_stamps(spec)
+
+    def _clear_settled_stamps(self, spec: "ReconfigurationPolicySpec",
+                              ) -> None:
+        now = self.clock.now()
+        key = self.keys.remapped_at_annotation
+        degraded = self._degraded_record()
+        for name, ns in sorted(self._by_name.items()):
+            raw = ns.node.metadata.annotations.get(key)
+            if raw is None:
+                continue
+            epoch_raw, _, missing = raw.partition(":")
+            try:
+                epoch = float(epoch_raw)
+            except ValueError:
+                epoch = 0.0  # corrupt stamp: clear immediately
+            pool = ns.node.metadata.labels.get(GKE_NODEPOOL_LABEL, "")
+            released = not any(
+                other.node.metadata.labels.get(GKE_NODEPOOL_LABEL) == pool
+                and other_name == missing
+                for other_name, other in self._by_name.items())
+            if not released:
+                # the condemned host is still a pool member (release in
+                # flight): the hold must outlive the join→release window
+                continue
+            if missing in degraded.get(pool, ()):
+                # a heal join whose degraded-record retirement has not
+                # committed yet: the stamp is that crash window's resume
+                # marker — keep it until the entry is gone
+                continue
+            if now < epoch + spec.settle_seconds:
+                if self.nudger is not None:
+                    self.nudger.nudge_at(epoch + spec.settle_seconds,
+                                         "reconfig-settle")
+                continue
+            self._guard(lambda n=name: self.client.patch_node_annotations(
+                n, {key: None}))
+            ns.node.metadata.annotations.pop(key, None)
+
+    def _heal_degraded(self, spec: "ReconfigurationPolicySpec") -> None:
+        """A spare that appeared after a degraded admission restores the
+        slice to full shape: reserve → (joint-plan wait) → join → drop
+        the lost host from the degraded record."""
+        degraded = self._degraded_record()
+        for pool, losts in sorted(degraded.items()):
+            exemplar = next(
+                (ns.node for ns in self._by_name.values()
+                 if ns.node.metadata.labels.get(GKE_NODEPOOL_LABEL)
+                 == pool), None)
+            for lost in losts:
+                joined = self._find_join(pool, lost)
+                if joined is not None:
+                    remaining = dict(degraded)
+                    remaining[pool] = tuple(
+                        h for h in remaining[pool] if h != lost)
+                    self._patch_degraded(remaining)
+                    degraded = remaining
+                    self.degraded_healed_total += 1
+                    logger.info(
+                        "degraded slice %s healed: spare %s restored the "
+                        "shape lost with host %s", pool, joined, lost)
+                    continue
+                if exemplar is None:
+                    continue  # pool fully vanished; nothing to match
+                spare = self._find_reservation(pool, lost)
+                now = self.clock.now()
+                if spare is None:
+                    spare = self._pick_spare(exemplar)
+                    if spare is None:
+                        continue
+                    self._guard(
+                        lambda s=spare: self.client.patch_node_annotations(
+                            s.metadata.name,
+                            {self.keys.reserved_for_annotation:
+                             f"{pool}/{lost}:{int(now)}"}))
+                    spare.metadata.annotations[
+                        self.keys.reserved_for_annotation] = \
+                        f"{pool}/{lost}:{int(now)}"
+                    self.spares_reserved_total += 1
+                if self._spare_ready(spare):
+                    self._join_spare(spare, pool, lost, now)
+
+    # ------------------------------------------------------------------
+    # remap mechanics
+    # ------------------------------------------------------------------
+    def _find_reservation(self, pool: str,
+                          missing: str) -> Optional[Node]:
+        """The spare durably booked for (pool, missing host), if any."""
+        prefix = f"{pool}/{missing}:"
+        for name, ns in sorted(self._by_name.items()):
+            raw = ns.node.metadata.annotations.get(
+                self.keys.reserved_for_annotation, "")
+            if raw.startswith(prefix):
+                return ns.node
+        return None
+
+    def _reservation_epoch(self, spare: Node) -> Optional[float]:
+        raw = spare.metadata.annotations.get(
+            self.keys.reserved_for_annotation, "")
+        _, _, epoch = raw.rpartition(":")
+        try:
+            return float(epoch)
+        except ValueError:
+            return None
+
+    def _find_join(self, pool: str, missing: str) -> Optional[str]:
+        """Name of a pool member whose ``remapped-at`` stamp records it
+        replaced ``missing`` (the crash-safe join marker)."""
+        for name, ns in sorted(self._by_name.items()):
+            if ns.node.metadata.labels.get(GKE_NODEPOOL_LABEL) != pool:
+                continue
+            raw = ns.node.metadata.annotations.get(
+                self.keys.remapped_at_annotation, "")
+            if raw.partition(":")[2] == missing:
+                return name
+        return None
+
+    def _pick_spare(self, condemned: Node) -> Optional[Node]:
+        """Deterministic spare choice: the first (sorted) unreserved
+        spare-pool node matching the condemned node's accelerator and
+        topology labels, healthy under both machines."""
+        want = {key: condemned.metadata.labels.get(key, "")
+                for key in (GKE_TPU_ACCELERATOR_LABEL,
+                            GKE_TPU_TOPOLOGY_LABEL)}
+        for name, ns in sorted(self._by_name.items()):
+            node = ns.node
+            labels = node.metadata.labels
+            if labels.get(self.keys.spare_pool_label) != TRUE_STRING:
+                continue
+            if GKE_NODEPOOL_LABEL in labels:
+                continue  # already a slice member
+            if any(labels.get(key, "") != value
+                   for key, value in want.items()):
+                continue
+            annotations = node.metadata.annotations
+            if self.keys.reserved_for_annotation in annotations:
+                continue  # booked for another remap
+            if self.remediation_keys.condemned_annotation in annotations:
+                continue
+            if labels.get(self.remediation_keys.state_label, ""):
+                continue  # under remediation itself
+            if not node.is_ready():
+                continue
+            return node
+        return None
+
+    def _spare_ready(self, spare: Node) -> bool:
+        """The joint-planning gate: the spare joins only once it is
+        upgrade-done, schedulable, and its runtime pod is Ready on the
+        DaemonSet's newest revision — its one cordon/drain cycle happened
+        while it was still out of the slice."""
+        if spare.is_unschedulable() or not spare.is_ready():
+            return False
+        if spare.metadata.labels.get(
+                self.upgrade_keys.state_label, "") \
+                != str(UpgradeState.DONE):
+            return False
+        ns = self._by_name.get(spare.metadata.name)
+        pod = ns.runtime_pod if ns is not None else None
+        if pod is None or not pod.is_ready():
+            return False
+        pod_hash = pod.metadata.labels.get(
+            POD_CONTROLLER_REVISION_HASH_LABEL)
+        ds = (None if pod.controller_owner() is None else next(
+            (d for d in self._daemon_sets
+             if d.metadata.uid == pod.controller_owner().uid), None))
+        if ds is None:
+            return False
+        return pod_hash is not None and pod_hash == self._newest_hash(ds)
+
+    def _join_spare(self, spare: Node, pool: str, missing: str,
+                    now: float) -> None:
+        """ONE patch joins the spare: pool membership, spare label off,
+        reservation cleared, remapped-at stamped. Committed BEFORE the
+        condemned node's release so the slice is never observed short."""
+        stamp = f"{int(now)}:{missing}"
+        self._guard(lambda: self.client.patch_node_meta(
+            spare.metadata.name,
+            labels={GKE_NODEPOOL_LABEL: pool,
+                    self.keys.spare_pool_label: None},
+            annotations={self.keys.reserved_for_annotation: None,
+                         self.keys.remapped_at_annotation: stamp}))
+        spare.metadata.labels[GKE_NODEPOOL_LABEL] = pool
+        spare.metadata.labels.pop(self.keys.spare_pool_label, None)
+        spare.metadata.annotations.pop(
+            self.keys.reserved_for_annotation, None)
+        spare.metadata.annotations[self.keys.remapped_at_annotation] = stamp
+        if self.nudger is not None:
+            self.nudger.nudge("reconfig-join")
+        logger.warning(
+            "SLICE REMAP: spare %s joined slice %s replacing host %s",
+            spare.metadata.name, pool, missing)
+        log_event(self.recorder, spare, Event.NORMAL,
+                  self.keys.event_reason,
+                  f"Joined slice {pool} as replacement for condemned "
+                  f"host {missing}")
+
+    def _release(self, node: Node, pool: str) -> None:
+        """Remove the condemned node from its pool (it becomes its own
+        single-node 'slice', parked for repair)."""
+        self._guard(lambda: self.client.patch_node_meta(
+            node.metadata.name,
+            labels={GKE_NODEPOOL_LABEL: None},
+            annotations={self.keys.released_from_annotation: pool}))
+        node.metadata.labels.pop(GKE_NODEPOOL_LABEL, None)
+        node.metadata.annotations[
+            self.keys.released_from_annotation] = pool
+
+    def _finish_remap(self, node: Node, pool: str, spare_name: str) -> None:
+        self._release(node, pool)
+        self.reconfigurations_total += 1
+        condemned_raw = node.metadata.annotations.get(
+            self.remediation_keys.condemned_annotation)
+        if condemned_raw is not None:
+            try:
+                self._remap_seconds.append(
+                    max(0.0, self.clock.now() - float(condemned_raw)))
+            except ValueError:
+                pass  # corrupt stamp: lose the sample, not the remap
+        logger.info("slice %s released from condemned node %s (replaced "
+                    "by %s)", pool, node.metadata.name, spare_name)
+        log_event(self.recorder, node, Event.NORMAL,
+                  self.keys.event_reason,
+                  f"Released from slice {pool}: remapped onto spare "
+                  f"{spare_name}")
+
+    def _admit_degraded(self, node: Node, pool: str,
+                        degraded: dict[str, tuple[str, ...]]) -> None:
+        """No spare: record the lost host durably (ONE DaemonSet patch)
+        then release the node — the slice runs a documented reduced
+        shape instead of parking."""
+        updated = dict(degraded)
+        updated[pool] = tuple(sorted(
+            set(updated.get(pool, ())) | {node.metadata.name}))
+        self._patch_degraded(updated)
+        self._release(node, pool)
+        self.degraded_admissions_total += 1
+        logger.warning(
+            "DEGRADED ADMISSION: slice %s continues without host %s "
+            "(no eligible spare)", pool, node.metadata.name)
+        log_event(self.recorder, node, Event.WARNING,
+                  self.keys.event_reason,
+                  f"Slice {pool} admitted in degraded shape: host "
+                  f"{node.metadata.name} lost, no spare available")
+
+    # ------------------------------------------------------------------
+    # status feed
+    # ------------------------------------------------------------------
+    def status(self) -> dict:
+        """CRD-embeddable lifetime counters (point-in-time spare-pool
+        gauges come from the snapshot via cluster_status /
+        observe_topology)."""
+        return {
+            "reconfigurations": self.reconfigurations_total,
+            "degradedAdmissions": self.degraded_admissions_total,
+            "degradedHealed": self.degraded_healed_total,
+            "sparesReserved": self.spares_reserved_total,
+        }

@@ -45,6 +45,33 @@ def parse_chip_topology(topology: str) -> Optional[tuple[int, ...]]:
     return dims if dims else None
 
 
+def decode_degraded_slices(value: str) -> dict[str, tuple[str, ...]]:
+    """Parse a degraded-slices annotation value into
+    ``{slice_id: (lost host names...)}``.
+
+    Wire format (one DaemonSet annotation, crash-atomic to patch):
+    ``slice:host[+host...]`` entries joined by commas, everything
+    sorted. Malformed fragments are dropped rather than raising — the
+    annotation is operator-visible and hand-editable."""
+    out: dict[str, tuple[str, ...]] = {}
+    for entry in (value or "").split(","):
+        slice_id, sep, hosts = entry.strip().partition(":")
+        if not sep or not slice_id:
+            continue
+        names = tuple(sorted({h for h in hosts.split("+") if h}))
+        if names:
+            out[slice_id] = names
+    return out
+
+
+def encode_degraded_slices(degraded: dict[str, tuple[str, ...]]) -> str:
+    """Inverse of :func:`decode_degraded_slices`; "" when empty (an
+    empty value deletes the annotation on a merge patch)."""
+    return ",".join(
+        f"{slice_id}:{'+'.join(sorted(set(hosts)))}"
+        for slice_id, hosts in sorted(degraded.items()) if hosts)
+
+
 @dataclass
 class SliceInfo:
     """One ICI domain: the atomic unit of upgrade."""
@@ -53,10 +80,20 @@ class SliceInfo:
     nodes: list[Node] = field(default_factory=list)
     accelerator: str = ""
     topology: str = ""
+    #: Host names the slice durably lost to degraded admissions (the
+    #: SliceReconfigurer found no spare for a condemned member). The
+    #: slice runs a documented reduced shape: ``nodes`` holds only the
+    #: remaining hosts, so availability math over them stays truthful,
+    #: and consumers that need the full-shape picture read this field.
+    lost_hosts: tuple[str, ...] = ()
 
     @property
     def is_multi_host(self) -> bool:
         return len(self.nodes) > 1
+
+    @property
+    def declared_degraded(self) -> bool:
+        return bool(self.lost_hosts)
 
     @property
     def chip_count(self) -> Optional[int]:
@@ -83,7 +120,13 @@ class SliceTopology:
         self._slices = slices
 
     @classmethod
-    def from_nodes(cls, nodes: Iterable[Node]) -> "SliceTopology":
+    def from_nodes(cls, nodes: Iterable[Node],
+                   degraded: Optional[dict[str, tuple[str, ...]]] = None,
+                   ) -> "SliceTopology":
+        """``degraded`` (slice id -> lost host names, the decoded
+        degraded-slices DaemonSet annotation) marks slices running a
+        documented reduced shape."""
+        degraded = degraded or {}
         slices: dict[str, SliceInfo] = {}
         for node in nodes:
             sid = slice_id_for_node(node)
@@ -93,7 +136,8 @@ class SliceTopology:
                 info = SliceInfo(
                     slice_id=sid,
                     accelerator=labels.get(GKE_TPU_ACCELERATOR_LABEL, ""),
-                    topology=labels.get(GKE_TPU_TOPOLOGY_LABEL, ""))
+                    topology=labels.get(GKE_TPU_TOPOLOGY_LABEL, ""),
+                    lost_hosts=degraded.get(sid, ()))
                 slices[sid] = info
             info.nodes.append(node)
         return cls(slices)

@@ -59,6 +59,7 @@ from tpu_operator_libs.consts import (
     ALL_STATES,
     IN_PROGRESS_STATES,
     TRUE_STRING,
+    TopologyKeys,
     UpgradeKeys,
     UpgradeState,
 )
@@ -207,6 +208,12 @@ class ClusterUpgradeStateManager:
                  incremental_reads: bool = True,
                  nudger: Optional["ReconcileNudger"] = None) -> None:
         self.keys = keys or UpgradeKeys()
+        # Same driver/domain family as the upgrade keys: marks the
+        # slice-reconfiguration surface (spare reservations, remap
+        # settle stamps, the degraded-slices DS record) the planners and
+        # cluster_status consult for joint planning.
+        self.topology_keys = TopologyKeys(driver=self.keys.driver,
+                                          domain=self.keys.domain)
         self.client = client
         self.recorder = recorder
         self.clock = clock or Clock()
@@ -641,7 +648,19 @@ class ClusterUpgradeStateManager:
             from tpu_operator_libs.topology.planner import (
                 CanaryWavePlanner,
             )
-            planner = CanaryWavePlanner(planner, self._rollout.cohort)
+            # Joint planning with slice reconfiguration: a spare
+            # reserved for a remap must reach the target revision while
+            # it is still OUT of the slice, so it passes through the
+            # canary gate instead of parking behind the cohort (its
+            # upgrade IS part of the remediation path, and it serves no
+            # traffic yet).
+            reserved_spares = frozenset(
+                ns.node.metadata.name
+                for ns in state.bucket(UpgradeState.UPGRADE_REQUIRED)
+                if self.topology_keys.reserved_for_annotation
+                in ns.node.metadata.annotations)
+            planner = CanaryWavePlanner(planner, self._rollout.cohort,
+                                        passthrough=reserved_spares)
         self.process_upgrade_required_nodes(
             state, upgrades_available, planner=planner)
         self.process_cordon_required_nodes(state)
@@ -833,7 +852,8 @@ class ClusterUpgradeStateManager:
             self, policy: UpgradePolicySpec) -> UpgradePlanner:
         if self._explicit_planner is None and policy.topology_mode == "slice":
             from tpu_operator_libs.topology.planner import SlicePlanner
-            return SlicePlanner(self._multislice_for_policy(policy))
+            return SlicePlanner(self._multislice_for_policy(policy),
+                                topology_keys=self.topology_keys)
         # The slice planner is not running, so nothing enforces (or
         # refreshes) multislice deferrals — stale ones must not keep
         # reporting through status/metrics after a switch to flat mode
@@ -1066,6 +1086,18 @@ class ClusterUpgradeStateManager:
         passes.
         """
         def recover(ns: NodeUpgradeState) -> None:
+            if self._skip_node_upgrade(ns.node):
+                # The remediation machine parks a node it quarantines
+                # behind the skip label (cordon → recovery). A FAILED
+                # node under that quarantine must wait it out: acting
+                # here — uncordon-on-healthy or the drain re-entry —
+                # would have two machines driving one node mid-ladder.
+                # (A user-set skip reads the same way: hands off.)
+                logger.info(
+                    "failed node %s carries the skip label (remediation "
+                    "quarantine or operator opt-out); holding recovery",
+                    ns.node.metadata.name)
+                return
             synced, orphaned = self._pod_in_sync_with_ds(ns)
             if not synced and not orphaned \
                     and ns.runtime_pod.is_ready():
@@ -1458,6 +1490,13 @@ class ClusterUpgradeStateManager:
             # why the upgrade is pacing: these slices wait for a member
             # of their DCN job to come back up
             status["multisliceDeferredSlices"] = list(deferred)
+        topology_block = self._topology_status(state, nodes)
+        if topology_block:
+            # the reconfiguration picture: spare-pool depth, bookings in
+            # flight, and any slices admitted in a degraded shape —
+            # derived from the snapshot alone, so every operator
+            # incarnation reports the same truth
+            status["topology"] = topology_block
         # per-node transitions deferred on transient cluster errors in
         # the MOST RECENT pass (after a chained reconcile: the count
         # still outstanding at chain exit) — a current-flakiness
@@ -1482,6 +1521,41 @@ class ClusterUpgradeStateManager:
                 # lifetime activity, matching observe_latency's counters
                 status["wakeups"] = wakeups
         return status
+
+    def _topology_status(self, state: ClusterUpgradeState,
+                         nodes: "list[Node]") -> dict:
+        """Spare-pool / degraded-slice block for cluster_status (empty
+        dict when neither exists — non-reconfiguring fleets see no new
+        key)."""
+        from tpu_operator_libs.topology.slice_topology import (
+            decode_degraded_slices,
+        )
+
+        keys = self.topology_keys
+        spares = [n for n in nodes
+                  if n.metadata.labels.get(keys.spare_pool_label)
+                  == TRUE_STRING]
+        reserved = sum(1 for n in spares
+                       if keys.reserved_for_annotation
+                       in n.metadata.annotations)
+        degraded: dict[str, tuple[str, ...]] = {}
+        seen_ds: set[str] = set()
+        for bucket in state.node_states.values():
+            for ns in bucket:
+                ds = ns.runtime_daemon_set
+                if ds is None or ds.metadata.uid in seen_ds:
+                    continue
+                seen_ds.add(ds.metadata.uid)
+                degraded.update(decode_degraded_slices(
+                    ds.metadata.annotations.get(
+                        keys.degraded_slices_annotation, "")))
+        out: dict = {}
+        if spares:
+            out["sparePool"] = {"size": len(spares), "inUse": reserved}
+        if degraded:
+            out["degradedSlices"] = {
+                sid: list(hosts) for sid, hosts in sorted(degraded.items())}
+        return out
 
     # ------------------------------------------------------------------
     # chained reconcile
