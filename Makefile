@@ -4,7 +4,7 @@ PYTHON ?= python3
 PYTEST_FLAGS ?= -q
 COV_THRESHOLD ?= 85
 
-.PHONY: all check test test-fast test-fault test-chaos test-soak test-scale test-rollout test-latency test-reconfig lint cov bench bench-reconcile bench-latency graft-check package clean diagram
+.PHONY: all check test test-fast test-fault test-chaos test-soak test-scale test-rollout test-latency test-reconfig test-shard lint cov bench bench-reconcile bench-latency bench-shard graft-check package clean diagram
 
 all: lint test
 
@@ -118,6 +118,20 @@ test-scale:
 # nodes (tools/latency_bench.py; docs/benchmarks.md §2d).
 bench-latency:
 	$(PYTHON) tools/latency_bench.py
+
+# Sharded-control-plane slice: ring/elector/fencing/budget-share units,
+# the single-replica equivalence pin, the sharded wire smoke (2
+# concurrent replicas over sockets), and the replica-kill chaos gate
+# (10 fixed seeds: kills/deposes mid-wave, zero shard-invariant
+# violations; widen with CHAOS_SEEDS/CHAOS_STEPS via `make test-soak`).
+test-shard:
+	$(PYTHON) -m pytest tests/ $(PYTEST_FLAGS) -m "shard and not slow"
+
+# Sharded-control-plane scale proof: single-owner vs 4 sharded replicas
+# on a 16k-node simulated fleet, bit-identical final cluster state
+# (tools/latency_bench.py --shard-nodes; docs/sharded-control-plane.md).
+bench-shard:
+	$(PYTHON) tools/latency_bench.py --shard-nodes 16384 --shard-replicas 4
 
 # Event-driven scheduling regressions (`latency` marker): timer wheel,
 # nudge dedup, eager refill, and the 64-node bench smoke are tier-1;

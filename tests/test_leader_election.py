@@ -468,3 +468,126 @@ class TestHAOperatorComposition:
         elector_b.release()
         if b["cached"] is not None:
             b["cached"].stop()
+
+
+class TestHardening:
+    """PR-7 satellites: jittered renewals, transition counters, and the
+    release-vs-renew race regression."""
+
+    def test_release_uses_fresh_record_not_stale_observation(self):
+        """REGRESSION: release() racing a concurrent
+        try_acquire_or_renew. The renew advances the lease's
+        resourceVersion after release() captured its observation; the
+        old implementation then wrote with the STALE version, hit a
+        conflict, returned False — and the lease stayed HELD at
+        shutdown, forcing the successor to wait out the whole duration.
+        The fix re-reads the live record under the op lock, so a
+        release issued after any number of interleaved renews still
+        lands."""
+        clock = FakeClock()
+        cluster = FakeCluster(clock=clock)
+        elector = make_elector(cluster, clock, "a")
+        assert elector.try_acquire_or_renew()
+        # interleaved renew: bumps the lease's resourceVersion
+        clock.advance(2.0)
+        assert elector.try_acquire_or_renew()
+        # simulate the race's observable half: the elector's local
+        # observation goes stale relative to the record (the thread
+        # interleaving the op lock now makes impossible to hit live)
+        elector._observed.metadata.resource_version = "0"
+        assert elector.release() is True
+        assert cluster.get_lease(NS, NAME).holder_identity == ""
+
+    def test_release_refuses_anothers_lease(self):
+        clock = FakeClock()
+        cluster = FakeCluster(clock=clock)
+        elector = make_elector(cluster, clock, "a")
+        assert elector.try_acquire_or_renew()
+        cluster.steal_lease(NS, NAME, "intruder")
+        assert elector.release() is False
+        assert cluster.get_lease(NS, NAME).holder_identity == "intruder"
+
+    def test_concurrent_release_and_renew_serialize(self):
+        """Hammer the two write paths from two threads: whatever the
+        interleaving, the final release must leave the lease released
+        and the elector consistent (the op lock's contract)."""
+        clock = FakeClock()
+        cluster = FakeCluster(clock=clock)
+        elector = make_elector(cluster, clock, "a")
+        assert elector.try_acquire_or_renew()
+        stop = threading.Event()
+
+        def renew_loop():
+            while not stop.is_set():
+                elector.try_acquire_or_renew()
+
+        thread = threading.Thread(target=renew_loop, daemon=True)
+        thread.start()
+        try:
+            for _ in range(50):
+                elector.release()
+        finally:
+            stop.set()
+            thread.join(timeout=5.0)
+        elector.step_down()
+        assert elector.release() is False  # not leading any more
+        # a final explicit cycle proves the record is still coherent
+        assert elector.try_acquire_or_renew() is True
+        assert elector.release() is True
+        assert cluster.get_lease(NS, NAME).holder_identity == ""
+
+    def test_transition_counters(self):
+        clock = FakeClock()
+        cluster = FakeCluster(clock=clock)
+        elector = make_elector(cluster, clock, "a")
+        assert elector.try_acquire_or_renew()
+        assert (elector.acquires_total, elector.losses_total) == (1, 0)
+        elector.step_down()
+        assert (elector.acquires_total, elector.losses_total) == (1, 1)
+        assert elector.try_acquire_or_renew()
+        assert elector.acquires_total == 2
+
+    def test_observe_refreshes_without_contending(self):
+        clock = FakeClock()
+        cluster = FakeCluster(clock=clock)
+        holder = make_elector(cluster, clock, "a")
+        watcher = make_elector(cluster, clock, "b")
+        assert holder.try_acquire_or_renew()
+        watcher.observe()
+        assert watcher.observed_leader == "a"
+        assert not watcher.is_leader
+        # observation alone never writes the record
+        assert cluster.get_lease(NS, NAME).holder_identity == "a"
+
+    def test_renew_jitter_validated_and_applied(self):
+        with pytest.raises(ValueError):
+            LeaderElectionConfig(NS, NAME, "a", renew_jitter=1.5)
+        clock = FakeClock()
+        cluster = FakeCluster(clock=clock)
+        config = LeaderElectionConfig(
+            namespace=NS, name=NAME, identity="a",
+            lease_duration=15.0, renew_deadline=10.0,
+            retry_period=2.0, renew_jitter=0.5)
+        elector = LeaderElector(cluster, config, clock=clock)
+        stop = threading.Event()
+        thread = threading.Thread(target=lambda: elector.run(stop),
+                                  daemon=True)
+        thread.start()
+        import time as _time
+
+        deadline = _time.monotonic() + 5.0
+        while not elector.is_leader and _time.monotonic() < deadline:
+            _time.sleep(0.01)
+        assert elector.is_leader
+        # the jittered sleep stretches the cadence but never shrinks it
+        # below retry_period; with the FakeClock, virtual time advances
+        # only by the elector's own sleeps, which we just let run a few
+        before = clock.now()
+        deadline = _time.monotonic() + 5.0
+        while clock.now() < before + 3 * config.retry_period \
+                and _time.monotonic() < deadline:
+            _time.sleep(0.01)
+        stop.set()
+        thread.join(timeout=5.0)
+        advanced = clock.now() - before
+        assert advanced >= 3 * config.retry_period

@@ -151,6 +151,12 @@ def main() -> int:
     parser.add_argument("--out", default=None,
                         help="write the run artifact JSON here (same "
                              "schema as docs/wire_smoke_run.json)")
+    parser.add_argument("--replicas", type=int, default=1,
+                        help="run this many CONCURRENT sharded operator "
+                             "replicas (per-shard Leases, fenced "
+                             "writes, durable budget shares — "
+                             "docs/sharded-control-plane.md) instead "
+                             "of one single-owner manager")
     args = parser.parse_args()
     ctx = args.context or sh(
         "kubectl", "config", "current-context").strip()
@@ -192,21 +198,52 @@ def main() -> int:
     kubectl(ctx, "apply", "-f", "-",
             stdin=DS_TEMPLATE.format(ns=NS, marker="new"))
 
-    # 4. drive the real state machine through RealCluster
+    # 4. drive the real state machine through RealCluster — one
+    # single-owner manager, or (--replicas N) N concurrent sharded
+    # replicas, each with its own client + ShardElector: the same
+    # wire-path proof the in-image smoke commits, against a genuine
+    # apiserver (Lease CAS, merge patches, eviction subresource)
     client = RealCluster.from_kubeconfig(context=args.context)
     keys = UpgradeKeys()
     recorder = CorrelatingEventRecorder(
         sink=ClusterEventSink(client, NS))
-    mgr = ClusterUpgradeStateManager(client, keys, recorder=recorder,
-                                     async_workers=False,
-                                     poll_interval=0.5)
+    managers = []
+    electors = []
+    if args.replicas > 1:
+        from tpu_operator_libs.k8s.sharding import (
+            ShardElectionConfig,
+            ShardElector,
+        )
+
+        for i in range(args.replicas):
+            replica_client = RealCluster.from_kubeconfig(
+                context=args.context)
+            elector = ShardElector(
+                replica_client,
+                ShardElectionConfig(
+                    namespace=NS, identity=f"kind-replica-{i}",
+                    num_shards=args.replicas * 2 + 1,
+                    replicas=args.replicas,
+                    lease_prefix="kind-shard",
+                    lease_duration=8.0, renew_deadline=5.0,
+                    retry_period=1.0))
+            electors.append(elector)
+            managers.append(ClusterUpgradeStateManager(
+                replica_client, keys, recorder=recorder,
+                async_workers=False,
+                poll_interval=0.5).with_sharding(elector))
+    else:
+        managers.append(ClusterUpgradeStateManager(
+            client, keys, recorder=recorder, async_workers=False,
+            poll_interval=0.5))
     policy = UpgradePolicySpec(
         auto_upgrade=True, max_parallel_upgrades=0,
         max_unavailable="100%",  # single-node kind: allow the only node
         drain=DrainSpec(enable=True, force=True, timeout_seconds=120))
 
     node_names = [n.metadata.name for n in client.list_nodes()]
-    print(f"kind_smoke: upgrading nodes: {node_names}")
+    print(f"kind_smoke: upgrading nodes: {node_names} "
+          f"({len(managers)} operator replica(s))")
     t0 = time.monotonic()
     deadline = t0 + args.timeout
     label = keys.state_label
@@ -214,11 +251,19 @@ def main() -> int:
     last_state: dict = {}
     converged = False
     while time.monotonic() < deadline:
-        try:
-            state = mgr.reconcile(NS, RUNTIME_LABELS, policy)
-        except BuildStateError as exc:
-            print(f"kind_smoke: snapshot incomplete ({exc}); retrying")
-            state = None
+        state = None
+        for elector in electors:
+            elector.tick()
+        for mgr in managers:
+            if mgr.shard_view is not None \
+                    and not mgr.shard_view.owned_shards():
+                continue
+            try:
+                state = mgr.reconcile(NS, RUNTIME_LABELS, policy) \
+                    or state
+            except BuildStateError as exc:
+                print(f"kind_smoke: snapshot incomplete ({exc}); "
+                      f"retrying")
         if state is not None:
             states = {}
             for node in client.list_nodes():
@@ -239,6 +284,8 @@ def main() -> int:
                 converged = True
                 break
         time.sleep(2.0)
+    for elector in electors:
+        elector.release_all()
     recorder.flush()
 
     # One snapshot serves the assertions AND the artifact — re-listing

@@ -315,6 +315,133 @@ def run_latency_cell(n_nodes: int, event_driven: bool,
     }
 
 
+#: Shard-cell election knobs: long leases + per-tick renewal keep the
+#: election itself off the critical path (the bench measures the ring's
+#: scaling, not lease churn — the chaos gate owns churn).
+SHARD_LEASE_DURATION = 120.0
+SHARD_TICK_INTERVAL = 30.0
+
+
+def run_shard_cell(n_nodes: int, replicas: int,
+                   interval: float = SHARD_TICK_INTERVAL,
+                   max_sim_seconds: float = 12 * 3600.0) -> dict:
+    """One full rolling upgrade, single-owner (``replicas <= 1``) or
+    partitioned across ``replicas`` sharded replicas with real
+    ShardElectors (per-shard Leases, ownership-filtered snapshots,
+    fenced writes, durable budget shares) on the same FakeCluster
+    virtual clock. Returns makespan + write accounting + the final
+    cluster-state fingerprint — the sharded cell must be bit-identical
+    to the single-owner cell (the ring changes WHO commits each
+    transition, never what converges)."""
+    from tpu_operator_libs.k8s.sharding import (
+        ShardElectionConfig,
+        ShardElector,
+    )
+
+    if n_nodes % HOSTS_PER_SLICE:
+        raise ValueError(f"n_nodes must be a multiple of {HOSTS_PER_SLICE}")
+    fleet = FleetSpec(n_slices=n_nodes // HOSTS_PER_SLICE,
+                      hosts_per_slice=HOSTS_PER_SLICE,
+                      pod_recreate_delay=POD_RECREATE_DELAY,
+                      pod_ready_delay=POD_READY_DELAY)
+    cluster, clock, keys = build_fleet(fleet)
+    policy = UpgradePolicySpec(
+        auto_upgrade=True, max_parallel_upgrades=0,
+        max_unavailable="25%", topology_mode="flat",
+        drain=DrainSpec(enable=False))
+    electors: list = []
+    managers: list = []
+    if replicas <= 1:
+        managers.append(ClusterUpgradeStateManager(
+            cluster, keys, clock=clock, async_workers=False,
+            poll_interval=0.0))
+    else:
+        for i in range(replicas):
+            elector = ShardElector(
+                cluster,
+                ShardElectionConfig(
+                    namespace="kube-system", identity=f"bench-{i}",
+                    num_shards=replicas * 2, replicas=replicas,
+                    lease_prefix="bench-shard",
+                    lease_duration=SHARD_LEASE_DURATION,
+                    renew_deadline=SHARD_LEASE_DURATION * 2 / 3,
+                    retry_period=10.0, renew_jitter=0.0),
+                clock=clock)
+            electors.append(elector)
+            managers.append(ClusterUpgradeStateManager(
+                cluster, keys, clock=clock, async_workers=False,
+                poll_interval=0.0).with_sharding(elector))
+    # settle the election before the upgrade starts (slot claims +
+    # handover need a couple of rounds; a real deployment's replicas
+    # are up long before a rollout begins)
+    for _ in range(3):
+        for elector in electors:
+            elector.tick()
+    done = str(UpgradeState.DONE)
+    reconciles = 0
+    converged = False
+    while clock.now() < max_sim_seconds:
+        for elector in electors:
+            elector.tick()
+        for mgr in managers:
+            if mgr.shard_view is not None \
+                    and not mgr.shard_view.owned_shards():
+                continue
+            try:
+                mgr.reconcile(NS, RUNTIME_LABELS, policy)
+                reconciles += 1
+            except BuildStateError:
+                pass
+        if all(n.metadata.labels.get(keys.state_label, "") == done
+               for n in cluster.list_nodes()):
+            converged = True
+            break
+        clock.advance(interval)
+        cluster.step()
+    writes = sum(m.provider.writes_total for m in managers)
+    out = {
+        "converged": converged,
+        "replicas": max(1, replicas),
+        "makespan_s": round(clock.now(), 1),
+        "reconciles": reconciles,
+        "node_writes": writes,
+        "_fingerprint": _final_fingerprint(cluster, keys),
+    }
+    if electors:
+        out["shards"] = replicas * 2
+        out["shards_owned"] = {
+            e.identity: sorted(e.owned_shards()) for e in electors}
+        out["fence_rejections"] = sum(
+            e.fence_rejections_total for e in electors)
+        caps = [m.last_budget_shares for m in managers
+                if m.last_budget_shares is not None]
+        if caps:
+            out["budget_caps"] = [c["cap"] for c in caps]
+            out["global_budget"] = caps[0]["globalBudget"]
+    return out
+
+
+def run_shard_bench(sizes: "tuple[int, ...]" = (16384,),
+                    replicas: int = 4) -> dict:
+    """The sharded-control-plane scale proof: per fleet size, one
+    single-owner upgrade vs the identical fleet partitioned across
+    ``replicas`` sharded replicas — final cluster state must be
+    bit-identical, and the per-replica snapshot/write load divides by
+    the replica count (each owns ~1/replicas of the fleet)."""
+    out: dict = {"replicas": replicas}
+    for n_nodes in sizes:
+        single = run_shard_cell(n_nodes, 1)
+        sharded = run_shard_cell(n_nodes, replicas)
+        identical = (single.pop("_fingerprint")
+                     == sharded.pop("_fingerprint"))
+        out[f"{n_nodes}_nodes"] = {
+            "single_owner": single,
+            "sharded": sharded,
+            "final_state_identical": identical,
+        }
+    return out
+
+
 def run_latency_bench(sizes: "tuple[int, ...]" = (64, 256, 1024),
                       interval: float = RESYNC_INTERVAL) -> dict:
     """The poll-paced vs event-driven comparison across fleet sizes."""
@@ -348,6 +475,8 @@ def run_latency_bench(sizes: "tuple[int, ...]" = (64, 256, 1024),
 def main(argv: "list[str]") -> int:
     sizes = (64, 256, 1024)
     interval = RESYNC_INTERVAL
+    shard_sizes: "Optional[tuple[int, ...]]" = None
+    shard_replicas = 4
     for i, arg in enumerate(argv):
         if arg == "--nodes" and i + 1 < len(argv):
             sizes = tuple(int(s) for s in argv[i + 1].split(","))
@@ -357,6 +486,22 @@ def main(argv: "list[str]") -> int:
             interval = float(argv[i + 1])
         elif arg.startswith("--interval="):
             interval = float(arg.split("=", 1)[1])
+        elif arg == "--shard-nodes" and i + 1 < len(argv):
+            shard_sizes = tuple(int(s)
+                                for s in argv[i + 1].split(","))
+        elif arg.startswith("--shard-nodes="):
+            shard_sizes = tuple(int(s)
+                                for s in arg.split("=", 1)[1].split(","))
+        elif arg == "--shard-replicas" and i + 1 < len(argv):
+            shard_replicas = int(argv[i + 1])
+        elif arg.startswith("--shard-replicas="):
+            shard_replicas = int(arg.split("=", 1)[1])
+    if shard_sizes is not None:
+        # sharded-control-plane scale proof only (16k default:
+        # `--shard-nodes 16384 --shard-replicas 4`)
+        print(json.dumps(run_shard_bench(shard_sizes, shard_replicas),
+                         indent=2))
+        return 0
     print(json.dumps(run_latency_bench(sizes, interval), indent=2))
     return 0
 

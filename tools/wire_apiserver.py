@@ -617,6 +617,13 @@ class WireHandler(BaseHTTPRequestHandler):
             if out is None:
                 return self._status(404, "NotFound", "pod not found")
             return self._send(200, out)
+        match = _APPS_RE.match(path)
+        if match and match.group(3):
+            namespace, kind, name = match.groups()
+            out = self.store.patch(kind, namespace, name, body)
+            if out is None:
+                return self._status(404, "NotFound", f"{kind} not found")
+            return self._send(200, out)
         match = _EVENT_RE.match(path)
         if match and match.group(2):
             out = self.store.patch("events", match.group(1),

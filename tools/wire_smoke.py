@@ -352,20 +352,207 @@ def run_smoke(n_nodes: int = 4, timeout_s: float = 120.0,
     }
 
 
+class _RecordingClient:
+    """HttpCluster wrapper recording which NODES this replica wrote —
+    the disjoint-write-sets evidence of the sharded smoke (each durable
+    node write is attributed to the replica that issued it, at the
+    client boundary, independent of the fencing layer)."""
+
+    def __init__(self, client, written: set) -> None:
+        self._client = client
+        self._written = written
+
+    def __getattr__(self, name):
+        return getattr(self._client, name)
+
+    def patch_node_labels(self, name, labels):
+        self._written.add(name)
+        return self._client.patch_node_labels(name, labels)
+
+    def patch_node_annotations(self, name, annotations):
+        self._written.add(name)
+        return self._client.patch_node_annotations(name, annotations)
+
+    def patch_node_meta(self, name, labels=None, annotations=None):
+        self._written.add(name)
+        return self._client.patch_node_meta(name, labels=labels,
+                                            annotations=annotations)
+
+    def set_node_unschedulable(self, name, unschedulable):
+        self._written.add(name)
+        return self._client.set_node_unschedulable(name, unschedulable)
+
+
+def run_sharded_smoke(n_nodes: int = 8, replicas: int = 2,
+                      timeout_s: float = 120.0) -> dict:
+    """The sharded-control-plane wire proof: ``replicas`` CONCURRENT
+    operator replicas — each a full HttpCluster stack with its own
+    ShardElector (member slot + per-shard Leases over the wire's
+    POST-409 / PUT-409 CAS path), ownership-filtered snapshots, fenced
+    writes and durable budget shares — drive one rolling upgrade of the
+    same fleet over real sockets. The artifact records each replica's
+    node-write set: the sets must be DISJOINT (no node was ever written
+    by two owners) and must cover the fleet."""
+    from tpu_operator_libs.k8s.sharding import (
+        ShardElectionConfig,
+        ShardElector,
+    )
+
+    server = WireApiServer().start()
+    seed(server.store, n_nodes)
+    controllers = ControllerSim(server.store)
+    workload = WorkloadSim(server.store)
+    controllers.start()
+    workload.start()
+
+    keys = UpgradeKeys()
+    # an odd (here prime) shard count: with shards = 2 * replicas, the
+    # round-robin assignment reduces to hash parity, and a small fleet
+    # of similar names can land every node on one replica by chance —
+    # more shards than replicas (and not a multiple) spreads load, the
+    # same guidance docs/sharded-control-plane.md gives deployments
+    num_shards = replicas * 2 + 1
+    policy = UpgradePolicySpec(
+        auto_upgrade=True, max_parallel_upgrades=0,
+        max_unavailable="50%",
+        drain=DrainSpec(enable=True, force=True, timeout_seconds=60))
+    stop = threading.Event()
+    write_sets: dict[str, set] = {}
+    owned_at_end: dict[str, list] = {}
+    errors: list[str] = []
+    t0 = time.monotonic()
+
+    def replica(index: int) -> None:
+        identity = f"wire-replica-{index}"
+        written: set = set()
+        write_sets[identity] = written
+        client = HttpCluster(server.url)
+        # leases comfortably longer than the whole run: a loaded CI
+        # box delaying a renewal past expiry would hand the shard over
+        # mid-run — legitimate, but it would dilute the disjointness
+        # evidence this smoke exists to commit
+        elector = ShardElector(
+            client,
+            ShardElectionConfig(
+                namespace="kube-system", identity=identity,
+                num_shards=num_shards, replicas=replicas,
+                lease_prefix="wire-shard",
+                lease_duration=60.0, renew_deadline=40.0,
+                retry_period=0.5))
+        mgr = ClusterUpgradeStateManager(
+            _RecordingClient(client, written), keys,
+            async_workers=False,
+            poll_interval=0.05).with_sharding(elector)
+        membership_deadline = time.monotonic() + 5.0
+        try:
+            while not stop.is_set():
+                elector.tick()
+                if (len(elector.live_members()) < replicas
+                        and time.monotonic() < membership_deadline):
+                    # hold reconciles until every peer has claimed its
+                    # member slot (bounded — a genuinely dead peer must
+                    # not block the upgrade): reconciling mid-rebalance
+                    # would write nodes of shards about to be handed
+                    # over, diluting the disjoint-write-set evidence
+                    stop.wait(0.05)
+                    continue
+                if elector.owned_shards():
+                    try:
+                        mgr.reconcile(NS, RUNTIME_LABELS, policy)
+                    except BuildStateError:
+                        pass
+                stop.wait(0.2)
+        except Exception as exc:  # noqa: BLE001 — surfaced in artifact
+            errors.append(f"{identity}: {exc!r}")
+        finally:
+            owned_at_end[identity] = sorted(elector.owned_shards())
+            elector.release_all()
+
+    threads = [threading.Thread(target=replica, args=(i,), daemon=True,
+                                name=f"wire-replica-{i}")
+               for i in range(replicas)]
+    for thread in threads:
+        thread.start()
+
+    observer = HttpCluster(server.url)
+    converged = False
+    while time.monotonic() - t0 < timeout_s:
+        nodes = observer.list_nodes()
+        if nodes and all(
+                n.metadata.labels.get(keys.state_label)
+                == str(UpgradeState.DONE) for n in nodes):
+            converged = True
+            break
+        time.sleep(0.25)
+    stop.set()
+    for thread in threads:
+        thread.join(timeout=10.0)
+    duration = time.monotonic() - t0
+
+    store = server.store
+    with store._lock:
+        pods = {name: json.loads(json.dumps(obj)) for (ns, name), obj
+                in store.objects["pods"].items() if ns == NS}
+        nodes_raw = {name: json.loads(json.dumps(obj)) for (_, name), obj
+                     in store.objects["nodes"].items()}
+    workload.stop()
+    controllers.stop()
+    server.stop()
+
+    runtime_revisions = {
+        name: (pod["metadata"].get("labels") or {})
+        .get("controller-revision-hash")
+        for name, pod in pods.items() if name.startswith("libtpu-")}
+    sets = {identity: sorted(written)
+            for identity, written in write_sets.items()}
+    identities = sorted(sets)
+    disjoint = True
+    for i, a in enumerate(identities):
+        for b in identities[i + 1:]:
+            if set(sets[a]) & set(sets[b]):
+                disjoint = False
+    return {
+        "schema": SCHEMA,
+        "captured_at": time.strftime("%Y-%m-%dT%H:%M:%SZ", time.gmtime()),
+        "server": {"impl": "tools/wire_apiserver.py",
+                   "transport": "http/tcp-loopback",
+                   "independent_of_fakecluster": True},
+        "client": "tpu_operator_libs.k8s.http.HttpCluster",
+        "fleet": {"nodes": n_nodes, "runtime_ds": "libtpu",
+                  "replicas": replicas,
+                  "shards": num_shards},
+        "converged": bool(converged),
+        "duration_s": round(duration, 2),
+        "replica_write_sets": sets,
+        "write_sets_disjoint": disjoint,
+        "every_replica_wrote": all(sets[i] for i in identities),
+        "owned_shards_at_end": owned_at_end,
+        "final_node_states": {
+            name: (obj.get("metadata") or {}).get("labels", {})
+            .get(keys.state_label) for name, obj in nodes_raw.items()},
+        "final_runtime_revisions": runtime_revisions,
+        "errors": errors,
+    }
+
+
 def main() -> int:
     parser = argparse.ArgumentParser()
     parser.add_argument("--nodes", type=int, default=4)
     parser.add_argument("--timeout", type=float, default=120.0)
     parser.add_argument("--scenario", default="drain",
-                        choices=("drain", "pod-deletion"))
+                        choices=("drain", "pod-deletion", "sharded"))
     parser.add_argument("--fault-rate", type=float, default=0.0,
                         help="answer this fraction of non-watch "
                              "requests with a 500 (seeded chaos)")
     parser.add_argument("--out", default=None,
                         help="write the artifact JSON here")
     args = parser.parse_args()
-    result = run_smoke(args.nodes, args.timeout, args.scenario,
-                       fault_rate=args.fault_rate)
+    if args.scenario == "sharded":
+        result = run_sharded_smoke(max(args.nodes, 8),
+                                   timeout_s=args.timeout)
+    else:
+        result = run_smoke(args.nodes, args.timeout, args.scenario,
+                           fault_rate=args.fault_rate)
     payload = json.dumps(result, indent=1)
     if args.out:
         with open(args.out, "w") as fh:
@@ -376,6 +563,9 @@ def main() -> int:
                   for rev in result["final_runtime_revisions"].values())
           and all(state == str(UpgradeState.DONE)
                   for state in result["final_node_states"].values()))
+    if args.scenario == "sharded":
+        ok = ok and result["write_sets_disjoint"] \
+            and result["every_replica_wrote"] and not result["errors"]
     print(f"\nwire smoke: {'PASS' if ok else 'FAIL'}", file=sys.stderr)
     return 0 if ok else 1
 

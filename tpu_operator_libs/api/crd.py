@@ -190,6 +190,58 @@ def rollback_schema() -> dict[str, Any]:
     }
 
 
+def sharding_schema() -> dict[str, Any]:
+    """ShardingPolicySpec (beyond-reference: sharded HA control
+    plane — per-shard Leases, crash-tolerant ownership, durable budget
+    shares; docs/sharded-control-plane.md)."""
+    return {
+        "type": "object",
+        "description": "Sharded HA control plane: N operator replicas "
+                       "each own a partition of the fleet via "
+                       "per-shard Leases, with the global budget "
+                       "coordinated through durable shares.",
+        "properties": {
+            "enable": {
+                "type": "boolean",
+                "default": False,
+                "description": "Master switch; when false the operator "
+                               "runs single-owner.",
+            },
+            "replicas": {
+                "type": "integer",
+                "minimum": 1,
+                "default": 2,
+                "description": "Expected operator replica count "
+                               "(member slots contended for).",
+            },
+            "shardsPerReplica": {
+                "type": "integer",
+                "minimum": 1,
+                "default": 1,
+                "description": "Ring granularity: total shards = "
+                               "replicas * shardsPerReplica. More "
+                               "shards per replica spread a dead "
+                               "peer's load over every survivor.",
+            },
+            "takeoverGraceSeconds": {
+                "type": "integer",
+                "minimum": 1,
+                "default": 150,
+                "description": "Seconds an orphaned shard may go "
+                               "ownerless before it counts as a "
+                               "liveness violation; must exceed "
+                               "leaseDurationSeconds.",
+            },
+            "leaseDurationSeconds": {
+                "type": "integer",
+                "minimum": 1,
+                "default": 30,
+                "description": "Per-shard Lease duration.",
+            },
+        },
+    }
+
+
 def wedge_detection_schema() -> dict[str, Any]:
     """WedgeDetectionSpec (api/remediation_policy.py)."""
     return {
@@ -378,6 +430,7 @@ def upgrade_policy_schema() -> dict[str, Any]:
             "drain": drain_schema(),
             "canary": canary_schema(),
             "rollback": rollback_schema(),
+            "sharding": sharding_schema(),
             "topologyMode": {
                 "type": "string",
                 "enum": ["flat", "slice"],

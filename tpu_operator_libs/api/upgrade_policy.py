@@ -244,6 +244,75 @@ class RollbackSpec:
 
 
 @dataclass
+class ShardingPolicySpec:
+    """Sharded HA control plane (beyond-reference; k8s/sharding.py).
+
+    ``replicas`` operator replicas each claim a member slot plus the
+    per-shard Leases of a ``replicas * shardsPerReplica``-shard
+    consistent-hash ring; a dead replica's orphaned shards must be
+    adopted by the survivors within ``takeoverGraceSeconds``. The
+    global maxUnavailable budget is coordinated through durable budget
+    shares on the runtime DaemonSet, so shards can never jointly
+    overdraw it — see docs/sharded-control-plane.md.
+    """
+
+    # Master switch; when False the operator runs single-owner.
+    enable: bool = False
+    # Expected replica count (member slots contended for).
+    replicas: int = 2
+    # Ring granularity: total shards = replicas * shardsPerReplica.
+    # More shards per replica smooth takeover (a dead peer's load
+    # spreads over every survivor instead of landing on one).
+    shards_per_replica: int = 1
+    # Seconds an orphaned shard may go ownerless before the operator
+    # (and the chaos gate) treat it as a liveness violation. Budget for
+    # member-slot expiry + shard-lease expiry + election rounds + one
+    # composed crash-restart: ~5 lease durations.
+    takeover_grace_seconds: int = 150
+    # Per-shard Lease duration; renew deadline is derived (2/3).
+    lease_duration_seconds: int = 30
+
+    @property
+    def num_shards(self) -> int:
+        return self.replicas * self.shards_per_replica
+
+    def validate(self) -> None:
+        if self.replicas < 1:
+            raise PolicyValidationError("sharding.replicas must be >= 1")
+        if self.shards_per_replica < 1:
+            raise PolicyValidationError(
+                "sharding.shardsPerReplica must be >= 1")
+        if self.lease_duration_seconds < 1:
+            raise PolicyValidationError(
+                "sharding.leaseDurationSeconds must be >= 1")
+        if self.takeover_grace_seconds < self.lease_duration_seconds:
+            raise PolicyValidationError(
+                "sharding.takeoverGraceSeconds must be >= "
+                "leaseDurationSeconds (a takeover cannot beat lease "
+                "expiry)")
+
+    def to_dict(self) -> dict[str, Any]:
+        return {"enable": self.enable,
+                "replicas": self.replicas,
+                "shardsPerReplica": self.shards_per_replica,
+                "takeoverGraceSeconds": self.takeover_grace_seconds,
+                "leaseDurationSeconds": self.lease_duration_seconds}
+
+    @classmethod
+    def from_dict(cls, data: dict[str, Any]) -> "ShardingPolicySpec":
+        return cls(enable=data.get("enable", False),
+                   replicas=data.get("replicas", 2),
+                   shards_per_replica=data.get("shardsPerReplica", 1),
+                   takeover_grace_seconds=data.get(
+                       "takeoverGraceSeconds", 150),
+                   lease_duration_seconds=data.get(
+                       "leaseDurationSeconds", 30))
+
+    def deep_copy(self) -> "ShardingPolicySpec":
+        return copy.deepcopy(self)
+
+
+@dataclass
 class UpgradePolicySpec:
     """Top-level rolling-upgrade policy.
 
@@ -280,6 +349,9 @@ class UpgradePolicySpec:
     # ControllerRevision after a canary halt. None = rollback enabled
     # with defaults whenever canary is enabled.
     rollback: Optional[RollbackSpec] = None
+    # Beyond-reference: sharded HA control plane (N replicas, per-shard
+    # Leases, durable budget shares). None = single-owner semantics.
+    sharding: Optional[ShardingPolicySpec] = None
 
     def validate(self) -> None:
         if self.max_parallel_upgrades < 0:
@@ -296,7 +368,7 @@ class UpgradePolicySpec:
             raise PolicyValidationError(
                 "maxUnavailableSlicesPerJob must be >= 1")
         for sub in (self.pod_deletion, self.wait_for_completion, self.drain,
-                    self.canary, self.rollback):
+                    self.canary, self.rollback, self.sharding):
             if sub is not None:
                 sub.validate()
 
@@ -318,6 +390,8 @@ class UpgradePolicySpec:
             out["canary"] = self.canary.to_dict()
         if self.rollback is not None:
             out["rollback"] = self.rollback.to_dict()
+        if self.sharding is not None:
+            out["sharding"] = self.sharding.to_dict()
         return out
 
     @classmethod
@@ -341,6 +415,8 @@ class UpgradePolicySpec:
             spec.canary = CanaryRolloutSpec.from_dict(data["canary"])
         if data.get("rollback") is not None:
             spec.rollback = RollbackSpec.from_dict(data["rollback"])
+        if data.get("sharding") is not None:
+            spec.sharding = ShardingPolicySpec.from_dict(data["sharding"])
         return spec
 
     def deep_copy(self) -> "UpgradePolicySpec":

@@ -31,14 +31,17 @@ from tpu_operator_libs.chaos.invariants import (
     InvariantViolation,
     ReconfigExpectation,
     RolloutExpectation,
+    ShardExpectation,
 )
 from tpu_operator_libs.chaos.runner import (
     ChaosConfig,
     ChaosReport,
     ReconfigChaosConfig,
+    ReplicaKillConfig,
     run_bad_revision_soak,
     run_chaos_soak,
     run_reconfig_soak,
+    run_replica_kill_soak,
 )
 from tpu_operator_libs.chaos.schedule import (
     FAULT_API_BURST,
@@ -50,6 +53,7 @@ from tpu_operator_libs.chaos.schedule import (
     FAULT_NOT_READY_FLAP,
     FAULT_OPERATOR_CRASH,
     FAULT_PDB_BLOCK,
+    FAULT_REPLICA_KILL,
     FAULT_STALE_READS,
     FAULT_WATCH_BREAK,
     FaultEvent,
@@ -70,6 +74,7 @@ __all__ = [
     "FAULT_NOT_READY_FLAP",
     "FAULT_OPERATOR_CRASH",
     "FAULT_PDB_BLOCK",
+    "FAULT_REPLICA_KILL",
     "FAULT_STALE_READS",
     "FAULT_WATCH_BREAK",
     "FaultEvent",
@@ -79,8 +84,11 @@ __all__ = [
     "OperatorCrash",
     "ReconfigChaosConfig",
     "ReconfigExpectation",
+    "ReplicaKillConfig",
     "RolloutExpectation",
+    "ShardExpectation",
     "run_bad_revision_soak",
     "run_chaos_soak",
     "run_reconfig_soak",
+    "run_replica_kill_soak",
 ]

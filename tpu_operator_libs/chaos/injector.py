@@ -28,6 +28,7 @@ from tpu_operator_libs.chaos.schedule import (
     FAULT_NOT_READY_FLAP,
     FAULT_OPERATOR_CRASH,
     FAULT_PDB_BLOCK,
+    FAULT_REPLICA_KILL,
     FAULT_STALE_READS,
     FAULT_WATCH_BREAK,
     FaultEvent,
@@ -181,15 +182,26 @@ class ChaosInjector:
 
     def __init__(self, cluster: FakeCluster, schedule: FaultSchedule,
                  lease_namespace: str = "kube-system",
-                 lease_name: str = "chaos-operator-leader") -> None:
+                 lease_name: str = "chaos-operator-leader",
+                 shard_lease_prefix: str = "") -> None:
         self._cluster = cluster
         self._schedule = schedule
         self._lease_namespace = lease_namespace
         self._lease_name = lease_name
+        # sharded-control-plane runs: leader-loss events targeting
+        # "shard:<i>" steal the i-th shard Lease of this prefix
+        self._shard_lease_prefix = shard_lease_prefix
         self.fuse = CrashFuse()
         self._crash_events: list[FaultEvent] = sorted(
             schedule.by_kind(FAULT_OPERATOR_CRASH), key=lambda e: e.at)
         self._crash_index = 0
+        # replica kills are operator-side faults like crashes: the
+        # "process" that dies is a caller-owned replica, so the runner
+        # polls due events instead of the cluster firing them
+        self._replica_kill_events: list[FaultEvent] = sorted(
+            schedule.by_kind(FAULT_REPLICA_KILL), key=lambda e: e.at)
+        self._replica_kill_index = 0
+        self.replicas_killed = 0
         # active crash-loop windows: node -> heal time
         self._crashloop_until: dict[str, float] = {}
         # active PDB windows (static list; the blocker checks the clock)
@@ -230,7 +242,7 @@ class ChaosInjector:
                 self._pdb_windows.append((event.at, event.until))
             elif event.kind == FAULT_LEADER_LOSS:
                 cluster.schedule_at(
-                    event.at, lambda: self._steal_lease())
+                    event.at, lambda e=event: self._steal_lease(e))
             elif event.kind == FAULT_BAD_REVISION:
                 cluster.schedule_at(
                     event.at,
@@ -296,10 +308,18 @@ class ChaosInjector:
         owner = pod.controller_owner()
         return owner is None or owner.kind != "DaemonSet"
 
-    def _steal_lease(self) -> None:
+    def _steal_lease(self, event: Optional[FaultEvent] = None) -> None:
         self.leader_losses += 1
+        name = self._lease_name
+        target = event.target if event is not None else ""
+        if target.startswith("shard:") and self._shard_lease_prefix:
+            # sharded control plane: depose one SHARD's owner — the
+            # incumbent's fencing check must reject its queued writes
+            # and the preferred replica re-adopts after expiry
+            name = (f"{self._shard_lease_prefix}-shard-"
+                    f"{int(target.split(':', 1)[1]):02d}")
         self._cluster.steal_lease(
-            self._lease_namespace, self._lease_name,
+            self._lease_namespace, name,
             f"chaos-intruder-{self.leader_losses}")
 
     # -- operator-side faults ---------------------------------------------
@@ -316,6 +336,21 @@ class ChaosInjector:
             self.fuse.arm(event.param, after=event.param % 2 == 1)
             armed = True
         return armed
+
+    def due_replica_kills(self, now: float) -> "list[FaultEvent]":
+        """Consume (once) every replica-kill event at or before ``now``.
+        The runner owns the replica objects, so it applies the kill —
+        dropping the incarnation WITHOUT releasing its Leases — and
+        schedules the replacement at the event's ``until``."""
+        due: list[FaultEvent] = []
+        while (self._replica_kill_index < len(self._replica_kill_events)
+               and self._replica_kill_events[
+                   self._replica_kill_index].at <= now):
+            event = self._replica_kill_events[self._replica_kill_index]
+            self._replica_kill_index += 1
+            self.replicas_killed += 1
+            due.append(event)
+        return due
 
     @property
     def crashes_fired(self) -> int:

@@ -137,6 +137,38 @@ class ReconfigExpectation:
 
 
 @dataclass(frozen=True)
+class ShardExpectation:
+    """Arms the sharded-control-plane invariants.
+
+    Two of the three shard invariants are write-time properties the
+    watch stream cannot attribute (events carry no writer identity), so
+    the soak runner feeds them through explicit hooks; the monitor owns
+    the bookkeeping, the verdicts and the report:
+
+    - **shard-ownership** (:meth:`InvariantMonitor.audit_shard_write`):
+      every durable node write must be issued by the replica that holds
+      the node's shard Lease *at the instant of the write*, verified
+      against the server-side Lease independently of the fencing layer
+      under test. One out-of-partition write landing is a split brain.
+    - **shard-takeover** (:meth:`InvariantMonitor.note_shard_orphaned` /
+      :meth:`~InvariantMonitor.note_shard_resumed`): a killed replica's
+      shards must be re-owned by a live replica within
+      ``takeover_grace_seconds`` — orphaned partitions stalling past
+      the grace is a liveness violation, and any shard still orphaned
+      at :meth:`~InvariantMonitor.final_check` is too.
+    - the **global budget** invariant needs no new machinery: the
+      standing max-unavailable check stays armed fleet-wide, which is
+      exactly what proves the durable budget shares never let two
+      shards jointly overdraw (each replica only ever sees its own
+      partition, yet the fleet-level inequality must hold at every
+      admission instant, across takeovers included).
+    """
+
+    num_shards: int
+    takeover_grace_seconds: float
+
+
+@dataclass(frozen=True)
 class InvariantViolation:
     """One broken safety property, with everything needed to replay it."""
 
@@ -181,6 +213,8 @@ class InvariantMonitor:
     rollout: Optional[RolloutExpectation] = None
     #: Arms the slice-reconfiguration invariants; None disables them.
     reconfig: Optional[ReconfigExpectation] = None
+    #: Arms the sharded-control-plane invariants; None disables them.
+    shard: Optional[ShardExpectation] = None
 
     violations: list[InvariantViolation] = field(default_factory=list)
     trace: list[str] = field(default_factory=list)
@@ -190,6 +224,10 @@ class InvariantMonitor:
     uncordons_seen: int = 0
     #: condemned→slice-released durations observed (reconfig mode).
     remap_seconds: list[float] = field(default_factory=list)
+    #: node writes audited against the shard Leases (shard mode).
+    shard_writes_audited: int = 0
+    #: orphaned→re-owned durations observed (shard mode).
+    shard_takeover_seconds: list[float] = field(default_factory=list)
 
     def __post_init__(self) -> None:
         self._nodes: dict[str, _NodeMirror] = {}
@@ -215,6 +253,9 @@ class InvariantMonitor:
         #: node -> virtual time its condemned annotation first appeared.
         self._condemned_at: dict[str, float] = {}
         self._expected_armed = False
+        # -- shard mode bookkeeping --
+        #: shard -> virtual time it was orphaned (owner killed).
+        self._shard_orphaned_at: dict[int, float] = {}
         self._watch = self.cluster.watch(max_queue=self.watch_queue_bound)
         self.resync("initial sync")
 
@@ -568,6 +609,68 @@ class InvariantMonitor:
                 f"{budget} (maxUnavailable="
                 f"{self.remediation_max_unavailable!r}, total={total})")
 
+    # -- sharded-control-plane invariants ---------------------------------
+    def audit_shard_write(self, node_name: str, shard: int,
+                          writer: str, holder: str) -> None:
+        """One durable node write, audited against the server-side shard
+        Lease at the instant it was issued (the runner's audit client
+        calls this independently of the fencing layer under test).
+        ``holder`` is the Lease's holder at write time; a mismatch means
+        an out-of-partition write LANDED — the split brain the fencing
+        check exists to make impossible."""
+        if self.shard is None:
+            return
+        self.shard_writes_audited += 1
+        if writer != holder:
+            self._violate(
+                "shard-ownership", node_name,
+                f"durable write by replica {writer!r} landed while "
+                f"shard {shard}'s lease was held by {holder!r} — an "
+                f"out-of-partition write (split brain)")
+
+    def note_shard_orphaned(self, shard: int, at: float) -> None:
+        """A replica died holding ``shard`` (runner hook)."""
+        if self.shard is None:
+            return
+        self._shard_orphaned_at.setdefault(shard, at)
+        self._record(f"shard {shard} orphaned (owner killed)")
+
+    def orphaned_shards(self) -> "tuple[int, ...]":
+        """Shards currently orphaned (killed owner, no live successor
+        observed yet) — the runner polls this to detect resumes."""
+        return tuple(sorted(self._shard_orphaned_at))
+
+    def suspend_orphan_clock(self, seconds: float) -> None:
+        """Exclude ``seconds`` from every orphaned shard's takeover
+        clock (runner hook, called for windows with ZERO live
+        replicas). The takeover invariant bounds how long the SYSTEM
+        leaves an adoptable shard ownerless — time in which no replica
+        exists to adopt anything measures the fault schedule, not the
+        control plane."""
+        if self.shard is None:
+            return
+        for shard in self._shard_orphaned_at:
+            self._shard_orphaned_at[shard] += seconds
+
+    def note_shard_resumed(self, shard: int) -> None:
+        """``shard``'s Lease is held by a live replica again (runner
+        hook). Violates shard-takeover when the orphan window exceeded
+        the configured grace."""
+        if self.shard is None:
+            return
+        orphaned_at = self._shard_orphaned_at.pop(shard, None)
+        if orphaned_at is None:
+            return
+        elapsed = self._now() - orphaned_at
+        self.shard_takeover_seconds.append(elapsed)
+        self._record(f"shard {shard} resumed after {elapsed:g}s orphaned")
+        if elapsed > self.shard.takeover_grace_seconds:
+            self._violate(
+                "shard-takeover", f"shard {shard}",
+                f"orphaned shard resumed only after {elapsed:g}s — "
+                f"past the {self.shard.takeover_grace_seconds:g}s "
+                f"takeover grace (a dead replica's partition stalled)")
+
     # -- pod events -------------------------------------------------------
     def _on_pod(self, event_type: str, pod) -> None:
         if (self.rollout is not None and pod.metadata.namespace
@@ -663,6 +766,12 @@ class InvariantMonitor:
         with an uncordon (nothing left quarantined) and no remediation
         bookkeeping may linger."""
         self.drain()
+        if self.shard is not None:
+            for shard, at in sorted(self._shard_orphaned_at.items()):
+                self._violate(
+                    "shard-takeover", f"shard {shard}",
+                    f"still orphaned at the end of the run (since "
+                    f"t={at:g}) — its partition was never taken over")
         nodes = consume_transient(self.cluster.list_nodes)
         for node in nodes:
             name = node.metadata.name

@@ -56,6 +56,7 @@ from tpu_operator_libs.chaos.invariants import (
     InvariantViolation,
     ReconfigExpectation,
     RolloutExpectation,
+    ShardExpectation,
 )
 from tpu_operator_libs.chaos.schedule import FaultSchedule
 from tpu_operator_libs.consts import (
@@ -1013,6 +1014,431 @@ def run_reconfig_soak(seed: int,
         crashes_fired=injector.crashes_fired,
         leader_handovers=handovers,
         operator_incarnations=incarnations,
+        watch_gaps=monitor.watch_gaps,
+        total_seconds=clock.now(),
+        steps=steps,
+        reconciles=reconciles,
+        trace=list(monitor.trace))
+    report.report_text = "\n".join(
+        [schedule.describe(), monitor.report(seed=seed)])
+    if not report.ok:
+        logger.error("%s", report.report_text)
+    return report
+
+
+@dataclass
+class ReplicaKillConfig(ChaosConfig):
+    """Knobs of one sharded-control-plane (replica-kill) soak episode."""
+
+    #: Operator replicas of the sharded control plane.
+    replicas: int = 2
+    #: Ring granularity: total shards = replicas * shards_per_replica.
+    shards_per_replica: int = 2
+    #: Per-shard / member-slot Lease duration (renew deadline 2/3).
+    shard_lease_duration: float = 30.0
+    #: Max virtual seconds an orphaned shard may go before a live
+    #: replica owns it again (the shard-takeover invariant's bound):
+    #: member-slot expiry + shard-lease expiry + election rounds + one
+    #: composed crash-restart — ~5 lease durations.
+    takeover_grace: float = 150.0
+    shard_lease_prefix: str = "chaos-shard"
+
+    @property
+    def num_shards(self) -> int:
+        return self.replicas * self.shards_per_replica
+
+
+class _ShardAuditClient:
+    """Write-attributing FakeCluster wrapper for the replica-kill gate.
+
+    Every durable NODE write a replica issues is audited — at the
+    instant of the write, against the server-side shard Lease —
+    INDEPENDENTLY of the fencing layer under test: the fence lives in
+    the state provider / cordon manager, this wrapper sits below them
+    at the client boundary, so a fencing bug shows up as a
+    ``shard-ownership`` violation instead of silently passing.
+    """
+
+    _AUDITED = ("patch_node_labels", "patch_node_annotations",
+                "patch_node_meta", "set_node_unschedulable")
+
+    def __init__(self, cluster: FakeCluster, identity: str,
+                 monitor: InvariantMonitor, ring: "object",
+                 pools: "dict[str, str]", lease_namespace: str,
+                 shard_lease_prefix: str) -> None:
+        self._cluster = cluster
+        self._identity = identity
+        self._monitor = monitor
+        self._ring = ring
+        self._pools = pools
+        self._lease_namespace = lease_namespace
+        self._shard_lease_prefix = shard_lease_prefix
+
+    def __getattr__(self, name: str) -> "object":
+        return getattr(self._cluster, name)
+
+    def _audit(self, node_name: str) -> None:
+        shard = self._ring.shard_for(node_name,
+                                     self._pools.get(node_name, ""))
+        try:
+            lease = self._cluster.get_lease(
+                self._lease_namespace,
+                f"{self._shard_lease_prefix}-shard-{shard:02d}")
+            holder = lease.holder_identity
+        except NotFoundError:
+            holder = ""
+        self._monitor.audit_shard_write(node_name, shard,
+                                        self._identity, holder)
+
+    def patch_node_labels(self, name: str, labels: "dict") -> "object":
+        self._audit(name)
+        return self._cluster.patch_node_labels(name, labels)
+
+    def patch_node_annotations(self, name: str,
+                               annotations: "dict") -> "object":
+        self._audit(name)
+        return self._cluster.patch_node_annotations(name, annotations)
+
+    def patch_node_meta(self, name: str, labels: "dict" = None,
+                        annotations: "dict" = None) -> "object":
+        self._audit(name)
+        return self._cluster.patch_node_meta(name, labels=labels,
+                                             annotations=annotations)
+
+    def set_node_unschedulable(self, name: str,
+                               unschedulable: bool) -> "object":
+        self._audit(name)
+        return self._cluster.set_node_unschedulable(name, unschedulable)
+
+
+class _ShardedReplica:
+    """One replica-lifetime of the sharded control plane: fresh
+    managers, fresh ShardElector, fresh identity. Everything that
+    survives a kill lives on the cluster — the shard/slot Leases, the
+    node labels, the budget-share annotations — which is exactly the
+    durability claim the replica-kill gate proves."""
+
+    def __init__(self, cluster: FakeCluster, clock: FakeClock,
+                 keys: UpgradeKeys, rem_keys: RemediationKeys,
+                 config: ReplicaKillConfig, injector: ChaosInjector,
+                 monitor: InvariantMonitor, identity: str,
+                 pools: "dict[str, str]") -> None:
+        from tpu_operator_libs.k8s.sharding import (
+            ShardElectionConfig,
+            ShardElector,
+        )
+        from tpu_operator_libs.upgrade.nudger import ReconcileNudger
+
+        self.identity = identity
+        self.nudger = ReconcileNudger(clock=clock)
+        self.elector = ShardElector(
+            cluster,
+            ShardElectionConfig(
+                namespace=config.lease_namespace, identity=identity,
+                num_shards=config.num_shards, replicas=config.replicas,
+                lease_prefix=config.shard_lease_prefix,
+                lease_duration=config.shard_lease_duration,
+                renew_deadline=config.shard_lease_duration * 2.0 / 3.0,
+                retry_period=2.0, renew_jitter=0.0),
+            clock=clock)
+        audit = _ShardAuditClient(
+            cluster, identity, monitor, self.elector.ring, pools,
+            config.lease_namespace, config.shard_lease_prefix)
+        provider = CrashingStateProvider(
+            audit, keys, None, clock, sync_timeout=5.0,
+            poll_interval=1.0, fuse=injector.fuse)
+        self.upgrade = ClusterUpgradeStateManager(
+            audit, keys, clock=clock, async_workers=False,
+            provider=provider, poll_interval=1.0, sync_timeout=5.0,
+            parallel_workers=config.parallel_workers,
+            nudger=self.nudger).with_sharding(self.elector)
+        rem_provider = CrashingStateProvider(
+            audit, rem_keys, None, clock,  # type: ignore[arg-type]
+            sync_timeout=5.0, poll_interval=1.0, fuse=injector.fuse)
+        self.remediation = NodeRemediationManager(
+            audit, rem_keys, upgrade_keys=keys, clock=clock,
+            provider=rem_provider, poll_interval=1.0, sync_timeout=5.0,
+            nudger=self.nudger).with_sharding(self.elector)
+
+
+def run_replica_kill_soak(seed: int,
+                          config: Optional[ReplicaKillConfig] = None,
+                          ) -> ChaosReport:
+    """The sharded-control-plane gate: ≥2 replicas each own a shard
+    partition via per-shard Leases, and the schedule kills/deposes them
+    mid-wave (SIGKILL without Lease release, shard-Lease steals, an
+    operator crash inside the durable-write path, plus control-plane
+    faults riding along).
+
+    What the episode proves, via the monitor's invariants plus the
+    convergence check:
+
+    - **shard-ownership**: every durable node write that LANDED was
+      issued by the replica holding that node's shard Lease at the
+      instant of the write (audited below the fencing layer, against
+      the server-side Lease) — zero split-brain writes;
+    - **budget**: the fleet-wide max-unavailable inequality holds at
+      every admission instant, even though no replica ever sees more
+      than its own partition — the durable budget shares coordinate
+      the joint spend across kills, steals and takeovers;
+    - **shard-takeover**: every shard orphaned by a kill is owned by a
+      live replica again within ``takeover_grace`` — dead replicas
+      stall nothing for longer than a bounded number of lease
+      durations;
+    - plus the standing legal-transition / workload-placement /
+      cordon-pairing invariants, and full convergence: every node
+      upgrade-done on the final revision.
+
+    Deterministic in ``seed``.
+    """
+    config = config or ReplicaKillConfig()
+    fleet = FleetSpec(
+        n_slices=config.n_slices,
+        hosts_per_slice=config.hosts_per_slice,
+        pod_recreate_delay=config.pod_recreate_delay,
+        pod_ready_delay=config.pod_ready_delay,
+        multislice_jobs=(
+            ("chaos-job", tuple(range(config.n_slices))),))
+    cluster, clock, keys = build_fleet(fleet)
+    rem_keys = RemediationKeys()
+    node_names = [n.metadata.name for n in cluster.list_nodes()]
+    pools = {n.metadata.name:
+             n.metadata.labels.get(GKE_NODEPOOL_LABEL, "")
+             for n in cluster.list_nodes()}
+
+    schedule = FaultSchedule.generate_replica_kill(
+        seed, node_names, replicas=config.replicas,
+        num_shards=config.num_shards, horizon=config.horizon)
+    injector = ChaosInjector(cluster, schedule,
+                             lease_namespace=config.lease_namespace,
+                             lease_name=config.lease_name,
+                             shard_lease_prefix=config.shard_lease_prefix)
+    injector.install()
+    cluster.schedule_at(
+        config.horizon / 2.0,
+        lambda: cluster.bump_daemon_set_revision(NS, "libtpu",
+                                                 FINAL_REVISION))
+
+    upgrade_policy = config.upgrade_policy()
+    remediation_policy = config.remediation_policy()
+    monitor = InvariantMonitor(
+        cluster=cluster, upgrade_keys=keys, remediation_keys=rem_keys,
+        # the budget invariant stays armed FLEET-WIDE: that is the
+        # durable-budget-shares proof (remediation budget is enforced
+        # per partition, so its global check is disarmed, like the
+        # reconfig gate disarms checks it deliberately relaxes)
+        max_unavailable=upgrade_policy.max_unavailable,
+        remediation_max_unavailable=None,
+        max_parallel_upgrades=config.max_parallel_upgrades,
+        shard=ShardExpectation(
+            num_shards=config.num_shards,
+            takeover_grace_seconds=config.takeover_grace))
+
+    generations = [1] * config.replicas
+    reconciles = 0
+    fencings = 0
+
+    def mk(slot: int) -> _ShardedReplica:
+        return _ShardedReplica(
+            cluster, clock, keys, rem_keys, config, injector, monitor,
+            identity=f"replica-{slot}-{generations[slot]}", pools=pools)
+
+    replicas: "list[Optional[_ShardedReplica]]" = [
+        mk(slot) for slot in range(config.replicas)]
+    pending_restarts: "list[tuple[float, int]]" = []
+
+    def replace(slot: int, reason: str) -> _ShardedReplica:
+        generations[slot] += 1
+        injector.fuse.reset()
+        fresh = mk(slot)
+        monitor.trace.append(
+            f"[t={clock.now():g}] replica slot {slot} restart "
+            f"#{generations[slot]} ({reason}) — rebuilding from "
+            f"cluster state alone")
+        return fresh
+
+    def converged() -> bool:
+        try:
+            nodes = cluster.list_nodes()
+            pods = cluster.list_pods(namespace=NS)
+        except (ApiServerError, TimeoutError):
+            return False
+        if len(nodes) != len(node_names):
+            return False
+        for node in nodes:
+            labels = node.metadata.labels
+            if labels.get(keys.state_label) != str(UpgradeState.DONE):
+                return False
+            if labels.get(rem_keys.state_label, ""):
+                return False
+            if keys.skip_label in labels:
+                return False
+            if node.is_unschedulable() or not node.is_ready():
+                return False
+        runtime = [p for p in pods if p.controller_owner() is not None]
+        if len(runtime) != len(node_names):
+            return False
+        return all(
+            p.metadata.labels.get(POD_CONTROLLER_REVISION_HASH_LABEL)
+            == FINAL_REVISION and p.is_ready() for p in runtime)
+
+    from tpu_operator_libs.k8s.sharding import ShardFencedError
+
+    steps = 0
+    is_converged = False
+    quiesce_ticks = 0
+    while steps < config.max_steps:
+        steps += 1
+        now = clock.now()
+        # replica kills: drop the incarnation WITHOUT releasing its
+        # Leases; note its shards orphaned for the takeover invariant
+        for event in injector.due_replica_kills(now):
+            slot = int(event.target)
+            victim = replicas[slot]
+            if victim is not None:
+                for shard in sorted(victim.elector.owned_shards()):
+                    monitor.note_shard_orphaned(shard, now)
+                monitor.trace.append(
+                    f"[t={now:g}] replica {victim.identity} KILLED "
+                    f"(slot {slot}; leases NOT released; replacement "
+                    f"at t={event.until:g})")
+                replicas[slot] = None
+            if event.until > now:
+                pending_restarts.append((event.until, slot))
+        due_restarts = [p for p in pending_restarts if p[0] <= now]
+        pending_restarts = [p for p in pending_restarts if p[0] > now]
+        for _, slot in due_restarts:
+            replicas[slot] = replace(slot, "replacement pod arrived")
+        for slot, replica in enumerate(replicas):
+            if replica is None:
+                continue
+            before = replica.elector.owned_shards()
+            replica.elector.tick()
+            if not replica.elector.owned_shards():
+                continue
+            if before != replica.elector.owned_shards():
+                monitor.trace.append(
+                    f"[t={now:g}] {replica.identity} owns "
+                    f"{sorted(replica.elector.owned_shards())}")
+            injector.arm_due_crashes(now)
+            replica.nudger.pop_due(now)
+            replica.nudger.consume_pending()
+            try:
+                replica.remediation.reconcile(NS, dict(RUNTIME_LABELS),
+                                              remediation_policy)
+                replica.upgrade.reconcile(NS, dict(RUNTIME_LABELS),
+                                          upgrade_policy)
+                reconciles += 1
+            except OperatorCrash:
+                for shard in sorted(replica.elector.owned_shards()):
+                    monitor.note_shard_orphaned(shard, now)
+                replicas[slot] = replace(
+                    slot, "operator crash mid-reconcile")
+            except ShardFencedError as exc:
+                # deposed mid-pass: the fence rejected the write and
+                # the pass aborted — the replica re-derives its
+                # partition from the Leases on its next tick
+                fencings += 1
+                monitor.trace.append(
+                    f"[t={now:g}] {replica.identity} fenced: {exc}")
+            except BuildStateError:
+                pass
+            except (ApiServerError, ConflictError, NotFoundError):
+                pass
+            if injector.fuse.pending:
+                for shard in sorted(replica.elector.owned_shards()):
+                    monitor.note_shard_orphaned(shard, now)
+                replicas[slot] = replace(
+                    slot, "operator crash (surfaced late)")
+        # takeover detection: an orphaned shard is resumed once its
+        # Lease is held by a LIVE replica again
+        live_idents = {r.identity for r in replicas if r is not None}
+        for shard in monitor.orphaned_shards():
+            try:
+                lease = cluster.get_lease(
+                    config.lease_namespace,
+                    f"{config.shard_lease_prefix}-shard-{shard:02d}")
+            except NotFoundError:
+                continue
+            if lease.holder_identity in live_idents:
+                monitor.note_shard_resumed(shard)
+        monitor.drain()
+        try:
+            restore_workload_pods(cluster, fleet)
+        except (ApiServerError, TimeoutError):
+            pass
+        monitor.drain()
+        if (now > schedule.last_fault_time
+                and not injector.fuse.armed
+                and not injector.fuse.pending
+                and not pending_restarts
+                and converged()):
+            quiesce_ticks += 1
+            if quiesce_ticks >= 3:
+                is_converged = True
+                break
+        else:
+            quiesce_ticks = 0
+        if not live_idents:
+            # an all-replicas-dead window: nothing exists to adopt
+            # anything, so this tick's span is excluded from the
+            # takeover clocks (the invariant bounds the system, not
+            # the schedule's double-kill windows)
+            monitor.suspend_orphan_clock(config.reconcile_interval)
+        clock.advance(config.reconcile_interval)
+        cluster.step()
+        monitor.drain()
+
+    if is_converged:
+        monitor.final_check()
+    else:
+        monitor.violations.append(InvariantViolation(
+            invariant="liveness", at=clock.now(), subject="fleet",
+            detail=f"sharded fleet did not converge within "
+                   f"{config.max_steps} steps ({clock.now():g}s "
+                   f"virtual) after the last fault healed at "
+                   f"{schedule.last_fault_time:g}s"))
+
+    # harness sanity: the episode must have exercised what it gates
+    if injector.replicas_killed < 1:
+        monitor.violations.append(InvariantViolation(
+            invariant="harness", at=clock.now(), subject="injector",
+            detail="no replica kill fired"))
+    if injector.crashes_fired == 0:
+        monitor.violations.append(InvariantViolation(
+            invariant="harness", at=clock.now(), subject="injector",
+            detail="no operator crash fired — the schedule's crash "
+                   "events never detonated"))
+    if injector.replicas_killed >= 1 \
+            and not monitor.shard_takeover_seconds:
+        monitor.violations.append(InvariantViolation(
+            invariant="harness", at=clock.now(), subject="monitor",
+            detail="a replica was killed but no orphaned-shard "
+                   "takeover was observed — the gate proved nothing "
+                   "about ownership handover"))
+    if monitor.shard_writes_audited == 0:
+        monitor.violations.append(InvariantViolation(
+            invariant="harness", at=clock.now(), subject="monitor",
+            detail="zero durable writes were audited against the "
+                   "shard leases"))
+    if monitor.shard_takeover_seconds:
+        monitor.trace.append(
+            f"[t={clock.now():g}] orphaned-shard takeover times (s): "
+            f"{sorted(round(s, 1) for s in monitor.shard_takeover_seconds)}"
+            f" (grace {config.takeover_grace:g}s)")
+    if fencings:
+        monitor.trace.append(
+            f"[t={clock.now():g}] {fencings} mid-pass fencing "
+            f"rejection(s) (deposed replicas' writes refused)")
+
+    report = ChaosReport(
+        seed=seed,
+        converged=is_converged,
+        violations=list(monitor.violations),
+        fault_kinds=tuple(sorted(schedule.kinds)),
+        crashes_fired=injector.crashes_fired,
+        leader_handovers=injector.replicas_killed + injector.leader_losses,
+        operator_incarnations=sum(generations),
         watch_gaps=monitor.watch_gaps,
         total_seconds=clock.now(),
         steps=steps,

@@ -59,6 +59,16 @@ FAULT_BAD_REVISION = "bad-revision"
 #: (spare remap or documented degraded admission), which is exactly
 #: what the reconfiguration soak gate proves.
 FAULT_NODE_KILL = "node-kill"
+#: One operator REPLICA of the sharded control plane dies without
+#: releasing its Leases (SIGKILL'd pod): ``target`` is the replica's
+#: member-slot index, ``until`` the virtual time its replacement pod
+#: arrives. Recovery is the system's job — the survivors' membership
+#: observations must age the victim out, the preferred assignment must
+#: reassign its shards, and each orphaned shard's Lease must be adopted
+#: within the takeover grace, mid-rollout, with the durable budget
+#: shares keeping the joint spend under the fleet budget throughout.
+#: Proven by the replica-kill soak gate (runner.run_replica_kill_soak).
+FAULT_REPLICA_KILL = "replica-kill"
 
 #: The full catalog, in deterministic order (generation samples from it).
 FAULT_KINDS = (
@@ -252,6 +262,64 @@ class FaultSchedule:
         pool = [FAULT_API_BURST, FAULT_WATCH_BREAK, FAULT_STALE_READS,
                 FAULT_LEADER_LOSS]
         nodes = sorted(n for members in pools.values() for n in members)
+        for kind in rng.sample(pool, min(extra_kinds, len(pool))):
+            start = rng.uniform(0.1, horizon * 0.7)
+            if kind == FAULT_API_BURST:
+                events.append(FaultEvent(
+                    at=start, kind=kind,
+                    target=rng.choice(API_BURST_OPERATIONS),
+                    param=rng.randint(1, 3)))
+            elif kind == FAULT_STALE_READS:
+                events.append(FaultEvent(
+                    at=start, kind=kind, target=rng.choice(nodes),
+                    param=rng.randint(1, 3)))
+            else:
+                events.append(FaultEvent(at=start, kind=kind))
+        events.sort(key=lambda e: (e.at, e.kind, e.target))
+        return cls(seed=seed, events=tuple(events))
+
+    @classmethod
+    def generate_replica_kill(cls, seed: int, node_names: list[str],
+                              replicas: int = 2,
+                              num_shards: int = 4,
+                              horizon: float = 600.0,
+                              extra_kinds: int = 2) -> "FaultSchedule":
+        """Schedule for the sharded-control-plane gate: 1-2 replica
+        kills (SIGKILL, no Lease release — ``until`` is when the
+        replacement pod arrives) landing mid-wave on distinct member
+        slots, at least one shard-Lease steal (``leader-loss`` with a
+        ``shard:<i>`` target — the split-brain seam the fencing check
+        closes), at least one operator crash inside the durable-write
+        path, and ``extra_kinds`` control-plane fault kinds riding
+        along. Node-healing faults are excluded for the same reason the
+        bad-revision gate excludes them: the gate proves ownership
+        handover and budget safety, and the compound node-fault
+        interplay is the main soak's job.
+        """
+        if not node_names:
+            raise ValueError("node_names must be non-empty")
+        if replicas < 2:
+            raise ValueError("replica-kill schedule needs >= 2 replicas")
+        rng = random.Random(f"chaos-replica-kill:{seed}")
+        nodes = sorted(node_names)
+        events: list[FaultEvent] = []
+        # kills land after the first waves start and before the
+        # mid-horizon revision bump's storm settles: always mid-wave
+        for slot in rng.sample(range(replicas), rng.randint(1, 2)):
+            start = rng.uniform(horizon * 0.1, horizon * 0.5)
+            events.append(FaultEvent(
+                at=start, kind=FAULT_REPLICA_KILL, target=str(slot),
+                until=start + rng.uniform(90.0, 240.0)))
+        for _ in range(rng.randint(1, 2)):
+            events.append(FaultEvent(
+                at=rng.uniform(0.1, horizon * 0.6),
+                kind=FAULT_LEADER_LOSS,
+                target=f"shard:{rng.randrange(num_shards)}"))
+        events.append(FaultEvent(
+            at=rng.uniform(0.1, horizon * 0.45),
+            kind=FAULT_OPERATOR_CRASH,
+            param=rng.randint(0, 8)))
+        pool = [FAULT_API_BURST, FAULT_WATCH_BREAK, FAULT_STALE_READS]
         for kind in rng.sample(pool, min(extra_kinds, len(pool))):
             start = rng.uniform(0.1, horizon * 0.7)
             if kind == FAULT_API_BURST:

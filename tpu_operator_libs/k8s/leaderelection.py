@@ -27,6 +27,7 @@ thin blocking driver for production.
 from __future__ import annotations
 
 import logging
+import random
 import threading
 from dataclasses import dataclass
 from typing import Callable, Optional, Protocol
@@ -69,6 +70,12 @@ class LeaderElectionConfig:
     # Upstream's ReleaseOnCancel: on a clean stop, write holder="" so the
     # next contender doesn't wait out the lease.
     release_on_stop: bool = True
+    # Fraction of retry_period added as deterministic per-identity
+    # jitter to the run() loop's sleeps: with N replicas (a sharded
+    # control plane runs one elector per shard lock) synchronized
+    # renewals would herd the apiserver every retry_period. 0 keeps the
+    # exact upstream cadence (and the deterministic tests).
+    renew_jitter: float = 0.0
 
     def __post_init__(self) -> None:
         if self.lease_duration <= self.renew_deadline:
@@ -77,6 +84,8 @@ class LeaderElectionConfig:
             raise ValueError("renew_deadline must exceed retry_period")
         if not self.identity:
             raise ValueError("identity must be non-empty")
+        if not 0.0 <= self.renew_jitter <= 1.0:
+            raise ValueError("renew_jitter must be in [0, 1]")
 
 
 class LeaderElector:
@@ -111,6 +120,18 @@ class LeaderElector:
         self._observed_at = 0.0
         self._last_reported_leader: Optional[str] = None
         self._last_renew_success = 0.0
+        # Serializes the two write paths (try_acquire_or_renew vs
+        # release): without it a release racing a renew reads a stale
+        # observation, its update conflicts, and the lease is left HELD
+        # at shutdown — the successor then waits out the full lease
+        # duration (regression-pinned in tests/test_leader_election.py).
+        self._op_lock = threading.Lock()
+        # deterministic per-identity jitter stream for run()'s sleeps
+        self._jitter_rng = random.Random(
+            f"leader-election:{config.identity}")
+        #: Lifetime leadership transitions (metrics surface).
+        self.acquires_total = 0
+        self.losses_total = 0
 
     # -- inspection --------------------------------------------------------
     @property
@@ -133,6 +154,10 @@ class LeaderElector:
         grace client-go gives. Only the definitive observation of another
         live holder steps us down immediately.
         """
+        with self._op_lock:
+            return self._try_acquire_or_renew()
+
+    def _try_acquire_or_renew(self) -> bool:
         config = self._config
         now = self._clock.now()
         try:
@@ -202,18 +227,27 @@ class LeaderElector:
         Returns after ``on_stopped_leading`` (if we ever led)."""
         stop = stop or threading.Event()
         config = self._config
+
+        def pace() -> None:
+            # jittered renewal cadence: each sleep stretches by up to
+            # renew_jitter * retry_period, drawn from a per-identity
+            # deterministic stream — N replicas spread out instead of
+            # herding the apiserver on synchronized ticks
+            self._clock.sleep(config.retry_period * (
+                1.0 + config.renew_jitter * self._jitter_rng.random()))
+
         try:
             while not stop.is_set():
                 if self.try_acquire_or_renew():
                     self._last_renew_success = self._clock.now()
                     break
-                self._clock.sleep(config.retry_period)
+                pace()
             if stop.is_set():
                 return
             logger.info("leader election: %s acquired %s/%s",
                         config.identity, config.namespace, config.name)
             while not stop.is_set():
-                self._clock.sleep(config.retry_period)
+                pace()
                 if stop.is_set():
                     break
                 if self.try_acquire_or_renew():
@@ -241,18 +275,67 @@ class LeaderElector:
                 self._set_leading(False)
 
     def release(self) -> bool:
-        """Write holder="" so successors need not wait out the lease."""
-        if not self._leading or self._observed is None:
-            return False
-        released = self._observed.clone()
-        released.holder_identity = ""
-        released.renew_time = self._clock.now()
-        try:
-            stored = self._client.update_lease(released)
-        except (ConflictError, NotFoundError):
-            return False
-        self._observe(stored, self._clock.now())
-        return True
+        """Write holder="" so successors need not wait out the lease.
+
+        Serialized against :meth:`try_acquire_or_renew` and based on a
+        FRESH read of the record, not the local observation: a release
+        racing a concurrent renew used to clone a stale
+        resourceVersion, conflict, and silently leave the lease HELD at
+        shutdown — the successor then waited out the whole lease
+        duration. The fresh read also refuses to release a lease some
+        other contender has already taken over.
+        """
+        with self._op_lock:
+            if not self._leading:
+                return False
+            try:
+                current = self._client.get_lease(
+                    self._config.namespace, self._config.name)
+            except Exception:  # noqa: BLE001 — any read failure means
+                # nothing releasable we can prove we still hold
+                return False
+            if current.holder_identity != self._config.identity:
+                return False  # already taken over; not ours to release
+            released = current.clone()
+            released.holder_identity = ""
+            released.renew_time = self._clock.now()
+            try:
+                stored = self._client.update_lease(released)
+            except (ConflictError, NotFoundError):
+                return False
+            except Exception:
+                logger.warning("leader election: release %s/%s failed",
+                               self._config.namespace, self._config.name,
+                               exc_info=True)
+                return False
+            self._observe(stored, self._clock.now())
+            return True
+
+    def step_down(self) -> None:
+        """Drop leadership LOCALLY without touching the record (the
+        record was already released, stolen, or fenced away). Fires
+        ``on_stopped_leading`` if we were leading."""
+        self._set_leading(False)
+
+    def observe(self) -> None:
+        """Refresh the local observation of the record WITHOUT
+        contending for it. A contender that keeps observing a lease it
+        may later need (a sharded replica watching shards a peer owns)
+        has a warm expiry clock the moment the assignment hands it the
+        shard — without this, the observed-time expiry rule makes every
+        preference change cost a full extra lease duration before
+        takeover."""
+        with self._op_lock:
+            now = self._clock.now()
+            try:
+                current = self._client.get_lease(
+                    self._config.namespace, self._config.name)
+            except NotFoundError:
+                return  # absent records are immediately claimable
+            except Exception:  # noqa: BLE001 — observation is best-effort
+                return
+            if self._record_changed(current):
+                self._observe(current, now)
 
     # -- internals -----------------------------------------------------------
     def _record_changed(self, current: Lease) -> bool:
@@ -272,10 +355,12 @@ class LeaderElector:
     def _set_leading(self, leading: bool) -> bool:
         if leading and not self._leading:
             self._leading = True
+            self.acquires_total += 1
             if self._on_started_leading is not None:
                 self._on_started_leading()
         elif not leading and self._leading:
             self._leading = False
+            self.losses_total += 1
             if self._on_stopped_leading is not None:
                 self._on_stopped_leading()
         return self._leading

@@ -1,0 +1,526 @@
+"""Sharded HA control plane: per-shard Leases, fencing and budget shares.
+
+One operator process holding one Lease tops out well below TPU-supercomputer
+fleet sizes, and killing it freezes every subsystem until restart. This
+module generalizes :mod:`tpu_operator_libs.k8s.leaderelection` from one
+global lock to a **consistent-hash ring of shard locks**:
+
+- :class:`ShardRing` maps every node to one of ``num_shards`` shards by a
+  stable hash. Nodes that belong to an ICI slice hash by their *slice*
+  (nodepool label), so a slice is never split across owners and
+  slice-atomic planning keeps working under sharding.
+- :class:`ShardElector` is one replica's contender: it claims a **member
+  slot** Lease (the replica registry — membership is discoverable with R
+  GETs, no LIST needed) plus the per-shard Leases the deterministic
+  slot-to-shard assignment prefers it for. When a peer's slot Lease
+  expires, the survivors recompute the assignment and **adopt the orphaned
+  shards** the moment their Leases expire — mid-rollout, from durable
+  cluster state alone. A late-joining replica claims a free slot, the
+  incumbents observe the membership change and *release* the shards the
+  new assignment hands over.
+- :meth:`ShardElector.fence` is the split-brain gate: immediately before
+  every durable write the state provider asks the elector to prove — by
+  local belief AND a server-side Lease read — that this replica still owns
+  the target node's shard. A deposed replica's queued transition writes
+  raise :class:`ShardFencedError` (a hard error the per-node transient
+  isolation must NOT swallow) instead of landing outside its partition.
+- :func:`split_budget` + :class:`ShardBudgetLedger` turn the global
+  maxUnavailable budget into **durable budget shares** recorded on the
+  runtime DaemonSet annotation (the RolloutGuard bake-stamp idiom): each
+  shard's share lives under its own annotation key, so concurrent owners
+  never clobber each other's claims (RFC 7386 merge of distinct keys), and
+  the spend rule — decreases take effect immediately, increases only one
+  pass after they are durably recorded and read back — means two shards
+  can never jointly overdraw the fleet budget, even across a takeover.
+
+Everything durable lives on the cluster (slot Leases, shard Leases, the
+budget-share annotations); the elector object carries only observations
+and counters, so replica crash–restart loses nothing the successor cannot
+re-derive.
+"""
+
+from __future__ import annotations
+
+import hashlib
+import logging
+from dataclasses import dataclass
+from typing import Optional
+
+from tpu_operator_libs.k8s.leaderelection import (
+    LeaderElectionConfig,
+    LeaderElector,
+    LeaseLockClient,
+)
+from tpu_operator_libs.util import Clock
+
+logger = logging.getLogger(__name__)
+
+#: Sharded deployments default to a longer lease than single-lock leader
+#: election: a takeover re-runs a whole partition's reconcile, so
+#: flapping ownership on a transient renewal hiccup costs more than a
+#: few extra seconds of orphan time.
+DEFAULT_SHARD_LEASE_DURATION = 30.0
+DEFAULT_SHARD_RENEW_DEADLINE = 20.0
+
+
+class ShardFencedError(RuntimeError):
+    """A durable write was attempted for a node outside the replica's
+    owned partition (or after its shard lease was lost/stolen).
+
+    Deliberately NOT an ApiServerError/ConflictError/NotFoundError: the
+    state machines' per-node transient isolation must not swallow it —
+    a fenced replica must abort its pass and re-derive ownership, the
+    same way an operator crash aborts a pass.
+    """
+
+
+class ShardRing:
+    """Stable node-to-shard mapping.
+
+    Hashing is keyed by the node's *slice* (nodepool label) when one is
+    present, else by the node name — so multi-host ICI slices always land
+    whole on one shard and the slice planner's atomicity survives
+    sharding. The map depends only on ``num_shards`` and the key, never
+    on replica membership: replicas claim *shards*, nodes never migrate
+    between shards when replicas come and go.
+    """
+
+    def __init__(self, num_shards: int) -> None:
+        if num_shards < 1:
+            raise ValueError("num_shards must be >= 1")
+        self.num_shards = num_shards
+
+    def shard_for(self, node_name: str, pool: str = "") -> int:
+        key = pool or node_name
+        digest = hashlib.sha256(key.encode("utf-8")).digest()
+        return int.from_bytes(digest[:8], "big") % self.num_shards
+
+
+def split_budget(total_budget: int,
+                 shard_counts: "dict[int, int]") -> "dict[int, int]":
+    """Deterministically split ``total_budget`` across shards
+    proportionally to their node counts (largest-remainder method, ties
+    broken by shard id). Every replica computes the identical split from
+    the same fleet census, and the shares sum to exactly
+    ``total_budget`` — the arithmetic half of the never-jointly-overdraw
+    guarantee (the durable ledger is the crash/skew half)."""
+    shards = sorted(shard_counts)
+    total_nodes = sum(shard_counts[s] for s in shards)
+    if total_nodes <= 0 or total_budget <= 0:
+        return {s: 0 for s in shards}
+    quotas = {s: total_budget * shard_counts[s] / total_nodes
+              for s in shards}
+    shares = {s: int(quotas[s]) for s in shards}
+    remainder = total_budget - sum(shares.values())
+    by_fraction = sorted(shards, key=lambda s: (-(quotas[s] - shares[s]), s))
+    for s in by_fraction[:remainder]:
+        shares[s] += 1
+    return shares
+
+
+class ShardBudgetLedger:
+    """Encode/decode the durable per-shard budget shares on the runtime
+    DaemonSet's annotations.
+
+    One annotation key PER SHARD (``...upgrade.budget-share.<shard>``):
+    concurrent owners patch disjoint keys, which an RFC 7386 merge patch
+    composes without clobbering — the same reason the RolloutGuard's
+    quarantine/bake stamps are safe to write from any incarnation.
+    """
+
+    def __init__(self, keys: "object") -> None:
+        # UpgradeKeys-shaped: domain + driver build the key family.
+        self._prefix = (f"{keys.domain}/{keys.driver}"
+                        f"-upgrade.budget-share.")
+
+    def annotation_key(self, shard: int) -> str:
+        return f"{self._prefix}{shard}"
+
+    def shares_from(self,
+                    annotations: "dict[str, str]") -> "dict[int, int]":
+        """All recorded shares found in a DaemonSet's annotations."""
+        out: dict[int, int] = {}
+        for key, value in annotations.items():
+            if not key.startswith(self._prefix):
+                continue
+            try:
+                out[int(key[len(self._prefix):])] = int(value)
+            except ValueError:
+                logger.warning("ignoring malformed budget share %r=%r",
+                               key, value)
+        return out
+
+
+@dataclass
+class ShardElectionConfig:
+    """Knobs of one replica's sharded election.
+
+    ``replicas`` is the expected replica count (the number of member
+    slots contended for); ``num_shards`` the ring size. A replica may
+    own MORE than ``num_shards // replicas`` shards while peers are
+    down — orphan adoption is what keeps a dead peer's partition
+    moving — and hands the excess back when the peer (or a fresh
+    replacement) claims a slot again.
+    """
+
+    namespace: str
+    identity: str
+    num_shards: int
+    replicas: int = 2
+    lease_prefix: str = "tpu-operator"
+    lease_duration: float = DEFAULT_SHARD_LEASE_DURATION
+    renew_deadline: float = DEFAULT_SHARD_RENEW_DEADLINE
+    retry_period: float = 2.0
+    #: Fraction of retry_period added as per-replica deterministic
+    #: jitter so N replicas' renewals do not herd the apiserver.
+    renew_jitter: float = 0.2
+
+    def __post_init__(self) -> None:
+        if self.num_shards < 1:
+            raise ValueError("num_shards must be >= 1")
+        if self.replicas < 1:
+            raise ValueError("replicas must be >= 1")
+        if not self.identity:
+            raise ValueError("identity must be non-empty")
+
+    @classmethod
+    def from_policy(cls, spec: "object", namespace: str, identity: str,
+                    lease_prefix: str = "tpu-operator",
+                    ) -> "ShardElectionConfig":
+        """Build the election config from a
+        :class:`~tpu_operator_libs.api.upgrade_policy.ShardingPolicySpec`
+        (the CRD surface): ring size and replica count come from the
+        policy; renew deadline and retry period derive from the lease
+        duration with the client-go 15:10:2 proportions."""
+        duration = float(spec.lease_duration_seconds)
+        return cls(namespace=namespace, identity=identity,
+                   num_shards=spec.num_shards, replicas=spec.replicas,
+                   lease_prefix=lease_prefix,
+                   lease_duration=duration,
+                   renew_deadline=duration * 2.0 / 3.0,
+                   retry_period=max(0.5, duration * 2.0 / 15.0))
+
+    def slot_lease_name(self, slot: int) -> str:
+        return f"{self.lease_prefix}-member-{slot:02d}"
+
+    def shard_lease_name(self, shard: int) -> str:
+        return f"{self.lease_prefix}-shard-{shard:02d}"
+
+
+@dataclass
+class _SlotObservation:
+    """Local observation of one member-slot Lease (client-go expiry
+    semantics: judged from when WE saw the record change, so wall-clock
+    skew between replicas never fabricates membership)."""
+
+    holder: str = ""
+    resource_version: str = ""
+    duration: float = DEFAULT_SHARD_LEASE_DURATION
+    observed_at: float = 0.0
+
+
+class ShardElector:
+    """One replica of the sharded control plane.
+
+    Drive it with :meth:`tick` (non-blocking, clock-injectable — the
+    chaos soaks and benches interleave replicas deterministically) or
+    :meth:`run_step` + a sleep loop for production. The elector exposes
+    the ownership surface the state machines consume:
+
+    - :meth:`owns` / :attr:`owned_shards` — the ownership filter for
+      ``build_state``;
+    - :meth:`fence` — the write-time split-brain gate;
+    - :attr:`ring` — the node-to-shard map (shared by every replica).
+    """
+
+    def __init__(self, client: LeaseLockClient,
+                 config: ShardElectionConfig,
+                 clock: Optional[Clock] = None) -> None:
+        self._client = client
+        self.config = config
+        self._clock = clock or Clock()
+        self.ring = ShardRing(config.num_shards)
+        self.identity = config.identity
+        # one LeaderElector per shard lock; per-slot electors are built
+        # lazily for the slot this replica actually contends for
+        self._shard_electors = {
+            shard: self._elector(config.shard_lease_name(shard))
+            for shard in range(config.num_shards)}
+        self._slot_electors = {
+            slot: self._elector(config.slot_lease_name(slot))
+            for slot in range(config.replicas)}
+        self._slot: Optional[int] = None
+        # observations of EVERY slot lease (membership registry)
+        self._slot_obs: dict[int, _SlotObservation] = {}
+        # lifetime counters (metrics.observe_shard_election)
+        self.acquires_total = 0
+        self.losses_total = 0
+        #: Shards adopted from another (expired) holder's partition.
+        self.takeovers_total = 0
+        #: Shards released to hand ownership to a preferred peer.
+        self.handovers_total = 0
+        #: fence() rejections (split-brain writes refused).
+        self.fence_rejections_total = 0
+
+    def _elector(self, name: str) -> LeaderElector:
+        config = self.config
+        return LeaderElector(
+            self._client,
+            LeaderElectionConfig(
+                namespace=config.namespace, name=name,
+                identity=config.identity,
+                lease_duration=config.lease_duration,
+                renew_deadline=config.renew_deadline,
+                retry_period=config.retry_period,
+                renew_jitter=config.renew_jitter),
+            clock=self._clock)
+
+    # -- inspection -------------------------------------------------------
+    @property
+    def num_shards(self) -> int:
+        return self.config.num_shards
+
+    @property
+    def slot(self) -> Optional[int]:
+        """The member slot this replica holds (None while unslotted)."""
+        return self._slot
+
+    def owned_shards(self) -> frozenset[int]:
+        return frozenset(
+            shard for shard, elector in self._shard_electors.items()
+            if elector.is_leader)
+
+    def owns(self, node_name: str, pool: str = "") -> bool:
+        return self.ring.shard_for(node_name, pool) in self.owned_shards()
+
+    def live_members(self) -> "dict[int, str]":
+        """slot -> holder identity for every UNEXPIRED member slot, by
+        this replica's own observations."""
+        now = self._clock.now()
+        live: dict[int, str] = {}
+        for slot, obs in self._slot_obs.items():
+            if obs.holder and obs.observed_at + obs.duration > now:
+                live[slot] = obs.holder
+        return live
+
+    def preferred_assignment(self) -> "dict[int, int]":
+        """shard -> preferred member SLOT, derived deterministically
+        from the live membership (round-robin over sorted live slots).
+        Every replica with the same observations computes the same
+        assignment — no coordination message exists anywhere."""
+        live = sorted(self.live_members())
+        if not live:
+            return {}
+        return {shard: live[shard % len(live)]
+                for shard in range(self.config.num_shards)}
+
+    # -- the decision step -------------------------------------------------
+    def tick(self) -> frozenset[int]:
+        """One non-blocking election round: claim/renew the member slot,
+        refresh membership observations, then renew / adopt / release
+        shard Leases per the preferred assignment. Returns the shards
+        owned after the round."""
+        self._tick_slot()
+        self._observe_slots()
+        assignment = self.preferred_assignment()
+        live_idents = set(self.live_members().values())
+        for shard, elector in self._shard_electors.items():
+            preferred = assignment.get(shard)
+            if elector.is_leader:
+                if preferred is not None and preferred != self._slot:
+                    # membership changed (a peer joined or we lost our
+                    # slot): hand the shard over instead of making the
+                    # peer wait out our lease
+                    if elector.release():
+                        self.handovers_total += 1
+                        elector.step_down()
+                        self.losses_total += 1
+                        logger.info(
+                            "shard elector %s: released shard %d to "
+                            "slot %s", self.identity, shard, preferred)
+                    continue
+                before = elector.is_leader
+                elector.try_acquire_or_renew()
+                if before and not elector.is_leader:
+                    self.losses_total += 1  # stolen/expired under us
+                continue
+            if preferred != self._slot or self._slot is None:
+                # not ours to claim — but keep the expiry clock warm:
+                # if membership changes and the assignment hands us
+                # this shard, a cold first observation would cost an
+                # extra full lease duration before takeover
+                elector.observe()
+                continue
+            previous = elector.observed_leader
+            if elector.try_acquire_or_renew():
+                self.acquires_total += 1
+                if previous and previous != self.identity \
+                        and previous not in live_idents:
+                    # the lease's last holder is no longer a live
+                    # member: an orphaned-shard takeover, not a first
+                    # claim or a handed-over lease from a live peer
+                    self.takeovers_total += 1
+                    logger.info(
+                        "shard elector %s: took over orphaned shard %d "
+                        "from %s", self.identity, shard, previous)
+        return self.owned_shards()
+
+    def _tick_slot(self) -> None:
+        if self._slot is not None:
+            elector = self._slot_electors[self._slot]
+            elector.try_acquire_or_renew()
+            if not elector.is_leader:
+                logger.warning("shard elector %s: lost member slot %d",
+                               self.identity, self._slot)
+                self._slot = None
+        if self._slot is None:
+            for slot, elector in sorted(self._slot_electors.items()):
+                if elector.try_acquire_or_renew():
+                    self._slot = slot
+                    logger.info("shard elector %s: claimed member "
+                                "slot %d", self.identity, slot)
+                    break
+
+    def _observe_slots(self) -> None:
+        from tpu_operator_libs.k8s.client import NotFoundError
+
+        now = self._clock.now()
+        for slot in range(self.config.replicas):
+            try:
+                lease = self._client.get_lease(
+                    self.config.namespace,
+                    self.config.slot_lease_name(slot))
+            except NotFoundError:
+                self._slot_obs[slot] = _SlotObservation(observed_at=now)
+                continue
+            except Exception:  # noqa: BLE001 — transient apiserver error
+                # keep the previous observation; expiry math will age it
+                # out if the outage persists past the lease duration
+                logger.warning("shard elector %s: slot %d lease read "
+                               "failed", self.identity, slot,
+                               exc_info=True)
+                continue
+            obs = self._slot_obs.get(slot)
+            if (obs is None or obs.resource_version
+                    != lease.metadata.resource_version):
+                self._slot_obs[slot] = _SlotObservation(
+                    holder=lease.holder_identity,
+                    resource_version=lease.metadata.resource_version,
+                    duration=(lease.lease_duration_seconds
+                              or self.config.lease_duration),
+                    observed_at=now)
+
+    # -- the write-time gate ----------------------------------------------
+    def fence(self, node_name: str, pool: str = "") -> None:
+        """Refuse a durable write for a node this replica does not own.
+
+        Two checks, both mandatory: the local belief (cheap, catches a
+        pass iterating a stale snapshot) and a server-side Lease read
+        (catches a mid-pass steal/expiry the next tick has not observed
+        yet — the split-brain seam). Raises :class:`ShardFencedError`;
+        a transient apiserver error on the Lease read propagates as-is,
+        so the per-node transient isolation defers the node instead of
+        letting an unverified write through (fail closed).
+        """
+        shard = self.ring.shard_for(node_name, pool)
+        elector = self._shard_electors[shard]
+        if not elector.is_leader:
+            self.fence_rejections_total += 1
+            raise ShardFencedError(
+                f"replica {self.identity} does not own shard {shard} "
+                f"(node {node_name}); write refused")
+        from tpu_operator_libs.k8s.client import NotFoundError
+
+        try:
+            lease = self._client.get_lease(
+                self.config.namespace,
+                self.config.shard_lease_name(shard))
+        except NotFoundError:
+            lease = None
+        if lease is None or lease.holder_identity != self.identity:
+            # deposed mid-pass: step down locally so every queued write
+            # of this pass is rejected too, not just this one
+            elector.step_down()
+            self.losses_total += 1
+            self.fence_rejections_total += 1
+            holder = lease.holder_identity if lease else "<gone>"
+            raise ShardFencedError(
+                f"replica {self.identity} was deposed from shard "
+                f"{shard} (lease now held by {holder!r}); write for "
+                f"node {node_name} refused")
+
+    # -- lifecycle ---------------------------------------------------------
+    def release_all(self) -> None:
+        """Clean shutdown: release every held shard Lease and the member
+        slot, so successors take over immediately instead of waiting
+        out the lease durations."""
+        for elector in self._shard_electors.values():
+            if elector.is_leader:
+                elector.release()
+                elector.step_down()
+        if self._slot is not None:
+            elector = self._slot_electors[self._slot]
+            if elector.is_leader:
+                elector.release()
+                elector.step_down()
+            self._slot = None
+
+    def run_step(self) -> float:
+        """One production-driver step: tick, then return how long the
+        caller should sleep before the next tick (retry period plus the
+        per-replica deterministic jitter)."""
+        self.tick()
+        return self.config.retry_period * (
+            1.0 + self.config.renew_jitter
+            * self._jitter_fraction())
+
+    def _jitter_fraction(self) -> float:
+        # deterministic per identity: stable spacing between replicas
+        # without shared state (herding is the enemy, not randomness)
+        digest = hashlib.sha256(self.identity.encode()).digest()
+        return digest[0] / 255.0
+
+
+@dataclass
+class StaticShardView:
+    """Fixed ownership for tests and single-process benches: the
+    ownership/fence surface of :class:`ShardElector` without Leases.
+    ``owned`` is the set of shards this view claims; fencing rejects
+    writes outside it (no server round-trip — there is no server-side
+    contention in a static split)."""
+
+    ring: ShardRing
+    owned: frozenset = frozenset()
+    identity: str = "static"
+    fence_rejections_total: int = 0
+    takeovers_total: int = 0
+    acquires_total: int = 0
+    losses_total: int = 0
+    handovers_total: int = 0
+    slot: Optional[int] = None
+
+    @property
+    def num_shards(self) -> int:
+        return self.ring.num_shards
+
+    def owned_shards(self) -> frozenset:
+        return frozenset(self.owned)
+
+    def owns(self, node_name: str, pool: str = "") -> bool:
+        return self.ring.shard_for(node_name, pool) in self.owned
+
+    def fence(self, node_name: str, pool: str = "") -> None:
+        if not self.owns(node_name, pool):
+            self.fence_rejections_total += 1
+            raise ShardFencedError(
+                f"static view {self.identity} does not own node "
+                f"{node_name}; write refused")
+
+    def tick(self) -> frozenset:
+        return self.owned_shards()
+
+    def release_all(self) -> None:
+        pass
+
+    def live_members(self) -> "dict[int, str]":
+        return {0: self.identity}

@@ -39,6 +39,10 @@ if TYPE_CHECKING:  # pragma: no cover - types only
     from tpu_operator_libs.k8s.leaderelection import (
         LeaderElectionConfig,
     )
+    from tpu_operator_libs.k8s.sharding import (
+        ShardElectionConfig,
+        ShardElector,
+    )
     from tpu_operator_libs.metrics import MetricsRegistry
     from tpu_operator_libs.upgrade.nudger import ReconcileNudger
     from tpu_operator_libs.util import Clock
@@ -83,6 +87,8 @@ class OperatorManager:
                  workers: int = 1,
                  leader_election: Optional[
                      "LeaderElectionConfig"] = None,
+                 shard_election: Optional[
+                     "ShardElectionConfig"] = None,
                  leader_election_clock: Optional["Clock"] = None,
                  metrics: Optional["MetricsRegistry"] = None,
                  rate_limiter: Optional[ExponentialBackoffRateLimiter] = None,
@@ -98,6 +104,16 @@ class OperatorManager:
         self._resync_period = resync_period
         self._workers = workers
         self._leader_election = leader_election
+        if leader_election is not None and shard_election is not None:
+            raise ValueError(
+                "leader_election and shard_election are exclusive: the "
+                "sharded control plane replaces the single global lock")
+        self._shard_election = shard_election
+        #: The live ShardElector once run() starts in sharded mode —
+        #: hand it to ClusterUpgradeStateManager.with_sharding (and the
+        #: remediation machine's) so reconciles run ownership-filtered
+        #: and fenced.
+        self.shard_elector: Optional["ShardElector"] = None
         self._leader_election_clock = leader_election_clock
         self._metrics = metrics
         self._rate_limiter = rate_limiter
@@ -267,6 +283,9 @@ class OperatorManager:
         leadership is lost — the standard exit-and-let-the-replica-
         controller-restart-us pattern)."""
         stop = stop or threading.Event()
+        if self._shard_election is not None:
+            self._run_sharded(stop)
+            return
         if self._leader_election is None:
             self.start()
             try:
@@ -316,4 +335,50 @@ class OperatorManager:
             elector_thread.join(timeout=5.0)
         if self._start_error is not None:
             # a startup failure must not look like a clean exit
+            raise self._start_error
+
+    def _run_sharded(self, stop: threading.Event) -> None:
+        """Sharded-HA driver: contend for the member slot + per-shard
+        Leases (k8s/sharding.py), start the runtime once ≥1 shard is
+        owned, and keep electing while it runs. Unlike the single-lock
+        mode, losing SOME shards does not stop the runtime — the
+        ownership filter and the write fence shrink the partition
+        instead (an empty partition reconciles nothing); the runtime
+        stops when the caller sets ``stop``, releasing every Lease so
+        successors take over immediately."""
+        from tpu_operator_libs.k8s.sharding import ShardElector
+
+        elector = ShardElector(self._raw_client, self._shard_election,
+                               clock=self._leader_election_clock)
+        self.shard_elector = elector
+        started = threading.Event()
+
+        def start_async() -> None:
+            try:
+                self.start()
+            except Exception as exc:  # noqa: BLE001 — surfaced via run()
+                logger.exception("%s: start after winning shards failed",
+                                 self._name)
+                self._start_error = exc
+                stop.set()
+
+        def drive() -> None:
+            while not stop.is_set():
+                delay = elector.run_step()
+                if elector.owned_shards() and not started.is_set():
+                    started.set()
+                    threading.Thread(target=start_async, daemon=True,
+                                     name=f"{self._name}-start").start()
+                stop.wait(delay)
+
+        elector_thread = threading.Thread(
+            target=drive, daemon=True, name=f"{self._name}-shard-elector")
+        elector_thread.start()
+        try:
+            stop.wait()
+        finally:
+            elector.release_all()
+            self.stop()
+            elector_thread.join(timeout=5.0)
+        if self._start_error is not None:
             raise self._start_error

@@ -5,7 +5,11 @@ The reference exposes fleet gauges only as methods on the state manager
 upgrade_state.go:1034-1120) and left metrics export as a commented-out
 TODO (upgrade_state.go:413-416). SURVEY.md §5 asks the TPU build to surface
 these as real metrics — they are the numerators/denominators of the
-north-star "slice availability %".
+north-star "slice availability %". That TODO is paid down here: all six
+reference counters export fleet-wide (``observe_cluster_state``,
+``upgrades_available`` included) and, under the sharded control plane,
+per shard with the fleet aggregate alongside (``observe_shards``) —
+mirrored into ``cluster_status`` for the CRD ``.status`` surface.
 
 Prometheus-text exposition without any client library dependency: call
 :meth:`MetricsRegistry.render_prometheus` from whatever HTTP handler the
@@ -228,6 +232,16 @@ def observe_cluster_state(registry: MetricsRegistry,
     registry.set_gauge("nodes_unavailable",
                        manager.get_current_unavailable_nodes(state),
                        "Cordoned or not-ready nodes", labels)
+    if manager.last_pass_slots is not None:
+        # the sixth reference fleet counter (GetUpgradesAvailable,
+        # upgrade_state.go:1073-1102): available slots as computed by
+        # the most recent pass's throttle math — budgets included, so
+        # it is exported from the pass record rather than recomputed
+        # here without the policy
+        registry.set_gauge(
+            "upgrades_available", manager.last_pass_slots["available"],
+            "Upgrade slots available at the last pass (throttle math "
+            "incl. maxUnavailable/maxParallel budgets)", labels)
     for s in ALL_STATES:
         registry.set_gauge(
             "nodes_in_state", len(state.bucket(s)),
@@ -384,6 +398,118 @@ def observe_latency(registry: MetricsRegistry,
         "upgrade_eager_refill_admissions_total",
         manager.eager_refill_admissions_total,
         "Nodes admitted by eager refill rounds", labels)
+
+
+def observe_shards(registry: MetricsRegistry,
+                   manager: "ClusterUpgradeStateManager",
+                   driver: str = "libtpu") -> None:
+    """Export the sharded control plane's fleet picture.
+
+    Pays down the reference's metrics TODO (upgrade_state.go:413-416)
+    at fleet scale: the per-state node gauges labelled PER SHARD (from
+    the manager's fleet-wide census — every replica sees the same
+    numbers even though it only processes its own partition) next to
+    the fleet-wide aggregates ``observe_cluster_state`` already
+    exports, plus this replica's ownership and the durable
+    budget-share split. No-op when sharding is not installed.
+    """
+    labels = {"driver": driver}
+    census = manager.last_shard_status
+    if census is None:
+        return
+    owned = set(census["owned"])
+    registry.set_gauge("shards_total", census["numShards"],
+                       "Shards of the consistent-hash ring", labels)
+    registry.set_gauge("shards_owned", len(owned),
+                       "Shards this replica currently owns", labels)
+    for shard, cell in sorted(census["perShard"].items()):
+        shard_labels = {**labels, "shard": str(shard)}
+        registry.set_gauge(
+            "shard_nodes_total", cell["total"],
+            "Managed nodes per shard (fleet-wide census)", shard_labels)
+        registry.set_gauge(
+            "shard_owned", 1.0 if shard in owned else 0.0,
+            "1 while this replica owns the shard", shard_labels)
+        for s in ALL_STATES:
+            key = str(s) or "unknown"
+            registry.set_gauge(
+                "shard_nodes_in_state", cell["byState"].get(key, 0),
+                "Node count per upgrade state per shard",
+                {**shard_labels, "state": key})
+    shares = manager.last_budget_shares
+    if shares is not None:
+        registry.set_gauge(
+            "shard_budget_global", shares["globalBudget"],
+            "Fleet-wide maxUnavailable budget the shares partition",
+            labels)
+        registry.set_gauge(
+            "shard_budget_cap", shares["cap"],
+            "This replica's effective unavailability cap (durable "
+            "budget shares, post-clamp)", labels)
+        for shard, share in sorted(shares["entitled"].items()):
+            registry.set_gauge(
+                "shard_budget_entitled", share,
+                "Deterministic budget entitlement per shard",
+                {**labels, "shard": shard})
+        for shard, share in sorted(shares["recorded"].items()):
+            registry.set_gauge(
+                "shard_budget_recorded", share,
+                "Durably recorded budget share per shard (DaemonSet "
+                "annotation ledger)", {**labels, "shard": shard})
+
+
+def observe_shard_election(registry: MetricsRegistry,
+                           elector: "object",
+                           driver: str = "libtpu") -> None:
+    """Export one replica's shard-election accounting.
+
+    ``elector`` is a :class:`tpu_operator_libs.k8s.sharding.
+    ShardElector` (anything exposing its counter surface works):
+    leadership transitions (acquires/losses), orphaned-shard takeovers,
+    handovers to preferred peers, fencing rejections — the
+    split-brain-refused write count an on-call wants at 0 — and the
+    member-slot gauge.
+    """
+    labels = {"driver": driver}
+    registry.set_counter_total(
+        "shard_lease_acquires_total", elector.acquires_total,
+        "Shard leases acquired (first claims + takeovers)", labels)
+    registry.set_counter_total(
+        "shard_lease_losses_total", elector.losses_total,
+        "Shard leases lost (stolen, expired, or handed over)", labels)
+    registry.set_counter_total(
+        "shard_takeovers_total", elector.takeovers_total,
+        "Orphaned shards adopted from a dead peer's partition", labels)
+    registry.set_counter_total(
+        "shard_handovers_total", elector.handovers_total,
+        "Shards released to a preferred live peer (rebalance)", labels)
+    registry.set_counter_total(
+        "shard_fence_rejections_total", elector.fence_rejections_total,
+        "Durable writes refused by the split-brain fencing check",
+        labels)
+    slot = getattr(elector, "slot", None)
+    registry.set_gauge(
+        "shard_member_slot", -1.0 if slot is None else float(slot),
+        "Member slot this replica holds (-1 while unslotted)", labels)
+
+
+def observe_leader_election(registry: MetricsRegistry,
+                            elector: "object",
+                            driver: str = "libtpu") -> None:
+    """Export a single-lock LeaderElector's transition counters: the
+    acquires/losses pair plus the is-leader gauge (1 exactly on the
+    current leader — a fleet-wide sum above 1 is the page)."""
+    labels = {"driver": driver}
+    registry.set_counter_total(
+        "leader_election_acquires_total", elector.acquires_total,
+        "Times this replica acquired leadership", labels)
+    registry.set_counter_total(
+        "leader_election_losses_total", elector.losses_total,
+        "Times this replica lost or released leadership", labels)
+    registry.set_gauge(
+        "leader_election_is_leader",
+        1.0 if elector.is_leader else 0.0,
+        "1 while this replica holds the lease", labels)
 
 
 #: Buckets for canary-halt→evacuated durations: a rollback rides pod

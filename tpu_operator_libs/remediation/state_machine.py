@@ -242,6 +242,25 @@ class NodeRemediationManager:
         self._recovery_seconds: list[float] = []
         self._transient_deferrals = 0
         self.last_pass_deferrals = 0
+        # Sharded control plane (k8s/sharding.py): ownership view
+        # shared with the upgrade machine. None = single-owner.
+        self._shard_view = None
+
+    def with_sharding(self, view: "object") -> "NodeRemediationManager":
+        """Install (or clear) the sharded-control-plane ownership view:
+        ``build_state`` keeps only nodes whose shard this replica owns,
+        and the provider + cordon manager fence their durable writes
+        (same contract as the upgrade machine's ``with_sharding``).
+        Budgets (maxConcurrent, maxUnavailable) then apply to the
+        PARTITION — remediation quarantines already-broken nodes, so a
+        per-partition budget errs conservative rather than unsafe."""
+        self._shard_view = view
+        fence = view.fence if view is not None else None
+        with_fence = getattr(self.provider, "with_fence", None)
+        if with_fence is not None:
+            with_fence(fence)
+        self.cordon_manager.with_fence(fence)
+        return self
 
     # ------------------------------------------------------------------
     # snapshot
@@ -268,6 +287,11 @@ class NodeRemediationManager:
             pod = pods_by_node.get(node.metadata.name)
             if pod is None and not label \
                     and TPU_RESOURCE_NAME not in node.metadata.labels:
+                continue
+            if self._shard_view is not None and not self._shard_view.owns(
+                    node.metadata.name,
+                    node.metadata.labels.get(GKE_NODEPOOL_LABEL, "")):
+                # ownership-filtered snapshot: another replica's shard
                 continue
             snapshot.node_states.setdefault(label, []).append(
                 NodeRemediationState(node=node, runtime_pod=pod))

@@ -3,7 +3,9 @@
 from __future__ import annotations
 
 import logging
+from typing import Callable, Optional
 
+from tpu_operator_libs.consts import GKE_NODEPOOL_LABEL
 from tpu_operator_libs.k8s.client import K8sClient
 from tpu_operator_libs.k8s.drain import run_cordon_or_uncordon
 from tpu_operator_libs.k8s.objects import Node
@@ -12,17 +14,38 @@ logger = logging.getLogger(__name__)
 
 
 class CordonManager:
-    """Marks nodes (un)schedulable via the drain helper's cordon path."""
+    """Marks nodes (un)schedulable via the drain helper's cordon path.
 
-    def __init__(self, client: K8sClient) -> None:
+    ``fence`` is the sharded-control-plane split-brain gate (the same
+    ``(node_name, nodepool)`` contract as the state provider's): a
+    cordon/uncordon is a durable node write too, so a deposed replica
+    must not flip schedulability outside its partition either.
+    """
+
+    def __init__(self, client: K8sClient,
+                 fence: Optional[Callable[[str, str], None]] = None,
+                 ) -> None:
         self._client = client
+        self._fence = fence
+
+    def with_fence(self, fence: Optional[Callable[[str, str], None]],
+                   ) -> "CordonManager":
+        self._fence = fence
+        return self
+
+    def _check_fence(self, node: Node) -> None:
+        if self._fence is not None:
+            self._fence(node.metadata.name,
+                        node.metadata.labels.get(GKE_NODEPOOL_LABEL, ""))
 
     def cordon(self, node: Node) -> None:
+        self._check_fence(node)
         run_cordon_or_uncordon(self._client, node.metadata.name, True)
         node.spec.unschedulable = True
         logger.info("cordoned node %s", node.metadata.name)
 
     def uncordon(self, node: Node) -> None:
+        self._check_fence(node)
         run_cordon_or_uncordon(self._client, node.metadata.name, False)
         node.spec.unschedulable = False
         logger.info("uncordoned node %s", node.metadata.name)

@@ -54,6 +54,16 @@ class MockNodeUpgradeStateProvider(RecordingMixin):
         self.keys = keys or UpgradeKeys()
         self.fail_next: Optional[Exception] = None
         self.live_states: dict[str, str] = {}
+        self.fence = None
+
+    def with_fence(self, fence: "object") -> "MockNodeUpgradeStateProvider":
+        """Sharded-control-plane seam parity: install the (node_name,
+        nodepool) fence the real provider checks before every durable
+        write. The mock stores it so with_sharding-driven tests can
+        assert the installation; mock writes do not call it (there is
+        no wire to fence)."""
+        self.fence = fence
+        return self
 
     def _maybe_fail(self) -> None:
         if self.fail_next is not None:
@@ -116,6 +126,12 @@ class MockCordonManager(RecordingMixin):
     def __init__(self) -> None:
         super().__init__()
         self.fail_next: Optional[Exception] = None
+        self.fence = None
+
+    def with_fence(self, fence: "object") -> "MockCordonManager":
+        """Sharded-control-plane seam parity (see the provider mock)."""
+        self.fence = fence
+        return self
 
     def cordon(self, node: Node) -> None:
         self.record("cordon", node.metadata.name)

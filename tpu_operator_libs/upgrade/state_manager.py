@@ -44,6 +44,7 @@ from typing import TYPE_CHECKING, Callable, Optional, Protocol
 
 if TYPE_CHECKING:  # pragma: no cover - import cycle guard (topology
     # imports k8s.objects; planner imports this module's state types)
+    from tpu_operator_libs.k8s.sharding import ShardElector
     from tpu_operator_libs.topology.multislice import MultisliceConstraint
     from tpu_operator_libs.topology.slice_topology import SliceTopology
     from tpu_operator_libs.upgrade.nudger import ReconcileNudger
@@ -308,6 +309,54 @@ class ClusterUpgradeStateManager:
         #: count, slot budget and saturation — the gauge feed for
         #: metrics.observe_latency and the cluster_status "slots" block.
         self.last_pass_slots: Optional[dict] = None
+        # ---- sharded control plane (k8s/sharding.py) ----
+        #: Ownership view (ShardElector or StaticShardView). None = the
+        #: single-owner reference semantics, bit for bit.
+        self._shard_view: Optional["ShardElector"] = None
+        #: The UNFILTERED snapshot of the most recent build (sharding
+        #: only): fleet-wide truth for the rollout guard's cohort, the
+        #: slice planner's topology grouping and the budget split —
+        #: decisions that must be identical across replicas.
+        self._last_full_state: Optional[ClusterUpgradeState] = None
+        #: Fleet-wide per-shard census of the most recent build
+        #: (sharding only): shard -> {"total": n, "byState": {...}} —
+        #: the feed for metrics.observe_shards and cluster_status.
+        self.last_shard_status: Optional[dict] = None
+        #: Budget-share picture of the most recent pass (sharding
+        #: only): global budget, entitlements, recorded shares, cap.
+        self.last_budget_shares: Optional[dict] = None
+
+    def with_sharding(
+            self, view: Optional["ShardElector"],
+    ) -> "ClusterUpgradeStateManager":
+        """Install (or clear) the sharded-control-plane ownership view.
+
+        With a view installed this replica's ``apply_state`` operates on
+        an **ownership-filtered snapshot** (only nodes whose shard it
+        owns), its durable writes are **fenced** (state provider AND
+        cordon manager refuse writes outside the partition — a deposed
+        replica's in-flight pass raises
+        :class:`~tpu_operator_libs.k8s.sharding.ShardFencedError`
+        instead of landing a split-brain write), and the global
+        maxUnavailable budget is spent through **durable budget shares**
+        on the runtime DaemonSet (see ``_sharded_unavailable_cap``).
+        ``None`` restores single-owner semantics exactly.
+        """
+        self._shard_view = view
+        fence = view.fence if view is not None else None
+        with_fence = getattr(self.provider, "with_fence", None)
+        if with_fence is not None:
+            with_fence(fence)
+        self.cordon_manager.with_fence(fence)
+        if view is None:
+            self._last_full_state = None
+            self.last_shard_status = None
+            self.last_budget_shares = None
+        return self
+
+    @property
+    def shard_view(self) -> Optional["ShardElector"]:
+        return self._shard_view
 
     def with_nudger(
             self, nudger: Optional["ReconcileNudger"],
@@ -544,9 +593,24 @@ class ClusterUpgradeStateManager:
             # unscheduled pods — refuse to act.
             if ds.status.desired_number_scheduled not in (
                     len(ds_pods), len(ds_pods) + stranded):
-                raise BuildStateError(
-                    f"runtime DaemonSet {ds.metadata.name} should not have "
-                    f"unscheduled pods")
+                if self._shard_view is not None and \
+                        self._partition_is_complete(ds_pods, nodes_by_name):
+                    # Sharded control plane: the missing pods are all on
+                    # OTHER replicas' partitions — their owners are
+                    # mid-pod-restart, which is the steady state of a
+                    # concurrent rollout. A fleet-wide abort here would
+                    # serialize the replicas behind whichever one
+                    # deleted pods first this tick (tick-order
+                    # starvation); our own partition is complete, so
+                    # the snapshot is safe for every decision we own.
+                    logger.debug(
+                        "runtime DaemonSet %s has pod-restart holes "
+                        "outside this replica's partition; proceeding",
+                        ds.metadata.name)
+                else:
+                    raise BuildStateError(
+                        f"runtime DaemonSet {ds.metadata.name} should "
+                        f"not have unscheduled pods")
             filtered.extend((p, ds) for p in ds_pods)
         filtered.extend((p, None) for p in pods if p.is_orphaned())
 
@@ -568,7 +632,188 @@ class ClusterUpgradeStateManager:
                 node=node, runtime_pod=pod, runtime_daemon_set=ds)
             label = node.metadata.labels.get(self.keys.state_label, "")
             state.node_states.setdefault(label, []).append(node_state)
+        if self._shard_view is not None:
+            return self._filter_owned_partition(state, nodes_by_name)
         return state
+
+    def _partition_is_complete(self, ds_pods: "list[Pod]",
+                               nodes_by_name: "dict[str, Node]") -> bool:
+        """True when every node LACKING a pod of this DaemonSet lies
+        outside this replica's partition — the sharded relaxation of
+        the completeness guard (holes in OUR partition keep the
+        reference's refuse-to-act semantics, bit for bit)."""
+        from tpu_operator_libs.consts import GKE_NODEPOOL_LABEL
+
+        covered = {pod.spec.node_name for pod in ds_pods
+                   if pod.spec.node_name}
+        view = self._shard_view
+        return not any(
+            view.owns(name,
+                      node.metadata.labels.get(GKE_NODEPOOL_LABEL, ""))
+            for name, node in nodes_by_name.items()
+            if name not in covered)
+
+    def _filter_owned_partition(
+            self, state: ClusterUpgradeState,
+            nodes_by_name: "dict[str, Node]") -> ClusterUpgradeState:
+        """Ownership filter: keep only nodes whose shard this replica
+        owns, while retaining the full snapshot (fleet-wide truth for
+        the rollout cohort, slice planning and the budget split) and a
+        per-shard census for metrics/status.
+
+        The census counts a node as managed when it carries a runtime
+        pod OR an upgrade-state label: a node whose pod is mid-restart
+        (deleted, recreation in flight) falls out of the pod snapshot
+        but must NOT fall out of the budget denominator — with several
+        replicas restarting pods concurrently, a pod-only census
+        shrinks and grows every tick and the budget entitlements flap
+        with it (observed as alternating-tick cap oscillation in the
+        shard bench)."""
+        from tpu_operator_libs.consts import GKE_NODEPOOL_LABEL
+
+        view = self._shard_view
+        self._last_full_state = state
+        owned = view.owned_shards()
+        census: dict[int, dict] = {
+            shard: {"total": 0, "byState": {}}
+            for shard in range(view.num_shards)}
+        covered: set[str] = set()
+        filtered = ClusterUpgradeState()
+        for label, bucket in state.node_states.items():
+            for ns in bucket:
+                covered.add(ns.node.metadata.name)
+                shard = view.ring.shard_for(
+                    ns.node.metadata.name,
+                    ns.node.metadata.labels.get(GKE_NODEPOOL_LABEL, ""))
+                cell = census[shard]
+                cell["total"] += 1
+                key = label or "unknown"
+                cell["byState"][key] = cell["byState"].get(key, 0) + 1
+                if shard in owned:
+                    filtered.node_states.setdefault(label, []).append(ns)
+        for name, node in nodes_by_name.items():
+            if name in covered:
+                continue
+            label = node.metadata.labels.get(self.keys.state_label, "")
+            if not label:
+                continue  # no pod, never managed: not fleet capacity
+            shard = view.ring.shard_for(
+                name, node.metadata.labels.get(GKE_NODEPOOL_LABEL, ""))
+            cell = census[shard]
+            cell["total"] += 1
+            cell["byState"][label] = cell["byState"].get(label, 0) + 1
+        self.last_shard_status = {
+            "owned": sorted(owned),
+            "numShards": view.num_shards,
+            "perShard": census,
+        }
+        return filtered
+
+    def _sharded_budget_caps(self, full_state: ClusterUpgradeState,
+                             policy: UpgradePolicySpec) -> tuple[int, int]:
+        """The partition's (maxUnavailable, maxParallel) caps under the
+        durable budget-share protocol.
+
+        The GLOBAL budget ``B`` is the policy scaled against the FULL
+        fleet; ``split_budget`` partitions it deterministically across
+        shards proportional to their node counts (sum == B exactly, so
+        every replica computing the same split cannot jointly overdraw).
+        The durable half closes the crash/skew holes: each owned
+        shard's share is recorded under its own annotation key on the
+        runtime DaemonSet (distinct keys — concurrent owners' merge
+        patches compose), and the spend rule is asymmetric:
+
+        - a DECREASE (fleet shrank, shard shrank) takes effect
+          immediately — ``min(entitlement, recorded)``;
+        - an INCREASE only takes effect one pass AFTER it was recorded
+          and read back from the snapshot, so by the time any replica
+          spends against a larger share, every replica's snapshot shows
+          it and the global clamp below applies to the same numbers.
+
+        The clamp is the takeover/skew backstop: if the recorded shares
+        of ALL shards ever sum past B (two replicas mid-disagreement
+        about the fleet size), this replica reduces its own cap to what
+        provably fits under B next to everyone else's recorded claims —
+        the conservative resolution that needs no coordination.
+        """
+        from tpu_operator_libs.k8s.sharding import (
+            ShardBudgetLedger,
+            split_budget,
+        )
+
+        view = self._shard_view
+        owned = view.owned_shards()
+        # the stable managed-node census (pods + mid-restart label
+        # holders) computed by _filter_owned_partition for this build
+        counts = {shard: cell["total"] for shard, cell in
+                  self.last_shard_status["perShard"].items()}
+        fleet_total = sum(counts.values())
+        global_budget = fleet_total
+        if policy.max_unavailable is not None:
+            global_budget = scaled_value_from_int_or_percent(
+                policy.max_unavailable, fleet_total, round_up=True)
+        entitled = split_budget(global_budget, counts)
+
+        # the ledger DaemonSet: deterministically the first runtime DS
+        # (sorted by namespace/name) — every replica picks the same one
+        ledger = ShardBudgetLedger(self.keys)
+        ledger_ds = None
+        seen: dict[str, DaemonSet] = {}
+        for bucket in full_state.node_states.values():
+            for ns in bucket:
+                if ns.runtime_daemon_set is not None:
+                    meta = ns.runtime_daemon_set.metadata
+                    seen[f"{meta.namespace}/{meta.name}"] = \
+                        ns.runtime_daemon_set
+        if seen:
+            ledger_ds = seen[min(seen)]
+        recorded = (ledger.shares_from(ledger_ds.metadata.annotations)
+                    if ledger_ds is not None else {})
+
+        # spend rule: decreases immediate, increases next pass
+        cap = sum(min(entitled[shard], recorded.get(shard,
+                                                    entitled[shard]))
+                  for shard in owned)
+        # global clamp: everyone else's recorded claim (their
+        # entitlement when unrecorded) must still fit next to ours
+        others = sum(recorded.get(shard, entitled[shard])
+                     for shard in range(view.num_shards)
+                     if shard not in owned)
+        cap = max(0, min(cap, global_budget - others))
+
+        # record our owned shards' entitlements when they changed (ONE
+        # merge patch, disjoint keys per shard — crash-atomic, and
+        # concurrent replicas never touch each other's keys)
+        stale = {shard: entitled[shard] for shard in owned
+                 if recorded.get(shard) != entitled[shard]}
+        if stale and ledger_ds is not None:
+            try:
+                self.client.patch_daemon_set_annotations(
+                    ledger_ds.metadata.namespace,
+                    ledger_ds.metadata.name,
+                    {ledger.annotation_key(shard): str(share)
+                     for shard, share in stale.items()})
+            except (ApiServerError, ConflictError, NotFoundError) as exc:
+                # transient: spend against the OLD recorded shares this
+                # pass (conservative) and retry the stamp next pass
+                logger.warning("budget-share stamp deferred on "
+                               "transient error: %s", exc)
+
+        max_parallel = policy.max_parallel_upgrades
+        if max_parallel > 0:
+            parallel_split = split_budget(max_parallel, counts)
+            max_parallel = sum(parallel_split[s] for s in owned)
+            if max_parallel == 0:
+                # 0 means UNLIMITED to the throttle; a shard whose
+                # parallel share rounded to zero must spend nothing
+                max_parallel = -1
+        self.last_budget_shares = {
+            "globalBudget": global_budget,
+            "entitled": {str(s): entitled[s] for s in sorted(entitled)},
+            "recorded": {str(s): recorded[s] for s in sorted(recorded)},
+            "cap": cap,
+        }
+        return cap, max_parallel
 
     # ------------------------------------------------------------------
     # apply_state (upgrade_state.go:364-484)
@@ -604,19 +849,38 @@ class ClusterUpgradeStateManager:
 
         # Rollout guard first: halt detection must land in the SAME pass
         # as the verdicts that tripped it — admissions below consult the
-        # decision, so a halting fleet admits nothing this pass.
-        self._rollout = self.rollout_guard.assess(state, policy,
-                                                 self.pod_manager)
+        # decision, so a halting fleet admits nothing this pass. Under
+        # sharding the guard assesses the FULL snapshot: the canary
+        # cohort and the halt verdicts are fleet-level decisions every
+        # replica must derive identically (its durable writes — the
+        # quarantine/bake stamps — are idempotent across replicas).
+        full_state = (self._last_full_state
+                      if self._shard_view is not None
+                      and self._last_full_state is not None else state)
+        self._rollout = self.rollout_guard.assess(full_state, policy,
+                                                  self.pod_manager)
         if self._rollout.quarantined:
             self._admit_rollback_nodes(state, policy)
 
         total_nodes = self.get_total_managed_nodes(state)
-        max_unavailable = total_nodes
-        if policy.max_unavailable is not None:
-            max_unavailable = scaled_value_from_int_or_percent(
-                policy.max_unavailable, total_nodes, round_up=True)
+        max_parallel = policy.max_parallel_upgrades
+        if self._shard_view is None or self.last_shard_status is None:
+            # single-owner semantics (also the fallback for a snapshot
+            # built before with_sharding was installed: no census means
+            # no share ledger to spend against)
+            max_unavailable = total_nodes
+            if policy.max_unavailable is not None:
+                max_unavailable = scaled_value_from_int_or_percent(
+                    policy.max_unavailable, total_nodes, round_up=True)
+        else:
+            # the partition's cap comes from the durable budget-share
+            # ledger, never from scaling the policy against the
+            # partition (per-shard percent ceilings would jointly
+            # overdraw the fleet budget)
+            max_unavailable, max_parallel = self._sharded_budget_caps(
+                full_state, policy)
         upgrades_available = self.get_upgrades_available(
-            state, policy.max_parallel_upgrades, max_unavailable)
+            state, max_parallel, max_unavailable)
         in_progress = self.get_upgrades_in_progress(state)
         logger.info(
             "upgrades in progress: %d, available slots: %d, "
@@ -627,8 +891,8 @@ class ClusterUpgradeStateManager:
         # throttle lets us spend? (the eager refill exists to keep this
         # saturated — see _eager_slot_refill)
         budget = max_unavailable
-        if policy.max_parallel_upgrades > 0:
-            budget = min(budget, policy.max_parallel_upgrades)
+        if max_parallel > 0:
+            budget = min(budget, max_parallel)
         self.last_pass_slots = {
             "inProgress": in_progress,
             "available": upgrades_available,
@@ -675,7 +939,8 @@ class ClusterUpgradeStateManager:
         self.process_rollback_required_nodes(state)
         self.process_validation_required_nodes(state)
         self.process_uncordon_required_nodes(state)
-        self._eager_slot_refill(state, policy, planner, max_unavailable)
+        self._eager_slot_refill(state, policy, planner, max_unavailable,
+                                max_parallel)
         # Gate-parked nodes that left every eviction-wanting state this
         # pass (policy flipped drain off, node recovered or vanished) are
         # handed back to the gate's release hook so e.g. serving
@@ -932,8 +1197,18 @@ class ClusterUpgradeStateManager:
             logger.info("node %s waiting for cordon",
                         ns.node.metadata.name)
 
-        self._map_bucket(planner.plan(candidates, upgrades_available, state),
-                         "upgrade start", start)
+        # Under sharding the planner sees the FULL snapshot (candidates
+        # stay partition-local): slice grouping and multislice-job
+        # budgets are fleet-wide truths, and a partition-local view
+        # would let two replicas jointly overdraw a DCN job's member
+        # budget or split a slice wave.
+        plan_state = state
+        if self._shard_view is not None and self._last_full_state \
+                is not None:
+            plan_state = self._last_full_state
+        self._map_bucket(
+            planner.plan(candidates, upgrades_available, plan_state),
+            "upgrade start", start)
 
     def process_cordon_required_nodes(self, state: ClusterUpgradeState) -> None:
         """Cordon and advance to wait-for-jobs (upgrade_state.go:635-654)."""
@@ -1283,7 +1558,8 @@ class ClusterUpgradeStateManager:
     def _eager_slot_refill(self, state: ClusterUpgradeState,
                            policy: UpgradePolicySpec,
                            planner: UpgradePlanner,
-                           max_unavailable: int) -> None:
+                           max_unavailable: int,
+                           max_parallel: Optional[int] = None) -> None:
         """Re-spend slots freed by nodes that finished THIS pass.
 
         Admission runs first in ``apply_state`` (reference bucket
@@ -1320,8 +1596,10 @@ class ClusterUpgradeStateManager:
                     candidates.append(ns)
         if not candidates:
             return
+        if max_parallel is None:
+            max_parallel = policy.max_parallel_upgrades
         available = self.get_upgrades_available(
-            effective, policy.max_parallel_upgrades, max_unavailable)
+            effective, max_parallel, max_unavailable)
         if available <= 0:
             return
         effective.node_states[required] = candidates
@@ -1513,6 +1791,22 @@ class ClusterUpgradeStateManager:
             # in-flight window saturation + eager-refill evidence for
             # the most recent pass (why the fleet is / is not pacing)
             status["slots"] = dict(self.last_pass_slots)
+        if self._shard_view is not None and self.last_shard_status:
+            # the sharded-control-plane picture: which shards this
+            # replica owns, the fleet-wide per-shard node census, and
+            # the durable budget-share split the partition spends under
+            shard_block: dict = {
+                "identity": getattr(self._shard_view, "identity", ""),
+                "owned": list(self.last_shard_status["owned"]),
+                "numShards": self.last_shard_status["numShards"],
+                "perShard": {
+                    str(shard): dict(cell) for shard, cell in
+                    sorted(self.last_shard_status["perShard"].items())},
+            }
+            if self.last_budget_shares is not None:
+                shard_block["budgetShares"] = dict(
+                    self.last_budget_shares)
+            status["shards"] = shard_block
         if self.nudger is not None:
             wakeups = self.nudger.counts_snapshot()
             if wakeups:

@@ -16,9 +16,14 @@ from __future__ import annotations
 
 import logging
 import threading
-from typing import Optional
+from typing import Callable, Optional
 
-from tpu_operator_libs.consts import NULL_STRING, UpgradeKeys, UpgradeState
+from tpu_operator_libs.consts import (
+    GKE_NODEPOOL_LABEL,
+    NULL_STRING,
+    UpgradeKeys,
+    UpgradeState,
+)
 from tpu_operator_libs.k8s.client import K8sClient
 from tpu_operator_libs.k8s.objects import Node
 from tpu_operator_libs.util import Clock, EventRecorder, Event, KeyedLock, log_event
@@ -37,7 +42,9 @@ class NodeUpgradeStateProvider:
                  recorder: Optional[EventRecorder] = None,
                  clock: Optional[Clock] = None,
                  sync_timeout: float = 10.0,
-                 poll_interval: float = 1.0) -> None:
+                 poll_interval: float = 1.0,
+                 fence: Optional[Callable[[str, str], None]] = None,
+                 ) -> None:
         self._client = client
         self._keys = keys
         self._recorder = recorder
@@ -46,12 +53,30 @@ class NodeUpgradeStateProvider:
         self._poll_interval = poll_interval
         self._node_lock = KeyedLock()
         self._counter_lock = threading.Lock()
+        # Sharded-control-plane split-brain gate: called with
+        # (node_name, nodepool) immediately before EVERY durable write.
+        # A replica deposed from the node's shard raises
+        # k8s.sharding.ShardFencedError HERE — inside the per-node lock,
+        # before the patch — so a stale pass's queued transition writes
+        # are rejected, never silently applied outside its partition.
+        self._fence = fence
         #: Durable node writes issued (each is one wire patch).
         self.writes_total = 0
         #: Wire patches avoided by coalescing a transition's label +
         #: annotation changes into one merge patch (metrics evidence
         #: for the fleet-scale write path).
         self.coalesced_writes_saved_total = 0
+
+    def with_fence(self, fence: Optional[Callable[[str, str], None]],
+                   ) -> "NodeUpgradeStateProvider":
+        """Install (or clear) the shard fence after construction."""
+        self._fence = fence
+        return self
+
+    def _check_fence(self, node: Node) -> None:
+        if self._fence is not None:
+            self._fence(node.metadata.name,
+                        node.metadata.labels.get(GKE_NODEPOOL_LABEL, ""))
 
     def _count_write(self, saved: int = 0) -> None:
         with self._counter_lock:
@@ -118,6 +143,7 @@ class NodeUpgradeStateProvider:
                 # another pass already committed this exact transition
                 self._copy_into(node, live)
                 return True
+            self._check_fence(node)
             try:
                 if ann_patch:
                     self._client.patch_node_meta(
@@ -177,6 +203,7 @@ class NodeUpgradeStateProvider:
                        else value)
                  for key, value in annotations.items()}
         with self._node_lock.lock(node.metadata.name):
+            self._check_fence(node)
             try:
                 self._client.patch_node_annotations(
                     node.metadata.name, patch)
@@ -214,6 +241,7 @@ class NodeUpgradeStateProvider:
         delete = value is None or value == NULL_STRING
         patch_value = None if delete else value
         with self._node_lock.lock(node.metadata.name):
+            self._check_fence(node)
             try:
                 self._client.patch_node_annotations(
                     node.metadata.name, {key: patch_value})
