@@ -4,7 +4,7 @@ PYTHON ?= python3
 PYTEST_FLAGS ?= -q
 COV_THRESHOLD ?= 85
 
-.PHONY: all check test test-fast test-fault test-chaos test-soak test-scale test-rollout test-latency test-reconfig test-shard lint cov bench bench-reconcile bench-latency bench-shard graft-check package clean diagram
+.PHONY: all check test test-fast test-fault test-chaos test-soak test-scale test-rollout test-latency test-reconfig test-shard lint cov bench bench-reconcile bench-latency bench-shard bench-shard-100k graft-check package clean diagram
 
 all: lint test
 
@@ -128,10 +128,19 @@ test-shard:
 	$(PYTHON) -m pytest tests/ $(PYTEST_FLAGS) -m "shard and not slow"
 
 # Sharded-control-plane scale proof: single-owner vs 4 sharded replicas
-# on a 16k-node simulated fleet, bit-identical final cluster state
+# on a 16k-node simulated fleet, bit-identical final cluster state with
+# per-replica O(partition) read accounting
 # (tools/latency_bench.py --shard-nodes; docs/sharded-control-plane.md).
 bench-shard:
-	$(PYTHON) tools/latency_bench.py --shard-nodes 16384 --shard-replicas 4
+	$(PYTHON) tools/latency_bench.py --shard-nodes 16384 --shard-replicas 4 --out BENCH_shard.json
+
+# The 100k-node scale proof (slow — ~15-20 min): 102,400 simulated
+# nodes, 4 partition-reading replicas vs one single owner; acceptance =
+# bit-identical convergence + per-replica steady-state read load within
+# ~1.3x of fleet/replicas + zero steady-state full-fleet pod LISTs
+# (docs/benchmarks.md §2e). Writes BENCH_shard.json.
+bench-shard-100k:
+	$(PYTHON) tools/latency_bench.py --shard-nodes 102400 --shard-replicas 4 --out BENCH_shard.json
 
 # Event-driven scheduling regressions (`latency` marker): timer wheel,
 # nudge dedup, eager refill, and the 64-node bench smoke are tier-1;

@@ -1,0 +1,429 @@
+"""O(partition) sharded reads: partition-filtered caches, delta-wired
+sharded build_state, node-selector pushdown, and the scale smoke.
+
+Covers ISSUE 8's tentpole end to end:
+
+- the k8s layer: ``ShardPartitionFilter`` ingest semantics (fail-open
+  on unknown nodes, drop on provably-unowned), the deterministic pump
+  mode, targeted re-LIST + cursor invalidation on ownership moves;
+- the state manager: partition-delta ``build_state`` producing OWNED
+  snapshots identical to the PR 7 post-filter reference across a
+  forced shard handover, the label-derived fleet census, and the
+  node-selector pushdown with fake-cluster selector parity;
+- the proof path: a 1024-node sharded bench smoke (``scale`` marker)
+  pinning bit-identical convergence and per-replica read scaling.
+"""
+
+import pytest
+
+pytestmark = [pytest.mark.shard]
+
+from tpu_operator_libs.api.upgrade_policy import (
+    CanaryRolloutSpec,
+    DrainSpec,
+    UpgradePolicySpec,
+)
+from tpu_operator_libs.consts import GKE_NODEPOOL_LABEL, UpgradeState
+from tpu_operator_libs.k8s.cached import CachedReadClient
+from tpu_operator_libs.k8s.objects import Node, ObjectMeta
+from tpu_operator_libs.k8s.sharding import ShardRing, StaticShardView
+from tpu_operator_libs.metrics import MetricsRegistry, observe_shards
+from tpu_operator_libs.simulate import (
+    NS,
+    RUNTIME_LABELS,
+    FleetSpec,
+    build_fleet,
+)
+from tpu_operator_libs.upgrade.state_manager import (
+    BuildStateError,
+    ClusterUpgradeStateManager,
+)
+
+POLICY = UpgradePolicySpec(
+    auto_upgrade=True, max_parallel_upgrades=0,
+    max_unavailable="25%", topology_mode="flat",
+    drain=DrainSpec(enable=False))
+
+
+def _mutable_view(ring, owned, identity="part"):
+    view = StaticShardView(ring=ring, owned=frozenset(owned),
+                           identity=identity)
+    return view
+
+
+def _canonical(result):
+    """Canonicalize a build_state outcome for cross-mode comparison:
+    either ('error',) or the owned snapshot's full observable content."""
+    if isinstance(result, tuple):
+        return result
+    return tuple(sorted(
+        (label, ns.node.metadata.name,
+         tuple(sorted(ns.node.metadata.labels.items())),
+         tuple(sorted(ns.node.metadata.annotations.items())),
+         ns.node.is_unschedulable(),
+         ns.runtime_pod.metadata.name,
+         ns.runtime_pod.metadata.labels.get(
+             "controller-revision-hash", ""),
+         ns.runtime_pod.is_ready(),
+         ns.runtime_daemon_set.metadata.uid
+         if ns.runtime_daemon_set is not None else None)
+        for label, bucket in result.node_states.items()
+        for ns in bucket))
+
+
+def _build(mgr):
+    try:
+        return mgr.build_state(NS, RUNTIME_LABELS)
+    except BuildStateError:
+        return ("error",)
+
+
+class TestPartitionFilterIngest:
+    """ShardPartitionFilter + Informer ingest filter semantics."""
+
+    def _fleet(self):
+        return build_fleet(FleetSpec(n_slices=4, hosts_per_slice=4))
+
+    def test_pod_cache_holds_only_owned_partition(self):
+        cluster, clock, keys = self._fleet()
+        ring = ShardRing(2)
+        view = _mutable_view(ring, {0})
+        cached = CachedReadClient(cluster, NS, threaded=False,
+                                  relist_interval=None,
+                                  partition_view=view)
+        owned_nodes = {n.metadata.name for n in cluster.list_nodes()
+                       if view.owns(n.metadata.name,
+                                    n.metadata.labels.get(
+                                        GKE_NODEPOOL_LABEL, ""))}
+        cached_pods = cached.list_pods(namespace=NS)
+        assert cached_pods, "owned partition must not be empty"
+        assert {p.spec.node_name for p in cached_pods} <= owned_nodes
+        acct = cached.read_accounting()
+        assert acct["cachedPods"] == len(owned_nodes)
+        assert acct["ingestDropped"] > 0
+        cached.stop()
+
+    def test_watch_events_filtered_and_update_converts_to_delete(self):
+        cluster, clock, keys = self._fleet()
+        ring = ShardRing(2)
+        view = _mutable_view(ring, {0, 1})  # owns everything
+        cached = CachedReadClient(cluster, NS, threaded=False,
+                                  relist_interval=None,
+                                  partition_view=view)
+        total = len(cached.list_pods(namespace=NS))
+        assert total == 16
+        # shrink ownership: a MODIFIED event for a now-unowned pod must
+        # retire the stored copy instead of refreshing it
+        view.owned = frozenset({0})
+        some = next(p for p in cluster.list_pods(namespace=NS)
+                    if not view.owns(
+                        p.spec.node_name,
+                        cluster.get_node(p.spec.node_name).metadata
+                        .labels.get(GKE_NODEPOOL_LABEL, "")))
+        cluster.set_pod_status(some.metadata.namespace,
+                               some.metadata.name, ready=False)
+        cached.pump()
+        names = {p.metadata.name for p in cached.list_pods(namespace=NS)}
+        assert some.metadata.name not in names
+        cached.stop()
+
+    def test_pump_mode_applies_events_only_on_pump(self):
+        cluster, clock, keys = self._fleet()
+        cached = CachedReadClient(cluster, NS, threaded=False,
+                                  relist_interval=None)
+        node = cluster.list_nodes()[0]
+        cluster.patch_node_labels(node.metadata.name, {"x": "1"})
+        assert "x" not in cached.get_node(
+            node.metadata.name).metadata.labels
+        cached.pump()
+        assert cached.get_node(
+            node.metadata.name).metadata.labels.get("x") == "1"
+        cached.stop()
+
+    def test_pump_resubscribes_after_stream_drop(self):
+        cluster, clock, keys = self._fleet()
+        cached = CachedReadClient(cluster, NS, threaded=False,
+                                  relist_interval=None)
+        cluster.drop_watch_streams()
+        node = cluster.list_nodes()[0]
+        cluster.patch_node_labels(node.metadata.name, {"y": "2"})
+        # the dropped stream never delivered the event; pump must
+        # resubscribe AND relist so the cache repairs itself
+        cached.pump()
+        assert cached.get_node(
+            node.metadata.name).metadata.labels.get("y") == "2"
+        cached.stop()
+
+    def test_ownership_move_refresh_picks_up_new_partition(self):
+        cluster, clock, keys = self._fleet()
+        ring = ShardRing(2)
+        view = _mutable_view(ring, {0})
+        cached = CachedReadClient(cluster, NS, threaded=False,
+                                  relist_interval=None,
+                                  partition_view=view)
+        before = len(cached.list_pods(namespace=NS))
+        view.owned = frozenset({0, 1})
+        # events before the acquisition were dropped — only the
+        # targeted re-LIST repairs the cache
+        cached.refresh_partition()
+        assert len(cached.list_pods(namespace=NS)) == 16 > before
+        assert cached.read_accounting()["partitionRefreshes"] == 1
+        cached.stop()
+
+
+class TestPartitionParity:
+    """Tier-1 256-node parity: the delta-wired sharded build and the
+    uncached post-filter build must produce identical owned snapshots
+    across a forced shard handover (acquire mid-pass, cursor
+    invalidation exercised)."""
+
+    @pytest.mark.scale
+    def test_partition_build_matches_postfilter_across_handover(self):
+        fleet = FleetSpec(n_slices=64, hosts_per_slice=4,
+                          pod_recreate_delay=10.0, pod_ready_delay=30.0)
+        cluster, clock, keys = build_fleet(fleet)
+        ring = ShardRing(4)
+        # ONE shared mutable view: both managers see every handover
+        view = _mutable_view(ring, {0, 2})
+        reference = ClusterUpgradeStateManager(
+            cluster, keys, clock=clock, async_workers=False,
+            poll_interval=0.0).with_sharding(view)
+        cached = CachedReadClient(cluster, NS, threaded=False,
+                                  relist_interval=None,
+                                  partition_view=view)
+        partition = ClusterUpgradeStateManager(
+            cached, keys, clock=clock, async_workers=False,
+            poll_interval=0.0).with_sharding(view)
+        assert partition._partition_reads
+        assert not reference._partition_reads
+        # a third, unsharded driver advances the actual upgrade so the
+        # snapshots being compared keep changing underneath
+        driver = ClusterUpgradeStateManager(
+            cluster, keys, clock=clock, async_workers=False,
+            poll_interval=0.0)
+
+        def compare():
+            cached.pump()
+            assert _canonical(_build(partition)) \
+                == _canonical(_build(reference))
+
+        compare()
+        for step in range(6):
+            try:
+                driver.reconcile(NS, RUNTIME_LABELS, POLICY)
+            except BuildStateError:
+                pass
+            clock.advance(15.0)
+            cluster.step()
+            if step == 2:
+                # forced handover mid-run: acquire shard 1, release
+                # shard 2 — the partition manager must re-LIST and
+                # invalidate its delta cursor to stay bit-identical
+                view.owned = frozenset({0, 1})
+            compare()
+        assert cached.read_accounting()["partitionRefreshes"] >= 1
+
+    def test_census_matches_recount_after_transitions(self):
+        fleet = FleetSpec(n_slices=8, hosts_per_slice=4)
+        cluster, clock, keys = build_fleet(fleet)
+        ring = ShardRing(4)
+        view = _mutable_view(ring, {0, 1, 2, 3})
+        cached = CachedReadClient(cluster, NS, threaded=False,
+                                  relist_interval=None,
+                                  partition_view=view)
+        mgr = ClusterUpgradeStateManager(
+            cached, keys, clock=clock, async_workers=False,
+            poll_interval=0.0).with_sharding(view)
+        for _ in range(4):
+            cached.pump()
+            try:
+                mgr.reconcile(NS, RUNTIME_LABELS, POLICY)
+            except BuildStateError:
+                pass
+            clock.advance(15.0)
+            cluster.step()
+        cached.pump()
+        mgr.build_state(NS, RUNTIME_LABELS)
+        # recount from the cluster: label-only census, per shard
+        want: dict = {}
+        for node in cluster.list_nodes():
+            label = node.metadata.labels.get(keys.state_label, "")
+            if not label:
+                continue
+            shard = ring.shard_for(
+                node.metadata.name,
+                node.metadata.labels.get(GKE_NODEPOOL_LABEL, ""))
+            want.setdefault(shard, {})[label] = \
+                want.setdefault(shard, {}).get(label, 0) + 1
+        got = {shard: cell["byState"] for shard, cell
+               in mgr.last_shard_status["perShard"].items()
+               if cell["total"]}
+        assert got == want
+
+    def test_cluster_status_reports_reads_block(self):
+        fleet = FleetSpec(n_slices=2, hosts_per_slice=4)
+        cluster, clock, keys = build_fleet(fleet)
+        ring = ShardRing(2)
+        view = _mutable_view(ring, {0, 1})
+        cached = CachedReadClient(cluster, NS, threaded=False,
+                                  relist_interval=None,
+                                  partition_view=view)
+        mgr = ClusterUpgradeStateManager(
+            cached, keys, clock=clock, async_workers=False,
+            poll_interval=0.0).with_sharding(view)
+        state = mgr.build_state(NS, RUNTIME_LABELS)
+        status = mgr.cluster_status(state)
+        reads = status["shards"]["reads"]
+        assert reads["podFullLists"] >= 1
+        assert reads["snapshotBuildSeconds"] >= 0
+        assert "ingestKept" in reads
+        registry = MetricsRegistry()
+        observe_shards(registry, mgr)
+        rendered = registry.render_prometheus()
+        assert "shard_pod_full_lists_total" in rendered
+        assert "shard_snapshot_build_seconds" in rendered
+
+
+class TestNodeSelectorPushdown:
+    """Satellite: build_state LISTs nodes with the policy's node-pool
+    selector pushed down, with fake-cluster selector parity."""
+
+    def _fleet_with_strays(self):
+        cluster, clock, keys = build_fleet(
+            FleetSpec(n_slices=4, hosts_per_slice=4))
+        for i in range(5):
+            cluster.add_node(Node(metadata=ObjectMeta(
+                name=f"stray-{i}", labels={"role": "cpu-worker"})))
+        return cluster, clock, keys
+
+    def test_fake_cluster_selector_parity(self):
+        cluster, clock, keys = self._fleet_with_strays()
+        selector = "google.com/tpu=true"
+        listed = {n.metadata.name
+                  for n in cluster.list_nodes(selector)}
+        manual = {n.metadata.name for n in cluster.list_nodes()
+                  if n.metadata.labels.get("google.com/tpu") == "true"}
+        assert listed == manual and listed and "stray-0" not in listed
+
+    def test_build_state_scopes_nodes_to_selector(self):
+        cluster, clock, keys = self._fleet_with_strays()
+        mgr = ClusterUpgradeStateManager(
+            cluster, keys, clock=clock, async_workers=False,
+            poll_interval=0.0)
+        state = mgr.build_state(NS, RUNTIME_LABELS,
+                                node_selector="google.com/tpu=true")
+        names = {ns.node.metadata.name
+                 for bucket in state.node_states.values()
+                 for ns in bucket}
+        assert names and not any(n.startswith("stray-") for n in names)
+
+    def test_incremental_path_honors_selector_changes(self):
+        cluster, clock, keys = self._fleet_with_strays()
+        cached = CachedReadClient(cluster, NS, threaded=False,
+                                  relist_interval=None)
+        mgr = ClusterUpgradeStateManager(
+            cached, keys, clock=clock, async_workers=False,
+            poll_interval=0.0)
+        selector = "google.com/tpu=true"
+        mgr.build_state(NS, RUNTIME_LABELS, node_selector=selector)
+        # a managed node relabeled OUT of the pool leaves the snapshot
+        # on the next (incremental) build
+        victim = sorted(mgr._inputs_nodes)[0]
+        cluster.patch_node_labels(victim, {"google.com/tpu": None})
+        cached.pump()
+        mgr.build_state(NS, RUNTIME_LABELS, node_selector=selector)
+        assert victim not in mgr._inputs_nodes
+        cached.stop()
+
+    def test_policy_validates_node_selector(self):
+        from tpu_operator_libs.api.upgrade_policy import (
+            PolicyValidationError,
+        )
+        policy = UpgradePolicySpec(node_selector="google.com/tpu=true")
+        policy.validate()
+        assert UpgradePolicySpec.from_dict(
+            policy.to_dict()).node_selector == "google.com/tpu=true"
+        with pytest.raises(PolicyValidationError):
+            UpgradePolicySpec(node_selector="a==,!bad!").validate()
+
+
+class TestShardedCanaryAttestation:
+    """Partition-reads canary: cohort from node metadata, per-shard
+    attestation stamps, fleet stamp only after every cohort shard."""
+
+    def test_cohort_spanning_shards_requires_both_attestations(self):
+        fleet = FleetSpec(n_slices=8, hosts_per_slice=2,
+                          pod_recreate_delay=5.0, pod_ready_delay=10.0)
+        cluster, clock, keys = build_fleet(fleet)
+        ring = ShardRing(2)
+        views = [_mutable_view(ring, {0}, "r0"),
+                 _mutable_view(ring, {1}, "r1")]
+        policy = UpgradePolicySpec(
+            auto_upgrade=True, max_parallel_upgrades=0,
+            max_unavailable="100%", topology_mode="flat",
+            node_selector="google.com/tpu=true",
+            canary=CanaryRolloutSpec(enable=True, canary_count="50%",
+                                     bake_seconds=0),
+            drain=DrainSpec(enable=False))
+        mgrs = []
+        cacheds = []
+        for view in views:
+            cached = CachedReadClient(cluster, NS, threaded=False,
+                                      relist_interval=None,
+                                      partition_view=view)
+            cacheds.append(cached)
+            mgrs.append(ClusterUpgradeStateManager(
+                cached, keys, clock=clock, async_workers=False,
+                poll_interval=0.0).with_sharding(view))
+        done = str(UpgradeState.DONE)
+        for _ in range(60):
+            for cached in cacheds:
+                cached.pump()
+            for mgr in mgrs:
+                try:
+                    mgr.reconcile(NS, RUNTIME_LABELS, policy)
+                except BuildStateError:
+                    pass
+            if all(n.metadata.labels.get(keys.state_label, "") == done
+                   for n in cluster.list_nodes()):
+                break
+            clock.advance(10.0)
+            cluster.step()
+        nodes = cluster.list_nodes()
+        assert all(n.metadata.labels.get(keys.state_label, "") == done
+                   for n in nodes), "sharded canary fleet must converge"
+        ds = cluster.list_daemon_sets(NS)[0]
+        annotations = ds.metadata.annotations
+        prefix = keys.canary_shard_passed_prefix
+        # the cohort (50% of 16 = 8 lowest names, pools 0-3) spans both
+        # shards of this fleet, so BOTH owners must have attested
+        # durably, and the fleet-wide stamp exists
+        cohort = sorted(n.metadata.name for n in nodes)[:8]
+        cohort_shards = {
+            ring.shard_for(name, next(
+                n.metadata.labels.get(GKE_NODEPOOL_LABEL, "")
+                for n in nodes if n.metadata.name == name))
+            for name in cohort}
+        assert len(cohort_shards) == 2, "fixture must span shards"
+        for shard in cohort_shards:
+            assert f"{prefix}{shard}" in annotations
+        assert keys.canary_passed_annotation in annotations
+
+
+@pytest.mark.scale
+class TestShardScaleSmoke:
+    """Tier-1 1024-node sharded smoke: bit-identical to single-owner
+    with per-replica reads scaling with the partition (the fast cell of
+    `make bench-shard-100k`)."""
+
+    def test_1024_nodes_4_replicas(self):
+        from tools.latency_bench import run_shard_bench
+
+        report = run_shard_bench((1024,), replicas=4)
+        cell = report["1024_nodes"]
+        assert cell["single_owner"]["converged"]
+        assert cell["sharded"]["converged"]
+        assert cell["final_state_identical"]
+        reads = cell["reads_o_partition"]
+        assert reads["steady_full_fleet_pod_lists"] == 0
+        assert reads["scales_with_partition"], reads

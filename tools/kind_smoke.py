@@ -209,7 +209,9 @@ def main() -> int:
         sink=ClusterEventSink(client, NS))
     managers = []
     electors = []
+    cached_clients = []
     if args.replicas > 1:
+        from tpu_operator_libs.k8s.cached import CachedReadClient
         from tpu_operator_libs.k8s.sharding import (
             ShardElectionConfig,
             ShardElector,
@@ -228,8 +230,19 @@ def main() -> int:
                     lease_duration=8.0, renew_deadline=5.0,
                     retry_period=1.0))
             electors.append(elector)
+            # The delta-wired sharded read path against a REAL
+            # apiserver: each replica's pod cache is partition-filtered
+            # at watch ingest; the per-replica read bound below is the
+            # real-cluster half of the O(partition) proof.
+            cached = CachedReadClient(replica_client, NS,
+                                      relist_interval=None,
+                                      partition_view=elector)
+            if not cached.has_synced(timeout=60.0):
+                print("kind_smoke: FAIL — replica cache did not sync")
+                return 1
+            cached_clients.append(cached)
             managers.append(ClusterUpgradeStateManager(
-                replica_client, keys, recorder=recorder,
+                cached, keys, recorder=recorder,
                 async_workers=False,
                 poll_interval=0.5).with_sharding(elector))
     else:
@@ -284,9 +297,25 @@ def main() -> int:
                 converged = True
                 break
         time.sleep(2.0)
+    replica_reads = []
+    for i, cached in enumerate(cached_clients):
+        acct = cached.read_accounting()
+        acct["identity"] = f"kind-replica-{i}"
+        replica_reads.append(acct)
+        cached.stop()
     for elector in electors:
         elector.release_all()
     recorder.flush()
+    if replica_reads:
+        print("kind_smoke: per-replica read accounting:")
+        for acct in replica_reads:
+            print(f"  {acct['identity']}: reads={acct['apiReadsTotal']} "
+                  f"objects={acct['readObjectsTotal']} "
+                  f"podFullLists={acct['podFullLists']} "
+                  f"(1 sync + {acct['partitionRefreshes']} partition "
+                  f"refreshes) cachedPods={acct['cachedPods']} "
+                  f"kept={acct.get('ingestKept', 0)} "
+                  f"dropped={acct.get('ingestDropped', 0)}")
 
     # One snapshot serves the assertions AND the artifact — re-listing
     # for each would be redundant round-trips that can disagree.
@@ -339,6 +368,23 @@ def main() -> int:
     if not event_rows:
         failures.append(
             f"no {keys.event_reason} Events visible in {NS}")
+    # O(partition) read bound (sharded runs): every namespace-wide pod
+    # LIST a replica issued must be accounted for by its initial sync
+    # or a shard acquisition/handover refresh — a steady-state pass
+    # that re-LISTs the fleet is exactly the regression this guards.
+    for acct in replica_reads:
+        allowed = 1 + acct["partitionRefreshes"]
+        if acct["podFullLists"] > allowed:
+            failures.append(
+                f"{acct['identity']} issued {acct['podFullLists']} "
+                f"namespace-wide pod LISTs, > {allowed} allowed "
+                f"(1 sync + {acct['partitionRefreshes']} partition "
+                f"refreshes) — steady-state reads are not O(partition)")
+        if acct["cachedPods"] > len(pods):
+            failures.append(
+                f"{acct['identity']} caches {acct['cachedPods']} pods "
+                f"> {len(pods)} managed pods — partition filter "
+                f"not applied")
 
     if not args.keep:
         kubectl(ctx, "delete", "namespace", NS, "--ignore-not-found")

@@ -324,15 +324,22 @@ SHARD_TICK_INTERVAL = 30.0
 
 def run_shard_cell(n_nodes: int, replicas: int,
                    interval: float = SHARD_TICK_INTERVAL,
-                   max_sim_seconds: float = 12 * 3600.0) -> dict:
+                   max_sim_seconds: float = 12 * 3600.0,
+                   cached: bool = True) -> dict:
     """One full rolling upgrade, single-owner (``replicas <= 1``) or
     partitioned across ``replicas`` sharded replicas with real
     ShardElectors (per-shard Leases, ownership-filtered snapshots,
     fenced writes, durable budget shares) on the same FakeCluster
-    virtual clock. Returns makespan + write accounting + the final
+    virtual clock. With ``cached`` (the default) every replica reads
+    through its OWN partition-filtered ``CachedReadClient`` in the
+    deterministic pump mode — pod store/index/delta cursors hold only
+    the owned partition, fleet-level inputs derive from node labels,
+    and the cell reports per-replica read accounting (the O(partition)
+    evidence). Returns makespan + read/write accounting + the final
     cluster-state fingerprint — the sharded cell must be bit-identical
     to the single-owner cell (the ring changes WHO commits each
-    transition, never what converges)."""
+    transition and what each replica READS, never what converges)."""
+    from tpu_operator_libs.k8s.cached import CachedReadClient
     from tpu_operator_libs.k8s.sharding import (
         ShardElectionConfig,
         ShardElector,
@@ -351,9 +358,30 @@ def run_shard_cell(n_nodes: int, replicas: int,
         drain=DrainSpec(enable=False))
     electors: list = []
     managers: list = []
+    clients: list = []
+
+    class _OwnsAll:
+        """Single-owner stand-in view: the unfiltered cell still runs
+        the identical ingest path (and its kept-counter), so the
+        per-replica steady read load is comparable across cells."""
+        identity = "single-owner"
+
+        @staticmethod
+        def owns(node_name: str, pool: str = "") -> bool:
+            return True
+
+    def reader(view) -> object:
+        if not cached:
+            return cluster
+        client = CachedReadClient(cluster, NS, threaded=False,
+                                  relist_interval=None,
+                                  partition_view=view or _OwnsAll())
+        clients.append(client)
+        return client
+
     if replicas <= 1:
         managers.append(ClusterUpgradeStateManager(
-            cluster, keys, clock=clock, async_workers=False,
+            reader(None), keys, clock=clock, async_workers=False,
             poll_interval=0.0))
     else:
         for i in range(replicas):
@@ -369,7 +397,7 @@ def run_shard_cell(n_nodes: int, replicas: int,
                 clock=clock)
             electors.append(elector)
             managers.append(ClusterUpgradeStateManager(
-                cluster, keys, clock=clock, async_workers=False,
+                reader(elector), keys, clock=clock, async_workers=False,
                 poll_interval=0.0).with_sharding(elector))
     # settle the election before the upgrade starts (slot claims +
     # handover need a couple of rounds; a real deployment's replicas
@@ -380,10 +408,17 @@ def run_shard_cell(n_nodes: int, replicas: int,
     done = str(UpgradeState.DONE)
     reconciles = 0
     converged = False
+    #: Per-replica accounting snapshot taken after the FIRST reconcile
+    #: round (initial sync + partition refresh + first waves admitted):
+    #: everything after it is the steady state the O(partition) claim
+    #: is about — in particular steady full-fleet pod LISTs must be 0.
+    baseline: "list[Optional[dict]]" = [None] * len(managers)
     while clock.now() < max_sim_seconds:
         for elector in electors:
             elector.tick()
-        for mgr in managers:
+        for client in clients:
+            client.pump()
+        for i, mgr in enumerate(managers):
             if mgr.shard_view is not None \
                     and not mgr.shard_view.owned_shards():
                 continue
@@ -392,6 +427,8 @@ def run_shard_cell(n_nodes: int, replicas: int,
                 reconciles += 1
             except BuildStateError:
                 pass
+            if cached and baseline[i] is None:
+                baseline[i] = clients[i].read_accounting()
         if all(n.metadata.labels.get(keys.state_label, "") == done
                for n in cluster.list_nodes()):
             converged = True
@@ -407,6 +444,40 @@ def run_shard_cell(n_nodes: int, replicas: int,
         "node_writes": writes,
         "_fingerprint": _final_fingerprint(cluster, keys),
     }
+    if cached:
+        replicas_out = []
+        for i, mgr in enumerate(managers):
+            acct = clients[i].read_accounting()
+            base = baseline[i] or {k: 0 for k in acct}
+            steady = {
+                "apiReads": acct["apiReadsTotal"]
+                - base.get("apiReadsTotal", 0),
+                "readObjects": acct["readObjectsTotal"]
+                - base.get("readObjectsTotal", 0),
+                "podFullLists": acct["podFullLists"]
+                - base.get("podFullLists", 0),
+            }
+            if "ingestKept" in acct:
+                steady["ingestKept"] = (acct["ingestKept"]
+                                        - base.get("ingestKept", 0))
+            row = {
+                "identity": getattr(mgr.shard_view, "identity",
+                                    "single-owner")
+                if mgr.shard_view is not None else "single-owner",
+                "api_reads_total": acct["apiReadsTotal"],
+                "api_writes_total": acct["apiWritesTotal"],
+                "read_objects_total": acct["readObjectsTotal"],
+                "pod_full_lists": acct["podFullLists"],
+                "cached_pods": acct["cachedPods"],
+                "steady": steady,
+                "snapshot_build_s_total": round(
+                    mgr.snapshot_build_seconds_total, 3),
+            }
+            if "ingestKept" in acct:
+                row["ingest_kept"] = acct["ingestKept"]
+                row["ingest_dropped"] = acct["ingestDropped"]
+            replicas_out.append(row)
+        out["reads"] = replicas_out
     if electors:
         out["shards"] = replicas * 2
         out["shards_owned"] = {
@@ -426,19 +497,43 @@ def run_shard_bench(sizes: "tuple[int, ...]" = (16384,),
     """The sharded-control-plane scale proof: per fleet size, one
     single-owner upgrade vs the identical fleet partitioned across
     ``replicas`` sharded replicas — final cluster state must be
-    bit-identical, and the per-replica snapshot/write load divides by
-    the replica count (each owns ~1/replicas of the fleet)."""
+    bit-identical, and each replica's steady-state read load scales
+    with its PARTITION, not the fleet: per-replica steady read load
+    (watch objects kept + delegate read objects after the first
+    reconcile round) within ~1.3x of the single-owner load divided by
+    the replica count, and steady-state full-fleet pod LISTs at 0."""
     out: dict = {"replicas": replicas}
     for n_nodes in sizes:
         single = run_shard_cell(n_nodes, 1)
         sharded = run_shard_cell(n_nodes, replicas)
         identical = (single.pop("_fingerprint")
                      == sharded.pop("_fingerprint"))
-        out[f"{n_nodes}_nodes"] = {
+        cell = {
             "single_owner": single,
             "sharded": sharded,
             "final_state_identical": identical,
         }
+        if single.get("reads") and sharded.get("reads"):
+            def load(row: dict) -> int:
+                return (row["steady"]["readObjects"]
+                        + row["steady"].get("ingestKept", 0))
+
+            single_load = load(single["reads"][0])
+            per_replica = [load(row) for row in sharded["reads"]]
+            fair = single_load / replicas if replicas else 0
+            cell["reads_o_partition"] = {
+                "single_owner_steady_read_load": single_load,
+                "per_replica_steady_read_load": per_replica,
+                "fair_share": round(fair, 1),
+                "max_over_fair_share": (round(max(per_replica) / fair, 3)
+                                        if fair else None),
+                "scales_with_partition": bool(
+                    fair and max(per_replica) <= 1.3 * fair),
+                "steady_full_fleet_pod_lists": max(
+                    row["steady"]["podFullLists"]
+                    for row in sharded["reads"]),
+            }
+        out[f"{n_nodes}_nodes"] = cell
     return out
 
 
@@ -477,8 +572,13 @@ def main(argv: "list[str]") -> int:
     interval = RESYNC_INTERVAL
     shard_sizes: "Optional[tuple[int, ...]]" = None
     shard_replicas = 4
+    out_path: "Optional[str]" = None
     for i, arg in enumerate(argv):
-        if arg == "--nodes" and i + 1 < len(argv):
+        if arg == "--out" and i + 1 < len(argv):
+            out_path = argv[i + 1]
+        elif arg.startswith("--out="):
+            out_path = arg.split("=", 1)[1]
+        elif arg == "--nodes" and i + 1 < len(argv):
             sizes = tuple(int(s) for s in argv[i + 1].split(","))
         elif arg.startswith("--nodes="):
             sizes = tuple(int(s) for s in arg.split("=", 1)[1].split(","))
@@ -498,11 +598,15 @@ def main(argv: "list[str]") -> int:
             shard_replicas = int(arg.split("=", 1)[1])
     if shard_sizes is not None:
         # sharded-control-plane scale proof only (16k default:
-        # `--shard-nodes 16384 --shard-replicas 4`)
-        print(json.dumps(run_shard_bench(shard_sizes, shard_replicas),
-                         indent=2))
-        return 0
-    print(json.dumps(run_latency_bench(sizes, interval), indent=2))
+        # `make bench-shard`; 100k: `make bench-shard-100k`)
+        report = run_shard_bench(shard_sizes, shard_replicas)
+    else:
+        report = run_latency_bench(sizes, interval)
+    rendered = json.dumps(report, indent=2)
+    print(rendered)
+    if out_path:
+        with open(out_path, "w") as fh:
+            fh.write(rendered + "\n")
     return 0
 
 

@@ -448,6 +448,14 @@ def upgrade_policy_schema() -> dict[str, Any]:
                                "most this many member slices may be "
                                "unavailable concurrently.",
             },
+            "nodeSelector": {
+                "type": "string",
+                "default": "",
+                "description": "Label selector scoping the managed node "
+                               "pool; pushed down into the operator's "
+                               "node LIST/watch so unmanaged pools cost "
+                               "nothing. Empty selects every node.",
+            },
         },
     }
 

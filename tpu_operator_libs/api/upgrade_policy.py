@@ -342,6 +342,14 @@ class UpgradePolicySpec:
     # reference's per-node budget (upgrade_state.go:606-616) to DCN job
     # membership. See tpu_operator_libs.topology.multislice.
     max_unavailable_slices_per_job: int = 1
+    # Beyond-reference: label selector scoping the managed node pool.
+    # Pushed down into build_state's node LIST (and the incremental node
+    # cursor) so a fleet sharing its cluster with unmanaged node pools
+    # never pays — or acts on — their node metadata; also the
+    # fleet-wide "managed node" definition the sharded canary cohort is
+    # derived from under partition reads. "" = all nodes (reference
+    # semantics).
+    node_selector: str = ""
     # Beyond-reference: canary-gated rollout (probe a new revision on a
     # small cohort, halt the fleet when it fails). None = disabled.
     canary: Optional[CanaryRolloutSpec] = None
@@ -367,6 +375,15 @@ class UpgradePolicySpec:
         if self.max_unavailable_slices_per_job < 1:
             raise PolicyValidationError(
                 "maxUnavailableSlicesPerJob must be >= 1")
+        if self.node_selector:
+            from tpu_operator_libs.k8s.selectors import (
+                parse_label_selector,
+            )
+            try:
+                parse_label_selector(self.node_selector)
+            except ValueError as exc:
+                raise PolicyValidationError(
+                    f"nodeSelector is not a valid label selector: {exc}")
         for sub in (self.pod_deletion, self.wait_for_completion, self.drain,
                     self.canary, self.rollback, self.sharding):
             if sub is not None:
@@ -380,6 +397,8 @@ class UpgradePolicySpec:
             "topologyMode": self.topology_mode,
             "maxUnavailableSlicesPerJob": self.max_unavailable_slices_per_job,
         }
+        if self.node_selector:
+            out["nodeSelector"] = self.node_selector
         if self.pod_deletion is not None:
             out["podDeletion"] = self.pod_deletion.to_dict()
         if self.wait_for_completion is not None:
@@ -403,6 +422,7 @@ class UpgradePolicySpec:
             topology_mode=data.get("topologyMode", "flat"),
             max_unavailable_slices_per_job=data.get(
                 "maxUnavailableSlicesPerJob", 1),
+            node_selector=data.get("nodeSelector", ""),
         )
         if "podDeletion" in data and data["podDeletion"] is not None:
             spec.pod_deletion = PodDeletionSpec.from_dict(data["podDeletion"])

@@ -1113,16 +1113,24 @@ class _ShardAuditClient:
 
 class _ShardedReplica:
     """One replica-lifetime of the sharded control plane: fresh
-    managers, fresh ShardElector, fresh identity. Everything that
-    survives a kill lives on the cluster — the shard/slot Leases, the
-    node labels, the budget-share annotations — which is exactly the
-    durability claim the replica-kill gate proves."""
+    managers, fresh ShardElector, fresh partition-filtered read cache,
+    fresh identity. Everything that survives a kill lives on the
+    cluster — the shard/slot Leases, the node labels, the budget-share
+    annotations — which is exactly the durability claim the
+    replica-kill gate proves. Reads go through the DELTA-WIRED sharded
+    path (a ``CachedReadClient`` in deterministic pump mode with the
+    elector pushed down as the pod-cache partition filter), so the
+    soak gates takeover re-sync correctness: a successor's targeted
+    re-LIST + cursor invalidation must reconstruct the dead replica's
+    partition from cluster state alone, under the same fault schedule
+    that killed it."""
 
     def __init__(self, cluster: FakeCluster, clock: FakeClock,
                  keys: UpgradeKeys, rem_keys: RemediationKeys,
                  config: ReplicaKillConfig, injector: ChaosInjector,
                  monitor: InvariantMonitor, identity: str,
                  pools: "dict[str, str]") -> None:
+        from tpu_operator_libs.k8s.cached import CachedReadClient
         from tpu_operator_libs.k8s.sharding import (
             ShardElectionConfig,
             ShardElector,
@@ -1144,21 +1152,46 @@ class _ShardedReplica:
         audit = _ShardAuditClient(
             cluster, identity, monitor, self.elector.ring, pools,
             config.lease_namespace, config.shard_lease_prefix)
+        # The replica's cache sync races the schedule's injected API
+        # errors (a real replacement pod's informer start does too):
+        # bounded retries, each consuming one injected failure, then
+        # let the last error surface to the harness.
+        self.cached: "Optional[CachedReadClient]" = None
+        for attempt in range(8):
+            try:
+                self.cached = CachedReadClient(
+                    audit, NS, threaded=False, relist_interval=None)
+                break
+            except Exception:  # noqa: BLE001 — injected API error
+                if attempt == 7:
+                    raise
         provider = CrashingStateProvider(
-            audit, keys, None, clock, sync_timeout=5.0,
+            self.cached, keys, None, clock, sync_timeout=5.0,
             poll_interval=1.0, fuse=injector.fuse)
         self.upgrade = ClusterUpgradeStateManager(
-            audit, keys, clock=clock, async_workers=False,
+            self.cached, keys, clock=clock, async_workers=False,
             provider=provider, poll_interval=1.0, sync_timeout=5.0,
             parallel_workers=config.parallel_workers,
             nudger=self.nudger).with_sharding(self.elector)
         rem_provider = CrashingStateProvider(
-            audit, rem_keys, None, clock,  # type: ignore[arg-type]
+            self.cached, rem_keys, None, clock,  # type: ignore[arg-type]
             sync_timeout=5.0, poll_interval=1.0, fuse=injector.fuse)
         self.remediation = NodeRemediationManager(
-            audit, rem_keys, upgrade_keys=keys, clock=clock,
+            self.cached, rem_keys, upgrade_keys=keys, clock=clock,
             provider=rem_provider, poll_interval=1.0, sync_timeout=5.0,
             nudger=self.nudger).with_sharding(self.elector)
+
+    def pump(self) -> None:
+        """Apply queued watch events before this tick's reconciles."""
+        if self.cached is not None:
+            self.cached.pump()
+
+    def stop(self) -> None:
+        """Tear down the read cache's watch subscriptions. A killed
+        incarnation must stop consuming the broadcaster — its queues
+        would otherwise grow for the rest of the episode."""
+        if self.cached is not None:
+            self.cached.stop()
 
 
 def run_replica_kill_soak(seed: int,
@@ -1302,13 +1335,20 @@ def run_replica_kill_soak(seed: int,
                     f"[t={now:g}] replica {victim.identity} KILLED "
                     f"(slot {slot}; leases NOT released; replacement "
                     f"at t={event.until:g})")
+                victim.stop()
                 replicas[slot] = None
             if event.until > now:
                 pending_restarts.append((event.until, slot))
         due_restarts = [p for p in pending_restarts if p[0] <= now]
         pending_restarts = [p for p in pending_restarts if p[0] > now]
         for _, slot in due_restarts:
-            replicas[slot] = replace(slot, "replacement pod arrived")
+            try:
+                replicas[slot] = replace(slot, "replacement pod arrived")
+            except (ApiServerError, ConflictError, NotFoundError,
+                    TimeoutError):
+                # the replacement's cache sync lost to the error
+                # schedule; the pod "crash-loops" and retries next tick
+                pending_restarts.append((now, slot))
         for slot, replica in enumerate(replicas):
             if replica is None:
                 continue
@@ -1324,6 +1364,10 @@ def run_replica_kill_soak(seed: int,
             replica.nudger.pop_due(now)
             replica.nudger.consume_pending()
             try:
+                # delta-wired read path: apply the watch backlog (and
+                # any rewatch/relist repair after a stream drop) before
+                # this tick's snapshots
+                replica.pump()
                 replica.remediation.reconcile(NS, dict(RUNTIME_LABELS),
                                               remediation_policy)
                 replica.upgrade.reconcile(NS, dict(RUNTIME_LABELS),
@@ -1332,8 +1376,14 @@ def run_replica_kill_soak(seed: int,
             except OperatorCrash:
                 for shard in sorted(replica.elector.owned_shards()):
                     monitor.note_shard_orphaned(shard, now)
-                replicas[slot] = replace(
-                    slot, "operator crash mid-reconcile")
+                replica.stop()
+                try:
+                    replicas[slot] = replace(
+                        slot, "operator crash mid-reconcile")
+                except (ApiServerError, ConflictError, NotFoundError,
+                        TimeoutError):
+                    replicas[slot] = None
+                    pending_restarts.append((now, slot))
             except ShardFencedError as exc:
                 # deposed mid-pass: the fence rejected the write and
                 # the pass aborted — the replica re-derives its
@@ -1348,8 +1398,14 @@ def run_replica_kill_soak(seed: int,
             if injector.fuse.pending:
                 for shard in sorted(replica.elector.owned_shards()):
                     monitor.note_shard_orphaned(shard, now)
-                replicas[slot] = replace(
-                    slot, "operator crash (surfaced late)")
+                replica.stop()
+                try:
+                    replicas[slot] = replace(
+                        slot, "operator crash (surfaced late)")
+                except (ApiServerError, ConflictError, NotFoundError,
+                        TimeoutError):
+                    replicas[slot] = None
+                    pending_restarts.append((now, slot))
         # takeover detection: an orphaned shard is resumed once its
         # Lease is held by a LIVE replica again
         live_idents = {r.identity for r in replicas if r is not None}
@@ -1389,6 +1445,9 @@ def run_replica_kill_soak(seed: int,
         cluster.step()
         monitor.drain()
 
+    for replica in replicas:
+        if replica is not None:
+            replica.stop()
     if is_converged:
         monitor.final_check()
     else:

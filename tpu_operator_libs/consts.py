@@ -459,6 +459,21 @@ class UpgradeKeys:
         return f"{self.domain}/{self.driver}-upgrade.canary-passed"
 
     @property
+    def canary_shard_passed_prefix(self) -> str:
+        """DAEMONSET annotation key PREFIX (``<prefix><shard-id>``):
+        per-shard canary attestation under the sharded control plane's
+        partition-scoped reads. A replica that only holds its own
+        partition's pods cannot verify cohort members on other shards,
+        so each shard's OWNER stamps ``<revision-hash>`` here once every
+        cohort member in that shard is upgrade-done on the revision
+        (pod hash verified against its own partition). Distinct keys
+        per shard — concurrent owners' merge patches compose (the
+        budget-share ledger idiom) — and the fleet-wide
+        ``canary_passed_annotation`` is only written once every
+        cohort-bearing shard's attestation matches the revision."""
+        return f"{self.domain}/{self.driver}-upgrade.canary-shard-passed."
+
+    @property
     def event_reason(self) -> str:
         """Reason string attached to Kubernetes events (util.go:136-139)."""
         return f"{self.driver.upper()}RuntimeUpgrade"

@@ -236,11 +236,35 @@ class Informer:
 
     def __init__(self, lister: Callable[[], list], watch: Watch,
                  key_fn: Callable[[object], tuple[str, str]] = default_key_fn,
-                 name: str = "informer") -> None:
+                 name: str = "informer",
+                 threaded: bool = True,
+                 ingest_filter: Optional[Callable[[object], bool]] = None,
+                 rewatch: Optional[Callable[[], Watch]] = None) -> None:
         self._lister = lister
         self._watch = watch
         self._key_fn = key_fn
         self._name = name
+        # Unthreaded drive mode: start() performs the initial list
+        # inline and events apply only on pump() — the deterministic
+        # single-threaded discipline the virtual-clock benches and the
+        # chaos harness need (a background pump racing a FakeClock
+        # would make snapshot content depend on thread scheduling).
+        self._threaded = threaded
+        # Ingest filter (partition pushdown seam): objects rejected by
+        # the predicate never enter the store — a listed/added object is
+        # skipped, a MODIFIED of a stored key that stopped matching is
+        # converted to a delete. The predicate may change its answers
+        # over time (shard ownership moves); callers must refresh()
+        # after such a change, because dropped events are gone.
+        self._ingest_filter = ingest_filter
+        # Re-subscribe seam for pump mode: a server-side stream drop
+        # stops the Watch; with a factory the next pump() opens a fresh
+        # stream and relists (the informer reconnect path).
+        self._rewatch = rewatch
+        # set when a pump-mode refresh failed (e.g. transient apiserver
+        # error on an overflow BOOKMARK): retried on the next pump so
+        # the consumed marker cannot strand the cache stale.
+        self._needs_refresh = False
         self._store: dict[tuple[str, str], object] = {}
         # Monotonic time of the last watch-event apply per key; deleted
         # keys keep their entry as a tombstone. refresh() consults these
@@ -264,11 +288,78 @@ class Informer:
         self._handlers.append((on_add, on_update, on_delete))
 
     def start(self) -> None:
+        if not self._threaded:
+            if not self._synced.is_set():
+                self._initial_list()
+            return
         if self._thread is not None:
             return
         self._thread = threading.Thread(
             target=self._run, name=self._name, daemon=True)
         self._thread.start()
+
+    def _initial_list(self) -> None:
+        """Inline initial sync for unthreaded informers. Unlike the
+        threaded path there is no retry loop: the caller owns pacing,
+        and a deterministic harness wants the error, not a sleep."""
+        for obj in self._lister():
+            try:
+                key = self._key_fn(obj)
+            except Exception:
+                logger.exception("%s: key function failed on listed "
+                                 "object", self._name)
+                continue
+            if self._ingest_filter is not None \
+                    and not self._ingest_filter(obj):
+                continue
+            with self._store_lock:
+                self._store[key] = obj
+            self._dispatch_add(obj)
+        self._synced.set()
+
+    def pump(self, max_events: Optional[int] = None) -> int:
+        """Apply every queued watch event inline (unthreaded mode).
+
+        Returns the number of events applied. A stopped watch is
+        re-subscribed through the ``rewatch`` factory (plus a relist:
+        the gap's deletes never replay); an overflow BOOKMARK triggers
+        the same relist repair the threaded loop performs. A failed
+        relist is remembered and retried on the next pump.
+        """
+        if self._threaded:
+            raise RuntimeError(f"{self._name}: pump() is for "
+                               f"unthreaded informers")
+        applied = 0
+        if self._watch.stopped and self._rewatch is not None:
+            self._watch = self._rewatch()
+            self._needs_refresh = True
+        if self._needs_refresh:
+            self._needs_refresh = False
+            try:
+                self.refresh()
+            except Exception:
+                self._needs_refresh = True
+                raise
+        while max_events is None or applied < max_events:
+            event = self._watch.get(timeout=0.0)
+            if event is None:
+                break
+            applied += 1
+            if event.type == BOOKMARK:
+                logger.warning("%s: watch overflow bookmark; relisting",
+                               self._name)
+                try:
+                    self.refresh()
+                except Exception:
+                    self._needs_refresh = True
+                    raise
+                continue
+            try:
+                self._apply(event)
+            except Exception:
+                logger.exception("%s: failed to apply watch event",
+                                 self._name)
+        return applied
 
     def _run(self) -> None:
         # The initial list retries with backoff like a client-go informer:
@@ -295,6 +386,9 @@ class Informer:
                 logger.exception("%s: key function failed on listed object",
                                  self._name)
                 continue
+            if self._ingest_filter is not None \
+                    and not self._ingest_filter(obj):
+                continue
             with self._store_lock:
                 self._store[key] = obj
             self._dispatch_add(obj)
@@ -318,6 +412,15 @@ class Informer:
     def _apply(self, event: WatchEvent) -> None:
         obj = event.object
         key = self._key_fn(obj)
+        if event.type != DELETED and self._ingest_filter is not None \
+                and not self._ingest_filter(obj):
+            # the object does not (or no longer) belong in this cache:
+            # drop it, and if an older version was stored, retire it
+            # the same way a DELETED would
+            with self._store_lock:
+                if key not in self._store:
+                    return
+            event = WatchEvent(DELETED, event.kind, obj)
         if event.type == DELETED:
             with self._store_lock:
                 old = self._store.pop(key, None)
@@ -389,6 +492,14 @@ class Informer:
         objects = self._lister()
         fresh: dict[tuple[str, str], object] = {}
         for obj in objects:
+            if self._ingest_filter is not None \
+                    and not self._ingest_filter(obj):
+                # partition pushdown: an object outside the filter is
+                # absent from the "server" view, so a stored copy is
+                # pruned by the deletion sweep below — this is what
+                # makes refresh() the repair step after an ownership
+                # handover (newly-unowned objects retire here)
+                continue
             try:
                 fresh[self._key_fn(obj)] = obj
             except Exception:
@@ -452,6 +563,14 @@ class Informer:
         mutation's own watch event lands later as an equal-value update.
         """
         key = self._key_fn(obj)
+        if self._ingest_filter is not None \
+                and not self._ingest_filter(obj):
+            # a write result outside the partition filter must not
+            # smuggle the object into the cache; retire a stored copy
+            meta = getattr(obj, "metadata", None)
+            if meta is not None:
+                self.apply_external_delete(meta.namespace, meta.name)
+            return
         with self._store_lock:
             old = self._store.get(key)
             self._store[key] = obj
@@ -475,6 +594,13 @@ class Informer:
             for _, _, on_delete in self._handlers:
                 if on_delete is not None:
                     self._safe(on_delete, old)
+
+    def set_ingest_filter(
+            self, pred: Optional[Callable[[object], bool]]) -> None:
+        """Install (or clear) the ingest filter. The store is NOT
+        rewritten here — call :meth:`refresh` afterwards to admit
+        newly-matching objects and retire newly-rejected ones."""
+        self._ingest_filter = pred
 
     def get(self, namespace: str, name: str) -> Optional[object]:
         with self._store_lock:

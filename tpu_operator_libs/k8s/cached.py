@@ -70,6 +70,58 @@ class CacheNotSyncedError(RuntimeError):
     """A read was attempted before the initial list completed."""
 
 
+class ShardPartitionFilter:
+    """Shard-ownership ingest predicate for the pod cache.
+
+    Applied at watch-event ingest (and to list results) by the pod
+    informer, so a sharded replica's pod store, node→pods index, delta
+    cursors and incremental rebuilds only ever hold the slices its
+    shard view owns — the client-side stand-in for the per-partition
+    LIST/watch pushdown a real deployment would express as a selector.
+    The predicate consults the live shard view, so ownership changes
+    take effect immediately for new events; objects dropped BEFORE an
+    acquisition are repaired by the targeted re-LIST
+    (:meth:`CachedReadClient.refresh_partition`).
+
+    Fail-open by design: a pod with no node binding, or whose node the
+    node cache has not seen yet (so its pool — the slice-whole hash key
+    — is unknown), is KEPT. Dropping only provably-unowned pods means a
+    racing node sync can cost memory, never a hole in the owned
+    partition; the state manager applies the exact ownership check
+    again at snapshot assembly.
+    """
+
+    def __init__(self, view: object,
+                 node_lookup: Callable[[str], object],
+                 pool_label: Optional[str] = None) -> None:
+        from tpu_operator_libs.consts import GKE_NODEPOOL_LABEL
+
+        #: ShardElector / StaticShardView: anything with owns(name, pool).
+        self.view = view
+        self._node_lookup = node_lookup
+        self._pool_label = pool_label or GKE_NODEPOOL_LABEL
+        #: Ingest accounting (the partition-scaling evidence): events /
+        #: listed objects kept into the cache vs dropped at the door.
+        self.kept_total = 0
+        self.dropped_total = 0
+
+    def __call__(self, obj: object) -> bool:
+        node_name = getattr(getattr(obj, "spec", None), "node_name", "")
+        if not node_name:
+            self.kept_total += 1
+            return True
+        node = self._node_lookup(node_name)
+        if node is None:
+            self.kept_total += 1
+            return True
+        pool = node.metadata.labels.get(self._pool_label, "")
+        if self.view.owns(node_name, pool):
+            self.kept_total += 1
+            return True
+        self.dropped_total += 1
+        return False
+
+
 class NodePodIndex:
     """node name → pods, maintained from the pod informer's watch deltas.
 
@@ -212,7 +264,9 @@ class CachedReadClient(K8sClient):
 
     def __init__(self, delegate: K8sClient, namespace: str,
                  require_sync: bool = True,
-                 relist_interval: Optional[float] = 300.0) -> None:
+                 relist_interval: Optional[float] = 300.0,
+                 threaded: bool = True,
+                 partition_view: Optional[object] = None) -> None:
         # Deferred: controller.py imports k8s.watch, whose package
         # __init__ re-exports this module — a top-level import of
         # controller here would be circular for any consumer that
@@ -222,18 +276,61 @@ class CachedReadClient(K8sClient):
         self._delegate = delegate
         self._namespace = namespace
         self._require_sync = require_sync
+        self._threaded = threaded
+        self._counters_lock = threading.Lock()
+        #: API calls this client actually forwarded to the delegate
+        #: (cache misses + writes + informer lists); cache hits cost
+        #: zero. Exported by metrics.observe_reconcile/observe_shards.
+        self.api_reads_total = 0
+        self.api_writes_total = 0
+        #: Objects the delegate returned across every forwarded read
+        #: (len of each LIST + 1 per GET): the wire-volume half of the
+        #: O(partition) claim — a call count alone hides that one LIST
+        #: can carry the whole fleet.
+        self.read_objects_total = 0
+        #: Forwarded LIST calls by cache kind, and specifically the
+        #: namespace-wide pod LISTs (initial sync, relist repairs,
+        #: partition refreshes): the bench pins these at ZERO in steady
+        #: state — every steady-state read rides the watch stream.
+        self.list_calls: dict[str, int] = {}
+        self.pod_full_lists_total = 0
+        #: Targeted pod-cache relists performed for shard
+        #: acquisitions/handovers: the only legitimate source of a
+        #: post-sync namespace-wide pod LIST — kind_smoke's per-replica
+        #: read bound is ``podFullLists <= 1 (sync) + refreshes``.
+        self.partition_refreshes_total = 0
+        # Partition pushdown (sharded replicas): pods outside the
+        # view's owned shards are dropped at ingest, so the pod store /
+        # index / delta cursors are O(partition), not O(fleet). The
+        # node cache stays fleet-wide — node metadata is the one
+        # deliberate O(fleet) object (the cheap fleet summary feed).
+        self._partition_filter: Optional[ShardPartitionFilter] = None
+        if partition_view is not None:
+            self._partition_filter = ShardPartitionFilter(
+                partition_view,
+                lambda name: self._nodes.get("", name))
         self._nodes = Informer(
-            delegate.list_nodes,
+            self._counted_lister("nodes", delegate.list_nodes),
             delegate.watch(kinds={KIND_NODE}),
-            name="node-cache")
+            name="node-cache", threaded=threaded,
+            rewatch=lambda: delegate.watch(kinds={KIND_NODE}))
         self._pods = Informer(
-            lambda: delegate.list_pods(namespace=namespace),
+            self._counted_lister(
+                "pods",
+                lambda: delegate.list_pods(namespace=namespace)),
             delegate.watch(kinds={KIND_POD}, namespace=namespace),
-            name="pod-cache")
+            name="pod-cache", threaded=threaded,
+            ingest_filter=self._partition_filter,
+            rewatch=lambda: delegate.watch(kinds={KIND_POD},
+                                           namespace=namespace))
         self._daemon_sets = Informer(
-            lambda: delegate.list_daemon_sets(namespace),
+            self._counted_lister(
+                "daemon_sets",
+                lambda: delegate.list_daemon_sets(namespace)),
             delegate.watch(kinds={KIND_DAEMON_SET}, namespace=namespace),
-            name="ds-cache")
+            name="ds-cache", threaded=threaded,
+            rewatch=lambda: delegate.watch(kinds={KIND_DAEMON_SET},
+                                           namespace=namespace))
         self._informers = (self._nodes, self._pods, self._daemon_sets)
         # node→pods index + delta fan-out ride the informer handler
         # chain, BEFORE start(): initial-sync adds must flow through
@@ -245,12 +342,6 @@ class CachedReadClient(K8sClient):
                                      on_delete=self._pod_index.on_delete)
         self._views: list[ClusterDeltaView] = []
         self._views_lock = threading.Lock()
-        self._counters_lock = threading.Lock()
-        #: API calls this client actually forwarded to the delegate
-        #: (cache misses + writes); cache hits cost zero. Exported by
-        #: metrics.observe_reconcile.
-        self.api_reads_total = 0
-        self.api_writes_total = 0
         # ControllerRevision lists, cached keyed on the DS cache's
         # change generation: a new revision only ever appears alongside
         # a DaemonSet template update (a MODIFIED event), so any DS
@@ -284,7 +375,7 @@ class CachedReadClient(K8sClient):
         # informer TTL-prunes them on delete, controller._TOMBSTONE_TTL).
         self._stop_relist = threading.Event()
         self._relist_thread: Optional[threading.Thread] = None
-        if relist_interval is not None and relist_interval > 0:
+        if threaded and relist_interval is not None and relist_interval > 0:
             self._relist_thread = threading.Thread(
                 target=self._relist_loop, args=(relist_interval,),
                 name="cache-relist", daemon=True)
@@ -329,13 +420,98 @@ class CachedReadClient(K8sClient):
         """The watch-delta-maintained node→pods index."""
         return self._pod_index
 
-    def _count_read(self) -> None:
+    def _count_read(self, objects: int = 1) -> None:
         with self._counters_lock:
             self.api_reads_total += 1
+            self.read_objects_total += objects
 
     def _count_write(self) -> None:
         with self._counters_lock:
             self.api_writes_total += 1
+
+    def _counted_lister(self, kind: str,
+                        fn: Callable[[], list]) -> Callable[[], list]:
+        """Wrap an informer lister so the initial sync and every relist
+        repair are billed like any other delegate read — the bench's
+        per-replica accounting must see the O(fleet) LISTs a takeover
+        costs, not just steady-state cache misses."""
+        def lister() -> list:
+            objects = fn()
+            with self._counters_lock:
+                self.api_reads_total += 1
+                self.read_objects_total += len(objects)
+                self.list_calls[kind] = self.list_calls.get(kind, 0) + 1
+                if kind == "pods":
+                    self.pod_full_lists_total += 1
+            return objects
+        return lister
+
+    # -- partition pushdown (sharded replicas) ----------------------------
+    def set_partition_filter(self, view: Optional[object]) -> None:
+        """Install (or clear, with ``None``) the shard-partition filter
+        on the pod cache. Prefer the ``partition_view`` constructor
+        argument — installing before the initial list keeps the first
+        sync O(partition) too; installing later re-LISTs the pod cache
+        once to rewrite it under the new predicate."""
+        if view is None:
+            self._partition_filter = None
+            self._pods.set_ingest_filter(None)
+        else:
+            self._partition_filter = ShardPartitionFilter(
+                view, lambda name: self._nodes.get("", name))
+            self._pods.set_ingest_filter(self._partition_filter)
+        if self._pods.has_synced(timeout=0):
+            self.refresh_partition()
+
+    @property
+    def partition_filter(self) -> Optional[ShardPartitionFilter]:
+        return self._partition_filter
+
+    def refresh_partition(self) -> None:
+        """Targeted re-LIST after a shard acquisition/handover: only the
+        POD cache is rebuilt (nodes and DaemonSets are fleet-scoped and
+        never partition-filtered). Watch events for newly-acquired
+        shards that arrived before the acquisition were dropped at
+        ingest — gone, not replayable — so the relist is what makes a
+        takeover's first snapshot bit-identical to the deposed owner's.
+        The caller should also invalidate its delta cursor
+        (``ClusterDeltaView.mark_full``); the relist emits add/delete
+        handler events for changed keys only, and a consumer patching a
+        partial previous snapshot must not trust its unchanged entries
+        across an ownership move."""
+        with self._counters_lock:
+            self.partition_refreshes_total += 1
+        self._pods.refresh()
+
+    def pump(self) -> int:
+        """Apply all queued watch events inline (unthreaded clients
+        only) and return how many were applied. Node events first: the
+        pod partition filter resolves pool labels through the node
+        cache, so a pod event must never be judged against a node
+        update still sitting in the queue behind it."""
+        total = 0
+        for informer in self._informers:
+            total += informer.pump()
+        return total
+
+    def read_accounting(self) -> dict:
+        """Snapshot of the per-replica read/write accounting the shard
+        bench and ``cluster_status`` report."""
+        with self._counters_lock:
+            out = {
+                "apiReadsTotal": self.api_reads_total,
+                "apiWritesTotal": self.api_writes_total,
+                "readObjectsTotal": self.read_objects_total,
+                "podFullLists": self.pod_full_lists_total,
+                "partitionRefreshes": self.partition_refreshes_total,
+                "listCalls": dict(self.list_calls),
+                "cachedPods": len(self._pods),
+                "cachedNodes": len(self._nodes),
+            }
+        if self._partition_filter is not None:
+            out["ingestKept"] = self._partition_filter.kept_total
+            out["ingestDropped"] = self._partition_filter.dropped_total
+        return out
 
     # -- lifecycle --------------------------------------------------------
     def has_synced(self, timeout: Optional[float] = None) -> bool:
@@ -411,9 +587,10 @@ class CachedReadClient(K8sClient):
             # the drain/eviction/validation paths rely on that to see
             # workload pods outside the operator namespace — the
             # single-namespace cache cannot answer those queries.
-            self._count_read()
-            return self._delegate.list_pods(namespace, label_selector,
+            pods = self._delegate.list_pods(namespace, label_selector,
                                             field_selector)
+            self._count_read(len(pods))
+            return pods
         label_match = parse_label_selector(label_selector)
         node = exact_field_requirement(field_selector, "spec.nodeName")
         if node:
@@ -443,8 +620,9 @@ class CachedReadClient(K8sClient):
                          label_selector: str = "") -> list[DaemonSet]:
         self._barrier()
         if namespace != self._namespace:
-            self._count_read()
-            return self._delegate.list_daemon_sets(namespace, label_selector)
+            out = self._delegate.list_daemon_sets(namespace, label_selector)
+            self._count_read(len(out))
+            return out
         match = parse_label_selector(label_selector)
         return [d.clone() for d in self._daemon_sets.list()
                 if match(d.metadata.labels)]
@@ -464,9 +642,9 @@ class CachedReadClient(K8sClient):
             cached = self._revisions_cache.get((namespace, label_selector))
             if cached is not None and cached[0] == gen:
                 return [r.clone() for r in cached[1]]
-        self._count_read()
         revisions = self._delegate.list_controller_revisions(
             namespace, label_selector)
+        self._count_read(len(revisions))
         with self._views_lock:
             if self._revisions_gen == gen:
                 self._revisions_cache[(namespace, label_selector)] = (

@@ -89,11 +89,23 @@ class ShardRing:
         if num_shards < 1:
             raise ValueError("num_shards must be >= 1")
         self.num_shards = num_shards
+        # Hash memo: shard_for sits on the watch-ingest hot path of the
+        # partition-filtered read client (one lookup per pod event) and
+        # in the per-pass census maintenance — at 100k nodes the sha256
+        # per call dominates. Keys are hash keys (pool or node name),
+        # whose population is bounded by the fleet size. dict get/set
+        # are atomic in CPython, so concurrent informer threads at
+        # worst duplicate a computation.
+        self._memo: dict[str, int] = {}
 
     def shard_for(self, node_name: str, pool: str = "") -> int:
         key = pool or node_name
-        digest = hashlib.sha256(key.encode("utf-8")).digest()
-        return int.from_bytes(digest[:8], "big") % self.num_shards
+        shard = self._memo.get(key)
+        if shard is None:
+            digest = hashlib.sha256(key.encode("utf-8")).digest()
+            shard = int.from_bytes(digest[:8], "big") % self.num_shards
+            self._memo[key] = shard
+        return shard
 
 
 def split_budget(total_budget: int,

@@ -456,6 +456,42 @@ def observe_shards(registry: MetricsRegistry,
                 "shard_budget_recorded", share,
                 "Durably recorded budget share per shard (DaemonSet "
                 "annotation ledger)", {**labels, "shard": shard})
+    # Per-replica read-path accounting (O(partition) reads evidence):
+    # only present when the manager reads through a CachedReadClient.
+    accounting = getattr(getattr(manager, "client", None),
+                         "read_accounting", None)
+    if accounting is not None:
+        reads = accounting()
+        registry.set_counter_total(
+            "shard_api_reads_total", reads["apiReadsTotal"],
+            "Delegate API reads this replica forwarded (cache hits "
+            "cost zero)", labels)
+        registry.set_counter_total(
+            "shard_api_writes_total", reads["apiWritesTotal"],
+            "Delegate API writes this replica issued", labels)
+        registry.set_counter_total(
+            "shard_read_objects_total", reads["readObjectsTotal"],
+            "Objects the delegate returned across forwarded reads "
+            "(LIST lengths + GETs)", labels)
+        registry.set_counter_total(
+            "shard_pod_full_lists_total", reads["podFullLists"],
+            "Namespace-wide pod LISTs (initial sync, relist repairs, "
+            "partition refreshes) — 0 per steady-state pass", labels)
+        if "ingestKept" in reads:
+            registry.set_counter_total(
+                "shard_ingest_kept_total", reads["ingestKept"],
+                "Pod list/watch objects kept by the partition filter",
+                labels)
+            registry.set_counter_total(
+                "shard_ingest_dropped_total", reads["ingestDropped"],
+                "Pod list/watch objects outside the owned partition, "
+                "dropped at ingest", labels)
+    build_seconds = getattr(manager, "last_snapshot_build_seconds", None)
+    if build_seconds is not None:
+        registry.set_gauge(
+            "shard_snapshot_build_seconds", build_seconds,
+            "Wall-clock cost of the most recent build_state "
+            "(inputs + assembly)", labels)
 
 
 def observe_shard_election(registry: MetricsRegistry,

@@ -98,6 +98,29 @@ class RolloutDecision:
 
 
 @dataclass
+class ShardedCanaryContext:
+    """Fleet-wide canary inputs under the sharded control plane's
+    partition-scoped reads (no fleet pod join exists).
+
+    ``eligible`` is the sorted, skip-filtered ``(node_name, pool)``
+    cohort domain derived from node metadata (see
+    ``ClusterUpgradeStateManager._sharded_canary_context``); ``view``
+    is the replica's shard view (``ring`` + ``owned_shards``). With a
+    context installed the guard verifies cohort completion through
+    durable PER-SHARD attestation stamps on the DaemonSet: each shard's
+    owner stamps the revision once every cohort member in its shard is
+    done-on-newest (pod hash checked against the partition it actually
+    holds), and the fleet-wide canary-passed stamp is only written once
+    every cohort-bearing shard attests — so no replica ever has to see
+    another partition's pods, and no replica can open the fleet waves
+    on members it cannot verify.
+    """
+
+    view: object
+    eligible: "list[tuple[str, str]]"
+
+
+@dataclass
 class _DsRollout:
     """Per-DaemonSet working set for one assessment."""
 
@@ -141,6 +164,9 @@ class RolloutGuard:
         #: never the rollback itself.
         self._rollback_durations: list[float] = []
         self._halt_started_at: dict[str, float] = {}
+        #: Partition-reads canary inputs for the CURRENT assessment
+        #: (None outside sharded partition mode) — set per assess().
+        self._shard_context: Optional[ShardedCanaryContext] = None
         self.last_decision = RolloutDecision()
 
     def drain_rollback_durations(self) -> "list[float]":
@@ -152,12 +178,21 @@ class RolloutGuard:
     # ------------------------------------------------------------------
     def assess(self, state: "ClusterUpgradeState",
                policy: UpgradePolicySpec,
-               pod_manager: "PodManager") -> RolloutDecision:
+               pod_manager: "PodManager",
+               shard_context: Optional[ShardedCanaryContext] = None,
+               ) -> RolloutDecision:
         """Evaluate verdicts, commit halts/rollbacks, return the pass
         decision. ``pod_manager`` is passed per call (not captured at
         construction) because ``with_pod_deletion_enabled`` rebuilds the
         state manager's instance and the revision memo must be the
-        per-snapshot one."""
+        per-snapshot one. ``shard_context`` switches cohort derivation
+        and completion checks to the partition-reads protocol (see
+        :class:`ShardedCanaryContext`); verdicts are always collected
+        from ``state`` — under sharding that is the replica's own
+        partition, and the halt/quarantine commits are durable DS
+        annotations every replica re-reads, so one partition's verdict
+        threshold halts the whole fleet."""
+        self._shard_context = shard_context
         canary = policy.canary
         if canary is None or not canary.enable:
             self.last_decision = RolloutDecision()
@@ -371,11 +406,17 @@ class RolloutGuard:
         """The deterministic canary cohort: first ``canaryCount`` of the
         managed node names in sorted order, skip-labeled nodes excluded
         (they would park the canary phase forever). Pure in the
-        snapshot, so every operator incarnation derives the same set."""
-        eligible = sorted(
-            node.metadata.name for node in state.all_nodes()
-            if node.metadata.labels.get(self._keys.skip_label)
-            != TRUE_STRING)
+        snapshot, so every operator incarnation derives the same set.
+        Under partition reads the domain comes from the shard context
+        (node metadata, fleet-wide) instead of the snapshot's pod join
+        (partition-scoped by construction)."""
+        if self._shard_context is not None:
+            eligible = [name for name, _ in self._shard_context.eligible]
+        else:
+            eligible = sorted(
+                node.metadata.name for node in state.all_nodes()
+                if node.metadata.labels.get(self._keys.skip_label)
+                != TRUE_STRING)
         if not eligible:
             return frozenset()
         count = max(1, scaled_value_from_int_or_percent(
@@ -424,7 +465,10 @@ class RolloutGuard:
                 POD_CONTROLLER_REVISION_HASH_LABEL, "")
             if pod_hash == ro.newest and ns.runtime_pod.is_ready():
                 done_on_newest.add(ns.node.metadata.name)
-        if not cohort <= done_on_newest:
+        if self._shard_context is not None:
+            if not self._shards_attested(ro, cohort, done_on_newest):
+                return False
+        elif not cohort <= done_on_newest:
             return False
         now = self._clock.now()
         try:
@@ -446,6 +490,52 @@ class RolloutGuard:
                   f"Canary cohort passed on revision {ro.newest}; baking "
                   f"{canary.bake_seconds}s before fleet waves")
         return canary.bake_seconds <= 0
+
+    def _shards_attested(self, ro: _DsRollout, cohort: "frozenset[str]",
+                         done_on_newest: "set[str]") -> bool:
+        """Partition-reads cohort completion: attest our own shards'
+        cohort members (verifiable against the pods this replica
+        holds), then require every cohort-bearing shard's durable
+        attestation to match ``ro.newest``.
+
+        The stamps are per-shard DaemonSet annotation keys (the
+        budget-share ledger idiom: disjoint keys, concurrent owners'
+        merge patches compose) valued with the revision hash, so a new
+        rollout ignores the previous rollout's attestations, and an
+        owner crash between attesting and the fleet stamp re-derives
+        from cluster state alone."""
+        ctx = self._shard_context
+        pool_of = dict(ctx.eligible)
+        ring = ctx.view.ring
+        by_shard: dict[int, set[str]] = {}
+        for name in cohort:
+            shard = ring.shard_for(name, pool_of.get(name, ""))
+            by_shard.setdefault(shard, set()).add(name)
+        prefix = self._keys.canary_shard_passed_prefix
+        annotations = ro.ds.metadata.annotations
+        owned = ctx.view.owned_shards()
+        for shard in sorted(by_shard):
+            if shard not in owned:
+                continue
+            key = f"{prefix}{shard}"
+            if annotations.get(key) == ro.newest:
+                continue
+            if not by_shard[shard] <= done_on_newest:
+                continue
+            try:
+                fresh = self._client.patch_daemon_set_annotations(
+                    ro.ds.metadata.namespace, ro.ds.metadata.name,
+                    {key: ro.newest})
+                ro.ds.metadata.annotations = fresh.metadata.annotations
+                annotations = ro.ds.metadata.annotations
+                logger.info(
+                    "canary shard %d attested on revision %s (%s)",
+                    shard, ro.newest, sorted(by_shard[shard]))
+            except (ApiServerError, ConflictError, NotFoundError) as exc:
+                logger.warning("failed to attest canary shard %d; "
+                               "retrying next pass: %s", shard, exc)
+        return all(annotations.get(f"{prefix}{shard}") == ro.newest
+                   for shard in by_shard)
 
     def status(self) -> dict:
         """CRD-embeddable rollout block for the last assessed pass."""

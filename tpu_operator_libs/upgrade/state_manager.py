@@ -259,6 +259,9 @@ class ClusterUpgradeStateManager:
         self._multislice_constraint: Optional["MultisliceConstraint"] = None
         self._multislice_constraint_is_custom = False
 
+        #: DaemonSet inputs of the most recent build (uid -> DS): the
+        #: budget-share ledger / oracle discovery surface.
+        self._last_daemon_sets: dict[str, DaemonSet] = {}
         self._pod_deletion_enabled = False
         # vanished nodes already warned about (log-dedup only; carries
         # no state-machine meaning — apply_state stays snapshot-driven)
@@ -280,10 +283,48 @@ class ClusterUpgradeStateManager:
         # re-read wholesale — O(delta) reads per pass.
         self._incremental_reads = incremental_reads
         self._delta_view = None
-        self._inputs_key: Optional[tuple[str, str]] = None
+        self._inputs_key: Optional[tuple[str, str, str]] = None
         self._inputs_ds: dict[str, DaemonSet] = {}
         self._inputs_pods: dict[tuple[str, str], Pod] = {}
         self._inputs_nodes: dict[str, Node] = {}
+        # ---- O(partition) sharded reads (ISSUE 8) ----
+        # With a sharded view AND a partition-capable cached client,
+        # build_state stops post-filtering a full snapshot: the pod
+        # cache only ever holds the owned partition (ingest filter),
+        # and the fleet-level inputs (per-shard census, canary cohort
+        # domain) are derived from NODE METADATA alone — maintained
+        # incrementally below, so a steady-state pass costs
+        # O(delta-in-partition), and the one O(fleet) object anywhere
+        # is the node cache itself.
+        self._partition_reads = False
+        #: owned_shards() observed at the previous build — an ownership
+        #: move invalidates the delta cursor and re-LISTs the pod cache
+        #: so a takeover's first snapshot is bit-identical to the
+        #: deposed owner's.
+        self._last_owned_shards: Optional[frozenset] = None
+        #: shard -> {state-label: count} over the node cache's labels
+        #: (no pod join): the budget split's census and the
+        #: last_shard_status feed. A node counts once it carries a
+        #: state label — label-only is MORE restart-stable than the
+        #: pod join (a mid-restart node keeps its label).
+        self._fleet_census: dict[int, dict[str, int]] = {}
+        #: Names of nodes whose shard this replica owns (incrementally
+        #: maintained alongside the census): the assembly-side
+        #: ownership check and the partition completeness guard.
+        self._owned_node_names: set[str] = set()
+        #: name -> (shard, state-label) the census currently counts for
+        #: that node. The decrement side of an incremental update MUST
+        #: come from here, never from the previous snapshot's node
+        #: object: apply_state commits transitions by mutating the
+        #: snapshot nodes in place (the provider's write-back), so by
+        #: the next build the "old" object already carries the new
+        #: label and the delta would cancel itself out.
+        self._census_entries: dict[str, tuple[int, str]] = {}
+        #: Wall-clock cost of the most recent build_state (inputs +
+        #: assembly) and the lifetime sum — the snapshot-build half of
+        #: the shard bench's per-replica accounting.
+        self.last_snapshot_build_seconds: Optional[float] = None
+        self.snapshot_build_seconds_total = 0.0
         # deferral counters are bumped from pool threads too
         self._deferral_lock = threading.Lock()
         #: Lifetime count of per-node transitions deferred on a
@@ -348,7 +389,31 @@ class ClusterUpgradeStateManager:
         if with_fence is not None:
             with_fence(fence)
         self.cordon_manager.with_fence(fence)
+        # O(partition) reads: a partition-capable cached client gets
+        # the view pushed down into its pod-cache ingest filter, and
+        # build_state switches to the partition-delta path (owned pods
+        # only + label-derived fleet census) instead of post-filtering
+        # a full snapshot. A plain client keeps the PR 7 post-filter
+        # semantics bit for bit.
+        set_filter = getattr(self.client, "set_partition_filter", None)
+        if set_filter is not None and self._incremental_reads:
+            current = getattr(self.client, "partition_filter", None)
+            if view is None:
+                if current is not None:
+                    set_filter(None)
+                self._partition_reads = False
+            else:
+                if current is None or current.view is not view:
+                    set_filter(view)
+                self._partition_reads = True
+            self._last_owned_shards = None
+            self._fleet_census = {}
+            self._owned_node_names = set()
+            self._census_entries = {}
+            if self._delta_view is not None:
+                self._delta_view.mark_full()
         if view is None:
+            self._partition_reads = False
             self._last_full_state = None
             self.last_shard_status = None
             self.last_budget_shares = None
@@ -443,20 +508,34 @@ class ClusterUpgradeStateManager:
     # build_state (upgrade_state.go:214-355)
     # ------------------------------------------------------------------
     def build_state(self, namespace: str,
-                    runtime_labels: dict[str, str]) -> ClusterUpgradeState:
+                    runtime_labels: dict[str, str],
+                    node_selector: str = "") -> ClusterUpgradeState:
         """Snapshot runtime DaemonSets + pods + nodes into state buckets.
 
-        Reads go one of two ways: a plain client is re-listed wholesale
-        every pass (reference semantics — but one bulk LIST instead of
-        the reference's GET per pod, upgrade_state.go:285); a
+        Reads go one of three ways: a plain client is re-listed
+        wholesale every pass (reference semantics — but one bulk LIST
+        instead of the reference's GET per pod, upgrade_state.go:285); a
         delta-capable client (CachedReadClient) is consulted only for
         the objects its watch stream marked dirty since the previous
         pass, the prior inputs are patched in place, and only a resync
         (first pass, watch overflow relist, selector change) falls back
         to the full re-read — per-pass read cost O(delta), not
-        O(cluster). Both paths feed the same assembly, so the snapshot
-        semantics are byte-identical (pinned by the mock-parity test).
+        O(cluster); and a SHARDED manager over a partition-capable
+        cached client reads only its owned partition's pods (the cache
+        never held the rest), with the fleet-level census derived from
+        node labels alone — O(delta-in-partition) per steady-state
+        pass. All paths feed the same assembly, so the snapshot
+        semantics are byte-identical (pinned by the mock-parity and
+        partition-parity tests).
+
+        ``node_selector`` (usually ``policy.node_selector``, threaded
+        by :meth:`reconcile`) scopes the node LIST to the managed node
+        pool — unmanaged pools sharing the cluster are neither read
+        nor acted on.
         """
+        import time as _time
+
+        started = _time.perf_counter()
         reset_memo = getattr(self.pod_manager, "reset_revision_cache", None)
         if reset_memo is not None:
             # the revision oracle's memo is per-snapshot: within one
@@ -464,10 +543,18 @@ class ClusterUpgradeStateManager:
             reset_memo()
         selector = selector_from_labels(runtime_labels)
         daemon_sets, pods, nodes_by_name = self._snapshot_inputs(
-            namespace, selector)
-        return self._assemble_state(daemon_sets, pods, nodes_by_name)
+            namespace, selector, node_selector)
+        # the ledger/oracle DaemonSet set of this snapshot (budget
+        # shares, rollout bookkeeping) — present even when every pod of
+        # a DS is mid-restart, unlike a pod-derived discovery
+        self._last_daemon_sets = daemon_sets
+        state = self._assemble_state(daemon_sets, pods, nodes_by_name)
+        self.last_snapshot_build_seconds = _time.perf_counter() - started
+        self.snapshot_build_seconds_total += self.last_snapshot_build_seconds
+        return state
 
-    def _full_inputs(self, namespace: str, selector: str) -> tuple[
+    def _full_inputs(self, namespace: str, selector: str,
+                     node_selector: str = "") -> tuple[
             dict[str, DaemonSet], list[Pod], dict[str, Node]]:
         daemon_sets = {ds.metadata.uid: ds
                        for ds in self.client.list_daemon_sets(
@@ -475,28 +562,48 @@ class ClusterUpgradeStateManager:
         pods = self.client.list_pods(namespace=namespace,
                                      label_selector=selector)
         nodes_by_name = {n.metadata.name: n
-                         for n in self.client.list_nodes()}
+                         for n in self.client.list_nodes(node_selector)}
         return daemon_sets, pods, nodes_by_name
 
-    def _snapshot_inputs(self, namespace: str, selector: str) -> tuple[
+    def _snapshot_inputs(self, namespace: str, selector: str,
+                         node_selector: str = "") -> tuple[
             dict[str, DaemonSet], list[Pod], dict[str, Node]]:
         factory = (getattr(self.client, "delta_view", None)
                    if self._incremental_reads else None)
         if factory is None:
-            return self._full_inputs(namespace, selector)
+            return self._full_inputs(namespace, selector, node_selector)
         if self._delta_view is None:
             self._delta_view = factory()
+        partition = self._partition_reads and self._shard_view is not None
+        if partition:
+            owned = frozenset(self._shard_view.owned_shards())
+            if owned != self._last_owned_shards:
+                # Shard acquisition/handover: events for newly-owned
+                # pods were dropped at ingest before the move — only a
+                # targeted re-LIST of the pod cache repairs that, and
+                # the delta cursor is invalidated so the next build
+                # cannot patch a snapshot whose partition boundary
+                # moved under it. This is what keeps a takeover's first
+                # snapshot bit-identical to the deposed owner's.
+                refresh = getattr(self.client, "refresh_partition", None)
+                if refresh is not None:
+                    refresh()
+                self._delta_view.mark_full()
+                self._last_owned_shards = owned
         delta = self._delta_view.poll()
-        key = (namespace, selector)
+        key = (namespace, selector, node_selector)
         try:
             if delta.full or self._inputs_key != key:
-                ds, pods, nodes = self._full_inputs(namespace, selector)
+                ds, pods, nodes = self._full_inputs(namespace, selector,
+                                                    node_selector)
                 self._inputs_key = key
                 self._inputs_ds = ds
                 self._inputs_pods = {
                     (p.metadata.namespace, p.metadata.name): p
                     for p in pods}
                 self._inputs_nodes = nodes
+                if partition:
+                    self._rebuild_fleet_census()
                 return ds, pods, nodes
             if delta.daemon_sets:
                 self._inputs_ds = {
@@ -516,19 +623,96 @@ class ClusterUpgradeStateManager:
                         self._inputs_pods.pop(pod_key, None)
                     else:
                         self._inputs_pods[pod_key] = pod
-            for name in delta.nodes:
-                try:
-                    self._inputs_nodes[name] = self.client.get_node(name)
-                except NotFoundError:
-                    self._inputs_nodes.pop(name, None)
+            if delta.nodes:
+                node_match = parse_label_selector(node_selector)
+                for name in delta.nodes:
+                    try:
+                        node = self.client.get_node(name)
+                    except NotFoundError:
+                        node = None
+                    if node is not None \
+                            and not node_match(node.metadata.labels):
+                        # left the managed pool: same as deleted, for
+                        # this manager's purposes
+                        node = None
+                    if node is None:
+                        self._inputs_nodes.pop(name, None)
+                    else:
+                        self._inputs_nodes[name] = node
+                    if partition:
+                        self._census_update(name, node)
         except Exception:
             # the delta was consumed but not fully applied: without
             # this the lost entries would leave the snapshot stale
-            # FOREVER. Force a full rebuild on the next pass.
+            # FOREVER. Force a full rebuild on the next pass (which
+            # also rebuilds the fleet census from scratch).
             self._delta_view.mark_full()
             raise
         return (self._inputs_ds, list(self._inputs_pods.values()),
                 self._inputs_nodes)
+
+    # ------------------------------------------------------------------
+    # fleet census over node labels (partition-reads mode)
+    # ------------------------------------------------------------------
+    def _node_pool(self, node: Node) -> str:
+        from tpu_operator_libs.consts import GKE_NODEPOOL_LABEL
+
+        return node.metadata.labels.get(GKE_NODEPOOL_LABEL, "")
+
+    def _rebuild_fleet_census(self) -> None:
+        """Recompute the label-derived per-shard census and the
+        owned-node set from the full node input map. O(fleet) — runs
+        only on a full resync or an ownership move; steady-state passes
+        maintain both incrementally via :meth:`_census_update`."""
+        view = self._shard_view
+        owned = view.owned_shards()
+        census: dict[int, dict[str, int]] = {
+            shard: {} for shard in range(view.num_shards)}
+        owned_names: set[str] = set()
+        entries: dict[str, tuple[int, str]] = {}
+        state_label = self.keys.state_label
+        ring = view.ring
+        for name, node in self._inputs_nodes.items():
+            shard = ring.shard_for(name, self._node_pool(node))
+            if shard in owned:
+                owned_names.add(name)
+            label = node.metadata.labels.get(state_label, "")
+            entries[name] = (shard, label)
+            if label:
+                cell = census[shard]
+                cell[label] = cell.get(label, 0) + 1
+        self._fleet_census = census
+        self._owned_node_names = owned_names
+        self._census_entries = entries
+
+    def _census_update(self, name: str, new: Optional[Node]) -> None:
+        """Apply one node delta to the incremental census + owned set.
+        The decrement comes from the recorded census entry (see
+        ``_census_entries``), so it is immune to in-place mutation of
+        the previous snapshot's node objects."""
+        view = self._shard_view
+        prev = self._census_entries.pop(name, None)
+        if prev is not None:
+            shard, label = prev
+            if label:
+                cell = self._fleet_census.get(shard)
+                if cell is not None and cell.get(label, 0) > 0:
+                    cell[label] -= 1
+                    if not cell[label]:
+                        del cell[label]
+        if new is None:
+            self._owned_node_names.discard(name)
+            return
+        shard = view.ring.shard_for(name, self._node_pool(new))
+        label = new.metadata.labels.get(self.keys.state_label, "")
+        self._census_entries[name] = (shard, label)
+        if label:
+            cell = self._fleet_census.setdefault(shard, {})
+            cell[label] = cell.get(label, 0) + 1
+        if shard in view.owned_shards():
+            self._owned_node_names.add(name)
+        else:
+            self._owned_node_names.discard(name)
 
     def _assemble_state(self, daemon_sets: dict[str, DaemonSet],
                         pods: list[Pod],
@@ -545,6 +729,16 @@ class ClusterUpgradeStateManager:
         # already dropped its desired count for the gone node, so
         # counting the lingering pod would otherwise fail the guard for
         # the whole GC window.
+        partition = (self._partition_reads and self._shard_view
+                     is not None)
+        if partition:
+            # Exact ownership boundary: the ingest filter is fail-open
+            # (it keeps a pod whose node it cannot resolve yet), so the
+            # authoritative check runs here against the fleet node map.
+            # O(partition) memoized ring lookups.
+            owned = self._owned_node_names
+            pods = [p for p in pods
+                    if not p.spec.node_name or p.spec.node_name in owned]
         live_pods = []
         stranded_by_uid: dict[str, int] = {}
         vanished_now: set[str] = set()
@@ -593,7 +787,24 @@ class ClusterUpgradeStateManager:
             # unscheduled pods — refuse to act.
             if ds.status.desired_number_scheduled not in (
                     len(ds_pods), len(ds_pods) + stranded):
-                if self._shard_view is not None and \
+                if partition:
+                    # Partition-reads: the desired count is fleet-wide
+                    # but the pod snapshot is partition-scoped, so the
+                    # raw guard always "fails" — the real question is
+                    # whether OUR partition has holes. O(partition) set
+                    # difference against the owned-node set, same
+                    # semantics as the post-filter mode's fleet scan.
+                    covered = {p.spec.node_name for p in ds_pods
+                               if p.spec.node_name}
+                    if self._owned_node_names - covered:
+                        raise BuildStateError(
+                            f"runtime DaemonSet {ds.metadata.name} "
+                            f"should not have unscheduled pods")
+                    logger.debug(
+                        "runtime DaemonSet %s has pod-restart holes "
+                        "outside this replica's partition; proceeding",
+                        ds.metadata.name)
+                elif self._shard_view is not None and \
                         self._partition_is_complete(ds_pods, nodes_by_name):
                     # Sharded control plane: the missing pods are all on
                     # OTHER replicas' partitions — their owners are
@@ -632,6 +843,24 @@ class ClusterUpgradeStateManager:
                 node=node, runtime_pod=pod, runtime_daemon_set=ds)
             label = node.metadata.labels.get(self.keys.state_label, "")
             state.node_states.setdefault(label, []).append(node_state)
+        if partition:
+            # Already partition-scoped: no post-filter pass. The fleet
+            # picture (census, ownership) comes from the incrementally
+            # maintained node-label census; there is no full snapshot
+            # to retain — fleet-level decisions consume the census and
+            # the node map, never a fleet-wide pod join.
+            self._last_full_state = None
+            view = self._shard_view
+            self.last_shard_status = {
+                "owned": sorted(view.owned_shards()),
+                "numShards": view.num_shards,
+                "perShard": {
+                    shard: {"total": sum(cell.values()),
+                            "byState": dict(cell)}
+                    for shard, cell in sorted(
+                        self._fleet_census.items())},
+            }
+            return state
         if self._shard_view is not None:
             return self._filter_owned_partition(state, nodes_by_name)
         return state
@@ -709,8 +938,51 @@ class ClusterUpgradeStateManager:
         }
         return filtered
 
-    def _sharded_budget_caps(self, full_state: ClusterUpgradeState,
-                             policy: UpgradePolicySpec) -> tuple[int, int]:
+    def _sharded_canary_context(self, state: ClusterUpgradeState,
+                                policy: UpgradePolicySpec) -> "object":
+        """The rollout guard's fleet-wide cohort domain under partition
+        reads, derived WITHOUT a fleet pod join.
+
+        With ``policy.node_selector`` set, the selector-scoped node map
+        IS the managed fleet — every replica derives the identical,
+        day-zero-complete cohort domain from node metadata alone (the
+        recommended configuration for sharded canary fleets). Without
+        one, fleet-wide membership is only visible once a node carries
+        a state label, so the domain is the labeled fleet plus this
+        partition's podded nodes; replicas converge on the same domain
+        after each partition's first triage pass, and the per-shard
+        attestation stamps keep a transiently narrower domain from
+        opening the fleet waves early (a shard owner only attests
+        members it can verify against its own pods)."""
+        from tpu_operator_libs.upgrade.rollout_guard import (
+            ShardedCanaryContext,
+        )
+
+        skip = self.keys.skip_label
+        eligible: dict[str, str] = {}
+        if policy.node_selector:
+            for name, node in self._inputs_nodes.items():
+                if node.metadata.labels.get(skip) != TRUE_STRING:
+                    eligible[name] = self._node_pool(node)
+        else:
+            state_label = self.keys.state_label
+            for name, node in self._inputs_nodes.items():
+                if node.metadata.labels.get(skip) == TRUE_STRING:
+                    continue
+                if node.metadata.labels.get(state_label, ""):
+                    eligible[name] = self._node_pool(node)
+            for bucket in state.node_states.values():
+                for ns in bucket:
+                    node = ns.node
+                    if node.metadata.labels.get(skip) != TRUE_STRING:
+                        eligible[node.metadata.name] = \
+                            self._node_pool(node)
+        return ShardedCanaryContext(
+            view=self._shard_view,
+            eligible=sorted(eligible.items()))
+
+    def _sharded_budget_caps(
+            self, policy: UpgradePolicySpec) -> tuple[int, int]:
         """The partition's (maxUnavailable, maxParallel) caps under the
         durable budget-share protocol.
 
@@ -755,16 +1027,17 @@ class ClusterUpgradeStateManager:
         entitled = split_budget(global_budget, counts)
 
         # the ledger DaemonSet: deterministically the first runtime DS
-        # (sorted by namespace/name) — every replica picks the same one
+        # (sorted by namespace/name) — every replica LISTs the same
+        # selector, so every replica picks the same one. Taken from the
+        # snapshot's DS inputs, not from the pod join: a DS whose pods
+        # are all mid-restart (or all on other partitions) must still
+        # carry the ledger.
         ledger = ShardBudgetLedger(self.keys)
         ledger_ds = None
         seen: dict[str, DaemonSet] = {}
-        for bucket in full_state.node_states.values():
-            for ns in bucket:
-                if ns.runtime_daemon_set is not None:
-                    meta = ns.runtime_daemon_set.metadata
-                    seen[f"{meta.namespace}/{meta.name}"] = \
-                        ns.runtime_daemon_set
+        for ds in self._last_daemon_sets.values():
+            meta = ds.metadata
+            seen[f"{meta.namespace}/{meta.name}"] = ds
         if seen:
             ledger_ds = seen[min(seen)]
         recorded = (ledger.shares_from(ledger_ds.metadata.annotations)
@@ -786,6 +1059,16 @@ class ClusterUpgradeStateManager:
         # concurrent replicas never touch each other's keys)
         stale = {shard: entitled[shard] for shard in owned
                  if recorded.get(shard) != entitled[shard]}
+        if fleet_total <= 0:
+            # Bootstrap guard (label-derived census): before any node
+            # carries a state label the census is empty and every
+            # entitlement is zero — recording those zeros would make
+            # the real first-pass shares an "increase" and cost every
+            # replica one idle pass under the increase-next-pass rule.
+            # An unestablished ledger already spends conservatively
+            # (unrecorded shares count as entitlement on both sides of
+            # the clamp), so stamp nothing until the census exists.
+            stale = {}
         if stale and ledger_ds is not None:
             try:
                 self.client.patch_daemon_set_annotations(
@@ -850,15 +1133,24 @@ class ClusterUpgradeStateManager:
         # Rollout guard first: halt detection must land in the SAME pass
         # as the verdicts that tripped it — admissions below consult the
         # decision, so a halting fleet admits nothing this pass. Under
-        # sharding the guard assesses the FULL snapshot: the canary
-        # cohort and the halt verdicts are fleet-level decisions every
-        # replica must derive identically (its durable writes — the
-        # quarantine/bake stamps — are idempotent across replicas).
+        # post-filter sharding the guard assesses the FULL snapshot:
+        # the canary cohort and the halt verdicts are fleet-level
+        # decisions every replica must derive identically (its durable
+        # writes — the quarantine/bake stamps — are idempotent across
+        # replicas). Under partition reads there IS no fleet pod join:
+        # the cohort domain comes from node metadata (the shard
+        # context) and cohort completion is attested per shard by each
+        # shard's owner through durable DS stamps.
         full_state = (self._last_full_state
                       if self._shard_view is not None
                       and self._last_full_state is not None else state)
-        self._rollout = self.rollout_guard.assess(full_state, policy,
-                                                  self.pod_manager)
+        shard_context = None
+        if (self._partition_reads and self._shard_view is not None
+                and policy.canary is not None and policy.canary.enable):
+            shard_context = self._sharded_canary_context(state, policy)
+        self._rollout = self.rollout_guard.assess(
+            full_state, policy, self.pod_manager,
+            shard_context=shard_context)
         if self._rollout.quarantined:
             self._admit_rollback_nodes(state, policy)
 
@@ -878,7 +1170,7 @@ class ClusterUpgradeStateManager:
             # partition (per-shard percent ceilings would jointly
             # overdraw the fleet budget)
             max_unavailable, max_parallel = self._sharded_budget_caps(
-                full_state, policy)
+                policy)
         upgrades_available = self.get_upgrades_available(
             state, max_parallel, max_unavailable)
         in_progress = self.get_upgrades_in_progress(state)
@@ -1806,6 +2098,17 @@ class ClusterUpgradeStateManager:
             if self.last_budget_shares is not None:
                 shard_block["budgetShares"] = dict(
                     self.last_budget_shares)
+            accounting = getattr(self.client, "read_accounting", None)
+            if accounting is not None:
+                # this replica's read-path cost picture: delegate
+                # calls/objects, steady-state pod LISTs (0 is the
+                # O(partition) claim), ingest keep/drop split, and the
+                # snapshot build cost
+                reads = accounting()
+                if self.last_snapshot_build_seconds is not None:
+                    reads["snapshotBuildSeconds"] = round(
+                        self.last_snapshot_build_seconds, 6)
+                shard_block["reads"] = reads
             status["shards"] = shard_block
         if self.nudger is not None:
             wakeups = self.nudger.counts_snapshot()
@@ -1874,9 +2177,12 @@ class ClusterUpgradeStateManager:
         """
         last_state = None
         fingerprint = None
+        node_selector = (getattr(policy, "node_selector", "")
+                         if policy is not None else "")
         for _ in range(max_chain):
             try:
-                state = self.build_state(namespace, runtime_labels)
+                state = self.build_state(namespace, runtime_labels,
+                                         node_selector)
             except BuildStateError:
                 # restarted runtime pod between deletion and recreation;
                 # nothing more to do until the controller catches up
