@@ -4,7 +4,7 @@ PYTHON ?= python3
 PYTEST_FLAGS ?= -q
 COV_THRESHOLD ?= 85
 
-.PHONY: all check test test-fast test-fault test-chaos test-soak test-scale test-rollout test-latency test-reconfig test-shard lint cov bench bench-reconcile bench-latency bench-shard bench-shard-100k graft-check package clean diagram
+.PHONY: all check test test-fast test-fault test-chaos test-soak test-scale test-rollout test-latency test-reconfig test-shard test-planner lint cov bench bench-reconcile bench-latency bench-shard bench-shard-100k bench-planner graft-check package clean diagram
 
 all: lint test
 
@@ -147,6 +147,21 @@ bench-shard-100k:
 # the 256/1024-node makespan-ratio cells are also marked slow.
 test-latency:
 	$(PYTHON) -m pytest tests/ $(PYTEST_FLAGS) -m latency
+
+# Cost-aware predictive wave planner slice (`planner` marker):
+# predictor/LPT/window units, planner-chain composition, the 64-node
+# bench smoke, and the seeded maintenance-window chaos gate are
+# tier-1; the 256/1024-node acceptance cells are also marked slow.
+test-planner:
+	$(PYTHON) -m pytest tests/ $(PYTEST_FLAGS) -m "planner and not slow"
+
+# Cost-aware predictive wave planning: flat admission order vs
+# learned-duration LPT packing on seeded heterogeneous 256/1024-node
+# fleets — ≥1.2x makespan win, ≤15% predicted-vs-actual makespan
+# error, bit-identical final state (tools/planner_bench.py;
+# docs/benchmarks.md §2f). Writes BENCH_planner.json.
+bench-planner:
+	$(PYTHON) tools/planner_bench.py --nodes 256,1024 --out BENCH_planner.json
 
 graft-check:
 	$(PYTHON) __graft_entry__.py

@@ -1,0 +1,679 @@
+"""Cost-aware predictive wave planner (ISSUE 9).
+
+Covers the duration predictor (phase stamps, EWMA + pooled fallback,
+durable crash/takeover seeds, forecasts), the PredictiveWavePlanner
+(LPT ordering, cold-start flat fallback, maintenance-window deferral,
+fleet ETA), planner-chain composition (predictive ∘ canary ∘ slice
+determinism, sharded ownership-filtered snapshots), the metrics
+satellite (per-bucket access + quantile estimator, observe_planner),
+the seeded heterogeneous-duration knobs, the planner bench smoke, and
+the maintenance-window chaos gate.
+"""
+
+from __future__ import annotations
+
+import pytest
+
+from tpu_operator_libs.api.upgrade_policy import (
+    DrainSpec,
+    MaintenanceWindowSpec,
+    PolicyValidationError,
+    PredictorSpec,
+    UpgradePolicySpec,
+)
+from tpu_operator_libs.consts import UpgradeKeys, UpgradeState
+from tpu_operator_libs.k8s.objects import Node, ObjectMeta
+from tpu_operator_libs.metrics import (
+    MetricsRegistry,
+    observe_planner,
+    quantile_from_buckets,
+)
+from tpu_operator_libs.simulate import (
+    NS,
+    RUNTIME_LABELS,
+    FleetSpec,
+    build_fleet,
+    heterogeneous_settle,
+    node_delay_factors,
+)
+from tpu_operator_libs.upgrade.predictor import (
+    PhaseDurationPredictor,
+    PredictiveWavePlanner,
+    decode_durations,
+    encode_durations,
+)
+from tpu_operator_libs.upgrade.state_manager import (
+    ClusterUpgradeStateManager,
+    FlatPlanner,
+)
+from tpu_operator_libs.util import FakeClock
+
+pytestmark = pytest.mark.planner
+
+KEYS = UpgradeKeys()
+
+
+def _node(name: str, annotations: dict | None = None) -> Node:
+    return Node(metadata=ObjectMeta(name=name,
+                                    annotations=dict(annotations or {})))
+
+
+def _walk(predictor: PhaseDurationPredictor, clock: FakeClock,
+          node: Node, transitions: "list[tuple[str, str, float]]") -> None:
+    """Apply (old, new, dwell-before) transitions through the observer,
+    merging returned annotation updates into the node like the
+    provider's patch would."""
+    for old, new, dwell in transitions:
+        clock.advance(dwell)
+        updates = predictor.observe_transition(node, old, new) or {}
+        for key, value in updates.items():
+            if value is None:
+                node.metadata.annotations.pop(key, None)
+            else:
+                node.metadata.annotations[key] = value
+        node.metadata.labels[KEYS.state_label] = new
+
+
+UP = str(UpgradeState.UPGRADE_REQUIRED)
+CORDON = str(UpgradeState.CORDON_REQUIRED)
+WAIT = str(UpgradeState.WAIT_FOR_JOBS_REQUIRED)
+DRAIN = str(UpgradeState.DRAIN_REQUIRED)
+RESTART = str(UpgradeState.POD_RESTART_REQUIRED)
+VALIDATE = str(UpgradeState.VALIDATION_REQUIRED)
+UNCORDON = str(UpgradeState.UNCORDON_REQUIRED)
+DONE = str(UpgradeState.DONE)
+FAILED = str(UpgradeState.FAILED)
+
+
+class TestQuantileEstimator:
+    def test_interpolates_within_bucket(self):
+        buckets = (10.0, 20.0, 40.0)
+        # 4 obs <=10, 4 more in (10,20], none above
+        assert quantile_from_buckets(buckets, [4, 8, 8], 8, 0.5) == 10.0
+        q75 = quantile_from_buckets(buckets, [4, 8, 8], 8, 0.75)
+        assert 10.0 < q75 <= 20.0
+
+    def test_clamps_to_last_finite_bucket(self):
+        buckets = (10.0, 20.0)
+        # everything beyond the last bucket
+        assert quantile_from_buckets(buckets, [0, 0], 5, 0.9) == 20.0
+
+    def test_empty_and_bad_q(self):
+        assert quantile_from_buckets((10.0,), [0], 0, 0.5) is None
+        assert quantile_from_buckets((10.0,), [1], 1, 1.5) is None
+
+    def test_registry_buckets_and_quantile(self):
+        registry = MetricsRegistry()
+        for value in (1.0, 2.0, 3.0, 100.0):
+            registry.observe_histogram("t_seconds", value,
+                                       buckets=(1.0, 5.0, 50.0))
+        pairs = registry.histogram_buckets("t_seconds")
+        assert pairs == [(1.0, 1), (5.0, 3), (50.0, 3),
+                         (float("inf"), 4)]
+        q50 = registry.histogram_quantile("t_seconds", 0.5)
+        assert 1.0 < q50 <= 5.0
+        assert registry.histogram_quantile("missing", 0.5) is None
+        assert registry.histogram_buckets("missing") is None
+
+
+class TestPhaseDurationPredictor:
+    def test_phase_lifecycle_records_samples(self):
+        clock = FakeClock()
+        predictor = PhaseDurationPredictor(KEYS, clock=clock)
+        node = _node("n1")
+        _walk(predictor, clock, node, [
+            (UP, CORDON, 0.0),     # stamp drain
+            (CORDON, WAIT, 5.0),   # same phase: no restamp
+            (WAIT, DRAIN, 5.0),
+            (DRAIN, RESTART, 10.0),   # drain sample = 20
+            (RESTART, VALIDATE, 40.0),  # restart sample = 40
+            (VALIDATE, UNCORDON, 25.0),  # same phase
+            (UNCORDON, DONE, 5.0),    # validate sample = 30
+        ])
+        assert predictor.samples_total == 3
+        assert predictor._ewma["n1"] == {"drain": 20.0, "restart": 40.0,
+                                         "validate": 30.0}
+        # stamp deleted at DONE; durable history KEPT (the next
+        # incarnation/rollout predicts this node from cluster state)
+        assert KEYS.phase_start_annotation not in node.metadata.annotations
+        history = decode_durations(
+            node.metadata.annotations[KEYS.phase_durations_annotation])
+        assert history == {"drain": 20.0, "restart": 40.0,
+                           "validate": 30.0}
+        assert predictor.predict_node("n1") == pytest.approx(90.0)
+
+    def test_ewma_update(self):
+        clock = FakeClock()
+        predictor = PhaseDurationPredictor(KEYS, clock=clock,
+                                           smoothing=0.5)
+        node = _node("n1")
+        for restart_s in (40.0, 20.0):
+            _walk(predictor, clock, node, [
+                (UP, CORDON, 0.0),
+                (CORDON, RESTART, 0.0),
+                (RESTART, UNCORDON, restart_s),
+                (UNCORDON, DONE, 0.0),
+            ])
+        assert predictor._ewma["n1"]["restart"] == pytest.approx(30.0)
+
+    def test_failure_aborts_open_phase_sample(self):
+        clock = FakeClock()
+        predictor = PhaseDurationPredictor(KEYS, clock=clock)
+        node = _node("n1")
+        _walk(predictor, clock, node, [
+            (UP, CORDON, 0.0),
+            (CORDON, RESTART, 5.0),   # drain sample recorded
+            (RESTART, FAILED, 500.0),  # failure dwell: sample DROPPED
+        ])
+        assert "restart" not in predictor._ewma["n1"]
+        assert KEYS.phase_start_annotation not in node.metadata.annotations
+
+    def test_crash_survival_closes_phase_from_durable_stamp(self):
+        clock = FakeClock()
+        first = PhaseDurationPredictor(KEYS, clock=clock)
+        node = _node("n1")
+        _walk(first, clock, node, [(UP, CORDON, 0.0),
+                                   (CORDON, RESTART, 0.0)])
+        # operator crash: a FRESH predictor (new incarnation / shard
+        # takeover) observes the next transition and must close the
+        # in-flight phase from the durable stamp alone
+        second = PhaseDurationPredictor(KEYS, clock=clock)
+        _walk(second, clock, node, [(RESTART, VALIDATE, 33.0)])
+        assert second._ewma["n1"]["restart"] == pytest.approx(33.0)
+
+    def test_durable_history_seeds_fresh_predictor(self):
+        fresh = PhaseDurationPredictor(KEYS, clock=FakeClock())
+        annotations = {KEYS.phase_durations_annotation: encode_durations(
+            {"drain": 5.0, "restart": 60.0, "validate": 20.0})}
+        assert fresh.predict_node("n1", annotations) \
+            == pytest.approx(85.0)
+
+    def test_pooled_fallback_and_prior(self):
+        clock = FakeClock()
+        predictor = PhaseDurationPredictor(KEYS, clock=clock,
+                                           prior_seconds=100.0)
+        # nothing learned at all: the prior, per phase
+        assert predictor.predict_node("nope") == pytest.approx(300.0)
+        node = _node("n1")
+        _walk(predictor, clock, node, [
+            (UP, CORDON, 0.0), (CORDON, RESTART, 10.0),
+            (RESTART, UNCORDON, 40.0), (UNCORDON, DONE, 10.0),
+        ])
+        # an unknown node now uses the pooled estimate, not the prior
+        unknown = predictor.predict_node("other")
+        assert unknown < 300.0
+
+    def test_conservative_exceeds_plain_with_history(self):
+        clock = FakeClock()
+        predictor = PhaseDurationPredictor(KEYS, clock=clock)
+        node = _node("n1")
+        _walk(predictor, clock, node, [
+            (UP, CORDON, 0.0), (CORDON, RESTART, 10.0),
+            (RESTART, UNCORDON, 40.0), (UNCORDON, DONE, 10.0),
+        ])
+        plain = predictor.predict_node("n1")
+        assert predictor.predict_node("n1", conservative=True) > plain
+
+    def test_forecast_error_closed_at_done(self):
+        clock = FakeClock()
+        predictor = PhaseDurationPredictor(KEYS, clock=clock)
+        node = _node("n1")
+        # pass 1 teaches the model; pass 2's forecast closes vs actual
+        for _ in range(2):
+            _walk(predictor, clock, node, [
+                (UP, CORDON, 0.0), (CORDON, RESTART, 10.0),
+                (RESTART, UNCORDON, 40.0), (UNCORDON, DONE, 10.0),
+            ])
+        assert predictor.forecasts_closed_total == 2
+        errors = predictor.drain_forecast_errors()
+        assert len(errors) == 2
+        # the second forecast had exact per-node history -> tiny error
+        assert errors[1] == pytest.approx(0.0, abs=1e-6)
+
+    def test_remaining_seconds_subtracts_elapsed(self):
+        clock = FakeClock()
+        predictor = PhaseDurationPredictor(KEYS, clock=clock)
+        node = _node("n1")
+        _walk(predictor, clock, node, [
+            (UP, CORDON, 0.0), (CORDON, RESTART, 10.0),
+            (RESTART, UNCORDON, 40.0), (UNCORDON, DONE, 10.0),
+        ])
+        # node mid-restart, 30s into a predicted-40s phase
+        _walk(predictor, clock, node, [
+            (UP, CORDON, 0.0), (CORDON, RESTART, 10.0)])
+        clock.advance(30.0)
+        remaining = predictor.remaining_seconds(
+            "n1", RESTART, node.metadata.annotations)
+        assert remaining == pytest.approx(10.0 + 10.0)  # rest + validate
+
+
+def _make_candidates(mgr, state):
+    return state.bucket("")
+
+
+def _fleet(n_slices: int = 4, **kwargs):
+    fleet = FleetSpec(n_slices=n_slices, hosts_per_slice=2, **kwargs)
+    cluster, clock, keys = build_fleet(fleet)
+    mgr = ClusterUpgradeStateManager(cluster, keys, clock=clock,
+                                     async_workers=False,
+                                     poll_interval=0.0)
+    return cluster, clock, keys, mgr
+
+
+class TestPredictiveWavePlanner:
+    def _teach(self, predictor, clock, name: str, restart_s: float):
+        node = _node(name)
+        _walk(predictor, clock, node, [
+            (UP, CORDON, 0.0), (CORDON, RESTART, 0.0),
+            (RESTART, UNCORDON, restart_s), (UNCORDON, DONE, 0.0),
+        ])
+
+    def test_lpt_orders_slowest_first(self):
+        cluster, clock, keys, mgr = _fleet()
+        state = mgr.build_state(NS, dict(RUNTIME_LABELS))
+        candidates = state.bucket("")
+        predictor = PhaseDurationPredictor(keys, clock=clock)
+        durations = {ns.node.metadata.name: 10.0 * (i + 1)
+                     for i, ns in enumerate(candidates)}
+        for name, seconds in durations.items():
+            self._teach(predictor, clock, name, seconds)
+        planner = PredictiveWavePlanner(FlatPlanner(), predictor,
+                                        clock=clock)
+        picked = planner.plan(list(candidates), 3, state)
+        slowest = sorted(durations, key=durations.get, reverse=True)[:3]
+        assert [ns.node.metadata.name for ns in picked] == slowest
+
+    def test_cold_start_preserves_flat_order(self):
+        cluster, clock, keys, mgr = _fleet()
+        state = mgr.build_state(NS, dict(RUNTIME_LABELS))
+        candidates = state.bucket("")
+        planner = PredictiveWavePlanner(
+            FlatPlanner(), PhaseDurationPredictor(keys, clock=clock),
+            clock=clock)
+        picked = planner.plan(list(candidates), 3, state)
+        flat = FlatPlanner().plan(list(candidates), 3, state)
+        assert [ns.node.metadata.name for ns in picked] \
+            == [ns.node.metadata.name for ns in flat]
+        assert planner.last_plan["coldStart"] is True
+
+    def test_window_defers_crossing_nodes(self):
+        cluster, clock, keys, mgr = _fleet()
+        state = mgr.build_state(NS, dict(RUNTIME_LABELS))
+        candidates = state.bucket("")
+        predictor = PhaseDurationPredictor(keys, clock=clock)
+        names = [ns.node.metadata.name for ns in candidates]
+        straggler = names[0]
+        for name in names:
+            self._teach(predictor, clock, name,
+                        500.0 if name == straggler else 20.0)
+        decisions = []
+        window = MaintenanceWindowSpec(
+            enable=True, close_epoch_seconds=clock.now() + 120.0)
+        planner = PredictiveWavePlanner(
+            FlatPlanner(), predictor, clock=clock, window=window,
+            audit=lambda *args: decisions.append(args))
+        picked = planner.plan(list(candidates), len(candidates), state)
+        picked_names = {ns.node.metadata.name for ns in picked}
+        assert straggler not in picked_names
+        assert picked_names == set(names) - {straggler}
+        assert planner.deferred_by_window_total == 1
+        assert planner.last_plan["deferredByWindow"] == 1
+        kinds = {(kind, name) for kind, name, _, _ in decisions}
+        assert ("defer", straggler) in kinds
+        assert all(name != straggler for kind, name, _, _ in decisions
+                   if kind == "admit")
+
+    def test_window_closed_defers_everything(self):
+        cluster, clock, keys, mgr = _fleet()
+        state = mgr.build_state(NS, dict(RUNTIME_LABELS))
+        candidates = state.bucket("")
+        window = MaintenanceWindowSpec(
+            enable=True, close_epoch_seconds=clock.now() - 1.0)
+        planner = PredictiveWavePlanner(
+            FlatPlanner(), PhaseDurationPredictor(keys, clock=clock),
+            clock=clock, window=window)
+        assert planner.plan(list(candidates), 8, state) == []
+
+    def test_eta_lpt_packing(self):
+        cluster, clock, keys, mgr = _fleet(n_slices=2)  # 4 nodes
+        state = mgr.build_state(NS, dict(RUNTIME_LABELS))
+        candidates = state.bucket("")
+        predictor = PhaseDurationPredictor(keys, clock=clock)
+        names = [ns.node.metadata.name for ns in candidates]
+        for name, seconds in zip(names, (100.0, 60.0, 40.0, 40.0)):
+            self._teach(predictor, clock, name, seconds)
+        planner = PredictiveWavePlanner(FlatPlanner(), predictor,
+                                        clock=clock)
+        planner.plan(list(candidates), 0, state)  # no slots: ETA only
+        # 2 waves of... slots = max(1, 0 in-progress + 0 available) = 1
+        plan = planner.last_plan
+        assert plan["pending"] == 4
+        assert plan["predictedMakespanSeconds"] == pytest.approx(
+            240.0, rel=0.01)  # single slot: serial sum
+        planner.plan(list(candidates), 2, state)
+        plan = planner.last_plan
+        # LPT on 2 slots: (100, 60+40) then 40 -> max(140, 100+40)=140
+        assert plan["predictedMakespanSeconds"] == pytest.approx(
+            140.0, rel=0.01)
+        assert plan["slots"] == 2
+        assert [w["nodes"] for w in plan["waves"]] == [2, 2]
+
+
+class TestPlannerChainComposition:
+    def test_predictive_canary_slice_deterministic(self):
+        from tpu_operator_libs.topology.planner import (
+            CanaryWavePlanner,
+            SlicePlanner,
+        )
+
+        cluster, clock, keys, mgr = _fleet()
+        predictor = PhaseDurationPredictor(keys, clock=clock)
+        cohort = frozenset(
+            n.metadata.name for n in cluster.list_nodes())
+
+        def plan_once():
+            state = mgr.build_state(NS, dict(RUNTIME_LABELS))
+            chain = PredictiveWavePlanner(
+                CanaryWavePlanner(SlicePlanner(), cohort), predictor,
+                clock=clock)
+            picked = chain.plan(list(state.bucket("")), 2, state)
+            return [ns.node.metadata.name for ns in picked]
+
+        first = plan_once()
+        second = plan_once()  # same snapshot -> same waves
+        assert first == second
+        assert first  # something was planned
+
+    def test_canary_filter_still_applies_inside_predictive(self):
+        from tpu_operator_libs.topology.planner import CanaryWavePlanner
+
+        cluster, clock, keys, mgr = _fleet()
+        state = mgr.build_state(NS, dict(RUNTIME_LABELS))
+        candidates = state.bucket("")
+        cohort = frozenset({candidates[-1].node.metadata.name})
+        chain = PredictiveWavePlanner(
+            CanaryWavePlanner(FlatPlanner(), cohort),
+            PhaseDurationPredictor(keys, clock=clock), clock=clock)
+        picked = chain.plan(list(candidates), 8, state)
+        assert [ns.node.metadata.name for ns in picked] == list(cohort)
+
+    def test_sharded_partitions_learn_and_plan_independently(self):
+        """Per-shard learning never reorders another shard's partition:
+        each replica plans only its ownership-filtered candidates, so
+        one replica's learned stragglers cannot move nodes of the
+        other's partition."""
+        from tpu_operator_libs.k8s.sharding import (
+            ShardRing,
+            StaticShardView,
+        )
+
+        cluster, clock, keys, mgr = _fleet()
+        ring = ShardRing(num_shards=2)
+        view_a = StaticShardView(ring=ring, owned=frozenset({0}),
+                                 identity="a")
+        view_b = StaticShardView(ring=ring, owned=frozenset({1}),
+                                 identity="b")
+        policy = UpgradePolicySpec(
+            auto_upgrade=True, max_parallel_upgrades=0,
+            max_unavailable="100%", drain=DrainSpec(enable=False),
+            predictor=PredictorSpec(enable=True))
+        mgr_a = ClusterUpgradeStateManager(
+            cluster, keys, clock=clock, async_workers=False,
+            poll_interval=0.0, incremental_reads=False,
+        ).with_sharding(view_a)
+        mgr_b = ClusterUpgradeStateManager(
+            cluster, keys, clock=clock, async_workers=False,
+            poll_interval=0.0, incremental_reads=False,
+        ).with_sharding(view_b)
+        state_a = mgr_a.build_state(NS, dict(RUNTIME_LABELS))
+        mgr_a.apply_state(state_a, policy)
+        # replica A only ever admits (and stamps) its own partition
+        from tpu_operator_libs.consts import GKE_NODEPOOL_LABEL
+
+        stamped = {n.metadata.name for n in cluster.list_nodes()
+                   if keys.phase_start_annotation
+                   in n.metadata.annotations}
+        owned_a = {n.metadata.name for n in cluster.list_nodes()
+                   if view_a.owns(n.metadata.name,
+                                  n.metadata.labels.get(
+                                      GKE_NODEPOOL_LABEL, ""))}
+        assert stamped <= owned_a
+        state_b = mgr_b.build_state(NS, dict(RUNTIME_LABELS))
+        mgr_b.apply_state(state_b, policy)
+        in_flight = {n.metadata.name for n in cluster.list_nodes()
+                     if n.metadata.labels.get(keys.state_label)
+                     not in (None, "", DONE)}
+        assert in_flight  # both partitions progressed
+        # each manager's predictor only learned its own partition
+        assert set(mgr_a.predictor._ewma) <= owned_a or \
+            not mgr_a.predictor._ewma
+
+
+class TestManagerIntegration:
+    def _policy(self, **kwargs) -> UpgradePolicySpec:
+        return UpgradePolicySpec(
+            auto_upgrade=True, max_parallel_upgrades=0,
+            max_unavailable="50%", drain=DrainSpec(enable=False),
+            predictor=PredictorSpec(enable=True), **kwargs)
+
+    def test_status_planner_block_and_observer_lifecycle(self):
+        cluster, clock, keys, mgr = _fleet()
+        policy = self._policy()
+        state = mgr.reconcile(NS, dict(RUNTIME_LABELS), policy)
+        assert mgr.predictor is not None
+        assert mgr.provider.transition_observer is not None
+        status = mgr.cluster_status(state)
+        planner_block = status["planner"]
+        assert "predictedMakespanSeconds" in planner_block
+        assert planner_block["samplesTotal"] == \
+            mgr.predictor.samples_total
+        # disabling the predictor detaches the learning observer (let
+        # the in-flight pod restarts settle first: an incomplete
+        # snapshot aborts the pass before planner wiring runs)
+        off = UpgradePolicySpec(
+            auto_upgrade=True, max_parallel_upgrades=0,
+            max_unavailable="50%", drain=DrainSpec(enable=False))
+        for _ in range(60):
+            clock.advance(10.0)
+            cluster.step()
+            if mgr.reconcile(NS, dict(RUNTIME_LABELS), off) is not None:
+                break
+        assert mgr.provider.transition_observer is None
+
+    def test_full_upgrade_learns_and_cleans_stamps(self):
+        cluster, clock, keys, mgr = _fleet()
+        policy = self._policy()
+        for _ in range(60):
+            mgr.reconcile(NS, dict(RUNTIME_LABELS), policy)
+            done = all(
+                n.metadata.labels.get(keys.state_label) == DONE
+                for n in cluster.list_nodes())
+            if done:
+                break
+            clock.advance(10.0)
+            cluster.step()
+        assert done
+        for node in cluster.list_nodes():
+            assert keys.phase_start_annotation \
+                not in node.metadata.annotations
+            # durable per-node history survives upgrade-done
+            assert keys.phase_durations_annotation \
+                in node.metadata.annotations
+        assert mgr.predictor.samples_total > 0
+        assert mgr.predictor.forecasts_closed_total > 0
+
+    def test_observe_planner_exports(self):
+        cluster, clock, keys, mgr = _fleet()
+        policy = self._policy()
+        for _ in range(60):
+            mgr.reconcile(NS, dict(RUNTIME_LABELS), policy)
+            if all(n.metadata.labels.get(keys.state_label) == DONE
+                   for n in cluster.list_nodes()):
+                break
+            clock.advance(10.0)
+            cluster.step()
+        registry = MetricsRegistry()
+        observe_planner(registry, mgr)
+        text = registry.render_prometheus()
+        assert "planner_phase_seconds_bucket" in text
+        assert "planner_forecast_error_ratio_bucket" in text
+        labels = {"driver": "libtpu"}
+        assert registry.get("planner_duration_samples_total", labels) \
+            == mgr.predictor.samples_total
+        assert registry.get("planner_known_nodes", labels) \
+            == mgr.predictor.known_nodes
+        # no-op on a predictor-less manager
+        observe_planner(MetricsRegistry(),
+                        ClusterUpgradeStateManager(
+                            cluster, keys, async_workers=False))
+
+    def test_window_ignored_without_predictor(self, caplog):
+        cluster, clock, keys, mgr = _fleet()
+        policy = UpgradePolicySpec(
+            auto_upgrade=True, max_parallel_upgrades=0,
+            max_unavailable="50%", drain=DrainSpec(enable=False),
+            maintenance_window=MaintenanceWindowSpec(
+                enable=True, close_epoch_seconds=1.0))
+        state = mgr.build_state(NS, dict(RUNTIME_LABELS))
+        with caplog.at_level("WARNING"):
+            mgr.apply_state(state, policy)
+        assert any("maintenanceWindow" in r.message
+                   for r in caplog.records)
+        # the (closed) window did NOT gate anything: admissions ran
+        assert any(
+            n.metadata.labels.get(keys.state_label)
+            for n in cluster.list_nodes())
+
+
+class TestPolicySpecs:
+    def test_round_trip(self):
+        spec = UpgradePolicySpec(
+            auto_upgrade=True,
+            predictor=PredictorSpec(enable=True, smoothing=0.3,
+                                    prior_seconds=60.0),
+            maintenance_window=MaintenanceWindowSpec(
+                enable=True, close_epoch_seconds=123.0,
+                margin_seconds=30))
+        data = spec.to_dict()
+        assert data["predictor"] == {"enable": True, "smoothing": 0.3,
+                                     "priorSeconds": 60.0}
+        assert data["maintenanceWindow"]["closeEpochSeconds"] == 123.0
+        back = UpgradePolicySpec.from_dict(data)
+        assert back.predictor == spec.predictor
+        assert back.maintenance_window == spec.maintenance_window
+        back.validate()
+
+    def test_validation_errors(self):
+        with pytest.raises(PolicyValidationError):
+            PredictorSpec(smoothing=0.0).validate()
+        with pytest.raises(PolicyValidationError):
+            PredictorSpec(prior_seconds=-1).validate()
+        with pytest.raises(PolicyValidationError):
+            MaintenanceWindowSpec(margin_seconds=-1).validate()
+        with pytest.raises(PolicyValidationError):
+            MaintenanceWindowSpec(daily_close_utc="25:00").validate()
+
+    def test_daily_close_resolution(self):
+        window = MaintenanceWindowSpec(enable=True,
+                                       daily_close_utc="06:00")
+        # 1970-01-01T00:00Z -> close 06:00 same day
+        assert window.close_at(0.0) == 6 * 3600.0
+        # just past 06:00 -> tomorrow's close
+        assert window.close_at(6 * 3600.0 + 1) == 30 * 3600.0
+        assert MaintenanceWindowSpec(enable=True).close_at(0.0) is None
+        assert MaintenanceWindowSpec(
+            enable=False, close_epoch_seconds=5.0).close_at(0.0) is None
+
+    def test_crd_schema_includes_new_specs(self):
+        from tpu_operator_libs.api.crd import upgrade_policy_schema
+
+        schema = upgrade_policy_schema()["properties"]
+        assert schema["predictor"]["properties"]["enable"]["default"] \
+            is False
+        assert "closeEpochSeconds" in \
+            schema["maintenanceWindow"]["properties"]
+
+
+class TestHeterogeneousKnobs:
+    def test_factors_deterministic_and_spread(self):
+        spec = FleetSpec(hetero_sigma=1.0)
+        names = [f"s{i}-h0" for i in range(64)]
+        first = [node_delay_factors(spec, n) for n in names]
+        second = [node_delay_factors(spec, n) for n in names]
+        assert first == second
+        ready = sorted(f[1] for f in first)
+        assert ready[len(ready) // 2] < ready[-1] / 2  # heavy tail
+
+    def test_sigma_zero_is_homogeneous(self):
+        spec = FleetSpec()
+        assert node_delay_factors(spec, "s0-h0") == (1.0, 1.0)
+        settle = heterogeneous_settle(spec, ["a", "b"], 30.0)
+        assert settle == {"a": 30.0, "b": 30.0}
+
+    def test_settle_deterministic(self):
+        spec = FleetSpec(hetero_sigma=0.8)
+        one = heterogeneous_settle(spec, ["a", "b", "c"], 30.0)
+        two = heterogeneous_settle(spec, ["a", "b", "c"], 30.0)
+        assert one == two
+        assert len(set(one.values())) == 3
+
+    def test_build_fleet_installs_lognormal_delays(self):
+        fleet = FleetSpec(n_slices=2, hosts_per_slice=2,
+                          hetero_sigma=1.0)
+        cluster, clock, keys = build_fleet(fleet)
+        assert cluster._ds_delay_fn is not None
+        recreate, ready = cluster._ds_delay_fn("s0-h0")
+        f_r, f_y = node_delay_factors(fleet, "s0-h0")
+        assert recreate == pytest.approx(fleet.pod_recreate_delay * f_r)
+        assert ready == pytest.approx(fleet.pod_ready_delay * f_y)
+
+
+class TestPlannerBenchSmoke:
+    def test_small_cell_accepts(self):
+        """16-node tier-1 smoke of the full bench harness: two rollouts
+        per cell, identical final state (modulo the predictor's own
+        annotations), and a sane forecast."""
+        from tools.planner_bench import run_planner_bench
+
+        report = run_planner_bench((16,))
+        cell = report["16_nodes"]
+        assert cell["final_state_identical"]
+        assert cell["flat"]["converged"]
+        assert cell["predictive"]["converged"]
+        assert cell["predictive"]["duration_samples"] > 0
+        assert cell["forecast_error_pct"] is not None
+
+    @pytest.mark.slow
+    def test_acceptance_cell_256(self):
+        from tools.planner_bench import run_planner_bench
+
+        cell = run_planner_bench((256,))["256_nodes"]
+        assert cell["meets_1_2x_makespan"], cell
+        assert cell["meets_15pct_error"], cell
+        assert cell["final_state_identical"]
+
+
+class TestMaintenanceWindowGate:
+    """The seeded maintenance-window chaos gate: predictive planner
+    live under operator crashes and control-plane faults, with the
+    window invariants armed (no admission whose predicted completion
+    crosses the close; deferred nodes never started; nothing stranded
+    mid-upgrade at the close)."""
+
+    @pytest.mark.chaos
+    @pytest.mark.parametrize("seed", [1, 2, 3])
+    def test_window_soak_seed(self, seed):
+        from tpu_operator_libs.chaos.runner import run_window_soak
+
+        report = run_window_soak(seed)
+        assert report.ok, report.report_text
+        assert report.crashes_fired >= 1
+
+    @pytest.mark.chaos
+    @pytest.mark.slow
+    @pytest.mark.parametrize("seed", [4, 5, 6, 7, 8, 9, 10])
+    def test_window_soak_extended(self, seed):
+        from tpu_operator_libs.chaos.runner import run_window_soak
+
+        report = run_window_soak(seed)
+        assert report.ok, report.report_text

@@ -1,0 +1,315 @@
+#!/usr/bin/env python3
+"""Cost-aware predictive wave planner benchmark: flat vs LPT-packed.
+
+Drives the REAL state machine over simulate.py fleets (64 / 256 / 1024
+nodes on the FakeCluster virtual clock) whose per-node durations are
+SEEDED HETEROGENEOUS: pod recreate/ready delays and the validation
+settle are each scaled by a mean-1 lognormal draw per node
+(``FleetSpec.hetero_sigma`` / ``heterogeneous_settle``), so the fleet
+has a realistic straggler tail reproducible from the seed alone. Each
+cell performs TWO full rollouts under the event-driven scheduling layer
+(PR 5: completion nudges + timer wheel + eager refill):
+
+- **flat** — the reference admission order (snapshot bucket order):
+  stragglers start whenever their name comes up, so whichever one lands
+  in the last wave paces the whole fleet.
+- **predictive** — the PredictiveWavePlanner is live: rollout #1 is the
+  LEARNING pass (zero history degrades to exactly the flat order), and
+  rollout #2 is planned longest-predicted-first from the learned
+  per-node phase durations, with the predicted-makespan ETA captured at
+  the rollout's first pass.
+
+Per fleet size the bench reports both rollouts' makespans, the
+acceptance ratio (flat rollout #2 / predictive rollout #2, target
+≥1.2x), the predicted-vs-actual makespan error of rollout #2 (target
+≤15% after the one-fleet-pass learning of rollout #1), and a full
+final-cluster-state fingerprint that must be bit-identical between the
+two cells (the planner changes admission ORDER, never what converges —
+and the predictor's phase annotations are deleted at upgrade-done).
+
+CLI: ``python tools/planner_bench.py [--nodes 256,1024]
+[--out BENCH_planner.json]`` prints one JSON document.
+``make bench-planner`` wraps it; bench.py embeds the same cells.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import sys
+from typing import Optional
+
+# direct `python tools/planner_bench.py` runs with tools/ on sys.path
+# but not the repo root; add it (same fix as the sweep tools)
+sys.path.insert(0, os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+
+from tools.latency_bench import (  # noqa: E402
+    _final_fingerprint as _raw_fingerprint,
+)
+from tpu_operator_libs.api.upgrade_policy import (  # noqa: E402
+    DrainSpec,
+    PredictorSpec,
+    UpgradePolicySpec,
+)
+from tpu_operator_libs.consts import (  # noqa: E402
+    POD_CONTROLLER_REVISION_HASH_LABEL,
+    UpgradeState,
+)
+from tpu_operator_libs.simulate import (  # noqa: E402
+    NS,
+    RUNTIME_LABELS,
+    FleetSpec,
+    build_fleet,
+    heterogeneous_settle,
+)
+from tpu_operator_libs.upgrade.nudger import ReconcileNudger  # noqa: E402
+from tpu_operator_libs.upgrade.state_manager import (  # noqa: E402
+    BuildStateError,
+    ClusterUpgradeStateManager,
+)
+
+HOSTS_PER_SLICE = 4
+RESYNC_INTERVAL = 120.0
+POD_RECREATE_DELAY = 2.0
+POD_READY_DELAY = 38.0
+VALIDATION_SETTLE = 30.0
+VALIDATION_RETRY = 5.0
+#: Lognormal sigma of the per-node duration spread: heavy-tailed enough
+#: that wave COMPOSITION dominates makespan (the planner's whole
+#: thesis), seeded so every run sees the identical fleet.
+HETERO_SIGMA = 1.0
+MAX_UNAVAILABLE = "12%"
+EVENT_BATCH_WINDOW = 1.0
+SECOND_REVISION = "new2"
+
+
+def _final_fingerprint(cluster, keys) -> tuple:
+    """latency_bench's full final-state fingerprint MINUS the
+    predictor's own two annotation keys (the phase-start stamp and the
+    durable per-node duration history). Those are the learning
+    feature's durable state — the predictive cell is SUPPOSED to leave
+    them behind so the next incarnation/rollout predicts from cluster
+    state alone — so the bit-identical claim covers everything the
+    UPGRADE touches: labels, cordons, readiness, pod placement and
+    revisions."""
+    nodes, pods = _raw_fingerprint(cluster, keys)
+    own = {keys.phase_start_annotation, keys.phase_durations_annotation}
+    filtered_nodes = tuple(
+        (name, labels,
+         tuple(pair for pair in annotations if pair[0] not in own),
+         unschedulable, ready)
+        for name, labels, annotations, unschedulable, ready in nodes)
+    return filtered_nodes, pods
+
+
+class _HeteroSettleValidator:
+    """Extra validator: healthy ``settle[node]`` seconds after it FIRST
+    sees the node's current runtime pod Ready — per-node heterogeneous,
+    seeded (simulate.heterogeneous_settle)."""
+
+    def __init__(self, cluster, clock, settle: "dict[str, float]") -> None:
+        self._cluster = cluster
+        self._clock = clock
+        self._settle = settle
+        self._first_ready: dict[tuple[str, str], float] = {}
+
+    def __call__(self, node) -> bool:
+        name = node.metadata.name
+        pods = self._cluster.list_pods(
+            namespace=NS, field_selector=f"spec.nodeName={name}")
+        pod = pods[0] if pods else None
+        if pod is None or not pod.is_ready():
+            return False
+        key = (name, pod.metadata.uid)
+        first = self._first_ready.setdefault(key, self._clock.now())
+        return self._clock.now() - first >= self._settle.get(name, 0.0)
+
+
+def run_planner_cell(n_nodes: int, predictive: bool,
+                     interval: float = RESYNC_INTERVAL,
+                     max_sim_seconds: float = 24 * 3600.0,
+                     hetero_sigma: float = HETERO_SIGMA) -> dict:
+    """Two full rollouts under one admission discipline."""
+    if n_nodes % HOSTS_PER_SLICE:
+        raise ValueError(f"n_nodes must be a multiple of {HOSTS_PER_SLICE}")
+    fleet = FleetSpec(n_slices=n_nodes // HOSTS_PER_SLICE,
+                      hosts_per_slice=HOSTS_PER_SLICE,
+                      pod_recreate_delay=POD_RECREATE_DELAY,
+                      pod_ready_delay=POD_READY_DELAY,
+                      hetero_sigma=hetero_sigma)
+    cluster, clock, keys = build_fleet(fleet)
+    names = [n.metadata.name for n in cluster.list_nodes()]
+    settle = heterogeneous_settle(fleet, names, VALIDATION_SETTLE)
+    nudger = ReconcileNudger(clock=clock, resolution=1.0)
+    mgr = ClusterUpgradeStateManager(
+        cluster, keys, clock=clock, async_workers=False,
+        poll_interval=0.0, nudger=nudger)
+    mgr.with_validation_enabled(
+        "", extra_validator=_HeteroSettleValidator(cluster, clock, settle))
+    mgr.validation_manager.retry_seconds = VALIDATION_RETRY
+    policy = UpgradePolicySpec(
+        auto_upgrade=True, max_parallel_upgrades=0,
+        max_unavailable=MAX_UNAVAILABLE, topology_mode="flat",
+        drain=DrainSpec(enable=True, force=True, timeout_seconds=300),
+        predictor=PredictorSpec(enable=True) if predictive else None)
+
+    reconciles = [0]
+
+    def reconcile() -> None:
+        reconciles[0] += 1
+        try:
+            mgr.reconcile(NS, RUNTIME_LABELS, policy)
+        except BuildStateError:
+            pass  # incomplete snapshot; the next wakeup retries
+        nudger.consume_pending()
+        nudger.pop_due(clock.now())
+
+    done = str(UpgradeState.DONE)
+
+    def converged(revision: str) -> bool:
+        if any(n.metadata.labels.get(keys.state_label, "") != done
+               for n in cluster.list_nodes()):
+            return False
+        pods = [p for p in cluster.list_pods(namespace=NS)
+                if p.controller_owner() is not None]
+        return len(pods) == n_nodes and all(
+            p.metadata.labels.get(POD_CONTROLLER_REVISION_HASH_LABEL)
+            == revision and p.is_ready() for p in pods)
+
+    def drive(revision: str, on_first_pass=None) -> float:
+        """Event-driven loop (PR 5 discipline) to convergence on
+        ``revision``; returns the rollout makespan (virtual s)."""
+        start = clock.now()
+        reconcile()
+        if on_first_pass is not None:
+            on_first_pass()
+        next_resync = clock.now() + interval
+        while not converged(revision):
+            if clock.now() >= max_sim_seconds:
+                raise RuntimeError(
+                    f"no convergence within {max_sim_seconds}s")
+            now = clock.now()
+            wake = next_resync
+            due = cluster.next_action_due()
+            if due is not None and max(due, now) < wake:
+                wake = max(due, now)
+            deadline = nudger.next_deadline()
+            if deadline is not None and max(deadline, now) < wake:
+                wake = max(deadline, now)
+            clock.advance(wake - now)
+            cluster.step()
+            # workqueue-coalescing model: events due within the batch
+            # window ride the same wakeup
+            while True:
+                due = cluster.next_action_due()
+                if due is None or due > wake + EVENT_BATCH_WINDOW:
+                    break
+                clock.advance(max(0.0, due - clock.now()))
+                cluster.step()
+            nudger.pop_due(clock.now())
+            if clock.now() >= next_resync:
+                next_resync = clock.now() + interval
+            reconcile()
+        return clock.now() - start
+
+    makespan_1 = drive("new")
+
+    # rollout #2: the measured pass (predictive: planned from the
+    # learned model). The ETA is captured right after the rollout's
+    # FIRST reconcile — the whole fleet is pending/in-flight, nothing
+    # has completed, so this is the forecast the acceptance grades.
+    cluster.bump_daemon_set_revision(NS, "libtpu", SECOND_REVISION)
+    predicted: Optional[float] = None
+
+    def capture_eta() -> None:
+        nonlocal predicted
+        planner = mgr.predictive_planner
+        if planner is not None and planner.last_plan is not None:
+            predicted = planner.last_plan["predictedMakespanSeconds"]
+
+    makespan_2 = drive(SECOND_REVISION,
+                       on_first_pass=capture_eta if predictive else None)
+
+    out = {
+        "converged": True,
+        "makespan_learning_s": round(makespan_1, 1),
+        "makespan_s": round(makespan_2, 1),
+        "reconciles": reconciles[0],
+        "_fingerprint": _final_fingerprint(cluster, keys),
+    }
+    if predictive:
+        out["predicted_makespan_s"] = (round(predicted, 1)
+                                       if predicted is not None else None)
+        if predicted and makespan_2:
+            out["forecast_error"] = round(
+                abs(predicted - makespan_2) / makespan_2, 4)
+        if mgr.predictor is not None:
+            out["duration_samples"] = mgr.predictor.samples_total
+            out["known_nodes"] = mgr.predictor.known_nodes
+            out["forecasts_closed"] = mgr.predictor.forecasts_closed_total
+    return out
+
+
+def run_planner_bench(sizes: "tuple[int, ...]" = (256, 1024),
+                      hetero_sigma: float = HETERO_SIGMA) -> dict:
+    """The flat vs predictive comparison across fleet sizes."""
+    out: dict = {
+        "pod_recreate_delay_s": POD_RECREATE_DELAY,
+        "pod_ready_delay_s": POD_READY_DELAY,
+        "validation_settle_s": VALIDATION_SETTLE,
+        "hetero_sigma": hetero_sigma,
+        "max_unavailable": MAX_UNAVAILABLE,
+    }
+    for n_nodes in sizes:
+        flat = run_planner_cell(n_nodes, predictive=False,
+                                hetero_sigma=hetero_sigma)
+        predictive = run_planner_cell(n_nodes, predictive=True,
+                                      hetero_sigma=hetero_sigma)
+        identical = (flat.pop("_fingerprint")
+                     == predictive.pop("_fingerprint"))
+        ratio = (round(flat["makespan_s"] / predictive["makespan_s"], 3)
+                 if predictive["makespan_s"] else None)
+        error = predictive.get("forecast_error")
+        out[f"{n_nodes}_nodes"] = {
+            "flat": flat,
+            "predictive": predictive,
+            # the acceptance metrics: makespan win + forecast honesty
+            "makespan_ratio": ratio,
+            "meets_1_2x_makespan": bool(ratio and ratio >= 1.2),
+            "forecast_error_pct": (round(100.0 * error, 2)
+                                   if error is not None else None),
+            "meets_15pct_error": bool(error is not None and error <= 0.15),
+            "final_state_identical": identical,
+        }
+    return out
+
+
+def main(argv: "list[str]") -> int:
+    sizes: tuple[int, ...] = (256, 1024)
+    out_path: Optional[str] = None
+    sigma = HETERO_SIGMA
+    for i, arg in enumerate(argv):
+        if arg == "--nodes" and i + 1 < len(argv):
+            sizes = tuple(int(s) for s in argv[i + 1].split(","))
+        elif arg.startswith("--nodes="):
+            sizes = tuple(int(s) for s in arg.split("=", 1)[1].split(","))
+        elif arg == "--out" and i + 1 < len(argv):
+            out_path = argv[i + 1]
+        elif arg.startswith("--out="):
+            out_path = arg.split("=", 1)[1]
+        elif arg == "--sigma" and i + 1 < len(argv):
+            sigma = float(argv[i + 1])
+        elif arg.startswith("--sigma="):
+            sigma = float(arg.split("=", 1)[1])
+    report = run_planner_bench(sizes, hetero_sigma=sigma)
+    rendered = json.dumps(report, indent=2)
+    print(rendered)
+    if out_path:
+        with open(out_path, "w") as fh:
+            fh.write(rendered + "\n")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main(sys.argv[1:]))

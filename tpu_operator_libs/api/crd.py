@@ -242,6 +242,84 @@ def sharding_schema() -> dict[str, Any]:
     }
 
 
+def predictor_schema() -> dict[str, Any]:
+    """PredictorSpec (beyond-reference: cost-aware predictive wave
+    planning — learned per-node phase durations + LPT packing;
+    docs/predictive-planner.md)."""
+    return {
+        "type": "object",
+        "description": "Cost-aware predictive wave planning: learn "
+                       "per-node/per-phase upgrade durations online and "
+                       "admit waves longest-predicted-first so "
+                       "stragglers never pace the last wave.",
+        "properties": {
+            "enable": {
+                "type": "boolean",
+                "default": False,
+                "description": "Master switch; when false admission "
+                               "order is reference-style.",
+            },
+            "smoothing": {
+                "type": "number",
+                "exclusiveMinimum": 0,
+                "maximum": 1,
+                "default": 0.5,
+                "description": "EWMA weight of the newest per-node "
+                               "duration sample.",
+            },
+            "priorSeconds": {
+                "type": "number",
+                "minimum": 0,
+                "default": 120,
+                "description": "Per-phase prior (seconds) while nothing "
+                               "has been learned; also the cold-fleet "
+                               "cost the maintenance-window gate "
+                               "assumes.",
+            },
+        },
+    }
+
+
+def maintenance_window_schema() -> dict[str, Any]:
+    """MaintenanceWindowSpec (beyond-reference: finish-by-close-or-
+    don't-start admission gating on predicted completion times)."""
+    return {
+        "type": "object",
+        "description": "Maintenance window: a node is only admitted "
+                       "when its conservatively predicted completion "
+                       "lands before the window close; otherwise it is "
+                       "deferred, never started-and-stranded. Requires "
+                       "the predictor.",
+        "properties": {
+            "enable": {
+                "type": "boolean",
+                "default": False,
+                "description": "Master switch; when false (or no close "
+                               "is configured) nothing is gated.",
+            },
+            "closeEpochSeconds": {
+                "type": "number",
+                "description": "Absolute close instant (epoch seconds); "
+                               "takes precedence over dailyCloseUtc.",
+            },
+            "dailyCloseUtc": {
+                "type": "string",
+                "default": "",
+                "description": "Recurring daily close, \"HH:MM\" UTC "
+                               "(\"finish by 06:00\").",
+            },
+            "marginSeconds": {
+                "type": "integer",
+                "minimum": 0,
+                "default": 0,
+                "description": "Safety slack: predicted completion must "
+                               "land this many seconds before the "
+                               "close.",
+            },
+        },
+    }
+
+
 def wedge_detection_schema() -> dict[str, Any]:
     """WedgeDetectionSpec (api/remediation_policy.py)."""
     return {
@@ -431,6 +509,8 @@ def upgrade_policy_schema() -> dict[str, Any]:
             "canary": canary_schema(),
             "rollback": rollback_schema(),
             "sharding": sharding_schema(),
+            "predictor": predictor_schema(),
+            "maintenanceWindow": maintenance_window_schema(),
             "topologyMode": {
                 "type": "string",
                 "enum": ["flat", "slice"],

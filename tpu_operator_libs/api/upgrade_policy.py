@@ -244,6 +244,135 @@ class RollbackSpec:
 
 
 @dataclass
+class PredictorSpec:
+    """Cost-aware predictive wave planning (beyond-reference;
+    upgrade/predictor.py).
+
+    With ``enable`` the operator learns online per-node/per-phase
+    upgrade durations (drain, pod-restart, validation — stamped with
+    durable phase-start annotations so learning survives crashes and
+    shard takeovers) and composes waves longest-predicted-first, so
+    stragglers start first instead of pacing the last wave. Zero
+    history degrades to the flat admission order exactly.
+    """
+
+    # Master switch; when False admission order is reference-style.
+    enable: bool = False
+    # EWMA weight of the newest per-node sample, in (0, 1].
+    smoothing: float = 0.5
+    # Per-phase prior (seconds) while NOTHING has been learned; also
+    # the cold-fleet cost the maintenance-window gate assumes.
+    prior_seconds: float = 120.0
+
+    def validate(self) -> None:
+        if not 0.0 < self.smoothing <= 1.0:
+            raise PolicyValidationError(
+                "predictor.smoothing must be in (0, 1]")
+        if self.prior_seconds < 0:
+            raise PolicyValidationError(
+                "predictor.priorSeconds must be >= 0")
+
+    def to_dict(self) -> dict[str, Any]:
+        return {"enable": self.enable,
+                "smoothing": self.smoothing,
+                "priorSeconds": self.prior_seconds}
+
+    @classmethod
+    def from_dict(cls, data: dict[str, Any]) -> "PredictorSpec":
+        return cls(enable=data.get("enable", False),
+                   smoothing=data.get("smoothing", 0.5),
+                   prior_seconds=data.get("priorSeconds", 120.0))
+
+    def deep_copy(self) -> "PredictorSpec":
+        return copy.deepcopy(self)
+
+
+@dataclass
+class MaintenanceWindowSpec:
+    """"Finish by the window close or don't start" (beyond-reference).
+
+    A node is only admitted into the upgrade flow when its
+    *conservatively* predicted completion (predictor EWMA x safety
+    factor, pooled p95 for unknown nodes) lands before the window
+    close plus ``marginSeconds`` of slack; otherwise it is deferred —
+    left untouched in upgrade-required, never started-and-stranded
+    mid-flow at the close. Requires the predictor (the gate needs
+    duration estimates); without one the window is ignored with a
+    warning. The close is either an absolute instant
+    (``closeEpochSeconds`` — also the form benches/chaos use on
+    virtual clocks) or a recurring daily wall-clock close
+    (``dailyCloseUtc: "06:00"``), whichever is set.
+    """
+
+    # Master switch; when False (or no close configured) nothing is
+    # gated.
+    enable: bool = False
+    # Absolute close instant (epoch seconds, same clock domain the
+    # operator runs on). Takes precedence over dailyCloseUtc.
+    close_epoch_seconds: Optional[float] = None
+    # Recurring daily close, "HH:MM" UTC ("finish by 06:00").
+    daily_close_utc: str = ""
+    # Safety slack subtracted from the window: predicted completion
+    # must land this many seconds BEFORE the close.
+    margin_seconds: int = 0
+
+    def close_at(self, now: float) -> Optional[float]:
+        """The next window close at/after ``now`` (None = no close
+        configured). An absolute close in the past is returned as-is:
+        the window is shut, nothing may start."""
+        if not self.enable:
+            return None
+        if self.close_epoch_seconds is not None:
+            return float(self.close_epoch_seconds)
+        if not self.daily_close_utc:
+            return None
+        import datetime
+
+        hour, _, minute = self.daily_close_utc.partition(":")
+        base = datetime.datetime.fromtimestamp(
+            now, tz=datetime.timezone.utc)
+        close = base.replace(hour=int(hour), minute=int(minute or 0),
+                             second=0, microsecond=0)
+        if close.timestamp() <= now:
+            close += datetime.timedelta(days=1)
+        return close.timestamp()
+
+    def validate(self) -> None:
+        if self.margin_seconds < 0:
+            raise PolicyValidationError(
+                "maintenanceWindow.marginSeconds must be >= 0")
+        if self.daily_close_utc:
+            hour, sep, minute = self.daily_close_utc.partition(":")
+            try:
+                ok = (sep and 0 <= int(hour) <= 23
+                      and 0 <= int(minute) <= 59)
+            except ValueError:
+                ok = False
+            if not ok:
+                raise PolicyValidationError(
+                    "maintenanceWindow.dailyCloseUtc must be \"HH:MM\"")
+
+    def to_dict(self) -> dict[str, Any]:
+        out: dict[str, Any] = {"enable": self.enable,
+                               "marginSeconds": self.margin_seconds}
+        if self.close_epoch_seconds is not None:
+            out["closeEpochSeconds"] = self.close_epoch_seconds
+        if self.daily_close_utc:
+            out["dailyCloseUtc"] = self.daily_close_utc
+        return out
+
+    @classmethod
+    def from_dict(cls, data: dict[str, Any]) -> "MaintenanceWindowSpec":
+        return cls(enable=data.get("enable", False),
+                   close_epoch_seconds=data.get("closeEpochSeconds"),
+                   daily_close_utc=data.get("dailyCloseUtc", ""),
+                   margin_seconds=data.get("marginSeconds", 0))
+
+    def deep_copy(self) -> "MaintenanceWindowSpec":
+        return copy.deepcopy(self)
+
+
+@dataclass
 class ShardingPolicySpec:
     """Sharded HA control plane (beyond-reference; k8s/sharding.py).
 
@@ -360,6 +489,13 @@ class UpgradePolicySpec:
     # Beyond-reference: sharded HA control plane (N replicas, per-shard
     # Leases, durable budget shares). None = single-owner semantics.
     sharding: Optional[ShardingPolicySpec] = None
+    # Beyond-reference: learned per-node phase-duration prediction +
+    # longest-processing-time-first wave packing. None = flat admission
+    # order (reference semantics).
+    predictor: Optional[PredictorSpec] = None
+    # Beyond-reference: "finish by the close or don't start" gating on
+    # predicted completion times. None = no window.
+    maintenance_window: Optional[MaintenanceWindowSpec] = None
 
     def validate(self) -> None:
         if self.max_parallel_upgrades < 0:
@@ -385,7 +521,8 @@ class UpgradePolicySpec:
                 raise PolicyValidationError(
                     f"nodeSelector is not a valid label selector: {exc}")
         for sub in (self.pod_deletion, self.wait_for_completion, self.drain,
-                    self.canary, self.rollback, self.sharding):
+                    self.canary, self.rollback, self.sharding,
+                    self.predictor, self.maintenance_window):
             if sub is not None:
                 sub.validate()
 
@@ -411,6 +548,10 @@ class UpgradePolicySpec:
             out["rollback"] = self.rollback.to_dict()
         if self.sharding is not None:
             out["sharding"] = self.sharding.to_dict()
+        if self.predictor is not None:
+            out["predictor"] = self.predictor.to_dict()
+        if self.maintenance_window is not None:
+            out["maintenanceWindow"] = self.maintenance_window.to_dict()
         return out
 
     @classmethod
@@ -437,6 +578,11 @@ class UpgradePolicySpec:
             spec.rollback = RollbackSpec.from_dict(data["rollback"])
         if data.get("sharding") is not None:
             spec.sharding = ShardingPolicySpec.from_dict(data["sharding"])
+        if data.get("predictor") is not None:
+            spec.predictor = PredictorSpec.from_dict(data["predictor"])
+        if data.get("maintenanceWindow") is not None:
+            spec.maintenance_window = MaintenanceWindowSpec.from_dict(
+                data["maintenanceWindow"])
         return spec
 
     def deep_copy(self) -> "UpgradePolicySpec":

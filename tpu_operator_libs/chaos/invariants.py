@@ -137,6 +137,33 @@ class ReconfigExpectation:
 
 
 @dataclass(frozen=True)
+class WindowExpectation:
+    """Arms the maintenance-window invariants (predictive planner).
+
+    ``close_seconds`` is the window close (virtual seconds). The soak
+    runner wires the state manager's ``window_audit`` hook to
+    :meth:`InvariantMonitor.window_decision`, so the monitor holds the
+    planner's admit/defer decision log ACROSS operator incarnations
+    (the planner itself dies with each crash; its decisions must not).
+    The monitor then asserts, from watch events plus that log:
+
+    - **window-admission**: every node observed entering
+      ``cordon-required`` must have a matching planner admit record
+      whose conservatively predicted completion lands at/before the
+      close — and nothing at all may be admitted once the close has
+      passed. An admission with no record means the window gate was
+      bypassed; a record crossing the close means the gate lied.
+    - **window-stranded** (:meth:`InvariantMonitor.final_check`): at
+      the end of the episode no node may sit mid-upgrade — every
+      admitted node finished, every other node was deferred untouched
+      in upgrade-required ("finish by the close or don't start",
+      never started-and-stranded).
+    """
+
+    close_seconds: float
+
+
+@dataclass(frozen=True)
 class ShardExpectation:
     """Arms the sharded-control-plane invariants.
 
@@ -215,6 +242,8 @@ class InvariantMonitor:
     reconfig: Optional[ReconfigExpectation] = None
     #: Arms the sharded-control-plane invariants; None disables them.
     shard: Optional[ShardExpectation] = None
+    #: Arms the maintenance-window invariants; None disables them.
+    window: Optional[WindowExpectation] = None
 
     violations: list[InvariantViolation] = field(default_factory=list)
     trace: list[str] = field(default_factory=list)
@@ -256,6 +285,16 @@ class InvariantMonitor:
         # -- shard mode bookkeeping --
         #: shard -> virtual time it was orphaned (owner killed).
         self._shard_orphaned_at: dict[int, float] = {}
+        # -- maintenance-window bookkeeping --
+        #: node -> (decided_at, predicted_done) of the LATEST planner
+        #: admit decision (window mode; survives incarnations because
+        #: it lives here, not on the planner).
+        self._window_admitted: dict[str, tuple[float, float]] = {}
+        #: node -> decided_at of the latest planner defer decision.
+        self._window_deferred: dict[str, float] = {}
+        #: lifetime admit/defer decisions recorded (teeth evidence).
+        self.window_admissions = 0
+        self.window_deferrals = 0
         self._watch = self.cluster.watch(max_queue=self.watch_queue_bound)
         self.resync("initial sync")
 
@@ -544,6 +583,8 @@ class InvariantMonitor:
                 f"signal — the fleet failed to halt")
         if new.upgrade_state != str(UpgradeState.CORDON_REQUIRED):
             return
+        if self.window is not None:
+            self._check_window_admission(name)
         if old.unschedulable:
             return  # manual-cordon override: admission is budget-free
         total = len(self._nodes)
@@ -608,6 +649,57 @@ class InvariantMonitor:
                 f"+ {live_committed} live committed-to-cordon > budget "
                 f"{budget} (maxUnavailable="
                 f"{self.remediation_max_unavailable!r}, total={total})")
+
+    # -- maintenance-window invariants ------------------------------------
+    def window_decision(self, kind: str, node: str, at: float,
+                        predicted_done: float) -> None:
+        """One planner window decision (wired as the state manager's
+        ``window_audit`` hook): ``kind`` is ``"admit"`` or ``"defer"``;
+        ``predicted_done`` the planner's CONSERVATIVE predicted
+        completion instant for the node at decision time."""
+        if self.window is None:
+            return
+        if kind == "admit":
+            self._window_admitted[node] = (at, predicted_done)
+            self.window_admissions += 1
+            self._record(
+                f"window admit {node}: predicted done t="
+                f"{predicted_done:g} (close t="
+                f"{self.window.close_seconds:g})")
+        else:
+            self._window_deferred[node] = at
+            self.window_deferrals += 1
+            self._record(
+                f"window defer {node}: predicted done t="
+                f"{predicted_done:g} would cross close t="
+                f"{self.window.close_seconds:g}")
+
+    def _check_window_admission(self, name: str) -> None:
+        """A node was observed entering cordon-required under an armed
+        window expectation: the planner must have recorded a compliant
+        admit decision for it."""
+        close = self.window.close_seconds
+        now = self._now()
+        if now >= close:
+            self._violate(
+                "window-admission", name,
+                f"node started upgrading at t={now:g}, at/after the "
+                f"maintenance-window close t={close:g}")
+            return
+        record = self._window_admitted.get(name)
+        if record is None:
+            self._violate(
+                "window-admission", name,
+                "node entered cordon-required with no planner admit "
+                "record — the maintenance-window gate was bypassed")
+            return
+        _, predicted_done = record
+        if predicted_done > close:
+            self._violate(
+                "window-admission", name,
+                f"node admitted although its predicted completion t="
+                f"{predicted_done:g} crosses the window close t="
+                f"{close:g}")
 
     # -- sharded-control-plane invariants ---------------------------------
     def audit_shard_write(self, node_name: str, shard: int,
@@ -772,6 +864,16 @@ class InvariantMonitor:
                     "shard-takeover", f"shard {shard}",
                     f"still orphaned at the end of the run (since "
                     f"t={at:g}) — its partition was never taken over")
+        if self.window is not None:
+            for name, mirror in sorted(self._nodes.items()):
+                if mirror.upgrade_state in _IN_PROGRESS:
+                    self._violate(
+                        "window-stranded", name,
+                        f"node sits mid-upgrade "
+                        f"({mirror.upgrade_state!r}) at the end of the "
+                        f"episode — it should have finished before the "
+                        f"close t={self.window.close_seconds:g} or "
+                        f"never have started")
         nodes = consume_transient(self.cluster.list_nodes)
         for node in nodes:
             name = node.metadata.name

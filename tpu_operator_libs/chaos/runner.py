@@ -43,6 +43,8 @@ from tpu_operator_libs.api.remediation_policy import (
 from tpu_operator_libs.api.upgrade_policy import (
     DrainSpec,
     IntOrString,
+    MaintenanceWindowSpec,
+    PredictorSpec,
     UpgradePolicySpec,
 )
 from tpu_operator_libs.chaos.injector import (
@@ -57,10 +59,12 @@ from tpu_operator_libs.chaos.invariants import (
     ReconfigExpectation,
     RolloutExpectation,
     ShardExpectation,
+    WindowExpectation,
 )
 from tpu_operator_libs.chaos.schedule import FaultSchedule
 from tpu_operator_libs.consts import (
     GKE_NODEPOOL_LABEL,
+    IN_PROGRESS_STATES,
     POD_CONTROLLER_REVISION_HASH_LABEL,
     RemediationKeys,
     RemediationState,
@@ -140,7 +144,13 @@ class ChaosConfig:
             max_unavailable=self.max_unavailable,
             topology_mode="flat",
             drain=DrainSpec(enable=True, force=True,
-                            timeout_seconds=300))
+                            timeout_seconds=300),
+            # The cost-aware predictive planner runs LIVE under the
+            # standing gate: LPT reordering + the phase-stamp learning
+            # seam must hold every invariant under compound faults and
+            # crash-restarts (each incarnation relearns from the
+            # durable stamps alone).
+            predictor=PredictorSpec(enable=True))
 
     def remediation_policy(self) -> RemediationPolicySpec:
         policy = RemediationPolicySpec(
@@ -1498,6 +1508,261 @@ def run_replica_kill_soak(seed: int,
         crashes_fired=injector.crashes_fired,
         leader_handovers=injector.replicas_killed + injector.leader_losses,
         operator_incarnations=sum(generations),
+        watch_gaps=monitor.watch_gaps,
+        total_seconds=clock.now(),
+        steps=steps,
+        reconciles=reconciles,
+        trace=list(monitor.trace))
+    report.report_text = "\n".join(
+        [schedule.describe(), monitor.report(seed=seed)])
+    if not report.ok:
+        logger.error("%s", report.report_text)
+    return report
+
+
+@dataclass
+class WindowChaosConfig(ChaosConfig):
+    """Knobs of one maintenance-window soak episode.
+
+    The fleet is deliberately heterogeneous — seeded lognormal delay
+    spread plus named straggler hosts whose runtime pods take
+    ``straggler_factor`` x the ready delay — so "finish by the close"
+    genuinely cannot hold for every node and the deferral path has
+    teeth. The episode is TWO rollouts: a learning rollout with no
+    window (one full fleet pass — the model's cold-start budget, same
+    framing as the planner bench), then a second rollout whose
+    maintenance window closes ``window_seconds`` after its first pass;
+    the stragglers' learned durations cross the close, so they must be
+    deferred untouched while everything else finishes inside it.
+    """
+
+    n_slices: int = 4
+    hosts_per_slice: int = 2
+    straggler_nodes: tuple = ("s0-h0", "s2-h1")
+    straggler_factor: float = 40.0
+    hetero_sigma: float = 0.3
+    #: Window length of rollout #2 (close = bump instant + this).
+    window_seconds: float = 300.0
+    window_margin_seconds: int = 60
+    #: Ticks the fleet must hold a quiescent post-close state before
+    #: the final audit.
+    horizon: float = 700.0
+    max_steps: int = 600
+
+
+def run_window_soak(seed: int,
+                    config: Optional[WindowChaosConfig] = None,
+                    ) -> ChaosReport:
+    """One seeded maintenance-window chaos episode; deterministic in
+    ``seed``. Green means: under operator crashes and control-plane
+    faults, every admission's predicted completion stayed inside the
+    window, at least one straggler was deferred (and left untouched in
+    upgrade-required), everything admitted finished before the episode
+    end, and no node was stranded mid-upgrade at the close."""
+    config = config or WindowChaosConfig()
+    fleet = FleetSpec(
+        n_slices=config.n_slices,
+        hosts_per_slice=config.hosts_per_slice,
+        pod_recreate_delay=config.pod_recreate_delay,
+        pod_ready_delay=config.pod_ready_delay,
+        straggler_nodes=config.straggler_nodes,
+        straggler_factor=config.straggler_factor,
+        hetero_sigma=config.hetero_sigma)
+    cluster, clock, keys = build_fleet(fleet)
+    rem_keys = RemediationKeys()
+    node_names = [n.metadata.name for n in cluster.list_nodes()]
+
+    schedule = FaultSchedule.generate_window(
+        seed, node_names, horizon=config.horizon)
+    injector = ChaosInjector(cluster, schedule,
+                             lease_namespace=config.lease_namespace,
+                             lease_name=config.lease_name)
+    injector.install()
+
+    learning_policy = config.upgrade_policy()
+    remediation_policy = config.remediation_policy()
+    monitor = InvariantMonitor(
+        cluster=cluster, upgrade_keys=keys, remediation_keys=rem_keys,
+        max_unavailable=learning_policy.max_unavailable,
+        remediation_max_unavailable=remediation_policy.max_unavailable,
+        max_parallel_upgrades=config.max_parallel_upgrades)
+
+    incarnations = 1
+    handovers = 0
+    reconciles = 0
+
+    def build_op(identity: str) -> _OperatorIncarnation:
+        op = _OperatorIncarnation(cluster, clock, keys, rem_keys,
+                                  config, injector, identity=identity)
+        # the planner's admit/defer decision log must survive the
+        # incarnation that made it: it lives on the monitor
+        op.upgrade.window_audit = monitor.window_decision
+        return op
+
+    op = build_op("operator-1")
+
+    def next_incarnation(reason: str) -> _OperatorIncarnation:
+        nonlocal incarnations
+        incarnations += 1
+        injector.fuse.reset()
+        monitor.trace.append(
+            f"[t={clock.now():g}] operator restart #{incarnations} "
+            f"({reason}) — rebuilding managers from cluster state alone")
+        return build_op(f"operator-{incarnations}")
+
+    def fleet_state() -> "tuple[int, int, int]":
+        """(done, in_progress, pending) over the upgrade labels."""
+        done = in_progress = pending = 0
+        in_progress_labels = frozenset(str(s) for s in IN_PROGRESS_STATES)
+        for node in cluster.list_nodes():
+            label = node.metadata.labels.get(keys.state_label, "")
+            if label == str(UpgradeState.DONE):
+                done += 1
+            elif label in in_progress_labels:
+                in_progress += 1
+            else:
+                pending += 1
+        return done, in_progress, pending
+
+    def rollout_converged(revision: str) -> bool:
+        try:
+            nodes = cluster.list_nodes()
+            pods = [p for p in cluster.list_pods(namespace=NS)
+                    if p.controller_owner() is not None]
+        except (ApiServerError, TimeoutError):
+            return False
+        if any(n.metadata.labels.get(keys.state_label)
+               != str(UpgradeState.DONE) or n.is_unschedulable()
+               for n in nodes):
+            return False
+        return len(pods) == len(node_names) and all(
+            p.metadata.labels.get(POD_CONTROLLER_REVISION_HASH_LABEL)
+            == revision and p.is_ready() for p in pods)
+
+    windowed_policy: Optional[UpgradePolicySpec] = None
+    close: Optional[float] = None
+    steps = 0
+    quiesce_ticks = 0
+    is_converged = False
+    while steps < config.max_steps:
+        steps += 1
+        now = clock.now()
+        was_leading = op.elector.is_leader
+        op.elector.try_acquire_or_renew()
+        if was_leading and not op.elector.is_leader:
+            handovers += 1
+            op = next_incarnation("leader election lost")
+            op.elector.try_acquire_or_renew()
+        if op.elector.is_leader:
+            injector.arm_due_crashes(now)
+            op.nudger.pop_due(now)
+            op.nudger.consume_pending()
+            policy = (windowed_policy if windowed_policy is not None
+                      else learning_policy)
+            try:
+                op.remediation.reconcile(NS, dict(RUNTIME_LABELS),
+                                         remediation_policy)
+                op.upgrade.reconcile(NS, dict(RUNTIME_LABELS), policy)
+                reconciles += 1
+            except OperatorCrash:
+                op = next_incarnation("operator crash mid-reconcile")
+            except BuildStateError:
+                pass
+            except (ApiServerError, ConflictError, NotFoundError):
+                pass
+            if injector.fuse.pending:
+                op = next_incarnation("operator crash (surfaced late)")
+        monitor.drain()
+        if windowed_policy is None:
+            # An ARMED-but-unfired crash does NOT gate the bump: the
+            # quiet tail of the learning rollout may carry too few
+            # writes to detonate it, and the windowed rollout's write
+            # burst is exactly where it should land.
+            if not injector.fuse.pending and rollout_converged("new"):
+                # learning rollout done: open the windowed rollout. The
+                # close is measured from the bump instant, so it is
+                # deterministic relative to the episode's own pacing.
+                close = clock.now() + config.window_seconds
+                windowed_policy = config.upgrade_policy()
+                windowed_policy.maintenance_window = \
+                    MaintenanceWindowSpec(
+                        enable=True, close_epoch_seconds=close,
+                        margin_seconds=config.window_margin_seconds)
+                monitor.window = WindowExpectation(close_seconds=close)
+                cluster.bump_daemon_set_revision(NS, "libtpu",
+                                                 FINAL_REVISION)
+                monitor.trace.append(
+                    f"[t={clock.now():g}] windowed rollout opened: "
+                    f"close t={close:g}, margin "
+                    f"{config.window_margin_seconds}s")
+        elif clock.now() > close and not injector.fuse.pending:
+            try:
+                _, in_progress, _ = fleet_state()
+            except (ApiServerError, TimeoutError):
+                in_progress = -1
+            if in_progress == 0:
+                quiesce_ticks += 1
+                if quiesce_ticks >= 3:
+                    is_converged = True
+                    break
+            else:
+                quiesce_ticks = 0
+        clock.advance(config.reconcile_interval)
+        cluster.step()
+        monitor.drain()
+
+    if is_converged:
+        monitor.final_check()
+        done, in_progress, pending = fleet_state()
+        # Teeth: the episode must have exercised BOTH window outcomes.
+        if monitor.window_deferrals == 0 or pending == 0:
+            monitor.violations.append(InvariantViolation(
+                invariant="harness", at=clock.now(), subject="window",
+                detail=f"no node was deferred by the window "
+                       f"({monitor.window_deferrals} deferral "
+                       f"decisions, {pending} pending at end) — the "
+                       f"close never bit"))
+        if monitor.window_admissions == 0 or done == 0:
+            monitor.violations.append(InvariantViolation(
+                invariant="harness", at=clock.now(), subject="window",
+                detail=f"windowed rollout made no clean progress "
+                       f"({done} done, {pending} pending, "
+                       f"{in_progress} in progress)"))
+        # Deferred nodes must be untouched: still schedulable, parked
+        # in upgrade-required (never cordoned, never phase-stamped).
+        for node in cluster.list_nodes():
+            label = node.metadata.labels.get(keys.state_label, "")
+            if label != str(UpgradeState.UPGRADE_REQUIRED):
+                continue
+            if node.is_unschedulable() \
+                    or keys.phase_start_annotation \
+                    in node.metadata.annotations:
+                monitor.violations.append(InvariantViolation(
+                    invariant="window-stranded", at=clock.now(),
+                    subject=node.metadata.name,
+                    detail="deferred node carries upgrade residue "
+                           "(cordon or phase stamp) — it was started "
+                           "after all"))
+    else:
+        monitor.violations.append(InvariantViolation(
+            invariant="liveness", at=clock.now(), subject="fleet",
+            detail=f"episode did not reach a quiescent post-close "
+                   f"state within {config.max_steps} steps "
+                   f"({clock.now():g}s virtual)"))
+    if injector.crashes_fired == 0:
+        monitor.violations.append(InvariantViolation(
+            invariant="harness", at=clock.now(), subject="injector",
+            detail="no operator crash fired — the schedule's crash "
+                   "events never detonated"))
+
+    report = ChaosReport(
+        seed=seed,
+        converged=is_converged,
+        violations=list(monitor.violations),
+        fault_kinds=tuple(sorted(schedule.kinds)),
+        crashes_fired=injector.crashes_fired,
+        leader_handovers=handovers,
+        operator_incarnations=incarnations,
         watch_gaps=monitor.watch_gaps,
         total_seconds=clock.now(),
         steps=steps,

@@ -220,6 +220,44 @@ class FaultSchedule:
         return cls(seed=seed, events=tuple(events))
 
     @classmethod
+    def generate_window(cls, seed: int, node_names: "list[str]",
+                        horizon: float = 600.0,
+                        extra_kinds: int = 3) -> "FaultSchedule":
+        """Schedule for the maintenance-window gate: operator crashes
+        plus control-plane faults (api bursts, watch breaks, stale
+        reads, leader losses) — deliberately NO node-health faults, so
+        every node's upgrade duration stays the seeded heterogeneous
+        one and the window invariant ("no admission whose predicted
+        completion crosses the close; nothing stranded mid-upgrade at
+        the close") is exact rather than fault-excused."""
+        rng = random.Random(f"chaos-window:{seed}")
+        nodes = sorted(node_names)
+        events: list[FaultEvent] = []
+        for _ in range(rng.randint(1, 2)):
+            events.append(FaultEvent(
+                at=rng.uniform(0.1, horizon * 0.45),
+                kind=FAULT_OPERATOR_CRASH,
+                param=rng.randint(0, 8)))
+        pool = [FAULT_API_BURST, FAULT_WATCH_BREAK, FAULT_STALE_READS,
+                FAULT_LEADER_LOSS]
+        for kind in rng.sample(pool, min(extra_kinds, len(pool))):
+            for _ in range(rng.randint(1, 2)):
+                start = rng.uniform(0.1, horizon * 0.8)
+                if kind == FAULT_API_BURST:
+                    events.append(FaultEvent(
+                        at=start, kind=kind,
+                        target=rng.choice(API_BURST_OPERATIONS),
+                        param=rng.randint(1, 4)))
+                elif kind == FAULT_STALE_READS:
+                    events.append(FaultEvent(
+                        at=start, kind=kind, target=rng.choice(nodes),
+                        param=rng.randint(1, 3)))
+                else:
+                    events.append(FaultEvent(at=start, kind=kind))
+        events.sort(key=lambda e: (e.at, e.kind, e.target))
+        return cls(seed=seed, events=tuple(events))
+
+    @classmethod
     def generate_reconfig(cls, seed: int,
                           slice_members: "dict[str, list[str]]",
                           horizon: float = 600.0,

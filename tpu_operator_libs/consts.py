@@ -474,6 +474,32 @@ class UpgradeKeys:
         return f"{self.domain}/{self.driver}-upgrade.canary-shard-passed."
 
     @property
+    def phase_start_annotation(self) -> str:
+        """NODE annotation ``<phase>:<epoch-seconds>`` stamping when the
+        node entered its current upgrade phase (drain / restart /
+        validate — see upgrade/predictor.py). Ridden onto the SAME merge
+        patch as the state-label commit, so it is crash-atomic with the
+        transition: a restarted operator (or a shard takeover) closes
+        the in-flight phase's duration sample from this stamp alone —
+        the durable half of online duration learning. Deleted when the
+        node leaves the phased flow (done/failed/rollback)."""
+        return f"{self.domain}/{self.driver}-upgrade.phase-start"
+
+    @property
+    def phase_durations_annotation(self) -> str:
+        """NODE annotation ``drain=<s>,restart=<s>,validate=<s>`` of the
+        node's most recently observed per-phase durations, updated on
+        the same patch that closes each phase. The durable per-node
+        model seed: a fresh operator incarnation (or the next shard
+        owner after a takeover, or the next ROLLOUT after a crash)
+        predicts this node from cluster state alone instead of falling
+        back to the fleet pool — so it survives upgrade-done. Benches
+        comparing predictive vs flat cells exclude this key (and the
+        phase-start stamp) from their final-state fingerprints; it is
+        the feature's own durable state, not rollout residue."""
+        return f"{self.domain}/{self.driver}-upgrade.phase-durations"
+
+    @property
     def event_reason(self) -> str:
         """Reason string attached to Kubernetes events (util.go:136-139)."""
         return f"{self.driver.upper()}RuntimeUpgrade"

@@ -66,6 +66,34 @@ class _Histogram:
         default_factory=dict)
 
 
+def quantile_from_buckets(buckets: "tuple[float, ...]",
+                          cumulative_counts: "list[int]",
+                          total_count: int, q: float) -> Optional[float]:
+    """Prometheus-style ``histogram_quantile`` over cumulative buckets.
+
+    ``cumulative_counts[i]`` is the observation count with value <=
+    ``buckets[i]``; ``total_count`` covers the implicit +Inf bucket.
+    Linear interpolation inside the containing bucket, exactly like
+    PromQL; observations beyond the last finite bucket clamp to it (the
+    honest answer a bounded histogram can give). Shared by the
+    registry's :meth:`MetricsRegistry.histogram_quantile` and the
+    duration predictor's pooled fallback (upgrade/predictor.py), so
+    both read the same evidence the same way. Returns None when the
+    series is empty or ``q`` is out of range."""
+    if total_count <= 0 or not 0.0 <= q <= 1.0:
+        return None
+    rank = q * total_count
+    prev_le = 0.0
+    prev_count = 0
+    for le, count in zip(buckets, cumulative_counts):
+        if count >= rank:
+            in_bucket = count - prev_count
+            if in_bucket <= 0:
+                return le
+            return prev_le + (le - prev_le) * (rank - prev_count) / in_bucket
+    return buckets[-1] if buckets else None
+
+
 class MetricsRegistry:
     """Thread-safe gauge/counter store with Prometheus text rendering."""
 
@@ -163,6 +191,45 @@ class MetricsRegistry:
             if data is None:
                 return None
             return data.count, data.total
+
+    def histogram_buckets(
+            self, name: str, labels: Optional[dict[str, str]] = None,
+    ) -> Optional[list[tuple[float, int]]]:
+        """Per-bucket access for one histogram series: the cumulative
+        ``(le, count)`` pairs exactly as exposition renders them, with
+        the implicit ``(+inf, total)`` bucket last. ``histogram_stats``
+        only exposes (count, sum), which cannot answer "how many
+        observations were under X" — the question the duration
+        predictor and ``observe_planner`` ask of their own evidence."""
+        with self._lock:
+            h = self._histograms.get(name)
+            if h is None:
+                return None
+            data = h.values.get(self._key(labels))
+            if data is None:
+                return None
+            out = list(zip(h.buckets, data.bucket_counts))
+            out.append((float("inf"), data.count))
+            return out
+
+    def histogram_quantile(
+            self, name: str, q: float,
+            labels: Optional[dict[str, str]] = None) -> Optional[float]:
+        """Estimate the ``q``-quantile of one histogram series
+        (Prometheus ``histogram_quantile`` semantics — linear
+        interpolation within the containing bucket, clamped to the last
+        finite bucket). None when the series is absent or empty."""
+        with self._lock:
+            h = self._histograms.get(name)
+            if h is None:
+                return None
+            data = h.values.get(self._key(labels))
+            if data is None:
+                return None
+            buckets = h.buckets
+            counts = list(data.bucket_counts)
+            total = data.count
+        return quantile_from_buckets(buckets, counts, total, q)
 
     def get(self, name: str,
             labels: Optional[dict[str, str]] = None) -> Optional[float]:
@@ -398,6 +465,89 @@ def observe_latency(registry: MetricsRegistry,
         "upgrade_eager_refill_admissions_total",
         manager.eager_refill_admissions_total,
         "Nodes admitted by eager refill rounds", labels)
+
+
+#: Buckets for learned phase durations (seconds): pod recreate/ready
+#: and validation-settle timescales, matching the predictor's pooled
+#: model so scraped evidence and model evidence line up.
+PHASE_SECONDS_BUCKETS = (1.0, 2.5, 5.0, 10.0, 20.0, 30.0, 45.0, 60.0,
+                         90.0, 120.0, 180.0, 300.0, 600.0, 1200.0,
+                         1800.0, 3600.0, 7200.0)
+
+#: Buckets for |predicted-actual|/actual forecast-error ratios: the
+#: acceptance band (≤0.15 after one fleet pass) needs resolution below
+#: and around it.
+FORECAST_ERROR_BUCKETS = (0.01, 0.025, 0.05, 0.075, 0.1, 0.15, 0.2,
+                          0.3, 0.5, 1.0, 2.0, 5.0)
+
+
+def observe_planner(registry: MetricsRegistry,
+                    manager: "ClusterUpgradeStateManager",
+                    driver: str = "libtpu") -> None:
+    """Export the cost-aware predictive planner's evidence.
+
+    No-op until a predictive policy has run. Three families:
+
+    - per-phase duration histograms (``planner_phase_seconds`` labeled
+      by phase) — the learning inputs, drained from the predictor's
+      sample buffer;
+    - predicted-vs-actual whole-node error ratios
+      (``planner_forecast_error_ratio``) — the model's honesty, the
+      bench's ≤15% acceptance band lives in these buckets;
+    - plan-side gauges/counters — predicted fleet makespan, model
+      coverage, and the maintenance-window deferral counter
+      (``planner_window_deferrals_total`` moving means the window gate
+      is actively holding nodes).
+
+    All of it is readable back through the registry's own per-bucket /
+    quantile accessors (``histogram_buckets`` / ``histogram_quantile``).
+    """
+    predictor = getattr(manager, "predictor", None)
+    if predictor is None:
+        return
+    labels = {"driver": driver}
+    for phase, seconds in predictor.drain_phase_samples():
+        registry.observe_histogram(
+            "planner_phase_seconds", seconds,
+            "Observed per-node upgrade-phase durations (the duration "
+            "model's learning inputs)", {**labels, "phase": phase},
+            buckets=PHASE_SECONDS_BUCKETS)
+    for ratio in predictor.drain_forecast_errors():
+        registry.observe_histogram(
+            "planner_forecast_error_ratio", ratio,
+            "Whole-node |predicted-actual|/actual duration error",
+            labels, buckets=FORECAST_ERROR_BUCKETS)
+    registry.set_counter_total(
+        "planner_duration_samples_total", predictor.samples_total,
+        "Phase-duration samples learned", labels)
+    registry.set_counter_total(
+        "planner_forecasts_closed_total",
+        predictor.forecasts_closed_total,
+        "Whole-node forecasts closed against an actual duration",
+        labels)
+    registry.set_gauge(
+        "planner_known_nodes", predictor.known_nodes,
+        "Nodes with a learned per-node duration model", labels)
+    planner = getattr(manager, "predictive_planner", None)
+    if planner is None:
+        return
+    registry.set_counter_total(
+        "planner_window_deferrals_total",
+        planner.deferred_by_window_total,
+        "Admissions deferred because predicted completion crossed the "
+        "maintenance-window close", labels)
+    plan = planner.last_plan
+    if plan is not None:
+        registry.set_gauge(
+            "planner_predicted_makespan_seconds",
+            plan["predictedMakespanSeconds"],
+            "Predicted seconds until the fleet's pending+in-flight "
+            "upgrades complete (LPT packing over learned durations)",
+            labels)
+        registry.set_gauge(
+            "planner_pending_nodes", plan["pending"],
+            "Upgrade-required nodes awaiting a wave at the last plan",
+            labels)
 
 
 def observe_shards(registry: MetricsRegistry,

@@ -105,6 +105,13 @@ class FleetSpec:
     # `straggler_factor` x the ready delay (heterogeneous-fleet tail).
     straggler_nodes: tuple[str, ...] = ()
     straggler_factor: float = 3.0
+    # Seeded LOGNORMAL per-node heterogeneity (mean-1 multipliers drawn
+    # per node per delay from `delay_seed`): sigma 0 = homogeneous;
+    # sigma ~1 gives the heavy-tailed per-node duration spread the
+    # cost-aware planner bench and the maintenance-window chaos soak
+    # need — reproducible from the seed alone, composing with
+    # delay_jitter and straggler_nodes multiplicatively.
+    hetero_sigma: float = 0.0
     # Scale-down events: (node name, virtual seconds) — the node is
     # deleted mid-upgrade. The DS controller sim drops desired counts
     # immediately and garbage-collects the node's pods after its
@@ -212,7 +219,8 @@ def build_fleet(spec: FleetSpec) -> tuple[FakeCluster, FakeClock, UpgradeKeys]:
 
 
 def _install_delay_model(cluster: FakeCluster, spec: FleetSpec) -> None:
-    """Per-node recreate/ready delays: seeded jitter + straggler hosts.
+    """Per-node recreate/ready delays: seeded jitter, lognormal
+    heterogeneity, and straggler hosts.
 
     Each node's factors are drawn from a generator seeded by
     ``(delay_seed, node name)``, so the distribution is deterministic,
@@ -221,7 +229,10 @@ def _install_delay_model(cluster: FakeCluster, spec: FleetSpec) -> None:
     """
     if not 0.0 <= spec.delay_jitter < 1.0:
         raise ValueError("delay_jitter must be in [0, 1)")
-    if spec.delay_jitter == 0.0 and not spec.straggler_nodes:
+    if spec.hetero_sigma < 0.0:
+        raise ValueError("hetero_sigma must be >= 0")
+    if spec.delay_jitter == 0.0 and not spec.straggler_nodes \
+            and spec.hetero_sigma == 0.0:
         return
     stragglers = set(spec.straggler_nodes)
     known = {n.metadata.name for n in cluster.list_nodes()}
@@ -231,15 +242,49 @@ def _install_delay_model(cluster: FakeCluster, spec: FleetSpec) -> None:
             f"straggler nodes {sorted(unknown)} are not fleet nodes")
     delays: dict[str, tuple[float, float]] = {}
     for name in known:
-        rng = random.Random(f"{spec.delay_seed}:{name}")
-        recreate = spec.pod_recreate_delay * (
-            1.0 + spec.delay_jitter * (2.0 * rng.random() - 1.0))
-        ready = spec.pod_ready_delay * (
-            1.0 + spec.delay_jitter * (2.0 * rng.random() - 1.0))
+        recreate, ready = node_delay_factors(spec, name)
+        recreate *= spec.pod_recreate_delay
+        ready *= spec.pod_ready_delay
         if name in stragglers:
             ready *= spec.straggler_factor
         delays[name] = (recreate, ready)
     cluster.set_per_node_ds_delays(lambda n: delays[n])
+
+
+def node_delay_factors(spec: FleetSpec, name: str) -> tuple[float, float]:
+    """One node's seeded (recreate, ready) delay MULTIPLIERS: uniform
+    jitter composed with a mean-1 lognormal draw per delay. Pure in
+    ``(delay_seed, name)`` — callers (benches, the chaos schedule, a
+    ground-truth oracle checking the predictor) reproduce the exact
+    fleet heterogeneity from the spec alone."""
+    rng = random.Random(f"{spec.delay_seed}:{name}")
+    recreate = 1.0 + spec.delay_jitter * (2.0 * rng.random() - 1.0)
+    ready = 1.0 + spec.delay_jitter * (2.0 * rng.random() - 1.0)
+    if spec.hetero_sigma > 0.0:
+        sigma = spec.hetero_sigma
+        mu = -sigma * sigma / 2.0  # mean-1 lognormal
+        recreate *= rng.lognormvariate(mu, sigma)
+        ready *= rng.lognormvariate(mu, sigma)
+    return recreate, ready
+
+
+def heterogeneous_settle(spec: FleetSpec, names: "list[str]",
+                         base_seconds: float) -> dict[str, float]:
+    """Seeded per-node validation-settle seconds: ``base_seconds``
+    scaled by a mean-1 lognormal draw per node (sigma =
+    ``spec.hetero_sigma``; homogeneous when 0). The third heterogeneous
+    phase next to the DS controller's recreate/ready delays — the
+    planner bench and the maintenance-window chaos soak install it on
+    their settle validators so per-node validation cost is reproducible
+    from the seed alone."""
+    out: dict[str, float] = {}
+    sigma = spec.hetero_sigma
+    mu = -sigma * sigma / 2.0
+    for name in names:
+        rng = random.Random(f"{spec.delay_seed}:settle:{name}")
+        factor = rng.lognormvariate(mu, sigma) if sigma > 0.0 else 1.0
+        out[name] = base_seconds * factor
+    return out
 
 
 def seed_spare_pool(cluster: FakeCluster, spec: FleetSpec, count: int,

@@ -33,6 +33,10 @@ from tpu_operator_libs.upgrade.rollout_guard import (  # noqa: F401
     RolloutDecision,
     RolloutGuard,
 )
+from tpu_operator_libs.upgrade.predictor import (  # noqa: F401
+    PhaseDurationPredictor,
+    PredictiveWavePlanner,
+)
 from tpu_operator_libs.upgrade.state_manager import (  # noqa: F401
     BuildStateError,
     ClusterUpgradeState,

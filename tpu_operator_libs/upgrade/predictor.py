@@ -1,0 +1,556 @@
+"""Cost-aware predictive wave planning: learned per-node phase durations.
+
+"Cost-aware Duration Prediction for Software Upgrades in Datacenters"
+(PAPERS.md) makes the case that per-node duration *prediction* is the
+input makespan optimization actually needs: with a heterogeneous fleet,
+admitting nodes in arbitrary (sorted-name) order lets one straggler
+start in the last wave and pace the whole rollout. This module supplies
+both halves:
+
+- :class:`PhaseDurationPredictor` — online per-node / per-phase duration
+  learning. The upgrade flow decomposes into three observable phases
+  (the same seams the PR 5 nudger wakes on):
+
+  * ``drain``    — cordon committed → workloads evicted
+                   (cordon-required through drain-required),
+  * ``restart``  — runtime pod deleted → new pod Ready
+                   (pod-restart-required),
+  * ``validate`` — validation gate entered → node back in service
+                   (validation-required + uncordon-required).
+
+  Phase entry is stamped as a node annotation riding the SAME merge
+  patch as the state-label commit (crash-atomic), so a restarted
+  operator — or the next shard owner after a takeover — closes the
+  in-flight phase's sample from durable state alone, and the most
+  recent per-phase durations are mirrored into a second annotation the
+  next incarnation seeds its per-node model from. In memory the model
+  is a per-(node, phase) EWMA with a fleet-pooled bucketed histogram as
+  the cold-start fallback (quantiles via the shared
+  ``metrics.quantile_from_buckets`` estimator — bounded memory at 100k
+  nodes, no sample lists).
+
+- :class:`PredictiveWavePlanner` — wraps any inner
+  :class:`~tpu_operator_libs.upgrade.state_manager.UpgradePlanner`
+  (flat, slice-atomic, canary-gated) and composes waves by predicted
+  duration: **longest-processing-time-first** ordering, so the
+  slowest-predicted nodes start in the first wave and never pace an
+  otherwise-finished fleet, while the PR 5 eager refill naturally
+  backfills freed slots with the short-predicted remainder. Ties keep
+  the candidates' input order (a stable sort), so with zero history the
+  plan degrades to exactly the inner planner's flat order — cold start
+  is reference behavior, bit for bit. The wrapper also enforces the
+  ``maintenanceWindow`` policy ("finish by 06:00 or don't start"): a
+  node whose *conservative* predicted completion crosses the window
+  close is deferred — left in upgrade-required, never started and
+  stranded mid-flow at the close — and every plan emits a predicted
+  fleet makespan + per-wave breakdown for ``cluster_status``.
+"""
+
+from __future__ import annotations
+
+import logging
+import threading
+from typing import TYPE_CHECKING, Callable, Optional
+
+from tpu_operator_libs.consts import (
+    IN_PROGRESS_STATES,
+    UpgradeKeys,
+    UpgradeState,
+)
+from tpu_operator_libs.metrics import quantile_from_buckets
+from tpu_operator_libs.util import Clock
+
+if TYPE_CHECKING:  # pragma: no cover - import cycle guard
+    from tpu_operator_libs.k8s.objects import Node
+    from tpu_operator_libs.upgrade.state_manager import (
+        ClusterUpgradeState,
+        NodeUpgradeState,
+        UpgradePlanner,
+    )
+
+logger = logging.getLogger(__name__)
+
+#: The learned phases, in flow order.
+PHASES: tuple[str, ...] = ("drain", "restart", "validate")
+
+#: Upgrade-state label value -> phase it belongs to. States outside the
+#: map (idle, failed, rollback) carry no phase: their dwell time is not
+#: an upgrade cost (failure dwell would poison the model).
+PHASE_OF_STATE: dict[str, str] = {
+    str(UpgradeState.CORDON_REQUIRED): "drain",
+    str(UpgradeState.WAIT_FOR_JOBS_REQUIRED): "drain",
+    str(UpgradeState.POD_DELETION_REQUIRED): "drain",
+    str(UpgradeState.DRAIN_REQUIRED): "drain",
+    str(UpgradeState.POD_RESTART_REQUIRED): "restart",
+    str(UpgradeState.VALIDATION_REQUIRED): "validate",
+    str(UpgradeState.UNCORDON_REQUIRED): "validate",
+}
+
+#: Transitions into these states ABORT the open phase: the elapsed time
+#: includes a failure dwell, so the sample is dropped, not recorded.
+_ABORT_STATES = frozenset((str(UpgradeState.FAILED),
+                           str(UpgradeState.ROLLBACK_REQUIRED)))
+
+#: Pooled-histogram buckets (seconds): per-phase durations ride pod
+#: recreate/ready and validation-settle timescales, seconds to hours.
+PHASE_SECONDS_BUCKETS: tuple[float, ...] = (
+    1.0, 2.5, 5.0, 10.0, 20.0, 30.0, 45.0, 60.0, 90.0, 120.0, 180.0,
+    300.0, 600.0, 1200.0, 1800.0, 3600.0, 7200.0)
+
+
+class _PooledPhase:
+    """Bucketed duration histogram for one phase (bounded memory)."""
+
+    __slots__ = ("counts", "count", "total")
+
+    def __init__(self) -> None:
+        self.counts = [0] * len(PHASE_SECONDS_BUCKETS)
+        self.count = 0
+        self.total = 0.0
+
+    def record(self, seconds: float) -> None:
+        for i, le in enumerate(PHASE_SECONDS_BUCKETS):
+            if seconds <= le:
+                self.counts[i] += 1
+        self.count += 1
+        self.total += seconds
+
+    def quantile(self, q: float) -> Optional[float]:
+        return quantile_from_buckets(PHASE_SECONDS_BUCKETS, self.counts,
+                                     self.count, q)
+
+
+class PhaseDurationPredictor:
+    """Online per-node / per-phase upgrade-duration model.
+
+    Wire :meth:`observe_transition` as the state provider's
+    ``transition_observer``: it is invoked inside the durable-commit
+    seam for every state transition, closes/opens phase samples against
+    the node's durable phase-start stamp, and returns the annotation
+    updates that must ride the transition's merge patch (one wire
+    write, crash-atomic). Everything else is read-side.
+    """
+
+    def __init__(self, keys: Optional[UpgradeKeys] = None,
+                 clock: Optional[Clock] = None,
+                 smoothing: float = 0.5,
+                 prior_seconds: float = 120.0,
+                 conservative_quantile: float = 0.95,
+                 conservative_factor: float = 1.25) -> None:
+        if not 0.0 < smoothing <= 1.0:
+            raise ValueError("smoothing must be in (0, 1]")
+        self.keys = keys or UpgradeKeys()
+        self._clock = clock or Clock()
+        self.smoothing = smoothing
+        #: Per-phase prior when NOTHING is known (cold fleet): the
+        #: window gate treats an unknown node as costing this much per
+        #: phase, which is deliberately conservative.
+        self.prior_seconds = prior_seconds
+        #: Window-gating pessimism: unknown nodes cost the pooled
+        #: ``conservative_quantile``; known nodes cost EWMA x factor.
+        self.conservative_quantile = conservative_quantile
+        self.conservative_factor = conservative_factor
+        # One coarse lock over every model mutation: the observer runs
+        # inside the provider's commit path, which executes on bucket
+        # worker-pool threads and async drain/eviction workers
+        # concurrently — a lost sample or torn EWMA update would be
+        # silent model drift. Prediction reads ride the same lock via
+        # the mutating read-through seed.
+        self._lock = threading.Lock()
+        # per-(node, phase) EWMA seconds
+        self._ewma: dict[str, dict[str, float]] = {}
+        # fleet-pooled per-phase histograms (cold-start fallback)
+        self._pooled: dict[str, _PooledPhase] = {
+            phase: _PooledPhase() for phase in PHASES}
+        #: whole-node forecasts opened at flow entry:
+        #: node -> (t_entry, predicted_total_seconds)
+        self._inflight: dict[str, tuple[float, float]] = {}
+        #: (phase, seconds) samples since the last metrics drain.
+        self._sample_buffer: list[tuple[str, float]] = []
+        #: |predicted - actual| / actual ratios since the last drain.
+        self._error_buffer: list[float] = []
+        #: lifetime accounting
+        self.samples_total = 0
+        self.forecasts_closed_total = 0
+
+    # ------------------------------------------------------------------
+    # learning side (provider transition observer)
+    # ------------------------------------------------------------------
+    def observe_transition(self, node: "Node", old_label: str,
+                           new_label: str,
+                           ) -> "Optional[dict[str, Optional[str]]]":
+        """Close/open phase samples for one durable state transition.
+
+        ``node`` is the LIVE node (pre-patch); returns annotation
+        updates (value None deletes) to merge into the transition's
+        patch, or None when nothing needs stamping.
+        """
+        now = self._clock.now()
+        name = node.metadata.name
+        annotations = node.metadata.annotations
+        stamp_key = self.keys.phase_start_annotation
+        hist_key = self.keys.phase_durations_annotation
+        stamp_phase, stamp_at = _parse_stamp(annotations.get(stamp_key))
+        new_phase = PHASE_OF_STATE.get(new_label)
+        updates: dict[str, Optional[str]] = {}
+
+        if stamp_phase is not None and stamp_phase != new_phase:
+            if new_label not in _ABORT_STATES:
+                seconds = max(0.0, now - stamp_at)
+                self._record_sample(name, stamp_phase, seconds)
+                history = decode_durations(annotations.get(hist_key))
+                history[stamp_phase] = round(seconds, 1)
+                updates[hist_key] = encode_durations(history)
+            else:
+                # failure dwell would poison the model: drop the sample
+                # and the open forecast
+                with self._lock:
+                    self._inflight.pop(name, None)
+
+        if new_phase is None:
+            if stamp_phase is not None or stamp_key in annotations:
+                updates[stamp_key] = None
+            if new_label == str(UpgradeState.DONE):
+                # forecast closes against the whole-node wall clock;
+                # the phase-durations annotation is deliberately KEPT:
+                # it is the per-node model's durable half — the next
+                # operator incarnation (or the next shard owner, or the
+                # NEXT rollout after a crash) predicts this node from
+                # cluster state alone. Benches comparing against a
+                # predictor-less run exclude exactly these two keys
+                # from their fingerprints.
+                self._close_forecast(name, now)
+        elif stamp_phase != new_phase:
+            updates[stamp_key] = f"{new_phase}:{now:.3f}"
+            if stamp_phase is None:
+                # entering the phased flow: open the whole-node forecast
+                predicted = self.predict_node(name, annotations)
+                with self._lock:
+                    self._inflight[name] = (now, predicted)
+        return updates or None
+
+    def _record_sample(self, name: str, phase: str,
+                       seconds: float) -> None:
+        with self._lock:
+            per_node = self._ewma.setdefault(name, {})
+            previous = per_node.get(phase)
+            if previous is None:
+                per_node[phase] = seconds
+            else:
+                a = self.smoothing
+                per_node[phase] = a * seconds + (1.0 - a) * previous
+            self._pooled[phase].record(seconds)
+            self._sample_buffer.append((phase, seconds))
+            self.samples_total += 1
+
+    def _close_forecast(self, name: str, now: float) -> None:
+        with self._lock:
+            opened = self._inflight.pop(name, None)
+            if opened is None:
+                return
+            t0, predicted = opened
+            actual = now - t0
+            if actual > 0.0:
+                self._error_buffer.append(
+                    abs(predicted - actual) / actual)
+                self.forecasts_closed_total += 1
+
+    # ------------------------------------------------------------------
+    # prediction side
+    # ------------------------------------------------------------------
+    def predict_phase(self, name: str, phase: str,
+                      annotations: "Optional[dict[str, str]]" = None,
+                      conservative: bool = False) -> float:
+        """Predicted seconds for one node's phase: per-node EWMA, else
+        the node's durable phase-durations annotation (the takeover /
+        crash-recovery seed), else the fleet pool, else the prior."""
+        with self._lock:
+            per_node = self._ewma.get(name, {}).get(phase)
+            if per_node is None and annotations:
+                durable = decode_durations(annotations.get(
+                    self.keys.phase_durations_annotation))
+                per_node = durable.get(phase)
+                if per_node is not None:
+                    # read-through: the durable seed becomes the
+                    # in-memory model so later passes agree without
+                    # re-parsing
+                    self._ewma.setdefault(name, {})[phase] = per_node
+        if per_node is not None:
+            return per_node * (self.conservative_factor
+                               if conservative else 1.0)
+        pooled = self._pooled[phase]
+        if pooled.count:
+            q = self.conservative_quantile if conservative else 0.5
+            estimate = pooled.quantile(q)
+            if estimate is not None:
+                return estimate
+        return self.prior_seconds
+
+    def predict_node(self, name: str,
+                     annotations: "Optional[dict[str, str]]" = None,
+                     conservative: bool = False) -> float:
+        """Predicted whole-flow seconds for one node (sum of phases)."""
+        return sum(
+            self.predict_phase(name, phase, annotations, conservative)
+            for phase in PHASES)
+
+    def remaining_seconds(self, name: str, state_label: str,
+                          annotations: "Optional[dict[str, str]]" = None,
+                          now: Optional[float] = None) -> float:
+        """Predicted seconds left for an IN-FLIGHT node: the current
+        phase's prediction minus the time already spent in it (from the
+        durable stamp), plus every later phase."""
+        phase = PHASE_OF_STATE.get(state_label)
+        if phase is None:
+            # failed/rollback: no phase clock runs; assume a full pass
+            return self.predict_node(name, annotations)
+        if now is None:
+            now = self._clock.now()
+        index = PHASES.index(phase)
+        remaining = sum(self.predict_phase(name, later, annotations)
+                        for later in PHASES[index + 1:])
+        current = self.predict_phase(name, phase, annotations)
+        elapsed = 0.0
+        if annotations:
+            stamp_phase, stamp_at = _parse_stamp(
+                annotations.get(self.keys.phase_start_annotation))
+            if stamp_phase == phase:
+                elapsed = max(0.0, now - stamp_at)
+        return remaining + max(0.0, current - elapsed)
+
+    # ------------------------------------------------------------------
+    # evidence feed (observe_planner)
+    # ------------------------------------------------------------------
+    def drain_phase_samples(self) -> "list[tuple[str, float]]":
+        """(phase, seconds) samples observed since the last drain."""
+        with self._lock:
+            out, self._sample_buffer = self._sample_buffer, []
+        return out
+
+    def drain_forecast_errors(self) -> "list[float]":
+        """|predicted-actual|/actual ratios closed since the last
+        drain."""
+        with self._lock:
+            out, self._error_buffer = self._error_buffer, []
+        return out
+
+    @property
+    def known_nodes(self) -> int:
+        with self._lock:
+            return len(self._ewma)
+
+    def pooled_stats(self) -> "dict[str, dict]":
+        """Per-phase pooled (count, mean, p50, p95) — the model's own
+        evidence, read through the shared quantile estimator."""
+        out = {}
+        with self._lock:
+            for phase, pooled in self._pooled.items():
+                out[phase] = {
+                    "count": pooled.count,
+                    "mean": (round(pooled.total / pooled.count, 2)
+                             if pooled.count else None),
+                    "p50": (round(pooled.quantile(0.5), 2)
+                            if pooled.count else None),
+                    "p95": (round(pooled.quantile(0.95), 2)
+                            if pooled.count else None),
+                }
+        return out
+
+
+def _parse_stamp(value: Optional[str],
+                 ) -> "tuple[Optional[str], float]":
+    """``<phase>:<epoch>`` -> (phase, epoch); (None, 0.0) when absent or
+    malformed (a garbled stamp reads as "no open phase" — the sample is
+    lost, never invented)."""
+    if not value:
+        return None, 0.0
+    phase, sep, raw = value.partition(":")
+    if not sep or phase not in PHASES:
+        return None, 0.0
+    try:
+        return phase, float(raw)
+    except ValueError:
+        return None, 0.0
+
+
+def decode_durations(value: Optional[str]) -> "dict[str, float]":
+    """``drain=12.5,restart=40`` -> {phase: seconds} (unknown phases and
+    malformed entries are dropped)."""
+    out: dict[str, float] = {}
+    if not value:
+        return out
+    for entry in value.split(","):
+        phase, sep, raw = entry.partition("=")
+        if not sep or phase not in PHASES:
+            continue
+        try:
+            out[phase] = float(raw)
+        except ValueError:
+            continue
+    return out
+
+
+def encode_durations(durations: "dict[str, float]") -> str:
+    return ",".join(f"{phase}={durations[phase]:g}"
+                    for phase in PHASES if phase in durations)
+
+
+class PredictiveWavePlanner:
+    """LPT wave composition + maintenance-window gating over any inner
+    planner.
+
+    Lives on the state manager across passes (like the multislice
+    constraint): the wrapper itself is stateless per plan, but it
+    carries the fleet ETA of the most recent plan for
+    ``cluster_status`` and the lifetime window-deferral counter for
+    metrics. ``audit`` (optional) receives
+    ``(kind, node, at, predicted_done)`` for every ``"admit"`` /
+    ``"defer"`` decision — the chaos monitor's maintenance-window
+    invariant feed.
+    """
+
+    def __init__(self, inner: "UpgradePlanner",
+                 predictor: PhaseDurationPredictor,
+                 clock: Optional[Clock] = None,
+                 window: "Optional[object]" = None,
+                 audit: "Optional[Callable[[str, str, float, float], None]]"
+                 = None) -> None:
+        self.inner = inner
+        self.predictor = predictor
+        self._clock = clock or Clock()
+        #: Optional MaintenanceWindowSpec (api/upgrade_policy.py).
+        self.window = window
+        self.audit = audit
+        #: Status block of the most recent plan (cluster_status feed).
+        self.last_plan: Optional[dict] = None
+        #: Lifetime nodes deferred by the maintenance window.
+        self.deferred_by_window_total = 0
+
+    def _window_close(self, now: float) -> Optional[float]:
+        window = self.window
+        if window is None or not getattr(window, "enable", False):
+            return None
+        resolve = getattr(window, "close_at", None)
+        if resolve is not None:
+            return resolve(now)
+        return None
+
+    def plan(self, candidates: "list[NodeUpgradeState]", available: int,
+             state: "ClusterUpgradeState") -> "list[NodeUpgradeState]":
+        now = self._clock.now()
+        predictions: dict[str, float] = {}
+        for ns in candidates:
+            name = ns.node.metadata.name
+            predictions[name] = self.predictor.predict_node(
+                name, ns.node.metadata.annotations)
+
+        close = self._window_close(now)
+        eligible = list(candidates)
+        deferred: list[str] = []
+        if close is not None:
+            margin = float(getattr(self.window, "margin_seconds", 0) or 0)
+            eligible = []
+            for ns in candidates:
+                name = ns.node.metadata.name
+                bound = self.predictor.predict_node(
+                    name, ns.node.metadata.annotations, conservative=True)
+                if now + bound + margin > close:
+                    # "finish by the close or don't start": the node
+                    # stays in upgrade-required and is reconsidered
+                    # next pass (the model may tighten, or the next
+                    # window may open)
+                    deferred.append(name)
+                    if self.audit is not None:
+                        self.audit("defer", name, now, now + bound)
+                    continue
+                eligible.append(ns)
+            if deferred:
+                self.deferred_by_window_total += len(deferred)
+                logger.info(
+                    "maintenance window (close in %.0fs) deferred %d "
+                    "node(s): predicted completion would cross it",
+                    close - now, len(deferred))
+
+        # LPT: slowest-predicted first. The sort is STABLE and the key
+        # is the prediction alone, so equal predictions (cold start:
+        # everything is the prior) keep the candidates' input order —
+        # zero history degrades to the inner planner's flat order.
+        ordered = sorted(
+            eligible, key=lambda ns: -predictions[ns.node.metadata.name])
+        selected = self.inner.plan(ordered, available, state)
+        if self.audit is not None:
+            for ns in selected:
+                name = ns.node.metadata.name
+                bound = self.predictor.predict_node(
+                    name, ns.node.metadata.annotations, conservative=True)
+                self.audit("admit", name, now, now + bound)
+        self.last_plan = self._eta(state, candidates, predictions, now,
+                                   available, frozenset(deferred), close)
+        return selected
+
+    # ------------------------------------------------------------------
+    # fleet makespan ETA (cluster_status feed)
+    # ------------------------------------------------------------------
+    def _eta(self, state: "ClusterUpgradeState",
+             candidates: "list[NodeUpgradeState]",
+             predictions: "dict[str, float]", now: float, available: int,
+             deferred: "frozenset[str]",
+             close: Optional[float]) -> dict:
+        """Predicted fleet makespan by LPT multiprocessor packing: every
+        in-flight node occupies a slot loaded with its predicted
+        remaining seconds; pending nodes are assigned longest-first to
+        the least-loaded slot. The slot count is the current in-flight
+        window (in-progress + available) — the budget the throttle
+        actually spends."""
+        import heapq
+
+        in_progress: list[float] = []
+        for bucket_state in IN_PROGRESS_STATES:
+            for ns in state.bucket(bucket_state):
+                in_progress.append(self.predictor.remaining_seconds(
+                    ns.node.metadata.name, str(bucket_state),
+                    ns.node.metadata.annotations, now))
+        # Pending work = this plan's candidates plus anything else still
+        # sitting in upgrade-required (e.g. canary-held nodes the inner
+        # planner will filter), minus window-deferred nodes: the ETA
+        # answers "when does the work that MAY run finish" — deferred
+        # nodes are reported separately, not folded into a makespan
+        # they will never join.
+        seen: set[str] = set()
+        pending: list[float] = []
+        for ns in list(candidates) \
+                + list(state.bucket(UpgradeState.UPGRADE_REQUIRED)):
+            name = ns.node.metadata.name
+            if name in seen or name in deferred:
+                continue
+            seen.add(name)
+            pending.append(predictions.get(
+                name, self.predictor.predict_node(
+                    name, ns.node.metadata.annotations)))
+        pending.sort(reverse=True)
+        slots = max(1, len(in_progress) + max(0, available))
+        loads = in_progress + [0.0] * max(0, slots - len(in_progress))
+        heapq.heapify(loads)
+        for job in pending:
+            heapq.heappush(loads, heapq.heappop(loads) + job)
+        makespan = max(loads) if (in_progress or pending) else 0.0
+
+        waves = []
+        for i in range(0, len(pending), slots):
+            chunk = pending[i:i + slots]
+            waves.append({"nodes": len(chunk),
+                          "predictedSeconds": round(chunk[0], 1)})
+        plan: dict = {
+            "predictedMakespanSeconds": round(makespan, 1),
+            "predictedDoneAtSeconds": round(now + makespan, 1),
+            "inProgress": len(in_progress),
+            "pending": len(pending),
+            "slots": slots,
+            "waves": waves,
+            "coldStart": self.predictor.samples_total == 0,
+        }
+        if close is not None:
+            plan["windowCloseSeconds"] = round(close, 1)
+            plan["deferredByWindow"] = len(deferred)
+            plan["fitsWindow"] = bool(now + makespan <= close)
+        return plan

@@ -258,6 +258,18 @@ class ClusterUpgradeStateManager:
         # (topology/multislice.py module docstring).
         self._multislice_constraint: Optional["MultisliceConstraint"] = None
         self._multislice_constraint_is_custom = False
+        # ---- cost-aware predictive planning (upgrade/predictor.py) ----
+        #: Online per-node/per-phase duration model; created on first
+        #: use and kept across passes (its in-memory EWMAs are the
+        #: learned state — the durable half lives on node annotations).
+        self._predictor = None
+        #: Persistent PredictiveWavePlanner wrapper (carries the fleet
+        #: ETA of the most recent plan + window-deferral counters).
+        self._predictive_planner = None
+        #: Optional (kind, node, at, predicted_done) hook for every
+        #: window admit/defer decision — the chaos harness's
+        #: maintenance-window invariant feed.
+        self.window_audit = None
 
         #: DaemonSet inputs of the most recent build (uid -> DS): the
         #: budget-share ledger / oracle discovery surface.
@@ -1217,6 +1229,12 @@ class ClusterUpgradeStateManager:
                 in ns.node.metadata.annotations)
             planner = CanaryWavePlanner(planner, self._rollout.cohort,
                                         passthrough=reserved_spares)
+        # Predictive wrapper OUTERMOST (PredictiveWavePlanner ∘
+        # CanaryWavePlanner ∘ SlicePlanner ∘ FlatPlanner): it reorders
+        # and window-gates the candidate list, while cohort filtering
+        # and every budget/slice admission decision stay with the inner
+        # chain untouched.
+        planner = self._wrap_predictive(policy, planner)
         self.process_upgrade_required_nodes(
             state, upgrades_available, planner=planner)
         self.process_cordon_required_nodes(state)
@@ -1421,6 +1439,64 @@ class ClusterUpgradeStateManager:
     def _clear_multislice_deferrals(self) -> None:
         if self._multislice_constraint is not None:
             self._multislice_constraint.last_deferred = ()
+
+    @property
+    def predictor(self) -> "object":
+        """The persistent :class:`~tpu_operator_libs.upgrade.predictor.
+        PhaseDurationPredictor` (None until a predictive policy ran)."""
+        return self._predictor
+
+    @property
+    def predictive_planner(self) -> "object":
+        """The persistent PredictiveWavePlanner wrapper (None until a
+        predictive policy ran) — its ``last_plan`` is the fleet ETA."""
+        return self._predictive_planner
+
+    def _wrap_predictive(self, policy: UpgradePolicySpec,
+                         inner: UpgradePlanner) -> UpgradePlanner:
+        """Wrap ``inner`` in the predictive LPT/window planner when the
+        policy asks for it; otherwise detach the learning observer and
+        return ``inner`` unchanged (reference semantics, bit for bit —
+        with no observer installed not a single extra annotation is
+        written)."""
+        spec = policy.predictor
+        if spec is None or not spec.enable:
+            if getattr(self.provider, "transition_observer", None) \
+                    is not None:
+                self.provider.transition_observer = None
+            if policy.maintenance_window is not None \
+                    and policy.maintenance_window.enable:
+                logger.warning(
+                    "maintenanceWindow is set but the predictor is "
+                    "disabled: the window gate needs duration "
+                    "estimates; ignoring the window")
+            return inner
+        from tpu_operator_libs.upgrade.predictor import (
+            PhaseDurationPredictor,
+            PredictiveWavePlanner,
+        )
+
+        if self._predictor is None:
+            self._predictor = PhaseDurationPredictor(
+                self.keys, clock=self.clock, smoothing=spec.smoothing,
+                prior_seconds=spec.prior_seconds)
+        else:
+            # the policy is re-read every pass (reference semantics):
+            # knob changes take effect without dropping learned state
+            self._predictor.smoothing = spec.smoothing
+            self._predictor.prior_seconds = spec.prior_seconds
+        if getattr(self.provider, "transition_observer", None) \
+                is not self._predictor.observe_transition:
+            self.provider.transition_observer = \
+                self._predictor.observe_transition
+        if self._predictive_planner is None:
+            self._predictive_planner = PredictiveWavePlanner(
+                inner, self._predictor, clock=self.clock)
+        wrapper = self._predictive_planner
+        wrapper.inner = inner
+        wrapper.window = policy.maintenance_window
+        wrapper.audit = self.window_audit
+        return wrapper
 
     def _multislice_for_policy(
             self, policy: UpgradePolicySpec) -> "MultisliceConstraint":
@@ -2083,6 +2159,15 @@ class ClusterUpgradeStateManager:
             # in-flight window saturation + eager-refill evidence for
             # the most recent pass (why the fleet is / is not pacing)
             status["slots"] = dict(self.last_pass_slots)
+        if self._predictive_planner is not None \
+                and self._predictive_planner.last_plan is not None:
+            # the predictive-planner ETA: learned-duration makespan
+            # forecast, per-wave breakdown, and the maintenance-window
+            # picture of the most recent plan
+            planner_block = dict(self._predictive_planner.last_plan)
+            planner_block["knownNodes"] = self._predictor.known_nodes
+            planner_block["samplesTotal"] = self._predictor.samples_total
+            status["planner"] = planner_block
         if self._shard_view is not None and self.last_shard_status:
             # the sharded-control-plane picture: which shards this
             # replica owns, the fleet-wide per-shard node census, and

@@ -60,6 +60,17 @@ class NodeUpgradeStateProvider:
         # before the patch — so a stale pass's queued transition writes
         # are rejected, never silently applied outside its partition.
         self._fence = fence
+        # Transition-observation seam (upgrade/predictor.py): called
+        # with (live_node, current_label, new_label) inside the commit
+        # path, AFTER the stale-snapshot precondition passed and BEFORE
+        # the patch is issued. Whatever annotation updates it returns
+        # ride the transition's merge patch — one wire write, so the
+        # observer's bookkeeping (phase-start stamps, duration history)
+        # is crash-atomic with the state commit it describes. An
+        # observer failure must never block the transition: it is
+        # logged and the commit proceeds unstamped.
+        self.transition_observer: Optional[Callable[
+            [Node, str, str], "Optional[dict[str, Optional[str]]]"]] = None
         #: Durable node writes issued (each is one wire patch).
         self.writes_total = 0
         #: Wire patches avoided by coalescing a transition's label +
@@ -141,8 +152,24 @@ class NodeUpgradeStateProvider:
                 return False
             if current == value and not ann_patch:
                 # another pass already committed this exact transition
+                # (its own observer stamped it — nothing to observe)
                 self._copy_into(node, live)
                 return True
+            observer = self.transition_observer
+            if observer is not None:
+                try:
+                    extra = observer(live, current, value)
+                except Exception as exc:  # noqa: BLE001 — observation
+                    # must never block the commit
+                    logger.warning(
+                        "transition observer failed for node %s "
+                        "(%r -> %r): %s; committing unstamped",
+                        node.metadata.name, current, value, exc)
+                    extra = None
+                if extra:
+                    for key, extra_value in extra.items():
+                        # explicit caller annotations win on collision
+                        ann_patch.setdefault(key, extra_value)
             self._check_fence(node)
             try:
                 if ann_patch:
