@@ -4,7 +4,7 @@ PYTHON ?= python3
 PYTEST_FLAGS ?= -q
 COV_THRESHOLD ?= 85
 
-.PHONY: all check test test-fast test-fault test-chaos test-soak test-scale test-rollout test-latency test-reconfig test-shard test-planner lint cov bench bench-reconcile bench-latency bench-shard bench-shard-100k bench-planner graft-check package clean diagram
+.PHONY: all check test test-fast test-fault test-chaos test-soak test-scale test-rollout test-latency test-reconfig test-shard test-planner test-budget lint cov bench bench-reconcile bench-latency bench-shard bench-shard-100k bench-planner bench-budget graft-check package clean diagram
 
 all: lint test
 
@@ -162,6 +162,23 @@ test-planner:
 # docs/benchmarks.md §2f). Writes BENCH_planner.json.
 bench-planner:
 	$(PYTHON) tools/planner_bench.py --nodes 256,1024 --out BENCH_planner.json
+
+# Traffic-aware capacity budget slice (`budget` marker): controller
+# units, the safe mid-flight abort arc (incl. operator crash
+# mid-abort), policy/CRD round-trips, the bench smoke, and the
+# 256-node diurnal-replay chaos gate seeds 1-3 (4-10 slow; widen via
+# `pytest -m budget`).
+test-budget:
+	$(PYTHON) -m pytest tests/ $(PYTEST_FLAGS) -m "budget and not slow"
+
+# Traffic-aware budgets vs static maxUnavailable on the diurnal
+# serving replay: peak-safe static (slow, safe) vs aggressive static
+# (fast, breaches the capacity SLO) vs the capacity controller (fast
+# AND safe — zero dropped generations, zero shortfall ticks)
+# (tools/budget_bench.py; docs/traffic-aware-budgets.md). Writes
+# BENCH_budget.json.
+bench-budget:
+	$(PYTHON) tools/budget_bench.py --out BENCH_budget.json
 
 graft-check:
 	$(PYTHON) __graft_entry__.py

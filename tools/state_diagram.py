@@ -86,14 +86,16 @@ UPGRADE_SPEC = DiagramSpec(
     rank={
         UNKNOWN: 0, "upgrade-required": 1, "cordon-required": 2,
         "wait-for-jobs-required": 3, "pod-deletion-required": 4,
-        "drain-required": 5, "pod-restart-required": 6,
-        "validation-required": 7, "rollback-required": 8,
-        "uncordon-required": 9, "upgrade-done": 10,
+        "drain-required": 5, "abort-required": 6,
+        "pod-restart-required": 7, "validation-required": 8,
+        "rollback-required": 9, "uncordon-required": 10,
+        "upgrade-done": 11,
     },
     fail_name="upgrade-failed",
     fail_rank=4.5,
     fill={UNKNOWN: "#f5f5f5", "upgrade-done": "#e3f4e3",
-          "upgrade-failed": "#fbe9e7", "rollback-required": "#fdf3d8"},
+          "upgrade-failed": "#fbe9e7", "rollback-required": "#fdf3d8",
+          "abort-required": "#fdf3d8"},
 )
 
 REMEDIATION_SPEC = DiagramSpec(

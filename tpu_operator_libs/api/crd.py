@@ -320,6 +320,88 @@ def maintenance_window_schema() -> dict[str, Any]:
     }
 
 
+def capacity_budget_schema() -> dict[str, Any]:
+    """CapacityBudgetSpec (beyond-reference: traffic-aware dynamic
+    disruption budgets over live serving load signals, with safe
+    mid-flight abort; docs/traffic-aware-budgets.md)."""
+    return {
+        "type": "object",
+        "description": "Traffic-aware disruption budgets: recompute the "
+                       "effective maxUnavailable every pass from live "
+                       "serving-endpoint load (in-flight generations, "
+                       "QPS EWMA, per-node capacity) — drain "
+                       "aggressively in traffic troughs, pause at "
+                       "peaks, abort mid-flight drains on capacity "
+                       "collapse.",
+        "properties": {
+            "enable": {
+                "type": "boolean",
+                "default": False,
+                "description": "Master switch; when false the static "
+                               "maxUnavailable applies unchanged.",
+            },
+            "sloHeadroomFraction": {
+                "type": "number",
+                "minimum": 0,
+                "default": 0.25,
+                "description": "Required spare-capacity fraction over "
+                               "current demand before a node may be "
+                               "taken unavailable.",
+            },
+            "minEffectiveBudget": {
+                "type": "integer",
+                "minimum": 0,
+                "default": 0,
+                "description": "Floor for the effective budget (nodes); "
+                               "0 lets peaks pause draining entirely.",
+            },
+            "maxEffectiveBudget": {
+                "type": "integer",
+                "minimum": 0,
+                "default": 0,
+                "description": "Ceiling for the effective budget "
+                               "(nodes); 0 = clamped by the static "
+                               "maxUnavailable alone, a positive value "
+                               "lets troughs exceed the static count.",
+            },
+            "peakPauseUtilization": {
+                "type": "number",
+                "exclusiveMinimum": 0,
+                "maximum": 1,
+                "default": 0.85,
+                "description": "Utilization (demand / live capacity) at "
+                               "or above which admission pauses "
+                               "outright.",
+            },
+            "perNodeCapacity": {
+                "type": "integer",
+                "minimum": 1,
+                "default": 8,
+                "description": "Concurrent generations one serving node "
+                               "sustains (default for endpoints that do "
+                               "not declare their own capacity).",
+            },
+            "smoothing": {
+                "type": "number",
+                "exclusiveMinimum": 0,
+                "maximum": 1,
+                "default": 0.3,
+                "description": "EWMA weight of the newest demand/QPS "
+                               "sample.",
+            },
+            "recheckSeconds": {
+                "type": "number",
+                "exclusiveMinimum": 0,
+                "default": 30,
+                "description": "Trough-window cadence: re-evaluation "
+                               "wakeup registered on the deadline timer "
+                               "wheel while the budget is held below "
+                               "the static count.",
+            },
+        },
+    }
+
+
 def wedge_detection_schema() -> dict[str, Any]:
     """WedgeDetectionSpec (api/remediation_policy.py)."""
     return {
@@ -511,6 +593,7 @@ def upgrade_policy_schema() -> dict[str, Any]:
             "sharding": sharding_schema(),
             "predictor": predictor_schema(),
             "maintenanceWindow": maintenance_window_schema(),
+            "capacityBudget": capacity_budget_schema(),
             "topologyMode": {
                 "type": "string",
                 "enum": ["flat", "slice"],

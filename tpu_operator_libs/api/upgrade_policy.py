@@ -373,6 +373,106 @@ class MaintenanceWindowSpec:
 
 
 @dataclass
+class CapacityBudgetSpec:
+    """Traffic-aware dynamic disruption budgets (beyond-reference;
+    upgrade/capacity.py).
+
+    With ``enable`` the operator aggregates live ``ServingEndpoint``
+    load signals (in-flight generations, a QPS EWMA, per-node serving
+    capacity) into fleet headroom and recomputes the EFFECTIVE
+    disruption budget every pass: drain aggressively in traffic
+    troughs, pause admission at peaks, and ABORT mid-flight drains
+    (``abort-required``) when a spike or node loss collapses the
+    budget below what is already unavailable. Without a wired endpoint
+    source (``ClusterUpgradeStateManager.with_serving_signal``) the
+    controller fails open to the static budget exactly — non-serving
+    fleets see reference semantics, bit for bit.
+    """
+
+    # Master switch; when False the static budget applies unchanged.
+    enable: bool = False
+    # Required spare-capacity fraction over current demand: the
+    # controller only leaves nodes drainable while
+    # capacity >= demand * (1 + sloHeadroomFraction).
+    slo_headroom_fraction: float = 0.25
+    # Floor for the effective budget (nodes). 0 = the controller may
+    # pause draining entirely at peaks.
+    min_effective_budget: int = 0
+    # Ceiling for the effective budget (nodes). 0 = clamped by the
+    # static policy ``maxUnavailable`` alone; a positive value lets
+    # traffic troughs exceed the static count (the point of
+    # traffic-awareness: a peak-safe static budget wastes troughs).
+    max_effective_budget: int = 0
+    # Utilization (demand / live capacity) at or above which admission
+    # pauses outright regardless of computed spare nodes.
+    peak_pause_utilization: float = 0.85
+    # Concurrent generations one serving node sustains (the default for
+    # endpoints that do not declare their own ``capacity``).
+    per_node_capacity: int = 8
+    # EWMA weight of the newest demand/QPS sample, in (0, 1].
+    smoothing: float = 0.3
+    # Trough-window cadence: while the controller holds the budget
+    # below the static count it registers a re-evaluation wakeup this
+    # many seconds out on the deadline timer wheel, so the next trough
+    # is caught without waiting out a resync interval.
+    recheck_seconds: float = 30.0
+
+    def validate(self) -> None:
+        if self.slo_headroom_fraction < 0:
+            raise PolicyValidationError(
+                "capacityBudget.sloHeadroomFraction must be >= 0")
+        if self.min_effective_budget < 0:
+            raise PolicyValidationError(
+                "capacityBudget.minEffectiveBudget must be >= 0")
+        if self.max_effective_budget < 0:
+            raise PolicyValidationError(
+                "capacityBudget.maxEffectiveBudget must be >= 0")
+        if self.max_effective_budget \
+                and self.max_effective_budget < self.min_effective_budget:
+            raise PolicyValidationError(
+                "capacityBudget.maxEffectiveBudget must be >= "
+                "minEffectiveBudget")
+        if not 0.0 < self.peak_pause_utilization <= 1.0:
+            raise PolicyValidationError(
+                "capacityBudget.peakPauseUtilization must be in (0, 1]")
+        if self.per_node_capacity < 1:
+            raise PolicyValidationError(
+                "capacityBudget.perNodeCapacity must be >= 1")
+        if not 0.0 < self.smoothing <= 1.0:
+            raise PolicyValidationError(
+                "capacityBudget.smoothing must be in (0, 1]")
+        if self.recheck_seconds <= 0:
+            raise PolicyValidationError(
+                "capacityBudget.recheckSeconds must be > 0")
+
+    def to_dict(self) -> dict[str, Any]:
+        return {"enable": self.enable,
+                "sloHeadroomFraction": self.slo_headroom_fraction,
+                "minEffectiveBudget": self.min_effective_budget,
+                "maxEffectiveBudget": self.max_effective_budget,
+                "peakPauseUtilization": self.peak_pause_utilization,
+                "perNodeCapacity": self.per_node_capacity,
+                "smoothing": self.smoothing,
+                "recheckSeconds": self.recheck_seconds}
+
+    @classmethod
+    def from_dict(cls, data: dict[str, Any]) -> "CapacityBudgetSpec":
+        return cls(enable=data.get("enable", False),
+                   slo_headroom_fraction=data.get(
+                       "sloHeadroomFraction", 0.25),
+                   min_effective_budget=data.get("minEffectiveBudget", 0),
+                   max_effective_budget=data.get("maxEffectiveBudget", 0),
+                   peak_pause_utilization=data.get(
+                       "peakPauseUtilization", 0.85),
+                   per_node_capacity=data.get("perNodeCapacity", 8),
+                   smoothing=data.get("smoothing", 0.3),
+                   recheck_seconds=data.get("recheckSeconds", 30.0))
+
+    def deep_copy(self) -> "CapacityBudgetSpec":
+        return copy.deepcopy(self)
+
+
+@dataclass
 class ShardingPolicySpec:
     """Sharded HA control plane (beyond-reference; k8s/sharding.py).
 
@@ -496,6 +596,10 @@ class UpgradePolicySpec:
     # Beyond-reference: "finish by the close or don't start" gating on
     # predicted completion times. None = no window.
     maintenance_window: Optional[MaintenanceWindowSpec] = None
+    # Beyond-reference: traffic-aware dynamic disruption budgets over
+    # live serving-endpoint load signals, with safe mid-flight abort.
+    # None = the static maxUnavailable applies unchanged.
+    capacity: Optional[CapacityBudgetSpec] = None
 
     def validate(self) -> None:
         if self.max_parallel_upgrades < 0:
@@ -522,7 +626,8 @@ class UpgradePolicySpec:
                     f"nodeSelector is not a valid label selector: {exc}")
         for sub in (self.pod_deletion, self.wait_for_completion, self.drain,
                     self.canary, self.rollback, self.sharding,
-                    self.predictor, self.maintenance_window):
+                    self.predictor, self.maintenance_window,
+                    self.capacity):
             if sub is not None:
                 sub.validate()
 
@@ -552,6 +657,8 @@ class UpgradePolicySpec:
             out["predictor"] = self.predictor.to_dict()
         if self.maintenance_window is not None:
             out["maintenanceWindow"] = self.maintenance_window.to_dict()
+        if self.capacity is not None:
+            out["capacityBudget"] = self.capacity.to_dict()
         return out
 
     @classmethod
@@ -583,6 +690,9 @@ class UpgradePolicySpec:
         if data.get("maintenanceWindow") is not None:
             spec.maintenance_window = MaintenanceWindowSpec.from_dict(
                 data["maintenanceWindow"])
+        if data.get("capacityBudget") is not None:
+            spec.capacity = CapacityBudgetSpec.from_dict(
+                data["capacityBudget"])
         return spec
 
     def deep_copy(self) -> "UpgradePolicySpec":

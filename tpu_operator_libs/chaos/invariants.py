@@ -47,6 +47,7 @@ from tpu_operator_libs.api.upgrade_policy import (
 )
 from tpu_operator_libs.chaos.injector import consume_transient
 from tpu_operator_libs.consts import (
+    ABORTABLE_STATES,
     GKE_NODEPOOL_LABEL,
     IN_PROGRESS_STATES,
     LEGAL_EDGES,
@@ -164,6 +165,38 @@ class WindowExpectation:
 
 
 @dataclass(frozen=True)
+class CapacityExpectation:
+    """Arms the traffic-aware capacity-budget invariants.
+
+    ``static_equivalent`` is the peak-safe STATIC budget a
+    non-traffic-aware operator would have had to configure for the
+    episode's worst observed demand (derived from the trace — see
+    ``chaos/serving.DiurnalTrace.peak_utilization``). The budget soak
+    runner feeds per-tick load/controller samples through
+    :meth:`InvariantMonitor.capacity_sample`; the monitor asserts:
+
+    - **capacity-slo**: at no tick does the offered load exceed what
+      the admitting endpoints can place (``shortfall`` stays 0) — the
+      controller left enough live capacity under every drain wave,
+      spike and node kill;
+    - **capacity-modulation** (:meth:`InvariantMonitor.final_check`):
+      the effective budget was observed BOTH above and below the
+      static equivalent during the episode — a controller that never
+      crosses the static line in either direction is just a
+      differently-spelled constant.
+
+    The abort-residue check rides the always-on edge monitoring: every
+    observed ``abort-required -> upgrade-required`` commit must leave
+    the node schedulable (unless pre-cordoned) with no phase/wait/
+    validation stamp — the patch is crash-atomic, so the event object
+    itself must already be clean.
+    """
+
+    static_equivalent: int
+    require_modulation: bool = True
+
+
+@dataclass(frozen=True)
 class ShardExpectation:
     """Arms the sharded-control-plane invariants.
 
@@ -244,6 +277,8 @@ class InvariantMonitor:
     shard: Optional[ShardExpectation] = None
     #: Arms the maintenance-window invariants; None disables them.
     window: Optional[WindowExpectation] = None
+    #: Arms the capacity-budget invariants; None disables them.
+    capacity: Optional[CapacityExpectation] = None
 
     violations: list[InvariantViolation] = field(default_factory=list)
     trace: list[str] = field(default_factory=list)
@@ -295,6 +330,19 @@ class InvariantMonitor:
         #: lifetime admit/defer decisions recorded (teeth evidence).
         self.window_admissions = 0
         self.window_deferrals = 0
+        #: nodes observed in a DRAIN-PHASE state at the first event at
+        #: or past the window close (None until the close is crossed):
+        #: each must end the episode finished or ABORTED — never
+        #: stranded mid-flight (the abort-not-strand extension).
+        self._mid_drain_at_close: "Optional[set[str]]" = None
+        # -- capacity-budget bookkeeping --
+        #: abort-required -> upgrade-required commits observed (the
+        #: abort arc's teeth evidence; residue-checked per event).
+        self.aborts_observed = 0
+        #: min/max effective budget seen via capacity_sample.
+        self.capacity_effective_min: Optional[int] = None
+        self.capacity_effective_max: Optional[int] = None
+        self.capacity_samples = 0
         self._watch = self.cluster.watch(max_queue=self.watch_queue_bound)
         self.resync("initial sync")
 
@@ -382,6 +430,21 @@ class InvariantMonitor:
         Call between mutation batches (the runner does, after each
         reconcile and each virtual-clock step)."""
         processed = 0
+        if self.window is not None and self._mid_drain_at_close is None \
+                and self._now() >= self.window.close_seconds:
+            # the close just passed: snapshot every node still in a
+            # drain-phase state — each must finish or ABORT by episode
+            # end, never strand (checked in final_check)
+            drain_phase = frozenset(str(s) for s in ABORTABLE_STATES)
+            self._mid_drain_at_close = {
+                name for name, mirror in self._nodes.items()
+                if mirror.upgrade_state in drain_phase}
+            if self._mid_drain_at_close:
+                self._record(
+                    f"window close crossed with "
+                    f"{len(self._mid_drain_at_close)} node(s) still "
+                    f"mid-drain: {sorted(self._mid_drain_at_close)} — "
+                    f"each must abort or finish, never strand")
         while True:
             if self._watch.stopped:
                 # the watch-break fault closed our stream: resubscribe
@@ -458,6 +521,7 @@ class InvariantMonitor:
                          f"{old.upgrade_state or 'unknown'} -> "
                          f"{new.upgrade_state or 'unknown'}")
             self._check_upgrade_edge(name, old, new)
+            self._check_abort_residue(name, old, new, node)
             self._track_rollout_verdict(name, new)
         if old.remediation_state != new.remediation_state:
             self._record(f"node {name} remediation "
@@ -649,6 +713,68 @@ class InvariantMonitor:
                 f"+ {live_committed} live committed-to-cordon > budget "
                 f"{budget} (maxUnavailable="
                 f"{self.remediation_max_unavailable!r}, total={total})")
+
+    # -- mid-flight abort invariants --------------------------------------
+    def _check_abort_residue(self, name: str, old: _NodeMirror,
+                             new: _NodeMirror, node: "object") -> None:
+        """An observed ``abort-required -> upgrade-required`` commit
+        must already be residue-free AT THE EVENT INSTANT: the abort's
+        annotation deletions ride the same merge patch as the label,
+        and the uncordon precedes it — so a dirty event means the
+        crash-atomicity claim is false, not merely that cleanup is
+        late. Always armed (the edge only exists when the abort arc
+        ran)."""
+        if old.upgrade_state != str(UpgradeState.ABORT_REQUIRED) \
+                or new.upgrade_state != str(UpgradeState.UPGRADE_REQUIRED):
+            return
+        self.aborts_observed += 1
+        keys = self.upgrade_keys
+        annotations = node.metadata.annotations
+        residue = sorted(
+            key for key in (keys.phase_start_annotation,
+                            keys.pod_completion_start_annotation,
+                            keys.validation_start_annotation)
+            if key in annotations)
+        if residue:
+            self._violate(
+                "abort-residue", name,
+                f"abort committed back to upgrade-required with "
+                f"bookkeeping still stamped: {residue}")
+        if new.unschedulable \
+                and keys.initial_state_annotation not in annotations:
+            self._violate(
+                "abort-residue", name,
+                "abort committed back to upgrade-required with the "
+                "node still cordoned (and no pre-upgrade cordon "
+                "memory) — the uncordon was skipped")
+
+    # -- capacity-budget invariants ---------------------------------------
+    def capacity_sample(self, load: dict,
+                        status: Optional[dict]) -> None:
+        """One replay tick's load/controller sample (budget soak runner
+        hook): ``load`` from ``ServingFleetSim.tick``, ``status`` the
+        CapacityBudgetController's ``last_status`` (None before its
+        first evaluation). The SLO check is strict — a single tick of
+        unplaced offered load is a breach."""
+        if self.capacity is None:
+            return
+        self.capacity_samples += 1
+        if load.get("shortfall", 0) > 0:
+            self._violate(
+                "capacity-slo", "fleet",
+                f"offered load {load['target']} exceeded admitting "
+                f"capacity {load['admittingCapacity']} by "
+                f"{load['shortfall']} generation(s) at t="
+                f"{load['now']:g} — the effective budget left too "
+                f"little live capacity")
+        if status is not None:
+            eff = status["effectiveBudget"]
+            self.capacity_effective_min = (
+                eff if self.capacity_effective_min is None
+                else min(self.capacity_effective_min, eff))
+            self.capacity_effective_max = (
+                eff if self.capacity_effective_max is None
+                else max(self.capacity_effective_max, eff))
 
     # -- maintenance-window invariants ------------------------------------
     def window_decision(self, kind: str, node: str, at: float,
@@ -874,6 +1000,36 @@ class InvariantMonitor:
                         f"episode — it should have finished before the "
                         f"close t={self.window.close_seconds:g} or "
                         f"never have started")
+            # abort-not-strand: a node the close overtook MID-DRAIN
+            # must have been aborted back to upgrade-required (zero
+            # residue, checked on the edge) or have finished — the PR 9
+            # admission gate bounded the start, the abort arc bounds
+            # the prediction-error stragglers
+            done = str(UpgradeState.DONE)
+            required = str(UpgradeState.UPGRADE_REQUIRED)
+            for name in sorted(self._mid_drain_at_close or ()):
+                mirror = self._nodes.get(name)
+                state = mirror.upgrade_state if mirror else "gone"
+                if state not in (done, required):
+                    self._violate(
+                        "window-stranded", name,
+                        f"node was mid-drain at the window close and "
+                        f"ended the episode in {state!r} — it was "
+                        f"neither aborted back to upgrade-required "
+                        f"nor finished")
+        if self.capacity is not None \
+                and self.capacity.require_modulation:
+            static_eq = self.capacity.static_equivalent
+            if self.capacity_effective_max is None \
+                    or self.capacity_effective_max <= static_eq \
+                    or self.capacity_effective_min >= static_eq:
+                self._violate(
+                    "capacity-modulation", "fleet",
+                    f"effective budget range "
+                    f"[{self.capacity_effective_min}, "
+                    f"{self.capacity_effective_max}] never crossed the "
+                    f"peak-safe static equivalent {static_eq} in both "
+                    f"directions — the controller did not modulate")
         nodes = consume_transient(self.cluster.list_nodes)
         for node in nodes:
             name = node.metadata.name

@@ -41,6 +41,7 @@ from tpu_operator_libs.api.remediation_policy import (
     RemediationPolicySpec,
 )
 from tpu_operator_libs.api.upgrade_policy import (
+    CapacityBudgetSpec,
     DrainSpec,
     IntOrString,
     MaintenanceWindowSpec,
@@ -54,6 +55,7 @@ from tpu_operator_libs.chaos.injector import (
     OperatorCrash,
 )
 from tpu_operator_libs.chaos.invariants import (
+    CapacityExpectation,
     InvariantMonitor,
     InvariantViolation,
     ReconfigExpectation,
@@ -61,7 +63,16 @@ from tpu_operator_libs.chaos.invariants import (
     ShardExpectation,
     WindowExpectation,
 )
-from tpu_operator_libs.chaos.schedule import FaultSchedule
+from tpu_operator_libs.chaos.schedule import (
+    FAULT_TRAFFIC_SPIKE,
+    FaultSchedule,
+)
+from tpu_operator_libs.chaos.serving import (
+    CapacityLog,
+    DiurnalTrace,
+    ServingFleetSim,
+    SpikeWindow,
+)
 from tpu_operator_libs.consts import (
     GKE_NODEPOOL_LABEL,
     IN_PROGRESS_STATES,
@@ -150,7 +161,12 @@ class ChaosConfig:
             # seam must hold every invariant under compound faults and
             # crash-restarts (each incarnation relearns from the
             # durable stamps alone).
-            predictor=PredictorSpec(enable=True))
+            predictor=PredictorSpec(enable=True),
+            # The capacity budget controller runs LIVE too: with no
+            # serving signal wired it must fail open to the static
+            # budget EXACTLY — the standing gates pin that under
+            # compound faults (the budget soak is where it modulates).
+            capacity=CapacityBudgetSpec(enable=True))
 
     def remediation_policy(self) -> RemediationPolicySpec:
         policy = RemediationPolicySpec(
@@ -216,7 +232,8 @@ class _OperatorIncarnation:
     def __init__(self, cluster: FakeCluster, clock: FakeClock,
                  keys: UpgradeKeys, rem_keys: RemediationKeys,
                  config: ChaosConfig, injector: ChaosInjector,
-                 identity: str, with_reconfigurer: bool = False) -> None:
+                 identity: str, with_reconfigurer: bool = False,
+                 serving: "Optional[ServingFleetSim]" = None) -> None:
         # The event-driven scheduling layer runs INSIDE the gate: both
         # machines carry a live ReconcileNudger (completion nudges +
         # deadline timer wheel + eager slot refill all active), exactly
@@ -237,6 +254,20 @@ class _OperatorIncarnation:
             provider=provider, poll_interval=1.0, sync_timeout=5.0,
             parallel_workers=config.parallel_workers,
             nudger=self.nudger)
+        if serving is not None:
+            # the budget gate's serving fleet: the drain gate guards
+            # every eviction against in-flight generations, and the
+            # capacity controller reads the same endpoints as its
+            # budget signal. Both die with the incarnation — the
+            # controller re-derives its picture from the live
+            # endpoints on its first pass (the crash-resume claim).
+            from tpu_operator_libs.health.serving_gate import (
+                ServingDrainGate,
+            )
+
+            self.upgrade.with_eviction_gate(
+                ServingDrainGate(serving.resolver))
+            self.upgrade.with_serving_signal(serving.source)
         rem_provider = CrashingStateProvider(
             cluster, rem_keys, None, clock,  # type: ignore[arg-type]
             sync_timeout=5.0, poll_interval=1.0, fuse=injector.fuse)
@@ -711,7 +742,8 @@ class ReconfigChaosConfig(ChaosConfig):
             topology_mode="slice",
             max_unavailable_slices_per_job=1,
             drain=DrainSpec(enable=True, force=True,
-                            timeout_seconds=300))
+                            timeout_seconds=300),
+            capacity=CapacityBudgetSpec(enable=True))
 
 
 def _restore_workload_pods_by_pool(cluster: FakeCluster,
@@ -1754,6 +1786,343 @@ def run_window_soak(seed: int,
             invariant="harness", at=clock.now(), subject="injector",
             detail="no operator crash fired — the schedule's crash "
                    "events never detonated"))
+
+    report = ChaosReport(
+        seed=seed,
+        converged=is_converged,
+        violations=list(monitor.violations),
+        fault_kinds=tuple(sorted(schedule.kinds)),
+        crashes_fired=injector.crashes_fired,
+        leader_handovers=handovers,
+        operator_incarnations=incarnations,
+        watch_gaps=monitor.watch_gaps,
+        total_seconds=clock.now(),
+        steps=steps,
+        reconciles=reconciles,
+        trace=list(monitor.trace))
+    report.report_text = "\n".join(
+        [schedule.describe(), monitor.report(seed=seed)])
+    if not report.ok:
+        logger.error("%s", report.report_text)
+    return report
+
+
+@dataclass
+class BudgetChaosConfig(ChaosConfig):
+    """Knobs of one traffic-aware-budget (diurnal replay) episode.
+
+    The fleet SERVES throughout: one decode endpoint per node replaying
+    a seeded diurnal QPS curve (chaos/serving.DiurnalTrace) while the
+    whole fleet rolls to a new revision. The static policy is the 25%
+    count a non-traffic-aware operator would ship; the capacity
+    controller may raise the effective budget to ``max_effective``
+    nodes in troughs and must shrink/pause/ABORT at peaks, spikes and
+    node kills — with zero operator-caused dropped generations and
+    zero capacity-SLO shortfall ticks.
+    """
+
+    #: 64 slices x 4 hosts = the 256-node acceptance fleet.
+    n_slices: int = 64
+    hosts_per_slice: int = 4
+    #: Serving pods restart fast (decode images are warm); the drain
+    #: phase — waiting out in-flight generations — dominates.
+    pod_recreate_delay: float = 5.0
+    pod_ready_delay: float = 10.0
+    horizon: float = 700.0
+    max_steps: int = 400
+    #: Static policy budget (the non-traffic-aware equivalent).
+    max_unavailable: IntOrString = "25%"
+    #: Trough ceiling for the effective budget, as a fleet fraction —
+    #: deliberately ABOVE the static 25% (the modulation proof needs
+    #: the controller observed on both sides of the static line).
+    max_effective_fraction: float = 0.4
+    slo_headroom_fraction: float = 0.5
+    peak_pause_utilization: float = 0.7
+    per_node_capacity: int = 8
+    #: Diurnal curve: utilization oscillates trough..peak over the
+    #: period; spikes multiply it inside their windows.
+    diurnal_period: float = 400.0
+    trough_util: float = 0.12
+    peak_util: float = 0.45
+    generation_seconds: tuple = (15.0, 45.0)
+
+    @property
+    def total_nodes(self) -> int:
+        return self.n_slices * self.hosts_per_slice
+
+    def upgrade_policy(self) -> UpgradePolicySpec:
+        return UpgradePolicySpec(
+            auto_upgrade=True,
+            max_parallel_upgrades=0,
+            max_unavailable=self.max_unavailable,
+            topology_mode="flat",
+            drain=DrainSpec(enable=True, force=True,
+                            timeout_seconds=300),
+            predictor=PredictorSpec(enable=True),
+            capacity=CapacityBudgetSpec(
+                enable=True,
+                slo_headroom_fraction=self.slo_headroom_fraction,
+                max_effective_budget=int(
+                    self.total_nodes * self.max_effective_fraction),
+                peak_pause_utilization=self.peak_pause_utilization,
+                per_node_capacity=self.per_node_capacity))
+
+
+def budget_static_equivalent(config: BudgetChaosConfig,
+                             trace: DiurnalTrace) -> int:
+    """The peak-safe STATIC budget for this episode's trace: the node
+    count an operator could leave unavailable at the WORST observed
+    demand while keeping the SLO headroom — what a non-traffic-aware
+    config would have to ship (and hold through every trough)."""
+    import math
+
+    peak = trace.peak_utilization(config.horizon)
+    required = math.ceil(peak * (1.0 + config.slo_headroom_fraction)
+                         * config.total_nodes)
+    return max(0, config.total_nodes - required)
+
+
+def run_budget_soak(seed: int,
+                    config: Optional[BudgetChaosConfig] = None,
+                    ) -> ChaosReport:
+    """The traffic-aware disruption-budget gate: a serving fleet is
+    upgraded end-to-end under a replayed diurnal load with traffic
+    spikes, transient node kills and operator crash-restarts.
+
+    What the episode proves, via the monitor's invariants plus the
+    convergence check:
+
+    - **capacity-slo**: at no tick did the offered load exceed what the
+      admitting endpoints could place — the effective budget always
+      left enough live capacity, through every drain wave, spike and
+      kill (and zero generations were dropped by the operator: every
+      eviction went through a quiesced serving gate);
+    - **capacity-modulation**: the effective budget was observed both
+      ABOVE the peak-safe static equivalent (troughs drained harder
+      than any safe static count could) and BELOW it (peaks paused);
+    - **abort arc**: at least one mid-flight abort fired (spike/kill
+      collapsing the budget below current unavailability), and every
+      observed ``abort-required -> upgrade-required`` commit was
+      residue-free at the event instant (``abort-residue``);
+    - plus the standing legal-transition / max-unavailable (armed at
+      the effective ceiling) / cordon-pairing invariants, and full
+      convergence: every node upgrade-done on the new revision with
+      every endpoint admitting.
+
+    Deterministic in ``seed``.
+    """
+    config = config or BudgetChaosConfig()
+    fleet = FleetSpec(
+        n_slices=config.n_slices,
+        hosts_per_slice=config.hosts_per_slice,
+        pod_recreate_delay=config.pod_recreate_delay,
+        pod_ready_delay=config.pod_ready_delay)
+    cluster, clock, keys = build_fleet(fleet)
+    rem_keys = RemediationKeys()
+    node_names = [n.metadata.name for n in cluster.list_nodes()]
+
+    schedule = FaultSchedule.generate_budget(
+        seed, node_names, horizon=config.horizon)
+    injector = ChaosInjector(cluster, schedule,
+                             lease_namespace=config.lease_namespace,
+                             lease_name=config.lease_name)
+    injector.install()
+    # rollout #2 mid-horizon, exactly like the main soak: guarantees
+    # write traffic after every armed crash (an armed-but-unfired
+    # crash would block convergence forever), and lands the second
+    # rollout's drain waves on the trace's later spikes
+    cluster.schedule_at(
+        config.horizon / 2.0,
+        lambda: cluster.bump_daemon_set_revision(NS, "libtpu",
+                                                 FINAL_REVISION))
+    # traffic spikes are harness-side faults (the injector has no
+    # traffic to inflate): fold them into the diurnal trace
+    spikes = tuple(SpikeWindow(at=e.at, until=e.until,
+                               factor=e.param / 10.0,
+                               ramp_seconds=60.0)
+                   for e in schedule.by_kind(FAULT_TRAFFIC_SPIKE))
+    trace = DiurnalTrace(seed=seed,
+                         period_seconds=config.diurnal_period,
+                         trough_util=config.trough_util,
+                         peak_util=config.peak_util,
+                         spikes=spikes)
+    serving = ServingFleetSim(
+        cluster, node_names, trace,
+        per_node_capacity=config.per_node_capacity,
+        generation_seconds=config.generation_seconds, seed=seed)
+
+    upgrade_policy = config.upgrade_policy()
+    remediation_policy = config.remediation_policy()
+    # disabled for the episode (the bad-revision gate's rationale): a
+    # transiently dead decode host must be attributed to the capacity
+    # controller's reaction, not the remediation ladder — their
+    # interplay is the main soak's job
+    remediation_policy.enable = False
+    # the modulation reference: the STATIC policy budget scaled against
+    # the fleet — the count a non-traffic-aware config ships. The
+    # effective budget must be observed above it (troughs) AND below
+    # it (peaks/spikes); the trace-derived peak-safe bound is reported
+    # alongside for context (it reaches 0 on big-spike seeds, where a
+    # static config simply could not serve the episode at all).
+    from tpu_operator_libs.api.upgrade_policy import (
+        scaled_value_from_int_or_percent,
+    )
+
+    static_eq = scaled_value_from_int_or_percent(
+        upgrade_policy.max_unavailable, config.total_nodes,
+        round_up=True)
+    monitor = InvariantMonitor(
+        cluster=cluster, upgrade_keys=keys, remediation_keys=rem_keys,
+        # the over-disruption bound is the CEILING the controller may
+        # reach in troughs, not the (lower) static policy count
+        max_unavailable=upgrade_policy.capacity.max_effective_budget,
+        remediation_max_unavailable=None,
+        max_parallel_upgrades=config.max_parallel_upgrades,
+        capacity=CapacityExpectation(static_equivalent=static_eq))
+    capacity_log = CapacityLog()
+
+    incarnations = 1
+    handovers = 0
+    reconciles = 0
+    op = _OperatorIncarnation(cluster, clock, keys, rem_keys, config,
+                              injector, identity="operator-1",
+                              serving=serving)
+
+    def next_incarnation(reason: str) -> _OperatorIncarnation:
+        nonlocal incarnations
+        incarnations += 1
+        injector.fuse.reset()
+        monitor.trace.append(
+            f"[t={clock.now():g}] operator restart #{incarnations} "
+            f"({reason}) — rebuilding managers from cluster state alone")
+        return _OperatorIncarnation(
+            cluster, clock, keys, rem_keys, config, injector,
+            identity=f"operator-{incarnations}", serving=serving)
+
+    def converged() -> bool:
+        try:
+            nodes = cluster.list_nodes()
+            pods = cluster.list_pods(namespace=NS)
+        except (ApiServerError, TimeoutError):
+            return False
+        if len(nodes) != len(node_names):
+            return False
+        for node in nodes:
+            labels = node.metadata.labels
+            if labels.get(keys.state_label) != str(UpgradeState.DONE):
+                return False
+            if node.is_unschedulable() or not node.is_ready():
+                return False
+        runtime = [p for p in pods if p.controller_owner() is not None]
+        if len(runtime) != len(node_names):
+            return False
+        if not all(
+                p.metadata.labels.get(POD_CONTROLLER_REVISION_HASH_LABEL)
+                == FINAL_REVISION and p.is_ready() for p in runtime):
+            return False
+        # the serving fleet must be whole again: every node's endpoint
+        # live and admitting
+        return (len(serving.endpoints) == len(node_names)
+                and not any(ep.draining
+                            for ep in serving.endpoints.values()))
+
+    steps = 0
+    is_converged = False
+    quiesce_ticks = 0
+    # prime the replay BEFORE the first reconcile: the controller's
+    # first evaluation must see live traffic, not the empty pre-start
+    # fleet (an idle first glance would over-admit at a peak start)
+    serving.tick(clock.now())
+    monitor.drain()
+    while steps < config.max_steps:
+        steps += 1
+        now = clock.now()
+        was_leading = op.elector.is_leader
+        op.elector.try_acquire_or_renew()
+        if was_leading and not op.elector.is_leader:
+            handovers += 1
+            op = next_incarnation("leader election lost")
+            op.elector.try_acquire_or_renew()
+        if op.elector.is_leader:
+            injector.arm_due_crashes(now)
+            op.nudger.pop_due(now)
+            op.nudger.consume_pending()
+            try:
+                op.remediation.reconcile(NS, dict(RUNTIME_LABELS),
+                                         remediation_policy)
+                op.upgrade.reconcile(NS, dict(RUNTIME_LABELS),
+                                     upgrade_policy)
+                reconciles += 1
+            except OperatorCrash:
+                op = next_incarnation("operator crash mid-reconcile")
+            except BuildStateError:
+                pass
+            except (ApiServerError, ConflictError, NotFoundError):
+                pass
+            if injector.fuse.pending:
+                op = next_incarnation("operator crash (surfaced late)")
+        monitor.drain()
+        # the serving replay: finish due generations, reconcile the
+        # endpoints with pod/node reality, admit toward the trace
+        load = serving.tick(now)
+        controller = op.upgrade.capacity_controller
+        status = (controller.last_status
+                  if controller is not None else None)
+        monitor.capacity_sample(load, status)
+        capacity_log.record(load, status)
+        monitor.drain()
+        if (now > schedule.last_fault_time
+                and not injector.fuse.armed
+                and not injector.fuse.pending
+                and converged()):
+            quiesce_ticks += 1
+            if quiesce_ticks >= 3:
+                is_converged = True
+                break
+        else:
+            quiesce_ticks = 0
+        clock.advance(config.reconcile_interval)
+        cluster.step()
+        monitor.drain()
+
+    if is_converged:
+        monitor.final_check()
+    else:
+        monitor.violations.append(InvariantViolation(
+            invariant="liveness", at=clock.now(), subject="fleet",
+            detail=f"serving fleet did not converge within "
+                   f"{config.max_steps} steps ({clock.now():g}s "
+                   f"virtual) after the last fault healed at "
+                   f"{schedule.last_fault_time:g}s"))
+
+    # the gate's unit of loss: zero generations dropped by the OPERATOR
+    # (fault-killed hosts' losses are the schedule's, accounted apart)
+    if serving.operator_dropped:
+        monitor.violations.append(InvariantViolation(
+            invariant="capacity-drop", at=clock.now(), subject="fleet",
+            detail=f"{serving.operator_dropped} generation(s) dropped "
+                   f"by upgrade evictions — the serving gate was "
+                   f"bypassed or mis-sequenced"))
+    # harness sanity: the episode must have exercised what it gates
+    if injector.crashes_fired == 0:
+        monitor.violations.append(InvariantViolation(
+            invariant="harness", at=clock.now(), subject="injector",
+            detail="no operator crash fired — the schedule's crash "
+                   "events never detonated"))
+    if monitor.aborts_observed == 0:
+        monitor.violations.append(InvariantViolation(
+            invariant="harness", at=clock.now(), subject="monitor",
+            detail="no mid-flight abort observed — the spikes/kills "
+                   "never collapsed the budget below current "
+                   "unavailability, so the abort arc proved nothing"))
+    monitor.trace.append(
+        f"[t={clock.now():g}] capacity: effective budget range "
+        f"[{monitor.capacity_effective_min}, "
+        f"{monitor.capacity_effective_max}] vs static policy budget "
+        f"{static_eq} (trace peak-safe bound "
+        f"{budget_static_equivalent(config, trace)}); "
+        f"{monitor.aborts_observed} abort(s); serving "
+        f"{serving.summary()}")
 
     report = ChaosReport(
         seed=seed,

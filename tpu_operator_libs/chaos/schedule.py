@@ -59,6 +59,16 @@ FAULT_BAD_REVISION = "bad-revision"
 #: (spare remap or documented degraded admission), which is exactly
 #: what the reconfiguration soak gate proves.
 FAULT_NODE_KILL = "node-kill"
+#: Replayed traffic spike: the diurnal serving trace's utilization is
+#: multiplied by ``param / 10`` inside ``[at, until)`` (ramped at the
+#: edges — see chaos/serving.SpikeWindow). A HARNESS-side fault like
+#: replica-kill: the injector has no traffic to inflate, so the budget
+#: soak runner folds these events into its DiurnalTrace. Recovery is
+#: the system's job — the CapacityBudgetController must shrink/pause
+#: the effective budget and, when the spike collapses it below what is
+#: already unavailable, abort mid-flight drains (abort-required)
+#: instead of breaching the capacity SLO.
+FAULT_TRAFFIC_SPIKE = "traffic-spike"
 #: One operator REPLICA of the sharded control plane dies without
 #: releasing its Leases (SIGKILL'd pod): ``target`` is the replica's
 #: member-slot index, ``until`` the virtual time its replacement pod
@@ -254,6 +264,66 @@ class FaultSchedule:
                         param=rng.randint(1, 3)))
                 else:
                     events.append(FaultEvent(at=start, kind=kind))
+        events.sort(key=lambda e: (e.at, e.kind, e.target))
+        return cls(seed=seed, events=tuple(events))
+
+    @classmethod
+    def generate_budget(cls, seed: int, node_names: "list[str]",
+                        horizon: float = 700.0,
+                        extra_kinds: int = 2) -> "FaultSchedule":
+        """Schedule for the traffic-aware budget gate: 1-2 traffic
+        spikes (harness-folded into the diurnal trace) landing while
+        the rollout's drain waves are active, 1-2 transient node kills
+        (NotReady windows — the host dies and its replacement arrives
+        at the window end, collapsing serving capacity meanwhile), at
+        least one operator crash inside the durable-write path, and
+        ``extra_kinds`` control-plane fault kinds riding along. The
+        healing node-fault pool (crashloop) is excluded for the
+        bad-revision gate's reason: a crash-looping decode host would
+        be indistinguishable from a capacity decision, and this gate
+        proves budget modulation + the abort arc, not fault
+        compounding (the main soak's job)."""
+        if not node_names:
+            raise ValueError("node_names must be non-empty")
+        rng = random.Random(f"chaos-budget:{seed}")
+        nodes = sorted(node_names)
+        events: list[FaultEvent] = []
+        # spikes land in the first 60% of the horizon, while drain
+        # waves are guaranteed active — a spike over an idle fleet
+        # proves nothing about aborts
+        for _ in range(rng.randint(1, 2)):
+            start = rng.uniform(horizon * 0.1, horizon * 0.6)
+            events.append(FaultEvent(
+                at=start, kind=FAULT_TRAFFIC_SPIKE,
+                until=start + rng.uniform(60.0, 150.0),
+                # param = utilization multiplier x10 (1.6x - 2.2x)
+                param=rng.randint(16, 22)))
+        # transient node kills: dead host, replacement at `until`
+        for victim in rng.sample(nodes, rng.randint(1, 2)):
+            start = rng.uniform(horizon * 0.1, horizon * 0.5)
+            events.append(FaultEvent(
+                at=start, kind=FAULT_NOT_READY_FLAP, target=victim,
+                until=start + rng.uniform(120.0, 240.0)))
+        for _ in range(rng.randint(1, 2)):
+            events.append(FaultEvent(
+                at=rng.uniform(0.1, horizon * 0.45),
+                kind=FAULT_OPERATOR_CRASH,
+                param=rng.randint(0, 8)))
+        pool = [FAULT_API_BURST, FAULT_WATCH_BREAK, FAULT_STALE_READS,
+                FAULT_LEADER_LOSS]
+        for kind in rng.sample(pool, min(extra_kinds, len(pool))):
+            start = rng.uniform(0.1, horizon * 0.7)
+            if kind == FAULT_API_BURST:
+                events.append(FaultEvent(
+                    at=start, kind=kind,
+                    target=rng.choice(API_BURST_OPERATIONS),
+                    param=rng.randint(1, 3)))
+            elif kind == FAULT_STALE_READS:
+                events.append(FaultEvent(
+                    at=start, kind=kind, target=rng.choice(nodes),
+                    param=rng.randint(1, 3)))
+            else:
+                events.append(FaultEvent(at=start, kind=kind))
         events.sort(key=lambda e: (e.at, e.kind, e.target))
         return cls(seed=seed, events=tuple(events))
 

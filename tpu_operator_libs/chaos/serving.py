@@ -1,0 +1,339 @@
+"""Replayed diurnal serving traffic over a simulated fleet.
+
+The traffic-aware budget gate (``runner.run_budget_soak``) and the
+capacity bench (``tools/budget_bench.py``) share this harness: one
+:class:`~tpu_operator_libs.health.serving_gate.ServingEndpoint` per
+fleet node — the exact seam ``examples/llama_serving_job.DecodeServer``
+fronts its fused decode with — driven by a seeded diurnal QPS curve
+with spike windows. Requests begin/finish on the virtual clock, so the
+whole replay is deterministic in its seed, and the unit-of-loss
+accounting is the serving gate's own: a generation is DROPPED only when
+its endpoint is killed mid-flight, and the harness attributes every
+drop to either the fault schedule (node kill) or the operator
+(mis-sequenced eviction — the count the gate drives to zero).
+"""
+
+from __future__ import annotations
+
+import heapq
+import math
+import random
+from dataclasses import dataclass, field
+from typing import Optional
+
+from tpu_operator_libs.health.serving_gate import ServingEndpoint
+from tpu_operator_libs.k8s.objects import (
+    ContainerStatus,
+    ObjectMeta,
+    Pod,
+    PodPhase,
+    PodSpec,
+    PodStatus,
+)
+
+#: Namespace + label of the decode serving pods the drain evicts.
+SERVING_NS = "workloads"
+SERVING_LABELS = {"app": "decode"}
+
+
+@dataclass(frozen=True)
+class SpikeWindow:
+    """One traffic spike: utilization multiplied by ``factor`` inside
+    ``[at, until)``, ramping linearly over ``ramp_seconds`` on both
+    edges (real spikes have seconds of ramp; an instantaneous step
+    would measure the schedule, not the controller's reaction)."""
+
+    at: float
+    until: float
+    factor: float
+    ramp_seconds: float = 30.0
+
+    def multiplier(self, now: float) -> float:
+        if now < self.at or now >= self.until:
+            return 1.0
+        rise = min(1.0, (now - self.at) / max(1e-9, self.ramp_seconds))
+        fall = min(1.0, (self.until - now) / max(1e-9,
+                                                 self.ramp_seconds))
+        return 1.0 + (self.factor - 1.0) * min(rise, fall)
+
+
+@dataclass
+class DiurnalTrace:
+    """Seeded diurnal target-utilization curve.
+
+    ``utilization(now)`` is the fraction of TOTAL fleet capacity the
+    replayed users want in flight: a sinusoid between ``trough_util``
+    and ``peak_util`` over ``period_seconds``, times any active spike
+    multipliers, plus small seeded noise — pure in ``(seed, knobs)``,
+    so two runs of the same seed offer byte-identical load.
+    """
+
+    seed: int = 0
+    period_seconds: float = 400.0
+    trough_util: float = 0.12
+    peak_util: float = 0.55
+    noise: float = 0.02
+    spikes: tuple[SpikeWindow, ...] = ()
+    #: Phase offset so t=0 starts mid-descent toward the first trough
+    #: (the rollout's first waves land in favorable traffic, like a
+    #: real operator timing its rollout start).
+    phase: float = 0.25
+
+    def utilization(self, now: float) -> float:
+        mid = (self.peak_util + self.trough_util) / 2.0
+        amp = (self.peak_util - self.trough_util) / 2.0
+        base = mid + amp * math.sin(
+            2.0 * math.pi * (now / self.period_seconds + self.phase))
+        if self.noise:
+            rng = random.Random(f"diurnal:{self.seed}:{round(now, 3)}")
+            base += self.noise * (2.0 * rng.random() - 1.0)
+        for spike in self.spikes:
+            base *= spike.multiplier(now)
+        return max(0.0, base)
+
+    def peak_utilization(self, horizon: float,
+                         step: float = 5.0) -> float:
+        """Worst-case sampled utilization over ``[0, horizon]`` — the
+        number a peak-safe STATIC budget has to be provisioned for
+        (the bench's and the gate's static-equivalent)."""
+        worst = 0.0
+        t = 0.0
+        while t <= horizon:
+            worst = max(worst, self.utilization(t))
+            t += step
+        return worst
+
+
+class ServingFleetSim:
+    """One decode endpoint per fleet node, replaying a DiurnalTrace.
+
+    Call :meth:`tick` once per harness tick (after ``cluster.step``):
+    it completes due generations, reconciles endpoints with the
+    cluster's pod/node reality (evictions, node kills, recoveries) and
+    admits new generations toward the trace's target. All entropy
+    comes from ``seed``.
+    """
+
+    def __init__(self, cluster: "object", node_names: "list[str]",
+                 trace: DiurnalTrace, per_node_capacity: int = 8,
+                 generation_seconds: tuple[float, float] = (15.0, 45.0),
+                 seed: int = 0) -> None:
+        self.cluster = cluster
+        self.node_names = sorted(node_names)
+        self.trace = trace
+        self.per_node_capacity = per_node_capacity
+        self.generation_seconds = generation_seconds
+        self._rng = random.Random(f"serving:{seed}")
+        #: node -> live endpoint (dead/evicted ones move to retired).
+        self.endpoints: dict[str, ServingEndpoint] = {}
+        self.retired: list[ServingEndpoint] = []
+        #: kill epoch per live endpoint object (guards scheduled
+        #: finishes from completing a generation of a killed epoch).
+        self._epochs: dict[int, int] = {}
+        #: (finish_at, seq, endpoint, epoch) min-heap.
+        self._inflight: list = []
+        self._seq = 0
+        self.parked = 0
+        #: generations the fleet could not place at their arrival tick
+        #: (offered load exceeded admitting capacity) — the operational
+        #: SLO-shortfall count.
+        self.unserved = 0
+        #: drop attribution: fault = node kill, operator = eviction of
+        #: a non-quiesced endpoint (the gate drives this to ZERO).
+        self.fault_dropped = 0
+        self.operator_dropped = 0
+        for name in self.node_names:
+            self._create_endpoint(name)
+
+    # ------------------------------------------------------------------
+    # wiring into the operator
+    # ------------------------------------------------------------------
+    def source(self) -> "dict[str, list[ServingEndpoint]]":
+        """The CapacityBudgetController's endpoint source."""
+        return {name: [ep] for name, ep in self.endpoints.items()}
+
+    def resolver(self, node: "object",
+                 pods: "list[Pod]") -> "list[ServingEndpoint]":
+        """ServingDrainGate resolver: the node's live endpoint,
+        regardless of which pods the eviction set lists (the decode pod
+        is node-local)."""
+        ep = self.endpoints.get(node.metadata.name)
+        return [ep] if ep is not None else []
+
+    # ------------------------------------------------------------------
+    # bookkeeping
+    # ------------------------------------------------------------------
+    def pod_name(self, node: str) -> str:
+        return f"decode-{node}"
+
+    def _create_endpoint(self, node: str) -> None:
+        self.cluster.add_pod(Pod(
+            metadata=ObjectMeta(name=self.pod_name(node),
+                                namespace=SERVING_NS,
+                                labels=dict(SERVING_LABELS)),
+            spec=PodSpec(node_name=node),
+            status=PodStatus(
+                phase=PodPhase.RUNNING,
+                container_statuses=[
+                    ContainerStatus(name="decode", ready=True)])))
+        ep = ServingEndpoint(self.pod_name(node),
+                             capacity=self.per_node_capacity)
+        self.endpoints[node] = ep
+        self._epochs[id(ep)] = 0
+
+    def _retire(self, node: str, ep: ServingEndpoint,
+                fault: bool) -> None:
+        dropped = ep.kill()
+        if fault:
+            self.fault_dropped += dropped
+        else:
+            self.operator_dropped += dropped
+        self._epochs[id(ep)] = self._epochs.get(id(ep), 0) + 1
+        self.retired.append(ep)
+        if self.endpoints.get(node) is ep:
+            del self.endpoints[node]
+
+    # ------------------------------------------------------------------
+    # the replay loop
+    # ------------------------------------------------------------------
+    def sync_with_cluster(self) -> None:
+        """Reconcile endpoints with pod/node reality: evicted pods kill
+        their endpoint (gate-sequenced evictions find it quiesced —
+        zero drops), dead nodes kill theirs (fault drops), recovered
+        schedulable+ready nodes get a fresh pod + endpoint (the serving
+        controller rescheduling its replica)."""
+        from tpu_operator_libs.chaos.injector import consume_transient
+
+        alive = {p.metadata.name for p in consume_transient(
+            lambda: self.cluster.list_pods(namespace=SERVING_NS))}
+        nodes = {n.metadata.name: n for n in consume_transient(
+            self.cluster.list_nodes)}
+        for node, ep in list(self.endpoints.items()):
+            host = nodes.get(node)
+            if host is not None and not host.is_ready():
+                # node kill: the serving pod dies with its host —
+                # in-flight generations are the FAULT's losses
+                self._retire(node, ep, fault=True)
+            elif ep.name not in alive:
+                # evicted by the upgrade flow: the gate must have
+                # waited out quiescence, so kill() finds zero in flight
+                self._retire(node, ep, fault=False)
+        for node in self.node_names:
+            if node in self.endpoints:
+                continue
+            host = nodes.get(node)
+            if host is None or host.is_unschedulable() \
+                    or not host.is_ready():
+                continue
+            if self.pod_name(node) in alive:
+                # pod object survived (node recovered without an
+                # eviction): replace the killed endpoint in place
+                ep = ServingEndpoint(self.pod_name(node),
+                                     capacity=self.per_node_capacity)
+                self.endpoints[node] = ep
+                self._epochs[id(ep)] = 0
+            else:
+                self._create_endpoint(node)
+
+    def total_in_flight(self) -> int:
+        return sum(ep.in_flight for ep in self.endpoints.values())
+
+    def admitting_capacity(self) -> int:
+        """Generations the fleet can currently ACCEPT new work toward
+        (admitting endpoints only) — the live-capacity side of the
+        SLO check."""
+        return sum(self.per_node_capacity
+                   for ep in self.endpoints.values() if not ep.draining)
+
+    def target_in_flight(self, now: float) -> int:
+        fleet_capacity = len(self.node_names) * self.per_node_capacity
+        return int(round(self.trace.utilization(now) * fleet_capacity))
+
+    def tick(self, now: float) -> dict:
+        """One replay step; returns the tick's load sample (the
+        monitor's capacity-SLO feed)."""
+        # 1. finish due generations (kill-epoch guarded)
+        while self._inflight and self._inflight[0][0] <= now:
+            _, _, ep, epoch = heapq.heappop(self._inflight)
+            if self._epochs.get(id(ep)) == epoch and ep.in_flight > 0:
+                ep.finish()
+        # 2. reconcile with the cluster (evictions, kills, recoveries)
+        self.sync_with_cluster()
+        # 3. admit toward the trace's target, round-robin over nodes
+        target = self.target_in_flight(now)
+        lo, hi = self.generation_seconds
+        admitting = [ep for _, ep in sorted(self.endpoints.items())
+                     if not ep.draining]
+        shortfall = 0
+        while self.total_in_flight() < target:
+            candidates = [ep for ep in admitting
+                          if ep.in_flight < self.per_node_capacity]
+            if not candidates:
+                shortfall = target - self.total_in_flight()
+                break
+            # least-loaded first: the router spreads load evenly
+            ep = min(candidates, key=lambda e: (e.in_flight, e.name))
+            if not ep.try_begin():
+                self.parked += 1
+                admitting.remove(ep)
+                continue
+            duration = self._rng.uniform(lo, hi)
+            self._seq += 1
+            heapq.heappush(self._inflight,
+                           (now + duration, self._seq, ep,
+                            self._epochs[id(ep)]))
+        self.unserved += shortfall
+        return {
+            "now": now,
+            "target": target,
+            "inFlight": self.total_in_flight(),
+            "admittingCapacity": self.admitting_capacity(),
+            "shortfall": shortfall,
+        }
+
+    # ------------------------------------------------------------------
+    # accounting
+    # ------------------------------------------------------------------
+    @property
+    def completed(self) -> int:
+        return (sum(ep.completed for ep in self.endpoints.values())
+                + sum(ep.completed for ep in self.retired))
+
+    @property
+    def dropped(self) -> int:
+        return self.fault_dropped + self.operator_dropped
+
+    def summary(self) -> dict:
+        return {
+            "completed": self.completed,
+            "operatorDropped": self.operator_dropped,
+            "faultDropped": self.fault_dropped,
+            "parked": self.parked,
+            "unserved": self.unserved,
+        }
+
+
+@dataclass
+class CapacityLog:
+    """Per-tick effective-budget/SLO evidence accumulated by a replay
+    (the modulation-proof side of the gate and the bench)."""
+
+    samples: list[dict] = field(default_factory=list)
+    effective_min: Optional[int] = None
+    effective_max: Optional[int] = None
+    slo_breach_ticks: int = 0
+
+    def record(self, load: dict, status: Optional[dict]) -> None:
+        sample = dict(load)
+        if status is not None:
+            sample["effectiveBudget"] = status["effectiveBudget"]
+            sample["staticBudget"] = status["staticBudget"]
+            sample["paused"] = status["paused"]
+            eff = status["effectiveBudget"]
+            self.effective_min = (eff if self.effective_min is None
+                                  else min(self.effective_min, eff))
+            self.effective_max = (eff if self.effective_max is None
+                                  else max(self.effective_max, eff))
+        if load["shortfall"] > 0:
+            self.slo_breach_ticks += 1
+        self.samples.append(sample)

@@ -73,6 +73,20 @@ class UpgradeState(str, enum.Enum):
     # quarantined the node's current revision (beyond-reference: the
     # reference has no notion of "the new revision itself is bad").
     ROLLBACK_REQUIRED = "rollback-required"
+    # Safe mid-flight abort (beyond-reference): the fleet can no longer
+    # afford this node's disruption — serving capacity collapsed under
+    # it (traffic spike, concurrent node kills shrinking the effective
+    # disruption budget) or the maintenance window is about to close on
+    # a predicted overrun. Entered only from the DRAIN-PHASE states
+    # (cordon / wait-for-jobs / pod-deletion / drain), where the node's
+    # runtime is still intact; past pod restart the cheapest path back
+    # to capacity is finishing. The abort halts eviction (the label
+    # flip fails any in-flight worker's optimistic commit), releases
+    # the serving-gate drain so its endpoints admit again, uncordons,
+    # and returns the node to upgrade-required with zero cordon/stamp
+    # residue — crash-ordered so an operator dying mid-abort resumes it
+    # from this label alone.
+    ABORT_REQUIRED = "abort-required"
 
     def __str__(self) -> str:  # label values are plain strings
         return self.value
@@ -91,6 +105,18 @@ IN_PROGRESS_STATES = (
     UpgradeState.UNCORDON_REQUIRED,
     UpgradeState.FAILED,
     UpgradeState.ROLLBACK_REQUIRED,
+    UpgradeState.ABORT_REQUIRED,
+)
+
+#: The drain-phase states a mid-flight abort may interrupt: the node is
+#: cordoned (or about to be) but its runtime pod has NOT been restarted
+#: yet, so returning it to service costs one uncordon — nothing was
+#: torn down. Past pod restart an abort would be slower than finishing.
+ABORTABLE_STATES = (
+    UpgradeState.CORDON_REQUIRED,
+    UpgradeState.WAIT_FOR_JOBS_REQUIRED,
+    UpgradeState.POD_DELETION_REQUIRED,
+    UpgradeState.DRAIN_REQUIRED,
 )
 
 #: Every state bucket, in the fixed order ApplyState processes them
@@ -103,6 +129,7 @@ ALL_STATES = (
     UpgradeState.WAIT_FOR_JOBS_REQUIRED,
     UpgradeState.POD_DELETION_REQUIRED,
     UpgradeState.DRAIN_REQUIRED,
+    UpgradeState.ABORT_REQUIRED,
     UpgradeState.POD_RESTART_REQUIRED,
     UpgradeState.FAILED,
     UpgradeState.ROLLBACK_REQUIRED,
@@ -176,6 +203,16 @@ STATE_EDGES: tuple[tuple[UpgradeState, UpgradeState, str], ...] = (
      "upgrade)"),
     (UpgradeState.ROLLBACK_REQUIRED, UpgradeState.FAILED,
      "rollback pod crash-looping (>10 restarts)"),
+    (UpgradeState.CORDON_REQUIRED, UpgradeState.ABORT_REQUIRED,
+     "capacity collapse | maintenance-window close (abort, don't strand)"),
+    (UpgradeState.WAIT_FOR_JOBS_REQUIRED, UpgradeState.ABORT_REQUIRED,
+     "capacity collapse | maintenance-window close (abort, don't strand)"),
+    (UpgradeState.POD_DELETION_REQUIRED, UpgradeState.ABORT_REQUIRED,
+     "capacity collapse | maintenance-window close (abort, don't strand)"),
+    (UpgradeState.DRAIN_REQUIRED, UpgradeState.ABORT_REQUIRED,
+     "capacity collapse | maintenance-window close (abort, don't strand)"),
+    (UpgradeState.ABORT_REQUIRED, UpgradeState.UPGRADE_REQUIRED,
+     "eviction halted, serving gate released, uncordoned — zero residue"),
 )
 
 #: Adjacency view of STATE_EDGES, keyed by label value ("" = unknown).

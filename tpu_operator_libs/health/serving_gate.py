@@ -47,8 +47,15 @@ class ServingEndpoint:
     thread while request handlers begin/finish generations concurrently.
     """
 
-    def __init__(self, name: str) -> None:
+    def __init__(self, name: str,
+                 capacity: "int | None" = None) -> None:
         self.name = name
+        #: Concurrent generations this endpoint sustains — the per-node
+        #: capacity signal the traffic-aware budget controller
+        #: (upgrade/capacity.py) aggregates into fleet headroom. None =
+        #: the controller's policy default (capacityBudget.
+        #: perNodeCapacity) applies.
+        self.capacity = capacity
         self._lock = threading.Lock()
         self._draining = False
         self._in_flight = 0

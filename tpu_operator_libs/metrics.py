@@ -950,6 +950,85 @@ def observe_client_health(registry: MetricsRegistry,
             "Correlated events dropped on sink-queue overflow", labels)
 
 
+#: Buckets for completed mid-flight-abort durations (seconds): an abort
+#: is one uncordon + one label commit, so it rides reconcile-tick
+#: timescales — seconds to a few minutes when retries intervene.
+ABORT_SECONDS_BUCKETS = (1.0, 2.5, 5.0, 10.0, 20.0, 30.0, 60.0, 120.0,
+                         300.0, 600.0)
+
+
+def observe_capacity(registry: MetricsRegistry,
+                     manager: "ClusterUpgradeStateManager",
+                     driver: str = "libtpu") -> None:
+    """Export the traffic-aware capacity budget controller's evidence.
+
+    No-op until a capacity-enabled policy has run with a wired serving
+    signal. Three families:
+
+    - headroom gauges — live demand, serving capacity, the headroom
+      between them, and the EFFECTIVE disruption budget next to the
+      static one (the pair whose divergence IS the feature working);
+    - safety counters — mid-flight aborts by trigger (capacity
+      collapse vs maintenance-window close), SLO-breach ticks (staying
+      at 0 across an upgrade is the controller's guarantee), and
+      peak-pause passes;
+    - ``capacity_abort_seconds`` — histogram of abort-required entry →
+      upgrade-required commit durations, drained from the controller's
+      buffer.
+    """
+    controller = getattr(manager, "capacity_controller", None)
+    if controller is None:
+        return
+    labels = {"driver": driver}
+    status = controller.last_status
+    if status is not None:
+        registry.set_gauge(
+            "capacity_demand_generations", status["demand"],
+            "Smoothed in-flight serving demand (generations)", labels)
+        registry.set_gauge(
+            "capacity_available_generations",
+            status["capacityAvailable"],
+            "Live serving capacity over admitting endpoints "
+            "(generations)", labels)
+        registry.set_gauge(
+            "capacity_headroom_generations", status["headroom"],
+            "Live capacity minus demand — the margin the effective "
+            "budget spends", labels)
+        registry.set_gauge(
+            "capacity_effective_budget", status["effectiveBudget"],
+            "Effective disruption budget this pass (nodes)", labels)
+        registry.set_gauge(
+            "capacity_static_budget", status["staticBudget"],
+            "Static policy budget the effective one modulates (nodes)",
+            labels)
+        registry.set_gauge(
+            "capacity_paused", 1.0 if status["paused"] else 0.0,
+            "1 while peak utilization pauses admission outright",
+            labels)
+    registry.set_counter_total(
+        "capacity_aborts_total", controller.aborts_total,
+        "Mid-flight aborts triggered by capacity collapse",
+        {**labels, "trigger": "capacity"})
+    registry.set_counter_total(
+        "capacity_aborts_total", controller.window_aborts_total,
+        "Mid-flight aborts triggered by capacity collapse",
+        {**labels, "trigger": "window"})
+    registry.set_counter_total(
+        "capacity_slo_breach_ticks_total",
+        controller.slo_breach_ticks_total,
+        "Evaluations that found live capacity below demand (0 is the "
+        "controller's guarantee)", labels)
+    registry.set_counter_total(
+        "capacity_pause_passes_total", controller.pause_passes_total,
+        "Passes admission was paused at peak utilization", labels)
+    for seconds in controller.drain_abort_durations():
+        registry.observe_histogram(
+            "capacity_abort_seconds", seconds,
+            "Mid-flight abort duration (abort-required entry to "
+            "upgrade-required commit)", labels,
+            buckets=ABORT_SECONDS_BUCKETS)
+
+
 def observe_serving_endpoints(registry: MetricsRegistry,
                               endpoints: "Iterable[object]",
                               driver: str = "libtpu",

@@ -1,0 +1,315 @@
+"""CapacityBudgetController: traffic-aware dynamic disruption budgets.
+
+``maxUnavailable`` is a static count, but a serving fleet's real
+constraint is capacity headroom: how many decode nodes can be out of
+service RIGHT NOW without the remaining ones failing to absorb live
+traffic. The Ironwood retrospective (PAPERS.md) frames fleet resilience
+as continuously routing work *around* disruption rather than pausing
+it, and the upgrade-duration-prediction line of work shows admission
+must react to live conditions, not a fixed plan. This module is the
+admission-side half of that:
+
+- Every reconcile pass the controller samples the fleet's
+  :class:`~tpu_operator_libs.health.serving_gate.ServingEndpoint`
+  signals — in-flight generations, a QPS EWMA derived from completed
+  counters, per-node capacity — and recomputes the **effective**
+  disruption budget: the node count that may be unavailable while
+  ``live capacity >= demand * (1 + sloHeadroomFraction)`` still holds.
+- Traffic troughs raise the effective budget (up to
+  ``maxEffectiveBudget``, which may deliberately EXCEED the static
+  ``maxUnavailable`` — a peak-safe static count wastes every trough);
+  peaks shrink it, and utilization past ``peakPauseUtilization``
+  pauses admission outright.
+- While the budget is held below the static count, a re-evaluation
+  wakeup rides the PR 5 :class:`~tpu_operator_libs.upgrade.nudger.
+  DeadlineTimerWheel` (``capacity-trough`` source), so the next trough
+  is caught at ``recheckSeconds`` cadence instead of a resync poll.
+- When the budget COLLAPSES below what is already unavailable (traffic
+  spike, concurrent node kills), the state manager pairs this with the
+  safe mid-flight abort arc: drain-phase nodes move to
+  ``abort-required`` and return to service (see
+  ``state_manager.process_abort_required_nodes``).
+
+The controller holds no durable state: every signal is re-derived from
+the live endpoints each pass, so an operator crash-restart (or a shard
+takeover) resumes with at most one pass of EWMA warm-up — and its
+first-pass demand estimate is the instantaneous in-flight count, which
+is the conservative side. Without a wired endpoint source it fails
+open to the static budget exactly (non-serving fleets keep reference
+semantics, bit for bit).
+
+Composition with the sharded control plane (PR 7/8): the effective
+budget replaces the GLOBAL ``B`` fed into ``split_budget`` — the
+per-shard share ledger, the decrease-now/increase-next-pass spend rule
+and the global clamp all operate on the capacity-derived number, so
+shards jointly respect the traffic picture the same way they jointly
+respect the static one. Every replica must therefore read the same
+fleet-level endpoint source (docs/traffic-aware-budgets.md).
+"""
+
+from __future__ import annotations
+
+import logging
+import math
+import threading
+from typing import TYPE_CHECKING, Callable, Optional, Sequence
+
+from tpu_operator_libs.util import Clock
+
+if TYPE_CHECKING:  # pragma: no cover - types only
+    from tpu_operator_libs.api.upgrade_policy import CapacityBudgetSpec
+    from tpu_operator_libs.upgrade.nudger import ReconcileNudger
+
+logger = logging.getLogger(__name__)
+
+#: node name -> that node's serving endpoints (ServingEndpoint-shaped:
+#: ``in_flight``, ``completed``, ``draining``, optional ``capacity``).
+#: Deployment-specific, like the serving gate's EndpointResolver — a
+#: fleet registry, a label-driven lookup, etc.
+EndpointSource = Callable[[], "Mapping[str, Sequence[object]]"]
+
+
+class CapacityBudgetController:
+    """Recomputes the effective disruption budget from live load.
+
+    One instance per state manager, kept across passes (its EWMAs are
+    the only in-memory state, and they are advisory — safety never
+    depends on them because the instantaneous in-flight count always
+    wins on the demand side).
+    """
+
+    def __init__(self, spec: "CapacityBudgetSpec",
+                 source: Optional[EndpointSource] = None,
+                 clock: Optional[Clock] = None,
+                 nudger: Optional["ReconcileNudger"] = None) -> None:
+        self.spec = spec
+        self._source = source
+        self._clock = clock or Clock()
+        self.nudger = nudger
+        self._lock = threading.Lock()
+        # demand EWMA (generations) and QPS EWMA (completions/second)
+        self._demand_ewma: Optional[float] = None
+        self._qps_ewma: Optional[float] = None
+        self._last_completed: Optional[int] = None
+        self._last_sample_at: Optional[float] = None
+        #: Status block of the most recent evaluation
+        #: (cluster_status["capacity"] feed). None until the first
+        #: pass with the controller enabled.
+        self.last_status: Optional[dict] = None
+        #: Lifetime counters (metrics.observe_capacity feed).
+        self.aborts_total = 0
+        self.window_aborts_total = 0
+        self.slo_breach_ticks_total = 0
+        self.pause_passes_total = 0
+        #: Seconds each completed abort took (abort-required entry ->
+        #: upgrade-required commit), buffered until the next metrics
+        #: drain. Best-effort in-memory: an abort resumed by a fresh
+        #: incarnation completes correctly but its duration sample is
+        #: lost with the process that started it.
+        self._abort_seconds: list[float] = []
+        self._abort_started: dict[str, float] = {}
+        #: True while the effective budget is CONTRACTING (this pass's
+        #: value below the previous pass's): the admission-side
+        #: hysteresis signal. Admitting into a falling budget is churn
+        #: by construction — the node would be aborted a pass later as
+        #: the spike keeps ramping — so the state manager freezes NEW
+        #: admissions while this holds (aborts still trim the excess).
+        self.budget_falling = False
+        self._last_effective: Optional[int] = None
+
+    # ------------------------------------------------------------------
+    # wiring
+    # ------------------------------------------------------------------
+    def set_source(self, source: Optional[EndpointSource]) -> None:
+        self._source = source
+
+    @property
+    def has_signal(self) -> bool:
+        """True when an endpoint source is wired AND currently reports
+        at least one endpoint — the condition under which the
+        controller modulates at all."""
+        if self._source is None:
+            return False
+        status = self.last_status
+        return bool(status and status.get("servingNodes"))
+
+    # ------------------------------------------------------------------
+    # the per-pass evaluation
+    # ------------------------------------------------------------------
+    def effective_budget(self, static_budget: int,
+                         now: Optional[float] = None) -> int:
+        """One evaluation: sample the endpoints, update the EWMAs, and
+        return the effective disruption budget for this pass.
+
+        ``static_budget`` is the policy ``maxUnavailable`` already
+        scaled against the fleet (or, under sharding, the global ``B``
+        about to be split). With no endpoint signal it is returned
+        unchanged — fail-open to static.
+        """
+        spec = self.spec
+        if now is None:
+            now = self._clock.now()
+        endpoints = self._sample()
+        if endpoints is None:
+            self.last_status = None
+            self.budget_falling = False
+            self._last_effective = None
+            return static_budget
+
+        per_node_default = spec.per_node_capacity
+        serving_nodes = 0
+        available_nodes = 0
+        in_flight = 0
+        completed = 0
+        capacity_available = 0
+        capacity_total = 0
+        for _, eps in endpoints:
+            if not eps:
+                continue
+            serving_nodes += 1
+            node_capacity = 0
+            admitting = False
+            for ep in eps:
+                declared = getattr(ep, "capacity", None)
+                node_capacity += (declared if declared
+                                  else per_node_default)
+                in_flight += ep.in_flight
+                completed += ep.completed
+                if not ep.draining:
+                    admitting = True
+            capacity_total += node_capacity
+            if admitting:
+                available_nodes += 1
+                capacity_available += node_capacity
+        if serving_nodes == 0:
+            # a wired source with nothing behind it (fleet warming up):
+            # same fail-open as no source at all
+            self.last_status = None
+            self.budget_falling = False
+            self._last_effective = None
+            return static_budget
+
+        with self._lock:
+            a = spec.smoothing
+            if self._demand_ewma is None:
+                self._demand_ewma = float(in_flight)
+            else:
+                self._demand_ewma = (a * in_flight
+                                     + (1.0 - a) * self._demand_ewma)
+            if (self._last_completed is not None
+                    and self._last_sample_at is not None
+                    and now > self._last_sample_at):
+                qps = max(0, completed - self._last_completed) \
+                    / (now - self._last_sample_at)
+                self._qps_ewma = (qps if self._qps_ewma is None
+                                  else a * qps + (1.0 - a) * self._qps_ewma)
+            self._last_completed = completed
+            self._last_sample_at = now
+            demand_ewma = self._demand_ewma
+            qps_ewma = self._qps_ewma
+
+        # The instantaneous count always wins on the way UP: a spike
+        # must shrink the budget on the very pass it appears, while the
+        # EWMA smooths the way DOWN so one quiet tick does not open the
+        # floodgates.
+        demand = max(float(in_flight), demand_ewma)
+        per_node = capacity_total / serving_nodes
+        required_nodes = math.ceil(
+            demand * (1.0 + spec.slo_headroom_fraction)
+            / max(1.0, per_node))
+        spare = serving_nodes - required_nodes
+        utilization = (demand / capacity_available
+                       if capacity_available > 0 else float("inf"))
+        slo_breached = capacity_available < demand
+        if slo_breached:
+            self.slo_breach_ticks_total += 1
+
+        ceiling = (spec.max_effective_budget
+                   if spec.max_effective_budget > 0 else static_budget)
+        paused = utilization >= spec.peak_pause_utilization
+        if paused:
+            effective = min(spec.min_effective_budget, ceiling)
+            self.pause_passes_total += 1
+        else:
+            effective = max(spec.min_effective_budget,
+                            min(spare, ceiling))
+        effective = max(0, effective)
+
+        self.budget_falling = (self._last_effective is not None
+                               and effective < self._last_effective)
+        self._last_effective = effective
+
+        if effective < static_budget and self.nudger is not None:
+            # trough-window scheduling: the budget is being held down —
+            # re-evaluate at the recheck cadence instead of waiting for
+            # the next resync/poll to notice the trough
+            self.nudger.nudge_after(spec.recheck_seconds,
+                                    "capacity-trough")
+
+        self.last_status = {
+            "servingNodes": serving_nodes,
+            "availableNodes": available_nodes,
+            "inFlight": in_flight,
+            "demand": round(demand, 2),
+            "qpsEwma": (round(qps_ewma, 3)
+                        if qps_ewma is not None else None),
+            "capacityAvailable": capacity_available,
+            "capacityTotal": capacity_total,
+            "headroom": capacity_available - round(demand, 2),
+            "utilization": (round(utilization, 4)
+                            if capacity_available > 0 else None),
+            "requiredNodes": required_nodes,
+            "staticBudget": static_budget,
+            "effectiveBudget": effective,
+            "paused": paused,
+            "falling": self.budget_falling,
+            "sloBreached": slo_breached,
+            "abortsTotal": self.aborts_total + self.window_aborts_total,
+            "sloBreachTicksTotal": self.slo_breach_ticks_total,
+        }
+        if effective != static_budget:
+            logger.info(
+                "capacity budget: demand %.1f / capacity %d over %d "
+                "serving node(s) -> effective budget %d (static %d%s)",
+                demand, capacity_available, serving_nodes, effective,
+                static_budget, ", PAUSED" if paused else "")
+        return effective
+
+    def _sample(self) -> "Optional[list[tuple[str, Sequence[object]]]]":
+        if self._source is None:
+            return None
+        try:
+            mapping = self._source()
+        except Exception as exc:  # noqa: BLE001 — signal boundary: a
+            # broken source must degrade to static, never wedge a pass
+            logger.warning("capacity endpoint source raised (%s); "
+                           "falling back to the static budget", exc)
+            return None
+        return sorted(mapping.items())
+
+    # ------------------------------------------------------------------
+    # abort bookkeeping (state manager hooks)
+    # ------------------------------------------------------------------
+    def note_abort_started(self, node: str, now: float,
+                           window: bool = False) -> None:
+        """A node entered abort-required this pass."""
+        if window:
+            self.window_aborts_total += 1
+        else:
+            self.aborts_total += 1
+        with self._lock:
+            self._abort_started[node] = now
+
+    def note_abort_finished(self, node: str, now: float) -> None:
+        """A node's abort committed back to upgrade-required."""
+        with self._lock:
+            started = self._abort_started.pop(node, None)
+            if started is not None:
+                self._abort_seconds.append(max(0.0, now - started))
+
+    def drain_abort_durations(self) -> "list[float]":
+        """Completed abort durations since the last drain (the
+        ``capacity_abort_seconds`` histogram feed)."""
+        with self._lock:
+            out, self._abort_seconds = self._abort_seconds, []
+        return out

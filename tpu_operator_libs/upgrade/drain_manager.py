@@ -114,6 +114,12 @@ class DrainManager:
         back to the gate's ``release`` hook (GateKeeper.abandon_stale)."""
         self._gatekeeper.abandon_stale(still_wanted)
 
+    def release_gate(self, node: Node, pods: "list") -> None:
+        """Mid-flight abort: return one node's endpoints to admitting
+        (GateKeeper.release_node — durable-label driven, so it works
+        across operator crash-restarts)."""
+        self._gatekeeper.release_node(node, pods)
+
     def schedule_nodes_drain(self, config: DrainConfiguration) -> None:
         """Schedule an async drain per node (drain_manager.go:58-138)."""
         if not config.nodes:

@@ -104,6 +104,32 @@ class GateKeeper:
                       f"checkpoint/eviction gate not yet open")
         return False
 
+    def release_node(self, node: Node, pods: list[Pod]) -> None:
+        """Explicitly hand ONE node back to the gate's ``release`` hook
+        (the mid-flight abort path, state_manager.
+        process_abort_required_nodes).
+
+        Unlike :meth:`abandon_stale` this does not depend on the
+        in-memory parked record: an operator that crashed mid-abort
+        rebuilds with an empty GateKeeper, yet the resumed abort must
+        still return the node's serving endpoints to admitting — so the
+        release is driven from the durable abort-required label, with
+        the caller supplying the node's current pods for the gate's
+        resolver. Idempotent (ServingDrainGate.release just resumes).
+        """
+        name = node.metadata.name
+        with self._parked_lock:
+            self._parked.pop(name, None)
+        self._deferred.remove(name)
+        release = getattr(self._gate, "release", None)
+        if release is None:
+            return
+        try:
+            release(node, pods)
+        except Exception as exc:  # noqa: BLE001 — gate boundary
+            logger.warning("gate release raised for node %s: %s",
+                           name, exc)
+
     def abandon_stale(self, still_wanted: "set[str]") -> None:
         """Release parked nodes the upgrade flow no longer wants evicted.
 

@@ -163,6 +163,10 @@ class MockDrainManager(RecordingMixin):
             exc, self.fail_next = self.fail_next, None
             raise exc
 
+    def release_gate(self, node: Node, pods: "list[Pod]") -> None:
+        """Mid-flight abort seam (process_abort_required_nodes)."""
+        self.record("release_gate", node.metadata.name)
+
     def join(self, timeout: float = 0.0) -> None:
         pass
 
@@ -196,6 +200,10 @@ class MockPodManager(RecordingMixin):
             self, ds: DaemonSet) -> Optional[str]:
         self.record("get_previous_daemon_set_revision_hash", ds.name)
         return self.previous_hashes.get(ds.name)
+
+    def release_gate(self, node: Node, pods: "list[Pod]") -> None:
+        """Mid-flight abort seam (process_abort_required_nodes)."""
+        self.record("release_gate", node.metadata.name)
 
     def reset_revision_cache(self) -> None:
         # deliberately not recorded: it is per-pass bookkeeping, and

@@ -130,6 +130,12 @@ class PodManager:
         back to the gate's ``release`` hook (GateKeeper.abandon_stale)."""
         self._gatekeeper.abandon_stale(still_wanted)
 
+    def release_gate(self, node: Node, pods: "list[Pod]") -> None:
+        """Mid-flight abort: return one node's endpoints to admitting
+        (GateKeeper.release_node — durable-label driven, so it works
+        across operator crash-restarts)."""
+        self._gatekeeper.release_node(node, pods)
+
     # ------------------------------------------------------------------
     # (d) revision oracle
     # ------------------------------------------------------------------

@@ -87,9 +87,12 @@ PHASE_OF_STATE: dict[str, str] = {
 }
 
 #: Transitions into these states ABORT the open phase: the elapsed time
-#: includes a failure dwell, so the sample is dropped, not recorded.
+#: includes a failure dwell (or, for abort-required, a deliberately
+#: truncated drain the fleet called off), so the sample is dropped, not
+#: recorded — a half-run phase would poison the duration model.
 _ABORT_STATES = frozenset((str(UpgradeState.FAILED),
-                           str(UpgradeState.ROLLBACK_REQUIRED)))
+                           str(UpgradeState.ROLLBACK_REQUIRED),
+                           str(UpgradeState.ABORT_REQUIRED)))
 
 #: Pooled-histogram buckets (seconds): per-phase durations ride pod
 #: recreate/ready and validation-settle timescales, seconds to hours.

@@ -57,8 +57,10 @@ from tpu_operator_libs.api.upgrade_policy import (
     scaled_value_from_int_or_percent,
 )
 from tpu_operator_libs.consts import (
+    ABORTABLE_STATES,
     ALL_STATES,
     IN_PROGRESS_STATES,
+    NODE_NAME_FIELD_SELECTOR_FMT,
     TRUE_STRING,
     TopologyKeys,
     UpgradeKeys,
@@ -270,6 +272,20 @@ class ClusterUpgradeStateManager:
         #: window admit/defer decision — the chaos harness's
         #: maintenance-window invariant feed.
         self.window_audit = None
+        # ---- traffic-aware capacity budgets (upgrade/capacity.py) ----
+        #: Persistent CapacityBudgetController; created on first use
+        #: from a policy with capacityBudget.enable (its EWMAs are
+        #: advisory in-memory state — every safety-relevant signal is
+        #: re-derived from the live endpoints each pass).
+        self._capacity = None
+        #: node -> serving endpoints source installed via
+        #: :meth:`with_serving_signal`; without one the controller
+        #: fails open to the static budget exactly.
+        self._capacity_source = None
+        #: Optional (kind, node, at, reason) hook for every mid-flight
+        #: abort admission/completion — the chaos harness's
+        #: abort-invariant feed (kind: "abort" | "aborted").
+        self.abort_audit = None
 
         #: DaemonSet inputs of the most recent build (uid -> DS): the
         #: budget-share ledger / oracle discovery surface.
@@ -448,6 +464,8 @@ class ClusterUpgradeStateManager:
         self.pod_manager.nudger = nudger
         self.validation_manager.nudger = nudger
         self.rollout_guard.nudger = nudger
+        if self._capacity is not None:
+            self._capacity.nudger = nudger
         return self
 
     @property
@@ -994,7 +1012,8 @@ class ClusterUpgradeStateManager:
             eligible=sorted(eligible.items()))
 
     def _sharded_budget_caps(
-            self, policy: UpgradePolicySpec) -> tuple[int, int]:
+            self, policy: UpgradePolicySpec,
+            capacity: "object" = None) -> tuple[int, int]:
         """The partition's (maxUnavailable, maxParallel) caps under the
         durable budget-share protocol.
 
@@ -1036,6 +1055,14 @@ class ClusterUpgradeStateManager:
         if policy.max_unavailable is not None:
             global_budget = scaled_value_from_int_or_percent(
                 policy.max_unavailable, fleet_total, round_up=True)
+        if capacity is not None:
+            # traffic-aware modulation of the GLOBAL budget, before the
+            # deterministic split: every replica reading the same
+            # fleet-level serving signal derives the same effective B,
+            # and the share ledger's decrease-now/increase-next-pass
+            # rule handles the per-pass movement exactly like a fleet
+            # resize would
+            global_budget = capacity.effective_budget(global_budget)
         entitled = split_budget(global_budget, counts)
 
         # the ledger DaemonSet: deterministically the first runtime DS
@@ -1168,6 +1195,13 @@ class ClusterUpgradeStateManager:
 
         total_nodes = self.get_total_managed_nodes(state)
         max_parallel = policy.max_parallel_upgrades
+        # Traffic-aware capacity budget (upgrade/capacity.py): with a
+        # capacity-enabled policy AND a wired serving signal, the
+        # effective budget — recomputed from live endpoint load every
+        # pass — replaces the static count (troughs may exceed it via
+        # maxEffectiveBudget, peaks shrink or pause it). Without a
+        # signal the controller returns the static budget unchanged.
+        capacity = self._capacity_for_policy(policy)
         if self._shard_view is None or self.last_shard_status is None:
             # single-owner semantics (also the fallback for a snapshot
             # built before with_sharding was installed: no census means
@@ -1176,15 +1210,35 @@ class ClusterUpgradeStateManager:
             if policy.max_unavailable is not None:
                 max_unavailable = scaled_value_from_int_or_percent(
                     policy.max_unavailable, total_nodes, round_up=True)
+            if capacity is not None:
+                max_unavailable = capacity.effective_budget(
+                    max_unavailable)
         else:
             # the partition's cap comes from the durable budget-share
             # ledger, never from scaling the policy against the
             # partition (per-shard percent ceilings would jointly
-            # overdraw the fleet budget)
+            # overdraw the fleet budget); the capacity controller
+            # modulates the GLOBAL budget before the split, so shards
+            # jointly respect the traffic picture too
             max_unavailable, max_parallel = self._sharded_budget_caps(
-                policy)
+                policy, capacity)
+        # Safe mid-flight abort: capacity collapse (spike / node kills
+        # shrinking the effective budget below what is already
+        # unavailable) or a maintenance-window close overtaking a
+        # mid-drain node moves drain-phase nodes to abort-required in
+        # the SAME pass the condition is detected.
+        self._admit_abort_nodes(state, policy, capacity, max_unavailable)
         upgrades_available = self.get_upgrades_available(
             state, max_parallel, max_unavailable)
+        if capacity is not None and capacity.budget_falling:
+            # admission hysteresis: a CONTRACTING budget (spike/kill
+            # ramp in progress) admits nothing — a node admitted now
+            # would be aborted a pass later as the ramp continues,
+            # which is churn (cordon + gate-drain + uncordon) for zero
+            # progress. Aborts above still trim the existing excess;
+            # admission resumes the first pass the budget stops
+            # falling.
+            upgrades_available = 0
         in_progress = self.get_upgrades_in_progress(state)
         logger.info(
             "upgrades in progress: %d, available slots: %d, "
@@ -1237,6 +1291,7 @@ class ClusterUpgradeStateManager:
         planner = self._wrap_predictive(policy, planner)
         self.process_upgrade_required_nodes(
             state, upgrades_available, planner=planner)
+        self.process_abort_required_nodes(state)
         self.process_cordon_required_nodes(state)
         self.process_wait_for_jobs_required_nodes(
             state, policy.wait_for_completion)
@@ -1250,7 +1305,7 @@ class ClusterUpgradeStateManager:
         self.process_validation_required_nodes(state)
         self.process_uncordon_required_nodes(state)
         self._eager_slot_refill(state, policy, planner, max_unavailable,
-                                max_parallel)
+                                max_parallel, capacity=capacity)
         # Gate-parked nodes that left every eviction-wanting state this
         # pass (policy flipped drain off, node recovered or vanished) are
         # handed back to the gate's release hook so e.g. serving
@@ -1440,6 +1495,49 @@ class ClusterUpgradeStateManager:
         if self._multislice_constraint is not None:
             self._multislice_constraint.last_deferred = ()
 
+    # ------------------------------------------------------------------
+    # traffic-aware capacity budgets (upgrade/capacity.py)
+    # ------------------------------------------------------------------
+    def with_serving_signal(
+            self, source: "object") -> "ClusterUpgradeStateManager":
+        """Install (or clear with None) the serving-endpoint source —
+        a callable returning ``{node_name: [ServingEndpoint, ...]}`` —
+        the :class:`~tpu_operator_libs.upgrade.capacity.
+        CapacityBudgetController` aggregates into fleet headroom. The
+        controller itself is created from the policy
+        (``capacityBudget.enable``); with the spec enabled but no
+        source wired it fails open to the static budget exactly."""
+        self._capacity_source = source
+        if self._capacity is not None:
+            self._capacity.set_source(source)
+        return self
+
+    @property
+    def capacity_controller(self) -> "object":
+        """The persistent CapacityBudgetController (None until a
+        capacity-enabled policy ran)."""
+        return self._capacity
+
+    def _capacity_for_policy(self, policy: UpgradePolicySpec) -> "object":
+        """The controller for this pass, created/refreshed from the
+        policy (re-read every pass, reference semantics); None when the
+        spec is absent or disabled."""
+        spec = policy.capacity
+        if spec is None or not spec.enable:
+            return None
+        if self._capacity is None:
+            from tpu_operator_libs.upgrade.capacity import (
+                CapacityBudgetController,
+            )
+
+            self._capacity = CapacityBudgetController(
+                spec, source=self._capacity_source, clock=self.clock,
+                nudger=self.nudger)
+        else:
+            self._capacity.spec = spec
+            self._capacity.nudger = self.nudger
+        return self._capacity
+
     @property
     def predictor(self) -> "object":
         """The persistent :class:`~tpu_operator_libs.upgrade.predictor.
@@ -1472,19 +1570,10 @@ class ClusterUpgradeStateManager:
                     "estimates; ignoring the window")
             return inner
         from tpu_operator_libs.upgrade.predictor import (
-            PhaseDurationPredictor,
             PredictiveWavePlanner,
         )
 
-        if self._predictor is None:
-            self._predictor = PhaseDurationPredictor(
-                self.keys, clock=self.clock, smoothing=spec.smoothing,
-                prior_seconds=spec.prior_seconds)
-        else:
-            # the policy is re-read every pass (reference semantics):
-            # knob changes take effect without dropping learned state
-            self._predictor.smoothing = spec.smoothing
-            self._predictor.prior_seconds = spec.prior_seconds
+        self._predictor_for_policy(policy)
         if getattr(self.provider, "transition_observer", None) \
                 is not self._predictor.observe_transition:
             self.provider.transition_observer = \
@@ -1497,6 +1586,31 @@ class ClusterUpgradeStateManager:
         wrapper.window = policy.maintenance_window
         wrapper.audit = self.window_audit
         return wrapper
+
+    def _predictor_for_policy(self, policy: UpgradePolicySpec) -> "object":
+        """The duration predictor for this pass, created/refreshed from
+        the policy (None when prediction is disabled). Split out of
+        :meth:`_wrap_predictive` because the mid-flight abort admission
+        needs remaining-duration estimates BEFORE the planner wrapping
+        runs — including on a fresh incarnation's very first pass after
+        a crash, where mid-flight nodes already exist."""
+        spec = policy.predictor
+        if spec is None or not spec.enable:
+            return None
+        if self._predictor is None:
+            from tpu_operator_libs.upgrade.predictor import (
+                PhaseDurationPredictor,
+            )
+
+            self._predictor = PhaseDurationPredictor(
+                self.keys, clock=self.clock, smoothing=spec.smoothing,
+                prior_seconds=spec.prior_seconds)
+        else:
+            # the policy is re-read every pass (reference semantics):
+            # knob changes take effect without dropping learned state
+            self._predictor.smoothing = spec.smoothing
+            self._predictor.prior_seconds = spec.prior_seconds
+        return self._predictor
 
     def _multislice_for_policy(
             self, policy: UpgradePolicySpec) -> "MultisliceConstraint":
@@ -1871,6 +1985,160 @@ class ClusterUpgradeStateManager:
             self._transient_deferrals += deferred_pods
             self.last_pass_deferrals += deferred_pods
 
+    # ------------------------------------------------------------------
+    # safe mid-flight abort (beyond-reference; docs/traffic-aware-
+    # budgets.md)
+    # ------------------------------------------------------------------
+    def _admit_abort_nodes(self, state: ClusterUpgradeState,
+                           policy: UpgradePolicySpec,
+                           capacity: "object",
+                           effective_budget: int) -> None:
+        """Move drain-phase nodes to ``abort-required`` when the fleet
+        can no longer afford their disruption.
+
+        Two triggers, checked per node over the ABORTABLE (pre-restart)
+        buckets in least-progressed-first order:
+
+        - **capacity collapse**: current unavailability exceeds the
+          effective budget (a traffic spike shrank it, or concurrent
+          node kills consumed it) — abort exactly the excess, cheapest
+          nodes first;
+        - **maintenance-window close**: the window has closed, or the
+          node's predicted remaining duration (durable phase stamps +
+          learned model) now overruns it — the PR 9 admission gate only
+          protected the START; this bounds prediction-error stragglers
+          mid-flight.
+
+        Snapshot buckets are updated in place (the rollback-admission
+        idiom) so later processors never act on stale membership, and
+        the transition is a single durable label write — crash-ordered:
+        an operator dying right after it resumes the abort from the
+        label alone."""
+        now = self.clock.now()
+        need_capacity = 0
+        if capacity is not None and capacity.has_signal:
+            need_capacity = max(
+                0, self.get_current_unavailable_nodes(state)
+                - effective_budget)
+            # Deadband: in the BENIGN regime (not paused, SLO intact)
+            # tolerate an overshoot smaller than ~3% of the serving
+            # fleet — demand noise moves the effective budget a few
+            # nodes per pass, and aborting into that jitter churns
+            # cordon/uncordon cycles for capacity the SLO headroom
+            # already covers. A real collapse (peak pause, SLO
+            # pressure) gets no band: its full excess aborts.
+            status = capacity.last_status
+            if not status["paused"] and not status["sloBreached"]:
+                slack = max(1, status["servingNodes"] // 32)
+                if need_capacity <= slack:
+                    need_capacity = 0
+        window = policy.maintenance_window
+        predictor = self._predictor_for_policy(policy)
+        close = None
+        margin = 0.0
+        if window is not None and window.enable and predictor is not None:
+            close = window.close_at(now)
+            margin = float(window.margin_seconds or 0)
+        if need_capacity <= 0 and close is None:
+            return
+        for source in ABORTABLE_STATES:
+            bucket = state.node_states.get(str(source), [])
+            moved: list[NodeUpgradeState] = []
+            for ns in bucket:
+                reason = None
+                if close is not None:
+                    if now >= close:
+                        reason = "window"
+                    else:
+                        remaining = predictor.remaining_seconds(
+                            ns.node.metadata.name, str(source),
+                            ns.node.metadata.annotations, now)
+                        if now + remaining + margin > close:
+                            reason = "window"
+                if reason is None and need_capacity > 0:
+                    reason = "capacity"
+                if reason is None:
+                    continue
+                with self._defer_node_on_transient(ns.node,
+                                                   "abort admit"):
+                    if self.provider.change_node_upgrade_state(
+                            ns.node, UpgradeState.ABORT_REQUIRED):
+                        moved.append(ns)
+                        if reason == "capacity":
+                            need_capacity -= 1
+                        if capacity is not None:
+                            capacity.note_abort_started(
+                                ns.node.metadata.name, now,
+                                window=(reason == "window"))
+                        if self.abort_audit is not None:
+                            self.abort_audit("abort",
+                                             ns.node.metadata.name,
+                                             now, reason)
+                        logger.info(
+                            "aborting mid-flight upgrade of node %s "
+                            "(%s; was %s)", ns.node.metadata.name,
+                            "capacity collapse" if reason == "capacity"
+                            else "maintenance-window close", source)
+            for ns in moved:
+                bucket.remove(ns)
+                state.node_states.setdefault(
+                    str(UpgradeState.ABORT_REQUIRED), []).append(ns)
+
+    def process_abort_required_nodes(
+            self, state: ClusterUpgradeState) -> None:
+        """Complete mid-flight aborts: halt eviction, release the
+        serving-gate drain, uncordon, and return the node to
+        ``upgrade-required`` with zero residue.
+
+        Eviction is halted structurally — the node left the
+        pod-deletion/drain buckets when it was admitted here, so no new
+        worker is scheduled, and any ALREADY-in-flight async worker's
+        outcome commit fails the provider's optimistic label
+        precondition (abort-required != the drain-required it
+        expects). The gate release is explicit and driven from the
+        durable label (not the GateKeeper's in-memory parked record),
+        so an operator that crashed mid-abort — fresh managers, empty
+        GateKeeper — still returns the endpoints to admitting when it
+        resumes. Ordering mirrors uncordon-required: the physical
+        uncordon precedes the label commit (a failed uncordon leaves
+        the node abort-required for retry), and every piece of upgrade
+        bookkeeping (phase-start stamp, wait-for-jobs stamp, validation
+        stamp) is deleted on the SAME merge patch as the commit —
+        crash-atomic, no residue window."""
+        def abort(ns: NodeUpgradeState) -> None:
+            node = ns.node
+            name = node.metadata.name
+            pods = self.client.list_pods(
+                namespace=None,
+                field_selector=NODE_NAME_FIELD_SELECTOR_FMT.format(name))
+            self.pod_manager.release_gate(node, pods)
+            self.drain_manager.release_gate(node, pods)
+            annotations: dict[str, Optional[str]] = {
+                self.keys.phase_start_annotation: None,
+                self.keys.pod_completion_start_annotation: None,
+                self.keys.validation_start_annotation: None,
+            }
+            if self.keys.initial_state_annotation \
+                    not in node.metadata.annotations:
+                self.cordon_manager.uncordon(node)
+            # else: the node was cordoned BEFORE the upgrade began —
+            # the abort restores that state, so the cordon AND its
+            # memory stay (the next admission re-enters with both)
+            if self.provider.change_node_upgrade_state(
+                    node, UpgradeState.UPGRADE_REQUIRED,
+                    annotations=annotations):
+                now = self.clock.now()
+                if self._capacity is not None:
+                    self._capacity.note_abort_finished(name, now)
+                if self.abort_audit is not None:
+                    self.abort_audit("aborted", name, now, "")
+                logger.info(
+                    "node %s abort complete: back to upgrade-required, "
+                    "serving endpoints admitting", name)
+
+        self._map_bucket(state.bucket(UpgradeState.ABORT_REQUIRED),
+                         "abort", abort)
+
     def process_validation_required_nodes(
             self, state: ClusterUpgradeState) -> None:
         """Run the validation gate (upgrade_state.go:880-911)."""
@@ -1927,7 +2195,8 @@ class ClusterUpgradeStateManager:
                            policy: UpgradePolicySpec,
                            planner: UpgradePlanner,
                            max_unavailable: int,
-                           max_parallel: Optional[int] = None) -> None:
+                           max_parallel: Optional[int] = None,
+                           capacity: "object" = None) -> None:
         """Re-spend slots freed by nodes that finished THIS pass.
 
         Admission runs first in ``apply_state`` (reference bucket
@@ -1951,6 +2220,10 @@ class ClusterUpgradeStateManager:
         with self._deferral_lock:
             freed = self._pass_slots_freed
         if freed <= 0 or self._rollout.halted:
+            return
+        if capacity is not None and capacity.budget_falling:
+            # same admission hysteresis as the main round: refilling
+            # into a contracting budget is churn (see apply_state)
             return
         required = str(UpgradeState.UPGRADE_REQUIRED)
         effective = ClusterUpgradeState()
@@ -2168,6 +2441,12 @@ class ClusterUpgradeStateManager:
             planner_block["knownNodes"] = self._predictor.known_nodes
             planner_block["samplesTotal"] = self._predictor.samples_total
             status["planner"] = planner_block
+        if self._capacity is not None \
+                and self._capacity.last_status is not None:
+            # the traffic-aware budget picture: live demand vs serving
+            # capacity, the effective budget the throttle actually
+            # spent, and the abort/SLO accounting
+            status["capacity"] = dict(self._capacity.last_status)
         if self._shard_view is not None and self.last_shard_status:
             # the sharded-control-plane picture: which shards this
             # replica owns, the fleet-wide per-shard node census, and
