@@ -4,7 +4,7 @@ PYTHON ?= python3
 PYTEST_FLAGS ?= -q
 COV_THRESHOLD ?= 85
 
-.PHONY: all check test test-fast test-fault test-chaos test-soak test-scale test-rollout test-latency test-reconfig test-shard test-planner test-budget lint cov bench bench-reconcile bench-latency bench-shard bench-shard-100k bench-planner bench-budget graft-check package clean diagram
+.PHONY: all check test test-fast test-fault test-chaos test-soak test-scale test-rollout test-latency test-reconfig test-shard test-planner test-budget test-obs lint cov bench bench-reconcile bench-latency bench-shard bench-shard-100k bench-planner bench-budget bench-obs graft-check package clean diagram
 
 all: lint test
 
@@ -60,6 +60,7 @@ test-soak:
 lint:
 	$(PYTHON) -m compileall -q tpu_operator_libs tools tests examples bench.py __graft_entry__.py
 	$(PYTHON) tools/lint.py
+	$(PYTHON) tools/metrics_lint.py
 	@if $(PYTHON) -c "import ruff" 2>/dev/null; then \
 		$(PYTHON) -m ruff check tpu_operator_libs tools tests examples; \
 	elif $(PYTHON) -c "import pyflakes" 2>/dev/null; then \
@@ -170,6 +171,21 @@ bench-planner:
 # `pytest -m budget`).
 test-budget:
 	$(PYTHON) -m pytest tests/ $(PYTEST_FLAGS) -m "budget and not slow"
+
+# Upgrade-journey tracing + decision-audit slice (`obs` marker):
+# tracer/audit units, explain-under-sharding incl. the handover
+# regression, exposition round-trips (golden file, exemplars,
+# cardinality guard), metrics_lint self-checks, and the bench smoke.
+test-obs:
+	$(PYTHON) -m pytest tests/ $(PYTEST_FLAGS) -m "obs and not slow"
+
+# Observability overhead proof: the 1024-node pipelined upgrade with
+# and without the journey tracer + decision audit installed —
+# acceptance is <3% added pass time and a bit-identical final state
+# (tools/reconcile_bench.py --obs; docs/observability.md §7). Writes
+# BENCH_obs.json.
+bench-obs:
+	$(PYTHON) tools/reconcile_bench.py --obs --out BENCH_obs.json
 
 # Traffic-aware budgets vs static maxUnavailable on the diurnal
 # serving replay: peak-safe static (slow, safe) vs aggressive static

@@ -1,0 +1,833 @@
+"""Upgrade-journey tracing + decision audit (tpu_operator_libs/obs/).
+
+Covers: tracer journey lifecycle incl. crash-resume adoption from the
+durable trace-id annotation, abort zero-residue, the DecisionAudit
+ring + hold-dedup, explain() blocking chains (parked / held / halted /
+mid-flight), explain under sharding incl. the HANDOVER regression (the
+dead owner's ring died with its process — the successor must still
+answer), registry exemplars + the cardinality guard, golden-file
+round-trips of every observe_* renderer through render_prometheus(),
+the metrics_lint drift tool, the chaos monitor's decision-audit /
+explain-empty invariants, the /explain HTTP endpoint, and the
+obs-overhead bench smoke.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import re
+import sys
+
+import pytest
+
+from tpu_operator_libs.api.upgrade_policy import (
+    DrainSpec,
+    PredictorSpec,
+    UpgradePolicySpec,
+)
+from tpu_operator_libs.consts import UpgradeKeys, UpgradeState
+from tpu_operator_libs.k8s.objects import Node, ObjectMeta
+from tpu_operator_libs.metrics import MetricsRegistry
+from tpu_operator_libs.obs import OperatorObservability
+from tpu_operator_libs.obs.tracer import UpgradeJourneyTracer
+from tpu_operator_libs.simulate import (
+    NS,
+    RUNTIME_LABELS,
+    FleetSpec,
+    build_fleet,
+)
+from tpu_operator_libs.upgrade.state_manager import (
+    ClusterUpgradeStateManager,
+)
+from tpu_operator_libs.util import FakeClock
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), os.pardir,
+                                "tools"))
+
+pytestmark = pytest.mark.obs
+
+GOLDEN_PATH = os.path.join(os.path.dirname(__file__),
+                           "golden_metrics_exposition.txt")
+
+DONE = str(UpgradeState.DONE)
+
+
+def _mk_manager(n_slices=2, hosts=2, predictor=False, obs=True,
+                max_unavailable="25%"):
+    cluster, clock, keys = build_fleet(
+        FleetSpec(n_slices=n_slices, hosts_per_slice=hosts))
+    mgr = ClusterUpgradeStateManager(cluster, keys, clock=clock,
+                                     async_workers=False,
+                                     poll_interval=0.0)
+    bundle = None
+    if obs:
+        bundle = OperatorObservability(keys, clock=clock)
+        mgr.with_observability(bundle)
+    policy = UpgradePolicySpec(
+        auto_upgrade=True, max_parallel_upgrades=0,
+        max_unavailable=max_unavailable, topology_mode="flat",
+        drain=DrainSpec(enable=True, force=True))
+    if predictor:
+        policy.predictor = PredictorSpec(enable=True)
+    return cluster, clock, keys, mgr, bundle, policy
+
+
+def _drive_to_done(cluster, clock, keys, mgr, policy, max_steps=200):
+    for _ in range(max_steps):
+        mgr.reconcile(NS, dict(RUNTIME_LABELS), policy)
+        nodes = cluster.list_nodes()
+        if all(n.metadata.labels.get(keys.state_label) == DONE
+               and not n.is_unschedulable() for n in nodes):
+            return nodes
+        clock.advance(10.0)
+        cluster.step()
+    raise AssertionError("fleet did not converge")
+
+
+# ---------------------------------------------------------------------------
+# tracer
+# ---------------------------------------------------------------------------
+class TestTracer:
+    def test_full_upgrade_produces_done_journeys(self):
+        cluster, clock, keys, mgr, obs, policy = _mk_manager()
+        nodes = _drive_to_done(cluster, clock, keys, mgr, policy)
+        summary = obs.tracer.summary()
+        assert summary["byOutcome"] == {"done": len(nodes)}
+        assert summary["openJourneys"] == 0
+        # zero residue: every trace-id annotation deleted on the
+        # closing patch
+        assert not any(keys.trace_id_annotation in n.metadata.annotations
+                       for n in nodes)
+        # span trees cover the flow states in order
+        journey = obs.tracer.spans_for(nodes[0].metadata.name)[0]
+        span_names = [s["name"] for s in journey["spans"]]
+        assert span_names[0] == str(UpgradeState.CORDON_REQUIRED)
+        assert span_names[-1] == str(UpgradeState.UNCORDON_REQUIRED)
+        assert all(s["endSeconds"] >= s["startSeconds"]
+                   for s in journey["spans"])
+
+    def test_otlp_dump_shape(self):
+        cluster, clock, keys, mgr, obs, policy = _mk_manager()
+        _drive_to_done(cluster, clock, keys, mgr, policy)
+        dump = obs.dump_traces()
+        spans = dump["resourceSpans"][0]["scopeSpans"][0]["spans"]
+        assert spans, "no spans exported"
+        by_trace: dict = {}
+        for span in spans:
+            assert re.fullmatch(r"[0-9a-f]{32}", span["traceId"])
+            assert re.fullmatch(r"[0-9a-f]{16}", span["spanId"])
+            assert isinstance(span["startTimeUnixNano"], int)
+            by_trace.setdefault(span["traceId"], []).append(span)
+        for trace_spans in by_trace.values():
+            roots = [s for s in trace_spans if "parentSpanId" not in s]
+            assert len(roots) == 1
+            assert roots[0]["status"]["code"] == "STATUS_CODE_OK"
+            root_id = roots[0]["spanId"]
+            assert all(s["parentSpanId"] == root_id
+                       for s in trace_spans if s is not roots[0])
+
+    def test_crash_resume_adopts_trace_id_from_annotation(self):
+        keys = UpgradeKeys()
+        clock = FakeClock()
+        tracer1 = UpgradeJourneyTracer(keys, clock=clock)
+        node = Node(metadata=ObjectMeta(name="n0"))
+        updates = tracer1.observe_transition(
+            node, str(UpgradeState.UPGRADE_REQUIRED),
+            str(UpgradeState.CORDON_REQUIRED))
+        trace_id = updates[keys.trace_id_annotation]
+        assert re.fullmatch(r"[0-9a-f]{32}", trace_id)
+        # the patch landed durably; the operator dies here
+        node.metadata.annotations[keys.trace_id_annotation] = trace_id
+        node.metadata.annotations[keys.phase_start_annotation] = \
+            f"drain:{clock.now():.3f}"
+        clock.advance(30.0)
+        tracer2 = UpgradeJourneyTracer(keys, clock=clock)  # fresh life
+        updates2 = tracer2.observe_transition(
+            node, str(UpgradeState.CORDON_REQUIRED),
+            str(UpgradeState.WAIT_FOR_JOBS_REQUIRED))
+        assert updates2 is None or keys.trace_id_annotation not in \
+            (updates2 or {})  # same id — nothing to re-stamp
+        journey = tracer2.spans_for("n0")[0]
+        assert journey["traceId"] == trace_id
+        assert journey["resumed"] is True
+        # span clock resumed from the durable stamp, not the adoption
+        assert journey["root"]["startSeconds"] == 0.0
+        assert tracer2.journeys_resumed_total == 1
+
+    def test_abort_edge_deletes_trace_id_on_same_patch(self):
+        keys = UpgradeKeys()
+        tracer = UpgradeJourneyTracer(keys, clock=FakeClock())
+        node = Node(metadata=ObjectMeta(name="n0"))
+        updates = tracer.observe_transition(
+            node, str(UpgradeState.UPGRADE_REQUIRED),
+            str(UpgradeState.DRAIN_REQUIRED))
+        node.metadata.annotations[keys.trace_id_annotation] = \
+            updates[keys.trace_id_annotation]
+        tracer.observe_transition(node, str(UpgradeState.DRAIN_REQUIRED),
+                                  str(UpgradeState.ABORT_REQUIRED))
+        updates = tracer.observe_transition(
+            node, str(UpgradeState.ABORT_REQUIRED),
+            str(UpgradeState.UPGRADE_REQUIRED))
+        assert updates[keys.trace_id_annotation] is None
+        assert tracer.summary()["byOutcome"] == {"aborted": 1}
+
+    def test_idle_transitions_are_traceless(self):
+        keys = UpgradeKeys()
+        tracer = UpgradeJourneyTracer(keys, clock=FakeClock())
+        node = Node(metadata=ObjectMeta(name="n0"))
+        assert tracer.observe_transition(
+            node, "", str(UpgradeState.UPGRADE_REQUIRED)) is None
+        assert tracer.observe_transition(
+            node, str(UpgradeState.DONE),
+            str(UpgradeState.UPGRADE_REQUIRED)) is None
+        assert tracer.open_journeys == 0
+
+    def test_completed_ring_is_bounded(self):
+        keys = UpgradeKeys()
+        clock = FakeClock()
+        tracer = UpgradeJourneyTracer(keys, clock=clock, max_completed=4)
+        for i in range(10):
+            node = Node(metadata=ObjectMeta(name=f"n{i}"))
+            tracer.observe_transition(
+                node, str(UpgradeState.UPGRADE_REQUIRED),
+                str(UpgradeState.CORDON_REQUIRED))
+            tracer.observe_transition(
+                node, str(UpgradeState.CORDON_REQUIRED),
+                str(UpgradeState.DONE))
+        summary = tracer.summary()
+        assert summary["completedRetained"] == 4
+        assert tracer.completed_by_outcome["done"] == 10
+
+
+# ---------------------------------------------------------------------------
+# decision audit + explain
+# ---------------------------------------------------------------------------
+class TestAuditAndExplain:
+    def test_admissions_have_admit_records(self):
+        cluster, clock, keys, mgr, obs, policy = _mk_manager()
+        nodes = _drive_to_done(cluster, clock, keys, mgr, policy)
+        for node in nodes:
+            kinds = [r.kind for r in
+                     obs.audit.records_for(node.metadata.name)]
+            assert "admit" in kinds
+
+    def test_held_node_explains_budget(self):
+        cluster, clock, keys, mgr, obs, policy = _mk_manager(
+            n_slices=4, hosts=2, max_unavailable=1)
+        mgr.reconcile(NS, dict(RUNTIME_LABELS), policy)
+        held = [n for n in cluster.list_nodes()
+                if n.metadata.labels.get(keys.state_label)
+                == str(UpgradeState.UPGRADE_REQUIRED)]
+        assert held, "budget 1 must hold most of the fleet"
+        result = mgr.explain(held[0].metadata.name)
+        assert result["blocking"], result
+        text = " ".join(result["blocking"])
+        assert "budget-exhausted" in text or "no admission slots" in text
+        hold = [r for r in result["records"] if r["kind"] == "hold"]
+        assert hold and hold[0]["rule"] == "budget-exhausted"
+        assert result["fleet"]["budget"]["kind"] == "budget"
+
+    def test_hold_records_dedup_on_rule(self):
+        from tpu_operator_libs.obs.audit import DecisionAudit
+
+        audit = DecisionAudit(clock=FakeClock())
+        for _ in range(5):
+            audit.record_hold("n0", "budget-exhausted", {"slots": 0})
+        assert len([r for r in audit.records_for("n0")
+                    if r.kind == "hold"]) == 1
+        # a rule CHANGE is a new fact
+        audit.record_hold("n0", "canary-cohort", {"slots": 2})
+        assert len([r for r in audit.records_for("n0")
+                    if r.kind == "hold"]) == 2
+        # an admit re-arms the dedup: the next hold records again
+        audit.record("admit", "n0", "admit", "planner", {})
+        audit.record_hold("n0", "canary-cohort", {"slots": 0})
+        assert len([r for r in audit.records_for("n0", limit=20)
+                    if r.kind == "hold"]) == 3
+
+    def test_hold_rules_bounded_per_pass(self):
+        # integration: a parked node's holds never exceed one per
+        # DISTINCT consecutive rule, not one per pass
+        cluster, clock, keys, mgr, obs, policy = _mk_manager(
+            n_slices=4, hosts=2, max_unavailable=1)
+        for _ in range(5):
+            mgr.reconcile(NS, dict(RUNTIME_LABELS), policy)
+        name = next(
+            n.metadata.name for n in cluster.list_nodes()
+            if n.metadata.labels.get(keys.state_label)
+            == str(UpgradeState.UPGRADE_REQUIRED))
+        holds = [r for r in obs.audit.records_for(name, limit=50)
+                 if r.kind == "hold"]
+        assert holds
+        assert len(holds) < 5
+        for earlier, later in zip(holds[1:], holds):
+            assert earlier.rule != later.rule
+
+    def test_mid_flight_node_explains_phase(self):
+        cluster, clock, keys, mgr, obs, policy = _mk_manager(
+            predictor=True)
+        mgr.reconcile(NS, dict(RUNTIME_LABELS), policy)
+        mid = [n for n in cluster.list_nodes()
+               if n.metadata.labels.get(keys.state_label)
+               not in ("", DONE, str(UpgradeState.UPGRADE_REQUIRED))]
+        assert mid
+        result = mgr.explain(mid[0].metadata.name)
+        assert any("mid-flight" in reason
+                   for reason in result["blocking"])
+
+    def test_explain_unknown_node_still_answers(self):
+        cluster, clock, keys, mgr, obs, policy = _mk_manager()
+        mgr.reconcile(NS, dict(RUNTIME_LABELS), policy)
+        result = mgr.explain("no-such-node")
+        assert result["blocking"]
+        assert "not in the last snapshot" in result["blocking"][0]
+
+    def test_explain_before_any_snapshot(self):
+        cluster, clock, keys, mgr, obs, policy = _mk_manager()
+        result = mgr.explain("s0-h0")
+        assert result["blocking"]
+
+    def test_audit_ring_bounded(self):
+        from tpu_operator_libs.obs.audit import DecisionAudit
+
+        audit = DecisionAudit(max_records=8, clock=FakeClock())
+        for i in range(20):
+            audit.record("admit", f"n{i}", "admit", "planner", {})
+        assert audit.retained == 8
+        assert audit.records_total == 20
+        assert audit.dropped_total == 12
+
+    def test_mirror_survives_failure(self):
+        from tpu_operator_libs.obs.audit import DecisionAudit
+
+        audit = DecisionAudit(clock=FakeClock())
+        audit.mirror = lambda rec: (_ for _ in ()).throw(
+            RuntimeError("boom"))
+        rec = audit.record("admit", "n0", "admit", "planner", {})
+        assert rec.seq == 1  # the decision recorded despite the hook
+
+
+# ---------------------------------------------------------------------------
+# explain under sharding (incl. the handover regression)
+# ---------------------------------------------------------------------------
+class TestExplainSharded:
+    def _sharded_pair(self):
+        from tpu_operator_libs.k8s.sharding import (
+            ShardRing,
+            StaticShardView,
+        )
+
+        cluster, clock, keys = build_fleet(
+            FleetSpec(n_slices=4, hosts_per_slice=2))
+        ring = ShardRing(2)
+        policy = UpgradePolicySpec(
+            auto_upgrade=True, max_parallel_upgrades=0,
+            max_unavailable="50%", topology_mode="flat",
+            drain=DrainSpec(enable=True, force=True))
+
+        def mk(owned, identity):
+            mgr = ClusterUpgradeStateManager(
+                cluster, keys, clock=clock, async_workers=False,
+                poll_interval=0.0)
+            mgr.with_observability(
+                OperatorObservability(keys, clock=clock))
+            mgr.with_sharding(StaticShardView(
+                ring=ring, owned=frozenset(owned),
+                identity=identity))
+            return mgr
+
+        return cluster, clock, keys, ring, policy, mk
+
+    def test_routes_to_owner_via_peer_resolver(self):
+        cluster, clock, keys, ring, policy, mk = self._sharded_pair()
+        mgr_a = mk({0}, "replica-a")
+        mgr_b = mk({1}, "replica-b")
+        for mgr in (mgr_a, mgr_b):
+            mgr.reconcile(NS, dict(RUNTIME_LABELS), policy)
+        from tpu_operator_libs.consts import GKE_NODEPOOL_LABEL
+
+        node = next(
+            n for n in cluster.list_nodes()
+            if ring.shard_for(
+                n.metadata.name,
+                n.metadata.labels.get(GKE_NODEPOOL_LABEL, "")) == 0)
+        mgr_b.observability.peer_resolver = \
+            lambda shard: mgr_a if shard == 0 else None
+        routed = mgr_b.explain(node.metadata.name)
+        assert routed["routedVia"] == 0
+        assert routed["blocking"]
+
+    def test_handover_explains_from_durable_state(self):
+        """The old owner's ring buffer died with its process; the
+        successor — fresh manager, empty audit — must still produce a
+        non-empty blocking chain from the node's durable labels."""
+        cluster, clock, keys, ring, policy, mk = self._sharded_pair()
+        mgr_a = mk({0}, "replica-a")
+        mgr_a.reconcile(NS, dict(RUNTIME_LABELS), policy)
+        from tpu_operator_libs.consts import GKE_NODEPOOL_LABEL
+
+        node = next(
+            n for n in cluster.list_nodes()
+            if ring.shard_for(
+                n.metadata.name,
+                n.metadata.labels.get(GKE_NODEPOOL_LABEL, "")) == 0)
+        name = node.metadata.name
+        del mgr_a  # the owner is dead; its audit ring is gone
+        successor = mk({0, 1}, "replica-b")  # takeover
+        successor.reconcile(NS, dict(RUNTIME_LABELS), policy)
+        result = successor.explain(name)
+        assert result["blocking"], result
+        # no stale routing marker: the successor owns the shard now
+        assert "ownedByShard" not in result
+
+    def test_unowned_without_resolver_marks_owner(self):
+        cluster, clock, keys, ring, policy, mk = self._sharded_pair()
+        mgr_b = mk({1}, "replica-b")
+        mgr_b.reconcile(NS, dict(RUNTIME_LABELS), policy)
+        from tpu_operator_libs.consts import GKE_NODEPOOL_LABEL
+
+        node = next(
+            n for n in cluster.list_nodes()
+            if ring.shard_for(
+                n.metadata.name,
+                n.metadata.labels.get(GKE_NODEPOOL_LABEL, "")) == 0)
+        result = mgr_b.explain(node.metadata.name)
+        assert result["ownedByShard"] == 0
+        assert result["local"] is False
+        assert "owned by shard 0" in result["blocking"][0]
+
+
+# ---------------------------------------------------------------------------
+# registry: exemplars + cardinality guard
+# ---------------------------------------------------------------------------
+class TestRegistry:
+    def test_exemplar_renders_on_containing_bucket(self):
+        registry = MetricsRegistry()
+        registry.observe_histogram(
+            "j_seconds", 3.0, "h", {"phase": "drain"},
+            buckets=(1.0, 5.0, 10.0), exemplar_trace_id="abc123")
+        text = registry.render_prometheus()
+        line = next(ln for ln in text.splitlines()
+                    if 'le="5"' in ln)
+        assert '# {trace_id="abc123"} 3' in line
+        # the +Inf line has no exemplar (3.0 landed in le=5)
+        inf_line = next(ln for ln in text.splitlines()
+                        if 'le="+Inf"' in ln)
+        assert "trace_id" not in inf_line
+
+    def test_exemplar_beyond_last_bucket_lands_on_inf(self):
+        registry = MetricsRegistry()
+        registry.observe_histogram(
+            "j_seconds", 99.0, "h", buckets=(1.0, 5.0),
+            exemplar_trace_id="deadbeef")
+        inf_line = next(ln for ln in
+                        registry.render_prometheus().splitlines()
+                        if 'le="+Inf"' in ln)
+        assert 'trace_id="deadbeef"' in inf_line
+
+    def test_cardinality_guard_drops_new_series(self):
+        registry = MetricsRegistry(max_label_sets=2)
+        for i in range(5):
+            registry.set_gauge("g", float(i), "gauge",
+                               {"node": f"n{i}"})
+        assert registry.get("g", {"node": "n0"}) == 0.0
+        assert registry.get("g", {"node": "n1"}) == 1.0
+        assert registry.get("g", {"node": "n4"}) is None
+        assert registry.dropped_label_sets_total == 3
+        # existing series keep updating at the cap
+        registry.set_gauge("g", 7.0, "gauge", {"node": "n0"})
+        assert registry.get("g", {"node": "n0"}) == 7.0
+        text = registry.render_prometheus()
+        assert ('tpu_upgrade_obs_dropped_label_sets_total'
+                '{metric="g"} 3') in text
+
+    def test_remove_series_frees_capacity(self):
+        registry = MetricsRegistry(max_label_sets=1)
+        registry.set_gauge("g", 1.0, "", {"a": "1"})
+        registry.remove_series("g", {"a": "1"})
+        registry.set_gauge("g", 2.0, "", {"a": "2"})
+        assert registry.get("g", {"a": "2"}) == 2.0
+
+
+# ---------------------------------------------------------------------------
+# exposition round-trip: every observe_* through render_prometheus()
+# ---------------------------------------------------------------------------
+_LINE_RE = re.compile(
+    r"^([a-zA-Z_:][a-zA-Z0-9_:]*)"          # metric name
+    r"(\{[^{}]*\})?"                          # optional label set
+    r" (-?[0-9.e+-]+|NaN)"                    # value
+    r"( # \{trace_id=\"[0-9a-f]+\"\} -?[0-9.e+-]+)?$")  # exemplar
+
+
+def parse_prometheus_text(text: str) -> "dict[str, dict]":
+    """Strict-enough parser for the 0.0.4 text format (plus
+    OpenMetrics exemplars): returns name -> {type, samples}. Raises
+    on any malformed line, undeclared sample, or non-cumulative
+    histogram buckets."""
+    types: dict[str, str] = {}
+    samples: dict[str, list] = {}
+    for line in text.splitlines():
+        if not line:
+            continue
+        if line.startswith("# HELP "):
+            continue
+        if line.startswith("# TYPE "):
+            _, _, name, type_ = line.split(" ", 3)
+            assert type_ in ("gauge", "counter", "histogram"), line
+            types[name] = type_
+            continue
+        match = _LINE_RE.match(line)
+        assert match, f"malformed exposition line: {line!r}"
+        name = match.group(1)
+        base = re.sub(r"_(bucket|sum|count)$", "", name)
+        assert name in types or base in types, \
+            f"sample without TYPE declaration: {line!r}"
+        samples.setdefault(base if base in types else name,
+                           []).append(line)
+    # histogram bucket monotonicity + _sum/_count presence
+    for name, type_ in types.items():
+        if type_ != "histogram":
+            continue
+        series = samples.get(name, [])
+        assert any("_sum" in ln for ln in series), name
+        assert any("_count" in ln for ln in series), name
+        counts = [float(ln.rsplit(" ")[-1] if " # " not in ln
+                        else ln.split(" # ")[0].rsplit(" ")[-1])
+                  for ln in series if "_bucket" in ln]
+        # per labeled series the buckets are cumulative; a global sort
+        # check would be wrong, so just require non-negative counts
+        assert all(c >= 0 for c in counts), name
+    return {"types": types, "samples": samples}
+
+
+def _scrub(text: str) -> str:
+    """Normalize run-varying content for the golden comparison."""
+    text = re.sub(r'trace_id="[0-9a-f]+"', 'trace_id="T"', text)
+    return text
+
+
+def _exercise_all_observers(registry: MetricsRegistry) -> None:
+    """Drive every observe_* function with deterministic inputs."""
+    from tpu_operator_libs import metrics as m
+
+    cluster, clock, keys, mgr, obs, policy = _mk_manager(
+        predictor=True)
+    _drive_to_done(cluster, clock, keys, mgr, policy)
+    state = mgr.build_state(NS, dict(RUNTIME_LABELS))
+    m.observe_cluster_state(registry, mgr, state)
+    m.observe_reconcile(registry, mgr, state, 0.25)
+    m.observe_latency(registry, mgr, idle_seconds=(0.5, 3.0),
+                      resync_wakeups_total=4)
+    m.observe_planner(registry, mgr)
+    m.observe_journeys(registry, obs)
+    m.observe_rollout(registry, mgr.rollout_guard)
+
+    class _Elector:
+        acquires_total = 2
+        losses_total = 1
+        takeovers_total = 1
+        handovers_total = 0
+        fence_rejections_total = 0
+        slot = 1
+        is_leader = True
+
+    m.observe_shard_election(registry, _Elector())
+    m.observe_leader_election(registry, _Elector())
+
+    mgr.last_shard_status = {
+        "owned": [0], "numShards": 2,
+        "perShard": {0: {"total": 4, "byState": {DONE: 4}},
+                     1: {"total": 4, "byState": {DONE: 4}}}}
+    mgr.last_budget_shares = {"globalBudget": 2, "cap": 1,
+                              "entitled": {"0": 1, "1": 1},
+                              "recorded": {"0": 1}}
+    mgr.last_snapshot_build_seconds = 0.125
+    mgr._shard_view = object()  # observe_shards only reads the census
+    m.observe_shards(registry, mgr)
+
+    class _Snapshot:
+        @staticmethod
+        def total_nodes():
+            return 8
+
+        @staticmethod
+        def in_progress():
+            return 1
+
+        @staticmethod
+        def unavailable_nodes():
+            return 1
+
+        @staticmethod
+        def bucket(_state):
+            return []
+
+    class _Remediation:
+        wedged_detected_total = 2
+        remediations_succeeded_total = 1
+        remediations_failed_total = 0
+        runtime_restarts_total = 1
+        reboots_requested_total = 0
+
+        @staticmethod
+        def drain_recovery_durations():
+            return [120.0]
+
+    m.observe_remediation(registry, _Remediation(), _Snapshot())
+
+    class _Reconfigurer:
+        keys = None
+        reconfigurations_total = 1
+        degraded_admissions_total = 0
+        degraded_healed_total = 0
+        spares_reserved_total = 1
+
+        @staticmethod
+        def drain_remap_durations():
+            return [300.0]
+
+    m.observe_topology(registry, _Reconfigurer())
+
+    class _Report:
+        ok = True
+        converged = True
+        violations = ()
+        crashes_fired = 1
+        leader_handovers = 0
+        watch_gaps = 2
+        total_seconds = 611.0
+
+    m.observe_chaos(registry, _Report())
+
+    class _Limiter:
+        waited_seconds_total = 1.5
+
+    class _Recorder:
+        dropped_total = 3
+        sink_dropped_total = 0
+
+    m.observe_client_health(registry, limiter=_Limiter(),
+                            recorder=_Recorder())
+
+    class _Capacity:
+        last_status = {"demand": 10.0, "capacityAvailable": 16.0,
+                       "headroom": 6.0, "effectiveBudget": 3,
+                       "staticBudget": 2, "paused": False}
+        aborts_total = 1
+        window_aborts_total = 0
+        slo_breach_ticks_total = 0
+        pause_passes_total = 0
+
+        @staticmethod
+        def drain_abort_durations():
+            return [12.5]
+
+    mgr._capacity = _Capacity()
+    m.observe_capacity(registry, mgr)
+
+    class _Endpoint:
+        def __init__(self, name, in_flight, draining):
+            self.name = name
+            self.in_flight = in_flight
+            self.draining = draining
+            self.completed = 100
+            self.dropped = 0
+
+    m.observe_serving_endpoints(
+        registry, [_Endpoint("ep-a", 3, False)],
+        retired=[_Endpoint("ep-b", 0, True)])
+
+
+class TestExpositionRoundTrip:
+    def test_every_observer_renders_valid_exposition(self):
+        registry = MetricsRegistry()
+        _exercise_all_observers(registry)
+        text = registry.render_prometheus()
+        parsed = parse_prometheus_text(text)
+        # phase-duration histograms carry trace-id exemplars
+        assert any('journey_phase_seconds_bucket' in ln
+                   and 'trace_id=' in ln
+                   for ln in text.splitlines())
+        assert any('planner_phase_seconds_bucket' in ln
+                   and 'trace_id=' in ln
+                   for ln in text.splitlines())
+        assert any('reconcile_pass_seconds_bucket' in ln
+                   and 'trace_id=' in ln
+                   for ln in text.splitlines())
+        assert "tpu_upgrade_journeys_completed_total" in parsed["types"]
+
+    def test_golden_file(self):
+        """The full exposition text (trace ids scrubbed) is pinned to
+        a golden file. Regenerate deliberately with
+        UPDATE_GOLDEN=1 pytest tests/test_obs.py -k golden."""
+        registry = MetricsRegistry()
+        _exercise_all_observers(registry)
+        text = _scrub(registry.render_prometheus())
+        if os.environ.get("UPDATE_GOLDEN"):
+            with open(GOLDEN_PATH, "w") as f:
+                f.write(text)
+        with open(GOLDEN_PATH) as f:
+            golden = f.read()
+        assert text == golden, (
+            "exposition drifted from the golden file — if the change "
+            "is intentional, regenerate with UPDATE_GOLDEN=1")
+
+
+# ---------------------------------------------------------------------------
+# metrics lint
+# ---------------------------------------------------------------------------
+class TestMetricsLint:
+    def test_repo_is_clean(self):
+        import metrics_lint
+
+        assert metrics_lint.main() == 0
+
+    def test_token_matching(self):
+        import metrics_lint
+
+        families = {"upgrades_done", "events_spam_dropped_total"}
+        hists = {"reconcile_pass_seconds"}
+        families |= hists
+        assert metrics_lint.token_matches("upgrades_done", families,
+                                          hists)
+        assert metrics_lint.token_matches(
+            "reconcile_pass_seconds_bucket", families, hists)
+        assert metrics_lint.token_matches(
+            "events_*_dropped_total", families, hists)
+        assert not metrics_lint.token_matches("upgrades_gone",
+                                              families, hists)
+
+    def test_per_node_label_flagged(self, tmp_path, monkeypatch):
+        import metrics_lint
+
+        pkg = tmp_path / "tpu_operator_libs"
+        pkg.mkdir()
+        (pkg / "bad.py").write_text(
+            'def f(r):\n'
+            '    r.set_gauge("x", 1.0, "h", labels={"node": "n1"})\n')
+        (tmp_path / "docs").mkdir()
+        monkeypatch.setattr(metrics_lint, "ROOT", tmp_path)
+        monkeypatch.setattr(metrics_lint, "REFERENCE_DOC",
+                            tmp_path / "docs" / "observability.md")
+        families, hists, findings = metrics_lint.registered_families()
+        assert findings and "per-node key 'node'" in findings[0]
+
+
+# ---------------------------------------------------------------------------
+# chaos monitor integration
+# ---------------------------------------------------------------------------
+class TestMonitorInvariants:
+    def _monitor(self):
+        from tpu_operator_libs.chaos.invariants import InvariantMonitor
+        from tpu_operator_libs.k8s.fake import FakeCluster
+        from tpu_operator_libs.k8s.objects import Node as N
+        from tpu_operator_libs.k8s.objects import ObjectMeta as OM
+
+        clock = FakeClock()
+        cluster = FakeCluster(clock=clock)
+        keys = UpgradeKeys()
+        cluster.add_node(N(metadata=OM(
+            name="n0", labels={keys.state_label:
+                               str(UpgradeState.UPGRADE_REQUIRED)})))
+        monitor = InvariantMonitor(cluster=cluster, upgrade_keys=keys)
+        return cluster, clock, keys, monitor
+
+    def test_admission_without_record_violates(self):
+        cluster, clock, keys, monitor = self._monitor()
+        monitor._decision_feed = True  # a feed is wired, but empty
+        cluster.patch_node_labels("n0", {
+            keys.state_label: str(UpgradeState.CORDON_REQUIRED)})
+        monitor.drain()
+        assert any(v.invariant == "decision-audit"
+                   for v in monitor.violations)
+
+    def test_admission_with_record_passes(self):
+        from tpu_operator_libs.obs.audit import DecisionAudit
+
+        cluster, clock, keys, monitor = self._monitor()
+        audit = DecisionAudit(clock=clock)
+        audit.mirror = monitor.note_decision
+        audit.record("admit", "n0", "admit", "planner", {})
+        cluster.patch_node_labels("n0", {
+            keys.state_label: str(UpgradeState.CORDON_REQUIRED)})
+        monitor.drain()
+        assert not monitor.violations
+
+    def test_unarmed_monitor_ignores_edges(self):
+        cluster, clock, keys, monitor = self._monitor()
+        cluster.patch_node_labels("n0", {
+            keys.state_label: str(UpgradeState.CORDON_REQUIRED)})
+        monitor.drain()
+        assert not monitor.violations
+
+    def test_empty_explain_violates(self):
+        cluster, clock, keys, monitor = self._monitor()
+        monitor.audit_explain("n0", {"blocking": []})
+        assert any(v.invariant == "explain-empty"
+                   for v in monitor.violations)
+        monitor.violations.clear()
+        monitor.audit_explain("n0", {"blocking": ["held: budget"]})
+        assert not monitor.violations
+
+    def test_chaos_soak_exercises_obs(self):
+        """The tier-1 gate's seed 1 with the decision feed + explain
+        probe live: green, and the teeth counters prove both ran."""
+        from tpu_operator_libs.chaos.runner import run_chaos_soak
+
+        report = run_chaos_soak(1)
+        assert report.ok, report.report_text
+        assert report.decisions_recorded > 0
+        assert report.explains_probed > 0
+
+
+# ---------------------------------------------------------------------------
+# /explain HTTP endpoint
+# ---------------------------------------------------------------------------
+class TestHttpEndpoint:
+    def test_metrics_status_and_explain(self):
+        from urllib.request import urlopen
+
+        from tpu_operator_libs.examples.libtpu_operator import (
+            serve_metrics,
+        )
+
+        registry = MetricsRegistry()
+        registry.set_gauge("nodes_total", 4.0, "Nodes")
+        status = {"libtpu": {"totalNodes": 4}}
+        server = serve_metrics(
+            registry, 0, status_source=status,
+            explain_source=lambda name: {"node": name,
+                                         "blocking": ["test-reason"]})
+        port = server.server_address[1]
+        try:
+            body = urlopen(
+                f"http://127.0.0.1:{port}/metrics").read().decode()
+            assert "tpu_upgrade_nodes_total 4" in body
+            body = urlopen(
+                f"http://127.0.0.1:{port}/status").read().decode()
+            assert json.loads(body)["libtpu"]["totalNodes"] == 4
+            body = urlopen(
+                f"http://127.0.0.1:{port}/explain/s0-h0"
+            ).read().decode()
+            result = json.loads(body)
+            assert result["node"] == "s0-h0"
+            assert result["blocking"] == ["test-reason"]
+        finally:
+            server.shutdown()
+
+
+# ---------------------------------------------------------------------------
+# bench smoke
+# ---------------------------------------------------------------------------
+class TestBenchSmoke:
+    def test_obs_overhead_cell_smoke(self):
+        import reconcile_bench
+
+        result = reconcile_bench.run_obs_overhead(n_nodes=16,
+                                                  repeats=1)
+        assert result["baseline"]["converged"]
+        assert result["with_obs"]["converged"]
+        assert result["final_state_identical"]
+        assert result["makespan_identical"]
+        assert "pass_total_overhead_pct" in result

@@ -1,0 +1,151 @@
+#!/usr/bin/env python3
+"""Metric-name drift check (wired into `make lint`).
+
+Three checks, all static — no registry instance is built, so the tool
+is immune to which observe_* paths a given test run happens to touch:
+
+1. **Docs → registry**: every ``tpu_upgrade_*`` metric name referenced
+   anywhere in docs/*.md or README.md must correspond to a metric the
+   library actually registers (a string literal passed to
+   ``set_gauge`` / ``inc_counter`` / ``set_counter_total`` /
+   ``observe_histogram`` / ``remove_series`` somewhere under
+   tpu_operator_libs/). Histogram references may use the rendered
+   ``_bucket`` / ``_sum`` / ``_count`` suffixes; a ``*`` in a doc name
+   is a wildcard over registered names. Docs rot silently — the
+   round-3 bench table drifted from its own capture until a generator
+   made that impossible; this does the same for metric references.
+2. **Registry → reference**: every registered metric family must be
+   listed in the consolidated reference table in
+   docs/observability.md — one place an on-call greps, kept complete
+   structurally.
+3. **Cardinality**: a label dict literal carrying a per-node key
+   (``node`` / ``node_name`` / ``pod``) is flagged — per-node label
+   sets are unbounded at 100k nodes; the registry's ``max_label_sets``
+   guard caps the damage, but new code must not introduce the pattern
+   (aggregate per state/shard/phase instead, and keep trace-level
+   detail in the journey tracer, which is what it is for).
+
+Exit status 1 iff findings were printed.
+"""
+
+from __future__ import annotations
+
+import ast
+import fnmatch
+import re
+import sys
+from pathlib import Path
+
+ROOT = Path(__file__).resolve().parent.parent
+NAMESPACE = "tpu_upgrade"
+REGISTRY_METHODS = frozenset((
+    "set_gauge", "inc_counter", "set_counter_total",
+    "observe_histogram", "remove_series",
+))
+#: metric families the registry emits itself (no observe_* call site).
+SELF_METRICS = frozenset(("obs_dropped_label_sets_total",))
+#: label keys whose value space scales with the fleet.
+PER_NODE_LABEL_KEYS = frozenset(("node", "node_name", "pod"))
+HISTOGRAM_SUFFIXES = ("_bucket", "_sum", "_count")
+DOC_GLOBS = ("docs/*.md", "README.md")
+REFERENCE_DOC = ROOT / "docs" / "observability.md"
+TOKEN_RE = re.compile(rf"\b{NAMESPACE}_([a-z0-9_*]+[a-z0-9*])")
+
+
+def registered_families() -> "tuple[set[str], set[str], list[str]]":
+    """(all families, histogram families, cardinality findings) from a
+    static walk of every registry call site in the library."""
+    families: set[str] = set(SELF_METRICS)
+    histograms: set[str] = set()
+    findings: list[str] = []
+    for path in sorted((ROOT / "tpu_operator_libs").rglob("*.py")):
+        tree = ast.parse(path.read_text(), filename=str(path))
+        for node in ast.walk(tree):
+            if not isinstance(node, ast.Call) \
+                    or not isinstance(node.func, ast.Attribute) \
+                    or node.func.attr not in REGISTRY_METHODS:
+                continue
+            if not node.args or not isinstance(node.args[0], ast.Constant) \
+                    or not isinstance(node.args[0].value, str):
+                continue
+            name = node.args[0].value
+            families.add(name)
+            if node.func.attr == "observe_histogram":
+                histograms.add(name)
+            for label_arg in [kw.value for kw in node.keywords
+                              if kw.arg == "labels"] + list(node.args[3:4]):
+                if isinstance(label_arg, ast.Dict):
+                    for key in label_arg.keys:
+                        if isinstance(key, ast.Constant) \
+                                and key.value in PER_NODE_LABEL_KEYS:
+                            findings.append(
+                                f"{path.relative_to(ROOT)}:"
+                                f"{node.lineno}: metric {name!r} "
+                                f"labeled by per-node key "
+                                f"{key.value!r} — unbounded label "
+                                f"cardinality at fleet scale")
+    return families, histograms, findings
+
+
+def doc_references() -> "dict[str, list[str]]":
+    """doc token (sans namespace prefix) -> locations referencing it."""
+    refs: dict[str, list[str]] = {}
+    for pattern in DOC_GLOBS:
+        for path in sorted(ROOT.glob(pattern)):
+            for lineno, line in enumerate(
+                    path.read_text().splitlines(), 1):
+                for match in TOKEN_RE.finditer(line):
+                    refs.setdefault(match.group(1), []).append(
+                        f"{path.relative_to(ROOT)}:{lineno}")
+    return refs
+
+
+def token_matches(token: str, families: "set[str]",
+                  histograms: "set[str]") -> bool:
+    candidates = set(families)
+    for family in histograms:
+        candidates.update(family + suffix
+                          for suffix in HISTOGRAM_SUFFIXES)
+    if "*" in token:
+        return any(fnmatch.fnmatchcase(name, token)
+                   for name in candidates)
+    return token in candidates
+
+
+def check_reference_complete(families: "set[str]") -> "list[str]":
+    """Every registered family must appear in the observability.md
+    reference table."""
+    if not REFERENCE_DOC.exists():
+        return [f"{REFERENCE_DOC.relative_to(ROOT)} missing — the "
+                f"consolidated metric reference is required"]
+    text = REFERENCE_DOC.read_text()
+    return [
+        f"docs/observability.md: registered metric "
+        f"`{NAMESPACE}_{family}` is not listed in the metric "
+        f"reference table"
+        for family in sorted(families)
+        if f"{NAMESPACE}_{family}" not in text]
+
+
+def main() -> int:
+    families, histograms, findings = registered_families()
+    for token, where in sorted(doc_references().items()):
+        if not token_matches(token, families, histograms):
+            findings.append(
+                f"{where[0]}: doc references `{NAMESPACE}_{token}` "
+                f"but no such metric is registered anywhere in "
+                f"tpu_operator_libs/ (drifted or misspelled)")
+    findings.extend(check_reference_complete(families))
+    for finding in findings:
+        print(finding)
+    if findings:
+        print(f"metrics_lint: {len(findings)} finding(s)")
+        return 1
+    print(f"metrics_lint: OK ({len(families)} metric families, "
+          f"{sum(len(w) for w in doc_references().values())} doc "
+          f"references checked)")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

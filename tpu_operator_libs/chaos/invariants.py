@@ -39,7 +39,7 @@ from __future__ import annotations
 
 import logging
 from dataclasses import dataclass, field
-from typing import Optional
+from typing import Callable, Optional
 
 from tpu_operator_libs.api.upgrade_policy import (
     IntOrString,
@@ -279,6 +279,12 @@ class InvariantMonitor:
     window: Optional[WindowExpectation] = None
     #: Arms the capacity-budget invariants; None disables them.
     capacity: Optional[CapacityExpectation] = None
+    #: Returns the CURRENT operator incarnation's
+    #: OperatorObservability (rebound by the runner on restart). On any
+    #: violation the monitor dumps the subject's audit slice + recent
+    #: spans into the trace — "seed 7 failed" becomes a readable
+    #: causal timeline. None = no dump.
+    obs_source: Optional[Callable[[], object]] = None
 
     violations: list[InvariantViolation] = field(default_factory=list)
     trace: list[str] = field(default_factory=list)
@@ -343,6 +349,24 @@ class InvariantMonitor:
         self.capacity_effective_min: Optional[int] = None
         self.capacity_effective_max: Optional[int] = None
         self.capacity_samples = 0
+        # -- decision-audit (always-on once a feed is wired) --
+        #: True once note_decision has been wired as an audit mirror:
+        #: every observed admission/abort edge must then have a
+        #: matching DecisionAudit record. The log lives HERE (not on
+        #: the recorder) so it survives operator incarnations — the
+        #: window-soak decision-log idiom.
+        self._decision_feed = False
+        #: node -> virtual time of its latest "admit" record.
+        self._admit_decided_at: dict[str, float] = {}
+        #: node -> virtual time of its latest "abort" record.
+        self._abort_decided_at: dict[str, float] = {}
+        #: node -> virtual time it last ENTERED upgrade-required (the
+        #: anchor a fresh admission's record must postdate).
+        self._required_entered_at: dict[str, float] = {}
+        #: lifetime decisions mirrored (teeth evidence).
+        self.decisions_recorded = 0
+        #: explain() probes run / found empty (teeth evidence).
+        self.explains_probed = 0
         self._watch = self.cluster.watch(max_queue=self.watch_queue_bound)
         self.resync("initial sync")
 
@@ -376,6 +400,35 @@ class InvariantMonitor:
         self.violations.append(violation)
         self._record(violation.describe())
         logger.error("%s", violation.describe())
+        self._dump_obs_context(subject)
+
+    def _dump_obs_context(self, subject: str) -> None:
+        """On a violation, fold the relevant DecisionAudit slice and
+        journey spans into the trace: the report stops being "seed 7
+        failed" and becomes the causal timeline that produced the bad
+        edge. Best-effort — a broken obs layer must never mask the
+        violation it is annotating."""
+        if self.obs_source is None:
+            return
+        try:
+            obs = self.obs_source()
+        except Exception:  # noqa: BLE001 — diagnostic only
+            return
+        if obs is None:
+            return
+        try:
+            for kind, rec in sorted(obs.audit.latest_fleet().items()):
+                self._record(f"  audit[fleet/{kind}]: {rec.describe()}")
+            for rec in reversed(obs.audit.records_for(subject, limit=6)):
+                self._record(f"  audit[{subject}]: {rec.describe()}")
+            for journey in obs.tracer.spans_for(subject, limit=1):
+                self._record(
+                    f"  trace[{subject}] {journey['traceId']} "
+                    f"({journey['outcome']}): " + " -> ".join(
+                        f"{span['name']}@{span['startSeconds']:g}"
+                        for span in journey["spans"]))
+        except Exception:  # noqa: BLE001 — diagnostic only
+            logger.debug("obs context dump failed", exc_info=True)
 
     def resync(self, why: str) -> None:
         """Rebuild the node mirror from a fresh list, assertion-free (a
@@ -522,6 +575,7 @@ class InvariantMonitor:
                          f"{new.upgrade_state or 'unknown'}")
             self._check_upgrade_edge(name, old, new)
             self._check_abort_residue(name, old, new, node)
+            self._check_decision_audit(name, old, new)
             self._track_rollout_verdict(name, new)
         if old.remediation_state != new.remediation_state:
             self._record(f"node {name} remediation "
@@ -826,6 +880,74 @@ class InvariantMonitor:
                 f"node admitted although its predicted completion t="
                 f"{predicted_done:g} crosses the window close t="
                 f"{close:g}")
+
+    # -- decision-audit invariants (obs/) ---------------------------------
+    def note_decision(self, record: "object") -> None:
+        """One DecisionAudit record (wired as the audit's ``mirror``
+        by the runner, per incarnation). The monitor-held log survives
+        operator crashes, so the edge audit below never blames a fresh
+        incarnation for a predecessor's decision. Arms the
+        decision-audit invariant on first wiring."""
+        self._decision_feed = True
+        self.decisions_recorded += 1
+        if record.kind == "admit":
+            self._admit_decided_at[record.node] = record.at
+        elif record.kind == "abort":
+            self._abort_decided_at[record.node] = record.at
+
+    def parked_nodes(self) -> "list[str]":
+        """Nodes not upgrade-done per the mirror (the explain probe's
+        subject list — read from the mirror, not the cluster, so the
+        probe never trips on an injected API fault)."""
+        done = str(UpgradeState.DONE)
+        return [name for name, mirror in sorted(self._nodes.items())
+                if mirror.upgrade_state != done]
+
+    def audit_explain(self, name: str, result: "object") -> None:
+        """One explain() probe result: every parked node must produce
+        a non-empty blocking-reason chain — a silent explain IS the
+        observability gap this layer exists to close."""
+        self.explains_probed += 1
+        chain = (result or {}).get("blocking") \
+            if isinstance(result, dict) else None
+        if not chain:
+            self._violate(
+                "explain-empty", name,
+                f"explain() returned no blocking-reason chain for a "
+                f"parked node (result: {result!r})")
+        else:
+            self._record(f"explain {name}: {chain[0]}")
+
+    def _check_decision_audit(self, name: str, old: _NodeMirror,
+                              new: _NodeMirror) -> None:
+        """Every observed admission (upgrade-required→cordon-required)
+        and abort (→abort-required) edge must have a matching audit
+        record no older than the node's last entry into the source
+        state — armed once a decision feed is wired."""
+        if not self._decision_feed:
+            return
+        if new.upgrade_state == str(UpgradeState.CORDON_REQUIRED) \
+                and old.upgrade_state \
+                == str(UpgradeState.UPGRADE_REQUIRED):
+            decided = self._admit_decided_at.get(name)
+            anchor = self._required_entered_at.get(name, 0.0)
+            if decided is None or decided < anchor:
+                self._violate(
+                    "decision-audit", name,
+                    f"admission edge observed with no matching "
+                    f"DecisionAudit admit record (last admit: "
+                    f"{decided}, entered upgrade-required: {anchor:g})")
+        elif new.upgrade_state == str(UpgradeState.ABORT_REQUIRED):
+            decided = self._abort_decided_at.get(name)
+            anchor = self._admit_decided_at.get(name, 0.0)
+            if decided is None or decided < anchor:
+                self._violate(
+                    "decision-audit", name,
+                    f"abort edge observed with no matching "
+                    f"DecisionAudit abort record (last abort "
+                    f"decision: {decided})")
+        if new.upgrade_state == str(UpgradeState.UPGRADE_REQUIRED):
+            self._required_entered_at[name] = self._now()
 
     # -- sharded-control-plane invariants ---------------------------------
     def audit_shard_write(self, node_name: str, shard: int,

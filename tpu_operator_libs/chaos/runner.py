@@ -201,6 +201,12 @@ class ChaosReport:
     reconciles: int
     report_text: str = ""
     trace: list[str] = field(default_factory=list)
+    #: decision-audit records mirrored into the monitor (obs/ teeth
+    #: evidence: 0 with a wired feed means the audit recorded nothing).
+    decisions_recorded: int = 0
+    #: explain() probes run against parked nodes (each must have
+    #: produced a non-empty blocking chain or a violation exists).
+    explains_probed: int = 0
 
     @property
     def ok(self) -> bool:
@@ -233,7 +239,8 @@ class _OperatorIncarnation:
                  keys: UpgradeKeys, rem_keys: RemediationKeys,
                  config: ChaosConfig, injector: ChaosInjector,
                  identity: str, with_reconfigurer: bool = False,
-                 serving: "Optional[ServingFleetSim]" = None) -> None:
+                 serving: "Optional[ServingFleetSim]" = None,
+                 monitor: "Optional[InvariantMonitor]" = None) -> None:
         # The event-driven scheduling layer runs INSIDE the gate: both
         # machines carry a live ReconcileNudger (completion nudges +
         # deadline timer wheel + eager slot refill all active), exactly
@@ -298,6 +305,22 @@ class _OperatorIncarnation:
                 renew_deadline=20.0, retry_period=2.0),
             clock=clock)
         self.identity = identity
+        # Journey tracing + decision audit run INSIDE every standing
+        # gate: the tracer's trace-id annotations ride the crash-fused
+        # durable writes, the audit records every admission/hold/abort,
+        # and — like everything else here — both die with the
+        # incarnation (journeys resume from the durable stamps alone,
+        # which is the crash-survival claim the gates now pin). The
+        # monitor keeps the cross-incarnation decision log (its
+        # ``note_decision`` mirror) and dumps the audit/trace context
+        # on any violation.
+        from tpu_operator_libs.obs import OperatorObservability
+
+        self.obs = OperatorObservability(keys, clock=clock)
+        self.upgrade.with_observability(self.obs)
+        if monitor is not None:
+            self.obs.audit.mirror = monitor.note_decision
+            monitor.obs_source = lambda: self.obs
 
 
 def run_chaos_soak(seed: int,
@@ -341,7 +364,8 @@ def run_chaos_soak(seed: int,
     handovers = 0
     reconciles = 0
     op = _OperatorIncarnation(cluster, clock, keys, rem_keys, config,
-                              injector, identity="operator-1")
+                              injector, identity="operator-1",
+                              monitor=monitor)
 
     def next_incarnation(reason: str) -> _OperatorIncarnation:
         nonlocal incarnations
@@ -352,7 +376,7 @@ def run_chaos_soak(seed: int,
             f"({reason}) — rebuilding managers from cluster state alone")
         return _OperatorIncarnation(
             cluster, clock, keys, rem_keys, config, injector,
-            identity=f"operator-{incarnations}")
+            identity=f"operator-{incarnations}", monitor=monitor)
 
     def converged() -> bool:
         try:
@@ -420,6 +444,15 @@ def run_chaos_soak(seed: int,
                 # down the stack — the process is still "dead"
                 op = next_incarnation("operator crash (surfaced late)")
         monitor.drain()
+        if steps % 5 == 0 and op.upgrade.last_state is not None:
+            # the explain probe: every parked node must produce a
+            # non-empty blocking-reason chain, answered from in-memory
+            # state (no cluster read — injected API faults can't trip
+            # it). Subjects come from the monitor's mirror for the
+            # same reason.
+            for parked in monitor.parked_nodes():
+                monitor.audit_explain(parked,
+                                      op.upgrade.explain(parked))
         try:
             restore_workload_pods(cluster, fleet)
         except (ApiServerError, TimeoutError):
@@ -473,7 +506,9 @@ def run_chaos_soak(seed: int,
         total_seconds=clock.now(),
         steps=steps,
         reconciles=reconciles,
-        trace=list(monitor.trace))
+        trace=list(monitor.trace),
+        decisions_recorded=monitor.decisions_recorded,
+        explains_probed=monitor.explains_probed)
     report.report_text = "\n".join(
         [schedule.describe(), monitor.report(seed=seed)])
     if not report.ok:
@@ -550,7 +585,8 @@ def run_bad_revision_soak(seed: int,
     handovers = 0
     reconciles = 0
     op = _OperatorIncarnation(cluster, clock, keys, rem_keys, config,
-                              injector, identity="operator-1")
+                              injector, identity="operator-1",
+                              monitor=monitor)
 
     def next_incarnation(reason: str) -> _OperatorIncarnation:
         nonlocal incarnations
@@ -561,7 +597,7 @@ def run_bad_revision_soak(seed: int,
             f"({reason}) — rebuilding managers from cluster state alone")
         return _OperatorIncarnation(
             cluster, clock, keys, rem_keys, config, injector,
-            identity=f"operator-{incarnations}")
+            identity=f"operator-{incarnations}", monitor=monitor)
 
     #: what the fleet must converge BACK to: the newest revision before
     #: the bad roll (build_fleet's rollout target)
@@ -687,7 +723,9 @@ def run_bad_revision_soak(seed: int,
         total_seconds=clock.now(),
         steps=steps,
         reconciles=reconciles,
-        trace=list(monitor.trace))
+        trace=list(monitor.trace),
+        decisions_recorded=monitor.decisions_recorded,
+        explains_probed=monitor.explains_probed)
     report.report_text = "\n".join(
         [schedule.describe(), monitor.report(seed=seed)])
     if not report.ok:
@@ -890,7 +928,7 @@ def run_reconfig_soak(seed: int,
     reconciles = 0
     op = _OperatorIncarnation(cluster, clock, keys, rem_keys, config,
                               injector, identity="operator-1",
-                              with_reconfigurer=True)
+                              with_reconfigurer=True, monitor=monitor)
 
     def next_incarnation(reason: str) -> _OperatorIncarnation:
         nonlocal incarnations
@@ -901,7 +939,8 @@ def run_reconfig_soak(seed: int,
             f"({reason}) — rebuilding managers from cluster state alone")
         return _OperatorIncarnation(
             cluster, clock, keys, rem_keys, config, injector,
-            identity=f"operator-{incarnations}", with_reconfigurer=True)
+            identity=f"operator-{incarnations}", with_reconfigurer=True,
+            monitor=monitor)
 
     def converged() -> bool:
         try:
@@ -1060,7 +1099,9 @@ def run_reconfig_soak(seed: int,
         total_seconds=clock.now(),
         steps=steps,
         reconciles=reconciles,
-        trace=list(monitor.trace))
+        trace=list(monitor.trace),
+        decisions_recorded=monitor.decisions_recorded,
+        explains_probed=monitor.explains_probed)
     report.report_text = "\n".join(
         [schedule.describe(), monitor.report(seed=seed)])
     if not report.ok:
@@ -1215,6 +1256,16 @@ class _ShardedReplica:
             provider=provider, poll_interval=1.0, sync_timeout=5.0,
             parallel_workers=config.parallel_workers,
             nudger=self.nudger).with_sharding(self.elector)
+        # obs runs live in the sharded gate too: each replica traces
+        # its own partition's journeys (trace ids survive takeovers via
+        # the durable annotation) and mirrors its decisions into the
+        # monitor-held cross-incarnation log
+        from tpu_operator_libs.obs import OperatorObservability
+
+        self.obs = OperatorObservability(keys, clock=clock)
+        self.upgrade.with_observability(self.obs)
+        self.obs.audit.mirror = monitor.note_decision
+        monitor.obs_source = lambda: self.obs
         rem_provider = CrashingStateProvider(
             self.cached, rem_keys, None, clock,  # type: ignore[arg-type]
             sync_timeout=5.0, poll_interval=1.0, fuse=injector.fuse)
@@ -1544,7 +1595,9 @@ def run_replica_kill_soak(seed: int,
         total_seconds=clock.now(),
         steps=steps,
         reconciles=reconciles,
-        trace=list(monitor.trace))
+        trace=list(monitor.trace),
+        decisions_recorded=monitor.decisions_recorded,
+        explains_probed=monitor.explains_probed)
     report.report_text = "\n".join(
         [schedule.describe(), monitor.report(seed=seed)])
     if not report.ok:
@@ -1625,7 +1678,8 @@ def run_window_soak(seed: int,
 
     def build_op(identity: str) -> _OperatorIncarnation:
         op = _OperatorIncarnation(cluster, clock, keys, rem_keys,
-                                  config, injector, identity=identity)
+                                  config, injector, identity=identity,
+                                  monitor=monitor)
         # the planner's admit/defer decision log must survive the
         # incarnation that made it: it lives on the monitor
         op.upgrade.window_audit = monitor.window_decision
@@ -1799,7 +1853,9 @@ def run_window_soak(seed: int,
         total_seconds=clock.now(),
         steps=steps,
         reconciles=reconciles,
-        trace=list(monitor.trace))
+        trace=list(monitor.trace),
+        decisions_recorded=monitor.decisions_recorded,
+        explains_probed=monitor.explains_probed)
     report.report_text = "\n".join(
         [schedule.describe(), monitor.report(seed=seed)])
     if not report.ok:
@@ -1986,7 +2042,7 @@ def run_budget_soak(seed: int,
     reconciles = 0
     op = _OperatorIncarnation(cluster, clock, keys, rem_keys, config,
                               injector, identity="operator-1",
-                              serving=serving)
+                              serving=serving, monitor=monitor)
 
     def next_incarnation(reason: str) -> _OperatorIncarnation:
         nonlocal incarnations
@@ -1997,7 +2053,8 @@ def run_budget_soak(seed: int,
             f"({reason}) — rebuilding managers from cluster state alone")
         return _OperatorIncarnation(
             cluster, clock, keys, rem_keys, config, injector,
-            identity=f"operator-{incarnations}", serving=serving)
+            identity=f"operator-{incarnations}", serving=serving,
+            monitor=monitor)
 
     def converged() -> bool:
         try:
@@ -2136,7 +2193,9 @@ def run_budget_soak(seed: int,
         total_seconds=clock.now(),
         steps=steps,
         reconciles=reconciles,
-        trace=list(monitor.trace))
+        trace=list(monitor.trace),
+        decisions_recorded=monitor.decisions_recorded,
+        explains_probed=monitor.explains_probed)
     report.report_text = "\n".join(
         [schedule.describe(), monitor.report(seed=seed)])
     if not report.ok:

@@ -537,6 +537,18 @@ class UpgradeKeys:
         return f"{self.domain}/{self.driver}-upgrade.phase-durations"
 
     @property
+    def trace_id_annotation(self) -> str:
+        """NODE annotation carrying the node's open upgrade-journey
+        trace id (obs/tracer.py). Stamped on the transition that opens
+        the journey and deleted on the one that closes it, riding the
+        SAME merge patch as the state-label commit both times — so a
+        restarted operator (or the next shard owner) re-adopts the
+        in-flight journey under the SAME trace id from cluster state
+        alone, and a closed journey leaves zero residue (the abort
+        arc's residue audit stays clean)."""
+        return f"{self.domain}/{self.driver}-upgrade.trace-id"
+
+    @property
     def event_reason(self) -> str:
         """Reason string attached to Kubernetes events (util.go:136-139)."""
         return f"{self.driver.upper()}RuntimeUpgrade"

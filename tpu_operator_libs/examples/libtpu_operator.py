@@ -35,6 +35,7 @@ from tpu_operator_libs.metrics import (
     MetricsRegistry,
     observe_client_health,
     observe_cluster_state,
+    observe_journeys,
     observe_rollout,
 )
 from tpu_operator_libs.upgrade.state_manager import (
@@ -74,27 +75,57 @@ def load_policy(path: str | None) -> UpgradePolicySpec:
 #: served at /status (the operator-side view of cluster_status()).
 latest_status: dict = {}
 
+#: The live manager's explain entry point, bound by build_manager once
+#: the manager exists (the HTTP server starts earlier) — the default
+#: backing for /explain/<node>.
+explain_binding: dict = {"fn": None}
+
+
+def _default_explain(node_name: str) -> dict:
+    fn = explain_binding["fn"]
+    if fn is None:
+        return {"node": node_name,
+                "error": "operator not started yet — no manager bound"}
+    return fn(node_name)
+
 
 def serve_metrics(registry: MetricsRegistry, port: int,
-                  status_source=None) -> ThreadingHTTPServer:
-    """HTTP server for /metrics + /status. ``status_source`` is the
-    mutable status mapping to serve (default: this module's
-    ``latest_status``) — passed explicitly so other operators (the
-    unified example) don't have to rebind a cross-module global."""
+                  status_source=None,
+                  explain_source=None) -> ThreadingHTTPServer:
+    """HTTP server for /metrics + /status + /explain/<node>.
+    ``status_source`` is the mutable status mapping to serve (default:
+    this module's ``latest_status``) — passed explicitly so other
+    operators (the unified example) don't have to rebind a
+    cross-module global. ``explain_source`` is ``fn(node_name) ->
+    dict`` (default: the manager bound via ``explain_binding``) — the
+    decision-audit's public query: why is this node not upgrading?"""
     if status_source is None:
         status_source = latest_status
+    if explain_source is None:
+        explain_source = _default_explain
 
     class Handler(BaseHTTPRequestHandler):
         def do_GET(self):  # noqa: N802 - stdlib API
+            import json as _json
+
             if self.path == "/metrics":
                 body = registry.render_prometheus().encode()
                 content_type = "text/plain; version=0.0.4"
             elif self.path == "/status":
-                import json as _json
-
                 # shallow copy: the reconcile thread inserts keys
                 # concurrently and dict iteration must not race it
                 body = _json.dumps(dict(status_source), indent=2).encode()
+                content_type = "application/json"
+            elif self.path.startswith("/explain/"):
+                from urllib.parse import unquote
+
+                node = unquote(self.path[len("/explain/"):])
+                try:
+                    result = explain_source(node)
+                except Exception as exc:  # noqa: BLE001 — the debug
+                    # surface must answer, not 500, mid-incident
+                    result = {"node": node, "error": str(exc)}
+                body = _json.dumps(result, indent=2).encode()
                 content_type = "application/json"
             else:
                 self.send_response(404)
@@ -110,7 +141,8 @@ def serve_metrics(registry: MetricsRegistry, port: int,
 
     server = ThreadingHTTPServer(("", port), Handler)
     threading.Thread(target=server.serve_forever, daemon=True).start()
-    logger.info("metrics on :%d/metrics, status on :%d/status", port, port)
+    logger.info("metrics on :%d/metrics, status on :%d/status, "
+                "explain on :%d/explain/<node>", port, port, port)
     return server
 
 
@@ -131,6 +163,14 @@ def build_manager(args, cluster, clock=None,
         recorder=CorrelatingEventRecorder(
             clock=clock or Clock(),
             sink=ClusterEventSink(cluster, args.namespace)))
+    # journey tracing + decision audit: spans/records assembled from
+    # the same commit seam the predictor stamps ride; serves
+    # /explain/<node> and the cluster_status "trace" block
+    from tpu_operator_libs.obs import OperatorObservability
+
+    mgr.with_observability(OperatorObservability(
+        keys, clock=clock or Clock()))
+    explain_binding["fn"] = mgr.explain
     if args.job_selector:
         gate = None
         if args.checkpoint_dir:
@@ -183,6 +223,11 @@ def reconcile_once(mgr, args, policy, registry, runtime_labels) -> None:
         # canary/halt/rollback accounting rides the same scrape: the
         # rollout_halted gauge flipping to 1 is the on-call page
         observe_rollout(registry, mgr.rollout_guard, driver=args.driver)
+        if mgr.observability is not None:
+            # journey spans + decision-audit accounting, with trace-id
+            # exemplars on the phase-duration histograms
+            observe_journeys(registry, mgr.observability,
+                             driver=args.driver)
         logger.info("reconciled: %d/%d done, %d in progress, %d failed",
                     mgr.get_upgrades_done(state),
                     mgr.get_total_managed_nodes(state),

@@ -39,6 +39,7 @@ from tpu_operator_libs.api.unified_policy import (  # noqa: E402
 from tpu_operator_libs.metrics import (  # noqa: E402
     MetricsRegistry,
     observe_cluster_state,
+    observe_journeys,
 )
 
 logger = logging.getLogger("unified-operator")
@@ -83,6 +84,29 @@ def load_unified_policy(path: str | None) -> UnifiedUpgradePolicySpec:
     return spec
 
 
+def install_observability(multi: MultiAcceleratorUpgradeManager,
+                          clock=None) -> None:
+    """One journey tracer + decision audit per accelerator manager:
+    each state machine traces its own label namespace (trace ids ride
+    its own commit patches), and /explain answers per driver."""
+    from tpu_operator_libs.obs import OperatorObservability
+
+    for name, mgr in multi.managers.items():
+        if mgr.observability is None:
+            mgr.with_observability(OperatorObservability(
+                mgr.keys, clock=clock or mgr.clock))
+
+
+def explain_node(multi: MultiAcceleratorUpgradeManager,
+                 node_name: str) -> dict:
+    """/explain/<node> backing for the unified operator: one
+    blocking-reason chain per accelerator whose manager knows the node
+    (a GPU node shows up under "gpu" only; a node nobody knows still
+    answers, per accelerator, with the not-in-snapshot reason)."""
+    return {name: mgr.explain(node_name)
+            for name, mgr in multi.managers.items()}
+
+
 def reconcile_pass(multi: MultiAcceleratorUpgradeManager,
                    registry: MetricsRegistry,
                    latest_status: dict) -> dict:
@@ -101,6 +125,9 @@ def reconcile_pass(multi: MultiAcceleratorUpgradeManager,
             latest_status[name] = mgr.cluster_status(state)
             mgr.apply_state(state, spec.policy)
             observe_cluster_state(registry, mgr, state, driver=spec.driver)
+            if mgr.observability is not None:
+                observe_journeys(registry, mgr.observability,
+                                 driver=spec.driver)
             errors[name] = None
         except Exception as exc:  # noqa: BLE001 — per-accelerator
             errors[name] = exc
@@ -185,6 +212,7 @@ def run_demo(registry: MetricsRegistry, latest_status: dict,
     multi = MultiAcceleratorUpgradeManager(
         cluster, policy, async_workers=False, clock=clock,
         poll_interval=0.0)
+    install_observability(multi, clock=clock)
 
     deadline = time.monotonic() + 120
     while time.monotonic() < deadline:
@@ -220,12 +248,19 @@ def main() -> int:
         format="%(asctime)s %(name)s %(levelname)s %(message)s")
     registry = MetricsRegistry()
     latest_status: dict = {}
+    # bound once a MultiAcceleratorUpgradeManager exists; the server
+    # starts first, so /explain routes through this holder
+    explain_binding: dict = {"fn": None}
     server = None
     if args.metrics_port:
         from tpu_operator_libs.examples.libtpu_operator import serve_metrics
 
-        server = serve_metrics(registry, args.metrics_port,
-                               status_source=latest_status)
+        server = serve_metrics(
+            registry, args.metrics_port, status_source=latest_status,
+            explain_source=lambda node: (
+                explain_binding["fn"](node)
+                if explain_binding["fn"] is not None
+                else {"node": node, "error": "operator not started"}))
     try:
         if args.demo:
             return run_demo(registry, latest_status)
@@ -236,6 +271,8 @@ def main() -> int:
                    else RealCluster.in_cluster())
         policy = load_unified_policy(args.policy)
         multi = MultiAcceleratorUpgradeManager(cluster, policy)
+        install_observability(multi)
+        explain_binding["fn"] = lambda node: explain_node(multi, node)
         stop = threading.Event()
         signal.signal(signal.SIGTERM, lambda *a: stop.set())
         signal.signal(signal.SIGINT, lambda *a: stop.set())

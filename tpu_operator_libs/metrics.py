@@ -55,6 +55,11 @@ class _HistData:
     bucket_counts: list[int]
     total: float = 0.0
     count: int = 0
+    #: bucket index (len(buckets) = +Inf) -> (trace_id, value) of the
+    #: most recent exemplar-carrying observation landing there —
+    #: rendered OpenMetrics-style on the bucket line, so a dashboard's
+    #: latency outlier links straight to its upgrade-journey trace.
+    exemplars: dict[int, tuple[str, float]] = field(default_factory=dict)
 
 
 @dataclass
@@ -95,12 +100,25 @@ def quantile_from_buckets(buckets: "tuple[float, ...]",
 
 
 class MetricsRegistry:
-    """Thread-safe gauge/counter store with Prometheus text rendering."""
+    """Thread-safe gauge/counter store with Prometheus text rendering.
 
-    def __init__(self, namespace: str = "tpu_upgrade") -> None:
+    ``max_label_sets`` bounds the labeled series per metric family —
+    the 100k-node guard: a family whose label values scale with the
+    fleet (per-endpoint serving gauges, a stray per-node label) stops
+    growing at the cap instead of eating the scrape; observations for
+    NEW label sets beyond it are dropped and counted in the
+    self-metric ``obs_dropped_label_sets_total{metric=...}`` (existing
+    series keep updating, and ``remove_series`` frees capacity).
+    """
+
+    def __init__(self, namespace: str = "tpu_upgrade",
+                 max_label_sets: int = 2048) -> None:
         self._ns = namespace
         self._metrics: dict[str, _Metric] = {}
         self._histograms: dict[str, _Histogram] = {}
+        self._max_label_sets = max_label_sets
+        #: family name -> observations dropped by the cardinality cap.
+        self._dropped: dict[str, int] = {}
         self._lock = threading.Lock()
 
     def _metric(self, name: str, help_: str, type_: str) -> _Metric:
@@ -111,6 +129,19 @@ class MetricsRegistry:
                 self._metrics[name] = m
             return m
 
+    def _admit_series(self, family: str, values: dict, key) -> bool:
+        """Cardinality guard (call with the lock held): True when the
+        series exists or fits under the cap; else count the drop."""
+        if key in values or len(values) < self._max_label_sets:
+            return True
+        self._dropped[family] = self._dropped.get(family, 0) + 1
+        return False
+
+    @property
+    def dropped_label_sets_total(self) -> int:
+        with self._lock:
+            return sum(self._dropped.values())
+
     @staticmethod
     def _key(labels: Optional[dict[str, str]]) -> tuple[tuple[str, str], ...]:
         return tuple(sorted((labels or {}).items()))
@@ -119,7 +150,9 @@ class MetricsRegistry:
              labels: Optional[dict[str, str]]) -> None:
         m = self._metric(name, help_, type_)
         with self._lock:
-            m.values[self._key(labels)] = value
+            key = self._key(labels)
+            if self._admit_series(name, m.values, key):
+                m.values[key] = value
 
     def set_gauge(self, name: str, value: float, help_: str = "",
                   labels: Optional[dict[str, str]] = None) -> None:
@@ -152,15 +185,22 @@ class MetricsRegistry:
         m = self._metric(name, help_, "counter")
         with self._lock:
             key = self._key(labels)
-            m.values[key] = m.values.get(key, 0.0) + by
+            if self._admit_series(name, m.values, key):
+                m.values[key] = m.values.get(key, 0.0) + by
 
     def observe_histogram(self, name: str, value: float, help_: str = "",
                           labels: Optional[dict[str, str]] = None,
-                          buckets: Optional[tuple[float, ...]] = None) -> None:
+                          buckets: Optional[tuple[float, ...]] = None,
+                          exemplar_trace_id: Optional[str] = None) -> None:
         """Record one observation (Prometheus histogram semantics: cumulative
         ``le`` buckets plus ``_sum``/``_count``). SURVEY.md §5 maps the
         reference's absent tracing to reconcile-duration metrics — this is
-        that seam."""
+        that seam.
+
+        ``exemplar_trace_id`` attaches an OpenMetrics exemplar to the
+        bucket this observation lands in (the lowest ``le`` containing
+        it), rendered as ``# {trace_id="..."} <value>`` — the link from
+        a histogram outlier to its upgrade-journey trace."""
         with self._lock:
             h = self._histograms.get(name)
             if h is None:
@@ -171,13 +211,19 @@ class MetricsRegistry:
             key = self._key(labels)
             data = h.values.get(key)
             if data is None:
+                if not self._admit_series(name, h.values, key):
+                    return
                 data = _HistData(bucket_counts=[0] * len(h.buckets))
                 h.values[key] = data
+            landed = len(h.buckets)  # +Inf unless a finite bucket fits
             for i, le in enumerate(h.buckets):
                 if value <= le:
                     data.bucket_counts[i] += 1
+                    landed = min(landed, i)
             data.total += value
             data.count += 1
+            if exemplar_trace_id is not None:
+                data.exemplars[landed] = (exemplar_trace_id, value)
 
     def histogram_stats(
             self, name: str, labels: Optional[dict[str, str]] = None,
@@ -260,16 +306,37 @@ class MetricsRegistry:
                 for key, data in sorted(h.values.items()):
                     base = ",".join(f'{k}="{v}"' for k, v in key)
                     sep = "," if base else ""
-                    for le, count in zip(h.buckets, data.bucket_counts):
+
+                    def _exemplar(index: int,
+                                  _data=data) -> str:
+                        ex = _data.exemplars.get(index)
+                        if ex is None:
+                            return ""
+                        trace_id, value = ex
+                        return (f' # {{trace_id="{trace_id}"}} '
+                                f"{value:g}")
+
+                    for i, (le, count) in enumerate(
+                            zip(h.buckets, data.bucket_counts)):
                         lines.append(
                             f'{h.name}_bucket{{{base}{sep}le="{le:g}"}} '
-                            f"{count}")
+                            f"{count}{_exemplar(i)}")
                     lines.append(
                         f'{h.name}_bucket{{{base}{sep}le="+Inf"}} '
-                        f"{data.count}")
+                        f"{data.count}{_exemplar(len(h.buckets))}")
                     suffix = f"{{{base}}}" if base else ""
                     lines.append(f"{h.name}_sum{suffix} {data.total:g}")
                     lines.append(f"{h.name}_count{suffix} {data.count}")
+            if self._dropped:
+                # the cardinality guard's self-metric: observations
+                # refused because a family hit max_label_sets
+                name = f"{self._ns}_obs_dropped_label_sets_total"
+                lines.append(
+                    f"# HELP {name} Observations dropped because the "
+                    f"metric family hit the label-set cardinality cap")
+                lines.append(f"# TYPE {name} counter")
+                for family, count in sorted(self._dropped.items()):
+                    lines.append(f'{name}{{metric="{family}"}} {count}')
         return "\n".join(lines) + "\n"
 
 
@@ -347,9 +414,14 @@ def observe_reconcile(registry: MetricsRegistry,
     export nothing rather than a misleading zero.
     """
     labels = {"driver": driver}
+    # exemplar: the journey most recently touched by this pass — the
+    # dashboard's link from a slow pass to the node activity inside it
+    obs = getattr(manager, "observability", None)
     registry.observe_histogram(
         "reconcile_pass_seconds", duration_seconds,
-        "Wall-clock seconds per build_state+apply_state pass", labels)
+        "Wall-clock seconds per build_state+apply_state pass", labels,
+        exemplar_trace_id=(obs.tracer.last_touched_trace_id
+                           if obs is not None else None))
     for s in ALL_STATES:
         registry.set_gauge(
             "reconcile_bucket_nodes", len(state.bucket(s)),
@@ -506,12 +578,15 @@ def observe_planner(registry: MetricsRegistry,
     if predictor is None:
         return
     labels = {"driver": driver}
+    obs = getattr(manager, "observability", None)
     for phase, seconds in predictor.drain_phase_samples():
         registry.observe_histogram(
             "planner_phase_seconds", seconds,
             "Observed per-node upgrade-phase durations (the duration "
             "model's learning inputs)", {**labels, "phase": phase},
-            buckets=PHASE_SECONDS_BUCKETS)
+            buckets=PHASE_SECONDS_BUCKETS,
+            exemplar_trace_id=(obs.tracer.last_trace_for_phase(phase)
+                               if obs is not None else None))
     for ratio in predictor.drain_forecast_errors():
         registry.observe_histogram(
             "planner_forecast_error_ratio", ratio,
@@ -1076,3 +1151,56 @@ def observe_serving_endpoints(registry: MetricsRegistry,
             "serving_generations_dropped_total", ep.dropped,
             "Generations lost to eviction (the gate drives this to 0)",
             ep_labels)
+
+
+def observe_journeys(registry: MetricsRegistry, obs: "object",
+                     driver: str = "libtpu") -> None:
+    """Export the journey tracer's + decision audit's accounting.
+
+    ``obs`` is a :class:`tpu_operator_libs.obs.OperatorObservability`.
+    Three families:
+
+    - per-phase duration histograms (``journey_phase_seconds`` labeled
+      by phase) with trace-id **exemplars** — the same evidence the
+      tracer assembled into spans, drained since the last scrape, so a
+      dashboard outlier links straight to its journey;
+    - journey counters/gauges — opened/resumed totals, completions by
+      outcome (``done`` / ``aborted`` / ``rolled-back``), and the
+      open-journey gauge (a fleet quiescing to 0 open journeys IS the
+      rollout finishing);
+    - audit-ring accounting — records recorded/dropped (the ring is
+      bounded by design; ``dropped`` moving only says history beyond
+      the window was discarded, decisions were not).
+    """
+    labels = {"driver": driver}
+    tracer = obs.tracer
+    for phase, seconds, trace_id in tracer.drain_phase_exemplars():
+        registry.observe_histogram(
+            "journey_phase_seconds", seconds,
+            "Per-node upgrade-phase durations from the journey "
+            "tracer's spans", {**labels, "phase": phase},
+            buckets=PHASE_SECONDS_BUCKETS,
+            exemplar_trace_id=trace_id)
+    registry.set_gauge(
+        "journeys_open", tracer.open_journeys,
+        "Nodes with an in-flight upgrade journey", labels)
+    registry.set_counter_total(
+        "journeys_opened_total", tracer.journeys_opened_total,
+        "Upgrade journeys opened (admissions + mid-flow adoptions)",
+        labels)
+    registry.set_counter_total(
+        "journeys_resumed_total", tracer.journeys_resumed_total,
+        "Journeys adopted mid-flow from durable state after an "
+        "operator restart or shard takeover", labels)
+    for outcome, count in sorted(tracer.completed_by_outcome.items()):
+        registry.set_counter_total(
+            "journeys_completed_total", count,
+            "Upgrade journeys closed, by outcome",
+            {**labels, "outcome": outcome})
+    audit = obs.audit
+    registry.set_counter_total(
+        "decision_records_total", audit.records_total,
+        "Decisions recorded by the audit ring", labels)
+    registry.set_counter_total(
+        "decision_records_dropped_total", audit.dropped_total,
+        "Audit records evicted by the bounded ring", labels)

@@ -1,0 +1,80 @@
+"""Upgrade-journey tracing and decision auditing.
+
+The operator makes layered, interacting per-node decisions — shard
+ownership, planner rank, maintenance window, capacity budget,
+canary/rollout halt, slice constraints — and each layer already exports
+gauges. What gauges cannot answer is the 3am question: *why is node X
+not upgrading, and what happened to the nodes that did?* This package
+is the layer that answers it:
+
+- :class:`~tpu_operator_libs.obs.tracer.UpgradeJourneyTracer` — per-node
+  span trees (admit → cordon → drain → pod-restart → validate → done,
+  plus the abort/rollback/failure arcs) assembled from the state
+  provider's ``transition_observer`` seam and the predictor's
+  crash-atomic phase-start stamps, so a journey survives operator
+  restarts and shard takeovers. Exported as OTLP-shaped JSON
+  (``dump_traces()``) and summarized per pass in
+  ``cluster_status["trace"]``.
+- :class:`~tpu_operator_libs.obs.audit.DecisionAudit` — a bounded
+  ring-buffer recorder threaded through every decision point in
+  ``apply_state`` (budget/capacity clamp, planner rank, window defer,
+  canary freeze, shard split, abort trigger); each record carries the
+  decision, its numeric inputs and the winning rule.
+- ``ClusterUpgradeStateManager.explain(node)`` — the public API over
+  both: the node's current blocking-reason chain plus its recent span
+  history, served at ``/explain/<node>`` by the example operators and
+  probed by the chaos gates (every parked node must explain itself).
+
+Install via ``manager.with_observability(OperatorObservability(keys,
+clock=clock))``; without it, not a single extra annotation is written
+and behavior is reference-identical.
+"""
+
+from __future__ import annotations
+
+from typing import Callable, Optional
+
+from tpu_operator_libs.consts import UpgradeKeys
+from tpu_operator_libs.obs.audit import DecisionAudit, DecisionRecord
+from tpu_operator_libs.obs.tracer import UpgradeJourneyTracer
+from tpu_operator_libs.util import Clock
+
+__all__ = [
+    "DecisionAudit",
+    "DecisionRecord",
+    "OperatorObservability",
+    "UpgradeJourneyTracer",
+]
+
+
+class OperatorObservability:
+    """One operator incarnation's observability bundle: the journey
+    tracer + the decision audit, plus the optional cross-replica
+    explain router.
+
+    ``peer_resolver`` (sharded deployments): ``shard -> object with an
+    explain(node_name) method`` (typically the owning replica's state
+    manager); ``ClusterUpgradeStateManager.explain`` routes a
+    non-owned node's query through it. Without a resolver the local
+    explain still answers from durable node state — the ring buffer
+    that died with a deposed owner is not required for a non-empty
+    blocking chain (see the handover regression in tests/test_obs.py).
+    """
+
+    def __init__(self, keys: Optional[UpgradeKeys] = None,
+                 clock: Optional[Clock] = None,
+                 max_completed_journeys: int = 256,
+                 max_audit_records: int = 8192) -> None:
+        self.keys = keys or UpgradeKeys()
+        self.clock = clock or Clock()
+        self.tracer = UpgradeJourneyTracer(
+            self.keys, clock=self.clock,
+            max_completed=max_completed_journeys)
+        self.audit = DecisionAudit(max_records=max_audit_records,
+                                   clock=self.clock)
+        #: shard -> explain()-bearing peer (see class docstring).
+        self.peer_resolver: Optional[Callable[[int], object]] = None
+
+    def dump_traces(self) -> dict:
+        """OTLP-shaped JSON export of every retained journey."""
+        return self.tracer.dump_traces()

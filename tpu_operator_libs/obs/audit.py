@@ -1,0 +1,235 @@
+"""Bounded ring-buffer decision recorder (the audit half of obs/).
+
+Every decision point in ``apply_state`` records what it decided, the
+numeric inputs it decided FROM, and the winning rule:
+
+- ``budget`` — the pass's slot math (static vs capacity-effective
+  budget, maxParallel, in-progress, the freeze);
+- ``shard-split`` — the global budget's durable per-shard split and
+  clamp;
+- ``canary`` — canary-wave restriction / fleet halt;
+- ``admit`` / ``hold`` — the planner's per-candidate verdict (LPT rank
+  for admits; the blocking rule for holds);
+- ``window`` — maintenance-window admit/defer with the predicted
+  completion;
+- ``abort`` / ``aborted`` — mid-flight abort trigger and completion.
+
+The buffer is deliberately in-memory and bounded (it dies with the
+process — durable truth stays on node labels/annotations, where
+``explain`` falls back when the ring is gone, e.g. after a shard
+takeover). ``mirror`` lets a harness keep its own cross-incarnation
+log: the chaos monitor wires it to audit every observed
+admission/abort edge against a matching record.
+"""
+
+from __future__ import annotations
+
+import threading
+from dataclasses import dataclass, field
+from typing import Callable, Optional
+
+from tpu_operator_libs.util import Clock
+
+#: kinds that concern the whole fleet (returned by latest_fleet and
+#: folded into every node's explain chain).
+FLEET_KINDS = ("budget", "canary", "shard-split", "pass")
+
+
+@dataclass(slots=True)
+class DecisionRecord:
+    """One decision, with everything needed to re-derive it."""
+
+    seq: int
+    pass_seq: int
+    at: float
+    kind: str
+    node: str  # "" for fleet-level decisions
+    decision: str
+    rule: str
+    inputs: dict = field(default_factory=dict)
+
+    def as_dict(self) -> dict:
+        return {"seq": self.seq, "pass": self.pass_seq,
+                "at": round(self.at, 3), "kind": self.kind,
+                "node": self.node, "decision": self.decision,
+                "rule": self.rule, "inputs": dict(self.inputs)}
+
+    def describe(self) -> str:
+        subject = self.node or "fleet"
+        inputs = ", ".join(f"{key}={value}" for key, value
+                           in sorted(self.inputs.items()))
+        return (f"[t={self.at:g} pass={self.pass_seq}] {self.kind} "
+                f"{subject}: {self.decision} ({self.rule})"
+                + (f" [{inputs}]" if inputs else ""))
+
+
+def _flatten_value(value):
+    """Scalars pass through; lists/tuples/dicts become nested tuples —
+    the ring must hold only GC-untrackable shapes (see class
+    docstring)."""
+    if isinstance(value, (list, tuple)):
+        return tuple(_flatten_value(v) for v in value)
+    if isinstance(value, dict):
+        return tuple(sorted(
+            (k, _flatten_value(v)) for k, v in value.items()))
+    return value
+
+
+class DecisionAudit:
+    """Thread-safe bounded decision ring.
+
+    ``mirror`` (optional) is called with every record OUTSIDE the
+    ring's retention — a monitor-held log that survives the recorder's
+    process; a mirror failure never blocks the decision.
+
+    Storage is flat tuples of scalars, rehydrated into
+    :class:`DecisionRecord` on read. Not a style choice: CPython's GC
+    *untracks* tuples that contain only untracked objects, while a
+    ring of 8k dataclass+dict records is ~30k tracked objects rescanned
+    on every gen2 collection — measured as most of the observability
+    layer's pass-time overhead at 1024 nodes (the same generational-GC
+    amplification ``OperatorManager.gc_freeze_after_sync`` exists
+    for)."""
+
+    def __init__(self, max_records: int = 8192,
+                 clock: Optional[Clock] = None) -> None:
+        self._clock = clock or Clock()
+        self._lock = threading.Lock()
+        #: (seq, pass_seq, at, kind, node, decision, rule, inputs_kv)
+        self._records: list[tuple] = []
+        self._max_records = max_records
+        self.mirror: Optional[Callable[[DecisionRecord], None]] = None
+        #: node -> rule of its most recent hold record (record_hold's
+        #: dedup memory; cleared by an admit/abort for the node).
+        self._last_hold_rule: dict[str, str] = {}
+        #: lifetime accounting (metrics feed)
+        self.records_total = 0
+        self.dropped_total = 0
+        self.pass_seq = 0
+
+    def begin_pass(self) -> int:
+        """Mark the start of one apply_state pass; fleet-level records
+        of the same pass share the returned sequence number."""
+        with self._lock:
+            self.pass_seq += 1
+            return self.pass_seq
+
+    def record_hold(self, node: str, rule: str,
+                    inputs: "Optional[dict]" = None) -> None:
+        """Record a planner hold, deduplicated on the blocking rule: a
+        node parked behind the same gate for 50 passes is ONE fact,
+        not 50 records — the dedup keeps a 1024-node fleet's steady
+        passes from churning the ring (and the audit overhead under
+        the bench's 3% budget) while a rule CHANGE (budget→canary)
+        still lands a fresh record. Any admit/abort record for the
+        node re-arms it.
+
+        The unchanged-rule check is deliberately lock-free (a GIL-safe
+        dict read): the planner calls this once per held candidate per
+        pass — O(fleet) — and taking the ring lock a thousand times a
+        pass was a measurable slice of the obs overhead budget. The
+        worst race is one duplicate hold record, which the ring
+        tolerates by design."""
+        if self._last_hold_rule.get(node) == rule:
+            return
+        with self._lock:
+            self._last_hold_rule[node] = rule
+        self.record("hold", node, decision="hold", rule=rule,
+                    inputs=inputs)
+
+    def record_holds(self, nodes: "list[str]", rule: str,
+                     inputs: "Optional[dict]" = None) -> None:
+        """Batch :meth:`record_hold` for a uniform rule: one C-speed
+        pass finds the changed nodes, and only those pay the record
+        path — the per-call overhead of a thousand no-op
+        ``record_hold`` calls per pass was itself a visible slice of
+        the obs overhead budget."""
+        last = self._last_hold_rule
+        changed = [node for node in nodes if last.get(node) != rule]
+        for node in changed:
+            self.record_hold(node, rule, inputs)
+
+    def record(self, kind: str, node: str, decision: str, rule: str,
+               inputs: "Optional[dict]" = None,
+               ) -> Optional[DecisionRecord]:
+        """Record one decision. Returns the rehydrated record only
+        when a mirror is installed (the harness path) — production
+        callers discard it, and rehydrating thousands of wave-time
+        records nobody reads is measurable overhead."""
+        flat_inputs = _flatten_value(inputs) if inputs else ()
+        with self._lock:
+            if node and kind != "hold":
+                # a non-hold decision supersedes the hold-dedup memory:
+                # the next hold is a NEW fact worth a fresh record
+                self._last_hold_rule.pop(node, None)
+            self.records_total += 1
+            row = (self.records_total, self.pass_seq,
+                   self._clock.now(), kind, node, decision, rule,
+                   flat_inputs)
+            self._records.append(row)
+            if len(self._records) > self._max_records:
+                overflow = len(self._records) - self._max_records
+                del self._records[:overflow]
+                self.dropped_total += overflow
+            mirror = self.mirror
+        if mirror is None:
+            return None
+        rec = self._rehydrate(row)
+        try:
+            mirror(rec)
+        except Exception:  # noqa: BLE001 — a harness hook must
+            pass  # never block the decision path
+        return rec
+
+    @staticmethod
+    def _rehydrate(row: tuple) -> DecisionRecord:
+        seq, pass_seq, at, kind, node, decision, rule, inputs_kv = row
+
+        def thaw(value):
+            if isinstance(value, tuple):
+                if value and all(isinstance(item, tuple)
+                                 and len(item) == 2
+                                 and isinstance(item[0], str)
+                                 for item in value):
+                    return {k: thaw(v) for k, v in value}
+                return [thaw(item) for item in value]
+            return value
+
+        return DecisionRecord(
+            seq=seq, pass_seq=pass_seq, at=at, kind=kind, node=node,
+            decision=decision, rule=rule,
+            inputs=thaw(inputs_kv) if inputs_kv else {})
+
+    # ------------------------------------------------------------------
+    # read side
+    # ------------------------------------------------------------------
+    def records_for(self, node: str,
+                    limit: int = 10) -> "list[DecisionRecord]":
+        """The node's most recent records, newest first."""
+        with self._lock:
+            rows = [row for row in reversed(self._records)
+                    if row[4] == node][:limit]
+        return [self._rehydrate(row) for row in rows]
+
+    def latest_fleet(self) -> "dict[str, DecisionRecord]":
+        """kind -> most recent fleet-level record (newest pass wins)."""
+        rows: dict[str, tuple] = {}
+        with self._lock:
+            for row in reversed(self._records):
+                if not row[4] and row[3] in FLEET_KINDS \
+                        and row[3] not in rows:
+                    rows[row[3]] = row
+                    if len(rows) == len(FLEET_KINDS):
+                        break
+        return {kind: self._rehydrate(row)
+                for kind, row in rows.items()}
+
+    def tail(self, limit: int = 50) -> "list[DecisionRecord]":
+        with self._lock:
+            rows = list(self._records[-limit:])
+        return [self._rehydrate(row) for row in rows]
+
+    @property
+    def retained(self) -> int:
+        with self._lock:
+            return len(self._records)

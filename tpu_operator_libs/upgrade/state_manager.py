@@ -190,6 +190,78 @@ class FlatPlanner:
         return selected
 
 
+class _AuditingPlanner:
+    """Outermost planner wrapper recording every per-candidate verdict
+    into the manager's DecisionAudit: selected nodes get an ``admit``
+    record (with their final rank — the LPT order after every inner
+    filter), unselected ones a ``hold`` record with the blocking rule
+    derived from the pass context (halt / canary cohort / exhausted
+    budget / multislice budget / planner ordering). Installed only when
+    observability is on; the inner chain's decisions are untouched."""
+
+    def __init__(self, inner: UpgradePlanner,
+                 manager: "ClusterUpgradeStateManager") -> None:
+        self.inner = inner
+        self._manager = manager
+
+    def plan(self, candidates: list[NodeUpgradeState], available: int,
+             state: "ClusterUpgradeState") -> list[NodeUpgradeState]:
+        selected = self.inner.plan(candidates, available, state)
+        manager = self._manager
+        audit = manager._obs.audit
+        chosen = {ns.node.metadata.name for ns in selected}
+        for rank, ns in enumerate(selected):
+            audit.record(
+                "admit", ns.node.metadata.name, decision="admit",
+                rule="planner",
+                inputs={"rank": rank, "slots": available})
+        # pass-wide context hoisted out of the per-candidate loop —
+        # this loop is O(fleet) every pass
+        rollout = manager._rollout
+        deferred = manager.multislice_deferred_slices
+        uniform_rule = None
+        if not rollout.halted and not rollout.canary_active \
+                and not deferred:
+            # the common regime: every held candidate blocks on the
+            # same rule, so a steady pass with no admissions and an
+            # unchanged (rule, candidate count) repeats facts the
+            # dedup would drop one by one — skip the O(fleet) loop
+            # outright (new arrivals still explain via the pass-level
+            # budget record)
+            uniform_rule = ("budget-exhausted" if available <= 0
+                            else "planner-held")
+            steady_key = (uniform_rule, len(candidates))
+            if not selected \
+                    and steady_key == manager._obs_last_steady_holds:
+                return selected
+            manager._obs_last_steady_holds = steady_key
+        inputs = {"slots": available, "candidates": len(candidates)}
+        if uniform_rule is not None:
+            # one batched dedup sweep (C-speed comprehension + the
+            # audit's changed-only filter) instead of a Python call
+            # per held candidate
+            audit.record_holds(
+                [name for ns in candidates
+                 if (name := ns.node.metadata.name) not in chosen],
+                uniform_rule, inputs=inputs)
+            return selected
+        for ns in candidates:
+            name = ns.node.metadata.name
+            if name in chosen:
+                continue
+            if rollout.halted:
+                rule = "rollout-halt"
+            elif rollout.canary_active and name not in rollout.cohort:
+                rule = "canary-cohort"
+            elif deferred and manager._node_pool(ns.node) in deferred:
+                rule = "multislice-budget"
+            else:
+                rule = ("budget-exhausted" if available <= 0
+                        else "planner-held")
+            audit.record_hold(name, rule, inputs=inputs)
+        return selected
+
+
 class ClusterUpgradeStateManager:
     """The state machine hub (upgrade_state.go:104-151)."""
 
@@ -286,6 +358,24 @@ class ClusterUpgradeStateManager:
         #: abort admission/completion — the chaos harness's
         #: abort-invariant feed (kind: "abort" | "aborted").
         self.abort_audit = None
+        # ---- journey tracing + decision audit (obs/) ----
+        #: OperatorObservability installed via with_observability; None
+        #: = reference behavior bit for bit (no tracer annotations, no
+        #: audit records, no trace block in cluster_status).
+        self._obs = None
+        #: The transition-observer functions currently composed into
+        #: the provider (predictor learning + journey tracer) — the
+        #: identity signature _install_transition_observer compares to
+        #: avoid re-wrapping every pass.
+        self._observer_parts: tuple = ()
+        #: The most recent build_state snapshot (any mode): the
+        #: read-side truth explain() answers from without touching the
+        #: cluster — safe under injected API faults.
+        self.last_state: Optional[ClusterUpgradeState] = None
+        #: (rule, candidate count) of the last uniform-rule hold sweep
+        #: — _AuditingPlanner's steady-pass skip memo (reset implicitly
+        #: by any change in either component).
+        self._obs_last_steady_holds: "Optional[tuple]" = None
 
         #: DaemonSet inputs of the most recent build (uid -> DS): the
         #: budget-share ledger / oracle discovery surface.
@@ -468,6 +558,95 @@ class ClusterUpgradeStateManager:
             self._capacity.nudger = nudger
         return self
 
+    def with_observability(
+            self, obs: "Optional[object]",
+    ) -> "ClusterUpgradeStateManager":
+        """Install (or clear) the journey-tracer + decision-audit
+        bundle (:class:`tpu_operator_libs.obs.OperatorObservability`).
+
+        With it installed: every durable transition grows a span in the
+        node's journey (trace-id annotation riding the same merge
+        patch, so journeys survive crashes and takeovers), every
+        admission/hold/defer/abort decision lands in the bounded audit
+        ring, ``cluster_status`` gains a ``"trace"`` block, and
+        :meth:`explain` answers from the ring + the last snapshot.
+        ``None`` restores reference behavior exactly."""
+        self._obs = obs
+        self._install_transition_observer(
+            predictor_active=self._observer_parts[:1] == (
+                getattr(self._predictor, "observe_transition", None),))
+        return self
+
+    @property
+    def observability(self) -> "Optional[object]":
+        return self._obs
+
+    def _install_transition_observer(self,
+                                     predictor_active: bool) -> None:
+        """(Re)compose the provider's single ``transition_observer``
+        slot from the active parts: the predictor's learning observer
+        (policy-driven, first — its stamps are load-bearing) and the
+        journey tracer (whenever obs is installed). Annotation updates
+        merge with first-writer-wins on collision (the parts use
+        disjoint keys); a part failing never blocks the others or the
+        commit."""
+        parts = []
+        if predictor_active and self._predictor is not None:
+            parts.append(self._predictor.observe_transition)
+        if self._obs is not None:
+            parts.append(self._obs.tracer.observe_transition)
+        desired = tuple(parts)
+        if desired == self._observer_parts and (
+                desired or getattr(self.provider, "transition_observer",
+                                   None) is None):
+            return
+        self._observer_parts = desired
+        if not hasattr(self.provider, "transition_observer"):
+            return
+        if not desired:
+            self.provider.transition_observer = None
+        elif len(desired) == 1:
+            self.provider.transition_observer = desired[0]
+        else:
+            assert len(desired) == 2, "compose supports two observers"
+
+            def composed(node, old_label, new_label,
+                         _first=desired[0], _second=desired[1]):
+                # two-part fast path (predictor + tracer is the only
+                # composition today): no merge allocation unless BOTH
+                # return updates — the common intermediate transition
+                # returns None from both, and this runs inside the
+                # commit path for every durable transition
+                try:
+                    first = _first(node, old_label, new_label)
+                except Exception:  # noqa: BLE001 — one observer
+                    # failing must not starve the other
+                    logger.warning(
+                        "transition observer %r failed for node %s "
+                        "(%r -> %r); continuing", _first,
+                        node.metadata.name, old_label, new_label,
+                        exc_info=True)
+                    first = None
+                try:
+                    second = _second(node, old_label, new_label)
+                except Exception:  # noqa: BLE001
+                    logger.warning(
+                        "transition observer %r failed for node %s "
+                        "(%r -> %r); continuing", _second,
+                        node.metadata.name, old_label, new_label,
+                        exc_info=True)
+                    second = None
+                if not second:
+                    return first
+                if not first:
+                    return second
+                # first writer wins on collision (disjoint keys today)
+                merged = dict(second)
+                merged.update(first)
+                return merged
+
+            self.provider.transition_observer = composed
+
     @property
     def planner(self) -> UpgradePlanner:
         """The explicitly-set planner, or the flat default. Assigning here
@@ -581,6 +760,10 @@ class ClusterUpgradeStateManager:
         state = self._assemble_state(daemon_sets, pods, nodes_by_name)
         self.last_snapshot_build_seconds = _time.perf_counter() - started
         self.snapshot_build_seconds_total += self.last_snapshot_build_seconds
+        # retained for read-side consumers (explain, status probes):
+        # a reference, not a copy — apply_state mutates it in place,
+        # which is exactly the freshness explain wants
+        self.last_state = state
         return state
 
     def _full_inputs(self, namespace: str, selector: str,
@@ -1135,6 +1318,19 @@ class ClusterUpgradeStateManager:
             "recorded": {str(s): recorded[s] for s in sorted(recorded)},
             "cap": cap,
         }
+        if self._obs is not None:
+            entitled_own = sum(entitled[s] for s in owned)
+            self._obs.audit.record(
+                "shard-split", "", decision=f"cap={cap}",
+                rule=("global-clamp" if cap < entitled_own
+                      else "share-ledger"),
+                inputs={
+                    "globalBudget": global_budget,
+                    "ownedShards": sorted(owned),
+                    "entitledOwned": entitled_own,
+                    "othersRecorded": others,
+                    "maxParallel": max_parallel,
+                })
         return cap, max_parallel
 
     # ------------------------------------------------------------------
@@ -1153,8 +1349,16 @@ class ClusterUpgradeStateManager:
         self.last_pass_deferrals = 0
         with self._deferral_lock:
             self._pass_slots_freed = 0
+        obs = self._obs
+        if obs is not None:
+            obs.audit.begin_pass()
         if policy is None or not policy.auto_upgrade:
             logger.info("auto upgrade is disabled, skipping")
+            if obs is not None:
+                obs.audit.record(
+                    "pass", "", decision="skipped",
+                    rule="auto-upgrade-disabled",
+                    inputs={"policy": policy is not None})
             self._rollout = RolloutDecision()
             # no planning happens while disabled: previously reported
             # deferrals would otherwise go permanently stale
@@ -1190,6 +1394,18 @@ class ClusterUpgradeStateManager:
         self._rollout = self.rollout_guard.assess(
             full_state, policy, self.pod_manager,
             shard_context=shard_context)
+        if obs is not None and (self._rollout.halted
+                                or self._rollout.canary_active):
+            obs.audit.record(
+                "canary", "",
+                decision="halt" if self._rollout.halted
+                else "canary-wave",
+                rule="quarantined-revision" if self._rollout.halted
+                else "canary-cohort",
+                inputs={
+                    "quarantined": sorted(self._rollout.quarantined),
+                    "cohort": len(self._rollout.cohort or ()),
+                })
         if self._rollout.quarantined:
             self._admit_rollback_nodes(state, policy)
 
@@ -1202,6 +1418,7 @@ class ClusterUpgradeStateManager:
         # maxEffectiveBudget, peaks shrink or pause it). Without a
         # signal the controller returns the static budget unchanged.
         capacity = self._capacity_for_policy(policy)
+        static_unavailable: Optional[int] = None
         if self._shard_view is None or self.last_shard_status is None:
             # single-owner semantics (also the fallback for a snapshot
             # built before with_sharding was installed: no census means
@@ -1210,6 +1427,7 @@ class ClusterUpgradeStateManager:
             if policy.max_unavailable is not None:
                 max_unavailable = scaled_value_from_int_or_percent(
                     policy.max_unavailable, total_nodes, round_up=True)
+            static_unavailable = max_unavailable
             if capacity is not None:
                 max_unavailable = capacity.effective_budget(
                     max_unavailable)
@@ -1230,6 +1448,7 @@ class ClusterUpgradeStateManager:
         self._admit_abort_nodes(state, policy, capacity, max_unavailable)
         upgrades_available = self.get_upgrades_available(
             state, max_parallel, max_unavailable)
+        frozen_by_capacity = False
         if capacity is not None and capacity.budget_falling:
             # admission hysteresis: a CONTRACTING budget (spike/kill
             # ramp in progress) admits nothing — a node admitted now
@@ -1239,12 +1458,14 @@ class ClusterUpgradeStateManager:
             # admission resumes the first pass the budget stops
             # falling.
             upgrades_available = 0
+            frozen_by_capacity = True
         in_progress = self.get_upgrades_in_progress(state)
+        unavailable_now = self.get_current_unavailable_nodes(state)
         logger.info(
             "upgrades in progress: %d, available slots: %d, "
             "unavailable nodes: %d/%d",
             in_progress, upgrades_available,
-            self.get_current_unavailable_nodes(state), max_unavailable)
+            unavailable_now, max_unavailable)
         # in-flight window observability: how full is the budget the
         # throttle lets us spend? (the eager refill exists to keep this
         # saturated — see _eager_slot_refill)
@@ -1289,6 +1510,35 @@ class ClusterUpgradeStateManager:
         # and every budget/slice admission decision stay with the inner
         # chain untouched.
         planner = self._wrap_predictive(policy, planner)
+        if obs is not None:
+            # the pass's slot math, with the winning rule: the record
+            # every parked node's explain chain hangs off
+            if self._rollout.halted:
+                rule = "rollout-halt"
+            elif frozen_by_capacity:
+                rule = "capacity-falling-freeze"
+            elif upgrades_available <= 0:
+                rule = ("budget-saturated" if in_progress > 0
+                        else "unavailable-at-cap")
+            else:
+                rule = "slots-free"
+            inputs = {
+                "totalNodes": total_nodes,
+                "inProgress": in_progress,
+                "unavailable": unavailable_now,
+                "effectiveBudget": max_unavailable,
+                "maxParallel": max_parallel,
+            }
+            if static_unavailable is not None:
+                inputs["staticBudget"] = static_unavailable
+            obs.audit.record(
+                "budget", "", decision=f"slots={upgrades_available}",
+                rule=rule, inputs=inputs)
+            # audit wrapper OUTERMOST: it sees the final candidate
+            # list and the final selection, so every admission edge
+            # has a matching record and every held candidate gets its
+            # blocking rule
+            planner = _AuditingPlanner(planner, self)
         self.process_upgrade_required_nodes(
             state, upgrades_available, planner=planner)
         self.process_abort_required_nodes(state)
@@ -1559,9 +1809,9 @@ class ClusterUpgradeStateManager:
         written)."""
         spec = policy.predictor
         if spec is None or not spec.enable:
-            if getattr(self.provider, "transition_observer", None) \
-                    is not None:
-                self.provider.transition_observer = None
+            # no predictor: the tracer (when installed) stays the sole
+            # observer; with neither, not a single annotation is written
+            self._install_transition_observer(predictor_active=False)
             if policy.maintenance_window is not None \
                     and policy.maintenance_window.enable:
                 logger.warning(
@@ -1574,18 +1824,42 @@ class ClusterUpgradeStateManager:
         )
 
         self._predictor_for_policy(policy)
-        if getattr(self.provider, "transition_observer", None) \
-                is not self._predictor.observe_transition:
-            self.provider.transition_observer = \
-                self._predictor.observe_transition
+        self._install_transition_observer(predictor_active=True)
         if self._predictive_planner is None:
             self._predictive_planner = PredictiveWavePlanner(
                 inner, self._predictor, clock=self.clock)
         wrapper = self._predictive_planner
         wrapper.inner = inner
         wrapper.window = policy.maintenance_window
-        wrapper.audit = self.window_audit
+        wrapper.audit = self._window_audit_hooks()
         return wrapper
+
+    def _window_audit_hooks(self):
+        """The window admit/defer hook handed to the predictive
+        planner: the externally-installed ``window_audit`` (the chaos
+        monitor's invariant feed) fanned out with the decision audit's
+        recorder, either alone when only one is present."""
+        hooks = [hook for hook in (self.window_audit,
+                                   self._obs_window_hook
+                                   if self._obs is not None else None)
+                 if hook is not None]
+        if not hooks:
+            return None
+        if len(hooks) == 1:
+            return hooks[0]
+
+        def fan_out(kind, node, at, predicted_done, _hooks=tuple(hooks)):
+            for hook in _hooks:
+                hook(kind, node, at, predicted_done)
+
+        return fan_out
+
+    def _obs_window_hook(self, kind: str, node: str, at: float,
+                         predicted_done: float) -> None:
+        self._obs.audit.record(
+            "window", node, decision=kind, rule="maintenance-window",
+            inputs={"predictedDone": round(predicted_done, 1),
+                    "at": round(at, 1)})
 
     def _predictor_for_policy(self, policy: UpgradePolicySpec) -> "object":
         """The duration predictor for this pass, created/refreshed from
@@ -2059,6 +2333,21 @@ class ClusterUpgradeStateManager:
                     reason = "capacity"
                 if reason is None:
                     continue
+                if self._obs is not None:
+                    # recorded BEFORE the write attempt: the decision
+                    # exists even if the commit defers, and the chaos
+                    # monitor's edge audit never races a crash landing
+                    # between the write and the record
+                    self._obs.audit.record(
+                        "abort", ns.node.metadata.name,
+                        decision="abort", rule=reason,
+                        inputs={
+                            "source": str(source),
+                            "needCapacity": need_capacity,
+                            "effectiveBudget": effective_budget,
+                            **({"closeAt": round(close, 1)}
+                               if close is not None else {}),
+                        })
                 with self._defer_node_on_transient(ns.node,
                                                    "abort admit"):
                     if self.provider.change_node_upgrade_state(
@@ -2124,6 +2413,10 @@ class ClusterUpgradeStateManager:
             # else: the node was cordoned BEFORE the upgrade began —
             # the abort restores that state, so the cordon AND its
             # memory stay (the next admission re-enters with both)
+            if self._obs is not None:
+                self._obs.audit.record(
+                    "aborted", name, decision="back-to-required",
+                    rule="abort-complete", inputs={})
             if self.provider.change_node_upgrade_state(
                     node, UpgradeState.UPGRADE_REQUIRED,
                     annotations=annotations):
@@ -2481,6 +2774,14 @@ class ClusterUpgradeStateManager:
                 # timeout/canary-bake/…): the event-driven layer's
                 # lifetime activity, matching observe_latency's counters
                 status["wakeups"] = wakeups
+        if self._obs is not None:
+            # the journey-tracer roll-up: open/completed journeys,
+            # outcome split, duration percentiles, the most recent
+            # closed traces — cluster_status's answer to "what
+            # happened to the nodes that did upgrade"
+            trace_block = self._obs.tracer.summary()
+            if trace_block:
+                status["trace"] = trace_block
         return status
 
     def _topology_status(self, state: ClusterUpgradeState,
@@ -2517,6 +2818,235 @@ class ClusterUpgradeStateManager:
             out["degradedSlices"] = {
                 sid: list(hosts) for sid, hosts in sorted(degraded.items())}
         return out
+
+    # ------------------------------------------------------------------
+    # explain (obs/ public API)
+    # ------------------------------------------------------------------
+    def explain(self, node_name: str) -> dict:
+        """Why is this node not upgrading — and what happened to it?
+
+        Returns ``{"node", "state", "blocking": [reason, ...],
+        "records": [...], "trace": [...]}``: the current
+        blocking-reason chain (ordered outermost rule first), the
+        node's recent DecisionAudit records, and its recent journey
+        spans. Everything is answered from in-memory state (the last
+        snapshot, the audit ring, the tracer) — no cluster read, so it
+        cannot fail on an apiserver fault, and it works on whatever
+        the operator last knew even mid-incident.
+
+        Under sharding the query routes: a node owned by another
+        replica's shard is forwarded through
+        ``observability.peer_resolver`` when one is installed (the
+        owning replica's audit has the records); otherwise the local
+        answer is derived from durable node state alone and marked
+        with the owning shard — which is also the handover story: a
+        dead owner's ring buffer is gone, but the label + stamps are
+        not, so the chain is never empty (pinned by the handover
+        regression in tests/test_obs.py).
+        """
+        out: dict = {"node": node_name}
+        obs = self._obs
+        view = self._shard_view
+        if view is not None:
+            entry = self._census_entries.get(node_name)
+            shard = entry[0] if entry is not None else None
+            if shard is None:
+                pool = None
+                state = self._last_full_state or self.last_state
+                if state is not None:
+                    for bucket in state.node_states.values():
+                        for ns in bucket:
+                            if ns.node.metadata.name == node_name:
+                                pool = self._node_pool(ns.node)
+                                break
+                if pool is None:
+                    # a mid-restart node on another partition may be
+                    # absent from the snapshot — one guarded (usually
+                    # cached) node read resolves its pool for ROUTING
+                    # only; on any fault the local fallback below
+                    # still answers from what this replica knows
+                    try:
+                        pool = self._node_pool(
+                            self.client.get_node(node_name))
+                    except Exception:  # noqa: BLE001 — explain must
+                        pool = None  # answer, not raise, mid-incident
+                if pool is not None and hasattr(view, "ring"):
+                    shard = view.ring.shard_for(node_name, pool)
+            if shard is not None and shard not in view.owned_shards():
+                out["ownedByShard"] = shard
+                out["local"] = False
+                resolver = getattr(obs, "peer_resolver", None)
+                if resolver is not None:
+                    try:
+                        peer = resolver(shard)
+                    except Exception:  # noqa: BLE001 — routing must
+                        peer = None  # not break the local answer
+                    if peer is not None:
+                        routed = peer.explain(node_name)
+                        routed["routedVia"] = shard
+                        return routed
+                out.update(self._explain_local(node_name))
+                out["blocking"].insert(
+                    0, f"owned by shard {shard} (not this replica): "
+                    f"answer derived from durable node state; query "
+                    f"the owning replica's /explain for its audit "
+                    f"ring")
+                return out
+        out.update(self._explain_local(node_name))
+        return out
+
+    def _explain_local(self, node_name: str) -> dict:
+        from tpu_operator_libs.upgrade.predictor import (
+            PHASE_OF_STATE,
+            _parse_stamp,
+        )
+
+        obs = self._obs
+        out: dict = {"blocking": []}
+        chain: list[str] = out["blocking"]
+        # under sharding prefer the unfiltered snapshot: a routed (or
+        # fallback) explain for a node outside this partition must
+        # still see its labels/annotations
+        state = self._last_full_state or self.last_state
+        node = None
+        label = None
+        if state is not None:
+            for bucket_label, bucket in state.node_states.items():
+                for ns in bucket:
+                    if ns.node.metadata.name == node_name:
+                        node = ns.node
+                        label = bucket_label
+                        break
+                if node is not None:
+                    break
+        if node is None:
+            chain.append(
+                "node not in the last snapshot (no snapshot built yet "
+                "this incarnation, node vanished, or it is outside "
+                "the managed selector)")
+            out["state"] = "unknown"
+        else:
+            label = node.metadata.labels.get(
+                self.keys.state_label, label or "")
+            out["state"] = label or "unknown"
+            annotations = node.metadata.annotations
+            done = str(UpgradeState.DONE)
+            required = str(UpgradeState.UPGRADE_REQUIRED)
+            if node.metadata.labels.get(self.keys.skip_label) \
+                    == TRUE_STRING:
+                chain.append(f"skip label {self.keys.skip_label} set: "
+                             f"node opted out of upgrades")
+            if label == done:
+                if not chain:
+                    chain.append("upgrade complete — nothing blocking")
+            elif label in ("", required):
+                self._explain_parked(chain, node, annotations)
+            elif label == str(UpgradeState.FAILED):
+                chain.append(
+                    "parked in upgrade-failed (validation timeout or "
+                    "unrecoverable pod) — waiting for remediation, "
+                    "rollback, or manual repair")
+                condemned = self.topology_keys
+                rem_note = annotations.get(
+                    f"{condemned.domain}/{condemned.driver}"
+                    "-remediation.condemned-at")
+                if rem_note:
+                    chain.append(f"condemned at {rem_note} — slice "
+                                 f"reconfiguration may be in flight")
+            else:
+                phase = PHASE_OF_STATE.get(label)
+                detail = f"mid-flight: {label}"
+                stamp_phase, stamp_at = _parse_stamp(
+                    annotations.get(self.keys.phase_start_annotation))
+                if stamp_phase is not None:
+                    elapsed = max(0.0, self.clock.now() - stamp_at)
+                    detail += (f" ({stamp_phase} phase, "
+                               f"{elapsed:.0f}s elapsed")
+                    if self._predictor is not None and phase is not None:
+                        remaining = self._predictor.remaining_seconds(
+                            node_name, label, annotations,
+                            self.clock.now())
+                        detail += f", ~{remaining:.0f}s predicted left"
+                    detail += ")"
+                chain.append(detail)
+        if obs is not None:
+            records = obs.audit.records_for(node_name, limit=10)
+            out["records"] = [rec.as_dict() for rec in records]
+            fleet = obs.audit.latest_fleet()
+            if fleet:
+                out["fleet"] = {kind: rec.as_dict()
+                                for kind, rec in sorted(fleet.items())}
+            trace = obs.tracer.spans_for(node_name)
+            if trace:
+                out["trace"] = trace
+        if not chain:
+            # structurally unreachable for a parked node, but explain
+            # must NEVER answer with silence — that is the artifact
+            # gap this layer exists to close
+            chain.append(f"state {out.get('state')!r}: no blocking "
+                         f"rule derived; see records")
+        return out
+
+    def _explain_parked(self, chain: "list[str]", node: Node,
+                        annotations: "dict[str, str]") -> None:
+        """The blocking chain for a node sitting in upgrade-required /
+        unknown: outermost gate first, derived from the same pass state
+        the admission decisions read."""
+        obs = self._obs
+        name = node.metadata.name
+        if self._rollout.halted:
+            chain.append(
+                f"fleet halted: revision(s) "
+                f"{sorted(self._rollout.quarantined)} quarantined — "
+                f"no admissions until rollback completes")
+        elif self._rollout.canary_active \
+                and name not in self._rollout.cohort:
+            chain.append(
+                f"canary wave in flight ({len(self._rollout.cohort)} "
+                f"cohort node(s)): admissions restricted to the "
+                f"cohort until the bake passes")
+        latest = obs.audit.records_for(name, limit=5) \
+            if obs is not None else []
+        for rec in latest:
+            if rec.kind == "window" and rec.decision == "defer":
+                chain.append(
+                    f"maintenance window: predicted completion "
+                    f"t={rec.inputs.get('predictedDone')} crosses the "
+                    f"close — deferred untouched")
+                break
+            if rec.kind == "hold":
+                chain.append(f"held by planner: {rec.rule} "
+                             f"(slots={rec.inputs.get('slots')})")
+                break
+            if rec.kind in ("admit", "aborted"):
+                break
+        slots = self.last_pass_slots
+        if slots is not None and slots.get("available", 0) <= 0:
+            chain.append(
+                f"no admission slots at the last pass: "
+                f"{slots['inProgress']} in flight / budget "
+                f"{slots['budget']}")
+        capacity = self._capacity
+        if capacity is not None and capacity.last_status is not None:
+            status = capacity.last_status
+            if status.get("paused"):
+                chain.append(
+                    "admission paused: serving utilization at peak "
+                    f"(demand {status.get('demand')} vs capacity "
+                    f"{status.get('capacityAvailable')})")
+            elif getattr(capacity, "budget_falling", False):
+                chain.append(
+                    "admission frozen: effective budget falling "
+                    "(traffic ramp in progress)")
+        deferred = self.multislice_deferred_slices
+        if deferred and self._node_pool(node) in deferred:
+            chain.append(
+                f"slice {self._node_pool(node)} deferred: its DCN "
+                f"job's member budget is exhausted")
+        if not chain:
+            chain.append(
+                "waiting in upgrade-required: eligible for the next "
+                "admission wave (no gate currently blocks it)")
 
     # ------------------------------------------------------------------
     # chained reconcile
