@@ -4,7 +4,7 @@ PYTHON ?= python3
 PYTEST_FLAGS ?= -q
 COV_THRESHOLD ?= 85
 
-.PHONY: all check test test-fast test-fault test-chaos test-soak test-scale test-rollout test-latency test-reconfig test-shard test-planner test-budget test-obs lint cov bench bench-reconcile bench-latency bench-shard bench-shard-100k bench-planner bench-budget bench-obs graft-check package clean diagram
+.PHONY: all check test test-fast test-fault test-chaos test-soak test-scale test-rollout test-latency test-reconfig test-shard test-planner test-budget test-obs test-federation lint cov bench bench-reconcile bench-latency bench-shard bench-shard-100k bench-planner bench-budget bench-obs bench-federation graft-check package clean diagram
 
 all: lint test
 
@@ -171,6 +171,24 @@ bench-planner:
 # `pytest -m budget`).
 test-budget:
 	$(PYTHON) -m pytest tests/ $(PYTEST_FLAGS) -m "budget and not slow"
+
+# Multi-cluster federation slice (`federation` marker): ledger/
+# controller/policy units, explain_region, the bench smoke, and the
+# two seeded federation chaos gates — regional-controller kills,
+# federation<->region partitions and federation-controller kills on
+# the good-path rollout (seeds 1-3 tier-1, 4-10 slow), plus the
+# bad-revision containment flavor (canary region halts, quarantine
+# lifts fleet-wide, zero non-canary admissions). Widen with
+# CHAOS_SEEDS like the other gates.
+test-federation:
+	$(PYTHON) -m pytest tests/ $(PYTEST_FLAGS) -m "federation and not slow"
+
+# Federation rollout proof: 4 simulated regions, fault-free —
+# region-as-canary makespan + canary-halt -> fleet-quarantine latency
+# with zero non-canary bad admissions (tools/federation_bench.py;
+# docs/benchmarks.md §2i). Writes BENCH_federation.json.
+bench-federation:
+	$(PYTHON) tools/federation_bench.py --out BENCH_federation.json
 
 # Upgrade-journey tracing + decision-audit slice (`obs` marker):
 # tracer/audit units, explain-under-sharding incl. the handover

@@ -181,11 +181,16 @@ class TestCrdManifest:
         yaml = pytest.importorskip(
             "yaml", reason="drift check compares parsed structures")
         root = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+        from tpu_operator_libs.api.crd import federation_policy_schema
+
         expected = {
             "tpuupgradepolicy.yaml": build_crd(),
             "unifiedupgradepolicy.yaml": build_crd(
                 kind="UnifiedUpgradePolicy",
                 spec_schema=unified_policy_schema()),
+            "tpufederationpolicy.yaml": build_crd(
+                kind="TPUFederationPolicy",
+                spec_schema=federation_policy_schema()),
         }
         for name, manifest in expected.items():
             path = os.path.join(root, "examples", "crd", name)

@@ -757,3 +757,90 @@ class TestPdbControllerDeclaredBase:
         cluster.evict_pod("tpu-system", "w0")  # 50% of live 2 = 1, ok
         with pytest.raises(EvictionBlockedError):
             cluster.evict_pod("tpu-system", "w1")
+
+
+class TestWatchDelayFault:
+    """delay_watch_events: seed-pure delayed/reordered watch delivery
+    (the FAULT_WATCH_DELAY chaos fault, distinct from stream drops)."""
+
+    def _cluster(self):
+        clock = FakeClock(start=0.0)
+        cluster = FakeCluster(clock=clock)
+        NodeBuilder("n1").create(cluster)
+        NodeBuilder("n2").create(cluster)
+        return cluster, clock
+
+    def test_buffers_then_releases_with_order_preserved_per_object(self):
+        cluster, clock = self._cluster()
+        normal = cluster.watch()
+        exempt = cluster.watch(delay_exempt=True)
+        cluster.delay_watch_events(10.0, 30.0, seed=3)
+        clock.advance(10.0)
+        cluster.step()
+        cluster.patch_node_labels("n1", {"a": "1"})
+        cluster.patch_node_labels("n1", {"a": "2"})
+        cluster.patch_node_labels("n2", {"b": "1"})
+        # exempt stream (the invariant monitor) sees everything live
+        exempt_events = []
+        while True:
+            event = exempt.get(timeout=0.0)
+            if event is None:
+                break
+            exempt_events.append(event)
+        assert len(exempt_events) == 3
+        # the non-exempt stream is silent: stale with NO relist signal
+        assert normal.get(timeout=0.0) is None
+        assert not normal.stopped
+        # window closes: the backlog lands, per-object order preserved
+        clock.advance(20.0)
+        cluster.step()
+        released = []
+        while True:
+            event = normal.get(timeout=0.0)
+            if event is None:
+                break
+            released.append(event)
+        assert len(released) == 3
+        assert cluster.watch_delay_released == 3
+        n1_values = [e.object.metadata.labels.get("a")
+                     for e in released
+                     if e.object.metadata.name == "n1"]
+        assert n1_values == ["1", "2"]
+
+    def test_events_outside_window_flow_normally(self):
+        cluster, clock = self._cluster()
+        normal = cluster.watch()
+        cluster.delay_watch_events(10.0, 20.0, seed=1)
+        cluster.patch_node_labels("n1", {"pre": "1"})
+        assert normal.get(timeout=0.0) is not None  # before the window
+        clock.advance(25.0)
+        cluster.step()
+        cluster.patch_node_labels("n1", {"post": "1"})
+        assert normal.get(timeout=0.0) is not None  # after the window
+
+    def test_release_order_is_seed_pure_across_kinds(self):
+        def run(seed):
+            clock = FakeClock(start=0.0)
+            cluster = FakeCluster(clock=clock)
+            NodeBuilder("n1").create(cluster)
+            PodBuilder("p1", "tpu-system").on_node("n1").create(cluster)
+            watch = cluster.watch()
+            while watch.get(timeout=0.0) is not None:
+                pass  # drain creation events
+            cluster.delay_watch_events(5.0, 15.0, seed=seed)
+            clock.advance(5.0)
+            cluster.step()
+            cluster.patch_node_labels("n1", {"x": "1"})
+            cluster.set_pod_status("tpu-system", "p1", ready=False)
+            clock.advance(10.0)
+            cluster.step()
+            kinds = []
+            while True:
+                event = watch.get(timeout=0.0)
+                if event is None:
+                    break
+                kinds.append(event.kind)
+            return tuple(kinds)
+
+        assert run(7) == run(7)  # deterministic in the seed
+        assert set(run(7)) == {"Node", "Pod"}

@@ -1,0 +1,385 @@
+"""Multi-cluster federation: region-as-canary global rollouts.
+
+Ledger/controller/policy units, explain_region, the federation chaos
+gates (regional-controller kill, federation↔region partition,
+federation-controller kill; plus the bad-revision containment flavor)
+and the bench smoke. ``make test-federation``.
+"""
+
+import os
+
+import pytest
+
+pytestmark = [pytest.mark.federation]
+
+from tpu_operator_libs.api.federation_policy import (
+    FederationPolicySpec,
+)
+from tpu_operator_libs.api.upgrade_policy import PolicyValidationError
+from tpu_operator_libs.chaos.federation import (
+    FED_FINAL_REVISION,
+    FederationChaosConfig,
+    FederationFleetSim,
+    FederationMonitor,
+    run_federation_bad_revision_soak,
+    run_federation_soak,
+)
+from tpu_operator_libs.chaos.injector import BAD_REVISION_HASH
+from tpu_operator_libs.chaos.schedule import (
+    FAULT_BAD_REVISION,
+    FAULT_FED_KILL,
+    FAULT_FED_PARTITION,
+    FAULT_OPERATOR_CRASH,
+    FAULT_REGION_KILL,
+    FaultSchedule,
+)
+from tpu_operator_libs.consts import FederationKeys
+from tpu_operator_libs.federation import FederationBudgetLedger
+from tpu_operator_libs.simulate import NS
+
+#: The fixed gate seeds: 1-3 tier-1, the rest slow (acceptance: all
+#: ten green with zero violations; widen via CHAOS_SEEDS).
+TIER1_SEEDS = (1, 2, 3)
+SLOW_SEEDS = tuple(range(4, 11))
+
+
+def _small_config(**overrides) -> FederationChaosConfig:
+    """A 2-3-region shape small enough for unit-level episodes."""
+    defaults = dict(regions=("asia", "europe"), n_slices=1,
+                    hosts_per_slice=2, pod_recreate_delay=2.0,
+                    pod_ready_delay=5.0, bake_seconds=20,
+                    region_bake_seconds=5, max_steps=200)
+    defaults.update(overrides)
+    return FederationChaosConfig(**defaults)
+
+
+def _drive(sim: FederationFleetSim, target: str, steps: int,
+           monitor: "FederationMonitor | None" = None) -> None:
+    for _ in range(steps):
+        if sim.fed is not None:
+            sim.fed.reconcile(target)
+        sim.reconcile_regions(monitor=monitor)
+        if monitor is not None:
+            monitor.sample()
+        sim.step_clusters()
+
+
+def _drive_until(sim: FederationFleetSim, target: str,
+                 predicate, max_steps: int = 200,
+                 monitor: "FederationMonitor | None" = None) -> bool:
+    for _ in range(max_steps):
+        if sim.fed is not None:
+            sim.fed.reconcile(target)
+        sim.reconcile_regions(monitor=monitor)
+        if monitor is not None:
+            monitor.sample()
+        if predicate():
+            return True
+        sim.step_clusters()
+    return False
+
+
+# ---------------------------------------------------------------------------
+# the ledger
+# ---------------------------------------------------------------------------
+class TestFederationLedger:
+    def test_plan_caps_shares_at_region_size(self):
+        ledger = FederationBudgetLedger()
+        shares = ledger.plan({"a": 2, "b": 10}, 10)
+        assert sum(shares.values()) <= 10
+        assert shares["a"] <= 2  # a share beyond the region is waste
+
+    def test_share_from_absent_and_malformed(self):
+        ledger = FederationBudgetLedger()
+        key = FederationKeys().budget_share_annotation
+        assert ledger.share_from({}) is None
+        assert ledger.share_from({key: "not-a-number"}) is None
+        assert ledger.share_from({key: "-3"}) == 0
+        assert ledger.share_from({key: "4"}) == 4
+
+    def test_raise_frozen_while_any_region_unread(self):
+        allowed = FederationBudgetLedger.raise_allowed
+        fleet = ["a", "b", "c"]
+        # all fresh, fits
+        assert allowed("a", 3, {"a": 0, "b": 2, "c": 1}, fleet, 6)
+        # all fresh, would overdraw
+        assert not allowed("a", 4, {"a": 0, "b": 2, "c": 1}, fleet, 6)
+        # region c unread: a stale read could hide a granted stamp
+        assert not allowed("a", 1, {"a": 0, "b": 0}, fleet, 6)
+
+    def test_plan_is_deterministic(self):
+        ledger = FederationBudgetLedger()
+        counts = {"asia": 4, "europe": 4, "uswest": 4}
+        assert ledger.plan(counts, 5) == ledger.plan(counts, 5)
+
+
+# ---------------------------------------------------------------------------
+# policy + CRD surface
+# ---------------------------------------------------------------------------
+class TestFederationPolicy:
+    def test_defaults_round_trip(self):
+        spec = FederationPolicySpec()
+        spec.validate()
+        again = FederationPolicySpec.from_dict(spec.to_dict())
+        assert again == spec
+        assert again.deep_copy() == spec
+
+    def test_validation(self):
+        with pytest.raises(PolicyValidationError):
+            FederationPolicySpec(bake_seconds=-1).validate()
+        with pytest.raises(PolicyValidationError):
+            FederationPolicySpec(max_concurrent_regions=0).validate()
+        with pytest.raises(PolicyValidationError):
+            FederationPolicySpec(trough_utilization=1.5).validate()
+        with pytest.raises(PolicyValidationError):
+            FederationPolicySpec(
+                global_max_unavailable="nope").validate()
+
+    def test_crd_schema_defaults_match_spec(self):
+        from tpu_operator_libs.api.crd import (
+            apply_defaults,
+            federation_policy_schema,
+        )
+
+        schema = federation_policy_schema()
+        defaulted = apply_defaults({}, schema)
+        assert FederationPolicySpec.from_dict(defaulted) \
+            == FederationPolicySpec()
+
+
+# ---------------------------------------------------------------------------
+# the controller (fault-free waves)
+# ---------------------------------------------------------------------------
+class TestFederationController:
+    def test_canary_first_then_bake_then_fleet(self):
+        sim = FederationFleetSim(_small_config())
+        monitor = FederationMonitor(sim)
+        target = FED_FINAL_REVISION
+        assert _drive_until(
+            sim, target,
+            lambda: all(sim.region_converged(n, target)
+                        for n in sim.regions)
+            and sim.shares_all_zero(), monitor=monitor)
+        assert not monitor.violations
+        # the canary region's DS moved first, and the fleet bake stamp
+        # is durable on its DaemonSet
+        canary_ds = next(
+            d for d in sim.regions[sim.canary].cluster
+            .list_daemon_sets(NS) if d.metadata.name == "libtpu")
+        stamp = canary_ds.metadata.annotations[
+            sim.fed_keys.bake_passed_annotation]
+        assert stamp.startswith(f"{target}:")
+        assert sim.fed.admissions_total == len(sim.regions)
+
+    def test_non_canary_held_behind_bake(self):
+        sim = FederationFleetSim(_small_config(bake_seconds=10_000))
+        target = FED_FINAL_REVISION
+        _drive(sim, target, 40)
+        status = sim.fed.last_status
+        other = next(n for n in sim.regions if n != sim.canary)
+        assert status["regions"][other]["revision"] != target
+        explained = sim.fed.explain_region(other)
+        assert any("canary" in reason
+                   for reason in explained["blocking"])
+
+    def test_partition_freezes_raises_and_admissions(self):
+        sim = FederationFleetSim(_small_config())
+        other = next(n for n in sim.regions if n != sim.canary)
+        # cut BOTH regions off before the first pass: no shares may be
+        # raised and nothing may be admitted on stale reads
+        for region in sim.regions.values():
+            region.gateway.add_window(0.0, 10_000.0)
+        _drive(sim, FED_FINAL_REVISION, 10)
+        status = sim.fed.last_status
+        assert all(not cell["reachable"]
+                   for cell in status["regions"].values())
+        assert sim.fed.admissions_total == 0
+        assert sim.fed.share_stamps_total == 0
+        explained = sim.fed.explain_region(other)
+        assert any("partitioned" in reason
+                   for reason in explained["blocking"])
+
+    def test_fed_restart_resumes_mid_wave(self):
+        sim = FederationFleetSim(_small_config())
+        target = FED_FINAL_REVISION
+        # run until the canary region is admitted, then kill the fed
+        assert _drive_until(
+            sim, target,
+            lambda: (sim.fed.last_status or {}).get("regions", {})
+            .get(sim.canary, {}).get("revision") == target)
+        sim.fed = None
+        _drive(sim, target, 5)  # regions keep upgrading, no federation
+        sim.build_fed()  # replacement: zero in-memory state
+        assert _drive_until(
+            sim, target,
+            lambda: all(sim.region_converged(n, target)
+                        for n in sim.regions)
+            and sim.shares_all_zero())
+        assert sim.fed_generation == 2
+
+    def test_quarantine_is_lifted_fleet_wide(self):
+        config = _small_config(bad_revision=BAD_REVISION_HASH)
+        sim = FederationFleetSim(config)
+        monitor = FederationMonitor(sim)
+        assert _drive_until(
+            sim, BAD_REVISION_HASH,
+            lambda: all(
+                next(d for d in r.cluster.list_daemon_sets(NS)
+                     if d.metadata.name == "libtpu")
+                .metadata.annotations.get(
+                    sim.keys.quarantined_revision_annotation)
+                == BAD_REVISION_HASH
+                for r in sim.regions.values()), monitor=monitor)
+        assert not monitor.violations
+        assert sim.fed.quarantine_stamps_total >= len(sim.regions) - 1
+        status = sim.fed.last_status
+        assert status["halted"]
+        explained = sim.fed.explain_region(sim.canary)
+        assert any("quarantined" in reason
+                   for reason in explained["blocking"])
+
+    def test_explain_unknown_region(self):
+        sim = FederationFleetSim(_small_config())
+        sim.fed.reconcile(FED_FINAL_REVISION)
+        out = sim.fed.explain_region("atlantis")
+        assert "unknown region" in out["blocking"][0]
+
+
+# ---------------------------------------------------------------------------
+# the schedules
+# ---------------------------------------------------------------------------
+class TestFederationSchedule:
+    def test_same_seed_same_schedule(self):
+        regions = ["asia", "europe", "uswest"]
+        assert FaultSchedule.generate_federation(5, regions) \
+            == FaultSchedule.generate_federation(5, regions)
+
+    def test_every_schedule_has_the_three_fault_families(self):
+        regions = ["asia", "europe", "uswest"]
+        for seed in TIER1_SEEDS + SLOW_SEEDS:
+            kinds = FaultSchedule.generate_federation(
+                seed, regions).kinds
+            assert FAULT_REGION_KILL in kinds
+            assert FAULT_FED_PARTITION in kinds
+            assert FAULT_FED_KILL in kinds
+            assert FAULT_OPERATOR_CRASH in kinds
+
+    def test_fed_kill_never_swallows_a_partition_window(self):
+        regions = ["asia", "europe", "uswest"]
+        for seed in range(1, 40):
+            schedule = FaultSchedule.generate_federation(seed, regions)
+            kills = schedule.by_kind(FAULT_FED_KILL)
+            for part in schedule.by_kind(FAULT_FED_PARTITION):
+                assert not any(k.at <= part.at and k.until >= part.until
+                               for k in kills), (seed, part, kills)
+
+    def test_bad_revision_schedule_targets_canary(self):
+        regions = ["asia", "europe", "uswest"]
+        schedule = FaultSchedule.generate_federation_bad_revision(
+            7, regions, "asia")
+        kinds = schedule.kinds
+        assert FAULT_BAD_REVISION in kinds
+        kills = schedule.by_kind(FAULT_REGION_KILL)
+        assert kills and kills[0].target == "asia"
+
+
+# ---------------------------------------------------------------------------
+# the chaos gates
+# ---------------------------------------------------------------------------
+def _assert_ok(report):
+    assert report.ok, (
+        f"federation seed {report.seed} failed — replay with "
+        f"run_federation_soak(seed={report.seed})\n{report.report_text}")
+
+
+class TestFederationSoakGate:
+    @pytest.mark.parametrize("seed", TIER1_SEEDS)
+    def test_seed_converges_with_zero_violations(self, seed):
+        report = run_federation_soak(seed)
+        _assert_ok(report)
+        assert FAULT_REGION_KILL in report.fault_kinds
+        assert FAULT_FED_KILL in report.fault_kinds
+        assert FAULT_FED_PARTITION in report.fault_kinds
+        assert report.crashes_fired >= 1
+        assert report.leader_handovers >= 2  # region + fed kills
+
+    @pytest.mark.slow
+    @pytest.mark.parametrize("seed", SLOW_SEEDS)
+    def test_slow_seed_converges_with_zero_violations(self, seed):
+        _assert_ok(run_federation_soak(seed))
+
+
+class TestFederationBadRevisionGate:
+    @pytest.mark.parametrize("seed", TIER1_SEEDS)
+    def test_seed_contains_and_rolls_back(self, seed):
+        report = run_federation_bad_revision_soak(seed)
+        _assert_ok(report)
+        assert FAULT_BAD_REVISION in report.fault_kinds
+        assert report.crashes_fired >= 1
+        # the containment latency evidence rode the trace
+        assert any("canary-halt -> fleet-quarantine" in line
+                   for line in report.trace)
+
+    @pytest.mark.slow
+    @pytest.mark.parametrize("seed", SLOW_SEEDS)
+    def test_slow_seed_contains_and_rolls_back(self, seed):
+        _assert_ok(run_federation_bad_revision_soak(seed))
+
+
+@pytest.mark.soak
+class TestFederationSoakExtended:
+    """Widen outside tier-1:
+    CHAOS_SEEDS=100,101 pytest -m "federation and soak"."""
+
+    def test_randomized_soak(self):
+        raw = os.environ.get("CHAOS_SEEDS", "")
+        seeds = [int(s) for s in raw.split(",") if s.strip()] \
+            or list(TIER1_SEEDS)
+        for seed in seeds:
+            _assert_ok(run_federation_soak(seed))
+            _assert_ok(run_federation_bad_revision_soak(seed))
+
+
+# ---------------------------------------------------------------------------
+# metrics + bench smoke
+# ---------------------------------------------------------------------------
+class TestFederationMetrics:
+    def test_observe_federation_exports_fleet_picture(self):
+        from tpu_operator_libs.metrics import (
+            MetricsRegistry,
+            observe_federation,
+        )
+
+        sim = FederationFleetSim(_small_config())
+        registry = MetricsRegistry(namespace="tpu_upgrade")
+        observe_federation(registry, sim.fed)  # no-op before a pass
+        assert "federation_regions_total" not in registry.render_prometheus()
+        sim.fed.reconcile(FED_FINAL_REVISION)
+        observe_federation(registry, sim.fed)
+        text = registry.render_prometheus()
+        assert "tpu_upgrade_federation_regions_total 2" in text.replace(
+            '{driver="libtpu"}', " ").replace("  ", " ")
+        assert "federation_budget_share" in text
+        assert "federation_admissions_total" in text
+        assert "federation_raise_freeze_passes_total" in text
+
+    def test_fed_status_carries_region_phases(self):
+        sim = FederationFleetSim(_small_config())
+        sim.fed.reconcile(FED_FINAL_REVISION)
+        status = sim.fed.status()
+        phases = {cell["phase"]
+                  for cell in status["regions"].values()}
+        assert phases <= {"pending", "canary-baking", "upgrading",
+                          "done", "partitioned", "quarantined", "held"}
+
+
+class TestFederationBenchSmoke:
+    def test_bench_cells_converge_clean(self):
+        from tools.federation_bench import run
+
+        result = run(regions=3)
+        assert result["rollout"]["converged"]
+        assert result["rollout"]["violations"] == []
+        assert result["containment"]["nonCanaryBadAdmissions"] == 0
+        assert result["containment"][
+            "canaryHaltToFleetQuarantineSeconds"] is not None

@@ -381,6 +381,74 @@ class TestExplainSharded:
         # no stale routing marker: the successor owns the shard now
         assert "ownedByShard" not in result
 
+    def test_hung_peer_times_out_to_durable_chain(self):
+        """The cross-replica hop is an HTTP call in production: a peer
+        that HANGS (half-open socket, wedged replica) must cost at
+        most timeout x (1 + retries) real seconds and then answer
+        from durable node state — never stall the explain request."""
+        import time
+
+        cluster, clock, keys, ring, policy, mk = self._sharded_pair()
+        mgr_a = mk({0}, "replica-a")
+        mgr_b = mk({1}, "replica-b")
+        for mgr in (mgr_a, mgr_b):
+            mgr.reconcile(NS, dict(RUNTIME_LABELS), policy)
+        from tpu_operator_libs.consts import GKE_NODEPOOL_LABEL
+
+        node = next(
+            n for n in cluster.list_nodes()
+            if ring.shard_for(
+                n.metadata.name,
+                n.metadata.labels.get(GKE_NODEPOOL_LABEL, "")) == 0)
+        hop_started = []
+
+        class HungPeer:
+            def explain(self, node_name):
+                hop_started.append(node_name)
+                time.sleep(30.0)  # far past any sane bound
+                return {"blocking": ["too late"]}
+
+        mgr_b.observability.peer_resolver = lambda shard: HungPeer()
+        mgr_b.observability.peer_timeout_seconds = 0.05
+        mgr_b.observability.peer_retries = 1
+        t0 = time.monotonic()
+        result = mgr_b.explain(node.metadata.name)
+        elapsed = time.monotonic() - t0
+        assert elapsed < 5.0, f"explain stalled {elapsed:.1f}s"
+        assert len(hop_started) == 2  # first attempt + one retry
+        assert "routedVia" not in result
+        assert result["ownedByShard"] == 0
+        assert result["blocking"], result
+        assert "did not answer" in result["blocking"][0]
+        # the durable-label chain still rode along under the marker
+        assert len(result["blocking"]) >= 2
+
+    def test_raising_peer_retries_then_falls_back(self):
+        cluster, clock, keys, ring, policy, mk = self._sharded_pair()
+        mgr_b = mk({1}, "replica-b")
+        mgr_b.reconcile(NS, dict(RUNTIME_LABELS), policy)
+        from tpu_operator_libs.consts import GKE_NODEPOOL_LABEL
+
+        node = next(
+            n for n in cluster.list_nodes()
+            if ring.shard_for(
+                n.metadata.name,
+                n.metadata.labels.get(GKE_NODEPOOL_LABEL, "")) == 0)
+        attempts = []
+
+        class DeadPeer:
+            def explain(self, node_name):
+                attempts.append(node_name)
+                raise ConnectionError("replica gone")
+
+        mgr_b.observability.peer_resolver = lambda shard: DeadPeer()
+        mgr_b.observability.peer_timeout_seconds = 0.5
+        mgr_b.observability.peer_retries = 1
+        result = mgr_b.explain(node.metadata.name)
+        assert len(attempts) == 2
+        assert result["blocking"]
+        assert "did not answer" in result["blocking"][0]
+
     def test_unowned_without_resolver_marks_owner(self):
         cluster, clock, keys, ring, policy, mk = self._sharded_pair()
         mgr_b = mk({1}, "replica-b")

@@ -353,6 +353,68 @@ class TestBudgetShares:
         assert mgr.last_budget_shares["cap"] \
             == int(mgr.last_budget_shares["entitled"]["0"])
 
+    def test_rapid_double_takeover_never_double_spends(self):
+        """Two successive handovers of one shard within a single pass
+        window must not double-spend its share. The predecessor left a
+        LOW recorded stamp (1 < entitlement 2); successor #1 spends
+        only the stamp it READ (increase-next-pass) while re-recording
+        the entitlement; successor #2 — taking over before #1 ever ran
+        a second pass — spends the re-recorded 2, never 1+2, and the
+        OTHER shard's concurrent owner counts shard 0's recorded claim
+        against its own clamp, so the joint spend across the whole
+        handover chain stays inside the global budget. This is the
+        decrease-immediate/increase-next-pass rule the federation's
+        per-region ledger inherits (federation/ledger.py)."""
+        from tpu_operator_libs.consts import GKE_NODEPOOL_LABEL
+
+        cluster, keys, managers = self._fleet_with_views()
+        ledger = ShardBudgetLedger(keys)
+        ring = ShardRing(2)
+        policy = _policy()  # global budget 4
+        counts = {0: 0, 1: 0}
+        for node in cluster.list_nodes():
+            counts[ring.shard_for(
+                node.metadata.name,
+                node.metadata.labels.get(GKE_NODEPOOL_LABEL, ""))] += 1
+        entitled = split_budget(4, counts)
+        # the contested shard: pick the one whose entitlement leaves
+        # room below it for a stale (lower) predecessor stamp
+        shard = max(entitled, key=lambda s: (entitled[s], s))
+        stale_stamp = entitled[shard] - 1
+        assert stale_stamp >= 1, entitled
+        ds = cluster.list_daemon_sets(NS)[0]
+        cluster.patch_daemon_set_annotations(
+            NS, ds.metadata.name,
+            {ledger.annotation_key(shard): str(stale_stamp)})
+
+        def successor(identity):
+            mgr = ClusterUpgradeStateManager(
+                cluster, keys, clock=cluster.clock,
+                async_workers=False).with_sharding(StaticShardView(
+                    ring=ring, owned=frozenset({shard}),
+                    identity=identity))
+            mgr.apply_state(mgr.build_state(NS, RUNTIME_LABELS), policy)
+            return mgr.last_budget_shares["cap"]
+
+        # handover #1: spends the predecessor's stamp, not the
+        # entitlement it re-records during the pass
+        assert successor("takeover-1") == stale_stamp
+        # handover #2, same pass window (takeover-1 never ran again):
+        # spends the re-recorded entitlement exactly once — never
+        # stamp + entitlement stacked across the handover chain
+        cap_2 = successor("takeover-2")
+        assert cap_2 == entitled[shard]
+        # the concurrent other-shard owner clamps against the
+        # contested shard's RECORDED claim — the chain as a whole
+        # cannot jointly overdraw B=4
+        mgr_other = managers[1 - shard]
+        mgr_other.apply_state(
+            mgr_other.build_state(NS, RUNTIME_LABELS), policy)
+        assert cap_2 + mgr_other.last_budget_shares["cap"] <= 4
+        recorded = ledger.shares_from(
+            cluster.list_daemon_sets(NS)[0].metadata.annotations)
+        assert sum(recorded.values()) <= 4
+
     def test_global_clamp_when_recorded_claims_overrun(self):
         """Skew backstop: if every OTHER shard's recorded claim already
         fills the global budget, this replica clamps itself to zero

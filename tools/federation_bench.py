@@ -1,0 +1,180 @@
+#!/usr/bin/env python3
+"""Multi-cluster federation rollout bench.
+
+Two fault-free cells over one :class:`~tpu_operator_libs.chaos.
+federation.FederationFleetSim` shape (default 4 simulated regions,
+the acceptance fleet):
+
+- ``rollout`` — a full region-as-canary global rollout to a new
+  revision: canary region first, durable bake, then follow-the-sun
+  waves under the global budget ledger. Reports the fleet MAKESPAN
+  (first admission -> every region converged, shares back to 0) and
+  the per-region admission timeline.
+- ``containment`` — the federation's target is a revision whose pods
+  can never become Ready: the canary region's guard halts and rolls
+  back, the federation lifts the quarantine fleet-wide. Reports the
+  CANARY-HALT -> FLEET-QUARANTINE latency (first quarantine stamp
+  observed anywhere -> every region's DaemonSet carrying it) and
+  asserts zero non-canary admissions in between.
+
+Writes BENCH_federation.json (``make bench-federation``). Both cells
+ride the same invariants as the chaos gate (FederationMonitor), so a
+bench run is also a fault-free regression of the safety story.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+
+from tpu_operator_libs.chaos.federation import (  # noqa: E402
+    FED_FINAL_REVISION,
+    FederationChaosConfig,
+    FederationFleetSim,
+    FederationMonitor,
+)
+from tpu_operator_libs.chaos.injector import BAD_REVISION_HASH  # noqa: E402
+
+
+def _drive(sim: FederationFleetSim, monitor: FederationMonitor,
+           target_of, converged, max_steps: int) -> "tuple[bool, int]":
+    steps = 0
+    while steps < max_steps:
+        steps += 1
+        target = target_of(sim.clock.now())
+        if target:
+            sim.fed.reconcile(target)
+        monitor.sample()
+        sim.reconcile_regions(monitor=monitor)
+        if converged(sim):
+            return True, steps
+        sim.step_clusters()
+        monitor.sample()
+    return False, steps
+
+
+def run_rollout_cell(config: FederationChaosConfig) -> dict:
+    sim = FederationFleetSim(config)
+    monitor = FederationMonitor(sim)
+    target = FED_FINAL_REVISION
+    admissions: "dict[str, float]" = {}
+
+    def target_of(now: float) -> str:
+        return target
+
+    def converged(sim: FederationFleetSim) -> bool:
+        status = sim.fed.last_status or {}
+        for region, cell in (status.get("regions") or {}).items():
+            if cell["revision"] == target and region not in admissions:
+                admissions[region] = sim.clock.now()
+        return (all(sim.region_converged(name, target)
+                    for name in sim.regions)
+                and sim.shares_all_zero())
+
+    ok, steps = _drive(sim, monitor, target_of, converged,
+                       config.max_steps)
+    return {
+        "converged": ok,
+        "regions": len(config.regions),
+        "nodesPerRegion": config.nodes_per_region,
+        "totalNodes": config.total_nodes,
+        "globalBudget": config.global_budget,
+        "canaryRegion": sim.canary,
+        "makespanSeconds": round(sim.clock.now(), 1),
+        "admissionTimeline": {name: round(at, 1) for name, at
+                              in sorted(admissions.items())},
+        "bakeSeconds": config.bake_seconds,
+        "violations": [v.describe() for v in monitor.violations],
+    }
+
+
+def run_containment_cell(config: FederationChaosConfig) -> dict:
+    import copy
+
+    config = copy.deepcopy(config)
+    config.bad_revision = BAD_REVISION_HASH
+    sim = FederationFleetSim(config)
+    monitor = FederationMonitor(sim)
+
+    def target_of(now: float) -> str:
+        return config.bad_revision
+
+    def converged(sim: FederationFleetSim) -> bool:
+        if monitor.fleet_quarantined_at is None:
+            return False
+        return all(sim.region_converged(name, "old")
+                   for name in sim.regions) and sim.shares_all_zero()
+
+    ok, steps = _drive(sim, monitor, target_of, converged,
+                       config.max_steps)
+    non_canary_admissions = sum(
+        1 for line in monitor.trace
+        if "DS revision" in line and f" {sim.canary} " not in line
+        and f"-> '{config.bad_revision}'" in line)
+    latency = None
+    if monitor.halt_seen_at is not None \
+            and monitor.fleet_quarantined_at is not None:
+        latency = round(
+            monitor.fleet_quarantined_at - monitor.halt_seen_at, 1)
+    return {
+        "converged": ok,
+        "canaryRegion": sim.canary,
+        "haltSeenAtSeconds": monitor.halt_seen_at,
+        "fleetQuarantinedAtSeconds": monitor.fleet_quarantined_at,
+        "canaryHaltToFleetQuarantineSeconds": latency,
+        "nonCanaryBadAdmissions": non_canary_admissions,
+        "violations": [v.describe() for v in monitor.violations],
+    }
+
+
+def run(regions: int = 4, check: bool = True) -> dict:
+    names = tuple(f"region-{i}" for i in range(regions))
+    config = FederationChaosConfig(regions=names, max_steps=600)
+    result = {
+        "bench": "federation",
+        "rollout": run_rollout_cell(config),
+        "containment": run_containment_cell(config),
+    }
+    if check:
+        rollout = result["rollout"]
+        containment = result["containment"]
+        assert rollout["converged"], rollout
+        assert not rollout["violations"], rollout["violations"]
+        assert containment["converged"], containment
+        assert not containment["violations"], containment["violations"]
+        assert containment["nonCanaryBadAdmissions"] == 0, containment
+        assert containment["canaryHaltToFleetQuarantineSeconds"] \
+            is not None, containment
+    return result
+
+
+def main() -> int:
+    parser = argparse.ArgumentParser(description=__doc__.split("\n")[0])
+    parser.add_argument("--regions", type=int, default=4)
+    parser.add_argument("--out", default="BENCH_federation.json")
+    parser.add_argument("--no-check", action="store_true")
+    args = parser.parse_args()
+    result = run(regions=args.regions, check=not args.no_check)
+    with open(args.out, "w") as fh:
+        json.dump(result, fh, indent=2, sort_keys=True)
+        fh.write("\n")
+    rollout = result["rollout"]
+    containment = result["containment"]
+    print(f"federation bench: {rollout['regions']} regions x "
+          f"{rollout['nodesPerRegion']} nodes — rollout makespan "
+          f"{rollout['makespanSeconds']}s (canary "
+          f"{rollout['canaryRegion']}, bake {rollout['bakeSeconds']}s); "
+          f"canary-halt -> fleet-quarantine "
+          f"{containment['canaryHaltToFleetQuarantineSeconds']}s with "
+          f"{containment['nonCanaryBadAdmissions']} non-canary bad "
+          f"admissions; wrote {args.out}")
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

@@ -557,6 +557,80 @@ def remediation_policy_schema() -> dict[str, Any]:
     }
 
 
+def federation_policy_schema() -> dict[str, Any]:
+    """FederationPolicySpec (beyond-reference: multi-cluster federated
+    rollouts — region-as-canary waves, a global disruption budget split
+    into durable per-region shares, follow-the-sun trough gating;
+    docs/federation.md)."""
+    return {
+        "type": "object",
+        "description": "Multi-cluster federated rollout policy: whole "
+                       "regions are ring members, one low-traffic "
+                       "region bakes each revision before the fleet, "
+                       "and a global disruption budget is split into "
+                       "durable per-region shares.",
+        "properties": {
+            "enable": {
+                "type": "boolean",
+                "default": True,
+                "description": "Master switch; when false the "
+                               "federation reconcile is a no-op.",
+            },
+            "globalMaxUnavailable": _int_or_string(
+                "Maximum number (ex: 20) or fleet percentage (ex: "
+                "\"25%\") of nodes that may be unavailable across ALL "
+                "regions combined.", default="25%"),
+            "canaryRegion": {
+                "type": "string",
+                "default": "",
+                "description": "Region that bakes every new revision "
+                               "before the fleet; empty selects the "
+                               "lowest-utilization region at "
+                               "evaluation time (ties by name).",
+            },
+            "bakeSeconds": {
+                "type": "integer",
+                "minimum": 0,
+                "default": 600,
+                "description": "Seconds the canary region must bake "
+                               "(every node done on the revision) "
+                               "before any other region is admitted.",
+            },
+            "maxConcurrentRegions": {
+                "type": "integer",
+                "minimum": 1,
+                "default": 1,
+                "description": "Non-canary regions upgrading "
+                               "concurrently once the bake passed.",
+            },
+            "followTheSun": {
+                "type": "boolean",
+                "default": True,
+                "description": "Admit each region only in its own "
+                               "traffic trough (ordered by live "
+                               "utilization); false admits in name "
+                               "order as slots free up.",
+            },
+            "troughUtilization": {
+                "type": "number",
+                "minimum": 0,
+                "maximum": 1,
+                "default": 0.35,
+                "description": "Utilization at or below which a region "
+                               "counts as in its trough.",
+            },
+            "maxTroughWaitSeconds": {
+                "type": "integer",
+                "minimum": 0,
+                "default": 3600,
+                "description": "Liveness override: a region never "
+                               "dipping below the trough threshold is "
+                               "admitted anyway after this wait.",
+            },
+        },
+    }
+
+
 def upgrade_policy_schema() -> dict[str, Any]:
     """The embeddable policy spec (DriverUpgradePolicySpec,
     upgrade_spec.go:27-49) with reference defaults: autoUpgrade=false,
@@ -822,6 +896,9 @@ def _main() -> None:  # pragma: no cover - exercised via test subprocess
         "unifiedupgradepolicy.yaml": build_crd(
             kind="UnifiedUpgradePolicy",
             spec_schema=unified_policy_schema()),
+        "tpufederationpolicy.yaml": build_crd(
+            kind="TPUFederationPolicy",
+            spec_schema=federation_policy_schema()),
     }
     for name, manifest in manifests.items():
         path = os.path.join(out_dir, name)

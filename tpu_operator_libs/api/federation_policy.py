@@ -1,0 +1,105 @@
+"""Federation policy types — the multi-cluster rollout configuration.
+
+The federation controller (:mod:`tpu_operator_libs.federation`) treats
+whole clusters/regions as ring members and drives each region's operator
+purely through its CRD/policy surface. This spec is the federation
+layer's own declarative configuration: the GLOBAL disruption budget the
+per-region shares partition, the region-as-canary gate (which region
+bakes a revision before the fleet, and for how long), the wave
+concurrency, and the follow-the-sun trough gating. Same dataclass +
+``to_dict``/``from_dict``/``deep_copy`` idiom as
+:mod:`tpu_operator_libs.api.upgrade_policy`.
+"""
+
+from __future__ import annotations
+
+import copy
+from dataclasses import dataclass
+from typing import Any
+
+from tpu_operator_libs.api.upgrade_policy import (
+    IntOrString,
+    PolicyValidationError,
+    scaled_value_from_int_or_percent,
+)
+
+
+@dataclass
+class FederationPolicySpec:
+    """Top-level multi-cluster federated rollout policy.
+
+    ``globalMaxUnavailable`` is scaled against the TOTAL fleet (the sum
+    of every region's managed node count) and split into durable
+    per-region budget-share stamps — a region's effective
+    ``maxUnavailable`` IS its stamp, so the global inequality holds
+    region-locally even across partitions and controller restarts.
+    """
+
+    # Master switch; when False the controller's reconcile is a no-op.
+    enable: bool = True
+    # Global disruption budget: max nodes (int) or fleet fraction
+    # (percent string) unavailable across ALL regions combined.
+    global_max_unavailable: IntOrString = "25%"
+    # Region that bakes every new revision before the fleet ("" = the
+    # lowest-utilization region at evaluation time, ties by name).
+    canary_region: str = ""
+    # Seconds the canary region must bake (every node done on the
+    # revision) before any other region is admitted.
+    bake_seconds: int = 600
+    # Non-canary regions upgrading concurrently once the bake passed.
+    max_concurrent_regions: int = 1
+    # Follow-the-sun: admit a region only while its live utilization is
+    # at or below troughUtilization (regions are ordered by current
+    # utilization, so each upgrades in its own traffic trough). False =
+    # admit in name order as slots free up.
+    follow_the_sun: bool = True
+    trough_utilization: float = 0.35
+    # Liveness override: a region that never dips below the trough
+    # threshold is admitted anyway after waiting this long.
+    max_trough_wait_seconds: int = 3600
+
+    def validate(self) -> None:
+        if scaled_value_from_int_or_percent(
+                self.global_max_unavailable, 100) < 0:
+            raise PolicyValidationError(
+                "globalMaxUnavailable must be >= 0")
+        if self.bake_seconds < 0:
+            raise PolicyValidationError("bakeSeconds must be >= 0")
+        if self.max_concurrent_regions < 1:
+            raise PolicyValidationError(
+                "maxConcurrentRegions must be >= 1")
+        if not 0.0 <= self.trough_utilization <= 1.0:
+            raise PolicyValidationError(
+                "troughUtilization must be in [0, 1]")
+        if self.max_trough_wait_seconds < 0:
+            raise PolicyValidationError(
+                "maxTroughWaitSeconds must be >= 0")
+
+    def to_dict(self) -> dict[str, Any]:
+        return {
+            "enable": self.enable,
+            "globalMaxUnavailable": self.global_max_unavailable,
+            "canaryRegion": self.canary_region,
+            "bakeSeconds": self.bake_seconds,
+            "maxConcurrentRegions": self.max_concurrent_regions,
+            "followTheSun": self.follow_the_sun,
+            "troughUtilization": self.trough_utilization,
+            "maxTroughWaitSeconds": self.max_trough_wait_seconds,
+        }
+
+    @classmethod
+    def from_dict(cls, data: dict[str, Any]) -> "FederationPolicySpec":
+        return cls(
+            enable=data.get("enable", True),
+            global_max_unavailable=data.get("globalMaxUnavailable",
+                                            "25%"),
+            canary_region=data.get("canaryRegion", ""),
+            bake_seconds=data.get("bakeSeconds", 600),
+            max_concurrent_regions=data.get("maxConcurrentRegions", 1),
+            follow_the_sun=data.get("followTheSun", True),
+            trough_utilization=data.get("troughUtilization", 0.35),
+            max_trough_wait_seconds=data.get("maxTroughWaitSeconds",
+                                             3600))
+
+    def deep_copy(self) -> "FederationPolicySpec":
+        return copy.deepcopy(self)

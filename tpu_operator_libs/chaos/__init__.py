@@ -33,6 +33,13 @@ from tpu_operator_libs.chaos.invariants import (
     RolloutExpectation,
     ShardExpectation,
 )
+from tpu_operator_libs.chaos.federation import (
+    FederationChaosConfig,
+    FederationFleetSim,
+    FederationMonitor,
+    run_federation_bad_revision_soak,
+    run_federation_soak,
+)
 from tpu_operator_libs.chaos.runner import (
     ChaosConfig,
     ChaosReport,
@@ -47,15 +54,19 @@ from tpu_operator_libs.chaos.schedule import (
     FAULT_API_BURST,
     FAULT_BAD_REVISION,
     FAULT_CRASHLOOP,
+    FAULT_FED_KILL,
+    FAULT_FED_PARTITION,
     FAULT_KINDS,
     FAULT_LEADER_LOSS,
     FAULT_NODE_KILL,
     FAULT_NOT_READY_FLAP,
     FAULT_OPERATOR_CRASH,
     FAULT_PDB_BLOCK,
+    FAULT_REGION_KILL,
     FAULT_REPLICA_KILL,
     FAULT_STALE_READS,
     FAULT_WATCH_BREAK,
+    FAULT_WATCH_DELAY,
     FaultEvent,
     FaultSchedule,
 )
@@ -68,17 +79,24 @@ __all__ = [
     "FAULT_API_BURST",
     "FAULT_BAD_REVISION",
     "FAULT_CRASHLOOP",
+    "FAULT_FED_KILL",
+    "FAULT_FED_PARTITION",
     "FAULT_KINDS",
     "FAULT_LEADER_LOSS",
     "FAULT_NODE_KILL",
     "FAULT_NOT_READY_FLAP",
     "FAULT_OPERATOR_CRASH",
     "FAULT_PDB_BLOCK",
+    "FAULT_REGION_KILL",
     "FAULT_REPLICA_KILL",
     "FAULT_STALE_READS",
     "FAULT_WATCH_BREAK",
+    "FAULT_WATCH_DELAY",
     "FaultEvent",
     "FaultSchedule",
+    "FederationChaosConfig",
+    "FederationFleetSim",
+    "FederationMonitor",
     "InvariantMonitor",
     "InvariantViolation",
     "OperatorCrash",
@@ -89,6 +107,8 @@ __all__ = [
     "ShardExpectation",
     "run_bad_revision_soak",
     "run_chaos_soak",
+    "run_federation_bad_revision_soak",
+    "run_federation_soak",
     "run_reconfig_soak",
     "run_replica_kill_soak",
 ]

@@ -1,0 +1,946 @@
+"""The multi-cluster federation chaos gate.
+
+A :func:`run_federation_soak` episode builds N REGIONS — each a real
+:class:`~tpu_operator_libs.k8s.fake.FakeCluster` running a real
+:class:`~tpu_operator_libs.upgrade.state_manager.
+ClusterUpgradeStateManager` incarnation, all sharing one virtual clock
+— and a :class:`~tpu_operator_libs.federation.controller.
+FederationController` driving them through a global rollout, while the
+seed's schedule kills regional controllers mid-rollout, partitions the
+federation from regions (stale reads + rejected writes), and kills the
+federation controller itself mid-wave. A :class:`FederationMonitor`
+reads every cluster directly — below the ledger layer, below the
+controller under test — and holds three always-on invariants:
+
+- **global-budget**: the SUM of observed per-region unavailability
+  never exceeds the global ``B``, at any sampled instant, across
+  kills, partitions and controller replacements — the durable share
+  stamps coordinate the joint spend with no live coordinator required;
+- **canary-containment**: no non-canary region's DaemonSet ever moves
+  to a revision lacking the fleet bake-passed stamp (bake elapsed) or
+  carrying a quarantine verdict, and no pod of a quarantined revision
+  ever exists outside the canary region;
+- **federation-resume**: controllers rebuilt with zero in-memory state
+  converge the rollout from the regions' durable annotations alone,
+  and the end state carries no share residue (every stamp back to 0).
+
+:func:`run_federation_bad_revision_soak` is the containment flavor:
+the federation's target becomes a revision whose pods can never become
+Ready — the canary region's own RolloutGuard must halt and roll back
+locally, the federation must lift the quarantine fleet-wide, and no
+non-canary region may ever admit the condemned hash, with the same
+fault storm landing on the machinery that proves it.
+"""
+
+from __future__ import annotations
+
+import copy
+import logging
+import math
+from dataclasses import dataclass
+from typing import Optional
+
+from tpu_operator_libs.api.federation_policy import FederationPolicySpec
+from tpu_operator_libs.api.upgrade_policy import (
+    CanaryRolloutSpec,
+    DrainSpec,
+    RollbackSpec,
+    UpgradePolicySpec,
+    scaled_value_from_int_or_percent,
+)
+from tpu_operator_libs.chaos.injector import (
+    BAD_REVISION_HASH,
+    CrashFuse,
+    CrashingStateProvider,
+    OperatorCrash,
+    consume_transient,
+)
+from tpu_operator_libs.chaos.invariants import InvariantViolation
+from tpu_operator_libs.chaos.runner import ChaosReport
+from tpu_operator_libs.chaos.schedule import (
+    FAULT_API_BURST,
+    FAULT_BAD_REVISION,
+    FAULT_FED_KILL,
+    FAULT_FED_PARTITION,
+    FAULT_OPERATOR_CRASH,
+    FAULT_REGION_KILL,
+    FaultSchedule,
+)
+from tpu_operator_libs.consts import (
+    POD_CONTROLLER_REVISION_HASH_LABEL,
+    FederationKeys,
+    UpgradeKeys,
+    UpgradeState,
+)
+from tpu_operator_libs.federation import (
+    FederationBudgetLedger,
+    FederationController,
+    RegionHandle,
+)
+from tpu_operator_libs.k8s.client import (
+    ApiServerError,
+    ConflictError,
+    NotFoundError,
+)
+from tpu_operator_libs.k8s.fake import FakeCluster
+from tpu_operator_libs.simulate import (
+    NS,
+    RUNTIME_LABELS,
+    FleetSpec,
+    build_fleet,
+)
+from tpu_operator_libs.upgrade.state_manager import (
+    BuildStateError,
+    ClusterUpgradeStateManager,
+)
+from tpu_operator_libs.util import FakeClock
+
+logger = logging.getLogger(__name__)
+
+#: Revision the good-path episode rolls the fleet to first.
+FED_TARGET_REVISION = "new"
+#: Second target, promoted at horizon/2 (the other gates' idiom):
+#: guarantees write traffic deep into the fault window, so every armed
+#: operator crash detonates, and lands the late kills on a mid-wave
+#: fleet. Convergence is judged against THIS revision.
+FED_FINAL_REVISION = "new2"
+
+
+@dataclass
+class FederationChaosConfig:
+    """Knobs of one federation soak episode (defaults: tier-1 shape)."""
+
+    regions: tuple = ("asia", "europe", "uswest")
+    n_slices: int = 2
+    hosts_per_slice: int = 2
+    pod_recreate_delay: float = 2.0
+    pod_ready_delay: float = 6.0
+    reconcile_interval: float = 10.0
+    horizon: float = 600.0
+    max_steps: int = 400
+    #: Global disruption budget across ALL regions combined.
+    global_max_unavailable: str = "50%"
+    #: Fleet bake after the canary REGION converges.
+    bake_seconds: int = 30
+    #: Node-level canary bake INSIDE each region (the per-cluster
+    #: guard runs live — it is the verdict machine the federation
+    #: lifts fleet-wide).
+    region_bake_seconds: int = 10
+    max_concurrent_regions: int = 1
+    follow_the_sun: bool = True
+    trough_utilization: float = 0.45
+    max_trough_wait_seconds: int = 480
+    #: When set, pods of this revision hash can never become Ready in
+    #: ANY region (the fleet-promoted broken build of the containment
+    #: gate; installed as a pod-ready gate at region build time).
+    bad_revision: str = ""
+    #: Diurnal utilization model per region: phase-offset sinusoids,
+    #: so each region troughs in its own window (follow-the-sun).
+    diurnal_period: float = 240.0
+    util_base: float = 0.55
+    util_amplitude: float = 0.35
+
+    @property
+    def nodes_per_region(self) -> int:
+        return self.n_slices * self.hosts_per_slice
+
+    @property
+    def total_nodes(self) -> int:
+        return len(self.regions) * self.nodes_per_region
+
+    @property
+    def global_budget(self) -> int:
+        return scaled_value_from_int_or_percent(
+            self.global_max_unavailable, self.total_nodes,
+            round_up=True)
+
+    def region_utilization(self, index: int, now: float) -> float:
+        """Region ``index``'s live utilization at ``now`` — a pure
+        phase-offset sinusoid (config, not seed: the federation's
+        follow-the-sun ordering must be reproducible across controller
+        restarts within one episode)."""
+        phase = 2.0 * math.pi * index / max(1, len(self.regions))
+        value = self.util_base + self.util_amplitude * math.sin(
+            2.0 * math.pi * now / self.diurnal_period + phase)
+        return max(0.0, min(1.0, value))
+
+    def federation_policy(self, canary: str) -> FederationPolicySpec:
+        return FederationPolicySpec(
+            global_max_unavailable=self.global_max_unavailable,
+            canary_region=canary,
+            bake_seconds=self.bake_seconds,
+            max_concurrent_regions=self.max_concurrent_regions,
+            follow_the_sun=self.follow_the_sun,
+            trough_utilization=self.trough_utilization,
+            max_trough_wait_seconds=self.max_trough_wait_seconds)
+
+
+class _FedGateway:
+    """The federation's access path to ONE region apiserver, with the
+    partition fault in the middle: inside a window, writes are
+    rejected (ApiServerError) and reads are served from the
+    pre-partition snapshot cache — a stale regional replica. The
+    region's OWN operator talks to its cluster directly (the partition
+    is federation↔region, not region-internal)."""
+
+    _READS = frozenset((
+        "list_daemon_sets", "list_nodes", "list_pods",
+        "list_controller_revisions", "get_node"))
+    _WRITES = frozenset((
+        "patch_daemon_set_annotations", "bump_daemon_set_revision",
+        "rollback_daemon_set", "patch_node_labels",
+        "patch_node_annotations", "patch_node_meta"))
+
+    def __init__(self, cluster: FakeCluster) -> None:
+        self._cluster = cluster
+        self._windows: "list[tuple[float, float]]" = []
+        self._stale: "dict[tuple, object]" = {}
+        #: Calls refused/served-stale inside partition windows (the
+        #: harness-sanity proof the partition actually bit).
+        self.partitioned_calls = 0
+
+    def add_window(self, start: float, end: float) -> None:
+        self._windows.append((start, end))
+
+    def partitioned(self) -> bool:
+        now = self._cluster.clock.now()
+        return any(start <= now < end for start, end in self._windows)
+
+    def __getattr__(self, name: str) -> "object":
+        if name in self._WRITES:
+            real = getattr(self._cluster, name)
+
+            def write(*args: "object", **kwargs: "object") -> "object":
+                if self.partitioned():
+                    self.partitioned_calls += 1
+                    raise ApiServerError(
+                        f"federation partitioned from region "
+                        f"({name} rejected)")
+                return real(*args, **kwargs)
+            return write
+        if name in self._READS:
+            real = getattr(self._cluster, name)
+
+            def read(*args: "object", **kwargs: "object") -> "object":
+                key = (name, repr(args), repr(sorted(kwargs.items())))
+                if self.partitioned():
+                    self.partitioned_calls += 1
+                    cached = self._stale.get(key)
+                    if cached is None:
+                        raise ApiServerError(
+                            f"federation partitioned from region "
+                            f"({name}: no cached read)")
+                    return copy.deepcopy(cached)
+                result = real(*args, **kwargs)
+                self._stale[key] = copy.deepcopy(result)
+                return result
+            return read
+        return getattr(self._cluster, name)
+
+
+class _RegionOperator:
+    """One regional controller process-lifetime (fresh manager, fresh
+    provider; everything durable lives in the region's cluster)."""
+
+    def __init__(self, cluster: FakeCluster, clock: FakeClock,
+                 keys: UpgradeKeys, fuse: CrashFuse,
+                 identity: str) -> None:
+        self.identity = identity
+        provider = CrashingStateProvider(
+            cluster, keys, None, clock, sync_timeout=5.0,
+            poll_interval=1.0, fuse=fuse)
+        self.upgrade = ClusterUpgradeStateManager(
+            cluster, keys, clock=clock, async_workers=False,
+            provider=provider, poll_interval=1.0, sync_timeout=5.0)
+
+
+@dataclass
+class _Region:
+    name: str
+    index: int
+    cluster: FakeCluster
+    gateway: _FedGateway
+    op: "Optional[_RegionOperator]" = None
+    generation: int = 1
+
+
+class FederationFleetSim:
+    """N simulated regions + the federation controller above them.
+
+    Shared by the chaos runners and ``tools/federation_bench.py``: the
+    bench drives it fault-free for the makespan/latency numbers, the
+    soaks layer the schedule on top.
+    """
+
+    def __init__(self, config: FederationChaosConfig,
+                 clock: Optional[FakeClock] = None) -> None:
+        self.config = config
+        self.clock = clock if clock is not None else FakeClock(start=0.0)
+        self.keys = UpgradeKeys()
+        self.fed_keys = FederationKeys()
+        self.ledger = FederationBudgetLedger(self.fed_keys)
+        self.fuse = CrashFuse()
+        #: The canary region is the lowest-utilization region at t=0 —
+        #: deterministic from config alone, pinned into the policy so
+        #: every federation incarnation agrees mid-episode.
+        spec = FleetSpec(
+            n_slices=config.n_slices,
+            hosts_per_slice=config.hosts_per_slice,
+            pod_recreate_delay=config.pod_recreate_delay,
+            pod_ready_delay=config.pod_ready_delay)
+        self.regions: "dict[str, _Region]" = {}
+        for index, name in enumerate(config.regions):
+            cluster, _, _ = build_fleet(spec, clock=self.clock,
+                                        roll=False)
+            if config.bad_revision:
+                cluster.add_pod_ready_gate(
+                    lambda pod, bad=config.bad_revision:
+                    pod.metadata.labels.get(
+                        POD_CONTROLLER_REVISION_HASH_LABEL) != bad)
+            self.regions[name] = _Region(
+                name=name, index=index, cluster=cluster,
+                gateway=_FedGateway(cluster))
+        self.canary = min(
+            self.regions,
+            key=lambda name: (config.region_utilization(
+                self.regions[name].index, 0.0), name))
+        self.fed: Optional[FederationController] = None
+        self.fed_generation = 0
+        self.region_incarnations = 0
+        self.build_fed()
+        for name in self.regions:
+            self.build_region_op(name)
+
+    # -- construction (also the restart paths) -------------------------
+    def build_fed(self) -> FederationController:
+        """A FRESH federation controller — zero in-memory state, which
+        is exactly what a post-kill replacement has."""
+        self.fed_generation += 1
+        config = self.config
+        handles = []
+        for name, region in sorted(self.regions.items()):
+            handles.append(RegionHandle(
+                name=name, client=region.gateway, namespace=NS,
+                ds_name="libtpu",
+                utilization=(lambda now, index=region.index:
+                             config.region_utilization(index, now))))
+        self.fed = FederationController(
+            handles, config.federation_policy(self.canary),
+            keys=self.fed_keys, upgrade_keys=self.keys,
+            clock=self.clock)
+        return self.fed
+
+    def build_region_op(self, name: str) -> _RegionOperator:
+        region = self.regions[name]
+        self.region_incarnations += 1
+        region.op = _RegionOperator(
+            region.cluster, self.clock, self.keys, self.fuse,
+            identity=f"{name}-op-{region.generation}")
+        return region.op
+
+    # -- the region policy surface --------------------------------------
+    def region_policy(self, name: str) -> UpgradePolicySpec:
+        """The policy the region operator consumes, derived from the
+        region's OWN durable state: its effective ``maxUnavailable``
+        is the federation's share stamp (absent = 0 = admit nothing),
+        so the global budget binds region-locally through partitions
+        and controller replacements alike."""
+        config = self.config
+        region = self.regions[name]
+        share = 0
+        for ds in region.cluster.list_daemon_sets(NS):
+            if ds.metadata.name == "libtpu":
+                share = self.ledger.share_from(
+                    ds.metadata.annotations) or 0
+                break
+        return UpgradePolicySpec(
+            auto_upgrade=True,
+            max_parallel_upgrades=0,
+            max_unavailable=share,
+            topology_mode="flat",
+            drain=DrainSpec(enable=True, force=True,
+                            timeout_seconds=300),
+            canary=CanaryRolloutSpec(
+                enable=True, canary_count=1,
+                bake_seconds=config.region_bake_seconds,
+                failure_threshold=1),
+            rollback=RollbackSpec(enable=True))
+
+    # -- one tick of control-plane work ---------------------------------
+    def reconcile_regions(self, on_crash: "Optional[object]" = None,
+                          monitor: "Optional[FederationMonitor]" = None,
+                          ) -> int:
+        """Run every live regional controller once (federation pass is
+        the caller's job). Returns reconciles performed; a detonating
+        crash fuse replaces the affected incarnation in place."""
+        reconciles = 0
+        for name in sorted(self.regions):
+            region = self.regions[name]
+            if region.op is None:
+                continue
+            try:
+                policy = self.region_policy(name)
+                region.op.upgrade.reconcile(NS, dict(RUNTIME_LABELS),
+                                            policy)
+                reconciles += 1
+            except OperatorCrash:
+                self.fuse.reset()
+                region.generation += 1
+                self.build_region_op(name)
+                if on_crash is not None:
+                    on_crash(name, "operator crash mid-reconcile")
+            except BuildStateError:
+                pass
+            except (ApiServerError, ConflictError, NotFoundError):
+                pass
+            if self.fuse.pending:
+                self.fuse.reset()
+                region.generation += 1
+                self.build_region_op(name)
+                if on_crash is not None:
+                    on_crash(name, "operator crash (surfaced late)")
+            if monitor is not None:
+                monitor.sample()
+        return reconciles
+
+    def step_clusters(self) -> None:
+        self.clock.advance(self.config.reconcile_interval)
+        for region in self.regions.values():
+            region.cluster.step()
+
+    # -- convergence checks ---------------------------------------------
+    def region_converged(self, name: str, revision: str) -> bool:
+        region = self.regions[name]
+        try:
+            nodes = region.cluster.list_nodes()
+            pods = region.cluster.list_pods(namespace=NS)
+        except (ApiServerError, TimeoutError):
+            return False
+        if len(nodes) != self.config.nodes_per_region:
+            return False
+        done = str(UpgradeState.DONE)
+        for node in nodes:
+            if node.metadata.labels.get(self.keys.state_label) != done:
+                return False
+            if node.is_unschedulable() or not node.is_ready():
+                return False
+        runtime = [p for p in pods if p.controller_owner() is not None]
+        if len(runtime) != len(nodes):
+            return False
+        return all(
+            p.metadata.labels.get(POD_CONTROLLER_REVISION_HASH_LABEL)
+            == revision and p.is_ready() for p in runtime)
+
+    def shares_all_zero(self) -> bool:
+        for region in self.regions.values():
+            for ds in region.cluster.list_daemon_sets(NS):
+                if ds.metadata.name != "libtpu":
+                    continue
+                share = self.ledger.share_from(ds.metadata.annotations)
+                if share not in (None, 0):
+                    return False
+        return True
+
+
+class FederationMonitor:
+    """Ground-truth auditor for one federation episode: reads every
+    region cluster DIRECTLY (below the gateways, below the ledger) and
+    asserts the three federation invariants at every sample."""
+
+    def __init__(self, sim: FederationFleetSim) -> None:
+        self.sim = sim
+        self.violations: "list[InvariantViolation]" = []
+        self.trace: "list[str]" = []
+        self.samples = 0
+        self.max_joint_unavailable = 0
+        #: revision -> bake-stamp epoch observed on the canary DS.
+        self._baked: "dict[str, float]" = {}
+        #: quarantined revisions observed anywhere.
+        self.quarantined: "set[str]" = set()
+        #: region -> last observed newest DS revision.
+        self._last_revision: "dict[str, str]" = {}
+        self._initial_revision: "dict[str, str]" = {}
+        #: canary-halt -> fleet-quarantine-complete latency evidence.
+        self.halt_seen_at: Optional[float] = None
+        self.fleet_quarantined_at: Optional[float] = None
+        for name, region in sim.regions.items():
+            revision = region.cluster.latest_revision_hash(NS, "libtpu")
+            self._initial_revision[name] = revision
+            self._last_revision[name] = revision
+
+    def _now(self) -> float:
+        return self.sim.clock.now()
+
+    def _record(self, line: str) -> None:
+        self.trace.append(f"[t={self._now():g}] {line}")
+
+    def _violate(self, invariant: str, subject: str,
+                 detail: str) -> None:
+        violation = InvariantViolation(invariant, self._now(), subject,
+                                       detail)
+        self.violations.append(violation)
+        self._record(violation.describe())
+        logger.error("%s", violation.describe())
+
+    def sample(self) -> None:
+        """One ground-truth audit: call after every mutation batch
+        (each region reconcile, each federation pass, each clock
+        step)."""
+        sim = self.sim
+        self.samples += 1
+        now = self._now()
+        budget = sim.config.global_budget
+        joint = 0
+        per_region: "dict[str, int]" = {}
+        for name, region in sorted(sim.regions.items()):
+            nodes = consume_transient(region.cluster.list_nodes)
+            unavailable = sum(
+                1 for node in nodes
+                if node.is_unschedulable() or not node.is_ready())
+            per_region[name] = unavailable
+            joint += unavailable
+        self.max_joint_unavailable = max(self.max_joint_unavailable,
+                                         joint)
+        if joint > budget:
+            self._violate(
+                "global-budget", "fleet",
+                f"joint unavailability {joint} "
+                f"({per_region}) exceeds the global budget {budget} — "
+                f"the per-region shares jointly overdrew")
+        # durable federation facts, observed from the clusters alone
+        quarantine_key = sim.keys.quarantined_revision_annotation
+        bake_key = sim.fed_keys.bake_passed_annotation
+        regions_quarantined = 0
+        for name, region in sorted(sim.regions.items()):
+            daemon_sets = consume_transient(
+                lambda c=region.cluster: c.list_daemon_sets(NS))
+            ds = next((d for d in daemon_sets
+                       if d.metadata.name == "libtpu"), None)
+            if ds is None:
+                continue
+            quarantined = ds.metadata.annotations.get(quarantine_key)
+            if quarantined:
+                regions_quarantined += 1
+                if quarantined not in self.quarantined:
+                    self.quarantined.add(quarantined)
+                    self._record(f"revision {quarantined!r} "
+                                 f"quarantined (first seen on region "
+                                 f"{name})")
+                    if self.halt_seen_at is None:
+                        self.halt_seen_at = now
+            if name == sim.canary:
+                stamp = ds.metadata.annotations.get(bake_key, "")
+                revision, _, passed_at = stamp.partition(":")
+                if revision and passed_at \
+                        and revision not in self._baked:
+                    try:
+                        self._baked[revision] = float(passed_at)
+                        self._record(f"bake stamp observed: "
+                                     f"{revision!r} at {passed_at}")
+                    except ValueError:
+                        pass
+        if self.quarantined and self.fleet_quarantined_at is None \
+                and regions_quarantined == len(sim.regions):
+            self.fleet_quarantined_at = now
+            self._record(
+                f"fleet quarantine complete "
+                f"({now - (self.halt_seen_at or now):g}s after the "
+                f"first verdict)")
+        self._check_containment(now)
+
+    def _check_containment(self, now: float) -> None:
+        """canary-containment: a non-canary region's DS may only move
+        to (a) its own initial revision (a rollback) or (b) a revision
+        whose fleet bake stamp exists with the bake elapsed and which
+        carries no quarantine verdict; and no pod of a quarantined
+        revision may exist outside the canary region."""
+        sim = self.sim
+        bake_seconds = sim.config.bake_seconds
+        for name, region in sorted(sim.regions.items()):
+            newest = consume_transient(
+                lambda c=region.cluster:
+                c.latest_revision_hash(NS, "libtpu"))
+            if newest != self._last_revision.get(name):
+                self._record(f"region {name} DS revision "
+                             f"{self._last_revision.get(name)!r} -> "
+                             f"{newest!r}")
+                if name != sim.canary \
+                        and newest != self._initial_revision[name]:
+                    stamped = self._baked.get(newest)
+                    if newest in self.quarantined:
+                        self._violate(
+                            "canary-containment", name,
+                            f"non-canary region admitted quarantined "
+                            f"revision {newest!r}")
+                    elif stamped is None:
+                        self._violate(
+                            "canary-containment", name,
+                            f"non-canary region admitted revision "
+                            f"{newest!r} with NO fleet bake-passed "
+                            f"stamp")
+                    elif now < stamped + bake_seconds:
+                        self._violate(
+                            "canary-containment", name,
+                            f"non-canary region admitted revision "
+                            f"{newest!r} only {now - stamped:g}s into "
+                            f"the {bake_seconds}s bake")
+                self._last_revision[name] = newest
+            if name == sim.canary or not self.quarantined:
+                continue
+            pods = consume_transient(
+                lambda c=region.cluster: c.list_pods(namespace=NS))
+            for pod in pods:
+                pod_hash = pod.metadata.labels.get(
+                    POD_CONTROLLER_REVISION_HASH_LABEL)
+                if pod_hash in self.quarantined:
+                    self._violate(
+                        "canary-containment",
+                        f"pod {pod.metadata.name}",
+                        f"pod of quarantined revision {pod_hash!r} "
+                        f"exists in non-canary region {name}")
+
+    def final_check(self, expect_quarantine: Optional[str]) -> None:
+        """federation-resume residue audit: every share stamp back to
+        0 (or never granted), and — in the containment flavor — the
+        quarantine record standing on EVERY region, which is what a
+        recovered region re-verifies before admitting anything."""
+        sim = self.sim
+        for name, region in sorted(sim.regions.items()):
+            for ds in region.cluster.list_daemon_sets(NS):
+                if ds.metadata.name != "libtpu":
+                    continue
+                share = sim.ledger.share_from(ds.metadata.annotations)
+                if share not in (None, 0):
+                    self._violate(
+                        "federation-resume", name,
+                        f"budget-share residue survived convergence: "
+                        f"stamp still grants {share} node(s)")
+                if expect_quarantine is not None:
+                    recorded = ds.metadata.annotations.get(
+                        sim.keys.quarantined_revision_annotation)
+                    if recorded != expect_quarantine:
+                        self._violate(
+                            "federation-resume", name,
+                            f"quarantine record for "
+                            f"{expect_quarantine!r} missing after "
+                            f"convergence (found {recorded!r}) — a "
+                            f"recovered region could re-admit the "
+                            f"condemned revision")
+
+    def report(self, seed: int) -> str:
+        lines = [f"federation run seed={seed}: "
+                 f"{len(self.violations)} violation(s), "
+                 f"{self.samples} samples, max joint unavailability "
+                 f"{self.max_joint_unavailable}/"
+                 f"{self.sim.config.global_budget}"]
+        lines += [v.describe() for v in self.violations]
+        if self.violations:
+            lines.append("--- trace (replay with "
+                         f"run_federation_soak(seed={seed})) ---")
+            lines += self.trace[-120:]
+        return "\n".join(lines)
+
+
+def _install_region_api_bursts(sim: FederationFleetSim,
+                               schedule: FaultSchedule) -> None:
+    for event in schedule.by_kind(FAULT_API_BURST):
+        region_name, _, operation = event.target.partition(":")
+        region = sim.regions.get(region_name)
+        if region is None:
+            continue
+        region.cluster.schedule_at(
+            event.at,
+            lambda c=region.cluster, op=operation, n=event.param:
+            c.inject_api_errors(op, n))
+
+
+def _run_federation_episode(seed: int, config: FederationChaosConfig,
+                            schedule: FaultSchedule,
+                            target_of: "object",
+                            converged: "object",
+                            expect_quarantine: "Optional[str]",
+                            ) -> "tuple[FederationFleetSim, FederationMonitor, ChaosReport]":
+    """Shared episode loop of both federation gates. ``target_of(now)``
+    yields the federation's target revision; ``converged(sim)`` the
+    episode's convergence predicate."""
+    sim = FederationFleetSim(config)
+    clock = sim.clock
+    monitor = FederationMonitor(sim)
+    _install_region_api_bursts(sim, schedule)
+
+    crash_events = sorted(schedule.by_kind(FAULT_OPERATOR_CRASH),
+                          key=lambda e: e.at)
+    crash_index = 0
+    region_kills = sorted(schedule.by_kind(FAULT_REGION_KILL),
+                          key=lambda e: e.at)
+    region_kill_index = 0
+    fed_kills = sorted(schedule.by_kind(FAULT_FED_KILL),
+                       key=lambda e: e.at)
+    fed_kill_index = 0
+    for event in schedule.by_kind(FAULT_FED_PARTITION):
+        gateway = sim.regions[event.target].gateway
+        gateway.add_window(event.at, event.until)
+    region_kills_fired = 0
+    fed_kills_fired = 0
+    fed_saw_partition = False
+    fed_restart_at: Optional[float] = None
+    pending_region_restarts: "list[tuple[float, str]]" = []
+    fed_reconciles = 0
+    region_reconciles = 0
+
+    def on_crash(region: str, reason: str) -> None:
+        monitor.trace.append(
+            f"[t={clock.now():g}] region {region} controller restart "
+            f"({reason}) — rebuilt from the region's state alone")
+
+    steps = 0
+    quiesce_ticks = 0
+    is_converged = False
+    while steps < config.max_steps:
+        steps += 1
+        now = clock.now()
+        # regional-controller kills / replacements
+        while region_kill_index < len(region_kills) \
+                and region_kills[region_kill_index].at <= now:
+            event = region_kills[region_kill_index]
+            region_kill_index += 1
+            region = sim.regions[event.target]
+            if region.op is not None:
+                region.op = None
+                region_kills_fired += 1
+                monitor.trace.append(
+                    f"[t={now:g}] region {event.target} controller "
+                    f"KILLED (replacement at t={event.until:g})")
+            pending_region_restarts.append((event.until, event.target))
+        due = [p for p in pending_region_restarts if p[0] <= now]
+        pending_region_restarts = [p for p in pending_region_restarts
+                                   if p[0] > now]
+        for _, name in due:
+            sim.regions[name].generation += 1
+            sim.build_region_op(name)
+            monitor.trace.append(
+                f"[t={now:g}] region {name} replacement controller "
+                f"started — re-verifies quarantine/share stamps from "
+                f"its own cluster before admitting anything")
+        # federation-controller kill / replacement
+        while fed_kill_index < len(fed_kills) \
+                and fed_kills[fed_kill_index].at <= now:
+            event = fed_kills[fed_kill_index]
+            fed_kill_index += 1
+            if sim.fed is not None:
+                sim.fed = None
+                fed_kills_fired += 1
+                fed_restart_at = event.until
+                monitor.trace.append(
+                    f"[t={now:g}] federation controller KILLED "
+                    f"(replacement at t={event.until:g})")
+        if sim.fed is None and fed_restart_at is not None \
+                and fed_restart_at <= now:
+            sim.build_fed()
+            fed_restart_at = None
+            monitor.trace.append(
+                f"[t={now:g}] federation controller replacement "
+                f"#{sim.fed_generation} started — zero in-memory "
+                f"state, resumes from the regions' durable stamps")
+        # arm operator crashes (the fuse is shared by every region's
+        # provider: the schedule says a controller dies around now,
+        # and whichever regional controller writes next dies)
+        while crash_index < len(crash_events) \
+                and crash_events[crash_index].at <= now:
+            event = crash_events[crash_index]
+            crash_index += 1
+            sim.fuse.arm(event.param, after=event.param % 2 == 1)
+        target = target_of(now)
+        if sim.fed is not None and target:
+            if any(r.gateway.partitioned()
+                   for r in sim.regions.values()):
+                fed_saw_partition = True
+            sim.fed.reconcile(target)
+            fed_reconciles += 1
+        monitor.sample()
+        region_reconciles += sim.reconcile_regions(on_crash=on_crash,
+                                                   monitor=monitor)
+        if (now > schedule.last_fault_time
+                and not sim.fuse.armed and not sim.fuse.pending
+                and sim.fed is not None
+                and not pending_region_restarts
+                and converged(sim)):
+            quiesce_ticks += 1
+            if quiesce_ticks >= 3:
+                is_converged = True
+                break
+        else:
+            quiesce_ticks = 0
+        sim.step_clusters()
+        monitor.sample()
+
+    if is_converged:
+        monitor.final_check(expect_quarantine)
+    else:
+        status = sim.fed.last_status if sim.fed is not None else None
+        monitor.violations.append(InvariantViolation(
+            invariant="liveness", at=clock.now(), subject="fleet",
+            detail=f"federated fleet did not converge within "
+                   f"{config.max_steps} steps ({clock.now():g}s "
+                   f"virtual); last status: {status}"))
+
+    # harness sanity: the episode must have exercised what it gates
+    if region_kills_fired < 1:
+        monitor._violate("harness", "runner",
+                         "no regional-controller kill fired")
+    if fed_kills_fired < 1:
+        monitor._violate("harness", "runner",
+                         "no federation-controller kill fired")
+    if sim.fuse.fired_total < 1:
+        monitor._violate("harness", "runner",
+                         "no operator crash fired — the schedule's "
+                         "crash events never detonated")
+    partitioned_calls = sum(r.gateway.partitioned_calls
+                            for r in sim.regions.values())
+    if fed_saw_partition and partitioned_calls == 0:
+        # the federation ran passes WHILE a partition window was
+        # active, yet never touched a cut gateway — the fault model
+        # is broken (windows the fed-kill fully covered are exempt:
+        # a dead controller cannot probe anything)
+        monitor._violate("harness", "runner",
+                         "a federation pass ran during a partition "
+                         "window but no call ever hit the cut — the "
+                         "windows proved nothing")
+
+    report = ChaosReport(
+        seed=seed,
+        converged=is_converged,
+        violations=list(monitor.violations),
+        fault_kinds=tuple(sorted(schedule.kinds)),
+        crashes_fired=sim.fuse.fired_total,
+        leader_handovers=region_kills_fired + fed_kills_fired,
+        operator_incarnations=sim.region_incarnations
+        + sim.fed_generation,
+        watch_gaps=0,
+        total_seconds=clock.now(),
+        steps=steps,
+        reconciles=region_reconciles + fed_reconciles,
+        trace=list(monitor.trace))
+    report.report_text = "\n".join(
+        [schedule.describe(), monitor.report(seed=seed)])
+    if not report.ok:
+        logger.error("%s", report.report_text)
+    return sim, monitor, report
+
+
+def run_federation_soak(seed: int,
+                        config: Optional[FederationChaosConfig] = None,
+                        ) -> ChaosReport:
+    """The federation robustness gate: a full region-as-canary global
+    rollout to :data:`FED_TARGET_REVISION` under regional-controller
+    kills, federation↔region partitions, a federation-controller kill
+    and regional operator crashes — deterministic in ``seed``. Green
+    means zero ``global-budget`` / ``canary-containment`` /
+    ``federation-resume`` violations AND full convergence: every
+    region done on the target, the bake stamp durable on the canary
+    region, every share stamp back to zero."""
+    config = config or FederationChaosConfig()
+    schedule = FaultSchedule.generate_federation(
+        seed, list(config.regions), horizon=config.horizon)
+    promote_at = config.horizon / 2.0
+
+    def target_of(now: float) -> str:
+        return (FED_FINAL_REVISION if now >= promote_at
+                else FED_TARGET_REVISION)
+
+    def converged(sim: FederationFleetSim) -> bool:
+        if not all(sim.region_converged(name, FED_FINAL_REVISION)
+                   for name in sim.regions):
+            return False
+        canary = sim.regions[sim.canary]
+        stamped = ""
+        for ds in canary.cluster.list_daemon_sets(NS):
+            if ds.metadata.name == "libtpu":
+                stamped = ds.metadata.annotations.get(
+                    sim.fed_keys.bake_passed_annotation, "")
+        if not stamped.startswith(f"{FED_FINAL_REVISION}:"):
+            return False
+        return sim.shares_all_zero()
+
+    _, monitor, report = _run_federation_episode(
+        seed, config, schedule, target_of=target_of,
+        converged=converged, expect_quarantine=None)
+    if monitor.max_joint_unavailable == 0:
+        # harness sanity: a rollout that never made anything
+        # unavailable exercised no budget at all
+        report.violations.append(InvariantViolation(
+            invariant="harness", at=report.total_seconds,
+            subject="monitor",
+            detail="joint unavailability never rose above zero — the "
+                   "episode upgraded nothing, so the global-budget "
+                   "audit proved nothing"))
+    return report
+
+
+def run_federation_bad_revision_soak(
+        seed: int,
+        config: Optional[FederationChaosConfig] = None) -> ChaosReport:
+    """The containment gate: the federation's target becomes a
+    revision whose pods can never become Ready. The canary REGION's
+    own RolloutGuard must halt and roll the region back; the
+    federation must lift the quarantine to every region in the same
+    pass(es) — through a canary-region controller kill, a
+    federation↔region partition and a federation-controller kill —
+    and no non-canary region may ever carry the condemned revision
+    (DS or pod). Convergence: every region back on its initial
+    revision, the quarantine record standing on EVERY region's
+    DaemonSet, shares back to zero."""
+    config = config or FederationChaosConfig()
+    if not config.bad_revision:
+        config = copy.deepcopy(config)
+        config.bad_revision = BAD_REVISION_HASH
+    # the canary choice is config-deterministic (FederationFleetSim
+    # picks the lowest-utilization region at t=0): recompute it here so
+    # the schedule can target it before the sim exists
+    names = list(config.regions)
+    canary_name = min(
+        names,
+        key=lambda name: (config.region_utilization(
+            names.index(name), 0.0), name))
+    schedule = FaultSchedule.generate_federation_bad_revision(
+        seed, names, canary_name, horizon=config.horizon)
+    bad_events = schedule.by_kind(FAULT_BAD_REVISION)
+    bad_at = bad_events[0].at if bad_events else 0.0
+
+    def target_of(now: float) -> str:
+        return config.bad_revision if now >= bad_at else ""
+
+    def converged(sim: FederationFleetSim) -> bool:
+        for name, region in sim.regions.items():
+            # recovery target: the fleet's initial revision (the
+            # canary region rolled back; nobody else ever moved)
+            if not sim.region_converged(name, "old"):
+                return False
+            ds = next((d for d in region.cluster.list_daemon_sets(NS)
+                       if d.metadata.name == "libtpu"), None)
+            if ds is None or ds.metadata.annotations.get(
+                    sim.keys.quarantined_revision_annotation) \
+                    != config.bad_revision:
+                return False
+        return sim.shares_all_zero()
+
+    _, monitor, report = _run_federation_episode(
+        seed, config, schedule, target_of=target_of,
+        converged=converged, expect_quarantine=config.bad_revision)
+    if monitor.halt_seen_at is None:
+        monitor._violate(
+            "harness", "monitor",
+            "no quarantine verdict observed — the bad revision never "
+            "tripped the canary region's guard, so the containment "
+            "gate proved nothing")
+        report.violations = list(monitor.violations)
+    if monitor.halt_seen_at is not None \
+            and monitor.fleet_quarantined_at is not None:
+        monitor.trace.append(
+            f"[t={report.total_seconds:g}] canary-halt -> "
+            f"fleet-quarantine latency: "
+            f"{monitor.fleet_quarantined_at - monitor.halt_seen_at:g}s")
+        report.trace = list(monitor.trace)
+    report.report_text = "\n".join(
+        [schedule.describe(), monitor.report(seed=seed)])
+    return report

@@ -31,6 +31,7 @@ from tpu_operator_libs.chaos.schedule import (
     FAULT_REPLICA_KILL,
     FAULT_STALE_READS,
     FAULT_WATCH_BREAK,
+    FAULT_WATCH_DELAY,
     FaultEvent,
     FaultSchedule,
 )
@@ -227,6 +228,11 @@ class ChaosInjector:
             elif event.kind == FAULT_WATCH_BREAK:
                 cluster.schedule_at(
                     event.at, lambda: cluster.drop_watch_streams())
+            elif event.kind == FAULT_WATCH_DELAY:
+                # schedules its own start/flush actions; seed-pure in
+                # the event's param
+                cluster.delay_watch_events(event.at, event.until,
+                                           seed=event.param)
             elif event.kind == FAULT_STALE_READS:
                 cluster.schedule_at(
                     event.at, lambda e=event: self._inject_stale(e))

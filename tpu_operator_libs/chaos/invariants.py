@@ -367,7 +367,13 @@ class InvariantMonitor:
         self.decisions_recorded = 0
         #: explain() probes run / found empty (teeth evidence).
         self.explains_probed = 0
-        self._watch = self.cluster.watch(max_queue=self.watch_queue_bound)
+        # delay_exempt: the auditor's stream stays live through a
+        # watch-delay fault window — the SYSTEM under test sees the
+        # lag, the monitor judging it must see ground truth (a lagged
+        # mirror would emit false budget/placement verdicts about
+        # transitions that already healed)
+        self._watch = self.cluster.watch(max_queue=self.watch_queue_bound,
+                                         delay_exempt=True)
         self.resync("initial sync")
 
     def _mirror_of(self, node) -> _NodeMirror:
@@ -504,7 +510,7 @@ class InvariantMonitor:
                 # and relist, like any informer whose server hung up
                 self.watch_gaps += 1
                 self._watch = self.cluster.watch(
-                    max_queue=self.watch_queue_bound)
+                    max_queue=self.watch_queue_bound, delay_exempt=True)
                 self.resync("watch stream dropped")
             event = self._watch.get(timeout=0.0)
             if event is None:

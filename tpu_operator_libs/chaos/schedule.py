@@ -79,6 +79,39 @@ FAULT_TRAFFIC_SPIKE = "traffic-spike"
 #: shares keeping the joint spend under the fleet budget throughout.
 #: Proven by the replica-kill soak gate (runner.run_replica_kill_soak).
 FAULT_REPLICA_KILL = "replica-kill"
+#: Watch event delivery is DELAYED for the window ``[at, until)``:
+#: events emitted inside the window are buffered and released at the
+#: close, per-kind streams re-interleaved in a seed-pure order
+#: (per-object ordering preserved — an apiserver never reorders one
+#: connection's stream, but the separate per-kind list/watch streams an
+#: informer runs genuinely race each other). Distinct from
+#: ``watch-break``: the stream never drops, so consumers get no relist
+#: signal — their caches simply go stale, which is exactly the window
+#: the incremental ``build_state`` path must stay safe in (writes are
+#: guarded by fencing/preconditions, reads must reconverge once the
+#: backlog lands). The invariant monitor's own stream is exempt (the
+#: auditor sees ground truth; the system under test sees the lag).
+FAULT_WATCH_DELAY = "watch-delay"
+#: A REGION's operator controller dies mid-rollout (multi-cluster
+#: federation gate): ``target`` is the region name, ``until`` when its
+#: replacement arrives. The region's cluster stays alive — pods
+#: restart, the federation still reads/stamps it — but nothing
+#: reconciles its nodes until the replacement rebuilds from the
+#: region's own durable state (labels, annotations, the share stamp).
+FAULT_REGION_KILL = "region-controller-kill"
+#: The federation layer is PARTITIONED from one region for the window
+#: ``[at, until)``: the federation's writes to that region are
+#: rejected and its reads return pre-partition snapshots (a stale
+#: regional cache). Recovery is the system's job — the freshness probe
+#: must detect the cut, the region must never be admitted on stale
+#: state, and no budget share anywhere may be raised until the fleet
+#: reads fresh again (federation/controller.py).
+FAULT_FED_PARTITION = "fed-partition"
+#: The federation controller itself dies mid-wave: ``until`` is when
+#: its replacement starts, with zero in-memory state — the rollout
+#: must resume from the regions' durable stamps alone (the
+#: ``federation-resume`` invariant).
+FAULT_FED_KILL = "federation-controller-kill"
 
 #: The full catalog, in deterministic order (generation samples from it).
 FAULT_KINDS = (
@@ -108,6 +141,28 @@ API_BURST_OPERATIONS = (
     "list_daemon_sets",
     "list_controller_revisions",
 )
+
+
+def _fed_kill_event(rng: "random.Random", horizon: float,
+                    partitions: "list[FaultEvent]") -> "FaultEvent":
+    """A federation-controller kill whose downtime never fully covers
+    a partition window: a federation that is dead for a partition's
+    whole duration cannot be tested against it (the partition would be
+    a harness-sanity no-op), so the windows must leave the controller
+    alive on at least one side of every cut."""
+    for _ in range(32):
+        start = rng.uniform(horizon * 0.1, horizon * 0.55)
+        until = start + rng.uniform(60.0, 150.0)
+        if not any(start <= p.at and until >= p.until
+                   for p in partitions):
+            return FaultEvent(at=start, kind=FAULT_FED_KILL,
+                              until=until)
+    # pathological horizons only: place the kill strictly before the
+    # first partition
+    first = min((p.at for p in partitions), default=horizon)
+    start = max(0.1, first - 180.0)
+    return FaultEvent(at=start, kind=FAULT_FED_KILL,
+                      until=max(start + 30.0, first - 10.0))
 
 
 @dataclass(frozen=True)
@@ -427,7 +482,11 @@ class FaultSchedule:
             at=rng.uniform(0.1, horizon * 0.45),
             kind=FAULT_OPERATOR_CRASH,
             param=rng.randint(0, 8)))
-        pool = [FAULT_API_BURST, FAULT_WATCH_BREAK, FAULT_STALE_READS]
+        # the delta-wired partition-read path lives in this gate, so the
+        # watch-delay fault (stale informer views with NO relist signal)
+        # rides along in its side-fault pool
+        pool = [FAULT_API_BURST, FAULT_WATCH_BREAK, FAULT_STALE_READS,
+                FAULT_WATCH_DELAY]
         for kind in rng.sample(pool, min(extra_kinds, len(pool))):
             start = rng.uniform(0.1, horizon * 0.7)
             if kind == FAULT_API_BURST:
@@ -439,8 +498,103 @@ class FaultSchedule:
                 events.append(FaultEvent(
                     at=start, kind=kind, target=rng.choice(nodes),
                     param=rng.randint(1, 3)))
+            elif kind == FAULT_WATCH_DELAY:
+                events.append(FaultEvent(
+                    at=start, kind=kind,
+                    until=start + rng.uniform(30.0, 90.0),
+                    param=rng.randint(0, 9999)))
             else:
                 events.append(FaultEvent(at=start, kind=kind))
+        events.sort(key=lambda e: (e.at, e.kind, e.target))
+        return cls(seed=seed, events=tuple(events))
+
+    @classmethod
+    def generate_federation(cls, seed: int, regions: "list[str]",
+                            horizon: float = 600.0) -> "FaultSchedule":
+        """Schedule for the multi-cluster federation gate: 1-2
+        regional-controller kills landing mid-rollout (``until`` is
+        when the replacement controller starts), 1-2
+        federation↔region partitions (stale reads + rejected writes
+        for the window), exactly one federation-controller kill
+        mid-wave, at least one operator crash inside a regional
+        controller's durable-write path, and 1-2 api-error bursts on
+        seed-chosen region apiservers riding along. Node-health faults
+        are excluded for the specialized-gate reason: with every
+        unavailability operator-caused, the ``global-budget`` audit is
+        exact rather than fault-excused.
+        """
+        if len(regions) < 2:
+            raise ValueError("federation schedule needs >= 2 regions")
+        rng = random.Random(f"chaos-federation:{seed}")
+        ordered = sorted(regions)
+        events: list[FaultEvent] = []
+        for region in rng.sample(ordered, rng.randint(1, 2)):
+            start = rng.uniform(horizon * 0.1, horizon * 0.5)
+            events.append(FaultEvent(
+                at=start, kind=FAULT_REGION_KILL, target=region,
+                until=start + rng.uniform(60.0, 180.0)))
+        partitions = []
+        for region in rng.sample(ordered, rng.randint(1, 2)):
+            start = rng.uniform(horizon * 0.1, horizon * 0.6)
+            partitions.append(FaultEvent(
+                at=start, kind=FAULT_FED_PARTITION, target=region,
+                until=start + rng.uniform(40.0, 140.0)))
+        events.extend(partitions)
+        events.append(_fed_kill_event(rng, horizon, partitions))
+        events.append(FaultEvent(
+            at=rng.uniform(0.1, horizon * 0.45),
+            kind=FAULT_OPERATOR_CRASH,
+            param=rng.randint(0, 8)))
+        for _ in range(rng.randint(1, 2)):
+            events.append(FaultEvent(
+                at=rng.uniform(0.1, horizon * 0.7),
+                kind=FAULT_API_BURST,
+                target=(f"{rng.choice(ordered)}:"
+                        f"{rng.choice(API_BURST_OPERATIONS)}"),
+                param=rng.randint(1, 3)))
+        events.sort(key=lambda e: (e.at, e.kind, e.target))
+        return cls(seed=seed, events=tuple(events))
+
+    @classmethod
+    def generate_federation_bad_revision(
+            cls, seed: int, regions: "list[str]", canary: str,
+            horizon: float = 600.0) -> "FaultSchedule":
+        """Schedule for the federation bad-revision gate: the
+        federation's target becomes a revision whose pods can never
+        become Ready (``bad-revision`` with target ``"fleet"``), and
+        the containment machinery must hold while the canary region's
+        controller is killed mid-rollback, the canary region is
+        partitioned from the federation around the verdict, and the
+        federation controller itself dies — the quarantine must still
+        reach every region and no non-canary region may ever admit the
+        condemned revision."""
+        if len(regions) < 2:
+            raise ValueError("federation schedule needs >= 2 regions")
+        rng = random.Random(f"chaos-federation-bad:{seed}")
+        ordered = sorted(regions)
+        bad_at = rng.uniform(horizon * 0.15, horizon * 0.3)
+        events: list[FaultEvent] = [FaultEvent(
+            at=bad_at, kind=FAULT_BAD_REVISION, target="fleet")]
+        # the canary region's controller dies around the halt/rollback
+        start = bad_at + rng.uniform(10.0, 80.0)
+        events.append(FaultEvent(
+            at=start, kind=FAULT_REGION_KILL, target=canary,
+            until=start + rng.uniform(60.0, 150.0)))
+        # the federation is cut off from a seed-chosen region (the
+        # canary half the time: the quarantine lift must wait out the
+        # partition and still land)
+        target = canary if rng.random() < 0.5 \
+            else rng.choice([r for r in ordered if r != canary])
+        start = bad_at + rng.uniform(0.0, 100.0)
+        partition = FaultEvent(
+            at=start, kind=FAULT_FED_PARTITION, target=target,
+            until=start + rng.uniform(40.0, 120.0))
+        events.append(partition)
+        events.append(_fed_kill_event(rng, horizon, [partition]))
+        events.append(FaultEvent(
+            at=rng.uniform(0.1, bad_at),
+            kind=FAULT_OPERATOR_CRASH,
+            param=rng.randint(0, 8)))
         events.sort(key=lambda e: (e.at, e.kind, e.target))
         return cls(seed=seed, events=tuple(events))
 

@@ -724,6 +724,69 @@ class TopologyKeys:
         return f"{self.driver.upper()}SliceReconfiguration"
 
 
+@dataclass(frozen=True)
+class FederationKeys:
+    """Instance-scoped builder for the multi-cluster federation keys.
+
+    Fourth key family next to :class:`UpgradeKeys` /
+    :class:`RemediationKeys` / :class:`TopologyKeys`, same
+    driver/domain scoping. Every durable fact the federation controller
+    relies on lives as an annotation on a REGION's runtime DaemonSet —
+    inside the region's own cluster, on the same object the region
+    operator already reads every pass — so a partitioned or restarted
+    regional controller re-derives the federation's verdicts (its
+    budget share, the fleet quarantine) from local cluster state alone,
+    and a restarted federation controller re-derives the rollout's
+    progress by reading the regions back.
+    """
+
+    driver: str = "libtpu"
+    domain: str = "google.com"
+
+    @property
+    def budget_share_annotation(self) -> str:
+        """REGION DaemonSet annotation: this region's durable share of
+        the GLOBAL disruption budget (an int node count). The region
+        operator's effective ``maxUnavailable`` IS this stamp — absent
+        or 0 means the region admits nothing — so the federation's
+        spend rule is enforced region-locally even while the region is
+        partitioned from the federation layer. The ledger invariant
+        (the sum of all stamped shares never exceeds the global B) is
+        maintained write-side: decreases are stamped immediately,
+        increases only while every region's stamp was freshly read
+        back this pass (see federation/ledger.py)."""
+        return f"{self.domain}/{self.driver}-fed.budget-share"
+
+    @property
+    def bake_passed_annotation(self) -> str:
+        """CANARY-REGION DaemonSet annotation:
+        ``<revision-hash>:<epoch-seconds>`` stamped when the canary
+        region reached upgrade-done on the revision (every node DONE,
+        every runtime pod on the hash and Ready). Fleet waves open only
+        once ``bakeSeconds`` have elapsed past the stamp; keyed by hash
+        so a new rollout re-runs its own region bake. The durable half
+        of canary-containment: a restarted federation controller may
+        not admit any non-canary region without re-reading this stamp
+        fresh."""
+        return f"{self.domain}/{self.driver}-fed.bake-passed"
+
+    @property
+    def probe_annotation(self) -> str:
+        """REGION DaemonSet annotation the federation controller
+        writes every pass with its current timestamp. Partition
+        detection: a region whose probe write is rejected (or never
+        read back) is treated as unreachable — its stale reads are
+        distrusted, and no budget share anywhere in the fleet may be
+        RAISED until every region reads fresh again (decreases stay
+        allowed; they only tighten the global inequality)."""
+        return f"{self.domain}/{self.driver}-fed.probe"
+
+    @property
+    def event_reason(self) -> str:
+        """Reason string attached to Kubernetes events."""
+        return f"{self.driver.upper()}FederatedRollout"
+
+
 #: Field selector template filtering pods by the node they run on
 #: (consts.go:70-73).
 NODE_NAME_FIELD_SELECTOR_FMT = "spec.nodeName={}"

@@ -1,0 +1,46 @@
+"""Multi-cluster federation: region-as-canary global rollouts.
+
+The production topology that serves millions of users is many clusters
+across regions, each already running this library's per-cluster
+operator. This package is the layer above them — a federation
+controller that treats whole clusters/regions as ring members and
+drives each region purely through the CRD/policy surface its operator
+already consumes:
+
+- :class:`~tpu_operator_libs.federation.controller.
+  FederationController` — region-as-canary waves (one low-traffic
+  region bakes every revision behind a durable bake stamp before the
+  fleet), fleet-wide quarantine lifted from the canary region's own
+  RolloutGuard verdict, follow-the-sun admission ordering from each
+  region's live capacity signal, and partition-safe freshness probing.
+- :class:`~tpu_operator_libs.federation.ledger.
+  FederationBudgetLedger` — the PR 7 shard-budget ledger lifted one
+  level: a GLOBAL disruption budget split into durable per-region
+  share stamps, spent under decrease-immediate/increase-next-pass with
+  a raise gate that freezes fleet-wide while any region reads stale.
+
+Robustness is the headline property, so the subsystem ships inside a
+standing chaos gate from day one: ``make test-federation`` drives a
+multi-cluster :class:`~tpu_operator_libs.chaos.federation.
+FederationFleetSim` (every region a real FakeCluster + operator
+incarnation) through regional-controller kills, federation↔region
+partitions and federation-controller kills, with the ``global-budget``,
+``canary-containment`` and ``federation-resume`` invariants always on
+(docs/federation.md).
+"""
+
+from tpu_operator_libs.api.federation_policy import FederationPolicySpec
+from tpu_operator_libs.federation.controller import (
+    FederationController,
+    RegionHandle,
+    RegionView,
+)
+from tpu_operator_libs.federation.ledger import FederationBudgetLedger
+
+__all__ = [
+    "FederationBudgetLedger",
+    "FederationController",
+    "FederationPolicySpec",
+    "RegionHandle",
+    "RegionView",
+]

@@ -1,0 +1,682 @@
+"""FederationController: region-as-canary global rollouts.
+
+One controller, many clusters. Each region runs the SAME per-cluster
+operator this library already ships — sharded, traffic-aware, with its
+own RolloutGuard — and the federation layer drives it purely through
+the CRD/policy surface it already consumes:
+
+- **admission** is rolling the region's runtime DaemonSet to the
+  target revision (the region operator notices outdated pods and walks
+  its own waves);
+- **budget** is the durable per-region share stamp
+  (:class:`~tpu_operator_libs.federation.ledger.
+  FederationBudgetLedger`) the region operator reads as its effective
+  ``maxUnavailable`` — the global B is enforced region-locally, so a
+  partitioned or freshly-restarted regional controller cannot
+  overdraw;
+- **verdicts** are the region guard's own quarantine annotation: the
+  canary region's guard halts and rolls back LOCALLY on a bad
+  revision, and the federation lifts the verdict fleet-wide by
+  stamping every other region's DaemonSet in the same pass.
+
+Everything durable lives on the regions' DaemonSets (share stamps, the
+canary bake stamp, quarantine records, the freshness probe); the
+controller object carries only counters and advisory bookkeeping, so a
+federation-controller crash-restart resumes the rollout mid-wave from
+the regions' state alone — the ``federation-resume`` invariant the
+chaos gate pins.
+
+Partition model: before trusting a region's reads, the controller
+writes a probe annotation and verifies it reads back. A region whose
+probe fails is *partitioned*: its stale data is used for display only,
+it is never admitted, and — because a stale read could hide a share
+stamp a previous incarnation granted — no region's share anywhere may
+be RAISED until the whole fleet reads fresh again (decreases stay
+allowed; they only tighten the global inequality).
+"""
+
+from __future__ import annotations
+
+import logging
+from dataclasses import dataclass
+from typing import Callable, Optional
+
+from tpu_operator_libs.api.federation_policy import FederationPolicySpec
+from tpu_operator_libs.api.upgrade_policy import (
+    scaled_value_from_int_or_percent,
+)
+from tpu_operator_libs.consts import (
+    POD_CONTROLLER_REVISION_HASH_LABEL,
+    FederationKeys,
+    UpgradeKeys,
+    UpgradeState,
+)
+from tpu_operator_libs.federation.ledger import FederationBudgetLedger
+from tpu_operator_libs.k8s.client import (
+    ApiServerError,
+    ConflictError,
+    NotFoundError,
+)
+from tpu_operator_libs.k8s.selectors import selector_from_labels
+from tpu_operator_libs.obs.audit import DecisionAudit
+from tpu_operator_libs.util import Clock
+
+logger = logging.getLogger(__name__)
+
+#: Transients a federation pass rides out per region (the region is
+#: simply skipped this pass and re-probed next pass).
+_TRANSIENTS = (ApiServerError, ConflictError, NotFoundError,
+               TimeoutError)
+
+
+@dataclass
+class RegionHandle:
+    """One region's access surface.
+
+    ``client`` is the region apiserver's K8sClient (possibly behind a
+    partition-detecting proxy); ``utilization`` is the region's live
+    serving-load signal in [0, 1] (the PR 10 capacity picture, one
+    number per region — a regional capacity controller's utilization,
+    a gateway QPS ratio...), consulted for follow-the-sun ordering.
+    ``roll`` overrides how an admission rolls the region's DaemonSet
+    to a revision (default: the client's ``bump_daemon_set_revision``,
+    which the FakeCluster regions of the chaos sim implement; a real
+    deployment patches the DS pod template).
+    """
+
+    name: str
+    client: object
+    namespace: str = "tpu-system"
+    ds_name: str = "libtpu"
+    utilization: Optional[Callable[[float], float]] = None
+    roll: Optional[Callable[[str], None]] = None
+
+    def roll_to(self, revision: str) -> None:
+        if self.roll is not None:
+            self.roll(revision)
+            return
+        self.client.bump_daemon_set_revision(self.namespace,
+                                             self.ds_name, revision)
+
+
+@dataclass
+class RegionView:
+    """One pass's (possibly stale) picture of a region."""
+
+    name: str
+    #: True only when the freshness probe landed AND read back — the
+    #: precondition for trusting anything below for decisions.
+    reachable: bool = False
+    ds_found: bool = False
+    newest: str = ""
+    total: int = 0
+    nodes_done: int = 0
+    unavailable: int = 0
+    ready_on_target: int = 0
+    share: Optional[int] = None
+    quarantined: frozenset = frozenset()
+    bake_stamp: str = ""
+    utilization: Optional[float] = None
+
+    def done_on(self, revision: str) -> bool:
+        """Region fully converged on ``revision``: DS points at it,
+        every node upgrade-done and schedulable, every runtime pod on
+        the hash and Ready."""
+        return (self.ds_found and self.newest == revision
+                and self.total > 0
+                and self.nodes_done == self.total
+                and self.ready_on_target == self.total
+                and self.unavailable == 0)
+
+
+class FederationController:
+    """The multi-cluster rollout brain. Drive with
+    :meth:`reconcile(target_revision)` once per federation pass."""
+
+    def __init__(self, regions: "list[RegionHandle]",
+                 policy: Optional[FederationPolicySpec] = None,
+                 keys: Optional[FederationKeys] = None,
+                 upgrade_keys: Optional[UpgradeKeys] = None,
+                 clock: Optional[Clock] = None,
+                 audit: Optional[DecisionAudit] = None) -> None:
+        if not regions:
+            raise ValueError("at least one region is required")
+        names = [handle.name for handle in regions]
+        if len(set(names)) != len(names):
+            raise ValueError(f"duplicate region names: {sorted(names)}")
+        self.regions: "dict[str, RegionHandle]" = {
+            handle.name: handle for handle in regions}
+        self.policy = policy or FederationPolicySpec()
+        self.keys = keys or FederationKeys()
+        self.upgrade_keys = upgrade_keys or UpgradeKeys()
+        self._clock = clock or Clock()
+        self.ledger = FederationBudgetLedger(self.keys)
+        #: Region-level decision audit (obs/ idiom; ``node`` carries
+        #: the region name). Feeds explain_region and the chaos
+        #: monitor's cross-incarnation mirror.
+        self.audit = audit or DecisionAudit(max_records=2048,
+                                            clock=self._clock)
+        # -- advisory in-memory state (a restart loses none of the
+        # safety story, only wait bookkeeping and cached sizes) --
+        #: region -> last known managed-node count (used for the
+        #: global-budget denominator while a region is partitioned —
+        #: an unknown region contributes its last census, or 0 on a
+        #: fresh restart, which only SHRINKS B: the conservative side).
+        self._region_totals: "dict[str, int]" = {}
+        #: region -> virtual time it started waiting for its trough.
+        self._trough_wait_started: "dict[str, float]" = {}
+        self._last_views: "dict[str, RegionView]" = {}
+        self._last_target = ""
+        # -- lifetime counters (metrics.observe_federation feed) --
+        self.admissions_total = 0
+        self.quarantine_stamps_total = 0
+        self.bake_stamps_total = 0
+        self.raise_freeze_passes_total = 0
+        self.share_stamps_total = 0
+        self.partitioned_reads_total = 0
+        self.passes_total = 0
+        self.last_status: "Optional[dict]" = None
+
+    # ------------------------------------------------------------------
+    # region reads
+    # ------------------------------------------------------------------
+    def _read_region(self, handle: RegionHandle, now: float,
+                     target: str) -> RegionView:
+        view = RegionView(name=handle.name)
+        client = handle.client
+        probe_value = f"{now:g}"
+        probed = False
+        try:
+            client.patch_daemon_set_annotations(
+                handle.namespace, handle.ds_name,
+                {self.keys.probe_annotation: probe_value})
+            probed = True
+        except _TRANSIENTS:
+            self.partitioned_reads_total += 1
+        try:
+            daemon_sets = client.list_daemon_sets(handle.namespace)
+            ds = next((d for d in daemon_sets
+                       if d.metadata.name == handle.ds_name), None)
+            if ds is not None:
+                view.ds_found = True
+                annotations = ds.metadata.annotations
+                # freshness: the probe we just wrote must read back —
+                # a stale cache serving pre-partition snapshots fails
+                # here even when the write "succeeded" before the cut
+                view.reachable = probed and annotations.get(
+                    self.keys.probe_annotation) == probe_value
+                view.share = self.ledger.share_from(annotations)
+                quarantined = annotations.get(
+                    self.upgrade_keys.quarantined_revision_annotation)
+                if quarantined:
+                    view.quarantined = frozenset({quarantined})
+                view.bake_stamp = annotations.get(
+                    self.keys.bake_passed_annotation, "")
+                view.newest = self._newest_revision(client, handle, ds)
+            nodes = client.list_nodes()
+            view.total = len(nodes)
+            state_label = self.upgrade_keys.state_label
+            done = str(UpgradeState.DONE)
+            for node in nodes:
+                if node.metadata.labels.get(state_label) == done:
+                    view.nodes_done += 1
+                if node.is_unschedulable() or not node.is_ready():
+                    view.unavailable += 1
+            pods = client.list_pods(namespace=handle.namespace)
+            view.ready_on_target = sum(
+                1 for pod in pods
+                if pod.controller_owner() is not None
+                and pod.metadata.labels.get(
+                    POD_CONTROLLER_REVISION_HASH_LABEL) == target
+                and pod.is_ready())
+        except _TRANSIENTS:
+            view.reachable = False
+        if view.reachable:
+            self._region_totals[handle.name] = view.total
+        return view
+
+    def _newest_revision(self, client: "object", handle: RegionHandle,
+                         ds: "object") -> str:
+        """Newest ControllerRevision hash of the region's runtime DS
+        (the pod-manager oracle, minus the per-snapshot memo — the
+        federation reads each region once per pass)."""
+        try:
+            selector = selector_from_labels(ds.spec.selector)
+            revisions = client.list_controller_revisions(
+                handle.namespace, selector)
+        except _TRANSIENTS:
+            return ""
+        prefix = f"{ds.metadata.name}-"
+        owned = [r for r in revisions
+                 if r.metadata.name.startswith(prefix)
+                 and "-" not in r.metadata.name[len(prefix):]]
+        if not owned:
+            return ""
+        newest = max(owned, key=lambda r: r.revision)
+        return newest.metadata.name[len(prefix):]
+
+    # ------------------------------------------------------------------
+    # the federation pass
+    # ------------------------------------------------------------------
+    def reconcile(self, target_revision: str) -> dict:
+        """One federation pass toward ``target_revision``. Reads every
+        region (probe-verified), lifts quarantine verdicts fleet-wide,
+        stamps the canary bake, admits regions (canary first, then
+        follow-the-sun waves), and maintains the per-region budget
+        shares. Returns the pass's status block."""
+        now = self._clock.now()
+        self.passes_total += 1
+        self.audit.begin_pass()
+        policy = self.policy
+        if not policy.enable or not target_revision:
+            self.last_status = {"target": target_revision,
+                                "enabled": policy.enable,
+                                "regions": {}}
+            return self.last_status
+        fleet = sorted(self.regions)
+        views = {name: self._read_region(self.regions[name], now,
+                                         target_revision)
+                 for name in fleet}
+        for name in fleet:
+            view = views[name]
+            if view.utilization is None:
+                signal = self.regions[name].utilization
+                if signal is not None:
+                    try:
+                        view.utilization = max(0.0, min(1.0,
+                                                        signal(now)))
+                    except Exception:  # noqa: BLE001 — a broken signal
+                        view.utilization = None  # must not wedge a pass
+        self._last_views = views
+        self._last_target = target_revision
+        canary = self._canary_region(views)
+
+        quarantined: set[str] = set()
+        for view in views.values():
+            quarantined |= view.quarantined
+        halted = target_revision in quarantined
+        if halted:
+            self._propagate_quarantine(views, target_revision)
+
+        baked, bake_at = self._bake_state(views, canary,
+                                          target_revision)
+        admitted: list[str] = []
+        if not halted:
+            admitted = self._admit(views, canary, target_revision,
+                                   baked, now)
+
+        shares = self._maintain_shares(views, canary, target_revision,
+                                       admitted)
+
+        status = {
+            "target": target_revision,
+            "canaryRegion": canary,
+            "halted": halted,
+            "quarantined": sorted(quarantined),
+            "baked": baked,
+            "bakePassedAt": bake_at,
+            "globalBudget": self._global_budget(views),
+            "shares": shares,
+            "admittedThisPass": admitted,
+            "regions": {
+                name: {
+                    "reachable": view.reachable,
+                    "revision": view.newest,
+                    "total": view.total,
+                    "done": view.done_on(target_revision),
+                    "unavailable": view.unavailable,
+                    "share": view.share,
+                    "utilization": view.utilization,
+                    "phase": self._phase(view, canary,
+                                         target_revision, halted,
+                                         baked),
+                } for name, view in sorted(views.items())},
+        }
+        self.last_status = status
+        return status
+
+    def _phase(self, view: RegionView, canary: str, target: str,
+               halted: bool, baked: bool) -> str:
+        if not view.reachable:
+            return "partitioned"
+        if halted:
+            return "quarantined" if view.newest == target \
+                or view.quarantined else "held"
+        if view.done_on(target):
+            return "done"
+        if view.newest == target:
+            return "canary-baking" if view.name == canary \
+                and not baked else "upgrading"
+        return "pending"
+
+    def _canary_region(self, views: "dict[str, RegionView]") -> str:
+        """The configured canary region, or — with ``canaryRegion``
+        unset — the lowest-utilization region (unknown utilization
+        sorts last; ties by name). Evaluated against live signals, so
+        a restarted controller lands on the same region as long as the
+        traffic picture has not inverted mid-wave; pin ``canaryRegion``
+        for a byte-stable choice."""
+        if self.policy.canary_region:
+            return self.policy.canary_region
+        def rank(name: str) -> tuple:
+            u = views[name].utilization
+            return (u if u is not None else 2.0, name)
+        return min(sorted(views), key=rank)
+
+    # ------------------------------------------------------------------
+    # quarantine lift (canary containment's second half)
+    # ------------------------------------------------------------------
+    def _propagate_quarantine(self, views: "dict[str, RegionView]",
+                              target: str) -> None:
+        """A region guard condemned ``target``: stamp every other
+        reachable region's DaemonSet in the SAME pass, so recovered or
+        partition-healed regional controllers re-derive the fleet halt
+        from their own cluster state before admitting anything."""
+        key = self.upgrade_keys.quarantined_revision_annotation
+        for name in sorted(views):
+            view = views[name]
+            if not view.reachable or target in view.quarantined:
+                continue
+            handle = self.regions[name]
+            try:
+                self.regions[name].client.patch_daemon_set_annotations(
+                    handle.namespace, handle.ds_name, {key: target})
+            except _TRANSIENTS as exc:
+                logger.warning("quarantine stamp for region %s "
+                               "deferred: %s", name, exc)
+                continue
+            view.quarantined = view.quarantined | {target}
+            self.quarantine_stamps_total += 1
+            self.audit.record(
+                "fed-quarantine", name,
+                decision=f"quarantine {target}",
+                rule="canary-verdict-lifted",
+                inputs={"revision": target})
+            logger.warning(
+                "FEDERATION HALT: revision %s quarantined fleet-wide "
+                "(stamped region %s)", target, name)
+
+    # ------------------------------------------------------------------
+    # canary bake
+    # ------------------------------------------------------------------
+    def _bake_state(self, views: "dict[str, RegionView]", canary: str,
+                    target: str) -> "tuple[bool, Optional[float]]":
+        """(baked, stamped_at): reads the durable bake stamp off the
+        canary region's DaemonSet — writing it first when the canary
+        region just converged on the target. Only a FRESH canary read
+        counts: a stale view could hide a quarantine racing the bake."""
+        view = views.get(canary)
+        if view is None or not view.reachable:
+            return False, None
+        revision, _, passed_at = view.bake_stamp.partition(":")
+        if revision == target and passed_at:
+            try:
+                stamped = float(passed_at)
+                now = self._clock.now()
+                return now >= stamped + self.policy.bake_seconds, stamped
+            except ValueError:
+                pass  # corrupt stamp: fall through and re-derive
+        if not view.done_on(target) or target in view.quarantined:
+            return False, None
+        handle = self.regions[canary]
+        now = self._clock.now()
+        try:
+            handle.client.patch_daemon_set_annotations(
+                handle.namespace, handle.ds_name,
+                {self.keys.bake_passed_annotation: f"{target}:{now:g}"})
+        except _TRANSIENTS as exc:
+            logger.warning("bake stamp for %s deferred: %s", target, exc)
+            return False, None
+        self.bake_stamps_total += 1
+        self.audit.record(
+            "fed-bake", canary, decision=f"bake started for {target}",
+            rule="canary-region-converged",
+            inputs={"bakeSeconds": self.policy.bake_seconds})
+        logger.info("canary region %s converged on %s; baking %ds "
+                    "before fleet waves", canary, target,
+                    self.policy.bake_seconds)
+        return self.policy.bake_seconds <= 0, now
+
+    # ------------------------------------------------------------------
+    # admissions (canary first, then follow-the-sun waves)
+    # ------------------------------------------------------------------
+    def _admit(self, views: "dict[str, RegionView]", canary: str,
+               target: str, baked: bool, now: float) -> "list[str]":
+        admitted: list[str] = []
+        canary_view = views.get(canary)
+        if canary_view is not None and canary_view.reachable \
+                and canary_view.ds_found \
+                and canary_view.newest != target \
+                and target not in canary_view.quarantined:
+            if self._roll(canary, target, rule="canary-region"):
+                admitted.append(canary)
+        if not baked:
+            for name in sorted(views):
+                if name != canary and views[name].newest != target:
+                    self.audit.record_hold(
+                        name, rule="canary-baking",
+                        inputs={"canary": canary, "target": target})
+            return admitted
+        upgrading = [name for name, view in views.items()
+                     if name != canary and view.ds_found
+                     and view.newest == target
+                     and not view.done_on(target)]
+        slots = self.policy.max_concurrent_regions - len(upgrading)
+        candidates = [name for name in views
+                      if name != canary
+                      and views[name].reachable
+                      and views[name].ds_found
+                      and views[name].newest != target]
+        candidates.sort(key=lambda name: (
+            views[name].utilization
+            if views[name].utilization is not None else 2.0, name))
+        if not self.policy.follow_the_sun:
+            candidates.sort()
+        for name in candidates:
+            if slots <= 0:
+                self.audit.record_hold(
+                    name, rule="region-concurrency",
+                    inputs={"maxConcurrentRegions":
+                            self.policy.max_concurrent_regions})
+                continue
+            if not self._in_trough(views[name], now):
+                self.audit.record_hold(
+                    name, rule="awaiting-trough",
+                    inputs={"utilization": views[name].utilization,
+                            "troughUtilization":
+                            self.policy.trough_utilization})
+                continue
+            if self._roll(name, target, rule="follow-the-sun"):
+                admitted.append(name)
+                slots -= 1
+                self._trough_wait_started.pop(name, None)
+        return admitted
+
+    def _in_trough(self, view: RegionView, now: float) -> bool:
+        """Follow-the-sun gate: the region's live utilization must be
+        at or below the trough threshold — with a bounded wait, so a
+        region that never quiets still upgrades (in-memory bookkeeping:
+        a controller restart restarts the wait, delaying liveness by at
+        most one more wait window, never violating safety)."""
+        if not self.policy.follow_the_sun or view.utilization is None:
+            return True
+        if view.utilization <= self.policy.trough_utilization:
+            return True
+        started = self._trough_wait_started.setdefault(
+            view.name, now)
+        return now - started >= self.policy.max_trough_wait_seconds
+
+    def _roll(self, region: str, target: str, rule: str) -> bool:
+        handle = self.regions[region]
+        try:
+            handle.roll_to(target)
+        except _TRANSIENTS as exc:
+            logger.warning("admission roll of region %s to %s "
+                           "deferred: %s", region, target, exc)
+            return False
+        self.admissions_total += 1
+        self.audit.record(
+            "fed-admit", region, decision=f"rolled to {target}",
+            rule=rule, inputs={"target": target})
+        logger.info("federation: region %s admitted to revision %s "
+                    "(%s)", region, target, rule)
+        return True
+
+    # ------------------------------------------------------------------
+    # budget shares (the lifted PR 7 ledger)
+    # ------------------------------------------------------------------
+    def _global_budget(self, views: "dict[str, RegionView]") -> int:
+        total = 0
+        for name in self.regions:
+            view = views.get(name)
+            if view is not None and view.reachable:
+                total += view.total
+            else:
+                total += self._region_totals.get(name, 0)
+        return scaled_value_from_int_or_percent(
+            self.policy.global_max_unavailable, total, round_up=True)
+
+    def _maintain_shares(self, views: "dict[str, RegionView]",
+                         canary: str, target: str,
+                         admitted: "list[str]") -> "dict[str, int]":
+        """Plan and stamp the per-region shares: active regions (DS on
+        target, not yet converged — including this pass's admissions)
+        split the global budget; everyone else is entitled to 0.
+        Decreases stamp immediately; raises only in a pass where EVERY
+        region's stamp was read fresh and the raised sum still fits
+        (the ledger's raise gate) — the write-side half of
+        decrease-immediate/increase-next-pass."""
+        fleet = sorted(self.regions)
+        global_budget = self._global_budget(views)
+        active: dict[str, int] = {}
+        for name in fleet:
+            view = views[name]
+            total = view.total if view.reachable \
+                else self._region_totals.get(name, 0)
+            if total <= 0:
+                continue
+            if name in admitted or (view.ds_found
+                                    and view.newest == target
+                                    and not view.done_on(target)):
+                active[name] = total
+            elif target in view.quarantined and (
+                    view.unavailable > 0 or view.nodes_done < view.total):
+                # a halted region mid-rollback keeps its share: the
+                # rollback arc needs budget to evacuate the bad hash
+                active[name] = total
+        desired = self.ledger.plan(active, global_budget) if active \
+            else {}
+        fresh = {name: (views[name].share or 0)
+                 for name in fleet if views[name].reachable}
+        froze = False
+        shares: dict[str, int] = {}
+        for name in fleet:
+            view = views[name]
+            current = view.share
+            want = desired.get(name, 0)
+            shares[name] = want
+            if not view.reachable:
+                continue
+            if current is None and want == 0:
+                continue  # never-granted regions need no zero stamp
+            if current == want:
+                continue
+            if want > (current or 0):
+                if not self.ledger.raise_allowed(
+                        name, want, fresh, fleet, global_budget):
+                    froze = True
+                    self.audit.record_hold(
+                        name, rule="share-raise-frozen",
+                        inputs={"want": want, "recorded": current})
+                    shares[name] = current or 0
+                    continue
+            if self._stamp_share(name, want):
+                fresh[name] = want
+            else:
+                shares[name] = current or 0
+        if froze:
+            self.raise_freeze_passes_total += 1
+        return shares
+
+    def _stamp_share(self, region: str, share: int) -> bool:
+        handle = self.regions[region]
+        try:
+            handle.client.patch_daemon_set_annotations(
+                handle.namespace, handle.ds_name,
+                {self.keys.budget_share_annotation: str(share)})
+        except _TRANSIENTS as exc:
+            logger.warning("share stamp for region %s deferred: %s",
+                           region, exc)
+            return False
+        self.share_stamps_total += 1
+        self.audit.record(
+            "fed-share", region, decision=f"share={share}",
+            rule="ledger-split", inputs={"share": share})
+        return True
+
+    # ------------------------------------------------------------------
+    # explain (obs/ public API, region granularity)
+    # ------------------------------------------------------------------
+    def explain_region(self, region: str) -> dict:
+        """Why is this region not upgrading — and what has the
+        federation decided about it? Answered from the last pass's
+        in-memory views plus the decision-audit ring (no cluster read,
+        the node-level ``explain`` contract)."""
+        out: dict = {"region": region, "blocking": []}
+        chain: "list[str]" = out["blocking"]
+        view = self._last_views.get(region)
+        target = self._last_target
+        status = self.last_status or {}
+        if region not in self.regions:
+            chain.append(f"unknown region {region!r} (known: "
+                         f"{sorted(self.regions)})")
+            return out
+        if view is None:
+            chain.append("no federation pass has read this region yet "
+                         "this incarnation")
+            return out
+        out["phase"] = (status.get("regions", {})
+                        .get(region, {}).get("phase", "unknown"))
+        canary = status.get("canaryRegion", "")
+        if not view.reachable:
+            chain.append("partitioned from the federation layer: the "
+                         "freshness probe did not read back — no "
+                         "admission and no share raise anywhere until "
+                         "the fleet reads fresh")
+        if status.get("halted"):
+            chain.append(f"revision {target!r} is quarantined "
+                         f"fleet-wide: the canary region's guard "
+                         f"condemned it; no region admits it again")
+        elif view.done_on(target):
+            chain.append("rollout complete on the target revision — "
+                         "nothing blocking")
+        elif view.newest == target:
+            if region == canary and not status.get("baked"):
+                chain.append("canary region mid-bake: the fleet waves "
+                             "open only after every node is done and "
+                             f"{self.policy.bake_seconds}s have "
+                             "elapsed past the durable bake stamp")
+            else:
+                chain.append(f"upgrading under a budget share of "
+                             f"{view.share or 0} node(s)")
+        else:
+            if region != canary and not status.get("baked"):
+                chain.append(f"held behind the canary region "
+                             f"{canary!r}: the target revision lacks "
+                             f"the fleet bake-passed stamp")
+            elif view.utilization is not None \
+                    and view.utilization > self.policy.trough_utilization:
+                chain.append(f"awaiting its traffic trough "
+                             f"(utilization {view.utilization:.2f} > "
+                             f"{self.policy.trough_utilization:g})")
+            else:
+                chain.append("awaiting a region wave slot "
+                             f"(maxConcurrentRegions="
+                             f"{self.policy.max_concurrent_regions})")
+        out["records"] = [rec.as_dict() for rec
+                          in self.audit.records_for(region, limit=6)]
+        return out
+
+    def status(self) -> dict:
+        """The last pass's status block (``{}`` before the first)."""
+        return dict(self.last_status or {})

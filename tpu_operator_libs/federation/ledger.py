@@ -1,0 +1,100 @@
+"""FederationBudgetLedger: the global→per-region disruption budget.
+
+The PR 7 shard ledger proved the pattern inside one cluster: a global
+``maxUnavailable`` split deterministically into durable per-partition
+shares, spent under decrease-immediate/increase-next-pass with a global
+clamp, so concurrent owners never jointly overdraw across takeovers.
+This module lifts the same ledger one level — the partition key is a
+REGION (a whole cluster), and each region's share lives as ONE
+annotation on that region's own runtime DaemonSet:
+
+- the region operator's effective ``maxUnavailable`` IS its stamp
+  (absent or 0 = the region admits nothing), so the global inequality
+  is enforced region-locally, even while the region is partitioned
+  from the federation layer or its controller is being replaced;
+- the federation controller stamps DECREASES immediately and stamps a
+  RAISE only in a pass where every region's stamp was freshly read
+  back and the raised sum still fits under the global budget — the
+  write-side dual of :func:`tpu_operator_libs.k8s.sharding.
+  ledger_spend_cap`, and the reason a freshly-recovered federation
+  controller (which knows nothing but what the regions' stamps say)
+  can never let two regions jointly overdraw.
+
+The arithmetic (largest-remainder proportional split) is shared with
+the shard ledger via :func:`~tpu_operator_libs.k8s.sharding.
+split_budget`, which is key-type generic for exactly this reason.
+"""
+
+from __future__ import annotations
+
+import logging
+from typing import Optional
+
+from tpu_operator_libs.consts import FederationKeys
+from tpu_operator_libs.k8s.sharding import split_budget
+
+logger = logging.getLogger(__name__)
+
+
+class FederationBudgetLedger:
+    """Encode/decode/plan the durable per-region budget shares."""
+
+    def __init__(self, keys: Optional[FederationKeys] = None) -> None:
+        self._keys = keys or FederationKeys()
+
+    @property
+    def annotation_key(self) -> str:
+        return self._keys.budget_share_annotation
+
+    def share_from(self,
+                   annotations: "dict[str, str]") -> Optional[int]:
+        """The region's recorded share, or None when never stamped (a
+        malformed stamp also reads as None — the region then admits
+        nothing, the conservative side)."""
+        raw = annotations.get(self._keys.budget_share_annotation)
+        if raw is None:
+            return None
+        try:
+            return max(0, int(raw))
+        except ValueError:
+            logger.warning("ignoring malformed budget share %r", raw)
+            return None
+
+    def plan(self, active_counts: "dict[str, int]",
+             global_budget: int) -> "dict[str, int]":
+        """Deterministic split of ``global_budget`` across the regions
+        currently spending (region name -> managed node count), each
+        share additionally capped at the region's own size (a share
+        beyond the region's node count can never be spent and would
+        only pad the global clamp). Inactive regions are entitled to
+        0 by definition — pass only the active census."""
+        shares = split_budget(global_budget, active_counts)
+        return {region: min(share, active_counts[region])
+                for region, share in shares.items()}
+
+    @staticmethod
+    def raise_allowed(region: str, proposed: int,
+                      fresh: "dict[str, int]",
+                      fleet: "list[str]",
+                      global_budget: int) -> bool:
+        """May ``region``'s stamp be RAISED to ``proposed`` this pass?
+
+        ``fresh`` maps each region whose DaemonSet was read FRESH this
+        pass (probe write landed and read back) to its recorded stamp,
+        with an absent annotation reading as 0 — truthful, because only
+        the federation controller ever writes these stamps. A raise is
+        allowed only when every fleet region was read fresh and the
+        proposed sum still fits: one partitioned region freezes raises
+        fleet-wide, because a stale read could hide a stamp a previous
+        federation incarnation already granted. Decreases never consult
+        this gate — they only tighten the inequality.
+        """
+        total = proposed
+        for other in fleet:
+            if other == region:
+                continue
+            stamp = fresh.get(other)
+            if stamp is None:
+                return False
+            total += stamp
+        return total <= global_budget

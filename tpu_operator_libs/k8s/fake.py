@@ -27,6 +27,7 @@ the engine behind ``bench.py`` and the e2e tests (BASELINE configs #2-#4).
 from __future__ import annotations
 
 import heapq
+import random
 import threading
 from dataclasses import dataclass, field
 from typing import Callable, Mapping, Optional
@@ -148,16 +149,26 @@ class FakeCluster(K8sClient):
         # informers/controllers (tpu_operator_libs.controller) can drive
         # reconciles the way controller-runtime does for the reference.
         self._broadcaster = WatchBroadcaster()
+        # Watch-delay fault state (delay_watch_events): while a window
+        # is active, events for non-exempt subscribers buffer here.
+        self._watch_delay_buffer: Optional[list] = None
+        self._watch_delay_until = 0.0
+        self._watch_delay_seed = 0
+        #: Events released from delay buffers (observability/tests).
+        self.watch_delay_released = 0
 
     def watch(self, kinds: Optional[set[str]] = None,
               namespace: Optional[str] = None,
-              max_queue: Optional[int] = None) -> Watch:
+              max_queue: Optional[int] = None,
+              delay_exempt: bool = False) -> Watch:
         """Subscribe to change events, optionally filtered to a kind set
         ({"Node", "Pod", "DaemonSet"}) and — for namespaced kinds — a
         namespace. Snapshot copies only. Signature matches
         RealCluster.watch so consumers are backend-agnostic.
         ``max_queue`` bounds the subscriber's buffer (overflow drops
-        events and delivers a BOOKMARK resync marker, k8s.watch.Watch)."""
+        events and delivers a BOOKMARK resync marker, k8s.watch.Watch);
+        ``delay_exempt`` keeps the stream live through a watch-delay
+        fault window (harness/auditor streams only)."""
         predicate = None
         if namespace:
             def predicate(event):
@@ -165,7 +176,8 @@ class FakeCluster(K8sClient):
                 ns = getattr(meta, "namespace", "")
                 return not ns or ns == namespace
         return self._broadcaster.subscribe(kinds, predicate,
-                                           max_queue=max_queue)
+                                           max_queue=max_queue,
+                                           delay_exempt=delay_exempt)
 
     def drop_watch_streams(self) -> int:
         """Fault injection: close every open watch stream, the way a real
@@ -175,7 +187,64 @@ class FakeCluster(K8sClient):
         number of streams dropped."""
         return self._broadcaster.drop_all()
 
+    def delay_watch_events(self, start: float, until: float,
+                           seed: int = 0) -> None:
+        """Fault injection: from ``start`` to ``until`` (virtual
+        seconds), watch event delivery to non-exempt subscribers is
+        BUFFERED — their informer caches go stale with no relist
+        signal (distinct from :meth:`drop_watch_streams`, which stops
+        the stream and forces a relist). At the window close the
+        backlog is released with deterministic, seed-pure reordering
+        ACROSS kinds: per-object (and per-kind) event order is
+        preserved — an apiserver never reorders one connection's
+        stream — but the separate per-kind streams an informer runs
+        genuinely race each other, so the release interleaves the
+        kind buffers in a seed-chosen order. Exempt subscribers (the
+        invariant monitor) keep receiving events live throughout."""
+        if until <= start:
+            raise ValueError("until must be after start")
+        self.schedule_at(
+            start, lambda: self._begin_watch_delay(until, seed))
+
+    def _begin_watch_delay(self, until: float, seed: int) -> None:
+        if self._watch_delay_buffer is not None:
+            # overlapping windows: extend the active one
+            self._watch_delay_until = max(self._watch_delay_until, until)
+            return
+        self._watch_delay_buffer = []
+        self._watch_delay_until = until
+        self._watch_delay_seed = seed
+        self.schedule_at(until, self._flush_watch_delay)
+
+    def _flush_watch_delay(self) -> None:
+        if self._watch_delay_buffer is None:
+            return
+        if self._clock.now() < self._watch_delay_until:
+            return  # window was extended; the later flush releases
+        buffered, self._watch_delay_buffer = \
+            self._watch_delay_buffer, None
+        by_kind: dict[str, list] = {}
+        for event_type, kind, obj in buffered:
+            by_kind.setdefault(kind, []).append((event_type, kind, obj))
+        kinds = sorted(by_kind)
+        random.Random(
+            f"watch-delay:{self._watch_delay_seed}").shuffle(kinds)
+        self.watch_delay_released += len(buffered)
+        for kind in kinds:
+            for event_type, _, obj in by_kind[kind]:
+                self._broadcaster.notify(event_type, kind, obj,
+                                         exempt_only=False)
+
     def _notify(self, event_type: str, kind: str, obj) -> None:
+        if self._watch_delay_buffer is not None \
+                and self._clock.now() < self._watch_delay_until:
+            # delay window active: exempt streams get the event live,
+            # everyone else sees it only at the flush
+            snapshot = obj.clone()
+            self._watch_delay_buffer.append((event_type, kind, snapshot))
+            self._broadcaster.notify(event_type, kind, snapshot,
+                                     exempt_only=True)
+            return
         self._broadcaster.notify(event_type, kind, obj.clone())
 
     # ------------------------------------------------------------------

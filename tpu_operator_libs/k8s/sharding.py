@@ -109,13 +109,16 @@ class ShardRing:
 
 
 def split_budget(total_budget: int,
-                 shard_counts: "dict[int, int]") -> "dict[int, int]":
-    """Deterministically split ``total_budget`` across shards
+                 shard_counts: "dict") -> "dict":
+    """Deterministically split ``total_budget`` across partition keys
     proportionally to their node counts (largest-remainder method, ties
-    broken by shard id). Every replica computes the identical split from
-    the same fleet census, and the shares sum to exactly
-    ``total_budget`` — the arithmetic half of the never-jointly-overdraw
-    guarantee (the durable ledger is the crash/skew half)."""
+    broken by key order). Every computer of the split derives the
+    identical answer from the same census, and the shares sum to
+    exactly ``total_budget`` — the arithmetic half of the
+    never-jointly-overdraw guarantee (the durable ledger is the
+    crash/skew half). Keys are shard ids for the in-cluster sharded
+    control plane and region names for the federation layer — any
+    sortable key type works."""
     shards = sorted(shard_counts)
     total_nodes = sum(shard_counts[s] for s in shards)
     if total_nodes <= 0 or total_budget <= 0:
@@ -128,6 +131,30 @@ def split_budget(total_budget: int,
     for s in by_fraction[:remainder]:
         shares[s] += 1
     return shares
+
+
+def ledger_spend_cap(owned: "frozenset | set", entitled: "dict",
+                     recorded: "dict", global_budget: int) -> int:
+    """The durable share ledger's spend rule, factored once for every
+    layer that partitions one global disruption budget (the in-cluster
+    shard ledger and the federation's per-region ledger):
+
+    - **decrease-immediate**: an owner spends ``min(entitlement,
+      recorded share)`` — a shrunk entitlement bites this pass, before
+      it is ever stamped;
+    - **increase-next-pass**: a grown entitlement only counts once it
+      is durably recorded AND read back, so until then the owner keeps
+      spending against the old stamp;
+    - **global clamp**: everyone else's recorded claim (their
+      entitlement while unrecorded) must still fit next to ours — two
+      owners acting on skewed censuses can never jointly exceed
+      ``global_budget``, even across takeovers.
+    """
+    cap = sum(min(entitled[key], recorded.get(key, entitled[key]))
+              for key in owned)
+    others = sum(recorded.get(key, entitled[key])
+                 for key in entitled if key not in owned)
+    return max(0, min(cap, global_budget - others))
 
 
 class ShardBudgetLedger:

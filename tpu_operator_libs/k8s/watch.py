@@ -158,27 +158,39 @@ class WatchBroadcaster:
         self._lock = threading.Lock()
         self._subs: list[tuple[Optional[frozenset[str]],
                                Optional[Callable[[WatchEvent], bool]],
-                               Watch]] = []
+                               Watch, bool]] = []
 
     def subscribe(self, kinds: Optional[set[str]] = None,
                   predicate: Optional[Callable[[WatchEvent], bool]] = None,
-                  max_queue: Optional[int] = None) -> Watch:
+                  max_queue: Optional[int] = None,
+                  delay_exempt: bool = False) -> Watch:
+        """``delay_exempt`` marks a subscriber that keeps receiving
+        events in real time while a watch-delay fault buffers delivery
+        to everyone else — the invariant monitor's stream (the auditor
+        must see ground truth; the system under test sees the lag)."""
         watch = Watch(on_stop=self._unsubscribe, max_queue=max_queue)
         kindset = frozenset(kinds) if kinds is not None else None
         with self._lock:
-            self._subs.append((kindset, predicate, watch))
+            self._subs.append((kindset, predicate, watch, delay_exempt))
         return watch
 
     def _unsubscribe(self, watch: Watch) -> None:
         with self._lock:
-            self._subs = [(k, p, w) for (k, p, w) in self._subs
-                          if w is not watch]
+            self._subs = [row for row in self._subs
+                          if row[2] is not watch]
 
-    def notify(self, event_type: str, kind: str, obj: object) -> None:
+    def notify(self, event_type: str, kind: str, obj: object,
+               exempt_only: Optional[bool] = None) -> None:
+        """Deliver one event. ``exempt_only`` restricts the fan-out:
+        True delivers only to delay-exempt subscribers (live delivery
+        while a delay fault buffers), False only to the non-exempt
+        ones (the buffered backlog's release), None to everyone."""
         event = WatchEvent(event_type, kind, obj)
         with self._lock:
             subs = list(self._subs)
-        for kindset, predicate, watch in subs:
+        for kindset, predicate, watch, exempt in subs:
+            if exempt_only is not None and exempt != exempt_only:
+                continue
             if kindset is not None and kind not in kindset:
                 continue
             if predicate is not None and not predicate(event):
@@ -192,7 +204,7 @@ class WatchBroadcaster:
         informer relist path a real stream drop forces. Returns the
         number of streams dropped."""
         with self._lock:
-            subs = [w for (_, _, w) in self._subs]
+            subs = [row[2] for row in self._subs]
             self._subs = []
         for watch in subs:
             watch.stop()

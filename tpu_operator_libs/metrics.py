@@ -1204,3 +1204,81 @@ def observe_journeys(registry: MetricsRegistry, obs: "object",
     registry.set_counter_total(
         "decision_records_dropped_total", audit.dropped_total,
         "Audit records evicted by the bounded ring", labels)
+
+
+def observe_federation(registry: MetricsRegistry,
+                       controller: "object",
+                       driver: str = "libtpu") -> None:
+    """Export the multi-cluster federation controller's fleet picture.
+
+    ``controller`` is a :class:`tpu_operator_libs.federation.
+    controller.FederationController`. One scrape answers the global
+    on-call questions: which regions are upgrading/partitioned/done,
+    what each region's durable budget share grants, whether the fleet
+    is halted on a quarantined revision, and how often share raises
+    froze because a region read stale. No-op before the first
+    federation pass.
+    """
+    labels = {"driver": driver}
+    status = controller.last_status
+    if status is None:
+        return
+    regions = status.get("regions", {})
+    phases: dict = {}
+    for cell in regions.values():
+        phases[cell["phase"]] = phases.get(cell["phase"], 0) + 1
+    registry.set_gauge(
+        "federation_regions_total", len(regions),
+        "Regions the federation controller drives", labels)
+    for phase in ("pending", "canary-baking", "upgrading", "done",
+                  "partitioned", "quarantined", "held"):
+        registry.set_gauge(
+            "federation_regions_in_phase", phases.get(phase, 0),
+            "Region count per federation rollout phase",
+            {**labels, "phase": phase})
+    registry.set_gauge(
+        "federation_budget_global", status.get("globalBudget", 0),
+        "Global disruption budget the per-region shares partition",
+        labels)
+    for region, share in sorted(status.get("shares", {}).items()):
+        registry.set_gauge(
+            "federation_budget_share", share,
+            "Durable per-region disruption-budget share (nodes)",
+            {**labels, "region": region})
+    registry.set_gauge(
+        "federation_halted",
+        1.0 if status.get("halted") else 0.0,
+        "1 while the target revision is quarantined fleet-wide",
+        labels)
+    registry.set_gauge(
+        "federation_bake_passed",
+        1.0 if status.get("baked") else 0.0,
+        "1 once the canary region's bake has elapsed for the target",
+        labels)
+    registry.set_counter_total(
+        "federation_admissions_total", controller.admissions_total,
+        "Region admissions (DaemonSet rolls to a target revision)",
+        labels)
+    registry.set_counter_total(
+        "federation_quarantine_stamps_total",
+        controller.quarantine_stamps_total,
+        "Fleet-wide quarantine stamps written to region DaemonSets",
+        labels)
+    registry.set_counter_total(
+        "federation_bake_stamps_total", controller.bake_stamps_total,
+        "Canary-region bake stamps written", labels)
+    registry.set_counter_total(
+        "federation_share_stamps_total", controller.share_stamps_total,
+        "Durable budget-share stamps written", labels)
+    registry.set_counter_total(
+        "federation_raise_freeze_passes_total",
+        controller.raise_freeze_passes_total,
+        "Passes in which share raises froze fleet-wide because a "
+        "region read stale", labels)
+    registry.set_counter_total(
+        "federation_partitioned_reads_total",
+        controller.partitioned_reads_total,
+        "Region probe/read attempts that hit a partition", labels)
+    registry.set_counter_total(
+        "federation_passes_total", controller.passes_total,
+        "Federation reconcile passes", labels)

@@ -74,6 +74,18 @@ class OperatorObservability:
                                    clock=self.clock)
         #: shard -> explain()-bearing peer (see class docstring).
         self.peer_resolver: Optional[Callable[[int], object]] = None
+        #: Bound (REAL seconds) on one routed peer-explain attempt —
+        #: the cross-replica hop is an HTTP call to the owning
+        #: replica's /explain in production, and a slow or dead peer
+        #: must degrade to the durable-label fallback instead of
+        #: stalling the caller's request (explain is the mid-incident
+        #: tool; an explain that hangs during the incident is worse
+        #: than none).
+        self.peer_timeout_seconds: float = 2.0
+        #: Retries after the first failed/timed-out peer attempt (one
+        #: retry absorbs a transient hiccup; anything more just delays
+        #: the fallback).
+        self.peer_retries: int = 1
 
     def dump_traces(self) -> dict:
         """OTLP-shaped JSON export of every retained journey."""

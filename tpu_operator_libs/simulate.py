@@ -163,8 +163,17 @@ class SimResult:
         return 100.0 * (1.0 - downtime / window_seconds)
 
 
-def build_fleet(spec: FleetSpec) -> tuple[FakeCluster, FakeClock, UpgradeKeys]:
-    clock = FakeClock(start=0.0)
+def build_fleet(spec: FleetSpec,
+                clock: Optional[FakeClock] = None,
+                roll: bool = True,
+                ) -> tuple[FakeCluster, FakeClock, UpgradeKeys]:
+    """Build one simulated fleet. ``clock`` lets several fleets share a
+    single virtual timeline (the multi-cluster federation sim builds
+    one FakeCluster per region on one clock); ``roll=False`` leaves the
+    DaemonSet on its initial revision so the fleet starts CONVERGED —
+    for scenarios where something else (the federation controller)
+    decides when each cluster's rollout begins."""
+    clock = clock if clock is not None else FakeClock(start=0.0)
     cluster = FakeCluster(clock=clock)
     cluster.enable_ds_controller(recreate_delay=spec.pod_recreate_delay,
                                  ready_delay=spec.pod_ready_delay)
@@ -209,8 +218,9 @@ def build_fleet(spec: FleetSpec) -> tuple[FakeCluster, FakeClock, UpgradeKeys]:
                 f"outside the fleet (n_slices={spec.n_slices})")
     _install_delay_model(cluster, spec)
     restore_workload_pods(cluster, spec)
-    # roll the DS template: every pod is now out of date
-    cluster.bump_daemon_set_revision(NS, "libtpu", "new")
+    if roll:
+        # roll the DS template: every pod is now out of date
+        cluster.bump_daemon_set_revision(NS, "libtpu", "new")
     _schedule_faults(cluster, spec)
     # apply any faults due at t=0 so "broken from the start" scenarios are
     # visible to the very first reconcile pass

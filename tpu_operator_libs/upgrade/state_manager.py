@@ -1224,6 +1224,7 @@ class ClusterUpgradeStateManager:
         """
         from tpu_operator_libs.k8s.sharding import (
             ShardBudgetLedger,
+            ledger_spend_cap,
             split_budget,
         )
 
@@ -1265,16 +1266,9 @@ class ClusterUpgradeStateManager:
         recorded = (ledger.shares_from(ledger_ds.metadata.annotations)
                     if ledger_ds is not None else {})
 
-        # spend rule: decreases immediate, increases next pass
-        cap = sum(min(entitled[shard], recorded.get(shard,
-                                                    entitled[shard]))
-                  for shard in owned)
-        # global clamp: everyone else's recorded claim (their
-        # entitlement when unrecorded) must still fit next to ours
-        others = sum(recorded.get(shard, entitled[shard])
-                     for shard in range(view.num_shards)
-                     if shard not in owned)
-        cap = max(0, min(cap, global_budget - others))
+        # spend rule (decrease-immediate / increase-next-pass) + global
+        # clamp, shared with the federation ledger (sharding.py)
+        cap = ledger_spend_cap(owned, entitled, recorded, global_budget)
 
         # record our owned shards' entitlements when they changed (ONE
         # merge patch, disjoint keys per shard — crash-atomic, and
@@ -1320,6 +1314,8 @@ class ClusterUpgradeStateManager:
         }
         if self._obs is not None:
             entitled_own = sum(entitled[s] for s in owned)
+            others = sum(recorded.get(s, entitled[s])
+                         for s in entitled if s not in owned)
             self._obs.audit.record(
                 "shard-split", "", decision=f"cap={cap}",
                 rule=("global-clamp" if cap < entitled_own
@@ -2876,24 +2872,82 @@ class ClusterUpgradeStateManager:
                 out["ownedByShard"] = shard
                 out["local"] = False
                 resolver = getattr(obs, "peer_resolver", None)
+                peer = None
+                route_failed = False
                 if resolver is not None:
                     try:
                         peer = resolver(shard)
                     except Exception:  # noqa: BLE001 — routing must
                         peer = None  # not break the local answer
-                    if peer is not None:
-                        routed = peer.explain(node_name)
+                if peer is not None:
+                    routed = self._routed_explain(peer, node_name)
+                    if routed is not None:
                         routed["routedVia"] = shard
                         return routed
+                    route_failed = True
                 out.update(self._explain_local(node_name))
-                out["blocking"].insert(
-                    0, f"owned by shard {shard} (not this replica): "
-                    f"answer derived from durable node state; query "
-                    f"the owning replica's /explain for its audit "
-                    f"ring")
+                if route_failed:
+                    out["blocking"].insert(
+                        0, f"owning replica (shard {shard}) did not "
+                        f"answer within the peer timeout: answer "
+                        f"derived from durable node state instead of "
+                        f"stalling the request")
+                else:
+                    out["blocking"].insert(
+                        0, f"owned by shard {shard} (not this "
+                        f"replica): answer derived from durable node "
+                        f"state; query the owning replica's /explain "
+                        f"for its audit ring")
                 return out
         out.update(self._explain_local(node_name))
         return out
+
+    def _routed_explain(self, peer: "object",
+                        node_name: str) -> "Optional[dict]":
+        """One bounded cross-replica explain hop: the peer is an HTTP
+        call away in production, and a slow or dead owning replica
+        must degrade this request to the durable-label fallback, not
+        stall it — explain is the mid-incident tool, and the incident
+        may be exactly what made the peer slow. Each attempt runs on a
+        daemon worker bounded by ``obs.peer_timeout_seconds`` REAL
+        seconds (an RPC bound, never the virtual clock), with
+        ``obs.peer_retries`` retries; a hung attempt's thread is
+        abandoned to finish in the background. Returns None when every
+        attempt failed or timed out (caller falls back)."""
+        import threading
+
+        obs = self._obs
+        timeout = max(0.05, float(getattr(obs, "peer_timeout_seconds",
+                                          2.0)))
+        retries = max(0, int(getattr(obs, "peer_retries", 1)))
+        for attempt in range(1 + retries):
+            box: dict = {}
+            done = threading.Event()
+
+            def hop(box: dict = box, done: "threading.Event" = done,
+                    ) -> None:
+                try:
+                    box["value"] = peer.explain(node_name)
+                except Exception as exc:  # noqa: BLE001 — peer fault
+                    box["error"] = exc  # = fallback, never a raise
+                finally:
+                    done.set()
+
+            worker = threading.Thread(
+                target=hop, daemon=True,
+                name=f"explain-peer-hop-{node_name}-{attempt}")
+            worker.start()
+            if done.wait(timeout) and "value" in box \
+                    and isinstance(box["value"], dict):
+                return box["value"]
+            logger.warning(
+                "peer explain for %s attempt %d/%d %s; %s",
+                node_name, attempt + 1, 1 + retries,
+                "failed" if done.is_set() else
+                f"timed out after {timeout:g}s",
+                "retrying" if attempt < retries
+                else "falling back to durable node state")
+        return None
 
     def _explain_local(self, node_name: str) -> dict:
         from tpu_operator_libs.upgrade.predictor import (
