@@ -4,7 +4,7 @@ PYTHON ?= python3
 PYTEST_FLAGS ?= -q
 COV_THRESHOLD ?= 85
 
-.PHONY: all check test test-fast test-fault test-chaos test-soak test-scale test-rollout test-latency test-reconfig test-shard test-planner test-budget test-obs test-federation lint cov bench bench-reconcile bench-latency bench-shard bench-shard-100k bench-planner bench-budget bench-obs bench-federation graft-check package clean diagram
+.PHONY: all check test test-fast test-fault test-chaos test-soak test-scale test-rollout test-latency test-reconfig test-shard test-planner test-budget test-handover test-obs test-federation lint cov bench bench-reconcile bench-latency bench-shard bench-shard-100k bench-planner bench-budget bench-obs bench-federation graft-check package clean diagram
 
 all: lint test
 
@@ -61,6 +61,7 @@ lint:
 	$(PYTHON) -m compileall -q tpu_operator_libs tools tests examples bench.py __graft_entry__.py
 	$(PYTHON) tools/lint.py
 	$(PYTHON) tools/metrics_lint.py
+	$(PYTHON) tools/marker_lint.py
 	@if $(PYTHON) -c "import ruff" 2>/dev/null; then \
 		$(PYTHON) -m ruff check tpu_operator_libs tools tests examples; \
 	elif $(PYTHON) -c "import pyflakes" 2>/dev/null; then \
@@ -171,6 +172,17 @@ bench-planner:
 # `pytest -m budget`).
 test-budget:
 	$(PYTHON) -m pytest tests/ $(PYTEST_FLAGS) -m "budget and not slow"
+
+# Zero-drop handover slice (`handover` marker): traffic-class spec /
+# ServingEndpoint validation units, DisruptionCostRanker ordering +
+# sole-replica holds, the PrewarmCoordinator reserve->ready->release
+# arc (incl. crash-mid-prewarm resume), router-side session handover,
+# and the 256-node class-aware diurnal-replay chaos gate at 2x the
+# budget gate's traffic — zero operator-dropped generations per
+# session id, zero interactive SLO breaches, zero prewarm residue.
+# Seeds 1-3 tier-1, 4-10 slow (CHAOS_SEEDS-style widening via slow).
+test-handover:
+	$(PYTHON) -m pytest tests/ $(PYTEST_FLAGS) -m "handover and not slow"
 
 # Multi-cluster federation slice (`federation` marker): ledger/
 # controller/policy units, explain_region, the bench smoke, and the

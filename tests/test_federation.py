@@ -245,6 +245,75 @@ class TestFederationController:
         assert "unknown region" in out["blocking"][0]
 
 
+class TestRegionCapacityStatus:
+    """The PR 10 capacity-controller status block as the per-region
+    signal (the federation remainder's first slice): preferred over
+    the scalar utilization trace, surfaced in region status, and a
+    region hard-pausing at peak is never 'in trough'."""
+
+    @staticmethod
+    def _status(utilization, paused=False):
+        return {"utilization": utilization, "demand": 100.0,
+                "headroom": 28, "capacityAvailable": 128,
+                "effectiveBudget": 3, "staticBudget": 4,
+                "paused": paused}
+
+    def test_status_block_preferred_over_scalar_trace(self):
+        sim = FederationFleetSim(_small_config())
+        region = sim.canary
+        # the scalar trace screams peak; the REAL controller block —
+        # the number the region's own admissions ran on — says trough
+        sim.fed.regions[region].utilization = lambda now: 0.95
+        sim.fed.regions[region].capacity_status = \
+            lambda: self._status(0.31)
+        status = sim.fed.reconcile(FED_FINAL_REVISION)
+        cell = status["regions"][region]
+        assert cell["utilization"] == pytest.approx(0.31)
+        assert cell["capacity"]["effectiveBudget"] == 3
+        assert cell["capacity"]["paused"] is False
+
+    def test_none_status_falls_back_to_scalar(self):
+        sim = FederationFleetSim(_small_config())
+        region = sim.canary
+        sim.fed.regions[region].utilization = lambda now: 0.6
+        sim.fed.regions[region].capacity_status = lambda: None
+        status = sim.fed.reconcile(FED_FINAL_REVISION)
+        cell = status["regions"][region]
+        assert cell["utilization"] == pytest.approx(0.6)
+        assert cell["capacity"] is None
+
+    def test_broken_status_source_does_not_wedge_the_pass(self):
+        sim = FederationFleetSim(_small_config())
+        region = sim.canary
+
+        def broken():
+            raise RuntimeError("controller unreachable")
+
+        sim.fed.regions[region].utilization = lambda now: 0.4
+        sim.fed.regions[region].capacity_status = broken
+        status = sim.fed.reconcile(FED_FINAL_REVISION)
+        assert status["regions"][region]["utilization"] \
+            == pytest.approx(0.4)
+
+    def test_paused_region_is_never_in_trough(self):
+        from tpu_operator_libs.federation.controller import RegionView
+
+        sim = FederationFleetSim(_small_config())
+        fed = sim.fed
+        fed.policy.follow_the_sun = True
+        fed.policy.trough_utilization = 0.5
+        fed.policy.max_trough_wait_seconds = 10_000
+        quiet = RegionView(name="r", utilization=0.2)
+        assert fed._in_trough(quiet, now=0.0)
+        # same low utilization number, but the region's own controller
+        # is hard-pausing at peak: the richer signal vetoes
+        paused = RegionView(name="r2", utilization=0.2,
+                            capacity=self._status(0.2, paused=True))
+        assert not fed._in_trough(paused, now=0.0)
+        # liveness: the bounded wait still admits it eventually
+        assert fed._in_trough(paused, now=20_000.0)
+
+
 # ---------------------------------------------------------------------------
 # the schedules
 # ---------------------------------------------------------------------------

@@ -1,0 +1,1012 @@
+"""Traffic-class-aware drain ordering + prewarmed session handover.
+
+Five layers, mirroring docs/traffic-aware-budgets.md:
+
+- Spec/validation units: TrafficClassSpec field validation,
+  CapacityBudgetSpec round-trips + the hardened [0,1) headroom bound,
+  ServingEndpoint construction-time rejection of bad capacity/class.
+- DisruptionCostRanker units: fail-open, tier ordering (cheapest
+  serving disruption first), sole-replica interactive holds, the
+  optimistic replication-floor decrement (a replicated pair never
+  co-drains), budget spent on cheap tiers first.
+- PrewarmCoordinator units: durable reserve -> ready -> release stamps,
+  crash-mid-prewarm resume from annotations alone, dead-spare
+  re-reservation.
+- Router-side session handover in ServingFleetSim: seed-pure session
+  ids, exact drop attribution, deadline-driven handover without drops.
+- The end-to-end arc + the class-aware diurnal-replay chaos gate
+  (chaos/runner.run_handover_soak): 256 nodes at 2x the budget gate's
+  traffic, operator crashes + node kills — zero operator-attributed
+  dropped generations, zero interactive SLO breaches, zero prewarm
+  residue. Seeds 1-3 tier-1, 4-10 slow.
+"""
+
+from __future__ import annotations
+
+import pytest
+
+from tpu_operator_libs.api.upgrade_policy import (
+    CapacityBudgetSpec,
+    DrainSpec,
+    PolicyValidationError,
+    TrafficClassSpec,
+    UpgradePolicySpec,
+)
+from tpu_operator_libs.chaos.serving import (
+    DiurnalTrace,
+    ServingFleetSim,
+    assign_traffic,
+)
+from tpu_operator_libs.consts import UpgradeState
+from tpu_operator_libs.health.serving_gate import (
+    ServingDrainGate,
+    ServingEndpoint,
+)
+from tpu_operator_libs.k8s.objects import Node, ObjectMeta
+from tpu_operator_libs.simulate import (
+    NS,
+    RUNTIME_LABELS,
+    FleetSpec,
+    build_fleet,
+)
+from tpu_operator_libs.upgrade.handover import (
+    HOLD_AWAITING_PREWARM,
+    HOLD_SOLE_REPLICA,
+    DisruptionCostRanker,
+    PrewarmCoordinator,
+)
+from tpu_operator_libs.upgrade.state_manager import (
+    ClusterUpgradeState,
+    ClusterUpgradeStateManager,
+    NodeUpgradeState,
+)
+
+pytestmark = pytest.mark.handover
+
+
+# ---------------------------------------------------------------------------
+# spec / construction validation (input-hardening satellite)
+# ---------------------------------------------------------------------------
+class TestTrafficClassSpec:
+    def test_round_trip(self):
+        spec = CapacityBudgetSpec(
+            enable=True, prewarm=True,
+            traffic_classes=[
+                TrafficClassSpec(name="interactive", interactive=True,
+                                 drain_deadline_seconds=60.0),
+                TrafficClassSpec(name="batch",
+                                 max_shortfall_fraction=0.3)])
+        spec.validate()
+        data = spec.to_dict()
+        again = CapacityBudgetSpec.from_dict(data)
+        assert again.to_dict() == data
+        assert set(again.class_map()) == {"interactive", "batch"}
+
+    @pytest.mark.parametrize("kwargs", [
+        dict(name="Bad_Name"),
+        dict(name=""),
+        dict(name="-leading"),
+        dict(min_replicas=0),
+        dict(min_replicas=True),
+        dict(drain_deadline_seconds=0),
+        dict(max_shortfall_fraction=1.0),
+        dict(max_shortfall_fraction=-0.1),
+        dict(interactive=True, max_shortfall_fraction=0.2),
+    ])
+    def test_field_rejected(self, kwargs):
+        with pytest.raises(PolicyValidationError):
+            TrafficClassSpec(**kwargs).validate()
+
+    def test_duplicate_class_names_rejected(self):
+        spec = CapacityBudgetSpec(
+            enable=True,
+            traffic_classes=[TrafficClassSpec(name="a"),
+                             TrafficClassSpec(name="a")])
+        with pytest.raises(PolicyValidationError):
+            spec.validate()
+
+    @pytest.mark.parametrize("fraction", [1.0, 1.5, -0.1])
+    def test_headroom_fraction_hardened(self, fraction):
+        with pytest.raises(PolicyValidationError):
+            CapacityBudgetSpec(
+                enable=True,
+                slo_headroom_fraction=fraction).validate()
+
+    def test_crd_schema_covers_traffic_classes(self):
+        from tpu_operator_libs.api.crd import capacity_budget_schema
+
+        schema = capacity_budget_schema()
+        assert "trafficClasses" in schema["properties"]
+        assert "prewarm" in schema["properties"]
+        item = schema["properties"]["trafficClasses"]["items"]
+        assert set(item["properties"]) == {
+            "name", "interactive", "minReplicas",
+            "drainDeadlineSeconds", "maxShortfallFraction"}
+
+
+class TestServingEndpointValidation:
+    @pytest.mark.parametrize("kwargs", [
+        dict(capacity=0),
+        dict(capacity=-3),
+        dict(capacity=True),
+        dict(capacity=2.5),
+        dict(traffic_class="Bad Class"),
+        dict(traffic_class=""),
+    ])
+    def test_rejected_at_construction(self, kwargs):
+        with pytest.raises(ValueError):
+            ServingEndpoint("ep", **kwargs)
+
+    def test_empty_name_rejected(self):
+        with pytest.raises(ValueError):
+            ServingEndpoint("")
+
+    def test_handover_accounting(self):
+        ep = ServingEndpoint("ep", capacity=4,
+                             traffic_class="interactive", model="m")
+        assert ep.try_begin()
+        assert ep.handover()
+        assert ep.in_flight == 0
+        assert ep.handed_over == 1
+        assert ep.dropped == 0 and ep.completed == 0
+        assert not ep.handover(), "nothing left to move"
+
+
+# ---------------------------------------------------------------------------
+# ranker units
+# ---------------------------------------------------------------------------
+def _ns(name: str, unschedulable: bool = False) -> NodeUpgradeState:
+    node = Node(metadata=ObjectMeta(name=name))
+    if unschedulable:
+        node.spec.unschedulable = True
+    return NodeUpgradeState(node=node, runtime_pod=None,
+                            runtime_daemon_set=None)
+
+
+def _endpoint(node: str, cls: str, model: str,
+              in_flight: int = 0,
+              draining: bool = False) -> ServingEndpoint:
+    ep = ServingEndpoint(f"decode-{node}", capacity=8,
+                         traffic_class=cls, model=model)
+    for _ in range(in_flight):
+        assert ep.try_begin()
+    if draining:
+        ep.begin_drain()
+    return ep
+
+
+class RecordingPlanner:
+    """Inner stub: records every (candidates, available) call and
+    admits first-come up to the budget (FlatPlanner semantics minus
+    the free-node override, which these units do not exercise)."""
+
+    def __init__(self):
+        self.calls = []
+
+    def plan(self, candidates, available, state):
+        self.calls.append(
+            ([ns.node.metadata.name for ns in candidates], available))
+        return list(candidates[:max(0, available)])
+
+
+CLASSES = {
+    "interactive": TrafficClassSpec(name="interactive",
+                                    interactive=True),
+    "batch": TrafficClassSpec(name="batch"),
+}
+
+
+def _state(candidates, in_progress=()):
+    buckets = {str(UpgradeState.UPGRADE_REQUIRED): list(candidates)}
+    buckets[str(UpgradeState.CORDON_REQUIRED)] = [
+        _ns(name) for name in in_progress]
+    return ClusterUpgradeState(node_states=buckets)
+
+
+class TestDisruptionCostRanker:
+    def test_fails_open_without_endpoints(self):
+        inner = RecordingPlanner()
+        ranker = DisruptionCostRanker(inner, source=dict,
+                                      classes=CLASSES)
+        candidates = [_ns("a"), _ns("b")]
+        selected = ranker.plan(candidates, 2, _state(candidates))
+        assert [ns.node.metadata.name for ns in selected] == ["a", "b"]
+        assert inner.calls == [(["a", "b"], 2)]
+        assert ranker.last_holds == {}
+
+    def test_broken_source_fails_open(self):
+        def broken():
+            raise RuntimeError("registry down")
+
+        inner = RecordingPlanner()
+        ranker = DisruptionCostRanker(inner, source=broken,
+                                      classes=CLASSES)
+        candidates = [_ns("a")]
+        assert ranker.plan(candidates, 1, _state(candidates))
+        assert inner.calls == [(["a"], 1)]
+
+    def test_cheapest_tier_first(self):
+        # idle < batch-only < interactive (replicated) — the inner
+        # planner is invoked tier by tier with the remaining budget
+        mapping = {
+            "batch1": [_endpoint("batch1", "batch", "bm")],
+            "batch2": [_endpoint("batch2", "batch", "bm")],
+            "inter1": [_endpoint("inter1", "interactive", "im")],
+            "other": [_endpoint("other", "interactive", "im")],
+        }
+        inner = RecordingPlanner()
+        ranker = DisruptionCostRanker(inner, source=lambda: mapping,
+                                      classes=CLASSES)
+        candidates = [_ns("inter1"), _ns("batch1"), _ns("idle1")]
+        selected = ranker.plan(candidates, 10, _state(candidates))
+        assert [call[0] for call in inner.calls] \
+            == [["idle1"], ["batch1"], ["inter1"]]
+        assert {ns.node.metadata.name for ns in selected} \
+            == {"idle1", "batch1", "inter1"}
+
+    def test_budget_spent_on_cheap_tier_first(self):
+        mapping = {
+            "batch1": [_endpoint("batch1", "batch", "b1")],
+            "batch2": [_endpoint("batch2", "batch", "b1")],
+            "inter1": [_endpoint("inter1", "interactive", "i1")],
+            "inter2": [_endpoint("inter2", "interactive", "i1")],
+        }
+        inner = RecordingPlanner()
+        ranker = DisruptionCostRanker(inner, source=lambda: mapping,
+                                      classes=CLASSES)
+        candidates = [_ns("inter1"), _ns("batch1")]
+        selected = ranker.plan(candidates, 1, _state(candidates))
+        assert [ns.node.metadata.name for ns in selected] == ["batch1"]
+
+    def test_lower_load_drains_first_within_tier(self):
+        mapping = {
+            "hot": [_endpoint("hot", "batch", "b1", in_flight=6)],
+            "cool": [_endpoint("cool", "batch", "b2", in_flight=1)],
+            "spare-b1": [_endpoint("s1", "batch", "b1")],
+            "spare-b2": [_endpoint("s2", "batch", "b2")],
+        }
+        inner = RecordingPlanner()
+        ranker = DisruptionCostRanker(inner, source=lambda: mapping,
+                                      classes=CLASSES)
+        candidates = [_ns("hot"), _ns("cool")]
+        ranker.plan(candidates, 2, _state(candidates))
+        assert inner.calls[0][0] == ["cool", "hot"]
+
+    def test_sole_replica_interactive_held(self):
+        mapping = {
+            "solo": [_endpoint("solo", "interactive", "im")],
+            "b": [_endpoint("b", "batch", "bm")],
+            "b2": [_endpoint("b2", "batch", "bm")],
+        }
+        inner = RecordingPlanner()
+        ranker = DisruptionCostRanker(inner, source=lambda: mapping,
+                                      classes=CLASSES)
+        candidates = [_ns("solo"), _ns("b")]
+        selected = ranker.plan(candidates, 5, _state(candidates))
+        assert [ns.node.metadata.name for ns in selected] == ["b"]
+        rule, inputs = ranker.last_holds["solo"]
+        assert rule == HOLD_SOLE_REPLICA
+        assert inputs["model"] == "im"
+        assert inputs["prewarm"] == "none"
+
+    def test_replicated_pair_never_co_drains(self):
+        mapping = {
+            "a": [_endpoint("a", "interactive", "im")],
+            "b": [_endpoint("b", "interactive", "im")],
+        }
+        inner = RecordingPlanner()
+        ranker = DisruptionCostRanker(inner, source=lambda: mapping,
+                                      classes=CLASSES)
+        candidates = [_ns("a"), _ns("b")]
+        selected = ranker.plan(candidates, 5, _state(candidates))
+        assert [ns.node.metadata.name for ns in selected] == ["a"]
+        assert set(ranker.last_holds) == {"b"}
+
+    def test_committed_down_partner_holds_survivor(self):
+        # the pair's first member sits in cordon-required (still
+        # admitting — the gate has not flipped it yet); the second
+        # must NOT count it as a replica
+        mapping = {
+            "a": [_endpoint("a", "interactive", "im")],
+            "b": [_endpoint("b", "interactive", "im")],
+        }
+        inner = RecordingPlanner()
+        ranker = DisruptionCostRanker(inner, source=lambda: mapping,
+                                      classes=CLASSES)
+        candidates = [_ns("b")]
+        selected = ranker.plan(candidates, 5,
+                               _state(candidates, in_progress=("a",)))
+        assert selected == []
+        assert set(ranker.last_holds) == {"b"}
+
+    def test_unlisted_class_ranks_as_relaxed(self):
+        mapping = {"x": [_endpoint("x", "mystery", "mm")]}
+        inner = RecordingPlanner()
+        ranker = DisruptionCostRanker(inner, source=lambda: mapping,
+                                      classes=CLASSES)
+        candidates = [_ns("x")]
+        # sole replica of a NON-interactive (unknown) class: drainable
+        # (relaxed SLO), just ranked into the most expensive tier
+        selected = ranker.plan(candidates, 5, _state(candidates))
+        assert [ns.node.metadata.name for ns in selected] == ["x"]
+        assert ranker.last_holds == {}
+
+
+# ---------------------------------------------------------------------------
+# prewarm coordinator units
+# ---------------------------------------------------------------------------
+def _serving_fleet(provider_fuse=None, n_slices=2, hosts_per_slice=2):
+    fleet = FleetSpec(n_slices=n_slices,
+                      hosts_per_slice=hosts_per_slice,
+                      pod_recreate_delay=2.0, pod_ready_delay=5.0)
+    cluster, clock, keys = build_fleet(fleet)
+    kwargs = {}
+    if provider_fuse is not None:
+        from tpu_operator_libs.chaos.injector import (
+            CrashingStateProvider,
+        )
+
+        kwargs["provider"] = CrashingStateProvider(
+            cluster, keys, None, clock, sync_timeout=5.0,
+            poll_interval=0.0, fuse=provider_fuse)
+    mgr = ClusterUpgradeStateManager(
+        cluster, keys, clock=clock, async_workers=False,
+        poll_interval=0.0, **kwargs)
+    return cluster, clock, keys, mgr
+
+
+def _mark_done(cluster, keys, names):
+    for name in names:
+        cluster.patch_node_labels(
+            name, {keys.state_label: str(UpgradeState.DONE)})
+
+
+class TestPrewarmCoordinator:
+    def _coordinator(self, mgr, keys, readiness=None, release=None):
+        return PrewarmCoordinator(mgr.provider, keys,
+                                  clock=mgr.clock,
+                                  readiness=readiness,
+                                  release=release)
+
+    def test_reserve_then_ready_then_release(self):
+        cluster, clock, keys, mgr = _serving_fleet()
+        names = sorted(n.metadata.name for n in cluster.list_nodes())
+        incumbent, spare = names[0], names[1]
+        _mark_done(cluster, keys, [spare])
+        ready = {"value": False}
+        released = []
+        coordinator = self._coordinator(
+            mgr, keys,
+            readiness=lambda s, i, m, c: ready["value"],
+            release=lambda s, i: released.append((s, i)))
+        state = mgr.build_state(NS, RUNTIME_LABELS)
+        assert coordinator.ensure(incumbent, "im", "interactive",
+                                  state) == "reserved"
+        node = cluster.get_node(spare)
+        assert node.metadata.annotations[
+            keys.prewarm_reservation_annotation] \
+            == f"{incumbent}:im:interactive"
+        assert keys.prewarm_ready_annotation \
+            not in node.metadata.annotations
+        # not ready yet -> warming; ready -> durable JOIN stamp
+        state = mgr.build_state(NS, RUNTIME_LABELS)
+        assert coordinator.ensure(incumbent, "im", "interactive",
+                                  state) == "warming"
+        ready["value"] = True
+        state = mgr.build_state(NS, RUNTIME_LABELS)
+        assert coordinator.ensure(incumbent, "im", "interactive",
+                                  state) == "ready"
+        node = cluster.get_node(spare)
+        assert node.metadata.annotations[
+            keys.prewarm_ready_annotation].startswith(f"{incumbent}:")
+        # incumbent finishes: sweep releases BOTH stamps on one patch
+        _mark_done(cluster, keys, [incumbent])
+        state = mgr.build_state(NS, RUNTIME_LABELS)
+        coordinator.sweep(state)
+        node = cluster.get_node(spare)
+        assert keys.prewarm_reservation_annotation \
+            not in node.metadata.annotations
+        assert keys.prewarm_ready_annotation \
+            not in node.metadata.annotations
+        assert released == [(spare, incumbent)]
+
+    def test_no_done_spare_is_unavailable(self):
+        cluster, clock, keys, mgr = _serving_fleet()
+        names = sorted(n.metadata.name for n in cluster.list_nodes())
+        coordinator = self._coordinator(mgr, keys)
+        state = mgr.build_state(NS, RUNTIME_LABELS)
+        assert coordinator.ensure(names[0], "im", "interactive",
+                                  state) == "unavailable"
+
+    def test_dead_spare_is_released_and_replaced(self):
+        cluster, clock, keys, mgr = _serving_fleet()
+        names = sorted(n.metadata.name for n in cluster.list_nodes())
+        incumbent, spare, spare2 = names[0], names[1], names[2]
+        _mark_done(cluster, keys, [spare, spare2])
+        coordinator = self._coordinator(
+            mgr, keys, readiness=lambda s, i, m, c: False)
+        state = mgr.build_state(NS, RUNTIME_LABELS)
+        assert coordinator.ensure(incumbent, "im", "interactive",
+                                  state) == "reserved"
+        assert keys.prewarm_reservation_annotation \
+            in cluster.get_node(spare).metadata.annotations
+        # the spare dies: the reservation moves to the next DONE node
+        cluster.set_node_ready(spare, False)
+        state = mgr.build_state(NS, RUNTIME_LABELS)
+        assert coordinator.ensure(incumbent, "im", "interactive",
+                                  state) == "reserved"
+        assert keys.prewarm_reservation_annotation \
+            not in cluster.get_node(spare).metadata.annotations
+        assert cluster.get_node(spare2).metadata.annotations[
+            keys.prewarm_reservation_annotation] \
+            .startswith(f"{incumbent}:")
+
+    def test_crash_mid_prewarm_resumes_from_annotations(self):
+        """Crash between the reserve stamp and the ready stamp: a
+        FRESH coordinator (fresh incarnation, zero in-memory state)
+        must resume the SAME reservation from cluster state alone —
+        no duplicate spare, no residue."""
+        from tpu_operator_libs.chaos.injector import (
+            CrashFuse,
+            OperatorCrash,
+        )
+
+        fuse = CrashFuse()
+        cluster, clock, keys, mgr = _serving_fleet(provider_fuse=fuse)
+        names = sorted(n.metadata.name for n in cluster.list_nodes())
+        incumbent, spare = names[0], names[1]
+        _mark_done(cluster, keys, [spare])
+        coordinator = self._coordinator(
+            mgr, keys, readiness=lambda s, i, m, c: True)
+        # write 1 = the reserve stamp (lands); write 2 = the ready
+        # stamp (the process dies before it lands)
+        fuse.arm(1, after=False)
+        state = mgr.build_state(NS, RUNTIME_LABELS)
+        with pytest.raises(OperatorCrash):
+            coordinator.ensure(incumbent, "im", "interactive", state)
+        node = cluster.get_node(spare)
+        assert node.metadata.annotations[
+            keys.prewarm_reservation_annotation] \
+            .startswith(f"{incumbent}:")
+        assert keys.prewarm_ready_annotation \
+            not in node.metadata.annotations
+        fuse.reset()
+        fresh = self._coordinator(
+            mgr, keys, readiness=lambda s, i, m, c: True)
+        state = mgr.build_state(NS, RUNTIME_LABELS)
+        assert fresh.ensure(incumbent, "im", "interactive",
+                            state) == "ready"
+        assert fresh.reservations(state)[incumbent].spare == spare
+        # and the release sweep leaves zero residue
+        _mark_done(cluster, keys, [incumbent])
+        state = mgr.build_state(NS, RUNTIME_LABELS)
+        fresh.sweep(state)
+        node = cluster.get_node(spare)
+        assert keys.prewarm_reservation_annotation \
+            not in node.metadata.annotations
+        assert keys.prewarm_ready_annotation \
+            not in node.metadata.annotations
+
+
+# ---------------------------------------------------------------------------
+# sim: sessions, attribution, handover
+# ---------------------------------------------------------------------------
+def _class_sim(cluster, node_names, seed=1, classes=None,
+               assignments=None, **kwargs):
+    classes = classes or {
+        "interactive": TrafficClassSpec(
+            name="interactive", interactive=True,
+            drain_deadline_seconds=30.0),
+        "batch": TrafficClassSpec(
+            name="batch", drain_deadline_seconds=20.0,
+            max_shortfall_fraction=0.3),
+    }
+    trace = DiurnalTrace(seed=seed, trough_util=0.3, peak_util=0.3,
+                         noise=0.0)
+    return ServingFleetSim(cluster, node_names, trace,
+                           per_node_capacity=4, seed=seed,
+                           classes=classes, assignments=assignments,
+                           **kwargs)
+
+
+class TestSessionAccounting:
+    def test_session_ids_are_seed_pure(self):
+        fleet = FleetSpec(n_slices=2, hosts_per_slice=2)
+        runs = []
+        for _ in range(2):
+            cluster, clock, keys = build_fleet(fleet)
+            names = [n.metadata.name for n in cluster.list_nodes()]
+            sim = _class_sim(cluster, names, seed=7)
+            for t in range(0, 60, 10):
+                sim.tick(float(t))
+            victim = names[0]
+            cluster.set_node_ready(victim, False)
+            sim.tick(70.0)
+            runs.append([dict(r) for r in sim.drop_records])
+        assert runs[0] == runs[1]
+        assert runs[0], "the kill should have dropped sessions"
+        assert all(r["session"].startswith("s7-") for r in runs[0])
+        assert all(r["cause"] == "fault" for r in runs[0])
+
+    def test_fault_drop_attribution_is_exact(self):
+        fleet = FleetSpec(n_slices=2, hosts_per_slice=2)
+        cluster, clock, keys = build_fleet(fleet)
+        names = sorted(n.metadata.name for n in cluster.list_nodes())
+        sim = _class_sim(cluster, names)
+        sim.tick(0.0)
+        victim = names[0]
+        in_flight = sim.endpoints[victim].in_flight
+        assert in_flight > 0
+        cluster.set_node_ready(victim, False)
+        sim.tick(1.0)
+        mine = [r for r in sim.drop_records
+                if r["session"].startswith("s1-")]
+        assert len(mine) == in_flight
+        assert sim.fault_dropped == in_flight
+        assert sim.operator_dropped == 0
+        assert sim.operator_drop_records() == []
+
+    def test_deadline_handover_moves_sessions_without_drops(self):
+        fleet = FleetSpec(n_slices=2, hosts_per_slice=2)
+        cluster, clock, keys = build_fleet(fleet)
+        names = sorted(n.metadata.name for n in cluster.list_nodes())
+        # two batch endpoints of the SAME model: sessions can migrate
+        assignments = {names[0]: ("bm", "batch"),
+                       names[1]: ("bm", "batch"),
+                       names[2]: ("other", "batch"),
+                       names[3]: ("other", "batch")}
+        sim = _class_sim(cluster, names, assignments=assignments)
+        sim.tick(0.0)
+        donor = sim.endpoints[names[0]]
+        moved = donor.in_flight
+        assert moved > 0
+        donor.begin_drain()
+        sim.tick(1.0)   # drain anchor recorded
+        sim.tick(25.0)  # past the 20s batch deadline
+        assert donor.in_flight == 0, "sessions should have migrated"
+        assert donor.handed_over >= 1
+        # conservation: every generation either completed in place
+        # before the deadline or was handed over — none dropped
+        assert donor.completed + donor.handed_over == moved
+        assert sim.handovers == donor.handed_over
+        assert sim.operator_dropped == 0 and sim.fault_dropped == 0
+
+    def test_handover_waits_when_no_peer_serves_the_model(self):
+        fleet = FleetSpec(n_slices=2, hosts_per_slice=2)
+        cluster, clock, keys = build_fleet(fleet)
+        names = sorted(n.metadata.name for n in cluster.list_nodes())
+        assignments = {names[0]: ("solo", "batch"),
+                       names[1]: ("other", "batch"),
+                       names[2]: ("other", "batch"),
+                       names[3]: ("other", "batch")}
+        sim = _class_sim(cluster, names, assignments=assignments)
+        sim.tick(0.0)
+        donor = sim.endpoints[names[0]]
+        stuck = donor.in_flight
+        assert stuck > 0
+        donor.begin_drain()
+        sim.tick(1.0)
+        sim.tick(25.0)
+        # no peer replica of "solo": the sessions stay and finish in
+        # place — NEVER dropped or migrated to meet the deadline
+        assert donor.handed_over == 0
+        assert donor.in_flight + donor.completed == stuck
+        assert sim.operator_dropped == 0
+
+
+class TestAssignTraffic:
+    def test_layout_shape(self):
+        nodes = [f"n{i:02d}" for i in range(16)]
+        out = assign_traffic(nodes, interactive_fraction=0.25,
+                             sole_models=2, interactive_replicas=2,
+                             batch_replicas=4)
+        classes = {}
+        models = {}
+        for node, (model, cls) in out.items():
+            classes.setdefault(cls, []).append(node)
+            models.setdefault(model, []).append(node)
+        assert len(classes["interactive"]) == 4
+        assert len(classes["batch"]) == 12
+        soles = [m for m, hosts in models.items() if len(hosts) == 1]
+        assert set(soles) == {"int-solo-0", "int-solo-1"}
+
+    def test_deterministic(self):
+        nodes = [f"n{i}" for i in range(12)]
+        assert assign_traffic(nodes) == assign_traffic(list(
+            reversed(nodes)))
+
+
+# ---------------------------------------------------------------------------
+# GateKeeper.release_node idempotency (regression satellite)
+# ---------------------------------------------------------------------------
+class TestReleaseNodeIdempotency:
+    def test_double_release_across_crash_incarnation(self):
+        """The abort released the serving gate, then the process died
+        before the upgrade-required commit. The resumed abort releases
+        AGAIN on a fresh (empty) GateKeeper: no error, endpoints
+        admitting, and the NEXT drain cycle still gates correctly —
+        no stale parked record, no stale draining state."""
+        from tpu_operator_libs.chaos.injector import (
+            CrashFuse,
+            OperatorCrash,
+        )
+
+        fuse = CrashFuse()
+        cluster, clock, keys, mgr = _serving_fleet(provider_fuse=fuse)
+        names = sorted(n.metadata.name for n in cluster.list_nodes())
+        victim = names[0]
+        endpoints = {n: ServingEndpoint(f"decode-{n}", capacity=4)
+                     for n in names}
+
+        def resolver(node, pods):
+            ep = endpoints.get(node.metadata.name)
+            return [ep] if ep is not None else []
+
+        def source():
+            return {n: [ep] for n, ep in endpoints.items()}
+
+        mgr.with_eviction_gate(ServingDrainGate(resolver))
+        mgr.with_serving_signal(source)
+        # budget 1, already spent by the cordoned victim: nothing else
+        # is admitted, so the abort's commit is the pass's FIRST
+        # durable write — the crash lands exactly between the gate
+        # release and the commit
+        policy = UpgradePolicySpec(
+            auto_upgrade=True, max_parallel_upgrades=0,
+            max_unavailable=1,
+            drain=DrainSpec(enable=True, force=True,
+                            timeout_seconds=300))
+        # the durable truth a crashed predecessor left behind: the
+        # victim was admitted to abort-required mid-drain, its serving
+        # endpoint still draining
+        cluster.set_node_unschedulable(victim, True)
+        cluster.patch_node_labels(
+            victim,
+            {keys.state_label: str(UpgradeState.ABORT_REQUIRED)})
+        for name in names[1:]:
+            cluster.patch_node_labels(
+                name,
+                {keys.state_label:
+                 str(UpgradeState.UPGRADE_REQUIRED)})
+        endpoints[victim].begin_drain()
+        fuse.arm(0, after=False)  # the abort commit itself crashes
+        with pytest.raises(OperatorCrash):
+            mgr.reconcile(NS, RUNTIME_LABELS, policy)
+        mid = cluster.get_node(victim)
+        assert mid.metadata.labels.get(keys.state_label) \
+            == str(UpgradeState.ABORT_REQUIRED)
+        assert not endpoints[victim].draining, \
+            "the release should have landed before the crash"
+
+        # fresh incarnation: empty GateKeeper — the resumed abort
+        # releases a SECOND time (durable-label driven) without error
+        fuse.reset()
+        mgr2 = ClusterUpgradeStateManager(
+            cluster, keys, clock=clock, async_workers=False,
+            poll_interval=0.0)
+        mgr2.with_eviction_gate(ServingDrainGate(resolver))
+        mgr2.with_serving_signal(source)
+        mgr2.reconcile(NS, RUNTIME_LABELS, policy)
+        fresh = cluster.get_node(victim)
+        assert fresh.metadata.labels.get(keys.state_label) \
+            == str(UpgradeState.UPGRADE_REQUIRED)
+        assert not fresh.is_unschedulable()
+        assert not endpoints[victim].draining
+        # the gate is not stale: a later eviction wish re-drains and
+        # re-parks exactly like a first encounter
+        gatekeeper = mgr2.drain_manager._gatekeeper
+        node = cluster.get_node(victim)
+        endpoints[victim].try_begin()
+        assert not gatekeeper.allows(node, [])
+        assert endpoints[victim].draining
+        # and releasing twice in a row is harmless
+        gatekeeper.release_node(node, [])
+        gatekeeper.release_node(node, [])
+        assert not endpoints[victim].draining
+
+
+# ---------------------------------------------------------------------------
+# the end-to-end arc (hold -> prewarm -> drain -> handover -> release)
+# ---------------------------------------------------------------------------
+class TestHandoverEndToEnd:
+    def _run(self):
+        from tpu_operator_libs.obs import OperatorObservability
+
+        fleet = FleetSpec(n_slices=2, hosts_per_slice=4,
+                          pod_recreate_delay=2.0, pod_ready_delay=5.0)
+        cluster, clock, keys = build_fleet(fleet)
+        names = sorted(n.metadata.name for n in cluster.list_nodes())
+        classes = {
+            "interactive": TrafficClassSpec(
+                name="interactive", interactive=True,
+                drain_deadline_seconds=30.0),
+            "batch": TrafficClassSpec(
+                name="batch", drain_deadline_seconds=20.0,
+                max_shortfall_fraction=0.3),
+        }
+        assignments = {names[0]: ("int-solo-0", "interactive")}
+        for name in names[1:3]:
+            assignments[name] = ("int-0", "interactive")
+        for name in names[3:]:
+            assignments[name] = ("bm-0", "batch")
+        trace = DiurnalTrace(seed=1, trough_util=0.25, peak_util=0.35,
+                             noise=0.0)
+        sim = ServingFleetSim(cluster, names, trace,
+                              per_node_capacity=4, seed=1,
+                              classes=classes,
+                              assignments=assignments,
+                              prewarm_ready_seconds=10.0)
+        policy = UpgradePolicySpec(
+            auto_upgrade=True, max_parallel_upgrades=0,
+            max_unavailable="50%",
+            drain=DrainSpec(enable=True, force=True,
+                            timeout_seconds=300),
+            capacity=CapacityBudgetSpec(
+                enable=True, per_node_capacity=4,
+                peak_pause_utilization=0.85,
+                traffic_classes=list(classes.values()),
+                prewarm=True))
+        mgr = ClusterUpgradeStateManager(
+            cluster, keys, clock=clock, async_workers=False,
+            poll_interval=0.0)
+        mgr.with_eviction_gate(ServingDrainGate(sim.resolver))
+        mgr.with_serving_signal(sim.source)
+        mgr.with_prewarm_hooks(sim.prewarm_readiness,
+                               sim.prewarm_release)
+        obs = OperatorObservability(keys, clock=clock)
+        mgr.with_observability(obs)
+        return cluster, clock, keys, sim, policy, mgr, obs, names
+
+    def test_full_arc(self):
+        cluster, clock, keys, sim, policy, mgr, obs, names = \
+            self._run()
+        solo = names[0]
+        sim.tick(clock.now())
+        hold_seen = False
+        explain_seen = False
+        for _ in range(120):
+            mgr.reconcile(NS, RUNTIME_LABELS, policy)
+            sim.tick(clock.now())
+            ranker = mgr.cost_ranker
+            if ranker is not None and solo in ranker.last_holds:
+                hold_seen = True
+                chain = mgr.explain(solo)["blocking"]
+                explain_seen = any("disruption-cost ranker" in reason
+                                   for reason in chain)
+            nodes_now = cluster.list_nodes()
+            if all(n.metadata.labels.get(keys.state_label)
+                   == str(UpgradeState.DONE) for n in nodes_now):
+                break
+            clock.advance(5.0)
+            cluster.step()
+        nodes_now = cluster.list_nodes()
+        assert all(n.metadata.labels.get(keys.state_label)
+                   == str(UpgradeState.DONE) for n in nodes_now), \
+            "rollout did not converge"
+        assert hold_seen, "the sole-replica hold never fired"
+        assert explain_seen, "explain never surfaced the hold"
+        assert sim.prewarms_started >= 1
+        assert sim.operator_drop_records() == []
+        assert sim.operator_dropped == 0
+        # zero residue: no prewarm stamp on any node, replicas retired
+        for node in nodes_now:
+            assert keys.prewarm_reservation_annotation \
+                not in node.metadata.annotations
+            assert keys.prewarm_ready_annotation \
+                not in node.metadata.annotations
+        # drive the release sweep + replica retirement to quiescence
+        for _ in range(10):
+            mgr.reconcile(NS, RUNTIME_LABELS, policy)
+            sim.tick(clock.now())
+            clock.advance(5.0)
+            cluster.step()
+        assert not sim.prewarmed
+        # every hold and prewarm decision left an audit record
+        kinds = {rec.kind for rec in obs.audit.tail(limit=2000)}
+        assert "prewarm" in kinds
+        holds = [rec for rec in obs.audit.tail(limit=2000)
+                 if rec.kind == "hold"
+                 and rec.rule in (HOLD_SOLE_REPLICA,
+                                  HOLD_AWAITING_PREWARM)]
+        assert holds, "ranker holds were not audited"
+        # cluster_status surfaces the ranker/prewarm picture
+        status = mgr.cluster_status(
+            mgr.build_state(NS, RUNTIME_LABELS))
+        assert "prewarm" in status["capacity"]
+        assert status["capacity"]["prewarm"]["releasedTotal"] >= 1
+
+
+# ---------------------------------------------------------------------------
+# per-class invariant units
+# ---------------------------------------------------------------------------
+class TestClassSloInvariant:
+    def _monitor(self, classes):
+        from tpu_operator_libs.chaos.invariants import (
+            CapacityExpectation,
+            InvariantMonitor,
+        )
+        from tpu_operator_libs.consts import UpgradeKeys
+
+        fleet = FleetSpec(n_slices=1, hosts_per_slice=2)
+        cluster, clock, keys = build_fleet(fleet)
+        return InvariantMonitor(
+            cluster=cluster, upgrade_keys=UpgradeKeys(),
+            capacity=CapacityExpectation(
+                static_equivalent=1, classes=classes,
+                zero_drop=True))
+
+    def test_interactive_shortfall_is_strict(self):
+        classes = {
+            "interactive": TrafficClassSpec(name="interactive",
+                                            interactive=True),
+            "batch": TrafficClassSpec(name="batch",
+                                      max_shortfall_fraction=0.3),
+        }
+        monitor = self._monitor(classes)
+        load = {"now": 1.0, "target": 20, "inFlight": 18,
+                "admittingCapacity": 18, "shortfall": 2,
+                "perClass": {
+                    "interactive": {"target": 10, "inFlight": 8,
+                                    "shortfall": 2,
+                                    "refCapacity": 16},
+                    "batch": {"target": 10, "inFlight": 10,
+                              "shortfall": 0, "refCapacity": 16},
+                }}
+        monitor.capacity_sample(load, None)
+        assert any(v.invariant == "class-slo"
+                   for v in monitor.violations)
+
+    def test_batch_degrades_within_allowance(self):
+        classes = {
+            "batch": TrafficClassSpec(name="batch",
+                                      max_shortfall_fraction=0.3),
+        }
+        monitor = self._monitor(classes)
+        load = {"now": 1.0, "target": 10, "inFlight": 8,
+                "admittingCapacity": 8, "shortfall": 2,
+                "perClass": {
+                    "batch": {"target": 10, "inFlight": 8,
+                              "shortfall": 2, "refCapacity": 16},
+                }}
+        monitor.capacity_sample(load, None)
+        assert not monitor.violations
+        # ... but only within it
+        load["perClass"]["batch"]["shortfall"] = 5
+        monitor.capacity_sample(load, None)
+        assert any(v.invariant == "class-slo"
+                   for v in monitor.violations)
+
+    def test_overload_beyond_reference_capacity_is_excused(self):
+        classes = {
+            "interactive": TrafficClassSpec(name="interactive",
+                                            interactive=True),
+        }
+        monitor = self._monitor(classes)
+        # offered 20 against a reference of 16: even a perfect fleet
+        # could not place 4 of them — not a drain decision
+        load = {"now": 1.0, "target": 20, "inFlight": 16,
+                "admittingCapacity": 16, "shortfall": 4,
+                "perClass": {
+                    "interactive": {"target": 20, "inFlight": 16,
+                                    "shortfall": 4,
+                                    "refCapacity": 16},
+                }}
+        monitor.capacity_sample(load, None)
+        assert not monitor.violations
+
+    def test_operator_dark_interactive_model_violates(self):
+        classes = {
+            "interactive": TrafficClassSpec(name="interactive",
+                                            interactive=True),
+        }
+        monitor = self._monitor(classes)
+        load = {"now": 1.0, "target": 4, "inFlight": 4,
+                "admittingCapacity": 4, "shortfall": 0,
+                "perClass": {}, "interactiveDarkOperator": 1}
+        monitor.capacity_sample(load, None)
+        assert any(v.invariant == "class-slo"
+                   and "DARK" in v.detail
+                   for v in monitor.violations)
+
+
+# ---------------------------------------------------------------------------
+# marker lint (CI/tooling satellite)
+# ---------------------------------------------------------------------------
+class TestMarkerLint:
+    def test_repo_is_clean(self):
+        from tools.marker_lint import lint
+
+        assert lint() == []
+
+    def _write_tree(self, tmp_path, markers, test_body, makefile):
+        (tmp_path / "pyproject.toml").write_text(
+            "[tool.pytest.ini_options]\nmarkers = [\n"
+            + "".join(f'    "{m}",\n' for m in markers) + "]\n")
+        tests = tmp_path / "tests"
+        tests.mkdir()
+        (tests / "test_x.py").write_text(test_body)
+        (tmp_path / "Makefile").write_text(makefile)
+
+    def test_undeclared_marker_is_found(self, tmp_path):
+        from tools.marker_lint import lint
+
+        self._write_tree(
+            tmp_path, ["alpha: a slice"],
+            "import pytest\n\n"
+            "@pytest.mark.alpha\n@pytest.mark.beta\n"
+            "def test_a():\n    pass\n",
+            "test-alpha:\n\tpytest -m alpha\n")
+        findings = lint(tmp_path)
+        assert any("'beta' is used but not declared" in f
+                   for f in findings)
+
+    def test_dead_declaration_and_missing_target_found(self, tmp_path):
+        from tools.marker_lint import lint
+
+        self._write_tree(
+            tmp_path,
+            ["alpha: a slice", "ghost: never used"],
+            "import pytest\n\npytestmark = pytest.mark.alpha\n\n"
+            "def test_a():\n    pass\n",
+            "test:\n\tpytest\n")
+        findings = lint(tmp_path)
+        assert any("'ghost' is declared but no test" in f
+                   for f in findings)
+        assert any("'alpha' appears in no" in f for f in findings)
+
+    def test_builtin_marks_exempt(self, tmp_path):
+        from tools.marker_lint import lint
+
+        self._write_tree(
+            tmp_path, ["alpha: a slice"],
+            "import pytest\n\n"
+            "@pytest.mark.alpha\n"
+            "@pytest.mark.parametrize('x', [1])\n"
+            "@pytest.mark.skipif(False, reason='no')\n"
+            "def test_a(x):\n    pass\n",
+            "test-alpha:\n\tpytest -m 'alpha and not slow'\n")
+        assert lint(tmp_path) == []
+
+
+# ---------------------------------------------------------------------------
+# the chaos gate + bench smoke
+# ---------------------------------------------------------------------------
+class TestHandoverSoakGate:
+    """The class-aware diurnal replay gate at 2x the budget gate's
+    trace amplitude: zero operator-dropped sessions (exact ids), zero
+    interactive SLO breaches, zero prewarm residue, full convergence
+    with every replica retired. Seeds 1-3 tier-1, 4-10 slow."""
+
+    @pytest.mark.chaos
+    @pytest.mark.parametrize("seed", [1, 2, 3])
+    def test_handover_soak_seed(self, seed):
+        from tpu_operator_libs.chaos.runner import run_handover_soak
+
+        report = run_handover_soak(seed)
+        assert report.ok, report.report_text
+        assert report.crashes_fired >= 1
+
+    @pytest.mark.chaos
+    @pytest.mark.slow
+    @pytest.mark.parametrize("seed", [4, 5, 6, 7, 8, 9, 10])
+    def test_handover_soak_extended(self, seed):
+        from tpu_operator_libs.chaos.runner import run_handover_soak
+
+        report = run_handover_soak(seed)
+        assert report.ok, report.report_text
+
+
+class TestHandoverBenchSmoke:
+    def test_class_aware_cell(self):
+        from tools.budget_bench import run_budget_bench, check
+
+        result = run_budget_bench(nodes=16, seeds=(1,))
+        cell = result["cells"]["classAware"]
+        assert cell["converged"]
+        assert cell["operatorDropped"] == 0
+        assert cell["interactiveBreachTicks"] == 0
+        assert cell["interactiveDarkTicks"] == 0
+        assert cell["rankHolds"] >= 1
+        assert cell["prewarmsStarted"] >= 1
+        assert result["stateFingerprintMatch"]
+        assert check(result) == []

@@ -2,7 +2,7 @@
 """Traffic-aware capacity budgets vs static maxUnavailable on the
 diurnal serving replay.
 
-Three cells per (nodes, seed), all serving the SAME seeded diurnal
+Four cells per (nodes, seed), all serving the SAME seeded diurnal
 trace (chaos/serving.DiurnalTrace — sinusoidal utilization plus one
 ramped spike) through the ServingDrainGate while the fleet rolls to a
 new revision:
@@ -17,11 +17,21 @@ new revision:
 - ``capacityAware`` — the CapacityBudgetController live: effective
   budget recomputed each pass, drains hard in troughs, pauses/aborts
   at the peak.
+- ``classAware`` — capacityAware plus traffic classes + the
+  DisruptionCostRanker + the prewarm arc + router-side session
+  handover: the fleet is split into interactive (incl. sole-replica
+  models) and batch, drains spend the budget on the cheapest class
+  first, sole-replica interactive nodes wait for a prewarmed
+  replacement, and sessions hand over behind per-class deadlines.
 
 Acceptance (asserted by ``--check`` and the bench smoke test):
 capacityAware has ZERO operator-dropped generations and ZERO SLO
 shortfall ticks, and its makespan is <= staticPeakSafe's (typically
-much shorter — the trough headroom it spends is real).
+much shorter — the trough headroom it spends is real); classAware
+ADDITIONALLY has zero interactive-class breach ticks and zero
+operator-dark interactive models, stays within 1.15x of the
+class-blind capacityAware makespan, and its final cluster state is
+bit-identical to capacityAware's modulo the durable prewarm stamps.
 
 Writes BENCH_budget.json (``make bench-budget``).
 """
@@ -40,6 +50,7 @@ sys.path.insert(0, os.path.dirname(os.path.dirname(
 from tpu_operator_libs.api.upgrade_policy import (  # noqa: E402
     CapacityBudgetSpec,
     DrainSpec,
+    TrafficClassSpec,
     UpgradePolicySpec,
 )
 from tpu_operator_libs.chaos.serving import (  # noqa: E402
@@ -47,6 +58,7 @@ from tpu_operator_libs.chaos.serving import (  # noqa: E402
     DiurnalTrace,
     ServingFleetSim,
     SpikeWindow,
+    assign_traffic,
 )
 from tpu_operator_libs.consts import UpgradeState  # noqa: E402
 from tpu_operator_libs.health.serving_gate import (  # noqa: E402
@@ -96,6 +108,25 @@ def peak_safe_budget(nodes: int, trace: DiurnalTrace) -> int:
     return max(1, nodes - required)
 
 
+def bench_classes(nodes: int) -> "dict[str, TrafficClassSpec]":
+    return {
+        "interactive": TrafficClassSpec(
+            name="interactive", interactive=True, min_replicas=1,
+            drain_deadline_seconds=60.0, max_shortfall_fraction=0.0),
+        "batch": TrafficClassSpec(
+            name="batch", interactive=False, min_replicas=1,
+            drain_deadline_seconds=30.0, max_shortfall_fraction=0.3),
+    }
+
+
+def bench_assignments(node_names: "list[str]",
+                      ) -> "dict[str, tuple[str, str]]":
+    return assign_traffic(
+        node_names, interactive_fraction=0.25,
+        sole_models=max(1, min(3, len(node_names) // 16)),
+        interactive_replicas=2, batch_replicas=8)
+
+
 def cell_policy(nodes: int, mode: str,
                 trace: DiurnalTrace) -> UpgradePolicySpec:
     max_effective = int(nodes * MAX_EFFECTIVE_FRACTION)
@@ -103,13 +134,17 @@ def cell_policy(nodes: int, mode: str,
         auto_upgrade=True, max_parallel_upgrades=0,
         topology_mode="flat",
         drain=DrainSpec(enable=True, force=True, timeout_seconds=300))
-    if mode == "capacityAware":
+    if mode in ("capacityAware", "classAware"):
         policy.max_unavailable = "25%"
         policy.capacity = CapacityBudgetSpec(
             enable=True, slo_headroom_fraction=SLO_HEADROOM,
             max_effective_budget=max_effective,
             peak_pause_utilization=0.75,
             per_node_capacity=PER_NODE_CAPACITY)
+        if mode == "classAware":
+            policy.capacity.traffic_classes = list(
+                bench_classes(nodes).values())
+            policy.capacity.prewarm = True
     elif mode == "staticPeakSafe":
         policy.max_unavailable = peak_safe_budget(nodes, trace)
     elif mode == "staticAggressive":
@@ -119,6 +154,31 @@ def cell_policy(nodes: int, mode: str,
     return policy
 
 
+def state_fingerprint(cluster: "object", keys: "object") -> str:
+    """Final cluster state modulo the feature's own durable stamps:
+    the prewarm reserve/ready annotations (and the predictor/tracer
+    stamps, for parity with the other benches) are the class-aware
+    cell's documented residue, not rollout drift."""
+    excluded = {
+        keys.prewarm_reservation_annotation,
+        keys.prewarm_ready_annotation,
+        keys.phase_start_annotation,
+        keys.phase_durations_annotation,
+        keys.trace_id_annotation,
+    }
+    raw = tuple(sorted(
+        (node.metadata.name,
+         tuple(sorted(node.metadata.labels.items())),
+         tuple(sorted((k, v) for k, v
+                      in node.metadata.annotations.items()
+                      if k not in excluded)),
+         node.is_unschedulable())
+        for node in cluster.list_nodes()))
+    import hashlib
+
+    return hashlib.sha256(repr(raw).encode()).hexdigest()
+
+
 def run_cell(nodes: int, seed: int, mode: str) -> dict:
     assert nodes % 4 == 0, "nodes must be a multiple of 4"
     fleet = FleetSpec(n_slices=nodes // 4, hosts_per_slice=4,
@@ -126,15 +186,22 @@ def run_cell(nodes: int, seed: int, mode: str) -> dict:
     cluster, clock, keys = build_fleet(fleet)
     node_names = [n.metadata.name for n in cluster.list_nodes()]
     trace = bench_trace(seed)
-    sim = ServingFleetSim(cluster, node_names, trace,
-                          per_node_capacity=PER_NODE_CAPACITY,
-                          seed=seed)
+    classes = bench_classes(nodes) if mode == "classAware" else None
+    sim = ServingFleetSim(
+        cluster, node_names, trace,
+        per_node_capacity=PER_NODE_CAPACITY, seed=seed,
+        classes=classes,
+        assignments=(bench_assignments(node_names)
+                     if classes else None))
     policy = cell_policy(nodes, mode, trace)
     mgr = ClusterUpgradeStateManager(
         cluster, keys, clock=clock, async_workers=False,
         poll_interval=0.0)
     mgr.with_eviction_gate(ServingDrainGate(sim.resolver))
     mgr.with_serving_signal(sim.source)
+    if classes:
+        mgr.with_prewarm_hooks(sim.prewarm_readiness,
+                               sim.prewarm_release)
 
     log = CapacityLog()
     makespan = None
@@ -150,7 +217,8 @@ def run_cell(nodes: int, seed: int, mode: str) -> dict:
         load = sim.tick(clock.now())
         controller = mgr.capacity_controller
         log.record(load, controller.last_status
-                   if controller is not None else None)
+                   if controller is not None else None,
+                   classes=classes)
         nodes_now = cluster.list_nodes()
         if makespan is None and all(
                 n.metadata.labels.get(keys.state_label)
@@ -160,7 +228,7 @@ def run_cell(nodes: int, seed: int, mode: str) -> dict:
         clock.advance(TICK)
         cluster.step()
     summary = sim.summary()
-    return {
+    out = {
         "mode": mode,
         "nodes": nodes,
         "seed": seed,
@@ -173,14 +241,27 @@ def run_cell(nodes: int, seed: int, mode: str) -> dict:
         "effectiveBudgetMin": log.effective_min,
         "effectiveBudgetMax": log.effective_max,
         "staticBudget": (policy.max_unavailable
-                         if mode != "capacityAware" else "25%"),
+                         if mode not in ("capacityAware", "classAware")
+                         else "25%"),
+        "stateFingerprint": state_fingerprint(cluster, keys),
     }
+    if classes:
+        out["interactiveBreachTicks"] = \
+            log.class_breach_ticks.get("interactive", 0)
+        out["batchBreachTicks"] = log.class_breach_ticks.get("batch", 0)
+        out["interactiveDarkTicks"] = log.interactive_dark_ticks
+        out["sessionHandovers"] = summary["handovers"]
+        out["prewarmsStarted"] = summary["prewarmsStarted"]
+        out["prewarmsRetired"] = summary["prewarmsRetired"]
+        out["rankHolds"] = (mgr.cost_ranker.holds_total
+                            if mgr.cost_ranker is not None else 0)
+    return out
 
 
 def aggregate(cells: "list[dict]") -> dict:
     makespans = [c["makespanSeconds"] for c in cells
                  if c["makespanSeconds"] is not None]
-    return {
+    out = {
         "seeds": sorted({c["seed"] for c in cells}),
         "converged": all(c["converged"] for c in cells),
         "makespanSeconds": (round(sum(makespans) / len(makespans), 1)
@@ -196,13 +277,24 @@ def aggregate(cells: "list[dict]") -> dict:
              if c["effectiveBudgetMax"] is not None), default=None),
         "perSeed": cells,
     }
+    for key in ("interactiveBreachTicks", "interactiveDarkTicks",
+                "batchBreachTicks", "sessionHandovers",
+                "prewarmsStarted", "rankHolds"):
+        if any(key in c for c in cells):
+            out[key] = sum(c.get(key, 0) for c in cells)
+    return out
+
+
+#: classAware must land within this factor of the class-blind
+#: capacity-aware makespan (the holds/prewarm waits are bounded).
+CLASS_MAKESPAN_FACTOR = 1.15
 
 
 def run_budget_bench(nodes: int = 256,
                      seeds: "tuple[int, ...]" = (1, 2, 3)) -> dict:
     cells: dict[str, list[dict]] = {
         "staticPeakSafe": [], "staticAggressive": [],
-        "capacityAware": []}
+        "capacityAware": [], "classAware": []}
     for seed in seeds:
         for mode in cells:
             cells[mode].append(run_cell(nodes, seed, mode))
@@ -219,10 +311,24 @@ def run_budget_bench(nodes: int = 256,
     }
     aware = out["cells"]["capacityAware"]
     safe = out["cells"]["staticPeakSafe"]
+    class_aware = out["cells"]["classAware"]
     out["makespanVsStatic"] = (
         round(safe["makespanSeconds"] / aware["makespanSeconds"], 3)
         if aware["makespanSeconds"] and safe["makespanSeconds"]
         else None)
+    out["classVsCapacityAware"] = (
+        round(class_aware["makespanSeconds"]
+              / aware["makespanSeconds"], 3)
+        if class_aware["makespanSeconds"] and aware["makespanSeconds"]
+        else None)
+    # final-state parity per seed: classAware must converge the fleet
+    # to the exact same durable state as the class-blind cell, modulo
+    # the documented prewarm stamps (excluded from the fingerprint)
+    by_seed = {c["seed"]: c["stateFingerprint"]
+               for c in aware["perSeed"]}
+    out["stateFingerprintMatch"] = all(
+        c["stateFingerprint"] == by_seed.get(c["seed"])
+        for c in class_aware["perSeed"])
     return out
 
 
@@ -244,6 +350,31 @@ def check(result: dict) -> "list[str]":
             and aware["makespanSeconds"] > safe["makespanSeconds"]:
         problems.append(
             "capacityAware was slower than the peak-safe static cell")
+    class_aware = result["cells"].get("classAware")
+    if class_aware is not None:
+        if not class_aware["converged"]:
+            problems.append("classAware did not converge")
+        if class_aware["operatorDropped"]:
+            problems.append(
+                f"classAware dropped {class_aware['operatorDropped']} "
+                f"generation(s) via evictions")
+        if class_aware.get("interactiveBreachTicks"):
+            problems.append(
+                f"classAware breached the interactive class SLO on "
+                f"{class_aware['interactiveBreachTicks']} tick(s)")
+        if class_aware.get("interactiveDarkTicks"):
+            problems.append(
+                f"classAware operator-drained interactive models dark "
+                f"on {class_aware['interactiveDarkTicks']} tick(s)")
+        ratio = result.get("classVsCapacityAware")
+        if ratio is not None and ratio > CLASS_MAKESPAN_FACTOR:
+            problems.append(
+                f"classAware makespan is {ratio}x the class-blind "
+                f"capacity-aware run (limit {CLASS_MAKESPAN_FACTOR}x)")
+        if not result.get("stateFingerprintMatch", True):
+            problems.append(
+                "classAware final cluster state diverged from "
+                "capacityAware (beyond the documented prewarm stamps)")
     return problems
 
 
@@ -263,6 +394,7 @@ def main() -> int:
     aware = result["cells"]["capacityAware"]
     safe = result["cells"]["staticPeakSafe"]
     aggressive = result["cells"]["staticAggressive"]
+    class_aware = result["cells"]["classAware"]
     print(f"wrote {args.out}")
     print(f"  staticPeakSafe  : makespan {safe['makespanSeconds']}s, "
           f"shortfall ticks {safe['sloShortfallTicks']}")
@@ -273,8 +405,17 @@ def main() -> int:
           f"shortfall ticks {aware['sloShortfallTicks']}, effective "
           f"budget [{aware['effectiveBudgetMin']}, "
           f"{aware['effectiveBudgetMax']}]")
+    print(f"  classAware      : makespan "
+          f"{class_aware['makespanSeconds']}s, interactive breach "
+          f"ticks {class_aware.get('interactiveBreachTicks', 0)}, "
+          f"holds {class_aware.get('rankHolds', 0)}, prewarms "
+          f"{class_aware.get('prewarmsStarted', 0)}, handovers "
+          f"{class_aware.get('sessionHandovers', 0)}")
     print(f"  makespan vs peak-safe static: "
-          f"{result['makespanVsStatic']}x")
+          f"{result['makespanVsStatic']}x; class-aware vs "
+          f"class-blind: {result['classVsCapacityAware']}x "
+          f"(fingerprints match: "
+          f"{result['stateFingerprintMatch']})")
     for problem in problems:
         print(f"  ACCEPTANCE FAIL: {problem}", file=sys.stderr)
     return 1 if problems else 0

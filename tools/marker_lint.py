@@ -1,0 +1,142 @@
+#!/usr/bin/env python3
+"""Pytest-marker drift check (wired into `make lint`).
+
+The repo's test surface is sliced by markers (`budget`, `shard`,
+`handover`, ...), each with a `make test-*` target that CI and humans
+run. Markers rot the same way metric names do (tools/metrics_lint.py):
+a test file grows a `pytest.mark.newthing` nobody declared (pytest
+only warns), or a declared marker loses its last test or its Makefile
+target and the slice silently stops running. Three static checks, no
+pytest import:
+
+1. **Used → declared**: every ``pytest.mark.<name>`` in tests/ must be
+   declared in pyproject.toml ``[tool.pytest.ini_options].markers``
+   (pytest builtins exempt).
+2. **Declared → used**: every declared marker must be used by at least
+   one test — an unused declaration is a dead slice.
+3. **Declared → Makefile**: every declared marker except structural
+   modifiers (``slow`` — a selector suffix, not a slice) must appear
+   in a ``-m`` expression of a Makefile target, so the slice is
+   actually runnable as ``make test-<something>``.
+
+Exit status 1 iff findings were printed.
+"""
+
+from __future__ import annotations
+
+import ast
+import re
+import sys
+from pathlib import Path
+
+ROOT = Path(__file__).resolve().parent.parent
+
+#: Marks pytest ships with — never declared in pyproject.
+BUILTIN_MARKS = frozenset((
+    "parametrize", "skip", "skipif", "xfail", "usefixtures",
+    "filterwarnings",
+))
+#: Declared markers that are selector MODIFIERS, not slices — they
+#: need no Makefile target of their own.
+MODIFIER_MARKERS = frozenset(("slow",))
+
+
+def declared_markers(root: Path = ROOT) -> "set[str]":
+    """Marker names from pyproject's [tool.pytest.ini_options].markers
+    (the text before the first ':' of each entry)."""
+    text = (root / "pyproject.toml").read_text()
+    try:
+        import tomllib
+
+        data = tomllib.loads(text)
+        entries = (data.get("tool", {}).get("pytest", {})
+                   .get("ini_options", {}).get("markers", []))
+    except ImportError:  # pragma: no cover - py3.10
+        block = re.search(r"markers\s*=\s*\[(.*?)\]", text, re.S)
+        entries = re.findall(r'"([^"]+)"', block.group(1)) \
+            if block else []
+    out = set()
+    for entry in entries:
+        name = entry.split(":", 1)[0].strip()
+        if name:
+            out.add(name)
+    return out
+
+
+def used_markers(root: Path = ROOT) -> "dict[str, str]":
+    """marker name -> first use site ("path:line") from a static walk
+    of every ``pytest.mark.<name>`` attribute in tests/."""
+    out: dict[str, str] = {}
+    for path in sorted((root / "tests").glob("*.py")):
+        tree = ast.parse(path.read_text(), filename=str(path))
+        for node in ast.walk(tree):
+            if not isinstance(node, ast.Attribute):
+                continue
+            value = node.value
+            if not (isinstance(value, ast.Attribute)
+                    and value.attr == "mark"
+                    and isinstance(value.value, ast.Name)
+                    and value.value.id == "pytest"):
+                continue
+            site = f"{path.relative_to(root)}:{node.lineno}"
+            out.setdefault(node.attr, site)
+    return out
+
+
+def makefile_marker_expressions(root: Path = ROOT) -> "set[str]":
+    """Every marker name referenced by a ``-m`` expression in the
+    Makefile (boolean operators stripped)."""
+    text = (root / "Makefile").read_text()
+    out: set[str] = set()
+    for expr in re.findall(r"-m\s+(?:\"([^\"]+)\"|'([^']+)'|(\S+))",
+                           text):
+        for group in expr:
+            for token in re.findall(r"[A-Za-z_][\w-]*", group):
+                if token not in ("and", "or", "not"):
+                    out.add(token)
+    return out
+
+
+def lint(root: Path = ROOT) -> "list[str]":
+    findings: list[str] = []
+    declared = declared_markers(root)
+    used = used_markers(root)
+    in_makefile = makefile_marker_expressions(root)
+    for name in sorted(used):
+        if name in BUILTIN_MARKS or name in declared:
+            continue
+        findings.append(
+            f"{used[name]}: marker {name!r} is used but not declared "
+            f"in pyproject.toml [tool.pytest.ini_options].markers "
+            f"(pytest will only warn, and the slice has no selector)")
+    for name in sorted(declared):
+        if name not in used:
+            findings.append(
+                f"pyproject.toml: marker {name!r} is declared but no "
+                f"test in tests/ uses it — a dead slice")
+        if name in MODIFIER_MARKERS:
+            continue
+        if name not in in_makefile:
+            findings.append(
+                f"Makefile: declared marker {name!r} appears in no "
+                f"-m expression — the slice is not runnable as a "
+                f"make test-* target")
+    return findings
+
+
+def main() -> int:
+    findings = lint()
+    for finding in findings:
+        print(finding)
+    if findings:
+        print(f"marker_lint: {len(findings)} finding(s)",
+              file=sys.stderr)
+        return 1
+    declared = declared_markers()
+    print(f"marker_lint: OK ({len(declared)} markers declared, "
+          f"used, and Makefile-reachable)")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

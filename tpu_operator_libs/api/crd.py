@@ -343,10 +343,14 @@ def capacity_budget_schema() -> dict[str, Any]:
             "sloHeadroomFraction": {
                 "type": "number",
                 "minimum": 0,
+                "exclusiveMaximum": 1,
                 "default": 0.25,
                 "description": "Required spare-capacity fraction over "
                                "current demand before a node may be "
-                               "taken unavailable.",
+                               "taken unavailable (a fraction >= 1 "
+                               "could never be satisfied at any "
+                               "nonzero utilization; rejected at "
+                               "policy-load time).",
             },
             "minEffectiveBudget": {
                 "type": "integer",
@@ -397,6 +401,81 @@ def capacity_budget_schema() -> dict[str, Any]:
                                "wakeup registered on the deadline timer "
                                "wheel while the budget is held below "
                                "the static count.",
+            },
+            "trafficClasses": {
+                "type": "array",
+                "default": [],
+                "description": "Serving traffic classes "
+                               "(upgrade/handover.py): with any "
+                               "declared, the DisruptionCostRanker "
+                               "spends the budget on the cheapest "
+                               "serving disruption first and holds "
+                               "sole-replica interactive nodes behind "
+                               "the prewarm arc.",
+                "items": traffic_class_schema(),
+            },
+            "prewarm": {
+                "type": "boolean",
+                "default": False,
+                "description": "Prewarm arc: reserve an already-"
+                               "upgraded spare, bring a replacement "
+                               "replica up on it and require readiness "
+                               "(durable reserve/ready stamps) before "
+                               "a hold-worthy incumbent's eviction is "
+                               "admitted.",
+            },
+        },
+    }
+
+
+def traffic_class_schema() -> dict[str, Any]:
+    """TrafficClassSpec (api/upgrade_policy.py)."""
+    return {
+        "type": "object",
+        "description": "One serving traffic class: disruption "
+                       "sensitivity, replication floor, drain "
+                       "deadline and admission SLO.",
+        "required": ["name"],
+        "properties": {
+            "name": {
+                "type": "string",
+                "pattern": "^[a-z0-9]([a-z0-9-]{0,61}[a-z0-9])?$",
+                "description": "Class name the ServingEndpoints "
+                               "declare (DNS-label shaped).",
+            },
+            "interactive": {
+                "type": "boolean",
+                "default": False,
+                "description": "Strict-SLO class: admission shortfall "
+                               "is a violation and sole-replica "
+                               "models are held behind the prewarm "
+                               "arc.",
+            },
+            "minReplicas": {
+                "type": "integer",
+                "minimum": 1,
+                "default": 1,
+                "description": "A node may drain only while each of "
+                               "its models keeps at least this many "
+                               "other admitting replicas.",
+            },
+            "drainDeadlineSeconds": {
+                "type": "number",
+                "exclusiveMinimum": 0,
+                "default": 120,
+                "description": "Router-side drain deadline: in-flight "
+                               "generations past it are handed over "
+                               "to a peer replica (never dropped).",
+            },
+            "maxShortfallFraction": {
+                "type": "number",
+                "minimum": 0,
+                "exclusiveMaximum": 1,
+                "default": 0,
+                "description": "Fraction of the class's offered load "
+                               "that may go unplaced at a tick before "
+                               "its SLO counts as breached (0 = "
+                               "strict; interactive must be 0).",
             },
         },
     }

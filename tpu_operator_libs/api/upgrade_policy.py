@@ -17,7 +17,8 @@ from __future__ import annotations
 
 import copy
 import math
-from dataclasses import dataclass
+import re
+from dataclasses import dataclass, field
 from typing import Any, Optional, Union
 
 IntOrString = Union[int, str]
@@ -372,6 +373,89 @@ class MaintenanceWindowSpec:
         return copy.deepcopy(self)
 
 
+#: DNS-label shape every traffic-class name must take (lowercase
+#: alphanumerics and dashes, no leading/trailing dash) — the same
+#: constraint a Kubernetes label VALUE carries, so class names can ride
+#: node labels and metric labels unchanged.
+_CLASS_NAME_RE = re.compile(r"^[a-z0-9]([a-z0-9-]{0,61}[a-z0-9])?$")
+
+
+@dataclass
+class TrafficClassSpec:
+    """One serving traffic class (beyond-reference; upgrade/handover.py).
+
+    A class groups serving endpoints by disruption sensitivity:
+    ``interactive`` classes carry a strict admission SLO (a user is
+    waiting on every generation), ``batch`` classes a relaxed one
+    (queued work tolerates deferral). The DisruptionCostRanker drains
+    nodes serving only cheap classes first and HOLDS a node whose
+    drain would leave one of its models below ``minReplicas`` admitting
+    replicas (for interactive classes the prewarm arc then brings a
+    replacement replica up before the hold lifts).
+    """
+
+    # Class name; must match the traffic_class the ServingEndpoints
+    # declare (DNS-label shaped, validated).
+    name: str = "batch"
+    # Strict-SLO class: admission shortfall is a violation, and
+    # sole-replica models are held behind the prewarm arc.
+    interactive: bool = False
+    # A node may drain only while each of its models keeps at least
+    # this many OTHER admitting replicas (1 = only sole replicas held).
+    min_replicas: int = 1
+    # Router-side drain deadline: generations still in flight on a
+    # draining endpoint past this many seconds are handed over to a
+    # peer replica (never dropped) so the drain can quiesce.
+    drain_deadline_seconds: float = 120.0
+    # Fraction of the class's offered load that may go unplaced at a
+    # tick before the class SLO counts as breached (0 = strict;
+    # interactive classes must be 0).
+    max_shortfall_fraction: float = 0.0
+
+    def validate(self) -> None:
+        if not isinstance(self.name, str) \
+                or not _CLASS_NAME_RE.match(self.name):
+            raise PolicyValidationError(
+                f"trafficClasses[].name {self.name!r} is malformed: "
+                f"must be a lowercase DNS label "
+                f"(alphanumerics and dashes)")
+        if isinstance(self.min_replicas, bool) or self.min_replicas < 1:
+            raise PolicyValidationError(
+                f"trafficClasses[{self.name}].minReplicas must be >= 1")
+        if self.drain_deadline_seconds <= 0:
+            raise PolicyValidationError(
+                f"trafficClasses[{self.name}].drainDeadlineSeconds "
+                f"must be > 0")
+        if not 0.0 <= self.max_shortfall_fraction < 1.0:
+            raise PolicyValidationError(
+                f"trafficClasses[{self.name}].maxShortfallFraction "
+                f"must be in [0, 1)")
+        if self.interactive and self.max_shortfall_fraction != 0.0:
+            raise PolicyValidationError(
+                f"trafficClasses[{self.name}]: an interactive class's "
+                f"maxShortfallFraction must be 0 (strict SLO)")
+
+    def to_dict(self) -> dict[str, Any]:
+        return {"name": self.name,
+                "interactive": self.interactive,
+                "minReplicas": self.min_replicas,
+                "drainDeadlineSeconds": self.drain_deadline_seconds,
+                "maxShortfallFraction": self.max_shortfall_fraction}
+
+    @classmethod
+    def from_dict(cls, data: dict[str, Any]) -> "TrafficClassSpec":
+        return cls(name=data.get("name", "batch"),
+                   interactive=data.get("interactive", False),
+                   min_replicas=data.get("minReplicas", 1),
+                   drain_deadline_seconds=data.get(
+                       "drainDeadlineSeconds", 120.0),
+                   max_shortfall_fraction=data.get(
+                       "maxShortfallFraction", 0.0))
+
+    def deep_copy(self) -> "TrafficClassSpec":
+        return copy.deepcopy(self)
+
+
 @dataclass
 class CapacityBudgetSpec:
     """Traffic-aware dynamic disruption budgets (beyond-reference;
@@ -416,11 +500,29 @@ class CapacityBudgetSpec:
     # many seconds out on the deadline timer wheel, so the next trough
     # is caught without waiting out a resync interval.
     recheck_seconds: float = 30.0
+    # Traffic classes (upgrade/handover.py): with any declared, the
+    # DisruptionCostRanker wraps the planner chain and spends the
+    # budget on the cheapest serving disruption first. Empty = the
+    # class-blind PR 10 behavior, bit for bit.
+    traffic_classes: list[TrafficClassSpec] = field(default_factory=list)
+    # Prewarm arc: before a hold-worthy incumbent drains, reserve an
+    # already-upgraded spare, bring a replacement replica up on it and
+    # require readiness (durable stamps) before the incumbent's
+    # eviction is admitted.
+    prewarm: bool = False
+
+    def class_map(self) -> "dict[str, TrafficClassSpec]":
+        return {spec.name: spec for spec in self.traffic_classes}
 
     def validate(self) -> None:
-        if self.slo_headroom_fraction < 0:
+        # NOTE on the headroom bound: a fraction >= 1 would demand more
+        # spare capacity than the whole fleet provides at any nonzero
+        # utilization — required = demand * (1 + f) can never be met,
+        # so the budget would silently pin to the floor forever.
+        # Rejected at policy-load time instead of misbehaving mid-pass.
+        if not 0.0 <= self.slo_headroom_fraction < 1.0:
             raise PolicyValidationError(
-                "capacityBudget.sloHeadroomFraction must be >= 0")
+                "capacityBudget.sloHeadroomFraction must be in [0, 1)")
         if self.min_effective_budget < 0:
             raise PolicyValidationError(
                 "capacityBudget.minEffectiveBudget must be >= 0")
@@ -444,6 +546,14 @@ class CapacityBudgetSpec:
         if self.recheck_seconds <= 0:
             raise PolicyValidationError(
                 "capacityBudget.recheckSeconds must be > 0")
+        seen: set[str] = set()
+        for spec in self.traffic_classes:
+            spec.validate()
+            if spec.name in seen:
+                raise PolicyValidationError(
+                    f"capacityBudget.trafficClasses: duplicate class "
+                    f"name {spec.name!r}")
+            seen.add(spec.name)
 
     def to_dict(self) -> dict[str, Any]:
         return {"enable": self.enable,
@@ -453,7 +563,10 @@ class CapacityBudgetSpec:
                 "peakPauseUtilization": self.peak_pause_utilization,
                 "perNodeCapacity": self.per_node_capacity,
                 "smoothing": self.smoothing,
-                "recheckSeconds": self.recheck_seconds}
+                "recheckSeconds": self.recheck_seconds,
+                "trafficClasses": [spec.to_dict()
+                                   for spec in self.traffic_classes],
+                "prewarm": self.prewarm}
 
     @classmethod
     def from_dict(cls, data: dict[str, Any]) -> "CapacityBudgetSpec":
@@ -466,7 +579,11 @@ class CapacityBudgetSpec:
                        "peakPauseUtilization", 0.85),
                    per_node_capacity=data.get("perNodeCapacity", 8),
                    smoothing=data.get("smoothing", 0.3),
-                   recheck_seconds=data.get("recheckSeconds", 30.0))
+                   recheck_seconds=data.get("recheckSeconds", 30.0),
+                   traffic_classes=[
+                       TrafficClassSpec.from_dict(item)
+                       for item in data.get("trafficClasses", [])],
+                   prewarm=data.get("prewarm", False))
 
     def deep_copy(self) -> "CapacityBudgetSpec":
         return copy.deepcopy(self)

@@ -190,10 +190,23 @@ class CapacityExpectation:
     the node schedulable (unless pre-cordoned) with no phase/wait/
     validation stamp — the patch is crash-atomic, so the event object
     itself must already be clean.
+
+    With ``classes`` armed (name -> ``TrafficClassSpec``) the per-class
+    teeth replace the strict aggregate SLO check:
+
+    - **class-slo**: an interactive class's admission shortfall must be
+      0 at every tick AND no interactive model may be operator-drained
+      dark (zero admitting replicas with every host healthy) — batch
+      classes may degrade within their ``maxShortfallFraction``;
+    - **zero-drop** (armed via ``zero_drop``; enforced by the soak
+      runner over the sim's exact per-session drop records): no
+      operator-attributed dropped generation for ANY class.
     """
 
     static_equivalent: int
     require_modulation: bool = True
+    classes: "Optional[dict]" = None
+    zero_drop: bool = False
 
 
 @dataclass(frozen=True)
@@ -819,7 +832,44 @@ class InvariantMonitor:
         if self.capacity is None:
             return
         self.capacity_samples += 1
-        if load.get("shortfall", 0) > 0:
+        classes = self.capacity.classes
+        if classes:
+            # per-class teeth: strict for interactive, relaxed for
+            # batch — the aggregate strict check would mis-flag the
+            # batch degradation the class SLOs deliberately allow
+            for cls, cell in sorted(
+                    (load.get("perClass") or {}).items()):
+                spec = classes.get(cls)
+                allowed = 0.0
+                if spec is not None and not spec.interactive:
+                    allowed = (spec.max_shortfall_fraction
+                               * cell["target"])
+                # overload/fault excuse: shortfall beyond what even a
+                # perfect (undrained, fault-dead-excluded) fleet could
+                # have served is not a drain decision
+                ref = cell.get("refCapacity")
+                if ref is not None:
+                    allowed += max(0, cell["target"] - ref)
+                if cell["shortfall"] > allowed:
+                    strict = spec is not None and spec.interactive
+                    kind = "strict interactive" if strict \
+                        else "relaxed"
+                    self._violate(
+                        "class-slo", f"class {cls}",
+                        f"offered load {cell['target']} exceeded "
+                        f"placed {cell['inFlight']} by "
+                        f"{cell['shortfall']} generation(s) at t="
+                        f"{load['now']:g} (allowed {allowed:g}) — "
+                        f"the {kind} class SLO was breached")
+            dark = load.get("interactiveDarkOperator", 0)
+            if dark:
+                self._violate(
+                    "class-slo", "fleet",
+                    f"{dark} interactive model(s) drained DARK by the "
+                    f"operator at t={load['now']:g} (zero admitting "
+                    f"replicas with every host healthy) — the "
+                    f"sole-replica hold / prewarm arc was bypassed")
+        elif load.get("shortfall", 0) > 0:
             self._violate(
                 "capacity-slo", "fleet",
                 f"offered load {load['target']} exceeded admitting "

@@ -46,6 +46,7 @@ from tpu_operator_libs.api.upgrade_policy import (
     IntOrString,
     MaintenanceWindowSpec,
     PredictorSpec,
+    TrafficClassSpec,
     UpgradePolicySpec,
 )
 from tpu_operator_libs.chaos.injector import (
@@ -72,6 +73,7 @@ from tpu_operator_libs.chaos.serving import (
     DiurnalTrace,
     ServingFleetSim,
     SpikeWindow,
+    assign_traffic,
 )
 from tpu_operator_libs.consts import (
     GKE_NODEPOOL_LABEL,
@@ -275,6 +277,11 @@ class _OperatorIncarnation:
             self.upgrade.with_eviction_gate(
                 ServingDrainGate(serving.resolver))
             self.upgrade.with_serving_signal(serving.source)
+            # the prewarm seams (no-ops unless the policy declares
+            # traffic classes + prewarm): the sim is the serving side
+            # that brings replacement replicas up and retires them
+            self.upgrade.with_prewarm_hooks(
+                serving.prewarm_readiness, serving.prewarm_release)
         rem_provider = CrashingStateProvider(
             cluster, rem_keys, None, clock,  # type: ignore[arg-type]
             sync_timeout=5.0, poll_interval=1.0, fuse=injector.fuse)
@@ -2179,6 +2186,341 @@ def run_budget_soak(seed: int,
         f"{static_eq} (trace peak-safe bound "
         f"{budget_static_equivalent(config, trace)}); "
         f"{monitor.aborts_observed} abort(s); serving "
+        f"{serving.summary()}")
+
+    report = ChaosReport(
+        seed=seed,
+        converged=is_converged,
+        violations=list(monitor.violations),
+        fault_kinds=tuple(sorted(schedule.kinds)),
+        crashes_fired=injector.crashes_fired,
+        leader_handovers=handovers,
+        operator_incarnations=incarnations,
+        watch_gaps=monitor.watch_gaps,
+        total_seconds=clock.now(),
+        steps=steps,
+        reconciles=reconciles,
+        trace=list(monitor.trace),
+        decisions_recorded=monitor.decisions_recorded,
+        explains_probed=monitor.explains_probed)
+    report.report_text = "\n".join(
+        [schedule.describe(), monitor.report(seed=seed)])
+    if not report.ok:
+        logger.error("%s", report.report_text)
+    return report
+
+
+@dataclass
+class HandoverChaosConfig(BudgetChaosConfig):
+    """Knobs of one zero-drop handover (class-aware diurnal replay)
+    episode: the PR 10 budget gate's 256-node serving fleet at TWICE
+    the trace amplitude (trough 0.24 / peak 0.90 vs 0.12 / 0.45), with
+    the fleet split into traffic classes — a handful of SOLE-REPLICA
+    interactive models (the nodes the ranker must hold behind the
+    prewarm arc), replicated interactive pairs, and batch groups. The
+    gate's teeth: ZERO operator-attributed dropped generations for any
+    class (exact, per session id), zero interactive-class SLO breaches
+    attributable to drains, zero prewarm crash residue.
+    """
+
+    trough_util: float = 0.24
+    peak_util: float = 0.9
+    #: Traffic layout (chaos/serving.assign_traffic knobs).
+    interactive_fraction: float = 0.25
+    sole_models: int = 3
+    interactive_replicas: int = 2
+    batch_replicas: int = 8
+    #: Per-class drain deadlines: past these, in-flight sessions hand
+    #: over to a peer replica so the drain can quiesce.
+    interactive_drain_deadline: float = 60.0
+    batch_drain_deadline: float = 30.0
+    #: Batch's relaxed SLO: the shortfall fraction it may absorb.
+    batch_shortfall_fraction: float = 0.3
+    #: Seconds a prewarmed replica warms before passing readiness.
+    prewarm_ready_seconds: float = 20.0
+
+    def traffic_classes(self) -> "dict[str, TrafficClassSpec]":
+        return {
+            "interactive": TrafficClassSpec(
+                name="interactive", interactive=True, min_replicas=1,
+                drain_deadline_seconds=self.interactive_drain_deadline,
+                max_shortfall_fraction=0.0),
+            "batch": TrafficClassSpec(
+                name="batch", interactive=False, min_replicas=1,
+                drain_deadline_seconds=self.batch_drain_deadline,
+                max_shortfall_fraction=self.batch_shortfall_fraction),
+        }
+
+    def assignments(self,
+                    node_names: "list[str]",
+                    ) -> "dict[str, tuple[str, str]]":
+        return assign_traffic(
+            node_names,
+            interactive_fraction=self.interactive_fraction,
+            sole_models=self.sole_models,
+            interactive_replicas=self.interactive_replicas,
+            batch_replicas=self.batch_replicas)
+
+    def upgrade_policy(self) -> UpgradePolicySpec:
+        policy = super().upgrade_policy()
+        policy.capacity.traffic_classes = list(
+            self.traffic_classes().values())
+        policy.capacity.prewarm = True
+        return policy
+
+
+def run_handover_soak(seed: int,
+                      config: Optional[HandoverChaosConfig] = None,
+                      ) -> ChaosReport:
+    """The zero-drop handover gate: the class-aware serving fleet is
+    upgraded end-to-end at 2x the budget gate's traffic under spikes,
+    transient node kills and operator crash-restarts, with the
+    DisruptionCostRanker + prewarm arc + router-side session handover
+    live.
+
+    What the episode proves, via the monitor's invariants plus the
+    runner's own checks:
+
+    - **zero-drop**: not one generation of ANY class was dropped by an
+      operator eviction — checked per SESSION id (exact attribution),
+      not by count;
+    - **class-slo**: the interactive class's admission shortfall was
+      zero at every tick (modulo pure overload/fault, which even an
+      undrained fleet could not have served) and no interactive model
+      was ever operator-drained dark — batch degraded only within its
+      relaxed allowance;
+    - **prewarm residue**: the converged fleet carries not a single
+      prewarm reservation/ready stamp, across every operator crash —
+      aborted prewarms resume or release from durable state alone;
+    - plus the standing legal-transition / max-unavailable /
+      cordon-pairing / decision-audit invariants and full convergence
+      with every prewarmed replica gracefully retired.
+
+    Deterministic in ``seed``.
+    """
+    config = config or HandoverChaosConfig()
+    fleet = FleetSpec(
+        n_slices=config.n_slices,
+        hosts_per_slice=config.hosts_per_slice,
+        pod_recreate_delay=config.pod_recreate_delay,
+        pod_ready_delay=config.pod_ready_delay)
+    cluster, clock, keys = build_fleet(fleet)
+    rem_keys = RemediationKeys()
+    node_names = [n.metadata.name for n in cluster.list_nodes()]
+
+    schedule = FaultSchedule.generate_handover(
+        seed, node_names, horizon=config.horizon)
+    injector = ChaosInjector(cluster, schedule,
+                             lease_namespace=config.lease_namespace,
+                             lease_name=config.lease_name)
+    injector.install()
+    # rollout #2 mid-horizon (the budget gate's rationale): guarantees
+    # write traffic after every armed crash and a second pass through
+    # the hold -> prewarm -> drain arc for every sole-replica model
+    cluster.schedule_at(
+        config.horizon / 2.0,
+        lambda: cluster.bump_daemon_set_revision(NS, "libtpu",
+                                                 FINAL_REVISION))
+    spikes = tuple(SpikeWindow(at=e.at, until=e.until,
+                               factor=e.param / 10.0,
+                               ramp_seconds=60.0)
+                   for e in schedule.by_kind(FAULT_TRAFFIC_SPIKE))
+    trace = DiurnalTrace(seed=seed,
+                         period_seconds=config.diurnal_period,
+                         trough_util=config.trough_util,
+                         peak_util=config.peak_util,
+                         spikes=spikes)
+    classes = config.traffic_classes()
+    serving = ServingFleetSim(
+        cluster, node_names, trace,
+        per_node_capacity=config.per_node_capacity,
+        generation_seconds=config.generation_seconds, seed=seed,
+        classes=classes,
+        assignments=config.assignments(node_names),
+        prewarm_ready_seconds=config.prewarm_ready_seconds)
+
+    upgrade_policy = config.upgrade_policy()
+    remediation_policy = config.remediation_policy()
+    remediation_policy.enable = False
+    from tpu_operator_libs.api.upgrade_policy import (
+        scaled_value_from_int_or_percent,
+    )
+
+    static_eq = scaled_value_from_int_or_percent(
+        upgrade_policy.max_unavailable, config.total_nodes,
+        round_up=True)
+    monitor = InvariantMonitor(
+        cluster=cluster, upgrade_keys=keys, remediation_keys=rem_keys,
+        max_unavailable=upgrade_policy.capacity.max_effective_budget,
+        remediation_max_unavailable=None,
+        max_parallel_upgrades=config.max_parallel_upgrades,
+        capacity=CapacityExpectation(static_equivalent=static_eq,
+                                     classes=classes, zero_drop=True))
+    capacity_log = CapacityLog()
+
+    incarnations = 1
+    handovers = 0
+    reconciles = 0
+    op = _OperatorIncarnation(cluster, clock, keys, rem_keys, config,
+                              injector, identity="operator-1",
+                              serving=serving, monitor=monitor)
+
+    def next_incarnation(reason: str) -> _OperatorIncarnation:
+        nonlocal incarnations
+        incarnations += 1
+        injector.fuse.reset()
+        monitor.trace.append(
+            f"[t={clock.now():g}] operator restart #{incarnations} "
+            f"({reason}) — rebuilding managers from cluster state alone")
+        return _OperatorIncarnation(
+            cluster, clock, keys, rem_keys, config, injector,
+            identity=f"operator-{incarnations}", serving=serving,
+            monitor=monitor)
+
+    def converged() -> bool:
+        try:
+            nodes = cluster.list_nodes()
+            pods = cluster.list_pods(namespace=NS)
+        except (ApiServerError, TimeoutError):
+            return False
+        if len(nodes) != len(node_names):
+            return False
+        for node in nodes:
+            labels = node.metadata.labels
+            if labels.get(keys.state_label) != str(UpgradeState.DONE):
+                return False
+            if node.is_unschedulable() or not node.is_ready():
+                return False
+        runtime = [p for p in pods if p.controller_owner() is not None]
+        if len(runtime) != len(node_names):
+            return False
+        if not all(
+                p.metadata.labels.get(POD_CONTROLLER_REVISION_HASH_LABEL)
+                == FINAL_REVISION and p.is_ready() for p in runtime):
+            return False
+        # the serving fleet must be whole again: every node's endpoint
+        # live and admitting, every prewarmed replica gracefully
+        # retired (no replacement may outlive its incumbent's return)
+        return (len(serving.endpoints) == len(node_names)
+                and not any(ep.draining
+                            for ep in serving.endpoints.values())
+                and not serving.prewarmed)
+
+    steps = 0
+    is_converged = False
+    quiesce_ticks = 0
+    serving.tick(clock.now())
+    monitor.drain()
+    while steps < config.max_steps:
+        steps += 1
+        now = clock.now()
+        was_leading = op.elector.is_leader
+        op.elector.try_acquire_or_renew()
+        if was_leading and not op.elector.is_leader:
+            handovers += 1
+            op = next_incarnation("leader election lost")
+            op.elector.try_acquire_or_renew()
+        if op.elector.is_leader:
+            injector.arm_due_crashes(now)
+            op.nudger.pop_due(now)
+            op.nudger.consume_pending()
+            try:
+                op.remediation.reconcile(NS, dict(RUNTIME_LABELS),
+                                         remediation_policy)
+                op.upgrade.reconcile(NS, dict(RUNTIME_LABELS),
+                                     upgrade_policy)
+                reconciles += 1
+            except OperatorCrash:
+                op = next_incarnation("operator crash mid-reconcile")
+            except BuildStateError:
+                pass
+            except (ApiServerError, ConflictError, NotFoundError):
+                pass
+            if injector.fuse.pending:
+                op = next_incarnation("operator crash (surfaced late)")
+        monitor.drain()
+        load = serving.tick(now)
+        controller = op.upgrade.capacity_controller
+        status = (controller.last_status
+                  if controller is not None else None)
+        monitor.capacity_sample(load, status)
+        capacity_log.record(load, status, classes=classes)
+        monitor.drain()
+        if (now > schedule.last_fault_time
+                and not injector.fuse.armed
+                and not injector.fuse.pending
+                and converged()):
+            quiesce_ticks += 1
+            if quiesce_ticks >= 3:
+                is_converged = True
+                break
+        else:
+            quiesce_ticks = 0
+        clock.advance(config.reconcile_interval)
+        cluster.step()
+        monitor.drain()
+
+    if is_converged:
+        monitor.final_check()
+    else:
+        monitor.violations.append(InvariantViolation(
+            invariant="liveness", at=clock.now(), subject="fleet",
+            detail=f"serving fleet did not converge within "
+                   f"{config.max_steps} steps ({clock.now():g}s "
+                   f"virtual) after the last fault healed at "
+                   f"{schedule.last_fault_time:g}s"))
+
+    # zero-drop, per SESSION: the sim's seed-pure session ids make the
+    # attribution exact — one operator-dropped session is a violation,
+    # named, not counted
+    for record in serving.operator_drop_records():
+        monitor.violations.append(InvariantViolation(
+            invariant="zero-drop", at=record["at"],
+            subject=record["session"],
+            detail=f"session {record['session']} (model "
+                   f"{record['model']}, class {record['class']}) was "
+                   f"dropped by an upgrade eviction — the serving "
+                   f"gate was bypassed or mis-sequenced"))
+    # prewarm crash residue: the converged fleet must carry no
+    # reservation/ready stamp on any node (aborted prewarms resume or
+    # release from durable state alone)
+    if is_converged:
+        try:
+            residue_nodes = cluster.list_nodes()
+        except (ApiServerError, TimeoutError):
+            residue_nodes = []
+        for node in residue_nodes:
+            for key in (keys.prewarm_reservation_annotation,
+                        keys.prewarm_ready_annotation):
+                if key in node.metadata.annotations:
+                    monitor.violations.append(InvariantViolation(
+                        invariant="prewarm-residue", at=clock.now(),
+                        subject=node.metadata.name,
+                        detail=f"converged fleet still carries "
+                               f"{key}="
+                               f"{node.metadata.annotations[key]!r} "
+                               f"— an aborted prewarm left durable "
+                               f"residue"))
+    # harness sanity: the episode must have exercised what it gates
+    if injector.crashes_fired == 0:
+        monitor.violations.append(InvariantViolation(
+            invariant="harness", at=clock.now(), subject="injector",
+            detail="no operator crash fired — the schedule's crash "
+                   "events never detonated"))
+    if serving.prewarms_started == 0:
+        monitor.violations.append(InvariantViolation(
+            invariant="harness", at=clock.now(), subject="serving",
+            detail="no prewarm was ever started — the sole-replica "
+                   "holds never drove the reserve->ready arc, so the "
+                   "gate proved nothing about it"))
+    monitor.trace.append(
+        f"[t={clock.now():g}] handover: effective budget range "
+        f"[{monitor.capacity_effective_min}, "
+        f"{monitor.capacity_effective_max}] vs static {static_eq}; "
+        f"{monitor.aborts_observed} abort(s); "
+        f"{serving.handovers} session handover(s); prewarms "
+        f"{serving.prewarms_started}/{serving.prewarms_ready}/"
+        f"{serving.prewarms_retired} started/ready/retired; serving "
         f"{serving.summary()}")
 
     report = ChaosReport(

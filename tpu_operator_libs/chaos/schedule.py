@@ -338,9 +338,30 @@ class FaultSchedule:
         be indistinguishable from a capacity decision, and this gate
         proves budget modulation + the abort arc, not fault
         compounding (the main soak's job)."""
+        return cls._generate_serving(f"chaos-budget:{seed}", seed,
+                                     node_names, horizon, extra_kinds)
+
+    @classmethod
+    def generate_handover(cls, seed: int, node_names: "list[str]",
+                          horizon: float = 700.0,
+                          extra_kinds: int = 2) -> "FaultSchedule":
+        """Schedule for the zero-drop handover gate: the same fault
+        shape as the budget gate (traffic spikes riding the doubled
+        diurnal trace, transient node kills collapsing serving capacity
+        — including, by the luck of the seed, prewarm spares and
+        sole-replica hosts — operator crashes inside the durable-write
+        path, control-plane faults along for the ride) under its own
+        seed stream, so the two gates never share a fault layout."""
+        return cls._generate_serving(f"chaos-handover:{seed}", seed,
+                                     node_names, horizon, extra_kinds)
+
+    @classmethod
+    def _generate_serving(cls, salt: str, seed: int,
+                          node_names: "list[str]", horizon: float,
+                          extra_kinds: int) -> "FaultSchedule":
         if not node_names:
             raise ValueError("node_names must be non-empty")
-        rng = random.Random(f"chaos-budget:{seed}")
+        rng = random.Random(salt)
         nodes = sorted(node_names)
         events: list[FaultEvent] = []
         # spikes land in the first 60% of the horizon, while drain

@@ -1,7 +1,8 @@
 """Replayed diurnal serving traffic over a simulated fleet.
 
-The traffic-aware budget gate (``runner.run_budget_soak``) and the
-capacity bench (``tools/budget_bench.py``) share this harness: one
+The traffic-aware budget gate (``runner.run_budget_soak``), the
+zero-drop handover gate (``runner.run_handover_soak``) and the capacity
+bench (``tools/budget_bench.py``) share this harness: one
 :class:`~tpu_operator_libs.health.serving_gate.ServingEndpoint` per
 fleet node — the exact seam ``examples/llama_serving_job.DecodeServer``
 fronts its fused decode with — driven by a seeded diurnal QPS curve
@@ -11,6 +12,25 @@ accounting is the serving gate's own: a generation is DROPPED only when
 its endpoint is killed mid-flight, and the harness attributes every
 drop to either the fault schedule (node kill) or the operator
 (mis-sequenced eviction — the count the gate drives to zero).
+
+With ``classes`` the sim becomes the router-side half of the
+traffic-class/prewarm arc (upgrade/handover.py):
+
+- every generation is a SESSION with a seed-pure id
+  (``s<seed>-<seq>``), a model and a traffic class, so drop
+  attribution is exact per session (``drop_records``) instead of
+  count-based;
+- interactive classes are admitted FIRST each tick (the router's
+  priority lane — their shortfall is a class-SLO breach, batch may
+  degrade within its relaxed allowance);
+- a draining endpoint finishes its in-flight generations behind its
+  class's drain deadline; past it the router HANDS sessions OVER to a
+  peer replica of the same model (never drops them), which is how a
+  drain quiesces under sustained load;
+- the prewarm hooks (``prewarm_readiness`` / ``prewarm_release``)
+  bring a replacement replica up on a reserved spare before a
+  sole-replica incumbent drains, and retire it gracefully once the
+  incumbent is back.
 """
 
 from __future__ import annotations
@@ -19,7 +39,7 @@ import heapq
 import math
 import random
 from dataclasses import dataclass, field
-from typing import Optional
+from typing import TYPE_CHECKING, Optional
 
 from tpu_operator_libs.health.serving_gate import ServingEndpoint
 from tpu_operator_libs.k8s.objects import (
@@ -30,6 +50,9 @@ from tpu_operator_libs.k8s.objects import (
     PodSpec,
     PodStatus,
 )
+
+if TYPE_CHECKING:  # pragma: no cover - types only
+    from tpu_operator_libs.api.upgrade_policy import TrafficClassSpec
 
 #: Namespace + label of the decode serving pods the drain evicts.
 SERVING_NS = "workloads"
@@ -104,35 +127,99 @@ class DiurnalTrace:
         return worst
 
 
+def assign_traffic(node_names: "list[str]",
+                   interactive_fraction: float = 0.25,
+                   sole_models: int = 2,
+                   interactive_replicas: int = 2,
+                   batch_replicas: int = 8,
+                   ) -> "dict[str, tuple[str, str]]":
+    """Deterministic node -> (model, class) layout for a class-aware
+    replay: ``sole_models`` interactive models with exactly ONE replica
+    each (the nodes the ranker must hold behind the prewarm arc), the
+    rest of the interactive share in ``interactive_replicas``-sized
+    groups, and everything else batch in ``batch_replicas``-sized
+    groups."""
+    nodes = sorted(node_names)
+    n = len(nodes)
+    n_interactive = max(min(sole_models, n),
+                        round(n * interactive_fraction))
+    out: dict[str, tuple[str, str]] = {}
+    i = 0
+    for k in range(min(sole_models, n_interactive)):
+        out[nodes[i]] = (f"int-solo-{k}", "interactive")
+        i += 1
+    group = 0
+    while i < n_interactive:
+        size = min(interactive_replicas, n_interactive - i)
+        for _ in range(size):
+            out[nodes[i]] = (f"int-{group}", "interactive")
+            i += 1
+        group += 1
+    group = 0
+    while i < n:
+        size = min(batch_replicas, n - i)
+        for _ in range(size):
+            out[nodes[i]] = (f"batch-{group}", "batch")
+            i += 1
+        group += 1
+    return out
+
+
 class ServingFleetSim:
     """One decode endpoint per fleet node, replaying a DiurnalTrace.
 
     Call :meth:`tick` once per harness tick (after ``cluster.step``):
     it completes due generations, reconciles endpoints with the
-    cluster's pod/node reality (evictions, node kills, recoveries) and
-    admits new generations toward the trace's target. All entropy
-    comes from ``seed``.
+    cluster's pod/node reality (evictions, node kills, recoveries),
+    hands sessions over off deadline-expired drains, and admits new
+    sessions toward the trace's target — interactive classes first.
+    All entropy comes from ``seed``; session ids are pure in it.
+
+    ``classes`` (name -> ``TrafficClassSpec``) plus ``assignments``
+    (node -> (model, class); default :func:`assign_traffic`) switch on
+    the class-aware router. Without them the sim replays the classless
+    PR 10 behavior bit for bit.
     """
 
     def __init__(self, cluster: "object", node_names: "list[str]",
                  trace: DiurnalTrace, per_node_capacity: int = 8,
                  generation_seconds: tuple[float, float] = (15.0, 45.0),
-                 seed: int = 0) -> None:
+                 seed: int = 0,
+                 classes: "Optional[dict[str, TrafficClassSpec]]" = None,
+                 assignments: "Optional[dict[str, tuple[str, str]]]"
+                 = None,
+                 prewarm_ready_seconds: float = 20.0) -> None:
         self.cluster = cluster
         self.node_names = sorted(node_names)
         self.trace = trace
         self.per_node_capacity = per_node_capacity
         self.generation_seconds = generation_seconds
+        self.seed = seed
         self._rng = random.Random(f"serving:{seed}")
+        self.classes = dict(classes) if classes else {}
+        if self.classes and assignments is None:
+            assignments = assign_traffic(self.node_names)
+        #: node -> (model, traffic_class); empty in classless mode.
+        self.assignments = dict(assignments or {})
+        self.prewarm_ready_seconds = prewarm_ready_seconds
         #: node -> live endpoint (dead/evicted ones move to retired).
         self.endpoints: dict[str, ServingEndpoint] = {}
         self.retired: list[ServingEndpoint] = []
+        #: spare node -> live prewarm replacement replica.
+        self.prewarmed: dict[str, ServingEndpoint] = {}
+        #: spare node -> {incumbent, model, cls, ready_at, released}.
+        self._prewarm_meta: dict[str, dict] = {}
         #: kill epoch per live endpoint object (guards scheduled
         #: finishes from completing a generation of a killed epoch).
         self._epochs: dict[int, int] = {}
-        #: (finish_at, seq, endpoint, epoch) min-heap.
+        #: [finish_at, seq, endpoint, epoch, session_id] min-heap
+        #: (lists, not tuples: handover re-binds entries in place).
         self._inflight: list = []
         self._seq = 0
+        self._now = 0.0
+        #: endpoint-object id -> virtual time its drain began (the
+        #: class drain-deadline anchor).
+        self._drain_started: dict[int, float] = {}
         self.parked = 0
         #: generations the fleet could not place at their arrival tick
         #: (offered load exceeded admitting capacity) — the operational
@@ -142,6 +229,17 @@ class ServingFleetSim:
         #: a non-quiesced endpoint (the gate drives this to ZERO).
         self.fault_dropped = 0
         self.operator_dropped = 0
+        #: exact per-session drop evidence: {session, model, class,
+        #: cause, at} — the zero-drop invariant's feed (replaces the
+        #: count-based attribution heuristic).
+        self.drop_records: list[dict] = []
+        #: sessions migrated off a deadline-expired drain (completed
+        #: on a peer replica; never dropped).
+        self.handovers = 0
+        #: prewarm lifecycle counters.
+        self.prewarms_started = 0
+        self.prewarms_ready = 0
+        self.prewarms_retired = 0
         for name in self.node_names:
             self._create_endpoint(name)
 
@@ -149,22 +247,79 @@ class ServingFleetSim:
     # wiring into the operator
     # ------------------------------------------------------------------
     def source(self) -> "dict[str, list[ServingEndpoint]]":
-        """The CapacityBudgetController's endpoint source."""
-        return {name: [ep] for name, ep in self.endpoints.items()}
+        """The CapacityBudgetController's / DisruptionCostRanker's
+        endpoint source: primaries plus any prewarm replicas, keyed by
+        hosting node."""
+        out: dict[str, list[ServingEndpoint]] = {
+            name: [ep] for name, ep in self.endpoints.items()}
+        for spare, replica in self.prewarmed.items():
+            out.setdefault(spare, []).append(replica)
+        return out
 
     def resolver(self, node: "object",
                  pods: "list[Pod]") -> "list[ServingEndpoint]":
-        """ServingDrainGate resolver: the node's live endpoint,
+        """ServingDrainGate resolver: the node's live endpoints,
         regardless of which pods the eviction set lists (the decode pod
         is node-local)."""
-        ep = self.endpoints.get(node.metadata.name)
-        return [ep] if ep is not None else []
+        name = node.metadata.name
+        out = []
+        ep = self.endpoints.get(name)
+        if ep is not None:
+            out.append(ep)
+        replica = self.prewarmed.get(name)
+        if replica is not None:
+            out.append(replica)
+        return out
+
+    def prewarm_readiness(self, spare: str, incumbent: str,
+                          model: str, traffic_class: str) -> bool:
+        """PrewarmCoordinator readiness hook: the first call brings the
+        replacement replica up on ``spare`` (draining until it passes
+        readiness at ``prewarm_ready_seconds``); later calls report
+        readiness. Idempotent; False for a dead spare."""
+        replica = self.prewarmed.get(spare)
+        if replica is None:
+            replica = ServingEndpoint(
+                f"prewarm-{spare}", capacity=self.per_node_capacity,
+                traffic_class=traffic_class or "batch", model=model)
+            replica.begin_drain()  # not admitting until ready
+            self.prewarmed[spare] = replica
+            self._epochs[id(replica)] = 0
+            self._prewarm_meta[spare] = {
+                "incumbent": incumbent, "model": model,
+                "cls": traffic_class,
+                "ready_at": self._now + self.prewarm_ready_seconds,
+                "released": False,
+                "became_ready": False,
+            }
+            self.prewarms_started += 1
+            return False
+        meta = self._prewarm_meta[spare]
+        return bool(meta["became_ready"]) and not replica.draining
+
+    def prewarm_release(self, spare: str, incumbent: str) -> None:
+        """PrewarmCoordinator release hook: the incumbent is back —
+        retire the replica GRACEFULLY (drain, hand sessions back, never
+        kill)."""
+        meta = self._prewarm_meta.get(spare)
+        if meta is None:
+            return
+        meta["released"] = True
+        replica = self.prewarmed.get(spare)
+        if replica is not None:
+            replica.begin_drain()
 
     # ------------------------------------------------------------------
     # bookkeeping
     # ------------------------------------------------------------------
     def pod_name(self, node: str) -> str:
         return f"decode-{node}"
+
+    def _endpoint_for(self, node: str) -> ServingEndpoint:
+        model, traffic_class = self.assignments.get(node, ("", "batch"))
+        return ServingEndpoint(self.pod_name(node),
+                               capacity=self.per_node_capacity,
+                               traffic_class=traffic_class, model=model)
 
     def _create_endpoint(self, node: str) -> None:
         self.cluster.add_pod(Pod(
@@ -176,19 +331,32 @@ class ServingFleetSim:
                 phase=PodPhase.RUNNING,
                 container_statuses=[
                     ContainerStatus(name="decode", ready=True)])))
-        ep = ServingEndpoint(self.pod_name(node),
-                             capacity=self.per_node_capacity)
+        ep = self._endpoint_for(node)
         self.endpoints[node] = ep
         self._epochs[id(ep)] = 0
 
+    def _inflight_sessions(self, ep: ServingEndpoint) -> "list[str]":
+        """Session ids currently scheduled on ``ep``'s live epoch."""
+        epoch = self._epochs.get(id(ep))
+        return [entry[4] for entry in self._inflight
+                if entry[2] is ep and entry[3] == epoch]
+
     def _retire(self, node: str, ep: ServingEndpoint,
                 fault: bool) -> None:
+        sessions = self._inflight_sessions(ep)
         dropped = ep.kill()
+        cause = "fault" if fault else "operator"
         if fault:
             self.fault_dropped += dropped
         else:
             self.operator_dropped += dropped
+        for sid in sessions:
+            self.drop_records.append({
+                "session": sid, "model": ep.model,
+                "class": ep.traffic_class, "cause": cause,
+                "at": self._now})
         self._epochs[id(ep)] = self._epochs.get(id(ep), 0) + 1
+        self._drain_started.pop(id(ep), None)
         self.retired.append(ep)
         if self.endpoints.get(node) is ep:
             del self.endpoints[node]
@@ -201,7 +369,9 @@ class ServingFleetSim:
         their endpoint (gate-sequenced evictions find it quiesced —
         zero drops), dead nodes kill theirs (fault drops), recovered
         schedulable+ready nodes get a fresh pod + endpoint (the serving
-        controller rescheduling its replica)."""
+        controller rescheduling its replica). Prewarm replicas die with
+        their host (fault) and retire gracefully once released and
+        quiesced."""
         from tpu_operator_libs.chaos.injector import consume_transient
 
         alive = {p.metadata.name for p in consume_transient(
@@ -218,6 +388,41 @@ class ServingFleetSim:
                 # evicted by the upgrade flow: the gate must have
                 # waited out quiescence, so kill() finds zero in flight
                 self._retire(node, ep, fault=False)
+        for spare, replica in list(self.prewarmed.items()):
+            host = nodes.get(spare)
+            if host is None or not host.is_ready():
+                # the spare died: its replica's in-flight generations
+                # are the fault's losses
+                sessions = self._inflight_sessions(replica)
+                dropped = replica.kill()
+                self.fault_dropped += dropped
+                for sid in sessions:
+                    self.drop_records.append({
+                        "session": sid, "model": replica.model,
+                        "class": replica.traffic_class,
+                        "cause": "fault", "at": self._now})
+                self._epochs[id(replica)] += 1
+                self.retired.append(replica)
+                del self.prewarmed[spare]
+                self._prewarm_meta.pop(spare, None)
+                continue
+            meta = self._prewarm_meta[spare]
+            if not meta["released"] and not meta["became_ready"] \
+                    and self._now >= meta["ready_at"]:
+                # readiness passed: the replica starts admitting (the
+                # coordinator's ready stamp follows on its next probe).
+                # Once-only: a LATER drain of this replica (the gate
+                # drafting its host, or a release) must never be undone
+                replica.resume()
+                meta["became_ready"] = True
+                self.prewarms_ready += 1
+            if meta["released"] and replica.quiesced:
+                # graceful retirement: drained empty, never killed
+                self.retired.append(replica)
+                self._epochs[id(replica)] += 1
+                del self.prewarmed[spare]
+                del self._prewarm_meta[spare]
+                self.prewarms_retired += 1
         for node in self.node_names:
             if node in self.endpoints:
                 continue
@@ -228,49 +433,157 @@ class ServingFleetSim:
             if self.pod_name(node) in alive:
                 # pod object survived (node recovered without an
                 # eviction): replace the killed endpoint in place
-                ep = ServingEndpoint(self.pod_name(node),
-                                     capacity=self.per_node_capacity)
+                ep = self._endpoint_for(node)
                 self.endpoints[node] = ep
                 self._epochs[id(ep)] = 0
             else:
                 self._create_endpoint(node)
 
+    def _handover_pass(self, now: float) -> None:
+        """Router-side session handover: a draining endpoint past its
+        class drain deadline gets its remaining in-flight generations
+        re-bound to peer replicas of the same model (prewarm replicas
+        included). A session with no peer to take it keeps running in
+        place — it is NEVER dropped; the drain simply waits."""
+        if not self.classes:
+            return
+        live: list[ServingEndpoint] = list(self.endpoints.values()) \
+            + list(self.prewarmed.values())
+        # maintain drain anchors
+        for ep in live:
+            if ep.draining:
+                self._drain_started.setdefault(id(ep), now)
+            else:
+                self._drain_started.pop(id(ep), None)
+        for ep in live:
+            if not ep.draining or ep.in_flight == 0:
+                continue
+            spec = self.classes.get(ep.traffic_class)
+            deadline = (spec.drain_deadline_seconds
+                        if spec is not None else 120.0)
+            started = self._drain_started.get(id(ep), now)
+            if now - started < deadline:
+                continue
+            epoch = self._epochs.get(id(ep))
+            for entry in self._inflight:
+                if entry[2] is not ep or entry[3] != epoch:
+                    continue
+                target = self._handover_target(ep, live)
+                if target is None:
+                    continue
+                if not ep.handover() or not target.try_begin():
+                    continue
+                entry[2] = target
+                entry[3] = self._epochs[id(target)]
+                self.handovers += 1
+                if ep.in_flight == 0:
+                    break
+
+    def _handover_target(self, ep: ServingEndpoint,
+                         live: "list[ServingEndpoint]",
+                         ) -> "Optional[ServingEndpoint]":
+        """Least-loaded admitting peer replica of the same model (the
+        session's KV state is model-bound; class-mates of a different
+        model cannot take it)."""
+        candidates = [
+            peer for peer in live
+            if peer is not ep and not peer.draining
+            and peer.model == ep.model
+            and peer.in_flight < self.per_node_capacity]
+        if not candidates:
+            return None
+        return min(candidates, key=lambda e: (e.in_flight, e.name))
+
     def total_in_flight(self) -> int:
-        return sum(ep.in_flight for ep in self.endpoints.values())
+        return (sum(ep.in_flight for ep in self.endpoints.values())
+                + sum(ep.in_flight for ep in self.prewarmed.values()))
 
     def admitting_capacity(self) -> int:
         """Generations the fleet can currently ACCEPT new work toward
         (admitting endpoints only) — the live-capacity side of the
         SLO check."""
         return sum(self.per_node_capacity
-                   for ep in self.endpoints.values() if not ep.draining)
+                   for ep in list(self.endpoints.values())
+                   + list(self.prewarmed.values())
+                   if not ep.draining)
 
     def target_in_flight(self, now: float) -> int:
         fleet_capacity = len(self.node_names) * self.per_node_capacity
         return int(round(self.trace.utilization(now) * fleet_capacity))
 
-    def tick(self, now: float) -> dict:
-        """One replay step; returns the tick's load sample (the
-        monitor's capacity-SLO feed)."""
-        # 1. finish due generations (kill-epoch guarded)
-        while self._inflight and self._inflight[0][0] <= now:
-            _, _, ep, epoch = heapq.heappop(self._inflight)
-            if self._epochs.get(id(ep)) == epoch and ep.in_flight > 0:
-                ep.finish()
-        # 2. reconcile with the cluster (evictions, kills, recoveries)
-        self.sync_with_cluster()
-        # 3. admit toward the trace's target, round-robin over nodes
-        target = self.target_in_flight(now)
+    def _class_shares(self) -> "dict[str, float]":
+        """Each class's share of offered load = its share of assigned
+        node capacity (uniform per-node capacity)."""
+        if not self.classes:
+            return {}
+        counts: dict[str, int] = {}
+        for node in self.node_names:
+            _, cls = self.assignments.get(node, ("", "batch"))
+            counts[cls] = counts.get(cls, 0) + 1
+        total = len(self.node_names)
+        return {cls: count / total for cls, count in counts.items()}
+
+    def _class_reference_capacity(self) -> "dict[str, int]":
+        """Per class: the capacity a PERFECT operator could have
+        admitting right now — every ASSIGNED node whose host is ready
+        (fault-dead hosts excluded; prewarm replicas deliberately not
+        counted — they are the arc's own surplus, and folding them in
+        would let overload shortfall masquerade as drain-caused)."""
+        nodes = {n.metadata.name: n for n in self.cluster.list_nodes()}
+        out: dict[str, int] = {}
+        for node, (_, cls) in self.assignments.items():
+            host = nodes.get(node)
+            if host is not None and host.is_ready():
+                out[cls] = out.get(cls, 0) + self.per_node_capacity
+        return out
+
+    def _interactive_dark_models(self) -> "tuple[int, int]":
+        """(operator-dark, fault-dark) interactive models: models with
+        ZERO admitting replicas right now. Dark is excused as a FAULT
+        when any host is not-ready/missing (the kill explains it);
+        otherwise the operator drained the model dark — the violation
+        the ranker's hold + prewarm arc exists to prevent."""
+        if not self.classes:
+            return 0, 0
+        interactive_models: dict[str, list[str]] = {}
+        for node, (model, cls) in self.assignments.items():
+            spec = self.classes.get(cls)
+            if spec is not None and spec.interactive and model:
+                interactive_models.setdefault(model, []).append(node)
+        nodes = {n.metadata.name: n
+                 for n in self.cluster.list_nodes()}
+        operator_dark = 0
+        fault_dark = 0
+        for model, hosts in sorted(interactive_models.items()):
+            admitting = sum(
+                1 for ep in list(self.endpoints.values())
+                + list(self.prewarmed.values())
+                if ep.model == model and not ep.draining)
+            if admitting > 0:
+                continue
+            faulted = any(
+                nodes.get(host) is None
+                or not nodes[host].is_ready() for host in hosts)
+            if faulted:
+                fault_dark += 1
+            else:
+                operator_dark += 1
+        return operator_dark, fault_dark
+
+    def _admit_class(self, now: float, target: int,
+                     pool: "list[ServingEndpoint]") -> int:
+        """Admit generations toward ``target`` over ``pool``'s class
+        in-flight; returns the unplaced shortfall."""
         lo, hi = self.generation_seconds
-        admitting = [ep for _, ep in sorted(self.endpoints.items())
+        in_flight = sum(ep.in_flight for ep in pool)
+        admitting = [ep for ep in sorted(pool,
+                                         key=lambda e: e.name)
                      if not ep.draining]
-        shortfall = 0
-        while self.total_in_flight() < target:
+        while in_flight < target:
             candidates = [ep for ep in admitting
                           if ep.in_flight < self.per_node_capacity]
             if not candidates:
-                shortfall = target - self.total_in_flight()
-                break
+                return target - in_flight
             # least-loaded first: the router spreads load evenly
             ep = min(candidates, key=lambda e: (e.in_flight, e.name))
             if not ep.try_begin():
@@ -279,17 +592,80 @@ class ServingFleetSim:
                 continue
             duration = self._rng.uniform(lo, hi)
             self._seq += 1
-            heapq.heappush(self._inflight,
-                           (now + duration, self._seq, ep,
-                            self._epochs[id(ep)]))
+            sid = f"s{self.seed}-{self._seq}"
+            heapq.heappush(
+                self._inflight,
+                [now + duration, self._seq, ep,
+                 self._epochs[id(ep)], sid])
+            in_flight += 1
+        return 0
+
+    def tick(self, now: float) -> dict:
+        """One replay step; returns the tick's load sample (the
+        monitor's capacity-SLO feed)."""
+        self._now = now
+        # 1. finish due generations (kill-epoch guarded)
+        while self._inflight and self._inflight[0][0] <= now:
+            entry = heapq.heappop(self._inflight)
+            _, _, ep, epoch, _ = entry
+            if self._epochs.get(id(ep)) == epoch and ep.in_flight > 0:
+                ep.finish()
+        # 2. reconcile with the cluster (evictions, kills, recoveries,
+        #    prewarm replica lifecycle)
+        self.sync_with_cluster()
+        # 3. hand sessions over off deadline-expired drains
+        self._handover_pass(now)
+        # 4. admit toward the trace's target — interactive classes
+        #    first (the router's priority lane), batch fills the rest
+        target = self.target_in_flight(now)
+        per_class: dict[str, dict] = {}
+        shortfall = 0
+        if self.classes:
+            shares = self._class_shares()
+            pools: dict[str, list[ServingEndpoint]] = {}
+            for ep in list(self.endpoints.values()) \
+                    + list(self.prewarmed.values()):
+                pools.setdefault(ep.traffic_class, []).append(ep)
+            ref = self._class_reference_capacity()
+            ordered = sorted(
+                shares,
+                key=lambda cls: (
+                    not getattr(self.classes.get(cls), "interactive",
+                                False), cls))
+            for cls in ordered:
+                cls_target = int(round(target * shares[cls]))
+                pool = pools.get(cls, [])
+                cls_shortfall = self._admit_class(now, cls_target, pool)
+                per_class[cls] = {
+                    "target": cls_target,
+                    "inFlight": sum(ep.in_flight for ep in pool),
+                    "shortfall": cls_shortfall,
+                    # what a perfect operator could have had admitting
+                    # (fault-dead hosts excluded): shortfall beyond
+                    # target - reference is pure overload/fault, not a
+                    # drain decision — the SLO excuses it
+                    "refCapacity": ref.get(cls, 0),
+                }
+                shortfall += cls_shortfall
+            operator_dark, fault_dark = self._interactive_dark_models()
+        else:
+            shortfall = self._admit_class(
+                now, target, list(self.endpoints.values()))
+            operator_dark = fault_dark = 0
         self.unserved += shortfall
-        return {
+        sample = {
             "now": now,
             "target": target,
             "inFlight": self.total_in_flight(),
             "admittingCapacity": self.admitting_capacity(),
             "shortfall": shortfall,
         }
+        if self.classes:
+            sample["perClass"] = per_class
+            sample["interactiveDarkOperator"] = operator_dark
+            sample["interactiveDarkFault"] = fault_dark
+            sample["handovers"] = self.handovers
+        return sample
 
     # ------------------------------------------------------------------
     # accounting
@@ -297,33 +673,51 @@ class ServingFleetSim:
     @property
     def completed(self) -> int:
         return (sum(ep.completed for ep in self.endpoints.values())
+                + sum(ep.completed for ep in self.prewarmed.values())
                 + sum(ep.completed for ep in self.retired))
 
     @property
     def dropped(self) -> int:
         return self.fault_dropped + self.operator_dropped
 
+    def operator_drop_records(self) -> "list[dict]":
+        """Exact per-session operator-attributed drops (empty is the
+        zero-drop guarantee)."""
+        return [rec for rec in self.drop_records
+                if rec["cause"] == "operator"]
+
     def summary(self) -> dict:
-        return {
+        out = {
             "completed": self.completed,
             "operatorDropped": self.operator_dropped,
             "faultDropped": self.fault_dropped,
             "parked": self.parked,
             "unserved": self.unserved,
         }
+        if self.classes:
+            out["handovers"] = self.handovers
+            out["prewarmsStarted"] = self.prewarms_started
+            out["prewarmsReady"] = self.prewarms_ready
+            out["prewarmsRetired"] = self.prewarms_retired
+        return out
 
 
 @dataclass
 class CapacityLog:
     """Per-tick effective-budget/SLO evidence accumulated by a replay
-    (the modulation-proof side of the gate and the bench)."""
+    (the modulation-proof side of the gate and the bench). With classes
+    live it also tracks per-class breach ticks (strict for interactive,
+    relaxed for batch) and the interactive operator-dark count."""
 
     samples: list[dict] = field(default_factory=list)
     effective_min: Optional[int] = None
     effective_max: Optional[int] = None
     slo_breach_ticks: int = 0
+    class_breach_ticks: dict = field(default_factory=dict)
+    interactive_dark_ticks: int = 0
 
-    def record(self, load: dict, status: Optional[dict]) -> None:
+    def record(self, load: dict, status: Optional[dict],
+               classes: "Optional[dict]" = None) -> None:
         sample = dict(load)
         if status is not None:
             sample["effectiveBudget"] = status["effectiveBudget"]
@@ -336,4 +730,17 @@ class CapacityLog:
                                   else max(self.effective_max, eff))
         if load["shortfall"] > 0:
             self.slo_breach_ticks += 1
+        for cls, cell in (load.get("perClass") or {}).items():
+            spec = (classes or {}).get(cls)
+            allowed = 0.0
+            if spec is not None and not spec.interactive:
+                allowed = spec.max_shortfall_fraction * cell["target"]
+            ref = cell.get("refCapacity")
+            if ref is not None:
+                allowed += max(0, cell["target"] - ref)
+            if cell["shortfall"] > allowed:
+                self.class_breach_ticks[cls] = \
+                    self.class_breach_ticks.get(cls, 0) + 1
+        if load.get("interactiveDarkOperator"):
+            self.interactive_dark_ticks += 1
         self.samples.append(sample)

@@ -549,6 +549,30 @@ class UpgradeKeys:
         return f"{self.domain}/{self.driver}-upgrade.trace-id"
 
     @property
+    def prewarm_reservation_annotation(self) -> str:
+        """NODE annotation on a prewarm SPARE:
+        ``<incumbent>:<model>:<class>`` — this already-upgraded node is
+        reserved to bring a replacement serving replica up before the
+        named incumbent's drain is admitted (upgrade/handover.py, the
+        PR 6 reserve→join idiom at serving granularity). The RESERVE
+        stamp: written first, crash-ordered before the ready stamp, so
+        a fresh operator incarnation resumes (or releases) the prewarm
+        from cluster state alone."""
+        return f"{self.domain}/{self.driver}-upgrade.prewarm-reservation"
+
+    @property
+    def prewarm_ready_annotation(self) -> str:
+        """NODE annotation on a prewarm spare:
+        ``<incumbent>:<epoch-seconds>`` stamped once the replacement
+        replica passed readiness. The JOIN stamp: the incumbent's
+        eviction is only admitted while its spare carries this, so a
+        crash between reserve and ready can never let the sole replica
+        drain early. Both prewarm stamps are deleted on ONE merge patch
+        when the incumbent finishes (or the reservation is abandoned) —
+        zero residue, crash-atomic."""
+        return f"{self.domain}/{self.driver}-upgrade.prewarm-ready"
+
+    @property
     def event_reason(self) -> str:
         """Reason string attached to Kubernetes events (util.go:136-139)."""
         return f"{self.driver.upper()}RuntimeUpgrade"

@@ -89,6 +89,15 @@ class RegionHandle:
     namespace: str = "tpu-system"
     ds_name: str = "libtpu"
     utilization: Optional[Callable[[float], float]] = None
+    #: Preferred over the scalar ``utilization`` trace when present:
+    #: a callable returning the region's REAL per-region
+    #: ``CapacityBudgetController.last_status`` block (PR 10;
+    #: ``cluster_status["capacity"]`` shape — utilization, demand,
+    #: headroom, effective/static budget, paused). None (or a call
+    #: returning None — e.g. the controller has not evaluated yet)
+    #: falls back to the scalar signal, so regions upgrade to the
+    #: richer feed one at a time.
+    capacity_status: Optional[Callable[[], Optional[dict]]] = None
     roll: Optional[Callable[[str], None]] = None
 
     def roll_to(self, revision: str) -> None:
@@ -117,6 +126,9 @@ class RegionView:
     quarantined: frozenset = frozenset()
     bake_stamp: str = ""
     utilization: Optional[float] = None
+    #: The region's live capacity picture when its handle exposes the
+    #: real controller status block (None = scalar-signal region).
+    capacity: Optional[dict] = None
 
     def done_on(self, revision: str) -> bool:
         """Region fully converged on ``revision``: DS points at it,
@@ -280,6 +292,30 @@ class FederationController:
         for name in fleet:
             view = views[name]
             if view.utilization is None:
+                # the REAL per-region capacity-controller status block
+                # wins over the scalar utilization trace: it is the
+                # same number the region's own admission decisions ran
+                # on this pass, plus the demand/headroom/paused context
+                # surfaced in the region status below
+                status_source = self.regions[name].capacity_status
+                if status_source is not None:
+                    try:
+                        status = status_source()
+                    except Exception:  # noqa: BLE001 — a broken
+                        status = None  # signal must not wedge a pass
+                    if status is not None:
+                        view.capacity = {
+                            key: status.get(key)
+                            for key in ("utilization", "demand",
+                                        "headroom",
+                                        "capacityAvailable",
+                                        "effectiveBudget",
+                                        "staticBudget", "paused")}
+                        utilization = status.get("utilization")
+                        if utilization is not None:
+                            view.utilization = max(
+                                0.0, min(1.0, float(utilization)))
+            if view.utilization is None:
                 signal = self.regions[name].utilization
                 if signal is not None:
                     try:
@@ -327,6 +363,7 @@ class FederationController:
                     "unavailable": view.unavailable,
                     "share": view.share,
                     "utilization": view.utilization,
+                    "capacity": view.capacity,
                     "phase": self._phase(view, canary,
                                          target_revision, halted,
                                          baked),
@@ -500,8 +537,17 @@ class FederationController:
         most one more wait window, never violating safety)."""
         if not self.policy.follow_the_sun or view.utilization is None:
             return True
-        if view.utilization <= self.policy.trough_utilization:
+        paused = (view.capacity is not None
+                  and bool(view.capacity.get("paused")))
+        if not paused \
+                and view.utilization <= self.policy.trough_utilization:
             return True
+        # A region whose OWN capacity controller is hard-pausing at
+        # peak is never "in trough" regardless of the utilization
+        # number — the richer status block vetoes the threshold, while
+        # the bounded wait still guarantees liveness (admission only
+        # rolls the DS; the region's controller keeps modulating its
+        # internal waves after the wait expires).
         started = self._trough_wait_started.setdefault(
             view.name, now)
         return now - started >= self.policy.max_trough_wait_seconds

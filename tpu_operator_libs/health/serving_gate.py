@@ -32,6 +32,7 @@ contract the gate needs is only admitting/draining + an in-flight count.
 from __future__ import annotations
 
 import logging
+import re
 import threading
 from typing import Callable
 
@@ -39,16 +40,47 @@ from tpu_operator_libs.k8s.objects import Node, Pod
 
 logger = logging.getLogger(__name__)
 
+#: DNS-label shape a traffic-class name must take (mirrors
+#: api/upgrade_policy._CLASS_NAME_RE — the gate is importable without
+#: the policy layer, so the pattern is duplicated by design).
+_CLASS_NAME_RE = re.compile(r"^[a-z0-9]([a-z0-9-]{0,61}[a-z0-9])?$")
+
 
 class ServingEndpoint:
     """Admission control + in-flight accounting for one decode server.
 
     Thread-safe: the upgrade controller drains from its reconcile
     thread while request handlers begin/finish generations concurrently.
+
+    ``traffic_class`` and ``model`` are the disruption-cost signals the
+    :class:`~tpu_operator_libs.upgrade.handover.DisruptionCostRanker`
+    ranks drain candidates by: endpoints of a batch class (or of a
+    well-replicated model) are cheap to disrupt, the sole admitting
+    replica of an interactive model is held behind the prewarm arc.
+    Both are validated at construction — a malformed class name or a
+    non-positive capacity must fail HERE, not misbehave passes later
+    inside the budget math.
     """
 
     def __init__(self, name: str,
-                 capacity: "int | None" = None) -> None:
+                 capacity: "int | None" = None,
+                 traffic_class: str = "batch",
+                 model: str = "") -> None:
+        if not isinstance(name, str) or not name:
+            raise ValueError("ServingEndpoint name must be a non-empty "
+                             "string")
+        if capacity is not None:
+            if isinstance(capacity, bool) \
+                    or not isinstance(capacity, int) or capacity < 1:
+                raise ValueError(
+                    f"ServingEndpoint {name}: capacity must be a "
+                    f"positive integer or None, got {capacity!r}")
+        if not isinstance(traffic_class, str) \
+                or not _CLASS_NAME_RE.match(traffic_class):
+            raise ValueError(
+                f"ServingEndpoint {name}: traffic_class "
+                f"{traffic_class!r} is malformed (must be a lowercase "
+                f"DNS label)")
         self.name = name
         #: Concurrent generations this endpoint sustains — the per-node
         #: capacity signal the traffic-aware budget controller
@@ -56,6 +88,12 @@ class ServingEndpoint:
         #: the controller's policy default (capacityBudget.
         #: perNodeCapacity) applies.
         self.capacity = capacity
+        #: Traffic class this endpoint serves (matches a
+        #: TrafficClassSpec name; "batch" = the cheap default).
+        self.traffic_class = traffic_class
+        #: Model identity for replication counting ("" = unscoped: the
+        #: endpoint never counts as anyone's sole replica).
+        self.model = model
         self._lock = threading.Lock()
         self._draining = False
         self._in_flight = 0
@@ -63,6 +101,10 @@ class ServingEndpoint:
         #: Generations aborted mid-flight (the metric the gate drives
         #: to zero; killed pods abort their in-flight handles).
         self.dropped = 0
+        #: Generations the router migrated OFF this endpoint to a peer
+        #: replica (session handover past the class drain deadline) —
+        #: they completed elsewhere, not here, and were never dropped.
+        self.handed_over = 0
 
     # -- request side ---------------------------------------------------
     def try_begin(self) -> bool:
@@ -92,6 +134,18 @@ class ServingEndpoint:
             self._in_flight = 0
             self._draining = True
             return dropped
+
+    def handover(self) -> bool:
+        """The router re-bound one in-flight generation to a peer
+        replica: it leaves this endpoint's accounting WITHOUT counting
+        as completed or dropped (the receiving endpoint's ``try_begin``
+        picks it up). False when nothing was in flight to move."""
+        with self._lock:
+            if self._in_flight <= 0:
+                return False
+            self._in_flight -= 1
+            self.handed_over += 1
+            return True
 
     # -- upgrade side ---------------------------------------------------
     def begin_drain(self) -> None:

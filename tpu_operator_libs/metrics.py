@@ -1096,6 +1096,38 @@ def observe_capacity(registry: MetricsRegistry,
     registry.set_counter_total(
         "capacity_pause_passes_total", controller.pause_passes_total,
         "Passes admission was paused at peak utilization", labels)
+    if status is not None:
+        for cls, cell in (status.get("classes") or {}).items():
+            class_labels = {**labels, "class": cls}
+            registry.set_gauge(
+                "capacity_class_in_flight", cell["inFlight"],
+                "In-flight generations per traffic class",
+                class_labels)
+            registry.set_gauge(
+                "capacity_class_capacity_admitting",
+                cell["capacityAdmitting"],
+                "Admitting serving capacity per traffic class "
+                "(generations)", class_labels)
+    ranker = getattr(manager, "cost_ranker", None)
+    if ranker is not None:
+        registry.set_counter_total(
+            "capacity_rank_holds_total", ranker.holds_total,
+            "Disruption-cost ranker holds (sole-replica interactive "
+            "nodes parked behind the prewarm arc)", labels)
+        registry.set_counter_total(
+            "capacity_ranked_passes_total", ranker.ranked_passes_total,
+            "Planner passes that ran class-aware drain ordering",
+            labels)
+    prewarm = getattr(manager, "prewarm_coordinator", None)
+    if prewarm is not None:
+        for phase, count in (
+                ("reserved", prewarm.reservations_total),
+                ("ready", prewarm.ready_total),
+                ("released", prewarm.released_total)):
+            registry.set_counter_total(
+                "capacity_prewarm_total", count,
+                "Prewarm arc transitions (reserve -> ready -> "
+                "release), by phase", {**labels, "phase": phase})
     for seconds in controller.drain_abort_durations():
         registry.observe_histogram(
             "capacity_abort_seconds", seconds,

@@ -163,6 +163,9 @@ class CapacityBudgetController:
         completed = 0
         capacity_available = 0
         capacity_total = 0
+        # per-traffic-class picture (the class-SLO/status feed; empty
+        # for endpoints predating the traffic_class field)
+        classes: dict[str, dict] = {}
         for _, eps in endpoints:
             if not eps:
                 continue
@@ -171,12 +174,22 @@ class CapacityBudgetController:
             admitting = False
             for ep in eps:
                 declared = getattr(ep, "capacity", None)
-                node_capacity += (declared if declared
-                                  else per_node_default)
+                ep_capacity = (declared if declared
+                               else per_node_default)
+                node_capacity += ep_capacity
                 in_flight += ep.in_flight
                 completed += ep.completed
                 if not ep.draining:
                     admitting = True
+                cls_name = getattr(ep, "traffic_class", "")
+                if cls_name:
+                    cell = classes.setdefault(
+                        cls_name, {"endpoints": 0, "inFlight": 0,
+                                   "capacityAdmitting": 0})
+                    cell["endpoints"] += 1
+                    cell["inFlight"] += ep.in_flight
+                    if not ep.draining:
+                        cell["capacityAdmitting"] += ep_capacity
             capacity_total += node_capacity
             if admitting:
                 available_nodes += 1
@@ -266,6 +279,8 @@ class CapacityBudgetController:
             "sloBreached": slo_breached,
             "abortsTotal": self.aborts_total + self.window_aborts_total,
             "sloBreachTicksTotal": self.slo_breach_ticks_total,
+            "classes": {name: dict(cell)
+                        for name, cell in sorted(classes.items())},
         }
         if effective != static_budget:
             logger.info(

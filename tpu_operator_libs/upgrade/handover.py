@@ -1,0 +1,492 @@
+"""Traffic-class-aware drain ordering + prewarmed session handover.
+
+PR 10's capacity budget made the disruption budget breathe with live
+serving load, but its signal is fleet-level: the budget knows HOW MANY
+nodes may drain, not WHICH — a trough-time wave can condemn the only
+replica of a hot interactive model while idle batch nodes sit
+untouched. This module closes that gap with two cooperating pieces:
+
+- :class:`DisruptionCostRanker` — a planner layer (outermost, the PR 9
+  ``PredictiveWavePlanner`` idiom: a persistent wrapper that reorders
+  and filters candidates while every budget/slice/canary admission
+  decision stays with the inner chain). Each pass it rebuilds the live
+  serving picture from the same endpoint source the
+  ``CapacityBudgetController`` reads and ranks drain candidates by
+  disruption cost: non-serving nodes first, then batch-only nodes,
+  then interactive nodes whose models stay replicated, then
+  sole-replica batch nodes — and it HOLDS a node whose drain would
+  leave an interactive model below its class's ``minReplicas``
+  admitting replicas, with an audited reason
+  (``sole-replica-interactive`` / ``awaiting-prewarm``).
+- :class:`PrewarmCoordinator` — the PR 6 reserve→join idiom at serving
+  granularity. Before a held incumbent may drain, an already-upgraded
+  spare (upgrade-done, ready, schedulable — typically a just-finished
+  node of the same wave) is RESERVED with a durable node annotation,
+  the deployment's readiness hook brings a replacement replica up on
+  it, and a second durable stamp records readiness. Both stamps ride
+  the crash-fused provider write path, reserve strictly before ready,
+  so a mid-prewarm operator crash resumes (or releases) the prewarm
+  from cluster state alone — and both are deleted on ONE merge patch
+  when the incumbent finishes, leaving zero residue.
+
+The hold lifts through the LIVE picture: once the replacement replica
+is admitting, the incumbent is no longer its model's sole replica and
+ranks like any other interactive node. Router-side session handover
+(the serving deployment's half; ``chaos/serving.ServingFleetSim`` is
+the reference implementation) then re-binds the incumbent's sessions
+to the replacement behind the class drain deadline, so the drain
+quiesces without dropping a single generation.
+
+Fail-open contract: with no endpoint source, an empty source, or no
+declared traffic classes the ranker is never installed (or degrades to
+a single pass-through ``inner.plan`` call) — class-blind fleets keep
+PR 10 behavior bit for bit.
+"""
+
+from __future__ import annotations
+
+import logging
+from typing import TYPE_CHECKING, Callable, Optional
+
+from tpu_operator_libs.consts import IN_PROGRESS_STATES, UpgradeState
+from tpu_operator_libs.util import Clock
+
+if TYPE_CHECKING:  # pragma: no cover - types only
+    from tpu_operator_libs.api.upgrade_policy import TrafficClassSpec
+    from tpu_operator_libs.consts import UpgradeKeys
+    from tpu_operator_libs.upgrade.state_manager import (
+        ClusterUpgradeState,
+        NodeUpgradeState,
+        UpgradePlanner,
+    )
+    from tpu_operator_libs.upgrade.state_provider import (
+        NodeUpgradeStateProvider,
+    )
+
+logger = logging.getLogger(__name__)
+
+#: (spare, incumbent, model, traffic_class) -> replacement replica is
+#: up AND passing readiness. The deployment seam: called once per pass
+#: per reservation; the first call is also the "start the replica"
+#: signal (idempotent on the serving side).
+ReadinessHook = Callable[[str, str, str, str], bool]
+
+#: (spare, incumbent) -> the serving side may retire the replacement
+#: replica (gracefully — drain it, never kill it).
+ReleaseHook = Callable[[str, str], None]
+
+#: (kind, node, decision, rule, inputs) recorder — the manager wires
+#: this into its DecisionAudit so every hold/prewarm decision explains
+#: itself.
+AuditHook = Callable[[str, str, str, str, dict], None]
+
+#: Hold rules the ranker emits (also the explain-chain vocabulary).
+HOLD_SOLE_REPLICA = "sole-replica-interactive"
+HOLD_AWAITING_PREWARM = "awaiting-prewarm"
+
+
+class _Reservation:
+    """One durable prewarm reservation, rehydrated from node
+    annotations each pass (the coordinator holds no in-memory truth)."""
+
+    __slots__ = ("spare", "incumbent", "model", "traffic_class",
+                 "ready", "spare_node")
+
+    def __init__(self, spare: str, incumbent: str, model: str,
+                 traffic_class: str, ready: bool,
+                 spare_node: "object") -> None:
+        self.spare = spare
+        self.incumbent = incumbent
+        self.model = model
+        self.traffic_class = traffic_class
+        self.ready = ready
+        self.spare_node = spare_node
+
+
+class PrewarmCoordinator:
+    """Crash-ordered reserve→ready→release of prewarm spares.
+
+    Stateless-durable: every pass re-derives its reservations from
+    node annotations alone, so an operator crash (or shard takeover)
+    at ANY point mid-prewarm resumes without residue — the worst case
+    is one repeated readiness probe.
+    """
+
+    def __init__(self, provider: "NodeUpgradeStateProvider",
+                 keys: "UpgradeKeys",
+                 clock: Optional[Clock] = None,
+                 readiness: Optional[ReadinessHook] = None,
+                 release: Optional[ReleaseHook] = None,
+                 audit: Optional[AuditHook] = None) -> None:
+        self.provider = provider
+        self.keys = keys
+        self._clock = clock or Clock()
+        self.readiness = readiness
+        self.release = release
+        self.audit = audit
+        #: lifetime counters (metrics.observe_capacity feed)
+        self.reservations_total = 0
+        self.ready_total = 0
+        self.released_total = 0
+
+    # ------------------------------------------------------------------
+    # durable-state scan
+    # ------------------------------------------------------------------
+    def reservations(self, state: "ClusterUpgradeState",
+                     ) -> "dict[str, _Reservation]":
+        """incumbent -> reservation, from the snapshot's annotations."""
+        out: dict[str, _Reservation] = {}
+        reserve_key = self.keys.prewarm_reservation_annotation
+        ready_key = self.keys.prewarm_ready_annotation
+        for node in state.all_nodes():
+            value = node.metadata.annotations.get(reserve_key)
+            if not value:
+                continue
+            incumbent, _, rest = value.partition(":")
+            model, _, traffic_class = rest.partition(":")
+            ready_stamp = node.metadata.annotations.get(ready_key, "")
+            out[incumbent] = _Reservation(
+                spare=node.metadata.name, incumbent=incumbent,
+                model=model, traffic_class=traffic_class,
+                ready=ready_stamp.startswith(f"{incumbent}:"),
+                spare_node=node)
+        return out
+
+    def _audit(self, node: str, decision: str, rule: str,
+               inputs: dict) -> None:
+        if self.audit is not None:
+            self.audit("prewarm", node, decision, rule, inputs)
+
+    # ------------------------------------------------------------------
+    # the per-hold drive
+    # ------------------------------------------------------------------
+    def ensure(self, incumbent: str, model: str, traffic_class: str,
+               state: "ClusterUpgradeState") -> str:
+        """Drive one incumbent's prewarm a step; returns the arc state
+        (``reserved`` / ``warming`` / ``ready`` / ``unavailable``).
+
+        Idempotent per pass: an existing healthy reservation is only
+        probed for readiness; a dead spare's reservation is released
+        and a fresh spare reserved (the transient-node-kill path)."""
+        live = self.reservations(state)
+        reservation = live.get(incumbent)
+        if reservation is not None:
+            node = reservation.spare_node
+            if not node.is_ready():
+                # the spare died mid-prewarm: abandon its stamps (one
+                # patch) and fall through to reserve a replacement
+                self._release_one(reservation, rule="spare-lost")
+            else:
+                return self._probe(reservation)
+        spare = self._pick_spare(incumbent, state,
+                                 reserved={r.spare
+                                           for r in live.values()})
+        if spare is None:
+            return "unavailable"
+        value = f"{incumbent}:{model}:{traffic_class}"
+        self.provider.change_node_upgrade_annotations(
+            spare, {self.keys.prewarm_reservation_annotation: value})
+        self.reservations_total += 1
+        self._audit(spare.metadata.name, "reserve", "prewarm-reserve",
+                    {"incumbent": incumbent, "model": model,
+                     "class": traffic_class})
+        logger.info(
+            "prewarm: reserved spare %s for incumbent %s "
+            "(model %s, class %s)", spare.metadata.name, incumbent,
+            model, traffic_class)
+        # first readiness probe doubles as the start-the-replica signal
+        self._probe(_Reservation(
+            spare=spare.metadata.name, incumbent=incumbent,
+            model=model, traffic_class=traffic_class, ready=False,
+            spare_node=spare))
+        return "reserved"
+
+    def _probe(self, reservation: _Reservation) -> str:
+        if reservation.ready:
+            return "ready"
+        if self.readiness is None:
+            return "warming"
+        try:
+            ready = bool(self.readiness(
+                reservation.spare, reservation.incumbent,
+                reservation.model, reservation.traffic_class))
+        except Exception as exc:  # noqa: BLE001 — deployment seam: a
+            # broken hook must park the prewarm, never wedge the pass
+            logger.warning("prewarm readiness hook raised for spare "
+                           "%s: %s", reservation.spare, exc)
+            return "warming"
+        if not ready:
+            return "warming"
+        stamp = f"{reservation.incumbent}:{self._clock.now():g}"
+        self.provider.change_node_upgrade_annotations(
+            reservation.spare_node,
+            {self.keys.prewarm_ready_annotation: stamp})
+        self.ready_total += 1
+        self._audit(reservation.spare, "ready", "prewarm-ready",
+                    {"incumbent": reservation.incumbent,
+                     "model": reservation.model})
+        logger.info("prewarm: spare %s ready for incumbent %s",
+                    reservation.spare, reservation.incumbent)
+        return "ready"
+
+    def _pick_spare(self, incumbent: str,
+                    state: "ClusterUpgradeState",
+                    reserved: "set[str]") -> "Optional[object]":
+        """Deterministic spare choice: the first upgrade-done, ready,
+        schedulable, unreserved node by name — typically a
+        just-finished node of the same wave."""
+        reserve_key = self.keys.prewarm_reservation_annotation
+        candidates = [
+            ns.node for ns in state.bucket(UpgradeState.DONE)
+            if ns.node.metadata.name != incumbent
+            and ns.node.metadata.name not in reserved
+            and ns.node.is_ready()
+            and not ns.node.is_unschedulable()
+            and reserve_key not in ns.node.metadata.annotations]
+        if not candidates:
+            return None
+        return min(candidates, key=lambda n: n.metadata.name)
+
+    # ------------------------------------------------------------------
+    # release
+    # ------------------------------------------------------------------
+    def sweep(self, state: "ClusterUpgradeState") -> None:
+        """Release reservations whose incumbent finished (or vanished):
+        the incumbent is back serving its model, so the replacement
+        replica may retire. Run every pass — this is also the
+        crash-residue sweep: a fresh incarnation releases stamps its
+        predecessor died holding."""
+        by_name: dict[str, str] = {}
+        for label, bucket in state.node_states.items():
+            for ns in bucket:
+                by_name[ns.node.metadata.name] = label
+        done = str(UpgradeState.DONE)
+        for reservation in self.reservations(state).values():
+            incumbent_state = by_name.get(reservation.incumbent)
+            if incumbent_state is None or incumbent_state == done:
+                self._release_one(reservation, rule="incumbent-done")
+                continue
+            spare_state = by_name.get(reservation.spare)
+            if spare_state != done:
+                # the spare was drafted into a new rollout (a revision
+                # bump re-marked it): it can no longer host a stable
+                # replacement replica — release so a fresh spare can
+                # be reserved
+                self._release_one(reservation, rule="spare-recycled")
+
+    def _release_one(self, reservation: _Reservation,
+                     rule: str) -> None:
+        """Delete BOTH prewarm stamps on one merge patch (crash-atomic:
+        there is no window where only one remains)."""
+        self.provider.change_node_upgrade_annotations(
+            reservation.spare_node,
+            {self.keys.prewarm_reservation_annotation: None,
+             self.keys.prewarm_ready_annotation: None})
+        self.released_total += 1
+        self._audit(reservation.spare, "release", rule,
+                    {"incumbent": reservation.incumbent,
+                     "model": reservation.model})
+        if self.release is not None:
+            try:
+                self.release(reservation.spare, reservation.incumbent)
+            except Exception as exc:  # noqa: BLE001 — deployment seam
+                logger.warning("prewarm release hook raised for spare "
+                               "%s: %s", reservation.spare, exc)
+        logger.info("prewarm: released spare %s (incumbent %s, %s)",
+                    reservation.spare, reservation.incumbent, rule)
+
+
+class DisruptionCostRanker:
+    """Spend the disruption budget on the cheapest serving disruption
+    first; hold sole-replica interactive nodes behind the prewarm arc.
+
+    Wraps the planner chain OUTERMOST and keeps every admission
+    decision with the inner chain: candidates are bucketed into cost
+    tiers and the inner planner is invoked tier by tier with the
+    remaining budget, so cheap tiers are exhausted before expensive
+    ones regardless of how the inner chain (LPT, slice atomicity,
+    canary cohort) orders within a tier.
+    """
+
+    #: tier indices (for status/tests)
+    TIER_IDLE = 0          # serving nothing
+    TIER_CHEAP = 1         # batch-only, replication preserved
+    TIER_INTERACTIVE = 2   # interactive served, replication preserved
+    TIER_SOLE_BATCH = 3    # would leave a relaxed-SLO model dark
+
+    def __init__(self, inner: "UpgradePlanner",
+                 source: "Callable[[], dict]",
+                 classes: "dict[str, TrafficClassSpec]",
+                 prewarm: Optional[PrewarmCoordinator] = None,
+                 audit: Optional[AuditHook] = None) -> None:
+        self.inner = inner
+        self._source = source
+        self.classes = classes
+        self.prewarm = prewarm
+        self.audit = audit
+        #: node -> (rule, inputs) of the most recent pass's holds —
+        #: consumed by the audit wrapper and the explain chain.
+        self.last_holds: "dict[str, tuple[str, dict]]" = {}
+        #: Status block of the most recent ranked plan
+        #: (cluster_status["capacity"]["ranker"] feed).
+        self.last_rank: Optional[dict] = None
+        #: lifetime counters (metrics feed)
+        self.holds_total = 0
+        self.ranked_passes_total = 0
+
+    def _sample(self) -> "Optional[dict[str, list]]":
+        try:
+            mapping = self._source()
+        except Exception as exc:  # noqa: BLE001 — signal boundary:
+            # a broken source degrades to class-blind, never wedges
+            logger.warning("disruption ranker endpoint source raised "
+                           "(%s); planning class-blind", exc)
+            return None
+        return dict(mapping) if mapping else None
+
+    def _class(self, name: str) -> "object":
+        spec = self.classes.get(name)
+        if spec is not None:
+            return spec
+        from tpu_operator_libs.api.upgrade_policy import (
+            TrafficClassSpec,
+        )
+
+        # an endpoint declaring an unlisted class ranks as a relaxed
+        # (non-interactive) class with the default replication floor
+        return TrafficClassSpec(name=name)
+
+    def plan(self, candidates: "list[NodeUpgradeState]", available: int,
+             state: "ClusterUpgradeState") -> "list[NodeUpgradeState]":
+        mapping = self._sample()
+        if mapping is None:
+            # fail open: no serving signal, class-blind inner plan
+            self.last_holds = {}
+            self.last_rank = None
+            return self.inner.plan(candidates, available, state)
+        self.ranked_passes_total += 1
+        # Replicas on nodes already COMMITTED to going down must not
+        # count toward a model's replication: a node in cordon-required
+        # still admits until the gate flips it, yet its drain is
+        # already decided — counting it would let a replicated pair's
+        # second member drain in the very next wave and darken the
+        # model (the SlicePlanner's committed_down rule, per model).
+        committed_down = {
+            ns.node.metadata.name
+            for st in IN_PROGRESS_STATES
+            for ns in state.bucket(st)}
+        # model -> admitting replica count over endpoints that are
+        # neither draining nor on a committed-down node (prewarmed
+        # replacement replicas included — that is exactly how a
+        # completed prewarm lifts its hold)
+        model_admitting: dict[str, int] = {}
+        for node_name, endpoints in mapping.items():
+            if node_name in committed_down:
+                continue
+            for ep in endpoints:
+                if ep.model and not ep.draining:
+                    model_admitting[ep.model] = \
+                        model_admitting.get(ep.model, 0) + 1
+
+        # first sweep: cost tiers from class/in-flight alone
+        tiers: "list[list[NodeUpgradeState]]" = [[], [], [], []]
+        load: dict[str, int] = {}
+        for ns in candidates:
+            name = ns.node.metadata.name
+            endpoints = mapping.get(name) or ()
+            tier = self.TIER_IDLE
+            in_flight = 0
+            for ep in endpoints:
+                in_flight += ep.in_flight
+                spec = self._class(ep.traffic_class)
+                if getattr(spec, "interactive", False):
+                    if tier < self.TIER_INTERACTIVE:
+                        tier = self.TIER_INTERACTIVE
+                elif tier < self.TIER_CHEAP:
+                    tier = self.TIER_CHEAP
+                if ep.model and not ep.draining \
+                        and not getattr(spec, "interactive", False) \
+                        and model_admitting.get(ep.model, 0) - 1 \
+                        < spec.min_replicas:
+                    tier = self.TIER_SOLE_BATCH
+            load[name] = in_flight
+            tiers[tier].append(ns)
+        # within a tier, fewer live generations drain cheaper; the
+        # sort is stable so equal loads keep the candidates' input
+        # order (cold tier == inner order, the PR 9 degradation rule)
+        for bucket in tiers:
+            bucket.sort(key=lambda ns: load[ns.node.metadata.name])
+
+        # second sweep, tier by tier: the replication-floor check runs
+        # SEQUENTIALLY with optimistic decrements, so two replicas of
+        # one model can never pass the floor in the same plan — the
+        # second is held this pass and re-evaluated once the first is
+        # done (worst case: one deferred wave, never a dark model).
+        holds: "dict[str, tuple[str, dict]]" = {}
+        selected: "list[NodeUpgradeState]" = []
+        remaining = available
+        for bucket in tiers:
+            eligible: "list[NodeUpgradeState]" = []
+            for ns in bucket:
+                name = ns.node.metadata.name
+                hold = self._floor_hold(name, mapping.get(name) or (),
+                                        model_admitting, state)
+                if hold is not None:
+                    holds[name] = hold
+                    continue
+                for ep in mapping.get(name) or ():
+                    if ep.model and not ep.draining:
+                        model_admitting[ep.model] = \
+                            model_admitting.get(ep.model, 0) - 1
+                eligible.append(ns)
+            if not eligible:
+                continue
+            picked = self.inner.plan(eligible, max(0, remaining), state)
+            selected.extend(picked)
+            remaining -= sum(
+                1 for ns in picked if not ns.node.is_unschedulable())
+        for name, hold in holds.items():
+            if hold != self.last_holds.get(name):
+                # audit on rule/arc CHANGE only (the dedup the
+                # DecisionAudit hold path applies, kept here so a
+                # pass-stable hold is one fact, not one per pass)
+                self.holds_total += 1
+                if self.audit is not None:
+                    self.audit("hold", name, "hold", hold[0], hold[1])
+                logger.info(
+                    "disruption ranker holding node %s: %s (%s)",
+                    name, hold[0], hold[1])
+        self.last_holds = holds
+        self.last_rank = {
+            "tiers": [len(bucket) for bucket in tiers],
+            "held": len(holds),
+            "selected": len(selected),
+        }
+        return selected
+
+    def _floor_hold(self, name: str, endpoints: "tuple | list",
+                    model_admitting: "dict[str, int]",
+                    state: "ClusterUpgradeState",
+                    ) -> "Optional[tuple[str, dict]]":
+        """(rule, inputs) when draining ``name`` now would take an
+        interactive model below its class replication floor; drives
+        the prewarm arc for the held model. None = free to drain."""
+        for ep in endpoints:
+            if not ep.model or ep.draining:
+                continue
+            spec = self._class(ep.traffic_class)
+            if not getattr(spec, "interactive", False):
+                continue
+            others = model_admitting.get(ep.model, 0) - 1
+            if others >= spec.min_replicas:
+                continue
+            arc = "none"
+            if self.prewarm is not None:
+                arc = self.prewarm.ensure(
+                    name, ep.model, spec.name, state)
+            rule = (HOLD_AWAITING_PREWARM
+                    if arc in ("reserved", "warming")
+                    else HOLD_SOLE_REPLICA)
+            return rule, {"model": ep.model, "class": spec.name,
+                          "prewarm": arc}
+        return None

@@ -219,9 +219,11 @@ class _AuditingPlanner:
         # this loop is O(fleet) every pass
         rollout = manager._rollout
         deferred = manager.multislice_deferred_slices
+        ranker = manager._cost_ranker
+        ranker_holds = ranker.last_holds if ranker is not None else {}
         uniform_rule = None
         if not rollout.halted and not rollout.canary_active \
-                and not deferred:
+                and not deferred and not ranker_holds:
             # the common regime: every held candidate blocks on the
             # same rule, so a steady pass with no admissions and an
             # unchanged (rule, candidate count) repeats facts the
@@ -253,6 +255,10 @@ class _AuditingPlanner:
                 rule = "rollout-halt"
             elif rollout.canary_active and name not in rollout.cohort:
                 rule = "canary-cohort"
+            elif name in ranker_holds:
+                # the ranker already recorded the rich record (model/
+                # class/prewarm arc); the shared rule dedups this one
+                rule = ranker_holds[name][0]
             elif deferred and manager._node_pool(ns.node) in deferred:
                 rule = "multislice-budget"
             else:
@@ -354,6 +360,17 @@ class ClusterUpgradeStateManager:
         #: :meth:`with_serving_signal`; without one the controller
         #: fails open to the static budget exactly.
         self._capacity_source = None
+        # ---- traffic-class drain ordering + prewarm (handover.py) ----
+        #: Persistent DisruptionCostRanker wrapper; created on first
+        #: use from a policy declaring capacityBudget.trafficClasses
+        #: (its last_holds feed the audit wrapper + explain chain).
+        self._cost_ranker = None
+        #: Persistent PrewarmCoordinator (stateless-durable — every
+        #: pass re-derives reservations from node annotations).
+        self._prewarm = None
+        #: Deployment hooks installed via :meth:`with_prewarm_hooks`.
+        self._prewarm_readiness = None
+        self._prewarm_release = None
         #: Optional (kind, node, at, reason) hook for every mid-flight
         #: abort admission/completion — the chaos harness's
         #: abort-invariant feed (kind: "abort" | "aborted").
@@ -1506,6 +1523,12 @@ class ClusterUpgradeStateManager:
         # and every budget/slice admission decision stay with the inner
         # chain untouched.
         planner = self._wrap_predictive(policy, planner)
+        # Disruption-cost ranker outermost of the semantic chain
+        # (DisruptionCostRanker ∘ Predictive ∘ ...): buckets candidates
+        # into serving-cost tiers, exhausts cheap tiers first, and
+        # holds sole-replica interactive nodes behind the prewarm arc
+        # — every budget decision still lands in the inner chain.
+        planner = self._wrap_cost_ranker(policy, planner)
         if obs is not None:
             # the pass's slot math, with the winning rule: the record
             # every parked node's explain chain hangs off
@@ -1552,6 +1575,12 @@ class ClusterUpgradeStateManager:
         self.process_uncordon_required_nodes(state)
         self._eager_slot_refill(state, policy, planner, max_unavailable,
                                 max_parallel, capacity=capacity)
+        # Prewarm release sweep: reservations whose incumbent finished
+        # are released (both stamps, one patch) — also the crash-residue
+        # sweep, since a fresh incarnation re-derives reservations from
+        # node annotations alone.
+        if self._prewarm is not None:
+            self._prewarm.sweep(state)
         # Gate-parked nodes that left every eviction-wanting state this
         # pass (policy flipped drain off, node recovered or vanished) are
         # handed back to the gate's release hook so e.g. serving
@@ -1763,6 +1792,85 @@ class ClusterUpgradeStateManager:
         """The persistent CapacityBudgetController (None until a
         capacity-enabled policy ran)."""
         return self._capacity
+
+    def with_prewarm_hooks(
+            self, readiness: "object",
+            release: "object" = None) -> "ClusterUpgradeStateManager":
+        """Install the deployment's prewarm seams (upgrade/handover.py):
+        ``readiness(spare, incumbent, model, cls) -> bool`` brings the
+        replacement replica up (first call) and reports when it passes
+        readiness; ``release(spare, incumbent)`` (optional) lets the
+        serving side retire the replica once the incumbent finished."""
+        self._prewarm_readiness = readiness
+        self._prewarm_release = release
+        if self._prewarm is not None:
+            self._prewarm.readiness = readiness
+            self._prewarm.release = release
+        return self
+
+    @property
+    def cost_ranker(self) -> "object":
+        """The persistent DisruptionCostRanker (None until a policy
+        with trafficClasses ran with a wired serving signal)."""
+        return self._cost_ranker
+
+    @property
+    def prewarm_coordinator(self) -> "object":
+        """The persistent PrewarmCoordinator (None until a prewarm-
+        enabled policy ran)."""
+        return self._prewarm
+
+    def _wrap_cost_ranker(self, policy: UpgradePolicySpec,
+                          inner: UpgradePlanner) -> UpgradePlanner:
+        """Wrap ``inner`` in the DisruptionCostRanker when the policy
+        declares traffic classes AND a serving signal is wired;
+        otherwise clear any stale holds and return ``inner`` unchanged
+        (class-blind fleets keep PR 10 semantics bit for bit)."""
+        spec = policy.capacity
+        active = (spec is not None and spec.enable
+                  and bool(spec.traffic_classes)
+                  and self._capacity_source is not None)
+        if not active:
+            if self._cost_ranker is not None:
+                self._cost_ranker.last_holds = {}
+                self._cost_ranker.last_rank = None
+            return inner
+        from tpu_operator_libs.upgrade.handover import (
+            DisruptionCostRanker,
+            PrewarmCoordinator,
+        )
+
+        if spec.prewarm and self._prewarm is None:
+            self._prewarm = PrewarmCoordinator(
+                self.provider, self.keys, clock=self.clock,
+                readiness=self._prewarm_readiness,
+                release=self._prewarm_release,
+                audit=self._prewarm_audit_hook)
+        if self._cost_ranker is None:
+            self._cost_ranker = DisruptionCostRanker(
+                inner, self._capacity_source, spec.class_map(),
+                prewarm=self._prewarm if spec.prewarm else None,
+                audit=self._ranker_audit_hook)
+        ranker = self._cost_ranker
+        ranker.inner = inner
+        ranker._source = self._capacity_source
+        ranker.classes = spec.class_map()
+        ranker.prewarm = self._prewarm if spec.prewarm else None
+        return ranker
+
+    def _ranker_audit_hook(self, kind: str, node: str, decision: str,
+                           rule: str, inputs: dict) -> None:
+        """Rich hold record (model/class/prewarm arc) — the audit
+        wrapper's later generic hold for the same node dedups against
+        it on the shared rule."""
+        if self._obs is not None:
+            self._obs.audit.record_hold(node, rule, inputs=inputs)
+
+    def _prewarm_audit_hook(self, kind: str, node: str, decision: str,
+                            rule: str, inputs: dict) -> None:
+        if self._obs is not None:
+            self._obs.audit.record(kind, node, decision=decision,
+                                   rule=rule, inputs=inputs)
 
     def _capacity_for_policy(self, policy: UpgradePolicySpec) -> "object":
         """The controller for this pass, created/refreshed from the
@@ -2736,6 +2844,22 @@ class ClusterUpgradeStateManager:
             # capacity, the effective budget the throttle actually
             # spent, and the abort/SLO accounting
             status["capacity"] = dict(self._capacity.last_status)
+            if self._cost_ranker is not None \
+                    and self._cost_ranker.last_rank is not None:
+                # the class-aware drain picture: per-tier candidate
+                # counts and the sole-replica holds of the last plan
+                ranker_block = dict(self._cost_ranker.last_rank)
+                ranker_block["holds"] = {
+                    node: rule for node, (rule, _)
+                    in sorted(self._cost_ranker.last_holds.items())}
+                status["capacity"]["ranker"] = ranker_block
+            if self._prewarm is not None:
+                status["capacity"]["prewarm"] = {
+                    "reservationsTotal":
+                        self._prewarm.reservations_total,
+                    "readyTotal": self._prewarm.ready_total,
+                    "releasedTotal": self._prewarm.released_total,
+                }
         if self._shard_view is not None and self.last_shard_status:
             # the sharded-control-plane picture: which shards this
             # replica owns, the fleet-wide per-shard node census, and
@@ -3059,6 +3183,15 @@ class ClusterUpgradeStateManager:
                 f"canary wave in flight ({len(self._rollout.cohort)} "
                 f"cohort node(s)): admissions restricted to the "
                 f"cohort until the bake passes")
+        ranker = self._cost_ranker
+        if ranker is not None and name in ranker.last_holds:
+            rule, hold_inputs = ranker.last_holds[name]
+            chain.append(
+                f"held by disruption-cost ranker: {rule} — draining "
+                f"would leave model {hold_inputs.get('model')!r} "
+                f"(class {hold_inputs.get('class')}) below its "
+                f"replication floor; prewarm arc: "
+                f"{hold_inputs.get('prewarm')}")
         latest = obs.audit.records_for(name, limit=5) \
             if obs is not None else []
         for rec in latest:
