@@ -4,7 +4,7 @@ PYTHON ?= python3
 PYTEST_FLAGS ?= -q
 COV_THRESHOLD ?= 85
 
-.PHONY: all check test test-fast test-fault test-chaos test-soak test-scale test-rollout test-latency test-reconfig test-shard test-planner test-budget test-handover test-obs test-federation lint cov bench bench-reconcile bench-latency bench-shard bench-shard-100k bench-planner bench-budget bench-obs bench-federation graft-check package clean diagram
+.PHONY: all check test test-fast test-fault test-chaos test-soak test-scale test-rollout test-latency test-reconfig test-shard test-planner test-budget test-handover test-obs test-federation test-policy test-dag lint cov bench bench-reconcile bench-latency bench-shard bench-shard-100k bench-planner bench-budget bench-obs bench-federation graft-check package clean diagram
 
 all: lint test
 
@@ -62,6 +62,7 @@ lint:
 	$(PYTHON) tools/lint.py
 	$(PYTHON) tools/metrics_lint.py
 	$(PYTHON) tools/marker_lint.py
+	$(PYTHON) tools/policy_lint.py
 	@if $(PYTHON) -c "import ruff" 2>/dev/null; then \
 		$(PYTHON) -m ruff check tpu_operator_libs tools tests examples; \
 	elif $(PYTHON) -c "import pyflakes" 2>/dev/null; then \
@@ -201,6 +202,24 @@ test-federation:
 # docs/benchmarks.md §2i). Writes BENCH_federation.json.
 bench-federation:
 	$(PYTHON) tools/federation_bench.py --out BENCH_federation.json
+
+# Declarative policy-engine slice (`policy` marker): the sandboxed
+# expression language, the hook registry's fail-closed/fail-open
+# contract, spec/CRD validation, the park-not-wedge property (an
+# erroring or over-budget program parks its node, audited, explain()
+# non-empty — never a crashed pass), and policy_lint self-checks.
+test-policy:
+	$(PYTHON) -m pytest tests/ $(PYTEST_FLAGS) -m "policy and not slow"
+
+# Multi-artifact upgrade-DAG slice (`dag` marker): ArtifactDAGSpec
+# validation (cycle/unknown-dep rejection), the coordinator's
+# dependency-ordered advance with crash-ordered stamps, quarantine +
+# dependent-suffix rollback, crash-mid-DAG resume, and the seeded DAG
+# chaos gate (run_dag_soak: compound faults + a node kill + a bad
+# mid-DAG artifact revision; always-on dag-order/policy-sandbox
+# invariants). Seeds 1-3 tier-1, 4-10 slow (the standing convention).
+test-dag:
+	$(PYTHON) -m pytest tests/ $(PYTEST_FLAGS) -m "dag and not slow"
 
 # Upgrade-journey tracing + decision-audit slice (`obs` marker):
 # tracer/audit units, explain-under-sharding incl. the handover

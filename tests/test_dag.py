@@ -1,0 +1,512 @@
+"""Multi-artifact upgrade DAGs: spec validation, the coordinator's
+dependency-ordered advance with crash-ordered stamps, quarantine +
+dependent-suffix rollback, crash-mid-DAG resume, and the seeded DAG
+chaos gate (ISSUE 15)."""
+
+import os
+
+import pytest
+
+from tpu_operator_libs.api.policy_spec import (
+    ArtifactDAGSpec,
+    ArtifactSpec,
+)
+from tpu_operator_libs.api.upgrade_policy import (
+    DrainSpec,
+    PolicyValidationError,
+    UpgradePolicySpec,
+)
+from tpu_operator_libs.consts import (
+    POD_CONTROLLER_REVISION_HASH_LABEL,
+    UpgradeState,
+)
+from tpu_operator_libs.simulate import (
+    NS,
+    RUNTIME_LABELS,
+    FleetSpec,
+    build_fleet,
+    seed_artifact_daemon_sets,
+)
+from tpu_operator_libs.upgrade.state_manager import (
+    ClusterUpgradeStateManager,
+)
+
+pytestmark = pytest.mark.dag
+
+ARTIFACT_LABELS = {
+    "device-plugin": {"app": "tpu-device-plugin"},
+    "network-driver": {"app": "tpu-network-driver"},
+    "os-image": {"app": "node-os-image"},
+}
+ALL_ARTIFACTS = ("libtpu", "device-plugin", "network-driver", "os-image")
+
+
+def diamond_spec(failure_threshold: int = 2) -> ArtifactDAGSpec:
+    """The canonical >=3-artifact diamond: libtpu -> {device-plugin,
+    network-driver} -> os-image."""
+    return ArtifactDAGSpec(
+        enable=True, failure_threshold=failure_threshold,
+        artifacts=[
+            ArtifactSpec(name="libtpu",
+                         runtime_labels=dict(RUNTIME_LABELS)),
+            ArtifactSpec(name="device-plugin",
+                         runtime_labels=ARTIFACT_LABELS["device-plugin"],
+                         depends_on=["libtpu"]),
+            ArtifactSpec(name="network-driver",
+                         runtime_labels=ARTIFACT_LABELS["network-driver"],
+                         depends_on=["libtpu"]),
+            ArtifactSpec(name="os-image",
+                         runtime_labels=ARTIFACT_LABELS["os-image"],
+                         depends_on=["device-plugin", "network-driver"]),
+        ])
+
+
+def dag_policy(**kwargs) -> UpgradePolicySpec:
+    policy = UpgradePolicySpec(
+        auto_upgrade=True, max_parallel_upgrades=0,
+        max_unavailable="50%",
+        drain=DrainSpec(enable=True, force=True),
+        artifact_dag=kwargs.pop("dag", diamond_spec()), **kwargs)
+    policy.validate()
+    return policy
+
+
+# ---------------------------------------------------------------------------
+# spec validation (the CRD admission path)
+# ---------------------------------------------------------------------------
+class TestArtifactDAGSpec:
+    def test_diamond_validates_and_orders(self):
+        spec = diamond_spec()
+        spec.validate()
+        order = [a.name for a in spec.topo_order()]
+        assert order[0] == "libtpu" and order[-1] == "os-image"
+        assert set(order[1:3]) == {"device-plugin", "network-driver"}
+
+    def test_cycle_rejected(self):
+        spec = ArtifactDAGSpec(enable=True, artifacts=[
+            ArtifactSpec(name="a", runtime_labels={"app": "a"},
+                         depends_on=["c"]),
+            ArtifactSpec(name="b", runtime_labels={"app": "b"},
+                         depends_on=["a"]),
+            ArtifactSpec(name="c", runtime_labels={"app": "c"},
+                         depends_on=["b"]),
+        ])
+        with pytest.raises(PolicyValidationError, match="cycle"):
+            spec.validate()
+
+    def test_self_dependency_rejected(self):
+        with pytest.raises(PolicyValidationError, match="itself"):
+            ArtifactSpec(name="a", runtime_labels={"app": "a"},
+                         depends_on=["a"]).validate()
+
+    def test_unknown_dependency_rejected(self):
+        spec = ArtifactDAGSpec(enable=True, artifacts=[
+            ArtifactSpec(name="a", runtime_labels={"app": "a"},
+                         depends_on=["ghost"])])
+        with pytest.raises(PolicyValidationError, match="unknown"):
+            spec.validate()
+
+    def test_duplicate_artifact_rejected(self):
+        spec = ArtifactDAGSpec(enable=True, artifacts=[
+            ArtifactSpec(name="a", runtime_labels={"app": "a"}),
+            ArtifactSpec(name="a", runtime_labels={"app": "b"})])
+        with pytest.raises(PolicyValidationError, match="duplicate"):
+            spec.validate()
+
+    @pytest.mark.parametrize("bad", ["", "-x", "x-", "Has.Caps"])
+    def test_bad_name_rejected(self, bad):
+        with pytest.raises(PolicyValidationError, match="name"):
+            ArtifactSpec(name=bad,
+                         runtime_labels={"app": "x"}).validate()
+
+    def test_missing_labels_rejected(self):
+        with pytest.raises(PolicyValidationError, match="runtimeLabels"):
+            ArtifactSpec(name="a").validate()
+
+    @pytest.mark.parametrize("threshold", [0, -1, True])
+    def test_threshold_bounds(self, threshold):
+        with pytest.raises(PolicyValidationError, match="Threshold"):
+            ArtifactDAGSpec(failure_threshold=threshold).validate()
+
+    def test_dependents_of_transitive(self):
+        spec = diamond_spec()
+        assert spec.dependents_of("libtpu") == [
+            "device-plugin", "network-driver", "os-image"]
+        assert spec.dependents_of("network-driver") == ["os-image"]
+        assert spec.dependents_of("os-image") == []
+
+    def test_round_trip(self):
+        spec = diamond_spec()
+        restored = ArtifactDAGSpec.from_dict(spec.to_dict())
+        assert restored == spec
+
+    def test_rides_upgrade_policy_round_trip(self):
+        policy = dag_policy()
+        restored = UpgradePolicySpec.from_dict(policy.to_dict())
+        assert restored.artifact_dag == policy.artifact_dag
+
+    def test_crd_schema_validates_dag_block(self):
+        from tpu_operator_libs.api.crd import (
+            upgrade_policy_schema,
+            validate_against_schema,
+        )
+
+        schema = upgrade_policy_schema()
+        validate_against_schema(
+            {"artifactDAG": diamond_spec().to_dict()}, schema)
+        with pytest.raises(PolicyValidationError):
+            validate_against_schema(
+                {"artifactDAG": {"artifacts": [{"name": "x"}]}},
+                schema)  # runtimeLabels required
+
+    def test_crd_defaults_applied(self):
+        from tpu_operator_libs.api.crd import (
+            apply_defaults,
+            upgrade_policy_schema,
+        )
+
+        out = apply_defaults({"artifactDAG": {}},
+                             upgrade_policy_schema())
+        assert out["artifactDAG"]["enable"] is False
+        assert out["artifactDAG"]["failureThreshold"] == 1
+
+
+# ---------------------------------------------------------------------------
+# coordinator end to end (the declarative scenario, no operator code)
+# ---------------------------------------------------------------------------
+def _build(n_slices=2, hosts=2):
+    fleet = FleetSpec(n_slices=n_slices, hosts_per_slice=hosts,
+                      pod_recreate_delay=5, pod_ready_delay=10)
+    cluster, clock, keys = build_fleet(fleet)
+    seed_artifact_daemon_sets(cluster, ARTIFACT_LABELS,
+                              revision_hash="old")
+    for name in ARTIFACT_LABELS:
+        cluster.bump_daemon_set_revision(NS, name, "new")
+    mgr = ClusterUpgradeStateManager(cluster, keys, clock=clock,
+                                     async_workers=False)
+    return cluster, clock, keys, mgr
+
+
+def _run(cluster, clock, mgr, policy, steps):
+    for _ in range(steps):
+        mgr.reconcile(NS, dict(RUNTIME_LABELS), policy)
+        clock.advance(10)
+        cluster.step()
+
+
+def _stamps(cluster, keys):
+    return {n.metadata.name: {
+        a: n.metadata.annotations.get(keys.artifact_stamp_prefix + a)
+        for a in ALL_ARTIFACTS}
+        for n in cluster.list_nodes()}
+
+
+def _all_done(cluster, keys):
+    return all(n.metadata.labels.get(keys.state_label)
+               == str(UpgradeState.DONE) for n in cluster.list_nodes())
+
+
+class TestDagCoordinator:
+    def test_diamond_completes_in_one_shared_cycle(self):
+        cluster, clock, keys, mgr = _build()
+        policy = dag_policy()
+        _run(cluster, clock, mgr, policy, 40)
+        assert _all_done(cluster, keys)
+        for per_node in _stamps(cluster, keys).values():
+            assert all(rev == "new" for rev in per_node.values())
+        dag = mgr.dag_coordinator
+        nodes = len(cluster.list_nodes())
+        # exactly one pod advance per non-primary artifact per node:
+        # ONE shared cordon/drain cycle drove all of them
+        assert dag.pods_advanced_total == 3 * nodes
+        assert dag.stamps_total == 4 * nodes
+        assert dag.quarantines_total == 0
+        # every artifact pod at target and ready
+        for labels in ARTIFACT_LABELS.values():
+            pods = [p for p in cluster.list_pods(namespace=NS)
+                    if p.metadata.labels.get("app") == labels["app"]]
+            assert len(pods) == nodes
+            assert all(p.metadata.labels.get(
+                POD_CONTROLLER_REVISION_HASH_LABEL) == "new"
+                and p.is_ready() for p in pods)
+
+    def test_stamps_respect_dependency_order_at_every_instant(self):
+        cluster, clock, keys, mgr = _build()
+        policy = dag_policy()
+        deps = {a.name: tuple(a.depends_on)
+                for a in policy.artifact_dag.artifacts}
+        for _ in range(40):
+            mgr.reconcile(NS, dict(RUNTIME_LABELS), policy)
+            for per_node in _stamps(cluster, keys).values():
+                for artifact, revision in per_node.items():
+                    if revision is None:
+                        continue
+                    for dep in deps[artifact]:
+                        assert per_node[dep] is not None, (
+                            f"{artifact} stamped before {dep}")
+            clock.advance(10)
+            cluster.step()
+        assert _all_done(cluster, keys)
+
+    def test_artifact_only_bump_drives_one_more_cycle(self):
+        cluster, clock, keys, mgr = _build()
+        policy = dag_policy()
+        _run(cluster, clock, mgr, policy, 40)
+        assert _all_done(cluster, keys)
+        # bump ONLY the device plugin: no primary out-of-sync signal
+        cluster.bump_daemon_set_revision(NS, "device-plugin", "dp2")
+        _run(cluster, clock, mgr, policy, 40)
+        assert _all_done(cluster, keys)
+        for per_node in _stamps(cluster, keys).values():
+            assert per_node["device-plugin"] == "dp2"
+            assert per_node["os-image"] == "new"
+        dag = mgr.dag_coordinator
+        assert dag.upgrade_requests_total >= len(cluster.list_nodes())
+
+    def test_crash_mid_dag_resumes_from_stamps_alone(self):
+        cluster, clock, keys, mgr = _build()
+        policy = dag_policy()
+        # run until SOME stamps exist but convergence has not happened
+        for _ in range(50):
+            mgr.reconcile(NS, dict(RUNTIME_LABELS), policy)
+            clock.advance(10)
+            cluster.step()
+            stamped = sum(1 for per_node in _stamps(cluster,
+                                                    keys).values()
+                          for rev in per_node.values() if rev)
+            if stamped and not _all_done(cluster, keys):
+                break
+        assert not _all_done(cluster, keys)
+        # the "crash": a brand-new manager with zero in-memory state
+        fresh = ClusterUpgradeStateManager(cluster, keys, clock=clock,
+                                           async_workers=False)
+        _run(cluster, clock, fresh, policy, 40)
+        assert _all_done(cluster, keys)
+        for per_node in _stamps(cluster, keys).values():
+            assert all(rev == "new" for rev in per_node.values())
+
+    def test_bad_revision_quarantines_and_contains_suffix(self):
+        cluster, clock, keys, mgr = _build(n_slices=3)
+        bad = "badart"
+        cluster.add_pod_ready_gate(lambda pod: not (
+            pod.metadata.labels.get("app") == "tpu-network-driver"
+            and pod.metadata.labels.get(
+                POD_CONTROLLER_REVISION_HASH_LABEL) == bad))
+
+        def bumps():
+            cluster.bump_daemon_set_revision(NS, "libtpu", "new2")
+            cluster.bump_daemon_set_revision(NS, "device-plugin",
+                                             "new2")
+            cluster.bump_daemon_set_revision(NS, "network-driver", bad)
+            cluster.bump_daemon_set_revision(NS, "os-image", "new2")
+
+        cluster.schedule_at(300.0, bumps)
+        policy = dag_policy(dag=diamond_spec(failure_threshold=2))
+        seen_os_image = set()
+        for _ in range(200):
+            mgr.reconcile(NS, dict(RUNTIME_LABELS), policy)
+            clock.advance(10)
+            cluster.step()
+            for pod in cluster.list_pods(namespace=NS):
+                if pod.metadata.labels.get("app") == "node-os-image":
+                    seen_os_image.add(pod.metadata.labels.get(
+                        POD_CONTROLLER_REVISION_HASH_LABEL))
+            if clock.now() > 320 and _all_done(cluster, keys):
+                targets = {"libtpu": "new2", "device-plugin": "new2",
+                           "network-driver": "new", "os-image": "new"}
+                if all(per_node == targets for per_node
+                       in _stamps(cluster, keys).values()):
+                    break
+        else:
+            pytest.fail(f"bad-revision arc did not converge: "
+                        f"{_stamps(cluster, keys)}")
+        dag = mgr.dag_coordinator
+        assert dag.quarantines_total == 1
+        assert dag.suffix_rollbacks_total == 1  # os-image only
+        # the condemned suffix never rolled FORWARD
+        assert "new2" not in seen_os_image
+        # the quarantine record is durable on the condemned DS
+        nd = cluster.list_daemon_sets(NS, "app=tpu-network-driver")[0]
+        assert nd.metadata.annotations.get(
+            keys.quarantined_revision_annotation) == bad
+        # the NON-dependent artifact kept rolling forward
+        dp_pods = [p for p in cluster.list_pods(namespace=NS)
+                   if p.metadata.labels.get("app")
+                   == "tpu-device-plugin"]
+        assert all(p.metadata.labels.get(
+            POD_CONTROLLER_REVISION_HASH_LABEL) == "new2"
+            for p in dp_pods)
+
+    def test_explain_names_pending_artifacts_while_parked(self):
+        cluster, clock, keys, mgr = _build()
+        policy = dag_policy()
+        for _ in range(60):
+            mgr.reconcile(NS, dict(RUNTIME_LABELS), policy)
+            validating = [
+                n.metadata.name for n in cluster.list_nodes()
+                if n.metadata.labels.get(keys.state_label)
+                == str(UpgradeState.VALIDATION_REQUIRED)]
+            if validating:
+                result = mgr.explain(validating[0])
+                assert result["blocking"]
+                assert any("artifact DAG" in reason
+                           for reason in result["blocking"])
+                break
+            clock.advance(10)
+            cluster.step()
+        else:
+            pytest.fail("no node ever parked in validation")
+
+    def test_cluster_status_carries_dag_block(self):
+        cluster, clock, keys, mgr = _build()
+        policy = dag_policy()
+        _run(cluster, clock, mgr, policy, 5)
+        state = mgr.build_state(NS, dict(RUNTIME_LABELS))
+        status = mgr.cluster_status(state)
+        assert "artifactDAG" in status
+        assert set(status["artifactDAG"]["artifacts"]) == set(
+            ALL_ARTIFACTS)
+        assert status["artifactDAG"]["artifacts"]["os-image"][
+            "dependsOn"] == ["device-plugin", "network-driver"]
+
+    def test_observe_policy_exports_dag_counters(self):
+        from tpu_operator_libs.metrics import (
+            MetricsRegistry,
+            observe_policy,
+        )
+
+        cluster, clock, keys, mgr = _build()
+        _run(cluster, clock, mgr, dag_policy(), 40)
+        registry = MetricsRegistry()
+        observe_policy(registry, mgr)
+        text = registry.render_prometheus()
+        assert "tpu_upgrade_policy_dag_stamps_total" in text
+        assert "tpu_upgrade_policy_dag_pods_advanced_total" in text
+
+
+# ---------------------------------------------------------------------------
+# the standing chaos gate (seeds 1-3 tier-1, 4-10 slow)
+# ---------------------------------------------------------------------------
+GATE_SEEDS = tuple(range(1, 11))
+TIER1_SEEDS = GATE_SEEDS[:3]
+
+
+def _assert_ok(report):
+    assert report.ok, (
+        f"DAG soak seed={report.seed} failed; replay with "
+        f"run_dag_soak(seed={report.seed})\n{report.report_text}")
+
+
+class TestDagChaosGate:
+    @pytest.mark.parametrize("seed", TIER1_SEEDS)
+    def test_seed_converges_with_zero_violations(self, seed):
+        from tpu_operator_libs.chaos.runner import run_dag_soak
+
+        report = run_dag_soak(seed)
+        _assert_ok(report)
+        assert report.crashes_fired >= 1
+        assert report.decisions_recorded > 0
+        assert report.explains_probed > 0
+
+    @pytest.mark.slow
+    @pytest.mark.parametrize("seed", GATE_SEEDS[3:])
+    def test_slow_seed_converges_with_zero_violations(self, seed):
+        from tpu_operator_libs.chaos.runner import run_dag_soak
+
+        report = run_dag_soak(seed)
+        _assert_ok(report)
+
+    def test_dag_order_monitor_catches_out_of_order_stamp(self):
+        """Teeth check: a stamp written before its dependency's stamp
+        MUST trip the dag-order invariant."""
+        from tpu_operator_libs.chaos.invariants import (
+            DagExpectation,
+            InvariantMonitor,
+        )
+
+        fleet = FleetSpec(n_slices=1, hosts_per_slice=2)
+        cluster, clock, keys = build_fleet(fleet)
+        monitor = InvariantMonitor(
+            cluster=cluster, upgrade_keys=keys,
+            dag=DagExpectation(
+                deps={"libtpu": (), "device-plugin": ("libtpu",)},
+                stamp_prefix=keys.artifact_stamp_prefix,
+                apps={"libtpu": "libtpu",
+                      "tpu-device-plugin": "device-plugin"},
+                runtime_namespace=NS))
+        name = cluster.list_nodes()[0].metadata.name
+        cluster.patch_node_annotations(
+            name, {keys.artifact_stamp_prefix + "device-plugin": "new"})
+        monitor.drain()
+        assert any(v.invariant == "dag-order"
+                   for v in monitor.violations)
+
+    def test_forbidden_revision_monitor_catches_suffix_breach(self):
+        from tpu_operator_libs.chaos.invariants import (
+            DagExpectation,
+            InvariantMonitor,
+        )
+        from tpu_operator_libs.k8s.objects import (
+            ContainerStatus,
+            ObjectMeta,
+            Pod,
+            PodPhase,
+            PodSpec,
+            PodStatus,
+        )
+
+        fleet = FleetSpec(n_slices=1, hosts_per_slice=2)
+        cluster, clock, keys = build_fleet(fleet)
+        monitor = InvariantMonitor(
+            cluster=cluster, upgrade_keys=keys,
+            dag=DagExpectation(
+                deps={"os-image": ()},
+                stamp_prefix=keys.artifact_stamp_prefix,
+                apps={"node-os-image": "os-image"},
+                runtime_namespace=NS,
+                forbidden=(("os-image", "new2"),)))
+        name = cluster.list_nodes()[0].metadata.name
+        cluster.add_pod(Pod(
+            metadata=ObjectMeta(
+                name="os-forbidden", namespace=NS,
+                labels={"app": "node-os-image",
+                        POD_CONTROLLER_REVISION_HASH_LABEL: "new2"}),
+            spec=PodSpec(node_name=name),
+            status=PodStatus(phase=PodPhase.RUNNING,
+                             container_statuses=[ContainerStatus(
+                                 name="c", ready=True)])))
+        monitor.drain()
+        assert any(v.invariant == "dag-order"
+                   and "suffix" in v.detail
+                   for v in monitor.violations)
+
+    def test_policy_sample_flags_unaudited_failures(self):
+        from tpu_operator_libs.chaos.invariants import (
+            DagExpectation,
+            InvariantMonitor,
+        )
+
+        fleet = FleetSpec(n_slices=1, hosts_per_slice=1)
+        cluster, clock, keys = build_fleet(fleet)
+        monitor = InvariantMonitor(
+            cluster=cluster, upgrade_keys=keys,
+            dag=DagExpectation(deps={}, stamp_prefix="x/",
+                               apps={}, runtime_namespace=NS))
+        monitor.policy_sample({"unauditedFailures": 0})
+        assert not monitor.violations
+        monitor.policy_sample({"unauditedFailures": 2})
+        assert any(v.invariant == "policy-sandbox"
+                   for v in monitor.violations)
+
+    @pytest.mark.slow
+    @pytest.mark.soak
+    def test_randomized_dag_soak(self):
+        """Widen beyond the fixed gate:
+        CHAOS_SEEDS=100,101 pytest tests/test_dag.py -m soak"""
+        from tpu_operator_libs.chaos.runner import run_dag_soak
+
+        raw = os.environ.get("CHAOS_SEEDS", "")
+        seeds = [int(s) for s in raw.split(",") if s.strip()] \
+            or list(GATE_SEEDS)
+        for seed in seeds:
+            _assert_ok(run_dag_soak(seed))

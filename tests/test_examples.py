@@ -45,11 +45,23 @@ class TestDemoOperator:
             capture_output=True, text=True, timeout=150)
         assert proc.returncode == 0, proc.stderr[-2000:]
         assert "demo complete" in proc.stderr
-        status = json.loads(proc.stdout)
+        # episode 1 (mixed-fleet convergence) and episode 2 (the
+        # declarative two-artifact DAG) each print one JSON document
+        decoder = json.JSONDecoder()
+        out = proc.stdout
+        status, end = decoder.raw_decode(out, out.index("{"))
         assert status["tpu"]["upgradesDone"] == 4
         assert status["tpu"]["sliceAvailability"] == 1.0
         assert status["gpu"]["upgradesDone"] == 2
         assert "sliceAvailability" not in status["gpu"]
+        assert "DAG episode complete" in proc.stderr
+        rest = out[end:]
+        dag, _ = decoder.raw_decode(rest, rest.index("{"))
+        assert dag["stamps"] and all(
+            stamps == {"libtpu": "new2", "device-plugin": "dp2"}
+            for stamps in dag["stamps"].values())
+        assert dag["artifactDAG"]["quarantinesTotal"] == 0
+        assert dag["policy"]["activeHooks"] == {"planner.admission": 1}
 
     def test_unified_policy_file_loading(self, tmp_path):
         sys.path.insert(0, "examples")

@@ -1,0 +1,541 @@
+"""Declarative policy engine: the sandboxed expression language, the
+unified hook registry's fail-closed/fail-open contract, spec/CRD
+validation, and the park-not-wedge property end to end (ISSUE 15)."""
+
+import pytest
+
+from tpu_operator_libs.api.policy_spec import (
+    HookProgramSpec,
+    PolicyHooksSpec,
+)
+from tpu_operator_libs.api.upgrade_policy import (
+    DrainSpec,
+    PolicyValidationError,
+    UpgradePolicySpec,
+)
+from tpu_operator_libs.consts import UpgradeState
+from tpu_operator_libs.policy import (
+    HOOK_POINTS,
+    EvalBudgetExceeded,
+    PolicyEvalError,
+    PolicyExprError,
+    PolicyHookRegistry,
+    UnknownHookError,
+    parse,
+)
+from tpu_operator_libs.simulate import (
+    NS,
+    RUNTIME_LABELS,
+    FleetSpec,
+    build_fleet,
+)
+from tpu_operator_libs.upgrade.state_manager import (
+    ClusterUpgradeStateManager,
+)
+
+pytestmark = pytest.mark.policy
+
+
+# ---------------------------------------------------------------------------
+# the expression language
+# ---------------------------------------------------------------------------
+class TestExprLanguage:
+    @pytest.mark.parametrize("program,env,expected", [
+        ("1 + 2 * 3", {}, 7),
+        ("(1 + 2) * 3", {}, 9),
+        ("10 % 3", {}, 1),
+        ("7 / 2", {}, 3.5),
+        ("-x", {"x": 4}, -4),
+        ("!flag", {"flag": False}, True),
+        ("a && b || c", {"a": True, "b": False, "c": True}, True),
+        ("x > 3 ? \"big\" : \"small\"", {"x": 5}, "big"),
+        ("node.labels[\"pool\"]",
+         {"node": {"labels": {"pool": "p1"}}}, "p1"),
+        ("node.name", {"node": {"name": "s0-h0"}}, "s0-h0"),
+        ("\"a\" in [\"a\", \"b\"]", {}, True),
+        ("\"x\" in {\"x\": 1}", {}, True),
+        ("size(\"abcd\")", {}, 4),
+        ("size(items)", {"items": [1, 2, 3]}, 3),
+        ("has(m, \"k\")", {"m": {"k": 1}}, True),
+        ("startsWith(\"pool-0\", \"pool\")", {}, True),
+        ("\"pool-0\".startsWith(\"pool\")", {}, True),  # method sugar
+        ("endsWith(\"a-b\", \"-b\")", {}, True),
+        ("contains([1, 2], 2)", {}, True),
+        ("min(3, 1, 2)", {}, 1),
+        ("max([3, 1, 2])", {}, 3),
+        ("abs(0 - 5)", {}, 5),
+        ("null == null", {}, True),
+        ("\"a\" + \"b\"", {}, "ab"),
+        ("[1, 2][1]", {}, 2),
+        ("\"abc\"[0]", {}, "a"),
+    ])
+    def test_evaluates(self, program, env, expected):
+        assert parse(program).evaluate(env) == expected
+
+    @pytest.mark.parametrize("bad", [
+        "", "   ", "1 +", "foo(", "a ? b", "a.3", "1 @ 2",
+        "unknownfn(1)", "'unterminated", "[1, 2", "{1: 2",
+    ])
+    def test_parse_errors(self, bad):
+        with pytest.raises(PolicyExprError):
+            parse(bad)
+
+    def test_program_size_cap(self):
+        with pytest.raises(PolicyExprError):
+            parse("1 + " * 3000 + "1")
+
+    @pytest.mark.parametrize("program,env", [
+        ("missing", {}),                      # unknown identifier
+        ("node.gone", {"node": {}}),          # missing member
+        ("m[\"k\"]", {"m": {}}),              # missing key
+        ("1 / 0", {}),                        # division by zero
+        ("1 && true", {}),                    # boolean type error
+        ("\"a\" < 1", {}),                    # mixed comparison
+        ("[1][5]", {}),                       # index out of range
+        ("size(1)", {}),                      # function type error
+    ])
+    def test_eval_errors(self, program, env):
+        with pytest.raises(PolicyEvalError):
+            parse(program).evaluate(env)
+
+    def test_step_budget_exhausts(self):
+        program = parse(" + ".join(["1"] * 200))
+        with pytest.raises(EvalBudgetExceeded):
+            program.evaluate({}, max_steps=10)
+        assert program.evaluate({}, max_steps=2000) == 200
+
+    def test_in_costs_scale_with_container(self):
+        big = list(range(10_000))
+        program = parse("x in items")
+        with pytest.raises(EvalBudgetExceeded):
+            program.evaluate({"x": -1, "items": big}, max_steps=50)
+
+    def test_no_attribute_escape(self):
+        # member access works on maps ONLY — Python objects are opaque
+        class Sneaky:
+            secret = "x"
+
+        with pytest.raises(PolicyEvalError):
+            parse("o.secret").evaluate({"o": Sneaky()})
+
+    def test_short_circuit_skips_right(self):
+        # the right side would raise; && must not evaluate it
+        assert parse("false && missing").evaluate({}) is False
+        assert parse("true || missing").evaluate({}) is True
+
+    def test_static_surface(self):
+        program = parse("node.ready && size(pods) > 0 && now > 1")
+        assert program.identifiers() == {"node", "pods", "now"}
+        assert program.functions() == {"size"}
+
+
+# ---------------------------------------------------------------------------
+# spec validation (the CRD admission path)
+# ---------------------------------------------------------------------------
+class TestHookSpecValidation:
+    def test_valid_spec(self):
+        PolicyHooksSpec(hooks=[HookProgramSpec(
+            hook="planner.admission",
+            program="fleet.slots > 0")]).validate()
+
+    def test_unknown_hook_rejected(self):
+        with pytest.raises(PolicyValidationError, match="not a known"):
+            HookProgramSpec(hook="nope.never",
+                            program="true").validate()
+
+    def test_unknown_version_rejected(self):
+        with pytest.raises(PolicyValidationError, match="version"):
+            HookProgramSpec(hook="planner.admission", version="v9",
+                            program="true").validate()
+
+    def test_unknown_identifier_rejected(self):
+        with pytest.raises(PolicyValidationError, match="identifier"):
+            HookProgramSpec(hook="planner.admission",
+                            program="pods == 0").validate()
+
+    @pytest.mark.parametrize("kwargs", [
+        {"max_steps": 0}, {"max_steps": 10 ** 9},
+        {"max_millis": 0}, {"max_millis": 5000.0},
+        {"max_steps": True},
+    ])
+    def test_budget_bounds_rejected(self, kwargs):
+        with pytest.raises(PolicyValidationError, match="policyHooks"):
+            HookProgramSpec(hook="planner.admission",
+                            program="true", **kwargs).validate()
+
+    def test_duplicate_hook_rejected(self):
+        spec = PolicyHooksSpec(hooks=[
+            HookProgramSpec(hook="planner.admission", program="true"),
+            HookProgramSpec(hook="planner.admission", program="false"),
+        ])
+        with pytest.raises(PolicyValidationError, match="duplicate"):
+            spec.validate()
+
+    def test_round_trip(self):
+        spec = PolicyHooksSpec(enable=True, hooks=[HookProgramSpec(
+            hook="eviction.filter", program="size(pods) == 0",
+            max_steps=99, max_millis=1.5)])
+        restored = PolicyHooksSpec.from_dict(spec.to_dict())
+        assert restored == spec
+
+    def test_rides_upgrade_policy_round_trip(self):
+        policy = UpgradePolicySpec(
+            auto_upgrade=True,
+            policy_hooks=PolicyHooksSpec(hooks=[HookProgramSpec(
+                hook="validation.verdict", program="node.ready")]))
+        policy.validate()
+        restored = UpgradePolicySpec.from_dict(policy.to_dict())
+        assert restored.policy_hooks == policy.policy_hooks
+
+    def test_crd_schema_validates_hooks_block(self):
+        from tpu_operator_libs.api.crd import (
+            upgrade_policy_schema,
+            validate_against_schema,
+        )
+
+        schema = upgrade_policy_schema()
+        validate_against_schema(
+            {"policyHooks": {"enable": True, "hooks": [
+                {"hook": "planner.admission",
+                 "program": "fleet.slots > 0"}]}}, schema)
+        with pytest.raises(PolicyValidationError):
+            validate_against_schema(
+                {"policyHooks": {"hooks": [
+                    {"hook": "not.a.hook", "program": "true"}]}},
+                schema)
+
+
+# ---------------------------------------------------------------------------
+# the hook registry: fail-closed / fail-open, budgets, audit
+# ---------------------------------------------------------------------------
+class TestHookRegistry:
+    def _registry(self):
+        records = []
+        registry = PolicyHookRegistry(
+            audit=lambda kind, subject, decision, rule, inputs:
+            records.append((kind, subject, decision, rule, inputs)))
+        return registry, records
+
+    def test_unknown_hook_registration_raises(self):
+        registry, _ = self._registry()
+        with pytest.raises(UnknownHookError):
+            registry.register_program("nope", "true", 100, 1.0)
+
+    def test_empty_hook_is_neutral(self):
+        registry, _ = self._registry()
+        verdict = registry.evaluate("planner.admission", {})
+        assert verdict.ok and verdict.value is True
+
+    def test_admission_denies_and_counts(self):
+        registry, _ = self._registry()
+        registry.register_program(
+            "planner.admission", "fleet.slots > 0", 100, 5.0)
+        allow = registry.evaluate("planner.admission",
+                                  {"fleet": {"slots": 1}}, "n1")
+        deny = registry.evaluate("planner.admission",
+                                 {"fleet": {"slots": 0}}, "n1")
+        assert allow.value is True and deny.value is False
+        assert deny.rule == "policy-deny"
+        assert registry.denies_total["planner.admission"] == 1
+        assert registry.evals_total["planner.admission"] == 2
+
+    def test_admission_error_fails_closed_and_audits(self):
+        registry, records = self._registry()
+        registry.register_program(
+            "planner.admission", "fleet.missing > 0", 100, 5.0)
+        verdict = registry.evaluate("planner.admission",
+                                    {"fleet": {}}, "n1")
+        assert verdict.value is False and not verdict.ok
+        assert verdict.rule == "policy-error"
+        assert registry.errors_total["planner.admission"] == 1
+        assert registry.unaudited_failures == 0
+        (kind, subject, decision, rule, inputs), = records
+        assert (kind, subject, decision, rule) == (
+            "policy", "n1", "park", "policy-error")
+        assert inputs["hook"] == "planner.admission"
+
+    def test_admission_budget_fails_closed_with_policy_budget(self):
+        registry, records = self._registry()
+        registry.register_program(
+            "planner.admission", " + ".join(["1"] * 50) + " > 0",
+            5, 5.0)
+        verdict = registry.evaluate("planner.admission", {}, "n2")
+        assert verdict.value is False and verdict.rule == "policy-budget"
+        assert registry.budget_exceeded_total["planner.admission"] == 1
+        assert records[0][3] == "policy-budget"
+
+    def test_observation_error_fails_open(self):
+        registry, records = self._registry()
+        registry.register_program(
+            "canary.verdict", "pod.missing > 9", 100, 5.0)
+        verdict = registry.evaluate("canary.verdict",
+                                    {"pod": {}, "node": {},
+                                     "revision": "r"}, "n3")
+        assert verdict.value is None  # no verdict contributed
+        assert not verdict.ok and verdict.rule == "policy-error"
+        assert records[0][2] == "observed-error"
+
+    def test_callable_seam_and_raise_parks(self):
+        registry, records = self._registry()
+        registry.register_callable(
+            "eviction.filter",
+            lambda node, pods: (_ for _ in ()).throw(RuntimeError("x")))
+        verdict = registry.evaluate("eviction.filter",
+                                    {"node": {}, "pods": []}, "n4")
+        assert verdict.value is False  # a raising Python hook parks too
+        assert records and records[0][3] == "policy-error"
+
+    def test_admission_non_boolean_program_fails_closed(self):
+        registry, _ = self._registry()
+        registry.register_program("planner.admission", "1 + 1", 100, 5.0)
+        verdict = registry.evaluate("planner.admission", {}, "n5")
+        assert verdict.value is False and verdict.rule == "policy-error"
+
+    def test_clear_by_source(self):
+        registry, _ = self._registry()
+        registry.register_program("planner.admission", "true", 100, 5.0)
+        registry.register_callable("planner.admission",
+                                   lambda **kw: True, name="builtin")
+        registry.clear("crd")
+        assert registry.active_hooks == {"planner.admission": 1}
+
+    def test_eval_samples_drain(self):
+        registry, _ = self._registry()
+        registry.register_program("planner.admission", "true", 100, 5.0)
+        registry.evaluate("planner.admission", {}, "n")
+        samples = registry.drain_eval_samples()
+        assert samples and samples[0][0] == "planner.admission"
+        assert registry.drain_eval_samples() == []
+
+    def test_every_catalog_hook_is_versioned(self):
+        for point in HOOK_POINTS.values():
+            assert point.version == "v1"
+            assert point.kind in ("admission", "observation")
+            assert point.env
+
+
+# ---------------------------------------------------------------------------
+# end to end: programs steer a live fleet; failures park, never wedge
+# ---------------------------------------------------------------------------
+def _policy(hooks=None, **kwargs):
+    return UpgradePolicySpec(
+        auto_upgrade=True, max_parallel_upgrades=0,
+        max_unavailable="50%",
+        drain=DrainSpec(enable=True, force=True),
+        policy_hooks=PolicyHooksSpec(hooks=hooks or []), **kwargs)
+
+
+def _run(cluster, clock, keys, mgr, policy, steps=60):
+    for _ in range(steps):
+        mgr.reconcile(NS, dict(RUNTIME_LABELS), policy)
+        clock.advance(10)
+        cluster.step()
+
+
+def _states(cluster, keys):
+    return {n.metadata.name: n.metadata.labels.get(keys.state_label, "")
+            for n in cluster.list_nodes()}
+
+
+class TestEngineEndToEnd:
+    def _fleet(self):
+        fleet = FleetSpec(n_slices=2, hosts_per_slice=2,
+                          pod_recreate_delay=5, pod_ready_delay=10)
+        cluster, clock, keys = build_fleet(fleet)
+        mgr = ClusterUpgradeStateManager(cluster, keys, clock=clock,
+                                         async_workers=False)
+        from tpu_operator_libs.obs import OperatorObservability
+
+        obs = OperatorObservability(keys, clock=clock)
+        mgr.with_observability(obs)
+        return cluster, clock, keys, mgr
+
+    def test_admission_program_steers_the_planner(self):
+        cluster, clock, keys, mgr = self._fleet()
+        policy = _policy([HookProgramSpec(
+            hook="planner.admission",
+            program="node.labels[\"cloud.google.com/gke-nodepool\"]"
+                    " != \"pool-0\"")])
+        _run(cluster, clock, keys, mgr, policy)
+        states = _states(cluster, keys)
+        done = str(UpgradeState.DONE)
+        for name, state in states.items():
+            if name.startswith("s0-"):
+                assert state != done, f"{name} was admitted past policy"
+            else:
+                assert state == done, f"{name} should have converged"
+        # the hold is explained and audited
+        held = next(n for n in states if n.startswith("s0-"))
+        result = mgr.explain(held)
+        assert any("policy" in reason for reason in result["blocking"])
+        assert any(rec["rule"] == "policy-deny"
+                   for rec in result.get("records", []))
+
+    def test_erroring_program_parks_audited_never_wedges(self):
+        """The acceptance property: an over-budget/raising policy
+        demonstrably PARKS (audited, explain() non-empty) rather than
+        wedging the pass — and fixing the policy releases the fleet."""
+        cluster, clock, keys, mgr = self._fleet()
+        raising = _policy([HookProgramSpec(
+            hook="planner.admission",
+            program="1 / (fleet.slots - fleet.slots) > 0")])
+        _run(cluster, clock, keys, mgr, raising, steps=20)
+        # nothing admitted, nothing crashed, every hold audited
+        states = _states(cluster, keys)
+        assert all(state == str(UpgradeState.UPGRADE_REQUIRED)
+                   for state in states.values())
+        engine = mgr.policy_engine
+        assert engine.registry.errors_total["planner.admission"] > 0
+        assert engine.registry.unaudited_failures == 0
+        some_node = next(iter(states))
+        result = mgr.explain(some_node)
+        assert result["blocking"], "explain must name the park"
+        assert any("policy-error" in reason
+                   for reason in result["blocking"])
+        assert any(rec["rule"] == "policy-error"
+                   for rec in result.get("records", []))
+        # fix the policy: the SAME manager converges
+        _run(cluster, clock, keys, mgr, _policy([]), steps=60)
+        assert all(state == str(UpgradeState.DONE)
+                   for state in _states(cluster, keys).values())
+
+    def test_over_budget_program_parks_with_policy_budget(self):
+        cluster, clock, keys, mgr = self._fleet()
+        policy = _policy([HookProgramSpec(
+            hook="planner.admission",
+            program=" + ".join(["1"] * 100) + " >= fleet.slots",
+            max_steps=5)])
+        _run(cluster, clock, keys, mgr, policy, steps=10)
+        engine = mgr.policy_engine
+        assert engine.registry.budget_exceeded_total[
+            "planner.admission"] > 0
+        assert engine.registry.unaudited_failures == 0
+        states = _states(cluster, keys)
+        assert all(state == str(UpgradeState.UPGRADE_REQUIRED)
+                   for state in states.values())
+        result = mgr.explain(next(iter(states)))
+        assert any("policy-budget" in reason
+                   for reason in result["blocking"])
+
+    def test_eviction_filter_program_parks_drain(self):
+        cluster, clock, keys, mgr = self._fleet()
+        blocked = _policy([HookProgramSpec(
+            hook="eviction.filter", program="false")])
+        _run(cluster, clock, keys, mgr, blocked, steps=25)
+        states = _states(cluster, keys)
+        # admitted nodes park at the drain gate; nobody finishes
+        assert str(UpgradeState.DONE) not in states.values()
+        # releasing the policy releases the gate (same manager)
+        _run(cluster, clock, keys, mgr, _policy([]), steps=60)
+        assert all(state == str(UpgradeState.DONE)
+                   for state in _states(cluster, keys).values())
+
+    def test_validation_verdict_program_gates_return_to_service(self):
+        cluster, clock, keys, mgr = self._fleet()
+        policy = _policy([HookProgramSpec(
+            hook="validation.verdict",
+            program="has(node.annotations, \"ok/signal\")")])
+        _run(cluster, clock, keys, mgr, policy, steps=20)
+        states = _states(cluster, keys)
+        assert str(UpgradeState.DONE) not in states.values()
+        assert str(UpgradeState.VALIDATION_REQUIRED) in states.values()
+        for node in cluster.list_nodes():
+            cluster.patch_node_annotations(
+                node.metadata.name, {"ok/signal": "true"})
+        _run(cluster, clock, keys, mgr, policy, steps=60)
+        assert all(state == str(UpgradeState.DONE)
+                   for state in _states(cluster, keys).values())
+
+    def test_invalid_spec_is_dropped_whole_and_audited(self):
+        cluster, clock, keys, mgr = self._fleet()
+        # bypasses CRD validation (hand-built spec): the engine must
+        # reject it at refresh, audit, and run hook-free
+        policy = _policy([
+            HookProgramSpec(hook="planner.admission", program="true"),
+            HookProgramSpec(hook="planner.admission", program="false"),
+        ])
+        _run(cluster, clock, keys, mgr, policy, steps=60)
+        assert all(state == str(UpgradeState.DONE)
+                   for state in _states(cluster, keys).values())
+        assert not mgr.policy_engine.active
+
+    def test_cluster_status_carries_policy_block(self):
+        cluster, clock, keys, mgr = self._fleet()
+        policy = _policy([HookProgramSpec(
+            hook="planner.admission", program="fleet.slots >= 0")])
+        _run(cluster, clock, keys, mgr, policy, steps=5)
+        state = mgr.build_state(NS, dict(RUNTIME_LABELS))
+        status = mgr.cluster_status(state)
+        assert "policy" in status
+        assert status["policy"]["activeHooks"] == {
+            "planner.admission": 1}
+        assert sum(status["policy"]["evalsTotal"].values()) > 0
+
+    def test_observe_policy_exports(self):
+        from tpu_operator_libs.metrics import (
+            MetricsRegistry,
+            observe_policy,
+        )
+
+        cluster, clock, keys, mgr = self._fleet()
+        policy = _policy([HookProgramSpec(
+            hook="planner.admission",
+            program="node.labels[\"cloud.google.com/gke-nodepool\"]"
+                    " != \"pool-0\"")])
+        _run(cluster, clock, keys, mgr, policy, steps=10)
+        registry = MetricsRegistry()
+        observe_policy(registry, mgr)
+        text = registry.render_prometheus()
+        assert "tpu_upgrade_policy_hook_eval_seconds" in text
+        assert "tpu_upgrade_policy_active_hooks" in text
+        assert "tpu_upgrade_policy_hook_denies_total" in text
+        assert "tpu_upgrade_policy_holds_total" in text
+
+
+class TestPolicyLintSelf:
+    def test_shipped_programs_are_clean(self):
+        import tools.policy_lint as policy_lint
+
+        assert policy_lint.lint() == []
+
+    def test_lint_catches_unknown_identifier(self, tmp_path,
+                                             monkeypatch):
+        import tools.policy_lint as policy_lint
+
+        (tmp_path / "examples").mkdir()
+        (tmp_path / "examples" / "bad.yaml").write_text(
+            "spec:\n"
+            "  policyHooks:\n"
+            "    hooks:\n"
+            "      - hook: planner.admission\n"
+            "        program: \"pods > 0\"\n")
+        (tmp_path / "docs").mkdir()
+        monkeypatch.setattr(policy_lint, "ROOT", tmp_path)
+        findings = policy_lint.lint()
+        assert any("identifier" in f for f in findings)
+
+    def test_lint_catches_infeasible_budget(self, tmp_path,
+                                            monkeypatch):
+        import tools.policy_lint as policy_lint
+
+        (tmp_path / "examples").mkdir()
+        (tmp_path / "examples" / "bad.yaml").write_text(
+            "spec:\n"
+            "  policyHooks:\n"
+            "    hooks:\n"
+            "      - hook: planner.admission\n"
+            "        program: \"1 + 1 + 1 + 1 + 1 + 1 + 1 > 0\"\n"
+            "        maxSteps: 2\n")
+        (tmp_path / "docs").mkdir()
+        monkeypatch.setattr(policy_lint, "ROOT", tmp_path)
+        findings = policy_lint.lint()
+        assert any("never complete" in f for f in findings)
+
+    def test_lint_requires_some_program(self, tmp_path, monkeypatch):
+        import tools.policy_lint as policy_lint
+
+        (tmp_path / "examples").mkdir()
+        (tmp_path / "docs").mkdir()
+        monkeypatch.setattr(policy_lint, "ROOT", tmp_path)
+        findings = policy_lint.lint()
+        assert any("no policy program" in f for f in findings)

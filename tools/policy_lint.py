@@ -1,0 +1,162 @@
+#!/usr/bin/env python3
+"""Static policy-program lint (wired into `make lint`).
+
+Declarative policy programs ship as DATA — in `examples/crd/*.yaml`
+instances, in `examples/*.yaml`, and in fenced ```yaml blocks across
+docs/*.md and README.md. Data rots silently: a doc snippet referencing
+an identifier the hook environment does not provide, a program that
+stops parsing after a language change, or a budget outside the sandbox
+bounds would only surface when a user pastes it into a CRD. Mirroring
+the `metrics_lint`/`marker_lint` pattern, this tool statically
+re-validates every shipped program against the live sandbox:
+
+1. **Parse + type-check**: each `policyHooks` entry runs the exact
+   validation the CRD admission path runs
+   (`HookProgramSpec.validate`): syntax, unknown functions, unknown
+   identifiers vs the hook point's environment, budget bounds.
+2. **Budget feasibility**: a program whose own tree size exceeds its
+   declared `maxSteps` can never complete an evaluation — the
+   budget-free-loop analogue in a loopless language (every node costs
+   at least one step, so this is a sound lower bound).
+3. **DAG validity**: each `artifactDAG` found is re-validated
+   (cycles, unknown dependencies, duplicate artifacts).
+4. **Teeth**: finding zero programs anywhere fails the lint — the
+   shipped examples ARE the documentation of the policy surface, and
+   an empty sweep means the glob drifted, not that everything is fine.
+
+Exit status 1 iff findings were printed.
+"""
+
+from __future__ import annotations
+
+import re
+import sys
+from pathlib import Path
+
+ROOT = Path(__file__).resolve().parent.parent
+sys.path.insert(0, str(ROOT))
+
+from tpu_operator_libs.api.policy_spec import (  # noqa: E402
+    ArtifactDAGSpec,
+    HookProgramSpec,
+)
+from tpu_operator_libs.api.upgrade_policy import (  # noqa: E402
+    PolicyValidationError,
+)
+from tpu_operator_libs.policy.expr import parse  # noqa: E402
+
+YAML_GLOBS = ("examples/crd/*.yaml", "examples/*.yaml")
+DOC_GLOBS = ("docs/*.md", "README.md")
+FENCE_RE = re.compile(r"```ya?ml\n(.*?)```", re.S)
+
+
+def _walk(value, found_hooks, found_dags, where: str) -> None:
+    """Collect every policyHooks/artifactDAG block in a parsed tree."""
+    if isinstance(value, dict):
+        hooks = value.get("policyHooks")
+        if isinstance(hooks, dict) and isinstance(
+                hooks.get("hooks"), list):
+            found_hooks.append((where, hooks))
+        dag = value.get("artifactDAG")
+        if isinstance(dag, dict) and isinstance(
+                dag.get("artifacts"), list):
+            found_dags.append((where, dag))
+        for key, child in value.items():
+            if key not in ("policyHooks", "artifactDAG"):
+                _walk(child, found_hooks, found_dags, where)
+    elif isinstance(value, list):
+        for child in value:
+            _walk(child, found_hooks, found_dags, where)
+
+
+def collect() -> "tuple[list, list, list[str]]":
+    """(hook blocks, dag blocks, findings) from every shipped source."""
+    try:
+        import yaml
+    except ImportError:  # pragma: no cover - lint degrades loudly
+        print("policy_lint: SKIPPED (pyyaml not installed — shipped "
+              "programs not validated)")
+        raise SystemExit(0)
+    hooks: list = []
+    dags: list = []
+    findings: list[str] = []
+    documents: "list[tuple[str, str]]" = []
+    for pattern in YAML_GLOBS:
+        for path in sorted(ROOT.glob(pattern)):
+            documents.append((str(path.relative_to(ROOT)),
+                              path.read_text()))
+    for pattern in DOC_GLOBS:
+        for path in sorted(ROOT.glob(pattern)):
+            rel = str(path.relative_to(ROOT))
+            for index, block in enumerate(
+                    FENCE_RE.findall(path.read_text())):
+                documents.append((f"{rel} (yaml block #{index + 1})",
+                                  block))
+    for where, text in documents:
+        try:
+            parsed = list(yaml.safe_load_all(text))
+        except yaml.YAMLError as exc:
+            if "policyHooks" in text or "artifactDAG" in text:
+                findings.append(
+                    f"{where}: YAML containing policy data does not "
+                    f"parse: {exc}")
+            continue
+        for doc in parsed:
+            _walk(doc, hooks, dags, where)
+    return hooks, dags, findings
+
+
+def lint() -> "list[str]":
+    hooks, dags, findings = collect()
+    programs = 0
+    for where, block in hooks:
+        for index, entry in enumerate(block.get("hooks", [])):
+            if not isinstance(entry, dict):
+                findings.append(f"{where}: policyHooks.hooks[{index}] "
+                                f"is not a mapping")
+                continue
+            programs += 1
+            spec = HookProgramSpec.from_dict(entry)
+            label = f"{where}: policyHooks[{spec.hook or index}]"
+            try:
+                spec.validate()
+            except PolicyValidationError as exc:
+                findings.append(f"{label}: {exc}")
+                continue
+            program = parse(spec.program)
+            if program.node_count() > spec.max_steps:
+                findings.append(
+                    f"{label}: program has {program.node_count()} "
+                    f"nodes but maxSteps={spec.max_steps} — it can "
+                    f"never complete an evaluation (every node costs "
+                    f">= 1 step)")
+    for where, block in dags:
+        try:
+            ArtifactDAGSpec.from_dict(block).validate()
+        except PolicyValidationError as exc:
+            findings.append(f"{where}: artifactDAG: {exc}")
+    if programs == 0:
+        findings.append(
+            "no policy program found under examples/ or in docs yaml "
+            "blocks — the shipped policy surface is undocumented (or "
+            "this lint's globs drifted)")
+    return findings
+
+
+def main() -> int:
+    findings = lint()
+    for finding in findings:
+        print(finding)
+    if findings:
+        print(f"policy_lint: {len(findings)} finding(s)",
+              file=sys.stderr)
+        return 1
+    hooks, dags, _ = collect()
+    total = sum(len(block.get("hooks", [])) for _, block in hooks)
+    print(f"policy_lint: OK ({total} program(s) and {len(dags)} "
+          f"artifact DAG(s) validated against the sandbox)")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -481,6 +481,166 @@ def traffic_class_schema() -> dict[str, Any]:
     }
 
 
+def policy_hooks_schema() -> dict[str, Any]:
+    """PolicyHooksSpec (api/policy_spec.py): declarative CEL-style
+    programs at the named hook points, evaluated sandboxed
+    (policy/expr.py) with per-hook step/wall budgets."""
+    return {
+        "type": "object",
+        "description": "Declarative policy hooks: small CEL-style "
+                       "programs attached to named, versioned hook "
+                       "points (eviction.filter, planner.admission, "
+                       "window.gate, validation.verdict, "
+                       "canary.verdict, abort.audit), evaluated in a "
+                       "sandbox with per-hook budgets. A failing or "
+                       "over-budget program parks its node with an "
+                       "audited policy-error/policy-budget reason — "
+                       "it can never wedge a reconcile pass.",
+        "properties": {
+            "enable": {
+                "type": "boolean",
+                "default": True,
+                "description": "Master switch; when false no program "
+                               "is evaluated.",
+            },
+            "hooks": {
+                "type": "array",
+                "default": [],
+                "description": "One program per hook point (compose "
+                               "conditions with '&&').",
+                "items": {
+                    "type": "object",
+                    "required": ["hook", "program"],
+                    "properties": {
+                        "hook": {
+                            "type": "string",
+                            "enum": ["eviction.filter",
+                                     "planner.admission",
+                                     "window.gate",
+                                     "validation.verdict",
+                                     "canary.verdict",
+                                     "abort.audit"],
+                            "description": "Named hook point from the "
+                                           "catalog "
+                                           "(docs/policy-engine.md §2).",
+                        },
+                        "version": {
+                            "type": "string",
+                            "enum": ["v1"],
+                            "default": "v1",
+                            "description": "Hook-point contract "
+                                           "version.",
+                        },
+                        "program": {
+                            "type": "string",
+                            "description": "The CEL-style expression; "
+                                           "admission hooks must "
+                                           "return a boolean.",
+                        },
+                        "maxSteps": {
+                            "type": "integer",
+                            "minimum": 1,
+                            "maximum": 100000,
+                            "default": 2000,
+                            "description": "Per-evaluation step "
+                                           "budget.",
+                        },
+                        "maxMillis": {
+                            "type": "number",
+                            "exclusiveMinimum": 0,
+                            "maximum": 1000,
+                            "default": 5,
+                            "description": "Per-evaluation wall budget "
+                                           "(milliseconds).",
+                        },
+                    },
+                },
+            },
+        },
+    }
+
+
+def artifact_dag_schema() -> dict[str, Any]:
+    """ArtifactDAGSpec (api/policy_spec.py): dependency-ordered
+    multi-artifact upgrades through one shared cordon/drain cycle per
+    node (policy/dag.py)."""
+    return {
+        "type": "object",
+        "description": "Multi-artifact upgrade DAG: every artifact "
+                       "(libtpu, device plugin, network driver, node "
+                       "OS image, ...) is a DaemonSet advanced through "
+                       "the node's ONE cordon/drain cycle in "
+                       "dependency order, with crash-ordered durable "
+                       "per-artifact revision stamps; a crash-looping "
+                       "artifact revision is quarantined and only its "
+                       "un-started dependent suffix rolls back.",
+        "properties": {
+            "enable": {
+                "type": "boolean",
+                "default": False,
+                "description": "Master switch; when false only the "
+                               "primary runtime is managed (reference "
+                               "semantics).",
+            },
+            "failureThreshold": {
+                "type": "integer",
+                "minimum": 1,
+                "default": 1,
+                "description": "Crash-looping nodes at an artifact's "
+                               "target revision that quarantine the "
+                               "revision.",
+            },
+            "artifacts": {
+                "type": "array",
+                "default": [],
+                "description": "The DAG's artifacts; the entry whose "
+                               "runtimeLabels equal the operator's "
+                               "managed runtime labels is the primary "
+                               "(driven by the state machine itself).",
+                "items": {
+                    "type": "object",
+                    "required": ["name", "runtimeLabels"],
+                    "properties": {
+                        "name": {
+                            "type": "string",
+                            "pattern": "^[a-z0-9]"
+                                       "([a-z0-9-]{0,61}[a-z0-9])?$",
+                            "description": "Artifact name — also the "
+                                           "per-node revision-stamp "
+                                           "key suffix.",
+                        },
+                        "runtimeLabels": {
+                            "type": "object",
+                            "additionalProperties": {"type": "string"},
+                            "description": "Labels selecting the "
+                                           "artifact's DaemonSet.",
+                        },
+                        "namespace": {
+                            "type": "string",
+                            "default": "",
+                            "description": "Namespace of the "
+                                           "artifact's DaemonSet "
+                                           "(empty = the reconcile "
+                                           "namespace).",
+                        },
+                        "dependsOn": {
+                            "type": "array",
+                            "default": [],
+                            "items": {"type": "string"},
+                            "description": "Artifacts that must be "
+                                           "stamped at their target "
+                                           "on a node before this one "
+                                           "may advance there "
+                                           "(cycles are rejected at "
+                                           "validation).",
+                        },
+                    },
+                },
+            },
+        },
+    }
+
+
 def wedge_detection_schema() -> dict[str, Any]:
     """WedgeDetectionSpec (api/remediation_policy.py)."""
     return {
@@ -747,6 +907,8 @@ def upgrade_policy_schema() -> dict[str, Any]:
             "predictor": predictor_schema(),
             "maintenanceWindow": maintenance_window_schema(),
             "capacityBudget": capacity_budget_schema(),
+            "policyHooks": policy_hooks_schema(),
+            "artifactDAG": artifact_dag_schema(),
             "topologyMode": {
                 "type": "string",
                 "enum": ["flat", "slice"],
@@ -931,6 +1093,16 @@ def validate_against_schema(data: Any, schema: dict[str, Any],
                 validate_against_schema(value, extra, f"{path}.{key}")
             # unknown fields with no additionalProperties schema are
             # pruned by the server, not rejected; accept them here too
+        return
+    if expected == "array":
+        if not isinstance(data, list):
+            raise PolicyValidationError(
+                f"{path}: expected array, got {type(data).__name__}")
+        items = schema.get("items")
+        if isinstance(items, dict):
+            for index, item in enumerate(data):
+                validate_against_schema(item, items,
+                                        f"{path}[{index}]")
         return
     if expected == "integer":
         if not isinstance(data, int) or isinstance(data, bool):

@@ -1,0 +1,306 @@
+"""Declarative policy-engine specs: per-hook programs + artifact DAGs.
+
+Two CRD-embeddable surfaces (ISSUE 15 / the ROADMAP's declarative-
+policy-engine item):
+
+- :class:`PolicyHooksSpec` — small CEL-style programs attached to the
+  named hook points of :mod:`tpu_operator_libs.policy.hooks`, each with
+  its own step/wall budget. Programs are parsed at validation time, so
+  a malformed policy is rejected at admission instead of discovered
+  mid-pass; evaluation is sandboxed (policy/expr.py), and a failing or
+  over-budget program parks its node with an audited reason — it can
+  never wedge or crash a reconcile pass.
+- :class:`ArtifactDAGSpec` — a dependency-ordered multi-artifact
+  upgrade (libtpu + device plugin + network driver + node OS image,
+  ...): per-artifact DaemonSets advance through ONE shared cordon/
+  drain cycle per node in DAG order, with crash-ordered per-artifact
+  revision stamps so partial progress resumes from cluster state alone
+  (policy/dag.py). Validation rejects cycles, unknown dependencies and
+  duplicate artifacts structurally.
+
+Both ride :class:`~tpu_operator_libs.api.upgrade_policy.
+UpgradePolicySpec` (``policyHooks`` / ``artifactDAG`` JSON keys) so the
+whole scenario ships as CRD data — no operator-code changes.
+"""
+
+from __future__ import annotations
+
+import copy
+from dataclasses import dataclass, field
+from typing import Any
+
+from tpu_operator_libs.api.upgrade_policy import PolicyValidationError
+from tpu_operator_libs.policy.expr import (
+    DEFAULT_MAX_MILLIS,
+    DEFAULT_MAX_STEPS,
+    MAX_MILLIS_CEILING,
+    MAX_STEPS_CEILING,
+    PolicyExprError,
+    parse,
+)
+
+
+@dataclass
+class HookProgramSpec:
+    """One declarative program bound to one named hook point."""
+
+    #: Hook point name ("planner.admission", "eviction.filter", ...);
+    #: must exist in the hook catalog (policy/hooks.py).
+    hook: str = ""
+    #: Hook point contract version; only "v1" exists today. Versioned
+    #: so a future env change ships as v2 while v1 programs keep their
+    #: original contract.
+    version: str = "v1"
+    #: The CEL-style program (policy/expr.py). Admission hooks must
+    #: return a boolean.
+    program: str = ""
+    #: Per-evaluation step budget (tree-node + container-cost units).
+    max_steps: int = DEFAULT_MAX_STEPS
+    #: Per-evaluation wall budget in milliseconds.
+    max_millis: float = DEFAULT_MAX_MILLIS
+
+    def validate(self) -> None:
+        # local import: hooks.py imports this module's sibling types
+        from tpu_operator_libs.policy.hooks import HOOK_POINTS
+
+        if not self.hook:
+            raise PolicyValidationError("policyHooks[].hook is required")
+        point = HOOK_POINTS.get(self.hook)
+        if point is None:
+            raise PolicyValidationError(
+                f"policyHooks[].hook {self.hook!r} is not a known hook "
+                f"point (known: {', '.join(sorted(HOOK_POINTS))})")
+        if self.version != point.version:
+            raise PolicyValidationError(
+                f"policyHooks[{self.hook}].version {self.version!r} is "
+                f"not supported (hook point is {point.version})")
+        if isinstance(self.max_steps, bool) \
+                or not isinstance(self.max_steps, int) \
+                or not 1 <= self.max_steps <= MAX_STEPS_CEILING:
+            raise PolicyValidationError(
+                f"policyHooks[{self.hook}].maxSteps must be an integer "
+                f"in [1, {MAX_STEPS_CEILING}]")
+        if not isinstance(self.max_millis, (int, float)) \
+                or isinstance(self.max_millis, bool) \
+                or not 0 < self.max_millis <= MAX_MILLIS_CEILING:
+            raise PolicyValidationError(
+                f"policyHooks[{self.hook}].maxMillis must be in "
+                f"(0, {MAX_MILLIS_CEILING}]")
+        try:
+            program = parse(self.program)
+        except PolicyExprError as exc:
+            raise PolicyValidationError(
+                f"policyHooks[{self.hook}].program does not parse: "
+                f"{exc}") from None
+        unknown = program.identifiers() - point.env
+        if unknown:
+            raise PolicyValidationError(
+                f"policyHooks[{self.hook}].program references unknown "
+                f"identifier(s) {sorted(unknown)}; the {self.hook} "
+                f"environment provides {sorted(point.env)}")
+
+    def to_dict(self) -> dict[str, Any]:
+        return {"hook": self.hook,
+                "version": self.version,
+                "program": self.program,
+                "maxSteps": self.max_steps,
+                "maxMillis": self.max_millis}
+
+    @classmethod
+    def from_dict(cls, data: dict[str, Any]) -> "HookProgramSpec":
+        return cls(hook=data.get("hook", ""),
+                   version=data.get("version", "v1"),
+                   program=data.get("program", ""),
+                   max_steps=data.get("maxSteps", DEFAULT_MAX_STEPS),
+                   max_millis=data.get("maxMillis", DEFAULT_MAX_MILLIS))
+
+    def deep_copy(self) -> "HookProgramSpec":
+        return copy.deepcopy(self)
+
+
+@dataclass
+class PolicyHooksSpec:
+    """Declarative hook programs shipped in the CRD."""
+
+    #: Master switch; when False no program is evaluated.
+    enable: bool = True
+    hooks: list[HookProgramSpec] = field(default_factory=list)
+
+    def validate(self) -> None:
+        seen: set[str] = set()
+        for spec in self.hooks:
+            spec.validate()
+            if spec.hook in seen:
+                raise PolicyValidationError(
+                    f"policyHooks: duplicate program for hook "
+                    f"{spec.hook!r} (one program per hook point; "
+                    f"compose with '&&' instead)")
+            seen.add(spec.hook)
+
+    def to_dict(self) -> dict[str, Any]:
+        return {"enable": self.enable,
+                "hooks": [spec.to_dict() for spec in self.hooks]}
+
+    @classmethod
+    def from_dict(cls, data: dict[str, Any]) -> "PolicyHooksSpec":
+        return cls(enable=data.get("enable", True),
+                   hooks=[HookProgramSpec.from_dict(item)
+                          for item in data.get("hooks", [])])
+
+    def deep_copy(self) -> "PolicyHooksSpec":
+        return copy.deepcopy(self)
+
+
+@dataclass
+class ArtifactSpec:
+    """One artifact (DaemonSet-delivered node component) in the DAG."""
+
+    #: Artifact name — also the per-node revision-stamp key suffix, so
+    #: it must be label-value shaped.
+    name: str = ""
+    #: Labels selecting the artifact's DaemonSet (and its pods). The
+    #: artifact whose labels equal the operator's managed runtime
+    #: labels is the PRIMARY artifact — driven by the state machine's
+    #: own pod-restart arc; every other artifact is advanced by the
+    #: DAG coordinator inside the node's validation window.
+    runtime_labels: dict[str, str] = field(default_factory=dict)
+    #: Namespace of the artifact's DaemonSet ("" = the reconcile
+    #: namespace).
+    namespace: str = ""
+    #: Names of artifacts that must be stamped at their target revision
+    #: on a node before this artifact may advance there.
+    depends_on: list[str] = field(default_factory=list)
+
+    def validate(self) -> None:
+        if not self.name or not all(
+                ch.isalnum() or ch == "-" for ch in self.name) \
+                or self.name.startswith("-") or self.name.endswith("-"):
+            raise PolicyValidationError(
+                f"artifactDAG.artifacts[].name {self.name!r} must be a "
+                f"DNS-label (alphanumerics and dashes)")
+        if not self.runtime_labels:
+            raise PolicyValidationError(
+                f"artifactDAG.artifacts[{self.name}].runtimeLabels "
+                f"must select the artifact's DaemonSet")
+        if self.name in self.depends_on:
+            raise PolicyValidationError(
+                f"artifactDAG.artifacts[{self.name}] depends on itself")
+
+    def to_dict(self) -> dict[str, Any]:
+        out: dict[str, Any] = {"name": self.name,
+                               "runtimeLabels": dict(self.runtime_labels)}
+        if self.namespace:
+            out["namespace"] = self.namespace
+        if self.depends_on:
+            out["dependsOn"] = list(self.depends_on)
+        return out
+
+    @classmethod
+    def from_dict(cls, data: dict[str, Any]) -> "ArtifactSpec":
+        return cls(name=data.get("name", ""),
+                   runtime_labels=dict(data.get("runtimeLabels", {})),
+                   namespace=data.get("namespace", ""),
+                   depends_on=list(data.get("dependsOn", [])))
+
+    def deep_copy(self) -> "ArtifactSpec":
+        return copy.deepcopy(self)
+
+
+@dataclass
+class ArtifactDAGSpec:
+    """Dependency-ordered multi-artifact upgrade, expressed as data."""
+
+    #: Master switch; when False only the primary runtime is managed
+    #: (reference semantics, bit for bit).
+    enable: bool = False
+    artifacts: list[ArtifactSpec] = field(default_factory=list)
+    #: Crash-looping pods observed at an artifact's target revision on
+    #: this many distinct nodes quarantine that revision and roll the
+    #: artifact (plus its un-started dependent suffix) back.
+    failure_threshold: int = 1
+
+    def validate(self) -> None:
+        if isinstance(self.failure_threshold, bool) \
+                or self.failure_threshold < 1:
+            raise PolicyValidationError(
+                "artifactDAG.failureThreshold must be >= 1")
+        names: set[str] = set()
+        for artifact in self.artifacts:
+            artifact.validate()
+            if artifact.name in names:
+                raise PolicyValidationError(
+                    f"artifactDAG: duplicate artifact {artifact.name!r}")
+            names.add(artifact.name)
+        for artifact in self.artifacts:
+            unknown = set(artifact.depends_on) - names
+            if unknown:
+                raise PolicyValidationError(
+                    f"artifactDAG.artifacts[{artifact.name}] depends on "
+                    f"unknown artifact(s) {sorted(unknown)}")
+        self.topo_order()  # raises on cycles
+
+    def topo_order(self) -> "list[ArtifactSpec]":
+        """Deterministic topological order (Kahn's algorithm, ties by
+        name). Raises :class:`PolicyValidationError` on a cycle —
+        validation's cycle rejection and the coordinator's walk share
+        this one implementation."""
+        by_name = {a.name: a for a in self.artifacts}
+        indegree = {a.name: len(set(a.depends_on)) for a in self.artifacts}
+        dependents: dict[str, list[str]] = {a.name: [] for a in self.artifacts}
+        for artifact in self.artifacts:
+            for dep in set(artifact.depends_on):
+                dependents.setdefault(dep, []).append(artifact.name)
+        ready = sorted(name for name, deg in indegree.items() if deg == 0)
+        order: list[ArtifactSpec] = []
+        while ready:
+            name = ready.pop(0)
+            order.append(by_name[name])
+            grew = False
+            for dependent in dependents.get(name, ()):
+                indegree[dependent] -= 1
+                if indegree[dependent] == 0:
+                    ready.append(dependent)
+                    grew = True
+            if grew:
+                ready.sort()
+        if len(order) != len(self.artifacts):
+            cyclic = sorted(name for name, deg in indegree.items()
+                            if deg > 0)
+            raise PolicyValidationError(
+                f"artifactDAG has a dependency cycle through "
+                f"{cyclic}")
+        return order
+
+    def dependents_of(self, name: str) -> "list[str]":
+        """Transitive dependents of ``name`` (the suffix a quarantine
+        contains), deterministic order."""
+        direct: dict[str, list[str]] = {}
+        for artifact in self.artifacts:
+            for dep in artifact.depends_on:
+                direct.setdefault(dep, []).append(artifact.name)
+        out: list[str] = []
+        frontier = list(direct.get(name, ()))
+        seen: set[str] = set()
+        while frontier:
+            current = frontier.pop(0)
+            if current in seen:
+                continue
+            seen.add(current)
+            out.append(current)
+            frontier.extend(direct.get(current, ()))
+        return sorted(out)
+
+    def to_dict(self) -> dict[str, Any]:
+        return {"enable": self.enable,
+                "failureThreshold": self.failure_threshold,
+                "artifacts": [a.to_dict() for a in self.artifacts]}
+
+    @classmethod
+    def from_dict(cls, data: dict[str, Any]) -> "ArtifactDAGSpec":
+        return cls(enable=data.get("enable", False),
+                   failure_threshold=data.get("failureThreshold", 1),
+                   artifacts=[ArtifactSpec.from_dict(item)
+                              for item in data.get("artifacts", [])])
+
+    def deep_copy(self) -> "ArtifactDAGSpec":
+        return copy.deepcopy(self)

@@ -717,6 +717,15 @@ class UpgradePolicySpec:
     # live serving-endpoint load signals, with safe mid-flight abort.
     # None = the static maxUnavailable applies unchanged.
     capacity: Optional[CapacityBudgetSpec] = None
+    # Beyond-reference: declarative CEL-style hook programs evaluated
+    # sandboxed at the named policy hook points (policy/engine.py).
+    # Typed "Any" to avoid an import cycle (api.policy_spec imports
+    # this module); holds a PolicyHooksSpec. None = no programs.
+    policy_hooks: Optional[Any] = None
+    # Beyond-reference: dependency-ordered multi-artifact upgrade DAG
+    # (policy/dag.py). Holds an ArtifactDAGSpec. None = only the
+    # primary runtime is managed (reference semantics).
+    artifact_dag: Optional[Any] = None
 
     def validate(self) -> None:
         if self.max_parallel_upgrades < 0:
@@ -744,7 +753,7 @@ class UpgradePolicySpec:
         for sub in (self.pod_deletion, self.wait_for_completion, self.drain,
                     self.canary, self.rollback, self.sharding,
                     self.predictor, self.maintenance_window,
-                    self.capacity):
+                    self.capacity, self.policy_hooks, self.artifact_dag):
             if sub is not None:
                 sub.validate()
 
@@ -776,6 +785,10 @@ class UpgradePolicySpec:
             out["maintenanceWindow"] = self.maintenance_window.to_dict()
         if self.capacity is not None:
             out["capacityBudget"] = self.capacity.to_dict()
+        if self.policy_hooks is not None:
+            out["policyHooks"] = self.policy_hooks.to_dict()
+        if self.artifact_dag is not None:
+            out["artifactDAG"] = self.artifact_dag.to_dict()
         return out
 
     @classmethod
@@ -810,6 +823,14 @@ class UpgradePolicySpec:
         if data.get("capacityBudget") is not None:
             spec.capacity = CapacityBudgetSpec.from_dict(
                 data["capacityBudget"])
+        if data.get("policyHooks") is not None:
+            from tpu_operator_libs.api.policy_spec import PolicyHooksSpec
+            spec.policy_hooks = PolicyHooksSpec.from_dict(
+                data["policyHooks"])
+        if data.get("artifactDAG") is not None:
+            from tpu_operator_libs.api.policy_spec import ArtifactDAGSpec
+            spec.artifact_dag = ArtifactDAGSpec.from_dict(
+                data["artifactDAG"])
         return spec
 
     def deep_copy(self) -> "UpgradePolicySpec":

@@ -27,6 +27,7 @@ from tpu_operator_libs.chaos.injector import (
     OperatorCrash,
 )
 from tpu_operator_libs.chaos.invariants import (
+    DagExpectation,
     InvariantMonitor,
     InvariantViolation,
     ReconfigExpectation,
@@ -43,10 +44,12 @@ from tpu_operator_libs.chaos.federation import (
 from tpu_operator_libs.chaos.runner import (
     ChaosConfig,
     ChaosReport,
+    DagChaosConfig,
     ReconfigChaosConfig,
     ReplicaKillConfig,
     run_bad_revision_soak,
     run_chaos_soak,
+    run_dag_soak,
     run_reconfig_soak,
     run_replica_kill_soak,
 )
@@ -74,6 +77,8 @@ from tpu_operator_libs.chaos.schedule import (
 __all__ = [
     "BAD_REVISION_HASH",
     "ChaosConfig",
+    "DagChaosConfig",
+    "DagExpectation",
     "ChaosInjector",
     "ChaosReport",
     "FAULT_API_BURST",
@@ -107,6 +112,7 @@ __all__ = [
     "ShardExpectation",
     "run_bad_revision_soak",
     "run_chaos_soak",
+    "run_dag_soak",
     "run_federation_bad_revision_soak",
     "run_federation_soak",
     "run_reconfig_soak",

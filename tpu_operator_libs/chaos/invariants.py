@@ -242,6 +242,42 @@ class ShardExpectation:
 
 
 @dataclass(frozen=True)
+class DagExpectation:
+    """Arms the multi-artifact upgrade-DAG invariants (policy/dag.py).
+
+    - **dag-order** (always-on, event-sourced): no artifact advances
+      on a node before its dependencies' durable stamps. Two edges are
+      audited from the watch stream: a stamp annotation appearing (or
+      changing) on a node requires every dependency's stamp to already
+      be present on that node, and an artifact POD materializing at a
+      NEW revision on a node requires the same — so neither the
+      annotation nor the pod side of an advancement can jump the DAG,
+      across operator crashes included. ``forbidden`` pins suffix
+      containment: a (artifact, revision) pair that must never appear
+      as a pod (the un-started dependent suffix of a quarantined
+      artifact — its new revision may roll back, never forward).
+    - **policy-sandbox** (fed by :meth:`InvariantMonitor.
+      policy_sample`): the engine's registry must never accumulate an
+      unaudited failure (every hook error/budget overrun produced a
+      DecisionAudit record), and — runner-side — no exception may
+      escape a reconcile while policy hooks are active (park, never
+      wedge).
+    """
+
+    #: artifact name -> its dependency names.
+    deps: "dict[str, tuple]"
+    #: node-annotation key prefix of the revision stamps
+    #: (UpgradeKeys.artifact_stamp_prefix).
+    stamp_prefix: str
+    #: pod "app" label value -> artifact name (pod attribution).
+    apps: "dict[str, str]"
+    #: namespace the artifact DaemonSets/pods live in.
+    runtime_namespace: str = "tpu-system"
+    #: (artifact, revision) pairs that must never run as a pod.
+    forbidden: "tuple" = ()
+
+
+@dataclass(frozen=True)
 class InvariantViolation:
     """One broken safety property, with everything needed to replay it."""
 
@@ -292,6 +328,9 @@ class InvariantMonitor:
     window: Optional[WindowExpectation] = None
     #: Arms the capacity-budget invariants; None disables them.
     capacity: Optional[CapacityExpectation] = None
+    #: Arms the artifact-DAG + policy-sandbox invariants; None
+    #: disables them.
+    dag: Optional[DagExpectation] = None
     #: Returns the CURRENT operator incarnation's
     #: OperatorObservability (rebound by the runner on restart). On any
     #: violation the monitor dumps the subject's audit slice + recent
@@ -380,6 +419,17 @@ class InvariantMonitor:
         self.decisions_recorded = 0
         #: explain() probes run / found empty (teeth evidence).
         self.explains_probed = 0
+        # -- artifact-DAG + policy-sandbox bookkeeping (dag mode) --
+        #: node -> artifact -> last seen revision stamp (from node
+        #: annotation events; survives operator incarnations).
+        self._artifact_stamps: "dict[str, dict[str, str]]" = {}
+        #: (artifact, node) -> last seen pod revision hash.
+        self._artifact_pod_rev: "dict[tuple, str]" = {}
+        #: dag-order edges audited (teeth evidence).
+        self.dag_stamps_seen = 0
+        self.dag_advances_seen = 0
+        #: policy_sample() probes run (teeth evidence).
+        self.policy_samples = 0
         # delay_exempt: the auditor's stream stays live through a
         # watch-delay fault window — the SYSTEM under test sees the
         # lag, the monitor judging it must see ground truth (a lagged
@@ -481,6 +531,31 @@ class InvariantMonitor:
                 for pool, names in members.items():
                     extra = names - self._original_members.get(pool, set())
                     self._joined.update(extra)
+        if self.dag is not None:
+            # re-seed the stamp + pod-revision mirrors from live state:
+            # like the node mirror, a stream gap absorbs unknown
+            # intermediate states assertion-free
+            stamps: "dict[str, dict[str, str]]" = {}
+            for node in nodes:
+                per_node = {}
+                for artifact in self.dag.deps:
+                    value = node.metadata.annotations.get(
+                        self.dag.stamp_prefix + artifact)
+                    if value:
+                        per_node[artifact] = value
+                if per_node:
+                    stamps[node.metadata.name] = per_node
+            self._artifact_stamps = stamps
+            dag_pods = consume_transient(lambda: self.cluster.list_pods(
+                namespace=self.dag.runtime_namespace))
+            for pod in dag_pods:
+                artifact = self.dag.apps.get(
+                    pod.metadata.labels.get("app", ""))
+                pod_hash = pod.metadata.labels.get(
+                    POD_CONTROLLER_REVISION_HASH_LABEL)
+                if artifact and pod_hash and pod.spec.node_name:
+                    self._artifact_pod_rev[(artifact,
+                                            pod.spec.node_name)] = pod_hash
         runtime_ns = None
         if self.rollout is not None:
             runtime_ns = self.rollout.runtime_namespace
@@ -548,6 +623,12 @@ class InvariantMonitor:
         if event_type == DELETED:
             gone = self._nodes.pop(name, None)
             self._record(f"node {name} deleted")
+            if self.dag is not None:
+                # a killed node takes its stamps and pods with it
+                self._artifact_stamps.pop(name, None)
+                for key in [k for k in self._artifact_pod_rev
+                            if k[1] == name]:
+                    del self._artifact_pod_rev[key]
             if self.reconfig is not None and gone is not None \
                     and gone.pool:
                 self._pool_members.get(gone.pool, set()).discard(name)
@@ -559,6 +640,8 @@ class InvariantMonitor:
             self._nodes[name] = new
             if self.reconfig is not None and new.pool:
                 self._pool_members.setdefault(new.pool, set()).add(name)
+            if self.dag is not None:
+                self._check_dag_stamps(name, node)
             self._record(f"node {name} added "
                          f"(upgrade={new.upgrade_state or 'unknown'})")
             return
@@ -588,6 +671,8 @@ class InvariantMonitor:
                 self._record(f"node {name} condemned")
             if old.pool != new.pool:
                 self._on_pool_change(name, old, new)
+        if self.dag is not None:
+            self._check_dag_stamps(name, node)
         if old.upgrade_state != new.upgrade_state:
             self._record(f"node {name} upgrade "
                          f"{old.upgrade_state or 'unknown'} -> "
@@ -601,6 +686,98 @@ class InvariantMonitor:
                          f"{old.remediation_state or 'healthy'} -> "
                          f"{new.remediation_state or 'healthy'}")
             self._check_remediation_edge(name, old, new)
+
+    # -- artifact-DAG + policy-sandbox invariants -------------------------
+    def _check_dag_stamps(self, name: str, node) -> None:
+        """dag-order, stamp side: a revision stamp appearing (or
+        changing) on a node requires every dependency's stamp to be
+        present on the node at that instant — stamps are written one
+        patch each in dependency order, so a crash can truncate the
+        sequence but never reorder it."""
+        dag = self.dag
+        annotations = node.metadata.annotations
+        current: "dict[str, str]" = {}
+        for artifact in dag.deps:
+            value = annotations.get(dag.stamp_prefix + artifact)
+            if value:
+                current[artifact] = value
+        previous = self._artifact_stamps.get(name, {})
+        for artifact, revision in current.items():
+            if previous.get(artifact) == revision:
+                continue
+            self.dag_stamps_seen += 1
+            missing = [dep for dep in dag.deps.get(artifact, ())
+                       if not current.get(dep)]
+            if missing:
+                self._violate(
+                    "dag-order", name,
+                    f"artifact {artifact} stamped at {revision!r} "
+                    f"before dependency stamp(s) {missing} — the "
+                    f"crash-ordered prefix property is broken")
+            else:
+                self._record(f"node {name} artifact {artifact} "
+                             f"stamped {revision}")
+        self._artifact_stamps[name] = current
+
+    def _on_dag_pod(self, event_type: str, pod) -> None:
+        """dag-order, pod side: an artifact pod materializing at a NEW
+        revision on a node requires the dependencies' stamps on that
+        node (the coordinator only deletes-for-upgrade under satisfied
+        deps, and the DS controller recreates at the target) — plus
+        the suffix-containment pin (``forbidden`` revisions never
+        run)."""
+        dag = self.dag
+        artifact = dag.apps.get(pod.metadata.labels.get("app", ""))
+        if artifact is None:
+            return
+        revision = pod.metadata.labels.get(
+            POD_CONTROLLER_REVISION_HASH_LABEL)
+        node_name = pod.spec.node_name
+        if not revision or not node_name or event_type == DELETED:
+            return
+        where = f"pod {pod.metadata.namespace}/{pod.metadata.name}"
+        for bad_artifact, bad_revision in dag.forbidden:
+            if artifact == bad_artifact and revision == bad_revision:
+                self._violate(
+                    "dag-order", where,
+                    f"artifact {artifact} ran revision {revision!r} — "
+                    f"the un-started dependent suffix of a quarantined "
+                    f"artifact must roll back, never forward")
+        key = (artifact, node_name)
+        previous = self._artifact_pod_rev.get(key)
+        self._artifact_pod_rev[key] = revision
+        if event_type != ADDED or previous is None \
+                or previous == revision:
+            return
+        self.dag_advances_seen += 1
+        stamps = self._artifact_stamps.get(node_name, {})
+        missing = [dep for dep in dag.deps.get(artifact, ())
+                   if not stamps.get(dep)]
+        if missing:
+            self._violate(
+                "dag-order", where,
+                f"artifact {artifact} advanced {previous!r} -> "
+                f"{revision!r} on node {node_name} before dependency "
+                f"stamp(s) {missing}")
+        else:
+            self._record(f"artifact {artifact} advanced {previous} -> "
+                         f"{revision} on {node_name}")
+
+    def policy_sample(self, stats: "Optional[dict]") -> None:
+        """One runner probe of the live engine's registry counters
+        (policy-sandbox): every hook failure must have produced an
+        audit record — an unaudited failure means the sandbox parked
+        silently, which is the observability gap the invariant
+        exists to close."""
+        if stats is None:
+            return
+        self.policy_samples += 1
+        unaudited = stats.get("unauditedFailures", 0)
+        if unaudited:
+            self._violate(
+                "policy-sandbox", "engine",
+                f"{unaudited} hook failure(s) produced no DecisionAudit "
+                f"record (stats: {stats})")
 
     # -- slice-reconfiguration invariants ---------------------------------
     def _degraded_lost(self, pool: str) -> int:
@@ -1069,6 +1246,11 @@ class InvariantMonitor:
 
     # -- pod events -------------------------------------------------------
     def _on_pod(self, event_type: str, pod) -> None:
+        if (self.dag is not None and pod.metadata.namespace
+                == self.dag.runtime_namespace):
+            self._on_dag_pod(event_type, pod)
+            # fall through: rollout/reconfig mirrors may share the
+            # namespace when armed together
         if (self.rollout is not None and pod.metadata.namespace
                 == self.rollout.runtime_namespace):
             self._on_runtime_pod(event_type, pod)

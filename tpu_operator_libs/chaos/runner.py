@@ -57,6 +57,7 @@ from tpu_operator_libs.chaos.injector import (
 )
 from tpu_operator_libs.chaos.invariants import (
     CapacityExpectation,
+    DagExpectation,
     InvariantMonitor,
     InvariantViolation,
     ReconfigExpectation,
@@ -2540,6 +2541,423 @@ def run_handover_soak(seed: int,
         explains_probed=monitor.explains_probed)
     report.report_text = "\n".join(
         [schedule.describe(), monitor.report(seed=seed)])
+    if not report.ok:
+        logger.error("%s", report.report_text)
+    return report
+
+
+# ---------------------------------------------------------------------------
+# multi-artifact upgrade-DAG soak (ISSUE 15 — policy/dag.py)
+# ---------------------------------------------------------------------------
+
+#: Broken artifact build injected mid-horizon (single hash segment, the
+#: FakeCluster revision-name rule). Distinct from the primary-runtime
+#: BAD_REVISION_HASH: this one is contained by the DAG coordinator's
+#: quarantine + suffix rollback, not the RolloutGuard.
+BAD_ARTIFACT_HASH = "badart"
+
+
+@dataclass
+class DagChaosConfig(ChaosConfig):
+    """Knobs of one DAG soak episode.
+
+    The fleet runs FOUR DaemonSet-delivered artifacts in a diamond:
+    libtpu (primary) -> {device-plugin, network-driver} -> os-image.
+    Everything the scenario needs is DECLARATIVE — the policy document
+    carries the DAG and the hook programs; the soak makes zero
+    operator-code changes (the acceptance property of ISSUE 15).
+    """
+
+    #: Crash-looping nodes at an artifact's target revision that
+    #: quarantine it. 2: a single crashloop-fault window (one node)
+    #: can never condemn a good revision, while the injected bad
+    #: artifact parks every node it reaches and crosses the threshold.
+    failure_threshold: int = 2
+    #: Delete one seeded node mid-horizon (scale-down "node kill"):
+    #: its stamps and pods vanish mid-DAG and the fleet must converge
+    #: over the survivors.
+    kill_node: bool = True
+    #: Extra headroom over the base soak: every node runs TWO shared
+    #: cordon/drain cycles (initial rollout + the mid-horizon bumps)
+    #: with the bad-artifact containment arc in between.
+    max_steps: int = 2000
+
+    #: artifact name -> DaemonSet/pod labels (the non-primary three).
+    ARTIFACT_LABELS = {
+        "device-plugin": {"app": "tpu-device-plugin"},
+        "network-driver": {"app": "tpu-network-driver"},
+        "os-image": {"app": "node-os-image"},
+    }
+
+    def dag_spec(self) -> "object":
+        from tpu_operator_libs.api.policy_spec import (
+            ArtifactDAGSpec,
+            ArtifactSpec,
+        )
+
+        return ArtifactDAGSpec(
+            enable=True,
+            failure_threshold=self.failure_threshold,
+            artifacts=[
+                ArtifactSpec(name="libtpu",
+                             runtime_labels=dict(RUNTIME_LABELS)),
+                ArtifactSpec(
+                    name="device-plugin",
+                    runtime_labels=dict(
+                        self.ARTIFACT_LABELS["device-plugin"]),
+                    depends_on=["libtpu"]),
+                ArtifactSpec(
+                    name="network-driver",
+                    runtime_labels=dict(
+                        self.ARTIFACT_LABELS["network-driver"]),
+                    depends_on=["libtpu"]),
+                ArtifactSpec(
+                    name="os-image",
+                    runtime_labels=dict(
+                        self.ARTIFACT_LABELS["os-image"]),
+                    depends_on=["device-plugin", "network-driver"]),
+            ])
+
+    def policy_hooks_spec(self) -> "object":
+        """Benign declarative programs on three hook points: the
+        sandbox runs LIVE under the gate (eval counters are the
+        policy-sandbox invariant's teeth) while steering nothing the
+        invariants depend on."""
+        from tpu_operator_libs.api.policy_spec import (
+            HookProgramSpec,
+            PolicyHooksSpec,
+        )
+
+        return PolicyHooksSpec(hooks=[
+            HookProgramSpec(
+                hook="planner.admission",
+                program="fleet.unavailable <= fleet.budget "
+                        "|| fleet.slots >= 0"),
+            HookProgramSpec(
+                hook="eviction.filter",
+                program="size(pods) >= 0 && !has(node.labels, "
+                        "\"chaos/never\")"),
+            HookProgramSpec(
+                hook="validation.verdict",
+                program="node.name != \"\""),
+        ])
+
+    def upgrade_policy(self) -> UpgradePolicySpec:
+        policy = super().upgrade_policy()
+        policy.artifact_dag = self.dag_spec()
+        policy.policy_hooks = self.policy_hooks_spec()
+        return policy
+
+
+def run_dag_soak(seed: int,
+                 config: Optional[DagChaosConfig] = None) -> ChaosReport:
+    """One seeded multi-artifact DAG episode; deterministic in ``seed``.
+
+    The scenario (all of it expressed as policy + spec — the operator
+    code is untouched by the config):
+
+    1. Four artifact DaemonSets (diamond DAG) roll old -> "new" at t0;
+       every node advances all four through ONE shared cordon/drain
+       cycle in dependency order, stamping durable per-artifact
+       revisions.
+    2. Mid-horizon, libtpu and device-plugin bump to "new2", os-image
+       to "new2" — and network-driver to a BROKEN build
+       (:data:`BAD_ARTIFACT_HASH`) whose pods can never become Ready.
+       The coordinator must quarantine it (durable DS annotation,
+       crash-ordered before the rollback), roll network-driver back to
+       "new", and contain the failure to the dependent suffix alone:
+       os-image (un-started, depends on the condemned arc) rolls back
+       to "new" while libtpu/device-plugin keep rolling to "new2".
+    3. The standard compound-fault storm runs throughout (operator
+       crashes inside the stamp seam included), plus one seeded node
+       DELETION mid-horizon (kill_node).
+
+    Always-on invariants: the base catalog + ``dag-order`` (no
+    artifact advances before its dependencies' stamps; the suffix
+    never runs "new2") and ``policy-sandbox`` (hook failures always
+    audited; no pass ever wedges on a policy).
+    """
+    import random as _random
+
+    config = config or DagChaosConfig()
+    victim = None
+    removals: "tuple" = ()
+    all_names = [f"s{s}-h{h}" for s in range(config.n_slices)
+                 for h in range(config.hosts_per_slice)]
+    if config.kill_node:
+        rng = _random.Random(f"dag-kill:{seed}")
+        victim = rng.choice(all_names)
+        removals = ((victim,
+                     config.horizon * (0.25 + 0.35 * rng.random())),)
+    fleet = FleetSpec(
+        n_slices=config.n_slices,
+        hosts_per_slice=config.hosts_per_slice,
+        pod_recreate_delay=config.pod_recreate_delay,
+        pod_ready_delay=config.pod_ready_delay,
+        multislice_jobs=(
+            ("chaos-job", tuple(range(config.n_slices))),),
+        node_removals=removals)
+    cluster, clock, keys = build_fleet(fleet)
+    rem_keys = RemediationKeys()
+    node_names = [n.metadata.name for n in cluster.list_nodes()]
+    surviving = [n for n in node_names if n != victim]
+
+    from tpu_operator_libs.simulate import seed_artifact_daemon_sets
+
+    seed_artifact_daemon_sets(cluster, config.ARTIFACT_LABELS,
+                              revision_hash="old")
+    for name in config.ARTIFACT_LABELS:
+        cluster.bump_daemon_set_revision(NS, name, "new")
+    # the broken network-driver build: pods recreated from it
+    # crash-loop forever — recovery is the coordinator's quarantine +
+    # suffix rollback or nothing
+    cluster.add_pod_ready_gate(
+        lambda pod: pod.metadata.labels.get(
+            POD_CONTROLLER_REVISION_HASH_LABEL) != BAD_ARTIFACT_HASH)
+
+    def mid_horizon_bumps() -> None:
+        cluster.bump_daemon_set_revision(NS, "libtpu", FINAL_REVISION)
+        cluster.bump_daemon_set_revision(NS, "device-plugin",
+                                         FINAL_REVISION)
+        cluster.bump_daemon_set_revision(NS, "network-driver",
+                                         BAD_ARTIFACT_HASH)
+        cluster.bump_daemon_set_revision(NS, "os-image", FINAL_REVISION)
+
+    cluster.schedule_at(config.horizon / 2.0, mid_horizon_bumps)
+
+    # faults target only survivors: a flap/stale action firing against
+    # the deleted victim would crash the SIM, not the system under test
+    schedule = FaultSchedule.generate(
+        seed, surviving, horizon=config.horizon,
+        extra_kinds=config.extra_fault_kinds)
+    injector = ChaosInjector(cluster, schedule,
+                             lease_namespace=config.lease_namespace,
+                             lease_name=config.lease_name)
+    injector.install()
+
+    upgrade_policy = config.upgrade_policy()
+    remediation_policy = config.remediation_policy()
+    dag_spec = upgrade_policy.artifact_dag
+    monitor = InvariantMonitor(
+        cluster=cluster, upgrade_keys=keys, remediation_keys=rem_keys,
+        max_unavailable=upgrade_policy.max_unavailable,
+        remediation_max_unavailable=remediation_policy.max_unavailable,
+        max_parallel_upgrades=config.max_parallel_upgrades,
+        dag=DagExpectation(
+            deps={a.name: tuple(a.depends_on)
+                  for a in dag_spec.artifacts},
+            stamp_prefix=keys.artifact_stamp_prefix,
+            apps={labels["app"]: name for name, labels in
+                  {**config.ARTIFACT_LABELS,
+                   "libtpu": dict(RUNTIME_LABELS)}.items()},
+            runtime_namespace=NS,
+            forbidden=(("os-image", FINAL_REVISION),)))
+
+    incarnations = 1
+    handovers = 0
+    reconciles = 0
+    policy_evals_total = 0
+
+    def engine_stats(op: _OperatorIncarnation) -> "Optional[dict]":
+        engine = op.upgrade.policy_engine
+        if engine is None:
+            return None
+        return engine.registry.stats()
+
+    op = _OperatorIncarnation(cluster, clock, keys, rem_keys, config,
+                              injector, identity="operator-1",
+                              monitor=monitor)
+
+    def next_incarnation(reason: str) -> _OperatorIncarnation:
+        nonlocal incarnations, policy_evals_total
+        incarnations += 1
+        stats = engine_stats(op)
+        if stats is not None:
+            # the dying incarnation's sandbox evidence (counters die
+            # with the process; the teeth total lives in the harness)
+            policy_evals_total += sum(stats["evalsTotal"].values())
+        injector.fuse.reset()
+        monitor.trace.append(
+            f"[t={clock.now():g}] operator restart #{incarnations} "
+            f"({reason}) — rebuilding managers from cluster state alone")
+        return _OperatorIncarnation(
+            cluster, clock, keys, rem_keys, config, injector,
+            identity=f"operator-{incarnations}", monitor=monitor)
+
+    #: artifact -> expected final revision (the containment picture).
+    final_targets = {
+        "libtpu": FINAL_REVISION,
+        "device-plugin": FINAL_REVISION,
+        "network-driver": "new",
+        "os-image": "new",
+    }
+
+    def converged() -> bool:
+        try:
+            nodes = cluster.list_nodes()
+            pods = cluster.list_pods(namespace=NS)
+            nd = cluster.list_daemon_sets(NS, "app=tpu-network-driver")
+        except (ApiServerError, TimeoutError):
+            return False
+        if len(nodes) != len(surviving):
+            return False
+        for node in nodes:
+            labels = node.metadata.labels
+            if labels.get(keys.state_label) != str(UpgradeState.DONE):
+                return False
+            if labels.get(rem_keys.state_label, ""):
+                return False
+            if keys.skip_label in labels:
+                return False
+            if node.is_unschedulable() or not node.is_ready():
+                return False
+            for artifact, target in final_targets.items():
+                if node.metadata.annotations.get(
+                        keys.artifact_stamp_prefix + artifact) != target:
+                    return False
+        # the quarantine record must be durable on the condemned DS
+        if not nd or nd[0].metadata.annotations.get(
+                keys.quarantined_revision_annotation) \
+                != BAD_ARTIFACT_HASH:
+            return False
+        by_app: "dict[str, list]" = {}
+        for pod in pods:
+            if pod.controller_owner() is None:
+                continue
+            by_app.setdefault(
+                pod.metadata.labels.get("app", ""), []).append(pod)
+        app_target = {"libtpu": FINAL_REVISION,
+                      "tpu-device-plugin": FINAL_REVISION,
+                      "tpu-network-driver": "new",
+                      "node-os-image": "new"}
+        for app, target in app_target.items():
+            group = by_app.get(app, [])
+            if len(group) != len(surviving):
+                return False
+            if not all(
+                    p.metadata.labels.get(
+                        POD_CONTROLLER_REVISION_HASH_LABEL) == target
+                    and p.is_ready() for p in group):
+                return False
+        return True
+
+    steps = 0
+    is_converged = False
+    quiesce_ticks = 0
+    while steps < config.max_steps:
+        steps += 1
+        now = clock.now()
+        was_leading = op.elector.is_leader
+        op.elector.try_acquire_or_renew()
+        if was_leading and not op.elector.is_leader:
+            handovers += 1
+            op = next_incarnation("leader election lost")
+            op.elector.try_acquire_or_renew()
+        if op.elector.is_leader:
+            injector.arm_due_crashes(now)
+            op.nudger.pop_due(now)
+            op.nudger.consume_pending()
+            try:
+                op.remediation.reconcile(NS, dict(RUNTIME_LABELS),
+                                         remediation_policy)
+                op.upgrade.reconcile(NS, dict(RUNTIME_LABELS),
+                                     upgrade_policy)
+                reconciles += 1
+            except OperatorCrash:
+                op = next_incarnation("operator crash mid-reconcile")
+            except BuildStateError:
+                pass  # incomplete snapshot; next tick retries
+            except (ApiServerError, ConflictError, NotFoundError):
+                pass  # pass aborted on a transient; next tick retries
+            except Exception as exc:  # noqa: BLE001 — the sandbox
+                # contract: with policy hooks active NOTHING else may
+                # escape a reconcile; an escape IS the wedge the
+                # policy-sandbox invariant forbids
+                monitor.violations.append(InvariantViolation(
+                    invariant="policy-sandbox", at=clock.now(),
+                    subject="operator",
+                    detail=f"reconcile raised through the policy "
+                           f"sandbox: {type(exc).__name__}: {exc}"))
+            if injector.fuse.pending:
+                op = next_incarnation("operator crash (surfaced late)")
+            monitor.policy_sample(engine_stats(op))
+        monitor.drain()
+        if steps % 5 == 0 and op.upgrade.last_state is not None:
+            for parked in monitor.parked_nodes():
+                monitor.audit_explain(parked,
+                                      op.upgrade.explain(parked))
+        try:
+            restore_workload_pods(cluster, fleet)
+        except (ApiServerError, TimeoutError):
+            pass  # injected fault; the JobSet controller retries too
+        monitor.drain()
+        if (now > schedule.last_fault_time
+                and now > config.horizon / 2.0
+                and not injector.fuse.armed
+                and not injector.fuse.pending
+                and converged()):
+            quiesce_ticks += 1
+            if quiesce_ticks >= 3:
+                is_converged = True
+                break
+        else:
+            quiesce_ticks = 0
+        clock.advance(config.reconcile_interval)
+        cluster.step()
+        monitor.drain()
+
+    stats = engine_stats(op)
+    if stats is not None:
+        policy_evals_total += sum(stats["evalsTotal"].values())
+
+    if is_converged:
+        monitor.final_check()
+    else:
+        monitor.violations.append(InvariantViolation(
+            invariant="liveness", at=clock.now(), subject="fleet",
+            detail=f"fleet did not converge within {config.max_steps} "
+                   f"steps ({clock.now():g}s virtual) after the last "
+                   f"fault healed at {schedule.last_fault_time:g}s"))
+
+    # harness sanity: the episode must have exercised what it claims
+    if injector.crashes_fired == 0:
+        monitor.violations.append(InvariantViolation(
+            invariant="harness", at=clock.now(), subject="injector",
+            detail="no operator crash fired — the schedule's crash "
+                   "events never detonated"))
+    if monitor.dag_stamps_seen == 0:
+        monitor.violations.append(InvariantViolation(
+            invariant="harness", at=clock.now(), subject="dag",
+            detail="no artifact revision stamp was ever observed — "
+                   "the DAG coordinator never advanced anything"))
+    if policy_evals_total == 0:
+        monitor.violations.append(InvariantViolation(
+            invariant="harness", at=clock.now(), subject="policy",
+            detail="no policy hook evaluation ran — the sandbox was "
+                   "never exercised"))
+
+    report = ChaosReport(
+        seed=seed,
+        converged=is_converged,
+        violations=list(monitor.violations),
+        fault_kinds=tuple(sorted(schedule.kinds)),
+        crashes_fired=injector.crashes_fired,
+        leader_handovers=handovers,
+        operator_incarnations=incarnations,
+        watch_gaps=monitor.watch_gaps,
+        total_seconds=clock.now(),
+        steps=steps,
+        reconciles=reconciles,
+        trace=list(monitor.trace),
+        decisions_recorded=monitor.decisions_recorded,
+        explains_probed=monitor.explains_probed)
+    report.report_text = "\n".join(
+        [schedule.describe(),
+         f"dag: victim={victim} stamps_seen={monitor.dag_stamps_seen} "
+         f"advances_seen={monitor.dag_advances_seen} "
+         f"policy_evals={policy_evals_total} "
+         f"policy_samples={monitor.policy_samples}",
+         monitor.report(seed=seed)])
     if not report.ok:
         logger.error("%s", report.report_text)
     return report

@@ -573,6 +573,20 @@ class UpgradeKeys:
         return f"{self.domain}/{self.driver}-upgrade.prewarm-ready"
 
     @property
+    def artifact_stamp_prefix(self) -> str:
+        """NODE annotation key PREFIX (``<prefix><artifact-name>``):
+        the durable per-artifact revision stamp of the multi-artifact
+        upgrade DAG (policy/dag.py). ``<value>`` is the revision hash
+        the artifact's pod was observed ready at on this node. Stamps
+        are written through the state provider in DEPENDENCY order,
+        one patch each — an artifact's stamp is only ever written
+        after every dependency's stamp is durable — so a crashed
+        operator resumes the node's DAG from the stamped prefix alone,
+        and the chaos gate's ``dag-order`` invariant can audit the
+        ordering from watch events."""
+        return f"{self.domain}/{self.driver}-upgrade.artifact."
+
+    @property
     def event_reason(self) -> str:
         """Reason string attached to Kubernetes events (util.go:136-139)."""
         return f"{self.driver.upper()}RuntimeUpgrade"

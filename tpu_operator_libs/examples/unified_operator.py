@@ -205,6 +205,130 @@ def build_demo_cluster():
     return cluster, clock
 
 
+def _seed_dag_artifact(cluster, revision: str) -> None:
+    """Add the demo's second artifact: a tpu-device-plugin DaemonSet
+    with one ready pod per TPU node at ``revision``."""
+    from tpu_operator_libs.k8s.objects import (
+        ContainerStatus,
+        DaemonSet,
+        DaemonSetSpec,
+        DaemonSetStatus,
+        ObjectMeta,
+        OwnerReference,
+        Pod,
+        PodPhase,
+        PodSpec,
+        PodStatus,
+    )
+
+    ns = "kube-system"
+    labels = {"app": "tpu-device-plugin"}
+    tpu_nodes = [n for n in cluster.list_nodes()
+                 if n.metadata.name.startswith("tpu-")]
+    ds = cluster.add_daemon_set(DaemonSet(
+        metadata=ObjectMeta(name="tpu-device-plugin", namespace=ns,
+                            labels=dict(labels)),
+        spec=DaemonSetSpec(selector=dict(labels)),
+        status=DaemonSetStatus(
+            desired_number_scheduled=len(tpu_nodes))),
+        revision_hash=revision)
+    for node in tpu_nodes:
+        cluster.add_pod(Pod(
+            metadata=ObjectMeta(
+                name=f"tpu-device-plugin-{node.metadata.name}",
+                namespace=ns,
+                labels={**labels,
+                        "controller-revision-hash": revision},
+                owner_references=[OwnerReference(
+                    kind="DaemonSet", name="tpu-device-plugin",
+                    uid=ds.metadata.uid)]),
+            spec=PodSpec(node_name=node.metadata.name),
+            status=PodStatus(
+                phase=PodPhase.RUNNING,
+                container_statuses=[
+                    ContainerStatus(name="plugin", ready=True)])))
+
+
+def run_dag_episode(cluster, clock, multi,
+                    registry: MetricsRegistry, latest_status: dict,
+                    interval_sim_s: float = 10.0) -> int:
+    """Episode 2: a TWO-ARTIFACT upgrade DAG, purely declarative.
+
+    The TPU accelerator's policy document grows an ``artifactDAG``
+    (libtpu -> tpu-device-plugin) and a sandboxed ``policyHooks``
+    admission program — zero operator-code changes — then both
+    DaemonSets bump one revision and every TPU node advances BOTH
+    artifacts through ONE shared cordon/drain cycle in dependency
+    order, leaving durable per-artifact revision stamps.
+    """
+    from tpu_operator_libs.api.policy_spec import (
+        ArtifactDAGSpec,
+        ArtifactSpec,
+        HookProgramSpec,
+        PolicyHooksSpec,
+    )
+
+    ns = "kube-system"
+    logger.info("episode 2: declarative two-artifact DAG upgrade "
+                "(libtpu -> tpu-device-plugin)")
+    _seed_dag_artifact(cluster, revision="dp1")
+    tpu = multi.policy.accelerators["tpu"]
+    tpu.policy.artifact_dag = ArtifactDAGSpec(
+        enable=True,
+        artifacts=[
+            ArtifactSpec(name="libtpu",
+                         runtime_labels={"app": "libtpu"}),
+            ArtifactSpec(name="device-plugin",
+                         runtime_labels={"app": "tpu-device-plugin"},
+                         depends_on=["libtpu"]),
+        ])
+    tpu.policy.policy_hooks = PolicyHooksSpec(hooks=[
+        HookProgramSpec(hook="planner.admission",
+                        program="fleet.unavailable <= fleet.budget")])
+    tpu.policy.validate()
+    # both artifacts roll one revision forward
+    cluster.bump_daemon_set_revision(ns, "libtpu", "new2")
+    cluster.bump_daemon_set_revision(ns, "tpu-device-plugin", "dp2")
+
+    manager = multi.managers["tpu"]
+    stamp_prefix = manager.keys.artifact_stamp_prefix
+    deadline = time.monotonic() + 120
+    while time.monotonic() < deadline:
+        reconcile_pass(multi, registry, latest_status)
+        tpu_nodes = [n for n in cluster.list_nodes()
+                     if n.metadata.name.startswith("tpu-")]
+        complete = all(
+            n.metadata.labels.get(manager.keys.state_label)
+            == "upgrade-done"
+            and n.metadata.annotations.get(
+                stamp_prefix + "libtpu") == "new2"
+            and n.metadata.annotations.get(
+                stamp_prefix + "device-plugin") == "dp2"
+            for n in tpu_nodes)
+        if complete:
+            block = latest_status.get("tpu", {})
+            logger.info("DAG episode complete in %.0fs simulated: "
+                        "both artifacts advanced through one shared "
+                        "cordon/drain cycle per node", clock.now())
+            print(json.dumps({
+                "artifactDAG": block.get("artifactDAG"),
+                "policy": block.get("policy"),
+                "stamps": {
+                    n.metadata.name: {
+                        "libtpu": n.metadata.annotations.get(
+                            stamp_prefix + "libtpu"),
+                        "device-plugin": n.metadata.annotations.get(
+                            stamp_prefix + "device-plugin"),
+                    } for n in tpu_nodes},
+            }, indent=2))
+            return 0
+        clock.advance(interval_sim_s)
+        cluster.step()
+    logger.error("DAG episode did not converge; status: %s",
+                 latest_status.get("tpu"))
+    return 1
+
+
 def run_demo(registry: MetricsRegistry, latest_status: dict,
              interval_sim_s: float = 10.0) -> int:
     cluster, clock = build_demo_cluster()
@@ -226,7 +350,9 @@ def run_demo(registry: MetricsRegistry, latest_status: dict,
         if done and len(latest_status) == len(policy.accelerators):
             logger.info("demo complete in %.0fs simulated", clock.now())
             print(json.dumps(latest_status, indent=2))
-            return 0
+            # episode 2: the declarative two-artifact DAG upgrade
+            return run_dag_episode(cluster, clock, multi, registry,
+                                   latest_status, interval_sim_s)
         clock.advance(interval_sim_s)
         cluster.step()
     logger.error("demo did not converge; status: %s", latest_status)

@@ -1238,6 +1238,105 @@ def observe_journeys(registry: MetricsRegistry, obs: "object",
         "Audit records evicted by the bounded ring", labels)
 
 
+#: Hook evaluations are microsecond-to-millisecond scale (the wall
+#: budget ceiling is 1s); buckets resolve the budget band.
+POLICY_EVAL_SECONDS_BUCKETS = (0.00005, 0.0001, 0.00025, 0.0005,
+                               0.001, 0.0025, 0.005, 0.01, 0.025,
+                               0.1, 1.0)
+
+
+def observe_policy(registry: MetricsRegistry,
+                   manager: "ClusterUpgradeStateManager",
+                   driver: str = "libtpu") -> None:
+    """Export the declarative policy engine + artifact DAG evidence.
+
+    No-op until a policy carrying ``policyHooks``/``artifactDAG`` has
+    run. Three families:
+
+    - ``policy_hook_eval_seconds`` — per-hook evaluation duration
+      histogram (drained from the registry's sample buffer), with a
+      trace-id exemplar from the most recent open journey so a slow
+      hook links straight to the node journey it gated;
+    - sandbox counters — per-hook errors, budget overruns and denies
+      (``policy_hook_errors_total`` / ``_budget_exceeded_total`` /
+      ``_denies_total``; the first two moving means programs are
+      PARKING nodes, which the decision audit explains), plus the
+      ``policy_active_hooks`` gauge (how many programs/callables are
+      live per hook point) and ``policy_holds_total``;
+    - artifact-DAG counters — stamps, pod advances, quarantines,
+      suffix rollbacks and failure verdicts (``policy_dag_*``), the
+      multi-artifact upgrade's progress/containment picture.
+    """
+    engine = getattr(manager, "policy_engine", None)
+    labels = {"driver": driver}
+    if engine is not None:
+        obs = getattr(manager, "observability", None)
+        exemplar = None
+        if obs is not None:
+            for phase in ("validate", "restart", "drain"):
+                exemplar = obs.tracer.last_trace_for_phase(phase)
+                if exemplar is not None:
+                    break
+        hook_registry = engine.registry
+        for hook, seconds in hook_registry.drain_eval_samples():
+            registry.observe_histogram(
+                "policy_hook_eval_seconds", seconds,
+                "Sandboxed policy-hook evaluation durations",
+                {**labels, "hook": hook},
+                buckets=POLICY_EVAL_SECONDS_BUCKETS,
+                exemplar_trace_id=exemplar)
+        for hook, count in hook_registry.active_hooks.items():
+            registry.set_gauge(
+                "policy_active_hooks", count,
+                "Live registrations (programs + callables) per hook "
+                "point", {**labels, "hook": hook})
+        for hook, count in hook_registry.errors_total.items():
+            registry.set_counter_total(
+                "policy_hook_errors_total", count,
+                "Hook evaluations that raised (admission hooks park "
+                "fail-closed, audited)", {**labels, "hook": hook})
+        for hook, count in hook_registry.budget_exceeded_total.items():
+            registry.set_counter_total(
+                "policy_hook_budget_exceeded_total", count,
+                "Evaluations past their step/wall budget (park with "
+                "policy-budget, audited)", {**labels, "hook": hook})
+        for hook, count in hook_registry.denies_total.items():
+            registry.set_counter_total(
+                "policy_hook_denies_total", count,
+                "Clean program denials (holds by verdict)",
+                {**labels, "hook": hook})
+        registry.set_counter_total(
+            "policy_holds_total", engine.holds_total,
+            "Admission candidates held by policy hooks", labels)
+    dag = getattr(manager, "dag_coordinator", None)
+    if dag is None:
+        return
+    registry.set_counter_total(
+        "policy_dag_stamps_total", dag.stamps_total,
+        "Durable per-artifact revision stamps written (DAG order)",
+        labels)
+    registry.set_counter_total(
+        "policy_dag_pods_advanced_total", dag.pods_advanced_total,
+        "Artifact pods advanced (deleted for recreate at target)",
+        labels)
+    registry.set_counter_total(
+        "policy_dag_quarantines_total", dag.quarantines_total,
+        "Artifact revisions quarantined on crash-loop verdicts",
+        labels)
+    registry.set_counter_total(
+        "policy_dag_suffix_rollbacks_total", dag.suffix_rollbacks_total,
+        "Dependent artifacts rolled back by suffix containment",
+        labels)
+    registry.set_counter_total(
+        "policy_dag_failure_verdicts_total", dag.failure_verdicts_total,
+        "Distinct (artifact, revision, node) crash-loop verdicts",
+        labels)
+    registry.set_counter_total(
+        "policy_dag_upgrade_requests_total", dag.upgrade_requests_total,
+        "Idle nodes re-entered for out-of-sync artifacts",
+        labels)
+
+
 def observe_federation(registry: MetricsRegistry,
                        controller: "object",
                        driver: str = "libtpu") -> None:

@@ -1,0 +1,56 @@
+"""Declarative policy engine: sandboxed hooks + multi-artifact DAGs.
+
+- :mod:`tpu_operator_libs.policy.expr` — the CEL-style sandboxed
+  expression language (parse once, evaluate under step/wall budgets).
+- :mod:`tpu_operator_libs.policy.hooks` — the unified hook-point
+  catalog + registry (Python callables and CRD programs behind one
+  named, versioned surface; fail-closed admission / fail-open
+  observation).
+- :mod:`tpu_operator_libs.policy.engine` — binds a
+  :class:`~tpu_operator_libs.api.policy_spec.PolicyHooksSpec` into the
+  state manager's seams.
+- :mod:`tpu_operator_libs.policy.dag` — the
+  :class:`ArtifactDAGCoordinator` driving dependency-ordered
+  multi-artifact upgrades through one shared cordon/drain cycle per
+  node.
+
+See docs/policy-engine.md.
+"""
+
+from tpu_operator_libs.policy.dag import ArtifactDAGCoordinator
+from tpu_operator_libs.policy.engine import (
+    PolicyAdmissionPlanner,
+    PolicyEngine,
+    PolicyEvictionGate,
+)
+from tpu_operator_libs.policy.expr import (
+    EvalBudgetExceeded,
+    PolicyEvalError,
+    PolicyExprError,
+    Program,
+    parse,
+)
+from tpu_operator_libs.policy.hooks import (
+    HOOK_POINTS,
+    HookPoint,
+    HookVerdict,
+    PolicyHookRegistry,
+    UnknownHookError,
+)
+
+__all__ = [
+    "ArtifactDAGCoordinator",
+    "PolicyAdmissionPlanner",
+    "PolicyEngine",
+    "PolicyEvictionGate",
+    "EvalBudgetExceeded",
+    "PolicyEvalError",
+    "PolicyExprError",
+    "Program",
+    "parse",
+    "HOOK_POINTS",
+    "HookPoint",
+    "HookVerdict",
+    "PolicyHookRegistry",
+    "UnknownHookError",
+]

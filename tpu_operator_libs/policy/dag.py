@@ -1,0 +1,619 @@
+"""ArtifactDAGCoordinator: dependency-ordered multi-artifact upgrades.
+
+The driving scenario of the policy engine (ISSUE 15, "The Kubernetes
+Network Driver Model" in PAPERS.md): a node runs several
+DaemonSet-delivered artifacts — libtpu, the TPU device plugin, the
+network driver, the node OS-image agent — whose upgrades are
+dependency-ordered (the device plugin and network driver need the new
+libtpu ABI; the OS-image agent needs both). Upgrading them as four
+independent rollouts would cordon/drain every node four times; this
+coordinator advances ALL of them through the node's ONE cordon/drain
+cycle, in DAG order, purely from declarative data
+(:class:`~tpu_operator_libs.api.policy_spec.ArtifactDAGSpec`) — zero
+operator-code changes per scenario.
+
+Mechanics, per reconcile pass (all re-derived from cluster state —
+the coordinator holds no durable state of its own):
+
+1. **Targets.** Each artifact's target revision is its DaemonSet's
+   newest ControllerRevision (the same oracle the primary machine
+   uses); a quarantined newest falls back to the restored previous.
+2. **Verdicts → quarantine → suffix rollback.** An artifact pod
+   crash-looping AT its target revision is a failure verdict; at
+   ``failureThreshold`` distinct nodes the revision is quarantined
+   (durable DS annotation FIRST — the crash-ordered commit, the PR 4
+   idiom), the artifact's DaemonSet is rolled back to the previous
+   revision, and every transitive dependent whose own newest revision
+   has landed on no node yet (zero stamps — the un-started suffix) is
+   rolled back with it. Artifacts outside the dependent suffix are
+   untouched and keep rolling forward.
+3. **Trigger.** An idle (done/unknown) node whose artifact pods are
+   out of sync with their targets gets the one-shot
+   ``upgrade-requested`` annotation — the state machine's existing
+   re-entry trigger — so a bump of ANY artifact drives the full
+   shared cordon/drain cycle.
+4. **Advance.** For each node in ``validation-required`` (cordoned,
+   drained, primary runtime already restarted by the machine): walk
+   the artifacts in topological order; the primary is stamped from
+   its in-sync runtime pod; every other artifact may act only once
+   ALL its dependencies carry stamps equal to their targets
+   (**dag-order**) — an out-of-sync pod is deleted (the DS controller
+   recreates it at the target), and a ready pod at the target writes
+   the artifact's durable revision stamp. Stamps are node
+   annotations written through the state provider (crash-fused,
+   shard-fenced), one patch each, in dependency order — so a crash at
+   any point leaves a durable DAG prefix the next incarnation resumes
+   from.
+5. **Gate.** :meth:`node_complete` parks the node in validation until
+   every applicable artifact is stamped at its target; the
+   ValidationManager treats an incomplete DAG as a park (no failure
+   timer — progress comes from the DS controller, liveness from the
+   chaos gate's convergence check).
+"""
+
+from __future__ import annotations
+
+import logging
+from typing import TYPE_CHECKING, Callable, Optional
+
+from tpu_operator_libs.consts import (
+    POD_CONTROLLER_REVISION_HASH_LABEL,
+    TRUE_STRING,
+    UpgradeKeys,
+    UpgradeState,
+)
+from tpu_operator_libs.k8s.client import (
+    ApiServerError,
+    ConflictError,
+    K8sClient,
+    NotFoundError,
+)
+from tpu_operator_libs.k8s.selectors import selector_from_labels
+from tpu_operator_libs.util import Clock
+
+if TYPE_CHECKING:  # pragma: no cover - import cycle guard
+    # (api.policy_spec imports policy.expr; this module is pulled in
+    # by policy/__init__, so the spec types are annotation-only here)
+    from tpu_operator_libs.api.policy_spec import (
+        ArtifactDAGSpec,
+        ArtifactSpec,
+    )
+    from tpu_operator_libs.k8s.objects import DaemonSet, Node, Pod
+    from tpu_operator_libs.upgrade.state_manager import ClusterUpgradeState
+    from tpu_operator_libs.upgrade.state_provider import (
+        NodeUpgradeStateProvider,
+    )
+
+logger = logging.getLogger(__name__)
+
+#: Transient cluster errors: the affected artifact/node simply waits
+#: for the next pass (the manager's per-node deferral semantics).
+_TRANSIENT = (ApiServerError, ConflictError, NotFoundError)
+
+
+class _ArtifactView:
+    """One artifact's resolved per-pass picture."""
+
+    __slots__ = ("spec", "ds", "newest", "target", "quarantined",
+                 "primary", "pods_by_node")
+
+    def __init__(self, spec: ArtifactSpec) -> None:
+        self.spec = spec
+        self.ds: "Optional[DaemonSet]" = None
+        self.newest = ""          # newest ControllerRevision hash
+        self.target = ""          # newest, or previous when quarantined
+        self.quarantined = ""     # the condemned hash (DS annotation)
+        self.primary = False
+        self.pods_by_node: "dict[str, Pod]" = {}
+
+
+class ArtifactDAGCoordinator:
+    """Drives every non-primary artifact through the shared cycle."""
+
+    def __init__(self, client: K8sClient, keys: UpgradeKeys,
+                 provider: "NodeUpgradeStateProvider",
+                 clock: Optional[Clock] = None,
+                 audit: "Optional[Callable[..., None]]" = None,
+                 pod_failure_threshold: int = 10) -> None:
+        self.client = client
+        self.keys = keys
+        self.provider = provider
+        self.clock = clock or Clock()
+        #: audit(kind, subject, decision, rule, inputs) — the
+        #: DecisionAudit bridge (None = silent).
+        self.audit = audit
+        self.pod_failure_threshold = pod_failure_threshold
+        self.spec: Optional[ArtifactDAGSpec] = None
+        self._order: "list[ArtifactSpec]" = []
+        #: per-pass views keyed by artifact name.
+        self._views: "dict[str, _ArtifactView]" = {}
+        #: pods this INCARNATION deleted for an upgrade (advisory only:
+        #: avoids re-deleting while the event is in flight; a fresh
+        #: incarnation re-derives intent from pod-vs-target alone).
+        self._deleted_pod_uids: "set[str]" = set()
+        #: (artifact, node) pairs with a deletion in flight — keeps
+        #: node_complete parked through the recreate gap (advisory for
+        #: the same reason; a crash here at worst skips one stamp,
+        #: which the next rollout rewrites).
+        self._deleted_for: "set[tuple[str, str]]" = set()
+        #: lifetime counters (metrics / gate-teeth evidence)
+        self.stamps_total = 0
+        self.pods_advanced_total = 0
+        self.quarantines_total = 0
+        self.suffix_rollbacks_total = 0
+        self.upgrade_requests_total = 0
+        self.failure_verdicts_total = 0
+        self._verdicts_seen: "set[tuple[str, str, str]]" = set()
+
+    # ------------------------------------------------------------------
+    # spec lifecycle
+    # ------------------------------------------------------------------
+    def refresh(self, spec: ArtifactDAGSpec) -> None:
+        """Install the pass's spec (reference re-read semantics)."""
+        self.spec = spec
+        self._order = spec.topo_order()
+
+    @property
+    def active(self) -> bool:
+        return (self.spec is not None and self.spec.enable
+                and bool(self._order))
+
+    def stamp_key(self, artifact: str) -> str:
+        return f"{self.keys.artifact_stamp_prefix}{artifact}"
+
+    # ------------------------------------------------------------------
+    # the per-pass walk
+    # ------------------------------------------------------------------
+    def advance(self, state: "ClusterUpgradeState", namespace: str,
+                runtime_labels: "dict[str, str]") -> None:
+        """One coordinator pass over the snapshot. Transient cluster
+        errors defer the affected artifact or node; nothing here may
+        wedge the reconcile (hard crashes from the provider's fused
+        writes do propagate — they ARE the simulated process death)."""
+        if not self.active:
+            return
+        self._resolve_views(namespace, runtime_labels)
+        self._assess_revisions()
+        self._request_idle_upgrades(state)
+        for ns in state.bucket(UpgradeState.VALIDATION_REQUIRED):
+            self._advance_node(ns.node)
+
+    def _resolve_views(self, namespace: str,
+                       runtime_labels: "dict[str, str]") -> None:
+        self._views = {}
+        for spec in self._order:
+            view = _ArtifactView(spec)
+            view.primary = (spec.runtime_labels == runtime_labels)
+            ns = spec.namespace or namespace
+            selector = selector_from_labels(spec.runtime_labels)
+            try:
+                ds_list = self.client.list_daemon_sets(ns, selector)
+                view.ds = ds_list[0] if ds_list else None
+                if view.ds is not None:
+                    view.newest = self._newest_revision(ns, view.ds)
+                    view.quarantined = view.ds.metadata.annotations.get(
+                        self.keys.quarantined_revision_annotation, "")
+                    view.target = view.newest
+                    if view.quarantined and view.quarantined == view.newest:
+                        # between the quarantine commit and the DS
+                        # rollback: target the previous revision
+                        view.target = self._previous_revision(
+                            ns, view.ds, view.newest)
+                    for pod in self.client.list_pods(
+                            namespace=ns, label_selector=selector):
+                        node_name = pod.spec.node_name
+                        if node_name:
+                            view.pods_by_node[node_name] = pod
+            except _TRANSIENT as exc:
+                logger.warning(
+                    "artifact %s unresolvable this pass: %s",
+                    spec.name, exc)
+                view.ds = None
+            self._views[spec.name] = view
+
+    def _newest_revision(self, namespace: str, ds: "DaemonSet") -> str:
+        prefix = f"{ds.metadata.name}-"
+        revs = [rev for rev in self.client.list_controller_revisions(
+                    namespace, selector_from_labels(ds.spec.selector))
+                if rev.metadata.name.startswith(prefix)
+                and "-" not in rev.metadata.name[len(prefix):]]
+        if not revs:
+            return ""
+        return max(revs, key=lambda rev: rev.revision).hash
+
+    def _previous_revision(self, namespace: str, ds: "DaemonSet",
+                           newest: str) -> str:
+        prefix = f"{ds.metadata.name}-"
+        revs = [rev for rev in self.client.list_controller_revisions(
+                    namespace, selector_from_labels(ds.spec.selector))
+                if rev.metadata.name.startswith(prefix)
+                and "-" not in rev.metadata.name[len(prefix):]
+                and rev.hash != newest]
+        if not revs:
+            return newest  # single-revision history: nothing to fall to
+        return max(revs, key=lambda rev: rev.revision).hash
+
+    # ------------------------------------------------------------------
+    # bad-revision containment (the PR 4 rollback arc, per artifact)
+    # ------------------------------------------------------------------
+    def _assess_revisions(self) -> None:
+        spec = self.spec
+        for view in self._views.values():
+            if view.primary or view.ds is None or not view.newest:
+                # the PRIMARY artifact's verdicts belong to the
+                # RolloutGuard (canary/halt/rollback machinery)
+                continue
+            if view.quarantined == view.newest:
+                # durable quarantine commit exists but the rollback has
+                # not landed yet (crash between the two): finish it —
+                # idempotent, rollback_daemon_set no-ops once newest
+                # moved
+                self._contain(view)
+                continue
+            failures = {
+                node_name
+                for node_name, pod in view.pods_by_node.items()
+                if pod.metadata.labels.get(
+                    POD_CONTROLLER_REVISION_HASH_LABEL) == view.newest
+                and pod.is_failing(self.pod_failure_threshold)}
+            for node_name in failures:
+                key = (view.spec.name, view.newest, node_name)
+                if key not in self._verdicts_seen:
+                    self._verdicts_seen.add(key)
+                    self.failure_verdicts_total += 1
+            if len(failures) >= spec.failure_threshold:
+                self._quarantine(view, failures)
+
+    def _quarantine(self, view: _ArtifactView,
+                    failures: "set[str]") -> None:
+        ds = view.ds
+        try:
+            fresh = self.client.patch_daemon_set_annotations(
+                ds.metadata.namespace, ds.metadata.name,
+                {self.keys.quarantined_revision_annotation: view.newest})
+        except _TRANSIENT as exc:
+            logger.warning("artifact %s quarantine commit deferred: %s",
+                           view.spec.name, exc)
+            return
+        ds.metadata.annotations = fresh.metadata.annotations
+        view.quarantined = view.newest
+        self.quarantines_total += 1
+        logger.warning(
+            "ARTIFACT QUARANTINE: revision %s of artifact %s condemned "
+            "(%d crash-looping node(s): %s)", view.newest,
+            view.spec.name, len(failures), sorted(failures))
+        self._audit("artifact", "", "quarantine", "artifact-quarantine",
+                    {"artifact": view.spec.name,
+                     "revision": view.newest,
+                     "failures": sorted(failures)})
+        self._contain(view)
+
+    def _contain(self, view: _ArtifactView) -> None:
+        """Roll the quarantined artifact back, then its un-started
+        dependent suffix — and nothing else."""
+        previous = self._previous_revision(
+            view.spec.namespace or view.ds.metadata.namespace,
+            view.ds, view.quarantined)
+        try:
+            self.client.rollback_daemon_set(
+                view.ds.metadata.namespace, view.ds.metadata.name,
+                previous)
+        except _TRANSIENT as exc:
+            logger.warning("artifact %s rollback deferred: %s",
+                           view.spec.name, exc)
+            return
+        view.newest = previous
+        view.target = previous
+        self._audit("artifact", "", "rollback", "artifact-rollback",
+                    {"artifact": view.spec.name, "to": previous})
+        stamped = self._stamped_revisions()
+        for dependent in self.spec.dependents_of(view.spec.name):
+            dep_view = self._views.get(dependent)
+            if dep_view is None or dep_view.ds is None \
+                    or dep_view.primary or not dep_view.newest:
+                continue
+            if dep_view.newest in stamped.get(dependent, ()):
+                # the dependent's new revision already landed on some
+                # node — it is mid-rollout on its own merits, not an
+                # un-started suffix; containment leaves it alone
+                continue
+            dep_previous = self._previous_revision(
+                dep_view.spec.namespace or dep_view.ds.metadata.namespace,
+                dep_view.ds, dep_view.newest)
+            if dep_previous == dep_view.newest:
+                continue  # no older revision to fall back to
+            try:
+                self.client.rollback_daemon_set(
+                    dep_view.ds.metadata.namespace,
+                    dep_view.ds.metadata.name, dep_previous)
+            except _TRANSIENT as exc:
+                logger.warning("dependent %s suffix rollback deferred: "
+                               "%s", dependent, exc)
+                continue
+            dep_view.newest = dep_previous
+            dep_view.target = dep_previous
+            self.suffix_rollbacks_total += 1
+            logger.warning(
+                "artifact %s rolled back to %s: un-started dependent "
+                "suffix of quarantined %s", dependent, dep_previous,
+                view.spec.name)
+            self._audit("artifact", "", "rollback",
+                        "artifact-suffix-rollback",
+                        {"artifact": dependent, "to": dep_previous,
+                         "quarantined": view.spec.name})
+
+    def _stamped_revisions(self) -> "dict[str, set[str]]":
+        """artifact -> set of revision hashes stamped on ANY node
+        (from the node annotations the provider reads — durable
+        truth)."""
+        out: "dict[str, set[str]]" = {}
+        try:
+            nodes = self.client.list_nodes()
+        except _TRANSIENT:
+            return out
+        for node in nodes:
+            for artifact in self._views:
+                stamp = node.metadata.annotations.get(
+                    self.stamp_key(artifact))
+                if stamp:
+                    out.setdefault(artifact, set()).add(stamp)
+        return out
+
+    # ------------------------------------------------------------------
+    # re-entry trigger
+    # ------------------------------------------------------------------
+    def _request_idle_upgrades(self, state: "ClusterUpgradeState") -> None:
+        """Idle nodes with any out-of-sync artifact pod re-enter the
+        machine via the one-shot upgrade-requested annotation (consumed
+        at admission) — a device-plugin-only bump still drives the full
+        shared cordon/drain cycle."""
+        for bucket in (UpgradeState.DONE, UpgradeState.UNKNOWN):
+            for ns in state.bucket(bucket):
+                node = ns.node
+                if node.metadata.annotations.get(
+                        self.keys.upgrade_requested_annotation) \
+                        == TRUE_STRING:
+                    continue
+                if not self._node_needs_artifacts(node.metadata.name):
+                    continue
+                try:
+                    self.provider.change_node_upgrade_annotation(
+                        node, self.keys.upgrade_requested_annotation,
+                        TRUE_STRING)
+                except _TRANSIENT as exc:
+                    logger.warning(
+                        "artifact upgrade request for node %s "
+                        "deferred: %s", node.metadata.name, exc)
+                    continue
+                self.upgrade_requests_total += 1
+                self._audit("artifact", node.metadata.name,
+                            "upgrade-requested", "artifact-out-of-sync",
+                            {"artifacts": self._stale_artifacts(
+                                node.metadata.name)})
+
+    def _node_needs_artifacts(self, node_name: str) -> bool:
+        return bool(self._stale_artifacts(node_name))
+
+    def _stale_artifacts(self, node_name: str) -> "list[str]":
+        stale = []
+        for view in self._views.values():
+            if view.primary or view.ds is None or not view.target:
+                continue
+            pod = view.pods_by_node.get(node_name)
+            if pod is None:
+                continue  # artifact not scheduled here (or mid-recreate)
+            if pod.metadata.labels.get(
+                    POD_CONTROLLER_REVISION_HASH_LABEL) != view.target:
+                stale.append(view.spec.name)
+        return sorted(stale)
+
+    # ------------------------------------------------------------------
+    # the in-cycle DAG walk
+    # ------------------------------------------------------------------
+    def _advance_node(self, node: "Node") -> None:
+        """Advance one cordoned node's artifacts in topological order.
+        Each stamp is its own durable patch, written only once every
+        dependency stamp is durable — the crash-ordered prefix
+        property."""
+        name = node.metadata.name
+        for spec in self._order:
+            view = self._views.get(spec.name)
+            if view is None or view.ds is None or not view.target:
+                continue
+            stamp = node.metadata.annotations.get(
+                self.stamp_key(spec.name))
+            if view.primary:
+                if stamp != view.target:
+                    self._stamp_primary(node, view)
+                continue
+            pod = view.pods_by_node.get(name)
+            pod_rev = (pod.metadata.labels.get(
+                POD_CONTROLLER_REVISION_HASH_LABEL)
+                if pod is not None else None)
+            if stamp == view.target \
+                    and (pod is None or pod_rev == view.target):
+                continue  # fully advanced (this or a prior cycle)
+            # NOTE stamp==target with a STALE pod still falls through:
+            # a re-bump can land between a cycle's stamp and a later
+            # rollback making the old stamp "current" again while the
+            # pod sits on the condemned revision — the pod's sync is
+            # the truth, the stamp only orders it
+            if not self._deps_satisfied(node, spec):
+                # dag-order: neither the stamp nor the pod advance may
+                # precede the dependencies' stamps — stop this
+                # artifact here; it is reconsidered next pass (or next
+                # cycle when the dependency can only move then)
+                continue
+            if pod is None:
+                continue  # DS controller recreating; wait
+            if pod_rev != view.target:
+                self._advance_pod(node, view, pod)
+                continue
+            if not pod.is_ready():
+                continue  # recreated at target; readiness pending
+            if stamp == view.target:
+                continue  # re-synced pod under an already-current stamp
+            try:
+                self.provider.change_node_upgrade_annotation(
+                    node, self.stamp_key(spec.name), view.target)
+            except _TRANSIENT as exc:
+                logger.warning("artifact %s stamp on node %s deferred: "
+                               "%s", spec.name, name, exc)
+                continue
+            self.stamps_total += 1
+            self._audit("artifact", name, "stamp", "dag-order",
+                        {"artifact": spec.name, "revision": view.target})
+            logger.info("artifact %s stamped at %s on node %s",
+                        spec.name, view.target, name)
+
+    def _stamp_primary(self, node: "Node", view: _ArtifactView) -> None:
+        """The primary artifact is driven by the machine's own
+        pod-restart arc; its stamp just records the in-sync revision so
+        dependents gate on durable state, not a pod read."""
+        pod = view.pods_by_node.get(node.metadata.name)
+        if pod is None or not pod.is_ready():
+            return
+        pod_rev = pod.metadata.labels.get(
+            POD_CONTROLLER_REVISION_HASH_LABEL)
+        if pod_rev != view.target:
+            return
+        try:
+            self.provider.change_node_upgrade_annotation(
+                node, self.stamp_key(view.spec.name), view.target)
+        except _TRANSIENT as exc:
+            logger.warning("primary stamp on node %s deferred: %s",
+                           node.metadata.name, exc)
+            return
+        self.stamps_total += 1
+        self._audit("artifact", node.metadata.name, "stamp", "dag-order",
+                    {"artifact": view.spec.name,
+                     "revision": view.target})
+
+    def _deps_satisfied(self, node: "Node", spec: ArtifactSpec) -> bool:
+        for dep in spec.depends_on:
+            dep_view = self._views.get(dep)
+            if dep_view is None or not dep_view.target:
+                return False
+            if node.metadata.annotations.get(self.stamp_key(dep)) \
+                    != dep_view.target:
+                return False
+        return True
+
+    def _advance_pod(self, node: "Node", view: _ArtifactView,
+                     pod: "Pod") -> None:
+        if pod.metadata.uid in self._deleted_pod_uids:
+            return  # deletion already dispatched; recreate in flight
+        try:
+            self.client.delete_pod(pod.metadata.namespace,
+                                   pod.metadata.name)
+        except _TRANSIENT as exc:
+            logger.warning("artifact %s pod advance on node %s "
+                           "deferred: %s", view.spec.name,
+                           node.metadata.name, exc)
+            return
+        self._deleted_pod_uids.add(pod.metadata.uid)
+        self._deleted_for.add((view.spec.name, node.metadata.name))
+        self.pods_advanced_total += 1
+        self._audit("artifact", node.metadata.name, "advance",
+                    "dag-order",
+                    {"artifact": view.spec.name,
+                     "from": pod.metadata.labels.get(
+                         POD_CONTROLLER_REVISION_HASH_LABEL, ""),
+                     "to": view.target})
+        logger.info("artifact %s pod on node %s advancing to %s",
+                    view.spec.name, node.metadata.name, view.target)
+
+    # ------------------------------------------------------------------
+    # the validation gate + status
+    # ------------------------------------------------------------------
+    def _artifact_pending(self, node: "Node",
+                          spec: "ArtifactSpec") -> bool:
+        """True while the artifact still has ACTIONABLE work on this
+        node in the current cycle: a pod advancing (deleted /
+        recreating / awaiting readiness) or a stamp catch-up. An
+        artifact whose dependencies cannot be satisfied this cycle
+        (e.g. the primary was re-bumped mid-validation — only the
+        machine's next pod-restart arc can move it) is NOT pending:
+        the node completes its cycle and the idle trigger re-enters
+        it, exactly like the machine's own mid-rollout re-entry."""
+        view = self._views.get(spec.name)
+        if view is None or view.ds is None or not view.target:
+            return False
+        name = node.metadata.name
+        stamp = node.metadata.annotations.get(self.stamp_key(spec.name))
+        pod = view.pods_by_node.get(name)
+        pod_rev = (pod.metadata.labels.get(
+            POD_CONTROLLER_REVISION_HASH_LABEL)
+            if pod is not None else None)
+        if view.primary:
+            # stamp catch-up only: the pod's lifecycle belongs to the
+            # machine's pod-restart arc
+            return (stamp != view.target and pod is not None
+                    and pod.is_ready() and pod_rev == view.target)
+        if stamp == view.target:
+            if pod is None:
+                # mid-recreate after our deletion (advisory memory; a
+                # crash at worst skips one readiness wait)
+                return (spec.name, name) in self._deleted_for
+            if pod_rev == view.target:
+                # in sync; if WE advanced it this cycle, hold the
+                # uncordon until it is ready again
+                return not pod.is_ready() \
+                    and (spec.name, name) in self._deleted_for
+            # current stamp over a STALE pod (re-bump + rollback race):
+            # actionable whenever the dependencies allow a re-sync
+            return self._deps_satisfied(node, spec)
+        if not self._deps_satisfied(node, spec):
+            return False  # unreachable this cycle
+        if pod is None:
+            # mid-recreate after our deletion (advisory memory; a
+            # crash at worst skips one stamp, rewritten next rollout)
+            return (spec.name, name) in self._deleted_for \
+                or stamp is not None
+        return True  # out-of-sync (delete pending) or awaiting ready
+
+    def node_complete(self, node: "Node") -> bool:
+        """True when no artifact has actionable work left on this node
+        — the validation-required parking gate."""
+        if not self.active:
+            return True
+        return not any(self._artifact_pending(node, spec)
+                       for spec in self._order)
+
+    def incomplete_artifacts(self, node: "Node") -> "list[str]":
+        """Names still pending on the node (explain() detail)."""
+        return [spec.name for spec in self._order
+                if self._artifact_pending(node, spec)]
+
+    def status(self) -> dict:
+        """JSON-able block for cluster_status["artifactDAG"]."""
+        artifacts = {}
+        for spec in self._order:
+            view = self._views.get(spec.name)
+            if view is None:
+                continue
+            artifacts[spec.name] = {
+                "target": view.target,
+                "quarantined": view.quarantined,
+                "primary": view.primary,
+                "dependsOn": list(spec.depends_on),
+            }
+        return {
+            "artifacts": artifacts,
+            "stampsTotal": self.stamps_total,
+            "podsAdvancedTotal": self.pods_advanced_total,
+            "quarantinesTotal": self.quarantines_total,
+            "suffixRollbacksTotal": self.suffix_rollbacks_total,
+            "failureVerdictsTotal": self.failure_verdicts_total,
+            "upgradeRequestsTotal": self.upgrade_requests_total,
+        }
+
+    def _audit(self, kind: str, subject: str, decision: str, rule: str,
+               inputs: dict) -> None:
+        if self.audit is None:
+            return
+        try:
+            self.audit(kind, subject, decision=decision, rule=rule,
+                       inputs=inputs)
+        except Exception:  # noqa: BLE001 — auditing must not block
+            pass

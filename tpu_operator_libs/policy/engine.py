@@ -1,0 +1,315 @@
+"""PolicyEngine: binds declarative hook programs into the manager seams.
+
+The engine is the privileged half of the gpu_ext architecture
+(PAPERS.md): it owns the :class:`~tpu_operator_libs.policy.hooks.
+PolicyHookRegistry`, compiles the CRD's
+:class:`~tpu_operator_libs.api.policy_spec.PolicyHooksSpec` into it
+(refreshed every pass — reference policy-re-read semantics, so editing
+the CRD takes effect without a restart), and exposes seam-shaped
+adapters the :class:`~tpu_operator_libs.upgrade.state_manager.
+ClusterUpgradeStateManager` installs:
+
+- :class:`PolicyEvictionGate` — wraps the installed EvictionGate; the
+  ``eviction.filter`` hook is consulted FIRST (deny parks, fail
+  closed), then the inner gate (ServingDrainGate etc.) keeps its
+  semantics, including ``release``.
+- :class:`PolicyAdmissionPlanner` — outermost semantic planner layer;
+  ``planner.admission`` and ``window.gate`` filter the candidate list
+  before the inner chain, recording per-node holds the decision audit
+  and ``explain()`` surface (``policy-deny`` / ``policy-error`` /
+  ``policy-budget`` rules).
+- :meth:`PolicyEngine.validation_gate` — the ValidationManager's
+  ``policy_validator`` seam (verdict False runs the normal validation
+  timeout; a failing program PARKS the node instead — audited, no
+  timer, no wedge).
+- :meth:`PolicyEngine.canary_verdict` — the RolloutGuard's
+  ``extra_verdict`` seam (observation: failures audit and contribute
+  nothing).
+- :meth:`PolicyEngine.observe_abort` — fan-in for the abort-audit
+  seam.
+
+Every adapter keeps the sandbox contract: nothing a policy does can
+raise out of a reconcile pass.
+"""
+
+from __future__ import annotations
+
+import logging
+from typing import TYPE_CHECKING, Any, Callable, Optional
+
+from tpu_operator_libs.policy.hooks import PolicyHookRegistry
+
+if TYPE_CHECKING:  # pragma: no cover - import cycle guard
+    # (api.policy_spec imports policy.expr; spec types stay
+    # annotation-only here)
+    from tpu_operator_libs.api.policy_spec import PolicyHooksSpec
+    from tpu_operator_libs.k8s.objects import Node, Pod
+    from tpu_operator_libs.upgrade.state_manager import (
+        ClusterUpgradeState,
+        NodeUpgradeState,
+        UpgradePlanner,
+    )
+
+logger = logging.getLogger(__name__)
+
+#: ValidationManager.policy_validator return values (see
+#: upgrade/validation_manager.py): None = pass; VERDICT_FAIL runs the
+#: validation-timeout ladder; VERDICT_PARK holds the node with no
+#: timer (the sandboxed fail-closed park).
+VERDICT_FAIL = "policy-verdict"
+VERDICT_PARK = "policy-park"
+
+
+def node_env(node: "Node", state: str = "") -> "dict[str, Any]":
+    """The ``node`` value every hook environment shares: a plain dict
+    (the sandbox has no attribute access on Python objects)."""
+    return {
+        "name": node.metadata.name,
+        "labels": dict(node.metadata.labels),
+        "annotations": dict(node.metadata.annotations),
+        "unschedulable": node.is_unschedulable(),
+        "ready": node.is_ready(),
+        "state": state,
+    }
+
+
+def _pod_env(pod: "Pod") -> "dict[str, Any]":
+    restarts = 0
+    ready = True
+    for status in pod.status.container_statuses:
+        restarts = max(restarts, status.restart_count)
+        ready = ready and status.ready
+    return {
+        "name": pod.metadata.name,
+        "namespace": pod.metadata.namespace,
+        "labels": dict(pod.metadata.labels),
+        "ready": ready and pod.is_ready(),
+        "restarts": restarts,
+    }
+
+
+class PolicyEvictionGate:
+    """EvictionGate adapter: policy first (fail closed), inner second.
+
+    One persistent instance lives on the manager; ``inner`` and
+    ``engine`` are re-pointed per pass so GateKeeper.set_gate's
+    identity comparison sees ONE stable gate (no release/re-park churn
+    on every reconcile)."""
+
+    def __init__(self, engine: "Optional[PolicyEngine]" = None,
+                 inner: "Optional[Callable]" = None) -> None:
+        self.engine = engine
+        self.inner = inner
+
+    def __call__(self, node: "Node", pods: "list[Pod]") -> bool:
+        engine = self.engine
+        if engine is not None and engine.registry.has("eviction.filter"):
+            env = {"node": node_env(node),
+                   "pods": [_pod_env(p) for p in pods]}
+            verdict = engine.registry.evaluate(
+                "eviction.filter", env, subject=node.metadata.name)
+            if verdict.value is not True:
+                return False
+        inner = self.inner
+        if inner is None:
+            return True
+        return bool(inner(node, pods))
+
+    def release(self, node: "Node", pods: "list[Pod]") -> None:
+        release = getattr(self.inner, "release", None)
+        if release is not None:
+            release(node, pods)
+
+
+class PolicyAdmissionPlanner:
+    """Outermost semantic planner layer: filters candidates through the
+    ``planner.admission`` and ``window.gate`` hooks before the inner
+    chain plans. Holds land in ``engine.last_holds`` (the audit
+    wrapper's rule source) and in the decision audit via the engine's
+    audit bridge."""
+
+    def __init__(self, inner: "UpgradePlanner",
+                 engine: "PolicyEngine") -> None:
+        self.inner = inner
+        self.engine = engine
+        #: pass context installed by the manager before planning.
+        self.fleet_env: dict = {}
+        self.now: float = 0.0
+        self.window_close: "Optional[float]" = None
+
+    def plan(self, candidates: "list[NodeUpgradeState]", available: int,
+             state: "ClusterUpgradeState") -> "list[NodeUpgradeState]":
+        engine = self.engine
+        registry = engine.registry
+        check_admission = registry.has("planner.admission")
+        check_window = registry.has("window.gate")
+        if not check_admission and not check_window:
+            return self.inner.plan(candidates, available, state)
+        allowed: list = []
+        for ns in candidates:
+            name = ns.node.metadata.name
+            env_node = node_env(ns.node, state=str(
+                ns.node.metadata.labels.get(engine.state_label, "")))
+            held = None
+            if check_admission:
+                verdict = registry.evaluate(
+                    "planner.admission",
+                    {"node": env_node, "fleet": self.fleet_env,
+                     "now": self.now},
+                    subject=name)
+                if verdict.value is not True:
+                    held = (verdict.rule or "policy-deny",
+                            verdict.detail or "planner.admission denied")
+            if held is None and check_window:
+                verdict = registry.evaluate(
+                    "window.gate",
+                    {"node": env_node, "now": self.now,
+                     "close": self.window_close},
+                    subject=name)
+                if verdict.value is not True:
+                    held = (verdict.rule or "policy-deny",
+                            verdict.detail or "window.gate denied")
+            if held is None:
+                allowed.append(ns)
+            else:
+                engine.note_hold(name, held[0], held[1])
+        return self.inner.plan(allowed, available, state)
+
+
+class PolicyEngine:
+    """The policy subsystem's front door (one per state manager)."""
+
+    def __init__(self, keys: "object",
+                 audit: "Optional[Callable[..., None]]" = None) -> None:
+        self.registry = PolicyHookRegistry(audit=audit)
+        self.state_label = getattr(keys, "state_label", "")
+        #: node -> (rule, detail) of this pass's policy holds — the
+        #: _AuditingPlanner's rule source and the explain() feed.
+        self.last_holds: dict[str, tuple] = {}
+        #: fingerprint of the last-compiled CRD spec (avoid recompiling
+        #: identical programs every pass).
+        self._spec_fingerprint: "Optional[tuple]" = None
+        #: lifetime holds recorded (teeth evidence for the gates).
+        self.holds_total = 0
+
+    # ------------------------------------------------------------------
+    # spec lifecycle
+    # ------------------------------------------------------------------
+    def refresh(self, spec: "Optional[PolicyHooksSpec]") -> None:
+        """(Re)compile the CRD's programs into the registry. Reference
+        semantics: the policy document is re-read every pass, so this
+        is called from ``apply_state`` — the fingerprint makes the
+        steady case free. A spec that fails validation here is dropped
+        whole (audited), never half-installed."""
+        if spec is None or not spec.enable or not spec.hooks:
+            if self._spec_fingerprint is not None:
+                self.registry.clear("crd")
+                self._spec_fingerprint = None
+            return
+        fingerprint = tuple(
+            (h.hook, h.program, h.max_steps, h.max_millis)
+            for h in spec.hooks)
+        if fingerprint == self._spec_fingerprint:
+            return
+        self.registry.clear("crd")
+        try:
+            spec.validate()
+            for hook_spec in spec.hooks:
+                self.registry.register_program(
+                    hook_spec.hook, hook_spec.program,
+                    hook_spec.max_steps, hook_spec.max_millis,
+                    name="crd")
+        except Exception as exc:  # noqa: BLE001 — a bad policy
+            # document must not wedge the pass: drop it, audit, run
+            # with no declarative hooks until it is fixed
+            self.registry.clear("crd")
+            logger.warning("policyHooks spec rejected; running without "
+                           "declarative hooks: %s", exc)
+            audit = self.registry.audit
+            if audit is not None:
+                try:
+                    audit("policy", "", decision="spec-rejected",
+                          rule="policy-error",
+                          inputs={"detail": str(exc)[:160]})
+                except Exception:  # noqa: BLE001
+                    pass
+        self._spec_fingerprint = fingerprint
+
+    def begin_pass(self) -> None:
+        self.last_holds = {}
+
+    @property
+    def active(self) -> bool:
+        return bool(self.registry.active_hooks)
+
+    # ------------------------------------------------------------------
+    # seam adapters
+    # ------------------------------------------------------------------
+    def note_hold(self, node: str, rule: str, detail: str) -> None:
+        self.last_holds[node] = (rule, detail)
+        self.holds_total += 1
+        audit = self.registry.audit
+        if audit is not None and rule == "policy-deny":
+            # error/budget failures were already audited inside the
+            # registry; the clean declarative deny is audited here so
+            # every policy hold has exactly one record
+            try:
+                audit("policy", node, decision="hold", rule=rule,
+                      inputs={"detail": detail[:160]})
+            except Exception:  # noqa: BLE001
+                pass
+
+    def validation_gate(self, node: "Node",
+                        now: float) -> "Optional[str]":
+        """The ValidationManager ``policy_validator`` seam. Returns
+        None (pass), :data:`VERDICT_FAIL` (program said unhealthy —
+        normal timeout ladder) or :data:`VERDICT_PARK` (program
+        failed/over budget — park, audited, no timer)."""
+        if not self.registry.has("validation.verdict"):
+            return None
+        verdict = self.registry.evaluate(
+            "validation.verdict",
+            {"node": node_env(node), "now": now},
+            subject=node.metadata.name)
+        if not verdict.ok:
+            self.last_holds[node.metadata.name] = (
+                verdict.rule, verdict.detail)
+            return VERDICT_PARK
+        if verdict.value is not True:
+            return VERDICT_FAIL
+        return None
+
+    def canary_verdict(self, node: "Node", revision: str,
+                       pod: "Pod") -> bool:
+        """The RolloutGuard ``extra_verdict`` seam (observation: a
+        failing program contributes NO verdict — fail open)."""
+        if not self.registry.has("canary.verdict"):
+            return False
+        verdict = self.registry.evaluate(
+            "canary.verdict",
+            {"node": node_env(node), "revision": revision,
+             "pod": _pod_env(pod)},
+            subject=node.metadata.name)
+        return verdict.ok and verdict.value is True
+
+    def observe_abort(self, kind: str, node: str, now: float,
+                      reason: str) -> None:
+        """The abort-audit seam (observation, fail open)."""
+        if not self.registry.has("abort.audit"):
+            return
+        self.registry.evaluate(
+            "abort.audit",
+            {"kind": kind, "node": node, "now": now, "reason": reason},
+            subject=node)
+
+    # ------------------------------------------------------------------
+    # status
+    # ------------------------------------------------------------------
+    def status(self) -> dict:
+        """JSON-able block for cluster_status["policy"]."""
+        out = self.registry.stats()
+        out["holdsTotal"] = self.holds_total
+        if self.last_holds:
+            out["holds"] = {name: rule for name, (rule, _)
+                            in sorted(self.last_holds.items())}
+        return out

@@ -1,0 +1,661 @@
+"""Sandboxed CEL-style expression language for declarative policy hooks.
+
+The gpu_ext paper (PAPERS.md) argues that user policy belongs in small
+verified programs injected into a privileged engine, not in forked
+operator code. This module is that program layer for the upgrade
+operator: a deliberately tiny expression language — CEL's operator set
+and call style, none of its macro/comprehension machinery — parsed once
+at policy-load time and evaluated under a hard step budget against an
+allowlisted environment.
+
+Safety model (the whole point — see docs/policy-engine.md §3):
+
+- **No loops, no recursion, no user definitions.** The grammar has
+  exactly one shape: an expression tree. Evaluation cost is bounded by
+  tree size times the step budget's per-node accounting, so a program
+  cannot even express unbounded work; the budget is belt and
+  suspenders against pathological trees and slow membership tests.
+- **Allowlisted environment.** Identifiers resolve against the dict
+  the hook point provides (``node``, ``fleet``, ``now``, ...) and
+  nothing else — no builtins, no imports, no attribute access on
+  Python objects (member access works on plain dicts only).
+- **Allowlisted functions.** ``size``, ``has``, ``startsWith``,
+  ``endsWith``, ``contains``, ``min``, ``max``, ``abs`` — total
+  functions over the value domain. Method-call sugar
+  (``name.startsWith("s0-")``) desugars to the same allowlist.
+- **Budgets raise, the caller parks.** :class:`EvalBudgetExceeded` /
+  :class:`PolicyEvalError` never escape the
+  :class:`~tpu_operator_libs.policy.hooks.PolicyHookRegistry`; the
+  registry converts them into an audited park/deny verdict
+  (fail-closed for admission hooks, fail-open for observation hooks).
+
+``parse`` performs full syntax + static checks (so ``tools/
+policy_lint.py`` and spec validation share one implementation);
+``Program.identifiers`` / ``Program.functions`` expose the free names
+for environment type-checking against the hook catalog.
+"""
+
+from __future__ import annotations
+
+import time
+from dataclasses import dataclass
+from typing import Any, Callable
+
+__all__ = [
+    "PolicyExprError",
+    "PolicyEvalError",
+    "EvalBudgetExceeded",
+    "Program",
+    "parse",
+    "ALLOWED_FUNCTIONS",
+    "DEFAULT_MAX_STEPS",
+    "DEFAULT_MAX_MILLIS",
+    "MAX_STEPS_CEILING",
+    "MAX_MILLIS_CEILING",
+    "MAX_PROGRAM_LENGTH",
+]
+
+
+class PolicyExprError(ValueError):
+    """Raised at parse time: syntax error, unknown function, program
+    too large."""
+
+
+class PolicyEvalError(RuntimeError):
+    """Raised at evaluation time: unknown identifier, type error,
+    division by zero — anything a correct program cannot do."""
+
+
+class EvalBudgetExceeded(PolicyEvalError):
+    """The program exceeded its per-evaluation step or wall budget."""
+
+
+#: Default/ceiling budgets. A hook program runs once per node per pass,
+#: so even the ceiling keeps one pass's policy cost bounded well below
+#: a single apiserver round-trip.
+DEFAULT_MAX_STEPS = 2000
+DEFAULT_MAX_MILLIS = 5.0
+MAX_STEPS_CEILING = 100_000
+MAX_MILLIS_CEILING = 1000.0
+#: Programs ship inside CRD annotations/spec fields; bound their size.
+MAX_PROGRAM_LENGTH = 4096
+
+#: name -> (min_args, max_args, implementation). Total functions only:
+#: every implementation terminates in O(size of its arguments).
+ALLOWED_FUNCTIONS: "dict[str, tuple[int, int, Callable[..., Any]]]" = {}
+
+
+def _register(name: str, min_args: int, max_args: int):
+    def wrap(fn: Callable[..., Any]):
+        ALLOWED_FUNCTIONS[name] = (min_args, max_args, fn)
+        return fn
+    return wrap
+
+
+@_register("size", 1, 1)
+def _fn_size(value: Any) -> int:
+    if isinstance(value, (str, list, dict, tuple)):
+        return len(value)
+    raise PolicyEvalError(f"size() takes a string, list or map, "
+                          f"got {type(value).__name__}")
+
+
+@_register("has", 2, 2)
+def _fn_has(container: Any, key: Any) -> bool:
+    if isinstance(container, dict):
+        return key in container
+    if isinstance(container, (list, tuple, str)):
+        return key in container
+    raise PolicyEvalError(f"has() takes a map, list or string, "
+                          f"got {type(container).__name__}")
+
+
+@_register("startsWith", 2, 2)
+def _fn_starts_with(value: Any, prefix: Any) -> bool:
+    if not isinstance(value, str) or not isinstance(prefix, str):
+        raise PolicyEvalError("startsWith() takes two strings")
+    return value.startswith(prefix)
+
+
+@_register("endsWith", 2, 2)
+def _fn_ends_with(value: Any, suffix: Any) -> bool:
+    if not isinstance(value, str) or not isinstance(suffix, str):
+        raise PolicyEvalError("endsWith() takes two strings")
+    return value.endswith(suffix)
+
+
+@_register("contains", 2, 2)
+def _fn_contains(container: Any, needle: Any) -> bool:
+    return _fn_has(container, needle)
+
+
+@_register("min", 1, 8)
+def _fn_min(*args: Any) -> Any:
+    values = args[0] if len(args) == 1 \
+        and isinstance(args[0], (list, tuple)) else args
+    if not values:
+        raise PolicyEvalError("min() of an empty sequence")
+    return min(values)
+
+
+@_register("max", 1, 8)
+def _fn_max(*args: Any) -> Any:
+    values = args[0] if len(args) == 1 \
+        and isinstance(args[0], (list, tuple)) else args
+    if not values:
+        raise PolicyEvalError("max() of an empty sequence")
+    return max(values)
+
+
+@_register("abs", 1, 1)
+def _fn_abs(value: Any) -> Any:
+    if isinstance(value, bool) or not isinstance(value, (int, float)):
+        raise PolicyEvalError("abs() takes a number")
+    return abs(value)
+
+
+# ---------------------------------------------------------------------------
+# tokenizer
+# ---------------------------------------------------------------------------
+
+_TWO_CHAR_OPS = ("==", "!=", "<=", ">=", "&&", "||")
+_ONE_CHAR_OPS = "+-*/%<>!?:(),[]{}."
+_KEYWORDS = {"true": True, "false": False, "null": None}
+
+
+@dataclass(slots=True)
+class _Token:
+    kind: str   # "num" | "str" | "ident" | "op" | "end"
+    value: Any
+    pos: int
+
+
+def _tokenize(text: str) -> "list[_Token]":
+    tokens: list[_Token] = []
+    i, n = 0, len(text)
+    while i < n:
+        ch = text[i]
+        if ch in " \t\r\n":
+            i += 1
+            continue
+        if text[i:i + 2] in _TWO_CHAR_OPS:
+            tokens.append(_Token("op", text[i:i + 2], i))
+            i += 2
+            continue
+        if ch in ('"', "'"):
+            quote, j, out = ch, i + 1, []
+            while j < n and text[j] != quote:
+                if text[j] == "\\" and j + 1 < n:
+                    esc = text[j + 1]
+                    out.append({"n": "\n", "t": "\t", "\\": "\\",
+                                '"': '"', "'": "'"}.get(esc, esc))
+                    j += 2
+                else:
+                    out.append(text[j])
+                    j += 1
+            if j >= n:
+                raise PolicyExprError(
+                    f"unterminated string literal at offset {i}")
+            tokens.append(_Token("str", "".join(out), i))
+            i = j + 1
+            continue
+        if ch.isdigit():
+            j = i
+            while j < n and (text[j].isdigit() or text[j] == "."):
+                j += 1
+            lit = text[i:j]
+            try:
+                value: Any = float(lit) if "." in lit else int(lit)
+            except ValueError:
+                raise PolicyExprError(
+                    f"malformed number {lit!r} at offset {i}") from None
+            tokens.append(_Token("num", value, i))
+            i = j
+            continue
+        if ch.isalpha() or ch == "_":
+            j = i
+            while j < n and (text[j].isalnum() or text[j] == "_"):
+                j += 1
+            word = text[i:j]
+            if word in _KEYWORDS:
+                tokens.append(_Token("num", _KEYWORDS[word], i))
+            elif word == "in":
+                tokens.append(_Token("op", "in", i))
+            else:
+                tokens.append(_Token("ident", word, i))
+            i = j
+            continue
+        if ch in _ONE_CHAR_OPS:
+            tokens.append(_Token("op", ch, i))
+            i += 1
+            continue
+        raise PolicyExprError(f"unexpected character {ch!r} at offset {i}")
+    tokens.append(_Token("end", None, n))
+    return tokens
+
+
+# ---------------------------------------------------------------------------
+# AST — plain tuples: ("lit", v) | ("ident", name) | ("unary", op, x)
+# | ("binary", op, a, b) | ("ternary", c, a, b) | ("member", obj, name)
+# | ("index", obj, key) | ("call", fname, args) | ("list", items)
+# | ("map", [(k, v), ...])
+# ---------------------------------------------------------------------------
+
+class _Parser:
+    """Recursive-descent with precedence climbing (ternary lowest)."""
+
+    def __init__(self, tokens: "list[_Token]") -> None:
+        self._tokens = tokens
+        self._i = 0
+
+    def _peek(self) -> _Token:
+        return self._tokens[self._i]
+
+    def _next(self) -> _Token:
+        token = self._tokens[self._i]
+        self._i += 1
+        return token
+
+    def _expect_op(self, op: str) -> None:
+        token = self._next()
+        if token.kind != "op" or token.value != op:
+            raise PolicyExprError(
+                f"expected {op!r} at offset {token.pos}, "
+                f"got {token.value!r}")
+
+    def parse(self) -> tuple:
+        node = self._ternary()
+        tail = self._peek()
+        if tail.kind != "end":
+            raise PolicyExprError(
+                f"unexpected trailing {tail.value!r} at offset {tail.pos}")
+        return node
+
+    def _ternary(self) -> tuple:
+        cond = self._or()
+        if self._peek().kind == "op" and self._peek().value == "?":
+            self._next()
+            then = self._ternary()
+            self._expect_op(":")
+            other = self._ternary()
+            return ("ternary", cond, then, other)
+        return cond
+
+    def _or(self) -> tuple:
+        node = self._and()
+        while self._peek().kind == "op" and self._peek().value == "||":
+            self._next()
+            node = ("binary", "||", node, self._and())
+        return node
+
+    def _and(self) -> tuple:
+        node = self._cmp()
+        while self._peek().kind == "op" and self._peek().value == "&&":
+            self._next()
+            node = ("binary", "&&", node, self._cmp())
+        return node
+
+    def _cmp(self) -> tuple:
+        node = self._add()
+        while self._peek().kind == "op" and self._peek().value in (
+                "==", "!=", "<", "<=", ">", ">=", "in"):
+            op = self._next().value
+            node = ("binary", op, node, self._add())
+        return node
+
+    def _add(self) -> tuple:
+        node = self._mul()
+        while self._peek().kind == "op" and self._peek().value in "+-":
+            op = self._next().value
+            node = ("binary", op, node, self._mul())
+        return node
+
+    def _mul(self) -> tuple:
+        node = self._unary()
+        while self._peek().kind == "op" and self._peek().value in "*/%":
+            op = self._next().value
+            node = ("binary", op, node, self._unary())
+        return node
+
+    def _unary(self) -> tuple:
+        token = self._peek()
+        if token.kind == "op" and token.value in ("!", "-"):
+            self._next()
+            return ("unary", token.value, self._unary())
+        return self._postfix()
+
+    def _postfix(self) -> tuple:
+        node = self._primary()
+        while True:
+            token = self._peek()
+            if token.kind == "op" and token.value == ".":
+                self._next()
+                name = self._next()
+                if name.kind != "ident":
+                    raise PolicyExprError(
+                        f"expected member name at offset {name.pos}")
+                if self._peek().kind == "op" \
+                        and self._peek().value == "(":
+                    # method sugar: x.f(a) == f(x, a); same allowlist
+                    args = self._call_args()
+                    node = self._make_call(name.value, [node] + args,
+                                           name.pos)
+                else:
+                    node = ("member", node, name.value)
+            elif token.kind == "op" and token.value == "[":
+                self._next()
+                key = self._ternary()
+                self._expect_op("]")
+                node = ("index", node, key)
+            else:
+                return node
+
+    def _call_args(self) -> "list[tuple]":
+        self._expect_op("(")
+        args: list[tuple] = []
+        if self._peek().kind == "op" and self._peek().value == ")":
+            self._next()
+            return args
+        while True:
+            args.append(self._ternary())
+            token = self._next()
+            if token.kind == "op" and token.value == ")":
+                return args
+            if not (token.kind == "op" and token.value == ","):
+                raise PolicyExprError(
+                    f"expected ',' or ')' at offset {token.pos}")
+
+    @staticmethod
+    def _make_call(name: str, args: "list[tuple]", pos: int) -> tuple:
+        spec = ALLOWED_FUNCTIONS.get(name)
+        if spec is None:
+            raise PolicyExprError(
+                f"unknown function {name!r} at offset {pos} (allowed: "
+                f"{', '.join(sorted(ALLOWED_FUNCTIONS))})")
+        min_args, max_args, _ = spec
+        if not min_args <= len(args) <= max_args:
+            raise PolicyExprError(
+                f"{name}() takes {min_args}..{max_args} argument(s), "
+                f"got {len(args)} at offset {pos}")
+        return ("call", name, args)
+
+    def _primary(self) -> tuple:
+        token = self._next()
+        if token.kind in ("num", "str"):
+            return ("lit", token.value)
+        if token.kind == "ident":
+            if self._peek().kind == "op" and self._peek().value == "(":
+                args = self._call_args()
+                return self._make_call(token.value, args, token.pos)
+            return ("ident", token.value)
+        if token.kind == "op" and token.value == "(":
+            node = self._ternary()
+            self._expect_op(")")
+            return node
+        if token.kind == "op" and token.value == "[":
+            items: list[tuple] = []
+            if self._peek().kind == "op" and self._peek().value == "]":
+                self._next()
+                return ("list", items)
+            while True:
+                items.append(self._ternary())
+                tail = self._next()
+                if tail.kind == "op" and tail.value == "]":
+                    return ("list", items)
+                if not (tail.kind == "op" and tail.value == ","):
+                    raise PolicyExprError(
+                        f"expected ',' or ']' at offset {tail.pos}")
+        if token.kind == "op" and token.value == "{":
+            pairs: list[tuple] = []
+            if self._peek().kind == "op" and self._peek().value == "}":
+                self._next()
+                return ("map", pairs)
+            while True:
+                key = self._ternary()
+                self._expect_op(":")
+                pairs.append((key, self._ternary()))
+                tail = self._next()
+                if tail.kind == "op" and tail.value == "}":
+                    return ("map", pairs)
+                if not (tail.kind == "op" and tail.value == ","):
+                    raise PolicyExprError(
+                        f"expected ',' or '}}' at offset {tail.pos}")
+        raise PolicyExprError(
+            f"unexpected {token.value!r} at offset {token.pos}")
+
+
+# ---------------------------------------------------------------------------
+# evaluation
+# ---------------------------------------------------------------------------
+
+class _Budget:
+    """Step + wall budget for ONE evaluation. The wall clock is checked
+    every 64 steps — cheap enough to leave always-on, tight enough that
+    a slow membership test over a large env value cannot stall a pass."""
+
+    __slots__ = ("steps_left", "deadline")
+
+    def __init__(self, max_steps: int, max_millis: float) -> None:
+        self.steps_left = max_steps
+        self.deadline = time.monotonic() + max_millis / 1000.0
+
+    def spend(self, cost: int = 1) -> None:
+        self.steps_left -= cost
+        if self.steps_left <= 0:
+            raise EvalBudgetExceeded("evaluation step budget exhausted")
+        if self.steps_left % 64 == 0 \
+                and time.monotonic() > self.deadline:
+            raise EvalBudgetExceeded("evaluation wall budget exhausted")
+
+
+def _is_number(value: Any) -> bool:
+    return isinstance(value, (int, float)) and not isinstance(value, bool)
+
+
+def _eval(node: tuple, env: "dict[str, Any]", budget: _Budget) -> Any:
+    budget.spend()
+    kind = node[0]
+    if kind == "lit":
+        return node[1]
+    if kind == "ident":
+        name = node[1]
+        if name not in env:
+            raise PolicyEvalError(
+                f"unknown identifier {name!r} (environment: "
+                f"{', '.join(sorted(env))})")
+        return env[name]
+    if kind == "unary":
+        value = _eval(node[2], env, budget)
+        if node[1] == "!":
+            if not isinstance(value, bool):
+                raise PolicyEvalError("'!' takes a boolean")
+            return not value
+        if not _is_number(value):
+            raise PolicyEvalError("unary '-' takes a number")
+        return -value
+    if kind == "binary":
+        op = node[1]
+        if op == "&&":
+            left = _eval(node[2], env, budget)
+            if not isinstance(left, bool):
+                raise PolicyEvalError("'&&' takes booleans")
+            if not left:
+                return False
+            right = _eval(node[3], env, budget)
+            if not isinstance(right, bool):
+                raise PolicyEvalError("'&&' takes booleans")
+            return right
+        if op == "||":
+            left = _eval(node[2], env, budget)
+            if not isinstance(left, bool):
+                raise PolicyEvalError("'||' takes booleans")
+            if left:
+                return True
+            right = _eval(node[3], env, budget)
+            if not isinstance(right, bool):
+                raise PolicyEvalError("'||' takes booleans")
+            return right
+        left = _eval(node[2], env, budget)
+        right = _eval(node[3], env, budget)
+        if op == "==":
+            return left == right
+        if op == "!=":
+            return left != right
+        if op == "in":
+            # cost proportional to the container, not a free lookup
+            if isinstance(right, (list, tuple, str, dict)):
+                budget.spend(max(1, len(right) // 16))
+                return left in right
+            raise PolicyEvalError("'in' takes a list, map or string "
+                                  "on the right")
+        if op in ("<", "<=", ">", ">="):
+            if not ((_is_number(left) and _is_number(right))
+                    or (isinstance(left, str) and isinstance(right, str))):
+                raise PolicyEvalError(
+                    f"{op!r} takes two numbers or two strings")
+            return {"<": left < right, "<=": left <= right,
+                    ">": left > right, ">=": left >= right}[op]
+        if op == "+":
+            if isinstance(left, str) and isinstance(right, str):
+                if len(left) + len(right) > MAX_PROGRAM_LENGTH:
+                    raise PolicyEvalError("string concatenation too large")
+                return left + right
+            if _is_number(left) and _is_number(right):
+                return left + right
+            raise PolicyEvalError("'+' takes two numbers or two strings")
+        if not (_is_number(left) and _is_number(right)):
+            raise PolicyEvalError(f"{op!r} takes two numbers")
+        if op == "-":
+            return left - right
+        if op == "*":
+            return left * right
+        if op in ("/", "%"):
+            if right == 0:
+                raise PolicyEvalError("division by zero")
+            return left / right if op == "/" else left % right
+        raise PolicyEvalError(f"unknown operator {op!r}")  # unreachable
+    if kind == "ternary":
+        cond = _eval(node[1], env, budget)
+        if not isinstance(cond, bool):
+            raise PolicyEvalError("ternary condition must be a boolean")
+        return _eval(node[2] if cond else node[3], env, budget)
+    if kind == "member":
+        obj = _eval(node[1], env, budget)
+        if not isinstance(obj, dict):
+            raise PolicyEvalError(
+                f"member access on {type(obj).__name__} (maps only)")
+        if node[2] not in obj:
+            raise PolicyEvalError(f"no such member {node[2]!r}")
+        return obj[node[2]]
+    if kind == "index":
+        obj = _eval(node[1], env, budget)
+        key = _eval(node[2], env, budget)
+        if isinstance(obj, dict):
+            if key not in obj:
+                raise PolicyEvalError(f"no such key {key!r}")
+            return obj[key]
+        if isinstance(obj, (list, tuple, str)):
+            if not isinstance(key, int) or isinstance(key, bool):
+                raise PolicyEvalError("list/string index must be an int")
+            if not -len(obj) <= key < len(obj):
+                raise PolicyEvalError(f"index {key} out of range")
+            return obj[key]
+        raise PolicyEvalError(
+            f"indexing a {type(obj).__name__} (maps, lists, strings)")
+    if kind == "call":
+        _, _, fn = ALLOWED_FUNCTIONS[node[1]]
+        args = [_eval(arg, env, budget) for arg in node[2]]
+        for arg in args:
+            if isinstance(arg, (str, list, tuple, dict)):
+                budget.spend(max(1, len(arg) // 16))
+        return fn(*args)
+    if kind == "list":
+        return [_eval(item, env, budget) for item in node[1]]
+    if kind == "map":
+        out: dict = {}
+        for key_node, value_node in node[1]:
+            key = _eval(key_node, env, budget)
+            if not isinstance(key, (str, int, float, bool)):
+                raise PolicyEvalError("map keys must be scalars")
+            out[key] = _eval(value_node, env, budget)
+        return out
+    raise PolicyEvalError(f"unknown node kind {kind!r}")  # unreachable
+
+
+def _walk(node: tuple):
+    yield node
+    kind = node[0]
+    if kind in ("unary",):
+        yield from _walk(node[2])
+    elif kind == "binary":
+        yield from _walk(node[2])
+        yield from _walk(node[3])
+    elif kind == "ternary":
+        for child in node[1:]:
+            yield from _walk(child)
+    elif kind in ("member", "index"):
+        yield from _walk(node[1])
+        if kind == "index":
+            yield from _walk(node[2])
+    elif kind == "call":
+        for arg in node[2]:
+            yield from _walk(arg)
+    elif kind == "list":
+        for item in node[1]:
+            yield from _walk(item)
+    elif kind == "map":
+        for key_node, value_node in node[1]:
+            yield from _walk(key_node)
+            yield from _walk(value_node)
+
+
+@dataclass(frozen=True)
+class Program:
+    """One parsed policy program, reusable across evaluations."""
+
+    source: str
+    _ast: tuple
+
+    def evaluate(self, env: "dict[str, Any]",
+                 max_steps: int = DEFAULT_MAX_STEPS,
+                 max_millis: float = DEFAULT_MAX_MILLIS) -> Any:
+        """Evaluate against ``env`` under the given budgets. Raises
+        :class:`PolicyEvalError` (or the :class:`EvalBudgetExceeded`
+        subclass) — callers translate into park/deny verdicts."""
+        return _eval(self._ast, env, _Budget(max_steps, max_millis))
+
+    def evaluate_bool(self, env: "dict[str, Any]",
+                      max_steps: int = DEFAULT_MAX_STEPS,
+                      max_millis: float = DEFAULT_MAX_MILLIS) -> bool:
+        value = self.evaluate(env, max_steps, max_millis)
+        if not isinstance(value, bool):
+            raise PolicyEvalError(
+                f"program must return a boolean, got "
+                f"{type(value).__name__} ({value!r})")
+        return value
+
+    def identifiers(self) -> "frozenset[str]":
+        """Free root identifiers — the names the environment must
+        provide (static type-check input for tools/policy_lint.py)."""
+        return frozenset(node[1] for node in _walk(self._ast)
+                         if node[0] == "ident")
+
+    def functions(self) -> "frozenset[str]":
+        return frozenset(node[1] for node in _walk(self._ast)
+                         if node[0] == "call")
+
+    def node_count(self) -> int:
+        return sum(1 for _ in _walk(self._ast))
+
+
+def parse(text: str) -> Program:
+    """Parse one policy program. Raises :class:`PolicyExprError` on any
+    syntax problem, unknown function, or oversized program — the same
+    check spec validation, the CRD webhook path and ``policy_lint``
+    share."""
+    if not isinstance(text, str) or not text.strip():
+        raise PolicyExprError("empty policy program")
+    if len(text) > MAX_PROGRAM_LENGTH:
+        raise PolicyExprError(
+            f"policy program exceeds {MAX_PROGRAM_LENGTH} characters")
+    return Program(source=text, _ast=_Parser(_tokenize(text)).parse())

@@ -1,0 +1,319 @@
+"""The unified policy-hook registry: named, versioned hook points.
+
+Before ISSUE 15 every extension point of the operator was its own
+constructor argument — eviction gates via ``with_eviction_gate``,
+validators via ``with_validation_enabled(extra_validator=...)``,
+planner wrappers via the ``planner`` property, the canary verdict
+buried in the RolloutGuard, abort/window audits as bare manager
+attributes. Changing behavior meant forking operator wiring, and a
+misbehaving hook could wedge a reconcile pass.
+
+This module absorbs those seams behind ONE catalog of named, versioned
+hook points (:data:`HOOK_POINTS`) and one registry
+(:class:`PolicyHookRegistry`) that accepts both:
+
+- **Python callables** — the old constructor seams, now registered by
+  hook name (the ServingDrainGate, the ICI probe validator, a custom
+  admission predicate) and run under the same boundary semantics; and
+- **declarative programs** — CEL-style expressions shipped in the CRD
+  (:class:`~tpu_operator_libs.api.policy_spec.PolicyHooksSpec`),
+  compiled once and evaluated sandboxed with per-hook step/wall
+  budgets.
+
+Failure semantics are the registry's contract, not each caller's ad-hoc
+choice: an ADMISSION hook that raises or overruns its budget fails
+**closed** — the subject node parks with an audited ``policy-error`` /
+``policy-budget`` reason; an OBSERVATION hook fails **open** — the
+event proceeds, the failure is audited. Either way the pass itself
+never raises out of a hook (the chaos gate's ``policy-sandbox``
+invariant pins this).
+"""
+
+from __future__ import annotations
+
+import logging
+import time
+from dataclasses import dataclass
+from typing import Any, Callable, Optional
+
+from tpu_operator_libs.policy.expr import (
+    EvalBudgetExceeded,
+    Program,
+    parse,
+)
+
+logger = logging.getLogger(__name__)
+
+#: Hook kinds. Admission hooks gate a state-machine edge (deny parks
+#: the node); observation hooks watch one (their result cannot block).
+ADMISSION = "admission"
+OBSERVATION = "observation"
+
+
+@dataclass(frozen=True)
+class HookPoint:
+    """One named, versioned extension point."""
+
+    name: str
+    version: str
+    kind: str  # ADMISSION | OBSERVATION
+    #: Identifiers the evaluation environment provides — the static
+    #: type-check surface policy_lint and spec validation share.
+    env: frozenset
+    description: str
+
+    @property
+    def admission(self) -> bool:
+        return self.kind == ADMISSION
+
+
+def _point(name: str, kind: str, env: "tuple[str, ...]",
+           description: str) -> HookPoint:
+    return HookPoint(name=name, version="v1", kind=kind,
+                     env=frozenset(env), description=description)
+
+
+#: The hook catalog. Every scattered seam of the pre-policy operator
+#: maps onto exactly one row (docs/policy-engine.md §2 is generated
+#: from these descriptions — keep them one line).
+HOOK_POINTS: "dict[str, HookPoint]" = {p.name: p for p in (
+    _point("eviction.filter", ADMISSION, ("node", "pods"),
+           "May this node's workload pods be evicted now? Deny parks "
+           "the node in its eviction-wanting state (the EvictionGate "
+           "seam)."),
+    _point("planner.admission", ADMISSION, ("node", "fleet", "now"),
+           "May this upgrade-required candidate enter the wave? Deny "
+           "holds it with an audited rule (the planner-wrapper seam)."),
+    _point("window.gate", ADMISSION, ("node", "now", "close"),
+           "May this candidate start given the maintenance-window "
+           "close? Deny defers it (the window-gate seam)."),
+    _point("validation.verdict", ADMISSION, ("node", "now"),
+           "Is this restarted node healthy enough to return to "
+           "service? False runs the validation-timeout ladder (the "
+           "extra-validator seam)."),
+    _point("canary.verdict", OBSERVATION, ("node", "revision", "pod"),
+           "Does this canary node count as a failure verdict on the "
+           "revision under test? (the RolloutGuard verdict seam)."),
+    _point("abort.audit", OBSERVATION, ("kind", "node", "now", "reason"),
+           "Fires on every mid-flight abort admission/completion (the "
+           "abort-audit seam)."),
+)}
+
+
+class UnknownHookError(KeyError):
+    """Registration against a hook name not in the catalog."""
+
+
+@dataclass
+class HookVerdict:
+    """Outcome of evaluating every registration on one hook point."""
+
+    #: The aggregate decision (admission: AND of every registration;
+    #: observation: last value, informational).
+    value: Any
+    #: True when every registration evaluated cleanly.
+    ok: bool
+    #: "" | "policy-error" | "policy-budget" — the park/audit rule when
+    #: not ok (admission hooks) or the audit rule (observation hooks).
+    rule: str = ""
+    #: Human detail for the audit record.
+    detail: str = ""
+
+
+@dataclass
+class _Registration:
+    point: HookPoint
+    name: str           # source label ("crd", "python:<fn>")
+    program: Optional[Program] = None
+    fn: Optional[Callable[..., Any]] = None
+    max_steps: int = 0
+    max_millis: float = 0.0
+
+
+class PolicyHookRegistry:
+    """Named hook points -> ordered registrations, with sandboxed
+    evaluation, budget enforcement and lifetime counters.
+
+    ``audit`` (optional) is called ``audit(kind, subject, decision,
+    rule, inputs)`` for every error/budget overrun AND every
+    declarative deny — the DecisionAudit bridge. An audit failure is
+    swallowed: auditing a failure must not create one.
+    """
+
+    def __init__(self, audit: "Optional[Callable[..., None]]" = None,
+                 ) -> None:
+        self._hooks: dict[str, list[_Registration]] = {}
+        self.audit = audit
+        #: lifetime counters (metrics feed; keyed by hook name)
+        self.evals_total: dict[str, int] = {}
+        self.errors_total: dict[str, int] = {}
+        self.budget_exceeded_total: dict[str, int] = {}
+        self.denies_total: dict[str, int] = {}
+        #: (hook, seconds) samples since the last drain — the
+        #: eval-duration histogram feed (predictor drain idiom).
+        self._eval_samples: list[tuple[str, float]] = []
+        #: overruns/errors that failed to produce an audit record
+        #: (should stay 0 forever; the policy-sandbox invariant's
+        #: teeth).
+        self.unaudited_failures = 0
+
+    # ------------------------------------------------------------------
+    # registration
+    # ------------------------------------------------------------------
+    def _point(self, hook: str) -> HookPoint:
+        point = HOOK_POINTS.get(hook)
+        if point is None:
+            raise UnknownHookError(
+                f"unknown hook point {hook!r} (known: "
+                f"{', '.join(sorted(HOOK_POINTS))})")
+        return point
+
+    def register_program(self, hook: str, program_text: str,
+                         max_steps: int, max_millis: float,
+                         name: str = "crd") -> None:
+        """Compile and attach a declarative program. Parse errors raise
+        here (policy-load time), never mid-pass."""
+        point = self._point(hook)
+        self._hooks.setdefault(hook, []).append(_Registration(
+            point=point, name=name, program=parse(program_text),
+            max_steps=max_steps, max_millis=max_millis))
+
+    def register_callable(self, hook: str, fn: Callable[..., Any],
+                          name: str = "") -> None:
+        """Attach a Python callable (the absorbed constructor seams).
+        The callable receives the hook's env as keyword arguments and
+        runs under the same fail-closed/fail-open boundary as a
+        program (no step budget — Python hooks are trusted code, but a
+        raise still parks instead of wedging)."""
+        point = self._point(hook)
+        self._hooks.setdefault(hook, []).append(_Registration(
+            point=point, name=name or f"python:{getattr(fn, '__name__', fn)!r}",
+            fn=fn))
+
+    def clear(self, source: "Optional[str]" = None) -> None:
+        """Drop registrations (all, or only those whose name matches
+        ``source`` — the per-pass CRD refresh drops only "crd")."""
+        if source is None:
+            self._hooks.clear()
+            return
+        for hook in list(self._hooks):
+            kept = [r for r in self._hooks[hook] if r.name != source]
+            if kept:
+                self._hooks[hook] = kept
+            else:
+                del self._hooks[hook]
+
+    def has(self, hook: str) -> bool:
+        return bool(self._hooks.get(hook))
+
+    @property
+    def active_hooks(self) -> "dict[str, int]":
+        """hook name -> registration count (the active-policy gauge)."""
+        return {hook: len(regs) for hook, regs in self._hooks.items()}
+
+    # ------------------------------------------------------------------
+    # evaluation
+    # ------------------------------------------------------------------
+    def evaluate(self, hook: str, env: "dict[str, Any]",
+                 subject: str = "") -> HookVerdict:
+        """Run every registration on ``hook`` against ``env``.
+
+        Admission points AND the boolean results: the first deny (or
+        failure — fail closed) wins. Observation points run every
+        registration and fail open. No exception ever escapes."""
+        regs = self._hooks.get(hook, ())
+        point = HOOK_POINTS[hook]
+        if not regs:
+            return HookVerdict(value=True if point.admission else None,
+                               ok=True)
+        value: Any = True if point.admission else None
+        for reg in regs:
+            self.evals_total[hook] = self.evals_total.get(hook, 0) + 1
+            started = time.perf_counter()
+            try:
+                if reg.program is not None:
+                    result = (reg.program.evaluate_bool(
+                        env, reg.max_steps, reg.max_millis)
+                        if point.admission
+                        else reg.program.evaluate(
+                            env, reg.max_steps, reg.max_millis))
+                else:
+                    result = reg.fn(**env)
+            except EvalBudgetExceeded as exc:
+                self._eval_samples.append(
+                    (hook, time.perf_counter() - started))
+                self.budget_exceeded_total[hook] = \
+                    self.budget_exceeded_total.get(hook, 0) + 1
+                return self._failure(point, subject, reg, "policy-budget",
+                                     str(exc))
+            except Exception as exc:  # noqa: BLE001 — the sandbox
+                # boundary: nothing a hook does may escape
+                self._eval_samples.append(
+                    (hook, time.perf_counter() - started))
+                self.errors_total[hook] = \
+                    self.errors_total.get(hook, 0) + 1
+                return self._failure(point, subject, reg, "policy-error",
+                                     f"{type(exc).__name__}: {exc}")
+            self._eval_samples.append(
+                (hook, time.perf_counter() - started))
+            if point.admission:
+                if result is not True:
+                    self.denies_total[hook] = \
+                        self.denies_total.get(hook, 0) + 1
+                    return HookVerdict(
+                        value=False, ok=True, rule="policy-deny",
+                        detail=f"{hook} denied by {reg.name}")
+            else:
+                value = result
+        return HookVerdict(value=value if not point.admission else True,
+                           ok=True)
+
+    def _failure(self, point: HookPoint, subject: str,
+                 reg: _Registration, rule: str,
+                 detail: str) -> HookVerdict:
+        """Convert a hook failure into the contracted verdict: deny for
+        admission (fail closed), neutral for observation (fail open) —
+        audited either way."""
+        logger.warning("policy hook %s (%s) failed %s for %s: %s "
+                       "(%s)", point.name, reg.name,
+                       "closed" if point.admission else "open",
+                       subject or "fleet", rule, detail)
+        audited = False
+        if self.audit is not None:
+            try:
+                self.audit("policy", subject,
+                           decision=("park" if point.admission
+                                     else "observed-error"),
+                           rule=rule,
+                           inputs={"hook": point.name,
+                                   "source": reg.name,
+                                   "detail": detail[:160]})
+                audited = True
+            except Exception:  # noqa: BLE001 — auditing a failure
+                pass           # must not create one
+        if not audited:
+            self.unaudited_failures += 1
+        if point.admission:
+            return HookVerdict(value=False, ok=False, rule=rule,
+                               detail=detail)
+        return HookVerdict(value=None, ok=False, rule=rule, detail=detail)
+
+    # ------------------------------------------------------------------
+    # metrics feed
+    # ------------------------------------------------------------------
+    def drain_eval_samples(self) -> "list[tuple[str, float]]":
+        samples, self._eval_samples = self._eval_samples, []
+        return samples
+
+    def stats(self) -> dict:
+        """JSON-able counter snapshot (cluster_status / the chaos
+        gate's policy-sandbox probe)."""
+        return {
+            "activeHooks": dict(sorted(self.active_hooks.items())),
+            "evalsTotal": dict(sorted(self.evals_total.items())),
+            "errorsTotal": dict(sorted(self.errors_total.items())),
+            "budgetExceededTotal": dict(sorted(
+                self.budget_exceeded_total.items())),
+            "deniesTotal": dict(sorted(self.denies_total.items())),
+            "unauditedFailures": self.unaudited_failures,
+        }

@@ -326,6 +326,50 @@ def seed_spare_pool(cluster: FakeCluster, spec: FleetSpec, count: int,
     return names
 
 
+def seed_artifact_daemon_sets(
+        cluster: FakeCluster,
+        artifacts: "dict[str, dict[str, str]]",
+        revision_hash: str = "old",
+        namespace: str = NS) -> None:
+    """Seed one fleet-wide DaemonSet + a ready pod per node for each
+    non-primary artifact of a multi-artifact upgrade DAG
+    (policy/dag.py) — the device plugin / network driver / OS-image
+    agents riding next to the libtpu runtime the fleet already runs.
+
+    ``artifacts`` maps artifact name -> pod/DS labels (the
+    ``runtimeLabels`` of its :class:`~tpu_operator_libs.api.
+    policy_spec.ArtifactSpec`). Pods start ready at
+    ``revision_hash``; bump each DS (``bump_daemon_set_revision``) to
+    open its rollout — the DAG coordinator then advances every node's
+    artifacts inside its one shared cordon/drain cycle.
+    """
+    nodes = cluster.list_nodes()
+    for name, labels in artifacts.items():
+        ds = DaemonSet(
+            metadata=ObjectMeta(name=name, namespace=namespace,
+                                labels=dict(labels)),
+            spec=DaemonSetSpec(selector=dict(labels)),
+            status=DaemonSetStatus(
+                desired_number_scheduled=len(nodes)))
+        cluster.add_daemon_set(ds, revision_hash=revision_hash)
+        for node in nodes:
+            cluster.add_pod(Pod(
+                metadata=ObjectMeta(
+                    name=f"{name}-{node.metadata.name}",
+                    namespace=namespace,
+                    labels={**labels,
+                            POD_CONTROLLER_REVISION_HASH_LABEL:
+                                revision_hash},
+                    owner_references=[OwnerReference(
+                        kind="DaemonSet", name=name,
+                        uid=ds.metadata.uid)]),
+                spec=PodSpec(node_name=node.metadata.name),
+                status=PodStatus(
+                    phase=PodPhase.RUNNING,
+                    container_statuses=[
+                        ContainerStatus(name=name, ready=True)])))
+
+
 def restore_workload_pods(cluster: FakeCluster, spec: FleetSpec) -> None:
     """(Re)create each multislice job's member pods on slices that are
     fully schedulable+ready — the sim's stand-in for the JobSet

@@ -168,6 +168,14 @@ class RolloutGuard:
         #: (None outside sharded partition mode) — set per assess().
         self._shard_context: Optional[ShardedCanaryContext] = None
         self.last_decision = RolloutDecision()
+        #: Policy-engine verdict seam (the ``canary.verdict``
+        #: OBSERVATION hook, policy/engine.py): ``fn(node, revision,
+        #: pod) -> bool`` — True contributes one failure verdict for
+        #: the node on the revision under test, exactly like the
+        #: machine's own FAILED-bucket signal. Fail-open: the engine
+        #: returns False on any program error (audited there), so a
+        #: bad policy can never halt a fleet by crashing.
+        self.extra_verdict = None
 
     def drain_rollback_durations(self) -> "list[float]":
         out, self._rollback_durations = self._rollback_durations, []
@@ -296,6 +304,14 @@ class RolloutGuard:
                     # crash-looping pod of the newest revision keeps
                     # its verdict standing (it was FAILED a pass ago)
                     ro.failures.append(ns.node.metadata.name)
+                elif self.extra_verdict is not None:
+                    # the policy engine's canary.verdict observation
+                    # hook: a user program may condemn the node on this
+                    # revision from signals the machine cannot see
+                    # (fail-open inside the engine — never raises)
+                    if self.extra_verdict(ns.node, ro.newest,
+                                          ns.runtime_pod):
+                        ro.failures.append(ns.node.metadata.name)
         return rollouts
 
     # ------------------------------------------------------------------

@@ -221,9 +221,12 @@ class _AuditingPlanner:
         deferred = manager.multislice_deferred_slices
         ranker = manager._cost_ranker
         ranker_holds = ranker.last_holds if ranker is not None else {}
+        engine = manager._policy_engine
+        policy_holds = engine.last_holds if engine is not None else {}
         uniform_rule = None
         if not rollout.halted and not rollout.canary_active \
-                and not deferred and not ranker_holds:
+                and not deferred and not ranker_holds \
+                and not policy_holds:
             # the common regime: every held candidate blocks on the
             # same rule, so a steady pass with no admissions and an
             # unchanged (rule, candidate count) repeats facts the
@@ -259,6 +262,10 @@ class _AuditingPlanner:
                 # the ranker already recorded the rich record (model/
                 # class/prewarm arc); the shared rule dedups this one
                 rule = ranker_holds[name][0]
+            elif name in policy_holds:
+                # the policy engine already recorded/audited the rich
+                # hold (policy-deny/-error/-budget); dedup on its rule
+                rule = policy_holds[name][0]
             elif deferred and manager._node_pool(ns.node) in deferred:
                 rule = "multislice-budget"
             else:
@@ -375,6 +382,26 @@ class ClusterUpgradeStateManager:
         #: abort admission/completion — the chaos harness's
         #: abort-invariant feed (kind: "abort" | "aborted").
         self.abort_audit = None
+        # ---- declarative policy engine + artifact DAG (policy/) ----
+        #: Persistent PolicyEngine; created on first use from a policy
+        #: carrying policyHooks (its registry also absorbs the Python
+        #: constructor seams — see docs/policy-engine.md).
+        self._policy_engine = None
+        #: The ONE persistent PolicyEvictionGate wrapper (GateKeeper's
+        #: set_gate identity-compares; a fresh wrapper per pass would
+        #: release/re-park every parked node every reconcile).
+        self._policy_gate = None
+        #: Persistent PolicyAdmissionPlanner wrapper.
+        self._policy_planner = None
+        #: Persistent ArtifactDAGCoordinator; created on first use
+        #: from a policy carrying artifactDAG (stateless-durable —
+        #: every pass re-derives targets/stamps from cluster state).
+        self._dag = None
+        #: (namespace, runtime labels) of the most recent build_state —
+        #: the DAG coordinator resolves artifact DaemonSets against
+        #: the same scope the snapshot came from.
+        self._last_namespace: Optional[str] = None
+        self._last_runtime_labels: Optional[dict] = None
         # ---- journey tracing + decision audit (obs/) ----
         #: OperatorObservability installed via with_observability; None
         #: = reference behavior bit for bit (no tracer annotations, no
@@ -730,6 +757,15 @@ class ClusterUpgradeStateManager:
     def is_validation_enabled(self) -> bool:
         return self._validation_enabled
 
+    @property
+    def _policy_validation_active(self) -> bool:
+        """True while the policy engine's validation.verdict program
+        or the artifact-DAG completion gate is installed: restarted
+        nodes must route through validation-required so the seam can
+        judge (or park) them — with neither, the reference's
+        skip-validation shortcut applies bit for bit."""
+        return self.validation_manager.policy_validator is not None
+
     # ------------------------------------------------------------------
     # build_state (upgrade_state.go:214-355)
     # ------------------------------------------------------------------
@@ -768,6 +804,11 @@ class ClusterUpgradeStateManager:
             # pass a DaemonSet's newest revision is immutable
             reset_memo()
         selector = selector_from_labels(runtime_labels)
+        # scope memo for the artifact-DAG coordinator: artifact
+        # DaemonSets resolve against the same namespace the snapshot
+        # came from
+        self._last_namespace = namespace
+        self._last_runtime_labels = dict(runtime_labels)
         daemon_sets, pods, nodes_by_name = self._snapshot_inputs(
             namespace, selector, node_selector)
         # the ledger/oracle DaemonSet set of this snapshot (budget
@@ -1386,6 +1427,16 @@ class ClusterUpgradeStateManager:
         logger.info("node states: %s", {
             str(s) or "unknown": len(state.bucket(s)) for s in ALL_STATES})
 
+        # Declarative policy engine + artifact DAG (policy/), refreshed
+        # from the policy document every pass (reference re-read
+        # semantics): the engine re-points the absorbed seams (eviction
+        # gate, validation verdict, canary verdict) BEFORE the guard
+        # and processors below consult them; a bad document is dropped
+        # whole and audited, never half-installed.
+        self._policy_engine_for_pass(policy)
+        dag = self._dag_for_policy(policy)
+        self._refresh_validation_seam()
+
         # Rollout guard first: halt detection must land in the SAME pass
         # as the verdicts that tripped it — admissions below consult the
         # decision, so a halting fleet admits nothing this pass. Under
@@ -1529,6 +1580,19 @@ class ClusterUpgradeStateManager:
         # holds sole-replica interactive nodes behind the prewarm arc
         # — every budget decision still lands in the inner chain.
         planner = self._wrap_cost_ranker(policy, planner)
+        # Declarative policy admission outermost of ALL semantic
+        # layers (PolicyAdmissionPlanner ∘ CostRanker ∘ ...): the
+        # planner.admission / window.gate programs filter the
+        # candidate list first, with per-node holds audited under
+        # policy-* rules (fail-closed: an erroring program holds its
+        # node, never the pass).
+        planner = self._wrap_policy_planner(
+            policy, planner,
+            fleet_env={"total": total_nodes,
+                       "inProgress": in_progress,
+                       "unavailable": unavailable_now,
+                       "slots": upgrades_available,
+                       "budget": max_unavailable})
         if obs is not None:
             # the pass's slot math, with the winning rule: the record
             # every parked node's explain chain hangs off
@@ -1571,6 +1635,15 @@ class ClusterUpgradeStateManager:
         self.process_pod_restart_nodes(state)
         self.process_upgrade_failed_nodes(state)
         self.process_rollback_required_nodes(state)
+        if dag is not None and self._last_namespace is not None:
+            # the artifact-DAG walk runs before the validation gate
+            # consults node_complete: cordoned nodes advance their
+            # remaining artifacts in dependency order inside this one
+            # cycle, idle nodes with stale artifacts get the re-entry
+            # trigger, and a crash-looping artifact revision is
+            # quarantined + suffix-rolled-back (all audited)
+            dag.advance(state, self._last_namespace,
+                        self._last_runtime_labels or {})
         self.process_validation_required_nodes(state)
         self.process_uncordon_required_nodes(state)
         self._eager_slot_refill(state, policy, planner, max_unavailable,
@@ -1871,6 +1944,159 @@ class ClusterUpgradeStateManager:
         if self._obs is not None:
             self._obs.audit.record(kind, node, decision=decision,
                                    rule=rule, inputs=inputs)
+
+    # ------------------------------------------------------------------
+    # declarative policy engine + artifact DAG (policy/)
+    # ------------------------------------------------------------------
+    @property
+    def policy_engine(self) -> "object":
+        """The persistent PolicyEngine (None until a policy carrying
+        policyHooks ran)."""
+        return self._policy_engine
+
+    @property
+    def dag_coordinator(self) -> "object":
+        """The persistent ArtifactDAGCoordinator (None until a policy
+        carrying artifactDAG ran)."""
+        return self._dag
+
+    def _policy_audit_hook(self, kind: str, subject: str,
+                           decision: str, rule: str,
+                           inputs: dict) -> None:
+        """DecisionAudit bridge for the engine/coordinator (reads
+        ``self._obs`` at call time, so installing observability later
+        lights the records up without rewiring)."""
+        if self._obs is not None:
+            self._obs.audit.record(kind, subject, decision=decision,
+                                   rule=rule, inputs=inputs)
+
+    def _policy_engine_for_pass(self, policy: UpgradePolicySpec) -> "object":
+        """Create/refresh the engine from the pass's policy and
+        re-point the absorbed seams. Returns the engine when any hook
+        is active, else None."""
+        spec = getattr(policy, "policy_hooks", None)
+        active = (spec is not None and getattr(spec, "enable", False)
+                  and bool(getattr(spec, "hooks", ())))
+        if self._policy_engine is None:
+            if not active:
+                return None
+            from tpu_operator_libs.policy.engine import PolicyEngine
+
+            self._policy_engine = PolicyEngine(
+                self.keys, audit=self._policy_audit_hook)
+        engine = self._policy_engine
+        engine.refresh(spec if active else None)
+        engine.begin_pass()
+        self._install_policy_gate(engine)
+        self.rollout_guard.extra_verdict = (
+            engine.canary_verdict
+            if engine.registry.has("canary.verdict") else None)
+        return engine if engine.active else None
+
+    def _install_policy_gate(self, engine: "object") -> None:
+        """Wrap (or unwrap) the installed EvictionGate with the ONE
+        persistent policy gate. Identity-stable across passes, so the
+        GateKeepers never release/re-park on a steady reconcile."""
+        current = self.pod_manager.eviction_gate
+        if engine.registry.has("eviction.filter"):
+            if self._policy_gate is None:
+                from tpu_operator_libs.policy.engine import (
+                    PolicyEvictionGate,
+                )
+
+                self._policy_gate = PolicyEvictionGate()
+            gate = self._policy_gate
+            gate.engine = engine
+            if current is not gate:
+                gate.inner = current
+                self.with_eviction_gate(gate)
+        elif self._policy_gate is not None \
+                and current is self._policy_gate:
+            self.with_eviction_gate(self._policy_gate.inner)
+
+    def _dag_for_policy(self, policy: UpgradePolicySpec) -> "object":
+        """Create/refresh the artifact-DAG coordinator from the
+        pass's policy; None when the spec is absent/disabled."""
+        spec = getattr(policy, "artifact_dag", None)
+        active = (spec is not None and getattr(spec, "enable", False)
+                  and bool(getattr(spec, "artifacts", ())))
+        if not active:
+            if self._dag is not None:
+                self._dag.spec = None  # deactivates node_complete too
+            return None
+        if self._dag is None:
+            from tpu_operator_libs.policy.dag import (
+                ArtifactDAGCoordinator,
+            )
+
+            self._dag = ArtifactDAGCoordinator(
+                self.client, self.keys, self.provider,
+                clock=self.clock, audit=self._policy_audit_hook,
+                pod_failure_threshold=POD_RESTART_FAILURE_THRESHOLD)
+        self._dag.refresh(spec)
+        return self._dag
+
+    def _refresh_validation_seam(self) -> None:
+        """Compose the ValidationManager's policy seam from the active
+        parts: the validation.verdict program (fail-closed park on
+        program failure) and the DAG completion gate (park while
+        artifacts advance)."""
+        engine = self._policy_engine
+        dag = self._dag
+        parts = []
+        if engine is not None \
+                and engine.registry.has("validation.verdict"):
+            def program_gate(node, _engine=engine):
+                return _engine.validation_gate(node, self.clock.now())
+
+            parts.append(program_gate)
+        if dag is not None and dag.active:
+            def dag_gate(node, _dag=dag):
+                return None if _dag.node_complete(node) \
+                    else "policy-park"
+
+            parts.append(dag_gate)
+        if not parts:
+            self.validation_manager.policy_validator = None
+            return
+
+        def composed(node, _parts=tuple(parts)):
+            for part in _parts:
+                verdict = part(node)
+                if verdict:
+                    return verdict
+            return None
+
+        self.validation_manager.policy_validator = composed
+
+    def _wrap_policy_planner(self, policy: UpgradePolicySpec,
+                             inner: UpgradePlanner,
+                             fleet_env: dict) -> UpgradePlanner:
+        """Wrap ``inner`` in the PolicyAdmissionPlanner when any
+        admission program is registered; otherwise return it
+        unchanged (policy-free fleets keep prior semantics bit for
+        bit)."""
+        engine = self._policy_engine
+        if engine is None or not (
+                engine.registry.has("planner.admission")
+                or engine.registry.has("window.gate")):
+            return inner
+        from tpu_operator_libs.policy.engine import (
+            PolicyAdmissionPlanner,
+        )
+
+        if self._policy_planner is None:
+            self._policy_planner = PolicyAdmissionPlanner(inner, engine)
+        wrapper = self._policy_planner
+        wrapper.inner = inner
+        wrapper.engine = engine
+        wrapper.fleet_env = fleet_env
+        wrapper.now = self.clock.now()
+        window = policy.maintenance_window
+        wrapper.window_close = (
+            window.close_at(wrapper.now)
+            if window is not None and window.enable else None)
+        return wrapper
 
     def _capacity_for_policy(self, policy: UpgradePolicySpec) -> "object":
         """The controller for this pass, created/refreshed from the
@@ -2174,7 +2400,8 @@ class ClusterUpgradeStateManager:
             # then wait for readiness.
             self.safe_load_manager.unblock_loading(ns.node)
             if self._is_runtime_pod_in_sync(ns):
-                if not self._validation_enabled:
+                if not self._validation_enabled \
+                        and not self._policy_validation_active:
                     self._update_node_to_uncordon_or_done(ns.node)
                     return None
                 self.provider.change_node_upgrade_state(
@@ -2258,7 +2485,8 @@ class ClusterUpgradeStateManager:
             # check(), not validate(): the recovery gate must not
             # stamp or expire validation timers on an already-failed
             # node.
-            if self._validation_enabled \
+            if (self._validation_enabled
+                    or self._policy_validation_active) \
                     and not self.validation_manager.check(ns.node):
                 logger.info("failed node %s has a healthy pod but has "
                             "not passed validation; holding",
@@ -2340,7 +2568,8 @@ class ClusterUpgradeStateManager:
             # pod is off the condemned hash: wait for sync+ready, then
             # hand back through the standard validation/uncordon arc
             if self._is_runtime_pod_in_sync(ns):
-                if not self._validation_enabled:
+                if not self._validation_enabled \
+                        and not self._policy_validation_active:
                     self._update_node_to_uncordon_or_done(ns.node)
                     return None
                 self.provider.change_node_upgrade_state(
@@ -2467,6 +2696,11 @@ class ClusterUpgradeStateManager:
                             self.abort_audit("abort",
                                              ns.node.metadata.name,
                                              now, reason)
+                        if self._policy_engine is not None:
+                            # abort.audit observation hook (fail-open)
+                            self._policy_engine.observe_abort(
+                                "abort", ns.node.metadata.name,
+                                now, reason)
                         logger.info(
                             "aborting mid-flight upgrade of node %s "
                             "(%s; was %s)", ns.node.metadata.name,
@@ -2529,6 +2763,9 @@ class ClusterUpgradeStateManager:
                     self._capacity.note_abort_finished(name, now)
                 if self.abort_audit is not None:
                     self.abort_audit("aborted", name, now, "")
+                if self._policy_engine is not None:
+                    self._policy_engine.observe_abort(
+                        "aborted", name, now, "")
                 logger.info(
                     "node %s abort complete: back to upgrade-required, "
                     "serving endpoints admitting", name)
@@ -2860,6 +3097,16 @@ class ClusterUpgradeStateManager:
                     "readyTotal": self._prewarm.ready_total,
                     "releasedTotal": self._prewarm.released_total,
                 }
+        if self._policy_engine is not None \
+                and self._policy_engine.active:
+            # the declarative-policy picture: active hooks, eval/error/
+            # budget counters, and this pass's policy holds — how the
+            # sandboxed programs are steering (or parking) the fleet
+            status["policy"] = self._policy_engine.status()
+        if self._dag is not None and self._dag.active:
+            # the multi-artifact DAG picture: per-artifact targets,
+            # quarantines, and the stamp/advance/rollback accounting
+            status["artifactDAG"] = self._dag.status()
         if self._shard_view is not None and self.last_shard_status:
             # the sharded-control-plane picture: which shards this
             # replica owns, the fleet-wide per-shard node census, and
@@ -3147,6 +3394,14 @@ class ClusterUpgradeStateManager:
                         detail += f", ~{remaining:.0f}s predicted left"
                     detail += ")"
                 chain.append(detail)
+                if label == str(UpgradeState.VALIDATION_REQUIRED) \
+                        and self._dag is not None and self._dag.active:
+                    pending = self._dag.incomplete_artifacts(node)
+                    if pending:
+                        chain.append(
+                            f"artifact DAG advancing in this node's "
+                            f"cordon cycle: waiting on "
+                            f"{', '.join(pending)} (dependency order)")
         if obs is not None:
             records = obs.audit.records_for(node_name, limit=10)
             out["records"] = [rec.as_dict() for rec in records]
@@ -3192,6 +3447,12 @@ class ClusterUpgradeStateManager:
                 f"(class {hold_inputs.get('class')}) below its "
                 f"replication floor; prewarm arc: "
                 f"{hold_inputs.get('prewarm')}")
+        engine = self._policy_engine
+        if engine is not None and name in engine.last_holds:
+            rule, detail = engine.last_holds[name]
+            detail = detail or ("the declarative admission program "
+                                "denied the candidate")
+            chain.append(f"held by policy hook: {rule} — {detail}")
         latest = obs.audit.records_for(name, limit=5) \
             if obs is not None else []
         for rec in latest:

@@ -62,6 +62,17 @@ class ValidationManager:
         self.nudger = nudger
         self.retry_seconds = retry_seconds
         self._keys = provider.keys
+        #: Policy-engine seam (policy/engine.py), re-pointed by the
+        #: state manager every pass: ``fn(node) -> None`` (pass),
+        #: ``"policy-verdict"`` (unhealthy — runs the normal timeout
+        #: ladder exactly like a failing extra validator) or
+        #: ``"policy-park"`` (the program itself failed or overran its
+        #: budget — the node PARKS in validation with no timer, audited
+        #: by the engine, so a bad policy can delay but never
+        #: fail/wedge a node). The DAG coordinator's completion gate
+        #: rides the same seam with park semantics.
+        self.policy_validator: Optional[Callable[[Node], Optional[str]]] \
+            = None
 
     @property
     def pod_selector(self) -> str:
@@ -76,7 +87,8 @@ class ValidationManager:
         failing extra validator) starts/checks the timeout; expiry flips the
         node to upgrade-failed.
         """
-        if not self._pod_selector and self._extra_validator is None:
+        if not self._pod_selector and self._extra_validator is None \
+                and self.policy_validator is None:
             return True  # trivially valid, no annotation traffic (:72-74)
 
         failure = self._gate_failure(node)
@@ -91,7 +103,18 @@ class ValidationManager:
             logger.warning("no validation pods found on node %s",
                            node.metadata.name)
             return False
-        if failure == "extra-validator" and self.nudger is not None:
+        if failure == "policy-park":
+            # The policy program itself failed/overran (or the artifact
+            # DAG is still advancing): PARK — no failure timer. The
+            # engine/coordinator already audited why; progress comes
+            # from fixing the policy (or the DS controller), liveness
+            # from the chaos gate's convergence check.
+            if self.nudger is not None:
+                self.nudger.nudge_after(self.retry_seconds,
+                                        "validation-retry")
+            return False
+        if failure in ("extra-validator", "policy-verdict") \
+                and self.nudger is not None:
             # the probe's eventual pass emits no cluster event — poll it
             # on the timer wheel instead of waiting for the resync
             self.nudger.nudge_after(self.retry_seconds,
@@ -128,6 +151,17 @@ class ValidationManager:
                 healthy = False
             if not healthy:
                 return "extra-validator"
+        if self.policy_validator is not None:
+            try:
+                verdict = self.policy_validator(node)
+            except Exception as exc:  # noqa: BLE001 — the sandbox
+                # boundary's boundary: even a broken seam parks
+                # instead of wedging the pass
+                logger.warning("policy validator raised on node %s "
+                               "(parking): %s", node.metadata.name, exc)
+                verdict = "policy-park"
+            if verdict:
+                return verdict
         return None
 
     def _handle_timeout(self, node: Node,
