@@ -4,7 +4,7 @@ PYTHON ?= python3
 PYTEST_FLAGS ?= -q
 COV_THRESHOLD ?= 85
 
-.PHONY: all check test test-fast test-fault test-chaos test-soak test-scale test-rollout test-latency test-reconfig test-shard test-planner test-budget test-handover test-obs test-federation test-policy test-dag lint cov bench bench-reconcile bench-latency bench-shard bench-shard-100k bench-planner bench-budget bench-obs bench-federation graft-check package clean diagram
+.PHONY: all check test test-fast test-fault test-chaos test-soak test-scale test-rollout test-latency test-reconfig test-shard test-planner test-budget test-handover test-obs test-federation test-policy test-dag test-precursor lint cov bench bench-reconcile bench-latency bench-shard bench-shard-100k bench-planner bench-budget bench-obs bench-federation bench-precursor graft-check package clean diagram
 
 all: lint test
 
@@ -244,6 +244,26 @@ bench-obs:
 # BENCH_budget.json.
 bench-budget:
 	$(PYTHON) tools/budget_bench.py --out BENCH_budget.json
+
+# Failure-precursor slice (`precursor` marker): NodeHealthSignal +
+# FailurePrecursorModel units (EWMA rates, verdict streaks, durable
+# seed resume), the at-risk condemn-before-fail arc (remap while
+# serving, planned drain, fleet budget, zero-residue stand-down,
+# wedge takeover), crash-mid-condemnation resume, explain()/ranker
+# integration, and the seeded degradation-then-death chaos gate
+# (run_precursor_soak). Seeds 1-3 tier-1, 4-10 slow (the standing
+# convention).
+test-precursor:
+	$(PYTHON) -m pytest tests/ $(PYTEST_FLAGS) -m "precursor and not slow"
+
+# Condemn-before-fail vs the reactive ladder on the seeded
+# degradation-then-death episode: predictive must pay ZERO victim
+# downtime and drop ZERO sessions while the reactive baseline pays
+# both, final states bit-identical modulo the precursor's own stamps
+# (tools/precursor_bench.py; docs/auto-remediation.md). Writes
+# BENCH_precursor.json.
+bench-precursor:
+	$(PYTHON) tools/precursor_bench.py --out BENCH_precursor.json
 
 graft-check:
 	$(PYTHON) __graft_entry__.py

@@ -105,6 +105,7 @@ def main() -> int:
     reconcile_pipeline = _reconcile_pipeline_cells()
     latency_scheduling = _latency_scheduling_cells()
     planner_cells = _planner_cells()
+    precursor_cells = _precursor_cells()
     straggler = _straggler_scenario()
     scale_down = _scale_down_scenario()
 
@@ -164,6 +165,14 @@ def main() -> int:
         # pass of learning) are the acceptance metrics; full document
         # also written to BENCH_planner.json
         "predictive_planner": planner_cells,
+        # condemn-before-fail (tools/precursor_bench.py): the failure-
+        # precursor model vs the reactive ladder on the seeded
+        # degradation-then-death episode — predictive must show zero
+        # victim downtime and zero dropped sessions while the reactive
+        # baseline pays both, with bit-identical final states; the
+        # committed BENCH_precursor.json acceptance artifact is owned
+        # by `make bench-precursor`
+        "failure_precursor": precursor_cells,
         # flattened legacy keys (round-over-round comparability); the
         # "ours" cell is the full framework path (slice_watch)
         "flat_availability_pct": reference,
@@ -1327,6 +1336,39 @@ def _planner_cells() -> dict:
     except Exception as exc:  # noqa: BLE001 — section boundary
         return {"error": f"{type(exc).__name__}: {exc}"}
     sidecar = os.environ.get("BENCH_PLANNER_SIDECAR")
+    if sidecar:
+        try:
+            with open(sidecar, "w") as fh:
+                json.dump(cells, fh, indent=2)
+                fh.write("\n")
+        except OSError as exc:
+            cells["sidecar_error"] = str(exc)
+    return cells
+
+
+def _precursor_cells() -> dict:
+    """Condemn-before-fail comparison (ISSUE 16 tentpole): the
+    FailurePrecursorModel's at-risk arc vs the reactive-only ladder on
+    the seeded degradation-then-death chaos episode, via
+    tools/precursor_bench.py. bench.py runs a one-seed smoke
+    (BENCH_PRECURSOR_SEEDS overrides); the committed
+    BENCH_precursor.json acceptance artifact is owned by `make
+    bench-precursor` (the CLI tool with --out) and is only written
+    from here when BENCH_PRECURSOR_SIDECAR is explicitly set. A cell
+    failure degrades to a structured error — the bench never dies on
+    one section."""
+    from tools.precursor_bench import check, run_precursor_bench
+
+    seeds = tuple(
+        int(s) for s in os.environ.get(
+            "BENCH_PRECURSOR_SEEDS", "1").split(","))
+    try:
+        cells = run_precursor_bench(seeds)
+        cells["acceptance"] = {"ok": not check(cells),
+                               "problems": check(cells)}
+    except Exception as exc:  # noqa: BLE001 — section boundary
+        return {"error": f"{type(exc).__name__}: {exc}"}
+    sidecar = os.environ.get("BENCH_PRECURSOR_SIDECAR")
     if sidecar:
         try:
             with open(sidecar, "w") as fh:

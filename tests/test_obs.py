@@ -706,6 +706,30 @@ def _exercise_all_observers(registry: MetricsRegistry) -> None:
         registry, [_Endpoint("ep-a", 3, False)],
         retired=[_Endpoint("ep-b", 0, True)])
 
+    class _Precursor:
+        known_nodes = 8
+        at_risk_streaks = 1
+        observations_total = 24
+
+        @staticmethod
+        def pooled_stats():
+            return {"ecc": {"count": 16, "mean": 2.5, "p50": 1.0,
+                            "p95": 12.0},
+                    "link-flap": {"count": 0, "mean": None,
+                                  "p50": None, "p95": None}}
+
+        @staticmethod
+        def drain_rate_samples():
+            return [("ecc", 4.0), ("ecc", 120.0), ("thermal", 0.5)]
+
+    class _PrecursorManager:
+        at_risk_condemned_total = 1
+        at_risk_aborted_total = 0
+        at_risk_parked_total = 1
+        at_risk_budget_deferrals_total = 2
+
+    m.observe_precursor(registry, _Precursor(), _PrecursorManager())
+
 
 class TestExpositionRoundTrip:
     def test_every_observer_renders_valid_exposition(self):

@@ -1,0 +1,724 @@
+"""Predictive condemn-before-fail: the NodeHealthSignal counter
+contract, the FailurePrecursorModel (EWMA rates, verdict streaks,
+durable per-node seed resume), the remediation machine's ``at-risk``
+arc (condemn while serving, remap, planned drain, budget, stand-down,
+wedge takeover), crash-atomic resume mid-condemnation, the explain()
+chain and DisruptionCostRanker tier for a held at-risk node, the
+policy/CRD surface, metrics, and the seeded precursor chaos gate
+(degradation-then-death: the model must fire and the slice must remap
+BEFORE the seeded kill lands — zero unplanned drops)."""
+
+import pytest
+
+pytestmark = [pytest.mark.fault, pytest.mark.precursor]
+
+from tpu_operator_libs.api.remediation_policy import (
+    PrecursorPolicySpec,
+    ReconfigurationPolicySpec,
+    RemediationPolicySpec,
+)
+from tpu_operator_libs.api.upgrade_policy import PolicyValidationError
+from tpu_operator_libs.chaos import (
+    FAULT_DEGRADATION,
+    FAULT_NODE_KILL,
+    FAULT_OPERATOR_CRASH,
+    FaultSchedule,
+    OperatorCrash,
+    PrecursorChaosConfig,
+    run_precursor_soak,
+)
+from tpu_operator_libs.chaos.injector import (
+    CrashFuse,
+    CrashingStateProvider,
+)
+from tpu_operator_libs.consts import (
+    GKE_NODEPOOL_LABEL,
+    GKE_TPU_ACCELERATOR_LABEL,
+    GKE_TPU_TOPOLOGY_LABEL,
+    TRUE_STRING,
+    RemediationKeys,
+    RemediationState,
+    TopologyKeys,
+    UpgradeKeys,
+    UpgradeState,
+)
+from tpu_operator_libs.health.precursor import (
+    SIGNALS,
+    FailurePrecursorModel,
+    NodeHealthSignal,
+    decode_rates,
+    encode_rates,
+)
+from tpu_operator_libs.k8s.fake import FakeCluster
+from tpu_operator_libs.k8s.objects import Node, ObjectMeta
+from tpu_operator_libs.metrics import MetricsRegistry, observe_precursor
+from tpu_operator_libs.remediation import NodeRemediationManager
+from tpu_operator_libs.topology.reconfigurer import SliceReconfigurer
+from tpu_operator_libs.util import FakeClock
+
+from builders import DaemonSetBuilder, NodeBuilder, PodBuilder
+
+NS = "tpu-system"
+RUNTIME_LABELS = {"app": "libtpu"}
+KEYS = RemediationKeys()
+UKEYS = UpgradeKeys()
+TKEYS = TopologyKeys()
+
+#: The fixed tier-1 precursor gate seeds (4-10 run under @slow below).
+GATE_SEEDS = (1, 2, 3)
+SLOW_GATE_SEEDS = tuple(range(4, 11))
+
+
+def tpu_labels(pool=None, accel="tpu-v5-lite-podslice", topo="2x2"):
+    labels = {GKE_TPU_ACCELERATOR_LABEL: accel,
+              GKE_TPU_TOPOLOGY_LABEL: topo,
+              "google.com/tpu": "true"}
+    if pool is not None:
+        labels[GKE_NODEPOOL_LABEL] = pool
+    return labels
+
+
+def make_fleet(n_slices=2, hosts=2, spares=1, revision="new"):
+    clock = FakeClock(start=1_000_000.0)
+    cluster = FakeCluster(clock=clock)
+    cluster.enable_ds_controller(recreate_delay=2.0, ready_delay=4.0)
+    ds = DaemonSetBuilder("libtpu", namespace=NS) \
+        .with_labels(dict(RUNTIME_LABELS)) \
+        .with_desired_scheduled(n_slices * hosts) \
+        .with_revision_hash(revision).create(cluster)
+    for s in range(n_slices):
+        for h in range(hosts):
+            node = NodeBuilder(f"s{s}-h{h}") \
+                .with_labels(tpu_labels(f"pool-{s}")) \
+                .with_upgrade_state(UKEYS, UpgradeState.DONE) \
+                .create(cluster)
+            PodBuilder(f"libtpu-s{s}-h{h}", namespace=NS).on_node(node) \
+                .owned_by(ds).with_revision_hash(revision).create(cluster)
+    for i in range(spares):
+        labels = tpu_labels()
+        labels[TKEYS.spare_pool_label] = TRUE_STRING
+        labels[UKEYS.state_label] = str(UpgradeState.DONE)
+        cluster.seed_node_with_ds_pod(
+            Node(metadata=ObjectMeta(name=f"spare-{i}", labels=labels)),
+            NS, "libtpu", revision_hash=revision)
+    return cluster, clock, ds
+
+
+def make_manager(cluster, clock, source, provider=None, fresh_model=None):
+    model = fresh_model or FailurePrecursorModel(
+        keys=KEYS, clock=clock, min_observations=3,
+        rate_threshold_per_hour=6.0)
+    reconfigurer = SliceReconfigurer(
+        cluster, TKEYS, remediation_keys=KEYS, upgrade_keys=UKEYS,
+        clock=clock)
+    manager = NodeRemediationManager(
+        cluster, KEYS, upgrade_keys=UKEYS, clock=clock,
+        poll_interval=0.0, sync_timeout=5.0, provider=provider,
+        reconfigurer=reconfigurer, precursor=model,
+        precursor_source=source)
+    return manager, reconfigurer, model
+
+
+def make_policy(**precursor_kwargs):
+    precursor_kwargs.setdefault("enable", True)
+    policy = RemediationPolicySpec(
+        enable=True, settle_seconds=0,
+        reconfiguration=ReconfigurationPolicySpec(
+            enable=True, settle_seconds=0),
+        precursor=PrecursorPolicySpec(**precursor_kwargs))
+    policy.detection.not_ready_grace_seconds = 0
+    return policy
+
+
+def apply(manager, policy, passes=1):
+    for _ in range(passes):
+        snapshot = manager.build_state(NS, RUNTIME_LABELS)
+        manager.apply_state(snapshot, policy)
+    return snapshot
+
+
+def rem_state(cluster, name):
+    return cluster.get_node(name).metadata.labels.get(KEYS.state_label, "")
+
+
+class RampingSource:
+    """Telemetry stub: one node's ECC counter climbs every read (a
+    deterministic degradation ramp), every other node stays silent."""
+
+    def __init__(self, node, signal="ecc", by=1):
+        self.sig = NodeHealthSignal(node)
+        self.node = node
+        self.signal = signal
+        self.by = by
+        self.ramping = True
+
+    def __call__(self):
+        if self.ramping:
+            self.sig.bump(self.signal, self.by)
+        return {self.node: self.sig.read()}
+
+
+def tick(manager, policy, clock, passes=1, seconds=30.0):
+    """One telemetry interval per pass: 1 event / 30s == 120/h, far
+    over the 6/h condemnation threshold."""
+    for _ in range(passes):
+        clock.advance(seconds)
+        apply(manager, policy)
+
+
+# ---------------------------------------------------------------------------
+# NodeHealthSignal
+# ---------------------------------------------------------------------------
+class TestNodeHealthSignal:
+    def test_counters_start_at_zero_per_family(self):
+        sig = NodeHealthSignal("n0")
+        assert sig.read() == {s: 0 for s in SIGNALS}
+
+    def test_bump_and_read_snapshot(self):
+        sig = NodeHealthSignal("n0", counters={"ecc": 3})
+        assert sig.bump("ecc", 2) == 5
+        snap = sig.read()
+        assert snap["ecc"] == 5
+        snap["ecc"] = 99  # snapshot is a copy
+        assert sig.read()["ecc"] == 5
+
+    def test_unknown_family_accepted_but_model_ignores(self):
+        sig = NodeHealthSignal("n0")
+        sig.bump("pcie-replay", 4)
+        assert sig.read()["pcie-replay"] == 4
+        model = FailurePrecursorModel(min_observations=1,
+                                      clock=FakeClock())
+        model.observe("n0", sig.read(), now=0.0)
+        sig.bump("pcie-replay", 400)
+        model.observe("n0", sig.read(), now=3600.0)
+        assert model.verdict("n0") is None
+
+    @pytest.mark.parametrize("kwargs", [
+        {"node": ""},
+        {"node": "n0", "counters": {"ECC": 1}},
+        {"node": "n0", "counters": {"-bad-": 1}},
+        {"node": "n0", "counters": {"ecc": -1}},
+        {"node": "n0", "counters": {"ecc": True}},
+        {"node": "n0", "counters": {"ecc": 1.5}},
+    ])
+    def test_malformed_rejected_at_construction(self, kwargs):
+        with pytest.raises(ValueError):
+            NodeHealthSignal(**kwargs)
+
+    def test_malformed_bump_rejected(self):
+        sig = NodeHealthSignal("n0")
+        with pytest.raises(ValueError):
+            sig.bump("ecc", -1)
+        with pytest.raises(ValueError):
+            sig.bump("Not A Label")
+
+
+# ---------------------------------------------------------------------------
+# FailurePrecursorModel
+# ---------------------------------------------------------------------------
+class TestFailurePrecursorModel:
+    def test_first_snapshot_is_baseline_only(self):
+        model = FailurePrecursorModel(clock=FakeClock())
+        assert model.observe("n0", {"ecc": 5}, now=0.0) is None
+        assert model.observations_total == 0
+        assert model.verdict("n0") is None
+
+    def test_seed_annotation_rides_the_callers_patch(self):
+        model = FailurePrecursorModel(clock=FakeClock())
+        model.observe("n0", {"ecc": 0}, now=0.0)
+        updates = model.observe("n0", {"ecc": 10}, now=3600.0)
+        key = KEYS.precursor_rates_annotation
+        assert updates is not None and key in updates
+        assert decode_rates(updates[key])["ecc"] > 0.0
+        # unchanged rates -> no redundant write
+        again = model.observe("n0", {"ecc": 10},
+                              now=7200.0,
+                              annotations={key: updates[key]})
+        assert again is None or again[key] != updates[key]
+
+    def test_verdict_needs_consecutive_streak(self):
+        model = FailurePrecursorModel(clock=FakeClock(),
+                                      min_observations=3,
+                                      rate_threshold_per_hour=6.0)
+        now = 0.0
+        model.observe("n0", {"ecc": 0}, now=now)
+        for i in range(1, 3):
+            now += 3600.0
+            model.observe("n0", {"ecc": i * 100}, now=now)
+            assert model.verdict("n0") is None, \
+                f"verdict fired after only {i} observation(s)"
+        now += 3600.0
+        model.observe("n0", {"ecc": 300}, now=now)
+        verdict = model.verdict("n0")
+        assert verdict is not None and verdict.signal == "ecc"
+        assert verdict.reason.startswith("precursor-ecc:")
+        assert ">=6/h" in verdict.reason
+
+    def test_one_noisy_sample_never_condemns(self):
+        model = FailurePrecursorModel(clock=FakeClock(),
+                                      min_observations=3)
+        model.observe("n0", {"ecc": 0}, now=0.0)
+        model.observe("n0", {"ecc": 500}, now=3600.0)  # one spike
+        model.observe("n0", {"ecc": 500}, now=7200.0)  # quiet again
+        assert model.verdict("n0") is None
+
+    def test_cold_model_never_cleared(self):
+        model = FailurePrecursorModel(clock=FakeClock(),
+                                      min_observations=3)
+        assert not model.cleared("n0"), \
+            "a cold model must never stand down a durable at-risk stamp"
+
+    def test_cleared_after_clean_streak_this_incarnation(self):
+        model = FailurePrecursorModel(clock=FakeClock(),
+                                      min_observations=2,
+                                      smoothing=1.0)
+        now = 0.0
+        model.observe("n0", {"ecc": 0}, now=now)
+        for count in (100, 100, 100):  # flat counter: rate 0
+            now += 3600.0
+            model.observe("n0", {"ecc": count}, now=now)
+        assert model.cleared("n0")
+
+    def test_fresh_incarnation_resumes_from_durable_seed(self):
+        key = KEYS.precursor_rates_annotation
+        seed = {key: encode_rates({"ecc": 120.0})}
+        model = FailurePrecursorModel(clock=FakeClock(),
+                                      min_observations=1,
+                                      smoothing=0.5)
+        # baseline read-through: the durable seed becomes the EWMA
+        model.observe("n0", {"ecc": 0}, now=0.0, annotations=seed)
+        # one modest over-nothing sample: the seeded EWMA keeps the
+        # node over threshold -> verdict on the FIRST real observation
+        model.observe("n0", {"ecc": 1}, now=3600.0, annotations=seed)
+        verdict = model.verdict("n0")
+        assert verdict is not None
+        assert verdict.rate_per_hour > 6.0
+
+    def test_counter_reset_rebaselines_not_negative(self):
+        model = FailurePrecursorModel(clock=FakeClock(),
+                                      min_observations=1)
+        model.observe("n0", {"ecc": 500}, now=0.0)
+        # agent restarted: counter fell; post-reset count is the
+        # window's worth of events, never a negative rate
+        model.observe("n0", {"ecc": 20}, now=3600.0)
+        samples = dict(model.drain_rate_samples())
+        assert samples["ecc"] == 20.0
+
+    def test_rates_codec_round_trip(self):
+        rates = {"ecc": 12.5, "link-flap": 0.0, "thermal": 250.0}
+        assert decode_rates(encode_rates(rates)) == rates
+        assert decode_rates(None) == {}
+        assert decode_rates("garbage") == {}
+        # unknown families are filtered on decode (closed set)
+        assert "pcie" not in decode_rates("pcie:1.0,ecc:2.0")
+
+    @pytest.mark.parametrize("kwargs", [
+        {"smoothing": 0.0},
+        {"smoothing": 1.5},
+        {"rate_threshold_per_hour": 0.0},
+        {"min_observations": 0},
+        {"min_observations": True},
+    ])
+    def test_constructor_validation(self, kwargs):
+        with pytest.raises(ValueError):
+            FailurePrecursorModel(**kwargs)
+
+
+# ---------------------------------------------------------------------------
+# policy surface
+# ---------------------------------------------------------------------------
+class TestPolicySurface:
+    def test_defaults_and_round_trip(self):
+        spec = PrecursorPolicySpec()
+        assert not spec.enable and spec.max_at_risk == "10%"
+        data = PrecursorPolicySpec(
+            enable=True, max_at_risk=2, rate_threshold_per_hour=3.5,
+            min_observations=5, smoothing=0.25).to_dict()
+        back = PrecursorPolicySpec.from_dict(data)
+        assert back.enable and back.max_at_risk == 2
+        assert back.rate_threshold_per_hour == 3.5
+        assert back.min_observations == 5 and back.smoothing == 0.25
+
+    @pytest.mark.parametrize("kwargs", [
+        {"max_at_risk": -1},
+        {"rate_threshold_per_hour": 0.0},
+        {"min_observations": 0},
+        {"smoothing": 0.0},
+        {"smoothing": 1.1},
+    ])
+    def test_validation_rejects(self, kwargs):
+        with pytest.raises(PolicyValidationError):
+            PrecursorPolicySpec(enable=True, **kwargs).validate()
+
+    def test_precursor_requires_reconfiguration(self):
+        policy = RemediationPolicySpec(
+            enable=True,
+            precursor=PrecursorPolicySpec(enable=True))
+        with pytest.raises(PolicyValidationError,
+                           match="reconfiguration"):
+            policy.validate()
+        policy.reconfiguration = ReconfigurationPolicySpec(enable=True)
+        policy.validate()
+
+
+# ---------------------------------------------------------------------------
+# the at-risk arc
+# ---------------------------------------------------------------------------
+class TestAtRiskArc:
+    def test_condemn_before_fail_full_walk(self):
+        """Ramp one node's ECC counter: verdict -> at-risk -> spare
+        joins its pool while it still serves -> planned drain -> parked
+        FAILED with the condemned stamp. The reactive ladder never ran."""
+        cluster, clock, _ds = make_fleet(spares=1)
+        source = RampingSource("s0-h0")
+        manager, reconfigurer, _model = make_manager(
+            cluster, clock, source)
+        policy = make_policy()
+        tick(manager, policy, clock, passes=3)  # baseline + streak 2
+        assert rem_state(cluster, "s0-h0") == ""
+        tick(manager, policy, clock, passes=1)  # streak 3: verdict
+        node = cluster.get_node("s0-h0")
+        assert KEYS.at_risk_annotation in node.metadata.annotations
+        reason = node.metadata.annotations[
+            KEYS.at_risk_reason_annotation]
+        assert reason.startswith("precursor-ecc:")
+        assert manager.at_risk_condemned_total == 1
+        tick(manager, policy, clock, passes=8)
+        # spare joined the pool; victim parked out of it
+        assert cluster.get_node("spare-0").metadata.labels.get(
+            GKE_NODEPOOL_LABEL) == "pool-0"
+        victim = cluster.get_node("s0-h0")
+        assert GKE_NODEPOOL_LABEL not in victim.metadata.labels
+        assert rem_state(cluster, "s0-h0") \
+            == str(RemediationState.FAILED)
+        assert KEYS.condemned_annotation in victim.metadata.annotations
+        assert victim.is_unschedulable()
+        assert victim.metadata.labels.get(UKEYS.skip_label) \
+            == TRUE_STRING
+        assert manager.at_risk_parked_total == 1
+        assert reconfigurer.reconfigurations_total == 1
+        # predictive, not reactive: no wedge was ever detected
+        assert manager.wedged_detected_total == 0
+
+    def test_stand_down_with_zero_residue(self):
+        """No spare, risk subsides: the arc aborts back to healthy and
+        every at-risk stamp leaves in the same commit."""
+        cluster, clock, _ds = make_fleet(spares=0)
+        source = RampingSource("s0-h0")
+        manager, _reconfigurer, _model = make_manager(
+            cluster, clock, source)
+        policy = make_policy()
+        tick(manager, policy, clock, passes=4)
+        assert rem_state(cluster, "s0-h0") \
+            == str(RemediationState.AT_RISK)
+        source.ramping = False  # counters go flat: rates decay to 0
+        tick(manager, policy, clock, passes=8)
+        node = cluster.get_node("s0-h0")
+        assert rem_state(cluster, "s0-h0") == ""
+        assert KEYS.at_risk_annotation not in node.metadata.annotations
+        assert KEYS.at_risk_reason_annotation \
+            not in node.metadata.annotations
+        assert not node.is_unschedulable()
+        assert manager.at_risk_aborted_total == 1
+
+    def test_fleet_budget_defers_condemnations(self):
+        """maxAtRisk 1 on a 5-node fleet: the second ramping node's
+        verdict is deferred, not committed — a signal storm can never
+        mass-drain the fleet."""
+        cluster, clock, _ds = make_fleet(spares=1)
+        sig0, sig1 = NodeHealthSignal("s0-h0"), NodeHealthSignal("s1-h0")
+
+        def source():
+            sig0.bump("ecc", 3)
+            sig1.bump("thermal", 3)
+            return {"s0-h0": sig0.read(), "s1-h0": sig1.read()}
+
+        manager, _reconfigurer, _model = make_manager(
+            cluster, clock, source)
+        policy = make_policy(max_at_risk=1)
+        tick(manager, policy, clock, passes=6)
+        stamped = [n.metadata.name for n in cluster.list_nodes()
+                   if KEYS.at_risk_annotation in n.metadata.annotations]
+        assert len(stamped) == 1
+        assert manager.at_risk_budget_deferrals_total >= 1
+
+    def test_wedge_beats_planned_drain_no_grace(self):
+        """The hardware dies mid-arc: the at-risk node falls to the
+        reactive ladder immediately (the precursor already distrusts
+        it — no grace window)."""
+        cluster, clock, _ds = make_fleet(spares=1)
+        source = RampingSource("s0-h0")
+        manager, _reconfigurer, _model = make_manager(
+            cluster, clock, source)
+        policy = make_policy()
+        policy.detection.not_ready_grace_seconds = 600
+        tick(manager, policy, clock, passes=4)
+        assert rem_state(cluster, "s0-h0") \
+            == str(RemediationState.AT_RISK)
+        cluster.set_node_ready("s0-h0", False)
+        apply(manager, policy)
+        assert rem_state(cluster, "s0-h0") \
+            == str(RemediationState.WEDGED)
+
+    def test_pool_less_node_never_condemned_at_risk(self):
+        """A ramping node with no slice has nothing to route around:
+        the verdict is not committed (the reactive ladder will handle
+        the death if it comes)."""
+        cluster, clock, _ds = make_fleet(spares=1)
+        source = RampingSource("spare-0")
+        manager, _reconfigurer, _model = make_manager(
+            cluster, clock, source)
+        tick(manager, make_policy(), clock, passes=6)
+        node = cluster.get_node("spare-0")
+        assert KEYS.at_risk_annotation not in node.metadata.annotations
+        assert manager.at_risk_condemned_total == 0
+
+
+# ---------------------------------------------------------------------------
+# crash-atomic resume (the satellite regression)
+# ---------------------------------------------------------------------------
+class TestCrashMidCondemnation:
+    def test_crash_between_verdict_and_reserve_resumes(self):
+        """Detonate the fuse on the very write that commits at-risk:
+        the verdict stamp landed, the spare reservation did not. A
+        fresh incarnation — fresh manager AND a cold model — must
+        resume the arc from the annotations alone: reserve, remap,
+        park; the cold model must NOT stand the arc down."""
+        cluster, clock, _ds = make_fleet(spares=2)
+        source = RampingSource("s0-h0")
+        fuse = CrashFuse()
+        provider = CrashingStateProvider(
+            cluster, KEYS, None, clock, sync_timeout=5.0,
+            poll_interval=0.0, fuse=fuse)
+        manager, _reconfigurer, _model = make_manager(
+            cluster, clock, source, provider=provider)
+        policy = make_policy()
+        tick(manager, policy, clock, passes=3)  # streak 2, no verdict
+        # the verdict pass's only durable write is the AT_RISK state
+        # commit (the ramp is steady, so the EWMA seed annotation is
+        # already current and observe() returns no update) — die right
+        # after that commit, before process_at_risk_nodes (which works
+        # from the pre-commit snapshot anyway) can stamp a reservation
+        fuse.arm(0, after=True)
+        clock.advance(30.0)
+        with pytest.raises(OperatorCrash):
+            apply(manager, policy)
+        node = cluster.get_node("s0-h0")
+        assert KEYS.at_risk_annotation in node.metadata.annotations
+        assert rem_state(cluster, "s0-h0") \
+            == str(RemediationState.AT_RISK)
+        for name in ("spare-0", "spare-1"):
+            spare = cluster.get_node(name)
+            assert TKEYS.reserved_for_annotation \
+                not in spare.metadata.annotations, \
+                "crash landed BEFORE the reservation stamp"
+        # fresh incarnation: cold model, no shared state
+        fresh, reconfigurer, model = make_manager(cluster, clock, source)
+        assert not model.cleared("s0-h0")
+        tick(fresh, policy, clock, passes=10)
+        victim = cluster.get_node("s0-h0")
+        assert rem_state(cluster, "s0-h0") \
+            == str(RemediationState.FAILED)
+        assert KEYS.condemned_annotation in victim.metadata.annotations
+        assert GKE_NODEPOOL_LABEL not in victim.metadata.labels
+        joined = [n for n in ("spare-0", "spare-1")
+                  if cluster.get_node(n).metadata.labels.get(
+                      GKE_NODEPOOL_LABEL) == "pool-0"]
+        assert len(joined) == 1, "exactly one spare backfilled the pool"
+        assert reconfigurer.reconfigurations_total == 1
+        # zero residue: no dangling reservation on the unused spare
+        for name in ("spare-0", "spare-1"):
+            spare = cluster.get_node(name)
+            if name not in joined:
+                assert TKEYS.reserved_for_annotation \
+                    not in spare.metadata.annotations
+
+
+# ---------------------------------------------------------------------------
+# explain() chain + ranker tier for a held at-risk node
+# ---------------------------------------------------------------------------
+class TestExplainAtRisk:
+    def test_explain_surfaces_the_at_risk_condemnation(self):
+        from tpu_operator_libs.simulate import (
+            FleetSpec,
+            build_fleet,
+        )
+        from tpu_operator_libs.upgrade.state_manager import (
+            ClusterUpgradeStateManager,
+        )
+
+        cluster, clock, keys = build_fleet(
+            FleetSpec(n_slices=2, hosts_per_slice=2))
+        mgr = ClusterUpgradeStateManager(cluster, keys, clock=clock,
+                                         async_workers=False,
+                                         poll_interval=0.0)
+        rem = RemediationKeys(driver=keys.driver, domain=keys.domain)
+        cluster.patch_node_annotations("s0-h0", {
+            rem.at_risk_annotation: "12345",
+            rem.at_risk_reason_annotation: "precursor-ecc:42/h>=6/h",
+        })
+        mgr.build_state(NS, dict(RUNTIME_LABELS))
+        result = mgr.explain("s0-h0")
+        text = " ".join(result["blocking"])
+        assert "at-risk" in text
+        assert "precursor-ecc:42/h>=6/h" in text
+        assert "planned" in text
+
+
+class TestRankerAtRiskTier:
+    def _ranker_bits(self):
+        from tpu_operator_libs.health.serving_gate import ServingEndpoint
+        from tpu_operator_libs.api.upgrade_policy import TrafficClassSpec
+        from tpu_operator_libs.upgrade.state_manager import (
+            ClusterUpgradeState,
+            NodeUpgradeState,
+        )
+
+        def ns(name, at_risk=False):
+            node = Node(metadata=ObjectMeta(name=name))
+            if at_risk:
+                node.metadata.annotations[KEYS.at_risk_annotation] = "1"
+            return NodeUpgradeState(node=node, runtime_pod=None,
+                                    runtime_daemon_set=None)
+
+        def ep(node):
+            e = ServingEndpoint(f"decode-{node}", capacity=8,
+                                traffic_class="interactive", model="m")
+            assert e.try_begin()
+            return e
+
+        classes = {"interactive": TrafficClassSpec(name="interactive",
+                                                   interactive=True)}
+        return ns, ep, classes, ClusterUpgradeState
+
+    def test_at_risk_node_outranks_every_serving_tier(self):
+        """An interactive-serving at-risk node is the CHEAPEST drain:
+        it is leaving anyway — the budget goes to it first."""
+        from tpu_operator_libs.upgrade.handover import (
+            RANK_AT_RISK,
+            DisruptionCostRanker,
+        )
+
+        ns, ep, classes, ClusterUpgradeState = self._ranker_bits()
+        risky = ns("risky", at_risk=True)
+        safe = ns("safe")
+        mapping = {"risky": [ep("risky")],
+                   "safe": [ep("safe")],
+                   "other": [ep("other")]}
+
+        class Inner:
+            calls = []
+
+            def plan(self, candidates, available, state):
+                Inner.calls.append(
+                    [c.node.metadata.name for c in candidates])
+                return list(candidates[:max(0, available)])
+
+        audits = []
+        ranker = DisruptionCostRanker(
+            Inner(), source=lambda: mapping, classes=classes,
+            audit=lambda *args: audits.append(args),
+            at_risk_annotation=KEYS.at_risk_annotation)
+        state = ClusterUpgradeState(node_states={
+            str(UpgradeState.UPGRADE_REQUIRED): [risky, safe]})
+        selected = ranker.plan([safe, risky], 1, state)
+        # budget 1: the at-risk node wins despite serving interactive
+        assert [s.node.metadata.name for s in selected] == ["risky"]
+        assert Inner.calls[0] == ["risky"]
+        assert ranker.last_rank["atRisk"] == 1
+        rank_records = [a for a in audits if a[3] == RANK_AT_RISK]
+        assert len(rank_records) == 1
+        # first-sight dedup: a second pass records nothing new
+        ranker.plan([safe, risky], 1, state)
+        assert len([a for a in audits if a[3] == RANK_AT_RISK]) == 1
+
+
+# ---------------------------------------------------------------------------
+# metrics
+# ---------------------------------------------------------------------------
+class TestPrecursorMetrics:
+    def test_observe_precursor_exports_the_arc(self):
+        cluster, clock, _ds = make_fleet(spares=1)
+        source = RampingSource("s0-h0")
+        manager, _reconfigurer, model = make_manager(
+            cluster, clock, source)
+        tick(manager, make_policy(), clock, passes=6)
+        registry = MetricsRegistry()
+        observe_precursor(registry, model, manager)
+        text = registry.render_prometheus()
+        assert "tpu_upgrade_precursor_nodes_observed 1" in text.replace(
+            '{driver="libtpu"}', " ").replace("  ", " ")
+        assert "precursor_at_risk_condemned_total" in text
+        assert "precursor_rate_per_hour_bucket" in text
+        assert 'signal="ecc"' in text
+
+
+# ---------------------------------------------------------------------------
+# the seeded chaos gate
+# ---------------------------------------------------------------------------
+class TestDegradationSchedule:
+    def test_schedule_is_seed_pure_and_paired(self):
+        members = {"pool-0": ["a", "b"], "pool-1": ["c", "d"],
+                   "pool-2": ["e", "f"]}
+        s1 = FaultSchedule.generate_precursor(7, members)
+        s2 = FaultSchedule.generate_precursor(7, members)
+        assert s1.events == s2.events
+        kills = [e for e in s1.events if e.kind == FAULT_NODE_KILL]
+        ramps = {e.target: e for e in s1.events
+                 if e.kind == FAULT_DEGRADATION}
+        assert len(kills) == 2
+        for kill in kills:
+            ramp = ramps[kill.target]
+            assert ramp.until == kill.at, \
+                "the degradation ramp must end exactly at the kill"
+            assert ramp.at < kill.at
+        assert any(e.kind == FAULT_OPERATOR_CRASH for e in s1.events)
+
+    def test_needs_two_multi_host_slices(self):
+        with pytest.raises(ValueError, match="multi-host"):
+            FaultSchedule.generate_precursor(
+                1, {"pool-0": ["a"], "pool-1": ["b"]})
+
+    @pytest.mark.parametrize("seed", GATE_SEEDS)
+    def test_precursor_gate_fixed_seeds(self, seed):
+        report = run_precursor_soak(seed)
+        assert report.ok, (
+            f"run_precursor_soak(seed={report.seed})\n"
+            f"{report.report_text}")
+        # the gate's teeth: zero unplanned drops, zero victim downtime
+        serving = report.stats["serving"]
+        assert serving["faultDropped"] == 0
+        assert serving["operatorDropped"] == 0
+        assert all(s == 0.0 for s in
+                   report.stats["victimDowntimeSeconds"].values())
+        assert all(lead > 0.0 for lead in
+                   report.stats["atRiskLeadSeconds"].values())
+
+
+@pytest.mark.soak
+@pytest.mark.slow
+class TestPrecursorSoak:
+    @pytest.mark.parametrize("seed", SLOW_GATE_SEEDS)
+    def test_precursor_gate_slow_seeds(self, seed):
+        report = run_precursor_soak(seed)
+        assert report.ok, (
+            f"run_precursor_soak(seed={report.seed})\n"
+            f"{report.report_text}")
+
+    def test_reactive_baseline_same_final_state(self):
+        """precursorEnable=False walks the SAME seeded episode through
+        the reactive ladder: it converges, pays real downtime and
+        drops, and lands on a bit-identical final cluster state modulo
+        the precursor's own annotations."""
+        predictive = run_precursor_soak(1)
+        baseline = run_precursor_soak(
+            1, PrecursorChaosConfig(precursor_enable=False))
+        assert baseline.ok, baseline.report_text
+        assert not baseline.stats["precursorEnabled"]
+        assert predictive.stats["fingerprint"] \
+            == baseline.stats["fingerprint"]
+        base_downtime = sum(
+            baseline.stats["victimDowntimeSeconds"].values())
+        pred_downtime = sum(
+            predictive.stats["victimDowntimeSeconds"].values())
+        assert pred_downtime == 0.0 and base_downtime > 0.0

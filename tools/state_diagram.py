@@ -106,14 +106,15 @@ REMEDIATION_SPEC = DiagramSpec(
     edges=tuple((s.value or HEALTHY, d.value or HEALTHY, c)
                 for s, d, c in REMEDIATION_EDGES),
     rank={
-        HEALTHY: 0, "wedged": 1, "cordon-required": 2,
-        "drain-required": 3, "runtime-restart-required": 4,
-        "reboot-required": 5, "revalidate-required": 6,
-        "uncordon-required": 7, "reconfigure-required": 8,
+        HEALTHY: 0, "at-risk": 1, "wedged": 2, "cordon-required": 3,
+        "drain-required": 4, "runtime-restart-required": 5,
+        "reboot-required": 6, "revalidate-required": 7,
+        "uncordon-required": 8, "reconfigure-required": 9,
     },
     fail_name="remediation-failed",
-    fail_rank=3.5,
-    fill={HEALTHY: "#e3f4e3", "wedged": "#fdf3d8",
+    fail_rank=4.5,
+    fill={HEALTHY: "#e3f4e3", "at-risk": "#fdf3d8",
+          "wedged": "#fdf3d8",
           "remediation-failed": "#fbe9e7",
           "reconfigure-required": "#fdf3d8"},
 )

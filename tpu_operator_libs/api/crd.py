@@ -728,6 +728,55 @@ def reconfiguration_schema() -> dict[str, Any]:
     }
 
 
+def precursor_schema() -> dict[str, Any]:
+    """PrecursorPolicySpec (predictive condemn-before-fail — the
+    Ironwood proactive-routing analogue)."""
+    return {
+        "type": "object",
+        "description": "Predictive condemn-before-fail: an online "
+                       "failure-precursor model condemns nodes whose "
+                       "hardware-health counter rates (ECC, link-flap, "
+                       "thermal) cross threshold, remapping their slice "
+                       "onto a spare while they still serve. Requires "
+                       "reconfiguration.enable.",
+        "properties": {
+            "enable": {
+                "type": "boolean",
+                "default": False,
+                "description": "Master switch; when false the "
+                               "remediation machine stays purely "
+                               "reactive.",
+            },
+            "maxAtRisk": _int_or_string(
+                "Fleet-wide at-risk condemnation budget: nodes carrying "
+                "the at-risk stamp may never exceed this count or fleet "
+                "percentage — a signal storm can never mass-drain the "
+                "fleet.", default="10%"),
+            "rateThresholdPerHour": {
+                "type": "number",
+                "default": 6.0,
+                "description": "Events/hour a per-node EWMA precursor "
+                               "rate must reach before the node is a "
+                               "condemnation candidate.",
+            },
+            "minObservations": {
+                "type": "integer",
+                "minimum": 1,
+                "default": 3,
+                "description": "Consecutive over-threshold observations "
+                               "required before the at-risk verdict "
+                               "fires (and the stand-down streak an "
+                               "in-flight arc needs to abort).",
+            },
+            "smoothing": {
+                "type": "number",
+                "default": 0.5,
+                "description": "EWMA smoothing factor in (0, 1].",
+            },
+        },
+    }
+
+
 def remediation_policy_schema() -> dict[str, Any]:
     """RemediationPolicySpec (api/remediation_policy.py): the
     unplanned-fault machine's declarative surface."""
@@ -792,6 +841,7 @@ def remediation_policy_schema() -> dict[str, Any]:
             "drain": drain_schema(),
             "detection": wedge_detection_schema(),
             "reconfiguration": reconfiguration_schema(),
+            "precursor": precursor_schema(),
         },
     }
 

@@ -152,6 +152,73 @@ class ReconfigurationPolicySpec:
 
 
 @dataclass
+class PrecursorPolicySpec:
+    """Predictive condemn-before-fail (the Ironwood proactive-routing
+    analogue): an online :class:`~tpu_operator_libs.health.precursor.
+    FailurePrecursorModel` watches per-node hardware-health counter
+    rates (ECC / link-flap / thermal) and, when a node's EWMA rate has
+    stayed over threshold for ``minObservations`` consecutive samples,
+    condemns it AT RISK — spare reserved, slice remapped, node drained
+    as a planned low-cost candidate while it still serves. Requires
+    ``reconfiguration.enable`` (the arc routes through the
+    SliceReconfigurer).
+    """
+
+    # Master switch; when False the machine stays purely reactive.
+    enable: bool = False
+    # Fleet-wide at-risk condemnation budget: the count of nodes
+    # carrying the at-risk stamp (in-flight or parked) may never exceed
+    # this fraction/count of the fleet — a noisy signal storm can slow
+    # remaps down but can never mass-drain the fleet.
+    max_at_risk: IntOrString = "10%"
+    # Events/hour a per-node EWMA rate must reach before the node is a
+    # condemnation candidate.
+    rate_threshold_per_hour: float = 6.0
+    # Consecutive over-threshold observations required before the
+    # verdict fires (a single noisy sample can never condemn a node);
+    # also the stand-down streak an in-flight arc needs to abort.
+    min_observations: int = 3
+    # EWMA smoothing factor in (0, 1] (same semantics as the duration
+    # predictor's).
+    smoothing: float = 0.5
+
+    def validate(self) -> None:
+        if scaled_value_from_int_or_percent(self.max_at_risk, 100) < 0:
+            raise PolicyValidationError(
+                "precursor.maxAtRisk must be >= 0")
+        if self.rate_threshold_per_hour <= 0:
+            raise PolicyValidationError(
+                "precursor.rateThresholdPerHour must be > 0")
+        if self.min_observations < 1:
+            raise PolicyValidationError(
+                "precursor.minObservations must be >= 1")
+        if not 0.0 < self.smoothing <= 1.0:
+            raise PolicyValidationError(
+                "precursor.smoothing must be in (0, 1]")
+
+    def to_dict(self) -> dict[str, Any]:
+        return {
+            "enable": self.enable,
+            "maxAtRisk": self.max_at_risk,
+            "rateThresholdPerHour": self.rate_threshold_per_hour,
+            "minObservations": self.min_observations,
+            "smoothing": self.smoothing,
+        }
+
+    @classmethod
+    def from_dict(cls, data: dict[str, Any]) -> "PrecursorPolicySpec":
+        return cls(
+            enable=data.get("enable", False),
+            max_at_risk=data.get("maxAtRisk", "10%"),
+            rate_threshold_per_hour=data.get("rateThresholdPerHour", 6.0),
+            min_observations=data.get("minObservations", 3),
+            smoothing=data.get("smoothing", 0.5))
+
+    def deep_copy(self) -> "PrecursorPolicySpec":
+        return copy.deepcopy(self)
+
+
+@dataclass
 class RemediationPolicySpec:
     """Top-level auto-remediation policy.
 
@@ -193,6 +260,8 @@ class RemediationPolicySpec:
     # Degraded-slice topology reconfiguration after give-up; None
     # disables it (condemned nodes park with their slice down).
     reconfiguration: Optional[ReconfigurationPolicySpec] = None
+    # Predictive condemn-before-fail; None disables it (reactive-only).
+    precursor: Optional[PrecursorPolicySpec] = None
 
     def __post_init__(self) -> None:
         if self.detection is None:
@@ -225,6 +294,15 @@ class RemediationPolicySpec:
         self.detection.validate()
         if self.reconfiguration is not None:
             self.reconfiguration.validate()
+        if self.precursor is not None:
+            self.precursor.validate()
+            if self.precursor.enable and (
+                    self.reconfiguration is None
+                    or not self.reconfiguration.enable):
+                raise PolicyValidationError(
+                    "precursor.enable requires reconfiguration.enable "
+                    "(the at-risk arc routes through the "
+                    "SliceReconfigurer)")
 
     def to_dict(self) -> dict[str, Any]:
         out: dict[str, Any] = {
@@ -242,6 +320,8 @@ class RemediationPolicySpec:
             out["drain"] = self.drain.to_dict()
         if self.reconfiguration is not None:
             out["reconfiguration"] = self.reconfiguration.to_dict()
+        if self.precursor is not None:
+            out["precursor"] = self.precursor.to_dict()
         return out
 
     @classmethod
@@ -263,6 +343,9 @@ class RemediationPolicySpec:
         if data.get("reconfiguration") is not None:
             spec.reconfiguration = ReconfigurationPolicySpec.from_dict(
                 data["reconfiguration"])
+        if data.get("precursor") is not None:
+            spec.precursor = PrecursorPolicySpec.from_dict(
+                data["precursor"])
         return spec
 
     def deep_copy(self) -> "RemediationPolicySpec":

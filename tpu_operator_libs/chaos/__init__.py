@@ -45,11 +45,13 @@ from tpu_operator_libs.chaos.runner import (
     ChaosConfig,
     ChaosReport,
     DagChaosConfig,
+    PrecursorChaosConfig,
     ReconfigChaosConfig,
     ReplicaKillConfig,
     run_bad_revision_soak,
     run_chaos_soak,
     run_dag_soak,
+    run_precursor_soak,
     run_reconfig_soak,
     run_replica_kill_soak,
 )
@@ -57,6 +59,7 @@ from tpu_operator_libs.chaos.schedule import (
     FAULT_API_BURST,
     FAULT_BAD_REVISION,
     FAULT_CRASHLOOP,
+    FAULT_DEGRADATION,
     FAULT_FED_KILL,
     FAULT_FED_PARTITION,
     FAULT_KINDS,
@@ -84,6 +87,7 @@ __all__ = [
     "FAULT_API_BURST",
     "FAULT_BAD_REVISION",
     "FAULT_CRASHLOOP",
+    "FAULT_DEGRADATION",
     "FAULT_FED_KILL",
     "FAULT_FED_PARTITION",
     "FAULT_KINDS",
@@ -105,6 +109,7 @@ __all__ = [
     "InvariantMonitor",
     "InvariantViolation",
     "OperatorCrash",
+    "PrecursorChaosConfig",
     "ReconfigChaosConfig",
     "ReconfigExpectation",
     "ReplicaKillConfig",
@@ -115,6 +120,7 @@ __all__ = [
     "run_dag_soak",
     "run_federation_bad_revision_soak",
     "run_federation_soak",
+    "run_precursor_soak",
     "run_reconfig_soak",
     "run_replica_kill_soak",
 ]

@@ -23,6 +23,7 @@ from tpu_operator_libs.chaos.schedule import (
     FAULT_API_BURST,
     FAULT_BAD_REVISION,
     FAULT_CRASHLOOP,
+    FAULT_DEGRADATION,
     FAULT_LEADER_LOSS,
     FAULT_NODE_KILL,
     FAULT_NOT_READY_FLAP,
@@ -35,6 +36,7 @@ from tpu_operator_libs.chaos.schedule import (
     FaultEvent,
     FaultSchedule,
 )
+from tpu_operator_libs.health.precursor import SIGNALS, NodeHealthSignal
 from tpu_operator_libs.consts import POD_CONTROLLER_REVISION_HASH_LABEL
 from tpu_operator_libs.consts import UpgradeState
 from tpu_operator_libs.k8s.client import ApiServerError, NotFoundError
@@ -212,6 +214,13 @@ class ChaosInjector:
         self.bad_revisions_rolled = 0
         self.nodes_killed = 0
         self.killed_nodes: list[str] = []
+        # hardware-health counters the degradation fault ramps; the
+        # runner hands ``health_source`` to the remediation manager as
+        # its PrecursorSource. Signals exist only for targeted nodes —
+        # the precursor model treats an absent node as "no sample",
+        # exactly like a telemetry agent that never reported.
+        self.health_signals: dict[str, NodeHealthSignal] = {}
+        self.degradation_ticks = 0
 
     # -- installation -----------------------------------------------------
     def install(self) -> None:
@@ -256,6 +265,8 @@ class ChaosInjector:
             elif event.kind == FAULT_NODE_KILL:
                 cluster.schedule_at(
                     event.at, lambda e=event: self._kill_node(e))
+            elif event.kind == FAULT_DEGRADATION:
+                self._install_degradation(event)
         if any(e.kind == FAULT_NODE_KILL for e in self._schedule.events):
             # a dead host's kubelet never reports a healthy container:
             # pods recreated on a killed node crash-loop until the node
@@ -282,6 +293,39 @@ class ChaosInjector:
                     event.target, BAD_REVISION_HASH)
         self._cluster.bump_daemon_set_revision(namespace, name,
                                                BAD_REVISION_HASH)
+
+    def _install_degradation(self, event: FaultEvent) -> None:
+        """Arm one degradation ramp as a fixed cadence of counter
+        bumps across ``[at, until)``. Everything is derived from the
+        event alone (seed-pure): ``param`` picks the signal family
+        (``param %% len(SIGNALS)``) and the per-tick increment, and the
+        tick times are evenly spaced — no RNG at injection time, so
+        the same schedule always ramps the same counters to the same
+        values at the same virtual instants."""
+        signal = SIGNALS[event.param % len(SIGNALS)]
+        by = max(1, event.param)
+        window = max(1.0, event.until - event.at)
+        ticks = 12
+        for i in range(ticks):
+            at = event.at + window * i / ticks
+            self._cluster.schedule_at(
+                at, lambda e=event, s=signal, b=by: self._degrade(
+                    e.target, s, b))
+
+    def _degrade(self, node: str, signal: str, by: int) -> None:
+        sig = self.health_signals.get(node)
+        if sig is None:
+            sig = self.health_signals[node] = NodeHealthSignal(node)
+        sig.bump(signal, by)
+        self.degradation_ticks += 1
+
+    def health_source(self) -> "dict[str, dict[str, int]]":
+        """Snapshot every ramped node's counters — the PrecursorSource
+        the runner hands to the remediation manager. Non-ramped nodes
+        are absent (no telemetry ever reported), which the model treats
+        as "no sample this pass"."""
+        return {name: dict(sig.read())
+                for name, sig in self.health_signals.items()}
 
     def _kill_node(self, event: FaultEvent) -> None:
         """Permanent NotReady: the node is dead hardware. No heal is

@@ -299,6 +299,7 @@ class _NodeMirror:
     ready: bool = True
     pool: str = ""
     condemned: bool = False
+    at_risk: bool = False
 
 
 @dataclass
@@ -374,6 +375,11 @@ class InvariantMonitor:
         self._joined: set[str] = set()
         #: node -> virtual time its condemned annotation first appeared.
         self._condemned_at: dict[str, float] = {}
+        #: node -> virtual time its at-risk stamp first appeared (the
+        #: predictive arc's MTTR anchor: the remap races the still-
+        #: ticking hardware from the VERDICT, not from a condemnation
+        #: that only lands after the planned drain).
+        self._at_risk_at: dict[str, float] = {}
         self._expected_armed = False
         # -- shard mode bookkeeping --
         #: shard -> virtual time it was orphaned (owner killed).
@@ -451,7 +457,10 @@ class InvariantMonitor:
             pool=labels.get(GKE_NODEPOOL_LABEL, ""),
             condemned=(self.remediation_keys is not None
                        and self.remediation_keys.condemned_annotation
-                       in node.metadata.annotations))
+                       in node.metadata.annotations),
+            at_risk=(self.remediation_keys is not None
+                     and self.remediation_keys.at_risk_annotation
+                     in node.metadata.annotations))
 
     # -- plumbing ---------------------------------------------------------
     def _now(self) -> float:
@@ -516,6 +525,8 @@ class InvariantMonitor:
                     members.setdefault(mirror.pool, set()).add(name)
                 if mirror.condemned:
                     self._condemned_at.setdefault(name, self._now())
+                if mirror.at_risk:
+                    self._at_risk_at.setdefault(name, self._now())
             self._pool_members = members
             if not self._expected_armed:
                 # the initial sync defines each slice's full shape
@@ -669,6 +680,10 @@ class InvariantMonitor:
             if not old.condemned and new.condemned:
                 self._condemned_at.setdefault(name, self._now())
                 self._record(f"node {name} condemned")
+            if not old.at_risk and new.at_risk:
+                self._at_risk_at.setdefault(name, self._now())
+                self._record(f"node {name} condemned at-risk "
+                             f"(precursor)")
             if old.pool != new.pool:
                 self._on_pool_change(name, old, new)
         if self.dag is not None:
@@ -848,6 +863,10 @@ class InvariantMonitor:
             # first) or declared degraded
             self._check_slice_shape(old.pool)
             condemned_at = self._condemned_at.get(name)
+            if condemned_at is None:
+                # predictive arc: the slice is released while the node
+                # still serves — the at-risk verdict is the anchor
+                condemned_at = self._at_risk_at.get(name)
             if condemned_at is not None:
                 self.remap_seconds.append(self._now() - condemned_at)
                 self._record(

@@ -37,6 +37,7 @@ from dataclasses import dataclass, field
 from typing import Optional
 
 from tpu_operator_libs.api.remediation_policy import (
+    PrecursorPolicySpec,
     ReconfigurationPolicySpec,
     RemediationPolicySpec,
 )
@@ -66,6 +67,7 @@ from tpu_operator_libs.chaos.invariants import (
     WindowExpectation,
 )
 from tpu_operator_libs.chaos.schedule import (
+    FAULT_NODE_KILL,
     FAULT_TRAFFIC_SPIKE,
     FaultSchedule,
 )
@@ -210,6 +212,11 @@ class ChaosReport:
     #: explain() probes run against parked nodes (each must have
     #: produced a non-empty blocking chain or a violation exists).
     explains_probed: int = 0
+    #: gate-specific outcome samples (the bench readers' feed): e.g.
+    #: the precursor gate's per-victim slice downtime and the serving
+    #: sim's drop attribution. Purely informational — never consulted
+    #: by ``ok``.
+    stats: dict = field(default_factory=dict)
 
     @property
     def ok(self) -> bool:
@@ -243,7 +250,8 @@ class _OperatorIncarnation:
                  config: ChaosConfig, injector: ChaosInjector,
                  identity: str, with_reconfigurer: bool = False,
                  serving: "Optional[ServingFleetSim]" = None,
-                 monitor: "Optional[InvariantMonitor]" = None) -> None:
+                 monitor: "Optional[InvariantMonitor]" = None,
+                 precursor_source: "object" = None) -> None:
         # The event-driven scheduling layer runs INSIDE the gate: both
         # machines carry a live ReconcileNudger (completion nudges +
         # deadline timer wheel + eager slot refill all active), exactly
@@ -301,10 +309,40 @@ class _OperatorIncarnation:
                 remediation_keys=rem_keys, upgrade_keys=keys,
                 clock=clock, nudger=self.nudger,
                 guard=injector.fuse.guard)
+        precursor = None
+        rem_gate = None
+        if precursor_source is not None:
+            # condemn-before-fail: a FRESH FailurePrecursorModel per
+            # incarnation — its memory dies with the process and must
+            # resume from the durable per-node seed annotations alone
+            # (the crash-resume claim of the predictive arc). The
+            # at-risk planned drain runs through the serving gate, so
+            # a still-serving node quiesces before its pods go.
+            spec = config.remediation_policy().precursor
+            if spec is not None and spec.enable:
+                from tpu_operator_libs.health.precursor import (
+                    FailurePrecursorModel,
+                )
+
+                precursor = FailurePrecursorModel(
+                    keys=rem_keys, clock=clock,
+                    smoothing=spec.smoothing,
+                    rate_threshold_per_hour=spec.rate_threshold_per_hour,
+                    min_observations=spec.min_observations)
+                if serving is not None:
+                    from tpu_operator_libs.health.serving_gate import (
+                        ServingDrainGate,
+                    )
+
+                    rem_gate = ServingDrainGate(serving.resolver)
         self.remediation = NodeRemediationManager(
             cluster, rem_keys, upgrade_keys=keys, clock=clock,
             provider=rem_provider, poll_interval=1.0, sync_timeout=5.0,
-            nudger=self.nudger, reconfigurer=reconfigurer)
+            nudger=self.nudger, reconfigurer=reconfigurer,
+            precursor=precursor,
+            precursor_source=(precursor_source
+                              if precursor is not None else None),
+            eviction_gate=rem_gate)
         self.elector = LeaderElector(
             cluster,
             LeaderElectionConfig(
@@ -1110,6 +1148,509 @@ def run_reconfig_soak(seed: int,
         trace=list(monitor.trace),
         decisions_recorded=monitor.decisions_recorded,
         explains_probed=monitor.explains_probed)
+    report.report_text = "\n".join(
+        [schedule.describe(), monitor.report(seed=seed)])
+    if not report.ok:
+        logger.error("%s", report.report_text)
+    return report
+
+
+@dataclass
+class PrecursorChaosConfig(ReconfigChaosConfig):
+    """Knobs of one predictive-health (condemn-before-fail) episode.
+
+    The fleet shape and reconfiguration ladder are the reconfig gate's;
+    on top of them a classless serving sim replays a diurnal trace so
+    "unplanned workload drop" is measured in SESSIONS, per id, and the
+    degradation→death schedule gives the precursor model a generous
+    observation lead before each seeded kill."""
+
+    #: False = the reactive-only baseline: the same fleet, schedule and
+    #: serving trace, but the precursor arc is disabled — every victim
+    #: pays the full WedgeDetector→ladder→condemn MTTR. The precursor
+    #: bench runs both modes and diffs the outcome.
+    precursor_enable: bool = True
+    rate_threshold_per_hour: float = 6.0
+    min_observations: int = 3
+    #: Fleet-wide at-risk budget. 50% of the 8-node default fleet = 4:
+    #: both victims condemn concurrently with headroom to prove the
+    #: budget is a cap, not a serializer.
+    max_at_risk: IntOrString = "50%"
+    per_node_capacity: int = 4
+    #: Short generations: an at-risk drain quiesces within a few ticks,
+    #: keeping the planned-drain window comfortably inside the
+    #: ramp→kill lead on every seed.
+    generation_seconds: "tuple[float, float]" = (10.0, 25.0)
+    diurnal_period: float = 600.0
+    trough_util: float = 0.3
+    peak_util: float = 0.55
+
+    def remediation_policy(self) -> RemediationPolicySpec:
+        policy = super().remediation_policy()
+        policy.precursor = PrecursorPolicySpec(
+            enable=self.precursor_enable,
+            max_at_risk=self.max_at_risk,
+            rate_threshold_per_hour=self.rate_threshold_per_hour,
+            min_observations=self.min_observations)
+        return policy
+
+    def upgrade_policy(self) -> UpgradePolicySpec:
+        policy = super().upgrade_policy()
+        # The serving sim here feeds the at-risk DRAIN gate, not the
+        # budget: with the capacity controller live, two permanently
+        # parked victims would pin "unavailable" above the shrunken
+        # effective budget and starve the rollout forever. The budget
+        # modulation gates are the budget/handover soaks' job.
+        policy.capacity = CapacityBudgetSpec(enable=False)
+        return policy
+
+
+#: Annotation-key substrings excluded from the final-state fingerprint:
+#: the precursor's own stamps (``-precursor.``, ``at-risk``) plus all
+#: three arcs' bookkeeping — remediation stamps, learned upgrade
+#: telemetry, and the reconfigurer's remap audit trail
+#: (``-topology.``) — which legitimately differ between a predictive
+#: and a reactive walk of the same episode. What remains — labels,
+#: pools, schedulability, readiness, upgrade state — must be
+#: BIT-IDENTICAL between the two modes.
+_FINGERPRINT_EXCLUDED = ("-precursor.", "-remediation.", "-upgrade.",
+                         "-topology.")
+
+
+def _fleet_fingerprint(cluster: FakeCluster,
+                       fungible: "frozenset[str]" = frozenset(),
+                       ) -> "list[tuple]":
+    """Canonical final-cluster-state digest for the precursor bench's
+    bit-identical check (modulo the excluded annotation namespaces).
+
+    ``fungible`` names the seeded hot spares, identical by
+    construction: WHICH spare backfilled which slice is
+    condemnation-order scheduling noise (the predictive walk condemns
+    in verdict order, the reactive one in kill order), so their
+    nodepool label is lifted out of the per-node tuple and folded into
+    a pool-composition digest instead — each pool must still end up
+    with the same surviving members plus the same number of spare
+    backfills.
+    """
+    out = []
+    pools: "dict[str, tuple[list[str], list[int]]]" = {}
+    for node in sorted(cluster.list_nodes(),
+                       key=lambda n: n.metadata.name):
+        name = node.metadata.name
+        labels = dict(node.metadata.labels)
+        pool = labels.get(GKE_NODEPOOL_LABEL)
+        if pool:
+            fixed, spare_count = pools.setdefault(pool, ([], [0]))
+            if name in fungible:
+                labels.pop(GKE_NODEPOOL_LABEL)
+                spare_count[0] += 1
+            else:
+                fixed.append(name)
+        annotations = tuple(sorted(
+            (k, v) for k, v in node.metadata.annotations.items()
+            if not any(sub in k for sub in _FINGERPRINT_EXCLUDED)))
+        out.append((name, tuple(sorted(labels.items())),
+                    node.is_unschedulable(), node.is_ready(),
+                    annotations))
+    out.append(("~pools", tuple(sorted(
+        (pool, tuple(sorted(fixed)), spare_count[0])
+        for pool, (fixed, spare_count) in pools.items()))))
+    return out
+
+
+def run_precursor_soak(seed: int,
+                       config: Optional[PrecursorChaosConfig] = None,
+                       ) -> ChaosReport:
+    """The condemn-before-fail gate: every seeded node kill is preceded
+    by a hardware-degradation counter ramp on the same node, and the
+    FailurePrecursorModel must route the slice around the dying host —
+    at-risk verdict, spare remapped, planned serving-gated drain —
+    BEFORE the kill lands, under operator crashes and control-plane
+    faults.
+
+    What the episode proves, via the monitor's invariants plus the
+    runner's own checks (the always-on predictive invariants; all
+    skipped in the reactive baseline mode):
+
+    - **condemn-before-fail**: with a spare available, an at-risk
+      node's slice takes ZERO downtime — at the moment its seeded kill
+      lands the victim is already out of the pool and its spare serves
+      in its place (per-victim downtime sampled every tick);
+    - **no unplanned drop**: not one serving session was dropped, by
+      fault OR operator, checked per session id — the planned drain
+      quiesced the victim's endpoint before eviction and the kill hit
+      an empty node;
+    - **predictive attribution**: every parked victim carries the
+      at-risk stamp from the PRECURSOR verdict (reason
+      ``precursor-<signal>:...``), placed >= minObservations reconcile
+      ticks before the kill — the reactive ladder never ran;
+    - plus the reconfig gate's standing invariants (slice placement,
+      joint-plan, legal transitions) and full convergence with every
+      victim parked condemned and every slice back to full shape.
+
+    Deterministic in ``seed``. The report's ``stats`` carry the bench
+    feed: per-victim downtime, serving drop attribution, and the
+    final-state fingerprint (modulo per-arc bookkeeping annotations).
+    """
+    config = config or PrecursorChaosConfig()
+    fleet = FleetSpec(
+        n_slices=config.n_slices,
+        hosts_per_slice=config.hosts_per_slice,
+        pod_recreate_delay=config.pod_recreate_delay,
+        pod_ready_delay=config.pod_ready_delay,
+        multislice_jobs=(
+            ("chaos-job", tuple(range(config.n_slices))),))
+    cluster, clock, keys = build_fleet(fleet)
+    rem_keys = RemediationKeys()
+    topo_keys = TopologyKeys(driver=keys.driver, domain=keys.domain)
+    spare_names = seed_spare_pool(cluster, fleet, config.spares)
+    node_names = [n.metadata.name for n in cluster.list_nodes()]
+
+    slice_members: dict[str, list[str]] = {}
+    for node in cluster.list_nodes():
+        pool = node.metadata.labels.get(GKE_NODEPOOL_LABEL)
+        if pool:
+            slice_members.setdefault(pool, []).append(node.metadata.name)
+    pool_of = {name: pool for pool, members in slice_members.items()
+               for name in members}
+    schedule = FaultSchedule.generate_precursor(
+        seed, slice_members, horizon=config.horizon, kills=config.kills)
+    #: victim -> seeded kill time (the downtime/lead anchors).
+    kill_at = {e.target: e.at for e in schedule.events
+               if e.kind == FAULT_NODE_KILL}
+    injector = ChaosInjector(cluster, schedule,
+                             lease_namespace=config.lease_namespace,
+                             lease_name=config.lease_name)
+    injector.install()
+    # rollout #2 EARLY, not mid-horizon: predictive remaps start as
+    # soon as a verdict streak holds (well before horizon/2), and the
+    # joint-plan invariant demands every spare join on the FINAL
+    # revision — so the final target must be declared before the first
+    # ramp opens. Write traffic deep into the crash window comes from
+    # the precursor's own durable stamps (seed annotations ride every
+    # observation pass while a ramp is ticking).
+    cluster.schedule_at(
+        config.horizon * 0.04,
+        lambda: cluster.bump_daemon_set_revision(NS, "libtpu",
+                                                 FINAL_REVISION))
+
+    trace = DiurnalTrace(seed=seed,
+                         period_seconds=config.diurnal_period,
+                         trough_util=config.trough_util,
+                         peak_util=config.peak_util)
+    serving = ServingFleetSim(
+        cluster, node_names, trace,
+        per_node_capacity=config.per_node_capacity,
+        generation_seconds=config.generation_seconds, seed=seed)
+
+    upgrade_policy = config.upgrade_policy()
+    remediation_policy = config.remediation_policy()
+    predictive = bool(remediation_policy.precursor
+                      and remediation_policy.precursor.enable)
+    monitor = InvariantMonitor(
+        cluster=cluster, upgrade_keys=keys, remediation_keys=rem_keys,
+        max_unavailable=None,
+        remediation_max_unavailable=None,
+        max_parallel_upgrades=0,
+        reconfig=ReconfigExpectation(
+            topology_keys=topo_keys,
+            target_revision=FINAL_REVISION,
+            runtime_namespace=NS))
+
+    incarnations = 1
+    handovers = 0
+    reconciles = 0
+    op = _OperatorIncarnation(cluster, clock, keys, rem_keys, config,
+                              injector, identity="operator-1",
+                              with_reconfigurer=True, serving=serving,
+                              monitor=monitor,
+                              precursor_source=injector.health_source)
+
+    def next_incarnation(reason: str) -> _OperatorIncarnation:
+        nonlocal incarnations
+        incarnations += 1
+        injector.fuse.reset()
+        monitor.trace.append(
+            f"[t={clock.now():g}] operator restart #{incarnations} "
+            f"({reason}) — rebuilding managers from cluster state alone")
+        return _OperatorIncarnation(
+            cluster, clock, keys, rem_keys, config, injector,
+            identity=f"operator-{incarnations}", with_reconfigurer=True,
+            serving=serving, monitor=monitor,
+            precursor_source=injector.health_source)
+
+    def converged() -> bool:
+        try:
+            nodes = cluster.list_nodes()
+            pods = cluster.list_pods(namespace=NS)
+            workload = cluster.list_pods(namespace=WORKLOAD_NS)
+            daemon_sets = cluster.list_daemon_sets(NS)
+        except (ApiServerError, TimeoutError):
+            return False
+        if len(nodes) != len(node_names):
+            return False
+        pods_by_node: dict[str, list] = {}
+        for pod in pods:
+            if pod.controller_owner() is not None and pod.spec.node_name:
+                pods_by_node.setdefault(pod.spec.node_name, []).append(pod)
+        pools: dict[str, list] = {}
+        parked = 0
+        for node in nodes:
+            labels = node.metadata.labels
+            condemned = rem_keys.condemned_annotation \
+                in node.metadata.annotations
+            if condemned:
+                parked += 1
+                if labels.get(rem_keys.state_label) \
+                        != str(RemediationState.FAILED):
+                    return False
+                if not node.is_unschedulable():
+                    return False
+                if labels.get(GKE_NODEPOOL_LABEL):
+                    return False
+                continue
+            if labels.get(keys.state_label) != str(UpgradeState.DONE):
+                return False
+            if labels.get(rem_keys.state_label, ""):
+                return False
+            if keys.skip_label in labels:
+                return False
+            if node.is_unschedulable() or not node.is_ready():
+                return False
+            runtime = pods_by_node.get(node.metadata.name, [])
+            if not any(
+                    p.metadata.labels.get(
+                        POD_CONTROLLER_REVISION_HASH_LABEL)
+                    == FINAL_REVISION and p.is_ready() for p in runtime):
+                return False
+            pool = labels.get(GKE_NODEPOOL_LABEL)
+            if pool:
+                pools.setdefault(pool, []).append(node)
+        for s in range(config.n_slices):
+            if len(pools.get(f"pool-{s}", [])) != fleet.hosts_per_slice:
+                return False
+        if config.spares >= config.kills and any(
+                topo_keys.degraded_slices_annotation
+                in ds.metadata.annotations for ds in daemon_sets):
+            return False
+        names = {p.metadata.name for p in workload}
+        for job, slice_ids in fleet.multislice_jobs:
+            if any(f"{job}-s{s}" not in names for s in slice_ids):
+                return False
+        # the serving fleet must be whole again: one live admitting
+        # endpoint per surviving node (parked victims serve nothing)
+        return (len(serving.endpoints) == len(node_names) - parked
+                and not any(ep.draining
+                            for ep in serving.endpoints.values()))
+
+    #: victim -> seconds its slice was short a Ready member AFTER the
+    #: seeded kill (tick-sampled): the gate's MTTR measure. Predictive
+    #: mode must hold it at zero; the reactive baseline pays the full
+    #: ladder walk here.
+    downtime: dict[str, float] = {name: 0.0 for name in kill_at}
+
+    def sample_downtime(now: float) -> None:
+        try:
+            nodes = cluster.list_nodes()
+        except (ApiServerError, TimeoutError):
+            return
+        by_pool: dict[str, list] = {}
+        for node in nodes:
+            pool = node.metadata.labels.get(GKE_NODEPOOL_LABEL)
+            if pool:
+                by_pool.setdefault(pool, []).append(node)
+        for victim, at in kill_at.items():
+            if now < at:
+                continue
+            ready = [n for n in by_pool.get(pool_of[victim], [])
+                     if n.is_ready()]
+            if len(ready) < fleet.hosts_per_slice:
+                downtime[victim] += config.reconcile_interval
+
+    steps = 0
+    is_converged = False
+    quiesce_ticks = 0
+    serving.tick(clock.now())
+    monitor.drain()
+    while steps < config.max_steps:
+        steps += 1
+        now = clock.now()
+        was_leading = op.elector.is_leader
+        op.elector.try_acquire_or_renew()
+        if was_leading and not op.elector.is_leader:
+            handovers += 1
+            op = next_incarnation("leader election lost")
+            op.elector.try_acquire_or_renew()
+        if op.elector.is_leader:
+            injector.arm_due_crashes(now)
+            op.nudger.pop_due(now)
+            op.nudger.consume_pending()
+            try:
+                op.remediation.reconcile(NS, dict(RUNTIME_LABELS),
+                                         remediation_policy)
+                op.upgrade.reconcile(NS, dict(RUNTIME_LABELS),
+                                     upgrade_policy)
+                reconciles += 1
+            except OperatorCrash:
+                op = next_incarnation("operator crash mid-reconcile")
+            except BuildStateError:
+                pass
+            except (ApiServerError, ConflictError, NotFoundError):
+                pass
+            if injector.fuse.pending:
+                op = next_incarnation("operator crash (surfaced late)")
+        monitor.drain()
+        try:
+            _restore_workload_pods_by_pool(cluster, fleet, topo_keys)
+        except (ApiServerError, TimeoutError):
+            pass
+        serving.tick(now)
+        monitor.drain()
+        sample_downtime(now)
+        if (now > schedule.last_fault_time
+                and not injector.fuse.armed
+                and not injector.fuse.pending
+                and converged()):
+            quiesce_ticks += 1
+            if quiesce_ticks >= 3:
+                is_converged = True
+                break
+        else:
+            quiesce_ticks = 0
+        clock.advance(config.reconcile_interval)
+        cluster.step()
+        monitor.drain()
+
+    if is_converged:
+        monitor.final_check()
+    else:
+        monitor.violations.append(InvariantViolation(
+            invariant="liveness", at=clock.now(), subject="fleet",
+            detail=f"fleet did not converge (victims parked, slices "
+                   f"remapped, survivors on {FINAL_REVISION!r}) within "
+                   f"{config.max_steps} steps "
+                   f"({clock.now():g}s virtual)"))
+
+    spare_backed = config.spares >= config.kills
+    lead_seconds: dict[str, float] = {}
+    try:
+        final_nodes = {n.metadata.name: n for n in cluster.list_nodes()}
+    except (ApiServerError, TimeoutError):
+        final_nodes = {}
+    if predictive and spare_backed:
+        # no unplanned drop, per SESSION: the seed-pure ids make the
+        # attribution exact — one dropped session is a named violation
+        for record in serving.drop_records:
+            monitor.violations.append(InvariantViolation(
+                invariant="predictive-drop", at=record["at"],
+                subject=record["session"],
+                detail=f"session {record['session']} was dropped "
+                       f"(cause: {record['cause']}) — an at-risk node "
+                       f"with an available spare took an unplanned "
+                       f"workload drop"))
+        for victim, at in sorted(kill_at.items()):
+            node = final_nodes.get(victim)
+            stamp = (node.metadata.annotations.get(
+                rem_keys.at_risk_annotation) if node else None)
+            reason = (node.metadata.annotations.get(
+                rem_keys.at_risk_reason_annotation, "")
+                if node else "")
+            if stamp is None:
+                monitor.violations.append(InvariantViolation(
+                    invariant="condemn-before-fail", at=clock.now(),
+                    subject=victim,
+                    detail="victim carries no at-risk stamp — the "
+                           "precursor never condemned it (the "
+                           "reactive ladder paid the MTTR instead)"))
+                continue
+            lead = at - float(int(stamp))
+            lead_seconds[victim] = lead
+            min_lead = (config.min_observations
+                        * config.reconcile_interval)
+            if lead < min_lead:
+                monitor.violations.append(InvariantViolation(
+                    invariant="condemn-before-fail", at=at,
+                    subject=victim,
+                    detail=f"at-risk verdict landed only {lead:g}s "
+                           f"before the kill (< {min_lead:g}s = "
+                           f"minObservations ticks)"))
+            if not reason.startswith("precursor-"):
+                monitor.violations.append(InvariantViolation(
+                    invariant="condemn-before-fail", at=at,
+                    subject=victim,
+                    detail=f"at-risk reason {reason!r} is not a "
+                           f"precursor verdict"))
+            if downtime.get(victim, 0.0) > 0.0:
+                monitor.violations.append(InvariantViolation(
+                    invariant="condemn-before-fail", at=at,
+                    subject=victim,
+                    detail=f"slice {pool_of[victim]} was short a Ready "
+                           f"member for {downtime[victim]:g}s after "
+                           f"the seeded kill — the remap did not "
+                           f"complete before the hardware died"))
+        if injector.degradation_ticks == 0:
+            monitor.violations.append(InvariantViolation(
+                invariant="harness", at=clock.now(), subject="injector",
+                detail="no degradation tick ever fired — the precursor "
+                       "had nothing to observe, so the gate proved "
+                       "nothing"))
+    # harness sanity shared with the reconfig gate
+    if injector.nodes_killed < 2:
+        monitor.violations.append(InvariantViolation(
+            invariant="harness", at=clock.now(), subject="injector",
+            detail=f"only {injector.nodes_killed} node kill(s) fired; "
+                   f"the gate requires kills across >= 2 slices"))
+    if injector.crashes_fired == 0:
+        monitor.violations.append(InvariantViolation(
+            invariant="harness", at=clock.now(), subject="injector",
+            detail="no operator crash fired — the schedule's crash "
+                   "events never detonated"))
+    if is_converged and len(monitor.remap_seconds) < injector.nodes_killed:
+        monitor.violations.append(InvariantViolation(
+            invariant="harness", at=clock.now(), subject="monitor",
+            detail=f"only {len(monitor.remap_seconds)} remap(s) "
+                   f"observed for {injector.nodes_killed} kill(s) — a "
+                   f"slice was not routed around its dying host"))
+
+    monitor.trace.append(
+        f"[t={clock.now():g}] precursor({'on' if predictive else 'off'})"
+        f": victim downtime (s) "
+        f"{ {v: round(s, 1) for v, s in sorted(downtime.items())} }; "
+        f"at-risk lead (s) "
+        f"{ {v: round(s, 1) for v, s in sorted(lead_seconds.items())} }; "
+        f"{injector.degradation_ticks} degradation tick(s); serving "
+        f"{serving.summary()}")
+
+    try:
+        fingerprint = _fleet_fingerprint(
+            cluster, fungible=frozenset(spare_names))
+    except (ApiServerError, TimeoutError):
+        fingerprint = []
+    report = ChaosReport(
+        seed=seed,
+        converged=is_converged,
+        violations=list(monitor.violations),
+        fault_kinds=tuple(sorted(schedule.kinds)),
+        crashes_fired=injector.crashes_fired,
+        leader_handovers=handovers,
+        operator_incarnations=incarnations,
+        watch_gaps=monitor.watch_gaps,
+        total_seconds=clock.now(),
+        steps=steps,
+        reconciles=reconciles,
+        trace=list(monitor.trace),
+        decisions_recorded=monitor.decisions_recorded,
+        explains_probed=monitor.explains_probed,
+        stats={
+            "precursorEnabled": predictive,
+            "victimDowntimeSeconds": dict(sorted(downtime.items())),
+            "atRiskLeadSeconds": dict(sorted(lead_seconds.items())),
+            "remapSeconds": sorted(
+                round(s, 1) for s in monitor.remap_seconds),
+            "serving": serving.summary(),
+            "degradationTicks": injector.degradation_ticks,
+            "fingerprint": fingerprint,
+        })
     report.report_text = "\n".join(
         [schedule.describe(), monitor.report(seed=seed)])
     if not report.ok:

@@ -59,6 +59,21 @@ FAULT_BAD_REVISION = "bad-revision"
 #: (spare remap or documented degraded admission), which is exactly
 #: what the reconfiguration soak gate proves.
 FAULT_NODE_KILL = "node-kill"
+#: The target node's hardware-health counters (ECC retries, ICI link
+#: flaps, thermal throttles) RAMP between ``at`` and ``until`` — the
+#: degradation signature a failing board emits in the days before it
+#: dies. ``param`` picks the seed-pure signal family and per-tick
+#: intensity; the injector bumps the node's NodeHealthSignal counters
+#: on a fixed cadence across the window. The fault itself breaks
+#: nothing (a counter is just a number); when the window is paired
+#: with a FAULT_NODE_KILL at ``until``, recovery is the system's job —
+#: the FailurePrecursorModel must condemn the node at-risk and the
+#: SliceReconfigurer must remap its slice to a spare BEFORE the kill
+#: lands, which is exactly what the precursor soak gate proves. As an
+#: unpaired side fault (the standing reconfig soak's pool) it is a
+#: pure red herring: counters climb on a healthy node and a run
+#: without a precursor model wired must ignore them entirely.
+FAULT_DEGRADATION = "degradation"
 #: Replayed traffic spike: the diurnal serving trace's utilization is
 #: multiplied by ``param / 10`` inside ``[at, until)`` (ramped at the
 #: edges — see chaos/serving.SpikeWindow). A HARNESS-side fault like
@@ -445,6 +460,91 @@ class FaultSchedule:
                 param=rng.randint(0, 8)))
         pool = [FAULT_API_BURST, FAULT_WATCH_BREAK, FAULT_STALE_READS,
                 FAULT_LEADER_LOSS]
+        nodes = sorted(n for members in pools.values() for n in members)
+        for kind in rng.sample(pool, min(extra_kinds, len(pool))):
+            start = rng.uniform(0.1, horizon * 0.7)
+            if kind == FAULT_API_BURST:
+                events.append(FaultEvent(
+                    at=start, kind=kind,
+                    target=rng.choice(API_BURST_OPERATIONS),
+                    param=rng.randint(1, 3)))
+            elif kind == FAULT_STALE_READS:
+                events.append(FaultEvent(
+                    at=start, kind=kind, target=rng.choice(nodes),
+                    param=rng.randint(1, 3)))
+            else:
+                events.append(FaultEvent(at=start, kind=kind))
+        # the red herring: one UNPAIRED degradation ramp on a survivor.
+        # Drawn after the pool sample so pre-existing seeds keep their
+        # side-fault draw bit-for-bit; counters climb on a node that
+        # never dies, and a run without a precursor model wired (or
+        # with one — the ramp is too short to hold a verdict streak by
+        # itself on most seeds) must not let that perturb convergence.
+        survivors = [n for n in nodes if n not in victims]
+        if survivors:
+            start = rng.uniform(0.1, horizon * 0.5)
+            events.append(FaultEvent(
+                at=start, kind=FAULT_DEGRADATION,
+                target=rng.choice(survivors),
+                until=start + rng.uniform(30.0, 90.0),
+                param=rng.randint(1, 9)))
+        events.sort(key=lambda e: (e.at, e.kind, e.target))
+        return cls(seed=seed, events=tuple(events))
+
+    @classmethod
+    def generate_precursor(cls, seed: int,
+                           slice_members: "dict[str, list[str]]",
+                           horizon: float = 600.0,
+                           kills: int = 2,
+                           extra_kinds: int = 2) -> "FaultSchedule":
+        """Schedule for the predictive-health (condemn-before-fail)
+        gate: ``kills`` permanent node kills spread across distinct
+        slices, each PRECEDED by a degradation ramp on the same node —
+        hardware-health counters start climbing early in the run and
+        the ramp window closes exactly when the kill lands, the
+        signature a failing board emits before it dies. The lead time
+        is generous by construction (ramps open in the first sixth of
+        the horizon, kills land after 40%%) so the precursor model has
+        many observation passes to hold a verdict streak and the
+        reconfigurer has time to remap BEFORE the death — the gate's
+        always-on invariant. Side faults ride along as in the reconfig
+        schedule; the healing node faults (crashloop / notready-flap)
+        stay excluded for the same serialization reason, and so does
+        leader-loss (a leaderless gap on top of the deliberately long
+        observation lead pushes slow seeds past the horizon).
+        """
+        pools = {sid: sorted(nodes)
+                 for sid, nodes in slice_members.items()
+                 if len(nodes) > 1}
+        if len(pools) < 2:
+            raise ValueError(
+                "precursor schedule needs >= 2 multi-host slices")
+        kills = max(2, min(kills, len(pools)))
+        rng = random.Random(f"chaos-precursor:{seed}")
+        victims = [rng.choice(pools[sid])
+                   for sid in rng.sample(sorted(pools), kills)]
+        events: list[FaultEvent] = []
+        for victim in victims:
+            kill_at = rng.uniform(horizon * 0.40, horizon * 0.65)
+            ramp_at = rng.uniform(horizon * 0.05, horizon * 0.15)
+            events.append(FaultEvent(
+                at=ramp_at, kind=FAULT_DEGRADATION, target=victim,
+                until=kill_at, param=rng.randint(1, 9)))
+            events.append(FaultEvent(
+                at=kill_at, kind=FAULT_NODE_KILL, target=victim))
+        for _ in range(rng.randint(1, 2)):
+            # crashes land inside 5-22% of the horizon: the precursor
+            # gate's rollout bump is EARLY (the joint plan needs the
+            # final revision declared before the first verdict), so
+            # unlike the other gates there is no mid-horizon write
+            # storm for a late-armed fuse to detonate on — rollout,
+            # verdicts and remaps all quiesce well before the seeded
+            # kills fire, and a fuse armed after that starves forever
+            events.append(FaultEvent(
+                at=rng.uniform(horizon * 0.05, horizon * 0.22),
+                kind=FAULT_OPERATOR_CRASH,
+                param=rng.randint(0, 8)))
+        pool = [FAULT_API_BURST, FAULT_WATCH_BREAK, FAULT_STALE_READS]
         nodes = sorted(n for members in pools.values() for n in members)
         for kind in rng.sample(pool, min(extra_kinds, len(pool))):
             start = rng.uniform(0.1, horizon * 0.7)

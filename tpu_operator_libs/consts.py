@@ -284,6 +284,14 @@ class RemediationState(str, enum.Enum):
     # reconfiguration: route the slice AROUND the dead host instead of
     # parking the whole ICI domain on its repair.
     RECONFIGURE_REQUIRED = "reconfigure-required"
+    # Condemned-at-risk: the FailurePrecursorModel predicts this node is
+    # going to die (ECC / link-flap / thermal precursor rates over
+    # threshold), so the machine routes around it BEFORE the failure —
+    # spare reserved, slice remapped, node drained as a *planned*
+    # low-cost candidate — all while the node still serves. The
+    # predictive dual of the reactive wedge arc: same reconfigure
+    # machinery, entered from a LIVE node instead of a dead one.
+    AT_RISK = "at-risk"
 
     def __str__(self) -> str:  # label values are plain strings
         return self.value
@@ -296,7 +304,12 @@ class RemediationState(str, enum.Enum):
 #: RECONFIGURE_REQUIRED is excluded for the same reason: the node is
 #: already dead and cordoned, and waiting for a spare to provision and
 #: upgrade can take a long time — holding a slot for that window would
-#: starve live wedges of remediation.
+#: starve live wedges of remediation. AT_RISK is excluded too: the node
+#: is still healthy and serving while its replacement provisions, and it
+#: is governed by its own fleet-wide condemnation budget
+#: (PrecursorPolicySpec.max_at_risk) rather than the remediation
+#: concurrency slots — a precursor storm must never crowd out real
+#: wedges.
 REMEDIATION_IN_PROGRESS_STATES = (
     RemediationState.CORDON_REQUIRED,
     RemediationState.DRAIN_REQUIRED,
@@ -309,6 +322,7 @@ REMEDIATION_IN_PROGRESS_STATES = (
 #: Every remediation bucket, in apply_state processing order.
 REMEDIATION_ALL_STATES = (
     RemediationState.HEALTHY,
+    RemediationState.AT_RISK,
     RemediationState.WEDGED,
     RemediationState.CORDON_REQUIRED,
     RemediationState.DRAIN_REQUIRED,
@@ -373,6 +387,18 @@ REMEDIATION_EDGES: tuple[
     (RemediationState.RECONFIGURE_REQUIRED,
      RemediationState.REVALIDATE_REQUIRED,
      "manual re-arm during reconfiguration (remap aborted)"),
+    (RemediationState.HEALTHY, RemediationState.AT_RISK,
+     "precursor verdict held for min_observations; at-risk budget "
+     "admitted"),
+    (RemediationState.AT_RISK, RemediationState.HEALTHY,
+     "precursor risk subsided before the remap joined; booking "
+     "dropped"),
+    (RemediationState.AT_RISK, RemediationState.WEDGED,
+     "hardware beat the planned drain: wedge signal on an at-risk "
+     "node (no grace)"),
+    (RemediationState.AT_RISK, RemediationState.FAILED,
+     "slice released while serving: node drained planned and parked "
+     "condemned-at-risk"),
 )
 
 #: Adjacency view of REMEDIATION_EDGES, keyed by label value
@@ -387,7 +413,9 @@ REMEDIATION_LEGAL_EDGES: dict[str, frozenset[str]] = {
 #: pods: recovery actions (drain/restart/reboot/revalidate) run only on
 #: a quarantined node — the machine cordons at admission and uncordons
 #: only after revalidation passes. Dual of WORKLOAD_UNSAFE_STATES, used
-#: by the chaos InvariantMonitor.
+#: by the chaos InvariantMonitor. AT_RISK is deliberately NOT here: the
+#: whole point of condemn-before-fail is that the node keeps serving its
+#: slice (schedulable, pods Ready) until the replacement has joined.
 REMEDIATION_WORKLOAD_UNSAFE_STATES = frozenset(str(s) for s in (
     RemediationState.DRAIN_REQUIRED,
     RemediationState.RESTART_REQUIRED,
@@ -683,6 +711,37 @@ class RemediationKeys:
         ``NodeCondemned`` Event instead of a silent FAILED dead end.
         Cleared only when the node recovers."""
         return f"{self.domain}/{self.driver}-remediation.condemned-at"
+
+    @property
+    def at_risk_annotation(self) -> str:
+        """Epoch-seconds stamp written when the FailurePrecursorModel
+        condemned the node AT RISK (predicted failure, node still
+        serving). The predictive sibling of ``condemned_annotation``:
+        it rides the SAME merge patch as the ``at-risk`` state commit
+        (crash-atomic), counts against the fleet-wide at-risk budget,
+        and is the MTTR anchor for a condemn-before-fail remap — the
+        clock starts at the verdict, not at a death that may never be
+        observed. Cleared only when the arc aborts back to healthy."""
+        return f"{self.domain}/{self.driver}-remediation.at-risk-at"
+
+    @property
+    def at_risk_reason_annotation(self) -> str:
+        """Which precursor signal condemned the node (the
+        ``PrecursorVerdict.reason`` slug, e.g. ``precursor-ecc:...``) —
+        stamped beside ``at_risk_annotation`` so a human reading the
+        node object sees the evidence, not just the verdict."""
+        return f"{self.domain}/{self.driver}-remediation.at-risk-reason"
+
+    @property
+    def precursor_rates_annotation(self) -> str:
+        """Durable per-node seed of the FailurePrecursorModel (encoded
+        per-signal EWMA rates). Deliberately under a ``-precursor``
+        prefix, NOT ``-remediation.``: the seed lives on HEALTHY nodes
+        permanently (a fresh incarnation resumes the model from cluster
+        state alone), so it must sit outside the remediation-residue
+        namespace that the chaos final_check and the reconcile
+        fingerprint treat as in-flight arc state."""
+        return f"{self.domain}/{self.driver}-precursor.rates"
 
     @property
     def event_reason(self) -> str:

@@ -13,6 +13,9 @@ Two gates that replace the reference's OFED/RDMA-specific concerns
 - ``serving_gate``: the serving-side counterpart — park new requests,
   finish in-flight generations, then admit eviction, so a rolling
   upgrade over a decode fleet drops zero generations.
+- ``precursor``: the predictive side — hardware-health counter signals
+  and the online failure-precursor model that condemns a node AT RISK
+  (and routes its slice around it) before the hardware dies.
 """
 
 from tpu_operator_libs.health.ici_probe import (  # noqa: F401
@@ -30,4 +33,9 @@ from tpu_operator_libs.health.checkpoint_gate import (  # noqa: F401
 from tpu_operator_libs.health.serving_gate import (  # noqa: F401
     ServingDrainGate,
     ServingEndpoint,
+)
+from tpu_operator_libs.health.precursor import (  # noqa: F401
+    FailurePrecursorModel,
+    NodeHealthSignal,
+    PrecursorVerdict,
 )

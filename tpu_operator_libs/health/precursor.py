@@ -1,0 +1,364 @@
+"""Failure-precursor health signals: condemn hardware BEFORE it dies.
+
+The Ironwood retrospective (PAPERS.md) credits proactive routing —
+moving work off degrading hardware before the hard failure — as a
+primary fleet-resilience mechanism, alongside the optical-circuit-switch
+remaps the :class:`~tpu_operator_libs.topology.reconfigurer.
+SliceReconfigurer` reproduces. Today's remediation machine is purely
+reactive: it waits for a :class:`~tpu_operator_libs.remediation.
+detectors.WedgeDetector` verdict, paying full MTTR and the unplanned
+session drops of a dead decode host on every failure. This module is
+the predictive half:
+
+- :class:`NodeHealthSignal` — the library-side handle for one node's
+  hardware health counters (ECC corrections, ICI link flaps, thermal
+  throttle events). Real deployments adapt this to their telemetry
+  agent; the contract the model needs is only a monotonic per-family
+  counter snapshot. Construction-time validation follows
+  :class:`~tpu_operator_libs.health.serving_gate.ServingEndpoint`: a
+  malformed counter family or a negative count must fail HERE, not
+  misbehave passes later inside the rate math.
+
+- :class:`FailurePrecursorModel` — the online model, built from the
+  same estimator pieces as the PR 9 duration predictor
+  (``upgrade/estimators.py``): a per-(node, signal) EWMA of counter
+  *rates* as the warm path, fleet-pooled bucketed rate histograms as
+  the evidence surface, and a durable per-node seed annotation so a
+  fresh operator incarnation resumes each node's model from cluster
+  state alone. ``observe`` returns the annotation updates that must
+  ride the caller's merge patch (one wire write, crash-atomic — the
+  predictor's ``observe_transition`` contract).
+
+- :class:`PrecursorVerdict` — the ``condemned-at-risk`` output: a node
+  whose EWMA rate has stayed over threshold for ``min_observations``
+  consecutive samples. The remediation machine commits it as the
+  ``at-risk`` state and routes the node into the PR 6 reconfigure arc
+  while it still serves: spare reserved, slice remapped, node drained
+  as a *planned* low-cost candidate — the failure, when it comes,
+  lands on an already-evacuated host.
+"""
+
+from __future__ import annotations
+
+import logging
+import re
+import threading
+from typing import Mapping, Optional
+
+from tpu_operator_libs.consts import RemediationKeys
+from tpu_operator_libs.upgrade.estimators import (
+    PooledHistogram,
+    ewma_update,
+)
+from tpu_operator_libs.util import Clock
+
+logger = logging.getLogger(__name__)
+
+#: The counter families the model learns, in verdict-priority order.
+#: Deliberately a closed set (like the predictor's PHASES): the durable
+#: seed annotation's encode/decode filters to these, so a renamed or
+#: retired family can never poison a resumed model.
+SIGNALS: tuple[str, ...] = ("ecc", "link-flap", "thermal")
+
+#: DNS-label shape a counter-family name must take (mirrors
+#: health/serving_gate._CLASS_NAME_RE — one validation idiom per layer,
+#: duplicated by design so this module imports nothing from serving).
+_SIGNAL_NAME_RE = re.compile(r"^[a-z0-9]([a-z0-9-]{0,61}[a-z0-9])?$")
+
+#: Pooled-histogram buckets (events per hour): precursor rates ride the
+#: scale from background noise (fractions of an event per hour) to the
+#: runaway ramps a dying part emits (hundreds per hour).
+RATE_PER_HOUR_BUCKETS: tuple[float, ...] = (
+    0.1, 0.25, 0.5, 1.0, 2.5, 5.0, 10.0, 25.0, 50.0, 100.0, 250.0,
+    1000.0)
+
+
+class NodeHealthSignal:
+    """Monotonic hardware-health counters for one node.
+
+    Thread-safe: a telemetry agent bumps counters while the operator's
+    reconcile thread snapshots them. Counter families are validated at
+    construction and on every ``bump`` — the model side must never see
+    a malformed family name or a non-integer count.
+    """
+
+    def __init__(self, node: str,
+                 counters: "Optional[Mapping[str, int]]" = None) -> None:
+        if not isinstance(node, str) or not node:
+            raise ValueError("NodeHealthSignal node must be a non-empty "
+                             "string")
+        self.node = node
+        self._lock = threading.Lock()
+        self._counters: dict[str, int] = {s: 0 for s in SIGNALS}
+        if counters:
+            for signal, value in counters.items():
+                self._validate(signal, value)
+                self._counters[signal] = value
+
+    def _validate(self, signal: str, value: int) -> None:
+        if not isinstance(signal, str) \
+                or not _SIGNAL_NAME_RE.match(signal):
+            raise ValueError(
+                f"NodeHealthSignal {self.node}: counter family "
+                f"{signal!r} is malformed (must be a lowercase DNS "
+                f"label)")
+        if isinstance(value, bool) or not isinstance(value, int) \
+                or value < 0:
+            raise ValueError(
+                f"NodeHealthSignal {self.node}: counter {signal!r} must "
+                f"be a non-negative integer, got {value!r}")
+
+    def bump(self, signal: str, by: int = 1) -> int:
+        """Add ``by`` events to one counter family; returns the new
+        cumulative count. Families outside :data:`SIGNALS` are accepted
+        (forward compatibility with richer telemetry) — the model simply
+        ignores them."""
+        self._validate(signal, by)
+        with self._lock:
+            self._counters[signal] = self._counters.get(signal, 0) + by
+            return self._counters[signal]
+
+    def read(self) -> "dict[str, int]":
+        """Point-in-time snapshot of every counter family."""
+        with self._lock:
+            return dict(self._counters)
+
+
+class PrecursorVerdict:
+    """One ``condemned-at-risk`` verdict: which signal family crossed
+    the line, and by how much. Immutable evidence — the remediation
+    machine stamps ``reason`` durably next to the at-risk commit."""
+
+    __slots__ = ("node", "signal", "rate_per_hour", "threshold_per_hour")
+
+    def __init__(self, node: str, signal: str, rate_per_hour: float,
+                 threshold_per_hour: float) -> None:
+        self.node = node
+        self.signal = signal
+        self.rate_per_hour = rate_per_hour
+        self.threshold_per_hour = threshold_per_hour
+
+    @property
+    def reason(self) -> str:
+        """Machine-readable slug (the at-risk-reason annotation value)."""
+        return (f"precursor-{self.signal}:"
+                f"{self.rate_per_hour:g}/h>={self.threshold_per_hour:g}/h")
+
+    @property
+    def detail(self) -> str:
+        return (f"{self.signal} precursor rate {self.rate_per_hour:g}/h "
+                f"crossed the condemnation threshold "
+                f"{self.threshold_per_hour:g}/h")
+
+
+class FailurePrecursorModel:
+    """Online per-node failure-precursor model (PR 9 predictor idiom).
+
+    Feed :meth:`observe` one counter snapshot per node per reconcile
+    pass; it converts the monotonic counters into per-hour rates
+    against the previous snapshot, folds them into the per-node EWMA
+    and the fleet pool, and returns the annotation updates that keep
+    the node's durable model seed current. :meth:`verdict` answers
+    whether the node has earned the ``condemned-at-risk`` call;
+    :meth:`cleared` answers whether an already-committed at-risk arc
+    may stand down — and deliberately answers False on a cold model, so
+    a freshly restarted operator can never abort a verdict a previous
+    incarnation committed durably.
+    """
+
+    def __init__(self, keys: Optional[RemediationKeys] = None,
+                 clock: Optional[Clock] = None,
+                 smoothing: float = 0.5,
+                 rate_threshold_per_hour: float = 6.0,
+                 min_observations: int = 3) -> None:
+        if not 0.0 < smoothing <= 1.0:
+            raise ValueError("smoothing must be in (0, 1]")
+        if rate_threshold_per_hour <= 0.0:
+            raise ValueError("rate_threshold_per_hour must be positive")
+        if isinstance(min_observations, bool) \
+                or not isinstance(min_observations, int) \
+                or min_observations < 1:
+            raise ValueError("min_observations must be a positive integer")
+        self.keys = keys or RemediationKeys()
+        self._clock = clock or Clock()
+        self.smoothing = smoothing
+        self.rate_threshold_per_hour = rate_threshold_per_hour
+        self.min_observations = min_observations
+        # One coarse lock over every model mutation, exactly like the
+        # duration predictor: observations arrive from the reconcile
+        # pass while metrics drains and status reads run concurrently.
+        self._lock = threading.Lock()
+        # per-(node, signal) EWMA of events/hour
+        self._ewma: dict[str, dict[str, float]] = {}
+        # per-node previous snapshot: (at, {signal: count}) — the rate
+        # baseline. In-memory only: losing it on a crash re-baselines
+        # the node (one sample lost, never invented).
+        self._last: dict[str, tuple[float, dict[str, int]]] = {}
+        # consecutive over-threshold / under-threshold observations
+        self._streak: dict[str, int] = {}
+        self._clear_streak: dict[str, int] = {}
+        # fleet-pooled per-signal rate histograms (evidence surface)
+        self._pooled: dict[str, PooledHistogram] = {
+            signal: PooledHistogram(RATE_PER_HOUR_BUCKETS)
+            for signal in SIGNALS}
+        #: (signal, rate_per_hour) samples since the last metrics drain.
+        self._sample_buffer: list[tuple[str, float]] = []
+        #: lifetime accounting
+        self.observations_total = 0
+
+    # ------------------------------------------------------------------
+    # learning side
+    # ------------------------------------------------------------------
+    def observe(self, name: str, counters: "Mapping[str, int]",
+                now: Optional[float] = None,
+                annotations: "Optional[Mapping[str, str]]" = None,
+                ) -> "Optional[dict[str, Optional[str]]]":
+        """Fold one counter snapshot into the node's model.
+
+        Returns annotation updates (the durable per-node seed) to merge
+        into the caller's patch when the encoded rates changed, or None.
+        The first snapshot after a (re)start only establishes the rate
+        baseline — and seeds the in-memory EWMA from the node's durable
+        annotation, so a fresh incarnation resumes from cluster state
+        alone instead of relearning the fleet from zero.
+        """
+        if now is None:
+            now = self._clock.now()
+        seed_key = self.keys.precursor_rates_annotation
+        with self._lock:
+            per_node = self._ewma.get(name)
+            if per_node is None:
+                per_node = {}
+                if annotations:
+                    # read-through: the durable seed becomes the
+                    # in-memory model (the predictor's crash-recovery
+                    # idiom)
+                    per_node.update(decode_rates(
+                        annotations.get(seed_key)))
+                self._ewma[name] = per_node
+            last = self._last.get(name)
+            snapshot = {signal: int(counters.get(signal, 0))
+                        for signal in SIGNALS}
+            self._last[name] = (now, snapshot)
+            if last is None or now <= last[0]:
+                return None  # baseline (re)established; no rate yet
+            t0, prev = last
+            hours = (now - t0) / 3600.0
+            for signal in SIGNALS:
+                delta = snapshot[signal] - prev.get(signal, 0)
+                if delta < 0:
+                    # counter reset (agent restart): the post-reset
+                    # count is the whole window's worth of events
+                    delta = snapshot[signal]
+                rate = delta / hours
+                per_node[signal] = ewma_update(per_node.get(signal),
+                                               rate, self.smoothing)
+                self._pooled[signal].record(rate)
+                self._sample_buffer.append((signal, rate))
+            self.observations_total += 1
+            if any(per_node.get(signal, 0.0)
+                   >= self.rate_threshold_per_hour
+                   for signal in SIGNALS):
+                self._streak[name] = self._streak.get(name, 0) + 1
+                self._clear_streak[name] = 0
+            else:
+                self._clear_streak[name] = \
+                    self._clear_streak.get(name, 0) + 1
+                self._streak[name] = 0
+            encoded = encode_rates(per_node)
+        durable = annotations.get(seed_key) if annotations else None
+        if encoded and encoded != durable:
+            return {seed_key: encoded}
+        return None
+
+    # ------------------------------------------------------------------
+    # verdict side
+    # ------------------------------------------------------------------
+    def verdict(self, name: str) -> Optional[PrecursorVerdict]:
+        """The ``condemned-at-risk`` call: the worst over-threshold
+        signal once the node's EWMA has stayed over the line for
+        ``min_observations`` consecutive observations (a single noisy
+        sample can never condemn a node)."""
+        with self._lock:
+            if self._streak.get(name, 0) < self.min_observations:
+                return None
+            per_node = self._ewma.get(name, {})
+            over = [(per_node[signal], signal) for signal in SIGNALS
+                    if per_node.get(signal, 0.0)
+                    >= self.rate_threshold_per_hour]
+            if not over:
+                return None
+            rate, signal = max(over)
+        return PrecursorVerdict(name, signal, round(rate, 3),
+                                self.rate_threshold_per_hour)
+
+    def cleared(self, name: str) -> bool:
+        """True when THIS incarnation has itself observed the node
+        under threshold ``min_observations`` times in a row — the
+        stand-down gate for an in-flight at-risk arc. A cold model
+        (fresh incarnation, zero observations) is never cleared: the
+        durable at-risk stamp outranks an empty memory."""
+        with self._lock:
+            return (self._clear_streak.get(name, 0)
+                    >= self.min_observations)
+
+    # ------------------------------------------------------------------
+    # evidence feed (observe_precursor / status)
+    # ------------------------------------------------------------------
+    def drain_rate_samples(self) -> "list[tuple[str, float]]":
+        """(signal, events/hour) samples observed since the last drain."""
+        with self._lock:
+            out, self._sample_buffer = self._sample_buffer, []
+        return out
+
+    @property
+    def known_nodes(self) -> int:
+        with self._lock:
+            return len(self._ewma)
+
+    @property
+    def at_risk_streaks(self) -> int:
+        """Nodes currently carrying a non-zero over-threshold streak."""
+        with self._lock:
+            return sum(1 for v in self._streak.values() if v)
+
+    def pooled_stats(self) -> "dict[str, dict]":
+        """Per-signal pooled (count, mean, p50, p95) events/hour — the
+        model's own evidence, read through the shared quantile
+        estimator (same shape as the predictor's pooled_stats)."""
+        out = {}
+        with self._lock:
+            for signal, pooled in self._pooled.items():
+                out[signal] = {
+                    "count": pooled.count,
+                    "mean": (round(pooled.total / pooled.count, 2)
+                             if pooled.count else None),
+                    "p50": (round(pooled.quantile(0.5), 2)
+                            if pooled.count else None),
+                    "p95": (round(pooled.quantile(0.95), 2)
+                            if pooled.count else None),
+                }
+        return out
+
+
+def decode_rates(value: Optional[str]) -> "dict[str, float]":
+    """``ecc=12.5,link-flap=0.4`` -> {signal: events/hour} (unknown
+    families and malformed entries are dropped — the predictor's
+    decode_durations contract)."""
+    out: dict[str, float] = {}
+    if not value:
+        return out
+    for entry in value.split(","):
+        signal, sep, raw = entry.partition("=")
+        if not sep or signal not in SIGNALS:
+            continue
+        try:
+            out[signal] = float(raw)
+        except ValueError:
+            continue
+    return out
+
+
+def encode_rates(rates: "dict[str, float]") -> str:
+    return ",".join(f"{signal}={rates[signal]:g}"
+                    for signal in SIGNALS if signal in rates)

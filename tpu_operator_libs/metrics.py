@@ -887,6 +887,83 @@ def observe_remediation(registry: MetricsRegistry,
             buckets=RECOVERY_SECONDS_BUCKETS)
 
 
+#: Buckets for precursor rate samples (events/hour): healthy hardware
+#: idles near 0, the condemnation threshold defaults to single digits,
+#: and a seeded degradation ramp lands in the tens-to-hundreds — the
+#: buckets must resolve the threshold crossing, not the tail.
+PRECURSOR_RATE_BUCKETS = (1.0, 3.0, 6.0, 12.0, 30.0, 60.0, 120.0,
+                          300.0, 600.0)
+
+
+def observe_precursor(registry: MetricsRegistry,
+                      model: "FailurePrecursorModel",
+                      manager: "NodeRemediationManager" = None,
+                      driver: str = "libtpu") -> None:
+    """Export the failure-precursor model's evidence and the at-risk
+    arc's accounting.
+
+    Rides the same scrape as the remediation gauges: the model's
+    census (nodes it has telemetry for, nodes carrying an
+    over-threshold streak), its per-signal pooled evidence, the rate
+    samples it drew since the last scrape (histogram labeled by
+    signal), and — when the owning ``manager`` is passed — the
+    lifetime at-risk counters. ``at_risk_budget_deferrals_total``
+    climbing while ``at_risk_condemned_total`` is flat is the on-call
+    signature of a too-tight condemnation budget.
+    """
+    labels = {"driver": driver}
+    registry.set_gauge(
+        "precursor_nodes_observed", model.known_nodes,
+        "Nodes the precursor model holds telemetry for", labels)
+    registry.set_gauge(
+        "precursor_at_risk_streaks", model.at_risk_streaks,
+        "Nodes currently on an over-threshold observation streak",
+        labels)
+    registry.set_counter_total(
+        "precursor_observations_total", model.observations_total,
+        "Health-counter snapshots folded into the model", labels)
+    for signal, stats in model.pooled_stats().items():
+        sig_labels = {**labels, "signal": signal}
+        registry.set_gauge(
+            "precursor_pooled_samples", stats["count"],
+            "Fleet-pooled rate samples held per signal", sig_labels)
+        if stats["mean"] is not None:
+            registry.set_gauge(
+                "precursor_pooled_rate_mean", stats["mean"],
+                "Fleet-pooled mean rate per signal (events/hour)",
+                sig_labels)
+        if stats["p95"] is not None:
+            registry.set_gauge(
+                "precursor_pooled_rate_p95", stats["p95"],
+                "Fleet-pooled p95 rate per signal (events/hour)",
+                sig_labels)
+    for signal, rate in model.drain_rate_samples():
+        registry.observe_histogram(
+            "precursor_rate_per_hour", rate,
+            "Per-node precursor rates observed (events/hour)",
+            {**labels, "signal": signal},
+            buckets=PRECURSOR_RATE_BUCKETS)
+    if manager is None:
+        return
+    registry.set_counter_total(
+        "precursor_at_risk_condemned_total",
+        manager.at_risk_condemned_total,
+        "Nodes condemned at-risk on a precursor verdict", labels)
+    registry.set_counter_total(
+        "precursor_at_risk_aborted_total",
+        manager.at_risk_aborted_total,
+        "At-risk arcs stood down after the risk subsided", labels)
+    registry.set_counter_total(
+        "precursor_at_risk_parked_total",
+        manager.at_risk_parked_total,
+        "At-risk nodes drained and parked for manual repair", labels)
+    registry.set_counter_total(
+        "precursor_at_risk_deferrals_total",
+        manager.at_risk_budget_deferrals_total,
+        "Verdicts deferred by the fleet at-risk condemnation budget",
+        labels)
+
+
 #: Buckets for condemned→remapped durations: a remap rides the spare's
 #: upgrade (one cordon/drain cycle) plus the reconfigurer's settle.
 REMAP_SECONDS_BUCKETS = (30.0, 60.0, 120.0, 300.0, 600.0, 1200.0,

@@ -32,6 +32,10 @@ One reconcile is:
    reconfigure-required ─┬─(slice released: spare
                             remap | degraded admit)→ remediation-failed
                          └─(manual re-arm)─────────→ revalidate
+   healthy ─(precursor verdict, budget admitted)───→ at-risk
+   at-risk ─┬─(risk subsided before the join)──────→ healthy
+            ├─(wedge signal: hardware beat us)─────→ wedged
+            └─(slice released; planned drain done)─→ remediation-failed
 
 Durability model is identical to the upgrade machine: the node label is
 the commit point, every decision re-derives from the snapshot, and the
@@ -50,8 +54,15 @@ from __future__ import annotations
 
 import contextlib
 import logging
-from dataclasses import dataclass, field
-from typing import TYPE_CHECKING, Iterator, Optional, Protocol
+from dataclasses import dataclass, field, replace
+from typing import (
+    TYPE_CHECKING,
+    Callable,
+    Iterator,
+    Mapping,
+    Optional,
+    Protocol,
+)
 
 from tpu_operator_libs.api.remediation_policy import RemediationPolicySpec
 from tpu_operator_libs.api.upgrade_policy import (
@@ -83,6 +94,7 @@ from tpu_operator_libs.remediation.detectors import (
     default_detector_chain,
 )
 from tpu_operator_libs.upgrade.cordon_manager import CordonManager
+from tpu_operator_libs.upgrade.gate import EvictionGate, GateKeeper
 from tpu_operator_libs.upgrade.state_provider import (
     NodeUpgradeStateProvider,
 )
@@ -90,8 +102,14 @@ from tpu_operator_libs.upgrade.validation_manager import NodeValidator
 from tpu_operator_libs.util import Clock, Event, EventRecorder, log_event
 
 if TYPE_CHECKING:
+    from tpu_operator_libs.health.precursor import FailurePrecursorModel
     from tpu_operator_libs.topology.reconfigurer import SliceReconfigurer
     from tpu_operator_libs.upgrade.nudger import ReconcileNudger
+
+#: Telemetry seam for the predictive arc: () -> {node name: {signal
+#: family: cumulative count}} — the operator-side read of whatever
+#: NodeHealthSignal sources the deployment runs.
+PrecursorSource = Callable[[], Mapping[str, Mapping[str, int]]]
 
 logger = logging.getLogger(__name__)
 
@@ -192,6 +210,9 @@ class NodeRemediationManager:
                  poll_interval: float = 1.0,
                  nudger: Optional["ReconcileNudger"] = None,
                  reconfigurer: Optional["SliceReconfigurer"] = None,
+                 precursor: Optional["FailurePrecursorModel"] = None,
+                 precursor_source: Optional[PrecursorSource] = None,
+                 eviction_gate: Optional[EvictionGate] = None,
                  ) -> None:
         self.keys = keys or RemediationKeys()
         # Completion-wakeup seam, shared with the upgrade machine (both
@@ -226,6 +247,22 @@ class NodeRemediationManager:
         # None = the pre-reconfiguration dead end (FAILED parks the
         # slice), regardless of policy.
         self.reconfigurer = reconfigurer
+        # Predictive condemn-before-fail seams (health/precursor.py):
+        # the online model plus the telemetry read that feeds it. Both
+        # must be present (and policy.precursor.enable on) for the
+        # at-risk arc to run — otherwise the machine stays reactive.
+        self.precursor = precursor
+        self.precursor_source = precursor_source
+        # Serving-aware gate for the at-risk PLANNED drain (same
+        # EvictionGate contract as the upgrade machine's drain path,
+        # with the same park-don't-escalate GateKeeper semantics): the
+        # at-risk node is still serving when its slice is released, so
+        # eviction waits for in-flight work to finish. The REACTIVE
+        # drain rungs never consult it — their pods are already dead.
+        self._at_risk_gatekeeper = GateKeeper(
+            self.keys, recorder,  # type: ignore[arg-type]
+            "at-risk drain")
+        self._at_risk_gatekeeper.set_gate(eviction_gate)
         # Set per apply_state pass from policy.reconfiguration: when
         # True, nodes parked in the upgrade machine's terminal FAILED
         # state are eligible for wedge detection/triage (the upgrade
@@ -239,6 +276,11 @@ class NodeRemediationManager:
         self.remediations_failed_total = 0
         self.runtime_restarts_total = 0
         self.reboots_requested_total = 0
+        # predictive-arc counters (exported via metrics.observe_precursor)
+        self.at_risk_condemned_total = 0
+        self.at_risk_aborted_total = 0
+        self.at_risk_parked_total = 0
+        self.at_risk_budget_deferrals_total = 0
         self._recovery_seconds: list[float] = []
         self._transient_deferrals = 0
         self.last_pass_deferrals = 0
@@ -324,6 +366,8 @@ class NodeRemediationManager:
             self.reconfigurer.begin_pass(snapshot)
         detector = self._detector_for_policy(policy)
         self.process_healthy_nodes(snapshot, detector)
+        self.process_precursor_signals(snapshot, policy)
+        self.process_at_risk_nodes(snapshot, policy, detector)
         self.process_wedged_nodes(snapshot, policy, detector)
         self.process_cordon_required_nodes(snapshot)
         self.process_drain_required_nodes(snapshot, policy)
@@ -428,6 +472,251 @@ class NodeRemediationManager:
                               f"Node wedged ({signal.reason}): "
                               f"{signal.detail}")
 
+    def process_precursor_signals(self, snapshot: RemediationSnapshot,
+                                  policy: RemediationPolicySpec) -> None:
+        """Predictive detection (condemn-before-fail): feed every
+        healthy node's hardware-health counters to the
+        FailurePrecursorModel, keep each node's durable model seed
+        current, and commit ``at-risk`` verdicts under the fleet-wide
+        condemnation budget. The verdict stamp and its evidence ride
+        the SAME merge patch as the state commit — a crash between
+        "decided" and "stamped" is impossible, so a fresh incarnation
+        resumes the arc from annotations alone."""
+        spec = policy.precursor
+        reconfig = policy.reconfiguration
+        if (spec is None or not spec.enable
+                or self.precursor is None
+                or self.precursor_source is None
+                or reconfig is None or not reconfig.enable
+                or self.reconfigurer is None):
+            return
+        try:
+            counters_by_node = self.precursor_source()
+        except Exception as exc:  # noqa: BLE001 — telemetry seam boundary
+            logger.warning("precursor source raised; skipping the "
+                           "predictive pass: %s", exc)
+            return
+        now = self.clock.now()
+        budget = scaled_value_from_int_or_percent(
+            spec.max_at_risk, snapshot.total_nodes(), round_up=True)
+        # Every node carrying the at-risk stamp counts — in-flight AND
+        # parked — so a signal storm drains at most the budget's worth
+        # of capacity until repaired nodes are re-armed.
+        at_risk = sum(
+            1 for bucket in snapshot.node_states.values() for ns in bucket
+            if self.keys.at_risk_annotation
+            in ns.node.metadata.annotations)
+        # AT_RISK nodes stay under observation too: their counters
+        # must keep feeding the model or cleared() could never fire
+        # and the stand-down path would be unreachable — but only
+        # HEALTHY nodes are eligible for a NEW verdict.
+        observed = list(snapshot.bucket(RemediationState.HEALTHY)) \
+            + list(snapshot.bucket(RemediationState.AT_RISK))
+        for ns in observed:
+            node = ns.node
+            with self._defer_node_on_transient(node,
+                                               "precursor observation"):
+                counters = counters_by_node.get(node.metadata.name)
+                if counters is None:
+                    continue
+                updates = self.precursor.observe(
+                    node.metadata.name, counters, now=now,
+                    annotations=node.metadata.annotations)
+                if updates:
+                    # durable per-node model seed: a fresh incarnation
+                    # resumes the model from cluster state alone
+                    self.provider.change_node_upgrade_annotations(
+                        node, updates)
+                if self.keys.at_risk_annotation \
+                        in node.metadata.annotations:
+                    continue
+                if self._skip_remediation(node) \
+                        or self._upgrade_in_progress(node):
+                    continue
+                verdict = self.precursor.verdict(node.metadata.name)
+                if verdict is None:
+                    continue
+                if not node.metadata.labels.get(GKE_NODEPOOL_LABEL):
+                    # no slice to route around it; the reactive ladder
+                    # will handle the death when (if) it comes
+                    continue
+                if at_risk >= budget:
+                    self.at_risk_budget_deferrals_total += 1
+                    logger.info(
+                        "deferring at-risk condemnation of node %s: "
+                        "%d/%d at-risk budget already committed",
+                        node.metadata.name, at_risk, budget)
+                    continue
+                if self.provider.change_node_upgrade_state(
+                        node, RemediationState.AT_RISK, annotations={
+                            self.keys.at_risk_annotation: str(int(now)),
+                            self.keys.at_risk_reason_annotation:
+                                verdict.reason,
+                        }):
+                    at_risk += 1
+                    self.at_risk_condemned_total += 1
+                    logger.warning("node %s condemned AT RISK: %s",
+                                   node.metadata.name, verdict.detail)
+                    log_event(self.recorder, node, Event.WARNING,
+                              "NodeAtRisk",
+                              f"Precursor model condemned the node at "
+                              f"risk ({verdict.detail}); remapping its "
+                              f"slice to a spare while it still serves")
+
+    def process_at_risk_nodes(self, snapshot: RemediationSnapshot,
+                              policy: RemediationPolicySpec,
+                              detector: WedgeDetector) -> None:
+        """Drive condemned-at-risk nodes through the reconfigure arc
+        WHILE THEY STILL SERVE: reserve a spare, wait for it to
+        provision, join it in the node's place — and only then cordon,
+        drain (planned, through the serving-aware eviction gate) and
+        park the node ``remediation-failed`` with the condemned stamp.
+        A node whose risk subsides before the join stands down to
+        healthy with zero residue; a node whose hardware beats the
+        planned drain falls to the reactive wedge ladder, which resumes
+        the remap from the durable reservation."""
+        from tpu_operator_libs.topology.reconfigurer import RELEASED
+
+        now = self.clock.now()
+        reconfig = policy.reconfiguration
+        spec = policy.precursor
+        reconfig_active = (reconfig is not None and reconfig.enable
+                          and self.reconfigurer is not None)
+        precursor_active = spec is not None and spec.enable
+        for ns in snapshot.bucket(RemediationState.AT_RISK):
+            node = ns.node
+            with self._defer_node_on_transient(node,
+                                               "at-risk condemnation"):
+                signal = detector(node, ns.runtime_pod, now)
+                if signal is not None:
+                    # The hardware beat the planned drain. No grace
+                    # window — the precursor already distrusts this
+                    # node. The reservation (if stamped) is durable, so
+                    # the reactive condemnation arc resumes the remap.
+                    self.provider.change_node_upgrade_annotations(node, {
+                        self.keys.wedge_since_annotation: str(int(now)),
+                        self.keys.wedge_reason_annotation: signal.reason,
+                    })
+                    if self.provider.change_node_upgrade_state(
+                            node, RemediationState.WEDGED):
+                        self.wedged_detected_total += 1
+                        logger.warning(
+                            "at-risk node %s hard-failed before its "
+                            "planned drain (%s); reactive ladder takes "
+                            "over", node.metadata.name, signal.detail)
+                        log_event(self.recorder, node, Event.WARNING,
+                                  self.keys.event_reason,
+                                  f"At-risk node wedged before its "
+                                  f"planned drain ({signal.reason})")
+                    continue
+                if not reconfig_active or not precursor_active:
+                    # policy flipped off mid-arc: the node was healthy
+                    # all along — stand down with zero residue
+                    self._abort_at_risk(node, "predictive condemnation "
+                                              "disabled")
+                    continue
+                if self.precursor is not None \
+                        and self.precursor.cleared(node.metadata.name) \
+                        and not self.reconfigurer.remap_committed(node):
+                    self._abort_at_risk(node, "precursor risk subsided")
+                    continue
+                # Drive the remap while the node serves. Degraded
+                # admission is never allowed from here: the node is
+                # ALIVE — cutting the slice to a short shape would
+                # trade real capacity for a prediction. No spare means
+                # the node simply keeps serving at risk.
+                if self.reconfigurer.advance(
+                        ns, replace(reconfig, allow_degraded=False)) \
+                        != RELEASED:
+                    continue
+                # Slice released (spare joined in its place): now the
+                # node leaves service as a PLANNED disruption — cordon,
+                # park the upgrade flow, gated drain, condemned stamp.
+                # Every step is idempotent; a crash anywhere resumes
+                # here because advance() short-circuits to RELEASED
+                # once the node has no pool.
+                self.cordon_manager.cordon(node)
+                self._park_upgrade_flow(node, parked=True)
+                if not self._planned_drain_done(node, policy):
+                    continue  # gate parked or drain failed; retry next pass
+                if self.provider.change_node_upgrade_state(
+                        node, RemediationState.FAILED, annotations={
+                            self.keys.condemned_annotation:
+                                str(int(now)),
+                        }):
+                    self.at_risk_parked_total += 1
+                    self.remediations_failed_total += 1
+                    reason = node.metadata.annotations.get(
+                        self.keys.at_risk_reason_annotation, "unknown")
+                    logger.warning(
+                        "node %s drained and parked condemned-at-risk "
+                        "(%s); slice already remapped",
+                        node.metadata.name, reason)
+                    log_event(self.recorder, node, Event.WARNING,
+                              "NodeCondemned",
+                              f"At-risk node drained (planned) and "
+                              f"parked for repair ({reason}); slice "
+                              f"already routed to a spare")
+
+    def _abort_at_risk(self, node: Node, why: str) -> None:
+        """Stand the at-risk arc down: drop the spare booking and
+        return the node to healthy. The stamp removals ride the state
+        commit in ONE merge patch — a crash can never leave a
+        healthy-labeled node holding at-risk residue."""
+        if self.reconfigurer is not None:
+            self.reconfigurer.abort(node)
+        if self.provider.change_node_upgrade_state(
+                node, RemediationState.HEALTHY, annotations={
+                    self.keys.at_risk_annotation: None,
+                    self.keys.at_risk_reason_annotation: None,
+                }):
+            self.at_risk_aborted_total += 1
+            logger.info("node %s at-risk arc stood down: %s",
+                        node.metadata.name, why)
+            log_event(self.recorder, node, Event.NORMAL,
+                      self.keys.event_reason,
+                      f"At-risk condemnation stood down ({why})")
+
+    def _planned_drain_done(self, node: Node,
+                            policy: RemediationPolicySpec) -> bool:
+        """Planned (serving-aware) drain of an at-risk node. Unlike the
+        reactive drain rung this one consults the eviction gate with
+        park-don't-escalate semantics: the node's endpoints stop
+        admitting, in-flight work finishes, and only then are the pods
+        evicted — the zero-drop property the soak invariant checks."""
+        spec = policy.drain
+        if spec is not None and spec.enable:
+            helper = DrainHelper(
+                client=self.client, force=spec.force,
+                delete_empty_dir_data=spec.delete_empty_dir,
+                timeout_seconds=spec.timeout_seconds,
+                pod_selector=spec.pod_selector,
+                clock=self.clock, poll_interval=self._poll_interval)
+        else:
+            # eviction is the point of the at-risk park, so the planned
+            # drain runs even when the reactive drain stage is disabled
+            helper = DrainHelper(client=self.client, force=True,
+                                 clock=self.clock,
+                                 poll_interval=self._poll_interval)
+        name = node.metadata.name
+        if self._at_risk_gatekeeper.gate is not None:
+            try:
+                pods, _ = helper.get_pods_for_deletion(name)
+            except (ApiServerError, ConflictError, NotFoundError) as exc:
+                logger.warning("could not enumerate pods for the "
+                               "at-risk gate on node %s; deferring: %s",
+                               name, exc)
+                return False
+            if not self._at_risk_gatekeeper.allows(node, pods):
+                return False
+        try:
+            helper.run_node_drain(name)
+        except DrainError as exc:
+            logger.warning("planned drain of at-risk node %s failed "
+                           "(will retry): %s", name, exc)
+            return False
+        return True
+
     def process_wedged_nodes(self, snapshot: RemediationSnapshot,
                              policy: RemediationPolicySpec,
                              detector: WedgeDetector) -> None:
@@ -452,6 +741,13 @@ class NodeRemediationManager:
                 if attempts == 0 \
                         and detector(node, ns.runtime_pod, now) is None:
                     # self-healed before any recovery action ran
+                    if self.reconfigurer is not None \
+                            and self.keys.at_risk_annotation \
+                            in node.metadata.annotations:
+                        # an at-risk arc funneled here, then the node
+                        # self-healed: drop the spare booking before
+                        # the bookkeeping (and its stamps) go
+                        self.reconfigurer.abort(node)
                     self._clear_bookkeeping(node)
                     self.provider.change_node_upgrade_state(
                         node, RemediationState.HEALTHY)
@@ -759,6 +1055,13 @@ class NodeRemediationManager:
             with self._defer_node_on_transient(node, "failed-node triage"):
                 rearmed = node.metadata.annotations.get(
                     self.keys.rearm_annotation) == TRUE_STRING
+                if not rearmed and self.keys.at_risk_annotation \
+                        in node.metadata.annotations:
+                    # Parked condemned-at-risk: the hardware is
+                    # PREDICTED to fail, so a currently-clear wedge
+                    # signal is not evidence of health — only a manual
+                    # re-arm (post-repair) returns the node to service.
+                    continue
                 if rearmed:
                     self.provider.change_node_upgrade_annotation(
                         node, self.keys.rearm_annotation, None)
@@ -963,6 +1266,8 @@ class NodeRemediationManager:
                     self.keys.reboot_requested_annotation,
                     self.keys.initial_state_annotation,
                     self.keys.condemned_annotation,
+                    self.keys.at_risk_annotation,
+                    self.keys.at_risk_reason_annotation,
                     self.keys.rearm_annotation):
             if key in node.metadata.annotations:
                 self.provider.change_node_upgrade_annotation(
@@ -1023,6 +1328,12 @@ class NodeRemediationManager:
             in ns.node.metadata.annotations)
         if condemned:
             status["condemnedNodes"] = condemned
+        at_risk = sum(
+            1 for bucket in snapshot.node_states.values() for ns in bucket
+            if self.keys.at_risk_annotation
+            in ns.node.metadata.annotations)
+        if at_risk:
+            status["atRiskNodes"] = at_risk
         if self.reconfigurer is not None:
             status["reconfiguration"] = self.reconfigurer.status()
         return status

@@ -292,6 +292,17 @@ class SliceReconfigurer:
         spare.metadata.annotations.pop(
             self.keys.reserved_for_annotation, None)
 
+    def remap_committed(self, node: Node) -> bool:
+        """True once the remap passed its point of no return for this
+        node: a spare has already joined in its place (or the node has
+        already left its pool). The at-risk arc may only stand down
+        BEFORE this point — afterwards the slice has a new member and
+        aborting would strand two nodes claiming one seat."""
+        pool = node.metadata.labels.get(GKE_NODEPOOL_LABEL, "")
+        if not pool:
+            return True
+        return self._find_join(pool, node.metadata.name) is not None
+
     # ------------------------------------------------------------------
     # post-bucket reconcile: settle expiry + degraded healing
     # ------------------------------------------------------------------
@@ -515,8 +526,16 @@ class SliceReconfigurer:
     def _finish_remap(self, node: Node, pool: str, spare_name: str) -> None:
         self._release(node, pool)
         self.reconfigurations_total += 1
+        # MTTR anchor: the reactive arc measures from the condemned
+        # stamp; the predictive (condemn-before-fail) arc has no
+        # condemned stamp yet at release time — it measures from the
+        # at-risk verdict, which is when the operator committed to the
+        # remap.
         condemned_raw = node.metadata.annotations.get(
             self.remediation_keys.condemned_annotation)
+        if condemned_raw is None:
+            condemned_raw = node.metadata.annotations.get(
+                self.remediation_keys.at_risk_annotation)
         if condemned_raw is not None:
             try:
                 self._remap_seconds.append(

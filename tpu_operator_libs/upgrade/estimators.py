@@ -1,0 +1,68 @@
+"""Shared online estimators: EWMA updates + pooled bucketed histograms.
+
+Extracted from the PR 9 duration predictor so every online model in the
+operator — :class:`~tpu_operator_libs.upgrade.predictor.
+PhaseDurationPredictor` (per-node phase durations) and
+:class:`~tpu_operator_libs.health.precursor.FailurePrecursorModel`
+(per-node hardware-counter rates) — runs the SAME arithmetic instead of
+a copy-paste second implementation. Both models share the shape the
+cost-aware-duration paper (PAPERS.md) argues for: a per-entity EWMA as
+the warm path, with a fleet-pooled bucketed histogram as the cold-start
+fallback (bounded memory at 100k nodes — no sample lists; quantiles via
+the shared ``metrics.quantile_from_buckets`` estimator).
+"""
+
+from __future__ import annotations
+
+from typing import Iterable, Optional
+
+from tpu_operator_libs.metrics import quantile_from_buckets
+
+
+def ewma_update(previous: Optional[float], sample: float,
+                smoothing: float) -> float:
+    """One exponentially-weighted-moving-average step.
+
+    ``a * sample + (1 - a) * previous``; seeds to the raw sample when no
+    previous value exists (the first observation IS the model).
+    """
+    if previous is None:
+        return sample
+    return smoothing * sample + (1.0 - smoothing) * previous
+
+
+class PooledHistogram:
+    """Bucketed sample histogram with bounded memory.
+
+    Cumulative ``le`` bucket counts (Prometheus-histogram shape) plus a
+    running count/total, so the pool costs O(buckets) regardless of
+    fleet size. Quantiles interpolate within the winning bucket via
+    ``metrics.quantile_from_buckets``. NOT thread-safe by itself — the
+    owning model serializes mutations under its own coarse lock, exactly
+    where the rest of its state is guarded.
+    """
+
+    __slots__ = ("buckets", "counts", "count", "total")
+
+    def __init__(self, buckets: Iterable[float]) -> None:
+        self.buckets = tuple(buckets)
+        if not self.buckets:
+            raise ValueError("PooledHistogram needs at least one bucket")
+        self.counts = [0] * len(self.buckets)
+        self.count = 0
+        self.total = 0.0
+
+    def record(self, value: float) -> None:
+        for i, le in enumerate(self.buckets):
+            if value <= le:
+                self.counts[i] += 1
+        self.count += 1
+        self.total += value
+
+    def quantile(self, q: float) -> Optional[float]:
+        return quantile_from_buckets(self.buckets, self.counts,
+                                     self.count, q)
+
+    @property
+    def mean(self) -> Optional[float]:
+        return self.total / self.count if self.count else None

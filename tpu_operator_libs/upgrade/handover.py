@@ -83,6 +83,10 @@ AuditHook = Callable[[str, str, str, str, dict], None]
 #: Hold rules the ranker emits (also the explain-chain vocabulary).
 HOLD_SOLE_REPLICA = "sole-replica-interactive"
 HOLD_AWAITING_PREWARM = "awaiting-prewarm"
+#: Rank rule: the node carries the remediation machine's at-risk stamp
+#: (condemn-before-fail), so its drain is already planned — it ranks as
+#: the cheapest possible disruption candidate.
+RANK_AT_RISK = "at-risk-precursor"
 
 
 class _Reservation:
@@ -318,12 +322,19 @@ class DisruptionCostRanker:
                  source: "Callable[[], dict]",
                  classes: "dict[str, TrafficClassSpec]",
                  prewarm: Optional[PrewarmCoordinator] = None,
-                 audit: Optional[AuditHook] = None) -> None:
+                 audit: Optional[AuditHook] = None,
+                 at_risk_annotation: Optional[str] = None) -> None:
         self.inner = inner
         self._source = source
         self.classes = classes
         self.prewarm = prewarm
         self.audit = audit
+        # Annotation key (RemediationKeys.at_risk_annotation) marking
+        # nodes the precursor model condemned at risk: their drain is
+        # already planned by the remediation arc, so when a rollout
+        # must disrupt someone anyway, they are the cheapest candidates.
+        self.at_risk_annotation = at_risk_annotation
+        self._last_at_risk: set[str] = set()
         #: node -> (rule, inputs) of the most recent pass's holds —
         #: consumed by the audit wrapper and the explain chain.
         self.last_holds: "dict[str, tuple[str, dict]]" = {}
@@ -391,6 +402,7 @@ class DisruptionCostRanker:
         # first sweep: cost tiers from class/in-flight alone
         tiers: "list[list[NodeUpgradeState]]" = [[], [], [], []]
         load: dict[str, int] = {}
+        at_risk_ranked: set[str] = set()
         for ns in candidates:
             name = ns.node.metadata.name
             endpoints = mapping.get(name) or ()
@@ -409,6 +421,14 @@ class DisruptionCostRanker:
                         and model_admitting.get(ep.model, 0) - 1 \
                         < spec.min_replicas:
                     tier = self.TIER_SOLE_BATCH
+            if self.at_risk_annotation is not None \
+                    and self.at_risk_annotation \
+                    in ns.node.metadata.annotations:
+                # condemned-at-risk (predicted failure): leaving anyway,
+                # so it outranks every serving tier — spend the budget
+                # on the node the fleet is about to lose regardless
+                tier = self.TIER_IDLE
+                at_risk_ranked.add(name)
             load[name] = in_flight
             tiers[tier].append(ns)
         # within a tier, fewer live generations drain cheaper; the
@@ -457,11 +477,22 @@ class DisruptionCostRanker:
                     "disruption ranker holding node %s: %s (%s)",
                     name, hold[0], hold[1])
         self.last_holds = holds
+        for name in sorted(at_risk_ranked - self._last_at_risk):
+            # audit on first sight only (the hold path's change-dedup):
+            # a pass-stable at-risk ranking is one fact, not one per pass
+            if self.audit is not None:
+                self.audit("rank", name, "tier-idle", RANK_AT_RISK,
+                           {"annotation": self.at_risk_annotation})
+            logger.info("disruption ranker promoting at-risk node %s "
+                        "to the cheapest tier", name)
+        self._last_at_risk = at_risk_ranked
         self.last_rank = {
             "tiers": [len(bucket) for bucket in tiers],
             "held": len(holds),
             "selected": len(selected),
         }
+        if at_risk_ranked:
+            self.last_rank["atRisk"] = len(at_risk_ranked)
         return selected
 
     def _floor_hold(self, name: str, endpoints: "tuple | list",

@@ -57,7 +57,10 @@ from tpu_operator_libs.consts import (
     UpgradeKeys,
     UpgradeState,
 )
-from tpu_operator_libs.metrics import quantile_from_buckets
+from tpu_operator_libs.upgrade.estimators import (
+    PooledHistogram,
+    ewma_update,
+)
 from tpu_operator_libs.util import Clock
 
 if TYPE_CHECKING:  # pragma: no cover - import cycle guard
@@ -101,28 +104,6 @@ PHASE_SECONDS_BUCKETS: tuple[float, ...] = (
     300.0, 600.0, 1200.0, 1800.0, 3600.0, 7200.0)
 
 
-class _PooledPhase:
-    """Bucketed duration histogram for one phase (bounded memory)."""
-
-    __slots__ = ("counts", "count", "total")
-
-    def __init__(self) -> None:
-        self.counts = [0] * len(PHASE_SECONDS_BUCKETS)
-        self.count = 0
-        self.total = 0.0
-
-    def record(self, seconds: float) -> None:
-        for i, le in enumerate(PHASE_SECONDS_BUCKETS):
-            if seconds <= le:
-                self.counts[i] += 1
-        self.count += 1
-        self.total += seconds
-
-    def quantile(self, q: float) -> Optional[float]:
-        return quantile_from_buckets(PHASE_SECONDS_BUCKETS, self.counts,
-                                     self.count, q)
-
-
 class PhaseDurationPredictor:
     """Online per-node / per-phase upgrade-duration model.
 
@@ -162,9 +143,11 @@ class PhaseDurationPredictor:
         self._lock = threading.Lock()
         # per-(node, phase) EWMA seconds
         self._ewma: dict[str, dict[str, float]] = {}
-        # fleet-pooled per-phase histograms (cold-start fallback)
-        self._pooled: dict[str, _PooledPhase] = {
-            phase: _PooledPhase() for phase in PHASES}
+        # fleet-pooled per-phase histograms (cold-start fallback);
+        # shared estimator — same arithmetic as the precursor model
+        self._pooled: dict[str, PooledHistogram] = {
+            phase: PooledHistogram(PHASE_SECONDS_BUCKETS)
+            for phase in PHASES}
         #: whole-node forecasts opened at flow entry:
         #: node -> (t_entry, predicted_total_seconds)
         self._inflight: dict[str, tuple[float, float]] = {}
@@ -236,12 +219,8 @@ class PhaseDurationPredictor:
                        seconds: float) -> None:
         with self._lock:
             per_node = self._ewma.setdefault(name, {})
-            previous = per_node.get(phase)
-            if previous is None:
-                per_node[phase] = seconds
-            else:
-                a = self.smoothing
-                per_node[phase] = a * seconds + (1.0 - a) * previous
+            per_node[phase] = ewma_update(per_node.get(phase), seconds,
+                                          self.smoothing)
             self._pooled[phase].record(seconds)
             self._sample_buffer.append((phase, seconds))
             self.samples_total += 1

@@ -1908,11 +1908,19 @@ class ClusterUpgradeStateManager:
                 self._cost_ranker.last_holds = {}
                 self._cost_ranker.last_rank = None
             return inner
+        from tpu_operator_libs.consts import RemediationKeys
         from tpu_operator_libs.upgrade.handover import (
             DisruptionCostRanker,
             PrewarmCoordinator,
         )
 
+        # the precursor's at-risk stamp (remediation namespace, same
+        # driver/domain as this manager's keys): a condemned-at-risk
+        # candidate is already being routed around, so disrupting it
+        # first is free — the ranker pins it to the idle tier
+        at_risk_key = RemediationKeys(
+            driver=self.keys.driver,
+            domain=self.keys.domain).at_risk_annotation
         if spec.prewarm and self._prewarm is None:
             self._prewarm = PrewarmCoordinator(
                 self.provider, self.keys, clock=self.clock,
@@ -1923,12 +1931,14 @@ class ClusterUpgradeStateManager:
             self._cost_ranker = DisruptionCostRanker(
                 inner, self._capacity_source, spec.class_map(),
                 prewarm=self._prewarm if spec.prewarm else None,
-                audit=self._ranker_audit_hook)
+                audit=self._ranker_audit_hook,
+                at_risk_annotation=at_risk_key)
         ranker = self._cost_ranker
         ranker.inner = inner
         ranker._source = self._capacity_source
         ranker.classes = spec.class_map()
         ranker.prewarm = self._prewarm if spec.prewarm else None
+        ranker.at_risk_annotation = at_risk_key
         return ranker
 
     def _ranker_audit_hook(self, kind: str, node: str, decision: str,
@@ -3357,6 +3367,19 @@ class ClusterUpgradeStateManager:
             annotations = node.metadata.annotations
             done = str(UpgradeState.DONE)
             required = str(UpgradeState.UPGRADE_REQUIRED)
+            tk = self.topology_keys
+            at_risk_at = annotations.get(
+                f"{tk.domain}/{tk.driver}-remediation.at-risk-at")
+            if at_risk_at:
+                reason = annotations.get(
+                    f"{tk.domain}/{tk.driver}-remediation.at-risk-reason",
+                    "unknown signal")
+                chain.append(
+                    f"condemned at-risk at {at_risk_at} by the "
+                    f"failure-precursor model ({reason}): slice "
+                    f"remapping to a spare while the node still "
+                    f"serves; it leaves service as a planned, gated "
+                    f"drain once released")
             if node.metadata.labels.get(self.keys.skip_label) \
                     == TRUE_STRING:
                 chain.append(f"skip label {self.keys.skip_label} set: "
