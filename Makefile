@@ -4,7 +4,7 @@ PYTHON ?= python3
 PYTEST_FLAGS ?= -q
 COV_THRESHOLD ?= 85
 
-.PHONY: all check test test-fast test-fault test-chaos test-soak test-scale test-rollout test-latency test-reconfig test-shard test-planner test-budget test-handover test-obs test-federation test-policy test-dag test-precursor lint cov bench bench-reconcile bench-latency bench-shard bench-shard-100k bench-planner bench-budget bench-obs bench-federation bench-precursor graft-check package clean diagram
+.PHONY: all check test test-fast test-fault test-chaos test-soak test-scale test-rollout test-latency test-reconfig test-shard test-planner test-budget test-handover test-obs test-federation test-policy test-dag test-precursor test-preflight lint cov bench bench-reconcile bench-latency bench-shard bench-shard-100k bench-planner bench-budget bench-obs bench-federation bench-precursor bench-preflight graft-check package clean diagram
 
 all: lint test
 
@@ -264,6 +264,29 @@ test-precursor:
 # BENCH_precursor.json.
 bench-precursor:
 	$(PYTHON) tools/precursor_bench.py --out BENCH_precursor.json
+
+# Rollout-preflight slice (`preflight` marker): the frozen-clone
+# write tripwire (every FakeCluster mutating path rejects when
+# frozen), forecast units (LPT makespan + error-histogram confidence
+# bounds, SLO replay, policy-hook holds, window deferrals), the
+# required-mode admission gate (audited park, non-empty explain,
+# zero admissions), crash-mid-forecast resume, status/HTTP/federation
+# surfacing, and the seeded read-only chaos gate (run_preflight_soak:
+# the budget fleet's compound-fault storm with the forecaster live on
+# every pass; preflight-readonly + storm-grade calibration + the
+# post-convergence required-mode hold probe). Seeds 1-3 tier-1, 4-10
+# slow (the standing convention).
+test-preflight:
+	$(PYTHON) -m pytest tests/ $(PYTEST_FLAGS) -m "preflight and not slow"
+
+# Forecast-vs-realized calibration proof: learn a rollout, preflight
+# the next, then realize it fault-free on the standing 256- and
+# 1024-node bench fleets — acceptance is forecast makespan within 15%
+# of realized with the confidence interval covering the realized
+# value (tools/preflight_bench.py; docs/preflight.md). Writes
+# BENCH_preflight.json.
+bench-preflight:
+	$(PYTHON) tools/preflight_bench.py --nodes 256,1024 --out BENCH_preflight.json
 
 graft-check:
 	$(PYTHON) __graft_entry__.py

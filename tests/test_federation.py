@@ -315,6 +315,77 @@ class TestRegionCapacityStatus:
 
 
 # ---------------------------------------------------------------------------
+# region-admission preflight (ISSUE 17: no roll, no share stamp)
+# ---------------------------------------------------------------------------
+class TestFederationPreflightGate:
+    """A required-mode forecast breach defers the region BEFORE the
+    roll and before its durable budget share is stamped."""
+
+    def _spec(self, mode):
+        from tpu_operator_libs.api.upgrade_policy import PreflightSpec
+
+        # 2-node regions roll in 2 share-wide waves at the 120s/node
+        # prior: a 240s horizon always breaches a 1s makespan bound,
+        # so the verdict is deterministic without a traffic signal
+        return PreflightSpec(mode=mode,
+                             max_forecast_makespan_seconds=1.0)
+
+    def test_required_breach_admits_nothing_and_stamps_no_share(self):
+        sim = FederationFleetSim(_small_config())
+        sim.fed.policy.preflight = self._spec("required")
+        sim.fed.policy.validate()
+        _drive(sim, FED_FINAL_REVISION, 10)
+        assert sim.fed.admissions_total == 0
+        assert sim.fed.share_stamps_total == 0
+        assert sim.fed.preflight_rejections_total >= 1
+        status = sim.fed.last_status
+        for cell in status["regions"].values():
+            assert cell["revision"] != FED_FINAL_REVISION
+            forecast = cell["preflight"]
+            assert forecast["verdict"] == "reject"
+            assert "makespan" in forecast["breaches"]
+        explained = sim.fed.explain_region(sim.canary)
+        assert any("preflight rejected the region admission" in reason
+                   for reason in explained["blocking"])
+        records = sim.fed.audit.records_for(sim.canary)
+        assert any(rec.rule == "preflight-rejected"
+                   for rec in records)
+
+    def test_advisory_breach_surfaces_but_admits(self):
+        sim = FederationFleetSim(_small_config())
+        sim.fed.policy.preflight = self._spec("advisory")
+        target = FED_FINAL_REVISION
+        assert _drive_until(
+            sim, target,
+            lambda: all(sim.region_converged(n, target)
+                        for n in sim.regions)
+            and sim.shares_all_zero())
+        assert sim.fed.admissions_total == len(sim.regions)
+        assert sim.fed.preflight_rejections_total == 0
+        status = sim.fed.last_status
+        for cell in status["regions"].values():
+            assert cell["preflight"]["verdict"] == "advisory-breach"
+
+    def test_park_clears_when_the_policy_relaxes(self):
+        sim = FederationFleetSim(_small_config())
+        sim.fed.policy.preflight = self._spec("required")
+        target = FED_FINAL_REVISION
+        _drive(sim, target, 5)
+        assert sim.fed.admissions_total == 0
+        # the operator relaxes the bounds (the sim's diurnal signal
+        # keeps the slo-risk breach standing otherwise): the reject
+        # clears on the next pass without any other intervention
+        sim.fed.policy.preflight.max_forecast_makespan_seconds = 0.0
+        sim.fed.policy.preflight.max_forecast_slo_risk_fraction = 1.0
+        assert _drive_until(
+            sim, target,
+            lambda: all(sim.region_converged(n, target)
+                        for n in sim.regions)
+            and sim.shares_all_zero())
+        assert sim.fed.admissions_total == len(sim.regions)
+
+
+# ---------------------------------------------------------------------------
 # the schedules
 # ---------------------------------------------------------------------------
 class TestFederationSchedule:

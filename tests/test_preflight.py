@@ -1,0 +1,591 @@
+"""Rollout preflight: what-if forecasting that gates admission.
+
+The frozen-clone write tripwire (every FakeCluster mutating entry
+point), the predictor's error-histogram confidence bounds, the
+PreflightForecaster against the real state machine (advisory surfacing,
+required-mode park with audited ``preflight-rejected`` + non-empty
+explain chain, re-evaluation clearing the park, the single-entry
+cache), crash-mid-forecast zero residue + identical re-derivation, the
+read-only evidence channels and the ``preflight-readonly`` invariant,
+the status/HTTP/metrics surfaces, and the seeded preflight chaos gate
+(seeds 1-3 tier-1, 4-10 slow). ``make test-preflight``.
+"""
+
+import json
+import urllib.request
+
+import pytest
+
+pytestmark = [pytest.mark.preflight]
+
+from tpu_operator_libs.api.upgrade_policy import (
+    CapacityBudgetSpec,
+    DrainSpec,
+    PolicyValidationError,
+    PredictorSpec,
+    PreflightSpec,
+    UpgradePolicySpec,
+)
+from tpu_operator_libs.chaos.injector import OperatorCrash
+from tpu_operator_libs.chaos.invariants import InvariantMonitor
+from tpu_operator_libs.consts import IN_PROGRESS_STATES, UpgradeState
+from tpu_operator_libs.k8s.fake import FakeCluster, FrozenClusterError
+from tpu_operator_libs.k8s.objects import Node, ObjectMeta
+from tpu_operator_libs.metrics import MetricsRegistry, observe_preflight
+from tpu_operator_libs.obs import OperatorObservability
+from tpu_operator_libs.simulate import (
+    NS,
+    RUNTIME_LABELS,
+    FleetSpec,
+    build_fleet,
+)
+from tpu_operator_libs.upgrade.predictor import (
+    COLD_START_ERROR_RATIO,
+    PhaseDurationPredictor,
+)
+from tpu_operator_libs.upgrade.preflight import (
+    MUTATING_OPS,
+    PreflightForecaster,
+)
+from tpu_operator_libs.upgrade.state_manager import (
+    ClusterUpgradeStateManager,
+)
+
+IN_FLIGHT = frozenset(str(s) for s in IN_PROGRESS_STATES)
+
+
+def small_fleet(n_slices=2, hosts=4):
+    fleet = FleetSpec(n_slices=n_slices, hosts_per_slice=hosts,
+                      pod_recreate_delay=2.0, pod_ready_delay=5.0)
+    cluster, clock, keys = build_fleet(fleet)
+    mgr = ClusterUpgradeStateManager(
+        cluster, keys, clock=clock, async_workers=False,
+        poll_interval=0.0)
+    return cluster, clock, keys, mgr
+
+
+def base_policy(**preflight_kwargs):
+    return UpgradePolicySpec(
+        auto_upgrade=True, max_parallel_upgrades=0,
+        max_unavailable="25%",
+        drain=DrainSpec(enable=True, force=True, timeout_seconds=300),
+        predictor=PredictorSpec(enable=True),
+        preflight=PreflightSpec(**preflight_kwargs))
+
+
+def node_states(cluster, keys):
+    return {n.metadata.name: n.metadata.labels.get(keys.state_label, "")
+            for n in cluster.list_nodes()}
+
+
+# ---------------------------------------------------------------------------
+# the policy / CRD surface
+# ---------------------------------------------------------------------------
+class TestPreflightSpec:
+    def test_defaults_off_and_enabled_property(self):
+        spec = PreflightSpec()
+        spec.validate()
+        assert not spec.enabled
+        assert PreflightSpec(mode="advisory").enabled
+        assert PreflightSpec(mode="required").enabled
+
+    def test_round_trip(self):
+        spec = PreflightSpec(mode="required",
+                             max_forecast_slo_risk_fraction=0.1,
+                             max_forecast_makespan_seconds=3600.0,
+                             confidence=0.95)
+        assert PreflightSpec.from_dict(spec.to_dict()) == spec
+        policy = UpgradePolicySpec(preflight=spec)
+        again = UpgradePolicySpec.from_dict(policy.to_dict())
+        assert again.preflight == spec
+
+    def test_validation_errors(self):
+        for bad in (dict(mode="sometimes"),
+                    dict(mode="required",
+                         max_forecast_slo_risk_fraction=1.5),
+                    dict(mode="required",
+                         max_forecast_slo_risk_fraction=-0.1),
+                    dict(mode="required",
+                         max_forecast_makespan_seconds=-1.0),
+                    dict(mode="required", confidence=0.0),
+                    dict(mode="required", confidence=1.0)):
+            with pytest.raises(PolicyValidationError):
+                PreflightSpec(**bad).validate()
+
+    def test_crd_schema_accepts_preflight(self):
+        from tpu_operator_libs.api.crd import (
+            upgrade_policy_schema,
+            validate_against_schema,
+        )
+
+        policy = UpgradePolicySpec(
+            auto_upgrade=True,
+            preflight=PreflightSpec(mode="advisory"))
+        validate_against_schema(policy.to_dict(),
+                                upgrade_policy_schema(), "spec")
+
+    def test_crd_schema_rejects_bad_mode(self):
+        from tpu_operator_libs.api.crd import (
+            upgrade_policy_schema,
+            validate_against_schema,
+        )
+
+        data = UpgradePolicySpec(
+            preflight=PreflightSpec(mode="advisory")).to_dict()
+        data["preflight"]["mode"] = "sometimes"
+        with pytest.raises(PolicyValidationError):
+            validate_against_schema(data, upgrade_policy_schema(),
+                                    "spec")
+
+
+# ---------------------------------------------------------------------------
+# the frozen-clone write tripwire (satellite: FakeCluster.freeze)
+# ---------------------------------------------------------------------------
+class TestFrozenCluster:
+    def build(self):
+        cluster, clock, keys, _ = small_fleet(n_slices=1, hosts=2)
+        return cluster, clock, keys
+
+    def test_every_mutating_entry_point_trips(self):
+        cluster, _, _ = self.build()
+        name = cluster.list_nodes()[0].metadata.name
+        cluster.freeze(reason="preflight")
+        assert cluster.frozen
+        attempts = [
+            lambda: cluster.add_node(
+                Node(metadata=ObjectMeta(name="intruder"))),
+            lambda: cluster.delete_node(name),
+            lambda: cluster.patch_node_labels(name, {"a": "b"}),
+            lambda: cluster.patch_node_annotations(name, {"a": "b"}),
+            lambda: cluster.patch_node_meta(name, labels={"a": "b"}),
+            lambda: cluster.set_node_unschedulable(name, True),
+            lambda: cluster.set_node_ready(name, False),
+            lambda: cluster.delete_pod(NS, "p0"),
+            lambda: cluster.evict_pod(NS, "p0"),
+            lambda: cluster.set_pod_status(NS, "p0", ready=False),
+            lambda: cluster.create_event(NS, "e0", object()),
+            lambda: cluster.patch_event(NS, "e0", object()),
+            lambda: cluster.bump_daemon_set_revision(NS, "libtpu",
+                                                     "rev2"),
+            lambda: cluster.rollback_daemon_set(NS, "libtpu", "rev1"),
+            lambda: cluster.patch_daemon_set_annotations(
+                NS, "libtpu", {"a": "b"}),
+            lambda: cluster.set_daemon_set_desired(NS, "libtpu", 3),
+            lambda: cluster.schedule_at(1.0, lambda: None),
+        ]
+        for attempt in attempts:
+            with pytest.raises(FrozenClusterError):
+                attempt()
+        assert cluster.frozen_write_attempts == len(attempts)
+
+    def test_reads_still_answer_while_frozen(self):
+        cluster, _, _ = self.build()
+        name = cluster.list_nodes()[0].metadata.name
+        cluster.freeze()
+        assert cluster.get_node(name).metadata.name == name
+        assert len(cluster.list_nodes()) == 2
+        assert cluster.list_pods(namespace=NS)
+        assert cluster.list_daemon_sets(NS)
+        assert cluster.frozen_write_attempts == 0
+
+    def test_snapshot_is_frozen_and_isolated(self):
+        cluster, _, _ = self.build()
+        name = cluster.list_nodes()[0].metadata.name
+        clone = cluster.snapshot()
+        assert clone.frozen and not cluster.frozen
+        with pytest.raises(FrozenClusterError):
+            clone.patch_node_labels(name, {"a": "b"})
+        # a mutable snapshot never leaks writes back to the origin
+        mutable = cluster.snapshot(frozen=False)
+        mutable.patch_node_labels(name, {"leak": "no"})
+        assert "leak" not in cluster.get_node(name).metadata.labels
+
+    def test_there_is_no_thaw(self):
+        cluster, _, _ = self.build()
+        cluster.freeze(reason="preflight")
+        assert not hasattr(cluster, "thaw")
+        assert not hasattr(cluster, "unfreeze")
+
+    def test_mutating_ops_set_matches_fake_cluster(self):
+        # the live-side evidence set must keep naming REAL entry
+        # points, or the diff silently stops watching anything
+        for op in sorted(MUTATING_OPS):
+            assert callable(getattr(FakeCluster, op)), op
+
+    def test_revision_hash_must_be_dash_free(self):
+        cluster, _, _ = self.build()
+        with pytest.raises(ValueError):
+            cluster.bump_daemon_set_revision(NS, "libtpu", "has-dash")
+
+
+# ---------------------------------------------------------------------------
+# confidence bounds from the retained error histogram (satellite:
+# the recorded-but-never-consumed forecast-error pool)
+# ---------------------------------------------------------------------------
+class TestConfidenceBounds:
+    def test_cold_start_is_wide_not_confident(self):
+        predictor = PhaseDurationPredictor()
+        assert predictor.error_samples == 0
+        assert predictor.error_ratio(0.9) == COLD_START_ERROR_RATIO
+
+    def test_error_ratio_widens_with_observed_error(self):
+        small = PhaseDurationPredictor()
+        noisy = PhaseDurationPredictor()
+        for _ in range(50):
+            small._error_hist.record(0.02)
+            noisy._error_hist.record(0.8)
+        assert small.error_samples == noisy.error_samples == 50
+        assert small.error_ratio(0.9) < COLD_START_ERROR_RATIO
+        assert noisy.error_ratio(0.9) > small.error_ratio(0.9)
+
+    def test_forecast_bounds_follow_the_model_error(self):
+        cluster, clock, keys, mgr = small_fleet()
+        # required + unmeetable threshold: the park keeps the fleet
+        # picture still, so successive forecasts grade the SAME rollout
+        policy = base_policy(mode="required",
+                             max_forecast_makespan_seconds=1.0)
+        mgr.reconcile(NS, RUNTIME_LABELS, policy)
+        cold = mgr.last_preflight["makespan"]
+        assert cold["coldStart"]
+        assert cold["errorSamples"] == 0
+        expected = cold["expectedSeconds"]
+        assert expected > 0
+        assert cold["upperSeconds"] == pytest.approx(
+            expected * (1.0 + COLD_START_ERROR_RATIO), rel=1e-3)
+        # a trained, tight model narrows the same forecast
+        for _ in range(50):
+            mgr.predictor._error_hist.record(0.05)
+        clock.advance(61.0)   # roll the cache's minute bucket
+        mgr.reconcile(NS, RUNTIME_LABELS, policy)
+        warm = mgr.last_preflight["makespan"]
+        assert not warm["coldStart"]
+        assert warm["errorSamples"] == 50
+        spread_cold = cold["upperSeconds"] - cold["lowerSeconds"]
+        spread_warm = warm["upperSeconds"] - warm["lowerSeconds"]
+        assert spread_warm < spread_cold
+
+
+# ---------------------------------------------------------------------------
+# the gate against the real state machine
+# ---------------------------------------------------------------------------
+class TestPreflightGate:
+    def test_off_mode_builds_nothing(self):
+        cluster, clock, keys, mgr = small_fleet()
+        policy = base_policy(mode="off")
+        mgr.reconcile(NS, RUNTIME_LABELS, policy)
+        assert mgr.preflight is None
+        assert mgr.last_preflight is None
+        # off mode admits immediately; let the DS controller recreate the
+        # drained pods so the fleet snapshot is buildable again
+        clock.advance(30.0)
+        cluster.step()
+        state = mgr.build_state(NS, RUNTIME_LABELS)
+        assert "preflight" not in mgr.cluster_status(state)
+
+    def test_advisory_surfaces_and_admits(self):
+        cluster, clock, keys, mgr = small_fleet()
+        # an unmeetable threshold: advisory records the breach but the
+        # rollout must proceed anyway
+        policy = base_policy(mode="advisory",
+                             max_forecast_makespan_seconds=1.0)
+        mgr.reconcile(NS, RUNTIME_LABELS, policy)
+        for _ in range(3):
+            clock.advance(30.0)
+            cluster.step()
+            mgr.reconcile(NS, RUNTIME_LABELS, policy)
+        forecast = mgr.last_preflight
+        assert forecast["mode"] == "advisory"
+        assert forecast["verdict"] in ("advisory-breach", "admit")
+        assert mgr.preflight.advisory_total >= 1
+        assert mgr.preflight.rejected_total == 0
+        assert any(state in IN_FLIGHT
+                   for state in node_states(cluster, keys).values())
+        assert forecast["readonly"] == {"frozenWriteAttempts": 0,
+                                        "liveMutations": 0}
+
+    def test_required_breach_parks_with_audit_and_explain(self):
+        cluster, clock, keys, mgr = small_fleet()
+        obs = OperatorObservability(keys, clock=clock)
+        mgr.with_observability(obs)
+        policy = base_policy(mode="required",
+                             max_forecast_makespan_seconds=1.0)
+        mgr.reconcile(NS, RUNTIME_LABELS, policy)
+        for _ in range(4):
+            clock.advance(30.0)
+            mgr.reconcile(NS, RUNTIME_LABELS, policy)
+        forecast = mgr.last_preflight
+        assert forecast["verdict"] == "reject"
+        assert "makespan" in forecast["breaches"]
+        assert mgr.preflight.rejected_total >= 1
+        # zero admissions: every node is still parked in
+        # upgrade-required, nothing ever entered the in-flight states
+        states = node_states(cluster, keys)
+        assert all(state not in IN_FLIGHT for state in states.values())
+        pending = [name for name, state in states.items()
+                   if state == str(UpgradeState.UPGRADE_REQUIRED)]
+        assert pending
+        # the audited pass record carries the winning rule
+        budget_record = obs.audit.latest_fleet()["budget"]
+        assert budget_record.rule == "preflight-rejected"
+        assert budget_record.inputs["preflightVerdict"] == "reject"
+        # explain answers with a non-empty chain naming the gate
+        explained = mgr.explain(pending[0])
+        assert explained["blocking"]
+        assert any("preflight rejected" in reason
+                   for reason in explained["blocking"])
+        # the what-if picture rides cluster_status
+        state = mgr.build_state(NS, RUNTIME_LABELS)
+        status = mgr.cluster_status(state)
+        assert status["preflight"]["verdict"] == "reject"
+        # read-only evidence stayed clean through every rejection
+        assert mgr.preflight.frozen_write_attempts_total == 0
+        assert mgr.preflight.live_mutations_total == 0
+
+    def test_park_clears_when_the_forecast_clears(self):
+        cluster, clock, keys, mgr = small_fleet()
+        held = base_policy(mode="required",
+                           max_forecast_makespan_seconds=1.0)
+        mgr.reconcile(NS, RUNTIME_LABELS, held)
+        clock.advance(61.0)
+        mgr.reconcile(NS, RUNTIME_LABELS, held)
+        assert mgr.last_preflight["verdict"] == "reject"
+        # the SAME policy object re-read with a workable threshold
+        # (a policy edit): the park lifts on the next pass
+        relaxed = base_policy(mode="required",
+                              max_forecast_makespan_seconds=0.0)
+        clock.advance(61.0)
+        mgr.reconcile(NS, RUNTIME_LABELS, relaxed)
+        assert mgr.last_preflight["verdict"] == "admit"
+        assert any(state in IN_FLIGHT
+                   for state in node_states(cluster, keys).values())
+
+    def test_single_entry_cache_hits_on_steady_passes(self):
+        cluster, clock, keys, mgr = small_fleet()
+        policy = base_policy(mode="required",
+                             max_forecast_makespan_seconds=1.0)
+        mgr.reconcile(NS, RUNTIME_LABELS, policy)
+        clock.advance(61.0)
+        mgr.reconcile(NS, RUNTIME_LABELS, policy)
+        forecaster = mgr.preflight
+        computed = forecaster.forecasts_total
+        hits = forecaster.cache_hits_total
+        # an identical picture in the same minute: served from cache
+        mgr.reconcile(NS, RUNTIME_LABELS, policy)
+        assert forecaster.forecasts_total == computed
+        assert forecaster.cache_hits_total == hits + 1
+        # the minute bucket rolling over recomputes (a parked rollout
+        # must never cache its own rejection forever)
+        clock.advance(61.0)
+        mgr.reconcile(NS, RUNTIME_LABELS, policy)
+        assert forecaster.forecasts_total == computed + 1
+
+
+# ---------------------------------------------------------------------------
+# crash-mid-forecast: zero residue, identical re-derivation
+# ---------------------------------------------------------------------------
+class TestCrashMidForecast:
+    def test_crash_leaves_zero_residue(self):
+        cluster, clock, keys, mgr = small_fleet()
+        # required + unmeetable threshold: the first pass relabels and
+        # parks, leaving a stable pending fleet for the crash probe
+        policy = base_policy(mode="required",
+                             max_forecast_makespan_seconds=1.0)
+        mgr.reconcile(NS, RUNTIME_LABELS, policy)
+        crashes = []
+
+        def fuse(tag):
+            crashes.append(tag)
+            raise OperatorCrash("armed for preflight-forecast")
+
+        mgr.preflight_guard = fuse
+        clock.advance(61.0)
+        before = {
+            n.metadata.name: (dict(n.metadata.labels),
+                              dict(n.metadata.annotations))
+            for n in cluster.list_nodes()}
+        events_before = len(cluster.list_events(NS))
+        with pytest.raises(OperatorCrash):
+            mgr.reconcile(NS, RUNTIME_LABELS, policy)
+        assert crashes == ["preflight-forecast"]
+        after = {
+            n.metadata.name: (dict(n.metadata.labels),
+                              dict(n.metadata.annotations))
+            for n in cluster.list_nodes()}
+        assert after == before
+        assert len(cluster.list_events(NS)) == events_before
+
+    def test_next_incarnation_rederives_identical_forecast(self):
+        cluster, clock, keys, mgr = small_fleet()
+        policy = base_policy(mode="required",
+                             max_forecast_makespan_seconds=1.0)
+        mgr.reconcile(NS, RUNTIME_LABELS, policy)
+        mgr.preflight_guard = lambda tag: (_ for _ in ()).throw(
+            OperatorCrash("mid-forecast"))
+        clock.advance(61.0)
+        with pytest.raises(OperatorCrash):
+            mgr.reconcile(NS, RUNTIME_LABELS, policy)
+        # two independent incarnations, zero shared in-memory state:
+        # the forecast is a pure function of cluster state + clock
+        forecasts = []
+        for _ in range(2):
+            incarnation = ClusterUpgradeStateManager(
+                cluster, keys, clock=clock, async_workers=False,
+                poll_interval=0.0)
+            state = incarnation.build_state(NS, RUNTIME_LABELS)
+            forecaster = PreflightForecaster(
+                policy.preflight, keys,
+                predictor=PhaseDurationPredictor(keys=keys,
+                                                 clock=clock),
+                clock=clock,
+                live_call_counts=cluster.api_call_counts)
+            forecasts.append(forecaster.forecast(state, policy))
+        assert forecasts[0] == forecasts[1]
+        assert forecasts[0]["nodesPending"] > 0
+
+
+# ---------------------------------------------------------------------------
+# the read-only evidence channels + the preflight-readonly invariant
+# ---------------------------------------------------------------------------
+class TestReadOnlyGuarantee:
+    def test_live_mutation_channel_catches_a_write_around_the_clone(self):
+        cluster, clock, keys, mgr = small_fleet()
+        policy = UpgradePolicySpec(
+            auto_upgrade=True,
+            capacity=CapacityBudgetSpec(enable=True,
+                                        per_node_capacity=4),
+            preflight=PreflightSpec(mode="advisory"))
+        state = mgr.build_state(NS, RUNTIME_LABELS)
+        victim = cluster.list_nodes()[0].metadata.name
+
+        class SabotagedTrace:
+            """A collaborator that writes to the LIVE cluster from
+            inside the forecast path."""
+
+            def utilization(self, now):
+                cluster.patch_node_labels(victim, {"evil": "1"})
+                return 0.5
+
+        forecaster = PreflightForecaster(
+            policy.preflight, keys, predictor=None, clock=clock,
+            trace=SabotagedTrace(),
+            live_call_counts=cluster.api_call_counts)
+        forecast = forecaster.forecast(state, policy)
+        assert forecast["readonly"]["liveMutations"] >= 1
+        assert forecaster.live_mutations_total >= 1
+        monitor = InvariantMonitor(cluster=cluster, upgrade_keys=keys)
+        monitor.preflight_sample(forecast["readonly"])
+        assert any(v.invariant == "preflight-readonly"
+                   for v in monitor.violations)
+
+    def test_invariant_sample_contract(self):
+        cluster, clock, keys, _ = small_fleet(n_slices=1, hosts=2)
+        monitor = InvariantMonitor(cluster=cluster, upgrade_keys=keys)
+        monitor.preflight_sample(None)
+        assert monitor.preflight_samples == 0
+        monitor.preflight_sample({"frozenWriteAttempts": 0,
+                                  "liveMutations": 0})
+        assert monitor.preflight_samples == 1
+        assert not monitor.violations
+        monitor.preflight_sample({"frozenWriteAttempts": 2,
+                                  "liveMutations": 0})
+        assert [v.invariant for v in monitor.violations] \
+            == ["preflight-readonly"]
+
+
+# ---------------------------------------------------------------------------
+# surfaces: HTTP + metrics
+# ---------------------------------------------------------------------------
+class TestSurfaces:
+    def test_preflight_http_endpoint(self):
+        from tpu_operator_libs.examples.libtpu_operator import (
+            serve_metrics,
+        )
+
+        registry = MetricsRegistry()
+        forecast = {"mode": "advisory", "verdict": "admit",
+                    "nodesPending": 3}
+        server = serve_metrics(
+            registry, 0, status_source={},
+            preflight_source=lambda: dict(forecast))
+        try:
+            port = server.server_address[1]
+            body = urllib.request.urlopen(
+                f"http://127.0.0.1:{port}/preflight", timeout=10).read()
+            assert json.loads(body) == forecast
+        finally:
+            server.shutdown()
+
+    def test_default_preflight_binding_fallbacks(self):
+        from tpu_operator_libs.examples import libtpu_operator as mod
+
+        saved = mod.preflight_binding["fn"]
+        try:
+            mod.preflight_binding["fn"] = None
+            assert "error" in mod._default_preflight()
+            mod.preflight_binding["fn"] = lambda: None
+            assert mod._default_preflight()["mode"] == "off"
+            mod.preflight_binding["fn"] = lambda: {"verdict": "admit"}
+            assert mod._default_preflight()["verdict"] == "admit"
+        finally:
+            mod.preflight_binding["fn"] = saved
+
+    def test_observe_preflight_exposition(self):
+        cluster, clock, keys, mgr = small_fleet()
+        policy = base_policy(mode="required",
+                             max_forecast_makespan_seconds=1.0)
+        mgr.reconcile(NS, RUNTIME_LABELS, policy)
+        clock.advance(61.0)
+        mgr.reconcile(NS, RUNTIME_LABELS, policy)
+        registry = MetricsRegistry()
+        observe_preflight(registry, mgr)
+        text = registry.render_prometheus()
+        assert "tpu_upgrade_preflight_forecasts_total" in text
+        assert "tpu_upgrade_preflight_rejections_total" in text
+        assert "tpu_upgrade_preflight_frozen_write_attempts_total" \
+            in text
+        assert 'tpu_upgrade_preflight_rejected{driver="libtpu"} 1' \
+            in text
+
+    def test_observe_preflight_is_noop_without_forecaster(self):
+        cluster, clock, keys, mgr = small_fleet(n_slices=1, hosts=2)
+        registry = MetricsRegistry()
+        observe_preflight(registry, mgr)
+        assert "preflight_forecasts_total" \
+            not in registry.render_prometheus()
+
+
+# ---------------------------------------------------------------------------
+# the seeded preflight chaos gate
+# ---------------------------------------------------------------------------
+class TestPreflightSoakGate:
+    """256-node serving replay under the compound-fault storm with the
+    forecaster live on every pass: read-only invariant green, storm-
+    grade calibration in band, the required-mode probe admitting zero
+    nodes, crash-mid-forecast resume. Seeds 1-3 tier-1, 4-10 slow."""
+
+    @pytest.mark.chaos
+    @pytest.mark.parametrize("seed", [1, 2, 3])
+    def test_preflight_soak_seed(self, seed):
+        from tpu_operator_libs.chaos.runner import run_preflight_soak
+
+        report = run_preflight_soak(seed)
+        assert report.ok, report.report_text
+        stats = report.stats
+        assert stats["preflight"]["frozenWriteAttempts"] == 0
+        assert stats["preflight"]["liveMutations"] == 0
+        assert stats["preflight"]["forecasts"] > 0
+        assert stats["preflightSamples"] > 0
+        probe = stats["requiredProbe"]
+        assert probe["ran"]
+        assert probe["verdict"] == "reject"
+        assert probe["admitted"] == 0
+
+    @pytest.mark.chaos
+    @pytest.mark.slow
+    @pytest.mark.parametrize("seed", [4, 5, 6, 7, 8, 9, 10])
+    def test_preflight_soak_extended(self, seed):
+        from tpu_operator_libs.chaos.runner import run_preflight_soak
+
+        report = run_preflight_soak(seed)
+        assert report.ok, report.report_text
+        assert report.stats["requiredProbe"]["admitted"] == 0

@@ -1,0 +1,279 @@
+#!/usr/bin/env python3
+"""Rollout-preflight calibration benchmark: forecast vs realized.
+
+Drives the REAL state machine over the standing heterogeneous bench
+fleets (tools/planner_bench.py's seeded lognormal straggler tail, 256 /
+1024 nodes on the FakeCluster virtual clock), with the preflight
+forecaster LIVE in advisory mode:
+
+- **rollout #1** is the LEARNING pass: the duration predictor records
+  per-node phase durations and closes its per-node forecasts into the
+  error histogram the preflight's confidence bounds consume;
+- **rollout #2** is the GRADED pass: the forecast captured on the first
+  pass that sees the full pending fleet (nothing admitted yet) is the
+  what-if answer an operator would read before approving the rollout,
+  and the fleet then realizes the rollout fault-free.
+
+Acceptance per fleet size (ISSUE 17): forecast expected makespan within
+15% of the realized makespan, AND the confidence interval
+[lower, upper] covering the realized value. The report carries an
+``acceptance`` block (``ok`` + ``problems``); the process exits 1 when
+any cell misses, so CI can gate on the tool directly.
+
+CLI: ``python tools/preflight_bench.py [--nodes 256,1024]
+[--out BENCH_preflight.json]`` prints one JSON document.
+``make bench-preflight`` wraps it.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import sys
+from typing import Optional
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+
+from tools.planner_bench import (  # noqa: E402
+    EVENT_BATCH_WINDOW,
+    HETERO_SIGMA,
+    HOSTS_PER_SLICE,
+    MAX_UNAVAILABLE,
+    POD_READY_DELAY,
+    POD_RECREATE_DELAY,
+    RESYNC_INTERVAL,
+    SECOND_REVISION,
+    VALIDATION_RETRY,
+    VALIDATION_SETTLE,
+    _HeteroSettleValidator,
+)
+from tpu_operator_libs.api.upgrade_policy import (  # noqa: E402
+    DrainSpec,
+    PredictorSpec,
+    PreflightSpec,
+    UpgradePolicySpec,
+)
+from tpu_operator_libs.consts import (  # noqa: E402
+    POD_CONTROLLER_REVISION_HASH_LABEL,
+    UpgradeState,
+)
+from tpu_operator_libs.simulate import (  # noqa: E402
+    NS,
+    RUNTIME_LABELS,
+    FleetSpec,
+    build_fleet,
+    heterogeneous_settle,
+)
+from tpu_operator_libs.upgrade.nudger import ReconcileNudger  # noqa: E402
+from tpu_operator_libs.upgrade.state_manager import (  # noqa: E402
+    BuildStateError,
+    ClusterUpgradeStateManager,
+)
+
+#: Confidence quantile the bench's interval-coverage check grades.
+CONFIDENCE = 0.9
+#: The ISSUE 17 acceptance bound on |forecast - realized| / realized.
+MAX_FORECAST_ERROR = 0.15
+
+
+def run_preflight_cell(n_nodes: int,
+                       interval: float = RESYNC_INTERVAL,
+                       max_sim_seconds: float = 24 * 3600.0,
+                       hetero_sigma: float = HETERO_SIGMA) -> dict:
+    """One learning rollout, then one forecast-graded rollout."""
+    if n_nodes % HOSTS_PER_SLICE:
+        raise ValueError(f"n_nodes must be a multiple of {HOSTS_PER_SLICE}")
+    fleet = FleetSpec(n_slices=n_nodes // HOSTS_PER_SLICE,
+                      hosts_per_slice=HOSTS_PER_SLICE,
+                      pod_recreate_delay=POD_RECREATE_DELAY,
+                      pod_ready_delay=POD_READY_DELAY,
+                      hetero_sigma=hetero_sigma)
+    cluster, clock, keys = build_fleet(fleet)
+    names = [n.metadata.name for n in cluster.list_nodes()]
+    settle = heterogeneous_settle(fleet, names, VALIDATION_SETTLE)
+    nudger = ReconcileNudger(clock=clock, resolution=1.0)
+    mgr = ClusterUpgradeStateManager(
+        cluster, keys, clock=clock, async_workers=False,
+        poll_interval=0.0, nudger=nudger)
+    mgr.with_validation_enabled(
+        "", extra_validator=_HeteroSettleValidator(cluster, clock, settle))
+    mgr.validation_manager.retry_seconds = VALIDATION_RETRY
+    policy = UpgradePolicySpec(
+        auto_upgrade=True, max_parallel_upgrades=0,
+        max_unavailable=MAX_UNAVAILABLE, topology_mode="flat",
+        drain=DrainSpec(enable=True, force=True, timeout_seconds=300),
+        predictor=PredictorSpec(enable=True),
+        preflight=PreflightSpec(mode="advisory", confidence=CONFIDENCE))
+
+    captured: Optional[dict] = None
+
+    def reconcile() -> None:
+        nonlocal captured
+        try:
+            mgr.reconcile(NS, RUNTIME_LABELS, policy)
+        except BuildStateError:
+            pass  # incomplete snapshot; the next wakeup retries
+        nudger.consume_pending()
+        nudger.pop_due(clock.now())
+        # the graded forecast: the first pass that sees the pending
+        # fleet (nothing admitted yet inside that same pass — the
+        # forecast runs before the throttle spends slot one)
+        forecast = mgr.last_preflight
+        if captured is None and forecast is not None \
+                and forecast.get("nodesPending", 0) > 0:
+            captured = dict(forecast)
+
+    done = str(UpgradeState.DONE)
+
+    def converged(revision: str) -> bool:
+        if any(n.metadata.labels.get(keys.state_label, "") != done
+               for n in cluster.list_nodes()):
+            return False
+        pods = [p for p in cluster.list_pods(namespace=NS)
+                if p.controller_owner() is not None]
+        return len(pods) == n_nodes and all(
+            p.metadata.labels.get(POD_CONTROLLER_REVISION_HASH_LABEL)
+            == revision and p.is_ready() for p in pods)
+
+    def drive(revision: str) -> float:
+        """planner_bench's event-driven loop to convergence."""
+        start = clock.now()
+        reconcile()
+        next_resync = clock.now() + interval
+        while not converged(revision):
+            if clock.now() >= max_sim_seconds:
+                raise RuntimeError(
+                    f"no convergence within {max_sim_seconds}s")
+            now = clock.now()
+            wake = next_resync
+            due = cluster.next_action_due()
+            if due is not None and max(due, now) < wake:
+                wake = max(due, now)
+            deadline = nudger.next_deadline()
+            if deadline is not None and max(deadline, now) < wake:
+                wake = max(deadline, now)
+            clock.advance(wake - now)
+            cluster.step()
+            while True:
+                due = cluster.next_action_due()
+                if due is None or due > wake + EVENT_BATCH_WINDOW:
+                    break
+                clock.advance(max(0.0, due - clock.now()))
+                cluster.step()
+            nudger.pop_due(clock.now())
+            if clock.now() >= next_resync:
+                next_resync = clock.now() + interval
+            reconcile()
+        return clock.now() - start
+
+    makespan_1 = drive("new")
+
+    # rollout #2: drop the learning pass's capture (it graded a cold /
+    # mid-flight picture), bump, and grade the fresh full-fleet one
+    captured = None
+    cluster.bump_daemon_set_revision(NS, "libtpu", SECOND_REVISION)
+    drive(SECOND_REVISION)
+
+    if captured is None:
+        raise RuntimeError("no preflight forecast saw the pending fleet")
+    makespan = captured["makespan"]
+    # realized from the forecast's OWN anchor: the interval the
+    # forecast models starts when it was generated, not at the bump
+    realized = clock.now() - captured["generatedAtSeconds"]
+    expected = makespan["expectedSeconds"]
+    error = abs(expected - realized) / realized if realized else None
+    forecaster = mgr.preflight
+    return {
+        "converged": True,
+        "makespan_learning_s": round(makespan_1, 1),
+        "realized_makespan_s": round(realized, 1),
+        "forecast_makespan_s": expected,
+        "forecast_lower_s": makespan["lowerSeconds"],
+        "forecast_upper_s": makespan["upperSeconds"],
+        "confidence": makespan["confidence"],
+        "error_samples": makespan["errorSamples"],
+        "nodes_pending_at_forecast": captured["nodesPending"],
+        "forecast_waves": len(captured.get("waves", ())),
+        "forecast_error": round(error, 4) if error is not None else None,
+        "ci_covers_realized": bool(
+            makespan["lowerSeconds"] <= realized
+            <= makespan["upperSeconds"]),
+        "forecasts_computed": (forecaster.forecasts_total
+                               if forecaster is not None else 0),
+        "forecast_cache_hits": (forecaster.cache_hits_total
+                                if forecaster is not None else 0),
+        "frozen_write_attempts": (forecaster.frozen_write_attempts_total
+                                  if forecaster is not None else 0),
+        "live_mutations": (forecaster.live_mutations_total
+                           if forecaster is not None else 0),
+    }
+
+
+def run_preflight_bench(sizes: "tuple[int, ...]" = (256, 1024),
+                        hetero_sigma: float = HETERO_SIGMA) -> dict:
+    """Forecast-vs-realized calibration across fleet sizes, with the
+    ISSUE 17 acceptance verdict folded in."""
+    out: dict = {
+        "pod_recreate_delay_s": POD_RECREATE_DELAY,
+        "pod_ready_delay_s": POD_READY_DELAY,
+        "validation_settle_s": VALIDATION_SETTLE,
+        "hetero_sigma": hetero_sigma,
+        "max_unavailable": MAX_UNAVAILABLE,
+        "confidence": CONFIDENCE,
+        "max_forecast_error": MAX_FORECAST_ERROR,
+    }
+    problems: list[str] = []
+    for n_nodes in sizes:
+        cell = run_preflight_cell(n_nodes, hetero_sigma=hetero_sigma)
+        error = cell["forecast_error"]
+        cell["meets_15pct_error"] = bool(
+            error is not None and error <= MAX_FORECAST_ERROR)
+        if not cell["meets_15pct_error"]:
+            problems.append(
+                f"{n_nodes} nodes: forecast error "
+                f"{error if error is None else round(100 * error, 2)}% "
+                f"exceeds {round(100 * MAX_FORECAST_ERROR)}%")
+        if not cell["ci_covers_realized"]:
+            problems.append(
+                f"{n_nodes} nodes: confidence interval "
+                f"[{cell['forecast_lower_s']}, {cell['forecast_upper_s']}]"
+                f" does not cover realized {cell['realized_makespan_s']}s")
+        if cell["frozen_write_attempts"] or cell["live_mutations"]:
+            problems.append(
+                f"{n_nodes} nodes: read-only guarantee violated "
+                f"({cell['frozen_write_attempts']} frozen write "
+                f"attempt(s), {cell['live_mutations']} live mutation(s))")
+        out[f"{n_nodes}_nodes"] = cell
+    out["acceptance"] = {"ok": not problems, "problems": problems}
+    return out
+
+
+def main(argv: "list[str]") -> int:
+    sizes: tuple[int, ...] = (256, 1024)
+    out_path: Optional[str] = None
+    sigma = HETERO_SIGMA
+    for i, arg in enumerate(argv):
+        if arg == "--nodes" and i + 1 < len(argv):
+            sizes = tuple(int(s) for s in argv[i + 1].split(","))
+        elif arg.startswith("--nodes="):
+            sizes = tuple(int(s) for s in arg.split("=", 1)[1].split(","))
+        elif arg == "--out" and i + 1 < len(argv):
+            out_path = argv[i + 1]
+        elif arg.startswith("--out="):
+            out_path = arg.split("=", 1)[1]
+        elif arg == "--sigma" and i + 1 < len(argv):
+            sigma = float(argv[i + 1])
+        elif arg.startswith("--sigma="):
+            sigma = float(arg.split("=", 1)[1])
+    report = run_preflight_bench(sizes, hetero_sigma=sigma)
+    rendered = json.dumps(report, indent=2)
+    print(rendered)
+    if out_path:
+        with open(out_path, "w") as fh:
+            fh.write(rendered + "\n")
+    return 0 if report["acceptance"]["ok"] else 1
+
+
+if __name__ == "__main__":
+    sys.exit(main(sys.argv[1:]))

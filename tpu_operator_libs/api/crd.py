@@ -916,6 +916,60 @@ def federation_policy_schema() -> dict[str, Any]:
                                "dipping below the trough threshold is "
                                "admitted anyway after this wait.",
             },
+            "preflight": preflight_schema(),
+        },
+    }
+
+
+def preflight_schema() -> dict[str, Any]:
+    """PreflightSpec (beyond-reference: what-if forecast gating
+    admission against a frozen cluster clone; docs/preflight.md)."""
+    return {
+        "type": "object",
+        "description": "Rollout preflight: before node one is admitted, "
+                       "replay the proposed revision in-process against "
+                       "a frozen clone of the cluster picture (learned "
+                       "durations, capacity/traffic, policy engine) and "
+                       "gate admission on the forecast.",
+        "properties": {
+            "mode": {
+                "type": "string",
+                "enum": ["off", "advisory", "required"],
+                "default": "off",
+                "description": "off = no forecast; advisory = forecast "
+                               "surfaced in status/explain but never "
+                               "blocks; required = a threshold breach "
+                               "parks the rollout with an audited "
+                               "preflight-rejected reason.",
+            },
+            "maxForecastSloRiskFraction": {
+                "type": "number",
+                "minimum": 0,
+                "maximum": 1,
+                "default": 0.2,
+                "description": "Highest tolerable forecast SLO-risk "
+                               "fraction (worst traffic class's "
+                               "predicted peak shortfall over the "
+                               "rollout).",
+            },
+            "maxForecastMakespanSeconds": {
+                "type": "number",
+                "minimum": 0,
+                "default": 0,
+                "description": "Highest tolerable forecast makespan "
+                               "(upper confidence bound, seconds); 0 "
+                               "means unbounded.",
+            },
+            "confidence": {
+                "type": "number",
+                "exclusiveMinimum": 0,
+                "exclusiveMaximum": 1,
+                "default": 0.9,
+                "description": "Confidence level for the error-widened "
+                               "forecast bounds; required mode gates on "
+                               "the upper bound so a noisy model gates "
+                               "earlier, never later.",
+            },
         },
     }
 
@@ -957,6 +1011,7 @@ def upgrade_policy_schema() -> dict[str, Any]:
             "predictor": predictor_schema(),
             "maintenanceWindow": maintenance_window_schema(),
             "capacityBudget": capacity_budget_schema(),
+            "preflight": preflight_schema(),
             "policyHooks": policy_hooks_schema(),
             "artifactDAG": artifact_dag_schema(),
             "topologyMode": {

@@ -15,11 +15,12 @@ from __future__ import annotations
 
 import copy
 from dataclasses import dataclass
-from typing import Any
+from typing import Any, Optional
 
 from tpu_operator_libs.api.upgrade_policy import (
     IntOrString,
     PolicyValidationError,
+    PreflightSpec,
     scaled_value_from_int_or_percent,
 )
 
@@ -57,6 +58,12 @@ class FederationPolicySpec:
     # Liveness override: a region that never dips below the trough
     # threshold is admitted anyway after waiting this long.
     max_trough_wait_seconds: int = 3600
+    # Region-admission preflight (upgrade/preflight.py semantics at
+    # region granularity): before a region is rolled — and before its
+    # budget share is stamped — its rollout is forecast against the
+    # region's live traffic signal; a required-mode threshold breach
+    # defers the region under an audited preflight-rejected hold.
+    preflight: Optional[PreflightSpec] = None
 
     def validate(self) -> None:
         if scaled_value_from_int_or_percent(
@@ -74,9 +81,11 @@ class FederationPolicySpec:
         if self.max_trough_wait_seconds < 0:
             raise PolicyValidationError(
                 "maxTroughWaitSeconds must be >= 0")
+        if self.preflight is not None:
+            self.preflight.validate()
 
     def to_dict(self) -> dict[str, Any]:
-        return {
+        out = {
             "enable": self.enable,
             "globalMaxUnavailable": self.global_max_unavailable,
             "canaryRegion": self.canary_region,
@@ -86,10 +95,13 @@ class FederationPolicySpec:
             "troughUtilization": self.trough_utilization,
             "maxTroughWaitSeconds": self.max_trough_wait_seconds,
         }
+        if self.preflight is not None:
+            out["preflight"] = self.preflight.to_dict()
+        return out
 
     @classmethod
     def from_dict(cls, data: dict[str, Any]) -> "FederationPolicySpec":
-        return cls(
+        spec = cls(
             enable=data.get("enable", True),
             global_max_unavailable=data.get("globalMaxUnavailable",
                                             "25%"),
@@ -100,6 +112,9 @@ class FederationPolicySpec:
             trough_utilization=data.get("troughUtilization", 0.35),
             max_trough_wait_seconds=data.get("maxTroughWaitSeconds",
                                              3600))
+        if "preflight" in data:
+            spec.preflight = PreflightSpec.from_dict(data["preflight"])
+        return spec
 
     def deep_copy(self) -> "FederationPolicySpec":
         return copy.deepcopy(self)

@@ -288,6 +288,80 @@ class PredictorSpec:
         return copy.deepcopy(self)
 
 
+#: Preflight gate modes: ``off`` (no forecast), ``advisory`` (forecast
+#: surfaced in status/explain but never blocks), ``required`` (a
+#: threshold breach parks the rollout before node one is admitted).
+PREFLIGHT_MODES: tuple[str, ...] = ("off", "advisory", "required")
+
+
+@dataclass
+class PreflightSpec:
+    """What-if forecast gating admission (beyond-reference;
+    upgrade/preflight.py).
+
+    Before the first node of a rollout is admitted, the operator
+    replays the proposed revision in-process against a FROZEN clone of
+    the cluster picture — the learned phase-duration model, the
+    capacity/traffic picture, and the policy engine — and produces a
+    structured forecast (makespan with confidence bounds, per-class SLO
+    risk, expected aborts/holds/window deferrals, per-wave breakdown).
+    In ``required`` mode a forecast breaching either threshold parks
+    the rollout with an audited ``preflight-rejected`` reason; in
+    ``advisory`` mode the forecast is surfaced but never blocks.
+    """
+
+    # Gate mode: "off", "advisory", or "required".
+    mode: str = "off"
+    # Highest tolerable forecast SLO-risk fraction (worst class's
+    # predicted peak shortfall over the rollout), in [0, 1].
+    max_forecast_slo_risk_fraction: float = 0.2
+    # Highest tolerable forecast makespan (seconds); 0 = unbounded.
+    max_forecast_makespan_seconds: float = 0.0
+    # Confidence level for the forecast's error-widened bounds; the
+    # REQUIRED-mode threshold compares against the UPPER bound, so a
+    # noisy model gates earlier, never later.
+    confidence: float = 0.9
+
+    @property
+    def enabled(self) -> bool:
+        return self.mode != "off"
+
+    def validate(self) -> None:
+        if self.mode not in PREFLIGHT_MODES:
+            raise PolicyValidationError(
+                f"preflight.mode must be one of {PREFLIGHT_MODES}, "
+                f"got {self.mode!r}")
+        if not 0.0 <= self.max_forecast_slo_risk_fraction <= 1.0:
+            raise PolicyValidationError(
+                "preflight.maxForecastSloRiskFraction must be in [0, 1]")
+        if self.max_forecast_makespan_seconds < 0:
+            raise PolicyValidationError(
+                "preflight.maxForecastMakespanSeconds must be >= 0")
+        if not 0.0 < self.confidence < 1.0:
+            raise PolicyValidationError(
+                "preflight.confidence must be in (0, 1)")
+
+    def to_dict(self) -> dict[str, Any]:
+        return {"mode": self.mode,
+                "maxForecastSloRiskFraction":
+                    self.max_forecast_slo_risk_fraction,
+                "maxForecastMakespanSeconds":
+                    self.max_forecast_makespan_seconds,
+                "confidence": self.confidence}
+
+    @classmethod
+    def from_dict(cls, data: dict[str, Any]) -> "PreflightSpec":
+        return cls(mode=data.get("mode", "off"),
+                   max_forecast_slo_risk_fraction=data.get(
+                       "maxForecastSloRiskFraction", 0.2),
+                   max_forecast_makespan_seconds=data.get(
+                       "maxForecastMakespanSeconds", 0.0),
+                   confidence=data.get("confidence", 0.9))
+
+    def deep_copy(self) -> "PreflightSpec":
+        return copy.deepcopy(self)
+
+
 @dataclass
 class MaintenanceWindowSpec:
     """"Finish by the window close or don't start" (beyond-reference).
@@ -717,6 +791,10 @@ class UpgradePolicySpec:
     # live serving-endpoint load signals, with safe mid-flight abort.
     # None = the static maxUnavailable applies unchanged.
     capacity: Optional[CapacityBudgetSpec] = None
+    # Beyond-reference: what-if forecast gating admission (replay the
+    # proposed revision against a frozen cluster clone BEFORE node one
+    # is admitted). None = no preflight (reference semantics).
+    preflight: Optional[PreflightSpec] = None
     # Beyond-reference: declarative CEL-style hook programs evaluated
     # sandboxed at the named policy hook points (policy/engine.py).
     # Typed "Any" to avoid an import cycle (api.policy_spec imports
@@ -753,7 +831,8 @@ class UpgradePolicySpec:
         for sub in (self.pod_deletion, self.wait_for_completion, self.drain,
                     self.canary, self.rollback, self.sharding,
                     self.predictor, self.maintenance_window,
-                    self.capacity, self.policy_hooks, self.artifact_dag):
+                    self.capacity, self.preflight, self.policy_hooks,
+                    self.artifact_dag):
             if sub is not None:
                 sub.validate()
 
@@ -785,6 +864,8 @@ class UpgradePolicySpec:
             out["maintenanceWindow"] = self.maintenance_window.to_dict()
         if self.capacity is not None:
             out["capacityBudget"] = self.capacity.to_dict()
+        if self.preflight is not None:
+            out["preflight"] = self.preflight.to_dict()
         if self.policy_hooks is not None:
             out["policyHooks"] = self.policy_hooks.to_dict()
         if self.artifact_dag is not None:
@@ -823,6 +904,8 @@ class UpgradePolicySpec:
         if data.get("capacityBudget") is not None:
             spec.capacity = CapacityBudgetSpec.from_dict(
                 data["capacityBudget"])
+        if data.get("preflight") is not None:
+            spec.preflight = PreflightSpec.from_dict(data["preflight"])
         if data.get("policyHooks") is not None:
             from tpu_operator_libs.api.policy_spec import PolicyHooksSpec
             spec.policy_hooks = PolicyHooksSpec.from_dict(

@@ -436,6 +436,8 @@ class InvariantMonitor:
         self.dag_advances_seen = 0
         #: policy_sample() probes run (teeth evidence).
         self.policy_samples = 0
+        #: preflight_sample() probes run (teeth evidence).
+        self.preflight_samples = 0
         # delay_exempt: the auditor's stream stays live through a
         # watch-delay fault window — the SYSTEM under test sees the
         # lag, the monitor judging it must see ground truth (a lagged
@@ -793,6 +795,29 @@ class InvariantMonitor:
                 "policy-sandbox", "engine",
                 f"{unaudited} hook failure(s) produced no DecisionAudit "
                 f"record (stats: {stats})")
+
+    def preflight_sample(self, stats: "Optional[dict]") -> None:
+        """One runner probe of the preflight forecaster's read-only
+        evidence counters (preflight-readonly): the what-if replay runs
+        against a FROZEN clone, so ANY write that reached the clone —
+        or any live-cluster mutation observed across a forecast — means
+        the simulation leaked into reality. The counters are lifetime
+        totals; a single nonzero reading condemns the whole episode."""
+        if stats is None:
+            return
+        self.preflight_samples += 1
+        frozen_writes = stats.get("frozenWriteAttempts", 0)
+        if frozen_writes:
+            self._violate(
+                "preflight-readonly", "forecaster",
+                f"{frozen_writes} write attempt(s) reached the frozen "
+                f"preflight clone (stats: {stats})")
+        live_mutations = stats.get("liveMutations", 0)
+        if live_mutations:
+            self._violate(
+                "preflight-readonly", "forecaster",
+                f"{live_mutations} live-cluster mutation(s) observed "
+                f"during preflight forecasting (stats: {stats})")
 
     # -- slice-reconfiguration invariants ---------------------------------
     def _degraded_lost(self, pool: str) -> int:

@@ -47,6 +47,7 @@ from tpu_operator_libs.api.upgrade_policy import (
     IntOrString,
     MaintenanceWindowSpec,
     PredictorSpec,
+    PreflightSpec,
     TrafficClassSpec,
     UpgradePolicySpec,
 )
@@ -2747,6 +2748,464 @@ def run_budget_soak(seed: int,
         explains_probed=monitor.explains_probed)
     report.report_text = "\n".join(
         [schedule.describe(), monitor.report(seed=seed)])
+    if not report.ok:
+        logger.error("%s", report.report_text)
+    return report
+
+
+@dataclass
+class PreflightChaosConfig(BudgetChaosConfig):
+    """Knobs of one rollout-preflight (read-only what-if) episode: the
+    budget gate's 256-node serving fleet and compound-fault storm, with
+    the preflight forecaster LIVE on every reconcile pass (advisory
+    mode, so rejects never block the convergence the episode must still
+    reach). The gate's teeth:
+
+    - **preflight-readonly**: zero write attempts ever reach the frozen
+      forecast clone and zero live-cluster mutations are attributable
+      to a forecast — sampled every tick from the forecaster's lifetime
+      evidence counters, across every operator incarnation (the
+      forecast path shares the crash fuse, so detonations land INSIDE
+      the forecast seam and must leave no residue);
+    - **preflight-calibration**: a completed rollout's realized
+      makespan lands within ``calibration_slack`` of the forecast made
+      when its pending wave first appeared — the storm-grade sanity
+      bound (the fault-free 15% bound is ``tools/preflight_bench.py``'s
+      job);
+    - **preflight-required-gate**: after convergence, a THIRD revision
+      is offered under a ``required``-mode policy whose makespan
+      threshold cannot be met — and zero nodes may enter any in-flight
+      state while the audited reject stands.
+    """
+
+    #: Forecast confidence quantile for the error-histogram bounds.
+    preflight_confidence: float = 0.9
+    #: Storm-grade calibration bound: realized/forecast makespan ratio
+    #: must land in [1/slack, slack] for the LAST completed rollout
+    #: (the one forecast by the most-trained predictor). Deliberately
+    #: loose — node kills, crash-restarts and peak pauses stretch the
+    #: realized tail in ways the analytic forecast does not model.
+    calibration_slack: float = 5.0
+    #: Ticks of the post-convergence required-mode hold probe (0
+    #: disables the probe).
+    required_probe_steps: int = 12
+    #: The unmeetable threshold the probe's policy ships: any real
+    #: fleet forecast exceeds one second, so required mode MUST park.
+    required_makespan_threshold: float = 1.0
+
+    def upgrade_policy(self) -> UpgradePolicySpec:
+        policy = super().upgrade_policy()
+        policy.preflight = PreflightSpec(
+            mode="advisory", confidence=self.preflight_confidence)
+        return policy
+
+
+#: The revision the required-mode hold probe offers after convergence —
+#: never admitted (that is the point), so converged() never sees it.
+HELD_REVISION = "new3hold"
+
+
+def run_preflight_soak(seed: int,
+                       config: Optional[PreflightChaosConfig] = None,
+                       ) -> ChaosReport:
+    """The rollout-preflight gate: the budget episode's serving fleet
+    rolls end-to-end under the compound-fault storm with the what-if
+    forecaster evaluated on every pass, proving the read-only
+    guarantee, storm-grade forecast calibration, and the required-mode
+    admission hold. Deterministic in ``seed``.
+    """
+    config = config or PreflightChaosConfig()
+    fleet = FleetSpec(
+        n_slices=config.n_slices,
+        hosts_per_slice=config.hosts_per_slice,
+        pod_recreate_delay=config.pod_recreate_delay,
+        pod_ready_delay=config.pod_ready_delay)
+    cluster, clock, keys = build_fleet(fleet)
+    rem_keys = RemediationKeys()
+    node_names = [n.metadata.name for n in cluster.list_nodes()]
+
+    schedule = FaultSchedule.generate_budget(
+        seed, node_names, horizon=config.horizon)
+    injector = ChaosInjector(cluster, schedule,
+                             lease_namespace=config.lease_namespace,
+                             lease_name=config.lease_name)
+    injector.install()
+    cluster.schedule_at(
+        config.horizon / 2.0,
+        lambda: cluster.bump_daemon_set_revision(NS, "libtpu",
+                                                 FINAL_REVISION))
+    spikes = tuple(SpikeWindow(at=e.at, until=e.until,
+                               factor=e.param / 10.0,
+                               ramp_seconds=60.0)
+                   for e in schedule.by_kind(FAULT_TRAFFIC_SPIKE))
+    trace = DiurnalTrace(seed=seed,
+                         period_seconds=config.diurnal_period,
+                         trough_util=config.trough_util,
+                         peak_util=config.peak_util,
+                         spikes=spikes)
+    serving = ServingFleetSim(
+        cluster, node_names, trace,
+        per_node_capacity=config.per_node_capacity,
+        generation_seconds=config.generation_seconds, seed=seed)
+
+    upgrade_policy = config.upgrade_policy()
+    remediation_policy = config.remediation_policy()
+    remediation_policy.enable = False
+
+    monitor = InvariantMonitor(
+        cluster=cluster, upgrade_keys=keys, remediation_keys=rem_keys,
+        max_unavailable=upgrade_policy.capacity.max_effective_budget,
+        remediation_max_unavailable=None,
+        max_parallel_upgrades=config.max_parallel_upgrades)
+
+    # the forecast path runs under the SAME crash fuse as the durable
+    # writes: each computed forecast consumes one fuse unit, so the
+    # schedule's detonations land inside the forecast seam too — and
+    # the read-only invariant must hold across those crashes
+    preflight_crashes = 0
+
+    def preflight_guard(tag: str) -> None:
+        nonlocal preflight_crashes
+        before = injector.fuse.fired_total
+        try:
+            injector.fuse.guard(lambda: None)
+        except OperatorCrash:
+            if injector.fuse.fired_total > before:
+                preflight_crashes += 1
+            raise
+
+    # forecaster evidence counters are per-incarnation (the forecaster
+    # dies with the process); the invariant needs episode-lifetime
+    # totals, so dead incarnations' counters are banked here
+    accum = {"forecasts": 0, "cacheHits": 0, "rejected": 0,
+             "frozenWriteAttempts": 0, "liveMutations": 0}
+
+    def harvest(op: "_OperatorIncarnation") -> None:
+        forecaster = op.upgrade.preflight
+        if forecaster is None:
+            return
+        accum["forecasts"] += forecaster.forecasts_total
+        accum["cacheHits"] += forecaster.cache_hits_total
+        accum["rejected"] += forecaster.rejected_total
+        accum["frozenWriteAttempts"] += \
+            forecaster.frozen_write_attempts_total
+        accum["liveMutations"] += forecaster.live_mutations_total
+
+    def wire(op: "_OperatorIncarnation") -> "_OperatorIncarnation":
+        # the soak's trace is the same object the serving sim replays,
+        # so the forecast sweeps the real traffic shape; the guard is
+        # the crash-fuse seam
+        op.upgrade.preflight_trace = trace
+        op.upgrade.preflight_guard = preflight_guard
+        return op
+
+    def probe_readonly(op: "_OperatorIncarnation") -> None:
+        forecaster = op.upgrade.preflight
+        if forecaster is None:
+            return
+        monitor.preflight_sample({
+            "forecasts": accum["forecasts"]
+            + forecaster.forecasts_total,
+            "frozenWriteAttempts": accum["frozenWriteAttempts"]
+            + forecaster.frozen_write_attempts_total,
+            "liveMutations": accum["liveMutations"]
+            + forecaster.live_mutations_total})
+
+    incarnations = 1
+    handovers = 0
+    reconciles = 0
+    op = wire(_OperatorIncarnation(
+        cluster, clock, keys, rem_keys, config, injector,
+        identity="operator-1", serving=serving, monitor=monitor))
+
+    def next_incarnation(reason: str) -> "_OperatorIncarnation":
+        nonlocal incarnations
+        incarnations += 1
+        harvest(op)
+        injector.fuse.reset()
+        monitor.trace.append(
+            f"[t={clock.now():g}] operator restart #{incarnations} "
+            f"({reason}) — rebuilding managers from cluster state alone")
+        return wire(_OperatorIncarnation(
+            cluster, clock, keys, rem_keys, config, injector,
+            identity=f"operator-{incarnations}", serving=serving,
+            monitor=monitor))
+
+    done_label = str(UpgradeState.DONE)
+    in_flight_labels = {str(s) for s in IN_PROGRESS_STATES}
+
+    def fleet_done() -> bool:
+        try:
+            nodes = cluster.list_nodes()
+        except (ApiServerError, TimeoutError):
+            return False
+        return (len(nodes) == len(node_names)
+                and all(n.metadata.labels.get(keys.state_label)
+                        == done_label for n in nodes))
+
+    def converged() -> bool:
+        try:
+            nodes = cluster.list_nodes()
+            pods = cluster.list_pods(namespace=NS)
+        except (ApiServerError, TimeoutError):
+            return False
+        if len(nodes) != len(node_names):
+            return False
+        for node in nodes:
+            if node.metadata.labels.get(keys.state_label) != done_label:
+                return False
+            if node.is_unschedulable() or not node.is_ready():
+                return False
+        runtime = [p for p in pods if p.controller_owner() is not None]
+        if len(runtime) != len(node_names):
+            return False
+        if not all(
+                p.metadata.labels.get(POD_CONTROLLER_REVISION_HASH_LABEL)
+                == FINAL_REVISION and p.is_ready() for p in runtime):
+            return False
+        return (len(serving.endpoints) == len(node_names)
+                and not any(ep.draining
+                            for ep in serving.endpoints.values()))
+
+    # forecast-vs-realized calibration: the first forecast that sees a
+    # rollout's pending wave (with a warm, non-zero makespan) is held
+    # until the fleet is all-done again — realized = done - generatedAt
+    calib_active: "Optional[dict]" = None
+    calib_samples: "list[dict]" = []
+
+    steps = 0
+    is_converged = False
+    quiesce_ticks = 0
+    serving.tick(clock.now())
+    monitor.drain()
+    while steps < config.max_steps:
+        steps += 1
+        now = clock.now()
+        was_leading = op.elector.is_leader
+        op.elector.try_acquire_or_renew()
+        if was_leading and not op.elector.is_leader:
+            handovers += 1
+            op = next_incarnation("leader election lost")
+            op.elector.try_acquire_or_renew()
+        if op.elector.is_leader:
+            injector.arm_due_crashes(now)
+            op.nudger.pop_due(now)
+            op.nudger.consume_pending()
+            try:
+                op.remediation.reconcile(NS, dict(RUNTIME_LABELS),
+                                         remediation_policy)
+                op.upgrade.reconcile(NS, dict(RUNTIME_LABELS),
+                                     upgrade_policy)
+                reconciles += 1
+            except OperatorCrash:
+                op = next_incarnation("operator crash mid-reconcile")
+            except BuildStateError:
+                pass
+            except (ApiServerError, ConflictError, NotFoundError):
+                pass
+            if injector.fuse.pending:
+                op = next_incarnation("operator crash (surfaced late)")
+        monitor.drain()
+        serving.tick(now)
+        probe_readonly(op)
+        forecast = op.upgrade.last_preflight
+        if (calib_active is None and forecast is not None
+                and forecast.get("nodesPending", 0) > 0
+                and forecast["makespan"]["expectedSeconds"] > 0):
+            calib_active = {
+                "generatedAtSeconds": forecast["generatedAtSeconds"],
+                "nodesPending": forecast["nodesPending"],
+                "expectedSeconds":
+                    forecast["makespan"]["expectedSeconds"],
+                "lowerSeconds": forecast["makespan"]["lowerSeconds"],
+                "upperSeconds": forecast["makespan"]["upperSeconds"],
+                "errorSamples": forecast["makespan"]["errorSamples"]}
+        if calib_active is not None and fleet_done():
+            realized = now - calib_active["generatedAtSeconds"]
+            if realized > 0:
+                calib_active["realizedSeconds"] = round(realized, 1)
+                calib_active["ratio"] = round(
+                    realized / calib_active["expectedSeconds"], 3)
+                calib_samples.append(calib_active)
+                monitor.trace.append(
+                    f"[t={now:g}] preflight calibration: forecast "
+                    f"{calib_active['expectedSeconds']}s "
+                    f"[{calib_active['lowerSeconds']}, "
+                    f"{calib_active['upperSeconds']}] for "
+                    f"{calib_active['nodesPending']} node(s), realized "
+                    f"{calib_active['realizedSeconds']}s "
+                    f"(ratio {calib_active['ratio']})")
+            calib_active = None
+        monitor.drain()
+        if (now > schedule.last_fault_time
+                and not injector.fuse.armed
+                and not injector.fuse.pending
+                and converged()):
+            quiesce_ticks += 1
+            if quiesce_ticks >= 3:
+                is_converged = True
+                break
+        else:
+            quiesce_ticks = 0
+        clock.advance(config.reconcile_interval)
+        cluster.step()
+        monitor.drain()
+
+    if is_converged:
+        monitor.final_check()
+    else:
+        monitor.violations.append(InvariantViolation(
+            invariant="liveness", at=clock.now(), subject="fleet",
+            detail=f"serving fleet did not converge within "
+                   f"{config.max_steps} steps ({clock.now():g}s "
+                   f"virtual) after the last fault healed at "
+                   f"{schedule.last_fault_time:g}s"))
+
+    # -- required-mode hold probe: a THIRD revision under an unmeetable
+    # threshold must admit ZERO nodes while the audited reject stands
+    required_verdict = ""
+    required_admitted = 0
+    probe_ran = False
+    if is_converged and config.required_probe_steps > 0:
+        probe_ran = True
+        required_policy = config.upgrade_policy()
+        required_policy.preflight = PreflightSpec(
+            mode="required",
+            max_forecast_makespan_seconds=(
+                config.required_makespan_threshold),
+            confidence=config.preflight_confidence)
+        cluster.bump_daemon_set_revision(NS, "libtpu", HELD_REVISION)
+        for _ in range(config.required_probe_steps):
+            now = clock.now()
+            op.elector.try_acquire_or_renew()
+            if op.elector.is_leader:
+                try:
+                    op.upgrade.reconcile(NS, dict(RUNTIME_LABELS),
+                                         required_policy)
+                    reconciles += 1
+                except (OperatorCrash, BuildStateError, ApiServerError,
+                        ConflictError, NotFoundError):
+                    pass
+            monitor.drain()
+            serving.tick(now)
+            probe_readonly(op)
+            forecast = op.upgrade.last_preflight
+            if forecast is not None:
+                required_verdict = forecast.get("verdict", "")
+            try:
+                nodes = cluster.list_nodes()
+            except (ApiServerError, TimeoutError):
+                nodes = []
+            required_admitted = max(required_admitted, sum(
+                1 for n in nodes
+                if n.metadata.labels.get(keys.state_label)
+                in in_flight_labels))
+            clock.advance(config.reconcile_interval)
+            cluster.step()
+            monitor.drain()
+        if required_verdict != "reject":
+            monitor.violations.append(InvariantViolation(
+                invariant="preflight-required-gate", at=clock.now(),
+                subject="forecaster",
+                detail=f"required-mode policy with an unmeetable "
+                       f"makespan threshold never rejected (last "
+                       f"verdict {required_verdict!r})"))
+        if required_admitted:
+            monitor.violations.append(InvariantViolation(
+                invariant="preflight-required-gate", at=clock.now(),
+                subject="fleet",
+                detail=f"{required_admitted} node(s) entered an "
+                       f"in-flight state under a standing required-mode "
+                       f"preflight reject — the hold admitted work"))
+
+    harvest(op)
+
+    # -- storm-grade calibration gate ---------------------------------
+    if not calib_samples:
+        monitor.violations.append(InvariantViolation(
+            invariant="preflight-calibration", at=clock.now(),
+            subject="forecaster",
+            detail="no completed rollout produced a forecast-vs-"
+                   "realized sample — the forecaster never saw a "
+                   "pending wave with a warm makespan"))
+    else:
+        # the LAST sample is the one the most-trained predictor made
+        last = calib_samples[-1]
+        slack = config.calibration_slack
+        if not (1.0 / slack <= last["ratio"] <= slack):
+            monitor.violations.append(InvariantViolation(
+                invariant="preflight-calibration", at=clock.now(),
+                subject="forecaster",
+                detail=f"realized makespan {last['realizedSeconds']}s "
+                       f"is {last['ratio']}x the forecast "
+                       f"{last['expectedSeconds']}s — outside the "
+                       f"storm-grade [{1.0 / slack:g}, {slack:g}] "
+                       f"band"))
+
+    # -- harness sanity: the episode must have exercised what it gates
+    if injector.crashes_fired == 0:
+        monitor.violations.append(InvariantViolation(
+            invariant="harness", at=clock.now(), subject="injector",
+            detail="no operator crash fired — the schedule's crash "
+                   "events never detonated"))
+    if accum["forecasts"] == 0:
+        monitor.violations.append(InvariantViolation(
+            invariant="harness", at=clock.now(), subject="forecaster",
+            detail="no preflight forecast was ever computed — the "
+                   "gate never exercised the read-only path"))
+    if monitor.preflight_samples == 0:
+        monitor.violations.append(InvariantViolation(
+            invariant="harness", at=clock.now(), subject="monitor",
+            detail="preflight_sample never ran — the readonly "
+                   "invariant had no evidence feed"))
+
+    monitor.trace.append(
+        f"[t={clock.now():g}] preflight: {accum['forecasts']} "
+        f"forecast(s) ({accum['cacheHits']} cache hit(s), "
+        f"{accum['rejected']} reject(s)), "
+        f"{accum['frozenWriteAttempts']} frozen write attempt(s), "
+        f"{accum['liveMutations']} live mutation(s), "
+        f"{preflight_crashes} crash(es) mid-forecast, "
+        f"{len(calib_samples)} calibration sample(s); serving "
+        f"{serving.summary()}")
+
+    report = ChaosReport(
+        seed=seed,
+        converged=is_converged,
+        violations=list(monitor.violations),
+        fault_kinds=tuple(sorted(schedule.kinds)),
+        crashes_fired=injector.crashes_fired,
+        leader_handovers=handovers,
+        operator_incarnations=incarnations,
+        watch_gaps=monitor.watch_gaps,
+        total_seconds=clock.now(),
+        steps=steps,
+        reconciles=reconciles,
+        trace=list(monitor.trace),
+        decisions_recorded=monitor.decisions_recorded,
+        explains_probed=monitor.explains_probed)
+    report.stats = {
+        "preflight": dict(accum),
+        "preflightCrashes": preflight_crashes,
+        "preflightSamples": monitor.preflight_samples,
+        "calibration": list(calib_samples),
+        "requiredProbe": {
+            "ran": probe_ran,
+            "verdict": required_verdict,
+            "admitted": required_admitted,
+        },
+    }
+    report.report_text = "\n".join(
+        [schedule.describe(),
+         f"preflight: forecasts={accum['forecasts']} "
+         f"cache_hits={accum['cacheHits']} "
+         f"frozen_write_attempts={accum['frozenWriteAttempts']} "
+         f"live_mutations={accum['liveMutations']} "
+         f"crashes_mid_forecast={preflight_crashes} "
+         f"preflight_samples={monitor.preflight_samples} "
+         f"required_probe=({required_verdict or 'n/a'}, "
+         f"admitted={required_admitted})",
+         monitor.report(seed=seed)])
     if not report.ok:
         logger.error("%s", report.report_text)
     return report

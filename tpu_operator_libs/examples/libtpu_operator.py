@@ -80,6 +80,11 @@ latest_status: dict = {}
 #: backing for /explain/<node>.
 explain_binding: dict = {"fn": None}
 
+#: The live manager's preflight forecast, bound by build_manager — the
+#: default backing for /preflight (the what-if picture next to
+#: /explain: what would admitting the pending rollout do?).
+preflight_binding: dict = {"fn": None}
+
 
 def _default_explain(node_name: str) -> dict:
     fn = explain_binding["fn"]
@@ -89,20 +94,39 @@ def _default_explain(node_name: str) -> dict:
     return fn(node_name)
 
 
+def _default_preflight() -> dict:
+    fn = preflight_binding["fn"]
+    if fn is None:
+        return {"error": "operator not started yet — no manager bound"}
+    forecast = fn()
+    if forecast is None:
+        return {"mode": "off",
+                "detail": "no preflight forecast: the policy does not "
+                          "enable preflight (spec.preflight.mode)"}
+    return forecast
+
+
 def serve_metrics(registry: MetricsRegistry, port: int,
                   status_source=None,
-                  explain_source=None) -> ThreadingHTTPServer:
-    """HTTP server for /metrics + /status + /explain/<node>.
-    ``status_source`` is the mutable status mapping to serve (default:
-    this module's ``latest_status``) — passed explicitly so other
-    operators (the unified example) don't have to rebind a
-    cross-module global. ``explain_source`` is ``fn(node_name) ->
-    dict`` (default: the manager bound via ``explain_binding``) — the
-    decision-audit's public query: why is this node not upgrading?"""
+                  explain_source=None,
+                  preflight_source=None) -> ThreadingHTTPServer:
+    """HTTP server for /metrics + /status + /explain/<node> +
+    /preflight. ``status_source`` is the mutable status mapping to
+    serve (default: this module's ``latest_status``) — passed
+    explicitly so other operators (the unified example) don't have to
+    rebind a cross-module global. ``explain_source`` is
+    ``fn(node_name) -> dict`` (default: the manager bound via
+    ``explain_binding``) — the decision-audit's public query: why is
+    this node not upgrading? ``preflight_source`` is ``fn() -> dict``
+    (default: the manager bound via ``preflight_binding``) — the
+    what-if query: the most recent rollout forecast and the verdict
+    the admission gate acted on."""
     if status_source is None:
         status_source = latest_status
     if explain_source is None:
         explain_source = _default_explain
+    if preflight_source is None:
+        preflight_source = _default_preflight
 
     class Handler(BaseHTTPRequestHandler):
         def do_GET(self):  # noqa: N802 - stdlib API
@@ -115,6 +139,14 @@ def serve_metrics(registry: MetricsRegistry, port: int,
                 # shallow copy: the reconcile thread inserts keys
                 # concurrently and dict iteration must not race it
                 body = _json.dumps(dict(status_source), indent=2).encode()
+                content_type = "application/json"
+            elif self.path == "/preflight":
+                try:
+                    result = preflight_source()
+                except Exception as exc:  # noqa: BLE001 — the debug
+                    # surface must answer, not 500, mid-incident
+                    result = {"error": str(exc)}
+                body = _json.dumps(result, indent=2).encode()
                 content_type = "application/json"
             elif self.path.startswith("/explain/"):
                 from urllib.parse import unquote
@@ -142,7 +174,8 @@ def serve_metrics(registry: MetricsRegistry, port: int,
     server = ThreadingHTTPServer(("", port), Handler)
     threading.Thread(target=server.serve_forever, daemon=True).start()
     logger.info("metrics on :%d/metrics, status on :%d/status, "
-                "explain on :%d/explain/<node>", port, port, port)
+                "explain on :%d/explain/<node>, preflight on "
+                ":%d/preflight", port, port, port, port)
     return server
 
 
@@ -171,6 +204,7 @@ def build_manager(args, cluster, clock=None,
     mgr.with_observability(OperatorObservability(
         keys, clock=clock or Clock()))
     explain_binding["fn"] = mgr.explain
+    preflight_binding["fn"] = lambda: mgr.last_preflight
     if args.job_selector:
         gate = None
         if args.checkpoint_dir:

@@ -65,6 +65,13 @@ logger = logging.getLogger(__name__)
 
 #: Transients a federation pass rides out per region (the region is
 #: simply skipped this pass and re-probed next pass).
+#: Per-node duration assumed by the region-admission preflight when
+#: sizing a region's rollout horizon — the duration predictor's cold
+#: prior (upgrade/predictor.py ``prior_seconds``); the federation layer
+#: has no per-node model, so the forecast uses the same documented
+#: cold-start estimate the node-level planner falls back to.
+REGION_NODE_PRIOR_SECONDS = 120.0
+
 _TRANSIENTS = (ApiServerError, ConflictError, NotFoundError,
                TimeoutError)
 
@@ -188,6 +195,13 @@ class FederationController:
         self.partitioned_reads_total = 0
         self.passes_total = 0
         self.last_status: "Optional[dict]" = None
+        #: region -> most recent admission-preflight forecast (empty
+        #: while the policy has no preflight) — the status /
+        #: explain_region feed and the admission gate's evidence.
+        self.last_preflight: "dict[str, dict]" = {}
+        #: lifetime region admissions deferred by a required-mode
+        #: preflight breach (metrics/chaos teeth).
+        self.preflight_rejections_total = 0
 
     # ------------------------------------------------------------------
     # region reads
@@ -325,6 +339,16 @@ class FederationController:
                         view.utilization = None  # must not wedge a pass
         self._last_views = views
         self._last_target = target_revision
+        # region-admission preflight: forecast every region's rollout
+        # against its live traffic signal BEFORE any admission (and
+        # before any budget share is stamped); _admit consults the
+        # verdicts below
+        self.last_preflight = {}
+        if policy.preflight is not None and policy.preflight.enabled:
+            for name in fleet:
+                forecast = self._forecast_region(views[name], now)
+                if forecast is not None:
+                    self.last_preflight[name] = forecast
         canary = self._canary_region(views)
 
         quarantined: set[str] = set()
@@ -364,6 +388,7 @@ class FederationController:
                     "share": view.share,
                     "utilization": view.utilization,
                     "capacity": view.capacity,
+                    "preflight": self.last_preflight.get(name),
                     "phase": self._phase(view, canary,
                                          target_revision, halted,
                                          baked),
@@ -475,6 +500,98 @@ class FederationController:
         return self.policy.bake_seconds <= 0, now
 
     # ------------------------------------------------------------------
+    # region-admission preflight (upgrade/preflight.py at region grain)
+    # ------------------------------------------------------------------
+    def _forecast_region(self, view: RegionView,
+                         now: float) -> "Optional[dict]":
+        """What-if forecast for admitting this region now, from reads
+        the pass already made (no extra cluster traffic — the
+        federation-side read-only guarantee is structural).
+
+        Horizon: the whole region rolled one budget-share-wide wave at
+        a time at the predictor's documented per-node prior. Risk: the
+        peak of the region's live utilization signal across that
+        horizon against the serving capacity left while a share of the
+        fleet is held out — the same shortfall fraction the node-level
+        replay computes."""
+        spec = self.policy.preflight
+        if spec is None or not spec.enabled:
+            return None
+        name = view.name
+        total = view.total if view.reachable \
+            else self._region_totals.get(name, 0)
+        if total <= 0:
+            return None
+        share = view.share or max(1, scaled_value_from_int_or_percent(
+            self.policy.global_max_unavailable, total, round_up=True))
+        share = min(share, total)
+        waves = -(-total // share)
+        horizon = REGION_NODE_PRIOR_SECONDS * waves
+        avail = 1.0 - share / total
+        handle = self.regions[name]
+        peak = view.utilization if view.utilization is not None else 0.0
+        signal = handle.utilization
+        if signal is not None:
+            step = horizon / 16
+            for i in range(17):
+                try:
+                    peak = max(peak, min(1.0, max(
+                        0.0, float(signal(now + i * step)))))
+                except Exception:  # noqa: BLE001 — a broken signal
+                    break  # must not wedge the pass
+        risk = round(max(0.0, peak - avail) / peak, 4) if peak > 0 \
+            else 0.0
+        breaches: list[str] = []
+        if spec.max_forecast_makespan_seconds > 0 \
+                and horizon > spec.max_forecast_makespan_seconds:
+            breaches.append("makespan")
+        if risk > spec.max_forecast_slo_risk_fraction:
+            breaches.append("slo-risk")
+        if not breaches:
+            verdict = "admit"
+        elif spec.mode == "required":
+            verdict = "reject"
+        else:
+            verdict = "advisory-breach"
+        return {
+            "mode": spec.mode,
+            "generatedAtSeconds": round(now, 1),
+            "horizonSeconds": round(horizon, 1),
+            "waves": waves,
+            "shareAssumed": share,
+            "peakUtilization": round(peak, 4),
+            "sloRiskFraction": risk,
+            "thresholds": {
+                "maxForecastSloRiskFraction":
+                    spec.max_forecast_slo_risk_fraction,
+                "maxForecastMakespanSeconds":
+                    spec.max_forecast_makespan_seconds,
+            },
+            "breaches": breaches,
+            "verdict": verdict,
+        }
+
+    def _preflight_defers(self, region: str) -> bool:
+        """True when a required-mode forecast breach defers this
+        region's admission this pass (audited; the region stays out of
+        ``admitted`` so :meth:`_maintain_shares` stamps it no share)."""
+        forecast = self.last_preflight.get(region)
+        if forecast is None or forecast["verdict"] != "reject":
+            return False
+        self.preflight_rejections_total += 1
+        self.audit.record_hold(
+            region, rule="preflight-rejected",
+            inputs={"breaches": ",".join(forecast["breaches"]),
+                    "sloRiskFraction": forecast["sloRiskFraction"],
+                    "horizonSeconds": forecast["horizonSeconds"]})
+        logger.info(
+            "federation preflight deferred region %s: %s (risk %.3f "
+            "over %.0fs horizon)", region,
+            ",".join(forecast["breaches"]),
+            forecast["sloRiskFraction"], forecast["horizonSeconds"])
+        return True
+
+    # ------------------------------------------------------------------
     # admissions (canary first, then follow-the-sun waves)
     # ------------------------------------------------------------------
     def _admit(self, views: "dict[str, RegionView]", canary: str,
@@ -484,7 +601,8 @@ class FederationController:
         if canary_view is not None and canary_view.reachable \
                 and canary_view.ds_found \
                 and canary_view.newest != target \
-                and target not in canary_view.quarantined:
+                and target not in canary_view.quarantined \
+                and not self._preflight_defers(canary):
             if self._roll(canary, target, rule="canary-region"):
                 admitted.append(canary)
         if not baked:
@@ -522,6 +640,8 @@ class FederationController:
                     inputs={"utilization": views[name].utilization,
                             "troughUtilization":
                             self.policy.trough_utilization})
+                continue
+            if self._preflight_defers(name):
                 continue
             if self._roll(name, target, rule="follow-the-sun"):
                 admitted.append(name)
@@ -706,6 +826,16 @@ class FederationController:
                 chain.append(f"upgrading under a budget share of "
                              f"{view.share or 0} node(s)")
         else:
+            forecast = self.last_preflight.get(region)
+            if forecast is not None \
+                    and forecast["verdict"] == "reject":
+                chain.append(
+                    f"preflight rejected the region admission "
+                    f"({', '.join(forecast['breaches'])}): forecast "
+                    f"SLO risk {forecast['sloRiskFraction']:g} over a "
+                    f"{forecast['horizonSeconds']:.0f}s horizon — no "
+                    f"roll and no budget-share stamp until the "
+                    f"forecast clears")
             if region != canary and not status.get("baked"):
                 chain.append(f"held behind the canary region "
                              f"{canary!r}: the target revision lacks "

@@ -71,7 +71,17 @@ from tpu_operator_libs.k8s.watch import (
     Watch,
     WatchBroadcaster,
 )
-from tpu_operator_libs.util import Clock
+from tpu_operator_libs.util import Clock, FakeClock
+
+
+class FrozenClusterError(RuntimeError):
+    """A mutating call reached a frozen (read-only) FakeCluster.
+
+    Deliberately NOT an :class:`ApiServerError` subclass: transient
+    apiserver errors are retried/absorbed by the reconcile machinery,
+    but a write against a preflight clone is a logic bug that must
+    fail loudly, never be silently retried away.
+    """
 
 
 @dataclass
@@ -156,6 +166,63 @@ class FakeCluster(K8sClient):
         self._watch_delay_seed = 0
         #: Events released from delay buffers (observability/tests).
         self.watch_delay_released = 0
+        # Freeze tripwire (preflight read-only clones): while set, every
+        # mutating entry point raises FrozenClusterError AND increments
+        # the attempt counter — the counter is the invariant monitor's
+        # evidence that a forecast pass tried to write.
+        self._frozen: Optional[str] = None
+        #: Mutating calls rejected while frozen (tripwire evidence).
+        self.frozen_write_attempts = 0
+
+    def freeze(self, reason: str = "preflight") -> None:
+        """Flip the store read-only: every subsequent mutating call —
+        API writes AND test/sim helpers alike — raises
+        :class:`FrozenClusterError` and increments
+        :attr:`frozen_write_attempts`. There is deliberately no thaw:
+        a preflight clone stays frozen for its whole life, so a zero
+        counter at the end of a forecast proves computational purity."""
+        with self._lock:
+            self._frozen = reason
+
+    @property
+    def frozen(self) -> bool:
+        return self._frozen is not None
+
+    def _check_frozen(self, operation: str) -> None:
+        with self._lock:
+            if self._frozen is None:
+                return
+            self.frozen_write_attempts += 1
+            reason = self._frozen
+        raise FrozenClusterError(
+            f"{operation} rejected: cluster is frozen ({reason}) — "
+            f"preflight clones are read-only")
+
+    def snapshot(self, frozen: bool = True) -> "FakeCluster":
+        """Deep-copy the object store into an independent FakeCluster
+        pinned at the current virtual time. Scheduled actions,
+        controller sims, fault state, watch subscribers, and call
+        counters do NOT carry over — the clone is a pure picture of
+        cluster state, frozen by default (the preflight substrate)."""
+        import copy
+
+        with self._lock:
+            clone = FakeCluster(clock=FakeClock(start=self._clock.now()))
+            clone._nodes = {k: v.clone() for k, v in self._nodes.items()}
+            for pod in self._pods.values():
+                clone._pod_put(pod.clone())
+            clone._daemon_sets = {
+                k: v.clone() for k, v in self._daemon_sets.items()}
+            clone._revisions = {
+                k: v.clone() for k, v in self._revisions.items()}
+            clone._revision_owner = dict(self._revision_owner)
+            clone._pdbs = {k: v.clone() for k, v in self._pdbs.items()}
+            clone._leases = {k: v.clone() for k, v in self._leases.items()}
+            clone._cluster_events = {
+                k: copy.copy(v) for k, v in self._cluster_events.items()}
+        if frozen:
+            clone.freeze()
+        return clone
 
     def watch(self, kinds: Optional[set[str]] = None,
               namespace: Optional[str] = None,
@@ -255,6 +322,7 @@ class FakeCluster(K8sClient):
         return self._clock
 
     def add_node(self, node: Node) -> Node:
+        self._check_frozen("add_node")
         with self._lock:
             self._nodes[node.metadata.name] = node.clone()
             self._notify(ADDED, KIND_NODE, node)
@@ -270,6 +338,7 @@ class FakeCluster(K8sClient):
         — exactly the window the state machine's vanished-node skip
         covers.
         """
+        self._check_frozen("delete_node")
         with self._lock:
             node = self._nodes.pop(name, None)
             if node is None:
@@ -324,6 +393,7 @@ class FakeCluster(K8sClient):
         return pod
 
     def add_pod(self, pod: Pod) -> Pod:
+        self._check_frozen("add_pod")
         with self._lock:
             self._pod_put(pod.clone())
             self._notify(ADDED, KIND_POD, pod)
@@ -347,6 +417,7 @@ class FakeCluster(K8sClient):
         The revision object is named ``<ds-name>-<hash>`` so the hash can be
         recovered as the name suffix (pod_manager.go:118-119).
         """
+        self._check_frozen("add_daemon_set")
         self._check_revision_hash(revision_hash)
         with self._lock:
             self._daemon_sets[(ds.metadata.namespace, ds.metadata.name)] = (
@@ -373,6 +444,7 @@ class FakeCluster(K8sClient):
         """Adjust a DaemonSet's desired count (scale-up/down events in
         tests — the real DS controller recomputes this from the node
         list)."""
+        self._check_frozen("set_daemon_set_desired")
         with self._lock:
             ds = self._daemon_sets.get((namespace, name))
             if ds is None:
@@ -388,6 +460,7 @@ class FakeCluster(K8sClient):
         are therefore out of sync — the trigger condition for an upgrade
         (upgrade_state.go:558-578).
         """
+        self._check_frozen("bump_daemon_set_revision")
         self._check_revision_hash(revision_hash)
         with self._lock:
             ds = self._daemon_sets.get((namespace, name))
@@ -418,6 +491,7 @@ class FakeCluster(K8sClient):
         paths are testable without hand-building revision objects.
         Existing revisions are re-numbered upward to make room; their
         relative order (and therefore the newest hash) is unchanged."""
+        self._check_frozen("seed_revision_history")
         for revision_hash in hashes:
             self._check_revision_hash(revision_hash)
         with self._lock:
@@ -446,6 +520,7 @@ class FakeCluster(K8sClient):
         revision is re-numbered newest; subsequent DS-controller pod
         recreations carry its hash). Works backward or forward across
         the seeded history. No-op when the hash is already newest."""
+        self._check_frozen("rollback_daemon_set")
         self._maybe_api_error("rollback_daemon_set")
         with self._lock:
             ds = self._daemon_sets.get((namespace, name))
@@ -468,6 +543,7 @@ class FakeCluster(K8sClient):
     def patch_daemon_set_annotations(
             self, namespace: str, name: str,
             annotations: Mapping[str, Optional[str]]) -> DaemonSet:
+        self._check_frozen("patch_daemon_set_annotations")
         self._maybe_api_error("patch_daemon_set_annotations")
         with self._lock:
             ds = self._daemon_sets.get((namespace, name))
@@ -491,6 +567,7 @@ class FakeCluster(K8sClient):
         When a NODE is deleted, its DaemonSets' desired counts drop
         immediately (the real DS controller reacts to the node list) and
         the node's pods are garbage-collected after ``pod_gc_delay``."""
+        self._check_frozen("enable_ds_controller")
         with self._lock:
             self._ds_controller = _DsControllerConfig(
                 recreate_delay=recreate_delay, ready_delay=ready_delay,
@@ -501,11 +578,13 @@ class FakeCluster(K8sClient):
         """Per-node ``(recreate_delay, ready_delay)`` override for the DS
         controller sim; ``fn(node_name)`` wins over the global delays.
         Models heterogeneous hosts and stragglers."""
+        self._check_frozen("set_per_node_ds_delays")
         with self._lock:
             self._ds_delay_fn = fn
 
     def add_eviction_blocker(self, blocker: Callable[[Pod], bool]) -> None:
         """Register a predicate that vetoes evictions (PDB analogue)."""
+        self._check_frozen("add_eviction_blocker")
         with self._lock:
             self._eviction_blockers.append(blocker)
 
@@ -514,6 +593,7 @@ class FakeCluster(K8sClient):
         ``gate(pod)`` returns True; until then they crash-loop (not ready,
         restart count above the failure threshold). Replaces any gate
         already installed; use :meth:`add_pod_ready_gate` to compose."""
+        self._check_frozen("set_pod_ready_gate")
         with self._lock:
             self._pod_ready_gate = gate
 
@@ -523,6 +603,7 @@ class FakeCluster(K8sClient):
         gate approves. Lets independent fault sources (a FleetSpec
         crashloop window and a chaos injector, say) coexist without
         silently replacing each other."""
+        self._check_frozen("add_pod_ready_gate")
         with self._lock:
             existing = self._pod_ready_gate
             if existing is None:
@@ -556,6 +637,7 @@ class FakeCluster(K8sClient):
         (build_state's completeness guard requires desired == scheduled).
         The spare-pool seeding path for reconfiguration tests: label the
         node as a spare and this wires everything else."""
+        self._check_frozen("seed_node_with_ds_pod")
         with self._lock:
             ds = self._daemon_sets.get((ds_namespace, ds_name))
             if ds is None:
@@ -591,6 +673,7 @@ class FakeCluster(K8sClient):
         :class:`ApiServerError` (or ``exc_factory()``). Each call sets the
         factory for the whole outstanding budget — passing None restores
         the default ApiServerError."""
+        self._check_frozen("inject_api_errors")
         with self._lock:
             self._api_errors[operation] = (
                 self._api_errors.get(operation, 0) + count)
@@ -635,6 +718,7 @@ class FakeCluster(K8sClient):
         (pre-future-patch) snapshot, emulating controller-runtime cache lag
         that the provider's poll loop exists to absorb
         (node_upgrade_state_provider.go:92-99)."""
+        self._check_frozen("inject_stale_node_reads")
         if reads <= 0:
             return
         with self._lock:
@@ -677,6 +761,7 @@ class FakeCluster(K8sClient):
         """Public scheduler hook: run ``action`` once the virtual clock
         reaches ``due`` and :meth:`step` is called. Used by fault
         injection (tpu_operator_libs.simulate) and available to tests."""
+        self._check_frozen("schedule_at")
         with self._lock:
             self._seq += 1
             heapq.heappush(self._scheduled,
@@ -718,6 +803,7 @@ class FakeCluster(K8sClient):
 
     def patch_node_labels(self, name: str,
                           labels: Mapping[str, Optional[str]]) -> Node:
+        self._check_frozen("patch_node_labels")
         self._maybe_api_error("patch_node_labels")
         with self._lock:
             node = self._mutate_node(name)
@@ -731,6 +817,7 @@ class FakeCluster(K8sClient):
 
     def patch_node_annotations(self, name: str,
                                annotations: Mapping[str, Optional[str]]) -> Node:
+        self._check_frozen("patch_node_annotations")
         self._maybe_api_error("patch_node_annotations")
         with self._lock:
             node = self._mutate_node(name)
@@ -751,6 +838,7 @@ class FakeCluster(K8sClient):
         injected-error budgets as the split patches so fault schedules
         targeting patch_node_labels / patch_node_annotations still bite
         coalesced writers."""
+        self._check_frozen("patch_node_meta")
         with self._lock:
             # one wire request, one count (the split ops' injected-error
             # budgets are still consumed below)
@@ -776,6 +864,7 @@ class FakeCluster(K8sClient):
             return node.clone()
 
     def set_node_unschedulable(self, name: str, unschedulable: bool) -> Node:
+        self._check_frozen("set_node_unschedulable")
         self._maybe_api_error("set_node_unschedulable")
         with self._lock:
             node = self._mutate_node(name)
@@ -785,6 +874,7 @@ class FakeCluster(K8sClient):
 
     def set_node_ready(self, name: str, ready: bool) -> Node:
         """Test helper: flip the node Ready condition."""
+        self._check_frozen("set_node_ready")
         with self._lock:
             node = self._mutate_node(name)
             for cond in node.status.conditions:
@@ -803,6 +893,7 @@ class FakeCluster(K8sClient):
         """Fault injection: schedule a NotReady flap — the node's Ready
         condition flips False at ``down_at`` and back True at ``up_at``
         (virtual seconds, fired by :meth:`step`)."""
+        self._check_frozen("flap_node_ready")
         if up_at <= down_at:
             raise ValueError("up_at must be after down_at")
         self.schedule_at(down_at, lambda: self.set_node_ready(name, False))
@@ -813,6 +904,7 @@ class FakeCluster(K8sClient):
         """Test helper: set an arbitrary node condition (the
         node-problem-detector seam the remediation wedge detectors
         watch, e.g. ``TpuHealthy=False``)."""
+        self._check_frozen("set_node_condition")
         with self._lock:
             node = self._mutate_node(name)
             for cond in node.status.conditions:
@@ -876,6 +968,7 @@ class FakeCluster(K8sClient):
         """Test helper: status subresource update (the builders in the
         reference suite force Running+Ready the same way,
         upgrade_suit_test.go:311-329)."""
+        self._check_frozen("set_pod_status")
         with self._lock:
             pod = self._pods.get((namespace, name))
             if pod is None:
@@ -897,6 +990,7 @@ class FakeCluster(K8sClient):
             return pod.clone()
 
     def delete_pod(self, namespace: str, name: str) -> None:
+        self._check_frozen("delete_pod")
         self._maybe_api_error("delete_pod")
         with self._lock:
             pod = self._pod_pop((namespace, name))
@@ -906,6 +1000,7 @@ class FakeCluster(K8sClient):
             self._maybe_recreate_ds_pod(pod)
 
     def evict_pod(self, namespace: str, name: str) -> None:
+        self._check_frozen("evict_pod")
         self._maybe_api_error("evict_pod")
         with self._lock:
             pod = self._pods.get((namespace, name))
@@ -929,6 +1024,7 @@ class FakeCluster(K8sClient):
         """Install a PDB; subsequent evictions of selector-matching pods
         in its namespace are admitted only while disruptionsAllowed > 0,
         exactly the apiserver check that surfaces as HTTP 429."""
+        self._check_frozen("add_pod_disruption_budget")
         with self._lock:
             self._pdbs[(pdb.metadata.namespace, pdb.metadata.name)] = \
                 pdb.clone()
@@ -936,6 +1032,7 @@ class FakeCluster(K8sClient):
 
     def delete_pod_disruption_budget(self, namespace: str,
                                      name: str) -> None:
+        self._check_frozen("delete_pod_disruption_budget")
         with self._lock:
             if self._pdbs.pop((namespace, name), None) is None:
                 raise NotFoundError(
@@ -1160,6 +1257,7 @@ class FakeCluster(K8sClient):
     def create_event(self, namespace: str, name: str,
                      event: object) -> None:
         """POST semantics: raises AlreadyExistsError on a name clash."""
+        self._check_frozen("create_event")
         self._maybe_api_error("create_event")
         import copy
 
@@ -1174,6 +1272,7 @@ class FakeCluster(K8sClient):
                     event: object) -> None:
         """PATCH semantics: refresh count/message/lastTimestamp of an
         existing Event; raises NotFoundError when absent."""
+        self._check_frozen("patch_event")
         self._maybe_api_error("patch_event")
         with self._lock:
             stored = self._cluster_events.get((namespace, name))
@@ -1209,6 +1308,7 @@ class FakeCluster(K8sClient):
             return lease.clone()
 
     def create_lease(self, lease: Lease) -> Lease:
+        self._check_frozen("create_lease")
         key = (lease.metadata.namespace, lease.metadata.name)
         with self._lock:
             if key in self._leases:
@@ -1227,6 +1327,7 @@ class FakeCluster(K8sClient):
         during a partition it could not see. Creates the lease when
         absent. The victim's next renew hits a ConflictError (its
         resourceVersion is stale) and it steps down."""
+        self._check_frozen("steal_lease")
         with self._lock:
             stored = self._leases.get((namespace, name))
             now = self._clock.now()
@@ -1252,6 +1353,7 @@ class FakeCluster(K8sClient):
         resourceVersion must match the stored one or ConflictError is
         raised — exactly the apiserver contract leader election's
         acquire race depends on."""
+        self._check_frozen("update_lease")
         key = (lease.metadata.namespace, lease.metadata.name)
         with self._lock:
             stored = self._leases.get(key)

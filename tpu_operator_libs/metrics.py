@@ -1414,6 +1414,87 @@ def observe_policy(registry: MetricsRegistry,
         labels)
 
 
+def observe_preflight(registry: MetricsRegistry,
+                      manager: "ClusterUpgradeStateManager",
+                      driver: str = "libtpu") -> None:
+    """Export the rollout-preflight forecaster's evidence.
+
+    No-op until a policy carrying ``preflight`` (mode ``advisory`` or
+    ``required``) has run. Two layers:
+
+    - lifetime counters — forecasts computed vs served from cache,
+      required-mode rejections and advisory breaches, and the two
+      read-only-guarantee tripwires
+      (``preflight_frozen_write_attempts_total`` /
+      ``preflight_live_mutations_total`` — EITHER moving is a bug, the
+      ``preflight-readonly`` chaos invariant red-flags it);
+    - the latest forecast — makespan with its confidence bounds
+      (``preflight_makespan_seconds{bound=expected|lower|upper}``),
+      per-traffic-class SLO risk, expected side effects
+      (``preflight_expected_events{kind=...}``), the pending/slot
+      picture and ``preflight_rejected`` (1 while the admission gate
+      is parking the rollout).
+    """
+    forecaster = getattr(manager, "preflight", None)
+    if forecaster is None:
+        return
+    labels = {"driver": driver}
+    registry.set_counter_total(
+        "preflight_forecasts_total", forecaster.forecasts_total,
+        "What-if forecasts computed (cache misses)", labels)
+    registry.set_counter_total(
+        "preflight_cache_hits_total", forecaster.cache_hits_total,
+        "Forecasts served from the single-entry cache", labels)
+    registry.set_counter_total(
+        "preflight_rejections_total", forecaster.rejected_total,
+        "Required-mode forecasts that parked the rollout", labels)
+    registry.set_counter_total(
+        "preflight_advisory_breaches_total", forecaster.advisory_total,
+        "Advisory-mode forecasts that breached a threshold", labels)
+    registry.set_counter_total(
+        "preflight_frozen_write_attempts_total",
+        forecaster.frozen_write_attempts_total,
+        "Write attempts rejected by the frozen forecast clone (any "
+        "nonzero is a read-only-guarantee violation)", labels)
+    registry.set_counter_total(
+        "preflight_live_mutations_total",
+        forecaster.live_mutations_total,
+        "Live-cluster mutations observed during a forecast (any "
+        "nonzero is a read-only-guarantee violation)", labels)
+    forecast = forecaster.last_forecast
+    if forecast is None:
+        return
+    makespan = forecast.get("makespan", {})
+    for bound in ("expected", "lower", "upper"):
+        registry.set_gauge(
+            "preflight_makespan_seconds",
+            makespan.get(f"{bound}Seconds", 0.0),
+            "Latest forecast rollout makespan with confidence bounds",
+            {**labels, "bound": bound})
+    registry.set_gauge(
+        "preflight_nodes_pending", forecast.get("nodesPending", 0),
+        "Pending nodes the latest forecast replayed", labels)
+    registry.set_gauge(
+        "preflight_slots", forecast.get("slots", 0),
+        "Admission slots the latest forecast assumed", labels)
+    for kind, count in sorted(forecast.get("expected", {}).items()):
+        registry.set_gauge(
+            "preflight_expected_events", count,
+            "Forecast side effects (holds / windowDeferrals / aborts "
+            "/ pausedTicks)", {**labels, "kind": kind})
+    for cls, fraction in sorted(
+            forecast.get("sloRisk", {}).get("classes", {}).items()):
+        registry.set_gauge(
+            "preflight_slo_risk_fraction", fraction,
+            "Forecast per-traffic-class SLO-shortfall risk",
+            {**labels, "class": cls})
+    registry.set_gauge(
+        "preflight_rejected",
+        1.0 if forecast.get("verdict") == "reject" else 0.0,
+        "1 while the latest required-mode forecast parks the rollout",
+        labels)
+
+
 def observe_federation(registry: MetricsRegistry,
                        controller: "object",
                        driver: str = "libtpu") -> None:

@@ -63,6 +63,21 @@ class PooledHistogram:
         return quantile_from_buckets(self.buckets, self.counts,
                                      self.count, q)
 
+    def confidence_interval(self, q: float) -> "Optional[tuple[float, float]]":
+        """Central ``q``-interval ``(lower, upper)`` of the pooled
+        samples — the quantile pair ``((1-q)/2, (1+q)/2)``. None while
+        the pool is empty (callers choose their own cold-start spread
+        rather than inheriting a fabricated one)."""
+        if not 0.0 < q < 1.0:
+            raise ValueError("q must be in (0, 1)")
+        if not self.count:
+            return None
+        lower = self.quantile((1.0 - q) / 2.0)
+        upper = self.quantile((1.0 + q) / 2.0)
+        if lower is None or upper is None:
+            return None
+        return lower, upper
+
     @property
     def mean(self) -> Optional[float]:
         return self.total / self.count if self.count else None

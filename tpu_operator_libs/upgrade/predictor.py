@@ -103,6 +103,18 @@ PHASE_SECONDS_BUCKETS: tuple[float, ...] = (
     1.0, 2.5, 5.0, 10.0, 20.0, 30.0, 45.0, 60.0, 90.0, 120.0, 180.0,
     300.0, 600.0, 1200.0, 1800.0, 3600.0, 7200.0)
 
+#: Forecast-error-ratio buckets (|predicted-actual|/actual): sub-percent
+#: through 5x — a warm model lands in the low buckets, a cold or
+#: drifting one in the tail.
+ERROR_RATIO_BUCKETS: tuple[float, ...] = (
+    0.01, 0.02, 0.05, 0.1, 0.15, 0.2, 0.3, 0.5, 0.75, 1.0, 1.5, 2.0,
+    3.0, 5.0)
+
+#: Relative half-width assumed for confidence bounds while ZERO
+#: forecasts have closed: deliberately wide (±50%) so a cold preflight
+#: reports honest uncertainty instead of fabricated precision.
+COLD_START_ERROR_RATIO = 0.5
+
 
 class PhaseDurationPredictor:
     """Online per-node / per-phase upgrade-duration model.
@@ -155,6 +167,11 @@ class PhaseDurationPredictor:
         self._sample_buffer: list[tuple[str, float]] = []
         #: |predicted - actual| / actual ratios since the last drain.
         self._error_buffer: list[float] = []
+        #: RETAINED forecast-error-ratio pool (the drain buffer above
+        #: only feeds metrics and empties): confidence bounds read the
+        #: model's own lifetime error here — bounds widen as observed
+        #: error grows instead of being invented.
+        self._error_hist = PooledHistogram(ERROR_RATIO_BUCKETS)
         #: lifetime accounting
         self.samples_total = 0
         self.forecasts_closed_total = 0
@@ -233,8 +250,9 @@ class PhaseDurationPredictor:
             t0, predicted = opened
             actual = now - t0
             if actual > 0.0:
-                self._error_buffer.append(
-                    abs(predicted - actual) / actual)
+                ratio = abs(predicted - actual) / actual
+                self._error_buffer.append(ratio)
+                self._error_hist.record(ratio)
                 self.forecasts_closed_total += 1
 
     # ------------------------------------------------------------------
@@ -275,6 +293,42 @@ class PhaseDurationPredictor:
         return sum(
             self.predict_phase(name, phase, annotations, conservative)
             for phase in PHASES)
+
+    def error_ratio(self, q: float = 0.9) -> float:
+        """The model's observed |predicted-actual|/actual forecast-error
+        ratio at quantile ``q``, from the RETAINED error pool (closed
+        whole-node forecasts). Cold start — zero closed forecasts —
+        returns :data:`COLD_START_ERROR_RATIO`: honest, wide
+        uncertainty instead of fabricated precision."""
+        with self._lock:
+            if self._error_hist.count:
+                estimate = self._error_hist.quantile(q)
+                if estimate is not None:
+                    return estimate
+        return COLD_START_ERROR_RATIO
+
+    @property
+    def error_samples(self) -> int:
+        """Closed forecasts retained in the error pool."""
+        with self._lock:
+            return self._error_hist.count
+
+    def confidence_interval(self, phase: "Optional[str]" = None,
+                            q: float = 0.9) -> "tuple[float, float]":
+        """``(lower, upper)`` seconds bound for a fleet-typical node's
+        ``phase`` (whole flow when None), widened multiplicatively by
+        the model's own observed forecast error at quantile ``q`` —
+        the consumer of the forecast-error histogram that was
+        previously recorded and then only drained to metrics. Bounds
+        WIDEN as observed error grows; a warm, accurate model tightens
+        them."""
+        phases = (phase,) if phase is not None else PHASES
+        for p in phases:
+            if p not in PHASES:
+                raise ValueError(f"unknown phase {p!r}")
+        base = sum(self.predict_phase("", p) for p in phases)
+        ratio = self.error_ratio(q)
+        return max(0.0, base * (1.0 - ratio)), base * (1.0 + ratio)
 
     def remaining_seconds(self, name: str, state_label: str,
                           annotations: "Optional[dict[str, str]]" = None,

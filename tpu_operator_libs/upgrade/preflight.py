@@ -1,0 +1,582 @@
+"""Rollout preflight: what-if forecasting that gates admission.
+
+Before the throttle spends slot one of a rollout, the
+:class:`PreflightForecaster` answers "what would this rollout do to the
+fleet if admitted NOW?" — entirely in-process, entirely read-only:
+
+* the live cluster picture is cloned into a **frozen**
+  :class:`~tpu_operator_libs.k8s.fake.FakeCluster` snapshot
+  (``snapshot``/``freeze`` — every mutating call on the clone raises
+  :class:`~tpu_operator_libs.k8s.fake.FrozenClusterError` and bumps a
+  tripwire counter), so the forecast provably cannot write;
+* the proposed wave is replayed ANALYTICALLY against the learned
+  :class:`~tpu_operator_libs.upgrade.predictor.PhaseDurationPredictor`
+  — the same LPT multiprocessor packing the predictive planner's
+  ``_eta`` uses — yielding an expected makespan with confidence bounds
+  from the predictor's retained forecast-error histogram;
+* the capacity/traffic picture (live controller status, or a diurnal
+  trace in soaks/benches) is swept across the forecast horizon for
+  per-traffic-class SLO risk, expected mid-flight aborts and
+  peak-pause ticks;
+* the declarative policy hooks (``planner.admission`` /
+  ``window.gate``) are evaluated against a FRESH
+  :class:`~tpu_operator_libs.policy.engine.PolicyEngine` — forecast
+  holds are counted without polluting the live engine's pass state;
+* the maintenance window is applied with the conservative bound, so
+  forecast window deferrals match what the planner would actually do.
+
+The forecast is a plain JSON-able dict; ``verdict`` is the admission
+gate: a ``required``-mode policy whose forecast breaches
+``maxForecastSloRiskFraction`` or ``maxForecastMakespanSeconds`` parks
+the rollout (zero slots spent) under an audited ``preflight-rejected``
+rule until the picture improves. ``advisory`` mode records the breach
+and admits anyway; ``off`` never builds a forecaster.
+
+Crash safety is structural: the forecast path owns no durable state
+and writes nothing, so an operator crash mid-forecast (the optional
+``guard`` hook is the chaos harness's crash-fuse seam) leaves ZERO
+residue — the next incarnation re-derives the identical forecast from
+the same snapshot inputs.
+"""
+
+from __future__ import annotations
+
+import logging
+from typing import TYPE_CHECKING, Callable, Optional
+
+from tpu_operator_libs.consts import IN_PROGRESS_STATES, UpgradeState
+from tpu_operator_libs.util import Clock
+
+if TYPE_CHECKING:  # pragma: no cover - annotation-only imports
+    from tpu_operator_libs.api.upgrade_policy import (
+        PreflightSpec,
+        UpgradePolicySpec,
+    )
+    from tpu_operator_libs.upgrade.capacity import CapacityBudgetController
+    from tpu_operator_libs.upgrade.predictor import PhaseDurationPredictor
+    from tpu_operator_libs.upgrade.state_manager import ClusterUpgradeState
+
+logger = logging.getLogger(__name__)
+
+#: FakeCluster operations that mutate apiserver state — the live-side
+#: evidence set: a preflight pass diffs the LIVE cluster's per-op call
+#: counts over these before/after forecasting, and any delta is a
+#: read-only-guarantee violation (the frozen-clone tripwire covers the
+#: clone side; this covers "the forecaster wrote around the clone").
+MUTATING_OPS = frozenset((
+    "patch_node_labels", "patch_node_annotations", "patch_node_meta",
+    "set_node_unschedulable", "delete_pod", "evict_pod",
+    "create_event", "patch_event", "rollback_daemon_set",
+    "patch_daemon_set_annotations",
+))
+
+#: Ticks swept across the forecast horizon for the SLO-risk replay —
+#: fixed so the forecast is deterministic in its inputs (no wall-clock
+#: dependent step sizing).
+REPLAY_TICKS = 64
+
+VERDICT_ADMIT = "admit"
+VERDICT_ADVISORY = "advisory-breach"
+VERDICT_REJECT = "reject"
+
+
+def _mutation_count(counts: "dict[str, int]") -> int:
+    return sum(n for op, n in counts.items() if op in MUTATING_OPS)
+
+
+class PreflightForecaster:
+    """One persistent forecaster per state manager / federation
+    controller (mirrors the ``_capacity_for_policy`` lifecycle: created
+    on first use from a policy with ``preflight.mode != off``, knobs
+    refreshed every pass).
+
+    ``trace`` (optional) is a diurnal utilization source — any object
+    with ``utilization(now) -> float`` — used when no live capacity
+    status exists or when the caller wants the forecast swept against a
+    known traffic shape (soaks, benches, federation). ``classify``
+    (optional) maps a node name to its traffic-class name so per-class
+    timeline segments use real node shares. ``guard`` (optional) is
+    called with ``"preflight-forecast"`` at the top of every computed
+    forecast — the chaos harness wires the crash fuse here to prove
+    crash-mid-forecast leaves no residue. ``live_call_counts``
+    (optional) returns the LIVE cluster's per-op API call counts; the
+    forecaster diffs :data:`MUTATING_OPS` across the forecast to
+    evidence the read-only guarantee from the live side too.
+    """
+
+    def __init__(self, spec: "PreflightSpec", keys: "object",
+                 predictor: "Optional[PhaseDurationPredictor]" = None,
+                 clock: Optional[Clock] = None,
+                 trace: "Optional[object]" = None,
+                 classify: "Optional[Callable[[str], str]]" = None,
+                 guard: "Optional[Callable[[str], None]]" = None,
+                 live_call_counts:
+                 "Optional[Callable[[], dict]]" = None) -> None:
+        self.spec = spec
+        self.keys = keys
+        self.predictor = predictor
+        self._clock = clock or Clock()
+        self.trace = trace
+        self.classify = classify
+        self.guard = guard
+        self.live_call_counts = live_call_counts
+        #: Most recent forecast dict (cluster_status / HTTP feed).
+        self.last_forecast: Optional[dict] = None
+        #: Lifetime computed forecasts (cache misses).
+        self.forecasts_total = 0
+        #: Lifetime forecasts served from the single-entry cache.
+        self.cache_hits_total = 0
+        #: Lifetime required-mode rejections.
+        self.rejected_total = 0
+        #: Lifetime advisory-mode breaches.
+        self.advisory_total = 0
+        #: Lifetime write attempts that reached a frozen clone (any
+        #: nonzero is a read-only-guarantee violation — invariant feed).
+        self.frozen_write_attempts_total = 0
+        #: Lifetime live-cluster mutations observed during a forecast
+        #: (any nonzero is a violation — invariant feed).
+        self.live_mutations_total = 0
+        self._cache_key: "Optional[tuple]" = None
+
+    # ------------------------------------------------------------------
+    # spec lifecycle
+    # ------------------------------------------------------------------
+    def refresh(self, spec: "PreflightSpec") -> None:
+        """Policy re-read every pass (reference semantics): knob
+        changes take effect without dropping counters or cache."""
+        if spec is not self.spec:
+            self.spec = spec
+
+    # ------------------------------------------------------------------
+    # forecast
+    # ------------------------------------------------------------------
+    def forecast(self, state: "ClusterUpgradeState",
+                 policy: "UpgradePolicySpec",
+                 slots: Optional[int] = None,
+                 capacity: "Optional[CapacityBudgetController]" = None,
+                 now: Optional[float] = None) -> dict:
+        """The what-if forecast for admitting the pending rollout now.
+
+        ``slots`` is the in-flight window the throttle would actually
+        spend (the pass's ``upgrades_available``); when omitted it is
+        derived from the policy's static budget. Returns the forecast
+        dict (also retained as :attr:`last_forecast`); never raises on
+        model cold start — a forecast with zero error samples carries
+        the documented cold-start spread instead.
+        """
+        if now is None:
+            now = self._clock.now()
+        pending = [ns for ns in state.bucket(UpgradeState.UPGRADE_REQUIRED)]
+        in_progress = [(str(bucket_state), ns)
+                       for bucket_state in IN_PROGRESS_STATES
+                       for ns in state.bucket(bucket_state)]
+        if slots is None:
+            slots = self._static_slots(state, policy, len(pending))
+        key = self._cache_lookup_key(policy, pending, in_progress,
+                                     slots, now)
+        if key is not None and key == self._cache_key \
+                and self.last_forecast is not None:
+            self.cache_hits_total += 1
+            return self.last_forecast
+
+        if self.guard is not None:
+            # the chaos harness's crash-fuse seam: a fuse armed for
+            # "preflight-forecast" raises OperatorCrash HERE — before
+            # any result is retained, after zero writes
+            self.guard("preflight-forecast")
+
+        live_before = (dict(self.live_call_counts())
+                       if self.live_call_counts is not None else None)
+        clone = self._frozen_clone(state, now)
+        try:
+            forecast = self._compute(clone, state, policy, pending,
+                                     in_progress, slots, now, capacity)
+        finally:
+            attempts = getattr(clone, "frozen_write_attempts", 0)
+            self.frozen_write_attempts_total += attempts
+        live_mutations = 0
+        if live_before is not None:
+            live_after = dict(self.live_call_counts())
+            live_mutations = max(
+                0, _mutation_count(live_after)
+                - _mutation_count(live_before))
+            self.live_mutations_total += live_mutations
+        forecast["readonly"] = {
+            "frozenWriteAttempts": attempts,
+            "liveMutations": live_mutations,
+        }
+        self.forecasts_total += 1
+        if forecast["verdict"] == VERDICT_REJECT:
+            self.rejected_total += 1
+        elif forecast["verdict"] == VERDICT_ADVISORY:
+            self.advisory_total += 1
+        self.last_forecast = forecast
+        self._cache_key = key
+        return forecast
+
+    # ------------------------------------------------------------------
+    # internals
+    # ------------------------------------------------------------------
+    def _static_slots(self, state: "ClusterUpgradeState",
+                      policy: "UpgradePolicySpec", n_pending: int) -> int:
+        """Standalone-mode slot derivation (HTTP / federation / bench
+        callers without a live pass): the static parallel budget
+        intersected with maxUnavailable, never below 1 while work
+        remains."""
+        from tpu_operator_libs.api.upgrade_policy import (
+            scaled_value_from_int_or_percent,
+        )
+
+        total = sum(len(bucket) for bucket in state.node_states.values())
+        available = (policy.max_parallel_upgrades
+                     if policy.max_parallel_upgrades > 0 else n_pending)
+        if policy.max_unavailable is not None:
+            available = min(available, scaled_value_from_int_or_percent(
+                policy.max_unavailable, total, round_up=True))
+        return max(1, available)
+
+    def _cache_lookup_key(self, policy: "UpgradePolicySpec",
+                          pending: list, in_progress: list, slots: int,
+                          now: float) -> "Optional[tuple]":
+        """Single-entry cache key: the forecast is pure in (fleet
+        picture, policy knobs, traffic level), so steady reconcile
+        passes — same pending/in-flight sets, same minute, unchanged
+        utilization to 2dp — reuse it instead of re-cloning the fleet.
+        Any change in the picture (a node admitted, traffic moved, the
+        policy edited) misses and recomputes."""
+        spec = self.spec
+        hooks = getattr(policy, "policy_hooks", None)
+        hooks_fp: tuple = ()
+        if hooks is not None and getattr(hooks, "enable", False):
+            hooks_fp = tuple(
+                (h.hook, h.program) for h in (hooks.hooks or ()))
+        util = None
+        if self.trace is not None:
+            util = round(float(self.trace.utilization(now)), 2)
+        return (
+            frozenset(ns.node.metadata.name for ns in pending),
+            frozenset(name for _, ns in in_progress
+                      for name in (ns.node.metadata.name,)),
+            slots,
+            spec.mode, spec.max_forecast_slo_risk_fraction,
+            spec.max_forecast_makespan_seconds, spec.confidence,
+            hooks_fp,
+            util,
+            int(now // 60),
+            self.predictor.samples_total
+            if self.predictor is not None else -1,
+        )
+
+    def _frozen_clone(self, state: "ClusterUpgradeState",
+                      now: float) -> "object":
+        """The read-only fleet snapshot every forecaster read goes
+        through: a fresh FakeCluster loaded with CLONES of the
+        snapshot's nodes, frozen before the first read — the tripwire
+        that makes the read-only guarantee checkable rather than
+        asserted."""
+        from tpu_operator_libs.k8s.fake import FakeCluster
+        from tpu_operator_libs.util import FakeClock
+
+        clone = FakeCluster(clock=FakeClock(start=now))
+        for node in state.all_nodes():
+            clone.add_node(node.clone())
+        clone.freeze(reason="preflight")
+        return clone
+
+    def _compute(self, clone: "object", state: "ClusterUpgradeState",
+                 policy: "UpgradePolicySpec", pending: list,
+                 in_progress: list, slots: int, now: float,
+                 capacity: "Optional[CapacityBudgetController]") -> dict:
+        import heapq
+
+        spec = self.spec
+        predictor = self.predictor
+        # every per-node read below goes through the frozen clone's
+        # read API (get_node returns a copy) — the tripwire proves the
+        # whole forecast path is a pure function of the snapshot
+        annotations_of = {}
+        for name in [ns.node.metadata.name for ns in pending] \
+                + [ns.node.metadata.name for _, ns in in_progress]:
+            annotations_of[name] = dict(
+                clone.get_node(name).metadata.annotations)
+
+        # ---- maintenance window: conservative-bound deferrals -------
+        window = policy.maintenance_window
+        close = None
+        if window is not None and getattr(window, "enable", False):
+            resolve = getattr(window, "close_at", None)
+            if resolve is not None:
+                close = resolve(now)
+        margin = float(getattr(window, "margin_seconds", 0) or 0) \
+            if window is not None else 0.0
+        deferred: list[str] = []
+        eligible: list[str] = []
+        for ns in pending:
+            name = ns.node.metadata.name
+            if close is not None and predictor is not None:
+                bound = predictor.predict_node(
+                    name, annotations_of[name], conservative=True)
+                if now + bound + margin > close:
+                    deferred.append(name)
+                    continue
+            eligible.append(name)
+
+        # ---- LPT makespan (the predictive planner's _eta packing) ---
+        loads: list[float] = []
+        for state_label, ns in in_progress:
+            name = ns.node.metadata.name
+            if predictor is not None:
+                loads.append(predictor.remaining_seconds(
+                    name, state_label, annotations_of[name], now))
+            else:
+                loads.append(0.0)
+        jobs = []
+        for name in eligible:
+            if predictor is not None:
+                jobs.append(predictor.predict_node(
+                    name, annotations_of[name]))
+            else:
+                jobs.append(0.0)
+        jobs.sort(reverse=True)
+        slot_count = max(1, len(loads) + max(0, slots))
+        packed = loads + [0.0] * max(0, slot_count - len(loads))
+        heapq.heapify(packed)
+        for job in jobs:
+            heapq.heappush(packed, heapq.heappop(packed) + job)
+        makespan = max(packed) if (loads or jobs) else 0.0
+        waves = []
+        for i in range(0, len(jobs), slot_count):
+            chunk = jobs[i:i + slot_count]
+            waves.append({"nodes": len(chunk),
+                          "predictedSeconds": round(chunk[0], 1)})
+
+        # ---- confidence bounds from the retained error histogram ----
+        error_ratio = (predictor.error_ratio(spec.confidence)
+                       if predictor is not None else 0.0)
+        error_samples = (predictor.error_samples
+                         if predictor is not None else 0)
+        lower = max(0.0, makespan * (1.0 - error_ratio))
+        upper = makespan * (1.0 + error_ratio)
+
+        # ---- policy hooks against a FRESH engine (zero pollution) ---
+        forecast_holds = self._forecast_holds(
+            clone, policy, eligible, state, slots, now, close)
+
+        # ---- capacity/traffic replay over the forecast horizon ------
+        slo = self._slo_replay(policy, capacity, eligible, slots,
+                               max(upper, 1.0), now,
+                               total_nodes=len(state.all_nodes()))
+
+        # ---- verdict ------------------------------------------------
+        breaches: list[str] = []
+        if spec.max_forecast_makespan_seconds > 0 \
+                and upper > spec.max_forecast_makespan_seconds:
+            breaches.append("makespan")
+        worst_fraction = slo["worstFraction"] if slo is not None else 0.0
+        if worst_fraction > spec.max_forecast_slo_risk_fraction:
+            breaches.append("slo-risk")
+        if not breaches:
+            verdict = VERDICT_ADMIT
+        elif spec.mode == "required":
+            verdict = VERDICT_REJECT
+        else:
+            verdict = VERDICT_ADVISORY
+
+        forecast: dict = {
+            "mode": spec.mode,
+            "generatedAtSeconds": round(now, 1),
+            "nodesPending": len(pending),
+            "nodesInProgress": len(in_progress),
+            "slots": slots,
+            "makespan": {
+                "expectedSeconds": round(makespan, 1),
+                "lowerSeconds": round(lower, 1),
+                "upperSeconds": round(upper, 1),
+                "confidence": spec.confidence,
+                "errorSamples": error_samples,
+                "coldStart": error_samples == 0,
+            },
+            "waves": waves,
+            "expected": {
+                "holds": forecast_holds["count"],
+                "windowDeferrals": len(deferred),
+                "aborts": slo["aborts"] if slo is not None else 0,
+                "pausedTicks": slo["pausedTicks"] if slo is not None
+                else 0,
+            },
+            "thresholds": {
+                "maxForecastSloRiskFraction":
+                    spec.max_forecast_slo_risk_fraction,
+                "maxForecastMakespanSeconds":
+                    spec.max_forecast_makespan_seconds,
+            },
+            "breaches": breaches,
+            "verdict": verdict,
+        }
+        if forecast_holds["rules"]:
+            forecast["holdRules"] = forecast_holds["rules"]
+        if slo is not None:
+            forecast["sloRisk"] = {
+                "worstClass": slo["worstClass"],
+                "worstFraction": slo["worstFraction"],
+                "classes": slo["classes"],
+            }
+        if close is not None:
+            forecast["windowCloseSeconds"] = round(close, 1)
+        return forecast
+
+    def _forecast_holds(self, clone: "object",
+                        policy: "UpgradePolicySpec",
+                        eligible: "list[str]",
+                        state: "ClusterUpgradeState", slots: int,
+                        now: float, close: Optional[float]) -> dict:
+        """Replay planner.admission / window.gate over the pending set
+        on a THROWAWAY engine — the live engine's last_holds / audit
+        stream never see forecast evaluations."""
+        hooks = getattr(policy, "policy_hooks", None)
+        if hooks is None or not getattr(hooks, "enable", False) \
+                or not getattr(hooks, "hooks", None):
+            return {"count": 0, "rules": {}}
+        from tpu_operator_libs.policy.engine import PolicyEngine, node_env
+
+        engine = PolicyEngine(self.keys)
+        engine.refresh(hooks)
+        registry = engine.registry
+        check_admission = registry.has("planner.admission")
+        check_window = registry.has("window.gate")
+        if not check_admission and not check_window:
+            return {"count": 0, "rules": {}}
+        total = len(state.all_nodes())
+        in_progress = sum(len(state.bucket(s))
+                          for s in IN_PROGRESS_STATES)
+        fleet_env = {"total": total, "inProgress": in_progress,
+                     "unavailable": in_progress, "slots": slots,
+                     "budget": slots}
+        count = 0
+        rules: dict[str, int] = {}
+        for name in eligible:
+            node = clone.get_node(name)
+            env_node = node_env(node, state=str(
+                node.metadata.labels.get(engine.state_label, "")))
+            held = None
+            if check_admission:
+                verdict = registry.evaluate(
+                    "planner.admission",
+                    {"node": env_node, "fleet": fleet_env, "now": now},
+                    subject=name)
+                if verdict.value is not True:
+                    held = verdict.rule or "policy-deny"
+            if held is None and check_window:
+                verdict = registry.evaluate(
+                    "window.gate",
+                    {"node": env_node, "now": now, "close": close},
+                    subject=name)
+                if verdict.value is not True:
+                    held = verdict.rule or "policy-deny"
+            if held is not None:
+                count += 1
+                rules[held] = rules.get(held, 0) + 1
+        return {"count": count, "rules": dict(sorted(rules.items()))}
+
+    def _slo_replay(self, policy: "UpgradePolicySpec",
+                    capacity: "Optional[CapacityBudgetController]",
+                    eligible: "list[str]", slots: int, horizon: float,
+                    now: float, total_nodes: int) -> Optional[dict]:
+        """Sweep the traffic picture across the forecast horizon.
+
+        Demand comes from the diurnal trace when wired (soaks/benches/
+        federation), else flat from the live controller's last status;
+        serving capacity is reduced by the in-flight concurrency the
+        rollout would hold out of service. Per-class risk maps each
+        class to a contiguous segment of the rollout timeline in
+        disruption-cost order (batch tiers drain first, interactive
+        last — the cost ranker's admission order), using real per-class
+        node shares when a classifier is wired and equal shares
+        otherwise. Returns None when the policy is capacity-blind."""
+        spec = policy.capacity
+        if spec is None or not spec.enable:
+            return None
+        per_node = max(1, int(spec.per_node_capacity))
+        status = capacity.last_status \
+            if capacity is not None else None
+        trace = self.trace
+        if status:
+            serving = int(status.get("servingNodes") or 0) or total_nodes
+            flat_util = float(status.get("utilization") or 0.0)
+        elif trace is not None:
+            serving = total_nodes
+            flat_util = float(trace.utilization(now))
+        else:
+            return None
+        capacity_total = serving * per_node
+        concurrency = min(slots, max(len(eligible), 1))
+        avail = max(0, serving - concurrency) * per_node
+
+        step = horizon / REPLAY_TICKS
+        risks: list[float] = []
+        paused_ticks = 0
+        aborts = 0
+        paused_prev = False
+        for i in range(REPLAY_TICKS + 1):
+            t = now + i * step
+            util = (float(trace.utilization(t)) if trace is not None
+                    else flat_util)
+            demand = util * capacity_total
+            risk = (max(0.0, demand - avail) / demand
+                    if demand > 0 else 0.0)
+            risks.append(risk)
+            paused = util >= spec.peak_pause_utilization
+            if paused:
+                paused_ticks += 1
+                if not paused_prev:
+                    # a pause onset mid-rollout collapses the budget
+                    # below what is already unavailable: every
+                    # in-flight drain is forecast aborted
+                    aborts += concurrency
+            paused_prev = paused
+
+        classes = list(spec.traffic_classes or ())
+        if not classes:
+            worst = max(risks)
+            return {"worstClass": "fleet",
+                    "worstFraction": round(worst, 4),
+                    "classes": {"fleet": round(worst, 4)},
+                    "aborts": aborts, "pausedTicks": paused_ticks}
+        # disruption-cost order: batch tiers drain early in the
+        # timeline, interactive last (mirrors DisruptionCostRanker)
+        ordered = ([c for c in classes if not c.interactive]
+                   + [c for c in classes if c.interactive])
+        shares = self._class_shares(ordered, eligible)
+        out: dict[str, float] = {}
+        worst_class, worst_fraction = "", 0.0
+        cursor = 0.0
+        n_ticks = len(risks)
+        for cls in ordered:
+            begin = int(cursor * n_ticks)
+            cursor = min(1.0, cursor + shares[cls.name])
+            end = max(begin + 1, int(cursor * n_ticks))
+            segment = risks[begin:min(end, n_ticks)] or [risks[-1]]
+            fraction = round(max(segment), 4)
+            out[cls.name] = fraction
+            if fraction >= worst_fraction:
+                worst_class, worst_fraction = cls.name, fraction
+        return {"worstClass": worst_class,
+                "worstFraction": worst_fraction,
+                "classes": dict(sorted(out.items())),
+                "aborts": aborts, "pausedTicks": paused_ticks}
+
+    def _class_shares(self, ordered: list,
+                      eligible: "list[str]") -> "dict[str, float]":
+        if self.classify is not None and eligible:
+            counts = {cls.name: 0 for cls in ordered}
+            matched = 0
+            for name in eligible:
+                cls = self.classify(name)
+                if cls in counts:
+                    counts[cls] += 1
+                    matched += 1
+            if matched:
+                return {name: count / matched
+                        for name, count in counts.items()}
+        share = 1.0 / len(ordered)
+        return {cls.name: share for cls in ordered}

@@ -367,6 +367,24 @@ class ClusterUpgradeStateManager:
         #: :meth:`with_serving_signal`; without one the controller
         #: fails open to the static budget exactly.
         self._capacity_source = None
+        # ---- rollout preflight (upgrade/preflight.py) ----
+        #: Persistent PreflightForecaster; created on first use from a
+        #: policy with preflight.mode != "off". Pure read-side state:
+        #: a forecast owns no durable bits, so crash-restart costs
+        #: nothing but a recompute from the same snapshot inputs.
+        self._preflight = None
+        #: Forecast dict of the most recent preflight pass (None while
+        #: preflight is off) — the cluster_status / explain / HTTP feed
+        #: and the admission gate's evidence.
+        self.last_preflight = None
+        #: Optional diurnal-trace source (``utilization(now)``) handed
+        #: to the forecaster — soaks and benches wire the same trace
+        #: their serving sim replays so the forecast sweeps the real
+        #: traffic shape.
+        self.preflight_trace = None
+        #: Optional crash-fuse guard for the forecast path (chaos
+        #: harness seam; see PreflightForecaster.guard).
+        self.preflight_guard = None
         # ---- traffic-class drain ordering + prewarm (handover.py) ----
         #: Persistent DisruptionCostRanker wrapper; created on first
         #: use from a policy declaring capacityBudget.trafficClasses
@@ -1523,6 +1541,24 @@ class ClusterUpgradeStateManager:
             # falling.
             upgrades_available = 0
             frozen_by_capacity = True
+        # Rollout preflight (upgrade/preflight.py): forecast the
+        # pending rollout against the learned models BEFORE slot one
+        # is spent, entirely read-only (frozen-clone tripwire). A
+        # required-mode threshold breach parks the rollout — zero
+        # admissions, audited under preflight-rejected — until the
+        # forecast clears; advisory mode records the breach and admits.
+        preflight_rejected = False
+        preflight = self._preflight_for_policy(policy)
+        if preflight is not None:
+            self.last_preflight = preflight.forecast(
+                state, policy, slots=upgrades_available,
+                capacity=capacity)
+            if self.last_preflight["verdict"] == "reject" \
+                    and upgrades_available > 0:
+                upgrades_available = 0
+                preflight_rejected = True
+        else:
+            self.last_preflight = None
         in_progress = self.get_upgrades_in_progress(state)
         unavailable_now = self.get_current_unavailable_nodes(state)
         logger.info(
@@ -1598,6 +1634,8 @@ class ClusterUpgradeStateManager:
             # every parked node's explain chain hangs off
             if self._rollout.halted:
                 rule = "rollout-halt"
+            elif preflight_rejected:
+                rule = "preflight-rejected"
             elif frozen_by_capacity:
                 rule = "capacity-falling-freeze"
             elif upgrades_available <= 0:
@@ -1614,6 +1652,12 @@ class ClusterUpgradeStateManager:
             }
             if static_unavailable is not None:
                 inputs["staticBudget"] = static_unavailable
+            if self.last_preflight is not None:
+                inputs["preflightVerdict"] = \
+                    self.last_preflight["verdict"]
+                if self.last_preflight["breaches"]:
+                    inputs["preflightBreaches"] = ",".join(
+                        self.last_preflight["breaches"])
             obs.audit.record(
                 "budget", "", decision=f"slots={upgrades_available}",
                 rule=rule, inputs=inputs)
@@ -2127,6 +2171,41 @@ class ClusterUpgradeStateManager:
             self._capacity.spec = spec
             self._capacity.nudger = self.nudger
         return self._capacity
+
+    def _preflight_for_policy(self, policy: UpgradePolicySpec) -> "object":
+        """The preflight forecaster for this pass (same lifecycle as
+        :meth:`_capacity_for_policy`: created on first use, knobs and
+        collaborators re-pointed every pass from the re-read policy);
+        None when the spec is absent or ``mode`` is ``off``."""
+        spec = policy.preflight
+        if spec is None or not spec.enabled:
+            return None
+        if self._preflight is None:
+            from tpu_operator_libs.upgrade.preflight import (
+                PreflightForecaster,
+            )
+
+            self._preflight = PreflightForecaster(
+                spec, self.keys,
+                predictor=self._predictor_for_policy(policy),
+                clock=self.clock,
+                trace=self.preflight_trace,
+                guard=self.preflight_guard,
+                live_call_counts=getattr(
+                    self.client, "api_call_counts", None))
+        else:
+            self._preflight.refresh(spec)
+            self._preflight.predictor = \
+                self._predictor_for_policy(policy)
+            self._preflight.trace = self.preflight_trace
+            self._preflight.guard = self.preflight_guard
+        return self._preflight
+
+    @property
+    def preflight(self) -> "object":
+        """The persistent PreflightForecaster (None until a preflight
+        policy ran) — its ``last_forecast`` is the what-if picture."""
+        return self._preflight
 
     @property
     def predictor(self) -> "object":
@@ -3085,6 +3164,11 @@ class ClusterUpgradeStateManager:
             planner_block["knownNodes"] = self._predictor.known_nodes
             planner_block["samplesTotal"] = self._predictor.samples_total
             status["planner"] = planner_block
+        if self.last_preflight is not None:
+            # the what-if picture: the most recent preflight forecast
+            # (makespan bounds, per-class SLO risk, read-only
+            # evidence) and the verdict the admission gate acted on
+            status["preflight"] = dict(self.last_preflight)
         if self._capacity is not None \
                 and self._capacity.last_status is not None:
             # the traffic-aware budget picture: live demand vs serving
@@ -3461,6 +3545,19 @@ class ClusterUpgradeStateManager:
                 f"canary wave in flight ({len(self._rollout.cohort)} "
                 f"cohort node(s)): admissions restricted to the "
                 f"cohort until the bake passes")
+        preflight = self.last_preflight
+        if preflight is not None and preflight.get("verdict") == "reject":
+            makespan = preflight.get("makespan", {})
+            risk = preflight.get("sloRisk", {})
+            chain.append(
+                f"preflight rejected the rollout "
+                f"({', '.join(preflight.get('breaches', []))}): "
+                f"forecast makespan <= "
+                f"{makespan.get('upperSeconds')}s at "
+                f"{makespan.get('confidence')} confidence, worst SLO "
+                f"risk {risk.get('worstFraction', 0.0)} on class "
+                f"{risk.get('worstClass', 'fleet')!r} — admissions "
+                f"parked until the forecast clears")
         ranker = self._cost_ranker
         if ranker is not None and name in ranker.last_holds:
             rule, hold_inputs = ranker.last_holds[name]
