@@ -4,7 +4,7 @@ PYTHON ?= python3
 PYTEST_FLAGS ?= -q
 COV_THRESHOLD ?= 85
 
-.PHONY: all check test test-fast test-fault test-chaos test-soak test-scale test-rollout test-latency test-reconfig test-shard test-planner test-budget test-handover test-obs test-federation test-policy test-dag test-precursor test-preflight lint cov bench bench-reconcile bench-latency bench-shard bench-shard-100k bench-planner bench-budget bench-obs bench-federation bench-precursor bench-preflight graft-check package clean diagram
+.PHONY: all check test test-fast test-fault test-chaos test-soak test-scale test-rollout test-latency test-reconfig test-shard test-planner test-budget test-handover test-obs test-federation test-policy test-dag test-precursor test-preflight lint cov bench bench-reconcile bench-latency bench-shard bench-shard-100k bench-shard-1m bench-planner bench-budget bench-budget-1m bench-obs bench-federation bench-precursor bench-preflight profile-pass graft-check package clean diagram
 
 all: lint test
 
@@ -145,6 +145,23 @@ bench-shard:
 bench-shard-100k:
 	$(PYTHON) tools/latency_bench.py --shard-nodes 102400 --shard-replicas 4 --out BENCH_shard.json
 
+# The million-node pass: 2**20 synthetic nodes driven to convergence by
+# the columnar (struct-of-arrays, vectorized) reconcile kernel AND its
+# per-node dict twin — acceptance is a bit-identical final-state
+# fingerprint + identical makespan, sub-second worst-case incremental
+# builds per replica, per-replica delta intake within 1.3x of
+# events/replicas and ZERO steady full-fleet lists
+# (tools/latency_bench.py --columnar-nodes; docs/benchmarks.md §2e).
+# Writes BENCH_shard.json.
+bench-shard-1m:
+	$(PYTHON) tools/latency_bench.py --columnar-nodes 1048576 --columnar-replicas 8 --out BENCH_shard.json
+
+# Reconcile-pass profiler: cProfile one steady-state pass at 64 and
+# 1024 nodes, print the top-20 cumulative hotspots and refresh the
+# PROFILE-PASS block in docs/benchmarks.md (tools/profile_pass.py).
+profile-pass:
+	$(PYTHON) tools/profile_pass.py
+
 # Event-driven scheduling regressions (`latency` marker): timer wheel,
 # nudge dedup, eager refill, and the 64-node bench smoke are tier-1;
 # the 256/1024-node makespan-ratio cells are also marked slow.
@@ -244,6 +261,14 @@ bench-obs:
 # BENCH_budget.json.
 bench-budget:
 	$(PYTHON) tools/budget_bench.py --out BENCH_budget.json
+
+# The million-session handover soak: the vectorized serving-fleet twin
+# (chaos/serving_vec.py) replays >1M concurrent sessions through
+# drain-wave handovers — acceptance is ZERO operator-attributed drops
+# with session-conservation intact (tools/budget_bench.py
+# --vector-sessions; docs/benchmarks.md §2g). Writes BENCH_budget.json.
+bench-budget-1m:
+	$(PYTHON) tools/budget_bench.py --vector-sessions 1048576 --out BENCH_budget.json
 
 # Failure-precursor slice (`precursor` marker): NodeHealthSignal +
 # FailurePrecursorModel units (EWMA rates, verdict streaks, durable

@@ -1,0 +1,316 @@
+"""Columnar reconcile core: census-store parity, snapshot modes, and
+the fleet-scale twin kernels.
+
+ISSUE 18's tentpole evidence at test scale:
+
+- :class:`CensusColumns` answers (per-shard census, shard totals,
+  canary-eligible domain, entries) bit-identically to the
+  :class:`DictCensus` it replaces, through randomized update/remove
+  churn, row recycling and full rebuilds;
+- the :class:`ParityCensus` wrapper cross-checks every read and counts
+  checks/mismatches (the ``columnar_parity_checks_total`` feed);
+- the manager's ``snapshot_mode`` selection (auto/columnar/dict/parity
+  + env override) and the canary-context fast path reuse;
+- a full sharded rollout under ``snapshot_mode="columnar"`` converges
+  to a cluster state AND DecisionAudit stream identical to
+  ``snapshot_mode="dict"``;
+- the 4096-node columnar-vs-dict twin engines (the ``bench-shard-1m``
+  kernels) converge bit-identically — fingerprint and makespan.
+"""
+
+import random
+
+import pytest
+
+pytestmark = [pytest.mark.shard]
+
+from tpu_operator_libs.api.upgrade_policy import (
+    DrainSpec,
+    UpgradePolicySpec,
+)
+from tpu_operator_libs.consts import ALL_STATES, UpgradeState
+from tpu_operator_libs.k8s.cached import CachedReadClient
+from tpu_operator_libs.k8s.sharding import ShardRing, StaticShardView
+from tpu_operator_libs.obs import OperatorObservability
+from tpu_operator_libs.simulate import (
+    NS,
+    RUNTIME_LABELS,
+    FleetSpec,
+    build_fleet,
+)
+from tpu_operator_libs.upgrade import columns as C
+from tpu_operator_libs.upgrade.state_manager import (
+    BuildStateError,
+    ClusterUpgradeStateManager,
+)
+
+numpy_only = pytest.mark.skipif(not C.HAVE_NUMPY,
+                                reason="numpy unavailable")
+
+POLICY = UpgradePolicySpec(
+    auto_upgrade=True, max_parallel_upgrades=0,
+    max_unavailable="25%", topology_mode="flat",
+    drain=DrainSpec(enable=False))
+
+LABELS = [""] + [str(s) for s in ALL_STATES if str(s)]
+
+
+def _stores(num_shards=4):
+    return C.CensusColumns(num_shards), C.DictCensus(num_shards)
+
+
+def _assert_equal(col, ref):
+    assert len(col) == len(ref)
+    assert C.census_equal(col.per_shard(), ref.per_shard())
+    totals_col, totals_ref = col.shard_totals(), ref.shard_totals()
+    assert all(totals_col.get(s, 0) == totals_ref.get(s, 0)
+               for s in set(totals_col) | set(totals_ref))
+    for labeled_only in (False, True):
+        assert col.eligible(labeled_only) == ref.eligible(labeled_only)
+
+
+@numpy_only
+class TestCensusColumns:
+    def test_update_remove_rebuild_parity_fuzz(self):
+        """Randomized churn: every read stays bit-identical to the
+        dict census through upserts, removals, row recycling and a
+        mid-run rebuild."""
+        rng = random.Random(18)
+        col, ref = _stores()
+        names = [f"n{i}" for i in range(64)]
+        for step in range(600):
+            name = rng.choice(names)
+            op = rng.random()
+            if op < 0.25:
+                col.remove(name)
+                ref.remove(name)
+            else:
+                args = (name, rng.randrange(4), rng.choice(LABELS),
+                        rng.random() < 0.2,
+                        rng.choice(["", "pool-a", "pool-b"]))
+                col.update(*args)
+                ref.update(*args)
+            if step == 300:
+                rows = [(n, rng.randrange(4), rng.choice(LABELS),
+                         False, "") for n in names[:40]]
+                col.rebuild(rows)
+                ref.rebuild(rows)
+            if step % 50 == 0:
+                _assert_equal(col, ref)
+        _assert_equal(col, ref)
+
+    def test_entry_lookup(self):
+        col, ref = _stores()
+        col.update("a", 2, str(UpgradeState.DONE))
+        ref.update("a", 2, str(UpgradeState.DONE))
+        assert col.entry("a") == ref.entry("a") \
+            == (2, str(UpgradeState.DONE))
+        assert col.entry("missing") is None
+
+    def test_out_of_vocab_label_gets_dynamic_code(self):
+        col, _ = _stores()
+        col.update("a", 0, "user-wrote-this")
+        assert col.entry("a") == (0, "user-wrote-this")
+        assert col.per_shard()[0] == {"user-wrote-this": 1}
+
+    def test_row_recycling_keeps_arrays_bounded(self):
+        col = C.CensusColumns(2, initial_capacity=16)
+        for round_no in range(10):
+            for i in range(16):
+                col.update(f"n{round_no}-{i}", i % 2,
+                           str(UpgradeState.DONE))
+            for i in range(16):
+                col.remove(f"n{round_no}-{i}")
+        # 160 upserts through 16 rows: the free list recycled them
+        assert len(col._shard) == 16
+        assert len(col) == 0
+
+    def test_eligible_cache_survives_labeled_transitions(self):
+        """The satellite-4 claim: steady labeled->labeled transitions
+        (the rollout's hot path) must NOT invalidate the sorted
+        canary-domain cache."""
+        col = C.CensusColumns(2)
+        for i in range(8):
+            col.update(f"n{i}", i % 2, str(UpgradeState.UPGRADE_REQUIRED),
+                       pool="p")
+        first = col.eligible(labeled_only=True)
+        version = (col.membership_version, col.labeled_version)
+        for i in range(8):
+            col.update(f"n{i}", i % 2, str(UpgradeState.DONE), pool="p")
+        assert (col.membership_version, col.labeled_version) == version
+        assert col.eligible(labeled_only=True) is first
+        # an unlabel (DONE -> "") must invalidate
+        col.update("n0", 0, "", pool="p")
+        assert col.eligible(labeled_only=True) is not first
+
+    def test_per_shard_cached_until_mutation(self):
+        col = C.CensusColumns(2)
+        col.update("a", 0, str(UpgradeState.DONE))
+        one = col.per_shard()
+        assert col.per_shard() is one
+        col.update("b", 1, str(UpgradeState.DONE))
+        assert col.per_shard() is not one
+
+
+@numpy_only
+class TestParityCensus:
+    def _parity(self):
+        return C.ParityCensus(*_stores())
+
+    def test_reads_cross_check_and_count(self):
+        par = self._parity()
+        par.update("a", 1, str(UpgradeState.DONE))
+        par.per_shard()
+        par.shard_totals()
+        par.eligible(True)
+        par.entry("a")
+        assert par.checks == 4
+        assert par.mismatches == 0
+
+    def test_mismatch_detected_not_raised(self):
+        par = self._parity()
+        par.update("a", 1, str(UpgradeState.DONE))
+        # corrupt the shadow behind the wrapper's back
+        par.shadow.update("ghost", 0, str(UpgradeState.DONE))
+        sites = []
+        par._on_mismatch = sites.append
+        got = par.per_shard()  # answers from the primary regardless
+        assert got[1] == {str(UpgradeState.DONE): 1}
+        assert par.mismatches == 1
+        assert sites == ["per_shard"]
+
+
+class TestSnapshotModes:
+    def _sharded_manager(self, mode, monkeypatch=None, env=""):
+        cluster, clock, keys = build_fleet(
+            FleetSpec(n_slices=4, hosts_per_slice=4))
+        if monkeypatch is not None:
+            monkeypatch.setenv("TPU_OPERATOR_SNAPSHOT_MODE", env)
+        view = StaticShardView(ring=ShardRing(2),
+                               owned=frozenset({0, 1}),
+                               identity="t")
+        cached = CachedReadClient(cluster, NS, threaded=False,
+                                  relist_interval=None,
+                                  partition_view=view)
+        mgr = ClusterUpgradeStateManager(
+            cached, keys, clock=clock, async_workers=False,
+            poll_interval=0.0,
+            snapshot_mode=mode).with_sharding(view)
+        return cluster, clock, cached, mgr
+
+    def test_mode_resolution(self, monkeypatch):
+        monkeypatch.delenv("TPU_OPERATOR_SNAPSHOT_MODE", raising=False)
+        _, _, _, mgr = self._sharded_manager("dict")
+        assert mgr._resolved_snapshot_mode() == "dict"
+        assert mgr.snapshot_build_mode == "dict"
+        _, _, _, auto = self._sharded_manager("auto")
+        expect = "columnar" if C.HAVE_NUMPY else "dict"
+        assert auto._resolved_snapshot_mode() == expect
+
+    def test_env_overrides_constructor(self, monkeypatch):
+        _, _, _, mgr = self._sharded_manager(
+            "auto", monkeypatch, env="dict")
+        assert mgr._resolved_snapshot_mode() == "dict"
+        assert mgr.snapshot_build_mode == "dict"
+
+    @numpy_only
+    def test_parity_mode_counts_checks_during_rollout(self, monkeypatch):
+        monkeypatch.delenv("TPU_OPERATOR_SNAPSHOT_MODE", raising=False)
+        cluster, clock, cached, mgr = self._sharded_manager("parity")
+        assert mgr.snapshot_build_mode == "columnar"
+        for _ in range(6):
+            cached.pump()
+            try:
+                mgr.reconcile(NS, RUNTIME_LABELS, POLICY)
+            except BuildStateError:
+                pass
+            clock.advance(15.0)
+            cluster.step()
+        assert mgr.columnar_parity_checks > 0
+        assert mgr.columnar_parity_mismatches == 0
+
+
+class TestManagerColumnarDictParity:
+    """The acceptance pin: an identical sharded rollout under the
+    columnar census and the dict census converges to the same cluster
+    state AND the same DecisionAudit stream."""
+
+    def _run(self, mode):
+        cluster, clock, keys = build_fleet(
+            FleetSpec(n_slices=16, hosts_per_slice=4,
+                      pod_recreate_delay=10.0, pod_ready_delay=30.0))
+        view = StaticShardView(ring=ShardRing(4),
+                               owned=frozenset({0, 1, 2, 3}),
+                               identity="par")
+        cached = CachedReadClient(cluster, NS, threaded=False,
+                                  relist_interval=None,
+                                  partition_view=view)
+        mgr = ClusterUpgradeStateManager(
+            cached, keys, clock=clock, async_workers=False,
+            poll_interval=0.0,
+            snapshot_mode=mode).with_sharding(view)
+        bundle = OperatorObservability(keys, clock=clock)
+        mgr.with_observability(bundle)
+        done = str(UpgradeState.DONE)
+        for _ in range(120):
+            cached.pump()
+            try:
+                mgr.reconcile(NS, RUNTIME_LABELS, POLICY)
+            except BuildStateError:
+                pass
+            if all(n.metadata.labels.get(keys.state_label) == done
+                   for n in cluster.list_nodes()):
+                break
+            clock.advance(15.0)
+            cluster.step()
+        state = tuple(sorted(
+            (n.metadata.name,
+             tuple(sorted(n.metadata.labels.items())),
+             tuple(sorted(n.metadata.annotations.items())),
+             n.is_unschedulable())
+            for n in cluster.list_nodes()))
+        audit = tuple((row[3], row[4], row[5], row[6], row[7])
+                      for row in bundle.audit._records)
+        assert mgr.snapshot_build_mode == (
+            "columnar" if mode == "columnar" else "dict")
+        cached.stop()
+        return state, audit
+
+    @pytest.mark.scale
+    @numpy_only
+    def test_columnar_matches_dict_rollout(self, monkeypatch):
+        monkeypatch.delenv("TPU_OPERATOR_SNAPSHOT_MODE", raising=False)
+        col_state, col_audit = self._run("columnar")
+        ref_state, ref_audit = self._run("dict")
+        assert col_state == ref_state
+        assert col_audit == ref_audit
+
+
+@numpy_only
+class TestEngineParity:
+    """The bench-shard-1m twin kernels at test scale."""
+
+    @pytest.mark.scale
+    def test_4096_nodes_bit_identical(self):
+        n, replicas = 4096, 4
+        num_shards = replicas * 2
+        owned = [tuple(s for s in range(num_shards)
+                       if s % replicas == r) for r in range(replicas)]
+        col = C.run_engine(C.ColumnarFleetEngine(n, num_shards, owned))
+        ref = C.run_engine(C.DictFleetEngine(n, num_shards, owned))
+        assert col["fingerprint"] == ref["fingerprint"]
+        assert col["makespan_ticks"] == ref["makespan_ticks"]
+        # every node admits once and finishes once; each lands in the
+        # owning replica's (server-side filtered) stream only
+        assert col["events_total"] == 2 * n
+        fair = col["events_total"] / replicas
+        assert max(col["events_by_replica"]) <= 1.3 * fair
+        assert max(col["full_fleet_lists"]) == 0
+
+    def test_synth_fleet_deterministic_and_balanced(self):
+        shard_a, dur_a = C.synth_fleet(2048, 8)
+        shard_b, dur_b = C.synth_fleet(2048, 8)
+        assert (shard_a == shard_b).all()
+        assert (dur_a == dur_b).all()
+        assert set(shard_a.tolist()) == set(range(8))
+        assert dur_a.min() >= 1 and dur_a.max() <= 12

@@ -477,7 +477,9 @@ class TestObserveReconcile:
         registry = MetricsRegistry()
         observe_reconcile(registry, mgr, state, duration_seconds=0.02)
         assert registry.histogram_stats(
-            "reconcile_pass_seconds", {"driver": "libtpu"}) == (1, 0.02)
+            "reconcile_pass_seconds",
+            {"driver": "libtpu",
+             "snapshot_build_mode": mgr.snapshot_build_mode}) == (1, 0.02)
         assert registry.get(
             "reconcile_bucket_nodes",
             {"driver": "libtpu",

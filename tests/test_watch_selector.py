@@ -1,0 +1,310 @@
+"""Server-side watch sharding: selector-scoped watch semantics, shard
+stamping at admission, and the selector-driven informer lifecycle.
+
+ISSUE 18's k8s-layer half:
+
+- FakeCluster ``watch(label_selector=...)`` implements the apiserver's
+  selector-scoped view: only matching objects' events arrive, and an
+  already-delivered object that STOPS matching surfaces as a synthetic
+  DELETED on the stream (the retiring-DELETE rule);
+- the satellite-2 regression: an ``Informer.ingest_filter`` whose
+  answer changes mid-watch (selector swap) must retire a stored object
+  on the next MODIFIED instead of refreshing it;
+- ``ShardLabelStamper``: ring-pure stamps applied at admission (node
+  AND pod create paths, DS-controller recreations included), the
+  ``key in (...)`` ownership selector, idempotent bootstrap stamping,
+  and stamp INVARIANCE across shard handover (only the watcher's
+  selector moves — the crash-ordered handover rule);
+- ``CachedReadClient(shard_selector_fn=...)``: the pod watch opens
+  server-side filtered, ``refresh_partition`` resubscribes when the
+  selector changes, and the threaded mode is rejected;
+- the end-to-end pin: a sharded upgrade with server-side watches live
+  converges bit-identically to the unfiltered single owner.
+"""
+
+import os
+import sys
+
+import pytest
+
+pytestmark = [pytest.mark.shard]
+
+from tpu_operator_libs.consts import GKE_NODEPOOL_LABEL
+from tpu_operator_libs.controller import Informer
+from tpu_operator_libs.k8s.cached import CachedReadClient
+from tpu_operator_libs.k8s.fake import FakeCluster
+from tpu_operator_libs.k8s.objects import (
+    Node,
+    ObjectMeta,
+    Pod,
+    PodSpec,
+)
+from tpu_operator_libs.k8s.sharding import ShardLabelStamper, ShardRing
+from tpu_operator_libs.k8s.watch import ADDED, DELETED, MODIFIED
+from tpu_operator_libs.simulate import (
+    NS,
+    FleetSpec,
+    build_fleet,
+)
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), os.pardir,
+                                "tools"))
+
+
+def _drain(watch):
+    """Synchronously drain whatever the fan-out already enqueued."""
+    events = []
+    while True:
+        event = watch.get(timeout=0)
+        if event is None:
+            return events
+        events.append(event)
+
+
+def _mk_cluster():
+    cluster = FakeCluster()
+    cluster.add_node(Node(metadata=ObjectMeta(
+        name="node-a", labels={GKE_NODEPOOL_LABEL: "pool"})))
+    cluster.add_node(Node(metadata=ObjectMeta(
+        name="node-b", labels={GKE_NODEPOOL_LABEL: "pool"})))
+    return cluster
+
+
+class TestSelectorScopedWatch:
+    def test_only_matching_events_arrive(self):
+        cluster = _mk_cluster()
+        watch = cluster.watch(label_selector="team=a")
+        cluster.add_pod(Pod(metadata=ObjectMeta(
+            name="p1", namespace=NS, labels={"team": "a"}),
+            spec=PodSpec(node_name="node-a")))
+        cluster.add_pod(Pod(metadata=ObjectMeta(
+            name="p2", namespace=NS, labels={"team": "b"}),
+            spec=PodSpec(node_name="node-a")))
+        events = _drain(watch)
+        assert [(e.type, e.object.metadata.name) for e in events] \
+            == [(ADDED, "p1")]
+        watch.stop()
+
+    def test_stop_matching_surfaces_as_deleted(self):
+        """The retiring-DELETE rule: a seen object whose labels stop
+        matching arrives as a synthetic DELETED, not a MODIFIED."""
+        cluster = _mk_cluster()
+        cluster.add_pod(Pod(metadata=ObjectMeta(
+            name="p1", namespace=NS, labels={"team": "a"}),
+            spec=PodSpec(node_name="node-a")))
+        watch = cluster.watch(label_selector="team=a")
+        cluster.patch_pod_labels(NS, "p1", {"team": "b"})
+        events = _drain(watch)
+        assert [(e.type, e.object.metadata.name) for e in events] \
+            == [(DELETED, "p1")]
+        # and once retired, further events for it are suppressed
+        cluster.patch_pod_labels(NS, "p1", {"x": "1"})
+        assert _drain(watch) == []
+        watch.stop()
+
+    def test_starts_matching_surfaces_as_modified(self):
+        """An unseen object that STARTS matching is delivered (the
+        apiserver admits it into the scoped view)."""
+        cluster = _mk_cluster()
+        cluster.add_pod(Pod(metadata=ObjectMeta(
+            name="p1", namespace=NS, labels={"team": "b"}),
+            spec=PodSpec(node_name="node-a")))
+        watch = cluster.watch(label_selector="team=a")
+        cluster.patch_pod_labels(NS, "p1", {"team": "a"})
+        events = _drain(watch)
+        assert [(e.type, e.object.metadata.name) for e in events] \
+            == [(MODIFIED, "p1")]
+        watch.stop()
+
+    def test_real_delete_of_seen_object_delivered(self):
+        cluster = _mk_cluster()
+        cluster.add_pod(Pod(metadata=ObjectMeta(
+            name="p1", namespace=NS, labels={"team": "a"}),
+            spec=PodSpec(node_name="node-a")))
+        watch = cluster.watch(label_selector="team=a")
+        cluster.delete_pod(NS, "p1")
+        events = _drain(watch)
+        assert [(e.type, e.object.metadata.name) for e in events] \
+            == [(DELETED, "p1")]
+        # deleting a never-matching pod stays invisible
+        cluster.add_pod(Pod(metadata=ObjectMeta(
+            name="p2", namespace=NS, labels={"team": "b"}),
+            spec=PodSpec(node_name="node-a")))
+        cluster.delete_pod(NS, "p2")
+        assert _drain(watch) == []
+        watch.stop()
+
+
+class TestIngestFilterSelectorChange:
+    """Satellite 2: the Informer's ingest-filter retiring-DELETE path
+    when the FILTER ITSELF changes mid-watch."""
+
+    def test_modified_after_selector_change_evicts_stored_object(self):
+        cluster = _mk_cluster()
+        cluster.add_pod(Pod(metadata=ObjectMeta(
+            name="p1", namespace=NS, labels={"team": "a"}),
+            spec=PodSpec(node_name="node-a")))
+        cluster.add_pod(Pod(metadata=ObjectMeta(
+            name="p2", namespace=NS, labels={"team": "b"}),
+            spec=PodSpec(node_name="node-a")))
+        wanted = {"team": "a"}
+
+        def flt(pod):
+            return pod.metadata.labels.get("team") == wanted["team"]
+
+        informer = Informer(
+            lister=lambda: cluster.list_pods(namespace=NS),
+            watch=cluster.watch(),
+            threaded=False, ingest_filter=flt)
+        informer.start()
+        assert {p.metadata.name for p in informer.list()} == {"p1"}
+        # the selector swaps (shard handover): p1 no longer matches.
+        # The NEXT MODIFIED for p1 must retire the stored copy BEFORE
+        # any snapshot is built from the cache — without a relist.
+        wanted["team"] = "b"
+        cluster.patch_pod_labels(NS, "p1", {"touch": "1"})
+        deleted = []
+        informer.add_event_handler(on_delete=deleted.append)
+        informer.pump()
+        assert {p.metadata.name for p in informer.list()} == set()
+        assert [p.metadata.name for p in deleted] == ["p1"]
+        informer.stop()
+
+    def test_deleted_event_for_filtered_object_still_applies(self):
+        cluster = _mk_cluster()
+        cluster.add_pod(Pod(metadata=ObjectMeta(
+            name="p1", namespace=NS, labels={"team": "a"}),
+            spec=PodSpec(node_name="node-a")))
+        informer = Informer(
+            lister=lambda: cluster.list_pods(namespace=NS),
+            watch=cluster.watch(),
+            threaded=False,
+            ingest_filter=lambda pod: True)
+        informer.start()
+        cluster.delete_pod(NS, "p1")
+        informer.pump()
+        assert informer.list() == []
+        informer.stop()
+
+
+class TestShardLabelStamper:
+    def _stamper(self):
+        cluster, clock, keys = build_fleet(
+            FleetSpec(n_slices=4, hosts_per_slice=4))
+        return cluster, clock, keys, ShardLabelStamper(ShardRing(4), keys)
+
+    def test_stamp_existing_bootstrap_and_idempotence(self):
+        cluster, clock, keys, stamper = self._stamper()
+        patched = stamper.stamp_existing(cluster, NS)
+        assert patched == len(cluster.list_nodes()) \
+            + len(cluster.list_pods(namespace=NS))
+        for node in cluster.list_nodes():
+            assert node.metadata.labels[stamper.label_key] \
+                == stamper.value_for(
+                    node.metadata.name,
+                    node.metadata.labels.get(GKE_NODEPOOL_LABEL, ""))
+        # second pass: everything already correct, zero patches
+        assert stamper.stamp_existing(cluster, NS) == 0
+
+    def test_admission_stamps_recreated_pods(self):
+        cluster, clock, keys, stamper = self._stamper()
+        stamper.install_admission(cluster)
+        stamper.stamp_existing(cluster, NS)
+        pod = cluster.list_pods(namespace=NS)[0]
+        node = pod.spec.node_name
+        cluster.delete_pod(NS, pod.metadata.name)
+        clock.advance(60.0)
+        cluster.step()  # DS controller recreates the pod
+        recreated = [p for p in cluster.list_pods(namespace=NS)
+                     if p.spec.node_name == node]
+        assert recreated, "DS controller should have recreated the pod"
+        want = stamper.value_for(
+            node, cluster.get_node(node).metadata.labels.get(
+                GKE_NODEPOOL_LABEL, ""))
+        assert recreated[0].metadata.labels[stamper.label_key] == want
+
+    def test_stamps_invariant_across_handover(self):
+        """The crash-ordered handover rule: ownership moves change the
+        SELECTOR, never the stamps — a re-stamping handover would race
+        every in-flight watch."""
+        cluster, clock, keys, stamper = self._stamper()
+        stamper.stamp_existing(cluster, NS)
+        before = {n.metadata.name:
+                  n.metadata.labels.get(stamper.label_key)
+                  for n in cluster.list_nodes()}
+        sel_a = stamper.selector(frozenset({0, 1}))
+        sel_b = stamper.selector(frozenset({2}))
+        assert sel_a != sel_b
+        assert stamper.stamp_existing(cluster, NS) == 0
+        after = {n.metadata.name:
+                 n.metadata.labels.get(stamper.label_key)
+                 for n in cluster.list_nodes()}
+        assert before == after
+
+    def test_empty_ownership_selector_matches_nothing(self):
+        cluster, clock, keys, stamper = self._stamper()
+        stamper.stamp_existing(cluster, NS)
+        watch = cluster.watch(
+            label_selector=stamper.selector(frozenset()))
+        pod = cluster.list_pods(namespace=NS)[0]
+        cluster.patch_pod_labels(NS, pod.metadata.name, {"x": "1"})
+        assert _drain(watch) == []
+        watch.stop()
+
+
+class TestCachedSelectorMode:
+    def test_threaded_selector_fn_rejected(self):
+        cluster, clock, keys = build_fleet(
+            FleetSpec(n_slices=2, hosts_per_slice=4))
+        with pytest.raises(ValueError):
+            CachedReadClient(cluster, NS, threaded=True,
+                             shard_selector_fn=lambda: "a=b")
+
+    def test_refresh_partition_resubscribes_on_selector_change(self):
+        cluster, clock, keys = build_fleet(
+            FleetSpec(n_slices=4, hosts_per_slice=4))
+        stamper = ShardLabelStamper(ShardRing(2), keys)
+        stamper.install_admission(cluster)
+        stamper.stamp_existing(cluster, NS)
+        owned = {"shards": frozenset({0})}
+        cached = CachedReadClient(
+            cluster, NS, threaded=False, relist_interval=None,
+            shard_selector_fn=lambda: stamper.selector(owned["shards"]))
+        ring = ShardRing(2)
+        partition = {
+            p.metadata.name for p in cluster.list_pods(namespace=NS)
+            if ring.shard_for(
+                p.spec.node_name,
+                cluster.get_node(p.spec.node_name).metadata.labels.get(
+                    GKE_NODEPOOL_LABEL, "")) == 0}
+        got = {p.metadata.name for p in cached.list_pods(namespace=NS)}
+        assert got == partition
+        # the apiserver filtered: nothing reached the client to drop
+        assert cached.read_accounting().get("ingestDropped", 0) == 0
+        # handover: ownership widens; refresh_partition must open the
+        # new selector's stream and relist — the cache now holds all
+        owned["shards"] = frozenset({0, 1})
+        cached.refresh_partition()
+        assert len(cached.list_pods(namespace=NS)) \
+            == len(cluster.list_pods(namespace=NS))
+        cached.stop()
+
+
+class TestServerSideCellParity:
+    """End to end: server-side filtered sharded upgrade converges
+    bit-identically to the unfiltered single owner."""
+
+    @pytest.mark.scale
+    def test_64_nodes_server_side_matches_single_owner(self):
+        from latency_bench import run_shard_cell
+
+        single = run_shard_cell(64, 1)
+        sharded = run_shard_cell(64, 2, server_side=True)
+        assert sharded["server_side_watch"]
+        assert sharded["converged"] and single["converged"]
+        assert single.pop("_fingerprint") == sharded.pop("_fingerprint")
+        assert single["makespan_s"] == sharded["makespan_s"]
+        # apiserver-side filtering leaves nothing for the client-side
+        # partition filter to drop in steady state
+        for row in sharded["reads"]:
+            assert row["steady"]["podFullLists"] == 0

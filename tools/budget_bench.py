@@ -378,12 +378,65 @@ def check(result: dict) -> "list[str]":
     return problems
 
 
+def run_vector_cell(target_sessions: int, out_path: str) -> int:
+    """The million-session handover soak (``make bench-budget-1m``):
+    the vectorized serving twin rolls the whole fleet through drain
+    waves at >= ``target_sessions`` concurrent sessions. The result
+    merges into the existing BENCH_budget.json under
+    ``vectorHandoverSoak`` (the 4-cell bench stays intact)."""
+    from tpu_operator_libs.chaos.serving_vec import (
+        run_vector_handover_soak,
+    )
+
+    n_endpoints = 4096
+    utilization = 0.6
+    capacity = max(8, -(-target_sessions
+                        // int(n_endpoints * utilization)))
+    cell = run_vector_handover_soak(
+        n_endpoints=n_endpoints, per_endpoint_capacity=capacity,
+        target_utilization=utilization)
+    cell["targetSessions"] = target_sessions
+    ok = (cell.get("zeroOperatorDrops", False)
+          and cell.get("conserved", False)
+          and cell.get("allUpgraded", False)
+          and cell.get("peakConcurrent", 0) >= target_sessions)
+    cell["acceptanceOk"] = ok
+    merged: dict = {}
+    if os.path.exists(out_path):
+        try:
+            with open(out_path) as fh:
+                merged = json.load(fh)
+        except (OSError, ValueError):
+            merged = {}
+    merged["vectorHandoverSoak"] = cell
+    with open(out_path, "w") as fh:
+        json.dump(merged, fh, indent=2, sort_keys=True)
+        fh.write("\n")
+    print(f"wrote {out_path} (vectorHandoverSoak)")
+    print(f"  endpoints {cell.get('endpoints')} x capacity {capacity}: "
+          f"peak concurrent {cell.get('peakConcurrent')}, sessions "
+          f"{cell.get('sessionsStarted')}, handovers "
+          f"{cell.get('handovers')}")
+    print(f"  operator drops {cell.get('operatorDropped')}, fault "
+          f"drops {cell.get('faultDropped')}, conserved "
+          f"{cell.get('conserved')}, all upgraded "
+          f"{cell.get('allUpgraded')} -> "
+          f"{'OK' if ok else 'ACCEPTANCE FAIL'}")
+    return 0 if ok else 1
+
+
 def main() -> int:
     parser = argparse.ArgumentParser(description=__doc__.split("\n")[0])
     parser.add_argument("--nodes", type=int, default=256)
     parser.add_argument("--seeds", default="1,2,3")
     parser.add_argument("--out", default="BENCH_budget.json")
+    parser.add_argument(
+        "--vector-sessions", type=int, default=None,
+        help="run ONLY the vectorized million-session handover soak "
+        "at >= this many concurrent sessions; merges into --out")
     args = parser.parse_args()
+    if args.vector_sessions is not None:
+        return run_vector_cell(args.vector_sessions, args.out)
     seeds = tuple(int(s) for s in args.seeds.split(",") if s.strip())
     result = run_budget_bench(nodes=args.nodes, seeds=seeds)
     problems = check(result)

@@ -157,10 +157,16 @@ class _SettleValidator:
 def _final_fingerprint(cluster, keys) -> tuple:
     """Every durable bit of cluster state the upgrade can touch. The
     two cells must produce IDENTICAL fingerprints: the scheduling layer
-    may only change when passes run, never what they commit."""
+    may only change when passes run, never what they commit. The one
+    exclusion is the shard stamp (keys.shard_label): it is a pure
+    function of node identity (ring hash), not upgrade state, and the
+    server-side-watch cell carries it while the plain cell does not —
+    comparing them must see through the bookkeeping."""
+    shard_label = keys.shard_label
     nodes = tuple(sorted(
         (n.metadata.name,
-         tuple(sorted(n.metadata.labels.items())),
+         tuple(sorted((k, v) for k, v in n.metadata.labels.items()
+                      if k != shard_label)),
          tuple(sorted(n.metadata.annotations.items())),
          n.is_unschedulable(), n.is_ready())
         for n in cluster.list_nodes()))
@@ -325,7 +331,8 @@ SHARD_TICK_INTERVAL = 30.0
 def run_shard_cell(n_nodes: int, replicas: int,
                    interval: float = SHARD_TICK_INTERVAL,
                    max_sim_seconds: float = 12 * 3600.0,
-                   cached: bool = True) -> dict:
+                   cached: bool = True,
+                   server_side: bool = False) -> dict:
     """One full rolling upgrade, single-owner (``replicas <= 1``) or
     partitioned across ``replicas`` sharded replicas with real
     ShardElectors (per-shard Leases, ownership-filtered snapshots,
@@ -343,6 +350,8 @@ def run_shard_cell(n_nodes: int, replicas: int,
     from tpu_operator_libs.k8s.sharding import (
         ShardElectionConfig,
         ShardElector,
+        ShardLabelStamper,
+        ShardRing,
     )
 
     if n_nodes % HOSTS_PER_SLICE:
@@ -352,6 +361,16 @@ def run_shard_cell(n_nodes: int, replicas: int,
                       pod_recreate_delay=POD_RECREATE_DELAY,
                       pod_ready_delay=POD_READY_DELAY)
     cluster, clock, keys = build_fleet(fleet)
+    stamper = None
+    if server_side and replicas > 1:
+        # Server-side watch sharding: shard labels stamped at admission
+        # (recreated pods are born stamped) + one bootstrap pass for
+        # the pre-built fleet, all BEFORE any replica subscribes its
+        # selector-filtered watch — the crash-ordered admission rule.
+        stamper = ShardLabelStamper(
+            ShardRing(num_shards=replicas * 2), keys)
+        stamper.install_admission(cluster)
+        stamper.stamp_existing(cluster, NS)
     policy = UpgradePolicySpec(
         auto_upgrade=True, max_parallel_upgrades=0,
         max_unavailable="25%", topology_mode="flat",
@@ -373,9 +392,14 @@ def run_shard_cell(n_nodes: int, replicas: int,
     def reader(view) -> object:
         if not cached:
             return cluster
+        selector_fn = None
+        if stamper is not None and view is not None:
+            def selector_fn(view=view):
+                return stamper.selector(view.owned_shards())
         client = CachedReadClient(cluster, NS, threaded=False,
                                   relist_interval=None,
-                                  partition_view=view or _OwnsAll())
+                                  partition_view=view or _OwnsAll(),
+                                  shard_selector_fn=selector_fn)
         clients.append(client)
         return client
 
@@ -442,6 +466,8 @@ def run_shard_cell(n_nodes: int, replicas: int,
         "makespan_s": round(clock.now(), 1),
         "reconciles": reconciles,
         "node_writes": writes,
+        "snapshot_build_mode": managers[0].snapshot_build_mode,
+        "server_side_watch": stamper is not None,
         "_fingerprint": _final_fingerprint(cluster, keys),
     }
     if cached:
@@ -493,7 +519,8 @@ def run_shard_cell(n_nodes: int, replicas: int,
 
 
 def run_shard_bench(sizes: "tuple[int, ...]" = (16384,),
-                    replicas: int = 4) -> dict:
+                    replicas: int = 4,
+                    server_side: bool = False) -> dict:
     """The sharded-control-plane scale proof: per fleet size, one
     single-owner upgrade vs the identical fleet partitioned across
     ``replicas`` sharded replicas — final cluster state must be
@@ -501,11 +528,19 @@ def run_shard_bench(sizes: "tuple[int, ...]" = (16384,),
     with its PARTITION, not the fleet: per-replica steady read load
     (watch objects kept + delegate read objects after the first
     reconcile round) within ~1.3x of the single-owner load divided by
-    the replica count, and steady-state full-fleet pod LISTs at 0."""
-    out: dict = {"replicas": replicas}
+    the replica count, and steady-state full-fleet pod LISTs at 0.
+
+    With ``server_side`` the sharded cell's replicas subscribe
+    selector-filtered watches against admission-stamped shard labels —
+    non-owned events never reach a replica's ingest (they are filtered
+    at the apiserver analogue), so ``ingest_dropped`` collapses toward
+    0 while the fingerprint must still match the unfiltered single
+    owner."""
+    out: dict = {"replicas": replicas, "server_side_watch": server_side}
     for n_nodes in sizes:
         single = run_shard_cell(n_nodes, 1)
-        sharded = run_shard_cell(n_nodes, replicas)
+        sharded = run_shard_cell(n_nodes, replicas,
+                                 server_side=server_side)
         identical = (single.pop("_fingerprint")
                      == sharded.pop("_fingerprint"))
         cell = {
@@ -533,7 +568,86 @@ def run_shard_bench(sizes: "tuple[int, ...]" = (16384,),
                     row["steady"]["podFullLists"]
                     for row in sharded["reads"]),
             }
+            if server_side:
+                # with apiserver-side filtering, non-owned events never
+                # reach the replica, so the client-side partition
+                # filter has (almost) nothing left to drop
+                cell["reads_o_partition"]["ingest_dropped_per_replica"] \
+                    = [row.get("ingest_dropped", 0)
+                       for row in sharded["reads"]]
         out[f"{n_nodes}_nodes"] = cell
+    return out
+
+
+def run_columnar_bench(n_nodes: int = 1 << 20,
+                       replicas: int = 8,
+                       budget_fraction: float = 0.25) -> dict:
+    """``bench-shard-1m``: the million-node pass. Drives the columnar
+    reconcile core (FleetColumns arrays + vectorized classification,
+    budget shares, shard census, LPT wave packing) and its dict twin
+    over the SAME synthetic fleet (deterministic ring placement +
+    seeded durations) to convergence, and asserts the contract the
+    tentpole rests on:
+
+    - **bit-identical convergence** — final (state, done-tick) arrays
+      fingerprint-equal between columnar and dict engines, identical
+      makespan in ticks;
+    - **sub-second incremental builds** — the columnar engine's worst
+      per-replica snapshot build stays under 1 s at 2**20 nodes;
+    - **O(partition) per-replica load** — each replica's delta-event
+      intake stays within 1.3x of fleet/replicas, with ZERO steady
+      full-fleet lists (the engines consume deltas, never relist).
+
+    The dict twin is the semantics oracle: it executes the identical
+    schedule per-node over plain dicts, so any divergence is an engine
+    bug, not workload noise."""
+    from tpu_operator_libs.upgrade.columns import (
+        HAVE_NUMPY,
+        ColumnarFleetEngine,
+        DictFleetEngine,
+        run_engine,
+        synth_fleet,
+    )
+
+    num_shards = replicas * 2
+    out: dict = {
+        "nodes": n_nodes,
+        "replicas": replicas,
+        "shards": num_shards,
+        "budget_fraction": budget_fraction,
+        "numpy": HAVE_NUMPY,
+    }
+    if not HAVE_NUMPY:
+        out["skipped"] = "numpy unavailable; columnar core gated off"
+        return out
+    # round-robin shard ownership across replicas, every shard owned
+    owned = [tuple(s for s in range(num_shards) if s % replicas == r)
+             for r in range(replicas)]
+    col = run_engine(ColumnarFleetEngine(
+        n_nodes, num_shards, owned, budget_fraction=budget_fraction))
+    ref = run_engine(DictFleetEngine(
+        n_nodes, num_shards, owned, budget_fraction=budget_fraction))
+    events = col["events_by_replica"]
+    # every node emits exactly two watch-visible transitions (admit,
+    # done), so the fair per-replica share is events_total / replicas
+    fair = col["events_total"] / replicas if replicas else 0
+    out["columnar"] = col
+    out["dict"] = ref
+    out["fingerprint_identical"] = (col["fingerprint"]
+                                    == ref["fingerprint"])
+    out["makespan_identical"] = (col["makespan_ticks"]
+                                 == ref["makespan_ticks"])
+    out["max_incremental_build_s"] = col["max_build_seconds"]
+    out["sub_second_builds"] = col["max_build_seconds"] < 1.0
+    out["per_replica_events"] = events
+    out["fair_share_events"] = round(fair, 1)
+    out["events_o_partition"] = bool(
+        fair and max(events) <= 1.3 * fair)
+    out["steady_full_fleet_lists"] = max(col["full_fleet_lists"])
+    # sanity on the synthetic fleet itself: the ring must place work
+    # on every shard or the O(partition) claim is vacuous
+    shard_hist = synth_fleet(min(n_nodes, 1 << 16), num_shards)[0]
+    out["_shards_populated"] = int(len(set(shard_hist.tolist())))
     return out
 
 
@@ -572,6 +686,9 @@ def main(argv: "list[str]") -> int:
     interval = RESYNC_INTERVAL
     shard_sizes: "Optional[tuple[int, ...]]" = None
     shard_replicas = 4
+    server_side = False
+    columnar_nodes: "Optional[int]" = None
+    columnar_replicas = 8
     out_path: "Optional[str]" = None
     for i, arg in enumerate(argv):
         if arg == "--out" and i + 1 < len(argv):
@@ -596,17 +713,45 @@ def main(argv: "list[str]") -> int:
             shard_replicas = int(argv[i + 1])
         elif arg.startswith("--shard-replicas="):
             shard_replicas = int(arg.split("=", 1)[1])
-    if shard_sizes is not None:
+        elif arg == "--server-side":
+            server_side = True
+        elif arg == "--columnar-nodes" and i + 1 < len(argv):
+            columnar_nodes = int(argv[i + 1])
+        elif arg.startswith("--columnar-nodes="):
+            columnar_nodes = int(arg.split("=", 1)[1])
+        elif arg == "--columnar-replicas" and i + 1 < len(argv):
+            columnar_replicas = int(argv[i + 1])
+        elif arg.startswith("--columnar-replicas="):
+            columnar_replicas = int(arg.split("=", 1)[1])
+    if columnar_nodes is not None:
+        # the million-node columnar-vs-dict twin-kernel cell
+        # (`make bench-shard-1m`)
+        report = run_columnar_bench(columnar_nodes, columnar_replicas)
+    elif shard_sizes is not None:
         # sharded-control-plane scale proof only (16k default:
         # `make bench-shard`; 100k: `make bench-shard-100k`)
-        report = run_shard_bench(shard_sizes, shard_replicas)
+        report = run_shard_bench(shard_sizes, shard_replicas,
+                                 server_side=server_side)
     else:
         report = run_latency_bench(sizes, interval)
     rendered = json.dumps(report, indent=2)
     print(rendered)
     if out_path:
+        payload = report
+        if columnar_nodes is not None and os.path.exists(out_path):
+            # bench-shard-1m shares BENCH_shard.json with the sharded
+            # scale proof: merge under its own key instead of
+            # clobbering the 16k/100k cells
+            try:
+                with open(out_path) as fh:
+                    existing = json.load(fh)
+            except (OSError, ValueError):
+                existing = None
+            if isinstance(existing, dict) and "columnar" not in existing:
+                existing["columnar1m"] = report
+                payload = existing
         with open(out_path, "w") as fh:
-            fh.write(rendered + "\n")
+            fh.write(json.dumps(payload, indent=2) + "\n")
     return 0
 
 

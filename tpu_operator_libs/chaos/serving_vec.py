@@ -1,0 +1,460 @@
+"""Vectorized serving-fleet twin: millions of concurrent sessions.
+
+:class:`~tpu_operator_libs.chaos.serving.ServingFleetSim` models one
+``ServingEndpoint`` object per node and one heap entry per in-flight
+generation — the right shape for the chaos gates' 256-node fleets, and
+hopeless at a million concurrent sessions (the zero-drop gate's scale
+target). This module is the struct-of-arrays twin:
+
+- **endpoints** are parallel numpy arrays (capacity, model code,
+  interactive flag, draining/alive bits, drain-start stamp, in-flight
+  count);
+- **sessions** are parallel arrays (hosting endpoint row, finish
+  time, alive bit), appended in admission batches and compacted
+  periodically;
+- every per-tick phase is a whole-array op: completions are one mask +
+  one ``bincount`` decrement, the drain-deadline handover re-binds a
+  draining endpoint's sessions onto least-loaded admitting peers of
+  the same model via argsort + repeat slot expansion, and admission
+  fills interactive classes first through the same batched
+  least-loaded slot order.
+
+The SEMANTICS mirror the object sim's class-aware router — a draining
+endpoint finishes or hands over, never drops; an operator eviction is
+legal only on a quiesced endpoint (anything still in flight is an
+operator-attributed drop, the count the gate drives to zero); a node
+kill drops its in-flight sessions on the fault's ledger. Parity is
+asserted semantically (conservation + attribution + zero-drop), not
+bit-for-bit: the twins draw durations from different RNG streams.
+
+``run_vector_handover_soak`` is the million-session cell behind
+``make bench-budget-1m``: a rolling drain-wave upgrade over the whole
+fleet at >1M concurrent sessions, green only with zero
+operator-attributed drops and exact session conservation.
+"""
+
+from __future__ import annotations
+
+import time
+
+try:  # pragma: no cover - exercised implicitly by every import
+    import numpy as np
+
+    HAVE_NUMPY = True
+except Exception:  # pragma: no cover - numpy is baked into the image
+    np = None  # type: ignore[assignment]
+    HAVE_NUMPY = False
+
+
+class VectorServingFleetSim:
+    """Struct-of-arrays serving fleet with batched admission/handover.
+
+    ``models``: endpoint row -> model code (endpoints sharing a code
+    are replicas; sessions only hand over within a code). ``
+    interactive``: per-row flag — interactive rows are admitted first
+    each tick and their target share is sized from their capacity
+    share, like the object sim's priority lane.
+    """
+
+    #: Sessions array is compacted when dead rows exceed this fraction.
+    COMPACT_FRACTION = 0.5
+
+    def __init__(self, models: "list[int]",
+                 interactive: "list[bool]",
+                 per_endpoint_capacity: int = 8,
+                 generation_seconds: "tuple[float, float]" = (15.0, 45.0),
+                 drain_deadline_seconds: float = 60.0,
+                 seed: int = 0) -> None:
+        if not HAVE_NUMPY:
+            raise RuntimeError("VectorServingFleetSim requires numpy")
+        n = len(models)
+        if n == 0 or len(interactive) != n:
+            raise ValueError("models/interactive must be equal-length "
+                             "and non-empty")
+        if per_endpoint_capacity < 1:
+            raise ValueError("per_endpoint_capacity must be >= 1")
+        self.n = n
+        self.capacity = int(per_endpoint_capacity)
+        self.generation_seconds = generation_seconds
+        self.drain_deadline_seconds = float(drain_deadline_seconds)
+        self.model = np.asarray(models, dtype=np.int32)
+        self.interactive = np.asarray(interactive, dtype=bool)
+        self.alive = np.ones(n, dtype=bool)
+        self.draining = np.zeros(n, dtype=bool)
+        self.drain_started = np.full(n, np.nan)
+        self.in_flight = np.zeros(n, dtype=np.int64)
+        self._rng = np.random.default_rng(seed)
+        # session SoA (grow-by-append, compact when mostly dead)
+        cap = 1024
+        self._s_ep = np.zeros(cap, dtype=np.int32)
+        self._s_finish = np.zeros(cap)
+        self._s_alive = np.zeros(cap, dtype=bool)
+        self._s_len = 0
+        self._now = 0.0
+        # fleet ledgers
+        self.sessions_started = 0
+        self.completed = 0
+        self.operator_dropped = 0
+        self.fault_dropped = 0
+        self.handovers = 0
+        self.unserved = 0
+        self.peak_concurrent = 0
+        self.tick_seconds_total = 0.0
+        self.max_tick_seconds = 0.0
+        self.ticks = 0
+
+    # -- session storage ----------------------------------------------
+    def _ensure_capacity(self, extra: int) -> None:
+        need = self._s_len + extra
+        cap = len(self._s_ep)
+        if need <= cap:
+            return
+        new_cap = max(need, cap * 2)
+        for attr in ("_s_ep", "_s_finish", "_s_alive"):
+            arr = getattr(self, attr)
+            grown = np.zeros(new_cap, dtype=arr.dtype)
+            grown[:cap] = arr
+            setattr(self, attr, grown)
+
+    def _compact(self) -> None:
+        used = self._s_alive[:self._s_len]
+        live = int(np.count_nonzero(used))
+        if self._s_len - live < self._s_len * self.COMPACT_FRACTION:
+            return
+        keep = np.nonzero(used)[0]
+        self._s_ep[:live] = self._s_ep[keep]
+        self._s_finish[:live] = self._s_finish[keep]
+        self._s_alive[:live] = True
+        self._s_alive[live:self._s_len] = False
+        self._s_len = live
+
+    def total_in_flight(self) -> int:
+        return int(self.in_flight.sum())
+
+    # -- operator-visible surface -------------------------------------
+    def begin_drain(self, rows: "np.ndarray") -> None:
+        """Cordon rows for upgrade: stop admitting, stamp the drain
+        start (the handover deadline's anchor)."""
+        rows = np.asarray(rows, dtype=np.int64)
+        fresh = rows[~self.draining[rows] & self.alive[rows]]
+        self.draining[fresh] = True
+        self.drain_started[fresh] = self._now
+
+    def quiesced(self) -> "np.ndarray":
+        """Rows legal to evict NOW: draining with nothing in flight."""
+        return np.nonzero(self.alive & self.draining
+                          & (self.in_flight == 0))[0]
+
+    def evict(self, rows: "np.ndarray") -> int:
+        """Operator eviction. A correctly-sequenced operator only
+        evicts quiesced rows; sessions still in flight on an evicted
+        row are OPERATOR drops — the zero-drop ledger."""
+        rows = np.asarray(rows, dtype=np.int64)
+        rows = rows[self.alive[rows]]
+        dropped = self._drop_sessions_on(rows)
+        self.operator_dropped += dropped
+        self.alive[rows] = False
+        self.draining[rows] = False
+        self.drain_started[rows] = np.nan
+        return dropped
+
+    def kill(self, rows: "np.ndarray") -> int:
+        """Fault kill (node death): in-flight sessions drop on the
+        FAULT's ledger."""
+        rows = np.asarray(rows, dtype=np.int64)
+        rows = rows[self.alive[rows]]
+        dropped = self._drop_sessions_on(rows)
+        self.fault_dropped += dropped
+        self.alive[rows] = False
+        self.draining[rows] = False
+        self.drain_started[rows] = np.nan
+        return dropped
+
+    def restart(self, rows: "np.ndarray") -> None:
+        """The upgraded (or rescheduled) replica is back and admitting."""
+        rows = np.asarray(rows, dtype=np.int64)
+        self.alive[rows] = True
+        self.draining[rows] = False
+        self.drain_started[rows] = np.nan
+
+    def _drop_sessions_on(self, rows: "np.ndarray") -> int:
+        if rows.size == 0:
+            return 0
+        used = slice(0, self._s_len)
+        mask = self._s_alive[used] \
+            & np.isin(self._s_ep[used], rows.astype(np.int32))
+        dropped = int(np.count_nonzero(mask))
+        if dropped:
+            self._s_alive[used][mask] = False
+            self.in_flight[rows] = 0
+        return dropped
+
+    # -- the tick phases ----------------------------------------------
+    def _complete_due(self, now: float) -> int:
+        used = slice(0, self._s_len)
+        due = self._s_alive[used] & (self._s_finish[used] <= now)
+        n_due = int(np.count_nonzero(due))
+        if n_due:
+            per_ep = np.bincount(self._s_ep[used][due],
+                                 minlength=self.n)
+            self.in_flight -= per_ep.astype(np.int64)
+            self._s_alive[used][due] = False
+            self.completed += n_due
+        return n_due
+
+    def _free_slots_order(self, candidate_rows: "np.ndarray",
+                          ) -> "np.ndarray":
+        """Expand candidate endpoints into admission slots, least
+        loaded first: argsort by in-flight, then repeat each row by its
+        free capacity. A batched analogue of the object router's
+        re-evaluated least-loaded pick — load spreads the same way to
+        within one batch."""
+        free = self.capacity - self.in_flight[candidate_rows]
+        keep = free > 0
+        candidate_rows = candidate_rows[keep]
+        free = free[keep]
+        if candidate_rows.size == 0:
+            return np.empty(0, dtype=np.int64)
+        order = np.argsort(self.in_flight[candidate_rows],
+                           kind="stable")
+        return np.repeat(candidate_rows[order], free[order])
+
+    def _handover_pass(self, now: float) -> None:
+        """Sessions on deadline-expired drains re-bind to admitting
+        peers of the same model (never dropped; with no peer capacity
+        they stay put and the drain keeps waiting)."""
+        overdue = self.alive & self.draining & (self.in_flight > 0) \
+            & (now - self.drain_started >= self.drain_deadline_seconds)
+        overdue_rows = np.nonzero(overdue)[0]
+        if overdue_rows.size == 0:
+            return
+        used = slice(0, self._s_len)
+        sess_mask = self._s_alive[used] & np.isin(
+            self._s_ep[used], overdue_rows.astype(np.int32))
+        sess_idx = np.nonzero(sess_mask)[0]
+        if sess_idx.size == 0:
+            return
+        admitting = self.alive & ~self.draining
+        sess_models = self.model[self._s_ep[sess_idx]]
+        for code in np.unique(sess_models):
+            model_sess = sess_idx[sess_models == code]
+            peers = np.nonzero(admitting & (self.model == code))[0]
+            slots = self._free_slots_order(peers)
+            take = min(len(model_sess), len(slots))
+            if take == 0:
+                continue
+            moved_from = self._s_ep[model_sess[:take]]
+            targets = slots[:take]
+            self._s_ep[model_sess[:take]] = targets.astype(np.int32)
+            self.in_flight -= np.bincount(moved_from,
+                                          minlength=self.n)
+            self.in_flight += np.bincount(targets, minlength=self.n)
+            self.handovers += take
+
+    def _admit(self, now: float, rows_mask: "np.ndarray",
+               target: int) -> int:
+        """Admit toward ``target`` in-flight over ``rows_mask``'s
+        class pool; returns the unplaced shortfall."""
+        current = int(self.in_flight[rows_mask].sum())
+        want = target - current
+        if want <= 0:
+            return 0
+        candidates = np.nonzero(rows_mask & self.alive
+                                & ~self.draining)[0]
+        slots = self._free_slots_order(candidates)
+        take = min(want, len(slots))
+        if take:
+            targets = slots[:take]
+            lo, hi = self.generation_seconds
+            finish = now + self._rng.uniform(lo, hi, size=take)
+            self._ensure_capacity(take)
+            start = self._s_len
+            self._s_ep[start:start + take] = targets.astype(np.int32)
+            self._s_finish[start:start + take] = finish
+            self._s_alive[start:start + take] = True
+            self._s_len = start + take
+            self.in_flight += np.bincount(targets, minlength=self.n)
+            self.sessions_started += take
+        return want - take
+
+    def tick(self, now: float, target_in_flight: int) -> dict:
+        """One replay step: complete due sessions, hand over off
+        deadline-expired drains, admit toward the target (interactive
+        first). The caller owns drain/evict/kill/restart sequencing
+        between ticks — the operator's half of the contract."""
+        started = time.perf_counter()
+        self._now = now
+        self._complete_due(now)
+        self._handover_pass(now)
+        cap_interactive = int(np.count_nonzero(
+            self.interactive)) * self.capacity
+        cap_total = self.n * self.capacity
+        share = cap_interactive / cap_total if cap_total else 0.0
+        target_interactive = int(round(target_in_flight * share))
+        shortfall = self._admit(now, self.interactive,
+                                target_interactive)
+        shortfall += self._admit(now, ~self.interactive,
+                                 target_in_flight - target_interactive)
+        self.unserved += shortfall
+        self._compact()
+        concurrent = self.total_in_flight()
+        self.peak_concurrent = max(self.peak_concurrent, concurrent)
+        elapsed = time.perf_counter() - started
+        self.tick_seconds_total += elapsed
+        self.max_tick_seconds = max(self.max_tick_seconds, elapsed)
+        self.ticks += 1
+        return {
+            "now": now,
+            "target": target_in_flight,
+            "inFlight": concurrent,
+            "shortfall": shortfall,
+        }
+
+    # -- invariants ----------------------------------------------------
+    def conserved(self) -> bool:
+        """Every session started is completed, dropped (attributed), or
+        still in flight — nothing leaks."""
+        return self.sessions_started == (
+            self.completed + self.operator_dropped
+            + self.fault_dropped + self.total_in_flight())
+
+    def summary(self) -> dict:
+        return {
+            "endpoints": self.n,
+            "sessionsStarted": self.sessions_started,
+            "completed": self.completed,
+            "operatorDropped": self.operator_dropped,
+            "faultDropped": self.fault_dropped,
+            "handovers": self.handovers,
+            "unserved": self.unserved,
+            "peakConcurrent": self.peak_concurrent,
+            "inFlight": self.total_in_flight(),
+            "conserved": self.conserved(),
+            "ticks": self.ticks,
+            "tickSecondsTotal": round(self.tick_seconds_total, 3),
+            "maxTickSeconds": round(self.max_tick_seconds, 4),
+        }
+
+
+def build_vector_fleet(n_endpoints: int,
+                       interactive_fraction: float = 0.25,
+                       replicas_per_model: int = 4,
+                       ) -> "tuple[list[int], list[bool]]":
+    """Deterministic model/class layout mirroring
+    :func:`~tpu_operator_libs.chaos.serving.assign_traffic`'s shape:
+    the first ``interactive_fraction`` of endpoints are interactive,
+    grouped ``replicas_per_model`` to a model (>=2 replicas per model,
+    so every drain has a same-model handover peer), the rest batch."""
+    n_interactive = int(round(n_endpoints * interactive_fraction))
+    models: "list[int]" = []
+    interactive: "list[bool]" = []
+    per = max(2, int(replicas_per_model))
+    for i in range(n_endpoints):
+        if i < n_interactive:
+            models.append(i // per)
+            interactive.append(True)
+        else:
+            models.append(1_000_000 + (i - n_interactive) // per)
+            interactive.append(False)
+    return models, interactive
+
+
+def run_vector_handover_soak(n_endpoints: int = 4096,
+                             per_endpoint_capacity: int = 512,
+                             target_utilization: float = 0.6,
+                             wave_fraction: float = 0.25,
+                             tick_seconds: float = 5.0,
+                             restart_delay_ticks: int = 3,
+                             generation_seconds: "tuple[float, float]"
+                             = (15.0, 45.0),
+                             drain_deadline_seconds: float = 30.0,
+                             seed: int = 20260807,
+                             max_ticks: int = 20_000) -> dict:
+    """The million-session handover soak (``make bench-budget-1m``).
+
+    Rolls the WHOLE fleet through drain waves (``wave_fraction`` of
+    endpoints at a time, never two replicas of one model in the same
+    wave beyond what peer capacity covers) under sustained load sized
+    to ``target_utilization`` of fleet capacity — at the 4096x512
+    default that is >1.2M concurrent sessions. Per wave: begin_drain,
+    let sessions finish or hand over behind the deadline, evict ONLY
+    quiesced endpoints, restart them ``restart_delay_ticks`` later.
+    Green = every endpoint upgraded, ZERO operator-attributed drops,
+    conservation exact."""
+    if not HAVE_NUMPY:
+        return {"skipped": "numpy unavailable"}
+    models, interactive = build_vector_fleet(n_endpoints)
+    sim = VectorServingFleetSim(
+        models, interactive,
+        per_endpoint_capacity=per_endpoint_capacity,
+        generation_seconds=generation_seconds,
+        drain_deadline_seconds=drain_deadline_seconds,
+        seed=seed)
+    fleet_capacity = n_endpoints * per_endpoint_capacity
+    target = int(fleet_capacity * target_utilization)
+    # strided wave order: consecutive rows are replicas of one model,
+    # so contiguous waves would drain whole models at once and starve
+    # the handover of same-model peers. One-in-k striding keeps every
+    # model mostly admitting through every wave — the rolling-upgrade
+    # shape the ranker enforces for real.
+    num_waves = max(1, int(round(1.0 / max(1e-9, wave_fraction))))
+    pending = [r for k in range(num_waves)
+               for r in range(n_endpoints) if r % num_waves == k]
+    wave_size = max(1, -(-n_endpoints // num_waves))
+    upgraded: "set[int]" = set()
+    wave: "list[int]" = []
+    evicted_at: "dict[int, int]" = {}
+    waves = 0
+    now = 0.0
+    # warm the fleet to steady load before the first wave
+    for t in range(10):
+        sim.tick(now, target)
+        now += tick_seconds
+    tick_no = 10
+    while (pending or wave or evicted_at) and tick_no < max_ticks:
+        if not wave and pending:
+            wave = pending[:wave_size]
+            pending = pending[wave_size:]
+            sim.begin_drain(np.asarray(wave, dtype=np.int64))
+            waves += 1
+        # evict whatever quiesced (the gate's correct sequencing)
+        if wave:
+            wave_arr = np.asarray(wave, dtype=np.int64)
+            quiet = wave_arr[np.isin(wave_arr, sim.quiesced())]
+            if quiet.size:
+                sim.evict(quiet)
+                for row in quiet.tolist():
+                    evicted_at[row] = tick_no
+                wave = [r for r in wave if r not in set(quiet.tolist())]
+        # restart evicted endpoints after the upgrade delay
+        back = [r for r, t0 in evicted_at.items()
+                if tick_no - t0 >= restart_delay_ticks]
+        if back:
+            sim.restart(np.asarray(back, dtype=np.int64))
+            for row in back:
+                del evicted_at[row]
+                upgraded.add(row)
+        sim.tick(now, target)
+        now += tick_seconds
+        tick_no += 1
+    out = sim.summary()
+    out.update({
+        "fleetCapacity": fleet_capacity,
+        "targetInFlight": target,
+        "waves": waves,
+        "upgraded": len(upgraded),
+        "allUpgraded": len(upgraded) == n_endpoints,
+        "converged": not (pending or wave or evicted_at),
+        "zeroOperatorDrops": out["operatorDropped"] == 0,
+        "millionConcurrent": out["peakConcurrent"] >= 1_000_000,
+        "virtualSeconds": round(now, 1),
+    })
+    return out
+
+
+__all__ = [
+    "HAVE_NUMPY",
+    "VectorServingFleetSim",
+    "build_vector_fleet",
+    "run_vector_handover_soak",
+]

@@ -470,6 +470,16 @@ class UpgradeKeys:
         return f"{self.domain}/{self.driver}-upgrade.skip"
 
     @property
+    def shard_label(self) -> str:
+        """Ring-derived shard id stamped on nodes AND runtime pods at
+        admission (k8s/sharding.py ShardLabelStamper): the selector key
+        server-side watch sharding filters each replica's LIST/WATCH
+        with. The value depends only on the ring (name/pool hash), so
+        concurrent stampers always write identical values and shard
+        handovers never re-stamp — only the watcher's selector moves."""
+        return f"{self.domain}/{self.driver}-upgrade.shard"
+
+    @property
     def wait_for_safe_load_annotation(self) -> str:
         """Annotation the runtime init container sets to request a safe
         (cordoned + drained) first load (consts.go:24-27)."""

@@ -317,6 +317,31 @@ class Informer:
             self._dispatch_add(obj)
         self._synced.set()
 
+    def resubscribe(self) -> None:
+        """Replace the watch stream through the ``rewatch`` factory and
+        schedule a relist (pump mode only).
+
+        The seam a server-side selector change rides on: when the
+        subscription's selector must move (shard handover narrowing a
+        partition watch), the OLD stream's events no longer describe
+        the wanted view and a fresh subscription + relist is the only
+        repair. Ordering matters for crash safety: the new stream is
+        opened BEFORE the old one stops, so no event gap opens between
+        the two, and the relist (applied through the ingest filter)
+        retires cached objects the new selector no longer covers.
+        Threaded informers cannot use this — their ``_run`` loop exits
+        permanently when its watch stops."""
+        if self._threaded:
+            raise RuntimeError(f"{self._name}: resubscribe() is for "
+                               f"unthreaded informers")
+        if self._rewatch is None:
+            raise RuntimeError(f"{self._name}: resubscribe() needs a "
+                               f"rewatch factory")
+        old = self._watch
+        self._watch = self._rewatch()
+        old.stop()
+        self._needs_refresh = True
+
     def pump(self, max_events: Optional[int] = None) -> int:
         """Apply every queued watch event inline (unthreaded mode).
 
@@ -548,6 +573,11 @@ class Informer:
             for _, on_update, _ in self._handlers:
                 if on_update is not None:
                     self._safe(on_update, old, obj)
+        # a completed relist satisfies any pending refresh request
+        # (resubscribe(), a failed earlier refresh) — without this an
+        # inline refresh after resubscribe would relist a second time
+        # on the next pump for nothing
+        self._needs_refresh = False
 
     def apply_external(self, obj: object) -> None:
         """Apply a write RESULT directly to the cache (read-your-writes).

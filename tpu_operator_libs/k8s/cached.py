@@ -266,7 +266,9 @@ class CachedReadClient(K8sClient):
                  require_sync: bool = True,
                  relist_interval: Optional[float] = 300.0,
                  threaded: bool = True,
-                 partition_view: Optional[object] = None) -> None:
+                 partition_view: Optional[object] = None,
+                 shard_selector_fn: Optional[Callable[[], str]] = None,
+                 ) -> None:
         # Deferred: controller.py imports k8s.watch, whose package
         # __init__ re-exports this module — a top-level import of
         # controller here would be circular for any consumer that
@@ -309,20 +311,41 @@ class CachedReadClient(K8sClient):
             self._partition_filter = ShardPartitionFilter(
                 partition_view,
                 lambda name: self._nodes.get("", name))
+        # Server-side watch sharding: with a selector factory installed
+        # the POD cache's LIST and WATCH both carry the current shard
+        # selector, so the apiserver filters the stream to the owned
+        # partition — per-replica watch traffic and relist volume drop
+        # to O(partition) instead of "ingest the fleet, drop the rest".
+        # The client-side partition filter stays installed as the
+        # authoritative (fail-open) backstop: a pod whose stamp lags an
+        # ownership move is still judged against the live view. Pump
+        # mode only — a selector swap re-subscribes the pod watch,
+        # which a threaded informer's run loop cannot survive.
+        self._shard_selector_fn = shard_selector_fn
+        if shard_selector_fn is not None and threaded:
+            raise ValueError(
+                "shard_selector_fn requires threaded=False: selector "
+                "handover re-subscribes the pod watch via "
+                "Informer.resubscribe(), a pump-mode-only operation")
+        self._pod_watch_selector = (shard_selector_fn()
+                                    if shard_selector_fn is not None
+                                    else "")
         self._nodes = Informer(
             self._counted_lister("nodes", delegate.list_nodes),
             delegate.watch(kinds={KIND_NODE}),
             name="node-cache", threaded=threaded,
             rewatch=lambda: delegate.watch(kinds={KIND_NODE}))
+        # the lister/rewatch helpers read the CURRENT selector at call
+        # time: a post-handover relist or re-subscription is filtered
+        # to the new partition without rebuilding the informer
         self._pods = Informer(
             self._counted_lister(
                 "pods",
-                lambda: delegate.list_pods(namespace=namespace)),
-            delegate.watch(kinds={KIND_POD}, namespace=namespace),
+                lambda: self._list_pods_for_cache(namespace)),
+            self._pod_watch(namespace),
             name="pod-cache", threaded=threaded,
             ingest_filter=self._partition_filter,
-            rewatch=lambda: delegate.watch(kinds={KIND_POD},
-                                           namespace=namespace))
+            rewatch=lambda: self._pod_watch(namespace))
         self._daemon_sets = Informer(
             self._counted_lister(
                 "daemon_sets",
@@ -447,6 +470,25 @@ class CachedReadClient(K8sClient):
         return lister
 
     # -- partition pushdown (sharded replicas) ----------------------------
+    def _list_pods_for_cache(self, namespace: str) -> list:
+        """Pod-cache lister: shard-selector filtered when server-side
+        watch sharding is on (the delegate only returns the partition),
+        namespace-wide otherwise. Kwarg-gated so delegates predating
+        the ``label_selector`` watch/list parameter keep working."""
+        if self._shard_selector_fn is None:
+            return self._delegate.list_pods(namespace=namespace)
+        return self._delegate.list_pods(
+            namespace=namespace,
+            label_selector=self._pod_watch_selector)
+
+    def _pod_watch(self, namespace: str):
+        if self._shard_selector_fn is None:
+            return self._delegate.watch(kinds={KIND_POD},
+                                        namespace=namespace)
+        return self._delegate.watch(
+            kinds={KIND_POD}, namespace=namespace,
+            label_selector=self._pod_watch_selector)
+
     def set_partition_filter(self, view: Optional[object]) -> None:
         """Install (or clear, with ``None``) the shard-partition filter
         on the pod cache. Prefer the ``partition_view`` constructor
@@ -478,9 +520,24 @@ class CachedReadClient(K8sClient):
         (``ClusterDeltaView.mark_full``); the relist emits add/delete
         handler events for changed keys only, and a consumer patching a
         partial previous snapshot must not trust its unchanged entries
-        across an ownership move."""
+        across an ownership move.
+
+        Under server-side watch sharding this is also the crash-ordered
+        selector-handover point: the selector factory is re-evaluated,
+        and a changed selector re-subscribes the pod watch BEFORE the
+        relist — the caller (the state manager's ownership-move branch)
+        has already re-stamped the newly-owned partition by the time it
+        calls here, so the narrowed/widened stream misses nothing and
+        the relist both fills the new partition and retires the old
+        one's cached pods."""
         with self._counters_lock:
             self.partition_refreshes_total += 1
+        fn = self._shard_selector_fn
+        if fn is not None:
+            selector = fn()
+            if selector != self._pod_watch_selector:
+                self._pod_watch_selector = selector
+                self._pods.resubscribe()
         self._pods.refresh()
 
     def pump(self) -> int:

@@ -92,6 +92,14 @@ class K8sClient(abc.ABC):
     def delete_pod(self, namespace: str, name: str) -> None:
         """Delete a pod; raises NotFoundError if absent."""
 
+    def patch_pod_labels(self, namespace: str, name: str,
+                         labels: "Mapping[str, Optional[str]]") -> Pod:
+        """Merge-patch pod labels (None deletes a key); returns the
+        patched pod. Optional capability (shard-selector stamping):
+        implemented by FakeCluster and RealCluster."""
+        raise NotImplementedError(
+            f"{type(self).__name__} does not support pod label patches")
+
     @abc.abstractmethod
     def evict_pod(self, namespace: str, name: str) -> None:
         """Evict a pod via the eviction subresource (drain path). May raise
@@ -99,12 +107,17 @@ class K8sClient(abc.ABC):
 
     # -- watches ----------------------------------------------------------
     def watch(self, kinds: Optional[set[str]] = None,
-              namespace: Optional[str] = None) -> "Watch":
+              namespace: Optional[str] = None,
+              label_selector: str = "") -> "Watch":
         """Stream change events (k8s.watch.WatchEvent) for Nodes / Pods /
         DaemonSets, optionally filtered by kind set and (for namespaced
-        kinds) namespace. Returns a k8s.watch.Watch. Optional capability:
-        implemented by FakeCluster and RealCluster; other backends may
-        leave it unsupported and drive reconciles by polling."""
+        kinds) namespace. ``label_selector`` filters server side: only
+        matching objects' events arrive, and an already-delivered object
+        that stops matching is surfaced as DELETED on this stream (the
+        apiserver's selector-scoped view semantics). Returns a
+        k8s.watch.Watch. Optional capability: implemented by FakeCluster
+        and RealCluster; other backends may leave it unsupported and
+        drive reconciles by polling."""
         raise NotImplementedError(
             f"{type(self).__name__} does not support watches")
 

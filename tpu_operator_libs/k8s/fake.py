@@ -70,6 +70,7 @@ from tpu_operator_libs.k8s.watch import (
     MODIFIED,
     Watch,
     WatchBroadcaster,
+    WatchEvent,
 )
 from tpu_operator_libs.util import Clock, FakeClock
 
@@ -173,6 +174,14 @@ class FakeCluster(K8sClient):
         self._frozen: Optional[str] = None
         #: Mutating calls rejected while frozen (tripwire evidence).
         self.frozen_write_attempts = 0
+        # Admission mutators (kind -> [fn(obj)]): applied to the STORED
+        # copy of every object of that kind as it enters the store —
+        # creation helpers AND controller-sim recreations — before its
+        # watch event fires. The mutating-webhook seam: shard-selector
+        # stamping uses it so recreated pods are born carrying their
+        # partition label and a server-side-filtered watch never
+        # misses the recreation.
+        self._admission_mutators: dict[str, list] = {}
 
     def freeze(self, reason: str = "preflight") -> None:
         """Flip the store read-only: every subsequent mutating call —
@@ -227,7 +236,8 @@ class FakeCluster(K8sClient):
     def watch(self, kinds: Optional[set[str]] = None,
               namespace: Optional[str] = None,
               max_queue: Optional[int] = None,
-              delay_exempt: bool = False) -> Watch:
+              delay_exempt: bool = False,
+              label_selector: str = "") -> Watch:
         """Subscribe to change events, optionally filtered to a kind set
         ({"Node", "Pod", "DaemonSet"}) and — for namespaced kinds — a
         namespace. Snapshot copies only. Signature matches
@@ -235,16 +245,68 @@ class FakeCluster(K8sClient):
         ``max_queue`` bounds the subscriber's buffer (overflow drops
         events and delivers a BOOKMARK resync marker, k8s.watch.Watch);
         ``delay_exempt`` keeps the stream live through a watch-delay
-        fault window (harness/auditor streams only)."""
+        fault window (harness/auditor streams only).
+
+        ``label_selector`` server-side filters the stream with the
+        apiserver's exact semantics: only events for matching objects
+        are delivered, and an object this stream HAS delivered that
+        stops matching (label change mid-watch) is surfaced as a
+        synthetic DELETED — the selector-scoped view genuinely lost
+        the object, and a consumer that cached it must evict it."""
         predicate = None
         if namespace:
             def predicate(event):
                 meta = getattr(event.object, "metadata", None)
                 ns = getattr(meta, "namespace", "")
                 return not ns or ns == namespace
+        transform = (self._selector_transform(label_selector)
+                     if label_selector else None)
         return self._broadcaster.subscribe(kinds, predicate,
                                            max_queue=max_queue,
-                                           delay_exempt=delay_exempt)
+                                           delay_exempt=delay_exempt,
+                                           transform=transform)
+
+    def _selector_transform(self, label_selector: str):
+        """Per-subscription server-side selector state machine. The
+        ``seen`` set (primed from the live store under the lock, so a
+        subscriber that LISTs right after subscribing agrees with its
+        stream) tracks which objects this stream's view contains;
+        membership decides whether a stops-matching MODIFIED becomes a
+        retiring DELETED or is silently suppressed."""
+        match = parse_label_selector(label_selector)
+        seen: set[tuple[str, str, str]] = set()
+        with self._lock:
+            for node in self._nodes.values():
+                if match(node.metadata.labels):
+                    seen.add((KIND_NODE, "", node.metadata.name))
+            for (ns, name), pod in self._pods.items():
+                if match(pod.metadata.labels):
+                    seen.add((KIND_POD, ns, name))
+            for (ns, name), ds in self._daemon_sets.items():
+                if match(ds.metadata.labels):
+                    seen.add((KIND_DAEMON_SET, ns, name))
+
+        def transform(event: WatchEvent) -> Optional[WatchEvent]:
+            meta = getattr(event.object, "metadata", None)
+            if meta is None:
+                return event  # BOOKMARK-style markers pass through
+            key = (event.kind, getattr(meta, "namespace", "") or "",
+                   meta.name)
+            if event.type == DELETED:
+                was_seen = key in seen
+                seen.discard(key)
+                return event if (was_seen or match(meta.labels)) else None
+            if match(meta.labels):
+                seen.add(key)
+                return event
+            if key in seen:
+                # stopped matching mid-watch: this selector's view lost
+                # the object — the apiserver emits DELETED here
+                seen.discard(key)
+                return WatchEvent(DELETED, event.kind, event.object)
+            return None
+
+        return transform
 
     def drop_watch_streams(self) -> int:
         """Fault injection: close every open watch stream, the way a real
@@ -321,11 +383,28 @@ class FakeCluster(K8sClient):
     def clock(self) -> Clock:
         return self._clock
 
+    def add_admission_mutator(self, kind: str,
+                              fn: Callable[[object], None]) -> None:
+        """Register a mutating-admission hook for ``kind`` ("Node" /
+        "Pod" / ...): applied to the stored copy of every object of
+        that kind entering the store — test helpers and controller-sim
+        recreations alike — before its watch event is emitted. Hooks
+        must be idempotent (replacement writes re-run them, like a
+        real mutating webhook on UPDATE)."""
+        with self._lock:
+            self._admission_mutators.setdefault(kind, []).append(fn)
+
+    def _admit(self, kind: str, obj: object) -> None:
+        for fn in self._admission_mutators.get(kind, ()):
+            fn(obj)
+
     def add_node(self, node: Node) -> Node:
         self._check_frozen("add_node")
         with self._lock:
-            self._nodes[node.metadata.name] = node.clone()
-            self._notify(ADDED, KIND_NODE, node)
+            stored = node.clone()
+            self._admit(KIND_NODE, stored)
+            self._nodes[node.metadata.name] = stored
+            self._notify(ADDED, KIND_NODE, stored)
         return node
 
     def delete_node(self, name: str) -> None:
@@ -370,7 +449,11 @@ class FakeCluster(K8sClient):
                 self._schedule(cfg.pod_gc_delay, gc)
 
     def _pod_put(self, pod: Pod) -> None:
-        """Insert/replace a pod in the store + nodeName index (lock held)."""
+        """Insert/replace a pod in the store + nodeName index (lock held).
+        Admission mutators run here — the single choke point every pod
+        insertion (helpers AND DS-controller recreations) flows
+        through, so a recreated pod is stamped before its ADDED event."""
+        self._admit(KIND_POD, pod)
         key = (pod.metadata.namespace, pod.metadata.name)
         if key in self._pods:
             # replacing an existing pod: drop its old index entry, which
@@ -395,8 +478,10 @@ class FakeCluster(K8sClient):
     def add_pod(self, pod: Pod) -> Pod:
         self._check_frozen("add_pod")
         with self._lock:
-            self._pod_put(pod.clone())
-            self._notify(ADDED, KIND_POD, pod)
+            stored = pod.clone()
+            self._pod_put(stored)
+            # notify with the stored copy: admission mutators ran on it
+            self._notify(ADDED, KIND_POD, stored)
         return pod
 
     @staticmethod
@@ -814,6 +899,23 @@ class FakeCluster(K8sClient):
                     node.metadata.labels[key] = value
             self._notify(MODIFIED, KIND_NODE, node)
             return node.clone()
+
+    def patch_pod_labels(self, namespace: str, name: str,
+                         labels: Mapping[str, Optional[str]]) -> Pod:
+        self._check_frozen("patch_pod_labels")
+        self._maybe_api_error("patch_pod_labels")
+        with self._lock:
+            pod = self._pods.get((namespace, name))
+            if pod is None:
+                raise NotFoundError(f"pod {namespace}/{name} not found")
+            for key, value in labels.items():
+                if value is None:
+                    pod.metadata.labels.pop(key, None)
+                else:
+                    pod.metadata.labels[key] = value
+            pod.metadata.resource_version += 1
+            self._notify(MODIFIED, KIND_POD, pod)
+            return pod.clone()
 
     def patch_node_annotations(self, name: str,
                                annotations: Mapping[str, Optional[str]]) -> Node:

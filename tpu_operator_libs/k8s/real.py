@@ -374,6 +374,15 @@ class RealCluster(K8sClient):
         except self._k8s.ApiException as exc:
             raise self._translate(exc) from exc
 
+    def patch_pod_labels(self, namespace: str, name: str,
+                         labels: Mapping[str, Optional[str]]) -> Pod:
+        body = {"metadata": {"labels": dict(labels)}}
+        try:
+            return _pod_from(self._core.patch_namespaced_pod(
+                name, namespace, body))
+        except self._k8s.ApiException as exc:
+            raise self._translate(exc) from exc
+
     def evict_pod(self, namespace: str, name: str) -> None:
         eviction = self._k8s.V1Eviction(
             metadata=self._k8s.V1ObjectMeta(name=name, namespace=namespace))
@@ -385,14 +394,17 @@ class RealCluster(K8sClient):
 
     # -- watches -------------------------------------------------------------
     def watch(self, kinds: Optional[set[str]] = None,
-              namespace: Optional[str] = None) -> "watch_mod.Watch":
+              namespace: Optional[str] = None,
+              label_selector: str = "") -> "watch_mod.Watch":
         """Stream Node/Pod/DaemonSet change events as
         :class:`tpu_operator_libs.k8s.watch.WatchEvent`, for driving a
         :class:`tpu_operator_libs.controller.Controller` (the live
         equivalent of FakeCluster.watch). One pump thread per kind;
         expired server watches are transparently restarted, which may
         re-deliver the current object set as ADDED events — harmless to a
-        level-triggered reconcile."""
+        level-triggered reconcile. ``label_selector`` is pushed down to
+        the server watches: the apiserver filters the stream and itself
+        emits DELETED for objects that stop matching."""
         import threading
 
         from tpu_operator_libs.k8s import watch as watch_mod
@@ -415,28 +427,32 @@ class RealCluster(K8sClient):
                     pass
 
         sub = watch_mod.Watch(on_stop=on_stop)
+        selector_kwargs = (
+            {"label_selector": label_selector} if label_selector else {})
         sources = []
         if watch_mod.KIND_NODE in wanted:
-            sources.append((watch_mod.KIND_NODE, self._core.list_node, {},
-                            _node_from))
+            sources.append((watch_mod.KIND_NODE, self._core.list_node,
+                            dict(selector_kwargs), _node_from))
         if watch_mod.KIND_POD in wanted:
             if namespace:
                 sources.append((watch_mod.KIND_POD,
                                 self._core.list_namespaced_pod,
-                                {"namespace": namespace}, _pod_from))
+                                {"namespace": namespace,
+                                 **selector_kwargs}, _pod_from))
             else:
                 sources.append((watch_mod.KIND_POD,
-                                self._core.list_pod_for_all_namespaces, {},
-                                _pod_from))
+                                self._core.list_pod_for_all_namespaces,
+                                dict(selector_kwargs), _pod_from))
         if watch_mod.KIND_DAEMON_SET in wanted:
             if namespace:
                 sources.append((watch_mod.KIND_DAEMON_SET,
                                 self._apps.list_namespaced_daemon_set,
-                                {"namespace": namespace}, _daemon_set_from))
+                                {"namespace": namespace,
+                                 **selector_kwargs}, _daemon_set_from))
             else:
                 sources.append((watch_mod.KIND_DAEMON_SET,
                                 self._apps.list_daemon_set_for_all_namespaces,
-                                {}, _daemon_set_from))
+                                dict(selector_kwargs), _daemon_set_from))
 
         def pump(kind, list_fn, kwargs, convert):
             import logging

@@ -44,7 +44,7 @@ from __future__ import annotations
 import hashlib
 import logging
 from dataclasses import dataclass
-from typing import Optional
+from typing import Callable, Optional
 
 from tpu_operator_libs.k8s.leaderelection import (
     LeaderElectionConfig,
@@ -563,3 +563,126 @@ class StaticShardView:
 
     def live_members(self) -> "dict[int, str]":
         return {0: self.identity}
+
+
+class ShardLabelStamper:
+    """Stamp ring-derived shard ids onto nodes and runtime pods so a
+    replica's LIST/WATCH can be **server-side filtered** to its owned
+    partition (``CachedReadClient(shard_selector_fn=...)``).
+
+    The stamp value is pure ring output — ``shard_for(name, pool)`` —
+    so it is idempotent and concurrent-owner safe (any number of
+    stampers write the identical value; merge patches compose), and it
+    NEVER changes on shard handover: ownership moves are a watcher-side
+    selector change only, which is what makes the crash ordering
+    simple — re-evaluate the selector (``refresh_partition``) after
+    ownership settles, and the stamps were already correct.
+
+    Two stamping surfaces:
+
+    - :meth:`install_admission` registers FakeCluster mutating-admission
+      hooks, so every node/pod — including DS-controller recreations
+      mid-upgrade — is **born** stamped (the mutating-webhook idiom a
+      real deployment would use; a pod recreated without its stamp
+      would be invisible to its owner's filtered watch).
+    - :meth:`stamp_existing` bootstraps a brownfield cluster: one LIST
+      of nodes + pods, patching only objects whose stamp is missing or
+      wrong. Run it BEFORE any replica narrows its watch to a selector
+      (the crash-ordered admission rule: stamp first, filter second).
+    """
+
+    def __init__(self, ring: ShardRing, keys: "Optional[object]" = None,
+                 ) -> None:
+        from tpu_operator_libs.consts import (
+            GKE_NODEPOOL_LABEL,
+            UpgradeKeys,
+        )
+
+        self.ring = ring
+        self.keys = keys or UpgradeKeys()
+        self.label_key = self.keys.shard_label
+        self._pool_label = GKE_NODEPOOL_LABEL
+        #: Objects patched by stamp_existing (bootstrap evidence).
+        self.stamped_nodes_total = 0
+        self.stamped_pods_total = 0
+
+    # -- values & selectors ----------------------------------------------
+    def value_for(self, node_name: str, pool: str = "") -> str:
+        return str(self.ring.shard_for(node_name, pool))
+
+    def selector(self, owned: "frozenset | set | list") -> str:
+        """Label selector matching exactly the owned shards' objects.
+        An empty ownership set yields a selector that matches nothing
+        (a replica between elections watches an empty partition, not
+        the fleet)."""
+        shards = sorted(int(s) for s in owned)
+        if not shards:
+            return f"{self.label_key} in (none)"
+        values = ",".join(str(s) for s in shards)
+        return f"{self.label_key} in ({values})"
+
+    # -- in-place stamping (admission hooks) ------------------------------
+    def stamp_node(self, node: "object") -> None:
+        labels = node.metadata.labels
+        pool = labels.get(self._pool_label, "")
+        labels[self.label_key] = self.value_for(node.metadata.name, pool)
+
+    def stamp_pod(self, pod: "object",
+                  pool_of: "Callable[[str], str]") -> None:
+        """Stamp one pod from its bound node's identity. ``pool_of``
+        maps node name -> nodepool label value (the ring's slice key).
+        Unbound pods are left unstamped — they are stamped by the
+        UPDATE admission pass when the binding lands."""
+        node_name = pod.spec.node_name
+        if not node_name:
+            return
+        pod.metadata.labels[self.label_key] = self.value_for(
+            node_name, pool_of(node_name))
+
+    def install_admission(self, cluster: "object") -> None:
+        """Register mutating-admission hooks on a FakeCluster: every
+        node and (bound) pod enters the store already stamped."""
+        from tpu_operator_libs.k8s.client import NotFoundError
+        from tpu_operator_libs.k8s.watch import KIND_NODE, KIND_POD
+
+        def pool_of(node_name: str) -> str:
+            try:
+                node = cluster.get_node(node_name)
+            except NotFoundError:
+                return ""
+            return node.metadata.labels.get(self._pool_label, "")
+
+        cluster.add_admission_mutator(KIND_NODE, self.stamp_node)
+        cluster.add_admission_mutator(
+            KIND_POD, lambda pod: self.stamp_pod(pod, pool_of))
+
+    # -- bootstrap stamping (brownfield clusters) --------------------------
+    def stamp_existing(self, client: "object", namespace: str,
+                       label_selector: str = "") -> int:
+        """One-shot bootstrap: LIST nodes + pods and patch every object
+        whose shard stamp is missing or wrong. Idempotent (second run
+        patches nothing). Returns the number of objects patched."""
+        patched = 0
+        pools: dict[str, str] = {}
+        for node in client.list_nodes():
+            name = node.metadata.name
+            pool = node.metadata.labels.get(self._pool_label, "")
+            pools[name] = pool
+            want = self.value_for(name, pool)
+            if node.metadata.labels.get(self.label_key) != want:
+                client.patch_node_labels(name, {self.label_key: want})
+                self.stamped_nodes_total += 1
+                patched += 1
+        for pod in client.list_pods(namespace=namespace,
+                                    label_selector=label_selector):
+            node_name = pod.spec.node_name
+            if not node_name:
+                continue
+            want = self.value_for(node_name, pools.get(node_name, ""))
+            if pod.metadata.labels.get(self.label_key) != want:
+                client.patch_pod_labels(
+                    pod.metadata.namespace, pod.metadata.name,
+                    {self.label_key: want})
+                self.stamped_pods_total += 1
+                patched += 1
+        return patched

@@ -158,20 +158,33 @@ class WatchBroadcaster:
         self._lock = threading.Lock()
         self._subs: list[tuple[Optional[frozenset[str]],
                                Optional[Callable[[WatchEvent], bool]],
-                               Watch, bool]] = []
+                               Watch, bool,
+                               Optional[Callable[[WatchEvent],
+                                                 Optional[WatchEvent]]]]] = []
 
     def subscribe(self, kinds: Optional[set[str]] = None,
                   predicate: Optional[Callable[[WatchEvent], bool]] = None,
                   max_queue: Optional[int] = None,
-                  delay_exempt: bool = False) -> Watch:
+                  delay_exempt: bool = False,
+                  transform: Optional[Callable[
+                      [WatchEvent], Optional[WatchEvent]]] = None) -> Watch:
         """``delay_exempt`` marks a subscriber that keeps receiving
         events in real time while a watch-delay fault buffers delivery
         to everyone else — the invariant monitor's stream (the auditor
-        must see ground truth; the system under test sees the lag)."""
+        must see ground truth; the system under test sees the lag).
+
+        ``transform`` is a per-subscription event rewriter applied
+        after the kind/predicate filters: return the event (possibly
+        replaced) to deliver, or None to suppress. This is the seam
+        server-side label selectors ride on — the apiserver turns a
+        MODIFIED that stops matching the selector into a DELETED on
+        that watch, which is a per-subscriber rewrite, not a global
+        predicate."""
         watch = Watch(on_stop=self._unsubscribe, max_queue=max_queue)
         kindset = frozenset(kinds) if kinds is not None else None
         with self._lock:
-            self._subs.append((kindset, predicate, watch, delay_exempt))
+            self._subs.append(
+                (kindset, predicate, watch, delay_exempt, transform))
         return watch
 
     def _unsubscribe(self, watch: Watch) -> None:
@@ -188,14 +201,19 @@ class WatchBroadcaster:
         event = WatchEvent(event_type, kind, obj)
         with self._lock:
             subs = list(self._subs)
-        for kindset, predicate, watch, exempt in subs:
+        for kindset, predicate, watch, exempt, transform in subs:
             if exempt_only is not None and exempt != exempt_only:
                 continue
             if kindset is not None and kind not in kindset:
                 continue
             if predicate is not None and not predicate(event):
                 continue
-            watch._deliver(event)
+            delivered = event
+            if transform is not None:
+                delivered = transform(event)
+                if delivered is None:
+                    continue
+            watch._deliver(delivered)
 
     def drop_all(self) -> int:
         """Fault injection: terminate every subscriber's stream (the

@@ -417,11 +417,28 @@ def observe_reconcile(registry: MetricsRegistry,
     # exemplar: the journey most recently touched by this pass — the
     # dashboard's link from a slow pass to the node activity inside it
     obs = getattr(manager, "observability", None)
+    # the pass histogram carries which census store built the snapshot
+    # ("columnar" vs "dict") so a perf regression after a mode flip is
+    # attributable from the dashboard alone; two values, bounded
+    build_mode = str(getattr(manager, "snapshot_build_mode", "dict"))
     registry.observe_histogram(
         "reconcile_pass_seconds", duration_seconds,
-        "Wall-clock seconds per build_state+apply_state pass", labels,
+        "Wall-clock seconds per build_state+apply_state pass",
+        {**labels, "snapshot_build_mode": build_mode},
         exemplar_trace_id=(obs.tracer.last_touched_trace_id
                            if obs is not None else None))
+    parity_checks = getattr(manager, "columnar_parity_checks", None)
+    if parity_checks:
+        registry.set_counter_total(
+            "columnar_parity_checks_total", parity_checks,
+            "Columnar-vs-dict census cross-checks performed in parity "
+            "snapshot mode", labels)
+        registry.set_counter_total(
+            "columnar_parity_mismatches_total",
+            getattr(manager, "columnar_parity_mismatches", 0),
+            "Parity cross-checks where the columnar census diverged "
+            "from the dict shadow (investigate before trusting "
+            "columnar mode)", labels)
     for s in ALL_STATES:
         registry.set_gauge(
             "reconcile_bucket_nodes", len(state.bucket(s)),

@@ -38,6 +38,7 @@ from __future__ import annotations
 
 import contextlib
 import logging
+import os
 import threading
 from dataclasses import dataclass, field
 from typing import TYPE_CHECKING, Callable, Optional, Protocol
@@ -294,6 +295,7 @@ class ClusterUpgradeStateManager:
                  poll_interval: float = 1.0,
                  parallel_workers: int = 0,
                  incremental_reads: bool = True,
+                 snapshot_mode: str = "auto",
                  nudger: Optional["ReconcileNudger"] = None) -> None:
         self.keys = keys or UpgradeKeys()
         # Same driver/domain family as the upgrade keys: marks the
@@ -482,24 +484,35 @@ class ClusterUpgradeStateManager:
         #: so a takeover's first snapshot is bit-identical to the
         #: deposed owner's.
         self._last_owned_shards: Optional[frozenset] = None
-        #: shard -> {state-label: count} over the node cache's labels
-        #: (no pod join): the budget split's census and the
-        #: last_shard_status feed. A node counts once it carries a
-        #: state label — label-only is MORE restart-stable than the
-        #: pod join (a mid-restart node keeps its label).
-        self._fleet_census: dict[int, dict[str, int]] = {}
+        #: The fleet census store behind partition reads: shard ->
+        #: {state-label: count} over the node cache's labels (no pod
+        #: join) — the budget split's census and the last_shard_status
+        #: feed. A node counts once it carries a state label —
+        #: label-only is MORE restart-stable than the pod join (a
+        #: mid-restart node keeps its label). ``snapshot_mode``
+        #: selects the backing store: "columnar" keeps the census in
+        #: parallel numpy arrays (bincount recounts, version-cached
+        #: canary domain — see upgrade/columns.py), "dict" keeps the
+        #: pre-columnar per-name dict semantics bit for bit, "parity"
+        #: runs both and cross-checks every read, "auto" (default)
+        #: picks columnar when numpy is importable. The env var
+        #: TPU_OPERATOR_SNAPSHOT_MODE overrides at resolve time.
+        #: The store's per-name decrement bookkeeping is the reason an
+        #: incremental update never consults the previous snapshot's
+        #: node object: apply_state commits transitions by mutating
+        #: the snapshot nodes in place (the provider's write-back), so
+        #: by the next build the "old" object already carries the new
+        #: label and the delta would cancel itself out.
+        self._snapshot_mode_cfg = snapshot_mode
+        self._census_store = None
+        #: Lifetime parity cross-checks run / failed ("parity" mode
+        #: only) — the columnar_parity_checks_total metric feed.
+        self.columnar_parity_checks = 0
+        self.columnar_parity_mismatches = 0
         #: Names of nodes whose shard this replica owns (incrementally
         #: maintained alongside the census): the assembly-side
         #: ownership check and the partition completeness guard.
         self._owned_node_names: set[str] = set()
-        #: name -> (shard, state-label) the census currently counts for
-        #: that node. The decrement side of an incremental update MUST
-        #: come from here, never from the previous snapshot's node
-        #: object: apply_state commits transitions by mutating the
-        #: snapshot nodes in place (the provider's write-back), so by
-        #: the next build the "old" object already carries the new
-        #: label and the delta would cancel itself out.
-        self._census_entries: dict[str, tuple[int, str]] = {}
         #: Wall-clock cost of the most recent build_state (inputs +
         #: assembly) and the lifetime sum — the snapshot-build half of
         #: the shard bench's per-replica accounting.
@@ -587,9 +600,9 @@ class ClusterUpgradeStateManager:
                     set_filter(view)
                 self._partition_reads = True
             self._last_owned_shards = None
-            self._fleet_census = {}
+            self._census_store = (self._make_census_store(view.num_shards)
+                                  if view is not None else None)
             self._owned_node_names = set()
-            self._census_entries = {}
             if self._delta_view is not None:
                 self._delta_view.mark_full()
         if view is None:
@@ -602,6 +615,75 @@ class ClusterUpgradeStateManager:
     @property
     def shard_view(self) -> Optional["ShardElector"]:
         return self._shard_view
+
+    def _resolved_snapshot_mode(self) -> str:
+        """Effective census-store mode: env override > constructor
+        config; "auto" means columnar whenever numpy imports; any
+        columnar-needing mode degrades to "dict" without numpy."""
+        from tpu_operator_libs.upgrade import columns as _columns
+
+        mode = os.environ.get("TPU_OPERATOR_SNAPSHOT_MODE", "") \
+            or self._snapshot_mode_cfg
+        if mode not in ("auto", "columnar", "dict", "parity"):
+            mode = "auto"
+        if mode == "auto":
+            mode = "columnar" if _columns.HAVE_NUMPY else "dict"
+        if mode in ("columnar", "parity") and not _columns.HAVE_NUMPY:
+            mode = "dict"
+        return mode
+
+    @property
+    def snapshot_build_mode(self) -> str:
+        """"columnar" when the partition census runs on the columnar
+        arrays (parity mode counts: its primary is columnar), "dict"
+        otherwise — the metrics label value."""
+        from tpu_operator_libs.upgrade.columns import (
+            CensusColumns,
+            ParityCensus,
+        )
+
+        store = self._census_store
+        if isinstance(store, (CensusColumns, ParityCensus)):
+            return "columnar"
+        return "dict"
+
+    def _make_census_store(self, num_shards: int) -> "object":
+        from tpu_operator_libs.upgrade.columns import (
+            CensusColumns,
+            DictCensus,
+            ParityCensus,
+        )
+
+        mode = self._resolved_snapshot_mode()
+        if mode == "columnar":
+            return CensusColumns(num_shards)
+        if mode == "parity":
+            def _warn(site: str) -> None:
+                logger.warning(
+                    "columnar census parity mismatch at %s "
+                    "(answering from the columnar primary)", site)
+
+            return ParityCensus(CensusColumns(num_shards),
+                                DictCensus(num_shards),
+                                on_mismatch=_warn)
+        return DictCensus(num_shards)
+
+    def _record_parity_counters(self) -> None:
+        """Roll the parity wrapper's counters up into the manager-level
+        lifetime counters the metrics layer scrapes."""
+        store = self._census_store
+        checks = getattr(store, "checks", None)
+        if checks is not None:
+            self.columnar_parity_checks = checks
+            self.columnar_parity_mismatches = store.mismatches
+
+    def _census_entry(self, name: str) -> "Optional[tuple[int, str]]":
+        """(shard, state-label) the census records for ``name`` (any
+        backing store), or None outside partition-reads mode."""
+        store = self._census_store
+        if store is None:
+            return None
+        return store.entry(name)
 
     def with_nudger(
             self, nudger: Optional["ReconcileNudger"],
@@ -952,52 +1034,50 @@ class ClusterUpgradeStateManager:
         """Recompute the label-derived per-shard census and the
         owned-node set from the full node input map. O(fleet) — runs
         only on a full resync or an ownership move; steady-state passes
-        maintain both incrementally via :meth:`_census_update`."""
+        maintain both incrementally via :meth:`_census_update`. The
+        census itself lives in the mode-selected store (columnar
+        arrays or the dict twin — see ``_make_census_store``)."""
         view = self._shard_view
         owned = view.owned_shards()
-        census: dict[int, dict[str, int]] = {
-            shard: {} for shard in range(view.num_shards)}
+        if self._census_store is None:
+            self._census_store = self._make_census_store(view.num_shards)
         owned_names: set[str] = set()
-        entries: dict[str, tuple[int, str]] = {}
         state_label = self.keys.state_label
+        skip_label = self.keys.skip_label
         ring = view.ring
+        rows: list[tuple[str, int, str, bool, str]] = []
         for name, node in self._inputs_nodes.items():
-            shard = ring.shard_for(name, self._node_pool(node))
+            pool = self._node_pool(node)
+            shard = ring.shard_for(name, pool)
             if shard in owned:
                 owned_names.add(name)
-            label = node.metadata.labels.get(state_label, "")
-            entries[name] = (shard, label)
-            if label:
-                cell = census[shard]
-                cell[label] = cell.get(label, 0) + 1
-        self._fleet_census = census
+            labels = node.metadata.labels
+            rows.append((name, shard, labels.get(state_label, ""),
+                         labels.get(skip_label) == TRUE_STRING, pool))
+        self._census_store.rebuild(rows)
         self._owned_node_names = owned_names
-        self._census_entries = entries
 
     def _census_update(self, name: str, new: Optional[Node]) -> None:
         """Apply one node delta to the incremental census + owned set.
-        The decrement comes from the recorded census entry (see
-        ``_census_entries``), so it is immune to in-place mutation of
-        the previous snapshot's node objects."""
+        The decrement comes from the store's recorded entry, so it is
+        immune to in-place mutation of the previous snapshot's node
+        objects."""
         view = self._shard_view
-        prev = self._census_entries.pop(name, None)
-        if prev is not None:
-            shard, label = prev
-            if label:
-                cell = self._fleet_census.get(shard)
-                if cell is not None and cell.get(label, 0) > 0:
-                    cell[label] -= 1
-                    if not cell[label]:
-                        del cell[label]
+        store = self._census_store
+        if store is None:
+            store = self._census_store = \
+                self._make_census_store(view.num_shards)
         if new is None:
+            store.remove(name)
             self._owned_node_names.discard(name)
             return
-        shard = view.ring.shard_for(name, self._node_pool(new))
-        label = new.metadata.labels.get(self.keys.state_label, "")
-        self._census_entries[name] = (shard, label)
-        if label:
-            cell = self._fleet_census.setdefault(shard, {})
-            cell[label] = cell.get(label, 0) + 1
+        pool = self._node_pool(new)
+        shard = view.ring.shard_for(name, pool)
+        labels = new.metadata.labels
+        store.update(name, shard,
+                     labels.get(self.keys.state_label, ""),
+                     labels.get(self.keys.skip_label) == TRUE_STRING,
+                     pool)
         if shard in view.owned_shards():
             self._owned_node_names.add(name)
         else:
@@ -1140,15 +1220,16 @@ class ClusterUpgradeStateManager:
             # the node map, never a fleet-wide pod join.
             self._last_full_state = None
             view = self._shard_view
+            census = self._census_store.per_shard()
             self.last_shard_status = {
                 "owned": sorted(view.owned_shards()),
                 "numShards": view.num_shards,
                 "perShard": {
                     shard: {"total": sum(cell.values()),
                             "byState": dict(cell)}
-                    for shard, cell in sorted(
-                        self._fleet_census.items())},
+                    for shard, cell in sorted(census.items())},
             }
+            self._record_parity_counters()
             return state
         if self._shard_view is not None:
             return self._filter_owned_partition(state, nodes_by_name)
@@ -1248,7 +1329,30 @@ class ClusterUpgradeStateManager:
         )
 
         skip = self.keys.skip_label
-        eligible: dict[str, str] = {}
+        store = self._census_store if self._partition_reads else None
+        if store is not None:
+            # Columnar fast path: the cohort domain comes straight from
+            # the census store's version-cached eligible set — a steady
+            # pass whose label transitions stay within labeled states
+            # reuses the previous sorted list outright, instead of the
+            # former O(fleet) label walk per pass. Only the partition's
+            # podded augmentation (no-selector mode) is recomputed, and
+            # that is O(partition).
+            if policy.node_selector:
+                return ShardedCanaryContext(
+                    view=self._shard_view,
+                    eligible=store.eligible(labeled_only=False))
+            eligible = dict(store.eligible(labeled_only=True))
+            for bucket in state.node_states.values():
+                for ns in bucket:
+                    node = ns.node
+                    if node.metadata.labels.get(skip) != TRUE_STRING:
+                        eligible[node.metadata.name] = \
+                            self._node_pool(node)
+            return ShardedCanaryContext(
+                view=self._shard_view,
+                eligible=sorted(eligible.items()))
+        eligible = {}
         if policy.node_selector:
             for name, node in self._inputs_nodes.items():
                 if node.metadata.labels.get(skip) != TRUE_STRING:
@@ -3309,7 +3413,7 @@ class ClusterUpgradeStateManager:
         obs = self._obs
         view = self._shard_view
         if view is not None:
-            entry = self._census_entries.get(node_name)
+            entry = self._census_entry(node_name)
             shard = entry[0] if entry is not None else None
             if shard is None:
                 pool = None
