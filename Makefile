@@ -4,7 +4,7 @@ PYTHON ?= python3
 PYTEST_FLAGS ?= -q
 COV_THRESHOLD ?= 85
 
-.PHONY: all check test test-fast test-fault test-chaos test-soak test-scale test-rollout test-latency test-reconfig test-shard test-planner test-budget test-handover test-obs test-federation test-policy test-dag test-precursor test-preflight lint cov bench bench-reconcile bench-latency bench-shard bench-shard-100k bench-shard-1m bench-planner bench-budget bench-budget-1m bench-obs bench-federation bench-precursor bench-preflight profile-pass graft-check package clean diagram
+.PHONY: all check test test-fast test-fault test-chaos test-soak test-scale test-rollout test-latency test-reconfig test-shard test-planner test-budget test-handover test-obs test-federation test-policy test-dag test-precursor test-preflight test-fsck lint cov bench bench-reconcile bench-latency bench-shard bench-shard-100k bench-shard-1m bench-planner bench-budget bench-budget-1m bench-obs bench-federation bench-precursor bench-preflight profile-pass graft-check package clean diagram
 
 all: lint test
 
@@ -63,6 +63,7 @@ lint:
 	$(PYTHON) tools/metrics_lint.py
 	$(PYTHON) tools/marker_lint.py
 	$(PYTHON) tools/policy_lint.py
+	$(PYTHON) tools/state_keys_lint.py
 	@if $(PYTHON) -c "import ruff" 2>/dev/null; then \
 		$(PYTHON) -m ruff check tpu_operator_libs tools tests examples; \
 	elif $(PYTHON) -c "import pyflakes" 2>/dev/null; then \
@@ -312,6 +313,20 @@ test-preflight:
 # BENCH_preflight.json.
 bench-preflight:
 	$(PYTHON) tools/preflight_bench.py --nodes 256,1024 --out BENCH_preflight.json
+
+# Durable-state fsck slice (`fsck` marker): registry completeness
+# (every owned key literal resolves, enforced by tools/state_keys_lint
+# in `make lint`), auditor classification units (garbage / orphaned /
+# conflicting / version-skewed), janitor repair + quarantine ordering,
+# codec corruption round-trips, 409/410 apiserver-semantics
+# regressions, and the seeded corruption chaos gate (run_fsck_soak:
+# adversarial stamp corruption between reconciles; acceptance = no
+# corrupted stamp drives a decision, every repair audited with a
+# non-empty explain() chain, post-soak fleet fingerprint bit-identical
+# to the corruption-free twin run). Seeds 1-3 tier-1, 4-10 slow (the
+# standing convention).
+test-fsck:
+	$(PYTHON) -m pytest tests/ $(PYTEST_FLAGS) -m "fsck and not slow"
 
 graft-check:
 	$(PYTHON) __graft_entry__.py

@@ -1,0 +1,213 @@
+"""Adversarial apiserver semantics: 409 conflict storms (bounded
+retry-then-park in the provider) and 410 resourceVersion expiry
+(in-band EXPIRED marker, informer relist), plus the per-object
+annotation byte budget — the write paths the fsck layer leans on must
+themselves degrade gracefully, never wedge or fail a reconcile.
+"""
+
+import pytest
+
+pytestmark = [pytest.mark.fsck]
+
+from tpu_operator_libs.consts import UpgradeState
+from tpu_operator_libs.controller import Informer
+from tpu_operator_libs.k8s.client import (
+    ApiServerError,
+    ConflictError,
+    GoneError,
+)
+from tpu_operator_libs.k8s.fake import FakeCluster
+from tpu_operator_libs.k8s.watch import EXPIRED, KIND_NODE
+from tpu_operator_libs.upgrade.state_provider import (
+    DEFAULT_ANNOTATION_BUDGET_BYTES,
+    NodeUpgradeStateProvider,
+)
+from tpu_operator_libs.util import EventRecorder, FakeClock
+
+from builders import NodeBuilder
+
+
+def _tight_env(**provider_kwargs):
+    """make_env() with provider overrides (retry budget, byte budget)."""
+    clock = FakeClock(start=1_000_000.0)
+    cluster = FakeCluster(clock=clock)
+    from tpu_operator_libs.consts import UpgradeKeys
+    keys = UpgradeKeys()
+    recorder = EventRecorder()
+    provider = NodeUpgradeStateProvider(
+        cluster, keys, recorder, clock,
+        sync_timeout=10.0, poll_interval=0.01, **provider_kwargs)
+    return cluster, keys, provider
+
+
+class TestConflictStorm:
+    def test_gone_is_a_transient_server_error(self):
+        """410 subclasses ApiServerError: callers with blanket
+        transient-retry handling stay correct, informers get the
+        specific relist signal."""
+        assert issubclass(GoneError, ApiServerError)
+
+    def test_brief_storm_is_absorbed_by_retry(self):
+        cluster, keys, provider = _tight_env(conflict_retries=3)
+        node = NodeBuilder("n1").create(cluster)
+        cluster.inject_conflict_storm("patch_node_labels", 2)
+        assert provider.change_node_upgrade_state(
+            node, UpgradeState.UPGRADE_REQUIRED)
+        assert cluster.get_node("n1").metadata.labels[keys.state_label] \
+            == "upgrade-required"
+        assert provider.conflict_retries_total == 2
+        assert provider.conflict_parks_total == 0
+
+    def test_sustained_storm_parks_the_transition(self):
+        """A storm outlasting the budget returns False (park) instead
+        of wedging the pass — the caller's next reconcile re-derives
+        the transition from live state."""
+        cluster, keys, provider = _tight_env(conflict_retries=3)
+        node = NodeBuilder("n1").create(cluster)
+        # initial attempt + 3 retries = 4 conflicts outlast the budget
+        cluster.inject_conflict_storm("patch_node_labels", 4)
+        assert provider.change_node_upgrade_state(
+            node, UpgradeState.UPGRADE_REQUIRED) is False
+        assert provider.conflict_parks_total == 1
+        assert keys.state_label not in \
+            cluster.get_node("n1").metadata.labels
+
+    def test_parked_transition_succeeds_once_the_storm_passes(self):
+        cluster, keys, provider = _tight_env(conflict_retries=3)
+        node = NodeBuilder("n1").create(cluster)
+        cluster.inject_conflict_storm("patch_node_labels", 4)
+        assert not provider.change_node_upgrade_state(
+            node, UpgradeState.UPGRADE_REQUIRED)
+        # the storm passed (budget consumed); the next pass re-derives
+        # the same transition from live state and lands it
+        node = cluster.get_node("n1")
+        assert provider.change_node_upgrade_state(
+            node, UpgradeState.UPGRADE_REQUIRED)
+
+    def test_annotation_write_reraises_after_budget(self):
+        """Annotation setters speak exceptions (their callers already
+        handle raise-on-failure); a sustained storm surfaces the
+        ConflictError rather than silently dropping the stamp."""
+        cluster, keys, provider = _tight_env(conflict_retries=2)
+        node = NodeBuilder("n1").create(cluster)
+        cluster.inject_conflict_storm("patch_node_annotations", 50)
+        with pytest.raises(ConflictError):
+            provider.change_node_upgrade_annotation(
+                node, keys.validation_start_annotation, "123.0")
+        # initial attempt + 2 retries, each counted
+        assert provider.conflict_retries_total == 3
+
+
+class TestResourceVersionExpiry:
+    def test_expire_delivers_in_band_marker_then_closes(self):
+        cluster = FakeCluster()
+        watch = cluster.watch(kinds={KIND_NODE})
+        assert cluster.expire_watch_streams() == 1
+        event = watch.get(timeout=0.1)
+        assert event is not None and event.type == EXPIRED
+        assert watch.get(timeout=0.0) is None
+        assert watch.stopped
+
+    def test_informer_relists_and_rewatches_on_expiry(self):
+        cluster = FakeCluster()
+        NodeBuilder("n1").create(cluster)
+        informer = Informer(
+            lister=cluster.list_nodes,
+            watch=cluster.watch(kinds={KIND_NODE}),
+            name="exp", threaded=False,
+            rewatch=lambda: cluster.watch(kinds={KIND_NODE}))
+        informer.start()
+        assert len(informer) == 1
+        cluster.expire_watch_streams()
+        # this create lands after the old stream died — only the
+        # relist (or the fresh stream opened before it) can see it
+        NodeBuilder("n2").create(cluster)
+        informer.pump()
+        assert informer.expired_relists == 1
+        assert len(informer) == 2
+        # the fresh stream is live: subsequent events flow normally
+        NodeBuilder("n3").create(cluster)
+        informer.pump()
+        assert len(informer) == 3
+        assert informer.expired_relists == 1
+
+    def test_repeated_expiry_keeps_converging(self):
+        cluster = FakeCluster()
+        informer = Informer(
+            lister=cluster.list_nodes,
+            watch=cluster.watch(kinds={KIND_NODE}),
+            name="exp2", threaded=False,
+            rewatch=lambda: cluster.watch(kinds={KIND_NODE}))
+        informer.start()
+        for i in range(3):
+            cluster.expire_watch_streams()
+            NodeBuilder(f"n{i}").create(cluster)
+            informer.pump()
+        assert informer.expired_relists == 3
+        assert len(informer) == 3
+
+
+class TestAnnotationByteBudget:
+    def test_default_budget_matches_apiserver_headroom(self):
+        assert DEFAULT_ANNOTATION_BUDGET_BYTES == 256 * 1024
+
+    def test_oversized_write_is_truncated_never_failed(self):
+        cluster, keys, provider = _tight_env(max_annotation_bytes=256)
+        node = NodeBuilder("n1").create(cluster)
+        key = keys.trace_id_annotation
+        provider.change_node_upgrade_annotation(node, key, "x" * 1024)
+        stored = cluster.get_node("n1").metadata.annotations[key]
+        assert len(stored) < 1024
+        assert provider.annotation_bytes_truncated_total > 0
+        merged = cluster.get_node("n1").metadata.annotations
+        assert sum(len(k) + len(v) for k, v in merged.items()) <= 256
+
+    def test_within_budget_writes_are_untouched(self):
+        cluster, keys, provider = _tight_env(max_annotation_bytes=4096)
+        node = NodeBuilder("n1").create(cluster)
+        key = keys.trace_id_annotation
+        provider.change_node_upgrade_annotation(node, key, "abc")
+        assert cluster.get_node("n1").metadata.annotations[key] == "abc"
+        assert provider.annotation_bytes_truncated_total == 0
+
+    def test_truncation_is_largest_first_and_deterministic(self):
+        cluster, keys, provider = _tight_env(max_annotation_bytes=200)
+        node = NodeBuilder("n1").create(cluster)
+        small_key = keys.validation_start_annotation
+        big_key = keys.trace_id_annotation
+        provider.change_node_upgrade_annotations(
+            node, {small_key: "123.0", big_key: "y" * 500})
+        annotations = cluster.get_node("n1").metadata.annotations
+        # the small value rode through intact; only the runaway stamp
+        # paid the budget
+        assert annotations[small_key] == "123.0"
+        assert len(annotations[big_key]) < 500
+
+    def test_preexisting_oversized_stamps_are_left_alone(self):
+        """The guard owns only bytes it is about to write — it never
+        truncates another writer's annotation to make room."""
+        cluster, keys, provider = _tight_env(max_annotation_bytes=300)
+        node = NodeBuilder("n1").with_annotations(
+            {"someone-elses.example.com/blob": "z" * 400}).create(cluster)
+        provider.change_node_upgrade_annotation(
+            node, keys.trace_id_annotation, "t" * 100)
+        annotations = cluster.get_node("n1").metadata.annotations
+        assert annotations["someone-elses.example.com/blob"] == "z" * 400
+        assert len(annotations[keys.trace_id_annotation]) < 100
+
+    def test_utf8_slice_never_splits_a_rune(self):
+        cluster, keys, provider = _tight_env(max_annotation_bytes=120)
+        node = NodeBuilder("n1").create(cluster)
+        key = keys.trace_id_annotation
+        provider.change_node_upgrade_annotation(node, key, "é" * 200)
+        stored = cluster.get_node("n1").metadata.annotations[key]
+        stored.encode("utf-8").decode("utf-8")  # round-trips cleanly
+
+    def test_disabled_budget_writes_anything(self):
+        cluster, keys, provider = _tight_env(max_annotation_bytes=None)
+        node = NodeBuilder("n1").create(cluster)
+        key = keys.trace_id_annotation
+        provider.change_node_upgrade_annotation(node, key, "x" * 10_000)
+        assert len(cluster.get_node("n1").metadata.annotations[key]) \
+            == 10_000
+        assert provider.annotation_bytes_truncated_total == 0

@@ -1,0 +1,559 @@
+"""Durable-state fsck: registry completeness, auditor classification,
+janitor repairs, codec corruption round-trips, and the seeded
+corruption chaos gate.
+
+The gate (``make test-fsck``) is the acceptance surface of the fsck
+layer: adversarial stamp corruption injected between reconciles must
+never drive a decision (scan-before-act holds the managers on
+findings), every repair must be audited with a non-empty ``explain()``
+chain that survives operator crashes, and the converged fleet must
+fingerprint bit-identically to a corruption-free twin run of the same
+seed.
+"""
+
+import pytest
+
+pytestmark = [pytest.mark.fsck]
+
+from tpu_operator_libs.chaos import (
+    FAULT_OPERATOR_CRASH,
+    FAULT_STATE_CORRUPTION,
+    FaultSchedule,
+    run_fsck_soak,
+)
+from tpu_operator_libs.consts import (
+    GKE_NODEPOOL_LABEL,
+    RemediationKeys,
+    TRUE_STRING,
+    UpgradeKeys,
+    UpgradeState,
+)
+from tpu_operator_libs.fsck import (
+    CONFLICTING,
+    GARBAGE,
+    ORPHANED,
+    REPAIR_CONVERT,
+    REPAIR_DROP,
+    REPAIR_NORMALIZE,
+    REPAIR_PRESERVE,
+    REPAIR_QUARANTINE,
+    REPAIR_SWEEP,
+    VERSION_SKEWED,
+    Janitor,
+    StateAuditor,
+    default_registry,
+    fsck_quarantine_annotation,
+)
+from tpu_operator_libs.metrics import MetricsRegistry, observe_fsck
+from tpu_operator_libs.simulate import NS, FleetSpec, build_fleet
+
+#: Adversarial value corpus every validator/normalizer must survive
+#: without raising: empty, separators-only, truncated pairs, unicode,
+#: control bytes, huge numerals, bare wrappers.
+GARBAGE_CORPUS = (
+    "", " ", ",", ";", ":", "=", "v0;", "a=,=b", "::::", "drain=abc",
+    "1e999", "-1", "\x00", "héllo wörld", "a" * 512, "nan", "inf",
+)
+
+
+def _fleet():
+    cluster, clock, keys = build_fleet(
+        FleetSpec(n_slices=1, hosts_per_slice=2))
+    return cluster, clock, keys
+
+
+def _node_meta(cluster, name):
+    node = cluster.get_node(name)
+    return node.metadata.labels, node.metadata.annotations
+
+
+class TestRegistry:
+    def test_every_consts_key_property_is_registered(self):
+        """The completeness pin state_keys_lint enforces statically:
+        every *_label/*_annotation/*_prefix property of the four key
+        families resolves to a spec."""
+        from tpu_operator_libs.consts import (
+            FederationKeys,
+            TopologyKeys,
+        )
+        registry = default_registry()
+        for keys in (UpgradeKeys(), RemediationKeys(), TopologyKeys(),
+                     FederationKeys()):
+            cls = type(keys)
+            for prop in dir(cls):
+                if not prop.endswith(("_label", "_annotation",
+                                      "_prefix")):
+                    continue
+                if not isinstance(getattr(cls, prop, None), property):
+                    continue
+                key = getattr(keys, prop)
+                probe = key + "x" if prop.endswith("_prefix") else key
+                assert registry.lookup(probe) is not None, (
+                    f"{cls.__name__}.{prop} = {key!r} unregistered")
+
+    def test_prefix_lookup_requires_a_suffix(self):
+        registry = default_registry()
+        prefix = UpgradeKeys().canary_shard_passed_prefix
+        assert registry.lookup(prefix + "7") is not None
+        assert registry.lookup(prefix) is None
+
+    def test_owns_covers_only_the_operator_namespace(self):
+        registry = default_registry()
+        assert registry.owns("google.com/libtpu-upgrade-state")
+        assert registry.owns("google.com/libtpu-anything.else")
+        assert not registry.owns(GKE_NODEPOOL_LABEL)
+        assert not registry.owns("example.com/libtpu-upgrade-state")
+
+    def test_registry_scales_to_other_driver_instances(self):
+        registry = default_registry(driver="gpudrv",
+                                    domain="example.com")
+        spec = registry.lookup("example.com/gpudrv-upgrade-state")
+        assert spec is not None and spec.owner == "upgrade"
+        assert not registry.owns("google.com/libtpu-upgrade-state")
+
+    def test_every_spec_declares_codec_and_contract(self):
+        for spec in default_registry().specs:
+            assert spec.codec, spec.key
+            assert spec.contract, spec.key
+            assert spec.repair in (
+                REPAIR_DROP, REPAIR_NORMALIZE, REPAIR_SWEEP,
+                REPAIR_QUARANTINE, REPAIR_CONVERT, REPAIR_PRESERVE)
+
+
+class TestAuditorClassification:
+    def _scan(self, cluster):
+        auditor = StateAuditor(default_registry())
+        return auditor, auditor.scan(cluster.list_nodes(),
+                                     cluster.list_daemon_sets(NS))
+
+    def test_clean_fleet_scans_clean(self):
+        cluster, _clock, _keys = _fleet()
+        _auditor, findings = self._scan(cluster)
+        assert findings == []
+
+    def test_garbage_annotation_is_found_with_drop_repair(self):
+        cluster, _clock, keys = _fleet()
+        cluster.patch_node_annotations(
+            "s0-h0", {keys.validation_start_annotation: "not-a-number"})
+        _auditor, findings = self._scan(cluster)
+        [f] = findings
+        assert f.classification == GARBAGE and f.repair == REPAIR_DROP
+        assert f.key == keys.validation_start_annotation
+        assert f.reason  # every finding carries a why
+
+    def test_garbled_state_label_quarantines_not_guesses(self):
+        cluster, _clock, keys = _fleet()
+        cluster.patch_node_labels("s0-h0", {keys.state_label: "???"})
+        _auditor, findings = self._scan(cluster)
+        [f] = findings
+        assert f.classification == GARBAGE
+        assert f.repair == REPAIR_QUARANTINE and f.is_label
+
+    def test_unregistered_owned_key_is_conflicting(self):
+        cluster, _clock, _keys = _fleet()
+        cluster.patch_node_annotations(
+            "s0-h0", {"google.com/libtpu-upgrade.bogus-0": "1"})
+        _auditor, findings = self._scan(cluster)
+        [f] = findings
+        assert f.classification == CONFLICTING
+        assert f.repair == REPAIR_DROP
+
+    def test_schema_wrapper_is_version_skewed(self):
+        cluster, _clock, keys = _fleet()
+        cluster.patch_node_annotations(
+            "s0-h0", {keys.phase_durations_annotation: "v0;drain=12"})
+        _auditor, findings = self._scan(cluster)
+        [f] = findings
+        assert f.classification == VERSION_SKEWED
+        assert f.repair == REPAIR_CONVERT
+
+    def test_preserve_keys_are_never_judged(self):
+        """Operator inputs (skip labels, quarantined revision) are
+        cataloged but any value is honored."""
+        cluster, _clock, keys = _fleet()
+        cluster.patch_node_labels(
+            "s0-h0", {keys.skip_label: "absolutely !! not valid"})
+        cluster.patch_daemon_set_annotations(
+            NS, "libtpu",
+            {keys.quarantined_revision_annotation: "any thing at all"})
+        _auditor, findings = self._scan(cluster)
+        assert findings == []
+
+    def test_ghost_incumbent_prewarm_stamp_is_orphaned(self):
+        cluster, _clock, keys = _fleet()
+        cluster.patch_node_annotations(
+            "s0-h0",
+            {keys.prewarm_reservation_annotation: "ghost:m1:gold"})
+        _auditor, findings = self._scan(cluster)
+        [f] = findings
+        assert f.classification == ORPHANED
+        assert f.repair == REPAIR_SWEEP
+        assert "ghost" in f.reason
+
+    def test_torn_prewarm_pair_is_orphaned(self):
+        """ready without its reservation half: swept, never completed
+        by guessing the missing reserve stamp."""
+        cluster, _clock, keys = _fleet()
+        cluster.patch_node_annotations(
+            "s0-h1", {keys.prewarm_ready_annotation: "s0-h0:123.0"})
+        _auditor, findings = self._scan(cluster)
+        [f] = findings
+        assert f.classification == ORPHANED and f.repair == REPAIR_SWEEP
+
+    def test_arc_stamp_is_residue_only_when_machine_at_rest(self):
+        """The orphan conservatism pin: a validation-start stamp is
+        residue on a node at rest, but NOT while the upgrade machine is
+        mid-arc on that node (the janitor must never race a live
+        arc)."""
+        cluster, _clock, keys = _fleet()
+        stamp = {keys.validation_start_annotation: "125.0"}
+        cluster.patch_node_annotations("s0-h0", stamp)
+        _auditor, findings = self._scan(cluster)
+        assert [f.classification for f in findings] == [ORPHANED]
+
+        cluster.patch_node_labels(
+            "s0-h0",
+            {keys.state_label: str(UpgradeState.VALIDATION_REQUIRED)})
+        _auditor, findings = self._scan(cluster)
+        assert findings == []
+
+    def test_retired_shard_attestation_is_orphaned(self):
+        """A per-shard canary attestation for a shard no live node
+        carries (the shard retired with its nodes) is residue."""
+        cluster, _clock, keys = _fleet()
+        cluster.patch_daemon_set_annotations(
+            NS, "libtpu",
+            {keys.canary_shard_passed_prefix + "99": "deadbeef"})
+        _auditor, findings = self._scan(cluster)
+        [f] = findings
+        assert f.classification == ORPHANED and f.repair == REPAIR_SWEEP
+        assert f.target == f"{NS}/libtpu"
+
+    def test_clean_digest_cache_skips_unchanged_targets(self):
+        cluster, _clock, keys = _fleet()
+        auditor = StateAuditor(default_registry())
+        auditor.scan(cluster.list_nodes(), cluster.list_daemon_sets(NS))
+        scanned_first = auditor.targets_scanned_total
+        auditor.scan(cluster.list_nodes(), cluster.list_daemon_sets(NS))
+        assert auditor.targets_scanned_total == scanned_first
+        assert auditor.targets_skipped_total >= 3  # 2 nodes + 1 DS
+        # a mutation invalidates exactly that target's digest
+        cluster.patch_node_annotations(
+            "s0-h0", {keys.trace_id_annotation: "has spaces"})
+        findings = auditor.scan(cluster.list_nodes(),
+                                cluster.list_daemon_sets(NS))
+        assert [f.target for f in findings] == ["s0-h0"]
+
+    def test_dirty_targets_are_never_digest_cached(self):
+        """A finding whose repair crashed must be re-found by the next
+        scan — clean digests are only recorded for zero-finding
+        targets."""
+        cluster, _clock, keys = _fleet()
+        cluster.patch_node_annotations(
+            "s0-h0", {keys.validation_start_annotation: "junk"})
+        auditor = StateAuditor(default_registry())
+        first = auditor.scan(cluster.list_nodes(),
+                             cluster.list_daemon_sets(NS))
+        second = auditor.scan(cluster.list_nodes(),
+                              cluster.list_daemon_sets(NS))
+        assert len(first) == len(second) == 1
+
+
+class TestJanitor:
+    def _pair(self, cluster, clock=None, guard=None):
+        registry = default_registry()
+        auditor = StateAuditor(registry)
+        keys = UpgradeKeys()
+        janitor = Janitor(cluster, registry, keys,
+                          remediation_keys=RemediationKeys(),
+                          guard=guard, clock=clock)
+        return auditor, janitor
+
+    def _scan(self, auditor, cluster):
+        return auditor.scan(cluster.list_nodes(),
+                            cluster.list_daemon_sets(NS))
+
+    def test_drop_repair_deletes_and_fleet_scans_clean(self):
+        cluster, clock, keys = _fleet()
+        cluster.patch_node_annotations("s0-h0", {
+            keys.validation_start_annotation: "junk",
+            keys.trace_id_annotation: "two tokens"})
+        auditor, janitor = self._pair(cluster, clock)
+        applied = janitor.repair(self._scan(auditor, cluster))
+        assert applied == 2
+        _labels, annotations = _node_meta(cluster, "s0-h0")
+        assert keys.validation_start_annotation not in annotations
+        assert keys.trace_id_annotation not in annotations
+        assert self._scan(StateAuditor(default_registry()),
+                          cluster) == []
+        assert janitor.repairs_total == {REPAIR_DROP: 2}
+
+    def test_normalize_reencodes_the_decodable_subset(self):
+        cluster, clock, keys = _fleet()
+        cluster.patch_node_annotations(
+            "s0-h0",
+            {keys.phase_durations_annotation: "drain=12,bogus,x=abc"})
+        auditor, janitor = self._pair(cluster, clock)
+        janitor.repair(self._scan(auditor, cluster))
+        _labels, annotations = _node_meta(cluster, "s0-h0")
+        survivor = annotations[keys.phase_durations_annotation]
+        assert "bogus" not in survivor and "drain" in survivor
+        spec = default_registry().lookup(keys.phase_durations_annotation)
+        assert spec.validate(survivor)
+
+    def test_normalize_with_no_survivors_deletes(self):
+        cluster, clock, keys = _fleet()
+        cluster.patch_node_annotations(
+            "s0-h0", {keys.phase_durations_annotation: "total garbage"})
+        auditor, janitor = self._pair(cluster, clock)
+        janitor.repair(self._scan(auditor, cluster))
+        _labels, annotations = _node_meta(cluster, "s0-h0")
+        assert keys.phase_durations_annotation not in annotations
+
+    def test_convert_unwraps_schema_wrapper_to_bare_form(self):
+        cluster, clock, keys = _fleet()
+        cluster.patch_node_annotations(
+            "s0-h0",
+            {keys.phase_durations_annotation: "v0;drain=12.0"})
+        auditor, janitor = self._pair(cluster, clock)
+        janitor.repair(self._scan(auditor, cluster))
+        _labels, annotations = _node_meta(cluster, "s0-h0")
+        value = annotations.get(keys.phase_durations_annotation, "")
+        assert not value.startswith("v0;")
+        assert "drain" in value
+
+    def test_convert_drops_wrapper_with_garbage_payload(self):
+        cluster, clock, keys = _fleet()
+        cluster.patch_node_annotations(
+            "s0-h0", {keys.validation_start_annotation: "v0;junk"})
+        auditor, janitor = self._pair(cluster, clock)
+        janitor.repair(self._scan(auditor, cluster))
+        _labels, annotations = _node_meta(cluster, "s0-h0")
+        assert keys.validation_start_annotation not in annotations
+
+    def test_quarantine_parks_both_machines_atomically(self):
+        cluster, clock, keys = _fleet()
+        rem = RemediationKeys()
+        cluster.patch_node_labels("s0-h0", {keys.state_label: "???"})
+        auditor, janitor = self._pair(cluster, clock)
+        janitor.repair(self._scan(auditor, cluster))
+        labels, annotations = _node_meta(cluster, "s0-h0")
+        assert labels[keys.skip_label] == TRUE_STRING
+        assert labels[rem.skip_label] == TRUE_STRING
+        stamp = annotations[fsck_quarantine_annotation()]
+        assert stamp.startswith(GARBAGE + ":")
+        assert "s0-h0" in janitor.quarantined_nodes
+        # the garbled label itself is NOT rewritten — never guess
+        assert labels[keys.state_label] == "???"
+        explain = janitor.explain("s0-h0", keys.state_label)
+        assert explain["action"] == REPAIR_QUARANTINE
+        assert any("never" in line or "parked" in line
+                   for line in explain["blocking"])
+
+    def test_recycled_spare_residue_is_swept(self):
+        """Satellite (f): a node deleted mid-arc leaves its prewarm
+        reservation on the spare that replaced it — the janitor sweeps
+        it without a human."""
+        cluster, clock, keys = _fleet()
+        cluster.patch_node_annotations("s0-h1", {
+            keys.prewarm_reservation_annotation: "vanished:m1:gold",
+            keys.prewarm_ready_annotation: "vanished:99.0"})
+        auditor, janitor = self._pair(cluster, clock)
+        findings = self._scan(auditor, cluster)
+        assert {f.classification for f in findings} == {ORPHANED}
+        janitor.repair(findings)
+        _labels, annotations = _node_meta(cluster, "s0-h1")
+        assert keys.prewarm_reservation_annotation not in annotations
+        assert keys.prewarm_ready_annotation not in annotations
+        assert janitor.repairs_total == {REPAIR_SWEEP: 2}
+
+    def test_retired_shard_attestation_is_swept_from_ds(self):
+        cluster, clock, keys = _fleet()
+        key = keys.canary_shard_passed_prefix + "99"
+        cluster.patch_daemon_set_annotations(NS, "libtpu",
+                                             {key: "deadbeef"})
+        auditor, janitor = self._pair(cluster, clock)
+        janitor.repair(self._scan(auditor, cluster))
+        [ds] = cluster.list_daemon_sets(NS)
+        assert key not in ds.metadata.annotations
+        explain = janitor.explain(f"{NS}/libtpu", key)
+        assert explain["blocking"]
+
+    def test_repair_intent_precedes_the_guarded_write(self):
+        """Crash ordering: the audit record is written BEFORE the
+        cluster patch, so a crash after the write still leaves the
+        repair explained (and a crash before it re-finds the
+        corruption)."""
+        cluster, clock, keys = _fleet()
+        cluster.patch_node_annotations(
+            "s0-h0", {keys.validation_start_annotation: "junk"})
+
+        class Boom(RuntimeError):
+            pass
+
+        def exploding_guard(write):
+            raise Boom()
+
+        auditor, janitor = self._pair(cluster, clock,
+                                      guard=exploding_guard)
+        with pytest.raises(Boom):
+            janitor.repair(self._scan(auditor, cluster))
+        # the intent survived the crash; the stamp did not get patched
+        assert janitor.explain("s0-h0",
+                               keys.validation_start_annotation)["blocking"]
+        _labels, annotations = _node_meta(cluster, "s0-h0")
+        assert annotations[keys.validation_start_annotation] == "junk"
+        # and a fresh scan re-finds it (no digest poisoning)
+        assert len(self._scan(auditor, cluster)) == 1
+
+    def test_explain_empty_for_untouched_keys(self):
+        cluster, clock, keys = _fleet()
+        _auditor, janitor = self._pair(cluster, clock)
+        assert janitor.explain("s0-h0", keys.state_label) == {
+            "blocking": [], "action": "", "at": 0.0}
+
+
+class TestCodecRoundTrips:
+    """Satellite (c): garbage in → clean default out + a finding,
+    never an exception, for EVERY registered codec."""
+
+    @pytest.mark.parametrize("garbage", GARBAGE_CORPUS)
+    def test_validators_never_raise(self, garbage):
+        for spec in default_registry().specs:
+            verdict = spec.validate(garbage)
+            assert isinstance(verdict, bool), (spec.key, garbage)
+
+    @pytest.mark.parametrize("garbage", GARBAGE_CORPUS)
+    def test_normalizers_yield_valid_or_empty(self, garbage):
+        for spec in default_registry().specs:
+            if spec.normalize is None:
+                continue
+            survivor = spec.normalize(garbage)
+            assert isinstance(survivor, str), (spec.key, garbage)
+            if survivor:
+                assert spec.validate(survivor), (spec.key, garbage,
+                                                 survivor)
+
+    def test_normalize_is_idempotent_on_canonical_values(self):
+        samples = {
+            "phase-durations": "drain=12.5",
+            "precursor.rates": "ecc=1.5",
+        }
+        for spec in default_registry().specs:
+            if spec.normalize is None:
+                continue
+            for fragment, sample in samples.items():
+                if fragment in spec.key:
+                    canonical = spec.normalize(sample)
+                    assert spec.normalize(canonical) == canonical
+
+    def test_garbage_in_every_node_codec_yields_finding_not_crash(self):
+        """End to end: one node vandalized on every non-preserve
+        node-annotation family — the scan classifies everything and
+        raises nothing. Drop/normalize families are erased; quarantine
+        families stay in place (park, never guess) with the node
+        parked, so a rescan re-reports exactly those."""
+        cluster, clock, keys = _fleet()
+        registry = default_registry()
+        vandalism = {}
+        for spec in registry.specs:
+            if spec.kind != "node-annotation":
+                continue
+            if spec.repair == REPAIR_PRESERVE:
+                continue
+            key = spec.key + "x" if spec.prefix else spec.key
+            vandalism[key] = "!! definitely not valid !!"
+        cluster.patch_node_annotations("s0-h0", vandalism)
+        auditor = StateAuditor(registry)
+        findings = auditor.scan(cluster.list_nodes(),
+                                cluster.list_daemon_sets(NS))
+        assert len(findings) == len(vandalism)
+        assert all(f.classification == GARBAGE for f in findings)
+        janitor = Janitor(cluster, registry, keys,
+                          remediation_keys=RemediationKeys(),
+                          clock=clock)
+        janitor.repair(findings)
+        quarantine_keys = {f.key for f in findings
+                           if f.repair == REPAIR_QUARANTINE}
+        leftovers = StateAuditor(registry).scan(
+            cluster.list_nodes(), cluster.list_daemon_sets(NS))
+        assert {f.key for f in leftovers} == quarantine_keys
+        assert "s0-h0" in janitor.quarantined_nodes
+
+
+class TestFsckMetrics:
+    def test_observe_fsck_exports_the_documented_families(self):
+        cluster, clock, keys = _fleet()
+        registry = default_registry()
+        cluster.patch_node_annotations(
+            "s0-h0", {keys.validation_start_annotation: "junk"})
+        auditor = StateAuditor(registry)
+        janitor = Janitor(cluster, registry, keys,
+                          remediation_keys=RemediationKeys(),
+                          clock=clock)
+        janitor.repair(auditor.scan(cluster.list_nodes(),
+                                    cluster.list_daemon_sets(NS)))
+        metrics = MetricsRegistry()
+        observe_fsck(metrics, auditor, janitor, key_registry=registry)
+        text = metrics.render_prometheus()
+        for family in ("fsck_keys_registered", "fsck_scans_total",
+                       "fsck_targets_scanned_total",
+                       "fsck_targets_skipped_total",
+                       "fsck_findings_total", "fsck_repairs_total",
+                       "fsck_quarantined_nodes"):
+            assert family in text, family
+        assert 'classification="garbage"' in text
+        assert f'action="{REPAIR_DROP}"' in text
+
+
+class TestFsckSchedule:
+    def test_generate_fsck_is_seed_pure(self):
+        nodes = ["s0-h0", "s0-h1"]
+        a = FaultSchedule.generate_fsck(7, nodes, ds_target="ns/libtpu")
+        b = FaultSchedule.generate_fsck(7, nodes, ds_target="ns/libtpu")
+        assert [(e.at, e.kind, e.target, e.param) for e in a.events] \
+            == [(e.at, e.kind, e.target, e.param) for e in b.events]
+        assert FAULT_STATE_CORRUPTION in a.kinds
+        assert FAULT_OPERATOR_CRASH in a.kinds
+
+    def test_without_strips_exactly_one_kind(self):
+        nodes = ["s0-h0", "s0-h1"]
+        full = FaultSchedule.generate_fsck(7, nodes,
+                                           ds_target="ns/libtpu")
+        twin = full.without(FAULT_STATE_CORRUPTION)
+        assert FAULT_STATE_CORRUPTION not in twin.kinds
+        kept = [e for e in full.events
+                if e.kind != FAULT_STATE_CORRUPTION]
+        assert [(e.at, e.kind, e.target) for e in twin.events] \
+            == [(e.at, e.kind, e.target) for e in kept]
+
+
+def _assert_fsck_ok(report):
+    assert report.ok, (
+        f"fsck seed {report.seed} failed — replay with "
+        f"run_fsck_soak(seed={report.seed})\n{report.report_text}")
+    # the vandal actually struck, and crashes composed with it
+    assert report.stats["corruptionsInjected"] >= 3
+    assert report.crashes_fired >= 1
+    # at least one leader pass held the managers to repair first
+    assert report.stats["fsckHoldTicks"] >= 1
+    assert report.stats["repairsByAction"]
+    # the differential acceptance: vandalism left no trace the repairs
+    # didn't erase
+    assert report.stats["baselineConverged"]
+    assert report.stats["fingerprint"] \
+        == report.stats["baselineFingerprint"]
+
+
+class TestFsckSoakGate:
+    """The corruption chaos gate: seeds 1-3 tier-1, 4-10 slow (the
+    standing seed convention)."""
+
+    @pytest.mark.parametrize("seed", (1, 2, 3))
+    def test_seed_survives_adversarial_corruption(self, seed):
+        _assert_fsck_ok(run_fsck_soak(seed))
+
+    @pytest.mark.slow
+    @pytest.mark.parametrize("seed", tuple(range(4, 11)))
+    def test_seed_survives_adversarial_corruption_slow(self, seed):
+        _assert_fsck_ok(run_fsck_soak(seed))

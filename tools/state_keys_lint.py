@@ -1,0 +1,146 @@
+#!/usr/bin/env python3
+"""Durable-key registry drift check (wired into `make lint`).
+
+The operator's only durable store is cluster metadata, and the fsck
+layer (tpu_operator_libs/fsck/) defends it — but only for keys the
+DurableKeyRegistry knows about. Registries rot the same way metric
+names and pytest markers do (tools/metrics_lint.py,
+tools/marker_lint.py): a consts.py property grows a new stamp nobody
+registered (the auditor would then classify the operator's OWN writes
+as conflicting), or a subsystem hardcodes an owned-key literal instead
+of going through consts (the stamp silently escapes both the registry
+and this check's reflection). Three static checks, no pytest import:
+
+1. **Declared → registered**: every ``*_label`` / ``*_annotation`` /
+   ``*_prefix`` property of the four consts key families (Upgrade /
+   Remediation / Topology / Federation) must resolve to a
+   DurableKeySpec via ``default_registry().lookup`` — prefix
+   properties are probed with a synthetic suffix.
+2. **Registered → documented**: every registered key family must
+   appear, verbatim, in docs/durable-state.md — the on-call reference
+   table of owner / codec / repair action / crash-ordering contract.
+3. **No stray literals**: no source file outside consts.py may embed a
+   hardcoded ``google.com/libtpu`` key literal (f-string fragments
+   included). Keys must flow from the consts instances so reflection
+   (this check, the registry builder, explain()) sees every family.
+
+Exit status 1 iff findings were printed.
+"""
+
+from __future__ import annotations
+
+import ast
+import sys
+from pathlib import Path
+
+ROOT = Path(__file__).resolve().parent.parent
+sys.path.insert(0, str(ROOT))
+
+from tpu_operator_libs.consts import (  # noqa: E402
+    FederationKeys,
+    RemediationKeys,
+    TopologyKeys,
+    UpgradeKeys,
+)
+from tpu_operator_libs.fsck.registry import (  # noqa: E402
+    default_registry,
+)
+
+#: Property-name suffixes that denote a durable key (event_reason and
+#: friends are Event strings, not cluster metadata).
+KEY_PROP_SUFFIXES = ("_label", "_annotation", "_prefix")
+
+#: The owned-literal fragment no file outside the allowlist may embed.
+OWNED_LITERAL = "google.com/libtpu"
+
+#: Files allowed to spell the owned domain/driver out: consts.py is
+#: the single source of truth the rest of the tree must import from.
+LITERAL_ALLOWLIST = frozenset(("tpu_operator_libs/consts.py",))
+
+DOC = ROOT / "docs" / "durable-state.md"
+
+
+def declared_keys() -> "list[tuple[str, str, bool]]":
+    """(property path, key value, is_prefix) for every durable-key
+    property of the four consts families."""
+    out: list[tuple[str, str, bool]] = []
+    for keys in (UpgradeKeys(), RemediationKeys(), TopologyKeys(),
+                 FederationKeys()):
+        cls = type(keys)
+        for name in sorted(dir(cls)):
+            if not name.endswith(KEY_PROP_SUFFIXES):
+                continue
+            if not isinstance(getattr(cls, name, None), property):
+                continue
+            out.append((f"{cls.__name__}.{name}", getattr(keys, name),
+                        name.endswith("_prefix")))
+    return out
+
+
+def stray_literals(root: Path = ROOT) -> "list[str]":
+    """Site strings for every hardcoded owned-key literal outside the
+    allowlist (plain strings and f-string constant fragments alike)."""
+    findings: list[str] = []
+    for path in sorted((root / "tpu_operator_libs").rglob("*.py")):
+        rel = path.relative_to(root).as_posix()
+        if rel in LITERAL_ALLOWLIST:
+            continue
+        tree = ast.parse(path.read_text(), filename=str(path))
+        for node in ast.walk(tree):
+            if not (isinstance(node, ast.Constant)
+                    and isinstance(node.value, str)):
+                continue
+            if OWNED_LITERAL in node.value:
+                findings.append(
+                    f"{rel}:{node.lineno}: hardcoded owned-key literal "
+                    f"{node.value!r} — import the key from "
+                    f"tpu_operator_libs.consts instead, so the "
+                    f"durable-key registry and this check see it")
+    return findings
+
+
+def lint(root: Path = ROOT) -> "list[str]":
+    findings: list[str] = []
+    registry = default_registry()
+    doc_text = DOC.read_text() if DOC.exists() else ""
+    if not doc_text:
+        findings.append(
+            "docs/durable-state.md: missing — the durable-key "
+            "reference table is the registry's on-call companion")
+    for prop, key, is_prefix in declared_keys():
+        probe = key + "x" if is_prefix else key
+        if registry.lookup(probe) is None:
+            findings.append(
+                f"tpu_operator_libs/consts.py: {prop} = {key!r} "
+                f"resolves to no DurableKeySpec — register it in "
+                f"tpu_operator_libs/fsck/registry.py:default_registry "
+                f"or the auditor will classify the operator's own "
+                f"writes as conflicting stamps")
+    for spec in registry.specs:
+        if doc_text and f"`{spec.key}" not in doc_text:
+            findings.append(
+                f"docs/durable-state.md: registered key "
+                f"{spec.key!r} (owner {spec.owner}) is undocumented — "
+                f"add its row (owner / codec / repair / contract)")
+    findings.extend(stray_literals(root))
+    return findings
+
+
+def main() -> int:
+    findings = lint()
+    for finding in findings:
+        print(finding)
+    if findings:
+        print(f"state_keys_lint: {len(findings)} finding(s)",
+              file=sys.stderr)
+        return 1
+    registry = default_registry()
+    n_props = len(declared_keys())
+    print(f"state_keys_lint: OK ({n_props} consts key properties "
+          f"registered, {len(registry.specs)} registered families "
+          f"documented, no stray owned-key literals)")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -45,12 +45,14 @@ from tpu_operator_libs.chaos.runner import (
     ChaosConfig,
     ChaosReport,
     DagChaosConfig,
+    FsckChaosConfig,
     PrecursorChaosConfig,
     ReconfigChaosConfig,
     ReplicaKillConfig,
     run_bad_revision_soak,
     run_chaos_soak,
     run_dag_soak,
+    run_fsck_soak,
     run_precursor_soak,
     run_reconfig_soak,
     run_replica_kill_soak,
@@ -71,6 +73,7 @@ from tpu_operator_libs.chaos.schedule import (
     FAULT_REGION_KILL,
     FAULT_REPLICA_KILL,
     FAULT_STALE_READS,
+    FAULT_STATE_CORRUPTION,
     FAULT_WATCH_BREAK,
     FAULT_WATCH_DELAY,
     FaultEvent,
@@ -99,6 +102,7 @@ __all__ = [
     "FAULT_REGION_KILL",
     "FAULT_REPLICA_KILL",
     "FAULT_STALE_READS",
+    "FAULT_STATE_CORRUPTION",
     "FAULT_WATCH_BREAK",
     "FAULT_WATCH_DELAY",
     "FaultEvent",
@@ -106,6 +110,7 @@ __all__ = [
     "FederationChaosConfig",
     "FederationFleetSim",
     "FederationMonitor",
+    "FsckChaosConfig",
     "InvariantMonitor",
     "InvariantViolation",
     "OperatorCrash",
@@ -119,6 +124,7 @@ __all__ = [
     "run_chaos_soak",
     "run_dag_soak",
     "run_federation_bad_revision_soak",
+    "run_fsck_soak",
     "run_federation_soak",
     "run_precursor_soak",
     "run_reconfig_soak",

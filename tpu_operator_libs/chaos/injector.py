@@ -17,6 +17,7 @@ exactly the way a SIGKILL between two apiserver writes would.
 from __future__ import annotations
 
 import logging
+from dataclasses import dataclass
 from typing import Callable, Optional
 
 from tpu_operator_libs.chaos.schedule import (
@@ -31,14 +32,21 @@ from tpu_operator_libs.chaos.schedule import (
     FAULT_PDB_BLOCK,
     FAULT_REPLICA_KILL,
     FAULT_STALE_READS,
+    FAULT_STATE_CORRUPTION,
     FAULT_WATCH_BREAK,
     FAULT_WATCH_DELAY,
     FaultEvent,
     FaultSchedule,
 )
+from tpu_operator_libs.fsck.registry import SCHEMA_WRAPPER_RE
 from tpu_operator_libs.health.precursor import SIGNALS, NodeHealthSignal
 from tpu_operator_libs.consts import POD_CONTROLLER_REVISION_HASH_LABEL
-from tpu_operator_libs.consts import UpgradeState
+from tpu_operator_libs.consts import (
+    FederationKeys,
+    RemediationKeys,
+    UpgradeKeys,
+    UpgradeState,
+)
 from tpu_operator_libs.k8s.client import ApiServerError, NotFoundError
 from tpu_operator_libs.k8s.fake import FakeCluster
 from tpu_operator_libs.k8s.objects import Node
@@ -52,6 +60,20 @@ logger = logging.getLogger(__name__)
 #: Pods carrying it can never become Ready — the "broken libtpu build"
 #: the canary guard exists to contain.
 BAD_REVISION_HASH = "bad"
+
+
+@dataclass(frozen=True)
+class CorruptionRecord:
+    """One landed state-corruption write, for the gate's post-checks:
+    every record must be matched by a janitor repair of the same
+    (target, key) at or after ``at``."""
+
+    at: float
+    target_kind: str  # "node" | "daemonset"
+    target: str
+    key: str
+    mode: int
+    value: str
 
 
 class OperatorCrash(RuntimeError):
@@ -186,9 +208,17 @@ class ChaosInjector:
     def __init__(self, cluster: FakeCluster, schedule: FaultSchedule,
                  lease_namespace: str = "kube-system",
                  lease_name: str = "chaos-operator-leader",
-                 shard_lease_prefix: str = "") -> None:
+                 shard_lease_prefix: str = "",
+                 upgrade_keys: Optional[UpgradeKeys] = None,
+                 remediation_keys: Optional[RemediationKeys] = None,
+                 federation_keys: Optional[FederationKeys] = None) -> None:
         self._cluster = cluster
         self._schedule = schedule
+        # key families the state-corruption fault vandalizes (defaults
+        # match the fleet builders' driver/domain)
+        self._upgrade_keys = upgrade_keys or UpgradeKeys()
+        self._remediation_keys = remediation_keys or RemediationKeys()
+        self._federation_keys = federation_keys or FederationKeys()
         self._lease_namespace = lease_namespace
         self._lease_name = lease_name
         # sharded-control-plane runs: leader-loss events targeting
@@ -221,6 +251,9 @@ class ChaosInjector:
         # exactly like a telemetry agent that never reported.
         self.health_signals: dict[str, NodeHealthSignal] = {}
         self.degradation_ticks = 0
+        #: Every state-corruption write that landed (the fsck gate's
+        #: repair-coverage ledger).
+        self.corruptions: list[CorruptionRecord] = []
 
     # -- installation -----------------------------------------------------
     def install(self) -> None:
@@ -267,6 +300,9 @@ class ChaosInjector:
                     event.at, lambda e=event: self._kill_node(e))
             elif event.kind == FAULT_DEGRADATION:
                 self._install_degradation(event)
+            elif event.kind == FAULT_STATE_CORRUPTION:
+                cluster.schedule_at(
+                    event.at, lambda e=event: self._corrupt(e))
         if any(e.kind == FAULT_NODE_KILL for e in self._schedule.events):
             # a dead host's kubelet never reports a healthy container:
             # pods recreated on a killed node crash-loop until the node
@@ -293,6 +329,105 @@ class ChaosInjector:
                     event.target, BAD_REVISION_HASH)
         self._cluster.bump_daemon_set_revision(namespace, name,
                                                BAD_REVISION_HASH)
+
+    # -- state corruption -------------------------------------------------
+    def _corrupt(self, event: FaultEvent) -> None:
+        """Vandalize one durable stamp the way an external writer would.
+
+        Writes go through the RAW cluster (ride-out on injected API
+        faults via :func:`consume_transient`), never the crash fuse:
+        corruption is not the operator's write, so it neither consumes
+        the fuse budget nor respects the provider's preconditions. Every
+        landed write is recorded in :attr:`corruptions` so the fsck gate
+        can demand a matching janitor repair. Values are chosen so the
+        auditor provably classifies each one (garbage validators fail,
+        ghost incumbents never exist, wrappers always read as skew) —
+        a corruption the auditor could mistake for legitimate state
+        would make the repair-coverage check vacuous.
+        """
+        up = self._upgrade_keys
+        rem = self._remediation_keys
+        fed = self._federation_keys
+        mode = event.param % 6
+        variant = event.param // 6
+        cluster = self._cluster
+        node = event.target
+
+        if mode == 0:
+            # garbage value on a registered node annotation; every
+            # payload has ZERO codec-decodable survivors, so normalize
+            # repairs delete rather than partially restore
+            key, value = (
+                (up.validation_start_annotation, "not-a-number"),
+                (up.phase_durations_annotation, "drain=abc,bogus"),
+                (rem.precursor_rates_annotation, "ecc=??,zzz=1"),
+                (up.phase_start_annotation, "warp:xx"),
+            )[variant % 4]
+            self._write_node_annotation(event, node, key, value, mode)
+        elif mode == 1:
+            # orphaned prewarm stamp naming a GHOST incumbent — provably
+            # dead regardless of fleet state; the ready variant is also
+            # a torn pair (join stamp without its reserve half)
+            if variant % 2 == 0:
+                key, value = (up.prewarm_reservation_annotation,
+                              "ghost-host:m1:gold")
+            else:
+                key, value = (up.prewarm_ready_annotation,
+                              "ghost-host:123.0")
+            self._write_node_annotation(event, node, key, value, mode)
+        elif mode == 2:
+            # garbage shard-owner label (labels, not annotations: the
+            # other repair path)
+            key, value = up.shard_label, "shard-!!"
+            consume_transient(lambda: cluster.patch_node_labels(
+                node, {key: value}))
+            self.corruptions.append(CorruptionRecord(
+                at=event.at, target_kind="node", target=node, key=key,
+                mode=mode, value=value))
+        elif mode == 3:
+            # cross-subsystem collision: an unregistered key squatting
+            # under the owned prefix
+            key = f"{up.domain}/{up.driver}-upgrade.bogus-{variant}"
+            self._write_node_annotation(event, node, key, "1", mode)
+        elif mode == 4:
+            # schema-version skew: wrap a PRESENT stamp so the convert
+            # repair must restore the exact original — never fabricate
+            # a value that was not there
+            live = consume_transient(lambda: cluster.get_node(node))
+            key, value = up.phase_durations_annotation, "v0;bogus"
+            for candidate in (up.phase_durations_annotation,
+                              rem.precursor_rates_annotation,
+                              up.phase_start_annotation):
+                current = live.metadata.annotations.get(candidate, "")
+                if current and not SCHEMA_WRAPPER_RE.match(current):
+                    key, value = candidate, f"v0;{current}"
+                    break
+            self._write_node_annotation(event, node, key, value, mode)
+        else:
+            # DaemonSet stamp corruption (dangling shard attestation /
+            # garbled federation ledger entries)
+            namespace, _, name = event.target.partition("/")
+            key, value = (
+                (up.canary_shard_passed_prefix + "99", "deadbeef"),
+                (fed.budget_share_annotation, "not-an-int"),
+                (fed.bake_passed_annotation, "garbled"),
+            )[variant % 3]
+            consume_transient(
+                lambda: cluster.patch_daemon_set_annotations(
+                    namespace, name, {key: value}))
+            self.corruptions.append(CorruptionRecord(
+                at=event.at, target_kind="daemonset", target=event.target,
+                key=key, mode=mode, value=value))
+        logger.info("chaos: corrupted %s (mode %d) on %s", key, mode,
+                    event.target)
+
+    def _write_node_annotation(self, event: FaultEvent, node: str,
+                               key: str, value: str, mode: int) -> None:
+        consume_transient(lambda: self._cluster.patch_node_annotations(
+            node, {key: value}))
+        self.corruptions.append(CorruptionRecord(
+            at=event.at, target_kind="node", target=node, key=key,
+            mode=mode, value=value))
 
     def _install_degradation(self, event: FaultEvent) -> None:
         """Arm one degradation ramp as a fixed cadence of counter

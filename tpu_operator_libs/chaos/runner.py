@@ -56,6 +56,7 @@ from tpu_operator_libs.chaos.injector import (
     ChaosInjector,
     CrashingStateProvider,
     OperatorCrash,
+    consume_transient,
 )
 from tpu_operator_libs.chaos.invariants import (
     CapacityExpectation,
@@ -69,9 +70,11 @@ from tpu_operator_libs.chaos.invariants import (
 )
 from tpu_operator_libs.chaos.schedule import (
     FAULT_NODE_KILL,
+    FAULT_STATE_CORRUPTION,
     FAULT_TRAFFIC_SPIKE,
     FaultSchedule,
 )
+from tpu_operator_libs.fsck import StateAuditor, default_registry
 from tpu_operator_libs.chaos.serving import (
     CapacityLog,
     DiurnalTrace,
@@ -252,7 +255,9 @@ class _OperatorIncarnation:
                  identity: str, with_reconfigurer: bool = False,
                  serving: "Optional[ServingFleetSim]" = None,
                  monitor: "Optional[InvariantMonitor]" = None,
-                 precursor_source: "object" = None) -> None:
+                 precursor_source: "object" = None,
+                 fsck_registry: "object" = None,
+                 fsck_repair_log: "Optional[list]" = None) -> None:
         # The event-driven scheduling layer runs INSIDE the gate: both
         # machines carry a live ReconcileNudger (completion nudges +
         # deadline timer wheel + eager slot refill all active), exactly
@@ -368,6 +373,24 @@ class _OperatorIncarnation:
         if monitor is not None:
             self.obs.audit.mirror = monitor.note_decision
             monitor.obs_source = lambda: self.obs
+        # The durable-state fsck pair: a fresh auditor per incarnation
+        # (its clean-digest cache is an optimization, never state — it
+        # dies with the process and the next incarnation rescans), and
+        # a janitor whose repairs run through the SAME crash fuse as
+        # the machines' durable writes. Only the repair log survives
+        # the incarnation (injected by the harness): audited explain()
+        # chains must outlive the process that wrote them.
+        self.auditor = None
+        self.janitor = None
+        if fsck_registry is not None:
+            from tpu_operator_libs.fsck import Janitor, StateAuditor
+
+            self.auditor = StateAuditor(fsck_registry, clock=clock,
+                                        audit=self.obs.audit)
+            self.janitor = Janitor(
+                cluster, fsck_registry, keys, remediation_keys=rem_keys,
+                guard=injector.fuse.guard, audit=self.obs.audit,
+                clock=clock, repair_log=fsck_repair_log)
 
 
 def run_chaos_soak(seed: int,
@@ -1215,7 +1238,7 @@ class PrecursorChaosConfig(ReconfigChaosConfig):
 #: pools, schedulability, readiness, upgrade state — must be
 #: BIT-IDENTICAL between the two modes.
 _FINGERPRINT_EXCLUDED = ("-precursor.", "-remediation.", "-upgrade.",
-                         "-topology.")
+                         "-topology.", "-fsck.")
 
 
 def _fleet_fingerprint(cluster: FakeCluster,
@@ -1654,6 +1677,341 @@ def run_precursor_soak(seed: int,
         })
     report.report_text = "\n".join(
         [schedule.describe(), monitor.report(seed=seed)])
+    if not report.ok:
+        logger.error("%s", report.report_text)
+    return report
+
+
+@dataclass
+class FsckChaosConfig(ChaosConfig):
+    """Knobs of one durable-state fsck episode.
+
+    The fleet and rollout shape are the base chaos gate's; the schedule
+    swaps the sampled side-fault pool for 4-8 seeded
+    ``state-corruption`` events (plus crashes and api/watch faults).
+    Each seed is run TWICE — corrupted and corruption-free twin — and
+    the converged fleets must fingerprint bit-identically."""
+
+    #: Side fault kinds beside crashes + corruption (api-burst /
+    #: watch-break; the generator excludes stale-reads by design).
+    extra_fault_kinds: int = 2
+
+
+def _run_fsck_episode(seed: int, config: FsckChaosConfig,
+                      corrupt: bool) -> ChaosReport:
+    """One fsck episode: the base chaos loop with the auditor/janitor
+    pair scanning BEFORE the state machines every leader pass.
+
+    The scan-before-act ordering is the gate's no-corrupted-decision
+    mechanism: corruption lands between ticks (scheduled cluster
+    actions), every leader pass audits first, and a pass with findings
+    repairs them and SKIPS the managers — so no manager ever builds
+    state from a snapshot containing an unrepaired corrupted stamp.
+    Unrepairable findings would hold the managers forever and fail the
+    liveness backstop, which is exactly the alarm that should fire.
+    """
+    fleet = FleetSpec(
+        n_slices=config.n_slices,
+        hosts_per_slice=config.hosts_per_slice,
+        pod_recreate_delay=config.pod_recreate_delay,
+        pod_ready_delay=config.pod_ready_delay,
+        multislice_jobs=(
+            ("chaos-job", tuple(range(config.n_slices))),))
+    cluster, clock, keys = build_fleet(fleet)
+    rem_keys = RemediationKeys()
+    node_names = [n.metadata.name for n in cluster.list_nodes()]
+
+    schedule = FaultSchedule.generate_fsck(
+        seed, node_names, ds_target=f"{NS}/libtpu",
+        horizon=config.horizon, extra_kinds=config.extra_fault_kinds)
+    if not corrupt:
+        # the corruption-free twin: SAME crashes and side faults at the
+        # same instants, zero vandalism — the fingerprint baseline
+        schedule = schedule.without(FAULT_STATE_CORRUPTION)
+    injector = ChaosInjector(cluster, schedule,
+                             lease_namespace=config.lease_namespace,
+                             lease_name=config.lease_name,
+                             upgrade_keys=keys,
+                             remediation_keys=rem_keys)
+    injector.install()
+    cluster.schedule_at(
+        config.horizon / 2.0,
+        lambda: cluster.bump_daemon_set_revision(NS, "libtpu",
+                                                 FINAL_REVISION))
+
+    registry = default_registry(driver=keys.driver, domain=keys.domain)
+    # the ONLY fsck state that survives incarnations: audited repairs
+    # with their explain() chains
+    repair_log: list = []
+
+    upgrade_policy = config.upgrade_policy()
+    remediation_policy = config.remediation_policy()
+    monitor = InvariantMonitor(
+        cluster=cluster, upgrade_keys=keys, remediation_keys=rem_keys,
+        max_unavailable=upgrade_policy.max_unavailable,
+        remediation_max_unavailable=remediation_policy.max_unavailable,
+        max_parallel_upgrades=config.max_parallel_upgrades)
+
+    incarnations = 1
+    handovers = 0
+    reconciles = 0
+    fsck_hold_ticks = 0
+    op = _OperatorIncarnation(cluster, clock, keys, rem_keys, config,
+                              injector, identity="operator-1",
+                              monitor=monitor, fsck_registry=registry,
+                              fsck_repair_log=repair_log)
+
+    def next_incarnation(reason: str) -> _OperatorIncarnation:
+        nonlocal incarnations
+        incarnations += 1
+        injector.fuse.reset()
+        monitor.trace.append(
+            f"[t={clock.now():g}] operator restart #{incarnations} "
+            f"({reason}) — rebuilding managers from cluster state alone")
+        return _OperatorIncarnation(
+            cluster, clock, keys, rem_keys, config, injector,
+            identity=f"operator-{incarnations}", monitor=monitor,
+            fsck_registry=registry, fsck_repair_log=repair_log)
+
+    def converged() -> bool:
+        try:
+            nodes = cluster.list_nodes()
+            pods = cluster.list_pods(namespace=NS)
+        except (ApiServerError, TimeoutError):
+            return False
+        if len(nodes) != len(node_names):
+            return False
+        for node in nodes:
+            labels = node.metadata.labels
+            if labels.get(keys.state_label) != str(UpgradeState.DONE):
+                return False
+            if labels.get(rem_keys.state_label, ""):
+                return False
+            if keys.skip_label in labels:
+                return False
+            if node.is_unschedulable() or not node.is_ready():
+                return False
+        runtime = [p for p in pods
+                   if p.controller_owner() is not None]
+        if len(runtime) != len(node_names):
+            return False
+        return all(
+            p.metadata.labels.get(POD_CONTROLLER_REVISION_HASH_LABEL)
+            == FINAL_REVISION and p.is_ready() for p in runtime)
+
+    steps = 0
+    is_converged = False
+    quiesce_ticks = 0
+    while steps < config.max_steps:
+        steps += 1
+        now = clock.now()
+        was_leading = op.elector.is_leader
+        op.elector.try_acquire_or_renew()
+        if was_leading and not op.elector.is_leader:
+            handovers += 1
+            op = next_incarnation("leader election lost")
+            op.elector.try_acquire_or_renew()
+        if op.elector.is_leader:
+            injector.arm_due_crashes(now)
+            op.nudger.pop_due(now)
+            op.nudger.consume_pending()
+            try:
+                # fsck runs FIRST: a pass that finds corruption repairs
+                # it and holds the machines this tick, so no corrupted
+                # stamp is ever in a snapshot a manager acts on
+                findings = op.auditor.scan(
+                    cluster.list_nodes(),
+                    cluster.list_daemon_sets(NS))
+                if findings:
+                    fsck_hold_ticks += 1
+                    monitor.trace.append(
+                        f"[t={now:g}] fsck: {len(findings)} finding(s) "
+                        f"— repairing, managers held this pass")
+                    op.janitor.repair(findings)
+                else:
+                    op.remediation.reconcile(NS, dict(RUNTIME_LABELS),
+                                             remediation_policy)
+                    op.upgrade.reconcile(NS, dict(RUNTIME_LABELS),
+                                         upgrade_policy)
+                    reconciles += 1
+            except OperatorCrash:
+                op = next_incarnation("operator crash mid-reconcile")
+            except BuildStateError:
+                pass  # incomplete snapshot; next tick retries
+            except (ApiServerError, ConflictError, NotFoundError):
+                pass  # pass aborted on a transient; next tick retries
+            if injector.fuse.pending:
+                op = next_incarnation("operator crash (surfaced late)")
+        monitor.drain()
+        if steps % 5 == 0 and op.upgrade.last_state is not None:
+            for parked in monitor.parked_nodes():
+                monitor.audit_explain(parked,
+                                      op.upgrade.explain(parked))
+        try:
+            restore_workload_pods(cluster, fleet)
+        except (ApiServerError, TimeoutError):
+            pass  # injected fault; the JobSet controller retries too
+        monitor.drain()
+        if (now > schedule.last_fault_time
+                and not injector.fuse.armed
+                and not injector.fuse.pending
+                and converged()):
+            quiesce_ticks += 1
+            if quiesce_ticks >= 3:
+                is_converged = True
+                break
+        else:
+            quiesce_ticks = 0
+        clock.advance(config.reconcile_interval)
+        cluster.step()
+        monitor.drain()
+
+    if is_converged:
+        monitor.final_check()
+    else:
+        monitor.violations.append(InvariantViolation(
+            invariant="liveness", at=clock.now(), subject="fleet",
+            detail=f"fleet did not converge within {config.max_steps} "
+                   f"steps ({clock.now():g}s virtual) after the last "
+                   f"fault healed at {schedule.last_fault_time:g}s"))
+
+    # fsck-clean: a FRESH auditor (no warm digest cache) over the final
+    # fleet must find nothing — every injected corruption and every
+    # crash-torn repair has been healed
+    try:
+        leftover = StateAuditor(registry).scan(
+            consume_transient(cluster.list_nodes),
+            consume_transient(lambda: cluster.list_daemon_sets(NS)))
+    except (ApiServerError, TimeoutError, RuntimeError):
+        leftover = []
+        monitor.violations.append(InvariantViolation(
+            invariant="fsck-clean", at=clock.now(), subject="fleet",
+            detail="final fsck scan could not read the fleet"))
+    for f in leftover:
+        monitor.violations.append(InvariantViolation(
+            invariant="fsck-clean", at=clock.now(),
+            subject=f"{f.target_kind}/{f.target}",
+            detail=f"post-soak stamp {f.key}={f.value!r} still "
+                   f"classified {f.classification}: {f.reason}"))
+
+    # repair coverage: every landed corruption must be matched by an
+    # audited repair of the same (target, key) at or after injection
+    for rec in injector.corruptions:
+        if not any(r.target == rec.target and r.key == rec.key
+                   and r.at >= rec.at for r in repair_log):
+            monitor.violations.append(InvariantViolation(
+                invariant="fsck-repair-coverage", at=rec.at,
+                subject=f"{rec.target_kind}/{rec.target}",
+                detail=f"corruption of {rec.key} (mode {rec.mode}, "
+                       f"value {rec.value!r}) was never repaired"))
+    # every repair audited with a non-empty explain chain
+    for r in repair_log:
+        if not r.chain:
+            monitor.violations.append(InvariantViolation(
+                invariant="fsck-audit", at=r.at,
+                subject=f"{r.target_kind}/{r.target}",
+                detail=f"repair {r.action} of {r.key} carries no "
+                       f"explain() chain"))
+
+    # harness sanity: the corrupted episode must actually have vandals
+    if injector.crashes_fired == 0:
+        monitor.violations.append(InvariantViolation(
+            invariant="harness", at=clock.now(), subject="injector",
+            detail="no operator crash fired — the schedule's crash "
+                   "events never detonated"))
+    if corrupt and len(injector.corruptions) < 3:
+        monitor.violations.append(InvariantViolation(
+            invariant="harness", at=clock.now(), subject="injector",
+            detail=f"only {len(injector.corruptions)} corruption(s) "
+                   f"landed — the fsck gate needs a real vandal"))
+
+    try:
+        fingerprint = _fleet_fingerprint(cluster)
+    except (ApiServerError, TimeoutError):
+        fingerprint = []
+    repairs_by_action: dict = {}
+    for r in repair_log:
+        repairs_by_action[r.action] = (
+            repairs_by_action.get(r.action, 0) + 1)
+    report = ChaosReport(
+        seed=seed,
+        converged=is_converged,
+        violations=list(monitor.violations),
+        fault_kinds=tuple(sorted(schedule.kinds)),
+        crashes_fired=injector.crashes_fired,
+        leader_handovers=handovers,
+        operator_incarnations=incarnations,
+        watch_gaps=monitor.watch_gaps,
+        total_seconds=clock.now(),
+        steps=steps,
+        reconciles=reconciles,
+        trace=list(monitor.trace),
+        decisions_recorded=monitor.decisions_recorded,
+        explains_probed=monitor.explains_probed,
+        stats={
+            "corrupted": corrupt,
+            "corruptionsInjected": len(injector.corruptions),
+            "corruptionModes": sorted(
+                {rec.mode for rec in injector.corruptions}),
+            "repairsByAction": dict(sorted(repairs_by_action.items())),
+            "fsckHoldTicks": fsck_hold_ticks,
+            "fingerprint": fingerprint,
+        })
+    report.report_text = "\n".join(
+        [schedule.describe(), monitor.report(seed=seed)])
+    return report
+
+
+def run_fsck_soak(seed: int,
+                  config: Optional[FsckChaosConfig] = None,
+                  ) -> ChaosReport:
+    """The durable-state fsck gate: one seeded episode run twice.
+
+    The corrupted run takes the full ``generate_fsck`` schedule — 4-8
+    external-writer corruption events laid over crashes and API faults
+    mid-rollout; the baseline twin strips ONLY the corruption (same
+    seed, same crash instants). The corrupted run must (1) converge,
+    (2) end fsck-clean with every corruption matched by an audited
+    repair carrying a non-empty explain() chain, and (3) produce a
+    final fleet fingerprint BIT-IDENTICAL to the baseline's — the
+    vandalism leaves no trace the repairs didn't erase. Baseline
+    violations are folded into the returned report (prefixed
+    ``baseline:``), so a broken twin can never green the gate.
+    """
+    config = config or FsckChaosConfig()
+    report = _run_fsck_episode(seed, config, corrupt=True)
+    baseline = _run_fsck_episode(seed, config, corrupt=False)
+
+    for violation in baseline.violations:
+        report.violations.append(InvariantViolation(
+            invariant=violation.invariant, at=violation.at,
+            subject=f"baseline:{violation.subject}",
+            detail=violation.detail))
+    if not baseline.converged:
+        report.converged = False
+    fingerprint = report.stats.get("fingerprint")
+    baseline_fp = baseline.stats.get("fingerprint")
+    if fingerprint != baseline_fp:
+        diff = [f"corrupted={c!r} baseline={b!r}"
+                for c, b in zip(fingerprint or [], baseline_fp or [])
+                if c != b]
+        report.violations.append(InvariantViolation(
+            invariant="fsck-fingerprint", at=report.total_seconds,
+            subject="fleet",
+            detail="corrupted-run fleet fingerprint diverges from the "
+                   "corruption-free twin: "
+                   + ("; ".join(diff[:3]) if diff else
+                      "fingerprint lengths differ")))
+    report.stats["baselineFingerprint"] = baseline_fp
+    report.stats["baselineConverged"] = baseline.converged
+    report.trace.append(
+        f"fsck soak seed={seed}: "
+        f"{report.stats['corruptionsInjected']} corruption(s) over "
+        f"modes {report.stats['corruptionModes']}, repairs "
+        f"{report.stats['repairsByAction']}, "
+        f"{report.stats['fsckHoldTicks']} held pass(es), fingerprint "
+        f"{'MATCHES' if fingerprint == baseline_fp else 'DIVERGES'} "
+        f"baseline")
     if not report.ok:
         logger.error("%s", report.report_text)
     return report

@@ -127,6 +127,26 @@ FAULT_FED_PARTITION = "fed-partition"
 #: must resume from the regions' durable stamps alone (the
 #: ``federation-resume`` invariant).
 FAULT_FED_KILL = "federation-controller-kill"
+#: An EXTERNAL writer corrupts one durable stamp at ``at``: a kubectl-
+#: editing human, a mutating webhook, a stale operator build — anything
+#: that writes the operator's annotations/labels without the operator's
+#: crash-ordering discipline. ``target`` is the victim node (or empty
+#: for the DaemonSet), ``param`` encodes the corruption mode+variant
+#: (``mode = param %% 6``: garbage value on a registered annotation /
+#: orphaned ghost-incumbent stamp incl. torn prewarm pairs / garbage
+#: shard label / unregistered key squatting under an owned prefix /
+#: schema-version wrapper / DaemonSet stamp corruption; ``variant =
+#: param // 6`` picks the key within the mode). The injector writes
+#: through the raw cluster — NOT the crash fuse — because corruption is
+#: not the operator's write. State LABELS other than the shard label
+#: are never corrupted: the invariant monitor's legal-edge tracking
+#: treats label transitions as ground truth, and fsck's answer to an
+#: ambiguous state label (quarantine, never guess) is deliberately
+#: exercised in unit tests rather than mid-soak. Repair is the fsck
+#: subsystem's job; the gate proves no corrupted stamp ever drives a
+#: decision and the fleet fingerprint converges bit-identical to a
+#: corruption-free run of the same seed.
+FAULT_STATE_CORRUPTION = "state-corruption"
 
 #: The full catalog, in deterministic order (generation samples from it).
 FAULT_KINDS = (
@@ -227,6 +247,16 @@ class FaultSchedule:
         lines = [f"fault schedule (seed={self.seed}):"]
         lines += [f"  {e.describe()}" for e in self.events]
         return "\n".join(lines)
+
+    def without(self, kind: str) -> "FaultSchedule":
+        """The same schedule minus every ``kind`` event — the
+        differential-baseline tool: ``generate_fsck(seed).without(
+        FAULT_STATE_CORRUPTION)`` is the corruption-free twin with the
+        crash/side faults at identical times, so a fingerprint diff
+        isolates exactly the corruption family's effect."""
+        return FaultSchedule(
+            seed=self.seed,
+            events=tuple(e for e in self.events if e.kind != kind))
 
     @classmethod
     def generate(cls, seed: int, node_names: list[str],
@@ -556,6 +586,62 @@ class FaultSchedule:
             elif kind == FAULT_STALE_READS:
                 events.append(FaultEvent(
                     at=start, kind=kind, target=rng.choice(nodes),
+                    param=rng.randint(1, 3)))
+            else:
+                events.append(FaultEvent(at=start, kind=kind))
+        events.sort(key=lambda e: (e.at, e.kind, e.target))
+        return cls(seed=seed, events=tuple(events))
+
+    @classmethod
+    def generate_fsck(cls, seed: int, node_names: "list[str]",
+                      ds_target: str,
+                      horizon: float = 600.0,
+                      extra_kinds: int = 2) -> "FaultSchedule":
+        """Schedule for the durable-state fsck gate: 4-8
+        ``state-corruption`` events spread over the first 70%% of the
+        horizon (so corruption lands before, during, AND after the
+        mid-run rollout bump at horizon/2), 1-2 operator crashes, and
+        ``extra_kinds`` control-plane side faults. The baseline twin is
+        ``generate_fsck(seed, ...).without(FAULT_STATE_CORRUPTION)`` —
+        same seed, same crashes and side faults at the same instants,
+        zero corruption — whose converged fleet fingerprint the
+        corrupted run must match bit-for-bit.
+
+        ``param`` packs ``mode + 6 * variant``; the injector decodes it
+        (see :data:`FAULT_STATE_CORRUPTION`). Node-victim modes pick a
+        node uniformly; repeated victims are fine (later corruption of
+        an already-repaired key just re-exercises the janitor).
+        """
+        if not node_names:
+            raise ValueError("node_names must be non-empty")
+        rng = random.Random(f"chaos-fsck:{seed}")
+        nodes = sorted(node_names)
+        events: list[FaultEvent] = []
+        for _ in range(rng.randint(1, 2)):
+            events.append(FaultEvent(
+                at=rng.uniform(0.1, horizon * 0.45),
+                kind=FAULT_OPERATOR_CRASH,
+                param=rng.randint(0, 8)))
+        for _ in range(rng.randint(4, 8)):
+            mode = rng.randrange(6)
+            variant = rng.randrange(4)
+            target = ds_target if mode == 5 else rng.choice(nodes)
+            events.append(FaultEvent(
+                at=rng.uniform(horizon * 0.05, horizon * 0.7),
+                kind=FAULT_STATE_CORRUPTION, target=target,
+                param=mode + 6 * variant))
+        # Side pool deliberately excludes stale-reads: the gate's
+        # no-corrupted-decision claim rests on scan-before-act within a
+        # pass, and a stale read could hand the auditor an older
+        # snapshot than the managers' — the one interleaving that
+        # breaks the construction rather than testing it.
+        pool = [FAULT_API_BURST, FAULT_WATCH_BREAK]
+        for kind in rng.sample(pool, min(extra_kinds, len(pool))):
+            start = rng.uniform(0.1, horizon * 0.7)
+            if kind == FAULT_API_BURST:
+                events.append(FaultEvent(
+                    at=start, kind=kind,
+                    target=rng.choice(API_BURST_OPERATIONS),
                     param=rng.randint(1, 3)))
             else:
                 events.append(FaultEvent(at=start, kind=kind))

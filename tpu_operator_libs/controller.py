@@ -35,7 +35,13 @@ import time
 from dataclasses import dataclass
 from typing import TYPE_CHECKING, Callable, Optional
 
-from tpu_operator_libs.k8s.watch import BOOKMARK, DELETED, Watch, WatchEvent
+from tpu_operator_libs.k8s.watch import (
+    BOOKMARK,
+    DELETED,
+    EXPIRED,
+    Watch,
+    WatchEvent,
+)
 
 if TYPE_CHECKING:
     from tpu_operator_libs.metrics import MetricsRegistry
@@ -265,6 +271,9 @@ class Informer:
         # error on an overflow BOOKMARK): retried on the next pump so
         # the consumed marker cannot strand the cache stale.
         self._needs_refresh = False
+        #: 410-expired recoveries performed (observability): each EXPIRED
+        #: marker that forced a relist + fresh watch bumps this.
+        self.expired_relists = 0
         self._store: dict[tuple[str, str], object] = {}
         # Monotonic time of the last watch-event apply per key; deleted
         # keys keep their entry as a tombstone. refresh() consults these
@@ -356,6 +365,30 @@ class Informer:
                                f"unthreaded informers")
         applied = 0
         if self._watch.stopped and self._rewatch is not None:
+            # Drain the dead stream's backlog before replacing it: an
+            # in-band EXPIRED marker (410) must be observed here — it
+            # is the difference between inferring a relist from a
+            # silently closed stream and the server-declared expiry
+            # the counters track. The backlog's regular events were
+            # delivered before the stream died and apply normally; the
+            # relist below heals anything after them.
+            while True:
+                event = self._watch.get(timeout=0.0)
+                if event is None:
+                    break
+                if event.type == EXPIRED:
+                    logger.warning("%s: watch cursor expired (410); "
+                                   "relisting", self._name)
+                    self.expired_relists += 1
+                    continue
+                if event.type == BOOKMARK:
+                    continue  # the pending relist already repairs this
+                applied += 1
+                try:
+                    self._apply(event)
+                except Exception:
+                    logger.exception("%s: failed to apply watch event",
+                                     self._name)
             self._watch = self._rewatch()
             self._needs_refresh = True
         if self._needs_refresh:
@@ -373,6 +406,22 @@ class Informer:
             if event.type == BOOKMARK:
                 logger.warning("%s: watch overflow bookmark; relisting",
                                self._name)
+                try:
+                    self.refresh()
+                except Exception:
+                    self._needs_refresh = True
+                    raise
+                continue
+            if event.type == EXPIRED:
+                # 410 Gone: the server cannot replay the gap — the old
+                # stream is dead. Open the fresh watch BEFORE relisting
+                # (no event gap between stream and list), then relist.
+                # Re-watching without relisting would loop 410 forever.
+                logger.warning("%s: watch cursor expired (410); "
+                               "relisting", self._name)
+                self.expired_relists += 1
+                if self._rewatch is not None:
+                    self._watch = self._rewatch()
                 try:
                     self.refresh()
                 except Exception:
@@ -426,6 +475,15 @@ class Informer:
                     # a relist repairs it
                     logger.warning("%s: watch overflow bookmark; "
                                    "relisting", self._name)
+                    self.refresh()
+                    continue
+                if event.type == EXPIRED:
+                    # 410 Gone: relist while draining; the stopped
+                    # stream then ends this loop (threaded informers
+                    # have no rewatch seam — the owner restarts them)
+                    logger.warning("%s: watch cursor expired (410); "
+                                   "relisting", self._name)
+                    self.expired_relists += 1
                     self.refresh()
                     continue
                 self._apply(event)
@@ -799,10 +857,12 @@ class Controller:
         for event in watch:
             if self._stop.is_set():
                 return
-            if event.type == BOOKMARK and key_fn is not _cluster_key_fn:
-                # overflow marker carries no object, so a per-object key
-                # function cannot resolve it; the resync timer remains
-                # the repair path for those controllers
+            if event.type in (BOOKMARK, EXPIRED) \
+                    and key_fn is not _cluster_key_fn:
+                # overflow/expiry markers carry no object, so a
+                # per-object key function cannot resolve them; the
+                # resync timer remains the repair path for those
+                # controllers
                 continue
             try:
                 key = key_fn(event)

@@ -1,0 +1,62 @@
+"""Durable-state fsck: registry, auditor, and self-healing janitor.
+
+The operator's only store is cluster metadata — node/DaemonSet labels
+and annotations — and eighteen PRs of crash-ordered stamps assume the
+operator itself wrote them. This package defends that store against
+everything else that writes it (kubectl-editing humans, mutating
+webhooks, stale operator builds mid-self-upgrade, torn multi-owner
+writes): the :class:`DurableKeyRegistry` catalogs every owned key with
+its codec, schema version, and repair action; the
+:class:`StateAuditor` classifies live stamps (garbage / orphaned /
+conflicting / version-skewed) before the state machines read them; the
+:class:`Janitor` repairs findings through audited, crash-ordered,
+idempotent patches — and parks what it cannot prove (quarantine, never
+guess). See ``docs/durable-state.md`` for the full key reference.
+"""
+
+from tpu_operator_libs.fsck.auditor import (
+    CLASSIFICATIONS,
+    CONFLICTING,
+    GARBAGE,
+    ORPHANED,
+    VERSION_SKEWED,
+    Finding,
+    StateAuditor,
+)
+from tpu_operator_libs.fsck.janitor import Janitor, RepairRecord
+from tpu_operator_libs.fsck.registry import (
+    REPAIR_CONVERT,
+    REPAIR_DROP,
+    REPAIR_NORMALIZE,
+    REPAIR_PRESERVE,
+    REPAIR_QUARANTINE,
+    REPAIR_SWEEP,
+    AuditContext,
+    DurableKeyRegistry,
+    DurableKeySpec,
+    default_registry,
+    fsck_quarantine_annotation,
+)
+
+__all__ = [
+    "AuditContext",
+    "CLASSIFICATIONS",
+    "CONFLICTING",
+    "DurableKeyRegistry",
+    "DurableKeySpec",
+    "Finding",
+    "GARBAGE",
+    "Janitor",
+    "ORPHANED",
+    "REPAIR_CONVERT",
+    "REPAIR_DROP",
+    "REPAIR_NORMALIZE",
+    "REPAIR_PRESERVE",
+    "REPAIR_QUARANTINE",
+    "REPAIR_SWEEP",
+    "RepairRecord",
+    "StateAuditor",
+    "VERSION_SKEWED",
+    "default_registry",
+    "fsck_quarantine_annotation",
+]

@@ -1,0 +1,285 @@
+"""StateAuditor: classify every owned durable stamp against the
+:class:`~tpu_operator_libs.fsck.registry.DurableKeyRegistry`.
+
+The auditor is the fsck *read* half: it runs inside the reconcile loop
+(before the state machines act, so a corrupted stamp is caught before
+it can drive an admission/abort/rollback decision) and emits
+:class:`Finding` records for the :class:`~tpu_operator_libs.fsck.
+janitor.Janitor` to repair. It never writes.
+
+Classification ladder per owned key, first hit wins:
+
+1. **conflicting** — the key sits under an owned prefix but resolves to
+   no registered spec (cross-subsystem collision, typo'd writer,
+   squatting webhook), or a registered key appears on the wrong object
+   kind / attribute (a node label where the catalog says DS
+   annotation).
+2. *(preserve keys stop here — user/runtime inputs are cataloged, never
+   judged.)*
+3. **version-skewed** — a ``v<K>;`` schema wrapper (bare payload = v1);
+   a stale operator build wrote a different schema mid-self-upgrade.
+4. **garbage** — the value fails the spec's validator (or its codec
+   round-trip for map-shaped values).
+5. **orphaned** — the value is well-formed but its owning arc is
+   provably dead: the incumbent node vanished, the shard retired, the
+   state machine left the stamp's owning states.
+6. valid.
+
+Cost: O(delta). A per-target digest of ``(labels, annotations)`` is
+cached after a scan that produced **zero** findings for that target —
+cache entries are deliberately NOT recorded for dirty targets, so a
+finding whose repair crashed (the janitor runs under the chaos crash
+fuse) is re-found by the next incarnation instead of being skipped as
+already-seen. The digest walk is columnar-friendly: two sorted
+key/value sweeps per object, no per-key allocation when clean.
+"""
+
+from __future__ import annotations
+
+import logging
+from dataclasses import dataclass, field
+from typing import Iterable, List, Optional, Tuple
+
+from tpu_operator_libs.consts import GKE_NODEPOOL_LABEL
+from tpu_operator_libs.fsck.registry import (
+    KIND_DS_ANNOTATION,
+    KIND_NODE_ANNOTATION,
+    KIND_NODE_LABEL,
+    REPAIR_CONVERT,
+    REPAIR_DROP,
+    REPAIR_PRESERVE,
+    REPAIR_SWEEP,
+    SCHEMA_WRAPPER_RE,
+    AuditContext,
+    DurableKeyRegistry,
+    DurableKeySpec,
+)
+
+logger = logging.getLogger(__name__)
+
+#: Finding classifications (the five-way tentpole taxonomy; ``valid``
+#: never leaves the auditor).
+GARBAGE = "garbage"
+ORPHANED = "orphaned"
+CONFLICTING = "conflicting"
+VERSION_SKEWED = "version-skewed"
+CLASSIFICATIONS = (GARBAGE, ORPHANED, CONFLICTING, VERSION_SKEWED)
+
+TARGET_NODE = "node"
+TARGET_DAEMON_SET = "daemonset"
+
+
+@dataclass(frozen=True)
+class Finding:
+    """One corrupted stamp: what, where, why, and the repair to apply."""
+
+    target_kind: str
+    target: str
+    key: str
+    value: str
+    classification: str
+    #: Repair action the janitor should take (a registry REPAIR_*).
+    repair: str
+    reason: str
+    owner: str = ""
+    #: True when the key is a LABEL (repairs go through the label patch
+    #: path); False for annotations.
+    is_label: bool = False
+    #: The spec that matched, for normalize/convert repairs (None for
+    #: unregistered conflicting keys).
+    spec: Optional[DurableKeySpec] = field(default=None, compare=False)
+
+
+class StateAuditor:
+    """Scan nodes + DaemonSets, classify owned stamps, record audits."""
+
+    def __init__(self, registry: DurableKeyRegistry,
+                 clock: "Optional[object]" = None,
+                 audit: "Optional[object]" = None) -> None:
+        self._registry = registry
+        self._clock = clock
+        self._audit = audit
+        #: (kind, name) -> digest of the last ZERO-finding scan.
+        self._clean_digests: "dict[Tuple[str, str], int]" = {}
+        self.scans_total = 0
+        self.targets_scanned_total = 0
+        self.targets_skipped_total = 0
+        self.findings_total: "dict[str, int]" = {
+            c: 0 for c in CLASSIFICATIONS}
+
+    # -- public ----------------------------------------------------------
+    def scan(self, nodes: Iterable, daemon_sets: Iterable = ()) \
+            -> "List[Finding]":
+        """One audit pass over the fleet; returns every finding."""
+        nodes = list(nodes)
+        daemon_sets = list(daemon_sets)
+        self.scans_total += 1
+
+        try:
+            shard_key = self._registry.key_for_role("upgrade",
+                                                    "-upgrade.shard")
+        except KeyError:  # pragma: no cover - registry always has it
+            shard_key = ""
+        try:
+            state_key = self._registry.key_for_role("upgrade",
+                                                    "-upgrade-state")
+        except KeyError:  # pragma: no cover
+            state_key = ""
+
+        node_names = frozenset(n.metadata.name for n in nodes)
+        shard_ids = frozenset(
+            n.metadata.labels[shard_key] for n in nodes
+            if shard_key and shard_key in n.metadata.labels)
+        pools = frozenset(
+            n.metadata.labels[GKE_NODEPOOL_LABEL] for n in nodes
+            if GKE_NODEPOOL_LABEL in n.metadata.labels)
+
+        findings: "List[Finding]" = []
+        for node in nodes:
+            meta = node.metadata
+            digest_key = (TARGET_NODE, meta.name)
+            digest = self._digest(meta)
+            if self._clean_digests.get(digest_key) == digest:
+                self.targets_skipped_total += 1
+                continue
+            self.targets_scanned_total += 1
+            ctx = AuditContext(
+                target=meta.name, target_kind=TARGET_NODE,
+                labels=meta.labels, annotations=meta.annotations,
+                node_names=node_names, shard_ids=shard_ids, pools=pools,
+                upgrade_state=meta.labels.get(state_key, ""))
+            target_findings = self._scan_meta(
+                TARGET_NODE, meta.name, meta.labels, meta.annotations, ctx)
+            if target_findings:
+                findings.extend(target_findings)
+            else:
+                self._clean_digests[digest_key] = digest
+
+        for ds in daemon_sets:
+            meta = ds.metadata
+            name = f"{meta.namespace}/{meta.name}"
+            digest_key = (TARGET_DAEMON_SET, name)
+            digest = self._digest(meta)
+            if self._clean_digests.get(digest_key) == digest:
+                self.targets_skipped_total += 1
+                continue
+            self.targets_scanned_total += 1
+            ctx = AuditContext(
+                target=name, target_kind=TARGET_DAEMON_SET,
+                labels=meta.labels, annotations=meta.annotations,
+                node_names=node_names, shard_ids=shard_ids, pools=pools)
+            target_findings = self._scan_meta(
+                TARGET_DAEMON_SET, name, meta.labels, meta.annotations,
+                ctx)
+            if target_findings:
+                findings.extend(target_findings)
+            else:
+                self._clean_digests[digest_key] = digest
+
+        for f in findings:
+            self.findings_total[f.classification] = (
+                self.findings_total.get(f.classification, 0) + 1)
+            self._record(f)
+        return findings
+
+    # -- internals -------------------------------------------------------
+    @staticmethod
+    def _digest(meta) -> int:
+        return hash((tuple(sorted(meta.labels.items())),
+                     tuple(sorted(meta.annotations.items()))))
+
+    def _scan_meta(self, target_kind: str, target: str, labels, annotations,
+                   ctx: AuditContext) -> "List[Finding]":
+        out: "List[Finding]" = []
+        for key in sorted(labels):
+            if not self._registry.owns(key):
+                continue
+            f = self._classify(target_kind, target, key, labels[key],
+                               is_label=True, ctx=ctx)
+            if f is not None:
+                out.append(f)
+        for key in sorted(annotations):
+            if not self._registry.owns(key):
+                continue
+            f = self._classify(target_kind, target, key, annotations[key],
+                               is_label=False, ctx=ctx)
+            if f is not None:
+                out.append(f)
+        return out
+
+    def _classify(self, target_kind: str, target: str, key: str,
+                  value: str, is_label: bool,
+                  ctx: AuditContext) -> Optional[Finding]:
+        spec = self._registry.lookup(key)
+        if spec is None:
+            return Finding(
+                target_kind, target, key, value, CONFLICTING, REPAIR_DROP,
+                "key sits under an owned prefix but is registered to no "
+                "subsystem (cross-subsystem collision or squatting "
+                "writer)", owner="", is_label=is_label)
+
+        actual_kind = self._actual_kind(target_kind, is_label)
+        if actual_kind != spec.kind:
+            return Finding(
+                target_kind, target, key, value, CONFLICTING, REPAIR_DROP,
+                f"registered as {spec.kind} but found as {actual_kind} "
+                f"(a stamp on the wrong object never drives decisions "
+                f"there)", owner=spec.owner, is_label=is_label, spec=spec)
+
+        if spec.repair == REPAIR_PRESERVE:
+            return None
+
+        if SCHEMA_WRAPPER_RE.match(value):
+            return Finding(
+                target_kind, target, key, value, VERSION_SKEWED,
+                REPAIR_CONVERT,
+                "schema-version wrapper on a bare-payload (v1) key — a "
+                "mixed-version operator fleet wrote a different schema",
+                owner=spec.owner, is_label=is_label, spec=spec)
+
+        try:
+            ok = spec.validate(value)
+        except Exception:  # defensive: validators must not raise
+            logger.exception("validator for %s raised; treating %r as "
+                             "garbage", key, value)
+            ok = False
+        if not ok:
+            return Finding(
+                target_kind, target, key, value, GARBAGE, spec.repair,
+                f"value fails the {spec.owner} codec ({spec.codec})",
+                owner=spec.owner, is_label=is_label, spec=spec)
+
+        if spec.orphaned is not None:
+            suffix = key[len(spec.key):] if spec.prefix else ""
+            ctx.key_suffix = suffix
+            try:
+                reason = spec.orphaned(value, ctx)
+            except Exception:  # defensive
+                logger.exception("orphan predicate for %s raised", key)
+                reason = None
+            finally:
+                ctx.key_suffix = ""
+            if reason:
+                return Finding(
+                    target_kind, target, key, value, ORPHANED,
+                    REPAIR_SWEEP, reason, owner=spec.owner,
+                    is_label=is_label, spec=spec)
+        return None
+
+    @staticmethod
+    def _actual_kind(target_kind: str, is_label: bool) -> str:
+        if target_kind == TARGET_DAEMON_SET:
+            # the operator only owns DS *annotations*; an owned key as a
+            # DS label is a location mismatch by construction
+            return KIND_DS_ANNOTATION if not is_label else "ds-label"
+        return KIND_NODE_LABEL if is_label else KIND_NODE_ANNOTATION
+
+    def _record(self, f: Finding) -> None:
+        if self._audit is None:
+            return
+        self._audit.record(
+            "fsck", f.target, decision=f.classification,
+            rule=f"fsck/{f.classification}",
+            inputs={"key": f.key, "value": f.value[:128],
+                    "owner": f.owner or "unregistered",
+                    "repair": f.repair, "reason": f.reason})

@@ -1,0 +1,292 @@
+"""Janitor: repair auditor findings through audited, crash-ordered
+writes.
+
+The janitor is the fsck *write* half. It takes the
+:class:`~tpu_operator_libs.fsck.auditor.Finding` list one audit pass
+produced and applies each spec's repair action:
+
+* **drop** / **sweep** — delete the key (garbage whose truth is
+  re-derivable; orphans whose owning arc is provably dead).
+* **normalize** — re-encode the decodable subset of a map-shaped value
+  through its own codec; delete when nothing survives.
+* **convert** — unwrap a ``v<K>;`` schema wrapper whose inner payload
+  validates back to the current bare form; drop when it does not (a
+  wrapper is never trusted further than its payload).
+* **quarantine** — the state itself is ambiguous (garbled state label,
+  unreadable cordon intent): park the node under BOTH machines' skip
+  labels plus the fsck quarantine stamp, and never guess. A human
+  clears all three after review.
+
+Crash ordering. All annotation repairs for one node coalesce into ONE
+merge patch; label repairs into one label patch; quarantine into one
+meta patch (skip labels + stamp, atomic — a crash can not leave a
+parked node unexplained or an explained node unparked). Every write
+funnels through the injected ``guard`` — the chaos crash fuse in soak
+runs — and every repair is idempotent: if the fuse detonates mid-
+repair the write is lost, the next incarnation's auditor re-finds the
+same corruption (the clean-digest cache only records zero-finding
+targets) and re-repairs it.
+
+Every applied repair is recorded twice: a DecisionAudit ``fsck-repair``
+record, and a :class:`RepairRecord` appended to the injectable
+``repair_log`` — a plain list the chaos harness threads through
+operator incarnations so ``explain()`` chains survive crashes.
+"""
+
+from __future__ import annotations
+
+import logging
+from dataclasses import dataclass
+from typing import Callable, Dict, Iterable, List, Optional, Tuple
+
+from tpu_operator_libs.consts import TRUE_STRING
+from tpu_operator_libs.fsck.auditor import (
+    TARGET_NODE,
+    Finding,
+)
+from tpu_operator_libs.fsck.registry import (
+    REPAIR_CONVERT,
+    REPAIR_DROP,
+    REPAIR_NORMALIZE,
+    REPAIR_QUARANTINE,
+    REPAIR_SWEEP,
+    SCHEMA_WRAPPER_RE,
+    DurableKeyRegistry,
+    fsck_quarantine_annotation,
+)
+
+logger = logging.getLogger(__name__)
+
+
+@dataclass(frozen=True)
+class RepairRecord:
+    """One applied repair and its full why-chain (explain() payload)."""
+
+    at: float
+    target_kind: str
+    target: str
+    key: str
+    action: str
+    #: The blocking explanation chain: finding reason → classification →
+    #: repair + its crash-ordering note. Stored in the record (not the
+    #: janitor) so chains survive operator-incarnation death.
+    chain: Tuple[str, ...]
+
+
+class Janitor:
+    """Apply repairs for one audit pass, coalesced per target."""
+
+    def __init__(self, client: "object", registry: DurableKeyRegistry,
+                 upgrade_keys: "object",
+                 remediation_keys: "Optional[object]" = None,
+                 guard: Optional[Callable] = None,
+                 audit: "Optional[object]" = None,
+                 clock: "Optional[object]" = None,
+                 repair_log: Optional["List[RepairRecord]"] = None) -> None:
+        self._client = client
+        self._registry = registry
+        self._upgrade_keys = upgrade_keys
+        self._remediation_keys = remediation_keys
+        self._guard = guard if guard is not None else (lambda write: write())
+        self._audit = audit
+        self._clock = clock
+        #: Injectable so the chaos harness can share one log across
+        #: operator incarnations (records must outlive crashes).
+        self.repair_log: "List[RepairRecord]" = (
+            repair_log if repair_log is not None else [])
+        self.repairs_total: "dict[str, int]" = {}
+        self.quarantined_nodes: "set[str]" = set()
+
+    # -- public ----------------------------------------------------------
+    def repair(self, findings: Iterable[Finding]) -> int:
+        """Apply every finding's repair; returns the repair count.
+
+        Raises whatever the guarded writes raise (OperatorCrash under
+        the chaos fuse, ApiServerError under transient faults) — the
+        caller's incarnation/transient handling applies, and the
+        auditor re-finds whatever was not committed."""
+        applied = 0
+        by_target: "Dict[Tuple[str, str], List[Finding]]" = {}
+        for f in findings:
+            by_target.setdefault((f.target_kind, f.target), []).append(f)
+
+        for (kind, target), group in sorted(by_target.items()):
+            quarantine = [f for f in group
+                          if f.repair == REPAIR_QUARANTINE]
+            rest = [f for f in group if f.repair != REPAIR_QUARANTINE]
+            if kind == TARGET_NODE:
+                applied += self._repair_node(target, rest, quarantine)
+            else:
+                applied += self._repair_daemon_set(target, rest, quarantine)
+        return applied
+
+    def explain(self, target: str, key: str) -> "dict":
+        """The why-chain of the most recent repair touching (target,
+        key): ``{"blocking": (...why lines...), "action": ..., "at":
+        ...}``; empty chain when no repair has touched it."""
+        for record in reversed(self.repair_log):
+            if record.target == target and record.key == key:
+                return {"blocking": list(record.chain),
+                        "action": record.action, "at": record.at}
+        return {"blocking": [], "action": "", "at": 0.0}
+
+    # -- repair planning -------------------------------------------------
+    def _planned_value(self, f: Finding) -> Optional[str]:
+        """The post-repair value for one finding: None deletes."""
+        if f.repair in (REPAIR_DROP, REPAIR_SWEEP):
+            return None
+        if f.repair == REPAIR_NORMALIZE:
+            if f.spec is None or f.spec.normalize is None:
+                return None
+            try:
+                survivor = f.spec.normalize(f.value)
+            except Exception:  # defensive: normalizers must not raise
+                logger.exception("normalize for %s raised; dropping",
+                                 f.key)
+                survivor = ""
+            return survivor or None
+        if f.repair == REPAIR_CONVERT:
+            inner = SCHEMA_WRAPPER_RE.sub("", f.value, count=1)
+            if f.spec is not None:
+                try:
+                    if f.spec.validate(inner):
+                        return inner
+                    if f.spec.normalize is not None:
+                        survivor = f.spec.normalize(inner)
+                        if survivor:
+                            return survivor
+                except Exception:  # defensive
+                    logger.exception("convert for %s raised; dropping",
+                                     f.key)
+            return None
+        logger.warning("unknown repair %r for %s; dropping", f.repair,
+                       f.key)
+        return None
+
+    def _chain(self, f: Finding, action: str,
+               value: Optional[str]) -> "Tuple[str, ...]":
+        if value is None:
+            effect = "delete the key"
+        else:
+            effect = f"rewrite to {value!r}"
+        contract = f.spec.contract if f.spec is not None else \
+            "unregistered key: no contract — removal is the contract"
+        return (
+            f"finding: {f.reason}",
+            f"classified {f.classification} "
+            f"(owner {f.owner or 'unregistered'})",
+            f"repair {action}: {effect} [{contract}]",
+        )
+
+    # -- node repairs ----------------------------------------------------
+    def _repair_node(self, name: str, rest: "List[Finding]",
+                     quarantine: "List[Finding]") -> int:
+        applied = 0
+        ann_patch: "Dict[str, Optional[str]]" = {}
+        ann_records: "List[Tuple[Finding, Optional[str]]]" = []
+        label_patch: "Dict[str, Optional[str]]" = {}
+        label_records: "List[Tuple[Finding, Optional[str]]]" = []
+        for f in rest:
+            value = self._planned_value(f)
+            if f.is_label:
+                label_patch[f.key] = value
+                label_records.append((f, value))
+            else:
+                ann_patch[f.key] = value
+                ann_records.append((f, value))
+
+        # one merge patch per attribute family per node (crash-atomic:
+        # either every annotation repair for the node lands or none).
+        # The intent records go FIRST (write-ahead): if the fuse
+        # detonates after the patch commits, the repair is still
+        # audited; if it detonates before, the auditor re-finds the
+        # corruption and a fresh intent+write follows.
+        if ann_patch:
+            applied += self._commit(ann_records)
+            self._guard(lambda: self._client.patch_node_annotations(
+                name, dict(ann_patch)))
+        if label_patch:
+            applied += self._commit(label_records)
+            self._guard(lambda: self._client.patch_node_labels(
+                name, dict(label_patch)))
+
+        if quarantine:
+            applied += self._quarantine_node(name, quarantine)
+        return applied
+
+    def _quarantine_node(self, name: str,
+                         findings: "List[Finding]") -> int:
+        """Park, never guess: both machines' skip labels + the fsck
+        stamp in ONE meta patch."""
+        reason = findings[0].classification
+        stamp_key = fsck_quarantine_annotation(
+            self._upgrade_keys.driver, self._upgrade_keys.domain)
+        stamp = f"{reason}:{self._now():g}"
+        labels: "Dict[str, Optional[str]]" = {
+            self._upgrade_keys.skip_label: TRUE_STRING}
+        if self._remediation_keys is not None:
+            labels[self._remediation_keys.skip_label] = TRUE_STRING
+        records = [(f, stamp) for f in findings]
+        self.quarantined_nodes.add(name)
+        applied = self._commit(records, action=REPAIR_QUARANTINE)
+        self._guard(lambda: self._client.patch_node_meta(
+            name, labels=labels, annotations={stamp_key: stamp}))
+        return applied
+
+    # -- DaemonSet repairs -----------------------------------------------
+    def _repair_daemon_set(self, target: str, rest: "List[Finding]",
+                           quarantine: "List[Finding]") -> int:
+        # quarantine is a node concept; an ambiguous DS stamp of a
+        # PRESERVE-adjacent kind would be registry-misconfigured — drop
+        # nothing, log loudly, leave it for humans
+        for f in quarantine:  # pragma: no cover - no DS key quarantines
+            logger.warning("DS stamp %s on %s classified for quarantine; "
+                           "leaving untouched", f.key, target)
+        if not rest:
+            return 0
+        namespace, _, name = target.partition("/")
+        patch: "Dict[str, Optional[str]]" = {}
+        records: "List[Tuple[Finding, Optional[str]]]" = []
+        for f in rest:
+            value = self._planned_value(f)
+            patch[f.key] = value
+            records.append((f, value))
+        applied = self._commit(records)
+        self._guard(lambda: self._client.patch_daemon_set_annotations(
+            namespace, name, dict(patch)))
+        return applied
+
+    # -- bookkeeping -----------------------------------------------------
+    def _commit(self, records: "List[Tuple[Finding, Optional[str]]]",
+                action: str = "") -> int:
+        """Write-ahead intent: record + audit each repair BEFORE its
+        guarded patch, so a crash-after-write repair is never
+        unaudited (a crash-before-write intent is re-found and
+        re-intended — duplicates are fine, silence is not)."""
+        now = self._now()
+        for f, value in records:
+            act = action or f.repair
+            chain = self._chain(f, act, value if act != REPAIR_QUARANTINE
+                                else None)
+            if act == REPAIR_QUARANTINE:
+                chain = chain + (
+                    "parked: skip labels for both machines + fsck stamp "
+                    "in one atomic meta patch; a human clears all three",)
+            self.repair_log.append(RepairRecord(
+                at=now, target_kind=f.target_kind, target=f.target,
+                key=f.key, action=act, chain=chain))
+            self.repairs_total[act] = self.repairs_total.get(act, 0) + 1
+            if self._audit is not None:
+                self._audit.record(
+                    "fsck-repair", f.target, decision=act,
+                    rule=f"fsck/repair-{act}",
+                    inputs={"key": f.key, "classification":
+                            f.classification,
+                            "new_value": "" if value is None else value,
+                            "reason": f.reason})
+        return len(records)
+
+    def _now(self) -> float:
+        if self._clock is not None:
+            return self._clock.now()
+        return 0.0

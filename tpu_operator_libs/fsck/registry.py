@@ -1,0 +1,630 @@
+"""DurableKeyRegistry: the one catalog of every durable key the
+operator owns.
+
+Eighteen PRs of crash-ordered durable stamps left the operator's only
+store — node/DaemonSet labels and annotations — described piecemeal:
+the key *names* live in :mod:`tpu_operator_libs.consts` (four
+instance-scoped ``*Keys`` families), the value *grammars* in the
+subsystems' codecs (``upgrade.predictor.decode_durations``,
+``health.precursor.decode_rates``, ``topology.slice_topology.
+decode_degraded_slices``, ``federation.ledger``), and the
+crash-ordering contracts in docstrings. Nothing knew the whole
+surface, so nothing could *defend* it: every crash-safety proof
+assumes the operator itself wrote the state, while production
+annotations are also touched by kubectl-editing humans, mutating
+webhooks, and stale operator versions mid-self-upgrade.
+
+This module is the missing catalog. A :class:`DurableKeySpec` binds
+one key (or key prefix) to its owner subsystem, object kind, value
+validator, schema version, default repair action, and crash-ordering
+contract; :func:`default_registry` enumerates every key of
+``UpgradeKeys`` / ``RemediationKeys`` / ``TopologyKeys`` /
+``FederationKeys`` (plus fsck's own quarantine stamp). The
+:class:`~tpu_operator_libs.fsck.auditor.StateAuditor` classifies live
+stamps against it, and the :class:`~tpu_operator_libs.fsck.janitor.
+Janitor` repairs what fails.
+
+Schema versioning convention: a bare payload IS schema version 1.  A
+mixed-version operator fleet (the operator's own rolling upgrade)
+marks other schemata by wrapping the payload as ``v<K>;<payload>``;
+the janitor's ``convert`` repair unwraps a recognized wrapper whose
+inner payload validates and rewrites the current (bare) form, so the
+fleet converges on one schema instead of fighting.
+"""
+
+from __future__ import annotations
+
+import re
+from dataclasses import dataclass, field
+from typing import TYPE_CHECKING, Callable, Mapping, Optional
+
+from tpu_operator_libs.consts import (
+    TRUE_STRING,
+    FederationKeys,
+    RemediationKeys,
+    TopologyKeys,
+    UpgradeKeys,
+    UpgradeState,
+)
+
+if TYPE_CHECKING:  # pragma: no cover - types only
+    pass
+
+# -- repair actions --------------------------------------------------------
+#: Delete the key: the value is garbage and the truth is re-derivable
+#: (or conservatively "absent" — timers restart, samples are lost but
+#: never invented).
+REPAIR_DROP = "drop"
+#: Re-encode the decodable subset of a map-shaped value through its own
+#: codec; delete the key when nothing survives. The repair for
+#: hand-edited or torn composite stamps (``drain=12,garbage``).
+REPAIR_NORMALIZE = "normalize"
+#: Delete an orphaned stamp whose owning arc is provably dead (the
+#: incumbent node no longer exists, the shard is carried by no node,
+#: the state machine left the states that own the stamp).
+REPAIR_SWEEP = "sweep"
+#: Park the node — skip labels for both machines plus the fsck
+#: quarantine stamp — and never guess: an ambiguous state label is a
+#: human's call, not the janitor's.
+REPAIR_QUARANTINE = "quarantine"
+#: Unwrap a ``v<K>;`` schema wrapper back to the current bare form
+#: (drop when the inner payload does not validate).
+REPAIR_CONVERT = "convert"
+#: Never repaired: operator *input* keys (skip labels, re-arm and
+#: on-demand-upgrade requests, the safe-load handshake) are written by
+#: humans/the runtime and any value must be honored, and fail-safe
+#: records (the quarantined-revision halt) must never be auto-removed.
+REPAIR_PRESERVE = "preserve"
+
+#: Target-kind tags (where a key legally lives).
+KIND_NODE_LABEL = "node-label"
+KIND_NODE_ANNOTATION = "node-annotation"
+KIND_DS_ANNOTATION = "ds-annotation"
+
+#: ``v<K>;`` schema-wrapper pattern (bare payload = schema v1).
+SCHEMA_WRAPPER_RE = re.compile(r"^v(\d+);")
+
+
+@dataclass
+class AuditContext:
+    """The cluster facts orphan predicates may consult — everything is
+    captured once per scan (cheap sets), never read per-key."""
+
+    target: str
+    target_kind: str
+    labels: Mapping[str, str]
+    annotations: Mapping[str, str]
+    #: Live node names (a stamp naming a vanished incumbent is orphaned).
+    node_names: frozenset = frozenset()
+    #: Shard ids some live node currently carries (a per-shard canary
+    #: attestation for a retired shard is orphaned).
+    shard_ids: frozenset = frozenset()
+    #: Live nodepool (slice) names.
+    pools: frozenset = frozenset()
+    #: The target node's upgrade-state label value ("" off-flow).
+    upgrade_state: str = ""
+    #: For prefix families: the suffix after the registered prefix of
+    #: the key under audit (e.g. the shard id of a per-shard canary
+    #: attestation). Set per-key by the auditor; "" for exact keys.
+    key_suffix: str = ""
+
+
+@dataclass(frozen=True)
+class DurableKeySpec:
+    """One owned key (or key-prefix) family and how to defend it."""
+
+    key: str
+    owner: str
+    kind: str
+    #: Human-readable value grammar (the docs/durable-state.md column).
+    codec: str
+    #: Default repair for a value that fails ``validate``.
+    repair: str
+    #: Crash-ordering contract, one line (the docs table column).
+    contract: str
+    #: True when ``key`` is a prefix (``<key><suffix>`` families like
+    #: the artifact stamps and per-shard canary attestations).
+    prefix: bool = False
+    schema_version: int = 1
+    #: Value validator; never raises. PRESERVE keys keep the default.
+    validate: Callable[[str], bool] = field(default=lambda value: True)
+    #: For REPAIR_NORMALIZE: re-encode the decodable subset ("" deletes).
+    normalize: Optional[Callable[[str], str]] = None
+    #: Orphan predicate: a reason string when the owning arc is provably
+    #: dead (sweep), None while it may be alive. Only consulted for
+    #: values that validated — garbage is already classified.
+    orphaned: Optional[Callable[[str, AuditContext], Optional[str]]] = None
+
+    def matches(self, key: str) -> bool:
+        if self.prefix:
+            return key.startswith(self.key) and len(key) > len(self.key)
+        return key == self.key
+
+
+class DurableKeyRegistry:
+    """Exact + longest-prefix lookup over the owned-key catalog."""
+
+    def __init__(self, specs: "list[DurableKeySpec]",
+                 owned_prefixes: "tuple[str, ...]") -> None:
+        self._exact = {s.key: s for s in specs if not s.prefix}
+        # longest prefix wins, so overlapping families stay unambiguous
+        self._prefixed = sorted((s for s in specs if s.prefix),
+                                key=lambda s: -len(s.key))
+        self._specs = tuple(specs)
+        #: Key prefixes this operator instance OWNS: any key under one
+        #: of these that resolves to no spec is a conflicting stamp
+        #: (cross-subsystem collision, typo'd writer, squatting webhook).
+        self.owned_prefixes = owned_prefixes
+
+    @property
+    def specs(self) -> "tuple[DurableKeySpec, ...]":
+        return self._specs
+
+    def owns(self, key: str) -> bool:
+        return any(key.startswith(p) for p in self.owned_prefixes)
+
+    def lookup(self, key: str) -> Optional[DurableKeySpec]:
+        spec = self._exact.get(key)
+        if spec is not None:
+            return spec
+        for candidate in self._prefixed:
+            if candidate.matches(key):
+                return candidate
+        return None
+
+    def key_for_role(self, owner: str, suffix: str) -> str:
+        """The registered key whose full name ends with ``suffix`` for
+        ``owner`` (auditor bootstrap: find the state/shard label keys
+        without re-plumbing the consts instances)."""
+        for spec in self._specs:
+            if spec.owner == owner and spec.key.endswith(suffix):
+                return spec.key
+        raise KeyError(f"{owner}:{suffix} not registered")
+
+
+# -- validators ------------------------------------------------------------
+def _is_epoch(value: str) -> bool:
+    try:
+        return float(value) >= 0.0
+    except ValueError:
+        return False
+
+
+def _is_int(value: str) -> bool:
+    try:
+        int(value)
+        return True
+    except ValueError:
+        return False
+
+
+def _is_nonneg_int(value: str) -> bool:
+    return _is_int(value) and int(value) >= 0
+
+
+def _is_true(value: str) -> bool:
+    return value == TRUE_STRING
+
+
+def _is_token(value: str) -> bool:
+    """An opaque single token: non-empty, no whitespace, no the
+    list/pair separators the composite codecs claim."""
+    return bool(value) and not re.search(r"[\s,;]", value)
+
+
+def _is_hash_epoch(value: str) -> bool:
+    """``<hash>:<epoch-seconds>`` (canary/bake attestations)."""
+    head, sep, raw = value.rpartition(":")
+    return bool(sep) and _is_token(head) and _is_epoch(raw)
+
+
+def _is_name_epoch(value: str) -> bool:
+    """``<name>:<epoch-seconds>`` (prewarm-ready join stamps)."""
+    return _is_hash_epoch(value)
+
+
+def _is_phase_stamp(value: str) -> bool:
+    from tpu_operator_libs.upgrade.predictor import _parse_stamp
+
+    phase, _ = _parse_stamp(value)
+    return phase is not None
+
+
+def _durations_canonical(value: str) -> bool:
+    from tpu_operator_libs.upgrade.predictor import (
+        decode_durations,
+        encode_durations,
+    )
+
+    return bool(value) and encode_durations(decode_durations(value)) == value
+
+
+def _normalize_durations(value: str) -> str:
+    from tpu_operator_libs.upgrade.predictor import (
+        decode_durations,
+        encode_durations,
+    )
+
+    return encode_durations(decode_durations(value))
+
+
+def _rates_canonical(value: str) -> bool:
+    from tpu_operator_libs.health.precursor import (
+        decode_rates,
+        encode_rates,
+    )
+
+    return bool(value) and encode_rates(decode_rates(value)) == value
+
+
+def _normalize_rates(value: str) -> str:
+    from tpu_operator_libs.health.precursor import (
+        decode_rates,
+        encode_rates,
+    )
+
+    return encode_rates(decode_rates(value))
+
+
+def _degraded_canonical(value: str) -> bool:
+    from tpu_operator_libs.topology.slice_topology import (
+        decode_degraded_slices,
+        encode_degraded_slices,
+    )
+
+    return bool(value) and encode_degraded_slices(
+        decode_degraded_slices(value)) == value
+
+
+def _normalize_degraded(value: str) -> str:
+    from tpu_operator_libs.topology.slice_topology import (
+        decode_degraded_slices,
+        encode_degraded_slices,
+    )
+
+    return encode_degraded_slices(decode_degraded_slices(value))
+
+
+def _is_reservation(value: str) -> bool:
+    """``<incumbent>:<model>:<class>`` (prewarm reserve stamps)."""
+    parts = value.split(":")
+    return len(parts) == 3 and all(_is_token(p) for p in parts)
+
+
+def _is_slice_reservation(value: str) -> bool:
+    """``<slice-id>/<missing-host>:<epoch>`` (spare reserved-for)."""
+    head, sep, raw = value.rpartition(":")
+    if not sep or not _is_epoch(raw):
+        return False
+    slice_id, slash, host = head.partition("/")
+    return bool(slash) and _is_token(slice_id) and _is_token(host)
+
+
+def _is_remap_stamp(value: str) -> bool:
+    """``<epoch>:<missing-host>`` (remapped-at join stamps)."""
+    raw, sep, host = value.partition(":")
+    return bool(sep) and _is_epoch(raw) and _is_token(host)
+
+
+def _member_of(enum_values: "frozenset[str]") -> Callable[[str], bool]:
+    return lambda value: value in enum_values
+
+
+def default_registry(driver: str = "libtpu",
+                     domain: str = "google.com") -> DurableKeyRegistry:
+    """The full owned-key catalog for one driver/domain instance."""
+    up = UpgradeKeys(driver=driver, domain=domain)
+    rem = RemediationKeys(driver=driver, domain=domain)
+    topo = TopologyKeys(driver=driver, domain=domain)
+    fed = FederationKeys(driver=driver, domain=domain)
+
+    from tpu_operator_libs.consts import RemediationState
+
+    upgrade_states = frozenset(str(s) for s in UpgradeState)
+    remediation_states = frozenset(str(s) for s in RemediationState)
+    #: The upgrade machine's REST states. Arc-scoped stamps are only
+    #: declared orphaned when the machine is at rest — deliberately
+    #: maximally conservative: any in-flow state (including FAILED,
+    #: which keeps its evidence for humans, and ROLLBACK, which
+    #: re-enters the flow) counts as a live arc, so the janitor can
+    #: never fight the operator over a stamp mid-journey.
+    rest_states = frozenset(("", str(UpgradeState.DONE)))
+
+    def _dead_arc(what: str):
+        def orphaned(value: str, ctx: AuditContext) -> Optional[str]:
+            if ctx.upgrade_state not in rest_states:
+                return None
+            return (f"{what} stamp survives with the upgrade machine at "
+                    f"rest (state {ctx.upgrade_state or 'unset'!r}) — "
+                    f"the owning arc is over")
+        return orphaned
+
+    def _dead_incumbent(value: str, ctx: AuditContext) -> Optional[str]:
+        incumbent = value.split(":", 1)[0]
+        if incumbent in ctx.node_names:
+            return None
+        return (f"prewarm stamp names incumbent {incumbent!r}, which no "
+                f"longer exists (recycled spare residue)")
+
+    def _torn_ready(value: str, ctx: AuditContext) -> Optional[str]:
+        dead = _dead_incumbent(value, ctx)
+        if dead is not None:
+            return dead
+        if up.prewarm_reservation_annotation not in ctx.annotations:
+            return ("prewarm-ready join stamp without its reserve stamp "
+                    "— a torn half-of-a-pair write (ready implies "
+                    "reservation; never invent the missing half)")
+        return None
+
+    def _dead_shard(value: str, ctx: AuditContext) -> Optional[str]:
+        shard = ctx.key_suffix
+        if shard and shard not in ctx.shard_ids:
+            return (f"canary attestation for shard {shard!r}, which no "
+                    f"live node carries (retired shard residue)")
+        return None
+
+    def _dead_pool(value: str, ctx: AuditContext) -> Optional[str]:
+        slice_id = value.partition("/")[0]
+        if slice_id in ctx.pools:
+            return None
+        return (f"spare reservation names slice {slice_id!r}, which no "
+                f"longer exists")
+
+    specs: "list[DurableKeySpec]" = [
+        # ---- upgrade machine -------------------------------------------
+        DurableKeySpec(
+            up.state_label, "upgrade", KIND_NODE_LABEL,
+            "UpgradeState enum value", REPAIR_QUARANTINE,
+            "THE durable commit point; every transition is one label "
+            "patch with its bookkeeping riding the same patch",
+            validate=_member_of(upgrade_states)),
+        DurableKeySpec(
+            up.skip_label, "upgrade", KIND_NODE_LABEL,
+            "operator input (presence opts the node out)",
+            REPAIR_PRESERVE, "human-owned input; never repaired"),
+        DurableKeySpec(
+            up.shard_label, "upgrade", KIND_NODE_LABEL,
+            "int shard id (ring-derived)", REPAIR_DROP,
+            "idempotent re-stamp: concurrent stampers always write "
+            "identical ring-derived values",
+            validate=_is_nonneg_int),
+        DurableKeySpec(
+            up.wait_for_safe_load_annotation, "upgrade",
+            KIND_NODE_ANNOTATION, "runtime init-container input",
+            REPAIR_PRESERVE, "runtime-owned handshake; never repaired"),
+        DurableKeySpec(
+            up.initial_state_annotation, "upgrade", KIND_NODE_ANNOTATION,
+            '"true" (node was already unschedulable)', REPAIR_QUARANTINE,
+            "rides the cordon-committing patch; read at uncordon — a "
+            "garbled value makes cordon intent ambiguous (never guess)",
+            validate=_is_true,
+            orphaned=_dead_arc("initial-state")),
+        DurableKeySpec(
+            up.pod_completion_start_annotation, "upgrade",
+            KIND_NODE_ANNOTATION, "epoch seconds", REPAIR_DROP,
+            "checkpoint stamp: absent means the wait-for-jobs timer "
+            "restarts (conservative)",
+            validate=_is_epoch,
+            orphaned=_dead_arc("pod-completion-start")),
+        DurableKeySpec(
+            up.validation_start_annotation, "upgrade",
+            KIND_NODE_ANNOTATION, "epoch seconds", REPAIR_DROP,
+            "checkpoint stamp: absent means the validation timer "
+            "restarts (conservative)",
+            validate=_is_epoch,
+            orphaned=_dead_arc("validation-start")),
+        DurableKeySpec(
+            up.upgrade_requested_annotation, "upgrade",
+            KIND_NODE_ANNOTATION, "operator input (on-demand upgrade)",
+            REPAIR_PRESERVE, "human-owned input; never repaired"),
+        DurableKeySpec(
+            up.quarantined_revision_annotation, "upgrade",
+            KIND_DS_ANNOTATION, "condemned revision hash",
+            REPAIR_PRESERVE,
+            "fail-safe halt record: auto-removing it would un-quarantine "
+            "a bad build — never repaired",
+            validate=_is_token),
+        DurableKeySpec(
+            up.canary_passed_annotation, "upgrade", KIND_DS_ANNOTATION,
+            "<revision-hash>:<epoch>", REPAIR_DROP,
+            "absent means the canary re-bakes (conservative: waves wait)",
+            validate=_is_hash_epoch),
+        DurableKeySpec(
+            up.canary_shard_passed_prefix, "upgrade", KIND_DS_ANNOTATION,
+            "<prefix><shard-id> = <revision-hash>", REPAIR_DROP,
+            "per-shard attestation; absent means the shard re-attests",
+            prefix=True, validate=_is_token, orphaned=_dead_shard),
+        DurableKeySpec(
+            up.phase_start_annotation, "upgrade", KIND_NODE_ANNOTATION,
+            "<phase>:<epoch>", REPAIR_DROP,
+            "rides the transition patch; a garbled stamp reads as 'no "
+            "open phase' — the sample is lost, never invented",
+            validate=_is_phase_stamp,
+            orphaned=_dead_arc("phase-start")),
+        DurableKeySpec(
+            up.phase_durations_annotation, "upgrade",
+            KIND_NODE_ANNOTATION, "drain=<s>,restart=<s>,validate=<s>",
+            REPAIR_NORMALIZE,
+            "durable model seed (outlives the arc); malformed entries "
+            "are re-encoded out, an empty survivor deletes the key",
+            validate=_durations_canonical, normalize=_normalize_durations),
+        DurableKeySpec(
+            up.trace_id_annotation, "upgrade", KIND_NODE_ANNOTATION,
+            "opaque trace id token", REPAIR_DROP,
+            "opens/closes with the journey on the state-commit patch; "
+            "residue past upgrade-done is swept",
+            validate=_is_token,
+            orphaned=_dead_arc("trace-id")),
+        DurableKeySpec(
+            up.prewarm_reservation_annotation, "upgrade",
+            KIND_NODE_ANNOTATION, "<incumbent>:<model>:<class>",
+            REPAIR_DROP,
+            "RESERVE stamp, crash-ordered before the ready stamp; a "
+            "reservation naming a vanished incumbent is swept",
+            validate=_is_reservation, orphaned=_dead_incumbent),
+        DurableKeySpec(
+            up.prewarm_ready_annotation, "upgrade", KIND_NODE_ANNOTATION,
+            "<incumbent>:<epoch>", REPAIR_DROP,
+            "JOIN stamp: ready implies reservation — a ready stamp "
+            "without its reserve half (torn pair) is swept, never "
+            "completed by guessing",
+            validate=_is_name_epoch, orphaned=_torn_ready),
+        DurableKeySpec(
+            up.artifact_stamp_prefix, "upgrade", KIND_NODE_ANNOTATION,
+            "<prefix><artifact> = <revision-hash>", REPAIR_DROP,
+            "written in DAG dependency order, one patch each; absent "
+            "means the artifact re-verifies (conservative)",
+            prefix=True, validate=_is_token),
+        # ---- remediation machine ---------------------------------------
+        DurableKeySpec(
+            rem.state_label, "remediation", KIND_NODE_LABEL,
+            "RemediationState enum value", REPAIR_QUARANTINE,
+            "the unplanned-fault machine's commit point (same provider "
+            "discipline as the upgrade label)",
+            validate=_member_of(remediation_states)),
+        DurableKeySpec(
+            rem.skip_label, "remediation", KIND_NODE_LABEL,
+            "operator input (presence opts the node out)",
+            REPAIR_PRESERVE, "human-owned input; never repaired"),
+        DurableKeySpec(
+            rem.wedge_since_annotation, "remediation",
+            KIND_NODE_ANNOTATION, "epoch seconds", REPAIR_DROP,
+            "debounce anchor: absent means the grace window restarts",
+            validate=_is_epoch),
+        DurableKeySpec(
+            rem.wedge_reason_annotation, "remediation",
+            KIND_NODE_ANNOTATION, "reason slug", REPAIR_DROP,
+            "evidence beside the state label; re-derived on re-detect",
+            validate=_is_token),
+        DurableKeySpec(
+            rem.attempt_annotation, "remediation", KIND_NODE_ANNOTATION,
+            "int attempt count", REPAIR_DROP,
+            "escalation rung pointer; absent restarts the ladder "
+            "(conservative: more attempts before condemning)",
+            validate=_is_nonneg_int),
+        DurableKeySpec(
+            rem.action_start_annotation, "remediation",
+            KIND_NODE_ANNOTATION, "epoch seconds", REPAIR_DROP,
+            "action-timeout anchor: absent means the timer restarts",
+            validate=_is_epoch),
+        DurableKeySpec(
+            rem.restart_pod_uid_annotation, "remediation",
+            KIND_NODE_ANNOTATION, "pod UID token", REPAIR_DROP,
+            "recreation detector; absent falls back to the timeout",
+            validate=_is_token),
+        DurableKeySpec(
+            rem.settle_start_annotation, "remediation",
+            KIND_NODE_ANNOTATION, "epoch seconds", REPAIR_DROP,
+            "stability-window anchor: absent means settling restarts",
+            validate=_is_epoch),
+        DurableKeySpec(
+            rem.reboot_requested_annotation, "remediation",
+            KIND_NODE_ANNOTATION, "epoch seconds", REPAIR_DROP,
+            "host-agent handshake stamp; absent means the rung "
+            "re-requests",
+            validate=_is_epoch),
+        DurableKeySpec(
+            rem.initial_state_annotation, "remediation",
+            KIND_NODE_ANNOTATION, '"true" (was already unschedulable)',
+            REPAIR_QUARANTINE,
+            "read at uncordon — a garbled value makes cordon intent "
+            "ambiguous (never guess)",
+            validate=_is_true),
+        DurableKeySpec(
+            rem.rearm_annotation, "remediation", KIND_NODE_ANNOTATION,
+            "operator input (re-arm after manual repair)",
+            REPAIR_PRESERVE, "human-owned input; never repaired"),
+        DurableKeySpec(
+            rem.condemned_annotation, "remediation", KIND_NODE_ANNOTATION,
+            "epoch seconds", REPAIR_QUARANTINE,
+            "durable give-up record keying slice remaps and MTTR; a "
+            "garbled stamp on a parked node is a human's call",
+            validate=_is_epoch),
+        DurableKeySpec(
+            rem.at_risk_annotation, "remediation", KIND_NODE_ANNOTATION,
+            "epoch seconds", REPAIR_QUARANTINE,
+            "condemn-before-fail anchor, crash-atomic with the at-risk "
+            "commit; a garbled stamp is a human's call",
+            validate=_is_epoch),
+        DurableKeySpec(
+            rem.at_risk_reason_annotation, "remediation",
+            KIND_NODE_ANNOTATION, "precursor verdict slug", REPAIR_DROP,
+            "evidence beside the at-risk stamp; re-stamped on the next "
+            "verdict",
+            validate=_is_token),
+        DurableKeySpec(
+            rem.precursor_rates_annotation, "remediation",
+            KIND_NODE_ANNOTATION, "ecc=<r>,link-flap=<r>,...",
+            REPAIR_NORMALIZE,
+            "durable model seed on HEALTHY nodes (outside the "
+            "remediation-residue namespace); malformed entries are "
+            "re-encoded out",
+            validate=_rates_canonical, normalize=_normalize_rates),
+        # ---- topology / reconfiguration --------------------------------
+        DurableKeySpec(
+            topo.spare_pool_label, "topology", KIND_NODE_LABEL,
+            '"true" (hot-standby member)', REPAIR_DROP,
+            "a node with a garbled spare marker is NOT trusted as a "
+            "spare (never hand workloads a bogus standby)",
+            validate=_is_true),
+        DurableKeySpec(
+            topo.reserved_for_annotation, "topology",
+            KIND_NODE_ANNOTATION, "<slice>/<host>:<epoch>", REPAIR_DROP,
+            "reserve→join→release commit #1; a reservation naming a "
+            "vanished slice is swept",
+            validate=_is_slice_reservation, orphaned=_dead_pool),
+        DurableKeySpec(
+            topo.remapped_at_annotation, "topology", KIND_NODE_ANNOTATION,
+            "<epoch>:<missing-host>", REPAIR_DROP,
+            "join stamp riding the pool-label patch; sticky-down window "
+            "anchor",
+            validate=_is_remap_stamp),
+        DurableKeySpec(
+            topo.released_from_annotation, "topology",
+            KIND_NODE_ANNOTATION, "slice id token", REPAIR_DROP,
+            "audit trail on a parked node; informational",
+            validate=_is_token),
+        DurableKeySpec(
+            topo.degraded_slices_annotation, "topology",
+            KIND_DS_ANNOTATION, "slice:host[+host],...", REPAIR_NORMALIZE,
+            "written in ONE patch before the condemned node releases; "
+            "malformed fragments are re-encoded out",
+            validate=_degraded_canonical, normalize=_normalize_degraded),
+        # ---- federation ------------------------------------------------
+        DurableKeySpec(
+            fed.budget_share_annotation, "federation", KIND_DS_ANNOTATION,
+            "non-negative int node count", REPAIR_DROP,
+            "absent/garbled means the region admits NOTHING — the "
+            "conservative side of the ledger inequality",
+            validate=_is_nonneg_int),
+        DurableKeySpec(
+            fed.bake_passed_annotation, "federation", KIND_DS_ANNOTATION,
+            "<revision-hash>:<epoch>", REPAIR_DROP,
+            "absent means the canary region re-bakes (waves wait)",
+            validate=_is_hash_epoch),
+        DurableKeySpec(
+            fed.probe_annotation, "federation", KIND_DS_ANNOTATION,
+            "epoch seconds", REPAIR_DROP,
+            "freshness probe, re-stamped every pass; absent reads as "
+            "unreachable (shares may only decrease)",
+            validate=_is_epoch),
+        # ---- fsck itself -----------------------------------------------
+        DurableKeySpec(
+            fsck_quarantine_annotation(driver, domain), "fsck",
+            KIND_NODE_ANNOTATION, "<reason-slug>:<epoch>",
+            REPAIR_PRESERVE,
+            "the janitor's park-never-guess record; cleared by humans "
+            "with the machines' re-arm inputs"),
+    ]
+    return DurableKeyRegistry(specs,
+                              owned_prefixes=(f"{domain}/{driver}-",))
+
+
+def fsck_quarantine_annotation(driver: str = "libtpu",
+                               domain: str = "google.com") -> str:
+    """NODE annotation ``<reason-slug>:<epoch>`` the janitor stamps when
+    it parks a node whose durable state is ambiguous (garbled state
+    label, unreadable cordon intent). Paired with both machines' skip
+    labels in the same repair; a human clears all three after manual
+    review."""
+    return f"{domain}/{driver}-fsck.quarantined"

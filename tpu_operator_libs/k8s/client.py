@@ -196,6 +196,17 @@ class ConflictError(RuntimeError):
     between read and write (apierrors.IsConflict analogue)."""
 
 
+class GoneError(ApiServerError):
+    """410 Gone: the requested resourceVersion fell out of the
+    apiserver's watch cache / etcd compaction window
+    (apierrors.IsResourceExpired analogue). Subclasses
+    :class:`ApiServerError` deliberately — a caller that only knows
+    "transient, retry the pass" stays correct — but informers catch it
+    specifically: the ONLY sound recovery is a fresh LIST (relist) and
+    a new watch from the returned resourceVersion; re-watching from the
+    expired cursor would loop 410 forever."""
+
+
 class AlreadyExistsError(RuntimeError):
     """Create of an object that already exists (apierrors.IsAlreadyExists
     analogue)."""

@@ -316,6 +316,31 @@ class FakeCluster(K8sClient):
         number of streams dropped."""
         return self._broadcaster.drop_all()
 
+    def expire_watch_streams(self) -> int:
+        """Fault injection: 410-expire every open watch stream — an etcd
+        compaction invalidating all outstanding cursors at once. Unlike
+        :meth:`drop_watch_streams` (silent close, consumers infer the
+        relist from a stopped stream), each consumer first receives one
+        EXPIRED marker, the in-band "410 Gone" the apiserver sends
+        before closing; informers must relist and start a fresh watch on
+        seeing it. Returns the number of streams expired."""
+        return self._broadcaster.expire_all()
+
+    def inject_conflict_storm(self, operation: str, count: int) -> None:
+        """Fault injection: the next ``count`` calls of ``operation``
+        fail 409 Conflict (the object's resourceVersion moved between
+        the caller's read and its write — a hot controller peer racing
+        every patch). Sugar over :meth:`inject_api_errors` with a
+        :class:`ConflictError` factory; unlike the default transient
+        ApiServerError, 409 signals a LOST RACE, so callers must
+        refetch + recheck their precondition before reissuing, and park
+        rather than spin when the storm outlasts their retry budget."""
+        self.inject_api_errors(
+            operation, count,
+            exc_factory=lambda: ConflictError(
+                f"injected conflict storm on {operation}: object "
+                f"modified, resourceVersion mismatch"))
+
     def delay_watch_events(self, start: float, until: float,
                            seed: int = 0) -> None:
         """Fault injection: from ``start`` to ``until`` (virtual

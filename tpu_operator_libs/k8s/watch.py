@@ -26,6 +26,14 @@ DELETED = "DELETED"
 #: lost — relist to repair"). ``WatchEvent.object`` is None and ``kind``
 #: is empty for these.
 BOOKMARK = "BOOKMARK"
+#: Synthetic 410-Gone marker: the stream's resourceVersion cursor fell
+#: out of the server's watch cache (etcd compaction / cache eviction).
+#: Unlike :data:`BOOKMARK` (events were dropped client-side, relist
+#: repairs), EXPIRED means the SERVER can no longer replay the gap —
+#: the stream is dead after the marker and the consumer must relist and
+#: start a fresh watch. ``WatchEvent.object`` is None and ``kind`` is
+#: empty for these.
+EXPIRED = "EXPIRED"
 
 #: Sentinel object kinds, matching the reference's watched types
 #: (Nodes + driver DaemonSets + their pods).
@@ -43,7 +51,7 @@ class WatchEvent:
     :data:`BOOKMARK` resync markers ``object`` is None.
     """
 
-    type: str          # ADDED | MODIFIED | DELETED | BOOKMARK
+    type: str          # ADDED | MODIFIED | DELETED | BOOKMARK | EXPIRED
     kind: str          # KIND_NODE | KIND_POD | KIND_DAEMON_SET | ""
     object: object     # Node | Pod | DaemonSet snapshot | None
 
@@ -126,6 +134,25 @@ class Watch:
                 return
             if event is not None:
                 yield event
+
+    def expire(self) -> None:
+        """Fault injection: the server declares this stream's cursor
+        expired (410 Gone). One :data:`EXPIRED` marker is enqueued and
+        the stream stops — the consumer drains the backlog, sees the
+        marker, and must relist + rewatch. Delivery uses the normal
+        queue so events already in flight are not reordered past the
+        marker."""
+        if self._stopped.is_set():
+            return
+        try:
+            self._queue.put_nowait(WatchEvent(EXPIRED, "", None))
+        except queue.Full:
+            # a full bounded queue already owes the consumer a relist
+            # (BOOKMARK overflow path); losing the marker is safe
+            # because stop() below still forces the rewatch
+            with self._overflow_lock:
+                self._overflow_pending = True
+        self.stop()
 
     def stop(self) -> None:
         if self._stopped.is_set():
@@ -226,6 +253,18 @@ class WatchBroadcaster:
             self._subs = []
         for watch in subs:
             watch.stop()
+        return len(subs)
+
+    def expire_all(self) -> int:
+        """Fault injection: 410-expire every subscriber's stream (an
+        etcd compaction invalidating all outstanding watch cursors at
+        once). Each consumer receives one :data:`EXPIRED` marker, then
+        its stream is stopped. Returns the number of streams expired."""
+        with self._lock:
+            subs = [row[2] for row in self._subs]
+            self._subs = []
+        for watch in subs:
+            watch.expire()
         return len(subs)
 
     def subscriber_count(self) -> int:

@@ -981,6 +981,57 @@ def observe_precursor(registry: MetricsRegistry,
         labels)
 
 
+def observe_fsck(registry: MetricsRegistry,
+                 auditor: "object",
+                 janitor: "object" = None,
+                 key_registry: "object" = None,
+                 driver: str = "libtpu") -> None:
+    """Export the durable-state fsck layer's accounting.
+
+    ``auditor`` is a :class:`tpu_operator_libs.fsck.StateAuditor`,
+    ``janitor`` the owning :class:`tpu_operator_libs.fsck.Janitor`,
+    ``key_registry`` the :class:`tpu_operator_libs.fsck.
+    DurableKeyRegistry` being enforced. Rides the same scrape as the
+    fleet gauges. ``fsck_findings_total`` climbing while
+    ``fsck_repairs_total`` is flat means the janitor is not being run
+    on the findings (corruption is detected but never healed);
+    ``fsck_quarantined_nodes`` above 0 is the page — a node is parked
+    under ambiguous durable state and needs a human.
+    """
+    labels = {"driver": driver}
+    if key_registry is not None:
+        registry.set_gauge(
+            "fsck_keys_registered", len(key_registry.specs),
+            "Durable key families the registry catalogs", labels)
+    registry.set_counter_total(
+        "fsck_scans_total", auditor.scans_total,
+        "Full fsck passes over the owned durable surface", labels)
+    registry.set_counter_total(
+        "fsck_targets_scanned_total", auditor.targets_scanned_total,
+        "Objects whose stamps were classified (digest-cache misses)",
+        labels)
+    registry.set_counter_total(
+        "fsck_targets_skipped_total", auditor.targets_skipped_total,
+        "Objects skipped via the clean-digest cache (O(delta) scans)",
+        labels)
+    for classification, count in sorted(auditor.findings_total.items()):
+        registry.set_counter_total(
+            "fsck_findings_total", count,
+            "Corrupted durable stamps found, by classification",
+            {**labels, "classification": classification})
+    if janitor is None:
+        return
+    for action, count in sorted(janitor.repairs_total.items()):
+        registry.set_counter_total(
+            "fsck_repairs_total", count,
+            "Audited repairs committed, by action",
+            {**labels, "action": action})
+    registry.set_gauge(
+        "fsck_quarantined_nodes", len(janitor.quarantined_nodes),
+        "Nodes parked under ambiguous durable state (0 is healthy)",
+        labels)
+
+
 #: Buckets for condemned→remapped durations: a remap rides the spare's
 #: upgrade (one cordon/drain cycle) plus the reconfigurer's settle.
 REMAP_SECONDS_BUCKETS = (30.0, 60.0, 120.0, 300.0, 600.0, 1200.0,

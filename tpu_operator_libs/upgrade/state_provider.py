@@ -24,11 +24,19 @@ from tpu_operator_libs.consts import (
     UpgradeKeys,
     UpgradeState,
 )
-from tpu_operator_libs.k8s.client import K8sClient
+from tpu_operator_libs.k8s.client import ConflictError, K8sClient
 from tpu_operator_libs.k8s.objects import Node
 from tpu_operator_libs.util import Clock, EventRecorder, Event, KeyedLock, log_event
 
 logger = logging.getLogger(__name__)
+
+#: Kubernetes rejects objects whose total annotation payload exceeds
+#: 256KiB (TotalAnnotationSizeLimitB). The provider enforces the budget
+#: client side at write time so a runaway stamp (an unbounded duration
+#: history, a pathological trace id) degrades to a truncated-but-audited
+#: value instead of poisoning EVERY subsequent write to the node with
+#: apiserver validation failures.
+DEFAULT_ANNOTATION_BUDGET_BYTES = 256 * 1024
 
 
 class CacheSyncTimeout(TimeoutError):
@@ -44,6 +52,10 @@ class NodeUpgradeStateProvider:
                  sync_timeout: float = 10.0,
                  poll_interval: float = 1.0,
                  fence: Optional[Callable[[str, str], None]] = None,
+                 conflict_retries: int = 3,
+                 max_annotation_bytes: Optional[int]
+                 = DEFAULT_ANNOTATION_BUDGET_BYTES,
+                 audit: "Optional[object]" = None,
                  ) -> None:
         self._client = client
         self._keys = keys
@@ -51,6 +63,19 @@ class NodeUpgradeStateProvider:
         self._clock = clock or Clock()
         self._sync_timeout = sync_timeout
         self._poll_interval = poll_interval
+        # 409 handling: a ConflictError means the write LOST A RACE
+        # (resourceVersion moved between read and write), not that the
+        # server hiccupped — blind re-raise would abort the pass and
+        # blind retry would spin against a hot peer. Each retry
+        # refetches the live object and rechecks the precondition
+        # before reissuing; a storm outlasting the budget parks the
+        # transition (return False) instead of wedging the reconcile.
+        self._conflict_retries = max(0, conflict_retries)
+        # Per-object annotation byte budget (None disables the guard).
+        self._max_annotation_bytes = max_annotation_bytes
+        # Optional DecisionAudit: truncations are recorded as audited
+        # decisions, not just log lines — durable state was altered.
+        self._audit = audit
         self._node_lock = KeyedLock()
         self._counter_lock = threading.Lock()
         # Sharded-control-plane split-brain gate: called with
@@ -77,6 +102,13 @@ class NodeUpgradeStateProvider:
         #: annotation changes into one merge patch (metrics evidence
         #: for the fleet-scale write path).
         self.coalesced_writes_saved_total = 0
+        #: 409-conflict write attempts retried after refetch+recheck.
+        self.conflict_retries_total = 0
+        #: Transitions parked because a conflict storm outlasted the
+        #: retry budget (the caller's next reconcile re-derives).
+        self.conflict_parks_total = 0
+        #: Annotation bytes dropped by the per-object size guard.
+        self.annotation_bytes_truncated_total = 0
 
     def with_fence(self, fence: Optional[Callable[[str, str], None]],
                    ) -> "NodeUpgradeStateProvider":
@@ -93,6 +125,118 @@ class NodeUpgradeStateProvider:
         with self._counter_lock:
             self.writes_total += 1
             self.coalesced_writes_saved_total += saved
+
+    def _guard_annotation_budget(
+            self, node: Node,
+            patch: "dict[str, Optional[str]]",
+    ) -> "dict[str, Optional[str]]":
+        """Clamp ``patch`` so the node's merged annotation payload stays
+        under the byte budget. NEW values are truncated largest-first
+        (deterministic: size then key order) and the truncation is
+        audited + evented — the write NEVER fails on size, because a
+        rejected patch would wedge every later transition on the node
+        behind one runaway stamp. Pre-existing oversized annotations are
+        left alone (this guard owns only bytes it is about to write).
+        The base size uses the caller's snapshot, not a fresh read:
+        the budget is a safety clamp, not an exact invariant, and one
+        extra wire read per write is the wrong trade."""
+        budget = self._max_annotation_bytes
+        if budget is None or not patch:
+            return patch
+        merged = dict(node.metadata.annotations)
+        for key, value in patch.items():
+            if value is None:
+                merged.pop(key, None)
+            else:
+                merged[key] = value
+        total = sum(len(k.encode("utf-8")) + len(v.encode("utf-8"))
+                    for k, v in merged.items())
+        over = total - budget
+        if over <= 0:
+            return patch
+        out = dict(patch)
+        victims = sorted(
+            ((key, value) for key, value in patch.items()
+             if value is not None),
+            key=lambda kv: (-len(kv[1].encode("utf-8")), kv[0]))
+        dropped = 0
+        truncated: list[str] = []
+        for key, value in victims:
+            if over <= 0:
+                break
+            raw = value.encode("utf-8")
+            keep = max(0, len(raw) - over)
+            # decode(errors="ignore") heals a slice landing mid-rune
+            out[key] = raw[:keep].decode("utf-8", errors="ignore")
+            over -= len(raw) - keep
+            dropped += len(raw) - keep
+            truncated.append(key)
+        if truncated:
+            with self._counter_lock:
+                self.annotation_bytes_truncated_total += dropped
+            logger.warning(
+                "node %s: annotation patch exceeds %d-byte budget; "
+                "truncated %d bytes from %s",
+                node.metadata.name, budget, dropped, truncated)
+            log_event(self._recorder, node, Event.WARNING,
+                      self._keys.event_reason,
+                      f"Annotation byte budget exceeded; truncated "
+                      f"{dropped} bytes from {sorted(truncated)}")
+            if self._audit is not None:
+                self._audit.record(
+                    "annotation-budget", node.metadata.name,
+                    decision="truncate", rule="size-guard/truncate",
+                    inputs={"budget": budget, "droppedBytes": dropped,
+                            "keys": ",".join(sorted(truncated))})
+        return out
+
+    def _patch_with_conflict_retry(
+            self, node: Node, issue: Callable[[], None],
+            recheck: "Optional[Callable[[Node], bool]]" = None,
+            describe: str = "write", reraise: bool = False) -> bool:
+        """Issue a durable write, absorbing a bounded number of 409s.
+
+        Each conflict refetches the live node and — when ``recheck`` is
+        given — re-validates the caller's precondition against it before
+        reissuing (409 means the object MOVED; reissuing blind could
+        commit a decision derived from a dead snapshot). Returns False
+        when the precondition no longer holds (lost the race to a real
+        writer) or the storm outlasts the retry budget (park: the
+        caller's next reconcile re-derives from fresh state). With
+        ``reraise`` the exhausted storm re-raises the ConflictError
+        instead of parking — for annotation writes whose callers speak
+        exceptions, not booleans. Any other exception propagates
+        unchanged."""
+        attempt = 0
+        while True:
+            try:
+                issue()
+                return True
+            except ConflictError as exc:
+                attempt += 1
+                with self._counter_lock:
+                    self.conflict_retries_total += 1
+                if attempt > self._conflict_retries:
+                    with self._counter_lock:
+                        self.conflict_parks_total += 1
+                    logger.warning(
+                        "node %s: %s hit %d consecutive conflicts; "
+                        "parking until next reconcile: %s",
+                        node.metadata.name, describe, attempt, exc)
+                    log_event(self._recorder, node, Event.WARNING,
+                              self._keys.event_reason,
+                              f"Sustained write conflicts on {describe}; "
+                              f"parked after {attempt} attempts")
+                    if reraise:
+                        raise
+                    return False
+                live = self._client.get_node(node.metadata.name)
+                if recheck is not None and not recheck(live):
+                    logger.warning(
+                        "node %s: %s precondition no longer holds after "
+                        "conflict; skipping", node.metadata.name, describe)
+                    return False
+                self._clock.sleep(self._poll_interval * attempt)
 
     @property
     def keys(self) -> UpgradeKeys:
@@ -171,7 +315,9 @@ class NodeUpgradeStateProvider:
                         # explicit caller annotations win on collision
                         ann_patch.setdefault(key, extra_value)
             self._check_fence(node)
-            try:
+            ann_patch = self._guard_annotation_budget(node, ann_patch)
+
+            def issue() -> None:
                 if ann_patch:
                     self._client.patch_node_meta(
                         node.metadata.name,
@@ -182,11 +328,22 @@ class NodeUpgradeStateProvider:
                     self._client.patch_node_labels(
                         node.metadata.name, {self._keys.state_label: value})
                     self._count_write()
+
+            def still_holds(live_node: Node) -> bool:
+                return live_node.metadata.labels.get(
+                    self._keys.state_label, "") in (expected, value)
+
+            try:
+                committed = self._patch_with_conflict_retry(
+                    node, issue, recheck=still_holds,
+                    describe=f"state transition to {value!r}")
             except Exception as exc:
                 log_event(self._recorder, node, Event.WARNING,
                           self._keys.event_reason,
                           f"Failed to update node state label to {value}: {exc}")
                 raise
+            if not committed:
+                return False
 
             def check(n: Node) -> bool:
                 if n.metadata.labels.get(
@@ -231,10 +388,17 @@ class NodeUpgradeStateProvider:
                  for key, value in annotations.items()}
         with self._node_lock.lock(node.metadata.name):
             self._check_fence(node)
-            try:
+            patch = self._guard_annotation_budget(node, patch)
+
+            def issue() -> None:
                 self._client.patch_node_annotations(
                     node.metadata.name, patch)
                 self._count_write()
+
+            try:
+                self._patch_with_conflict_retry(
+                    node, issue, describe="annotation patch",
+                    reraise=True)
             except Exception as exc:
                 log_event(self._recorder, node, Event.WARNING,
                           self._keys.event_reason,
@@ -269,10 +433,19 @@ class NodeUpgradeStateProvider:
         patch_value = None if delete else value
         with self._node_lock.lock(node.metadata.name):
             self._check_fence(node)
-            try:
+            guarded = self._guard_annotation_budget(
+                node, {key: patch_value})
+            patch_value = guarded[key]
+
+            def issue() -> None:
                 self._client.patch_node_annotations(
                     node.metadata.name, {key: patch_value})
                 self._count_write()
+
+            try:
+                self._patch_with_conflict_retry(
+                    node, issue, describe=f"annotation {key} patch",
+                    reraise=True)
             except Exception as exc:
                 log_event(self._recorder, node, Event.WARNING,
                           self._keys.event_reason,
@@ -281,7 +454,7 @@ class NodeUpgradeStateProvider:
             if delete:
                 check = lambda n: key not in n.metadata.annotations  # noqa: E731
             else:
-                check = lambda n: n.metadata.annotations.get(key) == value  # noqa: E731
+                check = lambda n: n.metadata.annotations.get(key) == patch_value  # noqa: E731
             try:
                 fresh = self._wait_visible(node.metadata.name, check)
             except CacheSyncTimeout:
