@@ -4,7 +4,7 @@ PYTHON ?= python3
 PYTEST_FLAGS ?= -q
 COV_THRESHOLD ?= 85
 
-.PHONY: all check test test-fast test-fault test-chaos test-soak test-scale test-rollout test-latency test-reconfig test-shard test-planner test-budget test-handover test-obs test-federation test-policy test-dag test-precursor test-preflight test-fsck lint cov bench bench-reconcile bench-latency bench-shard bench-shard-100k bench-shard-1m bench-planner bench-budget bench-budget-1m bench-obs bench-federation bench-precursor bench-preflight profile-pass graft-check package clean diagram
+.PHONY: all check test test-fast test-fault test-chaos test-soak test-scale test-rollout test-latency test-reconfig test-shard test-planner test-budget test-handover test-obs test-federation test-policy test-dag test-precursor test-preflight test-fsck lint cov bench bench-reconcile bench-latency bench-shard bench-shard-100k bench-shard-1m bench-planner bench-budget bench-budget-1m bench-obs bench-federation bench-federation-50 bench-precursor bench-preflight profile-pass graft-check package clean diagram
 
 all: lint test
 
@@ -220,6 +220,14 @@ test-federation:
 # docs/benchmarks.md §2i). Writes BENCH_federation.json.
 bench-federation:
 	$(PYTHON) tools/federation_bench.py --out BENCH_federation.json
+
+# 50-region read-path proof: one full rollout + 20 steady-state
+# passes under the watch-driven read path vs the polled baseline —
+# acceptance is >= 10x fewer steady-state read objects with a
+# bit-identical final fleet state and zero session drops
+# (docs/benchmarks.md §2i). Merges the cell into BENCH_federation.json.
+bench-federation-50:
+	$(PYTHON) tools/federation_bench.py --scale50 --out BENCH_federation.json
 
 # Declarative policy-engine slice (`policy` marker): the sandboxed
 # expression language, the hook registry's fail-closed/fail-open

@@ -481,6 +481,262 @@ class TestFederationSoakExtended:
 
 
 # ---------------------------------------------------------------------------
+# watch-driven O(changed-regions) reads (the 50-region read path)
+# ---------------------------------------------------------------------------
+class TestWatchDrivenReads:
+    def _converge(self, sim, monitor=None):
+        target = FED_FINAL_REVISION
+        assert _drive_until(
+            sim, target,
+            lambda: all(sim.region_converged(n, target)
+                        for n in sim.regions)
+            and sim.shares_all_zero(), monitor=monitor)
+        return target
+
+    def test_steady_state_pass_reads_zero_objects(self):
+        sim = FederationFleetSim(_small_config())
+        target = self._converge(sim)
+        # converged fleet, no regional churn: every further pass must
+        # be O(changed regions) = O(0) — no lists, no gets, no objects
+        # (the freshness probe is a WRITE whose echo rides the stream)
+        for _ in range(4):
+            sim.fed.reconcile(target)
+            sim.reconcile_regions()
+            reads = sim.fed.last_status["reads"]
+            assert reads["mode"] == "watch"
+            assert reads["apiReads"] == 0
+            assert reads["readObjects"] == 0
+            assert reads["relists"] == 0
+            assert reads["totalRegions"] == len(sim.regions)
+            sim.step_clusters()
+
+    def test_stream_drop_relists_only_that_region(self):
+        sim = FederationFleetSim(_small_config())
+        target = self._converge(sim)
+        sim.fed.reconcile(target)
+        victim = sim.canary
+        before = {name: watcher.read_accounting()["relists"]
+                  for name, watcher in sim.fed._watchers.items()}
+        assert sim.regions[victim].gateway.drop_streams() > 0
+        sim.fed.reconcile(target)
+        after = {name: watcher.read_accounting()["relists"]
+                 for name, watcher in sim.fed._watchers.items()}
+        # the dropped region relisted (one list per informer stream);
+        # every OTHER region's cache stayed warm — zero relists there
+        assert after[victim] > before[victim]
+        for name in sim.regions:
+            if name != victim:
+                assert after[name] == before[name]
+        reads = sim.fed.last_status["reads"]
+        assert reads["relists"] == after[victim] - before[victim]
+
+    def test_poll_mode_pays_per_region_every_pass(self):
+        sim = FederationFleetSim(_small_config(watch_regions=False))
+        target = self._converge(sim)
+        sim.fed.reconcile(target)
+        reads = sim.fed.last_status["reads"]
+        assert reads["mode"] == "poll"
+        # three reads per region per pass (nodes, pods, DS), objects
+        # proportional to fleet size — the bill the watch path retires
+        assert reads["apiReads"] == 3 * len(sim.regions)
+        assert reads["readObjects"] > 0
+
+    def test_region_change_moves_only_its_cursor(self):
+        sim = FederationFleetSim(_small_config())
+        target = self._converge(sim)
+        # quiesce any in-flight probe echoes, then snapshot cursors
+        sim.fed.reconcile(target)
+        victim = next(n for n in sim.regions if n != sim.canary)
+        cursors = {name: watcher.cursor
+                   for name, watcher in sim.fed._watchers.items()}
+        sim.regions[victim].cluster.patch_daemon_set_annotations(
+            NS, "libtpu", {"example.com/touched": "1"})
+        sim.fed.reconcile(target)
+        moved = {name for name, watcher in sim.fed._watchers.items()
+                 if watcher.cursor != cursors[name]}
+        assert victim in moved
+        assert sim.fed.last_status["reads"]["regionsChanged"] \
+            == len(moved)
+
+
+# ---------------------------------------------------------------------------
+# follow-the-sun determinism (wave order must not depend on float noise)
+# ---------------------------------------------------------------------------
+class TestWaveOrderDeterminism:
+    def test_float_noise_ties_break_by_name(self):
+        from tpu_operator_libs.federation.controller import (
+            FederationController,
+            RegionView,
+        )
+
+        views = {}
+        # live signals that differ only below the rounding grid: the
+        # order must read as a pure name tie, whatever dict order or
+        # controller incarnation produced the views
+        for i, name in enumerate(("osaka", "berlin", "dallas",
+                                  "accra")):
+            views[name] = RegionView(
+                name=name, utilization=0.3 + i * 1e-9)
+        order = FederationController._wave_order(views, list(views))
+        reversed_order = FederationController._wave_order(
+            views, list(reversed(list(views))))
+        assert order == reversed_order == sorted(views)
+        # unknown-signal regions sort after every live signal, also
+        # deterministically by name
+        views["zulu"] = RegionView(name="zulu", utilization=None)
+        views["yoke"] = RegionView(name="yoke", utilization=None)
+        order = FederationController._wave_order(views, list(views))
+        assert order[-2:] == ["yoke", "zulu"]
+
+    def test_canary_election_is_incarnation_stable(self):
+        sim = FederationFleetSim(_small_config())
+        first = sim.canary
+        sim.fed = None
+        sim.build_fed()
+        sim.fed.reconcile(FED_FINAL_REVISION)
+        assert sim.fed.last_status["canaryRegion"] == first
+
+
+# ---------------------------------------------------------------------------
+# watch faults during the canary bake (stale cursor = frozen admissions)
+# ---------------------------------------------------------------------------
+class TestWatchFaultsDuringBake:
+    def test_delay_and_drop_defer_admission_until_relist(self):
+        config = _small_config(bake_seconds=60, max_steps=400)
+        sim = FederationFleetSim(config)
+        monitor = FederationMonitor(sim)
+        target = FED_FINAL_REVISION
+        assert _drive_until(
+            sim, target,
+            lambda: (sim.fed.last_status or {}).get("regions", {})
+            .get(sim.canary, {}).get("revision") == target,
+            monitor=monitor)
+        victim = next(n for n in sim.regions if n != sim.canary)
+        now = sim.clock.now()
+        # freeze the victim's event delivery well past the staleness
+        # bound, and drop its streams mid-window for good measure
+        sim.regions[victim].cluster.delay_watch_events(
+            now, now + 4 * config.watch_staleness_seconds, seed=3)
+        assert sim.regions[victim].gateway.drop_streams() > 0
+        stale_passes = 0
+        for _ in range(12):
+            sim.fed.reconcile(target)
+            sim.reconcile_regions(monitor=monitor)
+            monitor.sample()
+            cell = sim.fed.last_status["regions"][victim]
+            if not cell["reachable"]:
+                stale_passes += 1
+                # a region whose cursor went stale is never admitted
+                # and freezes share raises, exactly like a partition
+                assert cell["revision"] != target
+            sim.step_clusters()
+        assert stale_passes > 0
+        assert sim.fed.fed_relists >= 1  # the targeted relist happened
+        # delivery resumes -> probe echo lands -> admission resumes
+        assert _drive_until(
+            sim, target,
+            lambda: all(sim.region_converged(n, target)
+                        for n in sim.regions)
+            and sim.shares_all_zero(),
+            max_steps=300, monitor=monitor)
+        assert not monitor.violations
+
+    def test_every_schedule_has_watch_faults(self):
+        from tpu_operator_libs.chaos.schedule import (
+            FAULT_WATCH_BREAK,
+            FAULT_WATCH_DELAY,
+        )
+
+        regions = ["asia", "europe", "uswest"]
+        for seed in TIER1_SEEDS + SLOW_SEEDS:
+            kinds = FaultSchedule.generate_federation(
+                seed, regions).kinds
+            assert FAULT_WATCH_DELAY in kinds
+            assert FAULT_WATCH_BREAK in kinds
+
+
+# ---------------------------------------------------------------------------
+# cross-region session pre-shift (zero-drop admission)
+# ---------------------------------------------------------------------------
+class TestSessionPreShift:
+    def test_rollout_preshifts_zero_drops_zero_residue(self):
+        sim = FederationFleetSim(_small_config())
+        monitor = FederationMonitor(sim)
+        target = FED_FINAL_REVISION
+        assert _drive_until(
+            sim, target,
+            lambda: all(sim.region_converged(n, target)
+                        for n in sim.regions)
+            and sim.shares_all_zero(), monitor=monitor)
+        monitor.final_check(expect_quarantine=None)
+        assert not monitor.violations
+        # sessions actually moved ahead of the disruption, and none
+        # were ever dropped — the invariant the stamps exist to buy
+        assert sim.sessions.shift_ticks > 0
+        assert sim.sessions.drops_total == 0
+        assert sim.fed.preshift_reservations_total >= 1
+        assert sim.fed.preshift_ready_total >= 1
+        assert sim.fed.preshift_released_total >= 1
+        # zero residue: the sweep released every stamp pair
+        for region in sim.regions.values():
+            ds = next(d for d in region.cluster.list_daemon_sets(NS)
+                      if d.metadata.name == "libtpu")
+            assert sim.fed_keys.preshift_reservation_annotation \
+                not in ds.metadata.annotations
+            assert sim.fed_keys.preshift_ready_annotation \
+                not in ds.metadata.annotations
+
+    def test_crash_resume_adopts_the_durable_stamp(self):
+        sim = FederationFleetSim(_small_config())
+        target = FED_FINAL_REVISION
+        res_key = sim.fed_keys.preshift_reservation_annotation
+
+        def stamps():
+            found = {}
+            for name, region in sim.regions.items():
+                ds = next(d for d in region.cluster
+                          .list_daemon_sets(NS)
+                          if d.metadata.name == "libtpu")
+                value = ds.metadata.annotations.get(res_key)
+                if value is not None:
+                    found[name] = value
+            return found
+
+        assert _drive_until(sim, target, lambda: bool(stamps()))
+        before = stamps()
+        sim.fed = None
+        _drive(sim, target, 3)
+        sim.build_fed()  # replacement: zero in-memory state
+        sim.fed.reconcile(target)
+        after = stamps()
+        # the replacement resumed from the stamps alone: each pair is
+        # either ADOPTED verbatim (never re-stamped with a new epoch)
+        # or already released by the sweep — never duplicated
+        for holder, value in after.items():
+            assert before.get(holder) == value
+        assert _drive_until(
+            sim, target,
+            lambda: all(sim.region_converged(n, target)
+                        for n in sim.regions)
+            and sim.shares_all_zero())
+        assert sim.sessions.drops_total == 0
+        assert not stamps()
+
+    def test_preshift_off_skips_the_gate(self):
+        sim = FederationFleetSim(
+            _small_config(session_pre_shift=False))
+        monitor = FederationMonitor(sim)
+        target = FED_FINAL_REVISION
+        assert _drive_until(
+            sim, target,
+            lambda: all(sim.region_converged(n, target)
+                        for n in sim.regions)
+            and sim.shares_all_zero(), monitor=monitor)
+        assert sim.fed.preshift_reservations_total == 0
+        assert not monitor.violations
+
+
+# ---------------------------------------------------------------------------
 # metrics + bench smoke
 # ---------------------------------------------------------------------------
 class TestFederationMetrics:

@@ -16,8 +16,15 @@ the acceptance fleet):
   CANARY-HALT -> FLEET-QUARANTINE latency (first quarantine stamp
   observed anywhere -> every region's DaemonSet carrying it) and
   asserts zero non-canary admissions in between.
+- ``scale50`` (``--scale50`` / ``make bench-federation-50``) — the
+  50-region read-path cell: one full rollout + 20 steady-state passes
+  under the watch-driven read path, the same episode again under the
+  polled baseline, reporting steady-state read objects per pass for
+  both arms, their ratio (acceptance: >= 10x fewer in watch mode) and
+  whether the two arms' final fleet state fingerprints are identical
+  (they must be — the read path changes the BILL, never the state).
 
-Writes BENCH_federation.json (``make bench-federation``). Both cells
+Writes BENCH_federation.json (``make bench-federation``). All cells
 ride the same invariants as the chaos gate (FederationMonitor), so a
 bench run is also a fault-free regression of the safety story.
 """
@@ -132,7 +139,118 @@ def run_containment_cell(config: FederationChaosConfig) -> dict:
     }
 
 
-def run(regions: int = 4, check: bool = True) -> dict:
+def _fleet_fingerprint(sim) -> str:
+    """sha256 over the semantically FINAL fleet state: per-region DS
+    revision generation, budget share, quarantine + pre-shift stamps
+    (must be absent), node upgrade states and pod revision hashes.
+    The freshness probe is excluded and the bake stamp's epoch is
+    normalized to its revision part — pass TIMING legitimately
+    differs between the read paths; the converged state must not."""
+    import hashlib
+
+    from tpu_operator_libs.consts import (
+        POD_CONTROLLER_REVISION_HASH_LABEL,
+    )
+    from tpu_operator_libs.simulate import NS
+
+    parts: "list[str]" = []
+    probe_key = sim.fed_keys.probe_annotation
+    bake_key = sim.fed_keys.bake_passed_annotation
+    for name in sorted(sim.regions):
+        cluster = sim.regions[name].cluster
+        ds = next(d for d in cluster.list_daemon_sets(NS)
+                  if d.metadata.name == "libtpu")
+        for key in sorted(ds.metadata.annotations):
+            if key == probe_key:
+                continue
+            value = ds.metadata.annotations[key]
+            if key == bake_key:
+                value = value.split(":")[0]
+            parts.append(f"{name}|ds|{key}={value}")
+        parts.append(f"{name}|gen|{ds.spec.template_generation}")
+        for node in sorted(cluster.list_nodes(),
+                           key=lambda n: n.metadata.name):
+            parts.append(
+                f"{name}|node|{node.metadata.name}|"
+                f"{node.metadata.labels.get(sim.keys.state_label)}|"
+                f"{node.is_unschedulable()}")
+        revisions = sorted(
+            p.metadata.labels.get(POD_CONTROLLER_REVISION_HASH_LABEL,
+                                  "") for p in cluster.list_pods(
+                namespace=NS) if p.controller_owner() is not None)
+        parts.append(f"{name}|pods|{','.join(revisions)}")
+    return hashlib.sha256("\n".join(parts).encode()).hexdigest()
+
+
+def run_scale50_cell(regions: int = 50,
+                     steady_passes: int = 20) -> dict:
+    """Watch vs polled read bill at 50 regions, identical final state."""
+    arms: "dict[str, dict]" = {}
+    fingerprints: "dict[str, str]" = {}
+    for mode, watch in (("watch", True), ("polled", False)):
+        names = tuple(f"region-{i:02d}" for i in range(regions))
+        config = FederationChaosConfig(
+            regions=names, n_slices=1, hosts_per_slice=2,
+            pod_recreate_delay=2.0, pod_ready_delay=5.0,
+            bake_seconds=20, region_bake_seconds=5,
+            follow_the_sun=False, max_concurrent_regions=8,
+            watch_regions=watch, max_steps=1500)
+        sim = FederationFleetSim(config)
+        monitor = FederationMonitor(sim)
+        target = FED_FINAL_REVISION
+
+        def converged(sim: FederationFleetSim) -> bool:
+            return (all(sim.region_converged(n, target)
+                        for n in sim.regions)
+                    and sim.shares_all_zero())
+
+        ok, steps = _drive(sim, monitor, lambda now: target,
+                           converged, config.max_steps)
+        reads_before = (sim.fed.fed_api_reads,
+                        sim.fed.fed_read_objects)
+        for _ in range(steady_passes):
+            sim.fed.reconcile(target)
+            sim.reconcile_regions(monitor=monitor)
+            monitor.sample()
+            sim.step_clusters()
+        steady_api_reads = sim.fed.fed_api_reads - reads_before[0]
+        steady_objects = sim.fed.fed_read_objects - reads_before[1]
+        monitor.final_check(expect_quarantine=None)
+        fingerprints[mode] = _fleet_fingerprint(sim)
+        arms[mode] = {
+            "converged": ok,
+            "rolloutSteps": steps,
+            "makespanSeconds": round(
+                sim.clock.now()
+                - steady_passes * config.reconcile_interval, 1),
+            "steadyApiReads": steady_api_reads,
+            "steadyReadObjects": steady_objects,
+            "steadyReadObjectsPerPass": round(
+                steady_objects / steady_passes, 2),
+            "sessionDrops": sim.sessions.drops_total,
+            "preshiftReservations":
+                sim.fed.preshift_reservations_total,
+            "preshiftReleased": sim.fed.preshift_released_total,
+            "violations": [v.describe() for v in monitor.violations],
+        }
+    polled_objects = arms["polled"]["steadyReadObjects"]
+    watch_objects = arms["watch"]["steadyReadObjects"]
+    return {
+        "regions": regions,
+        "nodesPerRegion": 2,
+        "steadyPasses": steady_passes,
+        "watch": arms["watch"],
+        "polled": arms["polled"],
+        "steadyReadObjectsRatio": round(
+            polled_objects / max(1, watch_objects), 1),
+        "finalStateIdentical":
+            fingerprints["watch"] == fingerprints["polled"],
+        "fleetFingerprint": fingerprints["watch"],
+    }
+
+
+def run(regions: int = 4, check: bool = True,
+        scale50: bool = False) -> dict:
     names = tuple(f"region-{i}" for i in range(regions))
     config = FederationChaosConfig(regions=names, max_steps=600)
     result = {
@@ -140,6 +258,8 @@ def run(regions: int = 4, check: bool = True) -> dict:
         "rollout": run_rollout_cell(config),
         "containment": run_containment_cell(config),
     }
+    if scale50:
+        result["scale50"] = run_scale50_cell()
     if check:
         rollout = result["rollout"]
         containment = result["containment"]
@@ -150,6 +270,14 @@ def run(regions: int = 4, check: bool = True) -> dict:
         assert containment["nonCanaryBadAdmissions"] == 0, containment
         assert containment["canaryHaltToFleetQuarantineSeconds"] \
             is not None, containment
+        if scale50:
+            cell = result["scale50"]
+            for arm in (cell["watch"], cell["polled"]):
+                assert arm["converged"], cell
+                assert not arm["violations"], arm["violations"]
+                assert arm["sessionDrops"] == 0, cell
+            assert cell["steadyReadObjectsRatio"] >= 10.0, cell
+            assert cell["finalStateIdentical"], cell
     return result
 
 
@@ -158,8 +286,21 @@ def main() -> int:
     parser.add_argument("--regions", type=int, default=4)
     parser.add_argument("--out", default="BENCH_federation.json")
     parser.add_argument("--no-check", action="store_true")
+    parser.add_argument("--scale50", action="store_true",
+                        help="add the 50-region watch-vs-polled "
+                        "read-path cell (merged into the same JSON)")
     args = parser.parse_args()
-    result = run(regions=args.regions, check=not args.no_check)
+    result = run(regions=args.regions, check=not args.no_check,
+                 scale50=args.scale50)
+    if args.scale50 and os.path.exists(args.out):
+        # merge: keep whichever cells the existing file already has
+        try:
+            with open(args.out) as fh:
+                previous = json.load(fh)
+            previous.update(result)
+            result = previous
+        except (ValueError, OSError):
+            pass
     with open(args.out, "w") as fh:
         json.dump(result, fh, indent=2, sort_keys=True)
         fh.write("\n")
@@ -173,6 +314,13 @@ def main() -> int:
           f"{containment['canaryHaltToFleetQuarantineSeconds']}s with "
           f"{containment['nonCanaryBadAdmissions']} non-canary bad "
           f"admissions; wrote {args.out}")
+    if "scale50" in result:
+        cell = result["scale50"]
+        print(f"scale50: {cell['regions']} regions — steady-state "
+              f"read objects/pass {cell['watch']['steadyReadObjectsPerPass']}"
+              f" (watch) vs {cell['polled']['steadyReadObjectsPerPass']}"
+              f" (polled), ratio {cell['steadyReadObjectsRatio']}x; "
+              f"final state identical: {cell['finalStateIdentical']}")
     return 0
 
 

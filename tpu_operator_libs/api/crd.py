@@ -916,6 +916,35 @@ def federation_policy_schema() -> dict[str, Any]:
                                "dipping below the trough threshold is "
                                "admitted anyway after this wait.",
             },
+            "watchStalenessSeconds": {
+                "type": "number",
+                "exclusiveMinimum": 0,
+                "default": 30.0,
+                "description": "Watch mode: how stale a region's "
+                               "change cursor may grow before the "
+                               "region stops counting as freshly read "
+                               "(freezes raises fleet-wide and defers "
+                               "its own admission).",
+            },
+            "sessionPreShift": {
+                "type": "boolean",
+                "default": True,
+                "description": "Reserve session capacity in an "
+                               "adjacent region (durable "
+                               "reservation→ready stamp pair) "
+                               "and require readiness before "
+                               "admitting a region, so an admission "
+                               "drops zero sessions globally.",
+            },
+            "maxPreshiftWaitSeconds": {
+                "type": "integer",
+                "minimum": 0,
+                "default": 3600,
+                "description": "Liveness override: if no reserve "
+                               "region reaches readiness within this "
+                               "wait the admission proceeds anyway "
+                               "(audited).",
+            },
             "preflight": preflight_schema(),
         },
     }

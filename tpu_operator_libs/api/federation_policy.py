@@ -58,6 +58,24 @@ class FederationPolicySpec:
     # Liveness override: a region that never dips below the trough
     # threshold is admitted anyway after waiting this long.
     max_trough_wait_seconds: int = 3600
+    # Watch mode (federation/region_watch.py): how stale a region's
+    # change cursor may grow before the region stops counting as
+    # freshly read — the staleness bound that replaces the per-pass
+    # probe round-trip. A region past the bound freezes raises
+    # fleet-wide and defers its own admission, exactly like a
+    # rejected probe write in polling mode.
+    watch_staleness_seconds: float = 30.0
+    # Cross-region session pre-shift: before admitting a region,
+    # reserve session capacity in an adjacent region (durable
+    # reservation→ready stamp pair on the reserve region's DS),
+    # require readiness, then admit — so a region admission drops
+    # zero interactive sessions globally.
+    session_pre_shift: bool = True
+    # Liveness override for pre-shift: if no reserve region can reach
+    # readiness within this wait, the admission proceeds anyway
+    # (audited) — a missing spare region must not park the rollout
+    # forever.
+    max_preshift_wait_seconds: int = 3600
     # Region-admission preflight (upgrade/preflight.py semantics at
     # region granularity): before a region is rolled — and before its
     # budget share is stamped — its rollout is forecast against the
@@ -81,6 +99,12 @@ class FederationPolicySpec:
         if self.max_trough_wait_seconds < 0:
             raise PolicyValidationError(
                 "maxTroughWaitSeconds must be >= 0")
+        if self.watch_staleness_seconds <= 0:
+            raise PolicyValidationError(
+                "watchStalenessSeconds must be > 0")
+        if self.max_preshift_wait_seconds < 0:
+            raise PolicyValidationError(
+                "maxPreshiftWaitSeconds must be >= 0")
         if self.preflight is not None:
             self.preflight.validate()
 
@@ -94,6 +118,9 @@ class FederationPolicySpec:
             "followTheSun": self.follow_the_sun,
             "troughUtilization": self.trough_utilization,
             "maxTroughWaitSeconds": self.max_trough_wait_seconds,
+            "watchStalenessSeconds": self.watch_staleness_seconds,
+            "sessionPreShift": self.session_pre_shift,
+            "maxPreshiftWaitSeconds": self.max_preshift_wait_seconds,
         }
         if self.preflight is not None:
             out["preflight"] = self.preflight.to_dict()
@@ -111,7 +138,12 @@ class FederationPolicySpec:
             follow_the_sun=data.get("followTheSun", True),
             trough_utilization=data.get("troughUtilization", 0.35),
             max_trough_wait_seconds=data.get("maxTroughWaitSeconds",
-                                             3600))
+                                             3600),
+            watch_staleness_seconds=data.get("watchStalenessSeconds",
+                                             30.0),
+            session_pre_shift=data.get("sessionPreShift", True),
+            max_preshift_wait_seconds=data.get("maxPreshiftWaitSeconds",
+                                               3600))
         if "preflight" in data:
             spec.preflight = PreflightSpec.from_dict(data["preflight"])
         return spec

@@ -22,7 +22,21 @@ controller under test — and holds three always-on invariants:
   ever exists outside the canary region;
 - **federation-resume**: controllers rebuilt with zero in-memory state
   converge the rollout from the regions' durable annotations alone,
-  and the end state carries no share residue (every stamp back to 0).
+  and the end state carries no share residue (every stamp back to 0)
+  and no pre-shift stamp residue (the reservation→ready pairs all
+  released; the fsck registry's torn-pair audit agrees);
+- **session-zero-drop**: a fixed population of interactive sessions
+  per region (capacity = serving nodes × ``sessions_per_node``) never
+  drops a session across region admissions — every capacity deficit a
+  rollout opens is absorbed by a READY cross-region pre-shift
+  reservation, sampled from ground truth below the gateways.
+
+The federation reads the regions watch-driven by default
+(``watch_regions``): the schedule's watch-delay windows buffer event
+delivery (the region's change cursor must go stale and freeze raises
+rather than trust a frozen cache) and its watch-break stops the
+federation's region streams mid-bake (repair = a relist of that
+region only, through the Informer rewatch machinery).
 
 :func:`run_federation_bad_revision_soak` is the containment flavor:
 the federation's target becomes a revision whose pods can never become
@@ -64,6 +78,8 @@ from tpu_operator_libs.chaos.schedule import (
     FAULT_FED_PARTITION,
     FAULT_OPERATOR_CRASH,
     FAULT_REGION_KILL,
+    FAULT_WATCH_BREAK,
+    FAULT_WATCH_DELAY,
     FaultSchedule,
 )
 from tpu_operator_libs.consts import (
@@ -77,6 +93,8 @@ from tpu_operator_libs.federation import (
     FederationController,
     RegionHandle,
 )
+from tpu_operator_libs.fsck.auditor import StateAuditor
+from tpu_operator_libs.fsck.registry import default_registry
 from tpu_operator_libs.k8s.client import (
     ApiServerError,
     ConflictError,
@@ -139,6 +157,22 @@ class FederationChaosConfig:
     diurnal_period: float = 240.0
     util_base: float = 0.55
     util_amplitude: float = 0.35
+    #: Watch-driven federation reads (region_watch.py). False drops
+    #: back to the polled read path — the bench's baseline arm.
+    watch_regions: bool = True
+    #: Staleness bound on each region's change cursor (watch mode).
+    watch_staleness_seconds: float = 30.0
+    #: Cross-region session pre-shift (reservation→ready on the
+    #: reserve region's DS before any region admission).
+    session_pre_shift: bool = True
+    #: Interactive sessions per serving node (sizes each region's
+    #: fixed session population AND its live capacity).
+    sessions_per_node: int = 2
+    #: Virtual seconds a pre-shift reservation takes to become
+    #: serving-ready (the readiness hook's warmup model).
+    preshift_warmup_seconds: float = 15.0
+    #: Bounded pre-shift wait before an audited admit-anyway.
+    max_preshift_wait_seconds: int = 480
 
     @property
     def nodes_per_region(self) -> int:
@@ -172,7 +206,10 @@ class FederationChaosConfig:
             max_concurrent_regions=self.max_concurrent_regions,
             follow_the_sun=self.follow_the_sun,
             trough_utilization=self.trough_utilization,
-            max_trough_wait_seconds=self.max_trough_wait_seconds)
+            max_trough_wait_seconds=self.max_trough_wait_seconds,
+            watch_staleness_seconds=self.watch_staleness_seconds,
+            session_pre_shift=self.session_pre_shift,
+            max_preshift_wait_seconds=self.max_preshift_wait_seconds)
 
 
 class _FedGateway:
@@ -198,6 +235,9 @@ class _FedGateway:
         #: Calls refused/served-stale inside partition windows (the
         #: harness-sanity proof the partition actually bit).
         self.partitioned_calls = 0
+        #: Every watch stream vended through this gateway (the
+        #: watch-break fault's blast surface).
+        self._watches: "list[_GatedWatch]" = []
 
     def add_window(self, start: float, end: float) -> None:
         self._windows.append((start, end))
@@ -205,6 +245,37 @@ class _FedGateway:
     def partitioned(self) -> bool:
         now = self._cluster.clock.now()
         return any(start <= now < end for start, end in self._windows)
+
+    def watch(self, *args: "object", **kwargs: "object") -> "object":
+        """Gated subscription: ``watch`` is not in ``_READS`` (it
+        vends a stream, not a snapshot), so it needs this explicit
+        seam — otherwise ``__getattr__`` would hand the federation an
+        ungated stream that tunnels events straight through a
+        partition window."""
+        gated = _GatedWatch(self, self._cluster.watch(*args, **kwargs))
+        self._watches.append(gated)
+        return gated
+
+    def drop_streams(self) -> int:
+        """Watch-break fault, silent flavor: every federation-side
+        stream of this region stops with no marker — the consumer
+        must infer the gap and relist (Informer rewatch)."""
+        dropped = 0
+        for gated in self._watches:
+            if not gated.stopped:
+                gated.stop()
+                dropped += 1
+        return dropped
+
+    def expire_streams(self) -> int:
+        """Watch-break fault, 410 flavor: the server declares the
+        cursor expired in-band before stopping each stream."""
+        expired = 0
+        for gated in self._watches:
+            if not gated.stopped:
+                gated.expire()
+                expired += 1
+        return expired
 
     def __getattr__(self, name: str) -> "object":
         if name in self._WRITES:
@@ -238,6 +309,34 @@ class _FedGateway:
         return getattr(self._cluster, name)
 
 
+class _GatedWatch:
+    """One region watch stream as the federation sees it through the
+    partition: inside a window events are WITHHELD (``get`` returns
+    None — the stream looks idle, exactly how a cut long-poll reads),
+    and the backlog drains the moment the window lifts. Detecting the
+    silence is the staleness bound's job, not the stream's."""
+
+    def __init__(self, gateway: _FedGateway, watch: "object") -> None:
+        self._gateway = gateway
+        self._watch = watch
+
+    def get(self, timeout: "Optional[float]" = None) -> "object":
+        if self._gateway.partitioned():
+            self._gateway.partitioned_calls += 1
+            return None
+        return self._watch.get(timeout=timeout)
+
+    @property
+    def stopped(self) -> bool:
+        return self._watch.stopped
+
+    def stop(self) -> None:
+        self._watch.stop()
+
+    def expire(self) -> None:
+        self._watch.expire()
+
+
 class _RegionOperator:
     """One regional controller process-lifetime (fresh manager, fresh
     provider; everything durable lives in the region's cluster)."""
@@ -262,6 +361,75 @@ class _Region:
     gateway: _FedGateway
     op: "Optional[_RegionOperator]" = None
     generation: int = 1
+
+
+class _SessionFleet:
+    """A fixed population of interactive sessions per region, routed
+    from ground truth BELOW the gateways (like the monitor). Capacity
+    is serving nodes × ``sessions_per_node``; every tick, a region's
+    capacity deficit is absorbed by a READY pre-shift reservation
+    naming it as source (capacity the federation reserved in an
+    adjacent region before admitting this one) and anything left over
+    DROPS — the zero-drop invariant's direct evidence. The model is
+    deliberately worst-case: sessions never shrink, shed, or retry."""
+
+    def __init__(self, sim: "FederationFleetSim") -> None:
+        self.sim = sim
+        per_region = (sim.config.nodes_per_region
+                      * sim.config.sessions_per_node)
+        self.population = {name: per_region for name in sim.regions}
+        self.drops_total = 0
+        #: ticks where at least one session rode a pre-shift reserve
+        #: (harness sanity: the invariant must have been exercised).
+        self.shift_ticks = 0
+        self.max_shifted = 0
+        self.drop_events: "list[tuple[float, str, int]]" = []
+
+    def sessions(self, region: str) -> int:
+        return self.population[region]
+
+    def tick(self) -> None:
+        sim = self.sim
+        spn = sim.config.sessions_per_node
+        res_key = sim.fed_keys.preshift_reservation_annotation
+        ready_key = sim.fed_keys.preshift_ready_annotation
+        ready_slots: "dict[str, int]" = {}
+        for region in sim.regions.values():
+            daemon_sets = consume_transient(
+                lambda c=region.cluster: c.list_daemon_sets(NS))
+            ds = next((d for d in daemon_sets
+                       if d.metadata.name == "libtpu"), None)
+            if ds is None:
+                continue
+            reservation = FederationController._parse_reservation(
+                ds.metadata.annotations.get(res_key, ""))
+            ready = FederationController._parse_ready(
+                ds.metadata.annotations.get(ready_key, ""))
+            # only a COMPLETE pair serves traffic: a reservation whose
+            # ready stamp has not landed is capacity on paper
+            if reservation is not None and ready is not None \
+                    and ready[0] == reservation[0] \
+                    and ready[1] == reservation[1]:
+                source = reservation[0]
+                ready_slots[source] = (ready_slots.get(source, 0)
+                                       + reservation[2])
+        for name, region in sorted(sim.regions.items()):
+            nodes = consume_transient(region.cluster.list_nodes)
+            capacity = spn * sum(
+                1 for node in nodes
+                if node.is_ready() and not node.is_unschedulable())
+            deficit = self.population[name] - capacity
+            if deficit <= 0:
+                continue
+            absorbed = min(deficit, ready_slots.get(name, 0))
+            if absorbed > 0:
+                self.shift_ticks += 1
+                self.max_shifted = max(self.max_shifted, absorbed)
+            dropped = deficit - absorbed
+            if dropped > 0:
+                self.drops_total += dropped
+                self.drop_events.append(
+                    (sim.clock.now(), name, dropped))
 
 
 class FederationFleetSim:
@@ -307,6 +475,7 @@ class FederationFleetSim:
         self.fed: Optional[FederationController] = None
         self.fed_generation = 0
         self.region_incarnations = 0
+        self.sessions = _SessionFleet(self)
         self.build_fed()
         for name in self.regions:
             self.build_region_op(name)
@@ -323,11 +492,21 @@ class FederationFleetSim:
                 name=name, client=region.gateway, namespace=NS,
                 ds_name="libtpu",
                 utilization=(lambda now, index=region.index:
-                             config.region_utilization(index, now))))
+                             config.region_utilization(index, now)),
+                sessions=(lambda name=name:
+                          self.sessions.sessions(name)),
+                # the readiness model: reserved capacity is serving-
+                # ready once the warmup elapsed past the durable
+                # reservation epoch — restart-stable, because the
+                # epoch lives in the stamp, not in controller memory
+                preshift_ready=(
+                    lambda slots, reserved_at:
+                    self.clock.now() >= reserved_at
+                    + config.preshift_warmup_seconds)))
         self.fed = FederationController(
             handles, config.federation_policy(self.canary),
             keys=self.fed_keys, upgrade_keys=self.keys,
-            clock=self.clock)
+            clock=self.clock, watch=config.watch_regions)
         return self.fed
 
     def build_region_op(self, name: str) -> _RegionOperator:
@@ -407,6 +586,7 @@ class FederationFleetSim:
         self.clock.advance(self.config.reconcile_interval)
         for region in self.regions.values():
             region.cluster.step()
+        self.sessions.tick()
 
     # -- convergence checks ---------------------------------------------
     def region_converged(self, name: str, revision: str) -> bool:
@@ -463,6 +643,9 @@ class FederationMonitor:
         #: canary-halt -> fleet-quarantine-complete latency evidence.
         self.halt_seen_at: Optional[float] = None
         self.fleet_quarantined_at: Optional[float] = None
+        #: session drops already converted into violations (each new
+        #: drop is reported exactly once).
+        self._session_drops_seen = 0
         for name, region in sim.regions.items():
             revision = region.cluster.latest_revision_hash(NS, "libtpu")
             self._initial_revision[name] = revision
@@ -539,6 +722,22 @@ class FederationMonitor:
                                      f"{revision!r} at {passed_at}")
                     except ValueError:
                         pass
+        # session-zero-drop: a pre-shift-enabled fleet must never
+        # have dropped a session (capacity deficits are absorbed by
+        # ready reservations; the fleet model records the remainder)
+        if sim.config.session_pre_shift \
+                and sim.sessions.drops_total > self._session_drops_seen:
+            dropped = (sim.sessions.drops_total
+                       - self._session_drops_seen)
+            self._session_drops_seen = sim.sessions.drops_total
+            recent = ", ".join(
+                f"t={at:g} {region} -{n}"
+                for at, region, n in sim.sessions.drop_events[-3:])
+            self._violate(
+                "session-zero-drop", "sessions",
+                f"{dropped} interactive session(s) dropped — a region "
+                f"admission opened a capacity deficit with no ready "
+                f"pre-shift reserve ({recent})")
         if self.quarantined and self.fleet_quarantined_at is None \
                 and regions_quarantined == len(sim.regions):
             self.fleet_quarantined_at = now
@@ -601,10 +800,17 @@ class FederationMonitor:
 
     def final_check(self, expect_quarantine: Optional[str]) -> None:
         """federation-resume residue audit: every share stamp back to
-        0 (or never granted), and — in the containment flavor — the
-        quarantine record standing on EVERY region, which is what a
-        recovered region re-verifies before admitting anything."""
+        0 (or never granted), every pre-shift reservation→ready pair
+        released (verified directly AND through the fsck registry's
+        torn-pair audit on the region DaemonSets), and — in the
+        containment flavor — the quarantine record standing on EVERY
+        region, which is what a recovered region re-verifies before
+        admitting anything."""
         sim = self.sim
+        preshift_keys = (
+            sim.fed_keys.preshift_reservation_annotation,
+            sim.fed_keys.preshift_ready_annotation)
+        auditor = StateAuditor(default_registry(), clock=sim.clock)
         for name, region in sorted(sim.regions.items()):
             for ds in region.cluster.list_daemon_sets(NS):
                 if ds.metadata.name != "libtpu":
@@ -615,6 +821,23 @@ class FederationMonitor:
                         "federation-resume", name,
                         f"budget-share residue survived convergence: "
                         f"stamp still grants {share} node(s)")
+                for key in preshift_keys:
+                    value = ds.metadata.annotations.get(key)
+                    if value is not None:
+                        self._violate(
+                            "federation-resume", name,
+                            f"pre-shift residue survived convergence: "
+                            f"{key}={value!r} (the release patch "
+                            f"deletes BOTH stamps; a survivor means a "
+                            f"torn or skipped release)")
+                for finding in auditor.scan([], daemon_sets=[ds]):
+                    if finding.key in preshift_keys:
+                        self._violate(
+                            "federation-resume", name,
+                            f"fsck flagged pre-shift stamp "
+                            f"{finding.key} as "
+                            f"{finding.classification}: "
+                            f"{finding.reason}")
                 if expect_quarantine is not None:
                     recorded = ds.metadata.annotations.get(
                         sim.keys.quarantined_revision_annotation)
@@ -680,6 +903,24 @@ def _run_federation_episode(seed: int, config: FederationChaosConfig,
     for event in schedule.by_kind(FAULT_FED_PARTITION):
         gateway = sim.regions[event.target].gateway
         gateway.add_window(event.at, event.until)
+    # watch-path faults: a delay window buffers the region's event
+    # delivery (every subscriber's cache silently freezes — the
+    # federation's staleness bound must notice); a break stops the
+    # federation's streams for one region (param parity: silent drop
+    # vs in-band 410 expiry — both repair via a region-local relist)
+    for event in schedule.by_kind(FAULT_WATCH_DELAY):
+        region = sim.regions.get(event.target)
+        if region is not None:
+            region.cluster.delay_watch_events(
+                event.at, event.until, seed=event.param)
+    for event in schedule.by_kind(FAULT_WATCH_BREAK):
+        region = sim.regions.get(event.target)
+        if region is not None:
+            breaker = (region.gateway.drop_streams
+                       if event.param % 2 == 0
+                       else region.gateway.expire_streams)
+            region.cluster.schedule_at(
+                event.at, lambda b=breaker: b() and None)
     region_kills_fired = 0
     fed_kills_fired = 0
     fed_saw_partition = False
@@ -862,7 +1103,7 @@ def run_federation_soak(seed: int,
             return False
         return sim.shares_all_zero()
 
-    _, monitor, report = _run_federation_episode(
+    sim, monitor, report = _run_federation_episode(
         seed, config, schedule, target_of=target_of,
         converged=converged, expect_quarantine=None)
     if monitor.max_joint_unavailable == 0:
@@ -874,6 +1115,15 @@ def run_federation_soak(seed: int,
             detail="joint unavailability never rose above zero — the "
                    "episode upgraded nothing, so the global-budget "
                    "audit proved nothing"))
+    if config.session_pre_shift and sim.sessions.shift_ticks == 0:
+        # harness sanity: the zero-drop audit only proves something
+        # if sessions actually rode a pre-shift reserve at least once
+        report.violations.append(InvariantViolation(
+            invariant="harness", at=report.total_seconds,
+            subject="sessions",
+            detail="no session was ever pre-shifted — every capacity "
+                   "deficit missed the reserves, so the "
+                   "session-zero-drop audit proved nothing"))
     return report
 
 

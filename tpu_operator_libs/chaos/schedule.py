@@ -729,6 +729,14 @@ class FaultSchedule:
         are excluded for the specialized-gate reason: with every
         unavailability operator-caused, the ``global-budget`` audit is
         exact rather than fault-excused.
+
+        Watch-path faults ride in the same pool: 1-2 region-targeted
+        watch-delays (deliveries buffered for the window — the
+        region's change cursor must go stale and freeze raises /
+        defer admission rather than trust a frozen cache) and exactly
+        one region-stream break (``param`` parity picks silent drop
+        vs 410 expiry; either way the repair is a relist of THAT
+        region only).
         """
         if len(regions) < 2:
             raise ValueError("federation schedule needs >= 2 regions")
@@ -759,6 +767,18 @@ class FaultSchedule:
                 target=(f"{rng.choice(ordered)}:"
                         f"{rng.choice(API_BURST_OPERATIONS)}"),
                 param=rng.randint(1, 3)))
+        # watch-path faults, drawn AFTER the legacy pool so existing
+        # seeds keep their legacy event streams verbatim
+        for region in rng.sample(ordered, rng.randint(1, 2)):
+            start = rng.uniform(horizon * 0.1, horizon * 0.6)
+            events.append(FaultEvent(
+                at=start, kind=FAULT_WATCH_DELAY, target=region,
+                until=start + rng.uniform(30.0, 90.0),
+                param=rng.randint(0, 9999)))
+        events.append(FaultEvent(
+            at=rng.uniform(horizon * 0.1, horizon * 0.6),
+            kind=FAULT_WATCH_BREAK, target=rng.choice(ordered),
+            param=rng.randint(0, 1)))
         events.sort(key=lambda e: (e.at, e.kind, e.target))
         return cls(seed=seed, events=tuple(events))
 

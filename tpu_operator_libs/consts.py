@@ -889,6 +889,35 @@ class FederationKeys:
         return f"{self.domain}/{self.driver}-fed.probe"
 
     @property
+    def preshift_reservation_annotation(self) -> str:
+        """RESERVE-REGION DaemonSet annotation:
+        ``<source-region>:<revision-hash>:<slots>:<epoch>`` — the
+        federation's durable claim of session capacity in this region
+        on behalf of ``source-region`` before that region is admitted
+        to ``revision-hash``. The PrewarmCoordinator reserve→ready
+        commit #1 lifted to region granularity: written BEFORE warmup
+        starts so a crash between reservation and readiness leaves a
+        findable claim, never an orphaned warm pool. ``slots`` is the
+        interactive-session count the reserve must absorb. Released
+        (with the ready stamp, in ONE patch) once the source region's
+        rollout quiesced — zero residue is an fsck-audited invariant."""
+        return f"{self.domain}/{self.driver}-fed.preshift-reservation"
+
+    @property
+    def preshift_ready_annotation(self) -> str:
+        """RESERVE-REGION DaemonSet annotation:
+        ``<source-region>:<revision-hash>:<epoch>`` — commit #2 of the
+        region-level pre-shift pair: the reserve capacity passed its
+        readiness probe and the source region's interactive sessions
+        may be routed here. The source region is admitted only after
+        this stamp exists (reserve→ready→admit ordering), so a region
+        admission never races its own traffic off a cliff. Ready
+        implies reservation; a ready stamp without its reservation is
+        a torn write the auditor flags. Both stamps are deleted in the
+        same merge patch on release (crash-atomic, zero residue)."""
+        return f"{self.domain}/{self.driver}-fed.preshift-ready"
+
+    @property
     def event_reason(self) -> str:
         """Reason string attached to Kubernetes events."""
         return f"{self.driver.upper()}FederatedRollout"

@@ -18,6 +18,12 @@ already consumes:
   level: a GLOBAL disruption budget split into durable per-region
   share stamps, spent under decrease-immediate/increase-next-pass with
   a raise gate that freezes fleet-wide while any region reads stale.
+- :class:`~tpu_operator_libs.federation.region_watch.RegionWatcher` —
+  the O(changed-regions) read path: per-region watch streams feeding
+  informer caches, so a 50-region steady-state pass reads only the
+  regions whose streams delivered events, with a staleness bound on
+  each region's change cursor standing in for the per-pass freshness
+  probe round-trip.
 
 Robustness is the headline property, so the subsystem ships inside a
 standing chaos gate from day one: ``make test-federation`` drives a
@@ -36,6 +42,7 @@ from tpu_operator_libs.federation.controller import (
     RegionView,
 )
 from tpu_operator_libs.federation.ledger import FederationBudgetLedger
+from tpu_operator_libs.federation.region_watch import RegionWatcher
 
 __all__ = [
     "FederationBudgetLedger",
@@ -43,4 +50,5 @@ __all__ = [
     "FederationPolicySpec",
     "RegionHandle",
     "RegionView",
+    "RegionWatcher",
 ]

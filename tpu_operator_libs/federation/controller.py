@@ -33,6 +33,26 @@ it is never admitted, and — because a stale read could hide a share
 stamp a previous incarnation granted — no region's share anywhere may
 be RAISED until the whole fleet reads fresh again (decreases stay
 allowed; they only tighten the global inequality).
+
+Read path: with ``watch=True`` the per-region poll is replaced by
+per-region watch streams feeding informer caches
+(:class:`~tpu_operator_libs.federation.region_watch.RegionWatcher`):
+a steady-state pass reads only regions whose streams delivered
+events, stream drops fall back to a targeted relist of that region
+only, and the freshness probe becomes a staleness bound on the
+region's change cursor. Both modes feed the same per-pass read
+accounting (``fed_api_reads`` / ``fed_read_objects`` /
+``fed_relists`` and the status ``reads`` block — the
+``read_accounting()`` idiom of k8s/cached.py), which is how the
+50-region bench proves the O(changed-regions) claim.
+
+Session pre-shift: before admitting a region, the controller reserves
+session capacity in an adjacent region via a durable region-level
+reservation→ready stamp pair on the reserve region's DaemonSet (the
+PrewarmCoordinator idiom of upgrade/handover.py lifted to region
+granularity — reserve crash-ordered before ready, both released in
+ONE patch, zero residue), requires readiness, then admits — so a
+region admission drops zero interactive sessions globally.
 """
 
 from __future__ import annotations
@@ -52,6 +72,7 @@ from tpu_operator_libs.consts import (
     UpgradeState,
 )
 from tpu_operator_libs.federation.ledger import FederationBudgetLedger
+from tpu_operator_libs.federation.region_watch import RegionWatcher
 from tpu_operator_libs.k8s.client import (
     ApiServerError,
     ConflictError,
@@ -106,6 +127,17 @@ class RegionHandle:
     #: richer feed one at a time.
     capacity_status: Optional[Callable[[], Optional[dict]]] = None
     roll: Optional[Callable[[str], None]] = None
+    #: Live interactive-session count hosted by this region (the
+    #: pre-shift reservation's ``slots`` sizing). None falls back to
+    #: the region's node census — a conservative proxy.
+    sessions: Optional[Callable[[], int]] = None
+    #: Readiness probe for this region AS A RESERVE: called with
+    #: ``(slots, reserved_at_epoch)``, True once the reserved session
+    #: capacity is actually serving-ready. None = ready immediately
+    #: (the PrewarmCoordinator "broken hook must not wedge" posture is
+    #: inverted here on purpose: a region with no warmup signal has
+    #: nothing to warm).
+    preshift_ready: Optional[Callable[[int, float], bool]] = None
 
     def roll_to(self, revision: str) -> None:
         if self.roll is not None:
@@ -136,6 +168,12 @@ class RegionView:
     #: The region's live capacity picture when its handle exposes the
     #: real controller status block (None = scalar-signal region).
     capacity: Optional[dict] = None
+    #: Raw pre-shift stamps found on THIS region's DS (this region is
+    #: the RESERVE of the pair's source region): reservation
+    #: ``<source>:<revision>:<slots>:<epoch>``, ready
+    #: ``<source>:<revision>:<epoch>``; "" when absent.
+    preshift_reservation: str = ""
+    preshift_ready: str = ""
 
     def done_on(self, revision: str) -> bool:
         """Region fully converged on ``revision``: DS points at it,
@@ -157,7 +195,8 @@ class FederationController:
                  keys: Optional[FederationKeys] = None,
                  upgrade_keys: Optional[UpgradeKeys] = None,
                  clock: Optional[Clock] = None,
-                 audit: Optional[DecisionAudit] = None) -> None:
+                 audit: Optional[DecisionAudit] = None,
+                 watch: bool = False) -> None:
         if not regions:
             raise ValueError("at least one region is required")
         names = [handle.name for handle in regions]
@@ -184,8 +223,26 @@ class FederationController:
         self._region_totals: "dict[str, int]" = {}
         #: region -> virtual time it started waiting for its trough.
         self._trough_wait_started: "dict[str, float]" = {}
+        #: region -> virtual time it started waiting for a pre-shift
+        #: reserve (liveness bookkeeping, same restart trade as the
+        #: trough wait).
+        self._preshift_wait_started: "dict[str, float]" = {}
         self._last_views: "dict[str, RegionView]" = {}
         self._last_target = ""
+        # -- watch mode (O(changed-regions) reads) --
+        self.watch = watch
+        self._watchers: "dict[str, RegionWatcher]" = {}
+        if watch:
+            for handle in regions:
+                self._watchers[handle.name] = RegionWatcher(
+                    handle.name, handle.client, handle.namespace,
+                    handle.ds_name, self.keys.probe_annotation,
+                    self._clock,
+                    staleness_seconds=self.policy
+                    .watch_staleness_seconds)
+        #: region -> change cursor at the end of the last pass (the
+        #: per-pass ``regionsChanged`` evidence).
+        self._last_cursors: "dict[str, int]" = {}
         # -- lifetime counters (metrics.observe_federation feed) --
         self.admissions_total = 0
         self.quarantine_stamps_total = 0
@@ -202,12 +259,28 @@ class FederationController:
         #: lifetime region admissions deferred by a required-mode
         #: preflight breach (metrics/chaos teeth).
         self.preflight_rejections_total = 0
+        # -- read accounting (k8s/cached.py read_accounting() idiom,
+        # lifted to the federation pass; poll mode counts its lists,
+        # watch mode aggregates the RegionWatchers) --
+        self.fed_api_reads = 0
+        self.fed_read_objects = 0
+        self.fed_relists = 0
+        self.fed_probe_writes = 0
+        self._last_reads_block: "dict" = {}
+        # -- session pre-shift lifetime counters --
+        self.preshift_reservations_total = 0
+        self.preshift_ready_total = 0
+        self.preshift_released_total = 0
+        self.preshift_holds_total = 0
+        self.preshift_expired_waits_total = 0
 
     # ------------------------------------------------------------------
     # region reads
     # ------------------------------------------------------------------
     def _read_region(self, handle: RegionHandle, now: float,
                      target: str) -> RegionView:
+        if self.watch:
+            return self._read_region_watch(handle, now, target)
         view = RegionView(name=handle.name)
         client = handle.client
         probe_value = f"{now:g}"
@@ -217,10 +290,13 @@ class FederationController:
                 handle.namespace, handle.ds_name,
                 {self.keys.probe_annotation: probe_value})
             probed = True
+            self.fed_probe_writes += 1
         except _TRANSIENTS:
             self.partitioned_reads_total += 1
         try:
+            self.fed_api_reads += 1
             daemon_sets = client.list_daemon_sets(handle.namespace)
+            self.fed_read_objects += len(daemon_sets)
             ds = next((d for d in daemon_sets
                        if d.metadata.name == handle.ds_name), None)
             if ds is not None:
@@ -231,32 +307,80 @@ class FederationController:
                 # here even when the write "succeeded" before the cut
                 view.reachable = probed and annotations.get(
                     self.keys.probe_annotation) == probe_value
-                view.share = self.ledger.share_from(annotations)
-                quarantined = annotations.get(
-                    self.upgrade_keys.quarantined_revision_annotation)
-                if quarantined:
-                    view.quarantined = frozenset({quarantined})
-                view.bake_stamp = annotations.get(
-                    self.keys.bake_passed_annotation, "")
+                self._fill_view_annotations(view, annotations)
                 view.newest = self._newest_revision(client, handle, ds)
+            self.fed_api_reads += 1
             nodes = client.list_nodes()
-            view.total = len(nodes)
-            state_label = self.upgrade_keys.state_label
-            done = str(UpgradeState.DONE)
-            for node in nodes:
-                if node.metadata.labels.get(state_label) == done:
-                    view.nodes_done += 1
-                if node.is_unschedulable() or not node.is_ready():
-                    view.unavailable += 1
+            self.fed_read_objects += len(nodes)
+            self._fill_view_nodes(view, nodes)
+            self.fed_api_reads += 1
             pods = client.list_pods(namespace=handle.namespace)
-            view.ready_on_target = sum(
-                1 for pod in pods
-                if pod.controller_owner() is not None
-                and pod.metadata.labels.get(
-                    POD_CONTROLLER_REVISION_HASH_LABEL) == target
-                and pod.is_ready())
+            self.fed_read_objects += len(pods)
+            view.ready_on_target = self._ready_on_target(pods, target)
         except _TRANSIENTS:
             view.reachable = False
+        if view.reachable:
+            self._region_totals[handle.name] = view.total
+        return view
+
+    def _fill_view_annotations(self, view: RegionView,
+                               annotations: "dict") -> None:
+        view.share = self.ledger.share_from(annotations)
+        quarantined = annotations.get(
+            self.upgrade_keys.quarantined_revision_annotation)
+        if quarantined:
+            view.quarantined = frozenset({quarantined})
+        view.bake_stamp = annotations.get(
+            self.keys.bake_passed_annotation, "")
+        view.preshift_reservation = annotations.get(
+            self.keys.preshift_reservation_annotation, "")
+        view.preshift_ready = annotations.get(
+            self.keys.preshift_ready_annotation, "")
+
+    def _fill_view_nodes(self, view: RegionView, nodes: list) -> None:
+        view.total = len(nodes)
+        state_label = self.upgrade_keys.state_label
+        done = str(UpgradeState.DONE)
+        for node in nodes:
+            if node.metadata.labels.get(state_label) == done:
+                view.nodes_done += 1
+            if node.is_unschedulable() or not node.is_ready():
+                view.unavailable += 1
+
+    @staticmethod
+    def _ready_on_target(pods: list, target: str) -> int:
+        return sum(
+            1 for pod in pods
+            if pod.controller_owner() is not None
+            and pod.metadata.labels.get(
+                POD_CONTROLLER_REVISION_HASH_LABEL) == target
+            and pod.is_ready())
+
+    def _read_region_watch(self, handle: RegionHandle, now: float,
+                           target: str) -> RegionView:
+        """The O(changed-regions) read: pump the region's streams,
+        re-probe only when the staleness bound asks, and build the
+        view entirely from informer caches (journal-overlaid). A
+        steady-state unchanged region costs ZERO list reads here."""
+        watcher = self._watchers[handle.name]
+        view = RegionView(name=handle.name)
+        pumped = watcher.pump()
+        if not pumped:
+            self.partitioned_reads_total += 1
+        watcher.maybe_probe(now)
+        ds = watcher.cached_daemon_set()
+        if ds is not None:
+            view.ds_found = True
+            self._fill_view_annotations(view, watcher.annotations())
+            view.newest = watcher.newest_revision()
+        # freshness: the probe's own event observed back through the
+        # stream, within the staleness bound — the cursor-freshness
+        # contract replacing the per-pass write+read-back round trip
+        view.reachable = (pumped and view.ds_found
+                          and watcher.is_fresh(now))
+        self._fill_view_nodes(view, watcher.cached_nodes())
+        view.ready_on_target = self._ready_on_target(
+            watcher.cached_pods(), target)
         if view.reachable:
             self._region_totals[handle.name] = view.total
         return view
@@ -293,6 +417,8 @@ class FederationController:
         now = self._clock.now()
         self.passes_total += 1
         self.audit.begin_pass()
+        reads_before = (self.fed_api_reads, self.fed_read_objects,
+                        self.fed_relists, self.fed_probe_writes)
         policy = self.policy
         if not policy.enable or not target_revision:
             self.last_status = {"target": target_revision,
@@ -339,6 +465,36 @@ class FederationController:
                         view.utilization = None  # must not wedge a pass
         self._last_views = views
         self._last_target = target_revision
+        # per-pass read accounting: watch mode aggregates the lifetime
+        # RegionWatcher counters (poll mode incremented inline above);
+        # regionsChanged compares each region's change cursor against
+        # the last pass — the O(changed-regions) evidence the bench
+        # and the soak read
+        if self.watch:
+            watchers = list(self._watchers.values())
+            self.fed_api_reads = sum(w.api_reads for w in watchers)
+            self.fed_read_objects = sum(w.read_objects
+                                        for w in watchers)
+            self.fed_relists = sum(w.relists for w in watchers)
+            self.fed_probe_writes = sum(w.probe_writes
+                                        for w in watchers)
+            changed = sum(
+                1 for name in fleet
+                if self._watchers[name].cursor
+                != self._last_cursors.get(name, 0))
+            self._last_cursors = {name: self._watchers[name].cursor
+                                  for name in fleet}
+        else:
+            changed = len(fleet)
+        self._last_reads_block = {
+            "mode": "watch" if self.watch else "poll",
+            "apiReads": self.fed_api_reads - reads_before[0],
+            "readObjects": self.fed_read_objects - reads_before[1],
+            "relists": self.fed_relists - reads_before[2],
+            "probeWrites": self.fed_probe_writes - reads_before[3],
+            "regionsChanged": changed,
+            "totalRegions": len(fleet),
+        }
         # region-admission preflight: forecast every region's rollout
         # against its live traffic signal BEFORE any admission (and
         # before any budget share is stamped); _admit consults the
@@ -360,6 +516,11 @@ class FederationController:
 
         baked, bake_at = self._bake_state(views, canary,
                                           target_revision)
+        # pre-shift release sweep runs even when halted (and even if
+        # the policy knob was just switched off): a rollback that
+        # quiesced must still free its reserve, and residue from a
+        # previous incarnation must never outlive its source's arc
+        self._preshift_sweep(views, target_revision, now, halted)
         admitted: list[str] = []
         if not halted:
             admitted = self._admit(views, canary, target_revision,
@@ -378,6 +539,19 @@ class FederationController:
             "globalBudget": self._global_budget(views),
             "shares": shares,
             "admittedThisPass": admitted,
+            "reads": dict(self._last_reads_block),
+            "preshift": {
+                "enabled": policy.session_pre_shift,
+                "reservations": {
+                    name: view.preshift_reservation
+                    for name, view in sorted(views.items())
+                    if view.preshift_reservation},
+                "ready": {
+                    name: view.preshift_ready
+                    for name, view in sorted(views.items())
+                    if view.preshift_ready},
+                "waiting": sorted(self._preshift_wait_started),
+            },
             "regions": {
                 name: {
                     "reachable": view.reachable,
@@ -420,10 +594,23 @@ class FederationController:
         for a byte-stable choice."""
         if self.policy.canary_region:
             return self.policy.canary_region
+        return self._wave_order(views, views)[0]
+
+    @staticmethod
+    def _wave_order(views: "dict[str, RegionView]",
+                    names: "object") -> "list[str]":
+        """Deterministic follow-the-sun order: utilization ascending,
+        unknown-signal regions last, ties broken by region name. The
+        utilization is ROUNDED before comparison — live float signals
+        jitter in the low decimals across controller incarnations, and
+        an unrounded 1e-12 difference silently reorders what should be
+        a name-broken tie, making wave order (and the elected canary)
+        incarnation-dependent. Shared by admission, the canary
+        election, and the pre-shift reserve pick."""
         def rank(name: str) -> tuple:
             u = views[name].utilization
-            return (u if u is not None else 2.0, name)
-        return min(sorted(views), key=rank)
+            return (round(u, 6) if u is not None else 2.0, name)
+        return sorted(names, key=rank)
 
     # ------------------------------------------------------------------
     # quarantine lift (canary containment's second half)
@@ -439,10 +626,8 @@ class FederationController:
             view = views[name]
             if not view.reachable or target in view.quarantined:
                 continue
-            handle = self.regions[name]
             try:
-                self.regions[name].client.patch_daemon_set_annotations(
-                    handle.namespace, handle.ds_name, {key: target})
+                self._patch_region(name, {key: target})
             except _TRANSIENTS as exc:
                 logger.warning("quarantine stamp for region %s "
                                "deferred: %s", name, exc)
@@ -480,12 +665,10 @@ class FederationController:
                 pass  # corrupt stamp: fall through and re-derive
         if not view.done_on(target) or target in view.quarantined:
             return False, None
-        handle = self.regions[canary]
         now = self._clock.now()
         try:
-            handle.client.patch_daemon_set_annotations(
-                handle.namespace, handle.ds_name,
-                {self.keys.bake_passed_annotation: f"{target}:{now:g}"})
+            self._patch_region(canary, {
+                self.keys.bake_passed_annotation: f"{target}:{now:g}"})
         except _TRANSIENTS as exc:
             logger.warning("bake stamp for %s deferred: %s", target, exc)
             return False, None
@@ -602,9 +785,15 @@ class FederationController:
                 and canary_view.ds_found \
                 and canary_view.newest != target \
                 and target not in canary_view.quarantined \
-                and not self._preflight_defers(canary):
+                and not self._preflight_defers(canary) \
+                and not self._holder_defers(views, canary) \
+                and self._preshift_gate(views, canary, target, now):
             if self._roll(canary, target, rule="canary-region"):
                 admitted.append(canary)
+                # mark the roll in this pass's views so later gate
+                # calls see the region as mid-upgrade (never picked
+                # as a reserve in the same pass it was admitted)
+                canary_view.newest = target
         if not baked:
             for name in sorted(views):
                 if name != canary and views[name].newest != target:
@@ -622,9 +811,7 @@ class FederationController:
                       and views[name].reachable
                       and views[name].ds_found
                       and views[name].newest != target]
-        candidates.sort(key=lambda name: (
-            views[name].utilization
-            if views[name].utilization is not None else 2.0, name))
+        candidates = self._wave_order(views, candidates)
         if not self.policy.follow_the_sun:
             candidates.sort()
         for name in candidates:
@@ -643,11 +830,38 @@ class FederationController:
                 continue
             if self._preflight_defers(name):
                 continue
+            if self._holder_defers(views, name):
+                continue
+            if not self._preshift_gate(views, name, target, now):
+                continue
             if self._roll(name, target, rule="follow-the-sun"):
                 admitted.append(name)
+                views[name].newest = target
                 slots -= 1
                 self._trough_wait_started.pop(name, None)
         return admitted
+
+    def _holder_defers(self, views: "dict[str, RegionView]",
+                       region: str) -> bool:
+        """A region currently hosting another region's pre-shifted
+        sessions (it holds a live reservation) must not itself be
+        admitted: its reserved capacity is spoken for, and disrupting
+        it would drop exactly the sessions the pair protects. The
+        release sweep frees it once the source quiesces (audited,
+        bounded by the source's own rollout — no extra liveness knob
+        needed)."""
+        if not views[region].preshift_reservation:
+            return False
+        source = ""
+        parsed = self._parse_reservation(
+            views[region].preshift_reservation)
+        if parsed is not None:
+            source = parsed[0]
+        self.preshift_holds_total += 1
+        self.audit.record_hold(
+            region, rule="reserve-holder",
+            inputs={"source": source})
+        return True
 
     def _in_trough(self, view: RegionView, now: float) -> bool:
         """Follow-the-sun gate: the region's live utilization must be
@@ -680,6 +894,11 @@ class FederationController:
             logger.warning("admission roll of region %s to %s "
                            "deferred: %s", region, target, exc)
             return False
+        if self.watch:
+            # the roll made ``target`` the newest revision
+            # synchronously; tell the watcher so a delayed DS event
+            # cannot make the next pass re-admit this region
+            self._watchers[region].note_rolled(target)
         self.admissions_total += 1
         self.audit.record(
             "fed-admit", region, decision=f"rolled to {target}",
@@ -687,6 +906,301 @@ class FederationController:
         logger.info("federation: region %s admitted to revision %s "
                     "(%s)", region, target, rule)
         return True
+
+    def _patch_region(self, region: str,
+                      annotations: "dict[str, Optional[str]]") -> None:
+        """Single write seam for region DS annotations: in watch mode
+        the write goes through the RegionWatcher so it lands in the
+        own-write journal (the next pass trusts the stamped truth even
+        while the MODIFIED event is delayed); ``None`` deletes a key.
+        Transients propagate — callers keep defer-and-retry."""
+        if self.watch:
+            self._watchers[region].patch_annotations(annotations)
+            return
+        handle = self.regions[region]
+        handle.client.patch_daemon_set_annotations(
+            handle.namespace, handle.ds_name, annotations)
+
+    # ------------------------------------------------------------------
+    # cross-region session pre-shift (PrewarmCoordinator at region
+    # granularity: reserve crash-ordered before ready, released in ONE
+    # patch, zero residue — the stamps ARE the state machine)
+    # ------------------------------------------------------------------
+    @staticmethod
+    def _parse_reservation(
+            value: str) -> "Optional[tuple[str, str, int, float]]":
+        """``<source>:<revision>:<slots>:<epoch>`` or None."""
+        parts = value.split(":")
+        if len(parts) != 4:
+            return None
+        try:
+            return parts[0], parts[1], int(parts[2]), float(parts[3])
+        except ValueError:
+            return None
+
+    @staticmethod
+    def _parse_ready(
+            value: str) -> "Optional[tuple[str, str, float]]":
+        """``<source>:<revision>:<epoch>`` or None."""
+        parts = value.split(":")
+        if len(parts) != 3:
+            return None
+        try:
+            return parts[0], parts[1], float(parts[2])
+        except ValueError:
+            return None
+
+    def _preshift_sweep(self, views: "dict[str, RegionView]",
+                        target: str, now: float,
+                        halted: bool) -> None:
+        """Release reservation→ready pairs whose source region's
+        admission arc is over. The reserve is held while the source is
+        DISRUPTING (nodes out, mid-upgrade, mid-rollback — shifted
+        sessions still live on the reserve) and while the source is
+        PENDING admission to the reserved revision (the gate stamped
+        it; the roll follows when readiness lands). Everything else —
+        source converged, rolled back and quiesced, target moved on,
+        source gone, stamp corrupt — releases BOTH stamps in one
+        patch, so no pass boundary can observe a half-released pair
+        and a converged fleet carries zero residue (the fsck gate)."""
+        for name in sorted(views):
+            view = views[name]
+            if not view.reachable or not view.preshift_reservation:
+                continue
+            parsed = self._parse_reservation(view.preshift_reservation)
+            source = parsed[0] if parsed else ""
+            release = False
+            if parsed is None:
+                release = True  # corrupt stamp (fsck would drop it)
+            else:
+                revision = parsed[1]
+                src = views.get(source)
+                if src is None:
+                    release = True  # source left the fleet: orphan
+                elif not src.reachable:
+                    continue  # stale info: never release blind
+                elif revision != target:
+                    # stale pair: the target moved on, so the stale
+                    # arc can never resume — its share is revoked
+                    # (decrease-immediate) and its operator freezes.
+                    # Release once the revocation is VISIBLE on the
+                    # source's stamp and its capacity is whole; a
+                    # fresh pair protects the source's admission to
+                    # the new target. Waiting for full node-DONE
+                    # quiescence here would deadlock: a region frozen
+                    # mid-upgrade by a promotion only recovers via an
+                    # admission the held reserve may itself block.
+                    release = (not src.share
+                               and src.unavailable == 0)
+                else:
+                    # quiesced: every node DONE and back in service —
+                    # the source's sessions have capacity at home again
+                    quiesced = (src.total > 0
+                                and src.nodes_done == src.total
+                                and src.unavailable == 0)
+                    # mid-arc: admitted to the reserved revision but
+                    # pods not all Ready yet
+                    mid_arc = (src.newest == revision
+                               and not src.done_on(revision))
+                    # pending: the gate stamped this pair and the roll
+                    # follows once readiness lands
+                    pending = (not halted
+                               and src.newest != revision)
+                    release = quiesced and not mid_arc and not pending
+            if not release:
+                continue
+            try:
+                self._patch_region(name, {
+                    self.keys.preshift_reservation_annotation: None,
+                    self.keys.preshift_ready_annotation: None})
+            except _TRANSIENTS as exc:
+                logger.warning("pre-shift release on region %s "
+                               "deferred: %s", name, exc)
+                continue
+            view.preshift_reservation = ""
+            view.preshift_ready = ""
+            self.preshift_released_total += 1
+            if source:
+                self._preshift_wait_started.pop(source, None)
+            self.audit.record(
+                "fed-preshift", name,
+                decision=f"released reserve held for {source or '?'}",
+                rule="preshift-release",
+                inputs={"source": source})
+            logger.info("federation: released pre-shift reserve on "
+                        "region %s (source %s quiesced)", name,
+                        source or "?")
+
+    def _pick_reserve(self, views: "dict[str, RegionView]",
+                      source: str,
+                      target: str) -> "tuple[list[str], list[str]]":
+        """(eligible, free) reserve regions for ``source``. Eligible:
+        reachable, DS present, and not mid-upgrade on the target (a
+        region whose own capacity is shrinking cannot absorb shifted
+        sessions). Free: eligible and not already holding a
+        reservation (one pair per reserve DS — holder-busy defers).
+        Preference order inside ``free``: the canary region LAST no
+        matter what (it is the first region disrupted on every future
+        revision, so a pair parked there blocks the very admission
+        that would release it), then regions already converged on the
+        target first (they will not be disrupted again this rollout),
+        then HIGHEST utilization — follow-the-sun admits the quiet
+        regions first, so the busiest region is admitted last and
+        stays stable as a reserve — ties by name."""
+        canary = self._canary_region(views) if views else ""
+        eligible: "list[str]" = []
+        for name in sorted(views):
+            if name == source:
+                continue
+            view = views[name]
+            if not view.reachable or not view.ds_found:
+                continue
+            if view.newest == target and not view.done_on(target):
+                continue
+            eligible.append(name)
+        free = [name for name in eligible
+                if not views[name].preshift_reservation]
+        def rank(name: str) -> tuple:
+            view = views[name]
+            u = view.utilization
+            return (1 if name == canary else 0,
+                    0 if view.done_on(target) else 1,
+                    -(round(u, 6) if u is not None else -1.0), name)
+        free.sort(key=rank)
+        return eligible, free
+
+    def _preshift_gate(self, views: "dict[str, RegionView]",
+                       region: str, target: str, now: float) -> bool:
+        """Zero-drop admission gate: True only once an adjacent region
+        holds a READY reservation for this region's sessions (or the
+        policy/fleet shape makes pre-shift moot). Crash-restart
+        resumes from the stamps alone: an existing reservation for
+        (region, target) is adopted, never re-stamped."""
+        policy = self.policy
+        if not policy.session_pre_shift:
+            return True
+        handle = self.regions[region]
+        slots: "Optional[int]" = None
+        if handle.sessions is not None:
+            try:
+                slots = int(handle.sessions())
+            except Exception:  # noqa: BLE001 — a broken signal must
+                slots = None  # not wedge the rollout
+        if slots is None:
+            slots = views[region].total  # census: conservative proxy
+        if slots <= 0:
+            return True
+        holder = ""
+        reserved_slots, reserved_at = slots, now
+        for name in sorted(views):
+            if name == region:
+                continue
+            parsed = self._parse_reservation(
+                views[name].preshift_reservation)
+            if parsed is not None and parsed[0] == region \
+                    and parsed[1] == target:
+                holder = name
+                reserved_slots, reserved_at = parsed[2], parsed[3]
+                break
+        if not holder:
+            eligible, free = self._pick_reserve(views, region, target)
+            if not eligible:
+                # a fleet with no possible spare can never pre-shift;
+                # admit (audited) rather than park the rollout forever
+                self.audit.record(
+                    "fed-preshift", region,
+                    decision="admitted without reserve",
+                    rule="preshift-no-reserve",
+                    inputs={"slots": slots})
+                return True
+            if not free:
+                return self._preshift_hold(
+                    region, now, holder="", slots=slots,
+                    why="holder-busy")
+            reserve = free[0]
+            value = f"{region}:{target}:{slots}:{now:g}"
+            try:
+                self._patch_region(reserve, {
+                    self.keys.preshift_reservation_annotation: value})
+            except _TRANSIENTS as exc:
+                logger.warning("pre-shift reservation on region %s "
+                               "deferred: %s", reserve, exc)
+                return self._preshift_hold(
+                    region, now, holder=reserve, slots=slots,
+                    why="reservation-write-deferred")
+            views[reserve].preshift_reservation = value
+            self.preshift_reservations_total += 1
+            self.audit.record(
+                "fed-preshift", region,
+                decision=f"reserved {slots} slot(s) in {reserve}",
+                rule="preshift-reserve",
+                inputs={"reserve": reserve, "slots": slots})
+            holder, reserved_slots, reserved_at = reserve, slots, now
+        ready_stamp = self._parse_ready(views[holder].preshift_ready)
+        if ready_stamp is not None and ready_stamp[0] == region \
+                and ready_stamp[1] == target:
+            self._preshift_wait_started.pop(region, None)
+            return True
+        hook = self.regions[holder].preshift_ready
+        hook_ready = True  # no warmup signal = nothing to warm
+        if hook is not None:
+            try:
+                hook_ready = bool(hook(reserved_slots, reserved_at))
+            except Exception:  # noqa: BLE001 — a broken hook must not
+                hook_ready = True  # wedge the rollout (prewarm posture)
+        if hook_ready:
+            value = f"{region}:{target}:{now:g}"
+            try:
+                self._patch_region(holder, {
+                    self.keys.preshift_ready_annotation: value})
+            except _TRANSIENTS as exc:
+                logger.warning("pre-shift ready stamp on region %s "
+                               "deferred: %s", holder, exc)
+                return self._preshift_hold(
+                    region, now, holder=holder, slots=reserved_slots,
+                    why="ready-write-deferred")
+            views[holder].preshift_ready = value
+            self.preshift_ready_total += 1
+            self._preshift_wait_started.pop(region, None)
+            self.audit.record(
+                "fed-preshift", region,
+                decision=f"reserve {holder} ready",
+                rule="preshift-ready",
+                inputs={"reserve": holder, "slots": reserved_slots})
+            return True
+        return self._preshift_hold(
+            region, now, holder=holder, slots=reserved_slots,
+            why="warming")
+
+    def _preshift_hold(self, region: str, now: float, holder: str,
+                       slots: int, why: str) -> bool:
+        """Bounded pre-shift wait (liveness): holds are audited, and a
+        region that cannot reach a ready reserve within
+        ``maxPreshiftWaitSeconds`` is admitted anyway (audited) — a
+        missing or never-warming spare must not park the rollout.
+        In-memory bookkeeping: a controller restart restarts the wait,
+        delaying liveness by at most one window, never safety."""
+        started = self._preshift_wait_started.setdefault(region, now)
+        if now - started >= self.policy.max_preshift_wait_seconds:
+            self.preshift_expired_waits_total += 1
+            self._preshift_wait_started.pop(region, None)
+            self.audit.record(
+                "fed-preshift", region,
+                decision="admitted after pre-shift wait expired",
+                rule="preshift-wait-expired",
+                inputs={"waitedSeconds": round(now - started, 1),
+                        "reserve": holder or None, "why": why})
+            logger.warning(
+                "federation: region %s admitted after %ds pre-shift "
+                "wait (%s) — sessions may drop", region,
+                int(now - started), why)
+            return True
+        self.preshift_holds_total += 1
+        self.audit.record_hold(
+            region, rule="awaiting-preshift",
+            inputs={"reserve": holder or None, "slots": slots,
+                    "why": why})
+        return False
 
     # ------------------------------------------------------------------
     # budget shares (the lifted PR 7 ledger)
@@ -765,11 +1279,9 @@ class FederationController:
         return shares
 
     def _stamp_share(self, region: str, share: int) -> bool:
-        handle = self.regions[region]
         try:
-            handle.client.patch_daemon_set_annotations(
-                handle.namespace, handle.ds_name,
-                {self.keys.budget_share_annotation: str(share)})
+            self._patch_region(region, {
+                self.keys.budget_share_annotation: str(share)})
         except _TRANSIENTS as exc:
             logger.warning("share stamp for region %s deferred: %s",
                            region, exc)
@@ -836,6 +1348,12 @@ class FederationController:
                     f"{forecast['horizonSeconds']:.0f}s horizon — no "
                     f"roll and no budget-share stamp until the "
                     f"forecast clears")
+            if region in self._preshift_wait_started:
+                chain.append(
+                    "holding for session pre-shift: no reserve region "
+                    "has a ready reservation for its sessions yet "
+                    "(bounded by maxPreshiftWaitSeconds="
+                    f"{self.policy.max_preshift_wait_seconds})")
             if region != canary and not status.get("baked"):
                 chain.append(f"held behind the canary region "
                              f"{canary!r}: the target revision lacks "

@@ -20,6 +20,16 @@ annotation on that region's own runtime DaemonSet:
   controller (which knows nothing but what the regions' stamps say)
   can never let two regions jointly overdraw.
 
+"Freshly read" is a pluggable contract, not necessarily a GET: in the
+polled read path it means the per-pass probe annotation read back; in
+the watch-driven path (federation/region_watch.py) it means the
+region's probe ECHO — the probe's own MODIFIED event observed back
+through the watch stream — is within the policy's staleness bound,
+with the own-write journal guaranteeing the controller's own share
+stamps are never summed stale while their events are still in flight.
+Either way the raise gate's invariant is the same: no raise anywhere
+until every region's stamp is trusted current.
+
 The arithmetic (largest-remainder proportional split) is shared with
 the shard ledger via :func:`~tpu_operator_libs.k8s.sharding.
 split_budget`, which is key-type generic for exactly this reason.

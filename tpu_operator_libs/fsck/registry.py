@@ -223,6 +223,23 @@ def _is_name_epoch(value: str) -> bool:
     return _is_hash_epoch(value)
 
 
+def _is_preshift_reservation(value: str) -> bool:
+    """``<source>:<revision>:<slots>:<epoch>`` (region pre-shift
+    reserve stamps)."""
+    parts = value.split(":")
+    return (len(parts) == 4 and _is_token(parts[0])
+            and _is_token(parts[1]) and _is_nonneg_int(parts[2])
+            and _is_epoch(parts[3]))
+
+
+def _is_preshift_ready(value: str) -> bool:
+    """``<source>:<revision>:<epoch>`` (region pre-shift ready
+    stamps)."""
+    parts = value.split(":")
+    return (len(parts) == 3 and _is_token(parts[0])
+            and _is_token(parts[1]) and _is_epoch(parts[2]))
+
+
 def _is_phase_stamp(value: str) -> bool:
     from tpu_operator_libs.upgrade.predictor import _parse_stamp
 
@@ -353,6 +370,13 @@ def default_registry(driver: str = "libtpu",
         if up.prewarm_reservation_annotation not in ctx.annotations:
             return ("prewarm-ready join stamp without its reserve stamp "
                     "— a torn half-of-a-pair write (ready implies "
+                    "reservation; never invent the missing half)")
+        return None
+
+    def _torn_preshift_ready(value: str, ctx: AuditContext) -> Optional[str]:
+        if fed.preshift_reservation_annotation not in ctx.annotations:
+            return ("pre-shift ready stamp without its reservation — a "
+                    "torn half-of-a-pair write (ready implies "
                     "reservation; never invent the missing half)")
         return None
 
@@ -608,6 +632,21 @@ def default_registry(driver: str = "libtpu",
             "freshness probe, re-stamped every pass; absent reads as "
             "unreachable (shares may only decrease)",
             validate=_is_epoch),
+        DurableKeySpec(
+            fed.preshift_reservation_annotation, "federation",
+            KIND_DS_ANNOTATION, "<source>:<revision>:<slots>:<epoch>",
+            REPAIR_DROP,
+            "region-level pre-shift RESERVE stamp, crash-ordered before "
+            "the ready stamp; released with it in ONE patch when the "
+            "source region's rollout quiesced (zero residue)",
+            validate=_is_preshift_reservation),
+        DurableKeySpec(
+            fed.preshift_ready_annotation, "federation",
+            KIND_DS_ANNOTATION, "<source>:<revision>:<epoch>",
+            REPAIR_DROP,
+            "pre-shift commit #2: sessions may route here; a ready "
+            "stamp without its reservation is a torn pair",
+            validate=_is_preshift_ready, orphaned=_torn_preshift_ready),
         # ---- fsck itself -----------------------------------------------
         DurableKeySpec(
             fsck_quarantine_annotation(driver, domain), "fsck",

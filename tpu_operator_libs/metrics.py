@@ -1639,3 +1639,43 @@ def observe_federation(registry: MetricsRegistry,
     registry.set_counter_total(
         "federation_passes_total", controller.passes_total,
         "Federation reconcile passes", labels)
+    registry.set_counter_total(
+        "federation_api_reads_total", controller.fed_api_reads,
+        "Region API read calls (lists/gets; relists in watch mode)",
+        labels)
+    registry.set_counter_total(
+        "federation_read_objects_total", controller.fed_read_objects,
+        "Objects returned by region API reads — the O(changed-"
+        "regions) headline number", labels)
+    registry.set_counter_total(
+        "federation_relists_total", controller.fed_relists,
+        "Targeted per-region relists after watch-stream drops or "
+        "compactions", labels)
+    reads = status.get("reads") or {}
+    registry.set_gauge(
+        "federation_regions_changed", reads.get("regionsChanged", 0),
+        "Regions whose watch cursor moved during the last pass",
+        labels)
+    registry.set_counter_total(
+        "federation_preshift_reservations_total",
+        controller.preshift_reservations_total,
+        "Cross-region session pre-shift reservation stamps written",
+        labels)
+    registry.set_counter_total(
+        "federation_preshift_ready_total",
+        controller.preshift_ready_total,
+        "Pre-shift reserves stamped ready (warmup confirmed)", labels)
+    registry.set_counter_total(
+        "federation_preshift_released_total",
+        controller.preshift_released_total,
+        "Pre-shift reservation pairs released by the sweep", labels)
+    registry.set_counter_total(
+        "federation_preshift_holds_total",
+        controller.preshift_holds_total,
+        "Admissions deferred awaiting a ready pre-shift reserve "
+        "(or because the region itself holds one)", labels)
+    registry.set_counter_total(
+        "federation_preshift_expired_waits_total",
+        controller.preshift_expired_waits_total,
+        "Audited admit-anyway decisions after the bounded pre-shift "
+        "wait expired", labels)
